@@ -1,0 +1,37 @@
+"""spark_rapids_tpu — a TPU-native columnar SQL/ETL accelerator framework.
+
+A from-scratch, TPU-first re-design of the capabilities of the RAPIDS
+Accelerator for Apache Spark (reference: Nqabz/spark-rapids):
+
+- transparent physical-plan rewrite with per-operator CPU fallback and
+  explain tagging (reference: sql-plugin GpuOverrides.scala / RapidsMeta.scala)
+- columnar operator implementations (scan/filter/project/agg/join/sort/
+  window/expand/generate/limit/write) lowered to jax.jit / XLA / Pallas
+  over HBM-resident columnar batches (reference: cuDF kernels via JNI)
+- HBM memory management with device->host->disk spill
+  (reference: RMM pool + RapidsBufferStore spill chain)
+- task-admission semaphore (reference: GpuSemaphore.scala)
+- typed, self-documenting config system (reference: RapidsConf.scala)
+- columnar shuffle: host-serialized fallback tier and a device-resident
+  tier moving data over ICI all-to-all across a TPU pod
+  (reference: GpuShuffleExchangeExec + RapidsShuffleManager/UCX)
+- CPU-vs-TPU equivalence test harness (reference: SparkQueryCompareTestSuite,
+  integration_tests/src/main/python/asserts.py)
+
+The compute path is JAX/XLA (jnp + Pallas kernels); the independent CPU
+oracle/fallback path is numpy. Long-context analog (arbitrarily large
+tables per partition) is handled by batch chunking + coalesce goals +
+spill tiers; distributed communication is jax.sharding collectives over
+ICI/DCN.
+"""
+
+__version__ = "0.1.0"
+
+from spark_rapids_tpu.conf import TpuConf  # noqa: F401
+
+
+def new_session(conf=None):
+    """Create a new TpuSession (the SparkSession analog)."""
+    from spark_rapids_tpu.engine.session import TpuSession
+
+    return TpuSession(conf=conf)

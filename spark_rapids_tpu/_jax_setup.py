@@ -1,0 +1,11 @@
+"""Central jax configuration, imported before any jax use in the package.
+
+Enables 64-bit types: SQL LONG/TIMESTAMP semantics require real int64.
+On TPU int64 lowers to XLA's 32-bit-pair emulation (correct, slower);
+float64 is narrowed to float32 at upload time instead (see
+columnar/batch.py:physical_np_dtype) because TPUs have no f64 hardware.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

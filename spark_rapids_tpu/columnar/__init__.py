@@ -1,0 +1,16 @@
+"""Columnar data representation (reference: sql-plugin GpuColumnVector.java,
+RapidsHostColumnVector.java, MetaUtils.scala).
+
+A columnar batch is a struct of device arrays: fixed-width data, a validity
+bitmask, and (for strings) int32 offsets + uint8 bytes. All device arrays are
+padded to a bucketed static capacity so that XLA sees stable shapes; the
+logical row count rides along as a host integer side channel.
+"""
+
+from spark_rapids_tpu.columnar.dtypes import DataType  # noqa: F401
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    ColumnarBatch,
+    ColumnVector,
+    HostColumnarBatch,
+    HostColumnVector,
+)

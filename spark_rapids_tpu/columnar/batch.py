@@ -1,0 +1,530 @@
+"""Device and host columnar batches.
+
+Reference parity:
+- GpuColumnVector.java (device column vector wrapping cudf; Spark<->cudf dtype
+  map :134-207, batch<->Table conversion :244-268, device memory accounting
+  :460-483) -> `ColumnVector` wrapping padded jax arrays.
+- RapidsHostColumnVector.java (host mirror with row accessors) ->
+  `HostColumnVector` over numpy arrays + validity mask.
+- GpuColumnarBatchBuilder (host-build-then-upload, GpuColumnVector.java:43-132)
+  -> `HostColumnarBatch.to_device()`.
+
+Shape discipline (the "dynamic shapes vs XLA static shapes" decision,
+SURVEY.md section 7 hard part #3): every device array is padded to a bucketed
+capacity (next power of two, >= 8). The logical row count is a host-side int.
+Kernels that care about the valid region take `num_rows` as a *traced scalar*
+argument and mask with `iota < num_rows`, so one compiled program serves every
+batch in the same capacity bucket.
+
+Padding convention: rows >= num_rows have validity False and zeroed data, so
+reductions/hashes over the padded tail are deterministic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401  (enables x64 before jax use)
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType, from_np
+
+MIN_CAPACITY = 8
+
+
+def bucket_capacity(n: int) -> int:
+    """Round up to the next power of two (min MIN_CAPACITY) so jit caches are
+    reused across batches of similar size."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << (int(n - 1).bit_length())
+
+
+def device_float64_supported() -> bool:
+    """TPU has no f64 hardware; DOUBLE columns are computed in f32 there and
+    the affected ops are tagged incompat (approximate-float compare in tests)."""
+    return jax.default_backend() == "cpu"
+
+
+def physical_np_dtype(dt: DataType) -> np.dtype:
+    if dt is DataType.FLOAT64 and not device_float64_supported():
+        return np.dtype(np.float32)
+    if dt is DataType.STRING:
+        return np.dtype(np.uint8)
+    return dt.to_np()
+
+
+# ---------------------------------------------------------------------------
+# Device column vector
+# ---------------------------------------------------------------------------
+class ColumnVector:
+    """A device-resident column (reference: GpuColumnVector.java).
+
+    data:     numeric/bool/date/timestamp -> [capacity] array
+              string -> uint8 [byte_capacity] array
+    offsets:  string only -> int32 [capacity + 1]
+    validity: bool [capacity]; False beyond num_rows and for SQL NULLs.
+
+    Registered as a jax pytree so whole batches can flow through jit.
+    """
+
+    __slots__ = ("dtype", "data", "validity", "offsets")
+
+    def __init__(self, dtype: DataType, data, validity, offsets=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets
+
+    @property
+    def capacity(self) -> int:
+        if self.dtype is DataType.STRING:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    def device_memory_size(self) -> int:
+        """Bytes of device memory referenced (reference:
+        GpuColumnVector.java:460-483 device-memory accounting)."""
+        size = self.data.size * self.data.dtype.itemsize
+        size += self.validity.size  # bool = 1 byte
+        if self.offsets is not None:
+            size += self.offsets.size * 4
+        return int(size)
+
+    def __repr__(self):
+        return f"ColumnVector({self.dtype.name}, cap={self.capacity})"
+
+
+def _cv_flatten(cv: ColumnVector):
+    if cv.offsets is None:
+        return (cv.data, cv.validity), (cv.dtype, False)
+    return (cv.data, cv.validity, cv.offsets), (cv.dtype, True)
+
+
+def _cv_unflatten(aux, children):
+    dtype, has_offsets = aux
+    if has_offsets:
+        data, validity, offsets = children
+        return ColumnVector(dtype, data, validity, offsets)
+    data, validity = children
+    return ColumnVector(dtype, data, validity)
+
+
+jax.tree_util.register_pytree_node(ColumnVector, _cv_flatten, _cv_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# Host column vector (CPU oracle / fallback representation)
+# ---------------------------------------------------------------------------
+class HostColumnVector:
+    """Host column: numpy data + validity (reference: RapidsHostColumnVector).
+
+    Strings are held as a numpy object array of Python str (None-free; nulls
+    are expressed only via the validity mask)."""
+
+    __slots__ = ("dtype", "data", "validity")
+
+    def __init__(self, dtype: DataType, data: np.ndarray, validity: np.ndarray):
+        assert len(data) == len(validity)
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+
+    def __len__(self):
+        return len(self.data)
+
+    @staticmethod
+    def from_pylist(values: Sequence[Any], dtype: DataType) -> "HostColumnVector":
+        n = len(values)
+        validity = np.array([v is not None for v in values], dtype=bool)
+        if dtype is DataType.STRING:
+            data = np.array([v if v is not None else "" for v in values], dtype=object)
+        else:
+            npdt = dtype.to_np()
+            zero = npdt.type(0)
+            data = np.array([v if v is not None else zero for v in values], dtype=npdt)
+        return HostColumnVector(dtype, data, validity)
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, validity: Optional[np.ndarray] = None,
+                   dtype: Optional[DataType] = None) -> "HostColumnVector":
+        arr = np.asarray(arr)
+        dt = dtype or from_np(arr.dtype)
+        if arr.dtype.kind == "M":
+            # normalize datetime64 to the documented physical units:
+            # DATE = days, TIMESTAMP = microseconds since epoch
+            unit = "D" if dt is DataType.DATE else "us"
+            nat = np.isnat(arr)
+            arr = arr.astype(f"datetime64[{unit}]").astype(dt.to_np())
+            if nat.any():
+                base = np.ones(len(arr), dtype=bool) if validity is None else \
+                    np.asarray(validity, dtype=bool)
+                validity = base & ~nat
+                arr = np.where(nat, 0, arr)
+        if dt is DataType.STRING:
+            if arr.dtype != object:
+                arr = arr.astype(object)
+            none_mask = np.fromiter((v is None for v in arr), dtype=bool,
+                                    count=len(arr))
+            if none_mask.any():
+                base = np.ones(len(arr), dtype=bool) if validity is None else \
+                    np.asarray(validity, dtype=bool)
+                validity = base & ~none_mask
+                arr = np.where(none_mask, "", arr)
+        elif arr.dtype != dt.to_np():
+            arr = arr.astype(dt.to_np())
+        if validity is None:
+            validity = np.ones(len(arr), dtype=bool)
+        return HostColumnVector(dt, np.asarray(arr), np.asarray(validity, dtype=bool))
+
+    def to_pylist(self) -> List[Any]:
+        out = []
+        for i in range(len(self.data)):
+            if not self.validity[i]:
+                out.append(None)
+            else:
+                v = self.data[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                out.append(v)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Batches
+# ---------------------------------------------------------------------------
+class HostColumnarBatch:
+    """Host-side columnar batch (reference: Spark ColumnarBatch over host
+    vectors; the CPU oracle engine operates directly on these)."""
+
+    __slots__ = ("columns", "num_rows")
+
+    def __init__(self, columns: List[HostColumnVector], num_rows: Optional[int] = None):
+        self.columns = columns
+        self.num_rows = num_rows if num_rows is not None else (
+            len(columns[0]) if columns else 0
+        )
+
+    @property
+    def num_columns(self):
+        return len(self.columns)
+
+    def dtypes(self) -> List[DataType]:
+        return [c.dtype for c in self.columns]
+
+    @staticmethod
+    def from_pydict(data, dtypes: Sequence[DataType]) -> "HostColumnarBatch":
+        cols = [
+            HostColumnVector.from_pylist(vals, dt)
+            for vals, dt in zip(data.values(), dtypes)
+        ]
+        return HostColumnarBatch(cols)
+
+    def to_pylist_rows(self) -> List[tuple]:
+        col_lists = [c.to_pylist() for c in self.columns]
+        return [tuple(vals) for vals in zip(*col_lists)] if col_lists else []
+
+    def slice(self, start: int, length: int) -> "HostColumnarBatch":
+        cols = [
+            HostColumnVector(c.dtype, c.data[start:start + length],
+                             c.validity[start:start + length])
+            for c in self.columns
+        ]
+        return HostColumnarBatch(cols, min(length, max(0, self.num_rows - start)))
+
+    def estimated_size_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            if c.dtype is DataType.STRING:
+                total += sum(len(s) for s in c.data) + 5 * len(c.data)
+            else:
+                total += c.data.nbytes + len(c.validity)
+        return total
+
+    # -- upload (reference: GpuColumnarBatchBuilder host-build-then-upload) --
+    def to_device(self) -> "ColumnarBatch":
+        n = self.num_rows
+        cap = bucket_capacity(n)
+        cols = []
+        for hc in self.columns:
+            validity = np.zeros(cap, dtype=bool)
+            validity[:n] = hc.validity[:n]
+            if hc.dtype is DataType.STRING:
+                encoded = [
+                    s.encode("utf-8") if isinstance(s, str) else bytes(s)
+                    for s in hc.data[:n]
+                ]
+                lengths = np.fromiter(
+                    (len(b) if validity[i] else 0 for i, b in enumerate(encoded)),
+                    dtype=np.int32, count=n,
+                )
+                offsets = np.zeros(cap + 1, dtype=np.int32)
+                np.cumsum(lengths, out=offsets[1:n + 1])
+                offsets[n + 1:] = offsets[n]
+                nbytes = int(offsets[n])
+                byte_cap = bucket_capacity(max(nbytes, 1))
+                buf = np.zeros(byte_cap, dtype=np.uint8)
+                if nbytes:
+                    joined = b"".join(
+                        b if validity[i] else b"" for i, b in enumerate(encoded)
+                    )
+                    buf[:nbytes] = np.frombuffer(joined, dtype=np.uint8)
+                cols.append(
+                    ColumnVector(
+                        DataType.STRING,
+                        jnp.asarray(buf),
+                        jnp.asarray(validity),
+                        jnp.asarray(offsets),
+                    )
+                )
+            else:
+                npdt = physical_np_dtype(hc.dtype)
+                data = np.zeros(cap, dtype=npdt)
+                data[:n] = np.where(hc.validity[:n], hc.data[:n], 0)
+                cols.append(
+                    ColumnVector(hc.dtype, jnp.asarray(data), jnp.asarray(validity))
+                )
+        return ColumnarBatch(cols, n)
+
+
+class ColumnarBatch:
+    """Device-resident columnar batch (reference: ColumnarBatch of
+    GpuColumnVectors / cudf Table)."""
+
+    __slots__ = ("columns", "num_rows")
+
+    def __init__(self, columns: List[ColumnVector], num_rows: int):
+        self.columns = columns
+        self.num_rows = int(num_rows)
+
+    @property
+    def num_columns(self):
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else bucket_capacity(self.num_rows)
+
+    def dtypes(self) -> List[DataType]:
+        return [c.dtype for c in self.columns]
+
+    def device_memory_size(self) -> int:
+        return sum(c.device_memory_size() for c in self.columns)
+
+    # -- download (reference: GpuColumnarToRowExec copyToHost) ---------------
+    def to_host(self) -> HostColumnarBatch:
+        n = self.num_rows
+        out = []
+        for cv in self.columns:
+            validity = np.asarray(jax.device_get(cv.validity))[:n]
+            if cv.dtype is DataType.STRING:
+                offsets = np.asarray(jax.device_get(cv.offsets))
+                data = np.asarray(jax.device_get(cv.data))
+                strs = np.empty(n, dtype=object)
+                for i in range(n):
+                    if validity[i]:
+                        strs[i] = bytes(data[offsets[i]:offsets[i + 1]]).decode(
+                            "utf-8", errors="replace")
+                    else:
+                        strs[i] = ""
+                out.append(HostColumnVector(DataType.STRING, strs, validity))
+            else:
+                data = np.asarray(jax.device_get(cv.data))[:n]
+                npdt = cv.dtype.to_np()
+                if data.dtype != npdt:
+                    data = data.astype(npdt)
+                data = np.where(validity, data, npdt.type(0))
+                out.append(HostColumnVector(cv.dtype, data, validity))
+        return HostColumnarBatch(out, n)
+
+    def __repr__(self):
+        return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
+                f"cols={[c.dtype.name for c in self.columns]})")
+
+
+# ---------------------------------------------------------------------------
+# Device batch ops used by many execs
+# ---------------------------------------------------------------------------
+def row_mask(num_rows, capacity: int):
+    """Traced mask of logically-present rows."""
+    return jnp.arange(capacity) < num_rows
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _pad_array(arr, fill, new_cap: int):
+    pad = new_cap - arr.shape[0]
+    return jnp.concatenate([arr, jnp.full((pad,), fill, dtype=arr.dtype)])
+
+
+def repad_column(cv: ColumnVector, new_cap: int) -> ColumnVector:
+    """Grow a column to a larger capacity bucket."""
+    if cv.capacity == new_cap:
+        return cv
+    assert new_cap > cv.capacity
+    if cv.dtype is DataType.STRING:
+        new_offsets = jnp.concatenate([
+            cv.offsets,
+            jnp.full((new_cap - cv.capacity,), cv.offsets[-1], dtype=jnp.int32),
+        ])
+        return ColumnVector(
+            cv.dtype,
+            cv.data,
+            _pad_array(cv.validity, False, new_cap),
+            new_offsets,
+        )
+    zero = jnp.zeros((), dtype=cv.data.dtype)
+    return ColumnVector(
+        cv.dtype,
+        _pad_array(cv.data, zero, new_cap),
+        _pad_array(cv.validity, False, new_cap),
+    )
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenate batches with the same schema (reference: cudf
+    Table.concatenate used by GpuCoalesceBatches.scala:38-63)."""
+    assert batches, "cannot concat zero batches"
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(total)
+    ncols = batches[0].num_columns
+    out_cols = []
+    for ci in range(ncols):
+        dt = batches[0].columns[ci].dtype
+        if dt is DataType.STRING:
+            out_cols.append(_concat_string_cols([b.columns[ci] for b in batches],
+                                                [b.num_rows for b in batches], cap))
+        else:
+            datas, valids = [], []
+            for b in batches:
+                cv = b.columns[ci]
+                datas.append(cv.data[:bucket_capacity(b.num_rows)])
+                valids.append(cv.validity[:bucket_capacity(b.num_rows)])
+            data, validity = _concat_fixed(tuple(datas), tuple(valids),
+                                           tuple(b.num_rows for b in batches), cap)
+            out_cols.append(ColumnVector(dt, data, validity))
+    return ColumnarBatch(out_cols, total)
+
+
+def _concat_fixed(datas, valids, nrows, cap: int):
+    # scatter-based compaction: write each batch's valid region at its offset
+    out_d = jnp.zeros((cap,), dtype=datas[0].dtype)
+    out_v = jnp.zeros((cap,), dtype=bool)
+    offset = 0
+    for d, v, n in zip(datas, valids, nrows):
+        k = d.shape[0]
+        idx = jnp.arange(k) + offset
+        take = jnp.arange(k) < n
+        idx = jnp.where(take, idx, cap)  # out-of-range drops
+        out_d = out_d.at[idx].set(d, mode="drop")
+        out_v = out_v.at[idx].set(v & take, mode="drop")
+        offset += int(n)
+    return out_d, out_v
+
+
+def _concat_string_cols(cols: List[ColumnVector], nrows: List[int], cap: int) -> ColumnVector:
+    # Host-coordinated string concat: compute byte sizes, then fuse device-side.
+    byte_sizes = [int(jax.device_get(c.offsets[n])) for c, n in zip(cols, nrows)]
+    total_bytes = sum(byte_sizes)
+    byte_cap = bucket_capacity(max(total_bytes, 1))
+    out_data = jnp.zeros((byte_cap,), dtype=jnp.uint8)
+    out_offsets = jnp.zeros((cap + 1,), dtype=jnp.int32)
+    out_valid = jnp.zeros((cap,), dtype=bool)
+    row_off = 0
+    byte_off = 0
+    for c, n, bs in zip(cols, nrows, byte_sizes):
+        k = c.capacity
+        bidx = jnp.arange(c.data.shape[0])
+        bmask = bidx < bs
+        out_data = out_data.at[jnp.where(bmask, bidx + byte_off, byte_cap)].set(
+            c.data, mode="drop")
+        ridx = jnp.arange(k)
+        rmask = ridx < n
+        out_offsets = out_offsets.at[
+            jnp.where(rmask, ridx + row_off, cap + 1)
+        ].set(c.offsets[:k] + byte_off, mode="drop")
+        out_valid = out_valid.at[jnp.where(rmask, ridx + row_off, cap)].set(
+            c.validity[:k], mode="drop")
+        row_off += n
+        byte_off += bs
+    out_offsets = out_offsets.at[row_off:].set(byte_off)
+    return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets)
+
+
+def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
+                 indices_valid=None) -> ColumnarBatch:
+    """Gather rows by index into a new batch of `out_rows` logical rows.
+    `indices` is a device int32 array of length >= bucket_capacity(out_rows);
+    entries >= capacity are treated as 'emit null row' (used by outer joins).
+    """
+    cap = bucket_capacity(max(out_rows, 1))
+    idx = indices[:cap]
+    sel_mask = (jnp.arange(cap) < out_rows)
+    in_bounds = sel_mask & (idx >= 0) & (idx < batch.capacity)
+    if indices_valid is not None:
+        in_bounds = in_bounds & indices_valid[:cap]
+    cols = []
+    for cv in batch.columns:
+        if cv.dtype is DataType.STRING:
+            cols.append(_gather_string(cv, idx, in_bounds, sel_mask))
+        else:
+            safe_idx = jnp.where(in_bounds, idx, 0)
+            data = jnp.where(in_bounds, cv.data[safe_idx], 0)
+            validity = jnp.where(in_bounds, cv.validity[safe_idx], False) & sel_mask
+            cols.append(ColumnVector(cv.dtype, data, validity))
+    return ColumnarBatch(cols, out_rows)
+
+
+def _gather_string(cv: ColumnVector, idx, in_bounds, sel_mask) -> ColumnVector:
+    cap = idx.shape[0]
+    safe_idx = jnp.where(in_bounds, idx, 0)
+    starts = cv.offsets[safe_idx]
+    ends = cv.offsets[safe_idx + 1]
+    lengths = jnp.where(in_bounds, ends - starts, 0)
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)
+    ])
+    total = int(jax.device_get(new_offsets[-1]))
+    byte_cap = bucket_capacity(max(total, 1))
+    out = _gather_string_bytes(cv.data, starts, new_offsets, lengths, byte_cap)
+    validity = jnp.where(in_bounds, cv.validity[safe_idx], False) & sel_mask
+    return ColumnVector(DataType.STRING, out, validity, new_offsets)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _gather_string_bytes(src, starts, new_offsets, lengths, byte_cap: int):
+    """Scatter-free string gather: for each output byte position find its
+    source row via searchsorted over the output offsets, then index the
+    source bytes."""
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets[1:], pos, side="right").astype(jnp.int32)
+    nrows = starts.shape[0]
+    row = jnp.clip(row, 0, nrows - 1)
+    within = pos - new_offsets[row]
+    src_pos = starts[row] + within
+    valid = pos < new_offsets[-1]
+    src_pos = jnp.clip(jnp.where(valid, src_pos, 0), 0, src.shape[0] - 1)
+    return jnp.where(valid, src[src_pos], 0).astype(jnp.uint8)
+
+
+def compact_batch(batch: ColumnarBatch, keep_mask) -> ColumnarBatch:
+    """Compact rows where keep_mask is True to the front (the filter kernel;
+    reference: cudf Table.filter used by GpuFilterExec,
+    basicPhysicalOperators.scala:96-177)."""
+    cap = batch.capacity
+    keep = keep_mask & row_mask(batch.num_rows, cap)
+    n_keep = int(jax.device_get(jnp.sum(keep)))
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    return gather_batch(batch, order, n_keep)
+
+
+def slice_batch_host(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
+    """Row-range slice via gather (used by limit; reference: limit.scala:39-123)."""
+    length = max(0, min(length, batch.num_rows - start))
+    idx = jnp.arange(bucket_capacity(max(length, 1)), dtype=jnp.int32) + start
+    return gather_batch(batch, idx, length)

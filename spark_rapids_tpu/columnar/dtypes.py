@@ -1,0 +1,156 @@
+"""Logical SQL data types and their physical dtype mapping.
+
+Reference parity: GpuColumnVector.java:134-207 (Spark DataType <-> cudf DType
+mapping) and GpuOverrides.isSupportedType (GpuOverrides.scala:383-395 — flat
+types only; timestamps restricted to UTC).
+
+TPU notes:
+- int64/timestamp use XLA's 64-bit emulation on TPU; correct but slower.
+- float64 has no TPU hardware support. The framework computes DOUBLE columns
+  in float32 on TPU and flags affected expressions `incompat` (the reference
+  uses the same incompat taxonomy for float corner cases).
+- Strings are (offsets:int32[n+1], bytes:uint8[cap]) pairs; there is no
+  pointer-chasing on device.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    BOOL = "boolean"
+    INT8 = "byte"
+    INT16 = "short"
+    INT32 = "int"
+    INT64 = "long"
+    FLOAT32 = "float"
+    FLOAT64 = "double"
+    STRING = "string"
+    DATE = "date"          # int32 days since epoch (Spark DateType)
+    TIMESTAMP = "timestamp"  # int64 microseconds since epoch UTC (Spark TimestampType)
+    NULL = "null"
+
+    # ------------------------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self in _NUMERIC
+
+    @property
+    def is_integral(self) -> bool:
+        return self in _INTEGRAL
+
+    @property
+    def is_floating(self) -> bool:
+        return self in (DataType.FLOAT32, DataType.FLOAT64)
+
+    @property
+    def is_string(self) -> bool:
+        return self is DataType.STRING
+
+    @property
+    def is_datetime(self) -> bool:
+        return self in (DataType.DATE, DataType.TIMESTAMP)
+
+    def to_np(self) -> np.dtype:
+        """Physical numpy dtype on the CPU oracle path (exact semantics).
+        The device-path mapping (with TPU f64->f32 narrowing) is
+        columnar.batch.physical_np_dtype."""
+        return _NP_MAP[self]
+
+    @property
+    def itemsize(self) -> int:
+        if self is DataType.STRING:
+            return 16  # rough per-row estimate used for batch sizing
+        return _NP_MAP[self].itemsize
+
+
+_NUMERIC = {
+    DataType.INT8,
+    DataType.INT16,
+    DataType.INT32,
+    DataType.INT64,
+    DataType.FLOAT32,
+    DataType.FLOAT64,
+}
+_INTEGRAL = {DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64}
+
+_NP_MAP = {
+    DataType.BOOL: np.dtype(np.bool_),
+    DataType.INT8: np.dtype(np.int8),
+    DataType.INT16: np.dtype(np.int16),
+    DataType.INT32: np.dtype(np.int32),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.FLOAT32: np.dtype(np.float32),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.STRING: np.dtype(object),
+    DataType.DATE: np.dtype(np.int32),
+    DataType.TIMESTAMP: np.dtype(np.int64),
+    DataType.NULL: np.dtype(np.bool_),
+}
+
+_FROM_NP = {
+    np.dtype(np.bool_): DataType.BOOL,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.float32): DataType.FLOAT32,
+    np.dtype(np.float64): DataType.FLOAT64,
+}
+
+
+def from_np(dtype: np.dtype) -> DataType:
+    dtype = np.dtype(dtype)
+    if dtype in _FROM_NP:
+        return _FROM_NP[dtype]
+    if dtype.kind in ("U", "S", "O"):
+        return DataType.STRING
+    if dtype.kind == "M":  # datetime64
+        unit = np.datetime_data(dtype)[0]
+        return DataType.DATE if unit == "D" else DataType.TIMESTAMP
+    raise TypeError(f"unsupported numpy dtype {dtype}")
+
+
+# The flat-type support gate (reference: GpuOverrides.isSupportedType,
+# GpuOverrides.scala:383-395). Nested types are not supported in v0.1.
+SUPPORTED_TYPES = frozenset(
+    {
+        DataType.BOOL,
+        DataType.INT8,
+        DataType.INT16,
+        DataType.INT32,
+        DataType.INT64,
+        DataType.FLOAT32,
+        DataType.FLOAT64,
+        DataType.STRING,
+        DataType.DATE,
+        DataType.TIMESTAMP,
+        DataType.NULL,
+    }
+)
+
+
+def is_supported_type(dt: DataType) -> bool:
+    return dt in SUPPORTED_TYPES
+
+
+def common_type(a: DataType, b: DataType) -> Optional[DataType]:
+    """Numeric promotion for binary arithmetic (Spark's findTightestCommonType
+    subset for flat types)."""
+    if a == b:
+        return a
+    order = [
+        DataType.INT8,
+        DataType.INT16,
+        DataType.INT32,
+        DataType.INT64,
+        DataType.FLOAT32,
+        DataType.FLOAT64,
+    ]
+    if a in order and b in order:
+        return order[max(order.index(a), order.index(b))]
+    return None

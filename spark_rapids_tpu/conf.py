@@ -1,0 +1,405 @@
+"""Typed, self-documenting configuration registry.
+
+Reference parity: sql-plugin RapidsConf.scala (ConfBuilder/TypedConfBuilder/
+ConfEntry registry with defaults, validators, doc strings and markdown doc
+generation, RapidsConf.scala:116-237; ~60 `spark.rapids.*` keys).
+
+Keys here use the `rapids.tpu.*` prefix. Per-operator enable keys are
+generated automatically by the plan-rewrite rule registry
+(see spark_rapids_tpu/plan/overrides.py, reference GpuOverrides.scala:125-130).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfEntry:
+    """One registered configuration key (reference: ConfEntry, RapidsConf.scala:116)."""
+
+    def __init__(
+        self,
+        key: str,
+        converter: Callable[[str], Any],
+        doc: str,
+        default: Any,
+        is_internal: bool = False,
+        checker: Optional[Callable[[Any], Optional[str]]] = None,
+    ):
+        self.key = key
+        self.converter = converter
+        self.doc = doc
+        self.default = default
+        self.is_internal = is_internal
+        self.checker = checker
+
+    def get(self, settings: Dict[str, Any]) -> Any:
+        if self.key in settings:
+            raw = settings[self.key]
+            value = self.converter(raw) if isinstance(raw, str) else raw
+        else:
+            value = self.default
+        if self.checker is not None and value is not None:
+            err = self.checker(value)
+            if err:
+                raise ValueError(f"invalid value for {self.key}: {err}")
+        return value
+
+    def help_string(self) -> str:
+        return f"{self.key} — {self.doc} (default: {self.default})"
+
+
+def _to_bool(s: str) -> bool:
+    if isinstance(s, bool):
+        return s
+    low = s.strip().lower()
+    if low in ("true", "1", "yes", "on"):
+        return True
+    if low in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"cannot parse boolean: {s!r}")
+
+
+def _to_bytes(s: str) -> int:
+    """Parse '512m', '1g', '64k', plain ints."""
+    if isinstance(s, int):
+        return s
+    s = s.strip().lower()
+    mult = 1
+    for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30), ("t", 1 << 40)):
+        if s.endswith(suffix):
+            mult = m
+            s = s[: -len(suffix)]
+            break
+    return int(float(s) * mult)
+
+
+class _Builder:
+    """Fluent builder (reference: ConfBuilder/TypedConfBuilder, RapidsConf.scala:116-237)."""
+
+    def __init__(self, registry: "ConfRegistry", key: str):
+        self._registry = registry
+        self._key = key
+        self._doc = ""
+        self._internal = False
+        self._checker: Optional[Callable[[Any], Optional[str]]] = None
+
+    def doc(self, text: str) -> "_Builder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "_Builder":
+        self._internal = True
+        return self
+
+    def check(self, fn: Callable[[Any], Optional[str]]) -> "_Builder":
+        self._checker = fn
+        return self
+
+    def _create(self, converter, default) -> ConfEntry:
+        entry = ConfEntry(
+            self._key, converter, self._doc, default, self._internal, self._checker
+        )
+        self._registry.register(entry)
+        return entry
+
+    def boolean(self, default: bool) -> ConfEntry:
+        return self._create(_to_bool, default)
+
+    def integer(self, default: int) -> ConfEntry:
+        return self._create(int, default)
+
+    def double(self, default: float) -> ConfEntry:
+        return self._create(float, default)
+
+    def string(self, default: Optional[str]) -> ConfEntry:
+        return self._create(str, default)
+
+    def bytes(self, default: int) -> ConfEntry:
+        return self._create(_to_bytes, default)
+
+
+class ConfRegistry:
+    def __init__(self):
+        self._entries: Dict[str, ConfEntry] = {}
+        self._lock = threading.Lock()
+
+    def conf(self, key: str) -> _Builder:
+        return _Builder(self, key)
+
+    def register(self, entry: ConfEntry) -> None:
+        with self._lock:
+            if entry.key in self._entries:
+                raise ValueError(f"duplicate conf key {entry.key}")
+            self._entries[entry.key] = entry
+
+    def register_dynamic(self, key: str, doc: str, default: Any, converter=_to_bool) -> ConfEntry:
+        """Register an auto-generated per-operator enable key if absent.
+
+        Reference: ReplacementRule.confKey, GpuOverrides.scala:125-130.
+        """
+        with self._lock:
+            if key in self._entries:
+                return self._entries[key]
+            entry = ConfEntry(key, converter, doc, default)
+            self._entries[key] = entry
+            return entry
+
+    def entries(self) -> List[ConfEntry]:
+        return sorted(self._entries.values(), key=lambda e: e.key)
+
+    def get(self, key: str) -> Optional[ConfEntry]:
+        return self._entries.get(key)
+
+
+REGISTRY = ConfRegistry()
+_conf = REGISTRY.conf
+
+# ---------------------------------------------------------------------------
+# Core enables (reference: RapidsConf.scala SQL_ENABLED etc.)
+# ---------------------------------------------------------------------------
+SQL_ENABLED = _conf("rapids.tpu.sql.enabled").doc(
+    "Enable the TPU columnar plan rewrite; when false every operator runs on "
+    "the CPU oracle path."
+).boolean(True)
+
+EXPLAIN = _conf("rapids.tpu.sql.explain").doc(
+    "Explain the plan rewrite: NONE, NOT_ON_TPU (only fallback reasons), or ALL."
+).check(
+    lambda v: None if v in ("NONE", "NOT_ON_TPU", "ALL") else "must be NONE|NOT_ON_TPU|ALL"
+).string("NONE")
+
+INCOMPATIBLE_OPS = _conf("rapids.tpu.sql.incompatibleOps.enabled").doc(
+    "Enable operators that produce results that differ in corner cases from "
+    "the CPU (float ordering, f64-as-f32 on TPU, timezone restrictions)."
+).boolean(False)
+
+HAS_NANS = _conf("rapids.tpu.sql.hasNans").doc(
+    "Assume floating point data may contain NaNs (affects agg/join support tagging)."
+).boolean(True)
+
+TEST_ENABLED = _conf("rapids.tpu.sql.test.enabled").doc(
+    "Strict test mode: assert every operator in the plan ran on the TPU "
+    "(reference: spark.rapids.sql.test.enabled, GpuTransitionOverrides.scala:211-260)."
+).internal().boolean(False)
+
+TEST_ALLOWED_NON_TPU = _conf("rapids.tpu.sql.test.allowedNonTpu").doc(
+    "Comma separated exec/expression class names allowed to stay on CPU in "
+    "strict test mode (reference: spark.rapids.sql.test.allowedNonGpu)."
+).internal().string("")
+
+# ---------------------------------------------------------------------------
+# Memory (reference: RapidsConf.scala:241-322)
+# ---------------------------------------------------------------------------
+MEMORY_FRACTION = _conf("rapids.tpu.memory.hbm.allocFraction").doc(
+    "Fraction of usable HBM the framework budgets for columnar batches; the "
+    "memory manager preemptively spills below this watermark (reference: "
+    "spark.rapids.memory.gpu.allocFraction=0.9, GpuDeviceManager.scala:152-198)."
+).check(lambda v: None if 0.0 < v <= 1.0 else "must be in (0,1]").double(0.8)
+
+HBM_SIZE_OVERRIDE = _conf("rapids.tpu.memory.hbm.sizeOverride").doc(
+    "Override detected HBM size in bytes (0 = autodetect via device memory stats)."
+).bytes(0)
+
+HOST_SPILL_STORAGE_SIZE = _conf("rapids.tpu.memory.host.spillStorageSize").doc(
+    "Bound on the host staging tier before buffers overflow to disk "
+    "(reference: spark.rapids.memory.host.spillStorageSize, RapidsHostMemoryStore)."
+).bytes(1 << 30)
+
+PINNED_POOL_SIZE = _conf("rapids.tpu.memory.pinnedPool.size").doc(
+    "Size of the aligned host staging pool used for host<->HBM transfers "
+    "(reference: spark.rapids.memory.pinnedPool.size, GpuDeviceManager.scala:200-206)."
+).bytes(256 << 20)
+
+SPILL_DIR = _conf("rapids.tpu.memory.spill.dir").doc(
+    "Local directory for the disk spill tier (reference: RapidsDiskBlockManager)."
+).string("")
+
+MEMORY_DEBUG = _conf("rapids.tpu.memory.debug").doc(
+    "Log every tracked device allocation/free (reference: spark.rapids.memory.gpu.debug)."
+).boolean(False)
+
+CONCURRENT_TPU_TASKS = _conf("rapids.tpu.concurrentTpuTasks").doc(
+    "Number of tasks that may hold the per-chip admission semaphore at once "
+    "(reference: spark.rapids.sql.concurrentGpuTasks=2, GpuSemaphore.scala)."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(2)
+
+# ---------------------------------------------------------------------------
+# Batch sizing (reference: RapidsConf.scala:309-322)
+# ---------------------------------------------------------------------------
+BATCH_SIZE_BYTES = _conf("rapids.tpu.sql.batchSizeBytes").doc(
+    "Target size in bytes of coalesced columnar batches "
+    "(reference: spark.rapids.sql.batchSizeBytes, GpuCoalesceBatches)."
+).bytes(512 << 20)
+
+MAX_READ_BATCH_SIZE_ROWS = _conf("rapids.tpu.sql.reader.batchSizeRows").doc(
+    "Max rows per batch produced by file readers "
+    "(reference: spark.rapids.sql.reader.batchSizeRows, GpuParquetScan.scala:571-605)."
+).integer(1 << 20)
+
+MAX_READ_BATCH_SIZE_BYTES = _conf("rapids.tpu.sql.reader.batchSizeBytes").doc(
+    "Max bytes per batch produced by file readers."
+).bytes(512 << 20)
+
+# ---------------------------------------------------------------------------
+# Per-format / per-feature enables (reference: RapidsConf.scala:433-469)
+# ---------------------------------------------------------------------------
+PARQUET_READ_ENABLED = _conf("rapids.tpu.sql.format.parquet.read.enabled").boolean(True)
+PARQUET_WRITE_ENABLED = _conf("rapids.tpu.sql.format.parquet.write.enabled").boolean(True)
+CSV_READ_ENABLED = _conf("rapids.tpu.sql.format.csv.read.enabled").boolean(True)
+ORC_READ_ENABLED = _conf("rapids.tpu.sql.format.orc.read.enabled").boolean(True)
+ORC_WRITE_ENABLED = _conf("rapids.tpu.sql.format.orc.write.enabled").boolean(True)
+
+ENABLE_FLOAT_AGG = _conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(
+    "Allow float aggregations whose result can vary with evaluation order "
+    "(reference: spark.rapids.sql.variableFloatAgg.enabled)."
+).boolean(True)
+
+ENABLE_CAST_FLOAT_TO_STRING = _conf("rapids.tpu.sql.castFloatToString.enabled").boolean(False)
+ENABLE_CAST_STRING_TO_FLOAT = _conf("rapids.tpu.sql.castStringToFloat.enabled").boolean(False)
+ENABLE_CAST_STRING_TO_TIMESTAMP = _conf("rapids.tpu.sql.castStringToTimestamp.enabled").boolean(False)
+
+IMPROVED_TIME_OPS = _conf("rapids.tpu.sql.improvedTimeOps.enabled").doc(
+    "Enable datetime ops whose range/overflow behavior differs slightly from CPU "
+    "(reference: spark.rapids.sql.improvedTimeOps.enabled, RapidsConf.scala:342)."
+).boolean(False)
+
+REPLACE_SORT_MERGE_JOIN = _conf("rapids.tpu.sql.replaceSortMergeJoin.enabled").doc(
+    "Replace sort-merge joins with TPU hash joins "
+    "(reference: spark.rapids.sql.replaceSortMergeJoin.enabled, RapidsConf.scala:382)."
+).boolean(True)
+
+EXPORT_COLUMNAR_RDD = _conf("rapids.tpu.sql.exportColumnarRdd").doc(
+    "Allow extracting device-resident columnar data from a plan for external ML "
+    "(reference: spark.rapids.sql.exportColumnarRdd, ColumnarRdd.scala)."
+).boolean(False)
+
+# ---------------------------------------------------------------------------
+# Shuffle (reference: RapidsConf.scala:520-596)
+# ---------------------------------------------------------------------------
+SHUFFLE_MANAGER_ENABLED = _conf("rapids.tpu.shuffle.manager.enabled").doc(
+    "Enable the accelerated shuffle manager that keeps shuffle partitions "
+    "device-resident and moves them over the transport "
+    "(reference: spark.shuffle.manager=RapidsShuffleManager)."
+).boolean(False)
+
+SHUFFLE_TRANSPORT_CLASS = _conf("rapids.tpu.shuffle.transport.class").doc(
+    "Fully qualified class of the shuffle transport (reference: "
+    "spark.rapids.shuffle.transport.class; default is the in-process transport, "
+    "ICI collective transport used under a multi-device mesh)."
+).string("spark_rapids_tpu.parallel.transport.LocalShuffleTransport")
+
+SHUFFLE_MAX_BYTES_IN_FLIGHT = _conf("rapids.tpu.shuffle.maxBytesInFlight").doc(
+    "Inflight-bytes throttle for shuffle fetches "
+    "(reference: spark.rapids.shuffle.transport.maxReceiveInflightBytes)."
+).bytes(1 << 30)
+
+SHUFFLE_PARTITIONS = _conf("rapids.tpu.sql.shuffle.partitions").doc(
+    "Default number of shuffle partitions (reference: spark.sql.shuffle.partitions)."
+).integer(8)
+
+# ---------------------------------------------------------------------------
+# Engine / scheduler
+# ---------------------------------------------------------------------------
+TASK_THREADS = _conf("rapids.tpu.engine.taskThreads").doc(
+    "Worker threads executing partition tasks (the Spark executor-slot analog)."
+).integer(8)
+
+BROADCAST_THRESHOLD = _conf("rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
+    "Max estimated bytes for a join side to be broadcast "
+    "(reference: spark.sql.autoBroadcastJoinThreshold)."
+).bytes(10 << 20)
+
+RANGE_SAMPLE_SIZE = _conf("rapids.tpu.sql.rangePartition.sampleSizePerPartition").doc(
+    "Reservoir sample size per partition for range partitioning bounds "
+    "(reference: GpuRangePartitioner.scala driver-side sampling)."
+).integer(100)
+
+
+class TpuConf:
+    """Resolved view of the settings map (reference: RapidsConf class).
+
+    Exposes each registered entry as a property-style `get(entry)` as well as
+    convenience attributes for the hot keys.
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self.settings: Dict[str, Any] = dict(settings or {})
+
+    def clone_with(self, extra: Dict[str, Any]) -> "TpuConf":
+        merged = dict(self.settings)
+        merged.update(extra)
+        return TpuConf(merged)
+
+    def get(self, entry: ConfEntry) -> Any:
+        return entry.get(self.settings)
+
+    def get_key(self, key: str, default: Any = None) -> Any:
+        entry = REGISTRY.get(key)
+        if entry is not None:
+            return entry.get(self.settings)
+        return self.settings.get(key, default)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        self.settings[key] = value
+        return self
+
+    def is_operator_enabled(self, key: str, incompat: bool, disabled_by_default: bool) -> bool:
+        """Per-operator gate logic (reference: RapidsMeta.scala:185-200)."""
+        if key in self.settings:
+            return _to_bool(self.settings[key])
+        if disabled_by_default:
+            return False
+        if incompat:
+            return self.get(INCOMPATIBLE_OPS)
+        return True
+
+    # -- hot-key conveniences -------------------------------------------------
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return self.get(EXPLAIN)
+
+    @property
+    def test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+    @property
+    def allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU) or ""
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def task_threads(self) -> int:
+        return self.get(TASK_THREADS)
+
+
+def generate_docs_markdown() -> str:
+    """Generate configs.md (reference: RapidsConf.help / docs/configs.md)."""
+    lines = [
+        "# spark_rapids_tpu configuration",
+        "",
+        "| Key | Default | Description |",
+        "|---|---|---|",
+    ]
+    for e in REGISTRY.entries():
+        if e.is_internal:
+            continue
+        lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+    return "\n".join(lines) + "\n"

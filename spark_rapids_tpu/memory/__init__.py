@@ -1,0 +1,6 @@
+"""Device & memory management (reference: sql-plugin layer 1 —
+GpuDeviceManager.scala, GpuSemaphore.scala, RapidsBuffer*.scala,
+Rapids{Device,Host,Disk}*Store.scala, DeviceMemoryEventHandler.scala)."""
+
+from spark_rapids_tpu.memory.device_manager import TpuDeviceManager  # noqa: F401
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore  # noqa: F401

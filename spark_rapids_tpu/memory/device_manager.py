@@ -1,0 +1,115 @@
+"""TPU device acquisition and HBM budget management.
+
+Reference parity: GpuDeviceManager.scala —
+- pick/acquire one accelerator per executor process (:98-127)
+- initialize the memory pool at allocFraction x total (:152-198)
+- pinned host staging pool (:200-206)
+- per-task/thread device setup (:139-150, :231-242)
+
+TPU differences (SURVEY.md section 7 hard part #4): XLA owns HBM and there is
+no RMM-style alloc-failure callback, so the manager keeps an explicit byte
+budget and the buffer stores spill *preemptively* before uploads instead of
+reactively on allocation failure. The DeviceMemoryEventHandler analog is
+`MemoryWatermark.ensure_headroom` (memory/spill.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+
+from spark_rapids_tpu import conf as C
+
+log = logging.getLogger(__name__)
+
+_DEFAULT_HBM_BYTES = 16 << 30  # v5e has 16 GiB HBM/chip
+
+
+class TpuDeviceManager:
+    """Singleton per process (reference: GpuDeviceManager object)."""
+
+    _instance: Optional["TpuDeviceManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, tpu_conf: "C.TpuConf"):
+        self.conf = tpu_conf
+        self.device = None
+        self.platform = None
+        self.hbm_total = 0
+        self.hbm_budget = 0
+        self._initialized = False
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    def initialize(cls, tpu_conf: Optional["C.TpuConf"] = None) -> "TpuDeviceManager":
+        """Acquire the accelerator and size the HBM budget (reference:
+        GpuDeviceManager.initializeGpuAndMemory, GpuDeviceManager.scala:120)."""
+        with cls._lock:
+            if cls._instance is not None and cls._instance._initialized:
+                return cls._instance
+            mgr = cls(tpu_conf or C.TpuConf())
+            mgr._do_init()
+            cls._instance = mgr
+            return mgr
+
+    @classmethod
+    def get(cls) -> "TpuDeviceManager":
+        if cls._instance is None or not cls._instance._initialized:
+            return cls.initialize()
+        return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def _do_init(self) -> None:
+        devices = jax.devices()
+        # one accelerator per process, like the 1-GPU-per-executor rule
+        # (GpuDeviceManager.scala:98-112); multi-chip execution goes through
+        # jax.sharding.Mesh in spark_rapids_tpu.parallel, not multiple
+        # independent devices.
+        self.device = devices[0]
+        self.platform = self.device.platform
+        override = self.conf.get(C.HBM_SIZE_OVERRIDE)
+        if override:
+            self.hbm_total = override
+        else:
+            self.hbm_total = self._detect_hbm(self.device)
+        frac = self.conf.get(C.MEMORY_FRACTION)
+        self.hbm_budget = int(self.hbm_total * frac)
+        self._initialized = True
+        log.info(
+            "TpuDeviceManager: device=%s platform=%s hbm_total=%d budget=%d",
+            self.device, self.platform, self.hbm_total, self.hbm_budget,
+        )
+
+    @staticmethod
+    def _detect_hbm(device) -> int:
+        try:
+            stats = device.memory_stats()
+            if stats:
+                for key in ("bytes_limit", "bytes_reservable_limit"):
+                    if key in stats and stats[key]:
+                        return int(stats[key])
+        except Exception:
+            pass
+        return _DEFAULT_HBM_BYTES
+
+    # -- accounting ----------------------------------------------------------
+    def bytes_in_use(self) -> int:
+        try:
+            stats = self.device.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                return int(stats["bytes_in_use"])
+        except Exception:
+            pass
+        return 0
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.platform not in ("cpu",)

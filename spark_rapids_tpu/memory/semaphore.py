@@ -1,0 +1,91 @@
+"""Per-chip task-admission semaphore.
+
+Reference parity: GpuSemaphore.scala — limits how many concurrently running
+tasks may hold device memory / issue device work at once
+(`concurrentGpuTasks`); re-entrant per task attempt, with automatic release on
+task completion (GpuSemaphore.scala:101-161).
+
+Here a "task" is one partition-task executed by the engine's worker pool; the
+scheduler registers a completion callback that calls `release_if_necessary`,
+mirroring Spark's TaskContext completion listener.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from spark_rapids_tpu.utils.metrics import trace_range
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    class _TaskState:
+        __slots__ = ("count", "lock")
+
+        def __init__(self):
+            self.count = 0
+            self.lock = threading.Lock()
+
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._holders: Dict[int, "TpuSemaphore._TaskState"] = {}
+        self._holders_lock = threading.Lock()
+
+    @classmethod
+    def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(max_concurrent)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        if cls._instance is None:
+            return cls.initialize(2)
+        return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def _state(self, task_id: int) -> "TpuSemaphore._TaskState":
+        with self._holders_lock:
+            st = self._holders.get(task_id)
+            if st is None:
+                st = TpuSemaphore._TaskState()
+                self._holders[task_id] = st
+            return st
+
+    # -- reference: GpuSemaphore.acquireIfNecessary (GpuSemaphore.scala:74) --
+    def acquire_if_necessary(self, task_id: int) -> None:
+        # per-task lock makes the count check and the blocking permit acquire
+        # atomic across threads working the same task attempt
+        st = self._state(task_id)
+        with st.lock:
+            if st.count == 0:
+                with trace_range("Acquire TPU Semaphore"):
+                    self._sem.acquire()
+            st.count += 1
+
+    # -- reference: GpuSemaphore.releaseIfNecessary (GpuSemaphore.scala:87) --
+    def release_if_necessary(self, task_id: int) -> None:
+        with self._holders_lock:
+            st = self._holders.get(task_id)
+        if st is None:
+            return
+        with st.lock:
+            if st.count > 0:
+                st.count = 0
+                self._sem.release()
+        with self._holders_lock:
+            self._holders.pop(task_id, None)
+
+    def held_by(self, task_id: int) -> bool:
+        with self._holders_lock:
+            st = self._holders.get(task_id)
+        return st is not None and st.count > 0

@@ -1,0 +1,1 @@
+"""Utilities: metrics/tracing, resource management, fuzz data generation."""

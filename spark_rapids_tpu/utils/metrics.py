@@ -1,0 +1,87 @@
+"""Metrics + tracing.
+
+Reference parity:
+- GpuMetricNames / GpuExec standard metrics (GpuExec.scala:24-41): numOutputRows,
+  numOutputBatches, totalTime, peakDevMemory, plus op-specific metrics.
+- NvtxWithMetrics (NvtxWithMetrics.scala:27-44): a profiler range that adds its
+  elapsed time to a metric on close. The TPU analog is
+  jax.profiler.TraceAnnotation (XProf/TraceMe), falling back to a no-op
+  timer when the profiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+try:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover
+    _TraceAnnotation = None
+
+# standard metric names (reference: GpuMetricNames, GpuExec.scala:24-41)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+TOTAL_TIME = "totalTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+
+
+class Metric:
+    """A thread-safe accumulator (the SQLMetric analog)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v) -> None:
+        with self._lock:
+            self._value += v
+
+    def set_max(self, v) -> None:
+        with self._lock:
+            self._value = max(self._value, v)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class MetricsMap:
+    """Per-exec metric registry."""
+
+    def __init__(self, *names: str):
+        self._metrics: Dict[str, Metric] = {}
+        for n in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, TOTAL_TIME,
+                  PEAK_DEVICE_MEMORY) + names:
+            self._metrics[n] = Metric(n)
+
+    def __getitem__(self, name: str) -> Metric:
+        if name not in self._metrics:
+            self._metrics[name] = Metric(name)
+        return self._metrics[name]
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: m.value for k, m in self._metrics.items()}
+
+
+@contextlib.contextmanager
+def trace_range(name: str, metric: Optional[Metric] = None):
+    """NvtxWithMetrics analog: XProf trace annotation + elapsed-ns metric."""
+    start = time.perf_counter_ns()
+    if _TraceAnnotation is not None:
+        cm = _TraceAnnotation(name)
+    else:  # pragma: no cover
+        cm = contextlib.nullcontext()
+    with cm:
+        try:
+            yield
+        finally:
+            if metric is not None:
+                metric.add(time.perf_counter_ns() - start)

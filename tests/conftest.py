@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+Tests run CPU-only (no TPU dependency) with 8 virtual XLA devices so that
+multi-chip sharding/collective paths compile and execute, per the driver's
+dryrun contract. Must run before jax initializes a backend.
+"""
+
+import os
+
+# Must be set before jax import / backend init.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon TPU registration
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def session():
+    """A fresh TpuSession per test."""
+    import spark_rapids_tpu as srt
+
+    s = srt.new_session()
+    yield s
+    s.stop()

@@ -1,0 +1,162 @@
+"""Columnar substrate tests (reference test model: GpuColumnVector round-trip
+coverage inside tests/ suites; GpuCoalesceBatchesSuite for concat)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    HostColumnarBatch,
+    HostColumnVector,
+    bucket_capacity,
+    compact_batch,
+    concat_batches,
+    gather_batch,
+    slice_batch_host,
+)
+import jax.numpy as jnp
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 8
+    assert bucket_capacity(8) == 8
+    assert bucket_capacity(9) == 16
+    assert bucket_capacity(1000) == 1024
+
+
+def make_host_batch():
+    return HostColumnarBatch(
+        [
+            HostColumnVector.from_pylist([1, 2, None, 4, 5], DataType.INT32),
+            HostColumnVector.from_pylist([1.5, None, 3.5, 4.5, 5.5], DataType.FLOAT64),
+            HostColumnVector.from_pylist(["a", "bb", None, "dddd", ""], DataType.STRING),
+            HostColumnVector.from_pylist([True, False, True, None, False], DataType.BOOL),
+        ]
+    )
+
+
+def test_roundtrip_host_device_host():
+    hb = make_host_batch()
+    db = hb.to_device()
+    assert db.num_rows == 5
+    assert db.capacity == 8
+    back = db.to_host()
+    assert back.to_pylist_rows() == hb.to_pylist_rows()
+
+
+def test_string_roundtrip_unicode():
+    hb = HostColumnarBatch(
+        [HostColumnVector.from_pylist(["héllo", "wörld", None, "日本語", ""], DataType.STRING)]
+    )
+    back = hb.to_device().to_host()
+    assert back.columns[0].to_pylist() == ["héllo", "wörld", None, "日本語", ""]
+
+
+def test_concat_batches():
+    hb1 = make_host_batch()
+    hb2 = make_host_batch()
+    db = concat_batches([hb1.to_device(), hb2.to_device()])
+    assert db.num_rows == 10
+    rows = db.to_host().to_pylist_rows()
+    assert rows == hb1.to_pylist_rows() + hb2.to_pylist_rows()
+
+
+def test_compact_filter():
+    hb = make_host_batch()
+    db = hb.to_device()
+    keep = jnp.asarray(np.array([True, False, True, False, True, True, True, True]))
+    out = compact_batch(db, keep)
+    assert out.num_rows == 3
+    rows = out.to_host().to_pylist_rows()
+    expected = [r for i, r in enumerate(hb.to_pylist_rows()) if i in (0, 2, 4)]
+    assert rows == expected
+
+
+def test_gather_with_null_rows():
+    hb = make_host_batch()
+    db = hb.to_device()
+    idx = jnp.asarray(np.array([4, 0, 99, 1, 0, 0, 0, 0], dtype=np.int32))
+    valid = jnp.asarray(np.array([True, True, False, True] + [False] * 4))
+    out = gather_batch(db, idx, 4, indices_valid=valid)
+    rows = out.to_host().to_pylist_rows()
+    src = hb.to_pylist_rows()
+    assert rows[0] == src[4]
+    assert rows[1] == src[0]
+    assert rows[2] == (None, None, None, None)
+    assert rows[3] == src[1]
+
+
+def test_slice():
+    hb = make_host_batch()
+    db = hb.to_device()
+    out = slice_batch_host(db, 1, 3)
+    assert out.num_rows == 3
+    assert out.to_host().to_pylist_rows() == hb.to_pylist_rows()[1:4]
+
+
+def test_large_batch_capacity_bucketing():
+    n = 1000
+    hb = HostColumnarBatch(
+        [HostColumnVector.from_numpy(np.arange(n, dtype=np.int64))]
+    )
+    db = hb.to_device()
+    assert db.capacity == 1024
+    assert db.to_host().to_pylist_rows() == [(i,) for i in range(n)]
+
+
+def test_from_numpy_datetime_units():
+    # review finding: datetime64 units must normalize to us (TIMESTAMP) / D (DATE)
+    ns = np.array(["2020-01-01T00:00:00", "NaT"], dtype="datetime64[ns]")
+    hv = HostColumnVector.from_numpy(ns)
+    assert hv.dtype == DataType.TIMESTAMP
+    assert hv.data[0] == 1577836800000000  # microseconds
+    assert list(hv.validity) == [True, False]
+    d = np.array(["2020-01-02"], dtype="datetime64[D]")
+    hv2 = HostColumnVector.from_numpy(d)
+    assert hv2.dtype == DataType.DATE
+    assert hv2.data[0] == 18263
+
+
+def test_from_numpy_object_strings_with_none():
+    hv = HostColumnVector.from_numpy(np.array(["a", None], dtype=object))
+    assert hv.to_pylist() == ["a", None]
+    # must survive upload
+    db = HostColumnarBatch([hv]).to_device()
+    assert db.to_host().columns[0].to_pylist() == ["a", None]
+
+
+def test_gather_oob_index_yields_null_row():
+    # review finding: OOB index must emit a null row even when the source
+    # batch exactly fills its capacity bucket
+    hb = HostColumnarBatch(
+        [HostColumnVector.from_numpy(np.arange(8, dtype=np.int32))]
+    )
+    db = hb.to_device()
+    assert db.capacity == 8
+    idx = jnp.asarray(np.array([99, 0, -1, 7, 0, 0, 0, 0], dtype=np.int32))
+    out = gather_batch(db, idx, 4)
+    assert out.to_host().to_pylist_rows() == [(None,), (0,), (None,), (7,)]
+
+
+def test_semaphore_concurrent_same_task():
+    # review finding: concurrent same-task acquires must consume one permit
+    import threading
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    sem = TpuSemaphore(1)
+    threads = [
+        threading.Thread(target=sem.acquire_if_necessary, args=(7,))
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert all(not t.is_alive() for t in threads)  # no deadlock: 1 permit, same task
+    sem.release_if_necessary(7)
+    # permit fully restored: a different task can acquire immediately
+    done = []
+    t = threading.Thread(target=lambda: (sem.acquire_if_necessary(8), done.append(1)))
+    t.start(); t.join(timeout=5)
+    assert done == [1]

@@ -1,0 +1,49 @@
+"""Config registry tests (reference: RapidsConf self-documenting registry)."""
+
+import pytest
+
+from spark_rapids_tpu import conf as C
+
+
+def test_defaults():
+    c = C.TpuConf()
+    assert c.sql_enabled is True
+    assert c.explain == "NONE"
+    assert c.concurrent_tpu_tasks == 2
+    assert c.get(C.MEMORY_FRACTION) == 0.8
+
+
+def test_string_parsing():
+    c = C.TpuConf({
+        "rapids.tpu.sql.enabled": "false",
+        "rapids.tpu.sql.batchSizeBytes": "64m",
+        "rapids.tpu.concurrentTpuTasks": "4",
+    })
+    assert c.sql_enabled is False
+    assert c.batch_size_bytes == 64 << 20
+    assert c.concurrent_tpu_tasks == 4
+
+
+def test_validator():
+    with pytest.raises(ValueError):
+        C.TpuConf({"rapids.tpu.sql.explain": "BOGUS"}).explain
+    with pytest.raises(ValueError):
+        C.TpuConf({"rapids.tpu.memory.hbm.allocFraction": "1.5"}).get(C.MEMORY_FRACTION)
+
+
+def test_operator_gate_logic():
+    # reference: RapidsMeta.scala:185-200 incompat/disabled gate
+    c = C.TpuConf()
+    assert c.is_operator_enabled("rapids.tpu.sql.expression.Abs", False, False)
+    assert not c.is_operator_enabled("rapids.tpu.sql.expression.X", True, False)
+    assert not c.is_operator_enabled("rapids.tpu.sql.expression.Y", False, True)
+    c2 = C.TpuConf({"rapids.tpu.sql.incompatibleOps.enabled": "true"})
+    assert c2.is_operator_enabled("rapids.tpu.sql.expression.X", True, False)
+    c3 = C.TpuConf({"rapids.tpu.sql.expression.Y": "true"})
+    assert c3.is_operator_enabled("rapids.tpu.sql.expression.Y", False, True)
+
+
+def test_docs_generation():
+    md = C.generate_docs_markdown()
+    assert "rapids.tpu.sql.enabled" in md
+    assert "rapids.tpu.sql.test.enabled" not in md  # internal keys hidden

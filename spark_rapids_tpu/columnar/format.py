@@ -1,0 +1,107 @@
+"""Device number/date formatting kernels (int->string, date->string).
+
+The cuDF analog is its cast-to-string kernels. All pure integer arithmetic —
+no host sync; output byte capacity is a static upper bound (20 bytes/int,
+10 bytes/date).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.values import ColV
+
+# powers of ten as uint64 (10^0 .. 10^19)
+_POW10 = np.array([10 ** k for k in range(20)], dtype=np.uint64)
+
+
+def int_to_string(ctx, v: ColV) -> ColV:
+    """Format integers (or bools as true/false) to decimal strings."""
+    cap = ctx.capacity
+    if v.dtype is DataType.BOOL:
+        return _bool_to_string(ctx, v)
+    x = v.data.astype(jnp.int64)
+    neg = x < 0
+    # abs via uint64 so int64-min doesn't overflow
+    ax = jnp.where(neg, (-(x + 1)).astype(jnp.uint64) + 1, x.astype(jnp.uint64))
+    pow10 = jnp.asarray(_POW10)
+    ndigits = jnp.sum((ax[:, None] >= pow10[None, 1:]).astype(jnp.int32), axis=1) + 1
+    out_len = ndigits + neg.astype(jnp.int32)
+    byte_cap = 20 * cap
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(jnp.where(v.validity, out_len, 0), dtype=jnp.int32)]
+    )
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = pos - offsets[row]
+    is_sign = neg[row] & (within == 0)
+    digit_idx = within - neg[row].astype(jnp.int32)          # 0-based from left
+    exp = ndigits[row] - 1 - digit_idx                        # power of ten
+    exp_c = jnp.clip(exp, 0, 19)
+    digit = (ax[row] // pow10[exp_c]) % jnp.uint64(10)
+    ch = jnp.where(is_sign, ord("-"), ord("0") + digit.astype(jnp.int32))
+    in_range = pos < offsets[-1]
+    data = jnp.where(in_range, ch, 0).astype(jnp.uint8)
+    return ColV(DataType.STRING, data, v.validity, offsets)
+
+
+def _bool_to_string(ctx, v: ColV) -> ColV:
+    cap = ctx.capacity
+    t = np.frombuffer(b"true", dtype=np.uint8)
+    f = np.frombuffer(b"false", dtype=np.uint8)
+    word = jnp.asarray(np.concatenate([t, f]))  # "truefalse"
+    b = v.data.astype(bool)
+    out_len = jnp.where(b, 4, 5)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(jnp.where(v.validity, out_len, 0), dtype=jnp.int32)]
+    )
+    byte_cap = 5 * cap
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = pos - offsets[row]
+    src = jnp.where(b[row], within, within + 4)
+    in_range = pos < offsets[-1]
+    data = jnp.where(in_range, word[jnp.clip(src, 0, 8)], 0).astype(jnp.uint8)
+    return ColV(DataType.STRING, data, v.validity, offsets)
+
+
+def date_to_string(ctx, v: ColV) -> ColV:
+    """Format int32 epoch-days as 'YYYY-MM-DD' (fixed 10 bytes; years assumed
+    in [0, 9999] — the meta layer restricts the cast like the reference
+    restricts timestamps to UTC)."""
+    from spark_rapids_tpu.ops import datetimeops as DT
+
+    cap = ctx.capacity
+    y, m, d = DT.civil_from_days(jnp, v.data.astype(jnp.int64))
+    out_len = jnp.full((cap,), 10, dtype=jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(jnp.where(v.validity, out_len, 0), dtype=jnp.int32)]
+    )
+    byte_cap = 10 * cap
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = pos - offsets[row]
+    yy, mm, dd = y[row], m[row], d[row]
+    # positions: 0123 4 56 7 89 -> Y Y Y Y - M M - D D
+    digits = jnp.stack([
+        yy // 1000 % 10, yy // 100 % 10, yy // 10 % 10, yy % 10,
+        jnp.full_like(yy, -1),
+        mm // 10 % 10, mm % 10,
+        jnp.full_like(yy, -1),
+        dd // 10 % 10, dd % 10,
+    ], axis=1)  # [byte_cap, 10] — already indexed per byte position via row
+    ch = digits[jnp.arange(byte_cap), jnp.clip(within, 0, 9)]
+    byte = jnp.where(ch < 0, ord("-"), ord("0") + ch)
+    in_range = pos < offsets[-1]
+    data = jnp.where(in_range, byte, 0).astype(jnp.uint8)
+    return ColV(DataType.STRING, data, v.validity, offsets)

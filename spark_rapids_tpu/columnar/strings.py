@@ -1,0 +1,453 @@
+"""Device string kernels over (offsets:int32[n+1], bytes:uint8[byte_cap]).
+
+This is the TPU answer to cuDF's string kernels (reference: stringFunctions.scala
+dispatches to cudf ColumnVector string ops). Design rules (SURVEY.md section 7
+hard part #1):
+
+- No pointer chasing: every op is a gather/scan/searchsorted composition over
+  flat byte arrays.
+- Outputs use *upper-bound* byte capacities (e.g. substring output fits in the
+  input's byte capacity; concat in the sum) so kernels stay fully traceable —
+  no host sync inside an expression tree.
+- Variable-length comparisons run as a lax.while_loop over 8-byte big-endian
+  chunks: trip count = ceil(longest-string/8), each step one gather per side.
+- Scalars normalize to a `StrView` whose rows all alias the same byte span,
+  so column/scalar kernels share one code path.
+
+The CPU-oracle equivalents live in the expression classes themselves (numpy
+object arrays + python string ops).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.values import ColV, ScalarV
+
+
+class StrView(NamedTuple):
+    """Normalized string operand: per-row byte spans into a flat buffer.
+    Unlike the offsets representation, spans may alias (scalar broadcast)."""
+
+    data: jnp.ndarray      # uint8 [byte_cap]
+    starts: jnp.ndarray    # int32 [cap]
+    lens: jnp.ndarray      # int32 [cap]
+    validity: jnp.ndarray  # bool [cap]
+
+
+def lengths_of(col: ColV):
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def as_view(ctx, v) -> StrView:
+    cap = ctx.capacity
+    if isinstance(v, ScalarV):
+        if v.is_null:
+            return StrView(
+                jnp.zeros((8,), dtype=jnp.uint8),
+                jnp.zeros((cap,), dtype=jnp.int32),
+                jnp.zeros((cap,), dtype=jnp.int32),
+                jnp.zeros((cap,), dtype=bool),
+            )
+        raw = v.value.encode("utf-8")
+        n = len(raw)
+        byte_cap = max(8, n)
+        buf = np.zeros(byte_cap, dtype=np.uint8)
+        if n:
+            buf[:n] = np.frombuffer(raw, dtype=np.uint8)
+        return StrView(
+            jnp.asarray(buf),
+            jnp.zeros((cap,), dtype=jnp.int32),
+            jnp.full((cap,), n, dtype=jnp.int32),
+            jnp.ones((cap,), dtype=bool),
+        )
+    return StrView(v.data, v.offsets[:-1], lengths_of(v), v.validity)
+
+
+def view_to_col(view_data, offsets, validity) -> ColV:
+    return ColV(DataType.STRING, view_data, validity, offsets)
+
+
+def plan_byte_cap(ctx, v) -> int:
+    """Static output-byte upper bound contributed by one operand: a column
+    can contribute at most its buffer; a scalar can be replicated into every
+    row, so it contributes capacity * len."""
+    if isinstance(v, ScalarV):
+        n = 0 if v.is_null else len(v.value.encode("utf-8"))
+        return max(8, ctx.capacity * n)
+    return int(v.data.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# Comparison (exact, variable length)
+# ---------------------------------------------------------------------------
+def _chunk_u64(data, start, remaining):
+    """Load up to 8 bytes per row at `start` as big-endian uint64, zero-padded
+    past the string end."""
+    byte_cap = data.shape[0]
+    idx = start[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+    in_range = jnp.arange(8)[None, :] < remaining[:, None]
+    safe = jnp.clip(idx, 0, byte_cap - 1)
+    b = jnp.where(in_range, data[safe], 0).astype(jnp.uint64)
+    shifts = (jnp.uint64(8) * (7 - jnp.arange(8, dtype=jnp.uint64)))
+    return jnp.sum(b << shifts[None, :], axis=1)
+
+
+def string_cmp3(ctx, lv, rv):
+    """Three-way lexicographic byte compare -> int8 array of -1/0/1."""
+    l = as_view(ctx, lv)
+    r = as_view(ctx, rv)
+    cap = l.starts.shape[0]
+
+    def cond(state):
+        pos, result = state
+        return jnp.any((result == 0) & (pos < jnp.maximum(l.lens, r.lens)))
+
+    def body(state):
+        pos, result = state
+        cl = _chunk_u64(l.data, l.starts + pos, jnp.maximum(l.lens - pos, 0))
+        cr = _chunk_u64(r.data, r.starts + pos, jnp.maximum(r.lens - pos, 0))
+        cmp = jnp.where(cl < cr, -1, jnp.where(cl > cr, 1, 0)).astype(jnp.int8)
+        return pos + 8, jnp.where(result == 0, cmp, result)
+
+    pos0 = jnp.zeros((cap,), dtype=jnp.int32)
+    res0 = jnp.zeros((cap,), dtype=jnp.int8)
+    _, result = lax.while_loop(cond, body, (pos0, res0))
+    len_cmp = jnp.where(l.lens < r.lens, -1,
+                        jnp.where(l.lens > r.lens, 1, 0)).astype(jnp.int8)
+    return jnp.where(result == 0, len_cmp, result)
+
+
+def string_equal(ctx, lv, rv):
+    if not ctx.is_device:
+        return _host_cmp(ctx, lv, rv, "eq")
+    l = as_view(ctx, lv)
+    r = as_view(ctx, rv)
+    return (l.lens == r.lens) & (string_cmp3(ctx, lv, rv) == 0)
+
+
+def string_compare(ctx, lv, rv, op: str):
+    if not ctx.is_device:
+        return _host_cmp(ctx, lv, rv, op)
+    c = string_cmp3(ctx, lv, rv)
+    return {"lt": c < 0, "le": c <= 0, "gt": c > 0, "ge": c >= 0}[op]
+
+
+def _host_cmp(ctx, lv, rv, op):
+    import operator
+
+    ops = {"eq": operator.eq, "lt": operator.lt, "le": operator.le,
+           "gt": operator.gt, "ge": operator.ge}
+    f = ops[op]
+
+    def side(v):
+        if isinstance(v, ScalarV):
+            return [v.value if not v.is_null else ""] * ctx.capacity
+        return v.data
+
+    l, r = side(lv), side(rv)
+    return np.array([f(a, b) for a, b in zip(l, r)], dtype=bool)
+
+
+# ---------------------------------------------------------------------------
+# Assembly: build an output column from per-row (source, start, len) plans
+# ---------------------------------------------------------------------------
+def build_from_plan(src_datas: Sequence[jnp.ndarray], src_choice, src_start,
+                    out_len, byte_cap: int):
+    """Row i takes out_len[i] bytes from src_datas[src_choice[i]] starting at
+    src_start[i]. Returns (bytes, offsets). The workhorse behind select/
+    coalesce/substring/trim/gather."""
+    out_len = jnp.maximum(out_len, 0).astype(jnp.int32)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len, dtype=jnp.int32)]
+    )
+    cap = out_len.shape[0]
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = pos - new_offsets[row]
+    valid = pos < new_offsets[-1]
+    out = jnp.zeros((byte_cap,), dtype=jnp.uint8)
+    for k, data in enumerate(src_datas):
+        take = valid & (src_choice[row] == k)
+        src_pos = jnp.clip(src_start[row] + within, 0, data.shape[0] - 1)
+        out = jnp.where(take, data[src_pos], out)
+    return out, new_offsets
+
+
+def string_select(ctx, pred_true, then_v, else_v) -> ColV:
+    """where(pred, then, else) over strings."""
+    if not ctx.is_device:
+        t = _host_col(ctx, then_v)
+        e = _host_col(ctx, else_v)
+        data = np.where(pred_true, t[0], e[0])
+        valid = np.where(pred_true, t[1], e[1])
+        return ColV(DataType.STRING, data, valid)
+    t = as_view(ctx, then_v)
+    e = as_view(ctx, else_v)
+    choice = jnp.where(pred_true, 0, 1).astype(jnp.int32)
+    validity = jnp.where(pred_true, t.validity, e.validity)
+    out_len = jnp.where(validity, jnp.where(pred_true, t.lens, e.lens), 0)
+    start = jnp.where(pred_true, t.starts, e.starts)
+    byte_cap = plan_byte_cap(ctx, then_v) + plan_byte_cap(ctx, else_v)
+    data, offsets = build_from_plan([t.data, e.data], choice, start, out_len,
+                                    byte_cap)
+    return ColV(DataType.STRING, data, validity, offsets)
+
+
+def string_coalesce(ctx, vals) -> ColV:
+    if not ctx.is_device:
+        datas = [_host_col(ctx, v) for v in vals]
+        data = datas[-1][0].copy()
+        valid = datas[-1][1].copy()
+        for d, va in list(reversed(datas))[1:]:
+            data = np.where(va, d, data)
+            valid = va | valid
+        return ColV(DataType.STRING, data, valid)
+    views = [as_view(ctx, v) for v in vals]
+    cap = ctx.capacity
+    choice = jnp.full((cap,), len(views) - 1, dtype=jnp.int32)
+    for k in range(len(views) - 2, -1, -1):
+        choice = jnp.where(views[k].validity, k, choice)
+    rows = jnp.arange(cap)
+    stacked_len = jnp.stack([v.lens for v in views])
+    stacked_start = jnp.stack([v.starts for v in views])
+    stacked_valid = jnp.stack([v.validity for v in views])
+    out_len = stacked_len[choice, rows]
+    start = stacked_start[choice, rows]
+    validity = stacked_valid[choice, rows]
+    byte_cap = sum(plan_byte_cap(ctx, v) for v in vals)
+    data, offsets = build_from_plan([v.data for v in views], choice, start,
+                                    jnp.where(validity, out_len, 0), byte_cap)
+    return ColV(DataType.STRING, data, validity, offsets)
+
+
+def _host_col(ctx, v):
+    if isinstance(v, ScalarV):
+        if v.is_null:
+            return (np.full((ctx.capacity,), "", dtype=object),
+                    np.zeros((ctx.capacity,), dtype=bool))
+        return (np.full((ctx.capacity,), v.value, dtype=object),
+                np.ones((ctx.capacity,), dtype=bool))
+    return v.data, v.validity
+
+
+# ---------------------------------------------------------------------------
+# Value ops (operate on real columns; scalar inputs fold on the host path)
+# ---------------------------------------------------------------------------
+def utf8_char_lengths(col: ColV):
+    """Codepoint count per row — UTF-8 continuation bytes don't start a char."""
+    is_cont = (col.data & 0xC0) == 0x80
+    starts_cum = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum((~is_cont).astype(jnp.int32), dtype=jnp.int32),
+    ])
+    return starts_cum[col.offsets[1:]] - starts_cum[col.offsets[:-1]]
+
+
+def upper_ascii(col: ColV) -> ColV:
+    d = col.data
+    is_lower = (d >= ord("a")) & (d <= ord("z"))
+    return ColV(DataType.STRING, jnp.where(is_lower, d - 32, d),
+                col.validity, col.offsets)
+
+
+def lower_ascii(col: ColV) -> ColV:
+    d = col.data
+    is_upper = (d >= ord("A")) & (d <= ord("Z"))
+    return ColV(DataType.STRING, jnp.where(is_upper, d + 32, d),
+                col.validity, col.offsets)
+
+
+def substring_utf8(ctx, col: ColV, start_1based, length):
+    """Spark SUBSTRING semantics on codepoints: pos is 1-based; negative pos
+    counts from the end; len < 0 -> empty."""
+    byte_cap = int(col.data.shape[0])
+    is_start_byte = (col.data & 0xC0) != 0x80
+    # char index of each byte (index of the char the byte belongs to)
+    char_idx_of_byte = jnp.cumsum(is_start_byte.astype(jnp.int32)) - 1
+    nchars = utf8_char_lengths(col)
+    row_start_byte = col.offsets[:-1]
+    row_end_byte = col.offsets[1:]
+    char_at_row_start = char_idx_of_byte[jnp.clip(row_start_byte, 0, byte_cap - 1)]
+    char_at_row_start = jnp.where(lengths_of(col) > 0, char_at_row_start, 0)
+
+    pos = jnp.where(start_1based < 0,
+                    jnp.maximum(nchars + start_1based, 0),
+                    jnp.maximum(start_1based - 1, 0))
+    want_len = jnp.maximum(length, 0)
+    first_char = jnp.minimum(pos, nchars)
+    last_char = jnp.minimum(pos + want_len, nchars)
+
+    # global byte position of each char start (padded with byte_cap)
+    char_starts = jnp.nonzero(is_start_byte, size=byte_cap, fill_value=byte_cap)[0] \
+        .astype(jnp.int32)
+
+    def char_to_byte(k):
+        g = char_at_row_start + k
+        b = char_starts[jnp.clip(g, 0, byte_cap - 1)]
+        b = jnp.where(g >= byte_cap, row_end_byte, b)
+        return jnp.clip(b, row_start_byte, row_end_byte)
+
+    b_start = char_to_byte(first_char)
+    b_end = char_to_byte(last_char)
+    out_len = jnp.maximum(b_end - b_start, 0)
+    cap = ctx.capacity
+    data, offsets = build_from_plan([col.data], jnp.zeros((cap,), jnp.int32),
+                                    b_start, out_len, byte_cap)
+    return ColV(DataType.STRING, data, col.validity, offsets)
+
+
+def concat2(ctx, lv, rv) -> ColV:
+    """CONCAT of two strings (null if any input null — Spark concat)."""
+    l = as_view(ctx, lv)
+    r = as_view(ctx, rv)
+    validity = l.validity & r.validity
+    out_len = jnp.where(validity, l.lens + r.lens, 0)
+    byte_cap = plan_byte_cap(ctx, lv) + plan_byte_cap(ctx, rv)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len, dtype=jnp.int32)]
+    )
+    cap = out_len.shape[0]
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = pos - offsets[row]
+    from_left = within < l.lens[row]
+    lpos = jnp.clip(l.starts[row] + within, 0, l.data.shape[0] - 1)
+    rpos = jnp.clip(r.starts[row] + within - l.lens[row], 0, r.data.shape[0] - 1)
+    valid = pos < offsets[-1]
+    data = jnp.where(valid, jnp.where(from_left, l.data[lpos], r.data[rpos]), 0)
+    return ColV(DataType.STRING, data, validity, offsets)
+
+
+# ---------------------------------------------------------------------------
+# Search ops (scalar needle, statically unrolled over needle bytes)
+# ---------------------------------------------------------------------------
+def _needle_bytes(needle: str) -> np.ndarray:
+    return np.frombuffer(needle.encode("utf-8"), dtype=np.uint8)
+
+
+def starts_with(ctx, col: ColV, needle: str):
+    nb = _needle_bytes(needle)
+    n = len(nb)
+    lens = lengths_of(col)
+    ok = lens >= n
+    start = col.offsets[:-1]
+    byte_cap = col.data.shape[0]
+    for k, b in enumerate(nb):
+        ok = ok & (col.data[jnp.clip(start + k, 0, byte_cap - 1)] == b)
+    return ok
+
+
+def ends_with(ctx, col: ColV, needle: str):
+    nb = _needle_bytes(needle)
+    n = len(nb)
+    lens = lengths_of(col)
+    ok = lens >= n
+    start = col.offsets[:-1] + lens - n
+    byte_cap = col.data.shape[0]
+    for k, b in enumerate(nb):
+        ok = ok & (col.data[jnp.clip(start + k, 0, byte_cap - 1)] == b)
+    return ok
+
+
+def contains(ctx, col: ColV, needle: str):
+    nb = _needle_bytes(needle)
+    n = len(nb)
+    cap = ctx.capacity
+    if n == 0:
+        return jnp.ones((cap,), dtype=bool)
+    byte_cap = int(col.data.shape[0])
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    m = jnp.ones((byte_cap,), dtype=bool)
+    for k, b in enumerate(nb):
+        m = m & (col.data[jnp.clip(pos + k, 0, byte_cap - 1)] == b)
+    row = jnp.clip(jnp.searchsorted(col.offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    fits = (pos >= col.offsets[row]) & ((pos + n) <= col.offsets[row + 1])
+    m = m & fits
+    hit = jax.ops.segment_max(m.astype(jnp.int32), row, num_segments=cap)
+    # empty segments get the int32 identity (INT_MIN) — compare, don't truthify
+    return hit >= 1
+
+
+def trim_spaces(ctx, col: ColV, side: str = "both") -> ColV:
+    """TRIM/LTRIM/RTRIM of ASCII space (Spark default trim char)."""
+    byte_cap = int(col.data.shape[0])
+    cap = ctx.capacity
+    is_space = col.data == ord(" ")
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(col.offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within_row = (pos >= col.offsets[row]) & (pos < col.offsets[row + 1])
+    nonspace = ~is_space & within_row
+    first_ns = jax.ops.segment_min(
+        jnp.where(nonspace, pos, byte_cap), row, num_segments=cap)
+    last_ns = jax.ops.segment_max(
+        jnp.where(nonspace, pos, -1), row, num_segments=cap)
+    all_space = first_ns >= byte_cap
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    if side in ("both", "left"):
+        new_start = jnp.where(all_space, ends, first_ns.astype(jnp.int32))
+    else:
+        new_start = starts
+    if side in ("both", "right"):
+        new_end = jnp.where(all_space, new_start, (last_ns + 1).astype(jnp.int32))
+    else:
+        new_end = ends
+    out_len = jnp.maximum(new_end - new_start, 0)
+    data, offsets = build_from_plan([col.data], jnp.zeros((cap,), jnp.int32),
+                                    new_start, out_len, byte_cap)
+    return ColV(DataType.STRING, data, col.validity, offsets)
+
+
+def like_match(ctx, col: ColV, pattern: str):
+    """SQL LIKE for the supported pattern subset (no '_'/escapes; '%' at edges
+    or one interior '%'): exact, 'a%', '%a', '%a%', 'a%b'. The plan-rewrite
+    meta tags other patterns as not-on-TPU (the reference similarly restricts
+    regexp patterns, GpuOverrides.scala:334-337)."""
+    kind, parts = classify_like(pattern)
+    if kind == "exact":
+        return string_equal(ctx, col, ScalarV(DataType.STRING, parts[0]))
+    if kind == "prefix":
+        return starts_with(ctx, col, parts[0])
+    if kind == "suffix":
+        return ends_with(ctx, col, parts[0])
+    if kind == "contains":
+        return contains(ctx, col, parts[0])
+    if kind == "prefix_suffix":
+        p, s = parts
+        lens = lengths_of(col)
+        return starts_with(ctx, col, p) & ends_with(ctx, col, s) & \
+            (lens >= (len(p.encode()) + len(s.encode())))
+    raise ValueError(f"unsupported LIKE pattern {pattern!r}")
+
+
+def classify_like(pattern: str):
+    """Classify a LIKE pattern; ('unsupported', ()) triggers CPU fallback."""
+    if "_" in pattern or "\\" in pattern:
+        return "unsupported", ()
+    if "%" not in pattern:
+        return "exact", (pattern,)
+    inner = pattern.strip("%")
+    if "%" in inner:
+        segs = inner.split("%")
+        if len(segs) == 2 and not pattern.startswith("%") and \
+           not pattern.endswith("%"):
+            return "prefix_suffix", tuple(segs)
+        return "unsupported", ()
+    if pattern.startswith("%") and pattern.endswith("%"):
+        return "contains", (inner,)
+    if pattern.endswith("%"):
+        return "prefix", (inner,)
+    return "suffix", (inner,)

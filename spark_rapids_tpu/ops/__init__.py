@@ -1,0 +1,22 @@
+"""Expression layer (reference: sql-plugin layer 4 — GpuExpressions.scala,
+GpuCast.scala, literals.scala, and the per-category expression files under
+org/apache/spark/sql/rapids/).
+
+Every expression has two evaluation paths:
+- device: builds a jax-traceable computation over padded columns (the cuDF
+  kernel analog); whole projections/filters are jit-compiled per capacity
+  bucket.
+- cpu: an independent numpy implementation with identical SQL null
+  semantics — the CPU-fallback engine and the equivalence-test oracle
+  (the role CPU Spark plays for the reference).
+"""
+
+from spark_rapids_tpu.ops.base import (  # noqa: F401
+    AttributeReference,
+    BoundReference,
+    Alias,
+    Expression,
+    SortOrder,
+)
+from spark_rapids_tpu.ops.literals import Literal  # noqa: F401
+from spark_rapids_tpu.ops.bind import bind_references  # noqa: F401

@@ -1,0 +1,255 @@
+"""Declarative aggregate functions (reference: AggregateFunctions.scala, 533 LoC).
+
+The reference models every aggregate as cudf update/merge aggregate pairs plus
+expression trees for initial values and final evaluation
+(AggregateFunctions.scala:171-533). This shape is exactly what makes
+partial/final aggregation composable across a shuffle, so it is kept:
+
+- `update_aggs`: (buffer_name, reduce_op, input_expr) applied to raw input
+  batches in Partial mode;
+- `merge_aggs`:  (buffer_name, reduce_op) applied to partial buffers in
+  Final mode;
+- `evaluate_expression`: expression over buffer attributes producing the
+  result column;
+- `default_values`: result for an empty ungrouped reduction
+  (reference: aggregate.scala:406-419).
+
+The reduce ops are names understood by the exec layer's segmented-reduce
+kernel (exec/aggregate.py): sum / min / max / count / first / last / any.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import (
+    Alias,
+    AttributeReference,
+    Expression,
+    UnaryExpression,
+)
+from spark_rapids_tpu.ops.literals import Literal
+
+# (buffer name suffix, reduce op, source expression)
+UpdateAgg = Tuple[str, str, Expression]
+MergeAgg = Tuple[str, str]
+
+
+class AggregateFunction(Expression):
+    """Base marker; not directly evaluable (evaluation happens through the
+    buffer machinery in the aggregate exec)."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self._id = None
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, new_children):
+        return type(self)(*new_children)
+
+    @property
+    def nullable(self):
+        return True
+
+    # -- declarative pieces --------------------------------------------------
+    def buffer_attrs(self) -> List[AttributeReference]:
+        raise NotImplementedError
+
+    def update_aggs(self) -> List[UpdateAgg]:
+        raise NotImplementedError
+
+    def merge_aggs(self) -> List[MergeAgg]:
+        raise NotImplementedError
+
+    def evaluate_expression(self, buffers: List[AttributeReference]) -> Expression:
+        raise NotImplementedError
+
+    def default_value(self):
+        """Result value for empty ungrouped reduction (None = SQL NULL)."""
+        return None
+
+    def eval_kernel(self, ctx, *vals):
+        raise RuntimeError("aggregate functions evaluate via the agg exec")
+
+
+class Min(AggregateFunction):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def buffer_attrs(self):
+        return [AttributeReference("min", self.data_type, True)]
+
+    def update_aggs(self):
+        return [("min", "min", self.child)]
+
+    def merge_aggs(self):
+        return [("min", "min")]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]
+
+
+class Max(AggregateFunction):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def buffer_attrs(self):
+        return [AttributeReference("max", self.data_type, True)]
+
+    def update_aggs(self):
+        return [("max", "max", self.child)]
+
+    def merge_aggs(self):
+        return [("max", "max")]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]
+
+
+def _sum_type(dt: DataType) -> DataType:
+    if dt in (DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64):
+        return DataType.INT64
+    return DataType.FLOAT64
+
+
+class Sum(AggregateFunction):
+    @property
+    def data_type(self):
+        return _sum_type(self.child.data_type)
+
+    def buffer_attrs(self):
+        return [AttributeReference("sum", self.data_type, True)]
+
+    def update_aggs(self):
+        from spark_rapids_tpu.ops.cast import Cast
+
+        src = self.child
+        if src.data_type != self.data_type:
+            src = Cast(src, self.data_type)
+        return [("sum", "sum", src)]
+
+    def merge_aggs(self):
+        return [("sum", "sum")]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]
+
+
+class Count(AggregateFunction):
+    """count(expr) — counts non-null; count(*) is Count(Literal(1))."""
+
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def buffer_attrs(self):
+        return [AttributeReference("count", DataType.INT64, False)]
+
+    def update_aggs(self):
+        return [("count", "count", self.child)]
+
+    def merge_aggs(self):
+        return [("count", "sum")]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]
+
+    def default_value(self):
+        return 0
+
+
+class Average(AggregateFunction):
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def buffer_attrs(self):
+        return [
+            AttributeReference("sum", DataType.FLOAT64, True),
+            AttributeReference("count", DataType.INT64, False),
+        ]
+
+    def update_aggs(self):
+        from spark_rapids_tpu.ops.cast import Cast
+
+        src = self.child
+        if src.data_type is not DataType.FLOAT64:
+            src = Cast(src, DataType.FLOAT64)
+        return [("sum", "sum", src), ("count", "count", self.child)]
+
+    def merge_aggs(self):
+        return [("sum", "sum"), ("count", "sum")]
+
+    def evaluate_expression(self, buffers):
+        from spark_rapids_tpu.ops.arithmetic import Divide
+
+        return Divide(buffers[0], buffers[1])
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, new_children):
+        return First(new_children[0], self.ignore_nulls)
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def buffer_attrs(self):
+        return [AttributeReference("first", self.data_type, True)]
+
+    def update_aggs(self):
+        op = "first_ignore_nulls" if self.ignore_nulls else "first"
+        return [("first", op, self.child)]
+
+    def merge_aggs(self):
+        op = "first_ignore_nulls" if self.ignore_nulls else "first"
+        return [("first", op)]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]
+
+    def _fingerprint_extra(self):
+        return f"{self.ignore_nulls};"
+
+
+class Last(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, new_children):
+        return Last(new_children[0], self.ignore_nulls)
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def buffer_attrs(self):
+        return [AttributeReference("last", self.data_type, True)]
+
+    def update_aggs(self):
+        op = "last_ignore_nulls" if self.ignore_nulls else "last"
+        return [("last", op, self.child)]
+
+    def merge_aggs(self):
+        op = "last_ignore_nulls" if self.ignore_nulls else "last"
+        return [("last", op)]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]
+
+    def _fingerprint_extra(self):
+        return f"{self.ignore_nulls};"

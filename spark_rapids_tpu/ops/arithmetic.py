@@ -1,0 +1,247 @@
+"""Arithmetic expressions (reference:
+org/apache/spark/sql/rapids/arithmetic.scala — +,-,*,/,div,pmod,remainder,
+abs,signum,unary +/-; 227 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType, common_type
+from spark_rapids_tpu.ops.base import BinaryExpression, UnaryExpression, _d
+from spark_rapids_tpu.ops.values import ColV
+
+
+class BinaryArithmetic(BinaryExpression):
+    @property
+    def data_type(self):
+        ct = common_type(self.left.data_type, self.right.data_type)
+        if ct is None:
+            raise TypeError(
+                f"{type(self).__name__}: incompatible types "
+                f"{self.left.data_type} / {self.right.data_type}"
+            )
+        return ct
+
+    def _cast_operands(self, ctx, lv, rv):
+        npdt = self.data_type.to_np()
+
+        def cast(x):
+            if hasattr(x, "astype"):
+                return x.astype(npdt) if x.dtype != npdt else x
+            return npdt.type(x)
+
+        return cast(_d(lv)), cast(_d(rv))
+
+
+class Add(BinaryArithmetic):
+    def do_columnar(self, ctx, lv, rv):
+        l, r = self._cast_operands(ctx, lv, rv)
+        return l + r
+
+
+class Subtract(BinaryArithmetic):
+    def do_columnar(self, ctx, lv, rv):
+        l, r = self._cast_operands(ctx, lv, rv)
+        return l - r
+
+
+class Multiply(BinaryArithmetic):
+    def do_columnar(self, ctx, lv, rv):
+        l, r = self._cast_operands(ctx, lv, rv)
+        return l * r
+
+
+class Divide(BinaryExpression):
+    """SQL / — always floating (Spark Divide); x/0 -> null handled by the
+    meta layer marking nullable and the kernel emitting NaN->null."""
+
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_kernel(self, ctx, lv, rv):
+        out = super().eval_kernel(ctx, lv, rv)
+        if isinstance(out, ColV):
+            # division by zero yields SQL NULL
+            xp = ctx.xp
+            r = _d(rv)
+            zero_div = (r == 0) if not isinstance(rv, ColV) else (rv.data == 0)
+            validity = out.validity & ctx.xp.logical_not(zero_div)
+            data = xp.where(validity, out.data, 0.0)
+            return ColV(out.dtype, data, validity)
+        if out.value is not None and _scalar_zero(rv):
+            out.value = None
+        return out
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        npdt = self.data_type.to_np()
+        l, r = _d(lv), _d(rv)
+        l = l.astype(npdt) if hasattr(l, "astype") else float(l)
+        r_arr = r.astype(npdt) if hasattr(r, "astype") else float(r)
+        safe_r = xp.where(r_arr == 0, 1.0, r_arr) if hasattr(r_arr, "dtype") else \
+            (1.0 if r_arr == 0 else r_arr)
+        return l / safe_r
+
+
+def _scalar_zero(v):
+    from spark_rapids_tpu.ops.values import ScalarV
+
+    return isinstance(v, ScalarV) and v.value == 0
+
+
+class IntegralDivide(BinaryExpression):
+    """SQL div — integer division returning LONG (Spark IntegralDivide)."""
+
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_kernel(self, ctx, lv, rv):
+        out = super().eval_kernel(ctx, lv, rv)
+        if isinstance(out, ColV):
+            xp = ctx.xp
+            zero_div = (rv.data == 0) if isinstance(rv, ColV) else (_d(rv) == 0)
+            validity = out.validity & ctx.xp.logical_not(zero_div)
+            return ColV(out.dtype, xp.where(validity, out.data, 0), validity)
+        if out.value is not None and _scalar_zero(rv):
+            out.value = None
+        return out
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        l = _d(lv)
+        l = l.astype(np.int64) if hasattr(l, "astype") else np.int64(l)
+        r = _d(rv)
+        r = r.astype(np.int64) if hasattr(r, "astype") else int(r)
+        safe_r = xp.where(r == 0, 1, r) if hasattr(r, "dtype") else (1 if r == 0 else r)
+        # SQL div truncates toward zero; // floors — fix up
+        q = l // safe_r
+        rem = l - q * safe_r
+        adj = (rem != 0) & ((l < 0) ^ (safe_r < 0))
+        return q + adj.astype(np.int64)
+
+
+class Remainder(BinaryArithmetic):
+    """SQL % — sign follows the dividend (C semantics, like Spark)."""
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_kernel(self, ctx, lv, rv):
+        out = super().eval_kernel(ctx, lv, rv)
+        if isinstance(out, ColV):
+            xp = ctx.xp
+            zero_div = (rv.data == 0) if isinstance(rv, ColV) else (_d(rv) == 0)
+            validity = out.validity & ctx.xp.logical_not(zero_div)
+            return ColV(out.dtype, xp.where(validity, out.data, 0), validity)
+        if out.value is not None and _scalar_zero(rv):
+            out.value = None
+        return out
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        npdt = self.data_type.to_np()
+        l, r = _d(lv), _d(rv)
+        l = l.astype(npdt) if hasattr(l, "astype") else l
+        r = r.astype(npdt) if hasattr(r, "astype") else r
+        safe_r = xp.where(r == 0, 1, r) if hasattr(r, "dtype") else (1 if r == 0 else r)
+        if npdt.kind == "f":
+            return xp.fmod(l, safe_r)
+        # truncated (toward-zero) remainder for ints: l - trunc_div(l,r)*r
+        q = l // safe_r
+        rem = l - q * safe_r
+        adj = (rem != 0) & ((l < 0) ^ (safe_r < 0))
+        return l - (q + adj) * safe_r
+
+
+class Pmod(BinaryArithmetic):
+    """pmod(a, b): positive modulus (reference: GpuPmod)."""
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_kernel(self, ctx, lv, rv):
+        out = super().eval_kernel(ctx, lv, rv)
+        if isinstance(out, ColV):
+            xp = ctx.xp
+            zero_div = (rv.data == 0) if isinstance(rv, ColV) else (_d(rv) == 0)
+            validity = out.validity & ctx.xp.logical_not(zero_div)
+            return ColV(out.dtype, xp.where(validity, out.data, 0), validity)
+        if out.value is not None and _scalar_zero(rv):
+            out.value = None
+        return out
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        npdt = self.data_type.to_np()
+        l, r = _d(lv), _d(rv)
+        l = l.astype(npdt) if hasattr(l, "astype") else l
+        r = r.astype(npdt) if hasattr(r, "astype") else r
+        safe_r = xp.where(r == 0, 1, r) if hasattr(r, "dtype") else (1 if r == 0 else r)
+        if npdt.kind == "f":
+            m = xp.fmod(l, safe_r)
+            return xp.where(m < 0, xp.fmod(m + safe_r, safe_r), m)
+
+        # java semantics: r = truncated a % n; if r < 0 then trunc_mod(r+n, n)
+        def trunc_mod(a, n):
+            q = a // n
+            rem = a - q * n
+            adj = (rem != 0) & ((a < 0) ^ (n < 0))
+            return a - (q + adj) * n
+
+        m = trunc_mod(l, safe_r)
+        return xp.where(m < 0, trunc_mod(m + safe_r, safe_r), m)
+
+
+class UnaryMinus(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def do_columnar(self, ctx, v):
+        return -v.data
+
+
+class UnaryPositive(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def do_columnar(self, ctx, v):
+        return v.data
+
+
+class Abs(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def do_columnar(self, ctx, v):
+        return ctx.xp.abs(v.data)
+
+
+class Signum(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def do_columnar(self, ctx, v):
+        return ctx.xp.sign(v.data).astype(self.data_type.to_np() if not ctx.is_device
+                                          else _phys(ctx))
+
+
+def _phys(ctx):
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    return physical_np_dtype(DataType.FLOAT64)

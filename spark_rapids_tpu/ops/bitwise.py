@@ -1,0 +1,81 @@
+"""Bitwise and shift expressions (reference: bitwise.scala, ~150 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType, common_type
+from spark_rapids_tpu.ops.base import BinaryExpression, UnaryExpression, _d
+
+
+class BitwiseBinary(BinaryExpression):
+    @property
+    def data_type(self):
+        return common_type(self.left.data_type, self.right.data_type)
+
+
+class BitwiseAnd(BitwiseBinary):
+    def do_columnar(self, ctx, lv, rv):
+        return _d(lv) & _d(rv)
+
+
+class BitwiseOr(BitwiseBinary):
+    def do_columnar(self, ctx, lv, rv):
+        return _d(lv) | _d(rv)
+
+
+class BitwiseXor(BitwiseBinary):
+    def do_columnar(self, ctx, lv, rv):
+        return _d(lv) ^ _d(rv)
+
+
+class BitwiseNot(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def do_columnar(self, ctx, v):
+        return ~v.data
+
+
+class ShiftLeft(BinaryExpression):
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        bits = 64 if self.data_type is DataType.INT64 else 32
+        shift = _d(rv) % bits  # java semantics: shift amount masked
+        return xp.left_shift(_d(lv), shift)
+
+
+class ShiftRight(BinaryExpression):
+    """Arithmetic (sign-extending) right shift."""
+
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        bits = 64 if self.data_type is DataType.INT64 else 32
+        shift = _d(rv) % bits
+        return xp.right_shift(_d(lv), shift)
+
+
+class ShiftRightUnsigned(BinaryExpression):
+    """Logical (zero-filling) right shift (java >>>)."""
+
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        npdt = self.data_type.to_np()
+        udt = np.dtype(np.uint64) if npdt == np.int64 else np.dtype(np.uint32)
+        bits = 64 if npdt == np.int64 else 32
+        shift = _d(rv) % bits
+        shift = shift.astype(udt) if hasattr(shift, "astype") else udt.type(shift)
+        return xp.right_shift(_d(lv).astype(udt), shift).astype(npdt)

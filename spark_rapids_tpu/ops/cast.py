@@ -1,0 +1,207 @@
+"""Cast (reference: GpuCast.scala, 867 LoC — per-direction compat flags,
+date/timestamp special cases; conf gates RapidsConf.scala:393-425).
+
+Device-supported directions (round 1): numeric<->numeric, bool<->numeric,
+date<->timestamp, timestamp<->long, int->string, date->string. String->numeric
+and float->string run on the CPU path (gated by the same conf keys the
+reference uses); the meta layer tags them for fallback on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import UnaryExpression
+from spark_rapids_tpu.ops.values import ColV
+
+MICROS_PER_DAY = 86_400_000_000
+MICROS_PER_SEC = 1_000_000
+
+
+class Cast(UnaryExpression):
+    def __init__(self, child, to_type: DataType, ansi: bool = False):
+        super().__init__(child)
+        self.to_type = to_type
+        self.ansi = ansi
+
+    def with_children(self, new_children):
+        return Cast(new_children[0], self.to_type, self.ansi)
+
+    @property
+    def data_type(self):
+        return self.to_type
+
+    def _fingerprint_extra(self):
+        return f"->{self.to_type.name};"
+
+    # which (from, to) directions the device kernel handles
+    @staticmethod
+    def device_supported(frm: DataType, to: DataType) -> bool:
+        if frm == to:
+            return True
+        numeric_ish = {DataType.BOOL, DataType.INT8, DataType.INT16,
+                       DataType.INT32, DataType.INT64, DataType.FLOAT32,
+                       DataType.FLOAT64}
+        if frm in numeric_ish and to in numeric_ish:
+            return True
+        if frm is DataType.DATE and to in (DataType.TIMESTAMP, DataType.STRING,
+                                           DataType.INT32):
+            return True
+        if frm is DataType.TIMESTAMP and to in (DataType.DATE, DataType.INT64):
+            return True
+        if frm in (DataType.INT8, DataType.INT16, DataType.INT32,
+                   DataType.INT64) and to is DataType.STRING:
+            return True
+        if frm is DataType.INT64 and to is DataType.TIMESTAMP:
+            return True
+        return False
+
+    def do_columnar(self, ctx, v):
+        frm, to = self.child.data_type, self.to_type
+        if frm == to:
+            return v.data if to is not DataType.STRING else v
+        if to is DataType.STRING:
+            return self._to_string(ctx, v, frm)
+        if frm is DataType.STRING:
+            return self._from_string(ctx, v, to)
+        return self._numeric_datetime(ctx, v, frm, to)
+
+    # -- numeric / datetime --------------------------------------------------
+    def _numeric_datetime(self, ctx, v, frm, to):
+        xp = ctx.xp
+        data = v.data
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+            npdt = physical_np_dtype(to)
+        else:
+            npdt = to.to_np()
+        if frm is DataType.DATE and to is DataType.TIMESTAMP:
+            return data.astype(np.int64) * MICROS_PER_DAY
+        if frm is DataType.TIMESTAMP and to is DataType.DATE:
+            return (data // MICROS_PER_DAY).astype(np.int32)
+        if frm is DataType.TIMESTAMP and to is DataType.INT64:
+            # spark: epoch seconds, floored
+            return data // MICROS_PER_SEC
+        if frm is DataType.INT64 and to is DataType.TIMESTAMP:
+            return data * MICROS_PER_SEC
+        if to is DataType.BOOL:
+            return data != 0
+        if frm.is_floating and to.is_integral:
+            # spark truncates toward zero; NaN -> 0, out-of-range saturates
+            # (non-ansi). float(int64.max) rounds up to 2^63, so saturate via
+            # comparisons instead of clip-then-astype (which would wrap).
+            clean = xp.where(xp.isnan(data), 0.0, data)
+            t = xp.trunc(clean)
+            info = np.iinfo(npdt)
+            res = t.astype(npdt)
+            res = xp.where(t >= float(info.max), info.max, res)
+            res = xp.where(t <= float(info.min), info.min, res)
+            return res
+        return data.astype(npdt)
+
+    # -- to string -----------------------------------------------------------
+    def _to_string(self, ctx, v, frm):
+        if not ctx.is_device:
+            return self._to_string_host(ctx, v, frm)
+        from spark_rapids_tpu.columnar import format as F
+
+        if frm.is_integral or frm is DataType.BOOL:
+            return F.int_to_string(ctx, v)
+        if frm is DataType.DATE:
+            return F.date_to_string(ctx, v)
+        raise NotImplementedError(f"device cast {frm} -> STRING")
+
+    def _to_string_host(self, ctx, v, frm):
+        def fmt(x):
+            if frm is DataType.BOOL:
+                return "true" if x else "false"
+            if frm.is_integral:
+                return str(int(x))
+            if frm is DataType.DATE:
+                return _date_str(int(x))
+            if frm is DataType.TIMESTAMP:
+                return _ts_str(int(x))
+            if frm.is_floating:
+                return _spark_float_str(float(x))
+            raise NotImplementedError(f"cast {frm} -> STRING")
+
+        return np.array([fmt(x) for x in v.data], dtype=object)
+
+    # -- from string (CPU only in round 1) -----------------------------------
+    def _from_string(self, ctx, v, to):
+        if ctx.is_device:
+            raise NotImplementedError("device cast STRING -> x (round 2)")
+        out = np.zeros(len(v.data), dtype=to.to_np())
+        validity = v.validity.copy()
+        for i, s in enumerate(v.data):
+            if not validity[i]:
+                continue
+            s = s.strip()
+            try:
+                if to.is_integral:
+                    out[i] = int(float(s)) if "." in s or "e" in s.lower() else int(s)
+                elif to.is_floating:
+                    out[i] = float(s)
+                elif to is DataType.BOOL:
+                    low = s.lower()
+                    if low in ("t", "true", "y", "yes", "1"):
+                        out[i] = True
+                    elif low in ("f", "false", "n", "no", "0"):
+                        out[i] = False
+                    else:
+                        raise ValueError(s)
+                elif to is DataType.DATE:
+                    out[i] = _parse_date(s)
+                elif to is DataType.TIMESTAMP:
+                    out[i] = _parse_ts(s)
+                else:
+                    raise NotImplementedError(f"cast STRING -> {to}")
+            except (ValueError, OverflowError):
+                if self.ansi:
+                    raise
+                validity[i] = False
+                out[i] = 0
+        return ColV(to, out, validity & v.validity)
+
+def _date_str(days: int) -> str:
+    import datetime
+
+    return (datetime.date(1970, 1, 1) + datetime.timedelta(days=days)).isoformat()
+
+
+def _ts_str(micros: int) -> str:
+    import datetime
+
+    dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=micros)
+    if dt.microsecond:
+        return dt.strftime("%Y-%m-%d %H:%M:%S.%f").rstrip("0")
+    return dt.strftime("%Y-%m-%d %H:%M:%S")
+
+
+def _parse_date(s: str) -> int:
+    import datetime
+
+    return (datetime.date.fromisoformat(s) - datetime.date(1970, 1, 1)).days
+
+
+def _parse_ts(s: str) -> int:
+    import datetime
+
+    dt = datetime.datetime.fromisoformat(s)
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+    delta = dt - datetime.datetime(1970, 1, 1)
+    return (delta.days * 86_400 + delta.seconds) * MICROS_PER_SEC + delta.microseconds
+
+
+def _spark_float_str(x: float) -> str:
+    """Java Double.toString-ish (Spark formatting): 1.0 not 1, NaN, Infinity."""
+    if np.isnan(x):
+        return "NaN"
+    if np.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == int(x) and abs(x) < 1e16:
+        return f"{x:.1f}"
+    return repr(x)

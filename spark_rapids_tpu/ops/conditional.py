@@ -1,0 +1,124 @@
+"""Conditional expressions (reference: conditionalExpressions.scala, 251 LoC —
+if / case-when)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import Expression, TernaryExpression
+from spark_rapids_tpu.ops.values import ColV, ScalarV, broadcast_scalar
+
+
+def _cond_parts(ctx, v):
+    """(is_true_data, ) for a boolean predicate value; null counts as false."""
+    xp = ctx.xp
+    if isinstance(v, ScalarV):
+        truth = (not v.is_null) and bool(v.value)
+        return xp.full((ctx.capacity,), truth)
+    return v.data.astype(bool) & v.validity
+
+
+def _merge_branch(ctx, pred_true, then_v, else_data, else_valid, dtype):
+    xp = ctx.xp
+    if dtype is DataType.STRING:
+        raise AssertionError("string branches handled via string_select")
+    if isinstance(then_v, ScalarV):
+        then_v = broadcast_scalar(ctx, then_v)
+    data = xp.where(pred_true, then_v.data, else_data)
+    valid = xp.where(pred_true, then_v.validity, else_valid)
+    return data, valid
+
+
+class If(TernaryExpression):
+    @property
+    def data_type(self):
+        return self.b.data_type if self.b.data_type is not DataType.NULL \
+            else self.c.data_type
+
+    def eval_kernel(self, ctx, pred, tv, fv):
+        xp = ctx.xp
+        if isinstance(pred, ScalarV) and isinstance(tv, ScalarV) and \
+           isinstance(fv, ScalarV):
+            taken = tv if ((not pred.is_null) and bool(pred.value)) else fv
+            return ScalarV(self.data_type, taken.value)
+        pred_true = _cond_parts(ctx, pred)
+        if self.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_select(ctx, pred_true, tv, fv)
+        if isinstance(fv, ScalarV):
+            fv = broadcast_scalar(ctx, fv)
+        data, valid = _merge_branch(ctx, pred_true, tv, fv.data, fv.validity,
+                                    self.data_type)
+        if ctx.is_device:
+            rm = ctx.row_mask()
+            valid = valid & rm
+            data = xp.where(valid, data, 0)
+        return ColV(self.data_type, data, valid)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2]... [ELSE e] END."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        assert branches
+        self.branches = tuple((c, v) for c, v in branches)
+        self.else_value = else_value
+
+    def children(self):
+        out: List[Expression] = []
+        for c, v in self.branches:
+            out.extend((c, v))
+        if self.else_value is not None:
+            out.append(self.else_value)
+        return tuple(out)
+
+    def with_children(self, new_children):
+        n = len(self.branches)
+        branches = [(new_children[2 * i], new_children[2 * i + 1]) for i in range(n)]
+        else_v = new_children[2 * n] if len(new_children) > 2 * n else None
+        return CaseWhen(branches, else_v)
+
+    @property
+    def data_type(self):
+        return self.branches[0][1].data_type
+
+    @property
+    def nullable(self):
+        if self.else_value is None:
+            return True
+        return any(v.nullable for _, v in self.branches) or self.else_value.nullable
+
+    def eval_kernel(self, ctx, *vals):
+        xp = ctx.xp
+        n = len(self.branches)
+        conds = [vals[2 * i] for i in range(n)]
+        thens = [vals[2 * i + 1] for i in range(n)]
+        else_v = vals[2 * n] if len(vals) > 2 * n else ScalarV(self.data_type, None)
+
+        if self.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            result = else_v
+            for c, t in zip(reversed(conds), reversed(thens)):
+                result = S.string_select(ctx, _cond_parts(ctx, c), t, result)
+            return result
+
+        if isinstance(else_v, ScalarV):
+            else_col = broadcast_scalar(
+                ctx, else_v if not else_v.is_null else ScalarV(self.data_type, None)
+            )
+        else:
+            else_col = else_v
+        data, valid = else_col.data, else_col.validity
+        for c, t in zip(reversed(conds), reversed(thens)):
+            pred_true = _cond_parts(ctx, c)
+            data, valid = _merge_branch(ctx, pred_true, t, data, valid,
+                                        self.data_type)
+        if ctx.is_device:
+            rm = ctx.row_mask()
+            valid = valid & rm
+            data = xp.where(valid, data, 0)
+        return ColV(self.data_type, data, valid)

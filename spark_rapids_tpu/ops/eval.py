@@ -1,0 +1,218 @@
+"""Projection/filter evaluation entry points.
+
+Device path: the whole bound-expression list is traced into ONE jit program
+per (expression fingerprint, batch shape bucket) — XLA fuses the expression
+tree the way cuDF evaluates per-op kernels back-to-back (but better: one
+fused kernel, no intermediate materialization in HBM unless XLA decides to).
+
+CPU path: the same expression trees evaluate with numpy — the independent
+oracle/fallback engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.batch import (
+    ColumnVector,
+    ColumnarBatch,
+    HostColumnVector,
+    HostColumnarBatch,
+)
+from spark_rapids_tpu.ops.base import Expression
+from spark_rapids_tpu.ops.values import ColV, EvalContext, ScalarV, broadcast_scalar
+
+# ColV must flow through jit as a pytree
+jax.tree_util.register_pytree_node(
+    ColV,
+    lambda cv: (
+        ((cv.data, cv.validity, cv.offsets), (cv.dtype, True))
+        if cv.offsets is not None
+        else ((cv.data, cv.validity), (cv.dtype, False))
+    ),
+    lambda aux, ch: ColV(aux[0], ch[0], ch[1], ch[2] if aux[1] else None),
+)
+
+
+def _col_to_colv(cv: ColumnVector) -> ColV:
+    return ColV(cv.dtype, cv.data, cv.validity, cv.offsets)
+
+
+def _colv_to_col(cv: ColV) -> ColumnVector:
+    return ColumnVector(cv.dtype, cv.data, cv.validity, cv.offsets)
+
+
+def _scalar_to_colv(ctx: EvalContext, s: ScalarV, want: DataType) -> ColV:
+    if want is DataType.STRING or s.dtype is DataType.STRING:
+        from spark_rapids_tpu.columnar import strings as S
+
+        # materialize one copy of the scalar bytes per row (string literal
+        # lengths are known at trace time, so byte_cap stays static)
+        v = S.as_view(ctx, s)
+        n = 0 if s.is_null else len(s.value.encode("utf-8"))
+        byte_cap = max(8, ctx.capacity * max(n, 1))
+        validity = v.validity & ctx.row_mask()
+        lens = jnp.where(validity, n, 0)
+        data, offsets = S.build_from_plan(
+            [v.data], jnp.zeros((ctx.capacity,), jnp.int32),
+            jnp.zeros((ctx.capacity,), jnp.int32), lens, byte_cap)
+        return ColV(DataType.STRING, data, validity, offsets)
+    if s.dtype is DataType.NULL:
+        s = ScalarV(want, None)
+    col = broadcast_scalar(ctx, s)
+    return ColV(want, col.data, col.validity)
+
+
+class DeviceProjector:
+    """Compiles and caches the jitted evaluator for a fixed list of bound
+    expressions (reference: GpuProjectExec's bound-expression evaluation,
+    basicPhysicalOperators.scala:34-95)."""
+
+    def __init__(self, exprs: Sequence[Expression]):
+        self.exprs = list(exprs)
+        self._jitted = None
+
+    def _build(self):
+        exprs = self.exprs
+
+        def fn(cols: List[ColV], num_rows, partition_id, row_start):
+            capacity = cols[0].validity.shape[0] if cols else 8
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity,
+                              partition_id=partition_id, row_start=row_start)
+            outs = []
+            for e in exprs:
+                r = e.eval(ctx)
+                if isinstance(r, ScalarV):
+                    r = _scalar_to_colv(ctx, r, e.data_type)
+                outs.append(r)
+            return outs
+
+        return jax.jit(fn)
+
+    def project(self, batch: ColumnarBatch, partition_id: int = 0,
+                row_start: int = 0) -> ColumnarBatch:
+        if self._jitted is None:
+            self._jitted = self._build()
+        cols = [_col_to_colv(c) for c in batch.columns]
+        if not cols:
+            # zero-column input (e.g. COUNT(*) over bare scan): evaluate with a
+            # synthetic capacity derived from num_rows
+            from spark_rapids_tpu.columnar.batch import bucket_capacity
+
+            cap = bucket_capacity(max(batch.num_rows, 1))
+            cols = [ColV(DataType.BOOL,
+                         jnp.zeros((cap,), dtype=bool),
+                         jnp.arange(cap) < batch.num_rows)]
+            outs = self._jitted(cols, jnp.int32(batch.num_rows),
+                                jnp.int32(partition_id), jnp.int64(row_start))
+        else:
+            outs = self._jitted(cols, jnp.int32(batch.num_rows),
+                                jnp.int32(partition_id), jnp.int64(row_start))
+        return ColumnarBatch([_colv_to_col(o) for o in outs], batch.num_rows)
+
+
+class DeviceFilter:
+    """Filter: evaluate the boolean condition inside jit, compact outside
+    (the row-count host sync; reference: GpuFilterExec + cudf Table.filter)."""
+
+    def __init__(self, condition: Expression):
+        self.condition = condition
+        self._jitted = None
+
+    def _build(self):
+        cond = self.condition
+
+        def fn(cols, num_rows, partition_id, row_start):
+            capacity = cols[0].validity.shape[0]
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity,
+                              partition_id=partition_id, row_start=row_start)
+            r = cond.eval(ctx)
+            if isinstance(r, ScalarV):
+                keep = jnp.full((capacity,),
+                                (not r.is_null) and bool(r.value))
+            else:
+                keep = r.data.astype(bool) & r.validity  # null -> dropped
+            return keep & ctx.row_mask()
+
+        return jax.jit(fn)
+
+    def apply(self, batch: ColumnarBatch, partition_id: int = 0,
+              row_start: int = 0) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar.batch import compact_batch
+
+        if self._jitted is None:
+            self._jitted = self._build()
+        cols = [_col_to_colv(c) for c in batch.columns]
+        keep = self._jitted(cols, jnp.int32(batch.num_rows),
+                            jnp.int32(partition_id), jnp.int64(row_start))
+        return compact_batch(batch, keep)
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle path
+# ---------------------------------------------------------------------------
+def _host_to_colv(hc: HostColumnVector) -> ColV:
+    return ColV(hc.dtype, hc.data, hc.validity)
+
+
+def _colv_to_host(cv: ColV, dtype: DataType) -> HostColumnVector:
+    data = cv.data
+    if dtype is DataType.STRING:
+        if data.dtype != object:
+            data = data.astype(object)
+        data = np.where(cv.validity, data, "")
+        return HostColumnVector(dtype, data, np.asarray(cv.validity, dtype=bool))
+    npdt = dtype.to_np()
+    if data.dtype != npdt:
+        data = data.astype(npdt)
+    data = np.where(cv.validity, data, npdt.type(0))
+    return HostColumnVector(dtype, data, np.asarray(cv.validity, dtype=bool))
+
+
+def cpu_eval_context(batch: HostColumnarBatch, partition_id: int = 0,
+                     row_start: int = 0) -> EvalContext:
+    cols = [_host_to_colv(c) for c in batch.columns]
+    n = batch.num_rows
+    return EvalContext(np, False, cols, n, n, partition_id=partition_id,
+                       row_start=row_start)
+
+
+def cpu_project(exprs: Sequence[Expression], batch: HostColumnarBatch,
+                partition_id: int = 0, row_start: int = 0) -> HostColumnarBatch:
+    ctx = cpu_eval_context(batch, partition_id, row_start)
+    outs = []
+    for e in exprs:
+        r = e.eval(ctx)
+        if isinstance(r, ScalarV):
+            if e.data_type is DataType.STRING or r.dtype is DataType.STRING:
+                data = np.full((ctx.capacity,),
+                               r.value if not r.is_null else "", dtype=object)
+                validity = np.full((ctx.capacity,), not r.is_null, dtype=bool)
+                outs.append(HostColumnVector(DataType.STRING, data, validity))
+                continue
+            r = broadcast_scalar(ctx, ScalarV(e.data_type, r.value))
+        outs.append(_colv_to_host(r, e.data_type))
+    return HostColumnarBatch(outs, batch.num_rows)
+
+
+def cpu_filter(condition: Expression, batch: HostColumnarBatch,
+               partition_id: int = 0, row_start: int = 0) -> HostColumnarBatch:
+    ctx = cpu_eval_context(batch, partition_id, row_start)
+    r = condition.eval(ctx)
+    if isinstance(r, ScalarV):
+        keep = np.full((batch.num_rows,), (not r.is_null) and bool(r.value))
+    else:
+        keep = np.asarray(r.data, dtype=bool) & r.validity
+    cols = [
+        HostColumnVector(c.dtype, c.data[keep], c.validity[keep])
+        for c in batch.columns
+    ]
+    return HostColumnarBatch(cols, int(keep.sum()))

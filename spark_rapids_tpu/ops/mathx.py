@@ -1,0 +1,162 @@
+"""Math expressions (reference: mathExpressions.scala, 378 LoC —
+trig/log/exp/sqrt/cbrt/rint/pow etc.)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import BinaryExpression, UnaryExpression, _d
+
+
+class UnaryMath(UnaryExpression):
+    """double -> double math fn."""
+
+    _fn = None  # name of the xp function
+
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def do_columnar(self, ctx, v):
+        xp = ctx.xp
+        data = v.data
+        if data.dtype.kind != "f":
+            data = data.astype(np.float64 if not ctx.is_device else _f(ctx))
+        return getattr(xp, self._fn)(data)
+
+
+def _f(ctx):
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    return physical_np_dtype(DataType.FLOAT64)
+
+
+class Sin(UnaryMath):
+    _fn = "sin"
+
+
+class Cos(UnaryMath):
+    _fn = "cos"
+
+
+class Tan(UnaryMath):
+    _fn = "tan"
+
+
+class Asin(UnaryMath):
+    _fn = "arcsin"
+
+
+class Acos(UnaryMath):
+    _fn = "arccos"
+
+
+class Atan(UnaryMath):
+    _fn = "arctan"
+
+
+class Sinh(UnaryMath):
+    _fn = "sinh"
+
+
+class Cosh(UnaryMath):
+    _fn = "cosh"
+
+
+class Tanh(UnaryMath):
+    _fn = "tanh"
+
+
+class Sqrt(UnaryMath):
+    _fn = "sqrt"
+
+
+class Exp(UnaryMath):
+    _fn = "exp"
+
+
+class Expm1(UnaryMath):
+    _fn = "expm1"
+
+
+class Log(UnaryMath):
+    _fn = "log"
+
+
+class Log1p(UnaryMath):
+    _fn = "log1p"
+
+
+class Log2(UnaryMath):
+    _fn = "log2"
+
+
+class Log10(UnaryMath):
+    _fn = "log10"
+
+
+class Cbrt(UnaryMath):
+    _fn = "cbrt"
+
+
+class Rint(UnaryMath):
+    _fn = "rint"
+
+
+class Floor(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    def do_columnar(self, ctx, v):
+        return ctx.xp.floor(v.data.astype(_f(ctx) if ctx.is_device else np.float64)) \
+            .astype(np.int64)
+
+
+class Ceil(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    def do_columnar(self, ctx, v):
+        return ctx.xp.ceil(v.data.astype(_f(ctx) if ctx.is_device else np.float64)) \
+            .astype(np.int64)
+
+
+class ToDegrees(UnaryMath):
+    _fn = "degrees"
+
+
+class ToRadians(UnaryMath):
+    _fn = "radians"
+
+
+class Pow(BinaryExpression):
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        f = _f(ctx) if ctx.is_device else np.float64
+
+        def cast(x):
+            return x.astype(f) if hasattr(x, "astype") else float(x)
+
+        return xp.power(cast(_d(lv)), cast(_d(rv)))
+
+
+class Atan2(BinaryExpression):
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        f = _f(ctx) if ctx.is_device else np.float64
+
+        def cast(x):
+            return x.astype(f) if hasattr(x, "astype") else float(x)
+
+        return xp.arctan2(cast(_d(lv)), cast(_d(rv)))

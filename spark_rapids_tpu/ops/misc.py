@@ -1,0 +1,126 @@
+"""Nondeterministic / context expressions (reference: GpuRandomExpressions.scala,
+GpuMonotonicallyIncreasingID, GpuSparkPartitionID, GpuInputFileBlock with
+coalesce poisoning — GpuExpressions.scala:81-85)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import LeafExpression
+from spark_rapids_tpu.ops.values import ColV
+
+
+class Rand(LeafExpression):
+    """rand(seed): uniform [0,1). Nondeterministic — per-partition stream
+    seeded by (seed, partition); values differ from the CPU oracle by design
+    (the reference marks rand INCOMPAT for the same reason)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def deterministic(self):
+        return False
+
+    def eval_kernel(self, ctx):
+        if ctx.is_device:
+            import jax
+
+            key = jax.random.key(
+                (self.seed * 1_000_003 + ctx.partition_id) & 0x7FFFFFFF
+            )
+            key = jax.random.fold_in(key, ctx.row_start)
+            from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+            data = jax.random.uniform(
+                key, (ctx.capacity,),
+                dtype=physical_np_dtype(DataType.FLOAT64))
+            validity = ctx.row_mask()
+            return ColV(DataType.FLOAT64, data * validity, validity)
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + ctx.partition_id) % (2**31))
+        rng.randint(0, 2**31)  # advance so row_start matters
+        data = rng.uniform(size=ctx.capacity)
+        return ColV(DataType.FLOAT64, data,
+                    np.ones((ctx.capacity,), dtype=bool))
+
+    def _fingerprint_extra(self):
+        return f"{self.seed};"
+
+
+class MonotonicallyIncreasingID(LeafExpression):
+    """partition_id << 33 | row_index (Spark's exact layout)."""
+
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def deterministic(self):
+        return False
+
+    def eval_kernel(self, ctx):
+        xp = ctx.xp
+        # partition_id/row_start may be traced scalars on the device path
+        base = xp.asarray(ctx.partition_id, dtype=np.int64) * np.int64(1 << 33)
+        ids = base + ctx.row_start + xp.arange(ctx.capacity, dtype=np.int64)
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            ids = xp.where(validity, ids, 0)
+        return ColV(DataType.INT64, ids, validity)
+
+
+class SparkPartitionID(LeafExpression):
+    @property
+    def data_type(self):
+        return DataType.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_kernel(self, ctx):
+        xp = ctx.xp
+        data = xp.full((ctx.capacity,), ctx.partition_id, dtype=np.int32)
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = xp.where(validity, data, 0)
+        return ColV(DataType.INT32, data, validity)
+
+
+class InputFileName(LeafExpression):
+    """input_file_name(). Poisons batch coalescing upstream (reference:
+    GpuExpression.disableCoalesceUntilInput) — handled by the transition
+    optimizer. Round 1: evaluates to '' like Spark does outside scans."""
+
+    @property
+    def data_type(self):
+        return DataType.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def disable_coalesce_until_input(self) -> bool:
+        return True
+
+    def eval_kernel(self, ctx):
+        from spark_rapids_tpu.ops.values import ScalarV
+
+        return ScalarV(DataType.STRING, "")

@@ -1,0 +1,175 @@
+"""Null-handling expressions (reference: nullExpressions.scala, 297 LoC —
+coalesce, isnull/isnotnull, isnan, nanvl, AtLeastNNonNulls)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import (
+    BinaryExpression,
+    Expression,
+    UnaryExpression,
+    _d,
+)
+from spark_rapids_tpu.ops.values import ColV, ScalarV, broadcast_scalar
+
+
+class IsNull(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_kernel(self, ctx, v):
+        xp = ctx.xp
+        if isinstance(v, ScalarV):
+            return ScalarV(DataType.BOOL, v.is_null)
+        data = ~v.validity
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = data & validity
+        return ColV(DataType.BOOL, data, validity)
+
+
+class IsNotNull(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_kernel(self, ctx, v):
+        xp = ctx.xp
+        if isinstance(v, ScalarV):
+            return ScalarV(DataType.BOOL, not v.is_null)
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+        return ColV(DataType.BOOL, v.validity & validity, validity)
+
+
+class IsNan(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_kernel(self, ctx, v):
+        xp = ctx.xp
+        if isinstance(v, ScalarV):
+            return ScalarV(DataType.BOOL,
+                           v.value is not None and np.isnan(v.value))
+        data = xp.isnan(v.data) & v.validity
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = data & validity
+        return ColV(DataType.BOOL, data, validity)
+
+
+class NaNvl(BinaryExpression):
+    """nanvl(a, b): b where a is NaN else a."""
+
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        l, r = _d(lv), _d(rv)
+        return xp.where(xp.isnan(l), r, l)
+
+
+class Coalesce(Expression):
+    def __init__(self, *exprs: Expression):
+        assert exprs
+        self.exprs = tuple(exprs)
+
+    def children(self):
+        return self.exprs
+
+    def with_children(self, new_children):
+        return Coalesce(*new_children)
+
+    @property
+    def data_type(self):
+        return self.exprs[0].data_type
+
+    @property
+    def nullable(self):
+        return all(e.nullable for e in self.exprs)
+
+    def eval_kernel(self, ctx, *vals):
+        xp = ctx.xp
+        if all(isinstance(v, ScalarV) for v in vals):
+            for v in vals:
+                if not v.is_null:
+                    return ScalarV(self.data_type, v.value)
+            return ScalarV(self.data_type, None)
+        if self.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_coalesce(ctx, vals)
+        cols = [broadcast_scalar(ctx, v) if isinstance(v, ScalarV) else v
+                for v in vals]
+        data = cols[-1].data
+        validity = cols[-1].validity
+        for c in reversed(cols[:-1]):
+            data = xp.where(c.validity, c.data, data)
+            validity = c.validity | validity
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = xp.where(validity, data, 0)
+        return ColV(self.data_type, data, validity)
+
+
+class AtLeastNNonNulls(Expression):
+    def __init__(self, n: int, *exprs: Expression):
+        self.n = n
+        self.exprs = tuple(exprs)
+
+    def children(self):
+        return self.exprs
+
+    def with_children(self, new_children):
+        return AtLeastNNonNulls(self.n, *new_children)
+
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_kernel(self, ctx, *vals):
+        xp = ctx.xp
+        count = xp.zeros((ctx.capacity,), dtype=np.int32)
+        for v in vals:
+            if isinstance(v, ScalarV):
+                if not v.is_null:
+                    count = count + 1
+            else:
+                valid = v.validity
+                if v.dtype.is_floating:
+                    valid = valid & ~xp.isnan(v.data)
+                count = count + valid.astype(np.int32)
+        data = count >= self.n
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = data & validity
+        return ColV(DataType.BOOL, data, validity)
+
+    def _fingerprint_extra(self):
+        return f"{self.n};"

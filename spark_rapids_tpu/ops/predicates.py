@@ -1,0 +1,231 @@
+"""Predicates and logical operators (reference: predicates.scala 621 LoC +
+GpuInSet.scala). And/Or use Kleene three-valued logic like Spark."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import (
+    BinaryExpression,
+    Expression,
+    UnaryExpression,
+    _d,
+)
+from spark_rapids_tpu.ops.values import ColV, ScalarV
+
+
+class BinaryComparison(BinaryExpression):
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    def _operands(self, ctx, lv, rv):
+        # string comparisons never reach here — each subclass short-circuits
+        # to the string kernels first
+        return _d(lv), _d(rv)
+
+
+class EqualTo(BinaryComparison):
+    def do_columnar(self, ctx, lv, rv):
+        if self.left.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_equal(ctx, lv, rv)
+        l, r = self._operands(ctx, lv, rv)
+        return l == r
+
+
+class LessThan(BinaryComparison):
+    def do_columnar(self, ctx, lv, rv):
+        if self.left.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_compare(ctx, lv, rv, "lt")
+        l, r = self._operands(ctx, lv, rv)
+        return l < r
+
+
+class LessThanOrEqual(BinaryComparison):
+    def do_columnar(self, ctx, lv, rv):
+        if self.left.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_compare(ctx, lv, rv, "le")
+        l, r = self._operands(ctx, lv, rv)
+        return l <= r
+
+
+class GreaterThan(BinaryComparison):
+    def do_columnar(self, ctx, lv, rv):
+        if self.left.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_compare(ctx, lv, rv, "gt")
+        l, r = self._operands(ctx, lv, rv)
+        return l > r
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    def do_columnar(self, ctx, lv, rv):
+        if self.left.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.string_compare(ctx, lv, rv, "ge")
+        l, r = self._operands(ctx, lv, rv)
+        return l >= r
+
+
+class EqualNullSafe(BinaryExpression):
+    """<=> — null-safe equality: NULL<=>NULL is true, never returns null."""
+
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_kernel(self, ctx, lv, rv):
+        xp = ctx.xp
+
+        def as_col(v):
+            if isinstance(v, ScalarV):
+                from spark_rapids_tpu.ops.values import broadcast_scalar
+
+                if v.dtype is DataType.STRING:
+                    return v
+                return broadcast_scalar(ctx, v)
+            return v
+
+        if self.left.data_type is DataType.STRING:
+            from spark_rapids_tpu.columnar import strings as S
+
+            eq = S.string_equal(ctx, lv, rv)
+            lvalid = lv.validity if isinstance(lv, ColV) else \
+                xp.full((ctx.capacity,), not lv.is_null)
+            rvalid = rv.validity if isinstance(rv, ColV) else \
+                xp.full((ctx.capacity,), not rv.is_null)
+        else:
+            lc, rc = as_col(lv), as_col(rv)
+            eq = lc.data == rc.data
+            lvalid, rvalid = lc.validity, rc.validity
+        both_valid = lvalid & rvalid
+        both_null = ~lvalid & ~rvalid
+        data = (both_valid & eq) | both_null
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = data & validity
+        return ColV(DataType.BOOL, data, validity)
+
+
+class And(BinaryExpression):
+    """Kleene AND: F&null=F, T&null=null."""
+
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    def eval_kernel(self, ctx, lv, rv):
+        xp = ctx.xp
+        ld, lval = _bool_parts(ctx, lv)
+        rd, rval = _bool_parts(ctx, rv)
+        data = ld & rd
+        false_somewhere = (~ld & lval) | (~rd & rval)
+        validity = (lval & rval) | false_somewhere
+        data = data & validity
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = data & validity
+        return ColV(DataType.BOOL, data, validity)
+
+
+class Or(BinaryExpression):
+    """Kleene OR: T|null=T, F|null=null."""
+
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    def eval_kernel(self, ctx, lv, rv):
+        xp = ctx.xp
+        ld, lval = _bool_parts(ctx, lv)
+        rd, rval = _bool_parts(ctx, rv)
+        data = ld | rd
+        true_somewhere = (ld & lval) | (rd & rval)
+        validity = (lval & rval) | true_somewhere
+        data = data & validity
+        if ctx.is_device:
+            validity = validity & ctx.row_mask()
+            data = data & validity
+        return ColV(DataType.BOOL, data, validity)
+
+
+def _bool_parts(ctx, v):
+    xp = ctx.xp
+    if isinstance(v, ScalarV):
+        if v.is_null:
+            return (xp.zeros((ctx.capacity,), dtype=bool),
+                    xp.zeros((ctx.capacity,), dtype=bool))
+        return (xp.full((ctx.capacity,), bool(v.value)),
+                xp.ones((ctx.capacity,), dtype=bool))
+    return v.data.astype(bool), v.validity
+
+
+class Not(UnaryExpression):
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    def do_columnar(self, ctx, v):
+        return ~v.data.astype(bool)
+
+
+class In(Expression):
+    """value IN (list of foldable literals) (reference: GpuInSet)."""
+
+    def __init__(self, value: Expression, candidates: Sequence[Expression]):
+        self.value = value
+        self.candidates = tuple(candidates)
+
+    def children(self):
+        return (self.value,) + self.candidates
+
+    def with_children(self, new_children):
+        return In(new_children[0], new_children[1:])
+
+    @property
+    def data_type(self):
+        return DataType.BOOL
+
+    def eval_kernel(self, ctx, v, *cand_vals):
+        xp = ctx.xp
+        if isinstance(v, ScalarV):
+            if v.is_null:
+                return ScalarV(DataType.BOOL, None)
+            hit = any((not c.is_null) and c.value == v.value for c in cand_vals)
+            has_null = any(c.is_null for c in cand_vals)
+            return ScalarV(DataType.BOOL, True if hit else (None if has_null else False))
+        acc = xp.zeros((ctx.capacity,), dtype=bool)
+        has_null_candidate = False
+        for c in cand_vals:
+            if c.is_null:
+                has_null_candidate = True
+                continue
+            if self.value.data_type is DataType.STRING:
+                from spark_rapids_tpu.columnar import strings as S
+
+                acc = acc | S.string_equal(ctx, v, c)
+            else:
+                acc = acc | (v.data == c.value)
+        # SQL: x IN (...) with a NULL candidate -> NULL unless matched
+        validity = v.validity & (acc | (not has_null_candidate))
+        data = acc & validity
+        return ColV(DataType.BOOL, data, validity)
+
+    def _fingerprint_extra(self):
+        return ""

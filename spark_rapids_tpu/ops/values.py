@@ -1,0 +1,112 @@
+"""Evaluation value model shared by the device (jnp) and cpu (numpy) paths.
+
+Reference parity: GpuExpression.columnarEval returns either a GpuColumnVector
+or a scalar (GpuExpressions.scala:74-99); GpuScalar wraps host values into
+cudf Scalars (literals.scala:33). Here `ColV` is the column result and
+`ScalarV` the scalar result; kernels receive either and rely on numpy/jnp
+broadcasting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+
+@dataclass
+class ColV:
+    """A column value during evaluation.
+
+    device path: data/validity (and offsets for strings) are traced jax arrays
+    padded to the batch capacity.
+    cpu path: numpy arrays of exactly num_rows; strings are object arrays and
+    offsets is None.
+    """
+
+    dtype: DataType
+    data: Any
+    validity: Any
+    offsets: Optional[Any] = None
+
+    @property
+    def is_string(self) -> bool:
+        return self.dtype is DataType.STRING
+
+
+@dataclass
+class ScalarV:
+    dtype: DataType
+    value: Any  # python scalar; None iff is_null
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+
+class EvalContext:
+    """Carries the batch being evaluated plus engine context.
+
+    device path: xp = jax.numpy, capacity static, num_rows traced scalar.
+    cpu path: xp = numpy, capacity == num_rows (no padding), num_rows int.
+    """
+
+    __slots__ = (
+        "xp", "is_device", "columns", "num_rows", "capacity",
+        "partition_id", "rng_seed", "row_start",
+    )
+
+    def __init__(self, xp, is_device, columns, num_rows, capacity,
+                 partition_id=0, rng_seed=0, row_start=0):
+        self.xp = xp
+        self.is_device = is_device
+        self.columns = columns  # list[ColV]
+        self.num_rows = num_rows
+        self.capacity = capacity
+        self.partition_id = partition_id
+        self.rng_seed = rng_seed
+        # global row offset of this batch within the partition (for
+        # monotonically_increasing_id)
+        self.row_start = row_start
+
+    def row_mask(self):
+        return self.xp.arange(self.capacity) < self.num_rows
+
+
+def and_validity(xp, *validities):
+    """Null propagation: result is null if any input is null."""
+    out = None
+    for v in validities:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+def broadcast_scalar(ctx: EvalContext, s: ScalarV):
+    """Materialize a scalar as a column (used when a kernel needs arrays)."""
+    xp = ctx.xp
+    if s.dtype is DataType.STRING:
+        raise NotImplementedError("string scalar broadcast is kernel-specific")
+    npdt = s.dtype.to_np()
+    if ctx.is_device:
+        from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+        npdt = physical_np_dtype(s.dtype)
+    fill = s.value if not s.is_null else 0
+    data = xp.full((ctx.capacity,), npdt.type(fill) if not ctx.is_device else fill,
+                   dtype=npdt)
+    validity = xp.full((ctx.capacity,), not s.is_null, dtype=bool)
+    if ctx.is_device:
+        validity = validity & ctx.row_mask()
+    return ColV(s.dtype, data, validity)
+
+
+def zero_nulls(xp, data, validity):
+    """Re-establish the 'data is 0 at null slots' convention after a kernel
+    (keeps padded/null lanes deterministic for hashing and sorting)."""
+    if validity is None:
+        return data
+    return xp.where(validity, data, np.zeros((), dtype=data.dtype))

@@ -30,8 +30,8 @@ __version__ = "0.1.0"
 from spark_rapids_tpu.conf import TpuConf  # noqa: F401
 
 
-def new_session(conf=None):
+def new_session(settings=None):
     """Create a new TpuSession (the SparkSession analog)."""
-    from spark_rapids_tpu.engine.session import TpuSession
+    from spark_rapids_tpu.session import TpuSession
 
-    return TpuSession(conf=conf)
+    return TpuSession(settings)

@@ -55,6 +55,21 @@ class DataType(enum.Enum):
     def is_datetime(self) -> bool:
         return self in (DataType.DATE, DataType.TIMESTAMP)
 
+    @staticmethod
+    def parse(s: str) -> "DataType":
+        """Parse a Spark-style type name ('int', 'long', 'double', ...)."""
+        aliases = {
+            "bool": "boolean", "tinyint": "byte", "smallint": "short",
+            "integer": "int", "bigint": "long", "real": "float",
+            "str": "string",
+        }
+        k = s.strip().lower()
+        k = aliases.get(k, k)
+        try:
+            return DataType(k)
+        except ValueError:
+            raise ValueError(f"unknown data type name {s!r}") from None
+
     def to_np(self) -> np.dtype:
         """Physical numpy dtype on the CPU oracle path (exact semantics).
         The device-path mapping (with TPU f64->f32 narrowing) is

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.engine.scheduler import TaskScheduler  # noqa: F401

@@ -1,0 +1,94 @@
+"""Partition-task scheduler.
+
+The Spark-executor analog: a pool of worker threads runs partition tasks;
+each task gets a task-attempt id (TaskContext analog) and automatically
+releases the TPU admission semaphore on completion, mirroring the
+completion-listener auto-release in GpuSemaphore.scala:101-161.
+
+Task failure behavior mirrors Spark's retry loop (reference: Spark task
+retry + lineage is the reference's whole failure story, SURVEY.md section 5):
+a failed partition task is retried up to `max_failures` times before the
+job fails.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from typing import Callable, Iterator, List, Optional, TypeVar
+
+from spark_rapids_tpu.exec.transitions import current_task_id, set_task_id
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+T = TypeVar("T")
+
+_next_task_id = iter(range(1_000_000, 1 << 62))
+_next_task_id_lock = threading.Lock()
+
+
+class TaskFailedError(RuntimeError):
+    def __init__(self, pidx: int, attempts: int, cause: BaseException):
+        super().__init__(
+            f"partition task {pidx} failed after {attempts} attempts: {cause!r}")
+        self.pidx = pidx
+        self.cause = cause
+
+
+class TaskScheduler:
+    def __init__(self, num_threads: int = 8, max_failures: int = 2):
+        self.num_threads = max(1, num_threads)
+        self.max_failures = max(1, max_failures)
+        self._pool: Optional[cf.ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+
+    def _ensure_pool(self) -> cf.ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = cf.ThreadPoolExecutor(
+                    max_workers=self.num_threads,
+                    thread_name_prefix="tpu-task")
+            return self._pool
+
+    def shutdown(self):
+        with self._lock:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+                self._pool = None
+
+    # -- the task wrapper ----------------------------------------------------
+    def _run_task(self, pidx: int, fn: Callable[[int], T]) -> T:
+        last: Optional[BaseException] = None
+        for _attempt in range(self.max_failures):
+            with _next_task_id_lock:
+                task_id = next(_next_task_id)
+            set_task_id(task_id)
+            try:
+                return fn(pidx)
+            except Exception as e:  # noqa: BLE001 — task isolation boundary
+                last = e
+            finally:
+                # completion-listener analog: always drop the semaphore
+                TpuSemaphore.get().release_if_necessary(task_id)
+                set_task_id(None)
+        raise TaskFailedError(pidx, self.max_failures, last)
+
+    def run_job(self, num_partitions: int,
+                fn: Callable[[int], T]) -> List[T]:
+        """Run fn over every partition index; returns results in order."""
+        if num_partitions == 0:
+            return []
+        if num_partitions == 1:
+            return [self._run_task(0, fn)]
+        pool = self._ensure_pool()
+        futures = [pool.submit(self._run_task, p, fn)
+                   for p in range(num_partitions)]
+        return [f.result() for f in futures]
+
+    def run_job_iter(self, num_partitions: int,
+                     fn: Callable[[int], T]) -> Iterator[T]:
+        """Yield per-partition results as they complete (unordered)."""
+        pool = self._ensure_pool()
+        futures = [pool.submit(self._run_task, p, fn)
+                   for p in range(num_partitions)]
+        for f in cf.as_completed(futures):
+            yield f.result()

@@ -1,0 +1,162 @@
+"""Physical execution layer base.
+
+Reference parity: GpuExec.scala —
+- `GpuExec` trait (supportsColumnar=true, standard metrics, GpuExec.scala:24-41)
+  -> `TpuExec` (device path over `ColumnarBatch`).
+- CPU fallback execs (plain Spark operators the plan falls back to) ->
+  `CpuExec` (numpy oracle path over `HostColumnarBatch`).
+- `coalesceAfter` / `childrenCoalesceGoal` hooks (GpuExec.scala:49-57) ->
+  same-named properties consumed by transition insertion
+  (plan/transitions.py, reference GpuTransitionOverrides.scala:64-147).
+
+Execution model: the Spark-RDD role is played by `PartitionedBatches` — a
+partition count plus a per-partition iterator factory. Operators compose
+lazily; exchanges materialize. The task scheduler (engine/scheduler.py) runs
+partition tasks on a worker pool gated by the TpuSemaphore, mirroring Spark
+executor slots + GpuSemaphore admission.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+from spark_rapids_tpu.ops.base import AttributeReference
+from spark_rapids_tpu.utils import metrics as M
+
+
+class PartitionedBatches:
+    """num_partitions + per-partition batch-iterator factory (the RDD analog)."""
+
+    __slots__ = ("num_partitions", "_factory")
+
+    def __init__(self, num_partitions: int,
+                 factory: Callable[[int], Iterator]):
+        self.num_partitions = num_partitions
+        self._factory = factory
+
+    def iterator(self, pidx: int) -> Iterator:
+        return self._factory(pidx)
+
+
+class ExecContext:
+    """Carried through execute(); holds session-scoped services."""
+
+    __slots__ = ("conf", "scheduler", "device_manager", "spill_catalog")
+
+    def __init__(self, conf, scheduler=None, device_manager=None,
+                 spill_catalog=None):
+        self.conf = conf
+        self.scheduler = scheduler
+        self.device_manager = device_manager
+        self.spill_catalog = spill_catalog
+
+
+class PhysicalExec:
+    """Base physical operator node."""
+
+    def __init__(self, *children: "PhysicalExec"):
+        self.children: Tuple[PhysicalExec, ...] = children
+        self.metrics = M.MetricsMap()
+
+    # -- schema --------------------------------------------------------------
+    @property
+    def output(self) -> List[AttributeReference]:
+        raise NotImplementedError(type(self).__name__)
+
+    # -- placement -----------------------------------------------------------
+    # "tpu" nodes consume/produce device ColumnarBatch; "cpu" nodes
+    # HostColumnarBatch. The planner inserts transition nodes at boundaries.
+    placement: str = "tpu"
+
+    # -- coalesce contracts (reference: GpuExec.scala:49-57) ------------------
+    @property
+    def coalesce_after(self) -> bool:
+        return False
+
+    @property
+    def children_coalesce_goal(self) -> List[Optional[object]]:
+        return [None] * len(self.children)
+
+    # -- partitioning info ----------------------------------------------------
+    def output_partitioning(self):
+        """Opaque partitioning descriptor; exchanges set it, most ops pass
+        the child's through (used to elide redundant exchanges)."""
+        if self.children:
+            return self.children[0].output_partitioning()
+        return None
+
+    # -- execution ------------------------------------------------------------
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        raise NotImplementedError(type(self).__name__)
+
+    # -- tree utilities --------------------------------------------------------
+    def with_children(self, new_children: Sequence["PhysicalExec"]) -> "PhysicalExec":
+        raise NotImplementedError(type(self).__name__)
+
+    def transform_up(self, fn) -> "PhysicalExec":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self
+        if new_children and any(a is not b for a, b in zip(new_children, self.children)):
+            node = self.with_children(new_children)
+        return fn(node)
+
+    def foreach(self, fn) -> None:
+        fn(self)
+        for c in self.children:
+            c.foreach(fn)
+
+    def collect_nodes(self, pred) -> List["PhysicalExec"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect_nodes(pred))
+        return out
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.node_name()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return self.node_name()
+
+
+class TpuExec(PhysicalExec):
+    """Device-path operator (reference: GpuExec trait)."""
+
+    placement = "tpu"
+
+
+class CpuExec(PhysicalExec):
+    """Host oracle-path operator (the 'stayed on CPU' fallback engine)."""
+
+    placement = "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Batch-count helpers shared by exec implementations
+# ---------------------------------------------------------------------------
+def count_output(metrics: M.MetricsMap, it: Iterator) -> Iterator:
+    """Wrap an iterator updating the standard output metrics."""
+    rows_m = metrics[M.NUM_OUTPUT_ROWS]
+    batches_m = metrics[M.NUM_OUTPUT_BATCHES]
+    for b in it:
+        rows_m.add(b.num_rows)
+        batches_m.add(1)
+        yield b
+
+
+def batch_rows(b) -> int:
+    return b.num_rows
+
+
+def is_device_batch(b) -> bool:
+    return isinstance(b, ColumnarBatch)
+
+
+def is_host_batch(b) -> bool:
+    return isinstance(b, HostColumnarBatch)

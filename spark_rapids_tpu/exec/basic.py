@@ -1,0 +1,420 @@
+"""Basic physical operators.
+
+Reference parity: basicPhysicalOperators.scala —
+- GpuProjectExec (:34-95)  -> TpuProjectExec / CpuProjectExec
+- GpuFilterExec  (:96-177) -> TpuFilterExec / CpuFilterExec
+- GpuUnionExec   (:178-200)-> TpuUnionExec / CpuUnionExec
+- GpuCoalesceExec(:201-240)-> CoalescePartitionsExec (partition merge)
+limit.scala:39-123 -> Tpu/CpuLocalLimitExec, Tpu/CpuGlobalLimitExec.
+Scans over pre-loaded host data (the LocalTableScan analog) plus a Range
+generator used heavily by tests and benchmarks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    HostColumnarBatch,
+    HostColumnVector,
+    slice_batch_host,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.ops.base import Alias, AttributeReference, Expression, to_attribute
+from spark_rapids_tpu.ops.bind import bind_all, bind_references
+from spark_rapids_tpu.ops.eval import (
+    DeviceFilter,
+    DeviceProjector,
+    cpu_filter,
+    cpu_project,
+)
+from spark_rapids_tpu.utils import metrics as M
+
+
+# ---------------------------------------------------------------------------
+# Scans
+# ---------------------------------------------------------------------------
+class HostScanExec(CpuExec):
+    """Scan of pre-partitioned host batches (LocalTableScan analog)."""
+
+    def __init__(self, schema: List[AttributeReference],
+                 partitions: List[List[HostColumnarBatch]]):
+        super().__init__()
+        self._schema = schema
+        self._partitions = partitions
+
+    @property
+    def output(self):
+        return self._schema
+
+    def with_children(self, new_children):
+        assert not new_children
+        return self
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        parts = self._partitions
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            return count_output(self.metrics, iter(parts[pidx]))
+
+        return PartitionedBatches(len(parts), factory)
+
+    def node_name(self):
+        return f"HostScan[{len(self._partitions)} parts]"
+
+
+class RangeExec(CpuExec):
+    """spark.range equivalent: int64 ids split across partitions."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 out_attr: Optional[AttributeReference] = None):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_parts = max(1, num_partitions)
+        self._attr = out_attr or AttributeReference("id", DataType.INT64, False)
+
+    @property
+    def output(self):
+        return [self._attr]
+
+    def with_children(self, new_children):
+        assert not new_children
+        return self
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_parts) if total else 0
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            lo = pidx * per
+            hi = min(total, (pidx + 1) * per)
+            if hi <= lo:
+                return iter(())
+            ids = self.start + self.step * np.arange(lo, hi, dtype=np.int64)
+            col = HostColumnVector(DataType.INT64, ids,
+                                   np.ones(len(ids), dtype=bool))
+            return count_output(self.metrics,
+                                iter([HostColumnarBatch([col], len(ids))]))
+
+        return PartitionedBatches(self.num_parts, factory)
+
+
+# ---------------------------------------------------------------------------
+# Project
+# ---------------------------------------------------------------------------
+class TpuProjectExec(TpuExec):
+    """Reference: GpuProjectExec, basicPhysicalOperators.scala:34-95."""
+
+    def __init__(self, project_list: Sequence[Expression], child: PhysicalExec):
+        super().__init__(child)
+        self.project_list = list(project_list)
+        self._bound = bind_all(self.project_list, child.output)
+        self._projector = DeviceProjector(self._bound)
+
+    @property
+    def output(self):
+        return [to_attribute(e) for e in self.project_list]
+
+    def with_children(self, new_children):
+        return TpuProjectExec(self.project_list, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        projector = self._projector
+        total_time = self.metrics[M.TOTAL_TIME]
+
+        def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            row_start = 0
+            for batch in child_pb.iterator(pidx):
+                with M.trace_range("TpuProject", total_time):
+                    out = projector.project(batch, partition_id=pidx,
+                                            row_start=row_start)
+                row_start += batch.num_rows
+                yield out
+
+        return PartitionedBatches(child_pb.num_partitions,
+                                  lambda p: count_output(self.metrics, factory(p)))
+
+
+class CpuProjectExec(CpuExec):
+    def __init__(self, project_list: Sequence[Expression], child: PhysicalExec):
+        super().__init__(child)
+        self.project_list = list(project_list)
+        self._bound = bind_all(self.project_list, child.output)
+
+    @property
+    def output(self):
+        return [to_attribute(e) for e in self.project_list]
+
+    def with_children(self, new_children):
+        return CpuProjectExec(self.project_list, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        bound = self._bound
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            row_start = 0
+            for batch in child_pb.iterator(pidx):
+                yield cpu_project(bound, batch, partition_id=pidx,
+                                  row_start=row_start)
+                row_start += batch.num_rows
+
+        return PartitionedBatches(child_pb.num_partitions,
+                                  lambda p: count_output(self.metrics, factory(p)))
+
+
+# ---------------------------------------------------------------------------
+# Filter
+# ---------------------------------------------------------------------------
+class TpuFilterExec(TpuExec):
+    """Reference: GpuFilterExec, basicPhysicalOperators.scala:96-177."""
+
+    def __init__(self, condition: Expression, child: PhysicalExec):
+        super().__init__(child)
+        self.condition = condition
+        self._bound = bind_references(condition, child.output)
+        self._filter = DeviceFilter(self._bound)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return TpuFilterExec(self.condition, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        filt = self._filter
+        total_time = self.metrics[M.TOTAL_TIME]
+
+        def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            row_start = 0
+            for batch in child_pb.iterator(pidx):
+                with M.trace_range("TpuFilter", total_time):
+                    out = filt.apply(batch, partition_id=pidx, row_start=row_start)
+                row_start += batch.num_rows
+                yield out
+
+        return PartitionedBatches(child_pb.num_partitions,
+                                  lambda p: count_output(self.metrics, factory(p)))
+
+
+class CpuFilterExec(CpuExec):
+    def __init__(self, condition: Expression, child: PhysicalExec):
+        super().__init__(child)
+        self.condition = condition
+        self._bound = bind_references(condition, child.output)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return CpuFilterExec(self.condition, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        bound = self._bound
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            row_start = 0
+            for batch in child_pb.iterator(pidx):
+                yield cpu_filter(bound, batch, partition_id=pidx,
+                                 row_start=row_start)
+                row_start += batch.num_rows
+
+        return PartitionedBatches(child_pb.num_partitions,
+                                  lambda p: count_output(self.metrics, factory(p)))
+
+
+# ---------------------------------------------------------------------------
+# Union
+# ---------------------------------------------------------------------------
+class _UnionBase(PhysicalExec):
+    """Union-all: concatenates the children's partition lists
+    (reference: GpuUnionExec, basicPhysicalOperators.scala:178-200)."""
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return type(self)(*new_children)
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pbs = [c.execute(ctx) for c in self.children]
+        spans = []
+        offset = 0
+        for pb in child_pbs:
+            spans.append((offset, pb))
+            offset += pb.num_partitions
+
+        def factory(pidx: int) -> Iterator:
+            for off, pb in spans:
+                if off <= pidx < off + pb.num_partitions:
+                    return count_output(self.metrics, pb.iterator(pidx - off))
+            raise IndexError(pidx)
+
+        return PartitionedBatches(offset, factory)
+
+
+class TpuUnionExec(_UnionBase, TpuExec):
+    placement = "tpu"
+
+
+class CpuUnionExec(_UnionBase, CpuExec):
+    placement = "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Limits (reference: limit.scala:39-123)
+# ---------------------------------------------------------------------------
+def _limited(it: Iterator, limit: int, slicer) -> Iterator:
+    remaining = limit
+    for b in it:
+        if remaining <= 0:
+            break
+        if b.num_rows <= remaining:
+            remaining -= b.num_rows
+            yield b
+        else:
+            yield slicer(b, remaining)
+            remaining = 0
+
+
+def _slice_host(b: HostColumnarBatch, n: int) -> HostColumnarBatch:
+    return b.slice(0, n)
+
+
+def _slice_device(b: ColumnarBatch, n: int) -> ColumnarBatch:
+    return slice_batch_host(b, 0, n)
+
+
+class TpuLocalLimitExec(TpuExec):
+    def __init__(self, limit: int, child: PhysicalExec):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return TpuLocalLimitExec(self.limit, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        limit = self.limit
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics,
+                                   _limited(child_pb.iterator(p), limit,
+                                            _slice_device)))
+
+
+class CpuLocalLimitExec(CpuExec):
+    def __init__(self, limit: int, child: PhysicalExec):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return CpuLocalLimitExec(self.limit, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        limit = self.limit
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics,
+                                   _limited(child_pb.iterator(p), limit,
+                                            _slice_host)))
+
+
+class _GlobalLimitBase(PhysicalExec):
+    """Global limit: requires a single input partition (the planner inserts a
+    shuffle-to-1 below, reference GpuCollectLimitMeta, limit.scala:124)."""
+
+    def __init__(self, limit: int, child: PhysicalExec):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return type(self)(self.limit, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        assert child_pb.num_partitions == 1, \
+            "global limit requires a single partition"
+        limit = self.limit
+        slicer = _slice_device if self.placement == "tpu" else _slice_host
+        return PartitionedBatches(
+            1,
+            lambda p: count_output(self.metrics,
+                                   _limited(child_pb.iterator(p), limit, slicer)))
+
+
+class TpuGlobalLimitExec(_GlobalLimitBase, TpuExec):
+    placement = "tpu"
+
+
+class CpuGlobalLimitExec(_GlobalLimitBase, CpuExec):
+    placement = "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Partition coalescing (reference: GpuCoalesceExec,
+# basicPhysicalOperators.scala:201-240)
+# ---------------------------------------------------------------------------
+class CoalescePartitionsExec(PhysicalExec):
+    """Merge input partitions into `num_partitions` by chaining iterators.
+    Placement-agnostic: passes batches through untouched."""
+
+    def __init__(self, num_partitions: int, child: PhysicalExec):
+        super().__init__(child)
+        self.num_partitions = max(1, num_partitions)
+        self.placement = child.placement
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return CoalescePartitionsExec(self.num_partitions, new_children[0])
+
+    def output_partitioning(self):
+        return None
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        n_in = child_pb.num_partitions
+        n_out = min(self.num_partitions, max(1, n_in))
+
+        def factory(pidx: int) -> Iterator:
+            mine = range(pidx, n_in, n_out)
+            return count_output(
+                self.metrics,
+                itertools.chain.from_iterable(
+                    child_pb.iterator(i) for i in mine))
+
+        return PartitionedBatches(n_out, factory)

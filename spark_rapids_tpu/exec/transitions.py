@@ -1,0 +1,269 @@
+"""Host/device boundary + batch-coalescing operators.
+
+Reference parity:
+- HostToDeviceExec <- GpuRowToColumnarExec / HostColumnarToGpu
+  (GpuRowToColumnarExec.scala:400-502, HostColumnarToGpu.scala:30-260):
+  uploads host batches, acquiring the admission semaphore before device work.
+- DeviceToHostExec <- GpuColumnarToRowExec / GpuBringBackToHost
+  (GpuColumnarToRowExec.scala:35-230): downloads to host and releases the
+  semaphore at batch end.
+- CoalesceGoal algebra (TargetSize / RequireSingleBatch, max-combine,
+  GpuCoalesceBatches.scala:90-112) and the accumulate-until-target iterator
+  with an on-deck batch (AbstractGpuCoalesceIterator,
+  GpuCoalesceBatches.scala:147-362) -> TpuCoalesceBatchesExec.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    HostColumnarBatch,
+    HostColumnVector,
+    concat_batches,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import (
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.utils import metrics as M
+
+_task_counter = iter(range(1, 1 << 62))
+_task_counter_lock = threading.Lock()
+_task_local = threading.local()
+
+
+def current_task_id() -> int:
+    """Task-attempt id of the running partition task (TaskContext analog).
+    The scheduler sets it; standalone callers get a thread-local fresh id."""
+    tid = getattr(_task_local, "task_id", None)
+    if tid is None:
+        with _task_counter_lock:
+            tid = next(_task_counter)
+        _task_local.task_id = tid
+    return tid
+
+
+def set_task_id(task_id: Optional[int]) -> None:
+    _task_local.task_id = task_id
+
+
+# ---------------------------------------------------------------------------
+# Coalesce goals (reference: CoalesceGoal, GpuCoalesceBatches.scala:90-112)
+# ---------------------------------------------------------------------------
+class CoalesceGoal:
+    def max_combine(self, other: "CoalesceGoal") -> "CoalesceGoal":
+        a = self.target_bytes()
+        b = other.target_bytes()
+        if a is None or b is None:  # RequireSingleBatch dominates
+            return RequireSingleBatch()
+        return TargetSize(max(a, b))
+
+    def target_bytes(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def satisfied_by(self, other: "CoalesceGoal") -> bool:
+        a, b = self.target_bytes(), other.target_bytes()
+        if a is None:
+            return b is None
+        return b is None or b >= a
+
+
+class TargetSize(CoalesceGoal):
+    def __init__(self, bytes_: int):
+        self.bytes = bytes_
+
+    def target_bytes(self):
+        return self.bytes
+
+    def __repr__(self):
+        return f"TargetSize({self.bytes})"
+
+    def __eq__(self, other):
+        return isinstance(other, TargetSize) and other.bytes == self.bytes
+
+
+class RequireSingleBatch(CoalesceGoal):
+    def target_bytes(self):
+        return None
+
+    def __repr__(self):
+        return "RequireSingleBatch"
+
+    def __eq__(self, other):
+        return isinstance(other, RequireSingleBatch)
+
+
+# ---------------------------------------------------------------------------
+# Transitions
+# ---------------------------------------------------------------------------
+class HostToDeviceExec(TpuExec):
+    """Upload host batches to the device (reference: GpuRowToColumnarExec /
+    HostColumnarToGpu; semaphore acquired before upload,
+    GpuRowToColumnarExec.scala:432)."""
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__(child)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return HostToDeviceExec(new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        total_time = self.metrics[M.TOTAL_TIME]
+        peak_mem = self.metrics[M.PEAK_DEVICE_MEMORY]
+
+        def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            sem = TpuSemaphore.get()
+            for hb in child_pb.iterator(pidx):
+                sem.acquire_if_necessary(current_task_id())
+                with M.trace_range("HostToDevice", total_time):
+                    db = hb.to_device()
+                peak_mem.set_max(db.device_memory_size())
+                yield db
+
+        return PartitionedBatches(child_pb.num_partitions,
+                                  lambda p: count_output(self.metrics, factory(p)))
+
+
+class DeviceToHostExec(PhysicalExec):
+    """Download device batches to host and release the semaphore (reference:
+    GpuColumnarToRowExec releases at batch end, GpuColumnarToRowExec.scala:109;
+    GpuBringBackToHost.scala:52)."""
+
+    placement = "cpu"  # output is host data
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__(child)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return DeviceToHostExec(new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        total_time = self.metrics[M.TOTAL_TIME]
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            sem = TpuSemaphore.get()
+            try:
+                for db in child_pb.iterator(pidx):
+                    with M.trace_range("DeviceToHost", total_time):
+                        hb = db.to_host()
+                    yield hb
+            finally:
+                sem.release_if_necessary(current_task_id())
+
+        return PartitionedBatches(child_pb.num_partitions,
+                                  lambda p: count_output(self.metrics, factory(p)))
+
+
+# ---------------------------------------------------------------------------
+# Batch coalescing
+# ---------------------------------------------------------------------------
+def _coalesce_iter(it: Iterator, goal: CoalesceGoal, concat, size_of,
+                   metrics: M.MetricsMap) -> Iterator:
+    """Accumulate-until-target with an on-deck batch (reference:
+    AbstractGpuCoalesceIterator, GpuCoalesceBatches.scala:147-362)."""
+    target = goal.target_bytes()
+    pending: List = []
+    pending_bytes = 0
+    concat_time = metrics["concatTime"]
+    for b in it:
+        if target is not None and pending and \
+                pending_bytes + size_of(b) > target:
+            with M.trace_range("coalesce-concat", concat_time):
+                yield concat(pending)
+            pending, pending_bytes = [], 0
+        pending.append(b)
+        pending_bytes += size_of(b)
+    if pending:
+        with M.trace_range("coalesce-concat", concat_time):
+            yield concat(pending)
+
+
+def _concat_host(batches: List[HostColumnarBatch]) -> HostColumnarBatch:
+    if len(batches) == 1:
+        return batches[0]
+    ncols = batches[0].num_columns
+    cols = []
+    for ci in range(ncols):
+        dt = batches[0].columns[ci].dtype
+        datas = [b.columns[ci].data[:b.num_rows] for b in batches]
+        valids = [b.columns[ci].validity[:b.num_rows] for b in batches]
+        cols.append(HostColumnVector(dt, np.concatenate(datas),
+                                     np.concatenate(valids)))
+    return HostColumnarBatch(cols, sum(b.num_rows for b in batches))
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Reference: GpuCoalesceBatches exec, GpuCoalesceBatches.scala:417-440."""
+
+    def __init__(self, goal: CoalesceGoal, child: PhysicalExec):
+        super().__init__(child)
+        self.goal = goal
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return TpuCoalesceBatchesExec(self.goal, new_children[0])
+
+    def node_name(self):
+        return f"TpuCoalesceBatches({self.goal!r})"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        goal = self.goal
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(
+                self.metrics,
+                _coalesce_iter(child_pb.iterator(p), goal,
+                               concat_batches,
+                               lambda b: b.device_memory_size(),
+                               self.metrics)))
+
+
+class CpuCoalesceBatchesExec(PhysicalExec):
+    placement = "cpu"
+
+    def __init__(self, goal: CoalesceGoal, child: PhysicalExec):
+        super().__init__(child)
+        self.goal = goal
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return CpuCoalesceBatchesExec(self.goal, new_children[0])
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        goal = self.goal
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(
+                self.metrics,
+                _coalesce_iter(child_pb.iterator(p), goal,
+                               _concat_host,
+                               lambda b: b.estimated_size_bytes(),
+                               self.metrics)))

@@ -1,0 +1,416 @@
+"""DataFrame API over the logical plan (the pyspark.sql.DataFrame analog).
+
+The reference accelerates plans produced by Spark's DataFrame/SQL API; this
+standalone framework supplies the equivalent user surface. Name resolution
+(`col("x")` -> AttributeReference) happens here, eagerly, against the child
+plan's output — the analog of Catalyst's analyzer for this flat algebra.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.aggregates import AggregateFunction
+from spark_rapids_tpu.ops.base import (
+    Alias,
+    AttributeReference,
+    Expression,
+    SortOrder,
+    to_attribute,
+)
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.column import Column, _to_expr, to_sort_order
+from spark_rapids_tpu.plan.functions import _UnresolvedAttribute
+
+ColumnOrName = Union[Column, str]
+
+
+class AnalysisError(Exception):
+    pass
+
+
+def resolve(expr: Expression, attrs: Sequence[AttributeReference]) -> Expression:
+    """Rewrite _UnresolvedAttribute leaves into schema attributes."""
+    by_name: Dict[str, AttributeReference] = {}
+    dupes = set()
+    for a in attrs:
+        if a.name in by_name:
+            dupes.add(a.name)
+        by_name.setdefault(a.name, a)
+
+    def rewrite(node: Expression) -> Expression:
+        if isinstance(node, _UnresolvedAttribute):
+            if node.name in dupes:
+                raise AnalysisError(
+                    f"ambiguous column {node.name!r}; rename before combining")
+            got = by_name.get(node.name)
+            if got is None:
+                raise AnalysisError(
+                    f"column {node.name!r} not found in "
+                    f"[{', '.join(a.name for a in attrs)}]")
+            return got
+        return node
+
+    return expr.transform_up(rewrite)
+
+
+def _auto_alias(e: Expression, fallback: str) -> Expression:
+    if isinstance(e, (Alias, AttributeReference)):
+        return e
+    return Alias(e, fallback)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session):
+        self._plan = plan
+        self.session = session
+
+    # -- schema ---------------------------------------------------------------
+    @property
+    def schema(self) -> List[AttributeReference]:
+        return self._plan.output
+
+    @property
+    def columns(self) -> List[str]:
+        return [a.name for a in self._plan.output]
+
+    def __getitem__(self, name: str) -> Column:
+        return Column(self._resolve_name(name))
+
+    def _resolve_name(self, name: str) -> AttributeReference:
+        for a in self._plan.output:
+            if a.name == name:
+                return a
+        raise AnalysisError(
+            f"column {name!r} not found in [{', '.join(self.columns)}]")
+
+    def _resolve(self, c: ColumnOrName) -> Expression:
+        if isinstance(c, str):
+            if c == "*":
+                raise AnalysisError("'*' only valid inside select()")
+            return self._resolve_name(c)
+        return resolve(_to_expr(c), self._plan.output)
+
+    def _with_plan(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(plan, self.session)
+
+    # -- relational ops -------------------------------------------------------
+    def select(self, *cols: ColumnOrName) -> "DataFrame":
+        out: List[Expression] = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                out.extend(self._plan.output)
+                continue
+            e = self._resolve(c)
+            out.append(_auto_alias(e, self._default_name(c, len(out))))
+        return self._with_plan(L.Project(out, self._plan))
+
+    @staticmethod
+    def _default_name(c: ColumnOrName, idx: int) -> str:
+        if isinstance(c, str):
+            return c
+        return f"col{idx}"
+
+    def withColumn(self, name: str, c: Column) -> "DataFrame":
+        e = Alias(self._resolve(c), name)
+        out: List[Expression] = []
+        replaced = False
+        for a in self._plan.output:
+            if a.name == name:
+                out.append(e)
+                replaced = True
+            else:
+                out.append(a)
+        if not replaced:
+            out.append(e)
+        return self._with_plan(L.Project(out, self._plan))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        out = [Alias(a, new) if a.name == old else a for a in self._plan.output]
+        return self._with_plan(L.Project(out, self._plan))
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [a for a in self._plan.output if a.name not in names]
+        return self._with_plan(L.Project(keep, self._plan))
+
+    def filter(self, condition: Union[Column, str]) -> "DataFrame":
+        if isinstance(condition, str):
+            raise AnalysisError("string predicates require the SQL frontend; "
+                                "pass a Column")
+        return self._with_plan(
+            L.Filter(self._resolve(condition), self._plan))
+
+    where = filter
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._with_plan(L.Limit(n, self._plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if len(other.schema) != len(self.schema):
+            raise AnalysisError("union requires same number of columns")
+        return self._with_plan(L.Union(self._plan, other._plan))
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        attrs = self._plan.output
+        return self._with_plan(L.Aggregate(list(attrs), list(attrs), self._plan))
+
+    def dropDuplicates(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        if not subset:
+            return self.distinct()
+        keys = [self._resolve_name(n) for n in subset]
+        from spark_rapids_tpu.ops.aggregates import First
+
+        aggs: List[Expression] = []
+        for a in self._plan.output:
+            if a.name in subset:
+                aggs.append(a)
+            else:
+                aggs.append(Alias(First(a), a.name))
+        return self._with_plan(L.Aggregate(keys, aggs, self._plan))
+
+    def repartition(self, num_partitions: int, *cols: ColumnOrName) -> "DataFrame":
+        exprs = [self._resolve(c) for c in cols]
+        return self._with_plan(
+            L.Repartition(num_partitions, exprs, False, self._plan))
+
+    def coalesce(self, num_partitions: int) -> "DataFrame":
+        return self._with_plan(
+            L.Repartition(num_partitions, [], True, self._plan))
+
+    def orderBy(self, *cols, **kwargs) -> "DataFrame":
+        orders = []
+        ascending = kwargs.get("ascending", True)
+        for c in cols:
+            if isinstance(c, SortOrder):
+                orders.append(SortOrder(resolve(c.child, self._plan.output),
+                                        c.ascending, c.nulls_first))
+            elif isinstance(c, str):
+                orders.append(SortOrder(self._resolve_name(c), ascending))
+            else:
+                orders.append(SortOrder(self._resolve(c), ascending))
+        return self._with_plan(L.Sort(orders, True, self._plan))
+
+    sort = orderBy
+
+    def sortWithinPartitions(self, *cols, **kwargs) -> "DataFrame":
+        df = self.orderBy(*cols, **kwargs)
+        plan = df._plan
+        assert isinstance(plan, L.Sort)
+        return self._with_plan(L.Sort(plan.orders, False, self._plan))
+
+    # -- aggregation ----------------------------------------------------------
+    def groupBy(self, *cols: ColumnOrName) -> "GroupedData":
+        keys = [self._resolve(c) for c in cols]
+        named = [_auto_alias(k, self._default_name(c, i))
+                 for i, (k, c) in enumerate(zip(keys, cols))]
+        return GroupedData(self, named)
+
+    groupby = groupBy
+
+    def agg(self, *cols: Column) -> "DataFrame":
+        return GroupedData(self, []).agg(*cols)
+
+    def count(self) -> int:
+        from spark_rapids_tpu.plan.functions import count as f_count
+
+        rows = self.agg(f_count("*").alias("count")).collect()
+        return rows[0][0]
+
+    # -- joins ----------------------------------------------------------------
+    def join(self, other: "DataFrame",
+             on: Union[str, List[str], Column, None] = None,
+             how: str = "inner") -> "DataFrame":
+        jt = L.JoinType.parse(how)
+        left_keys: List[Expression] = []
+        right_keys: List[Expression] = []
+        condition: Optional[Expression] = None
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, list):
+            for name in on:
+                left_keys.append(self._resolve_name(name))
+                right_keys.append(other._resolve_name(name))
+        elif isinstance(on, Column):
+            condition = self._resolve_join_condition(on, other)
+            left_keys, right_keys, condition = _extract_equi_keys(
+                condition, self._plan.output, other._plan.output)
+        elif on is not None:
+            raise AnalysisError(f"unsupported join on: {on!r}")
+        elif jt is not L.JoinType.CROSS:
+            raise AnalysisError("join requires 'on' unless how='cross'")
+        plan = L.Join(self._plan, other._plan, jt, left_keys, right_keys,
+                      condition)
+        df = self._with_plan(plan)
+        if isinstance(on, list) and jt in (
+                L.JoinType.INNER, L.JoinType.LEFT_OUTER,
+                L.JoinType.RIGHT_OUTER, L.JoinType.FULL_OUTER):
+            # USING-join semantics: emit the join columns once
+            drop_ids = {a.expr_id for a in right_keys
+                        if isinstance(a, AttributeReference)}
+            keep = [a for a in plan.output if a.expr_id not in drop_ids]
+            df = df._with_plan(L.Project(keep, plan))
+        return df
+
+    def _resolve_join_condition(self, c: Column, other: "DataFrame") -> Expression:
+        both = list(self._plan.output) + list(other._plan.output)
+        return resolve(c.expr, both)
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return self.join(other, on=None, how="cross")
+
+    # -- actions --------------------------------------------------------------
+    def collect(self) -> List[tuple]:
+        return self.session.execute_collect(self._plan)
+
+    def toLocalBatches(self):
+        return self.session.execute_batches(self._plan)
+
+    def show(self, n: int = 20) -> None:
+        rows = self.limit(n).collect()
+        names = self.columns
+        print(" | ".join(names))
+        for r in rows:
+            print(" | ".join(str(v) for v in r))
+
+    def explain(self, mode: str = "ALL") -> str:
+        text = self.session.explain_plan(self._plan, mode)
+        print(text)
+        return text
+
+    def toPandas(self):
+        import pandas as pd
+
+        rows = self.collect()
+        return pd.DataFrame(rows, columns=self.columns)
+
+    # -- write ----------------------------------------------------------------
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+    @property
+    def rdd_columnar(self):
+        """Device-resident columnar export (reference: ColumnarRdd.scala —
+        DataFrame -> RDD[Table] handoff for ML)."""
+        from spark_rapids_tpu.integration.columnar_rdd import columnar_rdd
+
+        return columnar_rdd(self)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping: List[Expression]):
+        self._df = df
+        self._grouping = grouping
+
+    def agg(self, *cols: Column) -> DataFrame:
+        out: List[Expression] = list(self._grouping)
+        for i, c in enumerate(cols):
+            e = resolve(_to_expr(c), self._df._plan.output)
+            out.append(_auto_alias(e, f"agg{i}"))
+        plan = L.Aggregate([to_attribute(g) if isinstance(g, Alias) else g
+                            for g in self._grouping], out, self._df._plan)
+        return self._df._with_plan(plan)
+
+    def _simple(self, fn, *cols: str) -> DataFrame:
+        from spark_rapids_tpu.plan import functions as F
+
+        names = cols or [a.name for a in self._df.schema
+                         if a.data_type.is_numeric]
+        return self.agg(*[getattr(F, fn)(n).alias(f"{fn}({n})") for n in names])
+
+    def sum(self, *cols: str) -> DataFrame:  # noqa: A003
+        return self._simple("sum", *cols)
+
+    def min(self, *cols: str) -> DataFrame:  # noqa: A003
+        return self._simple("min", *cols)
+
+    def max(self, *cols: str) -> DataFrame:  # noqa: A003
+        return self._simple("max", *cols)
+
+    def avg(self, *cols: str) -> DataFrame:
+        return self._simple("avg", *cols)
+
+    mean = avg
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.plan.functions import count as f_count
+
+        return self.agg(f_count("*").alias("count"))
+
+
+class DataFrameWriter:
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._mode = "error"
+        self._options: Dict[str, Any] = {}
+        self._partition_by: List[str] = []
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, k: str, v: Any) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    def parquet(self, path: str) -> None:
+        self._write("parquet", path)
+
+    def orc(self, path: str) -> None:
+        self._write("orc", path)
+
+    def csv(self, path: str) -> None:
+        self._write("csv", path)
+
+    def _write(self, fmt: str, path: str) -> None:
+        plan = L.WriteFile(fmt, path, self._mode, self._options,
+                           self._partition_by, self._df._plan)
+        self._df.session.execute_write(plan)
+
+
+def _extract_equi_keys(condition: Expression, left_attrs, right_attrs):
+    """Split a join condition into equi-key pairs + residual condition
+    (the planner's extractEquiJoinKeys analog)."""
+    from spark_rapids_tpu.ops.predicates import And, EqualTo
+
+    left_ids = {a.expr_id for a in left_attrs}
+    right_ids = {a.expr_id for a in right_attrs}
+
+    def refs(e: Expression):
+        return {n.expr_id for n in e.collect(
+            lambda x: isinstance(x, AttributeReference))}
+
+    conjuncts: List[Expression] = []
+
+    def split(e: Expression):
+        if isinstance(e, And):
+            split(e.left)
+            split(e.right)
+        else:
+            conjuncts.append(e)
+
+    split(condition)
+    lk, rk, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, EqualTo):
+            lrefs, rrefs = refs(c.left), refs(c.right)
+            if lrefs <= left_ids and rrefs <= right_ids:
+                lk.append(c.left)
+                rk.append(c.right)
+                continue
+            if lrefs <= right_ids and rrefs <= left_ids:
+                lk.append(c.right)
+                rk.append(c.left)
+                continue
+        residual.append(c)
+    cond: Optional[Expression] = None
+    for r in residual:
+        cond = r if cond is None else And(cond, r)
+    return lk, rk, cond

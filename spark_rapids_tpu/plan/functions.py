@@ -1,0 +1,324 @@
+"""User-facing function library (the pyspark.sql.functions analog).
+
+Covers the expression surface the reference accelerates
+(GpuOverrides.scala:461-1487 registry; per-category files under
+org/apache/spark/sql/rapids/).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops import aggregates as A
+from spark_rapids_tpu.ops import arithmetic as AR
+from spark_rapids_tpu.ops import bitwise as B
+from spark_rapids_tpu.ops import datetimeops as DT
+from spark_rapids_tpu.ops import mathx as MX
+from spark_rapids_tpu.ops import misc as MISC
+from spark_rapids_tpu.ops import nulls as N
+from spark_rapids_tpu.ops import stringops as S
+from spark_rapids_tpu.ops.base import Alias, AttributeReference, Expression
+from spark_rapids_tpu.ops.cast import Cast
+from spark_rapids_tpu.ops.conditional import CaseWhen, If
+from spark_rapids_tpu.ops.literals import Literal
+from spark_rapids_tpu.plan.column import Column, _to_expr
+
+ColumnOrName = Union[Column, str]
+
+
+def col(name: str) -> Column:
+    """An unresolved named column; resolved against the DataFrame schema at
+    plan-build time (plan/dataframe.py)."""
+    return Column(_UnresolvedAttribute(name))
+
+
+class _UnresolvedAttribute(Expression):
+    """Placeholder resolved by DataFrame methods; never evaluated."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def children(self):
+        return ()
+
+    def with_children(self, new_children):
+        return self
+
+    @property
+    def data_type(self):
+        raise RuntimeError(f"unresolved column {self.name!r}")
+
+    def eval(self, ctx):
+        raise RuntimeError(f"unresolved column {self.name!r}")
+
+    def _fingerprint_extra(self):
+        return f"{self.name};"
+
+    def __repr__(self):
+        return f"'{self.name}"
+
+
+def lit(v: Any) -> Column:
+    return Column(Literal(v))
+
+
+def _c(e: ColumnOrName) -> Expression:
+    if isinstance(e, str):
+        return _UnresolvedAttribute(e)
+    return _to_expr(e)
+
+
+# -- conditional -------------------------------------------------------------
+def when(cond: Column, value) -> "CaseBuilder":
+    return CaseBuilder([(cond.expr, _to_expr(value))])
+
+
+class CaseBuilder:
+    def __init__(self, branches):
+        self._branches = branches
+
+    def when(self, cond: Column, value) -> "CaseBuilder":
+        return CaseBuilder(self._branches + [(cond.expr, _to_expr(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(CaseWhen(self._branches, _to_expr(value)))
+
+    @property
+    def expr(self):
+        return CaseWhen(self._branches, None)
+
+
+def expr_if(cond: Column, a, b) -> Column:
+    return Column(If(cond.expr, _to_expr(a), _to_expr(b)))
+
+
+# -- null handling -----------------------------------------------------------
+def coalesce(*cols: ColumnOrName) -> Column:
+    return Column(N.Coalesce(*[_c(c) for c in cols]))
+
+
+def isnull(c: ColumnOrName) -> Column:
+    return Column(N.IsNull(_c(c)))
+
+
+def isnan(c: ColumnOrName) -> Column:
+    return Column(N.IsNan(_c(c)))
+
+
+def nanvl(a: ColumnOrName, b: ColumnOrName) -> Column:
+    return Column(N.NaNvl(_c(a), _c(b)))
+
+
+# -- math --------------------------------------------------------------------
+def _unary(klass):
+    def fn(c: ColumnOrName) -> Column:
+        return Column(klass(_c(c)))
+    fn.__name__ = klass.__name__.lower()
+    return fn
+
+
+sqrt = _unary(MX.Sqrt)
+exp = _unary(MX.Exp)
+expm1 = _unary(MX.Expm1)
+log = _unary(MX.Log)
+log1p = _unary(MX.Log1p)
+log2 = _unary(MX.Log2)
+log10 = _unary(MX.Log10)
+cbrt = _unary(MX.Cbrt)
+sin = _unary(MX.Sin)
+cos = _unary(MX.Cos)
+tan = _unary(MX.Tan)
+asin = _unary(MX.Asin)
+acos = _unary(MX.Acos)
+atan = _unary(MX.Atan)
+sinh = _unary(MX.Sinh)
+cosh = _unary(MX.Cosh)
+tanh = _unary(MX.Tanh)
+rint = _unary(MX.Rint)
+floor = _unary(MX.Floor)
+ceil = _unary(MX.Ceil)
+degrees = _unary(MX.ToDegrees)
+radians = _unary(MX.ToRadians)
+abs_ = _unary(AR.Abs)
+signum = _unary(AR.Signum)
+
+
+def pow(a: ColumnOrName, b) -> Column:  # noqa: A001
+    return Column(MX.Pow(_c(a), _to_expr(b)))
+
+
+def atan2(a: ColumnOrName, b) -> Column:
+    return Column(MX.Atan2(_c(a), _to_expr(b)))
+
+
+def pmod(a: ColumnOrName, b) -> Column:
+    return Column(AR.Pmod(_c(a), _to_expr(b)))
+
+
+# -- bitwise -----------------------------------------------------------------
+def shiftleft(c: ColumnOrName, n: int) -> Column:
+    return Column(B.ShiftLeft(_c(c), Literal(n)))
+
+
+def shiftright(c: ColumnOrName, n: int) -> Column:
+    return Column(B.ShiftRight(_c(c), Literal(n)))
+
+
+def shiftrightunsigned(c: ColumnOrName, n: int) -> Column:
+    return Column(B.ShiftRightUnsigned(_c(c), Literal(n)))
+
+
+def bitwise_not(c: ColumnOrName) -> Column:
+    return Column(B.BitwiseNot(_c(c)))
+
+
+# -- strings -----------------------------------------------------------------
+def length(c: ColumnOrName) -> Column:
+    return Column(S.Length(_c(c)))
+
+
+def upper(c: ColumnOrName) -> Column:
+    return Column(S.Upper(_c(c)))
+
+
+def lower(c: ColumnOrName) -> Column:
+    return Column(S.Lower(_c(c)))
+
+
+def substring(c: ColumnOrName, pos: int, length_: int) -> Column:
+    return Column(S.Substring(_c(c), Literal(pos), Literal(length_)))
+
+
+def concat(*cols: ColumnOrName) -> Column:
+    return Column(S.Concat(*[_c(c) for c in cols]))
+
+
+def trim(c: ColumnOrName) -> Column:
+    return Column(S.StringTrim(_c(c)))
+
+
+def ltrim(c: ColumnOrName) -> Column:
+    return Column(S.StringTrimLeft(_c(c)))
+
+
+def rtrim(c: ColumnOrName) -> Column:
+    return Column(S.StringTrimRight(_c(c)))
+
+
+def replace(c: ColumnOrName, search: str, repl: str) -> Column:
+    return Column(S.StringReplace(_c(c), Literal(search), Literal(repl)))
+
+
+# -- datetime ----------------------------------------------------------------
+year = _unary(DT.Year)
+month = _unary(DT.Month)
+dayofmonth = _unary(DT.DayOfMonth)
+dayofweek = _unary(DT.DayOfWeek)
+quarter = _unary(DT.Quarter)
+hour = _unary(DT.Hour)
+minute = _unary(DT.Minute)
+second = _unary(DT.Second)
+last_day = _unary(DT.LastDay)
+
+
+def datediff(end: ColumnOrName, start: ColumnOrName) -> Column:
+    return Column(DT.DateDiff(_c(end), _c(start)))
+
+
+def date_add(c: ColumnOrName, days) -> Column:
+    return Column(DT.DateAdd(_c(c), _to_expr(days)))
+
+
+def date_sub(c: ColumnOrName, days) -> Column:
+    return Column(DT.DateSub(_c(c), _to_expr(days)))
+
+
+def unix_timestamp(c: ColumnOrName) -> Column:
+    return Column(DT.UnixTimestamp(_c(c)))
+
+
+def from_unixtime(c: ColumnOrName, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    return Column(DT.FromUnixTime(_c(c), Literal(fmt)))
+
+
+# -- nondeterministic --------------------------------------------------------
+def rand(seed: int = 0) -> Column:
+    return Column(MISC.Rand(seed))
+
+
+def monotonically_increasing_id() -> Column:
+    return Column(MISC.MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Column:
+    return Column(MISC.SparkPartitionID())
+
+
+def input_file_name() -> Column:
+    return Column(MISC.InputFileName())
+
+
+# -- aggregates --------------------------------------------------------------
+def sum(c: ColumnOrName) -> Column:  # noqa: A001
+    return Column(A.Sum(_c(c)))
+
+
+def min(c: ColumnOrName) -> Column:  # noqa: A001
+    return Column(A.Min(_c(c)))
+
+
+def max(c: ColumnOrName) -> Column:  # noqa: A001
+    return Column(A.Max(_c(c)))
+
+
+def count(c: ColumnOrName = "*") -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(A.Count(Literal(1)))
+    return Column(A.Count(_c(c)))
+
+
+def avg(c: ColumnOrName) -> Column:
+    return Column(A.Average(_c(c)))
+
+
+mean = avg
+
+
+def first(c: ColumnOrName, ignorenulls: bool = False) -> Column:
+    return Column(A.First(_c(c), ignorenulls))
+
+
+def last(c: ColumnOrName, ignorenulls: bool = False) -> Column:
+    return Column(A.Last(_c(c), ignorenulls))
+
+
+# -- window ------------------------------------------------------------------
+def row_number() -> Column:
+    from spark_rapids_tpu.ops.window import RowNumber
+
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from spark_rapids_tpu.ops.window import Rank
+
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from spark_rapids_tpu.ops.window import DenseRank
+
+    return Column(DenseRank())
+
+
+def lead(c: ColumnOrName, offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.ops.window import Lead
+
+    return Column(Lead(_c(c), offset, default))
+
+
+def lag(c: ColumnOrName, offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.ops.window import Lag
+
+    return Column(Lag(_c(c), offset, default))

@@ -1,0 +1,319 @@
+"""Logical plan nodes.
+
+The reference rides Spark Catalyst for the logical layer and only rewrites
+physical plans; a standalone framework needs its own (small) logical algebra.
+The node set mirrors the operators the reference accelerates
+(SURVEY.md section 2.6): scan/filter/project/agg/join/sort/window/expand/
+generate/limit/union/repartition/write.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import (
+    Alias,
+    AttributeReference,
+    Expression,
+    SortOrder,
+    to_attribute,
+)
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT_OUTER = "left_outer"
+    RIGHT_OUTER = "right_outer"
+    FULL_OUTER = "full_outer"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    CROSS = "cross"
+
+    @staticmethod
+    def parse(s: str) -> "JoinType":
+        aliases = {
+            "inner": JoinType.INNER,
+            "left": JoinType.LEFT_OUTER, "leftouter": JoinType.LEFT_OUTER,
+            "left_outer": JoinType.LEFT_OUTER,
+            "right": JoinType.RIGHT_OUTER, "rightouter": JoinType.RIGHT_OUTER,
+            "right_outer": JoinType.RIGHT_OUTER,
+            "outer": JoinType.FULL_OUTER, "full": JoinType.FULL_OUTER,
+            "fullouter": JoinType.FULL_OUTER, "full_outer": JoinType.FULL_OUTER,
+            "semi": JoinType.LEFT_SEMI, "leftsemi": JoinType.LEFT_SEMI,
+            "left_semi": JoinType.LEFT_SEMI,
+            "anti": JoinType.LEFT_ANTI, "leftanti": JoinType.LEFT_ANTI,
+            "left_anti": JoinType.LEFT_ANTI,
+            "cross": JoinType.CROSS,
+        }
+        k = s.strip().lower().replace(" ", "")
+        if k not in aliases:
+            raise ValueError(f"unknown join type {s!r}")
+        return aliases[k]
+
+
+class LogicalPlan:
+    def __init__(self, *children: "LogicalPlan"):
+        self.children: Tuple[LogicalPlan, ...] = children
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        raise NotImplementedError(type(self).__name__)
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class LocalRelation(LogicalPlan):
+    """In-memory host data (host batches pre-split into partitions)."""
+
+    def __init__(self, schema: List[AttributeReference], partitions):
+        super().__init__()
+        self.schema = schema
+        self.partitions = partitions
+
+    @property
+    def output(self):
+        return self.schema
+
+    def describe(self):
+        return f"LocalRelation[{', '.join(a.name for a in self.schema)}]"
+
+
+class RangeRelation(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int, num_partitions: int):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self._attr = AttributeReference("id", DataType.INT64, False)
+
+    @property
+    def output(self):
+        return [self._attr]
+
+
+class FileScan(LogicalPlan):
+    """v2-style file scan (reference: GpuBatchScanExec / Gpu*Scan)."""
+
+    def __init__(self, fmt: str, paths: List[str],
+                 schema: Optional[List[AttributeReference]],
+                 options: Optional[Dict[str, Any]] = None):
+        super().__init__()
+        self.fmt = fmt
+        self.paths = paths
+        self.schema = schema  # resolved lazily by the session if None
+        self.options = dict(options or {})
+
+    @property
+    def output(self):
+        assert self.schema is not None, "unresolved file scan"
+        return self.schema
+
+    def describe(self):
+        return f"FileScan {self.fmt} {self.paths}"
+
+
+class Project(LogicalPlan):
+    def __init__(self, project_list: Sequence[Expression], child: LogicalPlan):
+        super().__init__(child)
+        self.project_list = list(project_list)
+
+    @property
+    def output(self):
+        return [to_attribute(e) for e in self.project_list]
+
+    def describe(self):
+        return f"Project [{', '.join(map(repr, self.project_list))}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        super().__init__(child)
+        self.condition = condition
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"Filter ({self.condition!r})"
+
+
+class Aggregate(LogicalPlan):
+    """Group-by aggregate. agg_exprs are Alias(AggregateFunction | expr over
+    grouping columns)."""
+
+    def __init__(self, grouping: Sequence[Expression],
+                 agg_exprs: Sequence[Expression], child: LogicalPlan):
+        super().__init__(child)
+        self.grouping = list(grouping)
+        self.agg_exprs = list(agg_exprs)
+
+    @property
+    def output(self):
+        return [to_attribute(e) for e in self.agg_exprs]
+
+    def describe(self):
+        return (f"Aggregate [{', '.join(map(repr, self.grouping))}] "
+                f"[{', '.join(map(repr, self.agg_exprs))}]")
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: Sequence[SortOrder], is_global: bool,
+                 child: LogicalPlan):
+        super().__init__(child)
+        self.orders = list(orders)
+        self.is_global = is_global
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        scope = "global" if self.is_global else "local"
+        return f"Sort {scope} [{', '.join(map(repr, self.orders))}]"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 join_type: JoinType,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+
+    @property
+    def output(self):
+        left, right = self.children
+        if self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            return left.output
+        def nullable(attrs):
+            return [AttributeReference(a.name, a.data_type, True, a.expr_id)
+                    for a in attrs]
+        if self.join_type is JoinType.LEFT_OUTER:
+            return left.output + nullable(right.output)
+        if self.join_type is JoinType.RIGHT_OUTER:
+            return nullable(left.output) + right.output
+        if self.join_type is JoinType.FULL_OUTER:
+            return nullable(left.output) + nullable(right.output)
+        return left.output + right.output
+
+    def describe(self):
+        return (f"Join {self.join_type.value} keys="
+                f"{list(zip(self.left_keys, self.right_keys))} "
+                f"cond={self.condition!r}")
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"Limit {self.n}"
+
+
+class Union(LogicalPlan):
+    def __init__(self, *children: LogicalPlan):
+        super().__init__(*children)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+
+class Repartition(LogicalPlan):
+    """Round-robin (no exprs) or hash (exprs) repartition; `coalesce_only`
+    maps to partition coalescing without a shuffle."""
+
+    def __init__(self, num_partitions: Optional[int],
+                 partition_exprs: Sequence[Expression],
+                 coalesce_only: bool, child: LogicalPlan):
+        super().__init__(child)
+        self.num_partitions = num_partitions
+        self.partition_exprs = list(partition_exprs)
+        self.coalesce_only = coalesce_only
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+
+class Expand(LogicalPlan):
+    """Multiple projection lists per input row (grouping sets;
+    reference: GpuExpandExec)."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 output_attrs: List[AttributeReference], child: LogicalPlan):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        self.output_attrs = output_attrs
+
+    @property
+    def output(self):
+        return self.output_attrs
+
+
+class Generate(LogicalPlan):
+    """Explode of an array-producing expression (reference: GpuGenerateExec).
+    v1 scope: explode(array literal columns) + posexplode."""
+
+    def __init__(self, generator: Expression, generator_output: List[AttributeReference],
+                 outer: bool, child: LogicalPlan):
+        super().__init__(child)
+        self.generator = generator
+        self.generator_output = generator_output
+        self.outer = outer
+
+    @property
+    def output(self):
+        return self.children[0].output + self.generator_output
+
+
+class WindowOp(LogicalPlan):
+    """Window expressions appended to child output (reference: GpuWindowExec)."""
+
+    def __init__(self, window_exprs: Sequence[Expression], child: LogicalPlan):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+
+    @property
+    def output(self):
+        return self.children[0].output + [to_attribute(e) for e in self.window_exprs]
+
+
+class WriteFile(LogicalPlan):
+    """Write to files (reference: GpuInsertIntoHadoopFsRelationCommand +
+    GpuParquetFileFormat/GpuOrcFileFormat)."""
+
+    def __init__(self, fmt: str, path: str, mode: str,
+                 options: Dict[str, Any],
+                 partition_by: List[str], child: LogicalPlan):
+        super().__init__(child)
+        self.fmt = fmt
+        self.path = path
+        self.mode = mode
+        self.options = dict(options)
+        self.partition_by = list(partition_by)
+
+    @property
+    def output(self):
+        return []
+
+    def describe(self):
+        return f"WriteFile {self.fmt} -> {self.path} mode={self.mode}"

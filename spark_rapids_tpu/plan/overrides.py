@@ -1,0 +1,282 @@
+"""TpuOverrides: the CPU->TPU plan rewrite driver + rule registry.
+
+Reference parity: GpuOverrides.scala —
+- rule registry, one ReplacementRule per CPU op (:461-1766) -> EXPR_RULES /
+  EXEC_RULES below (feature modules register more at import time).
+- `GpuOverrides.apply`: wrap plan -> tagForGpu -> explain -> convertIfNeeded
+  (:1769-1826) -> `TpuOverrides.apply`.
+- incompat taxonomy: ops whose TPU results differ in corner cases are tagged
+  with a reason and gated behind rapids.tpu.sql.incompatibleOps.enabled or the
+  per-op key (reference: ReplacementRule.incompat, GpuOverrides.scala:82-95).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+import jax
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.batch import device_float64_supported
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import PhysicalExec
+from spark_rapids_tpu.ops import arithmetic as AR
+from spark_rapids_tpu.ops import bitwise as BW
+from spark_rapids_tpu.ops import datetimeops as DT
+from spark_rapids_tpu.ops import mathx as MX
+from spark_rapids_tpu.ops import misc as MISC
+from spark_rapids_tpu.ops import nulls as N
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops import stringops as S
+from spark_rapids_tpu.ops import aggregates as AGG
+from spark_rapids_tpu.ops.base import (
+    Alias,
+    AttributeReference,
+    BoundReference,
+    Expression,
+    SortOrder,
+)
+from spark_rapids_tpu.ops.cast import Cast
+from spark_rapids_tpu.ops.conditional import CaseWhen, If
+from spark_rapids_tpu.ops.literals import Literal
+from spark_rapids_tpu.plan import meta as MT
+from spark_rapids_tpu.plan.meta import ExecMeta, ExecRule, ExprMeta, ExprRule
+
+EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
+EXEC_RULES: Dict[Type[PhysicalExec], ExecRule] = {}
+
+
+def register_expr(expr_cls, desc, incompat=None, disabled_by_default=False,
+                  tag_fn=None):
+    rule = ExprRule(expr_cls, desc, incompat, disabled_by_default, tag_fn)
+    EXPR_RULES[expr_cls] = rule
+    return rule
+
+
+def register_exec(cpu_cls, desc, convert, incompat=None,
+                  disabled_by_default=False, tag_fn=None):
+    rule = ExecRule(cpu_cls, desc, convert, incompat, disabled_by_default,
+                    tag_fn)
+    EXEC_RULES[cpu_cls] = rule
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# Incompat tag helpers
+# ---------------------------------------------------------------------------
+def _tag_f64_on_tpu(m: ExprMeta) -> None:
+    """DOUBLE math runs in f32 on TPU hardware (no f64 units); flag incompat
+    unless the session opted in (the reference's float-corner-case taxonomy)."""
+    try:
+        dt = m.expr.data_type
+    except Exception:
+        return
+    def _dt(c):
+        try:
+            return c.data_type
+        except Exception:
+            return None
+
+    involves_f64 = dt is DataType.FLOAT64 or any(
+        _dt(c) is DataType.FLOAT64 for c in m.expr.children())
+    if involves_f64 and not device_float64_supported():
+        if not m.conf.get(C.INCOMPATIBLE_OPS) and \
+                m.conf.get_key(m.rule.conf_key) is None:
+            m.will_not_work(
+                "DOUBLE is computed as float32 on TPU (no f64 hardware); "
+                "set rapids.tpu.sql.incompatibleOps.enabled=true to accept")
+
+
+# ---------------------------------------------------------------------------
+# Expression rules (reference registry: GpuOverrides.scala:461-1487)
+# ---------------------------------------------------------------------------
+def _register_expr_rules():
+    r = register_expr
+    # structural
+    r(Alias, "name a result")
+    r(AttributeReference, "reference an input column")
+    r(BoundReference, "ordinal input reference")
+    r(Literal, "literal value")
+    r(Cast, "cast between types", tag_fn=_tag_cast)
+    # arithmetic
+    for cls in (AR.Add, AR.Subtract, AR.Multiply, AR.Divide,
+                AR.IntegralDivide, AR.Remainder, AR.Pmod, AR.UnaryMinus,
+                AR.UnaryPositive, AR.Abs, AR.Signum):
+        r(cls, f"arithmetic {cls.__name__}", tag_fn=_tag_f64_on_tpu)
+    # predicates / logic
+    for cls in (P.EqualTo, P.LessThan, P.LessThanOrEqual, P.GreaterThan,
+                P.GreaterThanOrEqual, P.EqualNullSafe, P.And, P.Or, P.Not,
+                P.In):
+        r(cls, f"predicate {cls.__name__}")
+    # math (transcendental results can differ in ulps from libm; the reference
+    # tags several of these incompat for the same reason)
+    for cls in (MX.Sin, MX.Cos, MX.Tan, MX.Asin, MX.Acos, MX.Atan, MX.Sinh,
+                MX.Cosh, MX.Tanh, MX.Exp, MX.Expm1, MX.Log, MX.Log1p,
+                MX.Log2, MX.Log10, MX.Sqrt, MX.Cbrt, MX.Pow, MX.Atan2):
+        r(cls, f"math {cls.__name__}",
+          incompat="floating point results may differ in ulps from the CPU")
+    for cls in (MX.Rint, MX.Floor, MX.Ceil, MX.ToDegrees, MX.ToRadians):
+        r(cls, f"math {cls.__name__}", tag_fn=_tag_f64_on_tpu)
+    # bitwise
+    for cls in (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
+                BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned):
+        r(cls, f"bitwise {cls.__name__}")
+    # nulls / conditional
+    for cls in (N.IsNull, N.IsNotNull, N.IsNan, N.NaNvl, N.Coalesce,
+                N.AtLeastNNonNulls):
+        r(cls, f"null-handling {cls.__name__}")
+    r(If, "if/else")
+    r(CaseWhen, "case when")
+    # strings
+    for cls in (S.Length, S.Substring, S.Concat,
+                S.StartsWith, S.EndsWith, S.Contains, S.Like, S.StringTrim,
+                S.StringTrimLeft, S.StringTrimRight, S.StringReplace):
+        r(cls, f"string {cls.__name__}")
+    for cls in (S.Upper, S.Lower):
+        r(cls, f"string {cls.__name__}",
+          incompat="device case conversion is ASCII-only; non-ASCII "
+                   "characters pass through unchanged")
+    # datetime
+    for cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Hour, DT.Minute,
+                DT.Second, DT.DateDiff, DT.DateAdd, DT.DateSub, DT.LastDay,
+                DT.DayOfWeek, DT.Quarter):
+        r(cls, f"datetime {cls.__name__}")
+    r(DT.UnixTimestamp, "parse/convert to unix seconds",
+      incompat="range/overflow behavior differs slightly from CPU "
+               "(reference: improvedTimeOps)")
+    r(DT.FromUnixTime, "format unix seconds as string")
+    # nondeterministic
+    r(MISC.Rand, "uniform random",
+      incompat="TPU RNG stream differs from CPU XORShiftRandom")
+    r(MISC.MonotonicallyIncreasingID, "monotonically increasing id")
+    r(MISC.SparkPartitionID, "partition id")
+    r(MISC.InputFileName, "input file name")
+    # aggregate functions
+    for cls in (AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average,
+                AGG.First, AGG.Last):
+        r(cls, f"aggregate {cls.__name__}", tag_fn=_tag_agg)
+
+
+def _tag_cast(m: ExprMeta) -> None:
+    e: Cast = m.expr
+    src = e.child.data_type
+    dst = e.to_type
+    conf = m.conf
+    if src.is_floating and dst is DataType.STRING and \
+            not conf.get(C.ENABLE_CAST_FLOAT_TO_STRING):
+        m.will_not_work(
+            "cast float->string formatting differs from CPU; set "
+            "rapids.tpu.sql.castFloatToString.enabled=true")
+    if src is DataType.STRING and dst.is_floating and \
+            not conf.get(C.ENABLE_CAST_STRING_TO_FLOAT):
+        m.will_not_work(
+            "cast string->float corner cases differ; set "
+            "rapids.tpu.sql.castStringToFloat.enabled=true")
+    if src is DataType.STRING and dst is DataType.TIMESTAMP and \
+            not conf.get(C.ENABLE_CAST_STRING_TO_TIMESTAMP):
+        m.will_not_work(
+            "cast string->timestamp only supports a subset of formats; set "
+            "rapids.tpu.sql.castStringToTimestamp.enabled=true")
+    _tag_f64_on_tpu(m)
+
+
+def _tag_agg(m: ExprMeta) -> None:
+    e = m.expr
+    if isinstance(e, (AGG.Sum, AGG.Average)) and \
+            e.child.data_type.is_floating:
+        if not m.conf.get(C.ENABLE_FLOAT_AGG):
+            m.will_not_work(
+                "float aggregation order differs from CPU; set "
+                "rapids.tpu.sql.variableFloatAgg.enabled=true")
+    _tag_f64_on_tpu(m)
+
+
+# ---------------------------------------------------------------------------
+# Exec rules (reference registry: GpuOverrides.scala:1622-1766)
+# ---------------------------------------------------------------------------
+def _register_exec_rules():
+    register_exec(
+        B.CpuProjectExec, "columnar projection",
+        lambda cpu, ch: B.TpuProjectExec(cpu.project_list, ch[0]))
+    register_exec(
+        B.CpuFilterExec, "columnar filter",
+        lambda cpu, ch: B.TpuFilterExec(cpu.condition, ch[0]))
+    register_exec(
+        B.CpuUnionExec, "union-all",
+        lambda cpu, ch: B.TpuUnionExec(*ch))
+    register_exec(
+        B.CpuLocalLimitExec, "per-partition limit",
+        lambda cpu, ch: B.TpuLocalLimitExec(cpu.limit, ch[0]))
+    register_exec(
+        B.CpuGlobalLimitExec, "global limit",
+        lambda cpu, ch: B.TpuGlobalLimitExec(cpu.limit, ch[0]))
+
+
+# ---------------------------------------------------------------------------
+# Node-expression extraction (which expressions does a node evaluate?)
+# ---------------------------------------------------------------------------
+_NODE_EXPR_GETTERS: Dict[Type[PhysicalExec], callable] = {}
+
+
+def node_expressions_of(cls):
+    def deco(fn):
+        _NODE_EXPR_GETTERS[cls] = fn
+        return fn
+    return deco
+
+
+def _node_expressions(plan: PhysicalExec) -> List[Expression]:
+    fn = _NODE_EXPR_GETTERS.get(type(plan))
+    if fn is not None:
+        return fn(plan)
+    if isinstance(plan, (B.CpuProjectExec, B.TpuProjectExec)):
+        return list(plan.project_list)
+    if isinstance(plan, (B.CpuFilterExec, B.TpuFilterExec)):
+        return [plan.condition]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# wrap + apply (reference: GpuOverrides.apply, :1769-1826)
+# ---------------------------------------------------------------------------
+def _expr_rule_for(e: Expression) -> Optional[ExprRule]:
+    return EXPR_RULES.get(type(e))
+
+
+def _wrap_plan(plan: PhysicalExec, conf: C.TpuConf) -> ExecMeta:
+    return ExecMeta(plan, conf, EXEC_RULES.get(type(plan)), _expr_rule_for)
+
+
+def _wrap_expr(expr: Expression, conf: C.TpuConf) -> ExprMeta:
+    return ExprMeta(expr, conf, _expr_rule_for(expr))
+
+
+MT._WRAP_PLAN = _wrap_plan
+MT._WRAP_EXPR = _wrap_expr
+MT._NODE_EXPRESSIONS = _node_expressions
+
+
+class TpuOverrides:
+    """The pre-transition columnar rule (reference: ColumnarOverrideRules
+    preColumnarTransitions = GpuOverrides(), Plugin.scala:37-40)."""
+
+    @staticmethod
+    def apply(cpu_plan: PhysicalExec, conf: C.TpuConf,
+              explain_out: Optional[List[str]] = None) -> PhysicalExec:
+        if not conf.sql_enabled:
+            return cpu_plan
+        wrapped = _wrap_plan(cpu_plan, conf)
+        wrapped.tag_for_tpu()
+        explain = conf.explain
+        if explain != "NONE" or explain_out is not None:
+            text = wrapped.explain_string(all_nodes=(explain == "ALL"))
+            if explain_out is not None:
+                explain_out.append(wrapped.explain_string(all_nodes=True))
+            if explain != "NONE" and text:
+                print(text)
+        return wrapped.convert_if_needed()
+
+
+_register_expr_rules()
+_register_exec_rules()

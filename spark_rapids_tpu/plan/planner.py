@@ -1,0 +1,95 @@
+"""Logical -> CPU physical planning.
+
+The reference relies on Spark Catalyst to produce the CPU physical plan and
+only *rewrites* it (GpuOverrides); standalone, we need the (simple) physical
+planner itself. The CPU plan produced here is the oracle engine; the
+TpuOverrides pass (plan/overrides.py) then replaces supported nodes with TPU
+execs, exactly like the reference replaces Spark execs with Gpu execs.
+
+Distribution planning mirrors Spark:
+- Aggregate -> partial agg + hash exchange on keys + final agg
+  (reference call stack section 3.5).
+- Global sort -> range exchange + per-partition sort (GpuSortExec.scala:50-98).
+- Equi-join -> broadcast hash join when one side fits under the threshold,
+  else hash exchange both sides + shuffled hash join
+  (GpuShuffledHashJoinExec / GpuBroadcastHashJoinExec).
+- Global limit -> local limit + single-partition exchange + global limit
+  (GpuCollectLimitMeta, limit.scala:124).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Type
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import PhysicalExec
+from spark_rapids_tpu.plan import logical as L
+
+# dispatch table, extended by feature modules (aggregate/sort/join/io/...)
+_PLANNERS: Dict[Type[L.LogicalPlan], Callable] = {}
+
+
+def register_planner(logical_cls: Type[L.LogicalPlan]):
+    def deco(fn):
+        _PLANNERS[logical_cls] = fn
+        return fn
+    return deco
+
+
+def plan_physical(plan: L.LogicalPlan, conf: C.TpuConf) -> PhysicalExec:
+    fn = _PLANNERS.get(type(plan))
+    if fn is None:
+        raise NotImplementedError(
+            f"no physical planning for {type(plan).__name__}")
+    return fn(plan, conf)
+
+
+def _plan_children(plan: L.LogicalPlan, conf: C.TpuConf) -> List[PhysicalExec]:
+    return [plan_physical(c, conf) for c in plan.children]
+
+
+@register_planner(L.LocalRelation)
+def _plan_local(plan: L.LocalRelation, conf: C.TpuConf) -> PhysicalExec:
+    return B.HostScanExec(plan.schema, plan.partitions)
+
+
+@register_planner(L.RangeRelation)
+def _plan_range(plan: L.RangeRelation, conf: C.TpuConf) -> PhysicalExec:
+    return B.RangeExec(plan.start, plan.end, plan.step, plan.num_partitions,
+                       plan.output[0])
+
+
+@register_planner(L.Project)
+def _plan_project(plan: L.Project, conf: C.TpuConf) -> PhysicalExec:
+    (child,) = _plan_children(plan, conf)
+    return B.CpuProjectExec(plan.project_list, child)
+
+
+@register_planner(L.Filter)
+def _plan_filter(plan: L.Filter, conf: C.TpuConf) -> PhysicalExec:
+    (child,) = _plan_children(plan, conf)
+    return B.CpuFilterExec(plan.condition, child)
+
+
+@register_planner(L.Union)
+def _plan_union(plan: L.Union, conf: C.TpuConf) -> PhysicalExec:
+    return B.CpuUnionExec(*_plan_children(plan, conf))
+
+
+@register_planner(L.Limit)
+def _plan_limit(plan: L.Limit, conf: C.TpuConf) -> PhysicalExec:
+    (child,) = _plan_children(plan, conf)
+    local = B.CpuLocalLimitExec(plan.n, child)
+    merged = B.CoalescePartitionsExec(1, local)
+    return B.CpuGlobalLimitExec(plan.n, merged)
+
+
+@register_planner(L.Repartition)
+def _plan_repartition(plan: L.Repartition, conf: C.TpuConf) -> PhysicalExec:
+    (child,) = _plan_children(plan, conf)
+    if plan.coalesce_only:
+        return B.CoalescePartitionsExec(plan.num_partitions or 1, child)
+    from spark_rapids_tpu.shuffle.exchange import plan_repartition_exchange
+
+    return plan_repartition_exchange(plan, child, conf)

@@ -1,0 +1,141 @@
+"""Post-pass transition insertion + optimization.
+
+Reference parity: GpuTransitionOverrides.scala —
+- insert host/device boundary nodes (:152-169) -> placement-boundary insertion
+  of HostToDeviceExec / DeviceToHostExec.
+- insert GpuCoalesceBatches per child CoalesceGoal (:64-147) ->
+  coalesce-goal insertion.
+- fuse adjacent transitions (:37-62) -> `_optimize_transitions`.
+- `assertIsOnTheGpu` strict test mode with allow-list (:211-260) ->
+  `assert_is_on_tpu`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import CpuExec, PhysicalExec, TpuExec
+from spark_rapids_tpu.exec.transitions import (
+    CoalesceGoal,
+    CpuCoalesceBatchesExec,
+    DeviceToHostExec,
+    HostToDeviceExec,
+    TargetSize,
+    TpuCoalesceBatchesExec,
+)
+
+# execs that pass batches through without touching placement
+_TRANSPARENT = (B.CoalescePartitionsExec,)
+
+
+def _effective_placement(node: PhysicalExec) -> str:
+    if isinstance(node, _TRANSPARENT):
+        return _effective_placement(node.children[0]) if node.children else "cpu"
+    return node.placement
+
+
+class TpuTransitionOverrides:
+    """The post-transition columnar rule (reference: ColumnarOverrideRules
+    postColumnarTransitions, Plugin.scala:41-43)."""
+
+    @staticmethod
+    def apply(plan: PhysicalExec, conf: C.TpuConf) -> PhysicalExec:
+        plan = _insert_transitions(plan, want_host_output=True)
+        plan = _insert_coalesce(plan, conf)
+        plan = _optimize_transitions(plan)
+        if conf.test_enabled:
+            assert_is_on_tpu(plan, conf)
+        return plan
+
+
+def _insert_transitions(node: PhysicalExec, want_host_output: bool) -> PhysicalExec:
+    """Make batch placement consistent along every edge; the root must
+    produce host batches when `want_host_output` (collect boundary,
+    reference GpuBringBackToHost insertion)."""
+    new_children = []
+    for c in node.children:
+        c2 = _insert_transitions(c, want_host_output=False)
+        child_p = _effective_placement(c2)
+        # transparent nodes adopt whatever the child produces
+        my_p = _effective_placement(node) if isinstance(node, _TRANSPARENT) \
+            else node.placement
+        if my_p == "tpu" and child_p == "cpu":
+            c2 = HostToDeviceExec(c2)
+        elif my_p == "cpu" and child_p == "tpu" and \
+                not isinstance(node, DeviceToHostExec):
+            c2 = DeviceToHostExec(c2)
+        new_children.append(c2)
+    if new_children and any(
+            a is not b for a, b in zip(new_children, node.children)):
+        node = node.with_children(new_children)
+    if want_host_output and _effective_placement(node) == "tpu":
+        node = DeviceToHostExec(node)
+    return node
+
+
+def _insert_coalesce(node: PhysicalExec, conf: C.TpuConf) -> PhysicalExec:
+    """Insert batch-coalescing per the child goals each operator declares
+    (reference: GpuTransitionOverrides.insertCoalesce, :64-147)."""
+    goals = node.children_coalesce_goal
+    new_children = []
+    for c, goal in zip(node.children, goals):
+        c2 = _insert_coalesce(c, conf)
+        if goal is None and getattr(c2, "coalesce_after", False):
+            goal = TargetSize(conf.batch_size_bytes)
+        if goal is not None:
+            if _effective_placement(c2) == "tpu":
+                c2 = TpuCoalesceBatchesExec(goal, c2)
+            else:
+                c2 = CpuCoalesceBatchesExec(goal, c2)
+        new_children.append(c2)
+    if new_children and any(
+            a is not b for a, b in zip(new_children, node.children)):
+        node = node.with_children(new_children)
+    return node
+
+
+def _optimize_transitions(node: PhysicalExec) -> PhysicalExec:
+    """Drop adjacent DeviceToHost(HostToDevice(x)) / HostToDevice(DeviceToHost(x))
+    pairs (reference: optimizeGpuPlanTransitions, :37-44)."""
+
+    def fuse(n: PhysicalExec) -> PhysicalExec:
+        if isinstance(n, DeviceToHostExec) and \
+                isinstance(n.children[0], HostToDeviceExec):
+            return n.children[0].children[0]
+        if isinstance(n, HostToDeviceExec) and \
+                isinstance(n.children[0], DeviceToHostExec):
+            return n.children[0].children[0]
+        # merge nested same-placement coalesces, keep the stronger goal
+        if isinstance(n, TpuCoalesceBatchesExec) and \
+                isinstance(n.children[0], TpuCoalesceBatchesExec):
+            inner = n.children[0]
+            return TpuCoalesceBatchesExec(n.goal.max_combine(inner.goal),
+                                          inner.children[0])
+        return n
+
+    return node.transform_up(fuse)
+
+
+class NotOnTpuError(AssertionError):
+    pass
+
+
+def assert_is_on_tpu(plan: PhysicalExec, conf: C.TpuConf) -> None:
+    """Strict test mode: every operator must be a TPU exec unless allowed
+    (reference: GpuTransitionOverrides.assertIsOnTheGpu, :211-260)."""
+    allowed = set(conf.allowed_non_tpu)
+    always_ok = {
+        "HostScanExec", "RangeExec", "DeviceToHostExec", "HostToDeviceExec",
+        "CoalescePartitionsExec", "CpuCoalesceBatchesExec",
+    }
+
+    def check(n: PhysicalExec) -> None:
+        name = type(n).__name__
+        if isinstance(n, CpuExec) and name not in always_ok and \
+                name not in allowed:
+            raise NotOnTpuError(
+                f"{name} did not run on the TPU; plan:\n{plan.tree_string()}")
+
+    plan.foreach(check)

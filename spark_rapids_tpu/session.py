@@ -1,0 +1,261 @@
+"""TpuSession: the SparkSession-with-plugin analog.
+
+Bundles what the reference splits across SparkSession + SQLPlugin
+(Plugin.scala): conf handling, executor bring-up (device manager + admission
+semaphore + scheduler, reference RapidsExecutorPlugin.init Plugin.scala:114-142),
+the plan pipeline (planner -> TpuOverrides -> TpuTransitionOverrides, reference
+ColumnarOverrideRules Plugin.scala:36-54), and actions (collect/write).
+
+Plan capture for tests mirrors ExecutionPlanCaptureCallback
+(Plugin.scala:144-233).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
+from spark_rapids_tpu.columnar.dtypes import DataType, from_np
+from spark_rapids_tpu.engine.scheduler import TaskScheduler
+from spark_rapids_tpu.exec.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.ops.base import AttributeReference
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.dataframe import DataFrame
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.planner import plan_physical
+from spark_rapids_tpu.plan.transition_overrides import TpuTransitionOverrides
+
+
+class PlanCapture:
+    """Test hook capturing the final physical plan of each execution
+    (reference: ExecutionPlanCaptureCallback, Plugin.scala:144-233)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: List[PhysicalExec] = []
+        self.enabled = False
+
+    def start(self):
+        with self._lock:
+            self._plans.clear()
+            self.enabled = True
+
+    def stop(self) -> List[PhysicalExec]:
+        with self._lock:
+            self.enabled = False
+            return list(self._plans)
+
+    def record(self, plan: PhysicalExec):
+        if self.enabled:
+            with self._lock:
+                self._plans.append(plan)
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self.conf = C.TpuConf(settings)
+        self.plan_capture = PlanCapture()
+        # executor bring-up (reference: RapidsExecutorPlugin.init)
+        self.device_manager = TpuDeviceManager.initialize(self.conf)
+        TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
+        self.scheduler = TaskScheduler(self.conf.task_threads)
+        with TpuSession._lock:
+            TpuSession._active = self
+
+    # -- builder-style API ----------------------------------------------------
+    @staticmethod
+    def builder() -> "SessionBuilder":
+        return SessionBuilder()
+
+    @classmethod
+    def active(cls) -> "TpuSession":
+        with cls._lock:
+            if cls._active is None:
+                cls._active = TpuSession()
+            return cls._active
+
+    def stop(self):
+        self.scheduler.shutdown()
+        TpuSemaphore.shutdown()
+        with TpuSession._lock:
+            if TpuSession._active is self:
+                TpuSession._active = None
+
+    def set_conf(self, key: str, value: Any) -> None:
+        self.conf.set(key, value)
+
+    # -- data sources ---------------------------------------------------------
+    def createDataFrame(self, data, schema=None,
+                        num_partitions: int = 1) -> DataFrame:
+        """data: list of tuples + schema [(name, DataType)], or dict of
+        name->list with schema optional, or pandas DataFrame."""
+        attrs, batch = _to_host_batch(data, schema)
+        parts = _split_batch(batch, num_partitions)
+        return DataFrame(L.LocalRelation(attrs, parts), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: Optional[int] = None) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        n = num_partitions or self.conf.shuffle_partitions
+        return DataFrame(L.RangeRelation(start, end, step, n), self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        from spark_rapids_tpu.io.reader import DataFrameReader
+
+        return DataFrameReader(self)
+
+    # -- plan pipeline --------------------------------------------------------
+    def _physical_plan(self, plan: L.LogicalPlan) -> PhysicalExec:
+        cpu_plan = plan_physical(plan, self.conf)
+        tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
+        final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
+        self.plan_capture.record(final)
+        return final
+
+    def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
+        cpu_plan = plan_physical(plan, self.conf)
+        explain_out: List[str] = []
+        tpu_plan = TpuOverrides.apply(
+            cpu_plan, self.conf.clone_with({"rapids.tpu.sql.explain": "NONE"}),
+            explain_out=explain_out)
+        final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
+        parts = []
+        if explain_out:
+            parts.append("== TPU tagging ==\n" + explain_out[0])
+        parts.append("== Final plan ==\n" + final.tree_string())
+        return "\n".join(parts)
+
+    def _exec_context(self) -> ExecContext:
+        return ExecContext(self.conf, self.scheduler, self.device_manager)
+
+    # -- actions --------------------------------------------------------------
+    def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
+        physical = self._physical_plan(plan)
+        ctx = self._exec_context()
+        pb = physical.execute(ctx)
+        results = self.scheduler.run_job(
+            pb.num_partitions, lambda p: list(pb.iterator(p)))
+        return [b for part in results for b in part]
+
+    def execute_collect(self, plan: L.LogicalPlan) -> List[tuple]:
+        rows: List[tuple] = []
+        for b in self.execute_batches(plan):
+            rows.extend(b.to_pylist_rows())
+        return rows
+
+    def execute_write(self, plan: L.WriteFile) -> None:
+        from spark_rapids_tpu.io.writer import execute_write
+
+        execute_write(self, plan)
+
+
+class SessionBuilder:
+    def __init__(self):
+        self._settings: Dict[str, Any] = {}
+
+    def config(self, key: str, value: Any) -> "SessionBuilder":
+        self._settings[key] = value
+        return self
+
+    def getOrCreate(self) -> TpuSession:
+        with TpuSession._lock:
+            existing = TpuSession._active
+        if existing is not None:
+            for k, v in self._settings.items():
+                existing.conf.set(k, v)
+            return existing
+        return TpuSession(self._settings)
+
+
+# ---------------------------------------------------------------------------
+# createDataFrame input coercion
+# ---------------------------------------------------------------------------
+def _to_host_batch(data, schema):
+    if hasattr(data, "to_dict") and hasattr(data, "dtypes"):  # pandas
+        cols = {name: data[name].to_numpy() for name in data.columns}
+        return _dict_to_batch(cols, schema)
+    if isinstance(data, dict):
+        return _dict_to_batch(data, schema)
+    if isinstance(data, list):
+        if schema is None:
+            raise ValueError("schema required for list-of-rows input")
+        names_types = _normalize_schema(schema)
+        cols = {name: [row[i] for row in data]
+                for i, (name, _)in enumerate(names_types)}
+        attrs = [AttributeReference(n, t, True) for n, t in names_types]
+        vecs = [HostColumnVector.from_pylist(cols[n], t)
+                for n, t in names_types]
+        return attrs, HostColumnarBatch(vecs)
+    raise TypeError(f"cannot create DataFrame from {type(data)}")
+
+
+def _normalize_schema(schema):
+    out = []
+    for item in schema:
+        if isinstance(item, tuple):
+            name, t = item
+            if isinstance(t, str):
+                t = DataType.parse(t)
+            out.append((name, t))
+        elif isinstance(item, AttributeReference):
+            out.append((item.name, item.data_type))
+        else:
+            raise TypeError(f"bad schema element {item!r}")
+    return out
+
+
+def _dict_to_batch(cols: Dict[str, Any], schema):
+    names_types = _normalize_schema(schema) if schema else None
+    attrs, vecs = [], []
+    for i, (name, values) in enumerate(cols.items()):
+        want = names_types[i][1] if names_types else None
+        if isinstance(values, np.ndarray):
+            vec = HostColumnVector.from_numpy(values, dtype=want)
+        else:
+            dt = want
+            if dt is None:
+                dt = _infer_type(values)
+            vec = HostColumnVector.from_pylist(list(values), dt)
+        attrs.append(AttributeReference(name, vec.dtype, True))
+        vecs.append(vec)
+    return attrs, HostColumnarBatch(vecs)
+
+
+def _infer_type(values) -> DataType:
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return DataType.BOOL
+        if isinstance(v, int):
+            return DataType.INT64
+        if isinstance(v, float):
+            return DataType.FLOAT64
+        if isinstance(v, str):
+            return DataType.STRING
+        if isinstance(v, np.datetime64):
+            return DataType.TIMESTAMP
+        raise TypeError(f"cannot infer SQL type for {v!r}")
+    return DataType.STRING
+
+
+def _split_batch(batch: HostColumnarBatch, n: int) -> List[List[HostColumnarBatch]]:
+    n = max(1, n)
+    total = batch.num_rows
+    per = -(-total // n) if total else 0
+    parts: List[List[HostColumnarBatch]] = []
+    for i in range(n):
+        lo, hi = i * per, min(total, (i + 1) * per)
+        parts.append([batch.slice(lo, hi - lo)] if hi > lo else [])
+    return parts

@@ -1,0 +1,570 @@
+"""Hash aggregate execs (reference: aggregate.scala, 897 LoC).
+
+Reference parity:
+- `GpuHashAggregateExec` streaming per-batch aggregation: aggregate each
+  incoming batch, concatenate with the running aggregation and re-merge
+  (aggregate.scala:338-396) -> the same incremental merge loop here.
+- 4-phase bound expressions (input refs / update+merge cudf aggs / final
+  projection / result projection, aggregate.scala:307-336) -> key_exprs /
+  AggSpec update+merge ops / evaluate_expression / result projection.
+- reduction default row for empty ungrouped input (aggregate.scala:406-419)
+  -> `_default_row_batch`.
+- partial/final mode split composed across a hash exchange
+  (call stack SURVEY.md section 3.5).
+
+TPU design: groupby = group-id assignment (sort + neighbor-diff prefix sum)
+followed by `jax.ops.segment_*` reductions — the XLA-native composition —
+instead of cudf's hash-based groupby. One jitted program per (expression
+fingerprint, capacity bucket) covers eval + grouping + every reduction; the
+only host sync per batch is the group count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    ColumnVector,
+    HostColumnarBatch,
+    HostColumnVector,
+    bucket_capacity,
+    concat_batches,
+    gather_batch,
+    physical_np_dtype,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec import rowkeys as RK
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.ops.aggregates import AggregateFunction
+from spark_rapids_tpu.ops.base import (
+    Alias,
+    AttributeReference,
+    Expression,
+    to_attribute,
+)
+from spark_rapids_tpu.ops.bind import bind_all
+from spark_rapids_tpu.ops.eval import (
+    DeviceProjector,
+    _col_to_colv,
+    cpu_project,
+)
+from spark_rapids_tpu.utils import metrics as M
+
+PARTIAL = "partial"
+FINAL = "final"
+COMPLETE = "complete"
+
+
+class AggSpec(NamedTuple):
+    """One distinct aggregate function instance and its buffer slots."""
+
+    func: AggregateFunction
+    buffers: List[AttributeReference]
+
+
+def build_agg_specs(agg_exprs: Sequence[Expression]) -> List[AggSpec]:
+    """Collect distinct AggregateFunction nodes (deduped by fingerprint) and
+    allocate buffer attributes for each."""
+    specs: List[AggSpec] = []
+    seen: Dict[str, AggSpec] = {}
+    for e in agg_exprs:
+        for f in e.collect(lambda n: isinstance(n, AggregateFunction)):
+            fp = f.fingerprint()
+            if fp not in seen:
+                spec = AggSpec(f, list(f.buffer_attrs()))
+                seen[fp] = spec
+                specs.append(spec)
+    return specs
+
+
+def rewrite_result_exprs(agg_exprs: Sequence[Expression],
+                         specs: List[AggSpec]) -> List[Expression]:
+    """Replace AggregateFunction nodes with their evaluate_expression over
+    the buffer attributes (the reference's final projection)."""
+    by_fp = {s.func.fingerprint(): s for s in specs}
+
+    def rewrite(node: Expression) -> Expression:
+        if isinstance(node, AggregateFunction):
+            spec = by_fp[node.fingerprint()]
+            return node.evaluate_expression(spec.buffers)
+        return node
+
+    return [e.transform_up(rewrite) for e in agg_exprs]
+
+
+def _key_exprs_for(grouping: Sequence[AttributeReference],
+                   agg_exprs: Sequence[Expression]) -> List[Expression]:
+    """The expression computing each grouping key (the Alias carrying the
+    key computation lives in agg_exprs; fall back to the attr itself)."""
+    out: List[Expression] = []
+    for g in grouping:
+        found: Expression = g
+        for e in agg_exprs:
+            if isinstance(e, (Alias, AttributeReference)) and \
+                    to_attribute(e).expr_id == g.expr_id:
+                found = e
+                break
+        out.append(found)
+    return out
+
+
+class _HashAggregateBase(PhysicalExec):
+    """Shared schema/structure for the CPU and TPU hash aggregate."""
+
+    def __init__(self, grouping: List[AttributeReference],
+                 agg_exprs: List[Expression], mode: str,
+                 child: PhysicalExec,
+                 specs: Optional[List[AggSpec]] = None):
+        super().__init__(child)
+        self.grouping = list(grouping)
+        self.agg_exprs = list(agg_exprs)
+        self.mode = mode
+        self.specs = specs if specs is not None else build_agg_specs(agg_exprs)
+        self.key_exprs = _key_exprs_for(self.grouping, self.agg_exprs)
+
+    @property
+    def buffer_attrs(self) -> List[AttributeReference]:
+        return [b for s in self.specs for b in s.buffers]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        if self.mode == PARTIAL:
+            return list(self.grouping) + self.buffer_attrs
+        return [to_attribute(e) for e in self.agg_exprs]
+
+    def with_children(self, new_children):
+        return type(self)(self.grouping, self.agg_exprs, self.mode,
+                          new_children[0], self.specs)
+
+    def node_name(self):
+        return f"{type(self).__name__}({self.mode})"
+
+    # intermediate schema during update/merge: keys then buffers
+    @property
+    def _inter_attrs(self) -> List[AttributeReference]:
+        return list(self.grouping) + self.buffer_attrs
+
+    def _update_ops(self) -> List[Tuple[str, Expression, DataType]]:
+        """(reduce op, input expr, buffer dtype) per buffer, in buffer order."""
+        out = []
+        for spec in self.specs:
+            for (bname, op, expr), battr in zip(spec.func.update_aggs(),
+                                                spec.buffers):
+                out.append((op, expr, battr.data_type))
+        return out
+
+    def _merge_ops(self) -> List[Tuple[str, DataType]]:
+        out = []
+        for spec in self.specs:
+            for (bname, op), battr in zip(spec.func.merge_aggs(), spec.buffers):
+                out.append((op, battr.data_type))
+        return out
+
+
+def _default_row_values(specs: List[AggSpec]) -> List[Any]:
+    """Buffer values representing the empty ungrouped reduction
+    (reference: aggregate.scala:406-419)."""
+    vals: List[Any] = []
+    for spec in specs:
+        vals.extend(spec.func.initial_buffer_values())
+    return vals
+
+
+# ===========================================================================
+# TPU exec
+# ===========================================================================
+class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
+    placement = "tpu"
+
+    # -- jitted kernels (built lazily, cached per exec instance) -------------
+    def _build_update_kernel(self, input_attrs):
+        bound_keys = bind_all(self.key_exprs, input_attrs)
+        ops = self._update_ops()
+        bound_inputs = bind_all([e for _, e, _ in ops], input_attrs)
+        op_names = [op for op, _, _ in ops]
+        from spark_rapids_tpu.ops.values import EvalContext, ScalarV
+        from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+        def kernel(cols, num_rows):
+            capacity = cols[0].validity.shape[0] if cols else 8
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+
+            def as_col(e):
+                r = e.eval(ctx)
+                if isinstance(r, ScalarV):
+                    r = _scalar_to_colv(ctx, r, e.data_type)
+                return r
+
+            key_cols = [as_col(e) for e in bound_keys]
+            in_cols = [as_col(e) for e in bound_inputs]
+            gi = _group_info(key_cols, num_rows, capacity)
+            buf_outs = []
+            for op, cv in zip(op_names, in_cols):
+                data, validity = RK.segment_reduce(
+                    op, cv.data, cv.validity, gi.gid, num_rows, capacity)
+                buf_outs.append((data, validity))
+            return key_cols, buf_outs, gi
+
+        return jax.jit(kernel)
+
+    def _build_merge_kernel(self, n_keys: int):
+        ops = [op for op, _ in self._merge_ops()]
+
+        def kernel(cols, num_rows):
+            capacity = cols[0].validity.shape[0] if cols else 8
+            key_cols = cols[:n_keys]
+            buf_cols = cols[n_keys:]
+            gi = _group_info(key_cols, num_rows, capacity)
+            buf_outs = []
+            for op, cv in zip(ops, buf_cols):
+                data, validity = RK.segment_reduce(
+                    op, cv.data, cv.validity, gi.gid, num_rows, capacity)
+                buf_outs.append((data, validity))
+            return key_cols, buf_outs, gi
+
+        return jax.jit(kernel)
+
+    # -- assembling an intermediate [keys+buffers] device batch --------------
+    def _assemble(self, key_cols, buf_outs, gi, capacity) -> ColumnarBatch:
+        n_groups = int(jax.device_get(gi.num_groups))
+        key_batch = ColumnarBatch(
+            [ColumnVector(cv.dtype, cv.data, cv.validity, cv.offsets)
+             for cv in key_cols], capacity)
+        gathered = gather_batch(key_batch, gi.rep_rows, n_groups)
+        out_cap = gathered.capacity if gathered.columns else \
+            bucket_capacity(max(n_groups, 1))
+        cols = list(gathered.columns)
+        for (data, validity), battr in zip(buf_outs, self.buffer_attrs):
+            d = data[:out_cap]
+            v = validity[:out_cap] & (jnp.arange(out_cap) < n_groups)
+            npdt = physical_np_dtype(battr.data_type)
+            if d.dtype != jnp.dtype(npdt):
+                d = d.astype(npdt)
+            d = jnp.where(v, d, jnp.zeros((), d.dtype))
+            cols.append(ColumnVector(battr.data_type, d, v))
+        return ColumnarBatch(cols, n_groups)
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        update_kernel = [None]
+        merge_kernel = [None]
+        n_keys = len(self.grouping)
+        do_update = self.mode in (PARTIAL, COMPLETE)
+
+        def merge(batch: ColumnarBatch) -> ColumnarBatch:
+            if merge_kernel[0] is None:
+                merge_kernel[0] = self._build_merge_kernel(n_keys)
+            cols = [_col_to_colv(c) for c in batch.columns]
+            k, b, gi = merge_kernel[0](cols, jnp.int32(batch.num_rows))
+            return self._assemble(k, b, gi, batch.capacity)
+
+        def agg_partition(pidx: int):
+            running: Optional[ColumnarBatch] = None
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    continue
+                if do_update:
+                    if update_kernel[0] is None:
+                        update_kernel[0] = self._build_update_kernel(child_attrs)
+                    cols = [_col_to_colv(c) for c in batch.columns]
+                    if not cols:
+                        cols = [_synth_col(batch)]
+                    k, b, gi = update_kernel[0](cols, jnp.int32(batch.num_rows))
+                    local = self._assemble(k, b, gi, batch.capacity)
+                    # a fresh update output has unique keys already
+                    if running is None:
+                        running = local
+                    else:
+                        running = merge(concat_batches([running, local]))
+                else:
+                    # merge mode: even a single input batch may hold duplicate
+                    # keys (upstream coalesce concatenates exchange pieces)
+                    merged = batch if running is None else \
+                        concat_batches([running, batch])
+                    running = merge(merged)
+            yield from self._emit(running, pidx)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, agg_partition(pidx))
+
+        return PartitionedBatches(child_pb.num_partitions, factory)
+
+    def _emit(self, running: Optional[ColumnarBatch], pidx: int):
+        if self.mode == PARTIAL:
+            if running is not None:
+                yield running
+            return
+        if running is None:
+            if not self.grouping and pidx == 0:
+                yield _default_row_batch_device(self.specs, self._inter_attrs,
+                                                self.agg_exprs)
+            return
+        rewritten = rewrite_result_exprs(self.agg_exprs, self.specs)
+        projector = DeviceProjector(bind_all(rewritten, self._inter_attrs))
+        yield projector.project(running)
+
+
+def _synth_col(batch: ColumnarBatch):
+    from spark_rapids_tpu.ops.values import ColV
+
+    cap = bucket_capacity(max(batch.num_rows, 1))
+    return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
+                jnp.arange(cap) < batch.num_rows)
+
+
+def _group_info(key_cols, num_rows, capacity: int) -> RK.GroupInfo:
+    if not key_cols:
+        rows = jnp.arange(capacity)
+        gid = jnp.where(rows < num_rows, 0, capacity).astype(jnp.int32)
+        num_groups = jnp.minimum(num_rows, 1).astype(jnp.int32)
+        rep = jnp.zeros((capacity,), jnp.int32)
+        return RK.GroupInfo(gid, num_groups, rep)
+    proxies = [RK.key_proxy(cv) for cv in key_cols]
+    return RK.group_ids(proxies, num_rows, capacity)
+
+
+def _default_row_batch_device(specs, inter_attrs, agg_exprs) -> ColumnarBatch:
+    host = _default_row_batch_host(specs, inter_attrs, agg_exprs)
+    return _project_default(host, specs, inter_attrs, agg_exprs, True)
+
+
+# ===========================================================================
+# CPU oracle exec
+# ===========================================================================
+def _canonical_key(dtype: DataType, value, valid: bool):
+    if not valid:
+        return None
+    if dtype in (DataType.FLOAT32, DataType.FLOAT64):
+        f = float(value)
+        if f != f:
+            return ("NaN",)
+        if f == 0.0:
+            return 0.0
+        return f
+    if dtype is DataType.STRING:
+        return str(value)
+    if dtype is DataType.BOOL:
+        return bool(value)
+    return int(value)
+
+
+class _HostAcc:
+    """Per-group per-buffer accumulator with SQL null semantics."""
+
+    __slots__ = ("op", "value", "valid", "seen")
+
+    def __init__(self, op: str):
+        self.op = op
+        self.value = None
+        self.valid = False
+        self.seen = False  # for first/last including nulls
+
+    def add(self, v, valid: bool):
+        op = self.op
+        if op == "count":
+            if self.value is None:
+                self.value = 0
+            if valid:
+                self.value += 1
+            self.valid = True
+            return
+        if op in ("first", "last"):
+            if op == "first" and self.seen:
+                return
+            self.value, self.valid, self.seen = v, valid, True
+            return
+        if op in ("first_ignore_nulls", "last_ignore_nulls"):
+            if not valid:
+                return
+            if op.startswith("first") and self.seen:
+                return
+            self.value, self.valid, self.seen = v, True, True
+            return
+        if not valid:
+            return
+        if not self.valid:
+            self.value, self.valid = v, True
+            return
+        if op == "sum":
+            s = self.value + v
+            if isinstance(s, int):
+                # wrap to signed 64-bit like the device's int64 arithmetic
+                # (and Java long addition in the reference)
+                s = ((s + (1 << 63)) % (1 << 64)) - (1 << 63)
+            self.value = s
+        elif op == "min":
+            self.value = _min_sql(self.value, v)
+        elif op == "max":
+            self.value = _max_sql(self.value, v)
+        elif op == "any":
+            self.value = bool(self.value) or bool(v)
+        else:
+            raise ValueError(f"unknown op {op}")
+
+    def result(self):
+        if self.op == "count":
+            return (self.value or 0), True
+        return self.value, self.valid
+
+
+def _is_nan(v) -> bool:
+    try:
+        return v != v
+    except Exception:
+        return False
+
+
+def _min_sql(a, b):
+    # NaN is greater than any value (Spark float ordering)
+    if _is_nan(a):
+        return b
+    if _is_nan(b):
+        return a
+    return a if a <= b else b
+
+
+def _max_sql(a, b):
+    if _is_nan(a):
+        return a
+    if _is_nan(b):
+        return b
+    return a if a >= b else b
+
+
+class CpuHashAggregateExec(_HashAggregateBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+
+        def agg_partition(pidx: int):
+            groups: Dict[tuple, List[_HostAcc]] = {}
+            key_rows: Dict[tuple, tuple] = {}
+            order: List[tuple] = []
+            do_update = self.mode in (PARTIAL, COMPLETE)
+            ops = [op for op, _, _ in self._update_ops()] if do_update else \
+                [op for op, _ in self._merge_ops()]
+            n_keys = len(self.grouping)
+            key_dtypes = [g.data_type for g in self.grouping]
+            bound_update = bind_all(
+                self.key_exprs + [e for _, e, _ in self._update_ops()],
+                child_attrs) if do_update else None
+            saw_input = False
+
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    continue
+                saw_input = True
+                if do_update:
+                    ev = cpu_project(bound_update, batch, partition_id=pidx)
+                else:
+                    ev = batch
+                kcols = ev.columns[:n_keys]
+                vcols = ev.columns[n_keys:]
+                for i in range(ev.num_rows):
+                    key = tuple(
+                        _canonical_key(key_dtypes[c], kcols[c].data[i],
+                                       bool(kcols[c].validity[i]))
+                        for c in range(n_keys))
+                    accs = groups.get(key)
+                    if accs is None:
+                        accs = [_HostAcc(op) for op in ops]
+                        groups[key] = accs
+                        order.append(key)
+                        key_rows[key] = tuple(
+                            (kcols[c].data[i], bool(kcols[c].validity[i]))
+                            for c in range(n_keys))
+                    for acc, col in zip(accs, vcols):
+                        v = col.data[i]
+                        if isinstance(v, np.generic):
+                            v = v.item()
+                        acc.add(v, bool(col.validity[i]))
+
+            inter = self._build_inter_batch(order, key_rows, groups, saw_input,
+                                            pidx)
+            if inter is None:
+                return
+            if self.mode == PARTIAL:
+                yield inter
+                return
+            rewritten = rewrite_result_exprs(self.agg_exprs, self.specs)
+            yield cpu_project(bind_all(rewritten, self._inter_attrs), inter,
+                              partition_id=pidx)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, agg_partition(pidx))
+
+        return PartitionedBatches(child_pb.num_partitions, factory)
+
+    def _build_inter_batch(self, order, key_rows, groups, saw_input, pidx):
+        n_keys = len(self.grouping)
+        if not order:
+            if self.mode == PARTIAL or self.grouping or pidx != 0:
+                return None
+            return _default_row_batch_host(self.specs, self._inter_attrs,
+                                           self.agg_exprs)
+        n = len(order)
+        cols: List[HostColumnVector] = []
+        for c, attr in enumerate(self.grouping):
+            npdt = attr.data_type.to_np()
+            data = np.zeros(n, dtype=npdt)
+            validity = np.zeros(n, dtype=bool)
+            for i, key in enumerate(order):
+                v, valid = key_rows[key][c]
+                validity[i] = valid
+                if valid:
+                    data[i] = v
+                elif attr.data_type is DataType.STRING:
+                    data[i] = ""
+            cols.append(HostColumnVector(attr.data_type, data, validity))
+        for b, battr in enumerate(self.buffer_attrs):
+            npdt = battr.data_type.to_np()
+            data = np.zeros(n, dtype=npdt)
+            if battr.data_type is DataType.STRING:
+                data[:] = ""
+            validity = np.zeros(n, dtype=bool)
+            for i, key in enumerate(order):
+                v, valid = groups[key][b].result()
+                validity[i] = valid
+                if valid and v is not None:
+                    data[i] = v
+            cols.append(HostColumnVector(battr.data_type, data, validity))
+        return HostColumnarBatch(cols, n)
+
+
+def _default_row_batch_host(specs, inter_attrs, agg_exprs) -> HostColumnarBatch:
+    """One row of initial buffer values (no grouping columns by definition)."""
+    vals = _default_row_values(specs)
+    cols = []
+    for battr, v in zip(inter_attrs, vals):
+        npdt = battr.data_type.to_np()
+        data = np.zeros(1, dtype=npdt)
+        validity = np.array([v is not None])
+        if v is not None and battr.data_type is not DataType.STRING:
+            data[0] = v
+        cols.append(HostColumnVector(battr.data_type, data, validity))
+    return HostColumnarBatch(cols, 1)
+
+
+def _project_default(host_batch, specs, inter_attrs, agg_exprs, device: bool):
+    rewritten = rewrite_result_exprs(agg_exprs, specs)
+    if device:
+        dev = host_batch.to_device()
+        return DeviceProjector(bind_all(rewritten, inter_attrs)).project(dev)
+    return cpu_project(bind_all(rewritten, inter_attrs), host_batch)

@@ -1,0 +1,712 @@
+"""Join execs (reference: GpuHashJoin.scala, GpuShuffledHashJoinExec.scala,
+GpuBroadcastHashJoinExec.scala, GpuCartesianProductExec.scala).
+
+Reference parity:
+- shared join core: one built table, stream-side iteration with per-batch
+  join + optional post-join condition filter (GpuHashJoin.scala:27-230) ->
+  `_HashJoinBase` with a single build batch (RequireSingleBatch on the build
+  child) streaming probe batches.
+- shuffled hash join (both sides hash-exchanged, GpuShuffledHashJoinExec
+  :86-120) and broadcast hash join (build side collected once and reused by
+  every stream partition, GpuBroadcastHashJoinExec) -> the two exec
+  subclasses; sort-merge joins are *replaced* by shuffled hash join exactly
+  like the reference (GpuSortMergeJoinMeta, conf
+  rapids.tpu.sql.replaceSortMergeJoin.enabled).
+- cartesian/cross product (GpuCartesianProductExec.scala:59-257) ->
+  `TpuNestedLoopJoinExec` (tile/repeat composition + condition filter).
+
+TPU equi-join design (no hash table, XLA-native): dense-rank the BUILD and
+STREAM key tuples TOGETHER via union grouping (exec/rowkeys.group_ids_masked)
+so equality becomes an int32 group-id match; sort build rows by group id once
+per (stream-batch, build) pair inside the same jit; then each stream row's
+matches are the contiguous range [start[gid], start[gid]+cnt[gid]) of the
+sorted build order — an interval probe, expanded with a searchsorted-based
+output-row -> (stream row, k-th match) map. Null keys never match (SQL
+equi-join semantics); outer rows surface with count 0.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    ColumnVector,
+    HostColumnarBatch,
+    HostColumnVector,
+    bucket_capacity,
+    concat_batches,
+    gather_batch,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec import rowkeys as RK
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.exec.transitions import RequireSingleBatch
+from spark_rapids_tpu.ops.base import AttributeReference, Expression
+from spark_rapids_tpu.ops.bind import bind_all, bind_references
+from spark_rapids_tpu.ops.eval import (
+    DeviceFilter,
+    _col_to_colv,
+    cpu_filter,
+    cpu_project,
+)
+from spark_rapids_tpu.ops.values import EvalContext, ScalarV
+from spark_rapids_tpu.plan.logical import JoinType
+
+
+def _nullable(attrs: List[AttributeReference]) -> List[AttributeReference]:
+    return [AttributeReference(a.name, a.data_type, True, a.expr_id)
+            for a in attrs]
+
+
+def join_output(join_type: JoinType, left: List[AttributeReference],
+                right: List[AttributeReference]) -> List[AttributeReference]:
+    if join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+        return list(left)
+    if join_type is JoinType.LEFT_OUTER:
+        return list(left) + _nullable(right)
+    if join_type is JoinType.RIGHT_OUTER:
+        return _nullable(left) + list(right)
+    if join_type is JoinType.FULL_OUTER:
+        return _nullable(left) + _nullable(right)
+    return list(left) + list(right)
+
+
+class _JoinBase(PhysicalExec):
+    """Equi-join base. Build side is the right child except RIGHT_OUTER
+    (which builds left and streams right, preserving the stream side)."""
+
+    def __init__(self, left_keys: List[Expression],
+                 right_keys: List[Expression], join_type: JoinType,
+                 condition: Optional[Expression],
+                 left: PhysicalExec, right: PhysicalExec):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+
+    @property
+    def build_left(self) -> bool:
+        return self.join_type is JoinType.RIGHT_OUTER
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return join_output(self.join_type, self.children[0].output,
+                           self.children[1].output)
+
+    def with_children(self, new_children):
+        return type(self)(self.left_keys, self.right_keys, self.join_type,
+                          self.condition, *new_children)
+
+    def node_name(self):
+        return (f"{type(self).__name__}({self.join_type.value}, "
+                f"keys={len(self.left_keys)})")
+
+    # stream semantics: OUTER = preserve unmatched stream rows
+    @property
+    def _stream_mode(self) -> str:
+        jt = self.join_type
+        if jt is JoinType.INNER:
+            return "inner"
+        if jt in (JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
+                  JoinType.FULL_OUTER):
+            return "outer"
+        if jt is JoinType.LEFT_SEMI:
+            return "semi"
+        return "anti"
+
+
+# ===========================================================================
+# TPU equi-join kernel
+# ===========================================================================
+class _DeviceJoiner:
+    """Per-(stream schema, build schema) jitted equi-join planner."""
+
+    def __init__(self, stream_keys, build_keys, stream_attrs, build_attrs,
+                 mode: str):
+        self.bound_stream = bind_all(stream_keys, stream_attrs)
+        self.bound_build = bind_all(build_keys, build_attrs)
+        self.mode = mode
+        self._jitted = None
+
+    def _build(self):
+        bound_stream, bound_build = self.bound_stream, self.bound_build
+        mode = self.mode
+        from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+        def kernel(s_cols, s_rows, b_cols, b_rows):
+            s_cap = s_cols[0].validity.shape[0]
+            b_cap = b_cols[0].validity.shape[0]
+            s_ctx = EvalContext(jnp, True, s_cols, s_rows, s_cap)
+            b_ctx = EvalContext(jnp, True, b_cols, b_rows, b_cap)
+
+            def keys_of(ctx, bound):
+                out = []
+                for e in bound:
+                    r = e.eval(ctx)
+                    if isinstance(r, ScalarV):
+                        r = _scalar_to_colv(ctx, r, e.data_type)
+                    out.append(r)
+                return out
+
+            s_keys = keys_of(s_ctx, bound_stream)
+            b_keys = keys_of(b_ctx, bound_build)
+            cap = s_cap + b_cap
+
+            def cat(a, b):
+                if a.dtype == b.dtype:
+                    return jnp.concatenate([a, b])
+                dt = jnp.promote_types(a.dtype, b.dtype)
+                return jnp.concatenate([a.astype(dt), b.astype(dt)])
+
+            # union proxies: stream rows at [0,s_cap), build at [s_cap,cap)
+            proxies = []
+            any_null_s = jnp.zeros((s_cap,), bool)
+            any_null_b = jnp.zeros((b_cap,), bool)
+            for sk, bk in zip(s_keys, b_keys):
+                sp = RK.key_proxy(sk)
+                bp = RK.key_proxy(bk)
+                arrays = tuple(cat(a, b)
+                               for a, b in zip(sp.arrays, bp.arrays))
+                null_flag = jnp.concatenate([sp.null_flag, bp.null_flag])
+                proxies.append(RK.KeyProxy(arrays, null_flag, sp.orderable))
+                any_null_s = any_null_s | sp.null_flag
+                any_null_b = any_null_b | bp.null_flag
+
+            s_live = (jnp.arange(s_cap) < s_rows)
+            b_live = (jnp.arange(b_cap) < b_rows)
+            # null keys never match: exclude them from grouping entirely
+            s_grp = s_live & ~any_null_s
+            b_grp = b_live & ~any_null_b
+            valid = jnp.concatenate([s_grp, b_grp])
+            gi = RK.group_ids_masked(proxies, valid, cap)
+            s_gid = gi.gid[:s_cap]
+            b_gid = gi.gid[s_cap:]
+
+            # sort build rows by gid; per-gid contiguous ranges
+            b_order = jnp.argsort(jnp.where(b_grp, b_gid, cap),
+                                  stable=True).astype(jnp.int32)
+            b_cnt = jax.ops.segment_sum(
+                jnp.ones((b_cap,), jnp.int32),
+                jnp.where(b_grp, b_gid, cap), num_segments=cap)
+            b_start = jnp.concatenate([
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(b_cnt, dtype=jnp.int32)[:-1]])
+
+            s_safe_gid = jnp.where(s_grp, s_gid, cap - 1)
+            match_cnt = jnp.where(s_grp, b_cnt[s_safe_gid], 0)
+            if mode == "inner":
+                out_cnt = jnp.where(s_live, match_cnt, 0)
+            elif mode == "outer":
+                out_cnt = jnp.where(s_live, jnp.maximum(match_cnt, 1), 0)
+            elif mode == "semi":
+                out_cnt = jnp.where(s_live & (match_cnt > 0), 1, 0)
+            else:  # anti
+                out_cnt = jnp.where(s_live & (match_cnt == 0), 1, 0)
+
+            offsets = jnp.concatenate([
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(out_cnt, dtype=jnp.int32)])
+            total = offsets[-1]
+            # build-side matched flags (for full-outer tail emission)
+            s_cnt_per_gid = jax.ops.segment_sum(
+                jnp.ones((s_cap,), jnp.int32),
+                jnp.where(s_grp, s_gid, cap), num_segments=cap)
+            b_matched = b_grp & (s_cnt_per_gid[jnp.where(b_grp, b_gid, cap - 1)] > 0)
+            return (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
+                    b_matched)
+
+        return jax.jit(kernel)
+
+    def plan(self, stream: ColumnarBatch, build: ColumnarBatch):
+        if self._jitted is None:
+            self._jitted = self._build()
+        s_cols = [_col_to_colv(c) for c in stream.columns] or \
+            [_synth(stream)]
+        b_cols = [_col_to_colv(c) for c in build.columns] or [_synth(build)]
+        return self._jitted(s_cols, jnp.int32(stream.num_rows),
+                            b_cols, jnp.int32(build.num_rows))
+
+
+def _synth(batch: ColumnarBatch):
+    from spark_rapids_tpu.ops.values import ColV
+
+    cap = bucket_capacity(max(batch.num_rows, 1))
+    return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
+                jnp.arange(cap) < batch.num_rows)
+
+
+class _TpuJoinMixin:
+    """Shared device join driver for shuffled + broadcast variants."""
+
+    def _join_stream(self, stream_iter, build: ColumnarBatch,
+                     emit_build_tail: bool):
+        st = self  # typing: _JoinBase subclass
+        build_left = st.build_left
+        stream_child = 1 if build_left else 0
+        build_child = 0 if build_left else 1
+        stream_attrs = st.children[stream_child].output
+        build_attrs = st.children[build_child].output
+        stream_keys = st.right_keys if build_left else st.left_keys
+        build_keys = st.left_keys if build_left else st.right_keys
+        mode = st._stream_mode
+        joiner = _DeviceJoiner(stream_keys, build_keys, stream_attrs,
+                               build_attrs, mode)
+        emit_build_cols = mode in ("inner", "outer")
+        cond_filter = None
+        if st.condition is not None:
+            bound_cond = bind_references(st.condition,
+                                         st._joined_attrs())
+            cond_filter = DeviceFilter(bound_cond)
+
+        b_matched_acc = None
+        for stream_batch in stream_iter:
+            if stream_batch.num_rows == 0:
+                continue
+            (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
+             b_matched) = joiner.plan(stream_batch, build)
+            if b_matched_acc is None:
+                b_matched_acc = b_matched
+            else:
+                b_matched_acc = b_matched_acc | b_matched
+            n_out = int(jax.device_get(total))
+            if n_out == 0:
+                continue
+            out_cap = bucket_capacity(n_out)
+            s_idx, b_idx, live = _expand_full(offsets, b_order, b_start,
+                                              s_safe_gid, match_cnt, out_cap)
+            s_out = gather_batch(stream_batch, s_idx, n_out)
+            if emit_build_cols:
+                b_valid = b_idx >= 0
+                b_out = gather_batch(build, jnp.where(b_valid, b_idx, 0),
+                                     n_out, indices_valid=b_valid)
+                cols = (b_out.columns + s_out.columns) if build_left \
+                    else (s_out.columns + b_out.columns)
+                joined = ColumnarBatch(cols, n_out)
+            else:
+                joined = s_out
+            if cond_filter is not None:
+                joined = cond_filter.apply(joined)
+            yield joined
+
+        if emit_build_tail and build.num_rows > 0:
+            # full outer: unmatched build rows with null stream columns
+            if b_matched_acc is None:
+                b_matched_acc = jnp.zeros((build.capacity,), bool)
+            unmatched = (~np.asarray(jax.device_get(b_matched_acc))) & \
+                (np.arange(build.capacity) < build.num_rows)
+            rows = np.nonzero(unmatched)[0]
+            if len(rows) == 0:
+                return
+            n_out = len(rows)
+            idx_cap = bucket_capacity(n_out)
+            idx = np.zeros(idx_cap, dtype=np.int32)
+            idx[:n_out] = rows
+            b_out = gather_batch(build, jnp.asarray(idx), n_out)
+            # full outer always builds right / streams left: output is
+            # null left columns ++ the unmatched build rows
+            cols = (_null_batch(self.children[0].output, n_out).columns +
+                    b_out.columns)
+            yield ColumnarBatch(cols, n_out)
+
+    def _joined_attrs(self) -> List[AttributeReference]:
+        return self.children[0].output + self.children[1].output
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _expand_full(offsets, b_order, b_start, s_safe_gid, match_cnt,
+                 out_cap: int):
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    s_row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    s_cap = s_safe_gid.shape[0]
+    s_row = jnp.clip(s_row, 0, s_cap - 1)
+    k = pos - offsets[s_row]
+    has_match = match_cnt[s_row] > 0
+    b_pos = b_start[s_safe_gid[s_row]] + k
+    b_cap = b_order.shape[0]
+    b_row = jnp.where(has_match, b_order[jnp.clip(b_pos, 0, b_cap - 1)],
+                      jnp.int32(-1))
+    live = pos < offsets[-1]
+    return jnp.where(live, s_row, 0), jnp.where(live, b_row, -1), live
+
+
+def _null_batch(attrs: List[AttributeReference], n_rows: int) -> ColumnarBatch:
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    cap = bucket_capacity(max(n_rows, 1))
+    cols = []
+    for a in attrs:
+        validity = jnp.zeros((cap,), bool)
+        if a.data_type is DataType.STRING:
+            cols.append(ColumnVector(
+                a.data_type, jnp.zeros((8,), jnp.uint8), validity,
+                jnp.zeros((cap + 1,), jnp.int32)))
+        else:
+            npdt = physical_np_dtype(a.data_type)
+            cols.append(ColumnVector(a.data_type, jnp.zeros((cap,), npdt),
+                                     validity))
+    return ColumnarBatch(cols, n_rows)
+
+
+class TpuShuffledHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
+    placement = "tpu"
+
+    @property
+    def children_coalesce_goal(self):
+        if self.build_left:
+            return [RequireSingleBatch(), None]
+        return [None, RequireSingleBatch()]
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        left_pb = self.children[0].execute(ctx)
+        right_pb = self.children[1].execute(ctx)
+        build_pb = left_pb if self.build_left else right_pb
+        stream_pb = right_pb if self.build_left else left_pb
+        emit_tail = self.join_type is JoinType.FULL_OUTER
+
+        def factory(pidx: int):
+            builds = [b for b in build_pb.iterator(pidx) if b.num_rows > 0]
+            if builds:
+                build = builds[0] if len(builds) == 1 else \
+                    concat_batches(builds)
+            else:
+                build = _null_batch(
+                    self.children[0 if self.build_left else 1].output, 0)
+            it = self._join_stream(stream_pb.iterator(pidx), build, emit_tail)
+            return count_output(self.metrics, it)
+
+        return PartitionedBatches(stream_pb.num_partitions, factory)
+
+
+class TpuBroadcastHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
+    """Build side materialized ONCE (all partitions concatenated) and reused
+    by every stream partition (reference: GpuBroadcastHashJoinExec +
+    GpuBroadcastExchangeExec collect/broadcast)."""
+
+    placement = "tpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        build_child = 0 if self.build_left else 1
+        stream_child = 1 - build_child
+        build_pb = self.children[build_child].execute(ctx)
+        stream_pb = self.children[stream_child].execute(ctx)
+
+        def collect_build(pidx: int):
+            return [b for b in build_pb.iterator(pidx) if b.num_rows > 0]
+
+        if ctx.scheduler is not None:
+            parts = ctx.scheduler.run_job(build_pb.num_partitions,
+                                          collect_build)
+        else:
+            parts = [collect_build(p) for p in range(build_pb.num_partitions)]
+        batches = [b for part in parts for b in part]
+        if batches:
+            build = batches[0] if len(batches) == 1 else \
+                concat_batches(batches)
+        else:
+            build = _null_batch(self.children[build_child].output, 0)
+        emit_tail = self.join_type is JoinType.FULL_OUTER
+
+        def factory(pidx: int):
+            it = self._join_stream(stream_pb.iterator(pidx), build, emit_tail)
+            return count_output(self.metrics, it)
+
+        return PartitionedBatches(stream_pb.num_partitions, factory)
+
+
+class TpuNestedLoopJoinExec(_JoinBase, TpuExec):
+    """Cross/cartesian product with optional condition (reference:
+    GpuCartesianProductExec / GpuBroadcastNestedLoopJoinExec). The right
+    side is materialized once; per stream batch the product expands via a
+    repeat/tile index composition."""
+
+    placement = "tpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        left_pb = self.children[0].execute(ctx)
+        right_pb = self.children[1].execute(ctx)
+
+        def collect_right(pidx: int):
+            return [b for b in right_pb.iterator(pidx) if b.num_rows > 0]
+
+        if ctx.scheduler is not None:
+            parts = ctx.scheduler.run_job(right_pb.num_partitions,
+                                          collect_right)
+        else:
+            parts = [collect_right(p) for p in range(right_pb.num_partitions)]
+        batches = [b for part in parts for b in part]
+        build = concat_batches(batches) if batches else \
+            _null_batch(self.children[1].output, 0)
+        cond_filter = None
+        if self.condition is not None:
+            cond_filter = DeviceFilter(
+                bind_references(self.condition, self._joined_attrs()))
+
+        def factory(pidx: int):
+            def gen():
+                for sb in left_pb.iterator(pidx):
+                    if sb.num_rows == 0 or build.num_rows == 0:
+                        continue
+                    n_out = sb.num_rows * build.num_rows
+                    cap = bucket_capacity(n_out)
+                    pos = jnp.arange(cap, dtype=jnp.int32)
+                    s_idx = pos // build.num_rows
+                    b_idx = pos % build.num_rows
+                    s_out = gather_batch(sb, s_idx, n_out)
+                    b_out = gather_batch(build, b_idx, n_out)
+                    joined = ColumnarBatch(s_out.columns + b_out.columns,
+                                           n_out)
+                    if cond_filter is not None:
+                        joined = cond_filter.apply(joined)
+                    yield joined
+
+            return count_output(self.metrics, gen())
+
+        return PartitionedBatches(left_pb.num_partitions, factory)
+
+    def _joined_attrs(self):
+        return self.children[0].output + self.children[1].output
+
+
+# ===========================================================================
+# CPU oracle joins
+# ===========================================================================
+def _host_key(dtype: DataType, v, valid: bool):
+    if not valid:
+        return None  # sentinel; null keys never match
+    if dtype in (DataType.FLOAT32, DataType.FLOAT64):
+        f = float(v)
+        if f != f:
+            return ("NaN",)
+        return 0.0 if f == 0.0 else f
+    if dtype is DataType.STRING:
+        return str(v)
+    if dtype is DataType.BOOL:
+        return bool(v)
+    return int(v)
+
+
+class CpuShuffledHashJoinExec(_JoinBase, CpuExec):
+    placement = "cpu"
+
+    broadcast = False
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        left_pb = self.children[0].execute(ctx)
+        right_pb = self.children[1].execute(ctx)
+        build_left = self.build_left
+        build_pb = left_pb if build_left else right_pb
+        stream_pb = right_pb if build_left else left_pb
+
+        if self.broadcast:
+            def collect(pidx: int):
+                return list(build_pb.iterator(pidx))
+
+            if ctx.scheduler is not None:
+                parts = ctx.scheduler.run_job(build_pb.num_partitions, collect)
+            else:
+                parts = [collect(p) for p in range(build_pb.num_partitions)]
+            all_build = [b for part in parts for b in part if b.num_rows > 0]
+
+        def factory(pidx: int):
+            if self.broadcast:
+                builds = all_build
+            else:
+                builds = [b for b in build_pb.iterator(pidx)
+                          if b.num_rows > 0]
+            return count_output(
+                self.metrics,
+                self._join_partition(pidx, stream_pb.iterator(pidx), builds))
+
+        return PartitionedBatches(stream_pb.num_partitions, factory)
+
+    def _join_partition(self, pidx, stream_iter, builds):
+        build_left = self.build_left
+        stream_child = 1 if build_left else 0
+        build_child = 0 if build_left else 1
+        stream_attrs = self.children[stream_child].output
+        build_attrs = self.children[build_child].output
+        stream_keys = self.right_keys if build_left else self.left_keys
+        build_keys = self.left_keys if build_left else self.right_keys
+        mode = self._stream_mode
+        emit_build = mode in ("inner", "outer")
+        full_outer = self.join_type is JoinType.FULL_OUTER
+
+        build_batch = _concat_host(builds, build_attrs)
+        bkeys = cpu_project(bind_all(build_keys, build_attrs), build_batch,
+                            partition_id=pidx)
+        table: dict = {}
+        for i in range(build_batch.num_rows):
+            key = tuple(
+                _host_key(build_keys[c].data_type, bkeys.columns[c].data[i],
+                          bool(bkeys.columns[c].validity[i]))
+                for c in range(len(build_keys)))
+            if any(k is None for k in key):
+                continue
+            table.setdefault(key, []).append(i)
+        b_matched = np.zeros(build_batch.num_rows, dtype=bool)
+
+        bound_skeys = bind_all(stream_keys, stream_attrs)
+        for sb in stream_iter:
+            if sb.num_rows == 0:
+                continue
+            skeys = cpu_project(bound_skeys, sb, partition_id=pidx)
+            s_idx: List[int] = []
+            b_idx: List[int] = []
+            for i in range(sb.num_rows):
+                key = tuple(
+                    _host_key(stream_keys[c].data_type,
+                              skeys.columns[c].data[i],
+                              bool(skeys.columns[c].validity[i]))
+                    for c in range(len(stream_keys)))
+                matches = [] if any(k is None for k in key) else \
+                    table.get(key, [])
+                if matches:
+                    for m in matches:
+                        b_matched[m] = True
+                    if mode == "semi":
+                        s_idx.append(i)
+                        b_idx.append(-1)
+                    elif mode == "anti":
+                        pass
+                    else:
+                        for m in matches:
+                            s_idx.append(i)
+                            b_idx.append(m)
+                else:
+                    if mode == "outer" or mode == "anti":
+                        s_idx.append(i)
+                        b_idx.append(-1)
+            if not s_idx:
+                continue
+            out = self._emit_host(sb, build_batch, s_idx, b_idx, emit_build,
+                                  build_left, stream_attrs, build_attrs)
+            if self.condition is not None and mode == "inner":
+                out = cpu_filter(
+                    bind_references(self.condition,
+                                    self.children[0].output +
+                                    self.children[1].output), out)
+            yield out
+
+        if full_outer:
+            rows = [i for i in range(build_batch.num_rows) if not b_matched[i]]
+            if rows:
+                out = self._emit_host(None, build_batch,
+                                      [-1] * len(rows), rows, True,
+                                      build_left, stream_attrs, build_attrs)
+                yield out
+
+    def _emit_host(self, sb, build_batch, s_idx, b_idx, emit_build,
+                   build_left, stream_attrs, build_attrs):
+        s_cols = _host_gather(sb, stream_attrs, s_idx)
+        if not emit_build:
+            return HostColumnarBatch(s_cols, len(s_idx))
+        b_cols = _host_gather(build_batch, build_attrs, b_idx)
+        cols = (b_cols + s_cols) if build_left else (s_cols + b_cols)
+        return HostColumnarBatch(cols, len(s_idx))
+
+
+class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
+    broadcast = True
+
+
+class CpuNestedLoopJoinExec(_JoinBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        left_pb = self.children[0].execute(ctx)
+        right_pb = self.children[1].execute(ctx)
+
+        def collect(pidx: int):
+            return list(right_pb.iterator(pidx))
+
+        if ctx.scheduler is not None:
+            parts = ctx.scheduler.run_job(right_pb.num_partitions, collect)
+        else:
+            parts = [collect(p) for p in range(right_pb.num_partitions)]
+        batches = [b for part in parts for b in part if b.num_rows > 0]
+        build = _concat_host(batches, self.children[1].output)
+
+        def factory(pidx: int):
+            def gen():
+                for sb in left_pb.iterator(pidx):
+                    if sb.num_rows == 0 or build.num_rows == 0:
+                        continue
+                    s_idx = [i for i in range(sb.num_rows)
+                             for _ in range(build.num_rows)]
+                    b_idx = list(range(build.num_rows)) * sb.num_rows
+                    cols = _host_gather(sb, self.children[0].output, s_idx) + \
+                        _host_gather(build, self.children[1].output, b_idx)
+                    out = HostColumnarBatch(cols, len(s_idx))
+                    if self.condition is not None:
+                        out = cpu_filter(
+                            bind_references(
+                                self.condition,
+                                self.children[0].output +
+                                self.children[1].output), out)
+                    yield out
+
+            return count_output(self.metrics, gen())
+
+        return PartitionedBatches(left_pb.num_partitions, factory)
+
+
+def _concat_host(batches: List[HostColumnarBatch],
+                 attrs: List[AttributeReference]) -> HostColumnarBatch:
+    if not batches:
+        cols = [
+            HostColumnVector(
+                a.data_type,
+                np.zeros(0, dtype=a.data_type.to_np()),
+                np.zeros(0, dtype=bool))
+            for a in attrs
+        ]
+        return HostColumnarBatch(cols, 0)
+    if len(batches) == 1:
+        return batches[0]
+    cols = []
+    for c in range(batches[0].num_columns):
+        data = np.concatenate([b.columns[c].data for b in batches])
+        validity = np.concatenate([b.columns[c].validity for b in batches])
+        cols.append(HostColumnVector(batches[0].columns[c].dtype, data,
+                                     validity))
+    return HostColumnarBatch(cols, sum(b.num_rows for b in batches))
+
+
+def _host_gather(batch: Optional[HostColumnarBatch],
+                 attrs: List[AttributeReference],
+                 idx: List[int]) -> List[HostColumnVector]:
+    n = len(idx)
+    out = []
+    for c, a in enumerate(attrs):
+        npdt = a.data_type.to_np()
+        data = np.zeros(n, dtype=npdt)
+        validity = np.zeros(n, dtype=bool)
+        if a.data_type is DataType.STRING:
+            data[:] = ""
+        if batch is not None:
+            src = batch.columns[c]
+            for j, i in enumerate(idx):
+                if i >= 0:
+                    data[j] = src.data[i]
+                    validity[j] = src.validity[i]
+        return_col = HostColumnVector(a.data_type, data, validity)
+        out.append(return_col)
+    return out

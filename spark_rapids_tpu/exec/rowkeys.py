@@ -1,0 +1,287 @@
+"""Device row-key kernels: sort permutations and group-id assignment.
+
+These are the TPU-native replacements for the cudf primitives the reference
+leans on everywhere (`Table.orderBy` for GpuSortExec.scala:100-235,
+`Table.groupBy` for aggregate.scala:728, `Table.onColumns(keys).innerJoin`
+for GpuHashJoin.scala:27-230). On TPU the idiomatic composition is:
+
+- sort: iterated stable `argsort` passes (least-significant key first), which
+  XLA lowers to its sort HLO — no hand-written comparator needed;
+- groupby: sort rows by key, mark segment boundaries by neighbor inequality,
+  dense group ids via prefix-sum, then `jax.ops.segment_*` reductions;
+- join: dense-rank both sides' keys TOGETHER (union grouping), then the join
+  becomes an int32-key searchsorted interval probe (exec/join.py).
+
+Key *proxies*: every key column is reduced to one or more numeric arrays on
+which equality (and, for orderable types, order) agrees with SQL semantics:
+
+- integral/bool/date/timestamp: the data itself (nulls zeroed by convention,
+  null flag carried separately);
+- floats: total-order uint32 bit trick (-0.0 == 0.0, all NaNs equal, NaN
+  sorts greater than all numbers, matching Spark's NaN ordering);
+- strings: double 32-bit polynomial hash + byte length — EQUALITY-ONLY
+  proxies (grouping/joining on strings is exact up to a ~2^-60 collision
+  probability; lexicographic device string sort is not provided yet, so sorts
+  on string keys fall back to the CPU engine via tagging).
+
+All functions here take padded device arrays + a traced `num_rows` and are
+jit-safe. Padded rows always sort to the end and get group id = capacity
+(dropped by segment reductions with num_segments=capacity).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops import hashing as H
+from spark_rapids_tpu.ops.values import ColV
+
+
+class KeyProxy(NamedTuple):
+    """Numeric stand-ins for one key column."""
+
+    arrays: Tuple[Any, ...]   # uint32/int arrays; order-significant first
+    null_flag: Any            # bool array, True where SQL NULL
+    orderable: bool           # arrays reflect sort order, not just equality
+
+
+def _float_order_bits(data) -> Any:
+    """Map float32 to uint32 preserving total order: -NaN < -inf < ... <
+    -0.0 == 0.0 < ... < inf < NaN, with all NaNs canonicalized to +NaN
+    (Spark sorts NaN greater than any value)."""
+    f32 = data.astype(jnp.float32)
+    f32 = jnp.where(f32 == 0.0, jnp.zeros((), jnp.float32), f32)
+    f32 = jnp.where(jnp.isnan(f32), jnp.full((), jnp.nan, jnp.float32), f32)
+    bits = f32.view(jnp.uint32)
+    sign = (bits >> jnp.uint32(31)).astype(bool)
+    flipped = jnp.where(sign, ~bits, bits | jnp.uint32(0x80000000))
+    return flipped.astype(jnp.uint32)
+
+
+def key_proxy(col: ColV) -> KeyProxy:
+    """Null lanes are canonicalized to zero so all SQL NULLs compare equal
+    regardless of whatever data the producing kernel left behind."""
+    dt = col.dtype
+    if dt in (DataType.FLOAT32, DataType.FLOAT64):
+        bits = _float_order_bits(col.data)
+        bits = jnp.where(col.validity, bits, jnp.uint32(0))
+        return KeyProxy((bits,), ~col.validity, True)
+    if dt is DataType.STRING:
+        h1, h2, ln = H._string_words_device(col)
+        return KeyProxy((h1, h2, ln), ~col.validity, False)
+    if dt is DataType.BOOL:
+        data = jnp.where(col.validity, col.data, False).astype(jnp.int32)
+        return KeyProxy((data,), ~col.validity, True)
+    # integral / date / timestamp
+    data = jnp.where(col.validity, col.data, jnp.zeros((), col.data.dtype))
+    return KeyProxy((data,), ~col.validity, True)
+
+
+def sort_permutation(proxies: Sequence[KeyProxy],
+                     directions: Sequence[Tuple[bool, bool]],
+                     num_rows, capacity: int):
+    """Stable lexicographic sort permutation (int32 [capacity]).
+
+    directions[i] = (ascending, nulls_first) for proxies[i]. Requires every
+    proxy to be orderable. Padded rows land at the end.
+    """
+    order = jnp.arange(capacity, dtype=jnp.int32)
+    # least-significant key first; each key = value passes then a null pass
+    for proxy, (ascending, nulls_first) in zip(reversed(list(proxies)),
+                                               reversed(list(directions))):
+        assert proxy.orderable, "sort on equality-only key proxy"
+        for arr in reversed(proxy.arrays):
+            vals = arr[order]
+            order = order[jnp.argsort(vals, stable=True,
+                                      descending=not ascending)]
+        nf = proxy.null_flag[order]
+        order = order[jnp.argsort(nf, stable=True, descending=nulls_first)]
+    pad = order >= num_rows
+    order = order[jnp.argsort(pad, stable=True)]
+    return order
+
+
+def group_sort_permutation(proxies: Sequence[KeyProxy], num_rows,
+                           capacity: int):
+    """Permutation clustering equal keys together (any consistent order;
+    equality-only proxies allowed). Nulls group together (SQL GROUP BY)."""
+    return group_sort_permutation_masked(
+        proxies, jnp.arange(capacity) < num_rows, capacity)
+
+
+def group_sort_permutation_masked(proxies: Sequence[KeyProxy], valid_mask,
+                                  capacity: int):
+    """Like group_sort_permutation but with an arbitrary row-validity mask
+    (used by the join's union grouping where live rows are interleaved)."""
+    order = jnp.arange(capacity, dtype=jnp.int32)
+    for proxy in reversed(list(proxies)):
+        for arr in reversed(proxy.arrays):
+            order = order[jnp.argsort(arr[order], stable=True)]
+        order = order[jnp.argsort(proxy.null_flag[order], stable=True)]
+    pad = ~valid_mask[order]
+    order = order[jnp.argsort(pad, stable=True)]
+    return order
+
+
+def _neighbor_differs(proxies: Sequence[KeyProxy], order) -> Any:
+    """sorted-position i>0: does row order[i] differ from row order[i-1] in
+    any key (value or null flag)?"""
+    cap = order.shape[0]
+    prev = jnp.concatenate([order[:1], order[:-1]])
+    diff = jnp.zeros((cap,), dtype=bool)
+    for proxy in proxies:
+        for arr in proxy.arrays:
+            diff = diff | (arr[order] != arr[prev])
+        diff = diff | (proxy.null_flag[order] != proxy.null_flag[prev])
+    return diff.at[0].set(True)
+
+
+class GroupInfo(NamedTuple):
+    """Result of group_ids: everything a segment reduction needs."""
+
+    gid: Any         # int32 [capacity]; group id per original row; pads -> capacity
+    num_groups: Any  # traced int32 scalar
+    rep_rows: Any    # int32 [capacity]; original row index of each group's
+                     # first (in sorted order) member; slots >= num_groups = 0
+
+
+def group_ids(proxies: Sequence[KeyProxy], num_rows, capacity: int) -> GroupInfo:
+    return group_ids_masked(proxies, jnp.arange(capacity) < num_rows, capacity)
+
+
+def group_ids_masked(proxies: Sequence[KeyProxy], valid_mask,
+                     capacity: int) -> GroupInfo:
+    order = group_sort_permutation_masked(proxies, valid_mask, capacity)
+    valid_sorted = valid_mask[order]
+    boundary = _neighbor_differs(proxies, order) & valid_sorted
+    # the first valid row always starts a group even if it equals a pad row
+    first_valid = valid_sorted & (jnp.cumsum(valid_sorted.astype(jnp.int32)) == 1)
+    boundary = boundary | first_valid
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    gid_sorted = jnp.where(valid_sorted, gid_sorted, capacity)
+    gid = jnp.zeros((capacity,), jnp.int32).at[order].set(gid_sorted)
+    gid = jnp.where(valid_mask, gid, capacity)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    rep_rows = jnp.zeros((capacity,), jnp.int32).at[
+        jnp.where(boundary, gid_sorted, capacity)
+    ].set(order, mode="drop")
+    return GroupInfo(gid, num_groups, rep_rows)
+
+
+# ---------------------------------------------------------------------------
+# Segment reductions (the cudf groupby-aggregate analog)
+# ---------------------------------------------------------------------------
+def _seg_ids(gid, validity, capacity: int):
+    """Segment ids restricted to non-null input rows (SQL aggs skip nulls)."""
+    return jnp.where(validity, gid, capacity)
+
+
+def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
+    """Reduce `data` per group with SQL null semantics.
+
+    Returns (out_data [capacity], out_validity [capacity]) where slot g holds
+    group g's result. All-null (or empty) groups -> null, except count -> 0.
+    first/last follow encounter order in the ORIGINAL row order, matching the
+    reference's First/Last aggregates.
+    """
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    in_group = gid < capacity  # real (non-pad) rows
+    if op == "count":
+        seg = _seg_ids(gid, validity & in_group, capacity)
+        ones = jnp.ones((capacity,), jnp.int64)
+        cnt = jax.ops.segment_sum(jnp.where(seg < capacity, ones, 0), seg,
+                                  num_segments=capacity)
+        return cnt, jnp.ones((capacity,), bool)
+    if op in ("sum", "min", "max", "any"):
+        seg = _seg_ids(gid, validity & in_group, capacity)
+        nonnull = jax.ops.segment_sum(
+            (seg < capacity).astype(jnp.int32), seg, num_segments=capacity)
+        outv = nonnull > 0
+        if op == "sum":
+            out = jax.ops.segment_sum(jnp.where(seg < capacity, data, 0), seg,
+                                      num_segments=capacity)
+        elif op == "any":
+            out = jax.ops.segment_max(
+                jnp.where(seg < capacity, data.astype(jnp.int32), 0), seg,
+                num_segments=capacity).astype(bool)
+        elif op in ("min", "max"):
+            if jnp.dtype(data.dtype).kind == "f":
+                # reduce on total-order bits so NaN sorts greater than every
+                # number (Spark semantics: min skips NaN unless all-NaN)
+                bits = _float_order_bits(data)
+                if op == "min":
+                    r = jax.ops.segment_min(
+                        jnp.where(seg < capacity, bits, jnp.uint32(0xFFFFFFFF)),
+                        seg, num_segments=capacity)
+                else:
+                    r = jax.ops.segment_max(
+                        jnp.where(seg < capacity, bits, jnp.uint32(0)),
+                        seg, num_segments=capacity)
+                out = _float_from_order_bits(r).astype(data.dtype)
+            elif op == "min":
+                out = jax.ops.segment_min(_mask_for_min(data, seg, capacity),
+                                          seg, num_segments=capacity)
+            else:
+                out = jax.ops.segment_max(_mask_for_max(data, seg, capacity),
+                                          seg, num_segments=capacity)
+        out = jnp.where(outv, out, jnp.zeros((), out.dtype))
+        return out, outv
+    if op in ("first", "last", "first_ignore_nulls", "last_ignore_nulls"):
+        consider = in_group
+        if op.endswith("ignore_nulls"):
+            consider = consider & validity
+        seg = jnp.where(consider, gid, capacity)
+        if op.startswith("first"):
+            sel_pos = jax.ops.segment_min(
+                jnp.where(consider, pos, capacity), seg, num_segments=capacity)
+        else:
+            sel_pos = jax.ops.segment_max(
+                jnp.where(consider, pos, -1), seg, num_segments=capacity)
+        has = (sel_pos >= 0) & (sel_pos < capacity)
+        safe = jnp.clip(sel_pos, 0, capacity - 1)
+        out = jnp.where(has, data[safe], jnp.zeros((), data.dtype))
+        outv = jnp.where(has, validity[safe], False)
+        return out, outv
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def _mask_for_min(data, seg, capacity: int):
+    big = _type_max(data.dtype)
+    return jnp.where(seg < capacity, data, big)
+
+
+def _mask_for_max(data, seg, capacity: int):
+    small = _type_min(data.dtype)
+    return jnp.where(seg < capacity, data, small)
+
+
+def _float_from_order_bits(flipped):
+    """Inverse of _float_order_bits (modulo -0.0/NaN canonicalization)."""
+    top = (flipped & jnp.uint32(0x80000000)) != 0
+    bits = jnp.where(top, flipped ^ jnp.uint32(0x80000000), ~flipped)
+    return bits.view(jnp.float32)
+
+
+def _type_max(dtype):
+    dtype = jnp.dtype(dtype)
+    if dtype.kind == "f":
+        return jnp.array(jnp.inf, dtype)
+    if dtype.kind == "b":
+        return jnp.array(True)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _type_min(dtype):
+    dtype = jnp.dtype(dtype)
+    if dtype.kind == "f":
+        return jnp.array(-jnp.inf, dtype)
+    if dtype.kind == "b":
+        return jnp.array(False)
+    return jnp.array(jnp.iinfo(dtype).min, dtype)

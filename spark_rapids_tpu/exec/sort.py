@@ -1,0 +1,146 @@
+"""Sort execs (reference: GpuSortExec.scala, 235 LoC).
+
+Reference parity:
+- per-partition GPU sort via cudf `Table.orderBy` (GpuSortExec.scala:100-235)
+  -> `TpuSortExec`: one jitted multi-key stable argsort composition
+  (exec/rowkeys.sort_permutation — XLA's sort HLO) + row gather.
+- global sort = range-partition exchange + per-partition sort with
+  `RequireSingleBatch` (GpuSortExec.scala:50-98) -> planner composition in
+  plan/planner.py; this exec always requires a single input batch per
+  partition so the partition is totally ordered.
+
+Device string ordering is not implemented yet (strings have equality-only
+key proxies); sorts on string keys are tagged off the TPU and run on the
+CPU oracle exec instead.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    HostColumnarBatch,
+    HostColumnVector,
+    gather_batch,
+)
+from spark_rapids_tpu.exec import rowkeys as RK
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.exec.transitions import RequireSingleBatch
+from spark_rapids_tpu.ops.base import AttributeReference, SortOrder
+from spark_rapids_tpu.ops.bind import bind_sort_orders
+from spark_rapids_tpu.ops.eval import _col_to_colv, _host_to_colv, cpu_project
+from spark_rapids_tpu.ops.values import EvalContext, ScalarV
+
+
+class _SortBase(PhysicalExec):
+    def __init__(self, orders: List[SortOrder], child: PhysicalExec):
+        super().__init__(child)
+        self.orders = list(orders)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return type(self)(self.orders, new_children[0])
+
+    @property
+    def children_coalesce_goal(self):
+        # the whole partition must be one batch for a total partition order
+        return [RequireSingleBatch()]
+
+    def node_name(self):
+        return f"{type(self).__name__}{[repr(o) for o in self.orders]}"
+
+
+class TpuSortExec(_SortBase, TpuExec):
+    placement = "tpu"
+
+    def _build_kernel(self, input_attrs):
+        bound = bind_sort_orders(self.orders, input_attrs)
+        directions = [(o.ascending, o.nulls_first) for o in bound]
+        from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+        def kernel(cols, num_rows):
+            capacity = cols[0].validity.shape[0]
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+            proxies = []
+            for o in bound:
+                r = o.child.eval(ctx)
+                if isinstance(r, ScalarV):
+                    r = _scalar_to_colv(ctx, r, o.child.data_type)
+                proxies.append(RK.key_proxy(r))
+            return RK.sort_permutation(proxies, directions, num_rows, capacity)
+
+        return jax.jit(kernel)
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        kernel = [None]
+
+        def sort_partition(pidx: int):
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    yield batch
+                    continue
+                if kernel[0] is None:
+                    kernel[0] = self._build_kernel(child_attrs)
+                cols = [_col_to_colv(c) for c in batch.columns]
+                perm = kernel[0](cols, jnp.int32(batch.num_rows))
+                yield gather_batch(batch, perm, batch.num_rows)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, sort_partition(pidx))
+
+        return PartitionedBatches(child_pb.num_partitions, factory)
+
+
+class CpuSortExec(_SortBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        bound = bind_sort_orders(self.orders, child_attrs)
+
+        def sort_partition(pidx: int):
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    yield batch
+                    continue
+                ev = cpu_project([o.child for o in bound], batch,
+                                 partition_id=pidx)
+                from spark_rapids_tpu.shuffle.exchange import _order_key
+
+                keys = [c.to_pylist() for c in ev.columns]
+                idx = sorted(
+                    range(batch.num_rows),
+                    key=lambda i: tuple(
+                        _order_key(kc[i], o)
+                        for kc, o in zip(keys, self.orders)))
+                sel = np.array(idx, dtype=np.int64)
+                cols = [
+                    HostColumnVector(c.dtype, c.data[sel], c.validity[sel])
+                    for c in batch.columns
+                ]
+                yield HostColumnarBatch(cols, batch.num_rows)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, sort_partition(pidx))
+
+        return PartitionedBatches(child_pb.num_partitions, factory)

@@ -71,6 +71,12 @@ class AggregateFunction(Expression):
         """Result value for empty ungrouped reduction (None = SQL NULL)."""
         return None
 
+    def initial_buffer_values(self) -> List:
+        """Buffer values for the empty ungrouped reduction (the reference's
+        initialValues expression trees, AggregateFunctions.scala:253-533).
+        One entry per buffer attr; None = SQL NULL."""
+        return [None] * len(self.buffer_attrs())
+
     def eval_kernel(self, ctx, *vals):
         raise RuntimeError("aggregate functions evaluate via the agg exec")
 
@@ -166,6 +172,9 @@ class Count(AggregateFunction):
     def default_value(self):
         return 0
 
+    def initial_buffer_values(self):
+        return [0]
+
 
 class Average(AggregateFunction):
     @property
@@ -191,8 +200,12 @@ class Average(AggregateFunction):
 
     def evaluate_expression(self, buffers):
         from spark_rapids_tpu.ops.arithmetic import Divide
+        from spark_rapids_tpu.ops.cast import Cast
 
-        return Divide(buffers[0], buffers[1])
+        return Divide(buffers[0], Cast(buffers[1], DataType.FLOAT64))
+
+    def initial_buffer_values(self):
+        return [None, 0]
 
 
 class First(AggregateFunction):

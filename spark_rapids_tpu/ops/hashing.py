@@ -1,0 +1,201 @@
+"""Column hashing for hash partitioning and key grouping.
+
+Reference parity: GpuHashPartitioning.scala computes a cudf murmur3 hash that
+is bit-compatible with Spark's CPU Murmur3Hash so CPU and GPU stages can
+co-partition. This framework owns BOTH engines (numpy oracle + TPU), so the
+requirement degrades to *internal* consistency: the same engine must hash
+equal keys equally. We implement a murmur3-style finalizer-based mix that is
+identical across the numpy and jnp paths (same uint32 arithmetic), so even
+mixed CPU/TPU plans co-partition.
+
+All arithmetic is uint32 with wraparound, expressible identically in numpy
+and jax.numpy. Strings hash via a 31/1000003 double polynomial accumulated
+bytewise on the device representation (offsets+bytes) using a
+searchsorted-based byte->row map, and via Python bytes on the host path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.values import ColV
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_GOLDEN = np.uint32(0x9E3779B9)
+
+HASH_SEED = np.uint32(42)  # Spark's default seed (reference: Murmur3Hash)
+
+
+def _rotl32(xp, x, r: int):
+    x = x.astype(np.uint32) if hasattr(x, "astype") else np.uint32(x)
+    return ((x << np.uint32(r)) | (x >> np.uint32(32 - r))).astype(np.uint32)
+
+
+def _fmix32(xp, h):
+    h = h.astype(np.uint32)
+    h = h ^ (h >> np.uint32(16))
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h = h ^ (h >> np.uint32(13))
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def _mix_k1(xp, k1):
+    k1 = (k1.astype(np.uint32) * _C1).astype(np.uint32)
+    k1 = _rotl32(xp, k1, 15)
+    return (k1 * _C2).astype(np.uint32)
+
+
+def _mix_h1(xp, h1, k1):
+    h1 = h1 ^ _mix_k1(xp, k1)
+    h1 = _rotl32(xp, h1, 13)
+    return (h1.astype(np.uint32) * np.uint32(5) + np.uint32(0xE6546B64)).astype(
+        np.uint32)
+
+
+def _as_u32(xp, arr):
+    """Reinterpret/convert an integer array to uint32 words (low 32 bits)."""
+    return (arr.astype(np.int64) & np.int64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def _canonical_float_bits(xp, data, dtype: DataType):
+    """f32 bit pattern with -0.0 -> +0.0 and all NaNs canonical, widened/
+    narrowed from the physical dtype. f64 on the oracle path hashes by its
+    f32-narrowed value so CPU and TPU co-partition DOUBLE keys."""
+    f32 = data.astype(np.float32)
+    f32 = xp.where(f32 == 0.0, xp.zeros((), np.float32), f32)  # -0.0 -> 0.0
+    nan = xp.isnan(f32)
+    bits = f32.view(np.uint32)
+    canonical_nan = np.uint32(0x7FC00000)
+    return xp.where(nan, canonical_nan, bits).astype(np.uint32)
+
+
+def column_words(xp, col: ColV) -> List[Any]:
+    """Decompose a (non-string) column into a list of uint32 word arrays.
+    Null rows contribute the word 0 (data is zeroed at nulls by convention,
+    and the null flag is mixed separately by hash_columns)."""
+    dt = col.dtype
+    data = col.data
+    if dt is DataType.BOOL:
+        return [data.astype(np.uint32)]
+    if dt in (DataType.INT8, DataType.INT16, DataType.INT32, DataType.DATE):
+        # sign-extend to i64 then take low word, exactly like casting to int
+        return [_as_u32(xp, data.astype(np.int64))]
+    if dt in (DataType.INT64, DataType.TIMESTAMP):
+        x = data.astype(np.int64)
+        lo = _as_u32(xp, x)
+        hi = _as_u32(xp, x >> np.int64(32))
+        return [lo, hi]
+    if dt in (DataType.FLOAT32, DataType.FLOAT64):
+        return [_canonical_float_bits(xp, data, dt)]
+    raise TypeError(f"cannot hash column of type {dt}")
+
+
+def _string_words_host(col: ColV) -> List[Any]:
+    """Host path: per-row double polynomial over utf-8 bytes."""
+    n = len(col.data)
+    h1 = np.zeros(n, dtype=np.uint32)
+    h2 = np.zeros(n, dtype=np.uint32)
+    lens = np.zeros(n, dtype=np.uint32)
+    for i, s in enumerate(col.data):
+        b = s.encode("utf-8") if isinstance(s, str) else bytes(s)
+        a1 = 0
+        a2 = 0
+        for byte in b:
+            a1 = (a1 * 31 + byte) & 0xFFFFFFFF
+            a2 = (a2 * 1000003 + byte) & 0xFFFFFFFF
+        h1[i], h2[i], lens[i] = a1, a2, len(b)
+    return [h1, h2, lens]
+
+
+def _string_words_device(col: ColV) -> List[Any]:
+    """Device path: the same double polynomial, computed byte-centrically.
+
+    For byte position p belonging to row r at in-row offset k (k counted from
+    the string START), the poly-31 contribution is byte * 31^(len-1-k).
+    Accumulate with a segment-sum over rows. 31^m is computed mod 2^32 via
+    repeated-squaring on the exponent's bits (m <= 2^31).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    offsets = col.offsets
+    nrows = offsets.shape[0] - 1
+    data = col.data
+    nbytes = data.shape[0]
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, nrows - 1)
+    start = offsets[row]
+    end = offsets[row + 1]
+    in_str = (pos >= start) & (pos < end)
+    k = pos - start
+    m = (end - start - 1 - k).astype(jnp.uint32)
+    contrib1 = data.astype(jnp.uint32) * _pow_mod32(jnp, jnp.uint32(31), m)
+    contrib2 = data.astype(jnp.uint32) * _pow_mod32(jnp, jnp.uint32(1000003), m)
+    seg = jnp.where(in_str, row, nrows)
+    h1 = jax.ops.segment_sum(jnp.where(in_str, contrib1, 0), seg,
+                             num_segments=nrows).astype(jnp.uint32)
+    h2 = jax.ops.segment_sum(jnp.where(in_str, contrib2, 0), seg,
+                             num_segments=nrows).astype(jnp.uint32)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.uint32)
+    valid = col.validity
+    z = jnp.zeros((), jnp.uint32)
+    return [jnp.where(valid, h1, z), jnp.where(valid, h2, z),
+            jnp.where(valid, lens, z)]
+
+
+def _pow_mod32(xp, base, exp_u32):
+    """base^exp mod 2^32 elementwise, via square-and-multiply over 32 bits."""
+    result = xp.ones_like(exp_u32, dtype=np.uint32)
+    b = xp.full_like(exp_u32, base, dtype=np.uint32)
+    e = exp_u32
+    for _ in range(32):
+        bit = (e & np.uint32(1)).astype(bool)
+        result = xp.where(bit, (result * b).astype(np.uint32), result)
+        b = (b * b).astype(np.uint32)
+        e = e >> np.uint32(1)
+    return result
+
+
+def string_words(xp, col: ColV) -> List[Any]:
+    if col.offsets is None and isinstance(col.data, np.ndarray) and \
+            col.data.dtype == object:
+        return _string_words_host(col)
+    return _string_words_device(col)
+
+
+def hash_columns(xp, cols: List[ColV], seed=HASH_SEED):
+    """Murmur3-style row hash over multiple columns -> uint32 array.
+
+    Nulls: the reference's Spark semantics skip null columns entirely (hash of
+    null = seed passthrough); we mix an explicit null flag word instead, which
+    is simpler and equally consistent for partitioning/grouping since both
+    engines here share this code path.
+    """
+    h: Optional[Any] = None
+    for col in cols:
+        words = string_words(xp, col) if col.dtype is DataType.STRING \
+            else column_words(xp, col)
+        nullw = xp.where(col.validity, np.uint32(0), _GOLDEN).astype(np.uint32)
+        # zero data words at null lanes: an evaluated column may carry
+        # arbitrary data under null, and all NULLs must hash identically
+        words = [xp.where(col.validity, w, np.uint32(0)).astype(np.uint32)
+                 for w in words] + [nullw]
+        for w in words:
+            if h is None:
+                h = xp.full(w.shape, np.uint32(seed), dtype=np.uint32)
+            h = _mix_h1(xp, h, w.astype(np.uint32))
+    assert h is not None, "hash_columns needs at least one column"
+    return _fmix32(xp, h)
+
+
+def partition_ids(xp, cols: List[ColV], num_partitions: int):
+    """pmod(hash, n) partition index per row -> int32 in [0, n)."""
+    h = hash_columns(xp, cols)
+    return (h % np.uint32(num_partitions)).astype(np.int32)

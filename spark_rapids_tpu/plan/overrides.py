@@ -189,6 +189,13 @@ def _tag_agg(m: ExprMeta) -> None:
             m.will_not_work(
                 "float aggregation order differs from CPU; set "
                 "rapids.tpu.sql.variableFloatAgg.enabled=true")
+    if e.child.data_type is DataType.STRING and not isinstance(e, AGG.Count):
+        # device segment reductions operate on fixed-width lanes; string
+        # min/max additionally needs device string ordering (Count only
+        # reads the validity mask, so it stays on the TPU)
+        m.will_not_work(
+            "aggregates over STRING inputs run on the CPU engine "
+            "(no device string reduction yet)")
     _tag_f64_on_tpu(m)
 
 
@@ -211,6 +218,63 @@ def _register_exec_rules():
     register_exec(
         B.CpuGlobalLimitExec, "global limit",
         lambda cpu, ch: B.TpuGlobalLimitExec(cpu.limit, ch[0]))
+    _register_feature_exec_rules()
+
+
+def _register_feature_exec_rules():
+    from spark_rapids_tpu.exec import join as J
+    from spark_rapids_tpu.exec.aggregate import (
+        CpuHashAggregateExec,
+        TpuHashAggregateExec,
+    )
+    from spark_rapids_tpu.exec.sort import CpuSortExec, TpuSortExec
+    from spark_rapids_tpu.shuffle import exchange as X
+
+    register_exec(
+        CpuHashAggregateExec, "hash aggregate (groupby via sort+segment-reduce)",
+        lambda cpu, ch: TpuHashAggregateExec(
+            cpu.grouping, cpu.agg_exprs, cpu.mode, ch[0], cpu.specs))
+
+    def _tag_sort(m: ExecMeta):
+        for o in m.plan.orders:
+            if o.child.data_type is DataType.STRING:
+                m.will_not_work(
+                    "device lexicographic string ordering is not implemented "
+                    "yet; sort falls back to the CPU engine")
+
+    register_exec(
+        CpuSortExec, "multi-key stable sort",
+        lambda cpu, ch: TpuSortExec(cpu.orders, ch[0]),
+        tag_fn=_tag_sort)
+
+    def _tag_exchange(m: ExecMeta):
+        p = m.plan.partitioning
+        if isinstance(p, X.RangePartitioning):
+            for o in p.orders:
+                if o.child.data_type is DataType.STRING:
+                    m.will_not_work(
+                        "device range partitioning on strings is not "
+                        "implemented (no device string ordering)")
+
+    register_exec(
+        X.CpuShuffleExchangeExec, "columnar shuffle exchange",
+        lambda cpu, ch: X.TpuShuffleExchangeExec(cpu.partitioning, ch[0]),
+        tag_fn=_tag_exchange)
+
+    def _convert_join(tpu_cls):
+        return lambda cpu, ch: tpu_cls(
+            cpu.left_keys, cpu.right_keys, cpu.join_type, cpu.condition,
+            ch[0], ch[1])
+
+    register_exec(
+        J.CpuShuffledHashJoinExec, "shuffled hash equi-join",
+        _convert_join(J.TpuShuffledHashJoinExec))
+    register_exec(
+        J.CpuBroadcastHashJoinExec, "broadcast hash equi-join",
+        _convert_join(J.TpuBroadcastHashJoinExec))
+    register_exec(
+        J.CpuNestedLoopJoinExec, "cross/nested-loop join",
+        _convert_join(J.TpuNestedLoopJoinExec))
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +298,31 @@ def _node_expressions(plan: PhysicalExec) -> List[Expression]:
         return list(plan.project_list)
     if isinstance(plan, (B.CpuFilterExec, B.TpuFilterExec)):
         return [plan.condition]
+    from spark_rapids_tpu.exec.aggregate import _HashAggregateBase
+    from spark_rapids_tpu.exec.join import _JoinBase
+    from spark_rapids_tpu.exec.sort import _SortBase
+    from spark_rapids_tpu.shuffle.exchange import (
+        HashPartitioning,
+        RangePartitioning,
+        _ExchangeBase,
+    )
+
+    if isinstance(plan, _HashAggregateBase):
+        return list(plan.key_exprs) + list(plan.agg_exprs)
+    if isinstance(plan, _SortBase):
+        return [o.child for o in plan.orders]
+    if isinstance(plan, _ExchangeBase):
+        p = plan.partitioning
+        if isinstance(p, HashPartitioning):
+            return list(p.exprs)
+        if isinstance(p, RangePartitioning):
+            return [o.child for o in p.orders]
+        return []
+    if isinstance(plan, _JoinBase):
+        out = list(plan.left_keys) + list(plan.right_keys)
+        if plan.condition is not None:
+            out.append(plan.condition)
+        return out
     return []
 
 

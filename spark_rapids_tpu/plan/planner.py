@@ -93,3 +93,128 @@ def _plan_repartition(plan: L.Repartition, conf: C.TpuConf) -> PhysicalExec:
     from spark_rapids_tpu.shuffle.exchange import plan_repartition_exchange
 
     return plan_repartition_exchange(plan, child, conf)
+
+
+@register_planner(L.Aggregate)
+def _plan_aggregate(plan: L.Aggregate, conf: C.TpuConf) -> PhysicalExec:
+    """partial agg -> hash exchange on keys -> final agg (reference call
+    stack SURVEY.md section 3.5; ungrouped reductions exchange to one
+    partition)."""
+    from spark_rapids_tpu.exec.aggregate import (
+        FINAL,
+        PARTIAL,
+        CpuHashAggregateExec,
+        build_agg_specs,
+    )
+    from spark_rapids_tpu.shuffle.exchange import (
+        CpuShuffleExchangeExec,
+        HashPartitioning,
+        SinglePartitioning,
+    )
+
+    (child,) = _plan_children(plan, conf)
+    specs = build_agg_specs(plan.agg_exprs)
+    partial = CpuHashAggregateExec(plan.grouping, plan.agg_exprs, PARTIAL,
+                                   child, specs)
+    if plan.grouping:
+        part = HashPartitioning(list(plan.grouping), conf.shuffle_partitions)
+    else:
+        part = SinglePartitioning()
+    exchange = CpuShuffleExchangeExec(part, partial)
+    return CpuHashAggregateExec(plan.grouping, plan.agg_exprs, FINAL,
+                                exchange, specs)
+
+
+@register_planner(L.Sort)
+def _plan_sort(plan: L.Sort, conf: C.TpuConf) -> PhysicalExec:
+    """Global sort = range exchange + per-partition sort
+    (reference: GpuSortExec.scala:50-98)."""
+    from spark_rapids_tpu.exec.sort import CpuSortExec
+    from spark_rapids_tpu.shuffle.exchange import (
+        CpuShuffleExchangeExec,
+        RangePartitioning,
+    )
+
+    (child,) = _plan_children(plan, conf)
+    if plan.is_global:
+        child = CpuShuffleExchangeExec(
+            RangePartitioning(plan.orders, conf.shuffle_partitions), child)
+    return CpuSortExec(plan.orders, child)
+
+
+def _estimate_rows(plan: L.LogicalPlan):
+    """Best-effort row estimate for the broadcast-join decision (the
+    reference rides Spark's statistics; this is the standalone stand-in)."""
+    if isinstance(plan, L.LocalRelation):
+        return sum(b.num_rows for part in plan.partitions for b in part)
+    if isinstance(plan, L.RangeRelation):
+        step = plan.step or 1
+        return max(0, (plan.end - plan.start + step - 1) // step)
+    if isinstance(plan, L.Limit):
+        child = _estimate_rows(plan.children[0])
+        return plan.n if child is None else min(plan.n, child)
+    if isinstance(plan, (L.Project, L.Filter, L.Sort, L.Repartition)):
+        return _estimate_rows(plan.children[0])
+    return None
+
+
+@register_planner(L.Join)
+def _plan_join(plan: L.Join, conf: C.TpuConf) -> PhysicalExec:
+    from spark_rapids_tpu.exec.join import (
+        CpuBroadcastHashJoinExec,
+        CpuNestedLoopJoinExec,
+        CpuShuffledHashJoinExec,
+    )
+    from spark_rapids_tpu.shuffle.exchange import (
+        CpuShuffleExchangeExec,
+        HashPartitioning,
+    )
+
+    left, right = _plan_children(plan, conf)
+    jt = plan.join_type
+    if jt is L.JoinType.CROSS or not plan.left_keys:
+        if jt not in (L.JoinType.CROSS, L.JoinType.INNER):
+            raise NotImplementedError(
+                f"non-equi {jt.value} join is not supported")
+        return CpuNestedLoopJoinExec([], [], L.JoinType.CROSS,
+                                     plan.condition, left, right)
+    if plan.condition is not None and jt is not L.JoinType.INNER:
+        raise NotImplementedError(
+            f"{jt.value} join with a non-equi residual condition")
+
+    # co-partitioning + key equality require both key lists to share a type
+    from spark_rapids_tpu.columnar.dtypes import common_type
+    from spark_rapids_tpu.ops.cast import Cast
+
+    left_keys, right_keys = [], []
+    for lk, rk in zip(plan.left_keys, plan.right_keys):
+        if lk.data_type != rk.data_type:
+            ct = common_type(lk.data_type, rk.data_type)
+            if ct is None:
+                raise NotImplementedError(
+                    f"join keys of types {lk.data_type}/{rk.data_type}")
+            lk = lk if lk.data_type == ct else Cast(lk, ct)
+            rk = rk if rk.data_type == ct else Cast(rk, ct)
+        left_keys.append(lk)
+        right_keys.append(rk)
+
+    # broadcast decision on the build side (right, or left for right-outer);
+    # full outer cannot broadcast (unmatched-build tail would duplicate)
+    build_is_left = jt is L.JoinType.RIGHT_OUTER
+    build_logical = plan.children[0] if build_is_left else plan.children[1]
+    est = _estimate_rows(build_logical)
+    if est is not None:
+        est_bytes = est * max(1, sum(a.data_type.itemsize
+                                     for a in build_logical.output))
+    else:
+        est_bytes = None
+    threshold = conf.get(C.BROADCAST_THRESHOLD)
+    if jt is not L.JoinType.FULL_OUTER and est_bytes is not None and \
+            est_bytes <= threshold:
+        return CpuBroadcastHashJoinExec(left_keys, right_keys, jt,
+                                        plan.condition, left, right)
+    n = conf.shuffle_partitions
+    left_ex = CpuShuffleExchangeExec(HashPartitioning(left_keys, n), left)
+    right_ex = CpuShuffleExchangeExec(HashPartitioning(right_keys, n), right)
+    return CpuShuffledHashJoinExec(left_keys, right_keys, jt,
+                                   plan.condition, left_ex, right_ex)

@@ -1,0 +1,8 @@
+"""Shuffle layer: partitioning, exchange execs, device-resident shuffle store.
+
+Reference parity: SURVEY.md section 2.8 — tier A (always-on) columnar shuffle
+(GpuShuffleExchangeExec + partitioners + serializer) and the opt-in
+device-resident shuffle manager (RapidsShuffleInternalManager). In-process,
+map outputs stay device-resident (the tier-B semantics); the multi-host
+transport rides XLA collectives (parallel/ package).
+"""

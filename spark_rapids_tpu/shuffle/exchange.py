@@ -1,0 +1,542 @@
+"""Shuffle exchange execs + partitioners (tier A).
+
+Reference parity:
+- GpuShuffleExchangeExec.scala:122-243 — compute partition indices on the
+  device, slice the batch into per-partition batches, hand (partId, batch)
+  pairs to the shuffle -> `TpuShuffleExchangeExec` computes per-row partition
+  ids in one jit (hash/range/round-robin), sorts rows by partition id and
+  slices contiguously (the `sliceInternalOnGpu` contiguous-split analog,
+  GpuPartitioning.scala:29-120).
+- Partitioners (GpuHashPartitioning / GpuRangePartitioner with driver-side
+  sample + bounds / GpuRoundRobinPartitioning / GpuSinglePartitioning)
+  -> the Partitioning hierarchy below. Hashing is the framework's own
+  murmur-style mix (ops/hashing.py) — consistent across both engines.
+- In-process map outputs stay device-resident, which is the reference's
+  OPT-IN RapidsShuffleManager behavior (shuffle partitions cached in the
+  device store, RapidsShuffleInternalManager.scala:92-141) promoted to the
+  default here; host serialization only happens at explicit boundaries.
+
+The exchange materializes eagerly at execute() (a stage boundary, like
+Spark): a map job runs over child partitions via the task scheduler, each
+map task returns its per-target slices, and the reduce-side iterator streams
+the pieces for its partition in map order (deterministic).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    HostColumnarBatch,
+    HostColumnVector,
+    bucket_capacity,
+    gather_batch,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec import rowkeys as RK
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.ops import hashing as H
+from spark_rapids_tpu.ops.base import (
+    AttributeReference,
+    Expression,
+    SortOrder,
+)
+from spark_rapids_tpu.ops.bind import bind_all, bind_sort_orders
+from spark_rapids_tpu.ops.eval import (
+    _col_to_colv,
+    _host_to_colv,
+    cpu_project,
+)
+from spark_rapids_tpu.ops.values import EvalContext, ScalarV
+from spark_rapids_tpu.utils import metrics as M
+
+
+# ===========================================================================
+# Partitioning descriptors
+# ===========================================================================
+class Partitioning:
+    num_partitions: int
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class SinglePartitioning(Partitioning):
+    def __init__(self):
+        self.num_partitions = 1
+
+
+class RoundRobinPartitioning(Partitioning):
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+
+
+class HashPartitioning(Partitioning):
+    def __init__(self, exprs: Sequence[Expression], num_partitions: int):
+        self.exprs = list(exprs)
+        self.num_partitions = num_partitions
+
+    def describe(self):
+        return f"HashPartitioning({self.exprs!r}, {self.num_partitions})"
+
+    def key_ids(self) -> Tuple[int, ...]:
+        return tuple(
+            e.expr_id for e in self.exprs
+            if isinstance(e, AttributeReference))
+
+
+class RangePartitioning(Partitioning):
+    def __init__(self, orders: Sequence[SortOrder], num_partitions: int):
+        self.orders = list(orders)
+        self.num_partitions = num_partitions
+
+    def describe(self):
+        return f"RangePartitioning({self.orders!r}, {self.num_partitions})"
+
+
+# ===========================================================================
+# Shared exchange machinery
+# ===========================================================================
+class _ExchangeBase(PhysicalExec):
+    def __init__(self, partitioning: Partitioning, child: PhysicalExec):
+        super().__init__(child)
+        self.partitioning = partitioning
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return type(self)(self.partitioning, new_children[0])
+
+    def output_partitioning(self):
+        return self.partitioning
+
+    @property
+    def coalesce_after(self) -> bool:
+        # reduce-side pieces are small; coalesce them back up
+        # (reference: GpuShuffleExchangeExec coalesceAfter=true, :68)
+        return True
+
+    def node_name(self):
+        return f"{type(self).__name__}({self.partitioning.describe()})"
+
+    # -- shared runner -------------------------------------------------------
+    def _materialize(self, ctx: ExecContext, map_fn) -> PartitionedBatches:
+        """Run the map job; regroup slices into reduce buckets."""
+        child_pb = self.children[0].execute(ctx)
+        n_out = self.partitioning.num_partitions
+        n_maps = child_pb.num_partitions
+
+        def run_map(pidx: int) -> List[List[Any]]:
+            buckets: List[List[Any]] = [[] for _ in range(n_out)]
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    continue
+                for target, piece in map_fn(pidx, batch):
+                    if piece.num_rows > 0:
+                        buckets[target].append(piece)
+            return buckets
+
+        if ctx.scheduler is not None:
+            map_results = ctx.scheduler.run_job(n_maps, run_map)
+        else:
+            map_results = [run_map(p) for p in range(n_maps)]
+        reduce_buckets: List[List[Any]] = [[] for _ in range(n_out)]
+        bytes_m = self.metrics["dataSize"]
+        for mb in map_results:
+            for t in range(n_out):
+                for piece in mb[t]:
+                    reduce_buckets[t].append(piece)
+                    bytes_m.add(_piece_bytes(piece))
+
+        def factory(pidx: int):
+            return count_output(self.metrics, iter(reduce_buckets[pidx]))
+
+        return PartitionedBatches(n_out, factory)
+
+
+def _piece_bytes(piece) -> int:
+    if isinstance(piece, ColumnarBatch):
+        return piece.device_memory_size()
+    return piece.estimated_size_bytes()
+
+
+def _sample_bounds_host(key_cols: List[np.ndarray], orders: List[SortOrder],
+                        n_parts: int):
+    """Compute range-partition bounds from sampled key rows (host side;
+    reference: GpuRangePartitioner.scala driver-side reservoir sample).
+    Returns rows of raw key values at the n_parts-1 split points."""
+    if not key_cols or len(key_cols[0]) == 0:
+        return None
+    n = len(key_cols[0])
+    decorated = [
+        (tuple(_order_key(c[i], o) for c, o in zip(key_cols, orders)), i)
+        for i in range(n)
+    ]
+    decorated.sort(key=lambda t: t[0])
+    order_idx = [i for _, i in decorated]
+    bounds_rows = [order_idx[min(n - 1, (b * n) // n_parts)]
+                   for b in range(1, n_parts)]
+    return [tuple(c[i] for c in key_cols) for i in bounds_rows]
+
+
+def _order_key(v, o: SortOrder):
+    """Sortable python key matching SQL null/NaN ordering for one column:
+    (null_rank, nan_rank, value). Nulls rank 0 (first) or 2 (last); NaN is
+    strictly greater than every number including +inf (Spark ordering)."""
+    if isinstance(v, np.generic):
+        v = v.item()
+    if v is None:
+        return (0 if o.nulls_first else 2, 0, 0)
+    if isinstance(v, float) and v != v:
+        return (1, 1 if o.ascending else -1, 0)
+    if isinstance(v, str):
+        return (1, 0, _InvertedStr(v) if not o.ascending else v)
+    if isinstance(v, bool):
+        v = int(v)
+    return (1, 0, -v if not o.ascending else v)
+
+
+class _InvertedStr:
+    __slots__ = ("s",)
+
+    def __init__(self, s):
+        self.s = s
+
+    def __lt__(self, other):
+        return other.s < self.s
+
+    def __eq__(self, other):
+        return self.s == other.s
+
+    def __le__(self, other):
+        return other.s <= self.s
+
+
+# ===========================================================================
+# CPU exchange
+# ===========================================================================
+class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        p = self.partitioning
+        n = p.num_partitions
+        child_attrs = self.children[0].output
+
+        if isinstance(p, SinglePartitioning):
+            return self._materialize(ctx, lambda pidx, b: [(0, b)])
+
+        if isinstance(p, RoundRobinPartitioning):
+            def rr_map(pidx: int, batch: HostColumnarBatch):
+                ids = (np.arange(batch.num_rows) + pidx) % n
+                return _host_slices(batch, ids, n)
+            return self._materialize(ctx, rr_map)
+
+        if isinstance(p, HashPartitioning):
+            bound = bind_all(p.exprs, child_attrs)
+
+            def hash_map(pidx: int, batch: HostColumnarBatch):
+                ev = cpu_project(bound, batch, partition_id=pidx)
+                cols = [_host_to_colv(c) for c in ev.columns]
+                ids = np.asarray(H.partition_ids(np, cols, n))
+                return _host_slices(batch, ids, n)
+            return self._materialize(ctx, hash_map)
+
+        if isinstance(p, RangePartitioning):
+            return self._execute_range(ctx, p)
+        raise NotImplementedError(p.describe())
+
+    def _execute_range(self, ctx: ExecContext,
+                       p: RangePartitioning) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        bound = bind_all([o.child for o in p.orders], child_attrs)
+        n = p.num_partitions
+
+        # phase 1: materialize child batches + evaluated keys per partition
+        def mat(pidx: int):
+            out = []
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    continue
+                ev = cpu_project(bound, batch, partition_id=pidx)
+                keys = [c.to_pylist() for c in ev.columns]
+                out.append((batch, keys))
+            return out
+
+        if ctx.scheduler is not None:
+            per_part = ctx.scheduler.run_job(child_pb.num_partitions, mat)
+        else:
+            per_part = [mat(i) for i in range(child_pb.num_partitions)]
+        all_keys: List[List[Any]] = [[] for _ in p.orders]
+        for part in per_part:
+            for _, keys in part:
+                for i, k in enumerate(keys):
+                    all_keys[i].extend(k)
+        bounds = _sample_bounds_host(
+            [np.array(k, dtype=object) for k in all_keys], p.orders, n)
+
+        reduce_buckets: List[List[HostColumnarBatch]] = [[] for _ in range(n)]
+        for part in per_part:
+            for batch, keys in part:
+                ids = _range_ids_host(keys, bounds, p.orders)
+                for t, piece in _host_slices(batch, ids, n):
+                    if piece.num_rows:
+                        reduce_buckets[t].append(piece)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, iter(reduce_buckets[pidx]))
+
+        return PartitionedBatches(n, factory)
+
+
+def _range_ids_host(key_cols: List[List[Any]], bounds, orders) -> np.ndarray:
+    nrows = len(key_cols[0]) if key_cols else 0
+    if bounds is None:
+        return np.zeros(nrows, dtype=np.int32)
+    ids = np.zeros(nrows, dtype=np.int32)
+    bound_keys = [tuple(_order_key(v, o) for v, o in zip(b, orders))
+                  for b in bounds]
+    for i in range(nrows):
+        row = tuple(_order_key(kc[i], o) for kc, o in zip(key_cols, orders))
+        import bisect
+
+        ids[i] = bisect.bisect_right(bound_keys, row)
+    return ids
+
+
+def _host_slices(batch: HostColumnarBatch, ids: np.ndarray, n: int):
+    out = []
+    for t in range(n):
+        mask = ids == t
+        if not mask.any():
+            continue
+        cols = [HostColumnVector(c.dtype, c.data[mask], c.validity[mask])
+                for c in batch.columns]
+        out.append((t, HostColumnarBatch(cols, int(mask.sum()))))
+    return out
+
+
+# ===========================================================================
+# TPU exchange
+# ===========================================================================
+class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
+    placement = "tpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        p = self.partitioning
+        n = p.num_partitions
+        child_attrs = self.children[0].output
+
+        if isinstance(p, SinglePartitioning):
+            return self._materialize(ctx, lambda pidx, b: [(0, b)])
+
+        if isinstance(p, RoundRobinPartitioning):
+            jitted = _jit_rr_ids(n)
+
+            def rr_map(pidx: int, batch: ColumnarBatch):
+                ids = jitted(jnp.int32(pidx), jnp.int32(batch.num_rows),
+                             batch.capacity)
+                return _device_slices(batch, ids, n)
+            return self._materialize(ctx, rr_map)
+
+        if isinstance(p, HashPartitioning):
+            bound = bind_all(p.exprs, child_attrs)
+            jitted = [None]
+
+            def hash_map(pidx: int, batch: ColumnarBatch):
+                if jitted[0] is None:
+                    jitted[0] = _build_hash_ids(bound, n)
+                cols = [_col_to_colv(c) for c in batch.columns]
+                ids = jitted[0](cols, jnp.int32(batch.num_rows))
+                return _device_slices(batch, ids, n)
+            return self._materialize(ctx, hash_map)
+
+        if isinstance(p, RangePartitioning):
+            return self._execute_range(ctx, p)
+        raise NotImplementedError(p.describe())
+
+    def _execute_range(self, ctx: ExecContext,
+                       p: RangePartitioning) -> PartitionedBatches:
+        """Device range exchange over orderable keys: order bits computed on
+        device, bounds + routing via host bisect over the composite tuples
+        (string range partitioning falls back to the CPU engine via
+        tagging)."""
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        bound = bind_all([o.child for o in p.orders], child_attrs)
+        n = p.num_partitions
+        kernel = _build_order_keys_kernel(bound)
+
+        def mat(pidx: int):
+            out = []
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    continue
+                cols = [_col_to_colv(c) for c in batch.columns]
+                keys = kernel(cols, jnp.int32(batch.num_rows))
+                host_keys = [
+                    (np.asarray(jax.device_get(ob)),
+                     np.asarray(jax.device_get(nf)))
+                    for ob, nf in keys
+                ]
+                out.append((batch, host_keys))
+            return out
+
+        if ctx.scheduler is not None:
+            per_part = ctx.scheduler.run_job(child_pb.num_partitions, mat)
+        else:
+            per_part = [mat(i) for i in range(child_pb.num_partitions)]
+
+        # host-side bounds over composite (null_rank, +/-bits) tuples
+        rows: List[tuple] = []
+        for part in per_part:
+            for batch, host_keys in part:
+                for i in range(batch.num_rows):
+                    rows.append(tuple(
+                        _composite(ob[i], nf[i], o)
+                        for (ob, nf), o in zip(host_keys, p.orders)))
+        bounds = None
+        if rows:
+            rows.sort()
+            cnt = len(rows)
+            bounds = [rows[min(cnt - 1, (b * cnt) // n)]
+                      for b in range(1, n)]
+
+        import bisect
+
+        reduce_buckets: List[List[ColumnarBatch]] = [[] for _ in range(n)]
+        for part in per_part:
+            for batch, host_keys in part:
+                cap = batch.capacity
+                ids = np.zeros(cap, dtype=np.int32)
+                if bounds is not None:
+                    for i in range(batch.num_rows):
+                        row = tuple(
+                            _composite(ob[i], nf[i], o)
+                            for (ob, nf), o in zip(host_keys, p.orders))
+                        ids[i] = bisect.bisect_right(bounds, row)
+                ids[batch.num_rows:] = n
+                for t, piece in _device_slices(batch, jnp.asarray(ids), n):
+                    if piece.num_rows:
+                        reduce_buckets[t].append(piece)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, iter(reduce_buckets[pidx]))
+
+        return PartitionedBatches(n, factory)
+
+
+def _jit_rr_ids(n: int):
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def f(pidx, num_rows, capacity: int):
+        ids = (jnp.arange(capacity, dtype=jnp.int32) + pidx) % n
+        return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+
+    return f
+
+
+def _build_hash_ids(bound_exprs, n: int):
+    from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+    def f(cols, num_rows):
+        capacity = cols[0].validity.shape[0]
+        ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+        key_cols = []
+        for e in bound_exprs:
+            r = e.eval(ctx)
+            if isinstance(r, ScalarV):
+                r = _scalar_to_colv(ctx, r, e.data_type)
+            key_cols.append(r)
+        ids = H.partition_ids(jnp, key_cols, n)
+        return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+
+    return jax.jit(f)
+
+
+def _build_order_keys_kernel(bound_exprs):
+    """One jitted range-key evaluator reused for every batch of the exchange;
+    returns [(order_bits_int64, null_flag)] per key."""
+
+    @jax.jit
+    def f(cols, num_rows):
+        capacity = cols[0].validity.shape[0]
+        ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+        out = []
+        for e in bound_exprs:
+            r = e.eval(ctx)
+            if isinstance(r, ScalarV):
+                from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+                r = _scalar_to_colv(ctx, r, e.data_type)
+            proxy = RK.key_proxy(r)
+            assert proxy.orderable and len(proxy.arrays) == 1
+            out.append((proxy.arrays[0].astype(jnp.int64), proxy.null_flag))
+        return out
+
+    return f
+
+
+def _composite(obits: int, is_null: bool, order: SortOrder) -> Tuple[int, int]:
+    null_rank = (0 if order.nulls_first else 2) if is_null else 1
+    v = int(obits) if not is_null else 0
+    if not order.ascending:
+        v = -v
+    return (null_rank, v)
+
+
+def _device_slices(batch: ColumnarBatch, ids, n: int):
+    """Contiguous split by partition id: stable sort rows by id, then gather
+    each target's contiguous range (reference: GpuPartitioning
+    sliceInternalOnGpu, GpuPartitioning.scala:29-120)."""
+    cap = batch.capacity
+    order = jnp.argsort(ids[:cap], stable=True).astype(jnp.int32)
+    counts = np.asarray(jax.device_get(
+        jax.ops.segment_sum(jnp.ones((cap,), jnp.int32),
+                            jnp.clip(ids[:cap], 0, n), num_segments=n + 1)))
+    out = []
+    offset = 0
+    for t in range(n):
+        c = int(counts[t])
+        if c == 0:
+            continue
+        idx_cap = bucket_capacity(max(c, 1))
+        idx = jnp.concatenate([
+            order[offset:offset + c],
+            jnp.zeros((max(0, idx_cap - c),), jnp.int32)]) if idx_cap > c \
+            else order[offset:offset + c]
+        piece = gather_batch(batch, idx, c)
+        out.append((t, piece))
+        offset += c
+    return out
+
+
+# ===========================================================================
+# planner hook for Repartition (imported by plan/planner.py)
+# ===========================================================================
+def plan_repartition_exchange(plan, child: PhysicalExec, conf) -> PhysicalExec:
+    n = plan.num_partitions or conf.shuffle_partitions
+    if plan.partition_exprs:
+        part = HashPartitioning(plan.partition_exprs, n)
+    else:
+        part = RoundRobinPartitioning(n)
+    return CpuShuffleExchangeExec(part, child)

@@ -1,0 +1,143 @@
+"""Hash aggregate equivalence tests (reference: HashAggregatesSuite.scala,
+hash_aggregate_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    BoolGen,
+    FloatGen,
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    gen_df,
+)
+
+FLOAT_CONF = {"rapids.tpu.sql.variableFloatAgg.enabled": True}
+
+
+def test_groupby_sum_count(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32)),
+                             ("v", IntGen(DataType.INT64))], n=300)
+        .groupBy("k").agg(F.sum("v").alias("s"), F.count("v").alias("c")),
+        ignore_order=True)
+
+
+def test_groupby_min_max(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT16)),
+                             ("v", IntGen(DataType.INT32))], n=200)
+        .groupBy("k").agg(F.min("v").alias("lo"), F.max("v").alias("hi")),
+        ignore_order=True)
+
+
+def test_groupby_avg_float(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32)),
+                             ("v", FloatGen(DataType.FLOAT32))], n=200)
+        .groupBy("k").agg(F.avg("v").alias("a")),
+        ignore_order=True, approx_float=1e-5, extra_conf=FLOAT_CONF)
+
+
+def test_groupby_string_key(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", StringGen(max_len=6)),
+                             ("v", IntGen(DataType.INT64))], n=250)
+        .groupBy("k").agg(F.sum("v").alias("s"), F.count("*").alias("c")),
+        ignore_order=True)
+
+
+def test_groupby_multi_key(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("a", IntGen(DataType.INT32)),
+                             ("b", BoolGen()),
+                             ("v", IntGen(DataType.INT64))], n=300)
+        .groupBy("a", "b").agg(F.sum("v").alias("s")),
+        ignore_order=True)
+
+
+def test_ungrouped_reduction(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", IntGen(DataType.INT64))], n=128)
+        .agg(F.sum("v").alias("s"), F.count("v").alias("c"),
+             F.min("v").alias("lo"), F.max("v").alias("hi")))
+
+
+def test_ungrouped_empty_input_default_row(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.createDataFrame({"v": []}, [("v", "long")])
+        .agg(F.sum("v").alias("s"), F.count("v").alias("c")))
+
+
+def test_count_star(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32)),
+                             ("v", IntGen(DataType.INT64))], n=100)
+        .groupBy("k").agg(F.count("*").alias("c")),
+        ignore_order=True)
+
+
+def test_first_last(session):
+    # first/last depend on encounter order; restrict to one partition
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32, lo=0, hi=5)),
+                             ("v", IntGen(DataType.INT64))], n=64,
+                         num_partitions=1)
+        .groupBy("k").agg(F.first("v").alias("f"), F.last("v").alias("l")),
+        ignore_order=True)
+
+
+def test_distinct(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("a", IntGen(DataType.INT32, lo=0, hi=8)),
+                             ("b", BoolGen())], n=200).distinct(),
+        ignore_order=True)
+
+
+def test_all_null_group_sum_is_null(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.createDataFrame(
+            {"k": [1, 1, 2], "v": [None, None, 5]},
+            [("k", "int"), ("v", "long")])
+        .groupBy("k").agg(F.sum("v").alias("s"), F.count("v").alias("c")),
+        ignore_order=True)
+
+
+def test_dataframe_count_action(session):
+    from tests.harness import run_on_cpu, run_on_tpu
+
+    data = {"v": list(range(57))}
+
+    def build(s):
+        return s.createDataFrame(data, [("v", "long")]).filter(F.col("v") > 10)
+
+    cpu = run_on_cpu(session, lambda s: build(s).agg(F.count("*").alias("c")))
+    tpu = run_on_tpu(session, lambda s: build(s).agg(F.count("*").alias("c")))
+    assert cpu == tpu == [(46,)]
+
+
+def test_string_min_max_falls_back(session):
+    from tests.harness import assert_tpu_fallback_collect
+
+    assert_tpu_fallback_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32, lo=0, hi=6)),
+                             ("v", StringGen(max_len=5))], n=120)
+        .groupBy("k").agg(F.min("v").alias("lo"), F.max("v").alias("hi"),
+                          F.count("v").alias("c")),
+        fallback_exec="CpuHashAggregateExec",
+        ignore_order=True)

@@ -1,0 +1,126 @@
+"""Join equivalence tests (reference: JoinsSuite.scala, join_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    gen_df,
+)
+
+# keep key ranges small so joins actually match
+KEY = IntGen(DataType.INT32, lo=0, hi=20)
+BIG = {"rapids.tpu.sql.autoBroadcastJoinThreshold": 1}  # force shuffled join
+
+
+def _two(s, n_left=150, n_right=80, seed=0):
+    left = gen_df(s, [("k", KEY), ("lv", IntGen(DataType.INT64))],
+                  n=n_left, seed=seed)
+    right = gen_df(s, [("k", KEY), ("rv", IntGen(DataType.INT64))],
+                   n=n_right, seed=seed + 1)
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_shuffled_join_types(session, how):
+    def fn(s):
+        left, right = _two(s)
+        return left.join(right, "k", how)
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)
+
+
+@pytest.mark.parametrize("how", ["semi", "anti"])
+def test_semi_anti(session, how):
+    def fn(s):
+        left, right = _two(s)
+        return left.join(right, "k", how)
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_broadcast_join(session, how):
+    def fn(s):
+        left, right = _two(s, n_right=30)
+        return left.join(right, "k", how)
+
+    # small right side -> broadcast path (no threshold override)
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True)
+
+
+def test_join_string_keys(session):
+    def fn(s):
+        left = gen_df(s, [("k", StringGen(max_len=3)),
+                          ("lv", IntGen(DataType.INT64))], n=120)
+        right = gen_df(s, [("k", StringGen(max_len=3)),
+                           ("rv", IntGen(DataType.INT64))], n=60, seed=7)
+        return left.join(right, "k")
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)
+
+
+def test_join_multi_key(session):
+    def fn(s):
+        left = gen_df(s, [("a", KEY), ("b", IntGen(DataType.INT32,
+                                                   lo=0, hi=2)),
+                          ("lv", IntGen(DataType.INT64))], n=100)
+        right = gen_df(s, [("a", KEY), ("b", IntGen(DataType.INT32,
+                                                    lo=0, hi=2)),
+                           ("rv", IntGen(DataType.INT64))], n=60, seed=3)
+        return left.join(right, ["a", "b"])
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)
+
+
+def test_join_null_keys_never_match(session):
+    def fn(s):
+        left = s.createDataFrame({"k": [1, None, 2], "lv": [10, 20, 30]},
+                                 [("k", "int"), ("lv", "long")])
+        right = s.createDataFrame({"k": [1, None], "rv": [100, 200]},
+                                  [("k", "int"), ("rv", "long")])
+        return left.join(right, "k", "left")
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)
+
+
+def test_cross_join(session):
+    def fn(s):
+        left = gen_df(s, [("lv", IntGen(DataType.INT64))], n=20)
+        right = gen_df(s, [("rv", IntGen(DataType.INT64))], n=10, seed=5)
+        return left.crossJoin(right)
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True)
+
+
+def test_join_with_condition(session):
+    def fn(s):
+        left, right = _two(s, n_left=80, n_right=40)
+        return left.join(
+            right,
+            (left["k"] == right["k"]) & (left["lv"] > right["rv"]),
+            "inner")
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)
+
+
+def test_mismatched_key_types(session):
+    def fn(s):
+        left = s.createDataFrame({"k": [1, 2, 3], "lv": [1, 2, 3]},
+                                 [("k", "int"), ("lv", "long")])
+        right = s.createDataFrame({"k": [2, 3, 4], "rv": [20, 30, 40]},
+                                  [("k", "long"), ("rv", "long")])
+        return left.join(right, left["k"] == right["k"], "inner")
+
+    assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True,
+                                         extra_conf=BIG)

@@ -1,0 +1,67 @@
+"""Shuffle exchange / repartition tests (reference: repart_test.py,
+GpuPartitioning tests)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    gen_df,
+    run_on_cpu,
+    run_on_tpu,
+)
+
+
+def test_round_robin_repartition(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", IntGen(DataType.INT64))], n=200)
+        .repartition(5),
+        ignore_order=True)
+
+
+def test_hash_repartition(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32)),
+                             ("v", IntGen(DataType.INT64))], n=200)
+        .repartition(4, "k"),
+        ignore_order=True)
+
+
+def test_hash_repartition_string(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", StringGen(max_len=4)),
+                             ("v", IntGen(DataType.INT64))], n=150)
+        .repartition(3, "k"),
+        ignore_order=True)
+
+
+def test_coalesce_partitions(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", IntGen(DataType.INT64))], n=100,
+                         num_partitions=4).coalesce(1),
+        ignore_order=True)
+
+
+def test_hash_copartition_groups_keys(session):
+    """All rows with one key land in one partition: groupBy after
+    repartition must produce one row per key."""
+    def fn(s):
+        df = gen_df(s, [("k", IntGen(DataType.INT32, lo=0, hi=10,
+                                     nullable=False)),
+                        ("v", IntGen(DataType.INT64))], n=200)
+        return df.repartition(4, "k").groupBy("k").agg(
+            F.count("*").alias("c"))
+
+    cpu = run_on_cpu(session, fn)
+    tpu = run_on_tpu(session, fn)
+    assert sorted(cpu) == sorted(tpu)
+    keys = [r[0] for r in tpu]
+    assert len(keys) == len(set(keys)), "duplicate key across partitions"

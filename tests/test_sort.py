@@ -1,0 +1,66 @@
+"""Sort equivalence tests (reference: SortExecSuite, sort_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    FloatGen,
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+    gen_df,
+)
+
+
+def test_global_sort_int(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", IntGen(DataType.INT64)),
+                             ("x", IntGen(DataType.INT32))], n=300)
+        .orderBy("v"))
+
+
+def test_sort_desc_nulls(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", IntGen(DataType.INT32)),
+                             ("x", IntGen(DataType.INT32))], n=200)
+        .orderBy(F.col("v").desc()))
+
+
+def test_sort_multi_key(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("a", IntGen(DataType.INT32, lo=0, hi=4)),
+                             ("b", IntGen(DataType.INT64))], n=300)
+        .orderBy("a", F.col("b").desc()))
+
+
+def test_sort_float_nan(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", FloatGen(DataType.FLOAT32)),
+                             ("x", IntGen(DataType.INT32))], n=200)
+        .orderBy("v", "x"))
+
+
+def test_sort_within_partitions(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", IntGen(DataType.INT64))], n=128,
+                         num_partitions=1).sortWithinPartitions("v"))
+
+
+def test_sort_string_falls_back(session):
+    assert_tpu_fallback_collect(
+        session,
+        lambda s: gen_df(s, [("v", StringGen(max_len=5)),
+                             ("x", IntGen(DataType.INT32))], n=100)
+        .orderBy("v", "x"),
+        fallback_exec="CpuSortExec",
+        # the range exchange on a string key also stays on CPU
+        extra_conf={"rapids.tpu.sql.test.allowedNonTpu":
+                    "CpuSortExec,CpuShuffleExchangeExec"})

@@ -1,0 +1,84 @@
+"""Benchmark: BASELINE config 1/2 — filter + project + hash aggregate.
+
+Runs the full engine (DataFrame -> plan rewrite -> device execs) over
+generated columnar data on the real chip, measures steady-state wall clock,
+and prints ONE JSON line. `vs_baseline` is the speedup of the TPU engine
+over this framework's own CPU oracle engine on the identical plan (the
+reference's headline chart is likewise accelerator-vs-CPU wall-clock,
+README.md:10-18).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+N_ROWS = 1 << 20
+N_KEYS = 1024
+ITERS = 5
+
+
+def build_df(session):
+    """Input is cached (device-resident on the TPU engine, host-resident on
+    the CPU engine) so the metric measures engine throughput, not the
+    host<->device link of the benchmarking harness."""
+    rng = np.random.default_rng(42)
+    data = {
+        "k": rng.integers(0, N_KEYS, N_ROWS).astype(np.int64),
+        "a": rng.integers(-10_000, 10_000, N_ROWS).astype(np.int64),
+        "b": rng.random(N_ROWS).astype(np.float32),
+    }
+    return session.createDataFrame(
+        data, [("k", "long"), ("a", "long"), ("b", "float")],
+        num_partitions=4).cache()
+
+
+def run_query(session, df):
+    from spark_rapids_tpu.plan import functions as F
+
+    out = (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+             .withColumn("c", F.col("a") * 2 + 1)
+             .groupBy("k")
+             .agg(F.sum("c").alias("s"), F.count("*").alias("n"),
+                  F.max("a").alias("m")))
+    return out.collect()
+
+
+def timed(session, df, iters=ITERS):
+    run_query(session, df)  # warmup (compile)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        rows = run_query(session, df)
+        times.append(time.perf_counter() - t0)
+    assert len(rows) == N_KEYS
+    return min(times)
+
+
+def main():
+    import spark_rapids_tpu as srt
+
+    session = srt.new_session()
+    session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    df = build_df(session)
+
+    session.conf.set("rapids.tpu.sql.enabled", True)
+    tpu_t = timed(session, df)
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    cpu_t = timed(session, df, iters=2)
+
+    input_bytes = N_ROWS * (8 + 8 + 4)
+    gbps = input_bytes / tpu_t / 1e9
+    print(json.dumps({
+        "metric": "filter_project_groupby_gbps",
+        "value": round(gbps, 4),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(cpu_t / tpu_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -247,9 +247,16 @@ class HostColumnarBatch:
 
     # -- upload (reference: GpuColumnarBatchBuilder host-build-then-upload) --
     def to_device(self) -> "ColumnarBatch":
+        """Single-transfer upload: every column's data/validity/offsets are
+        packed into ONE host uint8 buffer, moved to the device in one copy,
+        and unpacked with one jitted bitcast program. With the accelerator
+        behind a network link, per-column transfers dominate otherwise
+        (the pinned-staging-pool lesson of GpuDeviceManager.scala:200-206)."""
         n = self.num_rows
         cap = bucket_capacity(n)
-        cols = []
+        parts: List[np.ndarray] = []
+        layout: List[Tuple[str, str, int]] = []
+        specs = []  # per column: ("fixed", dtype) | ("string",)
         for hc in self.columns:
             validity = np.zeros(cap, dtype=bool)
             validity[:n] = hc.validity[:n]
@@ -259,7 +266,8 @@ class HostColumnarBatch:
                     for s in hc.data[:n]
                 ]
                 lengths = np.fromiter(
-                    (len(b) if validity[i] else 0 for i, b in enumerate(encoded)),
+                    (len(b) if validity[i] else 0
+                     for i, b in enumerate(encoded)),
                     dtype=np.int32, count=n,
                 )
                 offsets = np.zeros(cap + 1, dtype=np.int32)
@@ -270,36 +278,84 @@ class HostColumnarBatch:
                 buf = np.zeros(byte_cap, dtype=np.uint8)
                 if nbytes:
                     joined = b"".join(
-                        b if validity[i] else b"" for i, b in enumerate(encoded)
-                    )
+                        b if validity[i] else b""
+                        for i, b in enumerate(encoded))
                     buf[:nbytes] = np.frombuffer(joined, dtype=np.uint8)
-                cols.append(
-                    ColumnVector(
-                        DataType.STRING,
-                        jnp.asarray(buf),
-                        jnp.asarray(validity),
-                        jnp.asarray(offsets),
-                    )
-                )
+                parts.append(offsets.view(np.uint8))
+                layout.append(("bitcast", "int32", cap + 1))
+                parts.append(buf)
+                layout.append(("u8", "uint8", byte_cap))
+                parts.append(validity.view(np.uint8))
+                layout.append(("bool", "bool", cap))
+                specs.append(("string",))
             else:
                 npdt = physical_np_dtype(hc.dtype)
                 data = np.zeros(cap, dtype=npdt)
                 data[:n] = np.where(hc.validity[:n], hc.data[:n], 0)
-                cols.append(
-                    ColumnVector(hc.dtype, jnp.asarray(data), jnp.asarray(validity))
-                )
+                parts.append(data.view(np.uint8).reshape(-1))
+                kind = "bool" if npdt == np.dtype(np.bool_) else "bitcast"
+                layout.append((kind, npdt.name, cap))
+                parts.append(validity.view(np.uint8))
+                layout.append(("bool", "bool", cap))
+                specs.append(("fixed", hc.dtype))
+        if not parts:
+            return ColumnarBatch([], n)
+        packed = jnp.asarray(np.concatenate(parts))
+        arrays = _unpack_device(packed, tuple(layout))
+        cols = []
+        ai = 0
+        for hc, spec in zip(self.columns, specs):
+            if spec[0] == "string":
+                offsets, buf, validity = arrays[ai], arrays[ai + 1], \
+                    arrays[ai + 2]
+                ai += 3
+                cols.append(ColumnVector(DataType.STRING, buf, validity,
+                                         offsets))
+            else:
+                data, validity = arrays[ai], arrays[ai + 1]
+                ai += 2
+                cols.append(ColumnVector(hc.dtype, data, validity))
         return ColumnarBatch(cols, n)
 
 
 class ColumnarBatch:
     """Device-resident columnar batch (reference: ColumnarBatch of
-    GpuColumnVectors / cudf Table)."""
+    GpuColumnVectors / cudf Table).
 
-    __slots__ = ("columns", "num_rows")
+    `num_rows` is normally a host int, but operators on the hot
+    agg->exchange->agg path carry it as a DEVICE scalar to avoid paying a
+    device->host round trip per batch (the row-count sync is the single
+    most expensive operation when the chip sits behind a network link).
+    Use `host_rows()` where a python int is genuinely required.
 
-    def __init__(self, columns: List[ColumnVector], num_rows: int):
+    `live` (optional device bool [capacity]) marks which lanes hold real
+    rows. A live-masked batch is a zero-copy VIEW used by the in-process
+    shuffle: a partition slice is just (shared columns, pid==target mask) —
+    no gather, no count sync, no data movement. Consumers compact via
+    `ensure_compact` / `concat_batches` (a single traced scatter)."""
+
+    __slots__ = ("columns", "num_rows", "live")
+
+    def __init__(self, columns: List[ColumnVector], num_rows, live=None):
         self.columns = columns
-        self.num_rows = int(num_rows)
+        self.num_rows = int(num_rows) if isinstance(
+            num_rows, (int, np.integer)) else num_rows
+        self.live = live
+
+    @property
+    def rows_on_host(self) -> bool:
+        return isinstance(self.num_rows, int)
+
+    def host_rows(self) -> int:
+        if not self.rows_on_host:
+            self.num_rows = int(jax.device_get(self.num_rows))
+        return self.num_rows
+
+    def live_mask(self):
+        """Traced mask of real rows (works for compact and masked batches)."""
+        if self.live is not None:
+            return self.live
+        return jnp.arange(self.capacity) < jnp.asarray(self.num_rows)
 
     @property
     def num_columns(self):
@@ -317,23 +373,68 @@ class ColumnarBatch:
 
     # -- download (reference: GpuColumnarToRowExec copyToHost) ---------------
     def to_host(self) -> HostColumnarBatch:
-        n = self.num_rows
-        out = []
+        """Single-transfer download: one jitted device pack into a uint8
+        buffer, one copy to host, numpy views to reconstruct columns."""
+        if self.live is not None:
+            return ensure_compact(self).to_host()
+        if not self.columns:
+            return HostColumnarBatch([], self.host_rows())
+        if self.rows_on_host:
+            n = self.num_rows
+            trim = min(self.capacity, bucket_capacity(max(n, 1)))
+        elif self.device_memory_size() <= (1 << 20):
+            # device count + small batch: ride the count inside the ONE
+            # packed transfer instead of paying a separate scalar round trip
+            n = None
+            trim = self.capacity
+        else:
+            n = self.host_rows()
+            trim = min(self.capacity, bucket_capacity(max(n, 1)))
+        arrays = []
         for cv in self.columns:
-            validity = np.asarray(jax.device_get(cv.validity))[:n]
             if cv.dtype is DataType.STRING:
-                offsets = np.asarray(jax.device_get(cv.offsets))
-                data = np.asarray(jax.device_get(cv.data))
+                arrays.extend([cv.offsets[:trim + 1], cv.data,
+                               cv.validity[:trim]])
+            else:
+                arrays.extend([cv.data[:trim], cv.validity[:trim]])
+        if n is None:
+            arrays.append(jnp.asarray(self.num_rows,
+                                      dtype=jnp.int32).reshape(1))
+        packed = _pack_device(tuple(arrays))
+        host = np.asarray(jax.device_get(packed))
+        if n is None:
+            n = int(host[-4:].view(np.int32)[0])
+            self.num_rows = n
+        out = []
+        off = 0
+
+        def take(count, np_dtype):
+            nonlocal off
+            itemsize = np.dtype(np_dtype).itemsize
+            seg = host[off:off + count * itemsize]
+            off += count * itemsize
+            if np_dtype == np.bool_:
+                return seg.astype(bool)
+            return seg.view(np_dtype)
+
+        for cv in self.columns:
+            if cv.dtype is DataType.STRING:
+                offsets = take(trim + 1, np.int32)
+                data = take(int(cv.data.shape[0]), np.uint8)
+                validity = take(trim, np.bool_)[:n]
                 strs = np.empty(n, dtype=object)
                 for i in range(n):
                     if validity[i]:
-                        strs[i] = bytes(data[offsets[i]:offsets[i + 1]]).decode(
-                            "utf-8", errors="replace")
+                        strs[i] = bytes(
+                            data[offsets[i]:offsets[i + 1]]
+                        ).decode("utf-8", errors="replace")
                     else:
                         strs[i] = ""
                 out.append(HostColumnVector(DataType.STRING, strs, validity))
             else:
-                data = np.asarray(jax.device_get(cv.data))[:n]
+                phys = np.dtype(cv.data.dtype)
+                data = take(trim, phys)[:n]
+                validity = take(trim, np.bool_)[:n]
                 npdt = cv.dtype.to_np()
                 if data.dtype != npdt:
                     data = data.astype(npdt)
@@ -344,6 +445,46 @@ class ColumnarBatch:
     def __repr__(self):
         return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
                 f"cols={[c.dtype.name for c in self.columns]})")
+
+
+# ---------------------------------------------------------------------------
+# Packed transfer helpers (one host<->device copy per batch)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnums=(1,))
+def _unpack_device(packed_u8, layout):
+    """Slice + bitcast the packed upload buffer back into column arrays.
+    layout: tuple of (kind, dtype_name, count); kind in bitcast|bool|u8."""
+    out = []
+    off = 0
+    for kind, dtype_name, count in layout:
+        npdt = np.dtype(dtype_name)
+        nbytes = count * (1 if kind == "bool" else npdt.itemsize)
+        seg = packed_u8[off:off + nbytes]
+        off += nbytes
+        if kind == "bool":
+            out.append(seg.astype(bool))
+        elif kind == "u8":
+            out.append(seg)
+        else:
+            out.append(jax.lax.bitcast_convert_type(
+                seg.reshape(count, npdt.itemsize), jnp.dtype(npdt)))
+    return out
+
+
+@jax.jit
+def _pack_device(arrays):
+    """Bitcast every array to uint8 and concatenate (the download mirror of
+    _unpack_device)."""
+    parts = []
+    for a in arrays:
+        if a.dtype == jnp.bool_:
+            parts.append(a.astype(jnp.uint8))
+        elif a.dtype == jnp.uint8:
+            parts.append(a)
+        else:
+            parts.append(
+                jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1))
+    return jnp.concatenate(parts)
 
 
 # ---------------------------------------------------------------------------
@@ -386,45 +527,132 @@ def repad_column(cv: ColumnVector, new_cap: int) -> ColumnVector:
 
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Concatenate batches with the same schema (reference: cudf
-    Table.concatenate used by GpuCoalesceBatches.scala:38-63)."""
+    Table.concatenate used by GpuCoalesceBatches.scala:38-63). The whole
+    fixed-width part is ONE fused device call. Batches carrying device-
+    scalar row counts concatenate without any host sync (capacity is then
+    bounded by the sum of input capacities)."""
     assert batches, "cannot concat zero batches"
     if len(batches) == 1:
-        return batches[0]
-    total = sum(b.num_rows for b in batches)
-    cap = bucket_capacity(total)
+        return ensure_compact(batches[0])
+    has_string = any(c.dtype is DataType.STRING for c in batches[0].columns)
+    if has_string:
+        # string concat is host-coordinated (byte totals); force host counts
+        # and compact any live-masked views first
+        batches = [ensure_compact(b) for b in batches]
+        for b in batches:
+            b.host_rows()
+    all_plain = all(b.rows_on_host and b.live is None for b in batches)
     ncols = batches[0].num_columns
-    out_cols = []
+    fixed_idx = [ci for ci in range(ncols)
+                 if batches[0].columns[ci].dtype is not DataType.STRING]
+    out_cols: List[Optional[ColumnVector]] = [None] * ncols
+    if all_plain:
+        total = sum(b.num_rows for b in batches)
+        cap = bucket_capacity(total)
+        if fixed_idx:
+            datas = tuple(
+                tuple(b.columns[ci].data[:bucket_capacity(b.num_rows)]
+                      for b in batches)
+                for ci in fixed_idx)
+            valids = tuple(
+                tuple(b.columns[ci].validity[:bucket_capacity(b.num_rows)]
+                      for b in batches)
+                for ci in fixed_idx)
+            nrows_arr = jnp.asarray([b.num_rows for b in batches],
+                                    dtype=jnp.int32)
+            outs = _concat_fixed_cols(cap, datas, valids, nrows_arr)
+            for ci, (data, validity) in zip(fixed_idx, outs):
+                out_cols[ci] = ColumnVector(batches[0].columns[ci].dtype,
+                                            data, validity)
+    else:
+        # masked/device-count path: ONE traced scatter-compaction, no syncs
+        assert not has_string
+        cap = bucket_capacity(sum(b.capacity for b in batches))
+        datas = tuple(
+            tuple(b.columns[ci].data for b in batches) for ci in fixed_idx)
+        valids = tuple(
+            tuple(b.columns[ci].validity for b in batches)
+            for ci in fixed_idx)
+        lives = tuple(b.live_mask() for b in batches)
+        outs, total = _concat_live_cols(cap, datas, valids, lives)
+        for ci, (data, validity) in zip(fixed_idx, outs):
+            out_cols[ci] = ColumnVector(batches[0].columns[ci].dtype, data,
+                                        validity)
     for ci in range(ncols):
-        dt = batches[0].columns[ci].dtype
-        if dt is DataType.STRING:
-            out_cols.append(_concat_string_cols([b.columns[ci] for b in batches],
-                                                [b.num_rows for b in batches], cap))
-        else:
-            datas, valids = [], []
-            for b in batches:
-                cv = b.columns[ci]
-                datas.append(cv.data[:bucket_capacity(b.num_rows)])
-                valids.append(cv.validity[:bucket_capacity(b.num_rows)])
-            data, validity = _concat_fixed(tuple(datas), tuple(valids),
-                                           tuple(b.num_rows for b in batches), cap)
-            out_cols.append(ColumnVector(dt, data, validity))
+        if batches[0].columns[ci].dtype is DataType.STRING:
+            out_cols[ci] = _concat_string_cols(
+                [b.columns[ci] for b in batches],
+                [b.num_rows for b in batches], cap)
     return ColumnarBatch(out_cols, total)
 
 
-def _concat_fixed(datas, valids, nrows, cap: int):
-    # scatter-based compaction: write each batch's valid region at its offset
-    out_d = jnp.zeros((cap,), dtype=datas[0].dtype)
-    out_v = jnp.zeros((cap,), dtype=bool)
-    offset = 0
-    for d, v, n in zip(datas, valids, nrows):
-        k = d.shape[0]
-        idx = jnp.arange(k) + offset
-        take = jnp.arange(k) < n
-        idx = jnp.where(take, idx, cap)  # out-of-range drops
-        out_d = out_d.at[idx].set(d, mode="drop")
-        out_v = out_v.at[idx].set(v & take, mode="drop")
-        offset += int(n)
-    return out_d, out_v
+def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
+    """Compact a live-masked shuffle view into a dense batch (single traced
+    scatter; row count stays a device scalar — still no sync)."""
+    if batch.live is None:
+        return batch
+    if any(c.dtype is DataType.STRING for c in batch.columns):
+        # string view compaction: sync the mask and gather
+        mask = np.asarray(jax.device_get(batch.live))
+        rows = np.nonzero(mask)[0]
+        n = len(rows)
+        idx_cap = bucket_capacity(max(n, 1))
+        idx = np.zeros(idx_cap, dtype=np.int32)
+        idx[:n] = rows
+        return gather_batch(
+            ColumnarBatch(batch.columns, batch.capacity), jnp.asarray(idx), n)
+    cap = bucket_capacity(batch.capacity)
+    datas = tuple((c.data,) for c in batch.columns)
+    valids = tuple((c.validity,) for c in batch.columns)
+    outs, total = _concat_live_cols(cap, datas, valids, (batch.live,))
+    cols = [ColumnVector(c.dtype, d, v)
+            for c, (d, v) in zip(batch.columns, outs)]
+    return ColumnarBatch(cols, total)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _concat_live_cols(cap: int, datas, valids, lives):
+    """Scatter-compact several live-masked views into one dense batch in a
+    single fused program. Output row position of source row i in piece p =
+    (rows of earlier pieces) + (live rows of p at or before i) - 1."""
+    pos_list = []
+    off = jnp.int32(0)
+    for live in lives:
+        c = jnp.cumsum(live.astype(jnp.int32)) - 1 + off
+        pos_list.append(jnp.where(live, c, cap))
+        off = off + jnp.sum(live.astype(jnp.int32))
+    outs = []
+    for col_datas, col_valids in zip(datas, valids):
+        out_d = jnp.zeros((cap,), dtype=col_datas[0].dtype)
+        out_v = jnp.zeros((cap,), dtype=bool)
+        for d, v, pos in zip(col_datas, col_valids, pos_list):
+            out_d = out_d.at[pos].set(d, mode="drop")
+            out_v = out_v.at[pos].set(v, mode="drop")
+        outs.append((out_d, out_v))
+    return outs, off
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _concat_fixed_cols(cap: int, datas, valids, nrows_arr):
+    """Scatter each batch's valid region at its running offset, for every
+    column at once (single dispatch; offsets traced so batch row counts
+    don't retrigger compilation)."""
+    offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(nrows_arr, dtype=jnp.int32)])
+    out = []
+    for col_datas, col_valids in zip(datas, valids):
+        out_d = jnp.zeros((cap,), dtype=col_datas[0].dtype)
+        out_v = jnp.zeros((cap,), dtype=bool)
+        for bi, (d, v) in enumerate(zip(col_datas, col_valids)):
+            k = d.shape[0]
+            n = nrows_arr[bi]
+            idx = jnp.arange(k) + offsets[bi]
+            take = jnp.arange(k) < n
+            idx = jnp.where(take, idx, cap)  # out-of-range drops
+            out_d = out_d.at[idx].set(d, mode="drop")
+            out_v = out_v.at[idx].set(v & take, mode="drop")
+        out.append((out_d, out_v))
+    return out
 
 
 def _concat_string_cols(cols: List[ColumnVector], nrows: List[int], cap: int) -> ColumnVector:
@@ -456,6 +684,27 @@ def _concat_string_cols(cols: List[ColumnVector], nrows: List[int], cap: int) ->
     return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets)
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def _gather_fixed_cols(cap: int, datas, valids, indices, indices_valid,
+                       out_rows):
+    """One fused gather for every fixed-width column of a batch (a single
+    device dispatch — critical when the accelerator sits behind a network
+    tunnel and each eager op is a round trip)."""
+    idx = indices[:cap]
+    sel_mask = jnp.arange(cap) < out_rows
+    src_cap = valids[0].shape[0] if valids else 0
+    in_bounds = sel_mask & (idx >= 0) & (idx < src_cap)
+    if indices_valid is not None:
+        in_bounds = in_bounds & indices_valid[:cap]
+    safe_idx = jnp.where(in_bounds, idx, 0)
+    out = []
+    for d, v in zip(datas, valids):
+        data = jnp.where(in_bounds, d[safe_idx], jnp.zeros((), d.dtype))
+        validity = jnp.where(in_bounds, v[safe_idx], False)
+        out.append((data, validity))
+    return out
+
+
 def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
                  indices_valid=None) -> ColumnarBatch:
     """Gather rows by index into a new batch of `out_rows` logical rows.
@@ -464,19 +713,25 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     """
     cap = bucket_capacity(max(out_rows, 1))
     idx = indices[:cap]
-    sel_mask = (jnp.arange(cap) < out_rows)
-    in_bounds = sel_mask & (idx >= 0) & (idx < batch.capacity)
-    if indices_valid is not None:
-        in_bounds = in_bounds & indices_valid[:cap]
-    cols = []
-    for cv in batch.columns:
+    sel_mask = jnp.arange(cap) < out_rows
+    in_bounds_s = None
+    fixed = [(i, cv) for i, cv in enumerate(batch.columns)
+             if cv.dtype is not DataType.STRING]
+    cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
+    if fixed:
+        datas = tuple(cv.data for _, cv in fixed)
+        valids = tuple(cv.validity for _, cv in fixed)
+        outs = _gather_fixed_cols(cap, datas, valids, indices,
+                                  indices_valid, jnp.int32(out_rows))
+        for (i, cv), (data, validity) in zip(fixed, outs):
+            cols[i] = ColumnVector(cv.dtype, data, validity)
+    for i, cv in enumerate(batch.columns):
         if cv.dtype is DataType.STRING:
-            cols.append(_gather_string(cv, idx, in_bounds, sel_mask))
-        else:
-            safe_idx = jnp.where(in_bounds, idx, 0)
-            data = jnp.where(in_bounds, cv.data[safe_idx], 0)
-            validity = jnp.where(in_bounds, cv.validity[safe_idx], False) & sel_mask
-            cols.append(ColumnVector(cv.dtype, data, validity))
+            if in_bounds_s is None:
+                in_bounds_s = sel_mask & (idx >= 0) & (idx < batch.capacity)
+                if indices_valid is not None:
+                    in_bounds_s = in_bounds_s & indices_valid[:cap]
+            cols[i] = _gather_string(cv, idx, in_bounds_s, sel_mask)
     return ColumnarBatch(cols, out_rows)
 
 
@@ -512,19 +767,24 @@ def _gather_string_bytes(src, starts, new_offsets, lengths, byte_cap: int):
     return jnp.where(valid, src[src_pos], 0).astype(jnp.uint8)
 
 
+@jax.jit
+def _compact_plan(keep_mask, num_rows):
+    cap = keep_mask.shape[0]
+    keep = keep_mask & (jnp.arange(cap) < num_rows)
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    return order, jnp.sum(keep)
+
+
 def compact_batch(batch: ColumnarBatch, keep_mask) -> ColumnarBatch:
     """Compact rows where keep_mask is True to the front (the filter kernel;
     reference: cudf Table.filter used by GpuFilterExec,
     basicPhysicalOperators.scala:96-177)."""
-    cap = batch.capacity
-    keep = keep_mask & row_mask(batch.num_rows, cap)
-    n_keep = int(jax.device_get(jnp.sum(keep)))
-    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
-    return gather_batch(batch, order, n_keep)
+    order, n = _compact_plan(keep_mask, jnp.int32(batch.num_rows))
+    return gather_batch(batch, order, int(jax.device_get(n)))
 
 
 def slice_batch_host(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
     """Row-range slice via gather (used by limit; reference: limit.scala:39-123)."""
-    length = max(0, min(length, batch.num_rows - start))
+    length = max(0, min(length, batch.host_rows() - start))
     idx = jnp.arange(bucket_capacity(max(length, 1)), dtype=jnp.int32) + start
     return gather_batch(batch, idx, length)

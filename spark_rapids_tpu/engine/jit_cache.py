@@ -1,0 +1,38 @@
+"""Process-wide jitted-kernel cache.
+
+jax.jit caches compiled executables per *function object*; exec nodes are
+rebuilt for every query execution, so per-instance closures would recompile
+the same kernel on every collect(). The reference does not have this problem
+(cudf kernels are precompiled); the TPU analog is to key the jitted callable
+by the semantic identity of the kernel — expression fingerprints + operator
+structure — so repeated queries (and repeated shapes within a query) hit
+XLA's compilation cache.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable
+
+_LOCK = threading.Lock()
+_CACHE: Dict[Hashable, Any] = {}
+
+
+def get_or_build(key: Hashable, builder: Callable[[], Any]) -> Any:
+    with _LOCK:
+        got = _CACHE.get(key)
+        if got is not None:
+            return got
+    built = builder()
+    with _LOCK:
+        return _CACHE.setdefault(key, built)
+
+
+def clear() -> None:
+    with _LOCK:
+        _CACHE.clear()
+
+
+def stats() -> Dict[str, int]:
+    with _LOCK:
+        return {"entries": len(_CACHE)}

@@ -187,56 +187,149 @@ def _default_row_values(specs: List[AggSpec]) -> List[Any]:
 # ===========================================================================
 # TPU exec
 # ===========================================================================
+def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression]):
+    """Fuse a TpuFilter/TpuProject/TpuCoalesceBatches chain below the
+    aggregate into its update kernel: project lists substitute into the
+    aggregate's expressions, filter conditions become row masks evaluated
+    inside the SAME jit. This removes the filter's compact (a device->host
+    row-count sync + gather) from the hot path entirely — the XLA analog of
+    cuDF's pre-projection into the groupby (aggregate.scala:307-336).
+
+    Returns (scan child, rewritten exprs, filter conditions)."""
+    from spark_rapids_tpu.exec import basic as B
+    from spark_rapids_tpu.exec.transitions import TpuCoalesceBatchesExec
+
+    filters: List[Expression] = []
+    exprs = list(exprs)
+    node = child
+    while True:
+        if isinstance(node, B.TpuProjectExec):
+            mapping: Dict[int, Expression] = {}
+            for e in node.project_list:
+                attr = to_attribute(e)
+                mapping[attr.expr_id] = e.child if isinstance(e, Alias) else e
+
+            def sub(x: Expression) -> Expression:
+                if isinstance(x, AttributeReference) and \
+                        x.expr_id in mapping:
+                    return mapping[x.expr_id]
+                return x
+
+            exprs = [e.transform_up(sub) for e in exprs]
+            filters = [f.transform_up(sub) for f in filters]
+            node = node.children[0]
+        elif isinstance(node, B.TpuFilterExec):
+            filters.append(node.condition)
+            node = node.children[0]
+        elif isinstance(node, TpuCoalesceBatchesExec):
+            node = node.children[0]
+        else:
+            break
+    if any(not e.deterministic for e in exprs + filters):
+        return child, list(exprs), []  # cannot push past a filter safely
+    return node, exprs, filters
+
+
 class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
     placement = "tpu"
 
-    # -- jitted kernels (built lazily, cached per exec instance) -------------
-    def _build_update_kernel(self, input_attrs):
-        bound_keys = bind_all(self.key_exprs, input_attrs)
-        ops = self._update_ops()
-        bound_inputs = bind_all([e for _, e, _ in ops], input_attrs)
-        op_names = [op for op, _, _ in ops]
+    # -- jitted kernels (cached process-wide by semantic identity) -----------
+    def _build_update_kernel(self, input_attrs, key_exprs, input_exprs,
+                             op_names, filters, lazy: bool):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+        bound_keys = bind_all(key_exprs, input_attrs)
+        bound_inputs = bind_all(input_exprs, input_attrs)
+        bound_filters = bind_all(filters, input_attrs)
+        key = ("agg_update", lazy,
+               tuple(e.fingerprint() for e in bound_keys),
+               tuple(zip(op_names,
+                         (e.fingerprint() for e in bound_inputs))),
+               tuple(f.fingerprint() for f in bound_filters))
+        buffer_npdts = tuple(physical_np_dtype(a.data_type)
+                             for a in self.buffer_attrs)
         from spark_rapids_tpu.ops.values import EvalContext, ScalarV
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
-        def kernel(cols, num_rows):
-            capacity = cols[0].validity.shape[0] if cols else 8
-            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+        def build():
+            def kernel(cols, num_rows):
+                capacity = cols[0].validity.shape[0] if cols else 8
+                ctx = EvalContext(jnp, True, cols, num_rows, capacity)
 
-            def as_col(e):
-                r = e.eval(ctx)
-                if isinstance(r, ScalarV):
-                    r = _scalar_to_colv(ctx, r, e.data_type)
-                return r
+                def as_col(e):
+                    r = e.eval(ctx)
+                    if isinstance(r, ScalarV):
+                        r = _scalar_to_colv(ctx, r, e.data_type)
+                    return r
 
-            key_cols = [as_col(e) for e in bound_keys]
-            in_cols = [as_col(e) for e in bound_inputs]
-            gi = _group_info(key_cols, num_rows, capacity)
-            buf_outs = []
-            for op, cv in zip(op_names, in_cols):
-                data, validity = RK.segment_reduce(
-                    op, cv.data, cv.validity, gi.gid, num_rows, capacity)
-                buf_outs.append((data, validity))
-            return key_cols, buf_outs, gi
+                live = ctx.row_mask()
+                for f in bound_filters:
+                    r = f.eval(ctx)
+                    if isinstance(r, ScalarV):
+                        live = live & ((not r.is_null) and bool(r.value))
+                    else:
+                        live = live & r.data.astype(bool) & r.validity
+                key_cols = [as_col(e) for e in bound_keys]
+                in_cols = [as_col(e) for e in bound_inputs]
+                gi = _group_info_masked(key_cols, live, capacity)
+                buf_outs = []
+                for op, cv in zip(op_names, in_cols):
+                    data, validity = RK.segment_reduce(
+                        op, cv.data, cv.validity & live, gi.gid, num_rows,
+                        capacity)
+                    buf_outs.append((data, validity))
+                if lazy:
+                    return (_assemble_traced(key_cols, buf_outs, gi,
+                                             capacity, buffer_npdts),
+                            gi.num_groups)
+                return key_cols, buf_outs, gi
 
-        return jax.jit(kernel)
+            return jax.jit(kernel)
 
-    def _build_merge_kernel(self, n_keys: int):
+        return get_or_build(key, build)
+
+    def _lazy_ok(self) -> bool:
+        """In-kernel assembly (device-scalar row counts, zero per-batch
+        syncs) works for fixed-width schemas; string output columns need a
+        host-coordinated byte-count gather."""
+        return all(a.data_type is not DataType.STRING
+                   for a in self._inter_attrs)
+
+    def _lazy_batch(self, outs, num_groups) -> ColumnarBatch:
+        cols = []
+        for (data, validity), attr in zip(outs, self._inter_attrs):
+            cols.append(ColumnVector(attr.data_type, data, validity))
+        return ColumnarBatch(cols, num_groups)
+
+    def _build_merge_kernel(self, n_keys: int, lazy: bool):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
         ops = [op for op, _ in self._merge_ops()]
+        key = ("agg_merge", lazy, n_keys, tuple(ops),
+               tuple(a.data_type for a in self._inter_attrs))
+        buffer_npdts = tuple(physical_np_dtype(a.data_type)
+                             for a in self.buffer_attrs)
 
-        def kernel(cols, num_rows):
-            capacity = cols[0].validity.shape[0] if cols else 8
-            key_cols = cols[:n_keys]
-            buf_cols = cols[n_keys:]
-            gi = _group_info(key_cols, num_rows, capacity)
-            buf_outs = []
-            for op, cv in zip(ops, buf_cols):
-                data, validity = RK.segment_reduce(
-                    op, cv.data, cv.validity, gi.gid, num_rows, capacity)
-                buf_outs.append((data, validity))
-            return key_cols, buf_outs, gi
+        def build():
+            def kernel(cols, num_rows):
+                capacity = cols[0].validity.shape[0] if cols else 8
+                key_cols = cols[:n_keys]
+                buf_cols = cols[n_keys:]
+                gi = _group_info(key_cols, num_rows, capacity)
+                buf_outs = []
+                for op, cv in zip(ops, buf_cols):
+                    data, validity = RK.segment_reduce(
+                        op, cv.data, cv.validity, gi.gid, num_rows, capacity)
+                    buf_outs.append((data, validity))
+                if lazy:
+                    return (_assemble_traced(key_cols, buf_outs, gi,
+                                             capacity, buffer_npdts),
+                            gi.num_groups)
+                return key_cols, buf_outs, gi
 
-        return jax.jit(kernel)
+            return jax.jit(kernel)
+
+        return get_or_build(key, build)
 
     # -- assembling an intermediate [keys+buffers] device batch --------------
     def _assemble(self, key_cols, buf_outs, gi, capacity) -> ColumnarBatch:
@@ -259,33 +352,71 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         return ColumnarBatch(cols, n_groups)
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
-        child_pb = self.children[0].execute(ctx)
-        child_attrs = self.children[0].output
+        do_update = self.mode in (PARTIAL, COMPLETE)
+        child = self.children[0]
+        key_exprs = self.key_exprs
+        ops = self._update_ops()
+        input_exprs = [e for _, e, _ in ops]
+        op_names = [op for op, _, _ in ops]
+        filters: List[Expression] = []
+        if do_update:
+            n_in = len(key_exprs)
+            scan, rewritten, filters = _collapse_scan_chain(
+                child, list(key_exprs) + list(input_exprs))
+            if scan is not child:
+                child = scan
+                key_exprs = rewritten[:n_in]
+                input_exprs = rewritten[n_in:]
+        child_pb = child.execute(ctx)
+        child_attrs = child.output
         update_kernel = [None]
         merge_kernel = [None]
         n_keys = len(self.grouping)
-        do_update = self.mode in (PARTIAL, COMPLETE)
+        # The update (partial) stage compacts with a row-count sync: group
+        # counts are usually a tiny fraction of input rows, and shrinking
+        # capacities 100x+ here makes everything downstream (shuffle concat,
+        # merge sorts, result download) proportionally cheaper. The merge
+        # stage stays sync-free — its inputs are already small.
+        update_lazy = False
+        lazy = self._lazy_ok()
+
+        def count_arg(b: ColumnarBatch):
+            return jnp.asarray(b.num_rows, dtype=jnp.int32)
 
         def merge(batch: ColumnarBatch) -> ColumnarBatch:
             if merge_kernel[0] is None:
-                merge_kernel[0] = self._build_merge_kernel(n_keys)
+                merge_kernel[0] = self._build_merge_kernel(n_keys, lazy)
             cols = [_col_to_colv(c) for c in batch.columns]
-            k, b, gi = merge_kernel[0](cols, jnp.int32(batch.num_rows))
+            out = merge_kernel[0](cols, count_arg(batch))
+            if lazy:
+                outs, num_groups = out
+                return self._lazy_batch(outs, num_groups)
+            k, b, gi = out
             return self._assemble(k, b, gi, batch.capacity)
 
         def agg_partition(pidx: int):
+            from spark_rapids_tpu.columnar.batch import ensure_compact
+
             running: Optional[ColumnarBatch] = None
             for batch in child_pb.iterator(pidx):
-                if batch.num_rows == 0:
+                if batch.rows_on_host and batch.num_rows == 0:
                     continue
+                batch = ensure_compact(batch)
                 if do_update:
                     if update_kernel[0] is None:
-                        update_kernel[0] = self._build_update_kernel(child_attrs)
+                        update_kernel[0] = self._build_update_kernel(
+                            child_attrs, key_exprs, input_exprs, op_names,
+                            filters, update_lazy)
                     cols = [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
-                    k, b, gi = update_kernel[0](cols, jnp.int32(batch.num_rows))
-                    local = self._assemble(k, b, gi, batch.capacity)
+                    out = update_kernel[0](cols, count_arg(batch))
+                    if update_lazy:
+                        outs, num_groups = out
+                        local = self._lazy_batch(outs, num_groups)
+                    else:
+                        k, b, gi = out
+                        local = self._assemble(k, b, gi, batch.capacity)
                     # a fresh update output has unique keys already
                     if running is None:
                         running = local
@@ -309,6 +440,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             if running is not None:
                 yield running
             return
+        if running is not None and not self.grouping:
+            # the empty ungrouped reduction must emit the default row; a
+            # device-count batch needs one scalar sync to know
+            if running.host_rows() == 0:
+                running = None
         if running is None:
             if not self.grouping and pidx == 0:
                 yield _default_row_batch_device(self.specs, self._inter_attrs,
@@ -327,15 +463,40 @@ def _synth_col(batch: ColumnarBatch):
                 jnp.arange(cap) < batch.num_rows)
 
 
+def _assemble_traced(key_cols, buf_outs, gi, capacity: int, buffer_npdts):
+    """In-kernel compaction to group slots: one (data, validity) pair per
+    output column, all lanes >= num_groups masked dead. Runs inside the
+    update/merge jit — no host round trip. Module-level on purpose: jit
+    closures are cached process-wide, so they must not capture the exec
+    (which would pin the whole plan + source data in memory)."""
+    slot = jnp.arange(capacity) < gi.num_groups
+    rep = jnp.clip(gi.rep_rows, 0, capacity - 1)
+    outs = []
+    for cv in key_cols:
+        data = jnp.where(slot, cv.data[rep], jnp.zeros((), cv.data.dtype))
+        validity = jnp.where(slot, cv.validity[rep], False)
+        outs.append((data, validity))
+    for (data, validity), npdt in zip(buf_outs, buffer_npdts):
+        d = data.astype(npdt) if data.dtype != jnp.dtype(npdt) else data
+        v = validity & slot
+        d = jnp.where(v, d, jnp.zeros((), d.dtype))
+        outs.append((d, v))
+    return outs
+
+
 def _group_info(key_cols, num_rows, capacity: int) -> RK.GroupInfo:
+    return _group_info_masked(key_cols, jnp.arange(capacity) < num_rows,
+                              capacity)
+
+
+def _group_info_masked(key_cols, live, capacity: int) -> RK.GroupInfo:
     if not key_cols:
-        rows = jnp.arange(capacity)
-        gid = jnp.where(rows < num_rows, 0, capacity).astype(jnp.int32)
-        num_groups = jnp.minimum(num_rows, 1).astype(jnp.int32)
+        gid = jnp.where(live, 0, capacity).astype(jnp.int32)
+        num_groups = jnp.minimum(jnp.sum(live.astype(jnp.int32)), 1)
         rep = jnp.zeros((capacity,), jnp.int32)
-        return RK.GroupInfo(gid, num_groups, rep)
+        return RK.GroupInfo(gid, num_groups.astype(jnp.int32), rep)
     proxies = [RK.key_proxy(cv) for cv in key_cols]
-    return RK.group_ids(proxies, num_rows, capacity)
+    return RK.group_ids_masked(proxies, live, capacity)
 
 
 def _default_row_batch_device(specs, inter_attrs, agg_exprs) -> ColumnarBatch:

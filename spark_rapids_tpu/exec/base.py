@@ -141,11 +141,15 @@ class CpuExec(PhysicalExec):
 # Batch-count helpers shared by exec implementations
 # ---------------------------------------------------------------------------
 def count_output(metrics: M.MetricsMap, it: Iterator) -> Iterator:
-    """Wrap an iterator updating the standard output metrics."""
+    """Wrap an iterator updating the standard output metrics. Batches whose
+    row count still lives on the device are counted as batches only — a
+    metric read must never force a device sync."""
     rows_m = metrics[M.NUM_OUTPUT_ROWS]
     batches_m = metrics[M.NUM_OUTPUT_BATCHES]
     for b in it:
-        rows_m.add(b.num_rows)
+        n = b.num_rows
+        if isinstance(n, int):
+            rows_m.add(n)
         batches_m.add(1)
         yield b
 

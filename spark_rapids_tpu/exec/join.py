@@ -144,6 +144,14 @@ class _DeviceJoiner:
         self._jitted = None
 
     def _build(self):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+        cache_key = ("join", self.mode,
+                     tuple(e.fingerprint() for e in self.bound_stream),
+                     tuple(e.fingerprint() for e in self.bound_build))
+        return get_or_build(cache_key, self._build_uncached)
+
+    def _build_uncached(self):
         bound_stream, bound_build = self.bound_stream, self.bound_build
         mode = self.mode
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
@@ -275,7 +283,7 @@ class _TpuJoinMixin:
 
         b_matched_acc = None
         for stream_batch in stream_iter:
-            if stream_batch.num_rows == 0:
+            if stream_batch.host_rows() == 0:
                 continue
             (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
              b_matched) = joiner.plan(stream_batch, build)
@@ -382,7 +390,8 @@ class TpuShuffledHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
         emit_tail = self.join_type is JoinType.FULL_OUTER
 
         def factory(pidx: int):
-            builds = [b for b in build_pb.iterator(pidx) if b.num_rows > 0]
+            builds = [b for b in build_pb.iterator(pidx)
+                      if b.host_rows() > 0]
             if builds:
                 build = builds[0] if len(builds) == 1 else \
                     concat_batches(builds)
@@ -403,13 +412,18 @@ class TpuBroadcastHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
     placement = "tpu"
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        if self.join_type is JoinType.FULL_OUTER:
+            # the unmatched-build tail would be emitted once per stream
+            # partition; the planner never broadcasts full outer joins
+            raise NotImplementedError(
+                "full outer join cannot use the broadcast path")
         build_child = 0 if self.build_left else 1
         stream_child = 1 - build_child
         build_pb = self.children[build_child].execute(ctx)
         stream_pb = self.children[stream_child].execute(ctx)
 
         def collect_build(pidx: int):
-            return [b for b in build_pb.iterator(pidx) if b.num_rows > 0]
+            return [b for b in build_pb.iterator(pidx) if b.host_rows() > 0]
 
         if ctx.scheduler is not None:
             parts = ctx.scheduler.run_job(build_pb.num_partitions,
@@ -444,7 +458,8 @@ class TpuNestedLoopJoinExec(_JoinBase, TpuExec):
         right_pb = self.children[1].execute(ctx)
 
         def collect_right(pidx: int):
-            return [b for b in right_pb.iterator(pidx) if b.num_rows > 0]
+            return [b for b in right_pb.iterator(pidx)
+                    if b.host_rows() > 0]
 
         if ctx.scheduler is not None:
             parts = ctx.scheduler.run_job(right_pb.num_partitions,
@@ -462,7 +477,7 @@ class TpuNestedLoopJoinExec(_JoinBase, TpuExec):
         def factory(pidx: int):
             def gen():
                 for sb in left_pb.iterator(pidx):
-                    if sb.num_rows == 0 or build.num_rows == 0:
+                    if sb.host_rows() == 0 or build.host_rows() == 0:
                         continue
                     n_out = sb.num_rows * build.num_rows
                     cap = bucket_capacity(n_out)
@@ -509,6 +524,9 @@ class CpuShuffledHashJoinExec(_JoinBase, CpuExec):
     broadcast = False
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        if self.broadcast and self.join_type is JoinType.FULL_OUTER:
+            raise NotImplementedError(
+                "full outer join cannot use the broadcast path")
         left_pb = self.children[0].execute(ctx)
         right_pb = self.children[1].execute(ctx)
         build_left = self.build_left

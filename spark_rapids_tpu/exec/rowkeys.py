@@ -53,9 +53,17 @@ class KeyProxy(NamedTuple):
 
 
 def _float_order_bits(data) -> Any:
-    """Map float32 to uint32 preserving total order: -NaN < -inf < ... <
-    -0.0 == 0.0 < ... < inf < NaN, with all NaNs canonicalized to +NaN
-    (Spark sorts NaN greater than any value)."""
+    """Map a float array to unsigned bits preserving total order: -NaN <
+    -inf < ... < -0.0 == 0.0 < ... < inf < NaN, with all NaNs canonicalized
+    (Spark sorts NaN greater than any value). float64 inputs (the CPU-backed
+    oracle-parity environment stores DOUBLE as real f64) use the 64-bit
+    transform — narrowing them to f32 would merge distinct keys."""
+    if jnp.dtype(data.dtype) == jnp.dtype(jnp.float64):
+        f = jnp.where(data == 0.0, jnp.zeros((), jnp.float64), data)
+        f = jnp.where(jnp.isnan(f), jnp.full((), jnp.nan, jnp.float64), f)
+        bits = f.view(jnp.uint64)
+        sign = (bits >> jnp.uint64(63)).astype(bool)
+        return jnp.where(sign, ~bits, bits | jnp.uint64(1 << 63))
     f32 = data.astype(jnp.float32)
     f32 = jnp.where(f32 == 0.0, jnp.zeros((), jnp.float32), f32)
     f32 = jnp.where(jnp.isnan(f32), jnp.full((), jnp.nan, jnp.float32), f32)
@@ -84,6 +92,23 @@ def key_proxy(col: ColV) -> KeyProxy:
     return KeyProxy((data,), ~col.validity, True)
 
 
+def _invert_order(arr):
+    """Monotonically order-reversing transform (for descending keys):
+    bitwise NOT reverses order for signed, unsigned, and bool alike."""
+    return ~arr
+
+
+def _multi_key_sort(operands, capacity: int):
+    """ONE lax.sort HLO over all key operands (lexicographic, stable) with
+    a row-index payload — instead of a chain of argsort passes. XLA fuses
+    the comparator; on TPU this is several times faster than iterated
+    argsorts of 64-bit keys."""
+    payload = jnp.arange(capacity, dtype=jnp.int32)
+    result = jax.lax.sort(tuple(operands) + (payload,),
+                          is_stable=True, num_keys=len(operands))
+    return result[-1]
+
+
 def sort_permutation(proxies: Sequence[KeyProxy],
                      directions: Sequence[Tuple[bool, bool]],
                      num_rows, capacity: int):
@@ -92,20 +117,15 @@ def sort_permutation(proxies: Sequence[KeyProxy],
     directions[i] = (ascending, nulls_first) for proxies[i]. Requires every
     proxy to be orderable. Padded rows land at the end.
     """
-    order = jnp.arange(capacity, dtype=jnp.int32)
-    # least-significant key first; each key = value passes then a null pass
-    for proxy, (ascending, nulls_first) in zip(reversed(list(proxies)),
-                                               reversed(list(directions))):
+    pad = jnp.arange(capacity) >= num_rows
+    operands = [pad]  # most significant: pads last
+    for proxy, (ascending, nulls_first) in zip(proxies, directions):
         assert proxy.orderable, "sort on equality-only key proxy"
-        for arr in reversed(proxy.arrays):
-            vals = arr[order]
-            order = order[jnp.argsort(vals, stable=True,
-                                      descending=not ascending)]
-        nf = proxy.null_flag[order]
-        order = order[jnp.argsort(nf, stable=True, descending=nulls_first)]
-    pad = order >= num_rows
-    order = order[jnp.argsort(pad, stable=True)]
-    return order
+        nf = proxy.null_flag
+        operands.append(~nf if nulls_first else nf)
+        for arr in proxy.arrays:
+            operands.append(arr if ascending else _invert_order(arr))
+    return _multi_key_sort(operands, capacity)
 
 
 def group_sort_permutation(proxies: Sequence[KeyProxy], num_rows,
@@ -120,14 +140,11 @@ def group_sort_permutation_masked(proxies: Sequence[KeyProxy], valid_mask,
                                   capacity: int):
     """Like group_sort_permutation but with an arbitrary row-validity mask
     (used by the join's union grouping where live rows are interleaved)."""
-    order = jnp.arange(capacity, dtype=jnp.int32)
-    for proxy in reversed(list(proxies)):
-        for arr in reversed(proxy.arrays):
-            order = order[jnp.argsort(arr[order], stable=True)]
-        order = order[jnp.argsort(proxy.null_flag[order], stable=True)]
-    pad = ~valid_mask[order]
-    order = order[jnp.argsort(pad, stable=True)]
-    return order
+    operands = [~valid_mask]  # pads last
+    for proxy in proxies:
+        operands.append(proxy.null_flag)
+        operands.extend(proxy.arrays)
+    return _multi_key_sort(operands, capacity)
 
 
 def _neighbor_differs(proxies: Sequence[KeyProxy], order) -> Any:
@@ -216,14 +233,16 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
                 # reduce on total-order bits so NaN sorts greater than every
                 # number (Spark semantics: min skips NaN unless all-NaN)
                 bits = _float_order_bits(data)
+                top = jnp.array(jnp.iinfo(bits.dtype).max, bits.dtype)
+                bot = jnp.array(0, bits.dtype)
                 if op == "min":
                     r = jax.ops.segment_min(
-                        jnp.where(seg < capacity, bits, jnp.uint32(0xFFFFFFFF)),
-                        seg, num_segments=capacity)
+                        jnp.where(seg < capacity, bits, top), seg,
+                        num_segments=capacity)
                 else:
                     r = jax.ops.segment_max(
-                        jnp.where(seg < capacity, bits, jnp.uint32(0)),
-                        seg, num_segments=capacity)
+                        jnp.where(seg < capacity, bits, bot), seg,
+                        num_segments=capacity)
                 out = _float_from_order_bits(r).astype(data.dtype)
             elif op == "min":
                 out = jax.ops.segment_min(_mask_for_min(data, seg, capacity),
@@ -264,6 +283,10 @@ def _mask_for_max(data, seg, capacity: int):
 
 def _float_from_order_bits(flipped):
     """Inverse of _float_order_bits (modulo -0.0/NaN canonicalization)."""
+    if jnp.dtype(flipped.dtype) == jnp.dtype(jnp.uint64):
+        top = (flipped & jnp.uint64(1 << 63)) != 0
+        bits = jnp.where(top, flipped ^ jnp.uint64(1 << 63), ~flipped)
+        return bits.view(jnp.float64)
     top = (flipped & jnp.uint32(0x80000000)) != 0
     bits = jnp.where(top, flipped ^ jnp.uint32(0x80000000), ~flipped)
     return bits.view(jnp.float32)

@@ -71,22 +71,29 @@ class TpuSortExec(_SortBase, TpuExec):
     placement = "tpu"
 
     def _build_kernel(self, input_attrs):
-        bound = bind_sort_orders(self.orders, input_attrs)
-        directions = [(o.ascending, o.nulls_first) for o in bound]
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
-        def kernel(cols, num_rows):
-            capacity = cols[0].validity.shape[0]
-            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
-            proxies = []
-            for o in bound:
-                r = o.child.eval(ctx)
-                if isinstance(r, ScalarV):
-                    r = _scalar_to_colv(ctx, r, o.child.data_type)
-                proxies.append(RK.key_proxy(r))
-            return RK.sort_permutation(proxies, directions, num_rows, capacity)
+        bound = bind_sort_orders(self.orders, input_attrs)
+        directions = [(o.ascending, o.nulls_first) for o in bound]
+        key = ("sort", tuple(o.fingerprint() for o in bound))
 
-        return jax.jit(kernel)
+        def build():
+            def kernel(cols, num_rows):
+                capacity = cols[0].validity.shape[0]
+                ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+                proxies = []
+                for o in bound:
+                    r = o.child.eval(ctx)
+                    if isinstance(r, ScalarV):
+                        r = _scalar_to_colv(ctx, r, o.child.data_type)
+                    proxies.append(RK.key_proxy(r))
+                return RK.sort_permutation(proxies, directions, num_rows,
+                                           capacity)
+
+            return jax.jit(kernel)
+
+        return get_or_build(key, build)
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         child_pb = self.children[0].execute(ctx)
@@ -95,7 +102,7 @@ class TpuSortExec(_SortBase, TpuExec):
 
         def sort_partition(pidx: int):
             for batch in child_pb.iterator(pidx):
-                if batch.num_rows == 0:
+                if batch.host_rows() == 0:
                     yield batch
                     continue
                 if kernel[0] is None:

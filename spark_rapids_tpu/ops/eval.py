@@ -81,21 +81,28 @@ class DeviceProjector:
         self._jitted = None
 
     def _build(self):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
         exprs = self.exprs
+        key = ("project", tuple(e.fingerprint() for e in exprs))
 
-        def fn(cols: List[ColV], num_rows, partition_id, row_start):
-            capacity = cols[0].validity.shape[0] if cols else 8
-            ctx = EvalContext(jnp, True, cols, num_rows, capacity,
-                              partition_id=partition_id, row_start=row_start)
-            outs = []
-            for e in exprs:
-                r = e.eval(ctx)
-                if isinstance(r, ScalarV):
-                    r = _scalar_to_colv(ctx, r, e.data_type)
-                outs.append(r)
-            return outs
+        def build():
+            def fn(cols: List[ColV], num_rows, partition_id, row_start):
+                capacity = cols[0].validity.shape[0] if cols else 8
+                ctx = EvalContext(jnp, True, cols, num_rows, capacity,
+                                  partition_id=partition_id,
+                                  row_start=row_start)
+                outs = []
+                for e in exprs:
+                    r = e.eval(ctx)
+                    if isinstance(r, ScalarV):
+                        r = _scalar_to_colv(ctx, r, e.data_type)
+                    outs.append(r)
+                return outs
 
-        return jax.jit(fn)
+            return jax.jit(fn)
+
+        return get_or_build(key, build)
 
     def project(self, batch: ColumnarBatch, partition_id: int = 0,
                 row_start: int = 0) -> ColumnarBatch:
@@ -107,15 +114,13 @@ class DeviceProjector:
             # synthetic capacity derived from num_rows
             from spark_rapids_tpu.columnar.batch import bucket_capacity
 
-            cap = bucket_capacity(max(batch.num_rows, 1))
+            cap = bucket_capacity(max(batch.host_rows(), 1))
             cols = [ColV(DataType.BOOL,
                          jnp.zeros((cap,), dtype=bool),
                          jnp.arange(cap) < batch.num_rows)]
-            outs = self._jitted(cols, jnp.int32(batch.num_rows),
-                                jnp.int32(partition_id), jnp.int64(row_start))
-        else:
-            outs = self._jitted(cols, jnp.int32(batch.num_rows),
-                                jnp.int32(partition_id), jnp.int64(row_start))
+        n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
+        outs = self._jitted(cols, n, jnp.int32(partition_id),
+                            jnp.int64(row_start))
         return ColumnarBatch([_colv_to_col(o) for o in outs], batch.num_rows)
 
 
@@ -128,21 +133,28 @@ class DeviceFilter:
         self._jitted = None
 
     def _build(self):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
         cond = self.condition
+        key = ("filter", cond.fingerprint())
 
-        def fn(cols, num_rows, partition_id, row_start):
-            capacity = cols[0].validity.shape[0]
-            ctx = EvalContext(jnp, True, cols, num_rows, capacity,
-                              partition_id=partition_id, row_start=row_start)
-            r = cond.eval(ctx)
-            if isinstance(r, ScalarV):
-                keep = jnp.full((capacity,),
-                                (not r.is_null) and bool(r.value))
-            else:
-                keep = r.data.astype(bool) & r.validity  # null -> dropped
-            return keep & ctx.row_mask()
+        def build():
+            def fn(cols, num_rows, partition_id, row_start):
+                capacity = cols[0].validity.shape[0]
+                ctx = EvalContext(jnp, True, cols, num_rows, capacity,
+                                  partition_id=partition_id,
+                                  row_start=row_start)
+                r = cond.eval(ctx)
+                if isinstance(r, ScalarV):
+                    keep = jnp.full((capacity,),
+                                    (not r.is_null) and bool(r.value))
+                else:
+                    keep = r.data.astype(bool) & r.validity  # null -> dropped
+                return keep & ctx.row_mask()
 
-        return jax.jit(fn)
+            return jax.jit(fn)
+
+        return get_or_build(key, build)
 
     def apply(self, batch: ColumnarBatch, partition_id: int = 0,
               row_start: int = 0) -> ColumnarBatch:

@@ -1,0 +1,16 @@
+"""Multi-chip parallel execution: device mesh + ICI collective shuffle.
+
+Reference parity: SURVEY.md section 2.8 tier B — the UCX peer-to-peer shuffle
+(shuffle-plugin/.../ucx/, 1,788 LoC of tag-matched RDMA) mapped to the TPU
+fabric the idiomatic way: a `jax.sharding.Mesh` over the pod slice, with the
+repartition step expressed as a jitted `shard_map` whose `lax.all_to_all`
+rides ICI (and DCN across pods, handled transparently by XLA's collective
+lowering). There is no connection management, tag scheme, or bounce-buffer
+pool to port: the compiler owns transport.
+"""
+
+from spark_rapids_tpu.parallel.mesh import (  # noqa: F401
+    all_to_all_table,
+    build_mesh,
+    distributed_agg_step,
+)

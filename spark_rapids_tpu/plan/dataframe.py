@@ -261,6 +261,25 @@ class DataFrame:
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         return self.join(other, on=None, how="cross")
 
+    # -- caching --------------------------------------------------------------
+    def cache(self) -> "DataFrame":
+        """Cache this DataFrame's batches in memory (device-resident on the
+        TPU engine; reference: df.cache() served by the accelerated
+        InMemoryTableScan path)."""
+        if isinstance(self._plan, L.CacheRelation):
+            return self
+        return self._with_plan(L.CacheRelation(self._plan))
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        from spark_rapids_tpu.exec.cache import invalidate
+
+        if isinstance(self._plan, L.CacheRelation):
+            invalidate(self._plan)
+            return self._with_plan(self._plan.children[0])
+        return self
+
     # -- actions --------------------------------------------------------------
     def collect(self) -> List[tuple]:
         return self.session.execute_collect(self._plan)

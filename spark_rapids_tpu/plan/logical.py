@@ -297,6 +297,20 @@ class WindowOp(LogicalPlan):
         return self.children[0].output + [to_attribute(e) for e in self.window_exprs]
 
 
+class CacheRelation(LogicalPlan):
+    """Marks the child as cached in memory (reference: InMemoryRelation,
+    accelerated via HostColumnarToGpu / cache_test.py). The physical cache
+    exec materializes the child once per engine placement and serves the
+    stored batches afterwards."""
+
+    def __init__(self, child: LogicalPlan):
+        super().__init__(child)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+
 class WriteFile(LogicalPlan):
     """Write to files (reference: GpuInsertIntoHadoopFsRelationCommand +
     GpuParquetFileFormat/GpuOrcFileFormat)."""

@@ -276,6 +276,15 @@ def _register_feature_exec_rules():
         J.CpuNestedLoopJoinExec, "cross/nested-loop join",
         _convert_join(J.TpuNestedLoopJoinExec))
 
+    from spark_rapids_tpu.exec.cache import (
+        CpuCachedScanExec,
+        TpuCachedScanExec,
+    )
+
+    register_exec(
+        CpuCachedScanExec, "device-resident in-memory table cache",
+        lambda cpu, ch: TpuCachedScanExec(cpu.logical_node, ch[0]))
+
 
 # ---------------------------------------------------------------------------
 # Node-expression extraction (which expressions does a node evaluate?)

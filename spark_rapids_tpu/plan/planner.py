@@ -95,6 +95,14 @@ def _plan_repartition(plan: L.Repartition, conf: C.TpuConf) -> PhysicalExec:
     return plan_repartition_exchange(plan, child, conf)
 
 
+@register_planner(L.CacheRelation)
+def _plan_cache(plan: L.CacheRelation, conf: C.TpuConf) -> PhysicalExec:
+    from spark_rapids_tpu.exec.cache import CpuCachedScanExec
+
+    (child,) = _plan_children(plan, conf)
+    return CpuCachedScanExec(plan, child)
+
+
 @register_planner(L.Aggregate)
 def _plan_aggregate(plan: L.Aggregate, conf: C.TpuConf) -> PhysicalExec:
     """partial agg -> hash exchange on keys -> final agg (reference call

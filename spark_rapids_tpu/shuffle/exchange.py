@@ -146,10 +146,12 @@ class _ExchangeBase(PhysicalExec):
         def run_map(pidx: int) -> List[List[Any]]:
             buckets: List[List[Any]] = [[] for _ in range(n_out)]
             for batch in child_pb.iterator(pidx):
-                if batch.num_rows == 0:
+                if getattr(batch, "rows_on_host", True) and \
+                        batch.num_rows == 0:
                     continue
                 for target, piece in map_fn(pidx, batch):
-                    if piece.num_rows > 0:
+                    if not getattr(piece, "rows_on_host", True) or \
+                            piece.num_rows > 0:
                         buckets[target].append(piece)
             return buckets
 
@@ -173,6 +175,10 @@ class _ExchangeBase(PhysicalExec):
 
 def _piece_bytes(piece) -> int:
     if isinstance(piece, ColumnarBatch):
+        if piece.live is not None:
+            # zero-copy view sharing the source batch: counting the full
+            # shared buffers once per target would overreport n_partitions-x
+            return 0
         return piece.device_memory_size()
     return piece.estimated_size_bytes()
 
@@ -348,13 +354,19 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         if isinstance(p, SinglePartitioning):
             return self._materialize(ctx, lambda pidx, b: [(0, b)])
 
+        no_strings = all(a.data_type is not DataType.STRING
+                         for a in child_attrs)
+        slicer = _device_slices_lazy if no_strings else _device_slices
+
         if isinstance(p, RoundRobinPartitioning):
             jitted = _jit_rr_ids(n)
 
             def rr_map(pidx: int, batch: ColumnarBatch):
-                ids = jitted(jnp.int32(pidx), jnp.int32(batch.num_rows),
+                batch = _compacted(batch)
+                ids = jitted(jnp.int32(pidx),
+                             jnp.asarray(batch.num_rows, dtype=jnp.int32),
                              batch.capacity)
-                return _device_slices(batch, ids, n)
+                return slicer(batch, ids, n)
             return self._materialize(ctx, rr_map)
 
         if isinstance(p, HashPartitioning):
@@ -362,11 +374,13 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             jitted = [None]
 
             def hash_map(pidx: int, batch: ColumnarBatch):
+                batch = _compacted(batch)
                 if jitted[0] is None:
                     jitted[0] = _build_hash_ids(bound, n)
                 cols = [_col_to_colv(c) for c in batch.columns]
-                ids = jitted[0](cols, jnp.int32(batch.num_rows))
-                return _device_slices(batch, ids, n)
+                ids = jitted[0](cols,
+                                jnp.asarray(batch.num_rows, dtype=jnp.int32))
+                return slicer(batch, ids, n)
             return self._materialize(ctx, hash_map)
 
         if isinstance(p, RangePartitioning):
@@ -447,53 +461,71 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 def _jit_rr_ids(n: int):
     import functools
 
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def f(pidx, num_rows, capacity: int):
-        ids = (jnp.arange(capacity, dtype=jnp.int32) + pidx) % n
-        return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
 
-    return f
+    def build():
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def f(pidx, num_rows, capacity: int):
+            ids = (jnp.arange(capacity, dtype=jnp.int32) + pidx) % n
+            return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+
+        return f
+
+    return get_or_build(("rr_ids", n), build)
 
 
 def _build_hash_ids(bound_exprs, n: int):
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
     from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
-    def f(cols, num_rows):
-        capacity = cols[0].validity.shape[0]
-        ctx = EvalContext(jnp, True, cols, num_rows, capacity)
-        key_cols = []
-        for e in bound_exprs:
-            r = e.eval(ctx)
-            if isinstance(r, ScalarV):
-                r = _scalar_to_colv(ctx, r, e.data_type)
-            key_cols.append(r)
-        ids = H.partition_ids(jnp, key_cols, n)
-        return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+    key = ("hash_ids", tuple(e.fingerprint() for e in bound_exprs), n)
 
-    return jax.jit(f)
+    def build():
+        def f(cols, num_rows):
+            capacity = cols[0].validity.shape[0]
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+            key_cols = []
+            for e in bound_exprs:
+                r = e.eval(ctx)
+                if isinstance(r, ScalarV):
+                    r = _scalar_to_colv(ctx, r, e.data_type)
+                key_cols.append(r)
+            ids = H.partition_ids(jnp, key_cols, n)
+            return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+
+        return jax.jit(f)
+
+    return get_or_build(key, build)
 
 
 def _build_order_keys_kernel(bound_exprs):
-    """One jitted range-key evaluator reused for every batch of the exchange;
-    returns [(order_bits_int64, null_flag)] per key."""
+    """One jitted range-key evaluator reused for every batch of the exchange
+    (process-wide cache); returns [(order_bits_int64, null_flag)] per key."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
 
-    @jax.jit
-    def f(cols, num_rows):
-        capacity = cols[0].validity.shape[0]
-        ctx = EvalContext(jnp, True, cols, num_rows, capacity)
-        out = []
-        for e in bound_exprs:
-            r = e.eval(ctx)
-            if isinstance(r, ScalarV):
-                from spark_rapids_tpu.ops.eval import _scalar_to_colv
+    key = ("order_keys", tuple(e.fingerprint() for e in bound_exprs))
 
-                r = _scalar_to_colv(ctx, r, e.data_type)
-            proxy = RK.key_proxy(r)
-            assert proxy.orderable and len(proxy.arrays) == 1
-            out.append((proxy.arrays[0].astype(jnp.int64), proxy.null_flag))
-        return out
+    def build():
+        @jax.jit
+        def f(cols, num_rows):
+            capacity = cols[0].validity.shape[0]
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+            out = []
+            for e in bound_exprs:
+                r = e.eval(ctx)
+                if isinstance(r, ScalarV):
+                    from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
-    return f
+                    r = _scalar_to_colv(ctx, r, e.data_type)
+                proxy = RK.key_proxy(r)
+                assert proxy.orderable and len(proxy.arrays) == 1
+                out.append((proxy.arrays[0].astype(jnp.int64),
+                            proxy.null_flag))
+            return out
+
+        return f
+
+    return get_or_build(key, build)
 
 
 def _composite(obits: int, is_null: bool, order: SortOrder) -> Tuple[int, int]:
@@ -504,26 +536,66 @@ def _composite(obits: int, is_null: bool, order: SortOrder) -> Tuple[int, int]:
     return (null_rank, v)
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _route_plan(ids, n: int):
+    cap = ids.shape[0]
+    order = jnp.argsort(ids, stable=True).astype(jnp.int32)
+    counts = jax.ops.segment_sum(jnp.ones((cap,), jnp.int32),
+                                 jnp.clip(ids, 0, n), num_segments=n + 1)
+    return order, counts
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _slice_indices(order, start, idx_cap: int):
+    pos = jnp.arange(idx_cap) + start
+    safe = jnp.clip(pos, 0, order.shape[0] - 1)
+    return order[safe]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _lazy_masks(ids, n: int):
+    counts = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.int32),
+                                 jnp.clip(ids, 0, n), num_segments=n + 1)
+    return [ids == t for t in range(n)], [counts[t] for t in range(n)]
+
+
+def _device_slices_lazy(batch: ColumnarBatch, ids, n: int):
+    """Zero-copy split: each piece is the SAME batch with a pid==target live
+    mask — no gather, no row-count sync, no data movement. The reduce-side
+    concat performs the one scatter-compaction. This is the in-process
+    promotion of the reference's device-resident cached shuffle
+    (RapidsShuffleInternalManager.scala:92-141): partitions never leave HBM
+    and never round-trip a count to the host."""
+    masks, counts = _lazy_masks(ids[:batch.capacity], n)
+    return [(t, ColumnarBatch(batch.columns, counts[t], live=masks[t]))
+            for t in range(n)]
+
+
+def _compacted(batch: ColumnarBatch) -> ColumnarBatch:
+    from spark_rapids_tpu.columnar.batch import ensure_compact
+
+    return ensure_compact(batch)
+
+
 def _device_slices(batch: ColumnarBatch, ids, n: int):
     """Contiguous split by partition id: stable sort rows by id, then gather
     each target's contiguous range (reference: GpuPartitioning
-    sliceInternalOnGpu, GpuPartitioning.scala:29-120)."""
+    sliceInternalOnGpu, GpuPartitioning.scala:29-120). One routing dispatch +
+    one fused gather per non-empty target."""
     cap = batch.capacity
-    order = jnp.argsort(ids[:cap], stable=True).astype(jnp.int32)
-    counts = np.asarray(jax.device_get(
-        jax.ops.segment_sum(jnp.ones((cap,), jnp.int32),
-                            jnp.clip(ids[:cap], 0, n), num_segments=n + 1)))
+    order, counts_dev = _route_plan(ids[:cap], n)
+    counts = np.asarray(jax.device_get(counts_dev))
     out = []
     offset = 0
     for t in range(n):
         c = int(counts[t])
         if c == 0:
             continue
-        idx_cap = bucket_capacity(max(c, 1))
-        idx = jnp.concatenate([
-            order[offset:offset + c],
-            jnp.zeros((max(0, idx_cap - c),), jnp.int32)]) if idx_cap > c \
-            else order[offset:offset + c]
+        idx = _slice_indices(order, jnp.int32(offset),
+                             bucket_capacity(max(c, 1)))
         piece = gather_batch(batch, idx, c)
         out.append((t, piece))
         offset += c

@@ -141,3 +141,17 @@ def test_string_min_max_falls_back(session):
                           F.count("v").alias("c")),
         fallback_exec="CpuHashAggregateExec",
         ignore_order=True)
+
+
+def test_groupby_double_key_exact(session):
+    # f64 keys must group exactly on the oracle-parity backend (no f32
+    # narrowing merging distinct keys)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.createDataFrame(
+            {"k": [1.0, 1.0 + 1e-12, 1.0, -0.0, 0.0, float("nan"),
+                   float("nan")],
+             "v": [1, 2, 3, 4, 5, 6, 7]},
+            [("k", "double"), ("v", "long")])
+        .groupBy("k").agg(F.count("v").alias("c")),
+        ignore_order=True)

@@ -1,0 +1,41 @@
+"""Cached-relation tests (reference: cache_test.py — accelerated
+InMemoryTableScan)."""
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    gen_df,
+    run_on_tpu,
+)
+
+
+def test_cache_equivalence(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT32, lo=0, hi=10)),
+                             ("v", IntGen(DataType.INT64)),
+                             ("t", StringGen(max_len=4))], n=200).cache()
+        .groupBy("k").agg(F.sum("v").alias("s"), F.count("t").alias("c")),
+        ignore_order=True)
+
+
+def test_cache_reused_across_queries(session):
+    df_holder = {}
+
+    def fn(s):
+        if "df" not in df_holder:
+            df_holder["df"] = gen_df(
+                s, [("v", IntGen(DataType.INT64))], n=100).cache()
+        return df_holder["df"].agg(F.count("*").alias("c"))
+
+    r1 = run_on_tpu(session, fn)
+    r2 = run_on_tpu(session, fn)
+    assert r1 == r2 == [(100,)]
+    # unpersist returns the uncached frame and still computes correctly
+    un = df_holder["df"].unpersist()
+    r3 = run_on_tpu(session, lambda s: un.agg(F.count("*").alias("c")))
+    assert r3 == [(100,)]

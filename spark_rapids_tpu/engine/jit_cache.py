@@ -11,21 +11,31 @@ XLA's compilation cache.
 
 from __future__ import annotations
 
+import collections
 import threading
-from typing import Any, Callable, Dict, Hashable
+from typing import Any, Callable, Hashable
 
 _LOCK = threading.Lock()
-_CACHE: Dict[Hashable, Any] = {}
+# LRU-bounded: expression fingerprints embed literal values, so a stream of
+# parameterized queries would otherwise grow the cache (and its compiled
+# XLA executables) without limit
+_MAX_ENTRIES = 512
+_CACHE: "collections.OrderedDict[Hashable, Any]" = collections.OrderedDict()
 
 
 def get_or_build(key: Hashable, builder: Callable[[], Any]) -> Any:
     with _LOCK:
         got = _CACHE.get(key)
         if got is not None:
+            _CACHE.move_to_end(key)
             return got
     built = builder()
     with _LOCK:
-        return _CACHE.setdefault(key, built)
+        got = _CACHE.setdefault(key, built)
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > _MAX_ENTRIES:
+            _CACHE.popitem(last=False)
+        return got
 
 
 def clear() -> None:

@@ -1,0 +1,8 @@
+"""File I/O layer (reference: SURVEY.md section 2.7).
+
+Parquet/ORC/CSV scans and writers. Phase 1 of the SURVEY.md build plan uses
+Arrow C++ (via pyarrow) for the host-side decode/encode — the counterpart of
+the reference's host-side footer parse + chunk reassembly
+(GpuParquetScan.scala:316-458) — feeding the packed single-copy upload into
+HBM; moving dictionary/RLE decode into Pallas kernels is a later phase.
+"""

@@ -1,0 +1,128 @@
+"""Arrow <-> columnar batch conversion.
+
+The host staging format is Arrow (pyarrow) — its C++ readers play the role
+cuDF's native parquet/ORC/CSV decoders play in the reference (GpuParquetScan
+/ GpuOrcScan / GpuCSVScan). Conversion is column-at-a-time and zero-copy
+where Arrow's layout allows (primitive columns without nulls).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.batch import (
+    HostColumnarBatch,
+    HostColumnVector,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.base import AttributeReference
+
+_ARROW_TO_DT = {
+    pa.bool_(): DataType.BOOL,
+    pa.int8(): DataType.INT8,
+    pa.int16(): DataType.INT16,
+    pa.int32(): DataType.INT32,
+    pa.int64(): DataType.INT64,
+    pa.float32(): DataType.FLOAT32,
+    pa.float64(): DataType.FLOAT64,
+    pa.string(): DataType.STRING,
+    pa.large_string(): DataType.STRING,
+    pa.date32(): DataType.DATE,
+}
+
+
+def arrow_type_to_dt(t: pa.DataType) -> DataType:
+    if t in _ARROW_TO_DT:
+        return _ARROW_TO_DT[t]
+    if pa.types.is_timestamp(t):
+        return DataType.TIMESTAMP
+    if pa.types.is_dictionary(t):
+        return arrow_type_to_dt(t.value_type)
+    raise TypeError(f"unsupported arrow type {t} (flat types only, "
+                    "reference: GpuOverrides.isSupportedType)")
+
+
+def dt_to_arrow_type(dt: DataType) -> pa.DataType:
+    for at, d in _ARROW_TO_DT.items():
+        if d is dt and at != pa.large_string():
+            return at
+    if dt is DataType.TIMESTAMP:
+        return pa.timestamp("us", tz="UTC")
+    raise TypeError(f"no arrow type for {dt}")
+
+
+def schema_attrs(schema: pa.Schema) -> List[AttributeReference]:
+    return [
+        AttributeReference(f.name, arrow_type_to_dt(f.type), f.nullable)
+        for f in schema
+    ]
+
+
+def _chunked_to_np(col: pa.ChunkedArray) -> pa.Array:
+    return col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+
+
+def arrow_to_host_batch(table: pa.Table,
+                        attrs: List[AttributeReference]) -> HostColumnarBatch:
+    cols = []
+    for attr in attrs:
+        # look up by NAME: pyarrow ORC returns selected columns in file
+        # order, not requested order
+        arr = _chunked_to_np(table.column(attr.name))
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        dt = attr.data_type
+        n = len(arr)
+        validity = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+            np.asarray(arr.is_valid())
+        if dt is DataType.STRING:
+            data = np.empty(n, dtype=object)
+            py = arr.to_pylist()
+            for i, v in enumerate(py):
+                data[i] = v if v is not None else ""
+        elif dt is DataType.TIMESTAMP:
+            # fill nulls BEFORE to_numpy: arrow otherwise converts through
+            # float64/NaT and corrupts large 64-bit values
+            a = arr.cast(pa.timestamp("us")).cast(pa.int64()).fill_null(0)
+            data = a.to_numpy(zero_copy_only=False).astype(np.int64)
+        elif dt is DataType.DATE:
+            data = arr.cast(pa.int32()).fill_null(0) \
+                .to_numpy(zero_copy_only=False).astype(np.int32)
+        else:
+            npdt = dt.to_np()
+            if dt is DataType.BOOL:
+                data = arr.fill_null(False).to_numpy(zero_copy_only=False)
+            else:
+                data = arr.fill_null(npdt.type(0).item()) \
+                    .to_numpy(zero_copy_only=False)
+            if data.dtype != npdt:
+                data = data.astype(npdt)
+        cols.append(HostColumnVector(dt, data, validity))
+    return HostColumnarBatch(cols, table.num_rows)
+
+
+def host_batch_to_arrow(batch: HostColumnarBatch,
+                        attrs: List[AttributeReference]) -> pa.Table:
+    arrays = []
+    names = []
+    for attr, col in zip(attrs, batch.columns):
+        dt = attr.data_type
+        mask = ~col.validity  # arrow mask semantics: True = null
+        if dt is DataType.STRING:
+            vals = [v if ok else None
+                    for v, ok in zip(col.data, col.validity)]
+            arrays.append(pa.array(vals, type=pa.string()))
+        elif dt is DataType.TIMESTAMP:
+            arrays.append(pa.array(col.data.astype(np.int64), mask=mask)
+                          .cast(pa.timestamp("us", tz="UTC")))
+        elif dt is DataType.DATE:
+            arrays.append(pa.array(col.data.astype(np.int32), mask=mask)
+                          .cast(pa.date32()))
+        else:
+            arrays.append(pa.array(col.data, mask=mask,
+                                   type=dt_to_arrow_type(dt)))
+        names.append(attr.name)
+    return pa.table(dict(zip(names, arrays)))

@@ -1,0 +1,112 @@
+"""DataFrameReader (the spark.read analog; reference: GpuReadParquet/Orc/
+CSVFileFormat + Gpu*Scan schema handling)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.io.arrow_convert import schema_attrs
+from spark_rapids_tpu.io.scan import _SUFFIXES, _to_bool, expand_paths
+from spark_rapids_tpu.ops.base import AttributeReference
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.dataframe import DataFrame
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self._session = session
+        self._options: Dict[str, Any] = {}
+        self._schema: Optional[List[AttributeReference]] = None
+
+    def option(self, key: str, value: Any) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def options(self, **kwargs) -> "DataFrameReader":
+        self._options.update(kwargs)
+        return self
+
+    def schema(self, schema) -> "DataFrameReader":
+        """schema: list of (name, type-name-or-DataType) tuples."""
+        attrs = []
+        for name, t in schema:
+            dt = t if isinstance(t, DataType) else DataType.parse(t)
+            attrs.append(AttributeReference(name, dt, True))
+        self._schema = attrs
+        return self
+
+    # -- formats --------------------------------------------------------------
+    def parquet(self, *paths: str) -> DataFrame:
+        return self._load("parquet", list(paths))
+
+    def orc(self, *paths: str) -> DataFrame:
+        return self._load("orc", list(paths))
+
+    def csv(self, *paths: str, header: Optional[bool] = None,
+            sep: Optional[str] = None,
+            inferSchema: Optional[bool] = None) -> DataFrame:
+        if header is not None:
+            self._options["header"] = header
+        if sep is not None:
+            self._options["sep"] = sep
+        if inferSchema is not None:
+            self._options["inferSchema"] = inferSchema
+        return self._load("csv", list(paths))
+
+    def format(self, fmt: str) -> "_FormatReader":
+        return _FormatReader(self, fmt)
+
+    # -- schema resolution ----------------------------------------------------
+    def _load(self, fmt: str, paths: List[str]) -> DataFrame:
+        attrs = self._schema or self._resolve_schema(fmt, paths)
+        plan = L.FileScan(fmt, paths, attrs, dict(self._options))
+        return DataFrame(plan, self._session)
+
+    def _resolve_schema(self, fmt: str,
+                        paths: List[str]) -> List[AttributeReference]:
+        sample = expand_paths(paths, _SUFFIXES.get(fmt, ()))[0]
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            return schema_attrs(pq.ParquetFile(sample).schema_arrow)
+        if fmt == "orc":
+            import pyarrow.orc as po
+
+            return schema_attrs(po.ORCFile(sample).schema)
+        if fmt == "csv":
+            import pyarrow.csv as pc
+
+            header = _to_bool(self._options.get("header", False))
+            sep = self._options.get("sep",
+                                    self._options.get("delimiter", ","))
+            infer = _to_bool(self._options.get("inferSchema", False))
+            # stream only the first block — never parse the whole file just
+            # to learn the schema
+            read_opts = pc.ReadOptions(autogenerate_column_names=not header)
+            with pc.open_csv(
+                    sample, read_options=read_opts,
+                    parse_options=pc.ParseOptions(delimiter=sep)) as reader:
+                first = reader.read_next_batch()
+            if infer:
+                return schema_attrs(first.schema)
+            # Spark default: everything is a string unless inferSchema
+            return [AttributeReference(n, DataType.STRING, True)
+                    for n in first.schema.names]
+        raise ValueError(f"unknown format {fmt}")
+
+
+class _FormatReader:
+    def __init__(self, reader: DataFrameReader, fmt: str):
+        self._reader = reader
+        self._fmt = fmt
+
+    def option(self, k, v) -> "_FormatReader":
+        self._reader.option(k, v)
+        return self
+
+    def load(self, *paths: str) -> DataFrame:
+        return self._reader._load(self._fmt, list(paths))

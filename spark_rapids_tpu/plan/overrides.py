@@ -285,6 +285,29 @@ def _register_feature_exec_rules():
         CpuCachedScanExec, "device-resident in-memory table cache",
         lambda cpu, ch: TpuCachedScanExec(cpu.logical_node, ch[0]))
 
+    from spark_rapids_tpu.io.scan import CpuFileScanExec, TpuFileScanExec
+
+    _FMT_READ_CONF = {
+        "parquet": C.PARQUET_READ_ENABLED,
+        "orc": C.ORC_READ_ENABLED,
+        "csv": C.CSV_READ_ENABLED,
+    }
+
+    def _tag_scan(m: ExecMeta):
+        entry = _FMT_READ_CONF.get(m.plan.fmt)
+        if entry is not None and not m.conf.get(entry):
+            m.will_not_work(
+                f"{m.plan.fmt} reads are disabled (set {entry.key}=true)")
+        for a in m.plan.output:
+            if not MT.is_supported_type(a.data_type):
+                m.will_not_work(f"column {a.name} has unsupported type "
+                                f"{a.data_type}")
+
+    register_exec(
+        CpuFileScanExec, "columnar file scan (Arrow host decode + upload)",
+        lambda cpu, ch: TpuFileScanExec(cpu.attrs, cpu.splits, cpu.fmt),
+        tag_fn=_tag_scan)
+
 
 # ---------------------------------------------------------------------------
 # Node-expression extraction (which expressions does a node evaluate?)

@@ -95,6 +95,14 @@ def _plan_repartition(plan: L.Repartition, conf: C.TpuConf) -> PhysicalExec:
     return plan_repartition_exchange(plan, child, conf)
 
 
+@register_planner(L.FileScan)
+def _plan_file_scan(plan: L.FileScan, conf: C.TpuConf) -> PhysicalExec:
+    from spark_rapids_tpu.io.scan import CpuFileScanExec, plan_splits
+
+    splits = plan_splits(plan.fmt, plan.paths, plan.options, conf)
+    return CpuFileScanExec(plan.output, splits, plan.fmt)
+
+
 @register_planner(L.CacheRelation)
 def _plan_cache(plan: L.CacheRelation, conf: C.TpuConf) -> PhysicalExec:
     from spark_rapids_tpu.exec.cache import CpuCachedScanExec

@@ -356,7 +356,15 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 
         no_strings = all(a.data_type is not DataType.STRING
                          for a in child_attrs)
-        slicer = _device_slices_lazy if no_strings else _device_slices
+
+        def slicer(batch, ids, n_):
+            # lazy zero-copy views keep FULL source capacity per piece, so
+            # the reduce side would run kernels over sum-of-capacities
+            # lanes. Worth it only for small batches (e.g. partial-agg
+            # output); big scans use the count-synced contiguous split.
+            if no_strings and batch.device_memory_size() <= (4 << 20):
+                return _device_slices_lazy(batch, ids, n_)
+            return _device_slices(batch, ids, n_)
 
         if isinstance(p, RoundRobinPartitioning):
             jitted = _jit_rr_ids(n)

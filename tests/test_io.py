@@ -1,0 +1,138 @@
+"""File I/O tests (reference: parquet_test.py, orc_test.py, csv_test.py,
+ParquetWriterSuite)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    DateGen,
+    FloatGen,
+    IntGen,
+    StringGen,
+    TimestampGen,
+    assert_rows_equal,
+    assert_tpu_and_cpu_are_equal_collect,
+    gen_df,
+    run_on_cpu,
+    run_on_tpu,
+)
+
+
+def _write_sample(session, path, n=300, fmt="parquet"):
+    df = gen_df(session, [("i", IntGen(DataType.INT32)),
+                          ("l", IntGen(DataType.INT64)),
+                          ("f", FloatGen(DataType.FLOAT32)),
+                          ("s", StringGen(max_len=8)),
+                          ("d", DateGen()),
+                          ("t", TimestampGen())], n=n)
+    getattr(df.write.mode("overwrite"), fmt)(path)
+    return df
+
+
+def test_parquet_roundtrip(session, tmp_path):
+    path = str(tmp_path / "t.parquet")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    df = _write_sample(session, path)
+    expected = df.collect()
+    got_cpu = run_on_cpu(session, lambda s: s.read.parquet(path))
+    got_tpu = run_on_tpu(session, lambda s: s.read.parquet(path))
+    assert_rows_equal(expected, got_cpu, ignore_order=True)
+    assert_rows_equal(expected, got_tpu, ignore_order=True)
+
+
+def test_orc_roundtrip(session, tmp_path):
+    path = str(tmp_path / "t.orc")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    df = _write_sample(session, path, fmt="orc")
+    expected = df.collect()
+    got = run_on_tpu(session, lambda s: s.read.orc(path))
+    assert_rows_equal(expected, got, ignore_order=True)
+
+
+def test_csv_roundtrip(session, tmp_path):
+    path = str(tmp_path / "t.csv")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    df = session.createDataFrame(
+        {"a": [1, 2, 3, None], "b": ["x", "", "z w", None]},
+        [("a", "int"), ("b", "string")])
+    df.write.mode("overwrite").option("header", True).csv(path)
+    got = sorted(run_on_tpu(
+        session,
+        lambda s: s.read.schema([("a", "int"), ("b", "string")])
+        .option("header", True).csv(path)), key=str)
+    # CSV cannot distinguish null string from empty string
+    expected = sorted([(1, "x"), (2, None), (3, "z w"), (None, None)],
+                      key=str)
+    assert got == expected
+
+
+def test_parquet_query_equivalence(session, tmp_path):
+    path = str(tmp_path / "q.parquet")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    _write_sample(session, path, n=500)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("i") > 0)
+        .groupBy("s").agg(F.count("*").alias("c"), F.sum("l").alias("t")),
+        ignore_order=True)
+
+
+def test_parquet_row_group_splits(session, tmp_path):
+    """Small maxReadBatchSizeRows must still read everything exactly once."""
+    path = str(tmp_path / "rg.parquet")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    df = gen_df(session, [("v", IntGen(DataType.INT64))], n=1000,
+                num_partitions=1)
+    df.write.mode("overwrite").parquet(path)
+    expected = df.collect()
+    got = run_on_tpu(
+        session, lambda s: s.read.parquet(path),
+        extra_conf={"rapids.tpu.sql.reader.batchSizeRows": 100})
+    assert_rows_equal(expected, got, ignore_order=True)
+
+
+def test_write_modes(session, tmp_path):
+    path = str(tmp_path / "m.parquet")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    df = session.createDataFrame({"v": [1, 2]}, [("v", "int")])
+    df.write.parquet(path)
+    with pytest.raises(Exception):
+        df.write.parquet(path)  # default error mode
+    df.write.mode("ignore").parquet(path)
+    df.write.mode("overwrite").parquet(path)
+    assert os.path.exists(os.path.join(path, "_SUCCESS"))
+    assert sorted(session.read.parquet(path).collect()) == [(1,), (2,)]
+
+
+def test_partitioned_write(session, tmp_path):
+    path = str(tmp_path / "p.parquet")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    df = session.createDataFrame(
+        {"k": [1, 1, 2, 2, 3], "v": [10, 20, 30, 40, 50]},
+        [("k", "int"), ("v", "long")])
+    df.write.mode("overwrite").partitionBy("k").parquet(path)
+    assert os.path.isdir(os.path.join(path, "k=1"))
+    assert os.path.isdir(os.path.join(path, "k=3"))
+    back = session.read.parquet(path).collect()
+    assert sorted(v for (v,) in back) == [10, 20, 30, 40, 50]
+
+
+def test_scan_disabled_falls_back(session, tmp_path):
+    from tests.harness import assert_tpu_fallback_collect
+
+    path = str(tmp_path / "d.parquet")
+    session.conf.set("rapids.tpu.sql.enabled", False)
+    session.createDataFrame({"v": [1, 2, 3]}, [("v", "int")]) \
+        .write.mode("overwrite").parquet(path)
+    assert_tpu_fallback_collect(
+        session,
+        lambda s: s.read.parquet(path),
+        fallback_exec="CpuFileScanExec",
+        ignore_order=True,
+        extra_conf={"rapids.tpu.sql.format.parquet.read.enabled": False})

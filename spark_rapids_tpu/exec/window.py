@@ -1,0 +1,619 @@
+"""Window execs (reference: GpuWindowExec.scala, 202 LoC +
+GpuWindowExpression.scala evaluation).
+
+Reference parity:
+- partition/order-by window aggregations per batch, projecting original +
+  window-agg columns (GpuWindowExec.scala:92-202) -> same output contract.
+- row/range frames (GpuWindowExpression.scala:457-683): ROWS offset frames,
+  RANGE unbounded->current (with peer rows), whole-partition frames.
+- row_number (:708) + rank/dense_rank/ntile/lag/lead/first/last and the
+  declarative aggregates (sum/min/max/count/avg) over frames.
+
+TPU design: ONE multi-operand lax.sort clusters partitions and orders rows
+(partition keys may be equality-only proxies — any consistent cluster order
+works); every frame computation is then a composition of segmented prefix
+sums / segmented scans / segment-min-max gathers in the sorted domain, and
+one scatter puts results back in input row order. All of it runs in a
+single jit per (expression set, capacity bucket).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    ColumnVector,
+    HostColumnarBatch,
+    HostColumnVector,
+    physical_np_dtype,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec import rowkeys as RK
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.exec.transitions import RequireSingleBatch
+from spark_rapids_tpu.ops.aggregates import (
+    AggregateFunction,
+    Average,
+    Count,
+    Max,
+    Min,
+    Sum,
+    First,
+    Last,
+)
+from spark_rapids_tpu.ops.base import (
+    AttributeReference,
+    Expression,
+    SortOrder,
+    to_attribute,
+)
+from spark_rapids_tpu.ops.bind import bind_all, bind_sort_orders
+from spark_rapids_tpu.ops.eval import _col_to_colv, cpu_project
+from spark_rapids_tpu.ops.values import EvalContext, ScalarV
+from spark_rapids_tpu.ops.window import (
+    UNBOUNDED,
+    DenseRank,
+    Lag,
+    Lead,
+    NTile,
+    Rank,
+    RowNumber,
+    WindowExpression,
+    WindowSpec,
+)
+
+
+class _WindowBase(PhysicalExec):
+    """All window_exprs share one (partition_by, order_by); the planner
+    splits differing specs into chained window nodes (the reference's meta
+    does the same extraction, GpuWindowExec.scala:33-91)."""
+
+    def __init__(self, window_exprs: List[Expression], child: PhysicalExec):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)  # Alias(WindowExpression)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output + [
+            to_attribute(e) for e in self.window_exprs
+        ]
+
+    def with_children(self, new_children):
+        return type(self)(self.window_exprs, new_children[0])
+
+    @property
+    def children_coalesce_goal(self):
+        return [RequireSingleBatch()]
+
+    def node_name(self):
+        return f"{type(self).__name__}({len(self.window_exprs)} exprs)"
+
+    def _spec(self) -> WindowSpec:
+        return _unwrap(self.window_exprs[0]).spec
+
+
+def _unwrap(e: Expression) -> WindowExpression:
+    w = e.collect(lambda n: isinstance(n, WindowExpression))
+    assert len(w) == 1
+    return w[0]
+
+
+# ===========================================================================
+# Segmented-scan helpers (sorted domain)
+# ===========================================================================
+def _seg_scan(op, gid, vals, reverse=False):
+    """Segmented inclusive scan: combine respects segment boundaries."""
+
+    def combine(a, b):
+        ga, va = a
+        gb, vb = b
+        return gb, jnp.where(ga == gb, op(va, vb), vb)
+
+    _, out = jax.lax.associative_scan(combine, (gid, vals), reverse=reverse)
+    return out
+
+
+def _gathered_segment(op_fn, pos_vals, gid, capacity):
+    red = op_fn(pos_vals, jnp.where(gid < capacity, gid, capacity),
+                num_segments=capacity)
+    safe = jnp.clip(gid, 0, capacity - 1)
+    return red[safe]
+
+
+# ===========================================================================
+# TPU exec
+# ===========================================================================
+class TpuWindowExec(_WindowBase, TpuExec):
+    placement = "tpu"
+
+    def _build_kernel(self, input_attrs):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+        from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+        spec = self._spec()
+        bound_part = bind_all(spec.partition_by, input_attrs)
+        bound_orders = bind_sort_orders(spec.order_by, input_attrs)
+        wexprs = [_unwrap(e) for e in self.window_exprs]
+        bound_inputs = []
+        for w in wexprs:
+            f = w.function
+            child = f.children()[0] if f.children() else None
+            bound_inputs.append(
+                bind_all([child], input_attrs)[0] if child is not None
+                else None)
+        key = ("window", spec.fingerprint(),
+               tuple(e.fingerprint() for e in bound_part),
+               tuple(o.fingerprint() for o in bound_orders),
+               tuple(w.fingerprint() for w in wexprs),
+               tuple(b.fingerprint() if b is not None else ""
+                     for b in bound_inputs))
+
+        def build():
+            def kernel(cols, num_rows):
+                cap = cols[0].validity.shape[0]
+                ctx = EvalContext(jnp, True, cols, num_rows, cap)
+
+                def as_col(e):
+                    r = e.eval(ctx)
+                    if isinstance(r, ScalarV):
+                        r = _scalar_to_colv(ctx, r, e.data_type)
+                    return r
+
+                part_cols = [as_col(e) for e in bound_part]
+                order_results = [(as_col(o.child), o) for o in bound_orders]
+                in_cols = [as_col(b) if b is not None else None
+                           for b in bound_inputs]
+
+                # ---- one sort: [pad, partition keys, order keys] ----------
+                live = ctx.row_mask()
+                operands = [~live]
+                for pc in part_cols:
+                    p = RK.key_proxy(pc)
+                    operands.append(p.null_flag)
+                    operands.extend(p.arrays)
+                order_proxies = []
+                for oc, o in order_results:
+                    p = RK.key_proxy(oc)
+                    operands.append(~p.null_flag if o.nulls_first
+                                    else p.null_flag)
+                    for arr in p.arrays:
+                        operands.append(arr if o.ascending
+                                        else RK._invert_order(arr))
+                    order_proxies.append(p)
+                perm = RK._multi_key_sort(operands, cap)
+
+                # ---- sorted-domain structure ------------------------------
+                live_s = live[perm]
+                pos = jnp.arange(cap, dtype=jnp.int32)
+                prev = jnp.concatenate([perm[:1], perm[:-1]])
+                part_change = jnp.zeros((cap,), bool).at[0].set(True)
+                for pc in part_cols:
+                    p = RK.key_proxy(pc)
+                    for arr in p.arrays:
+                        part_change |= arr[perm] != arr[prev]
+                    part_change |= p.null_flag[perm] != p.null_flag[prev]
+                part_change = (part_change | (pos == 0)) & live_s
+                pgid = jnp.where(live_s,
+                                 jnp.cumsum(part_change.astype(jnp.int32)) - 1,
+                                 cap)
+                peer_change = part_change
+                for p in order_proxies:
+                    for arr in p.arrays:
+                        peer_change = peer_change | (arr[perm] != arr[prev])
+                    peer_change = peer_change | \
+                        (p.null_flag[perm] != p.null_flag[prev])
+                peer_change = peer_change & live_s
+                qgid = jnp.where(live_s,
+                                 jnp.cumsum(peer_change.astype(jnp.int32)) - 1,
+                                 cap)
+                start = _gathered_segment(jax.ops.segment_min,
+                                          jnp.where(live_s, pos, cap),
+                                          pgid, cap)
+                end = _gathered_segment(jax.ops.segment_max,
+                                        jnp.where(live_s, pos, -1),
+                                        pgid, cap)
+                peer_end = _gathered_segment(jax.ops.segment_max,
+                                             jnp.where(live_s, pos, -1),
+                                             qgid, cap)
+
+                outs = []
+                for w, in_cv in zip(wexprs, in_cols):
+                    res = _eval_window_fn(
+                        w, in_cv, perm, live_s, pos, pgid, qgid, start, end,
+                        peer_end, peer_change, cap)
+                    outs.append(res)
+
+                # ---- scatter back to input row order ----------------------
+                final = []
+                for (data_s, valid_s), w in zip(outs, wexprs):
+                    npdt = physical_np_dtype(w.data_type)
+                    if data_s.dtype != jnp.dtype(npdt):
+                        data_s = data_s.astype(npdt)
+                    data = jnp.zeros((cap,), data_s.dtype).at[perm].set(data_s)
+                    valid = jnp.zeros((cap,), bool).at[perm].set(
+                        valid_s & live_s)
+                    final.append((data, valid))
+                return final
+
+            return jax.jit(kernel)
+
+        return get_or_build(key, build)
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        kernel = [None]
+        wexprs = [_unwrap(e) for e in self.window_exprs]
+
+        def window_partition(pidx: int):
+            for batch in child_pb.iterator(pidx):
+                if batch.host_rows() == 0:
+                    continue
+                if kernel[0] is None:
+                    kernel[0] = self._build_kernel(child_attrs)
+                cols = [_col_to_colv(c) for c in batch.columns]
+                outs = kernel[0](cols, jnp.int32(batch.num_rows))
+                new_cols = list(batch.columns)
+                for (data, valid), w in zip(outs, wexprs):
+                    new_cols.append(ColumnVector(w.data_type, data, valid))
+                yield ColumnarBatch(new_cols, batch.num_rows)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, window_partition(pidx))
+
+        return PartitionedBatches(child_pb.num_partitions, factory)
+
+
+def _eval_window_fn(w: WindowExpression, in_cv, perm, live_s, pos, pgid,
+                    qgid, start, end, peer_end, peer_change, cap: int):
+    """Compute one window expression in the sorted domain."""
+    f = w.function
+    frame = w.spec.frame
+    if isinstance(f, RowNumber):
+        return (pos - start + 1).astype(jnp.int32), live_s
+    if isinstance(f, Rank):
+        first_peer = _gathered_segment(jax.ops.segment_min,
+                                       jnp.where(live_s, pos, cap), qgid, cap)
+        return (first_peer - start + 1).astype(jnp.int32), live_s
+    if isinstance(f, DenseRank):
+        pf = jnp.cumsum(peer_change.astype(jnp.int32))
+        pf_at_start = pf[jnp.clip(start, 0, cap - 1)]
+        return (pf - pf_at_start + 1).astype(jnp.int32), live_s
+    if isinstance(f, NTile):
+        cnt = end - start + 1
+        rel = pos - start
+        return (rel * f.n // jnp.maximum(cnt, 1) + 1).astype(jnp.int32), \
+            live_s
+    if isinstance(f, (Lag, Lead)):
+        k = f.offset if isinstance(f, Lead) else -f.offset
+        vs = in_cv.data[perm]
+        valid_s = in_cv.validity[perm]
+        j = pos + k
+        in_seg = (j >= start) & (j <= end)
+        safe = jnp.clip(j, 0, cap - 1)
+        data = jnp.where(in_seg, vs[safe], _default_of(f, vs.dtype))
+        valid = jnp.where(in_seg, valid_s[safe],
+                          f.default is not None)
+        return data, valid & live_s
+    if isinstance(f, AggregateFunction):
+        return _eval_window_agg(f, frame, in_cv, perm, live_s, pos, pgid,
+                                start, end, peer_end, cap)
+    raise NotImplementedError(f"window function {type(f).__name__}")
+
+
+def _default_of(f, dtype):
+    if f.default is None:
+        return jnp.zeros((), dtype)
+    return jnp.asarray(f.default, dtype)
+
+
+def _frame_bounds(frame, pos, start, end, peer_end):
+    """Frame [lo, hi] as sorted-row positions, clamped to the partition."""
+    if frame.frame_type == "range":
+        lo = start
+        if frame.upper is UNBOUNDED:
+            hi = end
+        else:  # CURRENT ROW in range terms = end of peer group
+            hi = peer_end
+        if frame.lower is not UNBOUNDED:
+            raise NotImplementedError("range frames with a finite lower "
+                                      "bound")
+        return lo, hi
+    lo = start if frame.lower is UNBOUNDED else \
+        jnp.maximum(start, pos + frame.lower)
+    hi = end if frame.upper is UNBOUNDED else \
+        jnp.minimum(end, pos + frame.upper)
+    return lo, hi
+
+
+def _eval_window_agg(f: AggregateFunction, frame, in_cv, perm, live_s, pos,
+                     pgid, start, end, peer_end, cap: int):
+    vs = in_cv.data[perm]
+    valid_s = in_cv.validity[perm] & live_s
+    lo, hi = _frame_bounds(frame, pos, start, end, peer_end)
+    empty = hi < lo
+
+    if isinstance(f, (Sum, Count, Average)):
+        contrib = jnp.where(valid_s, vs, jnp.zeros((), vs.dtype)) \
+            if not isinstance(f, Count) else None
+        ones = valid_s.astype(jnp.int64)
+        pc = jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(ones)])
+        cnt = pc[jnp.clip(hi + 1, 0, cap)] - pc[jnp.clip(lo, 0, cap)]
+        cnt = jnp.where(empty, 0, cnt)
+        if isinstance(f, Count):
+            return cnt, jnp.ones((cap,), bool)
+        acc_dt = physical_np_dtype(f.data_type)
+        ps = jnp.concatenate([
+            jnp.zeros((1,), acc_dt),
+            jnp.cumsum(contrib.astype(acc_dt))])
+        s = ps[jnp.clip(hi + 1, 0, cap)] - ps[jnp.clip(lo, 0, cap)]
+        if isinstance(f, Sum):
+            return jnp.where(cnt > 0, s, 0), cnt > 0
+        avg = s.astype(jnp.float32 if acc_dt == np.dtype(np.float32)
+                       else jnp.float64) / jnp.maximum(cnt, 1)
+        return jnp.where(cnt > 0, avg, 0), cnt > 0
+
+    if isinstance(f, (Min, Max)):
+        if not (frame.is_unbounded_both or frame.is_unbounded_to_current):
+            raise NotImplementedError(
+                "min/max window frames beyond unbounded/current")
+        is_float = jnp.dtype(vs.dtype).kind == "f"
+        if is_float:
+            bits = RK._float_order_bits(vs)
+            worst = jnp.array(jnp.iinfo(bits.dtype).max, bits.dtype) \
+                if isinstance(f, Min) else jnp.array(0, bits.dtype)
+            masked = jnp.where(valid_s, bits, worst)
+        else:
+            worst = RK._type_max(vs.dtype) if isinstance(f, Min) \
+                else RK._type_min(vs.dtype)
+            masked = jnp.where(valid_s, vs, worst)
+        op = jnp.minimum if isinstance(f, Min) else jnp.maximum
+        if frame.is_unbounded_both:
+            seg_fn = jax.ops.segment_min if isinstance(f, Min) \
+                else jax.ops.segment_max
+            red = _gathered_segment(seg_fn, masked, pgid, cap)
+        else:
+            red = _seg_scan(op, pgid, masked)
+            # extend over the peer group (range current-row includes peers)
+            if frame.frame_type == "range":
+                red = red[jnp.clip(peer_end, 0, cap - 1)]
+        onesc = jnp.concatenate([
+            jnp.zeros((1,), jnp.int64),
+            jnp.cumsum(valid_s.astype(jnp.int64))])
+        cnt = onesc[jnp.clip(hi + 1, 0, cap)] - onesc[jnp.clip(lo, 0, cap)]
+        if is_float:
+            red = RK._float_from_order_bits(red).astype(vs.dtype)
+        return jnp.where(cnt > 0, red, jnp.zeros((), red.dtype)), cnt > 0
+
+    if isinstance(f, (First, Last)):
+        if isinstance(f, First):
+            sel = lo
+        else:
+            sel = hi
+        safe = jnp.clip(sel, 0, cap - 1)
+        data = vs[safe]
+        valid = valid_s[safe] & ~empty
+        return data, valid
+
+    raise NotImplementedError(
+        f"window aggregate {type(f).__name__}")
+
+
+# ===========================================================================
+# CPU oracle
+# ===========================================================================
+class CpuWindowExec(_WindowBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        spec = self._spec()
+        wexprs = [_unwrap(e) for e in self.window_exprs]
+        bound_part = bind_all(spec.partition_by, child_attrs)
+        bound_orders = bind_sort_orders(spec.order_by, child_attrs)
+        bound_inputs = []
+        for w in wexprs:
+            f = w.function
+            child = f.children()[0] if f.children() else None
+            bound_inputs.append(
+                bind_all([child], child_attrs)[0] if child is not None
+                else None)
+
+        def window_partition(pidx: int):
+            from spark_rapids_tpu.shuffle.exchange import _order_key
+
+            for batch in child_pb.iterator(pidx):
+                if batch.num_rows == 0:
+                    continue
+                n = batch.num_rows
+                evald = cpu_project(
+                    bound_part + [o.child for o in bound_orders] +
+                    [b for b in bound_inputs if b is not None],
+                    batch, partition_id=pidx)
+                np_ = len(bound_part)
+                no = len(bound_orders)
+                pcols = evald.columns[:np_]
+                ocols = evald.columns[np_:np_ + no]
+                icols_iter = iter(evald.columns[np_ + no:])
+                icols = [next(icols_iter) if b is not None else None
+                         for b in bound_inputs]
+
+                def pkey(i):
+                    return tuple(
+                        (None if not c.validity[i] else _canon(c.data[i]))
+                        for c in pcols)
+
+                def okey(i):
+                    return tuple(
+                        _order_key(None if not c.validity[i]
+                                   else _as_py(c.data[i]), o)
+                        for c, o in zip(ocols, bound_orders))
+
+                groups: Dict[tuple, List[int]] = {}
+                order_seen: List[tuple] = []
+                for i in range(n):
+                    k = pkey(i)
+                    if k not in groups:
+                        order_seen.append(k)
+                    groups.setdefault(k, []).append(i)
+                results = [
+                    [None] * n for _ in wexprs
+                ]
+                for k in order_seen:
+                    rows = sorted(groups[k], key=okey)
+                    for wi, (w, icol) in enumerate(zip(wexprs, icols)):
+                        vals = _cpu_window_rows(w, rows, okey, icol)
+                        for r, v in zip(rows, vals):
+                            results[wi][r] = v
+                new_cols = list(batch.columns)
+                for w, res in zip(wexprs, results):
+                    npdt = w.data_type.to_np()
+                    data = np.zeros(n, dtype=npdt)
+                    if npdt == np.dtype(object):
+                        data[:] = ""
+                    validity = np.zeros(n, dtype=bool)
+                    for i, v in enumerate(res):
+                        if v is not None:
+                            data[i] = v
+                            validity[i] = True
+                    new_cols.append(
+                        HostColumnVector(w.data_type, data, validity))
+                yield HostColumnarBatch(new_cols, n)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, window_partition(pidx))
+
+        return PartitionedBatches(child_pb.num_partitions, factory)
+
+
+def _canon(v):
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float):
+        if v != v:
+            return ("NaN",)
+        return 0.0 if v == 0.0 else v
+    return v
+
+
+def _as_py(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _cpu_window_rows(w: WindowExpression, rows: List[int], okey, icol):
+    """Evaluate one window expression over one sorted partition (oracle)."""
+    f = w.function
+    frame = w.spec.frame
+    n = len(rows)
+    okeys = [okey(r) for r in rows]
+
+    def in_vals():
+        return [
+            (_as_py(icol.data[r]) if icol.validity[r] else None)
+            for r in rows
+        ]
+
+    if isinstance(f, RowNumber):
+        return list(range(1, n + 1))
+    if isinstance(f, Rank):
+        out = []
+        for i in range(n):
+            first = i
+            while first > 0 and okeys[first - 1] == okeys[i]:
+                first -= 1
+            out.append(first + 1)
+        return out
+    if isinstance(f, DenseRank):
+        out = []
+        rank = 0
+        for i in range(n):
+            if i == 0 or okeys[i] != okeys[i - 1]:
+                rank += 1
+            out.append(rank)
+        return out
+    if isinstance(f, NTile):
+        return [i * f.n // max(n, 1) + 1 for i in range(n)]
+    if isinstance(f, (Lag, Lead)):
+        vals = in_vals()
+        k = f.offset if isinstance(f, Lead) else -f.offset
+        out = []
+        for i in range(n):
+            j = i + k
+            out.append(vals[j] if 0 <= j < n else f.default)
+        return out
+    if isinstance(f, AggregateFunction):
+        vals = in_vals()
+        out = []
+        for i in range(n):
+            if frame.frame_type == "range":
+                lo = 0
+                if frame.upper is UNBOUNDED:
+                    hi = n - 1
+                else:
+                    hi = i
+                    while hi + 1 < n and okeys[hi + 1] == okeys[i]:
+                        hi += 1
+            else:
+                lo = 0 if frame.lower is UNBOUNDED else max(0, i + frame.lower)
+                hi = n - 1 if frame.upper is UNBOUNDED else \
+                    min(n - 1, i + frame.upper)
+            window = [vals[j] for j in range(lo, hi + 1)] if hi >= lo else []
+            out.append(_reduce_window(f, window))
+        return out
+    raise NotImplementedError(type(f).__name__)
+
+
+def _reduce_window(f: AggregateFunction, window: List):
+    nn = [v for v in window if v is not None]
+    if isinstance(f, Count):
+        return len(nn)
+    if isinstance(f, First):
+        return window[0] if window else None
+    if isinstance(f, Last):
+        return window[-1] if window else None
+    if not nn:
+        return None
+    if isinstance(f, Sum):
+        s = 0
+        for v in nn:
+            s += v
+        if isinstance(s, int):
+            s = ((s + (1 << 63)) % (1 << 64)) - (1 << 63)
+        return s
+    if isinstance(f, Min):
+        out = nn[0]
+        for v in nn[1:]:
+            out = v if _lt(v, out) else out
+        return out
+    if isinstance(f, Max):
+        out = nn[0]
+        for v in nn[1:]:
+            out = v if _lt(out, v) else out
+        return out
+    if isinstance(f, Average):
+        return float(sum(float(v) for v in nn)) / len(nn)
+    raise NotImplementedError(type(f).__name__)
+
+
+def _lt(a, b):
+    # NaN greater than everything (Spark float ordering)
+    if isinstance(a, float) and a != a:
+        return False
+    if isinstance(b, float) and b != b:
+        return True
+    return a < b

@@ -157,6 +157,13 @@ class Column:
             GreaterThanOrEqual(self.expr, _to_expr(lo)),
             LessThanOrEqual(self.expr, _to_expr(hi))))
 
+    # -- windows -------------------------------------------------------------
+    def over(self, window) -> "Column":
+        """function OVER window (reference: GpuWindowExpression)."""
+        from spark_rapids_tpu.ops.window import WindowExpression
+
+        return Column(WindowExpression(self.expr, window.to_spec()))
+
     # -- sorting -------------------------------------------------------------
     def asc(self) -> SortOrder:
         return SortOrder(self.expr, True)

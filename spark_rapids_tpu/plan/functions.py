@@ -322,3 +322,9 @@ def lag(c: ColumnOrName, offset: int = 1, default=None) -> Column:
     from spark_rapids_tpu.ops.window import Lag
 
     return Column(Lag(_c(c), offset, default))
+
+
+def ntile(n: int) -> Column:
+    from spark_rapids_tpu.ops.window import NTile
+
+    return Column(NTile(n))

@@ -63,7 +63,61 @@ def _plan_range(plan: L.RangeRelation, conf: C.TpuConf) -> PhysicalExec:
 @register_planner(L.Project)
 def _plan_project(plan: L.Project, conf: C.TpuConf) -> PhysicalExec:
     (child,) = _plan_children(plan, conf)
-    return B.CpuProjectExec(plan.project_list, child)
+    return _project_with_windows(plan.project_list, child, conf)
+
+
+def _project_with_windows(project_list, child: PhysicalExec,
+                          conf: C.TpuConf) -> PhysicalExec:
+    """Extract window expressions into window exec nodes below the project
+    (reference: GpuWindowExec meta extracting window exprs from nested
+    projects, GpuWindowExec.scala:33-91). One window exec per distinct
+    (partition_by, order_by) spec."""
+    from spark_rapids_tpu.exec.window import CpuWindowExec
+    from spark_rapids_tpu.ops.base import Alias
+    from spark_rapids_tpu.ops.window import WindowExpression
+
+    wnodes = []
+    for e in project_list:
+        wnodes.extend(e.collect(lambda n: isinstance(n, WindowExpression)))
+    if not wnodes:
+        return B.CpuProjectExec(project_list, child)
+    by_fp = {}
+    attr_of = {}
+    for w in wnodes:
+        fp = w.fingerprint()
+        if fp in by_fp:
+            continue
+        alias = Alias(w, f"_w{len(by_fp)}")
+        by_fp[fp] = alias
+        from spark_rapids_tpu.ops.base import to_attribute
+
+        attr_of[fp] = to_attribute(alias)
+    # group by sort identity (partition+order)
+    groups = {}
+    for fp, alias in by_fp.items():
+        w = alias.child
+        skey = (tuple(e.fingerprint() for e in w.spec.partition_by),
+                tuple(o.fingerprint() for o in w.spec.order_by))
+        groups.setdefault(skey, []).append(alias)
+    node = child
+    for aliases in groups.values():
+        node = CpuWindowExec(aliases, node)
+
+    def rewrite(e):
+        if isinstance(e, WindowExpression):
+            return attr_of[e.fingerprint()]
+        return e
+
+    rewritten = [e.transform_up(rewrite) for e in project_list]
+    return B.CpuProjectExec(rewritten, node)
+
+
+@register_planner(L.WindowOp)
+def _plan_window(plan: L.WindowOp, conf: C.TpuConf) -> PhysicalExec:
+    from spark_rapids_tpu.exec.window import CpuWindowExec
+
+    (child,) = _plan_children(plan, conf)
+    return CpuWindowExec(plan.window_exprs, child)
 
 
 @register_planner(L.Filter)

@@ -1,0 +1,95 @@
+"""Window spec builder (the pyspark.sql.Window analog)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from spark_rapids_tpu.ops.base import SortOrder
+from spark_rapids_tpu.ops.window import (
+    CURRENT_ROW,
+    UNBOUNDED,
+    WindowFrame,
+    WindowSpec,
+)
+from spark_rapids_tpu.plan.column import Column, _to_expr
+
+unboundedPreceding = UNBOUNDED
+unboundedFollowing = UNBOUNDED
+currentRow = CURRENT_ROW
+
+
+class WindowBuilder:
+    def __init__(self, partition_by=(), order_by=(), frame=None):
+        self._partition_by = list(partition_by)
+        self._order_by = list(order_by)
+        self._frame = frame
+
+    def partitionBy(self, *cols) -> "WindowBuilder":
+        return WindowBuilder([_col(c) for c in cols], self._order_by,
+                             self._frame)
+
+    def orderBy(self, *cols) -> "WindowBuilder":
+        orders = []
+        for c in cols:
+            if isinstance(c, SortOrder):
+                orders.append(c)
+            else:
+                orders.append(SortOrder(_col(c), True))
+        return WindowBuilder(self._partition_by, orders, self._frame)
+
+    def rowsBetween(self, start, end) -> "WindowBuilder":
+        lo = None if start is None else int(start)
+        hi = None if end is None else int(end)
+        return WindowBuilder(self._partition_by, self._order_by,
+                             WindowFrame("rows", lo, hi))
+
+    def rangeBetween(self, start, end) -> "WindowBuilder":
+        if not ((start is None or start is UNBOUNDED) and
+                (end is None or end == CURRENT_ROW)):
+            raise NotImplementedError(
+                "range frames support only unbounded preceding .. "
+                "current row / unbounded following")
+        return WindowFrameBuilderRange(self._partition_by, self._order_by,
+                                       start, end)
+
+    def to_spec(self) -> WindowSpec:
+        return WindowSpec(self._partition_by, self._order_by, self._frame)
+
+
+def WindowFrameBuilderRange(part, order, start, end):
+    frame = WindowFrame("range", UNBOUNDED,
+                        UNBOUNDED if end is None else CURRENT_ROW)
+    return WindowBuilder(part, order, frame)
+
+
+def _col(c):
+    if isinstance(c, str):
+        from spark_rapids_tpu.plan.functions import col
+
+        return col(c).expr
+    if isinstance(c, Column):
+        return c.expr
+    return c
+
+
+class _WindowModule:
+    """`Window.partitionBy(...)` entry point."""
+
+    unboundedPreceding = UNBOUNDED
+    unboundedFollowing = UNBOUNDED
+    currentRow = CURRENT_ROW
+
+    @staticmethod
+    def partitionBy(*cols) -> WindowBuilder:
+        return WindowBuilder().partitionBy(*cols)
+
+    @staticmethod
+    def orderBy(*cols) -> WindowBuilder:
+        return WindowBuilder().orderBy(*cols)
+
+    @staticmethod
+    def rowsBetween(start, end) -> WindowBuilder:
+        return WindowBuilder().rowsBetween(start, end)
+
+
+Window = _WindowModule

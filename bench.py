@@ -1,30 +1,45 @@
 """Benchmark: BASELINE config 1/2 — filter + project + hash aggregate.
 
 Runs the full engine (DataFrame -> plan rewrite -> device execs) over
-generated columnar data on the real chip, measures steady-state wall clock,
-and prints ONE JSON line. `vs_baseline` is the speedup of the TPU engine
-over this framework's own CPU oracle engine on the identical plan (the
-reference's headline chart is likewise accelerator-vs-CPU wall-clock,
-README.md:10-18).
+generated columnar data, measures steady-state wall clock, and prints ONE
+JSON line.  `vs_baseline` is the speedup of the accelerated engine over this
+framework's own CPU oracle engine on the identical plan (the reference's
+headline chart is likewise accelerator-vs-CPU wall-clock, README.md:10-18).
+
+Structure: a tiny supervisor (no jax import) that runs each phase in a
+bounded subprocess so a wedged accelerator runtime can never eat the whole
+driver budget:
+  1. CPU oracle timing         (scrubbed env, CPU backend,  CPU_BUDGET_S)
+  2. accelerated engine timing (inherited env -> real chip, TPU_BUDGET_S)
+  3. fallback: engine timing on the CPU backend if (2) dies, so a parsed
+     JSON line is always produced ("platform" reports which path ran).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
-
 
 N_ROWS = 1 << 20
 N_KEYS = 1024
-ITERS = 5
+TPU_ITERS = 3
+CPU_ITERS = 2
+
+TPU_BUDGET_S = int(os.environ.get("SRT_BENCH_TPU_BUDGET_S", "780"))
+CPU_BUDGET_S = 240
 
 
-def build_df(session):
+# ---------------------------------------------------------------- workers
+
+def _build_df(session):
     """Input is cached (device-resident on the TPU engine, host-resident on
     the CPU engine) so the metric measures engine throughput, not the
     host<->device link of the benchmarking harness."""
+    import numpy as np
+
     rng = np.random.default_rng(42)
     data = {
         "k": rng.integers(0, N_KEYS, N_ROWS).astype(np.int64),
@@ -33,10 +48,10 @@ def build_df(session):
     }
     return session.createDataFrame(
         data, [("k", "long"), ("a", "long"), ("b", "float")],
-        num_partitions=4).cache()
+        num_partitions=2).cache()
 
 
-def run_query(session, df):
+def _run_query(df):
     from spark_rapids_tpu.plan import functions as F
 
     out = (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
@@ -47,38 +62,111 @@ def run_query(session, df):
     return out.collect()
 
 
-def timed(session, df, iters=ITERS):
-    run_query(session, df)  # warmup (compile)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        rows = run_query(session, df)
-        times.append(time.perf_counter() - t0)
-    assert len(rows) == N_KEYS
-    return min(times)
+def _log(msg):
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
-def main():
+_T0 = time.perf_counter()
+
+
+def _worker(mode: str) -> None:
+    """mode: 'tpu' (accelerated engine) or 'cpu' (oracle engine)."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _log(f"worker[{mode}]: initializing backend")
+    dev = jax.devices()[0]
+    _log(f"worker[{mode}]: backend up: {dev.platform}")
+
     import spark_rapids_tpu as srt
 
     session = srt.new_session()
     session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
-    df = build_df(session)
+    session.conf.set("rapids.tpu.sql.enabled", mode == "tpu")
+    df = _build_df(session)
+    _log(f"worker[{mode}]: data built, warmup (compile) pass")
+    rows = _run_query(df)
+    assert len(rows) == N_KEYS, len(rows)
+    _log(f"worker[{mode}]: warmup done, timing")
+    iters = TPU_ITERS if mode == "tpu" else CPU_ITERS
+    times = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        _run_query(df)
+        times.append(time.perf_counter() - t0)
+        _log(f"worker[{mode}]: iter {i}: {times[-1]:.3f}s")
+    print(json.dumps({"mode": mode, "platform": dev.platform,
+                      "best_s": min(times)}), flush=True)
 
-    session.conf.set("rapids.tpu.sql.enabled", True)
-    tpu_t = timed(session, df)
-    session.conf.set("rapids.tpu.sql.enabled", False)
-    cpu_t = timed(session, df, iters=2)
 
+# ------------------------------------------------------------- supervisor
+
+def _scrubbed_cpu_env() -> dict:
+    from spark_rapids_tpu.utils.hostenv import scrubbed_cpu_env
+
+    return scrubbed_cpu_env()
+
+
+def _run_phase(mode: str, env: dict, budget_s: int):
+    """Run a worker subprocess; return its parsed result dict or None."""
+    _log(f"phase[{mode}]: starting (budget {budget_s}s)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", mode],
+            env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+            text=True, timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        _log(f"phase[{mode}]: TIMED OUT after {budget_s}s")
+        return None
+    if proc.returncode != 0:
+        _log(f"phase[{mode}]: FAILED rc={proc.returncode}")
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main() -> None:
+    cpu = _run_phase("cpu", _scrubbed_cpu_env(), CPU_BUDGET_S)
+    acc = _run_phase("tpu", dict(os.environ), TPU_BUDGET_S)
+    platform = acc["platform"] if acc else None
+    if acc is None:
+        # Accelerator runtime unavailable/wedged: measure the accelerated
+        # engine path on the CPU backend instead so the driver still gets
+        # a real, parseable measurement (honestly labelled).
+        acc = _run_phase("tpu", _scrubbed_cpu_env(), CPU_BUDGET_S)
+        platform = "cpu-fallback" if acc else None
+    if acc is None:
+        print(json.dumps({"metric": "filter_project_groupby_gbps",
+                          "value": 0.0, "unit": "GB/s/chip",
+                          "vs_baseline": 0.0, "error": "bench failed"}))
+        return
     input_bytes = N_ROWS * (8 + 8 + 4)
-    gbps = input_bytes / tpu_t / 1e9
-    print(json.dumps({
+    gbps = input_bytes / acc["best_s"] / 1e9
+    result = {
         "metric": "filter_project_groupby_gbps",
         "value": round(gbps, 4),
         "unit": "GB/s/chip",
-        "vs_baseline": round(cpu_t / tpu_t, 3),
-    }))
+        "vs_baseline": (round(cpu["best_s"] / acc["best_s"], 3)
+                        if cpu else 0.0),
+        "platform": platform,
+    }
+    if cpu is None:
+        result["error"] = "cpu oracle phase failed; vs_baseline unknown"
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,56 @@
+"""Host-process environment control for backend selection.
+
+The deployment environment may export accelerator-runtime variables (e.g.
+the axon PJRT plugin's pool/remote-compile settings) that force jax onto
+the real chip even when a CPU-backend virtual mesh is wanted — and its
+sitecustomize forces the TPU backend regardless of ``JAX_PLATFORMS`` while
+``PALLAS_AXON_POOL_IPS`` is set.  Every place that needs a scrubbed
+CPU-backend child environment (bench supervisor, multichip dryrun, test
+conftest) must share ONE scrub rule set so a newly discovered variable is
+removed everywhere at once.
+
+Imports nothing heavier than ``os`` — safe for supervisors that must not
+touch jax themselves.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# Env vars that, when present, let the accelerator runtime hijack backend
+# selection away from the CPU host platform.
+_ACCELERATOR_ENV_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+)
+
+
+def scrubbed_cpu_env(n_devices: Optional[int] = None,
+                     base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Return a copy of ``base`` (default ``os.environ``) forcing the jax
+    CPU backend, optionally with ``n_devices`` virtual host devices.
+
+    Must be applied to a child process (or to ``os.environ`` before jax
+    initializes a backend) — backend choice is latched at first init.
+    """
+    env = dict(os.environ if base is None else base)
+    for var in _ACCELERATOR_ENV_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = env.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env
+
+
+def apply_cpu_env(n_devices: Optional[int] = None) -> None:
+    """In-place variant for processes that have not yet initialized jax."""
+    os.environ.update(scrubbed_cpu_env(n_devices))
+    for var in _ACCELERATOR_ENV_VARS:
+        os.environ.pop(var, None)

@@ -6,15 +6,18 @@ dryrun contract. Must run before jax initializes a backend.
 """
 
 import os
+import sys
 
-# Must be set before jax import / backend init.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon TPU registration
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Must be set before jax import / backend init.  Shared scrub rules live in
+# spark_rapids_tpu.utils.hostenv (imports no jax).
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from spark_rapids_tpu.utils.hostenv import apply_cpu_env  # noqa: E402
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    apply_cpu_env(8)
+else:
+    apply_cpu_env()
 
 import jax  # noqa: E402
 

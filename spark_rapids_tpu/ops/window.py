@@ -167,10 +167,22 @@ class WindowExpression(Expression):
         self.spec = spec
 
     def children(self):
-        return (self.function,)
+        # Spec expressions ARE children: analysis/transform machinery must
+        # resolve partition/order columns (e.g. `Window.partitionBy("k")`
+        # arrives as an unresolved name) just like the function input.
+        return (self.function, *self.spec.partition_by,
+                *[o.child for o in self.spec.order_by])
 
     def with_children(self, new_children):
-        return WindowExpression(new_children[0], self.spec)
+        n_part = len(self.spec.partition_by)
+        function = new_children[0]
+        part = list(new_children[1:1 + n_part])
+        orders = [
+            SortOrder(c, o.ascending, o.nulls_first)
+            for c, o in zip(new_children[1 + n_part:], self.spec.order_by)
+        ]
+        return WindowExpression(
+            function, WindowSpec(part, orders, self.spec.frame))
 
     @property
     def data_type(self):

@@ -156,6 +156,16 @@ def _register_expr_rules():
     for cls in (AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average,
                 AGG.First, AGG.Last):
         r(cls, f"aggregate {cls.__name__}", tag_fn=_tag_agg)
+    # window (reference registry: GpuWindowExpression/GpuRowNumber etc.,
+    # GpuOverrides.scala window expression rules)
+    from spark_rapids_tpu.ops import window as W
+
+    r(W.WindowExpression, "function over a window spec",
+      tag_fn=_tag_window_expr)
+    for cls in (W.RowNumber, W.Rank, W.DenseRank, W.NTile):
+        r(cls, f"window ranking {cls.__name__}")
+    r(W.Lag, "value from a preceding row")
+    r(W.Lead, "value from a following row")
 
 
 def _tag_cast(m: ExprMeta) -> None:
@@ -179,6 +189,31 @@ def _tag_cast(m: ExprMeta) -> None:
             "cast string->timestamp only supports a subset of formats; set "
             "rapids.tpu.sql.castStringToTimestamp.enabled=true")
     _tag_f64_on_tpu(m)
+
+
+def _tag_window_expr(m: ExprMeta) -> None:
+    """Gate window shapes the device kernel does not cover yet (the kernel
+    computes frames via segmented prefix scans, exec/window.py)."""
+    from spark_rapids_tpu.ops import window as W
+
+    w = m.expr
+    f = w.function
+    frame = w.spec.frame
+    if frame.frame_type == "range" and frame.lower is not W.UNBOUNDED:
+        m.will_not_work(
+            "range frames with a finite lower bound run on the CPU engine")
+    if isinstance(f, (AGG.Min, AGG.Max)) and not (
+            frame.is_unbounded_both or frame.is_unbounded_to_current):
+        m.will_not_work(
+            "min/max over offset frames runs on the CPU engine")
+    input_child = f.children()[0] if f.children() else None
+    if input_child is not None and \
+            input_child.data_type is DataType.STRING:
+        m.will_not_work(
+            "window functions over STRING inputs run on the CPU engine "
+            "(no device string gather in the window kernel yet)")
+    if isinstance(f, W.NTile) and f.n <= 0:
+        m.will_not_work("ntile(n) requires n > 0")
 
 
 def _tag_agg(m: ExprMeta) -> None:
@@ -308,6 +343,12 @@ def _register_feature_exec_rules():
         lambda cpu, ch: TpuFileScanExec(cpu.attrs, cpu.splits, cpu.fmt),
         tag_fn=_tag_scan)
 
+    from spark_rapids_tpu.exec.window import CpuWindowExec, TpuWindowExec
+
+    register_exec(
+        CpuWindowExec, "window functions (one-sort segmented-scan kernel)",
+        lambda cpu, ch: TpuWindowExec(cpu.window_exprs, ch[0]))
+
 
 # ---------------------------------------------------------------------------
 # Node-expression extraction (which expressions does a node evaluate?)
@@ -355,6 +396,10 @@ def _node_expressions(plan: PhysicalExec) -> List[Expression]:
         if plan.condition is not None:
             out.append(plan.condition)
         return out
+    from spark_rapids_tpu.exec.window import _WindowBase
+
+    if isinstance(plan, _WindowBase):
+        return list(plan.window_exprs)
     return []
 
 

@@ -101,7 +101,8 @@ def _project_with_windows(project_list, child: PhysicalExec,
         groups.setdefault(skey, []).append(alias)
     node = child
     for aliases in groups.values():
-        node = CpuWindowExec(aliases, node)
+        node = CpuWindowExec(
+            aliases, _window_distribution(aliases[0].child.spec, node, conf))
 
     def rewrite(e):
         if isinstance(e, WindowExpression):
@@ -112,12 +113,34 @@ def _project_with_windows(project_list, child: PhysicalExec,
     return B.CpuProjectExec(rewritten, node)
 
 
+def _window_distribution(spec, child: PhysicalExec,
+                         conf: C.TpuConf) -> PhysicalExec:
+    """Window requires all rows of a partition key in one task partition
+    (reference: GpuWindowExec requiredChildDistribution = ClusteredDistribution
+    on partitionSpec): hash-exchange on partition_by, or collapse to a single
+    partition when partition_by is empty."""
+    from spark_rapids_tpu.shuffle.exchange import (
+        CpuShuffleExchangeExec,
+        HashPartitioning,
+        SinglePartitioning,
+    )
+
+    if spec.partition_by:
+        part = HashPartitioning(list(spec.partition_by),
+                                conf.shuffle_partitions)
+    else:
+        part = SinglePartitioning()
+    return CpuShuffleExchangeExec(part, child)
+
+
 @register_planner(L.WindowOp)
 def _plan_window(plan: L.WindowOp, conf: C.TpuConf) -> PhysicalExec:
-    from spark_rapids_tpu.exec.window import CpuWindowExec
+    from spark_rapids_tpu.exec.window import CpuWindowExec, _unwrap
 
     (child,) = _plan_children(plan, conf)
-    return CpuWindowExec(plan.window_exprs, child)
+    spec = _unwrap(plan.window_exprs[0]).spec
+    return CpuWindowExec(plan.window_exprs,
+                         _window_distribution(spec, child, conf))
 
 
 @register_planner(L.Filter)

@@ -1,0 +1,200 @@
+"""Window function equivalence tests (reference: WindowFunctionSuite.scala,
+integration_tests window_function_test.py).
+
+Multi-partition inputs are the load-bearing case: the planner must insert a
+hash exchange on partition_by (or collapse to one partition for empty
+partition_by) so each key's rows land in one task partition.
+"""
+
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.plan.window_api import Window
+
+from tests.harness import (
+    FloatGen,
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+    gen_df,
+)
+
+
+def _kv(n=200, parts=3, key_hi=6, key_type=DataType.INT32):
+    """(k, v, x) generator spec over `parts` input partitions."""
+    return lambda s: gen_df(
+        s, [("k", IntGen(key_type, lo=0, hi=key_hi)),
+            ("v", IntGen(DataType.INT64, lo=-1000, hi=1000)),
+            ("x", IntGen(DataType.INT32, lo=0, hi=50))],
+        n=n, num_partitions=parts)
+
+
+def _w(df_fn, *wcols):
+    def build(s):
+        df = df_fn(s)
+        for i, c in enumerate(wcols):
+            df = df.withColumn(f"w{i}", c)
+        return df
+    return build
+
+
+def test_row_number_multi_partition(session):
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.row_number().over(w)), ignore_order=True)
+
+
+def test_row_number_desc_order(session):
+    w = Window.partitionBy("k").orderBy(F.col("v").desc(), "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.row_number().over(w)), ignore_order=True)
+
+
+def test_rank_dense_rank_with_ties(session):
+    # x in [0, 4): plenty of ties for rank vs dense_rank to disagree on
+    w = Window.partitionBy("k").orderBy(F.col("x"))
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(lambda s: gen_df(
+            s, [("k", IntGen(DataType.INT32, lo=0, hi=3)),
+                ("x", IntGen(DataType.INT32, lo=0, hi=3))],
+            n=150, num_partitions=3),
+           F.rank().over(w), F.dense_rank().over(w)),
+        ignore_order=True)
+
+
+def test_ntile(session):
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.ntile(4).over(w)), ignore_order=True)
+
+
+def test_lag_lead(session):
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(_kv(), F.lag("v").over(w), F.lead("v", 2).over(w)),
+        ignore_order=True)
+
+
+def test_lag_with_default(session):
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(_kv(), F.lag("v", 3, -1).over(w)), ignore_order=True)
+
+
+def test_sum_over_unbounded_partition(session):
+    # no order_by: whole-partition frame; per-key sums must be global,
+    # not per-task-partition (the round-1 advisor bug)
+    w = Window.partitionBy("k")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(n=300, parts=4), F.sum("v").over(w)),
+        ignore_order=True)
+
+
+def test_running_sum_range_current_row(session):
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.sum("v").over(w)), ignore_order=True)
+
+
+def test_count_avg_over_rows_frame(session):
+    w = (Window.partitionBy("k").orderBy("v", "x")
+         .rowsBetween(-2, 1))
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(_kv(), F.count("v").over(w), F.avg("v").over(w)),
+        ignore_order=True)
+
+
+def test_sum_rows_unbounded_following(session):
+    w = (Window.partitionBy("k").orderBy("v", "x")
+         .rowsBetween(Window.currentRow, Window.unboundedFollowing))
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.sum("v").over(w)), ignore_order=True)
+
+
+def test_min_max_unbounded(session):
+    w = Window.partitionBy("k")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(_kv(), F.min("v").over(w), F.max("v").over(w)),
+        ignore_order=True)
+
+
+def test_min_max_running(session):
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(_kv(), F.min("x").over(w), F.max("x").over(w)),
+        ignore_order=True)
+
+
+def test_window_empty_partition_by(session):
+    # global window: needs the single-partition exchange
+    w = Window.orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(n=120, parts=3), F.row_number().over(w)),
+        ignore_order=True)
+
+
+def test_window_with_nulls_in_keys_and_values(session):
+    gen = lambda s: gen_df(
+        s, [("k", IntGen(DataType.INT32, lo=0, hi=4, nullable=True)),
+            ("v", IntGen(DataType.INT64, nullable=True)),
+            ("x", IntGen(DataType.INT32, lo=0, hi=9))],
+        n=250, num_partitions=3)
+    w = Window.partitionBy("k").orderBy("v", "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(gen, F.row_number().over(w), F.sum("v").over(w)),
+        ignore_order=True)
+
+
+def test_window_float_sum_running(session):
+    # no inf/nan specials: the device computes frame sums as prefix-sum
+    # differences, so a partition containing both +inf and -inf yields nan
+    # where ordered accumulation yields inf — exactly the float-aggregation
+    # incompat class the variableFloatAgg conf opts into.
+    gen = lambda s: gen_df(
+        s, [("k", IntGen(DataType.INT32, lo=0, hi=4)),
+            ("v", FloatGen(DataType.FLOAT32, special=False)),
+            ("x", IntGen(DataType.INT32))],
+        n=150, num_partitions=2)
+    w = Window.partitionBy("k").orderBy("x", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(gen, F.sum("v").over(w)), ignore_order=True,
+        approx_float=1e-4,
+        extra_conf={"rapids.tpu.sql.variableFloatAgg.enabled": True})
+
+
+def test_two_window_specs_in_one_projection(session):
+    w1 = Window.partitionBy("k").orderBy("v", "x")
+    w2 = Window.partitionBy("x")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        _w(_kv(key_hi=4), F.row_number().over(w1), F.sum("v").over(w2)),
+        ignore_order=True)
+
+
+def test_string_window_input_falls_back(session):
+    gen = lambda s: gen_df(
+        s, [("k", IntGen(DataType.INT32, lo=0, hi=3)),
+            ("t", StringGen(max_len=6)),
+            ("x", IntGen(DataType.INT32))],
+        n=100, num_partitions=2)
+    w = Window.partitionBy("k").orderBy("x")
+    assert_tpu_fallback_collect(
+        session, _w(gen, F.lag("t").over(w)),
+        fallback_exec="CpuWindowExec", ignore_order=True)
+
+
+def test_range_finite_lower_falls_back(session):
+    # rows frame min/max with offsets is CPU-only for now
+    w = (Window.partitionBy("k").orderBy("v", "x").rowsBetween(-2, 2))
+    assert_tpu_fallback_collect(
+        session, _w(_kv(), F.min("v").over(w)),
+        fallback_exec="CpuWindowExec", ignore_order=True)

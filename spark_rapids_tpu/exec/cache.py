@@ -36,8 +36,22 @@ _HOST_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 def invalidate(logical_node) -> None:
     with _LOCK:
-        _DEVICE_CACHE.pop(logical_node, None)
+        dropped = _DEVICE_CACHE.pop(logical_node, None)
         _HOST_CACHE.pop(logical_node, None)
+    if dropped:
+        _free_buffers([b for part in dropped for b in part])
+
+
+def _free_buffers(bufs) -> None:
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    fw = SpillFramework.get()
+    if fw is not None:
+        for b in bufs:
+            try:
+                fw.free(b)
+            except Exception:
+                pass
 
 
 class _CachedScanBase(PhysicalExec):
@@ -84,10 +98,54 @@ class _CachedScanBase(PhysicalExec):
 
 
 class TpuCachedScanExec(_CachedScanBase, TpuExec):
+    """Device-resident cache whose entries are SPILLABLE: each materialized
+    batch is registered with the spill framework so the relation cache
+    participates in the device->host->disk chain instead of pinning HBM
+    (reference: cached GPU data flows through the RapidsBufferCatalog the
+    same way, RapidsBufferCatalog.scala:40-99)."""
+
     placement = "tpu"
 
     def _store(self):
         return _DEVICE_CACHE
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        from spark_rapids_tpu.memory.spill import SpillFramework
+
+        fw = SpillFramework.get()
+        if fw is None:
+            return super().execute(ctx)
+        with _LOCK:
+            cached = _DEVICE_CACHE.get(self.logical_node)
+        if cached is None:
+            child_pb = self.children[0].execute(ctx)
+
+            def mat(pidx: int):
+                out = []
+                for b in child_pb.iterator(pidx):
+                    n = b.host_rows() if hasattr(b, "host_rows") else b.num_rows
+                    if n > 0:
+                        out.append(fw.add_device_batch(b))
+                return out
+
+            if ctx.scheduler is not None:
+                parts = ctx.scheduler.run_job(child_pb.num_partitions, mat)
+            else:
+                parts = [mat(p) for p in range(child_pb.num_partitions)]
+            with _LOCK:
+                cached = _DEVICE_CACHE.setdefault(self.logical_node, parts)
+                if cached is parts:
+                    # free the buffers when the logical node (cache key) dies
+                    bufs = [b for part in parts for b in part]
+                    weakref.finalize(self.logical_node, _free_buffers, bufs)
+
+        def factory(pidx: int):
+            def gen():
+                for buf in cached[pidx]:
+                    yield fw.get_device_batch(buf)
+            return count_output(self.metrics, gen())
+
+        return PartitionedBatches(len(cached), factory)
 
 
 class CpuCachedScanExec(_CachedScanBase, CpuExec):

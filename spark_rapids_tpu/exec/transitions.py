@@ -127,9 +127,17 @@ class HostToDeviceExec(TpuExec):
         peak_mem = self.metrics[M.PEAK_DEVICE_MEMORY]
 
         def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            from spark_rapids_tpu.memory.spill import SpillFramework
+
             sem = TpuSemaphore.get()
+            fw = SpillFramework.get()
             for hb in child_pb.iterator(pidx):
                 sem.acquire_if_necessary(current_task_id())
+                if fw is not None:
+                    # preemptive spill before the upload (the TPU analog of
+                    # the RMM alloc-failure hook,
+                    # DeviceMemoryEventHandler.scala:65-89)
+                    fw.watermark.ensure_headroom(hb.estimated_size_bytes())
                 with M.trace_range("HostToDevice", total_time):
                     db = hb.to_device()
                 peak_mem.set_max(db.device_memory_size())

@@ -24,6 +24,7 @@ from spark_rapids_tpu.engine.scheduler import TaskScheduler
 from spark_rapids_tpu.exec.base import ExecContext, PhysicalExec
 from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spill import SpillFramework
 from spark_rapids_tpu.ops.base import AttributeReference
 from spark_rapids_tpu.plan import logical as L
 from spark_rapids_tpu.plan.dataframe import DataFrame
@@ -66,6 +67,14 @@ class TpuSession:
         self.plan_capture = PlanCapture()
         # executor bring-up (reference: RapidsExecutorPlugin.init)
         self.device_manager = TpuDeviceManager.initialize(self.conf)
+        # spill store chain + watermark (reference: GpuShuffleEnv.initStorage,
+        # GpuShuffleEnv.scala:57-79). Budget honors this session's conf even
+        # though the device manager is a process singleton.
+        hbm_total = self.conf.get(C.HBM_SIZE_OVERRIDE) or \
+            self.device_manager.hbm_total
+        budget = int(hbm_total * self.conf.get(C.MEMORY_FRACTION))
+        self.spill = SpillFramework.initialize(
+            self.conf, budget, self.device_manager.bytes_in_use)
         TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         self.scheduler = TaskScheduler(self.conf.task_threads)
         with TpuSession._lock:
@@ -86,6 +95,7 @@ class TpuSession:
     def stop(self):
         self.scheduler.shutdown()
         TpuSemaphore.shutdown()
+        SpillFramework.shutdown()
         with TpuSession._lock:
             if TpuSession._active is self:
                 TpuSession._active = None

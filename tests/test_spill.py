@@ -1,0 +1,253 @@
+"""Spill subsystem tests.
+
+Mirrors the reference's store suites (RapidsBufferCatalogSuite,
+RapidsDeviceMemoryStoreSuite, RapidsHostMemoryStoreSuite, RapidsDiskStoreSuite
+— device->host->disk chain under a tiny synthetic budget) plus the
+serialization round-trip and an end-to-end query whose HBM budget is smaller
+than its input.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.serde import (
+    deserialize_batch,
+    serialize_batch,
+    serialized_size,
+)
+from spark_rapids_tpu.memory.spill import (
+    SpillFramework,
+    SpillPriorities,
+    StorageTier,
+)
+
+from spark_rapids_tpu.plan import functions as F
+
+
+def _batch(n=10, with_strings=True, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = [
+        HostColumnVector.from_pylist(
+            [int(x) if i % 3 else None
+             for i, x in enumerate(rng.integers(-100, 100, n))],
+            DataType.INT64),
+        HostColumnVector.from_pylist(
+            [float(x) for x in rng.normal(size=n)], DataType.FLOAT64),
+        HostColumnVector.from_pylist(
+            [bool(x) if i % 4 else None
+             for i, x in enumerate(rng.integers(0, 2, n))], DataType.BOOL),
+    ]
+    if with_strings:
+        words = ["", "a", "ab", "héllo", "wörld✓", None, "xyz" * 10]
+        cols.append(HostColumnVector.from_pylist(
+            [words[i % len(words)] for i in range(n)], DataType.STRING))
+    return HostColumnarBatch(cols, n)
+
+
+def _rows(b):
+    return b.to_pylist_rows()
+
+
+# ---------------------------------------------------------------------------
+# serde round trip
+# ---------------------------------------------------------------------------
+class TestSerde:
+    def test_round_trip_mixed(self):
+        b = _batch(37)
+        data = serialize_batch(b)
+        assert len(data) == serialized_size(b)
+        out = deserialize_batch(data)
+        assert out.num_rows == 37
+        assert out.dtypes() == b.dtypes()
+        assert _rows(out) == _rows(b)
+
+    def test_round_trip_empty(self):
+        b = HostColumnarBatch(
+            [HostColumnVector.from_pylist([], DataType.INT32)], 0)
+        out = deserialize_batch(serialize_batch(b))
+        assert out.num_rows == 0 and out.num_columns == 1
+
+    def test_round_trip_zero_columns(self):
+        b = HostColumnarBatch([], 5)
+        out = deserialize_batch(serialize_batch(b))
+        assert out.num_rows == 5 and out.num_columns == 0
+
+    def test_all_null_strings(self):
+        b = HostColumnarBatch([HostColumnVector.from_pylist(
+            [None, None, None], DataType.STRING)], 3)
+        out = deserialize_batch(serialize_batch(b))
+        assert _rows(out) == [(None,), (None,), (None,)]
+
+    def test_every_dtype(self):
+        vals = {
+            DataType.BOOL: [True, False, None],
+            DataType.INT8: [1, -2, None],
+            DataType.INT16: [300, -4, None],
+            DataType.INT32: [70000, -5, None],
+            DataType.INT64: [1 << 40, -6, None],
+            DataType.FLOAT32: [1.5, -2.25, None],
+            DataType.FLOAT64: [3.14159, -0.0, None],
+            DataType.STRING: ["x", "", None],
+            DataType.DATE: [18000, 0, None],
+            DataType.TIMESTAMP: [1_600_000_000_000_000, 0, None],
+        }
+        cols = [HostColumnVector.from_pylist(v, dt) for dt, v in vals.items()]
+        b = HostColumnarBatch(cols, 3)
+        out = deserialize_batch(serialize_batch(b))
+        assert _rows(out) == _rows(b)
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError):
+            deserialize_batch(b"XXXX" + b"\x00" * 16)
+
+    def test_deterministic(self):
+        assert serialize_batch(_batch(20)) == serialize_batch(_batch(20))
+
+
+# ---------------------------------------------------------------------------
+# store chain
+# ---------------------------------------------------------------------------
+def _framework(host_limit=1 << 20, budget=0, tmp_path=None):
+    conf = C.TpuConf({
+        "rapids.tpu.memory.host.spillStorageSize": host_limit,
+        **({"rapids.tpu.memory.spill.dir": str(tmp_path)} if tmp_path else {}),
+    })
+    return SpillFramework(conf, budget, lambda: 0)
+
+
+class TestStoreChain:
+    def test_device_to_host_spill(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        hb = _batch(16)
+        buf = fw.device_store.add_batch(hb.to_device())
+        assert buf.tier is StorageTier.DEVICE
+        assert fw.device_store.buffer_count() == 1
+        fw.device_store.synchronous_spill(0)
+        assert buf.tier is StorageTier.HOST
+        assert fw.device_store.buffer_count() == 0
+        assert fw.host_store.buffer_count() == 1
+        assert buf.device_batch is None and buf.host_bytes is not None
+        # data survives the round trip
+        assert _rows(fw.get_host_batch(buf)) == _rows(hb)
+
+    def test_host_to_disk_spill(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        hb = _batch(16)
+        buf = fw.add_host_batch(hb)
+        fw.host_store.synchronous_spill(0)
+        assert buf.tier is StorageTier.DISK
+        assert buf.host_bytes is None and buf.disk_path is not None
+        import os
+        assert os.path.exists(buf.disk_path)
+        assert _rows(fw.get_host_batch(buf)) == _rows(hb)
+
+    def test_full_chain_and_rematerialize(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        hb = _batch(32)
+        buf = fw.device_store.add_batch(hb.to_device())
+        fw.device_store.synchronous_spill(0)
+        fw.host_store.synchronous_spill(0)
+        assert buf.tier is StorageTier.DISK
+        # climbing back re-uploads AND promotes to the device tier
+        db = fw.get_device_batch(buf)
+        assert buf.tier is StorageTier.DEVICE
+        assert buf.disk_path is None
+        assert _rows(db.to_host()) == _rows(hb)
+
+    def test_host_store_bound_pushes_to_disk(self, tmp_path):
+        hb = _batch(64)
+        size = serialized_size(hb)
+        # host store fits exactly one buffer
+        fw = _framework(host_limit=size + 8, tmp_path=tmp_path)
+        b1 = fw.add_host_batch(hb)
+        b2 = fw.add_host_batch(_batch(64, seed=1))
+        # adding b2 overflows the bound; the older/lower-priority one goes down
+        tiers = sorted([b1.tier, b2.tier])
+        assert tiers == [StorageTier.HOST, StorageTier.DISK]
+        assert fw.host_store.current_size <= size + 8
+
+    def test_pinned_buffer_not_spilled(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        buf = fw.device_store.add_batch(_batch(8).to_device())
+        fw.acquire(buf)
+        spilled = fw.device_store.synchronous_spill(0)
+        assert spilled == 0 and buf.tier is StorageTier.DEVICE
+        fw.release(buf)
+        fw.device_store.synchronous_spill(0)
+        assert buf.tier is StorageTier.HOST
+
+    def test_spill_priority_order(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        low = fw.device_store.add_batch(
+            _batch(8).to_device(), priority=SpillPriorities.OUTPUT_FOR_READ)
+        high = fw.device_store.add_batch(
+            _batch(8, seed=2).to_device(), priority=SpillPriorities.INPUT_ACTIVE)
+        # spill exactly one buffer's worth: the low-priority one must go first
+        fw.device_store.synchronous_spill(fw.device_store.current_size - 1)
+        assert low.tier is StorageTier.HOST
+        assert high.tier is StorageTier.DEVICE
+
+    def test_free_removes_everywhere(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        buf = fw.device_store.add_batch(_batch(8).to_device())
+        fw.device_store.synchronous_spill(0)
+        fw.host_store.synchronous_spill(0)
+        path = buf.disk_path
+        fw.free(buf)
+        import os
+        assert not os.path.exists(path)
+        with pytest.raises(KeyError):
+            fw.catalog.lookup(buf.id)
+        assert fw.disk_store.buffer_count() == 0
+
+    def test_watermark_triggers_spill(self, tmp_path):
+        hb = _batch(128, with_strings=False)
+        db = hb.to_device()
+        size = db.device_memory_size()
+        fw = _framework(budget=int(size * 1.5), tmp_path=tmp_path)
+        b1 = fw.add_device_batch(db)
+        assert b1.tier is StorageTier.DEVICE
+        # second add exceeds the budget -> watermark spills the first
+        b2 = fw.add_device_batch(_batch(128, with_strings=False,
+                                        seed=3).to_device())
+        assert b1.tier is StorageTier.HOST
+        assert b2.tier is StorageTier.DEVICE
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: query completes with HBM budget < input size
+# ---------------------------------------------------------------------------
+class TestEndToEnd:
+    def test_cached_query_survives_tiny_budget(self):
+        from spark_rapids_tpu.session import TpuSession
+
+        TpuSession._active = None
+        SpillFramework.shutdown()
+        sess = TpuSession.builder() \
+            .config("rapids.tpu.sql.enabled", True) \
+            .config("rapids.tpu.memory.hbm.sizeOverride", 64 * 1024) \
+            .config("rapids.tpu.memory.hbm.allocFraction", 0.5) \
+            .getOrCreate()
+        try:
+            fw = SpillFramework.get()
+            assert fw is not None and fw.watermark.budget == 32 * 1024
+            n = 4000  # 2 x 32 KB of int64 data per partition set > budget
+            df = sess.createDataFrame(
+                {"a": np.arange(n, dtype=np.int64),
+                 "b": np.arange(n, dtype=np.int64) % 7},
+                num_partitions=4).cache()
+
+            def total():
+                return df.agg(F.sum("a").alias("s")).collect()[0][0]
+
+            assert total() == n * (n - 1) // 2
+            # the cached partitions exceed the budget: some must have spilled
+            assert fw.host_store.buffer_count() + \
+                fw.disk_store.buffer_count() > 0
+            # second access re-materializes spilled cache entries
+            assert total() == n * (n - 1) // 2
+        finally:
+            sess.stop()

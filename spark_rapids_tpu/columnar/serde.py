@@ -69,6 +69,14 @@ def _string_payload(col: HostColumnVector, n: int) -> List[bytes]:
         else:
             encoded.append(b"")
     lengths = np.fromiter((len(b) for b in encoded), dtype=np.int64, count=n)
+    total = int(lengths.sum())
+    if total > np.iinfo(np.int32).max:
+        # offsets are int32 on the wire; batches this large must be split
+        # upstream (the reference caps batch bytes the same way,
+        # RapidsConf.scala:309 batchSizeBytes)
+        raise ValueError(
+            f"string payload of {total} bytes exceeds the 2 GiB serialized "
+            "batch limit; reduce rapids.tpu.sql.batchSizeBytes")
     offsets = np.zeros(n + 1, dtype=np.int32)
     if n:
         offsets[1:] = np.cumsum(lengths)

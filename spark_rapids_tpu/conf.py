@@ -290,6 +290,22 @@ SHUFFLE_TRANSPORT_CLASS = _conf("rapids.tpu.shuffle.transport.class").doc(
     "ICI collective transport used under a multi-device mesh)."
 ).string("spark_rapids_tpu.parallel.transport.LocalShuffleTransport")
 
+SHUFFLE_MODE = _conf("rapids.tpu.shuffle.mode").doc(
+    "Shuffle data plane: 'inprocess' keeps pieces device-resident within the "
+    "process (reference: RapidsShuffleInternalManager device store tier); "
+    "'ici' lowers hash exchanges onto a jitted shard_map + lax.all_to_all "
+    "over the session device mesh (the ICI collective replacement for the "
+    "reference's UCX peer-to-peer transport, UCXShuffleTransport.scala:47-507)."
+).check(lambda v: None if v in ("inprocess", "ici")
+        else "must be inprocess|ici").string("inprocess")
+
+SHUFFLE_SERIALIZE = _conf("rapids.tpu.shuffle.serialize.enabled").doc(
+    "Force shuffle pieces to cross the exchange as serialized host bytes "
+    "(the fallback-tier serializer, reference: "
+    "GpuColumnarBatchSerializer.scala:37-245). Serialized pieces register "
+    "with the host spill store so shuffle data participates in spill."
+).boolean(False)
+
 SHUFFLE_MAX_BYTES_IN_FLIGHT = _conf("rapids.tpu.shuffle.maxBytesInFlight").doc(
     "Inflight-bytes throttle for shuffle fetches "
     "(reference: spark.rapids.shuffle.transport.maxReceiveInflightBytes)."

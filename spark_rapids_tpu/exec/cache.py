@@ -138,6 +138,9 @@ class TpuCachedScanExec(_CachedScanBase, TpuExec):
                     # free the buffers when the logical node (cache key) dies
                     bufs = [b for part in parts for b in part]
                     weakref.finalize(self.logical_node, _free_buffers, bufs)
+            if cached is not parts:
+                # lost a concurrent-materialization race: drop our copies
+                _free_buffers([b for part in parts for b in part])
 
         def factory(pidx: int):
             def gen():

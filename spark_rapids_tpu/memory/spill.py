@@ -84,7 +84,7 @@ class SpillableBuffer:
                  priority: float = SpillPriorities.DEFAULT):
         self.id = buf_id
         self.size = size  # serialized-bytes size (tier-independent accounting)
-        self.tier = tier
+        self.tier: Optional[StorageTier] = tier  # None = freed (tombstone)
         self.priority = priority
         self.refcount = 0
         self.device_batch: Optional[ColumnarBatch] = None
@@ -156,11 +156,12 @@ class BufferStore:
             return len(self._buffers)
 
     # -- spill ---------------------------------------------------------------
-    def _spill_candidate(self) -> Optional[SpillableBuffer]:
+    def _spill_candidate(self, skip=()) -> Optional[SpillableBuffer]:
         """Lowest-priority unpinned buffer (reference: per-store
         HashedPriorityQueue ordering, RapidsBufferStore.scala:88)."""
         with self._lock:
-            candidates = [b for b in self._buffers.values() if b.refcount == 0]
+            candidates = [b for b in self._buffers.values()
+                          if b.refcount == 0 and b.id not in skip]
         if not candidates:
             return None
         return min(candidates, key=lambda b: (b.priority, b.id))
@@ -168,32 +169,46 @@ class BufferStore:
     def synchronous_spill(self, target_size: int) -> int:
         """Spill until current_size <= target_size; returns bytes spilled
         (reference: RapidsBufferStore.synchronousSpill,
-        RapidsBufferStore.scala:148-188)."""
+        RapidsBufferStore.scala:148-188). Buffers that race to pinned/freed
+        between selection and spill are skipped, not retried forever."""
         spilled = 0
+        skip = set()
         while self.current_size > target_size:
-            buf = self._spill_candidate()
+            buf = self._spill_candidate(skip)
             if buf is None:
                 log.warning(
                     "%s store: cannot reach spill target %d (size=%d, all "
                     "buffers pinned)", self.tier.name, target_size,
                     self.current_size)
                 break
-            spilled += self.spill_buffer(buf)
+            got = self.spill_buffer(buf)
+            if got == 0:
+                skip.add(buf.id)
+            spilled += got
         return spilled
 
     def spill_buffer(self, buf: SpillableBuffer) -> int:
         """Move one buffer to the next tier (reference: copy-on-spill +
-        catalog update, RapidsBufferStore.scala:255-282)."""
+        catalog update, RapidsBufferStore.scala:255-282).
+
+        Lock discipline: cross-buffer work (make_room, overflow push-down)
+        happens OUTSIDE buf.lock — a buffer lock is never held while
+        acquiring another buffer's lock, so spill chains cannot deadlock."""
         if self.spill_store is None:
             raise RuntimeError(f"{self.tier.name} store has no spill target")
+        self.spill_store.make_room(buf.size)
         with buf.lock:
-            if buf.tier is not self.tier:
-                return 0  # raced: someone else moved it
-            self.spill_store.make_room(buf.size)
+            if buf.tier is not self.tier or buf.refcount > 0:
+                return 0  # raced: moved, freed, or pinned meanwhile
             self._demote(buf)
             self.untrack(buf)
             buf.tier = self.spill_store.tier
             self.spill_store.track(buf)
+        # absorb overflow (e.g. buf.size alone exceeds a bounded store's
+        # limit, or concurrent spills raced past make_room)
+        limit = self.spill_store.size_limit()
+        if limit is not None and self.spill_store.current_size > limit:
+            self.spill_store.synchronous_spill(limit)
         if log.isEnabledFor(logging.DEBUG):
             log.debug("spilled buffer %d (%d B) %s -> %s", buf.id, buf.size,
                       self.tier.name, buf.tier.name)
@@ -214,18 +229,6 @@ class BufferStore:
         """Convert buf's payload from this tier's form to the next tier's."""
         raise NotImplementedError
 
-    # -- free ----------------------------------------------------------------
-    def free(self, buf: SpillableBuffer) -> None:
-        self.untrack(buf)
-        self.catalog.remove(buf.id)
-        buf.device_batch = None
-        buf.host_bytes = None
-        if buf.disk_path:
-            try:
-                os.unlink(buf.disk_path)
-            except OSError:
-                pass
-            buf.disk_path = None
 
 
 class DeviceStore(BufferStore):
@@ -269,8 +272,14 @@ class HostStore(BufferStore):
         return self.limit_bytes
 
     def track(self, buf: SpillableBuffer) -> None:
+        # NOTE: deliberately no spill here — track runs under buf.lock from
+        # spill_buffer; the caller pushes overflow down afterwards.
         super().track(buf)
-        # over-limit after a demotion from device: push down to disk
+
+    def add_bytes_tracked(self, buf: SpillableBuffer) -> None:
+        """Register a new host-tier buffer and push overflow to disk (safe:
+        not called under any buffer lock)."""
+        super().track(buf)
         if self.current_size > self.limit_bytes and self.spill_store:
             self.synchronous_spill(self.limit_bytes)
 
@@ -356,25 +365,45 @@ class SpillFramework:
     def add_host_batch(self, host_batch: HostColumnarBatch,
                        priority: float = SpillPriorities.DEFAULT
                        ) -> SpillableBuffer:
-        data = serialize_batch(host_batch)
+        return self.add_host_bytes(serialize_batch(host_batch), priority)
+
+    def add_host_bytes(self, data: bytes,
+                       priority: float = SpillPriorities.DEFAULT
+                       ) -> SpillableBuffer:
+        """Register already-serialized bytes at the host tier (used by the
+        serialized shuffle tier so shuffle pieces participate in spill,
+        reference: RapidsCachingWriter registering shuffle buffers,
+        RapidsShuffleInternalManager.scala:92-141)."""
         buf = SpillableBuffer(next_buffer_id(), len(data), StorageTier.HOST,
                               priority)
         buf.host_bytes = data
         self.catalog.register(buf)
-        self.host_store.track(buf)
+        self.host_store.add_bytes_tracked(buf)
         return buf
+
+    def read_bytes(self, buf: SpillableBuffer) -> bytes:
+        with buf.lock:
+            return self._read_bytes(buf)
 
     def get_device_batch(self, buf: SpillableBuffer) -> ColumnarBatch:
         """Materialize on device, re-uploading if spilled (reference:
         RapidsBufferCatalog.acquireBuffer + getColumnarBatch climbing tiers).
-        """
+
+        buf.lock is NOT held across ensure_headroom/upload (cross-buffer
+        work); a concurrent rematerialization race is resolved by letting
+        the first writer win."""
         with buf.lock:
             if buf.device_batch is not None:
                 return buf.device_batch
             data = self._read_bytes(buf)
-            host = deserialize_batch(data)
-            self.watermark.ensure_headroom(len(data))
-            batch = host.to_device()
+        # outside the lock: spill others + upload
+        self.watermark.ensure_headroom(len(data))
+        batch = deserialize_batch(data).to_device()
+        with buf.lock:
+            if buf.device_batch is not None:  # lost the race
+                return buf.device_batch
+            if buf.tier is None:  # freed meanwhile
+                return batch
             # promote back to the device tier so later accesses are free
             store = self._store_for(buf.tier)
             store.untrack(buf)
@@ -409,7 +438,24 @@ class SpillFramework:
             buf.refcount = max(0, buf.refcount - 1)
 
     def free(self, buf: SpillableBuffer) -> None:
-        self._store_for(buf.tier).free(buf)
+        """Release a buffer from whatever tier holds it. Runs under buf.lock
+        and tombstones the tier so a concurrent spill_buffer (which
+        re-checks tier under the lock) backs off instead of demoting a
+        half-freed buffer."""
+        with buf.lock:
+            if buf.tier is None:
+                return
+            self._store_for(buf.tier).untrack(buf)
+            self.catalog.remove(buf.id)
+            buf.device_batch = None
+            buf.host_bytes = None
+            if buf.disk_path:
+                try:
+                    os.unlink(buf.disk_path)
+                except OSError:
+                    pass
+                buf.disk_path = None
+            buf.tier = None
 
     def _store_for(self, tier: StorageTier) -> BufferStore:
         return {StorageTier.DEVICE: self.device_store,

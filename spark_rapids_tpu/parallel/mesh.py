@@ -25,6 +25,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
 from spark_rapids_tpu.exec import rowkeys as RK
 from spark_rapids_tpu.ops import hashing as H
 from spark_rapids_tpu.ops.values import ColV
@@ -98,8 +103,6 @@ def distributed_agg_step(mesh: Mesh, n_shards: int, cap: int,
       group keys / sums / validity per shard [n_shards, n_shards*bucket_cap]
       plus the global group count (replicated via psum).
     """
-    from jax.experimental.shard_map import shard_map
-
     def per_shard(keys, values, valid):
         keys = keys[0]
         values = values[0]

@@ -142,6 +142,7 @@ class _ExchangeBase(PhysicalExec):
         child_pb = self.children[0].execute(ctx)
         n_out = self.partitioning.num_partitions
         n_maps = child_pb.num_partitions
+        serialize = ctx.conf.get(C.SHUFFLE_SERIALIZE)
 
         def run_map(pidx: int) -> List[List[Any]]:
             buckets: List[List[Any]] = [[] for _ in range(n_out)]
@@ -152,6 +153,8 @@ class _ExchangeBase(PhysicalExec):
                 for target, piece in map_fn(pidx, batch):
                     if not getattr(piece, "rows_on_host", True) or \
                             piece.num_rows > 0:
+                        if serialize:
+                            piece = _encode_piece(piece)
                         buckets[target].append(piece)
             return buckets
 
@@ -167,13 +170,22 @@ class _ExchangeBase(PhysicalExec):
                     reduce_buckets[t].append(piece)
                     bytes_m.add(_piece_bytes(piece))
 
+        to_device = self.placement == "tpu"
+
         def factory(pidx: int):
-            return count_output(self.metrics, iter(reduce_buckets[pidx]))
+            def gen():
+                for piece in reduce_buckets[pidx]:
+                    if isinstance(piece, _SerializedPiece):
+                        piece = piece.decode(to_device)
+                    yield piece
+            return count_output(self.metrics, gen())
 
         return PartitionedBatches(n_out, factory)
 
 
 def _piece_bytes(piece) -> int:
+    if isinstance(piece, _SerializedPiece):
+        return piece.size
     if isinstance(piece, ColumnarBatch):
         if piece.live is not None:
             # zero-copy view sharing the source batch: counting the full
@@ -181,6 +193,57 @@ def _piece_bytes(piece) -> int:
             return 0
         return piece.device_memory_size()
     return piece.estimated_size_bytes()
+
+
+class _SerializedPiece:
+    """One shuffle piece held as serialized bytes (reference: the
+    length-prefixed host stream of GpuColumnarBatchSerializer.scala:37-245).
+    When the spill framework is up, the bytes live in the host spill store
+    (and can demote to disk); the piece frees its buffer when dropped."""
+
+    def __init__(self, data=None, buf=None, fw=None):
+        self._data = data
+        self._buf = buf
+        self._fw = fw
+        self.size = len(data) if data is not None else buf.size
+
+    def decode(self, to_device: bool):
+        from spark_rapids_tpu.columnar.serde import deserialize_batch
+
+        data = self._data if self._data is not None else \
+            self._fw.read_bytes(self._buf)
+        host = deserialize_batch(data)
+        if not to_device:
+            return host
+        fw = self._fw
+        if fw is not None:
+            fw.watermark.ensure_headroom(len(data))
+        return host.to_device()
+
+    def __del__(self):
+        if self._buf is not None and self._fw is not None:
+            try:
+                self._fw.free(self._buf)
+            except Exception:
+                pass
+
+
+def _encode_piece(piece) -> _SerializedPiece:
+    from spark_rapids_tpu.columnar.batch import ensure_compact
+    from spark_rapids_tpu.columnar.serde import serialize_batch
+    from spark_rapids_tpu.memory.spill import SpillFramework, SpillPriorities
+
+    if isinstance(piece, ColumnarBatch):
+        host = ensure_compact(piece).to_host()
+    else:
+        host = piece
+    data = serialize_batch(host)
+    fw = SpillFramework.get()
+    if fw is not None:
+        return _SerializedPiece(
+            buf=fw.add_host_bytes(data, SpillPriorities.OUTPUT_FOR_READ),
+            fw=fw)
+    return _SerializedPiece(data=data)
 
 
 def _sample_bounds_host(key_cols: List[np.ndarray], orders: List[SortOrder],
@@ -354,6 +417,16 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         if isinstance(p, SinglePartitioning):
             return self._materialize(ctx, lambda pidx, b: [(0, b)])
 
+        # ICI collective tier (reference: the opt-in RapidsShuffleManager
+        # data plane, RapidsShuffleInternalManager.scala:74-178, replaced by
+        # one all_to_all epoch over the mesh — shuffle/ici.py)
+        if ctx.conf.get(C.SHUFFLE_MODE) == "ici" and \
+                not ctx.conf.get(C.SHUFFLE_SERIALIZE):
+            from spark_rapids_tpu.shuffle import ici
+
+            if ici.supports_ici(p, child_attrs, n):
+                return self._execute_ici(ctx, p, n)
+
         no_strings = all(a.data_type is not DataType.STRING
                          for a in child_attrs)
 
@@ -394,6 +467,36 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         if isinstance(p, RangePartitioning):
             return self._execute_range(ctx, p)
         raise NotImplementedError(p.describe())
+
+    def _execute_ici(self, ctx: ExecContext, p: "HashPartitioning",
+                     n: int) -> PartitionedBatches:
+        """Lower the hash exchange onto one collective epoch over the mesh:
+        materialize map outputs, then shard_map + lax.all_to_all moves every
+        row to its target chip in a single XLA program (shuffle/ici.py)."""
+        from spark_rapids_tpu.shuffle import ici
+
+        child_pb = self.children[0].execute(ctx)
+        child_attrs = self.children[0].output
+        bound = bind_all(p.exprs, child_attrs)
+
+        def mat(pidx: int):
+            return [b for b in child_pb.iterator(pidx)
+                    if not getattr(b, "rows_on_host", True) or b.num_rows > 0]
+
+        if ctx.scheduler is not None:
+            per_map = ctx.scheduler.run_job(child_pb.num_partitions, mat)
+        else:
+            per_map = [mat(i) for i in range(child_pb.num_partitions)]
+        with M.trace_range("IciExchange", self.metrics[M.TOTAL_TIME]):
+            out = ici.ici_hash_exchange(per_map, bound, child_attrs, n)
+        bytes_m = self.metrics["dataSize"]
+        for b in out:
+            bytes_m.add(b.device_memory_size())
+
+        def factory(pidx: int):
+            return count_output(self.metrics, iter([out[pidx]]))
+
+        return PartitionedBatches(n, factory)
 
     def _execute_range(self, ctx: ExecContext,
                        p: RangePartitioning) -> PartitionedBatches:

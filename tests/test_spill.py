@@ -203,6 +203,27 @@ class TestStoreChain:
             fw.catalog.lookup(buf.id)
         assert fw.disk_store.buffer_count() == 0
 
+    def test_oversized_buffer_spills_through_bounded_host_store(self, tmp_path):
+        # regression: a device buffer LARGER than the host store limit used
+        # to self-deadlock (spill_buffer held buf.lock while HostStore.track
+        # synchronously re-spilled the same buffer)
+        fw = _framework(host_limit=64, tmp_path=tmp_path)
+        hb = _batch(64)
+        buf = fw.device_store.add_batch(hb.to_device())
+        assert buf.size > 64
+        fw.device_store.synchronous_spill(0)
+        # too big for the host tier: must land on disk, not hang
+        assert buf.tier is StorageTier.DISK
+        assert _rows(fw.get_host_batch(buf)) == _rows(hb)
+
+    def test_free_is_idempotent_and_locked(self, tmp_path):
+        fw = _framework(tmp_path=tmp_path)
+        buf = fw.device_store.add_batch(_batch(8).to_device())
+        fw.free(buf)
+        assert buf.tier is None
+        fw.free(buf)  # second free is a no-op
+        assert fw.device_store.buffer_count() == 0
+
     def test_watermark_triggers_spill(self, tmp_path):
         hb = _batch(128, with_strings=False)
         db = hb.to_device()

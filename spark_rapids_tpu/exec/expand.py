@@ -1,0 +1,270 @@
+"""Expand (grouping sets) and Generate (explode) execs.
+
+Reference parity:
+- GpuExpandExec.scala:66-102 — apply every projection list to every input
+  batch, emitting one output batch per projection (grouping sets / rollup /
+  cube feed a grouping-id column through this).
+- GpuGenerateExec.scala:101 — explode/posexplode of a created array by
+  table replication: element expression j evaluated over the batch becomes
+  output rows i*k+j, interleaved exactly like Spark's row order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    ColumnVector,
+    HostColumnarBatch,
+    HostColumnVector,
+    bucket_capacity,
+    ensure_compact,
+    gather_batch,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import (
+    CpuExec,
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.ops.base import AttributeReference, Expression
+from spark_rapids_tpu.ops.bind import bind_all
+from spark_rapids_tpu.ops.eval import DeviceProjector, cpu_project
+from spark_rapids_tpu.utils import metrics as M
+
+
+# ---------------------------------------------------------------------------
+# Expand
+# ---------------------------------------------------------------------------
+class _ExpandBase(PhysicalExec):
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 output_attrs: List[AttributeReference], child: PhysicalExec):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        self.output_attrs = list(output_attrs)
+
+    @property
+    def output(self):
+        return self.output_attrs
+
+    def with_children(self, new_children):
+        return type(self)(self.projections, self.output_attrs,
+                          new_children[0])
+
+    def node_name(self):
+        return f"{type(self).__name__}[{len(self.projections)} projections]"
+
+
+class CpuExpandExec(_ExpandBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        bound = [bind_all(p, self.children[0].output)
+                 for p in self.projections]
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            for batch in child_pb.iterator(pidx):
+                for proj in bound:
+                    yield cpu_project(proj, batch, partition_id=pidx)
+
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics, factory(p)))
+
+
+class TpuExpandExec(_ExpandBase, TpuExec):
+    """One DeviceProjector per projection list; each input batch produces
+    len(projections) output batches (reference: GpuExpandIterator cycling
+    projectionIndex, GpuExpandExec.scala:66-102)."""
+
+    placement = "tpu"
+
+    def __init__(self, projections, output_attrs, child):
+        super().__init__(projections, output_attrs, child)
+        self._projectors = [
+            DeviceProjector(bind_all(p, child.output))
+            for p in self.projections
+        ]
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        total_time = self.metrics[M.TOTAL_TIME]
+
+        def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            for batch in child_pb.iterator(pidx):
+                batch = ensure_compact(batch)
+                for projector in self._projectors:
+                    with M.trace_range("TpuExpand", total_time):
+                        yield projector.project(batch, partition_id=pidx)
+
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics, factory(p)))
+
+
+# ---------------------------------------------------------------------------
+# Generate (explode / posexplode of a created array)
+# ---------------------------------------------------------------------------
+class _GenerateBase(PhysicalExec):
+    def __init__(self, include_pos: bool, elem_exprs: Sequence[Expression],
+                 generator_output: List[AttributeReference],
+                 child: PhysicalExec):
+        super().__init__(child)
+        self.include_pos = include_pos
+        self.elem_exprs = list(elem_exprs)
+        self.generator_output = list(generator_output)
+
+    @property
+    def output(self):
+        return self.children[0].output + self.generator_output
+
+    def with_children(self, new_children):
+        return type(self)(self.include_pos, self.elem_exprs,
+                          self.generator_output, new_children[0])
+
+    def node_name(self):
+        kind = "posexplode" if self.include_pos else "explode"
+        return f"{type(self).__name__}[{kind} x{len(self.elem_exprs)}]"
+
+
+class CpuGenerateExec(_GenerateBase, CpuExec):
+    placement = "cpu"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        bound = bind_all(self.elem_exprs, self.children[0].output)
+        k = len(self.elem_exprs)
+        elem_attr = self.generator_output[-1]
+
+        def factory(pidx: int) -> Iterator[HostColumnarBatch]:
+            for batch in child_pb.iterator(pidx):
+                n = batch.num_rows
+                ev = cpu_project(bound, batch, partition_id=pidx)
+                cols: List[HostColumnVector] = []
+                # child columns: each input row repeated k times
+                for c in batch.columns:
+                    cols.append(HostColumnVector(
+                        c.dtype, np.repeat(c.data[:n], k),
+                        np.repeat(c.validity[:n], k)))
+                if self.include_pos:
+                    cols.append(HostColumnVector(
+                        DataType.INT32,
+                        np.tile(np.arange(k, dtype=np.int32), n),
+                        np.ones(n * k, dtype=bool)))
+                # element column: row i*k+j = expr_j(row i)
+                edt = elem_attr.data_type
+                if edt is DataType.STRING:
+                    data = np.empty(n * k, dtype=object)
+                else:
+                    data = np.zeros(n * k, dtype=edt.to_np())
+                validity = np.zeros(n * k, dtype=bool)
+                for j, c in enumerate(ev.columns):
+                    d = c.data[:n]
+                    if edt is not DataType.STRING and c.dtype is not edt:
+                        d = d.astype(edt.to_np())
+                    data[j::k] = d
+                    validity[j::k] = c.validity[:n]
+                if edt is DataType.STRING:
+                    data = np.where(validity, data, "")
+                cols.append(HostColumnVector(edt, data, validity))
+                yield HostColumnarBatch(cols, n * k)
+
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics, factory(p)))
+
+
+class TpuGenerateExec(_GenerateBase, TpuExec):
+    """Device explode: one fused gather replicates the child columns k times
+    and an interleaving reshape places element j of row i at output i*k+j
+    (reference: the per-element projection + replication of
+    GpuGenerateExec.scala:101; here it is a single XLA program)."""
+
+    placement = "tpu"
+
+    def __init__(self, include_pos, elem_exprs, generator_output, child):
+        super().__init__(include_pos, elem_exprs, generator_output, child)
+        self._projector = DeviceProjector(
+            bind_all(self.elem_exprs, child.output))
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        child_pb = self.children[0].execute(ctx)
+        k = len(self.elem_exprs)
+        elem_attr = self.generator_output[-1]
+        total_time = self.metrics[M.TOTAL_TIME]
+
+        def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            for batch in child_pb.iterator(pidx):
+                batch = ensure_compact(batch)
+                n = batch.host_rows()
+                cap = batch.capacity
+                out_rows = n * k
+                out_cap = bucket_capacity(max(out_rows, 1))
+                with M.trace_range("TpuGenerate", total_time):
+                    # child columns via one fused gather (handles strings)
+                    idx = _replicate_indices(out_cap, k, cap)
+                    child_out = gather_batch(batch, idx, out_rows)
+                    # element columns evaluated once over the input batch
+                    ev = self._projector.project(batch, partition_id=pidx)
+                    edt = elem_attr.data_type
+                    phys = None
+                    for c in ev.columns:
+                        if c.dtype is edt:
+                            phys = c.data.dtype
+                    datas = []
+                    valids = []
+                    for c in ev.columns:
+                        d = c.data
+                        if phys is not None and d.dtype != phys:
+                            d = d.astype(phys)
+                        datas.append(d)
+                        valids.append(c.validity)
+                    elem_d, elem_v, pos = _interleave_elems(
+                        out_cap, k, tuple(datas), tuple(valids),
+                        jnp.int32(out_rows))
+                cols = list(child_out.columns)
+                if self.include_pos:
+                    cols.append(ColumnVector(
+                        DataType.INT32, pos,
+                        jnp.arange(out_cap) < out_rows))
+                cols.append(ColumnVector(edt, elem_d, elem_v))
+                yield ColumnarBatch(cols, out_rows)
+
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics, factory(p)))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _replicate_indices(out_cap: int, k: int, src_cap: int):
+    """Output row r reads source row r//k."""
+    return jnp.minimum(jnp.arange(out_cap, dtype=jnp.int32) // k,
+                       src_cap - 1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _interleave_elems(out_cap: int, k: int, datas, valids, out_rows):
+    """Place element j of input row i at output position i*k+j."""
+    pos_j = jnp.arange(out_cap, dtype=jnp.int32) % k
+    src = jnp.arange(out_cap, dtype=jnp.int32) // k
+    src = jnp.minimum(src, datas[0].shape[0] - 1)
+    stacked_d = jnp.stack([d[src] for d in datas], axis=1)  # [out_cap, k]
+    stacked_v = jnp.stack([v[src] for v in valids], axis=1)
+    rows = jnp.arange(out_cap)
+    live = rows < out_rows
+    data = stacked_d[rows, pos_j]
+    valid = stacked_v[rows, pos_j] & live
+    data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+    return data, valid, pos_j

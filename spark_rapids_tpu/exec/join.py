@@ -35,6 +35,7 @@ from spark_rapids_tpu import _jax_setup  # noqa: F401
 import jax
 import jax.numpy as jnp
 
+from spark_rapids_tpu import conf as C
 from spark_rapids_tpu.columnar.batch import (
     ColumnarBatch,
     ColumnVector,
@@ -436,6 +437,15 @@ class TpuBroadcastHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
                 concat_batches(batches)
         else:
             build = _null_batch(self.children[build_child].output, 0)
+        if ctx.conf.get(C.SHUFFLE_SERIALIZE):
+            # materialize the broadcast relation through the serialized
+            # batch format — the host-serialized broadcast of
+            # GpuBroadcastExchangeExec.scala:47-200 (TorrentBroadcast
+            # payload); proves the build side survives a bytes round trip
+            # and registers it with the host spill store
+            from spark_rapids_tpu.shuffle.exchange import _encode_piece
+
+            build = _encode_piece(build).decode(to_device=True)
         emit_tail = self.join_type is JoinType.FULL_OUTER
 
         def factory(pidx: int):

@@ -1,0 +1,1 @@
+"""External integration APIs (reference: ColumnarRdd.scala, ml-integration)."""

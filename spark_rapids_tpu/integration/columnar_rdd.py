@@ -1,0 +1,68 @@
+"""Device-resident columnar export for ML handoff.
+
+Reference parity: ColumnarRdd.scala:41-60 — `DataFrame -> RDD[cudf.Table]`
+zero-copy handoff (XGBoost etc.), gated by
+`spark.rapids.sql.exportColumnarRdd`; InternalColumnarRddConverter.scala
+detects the `GpuColumnarToRowExec` boundary and extracts the device batches
+beneath it, re-uploading when the plan ends on the host.
+
+Here the export returns `ColumnarPartitions`: the partition structure plus
+per-partition iterators of DEVICE `ColumnarBatch`es (struct-of-jax-arrays —
+directly consumable by downstream JAX ML code with zero extra copies).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, ensure_compact
+
+
+class ColumnarPartitions:
+    """The RDD[Table] analog: lazily iterate device batches per partition."""
+
+    def __init__(self, pb, schema):
+        self._pb = pb
+        self.schema = list(schema)
+
+    @property
+    def num_partitions(self) -> int:
+        return self._pb.num_partitions
+
+    def iterator(self, pidx: int) -> Iterator[ColumnarBatch]:
+        for batch in self._pb.iterator(pidx):
+            yield ensure_compact(batch)
+
+    def collect_batches(self) -> List[ColumnarBatch]:
+        out: List[ColumnarBatch] = []
+        for p in range(self.num_partitions):
+            out.extend(self.iterator(p))
+        return out
+
+
+def columnar_rdd(df) -> ColumnarPartitions:
+    """Export a DataFrame's device batches (reference: ColumnarRdd.apply,
+    ColumnarRdd.scala:42)."""
+    session = df.session
+    if not session.conf.get(C.EXPORT_COLUMNAR_RDD):
+        raise RuntimeError(
+            "columnar export requires rapids.tpu.sql.exportColumnarRdd=true "
+            "(reference: spark.rapids.sql.exportColumnarRdd)")
+    physical = session._physical_plan(df._plan)
+    from spark_rapids_tpu.exec.transitions import (
+        DeviceToHostExec,
+        HostToDeviceExec,
+    )
+
+    if isinstance(physical, DeviceToHostExec):
+        # strip the host boundary: hand out the device batches beneath it
+        # (the GpuColumnarToRowExec detection of
+        # InternalColumnarRddConverter.scala)
+        physical = physical.children[0]
+    else:
+        # plan ends on the host (op fell back / sql disabled): upload, the
+        # reference's GpuRowToColumnarExec re-conversion path
+        physical = HostToDeviceExec(physical)
+    pb = physical.execute(session._exec_context())
+    return ColumnarPartitions(pb, df.schema)

@@ -271,14 +271,11 @@ class HostStore(BufferStore):
     def size_limit(self) -> Optional[int]:
         return self.limit_bytes
 
-    def track(self, buf: SpillableBuffer) -> None:
-        # NOTE: deliberately no spill here — track runs under buf.lock from
-        # spill_buffer; the caller pushes overflow down afterwards.
-        super().track(buf)
-
     def add_bytes_tracked(self, buf: SpillableBuffer) -> None:
-        """Register a new host-tier buffer and push overflow to disk (safe:
-        not called under any buffer lock)."""
+        """Register a new host-tier buffer and push overflow to disk. Safe
+        because it is never called under a buffer lock — plain track() (used
+        by spill_buffer under buf.lock) must NOT spill; spill_buffer absorbs
+        overflow itself after releasing the lock."""
         super().track(buf)
         if self.current_size > self.limit_bytes and self.spill_store:
             self.synchronous_spill(self.limit_bytes)

@@ -97,14 +97,55 @@ class DataFrame:
 
     # -- relational ops -------------------------------------------------------
     def select(self, *cols: ColumnOrName) -> "DataFrame":
+        from spark_rapids_tpu.ops.generators import Explode
+
         out: List[Expression] = []
+        gen: Optional[Expression] = None
+        gen_slot = -1
         for c in cols:
             if isinstance(c, str) and c == "*":
                 out.extend(self._plan.output)
                 continue
             e = self._resolve(c)
+            core = e.child if isinstance(e, Alias) else e
+            if isinstance(core, Explode):
+                if gen is not None:
+                    raise ValueError("only one explode()/posexplode() per "
+                                     "select (Spark restriction)")
+                gen = e
+                gen_slot = len(out)
+                out.append(e)  # placeholder, replaced below
+                continue
             out.append(_auto_alias(e, self._default_name(c, len(out))))
-        return self._with_plan(L.Project(out, self._plan))
+        if gen is None:
+            return self._with_plan(L.Project(out, self._plan))
+        return self._select_generate(out, gen, gen_slot)
+
+    def _select_generate(self, out: List[Expression], gen: Expression,
+                         gen_slot: int) -> "DataFrame":
+        """Lower select(..., explode(array(...)), ...) to Generate + Project
+        (reference: GpuGenerateExec replacing GenerateExec of
+        Explode(CreateArray), GpuGenerateExec.scala)."""
+        from spark_rapids_tpu.ops.cast import Cast
+        from spark_rapids_tpu.ops.generators import Explode
+
+        alias_name = gen.name if isinstance(gen, Alias) else None
+        core: Explode = gen.child if isinstance(gen, Alias) else gen
+        elem_t = core.array.element_type
+        elems = [e if e.data_type is elem_t else Cast(e, elem_t)
+                 for e in core.array.elems]
+        generator = core.with_children([core.array.with_children(elems)])
+        gen_attrs: List[AttributeReference] = []
+        if core.include_pos:
+            if alias_name is not None:
+                raise ValueError("posexplode produces two columns (pos, col)"
+                                 " and cannot be aliased to one name")
+            gen_attrs.append(AttributeReference("pos", DataType.INT32, False))
+        gen_attrs.append(AttributeReference(
+            alias_name or "col", elem_t, True))
+        plan = L.Generate(generator, gen_attrs, False, self._plan)
+        final = out[:gen_slot] + list(gen_attrs) + out[gen_slot + 1:]
+        return self._with_plan(L.Project(final, plan))
 
     @staticmethod
     def _default_name(c: ColumnOrName, idx: int) -> str:
@@ -209,6 +250,27 @@ class DataFrame:
         return GroupedData(self, named)
 
     groupby = groupBy
+
+    def rollup(self, *cols: ColumnOrName) -> "GroupedData":
+        """Hierarchical grouping sets (a,b) -> {(a,b), (a), ()} lowered
+        through Expand (reference: GpuExpandExec.scala:66-102)."""
+        g = self.groupBy(*cols)
+        m = len(g._grouping)
+        g._grouping_sets = [frozenset(range(k)) for k in range(m, -1, -1)]
+        return g
+
+    def cube(self, *cols: ColumnOrName) -> "GroupedData":
+        """All 2^m grouping-set combinations lowered through Expand."""
+        import itertools as _it
+
+        g = self.groupBy(*cols)
+        m = len(g._grouping)
+        g._grouping_sets = [
+            frozenset(s)
+            for k in range(m, -1, -1)
+            for s in _it.combinations(range(m), k)
+        ]
+        return g
 
     def agg(self, *cols: Column) -> "DataFrame":
         return GroupedData(self, []).agg(*cols)
@@ -323,14 +385,61 @@ class GroupedData:
     def __init__(self, df: DataFrame, grouping: List[Expression]):
         self._df = df
         self._grouping = grouping
+        # rollup/cube: list of frozensets of grouping-column ordinals
+        self._grouping_sets: Optional[List[frozenset]] = None
 
     def agg(self, *cols: Column) -> DataFrame:
+        if self._grouping_sets is not None:
+            return self._agg_grouping_sets(cols)
         out: List[Expression] = list(self._grouping)
         for i, c in enumerate(cols):
             e = resolve(_to_expr(c), self._df._plan.output)
             out.append(_auto_alias(e, f"agg{i}"))
         plan = L.Aggregate([to_attribute(g) if isinstance(g, Alias) else g
                             for g in self._grouping], out, self._df._plan)
+        return self._df._with_plan(plan)
+
+    def _agg_grouping_sets(self, cols) -> DataFrame:
+        """rollup/cube: Expand emits one copy of the input per grouping set
+        (null-filled dropped keys + a grouping id that keeps natural nulls
+        distinct from rolled-up nulls), then a regular aggregate groups on
+        the expanded keys + id (reference: GpuExpandExec feeding
+        GpuHashAggregateExec, GpuExpandExec.scala:66-102)."""
+        from spark_rapids_tpu.ops.literals import Literal
+
+        child = self._df._plan
+        m = len(self._grouping)
+        g_exprs = [g.child if isinstance(g, Alias) else g
+                   for g in self._grouping]
+        g_names = [to_attribute(g).name if isinstance(g, Alias) else g.name
+                   for g in self._grouping]
+        g_types = [g.data_type for g in g_exprs]
+        # fresh output attrs for the expanded keys (nullable: sets null them)
+        key_attrs = [AttributeReference(n, t, True)
+                     for n, t in zip(g_names, g_types)]
+        gid_attr = AttributeReference("spark_grouping_id", DataType.INT32,
+                                      False)
+        projections: List[List[Expression]] = []
+        for s in self._grouping_sets:
+            gid = 0
+            proj: List[Expression] = list(child.output)
+            for i in range(m):
+                if i in s:
+                    proj.append(g_exprs[i])
+                else:
+                    proj.append(Literal(None, g_types[i]))
+                    gid |= 1 << (m - 1 - i)
+            proj.append(Literal(gid, DataType.INT32))
+            projections.append(proj)
+        expand_out = list(child.output) + key_attrs + [gid_attr]
+        expand = L.Expand(projections, expand_out, child)
+        out: List[Expression] = [Alias(a, a.name) for a in key_attrs]
+        for i, c in enumerate(cols):
+            e = resolve(_to_expr(c), child.output)
+            out.append(_auto_alias(e, f"agg{i}"))
+        # gid is grouping-only (not in agg_exprs), so the Aggregate's output
+        # is already the user-visible schema
+        plan = L.Aggregate(key_attrs + [gid_attr], out, expand)
         return self._df._with_plan(plan)
 
     def _simple(self, fn, *cols: str) -> DataFrame:

@@ -243,6 +243,38 @@ def from_unixtime(c: ColumnOrName, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
 
 
 # -- nondeterministic --------------------------------------------------------
+def array(*cols: ColumnOrName) -> Column:
+    """array(e1, e2, ...) — consumable only by explode()/posexplode()
+    (reference: GpuGenerateExec supports Explode(CreateArray(...)) only,
+    GpuGenerateExec.scala tagPlanForGpu)."""
+    from spark_rapids_tpu.ops.generators import CreateArray
+
+    return Column(CreateArray([_c(c) for c in cols]))
+
+
+def explode(c: Column) -> Column:
+    """One output row per array element per input row (reference:
+    GpuGenerateExec.scala:101, includePos=false). Requires array(...)."""
+    from spark_rapids_tpu.ops.generators import CreateArray, Explode
+
+    e = _to_expr(c)
+    if not isinstance(e, CreateArray):
+        raise TypeError("explode() requires array(...) — arrays exist only "
+                        "as created arrays (flat column types)")
+    return Column(Explode(e))
+
+
+def posexplode(c: Column) -> Column:
+    """explode() plus the element position column (reference:
+    GpuGenerateExec.scala:101, includePos=true)."""
+    from spark_rapids_tpu.ops.generators import CreateArray, PosExplode
+
+    e = _to_expr(c)
+    if not isinstance(e, CreateArray):
+        raise TypeError("posexplode() requires array(...)")
+    return Column(PosExplode(e))
+
+
 def rand(seed: int = 0) -> Column:
     return Column(MISC.Rand(seed))
 

@@ -311,6 +311,30 @@ def _register_feature_exec_rules():
         J.CpuNestedLoopJoinExec, "cross/nested-loop join",
         _convert_join(J.TpuNestedLoopJoinExec))
 
+    from spark_rapids_tpu.exec.expand import (
+        CpuExpandExec,
+        CpuGenerateExec,
+        TpuExpandExec,
+        TpuGenerateExec,
+    )
+
+    register_exec(
+        CpuExpandExec, "grouping-sets expand (one projection list per set)",
+        lambda cpu, ch: TpuExpandExec(cpu.projections, cpu.output_attrs,
+                                      ch[0]))
+
+    def _tag_generate(m) -> None:
+        elem_t = m.plan.generator_output[-1].data_type
+        if elem_t is DataType.STRING:
+            m.will_not_work(
+                "device explode of string elements is not implemented")
+
+    register_exec(
+        CpuGenerateExec, "explode/posexplode of a created array",
+        lambda cpu, ch: TpuGenerateExec(
+            cpu.include_pos, cpu.elem_exprs, cpu.generator_output, ch[0]),
+        tag_fn=_tag_generate)
+
     from spark_rapids_tpu.exec.cache import (
         CpuCachedScanExec,
         TpuCachedScanExec,

@@ -218,6 +218,28 @@ def _plan_aggregate(plan: L.Aggregate, conf: C.TpuConf) -> PhysicalExec:
                                 exchange, specs)
 
 
+@register_planner(L.Expand)
+def _plan_expand(plan: L.Expand, conf: C.TpuConf) -> PhysicalExec:
+    """Grouping sets: one projection list per set (reference:
+    GpuExpandExec.scala:66-102)."""
+    from spark_rapids_tpu.exec.expand import CpuExpandExec
+
+    (child,) = _plan_children(plan, conf)
+    return CpuExpandExec(plan.projections, plan.output_attrs, child)
+
+
+@register_planner(L.Generate)
+def _plan_generate(plan: L.Generate, conf: C.TpuConf) -> PhysicalExec:
+    """explode/posexplode of a created array (reference:
+    GpuGenerateExec.scala:101)."""
+    from spark_rapids_tpu.exec.expand import CpuGenerateExec
+
+    (child,) = _plan_children(plan, conf)
+    gen = plan.generator
+    return CpuGenerateExec(gen.include_pos, list(gen.array.elems),
+                           plan.generator_output, child)
+
+
 @register_planner(L.Sort)
 def _plan_sort(plan: L.Sort, conf: C.TpuConf) -> PhysicalExec:
     """Global sort = range exchange + per-partition sort

@@ -72,8 +72,8 @@ def supports_ici(partitioning, child_attrs, n: int) -> bool:
     return mesh is not None and n == mesh.devices.size
 
 
-def _regroup(per_map: List[List[ColumnarBatch]], n: int,
-             dtypes: Sequence[DataType]) -> List[Optional[ColumnarBatch]]:
+def _regroup(per_map: List[List[ColumnarBatch]],
+             n: int) -> List[Optional[ColumnarBatch]]:
     """Assign map-partition outputs to the n shard slots (slot = pidx % n)
     and concat each slot to one compact batch."""
     slots: List[List[ColumnarBatch]] = [[] for _ in range(n)]
@@ -139,7 +139,7 @@ def ici_hash_exchange(per_map: List[List[ColumnarBatch]], bound_exprs,
     partition t)."""
     mesh = session_mesh()
     dtypes = [a.data_type for a in child_attrs]
-    slots = _regroup(per_map, n, dtypes)
+    slots = _regroup(per_map, n)
 
     rows = [s.host_rows() if s is not None else 0 for s in slots]
     cap = bucket_capacity(max(max(rows), 1))

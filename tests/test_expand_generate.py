@@ -1,0 +1,143 @@
+"""Expand (rollup/cube grouping sets) and Generate (explode/posexplode)
+equivalence tests (reference: GpuExpandExec.scala:66-102,
+GpuGenerateExec.scala:101; hash_aggregate_test.py rollup/cube cases)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+    gen_df,
+    run_on_cpu,
+)
+
+
+class TestRollupCube:
+    def test_rollup_sum(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("a", IntGen(DataType.INT64, lo=0, hi=4)),
+                                 ("b", IntGen(DataType.INT64, lo=0, hi=3)),
+                                 ("v", IntGen(DataType.INT64,
+                                              lo=-100, hi=100))],
+                             n=300, num_partitions=3)
+            .rollup("a", "b").agg(F.sum("v").alias("s"),
+                                  F.count("*").alias("c")),
+            ignore_order=True)
+
+    def test_cube_sum(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("a", IntGen(DataType.INT32, lo=0, hi=3)),
+                                 ("b", IntGen(DataType.INT32, lo=0, hi=3)),
+                                 ("v", IntGen(DataType.INT64))],
+                             n=200, num_partitions=2)
+            .cube("a", "b").agg(F.sum("v").alias("s")),
+            ignore_order=True)
+
+    def test_rollup_natural_nulls_distinct_from_subtotals(self, session):
+        # natural null keys must not merge with rollup subtotal rows
+        def q(s):
+            return s.createDataFrame(
+                {"a": [1, 1, None, None, 2],
+                 "v": [10, 20, 30, 40, 50]},
+                [("a", DataType.INT64), ("v", DataType.INT64)]) \
+                .rollup("a").agg(F.sum("v").alias("s"))
+
+        rows = sorted(run_on_cpu(session, q),
+                      key=lambda r: (r[0] is None, r[0], r[1]))
+        # groups: a=1 -> 30, a=2 -> 50, a=None(natural) -> 70, total -> 150
+        assert (1, 30) in rows and (2, 50) in rows
+        null_sums = sorted(r[1] for r in rows if r[0] is None)
+        assert null_sums == [70, 150]
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+    def test_rollup_count_rows(self, session):
+        # rollup(a,b) emits groups(a,b) + groups(a) + 1 total row
+        def q(s):
+            return gen_df(s, [("a", IntGen(DataType.INT64, lo=0, hi=2,
+                                           nullable=False)),
+                              ("b", IntGen(DataType.INT64, lo=0, hi=2,
+                                           nullable=False)),
+                              ("v", IntGen(DataType.INT64))], n=100) \
+                .rollup("a", "b").agg(F.count("*").alias("c"))
+
+        cpu = run_on_cpu(session, q)
+        ab = {(r[0], r[1]) for r in cpu if r[1] is not None}
+        a_only = {r[0] for r in cpu if r[1] is None and r[0] is not None}
+        total = [r for r in cpu if r[0] is None and r[1] is None]
+        assert len(total) == 1
+        assert len(cpu) == len(ab) + len(a_only) + 1
+
+
+class TestExplode:
+    def test_explode_columns(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("a", IntGen(DataType.INT64)),
+                                 ("b", IntGen(DataType.INT64)),
+                                 ("c", IntGen(DataType.INT64))], n=150)
+            .select("a", F.explode(F.array(F.col("b"), F.col("c"),
+                                           F.lit(7)))),
+            ignore_order=True)
+
+    def test_posexplode(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("a", IntGen(DataType.INT64)),
+                                 ("b", IntGen(DataType.INT64))], n=100)
+            .select("a", F.posexplode(F.array(F.col("a"), F.col("b")))),
+            ignore_order=True)
+
+    def test_explode_alias_and_downstream_ops(self, session):
+        def q(s):
+            df = gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=5)),
+                            ("x", IntGen(DataType.INT64, lo=0, hi=50)),
+                            ("y", IntGen(DataType.INT64, lo=0, hi=50))],
+                        n=120)
+            ex = df.select("k", F.explode(F.array(F.col("x"),
+                                                  F.col("y"))).alias("e"))
+            return ex.filter(ex["e"] > 10).groupBy("k") \
+                .agg(F.sum("e").alias("s"))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+    def test_explode_mixed_widths_promote(self, session):
+        # int32 + int64 elements promote to int64
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("a", IntGen(DataType.INT32)),
+                                 ("b", IntGen(DataType.INT64))], n=80)
+            .select(F.explode(F.array(F.col("a"), F.col("b")))),
+            ignore_order=True)
+
+    def test_explode_row_order_interleaved(self, session):
+        # Spark emits elements of row i before elements of row i+1
+        def q(s):
+            return s.createDataFrame(
+                {"a": [1, 2], "b": [10, 20]},
+                [("a", DataType.INT64), ("b", DataType.INT64)]) \
+                .select(F.posexplode(F.array(F.col("a"), F.col("b"))))
+
+        assert run_on_cpu(session, q) == [
+            (0, 1), (1, 10), (0, 2), (1, 20)]
+        assert_tpu_and_cpu_are_equal_collect(session, q)
+
+    def test_string_explode_falls_back(self, session):
+        assert_tpu_fallback_collect(
+            session,
+            lambda s: gen_df(s, [("t", StringGen(max_len=5)),
+                                 ("u", StringGen(max_len=5))], n=60)
+            .select(F.explode(F.array(F.col("t"), F.col("u")))),
+            fallback_exec="CpuGenerateExec",
+            ignore_order=True)
+
+    def test_explode_requires_array(self, session):
+        with pytest.raises(TypeError):
+            F.explode(F.col("x"))

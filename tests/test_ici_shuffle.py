@@ -122,6 +122,20 @@ class TestSerializedShuffle:
             .repartition(4, "k"),
             SER)
 
+    def test_broadcast_join_serialized(self, session):
+        # the build side materializes through the serialized batch format
+        # (reference: GpuBroadcastExchangeExec host-serialized broadcast)
+        def q(s):
+            left = gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=15)),
+                              ("a", IntGen(DataType.INT64))],
+                          n=300, num_partitions=3, seed=3)
+            right = gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=15)),
+                               ("b", IntGen(DataType.INT64))],
+                           n=40, num_partitions=1, seed=4)
+            return left.join(right, on="k", how="left")
+
+        _check(session, q, SER)
+
     def test_sort_serialized(self, session):
         _check(
             session,

@@ -70,8 +70,7 @@ def _log(msg):
 _T0 = time.perf_counter()
 
 
-def _worker(mode: str) -> None:
-    """mode: 'tpu' (accelerated engine) or 'cpu' (oracle engine)."""
+def _init_backend(mode: str):
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(os.path.dirname(
                               os.path.abspath(__file__)), ".jax_cache"))
@@ -83,7 +82,12 @@ def _worker(mode: str) -> None:
     _log(f"worker[{mode}]: initializing backend")
     dev = jax.devices()[0]
     _log(f"worker[{mode}]: backend up: {dev.platform}")
+    return dev
 
+
+def _worker(mode: str) -> None:
+    """mode: 'tpu' (accelerated engine) or 'cpu' (oracle engine)."""
+    dev = _init_backend(mode)
     import spark_rapids_tpu as srt
 
     session = srt.new_session()
@@ -103,6 +107,36 @@ def _worker(mode: str) -> None:
         _log(f"worker[{mode}]: iter {i}: {times[-1]:.3f}s")
     print(json.dumps({"mode": mode, "platform": dev.platform,
                       "best_s": min(times)}), flush=True)
+
+
+def _worker_tpch(mode: str, sf: float) -> None:
+    """TPC-H-like suite (reference: tpch/Benchmarks.scala:28-90 — loop
+    queries, print wall-clock). Geomean over q1/q3/q5/q6 best-of-2."""
+    import math
+
+    dev = _init_backend(mode)
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.benchmarks import tpch
+
+    session = srt.new_session()
+    session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    session.conf.set("rapids.tpu.sql.enabled", mode == "tpu")
+    tables = {k: v.cache() for k, v in
+              tpch.gen_tables(session, sf=sf, num_partitions=4).items()}
+    _log(f"worker[{mode}]: tpch sf={sf} tables built")
+    bests = {}
+    for qname, qfn in sorted(tpch.QUERIES.items()):
+        qfn(tables).collect()  # warmup/compile
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            qfn(tables).collect()
+            times.append(time.perf_counter() - t0)
+        bests[qname] = min(times)
+        _log(f"worker[{mode}]: {qname}: {bests[qname]:.3f}s")
+    geo = math.exp(sum(math.log(t) for t in bests.values()) / len(bests))
+    print(json.dumps({"mode": mode, "platform": dev.platform,
+                      "geomean_s": geo, "queries": bests}), flush=True)
 
 
 # ------------------------------------------------------------- supervisor
@@ -165,8 +199,47 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def main_tpch(sf: float) -> None:
+    """TPC-H-like suite mode: `python bench.py --tpch [sf]` (BASELINE
+    configs 2+3). Prints geomean wall-clock + speedup vs the CPU oracle."""
+    env_extra = {"SRT_TPCH_SF": str(sf)}
+    cpu_env = _scrubbed_cpu_env()
+    cpu_env.update(env_extra)
+    tpu_env = dict(os.environ)
+    tpu_env.update(env_extra)
+    cpu = _run_phase("tpch-cpu", cpu_env, CPU_BUDGET_S * 2)
+    acc = _run_phase("tpch-tpu", tpu_env, TPU_BUDGET_S)
+    platform = acc["platform"] if acc else None
+    if acc is None:
+        # same honest fallback as main(): accelerated engine on CPU backend
+        acc = _run_phase("tpch-tpu", cpu_env, CPU_BUDGET_S * 2)
+        platform = "cpu-fallback" if acc else None
+    if acc is None:
+        print(json.dumps({"metric": "tpch_like_geomean_s", "value": 0.0,
+                          "unit": "s", "vs_baseline": 0.0,
+                          "error": "tpch bench failed", "sf": sf}))
+        return
+    print(json.dumps({
+        "metric": "tpch_like_geomean_s",
+        "value": round(acc["geomean_s"], 4),
+        "unit": "s",
+        "vs_baseline": (round(cpu["geomean_s"] / acc["geomean_s"], 3)
+                        if cpu else 0.0),
+        "platform": platform,
+        "sf": sf,
+        "queries": {k: round(v, 4) for k, v in acc["queries"].items()},
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
-        _worker(sys.argv[2])
+        mode = sys.argv[2]
+        if mode.startswith("tpch-"):
+            _worker_tpch(mode.split("-", 1)[1],
+                         float(os.environ.get("SRT_TPCH_SF", "0.01")))
+        else:
+            _worker(mode)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--tpch":
+        main_tpch(float(sys.argv[2]) if len(sys.argv) >= 3 else 0.01)
     else:
         main()

@@ -1,0 +1,1 @@
+"""Benchmark suites (reference: integration_tests tpch/tpcxbb/mortgage)."""

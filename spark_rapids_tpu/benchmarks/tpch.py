@@ -1,0 +1,200 @@
+"""TPC-H-like schema, data generator, and queries.
+
+Reference parity: integration_tests/src/main/scala/.../tpch/TpchLikeSpark.scala:1
+(schema + 22 queries as DataFrame programs) and tpch/Benchmarks.scala:28-90
+(loop queries N times, print wall-clock). This module carries the BASELINE.md
+staged configs:
+  - q1, q6  -> config 2 (hash aggregate + sort over a scan)
+  - q3, q5  -> config 3 (broadcast + shuffled hash joins)
+Prices are float64 (the v0.1 reference's flat-type gate excludes decimals,
+GpuOverrides.scala:383-395; its TPC-H-like tables use doubles the same way).
+
+Data is generated in-memory with numpy at a given scale factor: SF 1 ~=
+6M lineitem rows. Distributions are uniform-ish stand-ins — the point is
+operator shape and volume, not statistical fidelity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.literals import Literal
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.plan.column import Column
+
+_EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def _days(s: str) -> int:
+    return int((np.datetime64(s, "D") - _EPOCH).astype(int))
+
+
+def date_lit(s: str) -> Column:
+    """A DATE literal from 'YYYY-MM-DD'."""
+    return Column(Literal(_days(s), DataType.DATE))
+
+
+# ---------------------------------------------------------------------------
+# generators
+# ---------------------------------------------------------------------------
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+_FLAGS = ["A", "N", "R"]
+_STATUS = ["F", "O"]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+
+def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
+               seed: int = 0) -> Dict[str, "object"]:
+    """Generate the lineitem/orders/customer/supplier/nation/region tables
+    at scale factor `sf` (reference row counts: TPC-H spec scaled)."""
+    rng = np.random.default_rng(seed)
+    n_li = max(64, int(6_000_000 * sf))
+    n_ord = max(32, int(1_500_000 * sf))
+    n_cust = max(16, int(150_000 * sf))
+    n_supp = max(8, int(10_000 * sf))
+    n_nation = 25
+
+    ship_lo, ship_hi = _days("1992-01-01"), _days("1998-12-01")
+    lineitem = session.createDataFrame({
+        "l_orderkey": rng.integers(0, n_ord, n_li).astype(np.int64),
+        "l_suppkey": rng.integers(0, n_supp, n_li).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": (rng.random(n_li) * 100_000).round(2),
+        "l_discount": (rng.integers(0, 11, n_li) / 100.0),
+        "l_tax": (rng.integers(0, 9, n_li) / 100.0),
+        "l_returnflag": np.array(
+            [_FLAGS[i] for i in rng.integers(0, len(_FLAGS), n_li)],
+            dtype=object),
+        "l_linestatus": np.array(
+            [_STATUS[i] for i in rng.integers(0, len(_STATUS), n_li)],
+            dtype=object),
+        "l_shipdate": rng.integers(ship_lo, ship_hi, n_li).astype(np.int32),
+    }, [("l_orderkey", "long"), ("l_suppkey", "long"),
+        ("l_quantity", "double"), ("l_extendedprice", "double"),
+        ("l_discount", "double"), ("l_tax", "double"),
+        ("l_returnflag", "string"), ("l_linestatus", "string"),
+        ("l_shipdate", DataType.DATE)],
+        num_partitions=num_partitions)
+
+    ord_lo, ord_hi = _days("1992-01-01"), _days("1998-08-02")
+    orders = session.createDataFrame({
+        "o_orderkey": np.arange(n_ord, dtype=np.int64),
+        "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
+        "o_orderdate": rng.integers(ord_lo, ord_hi, n_ord).astype(np.int32),
+        "o_shippriority": np.zeros(n_ord, dtype=np.int32),
+    }, [("o_orderkey", "long"), ("o_custkey", "long"),
+        ("o_orderdate", DataType.DATE), ("o_shippriority", "int")],
+        num_partitions=num_partitions)
+
+    customer = session.createDataFrame({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_mktsegment": np.array(
+            [_SEGMENTS[i] for i in rng.integers(0, len(_SEGMENTS), n_cust)],
+            dtype=object),
+        "c_nationkey": rng.integers(0, n_nation, n_cust).astype(np.int64),
+    }, [("c_custkey", "long"), ("c_mktsegment", "string"),
+        ("c_nationkey", "long")], num_partitions=num_partitions)
+
+    supplier = session.createDataFrame({
+        "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_nationkey": rng.integers(0, n_nation, n_supp).astype(np.int64),
+    }, [("s_suppkey", "long"), ("s_nationkey", "long")],
+        num_partitions=max(1, num_partitions // 2))
+
+    nation = session.createDataFrame({
+        "n_nationkey": np.arange(n_nation, dtype=np.int64),
+        "n_regionkey": (np.arange(n_nation) % len(_REGIONS)).astype(np.int64),
+        "n_name": np.array([f"NATION_{i}" for i in range(n_nation)],
+                           dtype=object),
+    }, [("n_nationkey", "long"), ("n_regionkey", "long"),
+        ("n_name", "string")], num_partitions=1)
+
+    region = session.createDataFrame({
+        "r_regionkey": np.arange(len(_REGIONS), dtype=np.int64),
+        "r_name": np.array(_REGIONS, dtype=object),
+    }, [("r_regionkey", "long"), ("r_name", "string")], num_partitions=1)
+
+    return {"lineitem": lineitem, "orders": orders, "customer": customer,
+            "supplier": supplier, "nation": nation, "region": region}
+
+
+# ---------------------------------------------------------------------------
+# queries (reference: Q1Like/Q3Like/Q5Like/Q6Like, TpchLikeSpark.scala)
+# ---------------------------------------------------------------------------
+def q1(t) -> "object":
+    """Pricing summary report (agg + sort; BASELINE config 2)."""
+    li = t["lineitem"]
+    return (li.filter(li["l_shipdate"] <= date_lit("1998-09-02"))
+            .withColumn("disc_price",
+                        F.col("l_extendedprice") * (F.lit(1.0) - F.col("l_discount")))
+            .withColumn("charge",
+                        F.col("l_extendedprice") * (F.lit(1.0) - F.col("l_discount"))
+                        * (F.lit(1.0) + F.col("l_tax")))
+            .groupBy("l_returnflag", "l_linestatus")
+            .agg(F.sum("l_quantity").alias("sum_qty"),
+                 F.sum("l_extendedprice").alias("sum_base_price"),
+                 F.sum("disc_price").alias("sum_disc_price"),
+                 F.sum("charge").alias("sum_charge"),
+                 F.avg("l_quantity").alias("avg_qty"),
+                 F.avg("l_extendedprice").alias("avg_price"),
+                 F.avg("l_discount").alias("avg_disc"),
+                 F.count("*").alias("count_order"))
+            .orderBy("l_returnflag", "l_linestatus"))
+
+
+def q6(t) -> "object":
+    """Forecasting revenue change (tight filter + reduction)."""
+    li = t["lineitem"]
+    return (li.filter((li["l_shipdate"] >= date_lit("1994-01-01"))
+                      & (li["l_shipdate"] < date_lit("1995-01-01"))
+                      & (li["l_discount"] >= F.lit(0.05))
+                      & (li["l_discount"] <= F.lit(0.07))
+                      & (li["l_quantity"] < F.lit(24.0)))
+            .withColumn("revenue",
+                        F.col("l_extendedprice") * F.col("l_discount"))
+            .agg(F.sum("revenue").alias("revenue")))
+
+
+def q3(t) -> "object":
+    """Shipping priority (3-way join + agg + sort + limit;
+    BASELINE config 3)."""
+    c = t["customer"]
+    o = t["orders"]
+    li = t["lineitem"]
+    return (c.filter(c["c_mktsegment"] == F.lit("BUILDING"))
+            .join(o, on=(c["c_custkey"] == o["o_custkey"]), how="inner")
+            .filter(F.col("o_orderdate") < date_lit("1995-03-15"))
+            .join(li.filter(li["l_shipdate"] > date_lit("1995-03-15")),
+                  on=(F.col("o_orderkey") == li["l_orderkey"]), how="inner")
+            .withColumn("volume",
+                        F.col("l_extendedprice") * (F.lit(1.0) - F.col("l_discount")))
+            .groupBy("o_orderkey", "o_orderdate", "o_shippriority")
+            .agg(F.sum("volume").alias("revenue"))
+            .orderBy(F.col("revenue").desc(), F.col("o_orderdate"))
+            .limit(10))
+
+
+def q5(t) -> "object":
+    """Local supplier volume (6-way join + agg + sort)."""
+    c, o, li = t["customer"], t["orders"], t["lineitem"]
+    s, n, r = t["supplier"], t["nation"], t["region"]
+    return (r.filter(r["r_name"] == F.lit("ASIA"))
+            .join(n, on=(r["r_regionkey"] == n["n_regionkey"]), how="inner")
+            .join(s, on=(n["n_nationkey"] == s["s_nationkey"]), how="inner")
+            .join(li, on=(s["s_suppkey"] == li["l_suppkey"]), how="inner")
+            .join(o.filter((o["o_orderdate"] >= date_lit("1994-01-01"))
+                           & (o["o_orderdate"] < date_lit("1995-01-01"))),
+                  on=(F.col("l_orderkey") == o["o_orderkey"]), how="inner")
+            .join(c, on=(F.col("o_custkey") == c["c_custkey"]), how="inner")
+            .filter(F.col("c_nationkey") == F.col("n_nationkey"))
+            .withColumn("volume",
+                        F.col("l_extendedprice") * (F.lit(1.0) - F.col("l_discount")))
+            .groupBy("n_name")
+            .agg(F.sum("volume").alias("revenue"))
+            .orderBy(F.col("revenue").desc()))
+
+
+QUERIES: Dict[str, Callable] = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}

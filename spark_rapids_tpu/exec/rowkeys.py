@@ -19,10 +19,13 @@ which equality (and, for orderable types, order) agrees with SQL semantics:
   null flag carried separately);
 - floats: total-order uint32 bit trick (-0.0 == 0.0, all NaNs equal, NaN
   sorts greater than all numbers, matching Spark's NaN ordering);
-- strings: double 32-bit polynomial hash + byte length — EQUALITY-ONLY
-  proxies (grouping/joining on strings is exact up to a ~2^-60 collision
-  probability; lexicographic device string sort is not provided yet, so sorts
-  on string keys fall back to the CPU engine via tagging).
+- strings, for grouping/joining: double 32-bit polynomial hash + byte
+  length — EQUALITY-ONLY proxies (exact up to a ~2^-60 collision
+  probability);
+- strings, for ORDERING: `string_order_proxy` — chunked big-endian uint64
+  byte keys + length tie-break, exact whenever the static chunk count
+  covers the batch's longest string (callers size it via
+  `string_chunks_needed`).
 
 All functions here take padded device arrays + a traced `num_rows` and are
 jit-safe. Padded rows always sort to the end and get group id = capacity
@@ -90,6 +93,40 @@ def key_proxy(col: ColV) -> KeyProxy:
     # integral / date / timestamp
     data = jnp.where(col.validity, col.data, jnp.zeros((), col.data.dtype))
     return KeyProxy((data,), ~col.validity, True)
+
+
+def string_order_proxy(col: ColV, n_chunks: int) -> KeyProxy:
+    """ORDERABLE string proxy: n_chunks big-endian uint64 byte chunks plus a
+    length tie-break (shorter sorts first when one string is a prefix of the
+    other, matching UTF-8 byte order == code point order). EXACT whenever
+    8*n_chunks >= the longest string in the batch — callers compute that
+    bound outside jit and pass it as a static arg (the cudf device string
+    comparator this replaces: reference GpuSortExec via Table.orderBy,
+    GpuSortExec.scala:100-235)."""
+    from spark_rapids_tpu.columnar import strings as STR
+
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - col.offsets[:-1]
+    arrays = []
+    for c in range(n_chunks):
+        off = 8 * c
+        chunk = STR._chunk_u64(col.data, starts + off,
+                               jnp.maximum(lens - off, 0))
+        arrays.append(jnp.where(col.validity, chunk, jnp.uint64(0)))
+    arrays.append(jnp.where(col.validity, lens, 0))
+    return KeyProxy(tuple(arrays), ~col.validity, True)
+
+
+def string_chunks_needed(col_or_lens) -> int:
+    """Bucketed chunk count for a batch's longest string (host sync; the
+    static-shape discipline of SURVEY.md section 7 hard part #3)."""
+    if hasattr(col_or_lens, "offsets"):
+        lens = col_or_lens.offsets[1:] - col_or_lens.offsets[:-1]
+    else:
+        lens = col_or_lens
+    max_len = int(jax.device_get(jnp.max(jnp.maximum(lens, 0))))
+    chunks = max(1, -(-max_len // 8))
+    return 1 << (chunks - 1).bit_length()  # pow2 bucket bounds recompiles
 
 
 def _invert_order(arr):

@@ -9,9 +9,10 @@ Reference parity:
   plan/planner.py; this exec always requires a single input batch per
   partition so the partition is totally ordered.
 
-Device string ordering is not implemented yet (strings have equality-only
-key proxies); sorts on string keys are tagged off the TPU and run on the
-CPU oracle exec instead.
+Plain string columns sort ON DEVICE via chunked big-endian uint64 order keys
+(rowkeys.string_order_proxy; chunk count is a static per-batch bound).
+Computed string sort keys (whose result length is unknown outside jit) are
+tagged off the TPU and run on the CPU oracle exec.
 """
 
 from __future__ import annotations
@@ -68,15 +69,19 @@ class _SortBase(PhysicalExec):
 
 
 class TpuSortExec(_SortBase, TpuExec):
+    """Device sort incl. string keys: strings use chunked big-endian uint64
+    order keys whose chunk count is a static per-batch bound (the cudf
+    string comparator analog; see rowkeys.string_order_proxy)."""
+
     placement = "tpu"
 
-    def _build_kernel(self, input_attrs):
+    def _build_kernel(self, input_attrs, n_chunks: int):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
         bound = bind_sort_orders(self.orders, input_attrs)
         directions = [(o.ascending, o.nulls_first) for o in bound]
-        key = ("sort", tuple(o.fingerprint() for o in bound))
+        key = ("sort", tuple(o.fingerprint() for o in bound), n_chunks)
 
         def build():
             def kernel(cols, num_rows):
@@ -87,7 +92,10 @@ class TpuSortExec(_SortBase, TpuExec):
                     r = o.child.eval(ctx)
                     if isinstance(r, ScalarV):
                         r = _scalar_to_colv(ctx, r, o.child.data_type)
-                    proxies.append(RK.key_proxy(r))
+                    if r.dtype.is_string:
+                        proxies.append(RK.string_order_proxy(r, n_chunks))
+                    else:
+                        proxies.append(RK.key_proxy(r))
                 return RK.sort_permutation(proxies, directions, num_rows,
                                            capacity)
 
@@ -95,20 +103,29 @@ class TpuSortExec(_SortBase, TpuExec):
 
         return get_or_build(key, build)
 
+    def _string_ordinals(self, input_attrs) -> List[int]:
+        bound = bind_sort_orders(self.orders, input_attrs)
+        return [o.child.ordinal for o in bound
+                if o.child.data_type.is_string]
+
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         child_pb = self.children[0].execute(ctx)
         child_attrs = self.children[0].output
-        kernel = [None]
+        str_ords = self._string_ordinals(child_attrs)
 
         def sort_partition(pidx: int):
             for batch in child_pb.iterator(pidx):
                 if batch.host_rows() == 0:
                     yield batch
                     continue
-                if kernel[0] is None:
-                    kernel[0] = self._build_kernel(child_attrs)
+                n_chunks = 0
+                if str_ords:
+                    n_chunks = max(
+                        RK.string_chunks_needed(batch.columns[i])
+                        for i in str_ords)
+                kernel = self._build_kernel(child_attrs, n_chunks)
                 cols = [_col_to_colv(c) for c in batch.columns]
-                perm = kernel[0](cols, jnp.int32(batch.num_rows))
+                perm = kernel(cols, jnp.int32(batch.num_rows))
                 yield gather_batch(batch, perm, batch.num_rows)
 
         def factory(pidx: int):

@@ -271,11 +271,17 @@ def _register_feature_exec_rules():
             cpu.grouping, cpu.agg_exprs, cpu.mode, ch[0], cpu.specs))
 
     def _tag_sort(m: ExecMeta):
+        from spark_rapids_tpu.ops.base import AttributeReference
+
         for o in m.plan.orders:
-            if o.child.data_type is DataType.STRING:
+            if o.child.data_type is DataType.STRING and \
+                    not isinstance(o.child, AttributeReference):
+                # plain string columns sort on device via chunked u64 order
+                # keys (rowkeys.string_order_proxy); computed string keys
+                # would need the result's max length, unknown outside jit
                 m.will_not_work(
-                    "device lexicographic string ordering is not implemented "
-                    "yet; sort falls back to the CPU engine")
+                    "device ordering of computed string expressions is not "
+                    "implemented (plain string columns sort on device)")
 
     register_exec(
         CpuSortExec, "multi-key stable sort",
@@ -283,13 +289,16 @@ def _register_feature_exec_rules():
         tag_fn=_tag_sort)
 
     def _tag_exchange(m: ExecMeta):
+        from spark_rapids_tpu.ops.base import AttributeReference
+
         p = m.plan.partitioning
         if isinstance(p, X.RangePartitioning):
             for o in p.orders:
-                if o.child.data_type is DataType.STRING:
+                if o.child.data_type is DataType.STRING and \
+                        not isinstance(o.child, AttributeReference):
                     m.will_not_work(
-                        "device range partitioning on strings is not "
-                        "implemented (no device string ordering)")
+                        "device range partitioning on computed string "
+                        "expressions is not implemented")
 
     register_exec(
         X.CpuShuffleExchangeExec, "columnar shuffle exchange",

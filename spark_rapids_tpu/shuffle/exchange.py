@@ -500,15 +500,19 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 
     def _execute_range(self, ctx: ExecContext,
                        p: RangePartitioning) -> PartitionedBatches:
-        """Device range exchange over orderable keys: order bits computed on
-        device, bounds + routing via host bisect over the composite tuples
-        (string range partitioning falls back to the CPU engine via
-        tagging)."""
+        """Device range exchange: order bits for fixed-width keys are
+        computed on device; STRING keys download their values so bounds are
+        computed host-side (the reference's driver-side reservoir sample,
+        GpuRangePartitioner.scala:42-230, does the same). Routing/slicing
+        stays on device either way."""
         child_pb = self.children[0].execute(ctx)
         child_attrs = self.children[0].output
         bound = bind_all([o.child for o in p.orders], child_attrs)
         n = p.num_partitions
-        kernel = _build_order_keys_kernel(bound)
+        str_key = [b.data_type is DataType.STRING for b in bound]
+        fixed_bound = [b for b, s in zip(bound, str_key) if not s]
+        kernel = _build_order_keys_kernel(fixed_bound) if fixed_bound \
+            else None
 
         def mat(pidx: int):
             out = []
@@ -516,12 +520,23 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 if batch.num_rows == 0:
                     continue
                 cols = [_col_to_colv(c) for c in batch.columns]
-                keys = kernel(cols, jnp.int32(batch.num_rows))
-                host_keys = [
-                    (np.asarray(jax.device_get(ob)),
-                     np.asarray(jax.device_get(nf)))
-                    for ob, nf in keys
-                ]
+                fixed_keys = []
+                if kernel is not None:
+                    fixed_keys = [
+                        (np.asarray(jax.device_get(ob)),
+                         np.asarray(jax.device_get(nf)))
+                        for ob, nf in kernel(cols,
+                                             jnp.int32(batch.num_rows))
+                    ]
+                host_keys = []
+                fi = 0
+                for b, is_str in zip(bound, str_key):
+                    if is_str:
+                        host_keys.append(
+                            ("str", _host_string_values(batch, b.ordinal)))
+                    else:
+                        host_keys.append(("bits", fixed_keys[fi]))
+                        fi += 1
                 out.append((batch, host_keys))
             return out
 
@@ -530,14 +545,22 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         else:
             per_part = [mat(i) for i in range(child_pb.num_partitions)]
 
-        # host-side bounds over composite (null_rank, +/-bits) tuples
+        # host-side bounds over composite key tuples
+        def row_key(host_keys, i):
+            out = []
+            for (kind, payload), o in zip(host_keys, p.orders):
+                if kind == "str":
+                    out.append(_order_key(payload[i], o))
+                else:
+                    ob, nf = payload
+                    out.append(_composite(ob[i], nf[i], o))
+            return tuple(out)
+
         rows: List[tuple] = []
         for part in per_part:
             for batch, host_keys in part:
                 for i in range(batch.num_rows):
-                    rows.append(tuple(
-                        _composite(ob[i], nf[i], o)
-                        for (ob, nf), o in zip(host_keys, p.orders)))
+                    rows.append(row_key(host_keys, i))
         bounds = None
         if rows:
             rows.sort()
@@ -554,10 +577,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 ids = np.zeros(cap, dtype=np.int32)
                 if bounds is not None:
                     for i in range(batch.num_rows):
-                        row = tuple(
-                            _composite(ob[i], nf[i], o)
-                            for (ob, nf), o in zip(host_keys, p.orders))
-                        ids[i] = bisect.bisect_right(bounds, row)
+                        ids[i] = bisect.bisect_right(
+                            bounds, row_key(host_keys, i))
                 ids[batch.num_rows:] = n
                 for t, piece in _device_slices(batch, jnp.asarray(ids), n):
                     if piece.num_rows:
@@ -637,6 +658,16 @@ def _build_order_keys_kernel(bound_exprs):
         return f
 
     return get_or_build(key, build)
+
+
+def _host_string_values(batch: ColumnarBatch, ordinal: int):
+    """Download one string key column as python values (None for NULL) for
+    host-side range bounds."""
+    cv = batch.columns[ordinal]
+    host = ColumnarBatch([cv], batch.host_rows()).to_host()
+    hv = host.columns[0]
+    return [hv.data[i] if hv.validity[i] else None
+            for i in range(host.num_rows)]
 
 
 def _composite(obits: int, is_null: bool, order: SortOrder) -> Tuple[int, int]:

@@ -54,13 +54,42 @@ def test_sort_within_partitions(session):
                          num_partitions=1).sortWithinPartitions("v"))
 
 
-def test_sort_string_falls_back(session):
+def test_sort_string_on_device(session):
+    # plain string columns sort ON DEVICE via chunked u64 order keys
+    # (rowkeys.string_order_proxy); the range exchange on string keys also
+    # stays on device with host-computed bounds
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", StringGen(max_len=5)),
+                             ("x", IntGen(DataType.INT32))], n=100)
+        .orderBy("v", "x"))
+
+
+def test_sort_string_desc_nulls_and_long(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("v", StringGen(max_len=40)),
+                             ("x", IntGen(DataType.INT64))], n=200)
+        .orderBy(F.col("v").desc(), F.col("x")))
+
+
+def test_sort_string_prefix_ordering(session):
+    # exact prefix cases: "ab" < "ab\x00-free" lengths, shared 8-byte chunks
+    def q(s):
+        return s.createDataFrame(
+            {"v": ["abcdefghi", "abcdefgh", "abcdefghj", "", "abcdefgh",
+               None, "abcdefghia", "z", "abcdefghi"]},
+            [("v", DataType.STRING)]).orderBy("v")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_sort_computed_string_key_falls_back(session):
     assert_tpu_fallback_collect(
         session,
         lambda s: gen_df(s, [("v", StringGen(max_len=5)),
                              ("x", IntGen(DataType.INT32))], n=100)
-        .orderBy("v", "x"),
+        .orderBy(F.upper(F.col("v"))),
         fallback_exec="CpuSortExec",
-        # the range exchange on a string key also stays on CPU
         extra_conf={"rapids.tpu.sql.test.allowedNonTpu":
                     "CpuSortExec,CpuShuffleExchangeExec"})

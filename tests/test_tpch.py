@@ -1,0 +1,26 @@
+"""TPC-H-like query equivalence at tiny scale (reference:
+TpchLikeSparkSuite.scala running the query set at SF-tiny;
+BASELINE configs 2 and 3)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpch
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.mark.parametrize("qname", ["q1", "q3", "q5", "q6"])
+def test_tpch_query_equivalence(session, qname):
+    def q(s):
+        tables = tpch.gen_tables(s, sf=0.0005, num_partitions=3)
+        return tpch.QUERIES[qname](tables)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9)
+
+
+def test_q6_nonempty(session):
+    # guard against the filter accidentally selecting nothing at tiny scale
+    tables = tpch.gen_tables(session, sf=0.0005, num_partitions=2)
+    rows = tpch.q6(tables).collect()
+    assert len(rows) == 1 and rows[0][0] is not None and rows[0][0] > 0

@@ -451,3 +451,188 @@ def classify_like(pattern: str):
     if pattern.endswith("%"):
         return "prefix", (inner,)
     return "suffix", (inner,)
+
+
+# ---------------------------------------------------------------------------
+# Replace / locate / initcap / concat_ws kernels
+# ---------------------------------------------------------------------------
+def has_border(s: bytes) -> bool:
+    """True when some proper prefix of s equals a suffix (e.g. 'aa', 'aba').
+    Borderless patterns cannot overlap themselves, so every match of a
+    borderless pattern is automatically non-overlapping — the precondition
+    for the vectorized replace below."""
+    for k in range(1, len(s)):
+        if s[:k] == s[-k:]:
+            return True
+    return False
+
+
+def _match_starts(col: ColV, nb: np.ndarray, cap: int):
+    """Bool [byte_cap] mask of byte positions where the needle matches and
+    fits inside its row, plus the per-byte row index."""
+    n = len(nb)
+    byte_cap = int(col.data.shape[0])
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    m = jnp.ones((byte_cap,), dtype=bool)
+    for k, b in enumerate(nb):
+        m = m & (col.data[jnp.clip(pos + k, 0, byte_cap - 1)] == b)
+    row = jnp.clip(jnp.searchsorted(col.offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    fits = (pos >= col.offsets[row]) & ((pos + n) <= col.offsets[row + 1])
+    return m & fits, row, pos
+
+
+def replace_literal(ctx, col: ColV, find: str, repl: str) -> ColV:
+    """replace(str, find, repl) on device, left-to-right non-overlapping
+    (python str.replace semantics; reference: GpuStringReplace via cudf
+    stringReplace, stringFunctions.scala). Precondition enforced by the meta
+    layer: find is non-empty and borderless (or length 1), so every match is
+    non-overlapping by construction."""
+    fb = _needle_bytes(find)
+    rb = _needle_bytes(repl)
+    f, r = len(fb), len(rb)
+    cap = ctx.capacity
+    m, row, pos = _match_starts(col, fb, cap)
+    byte_cap = int(col.data.shape[0])
+    # per-row match counts and per-byte prior-match counts (segmented cumsum)
+    cum_excl = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(m.astype(jnp.int32), dtype=jnp.int32)])[:-1]
+    prior = cum_excl - cum_excl[jnp.clip(col.offsets[row], 0, byte_cap - 1)]
+    counts = jax.ops.segment_sum(m.astype(jnp.int32), row, num_segments=cap)
+    lens = lengths_of(col)
+    out_len = jnp.where(col.validity, lens + counts * (r - f), 0)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len, dtype=jnp.int32)])
+    out_cap = byte_cap + (0 if r <= f else (byte_cap // max(f, 1)) * (r - f))
+    out = jnp.zeros((out_cap,), dtype=jnp.uint8)
+    # covered[i]: i lies inside some match (start in (i-f, i])
+    covered = jnp.zeros((byte_cap,), dtype=bool)
+    for k in range(f):
+        covered = covered | jnp.concatenate(
+            [jnp.zeros((k,), dtype=bool), m[:byte_cap - k]])
+    in_row = (pos >= col.offsets[row]) & (pos < col.offsets[row + 1])
+    # pass-through bytes
+    keep = in_row & ~covered
+    out_pos = new_offsets[row] + (pos - col.offsets[row]) + (r - f) * prior
+    out = out.at[jnp.where(keep, out_pos, out_cap)].set(
+        col.data, mode="drop")
+    # replacement bytes: the match at s emits rb[k] at the same output
+    # offset a pass-through byte at s would land on, plus k
+    for k in range(r):
+        out = out.at[jnp.where(m, out_pos + k, out_cap)].set(
+            jnp.uint8(rb[k]), mode="drop")
+    return ColV(DataType.STRING, out, col.validity, new_offsets)
+
+
+def locate(ctx, needle: str, col: ColV, start: int):
+    """1-based CHARACTER position of the first occurrence of needle at or
+    after char position `start`; 0 when absent (reference: GpuStringLocate,
+    stringFunctions.scala:62). UTF-8 aware."""
+    cap = ctx.capacity
+    lens = lengths_of(col)
+    if start < 1:
+        return jnp.zeros((cap,), dtype=jnp.int32)
+    nb = _needle_bytes(needle)
+    char_len = utf8_char_lengths(col)
+    if len(nb) == 0:
+        # empty needle: Spark returns `start` when start <= len+1
+        return jnp.where(start <= char_len + 1, start, 0).astype(jnp.int32)
+    m, row, pos = _match_starts(col, nb, cap)
+    # char index (0-based within row) of each byte position
+    is_start_byte = (col.data & 0xC0) != 0x80
+    cum_chars = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(is_start_byte.astype(jnp.int32), dtype=jnp.int32)])
+    byte_cap = int(col.data.shape[0])
+    char_pos = cum_chars[pos] - cum_chars[jnp.clip(col.offsets[row], 0,
+                                                   byte_cap - 1)]
+    cand = m & (char_pos >= start - 1)
+    INF = jnp.int32(1 << 30)
+    first = jax.ops.segment_min(jnp.where(cand, char_pos, INF), row,
+                                num_segments=cap)
+    return jnp.where(first < INF, first + 1, 0).astype(jnp.int32)
+
+
+def initcap_ascii(ctx, col: ColV) -> ColV:
+    """First letter of each space-separated word uppercased, rest lowercased
+    (ASCII; reference: GpuInitCap, stringFunctions.scala:399 — cudf title
+    case, which the meta layer flags incompat for non-ASCII the same way as
+    upper/lower)."""
+    d = col.data
+    byte_cap = int(d.shape[0])
+    prev = jnp.concatenate([jnp.full((1,), ord(" "), jnp.uint8),
+                            d[:byte_cap - 1]])
+    # word start: previous byte is a space OR this byte starts a row
+    row_start = jnp.zeros((byte_cap,), dtype=bool)
+    row_start = row_start.at[jnp.clip(col.offsets[:-1], 0, byte_cap - 1)].set(
+        True)
+    new_word = (prev == ord(" ")) | row_start
+    is_lower = (d >= ord("a")) & (d <= ord("z"))
+    is_upper = (d >= ord("A")) & (d <= ord("Z"))
+    up = jnp.where(new_word & is_lower, d - 32, d)
+    out = jnp.where(~new_word & is_upper, up + 32, up)
+    return ColV(DataType.STRING, out.astype(jnp.uint8), col.validity,
+                col.offsets)
+
+
+def concat_ws(ctx, sep: str, vals) -> ColV:
+    """concat_ws(sep, ...): join NON-NULL values with sep; never null (all
+    null -> ''), matching Spark. Device: per-row piece table (J static
+    pieces, each optionally preceded by the separator) driving one
+    build-from-pieces gather."""
+    sb = _needle_bytes(sep)
+    slen = len(sb)
+    if not ctx.is_device:
+        cols = [_host_col(ctx, v) for v in vals]
+        n = ctx.capacity
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            parts = [str(d[i]) for d, va in cols if va[i]]
+            out[i] = sep.join(parts)
+        return ColV(DataType.STRING, out,
+                    np.ones((n,), dtype=bool))
+    views = [as_view(ctx, v) for v in vals]
+    cap = ctx.capacity
+    sep_arr = jnp.asarray(sb) if slen else jnp.zeros((1,), jnp.uint8)
+    # piece k layout per row: [sep if k has a non-null predecessor] + val_k
+    any_before = jnp.zeros((cap,), dtype=bool)
+    sep_lens = []     # [J] per-row separator-prefix length
+    piece_lens = []   # [J] per-row value length (0 when null)
+    for v in views:
+        sep_lens.append(jnp.where(v.validity & any_before, slen, 0)
+                        .astype(jnp.int32))
+        piece_lens.append(jnp.where(v.validity, v.lens, 0).astype(jnp.int32))
+        any_before = any_before | v.validity
+    totals = [s + p for s, p in zip(sep_lens, piece_lens)]
+    # exclusive running offset of each piece within the row
+    piece_off = [jnp.zeros((cap,), dtype=jnp.int32)]
+    for t in totals[:-1]:
+        piece_off.append(piece_off[-1] + t)
+    out_len = piece_off[-1] + totals[-1]
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len, dtype=jnp.int32)])
+    byte_cap = sum(plan_byte_cap(ctx, v) for v in vals) + \
+        max(1, slen * cap * max(len(views) - 1, 0))
+    byte_cap = max(8, int(byte_cap))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    rowi = jnp.clip(jnp.searchsorted(new_offsets[1:], pos, side="right"),
+                    0, cap - 1).astype(jnp.int32)
+    within = pos - new_offsets[rowi]
+    valid = pos < new_offsets[-1]
+    out = jnp.zeros((byte_cap,), dtype=jnp.uint8)
+    for k, v in enumerate(views):
+        off_k = piece_off[k][rowi]
+        sl = sep_lens[k][rowi]
+        pl = piece_lens[k][rowi]
+        rel = within - off_k
+        in_sep = valid & (rel >= 0) & (rel < sl)
+        in_val = valid & (rel >= sl) & (rel < sl + pl)
+        if slen:
+            out = jnp.where(
+                in_sep, sep_arr[jnp.clip(rel, 0, slen - 1)], out)
+        src = jnp.clip(v.starts[rowi] + rel - sl, 0,
+                       int(v.data.shape[0]) - 1)
+        out = jnp.where(in_val, v.data[src], out)
+    return ColV(DataType.STRING, out,
+                jnp.ones((cap,), dtype=bool), new_offsets)

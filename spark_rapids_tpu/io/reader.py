@@ -68,7 +68,23 @@ class DataFrameReader:
 
     def _resolve_schema(self, fmt: str,
                         paths: List[str]) -> List[AttributeReference]:
-        sample = expand_paths(paths, _SUFFIXES.get(fmt, ()))[0]
+        # one directory walk serves both the file schema sample and the
+        # Hive-style partition discovery (reference:
+        # ColumnarPartitionReaderWithPartitionValues + Spark's inference)
+        from spark_rapids_tpu.io.scan import (
+            infer_partition_schema,
+            partition_values_of,
+        )
+
+        files = expand_paths(paths, _SUFFIXES.get(fmt, ()))
+        file_attrs = self._resolve_file_schema(fmt, files[0])
+        part_attrs = infer_partition_schema(
+            [partition_values_of(f, paths) for f in files])
+        names = {a.name for a in file_attrs}
+        return file_attrs + [a for a in part_attrs if a.name not in names]
+
+    def _resolve_file_schema(self, fmt: str,
+                             sample: str) -> List[AttributeReference]:
         if fmt == "parquet":
             import pyarrow.parquet as pq
 

@@ -26,7 +26,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
-from spark_rapids_tpu.columnar.batch import HostColumnarBatch
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
+from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.exec.base import (
     CpuExec,
     ExecContext,
@@ -44,15 +45,75 @@ from spark_rapids_tpu.utils import metrics as M
 
 @dataclass(frozen=True)
 class FileSplit:
-    """One read task: a file plus (for parquet) the row groups to read."""
+    """One read task: a file plus (for parquet) the row groups to read.
+    `partition_values` carries the Hive-style key=value directory components
+    of the file's path (reference: PartitionedFile partitionValues appended
+    by ColumnarPartitionReaderWithPartitionValues)."""
 
     path: str
     fmt: str
     row_groups: Optional[Tuple[int, ...]] = None
     options: Tuple[Tuple[str, Any], ...] = ()
+    partition_values: Tuple[Tuple[str, Optional[str]], ...] = ()
 
     def opt(self, key: str, default=None):
         return dict(self.options).get(key, default)
+
+
+HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def partition_values_of(path: str, roots: List[str]):
+    """key=value components of `path` under its root directory, in path
+    order (the Hive partition-discovery rule Spark applies)."""
+    from urllib.parse import unquote
+
+    for root in roots:
+        root = root.rstrip(os.sep)
+        if os.path.isdir(root) and path.startswith(root + os.sep):
+            rel = os.path.dirname(path[len(root) + 1:])
+            out = []
+            for comp in rel.split(os.sep):
+                if "=" in comp:
+                    k, _, v = comp.partition("=")
+                    v = unquote(v)
+                    out.append((k, None if v == HIVE_NULL else v))
+            return tuple(out)
+    return ()
+
+
+def infer_partition_schema(
+        pvs: List[Tuple[Tuple[str, Optional[str]], ...]]):
+    """Column order + types for discovered partition values (Spark's
+    partition-column type inference: int64 -> float64 -> string)."""
+    names: List[str] = []
+    values: Dict[str, List[Optional[str]]] = {}
+    for pv in pvs:
+        for k, v in pv:
+            if k not in values:
+                names.append(k)
+                values[k] = []
+            values[k].append(v)
+    out = []
+    for n in names:
+        dt = DataType.INT64
+        for v in values[n]:
+            if v is None:
+                continue
+            try:
+                int(v)
+                continue
+            except ValueError:
+                pass
+            try:
+                float(v)
+                dt = DataType.FLOAT64 if dt is DataType.INT64 else dt
+                continue
+            except ValueError:
+                dt = DataType.STRING
+                break
+        out.append(AttributeReference(n, dt, True))
+    return out
 
 
 def expand_paths(paths: List[str], suffixes: Tuple[str, ...]) -> List[str]:
@@ -85,8 +146,9 @@ def plan_splits(fmt: str, paths: List[str], options: Dict[str, Any],
 
     files = expand_paths(paths, _SUFFIXES.get(fmt, ()))
     opt_t = tuple(sorted(options.items()))
+    pvs = {f: partition_values_of(f, paths) for f in files}
     if fmt != "parquet":
-        return [FileSplit(f, fmt, None, opt_t) for f in files]
+        return [FileSplit(f, fmt, None, opt_t, pvs[f]) for f in files]
     import pyarrow.parquet as pq
 
     max_rows = conf.get(C.MAX_READ_BATCH_SIZE_ROWS)
@@ -98,12 +160,12 @@ def plan_splits(fmt: str, paths: List[str], options: Dict[str, Any],
         for rg in range(md.num_row_groups):
             n = md.row_group(rg).num_rows
             if group and rows + n > max_rows:
-                splits.append(FileSplit(f, fmt, tuple(group), opt_t))
+                splits.append(FileSplit(f, fmt, tuple(group), opt_t, pvs[f]))
                 group, rows = [], 0
             group.append(rg)
             rows += n
         if group:
-            splits.append(FileSplit(f, fmt, tuple(group), opt_t))
+            splits.append(FileSplit(f, fmt, tuple(group), opt_t, pvs[f]))
     return splits
 
 
@@ -148,6 +210,42 @@ def _to_bool(v) -> bool:
     return str(v).strip().lower() in ("1", "true", "yes")
 
 
+def _with_partition_columns(batch: HostColumnarBatch, attrs,
+                            pv: Dict[str, Optional[str]]) -> HostColumnarBatch:
+    """Rebuild the batch in `attrs` order, filling partition columns with
+    their (parsed) constant directory value."""
+    n = batch.num_rows
+    by_name = {}
+    di = 0
+    for a in attrs:
+        if a.name in pv:
+            continue
+        by_name[a.name] = batch.columns[di]
+        di += 1
+    cols = []
+    for a in attrs:
+        if a.name not in pv:
+            cols.append(by_name[a.name])
+            continue
+        raw = pv[a.name]
+        if raw is None:
+            validity = np.zeros(n, dtype=bool)
+            if a.data_type is DataType.STRING:
+                data = np.full(n, "", dtype=object)
+            else:
+                data = np.zeros(n, dtype=a.data_type.to_np())
+        else:
+            validity = np.ones(n, dtype=bool)
+            if a.data_type is DataType.STRING:
+                data = np.full(n, raw, dtype=object)
+            elif a.data_type is DataType.FLOAT64:
+                data = np.full(n, float(raw), dtype=np.float64)
+            else:
+                data = np.full(n, int(raw), dtype=a.data_type.to_np())
+        cols.append(HostColumnVector(a.data_type, data, validity))
+    return HostColumnarBatch(cols, n)
+
+
 class _FileScanBase(PhysicalExec):
     def __init__(self, attrs: List[AttributeReference],
                  splits: List[FileSplit], fmt: str):
@@ -170,8 +268,15 @@ class _FileScanBase(PhysicalExec):
     def _read_host(self, pidx: int, conf) -> List[HostColumnarBatch]:
         from spark_rapids_tpu import conf as C
 
-        table = read_split(self.splits[pidx], self.attrs)
-        batch = arrow_to_host_batch(table, self.attrs)
+        split = self.splits[pidx]
+        pv = dict(split.partition_values)
+        data_attrs = [a for a in self.attrs if a.name not in pv]
+        table = read_split(split, data_attrs)
+        batch = arrow_to_host_batch(table, data_attrs)
+        if pv:
+            # append partition-value constant columns (reference:
+            # ColumnarPartitionReaderWithPartitionValues)
+            batch = _with_partition_columns(batch, self.attrs, pv)
         max_rows = conf.get(C.MAX_READ_BATCH_SIZE_ROWS)
         if batch.num_rows <= max_rows:
             return [batch]

@@ -160,3 +160,23 @@ class Atan2(BinaryExpression):
             return x.astype(f) if hasattr(x, "astype") else float(x)
 
         return xp.arctan2(cast(_d(lv)), cast(_d(rv)))
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize -0.0 -> 0.0 and every NaN to one canonical NaN
+    (reference: NormalizeNaNAndZero, NormalizeFloatingNumbers.scala — Spark
+    inserts it over float group/join keys). The engine's key machinery
+    (exec/rowkeys key_proxy, ops/hashing float bits, the CPU oracle's
+    _canonical_key) already normalizes during grouping/joining; this
+    expression is the user-visible/value-level form."""
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def do_columnar(self, ctx, v):
+        xp = ctx.xp
+        d = _d(v)
+        dt = d.dtype if hasattr(d, "dtype") else np.float64
+        d = xp.where(d == 0.0, xp.asarray(0.0, dtype=dt), d)
+        return xp.where(xp.isnan(d), xp.asarray(float("nan"), dtype=dt), d)

@@ -226,13 +226,62 @@ class StringTrimRight(StringTrim):
     _side = "right"
 
 
-class StringReplace(TernaryExpression):
+class _ScalarArgsTernary(TernaryExpression):
+    """Ternary whose 2nd/3rd operands are scalar 'needle' arguments that
+    must STAY scalars (the base TernaryExpression lifts string scalars to
+    columns, which needle-style kernels can't use — same restriction as the
+    reference's scalar-only cudf args, stringFunctions.scala)."""
+
+    def eval_kernel(self, ctx, av, bv, cv):
+        from spark_rapids_tpu.ops.base import (
+            _fold_result,
+            _lift_string_scalar,
+            _null_string_col,
+            _scalar_fold_ctx,
+            and_validity,
+            zero_nulls,
+        )
+
+        for v in (bv, cv):
+            if not isinstance(v, ScalarV):
+                raise TypeError(
+                    f"{type(self).__name__} requires scalar arguments")
+        if bv.is_null or cv.is_null or \
+                (isinstance(av, ScalarV) and av.is_null):
+            if self.data_type is DataType.STRING:
+                return _null_string_col(ctx)
+            return ColV(self.data_type,
+                        ctx.xp.zeros((ctx.capacity,),
+                                     dtype=self.data_type.to_np()),
+                        ctx.xp.zeros((ctx.capacity,), dtype=bool))
+        if isinstance(av, ScalarV):
+            if ctx.is_device:
+                av = _lift_string_scalar(ctx, av)
+            else:
+                fctx = _scalar_fold_ctx()
+                lifted = ColV(DataType.STRING,
+                              np.array([av.value], dtype=object),
+                              np.array([True]))
+                return _fold_result(self.data_type,
+                                    self.do_columnar(fctx, lifted, bv, cv))
+        data = self.do_columnar(ctx, av, bv, cv)
+        validity = av.validity
+        if validity is None:
+            validity = ctx.xp.ones((ctx.capacity,), dtype=bool)
+        if isinstance(data, ColV):
+            return ColV(data.dtype, data.data,
+                        and_validity(ctx.xp, data.validity, validity),
+                        data.offsets)
+        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity),
+                    validity)
+
+
+class StringReplace(_ScalarArgsTernary):
     """replace(str, search, replacement) — scalar search/replacement only
-    (reference: GpuStringReplace requires scalar args). Device path currently
-    tags for fallback when replacement length differs unpredictably; the
-    simple equal/shrink case runs on device via contains/substring composition
-    in a later round, so for now the meta layer marks this CPU-only on device
-    unless search == '' (identity)."""
+    (reference: GpuStringReplace requires scalar args). Device kernel
+    (columnar/strings.replace_literal) requires a non-empty, borderless (or
+    single-char) search so matches cannot overlap; other searches are tagged
+    for CPU fallback by the meta layer."""
 
     @property
     def data_type(self):
@@ -241,5 +290,146 @@ class StringReplace(TernaryExpression):
     def do_columnar(self, ctx, sv, fv, rv):
         assert isinstance(fv, ScalarV) and isinstance(rv, ScalarV)
         if ctx.is_device:
-            raise NotImplementedError("StringReplace device kernel (round 2)")
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.replace_literal(ctx, sv, fv.value, rv.value)
         return _obj(lambda s: s.replace(fv.value, rv.value), sv.data)
+
+
+class RegExpReplace(_ScalarArgsTernary):
+    """regexp_replace(str, pattern, replacement). Device support mirrors the
+    reference's restriction (GpuOverrides.scala:1458-1468 + the regexList at
+    :334-337): the pattern must be a literal containing NO regex
+    metacharacters — i.e. it is really a literal replace — otherwise the
+    meta layer tags the expression for CPU fallback (where python `re` runs
+    the full regex)."""
+
+    # the reference's regexList (metacharacter blocklist) plus '+', which
+    # that list omits but is just as much a quantifier as '*'
+    REGEX_CHARS = ("\\", "\x00", "\t", "\n", "\r", "\f", "[", "]", "^", "&",
+                   ".", "*", "+", "$", "?", "|", "(", ")", "{", "}", ":",
+                   "!", "<=", ">")
+
+    @classmethod
+    def is_simple_pattern(cls, pattern: str) -> bool:
+        return not any(ch in pattern for ch in cls.REGEX_CHARS)
+
+    @property
+    def data_type(self):
+        return DataType.STRING
+
+    def do_columnar(self, ctx, sv, pv, rv):
+        assert isinstance(pv, ScalarV) and isinstance(rv, ScalarV)
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.replace_literal(ctx, sv, pv.value, rv.value)
+        import re
+
+        pat = re.compile(pv.value)
+        # literal replacement (no backslash/group expansion), matching the
+        # device path; group references in the replacement are unsupported
+        repl = rv.value
+        return _obj(lambda s: pat.sub(lambda _m: repl, s), sv.data)
+
+
+class StringLocate(_ScalarArgsTernary):
+    """locate(substr, str, start) — 1-based character position, 0 if absent
+    (reference: GpuStringLocate, stringFunctions.scala:62; scalar substr and
+    start, like the cudf version). Internal child order is (str, substr,
+    start) so the scalar-args template sees the column first."""
+
+    @property
+    def data_type(self):
+        return DataType.INT32
+
+    def do_columnar(self, ctx, sv, nv, pv):
+        assert isinstance(nv, ScalarV) and isinstance(pv, ScalarV)
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.locate(ctx, nv.value, sv, int(pv.value))
+
+        start = int(pv.value)
+
+        def loc(s):
+            if start < 1:
+                return 0
+            if nv.value == "":
+                return start if start <= len(s) + 1 else 0
+            return s.find(nv.value, start - 1) + 1
+
+        return np.fromiter((loc(s) for s in sv.data), dtype=np.int32,
+                           count=len(sv.data))
+
+
+class InitCap(UnaryExpression):
+    """initcap: first letter of each space-separated word uppercased, rest
+    lowercased (reference: GpuInitCap, stringFunctions.scala:399; ASCII-only
+    on device, flagged incompat like upper/lower)."""
+
+    @property
+    def data_type(self):
+        return DataType.STRING
+
+    def do_columnar(self, ctx, v):
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.initcap_ascii(ctx, v)
+
+        def cap_words(s):
+            return " ".join(w[:1].upper() + w[1:].lower()
+                            for w in s.split(" "))
+
+        return _obj(cap_words, v.data)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, c1, c2, ...): join non-null values with the separator;
+    never NULL (reference: Spark semantics; the v0.1 plugin leaves concat_ws
+    on CPU — here it runs on device via a static per-row piece table)."""
+
+    def __init__(self, sep: str, children):
+        self.sep = sep
+        self._children = tuple(children)
+
+    def children(self):
+        return self._children
+
+    def with_children(self, new_children):
+        return ConcatWs(self.sep, new_children)
+
+    @property
+    def data_type(self):
+        return DataType.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.columnar import strings as S
+        from spark_rapids_tpu.ops.values import ScalarV as SV
+
+        vals = []
+        for c in self._children:
+            r = c.eval(ctx)
+            vals.append(r)
+        if all(isinstance(v, SV) for v in vals):
+            parts = [v.value for v in vals if not v.is_null]
+            return SV(DataType.STRING, self.sep.join(parts))
+        if ctx.is_device:
+            from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+            vals = [
+                _scalar_to_colv(ctx, v, DataType.STRING)
+                if isinstance(v, SV) else v for v in vals
+            ]
+        return S.concat_ws(ctx, self.sep, vals)
+
+    def _fingerprint_extra(self):
+        return f"ws:{self.sep!r};"
+
+    def __repr__(self):
+        return f"concat_ws({self.sep!r}, {', '.join(map(repr, self._children))})"

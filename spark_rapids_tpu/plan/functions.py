@@ -206,6 +206,30 @@ def rtrim(c: ColumnOrName) -> Column:
     return Column(S.StringTrimRight(_c(c)))
 
 
+def regexp_replace(c: ColumnOrName, pattern: str, repl: str) -> Column:
+    """regexp_replace; only literal (metacharacter-free) patterns run on
+    device, mirroring the reference (GpuOverrides.scala:1458-1468)."""
+    return Column(S.RegExpReplace(_c(c), Literal(pattern), Literal(repl)))
+
+
+def locate(substr: str, c: ColumnOrName, pos: int = 1) -> Column:
+    """1-based position of substr in c, 0 if absent (reference:
+    GpuStringLocate, stringFunctions.scala:62)."""
+    return Column(S.StringLocate(_c(c), Literal(substr), Literal(pos)))
+
+
+def initcap(c: ColumnOrName) -> Column:
+    return Column(S.InitCap(_c(c)))
+
+
+def concat_ws(sep: str, *cols: ColumnOrName) -> Column:
+    """Join non-null values with sep; returns '' (never NULL) when all
+    inputs are null, matching Spark."""
+    if not cols:
+        raise ValueError("concat_ws requires at least one column")
+    return Column(S.ConcatWs(sep, [_c(c) for c in cols]))
+
+
 def replace(c: ColumnOrName, search: str, repl: str) -> Column:
     return Column(S.StringReplace(_c(c), Literal(search), Literal(repl)))
 

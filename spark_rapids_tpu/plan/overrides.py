@@ -118,6 +118,7 @@ def _register_expr_rules():
           incompat="floating point results may differ in ulps from the CPU")
     for cls in (MX.Rint, MX.Floor, MX.Ceil, MX.ToDegrees, MX.ToRadians):
         r(cls, f"math {cls.__name__}", tag_fn=_tag_f64_on_tpu)
+    r(MX.NormalizeNaNAndZero, "normalize -0.0 and NaN for float keys")
     # bitwise
     for cls in (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
                 BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned):
@@ -131,9 +132,53 @@ def _register_expr_rules():
     # strings
     for cls in (S.Length, S.Substring, S.Concat,
                 S.StartsWith, S.EndsWith, S.Contains, S.Like, S.StringTrim,
-                S.StringTrimLeft, S.StringTrimRight, S.StringReplace):
+                S.StringTrimLeft, S.StringTrimRight, S.ConcatWs):
         r(cls, f"string {cls.__name__}")
-    for cls in (S.Upper, S.Lower):
+
+    def _literal_value(e):
+        from spark_rapids_tpu.ops.literals import Literal as Lit
+
+        node = e
+        while hasattr(node, "child") and not isinstance(node, Lit):
+            node = node.child
+        return node.value if isinstance(node, Lit) else None
+
+    def _tag_replace(m):
+        from spark_rapids_tpu.columnar.strings import has_border
+
+        find = _literal_value(m.expr.children()[1])
+        if not isinstance(find, str) or find == "":
+            m.will_not_work("replace needs a non-empty literal search string")
+        elif len(find.encode("utf-8")) > 1 and \
+                has_border(find.encode("utf-8")):
+            m.will_not_work(
+                "device replace requires a self-overlap-free search string "
+                f"({find!r} can overlap itself)")
+
+    r(S.StringReplace, "string StringReplace", tag_fn=_tag_replace)
+
+    def _tag_regexp_replace(m):
+        from spark_rapids_tpu.columnar.strings import has_border
+
+        pat = _literal_value(m.expr.children()[1])
+        if not isinstance(pat, str) or pat == "":
+            m.will_not_work(
+                "regexp_replace needs a non-empty literal pattern")
+        elif not S.RegExpReplace.is_simple_pattern(pat):
+            # reference: only literal (metacharacter-free) patterns run on
+            # the accelerator, GpuOverrides.scala:1458-1468
+            m.will_not_work(
+                f"regexp pattern {pat!r} contains regex metacharacters; "
+                "only literal patterns are supported on device")
+        elif len(pat.encode("utf-8")) > 1 and has_border(pat.encode("utf-8")):
+            m.will_not_work(
+                f"device replace requires a self-overlap-free pattern "
+                f"({pat!r} can overlap itself)")
+
+    r(S.RegExpReplace, "string RegExpReplace (literal patterns)",
+      tag_fn=_tag_regexp_replace)
+    r(S.StringLocate, "string locate (scalar substring/start)")
+    for cls in (S.Upper, S.Lower, S.InitCap):
         r(cls, f"string {cls.__name__}",
           incompat="device case conversion is ASCII-only; non-ASCII "
                    "characters pass through unchanged")

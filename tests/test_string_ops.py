@@ -1,0 +1,190 @@
+"""String expression breadth tests: replace / regexp_replace / locate /
+initcap / concat_ws (reference: string_test.py + stringFunctions.scala)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    IntGen,
+    StringGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+    gen_df,
+    run_on_cpu,
+)
+
+
+def _df_words(s, n=120, seed=0):
+    return gen_df(s, [("t", StringGen(max_len=12, alphabet="abcxy z_")),
+                      ("u", StringGen(max_len=6))], n=n, seed=seed)
+
+
+class TestReplace:
+    def test_replace_on_device(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: _df_words(s).select(
+                F.replace(F.col("t"), "ab", "Z"),
+                F.replace(F.col("t"), "x", ""),
+                F.replace(F.col("t"), "z", "0123")))
+
+    def test_replace_grow_shrink_exact(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["abab", "xabx", "", "ab", "aabb", None, "abcab"]},
+                [("t", DataType.STRING)]) \
+                .select(F.replace(F.col("t"), "ab", "##LONG##"),
+                        F.replace(F.col("t"), "ab", ""))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q)
+
+    def test_replace_overlappy_pattern_falls_back(self, session):
+        # 'aa' can overlap itself -> CPU fallback, still correct
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["aaaa", "baa", "aaa", None]},
+                [("t", DataType.STRING)]) \
+                .select(F.replace(F.col("t"), "aa", "X").alias("r"))
+
+        cpu = run_on_cpu(session, q)
+        assert [r[0] for r in cpu] == ["XX", "bX", "Xa", None]
+        assert_tpu_fallback_collect(session, q,
+                                    fallback_exec="CpuProjectExec")
+
+
+class TestRegexpReplace:
+    def test_literal_pattern_on_device(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: _df_words(s).select(
+                F.regexp_replace(F.col("t"), "ab", "QQ")))
+
+    def test_metachar_pattern_falls_back(self, session):
+        assert_tpu_fallback_collect(
+            session,
+            lambda s: _df_words(s).select(
+                F.regexp_replace(F.col("t"), "a.c", "#")),
+            fallback_exec="CpuProjectExec")
+
+
+class TestLocate:
+    def test_locate_basic(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: _df_words(s).select(
+                F.locate("ab", F.col("t")),
+                F.locate("z", F.col("t"), 2),
+                F.locate("", F.col("t")),
+                F.locate("nope", F.col("t"))))
+
+    def test_locate_unicode_char_positions(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["héllo wörld", "ab", "ééx", "", None]},
+                [("t", DataType.STRING)]) \
+                .select(F.locate("x", F.col("t")),
+                        F.locate("ö", F.col("t")),
+                        F.locate("l", F.col("t"), 4))
+
+        cpu = run_on_cpu(session, q)
+        assert cpu[0] == (0, 8, 4)   # python find is char-based
+        assert cpu[2][0] == 3        # x after two 2-byte chars -> char pos 3
+        assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+class TestInitCapConcatWs:
+    def test_initcap(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["hello world", "a  b", "XYZ abc", "", None, "x"]},
+                [("t", DataType.STRING)]) \
+                .select(F.initcap(F.col("t")))
+
+        cpu = run_on_cpu(session, q)
+        assert [r[0] for r in cpu] == [
+            "Hello World", "A  B", "Xyz Abc", "", None, "X"]
+        # initcap is incompat-gated (ASCII-only device case conversion)
+        assert_tpu_and_cpu_are_equal_collect(
+            session, q,
+            extra_conf={"rapids.tpu.sql.incompatibleOps.enabled": True})
+
+    def test_concat_ws_skips_nulls(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"a": ["x", None, "p", None],
+                 "b": ["y", "q", None, None],
+                 "c": ["z", "r", "s", None]},
+                [("a", DataType.STRING), ("b", DataType.STRING),
+                 ("c", DataType.STRING)]) \
+                .select(F.concat_ws("-", F.col("a"), F.col("b"),
+                                    F.col("c")).alias("j"))
+
+        cpu = run_on_cpu(session, q)
+        assert [r[0] for r in cpu] == ["x-y-z", "q-r", "p-s", ""]
+        assert_tpu_and_cpu_are_equal_collect(session, q)
+
+    def test_concat_ws_fuzz(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("a", StringGen(max_len=5)),
+                                 ("b", StringGen(max_len=8)),
+                                 ("k", IntGen(DataType.INT64))], n=200)
+            .select(F.concat_ws("||", F.col("a"), F.col("b"))))
+
+
+class TestFloatKeyNormalization:
+    def test_normalize_expression(self, session):
+        from spark_rapids_tpu.plan.column import Column
+        from spark_rapids_tpu.ops.mathx import NormalizeNaNAndZero
+
+        def q(s):
+            df = s.createDataFrame(
+                {"f": [0.0, -0.0, float("nan"), 1.5, None]},
+                [("f", DataType.FLOAT64)])
+            return df.select(Column(
+                NormalizeNaNAndZero(df["f"].expr)).alias("n"))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q, approx_float=1e-7)
+
+    def test_float_group_keys_merge_nan_and_zero(self, session):
+        # -0.0/0.0 one group; all NaNs one group (Spark group semantics)
+        def q(s):
+            return s.createDataFrame(
+                {"f": [0.0, -0.0, float("nan"), float("nan"), 2.0],
+                 "v": [1, 2, 3, 4, 5]},
+                [("f", DataType.FLOAT64), ("v", DataType.INT64)]) \
+                .groupBy("f").agg(F.sum("v").alias("s"))
+
+        cpu = run_on_cpu(session, q)
+        assert len(cpu) == 3  # {0.0}, {nan}, {2.0}
+        sums = sorted(r[1] for r in cpu)
+        assert sums == [3, 5, 7]
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+class TestRegexpQuantifier:
+    def test_plus_quantifier_falls_back(self, session):
+        # 'a+' is NOT a literal pattern; must fall back and collapse runs
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["aaab", "b", "aa", None]},
+                [("t", DataType.STRING)]) \
+                .select(F.regexp_replace(F.col("t"), "a+", "X").alias("r"))
+
+        cpu = run_on_cpu(session, q)
+        assert [r[0] for r in cpu] == ["Xb", "b", "X", None]
+        assert_tpu_fallback_collect(session, q,
+                                    fallback_exec="CpuProjectExec")
+
+    def test_backslash_replacement_is_literal(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["ab", "xaby"]}, [("t", DataType.STRING)]) \
+                .select(F.regexp_replace(F.col("t"), "ab",
+                                         "\\n").alias("r"))
+
+        cpu = run_on_cpu(session, q)
+        assert [r[0] for r in cpu] == ["\\n", "x\\ny"]
+        assert_tpu_and_cpu_are_equal_collect(session, q)

@@ -109,6 +109,72 @@ def _worker(mode: str) -> None:
                       "best_s": min(times)}), flush=True)
 
 
+def _worker_decode(mode: str) -> None:
+    """Parquet scan throughput: device decode (raw dict/RLE bytes + jitted
+    expansion) vs host Arrow decode + upload. mode: 'dev' | 'host'."""
+    dev = _init_backend(mode)
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.plan import functions as F
+
+    n = 4 << 20
+    rng = np.random.default_rng(7)
+    path = "/tmp/srt_decode_bench.parquet"
+    if not os.path.exists(path):
+        t = pa.table({
+            "a": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+            "b": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+            "c": pa.array(rng.integers(0, 200, n).astype(np.int32)),
+        })
+        pq.write_table(t, path, compression="NONE", use_dictionary=True,
+                       data_page_version="1.0", row_group_size=1 << 19)
+    decoded_bytes = n * (8 + 8 + 4)
+    session = srt.new_session()
+    session.conf.set("rapids.tpu.sql.enabled", True)
+    session.conf.set(
+        "rapids.tpu.sql.format.parquet.deviceDecode.enabled", mode == "dev")
+
+    def q():
+        return session.read.parquet(path).agg(
+            F.sum("a").alias("sa"), F.sum("b").alias("sb"),
+            F.sum("c").alias("sc")).collect()
+
+    q()  # warmup/compile
+    _log(f"worker[{mode}]: warm, timing")
+    times = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        q()
+        times.append(time.perf_counter() - t0)
+        _log(f"worker[{mode}]: iter {i}: {times[-1]:.3f}s")
+    print(json.dumps({"mode": mode, "platform": dev.platform,
+                      "best_s": min(times),
+                      "gbps": decoded_bytes / min(times) / 1e9}), flush=True)
+
+
+def main_decode() -> None:
+    """`python bench.py --decode`: device-decode vs host-decode scan."""
+    env = dict(os.environ)
+    host = _run_phase("decode-host", env, TPU_BUDGET_S)
+    dev = _run_phase("decode-dev", env, TPU_BUDGET_S)
+    if dev is None or host is None:
+        print(json.dumps({"metric": "parquet_device_decode_gbps",
+                          "value": 0.0, "unit": "GB/s/chip",
+                          "vs_baseline": 0.0, "error": "decode bench failed"}))
+        return
+    print(json.dumps({
+        "metric": "parquet_device_decode_gbps",
+        "value": round(dev["gbps"], 4),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(host["best_s"] / dev["best_s"], 3),
+        "platform": dev["platform"],
+        "host_gbps": round(host["gbps"], 4),
+    }))
+
+
 def _worker_tpch(mode: str, sf: float) -> None:
     """TPC-H-like suite (reference: tpch/Benchmarks.scala:28-90 — loop
     queries, print wall-clock). Geomean over q1/q3/q5/q6 best-of-2."""
@@ -237,9 +303,13 @@ if __name__ == "__main__":
         if mode.startswith("tpch-"):
             _worker_tpch(mode.split("-", 1)[1],
                          float(os.environ.get("SRT_TPCH_SF", "0.01")))
+        elif mode.startswith("decode-"):
+            _worker_decode(mode.split("-", 1)[1])
         else:
             _worker(mode)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--tpch":
         main_tpch(float(sys.argv[2]) if len(sys.argv) >= 3 else 0.01)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--decode":
+        main_decode()
     else:
         main()

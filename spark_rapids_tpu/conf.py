@@ -246,6 +246,14 @@ MAX_READ_BATCH_SIZE_BYTES = _conf("rapids.tpu.sql.reader.batchSizeBytes").doc(
 # Per-format / per-feature enables (reference: RapidsConf.scala:433-469)
 # ---------------------------------------------------------------------------
 PARQUET_READ_ENABLED = _conf("rapids.tpu.sql.format.parquet.read.enabled").boolean(True)
+PARQUET_DEVICE_DECODE = _conf(
+    "rapids.tpu.sql.format.parquet.deviceDecode.enabled").doc(
+    "Decode eligible parquet columns ON the device: raw dictionary/RLE "
+    "chunk bytes upload and a jitted kernel expands runs + gathers the "
+    "dictionary (reference decodes on the accelerator the same way, "
+    "GpuParquetScan.scala:536-556). Ineligible columns/pages fall back to "
+    "the host Arrow decoder per column."
+).boolean(True)
 PARQUET_WRITE_ENABLED = _conf("rapids.tpu.sql.format.parquet.write.enabled").boolean(True)
 CSV_READ_ENABLED = _conf("rapids.tpu.sql.format.csv.read.enabled").boolean(True)
 ORC_READ_ENABLED = _conf("rapids.tpu.sql.format.orc.read.enabled").boolean(True)

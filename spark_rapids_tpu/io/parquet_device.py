@@ -1,0 +1,405 @@
+"""Device-side parquet column decode.
+
+Reference parity: the reference decodes parquet ON the accelerator —
+it reassembles a minimal in-memory file from raw column chunks on the host
+and hands the bytes to the GPU decoder (`GpuParquetScan.scala:316-458`
+host reassembly, `:536-556` device `Table.readParquet`). The TPU-native
+split keeps the same shape:
+
+- HOST (control plane, tiny): parse thrift-compact page headers and the
+  RLE/bit-packed *run tables* (a few dozen entries per page — runs, not
+  values), and locate the dictionary. No value is decoded on the host.
+- DEVICE (data plane): ONE jitted program per (shape-bucket) expands
+  definition-level runs into the validity mask, expands dictionary-index
+  runs (RLE repeats + bit-packed groups extracted straight from the raw
+  chunk bytes), and gathers the dictionary — i.e. the decode FLOPs and
+  bytes all happen on the accelerator. Upload volume is the raw
+  (dictionary-encoded) chunk, typically several times smaller than the
+  decoded column.
+
+Scope (v1): flat INT32/INT64 (+DATE/TIMESTAMP, and FLOAT32/FLOAT64 where
+the backend has f64) columns, UNCOMPRESSED codec, v1 data pages encoded
+PLAIN or RLE_DICTIONARY/PLAIN_DICTIONARY. Arrow remains the oracle and the
+fallback for everything else (per SURVEY.md section 7 hard part #2 phasing).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    ColumnVector,
+    bucket_capacity,
+    device_float64_supported,
+    physical_np_dtype,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+
+# ---------------------------------------------------------------------------
+# Thrift compact-protocol mini reader (PageHeader only)
+# ---------------------------------------------------------------------------
+class _Compact:
+    """Just enough TCompactProtocol to walk parquet PageHeader structs."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def struct(self) -> dict:
+        """Parse a struct into {field_id: value}; nested structs recurse,
+        other types reduce to ints / bytes / skipped."""
+        out = {}
+        fid = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            if b == 0:
+                return out
+            delta = b >> 4
+            ftype = b & 0x0F
+            if delta:
+                fid += delta
+            else:
+                fid = self.zigzag()
+            out[fid] = self._value(ftype)
+
+    def _value(self, ftype: int):
+        if ftype in (1, 2):          # bool true / false
+            return ftype == 1
+        if ftype == 3:               # i8
+            v = self.buf[self.pos]
+            self.pos += 1
+            return v
+        if ftype in (4, 5, 6):       # i16/i32/i64
+            return self.zigzag()
+        if ftype == 7:               # double
+            v = self.buf[self.pos:self.pos + 8]
+            self.pos += 8
+            return v
+        if ftype == 8:               # binary/string
+            n = self.varint()
+            v = self.buf[self.pos:self.pos + n]
+            self.pos += n
+            return v
+        if ftype == 9:               # list
+            b = self.buf[self.pos]
+            self.pos += 1
+            n = b >> 4
+            et = b & 0x0F
+            if n == 15:
+                n = self.varint()
+            return [self._value(et) for _ in range(n)]
+        if ftype == 12:              # struct
+            return self.struct()
+        raise ValueError(f"unsupported thrift compact type {ftype}")
+
+
+# PageHeader thrift field ids (parquet.thrift)
+_PH_TYPE = 1
+_PH_UNCOMPRESSED = 2
+_PH_COMPRESSED = 3
+_PH_DATA_V1 = 5
+_PH_DICT = 7
+# DataPageHeader fields
+_DP_NUM_VALUES = 1
+_DP_ENCODING = 2
+_DP_DEF_ENC = 3
+# DictionaryPageHeader fields
+_DI_NUM_VALUES = 1
+
+PAGE_DATA_V1 = 0
+PAGE_DICT = 2
+ENC_PLAIN = 0
+ENC_PLAIN_DICT = 2
+ENC_RLE = 3
+ENC_RLE_DICT = 8
+
+
+@dataclass
+class PageInfo:
+    kind: int            # PAGE_DATA_V1 | PAGE_DICT
+    num_values: int
+    encoding: int
+    data_start: int      # offset of page payload within the chunk bytes
+    data_len: int
+
+
+def parse_pages(chunk: bytes) -> List[PageInfo]:
+    """Walk the page headers of one raw column chunk."""
+    pages: List[PageInfo] = []
+    pos = 0
+    while pos < len(chunk):
+        r = _Compact(chunk, pos)
+        hdr = r.struct()
+        payload = r.pos
+        size = hdr[_PH_COMPRESSED]
+        kind = hdr[_PH_TYPE]
+        if kind == PAGE_DICT:
+            d = hdr[_PH_DICT]
+            pages.append(PageInfo(kind, d[_DI_NUM_VALUES], ENC_PLAIN,
+                                  payload, size))
+        elif kind == PAGE_DATA_V1:
+            d = hdr[_PH_DATA_V1]
+            pages.append(PageInfo(kind, d[_DP_NUM_VALUES], d[_DP_ENCODING],
+                                  payload, size))
+        else:  # v2 pages etc. -> caller falls back to Arrow
+            raise _Unsupported(f"page type {kind}")
+        pos = payload + size
+    return pages
+
+
+class _Unsupported(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# RLE/bit-packed hybrid run tables (host: runs only, never values)
+# ---------------------------------------------------------------------------
+@dataclass
+class RunTable:
+    """Decoded structure of one RLE/bit-packed hybrid stream: per run its
+    output range and either a repeated value or the absolute BIT offset of
+    its packed values within the chunk."""
+
+    out_start: np.ndarray   # int32 [n_runs]
+    is_rle: np.ndarray      # bool  [n_runs]
+    value: np.ndarray       # int32 [n_runs] (RLE runs)
+    bit_off: np.ndarray     # int64 [n_runs] (bit-packed runs, absolute bits)
+    total: int              # values described (>= logical count; bp pads to 8)
+
+
+def parse_runs(chunk: bytes, start: int, end: int, bit_width: int,
+               num_values: int) -> RunTable:
+    out_start: List[int] = []
+    is_rle: List[bool] = []
+    value: List[int] = []
+    bit_off: List[int] = []
+    r = _Compact(chunk, start)
+    produced = 0
+    vbytes = (bit_width + 7) // 8
+    while produced < num_values and r.pos < end:
+        header = r.varint()
+        if header & 1:  # bit-packed: (header>>1) groups of 8 values
+            groups = header >> 1
+            count = groups * 8
+            out_start.append(produced)
+            is_rle.append(False)
+            value.append(0)
+            bit_off.append(r.pos * 8)
+            r.pos += groups * bit_width
+        else:           # RLE run of (header>>1) copies of one LE value
+            count = header >> 1
+            v = int.from_bytes(chunk[r.pos:r.pos + vbytes], "little")
+            r.pos += vbytes
+            out_start.append(produced)
+            is_rle.append(True)
+            value.append(v)
+            bit_off.append(0)
+        produced += count
+    return RunTable(np.asarray(out_start, np.int32),
+                    np.asarray(is_rle, bool),
+                    np.asarray(value, np.int32),
+                    np.asarray(bit_off, np.int64),
+                    produced)
+
+
+# ---------------------------------------------------------------------------
+# Device expansion kernels
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _expand_hybrid(chunk_u8, out_start, is_rle, value, bit_off,
+                   bit_width: int, cap: int):
+    """values[j] for j in [0, cap): find j's run (searchsorted), then either
+    the run's repeated value or a bit-window extracted from the raw bytes.
+    bit_width <= 24 so a 4-byte LE gather always covers the window."""
+    j = jnp.arange(cap, dtype=jnp.int32)
+    run = jnp.clip(
+        jnp.searchsorted(out_start, j, side="right") - 1,
+        0, out_start.shape[0] - 1).astype(jnp.int32)
+    k = j - out_start[run]
+    bitpos = bit_off[run] + k.astype(jnp.int64) * bit_width
+    byte = (bitpos >> 3).astype(jnp.int32)
+    shift = (bitpos & 7).astype(jnp.int32)
+    nbytes = chunk_u8.shape[0]
+    b = jnp.zeros((cap,), dtype=jnp.uint32)
+    for o in range(4):
+        src = jnp.clip(byte + o, 0, nbytes - 1)
+        b = b | (chunk_u8[src].astype(jnp.uint32) << (8 * o))
+    mask = jnp.uint32((1 << bit_width) - 1) if bit_width < 32 else \
+        jnp.uint32(0xFFFFFFFF)
+    packed = (b >> shift.astype(jnp.uint32)) & mask
+    return jnp.where(is_rle[run], value[run].astype(jnp.uint32),
+                     packed).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _bitcast_values(chunk_u8, byte_start, count: int, np_dtype_name: str):
+    """PLAIN-encoded fixed-width values: gather + bitcast from raw bytes."""
+    dt = np.dtype(np_dtype_name)
+    w = dt.itemsize
+    idx = byte_start + jnp.arange(count * w, dtype=jnp.int32)
+    seg = chunk_u8[jnp.clip(idx, 0, chunk_u8.shape[0] - 1)]
+    return jax.lax.bitcast_convert_type(seg.reshape(count, w), jnp.dtype(dt))
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _assemble(validity, dense_vals, cap: int):
+    """Spread the dense present-values stream onto its row positions:
+    output j takes dense value #(valid-prefix-count of j) when valid."""
+    prefix = jnp.cumsum(validity.astype(jnp.int32)) - 1
+    slot = jnp.clip(prefix, 0, dense_vals.shape[0] - 1)
+    v = dense_vals[slot]
+    zero = jnp.zeros((), dtype=v.dtype)
+    return jnp.where(validity, v, zero)
+
+
+# ---------------------------------------------------------------------------
+# Column chunk decode driver
+# ---------------------------------------------------------------------------
+_PHYS_OK = {"INT32": DataType.INT32, "INT64": DataType.INT64,
+            "FLOAT": DataType.FLOAT32, "DOUBLE": DataType.FLOAT64}
+
+
+def column_eligible(col_meta, dtype: DataType) -> bool:
+    """Can this column chunk decode on device? (codec, physical type,
+    encodings; reference analog: GpuParquetScan tagging)."""
+    if col_meta.compression != "UNCOMPRESSED":
+        return False
+    if col_meta.physical_type not in _PHYS_OK:
+        return False
+    if dtype is DataType.FLOAT64 and not device_float64_supported():
+        return False
+    ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}
+    return set(col_meta.encodings) <= ok_enc
+
+
+def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
+                        max_def: int, cap: Optional[int] = None):
+    """Decode one raw column chunk into (data, validity) device arrays.
+
+    max_def: 1 for nullable columns (def levels present), 0 for required.
+    Raises _Unsupported for shapes outside the v1 scope (caller falls back
+    to the Arrow host path)."""
+    pages = parse_pages(chunk)
+    cap = cap or bucket_capacity(max(num_rows, 1))
+    npdt = physical_np_dtype(dtype)
+    chunk_dev = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
+
+    dict_vals = None
+    validity = jnp.zeros((cap,), dtype=bool)
+    dense = jnp.zeros((cap,), dtype=npdt)
+    out_row = 0
+    dense_fill = 0
+    dense_parts = []
+    valid_parts = []
+    for p in pages:
+        if p.kind == PAGE_DICT:
+            dict_vals = _bitcast_values(
+                chunk_dev, jnp.int32(p.data_start), p.num_values, npdt.name)
+            continue
+        if p.encoding not in (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT):
+            raise _Unsupported(f"data page encoding {p.encoding}")
+        pos = p.data_start
+        end = p.data_start + p.data_len
+        page_cap = bucket_capacity(max(p.num_values, 1))
+        if max_def > 0:
+            # v1 def levels: u32 length prefix + RLE hybrid, bit width 1
+            dl_len = int.from_bytes(chunk[pos:pos + 4], "little")
+            rt = parse_runs(chunk, pos + 4, pos + 4 + dl_len, 1,
+                            p.num_values)
+            page_valid = _expand_hybrid(
+                chunk_dev, jnp.asarray(rt.out_start), jnp.asarray(rt.is_rle),
+                jnp.asarray(rt.value), jnp.asarray(rt.bit_off), 1,
+                page_cap).astype(bool)
+            pos += 4 + dl_len
+        else:
+            page_valid = jnp.ones((page_cap,), dtype=bool)
+        page_valid = page_valid & (jnp.arange(page_cap) < p.num_values)
+        n_present = int(jax.device_get(jnp.sum(page_valid)))
+        if p.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+            if dict_vals is None:
+                raise _Unsupported("dictionary-encoded page before dict")
+            bit_width = chunk[pos]
+            if bit_width > 24:
+                raise _Unsupported(f"dict index bit width {bit_width}")
+            pos += 1
+            if bit_width == 0:
+                idx = jnp.zeros((page_cap,), dtype=jnp.int32)
+            else:
+                rt = parse_runs(chunk, pos, end, bit_width, n_present)
+                idx = _expand_hybrid(
+                    chunk_dev, jnp.asarray(rt.out_start),
+                    jnp.asarray(rt.is_rle), jnp.asarray(rt.value),
+                    jnp.asarray(rt.bit_off), bit_width, page_cap)
+            page_dense = dict_vals[jnp.clip(idx, 0,
+                                            dict_vals.shape[0] - 1)]
+        else:  # PLAIN
+            page_dense = _bitcast_values(chunk_dev, jnp.int32(pos),
+                                         page_cap, npdt.name)
+            # only the first n_present values are real; tail reads past the
+            # page but is masked by validity at assemble time
+        dense_parts.append((page_dense, n_present))
+        valid_parts.append((page_valid, p.num_values))
+        out_row += p.num_values
+
+    # stitch pages (single-page chunks — the common case with row-group
+    # splits — take the fast path)
+    if len(valid_parts) == 1:
+        validity = _pad_to(valid_parts[0][0], cap, False)
+        dense = _pad_to(dense_parts[0][0], cap, 0)
+    else:
+        validity = _concat_logical(
+            [(v, n) for v, n in valid_parts], cap, False)
+        dense = _concat_logical(
+            [(d, n) for d, n in dense_parts], cap, 0)
+    data = _assemble(validity, dense, cap)
+    return data, validity
+
+
+def _pad_to(arr, cap: int, fill):
+    if arr.shape[0] == cap:
+        return arr
+    if arr.shape[0] > cap:
+        return arr[:cap]
+    pad = jnp.full((cap - arr.shape[0],), fill, dtype=arr.dtype)
+    return jnp.concatenate([arr, pad])
+
+
+def _concat_logical(parts, cap: int, fill):
+    """Concatenate the first n logical elements of each part."""
+    segs = [p[:n] for p, n in parts]
+    out = jnp.concatenate(segs)
+    return _pad_to(out, cap, fill)
+
+
+def read_chunk_bytes(path: str, col_meta) -> bytes:
+    start = col_meta.dictionary_page_offset
+    if start is None or start <= 0:
+        start = col_meta.data_page_offset
+    with open(path, "rb") as f:
+        f.seek(start)
+        return f.read(col_meta.total_compressed_size)

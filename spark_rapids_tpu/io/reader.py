@@ -62,12 +62,16 @@ class DataFrameReader:
 
     # -- schema resolution ----------------------------------------------------
     def _load(self, fmt: str, paths: List[str]) -> DataFrame:
-        attrs = self._schema or self._resolve_schema(fmt, paths)
-        plan = L.FileScan(fmt, paths, attrs, dict(self._options))
+        files = None
+        if self._schema:
+            attrs = self._schema
+        else:
+            attrs, files = self._resolve_schema(fmt, paths)
+        plan = L.FileScan(fmt, paths, attrs, dict(self._options),
+                          files=files)
         return DataFrame(plan, self._session)
 
-    def _resolve_schema(self, fmt: str,
-                        paths: List[str]) -> List[AttributeReference]:
+    def _resolve_schema(self, fmt: str, paths: List[str]):
         # one directory walk serves both the file schema sample and the
         # Hive-style partition discovery (reference:
         # ColumnarPartitionReaderWithPartitionValues + Spark's inference)
@@ -81,7 +85,8 @@ class DataFrameReader:
         part_attrs = infer_partition_schema(
             [partition_values_of(f, paths) for f in files])
         names = {a.name for a in file_attrs}
-        return file_attrs + [a for a in part_attrs if a.name not in names]
+        return (file_attrs +
+                [a for a in part_attrs if a.name not in names], files)
 
     def _resolve_file_schema(self, fmt: str,
                              sample: str) -> List[AttributeReference]:

@@ -139,12 +139,12 @@ _SUFFIXES = {
 
 
 def plan_splits(fmt: str, paths: List[str], options: Dict[str, Any],
-                conf) -> List[FileSplit]:
+                conf, files: Optional[List[str]] = None) -> List[FileSplit]:
     """Split input files into read partitions. Parquet splits by row
     groups so each task reads at most maxReadBatchSizeRows rows."""
     from spark_rapids_tpu import conf as C
 
-    files = expand_paths(paths, _SUFFIXES.get(fmt, ()))
+    files = files or expand_paths(paths, _SUFFIXES.get(fmt, ()))
     opt_t = tuple(sorted(options.items()))
     pvs = {f: partition_values_of(f, paths) for f in files}
     if fmt != "parquet":
@@ -296,15 +296,28 @@ class CpuFileScanExec(_FileScanBase, CpuExec):
 
 
 class TpuFileScanExec(_FileScanBase, TpuExec):
-    """Host decode + packed upload per split, gated by the admission
-    semaphore exactly where the reference acquires it (before putting bytes
-    on the device, GpuParquetScan.scala:554)."""
+    """Parquet columns that qualify decode ON DEVICE from raw chunk bytes
+    (io/parquet_device.py — the reference's accelerator-side decode,
+    GpuParquetScan.scala:536-556); everything else host-decodes via Arrow
+    and uploads. The admission semaphore is acquired exactly where the
+    reference acquires it: before bytes go on the device
+    (GpuParquetScan.scala:554)."""
 
     placement = "tpu"
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        from spark_rapids_tpu import conf as C
+
+        device_decode = self.fmt == "parquet" and \
+            ctx.conf.get(C.PARQUET_DEVICE_DECODE)
+
         def factory(pidx: int):
             def gen():
+                if device_decode:
+                    batches = self._read_device(self.splits[pidx], ctx.conf)
+                    if batches is not None:
+                        yield from batches
+                        return
                 for hb in self._read_host(pidx, ctx.conf):
                     TpuSemaphore.get().acquire_if_necessary(current_task_id())
                     yield hb.to_device()
@@ -312,3 +325,87 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
             return count_output(self.metrics, gen())
 
         return PartitionedBatches(len(self.splits), factory)
+
+    def _read_device(self, split: FileSplit, conf):
+        """Device decode for one split; None -> no column qualified (caller
+        uses the host path). Mixed batches combine device-decoded columns
+        with host-decoded/partition-value columns at the same capacity."""
+        import pyarrow.parquet as pq
+
+        from spark_rapids_tpu import conf as C2
+        from spark_rapids_tpu.columnar.batch import (
+            ColumnarBatch,
+            ColumnVector,
+            bucket_capacity,
+        )
+        from spark_rapids_tpu.io import parquet_device as PD
+        from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
+
+        pf = pq.ParquetFile(split.path)
+        md = pf.metadata
+        pv = dict(split.partition_values)
+        schema_index = {md.row_group(0).column(ci).path_in_schema: ci
+                        for ci in range(md.num_columns)}
+        # required columns carry NO definition levels in v1 data pages —
+        # max_def must match or the value stream is misparsed
+        max_def = {pf.schema.column(ci).name:
+                   pf.schema.column(ci).max_definition_level
+                   for ci in range(len(pf.schema.names))}
+        data_attrs = [a for a in self.attrs if a.name not in pv]
+        eligible = []
+        for a in data_attrs:
+            ci = schema_index.get(a.name)
+            if ci is not None and PD.column_eligible(
+                    md.row_group(0).column(ci), a.data_type):
+                eligible.append(a)
+        if not eligible:
+            return None
+        groups = list(split.row_groups) if split.row_groups is not None \
+            else list(range(md.num_row_groups))
+        rest = [a for a in data_attrs if a not in eligible]
+        out = []
+        for rg in groups:
+            rows = md.row_group(rg).num_rows
+            cap = bucket_capacity(max(rows, 1))
+            TpuSemaphore.get().acquire_if_necessary(current_task_id())
+            dev_cols = {}
+            for a in eligible:
+                col = md.row_group(rg).column(schema_index[a.name])
+                chunk = PD.read_chunk_bytes(split.path, col)
+                try:
+                    data, validity = PD.decode_chunk_device(
+                        chunk, a.data_type, rows,
+                        max_def=max_def.get(a.name, 1), cap=cap)
+                except Exception:
+                    return None  # unexpected page shape: whole-split fallback
+                dev_cols[a.name] = ColumnVector(a.data_type, data, validity)
+            host_part = None
+            if rest or pv:
+                sub = FileSplit(split.path, "parquet", (rg,), split.options,
+                                split.partition_values)
+                table = read_split(sub, rest)
+                hb = arrow_to_host_batch(table, rest)
+                if pv:
+                    hb = _with_partition_columns(
+                        hb, rest + [a for a in self.attrs if a.name in pv],
+                        pv)
+                host_part = hb.to_device()
+                host_names = [a.name for a in rest] + \
+                    [a.name for a in self.attrs if a.name in pv]
+            cols = []
+            for a in self.attrs:
+                if a.name in dev_cols:
+                    cols.append(dev_cols[a.name])
+                else:
+                    cv = host_part.columns[host_names.index(a.name)]
+                    cols.append(cv)
+            batch = ColumnarBatch(cols, rows)
+            max_rows = conf.get(C2.MAX_READ_BATCH_SIZE_ROWS)
+            if rows <= max_rows:
+                out.append(batch)
+            else:
+                from spark_rapids_tpu.columnar.batch import slice_batch_host
+
+                out.extend(slice_batch_host(batch, i, max_rows)
+                           for i in range(0, rows, max_rows))
+        return out

@@ -226,6 +226,33 @@ class StringTrimRight(StringTrim):
     _side = "right"
 
 
+def _java_replacement_to_python(repl: str) -> str:
+    """Translate a Java Matcher.replaceAll replacement to a python re
+    template: $N -> \\g<N>, backslash-escaped char -> that literal char."""
+    out = []
+    i = 0
+    n = len(repl)
+    while i < n:
+        ch = repl[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = repl[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+        elif ch == "$" and i + 1 < n and repl[i + 1].isdigit():
+            j = i + 1
+            while j < n and repl[j].isdigit():
+                j += 1
+            out.append(f"\\g<{repl[i + 1:j]}>")
+            i = j
+        elif ch == "\\":
+            out.append("\\\\")  # trailing backslash: Java errors; keep literal
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
 class _ScalarArgsTernary(TernaryExpression):
     """Ternary whose 2nd/3rd operands are scalar 'needle' arguments that
     must STAY scalars (the base TernaryExpression lifts string scalars to
@@ -289,6 +316,10 @@ class StringReplace(_ScalarArgsTernary):
 
     def do_columnar(self, ctx, sv, fv, rv):
         assert isinstance(fv, ScalarV) and isinstance(rv, ScalarV)
+        if fv.value == "":
+            # Spark: empty search leaves the string unchanged (python's
+            # str.replace would interleave the replacement everywhere)
+            return sv
         if ctx.is_device:
             from spark_rapids_tpu.columnar import strings as S
 
@@ -327,9 +358,14 @@ class RegExpReplace(_ScalarArgsTernary):
         import re
 
         pat = re.compile(pv.value)
-        # literal replacement (no backslash/group expansion), matching the
-        # device path; group references in the replacement are unsupported
         repl = rv.value
+        if "$" in repl or "\\" in repl:
+            # Java Matcher.replaceAll semantics (Spark): $N = group ref,
+            # backslash escapes the next char to a literal. The meta layer
+            # keeps such replacements OFF the device, so this only runs on
+            # the CPU oracle.
+            py_repl = _java_replacement_to_python(repl)
+            return _obj(lambda s: pat.sub(py_repl, s), sv.data)
         return _obj(lambda s: pat.sub(lambda _m: repl, s), sv.data)
 
 

@@ -104,12 +104,16 @@ class FileScan(LogicalPlan):
 
     def __init__(self, fmt: str, paths: List[str],
                  schema: Optional[List[AttributeReference]],
-                 options: Optional[Dict[str, Any]] = None):
+                 options: Optional[Dict[str, Any]] = None,
+                 files: Optional[List[str]] = None):
         super().__init__()
         self.fmt = fmt
         self.paths = paths
         self.schema = schema  # resolved lazily by the session if None
         self.options = dict(options or {})
+        # file list already discovered during schema resolution (avoids a
+        # second directory walk at planning time)
+        self.files = files
 
     @property
     def output(self):

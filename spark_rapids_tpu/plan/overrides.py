@@ -147,10 +147,11 @@ def _register_expr_rules():
         from spark_rapids_tpu.columnar.strings import has_border
 
         find = _literal_value(m.expr.children()[1])
-        if not isinstance(find, str) or find == "":
-            m.will_not_work("replace needs a non-empty literal search string")
+        if not isinstance(find, str):
+            m.will_not_work("replace needs a literal search string")
         elif len(find.encode("utf-8")) > 1 and \
                 has_border(find.encode("utf-8")):
+            # empty search is identity on device (Spark semantics)
             m.will_not_work(
                 "device replace requires a self-overlap-free search string "
                 f"({find!r} can overlap itself)")
@@ -160,6 +161,13 @@ def _register_expr_rules():
     def _tag_regexp_replace(m):
         from spark_rapids_tpu.columnar.strings import has_border
 
+        repl = _literal_value(m.expr.children()[2])
+        if isinstance(repl, str) and ("$" in repl or "\\" in repl):
+            # Java-style $N group refs / escapes in the replacement: the
+            # device kernel inserts literally, so keep these on the CPU
+            m.will_not_work(
+                "regexp replacement with $-references or escapes runs on "
+                "the CPU (device replacement is literal)")
         pat = _literal_value(m.expr.children()[1])
         if not isinstance(pat, str) or pat == "":
             m.will_not_work(

@@ -176,7 +176,8 @@ def _plan_repartition(plan: L.Repartition, conf: C.TpuConf) -> PhysicalExec:
 def _plan_file_scan(plan: L.FileScan, conf: C.TpuConf) -> PhysicalExec:
     from spark_rapids_tpu.io.scan import CpuFileScanExec, plan_splits
 
-    splits = plan_splits(plan.fmt, plan.paths, plan.options, conf)
+    splits = plan_splits(plan.fmt, plan.paths, plan.options, conf,
+                         files=plan.files)
     return CpuFileScanExec(plan.output, splits, plan.fmt)
 
 

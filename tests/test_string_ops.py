@@ -178,13 +178,29 @@ class TestRegexpQuantifier:
         assert_tpu_fallback_collect(session, q,
                                     fallback_exec="CpuProjectExec")
 
-    def test_backslash_replacement_is_literal(self, session):
+    def test_java_replacement_semantics_fall_back(self, session):
+        # backslash-escape and $N group refs follow Java replaceAll and run
+        # on the CPU (device replacement is literal only)
         def q(s):
             return s.createDataFrame(
                 {"t": ["ab", "xaby"]}, [("t", DataType.STRING)]) \
                 .select(F.regexp_replace(F.col("t"), "ab",
-                                         "\\n").alias("r"))
+                                         "\\n").alias("r"),
+                        F.regexp_replace(F.col("t"), "(a)(b)",
+                                         "$2$1").alias("g"))
 
         cpu = run_on_cpu(session, q)
-        assert [r[0] for r in cpu] == ["\\n", "x\\ny"]
+        assert [r[0] for r in cpu] == ["n", "xny"]       # \n -> literal n
+        assert [r[1] for r in cpu] == ["ba", "xbay"]     # group swap
+        assert_tpu_fallback_collect(session, q,
+                                    fallback_exec="CpuProjectExec")
+
+    def test_empty_search_is_identity(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["ab", "", None]}, [("t", DataType.STRING)]) \
+                .select(F.replace(F.col("t"), "", "X").alias("r"))
+
+        cpu = run_on_cpu(session, q)
+        assert [r[0] for r in cpu] == ["ab", "", None]
         assert_tpu_and_cpu_are_equal_collect(session, q)

@@ -6,9 +6,13 @@ releases the TPU admission semaphore on completion, mirroring the
 completion-listener auto-release in GpuSemaphore.scala:101-161.
 
 Task failure behavior mirrors Spark's retry loop (reference: Spark task
-retry + lineage is the reference's whole failure story, SURVEY.md section 5):
-a failed partition task is retried up to `max_failures` times before the
-job fails.
+retry + lineage is the reference's whole failure story, SURVEY.md section 5),
+with the reference's failure taxonomy: shuffle-fetch failures
+(`FetchFailedError`, the RapidsShuffleFetchFailedException analog,
+shuffle/RapidsShuffleIterator.scala:237-330) and transient runtime errors
+retry up to `max_failures`; DETERMINISTIC errors (planning/type/user
+errors) fail fast on the first attempt — retrying them only doubles the
+cost of every real failure.
 """
 
 from __future__ import annotations
@@ -32,6 +36,28 @@ class TaskFailedError(RuntimeError):
             f"partition task {pidx} failed after {attempts} attempts: {cause!r}")
         self.pidx = pidx
         self.cause = cause
+
+
+class FetchFailedError(RuntimeError):
+    """A shuffle piece could not be materialized (reference:
+    RapidsShuffleFetchFailedException -> Spark stage retry). Always
+    retryable."""
+
+
+# deterministic failure classes: retrying cannot change the outcome
+_NON_RETRYABLE = (TypeError, ValueError, AssertionError, NotImplementedError,
+                  KeyError, IndexError, AttributeError, ZeroDivisionError)
+
+
+def _is_retryable(e: BaseException) -> bool:
+    if isinstance(e, FetchFailedError):
+        return True
+    if isinstance(e, _NON_RETRYABLE):
+        return False
+    # plan/analysis errors are deterministic wherever they're defined
+    if type(e).__name__ == "AnalysisError":
+        return False
+    return True
 
 
 class TaskScheduler:
@@ -58,7 +84,7 @@ class TaskScheduler:
     # -- the task wrapper ----------------------------------------------------
     def _run_task(self, pidx: int, fn: Callable[[int], T]) -> T:
         last: Optional[BaseException] = None
-        for _attempt in range(self.max_failures):
+        for attempt in range(self.max_failures):
             with _next_task_id_lock:
                 task_id = next(_next_task_id)
             set_task_id(task_id)
@@ -70,7 +96,9 @@ class TaskScheduler:
                 # completion-listener analog: always drop the semaphore
                 TpuSemaphore.get().release_if_necessary(task_id)
                 set_task_id(None)
-        raise TaskFailedError(pidx, self.max_failures, last)
+            if not _is_retryable(last):
+                raise TaskFailedError(pidx, attempt + 1, last) from last
+        raise TaskFailedError(pidx, self.max_failures, last) from last
 
     def run_job(self, num_partitions: int,
                 fn: Callable[[int], T]) -> List[T]:

@@ -15,8 +15,10 @@ Reference parity:
 TPU design: groupby = group-id assignment (sort + neighbor-diff prefix sum)
 followed by `jax.ops.segment_*` reductions — the XLA-native composition —
 instead of cudf's hash-based groupby. One jitted program per (expression
-fingerprint, capacity bucket) covers eval + grouping + every reduction; the
-only host sync per batch is the group count.
+fingerprint, capacity bucket) covers eval + grouping + every reduction; host
+syncs per batch are the group count plus, when a string min/max aggregate is
+present, one max-string-length read that sizes the static chunk count of the
+arg-extreme reduction.
 """
 
 from __future__ import annotations
@@ -235,13 +237,14 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
 
     # -- jitted kernels (cached process-wide by semantic identity) -----------
     def _build_update_kernel(self, input_attrs, key_exprs, input_exprs,
-                             op_names, filters, lazy: bool):
+                             op_names, filters, lazy: bool,
+                             n_chunks: int = 0):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
         bound_keys = bind_all(key_exprs, input_attrs)
         bound_inputs = bind_all(input_exprs, input_attrs)
         bound_filters = bind_all(filters, input_attrs)
-        key = ("agg_update", lazy,
+        key = ("agg_update", lazy, n_chunks,
                tuple(e.fingerprint() for e in bound_keys),
                tuple(zip(op_names,
                          (e.fingerprint() for e in bound_inputs))),
@@ -274,10 +277,17 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 gi = _group_info_masked(key_cols, live, capacity)
                 buf_outs = []
                 for op, cv in zip(op_names, in_cols):
-                    data, validity = RK.segment_reduce(
-                        op, cv.data, cv.validity & live, gi.gid, num_rows,
-                        capacity)
-                    buf_outs.append((data, validity))
+                    if cv.dtype.is_string and op in ("min", "max"):
+                        sel = RK.segment_arg_extreme_string(
+                            cv, cv.validity & live, gi.gid, capacity,
+                            n_chunks, want_min=(op == "min"))
+                        buf_outs.append(
+                            (sel, cv.data, cv.offsets, cv.validity))
+                    else:
+                        data, validity = RK.segment_reduce(
+                            op, cv.data, cv.validity & live, gi.gid,
+                            num_rows, capacity)
+                        buf_outs.append((data, validity))
                 if lazy:
                     return (_assemble_traced(key_cols, buf_outs, gi,
                                              capacity, buffer_npdts),
@@ -301,11 +311,12 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             cols.append(ColumnVector(attr.data_type, data, validity))
         return ColumnarBatch(cols, num_groups)
 
-    def _build_merge_kernel(self, n_keys: int, lazy: bool):
+    def _build_merge_kernel(self, n_keys: int, lazy: bool,
+                            n_chunks: int = 0):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
         ops = [op for op, _ in self._merge_ops()]
-        key = ("agg_merge", lazy, n_keys, tuple(ops),
+        key = ("agg_merge", lazy, n_keys, n_chunks, tuple(ops),
                tuple(a.data_type for a in self._inter_attrs))
         buffer_npdts = tuple(physical_np_dtype(a.data_type)
                              for a in self.buffer_attrs)
@@ -318,6 +329,13 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 gi = _group_info(key_cols, num_rows, capacity)
                 buf_outs = []
                 for op, cv in zip(ops, buf_cols):
+                    if cv.dtype.is_string and op in ("min", "max"):
+                        sel = RK.segment_arg_extreme_string(
+                            cv, cv.validity, gi.gid, capacity,
+                            n_chunks, want_min=(op == "min"))
+                        buf_outs.append(
+                            (sel, cv.data, cv.offsets, cv.validity))
+                        continue
                     data, validity = RK.segment_reduce(
                         op, cv.data, cv.validity, gi.gid, num_rows, capacity)
                     buf_outs.append((data, validity))
@@ -341,7 +359,18 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         out_cap = gathered.capacity if gathered.columns else \
             bucket_capacity(max(n_groups, 1))
         cols = list(gathered.columns)
-        for (data, validity), battr in zip(buf_outs, self.buffer_attrs):
+        for out, battr in zip(buf_outs, self.buffer_attrs):
+            if len(out) == 4:
+                # string min/max: (arg-row per group, source string col) —
+                # gather the winning row's string per group
+                sel, src_data, src_offsets, src_validity = out
+                src = ColumnarBatch(
+                    [ColumnVector(DataType.STRING, src_data, src_validity,
+                                  src_offsets)], capacity)
+                g = gather_batch(src, sel, n_groups)
+                cols.append(g.columns[0])
+                continue
+            data, validity = out
             d = data[:out_cap]
             v = validity[:out_cap] & (jnp.arange(out_cap) < n_groups)
             npdt = physical_np_dtype(battr.data_type)
@@ -359,19 +388,45 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         input_exprs = [e for _, e, _ in ops]
         op_names = [op for op, _, _ in ops]
         filters: List[Expression] = []
+        str_agg_idx = [i for i, (op, _e, dt) in enumerate(ops)
+                       if dt is DataType.STRING and op in ("min", "max")]
         if do_update:
             n_in = len(key_exprs)
-            scan, rewritten, filters = _collapse_scan_chain(
+            scan, rewritten, new_filters = _collapse_scan_chain(
                 child, list(key_exprs) + list(input_exprs))
-            if scan is not child:
+            collapsed_inputs = rewritten[n_in:]
+            # string min/max needs a statically-bounded max length, which is
+            # only derivable for plain column inputs — skip the collapse if
+            # it substituted a computed expression there
+            if scan is not child and all(
+                    isinstance(collapsed_inputs[i], AttributeReference)
+                    for i in str_agg_idx):
                 child = scan
                 key_exprs = rewritten[:n_in]
-                input_exprs = rewritten[n_in:]
+                input_exprs = collapsed_inputs
+                filters = new_filters
         child_pb = child.execute(ctx)
         child_attrs = child.output
         update_kernel = [None]
         merge_kernel = [None]
         n_keys = len(self.grouping)
+        # input/buffer column positions feeding string min/max (for the
+        # per-batch chunk-count bound)
+        str_update_ords = []
+        for i in str_agg_idx:
+            e = input_exprs[i]
+            if isinstance(e, AttributeReference):
+                for ci, a in enumerate(child_attrs):
+                    if a.expr_id == e.expr_id:
+                        str_update_ords.append(ci)
+                        break
+        str_merge_ords = [n_keys + i for i in str_agg_idx]
+
+        def str_chunks(batch: ColumnarBatch, ordinals) -> int:
+            if not ordinals:
+                return 0
+            return max(RK.string_chunks_needed(batch.columns[ci])
+                       for ci in ordinals)
         # The update (partial) stage compacts with a row-count sync: group
         # counts are usually a tiny fraction of input rows, and shrinking
         # capacities 100x+ here makes everything downstream (shuffle concat,
@@ -384,10 +439,12 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             return jnp.asarray(b.num_rows, dtype=jnp.int32)
 
         def merge(batch: ColumnarBatch) -> ColumnarBatch:
-            if merge_kernel[0] is None:
-                merge_kernel[0] = self._build_merge_kernel(n_keys, lazy)
+            nc = str_chunks(batch, str_merge_ords)
+            if merge_kernel[0] is None or merge_kernel[0][0] != nc:
+                merge_kernel[0] = (
+                    nc, self._build_merge_kernel(n_keys, lazy, nc))
             cols = [_col_to_colv(c) for c in batch.columns]
-            out = merge_kernel[0](cols, count_arg(batch))
+            out = merge_kernel[0][1](cols, count_arg(batch))
             if lazy:
                 outs, num_groups = out
                 return self._lazy_batch(outs, num_groups)
@@ -403,14 +460,15 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     continue
                 batch = ensure_compact(batch)
                 if do_update:
-                    if update_kernel[0] is None:
-                        update_kernel[0] = self._build_update_kernel(
+                    nc = str_chunks(batch, str_update_ords)
+                    if update_kernel[0] is None or update_kernel[0][0] != nc:
+                        update_kernel[0] = (nc, self._build_update_kernel(
                             child_attrs, key_exprs, input_exprs, op_names,
-                            filters, update_lazy)
+                            filters, update_lazy, nc))
                     cols = [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
-                    out = update_kernel[0](cols, count_arg(batch))
+                    out = update_kernel[0][1](cols, count_arg(batch))
                     if update_lazy:
                         outs, num_groups = out
                         local = self._lazy_batch(outs, num_groups)

@@ -103,18 +103,24 @@ def string_order_proxy(col: ColV, n_chunks: int) -> KeyProxy:
     bound outside jit and pass it as a static arg (the cudf device string
     comparator this replaces: reference GpuSortExec via Table.orderBy,
     GpuSortExec.scala:100-235)."""
+    lens = col.offsets[1:] - col.offsets[:-1]
+    arrays = [jnp.where(col.validity, c, jnp.uint64(0))
+              for c in _string_chunk_keys(col, n_chunks)]
+    arrays.append(jnp.where(col.validity, lens, 0))
+    return KeyProxy(tuple(arrays), ~col.validity, True)
+
+
+def _string_chunk_keys(col: ColV, n_chunks: int):
+    """The shared big-endian uint64 byte-chunk extraction used by both the
+    sort proxy and the aggregate arg-extreme reduction."""
     from spark_rapids_tpu.columnar import strings as STR
 
     starts = col.offsets[:-1]
     lens = col.offsets[1:] - col.offsets[:-1]
-    arrays = []
     for c in range(n_chunks):
         off = 8 * c
-        chunk = STR._chunk_u64(col.data, starts + off,
-                               jnp.maximum(lens - off, 0))
-        arrays.append(jnp.where(col.validity, chunk, jnp.uint64(0)))
-    arrays.append(jnp.where(col.validity, lens, 0))
-    return KeyProxy(tuple(arrays), ~col.validity, True)
+        yield STR._chunk_u64(col.data, starts + off,
+                             jnp.maximum(lens - off, 0))
 
 
 def string_chunks_needed(col_or_lens) -> int:
@@ -127,6 +133,43 @@ def string_chunks_needed(col_or_lens) -> int:
     max_len = int(jax.device_get(jnp.max(jnp.maximum(lens, 0))))
     chunks = max(1, -(-max_len // 8))
     return 1 << (chunks - 1).bit_length()  # pow2 bucket bounds recompiles
+
+
+def segment_arg_extreme_string(col: ColV, validity, gid, capacity: int,
+                               n_chunks: int, want_min: bool):
+    """Per-group ROW INDEX of the lexicographically min/max string
+    (null-skipping, SQL min/max semantics). Iterative refinement: keep the
+    rows extreme on chunk 0, then among those chunk 1, ..., then the length
+    tie-break — n_chunks+1 segment reductions total, all fused by XLA.
+    Returns sel_pos int32 [capacity], clamped to == capacity when the group
+    has no non-null row, for a string gather by the caller (the cudf groupby
+    min/max-on-strings this replaces; reference AggregateFunctions.scala)."""
+    mask = validity & (gid < capacity)
+    lens = col.offsets[1:] - col.offsets[:-1]
+    U64MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+    def refine(mask, key, top, bot):
+        seg = jnp.where(mask, gid, capacity)
+        if want_min:
+            best = jax.ops.segment_min(jnp.where(mask, key, top), seg,
+                                       num_segments=capacity)
+        else:
+            best = jax.ops.segment_max(jnp.where(mask, key, bot), seg,
+                                       num_segments=capacity)
+        safe_g = jnp.clip(gid, 0, capacity - 1)
+        return mask & (key == best[safe_g])
+
+    for chunk in _string_chunk_keys(col, n_chunks):
+        mask = refine(mask, chunk, U64MAX, jnp.uint64(0))
+    mask = refine(mask, lens.astype(jnp.int32), jnp.int32(1 << 30),
+                  jnp.int32(-1))
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    seg = jnp.where(mask, gid, capacity)
+    sel = jax.ops.segment_min(jnp.where(mask, pos, capacity), seg,
+                              num_segments=capacity)
+    # empty segments get segment_min's int32-max identity; normalize to the
+    # documented `capacity` sentinel
+    return jnp.minimum(sel, capacity)
 
 
 def _invert_order(arr):

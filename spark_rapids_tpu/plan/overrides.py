@@ -278,12 +278,19 @@ def _tag_agg(m: ExprMeta) -> None:
                 "float aggregation order differs from CPU; set "
                 "rapids.tpu.sql.variableFloatAgg.enabled=true")
     if e.child.data_type is DataType.STRING and not isinstance(e, AGG.Count):
-        # device segment reductions operate on fixed-width lanes; string
-        # min/max additionally needs device string ordering (Count only
-        # reads the validity mask, so it stays on the TPU)
-        m.will_not_work(
-            "aggregates over STRING inputs run on the CPU engine "
-            "(no device string reduction yet)")
+        from spark_rapids_tpu.ops.base import AttributeReference
+
+        if isinstance(e, (AGG.Min, AGG.Max)) and \
+                isinstance(e.child, AttributeReference):
+            # device string min/max via chunked-u64 arg-extreme reduction
+            # (rowkeys.segment_arg_extreme_string); computed string inputs
+            # need a length bound unknown outside jit -> CPU
+            pass
+        else:
+            m.will_not_work(
+                "this aggregate over STRING inputs runs on the CPU engine "
+                "(device string reductions cover min/max of plain columns "
+                "and count)")
     _tag_f64_on_tpu(m)
 
 

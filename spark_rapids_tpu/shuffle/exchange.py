@@ -209,9 +209,16 @@ class _SerializedPiece:
 
     def decode(self, to_device: bool):
         from spark_rapids_tpu.columnar.serde import deserialize_batch
+        from spark_rapids_tpu.engine.scheduler import FetchFailedError
 
-        data = self._data if self._data is not None else \
-            self._fw.read_bytes(self._buf)
+        try:
+            data = self._data if self._data is not None else \
+                self._fw.read_bytes(self._buf)
+        except (OSError, KeyError, RuntimeError) as e:
+            # a spilled shuffle piece could not be read back — surface as a
+            # retryable fetch failure (reference:
+            # RapidsShuffleFetchFailedException -> Spark stage retry)
+            raise FetchFailedError(f"shuffle piece unavailable: {e}") from e
         host = deserialize_batch(data)
         if not to_device:
             return host

@@ -130,16 +130,14 @@ def test_dataframe_count_action(session):
     assert cpu == tpu == [(46,)]
 
 
-def test_string_min_max_falls_back(session):
-    from tests.harness import assert_tpu_fallback_collect
-
-    assert_tpu_fallback_collect(
+def test_string_min_max_on_device(session):
+    # string min/max now runs ON DEVICE (arg-extreme over chunked u64 keys)
+    assert_tpu_and_cpu_are_equal_collect(
         session,
         lambda s: gen_df(s, [("k", IntGen(DataType.INT32, lo=0, hi=6)),
                              ("v", StringGen(max_len=5))], n=120)
         .groupBy("k").agg(F.min("v").alias("lo"), F.max("v").alias("hi"),
                           F.count("v").alias("c")),
-        fallback_exec="CpuHashAggregateExec",
         ignore_order=True)
 
 
@@ -155,3 +153,71 @@ def test_groupby_double_key_exact(session):
             [("k", "double"), ("v", "long")])
         .groupBy("k").agg(F.count("v").alias("c")),
         ignore_order=True)
+
+
+class TestStringMinMax:
+    """Device string min/max via chunked-u64 arg-extreme reduction
+    (rowkeys.segment_arg_extreme_string; reference: cudf groupby min/max on
+    strings, AggregateFunctions.scala)."""
+
+    def test_grouped_string_min_max(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=8)),
+                                 ("t", StringGen(max_len=10))],
+                             n=400, num_partitions=3)
+            .groupBy("k").agg(F.min("t").alias("mn"),
+                              F.max("t").alias("mx"),
+                              F.count("t").alias("c")),
+            ignore_order=True)
+
+    def test_ungrouped_string_min_max(self, session):
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("t", StringGen(max_len=20))], n=150)
+            .agg(F.min("t").alias("mn"), F.max("t").alias("mx")))
+
+    def test_string_min_max_prefix_ties_and_nulls(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"k": [1, 1, 1, 2, 2, 3],
+                 "t": ["abcdefghij", "abcdefghi", "abcdefghija",
+                       None, "z", None]},
+                [("k", DataType.INT64), ("t", DataType.STRING)]) \
+                .groupBy("k").agg(F.min("t").alias("mn"),
+                                  F.max("t").alias("mx"))
+
+        from tests.harness import run_on_cpu
+
+        cpu = sorted(run_on_cpu(session, q))
+        assert cpu == [(1, "abcdefghi", "abcdefghija"),
+                       (2, "z", "z"), (3, None, None)]
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+    def test_computed_string_input_falls_back(self, session):
+        from tests.harness import assert_tpu_fallback_collect
+
+        assert_tpu_fallback_collect(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=4)),
+                                 ("t", StringGen(max_len=6))], n=100)
+            .groupBy("k").agg(F.min(F.concat(F.col("t"),
+                                             F.col("t"))).alias("m")),
+            fallback_exec="CpuHashAggregateExec",
+            ignore_order=True,
+            extra_conf={"rapids.tpu.sql.test.allowedNonTpu":
+                        "CpuHashAggregateExec,CpuShuffleExchangeExec,"
+                        "CpuCoalesceBatchesExec"})
+
+    def test_string_min_through_projected_scan(self, session):
+        # scan-chain collapse must not substitute a computed string into
+        # the min input (the collapse guard)
+        def q(s):
+            df = gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=4)),
+                            ("a", StringGen(max_len=4)),
+                            ("b", StringGen(max_len=4))], n=120)
+            df2 = df.select("k", F.concat(F.col("a"),
+                                          F.col("b")).alias("c"))
+            return df2.groupBy("k").agg(F.min("c").alias("m"))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)

@@ -1,0 +1,74 @@
+"""Task scheduler retry taxonomy tests (reference: Spark task retry +
+RapidsShuffleFetchFailedException -> stage retry,
+shuffle/RapidsShuffleIterator.scala:237-330)."""
+
+import pytest
+
+from spark_rapids_tpu.engine.scheduler import (
+    FetchFailedError,
+    TaskFailedError,
+    TaskScheduler,
+)
+
+
+@pytest.fixture()
+def sched():
+    s = TaskScheduler(num_threads=2, max_failures=3)
+    yield s
+    s.shutdown()
+
+
+def test_deterministic_error_fails_fast(sched):
+    calls = []
+
+    def fn(p):
+        calls.append(p)
+        raise TypeError("bad expression")
+
+    with pytest.raises(TaskFailedError) as ei:
+        sched.run_job(1, fn)
+    assert len(calls) == 1  # NOT retried
+    assert isinstance(ei.value.cause, TypeError)
+
+
+def test_transient_error_retries(sched):
+    calls = []
+
+    def fn(p):
+        calls.append(p)
+        raise RuntimeError("transient runtime hiccup")
+
+    with pytest.raises(TaskFailedError):
+        sched.run_job(1, fn)
+    assert len(calls) == 3  # max_failures attempts
+
+
+def test_fetch_failure_retries_and_recovers(sched):
+    attempts = []
+
+    def fn(p):
+        attempts.append(p)
+        if len(attempts) < 2:
+            raise FetchFailedError("piece gone")
+        return "ok"
+
+    assert sched.run_job(1, fn) == ["ok"]
+    assert len(attempts) == 2
+
+
+def test_analysis_error_fails_fast(sched):
+    from spark_rapids_tpu.plan.dataframe import AnalysisError
+
+    calls = []
+
+    def fn(p):
+        calls.append(p)
+        raise AnalysisError("unresolved column")
+
+    with pytest.raises(TaskFailedError):
+        sched.run_job(1, fn)
+    assert len(calls) == 1
+
+
+def test_success_path_unchanged(sched):
+    assert sched.run_job(4, lambda p: p * p) == [0, 1, 4, 9]

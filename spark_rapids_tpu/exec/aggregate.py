@@ -15,10 +15,10 @@ Reference parity:
 TPU design: groupby = group-id assignment (sort + neighbor-diff prefix sum)
 followed by `jax.ops.segment_*` reductions — the XLA-native composition —
 instead of cudf's hash-based groupby. One jitted program per (expression
-fingerprint, capacity bucket) covers eval + grouping + every reduction; host
-syncs per batch are the group count plus, when a string min/max aggregate is
-present, one max-string-length read that sizes the static chunk count of the
-arg-extreme reduction.
+fingerprint, capacity bucket) covers eval + grouping + every reduction. Host
+syncs per batch: the group count; with a string min/max aggregate, also a
+max-string-length read (sizes the static chunk count) and the string
+gather's byte-total read in _assemble.
 """
 
 from __future__ import annotations
@@ -397,7 +397,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             collapsed_inputs = rewritten[n_in:]
             # string min/max needs a statically-bounded max length, which is
             # only derivable for plain column inputs — skip the collapse if
-            # it substituted a computed expression there
+            # it substituted a computed expression there. This abandons the
+            # fusion for the whole chain (filters + other aggs included);
+            # a finer guard could stop the walk at the offending project,
+            # but computed-string agg inputs over collapsible chains are
+            # rare enough that the simple rule wins on maintainability.
             if scan is not child and all(
                     isinstance(collapsed_inputs[i], AttributeReference)
                     for i in str_agg_idx):

@@ -194,6 +194,51 @@ class RunTable:
 
 def parse_runs(chunk: bytes, start: int, end: int, bit_width: int,
                num_values: int) -> RunTable:
+    """Run-table extraction; uses the native kernel
+    (native/srt_native.cpp srt_parse_runs) when built, else pure Python."""
+    native = _parse_runs_native(chunk, start, end, bit_width, num_values)
+    if native is not None:
+        return native
+    return _parse_runs_py(chunk, start, end, bit_width, num_values)
+
+
+def _parse_runs_native(chunk: bytes, start: int, end: int, bit_width: int,
+                       num_values: int) -> Optional[RunTable]:
+    import ctypes
+
+    from spark_rapids_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    # start small (typical streams have few runs); grow on overflow up to
+    # the worst case of one RLE header per value
+    max_runs = min(max(64, num_values // 64), num_values + 1)
+    while True:
+        out_start = np.empty(max_runs, np.int64)
+        is_rle = np.empty(max_runs, np.uint8)
+        value = np.empty(max_runs, np.int32)
+        bit_off = np.empty(max_runs, np.int64)
+        produced = ctypes.c_int64(0)
+        n = lib.srt_parse_runs(
+            chunk, start, end, bit_width, num_values,
+            out_start.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            is_rle.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            value.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            bit_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_runs, ctypes.byref(produced))
+        if n == -1 and max_runs <= num_values:
+            max_runs = min(max_runs * 8, num_values + 1)
+            continue
+        if n < 0:
+            return None
+        return RunTable(out_start[:n].astype(np.int32),
+                        is_rle[:n].astype(bool),
+                        value[:n], bit_off[:n], produced.value)
+
+
+def _parse_runs_py(chunk: bytes, start: int, end: int, bit_width: int,
+                   num_values: int) -> RunTable:
     out_start: List[int] = []
     is_rle: List[bool] = []
     value: List[int] = []

@@ -1,0 +1,80 @@
+// Native host-side control-plane kernels.
+//
+// Reference parity: the reference's host control plane is C++/JVM-native
+// (parquet-mr page walking + cudf's C++ RLE machinery feeding the GPU
+// decoder, GpuParquetScan.scala:316-458). Here the TPU framework keeps the
+// same split: the device data plane is XLA, and these byte-level host loops
+// — RLE/bit-packed run-table extraction and serialized-batch string offset
+// encoding — run natively instead of interpreting bytes in Python.
+//
+// Built as a plain shared object; Python binds via ctypes
+// (spark_rapids_tpu/native/__init__.py) and falls back to the pure-Python
+// implementations when the .so is absent.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Parse one parquet RLE/bit-packed hybrid stream into a run table.
+// Returns the number of runs written, or -1 if max_runs was too small,
+// -2 on a malformed varint.
+//
+//   buf[start:end) : the raw chunk bytes containing the hybrid stream
+//   bit_width      : value bit width (dict index width or 1 for def levels)
+//   num_values     : logical values to account for
+//   out_start[i]   : output index where run i begins
+//   is_rle[i]      : 1 = RLE run (value[i] repeated), 0 = bit-packed
+//   value[i]       : the repeated value for RLE runs
+//   bit_off[i]     : absolute BIT offset of packed values for bp runs
+int64_t srt_parse_runs(const uint8_t* buf, int64_t start, int64_t end,
+                       int32_t bit_width, int64_t num_values,
+                       int64_t* out_start, uint8_t* is_rle, int32_t* value,
+                       int64_t* bit_off, int64_t max_runs,
+                       int64_t* produced_out) {
+    int64_t pos = start;
+    int64_t produced = 0;
+    int64_t n = 0;
+    const int32_t vbytes = (bit_width + 7) / 8;
+    while (produced < num_values && pos < end) {
+        // LEB128 varint header
+        uint64_t header = 0;
+        int shift = 0;
+        for (;;) {
+            if (pos >= end || shift > 63) return -2;
+            uint8_t b = buf[pos++];
+            header |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        if (n >= max_runs) return -1;
+        if (header & 1) {  // bit-packed: (header>>1) groups of 8 values
+            int64_t groups = (int64_t)(header >> 1);
+            out_start[n] = produced;
+            is_rle[n] = 0;
+            value[n] = 0;
+            bit_off[n] = pos * 8;
+            pos += groups * bit_width;
+            produced += groups * 8;
+        } else {           // RLE: (header>>1) copies of one LE value
+            int64_t count = (int64_t)(header >> 1);
+            // accumulate unsigned: shifting into the sign bit of a signed
+            // int is UB; a single cast at the end is well-defined
+            uint32_t uv = 0;
+            for (int32_t k = 0; k < vbytes && pos + k < end; ++k)
+                uv |= (uint32_t)buf[pos + k] << (8 * k);
+            int32_t v = (int32_t)uv;
+            pos += vbytes;
+            out_start[n] = produced;
+            is_rle[n] = 1;
+            value[n] = v;
+            bit_off[n] = 0;
+            produced += count;
+        }
+        ++n;
+    }
+    *produced_out = produced;
+    return n;
+}
+
+}  // extern "C"

@@ -143,6 +143,13 @@ class HostColumnVector:
         validity = np.array([v is not None for v in values], dtype=bool)
         if dtype is DataType.STRING:
             data = np.array([v if v is not None else "" for v in values], dtype=object)
+        elif getattr(dtype, "is_decimal", False):
+            # logical values (Decimal/int/float/str) -> unscaled int64
+            from spark_rapids_tpu.ops.decimal_util import to_unscaled
+
+            data = np.array(
+                [to_unscaled(v, dtype.scale) if v is not None else 0
+                 for v in values], dtype=np.int64)
         else:
             npdt = dtype.to_np()
             zero = npdt.type(0)
@@ -182,6 +189,10 @@ class HostColumnVector:
         return HostColumnVector(dt, np.asarray(arr), np.asarray(validity, dtype=bool))
 
     def to_pylist(self) -> List[Any]:
+        dec_scale = self.dtype.scale if getattr(self.dtype, "is_decimal",
+                                                False) else None
+        if dec_scale is not None:
+            from spark_rapids_tpu.ops.decimal_util import from_unscaled
         out = []
         for i in range(len(self.data)):
             if not self.validity[i]:
@@ -190,6 +201,8 @@ class HostColumnVector:
                 v = self.data[i]
                 if isinstance(v, np.generic):
                     v = v.item()
+                if dec_scale is not None:
+                    v = from_unscaled(v, dec_scale)
                 out.append(v)
         return out
 

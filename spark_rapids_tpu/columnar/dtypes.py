@@ -55,9 +55,13 @@ class DataType(enum.Enum):
     def is_datetime(self) -> bool:
         return self in (DataType.DATE, DataType.TIMESTAMP)
 
+    @property
+    def is_decimal(self) -> bool:
+        return False
+
     @staticmethod
-    def parse(s: str) -> "DataType":
-        """Parse a Spark-style type name ('int', 'long', 'double', ...)."""
+    def parse(s: str):
+        """Parse a Spark-style type name ('int', 'long', 'decimal(10,2)', ...)."""
         aliases = {
             "bool": "boolean", "tinyint": "byte", "smallint": "short",
             "integer": "int", "bigint": "long", "real": "float",
@@ -65,6 +69,8 @@ class DataType(enum.Enum):
         }
         k = s.strip().lower()
         k = aliases.get(k, k)
+        if k.startswith("decimal") or k.startswith("numeric"):
+            return DecimalType.parse(k)
         try:
             return DataType(k)
         except ValueError:
@@ -81,6 +87,114 @@ class DataType(enum.Enum):
         if self is DataType.STRING:
             return 16  # rough per-row estimate used for batch sizing
         return _NP_MAP[self].itemsize
+
+
+class DecimalType:
+    """Fixed-point DECIMAL(precision, scale), precision <= 18.
+
+    Physical representation on both engines is the *unscaled* value as int64
+    (value = unscaled / 10**scale), which keeps every decimal kernel on the
+    MXU-friendly integer path and shares the existing int64 group/sort/join
+    machinery. The reference's v0.1 type gate excludes DecimalType entirely
+    (GpuOverrides.scala:383-395); this framework supports the 64-bit subset
+    (Spark's Decimal.MAX_LONG_DIGITS) to cover BASELINE config 5.
+
+    Instances duck-type the `DataType` surface that generic code relies on
+    (`to_np`, `itemsize`, `name`, `value`, `is_*` flags) so they can flow
+    through schemas, fingerprints, and batches unchanged.
+    """
+
+    MAX_PRECISION = 18
+    __slots__ = ("precision", "scale")
+
+    def __init__(self, precision: int = 10, scale: int = 0):
+        if not (1 <= precision <= self.MAX_PRECISION):
+            raise ValueError(
+                f"decimal precision {precision} out of range [1, "
+                f"{self.MAX_PRECISION}] (64-bit decimals only)")
+        if not (0 <= scale <= precision):
+            raise ValueError(
+                f"decimal scale {scale} out of range [0, {precision}]")
+        self.precision = precision
+        self.scale = scale
+
+    # -- DataType duck-type surface ------------------------------------------
+    @property
+    def value(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def name(self) -> str:
+        return f"DECIMAL_{self.precision}_{self.scale}"
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_integral(self) -> bool:
+        return False
+
+    @property
+    def is_floating(self) -> bool:
+        return False
+
+    @property
+    def is_string(self) -> bool:
+        return False
+
+    @property
+    def is_datetime(self) -> bool:
+        return False
+
+    @property
+    def is_decimal(self) -> bool:
+        return True
+
+    def to_np(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+    @property
+    def itemsize(self) -> int:
+        return 8
+
+    # -- identity -------------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, DecimalType)
+                and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash(("decimal", self.precision, self.scale))
+
+    def __repr__(self):
+        return f"DecimalType({self.precision},{self.scale})"
+
+    @staticmethod
+    def parse(s: str) -> "DecimalType":
+        body = s.strip().lower()
+        for prefix in ("decimal", "numeric"):
+            if body.startswith(prefix):
+                body = body[len(prefix):]
+                break
+        body = body.strip()
+        if not body:
+            return DecimalType(10, 0)
+        if not (body.startswith("(") and body.endswith(")")):
+            raise ValueError(f"bad decimal type {s!r}")
+        parts = [p.strip() for p in body[1:-1].split(",")]
+        if len(parts) == 1:
+            return DecimalType(int(parts[0]), 0)
+        if len(parts) == 2:
+            return DecimalType(int(parts[0]), int(parts[1]))
+        raise ValueError(f"bad decimal type {s!r}")
+
+
+def is_decimal(dt) -> bool:
+    return isinstance(dt, DecimalType)
 
 
 _NUMERIC = {
@@ -149,15 +263,32 @@ SUPPORTED_TYPES = frozenset(
 )
 
 
-def is_supported_type(dt: DataType) -> bool:
-    return dt in SUPPORTED_TYPES
+def is_supported_type(dt) -> bool:
+    return isinstance(dt, DecimalType) or dt in SUPPORTED_TYPES
 
 
-def common_type(a: DataType, b: DataType) -> Optional[DataType]:
+# Precision of each integral type when coerced to decimal (Spark's
+# DecimalType.forType): the smallest decimal that holds every value.
+INTEGRAL_DECIMAL_PRECISION = {
+    DataType.INT8: 3,
+    DataType.INT16: 5,
+    DataType.INT32: 10,
+    DataType.INT64: 18,  # clamped: int64 needs 19, 64-bit decimals cap at 18
+}
+
+
+def common_type(a, b) -> Optional["DataType"]:
     """Numeric promotion for binary arithmetic (Spark's findTightestCommonType
-    subset for flat types)."""
+    subset for flat types). Decimal mixes: decimal op float -> double (Spark
+    coerces the decimal to double); decimal op decimal / integral is resolved
+    by the per-operator precision rules in ops/decimal_util.py, not here."""
     if a == b:
         return a
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        other = b if isinstance(a, DecimalType) else a
+        if other in (DataType.FLOAT32, DataType.FLOAT64):
+            return DataType.FLOAT64
+        return None
     order = [
         DataType.INT8,
         DataType.INT16,

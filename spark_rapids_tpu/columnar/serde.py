@@ -36,7 +36,7 @@ from typing import List
 import numpy as np
 
 from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
-from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType
 
 MAGIC = b"TPB1"
 
@@ -55,6 +55,20 @@ _DTYPE_CODE = {
     DataType.NULL: 10,
 }
 _CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
+# DECIMAL(p,s): code 11, (p << 8) | s in the header's u16 extra field.
+_DECIMAL_CODE = 11
+
+
+def _dtype_code(dt):
+    if isinstance(dt, DecimalType):
+        return _DECIMAL_CODE, (dt.precision << 8) | dt.scale
+    return _DTYPE_CODE[dt], 0
+
+
+def _code_dtype(code: int, extra: int):
+    if code == _DECIMAL_CODE:
+        return DecimalType(extra >> 8, extra & 0xFF)
+    return _CODE_DTYPE[code]
 
 _HEADER = struct.Struct("<4sII")
 _COLHDR = struct.Struct("<BBHQ")
@@ -102,7 +116,8 @@ def serialize_batch(batch: HostColumnarBatch) -> bytes:
                 data = np.where(validity, data, npdt.type(0))
             payload.append(data.tobytes())
         plen = sum(len(p) for p in payload)
-        headers.append(_COLHDR.pack(_DTYPE_CODE[col.dtype], 1, 0, plen))
+        code, extra = _dtype_code(col.dtype)
+        headers.append(_COLHDR.pack(code, 1, extra, plen))
         parts.extend(payload)
     return b"".join(
         [_HEADER.pack(MAGIC, n, len(batch.columns))] + headers + parts)
@@ -117,9 +132,9 @@ def deserialize_batch(buf: bytes) -> HostColumnarBatch:
     off = _HEADER.size
     col_meta = []
     for _ in range(ncols):
-        code, _nullable, _r, plen = _COLHDR.unpack_from(mv, off)
+        code, _nullable, extra, plen = _COLHDR.unpack_from(mv, off)
         off += _COLHDR.size
-        col_meta.append((_CODE_DTYPE[code], plen))
+        col_meta.append((_code_dtype(code, extra), plen))
     vbytes = (n + 7) // 8
     cols: List[HostColumnVector] = []
     for dt, plen in col_meta:

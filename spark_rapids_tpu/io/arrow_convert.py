@@ -17,7 +17,7 @@ from spark_rapids_tpu.columnar.batch import (
     HostColumnarBatch,
     HostColumnVector,
 )
-from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType
 from spark_rapids_tpu.ops.base import AttributeReference
 
 _ARROW_TO_DT = {
@@ -39,6 +39,12 @@ def arrow_type_to_dt(t: pa.DataType) -> DataType:
         return _ARROW_TO_DT[t]
     if pa.types.is_timestamp(t):
         return DataType.TIMESTAMP
+    if pa.types.is_decimal(t):
+        if t.precision > DecimalType.MAX_PRECISION:
+            raise TypeError(
+                f"decimal precision {t.precision} exceeds the 64-bit cap "
+                f"({DecimalType.MAX_PRECISION})")
+        return DecimalType(t.precision, t.scale)
     if pa.types.is_dictionary(t):
         return arrow_type_to_dt(t.value_type)
     raise TypeError(f"unsupported arrow type {t} (flat types only, "
@@ -46,6 +52,8 @@ def arrow_type_to_dt(t: pa.DataType) -> DataType:
 
 
 def dt_to_arrow_type(dt: DataType) -> pa.DataType:
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
     for at, d in _ARROW_TO_DT.items():
         if d is dt and at != pa.large_string():
             return at
@@ -91,6 +99,8 @@ def arrow_to_host_batch(table: pa.Table,
         elif dt is DataType.DATE:
             data = arr.cast(pa.int32()).fill_null(0) \
                 .to_numpy(zero_copy_only=False).astype(np.int32)
+        elif isinstance(dt, DecimalType):
+            data = _decimal_unscaled(arr, dt, validity)
         else:
             npdt = dt.to_np()
             if dt is DataType.BOOL:
@@ -102,6 +112,32 @@ def arrow_to_host_batch(table: pa.Table,
                 data = data.astype(npdt)
         cols.append(HostColumnVector(dt, data, validity))
     return HostColumnarBatch(cols, table.num_rows)
+
+
+def _decimal_unscaled(arr: pa.Array, dt: DecimalType,
+                      validity: np.ndarray) -> np.ndarray:
+    """decimal128 arrow array -> unscaled int64 (the batch physical form).
+
+    Fast path reads the low 64 bits of each 128-bit little-endian value
+    straight from the arrow buffer — exact whenever |unscaled| < 2^63, which
+    the p <= 18 gate guarantees."""
+    n = len(arr)
+    if arr.type.scale != dt.scale or \
+            arr.type.precision > DecimalType.MAX_PRECISION:
+        # the cast raises loudly on values that don't fit dt — never
+        # silently truncate a wider file column to 64 bits
+        arr = arr.cast(pa.decimal128(dt.precision, dt.scale))
+    bufs = arr.buffers()
+    if len(bufs) > 1 and bufs[1] is not None and np.little_endian:
+        raw = np.frombuffer(bufs[1], dtype=np.int64)
+        lo = raw[arr.offset * 2:(arr.offset + n) * 2:2].copy()
+        return np.where(validity, lo, np.int64(0))
+    from spark_rapids_tpu.ops.decimal_util import to_unscaled
+
+    py = arr.to_pylist()
+    return np.array(
+        [to_unscaled(v, dt.scale) if v is not None else 0 for v in py],
+        dtype=np.int64)
 
 
 def host_batch_to_arrow(batch: HostColumnarBatch,
@@ -121,8 +157,17 @@ def host_batch_to_arrow(batch: HostColumnarBatch,
         elif dt is DataType.DATE:
             arrays.append(pa.array(col.data.astype(np.int32), mask=mask)
                           .cast(pa.date32()))
+        elif isinstance(dt, DecimalType):
+            from spark_rapids_tpu.ops.decimal_util import from_unscaled
+
+            vals = [from_unscaled(int(v), dt.scale) if ok else None
+                    for v, ok in zip(col.data, col.validity)]
+            arrays.append(pa.array(vals, type=pa.decimal128(dt.precision,
+                                                            dt.scale)))
         else:
             arrays.append(pa.array(col.data, mask=mask,
                                    type=dt_to_arrow_type(dt)))
         names.append(attr.name)
-    return pa.table(dict(zip(names, arrays)))
+    # positional construction: duplicate column names must round-trip to the
+    # writer (which then raises), not silently drop columns
+    return pa.table(arrays, names=names)

@@ -22,10 +22,13 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops.base import (
     Alias,
     AttributeReference,
+    BinaryExpression,
     Expression,
     UnaryExpression,
 )
@@ -117,10 +120,85 @@ class Max(AggregateFunction):
         return buffers[0]
 
 
-def _sum_type(dt: DataType) -> DataType:
+def _sum_type(dt):
+    if getattr(dt, "is_decimal", False):
+        # Spark: sum(decimal(p,s)) -> decimal(p+10, s), capped at the 64-bit
+        # MAX_PRECISION (sums beyond 18 digits are out of 64-bit range)
+        from spark_rapids_tpu.columnar.dtypes import DecimalType
+
+        return DecimalType(min(dt.precision + 10, DecimalType.MAX_PRECISION),
+                           dt.scale)
     if dt in (DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64):
         return DataType.INT64
     return DataType.FLOAT64
+
+
+class _UnscaledHi(UnaryExpression):
+    """High 32 bits (arithmetic shift) of a decimal's unscaled int64."""
+
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    def do_columnar(self, ctx, v):
+        return v.data.astype(np.int64) >> np.int64(32)
+
+
+class _UnscaledLo(UnaryExpression):
+    """Low 32 bits (non-negative) of a decimal's unscaled int64."""
+
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    def do_columnar(self, ctx, v):
+        return v.data.astype(np.int64) & np.int64(0xFFFFFFFF)
+
+
+class _DecimalSumFinish(BinaryExpression):
+    """Recombine hi/lo partial sums into the final decimal sum.
+
+    The hi/lo split makes 64-bit decimal sums *exact*: per-lane
+    v == (v >> 32)*2^32 + (v & 0xffffffff), and neither partial sum can wrap
+    int64 for any group under 2^31 rows. Overflow of the true sum beyond the
+    result precision (or int64) yields SQL NULL, matching Spark's non-ANSI
+    decimal sum."""
+
+    def __init__(self, hi, lo, result_type):
+        super().__init__(hi, lo)
+        self._result_type = result_type
+
+    def with_children(self, new_children):
+        return _DecimalSumFinish(new_children[0], new_children[1],
+                                 self._result_type)
+
+    @property
+    def data_type(self):
+        return self._result_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _fingerprint_extra(self):
+        return f"{self._result_type.name};"
+
+    def do_columnar(self, ctx, lv, rv):
+        from spark_rapids_tpu.ops import decimal_util as DU
+        from spark_rapids_tpu.ops.base import _d
+        from spark_rapids_tpu.ops.values import ColV
+
+        xp = ctx.xp
+        hi = DU._i64(xp, _d(lv))
+        lo = DU._i64(xp, _d(rv))
+        total_hi = hi + (lo >> np.int64(32))
+        rem = lo & np.int64(0xFFFFFFFF)
+        fits = (total_hi >= np.int64(-(2 ** 31))) & \
+               (total_hi < np.int64(2 ** 31))
+        val = xp.where(fits, total_hi, 0) * np.int64(2 ** 32) + rem
+        val, ok2 = DU.fit_precision(xp, val, self._result_type.precision)
+        ok = fits & ok2
+        return ColV(self._result_type, xp.where(ok, val, 0), ok)
 
 
 class Sum(AggregateFunction):
@@ -128,21 +206,35 @@ class Sum(AggregateFunction):
     def data_type(self):
         return _sum_type(self.child.data_type)
 
+    @property
+    def _is_decimal(self):
+        return getattr(self.child.data_type, "is_decimal", False)
+
     def buffer_attrs(self):
+        if self._is_decimal:
+            return [AttributeReference("sum_hi", DataType.INT64, True),
+                    AttributeReference("sum_lo", DataType.INT64, True)]
         return [AttributeReference("sum", self.data_type, True)]
 
     def update_aggs(self):
         from spark_rapids_tpu.ops.cast import Cast
 
+        if self._is_decimal:
+            return [("sum_hi", "sum", _UnscaledHi(self.child)),
+                    ("sum_lo", "sum", _UnscaledLo(self.child))]
         src = self.child
         if src.data_type != self.data_type:
             src = Cast(src, self.data_type)
         return [("sum", "sum", src)]
 
     def merge_aggs(self):
+        if self._is_decimal:
+            return [("sum_hi", "sum"), ("sum_lo", "sum")]
         return [("sum", "sum")]
 
     def evaluate_expression(self, buffers):
+        if self._is_decimal:
+            return _DecimalSumFinish(buffers[0], buffers[1], self.data_type)
         return buffers[0]
 
 
@@ -176,12 +268,66 @@ class Count(AggregateFunction):
         return [0]
 
 
-class Average(AggregateFunction):
+class _DecimalAvgFinish(BinaryExpression):
+    """sum(decimal) / count, HALF_UP at Spark's avg scale (s + 4, bounded).
+    Overflow of sum * 10^(rs - s) beyond int64 -> SQL NULL."""
+
+    def __init__(self, sum_expr, count_expr, sum_scale, result_type):
+        super().__init__(sum_expr, count_expr)
+        self._sum_scale = sum_scale
+        self._result_type = result_type
+
+    def with_children(self, new_children):
+        return _DecimalAvgFinish(new_children[0], new_children[1],
+                                 self._sum_scale, self._result_type)
+
     @property
     def data_type(self):
+        return self._result_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _fingerprint_extra(self):
+        return f"{self._sum_scale}->{self._result_type.name};"
+
+    def do_columnar(self, ctx, lv, rv):
+        from spark_rapids_tpu.ops import decimal_util as DU
+        from spark_rapids_tpu.ops.base import _d
+        from spark_rapids_tpu.ops.values import ColV
+
+        xp = ctx.xp
+        k = self._result_type.scale - self._sum_scale
+        num, ok1 = DU.checked_mul_pow10(xp, DU._i64(xp, _d(lv)), max(k, 0))
+        q, ok2 = DU.div_half_up(xp, num, DU._i64(xp, _d(rv)))
+        if k < 0:
+            q, _ = DU.rescale(xp, q, self._sum_scale, self._result_type.scale)
+        q, ok3 = DU.fit_precision(xp, q, self._result_type.precision)
+        ok = ok1 & ok2 & ok3
+        return ColV(self._result_type, xp.where(ok, q, 0), ok)
+
+
+class Average(AggregateFunction):
+    @property
+    def _dec(self):
+        dt = self.child.data_type
+        return dt if getattr(dt, "is_decimal", False) else None
+
+    @property
+    def data_type(self):
+        if self._dec is not None:
+            from spark_rapids_tpu.ops import decimal_util as DU
+
+            # Spark: avg(decimal(p,s)) -> decimal(p+4, s+4), bounded
+            return DU.bounded(self._dec.precision + 4, self._dec.scale + 4)
         return DataType.FLOAT64
 
     def buffer_attrs(self):
+        if self._dec is not None:
+            return [AttributeReference("sum_hi", DataType.INT64, True),
+                    AttributeReference("sum_lo", DataType.INT64, True),
+                    AttributeReference("count", DataType.INT64, False)]
         return [
             AttributeReference("sum", DataType.FLOAT64, True),
             AttributeReference("count", DataType.INT64, False),
@@ -190,21 +336,34 @@ class Average(AggregateFunction):
     def update_aggs(self):
         from spark_rapids_tpu.ops.cast import Cast
 
+        if self._dec is not None:
+            return [("sum_hi", "sum", _UnscaledHi(self.child)),
+                    ("sum_lo", "sum", _UnscaledLo(self.child)),
+                    ("count", "count", self.child)]
         src = self.child
         if src.data_type is not DataType.FLOAT64:
             src = Cast(src, DataType.FLOAT64)
         return [("sum", "sum", src), ("count", "count", self.child)]
 
     def merge_aggs(self):
+        if self._dec is not None:
+            return [("sum_hi", "sum"), ("sum_lo", "sum"), ("count", "sum")]
         return [("sum", "sum"), ("count", "sum")]
 
     def evaluate_expression(self, buffers):
         from spark_rapids_tpu.ops.arithmetic import Divide
         from spark_rapids_tpu.ops.cast import Cast
 
+        if self._dec is not None:
+            sum_type = _sum_type(self._dec)
+            return _DecimalAvgFinish(
+                _DecimalSumFinish(buffers[0], buffers[1], sum_type),
+                buffers[2], sum_type.scale, self.data_type)
         return Divide(buffers[0], Cast(buffers[1], DataType.FLOAT64))
 
     def initial_buffer_values(self):
+        if self._dec is not None:
+            return [None, None, 0]
         return [None, 0]
 
 

@@ -6,14 +6,40 @@ from __future__ import annotations
 
 import numpy as np
 
-from spark_rapids_tpu.columnar.dtypes import DataType, common_type
+from spark_rapids_tpu.columnar.dtypes import (
+    DataType,
+    DecimalType,
+    common_type,
+    is_decimal,
+)
+from spark_rapids_tpu.ops import decimal_util as DU
 from spark_rapids_tpu.ops.base import BinaryExpression, UnaryExpression, _d
 from spark_rapids_tpu.ops.values import ColV
 
 
 class BinaryArithmetic(BinaryExpression):
+    # per-op decimal precision rule (None -> decimal operands unsupported)
+    _decimal_result = None
+
+    def _decimal_types(self):
+        """(left, right, result) DecimalTypes when this op runs in decimal
+        space (at least one decimal operand, the other decimal-coercible)."""
+        lt, rt = self.left.data_type, self.right.data_type
+        if not (is_decimal(lt) or is_decimal(rt)):
+            return None
+        ld, rd = DU.as_decimal_type(lt), DU.as_decimal_type(rt)
+        if ld is None or rd is None:
+            return None  # decimal op float resolves via common_type -> double
+        if type(self)._decimal_result is None:
+            raise TypeError(
+                f"{type(self).__name__} does not support decimal operands")
+        return ld, rd, type(self)._decimal_result(ld, rd)
+
     @property
     def data_type(self):
+        dts = self._decimal_types()
+        if dts is not None:
+            return dts[2]
         ct = common_type(self.left.data_type, self.right.data_type)
         if ct is None:
             raise TypeError(
@@ -22,41 +48,114 @@ class BinaryArithmetic(BinaryExpression):
             )
         return ct
 
+    @property
+    def nullable(self):
+        # decimal arithmetic can overflow to NULL (Spark non-ANSI semantics)
+        if self._decimal_types() is not None:
+            return True
+        return super().nullable
+
     def _cast_operands(self, ctx, lv, rv):
         npdt = self.data_type.to_np()
+        types = (self.left.data_type, self.right.data_type)
 
-        def cast(x):
+        def cast(x, dt):
+            # decimal operand entering a float op: unscale to its real value
+            if is_decimal(dt) and npdt.kind == "f":
+                x = x / float(DU.POW10[dt.scale]) if hasattr(x, "astype") \
+                    else float(x) / float(DU.POW10[dt.scale])
             if hasattr(x, "astype"):
                 return x.astype(npdt) if x.dtype != npdt else x
             return npdt.type(x)
 
-        return cast(_d(lv)), cast(_d(rv))
+        return cast(_d(lv), types[0]), cast(_d(rv), types[1])
+
+    # -- shared decimal mod driver -------------------------------------------
+    def _decimal_mod(self, ctx, lv, rv, positive: bool):
+        """Truncated (or positive, for pmod) modulus at the common scale.
+        Result scale is max(s1, s2), which the remainder precision rule
+        always preserves (p <= 18 by construction, so no adjust)."""
+        xp = ctx.xp
+        ld, rd, res = self._decimal_types()
+        s = max(ld.scale, rd.scale)
+        l, ok1 = DU.rescale(xp, DU._i64(xp, _d(lv)), ld.scale, s)
+        r, ok2 = DU.rescale(xp, DU._i64(xp, _d(rv)), rd.scale, s)
+        safe_r = xp.where(r == 0, 1, r)
+
+        def trunc_mod(a, n):
+            q = a // n
+            rem = a - q * n
+            adj = (rem != 0) & ((a < 0) ^ (n < 0))
+            return a - (q + adj.astype(np.int64)) * n
+
+        m = trunc_mod(l, safe_r)
+        if positive:
+            m = xp.where(m < 0, trunc_mod(m + safe_r, safe_r), m)
+        ok = ok1 & ok2  # r == 0 -> null is applied by eval_kernel
+        return ColV(res, xp.where(ok, m, 0), ok)
+
+    # -- shared decimal addsub/mul driver ------------------------------------
+    def _decimal_addsub(self, ctx, lv, rv, sign: int):
+        xp = ctx.xp
+        ld, rd, res = self._decimal_types()
+        l, ok1 = DU.rescale(xp, DU._i64(xp, _d(lv)), ld.scale, res.scale)
+        r, ok2 = DU.rescale(xp, DU._i64(xp, _d(rv)), rd.scale, res.scale)
+        out = l + r if sign > 0 else l - r
+        out, ok3 = DU.fit_precision(xp, out, res.precision)
+        ok = ok1 & ok2 & ok3
+        return ColV(res, xp.where(ok, out, 0), ok)
 
 
 class Add(BinaryArithmetic):
+    _decimal_result = staticmethod(DU.add_result_type)
+
     def do_columnar(self, ctx, lv, rv):
+        if self._decimal_types() is not None:
+            return self._decimal_addsub(ctx, lv, rv, +1)
         l, r = self._cast_operands(ctx, lv, rv)
         return l + r
 
 
 class Subtract(BinaryArithmetic):
+    _decimal_result = staticmethod(DU.add_result_type)
+
     def do_columnar(self, ctx, lv, rv):
+        if self._decimal_types() is not None:
+            return self._decimal_addsub(ctx, lv, rv, -1)
         l, r = self._cast_operands(ctx, lv, rv)
         return l - r
 
 
 class Multiply(BinaryArithmetic):
+    _decimal_result = staticmethod(DU.multiply_result_type)
+
     def do_columnar(self, ctx, lv, rv):
+        dts = self._decimal_types()
+        if dts is not None:
+            xp = ctx.xp
+            ld, rd, res = dts
+            prod, ok1 = DU.checked_mul(xp, _d(lv), _d(rv))
+            # natural scale is ld.scale + rd.scale; adjust may have shrunk it
+            prod, ok2 = DU.rescale(xp, prod, ld.scale + rd.scale, res.scale)
+            prod, ok3 = DU.fit_precision(xp, prod, res.precision)
+            ok = ok1 & ok2 & ok3
+            return ColV(res, xp.where(ok, prod, 0), ok)
         l, r = self._cast_operands(ctx, lv, rv)
         return l * r
 
 
-class Divide(BinaryExpression):
-    """SQL / — always floating (Spark Divide); x/0 -> null handled by the
-    meta layer marking nullable and the kernel emitting NaN->null."""
+class Divide(BinaryArithmetic):
+    """SQL / — floating (Spark Divide), or decimal division with Spark's
+    DecimalPrecision result type when both operands are decimal-coercible and
+    at least one is decimal. x/0 -> null on both paths."""
+
+    _decimal_result = staticmethod(DU.divide_result_type)
 
     @property
     def data_type(self):
+        dts = self._decimal_types()
+        if dts is not None:
+            return dts[2]
         return DataType.FLOAT64
 
     @property
@@ -71,7 +170,8 @@ class Divide(BinaryExpression):
             r = _d(rv)
             zero_div = (r == 0) if not isinstance(rv, ColV) else (rv.data == 0)
             validity = out.validity & ctx.xp.logical_not(zero_div)
-            data = xp.where(validity, out.data, 0.0)
+            zero = np.zeros((), dtype=out.data.dtype)
+            data = xp.where(validity, out.data, zero)
             return ColV(out.dtype, data, validity)
         if out.value is not None and _scalar_zero(rv):
             out.value = None
@@ -79,6 +179,24 @@ class Divide(BinaryExpression):
 
     def do_columnar(self, ctx, lv, rv):
         xp = ctx.xp
+        dts = self._decimal_types()
+        if dts is not None:
+            ld, rd, res = dts
+            l = DU._i64(xp, _d(lv))
+            r = DU._i64(xp, _d(rv))
+            # bring the numerator to result scale: num = l * 10^k with
+            # k = res.scale - ld.scale + rd.scale, then HALF_UP divide
+            k = res.scale - ld.scale + rd.scale
+            if k >= 0:
+                num, ok1 = DU.checked_mul_pow10(xp, l, k)
+                q, ok2 = DU.div_half_up(xp, num, r)
+            else:
+                # extreme-scale corner: divide first, then scale down
+                q0, ok1 = DU.div_half_up(xp, l, r)
+                q, ok2 = DU.rescale(xp, q0, ld.scale - rd.scale, res.scale)
+            q, ok3 = DU.fit_precision(xp, q, res.precision)
+            ok = ok1 & ok2 & ok3
+            return ColV(res, xp.where(ok, q, 0), ok)
         npdt = self.data_type.to_np()
         l, r = _d(lv), _d(rv)
         l = l.astype(npdt) if hasattr(l, "astype") else float(l)
@@ -122,6 +240,29 @@ class IntegralDivide(BinaryExpression):
         l = l.astype(np.int64) if hasattr(l, "astype") else np.int64(l)
         r = _d(rv)
         r = r.astype(np.int64) if hasattr(r, "astype") else int(r)
+        lt = DU.as_decimal_type(self.left.data_type) \
+            if is_decimal(self.left.data_type) else None
+        rt = DU.as_decimal_type(self.right.data_type) \
+            if is_decimal(self.right.data_type) else None
+        if lt is not None or rt is not None:
+            # a div b over decimals = trunc(a/b) on the *logical* values:
+            # scale the numerator (or denominator) so both sides share one
+            # scale; overflow -> NULL
+            s1 = lt.scale if lt is not None else 0
+            s2 = rt.scale if rt is not None else 0
+            l = DU._i64(xp, l)
+            r = DU._i64(xp, r)
+            ok = xp.ones_like(l, dtype=bool)
+            if s2 > s1:
+                l, ok = DU.checked_mul_pow10(xp, l, s2 - s1)
+            elif s1 > s2:
+                r, ok = DU.checked_mul_pow10(xp, r, s1 - s2)
+            safe_r = xp.where(r == 0, 1, r)
+            q = l // safe_r
+            rem = l - q * safe_r
+            adj = (rem != 0) & ((l < 0) ^ (safe_r < 0))
+            q = q + adj.astype(np.int64)
+            return ColV(DataType.INT64, xp.where(ok, q, 0), ok)
         safe_r = xp.where(r == 0, 1, r) if hasattr(r, "dtype") else (1 if r == 0 else r)
         # SQL div truncates toward zero; // floors — fix up
         q = l // safe_r
@@ -132,6 +273,8 @@ class IntegralDivide(BinaryExpression):
 
 class Remainder(BinaryArithmetic):
     """SQL % — sign follows the dividend (C semantics, like Spark)."""
+
+    _decimal_result = staticmethod(DU.remainder_result_type)
 
     @property
     def nullable(self):
@@ -149,6 +292,8 @@ class Remainder(BinaryArithmetic):
         return out
 
     def do_columnar(self, ctx, lv, rv):
+        if self._decimal_types() is not None:
+            return self._decimal_mod(ctx, lv, rv, positive=False)
         xp = ctx.xp
         npdt = self.data_type.to_np()
         l, r = _d(lv), _d(rv)
@@ -167,6 +312,8 @@ class Remainder(BinaryArithmetic):
 class Pmod(BinaryArithmetic):
     """pmod(a, b): positive modulus (reference: GpuPmod)."""
 
+    _decimal_result = staticmethod(DU.remainder_result_type)
+
     @property
     def nullable(self):
         return True
@@ -183,6 +330,8 @@ class Pmod(BinaryArithmetic):
         return out
 
     def do_columnar(self, ctx, lv, rv):
+        if self._decimal_types() is not None:
+            return self._decimal_mod(ctx, lv, rv, positive=True)
         xp = ctx.xp
         npdt = self.data_type.to_np()
         l, r = _d(lv), _d(rv)

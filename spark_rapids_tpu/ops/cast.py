@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType, is_decimal
+from spark_rapids_tpu.ops import decimal_util as DU
 from spark_rapids_tpu.ops.base import UnaryExpression
 from spark_rapids_tpu.ops.values import ColV
 
@@ -37,12 +38,21 @@ class Cast(UnaryExpression):
 
     # which (from, to) directions the device kernel handles
     @staticmethod
-    def device_supported(frm: DataType, to: DataType) -> bool:
+    def device_supported(frm, to) -> bool:
         if frm == to:
             return True
         numeric_ish = {DataType.BOOL, DataType.INT8, DataType.INT16,
                        DataType.INT32, DataType.INT64, DataType.FLOAT32,
                        DataType.FLOAT64}
+        if is_decimal(frm):
+            # decimal -> numeric/decimal is pure int64 math on device
+            return is_decimal(to) or to in numeric_ish
+        if is_decimal(to):
+            # float -> decimal stays on the host oracle: Spark rounds via the
+            # double's shortest decimal repr (BigDecimal.valueOf), which has
+            # no jittable equivalent (cf. the reference gating float casts,
+            # RapidsConf.scala:393-425)
+            return frm in numeric_ish and not frm.is_floating
         if frm in numeric_ish and to in numeric_ish:
             return True
         if frm is DataType.DATE and to in (DataType.TIMESTAMP, DataType.STRING,
@@ -67,9 +77,93 @@ class Cast(UnaryExpression):
             return self._from_string(ctx, v, to)
         return self._numeric_datetime(ctx, v, frm, to)
 
+    # -- decimal --------------------------------------------------------------
+    def _decimal(self, ctx, v, frm, to):
+        """Casts with a decimal endpoint; overflow -> SQL NULL (non-ANSI) or
+        raises (ANSI), matching Spark's Decimal.changePrecision."""
+        xp = ctx.xp
+        data = v.data
+        if is_decimal(frm) and is_decimal(to):
+            out, ok1 = DU.rescale(xp, data, frm.scale, to.scale)
+            out, ok2 = DU.fit_precision(xp, out, to.precision)
+            return self._dec_result(ctx, v, to, out, ok1 & ok2)
+        if is_decimal(frm):
+            if to is DataType.BOOL:
+                return data != 0
+            if to.is_floating:
+                npdt = self._phys(ctx, to)
+                return data.astype(npdt) / npdt.type(float(DU.POW10[frm.scale]))
+            if to.is_integral:
+                # truncate toward zero, overflow -> null
+                q = xp.abs(data) // DU.POW10[frm.scale]
+                q = xp.where(data < 0, -q, q)
+                info = np.iinfo(to.to_np())
+                ok = (q >= info.min) & (q <= info.max)
+                out = xp.where(ok, q, 0).astype(self._phys(ctx, to))
+                return self._dec_result(ctx, v, to, out, ok)
+            raise NotImplementedError(f"cast {frm} -> {to}")
+        # numeric -> decimal
+        if frm is DataType.BOOL:
+            out = data.astype(np.int64) * DU.POW10[to.scale]
+            return self._dec_result(ctx, v, to, out,
+                                    xp.ones_like(out, dtype=bool))
+        if frm.is_integral:
+            out, ok1 = DU.checked_mul_pow10(xp, data.astype(np.int64),
+                                            to.scale)
+            out, ok2 = DU.fit_precision(xp, out, to.precision)
+            return self._dec_result(ctx, v, to, out, ok1 & ok2)
+        if frm.is_floating:
+            if ctx.is_device:
+                # approximate path (direct kernel use only; the plan layer
+                # keeps this direction on the host oracle): binary-float
+                # HALF_UP at target scale; NaN/Inf/overflow -> null
+                scaled = data * float(DU.POW10[to.scale])
+                finite = xp.isfinite(scaled)
+                limit = float(DU.bound(to.precision))
+                ok = finite & (xp.abs(scaled) <= limit)
+                half = xp.where(scaled >= 0, 0.5, -0.5)
+                out = xp.where(ok, scaled + half, 0.0).astype(np.int64)
+                out, ok2 = DU.fit_precision(xp, out, to.precision)
+                return self._dec_result(ctx, v, to, out, ok & ok2)
+            # host: Spark-exact — round the double's shortest decimal repr
+            # (BigDecimal.valueOf semantics), HALF_UP at target scale
+            out = np.zeros(len(data), dtype=np.int64)
+            ok = np.zeros(len(data), dtype=bool)
+            limit = int(DU.bound(to.precision))
+            for i, x in enumerate(data):
+                x = float(x)
+                if not np.isfinite(x):
+                    continue
+                try:
+                    u = DU.to_unscaled(x, to.scale)
+                except OverflowError:
+                    continue
+                if abs(u) <= limit:
+                    out[i] = u
+                    ok[i] = True
+            return self._dec_result(ctx, v, to, out, ok)
+        raise NotImplementedError(f"cast {frm} -> {to}")
+
+    def _dec_result(self, ctx, v, to, out, ok):
+        if self.ansi:
+            overflow = v.validity & ~ok
+            if not ctx.is_device and bool(np.asarray(overflow).any()):
+                raise ArithmeticError(
+                    f"cast to {getattr(to, 'value', to)} overflowed (ANSI)")
+        return ColV(to, out, ok)
+
+    def _phys(self, ctx, dt):
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+            return physical_np_dtype(dt)
+        return dt.to_np()
+
     # -- numeric / datetime --------------------------------------------------
     def _numeric_datetime(self, ctx, v, frm, to):
         xp = ctx.xp
+        if is_decimal(frm) or is_decimal(to):
+            return self._decimal(ctx, v, frm, to)
         data = v.data
         if ctx.is_device:
             from spark_rapids_tpu.columnar.batch import physical_np_dtype
@@ -115,6 +209,8 @@ class Cast(UnaryExpression):
 
     def _to_string_host(self, ctx, v, frm):
         def fmt(x):
+            if is_decimal(frm):
+                return str(DU.from_unscaled(int(x), frm.scale))
             if frm is DataType.BOOL:
                 return "true" if x else "false"
             if frm.is_integral:
@@ -140,7 +236,12 @@ class Cast(UnaryExpression):
                 continue
             s = s.strip()
             try:
-                if to.is_integral:
+                if is_decimal(to):
+                    u = DU.to_unscaled(s, to.scale)
+                    if abs(u) > int(DU.bound(to.precision)):
+                        raise OverflowError(s)
+                    out[i] = u
+                elif to.is_integral:
                     out[i] = int(float(s)) if "." in s or "e" in s.lower() else int(s)
                 elif to.is_floating:
                     out[i] = float(s)
@@ -158,7 +259,7 @@ class Cast(UnaryExpression):
                     out[i] = _parse_ts(s)
                 else:
                     raise NotImplementedError(f"cast STRING -> {to}")
-            except (ValueError, OverflowError):
+            except (ValueError, OverflowError, ArithmeticError):
                 if self.ansi:
                     raise
                 validity[i] = False

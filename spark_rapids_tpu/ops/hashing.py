@@ -86,7 +86,9 @@ def column_words(xp, col: ColV) -> List[Any]:
     if dt in (DataType.INT8, DataType.INT16, DataType.INT32, DataType.DATE):
         # sign-extend to i64 then take low word, exactly like casting to int
         return [_as_u32(xp, data.astype(np.int64))]
-    if dt in (DataType.INT64, DataType.TIMESTAMP):
+    if dt in (DataType.INT64, DataType.TIMESTAMP) or \
+            getattr(dt, "is_decimal", False):
+        # decimals hash their unscaled int64 exactly like LONG columns
         x = data.astype(np.int64)
         lo = _as_u32(xp, x)
         hi = _as_u32(xp, x >> np.int64(32))

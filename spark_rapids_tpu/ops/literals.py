@@ -9,7 +9,9 @@ from spark_rapids_tpu.ops.base import LeafExpression
 from spark_rapids_tpu.ops.values import ScalarV
 
 
-def infer_literal_type(value: Any) -> DataType:
+def infer_literal_type(value: Any):
+    import decimal as _dec
+
     if isinstance(value, bool):
         return DataType.BOOL
     if isinstance(value, int):
@@ -18,6 +20,10 @@ def infer_literal_type(value: Any) -> DataType:
         return DataType.FLOAT64
     if isinstance(value, str):
         return DataType.STRING
+    if isinstance(value, _dec.Decimal):
+        from spark_rapids_tpu.ops.decimal_util import infer_decimal_type
+
+        return infer_decimal_type(value)
     raise TypeError(f"cannot infer literal type for {value!r}")
 
 
@@ -25,6 +31,12 @@ class Literal(LeafExpression):
     def __init__(self, value: Any, dtype: Optional[DataType] = None):
         if dtype is None:
             dtype = DataType.NULL if value is None else infer_literal_type(value)
+        if getattr(dtype, "is_decimal", False) and value is not None:
+            from spark_rapids_tpu.ops.decimal_util import to_unscaled
+
+            # values are LOGICAL (5 means 5.00, like createDataFrame input);
+            # stored physically as the unscaled int64, collect converts back
+            value = to_unscaled(value, dtype.scale)
         self.value = value
         self._dtype = dtype
 

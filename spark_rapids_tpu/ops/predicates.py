@@ -25,6 +25,24 @@ class BinaryComparison(BinaryExpression):
     def _operands(self, ctx, lv, rv):
         # string comparisons never reach here — each subclass short-circuits
         # to the string kernels first
+        lt, rt = self.left.data_type, self.right.data_type
+        if getattr(lt, "is_decimal", False) or getattr(rt, "is_decimal", False):
+            from spark_rapids_tpu.ops import decimal_util as DU
+
+            ld, rd = DU.as_decimal_type(lt), DU.as_decimal_type(rt)
+            if ld is not None and rd is not None:
+                s = max(ld.scale, rd.scale)
+                return (DU.compare_rescale(ctx.xp, _d(lv), ld.scale, s),
+                        DU.compare_rescale(ctx.xp, _d(rv), rd.scale, s))
+            # decimal vs float: compare in floating space
+            def unscale(x, dt):
+                d = DU.as_decimal_type(dt)
+                if d is None:
+                    return x
+                return x / float(DU.POW10[d.scale]) if hasattr(x, "astype") \
+                    else float(x) / float(DU.POW10[d.scale])
+
+            return unscale(_d(lv), lt), unscale(_d(rv), rt)
         return _d(lv), _d(rv)
 
 

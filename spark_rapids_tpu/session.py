@@ -256,6 +256,29 @@ def _infer_type(values) -> DataType:
             return DataType.STRING
         if isinstance(v, np.datetime64):
             return DataType.TIMESTAMP
+        import decimal as _dec
+
+        if isinstance(v, _dec.Decimal):
+            from spark_rapids_tpu.ops.decimal_util import infer_decimal_type
+
+            # widest literal wins; scan the full column for the max (p, s)
+            from spark_rapids_tpu.columnar.dtypes import DecimalType
+
+            p = s = 0
+            for w in values:
+                if w is None:
+                    continue
+                t = infer_decimal_type(w)
+                s = max(s, t.scale)
+                p = max(p, t.precision - t.scale)
+            if p + s > DecimalType.MAX_PRECISION:
+                # never clamp: a clamped type would admit unscaled values
+                # beyond the precision bound every decimal kernel relies on
+                raise ValueError(
+                    f"decimal column needs precision {p + s} "
+                    f"(> {DecimalType.MAX_PRECISION}, the 64-bit cap); "
+                    "pass an explicit narrower schema or use double")
+            return DecimalType(p + s, s)
         raise TypeError(f"cannot infer SQL type for {v!r}")
     return DataType.STRING
 

@@ -1,0 +1,322 @@
+"""Decimal(p,s) equivalence tests — expression kernels (device vs oracle)
+and DataFrame-level CPU-vs-TPU runs.
+
+The reference's v0.1 type gate excludes decimals (GpuOverrides.scala:383-395);
+this framework implements the 64-bit subset (p <= 18, Spark's
+Decimal.MAX_LONG_DIGITS) for BASELINE config 5. Semantics under test mirror
+Spark: DecimalPrecision result types, HALF_UP rounding, non-ANSI
+overflow -> NULL."""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
+from spark_rapids_tpu.columnar.serde import deserialize_batch, serialize_batch
+from spark_rapids_tpu.ops import arithmetic as A
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.base import BoundReference
+from spark_rapids_tpu.ops.cast import Cast
+from spark_rapids_tpu.ops.literals import lit
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+from tests.test_expressions import check_exprs, make_batch, ref
+
+D = Decimal
+D92 = DecimalType(9, 2)
+D41 = DecimalType(4, 1)
+
+
+def dec_batch():
+    return make_batch(
+        a=([D("12.34"), D("-0.05"), None, D("9999999.99"), D("0")], D92),
+        b=([D("1.5"), D("2.5"), D("-3.5"), None, D("100.0")], D41),
+        i=([1, -2, 3, None, 100], DataType.INT32),
+        f=([0.5, -1.25, 3.0, 2.0, None], DataType.FLOAT32),
+    )
+
+
+# ---------------------------------------------------------------- type rules
+def test_result_types():
+    from spark_rapids_tpu.ops import decimal_util as DU
+
+    # max(p1-s1, p2-s2) + max(s1,s2) + 1 = 7 + 2 + 1
+    assert DU.add_result_type(D92, D41) == DecimalType(10, 2)
+    assert DU.multiply_result_type(D92, D41) == DecimalType(14, 3)
+    # divide: scale = max(6, 2+4+1) = 7, precision = 9-2+1+7 = 15
+    assert DU.divide_result_type(D92, D41) == DecimalType(15, 7)
+    # adjust: natural (p=37, s=19) must clamp to 18 digits
+    big = DecimalType(18, 10)
+    t = DU.multiply_result_type(big, big)
+    assert t.precision == 18 and t.scale <= 18
+
+
+def test_parse_and_repr():
+    assert DataType.parse("decimal(9,2)") == D92
+    assert DataType.parse("DECIMAL(4, 1)") == D41
+    assert DataType.parse("decimal") == DecimalType(10, 0)
+    assert D92.to_np() == np.dtype(np.int64)
+    with pytest.raises(ValueError):
+        DecimalType(25, 2)
+
+
+# ------------------------------------------------------------- expression ops
+def test_decimal_add_sub():
+    b = dec_batch()
+    check_exprs(b, [A.Add(ref(0, D92), ref(1, D41)),
+                    A.Subtract(ref(0, D92), ref(1, D41))])
+
+
+def test_decimal_multiply_divide():
+    b = dec_batch()
+    check_exprs(b, [A.Multiply(ref(0, D92), ref(1, D41)),
+                    A.Divide(ref(0, D92), ref(1, D41))])
+
+
+def test_decimal_int_mix():
+    b = dec_batch()
+    check_exprs(b, [A.Add(ref(0, D92), ref(2, DataType.INT32)),
+                    A.Multiply(ref(1, D41), ref(2, DataType.INT32))])
+
+
+def test_decimal_literal_ops():
+    b = dec_batch()
+    check_exprs(b, [A.Add(ref(0, D92), lit(D("1.25"))),
+                    A.Multiply(ref(0, D92), lit(D("2")))])
+
+
+def test_decimal_compare():
+    b = dec_batch()
+    check_exprs(b, [P.LessThan(ref(0, D92), ref(1, D41)),
+                    P.EqualTo(ref(1, D41), lit(D("1.5"))),
+                    P.GreaterThanOrEqual(ref(0, D92), lit(D("0")))])
+
+
+def test_decimal_divide_by_zero_is_null():
+    b = make_batch(a=([D("1.00"), D("2.00")], DecimalType(5, 2)),
+                   z=([D("0"), D("2")], DecimalType(5, 0)))
+    check_exprs(b, [A.Divide(ref(0, DecimalType(5, 2)),
+                             ref(1, DecimalType(5, 0)))])
+
+
+def test_decimal_overflow_to_null():
+    # 9999999.99 * 9999999.99 needs 16 integral digits at scale 4 -> the
+    # adjusted result type keeps it, but 9.99e7^2 * 10^4 exceeds int64 ->
+    # overflow lane must be NULL on both engines
+    dt = DecimalType(18, 9)
+    b = make_batch(a=([D("999999999.999999999"), D("2.0")], dt))
+    check_exprs(b, [A.Multiply(ref(0, dt), ref(0, dt))])
+
+
+# -------------------------------------------------------------------- casts
+def test_decimal_casts():
+    b = dec_batch()
+    check_exprs(b, [
+        Cast(ref(0, D92), DecimalType(12, 4)),   # rescale up
+        Cast(ref(0, D92), DecimalType(9, 0)),    # rescale down (HALF_UP)
+        Cast(ref(0, D92), DataType.INT64),       # truncate toward zero
+        Cast(ref(0, D92), DataType.INT32),
+        Cast(ref(2, DataType.INT32), DecimalType(10, 2)),
+        Cast(ref(0, D92), DataType.BOOL),
+    ], approx=False)
+
+
+def test_decimal_float_casts():
+    b = dec_batch()
+    check_exprs(b, [Cast(ref(0, D92), DataType.FLOAT32)], approx=True)
+    check_exprs(b, [Cast(ref(3, DataType.FLOAT32), DecimalType(10, 2))])
+
+
+def test_decimal_rescale_overflow_null():
+    dt = DecimalType(9, 2)
+    b = make_batch(a=([D("9999999.99"), D("1.00")], dt))
+    # target holds only 3 integral digits -> first lane NULL
+    check_exprs(b, [Cast(ref(0, dt), DecimalType(5, 2))])
+
+
+def test_decimal_half_up_rounding():
+    dt = DecimalType(6, 3)
+    b = make_batch(a=([D("1.005"), D("-1.005"), D("2.994"), D("-2.996")], dt))
+    out = Cast(ref(0, dt), DecimalType(6, 2))
+    check_exprs(b, [out])
+    # explicit value check: HALF_UP, not banker's
+    from spark_rapids_tpu.ops.eval import cpu_project
+
+    rows = cpu_project([out], b).to_pylist_rows()
+    assert [r[0] for r in rows] == [D("1.01"), D("-1.01"), D("2.99"),
+                                    D("-3.00")]
+
+
+def test_decimal_string_casts_host():
+    dt = DecimalType(7, 2)
+    b = make_batch(s=(["12.345", "-0.5", "bogus", None, "99999.99"],
+                      DataType.STRING))
+    from spark_rapids_tpu.ops.eval import cpu_project
+
+    rows = cpu_project([Cast(ref(0, DataType.STRING), dt)], b).to_pylist_rows()
+    assert [r[0] for r in rows] == [D("12.35"), D("-0.50"), None, None,
+                                    D("99999.99")]
+    b2 = make_batch(d=([D("3.10"), None, D("-0.05")], dt))
+    rows2 = cpu_project([Cast(ref(0, dt), DataType.STRING)],
+                        b2).to_pylist_rows()
+    assert [r[0] for r in rows2] == ["3.10", None, "-0.05"]
+
+
+# -------------------------------------------------------------------- serde
+def test_decimal_serde_roundtrip():
+    b = dec_batch()
+    out = deserialize_batch(serialize_batch(b))
+    assert out.columns[0].dtype == D92
+    assert out.columns[0].to_pylist() == b.columns[0].to_pylist()
+
+
+# ------------------------------------------------------------- DataFrame level
+def _dec_df(session):
+    return session.createDataFrame(
+        {"k": [1, 2, 1, 2, 3, 1],
+         "price": [D("10.50"), D("0.99"), None, D("123.45"), D("-7.25"),
+                   D("10.50")],
+         "qty": [2, 3, 1, None, 5, 4]},
+        [("k", "long"), ("price", "decimal(9,2)"), ("qty", "long")],
+        num_partitions=2)
+
+
+def test_df_decimal_filter_project(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _dec_df(s).filter(F.col("price") > D("0"))
+        .withColumn("total", F.col("price") * F.col("qty")),
+        ignore_order=True)
+
+
+def test_df_decimal_agg(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _dec_df(s).groupBy("k").agg(
+            F.sum("price").alias("s"),
+            F.min("price").alias("lo"),
+            F.max("price").alias("hi"),
+            F.count("price").alias("n")),
+        ignore_order=True)
+
+
+def test_df_decimal_sort(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _dec_df(s).orderBy("price"))
+
+
+def test_df_decimal_join_on_decimal_key(session):
+    def q(s):
+        left = _dec_df(s)
+        right = s.createDataFrame(
+            {"price": [D("10.50"), D("-7.25"), D("1.00")],
+             "label": ["a", "b", "c"]},
+            [("price", "decimal(9,2)"), ("label", "string")])
+        return left.join(right, on="price", how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_df_decimal_avg_cast(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _dec_df(s).groupBy("k").agg(F.avg("price").alias("m")),
+        ignore_order=True, approx_float=1e-5)
+
+
+def test_df_groupby_decimal_key(session):
+    # hash partitioning must treat the unscaled int64 like a LONG column
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _dec_df(s).groupBy("price").agg(F.count("*").alias("n")),
+        ignore_order=True)
+
+
+def test_decimal_sum_overflow_is_null(session):
+    # 20 x 9e17 = 1.8e19 > int64 max: Spark (non-ANSI) yields NULL, never a
+    # wrapped value
+    def q(s):
+        df = s.createDataFrame(
+            {"k": [1] * 20 + [2],
+             "v": [D("900000000000000000")] * 20 + [D("1")]},
+            [("k", "long"), ("v", "decimal(18,0)")], num_partitions=2)
+        return df.groupBy("k").agg(F.sum("v").alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+    rows = dict(q(session).collect())
+    assert rows[1] is None and rows[2] == D("1")
+
+
+def test_decimal_integral_divide():
+    dt = DecimalType(10, 2)
+    b = make_batch(a=([D("5.00"), D("-7.50"), D("100.00"), None], dt),
+                   n=([2, 2, 7, 3], DataType.INT32))
+    check_exprs(b, [A.IntegralDivide(ref(0, dt), ref(1, DataType.INT32)),
+                    A.IntegralDivide(ref(0, dt), lit(D("2.5")))])
+    from spark_rapids_tpu.ops.eval import cpu_project
+
+    rows = cpu_project([A.IntegralDivide(ref(0, dt), ref(1, DataType.INT32))],
+                       b).to_pylist_rows()
+    assert [r[0] for r in rows] == [2, -3, 14, None]
+
+
+def test_decimal_int_literal_is_logical():
+    # lit(5, decimal(10,2)) means 5.00 — same convention as createDataFrame
+    from spark_rapids_tpu.ops.literals import Literal
+
+    l = Literal(5, DecimalType(10, 2))
+    assert l.value == 500
+    b = make_batch(a=([D("1.00")], DecimalType(10, 2)))
+    check_exprs(b, [A.Add(ref(0, DecimalType(10, 2)),
+                          Literal(5, DecimalType(10, 2)))])
+
+
+def test_decimal_remainder_pmod():
+    dt = DecimalType(8, 2)
+    b = make_batch(a=([D("7.50"), D("-7.50"), D("10.00"), None], dt),
+                   n=([D("2.00"), D("2.00"), D("0"), D("3.00")], dt))
+    check_exprs(b, [A.Remainder(ref(0, dt), ref(1, dt)),
+                    A.Pmod(ref(0, dt), ref(1, dt))])
+    from spark_rapids_tpu.ops.eval import cpu_project
+
+    rows = cpu_project([A.Remainder(ref(0, dt), ref(1, dt)),
+                        A.Pmod(ref(0, dt), ref(1, dt))], b).to_pylist_rows()
+    assert rows[0] == (D("1.50"), D("1.50"))
+    assert rows[1] == (D("-1.50"), D("0.50"))   # sign follows dividend; pmod positive
+    assert rows[2] == (None, None)               # mod by zero
+    assert rows[3] == (None, None)               # null dividend
+
+
+def test_decimal_avg_exact(session):
+    # avg returns decimal(p+4, s+4) with exact HALF_UP division
+    def q(s):
+        df = s.createDataFrame(
+            {"k": [1, 1, 1, 2],
+             "v": [D("0.01"), D("0.02"), D("0.02"), D("5.00")]},
+            [("k", "long"), ("v", "decimal(9,2)")], num_partitions=2)
+        return df.groupBy("k").agg(F.avg("v").alias("m"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+    rows = dict(q(session).collect())
+    assert rows[1] == D("0.016667")  # 0.05/3 HALF_UP at scale 6
+    assert rows[2] == D("5.000000")
+
+
+def test_fit_precision_int64_min():
+    from spark_rapids_tpu.ops import decimal_util as DU
+
+    out, ok = DU.fit_precision(np, np.array([-2 ** 63, 5], dtype=np.int64), 18)
+    assert list(ok) == [False, True]
+
+
+def test_df_decimal_parquet_roundtrip(session, tmp_path):
+    path = str(tmp_path / "dec.parquet")
+    _dec_df(session).write.parquet(path)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path).filter(F.col("price") != D("0.99")),
+        ignore_order=True)

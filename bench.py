@@ -175,23 +175,26 @@ def main_decode() -> None:
     }))
 
 
-def _worker_tpch(mode: str, sf: float) -> None:
-    """TPC-H-like suite (reference: tpch/Benchmarks.scala:28-90 — loop
-    queries, print wall-clock). Geomean over q1/q3/q5/q6 best-of-2."""
+def _worker_suite(suite: str, mode: str, sf: float) -> None:
+    """Query-suite worker (reference: tpch/Benchmarks.scala:28-90 /
+    TpcxbbLikeBench.scala — loop queries, print wall-clock). suite:
+    'tpch' (BASELINE configs 2+3) or 'tpcxbb' (config 5: window +
+    decimal/timestamp casts). Geomean of per-query best-of-2."""
+    import importlib
     import math
 
     dev = _init_backend(mode)
     import spark_rapids_tpu as srt
-    from spark_rapids_tpu.benchmarks import tpch
 
+    qmod = importlib.import_module(f"spark_rapids_tpu.benchmarks.{suite}")
     session = srt.new_session()
     session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
     session.conf.set("rapids.tpu.sql.enabled", mode == "tpu")
     tables = {k: v.cache() for k, v in
-              tpch.gen_tables(session, sf=sf, num_partitions=4).items()}
-    _log(f"worker[{mode}]: tpch sf={sf} tables built")
+              qmod.gen_tables(session, sf=sf, num_partitions=4).items()}
+    _log(f"worker[{mode}]: {suite} sf={sf} tables built")
     bests = {}
-    for qname, qfn in sorted(tpch.QUERIES.items()):
+    for qname, qfn in sorted(qmod.QUERIES.items()):
         qfn(tables).collect()  # warmup/compile
         times = []
         for _ in range(2):
@@ -265,28 +268,28 @@ def main() -> None:
     print(json.dumps(result))
 
 
-def main_tpch(sf: float) -> None:
-    """TPC-H-like suite mode: `python bench.py --tpch [sf]` (BASELINE
-    configs 2+3). Prints geomean wall-clock + speedup vs the CPU oracle."""
+def main_suite(suite: str, sf: float) -> None:
+    """Suite mode: `python bench.py --tpch|--tpcxbb [sf]`. Prints geomean
+    wall-clock + speedup vs the CPU oracle."""
     env_extra = {"SRT_TPCH_SF": str(sf)}
     cpu_env = _scrubbed_cpu_env()
     cpu_env.update(env_extra)
     tpu_env = dict(os.environ)
     tpu_env.update(env_extra)
-    cpu = _run_phase("tpch-cpu", cpu_env, CPU_BUDGET_S * 2)
-    acc = _run_phase("tpch-tpu", tpu_env, TPU_BUDGET_S)
+    cpu = _run_phase(f"{suite}-cpu", cpu_env, CPU_BUDGET_S * 2)
+    acc = _run_phase(f"{suite}-tpu", tpu_env, TPU_BUDGET_S)
     platform = acc["platform"] if acc else None
     if acc is None:
         # same honest fallback as main(): accelerated engine on CPU backend
-        acc = _run_phase("tpch-tpu", cpu_env, CPU_BUDGET_S * 2)
+        acc = _run_phase(f"{suite}-tpu", cpu_env, CPU_BUDGET_S * 2)
         platform = "cpu-fallback" if acc else None
     if acc is None:
-        print(json.dumps({"metric": "tpch_like_geomean_s", "value": 0.0,
+        print(json.dumps({"metric": f"{suite}_like_geomean_s", "value": 0.0,
                           "unit": "s", "vs_baseline": 0.0,
-                          "error": "tpch bench failed", "sf": sf}))
+                          "error": f"{suite} bench failed", "sf": sf}))
         return
     print(json.dumps({
-        "metric": "tpch_like_geomean_s",
+        "metric": f"{suite}_like_geomean_s",
         "value": round(acc["geomean_s"], 4),
         "unit": "s",
         "vs_baseline": (round(cpu["geomean_s"] / acc["geomean_s"], 3)
@@ -300,15 +303,17 @@ def main_tpch(sf: float) -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         mode = sys.argv[2]
-        if mode.startswith("tpch-"):
-            _worker_tpch(mode.split("-", 1)[1],
-                         float(os.environ.get("SRT_TPCH_SF", "0.01")))
+        if mode.startswith("tpch-") or mode.startswith("tpcxbb-"):
+            suite, m = mode.split("-", 1)
+            _worker_suite(suite, m,
+                          float(os.environ.get("SRT_TPCH_SF", "0.01")))
         elif mode.startswith("decode-"):
             _worker_decode(mode.split("-", 1)[1])
         else:
             _worker(mode)
-    elif len(sys.argv) >= 2 and sys.argv[1] == "--tpch":
-        main_tpch(float(sys.argv[2]) if len(sys.argv) >= 3 else 0.01)
+    elif len(sys.argv) >= 2 and sys.argv[1] in ("--tpch", "--tpcxbb"):
+        main_suite(sys.argv[1].lstrip("-"),
+                   float(sys.argv[2]) if len(sys.argv) >= 3 else 0.01)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--decode":
         main_decode()
     else:

@@ -44,6 +44,14 @@ _SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
 _FLAGS = ["A", "N", "R"]
 _STATUS = ["F", "O"]
 _REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+_INSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_TYPES = ["ECONOMY ANODIZED STEEL", "LARGE BRUSHED BRASS",
+          "MEDIUM POLISHED COPPER", "PROMO BURNISHED NICKEL",
+          "PROMO PLATED TIN", "SMALL PLATED COPPER", "STANDARD POLISHED TIN"]
+_CONTAINERS = ["JUMBO PKG", "LG CASE", "MED BAG", "MED BOX", "MED PACK",
+               "MED PKG", "SM BOX", "SM CASE", "SM PACK", "SM PKG"]
 
 
 def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
@@ -57,9 +65,15 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
     n_supp = max(8, int(10_000 * sf))
     n_nation = 25
 
+    n_part = max(8, int(200_000 * sf))
+
     ship_lo, ship_hi = _days("1992-01-01"), _days("1998-12-01")
+    shipdate = rng.integers(ship_lo, ship_hi, n_li).astype(np.int32)
+    commitdate = shipdate + rng.integers(-30, 60, n_li).astype(np.int32)
+    receiptdate = shipdate + rng.integers(1, 31, n_li).astype(np.int32)
     lineitem = session.createDataFrame({
         "l_orderkey": rng.integers(0, n_ord, n_li).astype(np.int64),
+        "l_partkey": rng.integers(0, n_part, n_li).astype(np.int64),
         "l_suppkey": rng.integers(0, n_supp, n_li).astype(np.int64),
         "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
         "l_extendedprice": (rng.random(n_li) * 100_000).round(2),
@@ -71,12 +85,22 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
         "l_linestatus": np.array(
             [_STATUS[i] for i in rng.integers(0, len(_STATUS), n_li)],
             dtype=object),
-        "l_shipdate": rng.integers(ship_lo, ship_hi, n_li).astype(np.int32),
-    }, [("l_orderkey", "long"), ("l_suppkey", "long"),
+        "l_shipdate": shipdate,
+        "l_commitdate": commitdate,
+        "l_receiptdate": receiptdate,
+        "l_shipmode": np.array(
+            [_SHIPMODES[i] for i in rng.integers(0, len(_SHIPMODES), n_li)],
+            dtype=object),
+        "l_shipinstruct": np.array(
+            [_INSTRUCT[i] for i in rng.integers(0, len(_INSTRUCT), n_li)],
+            dtype=object),
+    }, [("l_orderkey", "long"), ("l_partkey", "long"), ("l_suppkey", "long"),
         ("l_quantity", "double"), ("l_extendedprice", "double"),
         ("l_discount", "double"), ("l_tax", "double"),
         ("l_returnflag", "string"), ("l_linestatus", "string"),
-        ("l_shipdate", DataType.DATE)],
+        ("l_shipdate", DataType.DATE), ("l_commitdate", DataType.DATE),
+        ("l_receiptdate", DataType.DATE), ("l_shipmode", "string"),
+        ("l_shipinstruct", "string")],
         num_partitions=num_partitions)
 
     ord_lo, ord_hi = _days("1992-01-01"), _days("1998-08-02")
@@ -85,9 +109,31 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
         "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
         "o_orderdate": rng.integers(ord_lo, ord_hi, n_ord).astype(np.int32),
         "o_shippriority": np.zeros(n_ord, dtype=np.int32),
+        "o_orderpriority": np.array(
+            [_PRIORITIES[i]
+             for i in rng.integers(0, len(_PRIORITIES), n_ord)],
+            dtype=object),
     }, [("o_orderkey", "long"), ("o_custkey", "long"),
-        ("o_orderdate", DataType.DATE), ("o_shippriority", "int")],
+        ("o_orderdate", DataType.DATE), ("o_shippriority", "int"),
+        ("o_orderpriority", "string")],
         num_partitions=num_partitions)
+
+    part = session.createDataFrame({
+        "p_partkey": np.arange(n_part, dtype=np.int64),
+        "p_type": np.array(
+            [_TYPES[i] for i in rng.integers(0, len(_TYPES), n_part)],
+            dtype=object),
+        "p_brand": np.array(
+            [f"Brand#{i}" for i in rng.integers(11, 56, n_part)],
+            dtype=object),
+        "p_container": np.array(
+            [_CONTAINERS[i]
+             for i in rng.integers(0, len(_CONTAINERS), n_part)],
+            dtype=object),
+        "p_size": rng.integers(1, 51, n_part).astype(np.int32),
+    }, [("p_partkey", "long"), ("p_type", "string"), ("p_brand", "string"),
+        ("p_container", "string"), ("p_size", "int")],
+        num_partitions=max(1, num_partitions // 2))
 
     customer = session.createDataFrame({
         "c_custkey": np.arange(n_cust, dtype=np.int64),
@@ -118,7 +164,8 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
     }, [("r_regionkey", "long"), ("r_name", "string")], num_partitions=1)
 
     return {"lineitem": lineitem, "orders": orders, "customer": customer,
-            "supplier": supplier, "nation": nation, "region": region}
+            "supplier": supplier, "nation": nation, "region": region,
+            "part": part}
 
 
 # ---------------------------------------------------------------------------
@@ -197,4 +244,108 @@ def q5(t) -> "object":
             .orderBy(F.col("revenue").desc()))
 
 
-QUERIES: Dict[str, Callable] = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
+def q4(t) -> "object":
+    """Order priority checking (EXISTS -> left-semi join + agg)."""
+    o, li = t["orders"], t["lineitem"]
+    late = li.filter(li["l_commitdate"] < li["l_receiptdate"])
+    return (o.filter((o["o_orderdate"] >= date_lit("1993-07-01"))
+                     & (o["o_orderdate"] < date_lit("1993-10-01")))
+            .join(late, on=(o["o_orderkey"] == late["l_orderkey"]),
+                  how="left_semi")
+            .groupBy("o_orderpriority")
+            .agg(F.count("*").alias("order_count"))
+            .orderBy("o_orderpriority"))
+
+
+def q10(t) -> "object":
+    """Returned item reporting (4-way join + agg + sort + limit)."""
+    c, o, li, n = t["customer"], t["orders"], t["lineitem"], t["nation"]
+    return (c.join(o.filter((o["o_orderdate"] >= date_lit("1993-10-01"))
+                            & (o["o_orderdate"] < date_lit("1994-01-01"))),
+                   on=(c["c_custkey"] == o["o_custkey"]), how="inner")
+            .join(li.filter(li["l_returnflag"] == F.lit("R")),
+                  on=(F.col("o_orderkey") == li["l_orderkey"]), how="inner")
+            .join(n, on=(F.col("c_nationkey") == n["n_nationkey"]),
+                  how="inner")
+            .withColumn("volume",
+                        F.col("l_extendedprice")
+                        * (F.lit(1.0) - F.col("l_discount")))
+            .groupBy("c_custkey", "n_name")
+            .agg(F.sum("volume").alias("revenue"))
+            .orderBy(F.col("revenue").desc(), F.col("c_custkey"))
+            .limit(20))
+
+
+def q12(t) -> "object":
+    """Shipping modes and order priority (join + conditional counts)."""
+    o, li = t["orders"], t["lineitem"]
+    flt = li.filter(
+        li["l_shipmode"].isin("MAIL", "SHIP")
+        & (li["l_commitdate"] < li["l_receiptdate"])
+        & (li["l_shipdate"] < li["l_commitdate"])
+        & (li["l_receiptdate"] >= date_lit("1994-01-01"))
+        & (li["l_receiptdate"] < date_lit("1995-01-01")))
+    high = F.when(F.col("o_orderpriority").isin("1-URGENT", "2-HIGH"),
+                  F.lit(1)).otherwise(F.lit(0))
+    low = F.when(F.col("o_orderpriority").isin("1-URGENT", "2-HIGH"),
+                 F.lit(0)).otherwise(F.lit(1))
+    return (o.join(flt, on=(o["o_orderkey"] == flt["l_orderkey"]),
+                   how="inner")
+            .withColumn("high_line", high)
+            .withColumn("low_line", low)
+            .groupBy("l_shipmode")
+            .agg(F.sum("high_line").alias("high_line_count"),
+                 F.sum("low_line").alias("low_line_count"))
+            .orderBy("l_shipmode"))
+
+
+def q14(t) -> "object":
+    """Promotion effect (join + conditional aggregate ratio)."""
+    li, p = t["lineitem"], t["part"]
+    return (li.filter((li["l_shipdate"] >= date_lit("1995-09-01"))
+                      & (li["l_shipdate"] < date_lit("1995-10-01")))
+            .join(p, on=(li["l_partkey"] == p["p_partkey"]), how="inner")
+            .withColumn("volume",
+                        F.col("l_extendedprice")
+                        * (F.lit(1.0) - F.col("l_discount")))
+            .withColumn("promo",
+                        F.when(F.col("p_type").startswith("PROMO"),
+                               F.col("volume")).otherwise(F.lit(0.0)))
+            .agg(F.sum("promo").alias("promo_revenue"),
+                 F.sum("volume").alias("total_revenue"))
+            .withColumn("promo_pct",
+                        F.lit(100.0) * F.col("promo_revenue")
+                        / F.col("total_revenue"))
+            .select("promo_pct"))
+
+
+def q19(t) -> "object":
+    """Discounted revenue (join + OR-of-ANDs predicate on both sides)."""
+    li, p = t["lineitem"], t["part"]
+    j = li.filter(li["l_shipinstruct"] == F.lit("DELIVER IN PERSON")).join(
+        p, on=(li["l_partkey"] == p["p_partkey"]), how="inner")
+    cond = (
+        (F.col("p_container").isin("SM CASE", "SM BOX", "SM PACK", "SM PKG")
+         & (F.col("l_quantity") >= F.lit(1.0))
+         & (F.col("l_quantity") <= F.lit(11.0))
+         & (F.col("p_size") <= F.lit(5)))
+        | (F.col("p_container").isin("MED BAG", "MED BOX", "MED PKG",
+                                     "MED PACK")
+           & (F.col("l_quantity") >= F.lit(10.0))
+           & (F.col("l_quantity") <= F.lit(20.0))
+           & (F.col("p_size") <= F.lit(10)))
+        | (F.col("p_container").isin("LG CASE", "JUMBO PKG")
+           & (F.col("l_quantity") >= F.lit(20.0))
+           & (F.col("l_quantity") <= F.lit(30.0))
+           & (F.col("p_size") <= F.lit(15))))
+    return (j.filter(cond)
+            .withColumn("revenue",
+                        F.col("l_extendedprice")
+                        * (F.lit(1.0) - F.col("l_discount")))
+            .agg(F.sum("revenue").alias("revenue")))
+
+
+QUERIES: Dict[str, Callable] = {
+    "q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
+    "q10": q10, "q12": q12, "q14": q14, "q19": q19,
+}

@@ -148,7 +148,8 @@ class HostColumnVector:
             from spark_rapids_tpu.ops.decimal_util import to_unscaled
 
             data = np.array(
-                [to_unscaled(v, dtype.scale) if v is not None else 0
+                [to_unscaled(v, dtype.scale, dtype.precision)
+                 if v is not None else 0
                  for v in values], dtype=np.int64)
         else:
             npdt = dtype.to_np()

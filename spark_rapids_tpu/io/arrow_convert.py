@@ -122,11 +122,12 @@ def _decimal_unscaled(arr: pa.Array, dt: DecimalType,
     straight from the arrow buffer — exact whenever |unscaled| < 2^63, which
     the p <= 18 gate guarantees."""
     n = len(arr)
-    if arr.type.scale != dt.scale or \
-            arr.type.precision > DecimalType.MAX_PRECISION:
+    want = pa.decimal128(dt.precision, dt.scale)
+    if arr.type != want:
         # the cast raises loudly on values that don't fit dt — never
-        # silently truncate a wider file column to 64 bits
-        arr = arr.cast(pa.decimal128(dt.precision, dt.scale))
+        # silently truncate a wider column (decimal256, higher precision,
+        # other scale) to 64 bits
+        arr = arr.cast(want)
     bufs = arr.buffers()
     if len(bufs) > 1 and bufs[1] is not None and np.little_endian:
         raw = np.frombuffer(bufs[1], dtype=np.int64)
@@ -138,6 +139,34 @@ def _decimal_unscaled(arr: pa.Array, dt: DecimalType,
     return np.array(
         [to_unscaled(v, dt.scale) if v is not None else 0 for v in py],
         dtype=np.int64)
+
+
+def _unscaled_to_decimal128(col, dt: DecimalType) -> pa.Array:
+    """Vectorized unscaled int64 -> decimal128 array: widen each value to
+    two little-endian 64-bit limbs (lo, sign-extended hi) and hand arrow the
+    raw buffer — no per-row Decimal objects."""
+    n = len(col.data)
+    data = np.ascontiguousarray(col.data[:n], dtype=np.int64)
+    validity = np.ascontiguousarray(col.validity[:n], dtype=bool)
+    if not np.little_endian:
+        vals = [
+            None if not ok else
+            __import__("decimal").Decimal(int(v)).scaleb(-dt.scale)
+            for v, ok in zip(data, validity)]
+        return pa.array(vals, type=pa.decimal128(dt.precision, dt.scale))
+    limbs = np.empty((n, 2), dtype=np.int64)
+    limbs[:, 0] = np.where(validity, data, 0)
+    limbs[:, 1] = limbs[:, 0] >> 63  # sign extension
+    if validity.all():
+        vbuf = None
+        null_count = 0
+    else:
+        vbuf = pa.py_buffer(
+            np.packbits(validity, bitorder="little").tobytes())
+        null_count = int((~validity).sum())
+    return pa.Array.from_buffers(
+        pa.decimal128(dt.precision, dt.scale), n,
+        [vbuf, pa.py_buffer(limbs.tobytes())], null_count=null_count)
 
 
 def host_batch_to_arrow(batch: HostColumnarBatch,
@@ -158,12 +187,7 @@ def host_batch_to_arrow(batch: HostColumnarBatch,
             arrays.append(pa.array(col.data.astype(np.int32), mask=mask)
                           .cast(pa.date32()))
         elif isinstance(dt, DecimalType):
-            from spark_rapids_tpu.ops.decimal_util import from_unscaled
-
-            vals = [from_unscaled(int(v), dt.scale) if ok else None
-                    for v, ok in zip(col.data, col.validity)]
-            arrays.append(pa.array(vals, type=pa.decimal128(dt.precision,
-                                                            dt.scale)))
+            arrays.append(_unscaled_to_decimal128(col, dt))
         else:
             arrays.append(pa.array(col.data, mask=mask,
                                    type=dt_to_arrow_type(dt)))

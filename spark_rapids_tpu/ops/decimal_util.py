@@ -11,6 +11,14 @@ Every kernel here is xp-polymorphic (numpy oracle / jax.numpy device) and
 uses only int64 ops — no floats — so device results are bit-identical to
 the oracle. Overflow is *detected before it can wrap* (checked multiply via
 magnitude bounds) and surfaces as a False validity lane.
+
+Known deviation of the 64-bit subset: multiply/divide intermediates are
+computed in int64 at the *natural* scale, so an operation whose final
+(adjusted) result would fit can still return NULL when the intermediate
+exceeds int64 — e.g. decimal(18,0) 10^13 / 10^4 scales the numerator by
+10^6 past 2^63. Spark's 128-bit Decimal backing succeeds there. Lifting
+this requires two-limb (hi/lo) multiply + long division; until then the
+engine returns NULL rather than ever a wrong value.
 """
 
 from __future__ import annotations
@@ -178,9 +186,12 @@ def compare_rescale(xp, data, from_scale: int, to_scale: int):
 # ---------------------------------------------------------------------------
 # Host-side value conversion (literals, builders, collect)
 # ---------------------------------------------------------------------------
-def to_unscaled(value, scale: int) -> int:
+def to_unscaled(value, scale: int, precision: Optional[int] = None) -> int:
     """Python value (Decimal/int/float/str) -> unscaled int at `scale`,
-    rounding HALF_UP like Spark's Decimal.changePrecision."""
+    rounding HALF_UP like Spark's Decimal.changePrecision. When `precision`
+    is given, values beyond its digit bound are rejected (ingestion must
+    never admit an unscaled value outside the bound every kernel relies
+    on)."""
     if isinstance(value, decimal.Decimal):
         d = value
     elif isinstance(value, (int, np.integer)):
@@ -196,6 +207,9 @@ def to_unscaled(value, scale: int) -> int:
     if abs(i) > int(INT64_MAX):
         raise OverflowError(f"decimal {value} does not fit in 64 bits at "
                             f"scale {scale}")
+    if precision is not None and abs(i) > int(bound(precision)):
+        raise OverflowError(
+            f"decimal {value} does not fit decimal({precision},{scale})")
     return i
 
 

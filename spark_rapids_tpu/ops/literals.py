@@ -36,7 +36,7 @@ class Literal(LeafExpression):
 
             # values are LOGICAL (5 means 5.00, like createDataFrame input);
             # stored physically as the unscaled int64, collect converts back
-            value = to_unscaled(value, dtype.scale)
+            value = to_unscaled(value, dtype.scale, dtype.precision)
         self.value = value
         self._dtype = dtype
 

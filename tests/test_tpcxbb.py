@@ -1,0 +1,28 @@
+"""TPCx-BB-like query equivalence at tiny scale (BASELINE config 5:
+window functions + decimal/timestamp casts; reference:
+TpcxbbLikeSpark.scala + TpcxbbLikeBench.scala shapes)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpcxbb
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.mark.parametrize("qname", sorted(tpcxbb.QUERIES))
+def test_tpcxbb_query_equivalence(session, qname):
+    def q(s):
+        tables = tpcxbb.gen_tables(s, sf=0.0005, num_partitions=3)
+        return tpcxbb.QUERIES[qname](tables)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-6)
+
+
+def test_q16_decimal_exact(session):
+    # the decimal aggregates must be exact: before + after == total per store
+    tables = tpcxbb.gen_tables(session, sf=0.0005, num_partitions=2)
+    for row in tpcxbb.q16_like(tables).collect():
+        _, before, after, total, _, delta = row
+        assert before + after == total
+        assert after - before == delta

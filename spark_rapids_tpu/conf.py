@@ -256,6 +256,15 @@ PARQUET_DEVICE_DECODE = _conf(
 ).boolean(True)
 PARQUET_WRITE_ENABLED = _conf("rapids.tpu.sql.format.parquet.write.enabled").boolean(True)
 CSV_READ_ENABLED = _conf("rapids.tpu.sql.format.csv.read.enabled").boolean(True)
+CSV_DEVICE_PARSE = _conf(
+    "rapids.tpu.sql.format.csv.deviceParse.enabled").doc(
+    "Parse eligible CSV integral columns ON the device: the host finds "
+    "field boundaries in one vectorized pass, raw bytes + offsets upload "
+    "once, and a jitted kernel folds digits into values (reference parses "
+    "CSV on the accelerator the same way, GpuBatchScanExec.scala:474-502). "
+    "Quoted/ragged files and non-integral columns fall back to the host "
+    "Arrow parser."
+).boolean(True)
 ORC_READ_ENABLED = _conf("rapids.tpu.sql.format.orc.read.enabled").boolean(True)
 ORC_WRITE_ENABLED = _conf("rapids.tpu.sql.format.orc.write.enabled").boolean(True)
 

@@ -149,10 +149,10 @@ def _unscaled_to_decimal128(col, dt: DecimalType) -> pa.Array:
     data = np.ascontiguousarray(col.data[:n], dtype=np.int64)
     validity = np.ascontiguousarray(col.validity[:n], dtype=bool)
     if not np.little_endian:
-        vals = [
-            None if not ok else
-            __import__("decimal").Decimal(int(v)).scaleb(-dt.scale)
-            for v, ok in zip(data, validity)]
+        from spark_rapids_tpu.ops.decimal_util import from_unscaled
+
+        vals = [from_unscaled(int(v), dt.scale) if ok else None
+                for v, ok in zip(data, validity)]
         return pa.array(vals, type=pa.decimal128(dt.precision, dt.scale))
     limbs = np.empty((n, 2), dtype=np.int64)
     limbs[:, 0] = np.where(validity, data, 0)

@@ -1,0 +1,253 @@
+"""Device-side CSV numeric parsing.
+
+Reference parity: the reference parses CSV ON the accelerator — the host
+reads line-aligned chunks and cudf tokenizes + converts on device
+(GpuBatchScanExec.scala:322-520, device parse under the semaphore at
+:474-502). The TPU-native split keeps the same control/data-plane shape as
+the parquet device decoder (io/parquet_device.py):
+
+- HOST (control plane, vectorized numpy): one pass over the raw bytes to
+  find field boundaries (separator/newline positions -> a (rows, cols)
+  offset table). No value is converted on the host.
+- DEVICE (data plane): raw bytes + per-field (start, len) upload once; a
+  jitted kernel gathers up to MAXW bytes per field and folds digits into
+  int64 — the conversion FLOPs happen on the accelerator.
+
+Scope (v1): integral columns (INT8..INT64) in structurally simple files —
+no quoted fields (a quote char anywhere falls back to host Arrow), regular
+column count per line. Empty fields are NULL (pyarrow's
+strings_can_be_null oracle behavior); malformed digits are NULL
+(Spark's permissive-mode behavior).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+MAXW = 20  # int64: up to 19 digits + sign
+
+_NL = 0x0A
+_CR = 0x0D
+_QUOTE = 0x22
+_MINUS = 0x2D
+_PLUS = 0x2B
+_ZERO = 0x30
+
+INTEGRAL = (DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64)
+
+
+class FieldTable:
+    """Host-side field offset table for one CSV file."""
+
+    __slots__ = ("raw", "starts", "lens", "num_rows", "header_names",
+                 "_dev_raw")
+
+    def __init__(self, raw, starts, lens, num_rows, header_names):
+        self.raw = raw              # np.uint8 [nbytes]
+        self.starts = starts        # np.int32 [rows, cols]
+        self.lens = lens            # np.int32 [rows, cols]
+        self.num_rows = num_rows
+        self.header_names = header_names  # list[str] | None
+        self._dev_raw = None
+
+    def device_raw(self):
+        """The raw bytes on device — uploaded once per file, shared by
+        every column decode."""
+        if self._dev_raw is None:
+            self._dev_raw = jnp.asarray(self.raw)
+        return self._dev_raw
+
+
+def plan_fields(data: bytes, ncols: int, header: bool,
+                sep: str = ",") -> Optional[FieldTable]:
+    """Field-boundary scan (native single-pass when built, numpy multi-pass
+    fallback). None -> structure too complex for the device path (quotes,
+    ragged rows): caller host-falls-back."""
+    if not data or len(data) > 2 ** 31 - 2:
+        return None
+    sep_b = ord(sep)
+    if sep_b in (_NL, _CR, _QUOTE):
+        return None
+    res = _plan_fields_native(data, ncols, sep_b)
+    if res is NotImplemented:
+        res = _plan_fields_py(data, ncols, sep_b)
+    if res is None:
+        return None
+    arr, starts, lens, n_lines = res
+    return _finish_plan(data, arr, starts, lens, n_lines, ncols, header)
+
+
+def _plan_fields_native(data: bytes, ncols: int, sep_b: int):
+    """Single native sweep (srt_csv_plan). NotImplemented -> no library."""
+    import ctypes
+
+    from spark_rapids_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return NotImplemented
+    est = data.count(b"\n") + (0 if data.endswith(b"\n") else 1)
+    if est <= 0:
+        est = 1
+    starts = np.empty(est * ncols, dtype=np.int32)
+    lens = np.empty(est * ncols, dtype=np.int32)
+    rc = lib.srt_csv_plan(
+        data, len(data), sep_b, ncols,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), est)
+    if rc < 0:
+        return None
+    n_lines = int(rc)
+    arr = np.frombuffer(data, dtype=np.uint8)
+    return (arr, starts[:n_lines * ncols].reshape(n_lines, ncols),
+            lens[:n_lines * ncols].reshape(n_lines, ncols), n_lines)
+
+
+def _plan_fields_py(data: bytes, ncols: int, sep_b: int):
+    """Vectorized numpy fallback for srt_csv_plan."""
+    arr = np.frombuffer(data, dtype=np.uint8)
+    if (arr == _QUOTE).any():
+        return None
+    is_bound = (arr == sep_b) | (arr == _NL)
+    bpos = np.flatnonzero(is_bound).astype(np.int64)
+    # virtual trailing newline when the file doesn't end with one
+    if arr[-1] != _NL:
+        bpos = np.append(bpos, len(arr))
+    n_fields = len(bpos)
+    if n_fields % ncols != 0:
+        return None
+    n_lines = n_fields // ncols
+    ends = bpos.reshape(n_lines, ncols)
+    # every line's last boundary must be a newline (or the virtual EOF one),
+    # and no interior boundary may be a newline — else the reshape is wrong
+    interior = ends[:, :-1].ravel()
+    if interior.size and (arr[interior] == _NL).any():
+        return None
+    # ...and every line-final boundary must be a newline (the last may be
+    # the virtual EOF boundary)
+    line_final = ends[:, -1]
+    real = line_final[line_final < len(arr)]
+    if real.size and (arr[real] != _NL).any():
+        return None
+    starts = np.empty_like(ends)
+    starts[:, 0] = np.concatenate(([0], ends[:-1, -1] + 1))
+    starts[:, 1:] = ends[:, :-1] + 1
+    lens = ends - starts
+    # tolerate CRLF: trim a trailing \r from the last field of each line
+    last_ends = ends[:, -1]
+    has_cr = np.zeros(n_lines, dtype=bool)
+    nonempty = lens[:, -1] > 0
+    prev = np.clip(last_ends - 1, 0, len(arr) - 1)
+    has_cr[nonempty] = arr[prev[nonempty]] == _CR
+    lens[:, -1] -= has_cr.astype(np.int32)
+    return arr, starts, lens, n_lines
+
+
+def _finish_plan(data: bytes, arr, starts, lens, n_lines: int, ncols: int,
+                 header: bool) -> Optional[FieldTable]:
+    if ncols == 1:
+        # blank lines are SKIPPED lines, not NULL rows (pyarrow's
+        # ignore_empty_lines oracle behavior); only reachable for
+        # single-column files — a blank line is ragged otherwise
+        keep = lens[:, 0] > 0
+        if header and n_lines >= 1:
+            keep[0] = True  # never drop the header row
+        if not keep.all():
+            starts = starts[keep]
+            lens = lens[keep]
+            n_lines = int(keep.sum())
+    header_names = None
+    if header:
+        if n_lines < 1:
+            return None
+        header_names = [
+            data[starts[0, j]:starts[0, j] + lens[0, j]].decode(
+                "utf-8", errors="replace").strip()
+            for j in range(ncols)]
+        starts = starts[1:]
+        lens = lens[1:]
+        n_lines -= 1
+    return FieldTable(arr, np.ascontiguousarray(starts, dtype=np.int32),
+                      np.ascontiguousarray(lens, dtype=np.int32),
+                      n_lines, header_names)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _parse_int_kernel(raw, starts, lens, maxw: int):
+    """Fold up to `maxw` gathered bytes per field into int64 + validity.
+    Optional +/- sign, then digits only; empty or malformed -> invalid."""
+    idx = starts[:, None].astype(jnp.int32) + \
+        jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    ch = raw[jnp.clip(idx, 0, raw.shape[0] - 1)]
+    inb = jnp.arange(maxw, dtype=jnp.int32)[None, :] < lens[:, None]
+    ch = jnp.where(inb, ch, 0)
+    first = ch[:, 0]
+    neg = first == _MINUS
+    skip = ((first == _MINUS) | (first == _PLUS)).astype(jnp.int32)
+    digits = ch.astype(jnp.int32) - _ZERO
+    isdig = (digits >= 0) & (digits <= 9)
+    pos = jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    digpos = (pos >= skip[:, None]) & inb
+    all_digits = jnp.all(jnp.where(digpos, isdig, True), axis=1)
+    ndig = lens - skip
+    ok = all_digits & (ndig > 0) & (lens <= maxw)
+    val = jnp.zeros(starts.shape[0], dtype=jnp.int64)
+    imax = jnp.int64(np.iinfo(np.int64).max)
+    overflow = jnp.zeros(starts.shape[0], dtype=bool)
+    for i in range(maxw):
+        d = jnp.where(isdig[:, i], digits[:, i], 0).astype(jnp.int64)
+        # detect BEFORE the fold can wrap: val*10 + d > int64max
+        overflow = overflow | (digpos[:, i] & (val > (imax - d) // 10))
+        val = jnp.where(digpos[:, i], val * 10 + d, val)
+    val = jnp.where(neg, -val, val)
+    # magnitudes beyond int64 are NULL, never a wrapped value (this also
+    # nulls the exact string "-9223372036854775808"; documented corner)
+    validity = ok & (lens > 0) & ~overflow
+    return jnp.where(validity, val, 0), validity
+
+
+def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
+                      cap: int):
+    """Parse one integral column on device, padded to `cap` rows. Returns
+    (data, validity) device arrays in the column's physical dtype."""
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    n = table.num_rows
+    starts = np.zeros(cap, dtype=np.int32)
+    lens = np.zeros(cap, dtype=np.int32)
+    starts[:n] = table.starts[:, col_idx]
+    lens[:n] = table.lens[:, col_idx]
+    val, validity = _parse_int_kernel(table.device_raw(),
+                                      jnp.asarray(starts),
+                                      jnp.asarray(lens), MAXW)
+    npdt = physical_np_dtype(dtype)
+    if npdt != np.dtype(np.int64):
+        # values outside the narrow type's range are NULL (Spark permissive
+        # mode), never a truncated wrap
+        info = np.iinfo(npdt)
+        in_range = (val >= info.min) & (val <= info.max)
+        validity = validity & in_range
+        val = jnp.where(in_range, val, 0).astype(npdt)
+    row_mask = jnp.arange(cap) < n
+    return val, validity & row_mask
+
+
+def eligible_attrs(attrs, header_names: Optional[List[str]],
+                   attr_names_in_file_order: List[str]) -> dict:
+    """Map attr name -> file column index for device-parseable columns."""
+    order = header_names if header_names is not None \
+        else attr_names_in_file_order
+    out = {}
+    for a in attrs:
+        if a.data_type in INTEGRAL and a.name in order:
+            out[a.name] = order.index(a.name)
+    return out

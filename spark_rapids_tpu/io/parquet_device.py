@@ -58,6 +58,8 @@ class _Compact:
     def varint(self) -> int:
         out = shift = 0
         while True:
+            if shift > 63:
+                raise ValueError("malformed varint")
             b = self.buf[self.pos]
             self.pos += 1
             out |= (b & 0x7F) << shift
@@ -112,6 +114,12 @@ class _Compact:
             et = b & 0x0F
             if n == 15:
                 n = self.varint()
+            if et in (1, 2):         # bools consume no bytes: nothing to walk
+                return []
+            if n > len(self.buf) - self.pos:
+                # each remaining element needs >= 1 byte; a count beyond the
+                # buffer is corruption, not a long loop
+                raise ValueError("malformed thrift list length")
             return [self._value(et) for _ in range(n)]
         if ftype == 12:              # struct
             return self.struct()
@@ -149,7 +157,50 @@ class PageInfo:
 
 
 def parse_pages(chunk: bytes) -> List[PageInfo]:
-    """Walk the page headers of one raw column chunk."""
+    """Walk the page headers of one raw column chunk (native single pass
+    when built, thrift-in-Python fallback)."""
+    pages = _parse_pages_native(chunk)
+    if pages is not NotImplemented:
+        return pages
+    return _parse_pages_py(chunk)
+
+
+def _parse_pages_native(chunk: bytes):
+    import ctypes
+
+    from spark_rapids_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return NotImplemented
+    max_pages = 64
+    while True:
+        kind = np.empty(max_pages, np.int32)
+        num_values = np.empty(max_pages, np.int64)
+        encoding = np.empty(max_pages, np.int32)
+        data_start = np.empty(max_pages, np.int64)
+        data_len = np.empty(max_pages, np.int64)
+        n = lib.srt_parse_pages(
+            chunk, len(chunk),
+            kind.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            num_values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            encoding.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            data_start.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            data_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_pages)
+        if n == -1:
+            max_pages *= 8
+            continue
+        if n == -4:
+            raise _Unsupported("page type not v1/dict")
+        if n < 0:
+            return NotImplemented  # malformed per native: let python decide
+        return [PageInfo(int(kind[i]), int(num_values[i]), int(encoding[i]),
+                         int(data_start[i]), int(data_len[i]))
+                for i in range(n)]
+
+
+def _parse_pages_py(chunk: bytes) -> List[PageInfo]:
     pages: List[PageInfo] = []
     pos = 0
     while pos < len(chunk):

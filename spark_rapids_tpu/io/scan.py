@@ -310,11 +310,18 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
 
         device_decode = self.fmt == "parquet" and \
             ctx.conf.get(C.PARQUET_DEVICE_DECODE)
+        device_csv = self.fmt == "csv" and ctx.conf.get(C.CSV_DEVICE_PARSE)
 
         def factory(pidx: int):
             def gen():
                 if device_decode:
                     batches = self._read_device(self.splits[pidx], ctx.conf)
+                    if batches is not None:
+                        yield from batches
+                        return
+                if device_csv:
+                    batches = self._read_device_csv(self.splits[pidx],
+                                                    ctx.conf)
                     if batches is not None:
                         yield from batches
                         return
@@ -325,6 +332,119 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
             return count_output(self.metrics, gen())
 
         return PartitionedBatches(len(self.splits), factory)
+
+    def _read_device_csv(self, split: FileSplit, conf):
+        """Device CSV parse for one split; None -> structure/columns not
+        eligible (caller uses the host Arrow path). Mirrors _read_device:
+        integral columns parse on device from the raw bytes, everything
+        else host-parses and uploads."""
+        from spark_rapids_tpu import conf as C2
+        from spark_rapids_tpu.columnar.batch import (
+            ColumnarBatch,
+            ColumnVector,
+            bucket_capacity,
+        )
+        from spark_rapids_tpu.io import csv_device as CD
+        from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
+
+        pv = dict(split.partition_values)
+        data_attrs = [a for a in self.attrs if a.name not in pv]
+        if not any(a.data_type in CD.INTEGRAL for a in data_attrs):
+            return None
+        header = _to_bool(split.opt("header", False))
+        sep = split.opt("sep", split.opt("delimiter", ","))
+        if not isinstance(sep, str) or len(sep) != 1:
+            return None
+        with open(split.path, "rb") as f:
+            data = f.read()
+        if not data:
+            return None
+        first_nl = data.find(b"\n")
+        first_line = data[:first_nl if first_nl >= 0 else len(data)]
+        ncols = first_line.count(sep.encode()) + 1
+        if not header and ncols != len(data_attrs):
+            return None
+        table = CD.plan_fields(data, ncols, header, sep)
+        if table is None:
+            return None
+        eligible = CD.eligible_attrs(data_attrs, table.header_names,
+                                     [a.name for a in data_attrs])
+        if not eligible:
+            return None
+        rows = table.num_rows
+        cap = bucket_capacity(max(rows, 1))
+        TpuSemaphore.get().acquire_if_necessary(current_task_id())
+        dev_cols = {}
+        for a in data_attrs:
+            if a.name not in eligible:
+                continue
+            d, v = CD.decode_int_column(table, eligible[a.name],
+                                        a.data_type, cap)
+            dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+        rest = [a for a in data_attrs if a.name not in dev_cols]
+        hb = None
+        if rest:
+            # host-parse ONLY the non-device columns, from the bytes already
+            # in memory — never a second disk read, never re-converting the
+            # columns the device just parsed
+            import pyarrow as pa
+            import pyarrow.csv as pc
+
+            from spark_rapids_tpu.io.arrow_convert import dt_to_arrow_type
+
+            all_names = table.header_names if header \
+                else [a.name for a in data_attrs]
+            read_opts = pc.ReadOptions(
+                column_names=None if header else all_names)
+            convert = pc.ConvertOptions(
+                column_types={a.name: dt_to_arrow_type(a.data_type)
+                              for a in rest},
+                include_columns=[a.name for a in rest],
+                strings_can_be_null=True)
+            tbl = pc.read_csv(
+                pa.BufferReader(data), read_options=read_opts,
+                parse_options=pc.ParseOptions(delimiter=sep),
+                convert_options=convert)
+            hb = arrow_to_host_batch(tbl, rest)
+            if hb.num_rows != rows:
+                return None  # host parser disagrees: fall back
+        return self._assemble_device_batch(dev_cols, hb, rest, pv, rows,
+                                           conf)
+
+    def _assemble_device_batch(self, dev_cols, hb, rest, pv, rows, conf):
+        """Combine device-decoded columns with a host-decoded partial batch
+        (+ partition-value columns) into output batches, sliced to
+        MAX_READ_BATCH_SIZE_ROWS. Shared by the parquet and CSV device read
+        paths — their mixed-batch assembly must never diverge."""
+        from spark_rapids_tpu import conf as C2
+        from spark_rapids_tpu.columnar.batch import (
+            ColumnarBatch,
+            slice_batch_host,
+        )
+
+        host_part = None
+        host_names: List[str] = []
+        if hb is None and pv:
+            hb = HostColumnarBatch([], rows)
+        if hb is not None:
+            if pv:
+                hb = _with_partition_columns(
+                    hb, rest + [a for a in self.attrs if a.name in pv], pv)
+            host_part = hb.to_device()
+            host_names = [a.name for a in rest] + \
+                [a.name for a in self.attrs if a.name in pv]
+        cols = []
+        for a in self.attrs:
+            if a.name in dev_cols:
+                cols.append(dev_cols[a.name])
+            else:
+                cols.append(host_part.columns[host_names.index(a.name)])
+        batch = ColumnarBatch(cols, rows)
+        max_rows = conf.get(C2.MAX_READ_BATCH_SIZE_ROWS)
+        if rows <= max_rows:
+            return [batch]
+        return [slice_batch_host(batch, i, max_rows)
+                for i in range(0, rows, max_rows)]
 
     def _read_device(self, split: FileSplit, conf):
         """Device decode for one split; None -> no column qualified (caller
@@ -379,33 +499,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback
                 dev_cols[a.name] = ColumnVector(a.data_type, data, validity)
-            host_part = None
+            hb = None
             if rest or pv:
                 sub = FileSplit(split.path, "parquet", (rg,), split.options,
                                 split.partition_values)
                 table = read_split(sub, rest)
                 hb = arrow_to_host_batch(table, rest)
-                if pv:
-                    hb = _with_partition_columns(
-                        hb, rest + [a for a in self.attrs if a.name in pv],
-                        pv)
-                host_part = hb.to_device()
-                host_names = [a.name for a in rest] + \
-                    [a.name for a in self.attrs if a.name in pv]
-            cols = []
-            for a in self.attrs:
-                if a.name in dev_cols:
-                    cols.append(dev_cols[a.name])
-                else:
-                    cv = host_part.columns[host_names.index(a.name)]
-                    cols.append(cv)
-            batch = ColumnarBatch(cols, rows)
-            max_rows = conf.get(C2.MAX_READ_BATCH_SIZE_ROWS)
-            if rows <= max_rows:
-                out.append(batch)
-            else:
-                from spark_rapids_tpu.columnar.batch import slice_batch_host
-
-                out.extend(slice_batch_host(batch, i, max_rows)
-                           for i in range(0, rows, max_rows))
+            out.extend(self._assemble_device_batch(dev_cols, hb, rest, pv,
+                                                   rows, conf))
         return out

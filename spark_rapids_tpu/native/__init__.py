@@ -66,5 +66,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.srt_parse_pages.restype = ctypes.c_int64
+        lib.srt_parse_pages.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
+        lib.srt_csv_plan.restype = ctypes.c_int64
+        lib.srt_csv_plan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint8,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
         _lib = lib
         return _lib

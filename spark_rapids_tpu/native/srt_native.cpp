@@ -2,10 +2,12 @@
 //
 // Reference parity: the reference's host control plane is C++/JVM-native
 // (parquet-mr page walking + cudf's C++ RLE machinery feeding the GPU
-// decoder, GpuParquetScan.scala:316-458). Here the TPU framework keeps the
-// same split: the device data plane is XLA, and these byte-level host loops
-// — RLE/bit-packed run-table extraction and serialized-batch string offset
-// encoding — run natively instead of interpreting bytes in Python.
+// decoder, GpuParquetScan.scala:316-458; cudf's C++ CSV tokenizer feeding
+// the device parser, GpuBatchScanExec.scala:322-520). Here the TPU
+// framework keeps the same split: the device data plane is XLA, and these
+// byte-level host loops — RLE/bit-packed run-table extraction, thrift
+// page-header walking, and CSV field-boundary scanning — run natively
+// instead of interpreting bytes in Python.
 //
 // Built as a plain shared object; Python binds via ctypes
 // (spark_rapids_tpu/native/__init__.py) and falls back to the pure-Python
@@ -13,6 +15,94 @@
 
 #include <cstdint>
 #include <cstring>
+
+// ---------------------------------------------------------------------------
+// Thrift compact-protocol reader (just enough for parquet PageHeader).
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Reader {
+    const uint8_t* buf;
+    int64_t pos;
+    int64_t end;
+    bool err = false;
+
+    uint64_t varint() {
+        uint64_t out = 0;
+        int shift = 0;
+        for (;;) {
+            if (pos >= end || shift > 63) { err = true; return 0; }
+            uint8_t b = buf[pos++];
+            out |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) return out;
+            shift += 7;
+        }
+    }
+
+    int64_t zigzag() {
+        uint64_t v = varint();
+        return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+    }
+
+    void skip_value(int ftype);
+
+    // Parse a struct, reporting (fid, ftype) to `cb`; the callback returns
+    // true when it consumed the value itself (possibly recursing).
+    template <typename F>
+    void parse_struct(F&& cb) {
+        int64_t fid = 0;
+        for (;;) {
+            if (pos >= end) { err = true; return; }
+            uint8_t b = buf[pos++];
+            if (b == 0) return;
+            int delta = b >> 4;
+            int ftype = b & 0x0F;
+            fid = delta ? fid + delta : zigzag();
+            if (err) return;
+            if (!cb(fid, ftype, *this)) skip_value(ftype);
+            if (err) return;
+        }
+    }
+};
+
+void Reader::skip_value(int ftype) {
+    // every length below is validated against the remaining bytes BEFORE
+    // advancing — corrupt varints must never move `pos` backward or spin
+    // (the python fallback throws on the same inputs; native must too)
+    switch (ftype) {
+        case 1: case 2: return;            // bool encoded in the type
+        case 3: ++pos; return;             // i8
+        case 4: case 5: case 6: zigzag(); return;
+        case 7: pos += 8; return;          // double
+        case 8: {                          // binary/string
+            uint64_t n = varint();
+            if (err || n > (uint64_t)(end - pos)) { err = true; return; }
+            pos += (int64_t)n;
+            return;
+        }
+        case 9: case 10: {                 // list/set
+            if (pos >= end) { err = true; return; }
+            uint8_t b = buf[pos++];
+            uint64_t n = b >> 4;
+            int et = b & 0x0F;
+            if (n == 15) n = varint();
+            if (err) return;
+            if (et == 1 || et == 2) return;  // bools consume no bytes
+            // each remaining element consumes >= 1 byte; a count beyond
+            // the buffer is malformed, not a long loop
+            if (n > (uint64_t)(end - pos)) { err = true; return; }
+            for (uint64_t i = 0; i < n && !err; ++i) skip_value(et);
+            return;
+        }
+        case 12:                           // struct
+            parse_struct([](int64_t, int, Reader&) { return false; });
+            return;
+        default:
+            err = true;
+    }
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -50,6 +140,11 @@ int64_t srt_parse_runs(const uint8_t* buf, int64_t start, int64_t end,
         if (n >= max_runs) return -1;
         if (header & 1) {  // bit-packed: (header>>1) groups of 8 values
             int64_t groups = (int64_t)(header >> 1);
+            // a group count whose bytes run past the stream is malformed —
+            // reject before the multiply can overflow or move pos wild
+            if (groups < 0 || (bit_width > 0 &&
+                               groups > (end - pos) / bit_width + 1))
+                return -2;
             out_start[n] = produced;
             is_rle[n] = 0;
             value[n] = 0;
@@ -75,6 +170,128 @@ int64_t srt_parse_runs(const uint8_t* buf, int64_t start, int64_t end,
     }
     *produced_out = produced;
     return n;
+}
+
+// Walk the page headers of one raw column chunk (python fallback:
+// io/parquet_device.py parse_pages). Returns the page count or
+//   -1 : max_pages too small      -2 : malformed thrift
+//   -4 : unsupported page type (v2 etc.) — caller falls back to Arrow
+int64_t srt_parse_pages(const uint8_t* buf, int64_t len,
+                        int32_t* kind, int64_t* num_values,
+                        int32_t* encoding, int64_t* data_start,
+                        int64_t* data_len, int64_t max_pages) {
+    int64_t n = 0;
+    int64_t pos = 0;
+    while (pos < len) {
+        Reader r{buf, pos, len};
+        int64_t ph_type = -1, ph_comp = -1;
+        int64_t dp_num = -1, dp_enc = -1, di_num = -1;
+        r.parse_struct([&](int64_t fid, int ftype, Reader& rr) {
+            if (fid == 1 && ftype >= 4 && ftype <= 6) {        // page type
+                ph_type = rr.zigzag();
+                return true;
+            }
+            if (fid == 3 && ftype >= 4 && ftype <= 6) {        // comp. size
+                ph_comp = rr.zigzag();
+                return true;
+            }
+            if (fid == 5 && ftype == 12) {                     // data v1 hdr
+                rr.parse_struct([&](int64_t f2, int t2, Reader& r2) {
+                    if (f2 == 1 && t2 >= 4 && t2 <= 6) {
+                        dp_num = r2.zigzag();
+                        return true;
+                    }
+                    if (f2 == 2 && t2 >= 4 && t2 <= 6) {
+                        dp_enc = r2.zigzag();
+                        return true;
+                    }
+                    return false;
+                });
+                return true;
+            }
+            if (fid == 7 && ftype == 12) {                     // dict hdr
+                rr.parse_struct([&](int64_t f2, int t2, Reader& r2) {
+                    if (f2 == 1 && t2 >= 4 && t2 <= 6) {
+                        di_num = r2.zigzag();
+                        return true;
+                    }
+                    return false;
+                });
+                return true;
+            }
+            return false;
+        });
+        if (r.err || ph_comp < 0 || ph_type < 0) return -2;
+        if (ph_comp > len - r.pos) return -2;  // payload past the buffer
+        if (n >= max_pages) return -1;
+        if (ph_type == 2) {            // dictionary page
+            kind[n] = 2;
+            num_values[n] = di_num;
+            encoding[n] = 0;           // dict payload reads as PLAIN
+        } else if (ph_type == 0) {     // data page v1
+            if (dp_num < 0 || dp_enc < 0) return -2;
+            kind[n] = 0;
+            num_values[n] = dp_num;
+            encoding[n] = (int32_t)dp_enc;
+        } else {
+            return -4;
+        }
+        data_start[n] = r.pos;
+        data_len[n] = ph_comp;
+        ++n;
+        pos = r.pos + ph_comp;
+    }
+    return n;
+}
+
+// Single-pass CSV field-boundary scan (the host control plane of the
+// device CSV parser, io/csv_device.py). Replaces a multi-pass numpy scan
+// with one cache-friendly sweep that simultaneously finds boundaries,
+// validates column counts per line, rejects quoted fields, and trims CRLF.
+//
+// Returns the number of data rows written, or
+//   -1 : structure not eligible (quote char seen, ragged line)
+//   -3 : more rows than max_rows (caller re-allocates and retries)
+//
+//   starts/lens : int32 [max_rows * ncols], row-major
+int64_t srt_csv_plan(const uint8_t* buf, int64_t len, uint8_t sep,
+                     int32_t ncols, int32_t* starts, int32_t* lens,
+                     int64_t max_rows) {
+    if (len <= 0) return -1;
+    int64_t row = 0;
+    int32_t col = 0;
+    int64_t field_start = 0;
+    for (int64_t i = 0; i <= len; ++i) {
+        const bool at_eof = (i == len);
+        const uint8_t c = at_eof ? (uint8_t)'\n' : buf[i];
+        if (c == (uint8_t)'"') return -1;
+        if (c == sep || c == (uint8_t)'\n') {
+            // EOF acts as a virtual newline only for a non-empty last line
+            if (at_eof && col == 0 && field_start == i) break;
+            if (c == sep) {
+                if (col >= ncols - 1) return -1;  // too many fields
+            } else {
+                if (col != ncols - 1) return -1;  // too few fields
+            }
+            if (row >= max_rows) return -3;
+            int32_t flen = (int32_t)(i - field_start);
+            // trim a trailing \r before a newline (CRLF files)
+            if (c == (uint8_t)'\n' && flen > 0 &&
+                buf[i - 1] == (uint8_t)'\r')
+                --flen;
+            starts[row * ncols + col] = (int32_t)field_start;
+            lens[row * ncols + col] = flen;
+            field_start = i + 1;
+            if (c == sep) {
+                ++col;
+            } else {
+                col = 0;
+                ++row;
+            }
+        }
+    }
+    if (col != 0) return -1;  // dangling partial line (shouldn't happen)
+    return row;
 }
 
 }  // extern "C"

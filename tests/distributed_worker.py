@@ -1,0 +1,59 @@
+"""Worker for the 2-process distributed test: joins the coordination
+service, builds the 8-device global mesh (4 virtual CPU devices per
+process), runs the flagship distributed agg step SPMD, and prints a JSON
+line with replicated results. Run via tests/test_distributed.py."""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    from spark_rapids_tpu.parallel import distributed as D
+
+    assert D.init_distributed(), "expected multi-process env"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu.parallel.mesh import distributed_agg_step
+
+    mesh = D.global_mesh()
+    n_shards = len(mesh.devices.ravel())
+    pid = D.process_index()
+    nproc = D.process_count()
+    cap, bucket_cap = 256, 256
+
+    rng = np.random.default_rng(11)  # same on every process
+    keys = rng.integers(0, 23, (n_shards, cap)).astype(np.int64)
+    values = rng.integers(-100, 100, (n_shards, cap)).astype(np.int64)
+    valid = rng.random((n_shards, cap)) < 0.9
+
+    local = slice(pid * n_shards // nproc, (pid + 1) * n_shards // nproc)
+    ks = D.shard_host_data(keys[local], mesh)
+    vs = D.shard_host_data(values[local], mesh)
+    vd = D.shard_host_data(valid[local], mesh)
+
+    step = distributed_agg_step(mesh, n_shards, cap, bucket_cap)
+    fkeys, fsums, fvalid, total_groups = step(ks, vs, vd)
+
+    # replicated global checksum over the sharded outputs
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    checksum = jax.jit(
+        lambda s, v: jnp.sum(jnp.where(v, s, 0)),
+        out_shardings=NamedSharding(mesh, P()))(fsums, fvalid)
+    groups = int(np.asarray(total_groups.addressable_data(0))[0])
+    print(json.dumps({
+        "pid": pid,
+        "devices": n_shards,
+        "local_devices": len(jax.local_devices()),
+        "groups": groups,
+        "checksum": int(np.asarray(checksum.addressable_data(0))),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

@@ -1,0 +1,121 @@
+"""Device-side CSV parse equivalence (reference: cudf device CSV parse,
+GpuBatchScanExec.scala:474-502; host Arrow remains the oracle)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.io import csv_device as CD
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+
+# ------------------------------------------------------------- kernel units
+def test_plan_fields_basic():
+    t = CD.plan_fields(b"1,2,3\n40,-5,60\n", 3, header=False)
+    assert t.num_rows == 2
+    assert t.lens.tolist() == [[1, 1, 1], [2, 2, 2]]
+
+
+def test_plan_fields_crlf_and_no_trailing_newline():
+    t = CD.plan_fields(b"7,8\r\n9,10", 2, header=False)
+    assert t.num_rows == 2
+    assert t.lens.tolist() == [[1, 1], [1, 2]]
+
+
+def test_plan_fields_header():
+    t = CD.plan_fields(b"a,b\n1,2\n", 2, header=True)
+    assert t.header_names == ["a", "b"]
+    assert t.num_rows == 1
+
+
+def test_plan_fields_rejects_quotes_and_ragged():
+    assert CD.plan_fields(b'a,"x,y"\n1,2\n', 2, header=False) is None
+    assert CD.plan_fields(b"1,2\n3\n", 2, header=False) is None
+
+
+def test_decode_int_column_values():
+    t = CD.plan_fields(b"12,-7\n+30,\nx,9223372036854775807\n", 2,
+                       header=False)
+    d, v = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    assert list(np.asarray(v)) == [True, True, False, False]
+    assert list(np.asarray(d))[:2] == [12, 30]
+    d, v = CD.decode_int_column(t, 1, DataType.INT64, 4)
+    # empty field -> null; 19-digit max parses exactly
+    assert list(np.asarray(v)) == [True, False, True, False]
+    assert np.asarray(d)[2] == 9223372036854775807
+
+
+def test_decode_int_overflow_is_null():
+    # 19-digit > int64max, and 25-digit: NULL, never a wrapped value
+    t = CD.plan_fields(b"9999999999999999999,1\n"
+                       b"1234567890123456789012345,2\n"
+                       b"9223372036854775807,3\n", 2, header=False)
+    d, v = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    assert list(np.asarray(v)) == [False, False, True, False]
+    assert np.asarray(d)[2] == np.iinfo(np.int64).max
+
+
+def test_decode_narrow_type_out_of_range_is_null():
+    t = CD.plan_fields(b"300\n-129\n127\n-128\n", 1, header=False)
+    d, v = CD.decode_int_column(t, 0, DataType.INT8, 4)
+    assert list(np.asarray(v)) == [False, False, True, True]
+    assert list(np.asarray(d)[2:]) == [127, -128]
+
+
+def test_single_column_blank_lines_skipped():
+    # pyarrow skips empty lines (ignore_empty_lines); the device plan must
+    # agree, not produce NULL rows
+    t = CD.plan_fields(b"1\n2\n\n3\n", 1, header=False)
+    assert t.num_rows == 3
+    d, v = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    assert list(np.asarray(d)[:3]) == [1, 2, 3]
+    assert all(np.asarray(v)[:3])
+
+
+# --------------------------------------------------------------- end to end
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_csv_device_parse_equivalence(session, tmp_path):
+    rng = np.random.default_rng(3)
+    lines = [f"{rng.integers(-1000, 1000)},{rng.integers(0, 50)},s{i}"
+             for i in range(500)]
+    # sprinkle empty numeric fields (NULLs)
+    lines[10] = ",5,s10"
+    lines[20] = "7,,s20"
+    path = _write(tmp_path, "t.csv", "\n".join(lines) + "\n")
+
+    def q(s):
+        return (s.read.schema([("a", "long"), ("b", "int"), ("c", "string")])
+                .csv(path)
+                .filter(F.col("b") > 10)
+                .groupBy("b").agg(F.sum("a").alias("sa"),
+                                  F.count("*").alias("n")))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_csv_device_parse_header_equivalence(session, tmp_path):
+    path = _write(tmp_path, "h.csv",
+                  "x,y\n1,alpha\n-2,beta\n30,gamma\n,delta\n")
+
+    def q(s):
+        return s.read.schema([("x", "long"), ("y", "string")]) \
+            .csv(path, header=True).orderBy("x")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_csv_quoted_falls_back_correct(session, tmp_path):
+    path = _write(tmp_path, "q.csv", 'a,b\n1,"x,y"\n2,plain\n')
+
+    def q(s):
+        return s.read.schema([("a", "long"), ("b", "string")]) \
+            .csv(path, header=True).orderBy("a")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)

@@ -1,0 +1,68 @@
+"""Multi-host (multi-process) distributed backend test: two OS processes,
+4 virtual CPU devices each, joined through jax.distributed into one
+8-device global mesh running the flagship SPMD agg step.
+
+Reference parity: the role of the reference's multi-executor UCX shuffle
+tested without a cluster (RapidsShuffleTestHelper.scala mocks transport;
+here two real processes exercise the real coordination service + gloo
+cross-process collectives)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _host_reference(n_shards=8, cap=256):
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 23, (n_shards, cap)).astype(np.int64)
+    values = rng.integers(-100, 100, (n_shards, cap)).astype(np.int64)
+    valid = rng.random((n_shards, cap)) < 0.9
+    keep = valid & (values % 3 != 0)
+    proj = np.where(keep, values * 2 + 1, 0)
+    groups = np.unique(keys[keep])
+    return len(groups), int(proj[keep].sum())
+
+
+def test_two_process_distributed_agg():
+    from spark_rapids_tpu.utils.hostenv import scrubbed_cpu_env
+
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = scrubbed_cpu_env(4)  # 4 virtual CPU devices per process
+        env.update({
+            "SRT_COORDINATOR": f"127.0.0.1:{port}",
+            "SRT_NUM_PROCESSES": "2",
+            "SRT_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "distributed_worker.py")],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        outs.append(json.loads(line))
+
+    exp_groups, exp_checksum = _host_reference()
+    for o in outs:
+        assert o["devices"] == 8
+        assert o["local_devices"] == 4
+        assert o["groups"] == exp_groups
+        assert o["checksum"] == exp_checksum

@@ -255,6 +255,16 @@ PARQUET_DEVICE_DECODE = _conf(
     "the host Arrow decoder per column."
 ).boolean(True)
 PARQUET_WRITE_ENABLED = _conf("rapids.tpu.sql.format.parquet.write.enabled").boolean(True)
+PARQUET_DEVICE_ENCODE = _conf(
+    "rapids.tpu.sql.format.parquet.deviceEncode.enabled").doc(
+    "Encode parquet ON the device (reference encodes on the accelerator, "
+    "ColumnarOutputWriter.scala:62-177): non-null values compact and "
+    "validity bit-packs in one jitted kernel per column, and only the "
+    "encoded PLAIN page payload downloads. Applies to fixed-width schemas "
+    "written with an explicit compression=none and no partitionBy; "
+    "everything else (including the snappy default) uses the host Arrow "
+    "writer."
+).boolean(True)
 CSV_READ_ENABLED = _conf("rapids.tpu.sql.format.csv.read.enabled").boolean(True)
 CSV_DEVICE_PARSE = _conf(
     "rapids.tpu.sql.format.csv.deviceParse.enabled").doc(

@@ -183,8 +183,11 @@ def _finish_plan(data: bytes, arr, starts, lens, n_lines: int, ncols: int,
 
 @functools.partial(jax.jit, static_argnums=(3,))
 def _parse_int_kernel(raw, starts, lens, maxw: int):
-    """Fold up to `maxw` gathered bytes per field into int64 + validity.
-    Optional +/- sign, then digits only; empty or malformed -> invalid."""
+    """Fold up to `maxw` gathered bytes per field into int64. Returns
+    (values, validity, malformed): empty fields are NULL; anything else the
+    strict grammar ('-' then digits, in int64 range — what the pyarrow host
+    oracle accepts) does not cover is MALFORMED, and the caller abandons the
+    device path for the whole split so both engines raise identically."""
     idx = starts[:, None].astype(jnp.int32) + \
         jnp.arange(maxw, dtype=jnp.int32)[None, :]
     ch = raw[jnp.clip(idx, 0, raw.shape[0] - 1)]
@@ -192,7 +195,7 @@ def _parse_int_kernel(raw, starts, lens, maxw: int):
     ch = jnp.where(inb, ch, 0)
     first = ch[:, 0]
     neg = first == _MINUS
-    skip = ((first == _MINUS) | (first == _PLUS)).astype(jnp.int32)
+    skip = neg.astype(jnp.int32)  # '+' is malformed, matching pyarrow
     digits = ch.astype(jnp.int32) - _ZERO
     isdig = (digits >= 0) & (digits <= 9)
     pos = jnp.arange(maxw, dtype=jnp.int32)[None, :]
@@ -209,16 +212,19 @@ def _parse_int_kernel(raw, starts, lens, maxw: int):
         overflow = overflow | (digpos[:, i] & (val > (imax - d) // 10))
         val = jnp.where(digpos[:, i], val * 10 + d, val)
     val = jnp.where(neg, -val, val)
-    # magnitudes beyond int64 are NULL, never a wrapped value (this also
-    # nulls the exact string "-9223372036854775808"; documented corner)
-    validity = ok & (lens > 0) & ~overflow
-    return jnp.where(validity, val, 0), validity
+    nonempty = lens > 0
+    validity = ok & nonempty & ~overflow
+    malformed = nonempty & ~validity
+    return jnp.where(validity, val, 0), validity, malformed
 
 
 def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
                       cap: int):
     """Parse one integral column on device, padded to `cap` rows. Returns
-    (data, validity) device arrays in the column's physical dtype."""
+    (data, validity) device arrays in the column's physical dtype, or None
+    when any field is malformed or out of the target type's range — the
+    caller must fall back to the host parser, which raises the same error
+    on both engines."""
     from spark_rapids_tpu.columnar.batch import physical_np_dtype
 
     n = table.num_rows
@@ -226,18 +232,19 @@ def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
     lens = np.zeros(cap, dtype=np.int32)
     starts[:n] = table.starts[:, col_idx]
     lens[:n] = table.lens[:, col_idx]
-    val, validity = _parse_int_kernel(table.device_raw(),
-                                      jnp.asarray(starts),
-                                      jnp.asarray(lens), MAXW)
+    row_mask = jnp.arange(cap) < n
+    val, validity, malformed = _parse_int_kernel(table.device_raw(),
+                                                 jnp.asarray(starts),
+                                                 jnp.asarray(lens), MAXW)
+    malformed = malformed & row_mask
     npdt = physical_np_dtype(dtype)
     if npdt != np.dtype(np.int64):
-        # values outside the narrow type's range are NULL (Spark permissive
-        # mode), never a truncated wrap
         info = np.iinfo(npdt)
         in_range = (val >= info.min) & (val <= info.max)
-        validity = validity & in_range
+        malformed = malformed | (validity & ~in_range & row_mask)
         val = jnp.where(in_range, val, 0).astype(npdt)
-    row_mask = jnp.arange(cap) < n
+    if bool(jax.device_get(jnp.any(malformed))):
+        return None
     return val, validity & row_mask
 
 

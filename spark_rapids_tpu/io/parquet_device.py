@@ -385,40 +385,89 @@ def column_eligible(col_meta, dtype: DataType) -> bool:
     encodings; reference analog: GpuParquetScan tagging)."""
     if col_meta.compression != "UNCOMPRESSED":
         return False
+    ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}
+    if not set(col_meta.encodings) <= ok_enc:
+        return False
+    if col_meta.physical_type == "BYTE_ARRAY":
+        # strings decode via dictionary gather; PLAIN byte-array data pages
+        # surface as _Unsupported at decode time (whole-split host fallback)
+        return dtype is DataType.STRING and \
+            col_meta.dictionary_page_offset is not None
     if col_meta.physical_type not in _PHYS_OK:
         return False
     if dtype is DataType.FLOAT64 and not device_float64_supported():
         return False
-    ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}
-    return set(col_meta.encodings) <= ok_enc
+    return True
+
+
+def _parse_dict_strings(chunk: bytes, start: int, n: int):
+    """Host control plane for a BYTE_ARRAY dictionary page: entry
+    (offset, length) table + one contiguous value-bytes buffer. Value bytes
+    copy once; no value is decoded."""
+    lens = np.empty(n, dtype=np.int32)
+    srcs = np.empty(n, dtype=np.int64)
+    pos = start
+    limit = len(chunk)
+    for i in range(n):
+        if pos + 4 > limit:
+            raise _Unsupported("truncated dictionary page")
+        ln = int.from_bytes(chunk[pos:pos + 4], "little")
+        if ln < 0 or pos + 4 + ln > limit:
+            raise _Unsupported("malformed dictionary entry")
+        srcs[i] = pos + 4
+        lens[i] = ln
+        pos += 4 + ln
+    offs = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lens, out=offs[1:])
+    total = int(offs[-1])
+    dict_bytes = np.empty(max(total, 1), dtype=np.uint8)
+    raw = np.frombuffer(chunk, dtype=np.uint8)
+    for i in range(n):
+        dict_bytes[offs[i]:offs[i + 1]] = raw[srcs[i]:srcs[i] + lens[i]]
+    return dict_bytes, offs, lens
 
 
 def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                         max_def: int, cap: Optional[int] = None):
-    """Decode one raw column chunk into (data, validity) device arrays.
+    """Decode one raw column chunk into a device ColumnVector.
+
+    Fixed-width columns: PLAIN / dictionary pages. STRING columns:
+    dictionary pages only — the (offset, length) dictionary table parses on
+    the host, value bytes upload once, and the output column is one jitted
+    gather through build_from_plan (reference decodes strings on the
+    accelerator via cudf the same way, GpuParquetScan.scala:536-556).
 
     max_def: 1 for nullable columns (def levels present), 0 for required.
-    Raises _Unsupported for shapes outside the v1 scope (caller falls back
-    to the Arrow host path)."""
+    Raises _Unsupported for shapes outside scope (caller falls back to the
+    Arrow host path)."""
+    from spark_rapids_tpu.columnar.batch import ColumnVector
+
     pages = parse_pages(chunk)
     cap = cap or bucket_capacity(max(num_rows, 1))
-    npdt = physical_np_dtype(dtype)
+    is_string = dtype is DataType.STRING
+    npdt = np.dtype(np.int32) if is_string else physical_np_dtype(dtype)
     chunk_dev = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
 
-    dict_vals = None
-    validity = jnp.zeros((cap,), dtype=bool)
-    dense = jnp.zeros((cap,), dtype=npdt)
-    out_row = 0
-    dense_fill = 0
+    dict_vals = None          # fixed-width dictionary values (device)
+    str_dict = None           # (bytes_dev, offs_dev, lens_dev) for strings
     dense_parts = []
     valid_parts = []
     for p in pages:
         if p.kind == PAGE_DICT:
-            dict_vals = _bitcast_values(
-                chunk_dev, jnp.int32(p.data_start), p.num_values, npdt.name)
+            if is_string:
+                db, do, dl = _parse_dict_strings(chunk, p.data_start,
+                                                 p.num_values)
+                str_dict = (jnp.asarray(db), jnp.asarray(do),
+                            jnp.asarray(dl))
+            else:
+                dict_vals = _bitcast_values(
+                    chunk_dev, jnp.int32(p.data_start), p.num_values,
+                    npdt.name)
             continue
         if p.encoding not in (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT):
             raise _Unsupported(f"data page encoding {p.encoding}")
+        if is_string and p.encoding == ENC_PLAIN:
+            raise _Unsupported("PLAIN byte-array data page")
         pos = p.data_start
         end = p.data_start + p.data_len
         page_cap = bucket_capacity(max(p.num_values, 1))
@@ -437,7 +486,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         page_valid = page_valid & (jnp.arange(page_cap) < p.num_values)
         n_present = int(jax.device_get(jnp.sum(page_valid)))
         if p.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
-            if dict_vals is None:
+            if dict_vals is None and str_dict is None:
                 raise _Unsupported("dictionary-encoded page before dict")
             bit_width = chunk[pos]
             if bit_width > 24:
@@ -451,16 +500,18 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                     chunk_dev, jnp.asarray(rt.out_start),
                     jnp.asarray(rt.is_rle), jnp.asarray(rt.value),
                     jnp.asarray(rt.bit_off), bit_width, page_cap)
-            page_dense = dict_vals[jnp.clip(idx, 0,
-                                            dict_vals.shape[0] - 1)]
-        else:  # PLAIN
+            if is_string:
+                page_dense = idx  # gather through the dict AFTER assembly
+            else:
+                page_dense = dict_vals[jnp.clip(idx, 0,
+                                                dict_vals.shape[0] - 1)]
+        else:  # PLAIN fixed-width
             page_dense = _bitcast_values(chunk_dev, jnp.int32(pos),
                                          page_cap, npdt.name)
             # only the first n_present values are real; tail reads past the
             # page but is masked by validity at assemble time
         dense_parts.append((page_dense, n_present))
         valid_parts.append((page_valid, p.num_values))
-        out_row += p.num_values
 
     # stitch pages (single-page chunks — the common case with row-group
     # splits — take the fast path)
@@ -473,7 +524,21 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         dense = _concat_logical(
             [(d, n) for d, n in dense_parts], cap, 0)
     data = _assemble(validity, dense, cap)
-    return data, validity
+    if not is_string:
+        return ColumnVector(dtype, data, validity)
+    if str_dict is None:
+        raise _Unsupported("string chunk without a dictionary page")
+    from spark_rapids_tpu.columnar.strings import build_from_plan
+
+    dict_bytes, dict_offs, dict_lens = str_dict
+    row_idx = jnp.clip(data, 0, dict_lens.shape[0] - 1)
+    row_lens = jnp.where(validity, dict_lens[row_idx], 0)
+    total = int(jax.device_get(jnp.sum(row_lens)))
+    byte_cap = bucket_capacity(max(total, 8))
+    out_bytes, offsets = build_from_plan(
+        [dict_bytes], jnp.zeros((cap,), jnp.int32),
+        dict_offs[row_idx], row_lens, byte_cap)
+    return ColumnVector(dtype, out_bytes, validity, offsets)
 
 
 def _pad_to(arr, cap: int, fill):

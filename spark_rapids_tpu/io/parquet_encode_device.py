@@ -1,0 +1,290 @@
+"""Device-side parquet encode (write path).
+
+Reference parity: the reference encodes parquet ON the accelerator into a
+host buffer and only streams bytes afterwards (`ColumnarOutputWriter.scala:
+62-177` — cudf `Table.writeParquet` under the semaphore,
+`GpuParquetFileFormat.scala:34-192`). The TPU-native split mirrors the
+device decoder (io/parquet_device.py) in reverse:
+
+- DEVICE (data plane): per column, one jitted kernel compacts the non-null
+  values into a dense stream (the PLAIN page payload) and bit-packs the
+  validity into v1 definition levels. What downloads is the *encoded* page
+  payload — dense values + packed bits — not padded arrays.
+- HOST (control plane, tiny): wraps payloads in thrift-compact page
+  headers and writes the footer (schema / row group / column chunk
+  metadata). No value is touched on the host.
+
+Scope: UNCOMPRESSED PLAIN v1 pages for fixed-width columns (INT32/INT64/
+FLOAT/DOUBLE + DATE/TIMESTAMP logical annotations; DECIMAL over INT64).
+Files read back with pyarrow/Spark. Strings/bool and compressed output use
+the host Arrow writer.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    device_float64_supported,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType
+
+MAGIC = b"PAR1"
+
+# parquet physical type ids (parquet.thrift Type)
+_T_INT32 = 1
+_T_INT64 = 2
+_T_FLOAT = 4
+_T_DOUBLE = 5
+
+# ConvertedType ids for logical annotation
+_CT_DATE = 6
+_CT_TIMESTAMP_MICROS = 10
+_CT_DECIMAL = 5
+
+
+def _phys_type(dt) -> Optional[Tuple[int, int, Optional[int]]]:
+    """(parquet physical type, byte width, converted type) or None when the
+    dtype can't device-encode."""
+    if isinstance(dt, DecimalType):
+        return _T_INT64, 8, _CT_DECIMAL
+    return {
+        DataType.INT32: (_T_INT32, 4, None),
+        DataType.INT64: (_T_INT64, 8, None),
+        DataType.FLOAT32: (_T_FLOAT, 4, None),
+        DataType.FLOAT64: (_T_DOUBLE, 8, None),
+        DataType.DATE: (_T_INT32, 4, _CT_DATE),
+        DataType.TIMESTAMP: (_T_INT64, 8, _CT_TIMESTAMP_MICROS),
+    }.get(dt)
+
+
+def schema_encodable(attrs) -> bool:
+    for a in attrs:
+        if _phys_type(a.data_type) is None:
+            return False
+        if a.data_type is DataType.FLOAT64 and not device_float64_supported():
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnums=())
+def _encode_fixed(data, validity, num_rows):
+    """Compact non-null values to the front (PLAIN payload order) and pack
+    validity bits little-endian (v1 def levels). Returns
+    (dense_values[cap], packed_bits[cap//8], n_present)."""
+    cap = data.shape[0]
+    live = validity & (jnp.arange(cap) < num_rows)
+    # stable compaction: present rows keep their order
+    order = jnp.argsort(~live, stable=True).astype(jnp.int32)
+    dense = data[order]
+    n_present = jnp.sum(live.astype(jnp.int32))
+    bits = live.reshape(cap // 8, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    packed = jnp.sum(bits * weights[None, :], axis=1).astype(jnp.uint8)
+    return dense, packed, n_present
+
+
+def encode_column_page(col, num_rows: int):
+    """Device-encode one column of one batch into host page-payload pieces:
+    (def_level_bytes, value_bytes, n_present). DOUBLE columns are eligible
+    only where the device computes real f64 (schema_encodable gates TPU)."""
+    dense, packed, n_present = _encode_fixed(col.data, col.validity,
+                                             jnp.int32(num_rows))
+    n_present = int(jax.device_get(n_present))
+    # slice ON device before download: only the encoded payload transfers
+    dense_host = np.asarray(jax.device_get(dense[:n_present]))
+    nbytes_bits = (num_rows + 7) // 8
+    bits_host = np.asarray(jax.device_get(packed[:nbytes_bits]))
+    # v1 def levels: u32 length prefix + RLE-hybrid; ONE bit-packed run of
+    # ceil(n/8) groups is always legal
+    groups = (num_rows + 7) // 8
+    header = _uvarint((groups << 1) | 1)
+    dl = header + bits_host.tobytes()
+    return struct.pack("<I", len(dl)) + dl, dense_host.tobytes(), n_present
+
+
+# ---------------------------------------------------------------------------
+# Thrift compact writer (just enough for parquet metadata)
+# ---------------------------------------------------------------------------
+def _uvarint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zigzag(v: int) -> bytes:
+    return _uvarint((v << 1) ^ (v >> 63))
+
+
+class _CompactWriter:
+    def __init__(self):
+        self.buf = bytearray()
+        self._fid_stack: List[int] = []
+        self.last_fid = 0
+
+    def _field_header(self, fid: int, ftype: int):
+        delta = fid - self.last_fid
+        if 0 < delta <= 15:
+            self.buf.append((delta << 4) | ftype)
+        else:
+            self.buf.append(ftype)
+            self.buf += _zigzag(fid)
+        self.last_fid = fid
+
+    def i32(self, fid: int, v: int):
+        self._field_header(fid, 5)
+        self.buf += _zigzag(v)
+
+    def i64(self, fid: int, v: int):
+        self._field_header(fid, 6)
+        self.buf += _zigzag(v)
+
+    def string(self, fid: int, s: str):
+        self._field_header(fid, 8)
+        b = s.encode("utf-8")
+        self.buf += _uvarint(len(b)) + b
+
+    def begin_struct(self, fid: int):
+        self._field_header(fid, 12)
+        self._fid_stack.append(self.last_fid)
+        self.last_fid = 0
+
+    def begin_element_struct(self):
+        """A struct that is a LIST ELEMENT: no field header byte — compact
+        protocol list elements are bare values."""
+        self._fid_stack.append(self.last_fid)
+        self.last_fid = 0
+
+    def end_struct(self):
+        self.buf.append(0)
+        self.last_fid = self._fid_stack.pop()
+
+    def list_header(self, fid: int, etype: int, n: int):
+        self._field_header(fid, 9)
+        if n < 15:
+            self.buf.append((n << 4) | etype)
+        else:
+            self.buf.append(0xF0 | etype)
+            self.buf += _uvarint(n)
+
+    def stop(self) -> bytes:
+        self.buf.append(0)
+        return bytes(self.buf)
+
+
+def _page_header(n_values: int, payload_len: int) -> bytes:
+    w = _CompactWriter()
+    w.i32(1, 0)                    # type = DATA_PAGE
+    w.i32(2, payload_len)          # uncompressed_size
+    w.i32(3, payload_len)          # compressed_size
+    w.begin_struct(5)              # data_page_header
+    w.i32(1, n_values)
+    w.i32(2, 0)                    # encoding = PLAIN
+    w.i32(3, 3)                    # definition_level_encoding = RLE
+    w.i32(4, 3)                    # repetition_level_encoding = RLE
+    w.end_struct()
+    return w.stop()
+
+
+def _schema_element(w: _CompactWriter, a) -> None:
+    phys, _width, conv = _phys_type(a.data_type)
+    w.begin_element_struct()
+    w.i32(1, phys)
+    w.i32(3, 1)        # repetition = OPTIONAL
+    w.string(4, a.name)
+    if conv is not None:
+        w.i32(6, conv)
+    if isinstance(a.data_type, DecimalType):
+        w.i32(7, a.data_type.scale)
+        w.i32(8, a.data_type.precision)
+    w.end_struct()
+
+
+def write_file(path: str, attrs, batches: List[ColumnarBatch]) -> int:
+    """Assemble one parquet file from device-encoded pages. Returns rows
+    written."""
+    # encode: pages[column][batch] -> (def_bytes, val_bytes, n_present, n)
+    pages: List[List[Tuple[bytes, bytes, int, int]]] = [[] for _ in attrs]
+    total_rows = 0
+    for b in batches:
+        for ci, a in enumerate(attrs):
+            defb, valb, npres = encode_column_page(b.columns[ci],
+                                                   b.num_rows)
+            pages[ci].append((defb, valb, npres, b.num_rows))
+        total_rows += b.num_rows
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        offset = 4
+        col_meta = []
+        for ci, a in enumerate(attrs):
+            first_off = offset
+            n_vals = 0
+            chunk_bytes = 0
+            for defb, valb, npres, nrows in pages[ci]:
+                payload = defb + valb
+                hdr = _page_header(nrows, len(payload))
+                f.write(hdr)
+                f.write(payload)
+                offset += len(hdr) + len(payload)
+                chunk_bytes += len(hdr) + len(payload)
+                n_vals += nrows
+            col_meta.append((a, first_off, n_vals, chunk_bytes))
+        # footer: FileMetaData
+        w = _CompactWriter()
+        w.i32(1, 1)                          # version
+        w.list_header(2, 12, len(attrs) + 1)  # schema
+        # root schema element
+        w.begin_element_struct()
+        w.string(4, "schema")
+        w.i32(5, len(attrs))                 # num_children
+        w.end_struct()
+        for a in attrs:
+            _schema_element(w, a)
+        w.i64(3, total_rows)                 # num_rows
+        w.list_header(4, 12, 1)              # row_groups
+        w.begin_element_struct()             # RowGroup
+        w.list_header(1, 12, len(attrs))     # columns
+        for a, first_off, n_vals, chunk_bytes in col_meta:
+            w.begin_element_struct()         # ColumnChunk
+            w.i64(2, first_off)              # file_offset
+            w.begin_struct(3)                # ColumnMetaData
+            w.i32(1, _phys_type(a.data_type)[0])
+            w.list_header(2, 5, 2)           # encodings [PLAIN, RLE]
+            w.buf += _zigzag(0) + _zigzag(3)
+            w.list_header(3, 8, 1)           # path_in_schema
+            nb = a.name.encode("utf-8")
+            w.buf += _uvarint(len(nb)) + nb
+            w.i32(4, 0)                      # codec = UNCOMPRESSED
+            w.i64(5, n_vals)
+            w.i64(6, chunk_bytes)            # total_uncompressed_size
+            w.i64(7, chunk_bytes)            # total_compressed_size
+            w.i64(9, first_off)              # data_page_offset
+            w.end_struct()
+            w.end_struct()
+        w.i64(2, sum(m[3] for m in col_meta))  # total_byte_size
+        w.i64(3, total_rows)                   # num_rows
+        w.end_struct()
+        w.string(6, "spark-rapids-tpu device encoder")
+        footer = w.stop()
+        f.write(footer)
+        f.write(struct.pack("<I", len(footer)))
+        f.write(MAGIC)
+    return total_rows

@@ -184,24 +184,32 @@ def read_split(split: FileSplit,
 
         return po.ORCFile(split.path).read(columns=names)
     if split.fmt == "csv":
-        import pyarrow.csv as pc
-
         header = _to_bool(split.opt("header", False))
         sep = split.opt("sep", split.opt("delimiter", ","))
-        read_opts = pc.ReadOptions(
-            column_names=None if header else names, autogenerate_column_names=False)
-        parse_opts = pc.ParseOptions(delimiter=sep)
-        from spark_rapids_tpu.io.arrow_convert import dt_to_arrow_type
-
-        convert = pc.ConvertOptions(
-            column_types={a.name: dt_to_arrow_type(a.data_type)
-                          for a in attrs},
-            strings_can_be_null=True)
-        table = pc.read_csv(split.path, read_options=read_opts,
-                            parse_options=parse_opts,
-                            convert_options=convert)
+        table = _read_csv_arrow(split.path, names, attrs, sep, header)
         return table.select(names)
     raise ValueError(f"unknown format {split.fmt}")
+
+
+def _read_csv_arrow(source, file_names, attrs, sep: str, header: bool,
+                    include=None):
+    """ONE pyarrow CSV option set for the host path and the device path's
+    host-rest parse (they must never diverge). `source` is a path or a
+    pyarrow buffer reader; `include` restricts converted columns."""
+    import pyarrow.csv as pc
+
+    from spark_rapids_tpu.io.arrow_convert import dt_to_arrow_type
+
+    read_opts = pc.ReadOptions(
+        column_names=None if header else file_names,
+        autogenerate_column_names=False)
+    convert = pc.ConvertOptions(
+        column_types={a.name: dt_to_arrow_type(a.data_type) for a in attrs},
+        include_columns=include,
+        strings_can_be_null=True)
+    return pc.read_csv(source, read_options=read_opts,
+                       parse_options=pc.ParseOptions(delimiter=sep),
+                       convert_options=convert)
 
 
 def _to_bool(v) -> bool:
@@ -338,9 +346,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         eligible (caller uses the host Arrow path). Mirrors _read_device:
         integral columns parse on device from the raw bytes, everything
         else host-parses and uploads."""
-        from spark_rapids_tpu import conf as C2
         from spark_rapids_tpu.columnar.batch import (
-            ColumnarBatch,
             ColumnVector,
             bucket_capacity,
         )
@@ -378,9 +384,13 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         for a in data_attrs:
             if a.name not in eligible:
                 continue
-            d, v = CD.decode_int_column(table, eligible[a.name],
-                                        a.data_type, cap)
-            dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+            dv = CD.decode_int_column(table, eligible[a.name],
+                                      a.data_type, cap)
+            if dv is None:
+                # malformed field somewhere: the host parser must raise the
+                # same error both engines would
+                return None
+            dev_cols[a.name] = ColumnVector(a.data_type, dv[0], dv[1])
         rest = [a for a in data_attrs if a.name not in dev_cols]
         hb = None
         if rest:
@@ -388,23 +398,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
             # in memory — never a second disk read, never re-converting the
             # columns the device just parsed
             import pyarrow as pa
-            import pyarrow.csv as pc
-
-            from spark_rapids_tpu.io.arrow_convert import dt_to_arrow_type
 
             all_names = table.header_names if header \
                 else [a.name for a in data_attrs]
-            read_opts = pc.ReadOptions(
-                column_names=None if header else all_names)
-            convert = pc.ConvertOptions(
-                column_types={a.name: dt_to_arrow_type(a.data_type)
-                              for a in rest},
-                include_columns=[a.name for a in rest],
-                strings_can_be_null=True)
-            tbl = pc.read_csv(
-                pa.BufferReader(data), read_options=read_opts,
-                parse_options=pc.ParseOptions(delimiter=sep),
-                convert_options=convert)
+            tbl = _read_csv_arrow(pa.BufferReader(data), all_names, rest,
+                                  sep, header,
+                                  include=[a.name for a in rest])
             hb = arrow_to_host_batch(tbl, rest)
             if hb.num_rows != rows:
                 return None  # host parser disagrees: fall back
@@ -452,12 +451,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         with host-decoded/partition-value columns at the same capacity."""
         import pyarrow.parquet as pq
 
-        from spark_rapids_tpu import conf as C2
-        from spark_rapids_tpu.columnar.batch import (
-            ColumnarBatch,
-            ColumnVector,
-            bucket_capacity,
-        )
+        from spark_rapids_tpu.columnar.batch import bucket_capacity
         from spark_rapids_tpu.io import parquet_device as PD
         from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
 
@@ -493,12 +487,11 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 col = md.row_group(rg).column(schema_index[a.name])
                 chunk = PD.read_chunk_bytes(split.path, col)
                 try:
-                    data, validity = PD.decode_chunk_device(
+                    dev_cols[a.name] = PD.decode_chunk_device(
                         chunk, a.data_type, rows,
                         max_def=max_def.get(a.name, 1), cap=cap)
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback
-                dev_cols[a.name] = ColumnVector(a.data_type, data, validity)
             hb = None
             if rest or pv:
                 sub = FileSplit(split.path, "parquet", (rg,), split.options,

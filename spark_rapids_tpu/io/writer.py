@@ -48,6 +48,29 @@ def execute_write(session, plan: L.WriteFile) -> None:
     child = plan.children[0]
     attrs = child.output
     physical = session._physical_plan(child)
+
+    # Device-side parquet encode (reference: ColumnarOutputWriter.scala:
+    # 62-177 encodes on the accelerator): peel the root DeviceToHost
+    # transition and hand DEVICE batches to the device encoder — what
+    # downloads is the encoded page payload, not padded columns.
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.exec.transitions import DeviceToHostExec
+    from spark_rapids_tpu.io import parquet_encode_device as PE
+
+    # the device encoder writes UNCOMPRESSED only, so it engages just for
+    # an explicit compression=none — the default write stays snappy via the
+    # host Arrow writer, identical before and after this feature
+    device_encode = (
+        plan.fmt == "parquet"
+        and not plan.partition_by
+        and session.conf.get(C.PARQUET_DEVICE_ENCODE)
+        and str(plan.options.get("compression", "snappy")).lower()
+        in ("none", "uncompressed")
+        and isinstance(physical, DeviceToHostExec)
+        and PE.schema_encodable(attrs))
+    if device_encode:
+        physical = physical.children[0]
+
     ctx = session._exec_context()
     pb = physical.execute(ctx)
     write_id = uuid.uuid4().hex[:12]
@@ -56,6 +79,9 @@ def execute_write(session, plan: L.WriteFile) -> None:
         batches = [b for b in pb.iterator(pidx) if b.num_rows > 0]
         if not batches:
             return 0
+        if device_encode:
+            fname = f"part-{pidx:05d}-{write_id}.{_ext(plan.fmt)}"
+            return PE.write_file(os.path.join(path, fname), attrs, batches)
         if plan.partition_by:
             return _write_partitioned(batches, attrs, plan, path, pidx,
                                       write_id)

@@ -326,6 +326,19 @@ SHUFFLE_MODE = _conf("rapids.tpu.shuffle.mode").doc(
 ).check(lambda v: None if v in ("inprocess", "ici")
         else "must be inprocess|ici").string("inprocess")
 
+ADAPTIVE_COALESCE = _conf(
+    "rapids.tpu.sql.adaptive.coalescePartitions.enabled").doc(
+    "After the shuffle map stage, merge small contiguous reduce buckets "
+    "until each task holds ~advisoryPartitionSizeBytes (the Spark AQE "
+    "CoalesceShufflePartitions role). Exchanges feeding a shuffled join "
+    "never coalesce: both join inputs must keep identical grouping."
+).boolean(True)
+ADAPTIVE_TARGET_BYTES = _conf(
+    "rapids.tpu.sql.adaptive.advisoryPartitionSizeBytes").doc(
+    "Target bytes per post-shuffle task when adaptive coalescing is on "
+    "(Spark's spark.sql.adaptive.advisoryPartitionSizeInBytes analog)."
+).integer(16 << 20)
+
 SHUFFLE_SERIALIZE = _conf("rapids.tpu.shuffle.serialize.enabled").doc(
     "Force shuffle pieces to cross the exchange as serialized host bytes "
     "(the fallback-tier serializer, reference: "

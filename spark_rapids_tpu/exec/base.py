@@ -26,17 +26,36 @@ from spark_rapids_tpu.utils import metrics as M
 
 
 class PartitionedBatches:
-    """num_partitions + per-partition batch-iterator factory (the RDD analog)."""
+    """num_partitions + per-partition batch-iterator factory (the RDD analog).
 
-    __slots__ = ("num_partitions", "_factory")
+    bucket_costs: optional per-partition byte estimates set by exchanges —
+    lets a downstream binary consumer (shuffled join) coalesce BOTH inputs
+    with one identical grouping (the coordinated half of AQE partition
+    coalescing). Row-preserving wrapper execs propagate it."""
+
+    __slots__ = ("num_partitions", "_factory", "bucket_costs")
 
     def __init__(self, num_partitions: int,
-                 factory: Callable[[int], Iterator]):
+                 factory: Callable[[int], Iterator],
+                 bucket_costs=None):
         self.num_partitions = num_partitions
         self._factory = factory
+        self.bucket_costs = bucket_costs
 
     def iterator(self, pidx: int) -> Iterator:
         return self._factory(pidx)
+
+    def grouped(self, groups) -> "PartitionedBatches":
+        """View with partitions [groups[i]...] chained into partition i."""
+        def factory(gidx: int):
+            def gen():
+                for t in groups[gidx]:
+                    yield from self.iterator(t)
+            return gen()
+        costs = None
+        if self.bucket_costs is not None:
+            costs = [sum(self.bucket_costs[t] for t in g) for g in groups]
+        return PartitionedBatches(len(groups), factory, costs)
 
 
 class ExecContext:

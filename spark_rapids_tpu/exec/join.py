@@ -374,6 +374,28 @@ def _null_batch(attrs: List[AttributeReference], n_rows: int) -> ColumnarBatch:
     return ColumnarBatch(cols, n_rows)
 
 
+def coalesce_join_inputs(ctx, left_pb, right_pb):
+    """Coordinated AQE partition coalescing for a shuffled join: group BOTH
+    inputs with the SAME contiguous bucket grouping, chosen from their
+    combined per-bucket costs (the exchanges below publish bucket_costs and
+    stay unfused; Spark AQE's coordinated CoalesceShufflePartitions)."""
+    from spark_rapids_tpu import conf as C
+
+    if (left_pb.bucket_costs is None or right_pb.bucket_costs is None
+            or left_pb.num_partitions != right_pb.num_partitions
+            or left_pb.num_partitions <= 1
+            or not ctx.conf.get(C.ADAPTIVE_COALESCE)):
+        return left_pb, right_pb
+    from spark_rapids_tpu.shuffle.exchange import _coalesce_groups
+
+    combined = [l + r for l, r in zip(left_pb.bucket_costs,
+                                      right_pb.bucket_costs)]
+    groups = _coalesce_groups(combined, ctx.conf.get(C.ADAPTIVE_TARGET_BYTES))
+    if len(groups) == left_pb.num_partitions:
+        return left_pb, right_pb
+    return left_pb.grouped(groups), right_pb.grouped(groups)
+
+
 class TpuShuffledHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
     placement = "tpu"
 
@@ -386,6 +408,7 @@ class TpuShuffledHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         left_pb = self.children[0].execute(ctx)
         right_pb = self.children[1].execute(ctx)
+        left_pb, right_pb = coalesce_join_inputs(ctx, left_pb, right_pb)
         build_pb = left_pb if self.build_left else right_pb
         stream_pb = right_pb if self.build_left else left_pb
         emit_tail = self.join_type is JoinType.FULL_OUTER
@@ -539,6 +562,8 @@ class CpuShuffledHashJoinExec(_JoinBase, CpuExec):
                 "full outer join cannot use the broadcast path")
         left_pb = self.children[0].execute(ctx)
         right_pb = self.children[1].execute(ctx)
+        if not self.broadcast:
+            left_pb, right_pb = coalesce_join_inputs(ctx, left_pb, right_pb)
         build_left = self.build_left
         build_pb = left_pb if build_left else right_pb
         stream_pb = right_pb if build_left else left_pb

@@ -247,7 +247,8 @@ class TpuCoalesceBatchesExec(TpuExec):
                 _coalesce_iter(child_pb.iterator(p), goal,
                                concat_batches,
                                lambda b: b.device_memory_size(),
-                               self.metrics)))
+                               self.metrics)),
+            bucket_costs=child_pb.bucket_costs)
 
 
 class CpuCoalesceBatchesExec(PhysicalExec):
@@ -274,4 +275,5 @@ class CpuCoalesceBatchesExec(PhysicalExec):
                 _coalesce_iter(child_pb.iterator(p), goal,
                                _concat_host,
                                lambda b: b.estimated_size_bytes(),
-                               self.metrics)))
+                               self.metrics)),
+            bucket_costs=child_pb.bucket_costs)

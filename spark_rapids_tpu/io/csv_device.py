@@ -221,10 +221,11 @@ def _parse_int_kernel(raw, starts, lens, maxw: int):
 def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
                       cap: int):
     """Parse one integral column on device, padded to `cap` rows. Returns
-    (data, validity) device arrays in the column's physical dtype, or None
-    when any field is malformed or out of the target type's range — the
-    caller must fall back to the host parser, which raises the same error
-    on both engines."""
+    (data, validity, any_malformed) where any_malformed is a DEVICE bool
+    scalar — the caller batches the malformed checks of every column into
+    ONE host sync (each sync is a network round trip when the chip is
+    tunneled) and falls back to the host parser if any is set, so both
+    engines raise the same error on bad fields."""
     from spark_rapids_tpu.columnar.batch import physical_np_dtype
 
     n = table.num_rows
@@ -243,9 +244,7 @@ def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
         in_range = (val >= info.min) & (val <= info.max)
         malformed = malformed | (validity & ~in_range & row_mask)
         val = jnp.where(in_range, val, 0).astype(npdt)
-    if bool(jax.device_get(jnp.any(malformed))):
-        return None
-    return val, validity & row_mask
+    return val, validity & row_mask, jnp.any(malformed)
 
 
 def eligible_attrs(attrs, header_names: Optional[List[str]],

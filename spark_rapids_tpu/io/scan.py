@@ -380,17 +380,22 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         rows = table.num_rows
         cap = bucket_capacity(max(rows, 1))
         TpuSemaphore.get().acquire_if_necessary(current_task_id())
+        import jax
+
         dev_cols = {}
+        malformed_flags = []
         for a in data_attrs:
             if a.name not in eligible:
                 continue
-            dv = CD.decode_int_column(table, eligible[a.name],
-                                      a.data_type, cap)
-            if dv is None:
-                # malformed field somewhere: the host parser must raise the
-                # same error both engines would
-                return None
-            dev_cols[a.name] = ColumnVector(a.data_type, dv[0], dv[1])
+            d, v, bad = CD.decode_int_column(table, eligible[a.name],
+                                             a.data_type, cap)
+            malformed_flags.append(bad)
+            dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+        if malformed_flags and any(
+                bool(x) for x in jax.device_get(malformed_flags)):
+            # malformed field somewhere: ONE batched sync, then the host
+            # parser raises the same error both engines would
+            return None
         rest = [a for a in data_attrs if a.name not in dev_cols]
         hb = None
         if rest:

@@ -362,7 +362,8 @@ def _register_feature_exec_rules():
 
     register_exec(
         X.CpuShuffleExchangeExec, "columnar shuffle exchange",
-        lambda cpu, ch: X.TpuShuffleExchangeExec(cpu.partitioning, ch[0]),
+        lambda cpu, ch: X.TpuShuffleExchangeExec(cpu.partitioning, ch[0],
+                                                 cpu.allow_adaptive),
         tag_fn=_tag_exchange)
 
     def _convert_join(tpu_cls):

@@ -45,9 +45,38 @@ class TpuTransitionOverrides:
         plan = _insert_transitions(plan, want_host_output=True)
         plan = _insert_coalesce(plan, conf)
         plan = _optimize_transitions(plan)
+        _pin_join_exchanges(plan)
         if conf.test_enabled:
             assert_is_on_tpu(plan, conf)
         return plan
+
+
+def _pin_join_exchanges(node: PhysicalExec) -> None:
+    """Disable adaptive partition coalescing on exchanges that feed a
+    shuffled join: both join inputs must keep the SAME reduce grouping for
+    pidx-by-pidx co-partitioning to hold (Spark AQE coordinates the two
+    sides; here the exchanges simply stay at the planned partition count).
+    Broadcast joins are unaffected — their build side is collected whole."""
+    from spark_rapids_tpu.exec.join import (
+        CpuShuffledHashJoinExec,
+        TpuShuffledHashJoinExec,
+    )
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+
+    def pin_first_exchanges(n: PhysicalExec) -> None:
+        if isinstance(n, _ExchangeBase):
+            n.allow_adaptive = False
+            return  # grouping below another exchange is independent
+        for c in n.children:
+            pin_first_exchanges(c)
+
+    shuffled_join = (TpuShuffledHashJoinExec, CpuShuffledHashJoinExec)
+    if isinstance(node, shuffled_join) and \
+            not getattr(node, "broadcast", False):
+        for c in node.children:
+            pin_first_exchanges(c)
+    for c in node.children:
+        _pin_join_exchanges(c)
 
 
 def _insert_transitions(node: PhysicalExec, want_host_output: bool) -> PhysicalExec:

@@ -113,16 +113,22 @@ class RangePartitioning(Partitioning):
 # Shared exchange machinery
 # ===========================================================================
 class _ExchangeBase(PhysicalExec):
-    def __init__(self, partitioning: Partitioning, child: PhysicalExec):
+    def __init__(self, partitioning: Partitioning, child: PhysicalExec,
+                 allow_adaptive: bool = True):
         super().__init__(child)
         self.partitioning = partitioning
+        # False for user-specified repartition(n) and for exchanges feeding
+        # a shuffled join (set at plan time / by the transition pass);
+        # carried through every rebuild so the pin can never be lost
+        self.allow_adaptive = allow_adaptive
 
     @property
     def output(self) -> List[AttributeReference]:
         return self.children[0].output
 
     def with_children(self, new_children):
-        return type(self)(self.partitioning, new_children[0])
+        return type(self)(self.partitioning, new_children[0],
+                          self.allow_adaptive)
 
     def output_partitioning(self):
         return self.partitioning
@@ -172,6 +178,16 @@ class _ExchangeBase(PhysicalExec):
 
         to_device = self.placement == "tpu"
 
+        # AQE-style adaptive partition coalescing (reference role: Spark
+        # AQE's CoalesceShufflePartitions, which the plugin runs under in
+        # TpchLikeAdaptiveSparkSuite): group small contiguous reduce buckets
+        # so downstream tasks amortize their fixed dispatch cost. Contiguity
+        # keeps range-partition order; hash buckets union freely. Exchanges
+        # pinned by the transition pass (join inputs) publish their bucket
+        # costs instead, and the JOIN coalesces both sides identically.
+        costs = [sum(_piece_cost(p, n_out) for p in bucket)
+                 for bucket in reduce_buckets]
+
         def factory(pidx: int):
             def gen():
                 for piece in reduce_buckets[pidx]:
@@ -180,7 +196,42 @@ class _ExchangeBase(PhysicalExec):
                     yield piece
             return count_output(self.metrics, gen())
 
-        return PartitionedBatches(n_out, factory)
+        pb = PartitionedBatches(n_out, factory, bucket_costs=costs)
+        if self.allow_adaptive and n_out > 1 and \
+                ctx.conf.get(C.ADAPTIVE_COALESCE):
+            groups = _coalesce_groups(costs,
+                                      ctx.conf.get(C.ADAPTIVE_TARGET_BYTES))
+            if len(groups) != n_out:
+                self.metrics["coalescedPartitions"].add(n_out - len(groups))
+                pb = pb.grouped(groups)
+        return pb
+
+
+def _coalesce_groups(costs: List[int], target: int) -> List[List[int]]:
+    """Greedy contiguous grouping: extend the current group while it stays
+    under `target` (every group keeps >= 1 bucket)."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_cost = 0
+    for t, c in enumerate(costs):
+        if cur and cur_cost + c > target:
+            groups.append(cur)
+            cur, cur_cost = [], 0
+        cur.append(t)
+        cur_cost += c
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _piece_cost(piece, n_out: int) -> int:
+    """Estimated bytes of one piece for coalescing decisions. Lazy device
+    views share full source buffers, so their per-target expected share is
+    used instead of 0 (unlike the dataSize metric, which must not
+    over-count shared buffers)."""
+    if isinstance(piece, ColumnarBatch) and piece.live is not None:
+        return piece.device_memory_size() // max(n_out, 1)
+    return _piece_bytes(piece)
 
 
 def _piece_bytes(piece) -> int:
@@ -760,4 +811,10 @@ def plan_repartition_exchange(plan, child: PhysicalExec, conf) -> PhysicalExec:
         part = HashPartitioning(plan.partition_exprs, n)
     else:
         part = RoundRobinPartitioning(n)
-    return CpuShuffleExchangeExec(part, child)
+    ex = CpuShuffleExchangeExec(part, child)
+    if plan.num_partitions is not None:
+        # an explicit repartition(n) states the user's intended fan-out —
+        # never adaptively merge it (Spark AQE likewise exempts
+        # REPARTITION_BY_NUM shuffles)
+        ex.allow_adaptive = False
+    return ex

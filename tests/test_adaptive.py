@@ -1,0 +1,127 @@
+"""Adaptive shuffle-partition coalescing (the Spark AQE
+CoalesceShufflePartitions role): correctness under coalescing, the join
+co-partitioning pin, the user-repartition exemption, and the coordinated
+join-side grouping."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.exec.base import PartitionedBatches
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.shuffle.exchange import _coalesce_groups
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+
+def _df(s, n=4000, parts=4):
+    rng = np.random.default_rng(17)
+    return s.createDataFrame(
+        {"k": rng.integers(0, 97, n).astype(np.int64),
+         "v": rng.integers(-1000, 1000, n).astype(np.int64)},
+        [("k", "long"), ("v", "long")], num_partitions=parts)
+
+
+def test_coalesce_groups_contiguous():
+    # greedy contiguous grouping, every group >= 1 bucket
+    assert _coalesce_groups([1, 1, 1, 1], 10) == [[0, 1, 2, 3]]
+    assert _coalesce_groups([6, 6, 6], 10) == [[0], [1], [2]]
+    assert _coalesce_groups([4, 4, 4, 4], 10) == [[0, 1], [2, 3]]
+    assert _coalesce_groups([100, 1, 1], 10) == [[0], [1, 2]]
+
+
+def test_grouped_view_chains_partitions():
+    data = {0: ["a"], 1: ["b", "c"], 2: [], 3: ["d"]}
+    pb = PartitionedBatches(4, lambda p: iter(data[p]),
+                            bucket_costs=[1, 2, 0, 1])
+    g = pb.grouped([[0, 1], [2, 3]])
+    assert g.num_partitions == 2
+    assert list(g.iterator(0)) == ["a", "b", "c"]
+    assert list(g.iterator(1)) == ["d"]
+    assert g.bucket_costs == [3, 1]
+
+
+@pytest.mark.parametrize("enabled", [True, False])
+def test_groupby_equal_with_and_without_coalescing(session, enabled):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _df(s).groupBy("k").agg(F.sum("v").alias("s"),
+                                          F.count("*").alias("n")),
+        ignore_order=True,
+        extra_conf={C.ADAPTIVE_COALESCE.key: enabled})
+
+
+def test_join_equal_under_coalescing(session):
+    def q(s):
+        left = _df(s, n=3000, parts=3)
+        right = s.createDataFrame(
+            {"k": np.arange(97, dtype=np.int64),
+             "w": np.arange(97, dtype=np.int64) * 10},
+            [("k", "long"), ("w", "long")], num_partitions=2)
+        return left.join(right, on="k", how="inner") \
+            .groupBy("w").agg(F.count("*").alias("n"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True,
+                                         extra_conf={
+                                             C.ADAPTIVE_COALESCE.key: True})
+
+
+def test_join_exchanges_are_pinned(session):
+    # the transition pass must pin BOTH inputs of a shuffled join — and the
+    # pin must survive plan rebuilds (it is constructor state)
+    from spark_rapids_tpu.exec.join import (
+        CpuShuffledHashJoinExec,
+        TpuShuffledHashJoinExec,
+    )
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+
+    left = _df(session, n=500, parts=2)
+    right = _df(session, n=300, parts=2).withColumnRenamed("v", "w")
+    # force the shuffled (non-broadcast) join path
+    old = session.conf.get(C.BROADCAST_THRESHOLD)
+    session.conf.set(C.BROADCAST_THRESHOLD.key, 0)
+    try:
+        plan = session._physical_plan(
+            left.join(right, on="k", how="inner")._plan)
+    finally:
+        session.conf.set(C.BROADCAST_THRESHOLD.key, old)
+
+    found = []
+
+    def walk(node, under_join):
+        is_join = isinstance(node, (TpuShuffledHashJoinExec,
+                                    CpuShuffledHashJoinExec)) and \
+            not getattr(node, "broadcast", False)
+        if isinstance(node, _ExchangeBase) and under_join:
+            found.append(node.allow_adaptive)
+            under_join = False  # deeper exchanges are independent
+        for c in node.children:
+            walk(c, under_join or is_join)
+
+    walk(plan, False)
+    assert found and not any(found), \
+        f"join-feeding exchanges must be pinned, got {found}"
+
+
+def test_repartition_n_is_never_coalesced(session, tmp_path):
+    # explicit repartition(n) states intended fan-out: n output files
+    session.conf.set("rapids.tpu.sql.enabled", True)
+    path = str(tmp_path / "rp.parquet")
+    _df(session, n=200, parts=2).repartition(6).write.parquet(path)
+    import os
+
+    files = [f for f in os.listdir(path) if f.endswith(".parquet")]
+    assert len(files) == 6
+
+
+def test_small_shuffle_writes_one_file(session, tmp_path):
+    # planner-chosen shuffle partitions DO coalesce when tiny: a small
+    # groupBy result lands in one task/file instead of shuffle_partitions
+    session.conf.set("rapids.tpu.sql.enabled", True)
+    path = str(tmp_path / "agg.parquet")
+    _df(session, n=500, parts=2).groupBy("k") \
+        .agg(F.sum("v").alias("s")).write.parquet(path)
+    import os
+
+    files = [f for f in os.listdir(path) if f.endswith(".parquet")]
+    assert len(files) == 1

@@ -38,41 +38,48 @@ def test_plan_fields_rejects_quotes_and_ragged():
 def test_decode_int_column_values():
     t = CD.plan_fields(b"12,-7\n30,\n-5,9223372036854775807\n", 2,
                        header=False)
-    d, v = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    d, v, bad = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    assert not bool(bad)
     assert list(np.asarray(v)) == [True, True, True, False]
     assert list(np.asarray(d))[:3] == [12, 30, -5]
-    d, v = CD.decode_int_column(t, 1, DataType.INT64, 4)
+    d, v, bad = CD.decode_int_column(t, 1, DataType.INT64, 4)
     # empty field -> null; 19-digit max parses exactly
+    assert not bool(bad)
     assert list(np.asarray(v)) == [True, False, True, False]
     assert np.asarray(d)[2] == 9223372036854775807
 
 
 def test_decode_malformed_aborts_device_path():
     # '+' sign and garbage are errors on the pyarrow host oracle, so the
-    # device path must abandon the split (None), never diverge silently
+    # device path must flag the split for fallback, never diverge silently
     for text in (b"+34,1\n2,2\n", b"x,1\n2,2\n", b"1.5,1\n2,2\n"):
         t = CD.plan_fields(text, 2, header=False)
-        assert CD.decode_int_column(t, 0, DataType.INT64, 4) is None
+        _, _, bad = CD.decode_int_column(t, 0, DataType.INT64, 4)
+        assert bool(bad), text
 
 
 def test_decode_int_overflow_aborts_device_path():
-    # out-of-int64 values error on the host oracle -> device must abort,
+    # out-of-int64 values error on the host oracle -> device must flag,
     # never a wrapped value and never a silent NULL
     t = CD.plan_fields(b"9999999999999999999,1\n"
                        b"1234567890123456789012345,2\n"
                        b"9223372036854775807,3\n", 2, header=False)
-    assert CD.decode_int_column(t, 0, DataType.INT64, 4) is None
+    _, _, bad = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    assert bool(bad)
     # the max in-range value still parses when alone
     t2 = CD.plan_fields(b"9223372036854775807\n", 1, header=False)
-    d, v = CD.decode_int_column(t2, 0, DataType.INT64, 2)
+    d, v, bad = CD.decode_int_column(t2, 0, DataType.INT64, 2)
+    assert not bool(bad)
     assert np.asarray(v)[0] and np.asarray(d)[0] == np.iinfo(np.int64).max
 
 
 def test_decode_narrow_type_out_of_range_aborts():
     t = CD.plan_fields(b"300\n-129\n127\n-128\n", 1, header=False)
-    assert CD.decode_int_column(t, 0, DataType.INT8, 4) is None
+    _, _, bad = CD.decode_int_column(t, 0, DataType.INT8, 4)
+    assert bool(bad)
     t2 = CD.plan_fields(b"127\n-128\n", 1, header=False)
-    d, v = CD.decode_int_column(t2, 0, DataType.INT8, 2)
+    d, v, bad = CD.decode_int_column(t2, 0, DataType.INT8, 2)
+    assert not bool(bad)
     assert list(np.asarray(d)) == [127, -128] and all(np.asarray(v))
 
 
@@ -81,7 +88,8 @@ def test_single_column_blank_lines_skipped():
     # agree, not produce NULL rows
     t = CD.plan_fields(b"1\n2\n\n3\n", 1, header=False)
     assert t.num_rows == 3
-    d, v = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    d, v, bad = CD.decode_int_column(t, 0, DataType.INT64, 4)
+    assert not bool(bad)
     assert list(np.asarray(d)[:3]) == [1, 2, 3]
     assert all(np.asarray(v)[:3])
 

@@ -65,6 +65,13 @@ class TpuSession:
     def __init__(self, settings: Optional[Dict[str, Any]] = None):
         self.conf = C.TpuConf(settings)
         self.plan_capture = PlanCapture()
+        # multi-host bring-up FIRST — the coordination service must join
+        # before any backend touch (reference: driver ships conf and
+        # executors announce themselves before GPU init, Plugin.scala:
+        # 103-142). Env-driven; single-process is a no-op.
+        from spark_rapids_tpu.parallel import distributed as _dist
+
+        _dist.init_distributed()
         # executor bring-up (reference: RapidsExecutorPlugin.init)
         self.device_manager = TpuDeviceManager.initialize(self.conf)
         # spill store chain + watermark (reference: GpuShuffleEnv.initStorage,

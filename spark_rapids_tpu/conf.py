@@ -276,6 +276,16 @@ CSV_DEVICE_PARSE = _conf(
     "Arrow parser."
 ).boolean(True)
 ORC_READ_ENABLED = _conf("rapids.tpu.sql.format.orc.read.enabled").boolean(True)
+ORC_DEVICE_DECODE = _conf(
+    "rapids.tpu.sql.format.orc.deviceDecode.enabled").doc(
+    "Decode eligible ORC integer columns ON the device: the host walks the "
+    "protobuf metadata and RLEv2/byte-RLE run headers, raw stripe bytes "
+    "upload once, and jitted kernels expand the runs (big-endian "
+    "bit-unpack, segmented delta prefix-sum, PRESENT bit extraction) — "
+    "the reference decodes ORC on the accelerator the same way "
+    "(GpuOrcScan.scala:284,709). Compressed files, PATCHED_BASE runs, and "
+    "non-integer columns fall back to the host Arrow reader."
+).boolean(True)
 ORC_WRITE_ENABLED = _conf("rapids.tpu.sql.format.orc.write.enabled").boolean(True)
 
 ENABLE_FLOAT_AGG = _conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(

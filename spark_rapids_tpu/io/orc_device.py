@@ -1,0 +1,589 @@
+"""Device-side ORC integer column decode.
+
+Reference parity: the reference decodes ORC ON the accelerator — host-side
+stripe reassembly feeds cudf's device ORC reader (`GpuOrcScan.scala`,
+semaphore at :284,:709). The TPU-native split mirrors the parquet device
+decoder (io/parquet_device.py):
+
+- HOST (control plane): walk the file's protobuf metadata (PostScript ->
+  Footer -> per-stripe StripeFooter), then parse each column's RLEv2 DATA
+  stream and byte-RLE PRESENT stream into *run tables* (a few entries per
+  run — headers and varint bases only; no value is decoded on the host).
+- DEVICE (data plane): jitted kernels expand the run tables straight from
+  the raw stripe bytes — big-endian bit-unpacking for DIRECT, segmented
+  prefix-sum for DELTA, bit extraction for PRESENT — so the decode work
+  happens on the accelerator and the upload is the encoded stream.
+
+Scope: UNCOMPRESSED files, SHORT/INT/LONG (+DATE) columns with DIRECT_V2
+encoding, RLEv2 sub-encodings SHORT_REPEAT / DIRECT / DELTA (PATCHED_BASE
+falls back), value widths <= 32 bits. Arrow remains the oracle and the
+fallback for everything else.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import bucket_capacity
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+
+class _Unsupported(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire-format mini reader (ORC metadata is plain protobuf)
+# ---------------------------------------------------------------------------
+class _Proto:
+    def __init__(self, buf: bytes, start: int = 0, end: Optional[int] = None):
+        self.buf = buf
+        self.pos = start
+        self.end = len(buf) if end is None else end
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            if self.pos >= self.end or shift > 70:
+                raise _Unsupported("malformed protobuf varint")
+            b = self.buf[self.pos]
+            self.pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def fields(self):
+        """Yield (field_number, wire_type, value); value is int for varint,
+        bytes for length-delimited, raw for fixed."""
+        while self.pos < self.end:
+            tag = self.varint()
+            fnum, wt = tag >> 3, tag & 7
+            if wt == 0:
+                yield fnum, wt, self.varint()
+            elif wt == 2:
+                n = self.varint()
+                if n > self.end - self.pos:
+                    raise _Unsupported("malformed protobuf length")
+                v = self.buf[self.pos:self.pos + n]
+                self.pos += n
+                yield fnum, wt, v
+            elif wt == 5:
+                v = self.buf[self.pos:self.pos + 4]
+                self.pos += 4
+                yield fnum, wt, v
+            elif wt == 1:
+                v = self.buf[self.pos:self.pos + 8]
+                self.pos += 8
+                yield fnum, wt, v
+            else:
+                raise _Unsupported(f"protobuf wire type {wt}")
+
+
+@dataclass
+class StripeInfo:
+    offset: int = 0
+    index_length: int = 0
+    data_length: int = 0
+    footer_length: int = 0
+    num_rows: int = 0
+
+
+@dataclass
+class OrcMeta:
+    compression: int = 0            # 0 = NONE
+    stripes: List[StripeInfo] = field(default_factory=list)
+    # column id -> (type kind, name); id 0 is the struct root
+    kinds: List[int] = field(default_factory=list)
+    names: List[str] = field(default_factory=list)
+    num_rows: int = 0
+
+
+# ORC type kinds
+K_SHORT, K_INT, K_LONG, K_DATE = 2, 3, 4, 15
+_INT_KINDS = {K_SHORT, K_INT, K_LONG, K_DATE}
+
+# stream kinds
+S_PRESENT, S_DATA = 0, 1
+
+
+def parse_file_meta(raw: bytes) -> OrcMeta:
+    """PostScript -> Footer (tail metadata of an uncompressed ORC file)."""
+    if len(raw) < 16 or raw[:3] != b"ORC":
+        raise _Unsupported("not an ORC file")
+    psl = raw[-1]
+    ps = _Proto(raw, len(raw) - 1 - psl, len(raw) - 1)
+    footer_len = 0
+    compression = 0
+    for fnum, _wt, v in ps.fields():
+        if fnum == 1:
+            footer_len = v
+        elif fnum == 2:
+            compression = v
+    if compression != 0:
+        raise _Unsupported("compressed ORC (device path is uncompressed-only)")
+    fstart = len(raw) - 1 - psl - footer_len
+    meta = OrcMeta(compression=compression)
+    root_subtypes: List[int] = []
+    for fnum, _wt, v in _Proto(raw, fstart, fstart + footer_len).fields():
+        if fnum == 3:  # StripeInformation
+            si = StripeInfo()
+            for f2, _w2, v2 in _Proto(v).fields():
+                if f2 == 1:
+                    si.offset = v2
+                elif f2 == 2:
+                    si.index_length = v2
+                elif f2 == 3:
+                    si.data_length = v2
+                elif f2 == 4:
+                    si.footer_length = v2
+                elif f2 == 5:
+                    si.num_rows = v2
+            meta.stripes.append(si)
+        elif fnum == 4:  # Type
+            kind = 0
+            fieldnames: List[str] = []
+            subtypes: List[int] = []
+            for f2, w2, v2 in _Proto(v).fields():
+                if f2 == 1:
+                    kind = v2
+                elif f2 == 2:
+                    if w2 == 0:
+                        subtypes.append(v2)
+                    else:  # packed
+                        p = _Proto(v2)
+                        while p.pos < p.end:
+                            subtypes.append(p.varint())
+                elif f2 == 3:
+                    fieldnames.append(v2.decode("utf-8"))
+            if not meta.kinds:  # root struct
+                root_subtypes = subtypes
+                meta.names = [""] + fieldnames
+            meta.kinds.append(kind)
+        elif fnum == 6:
+            meta.num_rows = v
+    # names: root fieldnames map to subtype column ids
+    names = [""] * len(meta.kinds)
+    for fname, cid in zip(meta.names[1:], root_subtypes):
+        if cid < len(names):
+            names[cid] = fname
+    meta.names = names
+    return meta
+
+
+@dataclass
+class StreamLoc:
+    kind: int
+    column: int
+    start: int   # absolute offset in the file
+    length: int
+
+
+def parse_stripe_footer(raw: bytes, si: StripeInfo
+                        ) -> Tuple[List[StreamLoc], Dict[int, int]]:
+    """StripeFooter -> data-area stream locations + column encodings."""
+    fstart = si.offset + si.index_length + si.data_length
+    streams: List[StreamLoc] = []
+    encodings: Dict[int, int] = {}
+    col_i = 0
+    pos = si.offset  # streams laid out from stripe start (index then data)
+    for fnum, _wt, v in _Proto(raw, fstart, fstart + si.footer_length).fields():
+        if fnum == 1:  # Stream
+            kind = column = length = 0
+            for f2, _w2, v2 in _Proto(v).fields():
+                if f2 == 1:
+                    kind = v2
+                elif f2 == 2:
+                    column = v2
+                elif f2 == 3:
+                    length = v2
+            streams.append(StreamLoc(kind, column, pos, length))
+            pos += length
+        elif fnum == 2:  # ColumnEncoding
+            enc = 0
+            for f2, _w2, v2 in _Proto(v).fields():
+                if f2 == 1:
+                    enc = v2
+            encodings[col_i] = enc
+            col_i += 1
+    return streams, encodings
+
+
+# ---------------------------------------------------------------------------
+# RLEv2 run-table parse (host: headers + varints only)
+# ---------------------------------------------------------------------------
+# run kinds in our table
+R_REPEAT, R_DIRECT, R_DELTA = 0, 1, 2
+
+_WIDTH_TABLE = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
+                17, 18, 19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48,
+                56, 64]
+
+
+def _svarint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (out >> 1) ^ -(out & 1), pos
+
+
+@dataclass
+class RleV2Table:
+    kind: np.ndarray       # int8 per run
+    out_start: np.ndarray  # int32
+    count: np.ndarray      # int32
+    base: np.ndarray       # int64 (SHORT_REPEAT value / DELTA base)
+    delta0: np.ndarray     # int64 (DELTA first delta, signed)
+    bit_off: np.ndarray    # int64 absolute BIT offset of packed payload
+    width: np.ndarray      # int8 packed bit width (0 = none)
+    produced: int
+
+
+def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
+                signed: bool = True) -> RleV2Table:
+    kinds: List[int] = []
+    starts: List[int] = []
+    counts: List[int] = []
+    bases: List[int] = []
+    delta0s: List[int] = []
+    bit_offs: List[int] = []
+    widths: List[int] = []
+    pos = start
+    produced = 0
+    while produced < num_values and pos < end:
+        h = raw[pos]
+        enc = h >> 6
+        if enc == 0:  # SHORT_REPEAT
+            w = ((h >> 3) & 0x7) + 1
+            n = (h & 0x7) + 3
+            v = int.from_bytes(raw[pos + 1:pos + 1 + w], "big")
+            if signed:
+                v = (v >> 1) ^ -(v & 1)
+            kinds.append(R_REPEAT)
+            starts.append(produced)
+            counts.append(n)
+            bases.append(v)
+            delta0s.append(0)
+            bit_offs.append(0)
+            widths.append(0)
+            pos += 1 + w
+            produced += n
+        elif enc == 1:  # DIRECT
+            w = _WIDTH_TABLE[(h >> 1) & 0x1F]
+            n = ((h & 1) << 8 | raw[pos + 1]) + 1
+            if w > 32:
+                raise _Unsupported(f"DIRECT width {w}")
+            kinds.append(R_DIRECT)
+            starts.append(produced)
+            counts.append(n)
+            bases.append(0)
+            delta0s.append(0)
+            bit_offs.append((pos + 2) * 8)
+            widths.append(w)
+            pos += 2 + (n * w + 7) // 8
+            produced += n
+        elif enc == 3:  # DELTA
+            wcode = (h >> 1) & 0x1F
+            w = 0 if wcode == 0 else _WIDTH_TABLE[wcode]
+            n = ((h & 1) << 8 | raw[pos + 1]) + 1
+            if w > 32:
+                raise _Unsupported(f"DELTA width {w}")
+            p = pos + 2
+            if signed:
+                base, p = _svarint(raw, p)
+            else:
+                pr = _Proto(raw, p, end)
+                base = pr.varint()
+                p = pr.pos
+            d0, p = _svarint(raw, p)
+            kinds.append(R_DELTA)
+            starts.append(produced)
+            counts.append(n)
+            bases.append(base)
+            delta0s.append(d0)
+            bit_offs.append(p * 8)
+            widths.append(w)
+            # packed deltas cover values 2..n-1 (n-2 of them)
+            pos = p + (max(n - 2, 0) * w + 7) // 8 if w else p
+            produced += n
+        else:
+            raise _Unsupported("PATCHED_BASE run")
+    return RleV2Table(np.asarray(kinds, np.int8),
+                      np.asarray(starts, np.int32),
+                      np.asarray(counts, np.int32),
+                      np.asarray(bases, np.int64),
+                      np.asarray(delta0s, np.int64),
+                      np.asarray(bit_offs, np.int64),
+                      np.asarray(widths, np.int8),
+                      produced)
+
+
+# byte-RLE for PRESENT: (run_start_byte, count, is_literal, value, lit_off)
+@dataclass
+class ByteRleTable:
+    out_start: np.ndarray  # int32, in BYTES of decoded stream
+    count: np.ndarray
+    is_run: np.ndarray
+    value: np.ndarray      # repeated byte for runs
+    lit_off: np.ndarray    # byte offset of literals (same base as raw_ref)
+    produced_bytes: int
+    raw_ref: bytes = b""   # source buffer lit_off indexes into
+
+
+def parse_byte_rle(raw: bytes, start: int, end: int) -> ByteRleTable:
+    outs, counts, is_run, vals, lit_offs = [], [], [], [], []
+    pos = start
+    produced = 0
+    while pos < end:
+        h = raw[pos]
+        if h < 128:  # run of h+3 copies of next byte
+            n = h + 3
+            outs.append(produced)
+            counts.append(n)
+            is_run.append(True)
+            vals.append(raw[pos + 1])
+            lit_offs.append(0)
+            pos += 2
+            produced += n
+        else:        # 256-h literal bytes
+            n = 256 - h
+            outs.append(produced)
+            counts.append(n)
+            is_run.append(False)
+            vals.append(0)
+            lit_offs.append(pos + 1)
+            pos += 1 + n
+            produced += n
+    return ByteRleTable(np.asarray(outs, np.int32),
+                        np.asarray(counts, np.int32),
+                        np.asarray(is_run, bool),
+                        np.asarray(vals, np.uint8),
+                        np.asarray(lit_offs, np.int64), produced, raw)
+
+
+# ---------------------------------------------------------------------------
+# Device expansion kernels
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnums=(1,))
+def _extract_be_bits(raw_u8, width: int, bitpos):
+    """Big-endian bit window extraction: `width` bits starting at absolute
+    bit position bitpos (MSB-first), via a 5-byte gather into u64."""
+    byte = (bitpos >> 3).astype(jnp.int64)
+    nbytes = raw_u8.shape[0]
+    acc = jnp.zeros(bitpos.shape, dtype=jnp.uint64)
+    for o in range(5):
+        src = jnp.clip(byte + o, 0, nbytes - 1)
+        acc = acc | (raw_u8[src].astype(jnp.uint64)
+                     << jnp.uint64(8 * (4 - o)))
+    shift = (jnp.uint64(40) - (bitpos & 7).astype(jnp.uint64)
+             - jnp.uint64(width))
+    mask = jnp.uint64((1 << width) - 1)
+    return ((acc >> shift) & mask).astype(jnp.int64)
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9))
+def _expand_rlev2(raw_u8, kind, out_start, count, base, delta0, bit_off,
+                  width_arr, width: int, cap: int):
+    """Expand one RLEv2 run table (all runs share static packed `width`;
+    the host groups runs by width) into int64 values [cap]."""
+    j = jnp.arange(cap, dtype=jnp.int32)
+    run = jnp.clip(jnp.searchsorted(out_start, j, side="right") - 1,
+                   0, out_start.shape[0] - 1).astype(jnp.int32)
+    k = (j - out_start[run]).astype(jnp.int64)
+    rkind = kind[run]
+
+    # SHORT_REPEAT -> base
+    val = base[run]
+
+    # DIRECT -> zigzag(be_bits at bit_off + k*w)
+    if width > 0:
+        bp = bit_off[run] + k * width
+        uv = _extract_be_bits(raw_u8, width, bp)
+        direct = (uv >> 1) ^ -(uv & 1)  # zigzag decode
+        val = jnp.where(rkind == R_DIRECT, direct, val)
+
+        # DELTA packed deltas (values 2..n-1): delta for slot k (k>=2) is
+        # packed at index k-2; cumulative within the run via global cumsum
+        dbp = bit_off[run] + (k - 2) * width
+        d = jnp.where((rkind == R_DELTA) & (k >= 2),
+                      _extract_be_bits(raw_u8, width, dbp), 0)
+    else:
+        d = jnp.zeros((cap,), dtype=jnp.int64)
+
+    # segmented prefix sum of deltas: global cumsum minus the exclusive
+    # cumsum at each run's first slot (d is 0 outside DELTA slots k>=2, so
+    # cross-run contamination is impossible)
+    csum = jnp.cumsum(d)
+    excl0 = (csum - d)[out_start[run]]
+    seg = csum - excl0  # sum of packed deltas for slots 2..k of this run
+    sign = jnp.where(delta0[run] < 0, -1, 1).astype(jnp.int64)
+    var_val = base[run] + jnp.where(k >= 1, delta0[run], 0) + \
+        jnp.where(k >= 2, sign * seg, 0)
+    # fixed-delta runs (no packed payload) step by delta0 every slot
+    fixed_val = base[run] + k * delta0[run]
+    delta_val = jnp.where(width_arr[run] == 0, fixed_val, var_val)
+    val = jnp.where(rkind == R_DELTA, delta_val, val)
+    return val
+
+
+@functools.partial(jax.jit, static_argnums=(6,))
+def _expand_present(raw_u8, out_start, count, is_run, value, lit_off,
+                    cap: int):
+    """byte-RLE expand + MSB-first bit extraction -> bool validity [cap]."""
+    j = jnp.arange(cap, dtype=jnp.int32)
+    bytepos = j >> 3
+    run = jnp.clip(jnp.searchsorted(out_start, bytepos, side="right") - 1,
+                   0, out_start.shape[0] - 1).astype(jnp.int32)
+    k = bytepos - out_start[run]
+    lit_idx = jnp.clip(lit_off[run] + k.astype(jnp.int64), 0,
+                       raw_u8.shape[0] - 1)
+    byte = jnp.where(is_run[run], value[run], raw_u8[lit_idx])
+    bit = 7 - (j & 7)
+    return ((byte >> bit) & 1).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# Column decode driver
+# ---------------------------------------------------------------------------
+_KIND_DT = {K_SHORT: DataType.INT16, K_INT: DataType.INT32,
+            K_LONG: DataType.INT64, K_DATE: DataType.DATE}
+
+
+def column_eligible(meta: OrcMeta, cid: int, dtype: DataType) -> bool:
+    if cid >= len(meta.kinds):
+        return False
+    kind = meta.kinds[cid]
+    return kind in _INT_KINDS and _KIND_DT[kind] == dtype
+
+
+def present_count(bt: ByteRleTable, num_rows: int) -> int:
+    """Count set PRESENT bits over the first num_rows — pure host numpy
+    over the run table; never a device round trip."""
+    nbytes = (num_rows + 7) // 8
+    out = np.zeros(nbytes, dtype=np.uint8)
+    for s0, c, r, v, lo in zip(bt.out_start, bt.count, bt.is_run,
+                               bt.value, bt.lit_off):
+        e = min(s0 + c, nbytes)
+        if e <= s0:
+            continue
+        if r:
+            out[s0:e] = v
+        else:
+            out[s0:e] = np.frombuffer(
+                memoryview(bt.raw_ref)[lo:lo + (e - s0)], dtype=np.uint8)
+    bits = np.unpackbits(out, bitorder="big")[:num_rows]
+    return int(bits.sum())
+
+
+@dataclass
+class ColumnPlan:
+    """Host-parsed decode plan for one stripe column: run tables with
+    offsets REBASED to the stripe region (so only the stripe's bytes need
+    to be on device), plus the present count (computed host-side — never a
+    device round trip)."""
+
+    present: Optional[ByteRleTable]
+    rt: RleV2Table
+    n_present: int
+
+
+def plan_column(raw: bytes, streams: List[StreamLoc],
+                encodings: Dict[int, int], cid: int, num_rows: int,
+                stripe_base: int) -> ColumnPlan:
+    """HOST control plane only: validate encodings and build the run
+    tables. Raises _Unsupported before any device work happens."""
+    if encodings.get(cid, -1) != 2:  # DIRECT_V2
+        raise _Unsupported(f"column encoding {encodings.get(cid)}")
+    data_s = next((s for s in streams
+                   if s.column == cid and s.kind == S_DATA), None)
+    pres_s = next((s for s in streams
+                   if s.column == cid and s.kind == S_PRESENT), None)
+    if data_s is None:
+        raise _Unsupported("no DATA stream")
+    bt = None
+    if pres_s is not None:
+        bt = parse_byte_rle(raw, pres_s.start, pres_s.start + pres_s.length)
+        n_present = present_count(bt, num_rows)
+        bt.lit_off = bt.lit_off - stripe_base
+    else:
+        n_present = num_rows
+    rt = parse_rlev2(raw, data_s.start, data_s.start + data_s.length,
+                     n_present, signed=True)
+    if rt.produced < n_present:
+        raise _Unsupported("RLEv2 stream shorter than expected")
+    rt.bit_off = rt.bit_off - stripe_base * 8
+    return ColumnPlan(bt, rt, n_present)
+
+
+def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
+                  num_rows: int, cap: int):
+    """DEVICE data plane: expand a host-built ColumnPlan over the stripe's
+    device bytes into (data, validity) padded to cap."""
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    raw_u8_dev = stripe_dev_u8
+    if plan.present is not None:
+        bt = plan.present
+        validity = _expand_present(
+            raw_u8_dev, jnp.asarray(bt.out_start), jnp.asarray(bt.count),
+            jnp.asarray(bt.is_run), jnp.asarray(bt.value),
+            jnp.asarray(bt.lit_off), cap)
+    else:
+        validity = jnp.ones((cap,), dtype=bool)
+    rt = plan.rt
+    widths = set(int(w) for w in rt.width if w > 0)
+    if len(widths) > 1:
+        # split runs by width so the kernel's width stays static: decode
+        # each width group over the full capacity and merge
+        dense = jnp.zeros((cap,), dtype=jnp.int64)
+        for w in sorted(widths | {0}):
+            sel = (rt.width == w) if w else \
+                (rt.kind == R_REPEAT) | ((rt.kind == R_DELTA) &
+                                         (rt.width == 0))
+            if not sel.any():
+                continue
+            part = _expand_rlev2(
+                raw_u8_dev, jnp.asarray(rt.kind[sel]),
+                jnp.asarray(rt.out_start[sel]), jnp.asarray(rt.count[sel]),
+                jnp.asarray(rt.base[sel]), jnp.asarray(rt.delta0[sel]),
+                jnp.asarray(rt.bit_off[sel]), jnp.asarray(rt.width[sel]),
+                w, cap)
+            # rows covered by this width group
+            starts = rt.out_start[sel]
+            ends = starts + rt.count[sel]
+            j = np.arange(cap, dtype=np.int32)
+            covered = np.zeros(cap, dtype=bool)
+            for s0, e0 in zip(starts, ends):
+                covered[s0:min(e0, cap)] = True
+            dense = jnp.where(jnp.asarray(covered), part, dense)
+    else:
+        w = widths.pop() if widths else 0
+        dense = _expand_rlev2(
+            raw_u8_dev, jnp.asarray(rt.kind), jnp.asarray(rt.out_start),
+            jnp.asarray(rt.count), jnp.asarray(rt.base),
+            jnp.asarray(rt.delta0), jnp.asarray(rt.bit_off),
+            jnp.asarray(rt.width), w, cap)
+
+    # spread dense present-values onto row slots (null rows get 0)
+    from spark_rapids_tpu.io.parquet_device import _assemble
+
+    row_mask = jnp.arange(cap) < num_rows
+    validity = validity & row_mask
+    data = _assemble(validity, dense, cap)
+    npdt = physical_np_dtype(dtype)
+    if data.dtype != npdt:
+        data = data.astype(npdt)
+    return data, validity

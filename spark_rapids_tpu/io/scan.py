@@ -319,6 +319,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         device_decode = self.fmt == "parquet" and \
             ctx.conf.get(C.PARQUET_DEVICE_DECODE)
         device_csv = self.fmt == "csv" and ctx.conf.get(C.CSV_DEVICE_PARSE)
+        device_orc = self.fmt == "orc" and ctx.conf.get(C.ORC_DEVICE_DECODE)
 
         def factory(pidx: int):
             def gen():
@@ -329,6 +330,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         return
                 if device_csv:
                     batches = self._read_device_csv(self.splits[pidx],
+                                                    ctx.conf)
+                    if batches is not None:
+                        yield from batches
+                        return
+                if device_orc:
+                    batches = self._read_device_orc(self.splits[pidx],
                                                     ctx.conf)
                     if batches is not None:
                         yield from batches
@@ -414,6 +421,90 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 return None  # host parser disagrees: fall back
         return self._assemble_device_batch(dev_cols, hb, rest, pv, rows,
                                            conf)
+
+    def _read_device_orc(self, split: FileSplit, conf):
+        """Device ORC decode for one split; None -> not eligible (caller
+        uses the host Arrow path). Two phases: (1) HOST-ONLY planning —
+        protobuf walk + run tables for every stripe/column, so any
+        unsupported shape falls back before a single device byte moves;
+        (2) a generator that, per stripe, acquires the admission semaphore,
+        uploads JUST that stripe's region, expands on device, and yields —
+        peak HBM is one stripe, not the file."""
+        from spark_rapids_tpu.io import orc_device as OD
+
+        pv = dict(split.partition_values)
+        data_attrs = [a for a in self.attrs if a.name not in pv]
+        try:
+            with open(split.path, "rb") as f:
+                raw = f.read()
+            meta = OD.parse_file_meta(raw)
+        except (OD._Unsupported, OSError):
+            return None
+        name_to_cid = {n: i for i, n in enumerate(meta.names) if n}
+        eligible = [a for a in data_attrs
+                    if a.name in name_to_cid and
+                    OD.column_eligible(meta, name_to_cid[a.name],
+                                       a.data_type)]
+        if not eligible:
+            return None
+        rest = [a for a in data_attrs if a not in eligible]
+        # phase 1: host-only plans for every stripe x eligible column
+        stripe_plans = []
+        try:
+            for si in meta.stripes:
+                streams, encs = OD.parse_stripe_footer(raw, si)
+                plans = {
+                    a.name: OD.plan_column(raw, streams, encs,
+                                           name_to_cid[a.name],
+                                           si.num_rows, si.offset)
+                    for a in eligible}
+                stripe_plans.append(plans)
+        except Exception:
+            return None  # unsupported shape anywhere: whole-split fallback
+
+        return self._orc_stripe_batches(split, meta, raw, stripe_plans,
+                                        eligible, rest, pv, conf)
+
+    def _orc_stripe_batches(self, split, meta, raw, stripe_plans, eligible,
+                            rest, pv, conf):
+        """Phase 2 generator: per-stripe upload + device expand + yield."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.batch import (
+            ColumnVector,
+            bucket_capacity,
+        )
+        from spark_rapids_tpu.io import orc_device as OD
+
+        orc_file = None
+        for sidx, si in enumerate(meta.stripes):
+            rows = si.num_rows
+            cap = bucket_capacity(max(rows, 1))
+            TpuSemaphore.get().acquire_if_necessary(current_task_id())
+            region = raw[si.offset:si.offset + si.index_length +
+                         si.data_length]
+            stripe_dev = jnp.asarray(np.frombuffer(region, dtype=np.uint8))
+            dev_cols = {}
+            for a in eligible:
+                d, v = OD.expand_column(stripe_dev,
+                                        stripe_plans[sidx][a.name],
+                                        a.data_type, rows, cap)
+                dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+            hb = None
+            if rest:
+                import pyarrow.orc as po
+
+                if orc_file is None:
+                    orc_file = po.ORCFile(split.path)
+                rb = orc_file.read_stripe(sidx,
+                                          columns=[a.name for a in rest])
+                hb = arrow_to_host_batch(pa.Table.from_batches([rb]), rest)
+                if hb.num_rows != rows:
+                    raise IOError(
+                        f"ORC stripe {sidx} row-count mismatch: device "
+                        f"plan {rows} vs host {hb.num_rows}")
+            yield from self._assemble_device_batch(dev_cols, hb, rest, pv,
+                                                   rows, conf)
 
     def _assemble_device_batch(self, dev_cols, hb, rest, pv, rows, conf):
         """Combine device-decoded columns with a host-decoded partial batch

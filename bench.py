@@ -178,8 +178,10 @@ def main_decode() -> None:
 def _worker_suite(suite: str, mode: str, sf: float) -> None:
     """Query-suite worker (reference: tpch/Benchmarks.scala:28-90 /
     TpcxbbLikeBench.scala — loop queries, print wall-clock). suite:
-    'tpch' (BASELINE configs 2+3) or 'tpcxbb' (config 5: window +
-    decimal/timestamp casts). Geomean of per-query best-of-2."""
+    'tpch' (BASELINE configs 2+3), 'tpcxbb' (config 5: window +
+    decimal/timestamp casts), or 'mortgage' (the reference's third
+    benchmark family, MortgageSpark.scala). Geomean of per-query
+    best-of-2."""
     import importlib
     import math
 
@@ -303,7 +305,8 @@ def main_suite(suite: str, sf: float) -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         mode = sys.argv[2]
-        if mode.startswith("tpch-") or mode.startswith("tpcxbb-"):
+        if mode.startswith("tpch-") or mode.startswith("tpcxbb-") \
+                or mode.startswith("mortgage-"):
             suite, m = mode.split("-", 1)
             _worker_suite(suite, m,
                           float(os.environ.get("SRT_TPCH_SF", "0.01")))
@@ -311,7 +314,8 @@ if __name__ == "__main__":
             _worker_decode(mode.split("-", 1)[1])
         else:
             _worker(mode)
-    elif len(sys.argv) >= 2 and sys.argv[1] in ("--tpch", "--tpcxbb"):
+    elif len(sys.argv) >= 2 and sys.argv[1] in ("--tpch", "--tpcxbb",
+                                           "--mortgage"):
         main_suite(sys.argv[1].lstrip("-"),
                    float(sys.argv[2]) if len(sys.argv) >= 3 else 0.01)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--decode":

@@ -1,0 +1,115 @@
+"""Mortgage-ETL-like schema, generator, and queries.
+
+Reference parity: integration_tests/src/main/scala/.../mortgage/
+MortgageSpark.scala (437 LoC — the third benchmark family next to TPC-H
+and TPCx-BB: acquisition + performance tables joined into delinquency
+features) and mortgage/Benchmarks.scala (wall-clock loop). The queries
+keep the reference's operator mix: CSV-ish wide scans, date arithmetic,
+conditional aggregation over delinquency status, a 3-way join into
+per-loan features, and a quarter-level rollup.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+_EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def _days(s: str) -> int:
+    return int((np.datetime64(s, "D") - _EPOCH).astype(int))
+
+
+def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
+               seed: int = 13) -> Dict[str, "object"]:
+    """acquisition (1 row per loan) + performance (~24 rows per loan)."""
+    rng = np.random.default_rng(seed)
+    n_loans = max(32, int(400_000 * sf))
+    n_perf = n_loans * 24
+
+    lo, hi = _days("2000-01-01"), _days("2008-12-31")
+    acquisition = session.createDataFrame({
+        "loan_id": np.arange(n_loans, dtype=np.int64),
+        "orig_date": rng.integers(lo, hi, n_loans).astype(np.int32),
+        "orig_upb": rng.integers(50_000, 800_000, n_loans).astype(np.int64),
+        "credit_score": rng.integers(300, 850, n_loans).astype(np.int32),
+        "dti": (rng.random(n_loans) * 60).astype(np.float32),
+        "seller": np.array(
+            [f"SELLER_{i}" for i in rng.integers(0, 20, n_loans)],
+            dtype=object),
+    }, [("loan_id", "long"), ("orig_date", DataType.DATE),
+        ("orig_upb", "long"), ("credit_score", "int"), ("dti", "float"),
+        ("seller", "string")], num_partitions=max(1, num_partitions // 2))
+
+    loan = rng.integers(0, n_loans, n_perf).astype(np.int64)
+    month = rng.integers(0, 72, n_perf).astype(np.int32)
+    performance = session.createDataFrame({
+        "loan_id": loan,
+        "report_date": (lo + month * 30).astype(np.int32),
+        "current_upb": rng.integers(0, 800_000, n_perf).astype(np.int64),
+        # 0 = current, 1-5 = months delinquent, 6 = default-ish
+        "delinq_status": np.minimum(
+            rng.geometric(0.6, n_perf) - 1, 6).astype(np.int32),
+    }, [("loan_id", "long"), ("report_date", DataType.DATE),
+        ("current_upb", "long"), ("delinq_status", "int")],
+        num_partitions=num_partitions)
+
+    return {"acquisition": acquisition, "performance": performance}
+
+
+def q_delinquency(t) -> "object":
+    """Per-loan delinquency features (the reference's core ETL join):
+    conditional aggregates over status, joined back to acquisition."""
+    perf, acq = t["performance"], t["acquisition"]
+    ever30 = F.when(F.col("delinq_status") >= F.lit(1),
+                    F.lit(1)).otherwise(F.lit(0))
+    ever90 = F.when(F.col("delinq_status") >= F.lit(3),
+                    F.lit(1)).otherwise(F.lit(0))
+    feats = (perf
+             .withColumn("e30", ever30)
+             .withColumn("e90", ever90)
+             .groupBy("loan_id")
+             .agg(F.max("delinq_status").alias("worst"),
+                  F.sum("e30").alias("months_30"),
+                  F.sum("e90").alias("months_90"),
+                  F.min("current_upb").alias("min_upb"),
+                  F.count("*").alias("n_reports")))
+    return (acq.join(feats, on="loan_id", how="inner")
+            .filter(F.col("months_90") > F.lit(0))
+            .withColumn("upb_paid_frac",
+                        F.lit(1.0) - F.col("min_upb")
+                        / F.col("orig_upb"))
+            .orderBy(F.col("worst").desc(), F.col("loan_id"))
+            .limit(100))
+
+
+def q_seller_quarter(t) -> "object":
+    """Quarter-level seller rollup (date bucketing + join + agg + sort)."""
+    perf, acq = t["performance"], t["acquisition"]
+    joined = perf.join(acq, on="loan_id", how="inner")
+    quarter = (F.year(F.col("report_date")) * F.lit(10)
+               + F.quarter(F.col("report_date")))
+    bad = F.when(F.col("delinq_status") >= F.lit(3),
+                 F.col("current_upb")).otherwise(F.lit(0))
+    return (joined
+            .withColumn("yq", quarter)
+            .withColumn("bad_upb", bad)
+            .groupBy("seller", "yq")
+            .agg(F.sum("current_upb").alias("upb"),
+                 F.sum("bad_upb").alias("bad_upb"),
+                 F.avg("credit_score").alias("avg_score"),
+                 F.count("*").alias("n"))
+            .filter(F.col("n") > F.lit(5))
+            .orderBy(F.col("bad_upb").desc(), F.col("seller"), F.col("yq"))
+            .limit(50))
+
+
+QUERIES: Dict[str, Callable] = {
+    "q_delinquency": q_delinquency,
+    "q_seller_quarter": q_seller_quarter,
+}

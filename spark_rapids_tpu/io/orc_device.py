@@ -32,7 +32,6 @@ from spark_rapids_tpu import _jax_setup  # noqa: F401
 import jax
 import jax.numpy as jnp
 
-from spark_rapids_tpu.columnar.batch import bucket_capacity
 from spark_rapids_tpu.columnar.dtypes import DataType
 
 
@@ -113,6 +112,22 @@ _INT_KINDS = {K_SHORT, K_INT, K_LONG, K_DATE}
 
 # stream kinds
 S_PRESENT, S_DATA = 0, 1
+
+
+def tail_compression(tail: bytes) -> int:
+    """Compression kind from a file TAIL (>= PostScript bytes) — lets the
+    caller reject compressed files before reading the whole file."""
+    if len(tail) < 2:
+        raise _Unsupported("not an ORC file")
+    psl = tail[-1]
+    if psl + 1 > len(tail):
+        raise _Unsupported("truncated tail")
+    comp = 0
+    for fnum, _wt, v in _Proto(tail, len(tail) - 1 - psl,
+                               len(tail) - 1).fields():
+        if fnum == 2:
+            comp = v
+    return comp
 
 
 def parse_file_meta(raw: bytes) -> OrcMeta:
@@ -544,6 +559,11 @@ def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
     else:
         validity = jnp.ones((cap,), dtype=bool)
     rt = plan.rt
+    if rt.kind.size == 0:
+        # entirely-null column in this stripe: no runs, nothing to expand
+        # (the PRESENT expansion already yields all-False validity)
+        return (jnp.zeros((cap,), dtype=physical_np_dtype(dtype)),
+                validity & (jnp.arange(cap) < num_rows))
     widths = set(int(w) for w in rt.width if w > 0)
     if len(widths) > 1:
         # split runs by width so the kernel's width stays static: decode

@@ -436,6 +436,14 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         data_attrs = [a for a in self.attrs if a.name not in pv]
         try:
             with open(split.path, "rb") as f:
+                # tail-first: reject compressed files (the common on-disk
+                # case) from the PostScript alone, before a full-file read
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 4096))
+                if OD.tail_compression(f.read()) != 0:
+                    return None
+                f.seek(0)
                 raw = f.read()
             meta = OD.parse_file_meta(raw)
         except (OD._Unsupported, OSError):
@@ -462,12 +470,16 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         except Exception:
             return None  # unsupported shape anywhere: whole-split fallback
 
-        return self._orc_stripe_batches(split, meta, raw, stripe_plans,
+        # the generator re-reads each stripe region from disk on demand —
+        # `raw` must NOT outlive phase 1, so peak host memory during the
+        # scan is one stripe, not the file
+        del raw
+        return self._orc_stripe_batches(split, meta, stripe_plans,
                                         eligible, rest, pv, conf)
 
-    def _orc_stripe_batches(self, split, meta, raw, stripe_plans, eligible,
+    def _orc_stripe_batches(self, split, meta, stripe_plans, eligible,
                             rest, pv, conf):
-        """Phase 2 generator: per-stripe upload + device expand + yield."""
+        """Phase 2 generator: per-stripe read + upload + expand + yield."""
         import jax.numpy as jnp
 
         from spark_rapids_tpu.columnar.batch import (
@@ -481,8 +493,9 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
             rows = si.num_rows
             cap = bucket_capacity(max(rows, 1))
             TpuSemaphore.get().acquire_if_necessary(current_task_id())
-            region = raw[si.offset:si.offset + si.index_length +
-                         si.data_length]
+            with open(split.path, "rb") as f:
+                f.seek(si.offset)
+                region = f.read(si.index_length + si.data_length)
             stripe_dev = jnp.asarray(np.frombuffer(region, dtype=np.uint8))
             dev_cols = {}
             for a in eligible:

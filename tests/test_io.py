@@ -467,6 +467,22 @@ class TestDeviceParquetDecode:
                               F.count("*").alias("n")),
             ignore_order=True)
 
+    def test_orc_all_null_column(self, session, tmp_path):
+        # an entirely-null int column has an EMPTY RLEv2 run table; the
+        # device path must decode it as all-NULL, not crash
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.orc as po
+
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+        tbl = pa.table({"a": pa.array([None] * 1000, type=pa.int64()),
+                        "b": pa.array(np.arange(1000, dtype=np.int64))})
+        path = str(tmp_path / "nulls.orc")
+        po.write_table(tbl, path, compression="uncompressed")
+        assert_tpu_and_cpu_are_equal_collect(
+            session, lambda s: s.read.orc(path), ignore_order=True)
+
     def test_orc_compressed_falls_back_correct(self, session, tmp_path):
         import numpy as np
         import pyarrow as pa

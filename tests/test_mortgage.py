@@ -1,0 +1,27 @@
+"""Mortgage-ETL-like query equivalence at tiny scale (reference:
+MortgageSpark.scala + mortgage/Benchmarks.scala — the third benchmark
+family: acquisition x performance delinquency features)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import mortgage
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.mark.parametrize("qname", sorted(mortgage.QUERIES))
+def test_mortgage_query_equivalence(session, qname):
+    def q(s):
+        tables = mortgage.gen_tables(s, sf=0.001, num_partitions=3)
+        return mortgage.QUERIES[qname](tables)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-6)
+
+
+def test_mortgage_nonempty(session):
+    tables = mortgage.gen_tables(session, sf=0.001, num_partitions=2)
+    rows = mortgage.q_delinquency(tables).collect()
+    assert 0 < len(rows) <= 100
+    rows2 = mortgage.q_seller_quarter(tables).collect()
+    assert 0 < len(rows2) <= 50

@@ -275,6 +275,15 @@ CSV_DEVICE_PARSE = _conf(
     "Quoted/ragged files and non-integral columns fall back to the host "
     "Arrow parser."
 ).boolean(True)
+CSV_DEVICE_MAX_SPLIT_BYTES = _conf(
+    "rapids.tpu.sql.format.csv.deviceParse.maxSplitBytes").doc(
+    "Largest CSV split the device parser will load whole into host memory "
+    "(the boundary plan builds rows*cols int32 tables before value "
+    "eligibility is known, so a near-2GiB split would cost several GiB of "
+    "host RAM); bigger splits use the streaming host Arrow reader "
+    "(reference bounds CSV reads with line-aligned chunks the same way, "
+    "GpuBatchScanExec.scala:322-520)."
+).bytes(256 << 20)
 ORC_READ_ENABLED = _conf("rapids.tpu.sql.format.orc.read.enabled").boolean(True)
 ORC_DEVICE_DECODE = _conf(
     "rapids.tpu.sql.format.orc.deviceDecode.enabled").doc(

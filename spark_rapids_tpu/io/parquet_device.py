@@ -17,10 +17,16 @@ split keeps the same shape:
   (dictionary-encoded) chunk, typically several times smaller than the
   decoded column.
 
-Scope (v1): flat INT32/INT64 (+DATE/TIMESTAMP, and FLOAT32/FLOAT64 where
-the backend has f64) columns, UNCOMPRESSED codec, v1 data pages encoded
-PLAIN or RLE_DICTIONARY/PLAIN_DICTIONARY. Arrow remains the oracle and the
-fallback for everything else (per SURVEY.md section 7 hard part #2 phasing).
+Scope: flat INT32/INT64 (+DATE/TIMESTAMP, and FLOAT32/FLOAT64 where
+the backend has f64) and dictionary-encoded STRING columns; v1 AND v2 data
+pages encoded PLAIN or RLE_DICTIONARY/PLAIN_DICTIONARY; UNCOMPRESSED,
+SNAPPY, GZIP, ZSTD and BROTLI codecs.  Compressed pages decompress on the
+HOST (block decompression is control-plane: inherently serial bit-stream
+work; the reference does it inside cuDF but the data-plane win — run
+expansion, dictionary gather, validity spread — is the same either way)
+and the decompressed chunk feeds the identical device expansion.  Arrow
+remains the oracle and the fallback for everything else (per SURVEY.md
+section 7 hard part #2 phasing).
 """
 
 from __future__ import annotations
@@ -132,15 +138,25 @@ _PH_UNCOMPRESSED = 2
 _PH_COMPRESSED = 3
 _PH_DATA_V1 = 5
 _PH_DICT = 7
+_PH_DATA_V2 = 8
 # DataPageHeader fields
 _DP_NUM_VALUES = 1
 _DP_ENCODING = 2
 _DP_DEF_ENC = 3
+# DataPageHeaderV2 fields
+_D2_NUM_VALUES = 1
+_D2_NUM_NULLS = 2
+_D2_NUM_ROWS = 3
+_D2_ENCODING = 4
+_D2_DEF_LEN = 5
+_D2_REP_LEN = 6
+_D2_IS_COMPRESSED = 7
 # DictionaryPageHeader fields
 _DI_NUM_VALUES = 1
 
 PAGE_DATA_V1 = 0
 PAGE_DICT = 2
+PAGE_DATA_V2 = 3
 ENC_PLAIN = 0
 ENC_PLAIN_DICT = 2
 ENC_RLE = 3
@@ -149,17 +165,25 @@ ENC_RLE_DICT = 8
 
 @dataclass
 class PageInfo:
-    kind: int            # PAGE_DATA_V1 | PAGE_DICT
+    kind: int            # PAGE_DATA_V1 | PAGE_DICT | PAGE_DATA_V2
     num_values: int
     encoding: int
     data_start: int      # offset of page payload within the chunk bytes
     data_len: int
+    uncompressed_len: int = -1  # -1: same as data_len (uncompressed chunk)
+    def_len: int = 0     # v2: definition-levels byte length (never prefixed)
+    rep_len: int = 0     # v2: repetition-levels byte length (0 for flat)
+    data_compressed: bool = True  # v2: is the data section compressed?
 
 
 def parse_pages(chunk: bytes) -> List[PageInfo]:
     """Walk the page headers of one raw column chunk (native single pass
-    when built, thrift-in-Python fallback)."""
-    pages = _parse_pages_native(chunk)
+    when built, thrift-in-Python fallback; the Python walker also speaks
+    v2 data pages, which the native one reports as unsupported)."""
+    try:
+        pages = _parse_pages_native(chunk)
+    except _Unsupported:
+        return _parse_pages_py(chunk)
     if pages is not NotImplemented:
         return pages
     return _parse_pages_py(chunk)
@@ -208,16 +232,24 @@ def _parse_pages_py(chunk: bytes) -> List[PageInfo]:
         hdr = r.struct()
         payload = r.pos
         size = hdr[_PH_COMPRESSED]
+        usize = hdr.get(_PH_UNCOMPRESSED, size)
         kind = hdr[_PH_TYPE]
         if kind == PAGE_DICT:
             d = hdr[_PH_DICT]
             pages.append(PageInfo(kind, d[_DI_NUM_VALUES], ENC_PLAIN,
-                                  payload, size))
+                                  payload, size, usize))
         elif kind == PAGE_DATA_V1:
             d = hdr[_PH_DATA_V1]
             pages.append(PageInfo(kind, d[_DP_NUM_VALUES], d[_DP_ENCODING],
-                                  payload, size))
-        else:  # v2 pages etc. -> caller falls back to Arrow
+                                  payload, size, usize))
+        elif kind == PAGE_DATA_V2:
+            d = hdr[_PH_DATA_V2]
+            pages.append(PageInfo(
+                kind, d[_D2_NUM_VALUES], d[_D2_ENCODING], payload, size,
+                usize, def_len=d.get(_D2_DEF_LEN, 0),
+                rep_len=d.get(_D2_REP_LEN, 0),
+                data_compressed=bool(d.get(_D2_IS_COMPRESSED, True))))
+        else:  # index pages etc. -> caller falls back to Arrow
             raise _Unsupported(f"page type {kind}")
         pos = payload + size
     return pages
@@ -225,6 +257,72 @@ def _parse_pages_py(chunk: bytes) -> List[PageInfo]:
 
 class _Unsupported(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Host-side page decompression (control plane)
+# ---------------------------------------------------------------------------
+_CODEC_NAMES = {"SNAPPY": "snappy", "GZIP": "gzip", "ZSTD": "zstd",
+                "BROTLI": "brotli"}
+
+
+@functools.lru_cache(maxsize=None)
+def _get_codec(parquet_codec: str):
+    """pyarrow block codec for a parquet CompressionCodec name, or None if
+    this build of Arrow lacks it. (LZ4/LZO stay unsupported: parquet's LZ4
+    framing differs from the lz4-frame codec Arrow exposes.)"""
+    name = _CODEC_NAMES.get(parquet_codec)
+    if name is None:
+        return None
+    try:
+        import pyarrow as pa
+
+        return pa.Codec(name)
+    except Exception:
+        return None
+
+
+def codec_supported(parquet_codec: str) -> bool:
+    return parquet_codec == "UNCOMPRESSED" or \
+        _get_codec(parquet_codec) is not None
+
+
+def normalize_chunk(chunk: bytes, codec: str):
+    """Decompress every page payload of a raw column chunk, returning
+    (uncompressed_chunk_bytes, pages-with-offsets-into-it). v2 pages keep
+    their level bytes (stored uncompressed by spec) and decompress only the
+    data section. The result feeds the same device expansion kernels as a
+    natively UNCOMPRESSED chunk — decompression is host control-plane work,
+    the decode data plane stays on the device."""
+    pages = _parse_pages_py(chunk)
+    if codec == "UNCOMPRESSED":
+        return chunk, pages
+    dec = _get_codec(codec)
+    if dec is None:
+        raise _Unsupported(f"codec {codec}")
+    out = bytearray()
+    new_pages = []
+    from dataclasses import replace as _replace
+
+    for p in pages:
+        payload = chunk[p.data_start:p.data_start + p.data_len]
+        usize = p.uncompressed_len if p.uncompressed_len >= 0 else p.data_len
+        if p.kind == PAGE_DATA_V2:
+            lvl = p.rep_len + p.def_len
+            body = payload[lvl:]
+            if p.data_compressed and len(body):
+                body = dec.decompress(body, usize - lvl).to_pybytes()
+            new_payload = bytes(payload[:lvl]) + bytes(body)
+        else:
+            new_payload = dec.decompress(payload, usize).to_pybytes() \
+                if len(payload) else b""
+        start = len(out)
+        out += new_payload
+        new_pages.append(_replace(p, data_start=start,
+                                  data_len=len(new_payload),
+                                  uncompressed_len=len(new_payload),
+                                  data_compressed=False))
+    return bytes(out), new_pages
 
 
 # ---------------------------------------------------------------------------
@@ -383,7 +481,7 @@ _PHYS_OK = {"INT32": DataType.INT32, "INT64": DataType.INT64,
 def column_eligible(col_meta, dtype: DataType) -> bool:
     """Can this column chunk decode on device? (codec, physical type,
     encodings; reference analog: GpuParquetScan tagging)."""
-    if col_meta.compression != "UNCOMPRESSED":
+    if not codec_supported(col_meta.compression):
         return False
     ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}
     if not set(col_meta.encodings) <= ok_enc:
@@ -428,21 +526,27 @@ def _parse_dict_strings(chunk: bytes, start: int, n: int):
 
 
 def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
-                        max_def: int, cap: Optional[int] = None):
+                        max_def: int, cap: Optional[int] = None,
+                        codec: str = "UNCOMPRESSED"):
     """Decode one raw column chunk into a device ColumnVector.
 
-    Fixed-width columns: PLAIN / dictionary pages. STRING columns:
-    dictionary pages only — the (offset, length) dictionary table parses on
-    the host, value bytes upload once, and the output column is one jitted
-    gather through build_from_plan (reference decodes strings on the
-    accelerator via cudf the same way, GpuParquetScan.scala:536-556).
+    Fixed-width columns: PLAIN / dictionary pages, v1 or v2. STRING
+    columns: dictionary pages only — the (offset, length) dictionary table
+    parses on the host, value bytes upload once, and the output column is
+    one jitted gather through build_from_plan (reference decodes strings on
+    the accelerator via cudf the same way, GpuParquetScan.scala:536-556).
+    Compressed chunks (snappy/gzip/zstd/brotli) decompress page-by-page on
+    the host first (normalize_chunk); the device data plane is identical.
 
     max_def: 1 for nullable columns (def levels present), 0 for required.
     Raises _Unsupported for shapes outside scope (caller falls back to the
     Arrow host path)."""
     from spark_rapids_tpu.columnar.batch import ColumnVector
 
-    pages = parse_pages(chunk)
+    if codec != "UNCOMPRESSED":
+        chunk, pages = normalize_chunk(chunk, codec)
+    else:
+        pages = parse_pages(chunk)
     cap = cap or bucket_capacity(max(num_rows, 1))
     is_string = dtype is DataType.STRING
     npdt = np.dtype(np.int32) if is_string else physical_np_dtype(dtype)
@@ -471,7 +575,22 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         pos = p.data_start
         end = p.data_start + p.data_len
         page_cap = bucket_capacity(max(p.num_values, 1))
-        if max_def > 0:
+        if p.kind == PAGE_DATA_V2:
+            # v2: rep/def level bytes sit unprefixed (and uncompressed)
+            # ahead of the data section, lengths from the page header
+            if p.rep_len:
+                raise _Unsupported("repetition levels (nested) in v2 page")
+            if max_def > 0 and p.def_len > 0:
+                rt = parse_runs(chunk, pos, pos + p.def_len, 1,
+                                p.num_values)
+                page_valid = _expand_hybrid(
+                    chunk_dev, jnp.asarray(rt.out_start),
+                    jnp.asarray(rt.is_rle), jnp.asarray(rt.value),
+                    jnp.asarray(rt.bit_off), 1, page_cap).astype(bool)
+            else:
+                page_valid = jnp.ones((page_cap,), dtype=bool)
+            pos += p.def_len
+        elif max_def > 0:
             # v1 def levels: u32 length prefix + RLE hybrid, bit width 1
             dl_len = int.from_bytes(chunk[pos:pos + 4], "little")
             rt = parse_runs(chunk, pos + 4, pos + 4 + dl_len, 1,

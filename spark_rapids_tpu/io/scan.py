@@ -368,6 +368,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         sep = split.opt("sep", split.opt("delimiter", ","))
         if not isinstance(sep, str) or len(sep) != 1:
             return None
+        from spark_rapids_tpu import conf as C
+
+        if os.path.getsize(split.path) > conf.get(C.CSV_DEVICE_MAX_SPLIT_BYTES):
+            # the whole-file boundary plan costs rows*cols int32 tables in
+            # host RAM; past this size the streaming Arrow path is cheaper
+            return None
         with open(split.path, "rb") as f:
             data = f.read()
         if not data:
@@ -598,7 +604,8 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 try:
                     dev_cols[a.name] = PD.decode_chunk_device(
                         chunk, a.data_type, rows,
-                        max_def=max_def.get(a.name, 1), cap=cap)
+                        max_def=max_def.get(a.name, 1), cap=cap,
+                        codec=col.compression)
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback
             hb = None

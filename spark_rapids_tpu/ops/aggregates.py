@@ -30,6 +30,7 @@ from spark_rapids_tpu.ops.base import (
     AttributeReference,
     BinaryExpression,
     Expression,
+    TernaryExpression,
     UnaryExpression,
 )
 from spark_rapids_tpu.ops.literals import Literal
@@ -155,22 +156,26 @@ class _UnscaledLo(UnaryExpression):
         return v.data.astype(np.int64) & np.int64(0xFFFFFFFF)
 
 
-class _DecimalSumFinish(BinaryExpression):
+class _DecimalSumFinish(TernaryExpression):
     """Recombine hi/lo partial sums into the final decimal sum.
 
     The hi/lo split makes 64-bit decimal sums *exact*: per-lane
     v == (v >> 32)*2^32 + (v & 0xffffffff), and neither partial sum can wrap
-    int64 for any group under 2^31 rows. Overflow of the true sum beyond the
-    result precision (or int64) yields SQL NULL, matching Spark's non-ANSI
-    decimal sum."""
+    int64 for any group under 2^31 rows.  The third operand is the per-group
+    non-null row count; at or above 2^31 rows the lo partial itself could
+    have wrapped undetectably, so the result is NULL (the framework's
+    "NULL, never a wrong value" guarantee — Spark would keep summing, but a
+    silently wrapped value is worse than a conservative NULL).  Overflow of
+    the true sum beyond the result precision (or int64) likewise yields SQL
+    NULL, matching Spark's non-ANSI decimal sum."""
 
-    def __init__(self, hi, lo, result_type):
-        super().__init__(hi, lo)
+    def __init__(self, hi, lo, n, result_type):
+        super().__init__(hi, lo, n)
         self._result_type = result_type
 
     def with_children(self, new_children):
         return _DecimalSumFinish(new_children[0], new_children[1],
-                                 self._result_type)
+                                 new_children[2], self._result_type)
 
     @property
     def data_type(self):
@@ -183,7 +188,7 @@ class _DecimalSumFinish(BinaryExpression):
     def _fingerprint_extra(self):
         return f"{self._result_type.name};"
 
-    def do_columnar(self, ctx, lv, rv):
+    def do_columnar(self, ctx, lv, rv, nv):
         from spark_rapids_tpu.ops import decimal_util as DU
         from spark_rapids_tpu.ops.base import _d
         from spark_rapids_tpu.ops.values import ColV
@@ -191,13 +196,15 @@ class _DecimalSumFinish(BinaryExpression):
         xp = ctx.xp
         hi = DU._i64(xp, _d(lv))
         lo = DU._i64(xp, _d(rv))
+        n = DU._i64(xp, _d(nv))
+        exact = n < np.int64(2 ** 31)
         total_hi = hi + (lo >> np.int64(32))
         rem = lo & np.int64(0xFFFFFFFF)
         fits = (total_hi >= np.int64(-(2 ** 31))) & \
                (total_hi < np.int64(2 ** 31))
         val = xp.where(fits, total_hi, 0) * np.int64(2 ** 32) + rem
         val, ok2 = DU.fit_precision(xp, val, self._result_type.precision)
-        ok = fits & ok2
+        ok = exact & fits & ok2
         return ColV(self._result_type, xp.where(ok, val, 0), ok)
 
 
@@ -213,7 +220,8 @@ class Sum(AggregateFunction):
     def buffer_attrs(self):
         if self._is_decimal:
             return [AttributeReference("sum_hi", DataType.INT64, True),
-                    AttributeReference("sum_lo", DataType.INT64, True)]
+                    AttributeReference("sum_lo", DataType.INT64, True),
+                    AttributeReference("sum_n", DataType.INT64, False)]
         return [AttributeReference("sum", self.data_type, True)]
 
     def update_aggs(self):
@@ -221,7 +229,8 @@ class Sum(AggregateFunction):
 
         if self._is_decimal:
             return [("sum_hi", "sum", _UnscaledHi(self.child)),
-                    ("sum_lo", "sum", _UnscaledLo(self.child))]
+                    ("sum_lo", "sum", _UnscaledLo(self.child)),
+                    ("sum_n", "count", self.child)]
         src = self.child
         if src.data_type != self.data_type:
             src = Cast(src, self.data_type)
@@ -229,13 +238,21 @@ class Sum(AggregateFunction):
 
     def merge_aggs(self):
         if self._is_decimal:
-            return [("sum_hi", "sum"), ("sum_lo", "sum")]
+            return [("sum_hi", "sum"), ("sum_lo", "sum"), ("sum_n", "sum")]
         return [("sum", "sum")]
 
     def evaluate_expression(self, buffers):
         if self._is_decimal:
-            return _DecimalSumFinish(buffers[0], buffers[1], self.data_type)
+            return _DecimalSumFinish(buffers[0], buffers[1], buffers[2],
+                                     self.data_type)
         return buffers[0]
+
+    def initial_buffer_values(self):
+        if self._is_decimal:
+            # sum_n is declared non-nullable: the empty reduction must seed
+            # it with 0, not SQL NULL (result NULL-ness comes from sum_hi/lo)
+            return [None, None, 0]
+        return [None]
 
 
 class Count(AggregateFunction):
@@ -357,7 +374,8 @@ class Average(AggregateFunction):
         if self._dec is not None:
             sum_type = _sum_type(self._dec)
             return _DecimalAvgFinish(
-                _DecimalSumFinish(buffers[0], buffers[1], sum_type),
+                _DecimalSumFinish(buffers[0], buffers[1], buffers[2],
+                                  sum_type),
                 buffers[2], sum_type.scale, self.data_type)
         return Divide(buffers[0], Cast(buffers[1], DataType.FLOAT64))
 

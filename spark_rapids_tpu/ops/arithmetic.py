@@ -96,13 +96,29 @@ class BinaryArithmetic(BinaryExpression):
 
     # -- shared decimal addsub/mul driver ------------------------------------
     def _decimal_addsub(self, ctx, lv, rv, sign: int):
+        """Add/sub at the max operand scale, then round once to the result
+        scale.  When precision adjustment shrinks the result scale below
+        max(s1, s2), rescaling each operand independently before adding
+        would round twice and can differ from Spark's exact-add-then-round
+        by one ulp.  The upscaled operands are only bounded by int64, so the
+        add carries an explicit wrap check; a wrapped intermediate is the
+        documented intermediate-overflow NULL, never a wrong value."""
         xp = ctx.xp
         ld, rd, res = self._decimal_types()
-        l, ok1 = DU.rescale(xp, DU._i64(xp, _d(lv)), ld.scale, res.scale)
-        r, ok2 = DU.rescale(xp, DU._i64(xp, _d(rv)), rd.scale, res.scale)
-        out = l + r if sign > 0 else l - r
+        s = max(ld.scale, rd.scale)
+        l, ok1 = DU.rescale(xp, DU._i64(xp, _d(lv)), ld.scale, s)
+        r, ok2 = DU.rescale(xp, DU._i64(xp, _d(rv)), rd.scale, s)
+        r = r if sign > 0 else -r
+        out = l + r
+        # the upscaled operands can each reach ~9.2e18, so the add itself can
+        # wrap int64: same-sign inputs whose sum flips sign -> overflow NULL
+        no_wrap = ~(((l >= 0) == (r >= 0)) & ((out >= 0) != (l >= 0)))
+        ok = ok1 & ok2 & no_wrap
+        if s != res.scale:
+            out, ok4 = DU.rescale(xp, out, s, res.scale)
+            ok = ok & ok4
         out, ok3 = DU.fit_precision(xp, out, res.precision)
-        ok = ok1 & ok2 & ok3
+        ok = ok & ok3
         return ColV(res, xp.where(ok, out, 0), ok)
 
 

@@ -172,6 +172,46 @@ def string_words(xp, col: ColV) -> List[Any]:
     return _string_words_device(col)
 
 
+def matrix_string_words(xp, mat, lens, validity) -> List[Any]:
+    """String hash words from a fixed-width [rows, W] byte matrix + per-row
+    byte lengths — bit-identical to _string_words_device on the
+    (offsets, bytes) representation, for rows exchanged as padded
+    fixed-width buckets (shuffle/ici.py). Bytes at j >= len are ignored."""
+    import jax.numpy as jnp
+
+    W = mat.shape[1]
+    j = jnp.arange(W, dtype=jnp.int32)[None, :]
+    lens_i = lens.astype(jnp.int32)[:, None]
+    in_str = j < lens_i
+    m = jnp.where(in_str, lens_i - 1 - j, 0).astype(jnp.uint32)
+    u = mat.astype(jnp.uint32)
+    c1 = u * _pow_mod32(jnp, jnp.uint32(31), m)
+    c2 = u * _pow_mod32(jnp, jnp.uint32(1000003), m)
+    zero = jnp.zeros((), jnp.uint32)
+    h1 = jnp.sum(jnp.where(in_str, c1, zero), axis=1).astype(jnp.uint32)
+    h2 = jnp.sum(jnp.where(in_str, c2, zero), axis=1).astype(jnp.uint32)
+    lens_u = lens.astype(jnp.uint32)
+    return [jnp.where(validity, h1, zero), jnp.where(validity, h2, zero),
+            jnp.where(validity, lens_u, zero)]
+
+
+def hash_word_entries(xp, entries, seed=HASH_SEED):
+    """Murmur3-style mix over pre-decomposed (words, validity) entries."""
+    h: Optional[Any] = None
+    for words, validity in entries:
+        nullw = xp.where(validity, np.uint32(0), _GOLDEN).astype(np.uint32)
+        # zero data words at null lanes: an evaluated column may carry
+        # arbitrary data under null, and all NULLs must hash identically
+        words = [xp.where(validity, w, np.uint32(0)).astype(np.uint32)
+                 for w in words] + [nullw]
+        for w in words:
+            if h is None:
+                h = xp.full(w.shape, np.uint32(seed), dtype=np.uint32)
+            h = _mix_h1(xp, h, w.astype(np.uint32))
+    assert h is not None, "hash needs at least one column"
+    return _fmix32(xp, h)
+
+
 def hash_columns(xp, cols: List[ColV], seed=HASH_SEED):
     """Murmur3-style row hash over multiple columns -> uint32 array.
 
@@ -180,24 +220,18 @@ def hash_columns(xp, cols: List[ColV], seed=HASH_SEED):
     is simpler and equally consistent for partitioning/grouping since both
     engines here share this code path.
     """
-    h: Optional[Any] = None
-    for col in cols:
-        words = string_words(xp, col) if col.dtype is DataType.STRING \
-            else column_words(xp, col)
-        nullw = xp.where(col.validity, np.uint32(0), _GOLDEN).astype(np.uint32)
-        # zero data words at null lanes: an evaluated column may carry
-        # arbitrary data under null, and all NULLs must hash identically
-        words = [xp.where(col.validity, w, np.uint32(0)).astype(np.uint32)
-                 for w in words] + [nullw]
-        for w in words:
-            if h is None:
-                h = xp.full(w.shape, np.uint32(seed), dtype=np.uint32)
-            h = _mix_h1(xp, h, w.astype(np.uint32))
-    assert h is not None, "hash_columns needs at least one column"
-    return _fmix32(xp, h)
+    entries = [(string_words(xp, col) if col.dtype is DataType.STRING
+                else column_words(xp, col), col.validity) for col in cols]
+    return hash_word_entries(xp, entries, seed)
 
 
 def partition_ids(xp, cols: List[ColV], num_partitions: int):
     """pmod(hash, n) partition index per row -> int32 in [0, n)."""
     h = hash_columns(xp, cols)
+    return (h % np.uint32(num_partitions)).astype(np.int32)
+
+
+def partition_ids_from_entries(xp, entries, num_partitions: int):
+    """partition_ids over pre-decomposed (words, validity) entries."""
+    h = hash_word_entries(xp, entries)
     return (h % np.uint32(num_partitions)).astype(np.int32)

@@ -227,11 +227,78 @@ class TestDeviceParquetDecode:
         assert_tpu_and_cpu_are_equal_collect(
             session, lambda s: s.read.parquet(path), ignore_order=True)
 
-    def test_compressed_file_falls_back_correctly(self, session, tmp_path):
+    def test_snappy_decodes_on_device(self, session, tmp_path, monkeypatch):
+        # real-world parquet is snappy: the device decode path must engage
+        # (host page decompression feeding the same device expansion), not
+        # silently fall back to Arrow
         from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.io import parquet_device as PD
 
+        calls = []
+        orig = PD.decode_chunk_device
+
+        def spy(*a, **k):
+            out = orig(*a, **k)
+            calls.append(k.get("codec", "UNCOMPRESSED"))
+            return out
+
+        monkeypatch.setattr(PD, "decode_chunk_device", spy)
         path = self._write(tmp_path, name="snappy.parquet",
                            compression="SNAPPY")
+        assert_tpu_and_cpu_are_equal_collect(
+            session, lambda s: s.read.parquet(path), ignore_order=True)
+        assert "SNAPPY" in calls, calls
+
+    def test_gzip_decodes_on_device(self, session, tmp_path):
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+        path = self._write(tmp_path, name="gz.parquet", compression="GZIP")
+        assert_tpu_and_cpu_are_equal_collect(
+            session, lambda s: s.read.parquet(path), ignore_order=True)
+
+    def test_v2_pages_decode_on_device(self, session, tmp_path, monkeypatch):
+        # v2 data pages: unprefixed def levels ahead of the data section
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.io import parquet_device as PD
+
+        calls = []
+        orig = PD.decode_chunk_device
+
+        def spy(*a, **k):
+            out = orig(*a, **k)
+            calls.append(1)
+            return out
+
+        monkeypatch.setattr(PD, "decode_chunk_device", spy)
+        n = 3000
+        rng = np.random.default_rng(5)
+        t = pa.table({
+            "i64": pa.array(rng.integers(0, 30, n).astype(np.int64)),
+            "i32n": pa.array([int(x) if x % 5 else None for x in range(n)],
+                             type=pa.int32()),
+            "s": pa.array([f"w{i % 11}" for i in range(n)]),
+        })
+        for comp in ("NONE", "SNAPPY"):
+            path = str(tmp_path / f"v2_{comp}.parquet")
+            pq.write_table(t, path, compression=comp, use_dictionary=True,
+                           data_page_version="2.0")
+            calls.clear()
+            assert_tpu_and_cpu_are_equal_collect(
+                session, lambda s: s.read.parquet(path), ignore_order=True)
+            assert calls, comp
+
+    def test_unsupported_codec_falls_back_correctly(self, session, tmp_path):
+        # parquet LZ4's framing differs from Arrow's lz4 codec: stays on the
+        # host Arrow path, results still correct
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.io import parquet_device as PD
+
+        assert not PD.codec_supported("LZ4")
+        path = self._write(tmp_path, name="lz4.parquet", compression="LZ4")
         assert_tpu_and_cpu_are_equal_collect(
             session, lambda s: s.read.parquet(path), ignore_order=True)
 

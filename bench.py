@@ -158,6 +158,66 @@ def _worker_decode(mode: str) -> None:
                       "gbps": decoded_bytes / min(times) / 1e9}), flush=True)
 
 
+def _worker_i64(mode: str) -> None:
+    """int64 vs int32 physical columns for the flagship agg step: measures
+    XLA's 32-bit-pair int64 emulation cost on the accelerator (SQL LONG
+    semantics ride int64; if this ratio is large, range-aware physical
+    narrowing in columnar/batch.physical_np_dtype is the mitigation).
+    mode: 'i64' | 'i32'."""
+    dev = _init_backend(mode)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = 1 << 22
+    dt = np.int64 if mode == "i64" else np.int32
+    rng = np.random.default_rng(5)
+    keys = jnp.asarray(rng.integers(0, 1024, n).astype(dt))
+    vals = jnp.asarray(rng.integers(-10_000, 10_000, n).astype(dt))
+
+    @jax.jit
+    def step(k, v):
+        keep = (v % 3 != 0)
+        proj = jnp.where(keep, v * 2 + 1, 0)
+        seg = jnp.where(keep, k, 1024).astype(jnp.int32)
+        return jax.ops.segment_sum(proj, seg, num_segments=1025)
+
+    step(keys, vals).block_until_ready()
+    _log(f"worker[{mode}]: warm, timing")
+    times = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        step(keys, vals).block_until_ready()
+        times.append(time.perf_counter() - t0)
+        _log(f"worker[{mode}]: iter {i}: {times[-1] * 1e3:.2f}ms")
+    print(json.dumps({"mode": mode, "platform": dev.platform,
+                      "best_s": min(times),
+                      "gbps": n * np.dtype(dt).itemsize * 2
+                      / min(times) / 1e9}), flush=True)
+
+
+def main_i64() -> None:
+    """`python bench.py --i64`: int64-emulation cost microbench."""
+    w64, _p = _run_accel_phase("i64-i64", TPU_BUDGET_S // 2)
+    w32, _p = ((None, 0) if w64 is None else
+               _run_accel_phase("i64-i32", TPU_BUDGET_S // 2,
+                                skip_probe=True))
+    if w64 is None or w32 is None:
+        print(json.dumps({"metric": "int64_emulation_ratio", "value": 0.0,
+                          "unit": "x", "vs_baseline": 0.0,
+                          "error": "i64 bench failed", "diag": _DIAG[-4:]}))
+        return
+    print(json.dumps({
+        "metric": "int64_emulation_ratio",
+        "value": round(w64["best_s"] / w32["best_s"], 3),
+        "unit": "x (int64 time / int32 time)",
+        "vs_baseline": round(w32["gbps"] / max(w64["gbps"], 1e-9), 3),
+        "platform": w64["platform"],
+        "i64_gbps": round(w64["gbps"], 3),
+        "i32_gbps": round(w32["gbps"], 3),
+    }))
+
+
 def main_decode() -> None:
     """`python bench.py --decode`: device-decode vs host-decode scan."""
     host, _p = _run_accel_phase("decode-host", TPU_BUDGET_S)
@@ -411,6 +471,8 @@ if __name__ == "__main__":
                           float(os.environ.get("SRT_TPCH_SF", "0.01")))
         elif mode.startswith("decode-"):
             _worker_decode(mode.split("-", 1)[1])
+        elif mode.startswith("i64-"):
+            _worker_i64(mode.split("-", 1)[1])
         else:
             _worker(mode)
     elif len(sys.argv) >= 2 and sys.argv[1] in ("--tpch", "--tpcxbb",
@@ -419,5 +481,7 @@ if __name__ == "__main__":
                    float(sys.argv[2]) if len(sys.argv) >= 3 else 0.01)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--decode":
         main_decode()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--i64":
+        main_i64()
     else:
         main()

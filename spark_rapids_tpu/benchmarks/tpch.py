@@ -104,6 +104,9 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
         num_partitions=num_partitions)
 
     ord_lo, ord_hi = _days("1992-01-01"), _days("1998-08-02")
+    comment_pool = ["regular deposits", "special requests sleep",
+                    "quick packages", "express special handling requests",
+                    "ironic accounts nag"]
     orders = session.createDataFrame({
         "o_orderkey": np.arange(n_ord, dtype=np.int64),
         "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
@@ -113,13 +116,33 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
             [_PRIORITIES[i]
              for i in rng.integers(0, len(_PRIORITIES), n_ord)],
             dtype=object),
+        "o_orderstatus": np.array(
+            [["F", "O", "P"][i] for i in rng.integers(0, 3, n_ord)],
+            dtype=object),
+        "o_totalprice": (rng.random(n_ord) * 500_000).round(2),
+        "o_comment": np.array(
+            [comment_pool[i]
+             for i in rng.integers(0, len(comment_pool), n_ord)],
+            dtype=object),
     }, [("o_orderkey", "long"), ("o_custkey", "long"),
         ("o_orderdate", DataType.DATE), ("o_shippriority", "int"),
-        ("o_orderpriority", "string")],
+        ("o_orderpriority", "string"), ("o_orderstatus", "string"),
+        ("o_totalprice", "double"), ("o_comment", "string")],
         num_partitions=num_partitions)
 
+    colors = ["almond", "azure", "forest", "green", "lime", "navy",
+              "plum", "rose", "sienna", "tan"]
+    nouns = ["bead", "case", "dust", "ink", "mat", "pad", "tube", "wire"]
     part = session.createDataFrame({
         "p_partkey": np.arange(n_part, dtype=np.int64),
+        "p_name": np.array(
+            [f"{colors[a]} {nouns[b]}"
+             for a, b in zip(rng.integers(0, len(colors), n_part),
+                             rng.integers(0, len(nouns), n_part))],
+            dtype=object),
+        "p_mfgr": np.array(
+            [f"Manufacturer#{i}" for i in rng.integers(1, 6, n_part)],
+            dtype=object),
         "p_type": np.array(
             [_TYPES[i] for i in rng.integers(0, len(_TYPES), n_part)],
             dtype=object),
@@ -131,23 +154,63 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
              for i in rng.integers(0, len(_CONTAINERS), n_part)],
             dtype=object),
         "p_size": rng.integers(1, 51, n_part).astype(np.int32),
-    }, [("p_partkey", "long"), ("p_type", "string"), ("p_brand", "string"),
+    }, [("p_partkey", "long"), ("p_name", "string"), ("p_mfgr", "string"),
+        ("p_type", "string"), ("p_brand", "string"),
         ("p_container", "string"), ("p_size", "int")],
         num_partitions=max(1, num_partitions // 2))
 
+    # 4 suppliers per part (TPC-H spec shape: |partsupp| = 4 * |part|)
+    n_ps = 4 * n_part
+    partsupp = session.createDataFrame({
+        "ps_partkey": np.repeat(np.arange(n_part, dtype=np.int64), 4),
+        "ps_suppkey": rng.integers(0, n_supp, n_ps).astype(np.int64),
+        "ps_availqty": rng.integers(1, 10_000, n_ps).astype(np.int32),
+        "ps_supplycost": (rng.random(n_ps) * 1000).round(2),
+    }, [("ps_partkey", "long"), ("ps_suppkey", "long"),
+        ("ps_availqty", "int"), ("ps_supplycost", "double")],
+        num_partitions=num_partitions)
+
+    phone_codes = ["13", "17", "18", "23", "29", "30", "31", "32", "33"]
     customer = session.createDataFrame({
         "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_name": np.array(
+            [f"Customer#{i:09d}" for i in range(n_cust)], dtype=object),
         "c_mktsegment": np.array(
             [_SEGMENTS[i] for i in rng.integers(0, len(_SEGMENTS), n_cust)],
             dtype=object),
         "c_nationkey": rng.integers(0, n_nation, n_cust).astype(np.int64),
-    }, [("c_custkey", "long"), ("c_mktsegment", "string"),
-        ("c_nationkey", "long")], num_partitions=num_partitions)
+        "c_acctbal": (rng.random(n_cust) * 11_000 - 1_000).round(2),
+        "c_phone": np.array(
+            [f"{phone_codes[i]}-{j:03d}-{k:03d}-{m:04d}"
+             for i, j, k, m in zip(
+                 rng.integers(0, len(phone_codes), n_cust),
+                 rng.integers(100, 1000, n_cust),
+                 rng.integers(100, 1000, n_cust),
+                 rng.integers(1000, 10_000, n_cust))],
+            dtype=object),
+    }, [("c_custkey", "long"), ("c_name", "string"),
+        ("c_mktsegment", "string"), ("c_nationkey", "long"),
+        ("c_acctbal", "double"), ("c_phone", "string")],
+        num_partitions=num_partitions)
 
+    s_comment_pool = ["blithely final accounts", "Customer insults",
+                      "Customer kindly Complaints about", "quiet waters",
+                      "furious Customer Complaints heard"]
     supplier = session.createDataFrame({
         "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_name": np.array(
+            [f"Supplier#{i:09d}" for i in range(n_supp)], dtype=object),
+        "s_address": np.array(
+            [f"addr {i % 97}" for i in range(n_supp)], dtype=object),
         "s_nationkey": rng.integers(0, n_nation, n_supp).astype(np.int64),
-    }, [("s_suppkey", "long"), ("s_nationkey", "long")],
+        "s_acctbal": (rng.random(n_supp) * 11_000 - 1_000).round(2),
+        "s_comment": np.array(
+            [s_comment_pool[i]
+             for i in rng.integers(0, len(s_comment_pool), n_supp)],
+            dtype=object),
+    }, [("s_suppkey", "long"), ("s_name", "string"),
+        ("s_address", "string"), ("s_nationkey", "long"),
+        ("s_acctbal", "double"), ("s_comment", "string")],
         num_partitions=max(1, num_partitions // 2))
 
     nation = session.createDataFrame({
@@ -165,7 +228,7 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
 
     return {"lineitem": lineitem, "orders": orders, "customer": customer,
             "supplier": supplier, "nation": nation, "region": region,
-            "part": part}
+            "part": part, "partsupp": partsupp}
 
 
 # ---------------------------------------------------------------------------
@@ -345,7 +408,347 @@ def q19(t) -> "object":
             .agg(F.sum("revenue").alias("revenue")))
 
 
+def q2(t) -> "object":
+    """Minimum cost supplier (correlated min-subquery -> agg + join-back;
+    reference: Q2Like, TpchLikeSpark.scala)."""
+    p, ps, s = t["part"], t["partsupp"], t["supplier"]
+    n, r = t["nation"], t["region"]
+    europe = (r.filter(r["r_name"] == F.lit("EUROPE"))
+              .join(n, on=(r["r_regionkey"] == n["n_regionkey"]),
+                    how="inner")
+              .join(s, on=(F.col("n_nationkey") == s["s_nationkey"]),
+                    how="inner")
+              .join(ps, on=(F.col("s_suppkey") == ps["ps_suppkey"]),
+                    how="inner"))
+    # p_size <= 15 (not == 15) keeps the join non-degenerate at SF-tiny
+    brass = p.filter((p["p_size"] <= F.lit(15))
+                     & p["p_type"].endswith("BRASS"))
+    joined = brass.join(europe,
+                        on=(brass["p_partkey"] == F.col("ps_partkey")),
+                        how="inner")
+    min_cost = (joined.groupBy("p_partkey")
+                .agg(F.min("ps_supplycost").alias("min_cost"))
+                .select(F.col("p_partkey").alias("mc_partkey"),
+                        F.col("min_cost")))
+    return (joined.join(
+        min_cost,
+        on=((F.col("p_partkey") == F.col("mc_partkey"))
+            & (F.col("ps_supplycost") == F.col("min_cost"))), how="inner")
+        .select("s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr")
+        .orderBy(F.col("s_acctbal").desc(), F.col("n_name"),
+                 F.col("s_name"), F.col("p_partkey"))
+        .limit(100))
+
+
+def q7(t) -> "object":
+    """Volume shipping between two nations (6-way join + year extract;
+    reference: Q7Like)."""
+    li, o, c, s, n = (t["lineitem"], t["orders"], t["customer"],
+                      t["supplier"], t["nation"])
+    n1 = n.select(F.col("n_nationkey").alias("sn_key"),
+                  F.col("n_name").alias("supp_nation"))
+    n2 = n.select(F.col("n_nationkey").alias("cn_key"),
+                  F.col("n_name").alias("cust_nation"))
+    a, b = "NATION_1", "NATION_2"
+    pair = (((F.col("supp_nation") == F.lit(a))
+             & (F.col("cust_nation") == F.lit(b)))
+            | ((F.col("supp_nation") == F.lit(b))
+               & (F.col("cust_nation") == F.lit(a))))
+    return (s.join(n1, on=(s["s_nationkey"] == F.col("sn_key")),
+                   how="inner")
+            .join(li.filter((li["l_shipdate"] >= date_lit("1995-01-01"))
+                            & (li["l_shipdate"] <= date_lit("1996-12-31"))),
+                  on=(F.col("s_suppkey") == li["l_suppkey"]), how="inner")
+            .join(o, on=(F.col("l_orderkey") == o["o_orderkey"]),
+                  how="inner")
+            .join(c, on=(F.col("o_custkey") == c["c_custkey"]), how="inner")
+            .join(n2, on=(F.col("c_nationkey") == F.col("cn_key")),
+                  how="inner")
+            .filter(pair)
+            .withColumn("l_year", F.year(F.col("l_shipdate")))
+            .withColumn("volume",
+                        F.col("l_extendedprice")
+                        * (F.lit(1.0) - F.col("l_discount")))
+            .groupBy("supp_nation", "cust_nation", "l_year")
+            .agg(F.sum("volume").alias("revenue"))
+            .orderBy("supp_nation", "cust_nation", "l_year"))
+
+
+def q8(t) -> "object":
+    """National market share (7-way join + conditional share ratio;
+    reference: Q8Like)."""
+    li, o, c, s, p = (t["lineitem"], t["orders"], t["customer"],
+                      t["supplier"], t["part"])
+    n, r = t["nation"], t["region"]
+    n1 = n.select(F.col("n_nationkey").alias("cn_key"),
+                  F.col("n_regionkey").alias("cn_region"))
+    n2 = n.select(F.col("n_nationkey").alias("sn_key"),
+                  F.col("n_name").alias("nation"))
+    return (p.filter(p["p_type"] == F.lit("ECONOMY ANODIZED STEEL"))
+            .join(li, on=(p["p_partkey"] == li["l_partkey"]), how="inner")
+            .join(t["supplier"],
+                  on=(F.col("l_suppkey") == s["s_suppkey"]), how="inner")
+            .join(o.filter((o["o_orderdate"] >= date_lit("1995-01-01"))
+                           & (o["o_orderdate"] <= date_lit("1996-12-31"))),
+                  on=(F.col("l_orderkey") == o["o_orderkey"]), how="inner")
+            .join(c, on=(F.col("o_custkey") == c["c_custkey"]), how="inner")
+            .join(n1, on=(F.col("c_nationkey") == F.col("cn_key")),
+                  how="inner")
+            .join(r.filter(r["r_name"] == F.lit("AMERICA")),
+                  on=(F.col("cn_region") == r["r_regionkey"]), how="inner")
+            .join(n2, on=(F.col("s_nationkey") == F.col("sn_key")),
+                  how="inner")
+            .withColumn("o_year", F.year(F.col("o_orderdate")))
+            .withColumn("volume",
+                        F.col("l_extendedprice")
+                        * (F.lit(1.0) - F.col("l_discount")))
+            .withColumn("nat_volume",
+                        F.when(F.col("nation") == F.lit("NATION_3"),
+                               F.col("volume")).otherwise(F.lit(0.0)))
+            .groupBy("o_year")
+            .agg(F.sum("nat_volume").alias("nat_rev"),
+                 F.sum("volume").alias("total_rev"))
+            .withColumn("mkt_share", F.col("nat_rev") / F.col("total_rev"))
+            .select("o_year", "mkt_share")
+            .orderBy("o_year"))
+
+
+def q9(t) -> "object":
+    """Product type profit measure (6-way join incl. 2-key partsupp join;
+    reference: Q9Like)."""
+    li, o, s, p, ps, n = (t["lineitem"], t["orders"], t["supplier"],
+                          t["part"], t["partsupp"], t["nation"])
+    return (p.filter(p["p_name"].contains("green"))
+            .join(li, on=(p["p_partkey"] == li["l_partkey"]), how="inner")
+            .join(s, on=(F.col("l_suppkey") == s["s_suppkey"]), how="inner")
+            .join(ps, on=((F.col("l_suppkey") == ps["ps_suppkey"])
+                          & (F.col("l_partkey") == ps["ps_partkey"])),
+                  how="inner")
+            .join(o, on=(F.col("l_orderkey") == o["o_orderkey"]),
+                  how="inner")
+            .join(n, on=(F.col("s_nationkey") == n["n_nationkey"]),
+                  how="inner")
+            .withColumn("o_year", F.year(F.col("o_orderdate")))
+            .withColumn("amount",
+                        F.col("l_extendedprice")
+                        * (F.lit(1.0) - F.col("l_discount"))
+                        - F.col("ps_supplycost") * F.col("l_quantity"))
+            .groupBy("n_name", "o_year")
+            .agg(F.sum("amount").alias("sum_profit"))
+            .orderBy(F.col("n_name"), F.col("o_year").desc()))
+
+
+def q11(t) -> "object":
+    """Important stock identification (agg vs global-threshold scalar via
+    cross join; reference: Q11Like)."""
+    ps, s, n = t["partsupp"], t["supplier"], t["nation"]
+    base = (ps.join(s, on=(ps["ps_suppkey"] == s["s_suppkey"]), how="inner")
+            .join(n.filter(n["n_name"] == F.lit("NATION_7")),
+                  on=(F.col("s_nationkey") == n["n_nationkey"]),
+                  how="inner")
+            .withColumn("value",
+                        F.col("ps_supplycost") * F.col("ps_availqty")))
+    grouped = base.groupBy("ps_partkey").agg(F.sum("value").alias("pvalue"))
+    threshold = base.agg(
+        (F.sum("value") * F.lit(0.0001)).alias("threshold"))
+    return (grouped.crossJoin(threshold)
+            .filter(F.col("pvalue") > F.col("threshold"))
+            .select("ps_partkey", "pvalue")
+            .orderBy(F.col("pvalue").desc()))
+
+
+def q13(t) -> "object":
+    """Customer order-count distribution (outer join + double agg;
+    reference: Q13Like). The %special%requests% LIKE is expressed as two
+    contains (the device LIKE subset excludes multi-%% patterns,
+    columnar/strings.py:classify_like)."""
+    c, o = t["customer"], t["orders"]
+    o_f = o.filter(~(o["o_comment"].contains("special")
+                     & o["o_comment"].contains("requests")))
+    return (c.join(o_f, on=(c["c_custkey"] == o_f["o_custkey"]),
+                   how="left")
+            .groupBy("c_custkey")
+            .agg(F.count("o_orderkey").alias("c_count"))
+            .groupBy("c_count")
+            .agg(F.count("*").alias("custdist"))
+            .orderBy(F.col("custdist").desc(), F.col("c_count").desc()))
+
+
+def q15(t) -> "object":
+    """Top supplier (agg view + global max via cross join;
+    reference: Q15Like)."""
+    li, s = t["lineitem"], t["supplier"]
+    revenue = (li.filter((li["l_shipdate"] >= date_lit("1996-01-01"))
+                         & (li["l_shipdate"] < date_lit("1996-04-01")))
+               .withColumn("rev",
+                           F.col("l_extendedprice")
+                           * (F.lit(1.0) - F.col("l_discount")))
+               .groupBy("l_suppkey")
+               .agg(F.sum("rev").alias("total_revenue")))
+    max_rev = revenue.agg(F.max("total_revenue").alias("max_revenue"))
+    return (s.join(revenue, on=(s["s_suppkey"] == F.col("l_suppkey")),
+                   how="inner")
+            .crossJoin(max_rev)
+            .filter(F.col("total_revenue") == F.col("max_revenue"))
+            .select("s_suppkey", "s_name", "total_revenue")
+            .orderBy("s_suppkey"))
+
+
+def q16(t) -> "object":
+    """Parts/supplier relationship (anti join + count-distinct rewritten as
+    two-level group-by; reference: Q16Like uses countDistinct)."""
+    ps, p, s = t["partsupp"], t["part"], t["supplier"]
+    excl = s.filter(s["s_comment"].contains("Customer")
+                    & s["s_comment"].contains("Complaints")) \
+        .select(F.col("s_suppkey").alias("bad_supp"))
+    return (ps.join(p, on=(ps["ps_partkey"] == p["p_partkey"]),
+                    how="inner")
+            .filter((F.col("p_brand") != F.lit("Brand#45"))
+                    & ~F.col("p_type").startswith("MEDIUM POLISHED")
+                    & F.col("p_size").isin(3, 9, 14, 19, 23, 36, 45, 49))
+            .join(excl, on=(F.col("ps_suppkey") == F.col("bad_supp")),
+                  how="left_anti")
+            .groupBy("p_brand", "p_type", "p_size", "ps_suppkey")
+            .agg(F.count("*").alias("_dup"))
+            .groupBy("p_brand", "p_type", "p_size")
+            .agg(F.count("*").alias("supplier_cnt"))
+            .orderBy(F.col("supplier_cnt").desc(), F.col("p_brand"),
+                     F.col("p_type"), F.col("p_size")))
+
+
+def q17(t) -> "object":
+    """Small-quantity-order revenue (correlated avg-subquery -> per-part agg
+    + join-back; reference: Q17Like)."""
+    li, p = t["lineitem"], t["part"]
+    fil = p.filter((p["p_brand"] == F.lit("Brand#23"))
+                   & (p["p_container"] == F.lit("MED BOX")))
+    j = li.join(fil, on=(li["l_partkey"] == fil["p_partkey"]), how="inner")
+    avg_qty = (j.groupBy("l_partkey")
+               .agg((F.avg("l_quantity") * F.lit(0.2)).alias("avg_fifth"))
+               .select(F.col("l_partkey").alias("ak"), F.col("avg_fifth")))
+    return (j.join(avg_qty, on=(F.col("l_partkey") == F.col("ak")),
+                   how="inner")
+            .filter(F.col("l_quantity") < F.col("avg_fifth"))
+            .agg((F.sum("l_extendedprice") / F.lit(7.0))
+                 .alias("avg_yearly")))
+
+
+def q18(t) -> "object":
+    """Large volume customer (having-subquery -> agg + semi join;
+    reference: Q18Like)."""
+    c, o, li = t["customer"], t["orders"], t["lineitem"]
+    big = (li.groupBy("l_orderkey")
+           .agg(F.sum("l_quantity").alias("big_qty"))
+           .filter(F.col("big_qty") > F.lit(300.0))
+           .select(F.col("l_orderkey").alias("bk")))
+    return (c.join(o, on=(c["c_custkey"] == o["o_custkey"]), how="inner")
+            .join(big, on=(F.col("o_orderkey") == F.col("bk")),
+                  how="left_semi")
+            .join(li, on=(F.col("o_orderkey") == li["l_orderkey"]),
+                  how="inner")
+            .groupBy("c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                     "o_totalprice")
+            .agg(F.sum("l_quantity").alias("sum_qty"))
+            .orderBy(F.col("o_totalprice").desc(), F.col("o_orderdate"))
+            .limit(100))
+
+
+def q20(t) -> "object":
+    """Potential part promotion (nested subqueries -> semi joins + per-key
+    agg threshold; reference: Q20Like)."""
+    li, p, ps, s, n = (t["lineitem"], t["part"], t["partsupp"],
+                       t["supplier"], t["nation"])
+    forest = p.filter(p["p_name"].startswith("forest")) \
+        .select(F.col("p_partkey").alias("fp"))
+    half_qty = (li.filter((li["l_shipdate"] >= date_lit("1994-01-01"))
+                          & (li["l_shipdate"] < date_lit("1995-01-01")))
+                .groupBy("l_partkey", "l_suppkey")
+                .agg((F.sum("l_quantity") * F.lit(0.5)).alias("half_qty"))
+                .select(F.col("l_partkey").alias("hp"),
+                        F.col("l_suppkey").alias("hs"),
+                        F.col("half_qty")))
+    eligible_ps = (ps.join(forest, on=(ps["ps_partkey"] == F.col("fp")),
+                           how="left_semi")
+                   .join(half_qty,
+                         on=((F.col("ps_partkey") == F.col("hp"))
+                             & (F.col("ps_suppkey") == F.col("hs"))),
+                         how="inner")
+                   .filter(F.col("ps_availqty") > F.col("half_qty"))
+                   .select(F.col("ps_suppkey").alias("ok_supp")))
+    return (s.join(eligible_ps, on=(s["s_suppkey"] == F.col("ok_supp")),
+                   how="left_semi")
+            .join(n.filter(n["n_name"] == F.lit("NATION_4")),
+                  on=(F.col("s_nationkey") == n["n_nationkey"]),
+                  how="inner")
+            .select("s_name", "s_address")
+            .orderBy("s_name"))
+
+
+def q21(t) -> "object":
+    """Suppliers who kept orders waiting (reference: Q21Like). The
+    EXISTS / NOT EXISTS subqueries carry a supplier-inequality, which
+    equi-joins cannot host (the reference likewise keeps conditioned
+    semi/anti joins off the accelerator, GpuHashJoin.scala:28-42);
+    decomposed with per-order min/max supplier aggregates:
+    'another supplier shipped this order' <=> min|max supplier != mine,
+    'no other supplier was late'          <=> all late lines are mine."""
+    li, o, s, n = t["lineitem"], t["orders"], t["supplier"], t["nation"]
+    l1 = li.filter(li["l_receiptdate"] > li["l_commitdate"])
+    any_supp = (li.groupBy("l_orderkey")
+                .agg(F.min("l_suppkey").alias("mn2"),
+                     F.max("l_suppkey").alias("mx2"))
+                .select(F.col("l_orderkey").alias("k2"),
+                        F.col("mn2"), F.col("mx2")))
+    late_supp = (l1.groupBy("l_orderkey")
+                 .agg(F.min("l_suppkey").alias("mn3"),
+                      F.max("l_suppkey").alias("mx3"))
+                 .select(F.col("l_orderkey").alias("k3"),
+                         F.col("mn3"), F.col("mx3")))
+    return (l1.join(o.filter(o["o_orderstatus"] == F.lit("F")),
+                    on=(l1["l_orderkey"] == o["o_orderkey"]), how="inner")
+            .join(s, on=(F.col("l_suppkey") == s["s_suppkey"]), how="inner")
+            .join(n.filter(n["n_name"] == F.lit("NATION_5")),
+                  on=(F.col("s_nationkey") == n["n_nationkey"]),
+                  how="inner")
+            # another supplier also shipped lines of this order …
+            .join(any_supp, on=(F.col("l_orderkey") == F.col("k2")),
+                  how="inner")
+            .filter((F.col("mn2") != F.col("l_suppkey"))
+                    | (F.col("mx2") != F.col("l_suppkey")))
+            # … but every LATE line of the order is mine
+            .join(late_supp, on=(F.col("l_orderkey") == F.col("k3")),
+                  how="inner")
+            .filter((F.col("mn3") == F.col("l_suppkey"))
+                    & (F.col("mx3") == F.col("l_suppkey")))
+            .groupBy("s_name")
+            .agg(F.count("*").alias("numwait"))
+            .orderBy(F.col("numwait").desc(), F.col("s_name"))
+            .limit(100))
+
+
+def q22(t) -> "object":
+    """Global sales opportunity (substring + scalar avg + anti join;
+    reference: Q22Like)."""
+    c, o = t["customer"], t["orders"]
+    cust = (c.withColumn("cntrycode",
+                         F.substring(F.col("c_phone"), 1, 2))
+            .filter(F.col("cntrycode").isin(
+                "13", "31", "23", "29", "30", "18", "17")))
+    avg_bal = cust.filter(F.col("c_acctbal") > F.lit(0.0)) \
+        .agg(F.avg("c_acctbal").alias("avg_bal"))
+    return (cust.crossJoin(avg_bal)
+            .filter(F.col("c_acctbal") > F.col("avg_bal"))
+            .join(o, on=(F.col("c_custkey") == o["o_custkey"]),
+                  how="left_anti")
+            .groupBy("cntrycode")
+            .agg(F.count("*").alias("numcust"),
+                 F.sum("c_acctbal").alias("totacctbal"))
+            .orderBy("cntrycode"))
+
+
 QUERIES: Dict[str, Callable] = {
-    "q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-    "q10": q10, "q12": q12, "q14": q14, "q19": q19,
+    "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
+    "q7": q7, "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12,
+    "q13": q13, "q14": q14, "q15": q15, "q16": q16, "q17": q17,
+    "q18": q18, "q19": q19, "q20": q20, "q21": q21, "q22": q22,
 }

@@ -92,8 +92,23 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
         ("wcs_item_sk", "long")],
         num_partitions=num_partitions)
 
+    n_web = max(64, int(1_440_000 * sf))
+    web_ts = rng.integers(t_lo, t_hi, n_web).astype(np.int64) * 1_000_000
+    ws_paid_c = rng.integers(100, 1_000_00, n_web)
+    web_sales = session.createDataFrame({
+        "ws_sold_ts": web_ts,
+        "ws_item_sk": rng.integers(0, n_item, n_web).astype(np.int64),
+        "ws_bill_customer_sk":
+            rng.integers(0, n_cust, n_web).astype(np.int64),
+        "ws_quantity": rng.integers(1, 12, n_web).astype(np.int32),
+        "ws_net_paid": [Decimal(int(c)).scaleb(-2) for c in ws_paid_c],
+    }, [("ws_sold_ts", DataType.TIMESTAMP), ("ws_item_sk", "long"),
+        ("ws_bill_customer_sk", "long"), ("ws_quantity", "int"),
+        ("ws_net_paid", "decimal(9,2)")],
+        num_partitions=num_partitions)
+
     return {"store_sales": store_sales, "item": item,
-            "web_clickstreams": web_clickstreams}
+            "web_clickstreams": web_clickstreams, "web_sales": web_sales}
 
 
 # ---------------------------------------------------------------------------
@@ -171,6 +186,117 @@ def q09_like(t) -> "object":
             .limit(50))
 
 
+def q01_like(t) -> "object":
+    """Frequently-sold items per store (TPCx-BB q1-ish basket shape):
+    per-(store, item) sales counts, kept above a support threshold, top by
+    count — groupBy + having + sort + limit over the fact table."""
+    ss = t["store_sales"]
+    return (ss.groupBy("ss_store_sk", "ss_item_sk")
+            .agg(F.count("*").alias("cnt"),
+                 F.sum("ss_quantity").alias("qty"))
+            .filter(F.col("cnt") >= F.lit(2))
+            .orderBy(F.col("cnt").desc(), F.col("ss_store_sk"),
+                     F.col("ss_item_sk"))
+            .limit(100))
+
+
+def q06_like(t) -> "object":
+    """Customers whose web spending grew half-over-half (TPCx-BB q6-ish):
+    conditional DECIMAL sums per customer around a pivot, ratio filter —
+    decimal arithmetic + division + sort."""
+    ws = t["web_sales"]
+    pivot = ts_lit("2003-07-01T00:00:00")
+    first_h = F.when(F.col("ws_sold_ts") < pivot,
+                     F.col("ws_net_paid")).otherwise(
+        Column(Literal(Decimal(0), DecimalType(9, 2))))
+    second_h = F.when(F.col("ws_sold_ts") >= pivot,
+                      F.col("ws_net_paid")).otherwise(
+        Column(Literal(Decimal(0), DecimalType(9, 2))))
+    return (ws.withColumn("h1", first_h)
+            .withColumn("h2", second_h)
+            .groupBy("ws_bill_customer_sk")
+            .agg(F.sum("h1").alias("h1_paid"),
+                 F.sum("h2").alias("h2_paid"))
+            .filter((F.col("h1_paid") > Column(Literal(Decimal("1"),
+                                                       DecimalType(9, 2))))
+                    & (F.col("h2_paid") > F.col("h1_paid")))
+            .withColumn("growth",
+                        F.col("h2_paid").cast("double")
+                        / F.col("h1_paid").cast("double"))
+            .orderBy(F.col("growth").desc(),
+                     F.col("ws_bill_customer_sk"))
+            .limit(100))
+
+
+def q07_like(t) -> "object":
+    """Stores selling items priced above 1.2x their category average
+    (TPCx-BB q7-ish): category-average subaggregate joined back, price
+    predicate, per-store counts."""
+    ss, it = t["store_sales"], t["item"]
+    cat_avg = (it.groupBy("i_category")
+               .agg(F.avg(F.col("i_current_price").cast("double"))
+                    .alias("cat_avg"))
+               .select(F.col("i_category").alias("ac"), F.col("cat_avg")))
+    pricey = (it.join(cat_avg, on=(it["i_category"] == F.col("ac")),
+                      how="inner")
+              .filter(F.col("i_current_price").cast("double")
+                      > F.lit(1.2) * F.col("cat_avg"))
+              .select(F.col("i_item_sk").alias("pricey_sk")))
+    return (ss.join(pricey, on=(ss["ss_item_sk"] == F.col("pricey_sk")),
+                    how="left_semi")
+            .groupBy("ss_store_sk")
+            .agg(F.count("*").alias("n_pricey"))
+            .filter(F.col("n_pricey") >= F.lit(2))
+            .orderBy(F.col("n_pricey").desc(), F.col("ss_store_sk"))
+            .limit(50))
+
+
+def q12_like(t) -> "object":
+    """Click-then-buy conversion within 30 days (TPCx-BB q12-ish):
+    clickstream joined to sales on (user, item) with a timestamp-window
+    condition — multi-key join + timestamp arithmetic."""
+    wcs, ss = t["web_clickstreams"], t["store_sales"]
+    day_us = 86_400 * 1_000_000
+    return (wcs.join(
+        ss,
+        on=((wcs["wcs_user_sk"] == ss["ss_customer_sk"])
+            & (wcs["wcs_item_sk"] == ss["ss_item_sk"])),
+        how="inner")
+        .filter((F.col("ss_sold_ts").cast("long")
+                 > F.col("wcs_click_ts").cast("long"))
+                & (F.col("ss_sold_ts").cast("long")
+                   - F.col("wcs_click_ts").cast("long")
+                   < F.lit(30 * day_us)))
+        .groupBy("wcs_item_sk")
+        .agg(F.count("*").alias("conversions"))
+        .orderBy(F.col("conversions").desc(), F.col("wcs_item_sk"))
+        .limit(100))
+
+
+def q15_like(t) -> "object":
+    """Per-store monthly profit trend (TPCx-BB q15-ish): timestamp ->
+    date -> month grouping, window lag for month-over-month delta, count
+    of declining months per store."""
+    ss = t["store_sales"]
+    w = Window.partitionBy("ss_store_sk").orderBy("month")
+    monthly = (ss.withColumn("sold_date",
+                             F.col("ss_sold_ts").cast("date"))
+               .withColumn("month", F.month(F.col("sold_date")))
+               .groupBy("ss_store_sk", "month")
+               .agg(F.sum("ss_net_profit").alias("profit")))
+    return (monthly
+            .withColumn("prev_profit", F.lag(F.col("profit"), 1).over(w))
+            .withColumn("declined",
+                        F.when(F.col("profit") < F.col("prev_profit"),
+                               F.lit(1)).otherwise(F.lit(0)))
+            .groupBy("ss_store_sk")
+            .agg(F.sum("declined").alias("down_months"),
+                 F.count("*").alias("months"))
+            .orderBy(F.col("down_months").desc(), F.col("ss_store_sk")))
+
+
 QUERIES: Dict[str, Callable] = {
-    "q05_like": q05_like, "q09_like": q09_like, "q16_like": q16_like,
+    "q01_like": q01_like, "q05_like": q05_like, "q06_like": q06_like,
+    "q07_like": q07_like, "q09_like": q09_like, "q12_like": q12_like,
+    "q15_like": q15_like, "q16_like": q16_like,
 }

@@ -539,6 +539,43 @@ def repad_column(cv: ColumnVector, new_cap: int) -> ColumnVector:
     )
 
 
+def batch_to_device(b: "ColumnarBatch", dev) -> "ColumnarBatch":
+    """Move a batch's arrays onto one device."""
+    cols = [ColumnVector(c.dtype, jax.device_put(c.data, dev),
+                         jax.device_put(c.validity, dev),
+                         None if c.offsets is None
+                         else jax.device_put(c.offsets, dev))
+            for c in b.columns]
+    live = None if b.live is None else jax.device_put(b.live, dev)
+    num = b.num_rows
+    if hasattr(num, "devices"):
+        num = jax.device_put(num, dev)
+    return ColumnarBatch(cols, num, live=live)
+
+
+def _same_device(batches: Sequence["ColumnarBatch"]):
+    """Bring batches committed to different chips onto one device before a
+    fused concat (exchange outputs chained by adaptive partition coalescing
+    live on the chip that received them — the reference's cross-device
+    concat goes through cudf the same way)."""
+    def dev_of(b):
+        if not b.columns:
+            return None  # zero-column batches carry no device arrays
+        devs = getattr(b.columns[0].data, "devices", None)
+        if devs is None:
+            return None
+        ds = devs() if callable(devs) else devs
+        return next(iter(ds)) if len(ds) == 1 else None
+
+    devs = [dev_of(b) for b in batches]
+    uniq = {d for d in devs if d is not None}
+    if len(uniq) <= 1:
+        return list(batches)
+    target = devs[0] or next(iter(uniq))
+    return [b if d is target else batch_to_device(b, target)
+            for b, d in zip(batches, devs)]
+
+
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Concatenate batches with the same schema (reference: cudf
     Table.concatenate used by GpuCoalesceBatches.scala:38-63). The whole
@@ -548,6 +585,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     assert batches, "cannot concat zero batches"
     if len(batches) == 1:
         return ensure_compact(batches[0])
+    batches = _same_device(batches)
     has_string = any(c.dtype is DataType.STRING for c in batches[0].columns)
     if has_string:
         # string concat is host-coordinated (byte totals); force host counts

@@ -296,6 +296,16 @@ ORC_DEVICE_DECODE = _conf(
     "non-integer columns fall back to the host Arrow reader."
 ).boolean(True)
 ORC_WRITE_ENABLED = _conf("rapids.tpu.sql.format.orc.write.enabled").boolean(True)
+ORC_DEVICE_ENCODE = _conf(
+    "rapids.tpu.sql.format.orc.deviceEncode.enabled").doc(
+    "Encode ORC ON the device (reference encodes on the accelerator, "
+    "GpuOrcFileFormat.scala / ColumnarOutputWriter.scala:62-177): "
+    "non-null values compact, zigzag-encode and bit-pack into the RLEv2 "
+    "DIRECT payload in jitted kernels per column, and only the encoded "
+    "stream payload downloads. Applies to flat int/date schemas written "
+    "uncompressed without partitionBy; everything else uses the host "
+    "Arrow writer."
+).boolean(True)
 
 ENABLE_FLOAT_AGG = _conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(
     "Allow float aggregations whose result can vary with evaluation order "
@@ -309,6 +319,14 @@ ENABLE_CAST_STRING_TO_TIMESTAMP = _conf("rapids.tpu.sql.castStringToTimestamp.en
 IMPROVED_TIME_OPS = _conf("rapids.tpu.sql.improvedTimeOps.enabled").doc(
     "Enable datetime ops whose range/overflow behavior differs slightly from CPU "
     "(reference: spark.rapids.sql.improvedTimeOps.enabled, RapidsConf.scala:342)."
+).boolean(False)
+
+HASH_OPTIMIZE_SORT = _conf("rapids.tpu.sql.hashOptimizeSort.enabled").doc(
+    "Insert a sort after hash-based operators (aggregate, shuffled join) "
+    "whose output feeds a file write, so rows with equal keys cluster and "
+    "the written files compress/size better (reference: "
+    "spark.rapids.sql.hashOptimizeSort.enabled, "
+    "GpuTransitionOverrides.scala:171-204)."
 ).boolean(False)
 
 REPLACE_SORT_MERGE_JOIN = _conf("rapids.tpu.sql.replaceSortMergeJoin.enabled").doc(

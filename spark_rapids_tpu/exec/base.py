@@ -93,6 +93,12 @@ class PhysicalExec:
     def coalesce_after(self) -> bool:
         return False
 
+    def node_expressions(self) -> List:
+        """This node's own expression trees (for plan passes that scan for
+        expression properties, e.g. input-file coalesce poisoning —
+        reference: GpuTransitionOverrides.scala:64-147)."""
+        return []
+
     @property
     def children_coalesce_goal(self) -> List[Optional[object]]:
         return [None] * len(self.children)

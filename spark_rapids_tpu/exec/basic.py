@@ -127,6 +127,9 @@ class TpuProjectExec(TpuExec):
     def output(self):
         return [to_attribute(e) for e in self.project_list]
 
+    def node_expressions(self):
+        return list(self.project_list)
+
     def with_children(self, new_children):
         return TpuProjectExec(self.project_list, new_children[0])
 
@@ -153,6 +156,9 @@ class CpuProjectExec(CpuExec):
         super().__init__(child)
         self.project_list = list(project_list)
         self._bound = bind_all(self.project_list, child.output)
+
+    def node_expressions(self):
+        return list(self.project_list)
 
     @property
     def output(self):
@@ -192,6 +198,9 @@ class TpuFilterExec(TpuExec):
     def output(self):
         return self.children[0].output
 
+    def node_expressions(self):
+        return [self.condition]
+
     def with_children(self, new_children):
         return TpuFilterExec(self.condition, new_children[0])
 
@@ -221,6 +230,9 @@ class CpuFilterExec(CpuExec):
     @property
     def output(self):
         return self.children[0].output
+
+    def node_expressions(self):
+        return [self.condition]
 
     def with_children(self, new_children):
         return CpuFilterExec(self.condition, new_children[0])

@@ -229,12 +229,38 @@ class TpuWindowExec(_WindowBase, TpuExec):
                 peer_end = _gathered_segment(jax.ops.segment_max,
                                              jnp.where(live_s, pos, -1),
                                              qgid, cap)
+                peer_start = _gathered_segment(jax.ops.segment_min,
+                                               jnp.where(live_s, pos, cap),
+                                               qgid, cap)
+
+                # single numeric ORDER BY column -> sorted-domain key for
+                # bounded RANGE frames (reference:
+                # GpuWindowExpression.scala:457-683 boundary checks)
+                range_ord = None
+                if len(order_results) == 1:
+                    oc, o = order_results[0]
+                    # integer-kind keys only: float bound arithmetic rounds
+                    # differently from the oracle (gated in overrides too)
+                    if oc.dtype in (DataType.INT8, DataType.INT16,
+                                    DataType.INT32, DataType.INT64,
+                                    DataType.DATE, DataType.TIMESTAMP):
+                        kd = oc.data[perm].astype(jnp.int64)
+                        key_s = kd if o.ascending else -kd
+                        kvalid = oc.validity[perm] & live_s
+                        nn_start = _gathered_segment(
+                            jax.ops.segment_min,
+                            jnp.where(kvalid, pos, cap), pgid, cap)
+                        nn_end = _gathered_segment(
+                            jax.ops.segment_max,
+                            jnp.where(kvalid, pos, -1), pgid, cap)
+                        range_ord = (key_s, kvalid, nn_start, nn_end)
 
                 outs = []
                 for w, in_cv in zip(wexprs, in_cols):
                     res = _eval_window_fn(
                         w, in_cv, perm, live_s, pos, pgid, qgid, start, end,
-                        peer_end, peer_change, cap)
+                        peer_end, peer_change, cap,
+                        peer_start=peer_start, range_ord=range_ord)
                     outs.append(res)
 
                 # ---- scatter back to input row order ----------------------
@@ -279,7 +305,8 @@ class TpuWindowExec(_WindowBase, TpuExec):
 
 
 def _eval_window_fn(w: WindowExpression, in_cv, perm, live_s, pos, pgid,
-                    qgid, start, end, peer_end, peer_change, cap: int):
+                    qgid, start, end, peer_end, peer_change, cap: int,
+                    peer_start=None, range_ord=None):
     """Compute one window expression in the sorted domain."""
     f = w.function
     frame = w.spec.frame
@@ -311,7 +338,8 @@ def _eval_window_fn(w: WindowExpression, in_cv, perm, live_s, pos, pgid,
         return data, valid & live_s
     if isinstance(f, AggregateFunction):
         return _eval_window_agg(f, frame, in_cv, perm, live_s, pos, pgid,
-                                start, end, peer_end, cap)
+                                start, end, peer_end, cap,
+                                peer_start=peer_start, range_ord=range_ord)
     raise NotImplementedError(f"window function {type(f).__name__}")
 
 
@@ -321,17 +349,64 @@ def _default_of(f, dtype):
     return jnp.asarray(f.default, dtype)
 
 
-def _frame_bounds(frame, pos, start, end, peer_end):
-    """Frame [lo, hi] as sorted-row positions, clamped to the partition."""
+def _bsearch(keys, target, lo0, hi0, side: str):
+    """Vectorized per-lane binary search: the smallest index in
+    [lo0, hi0 + 1] whose key is >= target ('left') or > target ('right').
+    keys must be sorted ascending within each lane's [lo0, hi0] span."""
+    cap = keys.shape[0]
+    lo = lo0.astype(jnp.int32)
+    hi = (hi0 + 1).astype(jnp.int32)
+    steps = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        vm = keys[jnp.clip(mid, 0, cap - 1)]
+        go_right = (vm < target) if side == "left" else (vm <= target)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def _frame_bounds(frame, pos, start, end, peer_end, peer_start=None,
+                  range_ord=None):
+    """Frame [lo, hi] as sorted-row positions, clamped to the partition.
+
+    RANGE frames with finite non-zero bounds (reference:
+    GpuWindowExpression.scala:457-683) binary-search the single numeric
+    ORDER BY key in the sorted domain: the frame of row i is every row j
+    with key[j] in [key[i] + lower, key[i] + upper] (descending orders are
+    key-negated so the same formula holds). Rows whose order key is NULL
+    frame exactly their peer group (the other NULL rows)."""
     if frame.frame_type == "range":
-        lo = start
-        if frame.upper is UNBOUNDED:
+        lo_b, hi_b = frame.lower, frame.upper
+        simple_lo = lo_b is UNBOUNDED or lo_b == 0
+        simple_hi = hi_b is UNBOUNDED or hi_b == 0
+        if simple_lo and simple_hi:
+            lo = start if lo_b is UNBOUNDED else peer_start
+            hi = end if hi_b is UNBOUNDED else peer_end
+            return lo, hi
+        if range_ord is None:
+            raise NotImplementedError(
+                "bounded range frame requires exactly ONE numeric "
+                "ORDER BY column")
+        key_s, kvalid, nn_start, nn_end = range_ord
+        if lo_b is UNBOUNDED:
+            lo = start
+        elif lo_b == 0:
+            lo = peer_start
+        else:
+            lo = _bsearch(key_s, key_s + key_s.dtype.type(lo_b),
+                          nn_start, nn_end, "left")
+        if hi_b is UNBOUNDED:
             hi = end
-        else:  # CURRENT ROW in range terms = end of peer group
+        elif hi_b == 0:
             hi = peer_end
-        if frame.lower is not UNBOUNDED:
-            raise NotImplementedError("range frames with a finite lower "
-                                      "bound")
+        else:
+            hi = _bsearch(key_s, key_s + key_s.dtype.type(hi_b),
+                          nn_start, nn_end, "right") - 1
+        # NULL order key: frame = the null peer group
+        lo = jnp.where(kvalid, lo, peer_start)
+        hi = jnp.where(kvalid, hi, peer_end)
         return lo, hi
     lo = start if frame.lower is UNBOUNDED else \
         jnp.maximum(start, pos + frame.lower)
@@ -341,10 +416,12 @@ def _frame_bounds(frame, pos, start, end, peer_end):
 
 
 def _eval_window_agg(f: AggregateFunction, frame, in_cv, perm, live_s, pos,
-                     pgid, start, end, peer_end, cap: int):
+                     pgid, start, end, peer_end, cap: int,
+                     peer_start=None, range_ord=None):
     vs = in_cv.data[perm]
     valid_s = in_cv.validity[perm] & live_s
-    lo, hi = _frame_bounds(frame, pos, start, end, peer_end)
+    lo, hi = _frame_bounds(frame, pos, start, end, peer_end,
+                           peer_start=peer_start, range_ord=range_ord)
     empty = hi < lo
 
     if isinstance(f, (Sum, Count, Average)):
@@ -464,6 +541,28 @@ class CpuWindowExec(_WindowBase, CpuExec):
                                    else _as_py(c.data[i]), o)
                         for c, o in zip(ocols, bound_orders))
 
+                # single numeric ORDER BY column -> key-space accessor for
+                # bounded RANGE frames (descending orders negate the key so
+                # frames read [k + lower, k + upper] either way)
+                oval = None
+                if len(bound_orders) == 1 and ocols:
+                    dt = ocols[0].dtype
+                    if dt not in (DataType.STRING, DataType.BOOL) and \
+                            not getattr(dt, "is_decimal", False):
+                        oc = ocols[0]
+                        sign = 1 if bound_orders[0].ascending else -1
+
+                        def oval(r, _c=oc, _s=sign):
+                            if not _c.validity[r]:
+                                return None
+                            v = _as_py(_c.data[r])
+                            if isinstance(v, float) and v != v:
+                                # NaN keys frame their (NaN) peer group,
+                                # like nulls — matches Spark's NaN-as-
+                                # largest total order
+                                return None
+                            return _s * v
+
                 groups: Dict[tuple, List[int]] = {}
                 order_seen: List[tuple] = []
                 for i in range(n):
@@ -477,7 +576,7 @@ class CpuWindowExec(_WindowBase, CpuExec):
                 for k in order_seen:
                     rows = sorted(groups[k], key=okey)
                     for wi, (w, icol) in enumerate(zip(wexprs, icols)):
-                        vals = _cpu_window_rows(w, rows, okey, icol)
+                        vals = _cpu_window_rows(w, rows, okey, icol, oval)
                         for r, v in zip(rows, vals):
                             results[wi][r] = v
                 new_cols = list(batch.columns)
@@ -515,12 +614,16 @@ def _as_py(v):
     return v.item() if isinstance(v, np.generic) else v
 
 
-def _cpu_window_rows(w: WindowExpression, rows: List[int], okey, icol):
-    """Evaluate one window expression over one sorted partition (oracle)."""
+def _cpu_window_rows(w: WindowExpression, rows: List[int], okey, icol,
+                     oval=None):
+    """Evaluate one window expression over one sorted partition (oracle).
+    oval maps a batch row index to its key-space ORDER BY value (None for
+    SQL NULL), available when the spec has one numeric order column."""
     f = w.function
     frame = w.spec.frame
     n = len(rows)
     okeys = [okey(r) for r in rows]
+    okvals = [oval(r) for r in rows] if oval is not None else None
 
     def in_vals():
         return [
@@ -561,21 +664,79 @@ def _cpu_window_rows(w: WindowExpression, rows: List[int], okey, icol):
         out = []
         for i in range(n):
             if frame.frame_type == "range":
-                lo = 0
-                if frame.upper is UNBOUNDED:
-                    hi = n - 1
-                else:
-                    hi = i
-                    while hi + 1 < n and okeys[hi + 1] == okeys[i]:
-                        hi += 1
+                window = _cpu_range_window(frame, i, n, vals, okeys, okvals)
             else:
                 lo = 0 if frame.lower is UNBOUNDED else max(0, i + frame.lower)
                 hi = n - 1 if frame.upper is UNBOUNDED else \
                     min(n - 1, i + frame.upper)
-            window = [vals[j] for j in range(lo, hi + 1)] if hi >= lo else []
+                window = [vals[j] for j in range(lo, hi + 1)] \
+                    if hi >= lo else []
             out.append(_reduce_window(f, window))
         return out
     raise NotImplementedError(type(f).__name__)
+
+
+def _cpu_range_window(frame, i: int, n: int, vals, okeys, okvals):
+    """Oracle RANGE frame of row i: value-distance window over the single
+    numeric order key; NULL-keyed rows frame their (null) peer group."""
+    lo_b, hi_b = frame.lower, frame.upper
+    if lo_b is UNBOUNDED and hi_b is UNBOUNDED:
+        return list(vals)
+    finite = (lo_b is not UNBOUNDED and lo_b != 0) or \
+        (hi_b is not UNBOUNDED and hi_b != 0)
+    if not finite:
+        # unbounded/current-row bounds: peer-group positions suffice
+        lo = 0
+        if lo_b == 0:
+            lo = i
+            while lo > 0 and okeys[lo - 1] == okeys[i]:
+                lo -= 1
+        hi = n - 1
+        if hi_b == 0:
+            hi = i
+            while hi + 1 < n and okeys[hi + 1] == okeys[i]:
+                hi += 1
+        return [vals[j] for j in range(lo, hi + 1)]
+    if okvals is None:
+        raise NotImplementedError(
+            "bounded range frame requires exactly ONE numeric ORDER BY "
+            "column")
+    ki = okvals[i]
+    if ki is None:
+        return [vals[j] for j in range(n) if okeys[j] == okeys[i]]
+    # positional frame [lo, hi]: an UNBOUNDED side reaches the partition
+    # edge (including any null-key block sitting there); a finite side
+    # binary-searches the non-null keys — matching the device engine's
+    # start/end vs nn-span bounds (_frame_bounds)
+    if lo_b is UNBOUNDED:
+        lo = 0
+    elif lo_b == 0:
+        lo = i
+        while lo > 0 and okeys[lo - 1] == okeys[i]:
+            lo -= 1
+    else:
+        lo = None
+        for j in range(n):
+            if okvals[j] is not None and okvals[j] >= ki + lo_b:
+                lo = j
+                break
+        if lo is None:
+            return []
+    if hi_b is UNBOUNDED:
+        hi = n - 1
+    elif hi_b == 0:
+        hi = i
+        while hi + 1 < n and okeys[hi + 1] == okeys[i]:
+            hi += 1
+    else:
+        hi = None
+        for j in range(n - 1, -1, -1):
+            if okvals[j] is not None and okvals[j] <= ki + hi_b:
+                hi = j
+                break
+        if hi is None:
+            return []
+    return [vals[j] for j in range(lo, hi + 1)] if hi >= lo else []
 
 
 def _reduce_window(f: AggregateFunction, window: List):

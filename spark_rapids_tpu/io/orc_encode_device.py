@@ -1,0 +1,260 @@
+"""Device-side ORC encode (write path).
+
+Reference parity: the reference encodes ORC ON the accelerator into a host
+buffer and only streams bytes afterwards (`ColumnarOutputWriter.scala:
+62-177` — cudf `Table.writeORC` under the semaphore,
+`GpuOrcFileFormat.scala`). Mirrors the parquet device encoder
+(io/parquet_encode_device.py) with ORC's stream model:
+
+- DEVICE (data plane): per column, jitted kernels compact the non-null
+  values, zigzag-encode, and big-endian bit-pack them into the RLEv2
+  DIRECT payload; the validity bitmap bit-packs into the PRESENT bytes.
+  What downloads is the *encoded* stream payload, not padded columns.
+- HOST (control plane, tiny): interleaves the per-512-value DIRECT run
+  headers and per-128-byte PRESENT literal headers, and writes the
+  protobuf metadata (StripeFooter / Footer / PostScript). No value is
+  touched on the host.
+
+Scope: UNCOMPRESSED files; flat SHORT/INT/LONG/DATE columns (one stripe
+per input batch, DIRECT_V2 with a single column-wide bit width). Files
+read back with pyarrow.orc and this repo's own device ORC decoder.
+Everything else uses the host Arrow writer.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+# ORC type kinds (orc_proto Type.Kind)
+_KIND = {
+    DataType.INT16: 2,   # SHORT
+    DataType.INT32: 3,   # INT
+    DataType.INT64: 4,   # LONG
+    DataType.DATE: 15,   # DATE
+}
+_K_STRUCT = 12
+
+# RLEv2 DIRECT width -> 5-bit width code (subset: the widths we emit)
+_DIRECT_WIDTHS = [1, 2, 4, 8, 16, 24, 32, 40, 48, 56, 64]
+_WIDTH_CODE = {1: 0, 2: 1, 4: 3, 8: 7, 16: 15, 24: 23, 32: 27, 40: 28,
+               48: 29, 56: 30, 64: 31}
+
+_RUN = 512           # values per DIRECT run (max RLEv2 run length)
+_LIT = 128           # bytes per PRESENT literal run
+
+
+def schema_encodable(attrs) -> bool:
+    return all(a.data_type in _KIND for a in attrs)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+@jax.jit
+def _compact_zigzag(data, validity):
+    """Dense non-null values in row order, zigzag-encoded to uint64, plus
+    the present count and the max encoded value (for the width pick)."""
+    order = jnp.argsort(~validity, stable=True)
+    dense = data.astype(jnp.int64)[order]
+    u = ((dense << 1) ^ (dense >> 63)).astype(jnp.uint64)
+    n = jnp.sum(validity.astype(jnp.int32))
+    in_range = jnp.arange(u.shape[0]) < n
+    u = jnp.where(in_range, u, 0)
+    return u, n, jnp.max(u)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _bitpack_be(u, width: int, out_bytes: int):
+    """Big-endian bit-pack: value i occupies bits [i*width, (i+1)*width),
+    MSB first — the RLEv2 DIRECT payload layout."""
+    nvals = u.shape[0]
+    byte_i = jnp.arange(out_bytes, dtype=jnp.int64)
+    gb = byte_i[:, None] * 8 + jnp.arange(8, dtype=jnp.int64)[None, :]
+    val_idx = gb // width
+    shift = (width - 1 - (gb % width)).astype(jnp.uint64)
+    vals = u[jnp.clip(val_idx, 0, nvals - 1)]
+    vals = jnp.where(val_idx < nvals, vals, 0)
+    bits = ((vals >> shift) & jnp.uint64(1)).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << (7 - jnp.arange(8, dtype=jnp.uint32)))
+    return jnp.sum(bits * weights[None, :], axis=1).astype(jnp.uint8)
+
+
+@jax.jit
+def _pack_present(validity, num_rows):
+    """PRESENT bitmap bytes: MSB-first, 1 = value present; bits beyond
+    num_rows are zero-padded."""
+    cap = validity.shape[0]
+    nbytes = (cap + 7) // 8
+    idx = jnp.arange(nbytes)[:, None] * 8 + jnp.arange(8)[None, :]
+    ok = (idx < num_rows) & validity[jnp.clip(idx, 0, cap - 1)]
+    weights = (jnp.uint32(1) << (7 - jnp.arange(8, dtype=jnp.uint32)))
+    return jnp.sum(ok.astype(jnp.uint32) * weights[None, :],
+                   axis=1).astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Host control plane: headers + protobuf
+# ---------------------------------------------------------------------------
+def _pick_width(max_u: int) -> int:
+    need = max(int(max_u).bit_length(), 1)
+    for w in _DIRECT_WIDTHS:
+        if w >= need:
+            return w
+    return 64
+
+
+def _direct_stream(packed: bytes, n: int, width: int) -> bytes:
+    """Interleave the 2-byte DIRECT run headers between the contiguous
+    512-value byte-aligned payload chunks the device produced."""
+    out = bytearray()
+    run_bytes = _RUN * width // 8
+    for r in range((n + _RUN - 1) // _RUN):
+        length = min(_RUN, n - r * _RUN)
+        h1 = 0x40 | (_WIDTH_CODE[width] << 1) | ((length - 1) >> 8)
+        h2 = (length - 1) & 0xFF
+        out.append(h1)
+        out.append(h2)
+        chunk = packed[r * run_bytes:
+                       r * run_bytes + (length * width + 7) // 8]
+        out += chunk
+    return bytes(out)
+
+
+def _present_stream(bitmap: bytes) -> bytes:
+    """Byte-RLE literal runs over the bitmap bytes (header = -count)."""
+    out = bytearray()
+    for i in range(0, len(bitmap), _LIT):
+        chunk = bitmap[i:i + _LIT]
+        out.append(256 - len(chunk))
+        out += chunk
+    return bytes(out)
+
+
+def _uvarint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _fv(fnum: int, v: int) -> bytes:
+    return _uvarint((fnum << 3) | 0) + _uvarint(v)
+
+
+def _fb(fnum: int, b: bytes) -> bytes:
+    return _uvarint((fnum << 3) | 2) + _uvarint(len(b)) + b
+
+
+def _encode_stripe(attrs, batch: ColumnarBatch) -> Tuple[bytes, bytes, int]:
+    """One input batch -> (stripe data bytes, stripe footer bytes, rows)."""
+    from spark_rapids_tpu.columnar.batch import ensure_compact
+
+    # live-masked batches (exchange outputs) compact first: the PRESENT
+    # bitmap is positional over the stripe's rows, so lanes 0..n_rows-1
+    # must BE the rows
+    batch = ensure_compact(batch)
+    n_rows = int(batch.host_rows())
+    streams: List[Tuple[int, int, bytes]] = []   # (kind, column, payload)
+    for ci, a in enumerate(attrs):
+        cv = batch.columns[ci]
+        validity = cv.validity
+        u, n, max_u = _compact_zigzag(cv.data, validity)
+        n, max_u = int(jax.device_get(n)), int(jax.device_get(max_u))
+        has_nulls = n != n_rows
+        if has_nulls:
+            bitmap = bytes(np.asarray(
+                jax.device_get(_pack_present(validity,
+                                             jnp.int32(n_rows)))))
+            bitmap = bitmap[:(n_rows + 7) // 8]
+            streams.append((0, ci + 1, _present_stream(bitmap)))
+        width = _pick_width(max_u)
+        if n > 0:
+            out_bytes = ((n + _RUN - 1) // _RUN) * (_RUN * width // 8)
+            packed = bytes(np.asarray(
+                jax.device_get(_bitpack_be(u, width, out_bytes))))
+            data = _direct_stream(packed, n, width)
+        else:
+            data = b""
+        streams.append((1, ci + 1, data))
+
+    data_area = bytearray()
+    footer = bytearray()
+    for kind, col, payload in streams:
+        data_area += payload
+        footer += _fb(1, _fv(1, kind) + _fv(2, col) + _fv(3, len(payload)))
+    # column encodings: root struct DIRECT, columns DIRECT_V2
+    footer += _fb(2, _fv(1, 0))
+    for _ in attrs:
+        footer += _fb(2, _fv(1, 2))
+    return bytes(data_area), bytes(footer), n_rows
+
+
+def write_file(path: str, attrs, batches: List[ColumnarBatch]) -> int:
+    """Assemble one ORC file from device-encoded stripes (one stripe per
+    batch). Returns rows written."""
+    header = b"ORC"
+    body = bytearray(header)
+    stripe_infos: List[Tuple[int, int, int, int]] = []
+    total_rows = 0
+    for b in batches:
+        if b.host_rows() == 0:
+            continue
+        offset = len(body)
+        data, sfooter, rows = _encode_stripe(attrs, b)
+        body += data
+        body += sfooter
+        stripe_infos.append((offset, len(data), len(sfooter), rows))
+        total_rows += rows
+
+    # Footer
+    footer = bytearray()
+    footer += _fv(1, len(header))          # headerLength
+    footer += _fv(2, len(body))            # contentLength
+    for off, dlen, flen, rows in stripe_infos:
+        footer += _fb(3, _fv(1, off) + _fv(2, 0) + _fv(3, dlen)
+                      + _fv(4, flen) + _fv(5, rows))
+    # types: root struct + one per column
+    root = _fv(1, _K_STRUCT)
+    for ci, a in enumerate(attrs):
+        root += _fv(2, ci + 1)
+    for a in attrs:
+        root += _fb(3, a.name.encode("utf-8"))
+    footer += _fb(4, root)
+    for a in attrs:
+        footer += _fb(4, _fv(1, _KIND[a.data_type]))
+    footer += _fv(6, total_rows)           # numberOfRows
+    footer += _fv(8, 0)                    # rowIndexStride: no row index
+
+    ps = bytearray()
+    ps += _fv(1, len(footer))              # footerLength
+    ps += _fv(2, 0)                        # compression NONE
+    ps += _fv(3, 64 * 1024)                # compressionBlockSize
+    ps += _uvarint((4 << 3) | 0) + _uvarint(0)    # version: 0
+    ps += _uvarint((4 << 3) | 0) + _uvarint(12)   # version: 12
+    ps += _fv(5, 0)                        # metadataLength
+    ps += _fv(6, 1)                        # writerVersion
+    ps += _fb(8000, b"ORC")                # magic
+    assert len(ps) < 256
+
+    with open(path, "wb") as f:
+        f.write(bytes(body))
+        f.write(bytes(footer))
+        f.write(bytes(ps))
+        f.write(struct.pack("B", len(ps)))
+    return total_rows

@@ -266,6 +266,13 @@ class _FileScanBase(PhysicalExec):
     def output(self) -> List[AttributeReference]:
         return self.attrs
 
+    @property
+    def coalesce_after(self) -> bool:
+        # scans emit per-row-group/per-chunk batches; coalescing them to the
+        # target batch size is the reference's signature plan shape
+        # (GpuScans set coalesceAfter, GpuCoalesceBatches sits above scans)
+        return True
+
     def with_children(self, new_children):
         assert not new_children
         return self

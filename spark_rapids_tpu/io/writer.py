@@ -49,6 +49,14 @@ def execute_write(session, plan: L.WriteFile) -> None:
     attrs = child.output
     physical = session._physical_plan(child)
 
+    # optional sort after hash ops so written files cluster equal keys
+    # (reference: GpuTransitionOverrides.insertHashOptimizeSorts :171-204)
+    from spark_rapids_tpu.plan.transition_overrides import (
+        insert_hash_optimize_sort,
+    )
+
+    physical = insert_hash_optimize_sort(physical, session.conf)
+
     # Device-side parquet encode (reference: ColumnarOutputWriter.scala:
     # 62-177 encodes on the accelerator): peel the root DeviceToHost
     # transition and hand DEVICE batches to the device encoder — what
@@ -60,6 +68,8 @@ def execute_write(session, plan: L.WriteFile) -> None:
     # the device encoder writes UNCOMPRESSED only, so it engages just for
     # an explicit compression=none — the default write stays snappy via the
     # host Arrow writer, identical before and after this feature
+    from spark_rapids_tpu.io import orc_encode_device as OE
+
     device_encode = (
         plan.fmt == "parquet"
         and not plan.partition_by
@@ -68,7 +78,17 @@ def execute_write(session, plan: L.WriteFile) -> None:
         in ("none", "uncompressed")
         and isinstance(physical, DeviceToHostExec)
         and PE.schema_encodable(attrs))
-    if device_encode:
+    # pyarrow's ORC default IS uncompressed, so the device ORC encoder
+    # (reference: GpuOrcFileFormat.scala) engages for default writes too
+    device_encode_orc = (
+        plan.fmt == "orc"
+        and not plan.partition_by
+        and session.conf.get(C.ORC_DEVICE_ENCODE)
+        and str(plan.options.get("compression", "uncompressed")).lower()
+        in ("none", "uncompressed")
+        and isinstance(physical, DeviceToHostExec)
+        and OE.schema_encodable(attrs))
+    if device_encode or device_encode_orc:
         physical = physical.children[0]
 
     ctx = session._exec_context()
@@ -82,6 +102,9 @@ def execute_write(session, plan: L.WriteFile) -> None:
         if device_encode:
             fname = f"part-{pidx:05d}-{write_id}.{_ext(plan.fmt)}"
             return PE.write_file(os.path.join(path, fname), attrs, batches)
+        if device_encode_orc:
+            fname = f"part-{pidx:05d}-{write_id}.{_ext(plan.fmt)}"
+            return OE.write_file(os.path.join(path, fname), attrs, batches)
         if plan.partition_by:
             return _write_partitioned(batches, attrs, plan, path, pidx,
                                       write_id)

@@ -87,7 +87,8 @@ def all_to_all_table(data_cols: List[jnp.ndarray], validity, pid,
     ]
     recv_valid = jax.lax.all_to_all(bucket_valid, axis, split_axis=0,
                                     concat_axis=0, tiled=True)
-    flat_cols = [c.reshape(-1) for c in recv_cols]
+    # rank-2 columns (fixed-width string matrices) keep their trailing axis
+    flat_cols = [c.reshape((-1,) + c.shape[2:]) for c in recv_cols]
     return flat_cols, recv_valid.reshape(-1)
 
 

@@ -252,9 +252,24 @@ def _tag_window_expr(m: ExprMeta) -> None:
     w = m.expr
     f = w.function
     frame = w.spec.frame
-    if frame.frame_type == "range" and frame.lower is not W.UNBOUNDED:
-        m.will_not_work(
-            "range frames with a finite lower bound run on the CPU engine")
+    if frame.frame_type == "range" and (
+            frame.lower not in (W.UNBOUNDED, 0)
+            or frame.upper not in (W.UNBOUNDED, 0)):
+        # bounded range frames binary-search the single numeric ORDER BY
+        # key in the sorted domain (exec/window.py:_frame_bounds;
+        # reference: GpuWindowExpression.scala:457-683)
+        ob = w.spec.order_by
+        dt = ob[0].child.data_type if len(ob) == 1 else None
+        ok = dt in (DataType.INT8, DataType.INT16, DataType.INT32,
+                    DataType.INT64, DataType.DATE, DataType.TIMESTAMP)
+        if not ok:
+            # float keys are excluded on the device: f64 narrows to f32 on
+            # TPU and even f32 bound arithmetic rounds differently from the
+            # oracle's f64 — frame membership is discrete, so a boundary
+            # round-off silently moves whole rows between frames
+            m.will_not_work(
+                "bounded range frames need exactly one integer/date/"
+                "timestamp ORDER BY column on the device engine")
     if isinstance(f, (AGG.Min, AGG.Max)) and not (
             frame.is_unbounded_both or frame.is_unbounded_to_current):
         m.will_not_work(

@@ -104,16 +104,50 @@ def _insert_transitions(node: PhysicalExec, want_host_output: bool) -> PhysicalE
     return node
 
 
-def _insert_coalesce(node: PhysicalExec, conf: C.TpuConf) -> PhysicalExec:
+def _has_input_file_expr(node: PhysicalExec) -> bool:
+    def expr_has(e) -> bool:
+        if getattr(e, "disable_coalesce_until_input", False):
+            return True
+        return any(expr_has(c) for c in e.children())
+
+    return any(expr_has(e) for e in node.node_expressions())
+
+
+def _is_new_input(node: PhysicalExec) -> bool:
+    """Nodes that produce their own rows: coalescing above them can no
+    longer mix rows from different files (reference: the disableUntilInput
+    walk stops at exchanges/scans, GpuTransitionOverrides.scala:113-147)."""
+    from spark_rapids_tpu.io.scan import _FileScanBase
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+
+    return isinstance(node, (_ExchangeBase, _FileScanBase, B.HostScanExec,
+                             B.RangeExec))
+
+
+def _insert_coalesce(node: PhysicalExec, conf: C.TpuConf,
+                     poisoned: bool = False) -> PhysicalExec:
     """Insert batch-coalescing per the child goals each operator declares
-    (reference: GpuTransitionOverrides.insertCoalesce, :64-147)."""
+    (reference: GpuTransitionOverrides.insertCoalesce, :64-147). Edges
+    BELOW a node evaluating an input-file expression (input_file_name()
+    etc.), down to the producing input (scan/exchange), are POISONED: a
+    coalesce there would merge batches across file boundaries before the
+    expression reads which file each row came from (reference: :64-147
+    input-file poisoning). Edges above the expression node are safe — the
+    value is already materialized."""
+    poisoned = poisoned or _has_input_file_expr(node)
     goals = node.children_coalesce_goal
     new_children = []
     for c, goal in zip(node.children, goals):
-        c2 = _insert_coalesce(c, conf)
+        # recursing INTO a new input clears the poison for ITS subtree;
+        # the edge directly above the input is still poisoned
+        c2 = _insert_coalesce(c, conf, poisoned and not _is_new_input(c))
         if goal is None and getattr(c2, "coalesce_after", False):
             goal = TargetSize(conf.batch_size_bytes)
-        if goal is not None:
+        # poisoning drops only best-effort TargetSize coalesces; a
+        # REQUIRED single-batch goal (sort/window/join-build correctness)
+        # always wins over input-file file-attribution fidelity
+        if goal is not None and not (poisoned and
+                                     isinstance(goal, TargetSize)):
             if _effective_placement(c2) == "tpu":
                 c2 = TpuCoalesceBatchesExec(goal, c2)
             else:
@@ -123,6 +157,48 @@ def _insert_coalesce(node: PhysicalExec, conf: C.TpuConf) -> PhysicalExec:
             a is not b for a, b in zip(new_children, node.children)):
         node = node.with_children(new_children)
     return node
+
+
+def insert_hash_optimize_sort(plan: PhysicalExec,
+                              conf: C.TpuConf) -> PhysicalExec:
+    """Optionally sort the output of hash-based operators feeding a file
+    write, clustering equal keys so written files size/compress better
+    (reference: GpuTransitionOverrides.insertHashOptimizeSorts, :171-204).
+    Called by the write path on the write's input plan."""
+    if not conf.get(C.HASH_OPTIMIZE_SORT):
+        return plan
+    from spark_rapids_tpu.exec.aggregate import _HashAggregateBase
+    from spark_rapids_tpu.exec.join import (
+        TpuShuffledHashJoinExec,
+    )
+    from spark_rapids_tpu.exec.sort import TpuSortExec
+    from spark_rapids_tpu.exec.transitions import DeviceToHostExec as D2H
+    from spark_rapids_tpu.ops.base import AttributeReference, SortOrder
+
+    def sort_keys(n: PhysicalExec):
+        if isinstance(n, _HashAggregateBase) and n.grouping:
+            return [a for a in n.grouping
+                    if isinstance(a, AttributeReference)]
+        if isinstance(n, TpuShuffledHashJoinExec):
+            return [a for a in getattr(n, "left_keys", [])
+                    if isinstance(a, AttributeReference)]
+        return None
+
+    def rewrite(n: PhysicalExec) -> PhysicalExec:
+        # walk through the transitions/coalesces directly under the write
+        if isinstance(n, (D2H, TpuCoalesceBatchesExec,
+                          CpuCoalesceBatchesExec)):
+            child = rewrite(n.children[0])
+            if child is not n.children[0]:
+                return n.with_children([child])
+            return n
+        keys = sort_keys(n)
+        if keys and _effective_placement(n) == "tpu":
+            orders = [SortOrder(k, True) for k in keys]
+            return TpuSortExec(orders, n)
+        return n
+
+    return rewrite(plan)
 
 
 def _optimize_transitions(node: PhysicalExec) -> PhysicalExec:

@@ -44,22 +44,16 @@ class WindowBuilder:
                              WindowFrame("rows", lo, hi))
 
     def rangeBetween(self, start, end) -> "WindowBuilder":
-        if not ((start is None or start is UNBOUNDED) and
-                (end is None or end == CURRENT_ROW)):
-            raise NotImplementedError(
-                "range frames support only unbounded preceding .. "
-                "current row / unbounded following")
-        return WindowFrameBuilderRange(self._partition_by, self._order_by,
-                                       start, end)
+        """RANGE frame; bounds are ORDER-BY-value offsets (0 = CURRENT ROW,
+        None = unbounded). Finite bounds need exactly one numeric ORDER BY
+        column (reference: GpuWindowExpression.scala:457-683)."""
+        lo = UNBOUNDED if start is None else int(start)
+        hi = UNBOUNDED if end is None else int(end)
+        return WindowBuilder(self._partition_by, self._order_by,
+                             WindowFrame("range", lo, hi))
 
     def to_spec(self) -> WindowSpec:
         return WindowSpec(self._partition_by, self._order_by, self._frame)
-
-
-def WindowFrameBuilderRange(part, order, start, end):
-    frame = WindowFrame("range", UNBOUNDED,
-                        UNBOUNDED if end is None else CURRENT_ROW)
-    return WindowBuilder(part, order, frame)
 
 
 def _col(c):

@@ -56,32 +56,68 @@ def session_mesh() -> Optional[Mesh]:
         if _MESH is None:
             devs = jax.devices()
             if len(devs) > 1:
-                _MESH = build_mesh()
+                if jax.process_count() > 1:
+                    # host-major order keeps intra-host traffic on ICI
+                    from spark_rapids_tpu.parallel.distributed import (
+                        global_mesh,
+                    )
+
+                    _MESH = global_mesh()
+                else:
+                    _MESH = build_mesh()
         return _MESH
 
 
 def supports_ici(partitioning, child_attrs, n: int) -> bool:
-    """Whether this exchange can lower onto the collective epoch."""
+    """Whether this exchange can lower onto the collective epoch.
+
+    Partition counts: n may equal the mesh size m, be a multiple of it
+    (k = n/m output partitions per chip, sub-split by routed partition id),
+    or divide it (chips >= n receive nothing) — the reference's accelerated
+    shuffle likewise serves any partition count
+    (RapidsShuffleInternalManager.scala:74-178).
+
+    Strings: columns exchange as fixed-width padded byte buckets; a STRING
+    *key* must be a direct column reference (it hashes from the exchanged
+    representation), and non-string key expressions must not read string
+    inputs (they evaluate inside the kernel where strings are matrices)."""
+    from spark_rapids_tpu.ops.base import AttributeReference
     from spark_rapids_tpu.shuffle.exchange import HashPartitioning
 
     if not isinstance(partitioning, HashPartitioning):
         return False
-    if any(a.data_type is DataType.STRING for a in child_attrs):
-        return False
     mesh = session_mesh()
-    return mesh is not None and n == mesh.devices.size
+    if mesh is None:
+        return False
+    m = mesh.devices.size
+    if not (n == m or (n > m and n % m == 0) or (n < m and m % n == 0)):
+        return False
+
+    def no_strings(e):
+        if getattr(e, "data_type", None) is DataType.STRING:
+            return False
+        return all(no_strings(c) for c in e.children())
+
+    return all(isinstance(e, AttributeReference) or no_strings(e)
+               for e in partitioning.exprs)
 
 
-def _regroup(per_map: List[List[ColumnarBatch]],
-             n: int) -> List[Optional[ColumnarBatch]]:
+def _regroup(per_map: List[List[ColumnarBatch]], n: int,
+             devs=None) -> List[Optional[ColumnarBatch]]:
     """Assign map-partition outputs to the n shard slots (slot = pidx % n)
-    and concat each slot to one compact batch."""
+    and concat each slot to one compact batch on the slot's device (map
+    outputs feeding this exchange may be committed to different chips by a
+    previous exchange)."""
+    from spark_rapids_tpu.columnar.batch import batch_to_device
+
     slots: List[List[ColumnarBatch]] = [[] for _ in range(n)]
     for pidx, batches in enumerate(per_map):
         for b in batches:
             slots[pidx % n].append(b)
     out: List[Optional[ColumnarBatch]] = []
-    for group in slots:
+    for s, group in enumerate(slots):
+        if devs is not None and jax.process_count() == 1:
+            group = [batch_to_device(b, devs[s]) for b in group]
         if not group:
             out.append(None)
         elif len(group) == 1:
@@ -92,119 +128,295 @@ def _regroup(per_map: List[List[ColumnarBatch]],
 
 
 def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, bound_exprs,
-                           n: int, cap: int):
-    """One jitted shard_map program per (schema, keys, n, cap): per-shard
-    hash ids -> bucket routing -> all_to_all -> received columns + live mask.
+                           n: int, cap: int, widths: Tuple):
+    """One jitted shard_map program per (schema, keys, n, cap, widths):
+    per-shard hash ids -> bucket routing -> all_to_all -> received columns +
+    live mask + routed partition ids.
+
+    widths[ci] is the fixed byte width for a STRING column's padded matrix
+    representation (0 for non-string columns). n may exceed the mesh size m
+    (k = n/m partitions per chip: rows route to chip pid//k and the routed
+    pid sub-splits after the exchange) or divide it (route to chip pid).
     """
+    from spark_rapids_tpu.ops.base import BoundReference
     from spark_rapids_tpu.parallel.mesh import shard_map
 
     ncols = len(dtypes_key)
     dtypes = [DataType(v) for v in dtypes_key]
+    m = mesh.devices.size
+    k = n // m if n > m else 1
+    str_cols = [ci for ci in range(ncols) if widths[ci]]
 
     def per_shard(live, *flat):
         live = live[0]
-        datas = [a[0] for a in flat[:ncols]]
-        valids = [a[0] for a in flat[ncols:]]
-        cols = [ColV(dt, d, v) for dt, d, v in zip(dtypes, datas, valids)]
+        datas = list(flat[:ncols])
+        valids = list(flat[ncols:2 * ncols])
+        lens = {ci: flat[2 * ncols + i][0]
+                for i, ci in enumerate(str_cols)}
+        datas = [d[0] for d in datas]
+        valids = [v[0] for v in valids]
+
+        # hash entries per key expr; string keys hash straight from the
+        # exchanged matrix representation (bit-identical to the offsets+
+        # bytes hash, ops/hashing.matrix_string_words)
+        eval_cols = [
+            ColV(dt, d, v) if wi == 0 else None
+            for dt, d, v, wi in zip(dtypes, datas, valids, widths)
+        ]
         num_rows = jnp.sum(live.astype(jnp.int32))
-        ctx = EvalContext(jnp, True, cols, num_rows, cap)
-        key_cols = []
+        ctx = EvalContext(jnp, True, eval_cols, num_rows, cap)
+        entries = []
         for e in bound_exprs:
+            if isinstance(e, BoundReference) and \
+                    dtypes[e.ordinal] is DataType.STRING:
+                ci = e.ordinal
+                entries.append((H.matrix_string_words(
+                    jnp, datas[ci], lens[ci], valids[ci]), valids[ci]))
+                continue
             r = e.eval(ctx)
             if isinstance(r, ScalarV):
                 from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
                 r = _scalar_to_colv(ctx, r, e.data_type)
-            key_cols.append(r)
-        pid = H.partition_ids(jnp, key_cols, n)
-        # route every column's data AND validity in the same epoch
+            entries.append((H.column_words(jnp, r), r.validity))
+        pid = H.partition_ids_from_entries(jnp, entries, n)
+        dev = pid // k if k > 1 else pid
+
+        # route every column's data AND validity (strings: matrix + lens);
+        # the partition id rides along only when chips hold k > 1 output
+        # partitions and must sub-split after the exchange
+        routed_in = datas + valids + [lens[ci] for ci in str_cols]
+        if k > 1:
+            routed_in = routed_in + [pid]
         routed, recv_live = all_to_all_table(
-            datas + valids, live, pid, n, cap, DATA_AXIS)
+            routed_in, live, dev, m, cap, DATA_AXIS)
         outs = [r[None] for r in routed]
         return (recv_live[None], *outs)
 
     spec = P(DATA_AXIS)
+    n_args = 1 + 2 * ncols + len(str_cols)
+    n_outs = n_args + (1 if k > 1 else 0)
     smapped = shard_map(
         per_shard, mesh=mesh,
-        in_specs=(spec,) * (1 + 2 * ncols),
-        out_specs=(spec,) * (1 + 2 * ncols),
+        in_specs=(spec,) * n_args,
+        out_specs=(spec,) * n_outs,
     )
     return jax.jit(smapped)
+
+
+@jax.jit
+def _string_lens(offsets):
+    return offsets[1:] - offsets[:-1]
+
+
+def _strings_to_matrix(data_u8, offsets, width: int):
+    """(bytes, offsets) -> fixed-width [rows, width] byte matrix + lengths:
+    the padded-bucket representation strings travel in over the collective.
+    """
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    j = jnp.arange(width, dtype=jnp.int32)[None, :]
+    idx = offsets[:-1][:, None] + j
+    mat = data_u8[jnp.clip(idx, 0, data_u8.shape[0] - 1)]
+    return jnp.where(j < lens[:, None], mat, jnp.uint8(0)), lens
+
+
+def _matrix_to_strings(mat, lens, byte_cap: int):
+    """Received [rows, W] matrix + masked lengths -> (bytes, offsets)."""
+    from spark_rapids_tpu.columnar.strings import build_from_plan
+
+    rows = lens.shape[0]
+    width = mat.shape[1]
+    starts = (jnp.arange(rows, dtype=jnp.int32) * width)
+    return build_from_plan([mat.reshape(-1)],
+                           jnp.zeros((rows,), jnp.int32),
+                           starts, lens, byte_cap)
 
 
 def ici_hash_exchange(per_map: List[List[ColumnarBatch]], bound_exprs,
                       child_attrs, n: int) -> List[ColumnarBatch]:
     """Exchange all map outputs across the mesh in one collective epoch;
-    returns one live-masked output batch per shard (device t holds output
-    partition t)."""
+    returns n live-masked output batches. Output partition p lives on mesh
+    device p // k (k = partitions per chip), so the downstream
+    per-partition pipeline runs on that chip."""
     mesh = session_mesh()
+    m = mesh.devices.size
+    k = n // m if n > m else 1
     dtypes = [a.data_type for a in child_attrs]
-    slots = _regroup(per_map, n)
+    slots = _regroup(per_map, m, devs=list(mesh.devices.ravel()))
 
     rows = [s.host_rows() if s is not None else 0 for s in slots]
     cap = bucket_capacity(max(max(rows), 1))
     ncols = len(dtypes)
+    str_cols = [ci for ci in range(ncols)
+                if dtypes[ci] is DataType.STRING]
 
-    # stack per-shard padded columns into [n, cap] globals
-    live_np = np.zeros((n, cap), dtype=bool)
+    # string columns: one fixed byte width per column across all shards
+    widths = [0] * ncols
+    if str_cols:
+        maxes = []
+        for ci in str_cols:
+            col_max = [jnp.max(_string_lens(batch.columns[ci].offsets))
+                       for batch in slots
+                       if batch is not None and batch.host_rows() > 0]
+            maxes.append(col_max)
+        flat = [x for grp in maxes for x in grp]
+        got = [int(v) for v in jax.device_get(flat)] if flat else []
+        it = iter(got)
+        for i, ci in enumerate(str_cols):
+            vals = [next(it) for _ in maxes[i]]
+            widths[ci] = int(bucket_capacity(max(max(vals, default=1), 1)))
+
+    # place per-shard padded columns as [m, cap(, W)] globals. Slot parts
+    # may be COMMITTED to different chips (outputs of a previous exchange
+    # feeding this one, e.g. join -> groupBy): each part device_puts to its
+    # own target shard — never a cross-device stack — and the global
+    # assembles zero-copy from the per-device pieces.
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    devs = list(mesh.devices.ravel())
+
+    def stack_global(parts, shape_tail, dtype):
+        if jax.process_count() > 1:
+            host = np.stack([
+                np.asarray(jax.device_get(p)) if p is not None
+                else np.zeros(shape_tail, dtype) for p in parts])
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+        arrs = []
+        for s, p in enumerate(parts):
+            x = p if p is not None else jnp.zeros(shape_tail, dtype)
+            arrs.append(jax.device_put(x[None], devs[s]))
+        return jax.make_array_from_single_device_arrays(
+            (len(parts),) + tuple(shape_tail), sharding, arrs)
+
+    live_np = np.zeros((m, cap), dtype=bool)
     for s, r in enumerate(rows):
         live_np[s, :r] = True
+    live = _to_global(jnp.asarray(live_np), sharding)
     datas, valids = [], []
+    lens_stk = {}
     for ci in range(ncols):
+        is_str = widths[ci] > 0
         phys = None
-        col_parts, val_parts = [], []
+        col_parts, val_parts, len_parts = [], [], []
         for s, batch in enumerate(slots):
             if batch is None:
                 col_parts.append(None)
                 val_parts.append(None)
+                len_parts.append(None)
                 continue
             cv = batch.columns[ci]
             if cv.capacity < cap:
                 from spark_rapids_tpu.columnar.batch import repad_column
 
                 cv = repad_column(cv, cap)
-            col_parts.append(cv.data[:cap])
+            if is_str:
+                mat, ln = _strings_to_matrix(cv.data, cv.offsets[:cap + 1],
+                                             widths[ci])
+                col_parts.append(mat)
+                len_parts.append(ln)
+            else:
+                col_parts.append(cv.data[:cap])
             val_parts.append(cv.validity[:cap])
             phys = col_parts[-1].dtype
         if phys is None:  # all slots empty: physical dtype from the schema
-            from spark_rapids_tpu.columnar.batch import physical_np_dtype
+            if is_str:
+                phys = jnp.dtype(jnp.uint8)
+            else:
+                from spark_rapids_tpu.columnar.batch import physical_np_dtype
 
-            phys = jnp.dtype(physical_np_dtype(dtypes[ci]))
-        zero_d = jnp.zeros((cap,), dtype=phys)
-        zero_v = jnp.zeros((cap,), dtype=bool)
-        datas.append(jnp.stack([c if c is not None else zero_d
-                                for c in col_parts]))
-        valids.append(jnp.stack([v if v is not None else zero_v
-                                 for v in val_parts]))
+                phys = jnp.dtype(physical_np_dtype(dtypes[ci]))
+        shape = (cap, widths[ci]) if is_str else (cap,)
+        datas.append(stack_global(col_parts, shape, phys))
+        valids.append(stack_global(val_parts, (cap,), jnp.dtype(bool)))
+        if is_str:
+            lens_stk[ci] = stack_global(len_parts, (cap,),
+                                        jnp.dtype(jnp.int32))
 
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    live = jax.device_put(jnp.asarray(live_np), sharding)
-    datas = [jax.device_put(d, sharding) for d in datas]
-    valids = [jax.device_put(v, sharding) for v in valids]
+    lens_in = [lens_stk[ci] for ci in str_cols]
 
     key = ("ici_exchange", tuple(dt.value for dt in dtypes),
-           tuple(e.fingerprint() for e in bound_exprs), n, cap)
+           tuple(e.fingerprint() for e in bound_exprs), n, cap,
+           tuple(widths))
     kernel = get_or_build(key, lambda: _build_exchange_kernel(
-        mesh, tuple(dt.value for dt in dtypes), bound_exprs, n, cap))
+        mesh, tuple(dt.value for dt in dtypes), bound_exprs, n, cap,
+        tuple(widths)))
 
-    out = kernel(live, *datas, *valids)
+    out = kernel(live, *datas, *valids, *lens_in)
+    if not out[0].is_fully_addressable:
+        # multi-controller mesh (the exchange spans OS processes): replicate
+        # the received arrays so every process can serve any partition to
+        # its local pipeline — the XLA all-gather over ICI/DCN playing the
+        # reference's cross-executor UCX fetch (RapidsShuffleClient.scala)
+        out = jax.jit(lambda *xs: xs,
+                      out_shardings=NamedSharding(mesh, P()))(*out)
     recv_live, routed = out[0], out[1:]
+    recv_pid = routed[2 * ncols + len(str_cols)] if k > 1 else None
+
+    # per-device received pieces
     out_batches: List[ColumnarBatch] = []
-    for t in range(n):
+    n_devs_used = min(n, m)
+    per_dev = []
+    for t in range(n_devs_used):
         live_t = _shard_data(recv_live, t)
+        pid_t = _shard_data(recv_pid, t) if k > 1 else None
+        cols_t = [(_shard_data(routed[ci], t),
+                   _shard_data(routed[ncols + ci], t)) for ci in range(ncols)]
+        lens_t = {ci: _shard_data(routed[2 * ncols + i], t)
+                  for i, ci in enumerate(str_cols)}
+        per_dev.append((live_t, pid_t, cols_t, lens_t))
+
+    # batch the string byte-size syncs: one device_get for all partitions
+    sums = []
+    part_plans = []
+    for p in range(n):
+        t = p // k if k > 1 else p
+        live_t, pid_t, cols_t, lens_t = per_dev[t]
+        live_p = live_t & (pid_t == p) if k > 1 else live_t
+        masked = {ci: jnp.where(live_p & cols_t[ci][1], lens_t[ci], 0)
+                  for ci in str_cols}
+        for ci in str_cols:
+            sums.append(jnp.sum(masked[ci]))
+        part_plans.append((t, live_p, masked))
+    totals = [int(v) for v in jax.device_get(sums)] if sums else []
+    ti = iter(totals)
+
+    for p in range(n):
+        t, live_p, masked = part_plans[p]
+        _, pid_t, cols_t, lens_t = per_dev[t]
         cols = []
         for ci in range(ncols):
-            data_t = _shard_data(routed[ci], t)
-            valid_t = _shard_data(routed[ncols + ci], t)
-            cols.append(ColumnVector(dtypes[ci], data_t, valid_t))
+            data_t, valid_t = cols_t[ci]
+            if widths[ci] > 0:
+                byte_cap = bucket_capacity(max(next(ti), 8))
+                packed, offs = _matrix_to_strings(data_t, masked[ci],
+                                                  byte_cap)
+                cols.append(ColumnVector(dtypes[ci], packed, valid_t, offs))
+            else:
+                cols.append(ColumnVector(dtypes[ci], data_t, valid_t))
         out_batches.append(ColumnarBatch(
-            cols, jnp.sum(live_t.astype(jnp.int32)), live=live_t))
+            cols, jnp.sum(live_p.astype(jnp.int32)), live=live_p))
     return out_batches
+
+
+def _to_global(arr, sharding):
+    """Place a host/local array onto the (possibly multi-process) mesh
+    sharding. Every process holds the identical full value (the exchange
+    driver is deterministic SPMD), so each can serve its addressable
+    shards."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    host = np.asarray(jax.device_get(arr))
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 def _shard_data(global_arr, t: int):
     """Device-t piece of a mesh-sharded [n, ...] array, squeezed to [...]
-    (keeps the data on chip t — downstream per-partition work runs there)."""
+    (keeps the data on chip t — downstream per-partition work runs there).
+    Replicated arrays (multi-process exchange output) slice locally."""
+    sl = global_arr.sharding.shard_shape(global_arr.shape)
+    if sl[0] == global_arr.shape[0]:  # replicated: any local copy serves t
+        return global_arr.addressable_data(0)[t]
     for shard in global_arr.addressable_shards:
         if shard.index[0].start == t:
             return shard.data[0]

@@ -53,7 +53,72 @@ def main() -> None:
     }), flush=True)
 
 
+def main_engine() -> None:
+    """Engine mode: a REAL DataFrame groupBy().agg() and a join execute
+    through the full engine (plan rewrite -> execs -> ICI shuffle tier)
+    over the 2-process global mesh. Every process runs the identical SPMD
+    driver; exchange outputs replicate across processes (shuffle/ici.py) so
+    each collect() sees the full result. Reference analog: a query whose
+    shuffle crosses executors over UCX
+    (RapidsShuffleInternalManager.scala:74-178)."""
+    from spark_rapids_tpu.parallel import distributed as D
+
+    assert D.init_distributed(), "expected multi-process env"
+    import jax
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.plan import functions as F
+
+    sess = srt.new_session()
+    sess.conf.set("rapids.tpu.sql.enabled", True)
+    sess.conf.set("rapids.tpu.shuffle.mode", "ici")
+    sess.conf.set("rapids.tpu.sql.shuffle.partitions",
+                  len(jax.devices()))
+    sess.conf.set("rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+
+    rng = np.random.default_rng(13)  # identical data on every process
+    n = 600
+    left = sess.createDataFrame({
+        "k": rng.integers(0, 23, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+    }, num_partitions=4)
+    right = sess.createDataFrame({
+        "k": rng.integers(0, 23, 200).astype(np.int64),
+        "w": rng.integers(0, 50, 200).astype(np.int64),
+    }, num_partitions=3)
+
+    agg = left.filter(left["v"] % 3 != 0).groupBy("k").agg(
+        F.sum("v").alias("s"), F.count("*").alias("c"))
+    got_agg = sorted(agg.collect())
+    j = left.join(right, on="k", how="inner").groupBy("k").agg(
+        F.sum("w").alias("sw"), F.count("*").alias("n"))
+    got_join = sorted(j.collect())
+
+    # per-process CPU oracle over the same (deterministic) frames
+    sess.set_conf("rapids.tpu.sql.enabled", False)
+    want_agg = sorted(agg.collect())
+    want_join = sorted(j.collect())
+    assert got_agg == want_agg, \
+        f"agg mismatch: {got_agg[:3]} != {want_agg[:3]}"
+    assert got_join == want_join, \
+        f"join mismatch: {got_join[:3]} != {want_join[:3]}"
+
+    print(json.dumps({
+        "pid": D.process_index(),
+        "devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "agg_groups": len(got_agg),
+        "agg_checksum": int(sum(r[1] for r in got_agg)),
+        "join_groups": len(got_join),
+        "join_checksum": int(sum(r[1] for r in got_join)),
+    }), flush=True)
+
+
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--engine":
+        main_engine()
+    else:
+        main()

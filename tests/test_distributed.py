@@ -66,3 +66,37 @@ def test_two_process_distributed_agg():
         assert o["local_devices"] == 4
         assert o["groups"] == exp_groups
         assert o["checksum"] == exp_checksum
+
+
+def test_two_process_dataframe_query():
+    """A real session DataFrame groupBy().agg() and a join execute across
+    2 OS processes x 4 virtual devices through the engine's ICI shuffle
+    tier, each process asserting equality to the CPU oracle in-worker
+    (reference: the executor-spanning UCX shuffle,
+    UCXShuffleTransport.scala:47-507)."""
+    from spark_rapids_tpu.utils.hostenv import scrubbed_cpu_env
+
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = scrubbed_cpu_env(4)
+        env.update({
+            "SRT_COORDINATOR": f"127.0.0.1:{port}",
+            "SRT_NUM_PROCESSES": "2",
+            "SRT_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "distributed_worker.py"),
+             "--engine"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=360)
+        assert p.returncode == 0, f"engine worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        outs.append(json.loads(line))
+    assert outs[0]["devices"] == 8 and outs[0]["local_devices"] == 4
+    # both processes saw the identical full result
+    assert outs[0] == {**outs[1], "pid": 0}

@@ -83,19 +83,141 @@ class TestIciShuffle:
             .groupBy("k").agg(F.count("v").alias("c")),
             ICI)
 
-    def test_string_schema_falls_back_to_inprocess(self, session,
-                                                   eight_devices):
-        # strings are not eligible for the collective epoch; the exchange
-        # must silently use the in-process tier and still be correct
+    def _spy_exchange(self, monkeypatch):
+        """Wrap ici_hash_exchange so tests can assert the collective tier
+        actually engaged (the silent-fallback guard of SURVEY section 4)."""
+        from spark_rapids_tpu.shuffle import ici
+
+        calls = []
+        orig = ici.ici_hash_exchange
+
+        def spy(*a, **k):
+            calls.append(a[3])  # n partitions
+            return orig(*a, **k)
+
+        monkeypatch.setattr(ici, "ici_hash_exchange", spy)
+        return calls
+
+    def test_string_payload_over_ici(self, session, eight_devices,
+                                     monkeypatch):
+        # string columns ride the collective as padded fixed-width buckets
         from tests.harness import StringGen
 
+        calls = self._spy_exchange(monkeypatch)
         _check(
             session,
             lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=10)),
-                                 ("t", StringGen(max_len=6))],
+                                 ("t", StringGen(max_len=6, nullable=True))],
                              n=200, num_partitions=3)
-            .groupBy("k").agg(F.count("t").alias("c")),
+            .repartition(8, "k"),
             ICI)
+        assert calls, "ICI tier did not engage for a string payload"
+
+    def test_string_key_groupby_over_ici(self, session, eight_devices,
+                                         monkeypatch):
+        # a STRING key hashes from the exchanged matrix representation
+        from tests.harness import StringGen
+
+        calls = self._spy_exchange(monkeypatch)
+        _check(
+            session,
+            lambda s: gen_df(s, [("g", StringGen(max_len=5, nullable=True)),
+                                 ("v", IntGen(DataType.INT64,
+                                              lo=-500, hi=500))],
+                             n=400, num_partitions=4)
+            .groupBy("g").agg(F.sum("v").alias("s"),
+                              F.count("*").alias("c")),
+            ICI)
+        assert calls, "ICI tier did not engage for a string key"
+
+    def test_partitions_multiple_of_mesh(self, session, eight_devices,
+                                         monkeypatch):
+        # n = 16 partitions over an 8-device mesh: 2 partitions per chip,
+        # sub-split by the routed partition id
+        calls = self._spy_exchange(monkeypatch)
+        _check(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=50)),
+                                 ("v", IntGen(DataType.INT64))],
+                             n=600, num_partitions=4)
+            .groupBy("k").agg(F.sum("v").alias("s")),
+            {**ICI, "rapids.tpu.sql.shuffle.partitions": 16})
+        assert 16 in calls, calls
+
+    def test_partitions_divisor_of_mesh(self, session, eight_devices,
+                                        monkeypatch):
+        # n = 4 partitions over an 8-device mesh: chips 4..7 receive nothing
+        calls = self._spy_exchange(monkeypatch)
+        _check(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=50)),
+                                 ("v", IntGen(DataType.INT64))],
+                             n=600, num_partitions=4)
+            .groupBy("k").agg(F.sum("v").alias("s")),
+            {**ICI, "rapids.tpu.sql.shuffle.partitions": 4})
+        assert 4 in calls, calls
+
+    def test_join_then_groupby_chains_exchanges(self, session,
+                                                eight_devices):
+        # TWO chained collective exchanges: the second one's inputs are
+        # committed to different chips by the first (regression: cross-
+        # device jnp.stack in the exchange driver)
+        def q(s):
+            left = gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=30)),
+                              ("a", IntGen(DataType.INT64))],
+                          n=400, num_partitions=3, seed=5)
+            right = gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=30)),
+                               ("b", IntGen(DataType.INT64, lo=0, hi=9))],
+                           n=300, num_partitions=2, seed=6)
+            return (left.join(right, on="k", how="inner")
+                    .groupBy("b").agg(F.sum("a").alias("sa"),
+                                      F.count("*").alias("n")))
+
+        _check(session, q, {**ICI,
+                            "rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+
+    def test_string_join_over_ici(self, session, eight_devices):
+        from tests.harness import StringGen
+
+        def q(s):
+            left = gen_df(s, [("k", StringGen(max_len=4)),
+                              ("a", IntGen(DataType.INT64))],
+                          n=300, num_partitions=3, seed=7)
+            right = gen_df(s, [("k", StringGen(max_len=4)),
+                               ("b", IntGen(DataType.INT64))],
+                           n=200, num_partitions=2, seed=8)
+            return left.join(right, on="k", how="inner")
+
+        _check(session, q, {**ICI,
+                            "rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+
+    def test_string_key_expression_falls_back(self, session, eight_devices,
+                                              monkeypatch):
+        # a STRING key that is NOT a direct column reference cannot hash
+        # from the matrix representation: in-process tier, still correct
+        from tests.harness import StringGen
+        from spark_rapids_tpu.columnar.dtypes import DataType as DT
+        from spark_rapids_tpu.ops.base import AttributeReference
+        from spark_rapids_tpu.ops.stringops import Concat
+        from spark_rapids_tpu.shuffle import ici
+        from spark_rapids_tpu.shuffle.exchange import HashPartitioning
+
+        attrs = [AttributeReference("g", DT.STRING, True),
+                 AttributeReference("v", DT.INT64, True)]
+        good = HashPartitioning([attrs[0]], 8)
+        bad = HashPartitioning([Concat(attrs[0], attrs[0])], 8)
+        assert ici.supports_ici(good, attrs, 8)
+        assert not ici.supports_ici(bad, attrs, 8)
+
+        calls = self._spy_exchange(monkeypatch)
+        _check(
+            session,
+            lambda s: gen_df(s, [("g", StringGen(max_len=5)),
+                                 ("v", IntGen(DataType.INT64))],
+                             n=200, num_partitions=3)
+            .repartition(8, F.concat(F.col("g"), F.col("g"))),
+            ICI)
+        assert not calls, "expression string key must not take the ICI tier"
 
 
 # ---------------------------------------------------------------------------

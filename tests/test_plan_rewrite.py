@@ -199,3 +199,82 @@ def test_multi_partition_row_start(session):
         )
 
     assert_tpu_and_cpu_are_equal_collect(session, fn, ignore_order=True)
+
+
+def test_input_file_expr_poisons_coalesce(session, tmp_path):
+    """A plan evaluating input_file_name() must NOT have a coalesce between
+    the expression and its scan — merged batches would span file boundaries
+    (reference: GpuTransitionOverrides.scala:64-147 poisoning)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.exec.transitions import (
+        CpuCoalesceBatchesExec,
+        TpuCoalesceBatchesExec,
+    )
+
+    path = str(tmp_path / "poison.parquet")
+    pq.write_table(pa.table({"a": pa.array(np.arange(100))}), path)
+
+    def physical_for(expr_fn):
+        # scans set coalesce_after, so a plain projection normally gets a
+        # coalesce directly above the scan — exactly the edge the
+        # input-file expression must poison
+        df = session.read.parquet(path).select(
+            F.col("a"), expr_fn().alias("f"))
+        session.set_conf("rapids.tpu.sql.enabled", True)
+        return session._physical_plan(df._plan)
+
+    poisoned = physical_for(F.input_file_name)
+    found = []
+    poisoned.foreach(lambda n: found.append(type(n).__name__))
+    assert "TpuCoalesceBatchesExec" not in found, found
+    assert "CpuCoalesceBatchesExec" not in found, found
+
+    # and WITHOUT the input-file expression the coalesce is still inserted
+    plain = physical_for(lambda: F.spark_partition_id())
+    found2 = []
+    plain.foreach(lambda n: found2.append(type(n).__name__))
+    assert "TpuCoalesceBatchesExec" in found2 or \
+        "CpuCoalesceBatchesExec" in found2, found2
+
+    # poisoning must not leak ABOVE an exchange (new input): the
+    # post-exchange coalesce of a groupBy stays
+    df3 = (session.read.parquet(path)
+           .select(F.col("a"), F.input_file_name().alias("f"))
+           .groupBy("a").agg(F.count("*").alias("c")))
+    found3 = []
+    session._physical_plan(df3._plan).foreach(
+        lambda n: found3.append(type(n).__name__))
+    assert "TpuCoalesceBatchesExec" in found3, found3
+
+
+def test_hash_optimize_sort_inserted(session):
+    """With hashOptimizeSort enabled, the write input of a hash aggregate
+    gains a sort over the grouping keys (reference: HashSortOptimizeSuite /
+    GpuTransitionOverrides.scala:171-204)."""
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.plan.transition_overrides import (
+        insert_hash_optimize_sort,
+    )
+
+    df = session.range(0, 100, num_partitions=2)
+    agg = df.groupBy("id").agg(F.count("*").alias("c"))
+    session.set_conf("rapids.tpu.sql.enabled", True)
+    physical = session._physical_plan(agg._plan)
+
+    session.set_conf("rapids.tpu.sql.hashOptimizeSort.enabled", True)
+    try:
+        sorted_plan = insert_hash_optimize_sort(physical, session.conf)
+        names = []
+        sorted_plan.foreach(lambda n: names.append(type(n).__name__))
+        assert "TpuSortExec" in names, names
+        # disabled -> untouched
+        session.set_conf("rapids.tpu.sql.hashOptimizeSort.enabled", False)
+        plain = insert_hash_optimize_sort(physical, session.conf)
+        names2 = []
+        plain.foreach(lambda n: names2.append(type(n).__name__))
+        assert "TpuSortExec" not in names2
+    finally:
+        session.set_conf("rapids.tpu.sql.hashOptimizeSort.enabled", False)

@@ -9,8 +9,8 @@ from spark_rapids_tpu.benchmarks import tpch
 from tests.harness import assert_tpu_and_cpu_are_equal_collect
 
 
-@pytest.mark.parametrize("qname", ["q1", "q3", "q4", "q5", "q6",
-                                   "q10", "q12", "q14", "q19"])
+@pytest.mark.parametrize("qname", sorted(tpch.QUERIES,
+                                         key=lambda q: int(q[1:])))
 def test_tpch_query_equivalence(session, qname):
     def q(s):
         tables = tpch.gen_tables(s, sf=0.0005, num_partitions=3)

@@ -198,3 +198,77 @@ def test_range_finite_lower_falls_back(session):
     assert_tpu_fallback_collect(
         session, _w(_kv(), F.min("v").over(w)),
         fallback_exec="CpuWindowExec", ignore_order=True)
+
+
+def test_range_bounded_sum(session):
+    # RANGE BETWEEN 5 PRECEDING AND 5 FOLLOWING over one numeric order col
+    # (reference: GpuWindowExpression.scala:457-683 bounded range frames)
+    w = Window.partitionBy("k").orderBy("v").rangeBetween(-5, 5)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.sum("x").over(w), F.count("x").over(w)),
+        ignore_order=True)
+
+
+def test_range_bounded_preceding_only(session):
+    # RANGE BETWEEN 10 PRECEDING AND CURRENT ROW (ties share frames)
+    w = Window.partitionBy("k").orderBy("x").rangeBetween(-10, 0)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.sum("v").over(w)), ignore_order=True)
+
+
+def test_range_bounded_desc_order(session):
+    w = Window.partitionBy("k").orderBy(F.col("v").desc()).rangeBetween(-7, 3)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.count("x").over(w), F.avg("x").over(w)),
+        ignore_order=True, approx_float=1e-6)
+
+
+def test_range_bounded_with_null_order_keys(session):
+    # NULL order keys frame exactly their peer (null) group
+    def gen(s):
+        return gen_df(
+            s, [("k", IntGen(DataType.INT32, lo=0, hi=4)),
+                ("v", IntGen(DataType.INT64, lo=-50, hi=50, nullable=True)),
+                ("x", IntGen(DataType.INT32, lo=0, hi=30))],
+            n=160, num_partitions=3)
+
+    w = Window.partitionBy("k").orderBy("v").rangeBetween(-4, 4)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(gen, F.sum("x").over(w)), ignore_order=True)
+
+
+def test_range_current_row_to_following(session):
+    w = Window.partitionBy("k").orderBy("v").rangeBetween(0, 20)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.sum("x").over(w)), ignore_order=True)
+
+
+def test_range_bounded_two_order_cols_rejected(session):
+    # two ORDER BY columns cannot define a value distance: rejected on
+    # BOTH engines (Spark raises an analysis error for this shape too)
+    w = Window.partitionBy("k").orderBy("v", "x").rangeBetween(-5, 5)
+    df_fn = _w(_kv(), F.sum("x").over(w))
+    session.set_conf("rapids.tpu.sql.enabled", False)
+    with pytest.raises(Exception, match="ORDER BY"):
+        df_fn(session).collect()
+    session.set_conf("rapids.tpu.sql.enabled", True)
+    with pytest.raises(Exception, match="ORDER BY"):
+        df_fn(session).collect()
+
+
+def test_range_half_unbounded_with_nulls(session):
+    # UNBOUNDED PRECEDING .. 5 FOLLOWING with NULL order keys: the
+    # unbounded side reaches the partition edge (including the null block),
+    # the finite side excludes null keys — identically on both engines
+    def gen(s):
+        return gen_df(
+            s, [("k", IntGen(DataType.INT32, lo=0, hi=4)),
+                ("v", IntGen(DataType.INT64, lo=-40, hi=40, nullable=True)),
+                ("x", IntGen(DataType.INT32, lo=0, hi=25))],
+            n=150, num_partitions=3)
+
+    w_lo = Window.partitionBy("k").orderBy("v").rangeBetween(None, 5)
+    w_hi = Window.partitionBy("k").orderBy("v").rangeBetween(-5, None)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(gen, F.sum("x").over(w_lo), F.count("x").over(w_hi)),
+        ignore_order=True)

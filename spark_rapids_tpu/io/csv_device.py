@@ -13,11 +13,15 @@ the parquet device decoder (io/parquet_device.py):
   jitted kernel gathers up to MAXW bytes per field and folds digits into
   int64 — the conversion FLOPs happen on the accelerator.
 
-Scope (v1): integral columns (INT8..INT64) in structurally simple files —
-no quoted fields (a quote char anywhere falls back to host Arrow), regular
-column count per line. Empty fields are NULL (pyarrow's
-strings_can_be_null oracle behavior); malformed digits are NULL
-(Spark's permissive-mode behavior).
+Scope: integral columns (INT8..INT64) and — where the backend has f64 —
+FLOAT32/FLOAT64 columns with plain decimal literals (sign, digits, one
+dot; <= 15 significant digits and <= 22 fractional digits, so the single
+f64 division is correctly rounded and bit-identical to the host parser;
+exponents/inf/nan take the host path). Quoted fields are handled
+structurally (quote-aware boundary scan + quote stripping; escaped ""
+falls back). Regular column count per line. Empty fields are NULL
+(pyarrow's strings_can_be_null oracle behavior); malformed digits abandon
+the device path for the split so both engines behave identically.
 """
 
 from __future__ import annotations
@@ -33,7 +37,8 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.dtypes import DataType
 
-MAXW = 20  # int64: up to 19 digits + sign
+MAXW = 20   # int64: up to 19 digits + sign
+MAXW_F = 24  # float: sign + 15 digits + dot (+ slack)
 
 _NL = 0x0A
 _CR = 0x0D
@@ -43,6 +48,8 @@ _PLUS = 0x2B
 _ZERO = 0x30
 
 INTEGRAL = (DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64)
+FLOATS = (DataType.FLOAT32, DataType.FLOAT64)
+_DOT = 0x2E
 
 
 class FieldTable:
@@ -77,9 +84,13 @@ def plan_fields(data: bytes, ncols: int, header: bool,
     sep_b = ord(sep)
     if sep_b in (_NL, _CR, _QUOTE):
         return None
-    res = _plan_fields_native(data, ncols, sep_b)
-    if res is NotImplemented:
-        res = _plan_fields_py(data, ncols, sep_b)
+    if b'"' in data:
+        # quote-aware boundary scan lives only in the numpy path
+        res = _plan_fields_quoted(data, ncols, sep_b)
+    else:
+        res = _plan_fields_native(data, ncols, sep_b)
+        if res is NotImplemented:
+            res = _plan_fields_py(data, ncols, sep_b)
     if res is None:
         return None
     arr, starts, lens, n_lines = res
@@ -110,6 +121,66 @@ def _plan_fields_native(data: bytes, ncols: int, sep_b: int):
     arr = np.frombuffer(data, dtype=np.uint8)
     return (arr, starts[:n_lines * ncols].reshape(n_lines, ncols),
             lens[:n_lines * ncols].reshape(n_lines, ncols), n_lines)
+
+
+def _plan_fields_quoted(data: bytes, ncols: int, sep_b: int):
+    """Quote-aware boundary scan (reference: cudf's quoted-field tokenizer
+    behind GpuBatchScanExec.scala:322-520). Separators/newlines inside
+    quotes are not boundaries; fully-quoted fields strip their quotes.
+    Escaped "" inside a field (quote count != 2 per quoted field) -> None
+    (host fallback), since unescaping would rewrite bytes."""
+    arr = np.frombuffer(data, dtype=np.uint8)
+    is_q = arr == _QUOTE
+    # inside[i]: byte i lies inside a quoted section (after an odd number
+    # of quotes). A quote toggles state AFTER itself.
+    inside = (np.cumsum(is_q) - is_q) % 2 == 1
+    is_bound = ((arr == sep_b) | (arr == _NL)) & ~inside & ~is_q
+    bpos = np.flatnonzero(is_bound).astype(np.int64)
+    if arr[-1] != _NL:
+        bpos = np.append(bpos, len(arr))
+    n_fields = len(bpos)
+    if n_fields % ncols != 0:
+        return None
+    n_lines = n_fields // ncols
+    ends = bpos.reshape(n_lines, ncols)
+    interior = ends[:, :-1].ravel()
+    if interior.size and (arr[interior] == _NL).any():
+        return None
+    line_final = ends[:, -1]
+    real = line_final[line_final < len(arr)]
+    if real.size and (arr[real] != _NL).any():
+        return None
+    starts = np.empty_like(ends)
+    starts[:, 0] = np.concatenate(([0], ends[:-1, -1] + 1))
+    starts[:, 1:] = ends[:, :-1] + 1
+    lens = ends - starts
+    last_ends = ends[:, -1]
+    has_cr = np.zeros(n_lines, dtype=bool)
+    nonempty = lens[:, -1] > 0
+    prev = np.clip(last_ends - 1, 0, len(arr) - 1)
+    has_cr[nonempty] = arr[prev[nonempty]] == _CR
+    lens[:, -1] -= has_cr.astype(np.int32)
+    # strip full surrounding quotes; any other quote layout -> fallback
+    fs = starts.ravel()
+    fl = lens.ravel()
+    first_q = np.zeros(fs.shape, dtype=bool)
+    last_q = np.zeros(fs.shape, dtype=bool)
+    nz = fl >= 2
+    first_q[nz] = arr[fs[nz]] == _QUOTE
+    last_q[nz] = arr[np.clip(fs[nz] + fl[nz] - 1, 0,
+                             len(arr) - 1)] == _QUOTE
+    quoted = first_q & last_q
+    # per-field quote counts must be exactly 2 (quoted) or 0 (bare):
+    # cum-count difference per field span
+    qcum = np.concatenate(([0], np.cumsum(is_q)))
+    qcnt = qcum[np.clip(fs + fl, 0, len(arr))] - qcum[np.clip(fs, 0,
+                                                              len(arr))]
+    if not np.all((quoted & (qcnt == 2)) | (~quoted & (qcnt == 0))):
+        return None
+    fs = fs + quoted.astype(np.int64)
+    fl = fl - 2 * quoted.astype(np.int64)
+    return (arr, fs.reshape(n_lines, ncols).astype(np.int64),
+            fl.reshape(n_lines, ncols).astype(np.int64), n_lines)
 
 
 def _plan_fields_py(data: bytes, ncols: int, sep_b: int):
@@ -218,6 +289,72 @@ def _parse_int_kernel(raw, starts, lens, maxw: int):
     return jnp.where(validity, val, 0), validity, malformed
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _parse_float_kernel(raw, starts, lens, maxw: int):
+    """Plain decimal floats: [-] digits [. digits], <= 15 significant
+    digits and <= 22 fractional digits. The value is mantissa / 10^scale in
+    ONE f64 division — both operands are exact, so the result is the
+    correctly-rounded double of the literal, bit-identical to the host
+    parser. Exponents / inf / nan / longer literals are MALFORMED (the
+    caller host-falls-back for the split; the host parses them fine)."""
+    idx = starts[:, None].astype(jnp.int32) + \
+        jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    ch = raw[jnp.clip(idx, 0, raw.shape[0] - 1)]
+    inb = jnp.arange(maxw, dtype=jnp.int32)[None, :] < lens[:, None]
+    ch = jnp.where(inb, ch, 0)
+    neg = ch[:, 0] == _MINUS
+    skip = neg.astype(jnp.int32)
+    digits = ch.astype(jnp.int32) - _ZERO
+    isdig = (digits >= 0) & (digits <= 9)
+    isdot = ch == _DOT
+    pos = jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    body = (pos >= skip[:, None]) & inb
+    # exactly 0 or 1 dots; everything else in the body must be a digit
+    ndots = jnp.sum((body & isdot).astype(jnp.int32), axis=1)
+    ok_chars = jnp.all(jnp.where(body, isdig | isdot, True), axis=1)
+    dotpos = jnp.argmax(body & isdot, axis=1).astype(jnp.int32)
+    has_dot = ndots == 1
+    # fractional digit count; mantissa = all digits folded in order
+    frac = jnp.where(has_dot, lens - 1 - dotpos, 0)
+    ndig = lens - skip - has_dot.astype(jnp.int32)
+    m = jnp.zeros(starts.shape[0], dtype=jnp.int64)
+    for i in range(maxw):
+        d = jnp.where(isdig[:, i], digits[:, i], 0).astype(jnp.int64)
+        m = jnp.where(body[:, i] & isdig[:, i], m * 10 + d, m)
+    ok = ok_chars & (ndots <= 1) & (ndig > 0) & (ndig <= 15) & \
+        (frac >= 0) & (frac <= 22) & (lens <= maxw)
+    p10 = jnp.asarray([10.0 ** k for k in range(23)], dtype=jnp.float64)
+    val = m.astype(jnp.float64) / p10[jnp.clip(frac, 0, 22)]
+    val = jnp.where(neg, -val, val)
+    nonempty = lens > 0
+    validity = ok & nonempty
+    malformed = nonempty & ~validity
+    return jnp.where(validity, val, 0.0), validity, malformed
+
+
+def decode_float_column(table: FieldTable, col_idx: int, dtype: DataType,
+                        cap: int):
+    """Parse one float column on device, padded to `cap` rows (same
+    contract as decode_int_column)."""
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    n = table.num_rows
+    starts = np.zeros(cap, dtype=np.int32)
+    lens = np.zeros(cap, dtype=np.int32)
+    starts[:n] = table.starts[:, col_idx]
+    lens[:n] = table.lens[:, col_idx]
+    row_mask = jnp.arange(cap) < n
+    val, validity, malformed = _parse_float_kernel(table.device_raw(),
+                                                   jnp.asarray(starts),
+                                                   jnp.asarray(lens),
+                                                   MAXW_F)
+    malformed = malformed & row_mask
+    npdt = physical_np_dtype(dtype)
+    if npdt != np.dtype(np.float64):
+        val = val.astype(npdt)
+    return val, validity & row_mask, jnp.any(malformed)
+
+
 def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
                       cap: int):
     """Parse one integral column on device, padded to `cap` rows. Returns
@@ -247,6 +384,27 @@ def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
     return val, validity & row_mask, jnp.any(malformed)
 
 
+def device_parseable(dtype: DataType) -> bool:
+    if dtype in INTEGRAL:
+        return True
+    if dtype is DataType.FLOAT64:
+        # the exact-rounding argument needs a real f64 division on device.
+        # FLOAT32 stays on the host: parse-f64-then-narrow double-rounds,
+        # which can differ from Arrow's direct decimal->float32 conversion
+        # on midpoint-adjacent literals.
+        from spark_rapids_tpu.columnar.batch import device_float64_supported
+
+        return device_float64_supported()
+    return False
+
+
+def decode_column(table: FieldTable, col_idx: int, dtype: DataType,
+                  cap: int):
+    if dtype in FLOATS:
+        return decode_float_column(table, col_idx, dtype, cap)
+    return decode_int_column(table, col_idx, dtype, cap)
+
+
 def eligible_attrs(attrs, header_names: Optional[List[str]],
                    attr_names_in_file_order: List[str]) -> dict:
     """Map attr name -> file column index for device-parseable columns."""
@@ -254,6 +412,6 @@ def eligible_attrs(attrs, header_names: Optional[List[str]],
         else attr_names_in_file_order
     out = {}
     for a in attrs:
-        if a.data_type in INTEGRAL and a.name in order:
+        if device_parseable(a.data_type) and a.name in order:
             out[a.name] = order.index(a.name)
     return out

@@ -62,9 +62,12 @@ def schema_encodable(attrs) -> bool:
 # Device kernels
 # ---------------------------------------------------------------------------
 @jax.jit
-def _compact_zigzag(data, validity):
+def _compact_zigzag(data, validity, num_rows):
     """Dense non-null values in row order, zigzag-encoded to uint64, plus
-    the present count and the max encoded value (for the width pick)."""
+    the present count and the max encoded value (for the width pick).
+    Validity is row-masked first — padding lanes must never contribute
+    (same guard as the parquet encoder, parquet_encode_device.py)."""
+    validity = validity & (jnp.arange(validity.shape[0]) < num_rows)
     order = jnp.argsort(~validity, stable=True)
     dense = data.astype(jnp.int64)[order]
     u = ((dense << 1) ^ (dense >> 63)).astype(jnp.uint64)
@@ -141,16 +144,8 @@ def _present_stream(bitmap: bytes) -> bytes:
     return bytes(out)
 
 
-def _uvarint(x: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = x & 0x7F
-        x >>= 7
-        if x:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+# varint shared with the parquet thrift writer (same LEB128 wire format)
+from spark_rapids_tpu.io.parquet_encode_device import _uvarint  # noqa: E402
 
 
 def _fv(fnum: int, v: int) -> bytes:
@@ -174,7 +169,8 @@ def _encode_stripe(attrs, batch: ColumnarBatch) -> Tuple[bytes, bytes, int]:
     for ci, a in enumerate(attrs):
         cv = batch.columns[ci]
         validity = cv.validity
-        u, n, max_u = _compact_zigzag(cv.data, validity)
+        u, n, max_u = _compact_zigzag(cv.data, validity,
+                                      jnp.int32(n_rows))
         n, max_u = int(jax.device_get(n)), int(jax.device_get(max_u))
         has_nulls = n != n_rows
         if has_nulls:

@@ -369,7 +369,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
 
         pv = dict(split.partition_values)
         data_attrs = [a for a in self.attrs if a.name not in pv]
-        if not any(a.data_type in CD.INTEGRAL for a in data_attrs):
+        if not any(CD.device_parseable(a.data_type) for a in data_attrs):
             return None
         header = _to_bool(split.opt("header", False))
         sep = split.opt("sep", split.opt("delimiter", ","))
@@ -407,8 +407,8 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         for a in data_attrs:
             if a.name not in eligible:
                 continue
-            d, v, bad = CD.decode_int_column(table, eligible[a.name],
-                                             a.data_type, cap)
+            d, v, bad = CD.decode_column(table, eligible[a.name],
+                                         a.data_type, cap)
             malformed_flags.append(bad)
             dev_cols[a.name] = ColumnVector(a.data_type, d, v)
         if malformed_flags and any(

@@ -194,8 +194,14 @@ def insert_hash_optimize_sort(plan: PhysicalExec,
             return n
         keys = sort_keys(n)
         if keys and _effective_placement(n) == "tpu":
+            from spark_rapids_tpu.exec.transitions import RequireSingleBatch
+
             orders = [SortOrder(k, True) for k in keys]
-            return TpuSortExec(orders, n)
+            # this pass runs after coalesce insertion, so the sort's
+            # single-batch requirement must be materialized here — a
+            # per-batch sort would not cluster keys across batches
+            return TpuSortExec(
+                orders, TpuCoalesceBatchesExec(RequireSingleBatch(), n))
         return n
 
     return rewrite(plan)

@@ -30,9 +30,43 @@ def test_plan_fields_header():
     assert t.num_rows == 1
 
 
-def test_plan_fields_rejects_quotes_and_ragged():
-    assert CD.plan_fields(b'a,"x,y"\n1,2\n', 2, header=False) is None
+def test_plan_fields_quoted_and_ragged():
+    # quoted fields plan structurally: separators inside quotes are not
+    # boundaries, surrounding quotes strip
+    t = CD.plan_fields(b'a,"x,y"\n1,2\n', 2, header=False)
+    assert t is not None and t.num_rows == 2
+    raw = t.raw.tobytes()
+    f01 = raw[t.starts[0, 1]:t.starts[0, 1] + t.lens[0, 1]]
+    assert f01 == b"x,y"  # quotes stripped, comma kept
+    # escaped "" inside a quoted field -> host fallback
+    assert CD.plan_fields(b'a,"x""y"\n1,2\n', 2, header=False) is None
+    # ragged -> host fallback
     assert CD.plan_fields(b"1,2\n3\n", 2, header=False) is None
+
+
+def test_decode_float_column_values():
+    t = CD.plan_fields(b"1.5,x\n-0.25,y\n,z\n123,w\n0.0001,v\n", 2,
+                       header=False)
+    assert t is not None
+    import jax
+
+    d, v, bad = CD.decode_float_column(t, 0, DataType.FLOAT64, 8)
+    assert not bool(jax.device_get(bad))
+    vals = jax.device_get(d)
+    valid = jax.device_get(v)
+    assert list(valid[:5]) == [True, True, False, True, True]
+    assert vals[0] == 1.5 and vals[1] == -0.25
+    assert vals[3] == 123.0 and vals[4] == 0.0001
+
+
+def test_decode_float_exponent_aborts_device_path():
+    # exponents are host-parser territory: malformed flag set
+    t = CD.plan_fields(b"1e5,x\n2.0,y\n", 2, header=False)
+    assert t is not None
+    import jax
+
+    _d, _v, bad = CD.decode_float_column(t, 0, DataType.FLOAT64, 4)
+    assert bool(jax.device_get(bad))
 
 
 def test_decode_int_column_values():
@@ -136,6 +170,41 @@ def test_csv_quoted_falls_back_correct(session, tmp_path):
 
     def q(s):
         return s.read.schema([("a", "long"), ("b", "string")]) \
+            .csv(path, header=True).orderBy("a")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_csv_float_scan_equivalence(session, tmp_path):
+    # floats parse ON device (f64 backends): engine results match the
+    # pyarrow host oracle bit-for-bit for the plain-decimal subset
+    import numpy as np
+
+    rng = np.random.default_rng(4)
+    lines = ["a,f"]
+    for i in range(400):
+        v = rng.integers(-10**6, 10**6)
+        lines.append(f"{i},{v / 1000.0}")
+    lines.append("401,")  # trailing NULL float
+    path = _write(tmp_path, "f.csv", "\n".join(lines) + "\n")
+
+    def q(s):
+        return (s.read.schema([("a", "long"), ("f", "double")])
+                .csv(path, header=True)
+                .filter(F.col("f") > -100.5)
+                .groupBy().agg(F.sum("f").alias("sf"),
+                               F.count("f").alias("n")))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_csv_quoted_ints_parse_on_device(session, tmp_path):
+    # fully-quoted numeric fields: structural quote handling + device parse
+    path = _write(tmp_path, "qi.csv",
+                  'a,b\n"1","10"\n2,"20"\n"3",30\n')
+
+    def q(s):
+        return s.read.schema([("a", "long"), ("b", "long")]) \
             .csv(path, header=True).orderBy("a")
 
     assert_tpu_and_cpu_are_equal_collect(session, q)

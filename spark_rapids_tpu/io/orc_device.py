@@ -14,10 +14,12 @@ decoder (io/parquet_device.py):
   prefix-sum for DELTA, bit extraction for PRESENT — so the decode work
   happens on the accelerator and the upload is the encoded stream.
 
-Scope: UNCOMPRESSED files, SHORT/INT/LONG (+DATE) columns with DIRECT_V2
-encoding, RLEv2 sub-encodings SHORT_REPEAT / DIRECT / DELTA (PATCHED_BASE
-falls back), value widths <= 32 bits. Arrow remains the oracle and the
-fallback for everything else.
+Scope: UNCOMPRESSED, ZLIB and SNAPPY files (compressed streams block-
+decompress on the HOST — control-plane work — and the normalized stripe
+image feeds the identical device expansion), SHORT/INT/LONG (+DATE)
+columns with DIRECT_V2 encoding, RLEv2 sub-encodings SHORT_REPEAT /
+DIRECT / DELTA (PATCHED_BASE falls back), value widths <= 32 bits. Arrow
+remains the oracle and the fallback for everything else.
 """
 
 from __future__ import annotations
@@ -98,7 +100,7 @@ class StripeInfo:
 
 @dataclass
 class OrcMeta:
-    compression: int = 0            # 0 = NONE
+    compression: int = 0            # 0=NONE 1=ZLIB 2=SNAPPY
     stripes: List[StripeInfo] = field(default_factory=list)
     # column id -> (type kind, name); id 0 is the struct root
     kinds: List[int] = field(default_factory=list)
@@ -112,6 +114,55 @@ _INT_KINDS = {K_SHORT, K_INT, K_LONG, K_DATE}
 
 # stream kinds
 S_PRESENT, S_DATA = 0, 1
+
+# compression kinds (orc_proto CompressionKind)
+COMP_NONE, COMP_ZLIB, COMP_SNAPPY = 0, 1, 2
+SUPPORTED_COMPRESSION = {COMP_NONE, COMP_ZLIB, COMP_SNAPPY}
+
+
+def _snappy_raw_len(chunk: bytes) -> int:
+    """Uncompressed length from a raw-snappy block's leading varint."""
+    out = shift = 0
+    for i in range(min(5, len(chunk))):
+        b = chunk[i]
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out
+        shift += 7
+    raise _Unsupported("malformed snappy length")
+
+
+def decompress_blocks(raw, start: int, length: int, kind: int) -> bytes:
+    """Decompress one ORC compressed stream: a sequence of blocks, each
+    with a 3-byte little-endian header (len << 1 | is_original). HOST
+    control plane — the decompressed bytes feed the same run-table parse
+    and device expansion as an uncompressed file."""
+    out = bytearray()
+    pos, end = start, start + length
+    while pos < end:
+        if pos + 3 > end:
+            raise _Unsupported("truncated compressed stream")
+        h = raw[pos] | (raw[pos + 1] << 8) | (raw[pos + 2] << 16)
+        pos += 3
+        blen = h >> 1
+        if pos + blen > end:
+            raise _Unsupported("compressed block overruns stream")
+        chunk = bytes(raw[pos:pos + blen])
+        pos += blen
+        if h & 1:           # original (stored) block
+            out += chunk
+        elif kind == COMP_ZLIB:
+            import zlib
+
+            out += zlib.decompress(chunk, -15)  # raw deflate per ORC spec
+        elif kind == COMP_SNAPPY:
+            import pyarrow as pa
+
+            out += pa.Codec("snappy").decompress(
+                chunk, _snappy_raw_len(chunk)).to_pybytes()
+        else:
+            raise _Unsupported(f"compression kind {kind}")
+    return bytes(out)
 
 
 def tail_compression(tail: bytes) -> int:
@@ -131,7 +182,8 @@ def tail_compression(tail: bytes) -> int:
 
 
 def parse_file_meta(raw: bytes) -> OrcMeta:
-    """PostScript -> Footer (tail metadata of an uncompressed ORC file)."""
+    """PostScript -> Footer (the PostScript is never compressed; the
+    Footer block-decompresses first for ZLIB/SNAPPY files)."""
     if len(raw) < 16 or raw[:3] != b"ORC":
         raise _Unsupported("not an ORC file")
     psl = raw[-1]
@@ -143,12 +195,17 @@ def parse_file_meta(raw: bytes) -> OrcMeta:
             footer_len = v
         elif fnum == 2:
             compression = v
-    if compression != 0:
-        raise _Unsupported("compressed ORC (device path is uncompressed-only)")
+    if compression not in SUPPORTED_COMPRESSION:
+        raise _Unsupported(f"ORC compression kind {compression}")
     fstart = len(raw) - 1 - psl - footer_len
+    if compression != COMP_NONE:
+        fbuf = decompress_blocks(raw, fstart, footer_len, compression)
+        fstart, footer_len = 0, len(fbuf)
+    else:
+        fbuf = raw
     meta = OrcMeta(compression=compression)
     root_subtypes: List[int] = []
-    for fnum, _wt, v in _Proto(raw, fstart, fstart + footer_len).fields():
+    for fnum, _wt, v in _Proto(fbuf, fstart, fstart + footer_len).fields():
         if fnum == 3:  # StripeInformation
             si = StripeInfo()
             for f2, _w2, v2 in _Proto(v).fields():
@@ -202,15 +259,15 @@ class StreamLoc:
     length: int
 
 
-def parse_stripe_footer(raw: bytes, si: StripeInfo
+def _walk_stripe_footer(fbuf, fstart: int, fend: int, base_pos: int
                         ) -> Tuple[List[StreamLoc], Dict[int, int]]:
-    """StripeFooter -> data-area stream locations + column encodings."""
-    fstart = si.offset + si.index_length + si.data_length
+    """StripeFooter protobuf -> stream locations (physical, laid out from
+    base_pos in declaration order) + column encodings."""
     streams: List[StreamLoc] = []
     encodings: Dict[int, int] = {}
     col_i = 0
-    pos = si.offset  # streams laid out from stripe start (index then data)
-    for fnum, _wt, v in _Proto(raw, fstart, fstart + si.footer_length).fields():
+    pos = base_pos
+    for fnum, _wt, v in _Proto(fbuf, fstart, fend).fields():
         if fnum == 1:  # Stream
             kind = column = length = 0
             for f2, _w2, v2 in _Proto(v).fields():
@@ -230,6 +287,42 @@ def parse_stripe_footer(raw: bytes, si: StripeInfo
             encodings[col_i] = enc
             col_i += 1
     return streams, encodings
+
+
+def parse_stripe_footer(raw: bytes, si: StripeInfo
+                        ) -> Tuple[List[StreamLoc], Dict[int, int]]:
+    """StripeFooter -> data-area stream locations + column encodings
+    (uncompressed files: absolute offsets into `raw`)."""
+    fstart = si.offset + si.index_length + si.data_length
+    return _walk_stripe_footer(raw, fstart, fstart + si.footer_length,
+                               si.offset)
+
+
+def normalize_stripe(region: bytes, si: StripeInfo, compression: int,
+                     columns: Optional[set] = None
+                     ) -> Tuple[bytes, List[StreamLoc], Dict[int, int]]:
+    """Decompress one stripe's PRESENT/DATA streams into a contiguous
+    uncompressed image (HOST control plane). `region` is the stripe's
+    bytes [si.offset, si.offset + index + data + footer). `columns`
+    restricts the image to those column ids (ineligible columns re-read
+    via the host path, so decompressing/uploading them is pure waste).
+    Returned StreamLocs index into the image; callers plan with
+    stripe_base=0 and upload the image — the device data plane is
+    identical to an uncompressed file's."""
+    fstart = si.index_length + si.data_length
+    fbuf = decompress_blocks(region, fstart, si.footer_length, compression)
+    phys, encodings = _walk_stripe_footer(fbuf, 0, len(fbuf), 0)
+    norm = bytearray()
+    out_streams: List[StreamLoc] = []
+    for s in phys:
+        if s.kind in (S_PRESENT, S_DATA) and \
+                (columns is None or s.column in columns):
+            payload = decompress_blocks(region, s.start, s.length,
+                                        compression)
+            out_streams.append(StreamLoc(s.kind, s.column, len(norm),
+                                         len(payload)))
+            norm += payload
+    return bytes(norm), out_streams, encodings
 
 
 # ---------------------------------------------------------------------------

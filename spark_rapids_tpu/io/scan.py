@@ -449,12 +449,14 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         data_attrs = [a for a in self.attrs if a.name not in pv]
         try:
             with open(split.path, "rb") as f:
-                # tail-first: reject compressed files (the common on-disk
-                # case) from the PostScript alone, before a full-file read
+                # tail-first: reject unsupported codecs from the PostScript
+                # alone, before a full-file read (zlib/snappy streams
+                # decompress on the host into the device expansion)
                 f.seek(0, os.SEEK_END)
                 size = f.tell()
                 f.seek(max(0, size - 4096))
-                if OD.tail_compression(f.read()) != 0:
+                if OD.tail_compression(f.read()) not in \
+                        OD.SUPPORTED_COMPRESSION:
                     return None
                 f.seek(0)
                 raw = f.read()
@@ -473,12 +475,25 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         stripe_plans = []
         try:
             for si in meta.stripes:
-                streams, encs = OD.parse_stripe_footer(raw, si)
-                plans = {
-                    a.name: OD.plan_column(raw, streams, encs,
-                                           name_to_cid[a.name],
-                                           si.num_rows, si.offset)
-                    for a in eligible}
+                if meta.compression != 0:
+                    region = raw[si.offset:
+                                 si.offset + si.index_length +
+                                 si.data_length + si.footer_length]
+                    norm, streams, encs = OD.normalize_stripe(
+                        region, si, meta.compression,
+                        {name_to_cid[a.name] for a in eligible})
+                    plans = {
+                        a.name: OD.plan_column(norm, streams, encs,
+                                               name_to_cid[a.name],
+                                               si.num_rows, 0)
+                        for a in eligible}
+                else:
+                    streams, encs = OD.parse_stripe_footer(raw, si)
+                    plans = {
+                        a.name: OD.plan_column(raw, streams, encs,
+                                               name_to_cid[a.name],
+                                               si.num_rows, si.offset)
+                        for a in eligible}
                 stripe_plans.append(plans)
         except Exception:
             return None  # unsupported shape anywhere: whole-split fallback
@@ -488,10 +503,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         # scan is one stripe, not the file
         del raw
         return self._orc_stripe_batches(split, meta, stripe_plans,
-                                        eligible, rest, pv, conf)
+                                        eligible, rest, pv, conf,
+                                        {name_to_cid[a.name]
+                                         for a in eligible})
 
     def _orc_stripe_batches(self, split, meta, stripe_plans, eligible,
-                            rest, pv, conf):
+                            rest, pv, conf, eligible_cids=None):
         """Phase 2 generator: per-stripe read + upload + expand + yield."""
         import jax.numpy as jnp
 
@@ -508,7 +525,15 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
             TpuSemaphore.get().acquire_if_necessary(current_task_id())
             with open(split.path, "rb") as f:
                 f.seek(si.offset)
-                region = f.read(si.index_length + si.data_length)
+                region = f.read(si.index_length + si.data_length +
+                                si.footer_length)
+            if meta.compression != 0:
+                # deterministic re-normalization over the SAME column set:
+                # plan offsets index the same decompressed image (peak host
+                # memory stays one stripe; decompression is host
+                # control-plane work)
+                region, _streams, _encs = OD.normalize_stripe(
+                    region, si, meta.compression, eligible_cids)
             stripe_dev = jnp.asarray(np.frombuffer(region, dtype=np.uint8))
             dev_cols = {}
             for a in eligible:

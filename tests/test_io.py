@@ -550,7 +550,42 @@ class TestDeviceParquetDecode:
         assert_tpu_and_cpu_are_equal_collect(
             session, lambda s: s.read.orc(path), ignore_order=True)
 
-    def test_orc_compressed_falls_back_correct(self, session, tmp_path):
+    def test_orc_compressed_decodes_on_device(self, session, tmp_path,
+                                              monkeypatch):
+        # zlib/snappy ORC: host block decompression feeds the same device
+        # expansion — the device path must ENGAGE, not silently fall back
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.orc as po
+
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.io import orc_device as OD
+
+        calls = []
+        orig = OD.normalize_stripe
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(OD, "normalize_stripe", spy)
+        rng = np.random.default_rng(6)
+        tbl = pa.table({
+            "a": pa.array(np.arange(3000, dtype=np.int64)),
+            "b": pa.array(rng.integers(-2000, 2000, 3000)
+                          .astype(np.int32)),
+            "n": pa.array([int(x) if x % 6 else None for x in range(3000)],
+                          type=pa.int64()),
+        })
+        for comp in ("zlib", "snappy"):
+            path = str(tmp_path / f"{comp}.orc")
+            po.write_table(tbl, path, compression=comp)
+            calls.clear()
+            assert_tpu_and_cpu_are_equal_collect(
+                session, lambda s: s.read.orc(path), ignore_order=True)
+            assert calls, f"{comp}: device decode did not engage"
+
+    def test_orc_unsupported_codec_falls_back(self, session, tmp_path):
         import numpy as np
         import pyarrow as pa
         import pyarrow.orc as po
@@ -558,8 +593,8 @@ class TestDeviceParquetDecode:
         from tests.harness import assert_tpu_and_cpu_are_equal_collect
 
         tbl = pa.table({"a": pa.array(np.arange(500, dtype=np.int64))})
-        path = str(tmp_path / "z.orc")
-        po.write_table(tbl, path, compression="zlib")
+        path = str(tmp_path / "zs.orc")
+        po.write_table(tbl, path, compression="zstd")
         assert_tpu_and_cpu_are_equal_collect(
             session, lambda s: s.read.orc(path), ignore_order=True)
 

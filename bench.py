@@ -29,7 +29,7 @@ TPU_ITERS = 3
 CPU_ITERS = 2
 
 TPU_BUDGET_S = int(os.environ.get("SRT_BENCH_TPU_BUDGET_S", "780"))
-CPU_BUDGET_S = 240
+CPU_BUDGET_S = int(os.environ.get("SRT_BENCH_CPU_BUDGET_S", "240"))
 
 
 # ---------------------------------------------------------------- workers

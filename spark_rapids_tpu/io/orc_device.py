@@ -1,4 +1,4 @@
-"""Device-side ORC integer column decode.
+"""Device-side ORC column decode (integers, dates, strings).
 
 Reference parity: the reference decodes ORC ON the accelerator — host-side
 stripe reassembly feeds cudf's device ORC reader (`GpuOrcScan.scala`,
@@ -16,10 +16,13 @@ decoder (io/parquet_device.py):
 
 Scope: UNCOMPRESSED, ZLIB and SNAPPY files (compressed streams block-
 decompress on the HOST — control-plane work — and the normalized stripe
-image feeds the identical device expansion), SHORT/INT/LONG (+DATE)
-columns with DIRECT_V2 encoding, RLEv2 sub-encodings SHORT_REPEAT /
-DIRECT / DELTA (PATCHED_BASE falls back), value widths <= 32 bits. Arrow
-remains the oracle and the fallback for everything else.
+image feeds the identical device expansion); SHORT/INT/LONG (+DATE)
+columns with DIRECT_V2 encoding; STRING columns with DIRECT_V2 (length
+stream + contiguous bytes) or DICTIONARY_V2 (index + dict lengths + dict
+bytes) — the value bytes gather on device through build_from_plan like
+the parquet string decode. RLEv2 sub-encodings SHORT_REPEAT / DIRECT /
+DELTA (PATCHED_BASE falls back), value widths <= 32 bits. Arrow remains
+the oracle and the fallback for everything else.
 """
 
 from __future__ import annotations
@@ -110,10 +113,14 @@ class OrcMeta:
 
 # ORC type kinds
 K_SHORT, K_INT, K_LONG, K_DATE = 2, 3, 4, 15
+K_STRING = 7
 _INT_KINDS = {K_SHORT, K_INT, K_LONG, K_DATE}
 
 # stream kinds
-S_PRESENT, S_DATA = 0, 1
+S_PRESENT, S_DATA, S_LENGTH, S_DICT = 0, 1, 2, 3
+
+# column encodings
+E_DIRECT, E_DICT, E_DIRECT_V2, E_DICT_V2 = 0, 1, 2, 3
 
 # compression kinds (orc_proto CompressionKind)
 COMP_NONE, COMP_ZLIB, COMP_SNAPPY = 0, 1, 2
@@ -315,7 +322,7 @@ def normalize_stripe(region: bytes, si: StripeInfo, compression: int,
     norm = bytearray()
     out_streams: List[StreamLoc] = []
     for s in phys:
-        if s.kind in (S_PRESENT, S_DATA) and \
+        if s.kind in (S_PRESENT, S_DATA, S_LENGTH, S_DICT) and \
                 (columns is None or s.column in columns):
             payload = decompress_blocks(region, s.start, s.length,
                                         compression)
@@ -358,6 +365,7 @@ class RleV2Table:
     bit_off: np.ndarray    # int64 absolute BIT offset of packed payload
     width: np.ndarray      # int8 packed bit width (0 = none)
     produced: int
+    signed: bool = True    # DIRECT payloads zigzag-decode iff signed
 
 
 def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
@@ -436,7 +444,7 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
                       np.asarray(delta0s, np.int64),
                       np.asarray(bit_offs, np.int64),
                       np.asarray(widths, np.int8),
-                      produced)
+                      produced, signed)
 
 
 # byte-RLE for PRESENT: (run_start_byte, count, is_literal, value, lit_off)
@@ -502,11 +510,13 @@ def _extract_be_bits(raw_u8, width: int, bitpos):
     return ((acc >> shift) & mask).astype(jnp.int64)
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9))
+@functools.partial(jax.jit, static_argnums=(8, 9, 10))
 def _expand_rlev2(raw_u8, kind, out_start, count, base, delta0, bit_off,
-                  width_arr, width: int, cap: int):
+                  width_arr, width: int, cap: int, signed: bool = True):
     """Expand one RLEv2 run table (all runs share static packed `width`;
-    the host groups runs by width) into int64 values [cap]."""
+    the host groups runs by width) into int64 values [cap]. DIRECT
+    payloads zigzag-decode only for signed streams — LENGTH/index streams
+    are unsigned raw values."""
     j = jnp.arange(cap, dtype=jnp.int32)
     run = jnp.clip(jnp.searchsorted(out_start, j, side="right") - 1,
                    0, out_start.shape[0] - 1).astype(jnp.int32)
@@ -516,11 +526,11 @@ def _expand_rlev2(raw_u8, kind, out_start, count, base, delta0, bit_off,
     # SHORT_REPEAT -> base
     val = base[run]
 
-    # DIRECT -> zigzag(be_bits at bit_off + k*w)
+    # DIRECT -> be_bits at bit_off + k*w (zigzag-decoded when signed)
     if width > 0:
         bp = bit_off[run] + k * width
         uv = _extract_be_bits(raw_u8, width, bp)
-        direct = (uv >> 1) ^ -(uv & 1)  # zigzag decode
+        direct = ((uv >> 1) ^ -(uv & 1)) if signed else uv
         val = jnp.where(rkind == R_DIRECT, direct, val)
 
         # DELTA packed deltas (values 2..n-1): delta for slot k (k>=2) is
@@ -574,6 +584,8 @@ def column_eligible(meta: OrcMeta, cid: int, dtype: DataType) -> bool:
     if cid >= len(meta.kinds):
         return False
     kind = meta.kinds[cid]
+    if kind == K_STRING:
+        return dtype is DataType.STRING
     return kind in _INT_KINDS and _KIND_DT[kind] == dtype
 
 
@@ -601,26 +613,38 @@ class ColumnPlan:
     """Host-parsed decode plan for one stripe column: run tables with
     offsets REBASED to the stripe region (so only the stripe's bytes need
     to be on device), plus the present count (computed host-side — never a
-    device round trip)."""
+    device round trip).
+
+    Integer columns (DIRECT_V2): rt = the signed value stream.
+    String columns (DIRECT_V2): rt = the LENGTH stream (unsigned);
+    data_start/data_len locate the concatenated utf-8 bytes (data_len
+    sizes the output byte buffer — no device sync needed).
+    String columns (DICTIONARY_V2): rt = the index stream (unsigned);
+    dict_len_rt = the dictionary LENGTH stream; data_start locates the
+    DICTIONARY_DATA bytes; dict_size entries."""
 
     present: Optional[ByteRleTable]
     rt: RleV2Table
     n_present: int
+    data_start: int = 0
+    data_len: int = 0
+    dict_len_rt: Optional[RleV2Table] = None
+    dict_size: int = 0
+
+
+def _find(streams, cid: int, kind: int) -> Optional[StreamLoc]:
+    return next((s for s in streams
+                 if s.column == cid and s.kind == kind), None)
 
 
 def plan_column(raw: bytes, streams: List[StreamLoc],
                 encodings: Dict[int, int], cid: int, num_rows: int,
-                stripe_base: int) -> ColumnPlan:
+                stripe_base: int,
+                dtype: Optional[DataType] = None) -> ColumnPlan:
     """HOST control plane only: validate encodings and build the run
     tables. Raises _Unsupported before any device work happens."""
-    if encodings.get(cid, -1) != 2:  # DIRECT_V2
-        raise _Unsupported(f"column encoding {encodings.get(cid)}")
-    data_s = next((s for s in streams
-                   if s.column == cid and s.kind == S_DATA), None)
-    pres_s = next((s for s in streams
-                   if s.column == cid and s.kind == S_PRESENT), None)
-    if data_s is None:
-        raise _Unsupported("no DATA stream")
+    enc = encodings.get(cid, -1)
+    pres_s = _find(streams, cid, S_PRESENT)
     bt = None
     if pres_s is not None:
         bt = parse_byte_rle(raw, pres_s.start, pres_s.start + pres_s.length)
@@ -628,6 +652,52 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
         bt.lit_off = bt.lit_off - stripe_base
     else:
         n_present = num_rows
+
+    if dtype is DataType.STRING:
+        data_s = _find(streams, cid, S_DATA)
+        len_s = _find(streams, cid, S_LENGTH)
+        if data_s is None or len_s is None:
+            raise _Unsupported("string column missing DATA/LENGTH stream")
+        if enc == E_DIRECT_V2:
+            # LENGTH carries n_present byte counts; DATA is the bytes
+            rt = parse_rlev2(raw, len_s.start, len_s.start + len_s.length,
+                             n_present, signed=False)
+            if rt.produced < n_present:
+                raise _Unsupported("LENGTH stream shorter than expected")
+            rt.bit_off = rt.bit_off - stripe_base * 8
+            return ColumnPlan(bt, rt, n_present,
+                              data_start=data_s.start - stripe_base,
+                              data_len=data_s.length)
+        if enc == E_DICT_V2:
+            # DATA carries n_present dictionary indices; LENGTH the dict
+            # entry byte counts; DICTIONARY_DATA the entry bytes
+            dict_s = _find(streams, cid, S_DICT)
+            if dict_s is None:
+                raise _Unsupported("dictionary column missing DICT stream")
+            rt = parse_rlev2(raw, data_s.start,
+                             data_s.start + data_s.length,
+                             n_present, signed=False)
+            if rt.produced < n_present:
+                raise _Unsupported("index stream shorter than expected")
+            rt.bit_off = rt.bit_off - stripe_base * 8
+            # dictionary size isn't in the stripe footer: parse lengths to
+            # exhaustion of the LENGTH stream
+            dict_rt = parse_rlev2(raw, len_s.start,
+                                  len_s.start + len_s.length,
+                                  1 << 62, signed=False)
+            dict_rt.bit_off = dict_rt.bit_off - stripe_base * 8
+            return ColumnPlan(bt, rt, n_present,
+                              data_start=dict_s.start - stripe_base,
+                              data_len=dict_s.length,
+                              dict_len_rt=dict_rt,
+                              dict_size=dict_rt.produced)
+        raise _Unsupported(f"string column encoding {enc}")
+
+    if enc != E_DIRECT_V2:
+        raise _Unsupported(f"column encoding {enc}")
+    data_s = _find(streams, cid, S_DATA)
+    if data_s is None:
+        raise _Unsupported("no DATA stream")
     rt = parse_rlev2(raw, data_s.start, data_s.start + data_s.length,
                      n_present, signed=True)
     if rt.produced < n_present:
@@ -636,27 +706,19 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
     return ColumnPlan(bt, rt, n_present)
 
 
-def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
-                  num_rows: int, cap: int):
-    """DEVICE data plane: expand a host-built ColumnPlan over the stripe's
-    device bytes into (data, validity) padded to cap."""
-    from spark_rapids_tpu.columnar.batch import physical_np_dtype
-
-    raw_u8_dev = stripe_dev_u8
+def _expand_validity(stripe_dev_u8, plan: ColumnPlan, cap: int):
     if plan.present is not None:
         bt = plan.present
-        validity = _expand_present(
-            raw_u8_dev, jnp.asarray(bt.out_start), jnp.asarray(bt.count),
+        return _expand_present(
+            stripe_dev_u8, jnp.asarray(bt.out_start), jnp.asarray(bt.count),
             jnp.asarray(bt.is_run), jnp.asarray(bt.value),
             jnp.asarray(bt.lit_off), cap)
-    else:
-        validity = jnp.ones((cap,), dtype=bool)
-    rt = plan.rt
-    if rt.kind.size == 0:
-        # entirely-null column in this stripe: no runs, nothing to expand
-        # (the PRESENT expansion already yields all-False validity)
-        return (jnp.zeros((cap,), dtype=physical_np_dtype(dtype)),
-                validity & (jnp.arange(cap) < num_rows))
+    return jnp.ones((cap,), dtype=bool)
+
+
+def _expand_rt_dense(raw_u8_dev, rt: RleV2Table, cap: int):
+    """Expand one RLEv2 run table to a dense [cap] int64 device array
+    (values in declaration order; slots past rt.produced undefined)."""
     widths = set(int(w) for w in rt.width if w > 0)
     if len(widths) > 1:
         # split runs by width so the kernel's width stays static: decode
@@ -673,7 +735,7 @@ def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
                 jnp.asarray(rt.out_start[sel]), jnp.asarray(rt.count[sel]),
                 jnp.asarray(rt.base[sel]), jnp.asarray(rt.delta0[sel]),
                 jnp.asarray(rt.bit_off[sel]), jnp.asarray(rt.width[sel]),
-                w, cap)
+                w, cap, rt.signed)
             # rows covered by this width group
             starts = rt.out_start[sel]
             ends = starts + rt.count[sel]
@@ -688,7 +750,25 @@ def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
             raw_u8_dev, jnp.asarray(rt.kind), jnp.asarray(rt.out_start),
             jnp.asarray(rt.count), jnp.asarray(rt.base),
             jnp.asarray(rt.delta0), jnp.asarray(rt.bit_off),
-            jnp.asarray(rt.width), w, cap)
+            jnp.asarray(rt.width), w, cap, rt.signed)
+    return dense
+
+
+def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
+                  num_rows: int, cap: int):
+    """DEVICE data plane: expand a host-built ColumnPlan over the stripe's
+    device bytes into (data, validity) padded to cap."""
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    raw_u8_dev = stripe_dev_u8
+    validity = _expand_validity(raw_u8_dev, plan, cap)
+    rt = plan.rt
+    if rt.kind.size == 0:
+        # entirely-null column in this stripe: no runs, nothing to expand
+        # (the PRESENT expansion already yields all-False validity)
+        return (jnp.zeros((cap,), dtype=physical_np_dtype(dtype)),
+                validity & (jnp.arange(cap) < num_rows))
+    dense = _expand_rt_dense(raw_u8_dev, rt, cap)
 
     # spread dense present-values onto row slots (null rows get 0)
     from spark_rapids_tpu.io.parquet_device import _assemble
@@ -700,3 +780,57 @@ def expand_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
     if data.dtype != npdt:
         data = data.astype(npdt)
     return data, validity
+
+
+def expand_string_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
+                         cap: int):
+    """DEVICE data plane for STRING columns: expand lengths (and, for
+    dictionary encoding, indices) from their run tables and gather the
+    value bytes into one (bytes, validity, offsets) device column — the
+    same one-jitted-gather shape as the parquet string decode
+    (reference: cudf's device ORC string decode, GpuOrcScan.scala)."""
+    from spark_rapids_tpu.columnar.batch import bucket_capacity
+    from spark_rapids_tpu.columnar.strings import build_from_plan
+
+    validity = _expand_validity(stripe_dev_u8, plan, cap) & \
+        (jnp.arange(cap) < num_rows)
+    if plan.rt.kind.size == 0:  # entirely-null column in this stripe
+        return (jnp.zeros((8,), jnp.uint8), validity,
+                jnp.zeros((cap + 1,), jnp.int32))
+    prefix = jnp.clip(jnp.cumsum(validity.astype(jnp.int32)) - 1, 0,
+                      cap - 1)
+    if plan.dict_len_rt is not None:
+        # DICTIONARY_V2: per-present-row dict indices + dict entry lengths
+        dict_cap = bucket_capacity(max(plan.dict_size, 1))
+        dict_lens = _expand_rt_dense(stripe_dev_u8, plan.dict_len_rt,
+                                     dict_cap)
+        in_dict = jnp.arange(dict_cap) < plan.dict_size
+        dict_lens = jnp.where(in_dict, dict_lens, 0).astype(jnp.int32)
+        dict_offs = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(dict_lens, dtype=jnp.int32)])
+        idx_dense = _expand_rt_dense(stripe_dev_u8, plan.rt, cap)
+        idx_row = jnp.clip(idx_dense[prefix], 0, dict_cap - 1).astype(
+            jnp.int32)
+        row_lens = jnp.where(validity, dict_lens[idx_row], 0)
+        src_start = jnp.int32(plan.data_start) + dict_offs[idx_row]
+    else:
+        # DIRECT_V2: per-present-row byte lengths; bytes are contiguous
+        lens_dense = _expand_rt_dense(stripe_dev_u8, plan.rt, cap)
+        in_present = jnp.arange(cap) < plan.n_present
+        lens_dense = jnp.where(in_present, lens_dense, 0).astype(jnp.int32)
+        dense_offs = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(lens_dense, dtype=jnp.int32)])
+        row_lens = jnp.where(validity, lens_dense[prefix], 0)
+        src_start = jnp.int32(plan.data_start) + dense_offs[prefix]
+    if plan.dict_len_rt is None:
+        # DIRECT_V2: the DATA stream length IS the total value bytes
+        byte_cap = bucket_capacity(max(plan.data_len, 8))
+    else:
+        total = int(jax.device_get(jnp.sum(row_lens)))
+        byte_cap = bucket_capacity(max(total, 8))
+    data, offsets = build_from_plan([stripe_dev_u8],
+                                    jnp.zeros((cap,), jnp.int32),
+                                    src_start, row_lens, byte_cap)
+    return data, validity, offsets

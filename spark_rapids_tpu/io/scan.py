@@ -485,14 +485,16 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     plans = {
                         a.name: OD.plan_column(norm, streams, encs,
                                                name_to_cid[a.name],
-                                               si.num_rows, 0)
+                                               si.num_rows, 0,
+                                               dtype=a.data_type)
                         for a in eligible}
                 else:
                     streams, encs = OD.parse_stripe_footer(raw, si)
                     plans = {
                         a.name: OD.plan_column(raw, streams, encs,
                                                name_to_cid[a.name],
-                                               si.num_rows, si.offset)
+                                               si.num_rows, si.offset,
+                                               dtype=a.data_type)
                         for a in eligible}
                 stripe_plans.append(plans)
         except Exception:
@@ -537,10 +539,16 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
             stripe_dev = jnp.asarray(np.frombuffer(region, dtype=np.uint8))
             dev_cols = {}
             for a in eligible:
-                d, v = OD.expand_column(stripe_dev,
-                                        stripe_plans[sidx][a.name],
-                                        a.data_type, rows, cap)
-                dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+                if a.data_type is DataType.STRING:
+                    d, v, offs = OD.expand_string_column(
+                        stripe_dev, stripe_plans[sidx][a.name], rows, cap)
+                    dev_cols[a.name] = ColumnVector(a.data_type, d, v,
+                                                    offs)
+                else:
+                    d, v = OD.expand_column(stripe_dev,
+                                            stripe_plans[sidx][a.name],
+                                            a.data_type, rows, cap)
+                    dev_cols[a.name] = ColumnVector(a.data_type, d, v)
             hb = None
             if rest:
                 import pyarrow.orc as po

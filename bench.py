@@ -253,6 +253,8 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
     import math
 
     dev = _init_backend(mode)
+    import jax
+
     import spark_rapids_tpu as srt
 
     qmod = importlib.import_module(f"spark_rapids_tpu.benchmarks.{suite}")
@@ -263,7 +265,7 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
               qmod.gen_tables(session, sf=sf, num_partitions=4).items()}
     _log(f"worker[{mode}]: {suite} sf={sf} tables built")
     bests = {}
-    for qname, qfn in sorted(qmod.QUERIES.items()):
+    for qi, (qname, qfn) in enumerate(sorted(qmod.QUERIES.items())):
         qfn(tables).collect()  # warmup/compile
         times = []
         for _ in range(2):
@@ -272,6 +274,11 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
             times.append(time.perf_counter() - t0)
         bests[qname] = min(times)
         _log(f"worker[{mode}]: {qname}: {bests[qname]:.3f}s")
+        if (qi + 1) % 5 == 0:
+            # a 22-query suite accumulates enough live XLA executables to
+            # segfault the CPU runtime; dropping them between queries keeps
+            # the worker alive (recompiles come from the persistent cache)
+            jax.clear_caches()
     geo = math.exp(sum(math.log(t) for t in bests.values()) / len(bests))
     print(json.dumps({"mode": mode, "platform": dev.platform,
                       "geomean_s": geo, "queries": bests}), flush=True)

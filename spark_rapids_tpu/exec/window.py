@@ -415,6 +415,32 @@ def _frame_bounds(frame, pos, start, end, peer_end, peer_start=None,
     return lo, hi
 
 
+def _rmq(masked, lo, hi, op, worst, cap: int):
+    """Per-row range min/max over [lo[i], hi[i]]: sparse-table query.
+    Builds ceil(log2(cap)) levels where level k holds the reduction over
+    the 2^k-wide window starting at each slot; a query combines the two
+    power-of-two windows covering [lo, hi]. Empty frames (hi < lo) return
+    `worst` (callers gate on the frame count)."""
+    levels_n = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+    levels = [masked]
+    cur = masked
+    for k in range(1, levels_n):
+        shift = 1 << (k - 1)
+        shifted = jnp.concatenate(
+            [cur[shift:], jnp.full((shift,), worst, cur.dtype)])
+        cur = op(cur, shifted)
+        levels.append(cur)
+    table = jnp.stack(levels)  # [levels_n, cap]
+    w = jnp.maximum(hi - lo + 1, 1).astype(jnp.int32)
+    k = jnp.zeros_like(w)
+    for j in range(1, levels_n):
+        k = k + (w >= (1 << j)).astype(jnp.int32)
+    p2 = jnp.left_shift(jnp.int32(1), k)
+    a = table[k, jnp.clip(lo, 0, cap - 1)]
+    b = table[k, jnp.clip(hi - p2 + 1, 0, cap - 1)]
+    return op(a, b)
+
+
 def _eval_window_agg(f: AggregateFunction, frame, in_cv, perm, live_s, pos,
                      pgid, start, end, peer_end, cap: int,
                      peer_start=None, range_ord=None):
@@ -445,9 +471,6 @@ def _eval_window_agg(f: AggregateFunction, frame, in_cv, perm, live_s, pos,
         return jnp.where(cnt > 0, avg, 0), cnt > 0
 
     if isinstance(f, (Min, Max)):
-        if not (frame.is_unbounded_both or frame.is_unbounded_to_current):
-            raise NotImplementedError(
-                "min/max window frames beyond unbounded/current")
         is_float = jnp.dtype(vs.dtype).kind == "f"
         if is_float:
             bits = RK._float_order_bits(vs)
@@ -463,11 +486,17 @@ def _eval_window_agg(f: AggregateFunction, frame, in_cv, perm, live_s, pos,
             seg_fn = jax.ops.segment_min if isinstance(f, Min) \
                 else jax.ops.segment_max
             red = _gathered_segment(seg_fn, masked, pgid, cap)
-        else:
+        elif frame.is_unbounded_to_current:
             red = _seg_scan(op, pgid, masked)
             # extend over the peer group (range current-row includes peers)
             if frame.frame_type == "range":
                 red = red[jnp.clip(peer_end, 0, cap - 1)]
+        else:
+            # arbitrary [lo, hi] frames: sparse-table range query —
+            # log(cap) precomputed power-of-two windows, then every row
+            # reads two overlapping windows (reference supports offset
+            # min/max frames via cudf windows, GpuWindowExpression.scala)
+            red = _rmq(masked, lo, hi, op, worst, cap)
         onesc = jnp.concatenate([
             jnp.zeros((1,), jnp.int64),
             jnp.cumsum(valid_s.astype(jnp.int64))])

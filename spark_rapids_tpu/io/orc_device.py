@@ -271,7 +271,7 @@ def _walk_stripe_footer(fbuf, fstart: int, fend: int, base_pos: int
     """StripeFooter protobuf -> stream locations (physical, laid out from
     base_pos in declaration order) + column encodings."""
     streams: List[StreamLoc] = []
-    encodings: Dict[int, int] = {}
+    encodings: Dict[int, Tuple[int, int]] = {}
     col_i = 0
     pos = base_pos
     for fnum, _wt, v in _Proto(fbuf, fstart, fend).fields():
@@ -286,12 +286,15 @@ def _walk_stripe_footer(fbuf, fstart: int, fend: int, base_pos: int
                     length = v2
             streams.append(StreamLoc(kind, column, pos, length))
             pos += length
-        elif fnum == 2:  # ColumnEncoding
+        elif fnum == 2:  # ColumnEncoding {kind, dictionarySize}
             enc = 0
+            dict_size = 0
             for f2, _w2, v2 in _Proto(v).fields():
                 if f2 == 1:
                     enc = v2
-            encodings[col_i] = enc
+                elif f2 == 2:
+                    dict_size = v2
+            encodings[col_i] = (enc, dict_size)
             col_i += 1
     return streams, encodings
 
@@ -643,7 +646,7 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
                 dtype: Optional[DataType] = None) -> ColumnPlan:
     """HOST control plane only: validate encodings and build the run
     tables. Raises _Unsupported before any device work happens."""
-    enc = encodings.get(cid, -1)
+    enc, dict_size = encodings.get(cid, (-1, 0))
     pres_s = _find(streams, cid, S_PRESENT)
     bt = None
     if pres_s is not None:
@@ -680,17 +683,19 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
             if rt.produced < n_present:
                 raise _Unsupported("index stream shorter than expected")
             rt.bit_off = rt.bit_off - stripe_base * 8
-            # dictionary size isn't in the stripe footer: parse lengths to
-            # exhaustion of the LENGTH stream
+            # dictionary size comes from the ColumnEncoding message
             dict_rt = parse_rlev2(raw, len_s.start,
                                   len_s.start + len_s.length,
-                                  1 << 62, signed=False)
+                                  dict_size, signed=False)
+            if dict_rt.produced < dict_size:
+                raise _Unsupported("dict LENGTH stream shorter than "
+                                   "dictionarySize")
             dict_rt.bit_off = dict_rt.bit_off - stripe_base * 8
             return ColumnPlan(bt, rt, n_present,
                               data_start=dict_s.start - stripe_base,
                               data_len=dict_s.length,
                               dict_len_rt=dict_rt,
-                              dict_size=dict_rt.produced)
+                              dict_size=dict_size)
         raise _Unsupported(f"string column encoding {enc}")
 
     if enc != E_DIRECT_V2:
@@ -828,6 +833,9 @@ def expand_string_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
         # DIRECT_V2: the DATA stream length IS the total value bytes
         byte_cap = bucket_capacity(max(plan.data_len, 8))
     else:
+        # dictionary path: total bytes depend on index frequencies, so one
+        # bounded sync sizes the buffer — the same established pattern as
+        # the parquet dictionary-string decode (parquet_device.py)
         total = int(jax.device_get(jnp.sum(row_lens)))
         byte_cap = bucket_capacity(max(total, 8))
     data, offsets = build_from_plan([stripe_dev_u8],

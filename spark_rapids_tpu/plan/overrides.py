@@ -270,10 +270,6 @@ def _tag_window_expr(m: ExprMeta) -> None:
             m.will_not_work(
                 "bounded range frames need exactly one integer/date/"
                 "timestamp ORDER BY column on the device engine")
-    if isinstance(f, (AGG.Min, AGG.Max)) and not (
-            frame.is_unbounded_both or frame.is_unbounded_to_current):
-        m.will_not_work(
-            "min/max over offset frames runs on the CPU engine")
     input_child = f.children()[0] if f.children() else None
     if input_child is not None and \
             input_child.data_type is DataType.STRING:

@@ -192,12 +192,26 @@ def test_string_window_input_falls_back(session):
         fallback_exec="CpuWindowExec", ignore_order=True)
 
 
-def test_range_finite_lower_falls_back(session):
-    # rows frame min/max with offsets is CPU-only for now
+def test_min_max_offset_rows_frame(session):
+    # rows frame min/max with offsets: sparse-table range query on device
     w = (Window.partitionBy("k").orderBy("v", "x").rowsBetween(-2, 2))
-    assert_tpu_fallback_collect(
-        session, _w(_kv(), F.min("v").over(w)),
-        fallback_exec="CpuWindowExec", ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.min("v").over(w), F.max("x").over(w)),
+        ignore_order=True)
+
+
+def test_min_max_bounded_range_frame(session):
+    w = Window.partitionBy("k").orderBy("x").rangeBetween(-6, 6)
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.min("v").over(w), F.max("v").over(w)),
+        ignore_order=True)
+
+
+def test_min_max_preceding_only_rows(session):
+    w = (Window.partitionBy("k").orderBy("v", "x")
+         .rowsBetween(-3, 0))
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _w(_kv(), F.max("v").over(w)), ignore_order=True)
 
 
 def test_range_bounded_sum(session):

@@ -113,6 +113,7 @@ class OrcMeta:
 
 # ORC type kinds
 K_SHORT, K_INT, K_LONG, K_DATE = 2, 3, 4, 15
+K_FLOAT, K_DOUBLE = 5, 6
 K_STRING = 7
 _INT_KINDS = {K_SHORT, K_INT, K_LONG, K_DATE}
 
@@ -589,6 +590,16 @@ def column_eligible(meta: OrcMeta, cid: int, dtype: DataType) -> bool:
     kind = meta.kinds[cid]
     if kind == K_STRING:
         return dtype is DataType.STRING
+    if kind == K_FLOAT:
+        return dtype is DataType.FLOAT32
+    if kind == K_DOUBLE:
+        if dtype is not DataType.FLOAT64:
+            return False
+        from spark_rapids_tpu.columnar.batch import device_float64_supported
+
+        # DOUBLE needs a real f64 bitcast on device; on f32-physical
+        # backends the host path (which narrows identically) serves it
+        return device_float64_supported()
     return kind in _INT_KINDS and _KIND_DT[kind] == dtype
 
 
@@ -655,6 +666,24 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
         bt.lit_off = bt.lit_off - stripe_base
     else:
         n_present = num_rows
+
+    if dtype in (DataType.FLOAT32, DataType.FLOAT64):
+        # FLOAT/DOUBLE: raw IEEE754 little-endian values, DIRECT encoding
+        if enc != E_DIRECT:
+            raise _Unsupported(f"float column encoding {enc}")
+        data_s = _find(streams, cid, S_DATA)
+        if data_s is None:
+            raise _Unsupported("no DATA stream")
+        width = 4 if dtype is DataType.FLOAT32 else 8
+        if data_s.length < n_present * width:
+            raise _Unsupported("float DATA stream shorter than expected")
+        empty = RleV2Table(np.zeros(0, np.int8), np.zeros(0, np.int32),
+                           np.zeros(0, np.int32), np.zeros(0, np.int64),
+                           np.zeros(0, np.int64), np.zeros(0, np.int64),
+                           np.zeros(0, np.int8), 0)
+        return ColumnPlan(bt, empty, n_present,
+                          data_start=data_s.start - stripe_base,
+                          data_len=data_s.length)
 
     if dtype is DataType.STRING:
         data_s = _find(streams, cid, S_DATA)
@@ -842,3 +871,24 @@ def expand_string_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
                                     jnp.zeros((cap,), jnp.int32),
                                     src_start, row_lens, byte_cap)
     return data, validity, offsets
+
+
+def expand_float_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
+                        num_rows: int, cap: int):
+    """DEVICE data plane for FLOAT/DOUBLE columns: the DATA stream is raw
+    IEEE754 little-endian values for the present rows — one gather +
+    bitcast (the parquet PLAIN kernel), then the validity spread."""
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+    from spark_rapids_tpu.io.parquet_device import _assemble, _bitcast_values
+
+    validity = _expand_validity(stripe_dev_u8, plan, cap) & \
+        (jnp.arange(cap) < num_rows)
+    npdt = np.dtype(np.float32) if dtype is DataType.FLOAT32 \
+        else np.dtype(np.float64)
+    dense = _bitcast_values(stripe_dev_u8, jnp.int32(plan.data_start),
+                            cap, npdt.name)
+    data = _assemble(validity, dense, cap)
+    # eligibility guarantees npdt == physical dtype (FLOAT64 only reaches
+    # here when the backend has real f64)
+    assert data.dtype == physical_np_dtype(dtype)
+    return data, validity

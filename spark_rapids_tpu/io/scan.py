@@ -544,6 +544,11 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         stripe_dev, stripe_plans[sidx][a.name], rows, cap)
                     dev_cols[a.name] = ColumnVector(a.data_type, d, v,
                                                     offs)
+                elif a.data_type in (DataType.FLOAT32, DataType.FLOAT64):
+                    d, v = OD.expand_float_column(
+                        stripe_dev, stripe_plans[sidx][a.name],
+                        a.data_type, rows, cap)
+                    dev_cols[a.name] = ColumnVector(a.data_type, d, v)
                 else:
                     d, v = OD.expand_column(stripe_dev,
                                             stripe_plans[sidx][a.name],

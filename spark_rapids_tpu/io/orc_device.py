@@ -630,6 +630,8 @@ class ColumnPlan:
     device round trip).
 
     Integer columns (DIRECT_V2): rt = the signed value stream.
+    FLOAT/DOUBLE columns: rt is empty; data_start/data_len locate the raw
+    IEEE754 little-endian value stream.
     String columns (DIRECT_V2): rt = the LENGTH stream (unsigned);
     data_start/data_len locate the concatenated utf-8 bytes (data_len
     sizes the output byte buffer — no device sync needed).

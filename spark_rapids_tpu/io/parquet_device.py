@@ -487,15 +487,45 @@ def column_eligible(col_meta, dtype: DataType) -> bool:
     if not set(col_meta.encodings) <= ok_enc:
         return False
     if col_meta.physical_type == "BYTE_ARRAY":
-        # strings decode via dictionary gather; PLAIN byte-array data pages
-        # surface as _Unsupported at decode time (whole-split host fallback)
-        return dtype is DataType.STRING and \
-            col_meta.dictionary_page_offset is not None
+        # strings decode via dictionary gather OR plain (start, len) walk
+        return dtype is DataType.STRING
     if col_meta.physical_type not in _PHYS_OK:
         return False
     if dtype is DataType.FLOAT64 and not device_float64_supported():
         return False
     return True
+
+
+def _parse_plain_strings(chunk: bytes, pos: int, end: int, n: int):
+    """Host control plane for a PLAIN byte-array data page: per-value
+    (absolute start, length) tables — native single pass when built. No
+    value bytes are touched; the device gathers them."""
+    import ctypes
+
+    from spark_rapids_tpu.native import get_lib
+
+    starts = np.empty(max(n, 1), dtype=np.int32)
+    lens = np.empty(max(n, 1), dtype=np.int32)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.srt_plain_strings(
+            chunk, pos, end, n,
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != n:
+            raise _Unsupported("truncated PLAIN byte-array page")
+        return starts[:n], lens[:n]
+    for i in range(n):
+        if pos + 4 > end:
+            raise _Unsupported("truncated PLAIN byte-array page")
+        ln = int.from_bytes(chunk[pos:pos + 4], "little")
+        pos += 4
+        if ln > end - pos:
+            raise _Unsupported("malformed PLAIN byte-array value")
+        starts[i] = pos
+        lens[i] = ln
+        pos += ln
+    return starts[:n], lens[:n]
 
 
 def _parse_dict_strings(chunk: bytes, start: int, n: int):
@@ -531,10 +561,13 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
     """Decode one raw column chunk into a device ColumnVector.
 
     Fixed-width columns: PLAIN / dictionary pages, v1 or v2. STRING
-    columns: dictionary pages only — the (offset, length) dictionary table
-    parses on the host, value bytes upload once, and the output column is
-    one jitted gather through build_from_plan (reference decodes strings on
-    the accelerator via cudf the same way, GpuParquetScan.scala:536-556).
+    columns: dictionary pages (host parses the (offset, length) dictionary
+    table, values gather through it) or PLAIN byte-array pages (host walks
+    per-value (start, len) tables — native single pass — and the device
+    gathers the bytes); a chunk mixing both falls back. Either way the
+    output column is one jitted gather through build_from_plan (reference
+    decodes strings on the accelerator via cudf the same way,
+    GpuParquetScan.scala:536-556).
     Compressed chunks (snappy/gzip/zstd/brotli) decompress page-by-page on
     the host first (normalize_chunk); the device data plane is identical.
 
@@ -554,6 +587,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
 
     dict_vals = None          # fixed-width dictionary values (device)
     str_dict = None           # (bytes_dev, offs_dev, lens_dev) for strings
+    str_plain = []            # per-page (starts_np, lens_np) for strings
     dense_parts = []
     valid_parts = []
     for p in pages:
@@ -570,8 +604,6 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             continue
         if p.encoding not in (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT):
             raise _Unsupported(f"data page encoding {p.encoding}")
-        if is_string and p.encoding == ENC_PLAIN:
-            raise _Unsupported("PLAIN byte-array data page")
         pos = p.data_start
         end = p.data_start + p.data_len
         page_cap = bucket_capacity(max(p.num_values, 1))
@@ -624,6 +656,10 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             else:
                 page_dense = dict_vals[jnp.clip(idx, 0,
                                                 dict_vals.shape[0] - 1)]
+        elif is_string:  # PLAIN byte-array: host (start, len) walk
+            ps, pl = _parse_plain_strings(chunk, pos, end, n_present)
+            str_plain.append((ps, pl))
+            page_dense = jnp.zeros((page_cap,), dtype=jnp.int32)  # unused
         else:  # PLAIN fixed-width
             page_dense = _bitcast_values(chunk_dev, jnp.int32(pos),
                                          page_cap, npdt.name)
@@ -636,19 +672,45 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
     # splits — take the fast path)
     if len(valid_parts) == 1:
         validity = _pad_to(valid_parts[0][0], cap, False)
-        dense = _pad_to(dense_parts[0][0], cap, 0)
     else:
         validity = _concat_logical(
             [(v, n) for v, n in valid_parts], cap, False)
-        dense = _concat_logical(
-            [(d, n) for d, n in dense_parts], cap, 0)
-    data = _assemble(validity, dense, cap)
+    if not str_plain:
+        # plain-string chunks skip the dense assembly entirely — their
+        # values come from the (start, len) tables below
+        if len(dense_parts) == 1:
+            dense = _pad_to(dense_parts[0][0], cap, 0)
+        else:
+            dense = _concat_logical(
+                [(d, n) for d, n in dense_parts], cap, 0)
+        data = _assemble(validity, dense, cap)
     if not is_string:
         return ColumnVector(dtype, data, validity)
-    if str_dict is None:
-        raise _Unsupported("string chunk without a dictionary page")
     from spark_rapids_tpu.columnar.strings import build_from_plan
 
+    if str_plain and str_dict is None:
+        # PLAIN byte-array pages: per-present (start, len) from the host
+        # walk; the device gathers the value bytes in one pass. Total byte
+        # size is host-known — no device sync.
+        starts_np = np.concatenate([s for s, _l in str_plain])
+        lens_np = np.concatenate([l for _s, l in str_plain])
+        total = int(lens_np.sum())
+        pad = max(0, cap - starts_np.shape[0])
+        dstarts = jnp.asarray(np.pad(starts_np, (0, pad))[:cap])
+        dlens = jnp.asarray(np.pad(lens_np, (0, pad))[:cap])
+        prefix = jnp.clip(jnp.cumsum(validity.astype(jnp.int32)) - 1,
+                          0, cap - 1)
+        row_starts = dstarts[prefix]
+        row_lens = jnp.where(validity, dlens[prefix], 0)
+        byte_cap = bucket_capacity(max(total, 8))
+        out_bytes, offsets = build_from_plan(
+            [chunk_dev], jnp.zeros((cap,), jnp.int32),
+            row_starts, row_lens, byte_cap)
+        return ColumnVector(dtype, out_bytes, validity, offsets)
+    if str_dict is None:
+        raise _Unsupported("string chunk without a dictionary page")
+    if str_plain:
+        raise _Unsupported("mixed dictionary/plain string pages")
     dict_bytes, dict_offs, dict_lens = str_dict
     row_idx = jnp.clip(data, 0, dict_lens.shape[0] - 1)
     row_lens = jnp.where(validity, dict_lens[row_idx], 0)

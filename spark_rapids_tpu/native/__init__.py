@@ -73,6 +73,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
         ]
+        lib.srt_plain_strings.restype = ctypes.c_int64
+        lib.srt_plain_strings.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         lib.srt_csv_plan.restype = ctypes.c_int64
         lib.srt_csv_plan.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint8,

@@ -294,4 +294,22 @@ int64_t srt_csv_plan(const uint8_t* buf, int64_t len, uint8_t sep,
     return row;
 }
 
+// Walk a parquet PLAIN byte-array page: n values of (u32 LE length +
+// bytes). Fills absolute starts/lens; returns n or -1 on truncation.
+int64_t srt_plain_strings(const uint8_t* buf, int64_t pos, int64_t end,
+                          int64_t n, int32_t* starts, int32_t* lens) {
+  for (int64_t i = 0; i < n; i++) {
+    if (pos + 4 > end) return -1;
+    uint32_t ln = (uint32_t)buf[pos] | ((uint32_t)buf[pos + 1] << 8) |
+                  ((uint32_t)buf[pos + 2] << 16) |
+                  ((uint32_t)buf[pos + 3] << 24);
+    pos += 4;
+    if ((int64_t)ln > end - pos) return -1;
+    starts[i] = (int32_t)pos;
+    lens[i] = (int32_t)ln;
+    pos += (int64_t)ln;
+  }
+  return n;
+}
+
 }  // extern "C"

@@ -20,9 +20,11 @@ image feeds the identical device expansion); SHORT/INT/LONG (+DATE)
 columns with DIRECT_V2 encoding; STRING columns with DIRECT_V2 (length
 stream + contiguous bytes) or DICTIONARY_V2 (index + dict lengths + dict
 bytes) — the value bytes gather on device through build_from_plan like
-the parquet string decode. RLEv2 sub-encodings SHORT_REPEAT / DIRECT /
-DELTA (PATCHED_BASE falls back), value widths <= 32 bits. Arrow remains
-the oracle and the fallback for everything else.
+the parquet string decode; FLOAT/DOUBLE raw IEEE754 streams. ALL four
+RLEv2 sub-encodings: SHORT_REPEAT / DIRECT / DELTA / PATCHED_BASE (the
+<= 31-entry patch list parses on the host and applies as one device
+scatter-add); packed widths <= 32 bits. Arrow remains the oracle and the
+fallback for everything else.
 """
 
 from __future__ import annotations
@@ -340,7 +342,14 @@ def normalize_stripe(region: bytes, si: StripeInfo, compression: int,
 # RLEv2 run-table parse (host: headers + varints only)
 # ---------------------------------------------------------------------------
 # run kinds in our table
-R_REPEAT, R_DIRECT, R_DELTA = 0, 1, 2
+R_REPEAT, R_DIRECT, R_DELTA, R_PATCHED = 0, 1, 2, 3
+
+
+def _closest_fixed_bits(x: int) -> int:
+    for w in _WIDTH_TABLE:
+        if w >= x:
+            return w
+    return 64
 
 _WIDTH_TABLE = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
                 17, 18, 19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48,
@@ -370,6 +379,11 @@ class RleV2Table:
     width: np.ndarray      # int8 packed bit width (0 = none)
     produced: int
     signed: bool = True    # DIRECT payloads zigzag-decode iff signed
+    # PATCHED_BASE: sparse high-bit patches, applied by one scatter-add
+    patch_pos: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.int32))
+    patch_add: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.int64))
 
 
 def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
@@ -381,6 +395,8 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
     delta0s: List[int] = []
     bit_offs: List[int] = []
     widths: List[int] = []
+    patch_pos: List[int] = []
+    patch_add: List[int] = []
     pos = start
     produced = 0
     while produced < num_values and pos < end:
@@ -439,8 +455,52 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
             # packed deltas cover values 2..n-1 (n-2 of them)
             pos = p + (max(n - 2, 0) * w + 7) // 8 if w else p
             produced += n
-        else:
-            raise _Unsupported("PATCHED_BASE run")
+        else:  # enc == 2: PATCHED_BASE
+            w = _WIDTH_TABLE[(h >> 1) & 0x1F]
+            n = ((h & 1) << 8 | raw[pos + 1]) + 1
+            b3 = raw[pos + 2]
+            b4 = raw[pos + 3]
+            bw = ((b3 >> 5) & 0x7) + 1          # base width, bytes
+            pw = _WIDTH_TABLE[b3 & 0x1F]        # patch value width, bits
+            pgw = ((b4 >> 5) & 0x7) + 1         # patch gap width, bits
+            pl = b4 & 0x1F                      # patch list length
+            if w > 32 or w + pw > 56:
+                raise _Unsupported(f"PATCHED_BASE widths {w}+{pw}")
+            p = pos + 4
+            base = int.from_bytes(raw[p:p + bw], "big")
+            msb = 1 << (bw * 8 - 1)
+            if base & msb:                      # sign-magnitude base
+                base = -(base & (msb - 1))
+            p += bw
+            data_bits = p * 8
+            p += (n * w + 7) // 8
+            # patch list: pl entries of closestFixedBits(pgw + pw) bits,
+            # each (gap << pw) | patch; value 0 entries only extend gaps.
+            # Tiny (<= 31 entries): host control plane.
+            plw = _closest_fixed_bits(pgw + pw)
+            out_idx = produced
+            for e in range(pl):
+                bitpos = p * 8 + e * plw
+                byte0 = bitpos // 8
+                span = (plw + (bitpos % 8) + 7) // 8
+                word = int.from_bytes(raw[byte0:byte0 + span], "big")
+                shift = span * 8 - (bitpos % 8) - plw
+                entry = (word >> shift) & ((1 << plw) - 1)
+                gap = entry >> pw
+                pval = entry & ((1 << pw) - 1)
+                out_idx += gap
+                if pval:
+                    patch_pos.append(out_idx)
+                    patch_add.append(pval << w)
+            pos = p + (pl * plw + 7) // 8
+            kinds.append(R_PATCHED)
+            starts.append(produced)
+            counts.append(n)
+            bases.append(base)
+            delta0s.append(0)
+            bit_offs.append(data_bits)
+            widths.append(w)
+            produced += n
     return RleV2Table(np.asarray(kinds, np.int8),
                       np.asarray(starts, np.int32),
                       np.asarray(counts, np.int32),
@@ -448,7 +508,9 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
                       np.asarray(delta0s, np.int64),
                       np.asarray(bit_offs, np.int64),
                       np.asarray(widths, np.int8),
-                      produced, signed)
+                      produced, signed,
+                      np.asarray(patch_pos, np.int32),
+                      np.asarray(patch_add, np.int64))
 
 
 # byte-RLE for PRESENT: (run_start_byte, count, is_literal, value, lit_off)
@@ -530,12 +592,14 @@ def _expand_rlev2(raw_u8, kind, out_start, count, base, delta0, bit_off,
     # SHORT_REPEAT -> base
     val = base[run]
 
-    # DIRECT -> be_bits at bit_off + k*w (zigzag-decoded when signed)
+    # DIRECT -> be_bits at bit_off + k*w (zigzag-decoded when signed);
+    # PATCHED_BASE -> base + unsigned bits (patches scatter-add later)
     if width > 0:
         bp = bit_off[run] + k * width
         uv = _extract_be_bits(raw_u8, width, bp)
         direct = ((uv >> 1) ^ -(uv & 1)) if signed else uv
         val = jnp.where(rkind == R_DIRECT, direct, val)
+        val = jnp.where(rkind == R_PATCHED, base[run] + uv, val)
 
         # DELTA packed deltas (values 2..n-1): delta for slot k (k>=2) is
         # packed at index k-2; cumulative within the run via global cumsum
@@ -787,6 +851,10 @@ def _expand_rt_dense(raw_u8_dev, rt: RleV2Table, cap: int):
             jnp.asarray(rt.count), jnp.asarray(rt.base),
             jnp.asarray(rt.delta0), jnp.asarray(rt.bit_off),
             jnp.asarray(rt.width), w, cap, rt.signed)
+    if rt.patch_pos.size:
+        # PATCHED_BASE high bits: one scatter-add of the (tiny) patch list
+        dense = dense.at[jnp.asarray(rt.patch_pos)].add(
+            jnp.asarray(rt.patch_add), mode="drop")
     return dense
 
 

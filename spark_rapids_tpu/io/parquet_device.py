@@ -659,13 +659,14 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         elif is_string:  # PLAIN byte-array: host (start, len) walk
             ps, pl = _parse_plain_strings(chunk, pos, end, n_present)
             str_plain.append((ps, pl))
-            page_dense = jnp.zeros((page_cap,), dtype=jnp.int32)  # unused
+            page_dense = None  # plain-string chunks skip dense assembly
         else:  # PLAIN fixed-width
             page_dense = _bitcast_values(chunk_dev, jnp.int32(pos),
                                          page_cap, npdt.name)
             # only the first n_present values are real; tail reads past the
             # page but is masked by validity at assemble time
-        dense_parts.append((page_dense, n_present))
+        if page_dense is not None:
+            dense_parts.append((page_dense, n_present))
         valid_parts.append((page_valid, p.num_values))
 
     # stitch pages (single-page chunks — the common case with row-group
@@ -698,10 +699,8 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         pad = max(0, cap - starts_np.shape[0])
         dstarts = jnp.asarray(np.pad(starts_np, (0, pad))[:cap])
         dlens = jnp.asarray(np.pad(lens_np, (0, pad))[:cap])
-        prefix = jnp.clip(jnp.cumsum(validity.astype(jnp.int32)) - 1,
-                          0, cap - 1)
-        row_starts = dstarts[prefix]
-        row_lens = jnp.where(validity, dlens[prefix], 0)
+        row_starts = _assemble(validity, dstarts, cap)
+        row_lens = _assemble(validity, dlens, cap)
         byte_cap = bucket_capacity(max(total, 8))
         out_bytes, offsets = build_from_plan(
             [chunk_dev], jnp.zeros((cap,), jnp.int32),

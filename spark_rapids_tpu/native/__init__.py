@@ -58,32 +58,42 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError as e:
             log.info("native load failed: %s", e)
             return None
-        lib.srt_parse_runs.restype = ctypes.c_int64
-        lib.srt_parse_runs.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.srt_parse_pages.restype = ctypes.c_int64
-        lib.srt_parse_pages.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-        ]
-        lib.srt_plain_strings.restype = ctypes.c_int64
-        lib.srt_plain_strings.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.srt_csv_plan.restype = ctypes.c_int64
-        lib.srt_csv_plan.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint8,
-            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
-        ]
+        try:
+            _bind(lib)
+        except AttributeError as e:
+            # stale cached .so predating a newly added symbol (mtime-equal
+            # copies skip the rebuild): fall back to pure Python
+            log.info("native lib stale (%s); using Python fallbacks", e)
+            return None
         _lib = lib
         return _lib
+
+
+def _bind(lib) -> None:
+    lib.srt_parse_runs.restype = ctypes.c_int64
+    lib.srt_parse_runs.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.srt_parse_pages.restype = ctypes.c_int64
+    lib.srt_parse_pages.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.srt_plain_strings.restype = ctypes.c_int64
+    lib.srt_plain_strings.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.srt_csv_plan.restype = ctypes.c_int64
+    lib.srt_csv_plan.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint8,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]

@@ -23,8 +23,8 @@ bytes) — the value bytes gather on device through build_from_plan like
 the parquet string decode; FLOAT/DOUBLE raw IEEE754 streams. ALL four
 RLEv2 sub-encodings: SHORT_REPEAT / DIRECT / DELTA / PATCHED_BASE (the
 <= 31-entry patch list parses on the host and applies as one device
-scatter-add); packed widths <= 32 bits. Arrow remains the oracle and the
-fallback for everything else.
+scatter-add); packed widths <= 56 bits (an 8-byte device bit window).
+Arrow remains the oracle and the fallback for everything else.
 """
 
 from __future__ import annotations
@@ -114,13 +114,15 @@ class OrcMeta:
 
 
 # ORC type kinds
+K_BOOL = 0
 K_SHORT, K_INT, K_LONG, K_DATE = 2, 3, 4, 15
 K_FLOAT, K_DOUBLE = 5, 6
 K_STRING = 7
+K_TIMESTAMP = 9
 _INT_KINDS = {K_SHORT, K_INT, K_LONG, K_DATE}
 
 # stream kinds
-S_PRESENT, S_DATA, S_LENGTH, S_DICT = 0, 1, 2, 3
+S_PRESENT, S_DATA, S_LENGTH, S_DICT, S_SECONDARY = 0, 1, 2, 3, 5
 
 # column encodings
 E_DIRECT, E_DICT, E_DIRECT_V2, E_DICT_V2 = 0, 1, 2, 3
@@ -275,6 +277,7 @@ def _walk_stripe_footer(fbuf, fstart: int, fend: int, base_pos: int
     base_pos in declaration order) + column encodings."""
     streams: List[StreamLoc] = []
     encodings: Dict[int, Tuple[int, int]] = {}
+    tz = ""
     col_i = 0
     pos = base_pos
     for fnum, _wt, v in _Proto(fbuf, fstart, fend).fields():
@@ -299,13 +302,14 @@ def _walk_stripe_footer(fbuf, fstart: int, fend: int, base_pos: int
                     dict_size = v2
             encodings[col_i] = (enc, dict_size)
             col_i += 1
-    return streams, encodings
+        elif fnum == 3:  # writerTimezone
+            tz = v.decode("utf-8", "replace")
+    return streams, encodings, tz
 
 
-def parse_stripe_footer(raw: bytes, si: StripeInfo
-                        ) -> Tuple[List[StreamLoc], Dict[int, int]]:
-    """StripeFooter -> data-area stream locations + column encodings
-    (uncompressed files: absolute offsets into `raw`)."""
+def parse_stripe_footer(raw: bytes, si: StripeInfo):
+    """StripeFooter -> (stream locations, column encodings, writer
+    timezone); uncompressed files: absolute offsets into `raw`."""
     fstart = si.offset + si.index_length + si.data_length
     return _walk_stripe_footer(raw, fstart, fstart + si.footer_length,
                                si.offset)
@@ -324,18 +328,18 @@ def normalize_stripe(region: bytes, si: StripeInfo, compression: int,
     identical to an uncompressed file's."""
     fstart = si.index_length + si.data_length
     fbuf = decompress_blocks(region, fstart, si.footer_length, compression)
-    phys, encodings = _walk_stripe_footer(fbuf, 0, len(fbuf), 0)
+    phys, encodings, tz = _walk_stripe_footer(fbuf, 0, len(fbuf), 0)
     norm = bytearray()
     out_streams: List[StreamLoc] = []
     for s in phys:
-        if s.kind in (S_PRESENT, S_DATA, S_LENGTH, S_DICT) and \
-                (columns is None or s.column in columns):
+        if s.kind in (S_PRESENT, S_DATA, S_LENGTH, S_DICT, S_SECONDARY) \
+                and (columns is None or s.column in columns):
             payload = decompress_blocks(region, s.start, s.length,
                                         compression)
             out_streams.append(StreamLoc(s.kind, s.column, len(norm),
                                          len(payload)))
             norm += payload
-    return bytes(norm), out_streams, encodings
+    return bytes(norm), out_streams, encodings, tz
 
 
 # ---------------------------------------------------------------------------
@@ -420,7 +424,7 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
         elif enc == 1:  # DIRECT
             w = _WIDTH_TABLE[(h >> 1) & 0x1F]
             n = ((h & 1) << 8 | raw[pos + 1]) + 1
-            if w > 32:
+            if w > 56:
                 raise _Unsupported(f"DIRECT width {w}")
             kinds.append(R_DIRECT)
             starts.append(produced)
@@ -435,7 +439,7 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
             wcode = (h >> 1) & 0x1F
             w = 0 if wcode == 0 else _WIDTH_TABLE[wcode]
             n = ((h & 1) << 8 | raw[pos + 1]) + 1
-            if w > 32:
+            if w > 56:
                 raise _Unsupported(f"DELTA width {w}")
             p = pos + 2
             if signed:
@@ -464,7 +468,7 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
             pw = _WIDTH_TABLE[b3 & 0x1F]        # patch value width, bits
             pgw = ((b4 >> 5) & 0x7) + 1         # patch gap width, bits
             pl = b4 & 0x1F                      # patch list length
-            if w > 32 or w + pw > 56:
+            if w > 56 or w + pw > 56:
                 raise _Unsupported(f"PATCHED_BASE widths {w}+{pw}")
             p = pos + 4
             base = int.from_bytes(raw[p:p + bw], "big")
@@ -562,15 +566,18 @@ def parse_byte_rle(raw: bytes, start: int, end: int) -> ByteRleTable:
 @functools.partial(jax.jit, static_argnums=(1,))
 def _extract_be_bits(raw_u8, width: int, bitpos):
     """Big-endian bit window extraction: `width` bits starting at absolute
-    bit position bitpos (MSB-first), via a 5-byte gather into u64."""
+    bit position bitpos (MSB-first). The gather spans ceil(width/8)+1
+    bytes to cover the 0-7 bit misalignment; an 8-byte u64 window caps the
+    supported width at 56 bits."""
+    nb = min((width + 7) // 8 + 1, 8)
     byte = (bitpos >> 3).astype(jnp.int64)
     nbytes = raw_u8.shape[0]
     acc = jnp.zeros(bitpos.shape, dtype=jnp.uint64)
-    for o in range(5):
+    for o in range(nb):
         src = jnp.clip(byte + o, 0, nbytes - 1)
         acc = acc | (raw_u8[src].astype(jnp.uint64)
-                     << jnp.uint64(8 * (4 - o)))
-    shift = (jnp.uint64(40) - (bitpos & 7).astype(jnp.uint64)
+                     << jnp.uint64(8 * (nb - 1 - o)))
+    shift = (jnp.uint64(8 * nb) - (bitpos & 7).astype(jnp.uint64)
              - jnp.uint64(width))
     mask = jnp.uint64((1 << width) - 1)
     return ((acc >> shift) & mask).astype(jnp.int64)
@@ -654,6 +661,10 @@ def column_eligible(meta: OrcMeta, cid: int, dtype: DataType) -> bool:
     kind = meta.kinds[cid]
     if kind == K_STRING:
         return dtype is DataType.STRING
+    if kind == K_BOOL:
+        return dtype is DataType.BOOL
+    if kind == K_TIMESTAMP:
+        return dtype is DataType.TIMESTAMP
     if kind == K_FLOAT:
         return dtype is DataType.FLOAT32
     if kind == K_DOUBLE:
@@ -710,6 +721,8 @@ class ColumnPlan:
     data_len: int = 0
     dict_len_rt: Optional[RleV2Table] = None
     dict_size: int = 0
+    bool_bits: Optional[ByteRleTable] = None  # BOOLEAN value bitmap
+    ts_nanos_rt: Optional[RleV2Table] = None  # TIMESTAMP SECONDARY stream
 
 
 def _find(streams, cid: int, kind: int) -> Optional[StreamLoc]:
@@ -720,7 +733,8 @@ def _find(streams, cid: int, kind: int) -> Optional[StreamLoc]:
 def plan_column(raw: bytes, streams: List[StreamLoc],
                 encodings: Dict[int, int], cid: int, num_rows: int,
                 stripe_base: int,
-                dtype: Optional[DataType] = None) -> ColumnPlan:
+                dtype: Optional[DataType] = None,
+                timezone: str = "") -> ColumnPlan:
     """HOST control plane only: validate encodings and build the run
     tables. Raises _Unsupported before any device work happens."""
     enc, dict_size = encodings.get(cid, (-1, 0))
@@ -732,6 +746,51 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
         bt.lit_off = bt.lit_off - stripe_base
     else:
         n_present = num_rows
+
+    if dtype is DataType.TIMESTAMP:
+        # seconds (signed, relative to 2015-01-01 UTC) + SECONDARY nanos
+        # (unsigned, trailing-zero-packed). ORC timestamps are writer-
+        # timezone-relative: only UTC-written files decode on device
+        if timezone not in ("UTC", "GMT", "Etc/UTC", ""):
+            raise _Unsupported(f"non-UTC ORC timestamps ({timezone})")
+        if enc != E_DIRECT_V2:
+            raise _Unsupported(f"timestamp column encoding {enc}")
+        data_s = _find(streams, cid, S_DATA)
+        nano_s = _find(streams, cid, S_SECONDARY)
+        if data_s is None or nano_s is None:
+            raise _Unsupported("timestamp missing DATA/SECONDARY stream")
+        rt = parse_rlev2(raw, data_s.start, data_s.start + data_s.length,
+                         n_present, signed=True)
+        if rt.produced < n_present:
+            raise _Unsupported("seconds stream shorter than expected")
+        rt.bit_off = rt.bit_off - stripe_base * 8
+        nrt = parse_rlev2(raw, nano_s.start, nano_s.start + nano_s.length,
+                          n_present, signed=False)
+        if nrt.produced < n_present:
+            raise _Unsupported("nanos stream shorter than expected")
+        nrt.bit_off = nrt.bit_off - stripe_base * 8
+        plan = ColumnPlan(bt, rt, n_present)
+        plan.ts_nanos_rt = nrt
+        return plan
+
+    if dtype is DataType.BOOL:
+        # BOOLEAN: the DATA stream is bit-packed bytes under byte-RLE —
+        # exactly the PRESENT layout, so its run table + device expansion
+        # serve the values too
+        if enc != E_DIRECT:
+            raise _Unsupported(f"bool column encoding {enc}")
+        data_s = _find(streams, cid, S_DATA)
+        if data_s is None:
+            raise _Unsupported("no DATA stream")
+        vt = parse_byte_rle(raw, data_s.start, data_s.start + data_s.length)
+        vt.lit_off = vt.lit_off - stripe_base
+        empty = RleV2Table(np.zeros(0, np.int8), np.zeros(0, np.int32),
+                           np.zeros(0, np.int32), np.zeros(0, np.int64),
+                           np.zeros(0, np.int64), np.zeros(0, np.int64),
+                           np.zeros(0, np.int8), 0)
+        plan = ColumnPlan(bt, empty, n_present)
+        plan.bool_bits = vt
+        return plan
 
     if dtype in (DataType.FLOAT32, DataType.FLOAT64):
         # FLOAT/DOUBLE: raw IEEE754 little-endian values, DIRECT encoding
@@ -961,4 +1020,50 @@ def expand_float_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
     # eligibility guarantees npdt == physical dtype (FLOAT64 only reaches
     # here when the backend has real f64)
     assert data.dtype == physical_np_dtype(dtype)
+    return data, validity
+
+
+def expand_bool_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
+                       cap: int):
+    """DEVICE data plane for BOOLEAN columns: the value bitmap expands with
+    the PRESENT kernel (same byte-RLE bit-packed layout), then spreads onto
+    row slots by validity rank."""
+    from spark_rapids_tpu.io.parquet_device import _assemble
+
+    validity = _expand_validity(stripe_dev_u8, plan, cap) & \
+        (jnp.arange(cap) < num_rows)
+    vt = plan.bool_bits
+    dense = _expand_present(
+        stripe_dev_u8, jnp.asarray(vt.out_start), jnp.asarray(vt.count),
+        jnp.asarray(vt.is_run), jnp.asarray(vt.value),
+        jnp.asarray(vt.lit_off), cap)
+    data = _assemble(validity, dense, cap)
+    return data, validity
+
+
+_ORC_TS_EPOCH = 1420070400  # 2015-01-01 00:00:00 UTC, seconds
+
+
+def expand_timestamp_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
+                            cap: int):
+    """DEVICE data plane for TIMESTAMP columns: expand the seconds and
+    trailing-zero-packed nanos streams and combine into int64 micros since
+    the unix epoch (the negative-seconds borrow matches the ORC reader)."""
+    from spark_rapids_tpu.io.parquet_device import _assemble
+
+    validity = _expand_validity(stripe_dev_u8, plan, cap) & \
+        (jnp.arange(cap) < num_rows)
+    secs = _expand_rt_dense(stripe_dev_u8, plan.rt, cap)
+    nv = _expand_rt_dense(stripe_dev_u8, plan.ts_nanos_rt, cap)
+    low3 = (nv & 7).astype(jnp.int32)
+    # trailing-zero code z decodes as * 10^(z+1): z=1 -> 2 zeros removed
+    # (orc TimestampTreeWriter.formatNanos)
+    scale = jnp.asarray([1, 10**2, 10**3, 10**4, 10**5, 10**6, 10**7,
+                         10**8], dtype=jnp.int64)
+    nanos = (nv >> 3) * scale[low3]
+    base_us = (secs + _ORC_TS_EPOCH) * 1_000_000
+    base_us = jnp.where((base_us < 0) & (nanos != 0),
+                        base_us - 1_000_000, base_us)
+    dense_us = base_us + nanos // 1000
+    data = _assemble(validity, dense_us, cap)
     return data, validity

@@ -479,22 +479,24 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     region = raw[si.offset:
                                  si.offset + si.index_length +
                                  si.data_length + si.footer_length]
-                    norm, streams, encs = OD.normalize_stripe(
+                    norm, streams, encs, tz = OD.normalize_stripe(
                         region, si, meta.compression,
                         {name_to_cid[a.name] for a in eligible})
                     plans = {
                         a.name: OD.plan_column(norm, streams, encs,
                                                name_to_cid[a.name],
                                                si.num_rows, 0,
-                                               dtype=a.data_type)
+                                               dtype=a.data_type,
+                                               timezone=tz)
                         for a in eligible}
                 else:
-                    streams, encs = OD.parse_stripe_footer(raw, si)
+                    streams, encs, tz = OD.parse_stripe_footer(raw, si)
                     plans = {
                         a.name: OD.plan_column(raw, streams, encs,
                                                name_to_cid[a.name],
                                                si.num_rows, si.offset,
-                                               dtype=a.data_type)
+                                               dtype=a.data_type,
+                                               timezone=tz)
                         for a in eligible}
                 stripe_plans.append(plans)
         except Exception:
@@ -534,7 +536,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 # plan offsets index the same decompressed image (peak host
                 # memory stays one stripe; decompression is host
                 # control-plane work)
-                region, _streams, _encs = OD.normalize_stripe(
+                region, _streams, _encs, _tz = OD.normalize_stripe(
                     region, si, meta.compression, eligible_cids)
             stripe_dev = jnp.asarray(np.frombuffer(region, dtype=np.uint8))
             dev_cols = {}
@@ -548,6 +550,14 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     d, v = OD.expand_float_column(
                         stripe_dev, stripe_plans[sidx][a.name],
                         a.data_type, rows, cap)
+                    dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+                elif a.data_type is DataType.BOOL:
+                    d, v = OD.expand_bool_column(
+                        stripe_dev, stripe_plans[sidx][a.name], rows, cap)
+                    dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+                elif a.data_type is DataType.TIMESTAMP:
+                    d, v = OD.expand_timestamp_column(
+                        stripe_dev, stripe_plans[sidx][a.name], rows, cap)
                     dev_cols[a.name] = ColumnVector(a.data_type, d, v)
                 else:
                     d, v = OD.expand_column(stripe_dev,

@@ -483,7 +483,7 @@ class TestDeviceParquetDecode:
         oracle = po.ORCFile(path).read()
         row0 = 0
         for si in meta.stripes:
-            streams, encs = OD.parse_stripe_footer(raw, si)
+            streams, encs, _tz = OD.parse_stripe_footer(raw, si)
             cap = bucket_capacity(si.num_rows)
             region = raw[si.offset:si.offset + si.index_length +
                          si.data_length]
@@ -908,7 +908,7 @@ def test_orc_patched_base_decodes_on_device(session, tmp_path):
     si = meta.stripes[0]
     region = raw[si.offset:si.offset + si.index_length + si.data_length
                  + si.footer_length]
-    norm, streams, encs = OD.normalize_stripe(region, si, meta.compression)
+    norm, streams, encs, _tz = OD.normalize_stripe(region, si, meta.compression)
     plan = OD.plan_column(norm, streams, encs, 1, si.num_rows, 0,
                           dtype=DT.INT64)
     assert plan.rt.patch_pos.size > 0
@@ -919,3 +919,89 @@ def test_orc_patched_base_decodes_on_device(session, tmp_path):
             F.sum("a").alias("sa"), F.sum("b").alias("sb"),
             F.max("a").alias("ma"), F.min("b").alias("mb")),
         ignore_order=True)
+
+
+class TestDeviceOrcMoreTypes:
+    """BOOLEAN (byte-RLE bitmap), TIMESTAMP (seconds + packed nanos), and
+    wide (>32-bit) RLEv2 widths decode on device."""
+
+    def test_bool_scan_equivalence(self, session, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.orc as po
+
+        rng = np.random.default_rng(22)
+        n = 5000
+        bools = [bool(x) if i % 9 else None
+                 for i, x in enumerate(rng.random(n) < 0.4)]
+        t = pa.table({
+            "b": pa.array(bools, type=pa.bool_()),
+            "k": pa.array(rng.integers(0, 9, n).astype(np.int64)),
+        })
+        path = str(tmp_path / "b.orc")
+        po.write_table(t, path, compression="zlib")
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: s.read.orc(path)
+            .groupBy("b").agg(F.count("*").alias("n"),
+                              F.sum("k").alias("sk")),
+            ignore_order=True)
+
+    def test_timestamp_scan_equivalence(self, session, tmp_path,
+                                        monkeypatch):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.orc as po
+
+        from spark_rapids_tpu.io import orc_device as OD
+
+        calls = []
+        orig = OD.expand_timestamp_column
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(OD, "expand_timestamp_column", spy)
+        rng = np.random.default_rng(23)
+        n = 5000
+        # post-2000 seconds keep the epoch-relative stream narrow enough
+        # for the device path (width <= 56); mixed sub-second precisions
+        # exercise every trailing-zero scale code
+        secs = rng.integers(946_684_800, 2_000_000_000, n)
+        sub = rng.integers(0, 1_000_000, n)
+        sub[::3] = (sub[::3] // 1000) * 1000      # ms precision
+        sub[::5] = 0                              # whole seconds
+        us = secs * 1_000_000 + sub
+        ts = [int(x) if i % 8 else None for i, x in enumerate(us)]
+        t = pa.table({
+            "t": pa.array(ts, type=pa.timestamp("us")),
+            "k": pa.array(rng.integers(0, 7, n).astype(np.int64)),
+        })
+        path = str(tmp_path / "ts.orc")
+        po.write_table(t, path, compression="snappy")
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: s.read.orc(path)
+            .groupBy("k").agg(F.count("t").alias("n"),
+                              F.min("t").alias("mn"),
+                              F.max("t").alias("mx")),
+            ignore_order=True)
+        assert calls, "device ORC timestamp decode did not engage"
+
+    def test_wide_direct_widths(self, session, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.orc as po
+
+        rng = np.random.default_rng(24)
+        vals = rng.integers(-2**54, 2**54, 4000).astype(np.int64)
+        path = str(tmp_path / "w.orc")
+        po.write_table(pa.table({"a": pa.array(vals)}), path,
+                       compression="uncompressed")
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: s.read.orc(path).agg(F.sum("a").alias("s"),
+                                           F.min("a").alias("mn"),
+                                           F.max("a").alias("mx")),
+            ignore_order=True)

@@ -272,7 +272,8 @@ class StreamLoc:
 
 
 def _walk_stripe_footer(fbuf, fstart: int, fend: int, base_pos: int
-                        ) -> Tuple[List[StreamLoc], Dict[int, int]]:
+                        ) -> Tuple[List[StreamLoc],
+                                   Dict[int, Tuple[int, int]], str]:
     """StripeFooter protobuf -> stream locations (physical, laid out from
     base_pos in declaration order) + column encodings."""
     streams: List[StreamLoc] = []
@@ -317,7 +318,8 @@ def parse_stripe_footer(raw: bytes, si: StripeInfo):
 
 def normalize_stripe(region: bytes, si: StripeInfo, compression: int,
                      columns: Optional[set] = None
-                     ) -> Tuple[bytes, List[StreamLoc], Dict[int, int]]:
+                     ) -> Tuple[bytes, List[StreamLoc],
+                                Dict[int, Tuple[int, int]], str]:
     """Decompress one stripe's PRESENT/DATA streams into a contiguous
     uncompressed image (HOST control plane). `region` is the stripe's
     bytes [si.offset, si.offset + index + data + footer). `columns`
@@ -358,6 +360,13 @@ def _closest_fixed_bits(x: int) -> int:
 _WIDTH_TABLE = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
                 17, 18, 19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48,
                 56, 64]
+
+
+def _empty_rlev2() -> "RleV2Table":
+    return RleV2Table(np.zeros(0, np.int8), np.zeros(0, np.int32),
+                      np.zeros(0, np.int32), np.zeros(0, np.int64),
+                      np.zeros(0, np.int64), np.zeros(0, np.int64),
+                      np.zeros(0, np.int8), 0)
 
 
 def _svarint(buf: bytes, pos: int) -> Tuple[int, int]:
@@ -505,16 +514,21 @@ def parse_rlev2(raw: bytes, start: int, end: int, num_values: int,
             bit_offs.append(data_bits)
             widths.append(w)
             produced += n
-    return RleV2Table(np.asarray(kinds, np.int8),
-                      np.asarray(starts, np.int32),
-                      np.asarray(counts, np.int32),
-                      np.asarray(bases, np.int64),
-                      np.asarray(delta0s, np.int64),
-                      np.asarray(bit_offs, np.int64),
-                      np.asarray(widths, np.int8),
-                      produced, signed,
-                      np.asarray(patch_pos, np.int32),
-                      np.asarray(patch_add, np.int64))
+    try:
+        return RleV2Table(np.asarray(kinds, np.int8),
+                          np.asarray(starts, np.int32),
+                          np.asarray(counts, np.int32),
+                          np.asarray(bases, np.int64),
+                          np.asarray(delta0s, np.int64),
+                          np.asarray(bit_offs, np.int64),
+                          np.asarray(widths, np.int8),
+                          produced, signed,
+                          np.asarray(patch_pos, np.int32),
+                          np.asarray(patch_add, np.int64))
+    except OverflowError as e:
+        # e.g. an unsigned stream carrying a 64-bit two's-complement value
+        # (pyarrow writes pre-1970 fractional nanos that way)
+        raise _Unsupported(f"RLEv2 value out of int64 range: {e}")
 
 
 # byte-RLE for PRESENT: (run_start_byte, count, is_literal, value, lit_off)
@@ -784,11 +798,7 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
             raise _Unsupported("no DATA stream")
         vt = parse_byte_rle(raw, data_s.start, data_s.start + data_s.length)
         vt.lit_off = vt.lit_off - stripe_base
-        empty = RleV2Table(np.zeros(0, np.int8), np.zeros(0, np.int32),
-                           np.zeros(0, np.int32), np.zeros(0, np.int64),
-                           np.zeros(0, np.int64), np.zeros(0, np.int64),
-                           np.zeros(0, np.int8), 0)
-        plan = ColumnPlan(bt, empty, n_present)
+        plan = ColumnPlan(bt, _empty_rlev2(), n_present)
         plan.bool_bits = vt
         return plan
 
@@ -802,11 +812,7 @@ def plan_column(raw: bytes, streams: List[StreamLoc],
         width = 4 if dtype is DataType.FLOAT32 else 8
         if data_s.length < n_present * width:
             raise _Unsupported("float DATA stream shorter than expected")
-        empty = RleV2Table(np.zeros(0, np.int8), np.zeros(0, np.int32),
-                           np.zeros(0, np.int32), np.zeros(0, np.int64),
-                           np.zeros(0, np.int64), np.zeros(0, np.int64),
-                           np.zeros(0, np.int8), 0)
-        return ColumnPlan(bt, empty, n_present,
+        return ColumnPlan(bt, _empty_rlev2(), n_present,
                           data_start=data_s.start - stripe_base,
                           data_len=data_s.length)
 
@@ -1062,7 +1068,9 @@ def expand_timestamp_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
                          10**8], dtype=jnp.int64)
     nanos = (nv >> 3) * scale[low3]
     base_us = (secs + _ORC_TS_EPOCH) * 1_000_000
-    base_us = jnp.where((base_us < 0) & (nanos != 0),
+    # reference readers borrow only when the fractional second is >= 1 ms
+    # (TimestampTreeReader: millis < 0 && nanos > 999999)
+    base_us = jnp.where((base_us < 0) & (nanos > 999_999),
                         base_us - 1_000_000, base_us)
     dense_us = base_us + nanos // 1000
     data = _assemble(validity, dense_us, cap)

@@ -450,6 +450,15 @@ def _expand_hybrid(chunk_u8, out_start, is_rle, value, bit_off,
                      packed).astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _extract_bits_lsb(chunk_u8, byte_start, count: int):
+    """PLAIN-encoded booleans: one bit per value, LSB-first per byte."""
+    i = jnp.arange(count, dtype=jnp.int32)
+    nbytes = chunk_u8.shape[0]
+    b = chunk_u8[jnp.clip(byte_start + (i >> 3), 0, nbytes - 1)]
+    return ((b >> (i & 7).astype(jnp.uint8)) & jnp.uint8(1)).astype(bool)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _bitcast_values(chunk_u8, byte_start, count: int, np_dtype_name: str):
     """PLAIN-encoded fixed-width values: gather + bitcast from raw bytes."""
@@ -475,7 +484,8 @@ def _assemble(validity, dense_vals, cap: int):
 # Column chunk decode driver
 # ---------------------------------------------------------------------------
 _PHYS_OK = {"INT32": DataType.INT32, "INT64": DataType.INT64,
-            "FLOAT": DataType.FLOAT32, "DOUBLE": DataType.FLOAT64}
+            "FLOAT": DataType.FLOAT32, "DOUBLE": DataType.FLOAT64,
+            "BOOLEAN": DataType.BOOL}
 
 
 def column_eligible(col_meta, dtype: DataType) -> bool:
@@ -602,7 +612,10 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                     chunk_dev, jnp.int32(p.data_start), p.num_values,
                     npdt.name)
             continue
-        if p.encoding not in (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT):
+        is_bool = dtype is DataType.BOOL
+        ok_encs = (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT) + \
+            ((ENC_RLE,) if is_bool else ())
+        if p.encoding not in ok_encs:
             raise _Unsupported(f"data page encoding {p.encoding}")
         pos = p.data_start
         end = p.data_start + p.data_len
@@ -656,6 +669,18 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             else:
                 page_dense = dict_vals[jnp.clip(idx, 0,
                                                 dict_vals.shape[0] - 1)]
+        elif is_bool and p.encoding == ENC_RLE:
+            # v2 boolean values: length-prefixed RLE hybrid, bit width 1
+            rl_len = int.from_bytes(chunk[pos:pos + 4], "little")
+            brt = parse_runs(chunk, pos + 4, pos + 4 + rl_len, 1,
+                             n_present)
+            page_dense = _expand_hybrid(
+                chunk_dev, jnp.asarray(brt.out_start),
+                jnp.asarray(brt.is_rle), jnp.asarray(brt.value),
+                jnp.asarray(brt.bit_off), 1, page_cap).astype(bool)
+        elif is_bool:  # PLAIN booleans: LSB-first bit-packed
+            page_dense = _extract_bits_lsb(chunk_dev, jnp.int32(pos),
+                                           page_cap)
         elif is_string:  # PLAIN byte-array: host (start, len) walk
             ps, pl = _parse_plain_strings(chunk, pos, end, n_present)
             str_plain.append((ps, pl))

@@ -1005,3 +1005,42 @@ class TestDeviceOrcMoreTypes:
                                            F.min("a").alias("mn"),
                                            F.max("a").alias("mx")),
             ignore_order=True)
+
+
+def test_parquet_bool_decodes_on_device(session, tmp_path, monkeypatch):
+    """BOOLEAN columns decode on device: PLAIN LSB-first bit-packing (v1)
+    and length-prefixed RLE (v2)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io import parquet_device as PD
+
+    calls = []
+    orig = PD.decode_chunk_device
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(PD, "decode_chunk_device", spy)
+    rng = np.random.default_rng(25)
+    n = 5000
+    bools = [bool(x) if i % 9 else None
+             for i, x in enumerate(rng.random(n) < 0.35)]
+    t = pa.table({
+        "b": pa.array(bools, type=pa.bool_()),
+        "k": pa.array(rng.integers(0, 8, n).astype(np.int64)),
+    })
+    for ver in ("1.0", "2.0"):
+        path = str(tmp_path / f"pb_{ver}.parquet")
+        pq.write_table(t, path, compression="SNAPPY",
+                       data_page_version=ver)
+        calls.clear()
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: s.read.parquet(path)
+            .groupBy("b").agg(F.count("*").alias("n"),
+                              F.sum("k").alias("sk")),
+            ignore_order=True)
+        assert calls, ver

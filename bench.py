@@ -170,7 +170,11 @@ def _worker_i64(mode: str) -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    n = 1 << 22
+    # Large enough that real kernel time clears the fence floor: on
+    # tunneled backends block_until_ready does NOT fence execution, so the
+    # timing loop uses an 8-byte device_get as the fence and the size must
+    # push compute well above the measured ~67 ms round-trip cost.
+    n = 1 << 25
     dt = np.int64 if mode == "i64" else np.int32
     rng = np.random.default_rng(5)
     keys = jnp.asarray(rng.integers(0, 1024, n).astype(dt))
@@ -181,14 +185,23 @@ def _worker_i64(mode: str) -> None:
         keep = (v % 3 != 0)
         proj = jnp.where(keep, v * 2 + 1, 0)
         seg = jnp.where(keep, k, 1024).astype(jnp.int32)
-        return jax.ops.segment_sum(proj, seg, num_segments=1025)
+        # iterate the body so compute dominates the fixed sync cost
+        def body(_, acc):
+            return acc + jax.ops.segment_sum(proj * (acc[0] % 7 + 1), seg,
+                                             num_segments=1025)
+        out = jax.lax.fori_loop(
+            0, 8, body, jnp.zeros((1025,), proj.dtype))
+        return out
 
-    step(keys, vals).block_until_ready()
+    def fenced(k, v):
+        return np.asarray(step(k, v)[0:1])  # tiny d2h = true exec fence
+
+    fenced(keys, vals)
     _log(f"worker[{mode}]: warm, timing")
     times = []
     for i in range(5):
         t0 = time.perf_counter()
-        step(keys, vals).block_until_ready()
+        fenced(keys, vals)
         times.append(time.perf_counter() - t0)
         _log(f"worker[{mode}]: iter {i}: {times[-1] * 1e3:.2f}ms")
     print(json.dumps({"mode": mode, "platform": dev.platform,

@@ -399,6 +399,24 @@ TASK_THREADS = _conf("rapids.tpu.engine.taskThreads").doc(
     "Worker threads executing partition tasks (the Spark executor-slot analog)."
 ).integer(8)
 
+AGG_COMPACT_SYNC = _conf("rapids.tpu.engine.aggCompactSync").doc(
+    "Whether the partial-aggregate stage compacts its output with a "
+    "row-count host sync before the shuffle. 'always' shrinks capacities "
+    "early (best when host<->device syncs are cheap and map partitions are "
+    "many); 'never' keeps the pipeline lazy with zero per-partition round "
+    "trips (best on high-latency/tunneled backends); 'auto' measures the "
+    "backend's fence cost once and skips the sync when a round trip costs "
+    "more than the compute it saves and the partition count is small."
+).check(lambda v: None if v in ("auto", "always", "never")
+        else "must be one of auto|always|never").string("auto")
+
+AGG_LAZY_MAX_PARTS = _conf("rapids.tpu.engine.aggLazyMaxPartitions").doc(
+    "Upper bound on map partitions for the 'auto' lazy (sync-free) partial "
+    "aggregate: beyond this many upstream partitions the un-compacted "
+    "batches concatenated at the merge stage would dominate, so compaction "
+    "is worth its sync."
+).integer(32)
+
 BROADCAST_THRESHOLD = _conf("rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "Max estimated bytes for a join side to be broadcast "
     "(reference: spark.sql.autoBroadcastJoinThreshold)."

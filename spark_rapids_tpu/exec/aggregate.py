@@ -51,6 +51,7 @@ from spark_rapids_tpu.exec.base import (
     TpuExec,
     count_output,
 )
+from spark_rapids_tpu import conf as C
 from spark_rapids_tpu.ops.aggregates import AggregateFunction
 from spark_rapids_tpu.ops.base import (
     Alias,
@@ -431,13 +432,25 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 return 0
             return max(RK.string_chunks_needed(batch.columns[ci])
                        for ci in ordinals)
-        # The update (partial) stage compacts with a row-count sync: group
-        # counts are usually a tiny fraction of input rows, and shrinking
-        # capacities 100x+ here makes everything downstream (shuffle concat,
-        # merge sorts, result download) proportionally cheaper. The merge
-        # stage stays sync-free — its inputs are already small.
-        update_lazy = False
+        # The update (partial) stage can either compact its output with a
+        # row-count sync (shrinking capacities 100x+ so shuffle concat,
+        # merge sorts, and result download get proportionally cheaper) or
+        # stay lazy with zero per-partition host round trips.  Which wins is
+        # a property of the backend: a fence is ~0.1 ms on a local chip but
+        # tens of ms on a tunneled PJRT backend, where per-partition syncs
+        # dominate the whole query.  'auto' measures once and decides; the
+        # merge stage stays sync-free either way — its inputs are small.
         lazy = self._lazy_ok()
+        update_lazy = False
+        if do_update and lazy and self.placement == "tpu":
+            policy = ctx.conf.get(C.AGG_COMPACT_SYNC)
+            if policy == "never":
+                update_lazy = True
+            elif policy == "auto" and \
+                    child_pb.num_partitions <= ctx.conf.get(
+                        C.AGG_LAZY_MAX_PARTS):
+                from spark_rapids_tpu.utils.devprobe import fence_cost_ms
+                update_lazy = fence_cost_ms() >= 5.0
 
         def count_arg(b: ColumnarBatch):
             return jnp.asarray(b.num_rows, dtype=jnp.int32)
@@ -455,6 +468,17 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             k, b, gi = out
             return self._assemble(k, b, gi, batch.capacity)
 
+        # un-compacted (lazy) update output keeps the INPUT batch capacity;
+        # past the exchange's zero-copy piece cap that re-introduces the
+        # very count fence the lazy path exists to avoid (the slicer falls
+        # back to the count-synced contiguous split) AND inflates every
+        # downstream kernel to input-capacity lanes. Lazy is only a win for
+        # outputs that stay under the cap, so the choice is per batch.
+        inter_width = sum(
+            (physical_np_dtype(a.data_type).itemsize + 1)
+            for a in self._inter_attrs) or 1
+        lazy_out_cap_bytes = 4 << 20
+
         def agg_partition(pidx: int):
             from spark_rapids_tpu.columnar.batch import ensure_compact
 
@@ -465,15 +489,19 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 batch = ensure_compact(batch)
                 if do_update:
                     nc = str_chunks(batch, str_update_ords)
-                    if update_kernel[0] is None or update_kernel[0][0] != nc:
-                        update_kernel[0] = (nc, self._build_update_kernel(
+                    b_lazy = update_lazy and \
+                        batch.capacity * inter_width <= lazy_out_cap_bytes
+                    if update_kernel[0] is None or \
+                            update_kernel[0][0] != (nc, b_lazy):
+                        update_kernel[0] = ((nc, b_lazy),
+                                            self._build_update_kernel(
                             child_attrs, key_exprs, input_exprs, op_names,
-                            filters, update_lazy, nc))
+                            filters, b_lazy, nc))
                     cols = [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
                     out = update_kernel[0][1](cols, count_arg(batch))
-                    if update_lazy:
+                    if b_lazy:
                         outs, num_groups = out
                         local = self._lazy_batch(outs, num_groups)
                     else:

@@ -672,6 +672,11 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         elif is_bool and p.encoding == ENC_RLE:
             # v2 boolean values: length-prefixed RLE hybrid, bit width 1
             rl_len = int.from_bytes(chunk[pos:pos + 4], "little")
+            if pos + 4 + rl_len > end:
+                # corrupt/truncated length prefix: decoding would walk into
+                # the next page's bytes — fall back rather than misread
+                raise _Unsupported(
+                    f"boolean RLE length {rl_len} exceeds page data section")
             brt = parse_runs(chunk, pos + 4, pos + 4 + rl_len, 1,
                              n_present)
             page_dense = _expand_hybrid(

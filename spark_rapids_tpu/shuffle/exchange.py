@@ -493,6 +493,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             # the reduce side would run kernels over sum-of-capacities
             # lanes. Worth it only for small batches (e.g. partial-agg
             # output); big scans use the count-synced contiguous split.
+            # (Measured on the tunneled single-chip backend: raising this
+            # cap to cover scan-sized batches multiplies reduce-side lane
+            # counts 8-16x and regressed the flagship query 13x — the
+            # per-lane cost is NOT free even where host fences dominate.)
             if no_strings and batch.device_memory_size() <= (4 << 20):
                 return _device_slices_lazy(batch, ids, n_)
             return _device_slices(batch, ids, n_)

@@ -27,6 +27,107 @@ def test_groupby_sum_count(session):
         ignore_order=True)
 
 
+@pytest.mark.parametrize("policy", ["always", "never"])
+def test_groupby_compact_sync_policies(session, policy):
+    """The partial-aggregate stage must produce identical results whether it
+    compacts with a row-count sync ('always') or stays fully lazy with
+    device-scalar row counts through the exchange ('never') — the policy is
+    a backend-latency tradeoff, never a semantics change."""
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=50)),
+                             ("v", IntGen(DataType.INT64)),
+                             ("f", FloatGen())], n=500)
+        .groupBy("k").agg(F.sum("v").alias("s"), F.count("*").alias("c"),
+                          F.max("f").alias("m")),
+        ignore_order=True,
+        extra_conf={"rapids.tpu.engine.aggCompactSync": policy,
+                    **FLOAT_CONF})
+
+
+def test_devprobe_override(monkeypatch):
+    from spark_rapids_tpu.utils import devprobe
+
+    devprobe.reset()
+    monkeypatch.setenv("SRT_FENCE_MS", "42.5")
+    assert devprobe.fence_cost_ms() == 42.5
+    devprobe.reset()
+
+
+@pytest.mark.parametrize("fence_ms,expect_lazy", [("50", True), ("0.1", False)])
+def test_auto_policy_follows_fence_cost(session, monkeypatch, fence_ms,
+                                        expect_lazy):
+    """'auto' must pick the sync-free lazy update kernel exactly when the
+    measured fence cost crosses the threshold (and the batch is small
+    enough for the exchange's zero-copy piece cap)."""
+    from spark_rapids_tpu.utils import devprobe
+    import spark_rapids_tpu.engine.jit_cache as jc
+
+    devprobe.reset()
+    monkeypatch.setenv("SRT_FENCE_MS", fence_ms)
+    seen = []
+    orig = jc.get_or_build
+
+    def spy(key, builder):
+        if isinstance(key, tuple) and key and key[0] == "agg_update":
+            seen.append(key[1])  # the lazy flag
+        return orig(key, builder)
+
+    monkeypatch.setattr(jc, "get_or_build", spy)
+    try:
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=20)),
+                                 ("v", IntGen(DataType.INT64))], n=400)
+            .groupBy("k").agg(F.sum("v").alias("s")),
+            ignore_order=True,
+            extra_conf={"rapids.tpu.engine.aggCompactSync": "auto"})
+    finally:
+        devprobe.reset()
+    assert seen and all(flag is expect_lazy for flag in seen), seen
+
+
+def test_auto_policy_big_batch_stays_compact(session, monkeypatch):
+    """Even on a high-fence backend, an update output too big for the
+    exchange's zero-copy cap must compact (lazy would just move the sync
+    into the shuffle slicer and inflate downstream lanes)."""
+    from spark_rapids_tpu.utils import devprobe
+    import spark_rapids_tpu.engine.jit_cache as jc
+
+    devprobe.reset()
+    monkeypatch.setenv("SRT_FENCE_MS", "50")
+    seen = []
+    orig = jc.get_or_build
+
+    def spy(key, builder):
+        if isinstance(key, tuple) and key and key[0] == "agg_update":
+            seen.append(key[1])
+        return orig(key, builder)
+
+    monkeypatch.setattr(jc, "get_or_build", spy)
+    try:
+        # 300k rows x (8+1)x2 bytes of inter buffers > the 4 MiB lazy cap
+        assert_tpu_and_cpu_are_equal_collect(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=20)),
+                                 ("v", IntGen(DataType.INT64))], n=300_000)
+            .groupBy("k").agg(F.sum("v").alias("s")),
+            ignore_order=True,
+            extra_conf={"rapids.tpu.engine.aggCompactSync": "auto"})
+    finally:
+        devprobe.reset()
+    assert seen and all(flag is False for flag in seen), seen
+
+
+def test_agg_compact_sync_conf_checker():
+    import spark_rapids_tpu.conf as C
+
+    with pytest.raises(ValueError):
+        C.TpuConf({"rapids.tpu.engine.aggCompactSync": "bogus"}).get(
+            C.AGG_COMPACT_SYNC)
+    assert C.TpuConf().get(C.AGG_COMPACT_SYNC) == "auto"
+
+
 def test_groupby_min_max(session):
     assert_tpu_and_cpu_are_equal_collect(
         session,

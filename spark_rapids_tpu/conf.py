@@ -401,12 +401,14 @@ TASK_THREADS = _conf("rapids.tpu.engine.taskThreads").doc(
 
 AGG_COMPACT_SYNC = _conf("rapids.tpu.engine.aggCompactSync").doc(
     "Whether the partial-aggregate stage compacts its output with a "
-    "row-count host sync before the shuffle. 'always' shrinks capacities "
-    "early (best when host<->device syncs are cheap and map partitions are "
-    "many); 'never' keeps the pipeline lazy with zero per-partition round "
-    "trips (best on high-latency/tunneled backends); 'auto' measures the "
-    "backend's fence cost once and skips the sync when a round trip costs "
-    "more than the compute it saves and the partition count is small."
+    "row-count host sync before the shuffle. 'always' compacts every "
+    "batch (best when host<->device syncs are cheap and map partitions "
+    "are many); 'never' requests the sync-free lazy path wherever it "
+    "applies — fixed-width buffer schemas whose un-compacted output fits "
+    "the exchange's zero-copy piece cap; bigger batches and string "
+    "min/max buffers still compact. 'auto' additionally requires the "
+    "measured backend fence cost to clear a fixed ~5 ms threshold and "
+    "the map partition count to stay under aggLazyMaxPartitions."
 ).check(lambda v: None if v in ("auto", "always", "never")
         else "must be one of auto|always|never").string("auto")
 

@@ -71,6 +71,12 @@ PARTIAL = "partial"
 FINAL = "final"
 COMPLETE = "complete"
 
+# 'auto' aggCompactSync goes lazy when one host fence costs at least this
+# many ms — locally attached chips (~0.1-1 ms) stay below it, tunneled/
+# remote backends (tens of ms) clear it. A fixed threshold, not a modeled
+# compute-saved comparison; conf 'always'/'never' override it either way.
+LAZY_FENCE_THRESHOLD_MS = 5.0
+
 
 class AggSpec(NamedTuple):
     """One distinct aggregate function instance and its buffer slots."""
@@ -450,7 +456,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     child_pb.num_partitions <= ctx.conf.get(
                         C.AGG_LAZY_MAX_PARTS):
                 from spark_rapids_tpu.utils.devprobe import fence_cost_ms
-                update_lazy = fence_cost_ms() >= 5.0
+                update_lazy = fence_cost_ms() >= LAZY_FENCE_THRESHOLD_MS
 
         def count_arg(b: ColumnarBatch):
             return jnp.asarray(b.num_rows, dtype=jnp.int32)
@@ -474,10 +480,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         # back to the count-synced contiguous split) AND inflates every
         # downstream kernel to input-capacity lanes. Lazy is only a win for
         # outputs that stay under the cap, so the choice is per batch.
+        from spark_rapids_tpu.shuffle.exchange import LAZY_PIECE_CAP_BYTES
         inter_width = sum(
             (physical_np_dtype(a.data_type).itemsize + 1)
             for a in self._inter_attrs) or 1
-        lazy_out_cap_bytes = 4 << 20
+        lazy_out_cap_bytes = LAZY_PIECE_CAP_BYTES
 
         def agg_partition(pidx: int):
             from spark_rapids_tpu.columnar.batch import ensure_compact

@@ -384,8 +384,79 @@ def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
     return val, validity & row_mask, jnp.any(malformed)
 
 
+def _null_sentinels() -> List[bytes]:
+    """pyarrow's default CSV null spellings, read at runtime so the device
+    path can never drift from the host oracle's list (the boundary scan
+    strips quotes, and quoted sentinels are null too —
+    quoted_strings_can_be_null defaults True)."""
+    global _NULL_SENTINELS
+    if _NULL_SENTINELS is None:
+        import pyarrow.csv as pc
+
+        _NULL_SENTINELS = [s.encode() for s in
+                           pc.ConvertOptions().null_values if s]
+    return _NULL_SENTINELS
+
+
+_NULL_SENTINELS: Optional[List[bytes]] = None
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _match_sentinels_kernel(raw, starts, lens, sentinels: Tuple[bytes, ...]):
+    """Per field: does it equal any null sentinel? (Empty fields are handled
+    by the caller — lens == 0.)"""
+    smax = max(len(s) for s in sentinels)
+    idx = starts[:, None].astype(jnp.int32) + \
+        jnp.arange(smax, dtype=jnp.int32)[None, :]
+    ch = raw[jnp.clip(idx, 0, raw.shape[0] - 1)]
+    inb = jnp.arange(smax, dtype=jnp.int32)[None, :] < lens[:, None]
+    ch = jnp.where(inb, ch, 0)
+    is_null = jnp.zeros(starts.shape[0], dtype=bool)
+    for s in sentinels:
+        pat = jnp.asarray(np.frombuffer(s.ljust(smax, b"\0"), np.uint8))
+        is_null = is_null | ((lens == len(s)) &
+                             jnp.all(ch == pat[None, :], axis=1))
+    return is_null
+
+
+def decode_string_column(table: FieldTable, col_idx: int, cap: int):
+    """Build a device STRING column straight from the boundary plan: the
+    (start, len) tables plus the already-uploaded raw bytes ARE the column —
+    one fused gather packs the field bytes contiguously (reference: cudf
+    parses the full CSV type matrix on device, GpuBatchScanExec.scala:
+    322-520). Null semantics match the host oracle's strings_can_be_null
+    list via an on-device sentinel match. Returns a ColumnVector; total
+    byte size is host-known, so there is no device sync."""
+    from spark_rapids_tpu.columnar.batch import (
+        ColumnVector,
+        bucket_capacity,
+    )
+    from spark_rapids_tpu.columnar.strings import build_from_plan
+
+    n = table.num_rows
+    starts = np.zeros(cap, dtype=np.int32)
+    lens = np.zeros(cap, dtype=np.int32)
+    starts[:n] = table.starts[:, col_idx]
+    lens[:n] = table.lens[:, col_idx]
+    total = int(lens.astype(np.int64).sum())
+    raw = table.device_raw()
+    dstarts = jnp.asarray(starts)
+    dlens = jnp.asarray(lens)
+    row_mask = jnp.arange(cap) < n
+    is_null = _match_sentinels_kernel(raw, dstarts, dlens,
+                                      tuple(_null_sentinels()))
+    validity = row_mask & (dlens > 0) & ~is_null
+    out_len = jnp.where(validity, dlens, 0)
+    byte_cap = bucket_capacity(max(total, 8))
+    out_bytes, offsets = build_from_plan(
+        [raw], jnp.zeros((cap,), jnp.int32), dstarts, out_len, byte_cap)
+    return ColumnVector(DataType.STRING, out_bytes, validity, offsets)
+
+
 def device_parseable(dtype: DataType) -> bool:
     if dtype in INTEGRAL:
+        return True
+    if dtype is DataType.STRING:
         return True
     if dtype is DataType.FLOAT64:
         # the exact-rounding argument needs a real f64 division on device.

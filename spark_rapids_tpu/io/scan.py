@@ -397,6 +397,17 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                                      [a.name for a in data_attrs])
         if not eligible:
             return None
+        has_dev_strings = any(
+            a.data_type is DataType.STRING and a.name in eligible
+            for a in data_attrs)
+        if has_dev_strings:
+            # the host oracle validates UTF-8 on string conversion; the
+            # device path carries raw bytes, so gate up front — on invalid
+            # input the host path raises the error both engines must raise
+            try:
+                data.decode("utf-8")
+            except UnicodeDecodeError:
+                return None
         rows = table.num_rows
         cap = bucket_capacity(max(rows, 1))
         TpuSemaphore.get().acquire_if_necessary(current_task_id())
@@ -406,6 +417,10 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         malformed_flags = []
         for a in data_attrs:
             if a.name not in eligible:
+                continue
+            if a.data_type is DataType.STRING:
+                dev_cols[a.name] = CD.decode_string_column(
+                    table, eligible[a.name], cap)
                 continue
             d, v, bad = CD.decode_column(table, eligible[a.name],
                                          a.data_type, cap)

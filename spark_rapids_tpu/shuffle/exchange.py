@@ -65,6 +65,12 @@ from spark_rapids_tpu.ops.eval import (
 from spark_rapids_tpu.ops.values import EvalContext, ScalarV
 from spark_rapids_tpu.utils import metrics as M
 
+# Max device bytes for a batch to be split into lazy zero-copy piece views
+# instead of the count-synced contiguous split. Shared with the aggregate
+# exec's lazy-update decision: an un-compacted partial-agg output bigger
+# than this would hit the count sync here anyway, defeating the point.
+LAZY_PIECE_CAP_BYTES = 4 << 20
+
 
 # ===========================================================================
 # Partitioning descriptors
@@ -497,7 +503,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             # cap to cover scan-sized batches multiplies reduce-side lane
             # counts 8-16x and regressed the flagship query 13x — the
             # per-lane cost is NOT free even where host fences dominate.)
-            if no_strings and batch.device_memory_size() <= (4 << 20):
+            if no_strings and \
+                    batch.device_memory_size() <= LAZY_PIECE_CAP_BYTES:
                 return _device_slices_lazy(batch, ids, n_)
             return _device_slices(batch, ids, n_)
 

@@ -129,6 +129,37 @@ def test_single_column_blank_lines_skipped():
 
 
 # --------------------------------------------------------------- end to end
+def test_decode_string_column_values():
+    data = "x,héllo\n7,NULL\n8,\n9,wörld\n10,NA\n".encode()
+    t = CD.plan_fields(data, 2, header=False)
+    assert t is not None and t.num_rows == 5
+    from spark_rapids_tpu.columnar.batch import (
+        ColumnarBatch,
+        bucket_capacity,
+    )
+
+    cv = CD.decode_string_column(t, 1, bucket_capacity(5))
+    hb = ColumnarBatch([cv], 5).to_host()
+    vals = [hb.columns[0].data[i] if hb.columns[0].validity[i] else None
+            for i in range(5)]
+    assert vals == ["héllo", None, None, "wörld", None]
+
+
+def test_decode_string_column_quoted_sentinel():
+    # quoted "NULL" is null for the host oracle (quoted_strings_can_be_null
+    # defaults True) and quotes strip structurally, so it must be null here
+    t = CD.plan_fields(b'a,"NULL"\nb,"ok"\n', 2, header=False)
+    from spark_rapids_tpu.columnar.batch import (
+        ColumnarBatch,
+        bucket_capacity,
+    )
+
+    cv = CD.decode_string_column(t, 1, bucket_capacity(2))
+    hb = ColumnarBatch([cv], 2).to_host()
+    assert not hb.columns[0].validity[0]
+    assert hb.columns[0].validity[1] and hb.columns[0].data[1] == "ok"
+
+
 def _write(tmp_path, name, text):
     p = tmp_path / name
     p.write_text(text)
@@ -208,3 +239,56 @@ def test_csv_quoted_ints_parse_on_device(session, tmp_path):
             .csv(path, header=True).orderBy("a")
 
     assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_csv_strings_decode_on_device(session, tmp_path, monkeypatch):
+    # string columns now come straight off the boundary plan on device —
+    # assert engagement (not silent host fallback) AND oracle equality
+    calls = []
+    orig = CD.decode_string_column
+
+    def spy(table, col_idx, cap):
+        calls.append(col_idx)
+        return orig(table, col_idx, cap)
+
+    monkeypatch.setattr(CD, "decode_string_column", spy)
+    path = _write(tmp_path, "s.csv",
+                  "k,s\n1,alpha\n2,NULL\n3,\n4,NA\n5,délta\n6,n/a\n")
+
+    def q(s):
+        return (s.read.schema([("k", "long"), ("s", "string")])
+                .csv(path, header=True).orderBy("k"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+    assert calls, "device string decode did not engage"
+
+
+def test_csv_string_ops_after_device_scan(session, tmp_path):
+    # device-built string columns must feed the string expression kernels
+    path = _write(tmp_path, "so.csv",
+                  "k,s\n1,apple\n2,banana\n3,\n4,Cherry\n5,avocado\n")
+
+    def q(s):
+        df = s.read.schema([("k", "long"), ("s", "string")]) \
+            .csv(path, header=True)
+        return (df.filter(F.col("s").startswith("a"))
+                  .groupBy().agg(F.count("*").alias("n"),
+                                 F.min("s").alias("lo"),
+                                 F.max("s").alias("hi")))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_csv_non_utf8_both_engines_raise(session, tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_bytes(b"k,s\n1,ok\n2,\xff\xfe\n")
+    from tests.harness import _with_conf
+
+    for enabled in (True, False):
+        restore = _with_conf(session, {"rapids.tpu.sql.enabled": enabled})
+        try:
+            with pytest.raises(Exception):
+                session.read.schema([("k", "long"), ("s", "string")]) \
+                    .csv(str(p), header=True).collect()
+        finally:
+            restore()

@@ -173,8 +173,11 @@ def _worker_i64(mode: str) -> None:
     # Large enough that real kernel time clears the fence floor: on
     # tunneled backends block_until_ready does NOT fence execution, so the
     # timing loop uses an 8-byte device_get as the fence and the size must
-    # push compute well above the measured ~67 ms round-trip cost.
-    n = 1 << 25
+    # push compute well above the measured ~67 ms round-trip cost. (32M rows
+    # proved TOO large: the int64 variant ran 26 s/iter on the real chip and
+    # blew the phase budget; 8M keeps both variants well inside it while the
+    # i64 side still runs seconds — far above the fence floor.)
+    n = 1 << 23
     dt = np.int64 if mode == "i64" else np.int32
     rng = np.random.default_rng(5)
     keys = jnp.asarray(rng.integers(0, 1024, n).astype(dt))
@@ -199,7 +202,7 @@ def _worker_i64(mode: str) -> None:
     fenced(keys, vals)
     _log(f"worker[{mode}]: warm, timing")
     times = []
-    for i in range(5):
+    for i in range(3):
         t0 = time.perf_counter()
         fenced(keys, vals)
         times.append(time.perf_counter() - t0)

@@ -1,0 +1,151 @@
+"""One-off experiment: race segment-reduction + sort strategies on the real
+chip to decide the int64 mitigation (VERDICT r2 weak #5 / next #6).
+
+Run: python exp_segsum.py   (needs the TPU tunnel up)
+"""
+import time
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+N = 1 << 20
+K = 1024
+
+
+def fence(x):
+    return np.asarray(jax.device_get(jnp.ravel(x)[0:1]))
+
+
+def bench(name, fn, *args, iters=5):
+    fn(*args)  # compile+warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fence(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:44s} {min(ts)*1e3:9.2f} ms")
+    return min(ts)
+
+
+rng = np.random.default_rng(3)
+gid_np = rng.integers(0, K, N).astype(np.int32)
+val_np = rng.integers(-10_000, 10_000, N).astype(np.int64)
+gid = jnp.asarray(gid_np)
+val64 = jnp.asarray(val_np)
+val32 = jnp.asarray(val_np.astype(np.int32))
+order = jnp.asarray(np.argsort(gid_np, kind="stable").astype(np.int32))
+gid_sorted = jnp.asarray(np.sort(gid_np).astype(np.int32))
+
+
+@jax.jit
+def seg_unsorted_i64(v, g):
+    return jax.ops.segment_sum(v, g, num_segments=K)
+
+
+@jax.jit
+def seg_unsorted_i32(v, g):
+    return jax.ops.segment_sum(v, g, num_segments=K)
+
+
+@jax.jit
+def seg_sorted_i64(v, o, gs):
+    return jax.ops.segment_sum(v[o], gs, num_segments=K,
+                               indices_are_sorted=True)
+
+
+@jax.jit
+def cumsum_diff_i64(v, o, gs):
+    vs = v[o]
+    cs = jnp.cumsum(vs)
+    # last position of each segment: boundary where next gid differs
+    nxt = jnp.concatenate([gs[1:], jnp.full((1,), K, jnp.int32)])
+    is_end = gs != nxt
+    pos = jnp.arange(N, dtype=jnp.int32)
+    ends = jnp.zeros((K,), jnp.int32).at[jnp.where(is_end, gs, K)].set(
+        pos, mode="drop")
+    totals = cs[ends]
+    # subtract previous segment's cumulative: ends of group g-1
+    prev = jnp.concatenate([jnp.zeros((1,), cs.dtype), totals[:-1]])
+    # note: only correct when every group is non-empty (true here)
+    return totals - prev
+
+
+@jax.jit
+def limb_matmul_i64(v, g):
+    # 7-bit unsigned limbs of the two's-complement u64 value, int8 one-hot
+    # matmul on the MXU, s32 accum, recombine in i64 on K-sized arrays
+    u = v.astype(jnp.uint64)
+    limbs = []
+    for i in range(10):  # 10*7 = 70 >= 64 bits
+        limbs.append(((u >> jnp.uint64(7 * i)) &
+                      jnp.uint64(0x7F)).astype(jnp.int8))
+    lm = jnp.stack(limbs, axis=1)  # [N, 10]
+    CH = 1 << 15
+    def body(carry, idx):
+        acc = carry
+        sl_g = jax.lax.dynamic_slice(g, (idx * CH,), (CH,))
+        sl_l = jax.lax.dynamic_slice(lm, (idx * CH, 0), (CH, 10))
+        onehot = (sl_g[None, :] == jnp.arange(K, dtype=jnp.int32)[:, None])
+        acc = acc + jax.lax.dot(
+            onehot.astype(jnp.int8), sl_l,
+            preferred_element_type=jnp.int32)
+        return acc, None
+    acc, _ = jax.lax.scan(body, jnp.zeros((K, 10), jnp.int32),
+                          jnp.arange(N // CH))
+    out = jnp.zeros((K,), jnp.uint64)
+    for i in range(10):
+        out = out + (acc[:, i].astype(jnp.uint64) << jnp.uint64(7 * i))
+    return out.astype(jnp.int64)
+
+
+# ---- sort strategies -------------------------------------------------------
+@jax.jit
+def sort_i64(k):
+    payload = jnp.arange(N, dtype=jnp.int32)
+    return jax.lax.sort((k, payload), is_stable=True, num_keys=1)[1]
+
+
+@jax.jit
+def sort_split32(k):
+    hi = (k >> jnp.int64(32)).astype(jnp.int32)
+    lo = k.astype(jnp.uint32)
+    payload = jnp.arange(N, dtype=jnp.int32)
+    return jax.lax.sort((hi, lo, payload), is_stable=True, num_keys=2)[1]
+
+
+@jax.jit
+def sort_i32(k):
+    payload = jnp.arange(N, dtype=jnp.int32)
+    return jax.lax.sort((k, payload), is_stable=True, num_keys=1)[1]
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    ref = np.zeros(K, np.int64)
+    np.add.at(ref, gid_np, val_np)
+
+    r = bench("segment_sum i64 unsorted (engine today)", seg_unsorted_i64,
+              val64, gid)
+    bench("segment_sum i32 unsorted", seg_unsorted_i32, val32, gid)
+    bench("segment_sum i64 sorted ids", seg_sorted_i64, val64, order,
+          gid_sorted)
+    bench("cumsum-diff i64 sorted", cumsum_diff_i64, val64, order, gid_sorted)
+    bench("limb one-hot int8 matmul", limb_matmul_i64, val64, gid)
+    # correctness
+    assert np.array_equal(np.asarray(seg_unsorted_i64(val64, gid)), ref)
+    assert np.array_equal(np.asarray(cumsum_diff_i64(val64, order,
+                                                     gid_sorted)), ref)
+    assert np.array_equal(np.asarray(limb_matmul_i64(val64, gid)), ref)
+
+    key64 = jnp.asarray(rng.integers(-2**62, 2**62, N).astype(np.int64))
+    key32 = jnp.asarray(rng.integers(-2**31, 2**31 - 1, N).astype(np.int32))
+    bench("lax.sort 1x i64 key", sort_i64, key64)
+    bench("lax.sort 2x 32-bit split key", sort_split32, key64)
+    bench("lax.sort 1x i32 key", sort_i32, key32)
+
+
+if __name__ == "__main__":
+    main()

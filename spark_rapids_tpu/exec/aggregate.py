@@ -292,7 +292,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                             (sel, cv.data, cv.offsets, cv.validity))
                     else:
                         data, validity = RK.segment_reduce(
-                            op, cv.data, cv.validity & live, gi.gid,
+                            op, cv.data, cv.validity & live, gi,
                             num_rows, capacity)
                         buf_outs.append((data, validity))
                 if lazy:
@@ -344,7 +344,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                             (sel, cv.data, cv.offsets, cv.validity))
                         continue
                     data, validity = RK.segment_reduce(
-                        op, cv.data, cv.validity, gi.gid, num_rows, capacity)
+                        op, cv.data, cv.validity, gi, num_rows, capacity)
                     buf_outs.append((data, validity))
                 if lazy:
                     return (_assemble_traced(key_cols, buf_outs, gi,

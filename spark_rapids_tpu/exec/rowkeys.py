@@ -241,12 +241,26 @@ def _neighbor_differs(proxies: Sequence[KeyProxy], order) -> Any:
 
 
 class GroupInfo(NamedTuple):
-    """Result of group_ids: everything a segment reduction needs."""
+    """Result of group_ids: everything a segment reduction needs.
+
+    The sorted-order fields power the fast segment reductions (see
+    `segment_reduce`): measured on the real chip, an exact cumulative-sum
+    difference over group-sorted data runs int64 sums 2.5x faster than
+    XLA's unsorted scatter-add (docs/tuning-guide.md "int64 on TPU").
+    They are None when the caller assembled gids by hand (e.g. the
+    keyless global-aggregate path), which keeps the scatter fallback.
+    """
 
     gid: Any         # int32 [capacity]; group id per original row; pads -> capacity
     num_groups: Any  # traced int32 scalar
     rep_rows: Any    # int32 [capacity]; original row index of each group's
                      # first (in sorted order) member; slots >= num_groups = 0
+    order: Any = None       # int32 [capacity]; group-sort permutation
+                            # (stable: within a group, original row order)
+    gid_sorted: Any = None  # int32 [capacity]; monotone group id per sorted
+                            # position; pads -> capacity
+    seg_ends: Any = None    # int32 [capacity]; sorted position of group g's
+                            # LAST member; slots >= num_groups = 0
 
 
 def group_ids(proxies: Sequence[KeyProxy], num_rows, capacity: int) -> GroupInfo:
@@ -269,7 +283,14 @@ def group_ids_masked(proxies: Sequence[KeyProxy], valid_mask,
     rep_rows = jnp.zeros((capacity,), jnp.int32).at[
         jnp.where(boundary, gid_sorted, capacity)
     ].set(order, mode="drop")
-    return GroupInfo(gid, num_groups, rep_rows)
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    nxt = jnp.concatenate([gid_sorted[1:],
+                           jnp.full((1,), capacity, jnp.int32)])
+    is_end = (gid_sorted != nxt) & (gid_sorted < capacity)
+    seg_ends = jnp.zeros((capacity,), jnp.int32).at[
+        jnp.where(is_end, gid_sorted, capacity)
+    ].set(pos, mode="drop")
+    return GroupInfo(gid, num_groups, rep_rows, order, gid_sorted, seg_ends)
 
 
 # ---------------------------------------------------------------------------
@@ -280,17 +301,56 @@ def _seg_ids(gid, validity, capacity: int):
     return jnp.where(validity, gid, capacity)
 
 
+def _sorted_group_totals(per_row_sorted, gi: GroupInfo, capacity: int):
+    """Per-group total of an already-sorted per-row array via ONE cumulative
+    sum + boundary gathers — the TPU-fast replacement for an unsorted
+    scatter-add (2.5x on emulated int64, measured on chip; tuning guide).
+    Exact for integers: a difference of wrapped cumulative values equals the
+    wrapped per-group sum in modular arithmetic, the same wrap the scatter
+    path has. Requires dense groups (every gid < num_groups has >= 1 member
+    row — group_ids guarantees this); slots >= num_groups return 0."""
+    cs = jnp.cumsum(per_row_sorted)
+    ends = jnp.clip(gi.seg_ends, 0, capacity - 1)
+    tot = cs[ends]
+    prev = jnp.concatenate([jnp.zeros((1,), tot.dtype), tot[:-1]])
+    slot_ok = jnp.arange(capacity, dtype=jnp.int32) < gi.num_groups
+    return jnp.where(slot_ok, tot - prev, jnp.zeros((), tot.dtype))
+
+
+def _sorted_counts(validity, gi: GroupInfo, capacity: int):
+    """Per-group count of rows whose `validity` (original order) is True.
+    i32 cumsum is exact: counts are bounded by capacity < 2^31."""
+    vs = validity[gi.order] & (gi.gid_sorted < capacity)
+    return _sorted_group_totals(vs.astype(jnp.int32), gi, capacity)
+
+
 def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
     """Reduce `data` per group with SQL null semantics.
+
+    `gid` may be a raw int32 per-row group-id array or a full `GroupInfo`;
+    with a GroupInfo carrying sort-order fields, sum/count (integral) and
+    first/last ride the group-sorted fast paths instead of unsorted
+    scatters. Float sums stay on the scatter path on purpose: a cumulative
+    difference would absorb other groups' magnitudes (catastrophic
+    cancellation), while f32 scatter-adds are native-speed anyway.
 
     Returns (out_data [capacity], out_validity [capacity]) where slot g holds
     group g's result. All-null (or empty) groups -> null, except count -> 0.
     first/last follow encounter order in the ORIGINAL row order, matching the
-    reference's First/Last aggregates.
+    reference's First/Last aggregates (stable group sort keeps original
+    order within each group).
     """
+    gi = gid if isinstance(gid, GroupInfo) else None
+    if gi is not None:
+        gid = gi.gid
+    sorted_ok = gi is not None and gi.order is not None
     pos = jnp.arange(capacity, dtype=jnp.int32)
     in_group = gid < capacity  # real (non-pad) rows
     if op == "count":
+        if sorted_ok:
+            cnt = _sorted_counts(validity & in_group, gi,
+                                 capacity).astype(jnp.int64)
+            return cnt, jnp.ones((capacity,), bool)
         seg = _seg_ids(gid, validity & in_group, capacity)
         ones = jnp.ones((capacity,), jnp.int64)
         cnt = jax.ops.segment_sum(jnp.where(seg < capacity, ones, 0), seg,
@@ -298,9 +358,20 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
         return cnt, jnp.ones((capacity,), bool)
     if op in ("sum", "min", "max", "any"):
         seg = _seg_ids(gid, validity & in_group, capacity)
-        nonnull = jax.ops.segment_sum(
-            (seg < capacity).astype(jnp.int32), seg, num_segments=capacity)
-        outv = nonnull > 0
+        if sorted_ok:
+            nonnull = _sorted_counts(validity & in_group, gi, capacity)
+            outv = nonnull > 0
+            if op == "sum" and jnp.dtype(data.dtype).kind in "iu":
+                vs = jnp.where((validity & in_group)[gi.order],
+                               data[gi.order], jnp.zeros((), data.dtype))
+                out = _sorted_group_totals(vs, gi, capacity)
+                out = jnp.where(outv, out, jnp.zeros((), out.dtype))
+                return out, outv
+        else:
+            nonnull = jax.ops.segment_sum(
+                (seg < capacity).astype(jnp.int32), seg,
+                num_segments=capacity)
+            outv = nonnull > 0
         if op == "sum":
             out = jax.ops.segment_sum(jnp.where(seg < capacity, data, 0), seg,
                                       num_segments=capacity)
@@ -333,6 +404,19 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
         out = jnp.where(outv, out, jnp.zeros((), out.dtype))
         return out, outv
     if op in ("first", "last", "first_ignore_nulls", "last_ignore_nulls"):
+        if sorted_ok and not op.endswith("ignore_nulls"):
+            # stable group sort => group g's members occupy sorted positions
+            # [start_g, end_g] in original row order: first/last are pure
+            # boundary gathers, no scatter-reduce needed
+            ends = jnp.clip(gi.seg_ends, 0, capacity - 1)
+            starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                      ends[:-1] + 1])
+            sel_sorted = starts if op.startswith("first") else ends
+            sel_row = gi.order[jnp.clip(sel_sorted, 0, capacity - 1)]
+            has = pos < gi.num_groups  # dense groups: every slot has a row
+            out = jnp.where(has, data[sel_row], jnp.zeros((), data.dtype))
+            outv = jnp.where(has, validity[sel_row], False)
+            return out, outv
         consider = in_group
         if op.endswith("ignore_nulls"):
             consider = consider & validity

@@ -116,7 +116,7 @@ def distributed_agg_step(mesh: Mesh, n_shards: int, cap: int,
         # -- partial aggregate (update) -------------------------------------
         kcol = ColV(DataType.INT64, keys, valid)
         gi = RK.group_ids_masked([RK.key_proxy(kcol)], valid, cap)
-        psum_, pvalid = RK.segment_reduce("sum", values, valid, gi.gid,
+        psum_, pvalid = RK.segment_reduce("sum", values, valid, gi,
                                           None, cap)
         pkeys = keys[gi.rep_rows]  # slot g holds group g's key
         slot = jnp.arange(cap) < gi.num_groups
@@ -131,7 +131,7 @@ def distributed_agg_step(mesh: Mesh, n_shards: int, cap: int,
         rcap = rk.shape[0]
         rcol = ColV(DataType.INT64, jnp.where(rvalid, rk, 0), rvalid)
         gi2 = RK.group_ids_masked([RK.key_proxy(rcol)], rvalid, rcap)
-        fsum, fvalid = RK.segment_reduce("sum", rv, rvalid, gi2.gid,
+        fsum, fvalid = RK.segment_reduce("sum", rv, rvalid, gi2,
                                          None, rcap)
         fkeys = rk[gi2.rep_rows]
         out_slot = jnp.arange(rcap) < gi2.num_groups

@@ -107,8 +107,22 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
         ("ws_net_paid", "decimal(9,2)")],
         num_partitions=num_partitions)
 
+    n_ret = max(32, int(288_000 * sf))
+    ret_ts = rng.integers(t_lo, t_hi, n_ret).astype(np.int64) * 1_000_000
+    ret_amt_c = rng.integers(100, 500_00, n_ret)
+    store_returns = session.createDataFrame({
+        "sr_item_sk": rng.integers(0, n_item, n_ret).astype(np.int64),
+        "sr_customer_sk": rng.integers(0, n_cust, n_ret).astype(np.int64),
+        "sr_return_ts": ret_ts,
+        "sr_return_amt": [Decimal(int(c)).scaleb(-2) for c in ret_amt_c],
+    }, [("sr_item_sk", "long"), ("sr_customer_sk", "long"),
+        ("sr_return_ts", DataType.TIMESTAMP),
+        ("sr_return_amt", "decimal(9,2)")],
+        num_partitions=max(1, num_partitions // 2))
+
     return {"store_sales": store_sales, "item": item,
-            "web_clickstreams": web_clickstreams, "web_sales": web_sales}
+            "web_clickstreams": web_clickstreams, "web_sales": web_sales,
+            "store_returns": store_returns}
 
 
 # ---------------------------------------------------------------------------
@@ -256,7 +270,7 @@ def q12_like(t) -> "object":
     clickstream joined to sales on (user, item) with a timestamp-window
     condition — multi-key join + timestamp arithmetic."""
     wcs, ss = t["web_clickstreams"], t["store_sales"]
-    day_us = 86_400 * 1_000_000
+    day_s = 86_400  # cast(ts as long) is epoch SECONDS (Spark)
     return (wcs.join(
         ss,
         on=((wcs["wcs_user_sk"] == ss["ss_customer_sk"])
@@ -266,7 +280,7 @@ def q12_like(t) -> "object":
                  > F.col("wcs_click_ts").cast("long"))
                 & (F.col("ss_sold_ts").cast("long")
                    - F.col("wcs_click_ts").cast("long")
-                   < F.lit(30 * day_us)))
+                   < F.lit(30 * day_s)))
         .groupBy("wcs_item_sk")
         .agg(F.count("*").alias("conversions"))
         .orderBy(F.col("conversions").desc(), F.col("wcs_item_sk"))
@@ -295,8 +309,211 @@ def q15_like(t) -> "object":
             .orderBy(F.col("down_months").desc(), F.col("ss_store_sk")))
 
 
+def q02_like(t) -> "object":
+    """Items co-viewed within the same hour by one user (TPCx-BB q2-ish
+    session co-occurrence): clickstream self-join on user with a time-window
+    condition, unordered item pairs, counted and ranked."""
+    wcs = t["web_clickstreams"]
+    hour_s = 3600  # cast(ts as long) is epoch SECONDS (Spark)
+    a = wcs.select(F.col("wcs_user_sk").alias("u1"),
+                   F.col("wcs_item_sk").alias("it1"),
+                   F.col("wcs_click_ts").alias("ts1"))
+    b = wcs.select(F.col("wcs_user_sk").alias("u2"),
+                   F.col("wcs_item_sk").alias("it2"),
+                   F.col("wcs_click_ts").alias("ts2"))
+    return (a.join(b, on=(F.col("u1") == F.col("u2")), how="inner")
+            .filter((F.col("it1") < F.col("it2"))
+                    & (F.col("ts2").cast("long") - F.col("ts1").cast("long")
+                       < F.lit(hour_s))
+                    & (F.col("ts1").cast("long") - F.col("ts2").cast("long")
+                       < F.lit(hour_s)))
+            .groupBy("it1", "it2")
+            .agg(F.count("*").alias("coviews"))
+            .filter(F.col("coviews") >= F.lit(2))
+            .orderBy(F.col("coviews").desc(), F.col("it1"), F.col("it2"))
+            .limit(100))
+
+
+def q03_like(t) -> "object":
+    """Distinct users who viewed an item within 10 days BEFORE buying it
+    (TPCx-BB q3-ish view-before-buy): join clicks to sales on (user, item)
+    with a before-purchase window, then a two-level aggregate emulating
+    COUNT(DISTINCT user) per item."""
+    wcs, ss = t["web_clickstreams"], t["store_sales"]
+    day_s = 86_400  # cast(ts as long) is epoch SECONDS (Spark)
+    hits = (wcs.join(
+        ss,
+        on=((wcs["wcs_user_sk"] == ss["ss_customer_sk"])
+            & (wcs["wcs_item_sk"] == ss["ss_item_sk"])),
+        how="inner")
+        .filter((F.col("ss_sold_ts").cast("long")
+                 >= F.col("wcs_click_ts").cast("long"))
+                & (F.col("ss_sold_ts").cast("long")
+                   - F.col("wcs_click_ts").cast("long")
+                   < F.lit(10 * day_s))))
+    per_user = (hits.groupBy("wcs_item_sk", "wcs_user_sk")
+                .agg(F.count("*").alias("views")))
+    return (per_user.groupBy("wcs_item_sk")
+            .agg(F.count("*").alias("buyers_who_viewed"),
+                 F.sum("views").alias("total_views"))
+            .orderBy(F.col("buyers_who_viewed").desc(),
+                     F.col("wcs_item_sk"))
+            .limit(100))
+
+
+def q08_like(t) -> "object":
+    """Revenue from customers who never clicked vs those who did (TPCx-BB
+    q8-ish reviews-vs-not split): left-semi and left-anti joins of sales
+    against the clickstream user set, decimal revenue per branch."""
+    ss, wcs = t["store_sales"], t["web_clickstreams"]
+    clickers = wcs.select(F.col("wcs_user_sk").alias("cu"))
+    clicked = (ss.join(clickers, on=(ss["ss_customer_sk"] == F.col("cu")),
+                       how="left_semi")
+               .agg(F.sum("ss_net_paid").alias("rev"),
+                    F.count("*").alias("n"))
+               .withColumn("cohort", F.lit("clicked")))
+    silent = (ss.join(clickers, on=(ss["ss_customer_sk"] == F.col("cu")),
+                      how="left_anti")
+              .agg(F.sum("ss_net_paid").alias("rev"),
+                   F.count("*").alias("n"))
+              .withColumn("cohort", F.lit("silent")))
+    return clicked.union(silent).orderBy("cohort")
+
+
+def q11_like(t) -> "object":
+    """Category price stats vs sales volume (TPCx-BB q11-ish correlation
+    shape): join sales to item, per-category decimal revenue, quantity, and
+    double avg-price aggregates side by side."""
+    ss, it = t["store_sales"], t["item"]
+    return (ss.join(it, on=(ss["ss_item_sk"] == it["i_item_sk"]),
+                    how="inner")
+            .groupBy("i_category")
+            .agg(F.sum("ss_net_paid").alias("rev"),
+                 F.sum("ss_quantity").alias("qty"),
+                 F.avg(F.col("i_current_price").cast("double"))
+                  .alias("avg_price"),
+                 F.count("*").alias("n"))
+            .withColumn("rev_per_unit",
+                        F.col("rev").cast("double")
+                        / F.col("qty").cast("double"))
+            .orderBy("i_category"))
+
+
+def q13_like(t) -> "object":
+    """Web-to-store spend ratio per customer (TPCx-BB q13-ish channel
+    shift): two per-customer aggregates joined, double division, top
+    ratios."""
+    ss, ws = t["store_sales"], t["web_sales"]
+    store = (ss.groupBy("ss_customer_sk")
+             .agg(F.sum("ss_net_paid").alias("store_paid")))
+    web = (ws.groupBy("ws_bill_customer_sk")
+           .agg(F.sum("ws_net_paid").alias("web_paid")))
+    return (store.join(
+        web, on=(store["ss_customer_sk"] == web["ws_bill_customer_sk"]),
+        how="inner")
+        .withColumn("ratio", F.col("web_paid").cast("double")
+                    / F.col("store_paid").cast("double"))
+        .filter(F.col("store_paid") > Column(Literal(Decimal("1"),
+                                                     DecimalType(9, 2))))
+        .orderBy(F.col("ratio").desc(), F.col("ss_customer_sk"))
+        .limit(100))
+
+
+def q14_like(t) -> "object":
+    """Morning vs evening click traffic per category (TPCx-BB q14-ish
+    'tween hours' ratio): hour() extraction, conditional counts, join to
+    item for the category rollup."""
+    wcs, it = t["web_clickstreams"], t["item"]
+    hr = F.hour(F.col("wcs_click_ts"))
+    return (wcs.join(it, on=(wcs["wcs_item_sk"] == it["i_item_sk"]),
+                     how="inner")
+            .withColumn("morning", F.when((hr >= F.lit(7))
+                                          & (hr < F.lit(12)),
+                                          F.lit(1)).otherwise(F.lit(0)))
+            .withColumn("evening", F.when((hr >= F.lit(17))
+                                          & (hr < F.lit(22)),
+                                          F.lit(1)).otherwise(F.lit(0)))
+            .groupBy("i_category")
+            .agg(F.sum("morning").alias("am_clicks"),
+                 F.sum("evening").alias("pm_clicks"),
+                 F.count("*").alias("clicks"))
+            .withColumn("am_pm_ratio",
+                        F.col("am_clicks").cast("double")
+                        / (F.col("pm_clicks").cast("double") + F.lit(1.0)))
+            .orderBy("i_category"))
+
+
+def q17_like(t) -> "object":
+    """Promo-window share of revenue per category (TPCx-BB q17-ish):
+    conditional decimal sum inside December vs the whole year, double
+    ratio per category."""
+    ss, it = t["store_sales"], t["item"]
+    dec_lo = ts_lit("2003-12-01T00:00:00")
+    promo = F.when(F.col("ss_sold_ts") >= dec_lo,
+                   F.col("ss_net_paid")).otherwise(
+        Column(Literal(Decimal(0), DecimalType(9, 2))))
+    return (ss.join(it, on=(ss["ss_item_sk"] == it["i_item_sk"]),
+                    how="inner")
+            .withColumn("promo_paid", promo)
+            .groupBy("i_category")
+            .agg(F.sum("promo_paid").alias("promo_rev"),
+                 F.sum("ss_net_paid").alias("total_rev"))
+            .withColumn("promo_share",
+                        F.col("promo_rev").cast("double")
+                        / F.col("total_rev").cast("double"))
+            .orderBy(F.col("promo_share").desc(), F.col("i_category")))
+
+
+def q21_like(t) -> "object":
+    """Items returned then re-purchased by the same customer within 90 days
+    (TPCx-BB q21-ish returns behavior): returns joined back to sales on
+    (customer, item) with a post-return window, counts and returned
+    amounts per item."""
+    sr, ss = t["store_returns"], t["store_sales"]
+    day_s = 86_400  # cast(ts as long) is epoch SECONDS (Spark)
+    return (sr.join(
+        ss,
+        on=((sr["sr_customer_sk"] == ss["ss_customer_sk"])
+            & (sr["sr_item_sk"] == ss["ss_item_sk"])),
+        how="inner")
+        .filter((F.col("ss_sold_ts").cast("long")
+                 > F.col("sr_return_ts").cast("long"))
+                & (F.col("ss_sold_ts").cast("long")
+                   - F.col("sr_return_ts").cast("long")
+                   < F.lit(90 * day_s)))
+        .groupBy("sr_item_sk")
+        .agg(F.count("*").alias("rebuys"),
+             F.sum("sr_return_amt").alias("returned_amt"))
+        .orderBy(F.col("rebuys").desc(), F.col("sr_item_sk"))
+        .limit(100))
+
+
+def q29_like(t) -> "object":
+    """Item-pair purchase affinity (TPCx-BB q29-ish basket pairs): sales
+    self-join on customer over high-quantity purchases, unordered item
+    pairs counted and ranked. The quantity filter bounds the quadratic
+    blow-up the same way the reference thins with category filters."""
+    ss = t["store_sales"]
+    big = ss.filter(F.col("ss_quantity") >= F.lit(10))
+    a = big.select(F.col("ss_customer_sk").alias("c1"),
+                   F.col("ss_item_sk").alias("pit1"))
+    b = big.select(F.col("ss_customer_sk").alias("c2"),
+                   F.col("ss_item_sk").alias("pit2"))
+    return (a.join(b, on=(F.col("c1") == F.col("c2")), how="inner")
+            .filter(F.col("pit1") < F.col("pit2"))
+            .groupBy("pit1", "pit2")
+            .agg(F.count("*").alias("together"))
+            .filter(F.col("together") >= F.lit(2))
+            .orderBy(F.col("together").desc(), F.col("pit1"),
+                     F.col("pit2"))
+            .limit(100))
+
+
 QUERIES: Dict[str, Callable] = {
-    "q01_like": q01_like, "q05_like": q05_like, "q06_like": q06_like,
-    "q07_like": q07_like, "q09_like": q09_like, "q12_like": q12_like,
-    "q15_like": q15_like, "q16_like": q16_like,
+    "q01_like": q01_like, "q02_like": q02_like, "q03_like": q03_like,
+    "q05_like": q05_like, "q06_like": q06_like, "q07_like": q07_like,
+    "q08_like": q08_like, "q09_like": q09_like, "q11_like": q11_like,
+    "q12_like": q12_like, "q13_like": q13_like, "q14_like": q14_like,
+    "q15_like": q15_like, "q16_like": q16_like, "q17_like": q17_like,
+    "q21_like": q21_like, "q29_like": q29_like,
 }

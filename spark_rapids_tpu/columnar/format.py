@@ -73,6 +73,58 @@ def _bool_to_string(ctx, v: ColV) -> ColV:
     return ColV(DataType.STRING, data, v.validity, offsets)
 
 
+def timestamp_to_string(ctx, v: ColV) -> ColV:
+    """Format int64 epoch-micros as 'YYYY-MM-DD HH:MM:SS[.ffffff]' with the
+    fraction's trailing zeros stripped — byte-identical to the host oracle's
+    strftime + rstrip('0') formatting (ops/cast.py:_ts_str; the cuDF analog
+    is its timestamp cast-to-string kernel behind GpuCast.scala). Years
+    assumed in [0, 9999], the same convention as date_to_string.
+
+    Build: a fixed 26-byte-per-row template (the maximal layout) packed to
+    variable widths with one build_from_plan gather — no host sync."""
+    from spark_rapids_tpu.columnar.strings import build_from_plan
+    from spark_rapids_tpu.ops import datetimeops as DT
+
+    cap = ctx.capacity
+    DAY = 86_400_000_000
+    us = v.data.astype(jnp.int64)
+    days = jnp.floor_divide(us, DAY)
+    rem = us - days * DAY  # [0, DAY)
+    y, m, d = DT.civil_from_days(jnp, days)
+    secs = rem // 1_000_000
+    frac = (rem % 1_000_000).astype(jnp.int32)
+    hh = (secs // 3600).astype(jnp.int32)
+    mi = (secs // 60 % 60).astype(jnp.int32)
+    ss = (secs % 60).astype(jnp.int32)
+    # fraction digit count after stripping trailing zeros
+    tz = jnp.zeros((cap,), jnp.int32)
+    for k in (10, 100, 1000, 10_000, 100_000):
+        tz = tz + ((frac % k) == 0).astype(jnp.int32)
+    fdigits = jnp.where(frac == 0, 0, 6 - tz)
+    out_len = jnp.where(frac == 0, 19, 20 + fdigits)
+
+    def dig(x, p):
+        return (ord("0") + x // p % 10).astype(jnp.int32)
+
+    dash = jnp.full((cap,), ord("-"), jnp.int32)
+    colon = jnp.full((cap,), ord(":"), jnp.int32)
+    template = jnp.stack([
+        dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1), dash,
+        dig(m, 10), dig(m, 1), dash,
+        dig(d, 10), dig(d, 1), jnp.full((cap,), ord(" "), jnp.int32),
+        dig(hh, 10), dig(hh, 1), colon,
+        dig(mi, 10), dig(mi, 1), colon,
+        dig(ss, 10), dig(ss, 1), jnp.full((cap,), ord("."), jnp.int32),
+        dig(frac, 100_000), dig(frac, 10_000), dig(frac, 1000),
+        dig(frac, 100), dig(frac, 10), dig(frac, 1),
+    ], axis=1).astype(jnp.uint8).reshape(cap * 26)
+    starts = (jnp.arange(cap, dtype=jnp.int32) * 26)
+    lens = jnp.where(v.validity, out_len, 0)
+    data, offsets = build_from_plan(
+        [template], jnp.zeros((cap,), jnp.int32), starts, lens, 26 * cap)
+    return ColV(DataType.STRING, data, v.validity, offsets)
+
+
 def date_to_string(ctx, v: ColV) -> ColV:
     """Format int32 epoch-days as 'YYYY-MM-DD' (fixed 10 bytes; years assumed
     in [0, 9999] — the meta layer restricts the cast like the reference

@@ -312,9 +312,20 @@ ENABLE_FLOAT_AGG = _conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(
     "(reference: spark.rapids.sql.variableFloatAgg.enabled)."
 ).boolean(True)
 
-ENABLE_CAST_FLOAT_TO_STRING = _conf("rapids.tpu.sql.castFloatToString.enabled").boolean(False)
-ENABLE_CAST_STRING_TO_FLOAT = _conf("rapids.tpu.sql.castStringToFloat.enabled").boolean(False)
-ENABLE_CAST_STRING_TO_TIMESTAMP = _conf("rapids.tpu.sql.castStringToTimestamp.enabled").boolean(False)
+_CAST_KEY_DOC = (
+    "Reserved for reference parity (spark.rapids.sql.%s): this cast "
+    "direction currently has no device kernel, so the expression falls "
+    "back to the CPU engine regardless of this setting."
+)
+ENABLE_CAST_FLOAT_TO_STRING = _conf(
+    "rapids.tpu.sql.castFloatToString.enabled").doc(
+    _CAST_KEY_DOC % "castFloatToString.enabled").boolean(False)
+ENABLE_CAST_STRING_TO_FLOAT = _conf(
+    "rapids.tpu.sql.castStringToFloat.enabled").doc(
+    _CAST_KEY_DOC % "castStringToFloat.enabled").boolean(False)
+ENABLE_CAST_STRING_TO_TIMESTAMP = _conf(
+    "rapids.tpu.sql.castStringToTimestamp.enabled").doc(
+    _CAST_KEY_DOC % "castStringToTimestamp.enabled").boolean(False)
 
 IMPROVED_TIME_OPS = _conf("rapids.tpu.sql.improvedTimeOps.enabled").doc(
     "Enable datetime ops whose range/overflow behavior differs slightly from CPU "

@@ -407,12 +407,14 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
         if sorted_ok and not op.endswith("ignore_nulls"):
             # stable group sort => group g's members occupy sorted positions
             # [start_g, end_g] in original row order: first/last are pure
-            # boundary gathers, no scatter-reduce needed
-            ends = jnp.clip(gi.seg_ends, 0, capacity - 1)
-            starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                      ends[:-1] + 1])
-            sel_sorted = starts if op.startswith("first") else ends
-            sel_row = gi.order[jnp.clip(sel_sorted, 0, capacity - 1)]
+            # boundary gathers, no scatter-reduce needed. first is exactly
+            # rep_rows (each group's first sorted member, already in
+            # GroupInfo); last gathers through seg_ends.
+            if op.startswith("first"):
+                sel_row = jnp.clip(gi.rep_rows, 0, capacity - 1)
+            else:
+                ends = jnp.clip(gi.seg_ends, 0, capacity - 1)
+                sel_row = gi.order[ends]
             has = pos < gi.num_groups  # dense groups: every slot has a row
             out = jnp.where(has, data[sel_row], jnp.zeros((), data.dtype))
             outv = jnp.where(has, validity[sel_row], False)

@@ -13,11 +13,14 @@ the parquet device decoder (io/parquet_device.py):
   jitted kernel gathers up to MAXW bytes per field and folds digits into
   int64 — the conversion FLOPs happen on the accelerator.
 
-Scope: integral columns (INT8..INT64) and — where the backend has f64 —
-FLOAT32/FLOAT64 columns with plain decimal literals (sign, digits, one
-dot; <= 15 significant digits and <= 22 fractional digits, so the single
-f64 division is correctly rounded and bit-identical to the host parser;
-exponents/inf/nan take the host path). Quoted fields are handled
+Scope: integral columns (INT8..INT64); DATE (strict ISO YYYY-MM-DD) and
+TIMESTAMP (ISO date[ T]HH:MM:SS[.f{1,6}]<zone>, zone required — the host
+oracle reads timestamp[us, tz=UTC]) columns; and —
+where the backend has f64 — FLOAT32/FLOAT64 columns with plain decimal
+literals (sign, digits, one dot; <= 15 significant digits and <= 22
+fractional digits, so the single f64 division is correctly rounded and
+bit-identical to the host parser; exponents/inf/nan take the host path).
+Quoted fields are handled
 structurally (quote-aware boundary scan + quote stripping; escaped ""
 falls back). Regular column count per line. Empty fields are NULL
 (pyarrow's strings_can_be_null oracle behavior); malformed digits abandon
@@ -384,6 +387,167 @@ def decode_int_column(table: FieldTable, col_idx: int, dtype: DataType,
     return val, validity & row_mask, jnp.any(malformed)
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _parse_date_kernel(raw, starts, lens, maxw: int):
+    """Strict ISO 'YYYY-MM-DD' (what the pyarrow host oracle accepts for
+    date32) -> epoch days on device. Invalid layouts AND invalid civil
+    dates (2023-02-30) are MALFORMED -> the caller abandons the device path
+    so the host parser raises the identical error."""
+    from spark_rapids_tpu.ops import datetimeops as DT
+
+    idx = starts[:, None].astype(jnp.int32) + \
+        jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    ch = raw[jnp.clip(idx, 0, raw.shape[0] - 1)]
+    inb = jnp.arange(maxw, dtype=jnp.int32)[None, :] < lens[:, None]
+    ch = jnp.where(inb, ch, 0)
+    digits = ch.astype(jnp.int32) - _ZERO
+    isdig = (digits >= 0) & (digits <= 9)
+    layout = jnp.ones(starts.shape[0], dtype=bool)
+    for i in (0, 1, 2, 3, 5, 6, 8, 9):
+        layout = layout & isdig[:, i]
+    layout = layout & (ch[:, 4] == _MINUS) & (ch[:, 7] == _MINUS)
+    layout = layout & (lens == 10)
+    y = (digits[:, 0] * 1000 + digits[:, 1] * 100
+         + digits[:, 2] * 10 + digits[:, 3])
+    m = digits[:, 5] * 10 + digits[:, 6]
+    d = digits[:, 8] * 10 + digits[:, 9]
+    days = DT.days_from_civil(jnp, y, m, d)
+    ry, rm, rd = DT.civil_from_days(jnp, days)
+    civil_ok = (ry == y) & (rm == m) & (rd == d)
+    nonempty = lens > 0
+    validity = layout & civil_ok & nonempty
+    malformed = nonempty & ~validity
+    return (jnp.where(validity, days, 0).astype(jnp.int32), validity,
+            malformed)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _parse_timestamp_kernel(raw, starts, lens, maxw: int):
+    """ISO zoned timestamps on device:
+    'YYYY-MM-DD[ T]HH:MM:SS[.f{1,6}]<zone>' with zone = 'Z' | ±HH |
+    ±HHMM | ±HH:MM -> epoch micros. The host oracle reads TIMESTAMP CSV
+    columns as arrow timestamp[us, tz=UTC], which REQUIRES a zone offset
+    in the text — naive timestamps are a conversion error there, so here
+    they are MALFORMED (whole split -> host, which raises identically)."""
+    from spark_rapids_tpu.ops import datetimeops as DT
+
+    n = starts.shape[0]
+    idx = starts[:, None].astype(jnp.int32) + \
+        jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    ch = raw[jnp.clip(idx, 0, raw.shape[0] - 1)]
+    inb = jnp.arange(maxw, dtype=jnp.int32)[None, :] < lens[:, None]
+    ch = jnp.where(inb, ch, 0)
+    digits = ch.astype(jnp.int32) - _ZERO
+    isdig = (digits >= 0) & (digits <= 9)
+    date_ok = lens >= 19
+    for i in (0, 1, 2, 3, 5, 6, 8, 9):
+        date_ok = date_ok & isdig[:, i]
+    date_ok = date_ok & (ch[:, 4] == _MINUS) & (ch[:, 7] == _MINUS)
+    y = (digits[:, 0] * 1000 + digits[:, 1] * 100
+         + digits[:, 2] * 10 + digits[:, 3])
+    m = digits[:, 5] * 10 + digits[:, 6]
+    d = digits[:, 8] * 10 + digits[:, 9]
+    days = DT.days_from_civil(jnp, y, m, d)
+    ry, rm, rd = DT.civil_from_days(jnp, days)
+    civil_ok = (ry == y) & (rm == m) & (rd == d)
+
+    time_ok = jnp.ones(n, dtype=bool)
+    for i in (11, 12, 14, 15, 17, 18):
+        time_ok = time_ok & isdig[:, i]
+    sep = ch[:, 10]
+    time_ok = time_ok & ((sep == 0x20) | (sep == 0x54))  # ' ' | 'T'
+    time_ok = time_ok & (ch[:, 13] == 0x3A) & (ch[:, 16] == 0x3A)
+    hh = digits[:, 11] * 10 + digits[:, 12]
+    mi = digits[:, 14] * 10 + digits[:, 15]
+    ss = digits[:, 17] * 10 + digits[:, 18]
+    time_ok = time_ok & (hh < 24) & (mi < 60) & (ss < 60)
+
+    # fraction: optional '.' at 19 followed by a 1..6-digit run
+    has_dot = (lens > 19) & (ch[:, 19] == _DOT)
+    fd = jnp.zeros(n, jnp.int32)
+    going = has_dot
+    frac = jnp.zeros(n, dtype=jnp.int64)
+    for i in range(6):
+        p = 20 + i
+        going = going & (jnp.int32(p) < lens) & isdig[:, p]
+        fd = fd + going.astype(jnp.int32)
+        frac = jnp.where(going, frac * 10 + digits[:, p], frac)
+    frac_ok = ~has_dot | (fd >= 1)
+    p10 = jnp.asarray([10 ** k for k in range(7)], dtype=jnp.int64)
+    frac = frac * p10[jnp.clip(6 - fd, 0, 6)]
+
+    # zone suffix starts right after seconds or fraction
+    zstart = jnp.where(has_dot, 20 + fd, 19)
+    zlen = lens - zstart
+
+    def at(k):
+        pos = jnp.clip(zstart + k, 0, maxw - 1)
+        v = jnp.take_along_axis(ch, pos[:, None], axis=1)[:, 0]
+        return jnp.where(zstart + k < lens, v, 0).astype(jnp.int32)
+
+    def dg(k):
+        return at(k) - _ZERO
+
+    def isd(k):
+        v = dg(k)
+        return (v >= 0) & (v <= 9)
+
+    sign_ch = at(0)
+    signed = (sign_ch == _PLUS) | (sign_ch == _MINUS)
+    z_utc = (zlen == 1) & (at(0) == 0x5A)  # 'Z'
+    z_hh = (zlen == 3) & signed & isd(1) & isd(2)
+    z_hhmm = (zlen == 5) & signed & isd(1) & isd(2) & isd(3) & isd(4)
+    z_colon = (zlen == 6) & signed & isd(1) & isd(2) & (at(3) == 0x3A) \
+        & isd(4) & isd(5)
+    off_h = dg(1) * 10 + dg(2)
+    off_m = jnp.where(z_hhmm, dg(3) * 10 + dg(4),
+                      jnp.where(z_colon, dg(4) * 10 + dg(5), 0))
+    zone_ok = z_utc | ((z_hh | z_hhmm | z_colon)
+                       & (off_h < 24) & (off_m < 60))
+    off_us = jnp.where(z_utc, 0,
+                       (off_h * 3600 + off_m * 60).astype(jnp.int64)
+                       * 1_000_000)
+    off_us = jnp.where(sign_ch == _MINUS, -off_us, off_us)
+
+    ok = date_ok & civil_ok & time_ok & frac_ok & zone_ok
+    us = (days.astype(jnp.int64) * 86_400_000_000
+          + (hh * 3600 + mi * 60 + ss).astype(jnp.int64) * 1_000_000
+          + frac - off_us)
+    nonempty = lens > 0
+    validity = ok & nonempty
+    malformed = nonempty & ~validity
+    return jnp.where(validity, us, 0), validity, malformed
+
+
+MAXW_TS = 32  # 19 + .ffffff (7) + ±HH:MM (6)
+
+
+def decode_date_column(table: FieldTable, col_idx: int, cap: int):
+    n = table.num_rows
+    starts = np.zeros(cap, dtype=np.int32)
+    lens = np.zeros(cap, dtype=np.int32)
+    starts[:n] = table.starts[:, col_idx]
+    lens[:n] = table.lens[:, col_idx]
+    row_mask = jnp.arange(cap) < n
+    val, validity, malformed = _parse_date_kernel(
+        table.device_raw(), jnp.asarray(starts), jnp.asarray(lens), 10)
+    malformed = malformed & row_mask
+    return val, validity & row_mask, jnp.any(malformed)
+
+
+def decode_timestamp_column(table: FieldTable, col_idx: int, cap: int):
+    n = table.num_rows
+    starts = np.zeros(cap, dtype=np.int32)
+    lens = np.zeros(cap, dtype=np.int32)
+    starts[:n] = table.starts[:, col_idx]
+    lens[:n] = table.lens[:, col_idx]
+    row_mask = jnp.arange(cap) < n
+    val, validity, malformed = _parse_timestamp_kernel(
+        table.device_raw(), jnp.asarray(starts), jnp.asarray(lens), MAXW_TS)
+    malformed = malformed & row_mask
+    return val, validity & row_mask, jnp.any(malformed)
+
+
 def _null_sentinels() -> List[bytes]:
     """pyarrow's default CSV null spellings, read at runtime so the device
     path can never drift from the host oracle's list (the boundary scan
@@ -458,6 +622,8 @@ def device_parseable(dtype: DataType) -> bool:
         return True
     if dtype is DataType.STRING:
         return True
+    if dtype in (DataType.DATE, DataType.TIMESTAMP):
+        return True
     if dtype is DataType.FLOAT64:
         # the exact-rounding argument needs a real f64 division on device.
         # FLOAT32 stays on the host: parse-f64-then-narrow double-rounds,
@@ -473,6 +639,10 @@ def decode_column(table: FieldTable, col_idx: int, dtype: DataType,
                   cap: int):
     if dtype in FLOATS:
         return decode_float_column(table, col_idx, dtype, cap)
+    if dtype is DataType.DATE:
+        return decode_date_column(table, col_idx, cap)
+    if dtype is DataType.TIMESTAMP:
+        return decode_timestamp_column(table, col_idx, cap)
     return decode_int_column(table, col_idx, dtype, cap)
 
 

@@ -58,10 +58,11 @@ class Cast(UnaryExpression):
         if frm is DataType.DATE and to in (DataType.TIMESTAMP, DataType.STRING,
                                            DataType.INT32):
             return True
-        if frm is DataType.TIMESTAMP and to in (DataType.DATE, DataType.INT64):
+        if frm is DataType.TIMESTAMP and to in (DataType.DATE, DataType.INT64,
+                                                DataType.STRING):
             return True
-        if frm in (DataType.INT8, DataType.INT16, DataType.INT32,
-                   DataType.INT64) and to is DataType.STRING:
+        if frm in (DataType.BOOL, DataType.INT8, DataType.INT16,
+                   DataType.INT32, DataType.INT64) and to is DataType.STRING:
             return True
         if frm is DataType.INT64 and to is DataType.TIMESTAMP:
             return True
@@ -205,6 +206,8 @@ class Cast(UnaryExpression):
             return F.int_to_string(ctx, v)
         if frm is DataType.DATE:
             return F.date_to_string(ctx, v)
+        if frm is DataType.TIMESTAMP:
+            return F.timestamp_to_string(ctx, v)
         raise NotImplementedError(f"device cast {frm} -> STRING")
 
     def _to_string_host(self, ctx, v, frm):
@@ -276,9 +279,14 @@ def _ts_str(micros: int) -> str:
     import datetime
 
     dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=micros)
+    # explicit field formatting, not strftime: glibc's %Y does not
+    # zero-pad years < 1000, while Spark (DateTimeFormatter yyyy) and the
+    # device kernel (columnar/format.py:timestamp_to_string) both do
+    base = (f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d} "
+            f"{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}")
     if dt.microsecond:
-        return dt.strftime("%Y-%m-%d %H:%M:%S.%f").rstrip("0")
-    return dt.strftime("%Y-%m-%d %H:%M:%S")
+        return f"{base}.{dt.microsecond:06d}".rstrip("0")
+    return base
 
 
 def _parse_date(s: str) -> int:

@@ -292,3 +292,91 @@ def test_csv_non_utf8_both_engines_raise(session, tmp_path):
                     .csv(str(p), header=True).collect()
         finally:
             restore()
+
+
+def test_decode_date_column_values():
+    t = CD.plan_fields(
+        b"2020-01-01,x\n1969-12-31,y\n,z\n2000-02-29,w\n", 2, header=False)
+    assert t is not None
+    import jax
+
+    d, v, bad = CD.decode_date_column(t, 0, 8)
+    assert not bool(jax.device_get(bad))
+    vals = jax.device_get(d)
+    valid = jax.device_get(v)
+    assert list(valid[:4]) == [True, True, False, True]
+    assert vals[0] == 18262 and vals[1] == -1 and vals[3] == 11016
+
+
+def test_decode_date_invalid_civil_aborts():
+    # Feb 30 is layout-valid but not a real date: whole split -> host,
+    # which raises the same conversion error both engines must raise
+    t = CD.plan_fields(b"2023-02-30,x\n", 2, header=False)
+    import jax
+
+    _d, _v, bad = CD.decode_date_column(t, 0, 8)
+    assert bool(jax.device_get(bad))
+
+
+def test_decode_timestamp_column_values():
+    t = CD.plan_fields(
+        b"2020-01-01 00:00:00Z,a\n"
+        b"2020-01-01T12:34:56.5Z,b\n"
+        b"2003-06-27 23:59:59.999999+00:00,c\n"
+        b"2020-01-01 02:00:00+02:00,d\n"
+        b"2020-01-01 00:00:00-0130,e\n"
+        b",f\n", 2, header=False)
+    import jax
+
+    d, v, bad = CD.decode_timestamp_column(t, 0, 8)
+    assert not bool(jax.device_get(bad))
+    vals = jax.device_get(d)
+    valid = jax.device_get(v)
+    assert list(valid[:6]) == [True, True, True, True, True, False]
+    base = 1577836800000000
+    assert vals[0] == base
+    assert vals[1] == base + (12 * 3600 + 34 * 60 + 56) * 10**6 + 500000
+    assert vals[3] == base  # +02:00 offset cancels the 02:00 local time
+    assert vals[4] == base + 5400 * 10**6  # -01:30 adds ninety minutes
+    # naive timestamp -> malformed (the tz=UTC host oracle rejects it)
+    t2 = CD.plan_fields(b"2020-01-01 00:00:00,a\n", 2, header=False)
+    _d, _v, bad2 = CD.decode_timestamp_column(t2, 0, 8)
+    assert bool(jax.device_get(bad2))
+
+
+def test_csv_date_timestamp_scan_equivalence(session, tmp_path, monkeypatch):
+    calls = []
+    for fname in ("decode_date_column", "decode_timestamp_column"):
+        orig = getattr(CD, fname)
+
+        def spy(table, col_idx, cap, _orig=orig, _f=fname):
+            calls.append(_f)
+            return _orig(table, col_idx, cap)
+
+        monkeypatch.setattr(CD, fname, spy)
+    rng = np.random.default_rng(11)
+    lines = []
+    for i in range(300):
+        day = int(rng.integers(0, 20000))
+        secs = int(rng.integers(0, 86400))
+        frac = int(rng.integers(0, 1_000_000))
+        d = np.datetime64(0, "D") + day
+        ts = f"{d} {secs // 3600:02d}:{secs % 3600 // 60:02d}" \
+             f":{secs % 60:02d}.{frac:06d}Z"
+        lines.append(f"{d},{ts},{i}")
+    lines[5] = f",{lines[5].split(',', 1)[1]}"   # NULL date
+    path = _write(tmp_path, "dt.csv", "\n".join(lines) + "\n")
+
+    def q(s):
+        return (s.read.schema([("d", "date"), ("t", "timestamp"),
+                               ("n", "long")])
+                .csv(path)
+                .withColumn("yr", F.year(F.col("d")))
+                .groupBy("yr").agg(F.count("*").alias("c"),
+                                   F.max("t").alias("mt"))
+                .orderBy("yr"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+    assert "decode_date_column" in calls, "device date decode did not engage"
+    assert "decode_timestamp_column" in calls, \
+        "device timestamp decode did not engage"

@@ -310,6 +310,26 @@ def test_cast_date_to_string():
     ]
 
 
+def test_cast_timestamp_to_string():
+    # device kernel must be byte-identical to the host strftime +
+    # fraction-rstrip formatting, incl. negative micros (floor to the
+    # previous second) and trailing-zero-stripped fractions
+    rows = check_exprs(DATE_BATCH, [CA.Cast(TS1, DataType.STRING)])
+    assert [r[0] for r in rows] == [
+        "1970-01-01 00:00:00", "2020-01-01 00:00:00",
+        "1969-12-31 23:59:59.999999", None,
+        "2023-12-31 23:59:59.999999", "1970-01-02 00:00:00",
+    ]
+    bt = make_batch(ts=([1_500_000, 123_450_000, 60_000_001],
+                        DataType.TIMESTAMP))
+    rows = check_exprs(bt, [CA.Cast(ref(0, DataType.TIMESTAMP),
+                                    DataType.STRING)])
+    assert [r[0] for r in rows] == [
+        "1970-01-01 00:00:01.5", "1970-01-01 00:02:03.45",
+        "1970-01-01 00:01:00.000001",
+    ]
+
+
 def test_bind_references():
     a = AttributeReference("a", DataType.INT32)
     b = AttributeReference("b", DataType.INT32)

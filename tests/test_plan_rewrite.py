@@ -152,6 +152,25 @@ def test_fallback_unsupported_expr(session):
     assert "TpuProjectExec" not in names
 
 
+def test_fallback_cast_without_device_kernel(session):
+    """Cast directions with no device kernel (string->int parse) must tag
+    the project for CPU fallback instead of crashing the device kernel
+    (reference: per-direction cast gates, GpuCast.scala /
+    RapidsConf.scala:393-425)."""
+    import numpy as np
+
+    def fn(s):
+        df = s.createDataFrame(
+            {"x": np.array(["1", "22", None, " 333 ", "4.5"], dtype=object)},
+            [("x", "string")], num_partitions=2)
+        return df.select(F.col("x").cast("int").alias("n"))
+
+    cpu = fn(session).collect()
+    tpu = run_on_tpu(session, fn, allowed_non_tpu=["CpuProjectExec"])
+    assert sorted(cpu, key=repr) == sorted(tpu, key=repr)
+    assert sorted(cpu, key=repr) == [(1,), (22,), (333,), (4,), (None,)]
+
+
 def test_strict_mode_raises_on_fallback(session):
     def fn(s):
         df = s.range(0, 10)

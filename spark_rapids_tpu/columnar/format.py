@@ -73,19 +73,49 @@ def _bool_to_string(ctx, v: ColV) -> ColV:
     return ColV(DataType.STRING, data, v.validity, offsets)
 
 
+_YEAR_W = 8  # sign + up to 7 digits (int32 days reach years +-5.8M)
+
+
+def _year_field(cap: int, y):
+    """Right-aligned year chars in an 8-wide field + per-row year length.
+    Formatting convention shared with the host (ops/cast.py:_year_str):
+    4-digit zero-padded inside [0, 9999]; explicit sign + >= 4 zero-padded
+    digits outside (Java DateTimeFormatter SignStyle.EXCEEDS_PAD, the
+    convention Spark's uuuu pattern uses)."""
+    ay = jnp.abs(y.astype(jnp.int64))
+    nd = jnp.full((cap,), 4, jnp.int32)
+    for p in (10_000, 100_000, 1_000_000, 10_000_000):
+        nd = nd + (ay >= p).astype(jnp.int32)
+    signed = (y < 0) | (y > 9999)
+    ylen = nd + signed.astype(jnp.int32)
+    p10 = jnp.asarray([10 ** k for k in range(8)], dtype=jnp.int64)
+    cols = []
+    for j in range(_YEAR_W):
+        k = _YEAR_W - 1 - j           # digit index from the right
+        digit = (ord("0") + (ay // p10[k]) % 10).astype(jnp.int32)
+        sign_ch = jnp.where(y < 0, ord("-"), ord("+"))
+        is_sign = signed & (k == nd)
+        cols.append(jnp.where(is_sign, sign_ch,
+                              jnp.where(k < nd, digit, 0)))
+    return cols, ylen
+
+
 def timestamp_to_string(ctx, v: ColV) -> ColV:
     """Format int64 epoch-micros as 'YYYY-MM-DD HH:MM:SS[.ffffff]' with the
-    fraction's trailing zeros stripped — byte-identical to the host oracle's
-    strftime + rstrip('0') formatting (ops/cast.py:_ts_str; the cuDF analog
-    is its timestamp cast-to-string kernel behind GpuCast.scala). Years
-    assumed in [0, 9999], the same convention as date_to_string.
+    fraction's trailing zeros stripped — byte-identical to the host
+    oracle's integer formatter over the FULL int64 domain (ops/cast.py:
+    _ts_str; the cuDF analog is the timestamp cast-to-string kernel behind
+    GpuCast.scala). Wide years carry an explicit sign per _year_field's
+    convention.
 
-    Build: a fixed 26-byte-per-row template (the maximal layout) packed to
-    variable widths with one build_from_plan gather — no host sync."""
+    Build: a fixed 30-byte-per-row template (8-wide right-aligned year +
+    maximal tail) packed to variable widths with one per-row-start-shifted
+    build_from_plan gather — no host sync."""
     from spark_rapids_tpu.columnar.strings import build_from_plan
     from spark_rapids_tpu.ops import datetimeops as DT
 
     cap = ctx.capacity
+    W = _YEAR_W + 22  # '-MM-DD HH:MM:SS' (15) + '.ffffff' (7)
     DAY = 86_400_000_000
     us = v.data.astype(jnp.int64)
     days = jnp.floor_divide(us, DAY)
@@ -101,15 +131,16 @@ def timestamp_to_string(ctx, v: ColV) -> ColV:
     for k in (10, 100, 1000, 10_000, 100_000):
         tz = tz + ((frac % k) == 0).astype(jnp.int32)
     fdigits = jnp.where(frac == 0, 0, 6 - tz)
-    out_len = jnp.where(frac == 0, 19, 20 + fdigits)
+    year_cols, ylen = _year_field(cap, y)
+    out_len = ylen + 15 + jnp.where(frac == 0, 0, 1 + fdigits)
 
     def dig(x, p):
         return (ord("0") + x // p % 10).astype(jnp.int32)
 
     dash = jnp.full((cap,), ord("-"), jnp.int32)
     colon = jnp.full((cap,), ord(":"), jnp.int32)
-    template = jnp.stack([
-        dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1), dash,
+    template = jnp.stack(year_cols + [
+        dash,
         dig(m, 10), dig(m, 1), dash,
         dig(d, 10), dig(d, 1), jnp.full((cap,), ord(" "), jnp.int32),
         dig(hh, 10), dig(hh, 1), colon,
@@ -117,43 +148,36 @@ def timestamp_to_string(ctx, v: ColV) -> ColV:
         dig(ss, 10), dig(ss, 1), jnp.full((cap,), ord("."), jnp.int32),
         dig(frac, 100_000), dig(frac, 10_000), dig(frac, 1000),
         dig(frac, 100), dig(frac, 10), dig(frac, 1),
-    ], axis=1).astype(jnp.uint8).reshape(cap * 26)
-    starts = (jnp.arange(cap, dtype=jnp.int32) * 26)
+    ], axis=1).astype(jnp.uint8).reshape(cap * W)
+    starts = (jnp.arange(cap, dtype=jnp.int32) * W) + (_YEAR_W - ylen)
     lens = jnp.where(v.validity, out_len, 0)
     data, offsets = build_from_plan(
-        [template], jnp.zeros((cap,), jnp.int32), starts, lens, 26 * cap)
+        [template], jnp.zeros((cap,), jnp.int32), starts, lens, W * cap)
     return ColV(DataType.STRING, data, v.validity, offsets)
 
 
 def date_to_string(ctx, v: ColV) -> ColV:
-    """Format int32 epoch-days as 'YYYY-MM-DD' (fixed 10 bytes; years assumed
-    in [0, 9999] — the meta layer restricts the cast like the reference
-    restricts timestamps to UTC)."""
+    """Format int32 epoch-days as 'YYYY-MM-DD' over the full int32 domain —
+    byte-identical to the host formatter (ops/cast.py:_date_str); wide
+    years carry an explicit sign per _year_field's convention."""
+    from spark_rapids_tpu.columnar.strings import build_from_plan
     from spark_rapids_tpu.ops import datetimeops as DT
 
     cap = ctx.capacity
+    W = _YEAR_W + 6  # '-MM-DD'
     y, m, d = DT.civil_from_days(jnp, v.data.astype(jnp.int64))
-    out_len = jnp.full((cap,), 10, dtype=jnp.int32)
-    offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32),
-         jnp.cumsum(jnp.where(v.validity, out_len, 0), dtype=jnp.int32)]
-    )
-    byte_cap = 10 * cap
-    pos = jnp.arange(byte_cap, dtype=jnp.int32)
-    row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
-                   0, cap - 1).astype(jnp.int32)
-    within = pos - offsets[row]
-    yy, mm, dd = y[row], m[row], d[row]
-    # positions: 0123 4 56 7 89 -> Y Y Y Y - M M - D D
-    digits = jnp.stack([
-        yy // 1000 % 10, yy // 100 % 10, yy // 10 % 10, yy % 10,
-        jnp.full_like(yy, -1),
-        mm // 10 % 10, mm % 10,
-        jnp.full_like(yy, -1),
-        dd // 10 % 10, dd % 10,
-    ], axis=1)  # [byte_cap, 10] — already indexed per byte position via row
-    ch = digits[jnp.arange(byte_cap), jnp.clip(within, 0, 9)]
-    byte = jnp.where(ch < 0, ord("-"), ord("0") + ch)
-    in_range = pos < offsets[-1]
-    data = jnp.where(in_range, byte, 0).astype(jnp.uint8)
+    year_cols, ylen = _year_field(cap, y)
+
+    def dig(x, p):
+        return (ord("0") + x // p % 10).astype(jnp.int32)
+
+    dash = jnp.full((cap,), ord("-"), jnp.int32)
+    template = jnp.stack(year_cols + [
+        dash, dig(m, 10), dig(m, 1), dash, dig(d, 10), dig(d, 1),
+    ], axis=1).astype(jnp.uint8).reshape(cap * W)
+    starts = (jnp.arange(cap, dtype=jnp.int32) * W) + (_YEAR_W - ylen)
+    out_len = ylen + 6
+    lens = jnp.where(v.validity, out_len, 0)
+    data, offsets = build_from_plan(
+        [template], jnp.zeros((cap,), jnp.int32), starts, lens, W * cap)
     return ColV(DataType.STRING, data, v.validity, offsets)

@@ -134,6 +134,28 @@ def _gathered_segment(op_fn, pos_vals, gid, capacity):
     return red[safe]
 
 
+def _run_start(change, pos):
+    """Per-row position of the current run's FIRST row. Runs are monotone
+    along the sorted domain (change marks run starts, row 0 of live data
+    always marked), so a cumulative max of the marked positions carries the
+    latest start forward — a log-depth scan instead of the scatter-reduce +
+    gather this replaces (unsorted scatters are the slow path on TPU; see
+    docs/tuning-guide.md 'int64 on TPU')."""
+    return jax.lax.cummax(jnp.where(change, pos, jnp.int32(0)))
+
+
+def _run_end(change, pos, live_s, cap: int):
+    """Per-row position of the current run's LAST row: reverse cumulative
+    min over marked run-end positions (a run ends where the NEXT row starts
+    a new run or leaves the live region). Pad rows yield `cap`; callers
+    mask by live_s."""
+    nxt_change = jnp.concatenate([change[1:], jnp.ones((1,), bool)])
+    nxt_live = jnp.concatenate([live_s[1:], jnp.zeros((1,), bool)])
+    is_end = (nxt_change | ~nxt_live) & live_s
+    rev = jnp.flip(jnp.where(is_end, pos, jnp.int32(cap)))
+    return jnp.flip(jax.lax.cummin(rev))
+
+
 # ===========================================================================
 # TPU exec
 # ===========================================================================
@@ -217,21 +239,10 @@ class TpuWindowExec(_WindowBase, TpuExec):
                     peer_change = peer_change | \
                         (p.null_flag[perm] != p.null_flag[prev])
                 peer_change = peer_change & live_s
-                qgid = jnp.where(live_s,
-                                 jnp.cumsum(peer_change.astype(jnp.int32)) - 1,
-                                 cap)
-                start = _gathered_segment(jax.ops.segment_min,
-                                          jnp.where(live_s, pos, cap),
-                                          pgid, cap)
-                end = _gathered_segment(jax.ops.segment_max,
-                                        jnp.where(live_s, pos, -1),
-                                        pgid, cap)
-                peer_end = _gathered_segment(jax.ops.segment_max,
-                                             jnp.where(live_s, pos, -1),
-                                             qgid, cap)
-                peer_start = _gathered_segment(jax.ops.segment_min,
-                                               jnp.where(live_s, pos, cap),
-                                               qgid, cap)
+                start = _run_start(part_change, pos)
+                end = _run_end(part_change, pos, live_s, cap)
+                peer_start = _run_start(peer_change, pos)
+                peer_end = _run_end(peer_change, pos, live_s, cap)
 
                 # single numeric ORDER BY column -> sorted-domain key for
                 # bounded RANGE frames (reference:
@@ -258,7 +269,7 @@ class TpuWindowExec(_WindowBase, TpuExec):
                 outs = []
                 for w, in_cv in zip(wexprs, in_cols):
                     res = _eval_window_fn(
-                        w, in_cv, perm, live_s, pos, pgid, qgid, start, end,
+                        w, in_cv, perm, live_s, pos, pgid, start, end,
                         peer_end, peer_change, cap,
                         peer_start=peer_start, range_ord=range_ord)
                     outs.append(res)
@@ -305,7 +316,7 @@ class TpuWindowExec(_WindowBase, TpuExec):
 
 
 def _eval_window_fn(w: WindowExpression, in_cv, perm, live_s, pos, pgid,
-                    qgid, start, end, peer_end, peer_change, cap: int,
+                    start, end, peer_end, peer_change, cap: int,
                     peer_start=None, range_ord=None):
     """Compute one window expression in the sorted domain."""
     f = w.function
@@ -313,9 +324,8 @@ def _eval_window_fn(w: WindowExpression, in_cv, perm, live_s, pos, pgid,
     if isinstance(f, RowNumber):
         return (pos - start + 1).astype(jnp.int32), live_s
     if isinstance(f, Rank):
-        first_peer = _gathered_segment(jax.ops.segment_min,
-                                       jnp.where(live_s, pos, cap), qgid, cap)
-        return (first_peer - start + 1).astype(jnp.int32), live_s
+        # peer_start IS each row's first-peer position (scan-computed)
+        return (peer_start - start + 1).astype(jnp.int32), live_s
     if isinstance(f, DenseRank):
         pf = jnp.cumsum(peer_change.astype(jnp.int32))
         pf_at_start = pf[jnp.clip(start, 0, cap - 1)]

@@ -522,30 +522,29 @@ def _parse_timestamp_kernel(raw, starts, lens, maxw: int):
 MAXW_TS = 32  # 19 + .ffffff (7) + ±HH:MM (6)
 
 
-def decode_date_column(table: FieldTable, col_idx: int, cap: int):
+def _decode_with_kernel(kernel, maxw: int, table: FieldTable, col_idx: int,
+                        cap: int):
+    """Shared (starts, lens) padding + row/malformed masking around a
+    field-parse kernel (same contract as decode_int_column)."""
     n = table.num_rows
     starts = np.zeros(cap, dtype=np.int32)
     lens = np.zeros(cap, dtype=np.int32)
     starts[:n] = table.starts[:, col_idx]
     lens[:n] = table.lens[:, col_idx]
     row_mask = jnp.arange(cap) < n
-    val, validity, malformed = _parse_date_kernel(
-        table.device_raw(), jnp.asarray(starts), jnp.asarray(lens), 10)
-    malformed = malformed & row_mask
-    return val, validity & row_mask, jnp.any(malformed)
+    val, validity, malformed = kernel(table.device_raw(),
+                                      jnp.asarray(starts),
+                                      jnp.asarray(lens), maxw)
+    return val, validity & row_mask, jnp.any(malformed & row_mask)
+
+
+def decode_date_column(table: FieldTable, col_idx: int, cap: int):
+    return _decode_with_kernel(_parse_date_kernel, 10, table, col_idx, cap)
 
 
 def decode_timestamp_column(table: FieldTable, col_idx: int, cap: int):
-    n = table.num_rows
-    starts = np.zeros(cap, dtype=np.int32)
-    lens = np.zeros(cap, dtype=np.int32)
-    starts[:n] = table.starts[:, col_idx]
-    lens[:n] = table.lens[:, col_idx]
-    row_mask = jnp.arange(cap) < n
-    val, validity, malformed = _parse_timestamp_kernel(
-        table.device_raw(), jnp.asarray(starts), jnp.asarray(lens), MAXW_TS)
-    malformed = malformed & row_mask
-    return val, validity & row_mask, jnp.any(malformed)
+    return _decode_with_kernel(_parse_timestamp_kernel, MAXW_TS, table,
+                               col_idx, cap)
 
 
 def _null_sentinels() -> List[bytes]:

@@ -19,7 +19,9 @@ split keeps the same shape:
 
 Scope: flat INT32/INT64 (+DATE/TIMESTAMP, and FLOAT32/FLOAT64 where
 the backend has f64) and dictionary-encoded STRING columns; v1 AND v2 data
-pages encoded PLAIN or RLE_DICTIONARY/PLAIN_DICTIONARY; UNCOMPRESSED,
+pages encoded PLAIN, RLE_DICTIONARY/PLAIN_DICTIONARY, or (for integral
+columns) DELTA_BINARY_PACKED — the delta recurrence decodes as ONE device
+cumsum over miniblock-unpacked deltas, bit widths to 56; UNCOMPRESSED,
 SNAPPY, GZIP, ZSTD and BROTLI codecs.  Compressed pages decompress on the
 HOST (block decompression is control-plane: inherently serial bit-stream
 work; the reference does it inside cuDF but the data-plane win — run
@@ -160,6 +162,7 @@ PAGE_DATA_V2 = 3
 ENC_PLAIN = 0
 ENC_PLAIN_DICT = 2
 ENC_RLE = 3
+ENC_DELTA_BINARY = 5
 ENC_RLE_DICT = 8
 
 
@@ -450,6 +453,82 @@ def _expand_hybrid(chunk_u8, out_start, is_rle, value, bit_off,
                      packed).astype(jnp.int32)
 
 
+def _parse_delta_header(chunk: bytes, pos: int, end: int, n_values: int):
+    """Host control plane for one DELTA_BINARY_PACKED page: walk the block/
+    miniblock headers into per-miniblock tables (bit offset, width,
+    min_delta) — runs-not-values, same discipline as parse_runs. Returns
+    (first_value, vpm, mb_bit_off, mb_width, mb_min_delta)."""
+    r = _Compact(chunk, pos)
+    block_size = r.varint()
+    mbs_per_block = r.varint()
+    total = r.varint()
+    first_value = r.zigzag()
+    if total != n_values:
+        raise _Unsupported(
+            f"delta page count {total} != page num_values {n_values}")
+    if mbs_per_block <= 0 or block_size % (8 * mbs_per_block) != 0:
+        raise _Unsupported("malformed delta block geometry")
+    vpm = block_size // mbs_per_block
+    ndeltas = total - 1
+    mb_off: List[int] = []
+    mb_w: List[int] = []
+    mb_md: List[int] = []
+    idx = 0
+    while idx < ndeltas:
+        if r.pos >= end:
+            raise _Unsupported("truncated delta page")
+        min_delta = r.zigzag()
+        widths = chunk[r.pos:r.pos + mbs_per_block]
+        if len(widths) < mbs_per_block:
+            raise _Unsupported("truncated delta miniblock widths")
+        r.pos += mbs_per_block
+        for w in widths:
+            if idx >= ndeltas:
+                break  # trailing miniblocks of the last block carry no data
+            if w > 56:
+                # the 8-byte LE bit-window below covers w + 7 shift bits
+                raise _Unsupported(f"delta miniblock bit width {w}")
+            mb_off.append(r.pos * 8)
+            mb_w.append(int(w))
+            mb_md.append(min_delta)
+            r.pos += vpm * int(w) // 8
+            idx += vpm
+        if r.pos > end:
+            raise _Unsupported("delta miniblock data past page end")
+    if not mb_off:  # 0- or 1-value page: kernel still wants non-empty tables
+        mb_off, mb_w, mb_md = [0], [0], [0]
+    return (first_value, vpm, np.asarray(mb_off, np.int64),
+            np.asarray(mb_w, np.int32), np.asarray(mb_md, np.int64))
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _expand_delta(chunk_u8, mb_bit_off, mb_width, mb_min_delta,
+                  vpm: int, cap: int):
+    """DELTA_BINARY_PACKED device expansion: unpack each miniblock-packed
+    delta with an 8-byte LE bit window (width <= 56), add its miniblock's
+    min_delta, then ONE cumulative sum rebuilds the prefix — the
+    delta-decode recurrence is exactly a cumsum, the most TPU-friendly
+    shape it could take. Returns the per-index delta PREFIX (value_i -
+    first_value); the caller adds first_value."""
+    i = jnp.arange(cap, dtype=jnp.int32)
+    d = i - 1                    # delta feeding value i (none for i == 0)
+    dc = jnp.clip(d, 0, cap - 1)
+    m = jnp.clip(dc // vpm, 0, mb_width.shape[0] - 1)
+    w = mb_width[m].astype(jnp.int64)
+    bitpos = mb_bit_off[m] + (dc % vpm).astype(jnp.int64) * w
+    byte = (bitpos >> 3).astype(jnp.int32)
+    shift = (bitpos & 7).astype(jnp.uint64)
+    nbytes = chunk_u8.shape[0]
+    word = jnp.zeros((cap,), dtype=jnp.uint64)
+    for o in range(8):
+        src = jnp.clip(byte + o, 0, nbytes - 1)
+        word = word | (chunk_u8[src].astype(jnp.uint64) << jnp.uint64(8 * o))
+    mask = (jnp.uint64(1) << w.astype(jnp.uint64)) - jnp.uint64(1)
+    vbits = (word >> shift) & mask
+    delta = vbits.astype(jnp.int64) + mb_min_delta[m]
+    return jnp.cumsum(jnp.where(d >= 0, delta, 0))
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def _extract_bits_lsb(chunk_u8, byte_start, count: int):
     """PLAIN-encoded booleans: one bit per value, LSB-first per byte."""
@@ -493,11 +572,15 @@ def column_eligible(col_meta, dtype: DataType) -> bool:
     encodings; reference analog: GpuParquetScan tagging)."""
     if not codec_supported(col_meta.compression):
         return False
-    ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}
+    ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
+              "DELTA_BINARY_PACKED"}
     if not set(col_meta.encodings) <= ok_enc:
         return False
     if col_meta.physical_type == "BYTE_ARRAY":
         # strings decode via dictionary gather OR plain (start, len) walk
+        # (DELTA_BYTE_ARRAY string pages are NOT in scope)
+        if "DELTA_BINARY_PACKED" in col_meta.encodings:
+            return False
         return dtype is DataType.STRING
     if col_meta.physical_type not in _PHYS_OK:
         return False
@@ -614,7 +697,8 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             continue
         is_bool = dtype is DataType.BOOL
         ok_encs = (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT) + \
-            ((ENC_RLE,) if is_bool else ())
+            ((ENC_RLE,) if is_bool else ()) + \
+            (() if (is_bool or is_string) else (ENC_DELTA_BINARY,))
         if p.encoding not in ok_encs:
             raise _Unsupported(f"data page encoding {p.encoding}")
         pos = p.data_start
@@ -686,6 +770,17 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         elif is_bool:  # PLAIN booleans: LSB-first bit-packed
             page_dense = _extract_bits_lsb(chunk_dev, jnp.int32(pos),
                                            page_cap)
+        elif p.encoding == ENC_DELTA_BINARY:
+            if not np.issubdtype(npdt, np.integer):
+                raise _Unsupported("DELTA_BINARY_PACKED on non-integral")
+            first_value, vpm, mb_off, mb_w, mb_md = _parse_delta_header(
+                chunk, pos, end, n_present)
+            prefix = _expand_delta(chunk_dev, jnp.asarray(mb_off),
+                                   jnp.asarray(mb_w), jnp.asarray(mb_md),
+                                   vpm, page_cap)
+            # int64 arithmetic wraps mod 2^64; the final astype wraps a
+            # 32-bit column the way the encoding's modular deltas require
+            page_dense = (jnp.int64(first_value) + prefix).astype(npdt)
         elif is_string:  # PLAIN byte-array: host (start, len) walk
             ps, pl = _parse_plain_strings(chunk, pos, end, n_present)
             str_plain.append((ps, pl))

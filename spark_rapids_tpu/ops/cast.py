@@ -270,22 +270,41 @@ class Cast(UnaryExpression):
         return ColV(to, out, validity & v.validity)
 
 def _date_str(days: int) -> str:
-    import datetime
+    # integer civil math, not datetime.date (which caps years at 9999 and
+    # raises beyond; DATE is the full int32 days domain). Byte-identical
+    # to the device kernel (columnar/format.py:date_to_string).
+    from spark_rapids_tpu.ops import datetimeops as DT
 
-    return (datetime.date(1970, 1, 1) + datetime.timedelta(days=days)).isoformat()
+    y, m, d = DT.civil_from_days(np, np.asarray([days], dtype=np.int64))
+    return f"{_year_str(int(y[0]))}-{int(m[0]):02d}-{int(d[0]):02d}"
+
+
+def _year_str(y: int) -> str:
+    """Year formatting shared by date/timestamp casts: 4-digit zero-padded
+    inside [0, 9999], explicit sign + >= 4 digits outside (Java
+    DateTimeFormatter SignStyle.EXCEEDS_PAD, which Spark's uuuu pattern
+    uses: 10000 -> '+10000', -5 -> '-0005')."""
+    if 0 <= y <= 9999:
+        return f"{y:04d}"
+    sign = "-" if y < 0 else "+"
+    return f"{sign}{abs(y):04d}"
 
 
 def _ts_str(micros: int) -> str:
-    import datetime
+    # pure integer civil-calendar math, NOT datetime/strftime: datetime
+    # caps years at [1, 9999] (raising beyond) and glibc's %Y does not
+    # zero-pad — while SQL timestamps span the full int64 micros domain
+    # (years +-294k). Must stay byte-identical to the device kernel
+    # (columnar/format.py:timestamp_to_string).
+    from spark_rapids_tpu.ops import datetimeops as DT
 
-    dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=micros)
-    # explicit field formatting, not strftime: glibc's %Y does not
-    # zero-pad years < 1000, while Spark (DateTimeFormatter yyyy) and the
-    # device kernel (columnar/format.py:timestamp_to_string) both do
-    base = (f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d} "
-            f"{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}")
-    if dt.microsecond:
-        return f"{base}.{dt.microsecond:06d}".rstrip("0")
+    days, rem = divmod(micros, MICROS_PER_DAY)
+    y, m, d = DT.civil_from_days(np, np.asarray([days], dtype=np.int64))
+    secs, frac = divmod(rem, MICROS_PER_SEC)
+    base = (f"{_year_str(int(y[0]))}-{int(m[0]):02d}-{int(d[0]):02d} "
+            f"{secs // 3600:02d}:{secs % 3600 // 60:02d}:{secs % 60:02d}")
+    if frac:
+        return f"{base}.{frac:06d}".rstrip("0")
     return base
 
 

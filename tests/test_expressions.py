@@ -328,6 +328,16 @@ def test_cast_timestamp_to_string():
         "1970-01-01 00:00:01.5", "1970-01-01 00:02:03.45",
         "1970-01-01 00:01:00.000001",
     ]
+    # wide/negative years: sign + >= 4 zero-padded digits (SignStyle
+    # EXCEEDS_PAD, the Spark uuuu convention) on BOTH engines — SQL
+    # timestamps span the full int64 micros domain
+    year_10k = 253_402_300_800_000_000          # 10000-01-01
+    bce = -62_198_755_200_000_000               # year -1 (0002 BCE)
+    bt = make_batch(ts=([year_10k, bce], DataType.TIMESTAMP))
+    rows = check_exprs(bt, [CA.Cast(ref(0, DataType.TIMESTAMP),
+                                    DataType.STRING)])
+    assert rows[0][0] == "+10000-01-01 00:00:00"
+    assert rows[1][0] == "-0001-01-01 00:00:00"
 
 
 def test_bind_references():

@@ -18,10 +18,13 @@ split keeps the same shape:
   decoded column.
 
 Scope: flat INT32/INT64 (+DATE/TIMESTAMP, and FLOAT32/FLOAT64 where
-the backend has f64) and dictionary-encoded STRING columns; v1 AND v2 data
-pages encoded PLAIN, RLE_DICTIONARY/PLAIN_DICTIONARY, or (for integral
-columns) DELTA_BINARY_PACKED — the delta recurrence decodes as ONE device
-cumsum over miniblock-unpacked deltas, bit widths to 56; UNCOMPRESSED,
+the backend has f64) and STRING columns; v1 AND v2 data pages encoded
+PLAIN, RLE_DICTIONARY/PLAIN_DICTIONARY, DELTA_BINARY_PACKED (integrals:
+the delta recurrence decodes as ONE device cumsum over miniblock-unpacked
+deltas, bit widths to 56), DELTA_LENGTH_BYTE_ARRAY (strings: lengths ride
+the same delta kernel, byte starts are a device exclusive-sum), or
+BYTE_STREAM_SPLIT (fixed-width: strided plane gathers + bitcast);
+DELTA_BYTE_ARRAY prefix pages fall back. UNCOMPRESSED,
 SNAPPY, GZIP, ZSTD and BROTLI codecs.  Compressed pages decompress on the
 HOST (block decompression is control-plane: inherently serial bit-stream
 work; the reference does it inside cuDF but the data-plane win — run
@@ -163,7 +166,9 @@ ENC_PLAIN = 0
 ENC_PLAIN_DICT = 2
 ENC_RLE = 3
 ENC_DELTA_BINARY = 5
+ENC_DELTA_LENGTH = 6
 ENC_RLE_DICT = 8
+ENC_BYTE_STREAM_SPLIT = 9
 
 
 @dataclass
@@ -457,7 +462,9 @@ def _parse_delta_header(chunk: bytes, pos: int, end: int, n_values: int):
     """Host control plane for one DELTA_BINARY_PACKED page: walk the block/
     miniblock headers into per-miniblock tables (bit offset, width,
     min_delta) — runs-not-values, same discipline as parse_runs. Returns
-    (first_value, vpm, mb_bit_off, mb_width, mb_min_delta)."""
+    (first_value, vpm, mb_bit_off, mb_width, mb_min_delta, data_base)
+    where data_base is the first byte past the delta stream (the value
+    bytes of a DELTA_LENGTH_BYTE_ARRAY page start there)."""
     r = _Compact(chunk, pos)
     block_size = r.varint()
     mbs_per_block = r.varint()
@@ -498,7 +505,8 @@ def _parse_delta_header(chunk: bytes, pos: int, end: int, n_values: int):
     if not mb_off:  # 0- or 1-value page: kernel still wants non-empty tables
         mb_off, mb_w, mb_md = [0], [0], [0]
     return (first_value, vpm, np.asarray(mb_off, np.int64),
-            np.asarray(mb_w, np.int32), np.asarray(mb_md, np.int64))
+            np.asarray(mb_w, np.int32), np.asarray(mb_md, np.int64),
+            r.pos)  # r.pos = first byte past the delta stream
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5))
@@ -527,6 +535,40 @@ def _expand_delta(chunk_u8, mb_bit_off, mb_width, mb_min_delta,
     vbits = (word >> shift) & mask
     delta = vbits.astype(jnp.int64) + mb_min_delta[m]
     return jnp.cumsum(jnp.where(d >= 0, delta, 0))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _fold_flba_be(chunk_u8, byte_start, count: int, w: int):
+    """FIXED_LEN_BYTE_ARRAY decimals: w-byte big-endian two's-complement
+    unscaled values folded to int64 (the logical precision <= 18 guarantees
+    the value fits, so bytes beyond the low 8 are sign extension)."""
+    i = jnp.arange(count, dtype=jnp.int32)
+    base = byte_start + i * w
+    nbytes = chunk_u8.shape[0]
+    word = jnp.zeros((count,), dtype=jnp.uint64)
+    for k in range(min(w, 8)):  # k-th byte from the little end
+        src = jnp.clip(base + (w - 1 - k), 0, nbytes - 1)
+        word = word | (chunk_u8[src].astype(jnp.uint64) << jnp.uint64(8 * k))
+    if w < 8:
+        sign = (word >> jnp.uint64(8 * w - 1)) & jnp.uint64(1)
+        ext = jnp.uint64(((1 << 64) - 1) ^ ((1 << (8 * w)) - 1))
+        word = jnp.where(sign == 1, word | ext, word)
+    return word.astype(jnp.int64)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _decode_bss(chunk_u8, pos, n, cap: int, np_dtype_name: str):
+    """BYTE_STREAM_SPLIT: value i's byte k lives at pos + k*n + i (one
+    plane per byte, improving downstream compression). The device
+    re-interleaves with w strided gathers + one bitcast."""
+    dt = np.dtype(np_dtype_name)
+    w = dt.itemsize
+    i = jnp.arange(cap, dtype=jnp.int32)
+    nbytes = chunk_u8.shape[0]
+    planes = [chunk_u8[jnp.clip(pos + k * n + i, 0, nbytes - 1)]
+              for k in range(w)]
+    return jax.lax.bitcast_convert_type(
+        jnp.stack(planes, axis=1), jnp.dtype(dt))
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -573,16 +615,34 @@ def column_eligible(col_meta, dtype: DataType) -> bool:
     if not codec_supported(col_meta.compression):
         return False
     ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
-              "DELTA_BINARY_PACKED"}
+              "DELTA_BINARY_PACKED", "DELTA_LENGTH_BYTE_ARRAY",
+              "BYTE_STREAM_SPLIT"}
     if not set(col_meta.encodings) <= ok_enc:
         return False
     if col_meta.physical_type == "BYTE_ARRAY":
-        # strings decode via dictionary gather OR plain (start, len) walk
-        # (DELTA_BYTE_ARRAY string pages are NOT in scope)
-        if "DELTA_BINARY_PACKED" in col_meta.encodings:
+        # strings decode via dictionary gather, plain (start, len) walk,
+        # or device delta-length expansion (DELTA_BYTE_ARRAY prefix pages
+        # are NOT in scope — parquet reports them as DELTA_BYTE_ARRAY, so
+        # the ok_enc gate above already rejects them)
+        if "DELTA_BINARY_PACKED" in col_meta.encodings or \
+                "BYTE_STREAM_SPLIT" in col_meta.encodings:
             return False
         return dtype is DataType.STRING
+    if col_meta.physical_type == "FIXED_LEN_BYTE_ARRAY":
+        # FLBA decimals: big-endian unscaled fold (decode validates the
+        # byte length); any other FLBA use falls back
+        from spark_rapids_tpu.columnar.dtypes import is_decimal
+
+        return is_decimal(dtype) and "BYTE_STREAM_SPLIT" not in \
+            col_meta.encodings and "DELTA_BINARY_PACKED" not in \
+            col_meta.encodings
     if col_meta.physical_type not in _PHYS_OK:
+        return False
+    from spark_rapids_tpu.columnar.dtypes import is_decimal
+
+    if is_decimal(dtype) and col_meta.physical_type != "INT64":
+        # int64-width device paths would misread 4-byte unscaled values;
+        # INT64- and FLBA-physical decimals are the in-scope layouts
         return False
     if dtype is DataType.FLOAT64 and not device_float64_supported():
         return False
@@ -650,7 +710,7 @@ def _parse_dict_strings(chunk: bytes, start: int, n: int):
 
 def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                         max_def: int, cap: Optional[int] = None,
-                        codec: str = "UNCOMPRESSED"):
+                        codec: str = "UNCOMPRESSED", flba_len: int = 0):
     """Decode one raw column chunk into a device ColumnVector.
 
     Fixed-width columns: PLAIN / dictionary pages, v1 or v2. STRING
@@ -673,14 +733,25 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         chunk, pages = normalize_chunk(chunk, codec)
     else:
         pages = parse_pages(chunk)
+    from spark_rapids_tpu.columnar.dtypes import is_decimal
+
     cap = cap or bucket_capacity(max(num_rows, 1))
     is_string = dtype is DataType.STRING
+    # flba_len == 0 with a decimal dtype means the column is physical
+    # INT64 (column_eligible rejects other widths): the generic
+    # fixed-width paths below read it correctly since npdt is int64
+    is_dec_flba = is_decimal(dtype) and flba_len > 0
+    if is_dec_flba and not 1 <= flba_len <= 16:
+        raise _Unsupported(f"FLBA decimal byte length {flba_len}")
     npdt = np.dtype(np.int32) if is_string else physical_np_dtype(dtype)
     chunk_dev = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
 
     dict_vals = None          # fixed-width dictionary values (device)
     str_dict = None           # (bytes_dev, offs_dev, lens_dev) for strings
     str_plain = []            # per-page (starts_np, lens_np) for strings
+    str_delta = []            # per-page DEVICE (starts, lens, n) for
+                              # DELTA_LENGTH_BYTE_ARRAY strings
+    str_delta_bytes = 0       # host-known total value bytes across pages
     dense_parts = []
     valid_parts = []
     for p in pages:
@@ -690,6 +761,10 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                                                  p.num_values)
                 str_dict = (jnp.asarray(db), jnp.asarray(do),
                             jnp.asarray(dl))
+            elif is_dec_flba:
+                dict_vals = _fold_flba_be(chunk_dev,
+                                          jnp.int32(p.data_start),
+                                          p.num_values, flba_len)
             else:
                 dict_vals = _bitcast_values(
                     chunk_dev, jnp.int32(p.data_start), p.num_values,
@@ -698,7 +773,9 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         is_bool = dtype is DataType.BOOL
         ok_encs = (ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE_DICT) + \
             ((ENC_RLE,) if is_bool else ()) + \
-            (() if (is_bool or is_string) else (ENC_DELTA_BINARY,))
+            (() if (is_bool or is_string)
+             else (ENC_DELTA_BINARY, ENC_BYTE_STREAM_SPLIT)) + \
+            ((ENC_DELTA_LENGTH,) if is_string else ())
         if p.encoding not in ok_encs:
             raise _Unsupported(f"data page encoding {p.encoding}")
         pos = p.data_start
@@ -773,18 +850,47 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         elif p.encoding == ENC_DELTA_BINARY:
             if not np.issubdtype(npdt, np.integer):
                 raise _Unsupported("DELTA_BINARY_PACKED on non-integral")
-            first_value, vpm, mb_off, mb_w, mb_md = _parse_delta_header(
-                chunk, pos, end, n_present)
+            first_value, vpm, mb_off, mb_w, mb_md, _base = \
+                _parse_delta_header(chunk, pos, end, n_present)
             prefix = _expand_delta(chunk_dev, jnp.asarray(mb_off),
                                    jnp.asarray(mb_w), jnp.asarray(mb_md),
                                    vpm, page_cap)
             # int64 arithmetic wraps mod 2^64; the final astype wraps a
             # 32-bit column the way the encoding's modular deltas require
             page_dense = (jnp.int64(first_value) + prefix).astype(npdt)
+        elif p.encoding == ENC_DELTA_LENGTH and is_string:
+            # DELTA_LENGTH_BYTE_ARRAY: delta-packed lengths, then the
+            # value bytes concatenated — lengths expand through the SAME
+            # delta cumsum kernel and exclusive-summed into byte starts,
+            # all on device; total byte size is host-known from the page
+            # layout (no sync)
+            first_value, vpm, mb_off, mb_w, mb_md, data_base = \
+                _parse_delta_header(chunk, pos, end, n_present)
+            prefix = _expand_delta(chunk_dev, jnp.asarray(mb_off),
+                                   jnp.asarray(mb_w), jnp.asarray(mb_md),
+                                   vpm, page_cap)
+            in_page = jnp.arange(page_cap) < n_present
+            lens_dev = jnp.where(in_page, jnp.int64(first_value) + prefix, 0)
+            cl = jnp.cumsum(lens_dev)
+            starts_dev = jnp.int64(data_base) + cl - lens_dev
+            str_delta.append((starts_dev.astype(jnp.int32),
+                              lens_dev.astype(jnp.int32), n_present))
+            str_delta_bytes += max(0, end - data_base)
+            page_dense = None
+        elif p.encoding == ENC_BYTE_STREAM_SPLIT:
+            # npdt.itemsize == the file's physical width here: eligibility
+            # rejects FLOAT64 columns unless the device stores real f64
+            # (same assumption the PLAIN bitcast path makes)
+            page_dense = _decode_bss(chunk_dev, jnp.int32(pos),
+                                     jnp.int32(n_present), page_cap,
+                                     npdt.name)
         elif is_string:  # PLAIN byte-array: host (start, len) walk
             ps, pl = _parse_plain_strings(chunk, pos, end, n_present)
             str_plain.append((ps, pl))
             page_dense = None  # plain-string chunks skip dense assembly
+        elif is_dec_flba:  # PLAIN FLBA decimal: big-endian fold
+            page_dense = _fold_flba_be(chunk_dev, jnp.int32(pos),
+                                       page_cap, flba_len)
         else:  # PLAIN fixed-width
             page_dense = _bitcast_values(chunk_dev, jnp.int32(pos),
                                          page_cap, npdt.name)
@@ -801,8 +907,8 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
     else:
         validity = _concat_logical(
             [(v, n) for v, n in valid_parts], cap, False)
-    if not str_plain:
-        # plain-string chunks skip the dense assembly entirely — their
+    if not str_plain and not str_delta:
+        # plain/delta-length string chunks skip the dense assembly — their
         # values come from the (start, len) tables below
         if len(dense_parts) == 1:
             dense = _pad_to(dense_parts[0][0], cap, 0)
@@ -814,6 +920,22 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         return ColumnVector(dtype, data, validity)
     from spark_rapids_tpu.columnar.strings import build_from_plan
 
+    if str_delta:
+        if str_dict is not None or str_plain:
+            raise _Unsupported("mixed delta-length/other string pages")
+        # per-page DEVICE (start, len) tables from the delta expansion;
+        # total byte size came from the page layout — no sync
+        starts_dev = _concat_logical([(s, n) for s, _l, n in str_delta],
+                                     cap, 0)
+        lens_dev = _concat_logical([(l, n) for _s, l, n in str_delta],
+                                   cap, 0)
+        row_starts = _assemble(validity, starts_dev, cap)
+        row_lens = _assemble(validity, lens_dev, cap)
+        byte_cap = bucket_capacity(max(str_delta_bytes, 8))
+        out_bytes, offsets = build_from_plan(
+            [chunk_dev], jnp.zeros((cap,), jnp.int32),
+            row_starts, jnp.where(validity, row_lens, 0), byte_cap)
+        return ColumnVector(dtype, out_bytes, validity, offsets)
     if str_plain and str_dict is None:
         # PLAIN byte-array pages: per-present (start, len) from the host
         # walk; the device gathers the value bytes in one pass. Total byte

@@ -650,6 +650,10 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         max_def = {pf.schema.column(ci).name:
                    pf.schema.column(ci).max_definition_level
                    for ci in range(len(pf.schema.names))}
+        # FLBA byte length per column (decimals; 0 for other physicals)
+        flba_len = {pf.schema.column(ci).name:
+                    (getattr(pf.schema.column(ci), "length", 0) or 0)
+                    for ci in range(len(pf.schema.names))}
         data_attrs = [a for a in self.attrs if a.name not in pv]
         eligible = []
         for a in data_attrs:
@@ -675,7 +679,8 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     dev_cols[a.name] = PD.decode_chunk_device(
                         chunk, a.data_type, rows,
                         max_def=max_def.get(a.name, 1), cap=cap,
-                        codec=col.compression)
+                        codec=col.compression,
+                        flba_len=flba_len.get(a.name, 0))
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback
             hb = None

@@ -1112,3 +1112,132 @@ class TestParquetDeltaBinaryPacked:
                          F.count("ni").alias("cn")))
 
         assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+class TestParquetDeltaLengthAndBSS:
+    """DELTA_LENGTH_BYTE_ARRAY strings (lengths ride the delta cumsum
+    kernel, starts are a device exclusive-sum) and BYTE_STREAM_SPLIT
+    fixed-width columns (strided plane gathers + bitcast) decode on
+    device."""
+
+    def _write(self, tmp_path, name, comp="NONE", n=4000):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        rng = np.random.default_rng(23)
+        words = ["", "a", "bee", "seven77", "unicode-日本語",
+                 "longer-value-" + "x" * 40]
+        t = pa.table({
+            "s": pa.array([words[i % len(words)] if i % 11 else None
+                           for i in range(n)], type=pa.string()),
+            "f": pa.array(rng.random(n).astype(np.float32)),
+            "i": pa.array(rng.integers(-2**60, 2**60, n).astype(np.int64)),
+        })
+        path = str(tmp_path / name)
+        pq.write_table(
+            t, path, compression=comp, use_dictionary=False,
+            column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY",
+                             "f": "BYTE_STREAM_SPLIT",
+                             "i": "BYTE_STREAM_SPLIT"},
+            data_page_version="2.0", version="2.6")
+        return path
+
+    def test_decodes_on_device(self, session, tmp_path, monkeypatch):
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.io import parquet_device as PD
+
+        calls = []
+        for fname in ("_expand_delta", "_decode_bss"):
+            orig = getattr(PD, fname)
+
+            def spy(*a, _orig=orig, _f=fname, **k):
+                calls.append(_f)
+                return _orig(*a, **k)
+
+            monkeypatch.setattr(PD, fname, spy)
+        for comp in ("NONE", "SNAPPY"):
+            path = self._write(tmp_path, f"dlba_{comp}.parquet", comp=comp)
+            calls.clear()
+            assert_tpu_and_cpu_are_equal_collect(
+                session, lambda s: s.read.parquet(path), ignore_order=True,
+                approx_float=1e-6)
+            assert "_expand_delta" in calls, f"{comp}: delta-length strings"
+            assert "_decode_bss" in calls, f"{comp}: byte-stream-split"
+
+    def test_string_ops_after_delta_length_scan(self, session, tmp_path):
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.plan import functions as F
+
+        path = self._write(tmp_path, "dlba_ops.parquet")
+
+        def q(s):
+            df = s.read.parquet(path)
+            return (df.filter(F.length(F.col("s")) > F.lit(2))
+                    .groupBy("s").agg(F.count("*").alias("c"),
+                                      F.max("i").alias("m")))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+class TestParquetDecimalDeviceDecode:
+    """FLBA-physical decimal columns decode on device: big-endian unscaled
+    fold (plain + dictionary pages), precision <= 18 guarantees the value
+    fits int64."""
+
+    def _write(self, tmp_path, name, comp="NONE", use_dict=True, n=2500):
+        from decimal import Decimal
+
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        rng = np.random.default_rng(31)
+        cents = rng.integers(-10**7, 10**7, n)
+        vals = [Decimal(int(c)).scaleb(-2) if i % 13 else None
+                for i, c in enumerate(cents)]
+        wide = [Decimal(int(c)) * 10**9 for c in cents]  # needs > 4 bytes
+        t = pa.table({
+            "d": pa.array(vals, type=pa.decimal128(9, 2)),
+            "w": pa.array(wide, type=pa.decimal128(18, 0)),
+            "k": pa.array((np.arange(n) % 7).astype(np.int64)),
+        })
+        path = str(tmp_path / name)
+        pq.write_table(t, path, compression=comp, use_dictionary=use_dict,
+                       data_page_version="1.0")
+        return path
+
+    @pytest.mark.parametrize("use_dict,comp", [
+        (True, "NONE"), (False, "NONE"), (True, "SNAPPY")])
+    def test_decimal_decodes_on_device(self, session, tmp_path, monkeypatch,
+                                       use_dict, comp):
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.io import parquet_device as PD
+
+        calls = []
+        orig = PD._fold_flba_be
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(PD, "_fold_flba_be", spy)
+        path = self._write(tmp_path, f"dec_{use_dict}_{comp}.parquet",
+                           comp=comp, use_dict=use_dict)
+        assert_tpu_and_cpu_are_equal_collect(
+            session, lambda s: s.read.parquet(path), ignore_order=True)
+        assert calls, "FLBA decimal device decode did not engage"
+
+    def test_decimal_agg_after_device_scan(self, session, tmp_path):
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.plan import functions as F
+
+        path = self._write(tmp_path, "dec_agg.parquet")
+
+        def q(s):
+            return (s.read.parquet(path)
+                    .groupBy("k")
+                    .agg(F.sum("d").alias("sd"), F.max("w").alias("mw"),
+                         F.count("d").alias("cd")))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)

@@ -312,6 +312,17 @@ ENABLE_FLOAT_AGG = _conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(
     "(reference: spark.rapids.sql.variableFloatAgg.enabled)."
 ).boolean(True)
 
+ENABLE_INT64_NARROWING = _conf("rapids.tpu.sql.int64.narrowing.enabled").doc(
+    "Let device kernels compute logically-int64 expressions in int32 lanes "
+    "when column value-range metadata proves the result is identical "
+    "(ranges come from upload-time min/max and parquet footer statistics). "
+    "XLA emulates int64 on TPU as 32-bit pairs at a measured ~9.8x cost "
+    "(docs/tuning-guide.md 'int64 on TPU'); narrowing removes that cost "
+    "for in-range data with no semantic change. SQL results, hashes, and "
+    "stored batches are unaffected — this only changes in-kernel compute "
+    "width where exactness is provable."
+).boolean(True)
+
 _CAST_KEY_DOC = (
     "Reserved for reference parity (spark.rapids.sql.%s): this cast "
     "direction currently has no device kernel, so the expression falls "
@@ -466,6 +477,23 @@ class TpuConf:
         return self.settings.get(key, default)
 
     def set(self, key: str, value: Any) -> "TpuConf":
+        if key == ENABLE_INT64_NARROWING.key:
+            from spark_rapids_tpu.columnar.batch import (
+                int64_narrowing_enabled,
+                set_int64_narrowing,
+            )
+            from spark_rapids_tpu.engine import jit_cache
+
+            self.settings[key] = value
+            new = self.get(ENABLE_INT64_NARROWING)
+            if new != int64_narrowing_enabled():
+                set_int64_narrowing(new)
+                # the flag is read at TRACE time, not in any jit-cache
+                # key — drop every compiled kernel so the flip applies
+                # immediately instead of leaving a mix of narrowed and
+                # un-narrowed programs (no-op sets skip the flush)
+                jit_cache.clear()
+            return self
         self.settings[key] = value
         return self
 

@@ -312,10 +312,15 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         return all(a.data_type is not DataType.STRING
                    for a in self._inter_attrs)
 
-    def _lazy_batch(self, outs, num_groups) -> ColumnarBatch:
+    def _lazy_batch(self, outs, num_groups,
+                    key_vranges=None) -> ColumnarBatch:
         cols = []
-        for (data, validity), attr in zip(outs, self._inter_attrs):
-            cols.append(ColumnVector(attr.data_type, data, validity))
+        for i, ((data, validity), attr) in enumerate(
+                zip(outs, self._inter_attrs)):
+            vr = (key_vranges[i]
+                  if key_vranges and i < len(key_vranges) else None)
+            cols.append(ColumnVector(attr.data_type, data, validity,
+                                     vrange=vr))
         return ColumnarBatch(cols, num_groups)
 
     def _build_merge_kernel(self, n_keys: int, lazy: bool,
@@ -330,8 +335,10 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
 
         def build():
             def kernel(cols, num_rows):
+                from spark_rapids_tpu.ops.values import narrow_colv
+
                 capacity = cols[0].validity.shape[0] if cols else 8
-                key_cols = cols[:n_keys]
+                key_cols = [narrow_colv(c) for c in cols[:n_keys]]
                 buf_cols = cols[n_keys:]
                 gi = _group_info(key_cols, num_rows, capacity)
                 buf_outs = []
@@ -357,15 +364,25 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         return get_or_build(key, build)
 
     # -- assembling an intermediate [keys+buffers] device batch --------------
-    def _assemble(self, key_cols, buf_outs, gi, capacity) -> ColumnarBatch:
+    def _assemble(self, key_cols, buf_outs, gi, capacity,
+                  key_vranges=None) -> ColumnarBatch:
         n_groups = int(jax.device_get(gi.num_groups))
         key_batch = ColumnarBatch(
-            [ColumnVector(cv.dtype, cv.data, cv.validity, cv.offsets)
+            [ColumnVector(
+                cv.dtype,
+                cv.data if (cv.dtype is DataType.STRING
+                            or cv.data.dtype == physical_np_dtype(cv.dtype))
+                else cv.data.astype(physical_np_dtype(cv.dtype)),
+                cv.validity, cv.offsets, vrange=cv.vrange)
              for cv in key_cols], capacity)
         gathered = gather_batch(key_batch, gi.rep_rows, n_groups)
         out_cap = gathered.capacity if gathered.columns else \
             bucket_capacity(max(n_groups, 1))
         cols = list(gathered.columns)
+        if key_vranges:
+            for i, vr in enumerate(key_vranges[:len(cols)]):
+                if vr is not None and cols[i].vrange is None:
+                    cols[i].vrange = vr
         for out, battr in zip(buf_outs, self.buffer_attrs):
             if len(out) == 4:
                 # string min/max: (arg-row per group, source string col) —
@@ -421,6 +438,8 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         update_kernel = [None]
         merge_kernel = [None]
         n_keys = len(self.grouping)
+        from spark_rapids_tpu.ops import bind as SV
+        bound_key_static = bind_all(key_exprs, child_attrs)
         # input/buffer column positions feeding string min/max (for the
         # per-batch chunk-count bound)
         str_update_ords = []
@@ -467,12 +486,13 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 merge_kernel[0] = (
                     nc, self._build_merge_kernel(n_keys, lazy, nc))
             cols = [_col_to_colv(c) for c in batch.columns]
+            kvr = [c.vrange for c in batch.columns[:n_keys]]
             out = merge_kernel[0][1](cols, count_arg(batch))
             if lazy:
                 outs, num_groups = out
-                return self._lazy_batch(outs, num_groups)
+                return self._lazy_batch(outs, num_groups, kvr)
             k, b, gi = out
-            return self._assemble(k, b, gi, batch.capacity)
+            return self._assemble(k, b, gi, batch.capacity, kvr)
 
         # un-compacted (lazy) update output keeps the INPUT batch capacity;
         # past the exchange's zero-copy piece cap that re-introduces the
@@ -489,6 +509,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         def agg_partition(pidx: int):
             from spark_rapids_tpu.columnar.batch import ensure_compact
 
+            kvr_cache: Dict[tuple, list] = {}
             running: Optional[ColumnarBatch] = None
             for batch in child_pb.iterator(pidx):
                 if batch.rows_on_host and batch.num_rows == 0:
@@ -508,12 +529,22 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     if not cols:
                         cols = [_synth_col(batch)]
                     out = update_kernel[0][1](cols, count_arg(batch))
+                    # keyed by the batch's (quantized) column vranges so the
+                    # symbolic walk runs once per distinct range profile,
+                    # not once per batch
+                    in_vrs = tuple(c.vrange for c in batch.columns)
+                    kvr = kvr_cache.get(in_vrs)
+                    if kvr is None:
+                        kvr = [SV.static_vrange(e, in_vrs)
+                               for e in bound_key_static]
+                        kvr_cache[in_vrs] = kvr
                     if b_lazy:
                         outs, num_groups = out
-                        local = self._lazy_batch(outs, num_groups)
+                        local = self._lazy_batch(outs, num_groups, kvr)
                     else:
                         k, b, gi = out
-                        local = self._assemble(k, b, gi, batch.capacity)
+                        local = self._assemble(k, b, gi, batch.capacity,
+                                               kvr)
                     # a fresh update output has unique keys already
                     if running is None:
                         running = local
@@ -571,6 +602,9 @@ def _assemble_traced(key_cols, buf_outs, gi, capacity: int, buffer_npdts):
     outs = []
     for cv in key_cols:
         data = jnp.where(slot, cv.data[rep], jnp.zeros((), cv.data.dtype))
+        npdt = physical_np_dtype(cv.dtype)
+        if cv.dtype is not DataType.STRING and data.dtype != jnp.dtype(npdt):
+            data = data.astype(npdt)  # restore storage width after narrowing
         validity = jnp.where(slot, cv.validity[rep], False)
         outs.append((data, validity))
     for (data, validity), npdt in zip(buf_outs, buffer_npdts):

@@ -90,7 +90,13 @@ def key_proxy(col: ColV) -> KeyProxy:
     if dt is DataType.BOOL:
         data = jnp.where(col.validity, col.data, False).astype(jnp.int32)
         return KeyProxy((data,), ~col.validity, True)
-    # integral / date / timestamp
+    # integral / date / timestamp. A logically-int64 column whose vrange
+    # fits int32 sorts/groups on an int32 proxy (value-preserving, so order
+    # and equality are unchanged) — argsort over emulated-int64 pairs is the
+    # hottest lane in sort-based groupby on TPU (BENCH_I64.json).
+    from spark_rapids_tpu.ops.values import narrow_colv
+
+    col = narrow_colv(col)
     data = jnp.where(col.validity, col.data, jnp.zeros((), col.data.dtype))
     return KeyProxy((data,), ~col.validity, True)
 
@@ -357,6 +363,12 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
                                   num_segments=capacity)
         return cnt, jnp.ones((capacity,), bool)
     if op in ("sum", "min", "max", "any"):
+        if op == "sum" and jnp.dtype(data.dtype).kind in "iu" \
+                and jnp.dtype(data.dtype).itemsize < 8:
+            # SQL sum over any integral type is LONG: an int32-narrowed (or
+            # plain INT) input must accumulate 64-bit — per-group totals are
+            # unbounded even when every element fits int32
+            data = data.astype(jnp.int64)
         seg = _seg_ids(gid, validity & in_group, capacity)
         if sorted_ok:
             nonnull = _sorted_counts(validity & in_group, gi, capacity)

@@ -187,7 +187,15 @@ class TpuWindowExec(_WindowBase, TpuExec):
         def build():
             def kernel(cols, num_rows):
                 cap = cols[0].validity.shape[0]
-                ctx = EvalContext(jnp, True, cols, num_rows, cap)
+                # narrow=False disables ALL int32 narrowing in this kernel
+                # (inputs and in-expression): window internals materialize
+                # function inputs/defaults at whatever width reaches them
+                # (e.g. lead/lag default literals can exceed int32), and the
+                # narrowing win is small here — frame aggregates already
+                # widen to physical dtype before the scan (_eval_window_agg)
+                # and partition grouping narrows inside key_proxy anyway.
+                ctx = EvalContext(jnp, True, cols, num_rows, cap,
+                                  narrow=False)
 
                 def as_col(e):
                     r = e.eval(ctx)

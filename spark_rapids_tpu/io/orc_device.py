@@ -49,6 +49,11 @@ class _Unsupported(Exception):
 # ---------------------------------------------------------------------------
 # Protobuf wire-format mini reader (ORC metadata is plain protobuf)
 # ---------------------------------------------------------------------------
+def _zigzag(v: int) -> int:
+    """protobuf sint64 zigzag -> signed python int."""
+    return (v >> 1) ^ -(v & 1)
+
+
 class _Proto:
     def __init__(self, buf: bytes, start: int = 0, end: Optional[int] = None):
         self.buf = buf
@@ -111,6 +116,9 @@ class OrcMeta:
     kinds: List[int] = field(default_factory=list)
     names: List[str] = field(default_factory=list)
     num_rows: int = 0
+    # column id -> (min, max) from footer IntegerStatistics, or None;
+    # feeds the int32-narrowing proof (columnar.batch module docstring)
+    col_stats: List[Optional[Tuple[int, int]]] = field(default_factory=list)
 
 
 # ORC type kinds
@@ -252,6 +260,19 @@ def parse_file_meta(raw: bytes) -> OrcMeta:
                 root_subtypes = subtypes
                 meta.names = [""] + fieldnames
             meta.kinds.append(kind)
+        elif fnum == 7:  # ColumnStatistics (one per column id, in order)
+            stat = None
+            for f2, _w2, v2 in _Proto(v).fields():
+                if f2 == 2:  # IntegerStatistics {1: min, 2: max} (sint64)
+                    lo = hi = None
+                    for f3, _w3, v3 in _Proto(v2).fields():
+                        if f3 == 1:
+                            lo = _zigzag(v3)
+                        elif f3 == 2:
+                            hi = _zigzag(v3)
+                    if lo is not None and hi is not None:
+                        stat = (lo, hi)
+            meta.col_stats.append(stat)
         elif fnum == 6:
             meta.num_rows = v
     # names: root fieldnames map to subtype column ids

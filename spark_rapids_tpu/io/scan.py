@@ -63,6 +63,51 @@ class FileSplit:
 HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
 
 
+def _orc_stats_vrange(attr, meta) -> Optional[Tuple[int, int]]:
+    """(lo, hi) for an ORC column from the file footer's IntegerStatistics
+    (parsed in orc_device.parse_file_meta), INT64 columns only — the same
+    narrowing proof _pq_stats_vrange supplies for parquet."""
+    from spark_rapids_tpu.columnar.batch import (
+        int64_narrowing_enabled,
+        quantize_vrange,
+    )
+
+    if attr.data_type is not DataType.INT64 or not int64_narrowing_enabled():
+        return None
+    try:
+        cid = meta.names.index(attr.name)
+        if 0 <= cid < len(meta.col_stats):
+            return quantize_vrange(meta.col_stats[cid])
+    except (ValueError, AttributeError):
+        pass
+    return None
+
+
+def _pq_stats_vrange(dt: DataType, col_meta) -> Optional[Tuple[int, int]]:
+    """(lo, hi) from a parquet column-chunk's footer statistics, for the
+    int32-narrowing proof (columnar.batch module docstring). INT64 logical
+    columns only — TIMESTAMP never fits int32 and narrower ints gain
+    nothing; None when stats are absent/untrusted."""
+    from spark_rapids_tpu.columnar.batch import (
+        int64_narrowing_enabled,
+        quantize_vrange,
+    )
+
+    if dt is not DataType.INT64 or not int64_narrowing_enabled():
+        return None
+    try:
+        st = col_meta.statistics
+        if st is None or not st.has_min_max:
+            return None
+        lo, hi = st.min, st.max
+        if isinstance(lo, (int, np.integer)) and \
+                isinstance(hi, (int, np.integer)):
+            return quantize_vrange((int(lo), int(hi)))
+    except Exception:
+        pass
+    return None
+
+
 def partition_values_of(path: str, roots: List[str]):
     """key=value components of `path` under its root directory, in path
     order (the Hive partition-discovery rule Spark applies)."""
@@ -578,7 +623,9 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     d, v = OD.expand_column(stripe_dev,
                                             stripe_plans[sidx][a.name],
                                             a.data_type, rows, cap)
-                    dev_cols[a.name] = ColumnVector(a.data_type, d, v)
+                    dev_cols[a.name] = ColumnVector(
+                        a.data_type, d, v,
+                        vrange=_orc_stats_vrange(a, meta))
             hb = None
             if rest:
                 import pyarrow.orc as po
@@ -683,6 +730,11 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         flba_len=flba_len.get(a.name, 0))
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback
+                # footer statistics -> value range: device-decoded columns
+                # never pass through a host array, so the upload-time min/max
+                # pass (columnar.batch.host_value_range) can't see them; the
+                # writer's chunk stats carry the same proof for free
+                dev_cols[a.name].vrange = _pq_stats_vrange(a.data_type, col)
             hb = None
             if rest or pv:
                 sub = FileSplit(split.path, "parquet", (rg,), split.options,

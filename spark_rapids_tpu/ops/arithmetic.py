@@ -13,8 +13,15 @@ from spark_rapids_tpu.columnar.dtypes import (
     is_decimal,
 )
 from spark_rapids_tpu.ops import decimal_util as DU
-from spark_rapids_tpu.ops.base import BinaryExpression, UnaryExpression, _d
+from spark_rapids_tpu.ops.base import (
+    BinaryExpression,
+    UnaryExpression,
+    _d,
+    val_interval,
+)
 from spark_rapids_tpu.ops.values import ColV
+
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
 
 
 class BinaryArithmetic(BinaryExpression):
@@ -55,8 +62,50 @@ class BinaryArithmetic(BinaryExpression):
             return True
         return super().nullable
 
+    # -- static interval rules (int32-narrowing proof; see columnar.batch) ---
+    def _math_interval(self, li, ri):
+        """Exact mathematical result interval from operand intervals (python
+        ints, no wrap), or None. Per-op; conservative default."""
+        return None
+
+    def result_vrange(self, lv, rv):
+        if not self.data_type.is_integral or self._decimal_types() is not None:
+            return None
+        iv = self._math_interval(val_interval(lv), val_interval(rv))
+        if iv is None:
+            return None
+        # only claim a bound when no wrap can have occurred at the result type
+        info = np.iinfo(self.data_type.to_np())
+        if iv[0] >= int(info.min) and iv[1] <= int(info.max):
+            return iv
+        return None
+
+    def _narrow_npdt(self, ctx, lv, rv):
+        """np.int32 when int32 compute is provably exact for this op's
+        int64 result (math interval and both operand values fit int32),
+        else None. Remainder's pure mod chain is ring-exact whenever its
+        FINAL value fits int32 (its _math_interval bounds that); Pmod's
+        sign fix-up DIVIDES after an add that can wrap, so its kernel
+        widens that one step to int64 (see Pmod.do_columnar)."""
+        from spark_rapids_tpu.columnar.batch import (
+            fits_int32,
+            int64_narrowing_enabled,
+        )
+
+        if (not ctx.is_device or not getattr(ctx, "narrow", True)
+                or not int64_narrowing_enabled()
+                or self.data_type is not DataType.INT64):
+            return None
+        li, ri = val_interval(lv), val_interval(rv)
+        if not (fits_int32(li) and fits_int32(ri)):
+            return None
+        mi = self._math_interval(li, ri)
+        if fits_int32(mi):
+            return np.dtype(np.int32)
+        return None
+
     def _cast_operands(self, ctx, lv, rv):
-        npdt = self.data_type.to_np()
+        npdt = self._narrow_npdt(ctx, lv, rv) or self.data_type.to_np()
         types = (self.left.data_type, self.right.data_type)
 
         def cast(x, dt):
@@ -125,6 +174,11 @@ class BinaryArithmetic(BinaryExpression):
 class Add(BinaryArithmetic):
     _decimal_result = staticmethod(DU.add_result_type)
 
+    def _math_interval(self, li, ri):
+        if li is None or ri is None:
+            return None
+        return (li[0] + ri[0], li[1] + ri[1])
+
     def do_columnar(self, ctx, lv, rv):
         if self._decimal_types() is not None:
             return self._decimal_addsub(ctx, lv, rv, +1)
@@ -135,6 +189,11 @@ class Add(BinaryArithmetic):
 class Subtract(BinaryArithmetic):
     _decimal_result = staticmethod(DU.add_result_type)
 
+    def _math_interval(self, li, ri):
+        if li is None or ri is None:
+            return None
+        return (li[0] - ri[1], li[1] - ri[0])
+
     def do_columnar(self, ctx, lv, rv):
         if self._decimal_types() is not None:
             return self._decimal_addsub(ctx, lv, rv, -1)
@@ -144,6 +203,12 @@ class Subtract(BinaryArithmetic):
 
 class Multiply(BinaryArithmetic):
     _decimal_result = staticmethod(DU.multiply_result_type)
+
+    def _math_interval(self, li, ri):
+        if li is None or ri is None:
+            return None
+        corners = [a * b for a in li for b in ri]
+        return (min(corners), max(corners))
 
     def do_columnar(self, ctx, lv, rv):
         dts = self._decimal_types()
@@ -235,6 +300,18 @@ class IntegralDivide(BinaryExpression):
     def data_type(self):
         return DataType.INT64
 
+    def result_vrange(self, lv, rv):
+        # |a div n| <= |a| except the INT64_MIN/-1 wrap corner; the result
+        # sign follows sign(a)*sign(n), so without a known divisor sign the
+        # bound must be symmetric (10 div -3 = -3)
+        li, ri = val_interval(lv), val_interval(rv)
+        if li is None or li[0] <= _I64_MIN:
+            return None
+        m = max(abs(li[0]), abs(li[1]))
+        if li[0] >= 0 and ri is not None and ri[0] >= 0:
+            return (0, m)
+        return (-m, m)
+
     @property
     def nullable(self):
         return True
@@ -245,7 +322,8 @@ class IntegralDivide(BinaryExpression):
             xp = ctx.xp
             zero_div = (rv.data == 0) if isinstance(rv, ColV) else (_d(rv) == 0)
             validity = out.validity & ctx.xp.logical_not(zero_div)
-            return ColV(out.dtype, xp.where(validity, out.data, 0), validity)
+            return ColV(out.dtype, xp.where(validity, out.data, 0), validity,
+                        vrange=out.vrange)
         if out.value is not None and _scalar_zero(rv):
             out.value = None
         return out
@@ -292,6 +370,16 @@ class Remainder(BinaryArithmetic):
 
     _decimal_result = staticmethod(DU.remainder_result_type)
 
+    def _math_interval(self, li, ri):
+        # |a % n| <= min(|a|, |n| - 1); sign follows the dividend. The
+        # wrapped int32 chain is ring-exact because this final bound always
+        # fits (divisor-zero lanes become NULL, value irrelevant).
+        if li is None or ri is None:
+            return None
+        mn = max(abs(ri[0]), abs(ri[1]))
+        m = min(max(abs(li[0]), abs(li[1])), max(mn - 1, 0))
+        return (0 if li[0] >= 0 else -m, 0 if li[1] <= 0 else m)
+
     @property
     def nullable(self):
         return True
@@ -302,7 +390,8 @@ class Remainder(BinaryArithmetic):
             xp = ctx.xp
             zero_div = (rv.data == 0) if isinstance(rv, ColV) else (_d(rv) == 0)
             validity = out.validity & ctx.xp.logical_not(zero_div)
-            return ColV(out.dtype, xp.where(validity, out.data, 0), validity)
+            return ColV(out.dtype, xp.where(validity, out.data, 0), validity,
+                        vrange=out.vrange)
         if out.value is not None and _scalar_zero(rv):
             out.value = None
         return out
@@ -311,7 +400,7 @@ class Remainder(BinaryArithmetic):
         if self._decimal_types() is not None:
             return self._decimal_mod(ctx, lv, rv, positive=False)
         xp = ctx.xp
-        npdt = self.data_type.to_np()
+        npdt = self._narrow_npdt(ctx, lv, rv) or self.data_type.to_np()
         l, r = _d(lv), _d(rv)
         l = l.astype(npdt) if hasattr(l, "astype") else l
         r = r.astype(npdt) if hasattr(r, "astype") else r
@@ -330,6 +419,20 @@ class Pmod(BinaryArithmetic):
 
     _decimal_result = staticmethod(DU.remainder_result_type)
 
+    def _math_interval(self, li, ri):
+        # pmod's sign follows the DIVISOR (Spark/Hive): pmod(-5, 3) = 1 but
+        # pmod(-5, -3) = -2. |result| <= |divisor| - 1 always; a
+        # non-negative dividend with a non-negative divisor also bounds by
+        # the dividend. (divisor-zero lanes become NULL, value irrelevant)
+        if li is None or ri is None:
+            return None
+        m = max(max(abs(ri[0]), abs(ri[1])) - 1, 0)
+        if li[0] >= 0 and ri[0] >= 0:
+            return (0, min(m, max(abs(li[0]), abs(li[1]))))
+        lo = 0 if ri[0] >= 0 else -m
+        hi = 0 if ri[1] <= 0 else m
+        return (lo, hi)
+
     @property
     def nullable(self):
         return True
@@ -340,7 +443,8 @@ class Pmod(BinaryArithmetic):
             xp = ctx.xp
             zero_div = (rv.data == 0) if isinstance(rv, ColV) else (_d(rv) == 0)
             validity = out.validity & ctx.xp.logical_not(zero_div)
-            return ColV(out.dtype, xp.where(validity, out.data, 0), validity)
+            return ColV(out.dtype, xp.where(validity, out.data, 0), validity,
+                        vrange=out.vrange)
         if out.value is not None and _scalar_zero(rv):
             out.value = None
         return out
@@ -349,7 +453,7 @@ class Pmod(BinaryArithmetic):
         if self._decimal_types() is not None:
             return self._decimal_mod(ctx, lv, rv, positive=True)
         xp = ctx.xp
-        npdt = self.data_type.to_np()
+        npdt = self._narrow_npdt(ctx, lv, rv) or self.data_type.to_np()
         l, r = _d(lv), _d(rv)
         l = l.astype(npdt) if hasattr(l, "astype") else l
         r = r.astype(npdt) if hasattr(r, "astype") else r
@@ -366,6 +470,17 @@ class Pmod(BinaryArithmetic):
             return a - (q + adj) * n
 
         m = trunc_mod(l, safe_r)
+        if np.dtype(npdt).itemsize < 8 and hasattr(m, "astype"):
+            # the sign fix-up intermediate m + r spans up to 2|r| - 1, which
+            # overflows int32 when |r| > 2^30 — and the trunc_mod that
+            # follows DIVIDES, so the wrap is not ring-exact (unlike
+            # Remainder's pure mod chain). Widen just the fix-up; the final
+            # pmod value always fits the narrow lane (|v| <= |r| - 1).
+            mw = m.astype(np.int64)
+            rw = safe_r.astype(np.int64) if hasattr(safe_r, "astype") \
+                else np.int64(safe_r)
+            fix = trunc_mod(mw + rw, rw).astype(npdt)
+            return xp.where(m < 0, fix, m)
         return xp.where(m < 0, trunc_mod(m + safe_r, safe_r), m)
 
 
@@ -374,14 +489,37 @@ class UnaryMinus(UnaryExpression):
     def data_type(self):
         return self.child.data_type
 
+    def result_vrange(self, v):
+        iv = val_interval(v)
+        if iv is None or not self.data_type.is_integral:
+            return None
+        info = np.iinfo(self.data_type.to_np())
+        # claim only when no wrap at the RESULT type (e.g. INT negate of
+        # INT32_MIN wraps and the math interval would be a lie)
+        if -iv[1] >= int(info.min) and -iv[0] <= int(info.max):
+            return (-iv[1], -iv[0])
+        return None
+
     def do_columnar(self, ctx, v):
-        return -v.data
+        data = v.data
+        iv = val_interval(v)
+        # only a logically-INT64 column narrowed to int32 lanes may widen:
+        # -INT32_MIN wraps in the narrowed lane but not in int64. A plain
+        # SQL INT keeps Java wrap semantics (-INT32_MIN == INT32_MIN).
+        if (self.data_type is DataType.INT64
+                and hasattr(data, "astype") and data.dtype == np.int32
+                and (iv is None or -iv[0] > (1 << 31) - 1)):
+            data = data.astype(np.int64)
+        return -data
 
 
 class UnaryPositive(UnaryExpression):
     @property
     def data_type(self):
         return self.child.data_type
+
+    def result_vrange(self, v):
+        return val_interval(v)
 
     def do_columnar(self, ctx, v):
         return v.data
@@ -392,8 +530,27 @@ class Abs(UnaryExpression):
     def data_type(self):
         return self.child.data_type
 
+    def result_vrange(self, v):
+        iv = val_interval(v)
+        if iv is None or not self.data_type.is_integral:
+            return None
+        info = np.iinfo(self.data_type.to_np())
+        hi = max(abs(iv[0]), abs(iv[1]))
+        if hi > int(info.max):  # abs(MIN) wraps at the result type
+            return None
+        lo = 0 if iv[0] <= 0 <= iv[1] else min(abs(iv[0]), abs(iv[1]))
+        return (lo, hi)
+
     def do_columnar(self, ctx, v):
-        return ctx.xp.abs(v.data)
+        data = v.data
+        iv = val_interval(v)
+        # see UnaryMinus: widen only int32-narrowed LONG lanes; SQL INT
+        # keeps Java wrap semantics (abs(INT32_MIN) == INT32_MIN)
+        if (self.data_type is DataType.INT64
+                and hasattr(data, "astype") and data.dtype == np.int32
+                and (iv is None or -iv[0] > (1 << 31) - 1)):
+            data = data.astype(np.int64)
+        return ctx.xp.abs(data)
 
 
 class Signum(UnaryExpression):

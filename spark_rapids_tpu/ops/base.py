@@ -32,6 +32,19 @@ def next_expr_id() -> int:
     return next(_expr_id_counter)
 
 
+def val_interval(v) -> Optional[Tuple[int, int]]:
+    """Static (lo, hi) bound of an evaluated integral value, or None.
+    Exact python-int arithmetic feeds the int32-narrowing proof
+    (columnar.batch module docstring)."""
+    if isinstance(v, ScalarV):
+        if v.dtype.is_integral and not v.is_null:
+            return (int(v.value), int(v.value))
+        return None
+    if isinstance(v, ColV) and v.dtype.is_integral:
+        return v.vrange
+    return None
+
+
 class Expression:
     """Immutable expression-tree node."""
 
@@ -83,6 +96,12 @@ class Expression:
     def eval_kernel(self, ctx: EvalContext, *child_vals):
         raise NotImplementedError(type(self).__name__)
 
+    def result_vrange(self, *child_vals) -> Optional[Tuple[int, int]]:
+        """Static (lo, hi) bound of this expression's integral result given
+        the child values' bounds, or None (unknown). Conservative default;
+        arithmetic/conditional ops override with exact interval rules."""
+        return None
+
     # -- identity (used for jit-cache keys and explain output) ---------------
     def fingerprint(self) -> str:
         parts = ",".join(c.fingerprint() for c in self.children())
@@ -128,8 +147,9 @@ class UnaryExpression(Expression):
         if isinstance(data, ColV):  # string kernels return full ColV
             return ColV(data.dtype, data.data,
                         and_validity(ctx.xp, data.validity, validity),
-                        data.offsets)
-        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity), validity)
+                        data.offsets, vrange=data.vrange)
+        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity), validity,
+                    vrange=self.result_vrange(v))
 
     def do_columnar(self, ctx, v: ColV):
         raise NotImplementedError(type(self).__name__)
@@ -185,8 +205,10 @@ class BinaryExpression(Expression):
                 validity = validity & ctx.row_mask()
         if isinstance(data, ColV):  # string kernels return full ColV
             return ColV(data.dtype, data.data,
-                        and_validity(ctx.xp, data.validity, validity), data.offsets)
-        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity), validity)
+                        and_validity(ctx.xp, data.validity, validity), data.offsets,
+                        vrange=data.vrange)
+        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity), validity,
+                    vrange=self.result_vrange(lv, rv))
 
     def do_columnar(self, ctx, lv, rv):
         """lv/rv are ColV or non-null ScalarV; kernels use `_d(v)` to get the
@@ -254,8 +276,10 @@ class TernaryExpression(Expression):
                 validity = validity & ctx.row_mask()
         if isinstance(data, ColV):
             return ColV(data.dtype, data.data,
-                        and_validity(ctx.xp, data.validity, validity), data.offsets)
-        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity), validity)
+                        and_validity(ctx.xp, data.validity, validity), data.offsets,
+                        vrange=data.vrange)
+        return ColV(self.data_type, zero_nulls(ctx.xp, data, validity), validity,
+                    vrange=self.result_vrange(*vals))
 
     def do_columnar(self, ctx, *vals):
         raise NotImplementedError(type(self).__name__)

@@ -47,3 +47,37 @@ def bind_sort_orders(orders: Sequence[SortOrder],
         SortOrder(bind_references(o.child, input_attrs), o.ascending, o.nulls_first)
         for o in orders
     ]
+
+
+def static_vrange(expr: Expression, col_vranges: Sequence):
+    """Best-effort static (lo, hi) bound of a BOUND integral expression given
+    per-ordinal input column bounds, evaluated symbolically via the same
+    `result_vrange` interval rules the kernels use (no data touched). Used to
+    re-attach value ranges to batches that cross a jit boundary as raw
+    arrays (e.g. aggregate intermediate key columns), so downstream kernels
+    keep the int32-narrowing proof (columnar.batch module docstring)."""
+    from spark_rapids_tpu.ops.base import Alias
+    from spark_rapids_tpu.ops.literals import Literal
+    from spark_rapids_tpu.ops.values import ColV, ScalarV
+
+    def rec(e):
+        if isinstance(e, BoundReference):
+            vr = col_vranges[e.ordinal] if e.ordinal < len(col_vranges) \
+                else None
+            return ColV(e.data_type, None, None, vrange=vr)
+        if isinstance(e, Alias):
+            return rec(e.child)
+        if isinstance(e, Literal):
+            return ScalarV(e.data_type, e.value)
+        vals = [rec(c) for c in e.children()]
+        try:
+            vr = e.result_vrange(*vals)
+        except Exception:
+            vr = None
+        return ColV(e.data_type, None, None, vrange=vr)
+
+    from spark_rapids_tpu.columnar.batch import quantize_vrange
+
+    out = rec(expr)
+    # quantized: the result becomes batch-level aux data (jit cache key)
+    return quantize_vrange(out.vrange) if isinstance(out, ColV) else None

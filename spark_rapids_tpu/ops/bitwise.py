@@ -8,6 +8,17 @@ from spark_rapids_tpu.columnar.dtypes import DataType, common_type
 from spark_rapids_tpu.ops.base import BinaryExpression, UnaryExpression, _d
 
 
+def _at_logical_width(dt, x):
+    """Shift semantics depend on the operand WIDTH, not just its value:
+    an int32-narrowed LONG must shift as a 64-bit lane (shift amounts up
+    to 63, wrap at bit 64). And/or/xor/not stay narrow — sign extension
+    commutes with bitwise-parallel ops."""
+    npdt = dt.to_np()
+    if hasattr(x, "astype") and x.dtype != npdt and npdt.kind in "iu":
+        return x.astype(npdt)
+    return x
+
+
 class BitwiseBinary(BinaryExpression):
     @property
     def data_type(self):
@@ -47,7 +58,7 @@ class ShiftLeft(BinaryExpression):
         xp = ctx.xp
         bits = 64 if self.data_type is DataType.INT64 else 32
         shift = _d(rv) % bits  # java semantics: shift amount masked
-        return xp.left_shift(_d(lv), shift)
+        return xp.left_shift(_at_logical_width(self.data_type, _d(lv)), shift)
 
 
 class ShiftRight(BinaryExpression):
@@ -61,7 +72,7 @@ class ShiftRight(BinaryExpression):
         xp = ctx.xp
         bits = 64 if self.data_type is DataType.INT64 else 32
         shift = _d(rv) % bits
-        return xp.right_shift(_d(lv), shift)
+        return xp.right_shift(_at_logical_width(self.data_type, _d(lv)), shift)
 
 
 class ShiftRightUnsigned(BinaryExpression):

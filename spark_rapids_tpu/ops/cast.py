@@ -36,6 +36,24 @@ class Cast(UnaryExpression):
     def _fingerprint_extra(self):
         return f"->{self.to_type.name};"
 
+    def result_vrange(self, v):
+        """Integral widening/identity casts preserve the child's value
+        bounds; an int->int cast to a *narrower* type may wrap, so only
+        claim a range when the child provably fits the target."""
+        frm, to = self.child.data_type, self.to_type
+        if not (frm.is_integral and to.is_integral):
+            return None
+        from spark_rapids_tpu.ops.base import val_interval
+
+        iv = val_interval(v)
+        if iv is None:
+            info = np.iinfo(frm.to_np())
+            iv = (int(info.min), int(info.max))
+        tinfo = np.iinfo(to.to_np())
+        if iv[0] >= int(tinfo.min) and iv[1] <= int(tinfo.max):
+            return iv
+        return None
+
     # which (from, to) directions the device kernel handles
     @staticmethod
     def device_supported(frm, to) -> bool:
@@ -180,7 +198,8 @@ class Cast(UnaryExpression):
             # spark: epoch seconds, floored
             return data // MICROS_PER_SEC
         if frm is DataType.INT64 and to is DataType.TIMESTAMP:
-            return data * MICROS_PER_SEC
+            # explicit widen: an int32-narrowed LONG would wrap at *1e6
+            return data.astype(np.int64) * MICROS_PER_SEC
         if to is DataType.BOOL:
             return data != 0
         if frm.is_floating and to.is_integral:

@@ -55,7 +55,16 @@ class If(TernaryExpression):
             rm = ctx.row_mask()
             valid = valid & rm
             data = xp.where(valid, data, 0)
-        return ColV(self.data_type, data, valid)
+        return ColV(self.data_type, data, valid,
+                    vrange=self.result_vrange(pred, tv, fv))
+
+    def result_vrange(self, pred, tv, fv):
+        from spark_rapids_tpu.columnar.batch import union_vrange
+        from spark_rapids_tpu.ops.base import val_interval
+
+        if not self.data_type.is_integral:
+            return None
+        return union_vrange(val_interval(tv), val_interval(fv))
 
 
 class CaseWhen(Expression):
@@ -121,4 +130,13 @@ class CaseWhen(Expression):
             rm = ctx.row_mask()
             valid = valid & rm
             data = xp.where(valid, data, 0)
-        return ColV(self.data_type, data, valid)
+        vrange = None
+        if self.data_type.is_integral:
+            from spark_rapids_tpu.columnar.batch import union_vrange
+            from spark_rapids_tpu.ops.base import val_interval
+
+            ivs = [val_interval(t) for t in thens]
+            if not (isinstance(else_v, ScalarV) and else_v.is_null):
+                ivs.append(val_interval(else_v))
+            vrange = union_vrange(*ivs)
+        return ColV(self.data_type, data, valid, vrange=vrange)

@@ -30,24 +30,44 @@ from spark_rapids_tpu.columnar.batch import (
 from spark_rapids_tpu.ops.base import Expression
 from spark_rapids_tpu.ops.values import ColV, EvalContext, ScalarV, broadcast_scalar
 
-# ColV must flow through jit as a pytree
+# ColV must flow through jit as a pytree (vrange rides the aux data so
+# narrowability is part of program cache identity)
 jax.tree_util.register_pytree_node(
     ColV,
     lambda cv: (
-        ((cv.data, cv.validity, cv.offsets), (cv.dtype, True))
+        ((cv.data, cv.validity, cv.offsets), (cv.dtype, True, cv.vrange))
         if cv.offsets is not None
-        else ((cv.data, cv.validity), (cv.dtype, False))
+        else ((cv.data, cv.validity), (cv.dtype, False, cv.vrange))
     ),
-    lambda aux, ch: ColV(aux[0], ch[0], ch[1], ch[2] if aux[1] else None),
+    lambda aux, ch: ColV(aux[0], ch[0], ch[1], ch[2] if aux[1] else None,
+                         vrange=aux[2]),
 )
 
 
 def _col_to_colv(cv: ColumnVector) -> ColV:
-    return ColV(cv.dtype, cv.data, cv.validity, cv.offsets)
+    return ColV(cv.dtype, cv.data, cv.validity, cv.offsets,
+                vrange=cv.vrange)
 
 
 def _colv_to_col(cv: ColV) -> ColumnVector:
-    return ColumnVector(cv.dtype, cv.data, cv.validity, cv.offsets)
+    return ColumnVector(cv.dtype, cv.data, cv.validity, cv.offsets,
+                        vrange=cv.vrange)
+
+
+def _widen_physical(cv: ColV) -> ColV:
+    """Restore storage physical dtype at a kernel boundary: batches in HBM
+    keep the physical_np_dtype invariant (int64 for LONG) so every consumer
+    — serde, shuffle slicing, window scans, export — stays oblivious to
+    in-kernel narrowing; vrange survives so the NEXT kernel re-narrows."""
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    if cv.dtype is DataType.STRING or not hasattr(cv.data, "astype"):
+        return cv
+    npdt = physical_np_dtype(cv.dtype)
+    if cv.data.dtype == npdt:
+        return cv
+    return ColV(cv.dtype, cv.data.astype(npdt), cv.validity, cv.offsets,
+                vrange=cv.vrange)
 
 
 def _scalar_to_colv(ctx: EvalContext, s: ScalarV, want: DataType) -> ColV:
@@ -97,7 +117,7 @@ class DeviceProjector:
                     r = e.eval(ctx)
                     if isinstance(r, ScalarV):
                         r = _scalar_to_colv(ctx, r, e.data_type)
-                    outs.append(r)
+                    outs.append(_widen_physical(r))
                 return outs
 
             return jax.jit(fn)

@@ -130,7 +130,13 @@ class Coalesce(Expression):
         if ctx.is_device:
             validity = validity & ctx.row_mask()
             data = xp.where(validity, data, 0)
-        return ColV(self.data_type, data, validity)
+        vrange = None
+        if self.data_type.is_integral:
+            from spark_rapids_tpu.columnar.batch import union_vrange
+            from spark_rapids_tpu.ops.base import val_interval
+
+            vrange = union_vrange(*[val_interval(v) for v in vals])
+        return ColV(self.data_type, data, validity, vrange=vrange)
 
 
 class AtLeastNNonNulls(Expression):

@@ -25,12 +25,19 @@ class ColV:
     padded to the batch capacity.
     cpu path: numpy arrays of exactly num_rows; strings are object arrays and
     offsets is None.
+
+    `vrange` (static (lo, hi) python ints, or None = unknown) bounds the
+    valid values of an integral column; kernels use it to prove that int32
+    compute is exact for a logically-int64 expression (columnar.batch
+    module docstring). It is aux data in the jit pytree, so narrowability
+    participates in program cache identity.
     """
 
     dtype: DataType
     data: Any
     validity: Any
     offsets: Optional[Any] = None
+    vrange: Optional[tuple] = None
 
     @property
     def is_string(self) -> bool:
@@ -55,13 +62,19 @@ class EvalContext:
 
     __slots__ = (
         "xp", "is_device", "columns", "num_rows", "capacity",
-        "partition_id", "rng_seed", "row_start",
+        "partition_id", "rng_seed", "row_start", "narrow",
     )
 
     def __init__(self, xp, is_device, columns, num_rows, capacity,
-                 partition_id=0, rng_seed=0, row_start=0):
+                 partition_id=0, rng_seed=0, row_start=0, narrow=True):
         self.xp = xp
         self.is_device = is_device
+        # narrow=False turns int32 narrowing off for the WHOLE kernel:
+        # inputs stay at physical width AND expression ops skip their
+        # in-kernel narrowing (checked via ctx.narrow in _narrow_npdt)
+        self.narrow = narrow
+        if is_device and narrow:
+            columns = [narrow_colv(cv) for cv in columns]
         self.columns = columns  # list[ColV]
         self.num_rows = num_rows
         self.capacity = capacity
@@ -73,6 +86,26 @@ class EvalContext:
 
     def row_mask(self):
         return self.xp.arange(self.capacity) < self.num_rows
+
+
+def narrow_colv(cv: ColV) -> ColV:
+    """int32 view of a logically-int64 column whose value range fits int32
+    (exact: value-preserving; null/pad lanes hold zeros by convention and
+    survive the cast unchanged). The astype fuses into the consuming kernel
+    — XLA reads the int64 pair once and computes 32-bit thereafter."""
+    from spark_rapids_tpu.columnar.batch import (
+        fits_int32,
+        int64_narrowing_enabled,
+    )
+
+    if (isinstance(cv, ColV) and cv.data is not None
+            and cv.dtype is DataType.INT64 and fits_int32(cv.vrange)
+            and int64_narrowing_enabled()
+            and hasattr(cv.data, "astype")
+            and np.dtype(cv.data.dtype).itemsize > 4):
+        return ColV(cv.dtype, cv.data.astype(np.int32), cv.validity,
+                    cv.offsets, cv.vrange)
+    return cv
 
 
 def and_validity(xp, *validities):
@@ -91,17 +124,27 @@ def broadcast_scalar(ctx: EvalContext, s: ScalarV):
     if s.dtype is DataType.STRING:
         raise NotImplementedError("string scalar broadcast is kernel-specific")
     npdt = s.dtype.to_np()
+    vrange = None
     if ctx.is_device:
-        from spark_rapids_tpu.columnar.batch import physical_np_dtype
+        from spark_rapids_tpu.columnar.batch import (
+            fits_int32,
+            int64_narrowing_enabled,
+            physical_np_dtype,
+        )
 
         npdt = physical_np_dtype(s.dtype)
+        if s.dtype is DataType.INT64 and not s.is_null:
+            vrange = (int(s.value), int(s.value))
+            if (fits_int32(vrange) and int64_narrowing_enabled()
+                    and getattr(ctx, "narrow", True)):
+                npdt = np.dtype(np.int32)
     fill = s.value if not s.is_null else 0
     data = xp.full((ctx.capacity,), npdt.type(fill) if not ctx.is_device else fill,
                    dtype=npdt)
     validity = xp.full((ctx.capacity,), not s.is_null, dtype=bool)
     if ctx.is_device:
         validity = validity & ctx.row_mask()
-    return ColV(s.dtype, data, validity)
+    return ColV(s.dtype, data, validity, vrange=vrange)
 
 
 def zero_nulls(xp, data, validity):

@@ -84,6 +84,9 @@ class TpuSession:
             self.conf, budget, self.device_manager.bytes_in_use)
         TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         self.scheduler = TaskScheduler(self.conf.task_threads)
+        from spark_rapids_tpu.columnar.batch import set_int64_narrowing
+
+        set_int64_narrowing(self.conf.get(C.ENABLE_INT64_NARROWING))
         with TpuSession._lock:
             TpuSession._active = self
 

@@ -120,9 +120,51 @@ def gen_tables(session, sf: float = 0.001, num_partitions: int = 4,
         ("sr_return_amt", "decimal(9,2)")],
         num_partitions=max(1, num_partitions // 2))
 
+    n_inv = max(64, int(720_000 * sf))
+    inv_ts = rng.integers(t_lo, t_hi, n_inv).astype(np.int64) * 1_000_000
+    inventory = session.createDataFrame({
+        "inv_item_sk": rng.integers(0, n_item, n_inv).astype(np.int64),
+        "inv_warehouse_sk": rng.integers(0, 5, n_inv).astype(np.int64),
+        "inv_ts": inv_ts,
+        "inv_quantity_on_hand":
+            rng.integers(0, 500, n_inv).astype(np.int32),
+    }, [("inv_item_sk", "long"), ("inv_warehouse_sk", "long"),
+        ("inv_ts", DataType.TIMESTAMP), ("inv_quantity_on_hand", "int")],
+        num_partitions=max(1, num_partitions // 2))
+
+    # synthetic review text: sentiment-bearing word soup so LIKE/contains
+    # predicates select meaningful subsets (the reference's q10/q18/q19/q27
+    # run NLP UDFs over real text, TpcxbbLikeSpark.scala product_reviews)
+    n_rev = max(48, int(60_000 * sf))
+    _POS = ["good", "great", "love", "excellent", "happy"]
+    _NEG = ["bad", "terrible", "hate", "broken", "awful"]
+    _FILL = ["the", "item", "works", "shipping", "box", "brandx", "price"]
+    ratings = rng.integers(1, 6, n_rev)
+
+    def _mk_review(i):
+        words = [_FILL[j] for j in rng.integers(0, len(_FILL), 4)]
+        pool = _POS if ratings[i] >= 4 else \
+            _NEG if ratings[i] <= 2 else _POS + _NEG
+        words.insert(int(rng.integers(0, 4)),
+                     pool[int(rng.integers(0, len(pool)))])
+        return " ".join(words)
+
+    product_reviews = session.createDataFrame({
+        "pr_review_sk": np.arange(n_rev, dtype=np.int64),
+        "pr_item_sk": rng.integers(0, n_item, n_rev).astype(np.int64),
+        "pr_user_sk": rng.integers(0, n_cust, n_rev).astype(np.int64),
+        "pr_rating": ratings.astype(np.int32),
+        "pr_content": np.array([_mk_review(i) for i in range(n_rev)],
+                               dtype=object),
+    }, [("pr_review_sk", "long"), ("pr_item_sk", "long"),
+        ("pr_user_sk", "long"), ("pr_rating", "int"),
+        ("pr_content", "string")],
+        num_partitions=max(1, num_partitions // 2))
+
     return {"store_sales": store_sales, "item": item,
             "web_clickstreams": web_clickstreams, "web_sales": web_sales,
-            "store_returns": store_returns}
+            "store_returns": store_returns, "inventory": inventory,
+            "product_reviews": product_reviews}
 
 
 # ---------------------------------------------------------------------------
@@ -509,11 +551,324 @@ def q29_like(t) -> "object":
             .limit(100))
 
 
+def q04_like(t) -> "object":
+    """Abandoned shopping days (TPCx-BB q4-ish): per (user, day) click
+    activity anti-joined against any same-day purchase by that user —
+    date-keyed anti-join over two fact tables, top abandoned browsers."""
+    wcs, ss = t["web_clickstreams"], t["store_sales"]
+    browse = (wcs.withColumn("cday", F.col("wcs_click_ts").cast("date"))
+              .groupBy("wcs_user_sk", "cday")
+              .agg(F.count("*").alias("clicks")))
+    bought = (ss.withColumn("bday", F.col("ss_sold_ts").cast("date"))
+              .select(F.col("ss_customer_sk").alias("bc"), F.col("bday")))
+    return (browse.join(
+        bought,
+        on=((browse["wcs_user_sk"] == F.col("bc"))
+            & (browse["cday"] == F.col("bday"))),
+        how="left_anti")
+        .groupBy("wcs_user_sk")
+        .agg(F.count("*").alias("abandoned_days"),
+             F.sum("clicks").alias("wasted_clicks"))
+        .filter(F.col("wasted_clicks") >= F.lit(2))
+        .orderBy(F.col("wasted_clicks").desc(), F.col("wcs_user_sk"))
+        .limit(100))
+
+
+def q10_like(t) -> "object":
+    """Review sentiment by category (TPCx-BB q10-ish, the NLP UDF replaced
+    by contains() word predicates): positive/negative word hits as
+    conditional counts per category, with the double ratio."""
+    pr, it = t["product_reviews"], t["item"]
+    pos = (F.col("pr_content").contains("good")
+           | F.col("pr_content").contains("great")
+           | F.col("pr_content").contains("love"))
+    neg = (F.col("pr_content").contains("bad")
+           | F.col("pr_content").contains("terrible")
+           | F.col("pr_content").contains("hate"))
+    return (pr.join(it, on=(pr["pr_item_sk"] == it["i_item_sk"]),
+                    how="inner")
+            .withColumn("is_pos", F.when(pos, F.lit(1)).otherwise(F.lit(0)))
+            .withColumn("is_neg", F.when(neg, F.lit(1)).otherwise(F.lit(0)))
+            .groupBy("i_category")
+            .agg(F.sum("is_pos").alias("pos_reviews"),
+                 F.sum("is_neg").alias("neg_reviews"),
+                 F.avg(F.col("pr_rating").cast("double")).alias("avg_rating"),
+                 F.count("*").alias("reviews"))
+            .withColumn("sentiment",
+                        (F.col("pos_reviews") - F.col("neg_reviews"))
+                        .cast("double")
+                        / F.col("reviews").cast("double"))
+            .orderBy("i_category"))
+
+
+def q18_like(t) -> "object":
+    """Stores with a declining monthly profit trend (TPCx-BB q18-ish, the
+    linear-regression slope as explicit sum-product aggregates): join each
+    store's monthly profits to its averages, slope numerator
+    sum((m - m̄)(p - p̄)) < 0 keeps decliners."""
+    ss = t["store_sales"]
+    monthly = (ss.withColumn("m",
+                             F.month(F.col("ss_sold_ts").cast("date")))
+               .groupBy("ss_store_sk", "m")
+               .agg(F.sum(F.col("ss_net_profit").cast("double"))
+                    .alias("profit")))
+    means = (monthly.groupBy("ss_store_sk")
+             .agg(F.avg(F.col("m").cast("double")).alias("m_bar"),
+                  F.avg("profit").alias("p_bar"))
+             .select(F.col("ss_store_sk").alias("msk"),
+                     F.col("m_bar"), F.col("p_bar")))
+    return (monthly.join(means,
+                         on=(monthly["ss_store_sk"] == F.col("msk")),
+                         how="inner")
+            .withColumn("dev",
+                        (F.col("m").cast("double") - F.col("m_bar"))
+                        * (F.col("profit") - F.col("p_bar")))
+            .groupBy("ss_store_sk")
+            .agg(F.sum("dev").alias("slope_num"),
+                 F.count("*").alias("months"))
+            .filter((F.col("slope_num") < F.lit(0.0))
+                    & (F.col("months") >= F.lit(3)))
+            .orderBy(F.col("slope_num"), F.col("ss_store_sk")))
+
+
+def q19_like(t) -> "object":
+    """Returned items with angry reviews (TPCx-BB q19-ish): per-item
+    decimal return totals joined to low-rating review counts — two
+    aggregates joined, ordered by returned amount."""
+    sr, pr = t["store_returns"], t["product_reviews"]
+    rets = (sr.groupBy("sr_item_sk")
+            .agg(F.sum("sr_return_amt").alias("returned_amt"),
+                 F.count("*").alias("returns")))
+    angry = (pr.filter(F.col("pr_rating") <= F.lit(2))
+             .groupBy("pr_item_sk")
+             .agg(F.count("*").alias("angry_reviews"))
+             .select(F.col("pr_item_sk").alias("ak"),
+                     F.col("angry_reviews")))
+    return (rets.join(angry, on=(rets["sr_item_sk"] == F.col("ak")),
+                      how="inner")
+            .orderBy(F.col("returned_amt").desc(), F.col("sr_item_sk"))
+            .limit(100))
+
+
+def q20_like(t) -> "object":
+    """Customer return-behavior features (TPCx-BB q20-ish k-means feature
+    prep): per-customer order/return counts and amounts, return ratios as
+    doubles — the clustering input vector without the clustering."""
+    ss, sr = t["store_sales"], t["store_returns"]
+    orders = (ss.groupBy("ss_customer_sk")
+              .agg(F.count("*").alias("orders"),
+                   F.sum("ss_net_paid").alias("paid")))
+    rets = (sr.groupBy("sr_customer_sk")
+            .agg(F.count("*").alias("returns"),
+                 F.sum("sr_return_amt").alias("returned"))
+            .select(F.col("sr_customer_sk").alias("rk"),
+                    F.col("returns"), F.col("returned")))
+    return (orders.join(rets, on=(orders["ss_customer_sk"] == F.col("rk")),
+                        how="inner")
+            .withColumn("return_rate",
+                        F.col("returns").cast("double")
+                        / F.col("orders").cast("double"))
+            .withColumn("amt_rate",
+                        F.col("returned").cast("double")
+                        / F.col("paid").cast("double"))
+            .filter(F.col("return_rate") > F.lit(0.0))
+            .orderBy(F.col("return_rate").desc(),
+                     F.col("ss_customer_sk"))
+            .limit(100))
+
+
+def q22_like(t) -> "object":
+    """Inventory before/after a pivot date (TPCx-BB q22 shape): per
+    (item, warehouse) quantity sums around the pivot, keep ratios in
+    [2/3, 3/2] — the classic conditional-sum + ratio-band HAVING."""
+    inv = t["inventory"]
+    pivot = ts_lit("2003-07-01T00:00:00")
+    before = F.when(F.col("inv_ts") < pivot,
+                    F.col("inv_quantity_on_hand")).otherwise(F.lit(0))
+    after = F.when(F.col("inv_ts") >= pivot,
+                   F.col("inv_quantity_on_hand")).otherwise(F.lit(0))
+    return (inv.withColumn("qb", before).withColumn("qa", after)
+            .groupBy("inv_item_sk", "inv_warehouse_sk")
+            .agg(F.sum("qb").alias("inv_before"),
+                 F.sum("qa").alias("inv_after"))
+            .filter((F.col("inv_before") > F.lit(0))
+                    & (F.col("inv_after").cast("double")
+                       >= F.lit(2.0 / 3.0)
+                       * F.col("inv_before").cast("double"))
+                    & (F.col("inv_after").cast("double")
+                       <= F.lit(1.5)
+                       * F.col("inv_before").cast("double")))
+            .orderBy("inv_item_sk", "inv_warehouse_sk")
+            .limit(100))
+
+
+def q23_like(t) -> "object":
+    """Inventory volatility (TPCx-BB q23 shape): monthly quantity per
+    (item, warehouse), then the coefficient of variation via sum/sum-of-
+    squares aggregates. cov > 0.1 is tested as its square
+    var/mean^2 > 0.01 — same predicate, no Sqrt (which is incompat-gated
+    off by default like the reference's floating-point ops)."""
+    inv = t["inventory"]
+    monthly = (inv.withColumn("m",
+                              F.month(F.col("inv_ts").cast("date")))
+               .groupBy("inv_item_sk", "inv_warehouse_sk", "m")
+               .agg(F.sum(F.col("inv_quantity_on_hand").cast("double"))
+                    .alias("q")))
+    return (monthly
+            .withColumn("q2", F.col("q") * F.col("q"))
+            .groupBy("inv_item_sk", "inv_warehouse_sk")
+            .agg(F.avg("q").alias("mean_q"),
+                 F.avg("q2").alias("mean_q2"),
+                 F.count("*").alias("months"))
+            .filter((F.col("months") >= F.lit(3))
+                    & (F.col("mean_q") > F.lit(0.0)))
+            .withColumn("cov2",
+                        (F.col("mean_q2")
+                         - F.col("mean_q") * F.col("mean_q"))
+                        / (F.col("mean_q") * F.col("mean_q")))
+            .filter(F.col("cov2") > F.lit(0.01))
+            .orderBy(F.col("cov2").desc(), F.col("inv_item_sk"),
+                     F.col("inv_warehouse_sk"))
+            .limit(100))
+
+
+def q24_like(t) -> "object":
+    """Channel mix for premium items (TPCx-BB q24-ish price-sensitivity
+    shape): items priced >= 1.2x category average, web vs store quantity
+    sums joined and ratioed."""
+    ss, ws, it = t["store_sales"], t["web_sales"], t["item"]
+    cat_avg = (it.groupBy("i_category")
+               .agg(F.avg(F.col("i_current_price").cast("double"))
+                    .alias("cavg"))
+               .select(F.col("i_category").alias("cc"), F.col("cavg")))
+    prem = (it.join(cat_avg, on=(it["i_category"] == F.col("cc")),
+                    how="inner")
+            .filter(F.col("i_current_price").cast("double")
+                    >= F.lit(1.2) * F.col("cavg"))
+            .select(F.col("i_item_sk").alias("pk")))
+    s_qty = (ss.join(prem, on=(ss["ss_item_sk"] == F.col("pk")),
+                     how="left_semi")
+             .groupBy("ss_item_sk")
+             .agg(F.sum("ss_quantity").alias("store_qty")))
+    w_qty = (ws.groupBy("ws_item_sk")
+             .agg(F.sum("ws_quantity").alias("web_qty"))
+             .select(F.col("ws_item_sk").alias("wk"), F.col("web_qty")))
+    return (s_qty.join(w_qty, on=(s_qty["ss_item_sk"] == F.col("wk")),
+                       how="inner")
+            .withColumn("web_share",
+                        F.col("web_qty").cast("double")
+                        / (F.col("web_qty") + F.col("store_qty"))
+                        .cast("double"))
+            .orderBy(F.col("web_share").desc(), F.col("ss_item_sk"))
+            .limit(100))
+
+
+def q25_like(t) -> "object":
+    """RFM customer segmentation features (TPCx-BB q25-ish): recency
+    (max ts as long), frequency, monetary from store + web sales unioned
+    into one per-customer feature row."""
+    ss, ws = t["store_sales"], t["web_sales"]
+    s = ss.select(F.col("ss_customer_sk").alias("c"),
+                  F.col("ss_sold_ts").cast("long").alias("ts"),
+                  F.col("ss_net_paid").alias("paid"))
+    w = ws.select(F.col("ws_bill_customer_sk").alias("c"),
+                  F.col("ws_sold_ts").cast("long").alias("ts"),
+                  F.col("ws_net_paid").alias("paid"))
+    return (s.union(w)
+            .groupBy("c")
+            .agg(F.max("ts").alias("recency"),
+                 F.count("*").alias("frequency"),
+                 F.sum("paid").alias("monetary"))
+            .filter(F.col("frequency") >= F.lit(2))
+            .orderBy(F.col("monetary").desc(), F.col("c"))
+            .limit(100))
+
+
+def q26_like(t) -> "object":
+    """Per-customer category spend vector (TPCx-BB q26-ish cluster-input
+    shape): join to item, one conditional decimal sum per category column
+    (the manual pivot), active customers only."""
+    ss, it = t["store_sales"], t["item"]
+    joined = ss.join(it, on=(ss["ss_item_sk"] == it["i_item_sk"]),
+                     how="inner")
+    zero = Column(Literal(Decimal(0), DecimalType(9, 2)))
+    agg_cols = []
+    for cat in ("BOOKS", "ELECTRONICS", "CLOTHING"):
+        joined = joined.withColumn(
+            f"paid_{cat.lower()}",
+            F.when(F.col("i_category") == F.lit(cat),
+                   F.col("ss_net_paid")).otherwise(zero))
+        agg_cols.append(F.sum(f"paid_{cat.lower()}")
+                        .alias(f"{cat.lower()}_spend"))
+    return (joined.groupBy("ss_customer_sk")
+            .agg(*agg_cols, F.count("*").alias("n"))
+            .filter(F.col("n") >= F.lit(3))
+            .orderBy(F.col("n").desc(), F.col("ss_customer_sk"))
+            .limit(100))
+
+
+def q27_like(t) -> "object":
+    """Competitor mentions in reviews (TPCx-BB q27-ish, NER replaced by
+    locate/substring): reviews naming 'brandx', the mention position and a
+    context snippet extracted, counted per category."""
+    pr, it = t["product_reviews"], t["item"]
+    return (pr.filter(F.col("pr_content").contains("brandx"))
+            .withColumn("pos", F.locate("brandx", F.col("pr_content")))
+            .withColumn("snippet",
+                        F.substring(F.col("pr_content"), 1, 20))
+            .join(it, on=(F.col("pr_item_sk") == it["i_item_sk"]),
+                  how="inner")
+            .groupBy("i_category")
+            .agg(F.count("*").alias("mentions"),
+                 F.avg(F.col("pos").cast("double")).alias("avg_pos"))
+            .orderBy("i_category"))
+
+
+def q28_like(t) -> "object":
+    """Sentiment-classifier data prep (TPCx-BB q28-ish): deterministic
+    train/test split by review id modulo, label from the rating threshold,
+    per-(split, label) counts and mean text length."""
+    pr = t["product_reviews"]
+    return (pr.withColumn("split",
+                          F.when(F.col("pr_review_sk") % F.lit(10)
+                                 < F.lit(9),
+                                 F.lit("train")).otherwise(F.lit("test")))
+            .withColumn("label",
+                        F.when(F.col("pr_rating") >= F.lit(4),
+                               F.lit(1)).otherwise(F.lit(0)))
+            .withColumn("len", F.length(F.col("pr_content")))
+            .groupBy("split", "label")
+            .agg(F.count("*").alias("n"),
+                 F.avg(F.col("len").cast("double")).alias("avg_len"))
+            .orderBy("split", "label"))
+
+
+def q30_like(t) -> "object":
+    """Items reviewed together (TPCx-BB q30-ish viewed-together affinity):
+    reviews self-joined on user, unordered distinct item pairs counted and
+    ranked."""
+    pr = t["product_reviews"]
+    a = pr.select(F.col("pr_user_sk").alias("ua"),
+                  F.col("pr_item_sk").alias("ia"))
+    b = pr.select(F.col("pr_user_sk").alias("ub"),
+                  F.col("pr_item_sk").alias("ib"))
+    return (a.join(b, on=(F.col("ua") == F.col("ub")), how="inner")
+            .filter(F.col("ia") < F.col("ib"))
+            .groupBy("ia", "ib")
+            .agg(F.count("*").alias("together"))
+            .orderBy(F.col("together").desc(), F.col("ia"), F.col("ib"))
+            .limit(100))
+
+
 QUERIES: Dict[str, Callable] = {
     "q01_like": q01_like, "q02_like": q02_like, "q03_like": q03_like,
-    "q05_like": q05_like, "q06_like": q06_like, "q07_like": q07_like,
-    "q08_like": q08_like, "q09_like": q09_like, "q11_like": q11_like,
-    "q12_like": q12_like, "q13_like": q13_like, "q14_like": q14_like,
-    "q15_like": q15_like, "q16_like": q16_like, "q17_like": q17_like,
-    "q21_like": q21_like, "q29_like": q29_like,
+    "q04_like": q04_like, "q05_like": q05_like, "q06_like": q06_like,
+    "q07_like": q07_like, "q08_like": q08_like, "q09_like": q09_like,
+    "q10_like": q10_like, "q11_like": q11_like, "q12_like": q12_like,
+    "q13_like": q13_like, "q14_like": q14_like, "q15_like": q15_like,
+    "q16_like": q16_like, "q17_like": q17_like, "q18_like": q18_like,
+    "q19_like": q19_like, "q20_like": q20_like, "q21_like": q21_like,
+    "q22_like": q22_like, "q23_like": q23_like, "q24_like": q24_like,
+    "q25_like": q25_like, "q26_like": q26_like, "q27_like": q27_like,
+    "q28_like": q28_like, "q29_like": q29_like, "q30_like": q30_like,
 }

@@ -554,6 +554,55 @@ def locate(ctx, needle: str, col: ColV, start: int):
     return jnp.where(first < INF, first + 1, 0).astype(jnp.int32)
 
 
+def substring_index(ctx, col: ColV, delim: str, count: int) -> ColV:
+    """substring_index(str, delim, count): the part of str before the
+    count-th occurrence of delim (count > 0), or after the |count|-th
+    occurrence counting from the end (count < 0); the whole string when
+    there are fewer occurrences; "" for count = 0 or an empty delim
+    (reference: GpuSubstringIndex, stringFunctions.scala — cudf
+    substring_index). Precondition enforced by the meta layer: delim is a
+    scalar, length 1 or borderless, so every match is non-overlapping and
+    byte-order occurrence ranks equal Java's scan order."""
+    cap = ctx.capacity
+    lens = lengths_of(col)
+    byte_cap = int(col.data.shape[0])
+    zeros = jnp.zeros((cap,), jnp.int32)
+    nb = _needle_bytes(delim)
+    if count == 0 or len(nb) == 0:
+        data, offsets = build_from_plan([col.data], zeros, zeros, zeros,
+                                        byte_cap)
+        return ColV(DataType.STRING, data, col.validity, offsets)
+    m, row, pos = _match_starts(col, nb, cap)
+    row_start = col.offsets[:-1]
+    # 0-based occurrence rank of each match within its row (matches are in
+    # ascending byte order; non-overlapping by the meta-layer precondition)
+    excl = jnp.cumsum(m.astype(jnp.int32)) - m.astype(jnp.int32)
+    base = excl[jnp.clip(row_start, 0, byte_cap - 1)]
+    base = jnp.where(lens > 0, base, 0)
+    rank = excl[pos] - base[row]
+    total = jax.ops.segment_sum(m.astype(jnp.int32), row, num_segments=cap)
+    INF = jnp.int32(1 << 30)
+    if count > 0:
+        sel = m & (rank == count - 1)
+        bpos = jax.ops.segment_min(jnp.where(sel, pos, INF), row,
+                                   num_segments=cap)
+        start_rel = zeros
+        out_len = jnp.where(total >= count, bpos - row_start, lens)
+    else:
+        k = -count
+        sel = m & (rank == (total - k)[row])
+        bpos = jax.ops.segment_min(jnp.where(sel, pos, INF), row,
+                                   num_segments=cap)
+        start_rel = jnp.where(total >= k,
+                              bpos - row_start + len(nb), 0)
+        out_len = lens - start_rel
+    out_len = jnp.clip(out_len, 0, lens)
+    data, offsets = build_from_plan([col.data], zeros,
+                                    row_start + start_rel, out_len,
+                                    byte_cap)
+    return ColV(DataType.STRING, data, col.validity, offsets)
+
+
 def initcap_ascii(ctx, col: ColV) -> ColV:
     """First letter of each space-separated word uppercased, rest lowercased
     (ASCII; reference: GpuInitCap, stringFunctions.scala:399 — cudf title

@@ -168,6 +168,12 @@ class UnixTimestamp(UnaryExpression):
         return v.data // MICROS_PER_SEC
 
 
+class ToUnixTimestamp(UnixTimestamp):
+    """to_unix_timestamp(ts) — same device kernel as unix_timestamp
+    (reference registers both names over one implementation,
+    GpuOverrides.scala expr[ToUnixTimestamp]/expr[UnixTimestamp])."""
+
+
 class FromUnixTime(UnaryExpression):
     """from_unixtime(sec) -> timestamp (default format path only)."""
 
@@ -190,6 +196,36 @@ class DayOfWeek(UnaryExpression):
         days = _days_of(ctx, v, self.child.data_type)
         # 1970-01-01 was a Thursday (dow=5 in Spark's 1=Sunday scheme)
         return ((days + 4) % 7 + 1).astype(np.int32)
+
+
+class WeekDay(UnaryExpression):
+    """0 = Monday .. 6 = Sunday (Spark weekday(); reference
+    datetimeExpressions.scala GpuWeekDay)."""
+
+    @property
+    def data_type(self):
+        return DataType.INT32
+
+    def do_columnar(self, ctx, v):
+        days = _days_of(ctx, v, self.child.data_type)
+        # 1970-01-01 was a Thursday (weekday=3 in the 0=Monday scheme)
+        return ((days + 3) % 7).astype(np.int32)
+
+
+class DayOfYear(UnaryExpression):
+    """1-based ordinal day within the year (reference:
+    datetimeExpressions.scala GpuDayOfYear)."""
+
+    @property
+    def data_type(self):
+        return DataType.INT32
+
+    def do_columnar(self, ctx, v):
+        xp = ctx.xp
+        days = _days_of(ctx, v, self.child.data_type).astype(np.int64)
+        y, _m, _d = civil_from_days(xp, days)
+        jan1 = days_from_civil(xp, y, xp.ones_like(y), xp.ones_like(y))
+        return (days - jan1 + 1).astype(np.int32)
 
 
 class Quarter(UnaryExpression):

@@ -19,17 +19,27 @@ class UnaryMath(UnaryExpression):
         return DataType.FLOAT64
 
     def do_columnar(self, ctx, v):
-        xp = ctx.xp
-        data = v.data
-        if data.dtype.kind != "f":
-            data = data.astype(np.float64 if not ctx.is_device else _f(ctx))
-        return getattr(xp, self._fn)(data)
+        return getattr(ctx.xp, self._fn)(
+            _to_float(ctx, v.data, ints_only=True))
 
 
 def _f(ctx):
     from spark_rapids_tpu.columnar.batch import physical_np_dtype
 
     return physical_np_dtype(DataType.FLOAT64)
+
+
+def _to_float(ctx, x, ints_only=False):
+    """Coerce a value/array to the double-compute dtype of this context
+    (f32 on TPU hardware, f64 on the CPU oracle) — the ONE place the
+    device-float policy lives for math kernels. ints_only=True leaves
+    float inputs at their stored width (unary-math pass-through)."""
+    f = _f(ctx) if ctx.is_device else np.float64
+    if hasattr(x, "astype"):
+        if ints_only and x.dtype.kind == "f":
+            return x
+        return x.astype(f)
+    return float(x)
 
 
 class Sin(UnaryMath):
@@ -100,6 +110,39 @@ class Cbrt(UnaryMath):
     _fn = "cbrt"
 
 
+class Asinh(UnaryMath):
+    _fn = "arcsinh"
+
+
+class Acosh(UnaryMath):
+    _fn = "arccosh"
+
+
+class Atanh(UnaryMath):
+    _fn = "arctanh"
+
+
+class Cot(UnaryMath):
+    """cot(x) = 1/tan(x) (reference: mathExpressions.scala GpuCot; Spark
+    returns Infinity at x=0, which 1/tan delivers for free)."""
+
+    def do_columnar(self, ctx, v):
+        return 1.0 / ctx.xp.tan(_to_float(ctx, v.data, ints_only=True))
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x) (reference: mathExpressions.scala GpuLogarithm —
+    lowered as log(x)/log(base), matching Spark's StrictMath identity)."""
+
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def do_columnar(self, ctx, lv, rv):
+        xp = ctx.xp
+        return xp.log(_to_float(ctx, _d(rv))) / xp.log(_to_float(ctx, _d(lv)))
+
+
 class Rint(UnaryMath):
     _fn = "rint"
 
@@ -138,13 +181,7 @@ class Pow(BinaryExpression):
         return DataType.FLOAT64
 
     def do_columnar(self, ctx, lv, rv):
-        xp = ctx.xp
-        f = _f(ctx) if ctx.is_device else np.float64
-
-        def cast(x):
-            return x.astype(f) if hasattr(x, "astype") else float(x)
-
-        return xp.power(cast(_d(lv)), cast(_d(rv)))
+        return ctx.xp.power(_to_float(ctx, _d(lv)), _to_float(ctx, _d(rv)))
 
 
 class Atan2(BinaryExpression):
@@ -153,13 +190,8 @@ class Atan2(BinaryExpression):
         return DataType.FLOAT64
 
     def do_columnar(self, ctx, lv, rv):
-        xp = ctx.xp
-        f = _f(ctx) if ctx.is_device else np.float64
-
-        def cast(x):
-            return x.astype(f) if hasattr(x, "astype") else float(x)
-
-        return xp.arctan2(cast(_d(lv)), cast(_d(rv)))
+        return ctx.xp.arctan2(_to_float(ctx, _d(lv)),
+                              _to_float(ctx, _d(rv)))
 
 
 class NormalizeNaNAndZero(UnaryExpression):

@@ -124,3 +124,36 @@ class InputFileName(LeafExpression):
         from spark_rapids_tpu.ops.values import ScalarV
 
         return ScalarV(DataType.STRING, "")
+
+
+class _InputFileBlockBase(LeafExpression):
+    """input_file_block_start()/length(): -1 outside a scan context, like
+    Spark when no file block is being read (reference:
+    GpuInputFileBlockStart/Length, GpuOverrides.scala). Shares
+    InputFileName's coalesce poisoning so the transition optimizer keeps
+    the batch:file-block correspondence intact."""
+
+    @property
+    def data_type(self):
+        return DataType.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def disable_coalesce_until_input(self) -> bool:
+        return True
+
+    def eval_kernel(self, ctx):
+        from spark_rapids_tpu.ops.values import ScalarV
+
+        return ScalarV(DataType.INT64, -1)
+
+
+class InputFileBlockStart(_InputFileBlockBase):
+    pass
+
+
+class InputFileBlockLength(_InputFileBlockBase):
+    pass

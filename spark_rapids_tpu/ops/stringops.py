@@ -327,6 +327,53 @@ class StringReplace(_ScalarArgsTernary):
         return _obj(lambda s: s.replace(fv.value, rv.value), sv.data)
 
 
+class SubstringIndex(_ScalarArgsTernary):
+    """substring_index(str, delim, count) — the part of str before the
+    count-th delim occurrence (count > 0) / after the |count|-th from the
+    end (count < 0) (reference: GpuSubstringIndex, stringFunctions.scala —
+    scalar delim+count like the cudf version). Device kernel requires a
+    length-1 or borderless delim so occurrence ranks match Java's
+    non-overlapping scan; other delims are tagged for CPU fallback by the
+    meta layer."""
+
+    @property
+    def data_type(self):
+        return DataType.STRING
+
+    def do_columnar(self, ctx, sv, dv, cv):
+        assert isinstance(dv, ScalarV) and isinstance(cv, ScalarV)
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar import strings as S
+
+            return S.substring_index(ctx, sv, dv.value, int(cv.value))
+
+        def sub(s):
+            # Java UTF8String.subStringIndex scan semantics: occurrences
+            # may OVERLAP (the scan advances one position, not delim
+            # length) — str.split would miscount for self-overlapping
+            # delims, exactly the inputs routed to this CPU path
+            d, n = dv.value, int(cv.value)
+            if n == 0 or d == "":
+                return ""
+            if n > 0:
+                idx = -1
+                for _ in range(n):
+                    idx = s.find(d, idx + 1)
+                    if idx == -1:
+                        return s
+                return s[:idx]
+            bound = len(s)
+            idx = -1
+            for _ in range(-n):
+                idx = s.rfind(d, 0, bound)
+                if idx == -1:
+                    return s
+                bound = idx + len(d) - 1
+            return s[idx + len(d):]
+
+        return _obj(sub, sv.data)
+
+
 class RegExpReplace(_ScalarArgsTernary):
     """regexp_replace(str, pattern, replacement). Device support mirrors the
     reference's restriction (GpuOverrides.scala:1458-1468 + the regexList at

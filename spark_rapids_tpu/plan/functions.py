@@ -135,6 +135,10 @@ atan = _unary(MX.Atan)
 sinh = _unary(MX.Sinh)
 cosh = _unary(MX.Cosh)
 tanh = _unary(MX.Tanh)
+asinh = _unary(MX.Asinh)
+acosh = _unary(MX.Acosh)
+atanh = _unary(MX.Atanh)
+cot = _unary(MX.Cot)
 rint = _unary(MX.Rint)
 floor = _unary(MX.Floor)
 ceil = _unary(MX.Ceil)
@@ -146,6 +150,11 @@ signum = _unary(AR.Signum)
 
 def pow(a: ColumnOrName, b) -> Column:  # noqa: A001
     return Column(MX.Pow(_c(a), _to_expr(b)))
+
+
+def log_base(base, c: ColumnOrName) -> Column:
+    """log(base, x) (Spark's two-argument log)."""
+    return Column(MX.Logarithm(_to_expr(base), _c(c)))
 
 
 def atan2(a: ColumnOrName, b) -> Column:
@@ -188,6 +197,10 @@ def lower(c: ColumnOrName) -> Column:
 
 def substring(c: ColumnOrName, pos: int, length_: int) -> Column:
     return Column(S.Substring(_c(c), Literal(pos), Literal(length_)))
+
+
+def substring_index(c: ColumnOrName, delim: str, count: int) -> Column:
+    return Column(S.SubstringIndex(_c(c), Literal(delim), Literal(count)))
 
 
 def concat(*cols: ColumnOrName) -> Column:
@@ -239,6 +252,8 @@ year = _unary(DT.Year)
 month = _unary(DT.Month)
 dayofmonth = _unary(DT.DayOfMonth)
 dayofweek = _unary(DT.DayOfWeek)
+weekday = _unary(DT.WeekDay)
+dayofyear = _unary(DT.DayOfYear)
 quarter = _unary(DT.Quarter)
 hour = _unary(DT.Hour)
 minute = _unary(DT.Minute)
@@ -260,6 +275,10 @@ def date_sub(c: ColumnOrName, days) -> Column:
 
 def unix_timestamp(c: ColumnOrName) -> Column:
     return Column(DT.UnixTimestamp(_c(c)))
+
+
+def to_unix_timestamp(c: ColumnOrName) -> Column:
+    return Column(DT.ToUnixTimestamp(_c(c)))
 
 
 def from_unixtime(c: ColumnOrName, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
@@ -313,6 +332,14 @@ def spark_partition_id() -> Column:
 
 def input_file_name() -> Column:
     return Column(MISC.InputFileName())
+
+
+def input_file_block_start() -> Column:
+    return Column(MISC.InputFileBlockStart())
+
+
+def input_file_block_length() -> Column:
+    return Column(MISC.InputFileBlockLength())
 
 
 # -- aggregates --------------------------------------------------------------

@@ -112,8 +112,10 @@ def _register_expr_rules():
     # math (transcendental results can differ in ulps from libm; the reference
     # tags several of these incompat for the same reason)
     for cls in (MX.Sin, MX.Cos, MX.Tan, MX.Asin, MX.Acos, MX.Atan, MX.Sinh,
-                MX.Cosh, MX.Tanh, MX.Exp, MX.Expm1, MX.Log, MX.Log1p,
-                MX.Log2, MX.Log10, MX.Sqrt, MX.Cbrt, MX.Pow, MX.Atan2):
+                MX.Cosh, MX.Tanh, MX.Asinh, MX.Acosh, MX.Atanh, MX.Cot,
+                MX.Exp, MX.Expm1, MX.Log, MX.Log1p,
+                MX.Log2, MX.Log10, MX.Sqrt, MX.Cbrt, MX.Pow, MX.Atan2,
+                MX.Logarithm):
         r(cls, f"math {cls.__name__}",
           incompat="floating point results may differ in ulps from the CPU")
     for cls in (MX.Rint, MX.Floor, MX.Ceil, MX.ToDegrees, MX.ToRadians):
@@ -143,20 +145,25 @@ def _register_expr_rules():
             node = node.child
         return node.value if isinstance(node, Lit) else None
 
-    def _tag_replace(m):
-        from spark_rapids_tpu.columnar.strings import has_border
+    def _borderless_literal_tag(child_idx, what):
+        """Shared device gate for needle-driven string kernels: the
+        argument must be a literal, and length-1 or borderless (no proper
+        border => matches cannot self-overlap, so byte-order occurrence
+        ranks equal Java's one-position scan)."""
+        def tag(m):
+            from spark_rapids_tpu.columnar.strings import has_border
 
-        find = _literal_value(m.expr.children()[1])
-        if not isinstance(find, str):
-            m.will_not_work("replace needs a literal search string")
-        elif len(find.encode("utf-8")) > 1 and \
-                has_border(find.encode("utf-8")):
-            # empty search is identity on device (Spark semantics)
-            m.will_not_work(
-                "device replace requires a self-overlap-free search string "
-                f"({find!r} can overlap itself)")
+            v = _literal_value(m.expr.children()[child_idx])
+            if not isinstance(v, str):
+                m.will_not_work(f"{what} needs a literal string argument")
+            elif len(v.encode("utf-8")) > 1 and has_border(v.encode("utf-8")):
+                m.will_not_work(
+                    f"device {what} requires a self-overlap-free string "
+                    f"({v!r} can overlap itself)")
+        return tag
 
-    r(S.StringReplace, "string StringReplace", tag_fn=_tag_replace)
+    r(S.StringReplace, "string StringReplace",
+      tag_fn=_borderless_literal_tag(1, "replace"))
 
     def _tag_regexp_replace(m):
         from spark_rapids_tpu.columnar.strings import has_border
@@ -186,6 +193,9 @@ def _register_expr_rules():
     r(S.RegExpReplace, "string RegExpReplace (literal patterns)",
       tag_fn=_tag_regexp_replace)
     r(S.StringLocate, "string locate (scalar substring/start)")
+
+    r(S.SubstringIndex, "string substring_index (scalar delim/count)",
+      tag_fn=_borderless_literal_tag(1, "substring_index"))
     for cls in (S.Upper, S.Lower, S.InitCap):
         r(cls, f"string {cls.__name__}",
           incompat="device case conversion is ASCII-only; non-ASCII "
@@ -193,9 +203,12 @@ def _register_expr_rules():
     # datetime
     for cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Hour, DT.Minute,
                 DT.Second, DT.DateDiff, DT.DateAdd, DT.DateSub, DT.LastDay,
-                DT.DayOfWeek, DT.Quarter):
+                DT.DayOfWeek, DT.WeekDay, DT.DayOfYear, DT.Quarter):
         r(cls, f"datetime {cls.__name__}")
     r(DT.UnixTimestamp, "parse/convert to unix seconds",
+      incompat="range/overflow behavior differs slightly from CPU "
+               "(reference: improvedTimeOps)")
+    r(DT.ToUnixTimestamp, "parse/convert to unix seconds",
       incompat="range/overflow behavior differs slightly from CPU "
                "(reference: improvedTimeOps)")
     r(DT.FromUnixTime, "format unix seconds as string")
@@ -205,6 +218,8 @@ def _register_expr_rules():
     r(MISC.MonotonicallyIncreasingID, "monotonically increasing id")
     r(MISC.SparkPartitionID, "partition id")
     r(MISC.InputFileName, "input file name")
+    r(MISC.InputFileBlockStart, "input file block start")
+    r(MISC.InputFileBlockLength, "input file block length")
     # aggregate functions
     for cls in (AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average,
                 AGG.First, AGG.Last):

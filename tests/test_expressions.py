@@ -290,6 +290,33 @@ def test_datetime_parts():
     assert rows[0][7] == 5
 
 
+def test_datetime_parts_extended():
+    """weekday()/dayofyear() (reference: GpuWeekDay/GpuDayOfYear,
+    datetimeExpressions.scala) and to_unix_timestamp."""
+    rows = check_exprs(DATE_BATCH, [
+        DT.WeekDay(D0), DT.DayOfYear(D0), DT.ToUnixTimestamp(TS1),
+    ])
+    # 1970-01-01 was a Thursday -> 3 in the 0=Monday scheme; day 1 of year
+    assert rows[0][0] == 3 and rows[0][1] == 1
+    # 18262 days = 2020-01-01 (leap year, day 1)
+    assert rows[1][0] == 2 and rows[1][1] == 1  # Wednesday
+    # 1969-12-31: day 365
+    assert rows[2][1] == 365
+
+
+def test_math_extended():
+    """asinh/acosh/atanh/cot and two-arg log (reference:
+    mathExpressions.scala GpuAsinh/GpuAcosh/GpuAtanh/GpuCot/GpuLogarithm)."""
+    pos = make_batch(x=([1.5, 2.5, 0.5, None, 100.0], DataType.FLOAT64),
+                     u=([0.5, -0.3, 0.9, 0.0, -0.99], DataType.FLOAT64))
+    x = ref(0, DataType.FLOAT64)
+    u = ref(1, DataType.FLOAT64)
+    check_exprs(pos, [
+        M.Asinh(x), M.Acosh(x), M.Atanh(u),
+        M.Cot(x), M.Logarithm(lit(2.0), x),
+    ], approx=True)
+
+
 def test_datetime_arith():
     rows = check_exprs(DATE_BATCH, [
         DT.DateDiff(D0, lit(0, DataType.DATE)),

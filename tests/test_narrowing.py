@@ -89,6 +89,52 @@ def test_host_upload_attaches_vrange():
     assert dev.columns[0].vrange == (-8, 15)
 
 
+def test_serde_roundtrip_recovers_vrange():
+    """TPB1 bytes carry no vrange (spill/shuffle/broadcast); the re-upload
+    min/max pass must recover one, so a spilled-and-restored batch narrows
+    again downstream."""
+    from spark_rapids_tpu.columnar.batch import HostColumnarBatch, \
+        HostColumnVector
+    from spark_rapids_tpu.columnar.serde import (
+        deserialize_batch,
+        serialize_batch,
+    )
+
+    hb = HostColumnarBatch(
+        [HostColumnVector(DataType.INT64,
+                          np.array([100, -3, 77], dtype=np.int64),
+                          np.array([True, True, True]))], 3)
+    back = deserialize_batch(serialize_batch(hb))
+    dev = back.to_device()
+    assert dev.columns[0].vrange == (-4, 127)
+
+
+def test_conf_flip_clears_kernels_and_applies():
+    """Flipping rapids.tpu.sql.int64.narrowing.enabled mid-session must
+    flush compiled kernels (the flag is read at trace time, not in cache
+    keys) — and a no-op set must NOT flush."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.columnar.batch import int64_narrowing_enabled
+    from spark_rapids_tpu.engine import jit_cache
+
+    s = srt.new_session()
+    try:
+        assert int64_narrowing_enabled()
+        jit_cache.get_or_build(("probe", 1), lambda: object())
+        before = jit_cache.stats()["entries"]
+        assert before >= 1
+        s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", True)  # no-op
+        assert jit_cache.stats()["entries"] == before
+        s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", False)
+        assert not int64_narrowing_enabled()
+        assert jit_cache.stats()["entries"] == 0
+        s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", True)
+        assert int64_narrowing_enabled()
+    finally:
+        s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", True)
+        s.stop()
+
+
 def test_quantize_vrange_ladder():
     """vrange is jit-cache aux data: exact per-batch min/max would retrace
     every kernel per batch, so bounds quantize to a power-of-two ladder.

@@ -204,3 +204,60 @@ class TestRegexpQuantifier:
         cpu = run_on_cpu(session, q)
         assert [r[0] for r in cpu] == ["ab", "", None]
         assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+class TestSubstringIndex:
+    def test_substring_index_on_device(self, session):
+        # positive / negative / overflow counts, single-char delim
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["a.b.c.d", "no-dots", "", ".lead", "trail.",
+                       None, "x.y"]},
+                [("t", DataType.STRING)]) \
+                .select(F.substring_index(F.col("t"), ".", 2).alias("p2"),
+                        F.substring_index(F.col("t"), ".", -2).alias("n2"),
+                        F.substring_index(F.col("t"), ".", 10).alias("all"),
+                        F.substring_index(F.col("t"), ".", 0).alias("z"))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q)
+
+    def test_substring_index_multichar_delim(self, session):
+        # ', ' is borderless (prefix ',' != suffix ' ') -> device kernel;
+        # values exercise fewer/more matches than |count|
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["a, b, c", ", x", "y, ", "none", None]},
+                [("t", DataType.STRING)]) \
+                .select(F.substring_index(F.col("t"), ", ", 1).alias("p1"),
+                        F.substring_index(F.col("t"), ", ", -1).alias("n1"))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q)
+
+    def test_substring_index_overlappy_delim_falls_back(self, session):
+        # 'aa' can overlap itself -> CPU fallback; the fallback must use
+        # Java's one-position scan (overlapping occurrences), NOT
+        # str.split: substring_index('aaa','aa',2) == 'a' (matches at
+        # bytes 0 AND 1), and ('aaa','aa',-2) == 'a'
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["aaa.b", "xaay", "aaa", "ababa", None]},
+                [("t", DataType.STRING)]) \
+                .select(F.substring_index(F.col("t"), "aa", 1).alias("r1"),
+                        F.substring_index(F.col("t"), "aa", 2).alias("r2"),
+                        F.substring_index(F.col("t"), "aa", -2).alias("rn"))
+
+        rows = run_on_cpu(session, q)
+        assert rows[2] == ("", "a", "a")       # 'aaa': overlap at 0 and 1
+        assert rows[3] == ("ababa",) * 3       # no 'aa' in 'ababa'
+        assert_tpu_fallback_collect(session, q,
+                                    fallback_exec="CpuProjectExec")
+
+    def test_substring_index_unicode(self, session):
+        def q(s):
+            return s.createDataFrame(
+                {"t": ["日本,語,テスト", "één,twee", "🎉,🎊,🎈", None]},
+                [("t", DataType.STRING)]) \
+                .select(F.substring_index(F.col("t"), ",", 1).alias("a"),
+                        F.substring_index(F.col("t"), ",", -1).alias("b"))
+
+        assert_tpu_and_cpu_are_equal_collect(session, q)

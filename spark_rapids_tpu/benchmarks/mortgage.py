@@ -227,10 +227,29 @@ def q_agg_join(t) -> "object":
             .limit(200))
 
 
+def q_percentiles(t) -> "object":
+    """AggregatesWithPercentiles (MortgageSpark.scala:367-390): per-loan
+    interest-rate min/max/avg plus the exact 50/75/90/99th percentiles —
+    the holistic percentile aggregate over the performance fact table."""
+    perf = t["performance"]
+    return (perf.groupBy("loan_id")
+            .agg(F.min("interest_rate").alias("rate_min"),
+                 F.max("interest_rate").alias("rate_max"),
+                 F.avg(F.col("interest_rate").cast("double"))
+                 .alias("rate_avg"),
+                 F.percentile(F.col("interest_rate"), 0.50).alias("p50"),
+                 F.percentile(F.col("interest_rate"), 0.75).alias("p75"),
+                 F.percentile(F.col("interest_rate"), 0.90).alias("p90"),
+                 F.percentile(F.col("interest_rate"), 0.99).alias("p99"))
+            .orderBy(F.col("rate_avg").desc(), F.col("loan_id"))
+            .limit(100))
+
+
 QUERIES: Dict[str, Callable] = {
     "q_delinquency": q_delinquency,
     "q_seller_quarter": q_seller_quarter,
     "q_delinquency_12": q_delinquency_12,
     "q_simple_agg": q_simple_agg,
     "q_agg_join": q_agg_join,
+    "q_percentiles": q_percentiles,
 }

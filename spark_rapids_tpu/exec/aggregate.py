@@ -231,6 +231,11 @@ def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression]):
             filters.append(node.condition)
             node = node.children[0]
         elif isinstance(node, TpuCoalesceBatchesExec):
+            if node.goal.target_bytes() is None:
+                # RequireSingleBatch is SEMANTIC (holistic aggregates need
+                # exactly one update pass per partition) — only
+                # best-effort TargetSize coalesces are perf no-ops here
+                break
             node = node.children[0]
         else:
             break
@@ -241,6 +246,19 @@ def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression]):
 
 class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
     placement = "tpu"
+
+    @property
+    def children_coalesce_goal(self):
+        if self.mode == COMPLETE and \
+                any(getattr(s.func, "holistic", False) for s in self.specs):
+            # holistic aggs can't merge partials: the whole partition must
+            # arrive as ONE batch so exactly one update pass runs. A
+            # TPU-kernel property only — the CPU exec streams rows into
+            # per-group accumulators and needs no coalesce
+            from spark_rapids_tpu.exec.transitions import RequireSingleBatch
+
+            return [RequireSingleBatch()]
+        return [None]
 
     # -- jitted kernels (cached process-wide by semantic identity) -----------
     def _build_update_kernel(self, input_attrs, key_exprs, input_exprs,
@@ -668,6 +686,16 @@ class _HostAcc:
 
     def add(self, v, valid: bool):
         op = self.op
+        if op.startswith("pct:"):
+            if valid:
+                if self.value is None:
+                    self.value = []
+                self.value.append(float(v))
+            return
+        if op == "unmergeable":
+            raise AssertionError(
+                "holistic aggregate reached a merge stage — the planner "
+                "must run it complete-mode")
         if op == "count":
             if self.value is None:
                 self.value = 0
@@ -711,6 +739,16 @@ class _HostAcc:
     def result(self):
         if self.op == "count":
             return (self.value or 0), True
+        if self.op.startswith("pct:"):
+            if not self.value:
+                return None, False
+            p = float(self.op[4:])
+            vals = np.sort(np.asarray(self.value, dtype=np.float64))
+            q = p * (len(vals) - 1)
+            k = int(np.floor(q))
+            frac = q - k
+            hi = min(k + 1, len(vals) - 1) if frac > 0 else k
+            return float(vals[k] * (1 - frac) + vals[hi] * frac), True
         return self.value, self.valid
 
 

@@ -443,3 +443,55 @@ class Last(AggregateFunction):
 
     def _fingerprint_extra(self):
         return f"{self.ignore_nulls};"
+
+
+class Percentile(AggregateFunction):
+    """Exact percentile(col, p): linear interpolation at rank p*(n-1) over
+    the group's sorted non-null values, as DOUBLE (Spark's exact
+    `percentile`; reference benchmark AggregatesWithPercentiles,
+    mortgage/MortgageSpark.scala:367-390).
+
+    HOLISTIC: not decomposable into update/merge partials (Spark runs it
+    via ObjectHashAggregate for the same reason), so the planner skips the
+    partial stage — raw rows exchange on the grouping keys and ONE
+    complete-mode aggregation runs per partition over a single coalesced
+    batch. On device the kernel is one (gid, value) sort + two boundary
+    gathers + an interpolation (exec/rowkeys.segment_reduce "pct:<p>"),
+    the TPU shape of cudf's group quantiles."""
+
+    holistic = True
+
+    def __init__(self, child: Expression, p: float):
+        super().__init__(child)
+        if not (0.0 <= float(p) <= 1.0):
+            raise ValueError(f"percentile fraction must be in [0, 1]: {p}")
+        self.p = float(p)
+
+    def with_children(self, new_children):
+        return Percentile(new_children[0], self.p)
+
+    def _fingerprint_extra(self):
+        return f"p={self.p!r};"
+
+    @property
+    def data_type(self):
+        return DataType.FLOAT64
+
+    def buffer_attrs(self):
+        return [AttributeReference("pct", DataType.FLOAT64, True)]
+
+    def update_aggs(self):
+        from spark_rapids_tpu.ops.cast import Cast
+
+        child = self.child
+        if child.data_type is not DataType.FLOAT64:
+            child = Cast(child, DataType.FLOAT64)
+        return [("pct", f"pct:{self.p!r}", child)]
+
+    def merge_aggs(self):
+        # never reached: holistic plans have no partial stage. A loud op
+        # name keeps a future planner regression from silently merging.
+        return [("pct", "unmergeable")]
+
+    def evaluate_expression(self, buffers):
+        return buffers[0]

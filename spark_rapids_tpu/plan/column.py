@@ -162,6 +162,12 @@ class Column:
         """function OVER window (reference: GpuWindowExpression)."""
         from spark_rapids_tpu.ops.window import WindowExpression
 
+        if getattr(self.expr, "holistic", False):
+            # holistic aggregates (percentile) have no windowed evaluation
+            # in either engine — fail at the API, not mid-query
+            raise NotImplementedError(
+                f"{type(self.expr).__name__} is not supported as a window "
+                "function")
         return Column(WindowExpression(self.expr, window.to_spec()))
 
     # -- sorting -------------------------------------------------------------

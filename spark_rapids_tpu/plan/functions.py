@@ -361,6 +361,11 @@ def count(c: ColumnOrName = "*") -> Column:
     return Column(A.Count(_c(c)))
 
 
+def percentile(c: ColumnOrName, p: float) -> Column:
+    """Exact percentile at fraction p in [0, 1] (Spark `percentile`)."""
+    return Column(A.Percentile(_c(c), p))
+
+
 def avg(c: ColumnOrName) -> Column:
     return Column(A.Average(_c(c)))
 

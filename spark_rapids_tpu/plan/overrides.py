@@ -224,6 +224,8 @@ def _register_expr_rules():
     for cls in (AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average,
                 AGG.First, AGG.Last):
         r(cls, f"aggregate {cls.__name__}", tag_fn=_tag_agg)
+    r(AGG.Percentile, "exact percentile (holistic sort-based aggregate)",
+      tag_fn=_tag_agg)
     # window (reference registry: GpuWindowExpression/GpuRowNumber etc.,
     # GpuOverrides.scala window expression rules)
     from spark_rapids_tpu.ops import window as W
@@ -262,6 +264,11 @@ def _tag_window_expr(m: ExprMeta) -> None:
 
     w = m.expr
     f = w.function
+    if getattr(f, "holistic", False):
+        # holistic aggregates (percentile) have no windowed evaluation in
+        # EITHER engine — reject at planning, not with a runtime crash
+        m.will_not_work(
+            f"{type(f).__name__} is not supported as a window function")
     frame = w.spec.frame
     if frame.frame_type == "range" and (
             frame.lower not in (W.UNBOUNDED, 0)

@@ -208,6 +208,26 @@ def _plan_aggregate(plan: L.Aggregate, conf: C.TpuConf) -> PhysicalExec:
 
     (child,) = _plan_children(plan, conf)
     specs = build_agg_specs(plan.agg_exprs)
+    if any(getattr(s.func, "holistic", False) for s in specs):
+        # holistic aggregates (percentile) are not update/merge
+        # decomposable: exchange RAW rows on the grouping keys and run ONE
+        # complete-mode aggregation (Spark's ObjectHashAggregate shape; the
+        # exec declares RequireSingleBatch so each partition aggregates
+        # exactly once)
+        from spark_rapids_tpu.exec.aggregate import (
+            COMPLETE,
+            _key_exprs_for,
+        )
+
+        if plan.grouping:
+            part = HashPartitioning(
+                _key_exprs_for(plan.grouping, plan.agg_exprs),
+                conf.shuffle_partitions)
+        else:
+            part = SinglePartitioning()
+        exchange = CpuShuffleExchangeExec(part, child)
+        return CpuHashAggregateExec(plan.grouping, plan.agg_exprs, COMPLETE,
+                                    exchange, specs)
     partial = CpuHashAggregateExec(plan.grouping, plan.agg_exprs, PARTIAL,
                                    child, specs)
     if plan.grouping:

@@ -1,0 +1,124 @@
+"""Exact percentile aggregate (reference benchmark:
+mortgage/MortgageSpark.scala AggregatesWithPercentiles:367-390; Spark's
+exact `percentile` semantics — linear interpolation at rank p*(n-1) over
+sorted non-null values, DOUBLE result, NULL for empty groups).
+
+The device kernel is one (gid, nulls-last, value) sort + boundary gathers
+(exec/rowkeys.segment_reduce "pct:<p>"); the plan is holistic: raw rows
+exchange on the keys and ONE complete-mode aggregation runs over a single
+coalesced batch per partition.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    FloatGen,
+    IntGen,
+    assert_tpu_and_cpu_are_equal_collect,
+    gen_df,
+    run_on_tpu,
+)
+
+
+def test_percentile_matches_numpy(session):
+    rng = np.random.default_rng(3)
+    vals = rng.normal(0, 50, 400)
+    df_rows = run_on_tpu(
+        session,
+        lambda s: s.createDataFrame(
+            {"v": vals}, [("v", "double")], num_partitions=3)
+        .agg(F.percentile(F.col("v"), 0.5).alias("p50"),
+             F.percentile(F.col("v"), 0.0).alias("p0"),
+             F.percentile(F.col("v"), 1.0).alias("p100"),
+             F.percentile(F.col("v"), 0.75).alias("p75")))
+    (p50, p0, p100, p75) = df_rows[0]
+    assert p50 == pytest.approx(float(np.percentile(vals, 50)), rel=1e-6)
+    assert p0 == pytest.approx(float(vals.min()), rel=1e-6)
+    assert p100 == pytest.approx(float(vals.max()), rel=1e-6)
+    assert p75 == pytest.approx(float(np.percentile(vals, 75)), rel=1e-6)
+
+
+def test_grouped_percentile_equivalence(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=12)),
+                             ("v", FloatGen())], n=800)
+        .groupBy("k").agg(F.percentile(F.col("v"), 0.5).alias("p50"),
+                          F.percentile(F.col("v"), 0.9).alias("p90")),
+        ignore_order=True, approx_float=1e-6)
+
+
+def test_percentile_mixed_with_plain_aggs(session):
+    # the holistic plan must still compute decomposable aggs correctly in
+    # the same single complete-mode pass
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: gen_df(s, [("k", IntGen(DataType.INT64, lo=0, hi=8)),
+                             ("v", FloatGen()),
+                             ("w", IntGen(DataType.INT64))], n=600)
+        .groupBy("k").agg(F.percentile(F.col("v"), 0.25).alias("p25"),
+                          F.min("v").alias("mn"), F.max("v").alias("mx"),
+                          F.sum("w").alias("s"),
+                          F.count("*").alias("c")),
+        ignore_order=True, approx_float=1e-6)
+
+
+def test_percentile_integer_input_and_nulls(session):
+    # integer inputs cast to double; null values are skipped; an all-null
+    # group yields NULL
+    def q(s):
+        return s.createDataFrame(
+            {"k": [1, 1, 1, 2, 2, 3],
+             "v": [10, None, 20, 7, None, None]},
+            [("k", "long"), ("v", "long")]) \
+            .groupBy("k").agg(F.percentile(F.col("v"), 0.5).alias("p"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+    rows = dict(run_on_tpu(session, q))
+    assert rows[1] == pytest.approx(15.0)
+    assert rows[2] == pytest.approx(7.0)
+    assert rows[3] is None
+
+
+def test_percentile_empty_input(session):
+    rows = run_on_tpu(
+        session,
+        lambda s: s.createDataFrame({"v": []}, [("v", "double")])
+        .agg(F.percentile(F.col("v"), 0.5).alias("p")))
+    assert rows == [(None,)]
+
+
+def test_percentile_invalid_fraction():
+    from spark_rapids_tpu.ops.aggregates import Percentile
+    from spark_rapids_tpu.ops.base import AttributeReference
+
+    with pytest.raises(ValueError):
+        Percentile(AttributeReference("v", DataType.FLOAT64), 1.5)
+
+
+def test_percentile_over_window_rejected(session):
+    # holistic aggregates have no windowed evaluation in either engine:
+    # the API must reject OVER immediately, not crash mid-query
+    from spark_rapids_tpu.plan.window_api import Window
+
+    with pytest.raises(NotImplementedError, match="window"):
+        F.percentile(F.col("v"), 0.5).over(Window.partitionBy("k"))
+
+
+def test_percentile_plan_is_single_stage(session):
+    # holistic: no partial stage, exchange carries raw rows, and a
+    # RequireSingleBatch coalesce guards the one update pass
+    def q(s):
+        return s.createDataFrame(
+            {"k": [1, 2], "v": [1.0, 2.0]},
+            [("k", "long"), ("v", "double")]) \
+            .groupBy("k").agg(F.percentile(F.col("v"), 0.5).alias("p"))
+
+    plan = q(session).explain()
+    assert "complete" in plan
+    assert "partial" not in plan
+    assert "RequireSingleBatch" in plan

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -281,23 +282,62 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
               qmod.gen_tables(session, sf=sf, num_partitions=4).items()}
     _log(f"worker[{mode}]: {suite} sf={sf} tables built")
     bests = {}
+    skipped = []
+    # per-query wall cap: a slow query (many small device steps) must cost
+    # its own slot, not the whole capture — partial geomeans with an
+    # explicit skipped list beat an empty artifact. SIGALRM only fires
+    # between Python bytecodes, so it cannot interrupt ONE long blocking
+    # C/XLA call (a hard tunnel wedge); the phase-level subprocess timeout
+    # in the supervisor remains the backstop for that case.
+    q_cap_s = float(os.environ.get("SRT_BENCH_QUERY_CAP_S", "300"))
+
+    class _QueryTimeout(Exception):
+        pass
+
+    def _alarm(_sig, _frm):
+        raise _QueryTimeout()
+
+    has_alarm = hasattr(signal, "SIGALRM")
+    if has_alarm:
+        signal.signal(signal.SIGALRM, _alarm)
     for qi, (qname, qfn) in enumerate(sorted(qmod.QUERIES.items())):
-        qfn(tables).collect()  # warmup/compile
-        times = []
-        for _ in range(2):
-            t0 = time.perf_counter()
-            qfn(tables).collect()
-            times.append(time.perf_counter() - t0)
-        bests[qname] = min(times)
-        _log(f"worker[{mode}]: {qname}: {bests[qname]:.3f}s")
+        try:
+            if has_alarm:
+                signal.alarm(int(q_cap_s))
+            qfn(tables).collect()  # warmup/compile
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                qfn(tables).collect()
+                times.append(time.perf_counter() - t0)
+            if has_alarm:
+                # cancel BEFORE recording so a late alarm can't put the
+                # query in both bests and skipped
+                signal.alarm(0)
+            bests[qname] = min(times)
+            _log(f"worker[{mode}]: {qname}: {bests[qname]:.3f}s")
+        except _QueryTimeout:
+            skipped.append(qname)
+            _log(f"worker[{mode}]: {qname}: SKIPPED (> {q_cap_s:.0f}s cap)")
+        finally:
+            if has_alarm:
+                signal.alarm(0)
         if (qi + 1) % 5 == 0:
             # a 22-query suite accumulates enough live XLA executables to
             # segfault the CPU runtime; dropping them between queries keeps
             # the worker alive (recompiles come from the persistent cache)
             jax.clear_caches()
+    if not bests:
+        print(json.dumps({"mode": mode, "platform": dev.platform,
+                          "geomean_s": None, "queries": {},
+                          "skipped": skipped}), flush=True)
+        return
     geo = math.exp(sum(math.log(t) for t in bests.values()) / len(bests))
-    print(json.dumps({"mode": mode, "platform": dev.platform,
-                      "geomean_s": geo, "queries": bests}), flush=True)
+    out = {"mode": mode, "platform": dev.platform,
+           "geomean_s": geo, "queries": bests}
+    if skipped:
+        out["skipped"] = skipped
+    print(json.dumps(out), flush=True)
 
 
 # ------------------------------------------------------------- supervisor
@@ -469,21 +509,40 @@ def main_suite(suite: str, sf: float) -> None:
         # same honest fallback as main(): accelerated engine on CPU backend
         acc = _run_phase(f"{suite}-tpu", cpu_env, CPU_BUDGET_S * 2)
         platform = "cpu-fallback" if acc else None
-    if acc is None:
+    if acc is None or not acc.get("queries"):
         print(json.dumps({"metric": f"{suite}_like_geomean_s", "value": 0.0,
                           "unit": "s", "vs_baseline": 0.0,
-                          "error": f"{suite} bench failed", "sf": sf}))
+                          "error": f"{suite} bench failed", "sf": sf,
+                          "skipped": (acc or {}).get("skipped", [])}))
         return
-    print(json.dumps({
+    # vs_baseline over the COMMON query set only — per-query caps can skip
+    # different queries on each side, and a mismatched geomean ratio would
+    # silently bias the headline
+    import math as _math
+
+    def _geo(d):
+        return _math.exp(sum(_math.log(t) for t in d.values()) / len(d))
+
+    out = {
         "metric": f"{suite}_like_geomean_s",
         "value": round(acc["geomean_s"], 4),
         "unit": "s",
-        "vs_baseline": (round(cpu["geomean_s"] / acc["geomean_s"], 3)
-                        if cpu else 0.0),
+        "vs_baseline": 0.0,
         "platform": platform,
         "sf": sf,
         "queries": {k: round(v, 4) for k, v in acc["queries"].items()},
-    }))
+    }
+    if cpu and cpu.get("queries"):
+        common = set(acc["queries"]) & set(cpu["queries"])
+        if common:
+            out["vs_baseline"] = round(
+                _geo({q: cpu["queries"][q] for q in common})
+                / _geo({q: acc["queries"][q] for q in common}), 3)
+    skipped = sorted(set((acc.get("skipped") or [])
+                         + ((cpu or {}).get("skipped") or [])))
+    if skipped:
+        out["skipped"] = skipped
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -36,30 +36,44 @@ def _host_reference(n_shards=8, cap=256):
     return len(groups), int(proj[keep].sum())
 
 
-def test_two_process_distributed_agg():
+def _run_two_workers(flag=None, timeout=240, label="worker"):
+    """Launch two distributed_worker.py processes joined through one
+    coordination service (4 virtual CPU devices each -> an 8-device global
+    mesh) and return their parsed JSON result lines."""
     from spark_rapids_tpu.utils.hostenv import scrubbed_cpu_env
 
     port = _free_port()
     procs = []
     for pid in range(2):
-        env = scrubbed_cpu_env(4)  # 4 virtual CPU devices per process
+        env = scrubbed_cpu_env(4)
         env.update({
             "SRT_COORDINATOR": f"127.0.0.1:{port}",
             "SRT_NUM_PROCESSES": "2",
             "SRT_PROCESS_ID": str(pid),
         })
+        cmd = [sys.executable,
+               os.path.join(REPO, "tests", "distributed_worker.py")]
+        if flag:
+            cmd.append(flag)
         procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests",
-                                          "distributed_worker.py")],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        line = [l for l in out.splitlines() if l.startswith("{")][-1]
-        outs.append(json.loads(line))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"{label} failed:\n{err[-3000:]}"
+            line = [l for l in out.splitlines() if l.startswith("{")][-1]
+            outs.append(json.loads(line))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
 
+
+def test_two_process_distributed_agg():
+    outs = _run_two_workers()
     exp_groups, exp_checksum = _host_reference()
     for o in outs:
         assert o["devices"] == 8
@@ -74,29 +88,19 @@ def test_two_process_dataframe_query():
     tier, each process asserting equality to the CPU oracle in-worker
     (reference: the executor-spanning UCX shuffle,
     UCXShuffleTransport.scala:47-507)."""
-    from spark_rapids_tpu.utils.hostenv import scrubbed_cpu_env
-
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = scrubbed_cpu_env(4)
-        env.update({
-            "SRT_COORDINATOR": f"127.0.0.1:{port}",
-            "SRT_NUM_PROCESSES": "2",
-            "SRT_PROCESS_ID": str(pid),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests",
-                                          "distributed_worker.py"),
-             "--engine"],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=360)
-        assert p.returncode == 0, f"engine worker failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("{")][-1]
-        outs.append(json.loads(line))
+    outs = _run_two_workers("--engine", timeout=360, label="engine worker")
     assert outs[0]["devices"] == 8 and outs[0]["local_devices"] == 4
     # both processes saw the identical full result
+    assert outs[0] == {**outs[1], "pid": 0}
+
+
+def test_two_process_tpch_queries():
+    """TPC-H q3 (string predicates + join + groupBy + sort) and q6 execute
+    across 2 OS processes x 4 devices through the ICI shuffle tier, each
+    process matching the CPU oracle — the reference's benchmark-over-UCX
+    deployment shape (TpchLikeSpark.scala over
+    RapidsShuffleInternalManager.scala:74-178)."""
+    outs = _run_two_workers("--tpch", timeout=420, label="tpch worker")
+    assert outs[0]["devices"] == 8 and outs[0]["local_devices"] == 4
+    assert outs[0]["rows"]["q3"] > 0 and outs[0]["rows"]["q6"] == 1
     assert outs[0] == {**outs[1], "pid": 0}

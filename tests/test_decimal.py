@@ -320,3 +320,37 @@ def test_df_decimal_parquet_roundtrip(session, tmp_path):
         session,
         lambda s: s.read.parquet(path).filter(F.col("price") != D("0.99")),
         ignore_order=True)
+
+
+def test_decimal_sum_narrow_vs_split_paths(session):
+    """precision <= 9 sums take the single-reduction narrow path;
+    precision >= 10 keeps the hi/lo overflow-detection split — both must
+    be exact and agree with the oracle at their precision-overflow edges
+    (ops/aggregates._narrow_decimal)."""
+    from spark_rapids_tpu.ops.aggregates import Sum, _narrow_decimal
+    from spark_rapids_tpu.ops.base import AttributeReference
+
+    assert _narrow_decimal(DecimalType(9, 2))
+    assert not _narrow_decimal(DecimalType(10, 2))
+    # buffer shapes differ: narrow = [sum_u, sum_n]; split = 3 buffers
+    narrow = Sum(AttributeReference("v", DecimalType(9, 2)))
+    split = Sum(AttributeReference("v", DecimalType(18, 0)))
+    assert len(narrow.buffer_attrs()) == 2
+    assert len(split.buffer_attrs()) == 3
+
+    def q(s):
+        # max-magnitude decimal(9,2) values: the narrow int64 sum holds
+        # them exactly; avg exercises the same buffers
+        df = s.createDataFrame(
+            {"k": [1] * 50 + [2] * 3,
+             "v": [D("9999999.99")] * 25 + [D("-9999999.99")] * 25
+                  + [D("0.01"), None, D("-0.02")]},
+            [("k", "long"), ("v", "decimal(9,2)")], num_partitions=3)
+        return df.groupBy("k").agg(F.sum("v").alias("s"),
+                                   F.avg("v").alias("a"),
+                                   F.count("v").alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+    rows = {r[0]: r for r in q(session).collect()}
+    assert rows[1][1] == D("0.00")
+    assert rows[2][1] == D("-0.01")

@@ -209,6 +209,50 @@ def test_interval_rules_exact():
                     type(expr).__name__, x, y, v, want)
 
 
+def test_interval_rules_fuzz_containment():
+    """Randomized sweep: for random operand intervals and random in-range
+    values, every op's claimed interval must contain the exact math result
+    — the narrowing proof is only as sound as these bounds."""
+    from spark_rapids_tpu.ops.arithmetic import (
+        Add,
+        Multiply,
+        Pmod,
+        Remainder,
+        Subtract,
+    )
+    from spark_rapids_tpu.ops.base import BoundReference
+
+    a = BoundReference(0, DataType.INT64, True)
+    b = BoundReference(1, DataType.INT64, True)
+    ops = {
+        Add(a, b): lambda x, y: x + y,
+        Subtract(a, b): lambda x, y: x - y,
+        Multiply(a, b): lambda x, y: x * y,
+        Remainder(a, b): lambda x, y: int(np.fmod(x, y)) if y else None,
+        Pmod(a, b): lambda x, y: ((x % y) + y) % y if y else None,
+    }
+    rng = np.random.default_rng(17)
+    for _ in range(300):
+        lo1 = int(rng.integers(-2**33, 2**33))
+        hi1 = lo1 + int(rng.integers(0, 2**20))
+        lo2 = int(rng.integers(-2**33, 2**33))
+        hi2 = lo2 + int(rng.integers(0, 2**20))
+        xs = [lo1, hi1] + [int(v) for v in rng.integers(lo1, hi1 + 1, 4)]
+        ys = [lo2, hi2] + [int(v) for v in rng.integers(lo2, hi2 + 1, 4)]
+        for expr, fn in ops.items():
+            iv = expr._math_interval((lo1, hi1), (lo2, hi2))
+            if iv is None:
+                continue
+            for x in xs:
+                for y in ys:
+                    v = fn(x, y)
+                    if v is None:
+                        continue
+                    assert iv[0] <= v <= iv[1], (
+                        type(expr).__name__, (lo1, hi1), (lo2, hi2),
+                        x, y, v, iv)
+
+
 def test_static_vrange_through_expressions():
     from spark_rapids_tpu.ops.arithmetic import Add, Multiply
     from spark_rapids_tpu.ops.base import BoundReference

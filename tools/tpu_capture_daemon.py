@@ -1,0 +1,122 @@
+"""Opportunistic real-TPU capture: probe the (flaky) axon tunnel, and on
+the first healthy window run the bench captures in priority order, writing
+session artifacts. Run from the repo root:
+
+    python tools/tpu_capture_daemon.py [max_hours]
+
+Each probe is a short-lived subprocess (a wedge costs PROBE_TIMEOUT_S, not
+a hang). On a healthy probe the captures run immediately — the tunnel's
+healthy windows have been minutes long, so order is by value density:
+flagship GB/s (with int64 narrowing now on by default), the i64 microbench
+re-check, then the SF1 TPC-H suite (per-query caps keep a mid-suite wedge
+from zeroing the artifact; see bench.py SRT_BENCH_QUERY_CAP_S).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_TIMEOUT_S = 75
+PROBE_INTERVAL_S = 300
+
+CAPTURES = [
+    # (artifact, argv, timeout_s)
+    ("BENCH_TPU_r03_narrowed.json", [sys.executable, "bench.py"], 1200),
+    ("BENCH_I64_r03.json", [sys.executable, "bench.py", "--i64"], 1200),
+    ("BENCH_DECODE_r03.json", [sys.executable, "bench.py", "--decode"], 1200),
+    ("BENCH_TPCH_SF1_r03.json",
+     [sys.executable, "bench.py", "--tpch", "1.0"], 5400),
+]
+
+
+def probe() -> bool:
+    code = ("import jax, jax.numpy as jnp\n"
+            "d = jax.devices()\n"
+            "x = jnp.ones((128, 128))\n"
+            "float((x @ x).sum())\n"
+            "print('PROBE_PLATFORM=' + d[0].platform)\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO, timeout=PROBE_TIMEOUT_S,
+            capture_output=True, text=True)
+        # exact-token parse (the bench supervisor's probe contract): any
+        # substring heuristic would misread benign log lines
+        platform = None
+        for ln in reversed(out.stdout.splitlines()):
+            if ln.startswith("PROBE_PLATFORM="):
+                platform = ln.split("=", 1)[1].strip()
+                break
+        ok = out.returncode == 0 and platform is not None \
+            and platform != "cpu"
+        print(f"[daemon] probe: rc={out.returncode} platform={platform}",
+              flush=True)
+        return ok
+    except subprocess.TimeoutExpired:
+        print("[daemon] probe: WEDGED (timeout)", flush=True)
+        return False
+
+
+def run_captures() -> int:
+    done = 0
+    for artifact, argv, cap in CAPTURES:
+        path = os.path.join(REPO, artifact)
+        if os.path.exists(path):
+            done += 1
+            continue
+        print(f"[daemon] capturing {artifact} ...", flush=True)
+        try:
+            out = subprocess.run(argv, cwd=REPO, timeout=cap,
+                                 capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"[daemon] {artifact}: capture timed out", flush=True)
+            return done
+        line = None
+        for ln in reversed(out.stdout.splitlines()):
+            if ln.startswith("{"):
+                line = ln
+                break
+        if line is None:
+            tail = (out.stderr or "").strip().splitlines()[-3:]
+            print(f"[daemon] {artifact}: no JSON line "
+                  f"(rc={out.returncode}); stderr tail: {tail}", flush=True)
+            return done
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            tail = (out.stderr or "").strip().splitlines()[-3:]
+            print(f"[daemon] {artifact}: malformed JSON line "
+                  f"{line[:120]!r}; stderr tail: {tail}", flush=True)
+            return done
+        # only persist REAL accelerator numbers — a cpu-fallback capture
+        # would overwrite nothing but adds noise
+        if rec.get("platform") not in (None, "cpu", "cpu-fallback"):
+            with open(path, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"[daemon] {artifact}: CAPTURED {rec.get('value')} "
+                  f"{rec.get('unit')}", flush=True)
+            done += 1
+        else:
+            print(f"[daemon] {artifact}: platform="
+                  f"{rec.get('platform')} — not persisting; tunnel "
+                  "presumably degraded again", flush=True)
+            return done
+    return done
+
+
+def main() -> None:
+    max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 8.0
+    deadline = time.time() + max_hours * 3600
+    while time.time() < deadline:
+        if probe():
+            if run_captures() >= len(CAPTURES):
+                print("[daemon] all captures done", flush=True)
+                return
+        time.sleep(PROBE_INTERVAL_S)
+    print("[daemon] deadline reached", flush=True)
+
+
+if __name__ == "__main__":
+    main()

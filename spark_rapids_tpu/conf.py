@@ -477,25 +477,32 @@ class TpuConf:
         return self.settings.get(key, default)
 
     def set(self, key: str, value: Any) -> "TpuConf":
-        if key == ENABLE_INT64_NARROWING.key:
-            from spark_rapids_tpu.columnar.batch import (
-                int64_narrowing_enabled,
-                set_int64_narrowing,
-            )
-            from spark_rapids_tpu.engine import jit_cache
-
-            self.settings[key] = value
-            new = self.get(ENABLE_INT64_NARROWING)
-            if new != int64_narrowing_enabled():
-                set_int64_narrowing(new)
-                # the flag is read at TRACE time, not in any jit-cache
-                # key — drop every compiled kernel so the flip applies
-                # immediately instead of leaving a mix of narrowed and
-                # un-narrowed programs (no-op sets skip the flush)
-                jit_cache.clear()
-            return self
         self.settings[key] = value
+        if key == ENABLE_INT64_NARROWING.key:
+            self.sync_int64_narrowing()
         return self
+
+    def sync_int64_narrowing(self) -> None:
+        """Align the process-wide narrowing flag with THIS conf. The flag
+        is read at kernel TRACE time (no session in scope there), so it is
+        a process global; this sync runs on set() AND at every query start
+        (session.execute_batches), which makes the executing session's
+        conf authoritative even across clone_with copies or multiple
+        sessions — at the price of a kernel-cache flush whenever the
+        effective value flips (no-op syncs cost nothing)."""
+        from spark_rapids_tpu.columnar.batch import (
+            int64_narrowing_enabled,
+            set_int64_narrowing,
+        )
+        from spark_rapids_tpu.engine import jit_cache
+
+        want = self.get(ENABLE_INT64_NARROWING)
+        if want != int64_narrowing_enabled():
+            set_int64_narrowing(want)
+            # the flag is in no jit-cache key — drop compiled kernels so
+            # the flip applies immediately instead of leaving a mix of
+            # narrowed and un-narrowed programs
+            jit_cache.clear()
 
     def is_operator_enabled(self, key: str, incompat: bool, disabled_by_default: bool) -> bool:
         """Per-operator gate logic (reference: RapidsMeta.scala:185-200)."""

@@ -84,9 +84,7 @@ class TpuSession:
             self.conf, budget, self.device_manager.bytes_in_use)
         TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         self.scheduler = TaskScheduler(self.conf.task_threads)
-        from spark_rapids_tpu.columnar.batch import set_int64_narrowing
-
-        set_int64_narrowing(self.conf.get(C.ENABLE_INT64_NARROWING))
+        self.conf.sync_int64_narrowing()
         with TpuSession._lock:
             TpuSession._active = self
 
@@ -161,6 +159,10 @@ class TpuSession:
 
     # -- actions --------------------------------------------------------------
     def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
+        # the executing session's conf drives the process-wide narrowing
+        # flag (conf.sync_int64_narrowing: covers clone_with copies and
+        # interleaved sessions)
+        self.conf.sync_int64_narrowing()
         physical = self._physical_plan(plan)
         ctx = self._exec_context()
         pb = physical.execute(ctx)

@@ -32,6 +32,18 @@ I32_MAX = (1 << 31) - 1
 I32_MIN = -(1 << 31)
 
 
+@pytest.fixture(autouse=True)
+def _narrowing_on():
+    """The process-wide flag follows the LAST executed query's conf
+    (TpuConf.sync_int64_narrowing) — pin it on for these unit tests so an
+    earlier narrowing-off query elsewhere in the session can't leak in."""
+    from spark_rapids_tpu.columnar.batch import set_int64_narrowing
+
+    set_int64_narrowing(True)
+    yield
+    set_int64_narrowing(True)
+
+
 # ---------------------------------------------------------------------------
 # unit: narrow_colv / vrange plumbing
 # ---------------------------------------------------------------------------

@@ -1,0 +1,153 @@
+"""Randomized plan fuzzing: seeded random schemas + random operator
+pipelines, every plan executed on the accelerated engine and the CPU
+oracle and compared row-for-row (SURVEY §4.4's fuzz strategy at PLAN
+granularity — the expression/data fuzzing lives in the per-op suites).
+
+Placement is NOT asserted here (a fuzzed plan may legitimately fall back);
+only results are. Floats compare with ulp tolerance; unordered plans
+compare as sorted multisets.
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import _with_conf, assert_rows_equal
+
+_N_PLANS = 24
+_ROWS = 220
+
+
+def _gen_frame(s, rng, tag):
+    """Random 3-5 column frame; always includes an int64 'k{tag}' key."""
+    n = _ROWS
+    cols = {f"k{tag}": [int(v) for v in rng.integers(0, 15, n)]}
+    schema = [(f"k{tag}", "long")]
+    pool = ["long", "int", "double", "string", "date", "bool",
+            "decimal(9,2)", "long_wide"]
+    for ci in range(int(rng.integers(2, 5))):
+        name = f"c{tag}{ci}"
+        kind = pool[int(rng.integers(0, len(pool)))]
+        nullmask = rng.random(n) < 0.12
+        if kind == "long":
+            vals = [None if m else int(v) for m, v in
+                    zip(nullmask, rng.integers(-5000, 5000, n))]
+            schema.append((name, "long"))
+        elif kind == "long_wide":
+            # values straddling the int32 boundary: the narrowing proof's
+            # adversarial range
+            vals = [None if m else int(v) for m, v in
+                    zip(nullmask, rng.integers(-2**33, 2**33, n))]
+            schema.append((name, "long"))
+        elif kind == "int":
+            vals = [None if m else int(v) for m, v in
+                    zip(nullmask, rng.integers(-100, 100, n))]
+            schema.append((name, "int"))
+        elif kind == "double":
+            vals = [None if m else float(v) for m, v in
+                    zip(nullmask, rng.normal(0, 50, n))]
+            schema.append((name, "double"))
+        elif kind == "string":
+            words = ["", "a", "bb", "héllo", "x,y", "零", "LONG" * 3]
+            vals = [None if m else words[int(v)] for m, v in
+                    zip(nullmask, rng.integers(0, len(words), n))]
+            schema.append((name, "string"))
+        elif kind == "date":
+            # DATE columns take epoch-day ints (10957 = 2000-01-01)
+            vals = [None if m else 10957 + int(v) for m, v in
+                    zip(nullmask, rng.integers(0, 8000, n))]
+            schema.append((name, "date"))
+        elif kind == "bool":
+            vals = [None if m else bool(v) for m, v in
+                    zip(nullmask, rng.integers(0, 2, n))]
+            schema.append((name, "boolean"))
+        else:  # decimal(9,2)
+            vals = [None if m else Decimal(int(v)).scaleb(-2) for m, v in
+                    zip(nullmask, rng.integers(-10**6, 10**6, n))]
+            schema.append((name, "decimal(9,2)"))
+        cols[name] = vals
+    return (s.createDataFrame(cols, schema,
+                              num_partitions=int(rng.integers(1, 4))),
+            schema)
+
+
+def _numeric_cols(schema, kinds=("long", "int")):
+    return [n for n, t in schema if t in kinds]
+
+
+def _build_plan(df, schema, rng):
+    """1-4 random stages; results always compare as multisets (a sort
+    stage exercises ordering kernels, but ties keep final row order
+    nondeterministic between engines)."""
+    n_stages = int(rng.integers(1, 5))
+    for _ in range(n_stages):
+        stage = int(rng.integers(0, 5))
+        ints = _numeric_cols(schema)
+        if stage == 0 and ints:  # filter
+            c = ints[int(rng.integers(0, len(ints)))]
+            thr = int(rng.integers(-3000, 3000))
+            df = df.filter(F.col(c).isNull()
+                           | (F.col(c) > F.lit(thr)))
+        elif stage == 1 and ints:  # arithmetic projection (append col)
+            c = ints[int(rng.integers(0, len(ints)))]
+            op = int(rng.integers(0, 4))
+            e = (F.col(c) + F.lit(7), F.col(c) * F.lit(3),
+                 F.col(c) % F.lit(13), -F.col(c))[op]
+            name = f"p{len(schema)}"
+            df = df.withColumn(name, e)
+            schema = schema + [(name, "long")]
+        elif stage == 2:  # groupBy agg over the key
+            key = schema[0][0]
+            aggs = [F.count("*").alias("cnt")]
+            for c, t in schema[1:]:
+                if t in ("long", "int"):
+                    aggs.append(F.sum(c).alias(f"s_{c}"))
+                    aggs.append(F.max(c).alias(f"mx_{c}"))
+                elif t == "decimal(9,2)":
+                    aggs.append(F.sum(c).alias(f"sd_{c}"))
+                elif t == "double":
+                    aggs.append(F.min(c).alias(f"mn_{c}"))
+            df = df.groupBy(key).agg(*aggs)
+            schema = [(key, "long"), ("cnt", "long")]
+        elif stage == 3:  # sort (multiset compare tolerates tie order)
+            key = schema[0][0]
+            df = df.orderBy(F.col(key).asc(),
+                            *[F.col(n).asc_nulls_last()
+                              for n, _t in schema[1:2]])
+        else:  # distinct-ish projection of the key
+            key = schema[0][0]
+            df = df.groupBy(key).agg(F.count("*").alias("n"))
+            schema = [(key, "long"), ("n", "long")]
+    return df
+
+
+@pytest.mark.parametrize("seed", range(_N_PLANS))
+def test_fuzz_plan_equivalence(session, seed):
+    rng = np.random.default_rng(1000 + seed)
+    df, schema = _gen_frame(session, rng, "a")
+    if rng.random() < 0.35:
+        # join against a second frame on the int64 keys
+        other, oschema = _gen_frame(session, rng, "b")
+        how = ("inner", "left_outer", "left_semi")[int(rng.integers(0, 3))]
+        df = df.join(other, on=(F.col("ka") == F.col("kb")), how=how)
+        if how != "left_semi":
+            schema = schema + oschema
+    df = _build_plan(df, schema, rng)
+
+    restore = _with_conf(session, {"rapids.tpu.sql.enabled": True,
+                                   "rapids.tpu.sql.variableFloatAgg.enabled":
+                                       True})
+    try:
+        got = df.collect()
+    finally:
+        restore()
+    restore = _with_conf(session, {"rapids.tpu.sql.enabled": False})
+    try:
+        want = df.collect()
+    finally:
+        restore()
+    assert_rows_equal(want, got, ignore_order=True, approx_float=1e-9)

@@ -1,0 +1,87 @@
+"""Capacity-bucket boundary sweeps: row counts AT and AROUND the
+power-of-two capacities the padded-batch model buckets to (columnar.batch
+bucket_capacity). Padding bugs live exactly at n == capacity (zero pad
+rows) and n == capacity - 1 / capacity + 1 — every kernel's live-row
+masking, compaction, and group-id padding is exercised at those edges
+through the full engine (filter -> project -> groupBy -> join -> sort)
+against the CPU oracle.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+# around MIN_CAPACITY (8), a middle bucket (64), and a larger one (512)
+EDGES = [1, 7, 8, 9, 63, 64, 65, 511, 512, 513]
+
+
+def _df(s, n, num_partitions=1):
+    rng = np.random.default_rng(n)
+    return s.createDataFrame(
+        {"k": [int(v) for v in rng.integers(0, max(2, n // 3), n)],
+         "v": [int(v) for v in rng.integers(-1000, 1000, n)],
+         "t": [f"s{v}" for v in rng.integers(0, 5, n)]},
+        [("k", "long"), ("v", "long"), ("t", "string")],
+        num_partitions=num_partitions)
+
+
+@pytest.mark.parametrize("n", EDGES)
+def test_agg_at_bucket_edge(session, n):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _df(s, n).groupBy("k").agg(
+            F.sum("v").alias("sv"), F.count("*").alias("c"),
+            F.min("t").alias("mt")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("n", [7, 8, 9, 64, 513])
+def test_filter_keeps_exact_bucket(session, n):
+    # a filter that keeps EVERY row (compaction at full capacity) and one
+    # that keeps nothing (empty-batch propagation)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _df(s, n).filter(F.col("v") > F.lit(-10_000))
+        .withColumn("w", F.col("v") * F.lit(2)),
+        ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _df(s, n).filter(F.col("v") > F.lit(10_000))
+        .groupBy("k").agg(F.count("*").alias("c")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("n", [8, 9, 64, 65])
+def test_join_at_bucket_edge(session, n):
+    def q(s):
+        a = _df(s, n).withColumnRenamed("v", "va")
+        b = _df(s, max(1, n - 1), num_partitions=2) \
+            .select(F.col("k").alias("kb"), F.col("v").alias("vb"))
+        return (a.join(b, on=(F.col("k") == F.col("kb")), how="inner")
+                .groupBy("k").agg(F.sum("vb").alias("s"),
+                                  F.count("*").alias("c")))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+@pytest.mark.parametrize("n", [8, 9, 512, 513])
+def test_sort_limit_at_bucket_edge(session, n):
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _df(s, n).orderBy(F.col("v").asc(), F.col("k").asc(),
+                                    F.col("t").asc())
+        .limit(n))  # limit == exact row count: the off-by-one magnet
+
+
+@pytest.mark.parametrize("n", [8, 64])
+def test_multi_partition_uneven_buckets(session, n):
+    # partitions of different bucket sizes concatenating through an
+    # exchange (repad/concat across capacities)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: _df(s, n * 3 + 1, num_partitions=3)
+        .groupBy("k").agg(F.sum("v").alias("s")),
+        ignore_order=True)

@@ -23,10 +23,14 @@ _ROWS = 220
 
 
 def _gen_frame(s, rng, tag):
-    """Random 3-5 column frame; always includes an int64 'k{tag}' key."""
+    """Random 4-6 column frame; always includes an int64 'k{tag}' key
+    and a unique 'u{tag}' row id."""
     n = _ROWS
-    cols = {f"k{tag}": [int(v) for v in rng.integers(0, 15, n)]}
-    schema = [(f"k{tag}", "long")]
+    # u{tag} is a UNIQUE row id: window stages order by it so
+    # tie-sensitive functions (row_number/lag) are deterministic
+    cols = {f"k{tag}": [int(v) for v in rng.integers(0, 15, n)],
+            f"u{tag}": [int(v) for v in rng.permutation(n)]}
+    schema = [(f"k{tag}", "long"), (f"u{tag}", "long")]
     pool = ["long", "int", "double", "string", "date", "bool",
             "decimal(9,2)", "long_wide"]
     for ci in range(int(rng.integers(2, 5))):
@@ -79,13 +83,15 @@ def _numeric_cols(schema, kinds=("long", "int")):
     return [n for n, t in schema if t in kinds]
 
 
-def _build_plan(df, schema, rng):
+def _build_plan(df, schema, rng, uniq=None):
     """1-4 random stages; results always compare as multisets (a sort
     stage exercises ordering kernels, but ties keep final row order
-    nondeterministic between engines)."""
+    nondeterministic between engines). `uniq` names a still-unique row-id
+    column (None after joins, whose multiplicities break uniqueness) —
+    tie-sensitive window functions only run while it exists."""
     n_stages = int(rng.integers(1, 5))
     for _ in range(n_stages):
-        stage = int(rng.integers(0, 5))
+        stage = int(rng.integers(0, 6))
         ints = _numeric_cols(schema)
         if stage == 0 and ints:  # filter
             c = ints[int(rng.integers(0, len(ints)))]
@@ -118,6 +124,20 @@ def _build_plan(df, schema, rng):
             df = df.orderBy(F.col(key).asc(),
                             *[F.col(n).asc_nulls_last()
                               for n, _t in schema[1:2]])
+        elif stage == 4 and ints and uniq is not None and \
+                any(n == uniq for n, _t in schema):  # window
+            from spark_rapids_tpu.plan.window_api import Window
+
+            key = schema[0][0]
+            c = ints[int(rng.integers(0, len(ints)))]
+            # unique order key: row_number/lag are tie-sensitive
+            w = Window.partitionBy(key).orderBy(F.col(uniq).asc())
+            fn = int(rng.integers(0, 3))
+            e = (F.row_number().over(w), F.sum(c).over(w),
+                 F.lag(F.col(c), 1).over(w))[fn]
+            name = f"w{len(schema)}"
+            df = df.withColumn(name, e)
+            schema = schema + [(name, "long")]
         else:  # distinct-ish projection of the key
             key = schema[0][0]
             df = df.groupBy(key).agg(F.count("*").alias("n"))
@@ -129,6 +149,7 @@ def _build_plan(df, schema, rng):
 def test_fuzz_plan_equivalence(session, seed):
     rng = np.random.default_rng(1000 + seed)
     df, schema = _gen_frame(session, rng, "a")
+    uniq = "ua"
     if rng.random() < 0.35:
         # join against a second frame on the int64 keys
         other, oschema = _gen_frame(session, rng, "b")
@@ -136,7 +157,11 @@ def test_fuzz_plan_equivalence(session, seed):
         df = df.join(other, on=(F.col("ka") == F.col("kb")), how=how)
         if how != "left_semi":
             schema = schema + oschema
-    df = _build_plan(df, schema, rng)
+            # inner/outer multiplicities break row-id uniqueness;
+            # left_semi keeps each left row at most once, so 'ua' stays a
+            # valid window order key
+            uniq = None
+    df = _build_plan(df, schema, rng, uniq=uniq)
 
     restore = _with_conf(session, {"rapids.tpu.sql.enabled": True,
                                    "rapids.tpu.sql.variableFloatAgg.enabled":

@@ -31,6 +31,30 @@ CPU_ITERS = 2
 
 TPU_BUDGET_S = int(os.environ.get("SRT_BENCH_TPU_BUDGET_S", "780"))
 CPU_BUDGET_S = int(os.environ.get("SRT_BENCH_CPU_BUDGET_S", "240"))
+QUERY_CAP_DEFAULT_S = 300  # per-query skip cap (suite workers)
+
+
+def _suite_query_count(suite: str) -> int:
+    """Number of queries in a suite, WITHOUT importing the module (the
+    supervisor never imports jax — a broken accelerator stack must only be
+    able to kill a bounded phase subprocess): parse the module source and
+    count the QUERIES dict literal's keys."""
+    import ast
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "spark_rapids_tpu", "benchmarks", f"{suite}.py")
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # QUERIES: Dict[...] = {...}
+            targets = [node.target]
+        if targets and any(getattr(t, "id", None) == "QUERIES"
+                           for t in targets) and \
+                isinstance(node.value, ast.Dict):
+            return len(node.value.keys)
+    raise RuntimeError(f"no QUERIES dict literal found in {path}")
 
 
 # ---------------------------------------------------------------- workers
@@ -289,7 +313,8 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
     # between Python bytecodes, so it cannot interrupt ONE long blocking
     # C/XLA call (a hard tunnel wedge); the phase-level subprocess timeout
     # in the supervisor remains the backstop for that case.
-    q_cap_s = float(os.environ.get("SRT_BENCH_QUERY_CAP_S", "300"))
+    q_cap_s = float(os.environ.get("SRT_BENCH_QUERY_CAP_S",
+                                   str(QUERY_CAP_DEFAULT_S)))
 
     class _QueryTimeout(Exception):
         pass
@@ -500,19 +525,12 @@ def main_suite(suite: str, sf: float) -> None:
     """Suite mode: `python bench.py --tpch|--tpcxbb [sf]`. Prints geomean
     wall-clock + speedup vs the CPU oracle."""
     env_extra = {"SRT_TPCH_SF": str(sf)}
-    # phase budgets scale with suite size: a 30-query suite needs compile +
-    # warmup + 2 timed iterations PER query (the accelerated CPU-mesh
-    # fallback is compile-dominated), so a fixed budget starves wide suites
-    import importlib
-
-    n_queries = len(importlib.import_module(
-        f"spark_rapids_tpu.benchmarks.{suite}").QUERIES)
     # ~3 runs/query (warmup + 2 timed) + first-compile; heavy shapes (the
     # mortgage 12x-explode ETL) measured >100 s/iteration at sf 0.02 on a
-    # contended host, so budget generously — a too-small budget zeroes the
-    # whole artifact, a too-large one costs nothing when queries are fast.
-    # Operator-set SRT_BENCH_*_BUDGET_S stays authoritative (a bounded CI
-    # job must stay bounded): the per-query floor applies only to defaults.
+    # contended host, so default budgets scale per query — a too-small
+    # budget zeroes the whole artifact. Operator-set SRT_BENCH_*_BUDGET_S
+    # stays authoritative (a bounded CI job must stay bounded).
+    n_queries = _suite_query_count(suite)
     if "SRT_BENCH_CPU_BUDGET_S" in os.environ:
         cpu_budget = CPU_BUDGET_S * 2
     else:
@@ -521,18 +539,21 @@ def main_suite(suite: str, sf: float) -> None:
         tpu_budget = TPU_BUDGET_S
     else:
         tpu_budget = max(TPU_BUDGET_S, 90 * n_queries)
-    # the worker's per-query skip cap must FIT the phase budget, or the
-    # phase timeout kills the whole run before skips can salvage a partial
-    # artifact; shrink it when needed (never grow an operator-set cap)
-    fit_cap = max(60, min(cpu_budget, tpu_budget) // max(n_queries // 3, 1))
-    cur_cap = float(os.environ.get("SRT_BENCH_QUERY_CAP_S", "300"))
-    env_extra["SRT_BENCH_QUERY_CAP_S"] = str(int(min(cur_cap, fit_cap)))
+    if "SRT_BENCH_QUERY_CAP_S" not in os.environ:
+        # the skip cap must FIT the phase budget (worst case every query
+        # wedges to the cap: n_queries * cap <= budget) or the phase
+        # timeout zeroes the artifact before skips can salvage a partial
+        # geomean. An operator-set cap is trusted as-is — whoever sizes
+        # the cap sizes the budget (tools/tpu_capture_daemon.py does).
+        fit_cap = max(60, min(cpu_budget, tpu_budget) // n_queries)
+        env_extra["SRT_BENCH_QUERY_CAP_S"] = \
+            str(int(min(QUERY_CAP_DEFAULT_S, fit_cap)))
     cpu_env = _scrubbed_cpu_env()
     cpu_env.update(env_extra)
     cpu = _run_phase(f"{suite}-cpu", cpu_env, cpu_budget)
     acc, _probes = _run_accel_phase(f"{suite}-tpu", tpu_budget, env_extra)
     platform = acc["platform"] if acc else None
-    if acc is None:
+    if acc is None and os.environ.get("SRT_BENCH_NO_FALLBACK") != "1":
         # same honest fallback as main(): accelerated engine on CPU backend
         acc = _run_phase(f"{suite}-tpu", cpu_env, cpu_budget * 2)
         platform = "cpu-fallback" if acc else None

@@ -22,7 +22,9 @@ reductions/hashes over the padded tail are deterministic.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,6 +72,7 @@ def physical_np_dtype(dt: DataType) -> np.dtype:
 # possible; it is attached at host->device build time (and from parquet
 # footer statistics) and propagated through filters/gathers/projections.
 _NARROW_I64 = True
+_NARROW_PIN = threading.local()
 I32_MIN, I32_MAX = -(1 << 31), (1 << 31) - 1
 
 
@@ -79,7 +82,24 @@ def set_int64_narrowing(enabled: bool) -> None:
 
 
 def int64_narrowing_enabled() -> bool:
-    return _NARROW_I64
+    # a per-thread pin (set by the jit-cache while invoking a cached kernel)
+    # outranks the process global: the flag is re-read at TRACE time, which
+    # happens on a cached callable's FIRST call — without the pin a
+    # concurrent conf flip between key lookup and first trace would cache a
+    # wrong-flavor program under the salted key forever
+    pinned = getattr(_NARROW_PIN, "v", None)
+    return _NARROW_I64 if pinned is None else pinned
+
+
+@contextlib.contextmanager
+def pin_int64_narrowing(value: bool):
+    """Pin the narrowing flag for the current thread (nestable)."""
+    prev = getattr(_NARROW_PIN, "v", None)
+    _NARROW_PIN.v = bool(value)
+    try:
+        yield
+    finally:
+        _NARROW_PIN.v = prev
 
 
 def fits_int32(vrange) -> bool:
@@ -786,34 +806,70 @@ def _concat_fixed_cols(cap: int, datas, valids, nrows_arr):
     return out
 
 
+def _concat_string_kernel(cap, byte_cap, datas, offsets_list, valids,
+                          nrows_arr, bytes_arr):
+    """Fused string-column concat dispatcher: routed through the LRU-bounded
+    process jit cache (NOT a module-level @jax.jit) because the key space —
+    piece count x piece shape buckets x cap x byte_cap — grows without limit
+    on a long-running stream; LRU eviction drops cold executables."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    key = ("concat_string", cap, byte_cap,
+           tuple(d.shape[0] for d in datas),
+           tuple(o.shape[0] for o in offsets_list))
+    fn = get_or_build(key, lambda: jax.jit(
+        _concat_string_traced, static_argnums=(0, 1)))
+    return fn(cap, byte_cap, datas, offsets_list, valids, nrows_arr,
+              bytes_arr)
+
+
+def _concat_string_traced(cap: int, byte_cap: int, datas, offsets_list,
+                          valids, nrows_arr, bytes_arr):
+    """Fused string-column concat: every piece's bytes/offsets/validity
+    scatter in ONE compiled program (the eager version cost ~20 dispatches
+    per piece and dominated suite-scale profiles)."""
+    row_offs = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(nrows_arr, dtype=jnp.int32)])
+    byte_offs = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(bytes_arr, dtype=jnp.int32)])
+    out_data = jnp.zeros((byte_cap,), dtype=jnp.uint8)
+    out_offsets = jnp.zeros((cap + 1,), dtype=jnp.int32)
+    out_valid = jnp.zeros((cap,), dtype=bool)
+    for i, (d, offs, v) in enumerate(zip(datas, offsets_list, valids)):
+        bidx = jnp.arange(d.shape[0])
+        bmask = bidx < bytes_arr[i]
+        out_data = out_data.at[
+            jnp.where(bmask, bidx + byte_offs[i], byte_cap)].set(
+            d, mode="drop")
+        k = offs.shape[0] - 1
+        ridx = jnp.arange(k)
+        rmask = ridx < nrows_arr[i]
+        out_offsets = out_offsets.at[
+            jnp.where(rmask, ridx + row_offs[i], cap + 1)
+        ].set(offs[:k] + byte_offs[i], mode="drop")
+        out_valid = out_valid.at[
+            jnp.where(rmask, ridx + row_offs[i], cap)].set(
+            v[:k], mode="drop")
+    # tail offsets (rows >= total) all point at the end of the data
+    pos = jnp.arange(cap + 1, dtype=jnp.int32)
+    out_offsets = jnp.where(pos >= row_offs[-1], byte_offs[-1], out_offsets)
+    return out_data, out_offsets, out_valid
+
+
 def _concat_string_cols(cols: List[ColumnVector], nrows: List[int], cap: int) -> ColumnVector:
-    # Host-coordinated string concat: compute byte sizes, then fuse
-    # device-side. ONE transfer for all piece sizes, not one sync per piece.
+    # Host-coordinated string concat: compute byte sizes (ONE transfer for
+    # all piece sizes — byte_cap must be static), then fuse device-side.
     byte_sizes = [int(x) for x in jax.device_get(
         [c.offsets[n] for c, n in zip(cols, nrows)])]
     total_bytes = sum(byte_sizes)
     byte_cap = bucket_capacity(max(total_bytes, 1))
-    out_data = jnp.zeros((byte_cap,), dtype=jnp.uint8)
-    out_offsets = jnp.zeros((cap + 1,), dtype=jnp.int32)
-    out_valid = jnp.zeros((cap,), dtype=bool)
-    row_off = 0
-    byte_off = 0
-    for c, n, bs in zip(cols, nrows, byte_sizes):
-        k = c.capacity
-        bidx = jnp.arange(c.data.shape[0])
-        bmask = bidx < bs
-        out_data = out_data.at[jnp.where(bmask, bidx + byte_off, byte_cap)].set(
-            c.data, mode="drop")
-        ridx = jnp.arange(k)
-        rmask = ridx < n
-        out_offsets = out_offsets.at[
-            jnp.where(rmask, ridx + row_off, cap + 1)
-        ].set(c.offsets[:k] + byte_off, mode="drop")
-        out_valid = out_valid.at[jnp.where(rmask, ridx + row_off, cap)].set(
-            c.validity[:k], mode="drop")
-        row_off += n
-        byte_off += bs
-    out_offsets = out_offsets.at[row_off:].set(byte_off)
+    out_data, out_offsets, out_valid = _concat_string_kernel(
+        cap, byte_cap,
+        tuple(c.data for c in cols),
+        tuple(c.offsets for c in cols),
+        tuple(c.validity for c in cols),
+        jnp.asarray(nrows, dtype=jnp.int32),
+        jnp.asarray(byte_sizes, dtype=jnp.int32))
     return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets)
 
 
@@ -847,7 +903,6 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     cap = bucket_capacity(max(out_rows, 1))
     idx = indices[:cap]
     sel_mask = jnp.arange(cap) < out_rows
-    in_bounds_s = None
     fixed = [(i, cv) for i, cv in enumerate(batch.columns)
              if cv.dtype is not DataType.STRING]
     cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
@@ -861,13 +916,26 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
             # so the source range bound still holds
             cols[i] = ColumnVector(cv.dtype, data, validity,
                                    vrange=cv.vrange)
-    for i, cv in enumerate(batch.columns):
-        if cv.dtype is DataType.STRING:
-            if in_bounds_s is None:
-                in_bounds_s = sel_mask & (idx >= 0) & (idx < batch.capacity)
-                if indices_valid is not None:
-                    in_bounds_s = in_bounds_s & indices_valid[:cap]
-            cols[i] = _gather_string(cv, idx, in_bounds_s, sel_mask)
+    sidx = [i for i, cv in enumerate(batch.columns)
+            if cv.dtype is DataType.STRING]
+    if sidx:
+        in_bounds_s = sel_mask & (idx >= 0) & (idx < batch.capacity)
+        if indices_valid is not None:
+            in_bounds_s = in_bounds_s & indices_valid[:cap]
+        # plan every string column first so the byte totals come back in a
+        # single host transfer (one sync per gather, not one per column)
+        plans = [_gather_string_plan(batch.columns[i].offsets,
+                                     batch.columns[i].validity,
+                                     idx, in_bounds_s, sel_mask)
+                 for i in sidx]
+        totals = jax.device_get([p[2][-1] for p in plans])
+        for i, (starts, lengths, new_offsets, validity), total in zip(
+                sidx, plans, totals):
+            byte_cap = bucket_capacity(max(int(total), 1))
+            out = _gather_string_bytes(batch.columns[i].data, starts,
+                                       new_offsets, lengths, byte_cap)
+            cols[i] = ColumnVector(DataType.STRING, out, validity,
+                                   new_offsets)
     return ColumnarBatch(cols, out_rows)
 
 
@@ -885,15 +953,6 @@ def _gather_string_plan(offsets, validity, idx, in_bounds, sel_mask):
     ])
     out_valid = jnp.where(in_bounds, validity[safe_idx], False) & sel_mask
     return starts, lengths, new_offsets, out_valid
-
-
-def _gather_string(cv: ColumnVector, idx, in_bounds, sel_mask) -> ColumnVector:
-    starts, lengths, new_offsets, validity = _gather_string_plan(
-        cv.offsets, cv.validity, idx, in_bounds, sel_mask)
-    total = int(jax.device_get(new_offsets[-1]))
-    byte_cap = bucket_capacity(max(total, 1))
-    out = _gather_string_bytes(cv.data, starts, new_offsets, lengths, byte_cap)
-    return ColumnVector(DataType.STRING, out, validity, new_offsets)
 
 
 @functools.partial(jax.jit, static_argnums=(4,))

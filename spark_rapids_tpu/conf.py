@@ -268,12 +268,13 @@ PARQUET_DEVICE_ENCODE = _conf(
 CSV_READ_ENABLED = _conf("rapids.tpu.sql.format.csv.read.enabled").boolean(True)
 CSV_DEVICE_PARSE = _conf(
     "rapids.tpu.sql.format.csv.deviceParse.enabled").doc(
-    "Parse eligible CSV integral columns ON the device: the host finds "
-    "field boundaries in one vectorized pass, raw bytes + offsets upload "
-    "once, and a jitted kernel folds digits into values (reference parses "
-    "CSV on the accelerator the same way, GpuBatchScanExec.scala:474-502). "
-    "Quoted/ragged files and non-integral columns fall back to the host "
-    "Arrow parser."
+    "Parse eligible CSV columns ON the device: the host finds field "
+    "boundaries in one vectorized pass (quote-aware), raw bytes + offsets "
+    "upload once, and jitted kernels fold the values — integers, floats, "
+    "strings, dates, and zoned timestamps, including quoted fields "
+    "(reference parses CSV on the accelerator the same way, "
+    "GpuBatchScanExec.scala:474-502). Ragged files and fields using "
+    "escaped \"\" quotes fall back to the host Arrow parser."
 ).boolean(True)
 CSV_DEVICE_MAX_SPLIT_BYTES = _conf(
     "rapids.tpu.sql.format.csv.deviceParse.maxSplitBytes").doc(
@@ -287,13 +288,15 @@ CSV_DEVICE_MAX_SPLIT_BYTES = _conf(
 ORC_READ_ENABLED = _conf("rapids.tpu.sql.format.orc.read.enabled").boolean(True)
 ORC_DEVICE_DECODE = _conf(
     "rapids.tpu.sql.format.orc.deviceDecode.enabled").doc(
-    "Decode eligible ORC integer columns ON the device: the host walks the "
-    "protobuf metadata and RLEv2/byte-RLE run headers, raw stripe bytes "
-    "upload once, and jitted kernels expand the runs (big-endian "
-    "bit-unpack, segmented delta prefix-sum, PRESENT bit extraction) — "
-    "the reference decodes ORC on the accelerator the same way "
-    "(GpuOrcScan.scala:284,709). Compressed files, PATCHED_BASE runs, and "
-    "non-integer columns fall back to the host Arrow reader."
+    "Decode eligible ORC columns ON the device: the host walks the "
+    "protobuf metadata and RLEv2/byte-RLE run headers (all four RLEv2 "
+    "sub-encodings incl. PATCHED_BASE, widths <= 56 bits), raw stripe "
+    "bytes upload once (zlib/snappy blocks host-decompressed first), and "
+    "jitted kernels expand the runs — integers, strings (DIRECT_V2 + "
+    "DICTIONARY_V2), floats, timestamps, and booleans — the reference "
+    "decodes ORC on the accelerator the same way (GpuOrcScan.scala:"
+    "284,709). Other codecs (zstd/lz4) and nested types fall back to the "
+    "host Arrow reader."
 ).boolean(True)
 ORC_WRITE_ENABLED = _conf("rapids.tpu.sql.format.orc.write.enabled").boolean(True)
 ORC_DEVICE_ENCODE = _conf(
@@ -488,21 +491,20 @@ class TpuConf:
         a process global; this sync runs on set() AND at every query start
         (session.execute_batches), which makes the executing session's
         conf authoritative even across clone_with copies or multiple
-        sessions — at the price of a kernel-cache flush whenever the
-        effective value flips (no-op syncs cost nothing)."""
+        sessions; the flag also salts every jit-cache key, so sessions
+        with different settings select different compiled programs rather
+        than flushing each other's."""
         from spark_rapids_tpu.columnar.batch import (
             int64_narrowing_enabled,
             set_int64_narrowing,
         )
-        from spark_rapids_tpu.engine import jit_cache
 
         want = self.get(ENABLE_INT64_NARROWING)
         if want != int64_narrowing_enabled():
+            # the flag salts every jit-cache key (engine/jit_cache._key_salt)
+            # so both flavors of compiled kernels coexist; flipping selects,
+            # never invalidates
             set_int64_narrowing(want)
-            # the flag is in no jit-cache key — drop compiled kernels so
-            # the flip applies immediately instead of leaving a mix of
-            # narrowed and un-narrowed programs
-            jit_cache.clear()
 
     def is_operator_enabled(self, key: str, incompat: bool, disabled_by_default: bool) -> bool:
         """Per-operator gate logic (reference: RapidsMeta.scala:185-200)."""

@@ -23,13 +23,47 @@ _MAX_ENTRIES = 512
 _CACHE: "collections.OrderedDict[Hashable, Any]" = collections.OrderedDict()
 
 
+def _key_salt() -> tuple:
+    """Process-wide flags that are read at kernel TRACE time (no session in
+    scope there) become part of every cache key, so flipping a flag selects
+    a different compiled program instead of invalidating all of them — two
+    sessions with different settings can interleave without thrashing."""
+    from spark_rapids_tpu.columnar.batch import int64_narrowing_enabled
+
+    return (int64_narrowing_enabled(),)
+
+
+class _SaltPinnedKernel:
+    """Pins the salt's flag values for the calling thread around every
+    invocation of a cached kernel. jax traces on the FIRST CALL, not at
+    build time — without the pin, a concurrent conf flip between key
+    lookup and first trace would permanently cache a wrong-flavor program
+    under the salted key."""
+
+    __slots__ = ("_fn", "_narrowing")
+
+    def __init__(self, fn, salt):
+        self._fn = fn
+        self._narrowing = salt[0]
+
+    def __call__(self, *args, **kwargs):
+        from spark_rapids_tpu.columnar.batch import pin_int64_narrowing
+
+        with pin_int64_narrowing(self._narrowing):
+            return self._fn(*args, **kwargs)
+
+
 def get_or_build(key: Hashable, builder: Callable[[], Any]) -> Any:
+    salt = _key_salt()
+    key = (key, salt)
     with _LOCK:
         got = _CACHE.get(key)
         if got is not None:
             _CACHE.move_to_end(key)
             return got
     built = builder()
+    if callable(built):
+        built = _SaltPinnedKernel(built, salt)
     with _LOCK:
         got = _CACHE.setdefault(key, built)
         _CACHE.move_to_end(key)
@@ -43,6 +77,6 @@ def clear() -> None:
         _CACHE.clear()
 
 
-def stats() -> Dict[str, int]:
+def stats() -> dict:
     with _LOCK:
         return {"entries": len(_CACHE)}

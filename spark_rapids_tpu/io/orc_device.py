@@ -281,6 +281,12 @@ def parse_file_meta(raw: bytes) -> OrcMeta:
         if cid < len(names):
             names[cid] = fname
     meta.names = names
+    # col_stats is built positionally from field-7 occurrences and indexed
+    # by column id downstream; a file with missing/extra ColumnStatistics
+    # entries would silently attribute one column's range to another (and
+    # wrap narrowed values). On any count mismatch drop the stats entirely.
+    if len(meta.col_stats) != len(meta.kinds):
+        meta.col_stats = []
     return meta
 
 

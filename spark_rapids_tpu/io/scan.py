@@ -77,10 +77,67 @@ def _orc_stats_vrange(attr, meta) -> Optional[Tuple[int, int]]:
     try:
         cid = meta.names.index(attr.name)
         if 0 <= cid < len(meta.col_stats):
-            return quantize_vrange(meta.col_stats[cid])
+            st = meta.col_stats[cid]
+            if (isinstance(st, tuple) and len(st) == 2
+                    and all(isinstance(x, int) for x in st)):
+                return quantize_vrange(st)
     except (ValueError, AttributeError):
         pass
     return None
+
+
+def _minmax_valid(data, validity):
+    """(any_valid, min, max) over valid lanes — jitted via the process cache
+    so every int64 column shares one compiled reduction per shape bucket."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        def fn(d, v):
+            lo = jnp.min(jnp.where(v, d, jnp.iinfo(d.dtype).max))
+            hi = jnp.max(jnp.where(v, d, jnp.iinfo(d.dtype).min))
+            return jnp.any(v), lo, hi
+        return jax.jit(fn)
+
+    return get_or_build(("scan_minmax_valid",), build)(data, validity)
+
+
+def verify_footer_vranges(dev_cols: Dict[str, "ColumnVector"]) -> List[str]:
+    """Check footer-statistics-derived value ranges against the decoded
+    data before any consumer narrows on them. Writers have shipped corrupt
+    min/max stats (parquet-mr carries CorruptStatistics heuristics for
+    exactly this); unlike row-group pruning — where a bad stat only loses
+    pruning — a bad range here would silently WRAP int32-narrowed values.
+    One batched reduction + one host transfer covers every claimed column
+    of the row group/stripe; a violated claim drops the vrange (the file
+    loses the optimization, never correctness). Returns the dropped column
+    names so a FILE-level claim source (ORC) can stop re-claiming it for
+    every subsequent stripe."""
+    import jax
+
+    claimed = [(name, cv) for name, cv in dev_cols.items()
+               if cv.vrange is not None and cv.dtype is DataType.INT64]
+    if not claimed:
+        return []
+    reds = [_minmax_valid(cv.data, cv.validity) for _, cv in claimed]
+    vals = jax.device_get(reds)
+    dropped: List[str] = []
+    for (name, cv), (any_valid, mn, mx) in zip(claimed, vals):
+        if not bool(any_valid):
+            continue
+        lo, hi = cv.vrange
+        if int(mn) < lo or int(mx) > hi:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "column %r: footer min/max stats (%d, %d) contradict the "
+                "decoded data (%d, %d) — corrupt statistics; dropping the "
+                "narrowing range", name, lo, hi, int(mn), int(mx))
+            cv.vrange = None
+            dropped.append(name)
+    return dropped
 
 
 def _pq_stats_vrange(dt: DataType, col_meta) -> Optional[Tuple[int, int]]:
@@ -626,6 +683,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     dev_cols[a.name] = ColumnVector(
                         a.data_type, d, v,
                         vrange=_orc_stats_vrange(a, meta))
+            # ORC stats are FILE-level: a claim one stripe disproves must
+            # not be re-claimed (re-reduced, re-warned) by later stripes
+            for name in verify_footer_vranges(dev_cols):
+                cid = meta.names.index(name)
+                if 0 <= cid < len(meta.col_stats):
+                    meta.col_stats[cid] = None
             hb = None
             if rest:
                 import pyarrow.orc as po
@@ -735,6 +798,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 # pass (columnar.batch.host_value_range) can't see them; the
                 # writer's chunk stats carry the same proof for free
                 dev_cols[a.name].vrange = _pq_stats_vrange(a.data_type, col)
+            verify_footer_vranges(dev_cols)
             hb = None
             if rest or pv:
                 sub = FileSplit(split.path, "parquet", (rg,), split.options,

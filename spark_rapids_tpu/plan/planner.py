@@ -224,6 +224,14 @@ def _plan_aggregate(plan: L.Aggregate, conf: C.TpuConf) -> PhysicalExec:
                 _key_exprs_for(plan.grouping, plan.agg_exprs),
                 conf.shuffle_partitions)
         else:
+            # KNOWN SCALE LIMIT: a global (ungrouped) holistic percentile
+            # routes the ENTIRE input through one partition and one device
+            # batch (SinglePartitioning + RequireSingleBatch). Correct —
+            # the unmergeable op fails loudly if violated — but a cliff at
+            # large SF; grouped percentiles scale normally. A two-level
+            # scheme (per-partition sorted runs merged on the driver)
+            # is the upgrade path if a workload needs a global percentile
+            # over more rows than one batch holds.
             part = SinglePartitioning()
         exchange = CpuShuffleExchangeExec(part, child)
         return CpuHashAggregateExec(plan.grouping, plan.agg_exprs, COMPLETE,

@@ -121,10 +121,11 @@ def test_serde_roundtrip_recovers_vrange():
     assert dev.columns[0].vrange == (-4, 127)
 
 
-def test_conf_flip_clears_kernels_and_applies():
-    """Flipping rapids.tpu.sql.int64.narrowing.enabled mid-session must
-    flush compiled kernels (the flag is read at trace time, not in cache
-    keys) — and a no-op set must NOT flush."""
+def test_conf_flip_selects_kernel_flavor():
+    """The narrowing flag is read at kernel TRACE time, so it salts every
+    jit-cache key: flipping rapids.tpu.sql.int64.narrowing.enabled selects
+    a different compiled program WITHOUT flushing the other flavor — two
+    sessions with different settings can interleave without thrashing."""
     import spark_rapids_tpu as srt
     from spark_rapids_tpu.columnar.batch import int64_narrowing_enabled
     from spark_rapids_tpu.engine import jit_cache
@@ -132,16 +133,20 @@ def test_conf_flip_clears_kernels_and_applies():
     s = srt.new_session()
     try:
         assert int64_narrowing_enabled()
-        jit_cache.get_or_build(("probe", 1), lambda: object())
+        on = jit_cache.get_or_build(("probe", 1), lambda: object())
+        assert jit_cache.get_or_build(("probe", 1), lambda: object()) is on
         before = jit_cache.stats()["entries"]
-        assert before >= 1
         s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", True)  # no-op
         assert jit_cache.stats()["entries"] == before
         s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", False)
         assert not int64_narrowing_enabled()
-        assert jit_cache.stats()["entries"] == 0
+        # same logical key now resolves to the narrowing-off flavor...
+        off = jit_cache.get_or_build(("probe", 1), lambda: object())
+        assert off is not on
+        # ...and the narrowing-on flavor survived the flip
         s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", True)
         assert int64_narrowing_enabled()
+        assert jit_cache.get_or_build(("probe", 1), lambda: object()) is on
     finally:
         s.conf.set("rapids.tpu.sql.int64.narrowing.enabled", True)
         s.stop()
@@ -490,3 +495,29 @@ class TestParquetStatsVrange:
             lambda s: s.read.parquet(path).select(
                 (F.col("a") + F.lit(1)).alias("a1")),
             ignore_order=True)
+
+
+def test_footer_vrange_verification_drops_corrupt_stats():
+    """ADVICE r3: footer min/max stats are a value-correctness proof for
+    narrowing, and writers have shipped corrupt stats. verify_footer_vranges
+    must drop a claim the decoded data contradicts (losing the optimization,
+    never correctness) and keep a claim the data satisfies."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnVector
+    from spark_rapids_tpu.columnar.dtypes import DataType
+    from spark_rapids_tpu.io.scan import verify_footer_vranges
+
+    data = jnp.asarray([100, -3, 77_000, 0], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, True, False])
+    honest = ColumnVector(DataType.INT64, data, valid, vrange=(-4, 131071))
+    # claims (-4, 127) but the data holds 77_000 in a valid lane
+    corrupt = ColumnVector(DataType.INT64, data, valid, vrange=(-4, 127))
+    # claim on a fully-null column is unverifiable -> kept
+    allnull = ColumnVector(DataType.INT64, data,
+                           jnp.zeros((4,), bool), vrange=(0, 1))
+    cols = {"h": honest, "c": corrupt, "n": allnull}
+    verify_footer_vranges(cols)
+    assert cols["h"].vrange == (-4, 131071)
+    assert cols["c"].vrange is None
+    assert cols["n"].vrange == (0, 1)

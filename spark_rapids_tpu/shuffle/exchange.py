@@ -362,6 +362,114 @@ class _InvertedStr:
         return other.s <= self.s
 
 
+# ---------------------------------------------------------------------------
+# Vectorized composite range keys
+# ---------------------------------------------------------------------------
+# Every sort key reduces to LEVELS whose unsigned elementwise comparison,
+# taken lexicographically, equals the SQL composite order: a null-rank level
+# (0/1/2 per nulls_first) and a value level (order bits as uint64 with the
+# sign bit flipped; descending keys complement the word, so every level is
+# plain ascending uint64). Packing all levels big-endian into one bytes
+# column makes numpy's 'S' comparison THE composite comparator — bounds and
+# per-row bucket ids come from vectorized sort/searchsorted instead of a
+# per-row python bisect loop (which dominated global-sort exchanges at SF1).
+
+
+def _fixed_key_levels_np(ob: np.ndarray, nf: np.ndarray, order: SortOrder):
+    """(null_rank u8[rows], value u64[rows]) for one fixed-width key from
+    downloaded order bits + null flags."""
+    null_rank = np.where(nf, np.uint8(0 if order.nulls_first else 2),
+                         np.uint8(1))
+    u = ob.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+    if not order.ascending:
+        u = ~u
+    u = np.where(nf, np.uint64(0), u)
+    return null_rank, u
+
+
+def _string_key_levels_np(values: List, order: SortOrder, width: int):
+    """(null_rank u8[rows], bytes u8[rows, width]) for one string key.
+    numpy 'S' arrays zero-pad, so ascending compares bytewise like SQL;
+    descending complements (pad becomes 0xFF, reversing the order)."""
+    bs = [b"" if v is None else v.encode("utf-8") for v in values]
+    width = max(width, 1)
+    arr = np.array(bs, dtype=f"S{width}")
+    mat = arr.view(np.uint8).reshape(len(bs), width).copy()
+    if not order.ascending:
+        mat = ~mat
+    nulls = np.array([v is None for v in values])
+    null_rank = np.where(nulls, np.uint8(0 if order.nulls_first else 2),
+                         np.uint8(1))
+    mat[nulls] = 0
+    return null_rank, mat
+
+
+def _pack_key_rows(levels: List[np.ndarray]) -> np.ndarray:
+    """Concatenate per-key levels into one 'S{w}' column whose bytewise
+    comparison is the composite lexicographic order."""
+    parts = []
+    for lv in levels:
+        if lv.dtype == np.uint64:
+            parts.append(lv.astype(">u8").view(np.uint8).reshape(-1, 8))
+        elif lv.ndim == 1:
+            parts.append(lv[:, None])
+        else:
+            parts.append(lv)
+    m = np.ascontiguousarray(np.concatenate(parts, axis=1))
+    return m.view(f"S{m.shape[1]}").ravel()
+
+
+def _range_bounds_levels_np(per_map, bound, orders, n: int):
+    """[n-1, 2K] uint64 bounds matrix for the ICI range exchange: evaluate
+    ORDER keys per materialized batch (device kernel), download, transform
+    to uint64 levels via _fixed_key_levels_np (the kernel-side _range_pid
+    mirrors the same transform), then pick quantile rows by lexsort."""
+    kernel = _build_order_keys_kernel(list(bound))
+    nlevels = 2 * len(orders)
+    # dispatch the order-keys kernel for EVERY batch first, then download
+    # all results in one host transfer (one sync per exchange, not one per
+    # map batch)
+    pending = []
+    for batches in per_map:
+        for batch in batches:
+            batch = _compacted(batch)  # live-masked exchange outputs hold
+            hr = batch.host_rows()     # dead lanes that must not seed bounds
+            if hr == 0:
+                continue
+            cols = [_col_to_colv(c) for c in batch.columns]
+            pending.append((hr, kernel(cols, jnp.int32(hr))))
+    gots = jax.device_get([outs for _, outs in pending])
+    level_parts: List[List[np.ndarray]] = []
+    for (hr, _), got in zip(pending, gots):
+        levels: List[np.ndarray] = []
+        for (ob, nf), o in zip(got, orders):
+            nr, u = _fixed_key_levels_np(np.asarray(ob)[:hr],
+                                         np.asarray(nf)[:hr], o)
+            levels.extend([nr.astype(np.uint64), u])
+        level_parts.append(levels)
+    if not level_parts:
+        return np.zeros((max(n - 1, 1), nlevels), np.uint64)
+    merged = [np.concatenate([lp[i] for lp in level_parts])
+              for i in range(nlevels)]
+    order_idx = np.lexsort(tuple(reversed(merged)))
+    cnt = order_idx.shape[0]
+    sel = [order_idx[min(cnt - 1, (b * cnt) // n)] for b in range(1, n)]
+    return np.stack([[merged[li][i] for li in range(nlevels)]
+                     for i in sel]).astype(np.uint64) if sel else \
+        np.zeros((max(n - 1, 1), nlevels), np.uint64)
+
+
+def _packed_bounds(packed_all: np.ndarray, n: int) -> Optional[np.ndarray]:
+    """n-1 sorted split points over all packed rows (the reference computes
+    bounds from a driver-side sample, GpuRangePartitioner.scala:42-230; the
+    full sort here is vectorized and exact)."""
+    cnt = packed_all.shape[0]
+    if cnt == 0:
+        return None
+    s = np.sort(packed_all)
+    return s[[min(cnt - 1, (b * cnt) // n) for b in range(1, n)]]
+
+
 # ===========================================================================
 # CPU exchange
 # ===========================================================================
@@ -537,16 +645,18 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             return self._execute_range(ctx, p)
         raise NotImplementedError(p.describe())
 
-    def _execute_ici(self, ctx: ExecContext, p: "HashPartitioning",
+    def _execute_ici(self, ctx: ExecContext, p: Partitioning,
                      n: int) -> PartitionedBatches:
-        """Lower the hash exchange onto one collective epoch over the mesh:
+        """Lower the exchange onto one collective epoch over the mesh:
         materialize map outputs, then shard_map + lax.all_to_all moves every
-        row to its target chip in a single XLA program (shuffle/ici.py)."""
+        row to its target chip in a single XLA program (shuffle/ici.py).
+        Hash routes by key hash, round-robin by live-row modulo, and range
+        by host-computed bounds (reference: the partitioning-agnostic
+        transport, RapidsShuffleInternalManager.scala:74-178)."""
         from spark_rapids_tpu.shuffle import ici
 
         child_pb = self.children[0].execute(ctx)
         child_attrs = self.children[0].output
-        bound = bind_all(p.exprs, child_attrs)
 
         def mat(pidx: int):
             return [b for b in child_pb.iterator(pidx)
@@ -556,8 +666,19 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             per_map = ctx.scheduler.run_job(child_pb.num_partitions, mat)
         else:
             per_map = [mat(i) for i in range(child_pb.num_partitions)]
+        bounds_np = None
+        if isinstance(p, HashPartitioning):
+            spec = ("hash", tuple(bind_all(p.exprs, child_attrs)), ())
+        elif isinstance(p, RoundRobinPartitioning):
+            spec = ("rr", (), ())
+        else:
+            bound = bind_all([o.child for o in p.orders], child_attrs)
+            flags = tuple((o.ascending, o.nulls_first) for o in p.orders)
+            bounds_np = _range_bounds_levels_np(per_map, bound, p.orders, n)
+            spec = ("range", tuple(bound), flags)
         with M.trace_range("IciExchange", self.metrics[M.TOTAL_TIME]):
-            out = ici.ici_hash_exchange(per_map, bound, child_attrs, n)
+            out = ici.ici_exchange(per_map, spec, child_attrs, n,
+                                   bounds_np=bounds_np)
         bytes_m = self.metrics["dataSize"]
         for b in out:
             bytes_m.add(b.device_memory_size())
@@ -572,8 +693,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         """Device range exchange: order bits for fixed-width keys are
         computed on device; STRING keys download their values so bounds are
         computed host-side (the reference's driver-side reservoir sample,
-        GpuRangePartitioner.scala:42-230, does the same). Routing/slicing
-        stays on device either way."""
+        GpuRangePartitioner.scala:42-230, does the same). Bucket assignment
+        is fully vectorized — composite keys pack into one bytes column and
+        bounds/ids come from numpy sort/searchsorted. Routing/slicing stays
+        on device."""
         child_pb = self.children[0].execute(ctx)
         child_attrs = self.children[0].output
         bound = bind_all([o.child for o in p.orders], child_attrs)
@@ -592,8 +715,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 fixed_keys = []
                 if kernel is not None:
                     fixed_keys = [
-                        (np.asarray(jax.device_get(ob)),
-                         np.asarray(jax.device_get(nf)))
+                        (np.asarray(jax.device_get(ob))[:batch.num_rows],
+                         np.asarray(jax.device_get(nf))[:batch.num_rows])
                         for ob, nf in kernel(cols,
                                              jnp.int32(batch.num_rows))
                     ]
@@ -614,41 +737,50 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         else:
             per_part = [mat(i) for i in range(child_pb.num_partitions)]
 
-        # host-side bounds over composite key tuples
-        def row_key(host_keys, i):
-            out = []
-            for (kind, payload), o in zip(host_keys, p.orders):
+        # one fixed byte width per string key across all batches so every
+        # packed row compares in the same space
+        widths = [0] * len(bound)
+        for ki, is_str in enumerate(str_key):
+            if is_str:
+                w = 1
+                for part in per_part:
+                    for _, host_keys in part:
+                        vals = host_keys[ki][1]
+                        w = max(w, max((len(v.encode("utf-8"))
+                                        for v in vals if v is not None),
+                                       default=1))
+                widths[ki] = w
+
+        def pack_batch(host_keys) -> np.ndarray:
+            levels: List[np.ndarray] = []
+            for (kind, payload), o, w in zip(host_keys, p.orders, widths):
                 if kind == "str":
-                    out.append(_order_key(payload[i], o))
+                    nr, mat_b = _string_key_levels_np(payload, o, w)
                 else:
-                    ob, nf = payload
-                    out.append(_composite(ob[i], nf[i], o))
-            return tuple(out)
+                    nr, u = _fixed_key_levels_np(payload[0], payload[1], o)
+                    mat_b = u
+                levels.append(nr)
+                levels.append(mat_b)
+            return _pack_key_rows(levels)
 
-        rows: List[tuple] = []
-        for part in per_part:
-            for batch, host_keys in part:
-                for i in range(batch.num_rows):
-                    rows.append(row_key(host_keys, i))
-        bounds = None
-        if rows:
-            rows.sort()
-            cnt = len(rows)
-            bounds = [rows[min(cnt - 1, (b * cnt) // n)]
-                      for b in range(1, n)]
-
-        import bisect
+        packed_parts = [pack_batch(host_keys)
+                        for part in per_part for _, host_keys in part]
+        bounds = _packed_bounds(
+            np.concatenate(packed_parts) if packed_parts
+            else np.empty((0,), dtype="S1"), n)
 
         reduce_buckets: List[List[ColumnarBatch]] = [[] for _ in range(n)]
+        pi = 0
         for part in per_part:
-            for batch, host_keys in part:
+            for batch, _host_keys in part:
                 cap = batch.capacity
-                ids = np.zeros(cap, dtype=np.int32)
+                ids = np.full(cap, n, dtype=np.int32)
                 if bounds is not None:
-                    for i in range(batch.num_rows):
-                        ids[i] = bisect.bisect_right(
-                            bounds, row_key(host_keys, i))
-                ids[batch.num_rows:] = n
+                    ids[:batch.num_rows] = np.searchsorted(
+                        bounds, packed_parts[pi], side="right")
+                else:
+                    ids[:batch.num_rows] = 0
+                pi += 1
                 for t, piece in _device_slices(batch, jnp.asarray(ids), n):
                     if piece.num_rows:
                         reduce_buckets[t].append(piece)
@@ -737,14 +869,6 @@ def _host_string_values(batch: ColumnarBatch, ordinal: int):
     hv = host.columns[0]
     return [hv.data[i] if hv.validity[i] else None
             for i in range(host.num_rows)]
-
-
-def _composite(obits: int, is_null: bool, order: SortOrder) -> Tuple[int, int]:
-    null_rank = (0 if order.nulls_first else 2) if is_null else 1
-    v = int(obits) if not is_null else 0
-    if not order.ascending:
-        v = -v
-    return (null_rank, v)
 
 
 import functools

@@ -69,23 +69,31 @@ def session_mesh() -> Optional[Mesh]:
 
 
 def supports_ici(partitioning, child_attrs, n: int) -> bool:
-    """Whether this exchange can lower onto the collective epoch.
+    """Whether this exchange can lower onto the collective epoch. The
+    reference transport is partitioning-agnostic
+    (RapidsShuffleInternalManager.scala:74-178); here hash, round-robin,
+    and range partitionings all lower — range computes bucket ids from
+    host-derived bounds inside the same routed collective, round-robin is
+    a live-row modulo.
 
     Partition counts: n may equal the mesh size m, be a multiple of it
     (k = n/m output partitions per chip, sub-split by routed partition id),
     or divide it (chips >= n receive nothing) — the reference's accelerated
-    shuffle likewise serves any partition count
-    (RapidsShuffleInternalManager.scala:74-178).
+    shuffle likewise serves any partition count.
 
     Strings: columns exchange as fixed-width padded byte buckets; a STRING
-    *key* must be a direct column reference (it hashes from the exchanged
-    representation), and non-string key expressions must not read string
-    inputs (they evaluate inside the kernel where strings are matrices)."""
+    hash *key* must be a direct column reference (it hashes from the
+    exchanged representation), non-string key expressions must not read
+    string inputs (they evaluate inside the kernel where strings are
+    matrices), and range ORDER keys must be fixed-width (string order bits
+    are multi-word; string-keyed sorts stay on the in-process tier)."""
     from spark_rapids_tpu.ops.base import AttributeReference
-    from spark_rapids_tpu.shuffle.exchange import HashPartitioning
+    from spark_rapids_tpu.shuffle.exchange import (
+        HashPartitioning,
+        RangePartitioning,
+        RoundRobinPartitioning,
+    )
 
-    if not isinstance(partitioning, HashPartitioning):
-        return False
     mesh = session_mesh()
     if mesh is None:
         return False
@@ -98,8 +106,18 @@ def supports_ici(partitioning, child_attrs, n: int) -> bool:
             return False
         return all(no_strings(c) for c in e.children())
 
-    return all(isinstance(e, AttributeReference) or no_strings(e)
-               for e in partitioning.exprs)
+    if isinstance(partitioning, HashPartitioning):
+        return all(isinstance(e, AttributeReference) or no_strings(e)
+                   for e in partitioning.exprs)
+    if isinstance(partitioning, RoundRobinPartitioning):
+        return True
+    if isinstance(partitioning, RangePartitioning):
+        # n == 1 would need a zero-row bounds matrix (a phantom bound would
+        # route every row to out-of-range pid 1); the in-process tier
+        # handles the single-partition sort fine
+        return n >= 2 and all(no_strings(o.child)
+                              for o in partitioning.orders)
+    return False
 
 
 def _regroup(per_map: List[List[ColumnarBatch]], n: int,
@@ -127,11 +145,18 @@ def _regroup(per_map: List[List[ColumnarBatch]], n: int,
     return out
 
 
-def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, bound_exprs,
+def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, pid_spec,
                            n: int, cap: int, widths: Tuple):
-    """One jitted shard_map program per (schema, keys, n, cap, widths):
-    per-shard hash ids -> bucket routing -> all_to_all -> received columns +
-    live mask + routed partition ids.
+    """One jitted shard_map program per (schema, pid program, n, cap,
+    widths): per-shard partition ids -> bucket routing -> all_to_all ->
+    received columns + live mask + routed partition ids.
+
+    pid_spec = (mode, bound_exprs, flags): 'hash' evaluates key exprs and
+    hashes; 'range' evaluates ORDER keys to uint64 level words and counts
+    host-supplied bounds <= row (the bounds ride in as a replicated traced
+    arg); 'rr' assigns (live-row position + shard index) % n. The reference
+    transport is likewise partitioning-agnostic
+    (RapidsShuffleInternalManager.scala:74-178).
 
     widths[ci] is the fixed byte width for a STRING column's padded matrix
     representation (0 for non-string columns). n may exceed the mesh size m
@@ -141,30 +166,17 @@ def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, bound_exprs,
     from spark_rapids_tpu.ops.base import BoundReference
     from spark_rapids_tpu.parallel.mesh import shard_map
 
+    mode, bound_exprs, flags = pid_spec
     ncols = len(dtypes_key)
     dtypes = [DataType(v) for v in dtypes_key]
     m = mesh.devices.size
     k = n // m if n > m else 1
     str_cols = [ci for ci in range(ncols) if widths[ci]]
 
-    def per_shard(live, *flat):
-        live = live[0]
-        datas = list(flat[:ncols])
-        valids = list(flat[ncols:2 * ncols])
-        lens = {ci: flat[2 * ncols + i][0]
-                for i, ci in enumerate(str_cols)}
-        datas = [d[0] for d in datas]
-        valids = [v[0] for v in valids]
-
+    def _hash_pid(ctx, datas, valids, lens):
         # hash entries per key expr; string keys hash straight from the
         # exchanged matrix representation (bit-identical to the offsets+
         # bytes hash, ops/hashing.matrix_string_words)
-        eval_cols = [
-            ColV(dt, d, v) if wi == 0 else None
-            for dt, d, v, wi in zip(dtypes, datas, valids, widths)
-        ]
-        num_rows = jnp.sum(live.astype(jnp.int32))
-        ctx = EvalContext(jnp, True, eval_cols, num_rows, cap)
         entries = []
         for e in bound_exprs:
             if isinstance(e, BoundReference) and \
@@ -179,7 +191,68 @@ def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, bound_exprs,
 
                 r = _scalar_to_colv(ctx, r, e.data_type)
             entries.append((H.column_words(jnp, r), r.validity))
-        pid = H.partition_ids_from_entries(jnp, entries, n)
+        return H.partition_ids_from_entries(jnp, entries, n)
+
+    def _range_pid(ctx, bounds):
+        # uint64 level words per ORDER key (must mirror the host transform
+        # exchange._fixed_key_levels_np EXACTLY — bounds were built there):
+        # null-rank word then sign-flipped (desc: complemented) order bits
+        from spark_rapids_tpu.exec import rowkeys as RK
+
+        levels = []
+        for e, (asc, nfirst) in zip(bound_exprs, flags):
+            r = e.eval(ctx)
+            if isinstance(r, ScalarV):
+                from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+                r = _scalar_to_colv(ctx, r, e.data_type)
+            proxy = RK.key_proxy(r)
+            ob = proxy.arrays[0].astype(jnp.int64)
+            nf = proxy.null_flag
+            u = ob.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+            if not asc:
+                u = ~u
+            u = jnp.where(nf, jnp.uint64(0), u)
+            nr = jnp.where(nf, jnp.uint64(0 if nfirst else 2),
+                           jnp.uint64(1))
+            levels.extend([nr, u])
+        nb = bounds.shape[0]
+        gt = jnp.zeros((cap, nb), dtype=bool)
+        eq = jnp.ones((cap, nb), dtype=bool)
+        for li, lv in enumerate(levels):
+            bl = bounds[:, li][None, :]
+            rl = lv[:, None]
+            gt = gt | (eq & (rl > bl))
+            eq = eq & (rl == bl)
+        # bisect_right: bucket = count of bounds <= row
+        return jnp.sum((gt | eq).astype(jnp.int32), axis=1)
+
+    def per_shard(live, *flat):
+        live = live[0]
+        bounds = None
+        if mode == "range":
+            bounds, flat = flat[-1], flat[:-1]
+        datas = list(flat[:ncols])
+        valids = list(flat[ncols:2 * ncols])
+        lens = {ci: flat[2 * ncols + i][0]
+                for i, ci in enumerate(str_cols)}
+        datas = [d[0] for d in datas]
+        valids = [v[0] for v in valids]
+
+        eval_cols = [
+            ColV(dt, d, v) if wi == 0 else None
+            for dt, d, v, wi in zip(dtypes, datas, valids, widths)
+        ]
+        num_rows = jnp.sum(live.astype(jnp.int32))
+        ctx = EvalContext(jnp, True, eval_cols, num_rows, cap)
+        if mode == "hash":
+            pid = _hash_pid(ctx, datas, valids, lens)
+        elif mode == "range":
+            pid = _range_pid(ctx, bounds)
+        else:  # rr: balanced assignment over live rows
+            pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+            shard = jax.lax.axis_index(DATA_AXIS).astype(jnp.int32)
+            pid = (pos + shard) % n
         dev = pid // k if k > 1 else pid
 
         # route every column's data AND validity (strings: matrix + lens);
@@ -196,9 +269,12 @@ def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, bound_exprs,
     spec = P(DATA_AXIS)
     n_args = 1 + 2 * ncols + len(str_cols)
     n_outs = n_args + (1 if k > 1 else 0)
+    in_specs = (spec,) * n_args
+    if mode == "range":
+        in_specs = in_specs + (P(),)  # bounds replicate to every shard
     smapped = shard_map(
         per_shard, mesh=mesh,
-        in_specs=(spec,) * n_args,
+        in_specs=in_specs,
         out_specs=(spec,) * n_outs,
     )
     return jax.jit(smapped)
@@ -234,10 +310,22 @@ def _matrix_to_strings(mat, lens, byte_cap: int):
 
 def ici_hash_exchange(per_map: List[List[ColumnarBatch]], bound_exprs,
                       child_attrs, n: int) -> List[ColumnarBatch]:
+    """Hash-partitioned collective exchange (see ici_exchange)."""
+    return ici_exchange(per_map, ("hash", tuple(bound_exprs), ()),
+                        child_attrs, n)
+
+
+def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
+                 child_attrs, n: int,
+                 bounds_np=None) -> List[ColumnarBatch]:
     """Exchange all map outputs across the mesh in one collective epoch;
     returns n live-masked output batches. Output partition p lives on mesh
     device p // k (k = partitions per chip), so the downstream
-    per-partition pipeline runs on that chip."""
+    per-partition pipeline runs on that chip. pid_spec selects the routing
+    program (hash keys / range bounds / round-robin — see
+    _build_exchange_kernel); bounds_np is the [n-1, 2K] uint64 level matrix
+    for range partitioning."""
+    mode, bound_exprs, flags = pid_spec
     mesh = session_mesh()
     m = mesh.devices.size
     k = n // m if n > m else 1
@@ -334,14 +422,20 @@ def ici_hash_exchange(per_map: List[List[ColumnarBatch]], bound_exprs,
 
     lens_in = [lens_stk[ci] for ci in str_cols]
 
+    pid_key = (mode, tuple(e.fingerprint() for e in bound_exprs),
+               tuple(flags))
     key = ("ici_exchange", tuple(dt.value for dt in dtypes),
-           tuple(e.fingerprint() for e in bound_exprs), n, cap,
-           tuple(widths))
+           pid_key, n, cap, tuple(widths))
     kernel = get_or_build(key, lambda: _build_exchange_kernel(
-        mesh, tuple(dt.value for dt in dtypes), bound_exprs, n, cap,
-        tuple(widths)))
+        mesh, tuple(dt.value for dt in dtypes),
+        (mode, bound_exprs, flags), n, cap, tuple(widths)))
 
-    out = kernel(live, *datas, *valids, *lens_in)
+    args = [live, *datas, *valids, *lens_in]
+    if mode == "range":
+        b = (np.zeros((max(n - 1, 1), 2 * len(bound_exprs)), np.uint64)
+             if bounds_np is None else bounds_np)
+        args.append(_to_global(jnp.asarray(b), NamedSharding(mesh, P())))
+    out = kernel(*args)
     if not out[0].is_fully_addressable:
         # multi-controller mesh (the exchange spans OS processes): replicate
         # the received arrays so every process can serve any partition to

@@ -84,18 +84,19 @@ class TestIciShuffle:
             ICI)
 
     def _spy_exchange(self, monkeypatch):
-        """Wrap ici_hash_exchange so tests can assert the collective tier
-        actually engaged (the silent-fallback guard of SURVEY section 4)."""
+        """Wrap ici_exchange (the general entry every partitioning mode
+        routes through) so tests can assert the collective tier actually
+        engaged (the silent-fallback guard of SURVEY section 4)."""
         from spark_rapids_tpu.shuffle import ici
 
         calls = []
-        orig = ici.ici_hash_exchange
+        orig = ici.ici_exchange
 
         def spy(*a, **k):
             calls.append(a[3])  # n partitions
             return orig(*a, **k)
 
-        monkeypatch.setattr(ici, "ici_hash_exchange", spy)
+        monkeypatch.setattr(ici, "ici_exchange", spy)
         return calls
 
     def test_string_payload_over_ici(self, session, eight_devices,
@@ -219,6 +220,79 @@ class TestIciShuffle:
             ICI)
         assert not calls, "expression string key must not take the ICI tier"
 
+    # -- range + round-robin over the collective (reference: the transport
+    # is partitioning-agnostic, RapidsShuffleInternalManager.scala:74-178) --
+    def test_global_sort_over_ici(self, session, eight_devices,
+                                  monkeypatch):
+        calls = self._spy_exchange(monkeypatch)
+        cpu = run_on_cpu(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64,
+                                              lo=-500, hi=500)),
+                                 ("v", FloatGen(DataType.FLOAT64,
+                                                nullable=True))],
+                             n=700, num_partitions=4).orderBy("k"))
+        tpu = run_on_tpu(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64,
+                                              lo=-500, hi=500)),
+                                 ("v", FloatGen(DataType.FLOAT64,
+                                                nullable=True))],
+                             n=700, num_partitions=4).orderBy("k"),
+            extra_conf=ICI)
+        from tests.harness import assert_rows_equal
+
+        # global sort: row ORDER is the contract (ties broken arbitrarily,
+        # so compare the sort keys positionally and the full rows as a set)
+        assert [r[0] for r in cpu] == [r[0] for r in tpu]
+        assert_rows_equal(cpu, tpu, ignore_order=True)
+        assert calls, "range exchange did not take the ICI tier"
+
+    def test_global_sort_desc_nulls_over_ici(self, session, eight_devices,
+                                             monkeypatch):
+        calls = self._spy_exchange(monkeypatch)
+
+        def q(s):
+            return gen_df(s, [("k", IntGen(DataType.INT32, lo=-40, hi=40,
+                                           nullable=True)),
+                              ("v", IntGen(DataType.INT64))],
+                          n=500, num_partitions=3).orderBy(
+                F.col("k").desc(), F.col("v"))
+
+        cpu = run_on_cpu(session, q)
+        tpu = run_on_tpu(session, q, extra_conf=ICI)
+        assert [r[0] for r in cpu] == [r[0] for r in tpu]
+        assert calls, "desc/nulls range exchange did not take the ICI tier"
+
+    def test_round_robin_over_ici(self, session, eight_devices,
+                                  monkeypatch):
+        calls = self._spy_exchange(monkeypatch)
+        _check(
+            session,
+            lambda s: gen_df(s, [("k", IntGen(DataType.INT64)),
+                                 ("v", FloatGen(DataType.FLOAT32,
+                                                nullable=True))],
+                             n=400, num_partitions=3).repartition(8),
+            ICI)
+        assert calls, "round-robin exchange did not take the ICI tier"
+
+    def test_string_sort_key_falls_back(self, session, eight_devices,
+                                        monkeypatch):
+        # string ORDER keys are multi-word: in-process tier, still correct
+        from tests.harness import StringGen
+
+        calls = self._spy_exchange(monkeypatch)
+
+        def q(s):
+            return gen_df(s, [("g", StringGen(max_len=5, nullable=True)),
+                              ("v", IntGen(DataType.INT64))],
+                          n=300, num_partitions=3).orderBy("g")
+
+        cpu = run_on_cpu(session, q)
+        tpu = run_on_tpu(session, q, extra_conf=ICI)
+        assert [r[0] for r in cpu] == [r[0] for r in tpu]
+        assert not calls, "string sort keys must not take the ICI tier"
+
 
 # ---------------------------------------------------------------------------
 # serialized tier (single device is fine)
@@ -264,3 +338,19 @@ class TestSerializedShuffle:
             lambda s: gen_df(s, [("v", IntGen(DataType.INT64))],
                              n=300, num_partitions=3).orderBy("v"),
             SER)
+
+
+def test_range_single_partition_not_ici():
+    """n=1 range would need a zero-row bounds matrix (a phantom bound routes
+    every row to out-of-range pid 1 — silent data loss); it must stay on the
+    in-process tier."""
+    from spark_rapids_tpu.columnar.dtypes import DataType as DT
+    from spark_rapids_tpu.ops.base import AttributeReference, SortOrder
+    from spark_rapids_tpu.shuffle import ici
+    from spark_rapids_tpu.shuffle.exchange import RangePartitioning
+
+    a = AttributeReference("k", DT.INT64, True)
+    assert not ici.supports_ici(
+        RangePartitioning([SortOrder(a)], 1), [a], 1)
+    assert ici.supports_ici(
+        RangePartitioning([SortOrder(a)], 8), [a], 8)

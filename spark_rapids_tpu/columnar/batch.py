@@ -697,38 +697,52 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         total = sum(b.num_rows for b in batches)
         cap = bucket_capacity(total)
         if fixed_idx:
-            datas = tuple(
-                tuple(b.columns[ci].data[:bucket_capacity(b.num_rows)]
-                      for b in batches)
-                for ci in fixed_idx)
-            valids = tuple(
-                tuple(b.columns[ci].validity[:bucket_capacity(b.num_rows)]
-                      for b in batches)
-                for ci in fixed_idx)
-            nrows_arr = jnp.asarray([b.num_rows for b in batches],
-                                    dtype=jnp.int32)
-            outs = _concat_fixed_cols(cap, datas, valids, nrows_arr)
-            for ci, (data, validity) in zip(fixed_idx, outs):
-                out_cols[ci] = ColumnVector(
-                    batches[0].columns[ci].dtype, data, validity,
-                    vrange=union_vrange(
-                        *[b.columns[ci].vrange for b in batches]))
+            piece_cols, buckets = _trimmed_piece_cols(batches, fixed_idx)
+            groups = _group_pieces(buckets)
+            row_starts = np.concatenate(
+                [[0], np.cumsum([b.num_rows for b in batches])]
+            ).astype(np.int32)
+            g_datas, g_valids, subcols = _assemble_groups(
+                piece_cols, groups)
+            meta_parts = []
+            for _bkt, m_pad, idxs in groups:
+                m = len(idxs)
+                part = np.zeros((2, m_pad), np.int32)
+                part[0, :] = cap
+                part[0, :m] = row_starts[idxs]
+                part[1, :m] = [batches[i].num_rows for i in idxs]
+                meta_parts.append(part)
+            meta = jnp.asarray(np.concatenate(meta_parts, axis=1))
+            outs = _pack_kernel(
+                "pack_fixed", _pack_fixed_traced, (0, 1, 2, 3),
+                cap, tuple((b, m) for b, m, _ in groups), subcols,
+                len(fixed_idx), meta, g_datas, g_valids)
+            _fill_out_cols(out_cols, fixed_idx, outs, batches)
     else:
-        # masked/device-count path: ONE traced scatter-compaction, no syncs
+        # masked/device-count path: grouped scatter-compaction, no syncs
         assert not has_string
         cap = bucket_capacity(sum(b.capacity for b in batches))
-        datas = tuple(
-            tuple(b.columns[ci].data for b in batches) for ci in fixed_idx)
-        valids = tuple(
-            tuple(b.columns[ci].validity for b in batches)
-            for ci in fixed_idx)
-        lives = tuple(b.live_mask() for b in batches)
-        outs, total = _concat_live_cols(cap, datas, valids, lives)
-        for ci, (data, validity) in zip(fixed_idx, outs):
-            out_cols[ci] = ColumnVector(
-                batches[0].columns[ci].dtype, data, validity,
-                vrange=union_vrange(
-                    *[b.columns[ci].vrange for b in batches]))
+        lives = [b.live_mask() for b in batches]
+        piece_cols = [tuple((b.columns[ci].data, b.columns[ci].validity)
+                            for ci in fixed_idx) for b in batches]
+        groups = _group_pieces([lv.shape[0] for lv in lives])
+        p_pad = 1 << (len(batches) - 1).bit_length()
+        g_datas, g_valids, subcols = _assemble_groups(piece_cols, groups)
+        g_lives, meta_parts = [], []
+        for bkt, m_pad, idxs in groups:
+            m = len(idxs)
+            g_lives.append(_pack3d([[lives[i] for i in idxs]], m_pad,
+                                   bkt)[0])
+            part = np.full((1, m_pad), p_pad, np.int32)
+            part[0, :m] = idxs
+            meta_parts.append(part)
+        meta = jnp.asarray(np.concatenate(meta_parts, axis=1))
+        outs, total = _pack_kernel(
+            "pack_live", _pack_live_traced, (0, 1, 2, 3, 4),
+            cap, p_pad, tuple((b, m) for b, m, _ in groups),
+            subcols, len(fixed_idx), meta, g_datas, g_valids,
+            tuple(g_lives))
+        _fill_out_cols(out_cols, fixed_idx, outs, batches)
     for ci in range(ncols):
         if batches[0].columns[ci].dtype is DataType.STRING:
             out_cols[ci] = _concat_string_cols(
@@ -753,123 +767,281 @@ def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
         return gather_batch(
             ColumnarBatch(batch.columns, batch.capacity), jnp.asarray(idx), n)
     cap = bucket_capacity(batch.capacity)
-    datas = tuple((c.data,) for c in batch.columns)
-    valids = tuple((c.validity,) for c in batch.columns)
-    outs, total = _concat_live_cols(cap, datas, valids, (batch.live,))
+    live = batch.live_mask()
+    bkt = live.shape[0]
+    ncols = batch.num_columns
+    piece_cols = [tuple((c.data, c.validity) for c in batch.columns)]
+    g_datas, g_valids, subcols = _assemble_groups(
+        piece_cols, [(bkt, 1, [0])])
+    outs, total = _pack_kernel(
+        "pack_live", _pack_live_traced, (0, 1, 2, 3, 4),
+        cap, 1, ((bkt, 1),), subcols, ncols,
+        jnp.zeros((1, 1), jnp.int32), g_datas, g_valids,
+        (live[None, :],))
     cols = [ColumnVector(c.dtype, d, v, vrange=c.vrange)
             for c, (d, v) in zip(batch.columns, outs)]
     return ColumnarBatch(cols, total)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def _concat_live_cols(cap: int, datas, valids, lives):
-    """Scatter-compact several live-masked views into one dense batch in a
-    single fused program. Output row position of source row i in piece p =
-    (rows of earlier pieces) + (live rows of p at or before i) - 1."""
-    pos_list = []
-    off = jnp.int32(0)
-    for live in lives:
-        c = jnp.cumsum(live.astype(jnp.int32)) - 1 + off
-        pos_list.append(jnp.where(live, c, cap))
-        off = off + jnp.sum(live.astype(jnp.int32))
-    outs = []
-    for col_datas, col_valids in zip(datas, valids):
-        out_d = jnp.zeros((cap,), dtype=col_datas[0].dtype)
-        out_v = jnp.zeros((cap,), dtype=bool)
-        for d, v, pos in zip(col_datas, col_valids, pos_list):
-            out_d = out_d.at[pos].set(d, mode="drop")
-            out_v = out_v.at[pos].set(v, mode="drop")
-        outs.append((out_d, out_v))
-    return outs, off
+def _group_pieces(buckets: Sequence) -> List[Tuple[Any, int, List[int]]]:
+    """Group piece indices by shape bucket, padding each group's piece count
+    to a power of two. The pack kernels below stack each group into one
+    (M, B) matrix and scatter with vectorized positions, so compiled-graph
+    size is O(groups x columns) REGARDLESS of piece count — a naive
+    per-piece trace put thousands of scatters in one graph and drove LLVM
+    out of memory on wide coalesces (TPC-H q8 at suite scale). Pow-2
+    padding keeps the program-key space log-bounded."""
+    by: dict = {}
+    for i, b in enumerate(buckets):
+        by.setdefault(b, []).append(i)
+    return [(b, 1 << (len(idxs) - 1).bit_length(), idxs)
+            for b, idxs in sorted(by.items())]
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def _concat_fixed_cols(cap: int, datas, valids, nrows_arr):
-    """Scatter each batch's valid region at its running offset, for every
-    column at once (single dispatch; offsets traced so batch row counts
-    don't retrigger compilation)."""
-    offsets = jnp.concatenate([
-        jnp.zeros((1,), jnp.int32), jnp.cumsum(nrows_arr, dtype=jnp.int32)])
-    out = []
-    for col_datas, col_valids in zip(datas, valids):
-        out_d = jnp.zeros((cap,), dtype=col_datas[0].dtype)
-        out_v = jnp.zeros((cap,), dtype=bool)
-        for bi, (d, v) in enumerate(zip(col_datas, col_valids)):
-            k = d.shape[0]
-            n = nrows_arr[bi]
-            idx = jnp.arange(k) + offsets[bi]
-            take = jnp.arange(k) < n
-            idx = jnp.where(take, idx, cap)  # out-of-range drops
-            out_d = out_d.at[idx].set(d, mode="drop")
-            out_v = out_v.at[idx].set(v & take, mode="drop")
-        out.append((out_d, out_v))
-    return out
+def _pack3d(piece_lists: Sequence[Sequence], m_pad: int, bkt: int):
+    """Eagerly pack C columns x M same-bucket pieces into one (C, m_pad,
+    bkt) matrix with ONE concatenate + reshape (+ pad). jnp.stack costs an
+    expand_dims dispatch per operand; at thousand-piece coalesces those
+    per-piece dispatches dominated the host profile."""
+    c = len(piece_lists)
+    m = len(piece_lists[0])
+    flat = [p for pieces in piece_lists for p in pieces]
+    mat = jnp.concatenate(flat).reshape(c, m, bkt)
+    if m_pad > m:
+        mat = jnp.pad(mat, [(0, 0), (0, m_pad - m), (0, 0)])
+    return mat
 
 
-def _concat_string_kernel(cap, byte_cap, datas, offsets_list, valids,
-                          nrows_arr, bytes_arr):
-    """Fused string-column concat dispatcher: routed through the LRU-bounded
-    process jit cache (NOT a module-level @jax.jit) because the key space —
-    piece count x piece shape buckets x cap x byte_cap — grows without limit
-    on a long-running stream; LRU eviction drops cold executables."""
-    from spark_rapids_tpu.engine.jit_cache import get_or_build
-
-    key = ("concat_string", cap, byte_cap,
-           tuple(d.shape[0] for d in datas),
-           tuple(o.shape[0] for o in offsets_list))
-    fn = get_or_build(key, lambda: jax.jit(
-        _concat_string_traced, static_argnums=(0, 1)))
-    return fn(cap, byte_cap, datas, offsets_list, valids, nrows_arr,
-              bytes_arr)
+def _dtype_subgroups(cols_of_first_piece) -> List[Tuple[str, Tuple[int, ...]]]:
+    """Partition local column indices by physical dtype so each subgroup
+    packs with one concatenate (mixed dtypes would silently promote)."""
+    by: dict = {}
+    for local, arr in enumerate(cols_of_first_piece):
+        by.setdefault(arr.dtype.name, []).append(local)
+    return [(dt, tuple(cis)) for dt, cis in sorted(by.items())]
 
 
-def _concat_string_traced(cap: int, byte_cap: int, datas, offsets_list,
-                          valids, nrows_arr, bytes_arr):
-    """Fused string-column concat: every piece's bytes/offsets/validity
-    scatter in ONE compiled program (the eager version cost ~20 dispatches
-    per piece and dominated suite-scale profiles)."""
-    row_offs = jnp.concatenate([
-        jnp.zeros((1,), jnp.int32), jnp.cumsum(nrows_arr, dtype=jnp.int32)])
-    byte_offs = jnp.concatenate([
-        jnp.zeros((1,), jnp.int32), jnp.cumsum(bytes_arr, dtype=jnp.int32)])
-    out_data = jnp.zeros((byte_cap,), dtype=jnp.uint8)
-    out_offsets = jnp.zeros((cap + 1,), dtype=jnp.int32)
-    out_valid = jnp.zeros((cap,), dtype=bool)
-    for i, (d, offs, v) in enumerate(zip(datas, offsets_list, valids)):
-        bidx = jnp.arange(d.shape[0])
-        bmask = bidx < bytes_arr[i]
-        out_data = out_data.at[
-            jnp.where(bmask, bidx + byte_offs[i], byte_cap)].set(
-            d, mode="drop")
-        k = offs.shape[0] - 1
-        ridx = jnp.arange(k)
-        rmask = ridx < nrows_arr[i]
-        out_offsets = out_offsets.at[
-            jnp.where(rmask, ridx + row_offs[i], cap + 1)
-        ].set(offs[:k] + byte_offs[i], mode="drop")
-        out_valid = out_valid.at[
-            jnp.where(rmask, ridx + row_offs[i], cap)].set(
-            v[:k], mode="drop")
-    # tail offsets (rows >= total) all point at the end of the data
+def _pack_fixed_traced(cap, shapes, subcols, ncols, meta, g_datas, g_valids):
+    """Pack grouped piece matrices into dense output columns. Position of
+    source lane (p, i) = start_p + i when i < nrows_p, else dropped; one
+    shared position grid per group, one scatter per column per group (all
+    inside this single compiled program — graph size is O(groups x
+    columns) regardless of piece count)."""
+    outs_d: List[Any] = [None] * ncols
+    outs_v: List[Any] = [None] * ncols
+    off = 0
+    for gi, (bkt, m_pad) in enumerate(shapes):
+        st = meta[0, off:off + m_pad]
+        nr = meta[1, off:off + m_pad]
+        off += m_pad
+        idx = jnp.arange(bkt, dtype=jnp.int32)
+        mask = idx[None, :] < nr[:, None]
+        pos = jnp.where(mask, st[:, None] + idx[None, :], cap).ravel()
+        for mat, cis in zip(g_datas[gi], subcols[gi]):
+            for k, ci in enumerate(cis):
+                od = (jnp.zeros((cap,), mat.dtype)
+                      if outs_d[ci] is None else outs_d[ci])
+                outs_d[ci] = od.at[pos].set(mat[k].ravel(), mode="drop")
+        vmat = g_valids[gi]
+        for ci in range(ncols):
+            ov = (jnp.zeros((cap,), bool)
+                  if outs_v[ci] is None else outs_v[ci])
+            outs_v[ci] = ov.at[pos].set(
+                (vmat[ci] & mask).ravel(), mode="drop")
+    return list(zip(outs_d, outs_v))
+
+
+def _pack_live_traced(cap, p_pad, shapes, subcols, ncols, meta, g_datas,
+                      g_valids, g_lives):
+    """Scatter-compact grouped live-masked views without any host sync.
+    Global position of live row i of piece p = (live rows of pieces earlier
+    in the ORIGINAL order) + (live cumsum within p) - 1; the original-order
+    piece index rides in meta row 0 so grouping never reorders rows."""
+    l_all = jnp.zeros((p_pad,), jnp.int32)
+    off = 0
+    for gi, (_bkt, m_pad) in enumerate(shapes):
+        orig = meta[0, off:off + m_pad]
+        off += m_pad
+        l_all = l_all.at[orig].set(
+            jnp.sum(g_lives[gi], axis=1, dtype=jnp.int32), mode="drop")
+    offs_all = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(l_all, dtype=jnp.int32)])
+    outs_d: List[Any] = [None] * ncols
+    outs_v: List[Any] = [None] * ncols
+    off = 0
+    for gi, (_bkt, m_pad) in enumerate(shapes):
+        orig = meta[0, off:off + m_pad]
+        off += m_pad
+        live = g_lives[gi]
+        within = jnp.cumsum(live, axis=1, dtype=jnp.int32) - 1
+        pos = jnp.where(live, offs_all[orig][:, None] + within, cap).ravel()
+        for mat, cis in zip(g_datas[gi], subcols[gi]):
+            for k, ci in enumerate(cis):
+                od = (jnp.zeros((cap,), mat.dtype)
+                      if outs_d[ci] is None else outs_d[ci])
+                outs_d[ci] = od.at[pos].set(mat[k].ravel(), mode="drop")
+        vmat = g_valids[gi]
+        for ci in range(ncols):
+            ov = (jnp.zeros((cap,), bool)
+                  if outs_v[ci] is None else outs_v[ci])
+            outs_v[ci] = ov.at[pos].set(
+                (vmat[ci] & live).ravel(), mode="drop")
+    return list(zip(outs_d, outs_v)), offs_all[-1]
+
+
+def _pack_string_traced(cap, byte_cap, shapes, meta, g_sd, g_so, g_sv,
+                        totals):
+    """Pack grouped stacked string pieces: data bytes, rebased offsets and
+    validity each scatter once per group."""
+    out_data = jnp.zeros((byte_cap,), jnp.uint8)
+    out_offsets = jnp.zeros((cap + 1,), jnp.int32)
+    out_valid = jnp.zeros((cap,), bool)
+    off = 0
+    for gi, (_db, _b1, m_pad) in enumerate(shapes):
+        rs = meta[0, off:off + m_pad]
+        nr = meta[1, off:off + m_pad]
+        bs = meta[2, off:off + m_pad]
+        bb = meta[3, off:off + m_pad]
+        off += m_pad
+        sd = g_sd[gi][0]
+        so = g_so[gi][0]
+        sv = g_sv[gi][0]
+        db = sd.shape[1]
+        bidx = jnp.arange(db, dtype=jnp.int32)
+        bmask = bidx[None, :] < bb[:, None]
+        bpos = jnp.where(bmask, bs[:, None] + bidx[None, :], byte_cap).ravel()
+        out_data = out_data.at[bpos].set(sd.ravel(), mode="drop")
+        k = so.shape[1] - 1
+        ridx = jnp.arange(k, dtype=jnp.int32)
+        rmask = ridx[None, :] < nr[:, None]
+        rpos = jnp.where(rmask, rs[:, None] + ridx[None, :], cap + 1)
+        out_offsets = out_offsets.at[rpos.ravel()].set(
+            (so[:, :k] + bs[:, None]).ravel(), mode="drop")
+        vpos = jnp.where(rmask, rs[:, None] + ridx[None, :], cap).ravel()
+        out_valid = out_valid.at[vpos].set((sv & rmask).ravel(), mode="drop")
     pos = jnp.arange(cap + 1, dtype=jnp.int32)
-    out_offsets = jnp.where(pos >= row_offs[-1], byte_offs[-1], out_offsets)
+    out_offsets = jnp.where(pos >= totals[0], totals[1], out_offsets)
     return out_data, out_offsets, out_valid
 
 
-def _concat_string_cols(cols: List[ColumnVector], nrows: List[int], cap: int) -> ColumnVector:
-    # Host-coordinated string concat: compute byte sizes (ONE transfer for
-    # all piece sizes — byte_cap must be static), then fuse device-side.
-    byte_sizes = [int(x) for x in jax.device_get(
-        [c.offsets[n] for c, n in zip(cols, nrows)])]
-    total_bytes = sum(byte_sizes)
+def _string_sizes_traced(offs3d, nr):
+    """Per-piece byte totals for one group: offsets[p, nrows_p]."""
+    return offs3d[0][jnp.arange(offs3d.shape[1]), nr]
+
+
+def _trimmed_piece_cols(batches, fixed_idx):
+    """Per piece, slice columns down to bucket_capacity(num_rows) when that
+    shrinks the array (post-filter batches can be nearly empty inside a
+    huge bucket) — otherwise pass arrays through untouched so the common
+    compact case stays O(1) dispatches per column group. All columns of a
+    batch share one capacity (ColumnarBatch invariant); _pack3d's reshape
+    fails loudly if that is ever violated."""
+    piece_cols, buckets = [], []
+    for b in batches:
+        bkt = b.columns[fixed_idx[0]].data.shape[0]
+        eff = bucket_capacity(max(b.num_rows, 1))
+        if eff < bkt:
+            piece_cols.append(tuple(
+                (b.columns[ci].data[:eff], b.columns[ci].validity[:eff])
+                for ci in fixed_idx))
+            buckets.append(eff)
+        else:
+            piece_cols.append(tuple(
+                (b.columns[ci].data, b.columns[ci].validity)
+                for ci in fixed_idx))
+            buckets.append(bkt)
+    return piece_cols, buckets
+
+
+def _assemble_groups(piece_cols, groups):
+    """Shared group assembly for the pack kernels: dtype-subgrouped data
+    matrices, one validity matrix per group, and the static subgroup ->
+    local-column map. piece_cols: per piece, a tuple of (data, validity)
+    pairs in local column order."""
+    g_datas, g_valids, subcols = [], [], []
+    ncols = len(piece_cols[0]) if piece_cols else 0
+    for bkt, m_pad, idxs in groups:
+        subs = _dtype_subgroups(
+            [piece_cols[idxs[0]][lc][0] for lc in range(ncols)])
+        g_datas.append(tuple(
+            _pack3d([[piece_cols[i][lc][0] for i in idxs] for lc in cis],
+                    m_pad, bkt) for _dt, cis in subs))
+        g_valids.append(_pack3d(
+            [[piece_cols[i][lc][1] for i in idxs] for lc in range(ncols)],
+            m_pad, bkt) if ncols else jnp.zeros((0, m_pad, bkt), bool))
+        subcols.append(tuple(cis for _dt, cis in subs))
+    return tuple(g_datas), tuple(g_valids), tuple(subcols)
+
+
+def _fill_out_cols(out_cols, fixed_idx, outs, batches):
+    for lc, (data, validity) in enumerate(outs):
+        ci = fixed_idx[lc]
+        out_cols[ci] = ColumnVector(
+            batches[0].columns[ci].dtype, data, validity,
+            vrange=union_vrange(*[b.columns[ci].vrange for b in batches]))
+
+
+def _pack_kernel(name: str, traced, statics: tuple, *args):
+    """Dispatch a pack kernel through the LRU-bounded process jit cache
+    (NOT module-level @jax.jit: the key space — group buckets x counts x
+    caps — still grows on a long-running stream; LRU eviction drops cold
+    executables)."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    key = (name,) + tuple(args[i] for i in statics)
+    fn = get_or_build(key, lambda: jax.jit(traced, static_argnums=statics))
+    return fn(*args)
+
+
+def _concat_string_cols(cols: List[ColumnVector], nrows: List[int],
+                        cap: int) -> ColumnVector:
+    # Host-coordinated string concat: byte_cap must be static, so piece
+    # byte totals come to the host in ONE jitted gather + transfer per
+    # group (never one eager op per piece).
+    groups = _group_pieces(
+        [(c.data.shape[0], c.offsets.shape[0]) for c in cols])
+    g_sd, g_so, g_sv = [], [], []
+    size_parts = []
+    for (db, b1), m_pad, idxs in groups:
+        m = len(idxs)
+        so = _pack3d([[cols[i].offsets for i in idxs]], m_pad, b1)
+        nr_real = jnp.asarray([nrows[i] for i in idxs] + [0] * (m_pad - m),
+                              dtype=jnp.int32)
+        size_parts.append(_pack_kernel(
+            "string_sizes", _string_sizes_traced, (), so, nr_real))
+        g_so.append(so)
+        g_sd.append(_pack3d([[cols[i].data for i in idxs]], m_pad, db))
+        g_sv.append(_pack3d([[cols[i].validity for i in idxs]], m_pad,
+                            cols[idxs[0]].validity.shape[0]))
+    sizes_by_group = [np.asarray(s) for s in jax.device_get(size_parts)]
+    byte_sizes = [0] * len(cols)
+    for ((_b, _m, idxs), sizes) in zip(groups, sizes_by_group):
+        for i, s in zip(idxs, sizes):
+            byte_sizes[i] = int(s)
+    row_starts = np.concatenate(
+        [[0], np.cumsum(nrows)]).astype(np.int32)
+    byte_starts = np.concatenate(
+        [[0], np.cumsum(byte_sizes)]).astype(np.int32)
+    total_rows = int(row_starts[-1])
+    total_bytes = int(byte_starts[-1])
     byte_cap = bucket_capacity(max(total_bytes, 1))
-    out_data, out_offsets, out_valid = _concat_string_kernel(
-        cap, byte_cap,
-        tuple(c.data for c in cols),
-        tuple(c.offsets for c in cols),
-        tuple(c.validity for c in cols),
-        jnp.asarray(nrows, dtype=jnp.int32),
-        jnp.asarray(byte_sizes, dtype=jnp.int32))
+    meta_parts = []
+    for (_b, m_pad, idxs) in groups:
+        m = len(idxs)
+        part = np.zeros((4, m_pad), np.int32)
+        part[0, :] = cap
+        part[0, :m] = row_starts[idxs]
+        part[1, :m] = [nrows[i] for i in idxs]
+        part[2, :] = byte_cap
+        part[2, :m] = byte_starts[idxs]
+        part[3, :m] = [byte_sizes[i] for i in idxs]
+        meta_parts.append(part)
+    meta = jnp.asarray(np.concatenate(meta_parts, axis=1))
+    shapes = tuple((db, b1, m) for (db, b1), m, _ in groups)
+    out_data, out_offsets, out_valid = _pack_kernel(
+        "pack_string", _pack_string_traced, (0, 1, 2),
+        cap, byte_cap, shapes, meta, tuple(g_sd), tuple(g_so), tuple(g_sv),
+        jnp.asarray([total_rows, total_bytes], dtype=jnp.int32))
     return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets)
 
 

@@ -29,6 +29,10 @@ import jax  # noqa: E402
 jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# the axon sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon latched into jax.config; env scrubbing alone is too
+# late in-process (see tests/conftest.py, same pattern)
+jax.config.update("jax_platforms", "cpu")
 
 import importlib  # noqa: E402
 

@@ -352,15 +352,18 @@ class HostColumnarBatch:
 
     # -- upload (reference: GpuColumnarBatchBuilder host-build-then-upload) --
     def to_device(self) -> "ColumnarBatch":
-        """Single-transfer upload: every column's data/validity/offsets are
-        packed into ONE host uint8 buffer, moved to the device in one copy,
-        and unpacked with one jitted bitcast program. With the accelerator
-        behind a network link, per-column transfers dominate otherwise
-        (the pinned-staging-pool lesson of GpuDeviceManager.scala:200-206)."""
+        """Batched upload: every column's data/validity/offsets are packed
+        into ONE host buffer PER DTYPE, moved to the device in a handful of
+        copies, and sliced apart by one jitted program. With the
+        accelerator behind a network link, per-column transfers dominate
+        otherwise (the pinned-staging-pool lesson of
+        GpuDeviceManager.scala:200-206). Per-dtype rather than one uint8
+        buffer because a device-side u8[n, itemsize] bitcast pads the
+        minor dim to the 128-lane tile on TPU — a 32x HBM blowup that
+        OOMed real-chip uploads at 64M rows."""
         n = self.num_rows
         cap = bucket_capacity(n)
-        parts: List[np.ndarray] = []
-        layout: List[Tuple[str, str, int]] = []
+        parts: List[Tuple[str, np.ndarray, bool]] = []  # (group, seg, want_bool)
         specs = []  # per column: ("fixed", dtype) | ("string",)
         for hc in self.columns:
             validity = np.zeros(cap, dtype=bool)
@@ -386,28 +389,24 @@ class HostColumnarBatch:
                         b if validity[i] else b""
                         for i, b in enumerate(encoded))
                     buf[:nbytes] = np.frombuffer(joined, dtype=np.uint8)
-                parts.append(offsets.view(np.uint8))
-                layout.append(("bitcast", "int32", cap + 1))
-                parts.append(buf)
-                layout.append(("u8", "uint8", byte_cap))
-                parts.append(validity.view(np.uint8))
-                layout.append(("bool", "bool", cap))
+                parts.append(("int32", offsets, False))
+                parts.append(("uint8", buf, False))
+                parts.append(("uint8", validity.view(np.uint8), True))
                 specs.append(("string",))
             else:
                 npdt = physical_np_dtype(hc.dtype)
                 data = np.zeros(cap, dtype=npdt)
                 data[:n] = np.where(hc.validity[:n], hc.data[:n], 0)
-                parts.append(data.view(np.uint8).reshape(-1))
-                kind = "bool" if npdt == np.dtype(np.bool_) else "bitcast"
-                layout.append((kind, npdt.name, cap))
-                parts.append(validity.view(np.uint8))
-                layout.append(("bool", "bool", cap))
+                if npdt == np.dtype(np.bool_):
+                    parts.append(("uint8", data.view(np.uint8), True))
+                else:
+                    parts.append((npdt.name, data, False))
+                parts.append(("uint8", validity.view(np.uint8), True))
                 specs.append(("fixed", hc.dtype,
                               host_value_range(hc.dtype, data[:n])))
         if not parts:
             return ColumnarBatch([], n)
-        packed = jnp.asarray(np.concatenate(parts))
-        arrays = _unpack_device(packed, tuple(layout))
+        arrays = _upload_grouped(parts)
         cols = []
         ai = 0
         for hc, spec in zip(self.columns, specs):
@@ -507,22 +506,22 @@ class ColumnarBatch:
         if n is None:
             arrays.append(jnp.asarray(self.num_rows,
                                       dtype=jnp.int32).reshape(1))
-        packed = _pack_device(tuple(arrays))
-        host = np.asarray(jax.device_get(packed))
+        host = {k: np.asarray(v) for k, v in jax.device_get(
+            _download_grouped(tuple(arrays))).items()}
         if n is None:
-            n = int(host[-4:].view(np.int32)[0])
+            n = int(host["int32"][-1])
             self.num_rows = n
         out = []
-        off = 0
+        offs = {k: 0 for k in host}
 
         def take(count, np_dtype):
-            nonlocal off
-            itemsize = np.dtype(np_dtype).itemsize
-            seg = host[off:off + count * itemsize]
-            off += count * itemsize
+            np_dtype = np.dtype(np_dtype)
+            key = "uint8" if np_dtype == np.bool_ else np_dtype.name
+            seg = host[key][offs[key]:offs[key] + count]
+            offs[key] += count
             if np_dtype == np.bool_:
                 return seg.astype(bool)
-            return seg.view(np_dtype)
+            return seg
 
         for cv in self.columns:
             if cv.dtype is DataType.STRING:
@@ -557,41 +556,43 @@ class ColumnarBatch:
 # ---------------------------------------------------------------------------
 # Packed transfer helpers (one host<->device copy per batch)
 # ---------------------------------------------------------------------------
+def _upload_grouped(parts):
+    """Upload (group, np_seg, want_bool) parts with one host concatenate +
+    one device transfer PER DTYPE GROUP, then slice each segment back out
+    in one jitted program. No device-side bitcasts: u8[n, itemsize]
+    bitcasting pads the minor dim to the 128-lane tile on TPU (32x HBM)."""
+    order: dict = {}
+    for gname, seg, _want in parts:
+        order.setdefault(gname, []).append(seg)
+    keys = tuple(sorted(order))
+    bufs = tuple(jnp.asarray(np.concatenate(order[k])) for k in keys)
+    layout = []
+    offs = {k: 0 for k in keys}
+    for gname, seg, want in parts:
+        layout.append((keys.index(gname), offs[gname], seg.shape[0], want))
+        offs[gname] += seg.shape[0]
+    return _slice_grouped(bufs, tuple(layout))
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
-def _unpack_device(packed_u8, layout):
-    """Slice + bitcast the packed upload buffer back into column arrays.
-    layout: tuple of (kind, dtype_name, count); kind in bitcast|bool|u8."""
+def _slice_grouped(bufs, layout):
     out = []
-    off = 0
-    for kind, dtype_name, count in layout:
-        npdt = np.dtype(dtype_name)
-        nbytes = count * (1 if kind == "bool" else npdt.itemsize)
-        seg = packed_u8[off:off + nbytes]
-        off += nbytes
-        if kind == "bool":
-            out.append(seg.astype(bool))
-        elif kind == "u8":
-            out.append(seg)
-        else:
-            out.append(jax.lax.bitcast_convert_type(
-                seg.reshape(count, npdt.itemsize), jnp.dtype(npdt)))
+    for bi, start, count, want_bool in layout:
+        seg = bufs[bi][start:start + count]
+        out.append(seg.astype(bool) if want_bool else seg)
     return out
 
 
 @jax.jit
-def _pack_device(arrays):
-    """Bitcast every array to uint8 and concatenate (the download mirror of
-    _unpack_device)."""
-    parts = []
-    for a in arrays:
-        if a.dtype == jnp.bool_:
-            parts.append(a.astype(jnp.uint8))
-        elif a.dtype == jnp.uint8:
-            parts.append(a)
-        else:
-            parts.append(
-                jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1))
-    return jnp.concatenate(parts)
+def _download_grouped(arrays):
+    """Concatenate arrays into one buffer per dtype for the host transfer
+    (the download mirror of _upload_grouped; bools ride as uint8)."""
+    order: dict = {}
+    for i, a in enumerate(arrays):
+        a = a.astype(jnp.uint8) if a.dtype == jnp.bool_ else a
+        order.setdefault(a.dtype.name, []).append(a)
+    keys = tuple(sorted(order))
+    return {k: jnp.concatenate(order[k]) for k in keys}
 
 
 # ---------------------------------------------------------------------------
@@ -712,7 +713,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
                 part[0, :m] = row_starts[idxs]
                 part[1, :m] = [batches[i].num_rows for i in idxs]
                 meta_parts.append(part)
-            meta = jnp.asarray(np.concatenate(meta_parts, axis=1))
+            meta = device_const(np.concatenate(meta_parts, axis=1))
             outs = _pack_kernel(
                 "pack_fixed", _pack_fixed_traced, (0, 1, 2, 3),
                 cap, tuple((b, m) for b, m, _ in groups), subcols,
@@ -736,7 +737,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
             part = np.full((1, m_pad), p_pad, np.int32)
             part[0, :m] = idxs
             meta_parts.append(part)
-        meta = jnp.asarray(np.concatenate(meta_parts, axis=1))
+        meta = device_const(np.concatenate(meta_parts, axis=1))
         outs, total = _pack_kernel(
             "pack_live", _pack_live_traced, (0, 1, 2, 3, 4),
             cap, p_pad, tuple((b, m) for b, m, _ in groups),
@@ -798,18 +799,66 @@ def _group_pieces(buckets: Sequence) -> List[Tuple[Any, int, List[int]]]:
             for b, idxs in sorted(by.items())]
 
 
+_DEVICE_CONST_MAX = 2048
+_DEVICE_CONST_LOCK = threading.Lock()
+_DEVICE_CONST: "dict" = {}
+
+
+def device_const(arr: np.ndarray):
+    """Device copy of a small host array through a content-keyed LRU: the
+    pack/slice metadata vectors repeat across iterations of a cached
+    query, and a fresh host->device upload costs ~17 ms when the chip sits
+    behind the network tunnel (measured; jitted launches pipeline at
+    ~0.2 ms). Entries are immutable jax arrays. A DEDICATED LRU, not the
+    kernel jit-cache: row-count-bearing meta keys churn much faster than
+    kernels, and sharing one bound would let meta entries evict compiled
+    executables (a recompile costs seconds to save a 17 ms upload).
+    Insertion-order (FIFO) eviction — cheap and good enough for a cache
+    whose entries cost ~nothing to rebuild."""
+    key = (arr.dtype.str, arr.shape, arr.tobytes())
+    with _DEVICE_CONST_LOCK:
+        got = _DEVICE_CONST.get(key)
+        if got is not None:
+            return got
+    val = jnp.asarray(arr)
+    with _DEVICE_CONST_LOCK:
+        got = _DEVICE_CONST.setdefault(key, val)
+        while len(_DEVICE_CONST) > _DEVICE_CONST_MAX:
+            _DEVICE_CONST.pop(next(iter(_DEVICE_CONST)))
+        return got
+
+
 def _pack3d(piece_lists: Sequence[Sequence], m_pad: int, bkt: int):
-    """Eagerly pack C columns x M same-bucket pieces into one (C, m_pad,
-    bkt) matrix with ONE concatenate + reshape (+ pad). jnp.stack costs an
-    expand_dims dispatch per operand; at thousand-piece coalesces those
-    per-piece dispatches dominated the host profile."""
+    """Pack C columns x M same-bucket pieces into one (C, m_pad, bkt)
+    matrix with ONE jitted concatenate + reshape (+ pad) program. jnp.stack
+    costs an expand_dims dispatch per operand, and even the fused eager
+    concatenate pays a ~7 ms per-op dispatch penalty over the network
+    tunnel; a jitted launch pipelines at ~0.2 ms."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
     c = len(piece_lists)
     m = len(piece_lists[0])
     flat = [p for pieces in piece_lists for p in pieces]
-    mat = jnp.concatenate(flat).reshape(c, m, bkt)
-    if m_pad > m:
-        mat = jnp.pad(mat, [(0, 0), (0, m_pad - m), (0, 0)])
-    return mat
+    if len(flat) > 64:
+        # tracing a jit over hundreds of operands costs seconds; at that
+        # piece count the two eager dispatches are already amortized
+        mat = jnp.concatenate(flat).reshape(c, m, bkt)
+        if m_pad > m:
+            mat = jnp.pad(mat, [(0, 0), (0, m_pad - m), (0, 0)])
+        return mat
+    key = ("pack3d", c, m, m_pad, bkt,
+           tuple(p.dtype.name for p in flat))
+
+    def build():
+        def fn(flat_arrs):
+            mat = jnp.concatenate(flat_arrs).reshape(c, m, bkt)
+            if m_pad > m:
+                mat = jnp.pad(mat, [(0, 0), (0, m_pad - m), (0, 0)])
+            return mat
+
+        return jax.jit(fn)
+
+    return get_or_build(key, build)(flat)
 
 
 def _dtype_subgroups(cols_of_first_piece) -> List[Tuple[str, Tuple[int, ...]]]:
@@ -1005,8 +1054,8 @@ def _concat_string_cols(cols: List[ColumnVector], nrows: List[int],
     for (db, b1), m_pad, idxs in groups:
         m = len(idxs)
         so = _pack3d([[cols[i].offsets for i in idxs]], m_pad, b1)
-        nr_real = jnp.asarray([nrows[i] for i in idxs] + [0] * (m_pad - m),
-                              dtype=jnp.int32)
+        nr_real = device_const(np.asarray(
+            [nrows[i] for i in idxs] + [0] * (m_pad - m), np.int32))
         size_parts.append(_pack_kernel(
             "string_sizes", _string_sizes_traced, (), so, nr_real))
         g_so.append(so)
@@ -1036,12 +1085,12 @@ def _concat_string_cols(cols: List[ColumnVector], nrows: List[int],
         part[2, :m] = byte_starts[idxs]
         part[3, :m] = [byte_sizes[i] for i in idxs]
         meta_parts.append(part)
-    meta = jnp.asarray(np.concatenate(meta_parts, axis=1))
+    meta = device_const(np.concatenate(meta_parts, axis=1))
     shapes = tuple((db, b1, m) for (db, b1), m, _ in groups)
     out_data, out_offsets, out_valid = _pack_kernel(
         "pack_string", _pack_string_traced, (0, 1, 2),
         cap, byte_cap, shapes, meta, tuple(g_sd), tuple(g_so), tuple(g_sv),
-        jnp.asarray([total_rows, total_bytes], dtype=jnp.int32))
+        device_const(np.asarray([total_rows, total_bytes], np.int32)))
     return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets)
 
 

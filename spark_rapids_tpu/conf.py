@@ -449,6 +449,17 @@ BROADCAST_THRESHOLD = _conf("rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "(reference: spark.sql.autoBroadcastJoinThreshold)."
 ).bytes(10 << 20)
 
+RUNTIME_BROADCAST = _conf(
+    "rapids.tpu.sql.adaptive.runtimeBroadcastJoin.enabled").doc(
+    "Re-plan a shuffled hash join as a broadcast join at EXECUTE time when "
+    "the materialized build side fits under autoBroadcastJoinThreshold "
+    "(the role Spark AQE's runtime join-strategy switch plays for the "
+    "reference plugin, exercised by TpchLikeAdaptiveSparkSuite): the "
+    "planner can only statically broadcast when it can bound the build "
+    "size from the logical plan; build sides behind aggregates/joins/file "
+    "scans estimate unknown and would otherwise always pay two shuffles."
+).boolean(True)
+
 RANGE_SAMPLE_SIZE = _conf("rapids.tpu.sql.rangePartition.sampleSizePerPartition").doc(
     "Reservoir sample size per partition for range partitioning bounds "
     "(reference: GpuRangePartitioner.scala driver-side sampling)."

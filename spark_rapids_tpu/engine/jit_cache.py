@@ -75,6 +75,12 @@ def get_or_build(key: Hashable, builder: Callable[[], Any]) -> Any:
 def clear() -> None:
     with _LOCK:
         _CACHE.clear()
+    # the device-const intern pool holds device buffers and is cleared on
+    # the same cadence (suite workers drop both between query groups)
+    from spark_rapids_tpu.columnar import batch as _b
+
+    with _b._DEVICE_CONST_LOCK:
+        _b._DEVICE_CONST.clear()
 
 
 def stats() -> dict:

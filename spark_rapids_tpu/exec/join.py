@@ -374,6 +374,73 @@ def _null_batch(attrs: List[AttributeReference], n_rows: int) -> ColumnarBatch:
     return ColumnarBatch(cols, n_rows)
 
 
+def _unwrap_to_exchange(node):
+    """Descend through batch-coalesce wrappers to the planned shuffle
+    exchange feeding a join input; None when the shape is anything else."""
+    from spark_rapids_tpu.exec.transitions import (
+        CpuCoalesceBatchesExec,
+        TpuCoalesceBatchesExec,
+    )
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+
+    cur = node
+    while isinstance(cur, (TpuCoalesceBatchesExec, CpuCoalesceBatchesExec)):
+        cur = cur.children[0]
+    return cur if isinstance(cur, _ExchangeBase) else None
+
+
+def runtime_broadcast_probe(node, ctx):
+    """AQE-style runtime join re-planning (the role Spark AQE's join
+    strategy switch plays for the reference plugin — its adaptive suite
+    TpchLikeAdaptiveSparkSuite exercises shuffled->broadcast demotion the
+    same way). The planner statically broadcasts only when the logical
+    plan bounds the build size; a build side behind an aggregate, another
+    join, or a file scan estimates unknown and would always pay two
+    shuffles. Here the join materializes the build input BEFORE its
+    exchange; when the actual bytes fit under autoBroadcastJoinThreshold
+    both exchanges are skipped and the join streams the other input
+    as-is. Safe because every downstream distribution requirement has its
+    own explicitly planned exchange (this planner never elides one based
+    on advertised output partitioning).
+
+    Returns None to proceed with the planned shuffle (any materialized
+    build input is handed back to its exchange via set_pre_executed), or
+    (build_batches, stream_pb) for the broadcast path."""
+    if node.join_type is JoinType.FULL_OUTER:
+        return None
+    if not ctx.conf.get(C.RUNTIME_BROADCAST):
+        return None
+    from spark_rapids_tpu.shuffle.exchange import _piece_bytes
+
+    bidx = 0 if node.build_left else 1
+    bex = _unwrap_to_exchange(node.children[bidx])
+    sex = _unwrap_to_exchange(node.children[1 - bidx])
+    if bex is None or sex is None:
+        return None
+    bpb = bex.children[0].execute(ctx)
+
+    def collect(pidx: int):
+        return list(bpb.iterator(pidx))
+
+    if ctx.scheduler is not None:
+        parts = ctx.scheduler.run_job(bpb.num_partitions, collect)
+    else:
+        parts = [collect(p) for p in range(bpb.num_partitions)]
+    batches = [b for part in parts for b in part
+               if (b.host_rows() if hasattr(b, "host_rows")
+                   else b.num_rows) > 0]
+    total = sum(_piece_bytes(b) for b in batches)
+    if total > ctx.conf.get(C.BROADCAST_THRESHOLD):
+        # too big: replay the already-materialized input through the
+        # planned exchange (it must not re-execute the child)
+        bex.set_pre_executed(PartitionedBatches(
+            bpb.num_partitions, lambda p: iter(parts[p])))
+        return None
+    node.metrics["runtimeBroadcastJoins"].add(1)
+    stream_pb = sex.children[0].execute(ctx)
+    return batches, stream_pb
+
+
 def coalesce_join_inputs(ctx, left_pb, right_pb):
     """Coordinated AQE partition coalescing for a shuffled join: group BOTH
     inputs with the SAME contiguous bucket grouping, chosen from their
@@ -406,6 +473,21 @@ class TpuShuffledHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
         return [None, RequireSingleBatch()]
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        rb = runtime_broadcast_probe(self, ctx)
+        if rb is not None:
+            build_batches, stream_pb = rb
+            if build_batches:
+                bc = build_batches[0] if len(build_batches) == 1 else \
+                    concat_batches(build_batches)
+            else:
+                bc = _null_batch(
+                    self.children[0 if self.build_left else 1].output, 0)
+
+            def bfactory(pidx: int):
+                it = self._join_stream(stream_pb.iterator(pidx), bc, False)
+                return count_output(self.metrics, it)
+
+            return PartitionedBatches(stream_pb.num_partitions, bfactory)
         left_pb = self.children[0].execute(ctx)
         right_pb = self.children[1].execute(ctx)
         left_pb, right_pb = coalesce_join_inputs(ctx, left_pb, right_pb)
@@ -560,6 +642,18 @@ class CpuShuffledHashJoinExec(_JoinBase, CpuExec):
         if self.broadcast and self.join_type is JoinType.FULL_OUTER:
             raise NotImplementedError(
                 "full outer join cannot use the broadcast path")
+        if not self.broadcast:
+            rb = runtime_broadcast_probe(self, ctx)
+            if rb is not None:
+                build_batches, stream_pb = rb
+
+                def bfactory(pidx: int):
+                    return count_output(
+                        self.metrics,
+                        self._join_partition(pidx, stream_pb.iterator(pidx),
+                                             build_batches))
+
+                return PartitionedBatches(stream_pb.num_partitions, bfactory)
         left_pb = self.children[0].execute(ctx)
         right_pb = self.children[1].execute(ctx)
         if not self.broadcast:

@@ -149,9 +149,26 @@ class _ExchangeBase(PhysicalExec):
         return f"{type(self).__name__}({self.partitioning.describe()})"
 
     # -- shared runner -------------------------------------------------------
+    # set by a runtime-broadcast probe that already executed (and
+    # materialized) this exchange's child; consumed exactly once
+    _pre_pb = None
+
+    def set_pre_executed(self, pb: PartitionedBatches) -> None:
+        self._pre_pb = pb
+
+    def _child_pb(self, ctx: ExecContext) -> PartitionedBatches:
+        """The input to exchange: a runtime-broadcast probe may have
+        already executed (and materialized) the child — consume that
+        exactly once so the child never runs twice. EVERY execute path
+        (in-process, ICI, range) must come through here."""
+        if self._pre_pb is not None:
+            pb, self._pre_pb = self._pre_pb, None
+            return pb
+        return self.children[0].execute(ctx)
+
     def _materialize(self, ctx: ExecContext, map_fn) -> PartitionedBatches:
         """Run the map job; regroup slices into reduce buckets."""
-        child_pb = self.children[0].execute(ctx)
+        child_pb = self._child_pb(ctx)
         n_out = self.partitioning.num_partitions
         n_maps = child_pb.num_partitions
         serialize = ctx.conf.get(C.SHUFFLE_SERIALIZE)
@@ -506,7 +523,7 @@ class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
 
     def _execute_range(self, ctx: ExecContext,
                        p: RangePartitioning) -> PartitionedBatches:
-        child_pb = self.children[0].execute(ctx)
+        child_pb = self._child_pb(ctx)
         child_attrs = self.children[0].output
         bound = bind_all([o.child for o in p.orders], child_attrs)
         n = p.num_partitions
@@ -655,7 +672,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         transport, RapidsShuffleInternalManager.scala:74-178)."""
         from spark_rapids_tpu.shuffle import ici
 
-        child_pb = self.children[0].execute(ctx)
+        child_pb = self._child_pb(ctx)
         child_attrs = self.children[0].output
 
         def mat(pidx: int):
@@ -697,7 +714,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         is fully vectorized — composite keys pack into one bytes column and
         bounds/ids come from numpy sort/searchsorted. Routing/slicing stays
         on device."""
-        child_pb = self.children[0].execute(ctx)
+        child_pb = self._child_pb(ctx)
         child_attrs = self.children[0].output
         bound = bind_all([o.child for o in p.orders], child_attrs)
         n = p.num_partitions

@@ -1,0 +1,96 @@
+"""On-chip stage microbench: which stage bounds the flagship pipeline?
+
+Times, for n rows of int32/float32 on the live backend: raw HBM copy,
+elementwise filter+project, full sort-by-key, segment_sum (scatter) with
+and without sorted indices, and a one-hot matmul segment sum (MXU path).
+Prints one JSON line per stage. Run on the real chip (default env) or CPU.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 24)
+S = 1024  # segments
+
+
+def fence(x):
+    return np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0][:1]))
+
+
+def timeit(name, fn, *args, iters=3, bytes_per_row=None):
+    fence(fn(*args))  # compile + warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fence(fn(*args))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    out = {"stage": name, "n": N, "best_s": round(best, 4)}
+    if bytes_per_row:
+        out["gbps"] = round(N * bytes_per_row / best / 1e9, 3)
+    print(json.dumps(out), flush=True)
+    return best
+
+
+rng = np.random.default_rng(0)
+k = jnp.asarray(rng.integers(0, S, N).astype(np.int32))
+v = jnp.asarray(rng.integers(-10_000, 10_000, N).astype(np.int32))
+f = jnp.asarray(rng.random(N).astype(np.float32))
+
+dev = jax.devices()[0]
+print(json.dumps({"platform": dev.platform, "n": N}), flush=True)
+
+timeit("copy", jax.jit(lambda a: a + 1), v, bytes_per_row=8)
+
+@jax.jit
+def filt_proj(k, v, f):
+    keep = (v % 3 != 0) & (f < 0.9)
+    return jnp.where(keep, v * 2 + 1, 0), jnp.where(keep, k, S)
+
+timeit("filter_project", filt_proj, k, v, f, bytes_per_row=12)
+
+timeit("sort_pairs", jax.jit(lambda k, v: jax.lax.sort((k, v))), k, v,
+       bytes_per_row=16)
+
+timeit("segsum_scatter_unsorted",
+       jax.jit(lambda k, v: jax.ops.segment_sum(v, k, num_segments=S)),
+       k, v, bytes_per_row=8)
+
+ks = jnp.sort(k)
+timeit("segsum_scatter_sorted_flag",
+       jax.jit(lambda k, v: jax.ops.segment_sum(
+           v, k, num_segments=S, indices_are_sorted=True)),
+       ks, v, bytes_per_row=8)
+
+@jax.jit
+def segsum_matmul(k, v):
+    # MXU path: chunked one-hot contraction; bf16 accumulate in f32
+    B = 1 << 15
+    nchunk = N // B
+
+    def body(c, acc):
+        kk = jax.lax.dynamic_slice(k, (c * B,), (B,))
+        vv = jax.lax.dynamic_slice(v, (c * B,), (B,)).astype(jnp.bfloat16)
+        oh = jax.nn.one_hot(kk, S, dtype=jnp.bfloat16)
+        return acc + jax.lax.dot_general(
+            oh, vv[:, None], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[:, 0]
+
+    return jax.lax.fori_loop(0, nchunk, body, jnp.zeros((S,), jnp.float32))
+
+timeit("segsum_onehot_matmul", segsum_matmul, k, v, bytes_per_row=8)
+
+@jax.jit
+def seg_minmax_sorted(ks, v):
+    # segment min/max on sorted keys via jnp.ops segment_max
+    return jax.ops.segment_max(v, ks, num_segments=S,
+                               indices_are_sorted=True)
+
+timeit("segmax_scatter_sorted", seg_minmax_sorted, ks, v, bytes_per_row=8)

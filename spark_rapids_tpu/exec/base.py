@@ -45,12 +45,36 @@ class PartitionedBatches:
     def iterator(self, pidx: int) -> Iterator:
         return self._factory(pidx)
 
-    def grouped(self, groups) -> "PartitionedBatches":
-        """View with partitions [groups[i]...] chained into partition i."""
+    def grouped(self, groups,
+                concat_device: bool = False) -> "PartitionedBatches":
+        """View with partitions [groups[i]...] chained into partition i.
+
+        concat_device=True additionally concatenates each multi-bucket
+        group's device batches into ONE batch: callers that size groups
+        under an advisory byte target (AQE join coalescing) use it so a
+        grouped partition costs one downstream dispatch instead of one per
+        original bucket — the reference gets the same effect from
+        GpuCoalesceBatches running above its coalesced shuffle reads."""
         def factory(gidx: int):
             def gen():
-                for t in groups[gidx]:
-                    yield from self.iterator(t)
+                if not concat_device or len(groups[gidx]) == 1:
+                    for t in groups[gidx]:
+                        yield from self.iterator(t)
+                    return
+                from spark_rapids_tpu.columnar.batch import (
+                    ColumnarBatch, concat_batches)
+
+                all_batches = [b for t in groups[gidx]
+                               for b in self.iterator(t)]
+                device = [b for b in all_batches
+                          if isinstance(b, ColumnarBatch)]
+                if len(device) != len(all_batches):
+                    # mixed host/device: preserve arrival order untouched
+                    yield from all_batches
+                elif len(device) == 1:
+                    yield device[0]
+                elif device:
+                    yield concat_batches(device)
             return gen()
         costs = None
         if self.bucket_costs is not None:

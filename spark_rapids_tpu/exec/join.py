@@ -99,9 +99,14 @@ class _JoinBase(PhysicalExec):
         self.right_keys = list(right_keys)
         self.join_type = join_type
         self.condition = condition
+        # set by runtime_broadcast_probe when an INNER join swaps its build
+        # side because the planned one exceeded the broadcast threshold
+        self._runtime_build_left: Optional[bool] = None
 
     @property
     def build_left(self) -> bool:
+        if self._runtime_build_left is not None:
+            return self._runtime_build_left
         return self.join_type is JoinType.RIGHT_OUTER
 
     @property
@@ -417,28 +422,49 @@ def runtime_broadcast_probe(node, ctx):
     sex = _unwrap_to_exchange(node.children[1 - bidx])
     if bex is None or sex is None:
         return None
+
+    def _materialize(pb):
+        def collect(pidx: int):
+            return list(pb.iterator(pidx))
+
+        if ctx.scheduler is not None:
+            parts = ctx.scheduler.run_job(pb.num_partitions, collect)
+        else:
+            parts = [collect(p) for p in range(pb.num_partitions)]
+        batches = [b for part in parts for b in part
+                   if (b.host_rows() if hasattr(b, "host_rows")
+                       else b.num_rows) > 0]
+        return parts, batches, sum(_piece_bytes(b) for b in batches)
+
+    threshold = ctx.conf.get(C.BROADCAST_THRESHOLD)
     bpb = bex.children[0].execute(ctx)
-
-    def collect(pidx: int):
-        return list(bpb.iterator(pidx))
-
-    if ctx.scheduler is not None:
-        parts = ctx.scheduler.run_job(bpb.num_partitions, collect)
-    else:
-        parts = [collect(p) for p in range(bpb.num_partitions)]
-    batches = [b for part in parts for b in part
-               if (b.host_rows() if hasattr(b, "host_rows")
-                   else b.num_rows) > 0]
-    total = sum(_piece_bytes(b) for b in batches)
-    if total > ctx.conf.get(C.BROADCAST_THRESHOLD):
-        # too big: replay the already-materialized input through the
-        # planned exchange (it must not re-execute the child)
-        bex.set_pre_executed(PartitionedBatches(
-            bpb.num_partitions, lambda p: iter(parts[p])))
-        return None
-    node.metrics["runtimeBroadcastJoins"].add(1)
-    stream_pb = sex.children[0].execute(ctx)
-    return batches, stream_pb
+    parts, batches, total = _materialize(bpb)
+    if total <= threshold:
+        node.metrics["runtimeBroadcastJoins"].add(1)
+        stream_pb = sex.children[0].execute(ctx)
+        return batches, stream_pb
+    if node.join_type is JoinType.INNER:
+        # the planned build side is too big, but an INNER join can build
+        # on either side (the preserved/filtering-side role constraints of
+        # outer/semi/anti joins don't apply): probe the other input before
+        # falling back to the two planned shuffles. Spark AQE reaches the
+        # same plan via statistics; here the actual materialized bytes
+        # decide (both inputs sit above their exchanges, so both must be
+        # materialized anyway for the shuffle fallback).
+        spb = sex.children[0].execute(ctx)
+        sparts, sbatches, stotal = _materialize(spb)
+        if stotal <= threshold:
+            node.metrics["runtimeBroadcastJoins"].add(1)
+            node._runtime_build_left = (1 - bidx) == 0
+            return sbatches, PartitionedBatches(
+                bpb.num_partitions, lambda p: iter(parts[p]))
+        sex.set_pre_executed(PartitionedBatches(
+            spb.num_partitions, lambda p: iter(sparts[p])))
+    # too big: replay the already-materialized input through the
+    # planned exchange (it must not re-execute the child)
+    bex.set_pre_executed(PartitionedBatches(
+        bpb.num_partitions, lambda p: iter(parts[p])))
+    return None
 
 
 def coalesce_join_inputs(ctx, left_pb, right_pb):
@@ -460,7 +486,11 @@ def coalesce_join_inputs(ctx, left_pb, right_pb):
     groups = _coalesce_groups(combined, ctx.conf.get(C.ADAPTIVE_TARGET_BYTES))
     if len(groups) == left_pb.num_partitions:
         return left_pb, right_pb
-    return left_pb.grouped(groups), right_pb.grouped(groups)
+    # groups are sized under the advisory target, so concatenating each
+    # group's device batches is memory-safe and turns a grouped partition
+    # into ONE joiner dispatch instead of one per original bucket
+    return (left_pb.grouped(groups, concat_device=True),
+            right_pb.grouped(groups, concat_device=True))
 
 
 class TpuShuffledHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):

@@ -330,6 +330,46 @@ def _sorted_counts(validity, gi: GroupInfo, capacity: int):
     return _sorted_group_totals(vs.astype(jnp.int32), gi, capacity)
 
 
+def _segment_starts(gi: GroupInfo):
+    """Boundary flags in sorted order: True at each group's first member.
+    Derived from the monotone gid_sorted — pads (gid == capacity) form one
+    trailing pseudo-segment whose scan result is never gathered."""
+    g = gi.gid_sorted
+    return jnp.concatenate([jnp.ones((1,), bool), g[1:] != g[:-1]])
+
+
+def _segmented_scan(per_row_sorted, starts, combine):
+    """Inclusive segmented scan (Blelloch flag-carry form): within each run
+    of rows sharing a group, accumulate with `combine`; reset at every
+    `starts` flag. One associative_scan — log2(capacity) fused elementwise
+    levels, NO scatter. This is the TPU answer to the measured scatter cliff
+    (BENCH_TPU_r04_stages.json: scatter segment reductions 0.63 GB/s vs
+    3+ GB/s for everything else at 16M rows): the per-group reduction
+    becomes scan + boundary gather, same as the int-sum cumsum trick but
+    valid for ANY associative op and numerically safe for float sums
+    (accumulation restarts at each group, so no cross-group magnitude
+    absorption the way a global-cumsum difference would)."""
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, combine(va, vb))
+
+    _, vals = jax.lax.associative_scan(comb, (starts, per_row_sorted))
+    return vals
+
+
+def _sorted_segment_reduce(per_row_sorted, gi: GroupInfo, capacity: int,
+                           combine):
+    """Per-group reduction of an already-group-sorted array via segmented
+    scan + gather at each group's last sorted position. Input must already
+    hold the op's identity in masked-out (null/pad) lanes. Slots >=
+    num_groups return the scan value at position 0 (callers mask by their
+    own per-group validity)."""
+    scanned = _segmented_scan(per_row_sorted, _segment_starts(gi), combine)
+    ends = jnp.clip(gi.seg_ends, 0, capacity - 1)
+    return scanned[ends]
+
+
 def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
     """Reduce `data` per group with SQL null semantics.
 
@@ -417,21 +457,55 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
             # plain INT) input must accumulate 64-bit — per-group totals are
             # unbounded even when every element fits int32
             data = data.astype(jnp.int64)
-        seg = _seg_ids(gid, validity & in_group, capacity)
         if sorted_ok:
+            # scatter-free lane: every reduction is scan + boundary gather
+            # over the group-sorted order (scatter segment reductions are the
+            # one slow TPU kernel, BENCH_TPU_r04_stages.json)
             nonnull = _sorted_counts(validity & in_group, gi, capacity)
             outv = nonnull > 0
+            vmask = (validity & in_group)[gi.order]
             if op == "sum" and jnp.dtype(data.dtype).kind in "iu":
-                vs = jnp.where((validity & in_group)[gi.order],
-                               data[gi.order], jnp.zeros((), data.dtype))
+                # integer sums: a single global cumsum + difference is even
+                # cheaper than the segmented scan (exact under modular wrap)
+                vs = jnp.where(vmask, data[gi.order],
+                               jnp.zeros((), data.dtype))
                 out = _sorted_group_totals(vs, gi, capacity)
-                out = jnp.where(outv, out, jnp.zeros((), out.dtype))
-                return out, outv
-        else:
-            nonnull = jax.ops.segment_sum(
-                (seg < capacity).astype(jnp.int32), seg,
-                num_segments=capacity)
-            outv = nonnull > 0
+            elif op == "sum":
+                vs = jnp.where(vmask, data[gi.order],
+                               jnp.zeros((), data.dtype))
+                out = _sorted_segment_reduce(vs, gi, capacity, jnp.add)
+            elif op == "any":
+                vs = vmask & data[gi.order].astype(bool)
+                out = _sorted_segment_reduce(vs, gi, capacity,
+                                             jnp.logical_or)
+            else:  # min / max
+                if jnp.dtype(data.dtype).kind == "f":
+                    # scan on total-order bits so NaN sorts greater than
+                    # every number (Spark: min skips NaN unless all-NaN)
+                    bits = _float_order_bits(data)[gi.order]
+                    if op == "min":
+                        ident = jnp.array(jnp.iinfo(bits.dtype).max,
+                                          bits.dtype)
+                        comb = jnp.minimum
+                    else:
+                        ident = jnp.array(0, bits.dtype)
+                        comb = jnp.maximum
+                    vs = jnp.where(vmask, bits, ident)
+                    r = _sorted_segment_reduce(vs, gi, capacity, comb)
+                    out = _float_from_order_bits(r).astype(data.dtype)
+                else:
+                    ident = (_type_max(data.dtype) if op == "min"
+                             else _type_min(data.dtype))
+                    comb = jnp.minimum if op == "min" else jnp.maximum
+                    vs = jnp.where(vmask, data[gi.order], ident)
+                    out = _sorted_segment_reduce(vs, gi, capacity, comb)
+            out = jnp.where(outv, out, jnp.zeros((), out.dtype))
+            return out, outv
+        seg = _seg_ids(gid, validity & in_group, capacity)
+        nonnull = jax.ops.segment_sum(
+            (seg < capacity).astype(jnp.int32), seg,
+            num_segments=capacity)
+        outv = nonnull > 0
         if op == "sum":
             out = jax.ops.segment_sum(jnp.where(seg < capacity, data, 0), seg,
                                       num_segments=capacity)

@@ -18,6 +18,304 @@ from spark_rapids_tpu.ops.values import ColV
 # powers of ten as uint64 (10^0 .. 10^19)
 _POW10 = np.array([10 ** k for k in range(20)], dtype=np.uint64)
 
+# f64 powers of ten shared by the float<->string kernels. Host (numpy) and
+# device (jax.numpy) both index THIS table and apply the same operation
+# sequence, so their results are bit-identical — the framework's
+# float-format/parse convention is defined BY this algorithm, not by Java
+# or libc (the reference gates the same directions as incompatible for the
+# same reason: cuDF's formatting differs from the JVM's, GpuCast.scala:79+,
+# RapidsConf.scala:393-425).
+_P10F_OFF = 343
+with np.errstate(over="ignore"):
+    # slots above 10^308 are inf; f64_scale's halved exponents never index
+    # them, and the clip bound below keeps any out-of-range k finite-safe
+    _P10F = np.power(10.0, np.arange(-_P10F_OFF, _P10F_OFF + 1))
+_P10I = np.array([10 ** k for k in range(19)], dtype=np.int64)
+
+
+def f64_scale(xp, x, k):
+    """x * 10^k: ONE table multiply when |k| <= 22 (single rounding — keeps
+    the shortest-digit search exact for the common magnitudes), two halved
+    multiplies beyond (one factor alone can overflow the f64 exponent
+    range). Same table + same split on host and device => bit-identical
+    results."""
+    P = xp.asarray(_P10F)
+    k1 = k // 2
+    k2 = k - k1
+    two = (x * P[xp.clip(k1 + _P10F_OFF, 0, 2 * _P10F_OFF)]
+           * P[xp.clip(k2 + _P10F_OFF, 0, 2 * _P10F_OFF)])
+    one = x * P[xp.clip(k + _P10F_OFF, 0, 2 * _P10F_OFF)]
+    return xp.where((k >= -22) & (k <= 22), one, two)
+
+
+def _two_prod(xp, a, c):
+    """Dekker error-free product: returns (p1, err) with a*c == p1 + err
+    EXACTLY (no fma needed; valid while the 2^27 splits don't overflow —
+    callers keep operands within ~1e17)."""
+    p1 = a * c
+    split = 134217729.0  # 2^27 + 1
+    ah = a * split
+    ah = ah - (ah - a)
+    al = a - ah
+    ch = c * split
+    ch = ch - (ch - c)
+    cl = c - ch
+    err = ((ah * ch - p1) + ah * cl + al * ch) + al * cl
+    return p1, err
+
+
+def _fast_two_sum(xp, h, l):
+    """Renormalize a pair: (s, e) with s + e == h + l exactly, |e| <= ulp(s)
+    (requires |h| >= |l| — all callers satisfy it)."""
+    s = h + l
+    return s, l - (s - h)
+
+
+def shortest_float_decomposition(xp, a, maxp: int, is32: bool = False):
+    """Shared (numpy/jax.numpy) shortest-round-trip decimal search.
+
+    For each POSITIVE FINITE f64 lane of `a`: find the smallest p <= maxp
+    such that rounding a to p significant decimal digits parses back to
+    the source value. Returns (m, p, e10) int64 arrays with m the p-digit
+    decimal mantissa and e10 the decimal exponent, i.e. value ~=
+    m * 10^(e10 - p + 1). Lanes where no p round-trips keep p = maxp.
+
+    Method: normalize a into [1, 10) as an error-free f64 PAIR by chained
+    Dekker multiplies/divides with f64-exact 10^(<=22) chunk factors (a
+    17-digit mantissa exceeds 2^53, so no single-f64 scaling can place
+    its digits exactly); then each candidate mantissa is one compensated
+    product of the pair with an exact 10^(p-1), and the round-trip test
+    is the exact half-gap condition |a*10^k - m| < ulp(a)*10^k / 2. The
+    pair chain's residual error is ~2^-105 relative, so digit selection
+    is exact across the whole normal range; subnormal inputs (|v| <
+    2.2e-308) may misplace their last digit (documented deviation). Every
+    operation and table is shared between host (numpy) and device
+    (jax.numpy), so both emit identical results lane-for-lane."""
+    i64 = xp.int64
+    P = xp.asarray(_P10F)
+    P10I = xp.asarray(_P10I)
+    eb = (a.view(xp.uint64) >> 52) & xp.uint64(0x7FF)
+    sub = eb == 0  # subnormal: estimate the exponent on a scaled copy
+    a_est = xp.where(sub, a * P[280 + _P10F_OFF], a)
+    e2 = ((a_est.view(xp.uint64) >> 52) & xp.uint64(0x7FF)).astype(i64) - 1023
+    e10 = (e2 * 315653) >> 20  # floor(e2 * log10(2)) +- 1
+    e10 = e10 + (a_est >= P[xp.clip(e10 + 1 + _P10F_OFF, 0,
+                                    2 * _P10F_OFF)]).astype(i64)
+    e10 = e10 - (a_est < P[xp.clip(e10 + _P10F_OFF, 0,
+                                   2 * _P10F_OFF)]).astype(i64)
+    e10 = e10 - xp.where(sub, 280, 0)  # decimal exponent estimate (+-1)
+
+    # relative ulp of the SOURCE value: the round-trip target dtype's ulp
+    # (f32 sources arrive exactly widened to f64, but their parse-back
+    # granularity is the f32 one). Subnormal lanes clamp the bit trick.
+    e2a = ((a.view(xp.uint64) >> xp.uint64(52)).astype(i64)) - 1023
+    if is32:
+        ulp_exp = xp.maximum(e2a, -126) - 23 + 1023
+    else:
+        ulp_exp = e2a - 52 + 1023
+    ulp = xp.where(ulp_exp > 0, (ulp_exp << 52).astype(xp.uint64)
+                   .view(xp.float64), 5e-324)
+    rel_ulp = ulp / a
+
+    # --- exact pair normalization: (h, l) == a * 10^(-e10), in [1, 10).
+    # Tiny inputs first scale up by an EXACT power of two so no Dekker
+    # split or error term ever touches the f64 subnormal range (XLA
+    # backends flush f64 subnormals to zero, numpy keeps them — without
+    # this the two engines diverge); the chain only grows these lanes, and
+    # the final /2^600 is exact.
+    s2 = xp.where(a < 1e-100, 2.0 ** 600, 1.0)
+    h = a * s2
+    l = xp.zeros(a.shape, xp.float64)
+    rem = -e10
+    n_chunks = 15 if maxp > 9 else 4  # ceil(324/22) / ceil(46/22) + slack
+    for _ in range(n_chunks):
+        step = xp.clip(rem, -22, 22)
+        cm = P[xp.clip(step + _P10F_OFF, 0, 2 * _P10F_OFF)]       # 10^step
+        cd = P[xp.clip(-step + _P10F_OFF, 0, 2 * _P10F_OFF)]      # 10^-step
+        # multiply branch (step >= 0): pair * 10^step
+        mp1, mperr = _two_prod(xp, h, cm)
+        mh, ml = _fast_two_sum(xp, mp1, mperr + l * cm)
+        # divide branch (step < 0): pair / 10^(-step)
+        q1 = h / cd
+        pp1, pperr = _two_prod(xp, q1, cd)
+        qerr = (((h - pp1) - pperr) + l) / cd
+        dh, dl = _fast_two_sum(xp, q1, qerr)
+        pos = step >= 0
+        h = xp.where(pos, mh, dh)
+        l = xp.where(pos, ml, dl)
+        rem = rem - step
+    h = h / s2  # exact power-of-two unscale
+    l = l / s2
+    # the estimate can be off by one: one exact pair-correction each way
+    over = h >= 10.0
+    q1 = h / 10.0
+    pp1, pperr = _two_prod(xp, q1, 10.0)
+    qerr = (((h - pp1) - pperr) + l) / 10.0
+    oh, ol = _fast_two_sum(xp, q1, qerr)
+    h = xp.where(over, oh, h)
+    l = xp.where(over, ol, l)
+    e10 = e10 + over.astype(i64)
+    under = h < 1.0
+    mp1, mperr = _two_prod(xp, h, 10.0)
+    uh, ul = _fast_two_sum(xp, mp1, mperr + l * 10.0)
+    h = xp.where(under, uh, h)
+    l = xp.where(under, ul, l)
+    e10 = e10 - under.astype(i64)
+
+    m_out = xp.zeros(a.shape, i64)
+    p_out = xp.full(a.shape, maxp, dtype=i64)
+    e_out = e10
+    done = xp.zeros(a.shape, bool)
+    for p in range(1, maxp + 1):
+        c = float(_P10F[(p - 1) + _P10F_OFF])  # 10^(p-1), f64-exact
+        w1, werr = _two_prod(xp, h, c)
+        tail = werr + l * c
+        base = xp.rint(w1)
+        delta = (w1 - base) + tail       # exact: (pair)*10^(p-1) - base
+        adj = xp.rint(delta)
+        m = base.astype(i64) + adj.astype(i64)
+        resid = delta - adj              # exact: a*10^k - m (in m units)
+        # round-trip <=> |a*10^k - m| < ulp(a)*10^k / 2; in m units the
+        # half gap is rel_ulp * m / 2 (approximation error << the margin)
+        half_gap = rel_ulp * base * 0.5
+        carry = m >= P10I[p]             # 9.99.. rounded up to 10^p
+        # carried candidate is 10^(p-1) one decade up: same exact test
+        # against 10^p in current units
+        # base ~= 10^p on carry lanes, so half_gap is already in current
+        # units for both tests
+        resid_c = (base - float(_P10F[p + _P10F_OFF])) + delta
+        ok = xp.where(carry, xp.abs(resid_c) < half_gap,
+                      xp.abs(resid) < half_gap)
+        m = xp.where(carry, P10I[p - 1], m)
+        e_cand = e10 + carry.astype(i64)
+        if p == maxp:
+            ok = xp.ones(a.shape, bool)
+        sel = ok & ~done
+        m_out = xp.where(sel, m, m_out)
+        p_out = xp.where(sel, p, p_out)
+        e_out = xp.where(sel, e_cand, e_out)
+        done = done | ok
+    return m_out, p_out, e_out
+
+
+# byte layout of the constant specials buffer used by float_to_string
+_FLT_SPECIALS = np.frombuffer(b"NaNInfinity-Infinity0.0-0.0", dtype=np.uint8)
+_SP_NAN, _SP_INF, _SP_NINF, _SP_ZERO, _SP_NZERO = (
+    (0, 3), (3, 8), (11, 9), (20, 3), (23, 4))
+
+_FLT_W = 26  # max emitted width of a finite nonzero float
+
+
+def float_to_string(ctx, v: ColV) -> ColV:
+    """Shortest-round-trip float formatting on device, Java-style notation
+    (plain for -3 <= e10 < 7, else 'd.dddE[-]ee'; '0.0'/'-0.0'/'NaN'/
+    '[-]Infinity'). Digit selection via shortest_float_decomposition — the
+    host oracle (ops/cast.py format_float_value) runs the numerically
+    identical algorithm, so both engines emit identical bytes. Gated by
+    rapids.tpu.sql.castFloatToString.enabled + an f64-capable backend
+    (reference: GpuCast float->string behind the same conf key)."""
+    from spark_rapids_tpu.columnar.strings import build_from_plan
+    import jax.numpy as jnp  # noqa: F811 (module alias clarity)
+
+    cap = ctx.capacity
+    src32 = v.dtype is DataType.FLOAT32
+    maxp = 9 if src32 else 17
+    x = v.data
+    f64 = x.astype(jnp.float64)
+    a = jnp.abs(f64)
+    if src32:
+        # XLA backends flush f32 subnormals to zero in float ops; rescue
+        # them bit-level (their widened f64 values are normal): value =
+        # mantissa * 2^-149, both factors exact
+        bits32 = x.view(jnp.uint32)
+        mant = (bits32 & jnp.uint32(0x7FFFFF)).astype(jnp.float64)
+        is_sub = ((bits32 >> jnp.uint32(23)) & jnp.uint32(0xFF)) == 0
+        is_sub = is_sub & (mant > 0)
+        a = jnp.where(is_sub, mant * (2.0 ** -149), a)
+        neg = (bits32 >> jnp.uint32(31)) == 1
+    else:
+        neg = jnp.signbit(f64)
+    nan = jnp.isnan(f64)
+    inf = jnp.isinf(f64)
+    zero = a == 0.0
+    finite = ~(nan | inf | zero)
+    m, p, e10 = shortest_float_decomposition(
+        jnp, jnp.where(finite, a, 1.0), maxp, is32=src32)
+    m = m.astype(jnp.int64)
+    p32 = p.astype(jnp.int32)
+    e32 = e10.astype(jnp.int32)
+    negi = neg.astype(jnp.int32)
+    P10I = jnp.asarray(_P10I)
+
+    sci = (e32 < -3) | (e32 >= 7)
+    ilen = jnp.where(e32 >= 0, e32 + 1, 1)
+    flen = jnp.where(e32 >= 0, jnp.maximum(p32 - 1 - e32, 1), p32 - e32 - 1)
+    len_plain = negi + ilen + 1 + flen
+    ae = jnp.abs(e32)
+    elen = 1 + (ae >= 10).astype(jnp.int32) + (ae >= 100).astype(jnp.int32)
+    sd = jnp.maximum(p32 - 1, 1)
+    len_sci = negi + 2 + sd + 1 + (e32 < 0).astype(jnp.int32) + elen
+    out_len = jnp.where(sci, len_sci, len_plain)
+
+    # 2-D emission over [cap, W]: one fused graph, no per-position unroll
+    # (an unrolled 26-column build costs ~2x the compile time)
+    t = (jnp.arange(_FLT_W, dtype=jnp.int32)[None, :] - negi[:, None])
+    mC = m[:, None]
+    pC = p32[:, None]
+    eC = e32[:, None]
+    ilenC = ilen[:, None]
+    sdC = sd[:, None]
+
+    def digit_at(q):
+        """char code of significant digit q (0-based from the left) of m;
+        '0' outside [0, p)."""
+        shift = jnp.clip(pC - 1 - q, 0, 18)
+        d = ((mC // P10I[shift]) % 10).astype(jnp.int32)
+        return jnp.where((q >= 0) & (q < pC), ord("0") + d, ord("0"))
+
+    # plain notation: [int digits] '.' [frac digits]
+    u = t - ilenC - 1
+    q_int = jnp.where(eC >= 0, t, -1)  # e10<0 => single '0' int part
+    q_plain = jnp.where(t < ilenC, q_int, u + eC + 1)
+    ch_plain = jnp.where(t == ilenC, ord("."), digit_at(q_plain))
+    # scientific: d '.' digits 'E' [-] exp
+    epos = 2 + sdC
+    ch_sd = digit_at(jnp.where(pC == 1, 99, t - 1))
+    vv = t - epos - 1 - (eC < 0).astype(jnp.int32)
+    esh = jnp.clip(elen[:, None] - 1 - vv, 0, 18)
+    ch_e = ord("0") + ((ae[:, None].astype(jnp.int64) // P10I[esh]) % 10
+                       ).astype(jnp.int32)
+    ch_sci = jnp.where(
+        t == 0, digit_at(jnp.zeros((cap, 1), jnp.int32)),
+        jnp.where(t == 1, ord("."),
+                  jnp.where(t < epos, ch_sd,
+                            jnp.where(t == epos, ord("E"),
+                                      jnp.where((t == epos + 1) & (eC < 0),
+                                                ord("-"), ch_e)))))
+    chm = jnp.where(sci[:, None], ch_sci, ch_plain)
+    chm = jnp.where(t < 0, ord("-"), chm)
+    template = chm.astype(jnp.uint8).reshape(cap * _FLT_W)
+
+    # specials route through a constant source buffer
+    sp_start = jnp.where(
+        nan, _SP_NAN[0],
+        jnp.where(inf & ~neg, _SP_INF[0],
+                  jnp.where(inf & neg, _SP_NINF[0],
+                            jnp.where(neg, _SP_NZERO[0], _SP_ZERO[0]))))
+    sp_len = jnp.where(
+        nan, _SP_NAN[1],
+        jnp.where(inf & ~neg, _SP_INF[1],
+                  jnp.where(inf & neg, _SP_NINF[1],
+                            jnp.where(neg, _SP_NZERO[1], _SP_ZERO[1]))))
+    choice = jnp.where(finite, 0, 1).astype(jnp.int32)
+    starts = jnp.where(finite, jnp.arange(cap, dtype=jnp.int32) * _FLT_W,
+                       sp_start).astype(jnp.int32)
+    lens = jnp.where(v.validity, jnp.where(finite, out_len, sp_len), 0)
+    data, offsets = build_from_plan(
+        [template, jnp.asarray(_FLT_SPECIALS)], choice, starts, lens,
+        _FLT_W * cap)
+    return ColV(DataType.STRING, data, v.validity, offsets)
+
 
 def int_to_string(ctx, v: ColV) -> ColV:
     """Format integers (or bools as true/false) to decimal strings."""

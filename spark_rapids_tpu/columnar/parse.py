@@ -1,0 +1,289 @@
+"""Device string->float / string->timestamp parse kernels (cast layer).
+
+The cuDF analog is the string-cast kernel family behind GpuCast.scala:79-181
+(conf-gated: RapidsConf.scala:393-425). Grammar and value arithmetic are
+THIS FRAMEWORK'S convention, mirrored exactly by the host oracle
+(ops/cast.py _parse_float_text / _parse_ts_strict):
+
+- float:  [+-]? ( digits [. digits*] | . digits+ ) ( [eE] [+-]? d{1,3} )?
+          | [+-]? (inf | infinity | nan)   (case-insensitive)
+  after ASCII-space trim; at most 48 chars; the first 17 significant
+  digits are folded into an int64 mantissa (further digits shift the
+  exponent; sub-ulp information beyond 17 digits is dropped) and the value
+  is mantissa * 10^q via the shared power-table scaling
+  (columnar/format.py f64_scale) — host and device produce bit-identical
+  f64/f32 results because every operation and table is shared.
+- timestamp: 'YYYY-MM-DD' (midnight UTC) or
+          'YYYY-MM-DD[ T]HH:MM:SS[.f{1,6}][Z|+-HH:MM]'
+  after trim; naive timestamps read as UTC; civil-validity checked
+  (2023-02-30 is invalid). Pure int64 math — exact on every backend.
+
+Unparseable non-empty strings are NULL (ANSI mode: the cast exec raises,
+matching the host engine). These kernels deliberately do NOT share the CSV
+scan kernels (io/csv_device.py): the scan's contract is
+fall-back-on-malformed (pyarrow-oracle parity), the cast's is NULL-on-
+malformed (SQL semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.values import ColV
+
+MAXW_FLOAT = 48
+MAXW_TS = 32
+
+_ZERO = ord("0")
+_MINUS = ord("-")
+_PLUS = ord("+")
+_DOT = ord(".")
+
+
+def _trimmed_window(col: ColV, maxw: int):
+    """Per-row (start, len) of the ASCII-space-trimmed field, plus the
+    gathered char matrix [cap, maxw] (0-padded)."""
+    cap = col.offsets.shape[0] - 1
+    byte_cap = col.data.shape[0]
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(col.offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = (pos >= col.offsets[row]) & (pos < col.offsets[row + 1])
+    # ASCII whitespace set matching the host oracle's str.strip()
+    b = col.data
+    is_ws = (b == 32) | (b == 9) | (b == 10) | (b == 13) | (b == 12) | \
+        (b == 11)
+    nonspace = within & ~is_ws
+    first_ns = jax.ops.segment_min(
+        jnp.where(nonspace, pos, byte_cap), row, num_segments=cap)
+    last_ns = jax.ops.segment_max(
+        jnp.where(nonspace, pos, -1), row, num_segments=cap)
+    starts = jnp.where(first_ns >= byte_cap, 0,
+                       first_ns).astype(jnp.int32)
+    lens = jnp.maximum(last_ns.astype(jnp.int32) + 1 - starts, 0)
+    lens = jnp.where(first_ns >= byte_cap, 0, lens)
+    idx = starts[:, None] + jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    ch = col.data[jnp.clip(idx, 0, byte_cap - 1)]
+    inb = jnp.arange(maxw, dtype=jnp.int32)[None, :] < lens[:, None]
+    return jnp.where(inb, ch, 0).astype(jnp.int32), lens
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _parse_float_kernel(data, offsets, maxw: int):
+    """Returns (value f64 [cap], parsed bool, malformed bool) — malformed
+    means non-empty and not matching the grammar."""
+    from spark_rapids_tpu.columnar import format as F
+
+    col = ColV(DataType.STRING, data, None, offsets)
+    ch, lens = _trimmed_window(col, maxw)
+    cap = lens.shape[0]
+    n = jnp.arange(maxw, dtype=jnp.int32)[None, :]
+    inb = n < lens[:, None]
+    lower = jnp.where((ch >= ord("A")) & (ch <= ord("Z")), ch + 32, ch)
+
+    def word_is(w: bytes, off):
+        m = off < lens  # word must fill the rest exactly
+        m = m & (lens - off == len(w))
+        for j, b in enumerate(w):
+            pos = jnp.clip(off + j, 0, maxw - 1)
+            cj = jnp.take_along_axis(lower, pos[:, None], axis=1)[:, 0]
+            m = m & ((off + j) < lens) & (cj == b)
+        return m
+
+    sign_ch = ch[:, 0]
+    signed = (sign_ch == _MINUS) | (sign_ch == _PLUS)
+    neg = sign_ch == _MINUS
+    body0 = signed.astype(jnp.int32)
+    is_inf = word_is(b"inf", body0) | word_is(b"infinity", body0)
+    is_nan = word_is(b"nan", body0)
+
+    # fully 2-D grammar analysis over [cap, maxw] — cumulative ops replace
+    # a per-position state machine (an unrolled 48-step scan compiles
+    # minutes; this graph compiles in seconds with identical semantics)
+    digits = ch - _ZERO
+    isdig = (digits >= 0) & (digits <= 9)
+    body = inb & (n >= body0[:, None])
+    isdot = body & (ch == _DOT)
+    emark_raw = body & ((ch == ord("e")) | (ch == ord("E")))
+    in_exp = jnp.cumsum(emark_raw.astype(jnp.int32), axis=1) > 0
+    # first 'e' position: where in_exp turns on
+    prev_in_exp = jnp.concatenate(
+        [jnp.zeros((cap, 1), bool), in_exp[:, :-1]], axis=1)
+    first_e = in_exp & ~prev_in_exp & emark_raw
+    mant = body & ~in_exp
+    mant_dig = mant & isdig
+    mdot = mant & isdot
+    ndots = jnp.sum(mdot.astype(jnp.int32), axis=1)
+    # at-or-after the first dot (the dot position itself is not a digit)
+    seen_dot = jnp.cumsum(mdot.astype(jnp.int32), axis=1) > 0
+    started = jnp.cumsum((mant_dig & (digits > 0)).astype(jnp.int32),
+                         axis=1) > 0
+    counted = mant_dig & started
+    crank = jnp.cumsum(counted.astype(jnp.int32), axis=1)
+    fold = mant_dig & (crank <= 17)
+    frank = jnp.cumsum(fold.astype(jnp.int32), axis=1)
+    nfold = frank[:, -1]
+    P10I64 = jnp.asarray([10 ** k for k in range(19)], dtype=jnp.int64)
+    mpow = P10I64[jnp.clip(nfold[:, None] - frank, 0, 18)]
+    m = jnp.sum(jnp.where(fold, digits.astype(jnp.int64) * mpow, 0),
+                axis=1)
+    scale = jnp.sum((fold & seen_dot).astype(jnp.int32), axis=1)
+    dropped_int = jnp.sum((mant_dig & ~seen_dot & (crank > 17))
+                          .astype(jnp.int32), axis=1)
+    ndig_mant = jnp.sum(mant_dig.astype(jnp.int32), axis=1)
+    # exponent part: optional sign right after 'e', then digits
+    exp_body = body & in_exp & ~first_e
+    e_pos = jnp.argmax(first_e, axis=1).astype(jnp.int32)
+    esign_pos = exp_body & (n == (e_pos + 1)[:, None]) & \
+        ((ch == _PLUS) | (ch == _MINUS))
+    exp_neg = jnp.any(esign_pos & (ch == _MINUS), axis=1)
+    exp_dig = exp_body & isdig
+    erank = jnp.cumsum(exp_dig.astype(jnp.int32), axis=1)
+    nde = erank[:, -1]
+    epow = P10I64[jnp.clip(nde[:, None] - erank, 0, 3)]
+    exp_val = jnp.sum(jnp.where(exp_dig & (nde[:, None] <= 3),
+                                digits.astype(jnp.int64) * epow, 0),
+                      axis=1).astype(jnp.int32)
+    ok_char = mant_dig | mdot | first_e | esign_pos | exp_dig
+    bad = jnp.any(body & ~ok_char, axis=1) | (ndots > 1)
+    has_exp_marker = jnp.any(first_e, axis=1)
+    grammar_ok = (~bad) & (ndig_mant > 0) & \
+        (~has_exp_marker | (nde >= 1)) & (nde <= 3) & \
+        (lens <= maxw) & (lens > body0)
+    q = jnp.where(exp_neg, -exp_val, exp_val) - scale + dropped_int
+    val = F.f64_scale(jnp, m.astype(jnp.float64),
+                      jnp.clip(q, -400, 400).astype(jnp.int64))
+    val = jnp.where(is_inf, jnp.inf, jnp.where(is_nan, jnp.nan, val))
+    val = jnp.where(neg, -val, val)
+    parsed = (grammar_ok | is_inf | is_nan) & (lens > 0)
+    # empty strings are NULL in non-ANSI mode but ERRORS under ANSI (the
+    # host mirror raises on '' too), so they count as malformed
+    malformed = ~parsed
+    return val, parsed, malformed
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _parse_timestamp_kernel(data, offsets, maxw: int):
+    """Returns (micros int64 [cap], parsed bool, malformed bool)."""
+    from spark_rapids_tpu.ops import datetimeops as DT
+
+    col = ColV(DataType.STRING, data, None, offsets)
+    ch, lens = _trimmed_window(col, maxw)
+    cap = lens.shape[0]
+    digits = ch - _ZERO
+    isdig = (digits >= 0) & (digits <= 9)
+
+    date_ok = lens >= 10
+    for i in (0, 1, 2, 3, 5, 6, 8, 9):
+        date_ok = date_ok & isdig[:, i]
+    date_ok = date_ok & (ch[:, 4] == _MINUS) & (ch[:, 7] == _MINUS)
+    y = (digits[:, 0] * 1000 + digits[:, 1] * 100
+         + digits[:, 2] * 10 + digits[:, 3])
+    mo = digits[:, 5] * 10 + digits[:, 6]
+    d = digits[:, 8] * 10 + digits[:, 9]
+    days = DT.days_from_civil(jnp, y, mo, d)
+    ry, rm, rd = DT.civil_from_days(jnp, days)
+    date_ok = date_ok & (ry == y) & (rm == mo) & (rd == d)
+
+    date_only = date_ok & (lens == 10)
+    has_time = date_ok & (lens >= 19)
+    time_ok = has_time
+    for i in (11, 12, 14, 15, 17, 18):
+        time_ok = time_ok & isdig[:, i]
+    sep = ch[:, 10]
+    time_ok = time_ok & ((sep == 0x20) | (sep == 0x54))  # ' ' | 'T'
+    time_ok = time_ok & (ch[:, 13] == 0x3A) & (ch[:, 16] == 0x3A)
+    hh = digits[:, 11] * 10 + digits[:, 12]
+    mi = digits[:, 14] * 10 + digits[:, 15]
+    ss = digits[:, 17] * 10 + digits[:, 18]
+    time_ok = time_ok & (hh < 24) & (mi < 60) & (ss < 60)
+
+    # optional fraction '.' + 1..6 digits
+    has_dot = time_ok & (lens > 19) & (ch[:, 19] == _DOT)
+    fd = jnp.zeros((cap,), jnp.int32)
+    going = has_dot
+    frac = jnp.zeros((cap,), jnp.int64)
+    for i in range(6):
+        p = 20 + i
+        going = going & (jnp.int32(p) < lens) & isdig[:, p]
+        fd = fd + going.astype(jnp.int32)
+        frac = jnp.where(going, frac * 10 + digits[:, p], frac)
+    frac_ok = ~has_dot | (fd >= 1)
+    p10 = jnp.asarray([10 ** k for k in range(7)], dtype=jnp.int64)
+    frac = frac * p10[jnp.clip(6 - fd, 0, 6)]
+
+    # optional zone: 'Z' or +-HH:MM
+    zstart = jnp.where(has_dot, 20 + fd, 19)
+    zlen = jnp.where(has_time, lens - zstart, 0)
+
+    def at(k):
+        pos = jnp.clip(zstart + k, 0, maxw - 1)
+        v = jnp.take_along_axis(ch, pos[:, None], axis=1)[:, 0]
+        return jnp.where(zstart + k < lens, v, 0)
+
+    def dg(k):
+        return at(k) - _ZERO
+
+    def isd(k):
+        v = dg(k)
+        return (v >= 0) & (v <= 9)
+
+    sign_ch = at(0)
+    zsigned = (sign_ch == _PLUS) | (sign_ch == _MINUS)
+    z_utc = (zlen == 1) & (at(0) == 0x5A)  # 'Z'
+    z_off = (zlen == 6) & zsigned & isd(1) & isd(2) & (at(3) == 0x3A) & \
+        isd(4) & isd(5)
+    zh = dg(1) * 10 + dg(2)
+    zm = dg(4) * 10 + dg(5)
+    z_off = z_off & (zh < 24) & (zm < 60)
+    off_min = jnp.where(z_off, zh * 60 + zm, 0)
+    off_min = jnp.where(z_off & (sign_ch == _MINUS), -off_min, off_min)
+    zone_ok = (zlen == 0) | z_utc | z_off
+
+    full_ok = time_ok & frac_ok & zone_ok
+    parsed = (date_only | full_ok) & (lens > 0)
+    micros = days.astype(jnp.int64) * 86_400_000_000
+    micros = micros + jnp.where(
+        full_ok,
+        (hh.astype(jnp.int64) * 3600 + mi * 60 + ss) * 1_000_000 + frac
+        - off_min.astype(jnp.int64) * 60_000_000, 0)
+    # empty strings flag as malformed (ANSI parity with the host mirror)
+    malformed = ~parsed
+    return jnp.where(parsed, micros, 0), parsed, malformed
+
+
+def parse_float_col(ctx, v: ColV, to: DataType):
+    """STRING -> FLOAT32/FLOAT64 on device (conf castStringToFloat)."""
+    val, parsed, malformed = _parse_float_kernel(v.data, v.offsets,
+                                                 MAXW_FLOAT)
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    npdt = physical_np_dtype(to)
+    if np.dtype(npdt) != np.dtype(np.float64):
+        # convention: FLOAT32 results below the smallest normal f32 AFTER
+        # rounding flush to (signed) zero — XLA backends flush f32
+        # subnormals anyway, and the host mirror applies the same
+        # round-then-check order so both engines agree even for f64
+        # values that round UP to the smallest normal
+        v32 = val.astype(npdt)
+        tiny = jnp.abs(v32) < np.dtype(npdt).type(2.0 ** -126)
+        val = jnp.where(tiny, jnp.copysign(0.0, val).astype(npdt), v32)
+    validity = parsed & v.validity
+    return ColV(to, jnp.where(validity, val, val.dtype.type(0)), validity), \
+        malformed & v.validity
+
+
+def parse_timestamp_col(ctx, v: ColV):
+    """STRING -> TIMESTAMP on device (conf castStringToTimestamp)."""
+    val, parsed, malformed = _parse_timestamp_kernel(v.data, v.offsets,
+                                                     MAXW_TS)
+    validity = parsed & v.validity
+    return ColV(DataType.TIMESTAMP, jnp.where(validity, val, 0), validity), \
+        malformed & v.validity

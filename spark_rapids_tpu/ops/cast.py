@@ -34,7 +34,9 @@ class Cast(UnaryExpression):
         return self.to_type
 
     def _fingerprint_extra(self):
-        return f"->{self.to_type.name};"
+        # ansi changes compiled behavior (deferred error flags), so it must
+        # key the jit cache
+        return f"->{self.to_type.name};ansi={int(self.ansi)};"
 
     def result_vrange(self, v):
         """Integral widening/identity casts preserve the child's value
@@ -227,9 +229,19 @@ class Cast(UnaryExpression):
             return F.date_to_string(ctx, v)
         if frm is DataType.TIMESTAMP:
             return F.timestamp_to_string(ctx, v)
+        if frm.is_floating:
+            # planner admits this direction only when
+            # rapids.tpu.sql.castFloatToString.enabled is set AND the
+            # backend carries real f64 lanes (the shared shortest-decimal
+            # search runs in f64; overrides.py:_tag_cast)
+            return F.float_to_string(ctx, v)
         raise NotImplementedError(f"device cast {frm} -> STRING")
 
     def _to_string_host(self, ctx, v, frm):
+        if frm.is_floating:
+            return format_float_array(np.asarray(v.data),
+                                      frm is DataType.FLOAT32)
+
         def fmt(x):
             if is_decimal(frm):
                 return str(DU.from_unscaled(int(x), frm.scale))
@@ -241,16 +253,30 @@ class Cast(UnaryExpression):
                 return _date_str(int(x))
             if frm is DataType.TIMESTAMP:
                 return _ts_str(int(x))
-            if frm.is_floating:
-                return _spark_float_str(float(x))
             raise NotImplementedError(f"cast {frm} -> STRING")
 
         return np.array([fmt(x) for x in v.data], dtype=object)
 
-    # -- from string (CPU only in round 1) -----------------------------------
+    # -- from string ---------------------------------------------------------
     def _from_string(self, ctx, v, to):
         if ctx.is_device:
-            raise NotImplementedError("device cast STRING -> x (round 2)")
+            from spark_rapids_tpu.columnar import parse as PRS
+
+            if to.is_floating:
+                out, malformed = PRS.parse_float_col(ctx, v, to)
+            elif to is DataType.TIMESTAMP:
+                out, malformed = PRS.parse_timestamp_col(ctx, v)
+            else:
+                raise NotImplementedError(f"device cast STRING -> {to}")
+            if self.ansi:
+                import jax.numpy as jnp
+
+                # deferred ANSI error: can't raise mid-trace; the evaluator
+                # entry point checks the flag after the jitted call
+                ctx.ansi_errors.append((
+                    jnp.any(malformed),
+                    f"ANSI cast STRING -> {to.name}: malformed input"))
+            return out
         out = np.zeros(len(v.data), dtype=to.to_np())
         validity = v.validity.copy()
         for i, s in enumerate(v.data):
@@ -266,7 +292,7 @@ class Cast(UnaryExpression):
                 elif to.is_integral:
                     out[i] = int(float(s)) if "." in s or "e" in s.lower() else int(s)
                 elif to.is_floating:
-                    out[i] = float(s)
+                    out[i] = _parse_float_text(s)
                 elif to is DataType.BOOL:
                     low = s.lower()
                     if low in ("t", "true", "y", "yes", "1"):
@@ -278,7 +304,7 @@ class Cast(UnaryExpression):
                 elif to is DataType.DATE:
                     out[i] = _parse_date(s)
                 elif to is DataType.TIMESTAMP:
-                    out[i] = _parse_ts(s)
+                    out[i] = _parse_ts_strict(s)
                 else:
                     raise NotImplementedError(f"cast STRING -> {to}")
             except (ValueError, OverflowError, ArithmeticError):
@@ -286,6 +312,11 @@ class Cast(UnaryExpression):
                     raise
                 validity[i] = False
                 out[i] = 0
+        if to is DataType.FLOAT32:
+            # shared convention with the device parse kernel: sub-normal
+            # f32 results flush to signed zero (columnar/parse.py)
+            tiny = np.isfinite(out) & (np.abs(out) < 2.0 ** -126)
+            out[tiny] = np.copysign(np.float32(0.0), out[tiny])
         return ColV(to, out, validity & v.validity)
 
 def _date_str(days: int) -> str:
@@ -333,6 +364,92 @@ def _parse_date(s: str) -> int:
     return (datetime.date.fromisoformat(s) - datetime.date(1970, 1, 1)).days
 
 
+import re as _re
+
+_FLOAT_RE = _re.compile(
+    r"^[+-]?(?:(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][+-]?\d{1,3})?|"
+    r"(?i:inf|infinity|nan))$")
+_TS_RE = _re.compile(
+    r"^(\d{4})-(\d{2})-(\d{2})"
+    r"(?:[ T](\d{2}):(\d{2}):(\d{2})(?:\.(\d{1,6}))?"
+    r"(Z|[+-]\d{2}:\d{2})?)?$")
+
+
+def _parse_float_text(s: str) -> float:
+    """Host mirror of the device STRING->float kernel
+    (columnar/parse.py:_parse_float_kernel): same grammar, same 17-digit
+    mantissa fold, same shared-table scaling — values agree bitwise with
+    the device (raises ValueError on grammar violations)."""
+    from spark_rapids_tpu.columnar import format as F
+
+    if len(s) > 48 or not _FLOAT_RE.match(s):
+        raise ValueError(s)
+    low = s.lstrip("+-").lower()
+    negv = s.startswith("-")
+    if low in ("inf", "infinity"):
+        return -np.inf if negv else np.inf
+    if low == "nan":
+        return np.nan
+    mant, _, ex = low.partition("e")
+    ipart, _, fpart = mant.partition(".")
+    m = 0
+    nsig = 0
+    dropped_int = 0
+    scale = 0
+    for d in ipart:
+        if nsig < 17:
+            m = m * 10 + int(d)
+            if m > 0:
+                nsig += 1
+        else:
+            dropped_int += 1
+    for d in fpart:
+        if nsig < 17:
+            m = m * 10 + int(d)
+            scale += 1
+            if m > 0:
+                nsig += 1
+    q = (int(ex) if ex else 0) - scale + dropped_int
+    val = float(F.f64_scale(np, np.float64(m),
+                            np.int64(max(-400, min(400, q)))))
+    return -val if negv else val
+
+
+def _parse_ts_strict(s: str) -> int:
+    """Host mirror of the device STRING->TIMESTAMP kernel
+    (columnar/parse.py:_parse_timestamp_kernel): strict 'YYYY-MM-DD' /
+    'YYYY-MM-DD[ T]HH:MM:SS[.f{1,6}][Z|+-HH:MM]' grammar, naive = UTC,
+    integer epoch math (raises ValueError on violations)."""
+    mt = _TS_RE.match(s)
+    if not mt:
+        raise ValueError(s)
+    from spark_rapids_tpu.ops import datetimeops as DT
+
+    y, mo, d = int(mt.group(1)), int(mt.group(2)), int(mt.group(3))
+    days = int(DT.days_from_civil(np, np.int64(y), np.int64(mo),
+                                  np.int64(d)))
+    ry, rm, rd = DT.civil_from_days(np, np.int64(days))
+    if (int(ry), int(rm), int(rd)) != (y, mo, d):
+        raise ValueError(s)
+    micros = days * 86_400_000_000
+    if mt.group(4) is not None:
+        hh, mi, ss = int(mt.group(4)), int(mt.group(5)), int(mt.group(6))
+        if hh >= 24 or mi >= 60 or ss >= 60:
+            raise ValueError(s)
+        frac = (mt.group(7) or "").ljust(6, "0")
+        micros += (hh * 3600 + mi * 60 + ss) * MICROS_PER_SEC + int(frac)
+        z = mt.group(8)
+        if z and z != "Z":
+            zh, zm = int(z[1:3]), int(z[4:6])
+            if zh >= 24 or zm >= 60:
+                raise ValueError(s)
+            off = zh * 60 + zm
+            if z[0] == "-":
+                off = -off
+            micros -= off * 60_000_000
+    return micros
+
+
 def _parse_ts(s: str) -> int:
     import datetime
 
@@ -343,12 +460,56 @@ def _parse_ts(s: str) -> int:
     return (delta.days * 86_400 + delta.seconds) * MICROS_PER_SEC + delta.microseconds
 
 
-def _spark_float_str(x: float) -> str:
-    """Java Double.toString-ish (Spark formatting): 1.0 not 1, NaN, Infinity."""
-    if np.isnan(x):
-        return "NaN"
-    if np.isinf(x):
-        return "Infinity" if x > 0 else "-Infinity"
-    if x == int(x) and abs(x) < 1e16:
-        return f"{x:.1f}"
-    return repr(x)
+def _emit_float_digits(m: int, p: int, e10: int, neg: bool) -> str:
+    """Render a (mantissa, precision, exponent) decomposition Java-style:
+    plain decimal for -3 <= e10 < 7, else 'd.dddE[-]ee'. Pure integer
+    logic — the device emitter (columnar/format.py float_to_string)
+    implements the identical placement rules, so given identical
+    decompositions the bytes are identical."""
+    digs = str(m).rjust(p, "0")
+    sign = "-" if neg else ""
+    if -3 <= e10 < 7:
+        if e10 >= p - 1:
+            body = digs + "0" * (e10 - p + 1) + ".0"
+        elif e10 >= 0:
+            body = digs[:e10 + 1] + "." + digs[e10 + 1:]
+        else:
+            body = "0." + "0" * (-e10 - 1) + digs
+        return sign + body
+    frac = digs[1:] if p > 1 else "0"
+    return f"{sign}{digs[0]}.{frac}E{e10}"
+
+
+def format_float_array(vals: np.ndarray, is32: bool) -> np.ndarray:
+    """Host float->string with the SAME shortest-round-trip algorithm as
+    the device kernel (shared core shortest_float_decomposition run with
+    xp=numpy): the framework's float formatting convention. Replaces the
+    earlier repr()-based formatter so host and device agree bytewise."""
+    from spark_rapids_tpu.columnar import format as F
+
+    x = np.ascontiguousarray(vals,
+                             dtype=np.float32 if is32 else np.float64)
+    f64 = x.astype(np.float64)
+    a = np.abs(f64)
+    nan = np.isnan(f64)
+    inf = np.isinf(f64)
+    zero = a == 0.0
+    neg = np.signbit(f64)
+    finite = ~(nan | inf | zero)
+    with np.errstate(over="ignore", invalid="ignore"):
+        m, p, e10 = F.shortest_float_decomposition(
+            np, np.where(finite, a, 1.0), 9 if is32 else 17, is32=is32)
+    out = np.empty(len(x), dtype=object)
+    for i in range(len(x)):
+        if nan[i]:
+            out[i] = "NaN"
+        elif inf[i]:
+            out[i] = "-Infinity" if neg[i] else "Infinity"
+        elif zero[i]:
+            out[i] = "-0.0" if neg[i] else "0.0"
+        else:
+            out[i] = _emit_float_digits(int(m[i]), int(p[i]), int(e10[i]),
+                                        bool(neg[i]))
+    return out
+
+

@@ -107,6 +107,8 @@ class DeviceProjector:
         key = ("project", tuple(e.fingerprint() for e in exprs))
 
         def build():
+            msgs: List[str] = []
+
             def fn(cols: List[ColV], num_rows, partition_id, row_start):
                 capacity = cols[0].validity.shape[0] if cols else 8
                 ctx = EvalContext(jnp, True, cols, num_rows, capacity,
@@ -118,9 +120,13 @@ class DeviceProjector:
                     if isinstance(r, ScalarV):
                         r = _scalar_to_colv(ctx, r, e.data_type)
                     outs.append(_widen_physical(r))
-                return outs
+                # deferred ANSI flags surface as extra outputs; messages are
+                # trace-static and rebuilt on every (re)trace
+                del msgs[:]
+                msgs.extend(m for _, m in ctx.ansi_errors)
+                return outs, [f for f, _ in ctx.ansi_errors]
 
-            return jax.jit(fn)
+            return jax.jit(fn), msgs
 
         return get_or_build(key, build)
 
@@ -128,6 +134,7 @@ class DeviceProjector:
                 row_start: int = 0) -> ColumnarBatch:
         if self._jitted is None:
             self._jitted = self._build()
+        jitted, msgs = self._jitted
         cols = [_col_to_colv(c) for c in batch.columns]
         if not cols:
             # zero-column input (e.g. COUNT(*) over bare scan): evaluate with a
@@ -139,8 +146,13 @@ class DeviceProjector:
                          jnp.zeros((cap,), dtype=bool),
                          jnp.arange(cap) < batch.num_rows)]
         n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
-        outs = self._jitted(cols, n, jnp.int32(partition_id),
-                            jnp.int64(row_start))
+        outs, flags = jitted(cols, n, jnp.int32(partition_id),
+                             jnp.int64(row_start))
+        if flags:
+            got = jax.device_get(flags)
+            for v, m in zip(got, msgs):
+                if bool(v):
+                    raise ValueError(m)
         return ColumnarBatch([_colv_to_col(o) for o in outs], batch.num_rows)
 
 
@@ -159,6 +171,8 @@ class DeviceFilter:
         key = ("filter", cond.fingerprint())
 
         def build():
+            msgs = []
+
             def fn(cols, num_rows, partition_id, row_start):
                 capacity = cols[0].validity.shape[0]
                 ctx = EvalContext(jnp, True, cols, num_rows, capacity,
@@ -170,9 +184,11 @@ class DeviceFilter:
                                     (not r.is_null) and bool(r.value))
                 else:
                     keep = r.data.astype(bool) & r.validity  # null -> dropped
-                return keep & ctx.row_mask()
+                del msgs[:]
+                msgs.extend(m for _, m in ctx.ansi_errors)
+                return keep & ctx.row_mask(), [f for f, _ in ctx.ansi_errors]
 
-            return jax.jit(fn)
+            return jax.jit(fn), msgs
 
         return get_or_build(key, build)
 
@@ -182,9 +198,16 @@ class DeviceFilter:
 
         if self._jitted is None:
             self._jitted = self._build()
+        jitted, msgs = self._jitted
         cols = [_col_to_colv(c) for c in batch.columns]
-        keep = self._jitted(cols, jnp.int32(batch.num_rows),
-                            jnp.int32(partition_id), jnp.int64(row_start))
+        keep, flags = jitted(cols, jnp.int32(batch.num_rows),
+                             jnp.int32(partition_id),
+                             jnp.int64(row_start))
+        if flags:
+            got = jax.device_get(flags)
+            for v, m in zip(got, msgs):
+                if bool(v):
+                    raise ValueError(m)
         return compact_batch(batch, keep)
 
 

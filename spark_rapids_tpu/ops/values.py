@@ -62,11 +62,17 @@ class EvalContext:
 
     __slots__ = (
         "xp", "is_device", "columns", "num_rows", "capacity",
-        "partition_id", "rng_seed", "row_start", "narrow",
+        "partition_id", "rng_seed", "row_start", "narrow", "ansi_errors",
     )
 
     def __init__(self, xp, is_device, columns, num_rows, capacity,
                  partition_id=0, rng_seed=0, row_start=0, narrow=True):
+        # deferred ANSI error channel: device ops can't raise mid-trace, so
+        # they append (device bool scalar, message) here and the evaluator
+        # entry point (DeviceProjector/DeviceFilter) checks the flags after
+        # the jitted call returns — one batched host read, zero cost when
+        # no ANSI op is present
+        self.ansi_errors = []
         self.xp = xp
         self.is_device = is_device
         # narrow=False turns int32 narrowing off for the WHOLE kernel:

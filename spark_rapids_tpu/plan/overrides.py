@@ -243,14 +243,58 @@ def _tag_cast(m: ExprMeta) -> None:
     src = e.child.data_type
     dst = e.to_type
     if not Cast.device_supported(src, dst):
-        # directions with no device kernel (string->numeric parse,
-        # float/decimal->string formatting) run on the CPU engine — the
+        # conf-gated directions with device kernels (the reference's
+        # GpuCast per-direction compat gates, RapidsConf.scala:393-425):
+        # float->string and string->float need real f64 lanes (their
+        # shared shortest-decimal / parse arithmetic runs in f64);
+        # string->timestamp is pure integer work.
+        from spark_rapids_tpu.columnar.batch import device_float64_supported
+
+        if src.is_floating and dst is DataType.STRING:
+            if not m.conf.get(C.ENABLE_CAST_FLOAT_TO_STRING):
+                m.will_not_work(
+                    "cast float->STRING on device is disabled by default "
+                    "(set rapids.tpu.sql.castFloatToString.enabled; output "
+                    "follows this framework's shortest-round-trip "
+                    "convention, not Java's)")
+            elif not device_float64_supported():
+                m.will_not_work(
+                    "cast float->STRING device kernel needs an f64-capable "
+                    "backend (shortest-decimal search runs in f64)")
+            return
+        if src is DataType.STRING and dst.is_floating:
+            if not m.conf.get(C.ENABLE_CAST_STRING_TO_FLOAT):
+                m.will_not_work(
+                    "cast STRING->float on device is disabled by default "
+                    "(set rapids.tpu.sql.castStringToFloat.enabled)")
+            elif not device_float64_supported():
+                m.will_not_work(
+                    "cast STRING->float device kernel needs an f64-capable "
+                    "backend")
+            elif e.ansi:
+                # the deferred ANSI error channel only drains at
+                # project/filter boundaries; in any other position the
+                # flag would be silently dropped — keep ANSI parses on
+                # the CPU engine, which raises in place
+                m.will_not_work("ANSI STRING->float cast runs on the CPU "
+                                "engine (deferred device errors only "
+                                "surface at project/filter boundaries)")
+            return
+        if src is DataType.STRING and dst is DataType.TIMESTAMP:
+            if not m.conf.get(C.ENABLE_CAST_STRING_TO_TIMESTAMP):
+                m.will_not_work(
+                    "cast STRING->TIMESTAMP on device is disabled by "
+                    "default (set "
+                    "rapids.tpu.sql.castStringToTimestamp.enabled)")
+            elif e.ansi:
+                m.will_not_work("ANSI STRING->TIMESTAMP cast runs on the "
+                                "CPU engine (deferred device errors only "
+                                "surface at project/filter boundaries)")
+            return
+        # directions with no device kernel (string->int parse,
+        # decimal->string formatting, ...) run on the CPU engine — the
         # reference likewise tags unsupported cast directions for fallback
         # (GpuCast.scala per-direction gates, RapidsConf.scala:393-425).
-        # The castFloatToString/castStringToFloat/castStringToTimestamp
-        # conf keys are registered for reference parity but currently
-        # cannot enable anything: those directions are all in this bucket
-        # until their device kernels land (conf.py notes the same).
         m.will_not_work(
             f"cast {getattr(src, 'name', src)}->{getattr(dst, 'name', dst)} "
             "has no device kernel")

@@ -1,0 +1,161 @@
+"""Device string-cast equivalence: float->string, string->float,
+string->timestamp (reference: GpuCast.scala:79-181 conf-gated directions;
+CastOpSuite). Host and device implement the SAME algorithm (shared power
+table + operation sequence, columnar/format.py / columnar/parse.py vs
+ops/cast.py mirrors), so comparisons are exact, not approximate."""
+
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops import cast as CA
+from spark_rapids_tpu.ops.base import BoundReference
+
+from tests.test_expressions import check_exprs, make_batch
+
+
+def ref(i, dt):
+    return BoundReference(i, dt)
+
+
+# ---------------------------------------------------------------- to string
+def test_cast_double_to_string_basics():
+    vals = [0.0, -0.0, 1.5, -1.5, 0.1, 123456.789, 1e20, 1.23e-7,
+            9999999.0, 1e7, 1e-3, 1e-4, float("nan"), float("inf"),
+            float("-inf"), None, 3.141592653589793]
+    bt = make_batch(a=(vals, DataType.FLOAT64))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.FLOAT64), DataType.STRING)])
+
+
+def test_cast_float32_to_string_basics():
+    vals = [0.1, -2.5, 3.4028235e38, 1.1754944e-38, 1e-45, None, 0.0,
+            float("nan"), 7.0, 1e10]
+    bt = make_batch(a=(vals, DataType.FLOAT32))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.FLOAT32), DataType.STRING)])
+
+
+def test_cast_float_to_string_fuzz_round_trip():
+    rng = np.random.default_rng(11)
+    vals = np.concatenate([
+        rng.random(200), rng.random(200) * 1e14, rng.random(200) * 1e-6,
+        rng.normal(0, 1e8, 200), rng.random(100) * 1e300,
+        rng.random(100) * 1e-300,
+    ])
+    bt = make_batch(a=(list(vals), DataType.FLOAT64))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.FLOAT64), DataType.STRING)])
+    # the convention guarantees parse-back for normal doubles
+    from spark_rapids_tpu.ops.cast import format_float_array
+
+    for v, s in zip(vals, format_float_array(vals, False)):
+        assert float(s) == v, (v, s)
+
+
+def test_cast_float32_to_string_fuzz():
+    rng = np.random.default_rng(12)
+    vals = np.concatenate([
+        rng.random(300), rng.random(200) * 1e30, rng.random(200) * 1e-30,
+        rng.random(100) * 1e-43,
+    ]).astype(np.float32)
+    bt = make_batch(a=(list(vals), DataType.FLOAT32))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.FLOAT32), DataType.STRING)])
+    from spark_rapids_tpu.ops.cast import format_float_array
+
+    for v, s in zip(vals, format_float_array(vals, True)):
+        assert np.float32(float(s)) == v, (v, s)
+
+
+# -------------------------------------------------------------- from string
+def test_cast_string_to_double():
+    vals = ["1.5", "-2.25", "  3.75  ", "1e3", "1E-3", "+4", "0.001",
+            ".5", "5.", "inf", "-Infinity", "NaN", "", None, "abc",
+            "1e", "--1", "1.2.3", "1e999", "1e-999",
+            "0.12345678901234567890123",  # >17 sig digits
+            "123456789012345678901"]
+    bt = make_batch(a=(vals, DataType.STRING))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.STRING), DataType.FLOAT64)])
+
+
+def test_cast_string_to_float32():
+    vals = ["1.5", "3.4e38", "1e-45", "bad", None, "7", "-0.0"]
+    bt = make_batch(a=(vals, DataType.STRING))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.STRING), DataType.FLOAT32)])
+
+
+def test_cast_string_to_float_fuzz():
+    rng = np.random.default_rng(13)
+    vals = []
+    for _ in range(400):
+        kind = rng.integers(0, 6)
+        if kind == 0:
+            vals.append(str(rng.normal(0, 1e6)))
+        elif kind == 1:
+            vals.append(f"{rng.random():.12f}")
+        elif kind == 2:
+            vals.append(f"{rng.random()}e{rng.integers(-40, 40)}")
+        elif kind == 3:
+            vals.append("".join(rng.choice(list("0123456789.eE+-x"))
+                                for _ in range(rng.integers(1, 12))))
+        elif kind == 4:
+            vals.append(rng.choice(["inf", "-inf", "NAN", "Infinity", ""]))
+        else:
+            vals.append(str(rng.integers(-10**12, 10**12)))
+    bt = make_batch(a=(vals, DataType.STRING))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.STRING), DataType.FLOAT64)])
+
+
+def test_cast_string_to_timestamp():
+    vals = ["2020-01-01", "2020-01-01 12:34:56", "2020-01-01T12:34:56",
+            "2020-01-01 12:34:56.123", "2020-01-01 12:34:56.123456",
+            "2020-01-01 12:34:56Z", "2020-01-01 12:34:56+05:30",
+            "2020-01-01 12:34:56.5-08:00", "2020-02-30", "2020-13-01",
+            "2020-01-01 24:00:00", "2020-01-01 12:34", "garbage", "",
+            None, "1969-12-31 23:59:59.999999", "9999-12-31 23:59:59",
+            "  2020-06-15 01:02:03  "]
+    bt = make_batch(a=(vals, DataType.STRING))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.STRING), DataType.TIMESTAMP)])
+
+
+def test_cast_string_to_timestamp_fuzz():
+    rng = np.random.default_rng(14)
+    vals = []
+    for _ in range(300):
+        y, mo, d = rng.integers(1, 3000), rng.integers(0, 14), \
+            rng.integers(0, 33)
+        hh, mi, ss = rng.integers(0, 25), rng.integers(0, 61), \
+            rng.integers(0, 61)
+        sep = rng.choice([" ", "T"])
+        frac = rng.choice(["", f".{rng.integers(0, 10**6)}"])
+        zone = rng.choice(["", "Z", "+05:30", "-11:45"])
+        vals.append(f"{y:04d}-{mo:02d}-{d:02d}{sep}"
+                    f"{hh:02d}:{mi:02d}:{ss:02d}{frac}{zone}")
+    bt = make_batch(a=(vals, DataType.STRING))
+    check_exprs(bt, [CA.Cast(ref(0, DataType.STRING), DataType.TIMESTAMP)])
+
+
+def test_ansi_string_to_float_raises_both_engines():
+    from spark_rapids_tpu.ops.eval import DeviceProjector, cpu_project
+
+    bt = make_batch(a=(["1.5", "bogus"], DataType.STRING))
+    expr = CA.Cast(ref(0, DataType.STRING), DataType.FLOAT64, ansi=True)
+    with pytest.raises(ValueError):
+        cpu_project([expr], bt)
+    with pytest.raises(ValueError):
+        DeviceProjector([expr]).project(bt.to_device()).to_host()
+
+
+def test_planner_gates_by_conf():
+    """The three directions fall back unless their conf key is set
+    (reference: per-direction gates RapidsConf.scala:393-425)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.plan import functions as Fn
+
+    session = srt.new_session()
+    df = session.createDataFrame({"s": ["1.5", "2.5"]})
+    q = df.select(df["s"].cast(DataType.FLOAT64).alias("f"))
+    session.conf.set("rapids.tpu.sql.castStringToFloat.enabled", False)
+    explain = session.explain_plan(q._plan)
+    assert "castStringToFloat" in explain
+    session.conf.set("rapids.tpu.sql.castStringToFloat.enabled", True)
+    assert [r[0] for r in q.collect()] == [1.5, 2.5]

@@ -258,12 +258,13 @@ PARQUET_WRITE_ENABLED = _conf("rapids.tpu.sql.format.parquet.write.enabled").boo
 PARQUET_DEVICE_ENCODE = _conf(
     "rapids.tpu.sql.format.parquet.deviceEncode.enabled").doc(
     "Encode parquet ON the device (reference encodes on the accelerator, "
-    "ColumnarOutputWriter.scala:62-177): non-null values compact and "
-    "validity bit-packs in one jitted kernel per column, and only the "
-    "encoded PLAIN page payload downloads. Applies to fixed-width schemas "
-    "written with an explicit compression=none and no partitionBy; "
-    "everything else (including the snappy default) uses the host Arrow "
-    "writer."
+    "ColumnarOutputWriter.scala:62-177): non-null values compact (strings "
+    "via a length-prefixing byte gather, booleans bit-pack) and validity "
+    "bit-packs in jitted kernels per column; only the encoded PLAIN page "
+    "payload downloads, then the host block-compresses pages "
+    "(none/snappy/gzip/zstd — the mirror of the decode split). Applies "
+    "to flat schemas (incl. the snappy DEFAULT write) without "
+    "partitionBy; other codecs/nested types use the host Arrow writer."
 ).boolean(True)
 CSV_READ_ENABLED = _conf("rapids.tpu.sql.format.csv.read.enabled").boolean(True)
 CSV_DEVICE_PARSE = _conf(
@@ -271,10 +272,11 @@ CSV_DEVICE_PARSE = _conf(
     "Parse eligible CSV columns ON the device: the host finds field "
     "boundaries in one vectorized pass (quote-aware), raw bytes + offsets "
     "upload once, and jitted kernels fold the values — integers, floats, "
-    "strings, dates, and zoned timestamps, including quoted fields "
-    "(reference parses CSV on the accelerator the same way, "
-    "GpuBatchScanExec.scala:474-502). Ragged files and fields using "
-    "escaped \"\" quotes fall back to the host Arrow parser."
+    "strings, dates, and zoned timestamps, including quoted fields and "
+    "escaped \"\" quotes (unescaped in the host control plane before "
+    "upload; reference parses CSV on the accelerator the same way, "
+    "GpuBatchScanExec.scala:474-502). Ragged files fall back to the host "
+    "Arrow parser."
 ).boolean(True)
 CSV_DEVICE_MAX_SPLIT_BYTES = _conf(
     "rapids.tpu.sql.format.csv.deviceParse.maxSplitBytes").doc(
@@ -291,12 +293,13 @@ ORC_DEVICE_DECODE = _conf(
     "Decode eligible ORC columns ON the device: the host walks the "
     "protobuf metadata and RLEv2/byte-RLE run headers (all four RLEv2 "
     "sub-encodings incl. PATCHED_BASE, widths <= 56 bits), raw stripe "
-    "bytes upload once (zlib/snappy blocks host-decompressed first), and "
-    "jitted kernels expand the runs — integers, strings (DIRECT_V2 + "
-    "DICTIONARY_V2), floats, timestamps, and booleans — the reference "
-    "decodes ORC on the accelerator the same way (GpuOrcScan.scala:"
-    "284,709). Other codecs (zstd/lz4) and nested types fall back to the "
-    "host Arrow reader."
+    "bytes upload once (zlib/snappy/zstd blocks host-decompressed "
+    "first), and jitted kernels expand the runs — integers, strings "
+    "(DIRECT_V2 + DICTIONARY_V2), floats, timestamps, and booleans — the "
+    "reference decodes ORC on the accelerator the same way "
+    "(GpuOrcScan.scala:284,709). LZO/LZ4 (no per-block decompressed size "
+    "for Arrow's raw codec) and nested types fall back to the host Arrow "
+    "reader."
 ).boolean(True)
 ORC_WRITE_ENABLED = _conf("rapids.tpu.sql.format.orc.write.enabled").boolean(True)
 ORC_DEVICE_ENCODE = _conf(
@@ -304,10 +307,11 @@ ORC_DEVICE_ENCODE = _conf(
     "Encode ORC ON the device (reference encodes on the accelerator, "
     "GpuOrcFileFormat.scala / ColumnarOutputWriter.scala:62-177): "
     "non-null values compact, zigzag-encode and bit-pack into the RLEv2 "
-    "DIRECT payload in jitted kernels per column, and only the encoded "
-    "stream payload downloads. Applies to flat int/date schemas written "
-    "uncompressed without partitionBy; everything else uses the host "
-    "Arrow writer."
+    "DIRECT payload (strings via a byte gather + RLEv2 LENGTH stream, "
+    "floats/bools as raw/bit streams) in jitted kernels per column; only "
+    "the encoded stream payload downloads, then the host block-compresses "
+    "in ORC framing (none/zlib/snappy). Applies to flat schemas without "
+    "partitionBy; decimal/nested types use the host Arrow writer."
 ).boolean(True)
 
 ENABLE_FLOAT_AGG = _conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(
@@ -326,20 +330,34 @@ ENABLE_INT64_NARROWING = _conf("rapids.tpu.sql.int64.narrowing.enabled").doc(
     "width where exactness is provable."
 ).boolean(True)
 
-_CAST_KEY_DOC = (
-    "Reserved for reference parity (spark.rapids.sql.%s): this cast "
-    "direction currently has no device kernel, so the expression falls "
-    "back to the CPU engine regardless of this setting."
-)
 ENABLE_CAST_FLOAT_TO_STRING = _conf(
     "rapids.tpu.sql.castFloatToString.enabled").doc(
-    _CAST_KEY_DOC % "castFloatToString.enabled").boolean(False)
+    "Enable the device float->STRING cast (reference: "
+    "spark.rapids.sql.castFloatToString.enabled). Output follows this "
+    "framework's shortest-round-trip convention (Java-style notation; "
+    "exact for all normal doubles and every float32; subnormal doubles "
+    "may differ in the last digit), NOT Java's Ryu output — the "
+    "reference marks the direction incompatible for the same reason. "
+    "Needs an f64-capable backend; otherwise the cast stays on the CPU "
+    "engine.").boolean(False)
 ENABLE_CAST_STRING_TO_FLOAT = _conf(
     "rapids.tpu.sql.castStringToFloat.enabled").doc(
-    _CAST_KEY_DOC % "castStringToFloat.enabled").boolean(False)
+    "Enable the device STRING->float cast (reference: "
+    "spark.rapids.sql.castStringToFloat.enabled). Grammar: optional "
+    "sign, decimal with optional <=3-digit exponent, inf/infinity/nan "
+    "(case-insensitive), <=48 chars after trim; the first 17 significant "
+    "digits are exact, further digits only shift the exponent. "
+    "Unparseable strings are NULL (ANSI: error). Host and device "
+    "produce bit-identical values. Needs an f64-capable "
+    "backend.").boolean(False)
 ENABLE_CAST_STRING_TO_TIMESTAMP = _conf(
     "rapids.tpu.sql.castStringToTimestamp.enabled").doc(
-    _CAST_KEY_DOC % "castStringToTimestamp.enabled").boolean(False)
+    "Enable the device STRING->TIMESTAMP cast (reference: "
+    "spark.rapids.sql.castStringToTimestamp.enabled). Grammar: "
+    "'YYYY-MM-DD' or 'YYYY-MM-DD[ T]HH:MM:SS[.f{1,6}][Z|+-HH:MM]' "
+    "after trim; naive timestamps are UTC; invalid civil dates are "
+    "NULL (ANSI: error). Pure integer math — exact on every "
+    "backend.").boolean(False)
 
 IMPROVED_TIME_OPS = _conf("rapids.tpu.sql.improvedTimeOps.enabled").doc(
     "Enable datetime ops whose range/overflow behavior differs slightly from CPU "

@@ -22,7 +22,8 @@ fractional digits, so the single f64 division is correctly rounded and
 bit-identical to the host parser; exponents/inf/nan take the host path).
 Quoted fields are handled
 structurally (quote-aware boundary scan + quote stripping; escaped ""
-falls back). Regular column count per line. Empty fields are NULL
+unescapes via a host control-plane rewrite before upload). Regular
+column count per line. Empty fields are NULL
 (pyarrow's strings_can_be_null oracle behavior); malformed digits abandon
 the device path for the split so both engines behave identically.
 """
@@ -173,15 +174,37 @@ def _plan_fields_quoted(data: bytes, ncols: int, sep_b: int):
     last_q[nz] = arr[np.clip(fs[nz] + fl[nz] - 1, 0,
                              len(arr) - 1)] == _QUOTE
     quoted = first_q & last_q
-    # per-field quote counts must be exactly 2 (quoted) or 0 (bare):
-    # cum-count difference per field span
+    # escaped "" pairs inside quoted fields: the first quote of a pair is
+    # seen while the pre-state is INSIDE (the toggle math already kept
+    # boundaries correct across the zero-width out-in flip)
+    pre_inside = inside
+    nxt_q = np.zeros_like(is_q)
+    nxt_q[:-1] = is_q[1:]
+    pair_first = is_q & nxt_q & pre_inside
+    # per-field quote / escape-pair counts via cum-count differences
     qcum = np.concatenate(([0], np.cumsum(is_q)))
-    qcnt = qcum[np.clip(fs + fl, 0, len(arr))] - qcum[np.clip(fs, 0,
-                                                              len(arr))]
-    if not np.all((quoted & (qcnt == 2)) | (~quoted & (qcnt == 0))):
+    ecum = np.concatenate(([0], np.cumsum(pair_first)))
+    lo = np.clip(fs, 0, len(arr))
+    hi = np.clip(fs + fl, 0, len(arr))
+    qcnt = qcum[hi] - qcum[lo]
+    ecnt = ecum[hi] - ecum[lo]
+    # quoted fields: outer pair + every interior quote in an escape pair;
+    # bare fields: no quotes at all. Anything else -> host fallback.
+    if not np.all((quoted & (qcnt == 2 + 2 * ecnt))
+                  | (~quoted & (qcnt == 0))):
         return None
     fs = fs + quoted.astype(np.int64)
     fl = fl - 2 * quoted.astype(np.int64)
+    if pair_first.any():
+        # unescape: delete the SECOND quote of each pair and remap spans
+        # (host control-plane rewrite, mirroring cudf's unescape pass)
+        second = np.zeros_like(pair_first)
+        second[1:] = pair_first[:-1]
+        delcum = np.concatenate(([0], np.cumsum(second)))
+        fl = fl - (delcum[np.clip(fs + fl, 0, len(arr))]
+                   - delcum[np.clip(fs, 0, len(arr))])
+        fs = fs - delcum[np.clip(fs, 0, len(arr))]
+        arr = arr[~second]
     return (arr, fs.reshape(n_lines, ncols).astype(np.int64),
             fl.reshape(n_lines, ncols).astype(np.int64), n_lines)
 

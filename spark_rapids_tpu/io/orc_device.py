@@ -14,9 +14,9 @@ decoder (io/parquet_device.py):
   prefix-sum for DELTA, bit extraction for PRESENT — so the decode work
   happens on the accelerator and the upload is the encoded stream.
 
-Scope: UNCOMPRESSED, ZLIB and SNAPPY files (compressed streams block-
-decompress on the HOST — control-plane work — and the normalized stripe
-image feeds the identical device expansion); SHORT/INT/LONG (+DATE)
+Scope: UNCOMPRESSED, ZLIB, SNAPPY and ZSTD files (compressed streams
+block-decompress on the HOST — control-plane work — and the normalized
+stripe image feeds the identical device expansion); SHORT/INT/LONG (+DATE)
 columns with DIRECT_V2 encoding; STRING columns with DIRECT_V2 (length
 stream + contiguous bytes) or DICTIONARY_V2 (index + dict lengths + dict
 bytes) — the value bytes gather on device through build_from_plan like
@@ -137,7 +137,10 @@ E_DIRECT, E_DICT, E_DIRECT_V2, E_DICT_V2 = 0, 1, 2, 3
 
 # compression kinds (orc_proto CompressionKind)
 COMP_NONE, COMP_ZLIB, COMP_SNAPPY = 0, 1, 2
-SUPPORTED_COMPRESSION = {COMP_NONE, COMP_ZLIB, COMP_SNAPPY}
+COMP_ZSTD = 5
+# LZO/LZ4 stay unsupported: ORC's raw-block framing records no per-block
+# decompressed size, which Arrow's lz4_raw codec requires
+SUPPORTED_COMPRESSION = {COMP_NONE, COMP_ZLIB, COMP_SNAPPY, COMP_ZSTD}
 
 
 def _snappy_raw_len(chunk: bytes) -> int:
@@ -150,6 +153,33 @@ def _snappy_raw_len(chunk: bytes) -> int:
             return out
         shift += 7
     raise _Unsupported("malformed snappy length")
+
+
+def _zstd_content_size(chunk: bytes):
+    """Frame content size from a zstd frame header (RFC 8878), or None
+    when the writer omitted it (Arrow's codec API needs the exact size)."""
+    if len(chunk) < 6 or chunk[:4] != b"\x28\xb5\x2f\xfd":
+        return None
+    fhd = chunk[4]
+    fcs_code = fhd >> 6
+    single_segment = (fhd >> 5) & 1
+    pos = 5
+    if not single_segment:
+        pos += 1  # window descriptor
+    pos += (0, 1, 2, 4)[fhd & 3]  # dictionary id
+    if fcs_code == 0:
+        if not single_segment:
+            return None  # content size absent
+        width, add = 1, 0
+    elif fcs_code == 1:
+        width, add = 2, 256
+    elif fcs_code == 2:
+        width, add = 4, 0
+    else:
+        width, add = 8, 0
+    if pos + width > len(chunk):
+        return None
+    return int.from_bytes(chunk[pos:pos + width], "little") + add
 
 
 def decompress_blocks(raw, start: int, length: int, kind: int) -> bytes:
@@ -180,6 +210,13 @@ def decompress_blocks(raw, start: int, length: int, kind: int) -> bytes:
 
             out += pa.Codec("snappy").decompress(
                 chunk, _snappy_raw_len(chunk)).to_pybytes()
+        elif kind == COMP_ZSTD:
+            import pyarrow as pa
+
+            size = _zstd_content_size(chunk)
+            if size is None:
+                raise _Unsupported("zstd frame without content size")
+            out += pa.Codec("zstd").decompress(chunk, size).to_pybytes()
         else:
             raise _Unsupported(f"compression kind {kind}")
     return bytes(out)

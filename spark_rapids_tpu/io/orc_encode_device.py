@@ -15,10 +15,14 @@ buffer and only streams bytes afterwards (`ColumnarOutputWriter.scala:
   protobuf metadata (StripeFooter / Footer / PostScript). No value is
   touched on the host.
 
-Scope: UNCOMPRESSED files; flat SHORT/INT/LONG/DATE columns (one stripe
-per input batch, DIRECT_V2 with a single column-wide bit width). Files
-read back with pyarrow.orc and this repo's own device ORC decoder.
-Everything else uses the host Arrow writer.
+Scope: flat SHORT/INT/LONG/DATE columns (DIRECT_V2 with a single
+column-wide bit width), STRING (DIRECT_V2: device byte-gather DATA +
+RLEv2 LENGTH), FLOAT/DOUBLE (raw IEEE LE streams; DOUBLE needs an
+f64-capable backend); one stripe per input batch. Streams and metadata
+sections optionally host-compressed in ORC's 3-byte-header block framing
+(zlib/snappy — the same codecs the device decoder's host control plane
+uses). Files read back with pyarrow.orc and this repo's own device ORC
+decoder. Everything else uses the host Arrow writer.
 """
 
 from __future__ import annotations
@@ -38,12 +42,21 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 
 # ORC type kinds (orc_proto Type.Kind)
 _KIND = {
+    DataType.BOOL: 0,    # BOOLEAN
     DataType.INT16: 2,   # SHORT
     DataType.INT32: 3,   # INT
     DataType.INT64: 4,   # LONG
     DataType.DATE: 15,   # DATE
+    DataType.FLOAT32: 5,   # FLOAT
+    DataType.FLOAT64: 6,   # DOUBLE
+    DataType.STRING: 7,    # STRING
 }
+_INT_DTS = (DataType.INT16, DataType.INT32, DataType.INT64, DataType.DATE)
 _K_STRUCT = 12
+
+# PostScript CompressionKind
+_COMP = {"none": 0, "uncompressed": 0, "zlib": 1, "snappy": 2}
+_COMP_BLOCK = 64 * 1024
 
 # RLEv2 DIRECT width -> 5-bit width code (subset: the widths we emit)
 _DIRECT_WIDTHS = [1, 2, 4, 8, 16, 24, 32, 40, 48, 56, 64]
@@ -55,7 +68,59 @@ _LIT = 128           # bytes per PRESENT literal run
 
 
 def schema_encodable(attrs) -> bool:
-    return all(a.data_type in _KIND for a in attrs)
+    from spark_rapids_tpu.columnar.batch import device_float64_supported
+
+    for a in attrs:
+        if a.data_type not in _KIND:
+            return False
+        if a.data_type is DataType.FLOAT64 and \
+                not device_float64_supported():
+            return False
+    return True
+
+
+def codec_supported(compression: str) -> bool:
+    name = compression.lower()
+    if name not in _COMP:
+        return False
+    if _COMP[name] == 2:  # snappy via the same pyarrow codec the decoder uses
+        try:
+            import pyarrow as pa
+
+            pa.Codec("snappy")
+        except Exception:
+            return False
+    return True
+
+
+def _compress_stream(payload: bytes, kind: int) -> bytes:
+    """Wrap a stream/metadata payload in ORC's compressed-block framing:
+    3-byte little-endian header (len << 1 | is_original) per <=64KB block.
+    HOST control plane — the mirror of decompress_blocks in the device
+    decoder (orc_device.py)."""
+    if kind == 0:
+        return payload
+    out = bytearray()
+    for i in range(0, len(payload), _COMP_BLOCK):
+        chunk = payload[i:i + _COMP_BLOCK]
+        if kind == 1:
+            import zlib
+
+            c = zlib.compressobj(6, zlib.DEFLATED, -15)
+            comp = c.compress(chunk) + c.flush()
+        else:
+            import pyarrow as pa
+
+            comp = bytes(pa.Codec("snappy").compress(chunk))
+        if len(comp) < len(chunk):
+            h = len(comp) << 1
+            out += bytes((h & 0xFF, (h >> 8) & 0xFF, (h >> 16) & 0xFF))
+            out += comp
+        else:
+            h = (len(chunk) << 1) | 1
+            out += bytes((h & 0xFF, (h >> 8) & 0xFF, (h >> 16) & 0xFF))
+            out += chunk
+    return bytes(out)
 
 
 # ---------------------------------------------------------------------------
@@ -156,9 +221,46 @@ def _fb(fnum: int, b: bytes) -> bytes:
     return _uvarint((fnum << 3) | 2) + _uvarint(len(b)) + b
 
 
-def _encode_stripe(attrs, batch: ColumnarBatch) -> Tuple[bytes, bytes, int]:
-    """One input batch -> (stripe data bytes, stripe footer bytes, rows)."""
-    from spark_rapids_tpu.columnar.batch import ensure_compact
+@jax.jit
+def _compact_fixed(data, validity, num_rows):
+    """Dense non-null values in row order (no transform — FLOAT/DOUBLE
+    raw IEEE streams)."""
+    validity = validity & (jnp.arange(validity.shape[0]) < num_rows)
+    order = jnp.argsort(~validity, stable=True)
+    return data[order], jnp.sum(validity.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _lens_u64(lens, n_present, cap: int):
+    """Unsigned length stream values for RLEv2 (no zigzag — LENGTH is
+    unsigned per the ORC spec)."""
+    in_sel = jnp.arange(cap) < n_present
+    u = jnp.where(in_sel, lens, 0).astype(jnp.uint64)
+    return u, jnp.max(u)
+
+
+def _rle_direct(u, n: int, max_u: int) -> bytes:
+    width = _pick_width(max_u)
+    if n <= 0:
+        return b""
+    out_bytes = ((n + _RUN - 1) // _RUN) * (_RUN * width // 8)
+    packed = bytes(np.asarray(jax.device_get(
+        _bitpack_be(u, width, out_bytes))))
+    return _direct_stream(packed, n, width)
+
+
+def _encode_stripe(attrs, batch: ColumnarBatch,
+                   comp_kind: int) -> Tuple[bytes, bytes, int]:
+    """One input batch -> (stripe data bytes, stripe footer bytes, rows).
+    Stream payloads are device-encoded then host-compressed per block."""
+    from spark_rapids_tpu.columnar.batch import (
+        bucket_capacity,
+        ensure_compact,
+    )
+    from spark_rapids_tpu.io.parquet_encode_device import (
+        _encode_string_bytes,
+        _encode_string_plan,
+    )
 
     # live-masked batches (exchange outputs) compact first: the PRESENT
     # bitmap is positional over the stripe's rows, so lanes 0..n_rows-1
@@ -169,41 +271,88 @@ def _encode_stripe(attrs, batch: ColumnarBatch) -> Tuple[bytes, bytes, int]:
     for ci, a in enumerate(attrs):
         cv = batch.columns[ci]
         validity = cv.validity
+        dt = a.data_type
+        if dt is DataType.STRING:
+            cap = validity.shape[0]
+            sel, lens, out_offsets, n, total = _encode_string_plan(
+                cv.data, cv.offsets, validity, jnp.int32(n_rows), cap, 0)
+            n = int(jax.device_get(n))
+            total = int(jax.device_get(total))
+            if n != n_rows:
+                bitmap = bytes(np.asarray(jax.device_get(
+                    _pack_present(validity, jnp.int32(n_rows)))))
+                streams.append((0, ci + 1,
+                                _present_stream(bitmap[:(n_rows + 7) // 8])))
+            byte_cap = bucket_capacity(max(total, 1))
+            sbytes = _encode_string_bytes(cv.data, cv.offsets, sel, lens,
+                                          out_offsets, byte_cap, 0)
+            data = bytes(np.asarray(jax.device_get(sbytes[:total])))
+            streams.append((1, ci + 1, data))
+            u, max_u = _lens_u64(lens, jnp.int32(n), cap)
+            max_u = int(jax.device_get(max_u))
+            streams.append((2, ci + 1, _rle_direct(u, n, max_u)))
+            continue
+        if dt is DataType.BOOL:
+            # BOOLEAN DATA: dense values bit-packed MSB-first in the same
+            # byte-RLE literal framing as PRESENT
+            dense, n = _compact_fixed(cv.data, validity, jnp.int32(n_rows))
+            n = int(jax.device_get(n))
+            if n != n_rows:
+                bitmap = bytes(np.asarray(jax.device_get(
+                    _pack_present(validity, jnp.int32(n_rows)))))
+                streams.append((0, ci + 1,
+                                _present_stream(bitmap[:(n_rows + 7) // 8])))
+            vbits = bytes(np.asarray(jax.device_get(
+                _pack_present(dense.astype(bool), jnp.int32(n)))))
+            streams.append((1, ci + 1,
+                            _present_stream(vbits[:(n + 7) // 8])))
+            continue
+        if dt in (DataType.FLOAT32, DataType.FLOAT64):
+            dense, n = _compact_fixed(cv.data, validity, jnp.int32(n_rows))
+            n = int(jax.device_get(n))
+            if n != n_rows:
+                bitmap = bytes(np.asarray(jax.device_get(
+                    _pack_present(validity, jnp.int32(n_rows)))))
+                streams.append((0, ci + 1,
+                                _present_stream(bitmap[:(n_rows + 7) // 8])))
+            host = np.asarray(jax.device_get(dense[:n]))
+            want = np.float32 if dt is DataType.FLOAT32 else np.float64
+            streams.append((1, ci + 1,
+                            host.astype(want, copy=False).tobytes()))
+            continue
         u, n, max_u = _compact_zigzag(cv.data, validity,
                                       jnp.int32(n_rows))
         n, max_u = int(jax.device_get(n)), int(jax.device_get(max_u))
-        has_nulls = n != n_rows
-        if has_nulls:
+        if n != n_rows:
             bitmap = bytes(np.asarray(
                 jax.device_get(_pack_present(validity,
                                              jnp.int32(n_rows)))))
             bitmap = bitmap[:(n_rows + 7) // 8]
             streams.append((0, ci + 1, _present_stream(bitmap)))
-        width = _pick_width(max_u)
-        if n > 0:
-            out_bytes = ((n + _RUN - 1) // _RUN) * (_RUN * width // 8)
-            packed = bytes(np.asarray(
-                jax.device_get(_bitpack_be(u, width, out_bytes))))
-            data = _direct_stream(packed, n, width)
-        else:
-            data = b""
-        streams.append((1, ci + 1, data))
+        streams.append((1, ci + 1, _rle_direct(u, n, max_u)))
 
     data_area = bytearray()
     footer = bytearray()
     for kind, col, payload in streams:
-        data_area += payload
-        footer += _fb(1, _fv(1, kind) + _fv(2, col) + _fv(3, len(payload)))
-    # column encodings: root struct DIRECT, columns DIRECT_V2
+        wire = _compress_stream(payload, comp_kind)
+        data_area += wire
+        footer += _fb(1, _fv(1, kind) + _fv(2, col) + _fv(3, len(wire)))
+    # column encodings: root struct DIRECT; ints/strings DIRECT_V2,
+    # floats DIRECT
     footer += _fb(2, _fv(1, 0))
-    for _ in attrs:
-        footer += _fb(2, _fv(1, 2))
+    for a in attrs:
+        enc = 0 if a.data_type in (DataType.FLOAT32, DataType.FLOAT64,
+                                   DataType.BOOL) else 2
+        footer += _fb(2, _fv(1, enc))
     return bytes(data_area), bytes(footer), n_rows
 
 
-def write_file(path: str, attrs, batches: List[ColumnarBatch]) -> int:
+def write_file(path: str, attrs, batches: List[ColumnarBatch],
+               compression: str = "uncompressed") -> int:
     """Assemble one ORC file from device-encoded stripes (one stripe per
-    batch). Returns rows written."""
+    batch); streams and metadata sections are host-block-compressed when
+    a codec is requested. Returns rows written."""
+    comp_kind = _COMP[compression.lower()]
     header = b"ORC"
     body = bytearray(header)
     stripe_infos: List[Tuple[int, int, int, int]] = []
@@ -212,7 +361,8 @@ def write_file(path: str, attrs, batches: List[ColumnarBatch]) -> int:
         if b.host_rows() == 0:
             continue
         offset = len(body)
-        data, sfooter, rows = _encode_stripe(attrs, b)
+        data, sfooter, rows = _encode_stripe(attrs, b, comp_kind)
+        sfooter = _compress_stream(sfooter, comp_kind)
         body += data
         body += sfooter
         stripe_infos.append((offset, len(data), len(sfooter), rows))
@@ -236,11 +386,12 @@ def write_file(path: str, attrs, batches: List[ColumnarBatch]) -> int:
         footer += _fb(4, _fv(1, _KIND[a.data_type]))
     footer += _fv(6, total_rows)           # numberOfRows
     footer += _fv(8, 0)                    # rowIndexStride: no row index
+    footer = bytearray(_compress_stream(bytes(footer), comp_kind))
 
     ps = bytearray()
     ps += _fv(1, len(footer))              # footerLength
-    ps += _fv(2, 0)                        # compression NONE
-    ps += _fv(3, 64 * 1024)                # compressionBlockSize
+    ps += _fv(2, comp_kind)                # compression kind
+    ps += _fv(3, _COMP_BLOCK)              # compressionBlockSize
     ps += _uvarint((4 << 3) | 0) + _uvarint(0)    # version: 0
     ps += _uvarint((4 << 3) | 0) + _uvarint(12)   # version: 12
     ps += _fv(5, 0)                        # metadataLength

@@ -23,8 +23,10 @@ PLAIN, RLE_DICTIONARY/PLAIN_DICTIONARY, DELTA_BINARY_PACKED (integrals:
 the delta recurrence decodes as ONE device cumsum over miniblock-unpacked
 deltas, bit widths to 56), DELTA_LENGTH_BYTE_ARRAY (strings: lengths ride
 the same delta kernel, byte starts are a device exclusive-sum), or
-BYTE_STREAM_SPLIT (fixed-width: strided plane gathers + bitcast);
-DELTA_BYTE_ARRAY prefix pages fall back. UNCOMPRESSED,
+BYTE_STREAM_SPLIT (fixed-width: strided plane gathers + bitcast), or
+DELTA_BYTE_ARRAY (strings: prefix-sharing resolves through a provider
+running-max scan, then one gather per output byte; pages whose
+values x max-length matrix exceeds the budget fall back). UNCOMPRESSED,
 SNAPPY, GZIP, ZSTD and BROTLI codecs.  Compressed pages decompress on the
 HOST (block decompression is control-plane: inherently serial bit-stream
 work; the reference does it inside cuDF but the data-plane win — run
@@ -167,8 +169,13 @@ ENC_PLAIN_DICT = 2
 ENC_RLE = 3
 ENC_DELTA_BINARY = 5
 ENC_DELTA_LENGTH = 6
+ENC_DELTA_BYTE_ARRAY = 7
 ENC_RLE_DICT = 8
 ENC_BYTE_STREAM_SPLIT = 9
+
+# provider-matrix budget for DELTA_BYTE_ARRAY reconstruction (elements);
+# pages whose n_values * max_string_len exceed it fall back to Arrow
+_DBA_MATRIX_BUDGET = 64 << 20
 
 
 @dataclass
@@ -537,6 +544,39 @@ def _expand_delta(chunk_u8, mb_bit_off, mb_width, mb_min_delta,
     return jnp.cumsum(jnp.where(d >= 0, delta, 0))
 
 
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _expand_dba(chunk_u8, plen, slen, suffix_base, maxlen: int,
+                byte_cap: int):
+    """DELTA_BYTE_ARRAY reconstruction: string i = first plen[i] bytes of
+    string i-1 + suffix i. The recurrence vectorizes through a PROVIDER
+    matrix: byte j of string i resolves to the suffix byte (j - plen[p])
+    of p = max{p' <= i : plen[p'] <= j} — a per-byte-column running max
+    (one associative scan over rows), then every output byte is one
+    gather. (cuDF's CUDA decoder resolves the same recurrence with a
+    block-parallel scan.) plen/slen must be zero beyond the real values.
+    Returns (bytes [byte_cap], offsets [n+1])."""
+    n = plen.shape[0]
+    out_len = plen + slen
+    out_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(out_len, dtype=jnp.int32)])
+    i = jnp.arange(n, dtype=jnp.int32)[:, None]
+    j = jnp.arange(maxlen, dtype=jnp.int32)[None, :]
+    cand = jnp.where(plen[:, None] <= j, i, -1)
+    prov = jax.lax.associative_scan(jnp.maximum, cand, axis=0)
+    scum = jnp.cumsum(slen, dtype=jnp.int32)
+    sstart = suffix_base.astype(jnp.int32) + scum - slen
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(out_off[1:], pos, side="right"),
+                   0, n - 1).astype(jnp.int32)
+    jj = pos - out_off[row]
+    p = prov[row, jnp.clip(jj, 0, maxlen - 1)]
+    pc = jnp.clip(p, 0, n - 1)
+    src = sstart[pc] + (jj - plen[pc])
+    valid = (pos < out_off[-1]) & (p >= 0)
+    byte = chunk_u8[jnp.clip(src, 0, chunk_u8.shape[0] - 1)]
+    return jnp.where(valid, byte, 0).astype(jnp.uint8), out_off
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _fold_flba_be(chunk_u8, byte_start, count: int, w: int):
     """FIXED_LEN_BYTE_ARRAY decimals: w-byte big-endian two's-complement
@@ -617,13 +657,15 @@ def column_eligible(col_meta, dtype: DataType) -> bool:
     ok_enc = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
               "DELTA_BINARY_PACKED", "DELTA_LENGTH_BYTE_ARRAY",
               "BYTE_STREAM_SPLIT"}
+    if col_meta.physical_type == "BYTE_ARRAY":
+        ok_enc = ok_enc | {"DELTA_BYTE_ARRAY"}
     if not set(col_meta.encodings) <= ok_enc:
         return False
     if col_meta.physical_type == "BYTE_ARRAY":
         # strings decode via dictionary gather, plain (start, len) walk,
-        # or device delta-length expansion (DELTA_BYTE_ARRAY prefix pages
-        # are NOT in scope — parquet reports them as DELTA_BYTE_ARRAY, so
-        # the ok_enc gate above already rejects them)
+        # device delta-length expansion, or the DELTA_BYTE_ARRAY
+        # provider-scan reconstruction (oversized pages raise _Unsupported
+        # at decode and fall back)
         if "DELTA_BINARY_PACKED" in col_meta.encodings or \
                 "BYTE_STREAM_SPLIT" in col_meta.encodings:
             return False
@@ -752,6 +794,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
     str_delta = []            # per-page DEVICE (starts, lens, n) for
                               # DELTA_LENGTH_BYTE_ARRAY strings
     str_delta_bytes = 0       # host-known total value bytes across pages
+    str_dba = []              # per-page (bytes_dev, starts, lens, n, total)
     dense_parts = []
     valid_parts = []
     for p in pages:
@@ -775,7 +818,8 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             ((ENC_RLE,) if is_bool else ()) + \
             (() if (is_bool or is_string)
              else (ENC_DELTA_BINARY, ENC_BYTE_STREAM_SPLIT)) + \
-            ((ENC_DELTA_LENGTH,) if is_string else ())
+            ((ENC_DELTA_LENGTH, ENC_DELTA_BYTE_ARRAY)
+             if is_string else ())
         if p.encoding not in ok_encs:
             raise _Unsupported(f"data page encoding {p.encoding}")
         pos = p.data_start
@@ -877,6 +921,35 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                               lens_dev.astype(jnp.int32), n_present))
             str_delta_bytes += max(0, end - data_base)
             page_dense = None
+        elif p.encoding == ENC_DELTA_BYTE_ARRAY and is_string:
+            # two delta streams (prefix lengths, suffix lengths) then the
+            # concatenated suffix bytes
+            fv1, vpm1, o1, w1, m1, base1 = \
+                _parse_delta_header(chunk, pos, end, n_present)
+            pp = _expand_delta(chunk_dev, jnp.asarray(o1), jnp.asarray(w1),
+                               jnp.asarray(m1), vpm1, page_cap)
+            in_page = jnp.arange(page_cap) < n_present
+            plen_dev = jnp.where(in_page, jnp.int64(fv1) + pp,
+                                 0).astype(jnp.int32)
+            fv2, vpm2, o2, w2, m2, base2 = \
+                _parse_delta_header(chunk, base1, end, n_present)
+            sp = _expand_delta(chunk_dev, jnp.asarray(o2), jnp.asarray(w2),
+                               jnp.asarray(m2), vpm2, page_cap)
+            slen_dev = jnp.where(in_page, jnp.int64(fv2) + sp,
+                                 0).astype(jnp.int32)
+            # one host sync sizes the provider matrix + byte buffer
+            maxlen, total = (int(x) for x in jax.device_get(
+                (jnp.max(plen_dev + slen_dev), jnp.sum(plen_dev + slen_dev))))
+            mlen_cap = bucket_capacity(max(maxlen, 1))
+            if page_cap * mlen_cap > _DBA_MATRIX_BUDGET:
+                raise _Unsupported(
+                    "DELTA_BYTE_ARRAY provider matrix over budget")
+            rec, out_off = _expand_dba(chunk_dev, plen_dev, slen_dev,
+                                       jnp.int32(base2), mlen_cap,
+                                       bucket_capacity(max(total, 8)))
+            str_dba.append((rec, out_off[:-1], plen_dev + slen_dev,
+                            n_present, total))
+            page_dense = None
         elif p.encoding == ENC_BYTE_STREAM_SPLIT:
             # npdt.itemsize == the file's physical width here: eligibility
             # rejects FLOAT64 columns unless the device stores real f64
@@ -907,7 +980,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
     else:
         validity = _concat_logical(
             [(v, n) for v, n in valid_parts], cap, False)
-    if not str_plain and not str_delta:
+    if not str_plain and not str_delta and not str_dba:
         # plain/delta-length string chunks skip the dense assembly — their
         # values come from the (start, len) tables below
         if len(dense_parts) == 1:
@@ -920,6 +993,27 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         return ColumnVector(dtype, data, validity)
     from spark_rapids_tpu.columnar.strings import build_from_plan
 
+    if str_dba:
+        if str_dict is not None or str_plain or str_delta:
+            raise _Unsupported("mixed DELTA_BYTE_ARRAY/other string pages")
+        # values live in per-page reconstructed buffers; build_from_plan's
+        # multi-source gather stitches them (source = page index)
+        starts_dev = _concat_logical(
+            [(s, n) for _b, s, _l, n, _t in str_dba], cap, 0)
+        lens_dev = _concat_logical(
+            [(l, n) for _b, _s, l, n, _t in str_dba], cap, 0)
+        page_ids = _concat_logical(
+            [(jnp.full((n,), pi, jnp.int32), n)
+             for pi, (_b, _s, _l, n, _t) in enumerate(str_dba)], cap, 0)
+        row_starts = _assemble(validity, starts_dev, cap)
+        row_lens = _assemble(validity, lens_dev, cap)
+        row_choice = _assemble(validity, page_ids, cap)
+        byte_cap = bucket_capacity(
+            max(sum(t for *_x, t in str_dba), 8))
+        out_bytes, offsets = build_from_plan(
+            [b for b, *_x in str_dba], row_choice, row_starts,
+            jnp.where(validity, row_lens, 0), byte_cap)
+        return ColumnVector(dtype, out_bytes, validity, offsets)
     if str_delta:
         if str_dict is not None or str_plain:
             raise _Unsupported("mixed delta-length/other string pages")

@@ -8,10 +8,11 @@ Reference parity:
 - dynamic partitioning by partition columns into key=value directories
   (GpuFileFormatDataWriter dynamic writer, 417 LoC) -> `_write_partitioned`.
 
-Phase 1 encodes on the host with Arrow C++ after the device->host boundary
-(the reference encodes on-GPU via cudf Table.writeParquet into a host
-buffer; the TPU equivalent — device-side encode kernels — is a later
-phase).
+Eligible schemas encode ON DEVICE (io/parquet_encode_device.py /
+io/orc_encode_device.py — the reference encodes on-GPU via cudf
+Table.writeParquet/writeORC into a host buffer, ColumnarOutputWriter.
+scala:62-177) with host block compression; everything else encodes on
+the host with Arrow C++ after the device->host boundary.
 """
 
 from __future__ import annotations
@@ -65,27 +66,27 @@ def execute_write(session, plan: L.WriteFile) -> None:
     from spark_rapids_tpu.exec.transitions import DeviceToHostExec
     from spark_rapids_tpu.io import parquet_encode_device as PE
 
-    # the device encoder writes UNCOMPRESSED only, so it engages just for
-    # an explicit compression=none — the default write stays snappy via the
-    # host Arrow writer, identical before and after this feature
+    # device encode + host block compression mirrors the decode split:
+    # the DEFAULT snappy parquet write goes through the device encoder
+    # (reference behavior: ColumnarOutputWriter.scala:62-177 encodes
+    # compressed parquet/ORC on the accelerator)
     from spark_rapids_tpu.io import orc_encode_device as OE
 
+    pq_compression = str(plan.options.get("compression", "snappy")).lower()
     device_encode = (
         plan.fmt == "parquet"
         and not plan.partition_by
         and session.conf.get(C.PARQUET_DEVICE_ENCODE)
-        and str(plan.options.get("compression", "snappy")).lower()
-        in ("none", "uncompressed")
+        and PE.codec_supported(pq_compression)
         and isinstance(physical, DeviceToHostExec)
         and PE.schema_encodable(attrs))
-    # pyarrow's ORC default IS uncompressed, so the device ORC encoder
-    # (reference: GpuOrcFileFormat.scala) engages for default writes too
+    orc_compression = str(plan.options.get("compression",
+                                           "uncompressed")).lower()
     device_encode_orc = (
         plan.fmt == "orc"
         and not plan.partition_by
         and session.conf.get(C.ORC_DEVICE_ENCODE)
-        and str(plan.options.get("compression", "uncompressed")).lower()
-        in ("none", "uncompressed")
+        and OE.codec_supported(orc_compression)
         and isinstance(physical, DeviceToHostExec)
         and OE.schema_encodable(attrs))
     if device_encode or device_encode_orc:
@@ -101,10 +102,12 @@ def execute_write(session, plan: L.WriteFile) -> None:
             return 0
         if device_encode:
             fname = f"part-{pidx:05d}-{write_id}.{_ext(plan.fmt)}"
-            return PE.write_file(os.path.join(path, fname), attrs, batches)
+            return PE.write_file(os.path.join(path, fname), attrs, batches,
+                                 compression=pq_compression)
         if device_encode_orc:
             fname = f"part-{pidx:05d}-{write_id}.{_ext(plan.fmt)}"
-            return OE.write_file(os.path.join(path, fname), attrs, batches)
+            return OE.write_file(os.path.join(path, fname), attrs, batches,
+                                 compression=orc_compression)
         if plan.partition_by:
             return _write_partitioned(batches, attrs, plan, path, pidx,
                                       write_id)

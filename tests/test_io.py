@@ -432,8 +432,9 @@ class TestDeviceParquetDecode:
             ignore_order=True)
 
     def test_device_encode_respects_compression_opt(self, session, tmp_path):
-        # explicit snappy keeps the host Arrow writer (device path is
-        # uncompressed-only) and stays readable
+        # explicit snappy produces a SNAPPY-tagged file (the device encoder
+        # covers compressed writes via host block codecs; a host-only child
+        # plan like this one uses the Arrow writer) and stays readable
         import numpy as np
         import pyarrow.parquet as pq
 

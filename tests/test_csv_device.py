@@ -38,8 +38,14 @@ def test_plan_fields_quoted_and_ragged():
     raw = t.raw.tobytes()
     f01 = raw[t.starts[0, 1]:t.starts[0, 1] + t.lens[0, 1]]
     assert f01 == b"x,y"  # quotes stripped, comma kept
-    # escaped "" inside a quoted field -> host fallback
-    assert CD.plan_fields(b'a,"x""y"\n1,2\n', 2, header=False) is None
+    # escaped "" inside a quoted field: unescaped in the control plane
+    t2 = CD.plan_fields(b'a,"x""y"\n1,2\n', 2, header=False)
+    assert t2 is not None and t2.num_rows == 2
+    raw2 = t2.raw.tobytes()
+    f01b = raw2[t2.starts[0, 1]:t2.starts[0, 1] + t2.lens[0, 1]]
+    assert f01b == b'x"y'
+    # a stray unpaired interior quote still falls back
+    assert CD.plan_fields(b'a,"x"y"\n1,2\n', 2, header=False) is None
     # ragged -> host fallback
     assert CD.plan_fields(b"1,2\n3\n", 2, header=False) is None
 

@@ -1160,11 +1160,9 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     return ColumnarBatch(cols, out_rows)
 
 
-@jax.jit
-def _gather_string_plan(offsets, validity, idx, in_bounds, sel_mask):
-    """Fused prelude of a string gather: source starts, output offsets, and
-    gathered validity in ONE dispatch (the eager version cost ~6 dispatches
-    per column — expensive when the chip sits behind a network tunnel)."""
+def _string_plan_body(offsets, validity, idx, in_bounds, sel_mask):
+    """Shared string-gather prelude: source starts, output offsets, and
+    gathered validity (called from both jitted plan entry points)."""
     safe_idx = jnp.where(in_bounds, idx, 0)
     starts = offsets[safe_idx]
     ends = offsets[safe_idx + 1]
@@ -1174,6 +1172,14 @@ def _gather_string_plan(offsets, validity, idx, in_bounds, sel_mask):
     ])
     out_valid = jnp.where(in_bounds, validity[safe_idx], False) & sel_mask
     return starts, lengths, new_offsets, out_valid
+
+
+@jax.jit
+def _gather_string_plan(offsets, validity, idx, in_bounds, sel_mask):
+    """Fused prelude of a string gather in ONE dispatch (the eager version
+    cost ~6 dispatches per column — expensive when the chip sits behind a
+    network tunnel)."""
+    return _string_plan_body(offsets, validity, idx, in_bounds, sel_mask)
 
 
 @functools.partial(jax.jit, static_argnums=(4,))
@@ -1200,12 +1206,63 @@ def _compact_plan(keep_mask, num_rows):
     return order, jnp.sum(keep)
 
 
-def compact_batch(batch: ColumnarBatch, keep_mask) -> ColumnarBatch:
+def compact_batch(batch: ColumnarBatch, keep_mask,
+                  lazy: bool = False) -> ColumnarBatch:
     """Compact rows where keep_mask is True to the front (the filter kernel;
     reference: cudf Table.filter used by GpuFilterExec,
-    basicPhysicalOperators.scala:96-177)."""
+    basicPhysicalOperators.scala:96-177).
+
+    lazy=True skips the row-count host sync: the gather runs at the
+    INPUT's capacity and the result carries a traced num_rows (the batch
+    invariant — rows 0..n-1 live, suffix padded — still holds, so every
+    consumer works unchanged; anything needing a host int syncs lazily
+    via host_rows()). On a high-fence backend (tunneled chip, ~67 ms per
+    sync) this folds the filter's fence into whatever downstream sync
+    happens anyway; the cost is padded-lane compute at the unshrunk
+    capacity."""
     order, n = _compact_plan(keep_mask, jnp.int32(batch.num_rows))
+    if lazy:
+        return _gather_batch_traced(batch, order, n)
     return gather_batch(batch, order, int(jax.device_get(n)))
+
+
+def _gather_batch_traced(batch: ColumnarBatch, indices,
+                         out_rows) -> ColumnarBatch:
+    """gather_batch with a TRACED output row count: output capacity = the
+    input's (static), string byte capacity = the input byte buffer's
+    (output bytes of a row-subset gather can never exceed it). No host
+    sync anywhere."""
+    cap = batch.capacity
+    n32 = jnp.asarray(out_rows, dtype=jnp.int32)
+    fixed = [(i, cv) for i, cv in enumerate(batch.columns)
+             if cv.dtype is not DataType.STRING]
+    cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
+    if fixed:
+        datas = tuple(cv.data for _, cv in fixed)
+        valids = tuple(cv.validity for _, cv in fixed)
+        outs = _gather_fixed_cols(cap, datas, valids, indices, None, n32)
+        for (i, cv), (data, validity) in zip(fixed, outs):
+            cols[i] = ColumnVector(cv.dtype, data, validity,
+                                   vrange=cv.vrange)
+    sidx = [i for i, cv in enumerate(batch.columns)
+            if cv.dtype is DataType.STRING]
+    for i in sidx:
+        cv = batch.columns[i]
+        starts, lengths, new_offsets, validity = _gather_string_plan_traced(
+            cv.offsets, cv.validity, indices[:cap], n32)
+        out = _gather_string_bytes(cv.data, starts, new_offsets, lengths,
+                                   int(cv.data.shape[0]))
+        cols[i] = ColumnVector(DataType.STRING, out, validity, new_offsets)
+    return ColumnarBatch(cols, out_rows)
+
+
+@jax.jit
+def _gather_string_plan_traced(offsets, validity, idx, out_rows):
+    """_gather_string_plan with the masks derived from a TRACED row count
+    (shared body; one extra fused mask computation, still one dispatch)."""
+    sel_mask = jnp.arange(idx.shape[0]) < out_rows
+    in_bounds = sel_mask & (idx >= 0) & (idx < (offsets.shape[0] - 1))
+    return _string_plan_body(offsets, validity, idx, in_bounds, sel_mask)
 
 
 def slice_batch_host(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:

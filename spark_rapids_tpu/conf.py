@@ -442,6 +442,17 @@ TASK_THREADS = _conf("rapids.tpu.engine.taskThreads").doc(
     "Worker threads executing partition tasks (the Spark executor-slot analog)."
 ).integer(8)
 
+FILTER_COMPACT_SYNC = _conf("rapids.tpu.engine.filterCompactSync").doc(
+    "Whether the filter compacts with a row-count host sync. 'always' "
+    "syncs per batch (shrinks capacity — best when fences are cheap); "
+    "'never' keeps the compacted rows at the input capacity with a "
+    "traced row count (no fence; padded lanes cost compute but the "
+    "sync folds into whatever downstream fence happens anyway); 'auto' "
+    "(default) goes lazy when the measured backend fence cost clears "
+    "~5 ms (tunneled chips measure ~67 ms; local chips ~0.1-1 ms)."
+).check(lambda v: None if v in ("auto", "always", "never")
+        else "must be one of auto|always|never").string("auto")
+
 AGG_COMPACT_SYNC = _conf("rapids.tpu.engine.aggCompactSync").doc(
     "Whether the partial-aggregate stage compacts its output with a "
     "row-count host sync before the shuffle. 'always' compacts every "

@@ -208,12 +208,30 @@ class TpuFilterExec(TpuExec):
         child_pb = self.children[0].execute(ctx)
         filt = self._filter
         total_time = self.metrics[M.TOTAL_TIME]
+        # skip the row-count sync on high-fence backends (same policy shape
+        # as aggCompactSync; the compacted batch stays invariant-correct at
+        # the input capacity with a traced num_rows)
+        from spark_rapids_tpu import conf as C
+
+        policy = ctx.conf.get(C.FILTER_COMPACT_SYNC)
+        if policy == "never":
+            lazy = True
+        elif policy == "auto":
+            from spark_rapids_tpu.exec.aggregate import (
+                LAZY_FENCE_THRESHOLD_MS,
+            )
+            from spark_rapids_tpu.utils.devprobe import fence_cost_ms
+
+            lazy = fence_cost_ms() >= LAZY_FENCE_THRESHOLD_MS
+        else:
+            lazy = False
 
         def factory(pidx: int) -> Iterator[ColumnarBatch]:
             row_start = 0
             for batch in child_pb.iterator(pidx):
                 with M.trace_range("TpuFilter", total_time):
-                    out = filt.apply(batch, partition_id=pidx, row_start=row_start)
+                    out = filt.apply(batch, partition_id=pidx,
+                                     row_start=row_start, lazy=lazy)
                 row_start += batch.num_rows
                 yield out
 

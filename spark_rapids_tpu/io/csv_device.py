@@ -130,9 +130,10 @@ def _plan_fields_native(data: bytes, ncols: int, sep_b: int):
 def _plan_fields_quoted(data: bytes, ncols: int, sep_b: int):
     """Quote-aware boundary scan (reference: cudf's quoted-field tokenizer
     behind GpuBatchScanExec.scala:322-520). Separators/newlines inside
-    quotes are not boundaries; fully-quoted fields strip their quotes.
-    Escaped "" inside a field (quote count != 2 per quoted field) -> None
-    (host fallback), since unescaping would rewrite bytes."""
+    quotes are not boundaries; fully-quoted fields strip their quotes;
+    escaped "" pairs inside quoted fields unescape via a host rewrite
+    (second quote of each pair deleted, spans remapped to the rewritten
+    buffer). Stray unpaired quotes -> None (host fallback)."""
     arr = np.frombuffer(data, dtype=np.uint8)
     is_q = arr == _QUOTE
     # inside[i]: byte i lies inside a quoted section (after an odd number
@@ -266,8 +267,10 @@ def _finish_plan(data: bytes, arr, starts, lens, n_lines: int, ncols: int,
     if header:
         if n_lines < 1:
             return None
+        # slice from `arr`, not `data`: the quoted planner's unescape pass
+        # may have rewritten the buffer and remapped starts/lens to it
         header_names = [
-            data[starts[0, j]:starts[0, j] + lens[0, j]].decode(
+            bytes(arr[starts[0, j]:starts[0, j] + lens[0, j]]).decode(
                 "utf-8", errors="replace").strip()
             for j in range(ncols)]
         starts = starts[1:]

@@ -193,7 +193,7 @@ class DeviceFilter:
         return get_or_build(key, build)
 
     def apply(self, batch: ColumnarBatch, partition_id: int = 0,
-              row_start: int = 0) -> ColumnarBatch:
+              row_start: int = 0, lazy: bool = False) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import compact_batch
 
         if self._jitted is None:
@@ -208,7 +208,7 @@ class DeviceFilter:
             for v, m in zip(got, msgs):
                 if bool(v):
                     raise ValueError(m)
-        return compact_batch(batch, keep)
+        return compact_batch(batch, keep, lazy=lazy)
 
 
 # ---------------------------------------------------------------------------

@@ -160,3 +160,40 @@ def test_semaphore_concurrent_same_task():
     t = threading.Thread(target=lambda: (sem.acquire_if_necessary(8), done.append(1)))
     t.start(); t.join(timeout=5)
     assert done == [1]
+
+
+def test_lazy_filter_compact_matches_eager():
+    """filterCompactSync=never: the filter emits a suffix-compacted batch
+    at the input capacity with a TRACED row count; results must match the
+    eager (synced) path exactly, strings included."""
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.plan import functions as F
+
+    session = srt.new_session()
+    rng = np.random.default_rng(33)
+    n = 4000
+    df = session.createDataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int64),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+        "s": [None if i % 11 == 0 else f"s{i % 23}" for i in range(n)],
+    }).cache()
+    q = (df.filter((F.col("v") > -500) & (F.col("v") < 700))
+           .filter(F.col("s").isNotNull())      # chained lazy filters
+           .groupBy("k").agg(F.sum("v").alias("sv"),
+                             F.min("s").alias("mn"),
+                             F.count("*").alias("c")))
+    try:
+        session.conf.set("rapids.tpu.engine.filterCompactSync", "never")
+        got = sorted(q.collect(), key=repr)
+    finally:
+        session.conf.set("rapids.tpu.engine.filterCompactSync", "always")
+    want = sorted(q.collect(), key=repr)
+    assert got == want
+    # empty result through the lazy path
+    try:
+        session.conf.set("rapids.tpu.engine.filterCompactSync", "never")
+        assert df.filter(F.col("v") > 10**9).collect() == []
+    finally:
+        session.conf.set("rapids.tpu.engine.filterCompactSync", "auto")

@@ -386,3 +386,10 @@ def test_csv_date_timestamp_scan_equivalence(session, tmp_path, monkeypatch):
     assert "decode_date_column" in calls, "device date decode did not engage"
     assert "decode_timestamp_column" in calls, \
         "device timestamp decode did not engage"
+
+
+def test_header_names_after_unescape():
+    # header names slice from the REWRITTEN buffer after "" unescaping
+    t = CD.plan_fields(b'"a""b",c\nx,y\n', 2, header=True)
+    assert t is not None and t.header_names == ['a"b', 'c']
+    assert t.num_rows == 1

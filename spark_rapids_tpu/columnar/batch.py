@@ -159,17 +159,27 @@ class ColumnVector:
     narrowability retraces dependent jit programs. Storage stays at
     physical_np_dtype regardless — vrange only licenses in-kernel int32
     compute (see module docstring above).
+
+    `max_len` (optional static python int, STRING only) is a power-of-two
+    upper bound on any single value's UTF-8 byte length. A host-known
+    bound lets string consumers derive static shapes without a device
+    round trip: sort/agg chunk counts (string_chunks_needed) and string
+    gather output byte capacities both come from it, which removes the
+    per-batch ~66 ms count fences on tunneled backends. Like vrange it
+    rides pytree aux data (pow2-bucketed so it rarely retraces).
     """
 
-    __slots__ = ("dtype", "data", "validity", "offsets", "vrange")
+    __slots__ = ("dtype", "data", "validity", "offsets", "vrange",
+                 "max_len")
 
     def __init__(self, dtype: DataType, data, validity, offsets=None,
-                 vrange=None):
+                 vrange=None, max_len=None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.offsets = offsets
         self.vrange = vrange
+        self.max_len = max_len
 
     @property
     def capacity(self) -> int:
@@ -192,17 +202,26 @@ class ColumnVector:
 
 def _cv_flatten(cv: ColumnVector):
     if cv.offsets is None:
-        return (cv.data, cv.validity), (cv.dtype, False, cv.vrange)
-    return (cv.data, cv.validity, cv.offsets), (cv.dtype, True, cv.vrange)
+        return (cv.data, cv.validity), (cv.dtype, False, cv.vrange, None)
+    return (cv.data, cv.validity, cv.offsets), (cv.dtype, True, cv.vrange,
+                                                cv.max_len)
 
 
 def _cv_unflatten(aux, children):
-    dtype, has_offsets, vrange = aux
+    dtype, has_offsets, vrange, max_len = aux
     if has_offsets:
         data, validity, offsets = children
-        return ColumnVector(dtype, data, validity, offsets, vrange)
+        return ColumnVector(dtype, data, validity, offsets, vrange,
+                            max_len)
     data, validity = children
     return ColumnVector(dtype, data, validity, vrange=vrange)
+
+
+def len_bucket(n: int) -> int:
+    """Pow2 bucket for a string max-byte-length bound (min 1): keeps the
+    set of distinct max_len aux values (and thus retraces) logarithmic."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
 
 
 jax.tree_util.register_pytree_node(ColumnVector, _cv_flatten, _cv_unflatten)
@@ -392,7 +411,8 @@ class HostColumnarBatch:
                 parts.append(("int32", offsets, False))
                 parts.append(("uint8", buf, False))
                 parts.append(("uint8", validity.view(np.uint8), True))
-                specs.append(("string",))
+                specs.append(("string",
+                              len_bucket(int(lengths.max()) if n else 1)))
             else:
                 npdt = physical_np_dtype(hc.dtype)
                 data = np.zeros(cap, dtype=npdt)
@@ -415,7 +435,7 @@ class HostColumnarBatch:
                     arrays[ai + 2]
                 ai += 3
                 cols.append(ColumnVector(DataType.STRING, buf, validity,
-                                         offsets))
+                                         offsets, max_len=spec[1]))
             else:
                 data, validity = arrays[ai], arrays[ai + 1]
                 ai += 2
@@ -477,14 +497,10 @@ class ColumnarBatch:
     def device_memory_size(self) -> int:
         return sum(c.device_memory_size() for c in self.columns)
 
-    # -- download (reference: GpuColumnarToRowExec copyToHost) ---------------
-    def to_host(self) -> HostColumnarBatch:
-        """Single-transfer download: one jitted device pack into a uint8
-        buffer, one copy to host, numpy views to reconstruct columns."""
-        if self.live is not None:
-            return ensure_compact(self).to_host()
-        if not self.columns:
-            return HostColumnarBatch([], self.host_rows())
+    # -- download (reference: GpuColumnarToRowExec copyToRowHost) ------------
+    def _download_plan(self):
+        """(device arrays to fetch, n_or_None, trim) for this batch — the
+        first phase of to_host, shared with the batched to_host_many."""
         if self.rows_on_host:
             n = self.num_rows
             trim = min(self.capacity, bucket_capacity(max(n, 1)))
@@ -506,14 +522,11 @@ class ColumnarBatch:
         if n is None:
             arrays.append(jnp.asarray(self.num_rows,
                                       dtype=jnp.int32).reshape(1))
-        host = {k: np.asarray(v) for k, v in jax.device_get(
-            _download_grouped(tuple(arrays))).items()}
-        if n is None:
-            n = int(host["int32"][-1])
-            self.num_rows = n
-        out = []
-        offs = {k: 0 for k in host}
+        return arrays, n, trim
 
+    def _download_finish(self, host, offs, n, trim) -> HostColumnarBatch:
+        """Reconstruct host columns from the grouped download buffers,
+        consuming segments at the shared per-dtype cursors `offs`."""
         def take(count, np_dtype):
             np_dtype = np.dtype(np_dtype)
             key = "uint8" if np_dtype == np.bool_ else np_dtype.name
@@ -523,11 +536,25 @@ class ColumnarBatch:
                 return seg.astype(bool)
             return seg
 
+        # consume raw segments in the exact _download_plan append order
+        # first (the count, when device-resident, rides LAST), then build
+        raw = []
         for cv in self.columns:
             if cv.dtype is DataType.STRING:
-                offsets = take(trim + 1, np.int32)
-                data = take(int(cv.data.shape[0]), np.uint8)
-                validity = take(trim, np.bool_)[:n]
+                raw.append((take(trim + 1, np.int32),
+                            take(int(cv.data.shape[0]), np.uint8),
+                            take(trim, np.bool_)))
+            else:
+                raw.append((take(trim, np.dtype(cv.data.dtype)),
+                            take(trim, np.bool_)))
+        if n is None:
+            n = int(take(1, np.int32)[0])
+            self.num_rows = n
+        out = []
+        for cv, seg in zip(self.columns, raw):
+            if cv.dtype is DataType.STRING:
+                offsets, data, validity = seg
+                validity = validity[:n]
                 strs = np.empty(n, dtype=object)
                 for i in range(n):
                     if validity[i]:
@@ -538,9 +565,7 @@ class ColumnarBatch:
                         strs[i] = ""
                 out.append(HostColumnVector(DataType.STRING, strs, validity))
             else:
-                phys = np.dtype(cv.data.dtype)
-                data = take(trim, phys)[:n]
-                validity = take(trim, np.bool_)[:n]
+                data, validity = seg[0][:n], seg[1][:n]
                 npdt = cv.dtype.to_np()
                 if data.dtype != npdt:
                     data = data.astype(npdt)
@@ -548,9 +573,50 @@ class ColumnarBatch:
                 out.append(HostColumnVector(cv.dtype, data, validity))
         return HostColumnarBatch(out, n)
 
+    def to_host(self) -> HostColumnarBatch:
+        """Single-transfer download: one jitted device pack into per-dtype
+        buffers, one copy to host, numpy views to reconstruct columns."""
+        return to_host_many([self])[0]
+
     def __repr__(self):
         return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
                 f"cols={[c.dtype.name for c in self.columns]})")
+
+
+def to_host_many(batches: Sequence["ColumnarBatch"],
+                 byte_budget: int = 256 << 20) -> List[HostColumnarBatch]:
+    """Download MANY device batches with one grouped transfer (one fence)
+    per `byte_budget` worth of data — the collect/transition path would
+    otherwise pay one ~66 ms round trip per batch on tunneled backends."""
+    batches = [b if b.live is None else ensure_compact(b) for b in batches]
+    out: List[Optional[HostColumnarBatch]] = [None] * len(batches)
+    group: List[Tuple[int, list, Any, int]] = []
+    group_bytes = 0
+
+    def flush():
+        nonlocal group, group_bytes
+        if not group:
+            return
+        arrays = tuple(a for _, segs, _, _ in group for a in segs)
+        host = {k: np.asarray(v) for k, v in jax.device_get(
+            _download_grouped(arrays)).items()}
+        offs = {k: 0 for k in host}
+        for bi, _segs, n, trim in group:
+            out[bi] = batches[bi]._download_finish(host, offs, n, trim)
+        group, group_bytes = [], 0
+
+    for bi, b in enumerate(batches):
+        if not b.columns:
+            out[bi] = HostColumnarBatch([], b.host_rows())
+            continue
+        arrays, n, trim = b._download_plan()
+        sz = b.device_memory_size()
+        if group and group_bytes + sz > byte_budget:
+            flush()
+        group.append((bi, arrays, n, trim))
+        group_bytes += sz
+    flush()
+    return out  # type: ignore[return-value]
 
 
 # ---------------------------------------------------------------------------
@@ -624,6 +690,7 @@ def repad_column(cv: ColumnVector, new_cap: int) -> ColumnVector:
             cv.data,
             _pad_array(cv.validity, False, new_cap),
             new_offsets,
+            max_len=cv.max_len,
         )
     zero = jnp.zeros((), dtype=cv.data.dtype)
     return ColumnVector(
@@ -640,7 +707,7 @@ def batch_to_device(b: "ColumnarBatch", dev) -> "ColumnarBatch":
                          jax.device_put(c.validity, dev),
                          None if c.offsets is None
                          else jax.device_put(c.offsets, dev),
-                         vrange=c.vrange)
+                         vrange=c.vrange, max_len=c.max_len)
             for c in b.columns]
     live = None if b.live is None else jax.device_put(b.live, dev)
     num = b.num_rows
@@ -766,7 +833,8 @@ def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
         idx = np.zeros(idx_cap, dtype=np.int32)
         idx[:n] = rows
         return gather_batch(
-            ColumnarBatch(batch.columns, batch.capacity), jnp.asarray(idx), n)
+            ColumnarBatch(batch.columns, batch.capacity), jnp.asarray(idx), n,
+            unique_indices=True)
     cap = bucket_capacity(batch.capacity)
     live = batch.live_mask()
     bkt = live.shape[0]
@@ -1091,7 +1159,10 @@ def _concat_string_cols(cols: List[ColumnVector], nrows: List[int],
         "pack_string", _pack_string_traced, (0, 1, 2),
         cap, byte_cap, shapes, meta, tuple(g_sd), tuple(g_so), tuple(g_sv),
         device_const(np.asarray([total_rows, total_bytes], np.int32)))
-    return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets)
+    lens = [c.max_len for c in cols]
+    out_ml = max(lens) if all(m is not None for m in lens) else None
+    return ColumnVector(DataType.STRING, out_data, out_valid, out_offsets,
+                        max_len=out_ml)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -1115,15 +1186,53 @@ def _gather_fixed_cols(cap: int, datas, valids, indices, indices_valid,
     return out
 
 
+def _string_byte_bound(cv: ColumnVector, out_cap: int,
+                       unique_indices: bool) -> Optional[int]:
+    """Static output byte capacity for gathering `out_cap` rows out of
+    string column `cv` without a device round trip, or None when the
+    sync-priced exact total is the better deal. Bounds: out_cap * max_len
+    always; the source byte buffer additionally when no index repeats
+    (permutations, group reps, contiguous slices). A max_len-only bound
+    (repeating join-probe gathers) that overshoots the source buffer by
+    more than 4x is declined — one skewed long value would otherwise
+    balloon every gather's output buffer and byte-kernel lanes."""
+    src_bytes = int(cv.data.shape[0])
+    bounds = []
+    if cv.max_len is not None:
+        ml_bound = out_cap * cv.max_len
+        if unique_indices or ml_bound <= 4 * src_bytes:
+            bounds.append(ml_bound)
+    if unique_indices:
+        bounds.append(src_bytes)
+    if not bounds:
+        return None
+    return bucket_capacity(max(min(bounds), 1))
+
+
+# a bounded (sync-free) string gather is only worth oversizing the output
+# buffer for when a fence is expensive; below this it stays exact-sized
+_SYNC_FREE_FENCE_MS = 5.0
+
+
+def _sync_free_strings() -> bool:
+    from spark_rapids_tpu.utils.devprobe import fence_cost_ms
+
+    return fence_cost_ms() >= _SYNC_FREE_FENCE_MS
+
+
 def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
-                 indices_valid=None) -> ColumnarBatch:
+                 indices_valid=None,
+                 unique_indices: bool = False) -> ColumnarBatch:
     """Gather rows by index into a new batch of `out_rows` logical rows.
     `indices` is a device int32 array of length >= bucket_capacity(out_rows);
     entries >= capacity are treated as 'emit null row' (used by outer joins).
+
+    unique_indices=True promises no source row index repeats (sort
+    permutations, group representatives, contiguous partition slices):
+    string output bytes are then bounded by the source buffer, which — on
+    high-fence backends — removes the per-gather byte-count round trip.
     """
     cap = bucket_capacity(max(out_rows, 1))
-    idx = indices[:cap]
-    sel_mask = jnp.arange(cap) < out_rows
     fixed = [(i, cv) for i, cv in enumerate(batch.columns)
              if cv.dtype is not DataType.STRING]
     cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
@@ -1131,7 +1240,7 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
         datas = tuple(cv.data for _, cv in fixed)
         valids = tuple(cv.validity for _, cv in fixed)
         outs = _gather_fixed_cols(cap, datas, valids, indices,
-                                  indices_valid, jnp.int32(out_rows))
+                                  indices_valid, np.int32(out_rows))
         for (i, cv), (data, validity) in zip(fixed, outs):
             # gathered values are a subset of the source (null lanes hold 0),
             # so the source range bound still holds
@@ -1140,23 +1249,30 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     sidx = [i for i, cv in enumerate(batch.columns)
             if cv.dtype is DataType.STRING]
     if sidx:
-        in_bounds_s = sel_mask & (idx >= 0) & (idx < batch.capacity)
-        if indices_valid is not None:
-            in_bounds_s = in_bounds_s & indices_valid[:cap]
-        # plan every string column first so the byte totals come back in a
-        # single host transfer (one sync per gather, not one per column)
-        plans = [_gather_string_plan(batch.columns[i].offsets,
-                                     batch.columns[i].validity,
-                                     idx, in_bounds_s, sel_mask)
+        # plan every string column first so any byte totals still needed
+        # come back in a single host transfer (one sync per gather at most)
+        plans = [_gather_string_plan_cap(batch.columns[i].offsets,
+                                         batch.columns[i].validity,
+                                         indices, indices_valid, cap,
+                                         np.int32(out_rows))
                  for i in sidx]
-        totals = jax.device_get([p[2][-1] for p in plans])
-        for i, (starts, lengths, new_offsets, validity), total in zip(
-                sidx, plans, totals):
-            byte_cap = bucket_capacity(max(int(total), 1))
+        byte_caps: List[Optional[int]] = [None] * len(sidx)
+        if _sync_free_strings():
+            for j, i in enumerate(sidx):
+                byte_caps[j] = _string_byte_bound(batch.columns[i], cap,
+                                                  unique_indices)
+        need = [j for j, bc in enumerate(byte_caps) if bc is None]
+        if need:
+            totals = jax.device_get([plans[j][2][-1] for j in need])
+            for j, total in zip(need, totals):
+                byte_caps[j] = bucket_capacity(max(int(total), 1))
+        for j, i in enumerate(sidx):
+            starts, lengths, new_offsets, validity = plans[j]
             out = _gather_string_bytes(batch.columns[i].data, starts,
-                                       new_offsets, lengths, byte_cap)
+                                       new_offsets, lengths, byte_caps[j])
             cols[i] = ColumnVector(DataType.STRING, out, validity,
-                                   new_offsets)
+                                   new_offsets,
+                                   max_len=batch.columns[i].max_len)
     return ColumnarBatch(cols, out_rows)
 
 
@@ -1174,11 +1290,18 @@ def _string_plan_body(offsets, validity, idx, in_bounds, sel_mask):
     return starts, lengths, new_offsets, out_valid
 
 
-@jax.jit
-def _gather_string_plan(offsets, validity, idx, in_bounds, sel_mask):
-    """Fused prelude of a string gather in ONE dispatch (the eager version
-    cost ~6 dispatches per column — expensive when the chip sits behind a
-    network tunnel)."""
+@functools.partial(jax.jit, static_argnums=(4,))
+def _gather_string_plan_cap(offsets, validity, indices, indices_valid,
+                            cap: int, out_rows):
+    """Fused prelude of a string gather in ONE dispatch, masks computed
+    in-trace (each eager mask op costs ~7 ms through a tunneled backend).
+    indices_valid=None (an empty pytree at the jit boundary) selects the
+    unmasked variant at trace time."""
+    idx = indices[:cap]
+    sel_mask = jnp.arange(cap) < out_rows
+    in_bounds = sel_mask & (idx >= 0) & (idx < offsets.shape[0] - 1)
+    if indices_valid is not None:
+        in_bounds = in_bounds & indices_valid[:cap]
     return _string_plan_body(offsets, validity, idx, in_bounds, sel_mask)
 
 
@@ -1252,7 +1375,8 @@ def _gather_batch_traced(batch: ColumnarBatch, indices,
             cv.offsets, cv.validity, indices[:cap], n32)
         out = _gather_string_bytes(cv.data, starts, new_offsets, lengths,
                                    int(cv.data.shape[0]))
-        cols[i] = ColumnVector(DataType.STRING, out, validity, new_offsets)
+        cols[i] = ColumnVector(DataType.STRING, out, validity, new_offsets,
+                               max_len=cv.max_len)
     return ColumnarBatch(cols, out_rows)
 
 

@@ -101,6 +101,20 @@ def _chunk_u64(data, start, remaining):
     return jnp.sum(b << shifts[None, :], axis=1)
 
 
+def _chunk_u32(data, start, remaining):
+    """Load up to 4 bytes per row at `start` as big-endian uint32 —
+    the narrow chunk for short sort keys (32-bit sort comparators skip
+    the TPU's 64-bit pair emulation)."""
+    byte_cap = data.shape[0]
+    idx = start[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :]
+    in_range = jnp.arange(4)[None, :] < remaining[:, None]
+    safe = jnp.clip(idx, 0, byte_cap - 1)
+    b = jnp.where(in_range, data[safe], 0).astype(jnp.uint32)
+    shifts = (jnp.uint32(8) * (3 - jnp.arange(4, dtype=jnp.uint32)))
+    # keep the accumulator uint32: with x64 on, jnp.sum would promote
+    return jnp.sum(b << shifts[None, :], axis=1, dtype=jnp.uint32)
+
+
 def string_cmp3(ctx, lv, rv):
     """Three-way lexicographic byte compare -> int8 array of -1/0/1."""
     l = as_view(ctx, lv)

@@ -307,7 +307,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                             cv, cv.validity & live, gi.gid, capacity,
                             n_chunks, want_min=(op == "min"))
                         buf_outs.append(
-                            (sel, cv.data, cv.offsets, cv.validity))
+                            (sel, cv))
                     else:
                         data, validity = RK.segment_reduce(
                             op, cv.data, cv.validity & live, gi,
@@ -366,7 +366,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                             cv, cv.validity, gi.gid, capacity,
                             n_chunks, want_min=(op == "min"))
                         buf_outs.append(
-                            (sel, cv.data, cv.offsets, cv.validity))
+                            (sel, cv))
                         continue
                     data, validity = RK.segment_reduce(
                         op, cv.data, cv.validity, gi, num_rows, capacity)
@@ -391,9 +391,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 cv.data if (cv.dtype is DataType.STRING
                             or cv.data.dtype == physical_np_dtype(cv.dtype))
                 else cv.data.astype(physical_np_dtype(cv.dtype)),
-                cv.validity, cv.offsets, vrange=cv.vrange)
+                cv.validity, cv.offsets, vrange=cv.vrange,
+                max_len=cv.max_len)
              for cv in key_cols], capacity)
-        gathered = gather_batch(key_batch, gi.rep_rows, n_groups)
+        gathered = gather_batch(key_batch, gi.rep_rows, n_groups,
+                                unique_indices=True)
         out_cap = gathered.capacity if gathered.columns else \
             bucket_capacity(max(n_groups, 1))
         cols = list(gathered.columns)
@@ -401,25 +403,34 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             for i, vr in enumerate(key_vranges[:len(cols)]):
                 if vr is not None and cols[i].vrange is None:
                     cols[i].vrange = vr
+        fixed: List[Tuple[int, Tuple[Any, Any], Any]] = []
+        slots: List[Optional[ColumnVector]] = []
         for out, battr in zip(buf_outs, self.buffer_attrs):
-            if len(out) == 4:
-                # string min/max: (arg-row per group, source string col) —
-                # gather the winning row's string per group
-                sel, src_data, src_offsets, src_validity = out
+            if len(out) == 2 and getattr(out[1], "is_string", False):
+                # string min/max: (arg-row per group, source string ColV) —
+                # gather the winning row's string per group (the ColV rides
+                # the jit pytree so its max_len bound survives the kernel)
+                sel, scv = out
                 src = ColumnarBatch(
-                    [ColumnVector(DataType.STRING, src_data, src_validity,
-                                  src_offsets)], capacity)
-                g = gather_batch(src, sel, n_groups)
-                cols.append(g.columns[0])
+                    [ColumnVector(DataType.STRING, scv.data, scv.validity,
+                                  scv.offsets, max_len=scv.max_len)],
+                    capacity)
+                g = gather_batch(src, sel, n_groups, unique_indices=True)
+                slots.append(g.columns[0])
                 continue
-            data, validity = out
-            d = data[:out_cap]
-            v = validity[:out_cap] & (jnp.arange(out_cap) < n_groups)
-            npdt = physical_np_dtype(battr.data_type)
-            if d.dtype != jnp.dtype(npdt):
-                d = d.astype(npdt)
-            d = jnp.where(v, d, jnp.zeros((), d.dtype))
-            cols.append(ColumnVector(battr.data_type, d, v))
+            fixed.append((len(slots), out, battr.data_type))
+            slots.append(None)
+        if fixed:
+            # ONE dispatch finalizes every fixed-width buffer column
+            # (eager per-column slice+mask glue costs ~7 ms per op through
+            # a tunneled backend)
+            npdts = tuple(physical_np_dtype(dt) for _, _, dt in fixed)
+            kern = _finalize_kernel(out_cap, npdts)
+            outs = kern([o for _, o, _ in fixed], np.int32(n_groups))
+            for (si, _o, dt), (d, v) in zip(fixed, outs):
+                slots[si] = ColumnVector(dt, d, v)
+        assert all(c is not None for c in slots)
+        cols.extend(slots)
         return ColumnarBatch(cols, n_groups)
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
@@ -496,7 +507,10 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 update_lazy = fence_cost_ms() >= LAZY_FENCE_THRESHOLD_MS
 
         def count_arg(b: ColumnarBatch):
-            return jnp.asarray(b.num_rows, dtype=jnp.int32)
+            n = b.num_rows
+            if isinstance(n, (int, np.integer)):
+                return np.int32(n)  # host count: no eager device convert
+            return jnp.asarray(n, dtype=jnp.int32)
 
         def merge(batch: ColumnarBatch) -> ColumnarBatch:
             nc = str_chunks(batch, str_merge_ords)
@@ -607,6 +621,30 @@ def _synth_col(batch: ColumnarBatch):
     cap = bucket_capacity(max(batch.num_rows, 1))
     return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
                 jnp.arange(cap) < batch.num_rows)
+
+
+def _finalize_kernel(out_cap: int, npdts: tuple):
+    """Jitted finalizer for _assemble's fixed-width buffer columns: slice
+    to the output capacity, mask dead slots, restore storage dtypes — all
+    columns in ONE device dispatch."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    def build():
+        @jax.jit
+        def fn(outs, n_groups):
+            slot = jnp.arange(out_cap) < n_groups
+            res = []
+            for (data, validity), npdt in zip(outs, npdts):
+                d = data[:out_cap]
+                v = validity[:out_cap] & slot
+                if d.dtype != jnp.dtype(npdt):
+                    d = d.astype(npdt)
+                d = jnp.where(v, d, jnp.zeros((), d.dtype))
+                res.append((d, v))
+            return res
+        return fn
+
+    return get_or_build(("agg_finalize", out_cap, npdts), build)
 
 
 def _assemble_traced(key_cols, buf_outs, gi, capacity: int, buffer_npdts):

@@ -34,6 +34,28 @@ _DEVICE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _HOST_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
+def cached_row_count(logical_node):
+    """Total materialized rows of a cached relation, or None if the cache
+    has not been populated yet (planner statistics hook: iteration 2+ of a
+    cached query plans with exact input counts)."""
+    with _LOCK:
+        parts = _DEVICE_CACHE.get(logical_node)
+        if parts is None:
+            parts = _HOST_CACHE.get(logical_node)
+    if parts is None:
+        return None
+    total = 0
+    for part in parts:
+        for b in part:
+            # device-cache entries are SpillableBuffers wrapping the batch
+            b = getattr(b, "device_batch", None) or b
+            n = getattr(b, "num_rows", None)
+            if not isinstance(n, int):
+                return None  # device-resident count: not worth a sync here
+            total += n
+    return total
+
+
 def invalidate(logical_node) -> None:
     with _LOCK:
         dropped = _DEVICE_CACHE.pop(logical_node, None)

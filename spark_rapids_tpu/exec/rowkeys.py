@@ -102,16 +102,35 @@ def key_proxy(col: ColV) -> KeyProxy:
 
 
 def string_order_proxy(col: ColV, n_chunks: int) -> KeyProxy:
-    """ORDERABLE string proxy: n_chunks big-endian uint64 byte chunks plus a
-    length tie-break (shorter sorts first when one string is a prefix of the
+    """ORDERABLE string proxy: big-endian byte-chunk keys plus a length
+    tie-break (shorter sorts first when one string is a prefix of the
     other, matching UTF-8 byte order == code point order). EXACT whenever
-    8*n_chunks >= the longest string in the batch — callers compute that
+    the chunks cover the batch's longest string — callers compute that
     bound outside jit and pass it as a static arg (the cudf device string
     comparator this replaces: reference GpuSortExec via Table.orderBy,
-    GpuSortExec.scala:100-235)."""
+    GpuSortExec.scala:100-235).
+
+    Columns with a host-known max_len <= 8 use uint32 chunks instead of
+    uint64 ones: sort comparators over emulated 64-bit pairs are the
+    hottest TPU lane, and short keys (flags, status codes) don't need
+    them."""
     lens = col.offsets[1:] - col.offsets[:-1]
-    arrays = [jnp.where(col.validity, c, jnp.uint64(0))
-              for c in _string_chunk_keys(col, n_chunks)]
+    ml = col.max_len
+    if ml is not None and ml <= 8:
+        from spark_rapids_tpu.columnar import strings as STR
+
+        starts = col.offsets[:-1]
+        widths = [4] if ml <= 4 else [4, 4]
+        arrays = []
+        off = 0
+        for _w in widths:
+            c = STR._chunk_u32(col.data, starts + off,
+                               jnp.maximum(lens - off, 0))
+            arrays.append(jnp.where(col.validity, c, jnp.uint32(0)))
+            off += 4
+    else:
+        arrays = [jnp.where(col.validity, c, jnp.uint64(0))
+                  for c in _string_chunk_keys(col, n_chunks)]
     arrays.append(jnp.where(col.validity, lens, 0))
     return KeyProxy(tuple(arrays), ~col.validity, True)
 
@@ -130,8 +149,16 @@ def _string_chunk_keys(col: ColV, n_chunks: int):
 
 
 def string_chunks_needed(col_or_lens) -> int:
-    """Bucketed chunk count for a batch's longest string (host sync; the
-    static-shape discipline of SURVEY.md section 7 hard part #3)."""
+    """Bucketed chunk count for a batch's longest string (the static-shape
+    discipline of SURVEY.md section 7 hard part #3). A column carrying a
+    host-known max_len bound answers without a device round trip — and
+    because both the bound and the chunk count are pow2-bucketed, the
+    bucket is IDENTICAL to the synced exact answer (pow2(ceil(x/8)) ==
+    pow2(x)/8 for x > 8), so kernels keyed on it never over-widen."""
+    ml = getattr(col_or_lens, "max_len", None)
+    if ml is not None:
+        chunks = max(1, -(-int(ml) // 8))
+        return 1 << (chunks - 1).bit_length()
     if hasattr(col_or_lens, "offsets"):
         lens = col_or_lens.offsets[1:] - col_or_lens.offsets[:-1]
     else:
@@ -307,6 +334,34 @@ def _seg_ids(gid, validity, capacity: int):
     return jnp.where(validity, gid, capacity)
 
 
+def _cumsum_wrap(x):
+    """Cumulative sum with modular-wrap semantics. 64-bit integer input on
+    an accelerator rides two uint32 lanes with carry reconstruction (exact
+    mod 2^64: lo-lane wrap at step i shows as clo[i] < clo[i-1], and the
+    running wrap count is the hi-lane carry) instead of XLA's 32-bit-pair
+    int64 emulation, whose log2(n) scan levels each pay the measured 9.18x
+    emulation tax (BENCH_I64_r04.json; exactness check in
+    tools/tpu_kernel_micro2.py). CPU XLA has native int64 — keep the plain
+    cumsum there (the 2-lane form measured ~2.5x slower on CPU)."""
+    dt = jnp.dtype(x.dtype)
+    if dt.kind not in "iu" or dt.itemsize < 8 \
+            or jax.default_backend() == "cpu":
+        return jnp.cumsum(x)
+    return _cumsum_wrap_lanes(x)
+
+
+def _cumsum_wrap_lanes(x):
+    u = x.astype(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+    clo = jnp.cumsum(lo)
+    prev = jnp.concatenate([jnp.zeros((1,), jnp.uint32), clo[:-1]])
+    carries = jnp.cumsum((clo < prev).astype(jnp.uint32))
+    chi = jnp.cumsum(hi) + carries
+    out = (chi.astype(jnp.uint64) << jnp.uint64(32)) | clo.astype(jnp.uint64)
+    return out.astype(x.dtype)
+
+
 def _sorted_group_totals(per_row_sorted, gi: GroupInfo, capacity: int):
     """Per-group total of an already-sorted per-row array via ONE cumulative
     sum + boundary gathers — the TPU-fast replacement for an unsorted
@@ -315,7 +370,7 @@ def _sorted_group_totals(per_row_sorted, gi: GroupInfo, capacity: int):
     wrapped per-group sum in modular arithmetic, the same wrap the scatter
     path has. Requires dense groups (every gid < num_groups has >= 1 member
     row — group_ids guarantees this); slots >= num_groups return 0."""
-    cs = jnp.cumsum(per_row_sorted)
+    cs = _cumsum_wrap(per_row_sorted)
     ends = jnp.clip(gi.seg_ends, 0, capacity - 1)
     tot = cs[ends]
     prev = jnp.concatenate([jnp.zeros((1,), tot.dtype), tot[:-1]])

@@ -125,8 +125,9 @@ class TpuSortExec(_SortBase, TpuExec):
                         for i in str_ords)
                 kernel = self._build_kernel(child_attrs, n_chunks)
                 cols = [_col_to_colv(c) for c in batch.columns]
-                perm = kernel(cols, jnp.int32(batch.num_rows))
-                yield gather_batch(batch, perm, batch.num_rows)
+                perm = kernel(cols, np.int32(batch.num_rows))
+                yield gather_batch(batch, perm, batch.num_rows,
+                                   unique_indices=True)
 
         def factory(pidx: int):
             return count_output(self.metrics, sort_partition(pidx))

@@ -750,6 +750,237 @@ def _parse_dict_strings(chunk: bytes, start: int, n: int):
     return dict_bytes, offs, lens
 
 
+def _host_count_ones(chunk_np: np.ndarray, rt: RunTable, n: int) -> int:
+    """Number of 1-bits among the first n values of a bit-width-1 hybrid
+    stream, computed ON HOST from the run table + raw bytes. This is what
+    lets the whole-chunk flat decode know every page's present-value count
+    without the per-page device round trip that cost the device tier 12x
+    vs host decode (BENCH_DECODE_r04.json: one ~66 ms sync per page)."""
+    total = 0
+    n_runs = len(rt.out_start)
+    for i in range(n_runs):
+        start = int(rt.out_start[i])
+        end = int(rt.out_start[i + 1]) if i + 1 < n_runs else rt.total
+        cnt = min(end, n) - start
+        if cnt <= 0:
+            continue
+        if rt.is_rle[i]:
+            total += (int(rt.value[i]) & 1) * cnt
+        else:
+            b0 = int(rt.bit_off[i]) >> 3  # byte-aligned for bit-packed runs
+            nb = (cnt + 7) >> 3
+            bits = np.unpackbits(chunk_np[b0:b0 + nb], bitorder="little")
+            total += int(bits[:cnt].sum())
+    return total
+
+
+def _shifted_tab(rt: RunTable, row_shift: int, n: int):
+    """Run table adjusted to a chunk-global output offset (numpy)."""
+    return (rt.out_start.astype(np.int32) + np.int32(row_shift),
+            rt.is_rle.astype(bool), rt.value.astype(np.int32),
+            rt.bit_off.astype(np.int64))
+
+
+def _synth_rle_tab(row_shift: int, value: int):
+    return (np.asarray([row_shift], np.int32), np.asarray([True], bool),
+            np.asarray([value], np.int32), np.asarray([0], np.int64))
+
+
+def _pack_flat_tabs(tabs):
+    """Concatenate shifted run tables and pad the run count to a pow2
+    bucket (pads carry out_start = INT32_MAX so searchsorted never selects
+    them) — run-count variation between chunks must not retrace."""
+    out_start = np.concatenate([t[0] for t in tabs])
+    is_rle = np.concatenate([t[1] for t in tabs])
+    value = np.concatenate([t[2] for t in tabs])
+    bit_off = np.concatenate([t[3] for t in tabs])
+    n = len(out_start)
+    padded = max(8, 1 << (n - 1).bit_length()) if n else 8
+    if padded > n:
+        pad = padded - n
+        out_start = np.pad(out_start, (0, pad),
+                           constant_values=np.iinfo(np.int32).max)
+        is_rle = np.pad(is_rle, (0, pad), constant_values=True)
+        value = np.pad(value, (0, pad))
+        bit_off = np.pad(bit_off, (0, pad))
+    return (out_start, is_rle, value, bit_off)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7))
+def _flat_dict_kernel(chunk_u8, def_tab, val_tab, dict_vals, bw: int,
+                      cap: int, cap_p: int, has_def: bool):
+    """Whole-chunk dictionary decode in one program: validity expansion,
+    index expansion, dictionary gather, dense->row assembly."""
+    if has_def:
+        validity = _expand_hybrid(chunk_u8, *def_tab, 1, cap).astype(bool)
+    else:
+        validity = jnp.ones((cap,), bool)
+    idx = _expand_hybrid(chunk_u8, *val_tab, bw, cap_p)
+    dense = dict_vals[jnp.clip(idx, 0, dict_vals.shape[0] - 1)]
+    return dense, validity
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _flat_plain_kernel(chunk_u8, def_tab, page_meta, np_dtype_name: str,
+                       cap: int, cap_p: int, has_def: bool):
+    """Whole-chunk PLAIN decode: per-lane page lookup (searchsorted over
+    dense offsets), byte gather, bitcast. page_meta: int32/int64 [2, m] =
+    (dense_end, byte_pos)."""
+    if has_def:
+        validity = _expand_hybrid(chunk_u8, *def_tab, 1, cap).astype(bool)
+    else:
+        validity = jnp.ones((cap,), bool)
+    dt = np.dtype(np_dtype_name)
+    w = dt.itemsize
+    i = jnp.arange(cap_p, dtype=jnp.int32)
+    dense_end = page_meta[0]
+    page = jnp.searchsorted(dense_end, i, side="right").astype(jnp.int32)
+    page = jnp.minimum(page, dense_end.shape[0] - 1)
+    dense_start = jnp.concatenate([jnp.zeros((1,), dense_end.dtype),
+                                   dense_end[:-1]])
+    local = i - dense_start[page]
+    base = page_meta[1][page] + local.astype(page_meta.dtype) * w
+    idx = base[:, None] + jnp.arange(w, dtype=page_meta.dtype)[None, :]
+    seg = chunk_u8[jnp.clip(idx, 0, chunk_u8.shape[0] - 1)]
+    dense = jax.lax.bitcast_convert_type(seg.reshape(cap_p, w),
+                                         jnp.dtype(dt))
+    return dense, validity
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _flat_finish(dense, validity, nums, cap: int):
+    """Mask validity to the row count and spread dense values to rows."""
+    validity = validity & (jnp.arange(cap) < nums[0])
+    data = _assemble(validity, dense, cap)
+    return data, validity
+
+
+def _try_flat_fixed(chunk: bytes, chunk_dev, pages, dtype: DataType,
+                    num_rows: int, max_def: int, cap: int, npdt):
+    """Whole-chunk fixed-width decode with ZERO per-page device work:
+    host computes every page's present count (bit-popcount over def-level
+    bytes), all pages' run tables concatenate into one flat table (output
+    offsets made chunk-global; bit offsets are already chunk-absolute),
+    and 2-3 jitted dispatches decode the entire chunk. Returns a
+    ColumnVector, or None when the chunk's shape needs the general
+    per-page path (mixed/exotic encodings, strings, bools, FLBA).
+
+    Reference bar: on-accelerator decode is the FAST path
+    (GpuParquetScan.scala:536-556); round 4's per-page loop paid one
+    ~66 ms sync + ~9 eager dispatches per page through the tunnel
+    (tools/decode_census.py: 648 syncs + 6015 eager ops per iteration)."""
+    from spark_rapids_tpu.columnar.batch import ColumnVector
+    from spark_rapids_tpu.columnar.dtypes import is_decimal
+
+    if dtype in (DataType.STRING, DataType.BOOL):
+        return None
+    if is_decimal(dtype) and np.dtype(npdt) not in (np.dtype(np.int32),
+                                                    np.dtype(np.int64)):
+        return None
+    data_pages = [p for p in pages if p.kind in (PAGE_DATA_V1,
+                                                 PAGE_DATA_V2)]
+    dict_pages = [p for p in pages if p.kind == PAGE_DICT]
+    if not data_pages or len(dict_pages) > 1:
+        return None
+    if any(p.rep_len for p in data_pages):
+        return None
+    encs = {p.encoding for p in data_pages}
+    dict_mode = bool(dict_pages) and encs <= {ENC_PLAIN_DICT, ENC_RLE_DICT}
+    plain_mode = not dict_pages and encs == {ENC_PLAIN}
+    if not (dict_mode or plain_mode):
+        return None
+    chunk_np = np.frombuffer(chunk, dtype=np.uint8)
+    def_tabs = []
+    val_tabs = []
+    plain_dense_end = []
+    plain_pos = []
+    rows = 0
+    present = 0
+    bw = None
+    for p in data_pages:
+        pos = p.data_start
+        end = p.data_start + p.data_len
+        if p.kind == PAGE_DATA_V2:
+            if max_def > 0 and p.def_len > 0:
+                rt = parse_runs(chunk, pos, pos + p.def_len, 1,
+                                p.num_values)
+                n_present = _host_count_ones(chunk_np, rt, p.num_values)
+                def_tabs.append(_shifted_tab(rt, rows, p.num_values))
+            else:
+                n_present = p.num_values
+                def_tabs.append(_synth_rle_tab(rows, 1))
+            pos += p.def_len
+        elif max_def > 0:
+            dl_len = int.from_bytes(chunk[pos:pos + 4], "little")
+            rt = parse_runs(chunk, pos + 4, pos + 4 + dl_len, 1,
+                            p.num_values)
+            n_present = _host_count_ones(chunk_np, rt, p.num_values)
+            def_tabs.append(_shifted_tab(rt, rows, p.num_values))
+            pos += 4 + dl_len
+        else:
+            n_present = p.num_values
+            def_tabs.append(_synth_rle_tab(rows, 1))
+        if dict_mode:
+            pbw = chunk[pos]
+            pos += 1
+            if pbw > 24:
+                return None
+            if pbw == 0:
+                val_tabs.append(_synth_rle_tab(present, 0))
+            else:
+                if bw is None:
+                    bw = pbw
+                elif bw != pbw:
+                    return None
+                rt = parse_runs(chunk, pos, end, pbw, n_present)
+                val_tabs.append(_shifted_tab(rt, present, n_present))
+        else:
+            plain_dense_end.append(present + n_present)
+            plain_pos.append(pos)
+        rows += p.num_values
+        present += n_present
+    has_def = max_def > 0
+    cap_p = bucket_capacity(max(present, 1))
+    def_tab = tuple(jnp.asarray(a) for a in _pack_flat_tabs(def_tabs)) \
+        if has_def else _EMPTY_TAB()
+    nums = np.asarray([num_rows, present], np.int32)
+    if dict_mode:
+        dp = dict_pages[0]
+        dict_vals = _bitcast_values(chunk_dev, np.int32(dp.data_start),
+                                    dp.num_values, np.dtype(npdt).name)
+        val_tab = tuple(jnp.asarray(a) for a in _pack_flat_tabs(val_tabs))
+        dense, validity = _flat_dict_kernel(
+            chunk_dev, def_tab, val_tab, dict_vals, int(bw or 1), cap,
+            cap_p, has_def)
+    else:
+        meta = np.zeros((2, len(plain_pos)), np.int64)
+        meta[0] = plain_dense_end
+        meta[1] = plain_pos
+        if int(meta.max()) * np.dtype(npdt).itemsize < (1 << 31):
+            meta = meta.astype(np.int32)
+        dense, validity = _flat_plain_kernel(
+            chunk_dev, def_tab, meta, np.dtype(npdt).name, cap, cap_p,
+            has_def)
+    data, validity = _flat_finish(dense, validity, nums, cap)
+    return ColumnVector(dtype, data, validity)
+
+
+_EMPTY_TAB_CACHE = None
+
+
+def _EMPTY_TAB():
+    # cached: rebuilding would pay 4 host->device uploads per chunk of
+    # every required column (device_const-style interning, local form)
+    global _EMPTY_TAB_CACHE
+    if _EMPTY_TAB_CACHE is None:
+        _EMPTY_TAB_CACHE = (
+            jnp.asarray(np.full((1,), np.iinfo(np.int32).max, np.int32)),
+            jnp.asarray(np.ones((1,), bool)),
+            jnp.asarray(np.zeros((1,), np.int32)),
+            jnp.asarray(np.zeros((1,), np.int64)))
+    return _EMPTY_TAB_CACHE
+
+
 def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                         max_def: int, cap: Optional[int] = None,
                         codec: str = "UNCOMPRESSED", flba_len: int = 0):
@@ -787,6 +1018,12 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         raise _Unsupported(f"FLBA decimal byte length {flba_len}")
     npdt = np.dtype(np.int32) if is_string else physical_np_dtype(dtype)
     chunk_dev = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
+
+    if not is_string and not is_dec_flba:
+        flat = _try_flat_fixed(chunk, chunk_dev, pages, dtype, num_rows,
+                               max_def, cap, npdt)
+        if flat is not None:
+            return flat
 
     dict_vals = None          # fixed-width dictionary values (device)
     str_dict = None           # (bytes_dev, offs_dev, lens_dev) for strings

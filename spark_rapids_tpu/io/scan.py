@@ -86,6 +86,25 @@ def _orc_stats_vrange(attr, meta) -> Optional[Tuple[int, int]]:
     return None
 
 
+def _stack_minmax(reds):
+    """Stack per-column (any_valid, lo, hi) scalars into one [n, 3] int64
+    array so the verify fetch is a single host round trip."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        def fn(rs):
+            return jnp.stack([
+                jnp.stack([a.astype(jnp.int64), lo.astype(jnp.int64),
+                           hi.astype(jnp.int64)])
+                for a, lo, hi in rs])
+        return jax.jit(fn)
+
+    return get_or_build(("scan_minmax_stack", len(reds)), build)(reds)
+
+
 def _minmax_valid(data, validity):
     """(any_valid, min, max) over valid lanes — jitted via the process cache
     so every int64 column shares one compiled reduction per shape bucket."""
@@ -122,7 +141,12 @@ def verify_footer_vranges(dev_cols: Dict[str, "ColumnVector"]) -> List[str]:
     if not claimed:
         return []
     reds = [_minmax_valid(cv.data, cv.validity) for _, cv in claimed]
-    vals = jax.device_get(reds)
+    # ONE stacked transfer: per-scalar device_get blocks once per leaf,
+    # which on a tunneled backend costs a ~66 ms fence each
+    stacked = _stack_minmax(tuple(reds))
+    flat = np.asarray(jax.device_get(stacked))
+    vals = [(bool(flat[i, 0]), int(flat[i, 1]), int(flat[i, 2]))
+            for i in range(len(reds))]
     dropped: List[str] = []
     for (name, cv), (any_valid, mn, mx) in zip(claimed, vals):
         if not bool(any_valid):

@@ -35,23 +35,24 @@ from spark_rapids_tpu.ops.values import ColV, EvalContext, ScalarV, broadcast_sc
 jax.tree_util.register_pytree_node(
     ColV,
     lambda cv: (
-        ((cv.data, cv.validity, cv.offsets), (cv.dtype, True, cv.vrange))
+        ((cv.data, cv.validity, cv.offsets),
+         (cv.dtype, True, cv.vrange, cv.max_len))
         if cv.offsets is not None
-        else ((cv.data, cv.validity), (cv.dtype, False, cv.vrange))
+        else ((cv.data, cv.validity), (cv.dtype, False, cv.vrange, None))
     ),
     lambda aux, ch: ColV(aux[0], ch[0], ch[1], ch[2] if aux[1] else None,
-                         vrange=aux[2]),
+                         vrange=aux[2], max_len=aux[3]),
 )
 
 
 def _col_to_colv(cv: ColumnVector) -> ColV:
     return ColV(cv.dtype, cv.data, cv.validity, cv.offsets,
-                vrange=cv.vrange)
+                vrange=cv.vrange, max_len=cv.max_len)
 
 
 def _colv_to_col(cv: ColV) -> ColumnVector:
     return ColumnVector(cv.dtype, cv.data, cv.validity, cv.offsets,
-                        vrange=cv.vrange)
+                        vrange=cv.vrange, max_len=cv.max_len)
 
 
 def _widen_physical(cv: ColV) -> ColV:

@@ -38,6 +38,9 @@ class ColV:
     validity: Any
     offsets: Optional[Any] = None
     vrange: Optional[tuple] = None
+    # static pow2 bound on any single string's byte length (STRING only;
+    # None = unknown) — see ColumnVector.max_len
+    max_len: Optional[int] = None
 
     @property
     def is_string(self) -> bool:
@@ -110,7 +113,7 @@ def narrow_colv(cv: ColV) -> ColV:
             and hasattr(cv.data, "astype")
             and np.dtype(cv.data.dtype).itemsize > 4):
         return ColV(cv.dtype, cv.data.astype(np.int32), cv.validity,
-                    cv.offsets, cv.vrange)
+                    cv.offsets, cv.vrange, cv.max_len)
     return cv
 
 

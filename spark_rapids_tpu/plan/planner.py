@@ -287,8 +287,14 @@ def _plan_sort(plan: L.Sort, conf: C.TpuConf) -> PhysicalExec:
 
 
 def _estimate_rows(plan: L.LogicalPlan):
-    """Best-effort row estimate for the broadcast-join decision (the
-    reference rides Spark's statistics; this is the standalone stand-in)."""
+    """Best-effort UPPER-BOUND row estimate for the broadcast-join decision
+    (the reference rides Spark's statistics; this is the standalone
+    stand-in). Descends through joins (equi inner/outer output is bounded
+    by the larger side times matches — approximated by max, the FK-join
+    case), aggregates (grouped output <= input), and cached relations
+    (exact counts once materialized), so multi-join plans like TPC-H q7
+    can statically broadcast their small intermediate sides instead of
+    re-exchanging the fact stream at every level."""
     if isinstance(plan, L.LocalRelation):
         return sum(b.num_rows for part in plan.partitions for b in part)
     if isinstance(plan, L.RangeRelation):
@@ -297,8 +303,35 @@ def _estimate_rows(plan: L.LogicalPlan):
     if isinstance(plan, L.Limit):
         child = _estimate_rows(plan.children[0])
         return plan.n if child is None else min(plan.n, child)
-    if isinstance(plan, (L.Project, L.Filter, L.Sort, L.Repartition)):
+    if isinstance(plan, (L.Project, L.Filter, L.Sort, L.Repartition,
+                         L.WindowOp, L.Aggregate)):
         return _estimate_rows(plan.children[0])
+    if isinstance(plan, L.CacheRelation):
+        from spark_rapids_tpu.exec.cache import cached_row_count
+
+        n = cached_row_count(plan)
+        return n if n is not None else _estimate_rows(plan.children[0])
+    if isinstance(plan, L.Union):
+        parts = [_estimate_rows(c) for c in plan.children]
+        return None if any(p is None for p in parts) else sum(parts)
+    if isinstance(plan, L.Expand):
+        child = _estimate_rows(plan.children[0])
+        return None if child is None else child * max(
+            len(plan.projections), 1)
+    if isinstance(plan, L.Join):
+        if plan.join_type is L.JoinType.CROSS:
+            l, r = (_estimate_rows(c) for c in plan.children)
+            return None if l is None or r is None else l * r
+        if plan.join_type in (L.JoinType.LEFT_SEMI, L.JoinType.LEFT_ANTI):
+            # filtering joins never emit more than their left input
+            return _estimate_rows(plan.children[0])
+        # Equi-join output is NOT boundable from input sizes (an m:n key
+        # reaches l*r); a statically-planned broadcast has no runtime
+        # size guard, so joins deliberately estimate unknown here. A
+        # small JOINED build side still broadcasts at runtime: the
+        # shuffled plan's runtime_broadcast_probe (exec/join.py) decides
+        # on the build's ACTUAL materialized bytes.
+        return None
     return None
 
 
@@ -344,19 +377,38 @@ def _plan_join(plan: L.Join, conf: C.TpuConf) -> PhysicalExec:
 
     # broadcast decision on the build side (right, or left for right-outer);
     # full outer cannot broadcast (unmatched-build tail would duplicate)
+    def est_bytes_of(side_logical):
+        est = _estimate_rows(side_logical)
+        if est is None:
+            return None
+        return est * max(1, sum(a.data_type.itemsize
+                                for a in side_logical.output))
+
     build_is_left = jt is L.JoinType.RIGHT_OUTER
     build_logical = plan.children[0] if build_is_left else plan.children[1]
-    est = _estimate_rows(build_logical)
-    if est is not None:
-        est_bytes = est * max(1, sum(a.data_type.itemsize
-                                     for a in build_logical.output))
-    else:
-        est_bytes = None
+    est_bytes = est_bytes_of(build_logical)
     threshold = conf.get(C.BROADCAST_THRESHOLD)
     if jt is not L.JoinType.FULL_OUTER and est_bytes is not None and \
             est_bytes <= threshold:
         return CpuBroadcastHashJoinExec(left_keys, right_keys, jt,
                                         plan.condition, left, right)
+    if jt is L.JoinType.INNER and not build_is_left:
+        # an INNER join can build on either side: when the right side is
+        # too big (or unbounded) but the LEFT estimates under the
+        # threshold, swap the children and broadcast — then restore the
+        # original column order with a projection. This is the static
+        # form of the runtime probe's build-side swap (exec/join.py
+        # runtime_broadcast_probe), reached without materializing the big
+        # side first; reference analog: Spark planning BroadcastHashJoin
+        # with BuildLeft from statistics.
+        from spark_rapids_tpu.exec.basic import CpuProjectExec
+
+        left_bytes = est_bytes_of(plan.children[0])
+        if left_bytes is not None and left_bytes <= threshold:
+            swapped = CpuBroadcastHashJoinExec(
+                right_keys, left_keys, jt, plan.condition, right, left)
+            out = list(left.output) + list(right.output)
+            return CpuProjectExec(out, swapped)
     n = conf.shuffle_partitions
     left_ex = CpuShuffleExchangeExec(HashPartitioning(left_keys, n), left)
     right_ex = CpuShuffleExchangeExec(HashPartitioning(right_keys, n), right)

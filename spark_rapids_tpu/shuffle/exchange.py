@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from spark_rapids_tpu import conf as C
 from spark_rapids_tpu.columnar.batch import (
     ColumnarBatch,
+    ColumnVector,
     HostColumnarBatch,
     HostColumnVector,
     bucket_capacity,
@@ -213,10 +214,26 @@ class _ExchangeBase(PhysicalExec):
 
         def factory(pidx: int):
             def gen():
+                # fuse runs of routed slices into one batch per <=16 slices
+                # (the assemble kernel unrolls per slice; 16 bounds compile
+                # size while one fused gather replaces piece-wise
+                # gather+concat)
+                routed: List[_RoutedSlice] = []
                 for piece in reduce_buckets[pidx]:
+                    if isinstance(piece, _RoutedSlice):
+                        routed.append(piece)
+                        if len(routed) >= 16:
+                            yield _assemble_routed(routed)
+                            routed = []
+                        continue
+                    if routed:
+                        yield _assemble_routed(routed)
+                        routed = []
                     if isinstance(piece, _SerializedPiece):
                         piece = piece.decode(to_device)
                     yield piece
+                if routed:
+                    yield _assemble_routed(routed)
             return count_output(self.metrics, gen())
 
         pb = PartitionedBatches(n_out, factory, bucket_costs=costs)
@@ -260,6 +277,8 @@ def _piece_cost(piece, n_out: int) -> int:
 def _piece_bytes(piece) -> int:
     if isinstance(piece, _SerializedPiece):
         return piece.size
+    if isinstance(piece, _RoutedSlice):
+        return piece.device_memory_size()  # pro-rata share of the source
     if isinstance(piece, ColumnarBatch):
         if piece.live is not None:
             # zero-copy view sharing the source batch: counting the full
@@ -618,20 +637,26 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 
         no_strings = all(a.data_type is not DataType.STRING
                          for a in child_attrs)
+        serialize = ctx.conf.get(C.SHUFFLE_SERIALIZE)
 
         def slicer(batch, ids, n_):
             # lazy zero-copy views keep FULL source capacity per piece, so
             # the reduce side would run kernels over sum-of-capacities
             # lanes. Worth it only for small batches (e.g. partial-agg
-            # output); big scans use the count-synced contiguous split.
-            # (Measured on the tunneled single-chip backend: raising this
-            # cap to cover scan-sized batches multiplies reduce-side lane
-            # counts 8-16x and regressed the flagship query 13x — the
+            # output); big scans use routed range views (one routing
+            # dispatch + one counts sync per batch, fused reduce-side
+            # assembly). The serialized tier needs materialized pieces, so
+            # it keeps the per-target contiguous split.
+            # (Measured on the tunneled single-chip backend: raising the
+            # lazy cap to cover scan-sized batches multiplies reduce-side
+            # lane counts 8-16x and regressed the flagship query 13x — the
             # per-lane cost is NOT free even where host fences dominate.)
             if no_strings and \
                     batch.device_memory_size() <= LAZY_PIECE_CAP_BYTES:
                 return _device_slices_lazy(batch, ids, n_)
-            return _device_slices(batch, ids, n_)
+            if serialize:
+                return _device_slices(batch, ids, n_)
+            return _device_slices_routed(batch, ids, n_)
 
         if isinstance(p, RoundRobinPartitioning):
             jitted = _jit_rr_ids(n)
@@ -946,12 +971,207 @@ def _device_slices(batch: ColumnarBatch, ids, n: int):
         c = int(counts[t])
         if c == 0:
             continue
-        idx = _slice_indices(order, jnp.int32(offset),
+        idx = _slice_indices(order, np.int32(offset),
                              bucket_capacity(max(c, 1)))
-        piece = gather_batch(batch, idx, c)
+        piece = gather_batch(batch, idx, c, unique_indices=True)
         out.append((t, piece))
         offset += c
     return out
+
+
+class _RoutedSlice:
+    """One target's rows of a route-sorted map batch, held as a ZERO-KERNEL
+    view: `order[start : start+count]` indexes the (still-shared) source
+    batch. The map side pays ONE routing dispatch + ONE counts sync per
+    batch and no per-target kernels; the reduce side assembles all of a
+    bucket's slices — across map batches — with ONE fused gather
+    (_assemble_routed). This in-process promotion of the reference's
+    device-resident shuffle (RapidsShuffleInternalManager.scala:92-141)
+    replaces the per-piece gather+concat pipeline that cost ~1000 kernel
+    launches per exchange epoch (tools/shuffle_census.py, round 5)."""
+
+    __slots__ = ("batch", "order", "start", "count")
+
+    def __init__(self, batch: ColumnarBatch, order, start: int, count: int):
+        self.batch = batch
+        self.order = order
+        self.start = start
+        self.count = count
+
+    @property
+    def rows_on_host(self) -> bool:
+        return True
+
+    @property
+    def num_rows(self) -> int:
+        return self.count
+
+    def device_memory_size(self) -> int:
+        # pro-rata share of the shared source (for coalesce cost models)
+        cap = max(self.batch.capacity, 1)
+        return self.batch.device_memory_size() * self.count // cap
+
+    def to_batch(self) -> ColumnarBatch:
+        return _assemble_routed([self])
+
+
+def _device_slices_routed(batch: ColumnarBatch, ids, n: int):
+    """Route once, sync the 16-int counts vector once, emit zero-kernel
+    range views (see _RoutedSlice)."""
+    cap = batch.capacity
+    order, counts_dev = _route_plan(ids[:cap], n)
+    counts = np.asarray(jax.device_get(counts_dev))
+    out = []
+    offset = 0
+    for t in range(n):
+        c = int(counts[t])
+        if c:
+            out.append((t, _RoutedSlice(batch, order, offset, c)))
+        offset += c
+    return out
+
+
+def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
+    """Concatenate routed slices (possibly from different map batches) into
+    one compact batch with ONE fused kernel. Static shape key: per-slice
+    source capacities + dtypes + output bucket — starts/counts ride as a
+    device argument, so batch-to-batch count variation never recompiles.
+    String byte capacity is host-known without a sync: routing uses each
+    source row at most once, so a bucket's bytes are bounded by the sum of
+    its sources' byte buffers (tightened by out_cap * max_len when known)."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    total = sum(s.count for s in slices)
+    cap_out = bucket_capacity(max(total, 1))
+    first = slices[0].batch
+    dtypes = tuple(c.dtype for c in first.columns)
+    src_caps = tuple(s.batch.capacity for s in slices)
+    byte_caps = []
+    for ci, dt in enumerate(dtypes):
+        if dt is not DataType.STRING:
+            byte_caps.append(0)
+            continue
+        bound = sum(int(s.batch.columns[ci].data.shape[0]) for s in slices)
+        mls = [s.batch.columns[ci].max_len for s in slices]
+        if all(m is not None for m in mls):
+            bound = min(bound, cap_out * max(mls))
+        byte_caps.append(bucket_capacity(max(bound, 1)))
+    key = ("routed_assemble", len(slices), src_caps, dtypes,
+           tuple(byte_caps), cap_out)
+
+    def build():
+        m = len(slices)
+
+        def kernel(cols_by_slice, orders, meta):
+            # meta: int32 [3, m] rows = (start, count, cum_start_out)
+            j = jnp.arange(cap_out, dtype=jnp.int32)
+            ends = meta[2] + meta[1]  # cumulative output ends per slice
+            pid = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+            pid = jnp.minimum(pid, m - 1)
+            local = j - meta[2][pid]
+            live = j < ends[m - 1]
+            # source row per output lane, resolved per slice then selected
+            src_rows = []
+            for p in range(m):
+                pos = jnp.clip(meta[0, p] + local, 0,
+                               orders[p].shape[0] - 1)
+                src_rows.append(orders[p][pos])
+            outs = []
+            for ci, dt in enumerate(dtypes):
+                if dt is DataType.STRING:
+                    outs.append(_routed_string_col(
+                        [cs[ci] for cs in cols_by_slice], src_rows, pid,
+                        live, byte_caps[ci], cap_out))
+                    continue
+                acc_d = None
+                acc_v = None
+                for p in range(m):
+                    cv = cols_by_slice[p][ci]
+                    d = cv.data[src_rows[p]]
+                    v = cv.validity[src_rows[p]]
+                    if acc_d is None:
+                        acc_d, acc_v = d, v
+                    else:
+                        here = pid == p
+                        acc_d = jnp.where(here, d, acc_d)
+                        acc_v = jnp.where(here, v, acc_v)
+                acc_v = acc_v & live
+                acc_d = jnp.where(acc_v, acc_d, jnp.zeros((), acc_d.dtype))
+                outs.append((acc_d, acc_v, None))
+            return outs
+
+        return jax.jit(kernel)
+
+    kern = get_or_build(key, build)
+    meta = np.zeros((3, len(slices)), np.int32)
+    cum = 0
+    for p, s in enumerate(slices):
+        meta[0, p] = s.start
+        meta[1, p] = s.count
+        meta[2, p] = cum
+        cum += s.count
+    cols_by_slice = [[_col_to_colv(c) for c in s.batch.columns]
+                     for s in slices]
+    orders = [s.order for s in slices]
+    outs = kern(cols_by_slice, orders, meta)  # np meta: no eager convert
+    cols = []
+    for ci, (dt, (d, v, off)) in enumerate(zip(dtypes, outs)):
+        if dt is DataType.STRING:
+            mls = [s.batch.columns[ci].max_len for s in slices]
+            ml = max(mls) if all(x is not None for x in mls) else None
+            cols.append(ColumnVector(dt, d, v, off, max_len=ml))
+        else:
+            vrs = [s.batch.columns[ci].vrange for s in slices]
+            from spark_rapids_tpu.columnar.batch import union_vrange
+
+            cols.append(ColumnVector(dt, d, v,
+                                     vrange=union_vrange(*vrs)))
+    return ColumnarBatch(cols, total)
+
+
+def _routed_string_col(col_slices, src_rows, pid, live, byte_cap: int,
+                       cap_out: int):
+    """String column assembly inside the routed kernel: per-lane source
+    starts/lengths selected across slices, then one searchsorted byte
+    gather into the host-bounded byte capacity."""
+    starts = None
+    lengths = None
+    valid = None
+    for p, cv in enumerate(col_slices):
+        sr = src_rows[p]
+        st = cv.offsets[sr]
+        ln = cv.offsets[sr + 1] - st
+        va = cv.validity[sr]
+        if starts is None:
+            starts, lengths, valid = st, ln, va
+        else:
+            here = pid == p
+            starts = jnp.where(here, st, starts)
+            lengths = jnp.where(here, ln, lengths)
+            valid = jnp.where(here, va, valid)
+    lengths = jnp.where(live, lengths, 0)
+    valid = valid & live
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(lengths, dtype=jnp.int32)])
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets[1:], pos,
+                           side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, cap_out - 1)
+    within = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    # re-select the source byte per lane across slices
+    out = None
+    src_pos_base = jnp.where(in_use, starts[row] + within, 0)
+    for p, cv in enumerate(col_slices):
+        sp = jnp.clip(src_pos_base, 0, cv.data.shape[0] - 1)
+        b = cv.data[sp]
+        if out is None:
+            out = b
+        else:
+            out = jnp.where(pid[row] == p, b, out)
+    out = jnp.where(in_use, out, 0).astype(jnp.uint8)
+    return out, valid, new_offsets
 
 
 # ===========================================================================

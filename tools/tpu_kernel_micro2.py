@@ -1,0 +1,243 @@
+"""On-chip kernel microbench, generation 2: the q1-shaped suspects.
+
+Round-4's stage microbench (tools/tpu_stage_micro.py) only measured int32
+pairs; TPC-H q1 per-partition batches at SF1 actually run, per 2M-row
+capacity bucket: a 6-operand variadic stable sort (pad flag + per-key null
+flag + uint64 string chunk x2 + i32 payload), int64 cumsum (the integer
+segment-sum fast path), flag-carry segmented f32 scans, int64 scatters in
+group_ids, and int64 gathers by the sort permutation. None of those have
+ever been timed on the chip. This tool times each in isolation at the q1
+bucket size so the 263.6 s SF1 q1 wall-clock (BENCH_TPCH_SF1_r04.json) can
+be attributed to specific kernels.
+
+Also probes the candidate fixes: u32-chunk sort keys, int8 one-hot matmul
+with int32 accumulation (exact MXU segment sum), two-lane int32
+block-hierarchical segment sum (exact int64 without full-width scans).
+
+Run on the real chip (default env) or CPU:  python tools/tpu_kernel_micro2.py [n]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401  (x64 on, as the engine runs)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 21)  # q1 SF1 bucket
+if N % (1 << 15):
+    raise SystemExit("n must be a multiple of 32768 (the one-hot matmul "
+                     "kernels chunk at 2^15 rows with no tail handling)")
+S = 8  # q1 group count bucket
+
+
+def fence(x):
+    return np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0][:1]))
+
+
+RESULTS = []
+
+
+def timeit(name, fn, *args, iters=3, nbytes=None):
+    try:
+        t0 = time.perf_counter()
+        fence(fn(*args))  # compile + warm
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fence(fn(*args))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        out = {"stage": name, "n": N, "best_s": round(best, 4),
+               "compile_s": round(compile_s, 2)}
+        if nbytes:
+            out["gbps"] = round(nbytes / best / 1e9, 3)
+    except Exception as e:  # noqa: BLE001 — record and continue
+        out = {"stage": name, "error": f"{type(e).__name__}: {e}"[:200]}
+    RESULTS.append(out)
+    print(json.dumps(out), flush=True)
+
+
+rng = np.random.default_rng(0)
+# q1 group keys: 2 single-chunk strings with tiny cardinality
+u64a = jnp.asarray(rng.integers(0, 3, N).astype(np.uint64) << 56)
+u64b = jnp.asarray(rng.integers(0, 2, N).astype(np.uint64) << 56)
+nfa = jnp.zeros((N,), bool)
+i32v = jnp.asarray(rng.integers(-10_000, 10_000, N).astype(np.int32))
+i64v = i32v.astype(jnp.int64)
+f32v = jnp.asarray(rng.random(N).astype(np.float32))
+pad = jnp.zeros((N,), bool)
+payload = jnp.arange(N, dtype=jnp.int32)
+gid_small = jnp.asarray(rng.integers(0, 6, N).astype(np.int32))
+order = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+dev = jax.devices()[0]
+print(json.dumps({"platform": dev.platform, "n": N}), flush=True)
+
+# --- the q1 group-sort shape: 6-operand variadic stable sort ---------------
+timeit("sort6_u64x2", jax.jit(
+    lambda p, a1, k1, a2, k2, pl: jax.lax.sort(
+        (p, a1, k1, a2, k2, pl), is_stable=True, num_keys=5)),
+    pad, nfa, u64a, nfa, u64b, payload, nbytes=N * 24)
+
+timeit("sort6_u32x2", jax.jit(
+    lambda p, a1, k1, a2, k2, pl: jax.lax.sort(
+        (p, a1, k1, a2, k2, pl), is_stable=True, num_keys=5)),
+    pad, nfa, (u64a >> 32).astype(jnp.uint32), nfa,
+    (u64b >> 32).astype(jnp.uint32), payload, nbytes=N * 16)
+
+timeit("sort2_u64", jax.jit(
+    lambda k, pl: jax.lax.sort((k, pl), is_stable=True, num_keys=1)),
+    u64a | (u64b >> 8), payload, nbytes=N * 12)
+
+timeit("sort2_u32", jax.jit(
+    lambda k, pl: jax.lax.sort((k, pl), is_stable=True, num_keys=1)),
+    (u64a >> 32).astype(jnp.uint32), payload, nbytes=N * 8)
+
+# --- orderby sort shape (q1 output is tiny; q3/q10 sort ~1M by f32) --------
+timeit("sort2_f32", jax.jit(
+    lambda k, pl: jax.lax.sort((k, pl), is_stable=True, num_keys=1)),
+    f32v, payload, nbytes=N * 8)
+
+# --- cumulative sums (integer segment-sum fast path) -----------------------
+timeit("cumsum_i64", jax.jit(jnp.cumsum), i64v, nbytes=N * 8)
+timeit("cumsum_i32", jax.jit(jnp.cumsum), i32v, nbytes=N * 4)
+timeit("cumsum_f32", jax.jit(jnp.cumsum), f32v, nbytes=N * 4)
+
+# --- flag-carry segmented scan (float sums) --------------------------------
+starts = jnp.asarray(rng.random(N) < 1e-5)
+
+
+@jax.jit
+def segscan_f32(st, v):
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va + vb)
+
+    return jax.lax.associative_scan(comb, (st, v))[1]
+
+
+timeit("segscan_flag_f32", segscan_f32, starts, f32v, nbytes=N * 5)
+
+
+@jax.jit
+def segscan_i64(st, v):
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va + vb)
+
+    return jax.lax.associative_scan(comb, (st, v))[1]
+
+
+timeit("segscan_flag_i64", segscan_i64, starts, i64v, nbytes=N * 9)
+
+# --- scatter / gather in group_ids shapes ----------------------------------
+timeit("scatter_set_i32", jax.jit(
+    lambda o, g: jnp.zeros((N,), jnp.int32).at[o].set(g)),
+    order, gid_small, nbytes=N * 8)
+
+timeit("gather_i64_by_perm", jax.jit(lambda v, o: v[o]), i64v, order,
+       nbytes=N * 12)
+timeit("gather_f32_by_perm", jax.jit(lambda v, o: v[o]), f32v, order,
+       nbytes=N * 8)
+
+# --- candidate: exact int segment-sum on the MXU (int8 lanes) --------------
+
+
+@jax.jit
+def segsum_int8_mxu(k, v):
+    """Exact int64 segment sum of int32 values: 4 unsigned-byte lanes,
+    int8 one-hot, int32 MXU accumulation, recombined in int64 (tiny)."""
+    B = 1 << 15
+    nchunk = N // B
+    oh_dt = jnp.int8
+
+    def body(c, acc):
+        kk = jax.lax.dynamic_slice(k, (c * B,), (B,))
+        vv = jax.lax.dynamic_slice(v, (c * B,), (B,))
+        oh = jax.nn.one_hot(kk, S, dtype=oh_dt)
+        uv = vv.astype(jnp.uint32)
+        cols = []
+        for lane in range(4):
+            # bias bytes into int8 range; un-bias with the count column
+            b = ((uv >> (8 * lane)) & 0xFF).astype(jnp.int32) - 128
+            cols.append(b.astype(jnp.int8))
+        cols.append(jnp.ones((B,), jnp.int8))          # count
+        cols.append((vv < 0).astype(jnp.int8))         # negatives
+        lv = jnp.stack(cols, axis=1)  # [B, 6] int8
+        return acc + jax.lax.dot_general(
+            oh, lv, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)  # [S, 6]
+
+    part = jax.lax.fori_loop(0, nchunk, body,
+                             jnp.zeros((S, 6), jnp.int32))
+    cnt = part[:, 4].astype(jnp.int64)
+    neg = part[:, 5].astype(jnp.int64)
+    tot = jnp.zeros((S,), jnp.int64)
+    for lane in range(4):
+        tot = tot + ((part[:, lane].astype(jnp.int64) + 128 * cnt)
+                     << (8 * lane))
+    # tot now holds sum of uint32 reinterpretations; each negative value
+    # contributed an extra 2^32
+    return tot - (neg << 32)
+
+
+def _check_segsum():
+    ref = np.zeros(S, np.int64)
+    np.add.at(ref, np.asarray(gid_small), np.asarray(i32v, np.int64))
+    got = np.asarray(jax.device_get(segsum_int8_mxu(gid_small, i32v)))
+    # modular-int64 agreement is the engine's contract
+    return bool(np.array_equal(ref, got))
+
+
+timeit("segsum_int8_mxu", segsum_int8_mxu, gid_small, i32v, nbytes=N * 8)
+
+# --- candidate: two-lane int32 block-hierarchical segment sum --------------
+
+
+@jax.jit
+def cumsum_i64_2lane(v):
+    """Exact int64 cumsum of int64 input via two uint32 lanes: cumsum each
+    lane in uint32 blocks with a carry count, combine in int64 only at
+    block granularity. Here: straight lane cumsum + carry-of-lo tracking.
+    lo lane: uint32 cumsum wraps; carries = count of wraps so far, derived
+    from a f64-free trick: carry happens where cum_lo < previous cum_lo.
+    Simpler exact equivalent used below: cumsum lo in int64 *emulated* is
+    what we're avoiding, so instead cumsum both lanes as f32-free int32 and
+    reconstruct: hi_cum + carries."""
+    u = v.astype(jnp.uint64)
+    lo = (u & 0xFFFFFFFF).astype(jnp.uint32)
+    hi = (u >> 32).astype(jnp.uint32)
+    clo = jnp.cumsum(lo)          # uint32, wraps mod 2^32
+    # carry detection: wrap happened at i iff clo[i] < clo[i-1] requires a
+    # scan itself; instead count total wraps via cumsum of (clo < lo): at
+    # position i, clo[i] = (sum lo[..i]) mod 2^32 and a wrap occurred at i
+    # iff clo[i] < clo[i-1] + lo[i] arithmetic... detect via uint32 compare:
+    prev = jnp.concatenate([jnp.zeros((1,), jnp.uint32), clo[:-1]])
+    wrapped = clo < prev  # true iff adding lo[i] wrapped (lo[i] < 2^32)
+    carries = jnp.cumsum(wrapped.astype(jnp.uint32))
+    chi = jnp.cumsum(hi)  # uint32 wraps fine (mod 2^64 overall contract)
+    return ((chi + carries).astype(jnp.uint64) << 32 | clo.astype(jnp.uint64)
+            ).astype(jnp.int64)
+
+
+def _check_2lane():
+    ref = np.cumsum(np.asarray(i64v))
+    got = np.asarray(jax.device_get(cumsum_i64_2lane(i64v)))
+    return bool(np.array_equal(ref, got))
+
+
+timeit("cumsum_i64_2lane", cumsum_i64_2lane, i64v, nbytes=N * 8)
+
+checks = {"segsum_int8_mxu_exact": _check_segsum(),
+          "cumsum_i64_2lane_exact": _check_2lane()}
+print(json.dumps({"platform": dev.platform, "checks": checks,
+                  "stages": RESULTS}), flush=True)

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Probe the axon tunnel every 120s; log every probe result to .tpu_probe.log
+# (bounded: the round lasts ~12h -> ~360 lines)
+cd /root/repo
+while true; do
+  ts=$(date +%H:%M:%S)
+  out=$(timeout 75 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((128,128))
+float((x @ x).sum())
+print('PROBE_PLATFORM='+d[0].platform)
+" 2>/dev/null | grep PROBE_PLATFORM)
+  if [[ "$out" == *"PROBE_PLATFORM="* && "$out" != *"=cpu" ]]; then
+    echo "$ts UP $out" >> .tpu_probe.log
+  else
+    echo "$ts DOWN" >> .tpu_probe.log
+  fi
+  sleep 120
+done

@@ -116,6 +116,18 @@ def _init_backend(mode: str):
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     _log(f"worker[{mode}]: backend up: {dev.platform}")
+    if os.environ.get("SRT_WORKER_GATE"):
+        # pre-warmed worker: hold here (backend initialized, nothing
+        # measured) until the supervisor releases us — lets backend
+        # bring-up overlap the CPU oracle phase without the measurement
+        # itself contending with it. The GO line carries the REAL
+        # measurement deadline (unknown at spawn time).
+        _log(f"worker[{mode}]: gated; waiting for GO")
+        line = sys.stdin.readline()
+        parts = line.split()
+        if len(parts) > 1:
+            os.environ["SRT_WORKER_DEADLINE"] = parts[1]
+        _log(f"worker[{mode}]: released")
     return dev
 
 
@@ -668,6 +680,185 @@ def _run_staged(mode: str, env: dict, budget_s: float,
     return _parse_last_json("".join(out_lines)), platform[0]
 
 
+class _WarmAccelSupervisor:
+    """Holds a PRE-WARMED accelerated worker: spawned at driver entry with
+    SRT_WORKER_GATE, it initializes the (flaky, slow-to-come-up) tunnel
+    backend WHILE the CPU oracle phase runs, then blocks on stdin until
+    released. A background thread keeps respawning wedged attempts, so by
+    the time the accel phase starts a healthy backend is usually already
+    up — the serial probe loop this replaces burned its whole budget on
+    5x75s bring-up kills (BENCH_r04.json diag). The gate (not measuring
+    concurrently) keeps the CPU oracle phase uncontended."""
+
+    def __init__(self, mode: str, env: dict, horizon_s: float):
+        import threading
+
+        self.mode = mode
+        self.env = dict(env)
+        self.env["SRT_WORKER_GATE"] = "1"
+        self.attempts = 0
+        self._lock = threading.Lock()
+        self._held = None          # (proc, platform, out_lines, err_tail)
+        self._stop = False
+        self._deadline = time.perf_counter() + horizon_s
+        self._thread = threading.Thread(target=self._probe_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _spawn(self):
+        import threading
+
+        env = dict(self.env)
+        env["SRT_WORKER_DEADLINE"] = str(time.time() + 24 * 3600)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             self.mode],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        platform = [""]
+        up = threading.Event()
+        out_lines: list = []
+        err_tail: list = []
+
+        def _drain_err():
+            for line in proc.stderr:
+                sys.stderr.write(line)
+                err_tail.append(line.rstrip())
+                del err_tail[:-8]
+                if "backend up:" in line:
+                    platform[0] = line.rsplit("backend up:", 1)[1].strip()
+                    up.set()
+
+        def _drain_out():
+            for line in proc.stdout:
+                out_lines.append(line)
+
+        threading.Thread(target=_drain_err, daemon=True).start()
+        threading.Thread(target=_drain_out, daemon=True).start()
+        return proc, platform, up, out_lines, err_tail
+
+    def _take_held(self):
+        with self._lock:
+            held, self._held = self._held, None
+        return held
+
+    def _probe_loop(self):
+        while not self._stop:
+            with self._lock:
+                held = self._held
+            if held is not None:
+                if held[0] == "cpu":
+                    return
+                # verify the held worker is still alive
+                if held[0].poll() is not None:
+                    _log("warm-probe: held worker died; respawning")
+                    with self._lock:
+                        if self._held is held:
+                            self._held = None
+                else:
+                    time.sleep(1.0)
+                continue
+            if time.perf_counter() >= self._deadline:
+                return
+            self.attempts += 1
+            proc, platform, up, out_lines, err_tail = self._spawn()
+            deadline = time.perf_counter() + BACKEND_UP_S
+            while not up.is_set():
+                if proc.poll() is not None or \
+                        time.perf_counter() >= deadline or self._stop:
+                    break
+                up.wait(timeout=0.5)
+            if self._stop:
+                proc.kill()
+                return
+            if up.is_set() and platform[0] != "cpu":
+                _log(f"warm-probe: backend up ({platform[0]}) after "
+                     f"{self.attempts} attempt(s); holding")
+                with self._lock:
+                    self._held = (proc, platform[0], out_lines, err_tail)
+                continue
+            reason = ("resolved to host cpu" if up.is_set()
+                      else f"not up within {BACKEND_UP_S}s")
+            _log(f"warm-probe: attempt {self.attempts} {reason}; killed")
+            proc.kill()
+            proc.wait()
+            if up.is_set() and platform[0] == "cpu":
+                # env-level misconfig: retrying cannot help
+                with self._lock:
+                    self._held = ("cpu", "cpu", [], [])
+                return
+            time.sleep(2.0)
+
+    def _ensure_probing(self):
+        import threading
+
+        if not self._thread.is_alive() and not self._stop:
+            self._thread = threading.Thread(target=self._probe_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    def measure(self, budget_s: float):
+        """Release (or wait for) a warm worker and collect its result;
+        wedged/dead attempts retry while budget remains (the behavior of
+        the serial probe loop this class replaces). Returns
+        (result_or_None, platform, attempts)."""
+        t_end = time.perf_counter() + budget_s
+        platform = ""
+        while True:
+            remaining = t_end - time.perf_counter()
+            if remaining <= 0:
+                break
+            self._deadline = min(self._deadline,
+                                 time.perf_counter() + remaining)
+            self._ensure_probing()
+            held = None
+            while held is None and time.perf_counter() < t_end:
+                held = self._take_held()
+                if held is None:
+                    time.sleep(0.5)
+            if held is None:
+                break
+            if held[0] == "cpu":
+                _diag(f"warm-probe: backend resolves to host cpu "
+                      f"({self.attempts} attempt(s))")
+                return None, "cpu", self.attempts
+            proc, platform, out_lines, err_tail = held
+            try:
+                proc.stdin.write(f"GO {time.time() + remaining - 10:.0f}\n")
+                proc.stdin.flush()
+            except (BrokenPipeError, OSError):
+                _diag("warm-probe: worker died at release; retrying")
+                continue
+            try:
+                proc.wait(timeout=max(5.0, t_end - time.perf_counter()))
+            except subprocess.TimeoutExpired:
+                _diag(f"phase[{self.mode}]: budget {budget_s:.0f}s "
+                      "exhausted mid-run; killed (keeping partials)")
+                proc.kill()
+                proc.wait()
+            time.sleep(0.5)  # let drain threads flush
+            res = _parse_last_json("".join(out_lines))
+            if res is not None:
+                self._stop = True
+                return res, platform, self.attempts
+            _diag(f"phase[{self.mode}]: no JSON from warm worker; tail: "
+                  f"{err_tail[-1] if err_tail else ''}")
+            # fall through: retry with a fresh worker while budget remains
+        self._stop = True
+        _diag(f"warm-probe: no accel result after {self.attempts} "
+              "attempt(s)")
+        return None, platform, self.attempts
+
+    def shutdown(self):
+        self._stop = True
+        held = self._take_held()
+        if held is not None and held[0] != "cpu":
+            try:
+                held[0].kill()
+            except Exception:
+                pass
+
+
 def _run_accel_phase(mode: str, total_budget_s: int, env_extra=None):
     """Wedge-resistant accelerated phase: the worker process IS the probe —
     its backend-init stage is deadline-supervised (BACKEND_UP_S), so a
@@ -702,8 +893,13 @@ def _run_accel_phase(mode: str, total_budget_s: int, env_extra=None):
 
 
 def main() -> None:
+    # pre-warm the accel backend CONCURRENTLY with the CPU oracle phase
+    # (gated: it holds after init, so the oracle runs uncontended)
+    warm = _WarmAccelSupervisor("tpu", dict(os.environ),
+                                CPU_BUDGET_S + TPU_BUDGET_S)
     cpu = _run_phase("cpu", _scrubbed_cpu_env(), CPU_BUDGET_S)
-    acc, probes = _run_accel_phase("tpu", TPU_BUDGET_S)
+    acc, _platform, probes = warm.measure(TPU_BUDGET_S)
+    warm.shutdown()
     platform = acc["platform"] if acc else None
     if acc is None:
         # Accelerator runtime unavailable/wedged: measure the accelerated

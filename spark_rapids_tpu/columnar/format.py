@@ -48,6 +48,58 @@ def f64_scale(xp, x, k):
     return xp.where((k >= -22) & (k <= 22), one, two)
 
 
+def f64_scale_int(xp, m, k):
+    """Integer-mantissa scale m * 10^k (|m| < 10^18) with ONE final
+    rounding: m splits into two exactly-representable f64 halves, the
+    pair chunk-scales by 10^k through error-free Dekker transforms (the
+    same chain the emitter normalization uses), and only the final
+    collapse rounds. This replaces the double-rounded f64_scale on the
+    STRING->float parse path (advisor round 4: outside |k| <= 22 the
+    halved-table product re-parsed 901/2046 exact powers of two 1 ulp
+    off). Tiny results prescale by 2^600 so pair error terms never enter
+    the f64 subnormal range mid-chain (XLA flushes f64 subnormals);
+    results that are themselves subnormal still flush on such backends.
+    Overflow lanes (the chain hits inf, whose Dekker split is NaN) fall
+    back to the single-rounded f64_scale, which yields the same inf."""
+    i64 = xp.int64
+    mq = m // (10 ** 8)
+    hi = mq.astype(xp.float64)                 # < 10^10: exact
+    lo = (m - mq * (10 ** 8)).astype(xp.float64)   # < 10^8: exact
+    p1, e1 = _two_prod(xp, hi, 1e8)
+    # e1 and lo are both integers with |e1 + lo| < 2^53: the sum is exact
+    h, l = _fast_two_sum(xp, p1, e1 + lo)
+    # exact pow2 prescale keeps the chain clear of BOTH hazard zones:
+    # tiny lanes never push pair error terms into the subnormal range,
+    # huge lanes never overflow the 2^27+1 Dekker split (inf -> NaN)
+    s2 = xp.where(k < -250, 2.0 ** 600,
+                  xp.where(k > 250, 2.0 ** -600, 1.0))
+    h = h * s2
+    l = l * s2
+    P = xp.asarray(_P10F)
+    rem = xp.asarray(k, dtype=i64)
+    for _ in range(19):  # ceil(400 / 22) chunks; step-0 chunks are no-ops
+        step = xp.clip(rem, -22, 22)
+        cm = P[xp.clip(step + _P10F_OFF, 0, 2 * _P10F_OFF)]
+        cd = P[xp.clip(-step + _P10F_OFF, 0, 2 * _P10F_OFF)]
+        mp1, mperr = _two_prod(xp, h, cm)
+        mh, ml = _fast_two_sum(xp, mp1, mperr + l * cm)
+        q1 = h / cd
+        pp1, pperr = _two_prod(xp, q1, cd)
+        qerr = (((h - pp1) - pperr) + l) / cd
+        dh, dl = _fast_two_sum(xp, q1, qerr)
+        pos = step >= 0
+        h = xp.where(pos, mh, dh)
+        l = xp.where(pos, ml, dl)
+        rem = rem - step
+    h = h / s2
+    l = l / s2
+    out = h + l
+    return xp.where(xp.isnan(out),
+                    f64_scale(xp, m.astype(xp.float64)
+                              if hasattr(m, "astype") else float(m), k),
+                    out)
+
+
 def _two_prod(xp, a, c):
     """Dekker error-free product: returns (p1, err) with a*c == p1 + err
     EXACTLY (no fma needed; valid while the 2^27 splits don't overflow —
@@ -116,6 +168,17 @@ def shortest_float_decomposition(xp, a, maxp: int, is32: bool = False):
     ulp = xp.where(ulp_exp > 0, (ulp_exp << 52).astype(xp.uint64)
                    .view(xp.float64), 5e-324)
     rel_ulp = ulp / a
+    # lower-binade boundary (a == 2^j, normal, above the min normal): the
+    # gap DOWN to the previous representable is ulp/2, so a decimal BELOW
+    # a (resid > 0) only parses back to a within a QUARTER ulp
+    if is32:
+        mant_mask = xp.uint64(((1 << 52) - 1) - ((1 << 29) - 1))
+        min_e2 = -126
+    else:
+        mant_mask = xp.uint64((1 << 52) - 1)
+        min_e2 = -1022
+    pow2 = ((a.view(xp.uint64) & mant_mask) == xp.uint64(0)) \
+        & (e2a > min_e2)
 
     # --- exact pair normalization: (h, l) == a * 10^(-e10), in [1, 10).
     # Tiny inputs first scale up by an EXACT power of two so no Dekker
@@ -175,17 +238,29 @@ def shortest_float_decomposition(xp, a, maxp: int, is32: bool = False):
         adj = xp.rint(delta)
         m = base.astype(i64) + adj.astype(i64)
         resid = delta - adj              # exact: a*10^k - m (in m units)
-        # round-trip <=> |a*10^k - m| < ulp(a)*10^k / 2; in m units the
-        # half gap is rel_ulp * m / 2 (approximation error << the margin)
-        half_gap = rel_ulp * base * 0.5
+        # round-trip <=> |a*10^k - m| < gap/2 toward that side; in m units
+        # the half gap is rel_ulp * m / 2. The band shrinks by a 2^-40
+        # relative guard: it strictly excludes exact decimal ties (which
+        # parse back round-half-even to either neighbor) and swallows the
+        # few-2^-50 rounding slop of this very computation — a rejected
+        # borderline candidate just emits one more (still-correct) digit.
+        # resid > 0 means the decimal sits BELOW a, where a power-of-two
+        # boundary halves the gap (quarter-ulp band).
+        guard = 1.0 - 2.0 ** -40
+        # gap from the TRUE scaled value (base + delta == a*10^k to f64
+        # rounding): scaling off `base` alone inflates the band by
+        # ~rel_ulp/2 relative — 4e-8 for f32 sources, enough to re-admit
+        # exact ties (the 2^-40 guard only covers arithmetic slop)
+        half_gap = rel_ulp * (base + delta) * 0.5 * guard
+        down_gap = xp.where(pow2, half_gap * 0.5, half_gap)
         carry = m >= P10I[p]             # 9.99.. rounded up to 10^p
         # carried candidate is 10^(p-1) one decade up: same exact test
         # against 10^p in current units
         # base ~= 10^p on carry lanes, so half_gap is already in current
         # units for both tests
         resid_c = (base - float(_P10F[p + _P10F_OFF])) + delta
-        ok = xp.where(carry, xp.abs(resid_c) < half_gap,
-                      xp.abs(resid) < half_gap)
+        rsel = xp.where(carry, resid_c, resid)
+        ok = xp.where(rsel > 0, rsel < down_gap, -rsel < half_gap)
         m = xp.where(carry, P10I[p - 1], m)
         e_cand = e10 + carry.astype(i64)
         if p == maxp:

@@ -158,8 +158,8 @@ def _parse_float_kernel(data, offsets, maxw: int):
         (~has_exp_marker | (nde >= 1)) & (nde <= 3) & \
         (lens <= maxw) & (lens > body0)
     q = jnp.where(exp_neg, -exp_val, exp_val) - scale + dropped_int
-    val = F.f64_scale(jnp, m.astype(jnp.float64),
-                      jnp.clip(q, -400, 400).astype(jnp.int64))
+    val = F.f64_scale_int(jnp, m,
+                          jnp.clip(q, -400, 400).astype(jnp.int64))
     val = jnp.where(is_inf, jnp.inf, jnp.where(is_nan, jnp.nan, val))
     val = jnp.where(neg, -val, val)
     parsed = (grammar_ok | is_inf | is_nan) & (lens > 0)

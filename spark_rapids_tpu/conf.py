@@ -335,7 +335,9 @@ ENABLE_CAST_FLOAT_TO_STRING = _conf(
     "Enable the device float->STRING cast (reference: "
     "spark.rapids.sql.castFloatToString.enabled). Output follows this "
     "framework's shortest-round-trip convention (Java-style notation; "
-    "exact for all normal doubles and every float32; subnormal doubles "
+    "parse-back-exact for all normal doubles and every float32 under "
+    "this framework's own string->float parser and for correctly-"
+    "rounded parsers; subnormal doubles "
     "may differ in the last digit), NOT Java's Ryu output — the "
     "reference marks the direction incompatible for the same reason. "
     "Needs an f64-capable backend; otherwise the cast stays on the CPU "
@@ -345,10 +347,12 @@ ENABLE_CAST_STRING_TO_FLOAT = _conf(
     "Enable the device STRING->float cast (reference: "
     "spark.rapids.sql.castStringToFloat.enabled). Grammar: optional "
     "sign, decimal with optional <=3-digit exponent, inf/infinity/nan "
-    "(case-insensitive), <=48 chars after trim; the first 17 significant "
-    "digits are exact, further digits only shift the exponent. "
-    "Unparseable strings are NULL (ANSI: error). Host and device "
-    "produce bit-identical values. Needs an f64-capable "
+    "(case-insensitive), <=48 chars after ASCII-whitespace trim; the "
+    "17-digit mantissa fold scales through error-free pair arithmetic, "
+    "so normal-range results match a correctly-rounded strtod (further "
+    "digits only shift the exponent; subnormal results flush on "
+    "accelerator backends). Unparseable strings are NULL (ANSI: error). "
+    "Host and device produce bit-identical values. Needs an f64-capable "
     "backend.").boolean(False)
 ENABLE_CAST_STRING_TO_TIMESTAMP = _conf(
     "rapids.tpu.sql.castStringToTimestamp.enabled").doc(

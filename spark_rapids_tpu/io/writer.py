@@ -72,14 +72,15 @@ def execute_write(session, plan: L.WriteFile) -> None:
     # compressed parquet/ORC on the accelerator)
     from spark_rapids_tpu.io import orc_encode_device as OE
 
+    part_names = list(plan.partition_by or [])
+    data_attrs_w = [a for a in attrs if a.name not in part_names]
     pq_compression = str(plan.options.get("compression", "snappy")).lower()
     device_encode = (
         plan.fmt == "parquet"
-        and not plan.partition_by
         and session.conf.get(C.PARQUET_DEVICE_ENCODE)
         and PE.codec_supported(pq_compression)
         and isinstance(physical, DeviceToHostExec)
-        and PE.schema_encodable(attrs))
+        and PE.schema_encodable(data_attrs_w))
     orc_compression = str(plan.options.get("compression",
                                            "uncompressed")).lower()
     device_encode_orc = (
@@ -100,6 +101,10 @@ def execute_write(session, plan: L.WriteFile) -> None:
         batches = [b for b in pb.iterator(pidx) if b.num_rows > 0]
         if not batches:
             return 0
+        if device_encode and plan.partition_by:
+            return _write_partitioned_device(
+                batches, attrs, plan, path, pidx, write_id,
+                pq_compression)
         if device_encode:
             fname = f"part-{pidx:05d}-{write_id}.{_ext(plan.fmt)}"
             return PE.write_file(os.path.join(path, fname), attrs, batches,
@@ -157,6 +162,88 @@ def _write_table(table, file_path: str, plan: L.WriteFile) -> None:
         raise ValueError(f"unknown write format {plan.fmt}")
 
 
+def _write_partitioned_device(batches, attrs, plan, path: str, pidx: int,
+                              write_id: str, compression: str) -> int:
+    """Dynamic-partition write with DEVICE encode (reference: the dynamic
+    partition data writer encodes on the accelerator,
+    GpuFileFormatDataWriter.scala): only the partition-KEY columns come to
+    the host (they name the directories), the data columns group on
+    device — one route dispatch + one per-group range gather per batch —
+    and each group's device batch runs the existing parquet device
+    encoder."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import (
+        ColumnarBatch,
+        bucket_capacity,
+        gather_batch,
+    )
+    from spark_rapids_tpu.io import parquet_encode_device as PE
+    from spark_rapids_tpu.shuffle.exchange import _route_plan, _slice_indices
+
+    part_names = plan.partition_by
+    part_idx = [i for i, a in enumerate(attrs) if a.name in part_names]
+    data_idx = [i for i, a in enumerate(attrs) if a.name not in part_names]
+    data_attrs = [attrs[i] for i in data_idx]
+    groups: Dict[tuple, List] = {}
+    for b in batches:
+        n = b.host_rows()
+        # 1. keys to host (small: the partition columns only)
+        key_host = ColumnarBatch([b.columns[i] for i in part_idx],
+                                 n).to_host()
+        key_vals, inverse, first_idx = _partition_key_groups(
+            key_host.columns, n)
+        # 2. route data rows by group id on device (contiguous ranges)
+        n_groups = len(first_idx)
+        gid = np.full(bucket_capacity(max(n, 1)), n_groups, np.int32)
+        gid[:n] = inverse.astype(np.int32)
+        order, counts_dev = _route_plan(jnp.asarray(gid), n_groups)
+        counts = np.asarray(jax.device_get(counts_dev))
+        data_batch = ColumnarBatch([b.columns[i] for i in data_idx], n)
+        offset = 0
+        for g in range(n_groups):
+            c = int(counts[g])
+            if c == 0:
+                continue
+            idx = _slice_indices(order, np.int32(offset),
+                                 bucket_capacity(max(c, 1)))
+            piece = gather_batch(data_batch, idx, c, unique_indices=True)
+            key = tuple(kv[first_idx[g]] for kv in key_vals)
+            groups.setdefault(key, []).append(piece)
+            offset += c
+    total = 0
+    seq = 0
+    for key, gbatches in groups.items():
+        out_dir = os.path.join(path, _partition_dirname(attrs, part_idx,
+                                                        key))
+        os.makedirs(out_dir, exist_ok=True)
+        fname = f"part-{pidx:05d}-{seq:03d}-{write_id}.{_ext(plan.fmt)}"
+        total += PE.write_file(os.path.join(out_dir, fname), data_attrs,
+                               gbatches, compression=compression)
+        seq += 1
+    return total
+
+
+def _partition_key_groups(key_cols, n: int):
+    """Canonical partition-key grouping shared by the device- and
+    host-encoded dynamic writers: (per-column value arrays with None for
+    NULL, per-row group index, each group's first row index)."""
+    key_vals = [np.where(c.validity, c.data.astype(object), None)
+                for c in key_cols]
+    decorated = np.array(
+        ["\x00".join(repr(kv[i]) for kv in key_vals) for i in range(n)],
+        dtype=object)
+    _uniq, first_idx, inverse = np.unique(
+        decorated, return_index=True, return_inverse=True)
+    return key_vals, inverse, first_idx
+
+
+def _partition_dirname(attrs, part_idx, key) -> str:
+    return "/".join(f"{attrs[i].name}={_part_value(v)}"
+                    for i, v in zip(part_idx, key))
+
+
 def _write_partitioned(batches: List[HostColumnarBatch], attrs, plan,
                        path: str, pidx: int, write_id: str) -> int:
     """Hive-style key=value directory layout (reference: the dynamic
@@ -173,21 +260,11 @@ def _write_partitioned(batches: List[HostColumnarBatch], attrs, plan,
     # per-group boolean masks; no per-row python loops over the data
     groups: Dict[tuple, List[HostColumnarBatch]] = {}
     for b in batches:
-        decorated = np.empty(b.num_rows, dtype=object)
-        decorated[:] = ""
-        key_vals: List[np.ndarray] = []
-        for i in part_idx:
-            col = b.columns[i]
-            vals = np.where(col.validity, col.data.astype(object), None)
-            key_vals.append(vals)
-            decorated = np.array(
-                [d + "\x00" + repr(v) for d, v in zip(decorated, vals)],
-                dtype=object)
-        uniq, inverse = np.unique(decorated, return_inverse=True)
-        for g in range(len(uniq)):
+        key_vals, inverse, first_idx = _partition_key_groups(
+            [b.columns[i] for i in part_idx], b.num_rows)
+        for g in range(len(first_idx)):
             mask = inverse == g
-            first = int(np.nonzero(mask)[0][0])
-            key = tuple(kv[first] for kv in key_vals)
+            key = tuple(kv[first_idx[g]] for kv in key_vals)
             cols = [
                 HostColumnVector(attrs[i].data_type,
                                  b.columns[i].data[mask],
@@ -197,10 +274,8 @@ def _write_partitioned(batches: List[HostColumnarBatch], attrs, plan,
             groups.setdefault(key, []).append(
                 HostColumnarBatch(cols, int(mask.sum())))
     for key, group_batches in groups.items():
-        dirname = "/".join(
-            f"{attrs[i].name}={_part_value(v)}"
-            for i, v in zip(part_idx, key))
-        out_dir = os.path.join(path, dirname)
+        out_dir = os.path.join(path, _partition_dirname(attrs, part_idx,
+                                                        key))
         os.makedirs(out_dir, exist_ok=True)
         table = _concat_arrow(group_batches, data_attrs)
         fname = f"part-{pidx:05d}-{seq:03d}-{write_id}.{_ext(plan.fmt)}"

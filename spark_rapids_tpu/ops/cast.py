@@ -282,7 +282,10 @@ class Cast(UnaryExpression):
         for i, s in enumerate(v.data):
             if not validity[i]:
                 continue
-            s = s.strip()
+            # ASCII whitespace only: the device trim (columnar/parse.py)
+            # cannot see Unicode spaces, and host/device must agree on
+            # exactly which inputs parse (advisor round 4)
+            s = s.strip(" \t\n\r\f\x0b")
             try:
                 if is_decimal(to):
                     u = DU.to_unscaled(s, to.scale)
@@ -410,8 +413,8 @@ def _parse_float_text(s: str) -> float:
             if m > 0:
                 nsig += 1
     q = (int(ex) if ex else 0) - scale + dropped_int
-    val = float(F.f64_scale(np, np.float64(m),
-                            np.int64(max(-400, min(400, q)))))
+    val = float(F.f64_scale_int(np, np.int64(m),
+                                np.int64(max(-400, min(400, q)))))
     return -val if negv else val
 
 

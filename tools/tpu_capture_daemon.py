@@ -24,21 +24,26 @@ PROBE_INTERVAL_S = 300
 
 CAPTURES = [
     # (artifact, argv, timeout_s, extra_env)
-    # round-4 flagship: scale sweep to the GB/s plateau with the
-    # scatter-free segmented-scan aggregates
-    ("BENCH_TPU_r04_flagship.json", [sys.executable, "bench.py"], 1500, {}),
-    # exchange throughput through the device/serialized tiers on chip
-    ("BENCH_SHUFFLE_r04.json", [sys.executable, "bench.py", "--shuffle"],
+    # kernel microbench gen2 FIRST: cheapest capture, and it decides which
+    # round-5 kernel paths (2-lane int64 cumsum, int8-MXU segsum, u32
+    # chunk sorts) are wins on real silicon
+    ("BENCH_TPU_r05_kernels.json",
+     [sys.executable, "tools/tpu_kernel_micro2.py"], 1200, {}),
+    # round-5 flagship: scale sweep to the GB/s plateau with the
+    # dispatch-lean (max_len / routed / flat-decode) engine
+    ("BENCH_TPU_r05_flagship.json", [sys.executable, "bench.py"], 1500, {}),
+    # exchange throughput: routed device tier vs serialized fallback
+    ("BENCH_SHUFFLE_r05.json", [sys.executable, "bench.py", "--shuffle"],
      1500, {}),
-    ("BENCH_I64_r04.json", [sys.executable, "bench.py", "--i64"], 1200, {}),
-    ("BENCH_DECODE_r04.json", [sys.executable, "bench.py", "--decode"],
+    ("BENCH_DECODE_r05.json", [sys.executable, "bench.py", "--decode"],
      1200, {}),
+    ("BENCH_I64_r05.json", [sys.executable, "bench.py", "--i64"], 1200, {}),
     # SF1 TPC-H: slowest SF1 oracle query measured 221 s, so 3 runs need a
     # ~900 s cap; budgets sized to the ~930 s full-sweep oracle profile
     # (BENCH_SUITES.json tpch_sf1_cpu_oracle) x3 + compile. The daemon
     # wants REAL-chip numbers only, so the cpu-fallback re-run is skipped
     # (a wedge mid-run then costs one capture window, not hours).
-    ("BENCH_TPCH_SF1_r04.json",
+    ("BENCH_TPCH_SF1_r05.json",
      [sys.executable, "bench.py", "--tpch", "1.0"], 8400,
      {"SRT_BENCH_CPU_BUDGET_S": "1800", "SRT_BENCH_TPU_BUDGET_S": "3600",
       "SRT_BENCH_QUERY_CAP_S": "900", "SRT_BENCH_NO_FALLBACK": "1"}),

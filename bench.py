@@ -605,22 +605,20 @@ def _run_phase(mode: str, env: dict, budget_s: int):
 BACKEND_UP_S = 75         # stage deadline: worker must report backend up
 
 
-def _run_staged(mode: str, env: dict, budget_s: float,
-                require_accel: bool):
-    """Run ONE worker subprocess supervised by STAGE: the worker must print
-    'backend up: <platform>' on stderr within BACKEND_UP_S (the axon tunnel
-    wedges inside backend init for minutes when unhealthy), then gets the
-    remaining budget to finish. Because workers emit a parseable partial
-    JSON line after every sweep size / query, a mid-run kill still returns
-    the last partial. Returns (result_or_None, platform_or_'')."""
+def _spawn_draining(argv, env, stdin_pipe: bool = False):
+    """Spawn a worker with stderr/stdout drain threads and 'backend up:'
+    platform detection (the one copy of the worker handshake protocol —
+    shared by the staged runner and the warm supervisor). Returns
+    (proc, platform_box, up_event, out_lines, err_tail, threads)."""
     import threading
 
-    t_end = time.perf_counter() + budget_s
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--worker", mode],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        argv, env=env,
+        stdin=subprocess.PIPE if stdin_pipe else None,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     platform = [""]
     up = threading.Event()
+    out_lines: list = []
     err_tail: list = []
 
     def _drain_err():
@@ -632,8 +630,6 @@ def _run_staged(mode: str, env: dict, budget_s: float,
                 platform[0] = line.rsplit("backend up:", 1)[1].strip()
                 up.set()
 
-    out_lines: list = []
-
     def _drain_out():
         for line in proc.stdout:
             out_lines.append(line)
@@ -642,6 +638,20 @@ def _run_staged(mode: str, env: dict, budget_s: float,
     to = threading.Thread(target=_drain_out, daemon=True)
     te.start()
     to.start()
+    return proc, platform, up, out_lines, err_tail, (te, to)
+
+
+def _run_staged(mode: str, env: dict, budget_s: float,
+                require_accel: bool):
+    """Run ONE worker subprocess supervised by STAGE: the worker must print
+    'backend up: <platform>' on stderr within BACKEND_UP_S (the axon tunnel
+    wedges inside backend init for minutes when unhealthy), then gets the
+    remaining budget to finish. Because workers emit a parseable partial
+    JSON line after every sweep size / query, a mid-run kill still returns
+    the last partial. Returns (result_or_None, platform_or_'')."""
+    t_end = time.perf_counter() + budget_s
+    proc, platform, up, out_lines, err_tail, (te, to) = _spawn_draining(
+        [sys.executable, os.path.abspath(__file__), "--worker", mode], env)
 
     def _kill(reason: str):
         _diag(f"phase[{mode}]: {reason}")
@@ -698,44 +708,21 @@ class _WarmAccelSupervisor:
         self.env["SRT_WORKER_GATE"] = "1"
         self.attempts = 0
         self._lock = threading.Lock()
-        self._held = None          # (proc, platform, out_lines, err_tail)
+        self._held = None  # (proc, platform, out_lines, err_tail, threads)
         self._stop = False
+        self._pause = False   # True while a released worker is measuring
         self._deadline = time.perf_counter() + horizon_s
         self._thread = threading.Thread(target=self._probe_loop,
                                         daemon=True)
         self._thread.start()
 
     def _spawn(self):
-        import threading
-
         env = dict(self.env)
         env["SRT_WORKER_DEADLINE"] = str(time.time() + 24 * 3600)
-        proc = subprocess.Popen(
+        return _spawn_draining(
             [sys.executable, os.path.abspath(__file__), "--worker",
              self.mode],
-            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True)
-        platform = [""]
-        up = threading.Event()
-        out_lines: list = []
-        err_tail: list = []
-
-        def _drain_err():
-            for line in proc.stderr:
-                sys.stderr.write(line)
-                err_tail.append(line.rstrip())
-                del err_tail[:-8]
-                if "backend up:" in line:
-                    platform[0] = line.rsplit("backend up:", 1)[1].strip()
-                    up.set()
-
-        def _drain_out():
-            for line in proc.stdout:
-                out_lines.append(line)
-
-        threading.Thread(target=_drain_err, daemon=True).start()
-        threading.Thread(target=_drain_out, daemon=True).start()
-        return proc, platform, up, out_lines, err_tail
+            env, stdin_pipe=True)
 
     def _take_held(self):
         with self._lock:
@@ -744,6 +731,12 @@ class _WarmAccelSupervisor:
 
     def _probe_loop(self):
         while not self._stop:
+            if self._pause:
+                # a released worker is measuring: spawning another
+                # backend-initializing process now would contend with the
+                # very measurement this class exists to keep clean
+                time.sleep(1.0)
+                continue
             with self._lock:
                 held = self._held
             if held is not None:
@@ -761,7 +754,7 @@ class _WarmAccelSupervisor:
             if time.perf_counter() >= self._deadline:
                 return
             self.attempts += 1
-            proc, platform, up, out_lines, err_tail = self._spawn()
+            proc, platform, up, out_lines, err_tail, thr = self._spawn()
             deadline = time.perf_counter() + BACKEND_UP_S
             while not up.is_set():
                 if proc.poll() is not None or \
@@ -775,7 +768,8 @@ class _WarmAccelSupervisor:
                 _log(f"warm-probe: backend up ({platform[0]}) after "
                      f"{self.attempts} attempt(s); holding")
                 with self._lock:
-                    self._held = (proc, platform[0], out_lines, err_tail)
+                    self._held = (proc, platform[0], out_lines, err_tail,
+                                  thr)
                 continue
             reason = ("resolved to host cpu" if up.is_set()
                       else f"not up within {BACKEND_UP_S}s")
@@ -785,7 +779,7 @@ class _WarmAccelSupervisor:
             if up.is_set() and platform[0] == "cpu":
                 # env-level misconfig: retrying cannot help
                 with self._lock:
-                    self._held = ("cpu", "cpu", [], [])
+                    self._held = ("cpu", "cpu", [], [], ())
                 return
             time.sleep(2.0)
 
@@ -822,28 +816,36 @@ class _WarmAccelSupervisor:
                 _diag(f"warm-probe: backend resolves to host cpu "
                       f"({self.attempts} attempt(s))")
                 return None, "cpu", self.attempts
-            proc, platform, out_lines, err_tail = held
+            proc, platform, out_lines, err_tail, threads = held
+            self._pause = True   # no concurrent spawns while measuring
             try:
-                proc.stdin.write(f"GO {time.time() + remaining - 10:.0f}\n")
-                proc.stdin.flush()
-            except (BrokenPipeError, OSError):
-                _diag("warm-probe: worker died at release; retrying")
-                continue
-            try:
-                proc.wait(timeout=max(5.0, t_end - time.perf_counter()))
-            except subprocess.TimeoutExpired:
-                _diag(f"phase[{self.mode}]: budget {budget_s:.0f}s "
-                      "exhausted mid-run; killed (keeping partials)")
-                proc.kill()
-                proc.wait()
-            time.sleep(0.5)  # let drain threads flush
-            res = _parse_last_json("".join(out_lines))
-            if res is not None:
-                self._stop = True
-                return res, platform, self.attempts
-            _diag(f"phase[{self.mode}]: no JSON from warm worker; tail: "
-                  f"{err_tail[-1] if err_tail else ''}")
-            # fall through: retry with a fresh worker while budget remains
+                try:
+                    proc.stdin.write(
+                        f"GO {time.time() + remaining - 10:.0f}\n")
+                    proc.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    _diag("warm-probe: worker died at release; retrying")
+                    continue
+                try:
+                    proc.wait(timeout=max(5.0,
+                                          t_end - time.perf_counter()))
+                except subprocess.TimeoutExpired:
+                    _diag(f"phase[{self.mode}]: budget {budget_s:.0f}s "
+                          "exhausted mid-run; killed (keeping partials)")
+                    proc.kill()
+                    proc.wait()
+                for t in threads:
+                    t.join(timeout=5)
+                res = _parse_last_json("".join(out_lines))
+                if res is not None:
+                    self._stop = True
+                    return res, platform, self.attempts
+                _diag(f"phase[{self.mode}]: no JSON from warm worker; "
+                      f"tail: {err_tail[-1] if err_tail else ''}")
+                # fall through: retry with a fresh worker while budget
+                # remains
+            finally:
+                self._pause = False
         self._stop = True
         _diag(f"warm-probe: no accel result after {self.attempts} "
               "attempt(s)")

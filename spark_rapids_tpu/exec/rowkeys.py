@@ -445,13 +445,27 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
     if gi is not None:
         gid = gi.gid
     sorted_ok = gi is not None and gi.order is not None
+    # a GroupInfo without sort-order fields is the keyless global
+    # aggregate (the only hand-assembled construction,
+    # exec/aggregate.py:_group_info_masked): ONE group -> plain masked
+    # tree reductions into slot 0, no scatter at all
+    keyless = gi is not None and not sorted_ok
     pos = jnp.arange(capacity, dtype=jnp.int32)
     in_group = gid < capacity  # real (non-pad) rows
+    slot0 = pos == 0
+
+    def at_slot0(x, dtype=None):
+        z = jnp.zeros((capacity,), dtype or x.dtype)
+        return jnp.where(slot0, x.astype(z.dtype), z)
+
     if op == "count":
         if sorted_ok:
             cnt = _sorted_counts(validity & in_group, gi,
                                  capacity).astype(jnp.int64)
             return cnt, jnp.ones((capacity,), bool)
+        if keyless:
+            cnt = jnp.sum((validity & in_group).astype(jnp.int64))
+            return at_slot0(cnt), jnp.ones((capacity,), bool)
         seg = _seg_ids(gid, validity & in_group, capacity)
         ones = jnp.ones((capacity,), jnp.int64)
         cnt = jax.ops.segment_sum(jnp.where(seg < capacity, ones, 0), seg,
@@ -555,6 +569,32 @@ def segment_reduce(op: str, data, validity, gid, num_rows, capacity: int):
                     vs = jnp.where(vmask, data[gi.order], ident)
                     out = _sorted_segment_reduce(vs, gi, capacity, comb)
             out = jnp.where(outv, out, jnp.zeros((), out.dtype))
+            return out, outv
+        if keyless:
+            vmask = validity & in_group
+            nn = jnp.sum(vmask.astype(jnp.int32))
+            outv = at_slot0(nn > 0, bool)
+            if op == "sum":
+                r = jnp.sum(jnp.where(vmask, data, jnp.zeros((),
+                                                             data.dtype)))
+            elif op == "any":
+                r = jnp.any(vmask & data.astype(bool))
+            elif jnp.dtype(data.dtype).kind == "f":
+                bits = _float_order_bits(data)
+                if op == "min":
+                    r = _float_from_order_bits(jnp.min(jnp.where(
+                        vmask, bits, jnp.array(jnp.iinfo(bits.dtype).max,
+                                               bits.dtype)))
+                    ).astype(data.dtype)
+                else:
+                    r = _float_from_order_bits(jnp.max(jnp.where(
+                        vmask, bits, jnp.array(0, bits.dtype)))
+                    ).astype(data.dtype)
+            elif op == "min":
+                r = jnp.min(jnp.where(vmask, data, _type_max(data.dtype)))
+            else:
+                r = jnp.max(jnp.where(vmask, data, _type_min(data.dtype)))
+            out = jnp.where(outv, at_slot0(r), jnp.zeros((), r.dtype))
             return out, outv
         seg = _seg_ids(gid, validity & in_group, capacity)
         nonnull = jax.ops.segment_sum(

@@ -186,8 +186,14 @@ def _write_partitioned_device(batches, attrs, plan, path: str, pidx: int,
     part_idx = [i for i, a in enumerate(attrs) if a.name in part_names]
     data_idx = [i for i, a in enumerate(attrs) if a.name not in part_names]
     data_attrs = [attrs[i] for i in data_idx]
+    from spark_rapids_tpu.columnar.batch import ensure_compact
+
     groups: Dict[tuple, List] = {}
     for b in batches:
+        # live-masked shuffle/ici views hold real rows in scattered lanes;
+        # the key download and the group routing below address physical
+        # lanes 0..n-1, so compact first
+        b = ensure_compact(b)
         n = b.host_rows()
         # 1. keys to host (small: the partition columns only)
         key_host = ColumnarBatch([b.columns[i] for i in part_idx],

@@ -338,21 +338,32 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
     str_cols = [ci for ci in range(ncols)
                 if dtypes[ci] is DataType.STRING]
 
-    # string columns: one fixed byte width per column across all shards
+    # string columns: one fixed byte width per column across all shards —
+    # host-known max_len bounds answer without the per-epoch device sync;
+    # only unbounded columns still pay the round trip
     widths = [0] * ncols
     if str_cols:
-        maxes = []
+        live_slots = [b for b in slots
+                      if b is not None and b.host_rows() > 0]
+        need = []
         for ci in str_cols:
-            col_max = [jnp.max(_string_lens(batch.columns[ci].offsets))
-                       for batch in slots
-                       if batch is not None and batch.host_rows() > 0]
-            maxes.append(col_max)
-        flat = [x for grp in maxes for x in grp]
-        got = [int(v) for v in jax.device_get(flat)] if flat else []
-        it = iter(got)
-        for i, ci in enumerate(str_cols):
-            vals = [next(it) for _ in maxes[i]]
-            widths[ci] = int(bucket_capacity(max(max(vals, default=1), 1)))
+            mls = [b.columns[ci].max_len for b in live_slots]
+            if mls and all(m is not None for m in mls):
+                widths[ci] = int(bucket_capacity(max(max(mls), 1)))
+            else:
+                need.append(ci)
+        if need:
+            maxes = []
+            for ci in need:
+                maxes.append([jnp.max(_string_lens(b.columns[ci].offsets))
+                              for b in live_slots])
+            flat = [x for grp in maxes for x in grp]
+            got = [int(v) for v in jax.device_get(flat)] if flat else []
+            it = iter(got)
+            for i, ci in enumerate(need):
+                vals = [next(it) for _ in maxes[i]]
+                widths[ci] = int(bucket_capacity(max(max(vals, default=1),
+                                                     1)))
 
     # place per-shard padded columns as [m, cap(, W)] globals. Slot parts
     # may be COMMITTED to different chips (outputs of a previous exchange
@@ -484,7 +495,9 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
                 byte_cap = bucket_capacity(max(next(ti), 8))
                 packed, offs = _matrix_to_strings(data_t, masked[ci],
                                                   byte_cap)
-                cols.append(ColumnVector(dtypes[ci], packed, valid_t, offs))
+                # the shard width is itself a per-value byte bound
+                cols.append(ColumnVector(dtypes[ci], packed, valid_t, offs,
+                                         max_len=widths[ci]))
             else:
                 cols.append(ColumnVector(dtypes[ci], data_t, valid_t))
         out_batches.append(ColumnarBatch(

@@ -150,27 +150,46 @@ def _worker(mode: str) -> None:
     iters = TPU_ITERS if mode == "tpu" else CPU_ITERS
     sweep = {}
     best_1m = None
+    diags = {}
+    from jax._src import monitoring as _jmon
+
+    compile_ctr = [0]
+    # duration listener: fires on ACTUAL compiles regardless of whether
+    # the persistent compilation cache is enabled/supported (the plain
+    # event listener only sees cache-key events)
+    _jmon.register_event_duration_secs_listener(
+        lambda event, _secs, **kw: compile_ctr.__setitem__(
+            0, compile_ctr[0] + (1 if "compile_time" in event else 0)))
     for n in sizes:
         df = _build_df(session, n)
         _log(f"worker[{mode}]: rows={n}: data built, warmup pass")
         rows = _run_query(df)
         assert len(rows) == N_KEYS, len(rows)
         times = []
+        iter_compiles = []
+        spills0 = _spill_count()
         for i in range(iters):
+            c0 = compile_ctr[0]
             t0 = time.perf_counter()
             _run_query(df)
             times.append(time.perf_counter() - t0)
-            _log(f"worker[{mode}]: rows={n} iter {i}: {times[-1]:.3f}s")
+            iter_compiles.append(compile_ctr[0] - c0)
+            _log(f"worker[{mode}]: rows={n} iter {i}: {times[-1]:.3f}s "
+                 f"(compiles={iter_compiles[-1]})")
         best = min(times)
         sweep[n] = best
+        # per-size attribution so a throughput decline names its cause
+        # (steady-state recompiles / spill thrash / neither => kernel)
+        diags[n] = {"steady_compiles": iter_compiles,
+                    "spills": _spill_count() - spills0}
         if n == N_ROWS:
             best_1m = best
         df.unpersist()
         del df
         # emit a parseable partial after every size so a mid-sweep wedge
         # still leaves the supervisor a result
-        print(json.dumps(_sweep_result(mode, dev.platform, sweep, best_1m)),
-              flush=True)
+        print(json.dumps(_sweep_result(mode, dev.platform, sweep, best_1m,
+                                       diags)), flush=True)
         if deadline is not None and n != sizes[-1]:
             # next size is ~4x the work; skip if it cannot fit
             projected = (best * 4) * (iters + 1) + 20
@@ -180,10 +199,16 @@ def _worker(mode: str) -> None:
                 break
 
 
-def _sweep_result(mode, platform, sweep, best_1m):
+def _spill_count() -> int:
+    from spark_rapids_tpu.memory import spill as _sp
+
+    return _sp.SPILL_EVENTS
+
+
+def _sweep_result(mode, platform, sweep, best_1m, diags=None):
     gbps = {n: n * BYTES_PER_ROW / s / 1e9 for n, s in sweep.items()}
     plateau_rows = max(gbps, key=lambda n: gbps[n])
-    return {
+    out = {
         "mode": mode, "platform": platform,
         "best_s": best_1m if best_1m is not None else sweep[min(sweep)],
         "sweep_s": {str(n): round(s, 4) for n, s in sweep.items()},
@@ -192,6 +217,25 @@ def _sweep_result(mode, platform, sweep, best_1m):
         "plateau_rows": plateau_rows,
         "hbm_frac": round(gbps[plateau_rows] / HBM_GBPS, 6),
     }
+    if diags:
+        out["size_diags"] = {str(n): d for n, d in diags.items()}
+        # name the cause of any post-plateau decline in the artifact
+        declining = [n for n in sorted(gbps) if n > plateau_rows
+                     and gbps[n] < 0.9 * gbps[plateau_rows]]
+        if declining:
+            causes = []
+            for n in declining:
+                d = diags.get(n, {})
+                if any(d.get("steady_compiles", [])):
+                    causes.append(f"{n}: steady-state recompiles "
+                                  f"{d['steady_compiles']}")
+                elif d.get("spills"):
+                    causes.append(f"{n}: {d['spills']} spill demotions")
+                else:
+                    causes.append(f"{n}: no recompiles/spills -> "
+                                  "kernel-side scaling")
+            out["decline_causes"] = causes
+    return out
 
 
 def _worker_decode(mode: str) -> None:

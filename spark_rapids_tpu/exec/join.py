@@ -252,8 +252,13 @@ class _DeviceJoiner:
         s_cols = [_col_to_colv(c) for c in stream.columns] or \
             [_synth(stream)]
         b_cols = [_col_to_colv(c) for c in build.columns] or [_synth(build)]
-        return self._jitted(s_cols, jnp.int32(stream.num_rows),
-                            b_cols, jnp.int32(build.num_rows))
+        def cnt(b):
+            n = b.num_rows
+            if isinstance(n, (int, np.integer)):
+                return np.int32(n)  # host count: no eager device convert
+            return jnp.asarray(n, dtype=jnp.int32)
+
+        return self._jitted(s_cols, cnt(stream), b_cols, cnt(build))
 
 
 def _synth(batch: ColumnarBatch):
@@ -305,9 +310,9 @@ class _TpuJoinMixin:
                                               s_safe_gid, match_cnt, out_cap)
             s_out = gather_batch(stream_batch, s_idx, n_out)
             if emit_build_cols:
-                b_valid = b_idx >= 0
-                b_out = gather_batch(build, jnp.where(b_valid, b_idx, 0),
-                                     n_out, indices_valid=b_valid)
+                # negative (unmatched) indices already emit null rows in
+                # gather_batch's in-bounds mask — no eager pre-masking
+                b_out = gather_batch(build, b_idx, n_out)
                 cols = (b_out.columns + s_out.columns) if build_left \
                     else (s_out.columns + b_out.columns)
                 joined = ColumnarBatch(cols, n_out)

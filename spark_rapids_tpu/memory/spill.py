@@ -67,6 +67,11 @@ class SpillPriorities:
 
 _id_counter = itertools.count(1)
 
+# process-wide count of buffer tier demotions (benchmark diagnostics: a
+# throughput decline past the HBM plateau names spill thrash as its cause
+# iff this moved during the measured iterations)
+SPILL_EVENTS = 0
+
 
 def next_buffer_id() -> int:
     return next(_id_counter)
@@ -200,6 +205,8 @@ class BufferStore:
         with buf.lock:
             if buf.tier is not self.tier or buf.refcount > 0:
                 return 0  # raced: moved, freed, or pinned meanwhile
+            global SPILL_EVENTS
+            SPILL_EVENTS += 1
             self._demote(buf)
             self.untrack(buf)
             buf.tier = self.spill_store.tier

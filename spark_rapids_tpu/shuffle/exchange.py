@@ -1041,15 +1041,26 @@ def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
     its sources' byte buffers (tightened by out_cap * max_len when known)."""
     from spark_rapids_tpu.engine.jit_cache import get_or_build
 
+    from spark_rapids_tpu.columnar.batch import _sync_free_strings
+
     total = sum(s.count for s in slices)
     cap_out = bucket_capacity(max(total, 1))
     first = slices[0].batch
     dtypes = tuple(c.dtype for c in first.columns)
     src_caps = tuple(s.batch.capacity for s in slices)
+    # string byte capacities: a high-fence backend uses the host-known
+    # bound (sum of source buffers, tightened by cap_out * max_len); a
+    # cheap-fence backend syncs the EXACT totals and gathers at exact
+    # capacity — a bucket holds ~1/n_out of its sources' rows, so the
+    # bound over-sizes the byte kernel by ~n_out
+    sync_free = _sync_free_strings()
     byte_caps = []
     for ci, dt in enumerate(dtypes):
         if dt is not DataType.STRING:
             byte_caps.append(0)
+            continue
+        if not sync_free:
+            byte_caps.append(-1)  # resolved after the plan pass
             continue
         bound = sum(int(s.batch.columns[ci].data.shape[0]) for s in slices)
         mls = [s.batch.columns[ci].max_len for s in slices]
@@ -1079,9 +1090,19 @@ def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
             outs = []
             for ci, dt in enumerate(dtypes):
                 if dt is DataType.STRING:
-                    outs.append(_routed_string_col(
-                        [cs[ci] for cs in cols_by_slice], src_rows, pid,
-                        live, byte_caps[ci], cap_out))
+                    col_slices = [cs[ci] for cs in cols_by_slice]
+                    starts, new_offsets, valid = _routed_string_plan(
+                        col_slices, src_rows, pid, live)
+                    if byte_caps[ci] > 0:
+                        out = _routed_string_bytes(
+                            [cv.data for cv in col_slices], starts,
+                            new_offsets, pid, byte_caps[ci], cap_out)
+                        outs.append([out, valid, new_offsets])
+                    else:
+                        # exact-cap path (4-list): bytes gather runs
+                        # after a host read of the totals (cheap-fence
+                        # backends)
+                        outs.append([starts, new_offsets, valid, pid])
                     continue
                 acc_d = None
                 acc_v = None
@@ -1097,7 +1118,7 @@ def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
                         acc_v = jnp.where(here, v, acc_v)
                 acc_v = acc_v & live
                 acc_d = jnp.where(acc_v, acc_d, jnp.zeros((), acc_d.dtype))
-                outs.append((acc_d, acc_v, None))
+                outs.append([acc_d, acc_v, None])
             return outs
 
         return jax.jit(kernel)
@@ -1114,6 +1135,19 @@ def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
                      for s in slices]
     orders = [s.order for s in slices]
     outs = kern(cols_by_slice, orders, meta)  # np meta: no eager convert
+    # exact-cap string columns: one host read of all totals, then one
+    # byte-gather kernel each at the exact bucket
+    plan_cis = [ci for ci, o in enumerate(outs) if len(o) == 4]
+    if plan_cis:
+        totals = jax.device_get([outs[ci][1][-1] for ci in plan_cis])
+        for ci, tot in zip(plan_cis, totals):
+            starts, new_offsets, valid, pid = outs[ci]
+            byte_cap = bucket_capacity(max(int(tot), 1))
+            datas = [s.batch.columns[ci].data for s in slices]
+            out = _routed_bytes_kernel(
+                tuple(int(d.shape[0]) for d in datas), byte_cap, cap_out,
+                len(slices))(datas, starts, new_offsets, pid)
+            outs[ci] = (out, valid, new_offsets)
     cols = []
     for ci, (dt, (d, v, off)) in enumerate(zip(dtypes, outs)):
         if dt is DataType.STRING:
@@ -1129,11 +1163,9 @@ def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
     return ColumnarBatch(cols, total)
 
 
-def _routed_string_col(col_slices, src_rows, pid, live, byte_cap: int,
-                       cap_out: int):
-    """String column assembly inside the routed kernel: per-lane source
-    starts/lengths selected across slices, then one searchsorted byte
-    gather into the host-bounded byte capacity."""
+def _routed_string_plan(col_slices, src_rows, pid, live):
+    """String plan inside the routed kernel: per-lane source starts and
+    output offsets selected across slices (no byte work)."""
     starts = None
     lengths = None
     valid = None
@@ -1154,24 +1186,48 @@ def _routed_string_col(col_slices, src_rows, pid, live, byte_cap: int,
     new_offsets = jnp.concatenate([
         jnp.zeros((1,), jnp.int32),
         jnp.cumsum(lengths, dtype=jnp.int32)])
+    return starts, new_offsets, valid
+
+
+def _routed_string_bytes(datas, starts, new_offsets, pid, byte_cap: int,
+                         cap_out: int):
+    """Byte gather of a routed string plan: searchsorted byte->row, then
+    per-slice source selection (shared by the fused in-kernel path and
+    the exact-cap post-sync path)."""
     pos = jnp.arange(byte_cap, dtype=jnp.int32)
     row = jnp.searchsorted(new_offsets[1:], pos,
                            side="right").astype(jnp.int32)
     row = jnp.clip(row, 0, cap_out - 1)
     within = pos - new_offsets[row]
     in_use = pos < new_offsets[-1]
-    # re-select the source byte per lane across slices
     out = None
     src_pos_base = jnp.where(in_use, starts[row] + within, 0)
-    for p, cv in enumerate(col_slices):
-        sp = jnp.clip(src_pos_base, 0, cv.data.shape[0] - 1)
-        b = cv.data[sp]
+    for p, d in enumerate(datas):
+        sp = jnp.clip(src_pos_base, 0, d.shape[0] - 1)
+        b = d[sp]
         if out is None:
             out = b
         else:
             out = jnp.where(pid[row] == p, b, out)
     out = jnp.where(in_use, out, 0).astype(jnp.uint8)
-    return out, valid, new_offsets
+    return out
+
+
+def _routed_bytes_kernel(byte_shapes, byte_cap: int, cap_out: int,
+                         m: int):
+    """Jitted exact-cap byte gather (cheap-fence backends), cached per
+    (source byte buffer shapes, output byte bucket)."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    key = ("routed_bytes", tuple(byte_shapes), byte_cap, cap_out, m)
+
+    def build():
+        def fn(datas, starts, new_offsets, pid):
+            return _routed_string_bytes(datas, starts, new_offsets, pid,
+                                        byte_cap, cap_out)
+        return jax.jit(fn)
+
+    return get_or_build(key, build)
 
 
 # ===========================================================================

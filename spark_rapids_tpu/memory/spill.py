@@ -69,8 +69,11 @@ _id_counter = itertools.count(1)
 
 # process-wide count of buffer tier demotions (benchmark diagnostics: a
 # throughput decline past the HBM plateau names spill thrash as its cause
-# iff this moved during the measured iterations)
+# iff this moved during the measured iterations). Incremented under its own
+# lock: concurrent demotions hold only their per-buffer locks, so a bare
+# read-modify-write would lose counts.
 SPILL_EVENTS = 0
+_SPILL_EVENTS_LOCK = threading.Lock()
 
 
 def next_buffer_id() -> int:
@@ -206,7 +209,8 @@ class BufferStore:
             if buf.tier is not self.tier or buf.refcount > 0:
                 return 0  # raced: moved, freed, or pinned meanwhile
             global SPILL_EVENTS
-            SPILL_EVENTS += 1
+            with _SPILL_EVENTS_LOCK:
+                SPILL_EVENTS += 1
             self._demote(buf)
             self.untrack(buf)
             buf.tier = self.spill_store.tier

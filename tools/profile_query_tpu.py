@@ -1,0 +1,77 @@
+"""Profile one suite query through the engine on the REAL TPU backend.
+
+Usage: python tools/profile_query_tpu.py [suite] [qname] [sf]
+Same shape as profile_query.py but leaves the axon/TPU backend selection
+alone, and prints the cProfile breakdown of the steady-state iteration so
+host round trips (device_put / device_get / eager dispatch) are visible.
+"""
+from __future__ import annotations
+
+import cProfile
+import importlib
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache_tpu"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import spark_rapids_tpu as srt  # noqa: E402
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    suite = args[0] if len(args) > 0 else "tpch"
+    qname = args[1] if len(args) > 1 else "q1"
+    sf = float(args[2]) if len(args) > 2 else 0.05
+
+    print("devices:", jax.devices(), flush=True)
+    qmod = importlib.import_module(f"spark_rapids_tpu.benchmarks.{suite}")
+    session = srt.new_session()
+    session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    session.conf.set("rapids.tpu.sql.incompatibleOps.enabled", True)
+    t0 = time.perf_counter()
+    tables = {k: v.cache() for k, v in
+              qmod.gen_tables(session, sf=sf, num_partitions=4).items()}
+    print(f"gen_tables: {time.perf_counter() - t0:.3f}s", flush=True)
+    qfn = qmod.QUERIES[qname]
+
+    t0 = time.perf_counter()
+    qfn(tables).collect()
+    print(f"warmup (compile): {time.perf_counter() - t0:.3f}s", flush=True)
+
+    t0 = time.perf_counter()
+    qfn(tables).collect()
+    print(f"iter 1: {time.perf_counter() - t0:.3f}s", flush=True)
+
+    pr = cProfile.Profile()
+    t0 = time.perf_counter()
+    pr.enable()
+    qfn(tables).collect()
+    pr.disable()
+    print(f"iter 2 (profiled): {time.perf_counter() - t0:.3f}s", flush=True)
+
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue())
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("tottime")
+    ps.print_stats(30)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

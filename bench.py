@@ -157,13 +157,19 @@ def _worker(mode: str) -> None:
     # duration listener: fires on ACTUAL compiles regardless of whether
     # the persistent compilation cache is enabled/supported (the plain
     # event listener only sees cache-key events)
-    # actual-compile signal: backend_compile_duration fires per real XLA
-    # compile (cache hits fire only compile_time_saved_sec, which must NOT
-    # count — a hit is exactly the case that is not a recompile)
-    _jmon.register_event_duration_secs_listener(
-        lambda event, _secs, **kw: compile_ctr.__setitem__(
-            0, compile_ctr[0]
-            + (1 if "backend_compile_duration" in event else 0)))
+    # backend_compile_duration wraps compile_or_get_cached INCLUDING
+    # persistent-cache hits (jax 0.9 pxla.py), so counts alone cannot
+    # distinguish a recompile from a cheap cache load. Track seconds too:
+    # the decline attribution below names recompiles only when real time
+    # went to them (a load is ~ms, a compile is seconds).
+    compile_secs = [0.0]
+
+    def _on_compile_event(event, secs, **_kw):
+        if "backend_compile_duration" in event:
+            compile_ctr[0] += 1
+            compile_secs[0] += secs
+
+    _jmon.register_event_duration_secs_listener(_on_compile_event)
     for n in sizes:
         df = _build_df(session, n)
         _log(f"worker[{mode}]: rows={n}: data built, warmup pass")
@@ -171,20 +177,24 @@ def _worker(mode: str) -> None:
         assert len(rows) == N_KEYS, len(rows)
         times = []
         iter_compiles = []
+        iter_compile_s = []
         spills0 = _spill_count()
         for i in range(iters):
-            c0 = compile_ctr[0]
+            c0, s0 = compile_ctr[0], compile_secs[0]
             t0 = time.perf_counter()
             _run_query(df)
             times.append(time.perf_counter() - t0)
             iter_compiles.append(compile_ctr[0] - c0)
+            iter_compile_s.append(round(compile_secs[0] - s0, 3))
             _log(f"worker[{mode}]: rows={n} iter {i}: {times[-1]:.3f}s "
-                 f"(compiles={iter_compiles[-1]})")
+                 f"(compiles={iter_compiles[-1]}, "
+                 f"{iter_compile_s[-1]:.2f}s)")
         best = min(times)
         sweep[n] = best
         # per-size attribution so a throughput decline names its cause
         # (steady-state recompiles / spill thrash / neither => kernel)
         diags[n] = {"steady_compiles": iter_compiles,
+                    "steady_compile_s": iter_compile_s,
                     "spills": _spill_count() - spills0}
         if n == N_ROWS:
             best_1m = best
@@ -230,9 +240,15 @@ def _sweep_result(mode, platform, sweep, best_1m, diags=None):
             causes = []
             for n in declining:
                 d = diags.get(n, {})
-                if any(d.get("steady_compiles", [])):
-                    causes.append(f"{n}: steady-state recompiles "
-                                  f"{d['steady_compiles']}")
+                # the compile-event counter also fires on persistent-cache
+                # LOADS (the duration event wraps compile_or_get_cached);
+                # only meaningful compile SECONDS name recompiles as the
+                # cause — a load costs ~ms
+                csecs = sum(d.get("steady_compile_s", []))
+                if csecs > 0.25:
+                    causes.append(
+                        f"{n}: steady-state recompiles "
+                        f"{d['steady_compiles']} ({csecs:.2f}s)")
                 elif d.get("spills"):
                     causes.append(f"{n}: {d['spills']} spill demotions")
                 else:

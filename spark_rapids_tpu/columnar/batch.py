@@ -1192,15 +1192,31 @@ def _string_byte_bound(cv: ColumnVector, out_cap: int,
     string column `cv` without a device round trip, or None when the
     sync-priced exact total is the better deal. Bounds: out_cap * max_len
     always; the source byte buffer additionally when no index repeats
-    (permutations, group reps, contiguous slices). A max_len-only bound
-    (repeating join-probe gathers) that overshoots the source buffer by
-    more than 4x is declined — one skewed long value would otherwise
-    balloon every gather's output buffer and byte-kernel lanes."""
+    (permutations, group reps, contiguous slices).
+
+    Balloon guard for repeating gathers (join probes): the hazard is ONE
+    long outlier row repeated out_cap times — max_len then oversizes every
+    lane of the byte kernel. That is a per-row SKEW property, not an
+    output/source ratio: a dimension table's short uniform strings (nation
+    names) gathered to fact-table size overshoot the source buffer
+    enormously yet bound tightly. Accept the max_len bound when max_len is
+    close to the source's mean length (or absolutely small); decline only
+    genuinely skewed sources, whose exact-total sync is cheaper than the
+    ballooned kernel."""
     src_bytes = int(cv.data.shape[0])
     bounds = []
     if cv.max_len is not None:
         ml_bound = out_cap * cv.max_len
-        if unique_indices or ml_bound <= 4 * src_bytes:
+        # src_bytes is the pow2-bucketed byte CAPACITY (up to ~2x the live
+        # byte count) over capacity lanes (dead lanes count 0), so this
+        # mean can run up to ~2x the live-row mean; the 2x gate below
+        # keeps the effective live-mean bound at <= 4x even in that worst
+        # case
+        n_lanes = max(int(cv.offsets.shape[0]) - 1, 1) \
+            if cv.offsets is not None else 1
+        mean_len = src_bytes / n_lanes
+        low_skew = cv.max_len <= 2 * mean_len + 8
+        if unique_indices or ml_bound <= 4 * src_bytes or low_skew:
             bounds.append(ml_bound)
     if unique_indices:
         bounds.append(src_bytes)

@@ -293,18 +293,14 @@ class _TpuJoinMixin:
             cond_filter = DeviceFilter(bound_cond)
 
         b_matched_acc = None
-        for stream_batch in stream_iter:
-            if stream_batch.host_rows() == 0:
-                continue
+
+        def emit(stream_batch, plan_out):
+            nonlocal b_matched_acc
             (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
-             b_matched) = joiner.plan(stream_batch, build)
-            if b_matched_acc is None:
-                b_matched_acc = b_matched
-            else:
-                b_matched_acc = b_matched_acc | b_matched
+             _b_matched) = plan_out
             n_out = int(jax.device_get(total))
             if n_out == 0:
-                continue
+                return None
             out_cap = bucket_capacity(n_out)
             s_idx, b_idx, live = _expand_full(offsets, b_order, b_start,
                                               s_safe_gid, match_cnt, out_cap)
@@ -320,7 +316,35 @@ class _TpuJoinMixin:
                 joined = s_out
             if cond_filter is not None:
                 joined = cond_filter.apply(joined)
-            yield joined
+            return joined
+
+        # depth-1 software pipeline: batch i's output-count fence (one
+        # ~66 ms round trip on a tunneled backend) overlaps batch i+1's
+        # plan dispatch — the count's host copy is requested as soon as
+        # the plan kernel is enqueued
+        pending = None
+        for stream_batch in stream_iter:
+            if stream_batch.host_rows() == 0:
+                continue
+            plan_out = joiner.plan(stream_batch, build)
+            b_matched = plan_out[6]
+            if b_matched_acc is None:
+                b_matched_acc = b_matched
+            else:
+                b_matched_acc = b_matched_acc | b_matched
+            try:
+                plan_out[1].copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax scalar (host count path)
+            if pending is not None:
+                joined = emit(*pending)
+                if joined is not None:
+                    yield joined
+            pending = (stream_batch, plan_out)
+        if pending is not None:
+            joined = emit(*pending)
+            if joined is not None:
+                yield joined
 
         if emit_build_tail and build.num_rows > 0:
             # full outer: unmatched build rows with null stream columns

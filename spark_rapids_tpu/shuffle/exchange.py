@@ -894,8 +894,19 @@ def _build_order_keys_kernel(bound_exprs):
                     r = _scalar_to_colv(ctx, r, e.data_type)
                 proxy = RK.key_proxy(r)
                 assert proxy.orderable and len(proxy.arrays) == 1
-                out.append((proxy.arrays[0].astype(jnp.int64),
-                            proxy.null_flag))
+                arr = proxy.arrays[0]
+                if arr.dtype == jnp.uint64:
+                    # f64 order bits are monotone in UNSIGNED space; the
+                    # host/device binning transform treats every emitted
+                    # key as a SIGNED int64 (sign-flip to uint64). A bare
+                    # astype would wrap values >= 2^63 negative and invert
+                    # the negative/positive float order; pre-flipping the
+                    # top bit makes the bitcast signed-monotone.
+                    arr = jax.lax.bitcast_convert_type(
+                        arr ^ jnp.uint64(1 << 63), jnp.int64)
+                else:
+                    arr = arr.astype(jnp.int64)
+                out.append((arr, proxy.null_flag))
             return out
 
         return f

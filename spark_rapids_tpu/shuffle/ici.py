@@ -207,7 +207,15 @@ def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, pid_spec,
 
                 r = _scalar_to_colv(ctx, r, e.data_type)
             proxy = RK.key_proxy(r)
-            ob = proxy.arrays[0].astype(jnp.int64)
+            ob = proxy.arrays[0]
+            if ob.dtype == jnp.uint64:
+                # f64 order bits: unsigned-monotone -> signed-monotone
+                # int64 (see exchange._build_order_keys_kernel; the sign
+                # flip below assumes signed inputs)
+                ob = jax.lax.bitcast_convert_type(
+                    ob ^ jnp.uint64(1 << 63), jnp.int64)
+            else:
+                ob = ob.astype(jnp.int64)
             nf = proxy.null_flag
             u = ob.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
             if not asc:

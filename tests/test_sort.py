@@ -47,6 +47,23 @@ def test_sort_float_nan(session):
         .orderBy("v", "x"))
 
 
+@pytest.mark.parametrize("desc", [False, True])
+def test_global_sort_double_mixed_sign(session, desc):
+    # regression: the range exchange's f64 order bits are monotone in
+    # UNSIGNED space; a bare int64 cast before the signed sign-flip binning
+    # transform wrapped values >= 2^63 and binned every negative double
+    # ABOVE the positives (latent under limit in TPC-H q2, the one suite
+    # sort with negative keys)
+    def q(s):
+        df = gen_df(s, [("v", FloatGen(DataType.FLOAT64)),
+                        ("x", IntGen(DataType.INT32))], n=400,
+                    num_partitions=4)
+        o = F.col("v").desc() if desc else F.col("v").asc()
+        return df.orderBy(o, "x")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
 def test_sort_within_partitions(session):
     assert_tpu_and_cpu_are_equal_collect(
         session,

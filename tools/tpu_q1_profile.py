@@ -1,0 +1,76 @@
+"""On-chip cProfile of one TPC-H query at SF1: attributes steady-state
+wall-clock to fences (device_get), uploads (device_put), dispatch, and
+Python glue. Uses the SAME persistent compile cache as bench.py and the
+prewarm (.jax_cache/<platform>) so a profiling run costs a warm minute of
+tunnel time, not a cold compile.
+
+Usage: python tools/tpu_q1_profile.py [sf] [qname]
+Writes PROFILE_TPU_<qname>.txt and prints one JSON summary line (the
+capture daemon's artifact format).
+"""
+from __future__ import annotations
+
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+
+def main():
+    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    qname = sys.argv[2] if len(sys.argv) > 2 else "q1"
+    dev = jax.devices()[0]
+    cache_dir = os.path.join(REPO, ".jax_cache", dev.platform)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.benchmarks import tpch
+
+    session = srt.new_session()
+    session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    session.conf.set("rapids.tpu.sql.incompatibleOps.enabled", True)
+    tables = {k: v.cache() for k, v in
+              tpch.gen_tables(session, sf=sf, num_partitions=4).items()}
+    qfn = tpch.QUERIES[qname]
+    t0 = time.perf_counter()
+    qfn(tables).collect()
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    qfn(tables).collect()
+    iter1 = time.perf_counter() - t0
+
+    pr = cProfile.Profile()
+    t0 = time.perf_counter()
+    pr.enable()
+    qfn(tables).collect()
+    pr.disable()
+    iter2 = time.perf_counter() - t0
+
+    s = io.StringIO()
+    s.write(f"platform={dev.platform} sf={sf} warmup={warm:.3f}s "
+            f"iter1={iter1:.3f}s iter2={iter2:.3f}s\n\n")
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(50)
+    ps.sort_stats("tottime")
+    ps.print_stats(35)
+    with open(os.path.join(REPO, f"PROFILE_TPU_{qname}.txt"), "w") as f:
+        f.write(s.getvalue())
+    print(json.dumps({"metric": f"tpch_{qname}_steady_s",
+                      "value": round(iter2, 3),
+                      "unit": "s", "vs_baseline": 0.0,
+                      "platform": dev.platform, "sf": sf,
+                      "warmup_s": round(warm, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Run the full TPC-H SF1 suite once on the real chip, populating the SAME
+persistent compile cache bench.py's suite worker uses (.jax_cache/<platform>),
+and record per-query warmup (compile-inclusive) + best-of-2 steady times.
+
+Usage: python tools/tpu_sf1_prewarm.py [sf] [suite]
+Writes BENCH_TPCH_SF1_r05_prewarm.json incrementally after every query so a
+tunnel wedge keeps the completed prefix, and RESUMES from that artifact on
+relaunch (a wedge-killed run re-attempts only the missing queries — the
+supervisor watchdog in tpu_capture_daemon relaunches this script until the
+query set is complete).
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import math
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+QUERY_CAP_S = 1500
+
+
+def main():
+    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    suite = sys.argv[2] if len(sys.argv) > 2 else "tpch"
+    dev = jax.devices()[0]
+    cache_dir = os.path.join(REPO, ".jax_cache", dev.platform)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    print(f"platform={dev.platform} cache={cache_dir}", flush=True)
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.engine import jit_cache
+
+    qmod = importlib.import_module(f"spark_rapids_tpu.benchmarks.{suite}")
+    session = srt.new_session()
+    session.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    session.conf.set("rapids.tpu.sql.incompatibleOps.enabled", True)
+    tables = {k: v.cache() for k, v in
+              qmod.gen_tables(session, sf=sf, num_partitions=4).items()}
+    print("tables built", flush=True)
+
+    out_path = os.path.join(REPO, "BENCH_TPCH_SF1_r05_prewarm.json")
+    rec = {"platform": dev.platform, "sf": sf, "suite": suite,
+           "warmup_s": {}, "best_s": {}, "skipped": []}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prev = json.load(f)
+            if prev.get("sf") == sf and prev.get("suite") == suite:
+                rec["warmup_s"].update(prev.get("warmup_s", {}))
+                rec["best_s"].update(prev.get("best_s", {}))
+                print(f"resuming: {sorted(rec['best_s'])} done", flush=True)
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    class _Cap(Exception):
+        pass
+
+    signal.signal(signal.SIGALRM, lambda *_: (_ for _ in ()).throw(_Cap()))
+    ran = 0
+    for qname, qfn in sorted(qmod.QUERIES.items()):
+        if qname in rec["best_s"]:
+            continue
+        try:
+            signal.alarm(QUERY_CAP_S)
+            t0 = time.perf_counter()
+            qfn(tables).collect()
+            rec["warmup_s"][qname] = round(time.perf_counter() - t0, 3)
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                qfn(tables).collect()
+                times.append(time.perf_counter() - t0)
+            signal.alarm(0)
+            rec["best_s"][qname] = round(min(times), 3)
+            print(f"{qname}: warmup={rec['warmup_s'][qname]}s "
+                  f"best={rec['best_s'][qname]}s", flush=True)
+        except _Cap:
+            rec["skipped"].append(qname)
+            print(f"{qname}: SKIPPED (> {QUERY_CAP_S}s)", flush=True)
+        finally:
+            signal.alarm(0)
+        if rec["best_s"]:
+            rec["geomean_s"] = round(math.exp(
+                sum(math.log(t) for t in rec["best_s"].values())
+                / len(rec["best_s"])), 3)
+        rec["n_done"] = len(rec["best_s"])
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        ran += 1
+        if ran % 5 == 0:
+            jit_cache.clear()
+            jax.clear_caches()
+    print("done:", json.dumps(rec.get("geomean_s")), flush=True)
+
+
+if __name__ == "__main__":
+    main()

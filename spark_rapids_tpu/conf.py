@@ -477,6 +477,14 @@ AGG_LAZY_MAX_PARTS = _conf("rapids.tpu.engine.aggLazyMaxPartitions").doc(
     "is worth its sync."
 ).integer(32)
 
+COLUMN_PRUNING = _conf("rapids.tpu.sql.optimizer.columnPruning.enabled").doc(
+    "Prune unreferenced columns from the logical plan before physical "
+    "planning (the role Spark Catalyst's ColumnPruning rule plays for the "
+    "reference plugin, which receives already-pruned plans): scans decode "
+    "only consumed columns, exchanges and joins move only consumed "
+    "columns, and narrowed build sides qualify for (runtime) broadcast."
+).boolean(True)
+
 BROADCAST_THRESHOLD = _conf("rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "Max estimated bytes for a join side to be broadcast "
     "(reference: spark.sql.autoBroadcastJoinThreshold)."

@@ -134,15 +134,20 @@ class TpuSession:
         return DataFrameReader(self)
 
     # -- plan pipeline --------------------------------------------------------
+    def _optimized(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        from spark_rapids_tpu.plan.optimizer import optimize
+
+        return optimize(plan, self.conf)
+
     def _physical_plan(self, plan: L.LogicalPlan) -> PhysicalExec:
-        cpu_plan = plan_physical(plan, self.conf)
+        cpu_plan = plan_physical(self._optimized(plan), self.conf)
         tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         self.plan_capture.record(final)
         return final
 
     def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
-        cpu_plan = plan_physical(plan, self.conf)
+        cpu_plan = plan_physical(self._optimized(plan), self.conf)
         explain_out: List[str] = []
         tpu_plan = TpuOverrides.apply(
             cpu_plan, self.conf.clone_with({"rapids.tpu.sql.explain": "NONE"}),

@@ -1,0 +1,205 @@
+"""Column-pruning optimizer tests (plan/optimizer.py).
+
+Plan-shape assertions + engine-vs-oracle equivalence on the shapes that
+exercise each pruning rule: join children, cache boundaries, positional
+union, unused windows, grouping keys that must survive, csv positional
+schemas. The reference delegates this rule to Spark Catalyst
+(ColumnPruning); these tests pin the standalone behavior instead.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.optimizer import optimize
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture()
+def session():
+    s = srt.new_session()
+    s.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    return s
+
+
+def _df(session, n=100, parts=2):
+    rng = np.random.default_rng(5)
+    return session.createDataFrame(
+        {"a": rng.integers(0, 10, n).astype(np.int64),
+         "b": rng.integers(-50, 50, n).astype(np.int64),
+         "c": rng.random(n).astype(np.float64),
+         "s": np.array([f"v{i % 7}" for i in range(n)], dtype=object)},
+        [("a", "long"), ("b", "long"), ("c", "double"), ("s", "string")],
+        num_partitions=parts)
+
+
+def _scans(plan):
+    out = []
+
+    def walk(p):
+        if isinstance(p, (L.LocalRelation, L.FileScan)):
+            out.append(p)
+        for ch in p.children:
+            walk(ch)
+    walk(plan)
+    return out
+
+
+def test_scan_narrows_to_consumed_columns(session):
+    df = _df(session)
+    q = df.groupBy("a").agg(F.sum("b").alias("sb"))
+    plan = optimize(q._plan, session.conf)
+    (scan,) = _scans(plan)
+    assert sorted(a.name for a in scan.output) == ["a", "b"]
+
+
+def test_pruning_disabled_keeps_schema(session):
+    session.conf.set("rapids.tpu.sql.optimizer.columnPruning.enabled", False)
+    df = _df(session)
+    q = df.groupBy("a").agg(F.sum("b").alias("sb"))
+    plan = optimize(q._plan, session.conf)
+    (scan,) = _scans(plan)
+    assert len(scan.output) == 4
+
+
+def test_filter_keeps_condition_columns(session):
+    df = _df(session)
+    q = df.filter(F.col("c") > F.lit(0.5)).select("a")
+    plan = optimize(q._plan, session.conf)
+    (scan,) = _scans(plan)
+    assert sorted(a.name for a in scan.output) == ["a", "c"]
+
+
+def test_cache_boundary_gets_project_above(session):
+    df = _df(session).cache()
+    q = df.select("a")
+    plan = optimize(q._plan, session.conf)
+    # the cache child keeps its full schema (shared materialization)...
+    caches = []
+
+    def walk(p):
+        if isinstance(p, L.CacheRelation):
+            caches.append(p)
+        for ch in p.children:
+            walk(ch)
+    walk(plan)
+    (cache,) = caches
+    assert len(cache.output) == 4
+    assert_tpu_and_cpu_are_equal_collect(
+        session, lambda s: _df(s).cache().select("a"), ignore_order=True)
+
+
+def test_join_children_narrow_but_keep_keys(session):
+    left = _df(session)
+    right = _df(session).select(
+        F.col("a").alias("k"), F.col("b").alias("v"),
+        F.col("s").alias("t"))
+    q = left.join(right, on=(left["a"] == F.col("k")), how="inner") \
+        .select("b", "v")
+    plan = optimize(q._plan, session.conf)
+    scans = _scans(plan)
+    names = sorted(tuple(sorted(a.name for a in s.output)) for s in scans)
+    # left keeps join key a + selected b; right keeps k(=a) + v, drops s/c
+    assert names == [("a", "b"), ("a", "b")]
+
+
+def test_grouping_key_survives_when_unselected(session):
+    # grouping on a determines output cardinality even though only the
+    # aggregate value is selected
+    def q(s):
+        df = _df(s)
+        return df.groupBy("a").agg(F.sum("b").alias("sb")).select("sb")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_union_positional_alignment(session):
+    def q(s):
+        d1 = _df(s).select("a", "b", "c")
+        d2 = _df(s).select(
+            (F.col("a") + F.lit(1)).alias("a2"),
+            (F.col("b") * F.lit(2)).alias("b2"), F.col("c").alias("c2"))
+        return d1.union(d2).select("b")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_unused_window_is_dropped(session):
+    from spark_rapids_tpu.plan.window_api import Window
+
+    def q(s):
+        df = _df(s)
+        w = Window.partitionBy("a").orderBy("b")
+        return (df.withColumn("rn", F.row_number().over(w))
+                .select("a", "b"))
+
+    # plan shape: no WindowOp survives
+    s2 = srt.new_session()
+    from spark_rapids_tpu.plan.window_api import Window as W2
+    df = _df(s2)
+    plan = optimize(
+        df.withColumn("rn", F.row_number().over(
+            W2.partitionBy("a").orderBy("b"))).select("a", "b")._plan,
+        s2.conf)
+    found = []
+
+    def walk(p):
+        if isinstance(p, L.WindowOp):
+            found.append(p)
+        for ch in p.children:
+            walk(ch)
+    walk(plan)
+    assert not found
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_used_window_keeps_order_columns(session):
+    from spark_rapids_tpu.plan.window_api import Window
+
+    def q(s):
+        df = _df(s)
+        w = Window.partitionBy("a").orderBy("b")
+        return df.withColumn("rn", F.row_number().over(w)).select("a", "rn")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_generate_keeps_cardinality(session):
+    def q(s):
+        df = _df(s, n=20, parts=1)
+        return (df.select("a", F.explode(
+            F.array(F.col("b"), F.col("b") + F.lit(1))).alias("e"))
+                .select("a"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_sort_keeps_order_columns(session):
+    def q(s):
+        return _df(s).orderBy(F.col("b").desc()).select("a").limit(5)
+
+    assert_tpu_and_cpu_are_equal_collect(session, q)
+
+
+def test_self_join_shared_exprids(session):
+    def q(s):
+        df = _df(s).cache()
+        agg = df.groupBy("a").agg(F.count("*").alias("n"))
+        return (df.join(agg, on=(df["a"] == agg["a"]), how="left_semi")
+                .select("b"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_aggregate_drops_unused_agg_exprs(session):
+    df = _df(session)
+    q = df.groupBy("a").agg(F.sum("b").alias("sb"),
+                            F.sum("c").alias("sc")).select("a", "sb")
+    plan = optimize(q._plan, session.conf)
+    (scan,) = _scans(plan)
+    # c's aggregate is unused -> c never read
+    assert sorted(a.name for a in scan.output) == ["a", "b"]

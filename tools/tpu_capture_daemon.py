@@ -160,10 +160,14 @@ def run_sf1_prewarm() -> bool:
 
 
 def _artifact_quality(rec) -> int:
-    """Orderable quality of a capture: more completed queries beats fewer
-    (non-suite artifacts are all quality 1 — first capture wins)."""
+    """Orderable quality of a capture: more completed queries (suites) or
+    stages (kernel microbench partials) beats fewer; other artifacts are
+    all quality 1 — first capture wins."""
     q = rec.get("queries")
-    return len(q) if isinstance(q, dict) else 1
+    if isinstance(q, dict):
+        return len(q)
+    s = rec.get("stages")
+    return len(s) if isinstance(s, list) else 1
 
 
 def run_captures() -> int:

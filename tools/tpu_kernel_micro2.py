@@ -61,7 +61,10 @@ def timeit(name, fn, *args, iters=3, nbytes=None):
     except Exception as e:  # noqa: BLE001 — record and continue
         out = {"stage": name, "error": f"{type(e).__name__}: {e}"[:200]}
     RESULTS.append(out)
-    print(json.dumps(out), flush=True)
+    # every line carries platform + the stage prefix so a wedge-killed run
+    # still leaves the capture daemon a platform-labelled partial
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "stages": RESULTS, **out}), flush=True)
 
 
 rng = np.random.default_rng(0)

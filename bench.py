@@ -170,6 +170,7 @@ def _worker(mode: str) -> None:
             compile_secs[0] += secs
 
     _jmon.register_event_duration_secs_listener(_on_compile_event)
+    dispatch_info = None
     for n in sizes:
         df = _build_df(session, n)
         _log(f"worker[{mode}]: rows={n}: data built, warmup pass")
@@ -198,12 +199,15 @@ def _worker(mode: str) -> None:
                     "spills": _spill_count() - spills0}
         if n == N_ROWS:
             best_1m = best
+            if mode == "tpu":
+                dispatch_info = _measure_dispatches(session, df)
+                _log(f"worker[{mode}]: dispatches {dispatch_info}")
         df.unpersist()
         del df
         # emit a parseable partial after every size so a mid-sweep wedge
         # still leaves the supervisor a result
         print(json.dumps(_sweep_result(mode, dev.platform, sweep, best_1m,
-                                       diags)), flush=True)
+                                       diags, dispatch_info)), flush=True)
         if deadline is not None and n != sizes[-1]:
             # next size is ~4x the work; skip if it cannot fit
             projected = (best * 4) * (iters + 1) + 20
@@ -213,13 +217,40 @@ def _worker(mode: str) -> None:
                 break
 
 
+def _measure_dispatches(session, df) -> dict:
+    """Device-dispatch counts of the flagship query with whole-stage fusion
+    on vs off (plan/fusion.py). Dispatch count is backend-independent, so
+    the fusion win stays measurable even on the cpu-fallback path where
+    wall-clock deltas drown in noise. Runs AFTER the timed loop for this
+    size so the flag flip's recompiles never pollute the steady-state
+    compile attribution."""
+    from spark_rapids_tpu import conf as C
+
+    key = "rapids.tpu.sql.fusion.enabled"
+    prior = session.conf.get(C.FUSION_ENABLED)
+    out = {}
+    try:
+        for label, enabled in (("fused", True), ("unfused", False)):
+            session.conf.set(key, enabled)
+            _run_query(df)  # warm the flag's compiled programs
+            _run_query(df)
+            m = session.last_query_metrics
+            out[f"dispatches_{label}"] = m.get("deviceDispatches", 0)
+            if enabled:
+                out["fused_stages"] = m.get("fusedStages", 0)
+    finally:
+        session.conf.set(key, prior)
+    return out
+
+
 def _spill_count() -> int:
     from spark_rapids_tpu.memory import spill as _sp
 
     return _sp.SPILL_EVENTS
 
 
-def _sweep_result(mode, platform, sweep, best_1m, diags=None):
+def _sweep_result(mode, platform, sweep, best_1m, diags=None,
+                  dispatch_info=None):
     gbps = {n: n * BYTES_PER_ROW / s / 1e9 for n, s in sweep.items()}
     plateau_rows = max(gbps, key=lambda n: gbps[n])
     out = {
@@ -231,6 +262,8 @@ def _sweep_result(mode, platform, sweep, best_1m, diags=None):
         "plateau_rows": plateau_rows,
         "hbm_frac": round(gbps[plateau_rows] / HBM_GBPS, 6),
     }
+    if dispatch_info:
+        out.update(dispatch_info)
     if diags:
         out["size_diags"] = {str(n): d for n, d in diags.items()}
         # name the cause of any post-plateau decline in the artifact
@@ -998,7 +1031,8 @@ def main() -> None:
         "platform": platform,
         "probe_attempts": probes,
     }
-    for k in ("sweep_s", "sweep_gbps", "plateau_rows", "hbm_frac"):
+    for k in ("sweep_s", "sweep_gbps", "plateau_rows", "hbm_frac",
+              "dispatches_fused", "dispatches_unfused", "fused_stages"):
         if k in acc:
             result[k] = acc[k]
     if platform == "cpu-fallback":

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.dtypes import DataType, from_np
+from spark_rapids_tpu.utils import metrics as M
 
 MIN_CAPACITY = 8
 
@@ -1249,6 +1250,7 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     high-fence backends — removes the per-gather byte-count round trip.
     """
     cap = bucket_capacity(max(out_rows, 1))
+    M.record_dispatch()
     fixed = [(i, cv) for i, cv in enumerate(batch.columns)
              if cv.dtype is not DataType.STRING]
     cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
@@ -1359,6 +1361,7 @@ def compact_batch(batch: ColumnarBatch, keep_mask,
     sync) this folds the filter's fence into whatever downstream sync
     happens anyway; the cost is padded-lane compute at the unshrunk
     capacity."""
+    M.record_dispatch()
     order, n = _compact_plan(keep_mask, jnp.int32(batch.num_rows))
     if lazy:
         return _gather_batch_traced(batch, order, n)
@@ -1373,6 +1376,7 @@ def _gather_batch_traced(batch: ColumnarBatch, indices,
     sync anywhere."""
     cap = batch.capacity
     n32 = jnp.asarray(out_rows, dtype=jnp.int32)
+    M.record_dispatch()
     fixed = [(i, cv) for i, cv in enumerate(batch.columns)
              if cv.dtype is not DataType.STRING]
     cols: List[Optional[ColumnVector]] = [None] * batch.num_columns

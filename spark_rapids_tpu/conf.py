@@ -477,6 +477,21 @@ AGG_LAZY_MAX_PARTS = _conf("rapids.tpu.engine.aggLazyMaxPartitions").doc(
     "is worth its sync."
 ).integer(32)
 
+FUSION_ENABLED = _conf("rapids.tpu.sql.fusion.enabled").doc(
+    "Compile whole pipelined stages — maximal chains of Filter/Project/"
+    "Expand/LocalLimit feeding each other (and the update side of a "
+    "partial hash aggregate) — into ONE XLA program per stage, so XLA "
+    "fuses across operator boundaries and intermediate batches never "
+    "materialize between exec nodes (the WholeStageCodegen analog; "
+    "docs/fusion.md). Off = one jitted program per operator."
+).boolean(True)
+
+FUSION_MAX_OPS = _conf("rapids.tpu.sql.fusion.maxOps").doc(
+    "Upper bound on operators fused into one stage program; a pathological "
+    "deep chain past this splits into multiple stages (guards XLA compile "
+    "time, which grows with the traced program)."
+).check(lambda v: None if v >= 2 else "must be >= 2").integer(16)
+
 COLUMN_PRUNING = _conf("rapids.tpu.sql.optimizer.columnPruning.enabled").doc(
     "Prune unreferenced columns from the logical plan before physical "
     "planning (the role Spark Catalyst's ColumnPruning rule plays for the "

@@ -196,13 +196,18 @@ def _default_row_values(specs: List[AggSpec]) -> List[Any]:
 # ===========================================================================
 # TPU exec
 # ===========================================================================
-def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression]):
+def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression],
+                         max_nodes: Optional[int] = None):
     """Fuse a TpuFilter/TpuProject/TpuCoalesceBatches chain below the
     aggregate into its update kernel: project lists substitute into the
     aggregate's expressions, filter conditions become row masks evaluated
     inside the SAME jit. This removes the filter's compact (a device->host
     row-count sync + gather) from the hot path entirely — the XLA analog of
     cuDF's pre-projection into the groupby (aggregate.scala:307-336).
+
+    `max_nodes` bounds the walk to the same chain length the fusion pass
+    claimed (fusion.maxOps), keeping the executed program consistent with
+    the plan's stage accounting.
 
     Returns (scan child, rewritten exprs, filter conditions)."""
     from spark_rapids_tpu.exec import basic as B
@@ -211,7 +216,9 @@ def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression]):
     filters: List[Expression] = []
     exprs = list(exprs)
     node = child
-    while True:
+    walked = 0
+    while max_nodes is None or walked < max_nodes:
+        walked += 1
         if isinstance(node, B.TpuProjectExec):
             mapping: Dict[int, Expression] = {}
             for e in node.project_list:
@@ -426,6 +433,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             # a tunneled backend)
             npdts = tuple(physical_np_dtype(dt) for _, _, dt in fixed)
             kern = _finalize_kernel(out_cap, npdts)
+            M.record_dispatch()
             outs = kern([o for _, o, _ in fixed], np.int32(n_groups))
             for (si, _o, dt), (d, v) in zip(fixed, outs):
                 slots[si] = ColumnVector(dt, d, v)
@@ -443,10 +451,22 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         filters: List[Expression] = []
         str_agg_idx = [i for i, (op, _e, dt) in enumerate(ops)
                        if dt is DataType.STRING and op in ("min", "max")]
-        if do_update:
+        # chain collapse is the aggregate half of whole-stage fusion; it
+        # follows the SAME eligibility predicate and chain-length budget as
+        # the plan pass (plan/fusion._agg_stage_len wraps the chain in a
+        # TpuFusedStageExec for accounting), so what executes always matches
+        # the claimed stage — and fusion off really runs one program per
+        # operator
+        stage_len = 0
+        if do_update and ctx.conf.get(C.FUSION_ENABLED):
+            from spark_rapids_tpu.plan.fusion import agg_stage_len
+
+            stage_len = agg_stage_len(self, ctx.conf.get(C.FUSION_MAX_OPS))
+        if stage_len > 1:
             n_in = len(key_exprs)
             scan, rewritten, new_filters = _collapse_scan_chain(
-                child, list(key_exprs) + list(input_exprs))
+                child, list(key_exprs) + list(input_exprs),
+                max_nodes=stage_len - 1)
             collapsed_inputs = rewritten[n_in:]
             # string min/max needs a statically-bounded max length, which is
             # only derivable for plain column inputs — skip the collapse if
@@ -519,6 +539,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     nc, self._build_merge_kernel(n_keys, lazy, nc))
             cols = [_col_to_colv(c) for c in batch.columns]
             kvr = [c.vrange for c in batch.columns[:n_keys]]
+            M.record_dispatch()
             out = merge_kernel[0][1](cols, count_arg(batch))
             if lazy:
                 outs, num_groups = out
@@ -560,6 +581,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     cols = [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
+                    M.record_dispatch()
                     out = update_kernel[0][1](cols, count_arg(batch))
                     # keyed by the batch's (quantized) column vranges so the
                     # symbolic walk runs once per distinct range profile,

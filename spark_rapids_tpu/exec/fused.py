@@ -1,0 +1,326 @@
+"""Whole-stage fused executor (the WholeStageCodegen analog).
+
+One `TpuFusedStageExec` owns a maximal chain of pipelined device operators
+(the plan/fusion.py pass builds it) and traces the WHOLE chain as one
+composed device function: child batch in, final stage batch out. Filters
+become live-row masks carried through the trace (no per-operator compaction),
+projections rewrite the column set in-trace, Expand selects its projection
+list as a static program variant, and a LocalLimit becomes a prefix mask over
+the live rows — so XLA fuses across operator boundaries and the
+intermediates between exec nodes never materialize as HBM batches. One
+compaction at stage exit (skipped entirely for row-preserving chains)
+replaces the per-filter compact+sync of the unfused path.
+
+The stage keeps the ORIGINAL operator subtree as its child for plan
+introspection (EXPLAIN renders the members with Spark-style `*(N)` markers,
+plan-capture tests keep seeing the member nodes); execute() bypasses the
+members and runs the composed program against the chain's input directly.
+
+Two forms:
+- scan form: Filter/Project/Expand/LocalLimit chain -> own composed program.
+- aggregate form: the chain terminates at the update side of a hash
+  aggregate; the aggregate's update kernel already traces projections and
+  filter masks below it into its single program
+  (exec/aggregate._collapse_scan_chain — gated on the same fusion conf), so
+  the stage node wraps it for stage accounting and delegates execution.
+
+Program cache: engine/jit_cache.py keyed by the stage's composite expression
+fingerprint (+ expand variant); capacity bucketing rides jax.jit's
+shape-keyed retrace as everywhere else in the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu import _jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import (
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.ops.base import Expression
+from spark_rapids_tpu.ops.bind import bind_all, bind_references
+from spark_rapids_tpu.ops.eval import (
+    _col_to_colv,
+    _colv_to_col,
+    _scalar_to_colv,
+    _widen_physical,
+    keep_mask_from_result,
+    raise_deferred_ansi,
+)
+from spark_rapids_tpu.ops.values import ColV, EvalContext, ScalarV
+from spark_rapids_tpu.utils import metrics as M
+
+
+def is_fusable_scan_node(node: PhysicalExec) -> bool:
+    """Stage-member predicate shared with the fusion pass: pipelined device
+    operators whose semantics survive mask-deferred evaluation."""
+    from spark_rapids_tpu.exec.expand import TpuExpandExec
+
+    return isinstance(node, (B.TpuFilterExec, B.TpuProjectExec,
+                             TpuExpandExec, B.TpuLocalLimitExec))
+
+
+def exprs_fusable(exprs: Sequence[Expression]) -> bool:
+    """Expressions a fused stage may defer behind a live-row mask:
+    deterministic (a filtered-then-projected nondeterministic stream must
+    not see dropped rows — rand/monotonic ids consume positions), no
+    deferred-ANSI ops (an ANSI error on a row a preceding filter dropped
+    must not surface), no input-file context expressions."""
+    def bad(x) -> bool:
+        return (getattr(x, "ansi", False)
+                or getattr(x, "disable_coalesce_until_input", False))
+
+    for e in exprs:
+        if not e.deterministic or e.collect(bad):
+            return False
+    return True
+
+
+class _StageOp:
+    """One fused operator: kind + expressions bound to the running schema."""
+
+    __slots__ = ("kind", "bound", "limit")
+
+    def __init__(self, kind: str, bound=None, limit: Optional[int] = None):
+        self.kind = kind       # 'filter' | 'project' | 'expand' | 'limit'
+        self.bound = bound     # filter: Expression; project: [Expression];
+        #                        expand: [[Expression]] (one list per variant)
+        self.limit = limit
+
+    def fingerprint(self) -> tuple:
+        if self.kind == "filter":
+            return ("filter", self.bound.fingerprint())
+        if self.kind == "project":
+            return ("project", tuple(e.fingerprint() for e in self.bound))
+        if self.kind == "expand":
+            return ("expand", tuple(tuple(e.fingerprint() for e in p)
+                                    for p in self.bound))
+        return ("limit",)
+
+
+class TpuFusedStageExec(TpuExec):
+    """Executes `n_ops` chained operators (rooted at children[0]) as one
+    composed XLA program per batch (aggregate form: delegates to the
+    aggregate's own fused update kernel)."""
+
+    def __init__(self, stage_id: int, top: PhysicalExec, n_ops: int):
+        super().__init__(top)
+        self.stage_id = stage_id
+        self.n_ops = n_ops
+        # walk the member chain top-down; the node below the chain is the
+        # stage input
+        self.members: List[PhysicalExec] = []
+        node = top
+        for _ in range(n_ops):
+            self.members.append(node)
+            node = node.children[0]
+        self.input_node = node
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+
+        self.agg_form = isinstance(top, TpuHashAggregateExec)
+        if not self.agg_form:
+            self._build_scan_ops()
+
+    # -- structure -----------------------------------------------------------
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        return TpuFusedStageExec(self.stage_id, new_children[0], self.n_ops)
+
+    def node_name(self):
+        inner = "->".join(type(m).__name__.replace("Tpu", "").replace(
+            "Exec", "") for m in reversed(self.members))
+        return f"TpuFusedStage({self.stage_id})[{inner}]"
+
+    # -- scan-form program ----------------------------------------------------
+    def _build_scan_ops(self) -> None:
+        """Bottom-up: rebind each member's expressions against the running
+        schema so the composed trace consumes the previous op's outputs."""
+        from spark_rapids_tpu.exec.expand import TpuExpandExec
+
+        ops: List[_StageOp] = []
+        attrs = list(self.input_node.output)
+        n_variants = 1
+        for node in reversed(self.members):
+            if isinstance(node, B.TpuFilterExec):
+                ops.append(_StageOp(
+                    "filter", bind_references(node.condition, attrs)))
+            elif isinstance(node, B.TpuProjectExec):
+                ops.append(_StageOp(
+                    "project", bind_all(node.project_list, attrs)))
+                attrs = node.output
+            elif isinstance(node, TpuExpandExec):
+                ops.append(_StageOp(
+                    "expand", [bind_all(p, attrs) for p in node.projections]))
+                attrs = list(node.output_attrs)
+                n_variants = len(node.projections)
+            elif isinstance(node, B.TpuLocalLimitExec):
+                ops.append(_StageOp("limit", limit=node.limit))
+            else:  # pragma: no cover - the fusion pass only builds the above
+                raise AssertionError(f"unfusable {type(node).__name__}")
+        self._ops = ops
+        self._n_variants = n_variants
+        self._limit = next((op.limit for op in ops if op.kind == "limit"),
+                           None)
+        # does the (single) limit sit below the (single) expand? then all
+        # expand variants of one input batch share the SAME remaining budget
+        kinds = [op.kind for op in ops]
+        self._limit_below_expand = (
+            "limit" in kinds and "expand" in kinds
+            and kinds.index("limit") < kinds.index("expand"))
+        self._row_changing = any(k in ("filter", "limit") for k in kinds)
+        # every row-changing op below the expand => all expand variants of
+        # one input batch share the SAME live mask, so the stage computes
+        # one compaction plan per batch instead of one per variant
+        self._live_shared = "expand" not in kinds or all(
+            k not in ("filter", "limit")
+            for k in kinds[kinds.index("expand") + 1:])
+        self._programs = {}
+
+    def _program(self, variant: int):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+        cached = self._programs.get(variant)
+        if cached is not None:
+            return cached
+        ops = self._ops
+        key = ("fused_stage", tuple(op.fingerprint() for op in ops), variant)
+
+        def build():
+            msgs: List[str] = []
+
+            def fn(cols: List[ColV], num_rows, partition_id, row_start,
+                   remaining):
+                capacity = cols[0].validity.shape[0] if cols else 8
+                live = jnp.arange(capacity) < num_rows
+                limit_passed = jnp.int32(0)
+                ansi = []
+                cur = cols
+                for op in ops:
+                    if op.kind == "limit":
+                        n_live = jnp.sum(live.astype(jnp.int32))
+                        limit_passed = jnp.minimum(n_live, remaining)
+                        live = live & (jnp.cumsum(live.astype(jnp.int32))
+                                       <= remaining)
+                        continue
+                    ctx = EvalContext(jnp, True, cur, num_rows, capacity,
+                                      partition_id=partition_id,
+                                      row_start=row_start)
+                    if op.kind == "filter":
+                        live = live & keep_mask_from_result(
+                            op.bound.eval(ctx), capacity)
+                    else:  # project / expand
+                        exprs = op.bound if op.kind == "project" \
+                            else op.bound[variant]
+                        outs = []
+                        for e in exprs:
+                            r = e.eval(ctx)
+                            if isinstance(r, ScalarV):
+                                r = _scalar_to_colv(ctx, r, e.data_type)
+                            outs.append(r)
+                        cur = outs
+                    ansi.extend(ctx.ansi_errors)
+                del msgs[:]
+                msgs.extend(m for _, m in ansi)
+                return ([_widen_physical(c) for c in cur], live,
+                        limit_passed, [f for f, _ in ansi])
+
+            return jax.jit(fn), msgs
+
+        built = get_or_build(key, build)
+        self._programs[variant] = built
+        return built
+
+    # -- execution ------------------------------------------------------------
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        if self.agg_form:
+            # the aggregate's update kernel IS the stage program (it folds
+            # the projections/filter masks below it into its own trace)
+            agg_pb = self.children[0].execute(ctx)
+            return PartitionedBatches(
+                agg_pb.num_partitions,
+                lambda p: count_output(self.metrics, agg_pb.iterator(p)),
+                bucket_costs=agg_pb.bucket_costs)
+        child_pb = self.input_node.execute(ctx)
+        total_time = self.metrics[M.TOTAL_TIME]
+        # stage-exit compaction sync policy: same shape as the standalone
+        # filter's (exec/basic.TpuFilterExec); a limit in the stage always
+        # syncs — its cross-batch budget needs the host count anyway
+        lazy = False
+        if self._row_changing and self._limit is None:
+            policy = ctx.conf.get(C.FILTER_COMPACT_SYNC)
+            if policy == "never":
+                lazy = True
+            elif policy == "auto":
+                from spark_rapids_tpu.exec.aggregate import (
+                    LAZY_FENCE_THRESHOLD_MS,
+                )
+                from spark_rapids_tpu.utils.devprobe import fence_cost_ms
+
+                lazy = fence_cost_ms() >= LAZY_FENCE_THRESHOLD_MS
+
+        def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            from spark_rapids_tpu.columnar.batch import (
+                _compact_plan,
+                _gather_batch_traced,
+                bucket_capacity,
+                gather_batch,
+            )
+
+            row_start = 0
+            remaining = self._limit
+            for batch in child_pb.iterator(pidx):
+                if remaining is not None and remaining <= 0:
+                    break
+                cols = [_col_to_colv(c) for c in batch.columns]
+                if not cols:
+                    cap = bucket_capacity(max(batch.host_rows(), 1))
+                    cols = [ColV(DataType.BOOL,
+                                 jnp.zeros((cap,), dtype=bool),
+                                 jnp.arange(cap) < batch.num_rows)]
+                n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
+                order = n_keep = None
+                for variant in range(self._n_variants):
+                    if remaining is not None and remaining <= 0:
+                        break
+                    jitted, msgs = self._program(variant)
+                    with M.trace_range("TpuFusedStage", total_time):
+                        M.record_dispatch()
+                        outs, live, limit_passed, flags = jitted(
+                            cols, n, jnp.int32(pidx), jnp.int64(row_start),
+                            jnp.int32(remaining or 0))
+                    raise_deferred_ansi(flags, msgs)
+                    out = ColumnarBatch([_colv_to_col(o) for o in outs],
+                                        batch.num_rows)
+                    if self._row_changing:
+                        if order is None or not self._live_shared:
+                            M.record_dispatch()
+                            order, nk = _compact_plan(live, n)
+                            n_keep = nk if lazy else \
+                                int(jax.device_get(nk))
+                        out = _gather_batch_traced(out, order, n_keep) \
+                            if lazy else gather_batch(out, order, n_keep)
+                    if remaining is not None and \
+                            not self._limit_below_expand:
+                        remaining -= int(jax.device_get(limit_passed))
+                    yield out
+                if remaining is not None and self._limit_below_expand:
+                    remaining -= int(jax.device_get(limit_passed))
+                row_start += batch.num_rows
+
+        return PartitionedBatches(
+            child_pb.num_partitions,
+            lambda p: count_output(self.metrics, factory(p)))

@@ -29,6 +29,7 @@ from spark_rapids_tpu.columnar.batch import (
 )
 from spark_rapids_tpu.ops.base import Expression
 from spark_rapids_tpu.ops.values import ColV, EvalContext, ScalarV, broadcast_scalar
+from spark_rapids_tpu.utils import metrics as M
 
 # ColV must flow through jit as a pytree (vrange rides the aux data so
 # narrowability is part of program cache identity)
@@ -92,6 +93,28 @@ def _scalar_to_colv(ctx: EvalContext, s: ScalarV, want: DataType) -> ColV:
     return ColV(want, col.data, col.validity)
 
 
+def keep_mask_from_result(r, capacity: int):
+    """Boolean keep mask from a filter condition's evaluated result:
+    a scalar condition keeps all or no rows; a column keeps rows whose
+    value is true AND non-null (SQL: null condition drops the row).
+    Shared by DeviceFilter and the fused-stage program (exec/fused.py) so
+    the two paths can never diverge on null semantics."""
+    if isinstance(r, ScalarV):
+        return jnp.full((capacity,), (not r.is_null) and bool(r.value))
+    return r.data.astype(bool) & r.validity
+
+
+def raise_deferred_ansi(flags, msgs) -> None:
+    """Drain the deferred ANSI error channel after a jitted call (one
+    batched host read; zero cost when no ANSI op traced)."""
+    if not flags:
+        return
+    got = jax.device_get(flags)
+    for v, m in zip(got, msgs):
+        if bool(v):
+            raise ValueError(m)
+
+
 class DeviceProjector:
     """Compiles and caches the jitted evaluator for a fixed list of bound
     expressions (reference: GpuProjectExec's bound-expression evaluation,
@@ -147,13 +170,10 @@ class DeviceProjector:
                          jnp.zeros((cap,), dtype=bool),
                          jnp.arange(cap) < batch.num_rows)]
         n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
+        M.record_dispatch()
         outs, flags = jitted(cols, n, jnp.int32(partition_id),
                              jnp.int64(row_start))
-        if flags:
-            got = jax.device_get(flags)
-            for v, m in zip(got, msgs):
-                if bool(v):
-                    raise ValueError(m)
+        raise_deferred_ansi(flags, msgs)
         return ColumnarBatch([_colv_to_col(o) for o in outs], batch.num_rows)
 
 
@@ -179,12 +199,7 @@ class DeviceFilter:
                 ctx = EvalContext(jnp, True, cols, num_rows, capacity,
                                   partition_id=partition_id,
                                   row_start=row_start)
-                r = cond.eval(ctx)
-                if isinstance(r, ScalarV):
-                    keep = jnp.full((capacity,),
-                                    (not r.is_null) and bool(r.value))
-                else:
-                    keep = r.data.astype(bool) & r.validity  # null -> dropped
+                keep = keep_mask_from_result(cond.eval(ctx), capacity)
                 del msgs[:]
                 msgs.extend(m for _, m in ctx.ansi_errors)
                 return keep & ctx.row_mask(), [f for f, _ in ctx.ansi_errors]
@@ -201,14 +216,11 @@ class DeviceFilter:
             self._jitted = self._build()
         jitted, msgs = self._jitted
         cols = [_col_to_colv(c) for c in batch.columns]
+        M.record_dispatch()
         keep, flags = jitted(cols, jnp.int32(batch.num_rows),
                              jnp.int32(partition_id),
                              jnp.int64(row_start))
-        if flags:
-            got = jax.device_get(flags)
-            for v, m in zip(got, msgs):
-                if bool(v):
-                    raise ValueError(m)
+        raise_deferred_ansi(flags, msgs)
         return compact_batch(batch, keep, lazy=lazy)
 
 

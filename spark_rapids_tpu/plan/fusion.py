@@ -1,0 +1,163 @@
+"""Whole-stage fusion pass (the WholeStageCodegen planning analog).
+
+Runs on the FINAL physical plan (after TpuOverrides conversion and
+transition/coalesce insertion): greedily groups maximal chains of fusable,
+pipelined TPU operators into `TpuFusedStageExec` nodes (exec/fused.py), so
+each stage executes as ONE composed XLA program instead of one program (plus
+intermediate batch) per operator.
+
+Stage membership:
+- scan form: TpuFilter / TpuProject / TpuExpand / TpuLocalLimit chains with
+  deterministic, non-ANSI, non-input-file expressions; at most one Expand
+  and one LocalLimit per stage (an Expand multiplies the program into one
+  static variant per projection list; a second limit would need a second
+  cross-batch budget operand).
+- aggregate form: a partial/complete TpuHashAggregate tops the stage; its
+  update kernel already folds the Project/Filter chain below it into one
+  trace (exec/aggregate._collapse_scan_chain, gated on the same conf), so
+  the pass wraps aggregate + chain for stage accounting.
+
+Fusion barriers — anything else terminates a stage, mirroring the
+reference's coalesce-goal boundaries: shuffle exchanges, joins, sorts,
+windows, host<->device transitions, batch coalesces, scans, caches, and the
+merge/final side of aggregates (blocking, not pipelined).
+
+Conf: rapids.tpu.sql.fusion.enabled (default on),
+rapids.tpu.sql.fusion.maxOps (stage size guard).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import PhysicalExec
+from spark_rapids_tpu.exec.fused import (
+    TpuFusedStageExec,
+    exprs_fusable,
+    is_fusable_scan_node,
+)
+
+
+def _scan_member(node: PhysicalExec) -> bool:
+    return is_fusable_scan_node(node) and \
+        exprs_fusable(node.node_expressions())
+
+
+def _agg_chain_member(node: PhysicalExec) -> bool:
+    """What the aggregate's update-kernel collapse walks through: projects,
+    filters, and best-effort TargetSize coalesces (a RequireSingleBatch
+    coalesce is semantic — holistic aggregates — and blocks the stage)."""
+    from spark_rapids_tpu.exec.transitions import TpuCoalesceBatchesExec
+
+    if isinstance(node, TpuCoalesceBatchesExec):
+        return node.goal.target_bytes() is not None
+    return isinstance(node, (B.TpuFilterExec, B.TpuProjectExec)) and \
+        exprs_fusable(node.node_expressions())
+
+
+def agg_stage_len(node: PhysicalExec, max_ops: int) -> int:
+    """Chain length (agg included) of an aggregate-form stage rooted at
+    `node`, or 0 when the node does not head a fusable aggregate stage."""
+    from spark_rapids_tpu.columnar.dtypes import DataType
+    from spark_rapids_tpu.exec.aggregate import (
+        COMPLETE,
+        PARTIAL,
+        TpuHashAggregateExec,
+    )
+
+    if not isinstance(node, TpuHashAggregateExec) or \
+            node.mode not in (PARTIAL, COMPLETE):
+        return 0
+    exprs = list(node.key_exprs) + [e for _, e, _ in node._update_ops()]
+    if not exprs_fusable(exprs):
+        return 0
+    n_ops = 1
+    real_members = 0
+    has_project = False
+    cur = node.children[0]
+    while n_ops < max_ops and _agg_chain_member(cur):
+        if isinstance(cur, (B.TpuFilterExec, B.TpuProjectExec)):
+            real_members += 1
+            has_project = has_project or isinstance(cur, B.TpuProjectExec)
+        n_ops += 1
+        cur = cur.children[0]
+    if real_members == 0:
+        return 0
+    if has_project and any(
+            op in ("min", "max") and e.data_type is DataType.STRING
+            for op, e, _ in node._update_ops()):
+        # the update kernel's string min/max needs plain-column inputs for
+        # its static length bound; a project in the chain may substitute a
+        # computed expression there and the runtime collapse would bail —
+        # don't claim a stage the kernel may not fuse
+        return 0
+    return n_ops
+
+
+def _scan_stage_len(node: PhysicalExec, max_ops: int) -> int:
+    """Chain length of a scan-form stage rooted at `node` (0 = no stage)."""
+    from spark_rapids_tpu.exec.expand import TpuExpandExec
+
+    if not _scan_member(node):
+        return 0
+    n_ops = 0
+    n_expand = n_limit = 0
+    cur = node
+    while n_ops < max_ops and _scan_member(cur):
+        if isinstance(cur, TpuExpandExec):
+            if n_expand:
+                break
+            n_expand += 1
+        if isinstance(cur, B.TpuLocalLimitExec):
+            if n_limit:
+                break
+            n_limit += 1
+        n_ops += 1
+        cur = cur.children[0]
+    return n_ops if n_ops >= 2 else 0
+
+
+def _rebuild_chain(top: PhysicalExec, n_ops: int,
+                   new_input: PhysicalExec) -> PhysicalExec:
+    if n_ops == 0:
+        return new_input
+    child = _rebuild_chain(top.children[0], n_ops - 1, new_input)
+    if child is top.children[0]:
+        return top
+    return top.with_children([child])
+
+
+def _chain_input(top: PhysicalExec, n_ops: int) -> PhysicalExec:
+    node = top
+    for _ in range(n_ops):
+        node = node.children[0]
+    return node
+
+
+def fuse_stages(plan: PhysicalExec, conf: C.TpuConf) -> PhysicalExec:
+    if not conf.get(C.FUSION_ENABLED):
+        return plan
+    max_ops = conf.get(C.FUSION_MAX_OPS)
+    counter = itertools.count(1)
+
+    def walk(node: PhysicalExec) -> PhysicalExec:
+        n_ops = agg_stage_len(node, max_ops) or \
+            _scan_stage_len(node, max_ops)
+        if n_ops:
+            below = _chain_input(node, n_ops)
+            new_top = _rebuild_chain(node, n_ops, walk(below))
+            return TpuFusedStageExec(next(counter), new_top, n_ops)
+        new_children = [walk(c) for c in node.children]
+        if new_children and any(
+                a is not b for a, b in zip(new_children, node.children)):
+            node = node.with_children(new_children)
+        return node
+
+    return walk(plan)
+
+
+def count_fused_stages(plan: PhysicalExec) -> int:
+    return len(plan.collect_nodes(
+        lambda n: isinstance(n, TpuFusedStageExec)))

@@ -196,6 +196,33 @@ class ExecMeta(BaseMeta):
         return "\n".join(lines)
 
 
+def explain_string(plan: PhysicalExec, indent: int = 0) -> str:
+    """Render a FINAL physical plan with Spark-style whole-stage markers:
+    every operator belonging to fused stage N prints as `*(N) Op` under its
+    `TpuFusedStage(N)` node (reference: WholeStageCodegen's `*(N)` EXPLAIN
+    prefix). Non-member nodes print bare."""
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+
+    lines: List[str] = []
+
+    def walk(node: PhysicalExec, depth: int, stage: Optional[int],
+             remaining: int) -> None:
+        if isinstance(node, TpuFusedStageExec):
+            lines.append("  " * depth + node.node_name())
+            walk(node.children[0], depth + 1, node.stage_id, node.n_ops)
+            return
+        marker = f"*({stage}) " if stage is not None and remaining > 0 \
+            else ""
+        lines.append("  " * depth + marker + node.node_name())
+        in_stage = stage is not None and remaining > 1
+        for c in node.children:
+            walk(c, depth + 1, stage if in_stage else None,
+                 remaining - 1 if in_stage else 0)
+
+    walk(plan, indent, None, 0)
+    return "\n".join(lines)
+
+
 # wiring set by overrides.py at import time (mutual recursion breaker)
 _WRAP_PLAN: Optional[Callable] = None
 _WRAP_EXPR: Optional[Callable] = None

@@ -51,14 +51,15 @@ def _refs(exprs: Sequence[Expression]) -> Set[int]:
     return out
 
 
+def _attr_cost(a: AttributeReference) -> int:
+    dt = a.data_type
+    return 64 if dt.is_string else dt.itemsize
+
+
 def _narrowest(attrs: List[AttributeReference]) -> AttributeReference:
     """Row-count carrier when nothing is referenced: cheapest column wins
     (strings cost offsets + bytes, so any fixed-width beats them)."""
-    def cost(a: AttributeReference) -> int:
-        dt = a.data_type
-        return 64 if dt.is_string else dt.itemsize
-
-    return min(attrs, key=cost)
+    return min(attrs, key=_attr_cost)
 
 
 def _keep(attrs: List[AttributeReference],
@@ -160,8 +161,15 @@ def _project(plan: L.Project, req):
 
 @_rule(L.Filter)
 def _filter(plan: L.Filter, req):
-    child_req = None if req is None else req | _refs([plan.condition])
-    return L.Filter(plan.condition, _prune(plan.children[0], child_req))
+    cond_refs = _refs([plan.condition])
+    child_req = None if req is None else req | cond_refs
+    pruned = L.Filter(plan.condition, _prune(plan.children[0], child_req))
+    if req is not None and cond_refs - req:
+        # condition-only columns the parent never asked for would otherwise
+        # flow through every exchange/join between this Filter and the next
+        # Project; Catalyst inserts the pruning Project in this position
+        return _wrap_project(pruned, req)
+    return pruned
 
 
 @_rule(L.Limit)
@@ -225,7 +233,11 @@ def _expand(plan: L.Expand, req):
         keep_pos = [i for i, a in enumerate(plan.output_attrs)
                     if a.expr_id in req]
         if not keep_pos:
-            keep_pos = [0]
+            # row-count carrier: same cost function as every other rule
+            # (position 0 can be a string column — offsets + bytes through
+            # every downstream exchange just to preserve cardinality)
+            keep_pos = [min(range(len(plan.output_attrs)),
+                            key=lambda i: _attr_cost(plan.output_attrs[i]))]
     projections = [[p[i] for i in keep_pos] for p in plan.projections]
     attrs = [plan.output_attrs[i] for i in keep_pos]
     child_req = _refs([e for p in projections for e in p])

@@ -65,6 +65,9 @@ class TpuSession:
     def __init__(self, settings: Optional[Dict[str, Any]] = None):
         self.conf = C.TpuConf(settings)
         self.plan_capture = PlanCapture()
+        # fusion accounting of the most recent execute_batches (fusedStages,
+        # deviceDispatches) — read by bench.py and the fusion tests
+        self.last_query_metrics: Dict[str, int] = {}
         # multi-host bring-up FIRST — the coordination service must join
         # before any backend touch (reference: driver ships conf and
         # executors announce themselves before GPU init, Plugin.scala:
@@ -140,23 +143,30 @@ class TpuSession:
         return optimize(plan, self.conf)
 
     def _physical_plan(self, plan: L.LogicalPlan) -> PhysicalExec:
+        from spark_rapids_tpu.plan.fusion import fuse_stages
+
         cpu_plan = plan_physical(self._optimized(plan), self.conf)
         tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
+        final = fuse_stages(final, self.conf)
         self.plan_capture.record(final)
         return final
 
     def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
+        from spark_rapids_tpu.plan.fusion import fuse_stages
+        from spark_rapids_tpu.plan.meta import explain_string
+
         cpu_plan = plan_physical(self._optimized(plan), self.conf)
         explain_out: List[str] = []
         tpu_plan = TpuOverrides.apply(
             cpu_plan, self.conf.clone_with({"rapids.tpu.sql.explain": "NONE"}),
             explain_out=explain_out)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
+        final = fuse_stages(final, self.conf)
         parts = []
         if explain_out:
             parts.append("== TPU tagging ==\n" + explain_out[0])
-        parts.append("== Final plan ==\n" + final.tree_string())
+        parts.append("== Final plan ==\n" + explain_string(final))
         return "\n".join(parts)
 
     def _exec_context(self) -> ExecContext:
@@ -164,15 +174,26 @@ class TpuSession:
 
     # -- actions --------------------------------------------------------------
     def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
+        from spark_rapids_tpu.plan.fusion import count_fused_stages
+        from spark_rapids_tpu.utils import metrics as M
+
         # the executing session's conf drives the process-wide narrowing
         # flag (conf.sync_int64_narrowing: covers clone_with copies and
         # interleaved sessions)
         self.conf.sync_int64_narrowing()
         physical = self._physical_plan(plan)
         ctx = self._exec_context()
+        dispatches_before = M.dispatch_count()
         pb = physical.execute(ctx)
         results = self.scheduler.run_job(
             pb.num_partitions, lambda p: list(pb.iterator(p)))
+        # per-query fusion accounting (process-wide dispatch counter: tasks
+        # share one worker pool; interleaved sessions would blur the delta,
+        # same caveat as jit_cache stats)
+        self.last_query_metrics = {
+            M.FUSED_STAGES: count_fused_stages(physical),
+            M.DEVICE_DISPATCHES: M.dispatch_count() - dispatches_before,
+        }
         return [b for part in results for b in part]
 
     def execute_collect(self, plan: L.LogicalPlan) -> List[tuple]:

@@ -28,6 +28,11 @@ NUM_INPUT_ROWS = "numInputRows"
 NUM_INPUT_BATCHES = "numInputBatches"
 TOTAL_TIME = "totalTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
+# whole-stage fusion metrics (plan/fusion.py; Spark's WholeStageCodegen has
+# no dispatch analog — on an accelerator every program launch is one host
+# round trip, so the dispatch count IS the fusion win's unit)
+FUSED_STAGES = "fusedStages"
+DEVICE_DISPATCHES = "deviceDispatches"
 
 
 class Metric:
@@ -69,6 +74,27 @@ class MetricsMap:
 
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self._metrics.items()}
+
+
+# ---------------------------------------------------------------------------
+# Device-dispatch accounting
+# ---------------------------------------------------------------------------
+# Process-wide: partition tasks run on a shared worker pool, so per-exec
+# counters would need threading context; queries snapshot before/after
+# instead (session.execute_batches -> session.last_query_metrics).
+_DISPATCHES = Metric(DEVICE_DISPATCHES)
+
+
+def record_dispatch(n: int = 1) -> None:
+    """Count a device program launch (jitted kernel invocation). Called at
+    the engine's kernel entry points — projector/filter/fused-stage/agg
+    kernels and the batch gather/compact helpers — NOT per XLA executable
+    internals; the unit is 'host->device dispatches the engine issued'."""
+    _DISPATCHES.add(n)
+
+
+def dispatch_count() -> int:
+    return _DISPATCHES.value
 
 
 @contextlib.contextmanager

@@ -1,0 +1,223 @@
+"""Whole-stage fusion: fused-vs-unfused equivalence + plan/metric shape.
+
+The fusion pass (plan/fusion.py) compiles Filter/Project/Expand/LocalLimit
+chains — and the update side of partial hash aggregates — into one XLA
+program per stage (exec/fused.py). Every test here runs the SAME plan with
+fusion on and off on the device engine plus the CPU oracle and asserts
+identical rows; the flagship shape additionally asserts a strictly lower
+device-dispatch count when fused. The fusion-off runs double as the
+tier-1 smoke coverage of the per-operator fallback path.
+
+Kept deliberately lean: a handful of query shapes, each covering several
+checklist dimensions at once (nulls + strings + chained filters in one
+plan, empty partitions + all-rows-filtered in another) — jit compiles of
+three engine paths per shape dominate this module's wall clock.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    assert_rows_equal,
+    run_on_cpu,
+    run_on_tpu,
+)
+
+FUSION_KEY = "rapids.tpu.sql.fusion.enabled"
+
+
+@pytest.fixture()
+def session():
+    s = srt.new_session()
+    s.conf.set("rapids.tpu.sql.variableFloatAgg.enabled", True)
+    yield s
+    s.stop()
+
+
+def _base_df(s, n=300, parts=3):
+    rng = np.random.default_rng(7)
+    return s.createDataFrame(
+        {"k": rng.integers(0, 12, n).astype(np.int64),
+         "a": rng.integers(-1000, 1000, n).astype(np.int64),
+         "b": rng.random(n).astype(np.float32),
+         "t": np.array([f"v{i % 9}" if i % 5 else None for i in range(n)],
+                       dtype=object)},
+        [("k", "long"), ("a", "long"), ("b", "float"), ("t", "string")],
+        num_partitions=parts)
+
+
+def assert_fused_unfused_equal(session, df_fn, ignore_order=True,
+                               expect_stages=True):
+    """Run the plan on the TPU engine with fusion on and off, and on the
+    CPU oracle; assert three-way equal rows, that fusion on/off actually
+    toggles TpuFusedStageExec presence, and that fusing never dispatches
+    MORE device programs than the per-operator path."""
+    cpu = run_on_cpu(session, df_fn)
+    fused = run_on_tpu(session, df_fn, extra_conf={FUSION_KEY: True})
+    m_fused = dict(session.last_query_metrics)
+    unfused = run_on_tpu(session, df_fn, extra_conf={FUSION_KEY: False})
+    m_unfused = dict(session.last_query_metrics)
+    assert_rows_equal(cpu, fused, ignore_order=ignore_order)
+    assert_rows_equal(cpu, unfused, ignore_order=ignore_order)
+    if expect_stages:
+        assert m_fused["fusedStages"] >= 1, m_fused
+        assert m_fused["deviceDispatches"] <= m_unfused["deviceDispatches"],\
+            (m_fused, m_unfused)
+    assert m_unfused["fusedStages"] == 0, m_unfused
+    return m_fused, m_unfused
+
+
+# ---------------------------------------------------------------------------
+# the flagship shape: Filter -> Project -> partial HashAggregate
+# ---------------------------------------------------------------------------
+def _flagship(s):
+    return (_base_df(s)
+            .filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+            .withColumn("c", F.col("a") * 2 + 1)
+            .groupBy("k")
+            .agg(F.sum("c").alias("s"), F.count("*").alias("n"),
+                 F.max("a").alias("m")))
+
+
+def test_filter_project_partial_agg_fuses(session):
+    m_fused, m_unfused = assert_fused_unfused_equal(session, _flagship)
+    # the tentpole claim: the fused stage strictly beats per-operator
+    # dispatch on the hottest path in the repo
+    assert m_fused["deviceDispatches"] < m_unfused["deviceDispatches"], \
+        (m_fused, m_unfused)
+
+
+def test_agg_stage_in_plan_and_explain(session):
+    q = _flagship(session)  # same shape as above -> kernels stay cached
+    session.plan_capture.start()
+    try:
+        q.collect()
+    finally:
+        (plan,) = session.plan_capture.stop()
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+
+    stages = plan.collect_nodes(
+        lambda n: isinstance(n, TpuFusedStageExec))
+    assert stages, plan.tree_string()
+    agg_stages = [st for st in stages if st.agg_form]
+    assert agg_stages and agg_stages[0].n_ops >= 3, \
+        [st.node_name() for st in stages]
+    text = session.explain_plan(q._plan)
+    sid = agg_stages[0].stage_id
+    assert f"TpuFusedStage({sid})" in text, text
+    assert f"*({sid}) TpuHashAggregateExec(partial)" in text, text
+    assert f"*({sid}) TpuFilterExec" in text, text
+
+
+# ---------------------------------------------------------------------------
+# scan-form stages
+# ---------------------------------------------------------------------------
+def test_strings_nulls_and_chained_filters(session):
+    # one shape covering: null-bearing string column through a fused
+    # projection, two filters in one stage, fixed-width + string outputs
+    def q(s):
+        return (_base_df(s)
+                .filter(F.col("t").isNotNull() & (F.col("a") != 0))
+                .select(F.concat(F.col("t"), F.lit("_x")).alias("u"),
+                        F.length(F.col("t")).alias("l"), "a")
+                .filter(F.col("l") >= 2))
+
+    assert_fused_unfused_equal(session, q)
+
+
+def test_limit_inside_stage(session):
+    def q(s):
+        return (_base_df(s, parts=2)
+                .filter(F.col("a") % 2 == 0)
+                .limit(23)
+                .select((F.col("a") + 1).alias("a1"), "t"))
+
+    # CPU and TPU engines share the partitioning, so per-partition limit
+    # prefixes — and therefore the rows — match exactly
+    m_fused, _ = assert_fused_unfused_equal(session, q)
+    assert m_fused["fusedStages"] >= 1
+
+
+def test_expand_chain(session):
+    def q(s):
+        return (_base_df(s)
+                .filter(F.col("a") != 0)
+                .rollup("k")
+                .agg(F.count("*").alias("n"), F.sum("a").alias("sa")))
+
+    assert_fused_unfused_equal(session, q)
+
+
+def test_empty_batches_and_all_filtered(session):
+    def q(s):
+        # 3 rows over 4 partitions => an empty partition feeds the stage;
+        # the second branch drops EVERY row before the union
+        df = _base_df(s, n=3, parts=4)
+        kept = (df.filter(F.col("a") > -10_000)
+                .withColumn("c", F.col("a") + 1).select("c", "k"))
+        none = (df.filter(F.col("a") > 10_000)
+                .withColumn("c", F.col("a") + 1).select("c", "k"))
+        return kept.union(none)
+
+    assert_fused_unfused_equal(session, q)
+
+
+# ---------------------------------------------------------------------------
+# fusion guards
+# ---------------------------------------------------------------------------
+def test_nondeterministic_exprs_not_fused(session):
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+
+    df = _base_df(session)
+    # monotonically_increasing_id consumes row positions: fusing it behind
+    # a filter's deferred mask would renumber rows
+    q = (df.filter(F.col("a") > 0)
+         .withColumn("id", F.monotonically_increasing_id())
+         .select("id", "a"))
+    session.plan_capture.start()
+    try:
+        rows = run_on_tpu(session, lambda s: q,
+                          extra_conf={FUSION_KEY: True})
+    finally:
+        (plan,) = session.plan_capture.stop()
+    stages = plan.collect_nodes(lambda n: isinstance(n, TpuFusedStageExec))
+    assert not stages, plan.tree_string()
+    cpu = run_on_cpu(session, lambda s: q)
+    assert_rows_equal(cpu, rows, ignore_order=True)
+
+
+def test_fusion_disabled_smoke(session):
+    """Fallback-path smoke: the flagship shape executed per-operator
+    (fusion.enabled=false) must keep matching the oracle — the tier-1 line
+    stays covered when the flag is off."""
+    session.conf.set(FUSION_KEY, False)
+    cpu = run_on_cpu(session, _flagship)
+    tpu = run_on_tpu(session, _flagship, extra_conf={FUSION_KEY: False})
+    assert_rows_equal(cpu, tpu, ignore_order=True)
+    assert session.last_query_metrics["fusedStages"] == 0
+
+
+def test_max_ops_splits_stage(session):
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+
+    def q(s):
+        df = _base_df(s).filter(F.col("a") != 0)
+        for i in range(3):
+            df = df.withColumn(f"c{i}", F.col("a") + i)
+        return df.select("a", "c0", "c2")
+
+    session.plan_capture.start()
+    try:
+        rows = run_on_tpu(session, q,
+                          extra_conf={FUSION_KEY: True,
+                                      "rapids.tpu.sql.fusion.maxOps": 2})
+    finally:
+        (plan,) = session.plan_capture.stop()
+    stages = plan.collect_nodes(lambda n: isinstance(n, TpuFusedStageExec))
+    assert all(st.n_ops <= 2 for st in stages), \
+        [(st.stage_id, st.n_ops) for st in stages]
+    cpu = run_on_cpu(session, q)
+    assert_rows_equal(cpu, rows, ignore_order=True)

@@ -167,7 +167,14 @@ def _artifact_quality(rec) -> int:
     if isinstance(q, dict):
         return len(q)
     s = rec.get("stages")
-    return len(s) if isinstance(s, list) else 1
+    if isinstance(s, list):
+        return len(s)
+    # kernel-microbench progress lines now carry a count instead of the
+    # cumulative stage list (tools/tpu_kernel_micro2.py)
+    try:
+        return int(rec.get("stages_done", 1) or 1)
+    except (TypeError, ValueError):
+        return 1
 
 
 def run_captures() -> int:

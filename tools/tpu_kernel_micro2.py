@@ -35,6 +35,11 @@ if N % (1 << 15):
                      "kernels chunk at 2^15 rows with no tail handling)")
 S = 8  # q1 group count bucket
 
+# resolved ONCE at startup: if the device wedge that just errored a stage
+# also breaks jax.devices(), evaluating it inside the progress print would
+# raise and lose the very partial record the print exists to preserve
+PLATFORM = jax.devices()[0].platform
+
 
 def fence(x):
     return np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0][:1]))
@@ -61,10 +66,12 @@ def timeit(name, fn, *args, iters=3, nbytes=None):
     except Exception as e:  # noqa: BLE001 — record and continue
         out = {"stage": name, "error": f"{type(e).__name__}: {e}"[:200]}
     RESULTS.append(out)
-    # every line carries platform + the stage prefix so a wedge-killed run
-    # still leaves the capture daemon a platform-labelled partial
-    print(json.dumps({"platform": jax.devices()[0].platform,
-                      "stages": RESULTS, **out}), flush=True)
+    # every line carries platform + the latest stage + a running count so a
+    # wedge-killed run still leaves the capture daemon a platform-labelled
+    # partial; only the FINAL summary line embeds the full stage list (a
+    # per-line cumulative dump grew the log O(n^2) in stage count)
+    print(json.dumps({"platform": PLATFORM, "stages_done": len(RESULTS),
+                      **out}), flush=True)
 
 
 rng = np.random.default_rng(0)
@@ -80,8 +87,7 @@ payload = jnp.arange(N, dtype=jnp.int32)
 gid_small = jnp.asarray(rng.integers(0, 6, N).astype(np.int32))
 order = jnp.asarray(rng.permutation(N).astype(np.int32))
 
-dev = jax.devices()[0]
-print(json.dumps({"platform": dev.platform, "n": N}), flush=True)
+print(json.dumps({"platform": PLATFORM, "n": N}), flush=True)
 
 # --- the q1 group-sort shape: 6-operand variadic stable sort ---------------
 timeit("sort6_u64x2", jax.jit(
@@ -242,5 +248,5 @@ timeit("cumsum_i64_2lane", cumsum_i64_2lane, i64v, nbytes=N * 8)
 
 checks = {"segsum_int8_mxu_exact": _check_segsum(),
           "cumsum_i64_2lane_exact": _check_2lane()}
-print(json.dumps({"platform": dev.platform, "checks": checks,
+print(json.dumps({"platform": PLATFORM, "checks": checks,
                   "stages": RESULTS}), flush=True)

@@ -40,6 +40,30 @@ TPU_BUDGET_S = int(os.environ.get("SRT_BENCH_TPU_BUDGET_S", "780"))
 CPU_BUDGET_S = int(os.environ.get("SRT_BENCH_CPU_BUDGET_S", "240"))
 QUERY_CAP_DEFAULT_S = 300  # per-query skip cap (suite workers)
 
+# Incremental summary file: the supervisor persists a valid (partial)
+# summary after every completed phase, so a driver-budget timeout that
+# kills this process mid-run still leaves a parseable BENCH artifact —
+# the stdout JSON line alone would be lost with the process.
+BENCH_OUT_PATH = os.environ.get("SRT_BENCH_OUT") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.json")
+
+
+def _write_summary(obj: dict) -> None:
+    try:
+        tmp = BENCH_OUT_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(obj, fh)
+            fh.write("\n")
+        os.replace(tmp, BENCH_OUT_PATH)
+    except OSError as e:
+        print(f"[bench] summary write failed: {e}", file=sys.stderr)
+
+
+def _emit(obj: dict) -> None:
+    """Final supervisor result: persist AND print the stdout JSON line."""
+    _write_summary(obj)
+    print(json.dumps(obj))
+
 
 def _suite_query_count(suite: str) -> int:
     """Number of queries in a suite, WITHOUT importing the module (the
@@ -410,11 +434,15 @@ def main_shuffle() -> None:
         dev = _run_phase("shuffle-dev", _scrubbed_cpu_env(), CPU_BUDGET_S)
         platform = "cpu-fallback" if dev else None
     if dev is None:
-        print(json.dumps({"metric": "shuffle_exchange_gbps", "value": 0.0,
-                          "unit": "GB/s", "vs_baseline": 0.0,
-                          "error": "shuffle bench failed",
-                          "diag": _DIAG[-4:]}))
+        _emit({"metric": "shuffle_exchange_gbps", "value": 0.0,
+               "unit": "GB/s", "vs_baseline": 0.0,
+               "error": "shuffle bench failed",
+               "diag": _DIAG[-4:]})
         return
+    _write_summary({"metric": "shuffle_exchange_gbps",
+                    "value": round(dev["gbps"], 4), "unit": "GB/s",
+                    "vs_baseline": 0.0, "platform": platform,
+                    "partial": "device tier done; ser/ici tiers pending"})
     # serialized fallback tier on the SAME backend = the vs_baseline (the
     # reference compares its device-resident shuffle against the JVM
     # serialized tier the same way)
@@ -439,7 +467,7 @@ def main_shuffle() -> None:
     if ici:
         out["ici_vdev8_gbps"] = round(ici["gbps"], 4)
         out["ici_vdev8_rows_per_s"] = ici["rows_per_s"]
-    print(json.dumps(out))
+    _emit(out)
 
 
 def _worker_i64(mode: str) -> None:
@@ -500,15 +528,20 @@ def _worker_i64(mode: str) -> None:
 def main_i64() -> None:
     """`python bench.py --i64`: int64-emulation cost microbench."""
     w64, _p = _run_accel_phase("i64-i64", TPU_BUDGET_S // 2)
+    if w64 is not None:
+        _write_summary({"metric": "int64_emulation_ratio", "value": 0.0,
+                        "unit": "x", "vs_baseline": 0.0,
+                        "partial": "i64 phase done; i32 phase pending",
+                        "i64_gbps": round(w64["gbps"], 3)})
     w32, _p = ((None, 0) if w64 is None else
                _run_accel_phase("i64-i32", TPU_BUDGET_S // 2))
     if w64 is None or w32 is None:
-        print(json.dumps({"metric": "int64_emulation_ratio", "value": 0.0,
-                          "unit": "x", "vs_baseline": 0.0,
-                          "error": "i64 bench failed", "diag": _DIAG[-4:]}))
+        _emit({"metric": "int64_emulation_ratio", "value": 0.0,
+               "unit": "x", "vs_baseline": 0.0,
+               "error": "i64 bench failed", "diag": _DIAG[-4:]})
         return
     ratio = round(w64["best_s"] / w32["best_s"], 3)
-    print(json.dumps({
+    _emit({
         "metric": "int64_emulation_ratio",
         "value": ratio,
         "unit": "x (int64 time / int32 time, same element count)",
@@ -516,29 +549,35 @@ def main_i64() -> None:
         "platform": w64["platform"],
         "i64_gbps": round(w64["gbps"], 3),
         "i32_gbps": round(w32["gbps"], 3),
-    }))
+    })
 
 
 def main_decode() -> None:
     """`python bench.py --decode`: device-decode vs host-decode scan."""
     host, _p = _run_accel_phase("decode-host", TPU_BUDGET_S)
+    if host is not None:
+        _write_summary({"metric": "parquet_device_decode_gbps",
+                        "value": 0.0, "unit": "GB/s/chip",
+                        "vs_baseline": 0.0,
+                        "partial": "host phase done; device phase pending",
+                        "host_gbps": round(host["gbps"], 4)})
     # probe verdict carries over: if the host phase never came up there is
     # no point re-probing for the device phase
     dev, _p = (_run_accel_phase("decode-dev", TPU_BUDGET_S)
                if host is not None else (None, 0))
     if dev is None or host is None:
-        print(json.dumps({"metric": "parquet_device_decode_gbps",
-                          "value": 0.0, "unit": "GB/s/chip",
-                          "vs_baseline": 0.0, "error": "decode bench failed"}))
+        _emit({"metric": "parquet_device_decode_gbps",
+               "value": 0.0, "unit": "GB/s/chip",
+               "vs_baseline": 0.0, "error": "decode bench failed"})
         return
-    print(json.dumps({
+    _emit({
         "metric": "parquet_device_decode_gbps",
         "value": round(dev["gbps"], 4),
         "unit": "GB/s/chip",
         "vs_baseline": round(host["best_s"] / dev["best_s"], 3),
         "platform": dev["platform"],
         "host_gbps": round(host["gbps"], 4),
-    }))
+    })
 
 
 def _worker_suite(suite: str, mode: str, sf: float) -> None:
@@ -1004,6 +1043,10 @@ def main() -> None:
     warm = _WarmAccelSupervisor("tpu", dict(os.environ),
                                 CPU_BUDGET_S + TPU_BUDGET_S)
     cpu = _run_phase("cpu", _scrubbed_cpu_env(), CPU_BUDGET_S)
+    _write_summary({"metric": "filter_project_groupby_gbps", "value": 0.0,
+                    "unit": "GB/s/chip", "vs_baseline": 0.0,
+                    "partial": "cpu-oracle done; accel phase pending",
+                    "cpu_best_s": cpu["best_s"] if cpu else None})
     acc, _platform, probes = warm.measure(TPU_BUDGET_S)
     warm.shutdown()
     platform = acc["platform"] if acc else None
@@ -1014,10 +1057,10 @@ def main() -> None:
         acc = _run_phase("tpu", _scrubbed_cpu_env(), CPU_BUDGET_S)
         platform = "cpu-fallback" if acc else None
     if acc is None:
-        print(json.dumps({"metric": "filter_project_groupby_gbps",
-                          "value": 0.0, "unit": "GB/s/chip",
-                          "vs_baseline": 0.0, "error": "bench failed",
-                          "probe_attempts": probes, "diag": _DIAG[-6:]}))
+        _emit({"metric": "filter_project_groupby_gbps",
+               "value": 0.0, "unit": "GB/s/chip",
+               "vs_baseline": 0.0, "error": "bench failed",
+               "probe_attempts": probes, "diag": _DIAG[-6:]})
         return
     # headline GB/s/chip is the sweep plateau (large inputs amortize
     # dispatch); vs_baseline stays the equal-size 1M-row oracle ratio
@@ -1039,7 +1082,7 @@ def main() -> None:
         result["diag"] = _DIAG[-6:]
     if cpu is None:
         result["error"] = "cpu oracle phase failed; vs_baseline unknown"
-    print(json.dumps(result))
+    _emit(result)
 
 
 def main_suite(suite: str, sf: float) -> None:
@@ -1072,6 +1115,12 @@ def main_suite(suite: str, sf: float) -> None:
     cpu_env = _scrubbed_cpu_env()
     cpu_env.update(env_extra)
     cpu = _run_phase(f"{suite}-cpu", cpu_env, cpu_budget)
+    _write_summary({
+        "metric": f"{suite}_like_geomean_s", "value": 0.0, "unit": "s",
+        "vs_baseline": 0.0, "sf": sf,
+        "partial": "cpu-oracle done; accel phase pending",
+        "cpu_geomean_s": round(cpu["geomean_s"], 4)
+        if cpu and cpu.get("geomean_s") else None})
     acc, _probes = _run_accel_phase(f"{suite}-tpu", tpu_budget, env_extra)
     platform = acc["platform"] if acc else None
     if acc is None and os.environ.get("SRT_BENCH_NO_FALLBACK") != "1":
@@ -1079,10 +1128,10 @@ def main_suite(suite: str, sf: float) -> None:
         acc = _run_phase(f"{suite}-tpu", cpu_env, cpu_budget * 2)
         platform = "cpu-fallback" if acc else None
     if acc is None or not acc.get("queries"):
-        print(json.dumps({"metric": f"{suite}_like_geomean_s", "value": 0.0,
-                          "unit": "s", "vs_baseline": 0.0,
-                          "error": f"{suite} bench failed", "sf": sf,
-                          "skipped": (acc or {}).get("skipped", [])}))
+        _emit({"metric": f"{suite}_like_geomean_s", "value": 0.0,
+               "unit": "s", "vs_baseline": 0.0,
+               "error": f"{suite} bench failed", "sf": sf,
+               "skipped": (acc or {}).get("skipped", [])})
         return
     # vs_baseline over the COMMON query set only — per-query caps can skip
     # different queries on each side, and a mismatched geomean ratio would
@@ -1111,7 +1160,7 @@ def main_suite(suite: str, sf: float) -> None:
                          + ((cpu or {}).get("skipped") or [])))
     if skipped:
         out["skipped"] = skipped
-    print(json.dumps(out))
+    _emit(out)
 
 
 if __name__ == "__main__":

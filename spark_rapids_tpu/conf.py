@@ -521,6 +521,27 @@ RANGE_SAMPLE_SIZE = _conf("rapids.tpu.sql.rangePartition.sampleSizePerPartition"
     "(reference: GpuRangePartitioner.scala driver-side sampling)."
 ).integer(100)
 
+# ---------------------------------------------------------------------------
+# Static analysis (plan/verify.py, docs/static-analysis.md)
+# ---------------------------------------------------------------------------
+PLAN_VERIFY = _conf("rapids.tpu.sql.planVerify.enabled").doc(
+    "Run the static plan verifier on every FINAL physical plan before "
+    "execution: schema (name/dtype/nullability) propagates bottom-up — "
+    "including through TpuFusedStage member chains — and plans with "
+    "unresolvable column references, dtype drift, host/device edges "
+    "missing a transition node, or fused-stage accounting mismatches "
+    "are rejected before any kernel runs (the GpuOverrides static-"
+    "tagging safety net extended to the post-fusion plan). Violations "
+    "also render in EXPLAIN under '== Plan verification =='."
+).boolean(True)
+
+PLAN_VERIFY_FAIL = _conf("rapids.tpu.sql.planVerify.failOnViolation").doc(
+    "Raise PlanVerificationError when the plan verifier finds "
+    "violations (default). When false the verifier is observe-only: "
+    "violations surface in EXPLAIN output but the plan still executes "
+    "— the triage mode for a rejected production plan."
+).boolean(True)
+
 
 class TpuConf:
     """Resolved view of the settings map (reference: RapidsConf class).

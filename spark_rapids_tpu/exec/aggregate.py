@@ -391,6 +391,8 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
     # -- assembling an intermediate [keys+buffers] device batch --------------
     def _assemble(self, key_cols, buf_outs, gi, capacity,
                   key_vranges=None) -> ColumnarBatch:
+        # tpulint: host-sync -- merge-side group count at the blocking
+        # aggregate boundary; sizes the assembled intermediate batch
         n_groups = int(jax.device_get(gi.num_groups))
         key_batch = ColumnarBatch(
             [ColumnVector(
@@ -641,6 +643,7 @@ def _synth_col(batch: ColumnarBatch):
     from spark_rapids_tpu.ops.values import ColV
 
     cap = bucket_capacity(max(batch.num_rows, 1))
+    # tpulint: eager-jnp -- zero-column COUNT(*) placeholder col
     return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
                 jnp.arange(cap) < batch.num_rows)
 

@@ -236,6 +236,8 @@ class TpuGenerateExec(_GenerateBase, TpuExec):
                         jnp.int32(out_rows))
                 cols = list(child_out.columns)
                 if self.include_pos:
+                    # tpulint: eager-jnp -- posexplode validity mask; one
+                    # iota per batch beside the jitted interleave kernel
                     cols.append(ColumnVector(
                         DataType.INT32, pos,
                         jnp.arange(out_cap) < out_rows))

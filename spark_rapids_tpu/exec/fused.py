@@ -288,6 +288,7 @@ class TpuFusedStageExec(TpuExec):
                 cols = [_col_to_colv(c) for c in batch.columns]
                 if not cols:
                     cap = bucket_capacity(max(batch.host_rows(), 1))
+                    # tpulint: eager-jnp -- zero-column COUNT(*) placeholder
                     cols = [ColV(DataType.BOOL,
                                  jnp.zeros((cap,), dtype=bool),
                                  jnp.arange(cap) < batch.num_rows)]
@@ -309,15 +310,18 @@ class TpuFusedStageExec(TpuExec):
                         if order is None or not self._live_shared:
                             M.record_dispatch()
                             order, nk = _compact_plan(live, n)
+                            # tpulint: host-sync -- policy-gated stage-exit
                             n_keep = nk if lazy else \
                                 int(jax.device_get(nk))
                         out = _gather_batch_traced(out, order, n_keep) \
                             if lazy else gather_batch(out, order, n_keep)
                     if remaining is not None and \
                             not self._limit_below_expand:
+                        # tpulint: host-sync -- cross-batch LIMIT budget
                         remaining -= int(jax.device_get(limit_passed))
                     yield out
                 if remaining is not None and self._limit_below_expand:
+                    # tpulint: host-sync -- cross-batch LIMIT budget
                     remaining -= int(jax.device_get(limit_passed))
                 row_start += batch.num_rows
 

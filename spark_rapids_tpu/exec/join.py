@@ -265,6 +265,7 @@ def _synth(batch: ColumnarBatch):
     from spark_rapids_tpu.ops.values import ColV
 
     cap = bucket_capacity(max(batch.num_rows, 1))
+    # tpulint: eager-jnp -- zero-column COUNT(*) placeholder col
     return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
                 jnp.arange(cap) < batch.num_rows)
 
@@ -298,6 +299,8 @@ class _TpuJoinMixin:
             nonlocal b_matched_acc
             (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
              _b_matched) = plan_out
+            # tpulint: host-sync -- join output size determines the gather
+            # bucket; one count sync per (stream batch, build) pair
             n_out = int(jax.device_get(total))
             if n_out == 0:
                 return None
@@ -349,7 +352,10 @@ class _TpuJoinMixin:
         if emit_build_tail and build.num_rows > 0:
             # full outer: unmatched build rows with null stream columns
             if b_matched_acc is None:
+                # tpulint: eager-jnp -- empty-stream full outer: no match
                 b_matched_acc = jnp.zeros((build.capacity,), bool)
+            # tpulint: host-sync -- once per partition at stream end: the
+            # unmatched-build tail of a full outer join needs host rows
             unmatched = (~np.asarray(jax.device_get(b_matched_acc))) & \
                 (np.arange(build.capacity) < build.num_rows)
             rows = np.nonzero(unmatched)[0]
@@ -396,13 +402,16 @@ def _null_batch(attrs: List[AttributeReference], n_rows: int) -> ColumnarBatch:
     cap = bucket_capacity(max(n_rows, 1))
     cols = []
     for a in attrs:
+        # tpulint: eager-jnp -- all-null column build, outer-join tail only
         validity = jnp.zeros((cap,), bool)
         if a.data_type is DataType.STRING:
+            # tpulint: eager-jnp -- all-null string column, same tail
             cols.append(ColumnVector(
                 a.data_type, jnp.zeros((8,), jnp.uint8), validity,
                 jnp.zeros((cap + 1,), jnp.int32)))
         else:
             npdt = physical_np_dtype(a.data_type)
+            # tpulint: eager-jnp -- all-null column build, same tail
             cols.append(ColumnVector(a.data_type, jnp.zeros((cap,), npdt),
                                      validity))
     return ColumnarBatch(cols, n_rows)
@@ -655,6 +664,8 @@ class TpuNestedLoopJoinExec(_JoinBase, TpuExec):
                         continue
                     n_out = sb.num_rows * build.num_rows
                     cap = bucket_capacity(n_out)
+                    # tpulint: eager-jnp -- cross-product index build; the
+                    # two fused gathers below dominate this tiny iota
                     pos = jnp.arange(cap, dtype=jnp.int32)
                     s_idx = pos // build.num_rows
                     b_idx = pos % build.num_rows

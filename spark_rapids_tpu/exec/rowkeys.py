@@ -27,6 +27,10 @@ which equality (and, for orderable types, order) agrees with SQL semantics:
   covers the batch's longest string (callers size it via
   `string_chunks_needed`).
 
+Every function here is a kernel HELPER invoked inside jit traces built by
+the exec drivers (aggregate/sort/join/mesh kernels):
+# tpulint: traced-helpers
+
 All functions here take padded device arrays + a traced `num_rows` and are
 jit-safe. Padded rows always sort to the end and get group id = capacity
 (dropped by segment reductions with num_segments=capacity).
@@ -163,6 +167,8 @@ def string_chunks_needed(col_or_lens) -> int:
         lens = col_or_lens.offsets[1:] - col_or_lens.offsets[:-1]
     else:
         lens = col_or_lens
+    # tpulint: host-sync -- one max-length probe per string sort column;
+    # the pow2 bucket below bounds how often the answer can change
     max_len = int(jax.device_get(jnp.max(jnp.maximum(lens, 0))))
     chunks = max(1, -(-max_len // 8))
     return 1 << (chunks - 1).bit_length()  # pow2 bucket bounds recompiles

@@ -649,6 +649,7 @@ class CpuWindowExec(_WindowBase, CpuExec):
 
 def _canon(v):
     if isinstance(v, np.generic):
+        # tpulint: host-sync -- np.generic -> python scalar; host value
         v = v.item()
     if isinstance(v, float):
         if v != v:
@@ -658,6 +659,7 @@ def _canon(v):
 
 
 def _as_py(v):
+    # tpulint: host-sync -- np.generic -> python scalar; host value
     return v.item() if isinstance(v, np.generic) else v
 
 

@@ -109,6 +109,7 @@ def raise_deferred_ansi(flags, msgs) -> None:
     batched host read; zero cost when no ANSI op traced)."""
     if not flags:
         return
+    # tpulint: host-sync -- one batched flag read, only when ANSI ops traced
     got = jax.device_get(flags)
     for v, m in zip(got, msgs):
         if bool(v):
@@ -166,6 +167,7 @@ class DeviceProjector:
             from spark_rapids_tpu.columnar.batch import bucket_capacity
 
             cap = bucket_capacity(max(batch.host_rows(), 1))
+            # tpulint: eager-jnp -- zero-column COUNT(*) placeholder col
             cols = [ColV(DataType.BOOL,
                          jnp.zeros((cap,), dtype=bool),
                          jnp.arange(cap) < batch.num_rows)]

@@ -72,6 +72,20 @@ def init_distributed(coordinator_address: Optional[str] = None,
             int(os.environ.get("SRT_PROCESS_ID", "-1"))
         if not coordinator_address or num_processes <= 1 or process_id < 0:
             return False
+        platforms = (getattr(jax.config, "jax_platforms", None)
+                     or os.environ.get("JAX_PLATFORMS", ""))
+        if platforms.split(",")[0].strip().lower() in ("", "cpu"):
+            # CPU-backend multi-process collectives need an explicit
+            # implementation; without it XLA raises 'Multiprocess
+            # computations aren't implemented on the CPU backend' at the
+            # first collective (the multichip dryrun contract runs 2
+            # processes x 4 virtual CPU devices through here). Keyed on
+            # the RESOLVED platform preference — the config value set by
+            # jax.config.update('jax_platforms', ...) wins over the env
+            # spelling, 'cpu,tpu' counts, and an UNSET preference may
+            # still auto-resolve to cpu, so it opts in too (the setting
+            # only affects the CPU backend; harmless on real chips).
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

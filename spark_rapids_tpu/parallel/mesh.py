@@ -145,4 +145,5 @@ def distributed_agg_step(mesh: Mesh, n_shards: int, cap: int,
         in_specs=(spec, spec, spec),
         out_specs=(spec, spec, spec, spec),
     )
+    # tpulint: jit-cache -- built once per mesh; callers hold the step fn
     return jax.jit(smapped)

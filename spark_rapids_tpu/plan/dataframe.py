@@ -352,12 +352,15 @@ class DataFrame:
     def show(self, n: int = 20) -> None:
         rows = self.limit(n).collect()
         names = self.columns
+        # tpulint: stdout-print -- show() IS the console API
         print(" | ".join(names))
         for r in rows:
+            # tpulint: stdout-print -- show() IS the console API
             print(" | ".join(str(v) for v in r))
 
     def explain(self, mode: str = "ALL") -> str:
         text = self.session.explain_plan(self._plan, mode)
+        # tpulint: stdout-print -- explain() IS the console API
         print(text)
         return text
 

@@ -607,6 +607,7 @@ class TpuOverrides:
             if explain_out is not None:
                 explain_out.append(wrapped.explain_string(all_nodes=True))
             if explain != "NONE" and text:
+                # tpulint: stdout-print -- the explain conf asks for console
                 print(text)
         return wrapped.convert_if_needed()
 

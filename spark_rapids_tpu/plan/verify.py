@@ -1,0 +1,331 @@
+"""Static physical-plan verifier: a type check over the FINAL plan.
+
+The reference plugin's core safety net is static tagging — GpuOverrides
+walks the plan and PROVES each operator can run before anything executes.
+Whole-stage fusion (PR 1) raised the cost of the hazards tagging cannot
+see: schema drift across fused stage boundaries, stale column references
+after pruning, fused-stage accounting that disagrees with the member
+chain, and host/device edges missing a transition node. This module is
+the machine check for those: schema (name, dtype, nullability) propagates
+bottom-up through the plan — INCLUDING the member chains inside
+`TpuFusedStageExec` — and any plan whose declared outputs, references, or
+stage accounting don't line up is rejected before a single kernel runs.
+
+Wired into the rewrite path (session._physical_plan) behind
+`rapids.tpu.sql.planVerify.enabled` and rendered by EXPLAIN
+(`== Plan verification ==` section). `planVerify.failOnViolation=false`
+switches to observe-only: violations surface in EXPLAIN instead of
+raising (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import PhysicalExec
+from spark_rapids_tpu.ops.base import AttributeReference, Expression
+
+
+class PlanVerificationError(ValueError):
+    """A physical plan failed static verification."""
+
+    def __init__(self, violations: List[str]):
+        self.violations = list(violations)
+        super().__init__(
+            "physical plan failed static verification:\n  - "
+            + "\n  - ".join(self.violations))
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+def _attr_map(attrs) -> Dict[int, AttributeReference]:
+    return {a.expr_id: a for a in attrs}
+
+
+def _refs(e: Expression) -> List[AttributeReference]:
+    """AttributeReference leaves of an expression tree (the columns it
+    consumes from its input)."""
+    return e.collect(lambda x: isinstance(x, AttributeReference))
+
+
+def _check_refs(node_name: str, exprs, available: Dict[int, AttributeReference],
+                out: List[str], what: str = "expression") -> None:
+    for e in exprs:
+        for ref in _refs(e):
+            have = available.get(ref.expr_id)
+            if have is None:
+                out.append(
+                    f"{node_name}: {what} references column "
+                    f"{ref.name}#{ref.expr_id} which no child produces "
+                    "(column-pruning/rewrite drift)")
+            elif have.data_type != ref.data_type:
+                out.append(
+                    f"{node_name}: {what} reads {ref.name}#{ref.expr_id} "
+                    f"as {ref.data_type} but the child produces "
+                    f"{have.data_type} (dtype drift)")
+            elif not ref.nullable and have.nullable:
+                out.append(
+                    f"{node_name}: {what} assumes {ref.name}#{ref.expr_id}"
+                    " is non-nullable but the child declares it nullable")
+
+
+def _check_identity_schema(node: PhysicalExec, out: List[str]) -> None:
+    child = node.children[0]
+    mine, theirs = node.output, child.output
+    if [a.expr_id for a in mine] != [a.expr_id for a in theirs] or \
+            [a.data_type for a in mine] != [a.data_type for a in theirs]:
+        out.append(
+            f"{node.node_name()}: row-preserving operator declares an "
+            f"output schema {_schema_str(mine)} different from its "
+            f"child's {_schema_str(theirs)}")
+
+
+def _schema_str(attrs) -> str:
+    return "[" + ", ".join(f"{a.name}:{getattr(a.data_type, 'name', a.data_type)}"
+                           for a in attrs) + "]"
+
+
+def _expr_dtype(e: Expression):
+    try:
+        return e.data_type
+    except Exception:  # noqa: BLE001 - a raising property IS the finding
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Per-node checks
+# ---------------------------------------------------------------------------
+def _check_node(node: PhysicalExec, out: List[str]) -> None:
+    from spark_rapids_tpu.exec import basic as B
+    from spark_rapids_tpu.exec.aggregate import _HashAggregateBase
+    from spark_rapids_tpu.exec.expand import _ExpandBase
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+    from spark_rapids_tpu.exec.join import _JoinBase
+    from spark_rapids_tpu.exec.sort import _SortBase
+    from spark_rapids_tpu.exec.transitions import (
+        CpuCoalesceBatchesExec,
+        DeviceToHostExec,
+        HostToDeviceExec,
+        TpuCoalesceBatchesExec,
+    )
+    from spark_rapids_tpu.shuffle.exchange import (
+        HashPartitioning,
+        RangePartitioning,
+        _ExchangeBase,
+    )
+
+    name = node.node_name()
+    # -- output well-formedness ----------------------------------------------
+    try:
+        output = node.output
+    except Exception as e:  # noqa: BLE001
+        out.append(f"{name}: output schema is not computable: {e!r}")
+        return
+    for a in output:
+        if not isinstance(a, AttributeReference):
+            out.append(f"{name}: output element {a!r} is not an "
+                       "AttributeReference")
+            return
+        if not isinstance(a.data_type, DataType) and \
+                not hasattr(a.data_type, "to_np"):
+            out.append(f"{name}: output column {a.name} has no usable "
+                       f"dtype ({a.data_type!r})")
+
+    available = _attr_map(a for c in node.children for a in c.output)
+
+    # -- per-class structure/reference checks --------------------------------
+    if isinstance(node, TpuFusedStageExec):
+        _check_fused_stage(node, out)
+    elif isinstance(node, (B.TpuProjectExec, B.CpuProjectExec)):
+        if len(output) != len(node.project_list):
+            out.append(f"{name}: declares {len(output)} output columns "
+                       f"for {len(node.project_list)} projections")
+        _check_refs(name, node.project_list, available, out, "projection")
+        for a, e in zip(output, node.project_list):
+            dt = _expr_dtype(e)
+            if dt is not None and a.data_type != dt:
+                out.append(f"{name}: output column {a.name} declares "
+                           f"{a.data_type} but its projection evaluates "
+                           f"to {dt}")
+    elif isinstance(node, (B.TpuFilterExec, B.CpuFilterExec)):
+        _check_refs(name, [node.condition], available, out, "condition")
+        dt = _expr_dtype(node.condition)
+        if dt is not None and dt is not DataType.BOOL:
+            out.append(f"{name}: filter condition evaluates to {dt}, "
+                       "not BOOL")
+        _check_identity_schema(node, out)
+    elif isinstance(node, _ExpandBase):
+        for pi, proj in enumerate(node.projections):
+            if len(proj) != len(node.output_attrs):
+                out.append(f"{name}: projection {pi} has {len(proj)} "
+                           f"expressions for {len(node.output_attrs)} "
+                           "output columns")
+                continue
+            _check_refs(name, proj, available, out, f"projection {pi}")
+            for a, e in zip(node.output_attrs, proj):
+                dt = _expr_dtype(e)
+                if dt is not None and dt is not DataType.NULL and \
+                        a.data_type != dt:
+                    out.append(f"{name}: projection {pi} column {a.name} "
+                               f"declares {a.data_type} but evaluates to "
+                               f"{dt}")
+    elif isinstance(node, _SortBase):
+        _check_refs(name, [o.child for o in node.orders], available, out,
+                    "sort key")
+        _check_identity_schema(node, out)
+    elif isinstance(node, _ExchangeBase):
+        p = node.partitioning
+        if isinstance(p, HashPartitioning):
+            _check_refs(name, p.exprs, available, out, "partition key")
+        elif isinstance(p, RangePartitioning):
+            _check_refs(name, [o.child for o in p.orders], available, out,
+                        "range key")
+        _check_identity_schema(node, out)
+    elif isinstance(node, _JoinBase):
+        left = _attr_map(node.children[0].output)
+        right = _attr_map(node.children[1].output)
+        _check_refs(name, getattr(node, "left_keys", []) or [], left, out,
+                    "left key")
+        _check_refs(name, getattr(node, "right_keys", []) or [], right,
+                    out, "right key")
+        if getattr(node, "condition", None) is not None:
+            _check_refs(name, [node.condition], available, out,
+                        "join condition")
+    elif isinstance(node, _HashAggregateBase):
+        _check_refs(name, [g for g in node.grouping
+                           if isinstance(g, AttributeReference)],
+                    available, out, "grouping key")
+    elif isinstance(node, (B.TpuLocalLimitExec, B.CpuLocalLimitExec,
+                           B._GlobalLimitBase, B.CoalescePartitionsExec,
+                           TpuCoalesceBatchesExec, CpuCoalesceBatchesExec,
+                           HostToDeviceExec, DeviceToHostExec)):
+        _check_identity_schema(node, out)
+    elif isinstance(node, B._UnionBase):
+        first = node.children[0].output
+        for ci, c in enumerate(node.children[1:], start=1):
+            if [a.data_type for a in c.output] != \
+                    [a.data_type for a in first]:
+                out.append(f"{name}: union input {ci} schema "
+                           f"{_schema_str(c.output)} does not match input "
+                           f"0 {_schema_str(first)}")
+
+    # -- placement edges (every device<->host edge needs a transition) -------
+    from spark_rapids_tpu.plan.transition_overrides import (
+        _effective_placement,
+    )
+
+    my_p = _effective_placement(node)
+    for c in node.children:
+        child_p = _effective_placement(c)
+        if my_p == "tpu" and child_p == "cpu" and \
+                not isinstance(node, HostToDeviceExec):
+            out.append(f"{name}: device operator consumes host batches "
+                       f"from {c.node_name()} without a HostToDeviceExec")
+        elif my_p == "cpu" and child_p == "tpu" and \
+                not isinstance(node, DeviceToHostExec):
+            out.append(f"{name}: host operator consumes device batches "
+                       f"from {c.node_name()} without a DeviceToHostExec")
+
+
+def _check_fused_stage(node, out: List[str]) -> None:
+    """Fused-stage accounting: the stage's claimed operator count, member
+    chain, and input node must agree, every member must be a fusable
+    kind, and the member chain's recomputed running schema must reach the
+    stage's declared output."""
+    from spark_rapids_tpu.exec import basic as B
+    from spark_rapids_tpu.exec.aggregate import (
+        COMPLETE,
+        PARTIAL,
+        TpuHashAggregateExec,
+    )
+    from spark_rapids_tpu.exec.expand import TpuExpandExec
+    from spark_rapids_tpu.exec.fused import is_fusable_scan_node
+    from spark_rapids_tpu.plan.fusion import _agg_chain_member
+
+    name = node.node_name()
+    if len(node.members) != node.n_ops:
+        out.append(f"{name}: claims {node.n_ops} fused operators but "
+                   f"walked {len(node.members)} members")
+        return
+    cur: Optional[PhysicalExec] = node.children[0]
+    for _ in range(node.n_ops):
+        cur = cur.children[0] if cur is not None and cur.children else None
+    if cur is not node.input_node:
+        out.append(f"{name}: stage input accounting is wrong — the node "
+                   f"{node.n_ops} below the top is not the recorded "
+                   "stage input")
+        return
+    if node.agg_form:
+        top = node.members[0]
+        if not isinstance(top, TpuHashAggregateExec) or \
+                top.mode not in (PARTIAL, COMPLETE):
+            out.append(f"{name}: aggregate-form stage is not headed by a "
+                       "partial/complete TpuHashAggregate")
+        for m in node.members[1:]:
+            if not _agg_chain_member(m):
+                out.append(f"{name}: aggregate-form member "
+                           f"{type(m).__name__} is not a fusable "
+                           "update-chain operator")
+        return
+    # scan form: re-derive the running schema bottom-up exactly the way
+    # execution composes the stage program (exec/fused._build_scan_ops)
+    attrs = list(node.input_node.output)
+    for m in reversed(node.members):
+        if not is_fusable_scan_node(m):
+            out.append(f"{name}: member {type(m).__name__} is not a "
+                       "fusable pipelined operator")
+            return
+        available = _attr_map(attrs)
+        mname = f"{name} member {type(m).__name__}"
+        if isinstance(m, B.TpuProjectExec):
+            _check_refs(mname, m.project_list, available, out,
+                        "projection")
+            attrs = m.output
+        elif isinstance(m, TpuExpandExec):
+            for proj in m.projections:
+                _check_refs(mname, proj, available, out, "projection")
+            attrs = list(m.output_attrs)
+        elif isinstance(m, B.TpuFilterExec):
+            _check_refs(mname, [m.condition], available, out, "condition")
+    if [a.expr_id for a in attrs] != [a.expr_id for a in node.output] or \
+            [a.data_type for a in attrs] != \
+            [a.data_type for a in node.output]:
+        out.append(f"{name}: member chain produces {_schema_str(attrs)} "
+                   f"but the stage declares {_schema_str(node.output)}")
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+def verify_plan(plan: PhysicalExec) -> List[str]:
+    """Bottom-up verification; returns violation strings (empty = OK)."""
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+
+    out: List[str] = []
+    stage_ids: Dict[int, int] = {}
+
+    def walk(node: PhysicalExec) -> None:
+        for c in node.children:
+            walk(c)
+        _check_node(node, out)
+        if isinstance(node, TpuFusedStageExec):
+            stage_ids[node.stage_id] = stage_ids.get(node.stage_id, 0) + 1
+
+    walk(plan)
+    for sid, n in sorted(stage_ids.items()):
+        if n > 1:
+            out.append(f"fused stage id {sid} appears {n} times — stage "
+                       "accounting/EXPLAIN markers would collide")
+    return out
+
+
+def check_plan(plan: PhysicalExec, conf) -> List[str]:
+    """Verify and, per conf, raise. Returns the violations either way."""
+    from spark_rapids_tpu import conf as C
+
+    violations = verify_plan(plan)
+    if violations and conf.get(C.PLAN_VERIFY_FAIL):
+        raise PlanVerificationError(violations)
+    return violations

@@ -68,6 +68,9 @@ class TpuSession:
         # fusion accounting of the most recent execute_batches (fusedStages,
         # deviceDispatches) — read by bench.py and the fusion tests
         self.last_query_metrics: Dict[str, int] = {}
+        # static plan-verifier findings of the most recent plan build
+        # (empty when clean; populated only while planVerify is enabled)
+        self.last_plan_violations: List[str] = []
         # multi-host bring-up FIRST — the coordination service must join
         # before any backend touch (reference: driver ships conf and
         # executors announce themselves before GPU init, Plugin.scala:
@@ -149,6 +152,25 @@ class TpuSession:
         tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         final = fuse_stages(final, self.conf)
+        if self.conf.get(C.PLAN_VERIFY):
+            from spark_rapids_tpu.plan.verify import (
+                PlanVerificationError,
+                check_plan,
+            )
+
+            # static plan verification (raises per failOnViolation);
+            # violations kept for EXPLAIN/test introspection — recorded
+            # even when the check raises, so a caller that catches the
+            # error still reads THIS plan's violations, not the last one's
+            try:
+                self.last_plan_violations = check_plan(final, self.conf)
+            except PlanVerificationError as e:
+                self.last_plan_violations = list(e.violations)
+                raise
+        else:
+            # verifier skipped: clear rather than carry a previous
+            # query's violations into this plan's introspection
+            self.last_plan_violations = []
         self.plan_capture.record(final)
         return final
 
@@ -167,6 +189,13 @@ class TpuSession:
         if explain_out:
             parts.append("== TPU tagging ==\n" + explain_out[0])
         parts.append("== Final plan ==\n" + explain_string(final))
+        if self.conf.get(C.PLAN_VERIFY):
+            from spark_rapids_tpu.plan.verify import verify_plan
+
+            violations = verify_plan(final)
+            parts.append("== Plan verification ==\n" + (
+                "OK" if not violations
+                else "\n".join(f"! {v}" for v in violations)))
         return "\n".join(parts)
 
     def _exec_context(self) -> ExecContext:

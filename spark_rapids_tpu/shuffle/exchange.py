@@ -370,6 +370,7 @@ def _order_key(v, o: SortOrder):
     (null_rank, nan_rank, value). Nulls rank 0 (first) or 2 (last); NaN is
     strictly greater than every number including +inf (Spark ordering)."""
     if isinstance(v, np.generic):
+        # tpulint: host-sync -- np.generic -> python scalar; host value
         v = v.item()
     if v is None:
         return (0 if o.nulls_first else 2, 0, 0)
@@ -749,19 +750,32 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             else None
 
         def mat(pidx: int):
-            out = []
+            """Materialize batches + DISPATCH the order-key kernel per
+            batch, then download the partition's fixed-width order bits in
+            ONE grouped transfer (the per-batch device_get pair this
+            replaces cost 2*n_keys fences per batch on tunneled backends;
+            grouping per PARTITION rather than per exchange keeps peak HBM
+            for key arrays bounded by one partition's batches — the device
+            refs drop as each partition completes)."""
+            staged = []
             for batch in child_pb.iterator(pidx):
                 if batch.num_rows == 0:
                     continue
                 cols = [_col_to_colv(c) for c in batch.columns]
-                fixed_keys = []
-                if kernel is not None:
-                    fixed_keys = [
-                        (np.asarray(jax.device_get(ob))[:batch.num_rows],
-                         np.asarray(jax.device_get(nf))[:batch.num_rows])
-                        for ob, nf in kernel(cols,
-                                             jnp.int32(batch.num_rows))
-                    ]
+                dev_keys = kernel(cols, jnp.int32(batch.num_rows)) \
+                    if kernel is not None else []
+                staged.append((batch, dev_keys))
+            # tpulint: host-sync -- one grouped key download per partition
+            flat = jax.device_get([arr for _, dev in staged
+                                   for ob, nf in dev for arr in (ob, nf)])
+            got = iter(flat)
+            out = []
+            for batch, dev in staged:
+                # tpulint: host-sync -- already host: grouped download above
+                fixed_keys = [
+                    (np.asarray(next(got))[:batch.num_rows],
+                     np.asarray(next(got))[:batch.num_rows])
+                    for _ in dev]
                 host_keys = []
                 fi = 0
                 for b, is_str in zip(bound, str_key):
@@ -975,6 +989,8 @@ def _device_slices(batch: ColumnarBatch, ids, n: int):
     one fused gather per non-empty target."""
     cap = batch.capacity
     order, counts_dev = _route_plan(ids[:cap], n)
+    # tpulint: host-sync -- one n-int counts sync per batch: the
+    # contiguous split's gather capacities are static shape arguments
     counts = np.asarray(jax.device_get(counts_dev))
     out = []
     offset = 0
@@ -1031,6 +1047,8 @@ def _device_slices_routed(batch: ColumnarBatch, ids, n: int):
     range views (see _RoutedSlice)."""
     cap = batch.capacity
     order, counts_dev = _route_plan(ids[:cap], n)
+    # tpulint: host-sync -- the ONE counts sync per routed batch (the
+    # design point of _RoutedSlice: no per-target kernels or syncs)
     counts = np.asarray(jax.device_get(counts_dev))
     out = []
     offset = 0
@@ -1150,6 +1168,8 @@ def _assemble_routed(slices: Sequence[_RoutedSlice]) -> ColumnarBatch:
     # byte-gather kernel each at the exact bucket
     plan_cis = [ci for ci, o in enumerate(outs) if len(o) == 4]
     if plan_cis:
+        # tpulint: host-sync -- one batched byte-totals read (cheap-fence
+        # backends only) buys exact-capacity string gathers
         totals = jax.device_get([outs[ci][1][-1] for ci in plan_cis])
         for ci, tot in zip(plan_cis, totals):
             starts, new_offsets, valid, pid = outs[ci]

@@ -10,6 +10,11 @@ a single `lax.all_to_all` moves them across the ICI links. Static bucket
 capacities are the bounce-buffer discipline (BounceBufferManager.scala)
 recast as padded device arrays; XLA owns scheduling and overlap.
 
+The eager jnp dispatches in this module are once-per-exchange-EPOCH
+staging/assembly control plane (not per-batch hot-path work), and the
+string-matrix helpers also run inside the jitted epoch program:
+# tpulint: traced-helpers
+
 Engine integration (the RapidsShuffleManager analog): when
 `rapids.tpu.shuffle.mode=ici`, `TpuShuffleExchangeExec` calls
 `ici_hash_exchange` for hash partitionings whose partition count matches the
@@ -366,6 +371,7 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
                 maxes.append([jnp.max(_string_lens(b.columns[ci].offsets))
                               for b in live_slots])
             flat = [x for grp in maxes for x in grp]
+            # tpulint: host-sync -- one grouped width-probe read per epoch
             got = [int(v) for v in jax.device_get(flat)] if flat else []
             it = iter(got)
             for i, ci in enumerate(need):
@@ -384,6 +390,7 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
     def stack_global(parts, shape_tail, dtype):
         if jax.process_count() > 1:
             host = np.stack([
+                # tpulint: host-sync -- multi-process path must host-stage
                 np.asarray(jax.device_get(p)) if p is not None
                 else np.zeros(shape_tail, dtype) for p in parts])
             return jax.make_array_from_callback(
@@ -460,8 +467,14 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
         # the received arrays so every process can serve any partition to
         # its local pipeline — the XLA all-gather over ICI/DCN playing the
         # reference's cross-executor UCX fetch (RapidsShuffleClient.scala)
-        out = jax.jit(lambda *xs: xs,
-                      out_shardings=NamedSharding(mesh, P()))(*out)
+        # cached per mesh: a bare jax.jit(lambda ...) here built a fresh
+        # function object — and paid a retrace — every exchange epoch
+        # (found by tpulint's jit-cache rule)
+        rep = get_or_build(
+            ("ici_replicate", mesh),
+            lambda: jax.jit(lambda *xs: xs,
+                            out_shardings=NamedSharding(mesh, P())))
+        out = rep(*out)
     recv_live, routed = out[0], out[1:]
     recv_pid = routed[2 * ncols + len(str_cols)] if k > 1 else None
 
@@ -490,6 +503,7 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
         for ci in str_cols:
             sums.append(jnp.sum(masked[ci]))
         part_plans.append((t, live_p, masked))
+    # tpulint: host-sync -- ONE batched byte-size sync for all partitions
     totals = [int(v) for v in jax.device_get(sums)] if sums else []
     ti = iter(totals)
 
@@ -520,6 +534,7 @@ def _to_global(arr, sharding):
     shards."""
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
+    # tpulint: host-sync -- multi-process placement goes through host
     host = np.asarray(jax.device_get(arr))
     return jax.make_array_from_callback(host.shape, sharding,
                                         lambda idx: host[idx])

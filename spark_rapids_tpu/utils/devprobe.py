@@ -38,6 +38,7 @@ def fence_cost_ms() -> float:
     import jax.numpy as jnp
     import numpy as np
 
+    # tpulint: jit-cache -- one-shot probe; result memoized in _fence_ms
     f = jax.jit(lambda x: x + 1)
     x = jnp.zeros((8,), jnp.int32)
     np.asarray(f(x))  # warm (compile)

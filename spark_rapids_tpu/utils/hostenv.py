@@ -54,3 +54,16 @@ def apply_cpu_env(n_devices: Optional[int] = None) -> None:
     os.environ.update(scrubbed_cpu_env(n_devices))
     for var in _ACCELERATOR_ENV_VARS:
         os.environ.pop(var, None)
+
+
+def ensure_cpu_env(default_devices: int = 8) -> None:
+    """Force the scrubbed CPU env in-place, adding ``default_devices``
+    virtual host devices unless the caller's ``XLA_FLAGS`` already pins a
+    device count. The ONE entry-point rule shared by the test conftest
+    and the standalone distributed tests, so the device-count handling
+    cannot diverge between the pytest and standalone paths."""
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        apply_cpu_env(default_devices)
+    else:
+        apply_cpu_env()

@@ -11,19 +11,40 @@ import sys
 # Must be set before jax import / backend init.  Shared scrub rules live in
 # spark_rapids_tpu.utils.hostenv (imports no jax).
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-from spark_rapids_tpu.utils.hostenv import apply_cpu_env  # noqa: E402
+from spark_rapids_tpu.utils.hostenv import ensure_cpu_env  # noqa: E402
 
-if "xla_force_host_platform_device_count" not in os.environ.get(
-        "XLA_FLAGS", ""):
-    apply_cpu_env(8)
-else:
-    apply_cpu_env()
+ensure_cpu_env(default_devices=8)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "hotpath: run under jax transfer_guard_device_to_host('disallow') "
+        "— any IMPLICIT device->host transfer (np.asarray/bool()/float() "
+        "on a device value) raises, dynamically enforcing what tpulint's "
+        "host-sync rule proves statically; explicit jax.device_get at "
+        "planned sync points stays allowed")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 verify run")
+
+
+@pytest.fixture(autouse=True)
+def _transfer_guard_sanitizer(request):
+    """Sanitizer for tests marked @pytest.mark.hotpath: the linter claims
+    the hot paths never sync implicitly; the transfer guard makes the
+    claim enforce itself at runtime (PAPERS.md: Theseus attributes most
+    regressions to exactly these unplanned device->host transfers)."""
+    if request.node.get_closest_marker("hotpath") is None:
+        yield
+        return
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
 
 
 @pytest.fixture(autouse=True, scope="module")

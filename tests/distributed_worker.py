@@ -163,6 +163,13 @@ def main_tpch() -> None:
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # Self-force the virtual-CPU backend BEFORE anything imports jax: the
+    # worker must come up with its own 4-device CPU mesh even when the
+    # parent's env (conftest scrub) was not inherited — the multichip
+    # dryrun contract must hold standalone.
+    from spark_rapids_tpu.utils.hostenv import apply_cpu_env
+
+    apply_cpu_env(int(os.environ.get("SRT_LOCAL_DEVICES", "4")))
     if len(sys.argv) > 1 and sys.argv[1] == "--engine":
         main_engine()
     elif len(sys.argv) > 1 and sys.argv[1] == "--tpch":

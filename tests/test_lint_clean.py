@@ -1,0 +1,28 @@
+"""Tier-1 lint gate: tpulint over the whole package + docs must be clean.
+
+The linter's findings are machine-checked invariants of the hot paths
+(no silent host syncs, no eager dispatches, no jit-cache churn, no conf
+typos); running it as a test makes any regression fail the standard
+verify command, the way the reference's GpuOverrides tagging gates its
+plans (docs/static-analysis.md)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.tpulint.core import lint_paths  # noqa: E402
+
+
+def test_package_and_docs_lint_clean():
+    findings = lint_paths([os.path.join(REPO, "spark_rapids_tpu"),
+                           os.path.join(REPO, "docs")])
+    assert not findings, "tpulint findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_linter_cli_is_invocable():
+    from tools.tpulint.__main__ import main
+
+    assert main([os.path.join(REPO, "spark_rapids_tpu")]) == 0

@@ -1,0 +1,187 @@
+"""Plan-verifier tests: clean plans verify, corrupted plans are rejected
+(schema drift, unresolved references, fused-stage accounting), and the
+conf gates (enabled / failOnViolation) behave (docs/static-analysis.md)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.basic import TpuProjectExec
+from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+from spark_rapids_tpu.ops.base import AttributeReference
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.plan.verify import (
+    PlanVerificationError,
+    check_plan,
+    verify_plan,
+)
+
+
+def _flagship_df(session, n=2000):
+    rng = np.random.default_rng(3)
+    df = session.createDataFrame({
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+        "f": rng.random(n).astype(np.float32),
+    }, num_partitions=2)
+    return (df.filter(F.col("v") % 3 != 0)
+              .withColumn("c", F.col("v") * 2 + 1)
+              .groupBy("k").agg(F.sum("c").alias("s")))
+
+
+def _capture_final_plan(session, df):
+    session.plan_capture.start()
+    df.collect()
+    plans = session.plan_capture.stop()
+    assert plans
+    return plans[-1]
+
+
+def _find_project_ref(plan):
+    """(project node, index, reference) of the first bare column reference
+    inside a device projection list."""
+    for node in plan.collect_nodes(
+            lambda n: isinstance(n, TpuProjectExec)):
+        for i, e in enumerate(node.project_list):
+            if isinstance(e, AttributeReference):
+                return node, i, e
+    raise AssertionError("no bare column reference found in any project")
+
+
+# ---------------------------------------------------------------------------
+# clean plans verify
+# ---------------------------------------------------------------------------
+def test_real_query_plans_verify_clean(session):
+    plan = _capture_final_plan(session, _flagship_df(session))
+    assert verify_plan(plan) == []
+    assert session.last_plan_violations == []
+
+
+def test_join_sort_expand_plans_verify_clean(session):
+    rng = np.random.default_rng(5)
+    left = session.createDataFrame({
+        "k": rng.integers(0, 30, 500).astype(np.int64),
+        "v": rng.integers(0, 9, 500).astype(np.int64)},
+        num_partitions=2)
+    right = session.createDataFrame({
+        "k": rng.integers(0, 30, 200).astype(np.int64),
+        "w": rng.integers(0, 5, 200).astype(np.int64)},
+        num_partitions=2)
+    q = (left.join(right, on="k", how="inner")
+             .groupBy("k").agg(F.sum("w").alias("sw"))
+             .orderBy("k").limit(10))
+    plan = _capture_final_plan(session, q)
+    assert verify_plan(plan) == []
+    cube = left.cube("k").agg(F.count("*").alias("n"))
+    plan = _capture_final_plan(session, cube)
+    assert verify_plan(plan) == []
+
+
+def test_explain_renders_verification_section(session):
+    df = _flagship_df(session)
+    text = df.explain()
+    assert "== Plan verification ==" in text
+    assert "OK" in text.split("== Plan verification ==")[1]
+
+
+# ---------------------------------------------------------------------------
+# corrupted plans are rejected
+# ---------------------------------------------------------------------------
+def test_dtype_drift_rejected(session):
+    plan = _capture_final_plan(session, _flagship_df(session))
+    node, i, ref = _find_project_ref(plan)
+    # a FRESH reference with the same id but a lying dtype (mutating the
+    # shared attr object would change both sides of the check at once)
+    node.project_list[i] = AttributeReference(
+        ref.name, DataType.STRING, ref.nullable, expr_id=ref.expr_id)
+    violations = verify_plan(plan)
+    assert any("dtype drift" in v for v in violations)
+
+
+def test_unresolved_reference_rejected(session):
+    plan = _capture_final_plan(session, _flagship_df(session))
+    node, i, ref = _find_project_ref(plan)
+    node.project_list[i] = AttributeReference(
+        "ghost", ref.data_type, True)  # fresh expr_id nobody produces
+    violations = verify_plan(plan)
+    assert any("no child produces" in v for v in violations)
+
+
+def test_fused_stage_accounting_mismatch_rejected(session):
+    session.set_conf("rapids.tpu.sql.fusion.enabled", True)
+    plan = _capture_final_plan(session, _flagship_df(session))
+    stages = plan.collect_nodes(
+        lambda n: isinstance(n, TpuFusedStageExec))
+    assert stages, "expected a fused stage in the flagship plan"
+    stages[0].n_ops += 1
+    violations = verify_plan(plan)
+    assert any("fused" in v.lower() or "claims" in v for v in violations)
+
+
+def test_filter_condition_dtype_checked(session):
+    from spark_rapids_tpu.exec.basic import TpuFilterExec
+
+    plan = _capture_final_plan(session, _flagship_df(session))
+    filt = plan.collect_nodes(lambda n: isinstance(n, TpuFilterExec))
+    assert filt
+    # replace the condition with a non-boolean expression
+    filt[0].condition = filt[0].children[0].output[0]
+    violations = verify_plan(plan)
+    assert any("not BOOL" in v for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# conf gates
+# ---------------------------------------------------------------------------
+def test_check_plan_raises_and_observe_mode_does_not(session):
+    plan = _capture_final_plan(session, _flagship_df(session))
+    node, i, ref = _find_project_ref(plan)
+    node.project_list[i] = AttributeReference(
+        ref.name, DataType.STRING, ref.nullable, expr_id=ref.expr_id)
+    with pytest.raises(PlanVerificationError) as exc:
+        check_plan(plan, session.conf)
+    assert exc.value.violations
+    observe = session.conf.clone_with(
+        {"rapids.tpu.sql.planVerify.failOnViolation": False})
+    got = check_plan(plan, observe)
+    assert got and any("dtype drift" in v for v in got)
+
+
+def test_verify_off_passthrough(session, monkeypatch):
+    import spark_rapids_tpu.plan.verify as V
+
+    session.set_conf("rapids.tpu.sql.planVerify.enabled", False)
+
+    def boom(plan):
+        raise AssertionError("verifier must not run when disabled")
+
+    monkeypatch.setattr(V, "verify_plan", boom)
+    session.last_plan_violations = ["sentinel"]
+    rows = _flagship_df(session).collect()
+    assert len(rows) == 20
+    # the verifier never ran, and the stale violations were cleared
+    # rather than misattributed to this plan
+    assert session.last_plan_violations == []
+
+
+def test_last_plan_violations_recorded_when_check_raises(
+        session, monkeypatch):
+    """A raised verification must still record THIS plan's violations on
+    the session — a caller that catches the error reads them, not the
+    previous query's (typically empty) list."""
+    import spark_rapids_tpu.plan.verify as V
+
+    session.last_plan_violations = []
+    monkeypatch.setattr(V, "verify_plan", lambda plan: ["injected"])
+    with pytest.raises(PlanVerificationError):
+        _flagship_df(session).collect()
+    assert session.last_plan_violations == ["injected"]
+
+
+def test_verify_on_by_default_and_runs(session):
+    import spark_rapids_tpu.conf as C
+
+    assert session.conf.get(C.PLAN_VERIFY) is True
+    session.last_plan_violations = ["sentinel"]
+    _flagship_df(session).collect()
+    assert session.last_plan_violations == []

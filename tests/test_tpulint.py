@@ -1,0 +1,436 @@
+"""tpulint rule tests: one positive + one negative + pragma suppression
+per rule, plus the dynamic transfer-guard sanitizer the linter's static
+claims are backed by (docs/static-analysis.md)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.tpulint.core import (  # noqa: E402
+    ConfKeyIndex,
+    lint_md_text,
+    lint_source,
+)
+
+HOT = "spark_rapids_tpu/exec/fake.py"
+COLD = "spark_rapids_tpu/plan/fake.py"
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def lint(src, path=HOT, keys=None):
+    return lint_source(src, path, conf_keys=keys)
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+def test_host_sync_device_get_flagged_in_hot_path():
+    src = "import jax\n\ndef f(x):\n    return jax.device_get(x)\n"
+    assert rules_of(lint(src)) == ["host-sync"]
+
+
+def test_host_sync_not_flagged_outside_hot_path():
+    src = "import jax\n\ndef f(x):\n    return jax.device_get(x)\n"
+    assert lint(src, path=COLD) == []
+
+
+def test_host_sync_item_and_asarray_flagged():
+    src = ("import numpy as np\n"
+           "def f(x):\n"
+           "    a = x.item()\n"
+           "    b = np.asarray(x)\n"
+           "    return a, b\n")
+    got = lint(src)
+    assert [f.rule for f in got] == ["host-sync", "host-sync"]
+    assert got[0].line == 3 and got[1].line == 4
+
+
+def test_host_sync_builtin_over_device_value():
+    src = "def f(b):\n    return int(b.num_rows)\n"
+    assert rules_of(lint(src)) == ["host-sync"]
+
+
+def test_host_sync_cpu_oracle_scope_exempt():
+    src = ("import numpy as np\n"
+           "def cpu_filter(x):\n"
+           "    return np.asarray(x)\n"
+           "def _to_host(x):\n"
+           "    return x.item()\n")
+    assert lint(src) == []
+
+
+def test_host_sync_pragma_suppresses():
+    src = ("import jax\n"
+           "def f(x):\n"
+           "    # tpulint: host-sync -- one planned sync per epoch\n"
+           "    return jax.device_get(x)\n")
+    assert lint(src) == []
+
+
+def test_pragma_covers_multiline_statement():
+    src = ("import jax\n"
+           "def f(x, y):\n"
+           "    # tpulint: host-sync -- grouped read\n"
+           "    out = jax.device_get(\n"
+           "        [x,\n"
+           "         jax.device_get(y)])\n"
+           "    return out\n")
+    assert lint(src) == []
+
+
+def test_quoted_pragma_in_string_or_docstring_is_inert():
+    """A pragma QUOTED in a docstring or string literal is documentation,
+    not a waiver: it neither suppresses the next line nor reports as a
+    stale pragma. File directives (traced-helpers) stay honored from
+    docstrings — shuffle/ici.py declares one there."""
+    src = ('"""Example waiver:\n'
+           '    # tpulint: host-sync -- example only\n'
+           '"""\n'
+           "import jax\n"
+           "def f(x):\n"
+           '    s = "# tpulint: host-sync -- quoted"\n'
+           "    return jax.device_get(x), s\n")
+    got = lint(src)
+    assert [(f.rule, f.line) for f in got] == [("host-sync", 7)]
+
+    helpers = ('"""Helpers traced from other modules.\n'
+               "# tpulint: traced-helpers\n"
+               '"""\n'
+               "import jax.numpy as jnp\n"
+               "def helper(x):\n"
+               "    return jnp.sum(x)\n")
+    assert lint(helpers) == []
+
+
+def test_quoted_skip_file_does_not_disable_the_gate():
+    """skip-file disables linting for the whole file, so a QUOTED mention
+    (docstring prose, an error-message string) must not trigger it."""
+    src = ('"""Opt a file out with \'# tpulint: skip-file\'."""\n'
+           "import jax\n"
+           "def f(x):\n"
+           "    return jax.device_get(x)\n")
+    assert rules_of(lint(src)) == ["host-sync"]
+
+
+def test_trailing_pragma_does_not_leak_to_next_line():
+    """A pragma trailing code waives that statement ONLY: a new
+    unjustified sync added directly below a justified one must still be
+    flagged (a standalone comment pragma keeps its next-line coverage)."""
+    src = ("import jax\n"
+           "def f(x, y):\n"
+           "    a = jax.device_get(x)  # tpulint: host-sync -- planned\n"
+           "    b = jax.device_get(y)\n"
+           "    return a, b\n")
+    got = lint(src)
+    assert [f.rule for f in got] == ["host-sync"]
+    assert got[0].line == 4
+
+
+# ---------------------------------------------------------------------------
+# eager-jnp
+# ---------------------------------------------------------------------------
+def test_eager_jnp_flagged_outside_jit():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return jnp.sum(x)\n")
+    assert rules_of(lint(src)) == ["eager-jnp"]
+
+
+def test_eager_jnp_ok_inside_jitted_function():
+    src = ("import jax\nimport jax.numpy as jnp\n"
+           "def build():\n"
+           "    def fn(x):\n"
+           "        return jnp.sum(x)\n"
+           "    return jax.jit(fn)\n")
+    assert lint(src) == []
+
+
+def test_eager_jnp_ok_in_helper_called_from_trace():
+    src = ("import jax\nimport jax.numpy as jnp\n"
+           "def helper(x):\n"
+           "    return jnp.cumsum(x)\n"
+           "def build():\n"
+           "    def fn(x):\n"
+           "        return helper(x)\n"
+           "    return jax.jit(fn)\n")
+    assert lint(src) == []
+
+
+def test_eager_jnp_staging_constructors_allowed():
+    src = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    return jnp.asarray(n, dtype=jnp.int32), jnp.int64(n)\n")
+    assert lint(src) == []
+
+
+def test_eager_jnp_traced_helpers_directive():
+    src = ("# tpulint: traced-helpers\n"
+           "import jax.numpy as jnp\n"
+           "def kernel_helper(x):\n"
+           "    return jnp.sum(x)\n")
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# jit-cache
+# ---------------------------------------------------------------------------
+def test_jit_cache_per_call_jit_flagged():
+    src = ("import jax\n"
+           "def per_batch(fn, x):\n"
+           "    return jax.jit(fn)(x)\n")
+    assert rules_of(lint(src, path=COLD)) == ["jit-cache"]
+
+
+def test_jit_cache_inline_lambda_flagged():
+    src = ("import jax\n"
+           "def f(x):\n"
+           "    return jax.jit(lambda v: v + 1)(x)\n")
+    assert "jit-cache" in rules_of(lint(src, path=COLD))
+
+
+def test_jit_cache_builder_and_module_scope_ok():
+    src = ("import jax\n"
+           "from spark_rapids_tpu.engine.jit_cache import get_or_build\n"
+           "def _make(key):\n"
+           "    def build():\n"
+           "        def fn(x):\n"
+           "            return x\n"
+           "        return jax.jit(fn)\n"
+           "    return get_or_build(key, build)\n"
+           "also = get_or_build('k', lambda: jax.jit(lambda x: x))\n")
+    assert lint(src, path=COLD) == []
+
+
+def test_jit_cache_class_body_decorator_ok_nested_def_flagged():
+    """A parameterized @jax.jit(...) decorator or plain jax.jit call in a
+    class body runs once at import — not a recompile hazard; the same
+    decorator on a def nested inside a FUNCTION builds a fresh jitted
+    object per outer call and stays flagged."""
+    src = ("import jax\n"
+           "class Kern:\n"
+           "    @jax.jit(donate_argnums=(0,))\n"
+           "    def step(self, x):\n"
+           "        return x\n"
+           "    _fast = jax.jit(step)\n")
+    assert lint(src, path=COLD) == []
+    src2 = ("import jax\n"
+            "def per_call(x):\n"
+            "    @jax.jit(donate_argnums=(0,))\n"
+            "    def step(v):\n"
+            "        return v\n"
+            "    return step(x)\n")
+    assert "jit-cache" in rules_of(lint(src2, path=COLD))
+
+
+def test_jit_cache_arbitrary_lambda_is_not_a_builder():
+    """Only a lambda passed DIRECTLY to get_or_build is a builder; jit
+    wrapped in any other lambda is still a fresh function object (and a
+    recompile) per invocation."""
+    src = ("import jax\n"
+           "def per_batch(x):\n"
+           "    g = (lambda: jax.jit(lambda v: v + 1))()\n"
+           "    return g(x)\n")
+    assert "jit-cache" in rules_of(lint(src, path=COLD))
+
+
+def test_jit_cache_pragma_suppresses():
+    src = ("import jax\n"
+           "def probe():\n"
+           "    # tpulint: jit-cache -- one-shot probe, memoized result\n"
+           "    return jax.jit(lambda x: x + 1)\n")
+    assert lint(src, path=COLD) == []
+
+
+# ---------------------------------------------------------------------------
+# conf-key
+# ---------------------------------------------------------------------------
+KEYS = ConfKeyIndex(["rapids.tpu.sql.enabled",
+                     "rapids.tpu.sql.fusion.enabled"])
+
+
+def test_conf_key_typo_flagged_and_valid_passes():
+    src = ('GOOD = "rapids.tpu.sql.enabled"\n'
+           'BAD = "rapids.tpu.sql.fusion.enable"\n')
+    got = lint(src, path=COLD, keys=KEYS)
+    assert [f.rule for f in got] == ["conf-key"]
+    assert got[0].line == 2
+
+
+def test_conf_key_dynamic_and_prefix_mentions_pass():
+    src = ('A = "rapids.tpu.sql.exec.TpuProjectExec"\n'
+           'B = "rapids.tpu.sql.expression.Add"\n'
+           '# prose may mention the rapids.tpu.sql namespace bare\n')
+    assert lint(src, path=COLD, keys=KEYS) == []
+
+
+def test_conf_key_comment_and_docstring_scanned():
+    src = ('"""Doc mentions rapids.tpu.sql.fusion.enalbed badly."""\n'
+           "# and a comment typo: rapids.tpu.sql.enabeld\n")
+    got = lint(src, path=COLD, keys=KEYS)
+    assert [f.line for f in got] == [1, 2]
+
+
+def test_conf_key_pragma_suppresses():
+    src = ('# tpulint: conf-key -- deliberately unknown, tested below\n'
+           'BAD = "rapids.tpu.sql.not.a.key"\n')
+    assert lint(src, path=COLD, keys=KEYS) == []
+
+
+def test_conf_key_markdown():
+    md = ("The `rapids.tpu.sql.enabled` key is real.\n"
+          "The `rapids.tpu.sql.fusion.enalbed` key is a typo.\n"
+          "Waived: `rapids.tpu.bogus` <!-- tpulint: conf-key -->\n")
+    got = lint_md_text(md, "docs/fake.md", KEYS)
+    assert [f.rule for f in got] == ["conf-key"]
+    assert got[0].line == 2
+
+
+def test_conf_key_markdown_pragma_covers_heading_not_beyond():
+    """In markdown a '#' line is a HEADING, not a comment continuation:
+    a standalone pragma must waive the heading directly below it and
+    nothing past it."""
+    md = ("<!-- tpulint: conf-key -->\n"
+          "# about rapids.tpu.waived.key\n"
+          "and `rapids.tpu.still.a.typo` stays flagged\n")
+    got = lint_md_text(md, "docs/fake.md", KEYS)
+    assert [f.rule for f in got] == ["conf-key"]
+    assert got[0].line == 3
+
+
+def test_conf_key_real_registry_knows_new_keys():
+    index = ConfKeyIndex.load()
+    assert index.is_valid("rapids.tpu.sql.planVerify.enabled")
+    assert index.is_valid("rapids.tpu.sql.planVerify.failOnViolation")
+    assert not index.is_valid("rapids.tpu.sql.planVerify.enable")
+
+
+# ---------------------------------------------------------------------------
+# cpu-oracle
+# ---------------------------------------------------------------------------
+def test_cpu_oracle_jnp_flagged():
+    src = ("import jax.numpy as jnp\n"
+           "def cpu_project(x):\n"
+           "    return jnp.sum(x)\n")
+    assert "cpu-oracle" in rules_of(lint(src, path=COLD))
+
+
+def test_cpu_oracle_numpy_ok_and_pragma():
+    src = ("import numpy as np\nimport jax\n"
+           "def cpu_fold(x):\n"
+           "    return np.sum(x)\n"
+           "class CpuThing:\n"
+           "    def go(self, x):\n"
+           "        # tpulint: cpu-oracle -- transitional shim\n"
+           "        return jax.device_get(x)\n")
+    assert lint(src, path=COLD) == []
+
+
+# ---------------------------------------------------------------------------
+# stdout-print
+# ---------------------------------------------------------------------------
+def test_stdout_print_flagged_and_stderr_ok():
+    src = ("import sys\n"
+           "def f():\n"
+           "    print('oops')\n"
+           "    print('fine', file=sys.stderr)\n")
+    got = lint(src, path=COLD)
+    assert [f.rule for f in got] == ["stdout-print"]
+    assert got[0].line == 3
+
+
+def test_stdout_print_pragma_suppresses():
+    src = ("def show():\n"
+           "    # tpulint: stdout-print -- console API\n"
+           "    print('table')\n")
+    assert lint(src, path=COLD) == []
+
+
+# ---------------------------------------------------------------------------
+# pragma hygiene
+# ---------------------------------------------------------------------------
+def test_unknown_pragma_rule_reported():
+    src = "# tpulint: no-such-rule\nx = 1\n"
+    got = lint(src, path=COLD)
+    assert [f.rule for f in got] == ["pragma"]
+    assert "no-such-rule" in got[0].message
+
+
+def test_stale_pragma_reported():
+    src = "def f():\n    # tpulint: host-sync -- nothing here\n    pass\n"
+    got = lint(src)
+    assert [f.rule for f in got] == ["pragma"]
+    assert "stale" in got[0].message
+
+
+def test_skip_file_directive():
+    src = ("# tpulint: skip-file\nimport jax\n"
+           "def f(x):\n    return jax.device_get(x)\n")
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_exit_codes(tmp_path):
+    from tools.tpulint.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean)]) == 0
+    dirty = tmp_path / "spark_rapids_tpu" / "exec"
+    dirty.mkdir(parents=True)
+    bad = dirty / "bad.py"
+    bad.write_text("import jax\n\ndef f(x):\n    return jax.device_get(x)\n")
+    assert main([str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# dynamic sanitizer: the linter's static claim, enforced at runtime
+# ---------------------------------------------------------------------------
+@pytest.mark.hotpath
+def test_fused_hot_path_has_no_implicit_device_to_host(session):
+    """The flagship filter->project->aggregate pipeline runs end to end
+    under transfer_guard_device_to_host('disallow'): every device->host
+    crossing in the hot path must be an EXPLICIT planned sync."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(7)
+    df = session.createDataFrame({
+        "k": rng.integers(0, 40, 5000).astype(np.int64),
+        "v": rng.integers(-100, 100, 5000).astype(np.int64),
+    }, num_partitions=2)
+    out = (df.filter(F.col("v") % 3 != 0)
+             .withColumn("c", F.col("v") * 2 + 1)
+             .groupBy("k").agg(F.sum("c").alias("s"),
+                               F.count("*").alias("n")).collect())
+    assert len(out) == 40
+    session.set_conf("rapids.tpu.sql.enabled", False)
+    want = (df.filter(F.col("v") % 3 != 0)
+              .withColumn("c", F.col("v") * 2 + 1)
+              .groupBy("k").agg(F.sum("c").alias("s"),
+                                F.count("*").alias("n")).collect())
+    assert sorted(out) == sorted(want)
+
+
+@pytest.mark.hotpath
+def test_shuffle_hot_path_has_no_implicit_device_to_host(session):
+    """A hash exchange (repartition) under the same sanitizer: the routed
+    split's counts sync and the download at collect() are explicit."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(9)
+    df = session.createDataFrame({
+        "k": rng.integers(0, 1 << 20, 4000).astype(np.int64),
+        "v": rng.integers(0, 10, 4000).astype(np.int64),
+    }, num_partitions=3)
+    got = df.repartition(8, F.col("k")).agg(
+        F.count("*").alias("n")).collect()
+    assert got[0][0] == 4000

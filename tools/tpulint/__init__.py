@@ -1,0 +1,23 @@
+"""tpulint — static analysis for TPU hot-path hazards.
+
+The reference plugin's safety net is static: GpuOverrides walks the plan
+and PROVES each operator can run on the accelerator before execution.
+tpulint is the source-level counterpart for this codebase: an AST linter
+that proves the device hot paths (exec/, shuffle/, ops/eval.py) contain
+no silent host syncs, no eager per-batch dispatches outside jit, no
+jit-recompile hazards, and no config-key typos — machine-checked, not
+grep (docs/static-analysis.md).
+
+Run: python -m tools.tpulint spark_rapids_tpu [docs ...]
+Suppress a finding with a justified pragma on the line or the line above:
+    # tpulint: host-sync -- one counts sync per routed batch
+"""
+
+from tools.tpulint.core import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_file,
+    lint_md_text,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,40 @@
+"""CLI: python -m tools.tpulint [paths...]   (default: spark_rapids_tpu docs)
+
+Exit status: 0 = clean, 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.tpulint.core import RULES, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="Static analysis for TPU hot-path hazards.")
+    ap.add_argument("paths", nargs="*",
+                    default=["spark_rapids_tpu", "docs"],
+                    help="files or directories (*.py, *.md)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule names and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for r in RULES:
+            # tpulint: stdout-print -- findings/rules ARE this CLI's stdout
+            print(r)
+        return 0
+    findings = lint_paths(args.paths)
+    for f in findings:
+        # tpulint: stdout-print -- findings ARE this CLI's stdout
+        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    if findings:
+        print(f"tpulint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

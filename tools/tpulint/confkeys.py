@@ -1,0 +1,26 @@
+"""Conf-key registry access for the conf-key rule.
+
+`spark_rapids_tpu.conf` is deliberately light (threading + typing only;
+the package __init__ pulls nothing heavier) so the linter can load the
+REAL registry — the same one the engine resolves keys against — without
+initializing jax or a backend. Per-operator keys are generated at plan
+-rule registration time (plan/overrides.py, which does import jax), so
+they are matched as patterns instead (ConfKeyIndex.DYNAMIC_PREFIXES).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def registry_keys() -> List[str]:
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from spark_rapids_tpu import conf as C
+
+    return [e.key for e in C.REGISTRY.entries()]

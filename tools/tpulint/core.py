@@ -1,0 +1,626 @@
+"""tpulint rule engine: one AST pass per file + a raw-text conf-key scan.
+
+Rules (each suppressible with `# tpulint: <rule>` on the finding's line or
+the line above; `-- reason` after the rule names documents the waiver):
+
+  host-sync   device->host synchronization in a hot-path file (exec/,
+              shuffle/, ops/eval.py): jax.device_get, np.asarray/np.array,
+              .item()/.tolist()/.block_until_ready(), and bool()/int()/
+              float() over device values. Host-side helpers (enclosing
+              def/class name containing 'cpu'/'host' or ending '_np')
+              are exempt — the CPU oracle path is not a device hot path.
+  eager-jnp   jnp.* compute dispatched OUTSIDE any jit-traced function in
+              a hot-path file (one un-fused kernel launch per call per
+              batch). Argument staging (jnp.asarray / dtype constructors)
+              is allowed.
+  jit-cache   jax.jit called somewhere it creates a FRESH function object
+              per invocation (recompile churn): non-module scope that is
+              not a recognized kernel-builder (build*/lambda passed to
+              get_or_build), or jax.jit over an inline lambda.
+  conf-key    a `rapids.tpu.*` key string (code, docstring, comment, or
+              markdown) that is not registered in conf.py and is not a
+              generated per-operator key — a typo'd key silently reads
+              as its default.
+  cpu-oracle  jax/jnp usage inside the CPU oracle path (functions named
+              cpu_* / classes Cpu*): the oracle must stay an independent
+              numpy engine or equivalence tests prove nothing.
+  stdout-print  print() to stdout inside the package: workers speak a
+              JSON-line protocol on stdout (bench.py, daemons); stray
+              prints corrupt it. Print to sys.stderr instead.
+  pragma      tpulint pragma hygiene: unknown rule name, or a pragma
+              that suppresses nothing (stale waiver).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES = (
+    "host-sync",
+    "eager-jnp",
+    "jit-cache",
+    "conf-key",
+    "cpu-oracle",
+    "stdout-print",
+    "pragma",
+)
+
+# jnp constructors that only stage host scalars/arrays as device operands
+# (necessary at every kernel boundary; not an eager compute dispatch)
+_STAGING_OK = {
+    "asarray", "dtype", "bool_",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64", "bfloat16",
+}
+
+# method calls that force a device->host round trip
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+# call sinks whose function/body arguments become jit-traced
+_TRACE_SINKS = {
+    "jit", "shard_map", "vmap", "pmap", "scan", "fori_loop", "while_loop",
+    "cond", "switch", "checkpoint", "remat", "grad", "custom_jvp",
+}
+
+_HOST_SCOPE_RE = re.compile(r"(?i)(cpu|host)|_np$")
+_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*([a-z\-, ]+?)(?:\s*--.*)?$")
+_MD_PRAGMA_RE = re.compile(r"<!--\s*tpulint:\s*([a-z\-, ]+?)\s*-->")
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path: str, line: int, rule: str, message: str):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def is_hot_path(path: str) -> bool:
+    """Device hot-path files: exec/, shuffle/, and ops/eval.py."""
+    p = _norm(path)
+    return ("spark_rapids_tpu/exec/" in p
+            or "spark_rapids_tpu/shuffle/" in p
+            or p.endswith("spark_rapids_tpu/ops/eval.py"))
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.device_get', 'jnp.sum',
+    'np.asarray', ...); '' when not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _contains(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+# ---------------------------------------------------------------------------
+# Pragmas
+# ---------------------------------------------------------------------------
+def _comment_lines(source: str) -> Optional[Set[int]]:
+    """Lines holding a real COMMENT token; None when the source does not
+    tokenize (then raw-text matching is the only option — ast.parse will
+    surface the syntax error separately)."""
+    try:
+        return {tok.start[0]
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT}
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+
+
+class _Pragmas:
+    """Per-file pragma table: suppression lookup + hygiene reporting."""
+
+    def __init__(self, source: str, path: str, md: bool = False):
+        self.path = path
+        self.by_line: Dict[int, Set[str]] = {}
+        self.bad: List[Tuple[int, str]] = []
+        self.used: Set[int] = set()
+        self.skip_file = False
+        # file directive for kernel-helper libraries whose functions are
+        # called INSIDE jit traces from other modules (cross-module
+        # tracedness a single-file pass cannot see): disables eager-jnp
+        # only — host-sync and the rest still apply
+        self.traced_helpers = False
+        rx = _MD_PRAGMA_RE if md else _PRAGMA_RE
+        # suppression pragmas must be REAL comment tokens: a pragma quoted
+        # in a docstring/string literal is documentation, and treating it
+        # as live would silently waive findings near it (or report the
+        # quoted example as stale). File directives stay honored anywhere
+        # — shuffle/ici.py declares traced-helpers from its docstring.
+        comment_lines = None if md else _comment_lines(source)
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = rx.search(text)
+            if not m:
+                continue
+            names = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            live = comment_lines is None or i in comment_lines
+            if "skip-file" in names:
+                # skip-file disables the WHOLE gate for the file, so a
+                # quoted mention (docstring prose, an error message) must
+                # not trigger it — real comment tokens only
+                if live:
+                    self.skip_file = True
+                    self.used.add(i)
+                names.discard("skip-file")
+            if "traced-helpers" in names:
+                self.traced_helpers = True
+                self.used.add(i)
+                names.discard("traced-helpers")
+            if not live:
+                continue  # quoted pragma text: inert
+            unknown = names - set(RULES)
+            for u in sorted(unknown):
+                self.bad.append((i, u))
+            if names & set(RULES):
+                self.by_line[i] = names & set(RULES)
+        # a pragma covers its own line and — ONLY when it stands alone on
+        # a comment line — the first CODE line below it (skipping blank/
+        # comment continuation lines). A pragma trailing code waives that
+        # line's statement only: extending it downward would silently
+        # cover an unjustified violation added under a justified one.
+        lines = source.splitlines()
+        # the mode's comment marker: '#' in python, '<!--' in markdown
+        # (a '#' line in markdown is a HEADING — real content, not a
+        # comment continuation)
+        comment = "<!--" if md else "#"
+        self._eff: Dict[int, List[int]] = {}
+        for p in self.by_line:
+            self._eff.setdefault(p, []).append(p)
+            if not lines[p - 1].lstrip().startswith(comment):
+                continue
+            j = p + 1
+            while j <= len(lines) and (
+                    not lines[j - 1].strip()
+                    or lines[j - 1].lstrip().startswith(comment)):
+                j += 1
+            if j <= len(lines):
+                self._eff.setdefault(j, []).append(p)
+
+    def suppresses(self, line: int, rule: str,
+                   stmt_start: Optional[int] = None) -> bool:
+        """A pragma applies on its own line, the first code line below it,
+        and — when a statement spans lines — anywhere inside a statement
+        whose first line it covers."""
+        candidates = {line}
+        if stmt_start is not None:
+            candidates.add(stmt_start)
+        for ln in sorted(candidates):
+            for p in self._eff.get(ln, ()):
+                if rule in self.by_line[p]:
+                    self.used.add(p)
+                    return True
+        return False
+
+    def hygiene_findings(self) -> List[Finding]:
+        out = [Finding(self.path, ln, "pragma",
+                       f"unknown tpulint rule {name!r} in pragma")
+               for ln, name in self.bad]
+        for ln in sorted(set(self.by_line) - self.used):
+            out.append(Finding(
+                self.path, ln, "pragma",
+                "stale pragma: suppresses no finding on this or the next "
+                f"line ({', '.join(sorted(self.by_line[ln]))})"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: traced-function discovery
+# ---------------------------------------------------------------------------
+class _TraceIndex:
+    """Which source spans are jit-traced. Seeds: functions decorated with
+    jax.jit (incl. functools.partial(jax.jit, ...)), and names/lambdas
+    passed to a trace sink (jax.jit, shard_map, lax.scan, ...). Helpers
+    CALLED from a traced span are traced too (fixpoint, by local name)."""
+
+    def __init__(self, tree: ast.Module):
+        self._defs: Dict[str, List[ast.AST]] = {}
+        self._spans: List[Tuple[int, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(node.name, []).append(node)
+        traced_nodes: List[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._is_jit_deco(d) for d in node.decorator_list):
+                    traced_nodes.append(node)
+            elif isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name.rsplit(".", 1)[-1] in _TRACE_SINKS:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Lambda):
+                            traced_nodes.append(arg)
+                        elif isinstance(arg, ast.Name):
+                            traced_nodes.extend(
+                                self._defs.get(arg.id, ()))
+        seen: Set[int] = set()
+        frontier = [n for n in traced_nodes if n is not None]
+        while frontier:
+            node = frontier.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            span = (node.lineno, getattr(node, "end_lineno", node.lineno))
+            self._spans.append(span)
+            # helpers called from inside this traced body become traced
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    frontier.extend(self._defs.get(sub.func.id, ()))
+
+    @staticmethod
+    def _is_jit_deco(deco: ast.AST) -> bool:
+        name = _dotted(deco)
+        if name.rsplit(".", 1)[-1] == "jit":
+            return True
+        if isinstance(deco, ast.Call):
+            fname = _dotted(deco.func)
+            if fname.rsplit(".", 1)[-1] == "jit":
+                return True
+            if fname.endswith("partial") and deco.args and \
+                    _dotted(deco.args[0]).rsplit(".", 1)[-1] == "jit":
+                return True
+        return False
+
+    def in_trace(self, line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in self._spans)
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: rule visitor
+# ---------------------------------------------------------------------------
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, trace: _TraceIndex,
+                 conf_keys: Optional["ConfKeyIndex"],
+                 traced_helpers: bool = False):
+        self.path = path
+        self.hot = is_hot_path(path)
+        self.trace = trace
+        self.traced_helpers = traced_helpers
+        self.conf_keys = conf_keys
+        self.scope: List[str] = []  # enclosing def/class names
+        self.scope_kinds: List[str] = []  # 'class' or 'func', parallel
+        # lambdas passed directly to a *get_or_build(...) call: the one
+        # lambda shape where jax.jit inside runs exactly once (the cache
+        # builder); every other lambda is a per-invocation scope
+        self._builder_lambdas: Set[int] = set()
+        self.findings: List[Finding] = []
+
+    # -- helpers -------------------------------------------------------------
+    def _flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(Finding(self.path, node.lineno, rule, msg))
+
+    def _host_scope(self) -> bool:
+        return any(_HOST_SCOPE_RE.search(s) for s in self.scope)
+
+    def _cpu_oracle_scope(self) -> bool:
+        return any(s.startswith("Cpu") or s.startswith("cpu_")
+                   for s in self.scope)
+
+    def _in_builder(self) -> bool:
+        """Recognized jit-cache builder scopes: a function named build*/
+        _build* (the get_or_build idiom everywhere in the engine)."""
+        return any(s.lstrip("_").startswith("build") for s in self.scope)
+
+    def _per_invocation_scope(self) -> bool:
+        """True when the current scope re-executes per call: any enclosing
+        function/lambda. Module scope and pure class bodies run exactly
+        once, at import — a jax.jit there builds one function object."""
+        return bool(self.scope) and \
+            not all(k == "class" for k in self.scope_kinds)
+
+    # -- scope tracking ------------------------------------------------------
+    def _visit_scoped(self, node, name: str, kind: str) -> None:
+        # decorators are visited BEFORE their def's scope is pushed: a
+        # decorator's hazard profile is that of the scope AROUND the def
+        # (a @jax.jit(...) on a class method runs once at import; on a
+        # def nested in a function it rebuilds per outer call)
+        for deco in getattr(node, "decorator_list", ()):
+            self.visit(deco)
+        self.scope.append(name)
+        self.scope_kinds.append(kind)
+        for child in ast.iter_child_nodes(node):
+            if child not in getattr(node, "decorator_list", ()):
+                self.visit(child)
+        self.scope.pop()
+        self.scope_kinds.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_scoped(node, node.name, "func")
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._visit_scoped(node, node.name, "class")
+
+    def visit_Lambda(self, node):
+        self.scope.append("<builder>"
+                          if id(node) in self._builder_lambdas
+                          else "<lambda>")
+        self.scope_kinds.append("func")
+        self.generic_visit(node)
+        self.scope.pop()
+        self.scope_kinds.pop()
+
+    # -- calls ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        tail = name.rsplit(".", 1)[-1]
+        in_trace = self.trace.in_trace(node.lineno)
+
+        if tail == "get_or_build":
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    self._builder_lambdas.add(id(arg))
+
+        # cpu-oracle: the numpy oracle must not touch jax
+        if self._cpu_oracle_scope() and \
+                (name.startswith("jnp.") or name.startswith("jax.")):
+            self._flag(node, "cpu-oracle",
+                       f"{name}() inside the CPU oracle path; the oracle "
+                       "must stay an independent numpy engine")
+
+        # stdout-print
+        if name == "print" and not self._prints_to_stderr(node):
+            self._flag(node, "stdout-print",
+                       "print() to stdout inside the package; stdout "
+                       "carries the workers' JSON-line protocol — write "
+                       "to sys.stderr or a metric instead")
+
+        # jit-cache
+        if tail == "jit" and name in ("jax.jit", "jit"):
+            if node.args and isinstance(node.args[0], ast.Lambda) and \
+                    self._per_invocation_scope() and \
+                    not self._in_builder() and \
+                    not self._inside_get_or_build_arg(node):
+                self._flag(node, "jit-cache",
+                           "jax.jit over an inline lambda builds a fresh "
+                           "function object (and a recompile) per call; "
+                           "hoist to module scope or cache via "
+                           "engine.jit_cache.get_or_build")
+            elif self._per_invocation_scope() and \
+                    not self._in_builder() and \
+                    not self._inside_get_or_build_arg(node):
+                self._flag(node, "jit-cache",
+                           "jax.jit called in a per-invocation scope; the "
+                           "compiled program is keyed by function object "
+                           "identity, so this recompiles every call — "
+                           "cache via get_or_build or a build*() closure")
+
+        # hot-path-only rules
+        if self.hot and not self._host_scope():
+            if not in_trace:
+                self._check_host_sync(node, name, tail)
+                if not self.traced_helpers:
+                    self._check_eager_jnp(node, name, tail)
+            elif name in ("jax.device_get", "device_get"):
+                self._flag(node, "host-sync",
+                           "jax.device_get inside a jit-traced function "
+                           "cannot work; hoist it out of the trace")
+        self.generic_visit(node)
+
+    def _check_host_sync(self, node: ast.Call, name: str, tail: str) -> None:
+        if name in ("jax.device_get", "device_get"):
+            self._flag(node, "host-sync",
+                       "jax.device_get blocks on the device in a hot "
+                       "path; batch it or justify with a pragma")
+        elif name in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array"):
+            # np.asarray(jax.device_get(x)) is pure host work — the sync
+            # is the (already flagged) device_get inside
+            if not (node.args and isinstance(node.args[0], ast.Call)
+                    and _dotted(node.args[0].func) in
+                    ("jax.device_get", "device_get")):
+                self._flag(node, "host-sync",
+                           f"{name}() on a device value forces an "
+                           "implicit device->host transfer in a hot path")
+        elif isinstance(node.func, ast.Attribute) and \
+                tail in _SYNC_METHODS and not node.args:
+            self._flag(node, "host-sync",
+                       f".{tail}() forces a device->host sync in a hot "
+                       "path")
+        elif name in ("bool", "int", "float") and len(node.args) == 1 and \
+                self._looks_device_valued(node.args[0]):
+            self._flag(node, "host-sync",
+                       f"{name}() over a device value syncs implicitly "
+                       "in a hot path; use host_rows()/device_get at a "
+                       "planned boundary")
+
+    def _check_eager_jnp(self, node: ast.Call, name: str,
+                         tail: str) -> None:
+        if name.startswith("jnp.") and tail not in _STAGING_OK:
+            self._flag(node, "eager-jnp",
+                       f"{name}() outside any jit-traced function "
+                       "dispatches one un-fused kernel per call per "
+                       "batch; move it into the traced program")
+
+    @staticmethod
+    def _looks_device_valued(arg: ast.AST) -> bool:
+        """Conservative 'device value' detector for bool()/int()/float():
+        touches .num_rows (the engine's device-resident row count) or a
+        jnp.* call result."""
+        def pred(n):
+            if isinstance(n, ast.Attribute) and n.attr == "num_rows":
+                return True
+            if isinstance(n, ast.Call) and \
+                    _dotted(n.func).startswith("jnp."):
+                return True
+            return False
+
+        return _contains(arg, pred)
+
+    @staticmethod
+    def _prints_to_stderr(node: ast.Call) -> bool:
+        for kw in node.keywords:
+            if kw.arg == "file":
+                return True  # explicit stream: author chose a destination
+        return False
+
+    def _inside_get_or_build_arg(self, node: ast.Call) -> bool:
+        # `get_or_build(key, lambda: jax.jit(...))`: only a lambda passed
+        # DIRECTLY to get_or_build is a builder — an arbitrary enclosing
+        # lambda is still a fresh function object per invocation
+        return "<builder>" in self.scope
+
+
+# ---------------------------------------------------------------------------
+# conf-key scan (raw text: catches strings, comments, docstrings, markdown)
+# ---------------------------------------------------------------------------
+_KEY_RE = re.compile(r"rapids\.tpu\.[A-Za-z0-9_]+(?:\.[A-Za-z0-9_*]+)*")
+
+
+class ConfKeyIndex:
+    """Registered conf keys + generated per-operator key patterns."""
+
+    DYNAMIC_PREFIXES = ("rapids.tpu.sql.exec.",
+                        "rapids.tpu.sql.expression.")
+
+    def __init__(self, keys: Sequence[str]):
+        self.keys = set(keys)
+        self._prefixes: Set[str] = set()
+        for k in self.keys:
+            parts = k.split(".")
+            for i in range(2, len(parts)):
+                self._prefixes.add(".".join(parts[:i]))
+
+    @classmethod
+    def load(cls) -> "ConfKeyIndex":
+        from tools.tpulint.confkeys import registry_keys
+
+        return cls(registry_keys())
+
+    def is_valid(self, token: str) -> bool:
+        if "*" in token:
+            return True  # wildcard mention ('rapids.tpu.sql.exec.*')
+        if token in self.keys:
+            return True
+        if any(token.startswith(p) and len(token) > len(p)
+               for p in self.DYNAMIC_PREFIXES):
+            return True
+        # dotted-segment prefix of a registered key: prose like
+        # 'rapids.tpu.sql' / a dynamic-prefix mention without suffix
+        return token in self._prefixes or \
+            any(token == p.rstrip(".") for p in self.DYNAMIC_PREFIXES)
+
+
+def _scan_conf_keys(source: str, path: str, index: ConfKeyIndex,
+                    pragmas: _Pragmas) -> List[Finding]:
+    out: List[Finding] = []
+    for ln, text in enumerate(source.splitlines(), start=1):
+        for m in _KEY_RE.finditer(text):
+            token = m.group(0).rstrip(".")
+            if index.is_valid(token):
+                continue
+            if pragmas.suppresses(ln, "conf-key"):
+                continue
+            out.append(Finding(
+                path, ln, "conf-key",
+                f"unknown config key {token!r}: not in the conf.py "
+                "registry and not a generated per-operator key (typo "
+                "reads as the default silently)"))
+    return out
+
+
+def _stmt_start_map(tree: ast.Module) -> Dict[int, int]:
+    """line -> first line of the innermost statement containing it (BFS
+    assigns outer statements first, so inner spans overwrite)."""
+    out: Dict[int, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt):
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for ln in range(node.lineno, end + 1):
+                out[ln] = node.lineno
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+def lint_source(source: str, path: str,
+                conf_keys: Optional[ConfKeyIndex] = None) -> List[Finding]:
+    """Lint python source as if it lived at `path` (hot-path scoping and
+    rule selection key off the path)."""
+    pragmas = _Pragmas(source, path)
+    if pragmas.skip_file:
+        return []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "pragma",
+                        f"cannot parse: {e.msg}")]
+    visitor = _Visitor(path, _TraceIndex(tree), conf_keys,
+                       traced_helpers=pragmas.traced_helpers)
+    visitor.visit(tree)
+    stmt_start = _stmt_start_map(tree)
+    findings = [f for f in visitor.findings
+                if not pragmas.suppresses(f.line, f.rule,
+                                          stmt_start.get(f.line))]
+    if conf_keys is not None:
+        findings.extend(_scan_conf_keys(source, path, conf_keys, pragmas))
+    findings.extend(pragmas.hygiene_findings())
+    return sorted(findings, key=lambda f: (f.line, f.rule))
+
+
+def lint_md_text(source: str, path: str,
+                 conf_keys: ConfKeyIndex) -> List[Finding]:
+    pragmas = _Pragmas(source, path, md=True)
+    if pragmas.skip_file:
+        return []
+    findings = _scan_conf_keys(source, path, conf_keys, pragmas)
+    findings.extend(pragmas.hygiene_findings())
+    return sorted(findings, key=lambda f: (f.line, f.rule))
+
+
+def lint_file(path: str,
+              conf_keys: Optional[ConfKeyIndex] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    if path.endswith(".md"):
+        if conf_keys is None:
+            conf_keys = ConfKeyIndex.load()
+        return lint_md_text(source, path, conf_keys)
+    return lint_source(source, path, conf_keys)
+
+
+def lint_paths(paths: Sequence[str],
+               conf_keys: Optional[ConfKeyIndex] = None) -> List[Finding]:
+    """Lint files and directories (recursively: *.py + *.md)."""
+    if conf_keys is None:
+        conf_keys = ConfKeyIndex.load()
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith((".py", ".md")))
+        else:
+            files.append(p)
+    out: List[Finding] = []
+    for f in files:
+        out.extend(lint_file(f, conf_keys))
+    return out

@@ -262,9 +262,32 @@ def _measure_dispatches(session, df) -> dict:
             out[f"dispatches_{label}"] = m.get("deviceDispatches", 0)
             if enabled:
                 out["fused_stages"] = m.get("fusedStages", 0)
+            # analyzer prediction next to the measurement, so estimate
+            # drift shows up in the bench trajectory (plan/resources.py)
+            out.update({f"{k}_{label}": v for k, v in
+                        _resource_prediction(session).items()})
     finally:
         session.conf.set(key, prior)
     return out
+
+
+def _resource_prediction(session) -> dict:
+    """Flatten the resource analyzer's report for the LAST planned query
+    into JSON-safe drift-tracking fields (inf -> None)."""
+    rep = getattr(session, "last_resource_report", None)
+    if rep is None:
+        return {}
+
+    def _num(v):
+        return None if v != v or v in (float("inf"),) else int(v)
+
+    return {
+        "pred_dispatches_lo": _num(rep.dispatches.lo),
+        "pred_dispatches_hi": _num(rep.dispatches.hi),
+        "pred_dispatches_exact": bool(rep.dispatches_exact),
+        "pred_peak_bytes_lo": _num(rep.peak_bytes.lo),
+        "pred_peak_bytes_hi": _num(rep.peak_bytes.hi),
+    }
 
 
 def _spill_count() -> int:
@@ -611,6 +634,9 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
     _log(f"worker[{mode}]: {suite} sf={sf} tables built")
     bests = {}
     skipped = []
+    # per-query analyzer predictions + measured peak/dispatches (tpu
+    # mode): the summary carries prediction drift query by query
+    resources = {}
     # per-query wall cap: a slow query (many small device steps) must cost
     # its own slot, not the whole capture — partial geomeans with an
     # explicit skipped list beat an empty artifact. SIGALRM only fires
@@ -630,15 +656,30 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
     if has_alarm:
         signal.signal(signal.SIGALRM, _alarm)
     for qi, (qname, qfn) in enumerate(sorted(qmod.QUERIES.items())):
+        tracking = False
         try:
             if has_alarm:
                 signal.alarm(int(q_cap_s))
             qfn(tables).collect()  # warmup/compile
             times = []
-            for _ in range(2):
+            for i in range(2):
+                if i == 0 and mode == "tpu":
+                    # live-bytes peak sampled on the FIRST timed run only
+                    # (per-dispatch sampler; the second, untracked run
+                    # keeps one unperturbed time for best-of)
+                    session.device_manager.start_live_peak_tracking()
+                    tracking = True
                 t0 = time.perf_counter()
                 qfn(tables).collect()
                 times.append(time.perf_counter() - t0)
+                if tracking:
+                    peak = session.device_manager.stop_live_peak_tracking()
+                    tracking = False
+                    res = _resource_prediction(session)
+                    res["measured_peak_bytes"] = int(peak)
+                    res["measured_dispatches"] = \
+                        session.last_query_metrics.get("deviceDispatches", 0)
+                    resources[qname] = res
             if has_alarm:
                 # cancel BEFORE recording so a late alarm can't put the
                 # query in both bests and skipped
@@ -653,6 +694,7 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
                 "geomean_s": math.exp(sum(map(math.log, bests.values()))
                                       / len(bests)),
                 "queries": bests, "skipped": skipped,
+                "resources": resources,
                 "partial": True}), flush=True)
         except _QueryTimeout:
             skipped.append(qname)
@@ -660,6 +702,10 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
         finally:
             if has_alarm:
                 signal.alarm(0)
+            if tracking:
+                # a timeout mid-tracked-run must not leak the per-dispatch
+                # sampling hook into the remaining queries' timings
+                session.device_manager.stop_live_peak_tracking()
         if (qi + 1) % 5 == 0:
             # a 22-query suite accumulates enough live XLA executables to
             # segfault the CPU runtime (or kill LLVM with ENOMEM on the
@@ -679,6 +725,8 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
     geo = math.exp(sum(math.log(t) for t in bests.values()) / len(bests))
     out = {"mode": mode, "platform": dev.platform,
            "geomean_s": geo, "queries": bests}
+    if resources:
+        out["resources"] = resources
     if skipped:
         out["skipped"] = skipped
     print(json.dumps(out), flush=True)
@@ -1078,6 +1126,8 @@ def main() -> None:
               "dispatches_fused", "dispatches_unfused", "fused_stages"):
         if k in acc:
             result[k] = acc[k]
+    # analyzer predictions ride along with the measured dispatch counts
+    result.update({k: v for k, v in acc.items() if k.startswith("pred_")})
     if platform == "cpu-fallback":
         result["diag"] = _DIAG[-6:]
     if cpu is None:
@@ -1160,6 +1210,10 @@ def main_suite(suite: str, sf: float) -> None:
                          + ((cpu or {}).get("skipped") or [])))
     if skipped:
         out["skipped"] = skipped
+    if acc.get("resources"):
+        # per-query predicted-vs-measured peak bytes + dispatch counts
+        # (estimate drift stays visible in the bench trajectory)
+        out["resources"] = acc["resources"]
     _emit(out)
 
 

@@ -542,6 +542,49 @@ PLAN_VERIFY_FAIL = _conf("rapids.tpu.sql.planVerify.failOnViolation").doc(
     "— the triage mode for a rejected production plan."
 ).boolean(True)
 
+RESOURCE_ANALYSIS = _conf("rapids.tpu.sql.resourceAnalysis.enabled").doc(
+    "Run the plan-time resource analyzer on every FINAL physical plan: a "
+    "bottom-up abstract interpretation propagating row-count bounds, padded "
+    "batch shape sets, and a peak-HBM watermark (including transient "
+    "doubles: sort buffers, hash-join build tables, shuffle staging, "
+    "partial-agg scratch) per operator — including through TpuFusedStage "
+    "member chains. Emits per-stage peak-byte estimates, predicted jit "
+    "shape-bucket compile keys, and predicted device dispatches; typed "
+    "violations (OOM_HAZARD, SPILL_LIKELY, RECOMPILE_CHURN, "
+    "UNBOUNDED_GENERATE) render in EXPLAIN under '== Resource analysis ==' "
+    "and feed admission-weight hints to the TPU semaphore and headroom "
+    "hints to the spill framework (docs/static-analysis.md)."
+).boolean(True)
+
+RESOURCE_ANALYSIS_FAIL = _conf(
+    "rapids.tpu.sql.resourceAnalysis.failOnViolation").doc(
+    "Raise ResourceAnalysisError before execution when the resource "
+    "analyzer finds a fatal violation (OOM_HAZARD, RECOMPILE_CHURN, "
+    "UNBOUNDED_GENERATE; SPILL_LIKELY is always advisory — the spill "
+    "framework exists to absorb it). Off by default: the analyzer works "
+    "from static bounds, so the default mode observes — violations are "
+    "recorded in session.last_plan_violations and EXPLAIN, and admission/"
+    "spill hints still flow — while admission control that REJECTS "
+    "queries is an explicit opt-in."
+).boolean(False)
+
+RESOURCE_STATS_MAX_ROWS = _conf(
+    "rapids.tpu.sql.resourceAnalysis.statsMaxRows").doc(
+    "Largest host-resident relation (total rows) the resource analyzer "
+    "scans for per-column distinct-count stats at plan time; bigger "
+    "relations skip the scan and keep loose row bounds (plan-time cost "
+    "guard: the stats pass is O(rows log rows) per column)."
+).internal().integer(1 << 17)
+
+RESOURCE_HBM_BUDGET = _conf(
+    "rapids.tpu.sql.resourceAnalysis.hbmBudgetBytes").doc(
+    "HBM byte budget the resource analyzer checks predicted peaks "
+    "against. 0 (default) uses the device manager's budget (detected "
+    "HBM x rapids.tpu.memory.hbm.allocFraction); a nonzero override "
+    "lets admission policy be tested or tightened independently of the "
+    "physical device."
+).bytes(0)
+
 
 class TpuConf:
     """Resolved view of the settings map (reference: RapidsConf class).

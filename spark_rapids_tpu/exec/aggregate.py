@@ -643,7 +643,8 @@ def _synth_col(batch: ColumnarBatch):
     from spark_rapids_tpu.ops.values import ColV
 
     cap = bucket_capacity(max(batch.num_rows, 1))
-    # tpulint: eager-jnp -- zero-column COUNT(*) placeholder col
+    # tpulint: eager-jnp, untracked-alloc -- zero-column COUNT(*)
+    # placeholder col: one tiny bool lane, not batch data
     return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
                 jnp.arange(cap) < batch.num_rows)
 

@@ -56,6 +56,36 @@ def cached_row_count(logical_node):
     return total
 
 
+def cached_host_partitions(logical_node):
+    """Materialized HOST partitions of a cached relation, or None when the
+    cache is empty or device-resident. The resource analyzer
+    (plan/resources.py) reads exact per-batch row counts — and, for small
+    relations, column stats — from here without any device sync."""
+    with _LOCK:
+        return _HOST_CACHE.get(logical_node)
+
+
+def cached_device_partition_rows(logical_node):
+    """Per-batch row counts of a device-cached relation as
+    [[rows, ...] per partition], or None when unavailable (cache empty, or
+    a batch carries a device-resident count — not worth a sync here)."""
+    with _LOCK:
+        parts = _DEVICE_CACHE.get(logical_node)
+    if parts is None:
+        return None
+    out = []
+    for part in parts:
+        rows = []
+        for b in part:
+            b = getattr(b, "device_batch", None) or b
+            n = getattr(b, "num_rows", None)
+            if not isinstance(n, int):
+                return None
+            rows.append(n)
+        out.append(rows)
+    return out
+
+
 def invalidate(logical_node) -> None:
     with _LOCK:
         dropped = _DEVICE_CACHE.pop(logical_node, None)

@@ -288,7 +288,8 @@ class TpuFusedStageExec(TpuExec):
                 cols = [_col_to_colv(c) for c in batch.columns]
                 if not cols:
                     cap = bucket_capacity(max(batch.host_rows(), 1))
-                    # tpulint: eager-jnp -- zero-column COUNT(*) placeholder
+                    # tpulint: eager-jnp, untracked-alloc -- zero-column
+                    # COUNT(*) placeholder: one tiny bool lane
                     cols = [ColV(DataType.BOOL,
                                  jnp.zeros((cap,), dtype=bool),
                                  jnp.arange(cap) < batch.num_rows)]

@@ -265,7 +265,8 @@ def _synth(batch: ColumnarBatch):
     from spark_rapids_tpu.ops.values import ColV
 
     cap = bucket_capacity(max(batch.num_rows, 1))
-    # tpulint: eager-jnp -- zero-column COUNT(*) placeholder col
+    # tpulint: eager-jnp, untracked-alloc -- zero-column COUNT(*)
+    # placeholder col: one tiny bool lane, not batch data
     return ColV(DataType.BOOL, jnp.zeros((cap,), bool),
                 jnp.arange(cap) < batch.num_rows)
 
@@ -352,7 +353,8 @@ class _TpuJoinMixin:
         if emit_build_tail and build.num_rows > 0:
             # full outer: unmatched build rows with null stream columns
             if b_matched_acc is None:
-                # tpulint: eager-jnp -- empty-stream full outer: no match
+                # tpulint: eager-jnp, untracked-alloc -- empty-stream full
+                # outer: one bool mask at build capacity
                 b_matched_acc = jnp.zeros((build.capacity,), bool)
             # tpulint: host-sync -- once per partition at stream end: the
             # unmatched-build tail of a full outer join needs host rows
@@ -402,16 +404,19 @@ def _null_batch(attrs: List[AttributeReference], n_rows: int) -> ColumnarBatch:
     cap = bucket_capacity(max(n_rows, 1))
     cols = []
     for a in attrs:
-        # tpulint: eager-jnp -- all-null column build, outer-join tail only
+        # tpulint: eager-jnp, untracked-alloc -- all-null column
+        # build, outer-join tail only (once per partition)
         validity = jnp.zeros((cap,), bool)
         if a.data_type is DataType.STRING:
-            # tpulint: eager-jnp -- all-null string column, same tail
+            # tpulint: eager-jnp, untracked-alloc -- all-null string
+            # column, same tail
             cols.append(ColumnVector(
                 a.data_type, jnp.zeros((8,), jnp.uint8), validity,
                 jnp.zeros((cap + 1,), jnp.int32)))
         else:
             npdt = physical_np_dtype(a.data_type)
-            # tpulint: eager-jnp -- all-null column build, same tail
+            # tpulint: eager-jnp, untracked-alloc -- all-null column
+            # build, same tail
             cols.append(ColumnVector(a.data_type, jnp.zeros((cap,), npdt),
                                      validity))
     return ColumnarBatch(cols, n_rows)

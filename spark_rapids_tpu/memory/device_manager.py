@@ -42,6 +42,10 @@ class TpuDeviceManager:
         self.hbm_total = 0
         self.hbm_budget = 0
         self._initialized = False
+        # live-bytes high-water mark (start/stop_live_peak_tracking):
+        # sampled at every device dispatch while tracking is on
+        self._peak_lock = threading.Lock()
+        self._live_peak = 0
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -109,6 +113,54 @@ class TpuDeviceManager:
         except Exception:
             pass
         return 0
+
+    def live_bytes(self) -> int:
+        """Current device-resident bytes: the backend allocator's
+        bytes_in_use when the platform reports it, else the sum of live
+        jax array buffers on this platform (the CPU-backend fallback —
+        its allocator exposes no stats)."""
+        got = self.bytes_in_use()
+        if got:
+            return got
+        try:
+            total = 0
+            for arr in jax.live_arrays(self.platform):
+                total += int(getattr(arr, "nbytes", 0) or 0)
+            return total
+        except Exception:
+            return 0
+
+    # -- live-bytes high-water mark (resource-analyzer accuracy tests and
+    # bench.py estimate-drift reporting measure against this) ----------------
+    def start_live_peak_tracking(self) -> None:
+        """Begin sampling the live-bytes high-water mark at every device
+        dispatch. Off by default: the sampler walks the backend's live
+        buffers, which is measurement machinery, not a hot-path default."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        with self._peak_lock:
+            self._live_peak = self.live_bytes()
+        M.set_dispatch_hook(self._sample_live_peak)
+
+    def stop_live_peak_tracking(self) -> int:
+        """Stop sampling and return the observed high-water mark."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        M.set_dispatch_hook(None)
+        self._sample_live_peak()
+        with self._peak_lock:
+            return self._live_peak
+
+    def _sample_live_peak(self) -> None:
+        now = self.live_bytes()
+        with self._peak_lock:
+            if now > self._live_peak:
+                self._live_peak = now
+
+    @property
+    def live_bytes_peak(self) -> int:
+        with self._peak_lock:
+            return self._live_peak
 
     @property
     def is_tpu(self) -> bool:

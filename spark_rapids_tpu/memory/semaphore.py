@@ -8,6 +8,14 @@ task completion (GpuSemaphore.scala:101-161).
 Here a "task" is one partition-task executed by the engine's worker pool; the
 scheduler registers a completion callback that calls `release_if_necessary`,
 mirroring Spark's TaskContext completion listener.
+
+Beyond the reference: admission is WEIGHTED. The plan-time resource analyzer
+(plan/resources.py) predicts each query's per-task peak HBM and calls
+`set_query_weight` with how many of the `max_concurrent` permits one task of
+that query should hold — a plan predicted to fill the whole budget takes all
+permits (tasks serialize), a light plan takes one (full concurrency). This is
+the static half of admission control; the spill framework remains the dynamic
+backstop.
 """
 
 from __future__ import annotations
@@ -23,15 +31,18 @@ class TpuSemaphore:
     _lock = threading.Lock()
 
     class _TaskState:
-        __slots__ = ("count", "lock")
+        __slots__ = ("count", "permits", "lock")
 
         def __init__(self):
             self.count = 0
+            self.permits = 0  # permits this task holds while count > 0
             self.lock = threading.Lock()
 
     def __init__(self, max_concurrent: int):
         self.max_concurrent = max_concurrent
-        self._sem = threading.Semaphore(max_concurrent)
+        self._available = max_concurrent
+        self._cv = threading.Condition()
+        self._weight = 1
         self._holders: Dict[int, "TpuSemaphore._TaskState"] = {}
         self._holders_lock = threading.Lock()
 
@@ -61,6 +72,22 @@ class TpuSemaphore:
                 self._holders[task_id] = st
             return st
 
+    # -- plan-time admission hint (plan/resources.py) ------------------------
+    def set_query_weight(self, permits: int) -> None:
+        """How many permits ONE task of the current query holds, clamped to
+        [1, max_concurrent]. Set from the resource analyzer's
+        admission_weight before each query; weight 1 is the default full
+        concurrency. Tasks already holding permits keep (and return) what
+        they acquired — the weight applies to acquisitions from now on."""
+        w = max(1, min(int(permits), self.max_concurrent))
+        with self._cv:
+            self._weight = w
+
+    @property
+    def query_weight(self) -> int:
+        with self._cv:
+            return self._weight
+
     # -- reference: GpuSemaphore.acquireIfNecessary (GpuSemaphore.scala:74) --
     def acquire_if_necessary(self, task_id: int) -> None:
         # per-task lock makes the count check and the blocking permit acquire
@@ -69,7 +96,12 @@ class TpuSemaphore:
         with st.lock:
             if st.count == 0:
                 with trace_range("Acquire TPU Semaphore"):
-                    self._sem.acquire()
+                    with self._cv:
+                        want = self._weight
+                        while self._available < want:
+                            self._cv.wait()
+                        self._available -= want
+                st.permits = want
             st.count += 1
 
     # -- reference: GpuSemaphore.releaseIfNecessary (GpuSemaphore.scala:87) --
@@ -78,10 +110,16 @@ class TpuSemaphore:
             st = self._holders.get(task_id)
         if st is None:
             return
+        give_back = 0
         with st.lock:
             if st.count > 0:
                 st.count = 0
-                self._sem.release()
+                give_back = st.permits
+                st.permits = 0
+        if give_back:
+            with self._cv:
+                self._available += give_back
+                self._cv.notify_all()
         with self._holders_lock:
             self._holders.pop(task_id, None)
 

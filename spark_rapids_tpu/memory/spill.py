@@ -361,6 +361,17 @@ class SpillFramework:
         with cls._lock:
             cls._instance = None
 
+    # -- plan-time hints (plan/resources.py) ---------------------------------
+    def set_plan_hint(self, spill_pressure: float, per_task_peak) -> None:
+        """Forward the resource analyzer's prediction for the query about
+        to run: `spill_pressure` is predicted-peak / budget (> 1.0 means
+        the spill framework is expected to engage) and `per_task_peak` is
+        the transient bytes one task is predicted to need. The watermark
+        uses them to reserve headroom BEFORE the transients allocate, so
+        spill happens at upload boundaries (cheap, chosen victims) instead
+        of mid-operator."""
+        self.watermark.set_plan_hint(spill_pressure, per_task_peak)
+
     # -- buffer API ----------------------------------------------------------
     def add_device_batch(self, batch: ColumnarBatch,
                          priority: float = SpillPriorities.DEFAULT,
@@ -489,6 +500,23 @@ class MemoryWatermark:
         self.device_store = device_store
         self.budget = budget
         self.bytes_in_use = bytes_in_use
+        # plan-time transient reserve (set_plan_hint): bytes kept free for
+        # the running query's predicted operator transients
+        self.plan_reserve = 0
+
+    def set_plan_hint(self, spill_pressure: float, per_task_peak) -> None:
+        """Reserve predicted-transient headroom only for plans the analyzer
+        expects to overrun the budget (pressure > 1.0); light plans keep
+        the full budget for resident batches. The reserve is capped at
+        half the budget so a wildly pessimistic estimate cannot spill the
+        store empty."""
+        if (self.budget > 0 and spill_pressure > 1.0
+                and per_task_peak is not None
+                and per_task_peak == per_task_peak  # not NaN
+                and per_task_peak != float("inf")):
+            self.plan_reserve = min(int(per_task_peak), self.budget // 2)
+        else:
+            self.plan_reserve = 0
 
     def ensure_headroom(self, nbytes: int) -> None:
         """Spill tracked device buffers until `nbytes` fits under the budget.
@@ -498,7 +526,7 @@ class MemoryWatermark:
             return
         tracked = self.device_store.current_size
         external = max(0, self.bytes_in_use() - tracked)
-        avail = self.budget - external - tracked
+        avail = self.budget - self.plan_reserve - external - tracked
         if nbytes > avail:
             self.device_store.synchronous_spill(
-                max(0, self.budget - external - nbytes))
+                max(0, self.budget - self.plan_reserve - external - nbytes))

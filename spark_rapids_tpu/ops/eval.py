@@ -167,7 +167,8 @@ class DeviceProjector:
             from spark_rapids_tpu.columnar.batch import bucket_capacity
 
             cap = bucket_capacity(max(batch.host_rows(), 1))
-            # tpulint: eager-jnp -- zero-column COUNT(*) placeholder col
+            # tpulint: eager-jnp, untracked-alloc -- zero-column COUNT(*)
+            # placeholder col: one tiny bool lane, not batch data
             cols = [ColV(DataType.BOOL,
                          jnp.zeros((cap,), dtype=bool),
                          jnp.arange(cap) < batch.num_rows)]

@@ -27,6 +27,20 @@ from spark_rapids_tpu.exec.base import PhysicalExec
 from spark_rapids_tpu.ops.base import AttributeReference, Expression
 
 
+class PlanViolation(str):
+    """One static-analysis violation record. A plain `str` (every existing
+    consumer formats/joins violations as strings) carrying a `kind` tag, so
+    the plan verifier and the resource analyzer (plan/resources.py) share
+    one record type and one reporting path (session.last_plan_violations)."""
+
+    kind: str
+
+    def __new__(cls, msg: str, kind: str = "PLAN_VERIFY") -> "PlanViolation":
+        self = super().__new__(cls, msg)
+        self.kind = kind
+        return self
+
+
 class PlanVerificationError(ValueError):
     """A physical plan failed static verification."""
 
@@ -299,8 +313,8 @@ def _check_fused_stage(node, out: List[str]) -> None:
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
-def verify_plan(plan: PhysicalExec) -> List[str]:
-    """Bottom-up verification; returns violation strings (empty = OK)."""
+def verify_plan(plan: PhysicalExec) -> List[PlanViolation]:
+    """Bottom-up verification; returns violation records (empty = OK)."""
     from spark_rapids_tpu.exec.fused import TpuFusedStageExec
 
     out: List[str] = []
@@ -318,7 +332,8 @@ def verify_plan(plan: PhysicalExec) -> List[str]:
         if n > 1:
             out.append(f"fused stage id {sid} appears {n} times — stage "
                        "accounting/EXPLAIN markers would collide")
-    return out
+    return [v if isinstance(v, PlanViolation) else PlanViolation(v)
+            for v in out]
 
 
 def check_plan(plan: PhysicalExec, conf) -> List[str]:

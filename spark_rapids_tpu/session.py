@@ -12,6 +12,7 @@ Plan capture for tests mirrors ExecutionPlanCaptureCallback
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -31,6 +32,8 @@ from spark_rapids_tpu.plan.dataframe import DataFrame
 from spark_rapids_tpu.plan.overrides import TpuOverrides
 from spark_rapids_tpu.plan.planner import plan_physical
 from spark_rapids_tpu.plan.transition_overrides import TpuTransitionOverrides
+
+log = logging.getLogger(__name__)
 
 
 class PlanCapture:
@@ -68,9 +71,13 @@ class TpuSession:
         # fusion accounting of the most recent execute_batches (fusedStages,
         # deviceDispatches) — read by bench.py and the fusion tests
         self.last_query_metrics: Dict[str, int] = {}
-        # static plan-verifier findings of the most recent plan build
-        # (empty when clean; populated only while planVerify is enabled)
+        # static-analysis findings of the most recent plan build: the plan
+        # verifier's and the resource analyzer's violations share this one
+        # record path (plan/verify.PlanViolation carries the kind tag)
         self.last_plan_violations: List[str] = []
+        # the resource analyzer's full report for the most recent plan
+        # build (None while resourceAnalysis is disabled)
+        self.last_resource_report = None
         # multi-host bring-up FIRST — the coordination service must join
         # before any backend touch (reference: driver ships conf and
         # executors announce themselves before GPU init, Plugin.scala:
@@ -110,6 +117,11 @@ class TpuSession:
         self.scheduler.shutdown()
         TpuSemaphore.shutdown()
         SpillFramework.shutdown()
+        # symmetric with the semaphore/spill singletons: a later session
+        # must size its budget from ITS conf — without this, a test
+        # session's hbm.sizeOverride leaks into every session that
+        # follows in the process
+        TpuDeviceManager.shutdown()
         with TpuSession._lock:
             if TpuSession._active is self:
                 TpuSession._active = None
@@ -171,8 +183,61 @@ class TpuSession:
             # verifier skipped: clear rather than carry a previous
             # query's violations into this plan's introspection
             self.last_plan_violations = []
+        if self.conf.get(C.RESOURCE_ANALYSIS):
+            from spark_rapids_tpu.plan.resources import (
+                ResourceAnalysisError,
+                check_resources,
+            )
+
+            # plan-time resource admission (raises per failOnViolation);
+            # the report and its violations are recorded even when the
+            # check raises — same contract as the plan verifier above
+            try:
+                report = check_resources(final, self.conf,
+                                         device_manager=self.device_manager)
+            except ResourceAnalysisError as e:
+                self.last_resource_report = e.report
+                self.last_plan_violations = (
+                    list(self.last_plan_violations)
+                    + list(e.report.violations))
+                raise
+            except Exception:  # noqa: BLE001 - estimator is best-effort
+                # an internal estimator bug must not abort the query: the
+                # analyzer only OBSERVES unless a real violation trips
+                # failOnViolation — run without a report or hints
+                log.warning("resource analysis failed; running without "
+                            "admission hints", exc_info=True)
+                self.last_resource_report = None
+            else:
+                self.last_resource_report = report
+                if report.violations:
+                    self.last_plan_violations = (
+                        list(self.last_plan_violations)
+                        + list(report.violations))
+                self._apply_resource_hints(report)
+        else:
+            self.last_resource_report = None
+            # a previous query's admission weight / spill reserve must not
+            # outlive the analysis that produced it
+            TpuSemaphore.get().set_query_weight(1)
+            fw = SpillFramework.get()
+            if fw is not None:
+                fw.set_plan_hint(0.0, None)
         self.plan_capture.record(final)
         return final
+
+    def _apply_resource_hints(self, report) -> None:
+        """Forward the static analysis to the runtime admission paths: the
+        semaphore learns how many permits one task of this query should
+        hold (heavy plans admit fewer concurrent tasks), and the spill
+        framework learns how much transient headroom the plan is predicted
+        to need (docs/static-analysis.md)."""
+        sem = TpuSemaphore.get()
+        sem.set_query_weight(report.admission_weight(sem.max_concurrent))
+        fw = SpillFramework.get()
+        if fw is not None:
+            fw.set_plan_hint(report.spill_pressure,
+                             report.per_task_peak_bytes)
 
     def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
         from spark_rapids_tpu.plan.fusion import fuse_stages
@@ -189,6 +254,9 @@ class TpuSession:
         if explain_out:
             parts.append("== TPU tagging ==\n" + explain_out[0])
         parts.append("== Final plan ==\n" + explain_string(final))
+        # static-analysis sections render in a FIXED order after the plan
+        # tree: verification, then resources (tests/test_plan_resources.py
+        # pins the golden layout)
         if self.conf.get(C.PLAN_VERIFY):
             from spark_rapids_tpu.plan.verify import verify_plan
 
@@ -196,6 +264,12 @@ class TpuSession:
             parts.append("== Plan verification ==\n" + (
                 "OK" if not violations
                 else "\n".join(f"! {v}" for v in violations)))
+        if self.conf.get(C.RESOURCE_ANALYSIS):
+            from spark_rapids_tpu.plan.resources import analyze_plan
+
+            report = analyze_plan(final, self.conf,
+                                  device_manager=self.device_manager)
+            parts.append("== Resource analysis ==\n" + report.render())
         return "\n".join(parts)
 
     def _exec_context(self) -> ExecContext:

@@ -84,6 +84,20 @@ class MetricsMap:
 # instead (session.execute_batches -> session.last_query_metrics).
 _DISPATCHES = Metric(DEVICE_DISPATCHES)
 
+# measurement hook invoked after every record_dispatch (None = disabled).
+# Used by TpuDeviceManager's live-bytes peak sampler: dispatches are the
+# engine's natural "device state changed" cadence, so sampling here catches
+# the high-water mark without instrumenting every allocation site.
+_DISPATCH_HOOK = None
+
+
+def set_dispatch_hook(fn) -> None:
+    """Install (or clear, with None) the post-dispatch measurement hook.
+    The hook runs on the dispatching thread with no arguments; keep it
+    cheap — it fires on every device dispatch while installed."""
+    global _DISPATCH_HOOK
+    _DISPATCH_HOOK = fn
+
 
 def record_dispatch(n: int = 1) -> None:
     """Count a device program launch (jitted kernel invocation). Called at
@@ -91,6 +105,9 @@ def record_dispatch(n: int = 1) -> None:
     kernels and the batch gather/compact helpers — NOT per XLA executable
     internals; the unit is 'host->device dispatches the engine issued'."""
     _DISPATCHES.add(n)
+    hook = _DISPATCH_HOOK
+    if hook is not None:
+        hook()
 
 
 def dispatch_count() -> int:

@@ -1,3 +1,5 @@
+# tpulint: stdout-protocol -- worker speaks the JSON-line result
+# protocol on stdout; the parent test parses it
 """Worker for the 2-process distributed test: joins the coordination
 service, builds the 8-device global mesh (4 virtual CPU devices per
 process), runs the flagship distributed agg step SPMD, and prints a JSON
@@ -6,6 +8,14 @@ line with replicated results. Run via tests/test_distributed.py."""
 import json
 import os
 import sys
+
+
+def _masked_sum(s, v):
+    # jnp imported lazily: jax must not initialize before the
+    # distributed service joins (main() orders that explicitly)
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.where(v, s, 0))
 
 
 def main() -> None:
@@ -37,12 +47,17 @@ def main() -> None:
     step = distributed_agg_step(mesh, n_shards, cap, bucket_cap)
     fkeys, fsums, fvalid, total_groups = step(ks, vs, vd)
 
-    # replicated global checksum over the sharded outputs
+    # replicated global checksum over the sharded outputs; cached per
+    # mesh so a retried step reuses the compiled program
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    checksum = jax.jit(
-        lambda s, v: jnp.sum(jnp.where(v, s, 0)),
-        out_shardings=NamedSharding(mesh, P()))(fsums, fvalid)
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    ck = get_or_build(
+        ("distributed_worker.checksum", tuple(mesh.shape.items())),
+        lambda: jax.jit(_masked_sum,
+                        out_shardings=NamedSharding(mesh, P())))
+    checksum = ck(fsums, fvalid)
     groups = int(np.asarray(total_groups.addressable_data(0))[0])
     print(json.dumps({
         "pid": pid,

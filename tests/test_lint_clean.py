@@ -22,6 +22,17 @@ def test_package_and_docs_lint_clean():
         f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings)
 
 
+def test_tools_and_tests_lint_clean():
+    """tools/ and tests/ are gated too: CLI emitters carry the
+    stdout-protocol file directive, lint fixtures carry statement-level
+    conf-key waivers — everything else must hold to the same rules as
+    the package."""
+    findings = lint_paths([os.path.join(REPO, "tools"),
+                           os.path.join(REPO, "tests")])
+    assert not findings, "tpulint findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings)
+
+
 def test_linter_cli_is_invocable():
     from tools.tpulint.__main__ import main
 

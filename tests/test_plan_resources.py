@@ -1,0 +1,359 @@
+"""Plan-time resource analyzer (plan/resources.py): golden EXPLAIN
+layout, admission (OOM_HAZARD fail/observe, SPILL_LIKELY advisory),
+runtime hint wiring (semaphore weight, spill reserve), and estimator
+accuracy against the engine's own instrumentation — predicted device
+dispatches vs the deviceDispatches metric and predicted peak HBM vs the
+device manager's live-bytes high-water mark (docs/static-analysis.md)."""
+
+import re
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.utils import metrics as M
+
+RA_FAIL = "rapids.tpu.sql.resourceAnalysis.failOnViolation"
+RA_BUDGET = "rapids.tpu.sql.resourceAnalysis.hbmBudgetBytes"
+RA_ENABLED = "rapids.tpu.sql.resourceAnalysis.enabled"
+FUSION = "rapids.tpu.sql.fusion.enabled"
+
+
+@pytest.fixture()
+def session():
+    s = srt.new_session()
+    yield s
+    s.stop()
+
+
+def _small_df(s, n=100, parts=2):
+    return s.createDataFrame(
+        {"a": np.arange(n, dtype=np.int64),
+         "b": np.arange(n, dtype=np.float64)},
+        [("a", "long"), ("b", "double")], num_partitions=parts)
+
+
+def _scanform(s):
+    return (_small_df(s).filter(F.col("a") > 10)
+            .withColumn("c", F.col("a") + 1).select("c"))
+
+
+def _cross(s, n=600):
+    left = s.createDataFrame({"a": np.arange(n, dtype=np.int64)},
+                             [("a", "long")], num_partitions=1)
+    right = s.createDataFrame({"b": np.arange(n, dtype=np.int64)},
+                              [("b", "long")], num_partitions=1)
+    return left.crossJoin(right)
+
+
+def _normalize(text: str) -> str:
+    """Strip process-global counters (expr ids, fusion stage ids) so the
+    golden string survives running after other tests."""
+    text = re.sub(r"#\d+", "#N", text)
+    text = re.sub(r"TpuFusedStage\(\d+\)", "TpuFusedStage(S)", text)
+    return re.sub(r"\*\(\d+\)", "*(S)", text)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN: deterministic section order + golden layout
+# ---------------------------------------------------------------------------
+GOLDEN_SCANFORM = """\
+== TPU tagging ==
+* CpuProjectExec
+  * CpuProjectExec
+    * CpuFilterExec
+      ! HostScanExec <- no TPU rule for exec HostScanExec
+== Final plan ==
+DeviceToHostExec
+  TpuFusedStage(S)[Filter->Project->Project]
+    *(S) TpuProjectExec
+      *(S) TpuProjectExec
+        *(S) TpuFilterExec
+          HostToDeviceExec
+            HostScan[2 parts]
+== Plan verification ==
+OK
+== Resource analysis ==
+peak HBM: 0B..3.4KiB (budget 256.0MiB, concurrency 2)
+device dispatches: 6..6 (exact)
+jit shape-bucket cache keys: 1
+      TpuFusedStage(S)[Filter->Project->Project]: rows=[0, 90] \
+resident~3.4KiB dispatches=[6, 6]
+violations: none"""
+
+
+def test_explain_golden_string(session):
+    session.conf.set(RA_BUDGET, 256 << 20)
+    q = _scanform(session)
+    assert _normalize(session.explain_plan(q._plan)) == GOLDEN_SCANFORM
+
+
+def test_explain_sections_ordered_and_stable(session):
+    q = _scanform(session)
+    text = session.explain_plan(q._plan)
+    order = [text.index("== Final plan =="),
+             text.index("== Plan verification =="),
+             text.index("== Resource analysis ==")]
+    assert order == sorted(order)
+    # the static-analysis sections always render AFTER the plan tree
+    assert text.index("HostScan[2 parts]") < order[1]
+    assert text == session.explain_plan(q._plan)  # deterministic
+
+
+def test_explain_without_analysis_has_no_section(session):
+    session.conf.set(RA_ENABLED, False)
+    q = _scanform(session)
+    text = session.explain_plan(q._plan)
+    assert "== Resource analysis ==" not in text
+    assert "== Plan verification ==" in text
+    q.collect()
+    assert session.last_resource_report is None
+
+
+# ---------------------------------------------------------------------------
+# OOM_HAZARD admission: fail-on-violation vs observe
+# ---------------------------------------------------------------------------
+def test_over_budget_plan_raises_before_execution(session):
+    from spark_rapids_tpu.plan.resources import ResourceAnalysisError
+
+    session.conf.set(RA_BUDGET, 1 << 20)  # 1 MiB
+    session.conf.set(RA_FAIL, True)
+    q = _cross(session)
+    before = M.dispatch_count()
+    with pytest.raises(ResourceAnalysisError) as exc:
+        q.collect()
+    # plan-time rejection: not one device program was dispatched
+    assert M.dispatch_count() == before
+    kinds = {v.kind for v in session.last_plan_violations}
+    assert "OOM_HAZARD" in kinds
+    assert session.last_resource_report is not None
+    assert any(v.kind == "OOM_HAZARD" for v in exc.value.violations)
+
+
+def test_over_budget_plan_observed_when_fail_off(session):
+    session.conf.set(RA_BUDGET, 1 << 20)
+    session.conf.set(RA_FAIL, False)  # the default
+    q = _cross(session, n=600)
+    rows = q.collect()
+    assert len(rows) == 600 * 600
+    kinds = {v.kind for v in session.last_plan_violations}
+    assert "OOM_HAZARD" in kinds
+    assert "OOM_HAZARD" in session.explain_plan(q._plan)
+
+
+def test_spill_likely_is_always_advisory(session):
+    # pick a budget between the analyzer's certain floor and its
+    # pessimistic ceiling: SPILL_LIKELY, which must never raise
+    session.conf.set(RA_FAIL, True)
+    q = _scanform(session)
+    q.collect()
+    rep = session.last_resource_report
+    assert rep.peak_bytes.lo == 0 and rep.peak_bytes.hi > 1
+    session.conf.set(RA_BUDGET, int(rep.peak_bytes.hi) - 1)
+    rows = _scanform(session).collect()  # does not raise
+    assert len(rows) == 89
+    kinds = {v.kind for v in session.last_plan_violations}
+    assert kinds == {"SPILL_LIKELY"}
+
+
+# ---------------------------------------------------------------------------
+# runtime hint wiring: semaphore admission weight + spill reserve
+# ---------------------------------------------------------------------------
+def test_heavy_plan_widens_semaphore_weight_and_spill_reserve(session):
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    session.conf.set(RA_BUDGET, 1 << 20)
+    _cross(session).collect()
+    sem = TpuSemaphore.get()
+    # a plan predicted to blow the budget serializes: one task holds
+    # every permit
+    assert sem.query_weight == sem.max_concurrent
+    fw = SpillFramework.get()
+    assert fw.watermark.plan_reserve > 0
+
+    # a light plan under a huge budget restores full concurrency and
+    # releases the transient reserve
+    session.conf.set(RA_BUDGET, 1 << 40)
+    _scanform(session).collect()
+    assert sem.query_weight == 1
+    assert fw.watermark.plan_reserve == 0
+
+
+def test_disabling_analysis_resets_stale_hints(session):
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    session.conf.set(RA_BUDGET, 1 << 20)
+    _cross(session).collect()  # heavy: weight + reserve applied
+    sem = TpuSemaphore.get()
+    assert sem.query_weight > 1
+    session.conf.set(RA_ENABLED, False)
+    _scanform(session).collect()
+    assert sem.query_weight == 1
+    assert SpillFramework.get().watermark.plan_reserve == 0
+
+
+def test_interval_arithmetic_never_produces_nan():
+    """0 x inf must be 0 (an exactly-empty side empties the product) —
+    the float NaN would poison every downstream comparison and crash
+    _bucket at plan time."""
+    from spark_rapids_tpu.plan.resources import INF, Interval
+
+    prod = Interval.exact(0).mul(Interval(0, INF))
+    assert (prod.lo, prod.hi) == (0, 0)
+    scaled = Interval(0, INF).scale(0)
+    assert (scaled.lo, scaled.hi) == (0, 0)
+
+
+def test_empty_side_join_with_unbounded_side_plans_cleanly(session):
+    """End-to-end NaN regression: cross join an exactly-empty relation
+    against one whose row bound the analyzer cannot box."""
+    import numpy as np
+
+    empty = session.createDataFrame(
+        {"a": np.array([], dtype=np.int64)}, [("a", "long")],
+        num_partitions=1)
+    other = session.createDataFrame(
+        {"b": np.arange(10, dtype=np.int64)}, [("b", "long")],
+        num_partitions=1)
+    q = empty.crossJoin(other)
+    assert q.collect() == []
+    rep = session.last_resource_report
+    assert rep is not None
+    assert rep.peak_bytes.hi == rep.peak_bytes.hi  # not NaN
+
+
+def test_unbounded_dispatch_plan_renders(session, tmp_path):
+    """Derived-infinity regression: a file scan spends an unbounded
+    dispatch interval; arithmetic on inf produces NEW float objects, so
+    the report must handle inf by value, not identity — rendering and
+    analysis must not crash."""
+    path = str(tmp_path / "t.csv")
+    df = session.createDataFrame(
+        {"a": np.arange(50, dtype=np.int64)}, [("a", "long")])
+    df.write.mode("overwrite").option("header", True).csv(path)
+    q = (session.read.schema([("a", "int")]).option("header", True)
+         .csv(path).filter(F.col("a") > 5))
+    text = session.explain_plan(q._plan)
+    assert "== Resource analysis ==" in text
+    assert "device dispatches: " in text
+    rows = q.collect()
+    assert len(rows) == 44
+    rep = session.last_resource_report
+    assert rep.dispatches.hi == float("inf")
+    assert "inf" in rep.render()
+
+
+def test_admission_weight_scales_with_predicted_share():
+    from spark_rapids_tpu.plan.resources import (
+        INF,
+        Interval,
+        PlanResourceReport,
+    )
+
+    rep = PlanResourceReport(budget=1000, concurrency=4)
+    rep.peak_bytes = Interval(0, 400)  # 100/task vs 250/task share
+    assert rep.admission_weight(4) == 1
+    rep.peak_bytes = Interval(0, 2000)  # 500/task: needs 2 shares
+    assert rep.admission_weight(4) == 2
+    rep.peak_bytes = Interval(0, 100000)  # over budget: serialize
+    assert rep.admission_weight(4) == 4
+    rep.peak_bytes = Interval(0, INF)
+    assert rep.admission_weight(4) == 4
+
+
+# ---------------------------------------------------------------------------
+# estimator accuracy: dispatches (exact where claimed) and peak bytes
+# ---------------------------------------------------------------------------
+def _agg_shape(s):
+    rng = np.random.default_rng(7)
+    n = 300
+    df = s.createDataFrame(
+        {"k": rng.integers(0, 12, n).astype(np.int64),
+         "a": rng.integers(-1000, 1000, n).astype(np.int64),
+         "b": rng.random(n).astype(np.float32)},
+        [("k", "long"), ("a", "long"), ("b", "float")], num_partitions=3)
+    return (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+            .withColumn("c", F.col("a") * 2 + 1)
+            .groupBy("k").agg(F.sum("c").alias("s")))
+
+
+def test_dispatches_exact_on_fused_stage_shapes(session):
+    """The fusion-suite shapes: when the analyzer claims exactness its
+    prediction must EQUAL the deviceDispatches metric."""
+    for fusion, fn in ((True, _agg_shape), (True, _scanform),
+                      (False, _scanform)):
+        session.conf.set(FUSION, fusion)
+        fn(session).collect()
+        rep = session.last_resource_report
+        measured = session.last_query_metrics["deviceDispatches"]
+        assert rep.dispatches_exact, (fusion, fn.__name__, rep.render())
+        assert rep.dispatches.lo == rep.dispatches.hi == measured, \
+            (fusion, fn.__name__, repr(rep.dispatches), measured)
+
+
+def test_dispatches_sound_on_unfused_agg_shape(session):
+    """Unfused, a compacting filter feeds the aggregate batches whose
+    emptiness is data-dependent (the agg skips host-known-empty
+    batches), so the honest claim is an interval — which must contain
+    the measured count."""
+    session.conf.set(FUSION, False)
+    _agg_shape(session).collect()
+    rep = session.last_resource_report
+    measured = session.last_query_metrics["deviceDispatches"]
+    assert rep.dispatches.lo <= measured <= rep.dispatches.hi, \
+        (repr(rep.dispatches), measured)
+
+
+@pytest.mark.parametrize("qname", ["q1", "q5"])
+def test_tpch_peak_estimate_within_2x(session, qname):
+    """Predicted peak HBM within 2x of the measured live-bytes
+    high-water mark, and the predicted dispatch interval contains the
+    measured count — under the default (fused) engine config."""
+    from spark_rapids_tpu.benchmarks import tpch
+
+    tables = tpch.gen_tables(session, sf=0.002, num_partitions=3)
+    q = tpch.QUERIES[qname](tables)
+    mgr = session.device_manager
+    base = mgr.live_bytes()
+    mgr.start_live_peak_tracking()
+    q.collect()
+    measured = mgr.stop_live_peak_tracking() - base
+    rep = session.last_resource_report
+    assert measured > 0
+    pred = rep.peak_bytes.hi
+    assert measured / 2 <= pred <= measured * 2, \
+        (qname, pred, measured, pred / measured)
+    md = session.last_query_metrics["deviceDispatches"]
+    assert rep.dispatches.lo <= md <= rep.dispatches.hi, \
+        (qname, repr(rep.dispatches), md)
+
+
+def test_tpch_dispatch_interval_contains_measured_unfused(session):
+    from spark_rapids_tpu.benchmarks import tpch
+
+    session.conf.set(FUSION, False)
+    for qname in ("q1", "q5"):
+        tables = tpch.gen_tables(session, sf=0.0005, num_partitions=3)
+        tpch.QUERIES[qname](tables).collect()
+        rep = session.last_resource_report
+        md = session.last_query_metrics["deviceDispatches"]
+        assert rep.dispatches.lo <= md <= rep.dispatches.hi, \
+            (qname, repr(rep.dispatches), md)
+
+
+# ---------------------------------------------------------------------------
+# shared violation record path (plan/verify.PlanViolation)
+# ---------------------------------------------------------------------------
+def test_violations_share_one_record_type(session):
+    from spark_rapids_tpu.plan.verify import PlanViolation
+
+    session.conf.set(RA_BUDGET, 1 << 20)
+    _cross(session).collect()
+    assert session.last_plan_violations
+    for v in session.last_plan_violations:
+        assert isinstance(v, PlanViolation)
+        assert isinstance(v, str)  # formats anywhere a string does
+        assert v.kind == "OOM_HAZARD"

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.memory import spill as spill_mod
 from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
 from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.columnar.serde import (
@@ -264,10 +265,14 @@ class TestEndToEnd:
             def total():
                 return df.agg(F.sum("a").alias("s")).collect()[0][0]
 
+            events_before = spill_mod.SPILL_EVENTS
             assert total() == n * (n - 1) // 2
-            # the cached partitions exceed the budget: some must have spilled
-            assert fw.host_store.buffer_count() + \
-                fw.disk_store.buffer_count() > 0
+            # the cached partitions exceed the budget: spill must have
+            # engaged DURING the query. (The end-state tier split is
+            # timing-dependent — reads promote spilled entries back to
+            # device — so assert the monotone event counter, not where
+            # the buffers happen to sit when the query finishes.)
+            assert spill_mod.SPILL_EVENTS > events_before
             # second access re-materializes spilled cache entries
             assert total() == n * (n - 1) // 2
         finally:

@@ -257,6 +257,7 @@ KEYS = ConfKeyIndex(["rapids.tpu.sql.enabled",
 
 
 def test_conf_key_typo_flagged_and_valid_passes():
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
     src = ('GOOD = "rapids.tpu.sql.enabled"\n'
            'BAD = "rapids.tpu.sql.fusion.enable"\n')
     got = lint(src, path=COLD, keys=KEYS)
@@ -272,6 +273,7 @@ def test_conf_key_dynamic_and_prefix_mentions_pass():
 
 
 def test_conf_key_comment_and_docstring_scanned():
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
     src = ('"""Doc mentions rapids.tpu.sql.fusion.enalbed badly."""\n'
            "# and a comment typo: rapids.tpu.sql.enabeld\n")
     got = lint(src, path=COLD, keys=KEYS)
@@ -279,12 +281,28 @@ def test_conf_key_comment_and_docstring_scanned():
 
 
 def test_conf_key_pragma_suppresses():
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
     src = ('# tpulint: conf-key -- deliberately unknown, tested below\n'
            'BAD = "rapids.tpu.sql.not.a.key"\n')
     assert lint(src, path=COLD, keys=KEYS) == []
 
 
+def test_conf_key_pragma_covers_multiline_statement():
+    """A key buried inside a multi-line statement (a fixture string) is
+    waivable only by a pragma above the statement's first line — there
+    is no comment position inside a string literal."""
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
+    src = ('# tpulint: conf-key -- fixture: keys quoted for a test\n'
+           'SRC = ("a rapids.tpu.not.real key\\n"\n'
+           '       "b rapids.tpu.also.fake key\\n")\n'
+           'BAD = "rapids.tpu.outside.the.statement"\n')
+    got = lint(src, path=COLD, keys=KEYS)
+    assert [f.rule for f in got] == ["conf-key"]
+    assert got[0].line == 4
+
+
 def test_conf_key_markdown():
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
     md = ("The `rapids.tpu.sql.enabled` key is real.\n"
           "The `rapids.tpu.sql.fusion.enalbed` key is a typo.\n"
           "Waived: `rapids.tpu.bogus` <!-- tpulint: conf-key -->\n")
@@ -297,6 +315,7 @@ def test_conf_key_markdown_pragma_covers_heading_not_beyond():
     """In markdown a '#' line is a HEADING, not a comment continuation:
     a standalone pragma must waive the heading directly below it and
     nothing past it."""
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
     md = ("<!-- tpulint: conf-key -->\n"
           "# about rapids.tpu.waived.key\n"
           "and `rapids.tpu.still.a.typo` stays flagged\n")
@@ -309,6 +328,7 @@ def test_conf_key_real_registry_knows_new_keys():
     index = ConfKeyIndex.load()
     assert index.is_valid("rapids.tpu.sql.planVerify.enabled")
     assert index.is_valid("rapids.tpu.sql.planVerify.failOnViolation")
+    # tpulint: conf-key -- fixture: deliberate typo the test asserts on
     assert not index.is_valid("rapids.tpu.sql.planVerify.enable")
 
 
@@ -351,6 +371,59 @@ def test_stdout_print_pragma_suppresses():
            "    # tpulint: stdout-print -- console API\n"
            "    print('table')\n")
     assert lint(src, path=COLD) == []
+
+
+def test_stdout_protocol_directive_allows_prints_only():
+    """The file directive for protocol emitters/CLIs: stdout-print off
+    for the whole file, every other rule still applies."""
+    src = ("# tpulint: stdout-protocol -- CLI: stdout is the report\n"
+           "import jax\n"
+           "def emit(x):\n"
+           "    print('{\"row\": 1}')\n"
+           "    return jax.device_get(x)\n")
+    assert rules_of(lint(src)) == ["host-sync"]
+
+
+def test_stdout_protocol_directive_not_stale():
+    src = ("# tpulint: stdout-protocol -- JSON-line worker\n"
+           "print('{}')\n")
+    assert lint(src, path=COLD) == []
+
+
+# ---------------------------------------------------------------------------
+# untracked-alloc
+# ---------------------------------------------------------------------------
+def test_untracked_alloc_flagged_in_hot_path():
+    src = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    return jnp.zeros((n,), jnp.int32)\n")
+    got = lint(src)
+    assert "untracked-alloc" in rules_of(got)
+    assert any(f.line == 3 for f in got if f.rule == "untracked-alloc")
+
+
+def test_untracked_alloc_not_flagged_inside_trace():
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x + jnp.zeros((8,), jnp.int32)\n")
+    assert lint(src) == []
+
+
+def test_untracked_alloc_not_flagged_outside_hot_path():
+    src = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    return jnp.ones((n,), jnp.int32)\n")
+    assert "untracked-alloc" not in rules_of(lint(src, path=COLD))
+
+
+def test_untracked_alloc_pragma_suppresses():
+    src = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    # tpulint: eager-jnp, untracked-alloc -- tiny staging val\n"
+           "    return jnp.zeros((8,), bool)\n")
+    assert lint(src) == []
 
 
 # ---------------------------------------------------------------------------

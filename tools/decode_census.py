@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- census CLI: stdout is the report
 """Dispatch census of the parquet device-decode bench query (bench.py
 --decode shape): 4M rows x 3 int cols, snappy v1 dictionary pages, 8 row
 groups. Attributes the device tier's measured 12x loss to host decode

@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- census CLI: stdout is the report
 """Attribute eager jax dispatches / host syncs / uploads to repo call sites.
 
 Runs one suite query on the CPU backend (dispatch counts are

@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- census CLI: stdout is the report
 """Attribute eager (non-jit) jax primitive dispatches and device_get calls
 to engine call sites for one suite query on the CPU backend.
 

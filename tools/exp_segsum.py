@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- experiment CLI: stdout is the report
 """One-off experiment: race segment-reduction + sort strategies on the real
 chip to decide the int64 mitigation (VERDICT r2 weak #5 / next #6).
 

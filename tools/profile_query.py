@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- profiler CLI: stdout is the report
 """Profile one suite query through the engine (CPU backend).
 
 Usage: python tools/profile_query.py [suite] [qname] [sf] [--oracle]

@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- census CLI: stdout is the report
 """Dispatch census of the shuffle bench query (bench.py --shuffle shape):
 hash-repartition 4M rows from 8 map partitions into 16 targets, then
 count(*). Reports eager ops / syncs / jit calls per steady-state iteration

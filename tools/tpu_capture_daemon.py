@@ -1,3 +1,5 @@
+# tpulint: stdout-protocol -- daemon speaks the JSON-line capture
+# protocol on stdout by design
 """Opportunistic real-TPU capture: probe the (flaky) axon tunnel, and on
 each healthy window run the bench captures in priority order, writing
 session artifacts. Run from the repo root:

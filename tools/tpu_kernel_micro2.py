@@ -1,3 +1,5 @@
+# tpulint: stdout-protocol -- micro-bench worker: JSON-line
+# progress protocol on stdout
 """On-chip kernel microbench, generation 2: the q1-shaped suspects.
 
 Round-4's stage microbench (tools/tpu_stage_micro.py) only measured int32

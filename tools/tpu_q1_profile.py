@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- profiler CLI: stdout is the report
 """On-chip cProfile of one TPC-H query at SF1: attributes steady-state
 wall-clock to fences (device_get), uploads (device_put), dispatch, and
 Python glue. Uses the SAME persistent compile cache as bench.py and the

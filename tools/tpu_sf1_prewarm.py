@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- prewarm CLI: stdout is the report
 """Run the full TPC-H SF1 suite once on the real chip, populating the SAME
 persistent compile cache bench.py's suite worker uses (.jax_cache/<platform>),
 and record per-query warmup (compile-inclusive) + best-of-2 steady times.

@@ -1,3 +1,4 @@
+# tpulint: stdout-protocol -- micro-bench CLI: stdout is the report
 """On-chip stage microbench: which stage bounds the flagship pipeline?
 
 Times, for n rows of int32/float32 on the live backend: raw HBM copy,

@@ -24,9 +24,22 @@ the line above; `-- reason` after the rule names documents the waiver):
   cpu-oracle  jax/jnp usage inside the CPU oracle path (functions named
               cpu_* / classes Cpu*): the oracle must stay an independent
               numpy engine or equivalence tests prove nothing.
+  untracked-alloc  a direct jnp.zeros/ones/empty/full (or *_like)
+              allocation in a hot-path file OUTSIDE any jit trace: the
+              buffer lands in HBM without memory/device_manager
+              accounting — the spill watermark cannot see it, so enough
+              of these OOM the device invisibly. Allocate inside the
+              traced program (XLA-managed) or register the batch with
+              the spill framework; tiny fixed-size staging values get a
+              justified pragma.
   stdout-print  print() to stdout inside the package: workers speak a
               JSON-line protocol on stdout (bench.py, daemons); stray
-              prints corrupt it. Print to sys.stderr instead.
+              prints corrupt it. Print to sys.stderr instead. Files
+              whose stdout IS their interface — protocol emitters and
+              CLI tools under tools/ — declare `# tpulint:
+              stdout-protocol` once (a file directive like
+              traced-helpers): stdout-print is disabled for that file,
+              every other rule still applies.
   pragma      tpulint pragma hygiene: unknown rule name, or a pragma
               that suppresses nothing (stale waiver).
 """
@@ -47,8 +60,17 @@ RULES = (
     "conf-key",
     "cpu-oracle",
     "stdout-print",
+    "untracked-alloc",
     "pragma",
 )
+
+# jnp constructors that materialize a NEW device buffer sized by their
+# arguments (the untracked-alloc rule's targets); asarray/dtype staging
+# wraps existing host data and is handled by eager-jnp's allowances
+_ALLOC_FNS = {
+    "zeros", "ones", "empty", "full",
+    "zeros_like", "ones_like", "empty_like", "full_like",
+}
 
 # jnp constructors that only stage host scalars/arrays as device operands
 # (necessary at every kernel boundary; not an eager compute dispatch)
@@ -143,8 +165,12 @@ class _Pragmas:
         # file directive for kernel-helper libraries whose functions are
         # called INSIDE jit traces from other modules (cross-module
         # tracedness a single-file pass cannot see): disables eager-jnp
-        # only — host-sync and the rest still apply
+        # and untracked-alloc (allocations inside traced helpers are
+        # XLA-managed) — host-sync and the rest still apply
         self.traced_helpers = False
+        # file directive for protocol emitters / CLI tools whose stdout
+        # IS the interface: disables stdout-print only
+        self.stdout_protocol = False
         rx = _MD_PRAGMA_RE if md else _PRAGMA_RE
         # suppression pragmas must be REAL comment tokens: a pragma quoted
         # in a docstring/string literal is documentation, and treating it
@@ -170,6 +196,10 @@ class _Pragmas:
                 self.traced_helpers = True
                 self.used.add(i)
                 names.discard("traced-helpers")
+            if "stdout-protocol" in names:
+                self.stdout_protocol = True
+                self.used.add(i)
+                names.discard("stdout-protocol")
             if not live:
                 continue  # quoted pragma text: inert
             unknown = names - set(RULES)
@@ -295,11 +325,13 @@ class _TraceIndex:
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, trace: _TraceIndex,
                  conf_keys: Optional["ConfKeyIndex"],
-                 traced_helpers: bool = False):
+                 traced_helpers: bool = False,
+                 stdout_protocol: bool = False):
         self.path = path
         self.hot = is_hot_path(path)
         self.trace = trace
         self.traced_helpers = traced_helpers
+        self.stdout_protocol = stdout_protocol
         self.conf_keys = conf_keys
         self.scope: List[str] = []  # enclosing def/class names
         self.scope_kinds: List[str] = []  # 'class' or 'func', parallel
@@ -384,7 +416,8 @@ class _Visitor(ast.NodeVisitor):
                        "must stay an independent numpy engine")
 
         # stdout-print
-        if name == "print" and not self._prints_to_stderr(node):
+        if name == "print" and not self.stdout_protocol and \
+                not self._prints_to_stderr(node):
             self._flag(node, "stdout-print",
                        "print() to stdout inside the package; stdout "
                        "carries the workers' JSON-line protocol — write "
@@ -416,6 +449,7 @@ class _Visitor(ast.NodeVisitor):
                 self._check_host_sync(node, name, tail)
                 if not self.traced_helpers:
                     self._check_eager_jnp(node, name, tail)
+                    self._check_untracked_alloc(node, name, tail)
             elif name in ("jax.device_get", "device_get"):
                 self._flag(node, "host-sync",
                            "jax.device_get inside a jit-traced function "
@@ -456,6 +490,16 @@ class _Visitor(ast.NodeVisitor):
                        f"{name}() outside any jit-traced function "
                        "dispatches one un-fused kernel per call per "
                        "batch; move it into the traced program")
+
+    def _check_untracked_alloc(self, node: ast.Call, name: str,
+                               tail: str) -> None:
+        if name.startswith("jnp.") and tail in _ALLOC_FNS:
+            self._flag(node, "untracked-alloc",
+                       f"{name}() outside any jit trace allocates HBM "
+                       "that memory/device_manager accounting cannot "
+                       "see (the spill watermark never learns of it); "
+                       "allocate inside the traced program or register "
+                       "the batch with the spill framework")
 
     @staticmethod
     def _looks_device_valued(arg: ast.AST) -> bool:
@@ -527,14 +571,21 @@ class ConfKeyIndex:
 
 
 def _scan_conf_keys(source: str, path: str, index: ConfKeyIndex,
-                    pragmas: _Pragmas) -> List[Finding]:
+                    pragmas: _Pragmas,
+                    stmt_start: Optional[Dict[int, int]] = None
+                    ) -> List[Finding]:
     out: List[Finding] = []
     for ln, text in enumerate(source.splitlines(), start=1):
         for m in _KEY_RE.finditer(text):
             token = m.group(0).rstrip(".")
             if index.is_valid(token):
                 continue
-            if pragmas.suppresses(ln, "conf-key"):
+            # a key inside a multi-line statement (a lint-fixture string,
+            # a wrapped message) is waivable by a pragma covering the
+            # statement's first line — the only comment position that
+            # exists for content buried in a string literal
+            if pragmas.suppresses(ln, "conf-key",
+                                  (stmt_start or {}).get(ln)):
                 continue
             out.append(Finding(
                 path, ln, "conf-key",
@@ -572,14 +623,16 @@ def lint_source(source: str, path: str,
         return [Finding(path, e.lineno or 1, "pragma",
                         f"cannot parse: {e.msg}")]
     visitor = _Visitor(path, _TraceIndex(tree), conf_keys,
-                       traced_helpers=pragmas.traced_helpers)
+                       traced_helpers=pragmas.traced_helpers,
+                       stdout_protocol=pragmas.stdout_protocol)
     visitor.visit(tree)
     stmt_start = _stmt_start_map(tree)
     findings = [f for f in visitor.findings
                 if not pragmas.suppresses(f.line, f.rule,
                                           stmt_start.get(f.line))]
     if conf_keys is not None:
-        findings.extend(_scan_conf_keys(source, path, conf_keys, pragmas))
+        findings.extend(_scan_conf_keys(source, path, conf_keys, pragmas,
+                                        stmt_start))
     findings.extend(pragmas.hygiene_findings())
     return sorted(findings, key=lambda f: (f.line, f.rule))
 

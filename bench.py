@@ -262,6 +262,7 @@ def _measure_dispatches(session, df) -> dict:
             out[f"dispatches_{label}"] = m.get("deviceDispatches", 0)
             if enabled:
                 out["fused_stages"] = m.get("fusedStages", 0)
+                out.update(_robustness_metrics(session))
             # analyzer prediction next to the measurement, so estimate
             # drift shows up in the bench trajectory (plan/resources.py)
             out.update({f"{k}_{label}": v for k, v in
@@ -269,6 +270,20 @@ def _measure_dispatches(session, df) -> dict:
     finally:
         session.conf.set(key, prior)
     return out
+
+
+def _robustness_metrics(session) -> dict:
+    """Per-query fault-tolerance counters of the LAST executed query
+    (engine/retry.py): nonzero values on a healthy run mean the retry
+    framework is firing where it should not — a regression the bench
+    trajectory must surface."""
+    m = session.last_query_metrics
+    return {
+        "retries": m.get("retries", 0),
+        "split_retries": m.get("splitRetries", 0),
+        "cpu_fallback_events": m.get("cpuFallbackEvents", 0),
+        "fetch_retries": m.get("fetchRetries", 0),
+    }
 
 
 def _resource_prediction(session) -> dict:
@@ -679,6 +694,10 @@ def _worker_suite(suite: str, mode: str, sf: float) -> None:
                     res["measured_peak_bytes"] = int(peak)
                     res["measured_dispatches"] = \
                         session.last_query_metrics.get("deviceDispatches", 0)
+                    # robustness accounting rides along so the perf
+                    # trajectory shows fault tolerance is not silently
+                    # costing throughput (all zero on a healthy run)
+                    res.update(_robustness_metrics(session))
                     resources[qname] = res
             if has_alarm:
                 # cancel BEFORE recording so a late alarm can't put the
@@ -1123,7 +1142,9 @@ def main() -> None:
         "probe_attempts": probes,
     }
     for k in ("sweep_s", "sweep_gbps", "plateau_rows", "hbm_frac",
-              "dispatches_fused", "dispatches_unfused", "fused_stages"):
+              "dispatches_fused", "dispatches_unfused", "fused_stages",
+              "retries", "split_retries", "cpu_fallback_events",
+              "fetch_retries"):
         if k in acc:
             result[k] = acc[k]
     # analyzer predictions ride along with the measured dispatch counts

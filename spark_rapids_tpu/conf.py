@@ -522,6 +522,118 @@ RANGE_SAMPLE_SIZE = _conf("rapids.tpu.sql.rangePartition.sampleSizePerPartition"
 ).integer(100)
 
 # ---------------------------------------------------------------------------
+# Execution-time fault tolerance (engine/retry.py, docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+RETRY_OOM_RETRIES = _conf("rapids.tpu.execution.retry.oomRetries").doc(
+    "Device re-dispatch attempts after a retryable OOM "
+    "(XLA RESOURCE_EXHAUSTED -> TpuRetryOOM): each attempt first spills "
+    "tracked device buffers via DeviceStore.synchronous_spill, then "
+    "re-dispatches. Exhaustion escalates to TpuSplitAndRetryOOM — "
+    "splittable operators (project/filter/fused stage) bisect the input "
+    "batch and process halves (reference: the RMM retry/split-retry "
+    "state machine the plugin wraps every GPU allocation in)."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(2)
+
+RETRY_TRANSIENT_RETRIES = _conf(
+    "rapids.tpu.execution.retry.transientRetries").doc(
+    "Re-dispatch attempts after a transient device error (XLA "
+    "ABORTED/UNAVAILABLE/INTERNAL -> TpuTransientDeviceError), with "
+    "exponential backoff and deterministic jitter between attempts."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(3)
+
+RETRY_MAX_SPLIT_DEPTH = _conf(
+    "rapids.tpu.execution.retry.maxSplitDepth").doc(
+    "Maximum bisection depth for split-and-retry: a batch OOMing after "
+    "every spill+retry attempt is halved recursively at most this many "
+    "times (2^depth pieces) before the operator gives up and degrades "
+    "to the CPU path."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(3)
+
+CPU_FALLBACK_ENABLED = _conf(
+    "rapids.tpu.execution.cpuFallback.enabled").doc(
+    "When an operator exhausts its device retries, re-execute the failed "
+    "unit of work through the CPU-oracle path instead of failing the "
+    "query: project/filter/fused stages fall back per batch; operators "
+    "with device-resident state (aggregate/join/sort/scan) fall back by "
+    "re-planning the whole query on the CPU engine. Every fallback "
+    "increments the cpuFallbackEvents metric."
+).boolean(True)
+
+CIRCUIT_BREAKER_ENABLED = _conf(
+    "rapids.tpu.execution.circuitBreaker.enabled").doc(
+    "Per-session device circuit breaker: after failureThreshold device "
+    "failures (retry exhaustions / query-level fallbacks), the breaker "
+    "opens and the remaining work routes straight to the CPU path — "
+    "batch-level device ops bypass the device and new queries plan on "
+    "the CPU engine — instead of burning retry budget against an "
+    "unhealthy device."
+).boolean(True)
+
+CIRCUIT_BREAKER_THRESHOLD = _conf(
+    "rapids.tpu.execution.circuitBreaker.failureThreshold").doc(
+    "Device failures (retry exhaustions, not individual retries) the "
+    "session tolerates before the circuit breaker opens."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(4)
+
+TASK_TIMEOUT_SECONDS = _conf("rapids.tpu.engine.taskTimeoutSeconds").doc(
+    "Wall-clock budget for one partition task; a pooled job whose task "
+    "exceeds it fails with a TaskFailedError(TaskTimeoutError) instead "
+    "of wedging the query (0 = disabled; single-partition jobs run "
+    "inline on the caller thread and are not covered). The wedged worker "
+    "thread cannot be interrupted — it keeps its pool slot and semaphore "
+    "permits until its device call returns — so the timeout error is "
+    "typed as a device failure: the query re-executes on the CPU engine "
+    "(which never touches the admission semaphore) and the circuit "
+    "breaker counts the failure."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(0.0)
+
+RETRY_BUDGET = _conf("rapids.tpu.engine.retryBudget").doc(
+    "Total task retries one query may spend across all of its jobs "
+    "(map stages, exchanges, reduce stages share the budget); once "
+    "exhausted further failures are terminal. Guards against a flaky "
+    "device turning a query into an unbounded retry storm."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(64)
+
+RETRY_BACKOFF_MS = _conf("rapids.tpu.engine.retryBackoffMs").doc(
+    "Base backoff in milliseconds between retry attempts (task retries "
+    "and transient-device re-dispatches): sleep = base * 2^attempt * "
+    "(0.5 + jitter) where jitter is a deterministic hash of the retry "
+    "identity — reproducible schedules, no thundering herd."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(5.0)
+
+# ---------------------------------------------------------------------------
+# Fault injection (utils/faultinject.py; the chaos-test substrate)
+# ---------------------------------------------------------------------------
+FAULT_INJECTION_ENABLED = _conf(
+    "rapids.tpu.test.faultInjection.enabled").doc(
+    "Enable the deterministic fault-injection harness: registered "
+    "execution sites (device dispatches, transfers, shuffle fetches) "
+    "consult a seeded PRF before running and raise the site's fault "
+    "kind when it fires. Results must stay identical to the CPU oracle "
+    "under every injected fault pattern (tests/test_faults.py)."
+).boolean(False)
+
+FAULT_INJECTION_SEED = _conf("rapids.tpu.test.faultInjection.seed").doc(
+    "Seed of the fault-injection PRF; the injection decision for "
+    "(site, invocation N) is a pure function of (seed, site, N), so a "
+    "run replays exactly under the same seed."
+).integer(0)
+
+FAULT_INJECTION_SITES = _conf("rapids.tpu.test.faultInjection.sites").doc(
+    "Comma-separated injection sites, each 'name' or 'name:kind' with "
+    "kind one of oom|dispatch|transfer|fetch ('*' = every registered "
+    "site at its default kind). Registered sites: see "
+    "spark_rapids_tpu.utils.faultinject.SITES / docs/fault-tolerance.md."
+).string("*")
+
+FAULT_INJECTION_RATE = _conf("rapids.tpu.test.faultInjection.rate").doc(
+    "Probability in [0,1] that an armed site injects on one invocation "
+    "(each retry re-rolls with a fresh invocation count, so rates < 1 "
+    "terminate; the CPU fallback backstops rate = 1)."
+).check(lambda v: None if 0.0 <= v <= 1.0 else "must be in [0,1]"
+        ).double(0.25)
+
+# ---------------------------------------------------------------------------
 # Static analysis (plan/verify.py, docs/static-analysis.md)
 # ---------------------------------------------------------------------------
 PLAN_VERIFY = _conf("rapids.tpu.sql.planVerify.enabled").doc(

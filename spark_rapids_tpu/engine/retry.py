@@ -1,0 +1,387 @@
+"""Execution-time fault tolerance: typed retryable errors + retry combinators.
+
+Reference parity: the plugin wraps every GPU allocation in a retry/OOM state
+machine (RmmRapidsRetryIterator.scala — `withRetry` / `withRetryNoSplit` /
+`splitAndRetry`, driven by RetryOOM / SplitAndRetryOOM thrown from the RMM
+failure callback) so device memory pressure never kills a query: tasks
+spill, retry, and bisect their input until it fits. XLA gives no allocation
+callback, so here the typed errors come from TRANSLATING backend runtime
+errors (TpuDeviceManager.translate_device_error maps RESOURCE_EXHAUSTED ->
+TpuRetryOOM, ABORTED/UNAVAILABLE -> TpuTransientDeviceError) and from the
+fault-injection harness (utils/faultinject.py), and the combinators wrap the
+engine's dispatch sites:
+
+- `with_retry(attempt, site)` — innermost: run one dispatch closure; on a
+  retryable OOM spill the device store (DeviceStore.synchronous_spill) and
+  re-dispatch; on a transient device error back off (exponential,
+  deterministic jitter) and re-dispatch. Exhaustion of OOM attempts
+  escalates to TpuSplitAndRetryOOM.
+- `split_and_retry(batch_fn, batch, site)` — exec-level for batch-wise
+  operators (project/filter/fused stage): catches the escalation and
+  bisects the input batch, processing halves recursively (the
+  splitSpillableInHalfByRows analog).
+- `device_op_with_fallback(...)` — split_and_retry + runtime graceful
+  degradation: when the device path is exhausted (or the circuit breaker
+  is open) the batch re-executes through the CPU-oracle function and the
+  result re-uploads; every fallback counts in cpuFallbackEvents.
+- `CircuitBreaker` — per-session: after N device failures the remaining
+  work routes to the CPU instead of failing the job (the per-op fallback
+  of the reference promoted to a runtime health policy).
+
+The normal path adds ZERO extra dispatches: `attempt` runs exactly once
+when nothing fails, so dispatch counts still match the plan-time resource
+analyzer's predictions (tests/test_plan_resources.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, List, Optional, TypeVar
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.utils import metrics as M
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# Typed error hierarchy (reference: RetryOOM / SplitAndRetryOOM /
+# CpuRetryOOM thrown by the RMM state machine)
+# ---------------------------------------------------------------------------
+class TpuRetryableError(RuntimeError):
+    """Base of every error the execution layer may retry."""
+
+
+class TpuRetryOOM(TpuRetryableError):
+    """Device memory exhausted; spill tracked buffers and re-dispatch."""
+
+
+class TpuSplitAndRetryOOM(TpuRetryOOM):
+    """OOM persisted through every spill+retry attempt: the caller should
+    bisect its input and process halves (only batch-wise operators can)."""
+
+
+class TpuTransientDeviceError(TpuRetryableError):
+    """A transient device/dispatch failure (XLA ABORTED/UNAVAILABLE, flaky
+    transport): re-dispatch after backoff, the input is intact."""
+
+
+# deterministic failure classes: retrying cannot change the outcome
+# (moved here from engine/scheduler so every layer classifies identically)
+NON_RETRYABLE = (TypeError, ValueError, AssertionError, NotImplementedError,
+                 KeyError, IndexError, AttributeError, ZeroDivisionError)
+
+
+def as_typed_error(e: BaseException) -> Optional[TpuRetryableError]:
+    """The typed view of an arbitrary execution error: already-typed errors
+    pass through; backend runtime errors translate via the device manager;
+    deterministic errors and everything else return None (not retryable
+    at the dispatch layer)."""
+    if isinstance(e, TpuRetryableError):
+        return e
+    if isinstance(e, NON_RETRYABLE):
+        return None
+    from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
+
+    return TpuDeviceManager.translate_device_error(e)
+
+
+def is_retryable_failure(e: BaseException) -> bool:
+    """Task-level classification (engine/scheduler._is_retryable): typed
+    retryable and fetch failures retry; deterministic classes and
+    plan/analysis errors fail fast; unknown runtime errors are treated as
+    transient — on a real cluster the cost of one wasted retry is far
+    below the cost of failing a query on an unclassified hiccup."""
+    from spark_rapids_tpu.engine.scheduler import FetchFailedError
+
+    if isinstance(e, (TpuRetryableError, FetchFailedError)):
+        return True
+    if isinstance(e, NON_RETRYABLE):
+        return False
+    # plan/analysis errors are deterministic wherever they're defined
+    if type(e).__name__ == "AnalysisError":
+        return False
+    return True
+
+
+def failure_is_device_rooted(e: BaseException) -> bool:
+    """Whether a failure (or anything on its cause chain) is a typed device
+    error or an exhausted shuffle fetch — the gate for query-level CPU
+    fallback. Fetch failures are not device-health signals in Spark terms,
+    but once the in-place map re-execution AND the task retry both gave up
+    the only alternative to the fallback is failing the job."""
+    from spark_rapids_tpu.engine.scheduler import FetchFailedError
+
+    seen = set()
+    node: Optional[BaseException] = e
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        if isinstance(node, FetchFailedError) or \
+                as_typed_error(node) is not None:
+            return True
+        node = node.__cause__ or node.__context__
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Retry policy (configured per query by session.execute_batches)
+# ---------------------------------------------------------------------------
+class RetryPolicy:
+    __slots__ = ("oom_retries", "transient_retries", "max_split_depth",
+                 "backoff_ms", "cpu_fallback")
+
+    def __init__(self, oom_retries: int = 2, transient_retries: int = 3,
+                 max_split_depth: int = 3, backoff_ms: float = 5.0,
+                 cpu_fallback: bool = True):
+        self.oom_retries = oom_retries
+        self.transient_retries = transient_retries
+        self.max_split_depth = max_split_depth
+        self.backoff_ms = backoff_ms
+        self.cpu_fallback = cpu_fallback
+
+
+_POLICY = RetryPolicy()
+
+
+def set_policy_from_conf(tpu_conf: "C.TpuConf") -> None:
+    """Refresh the process retry policy from the executing session's conf
+    (called at every query start, like conf.sync_int64_narrowing)."""
+    global _POLICY
+    _POLICY = RetryPolicy(
+        oom_retries=tpu_conf.get(C.RETRY_OOM_RETRIES),
+        transient_retries=tpu_conf.get(C.RETRY_TRANSIENT_RETRIES),
+        max_split_depth=tpu_conf.get(C.RETRY_MAX_SPLIT_DEPTH),
+        backoff_ms=tpu_conf.get(C.RETRY_BACKOFF_MS),
+        cpu_fallback=tpu_conf.get(C.CPU_FALLBACK_ENABLED),
+    )
+
+
+def policy() -> RetryPolicy:
+    return _POLICY
+
+
+def deterministic_jitter(*identity) -> float:
+    """[0,1) jitter as a pure function of the retry identity (site/task,
+    attempt): reproducible backoff schedules, no shared RNG state."""
+    h = zlib.crc32(repr(identity).encode("utf-8")) & 0xFFFFFFFF
+    return h / 4294967296.0
+
+
+def backoff_sleep(attempt: int, *identity) -> None:
+    base = _POLICY.backoff_ms
+    if base <= 0:
+        return
+    delay_ms = base * (2 ** attempt) * (0.5 + deterministic_jitter(
+        attempt, *identity))
+    time.sleep(delay_ms / 1000.0)
+
+
+def _spill_for_retry(site: str) -> int:
+    """Free device memory before a re-dispatch: synchronously spill tracked
+    device buffers down to half the store's current footprint (reference:
+    DeviceMemoryEventHandler.onAllocFailure -> synchronousSpill). Returns
+    bytes spilled (0 when no framework is up or nothing was unpinned)."""
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    fw = SpillFramework.get()
+    if fw is None:
+        return 0
+    store = fw.device_store
+    return store.synchronous_spill(store.current_size // 2)
+
+
+# ---------------------------------------------------------------------------
+# Combinators
+# ---------------------------------------------------------------------------
+def with_retry(attempt: Callable[[], T], site: str = "device") -> T:
+    """Run one dispatch closure with the OOM/transient retry state machine.
+
+    The fault-injection harness is consulted INSIDE the attempt loop, so an
+    injected fault consumes a retry exactly like a real one and every retry
+    re-rolls the (deterministic) injection decision. Non-retryable errors
+    propagate untouched on the first raise."""
+    from spark_rapids_tpu.utils import faultinject as FI
+
+    pol = _POLICY
+    oom_left = pol.oom_retries
+    transient_left = pol.transient_retries
+    attempt_no = 0
+    while True:
+        try:
+            FI.maybe_inject(site)
+            return attempt()
+        except Exception as e:  # noqa: BLE001 — classification boundary
+            typed = as_typed_error(e)
+            if typed is None:
+                raise
+            if isinstance(typed, TpuSplitAndRetryOOM):
+                # an inner wrapper already exhausted its OOM budget: do not
+                # multiply budgets, hand the escalation straight up
+                raise typed from e
+            if isinstance(typed, TpuRetryOOM):
+                if oom_left <= 0:
+                    raise TpuSplitAndRetryOOM(
+                        f"{site}: OOM persisted through "
+                        f"{pol.oom_retries} spill+retry attempts: {typed}"
+                    ) from e
+                oom_left -= 1
+                M.record_retry()
+                _spill_for_retry(site)
+            else:  # transient device error
+                if transient_left <= 0:
+                    if typed is e:
+                        raise
+                    raise typed from e
+                transient_left -= 1
+                M.record_retry()
+                backoff_sleep(attempt_no, site)
+            attempt_no += 1
+
+
+def split_batch_halves(batch):
+    """Bisect a device batch by rows (the splitSpillableInHalfByRows
+    analog). Compacts lazy batches first — we are on a failure path, the
+    row-count sync is the least of our costs."""
+    from spark_rapids_tpu.columnar.batch import (
+        ensure_compact,
+        slice_batch_host,
+    )
+
+    batch = ensure_compact(batch)
+    n = batch.host_rows()
+    if n <= 1:
+        raise TpuSplitAndRetryOOM(
+            f"cannot split a {n}-row batch any further")
+    mid = n // 2
+    return (slice_batch_host(batch, 0, mid),
+            slice_batch_host(batch, mid, n - mid), mid)
+
+
+def split_and_retry(batch_fn: Callable, batch, site: str = "device",
+                    row_offset: int = 0) -> List:
+    """Run `batch_fn(batch, row_offset)`; on an escalated OOM
+    (TpuSplitAndRetryOOM — the dispatch inside batch_fn already spent its
+    spill+retry budget under with_retry) bisect the batch and process the
+    halves recursively. `row_offset` tracks rows preceding each piece
+    within the ORIGINAL batch so positional expressions stay correct.
+    Returns the list of output batches in row order.
+
+    batch_fn must route its device dispatches through with_retry (the
+    naked-dispatch lint rule enforces this); wrapping again here would
+    multiply retry budgets and fault-injection rolls."""
+
+    def run(piece, off: int, depth: int) -> List:
+        try:
+            return [batch_fn(piece, off)]
+        except TpuSplitAndRetryOOM:
+            if depth >= _POLICY.max_split_depth:
+                raise
+            left, right, mid = split_batch_halves(piece)
+            M.record_split_retry()
+            return run(left, off, depth + 1) + run(right, off + mid,
+                                                   depth + 1)
+
+    return run(batch, row_offset, 0)
+
+
+def device_op_with_fallback(batch_fn: Callable, batch,
+                            cpu_fn: Optional[Callable], site: str,
+                            row_offset: int = 0) -> List:
+    """The full per-batch resilience stack for a batch-wise device operator:
+    circuit-breaker bypass -> split_and_retry -> CPU-oracle fallback.
+
+    `batch_fn(device_batch, row_offset) -> ColumnarBatch` is the device
+    path (dispatches internally guarded by with_retry); `cpu_fn(host_batch,
+    row_offset) -> HostColumnarBatch` is the oracle path for the same unit
+    of work (None = no per-batch fallback; exhaustion propagates for
+    query-level handling). Returns a list of device output batches."""
+    breaker = CircuitBreaker.get()
+    if cpu_fn is not None and _POLICY.cpu_fallback and breaker.is_open():
+        return [_run_cpu_fallback(cpu_fn, batch, row_offset)]
+    try:
+        return split_and_retry(batch_fn, batch, site=site,
+                               row_offset=row_offset)
+    except Exception as e:  # noqa: BLE001 — classification boundary
+        typed = as_typed_error(e)
+        if typed is None:
+            raise
+        breaker.record_failure()
+        if cpu_fn is None or not _POLICY.cpu_fallback:
+            raise
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s: device path exhausted retries (%s); re-executing the "
+            "batch on the CPU oracle", site, typed)
+        return [_run_cpu_fallback(cpu_fn, batch, row_offset)]
+
+
+def _run_cpu_fallback(cpu_fn: Callable, batch, row_offset: int):
+    from spark_rapids_tpu.columnar.batch import ensure_compact
+
+    M.record_cpu_fallback()
+    host = ensure_compact(batch).to_host()
+    return cpu_fn(host, row_offset).to_device()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (per-session: session.stop() resets it)
+# ---------------------------------------------------------------------------
+class CircuitBreaker:
+    """Counts device failures (retry exhaustions, not individual retries);
+    once `threshold` is reached the breaker opens and stays open for the
+    session — remaining batches bypass the device and remaining queries
+    plan on the CPU engine (rapids.tpu.execution.circuitBreaker.*)."""
+
+    _instance: Optional["CircuitBreaker"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, enabled: bool = True, threshold: int = 4):
+        self.enabled = enabled
+        self.threshold = max(1, threshold)
+        self._failures = 0
+        self._cv = threading.Lock()
+
+    @classmethod
+    def configure(cls, tpu_conf: "C.TpuConf") -> "CircuitBreaker":
+        """Refresh policy knobs from the session conf; the failure count
+        survives (the breaker is per-session, not per-query)."""
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            inst = cls._instance
+        with inst._cv:
+            inst.enabled = tpu_conf.get(C.CIRCUIT_BREAKER_ENABLED)
+            inst.threshold = max(
+                1, tpu_conf.get(C.CIRCUIT_BREAKER_THRESHOLD))
+        return inst
+
+    @classmethod
+    def get(cls) -> "CircuitBreaker":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def record_failure(self) -> bool:
+        """Count one device failure; returns True when the breaker is now
+        open."""
+        with self._cv:
+            self._failures += 1
+            return self.enabled and self._failures >= self.threshold
+
+    @property
+    def failures(self) -> int:
+        with self._cv:
+            return self._failures
+
+    def is_open(self) -> bool:
+        with self._cv:
+            return self.enabled and self._failures >= self.threshold

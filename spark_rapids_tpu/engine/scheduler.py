@@ -6,13 +6,22 @@ releases the TPU admission semaphore on completion, mirroring the
 completion-listener auto-release in GpuSemaphore.scala:101-161.
 
 Task failure behavior mirrors Spark's retry loop (reference: Spark task
-retry + lineage is the reference's whole failure story, SURVEY.md section 5),
-with the reference's failure taxonomy: shuffle-fetch failures
+retry + lineage is the reference's whole failure story, SURVEY.md section 5)
+with the typed taxonomy of engine/retry.py: shuffle-fetch failures
 (`FetchFailedError`, the RapidsShuffleFetchFailedException analog,
-shuffle/RapidsShuffleIterator.scala:237-330) and transient runtime errors
-retry up to `max_failures`; DETERMINISTIC errors (planning/type/user
+shuffle/RapidsShuffleIterator.scala:237-330) and typed/transient device
+errors retry up to `max_failures`; DETERMINISTIC errors (planning/type/user
 errors) fail fast on the first attempt — retrying them only doubles the
 cost of every real failure.
+
+Hardening (docs/fault-tolerance.md):
+- retries sleep with exponential backoff + deterministic jitter (a pure
+  function of (partition, attempt): reproducible, no thundering herd);
+- a per-query retry BUDGET bounds total retries across all of a query's
+  jobs (map stages, exchanges, reduces share it);
+- an optional per-task wall-clock timeout fails a pooled job whose task
+  wedges instead of hanging the query (the worker thread itself cannot be
+  interrupted — single-partition jobs run inline and are not covered).
 """
 
 from __future__ import annotations
@@ -21,6 +30,7 @@ import concurrent.futures as cf
 import threading
 from typing import Callable, Iterator, List, Optional, TypeVar
 
+from spark_rapids_tpu.engine import retry as R
 from spark_rapids_tpu.exec.transitions import current_task_id, set_task_id
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore
 
@@ -41,31 +51,64 @@ class TaskFailedError(RuntimeError):
 class FetchFailedError(RuntimeError):
     """A shuffle piece could not be materialized (reference:
     RapidsShuffleFetchFailedException -> Spark stage retry). Always
-    retryable."""
+    retryable; the exchange additionally re-executes the upstream map
+    partition in place (shuffle/exchange.py) before this surfaces."""
 
 
-# deterministic failure classes: retrying cannot change the outcome
-_NON_RETRYABLE = (TypeError, ValueError, AssertionError, NotImplementedError,
-                  KeyError, IndexError, AttributeError, ZeroDivisionError)
+class TaskTimeoutError(R.TpuTransientDeviceError, TimeoutError):
+    """A partition task exceeded rapids.tpu.engine.taskTimeoutSeconds.
+    Part of the typed DEVICE hierarchy (a wedged task on a device query is
+    a wedged dispatch until proven otherwise) so the query-level CPU
+    fallback and the circuit breaker engage — the session degrades to the
+    CPU engine, which never acquires the admission semaphore the zombie
+    worker may still hold."""
 
 
 def _is_retryable(e: BaseException) -> bool:
-    if isinstance(e, FetchFailedError):
-        return True
-    if isinstance(e, _NON_RETRYABLE):
-        return False
-    # plan/analysis errors are deterministic wherever they're defined
-    if type(e).__name__ == "AnalysisError":
-        return False
-    return True
+    # classification lives with the typed hierarchy (engine/retry.py) so
+    # the dispatch layer and the task layer can never disagree
+    return R.is_retryable_failure(e)
 
 
 class TaskScheduler:
-    def __init__(self, num_threads: int = 8, max_failures: int = 2):
+    def __init__(self, num_threads: int = 8, max_failures: int = 2,
+                 task_timeout_s: float = 0.0, retry_budget: int = 0):
         self.num_threads = max(1, num_threads)
         self.max_failures = max(1, max_failures)
+        self.task_timeout_s = max(0.0, task_timeout_s)
+        # 0 = unlimited (standalone schedulers in unit tests); sessions
+        # configure a real budget per query via configure()/begin_query()
+        self.retry_budget = max(0, retry_budget)
+        self._retries_spent = 0
+        self._budget_lock = threading.Lock()
         self._pool: Optional[cf.ThreadPoolExecutor] = None
         self._lock = threading.Lock()
+
+    def configure(self, tpu_conf) -> None:
+        """Refresh scheduler policy from the executing session's conf and
+        reset the per-query retry budget (called at query start)."""
+        from spark_rapids_tpu import conf as C
+
+        self.task_timeout_s = max(0.0, tpu_conf.get(C.TASK_TIMEOUT_SECONDS))
+        self.retry_budget = max(0, tpu_conf.get(C.RETRY_BUDGET))
+        self.begin_query()
+
+    def begin_query(self) -> None:
+        with self._budget_lock:
+            self._retries_spent = 0
+
+    def _try_spend_retry(self) -> bool:
+        """Reserve one retry from the query budget; False = exhausted."""
+        with self._budget_lock:
+            if self.retry_budget and self._retries_spent >= self.retry_budget:
+                return False
+            self._retries_spent += 1
+            return True
+
+    @property
+    def retries_spent(self) -> int:
+        with self._budget_lock:
+            return self._retries_spent
 
     def _ensure_pool(self) -> cf.ThreadPoolExecutor:
         with self._lock:
@@ -85,6 +128,10 @@ class TaskScheduler:
     def _run_task(self, pidx: int, fn: Callable[[int], T]) -> T:
         last: Optional[BaseException] = None
         for attempt in range(self.max_failures):
+            if attempt > 0:
+                # exponential backoff, jitter a pure function of the retry
+                # identity (docs/fault-tolerance.md)
+                R.backoff_sleep(attempt - 1, "task", pidx)
             with _next_task_id_lock:
                 task_id = next(_next_task_id)
             set_task_id(task_id)
@@ -98,7 +145,31 @@ class TaskScheduler:
                 set_task_id(None)
             if not _is_retryable(last):
                 raise TaskFailedError(pidx, attempt + 1, last) from last
+            if attempt + 1 < self.max_failures and \
+                    not self._try_spend_retry():
+                raise TaskFailedError(pidx, attempt + 1, last) from last
         raise TaskFailedError(pidx, self.max_failures, last) from last
+
+    def _result_with_timeout(self, fut: "cf.Future", pidx: int,
+                             futures: List["cf.Future"]) -> T:
+        if not self.task_timeout_s:
+            return fut.result()
+        try:
+            return fut.result(timeout=self.task_timeout_s)
+        except cf.TimeoutError:
+            for f in futures:
+                f.cancel()
+            # the wedged worker thread cannot be interrupted: it keeps its
+            # pool slot AND any semaphore permits until its device call
+            # eventually returns (only then does _run_task's finally
+            # release them). TaskTimeoutError is part of the typed device
+            # hierarchy precisely so the query-level CPU fallback engages
+            # — the CPU plan never touches the admission semaphore, so a
+            # wedged device cannot wedge the session with it.
+            raise TaskFailedError(
+                pidx, 1, TaskTimeoutError(
+                    f"partition task {pidx} exceeded "
+                    f"{self.task_timeout_s:.1f}s")) from None
 
     def run_job(self, num_partitions: int,
                 fn: Callable[[int], T]) -> List[T]:
@@ -110,7 +181,8 @@ class TaskScheduler:
         pool = self._ensure_pool()
         futures = [pool.submit(self._run_task, p, fn)
                    for p in range(num_partitions)]
-        return [f.result() for f in futures]
+        return [self._result_with_timeout(f, p, futures)
+                for p, f in enumerate(futures)]
 
     def run_job_iter(self, num_partitions: int,
                      fn: Callable[[int], T]) -> Iterator[T]:
@@ -120,3 +192,31 @@ class TaskScheduler:
                    for p in range(num_partitions)]
         for f in cf.as_completed(futures):
             yield f.result()
+
+
+def run_job_or_serial(scheduler: Optional[TaskScheduler],
+                      num_partitions: int,
+                      fn: Callable[[int], T]) -> List[T]:
+    """The one way an exec materializes partitions: the session scheduler
+    when one is in scope (task retries, budget, timeout, semaphore
+    auto-release), else the serial fallback below — so a scheduler-policy
+    change never needs to visit every exec's else-branch."""
+    if scheduler is not None:
+        return scheduler.run_job(num_partitions, fn)
+    return run_serial(num_partitions, fn)
+
+
+def run_serial(num_partitions: int, fn: Callable[[int], T]) -> List[T]:
+    """Serial fallback for execution paths with no scheduler in scope
+    (direct exec tests): runs each partition on the caller thread, ALWAYS
+    releasing the admission semaphore after each — without this, a partition
+    body that acquires and then raises would leak its permits forever on
+    the calling thread (the scheduler's completion-listener analog covers
+    only pooled tasks)."""
+    out: List[T] = []
+    for p in range(num_partitions):
+        try:
+            out.append(fn(p))
+        finally:
+            TpuSemaphore.get().release_if_necessary(current_task_id())
+    return out

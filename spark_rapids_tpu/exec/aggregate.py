@@ -42,6 +42,7 @@ from spark_rapids_tpu.columnar.batch import (
     physical_np_dtype,
 )
 from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.engine.retry import with_retry
 from spark_rapids_tpu.exec import rowkeys as RK
 from spark_rapids_tpu.exec.base import (
     CpuExec,
@@ -435,8 +436,12 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             # a tunneled backend)
             npdts = tuple(physical_np_dtype(dt) for _, _, dt in fixed)
             kern = _finalize_kernel(out_cap, npdts)
-            M.record_dispatch()
-            outs = kern([o for _, o, _ in fixed], np.int32(n_groups))
+
+            def _attempt():
+                M.record_dispatch()
+                return kern([o for _, o, _ in fixed], np.int32(n_groups))
+
+            outs = with_retry(_attempt, site="agg.finalize")
             for (si, _o, dt), (d, v) in zip(fixed, outs):
                 slots[si] = ColumnVector(dt, d, v)
         assert all(c is not None for c in slots)
@@ -541,8 +546,12 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     nc, self._build_merge_kernel(n_keys, lazy, nc))
             cols = [_col_to_colv(c) for c in batch.columns]
             kvr = [c.vrange for c in batch.columns[:n_keys]]
-            M.record_dispatch()
-            out = merge_kernel[0][1](cols, count_arg(batch))
+
+            def _attempt():
+                M.record_dispatch()
+                return merge_kernel[0][1](cols, count_arg(batch))
+
+            out = with_retry(_attempt, site="agg.merge")
             if lazy:
                 outs, num_groups = out
                 return self._lazy_batch(outs, num_groups, kvr)
@@ -583,8 +592,12 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     cols = [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
-                    M.record_dispatch()
-                    out = update_kernel[0][1](cols, count_arg(batch))
+
+                    def _attempt():
+                        M.record_dispatch()
+                        return update_kernel[0][1](cols, count_arg(batch))
+
+                    out = with_retry(_attempt, site="agg.update")
                     # keyed by the batch's (quantized) column vranges so the
                     # symbolic walk runs once per distinct range profile,
                     # not once per batch

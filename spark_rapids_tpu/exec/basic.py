@@ -24,6 +24,7 @@ from spark_rapids_tpu.columnar.batch import (
     slice_batch_host,
 )
 from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.engine.retry import device_op_with_fallback
 from spark_rapids_tpu.exec.base import (
     CpuExec,
     ExecContext,
@@ -136,16 +137,28 @@ class TpuProjectExec(TpuExec):
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         child_pb = self.children[0].execute(ctx)
         projector = self._projector
+        bound = self._bound
         total_time = self.metrics[M.TOTAL_TIME]
 
         def factory(pidx: int) -> Iterator[ColumnarBatch]:
             row_start = 0
             for batch in child_pb.iterator(pidx):
                 with M.trace_range("TpuProject", total_time):
-                    out = projector.project(batch, partition_id=pidx,
-                                            row_start=row_start)
+                    # OOM resilience: spill+retry happens inside the
+                    # projector's dispatch (engine/retry.with_retry); this
+                    # layer adds batch bisection and the per-batch CPU
+                    # oracle fallback — off is the row offset of a split
+                    # piece so positional expressions stay exact
+                    outs = device_op_with_fallback(
+                        lambda b, off: projector.project(
+                            b, partition_id=pidx, row_start=row_start + off),
+                        batch,
+                        lambda hb, off: cpu_project(
+                            bound, hb, partition_id=pidx,
+                            row_start=row_start + off),
+                        site="project")
                 row_start += batch.num_rows
-                yield out
+                yield from outs
 
         return PartitionedBatches(child_pb.num_partitions,
                                   lambda p: count_output(self.metrics, factory(p)))
@@ -226,14 +239,23 @@ class TpuFilterExec(TpuExec):
         else:
             lazy = False
 
+        bound = self._bound
+
         def factory(pidx: int) -> Iterator[ColumnarBatch]:
             row_start = 0
             for batch in child_pb.iterator(pidx):
                 with M.trace_range("TpuFilter", total_time):
-                    out = filt.apply(batch, partition_id=pidx,
-                                     row_start=row_start, lazy=lazy)
+                    outs = device_op_with_fallback(
+                        lambda b, off: filt.apply(
+                            b, partition_id=pidx,
+                            row_start=row_start + off, lazy=lazy),
+                        batch,
+                        lambda hb, off: cpu_filter(
+                            bound, hb, partition_id=pidx,
+                            row_start=row_start + off),
+                        site="filter")
                 row_start += batch.num_rows
-                yield out
+                yield from outs
 
         return PartitionedBatches(child_pb.num_partitions,
                                   lambda p: count_output(self.metrics, factory(p)))

@@ -136,10 +136,9 @@ class _CachedScanBase(PhysicalExec):
                         out.append(b)
                 return out
 
-            if ctx.scheduler is not None:
-                parts = ctx.scheduler.run_job(child_pb.num_partitions, mat)
-            else:
-                parts = [mat(p) for p in range(child_pb.num_partitions)]
+            from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+            parts = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
             with _LOCK:
                 cached = store.setdefault(self.logical_node, parts)
 
@@ -180,10 +179,9 @@ class TpuCachedScanExec(_CachedScanBase, TpuExec):
                         out.append(fw.add_device_batch(b))
                 return out
 
-            if ctx.scheduler is not None:
-                parts = ctx.scheduler.run_job(child_pb.num_partitions, mat)
-            else:
-                parts = [mat(p) for p in range(child_pb.num_partitions)]
+            from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+            parts = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
             with _LOCK:
                 cached = _DEVICE_CACHE.setdefault(self.logical_node, parts)
                 if cached is parts:

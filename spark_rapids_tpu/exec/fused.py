@@ -272,6 +272,17 @@ class TpuFusedStageExec(TpuExec):
 
                 lazy = fence_cost_ms() >= LAZY_FENCE_THRESHOLD_MS
 
+        # per-batch CPU replay (runtime graceful degradation) is possible
+        # exactly when the stage is one variant with no limit and every
+        # member is a plain filter/project: the member chain re-executes on
+        # the host oracle engine with identical semantics (fused exprs are
+        # deterministic by eligibility, so immediate compaction on the CPU
+        # path cannot diverge from the fused deferred-mask evaluation)
+        cpu_replayable = (
+            self._n_variants == 1 and self._limit is None and
+            all(isinstance(m, (B.TpuFilterExec, B.TpuProjectExec))
+                for m in self.members))
+
         def factory(pidx: int) -> Iterator[ColumnarBatch]:
             from spark_rapids_tpu.columnar.batch import (
                 _compact_plan,
@@ -279,38 +290,102 @@ class TpuFusedStageExec(TpuExec):
                 bucket_capacity,
                 gather_batch,
             )
+            from spark_rapids_tpu.engine.retry import (
+                device_op_with_fallback,
+                with_retry,
+            )
+            from spark_rapids_tpu.ops.eval import cpu_filter, cpu_project
+
+            def prep_cols(b: ColumnarBatch):
+                cols = [_col_to_colv(c) for c in b.columns]
+                if not cols:
+                    cap = bucket_capacity(max(b.host_rows(), 1))
+                    # tpulint: eager-jnp, untracked-alloc -- zero-column
+                    # COUNT(*) placeholder: one tiny bool lane
+                    cols = [ColV(DataType.BOOL,
+                                 jnp.zeros((cap,), dtype=bool),
+                                 jnp.arange(cap) < b.num_rows)]
+                return cols
+
+            def dispatch_variant(variant, cols, n, pidx, row_start,
+                                 remaining):
+                jitted, msgs = self._program(variant)
+
+                def _attempt():
+                    M.record_dispatch()
+                    outs, live, limit_passed, flags = jitted(
+                        cols, n, jnp.int32(pidx), jnp.int64(row_start),
+                        jnp.int32(remaining or 0))
+                    raise_deferred_ansi(flags, msgs)
+                    return outs, live, limit_passed
+
+                return with_retry(_attempt, site="fused")
+
+            def compact_plan(live, n):
+                def _attempt():
+                    M.record_dispatch()
+                    return _compact_plan(live, n)
+
+                return with_retry(_attempt, site="fused")
+
+            def run_simple(b: ColumnarBatch, off: int) -> ColumnarBatch:
+                """One-variant no-limit batch: the split-and-retry /
+                CPU-fallback unit."""
+                cols = prep_cols(b)
+                n = jnp.asarray(b.num_rows, dtype=jnp.int32)
+                outs, live, _lp = dispatch_variant(
+                    0, cols, n, pidx, row_start + off, None)
+                out = ColumnarBatch([_colv_to_col(o) for o in outs],
+                                    b.num_rows)
+                if self._row_changing:
+                    order, nk = compact_plan(live, n)
+                    # tpulint: host-sync -- policy-gated stage-exit
+                    n_keep = nk if lazy else int(jax.device_get(nk))
+                    out = _gather_batch_traced(out, order, n_keep) \
+                        if lazy else gather_batch(out, order, n_keep)
+                return out
+
+            def cpu_replay(hb, off: int):
+                """Re-run the member chain bottom-up on the host oracle."""
+                for m in reversed(self.members):
+                    if isinstance(m, B.TpuFilterExec):
+                        hb = cpu_filter(m._bound, hb, partition_id=pidx,
+                                        row_start=row_start + off)
+                    else:
+                        hb = cpu_project(m._bound, hb, partition_id=pidx,
+                                         row_start=row_start + off)
+                return hb
 
             row_start = 0
             remaining = self._limit
             for batch in child_pb.iterator(pidx):
                 if remaining is not None and remaining <= 0:
                     break
-                cols = [_col_to_colv(c) for c in batch.columns]
-                if not cols:
-                    cap = bucket_capacity(max(batch.host_rows(), 1))
-                    # tpulint: eager-jnp, untracked-alloc -- zero-column
-                    # COUNT(*) placeholder: one tiny bool lane
-                    cols = [ColV(DataType.BOOL,
-                                 jnp.zeros((cap,), dtype=bool),
-                                 jnp.arange(cap) < batch.num_rows)]
+                if cpu_replayable:
+                    with M.trace_range("TpuFusedStage", total_time):
+                        outs = device_op_with_fallback(
+                            run_simple, batch, cpu_replay, site="fused")
+                    row_start += batch.num_rows
+                    yield from outs
+                    continue
+                # variant/limit form: dispatches retry in place (spill +
+                # transient backoff); exhaustion propagates for task-level
+                # retry / query-level CPU fallback — mid-variant splits
+                # would corrupt the cross-batch LIMIT budget
+                cols = prep_cols(batch)
                 n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
                 order = n_keep = None
                 for variant in range(self._n_variants):
                     if remaining is not None and remaining <= 0:
                         break
-                    jitted, msgs = self._program(variant)
                     with M.trace_range("TpuFusedStage", total_time):
-                        M.record_dispatch()
-                        outs, live, limit_passed, flags = jitted(
-                            cols, n, jnp.int32(pidx), jnp.int64(row_start),
-                            jnp.int32(remaining or 0))
-                    raise_deferred_ansi(flags, msgs)
+                        outs, live, limit_passed = dispatch_variant(
+                            variant, cols, n, pidx, row_start, remaining)
                     out = ColumnarBatch([_colv_to_col(o) for o in outs],
                                         batch.num_rows)
                     if self._row_changing:
                         if order is None or not self._live_shared:
-                            M.record_dispatch()
-                            order, nk = _compact_plan(live, n)
+                            order, nk = compact_plan(live, n)
                             # tpulint: host-sync -- policy-gated stage-exit
                             n_keep = nk if lazy else \
                                 int(jax.device_get(nk))

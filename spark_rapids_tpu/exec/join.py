@@ -326,11 +326,19 @@ class _TpuJoinMixin:
         # ~66 ms round trip on a tunneled backend) overlaps batch i+1's
         # plan dispatch — the count's host copy is requested as soon as
         # the plan kernel is enqueued
+        from spark_rapids_tpu.engine.retry import with_retry
+
         pending = None
         for stream_batch in stream_iter:
             if stream_batch.host_rows() == 0:
                 continue
-            plan_out = joiner.plan(stream_batch, build)
+            # OOM/transient resilience: the plan and emit dispatches are
+            # pure over (stream batch, build), so a spill+re-dispatch is
+            # safe; exhaustion propagates for task retry / query-level
+            # CPU fallback (the build table is device-resident state —
+            # batch bisection cannot recover it)
+            plan_out = with_retry(
+                lambda: joiner.plan(stream_batch, build), site="join")
             b_matched = plan_out[6]
             if b_matched_acc is None:
                 b_matched_acc = b_matched
@@ -341,12 +349,12 @@ class _TpuJoinMixin:
             except AttributeError:
                 pass  # non-jax scalar (host count path)
             if pending is not None:
-                joined = emit(*pending)
+                joined = with_retry(lambda: emit(*pending), site="join")
                 if joined is not None:
                     yield joined
             pending = (stream_batch, plan_out)
         if pending is not None:
-            joined = emit(*pending)
+            joined = with_retry(lambda: emit(*pending), site="join")
             if joined is not None:
                 yield joined
 
@@ -470,10 +478,9 @@ def runtime_broadcast_probe(node, ctx):
         def collect(pidx: int):
             return list(pb.iterator(pidx))
 
-        if ctx.scheduler is not None:
-            parts = ctx.scheduler.run_job(pb.num_partitions, collect)
-        else:
-            parts = [collect(p) for p in range(pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        parts = run_job_or_serial(ctx.scheduler, pb.num_partitions, collect)
         batches = [b for part in parts for b in part
                    if (b.host_rows() if hasattr(b, "host_rows")
                        else b.num_rows) > 0]
@@ -604,11 +611,10 @@ class TpuBroadcastHashJoinExec(_JoinBase, _TpuJoinMixin, TpuExec):
         def collect_build(pidx: int):
             return [b for b in build_pb.iterator(pidx) if b.host_rows() > 0]
 
-        if ctx.scheduler is not None:
-            parts = ctx.scheduler.run_job(build_pb.num_partitions,
-                                          collect_build)
-        else:
-            parts = [collect_build(p) for p in range(build_pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        parts = run_job_or_serial(ctx.scheduler, build_pb.num_partitions,
+                                  collect_build)
         batches = [b for part in parts for b in part]
         if batches:
             build = batches[0] if len(batches) == 1 else \
@@ -649,11 +655,10 @@ class TpuNestedLoopJoinExec(_JoinBase, TpuExec):
             return [b for b in right_pb.iterator(pidx)
                     if b.host_rows() > 0]
 
-        if ctx.scheduler is not None:
-            parts = ctx.scheduler.run_job(right_pb.num_partitions,
-                                          collect_right)
-        else:
-            parts = [collect_right(p) for p in range(right_pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        parts = run_job_or_serial(ctx.scheduler, right_pb.num_partitions,
+                                  collect_right)
         batches = [b for part in parts for b in part]
         build = concat_batches(batches) if batches else \
             _null_batch(self.children[1].output, 0)
@@ -741,10 +746,9 @@ class CpuShuffledHashJoinExec(_JoinBase, CpuExec):
             def collect(pidx: int):
                 return list(build_pb.iterator(pidx))
 
-            if ctx.scheduler is not None:
-                parts = ctx.scheduler.run_job(build_pb.num_partitions, collect)
-            else:
-                parts = [collect(p) for p in range(build_pb.num_partitions)]
+            from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+            parts = run_job_or_serial(ctx.scheduler, build_pb.num_partitions, collect)
             all_build = [b for part in parts for b in part if b.num_rows > 0]
 
         def factory(pidx: int):
@@ -859,10 +863,9 @@ class CpuNestedLoopJoinExec(_JoinBase, CpuExec):
         def collect(pidx: int):
             return list(right_pb.iterator(pidx))
 
-        if ctx.scheduler is not None:
-            parts = ctx.scheduler.run_job(right_pb.num_partitions, collect)
-        else:
-            parts = [collect(p) for p in range(right_pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        parts = run_job_or_serial(ctx.scheduler, right_pb.num_partitions, collect)
         batches = [b for part in parts for b in part if b.num_rows > 0]
         build = _concat_host(batches, self.children[1].output)
 

@@ -31,6 +31,7 @@ from spark_rapids_tpu.columnar.batch import (
     HostColumnVector,
     gather_batch,
 )
+from spark_rapids_tpu.engine import retry as R
 from spark_rapids_tpu.exec import rowkeys as RK
 from spark_rapids_tpu.exec.base import (
     CpuExec,
@@ -125,9 +126,16 @@ class TpuSortExec(_SortBase, TpuExec):
                         for i in str_ords)
                 kernel = self._build_kernel(child_attrs, n_chunks)
                 cols = [_col_to_colv(c) for c in batch.columns]
-                perm = kernel(cols, np.int32(batch.num_rows))
-                yield gather_batch(batch, perm, batch.num_rows,
-                                   unique_indices=True)
+
+                def _attempt():
+                    perm = kernel(cols, np.int32(batch.num_rows))
+                    return gather_batch(batch, perm, batch.num_rows,
+                                        unique_indices=True)
+
+                # no batch bisection here: consumers rely on one sorted
+                # batch per partition (RequireSingleBatch), so exhaustion
+                # propagates for task retry / query-level CPU fallback
+                yield R.with_retry(_attempt, site="sort")
 
         def factory(pidx: int):
             return count_output(self.metrics, sort_partition(pidx))

@@ -127,6 +127,7 @@ class HostToDeviceExec(TpuExec):
         peak_mem = self.metrics[M.PEAK_DEVICE_MEMORY]
 
         def factory(pidx: int) -> Iterator[ColumnarBatch]:
+            from spark_rapids_tpu.engine.retry import with_retry
             from spark_rapids_tpu.memory.spill import SpillFramework
 
             sem = TpuSemaphore.get()
@@ -139,7 +140,10 @@ class HostToDeviceExec(TpuExec):
                     # DeviceMemoryEventHandler.scala:65-89)
                     fw.watermark.ensure_headroom(hb.estimated_size_bytes())
                 with M.trace_range("HostToDevice", total_time):
-                    db = hb.to_device()
+                    # an upload OOM spills tracked buffers and re-uploads;
+                    # the host batch is intact, so the retry is pure
+                    db = with_retry(lambda: hb.to_device(),
+                                    site="transfer.upload")
                 peak_mem.set_max(db.device_memory_size())
                 yield db
 
@@ -170,6 +174,7 @@ class DeviceToHostExec(PhysicalExec):
 
         def factory(pidx: int) -> Iterator[HostColumnarBatch]:
             from spark_rapids_tpu.columnar.batch import to_host_many
+            from spark_rapids_tpu.engine.retry import with_retry
 
             sem = TpuSemaphore.get()
             try:
@@ -187,13 +192,15 @@ class DeviceToHostExec(PhysicalExec):
                     run_bytes += db.device_memory_size()
                     if len(run) >= run_cap or run_bytes > (128 << 20):
                         with M.trace_range("DeviceToHost", total_time):
-                            hbs = to_host_many(run)
+                            hbs = with_retry(lambda: to_host_many(run),
+                                             site="transfer.download")
                         yield from hbs
                         run, run_bytes = [], 0
                         run_cap = min(run_cap * 2, 32)
                 if run:
                     with M.trace_range("DeviceToHost", total_time):
-                        hbs = to_host_many(run)
+                        hbs = with_retry(lambda: to_host_many(run),
+                                         site="transfer.download")
                     yield from hbs
             finally:
                 sem.release_if_necessary(current_task_id())

@@ -455,19 +455,31 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         device_orc = self.fmt == "orc" and ctx.conf.get(C.ORC_DEVICE_DECODE)
 
         def factory(pidx: int):
+            from spark_rapids_tpu.engine.retry import with_retry
+
             def gen():
+                # device decodes are pure over (split bytes, conf): a
+                # retryable OOM/transient error re-reads and re-decodes the
+                # split after the spill (with_retry); exhaustion propagates
+                # for task retry / query-level CPU fallback
                 if device_decode:
-                    batches = self._read_device(self.splits[pidx], ctx.conf)
+                    batches = with_retry(
+                        lambda: self._read_device(self.splits[pidx],
+                                                  ctx.conf), site="scan")
                     if batches is not None:
                         yield from batches
                         return
                 if device_csv:
-                    batches = self._read_device_csv(self.splits[pidx],
-                                                    ctx.conf)
+                    batches = with_retry(
+                        lambda: self._read_device_csv(self.splits[pidx],
+                                                      ctx.conf), site="scan")
                     if batches is not None:
                         yield from batches
                         return
                 if device_orc:
+                    # per-stripe generator: a retry wrapper around next()
+                    # could silently truncate a closed generator, so device
+                    # ORC errors propagate to the task-level retry instead
                     batches = self._read_device_orc(self.splits[pidx],
                                                     ctx.conf)
                     if batches is not None:
@@ -475,7 +487,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         return
                 for hb in self._read_host(pidx, ctx.conf):
                     TpuSemaphore.get().acquire_if_necessary(current_task_id())
-                    yield hb.to_device()
+                    yield with_retry(lambda: hb.to_device(), site="scan")
 
             return count_output(self.metrics, gen())
 

@@ -92,6 +92,48 @@ class TpuDeviceManager:
             self.device, self.platform, self.hbm_total, self.hbm_budget,
         )
 
+    # -- error translation ---------------------------------------------------
+    # markers of a device-memory exhaustion in backend runtime errors (XLA
+    # raises XlaRuntimeError with a gRPC-style status prefix; the allocator
+    # message wording varies by backend/version, so match broadly)
+    _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                    "Out of memory", "out of memory", "OOM",
+                    "Attempting to allocate")
+    _TRANSIENT_MARKERS = ("ABORTED", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+                          "DATA_LOSS", "device disconnected",
+                          "premature end of stream")
+    # backend exception type names that carry device-runtime failures
+    # (matched by name: jaxlib layouts move across versions and the
+    # translation must not hard-depend on them)
+    _DEVICE_ERROR_TYPES = ("XlaRuntimeError", "JaxRuntimeError",
+                           "InternalError", "PjRtError")
+
+    @classmethod
+    def translate_device_error(cls, e: BaseException):
+        """Map a backend runtime error into the typed retryable hierarchy
+        (engine/retry.py): RESOURCE_EXHAUSTED -> TpuRetryOOM, ABORTED/
+        UNAVAILABLE -> TpuTransientDeviceError, anything else -> None
+        (not a device-health failure; the caller re-raises). This is the
+        TPU analog of the RMM failure callback classifying allocation
+        failures for the retry state machine."""
+        from spark_rapids_tpu.engine.retry import (
+            TpuRetryOOM,
+            TpuTransientDeviceError,
+        )
+
+        if isinstance(e, (TpuRetryOOM, TpuTransientDeviceError)):
+            return e
+        tname = type(e).__name__
+        if tname not in cls._DEVICE_ERROR_TYPES:
+            return None
+        msg = str(e)
+        if any(m in msg for m in cls._OOM_MARKERS):
+            return TpuRetryOOM(f"device OOM ({tname}): {msg}")
+        if any(m in msg for m in cls._TRANSIENT_MARKERS):
+            return TpuTransientDeviceError(
+                f"transient device error ({tname}): {msg}")
+        return None
+
     @staticmethod
     def _detect_hbm(device) -> int:
         try:

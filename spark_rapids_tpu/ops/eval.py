@@ -27,6 +27,7 @@ from spark_rapids_tpu.columnar.batch import (
     HostColumnVector,
     HostColumnarBatch,
 )
+from spark_rapids_tpu.engine.retry import with_retry
 from spark_rapids_tpu.ops.base import Expression
 from spark_rapids_tpu.ops.values import ColV, EvalContext, ScalarV, broadcast_scalar
 from spark_rapids_tpu.utils import metrics as M
@@ -173,10 +174,15 @@ class DeviceProjector:
                          jnp.zeros((cap,), dtype=bool),
                          jnp.arange(cap) < batch.num_rows)]
         n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
-        M.record_dispatch()
-        outs, flags = jitted(cols, n, jnp.int32(partition_id),
-                             jnp.int64(row_start))
-        raise_deferred_ansi(flags, msgs)
+
+        def _attempt():
+            M.record_dispatch()
+            outs, flags = jitted(cols, n, jnp.int32(partition_id),
+                                 jnp.int64(row_start))
+            raise_deferred_ansi(flags, msgs)
+            return outs
+
+        outs = with_retry(_attempt, site="project")
         return ColumnarBatch([_colv_to_col(o) for o in outs], batch.num_rows)
 
 
@@ -219,11 +225,16 @@ class DeviceFilter:
             self._jitted = self._build()
         jitted, msgs = self._jitted
         cols = [_col_to_colv(c) for c in batch.columns]
-        M.record_dispatch()
-        keep, flags = jitted(cols, jnp.int32(batch.num_rows),
-                             jnp.int32(partition_id),
-                             jnp.int64(row_start))
-        raise_deferred_ansi(flags, msgs)
+
+        def _attempt():
+            M.record_dispatch()
+            keep, flags = jitted(cols, jnp.int32(batch.num_rows),
+                                 jnp.int32(partition_id),
+                                 jnp.int64(row_start))
+            raise_deferred_ansi(flags, msgs)
+            return keep
+
+        keep = with_retry(_attempt, site="filter")
         return compact_batch(batch, keep, lazy=lazy)
 
 

@@ -114,9 +114,17 @@ class TpuSession:
             return cls._active
 
     def stop(self):
+        from spark_rapids_tpu.engine.retry import CircuitBreaker
+        from spark_rapids_tpu.utils import faultinject as FI
+
         self.scheduler.shutdown()
         TpuSemaphore.shutdown()
         SpillFramework.shutdown()
+        # fault-tolerance state is per-session: the breaker's failure
+        # count and any armed fault injection must not leak into the next
+        # session in the process
+        CircuitBreaker.reset()
+        FI.disable()
         # symmetric with the semaphore/spill singletons: a later session
         # must size its budget from ITS conf — without this, a test
         # session's hbm.sizeOverride leaks into every session that
@@ -277,27 +285,90 @@ class TpuSession:
 
     # -- actions --------------------------------------------------------------
     def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
+        from spark_rapids_tpu.engine import retry as R
         from spark_rapids_tpu.plan.fusion import count_fused_stages
+        from spark_rapids_tpu.utils import faultinject as FI
         from spark_rapids_tpu.utils import metrics as M
 
         # the executing session's conf drives the process-wide narrowing
         # flag (conf.sync_int64_narrowing: covers clone_with copies and
-        # interleaved sessions)
+        # interleaved sessions) — and, same contract, the retry policy,
+        # the circuit breaker knobs, the fault-injection harness, and the
+        # scheduler's per-query retry budget/timeout
         self.conf.sync_int64_narrowing()
-        physical = self._physical_plan(plan)
-        ctx = self._exec_context()
+        R.set_policy_from_conf(self.conf)
+        breaker = R.CircuitBreaker.configure(self.conf)
+        FI.configure(self.conf)
+        self.scheduler.configure(self.conf)
         dispatches_before = M.dispatch_count()
-        pb = physical.execute(ctx)
-        results = self.scheduler.run_job(
-            pb.num_partitions, lambda p: list(pb.iterator(p)))
+        before = (M.retry_count(), M.split_retry_count(),
+                  M.cpu_fallback_count(), M.fetch_retry_count())
+        cpu_fallback_ok = self.conf.get(C.CPU_FALLBACK_ENABLED)
+        if breaker.is_open() and cpu_fallback_ok:
+            # the session's device is unhealthy: remaining queries plan
+            # straight on the CPU engine instead of burning retries. Like
+            # the device-failure fallback below, this run is the backstop:
+            # injected faults must not chase it
+            M.record_cpu_fallback()
+            FI.disable()
+            physical, results = self._execute_on_cpu(plan)
+        else:
+            physical = self._physical_plan(plan)
+            ctx = self._exec_context()
+            try:
+                pb = physical.execute(ctx)
+                results = self.scheduler.run_job(
+                    pb.num_partitions, lambda p: list(pb.iterator(p)))
+            except Exception as e:  # noqa: BLE001 — degradation boundary
+                if not (cpu_fallback_ok and R.failure_is_device_rooted(e)):
+                    raise
+                # runtime graceful degradation: an operator with device-
+                # resident state (aggregate/join/sort/scan) exhausted its
+                # retries — re-execute the whole query through the CPU
+                # oracle instead of failing the job
+                breaker.record_failure()
+                M.record_cpu_fallback()
+                log.warning("device execution failed (%r); re-executing "
+                            "the query on the CPU oracle engine", e)
+                # the fallback run is the backstop: injected faults must
+                # not chase it (re-armed at the next query start)
+                FI.disable()
+                physical, results = self._execute_on_cpu(plan)
         # per-query fusion accounting (process-wide dispatch counter: tasks
         # share one worker pool; interleaved sessions would blur the delta,
         # same caveat as jit_cache stats)
         self.last_query_metrics = {
             M.FUSED_STAGES: count_fused_stages(physical),
             M.DEVICE_DISPATCHES: M.dispatch_count() - dispatches_before,
+            M.RETRIES: M.retry_count() - before[0],
+            M.SPLIT_RETRIES: M.split_retry_count() - before[1],
+            M.CPU_FALLBACK_EVENTS: M.cpu_fallback_count() - before[2],
+            M.FETCH_RETRIES: M.fetch_retry_count() - before[3],
         }
         return [b for part in results for b in part]
+
+    def _execute_on_cpu(self, plan: L.LogicalPlan):
+        """Plan and run a query entirely on the CPU-oracle engine (runtime
+        graceful degradation; strict on-TPU assertion is meaningless for a
+        deliberate fallback, so it is disabled for this run)."""
+        saved = dict(self.conf.settings)
+        self.conf.settings.update({
+            C.SQL_ENABLED.key: False,
+            C.TEST_ENABLED.key: False,
+        })
+        # the device run may have spent the whole per-query retry budget;
+        # the fallback run starts fresh
+        self.scheduler.begin_query()
+        try:
+            physical = self._physical_plan(plan)
+            ctx = self._exec_context()
+            pb = physical.execute(ctx)
+            results = self.scheduler.run_job(
+                pb.num_partitions, lambda p: list(pb.iterator(p)))
+            return physical, results
+        finally:
+            self.conf.settings.clear()
+            self.conf.settings.update(saved)
 
     def execute_collect(self, plan: L.LogicalPlan) -> List[tuple]:
         rows: List[tuple] = []

@@ -72,6 +72,13 @@ from spark_rapids_tpu.utils import metrics as M
 # than this would hit the count sync here anyway, defeating the point.
 LAZY_PIECE_CAP_BYTES = 4 << 20
 
+# In-place re-executions of an upstream map partition per failed piece
+# before the FetchFailedError surfaces to the task-level retry loop (each
+# re-execution is a full recompute of the map task — cheap in-process, so
+# the bound is generous; beyond it the task retry and then the query-level
+# CPU fallback take over).
+_FETCH_REMAP_ATTEMPTS = 6
+
 
 # ===========================================================================
 # Partitioning descriptors
@@ -188,16 +195,21 @@ class _ExchangeBase(PhysicalExec):
                         buckets[target].append(piece)
             return buckets
 
-        if ctx.scheduler is not None:
-            map_results = ctx.scheduler.run_job(n_maps, run_map)
-        else:
-            map_results = [run_map(p) for p in range(n_maps)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        map_results = run_job_or_serial(ctx.scheduler, n_maps, run_map)
         reduce_buckets: List[List[Any]] = [[] for _ in range(n_out)]
+        # piece provenance (map partition, index within its (map, target)
+        # slice list): the lineage needed to RE-EXECUTE the upstream map
+        # partition when a serialized piece cannot be fetched back — the
+        # in-process analog of Spark's stage retry after FetchFailed
+        piece_src: List[List[Tuple[int, int]]] = [[] for _ in range(n_out)]
         bytes_m = self.metrics["dataSize"]
-        for mb in map_results:
+        for m_idx, mb in enumerate(map_results):
             for t in range(n_out):
-                for piece in mb[t]:
+                for k, piece in enumerate(mb[t]):
                     reduce_buckets[t].append(piece)
+                    piece_src[t].append((m_idx, k))
                     bytes_m.add(_piece_bytes(piece))
 
         to_device = self.placement == "tpu"
@@ -212,6 +224,28 @@ class _ExchangeBase(PhysicalExec):
         costs = [sum(_piece_cost(p, n_out) for p in bucket)
                  for bucket in reduce_buckets]
 
+        def decode_with_remap(piece: "_SerializedPiece", t: int, j: int):
+            """Decode a serialized piece; on fetch failure re-execute its
+            upstream map partition and decode the regenerated piece
+            (bounded attempts — beyond them the failure surfaces and the
+            task-level retry takes over)."""
+            from spark_rapids_tpu.engine.scheduler import FetchFailedError
+
+            attempts = 0
+            while True:
+                try:
+                    return piece.decode(to_device)
+                except FetchFailedError:
+                    if attempts >= _FETCH_REMAP_ATTEMPTS:
+                        raise
+                    attempts += 1
+                    M.record_fetch_retry()
+                    m_idx, k = piece_src[t][j]
+                    fresh = run_map(m_idx)[t]
+                    if k >= len(fresh):
+                        raise
+                    piece = fresh[k]
+
         def factory(pidx: int):
             def gen():
                 # fuse runs of routed slices into one batch per <=16 slices
@@ -219,7 +253,7 @@ class _ExchangeBase(PhysicalExec):
                 # size while one fused gather replaces piece-wise
                 # gather+concat)
                 routed: List[_RoutedSlice] = []
-                for piece in reduce_buckets[pidx]:
+                for j, piece in enumerate(reduce_buckets[pidx]):
                     if isinstance(piece, _RoutedSlice):
                         routed.append(piece)
                         if len(routed) >= 16:
@@ -230,7 +264,7 @@ class _ExchangeBase(PhysicalExec):
                         yield _assemble_routed(routed)
                         routed = []
                     if isinstance(piece, _SerializedPiece):
-                        piece = piece.decode(to_device)
+                        piece = decode_with_remap(piece, pidx, j)
                     yield piece
                 if routed:
                     yield _assemble_routed(routed)
@@ -303,7 +337,9 @@ class _SerializedPiece:
     def decode(self, to_device: bool):
         from spark_rapids_tpu.columnar.serde import deserialize_batch
         from spark_rapids_tpu.engine.scheduler import FetchFailedError
+        from spark_rapids_tpu.utils import faultinject as FI
 
+        FI.maybe_inject("shuffle.fetch")
         try:
             data = self._data if self._data is not None else \
                 self._fw.read_bytes(self._buf)
@@ -559,10 +595,9 @@ class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
                 out.append((batch, keys))
             return out
 
-        if ctx.scheduler is not None:
-            per_part = ctx.scheduler.run_job(child_pb.num_partitions, mat)
-        else:
-            per_part = [mat(i) for i in range(child_pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        per_part = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
         all_keys: List[List[Any]] = [[] for _ in p.orders]
         for part in per_part:
             for _, keys in part:
@@ -705,10 +740,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             return [b for b in child_pb.iterator(pidx)
                     if not getattr(b, "rows_on_host", True) or b.num_rows > 0]
 
-        if ctx.scheduler is not None:
-            per_map = ctx.scheduler.run_job(child_pb.num_partitions, mat)
-        else:
-            per_map = [mat(i) for i in range(child_pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        per_map = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
         bounds_np = None
         if isinstance(p, HashPartitioning):
             spec = ("hash", tuple(bind_all(p.exprs, child_attrs)), ())
@@ -788,10 +822,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 out.append((batch, host_keys))
             return out
 
-        if ctx.scheduler is not None:
-            per_part = ctx.scheduler.run_job(child_pb.num_partitions, mat)
-        else:
-            per_part = [mat(i) for i in range(child_pb.num_partitions)]
+        from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+
+        per_part = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
 
         # one fixed byte width per string key across all batches so every
         # packed row compares in the same space

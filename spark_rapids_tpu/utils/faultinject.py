@@ -1,0 +1,178 @@
+"""Deterministic, conf-gated fault-injection harness.
+
+The substrate for the chaos suite (tests/test_faults.py): registered
+execution sites consult `maybe_inject(site)` immediately before doing their
+real work; when the harness is armed for that site, a seeded PRF decides
+per invocation whether to raise the site's fault kind instead. Injection is
+a PURE function of (seed, site, invocation count) — a run replays exactly
+under the same seed, and every retry re-rolls with a fresh invocation count
+so rates < 1 terminate (the CPU fallback backstops rate = 1).
+
+Conf: rapids.tpu.test.faultInjection.{enabled,seed,sites,rate}
+(disabled by default; `maybe_inject` is a single None-check when off).
+
+Fault kinds and what they model:
+- oom       XLA RESOURCE_EXHAUSTED on a device dispatch -> TpuRetryOOM
+            (spill + re-dispatch, then split-and-retry, then CPU fallback)
+- dispatch  a flaky program launch (XLA ABORTED) -> TpuTransientDeviceError
+- transfer  a failed host<->device transfer -> TpuTransientDeviceError
+- fetch     a lost shuffle piece -> FetchFailedError (upstream map
+            partition re-execution, then task retry)
+
+The reference grows the same substrate inside RMM for its retry tests
+(RmmSpark.forceRetryOOM / forceSplitAndRetryOOM injecting OOMs at chosen
+allocation counts); sites here are named execution points instead of
+allocation indices because XLA owns allocation.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, Optional
+
+from spark_rapids_tpu import conf as C
+
+# every registered site -> its default fault kind. Keep docs/fault-tolerance.md
+# in sync when adding a site.
+SITES: Dict[str, str] = {
+    "scan": "oom",
+    "project": "oom",
+    "filter": "oom",
+    "fused": "oom",
+    "agg.update": "oom",
+    "agg.merge": "oom",
+    "agg.finalize": "oom",
+    "join": "oom",
+    "sort": "oom",
+    "transfer.upload": "transfer",
+    "transfer.download": "transfer",
+    "shuffle.fetch": "fetch",
+}
+
+KINDS = ("oom", "dispatch", "transfer", "fetch")
+
+
+class FaultInjector:
+    """Armed sites + the seeded decision function."""
+
+    def __init__(self, seed: int, sites_spec: str, rate: float):
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.armed: Dict[str, str] = _parse_sites(sites_spec)
+        self._lock = threading.Lock()
+        self._invocations: Dict[str, int] = {}
+        self._injected: Dict[str, int] = {}
+
+    def decide(self, site: str, invocation: int) -> bool:
+        """Pure (seed, site, invocation) -> inject? decision. crc32 keeps
+        it stable across processes and python hash randomization."""
+        h = zlib.crc32(f"{self.seed}:{site}:{invocation}".encode("utf-8"))
+        return (h & 0xFFFFFFFF) / 4294967296.0 < self.rate
+
+    def check(self, site: str) -> Optional[str]:
+        """Count the invocation; return the fault kind to raise, or None."""
+        kind = self.armed.get(site)
+        if kind is None:
+            return None
+        with self._lock:
+            n = self._invocations.get(site, 0)
+            self._invocations[site] = n + 1
+        if not self.decide(site, n):
+            return None
+        with self._lock:
+            self._injected[site] = self._injected.get(site, 0) + 1
+        return kind
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._injected)
+
+    def invocation_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._invocations)
+
+
+def _parse_sites(spec: str) -> Dict[str, str]:
+    """'*' or 'name[,name:kind,...]' -> {site: kind}. Unknown sites are
+    accepted (tests register ad-hoc sites); unknown kinds raise."""
+    armed: Dict[str, str] = {}
+    spec = (spec or "").strip()
+    if not spec:
+        return armed
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if entry == "*":
+            armed.update(SITES)
+            continue
+        if ":" in entry:
+            name, kind = entry.split(":", 1)
+            name, kind = name.strip(), kind.strip()
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} for site {name!r} "
+                    f"(must be one of {'|'.join(KINDS)})")
+        else:
+            name = entry
+            kind = SITES.get(name, "oom")
+        armed[name] = kind
+    return armed
+
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def configure(tpu_conf: "C.TpuConf") -> Optional[FaultInjector]:
+    """Arm (or disarm) the harness from a session conf; called at every
+    query start so the executing session's conf is authoritative."""
+    global _ACTIVE
+    if not tpu_conf.get(C.FAULT_INJECTION_ENABLED):
+        _ACTIVE = None
+        return None
+    _ACTIVE = FaultInjector(
+        seed=tpu_conf.get(C.FAULT_INJECTION_SEED),
+        sites_spec=tpu_conf.get(C.FAULT_INJECTION_SITES),
+        rate=tpu_conf.get(C.FAULT_INJECTION_RATE),
+    )
+    return _ACTIVE
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def maybe_inject(site: str) -> None:
+    """Raise the armed fault for `site`, or return. A single None-check
+    when the harness is off — safe on every hot path."""
+    inj = _ACTIVE
+    if inj is None:
+        return
+    kind = inj.check(site)
+    if kind is None:
+        return
+    # lazy imports: utils must not pull the engine in at module import
+    from spark_rapids_tpu.engine.retry import (
+        TpuRetryOOM,
+        TpuTransientDeviceError,
+    )
+
+    if kind == "oom":
+        raise TpuRetryOOM(
+            f"[injected] RESOURCE_EXHAUSTED: out of memory at {site}")
+    if kind == "dispatch":
+        raise TpuTransientDeviceError(
+            f"[injected] ABORTED: device dispatch failed at {site}")
+    if kind == "transfer":
+        raise TpuTransientDeviceError(
+            f"[injected] UNAVAILABLE: host<->device transfer failed "
+            f"at {site}")
+    from spark_rapids_tpu.engine.scheduler import FetchFailedError
+
+    raise FetchFailedError(f"[injected] shuffle piece lost at {site}")

@@ -33,6 +33,12 @@ PEAK_DEVICE_MEMORY = "peakDevMemory"
 # round trip, so the dispatch count IS the fusion win's unit)
 FUSED_STAGES = "fusedStages"
 DEVICE_DISPATCHES = "deviceDispatches"
+# fault-tolerance metrics (engine/retry.py; reference: the retry/OOM state
+# machine the plugin wraps every GPU allocation in + per-op CPU fallback)
+RETRIES = "retries"
+SPLIT_RETRIES = "splitRetries"
+CPU_FALLBACK_EVENTS = "cpuFallbackEvents"
+FETCH_RETRIES = "fetchRetries"
 
 
 class Metric:
@@ -112,6 +118,53 @@ def record_dispatch(n: int = 1) -> None:
 
 def dispatch_count() -> int:
     return _DISPATCHES.value
+
+
+# ---------------------------------------------------------------------------
+# Fault-tolerance accounting (engine/retry.py increments; queries snapshot
+# before/after, same pattern as the dispatch counter above)
+# ---------------------------------------------------------------------------
+_RETRIES = Metric(RETRIES)
+_SPLIT_RETRIES = Metric(SPLIT_RETRIES)
+_CPU_FALLBACKS = Metric(CPU_FALLBACK_EVENTS)
+_FETCH_RETRIES = Metric(FETCH_RETRIES)
+
+
+def record_retry(n: int = 1) -> None:
+    """Count one device re-dispatch (OOM spill+retry or transient retry)."""
+    _RETRIES.add(n)
+
+
+def record_split_retry(n: int = 1) -> None:
+    """Count one batch bisection performed by split-and-retry."""
+    _SPLIT_RETRIES.add(n)
+
+
+def record_cpu_fallback(n: int = 1) -> None:
+    """Count one degradation to the CPU-oracle path (per batch or per
+    query, whichever unit fell back)."""
+    _CPU_FALLBACKS.add(n)
+
+
+def record_fetch_retry(n: int = 1) -> None:
+    """Count one shuffle-piece re-execution after a fetch failure."""
+    _FETCH_RETRIES.add(n)
+
+
+def retry_count() -> int:
+    return _RETRIES.value
+
+
+def split_retry_count() -> int:
+    return _SPLIT_RETRIES.value
+
+
+def cpu_fallback_count() -> int:
+    return _CPU_FALLBACKS.value
+
+
+def fetch_retry_count() -> int:
+    return _FETCH_RETRIES.value
 
 
 @contextlib.contextmanager

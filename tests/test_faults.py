@@ -1,0 +1,531 @@
+"""Fault-tolerance suite: retry framework units + deterministic chaos tests.
+
+The chaos half asserts the paper's robustness property end to end: with the
+fault-injection harness (utils/faultinject.py) armed at every registered
+execution site, queries COMPLETE and their results equal the CPU oracle —
+device memory pressure, flaky dispatches, failed transfers, and lost
+shuffle pieces never kill a query (reference: the RMM retry/split-retry
+state machine + per-op CPU fallback; PAPER.md).
+
+Everything is deterministic: injection decisions are a pure function of
+(seed, site, invocation), backoff jitter is a pure function of the retry
+identity, and the CPU fallback backstops the pathological corners.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, HostColumnVector
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.engine import retry as R
+from spark_rapids_tpu.engine.scheduler import (
+    FetchFailedError,
+    TaskFailedError,
+    TaskScheduler,
+)
+from spark_rapids_tpu.utils import faultinject as FI
+from spark_rapids_tpu.utils import metrics as M
+
+from tests.harness import assert_rows_equal, run_on_cpu, run_on_tpu
+
+
+# ---------------------------------------------------------------------------
+# Typed-error classification
+# ---------------------------------------------------------------------------
+class XlaRuntimeError(RuntimeError):
+    """Stand-in with the backend exception's NAME (translation matches by
+    type name so it cannot hard-depend on jaxlib layouts)."""
+
+
+def test_translate_resource_exhausted_to_oom():
+    from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
+
+    e = XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                        "1073741824 bytes")
+    typed = TpuDeviceManager.translate_device_error(e)
+    assert isinstance(typed, R.TpuRetryOOM)
+
+
+def test_translate_aborted_to_transient():
+    from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
+
+    e = XlaRuntimeError("ABORTED: dispatch failed; device in bad state")
+    typed = TpuDeviceManager.translate_device_error(e)
+    assert isinstance(typed, R.TpuTransientDeviceError)
+    assert not isinstance(typed, R.TpuRetryOOM)
+
+
+def test_translate_unknown_errors_pass_through():
+    from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
+
+    assert TpuDeviceManager.translate_device_error(
+        ValueError("bad arg")) is None
+    assert TpuDeviceManager.translate_device_error(
+        RuntimeError("RESOURCE_EXHAUSTED")) is None  # not a backend type
+
+
+def test_task_level_classification():
+    assert R.is_retryable_failure(R.TpuRetryOOM("x"))
+    assert R.is_retryable_failure(R.TpuTransientDeviceError("x"))
+    assert R.is_retryable_failure(FetchFailedError("x"))
+    assert not R.is_retryable_failure(TypeError("x"))
+    assert not R.is_retryable_failure(ValueError("x"))
+    assert R.is_retryable_failure(RuntimeError("unclassified hiccup"))
+
+
+# ---------------------------------------------------------------------------
+# with_retry / split_and_retry
+# ---------------------------------------------------------------------------
+def test_with_retry_oom_spills_and_redispatches(session):
+    from spark_rapids_tpu.memory.spill import SpillFramework, StorageTier
+
+    fw = SpillFramework.get()
+    vec = HostColumnVector.from_numpy(np.arange(64, dtype=np.int64))
+    buf = fw.add_device_batch(HostColumnarBatch([vec]).to_device())
+    assert buf.tier is StorageTier.DEVICE
+    calls = []
+    r0 = M.retry_count()
+
+    def attempt():
+        calls.append(1)
+        if len(calls) == 1:
+            raise R.TpuRetryOOM("synthetic OOM")
+        return "ok"
+
+    assert R.with_retry(attempt, site="unit") == "ok"
+    assert len(calls) == 2
+    assert M.retry_count() - r0 == 1
+    # the OOM retry synchronously spilled the tracked device buffer
+    assert buf.tier is StorageTier.HOST
+
+
+def test_with_retry_exhaustion_escalates_to_split(session):
+    with pytest.raises(R.TpuSplitAndRetryOOM):
+        R.with_retry(lambda: (_ for _ in ()).throw(
+            R.TpuRetryOOM("always")), site="unit")
+
+
+def test_with_retry_does_not_retry_deterministic_errors(session):
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise ValueError("deterministic")
+
+    with pytest.raises(ValueError):
+        R.with_retry(attempt, site="unit")
+    assert len(calls) == 1
+
+
+def test_with_retry_transient_backs_off_and_recovers(session):
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        if len(calls) < 3:
+            raise R.TpuTransientDeviceError("flaky")
+        return 42
+
+    assert R.with_retry(attempt, site="unit") == 42
+    assert len(calls) == 3
+
+
+def _device_batch(n: int):
+    vec = HostColumnVector.from_numpy(np.arange(n, dtype=np.int64))
+    return HostColumnarBatch([vec]).to_device()
+
+
+def test_split_and_retry_bisects_until_it_fits(session):
+    s0 = M.split_retry_count()
+
+    def batch_fn(b, off):
+        if b.host_rows() > 4:
+            raise R.TpuSplitAndRetryOOM("too big")
+        return (off, b.host_rows())
+
+    out = R.split_and_retry(batch_fn, _device_batch(16), site="unit")
+    assert [n for _, n in out] == [4, 4, 4, 4]
+    assert [off for off, _ in out] == [0, 4, 8, 12]
+    assert M.split_retry_count() - s0 == 3  # 16 -> 8+8 -> 4x4
+    with pytest.raises(R.TpuSplitAndRetryOOM):
+        R.split_and_retry(
+            lambda b, off: (_ for _ in ()).throw(
+                R.TpuSplitAndRetryOOM("never fits")),
+            _device_batch(16), site="unit")
+
+
+def test_device_op_with_fallback_degrades_to_cpu(session):
+    f0 = M.cpu_fallback_count()
+
+    def cpu_fn(hb, off):
+        cols = [HostColumnVector(c.dtype, c.data * 2, c.validity)
+                for c in hb.columns]
+        return HostColumnarBatch(cols, hb.num_rows)
+
+    out = R.device_op_with_fallback(
+        lambda b, off: (_ for _ in ()).throw(R.TpuRetryOOM("dead device")),
+        _device_batch(4), cpu_fn, site="unit")
+    assert len(out) == 1
+    got = out[0].to_host().columns[0].data[:4]
+    assert list(got) == [0, 2, 4, 6]
+    assert M.cpu_fallback_count() - f0 == 1
+
+
+# ---------------------------------------------------------------------------
+# Fault injector determinism
+# ---------------------------------------------------------------------------
+def test_injector_is_deterministic_per_seed():
+    a = FI.FaultInjector(seed=7, sites_spec="*", rate=0.5)
+    b = FI.FaultInjector(seed=7, sites_spec="*", rate=0.5)
+    c = FI.FaultInjector(seed=8, sites_spec="*", rate=0.5)
+    seq_a = [a.decide("project", i) for i in range(64)]
+    assert seq_a == [b.decide("project", i) for i in range(64)]
+    assert seq_a != [c.decide("project", i) for i in range(64)]
+    assert any(seq_a) and not all(seq_a)
+
+
+def test_injector_site_spec_parsing():
+    inj = FI.FaultInjector(seed=0, sites_spec="project,join:dispatch",
+                           rate=1.0)
+    assert inj.armed == {"project": "oom", "join": "dispatch"}
+    star = FI.FaultInjector(seed=0, sites_spec="*", rate=1.0)
+    assert star.armed == FI.SITES
+    with pytest.raises(ValueError):
+        FI.FaultInjector(seed=0, sites_spec="project:nope", rate=1.0)
+
+
+def test_maybe_inject_noop_when_disabled():
+    FI.disable()
+    FI.maybe_inject("project")  # must not raise
+    assert FI.active() is None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler hardening
+# ---------------------------------------------------------------------------
+def test_scheduler_backoff_is_jittered_and_bounded(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    sched = TaskScheduler(num_threads=1, max_failures=3)
+    calls = []
+
+    def fn(p):
+        calls.append(p)
+        raise R.TpuTransientDeviceError("flaky")
+
+    with pytest.raises(TaskFailedError):
+        sched._run_task(0, fn)
+    sched.shutdown()
+    assert len(calls) == 3
+    assert len(sleeps) == 2 and sleeps[1] > sleeps[0]  # exponential
+    # deterministic: the same identity produces the same jitter
+    assert R.deterministic_jitter(0, "task", 0) == \
+        R.deterministic_jitter(0, "task", 0)
+    assert R.deterministic_jitter(0, "task", 0) != \
+        R.deterministic_jitter(1, "task", 0)
+
+
+def test_scheduler_retry_budget_caps_query_retries():
+    sched = TaskScheduler(num_threads=2, max_failures=5, retry_budget=1)
+    sched.begin_query()
+    calls = []
+
+    def fn(p):
+        calls.append(p)
+        raise R.TpuTransientDeviceError("flaky")
+
+    with pytest.raises(TaskFailedError):
+        sched.run_job(1, fn)
+    sched.shutdown()
+    # 1 first attempt + 1 budgeted retry, NOT max_failures=5 attempts
+    assert len(calls) == 2
+    assert sched.retries_spent == 1
+
+
+def test_scheduler_task_timeout_fails_instead_of_wedging():
+    sched = TaskScheduler(num_threads=2, max_failures=1,
+                          task_timeout_s=0.3)
+
+    def fn(p):
+        if p == 1:
+            time.sleep(1.2)  # wedged task
+        return p
+
+    with pytest.raises(TaskFailedError) as ei:
+        sched.run_job(2, fn)
+    assert isinstance(ei.value.cause, TimeoutError)
+    sched.shutdown()
+
+
+def test_failing_task_releases_semaphore_no_deadlock():
+    """Satellite regression: a task that acquires the admission semaphore
+    and then raises mid-batch must not deadlock subsequent admission."""
+    from spark_rapids_tpu.exec.transitions import current_task_id
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    TpuSemaphore.shutdown()
+    TpuSemaphore.initialize(1)  # single permit: a leak deadlocks instantly
+    sched = TaskScheduler(num_threads=2, max_failures=1)
+
+    def failing(p):
+        TpuSemaphore.get().acquire_if_necessary(current_task_id())
+        raise TypeError("task body raises while holding the semaphore")
+
+    with pytest.raises(TaskFailedError):
+        sched.run_job(2, failing)
+
+    acquired = []
+
+    def ok(p):
+        TpuSemaphore.get().acquire_if_necessary(current_task_id())
+        acquired.append(p)
+        return p
+
+    done = threading.Event()
+    result = []
+
+    def run():
+        result.append(sched.run_job(2, ok))
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert done.wait(timeout=10.0), \
+        "admission deadlocked: failing task leaked its permit"
+    assert result[0] == [0, 1] and len(acquired) == 2
+    sched.shutdown()
+    TpuSemaphore.shutdown()
+
+
+def test_run_serial_releases_semaphore_on_failure():
+    from spark_rapids_tpu.engine.scheduler import run_serial
+    from spark_rapids_tpu.exec.transitions import current_task_id
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    TpuSemaphore.shutdown()
+    sem = TpuSemaphore.initialize(1)
+
+    def failing(p):
+        sem.acquire_if_necessary(current_task_id())
+        raise RuntimeError("mid-partition failure")
+
+    with pytest.raises(RuntimeError):
+        run_serial(1, failing)
+    # the caller thread's permit was returned
+    assert sem._available == 1
+    TpuSemaphore.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+def test_circuit_breaker_opens_at_threshold():
+    R.CircuitBreaker.reset()
+    br = R.CircuitBreaker(enabled=True, threshold=2)
+    assert not br.is_open()
+    br.record_failure()
+    assert not br.is_open()
+    br.record_failure()
+    assert br.is_open()
+    disabled = R.CircuitBreaker(enabled=False, threshold=1)
+    disabled.record_failure()
+    assert not disabled.is_open()
+    R.CircuitBreaker.reset()
+
+
+# ---------------------------------------------------------------------------
+# Chaos suite: injected faults, results must equal the CPU oracle
+# ---------------------------------------------------------------------------
+def _chaos_conf(seed: int, sites: str = "*", rate: float = 0.3):
+    return {
+        "rapids.tpu.test.faultInjection.enabled": True,
+        "rapids.tpu.test.faultInjection.seed": seed,
+        "rapids.tpu.test.faultInjection.sites": sites,
+        "rapids.tpu.test.faultInjection.rate": rate,
+    }
+
+
+def _tpch_q(qname, sf=0.0005, num_partitions=3):
+    from spark_rapids_tpu.benchmarks import tpch
+
+    def q(s):
+        tables = tpch.gen_tables(s, sf=sf, num_partitions=num_partitions)
+        return tpch.QUERIES[qname](tables)
+
+    return q
+
+
+def _assert_chaos_equal(session, df_fn, seed, sites="*", rate=0.3):
+    cpu = run_on_cpu(session, df_fn)
+    tpu = run_on_tpu(session, df_fn, extra_conf=_chaos_conf(
+        seed, sites=sites, rate=rate))
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    return session.last_query_metrics
+
+
+def test_chaos_q1_oom_everywhere(session):
+    m = _assert_chaos_equal(session, _tpch_q("q1"), seed=1)
+    # at rate 0.3 over every site SOMETHING must have fired and recovered
+    assert m["retries"] + m["splitRetries"] + m["cpuFallbackEvents"] > 0
+
+
+@pytest.mark.slow  # heavy chaos combination: protects the tier-1 dots window
+def test_chaos_q5_oom_everywhere(session):
+    _assert_chaos_equal(session, _tpch_q("q5"), seed=2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [3, 4, 5])
+def test_chaos_q1_seed_matrix(session, seed):
+    _assert_chaos_equal(session, _tpch_q("q1"), seed=seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [3, 4])
+def test_chaos_q5_seed_matrix(session, seed):
+    _assert_chaos_equal(session, _tpch_q("q5"), seed=seed)
+
+
+@pytest.mark.slow  # heavy chaos combination: protects the tier-1 dots window
+def test_chaos_join_sort_e2e(session):
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(11)
+    n = 3000
+    lk = rng.integers(0, 50, n).astype(np.int64)
+    lv = rng.integers(-1000, 1000, n).astype(np.int64)
+
+    def q(s):
+        left = s.createDataFrame({"k": lk, "v": lv}, num_partitions=3)
+        right = s.createDataFrame({
+            "k": np.arange(50, dtype=np.int64),
+            "w": (np.arange(50, dtype=np.int64) * 7) % 13,
+        }, num_partitions=2)
+        return (left.join(right, on="k")
+                    .groupBy("w").agg(F.sum("v").alias("s"),
+                                      F.count("*").alias("n"))
+                    .orderBy("w"))
+
+    _assert_chaos_equal(session, q, seed=6)
+
+
+@pytest.mark.slow  # heavy chaos combination: protects the tier-1 dots window
+def test_chaos_spill_pressure_e2e(session):
+    """Injection + a tiny HBM budget: the spill framework and the retry
+    framework engage together and the result still matches the oracle."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(12)
+    n = 4000
+    dk = rng.integers(0, 32, n).astype(np.int64)
+    dv = rng.integers(0, 1 << 20, n).astype(np.int64)
+
+    def q(s):
+        df = s.createDataFrame({"k": dk, "v": dv}, num_partitions=4)
+        return (df.filter(F.col("v") % 5 != 0)
+                  .withColumn("c", F.col("v") * 3 + 1)
+                  .groupBy("k").agg(F.sum("c").alias("s")))
+
+    cpu = run_on_cpu(session, q)
+    tpu = run_on_tpu(session, q, extra_conf={
+        **_chaos_conf(seed=9, rate=0.25),
+        "rapids.tpu.memory.hbm.sizeOverride": 8 << 20,
+    })
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+
+
+def test_chaos_shuffle_fetch_failure_remaps_upstream(session):
+    """A lost serialized shuffle piece re-executes its upstream map
+    partition in place (the Spark stage-retry analog)."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(13)
+    n = 2000
+    dk = rng.integers(0, 1 << 16, n).astype(np.int64)
+    dv = rng.integers(0, 100, n).astype(np.int64)
+
+    def q(s):
+        df = s.createDataFrame({"k": dk, "v": dv}, num_partitions=3)
+        return df.repartition(6, F.col("k")).groupBy("k").agg(
+            F.sum("v").alias("s")).agg(F.count("*").alias("groups"),
+                                       F.sum("s").alias("total"))
+
+    cpu = run_on_cpu(session, q)
+    tpu = run_on_tpu(session, q, extra_conf={
+        **_chaos_conf(seed=5, sites="shuffle.fetch", rate=0.25),
+        "rapids.tpu.shuffle.serialize.enabled": True,
+    })
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    assert session.last_query_metrics["fetchRetries"] > 0
+
+
+def test_chaos_hard_failure_falls_back_to_cpu_query(session):
+    """rate=1.0 at the aggregate update kernel: the device path can never
+    succeed, so the query re-executes on the CPU oracle instead of
+    failing (runtime graceful degradation)."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(14)
+    dk = rng.integers(0, 10, 500).astype(np.int64)
+    dv = rng.integers(0, 100, 500).astype(np.int64)
+
+    def q(s):
+        df = s.createDataFrame({"k": dk, "v": dv}, num_partitions=2)
+        return df.groupBy("k").agg(F.sum("v").alias("s"))
+
+    cpu = run_on_cpu(session, q)
+    tpu = run_on_tpu(session, q, extra_conf=_chaos_conf(
+        seed=0, sites="agg.update", rate=1.0))
+    assert_rows_equal(cpu, tpu, ignore_order=True)
+    assert session.last_query_metrics["cpuFallbackEvents"] >= 1
+
+
+def test_circuit_breaker_trips_session_to_cpu(session):
+    """After threshold device failures the breaker opens: the next query
+    plans straight on the CPU engine (0 device dispatches) instead of
+    probing the unhealthy device again."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(15)
+    dk = rng.integers(0, 8, 300).astype(np.int64)
+    dv = rng.integers(0, 50, 300).astype(np.int64)
+
+    def q(s):
+        df = s.createDataFrame({"k": dk, "v": dv}, num_partitions=2)
+        return df.groupBy("k").agg(F.sum("v").alias("s"))
+
+    cpu = run_on_cpu(session, q)
+    conf = {
+        **_chaos_conf(seed=0, sites="agg.update", rate=1.0),
+        "rapids.tpu.execution.circuitBreaker.failureThreshold": 1,
+    }
+    first = run_on_tpu(session, q, extra_conf=conf)
+    assert_rows_equal(cpu, first, ignore_order=True)
+    assert R.CircuitBreaker.get().is_open()
+    # breaker open: the second run never touches the device
+    second = run_on_tpu(session, q, extra_conf={
+        k: v for k, v in conf.items()
+        if not k.startswith("rapids.tpu.test.faultInjection")})
+    assert_rows_equal(cpu, second, ignore_order=True)
+    assert session.last_query_metrics["deviceDispatches"] == 0
+    assert session.last_query_metrics["cpuFallbackEvents"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# No-injection invariants (the acceptance criterion's second half)
+# ---------------------------------------------------------------------------
+def test_no_injection_means_zero_retries(session):
+    """With injection disabled the retry wrappers are inert: no retries,
+    no splits, no fallbacks — and by implication no hidden extra
+    dispatches (the resource-analyzer equality tests pin the counts)."""
+    tpu = run_on_tpu(session, _tpch_q("q1"))
+    assert len(tpu) > 0
+    m = session.last_query_metrics
+    assert m["retries"] == 0
+    assert m["splitRetries"] == 0
+    assert m["cpuFallbackEvents"] == 0
+    assert m["fetchRetries"] == 0

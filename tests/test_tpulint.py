@@ -507,3 +507,62 @@ def test_shuffle_hot_path_has_no_implicit_device_to_host(session):
     got = df.repartition(8, F.col("k")).agg(
         F.count("*").alias("n")).collect()
     assert got[0][0] == 4000
+
+
+# ---------------------------------------------------------------------------
+# naked-dispatch
+# ---------------------------------------------------------------------------
+def test_naked_dispatch_flagged_in_hot_path():
+    src = ("from spark_rapids_tpu.utils import metrics as M\n\n"
+           "def f(jitted, cols):\n"
+           "    M.record_dispatch()\n"
+           "    return jitted(cols)\n")
+    assert rules_of(lint(src)) == ["naked-dispatch"]
+
+
+def test_naked_dispatch_not_flagged_outside_hot_path():
+    src = ("from spark_rapids_tpu.utils import metrics as M\n\n"
+           "def f(jitted, cols):\n"
+           "    M.record_dispatch()\n"
+           "    return jitted(cols)\n")
+    assert rules_of(lint(src, path=COLD)) == []
+
+
+def test_naked_dispatch_attempt_closure_ok():
+    src = ("from spark_rapids_tpu.engine.retry import with_retry\n"
+           "from spark_rapids_tpu.utils import metrics as M\n\n"
+           "def f(jitted, cols):\n"
+           "    def _attempt():\n"
+           "        M.record_dispatch()\n"
+           "        return jitted(cols)\n"
+           "    return with_retry(_attempt, site='x')\n")
+    assert rules_of(lint(src)) == []
+
+
+def test_naked_dispatch_named_fn_passed_to_combinator_ok():
+    src = ("from spark_rapids_tpu.engine.retry import split_and_retry\n"
+           "from spark_rapids_tpu.utils import metrics as M\n\n"
+           "def run_one(b, off):\n"
+           "    M.record_dispatch()\n"
+           "    return b\n\n"
+           "def f(batch):\n"
+           "    return split_and_retry(run_one, batch, site='x')\n")
+    assert rules_of(lint(src)) == []
+
+
+def test_naked_dispatch_lambda_passed_to_combinator_ok():
+    src = ("from spark_rapids_tpu.engine.retry import with_retry\n"
+           "from spark_rapids_tpu.utils import metrics as M\n\n"
+           "def f(jitted, cols):\n"
+           "    return with_retry(lambda: (M.record_dispatch(),\n"
+           "                               jitted(cols))[1], site='x')\n")
+    assert rules_of(lint(src)) == []
+
+
+def test_naked_dispatch_pragma_suppresses():
+    src = ("from spark_rapids_tpu.utils import metrics as M\n\n"
+           "def f(jitted, cols):\n"
+           "    # tpulint: naked-dispatch -- measurement-only dispatch\n"
+           "    M.record_dispatch()\n"
+           "    return jitted(cols)\n")
+    assert rules_of(lint(src)) == []

@@ -32,6 +32,16 @@ the line above; `-- reason` after the rule names documents the waiver):
               traced program (XLA-managed) or register the batch with
               the spill framework; tiny fixed-size staging values get a
               justified pragma.
+  naked-dispatch  a device dispatch site (utils.metrics.record_dispatch
+              caller) in a hot-path file that does not go through the
+              fault-tolerance combinators: the dispatch must run inside a
+              closure handed to engine.retry.with_retry /
+              split_and_retry / device_op_with_fallback (by convention a
+              local function named `_attempt*`, or a function/lambda
+              passed to one of those combinators in this file) so an XLA
+              RESOURCE_EXHAUSTED / transient device error spills and
+              re-dispatches instead of killing the query. A dispatch
+              that genuinely cannot retry carries a justified pragma.
   stdout-print  print() to stdout inside the package: workers speak a
               JSON-line protocol on stdout (bench.py, daemons); stray
               prints corrupt it. Print to sys.stderr instead. Files
@@ -61,8 +71,13 @@ RULES = (
     "cpu-oracle",
     "stdout-print",
     "untracked-alloc",
+    "naked-dispatch",
     "pragma",
 )
+
+# the fault-tolerance combinators (engine/retry.py): a callable passed to
+# one of these has its dispatches covered by the retry state machine
+_RETRY_SINKS = {"with_retry", "split_and_retry", "device_op_with_fallback"}
 
 # jnp constructors that materialize a NEW device buffer sized by their
 # arguments (the untracked-alloc rule's targets); asarray/dtype staging
@@ -326,7 +341,9 @@ class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, trace: _TraceIndex,
                  conf_keys: Optional["ConfKeyIndex"],
                  traced_helpers: bool = False,
-                 stdout_protocol: bool = False):
+                 stdout_protocol: bool = False,
+                 retry_names: Optional[Set[str]] = None,
+                 retry_lambdas: Optional[Set[int]] = None):
         self.path = path
         self.hot = is_hot_path(path)
         self.trace = trace
@@ -339,6 +356,10 @@ class _Visitor(ast.NodeVisitor):
         # lambda shape where jax.jit inside runs exactly once (the cache
         # builder); every other lambda is a per-invocation scope
         self._builder_lambdas: Set[int] = set()
+        # functions/lambdas whose dispatches run under the retry
+        # combinators (naked-dispatch rule; collected by _retry_guarded)
+        self._retry_names: Set[str] = retry_names or set()
+        self._retry_lambdas: Set[int] = retry_lambdas or set()
         self.findings: List[Finding] = []
 
     # -- helpers -------------------------------------------------------------
@@ -389,9 +410,13 @@ class _Visitor(ast.NodeVisitor):
         self._visit_scoped(node, node.name, "class")
 
     def visit_Lambda(self, node):
-        self.scope.append("<builder>"
-                          if id(node) in self._builder_lambdas
-                          else "<lambda>")
+        if id(node) in self._builder_lambdas:
+            label = "<builder>"
+        elif id(node) in self._retry_lambdas:
+            label = "<retry-attempt>"
+        else:
+            label = "<lambda>"
+        self.scope.append(label)
         self.scope_kinds.append("func")
         self.generic_visit(node)
         self.scope.pop()
@@ -442,6 +467,16 @@ class _Visitor(ast.NodeVisitor):
                            "compiled program is keyed by function object "
                            "identity, so this recompiles every call — "
                            "cache via get_or_build or a build*() closure")
+
+        # naked-dispatch: a dispatch site outside the retry combinators
+        if self.hot and tail == "record_dispatch" and \
+                not self._retry_guarded_scope():
+            self._flag(node, "naked-dispatch",
+                       "device dispatch without fault-tolerance: run it "
+                       "inside a closure handed to engine.retry."
+                       "with_retry/split_and_retry (name it _attempt*) so "
+                       "an OOM spills and re-dispatches instead of "
+                       "killing the query")
 
         # hot-path-only rules
         if self.hot and not self._host_scope():
@@ -529,6 +564,18 @@ class _Visitor(ast.NodeVisitor):
         # lambda is still a fresh function object per invocation
         return "<builder>" in self.scope
 
+    def _retry_guarded_scope(self) -> bool:
+        """True when the current scope chain runs under a retry combinator:
+        a local `_attempt*`/`attempt*` closure (the with_retry idiom), a
+        function passed by name to with_retry/split_and_retry in this
+        file, or a lambda passed directly to one."""
+        for s in self.scope:
+            if s == "<retry-attempt>" or s in self._retry_names:
+                return True
+            if s.lstrip("_").startswith("attempt"):
+                return True
+        return False
+
 
 # ---------------------------------------------------------------------------
 # conf-key scan (raw text: catches strings, comments, docstrings, markdown)
@@ -595,6 +642,24 @@ def _scan_conf_keys(source: str, path: str, index: ConfKeyIndex,
     return out
 
 
+def _retry_guarded(tree: ast.Module) -> Tuple[Set[str], Set[int]]:
+    """Functions (by local name) and lambdas (by node id) passed to a retry
+    combinator anywhere in the file — their dispatches are covered."""
+    names: Set[str] = set()
+    lambdas: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func).rsplit(".", 1)[-1] not in _RETRY_SINKS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                lambdas.add(id(arg))
+            elif isinstance(arg, ast.Name):
+                names.add(arg.id)
+    return names, lambdas
+
+
 def _stmt_start_map(tree: ast.Module) -> Dict[int, int]:
     """line -> first line of the innermost statement containing it (BFS
     assigns outer statements first, so inner spans overwrite)."""
@@ -622,9 +687,12 @@ def lint_source(source: str, path: str,
     except SyntaxError as e:
         return [Finding(path, e.lineno or 1, "pragma",
                         f"cannot parse: {e.msg}")]
+    retry_names, retry_lambdas = _retry_guarded(tree)
     visitor = _Visitor(path, _TraceIndex(tree), conf_keys,
                        traced_helpers=pragmas.traced_helpers,
-                       stdout_protocol=pragmas.stdout_protocol)
+                       stdout_protocol=pragmas.stdout_protocol,
+                       retry_names=retry_names,
+                       retry_lambdas=retry_lambdas)
     visitor.visit(tree)
     stmt_start = _stmt_start_map(tree)
     findings = [f for f in visitor.findings

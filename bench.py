@@ -283,6 +283,12 @@ def _robustness_metrics(session) -> dict:
         "split_retries": m.get("splitRetries", 0),
         "cpu_fallback_events": m.get("cpuFallbackEvents", 0),
         "fetch_retries": m.get("fetchRetries", 0),
+        # issue-ahead accounting (docs/async-execution.md): fences is the
+        # latency regression metric (~66 ms each on a tunneled backend);
+        # checked replays should be 0 on a healthy run
+        "fences_per_query": m.get("fencesPerQuery", 0),
+        "checked_replays": m.get("checkedReplays", 0),
+        "donated_bytes": m.get("donatedBytes", 0),
     }
 
 
@@ -1144,7 +1150,8 @@ def main() -> None:
     for k in ("sweep_s", "sweep_gbps", "plateau_rows", "hbm_frac",
               "dispatches_fused", "dispatches_unfused", "fused_stages",
               "retries", "split_retries", "cpu_fallback_events",
-              "fetch_retries"):
+              "fetch_retries", "fences_per_query", "checked_replays",
+              "donated_bytes"):
         if k in acc:
             result[k] = acc[k]
     # analyzer predictions ride along with the measured dispatch counts

@@ -426,7 +426,7 @@ class HostColumnarBatch:
                 specs.append(("fixed", hc.dtype,
                               host_value_range(hc.dtype, data[:n])))
         if not parts:
-            return ColumnarBatch([], n)
+            return ColumnarBatch([], n, owned=True)
         arrays = _upload_grouped(parts)
         cols = []
         ai = 0
@@ -442,7 +442,9 @@ class HostColumnarBatch:
                 ai += 2
                 cols.append(ColumnVector(hc.dtype, data, validity,
                                          vrange=spec[2]))
-        return ColumnarBatch(cols, n)
+        # a fresh upload is consume-once by construction (donation-eligible
+        # until some path stores it for re-read and clears the flag)
+        return ColumnarBatch(cols, n, owned=True)
 
 
 class ColumnarBatch:
@@ -459,15 +461,26 @@ class ColumnarBatch:
     rows. A live-masked batch is a zero-copy VIEW used by the in-process
     shuffle: a partition slice is just (shared columns, pid==target mask) —
     no gather, no count sync, no data movement. Consumers compact via
-    `ensure_compact` / `concat_batches` (a single traced scatter)."""
+    `ensure_compact` / `concat_batches` (a single traced scatter).
 
-    __slots__ = ("columns", "num_rows", "live")
+    `owned` marks a batch whose column buffers were FRESHLY materialized
+    for it (an upload, a gather/concat output) and that no other holder
+    can re-read — the consume-once proof buffer DONATION requires
+    (docs/async-execution.md). Producers of fresh buffers set it; any
+    path that stores a batch for potential multi-read (the shuffle's
+    reduce buckets, the spill store's cached device batches) clears it.
+    Donation sites (fused stage, agg update, sort gather) only donate
+    owned batches."""
 
-    def __init__(self, columns: List[ColumnVector], num_rows, live=None):
+    __slots__ = ("columns", "num_rows", "live", "owned")
+
+    def __init__(self, columns: List[ColumnVector], num_rows, live=None,
+                 owned: bool = False):
         self.columns = columns
         self.num_rows = int(num_rows) if isinstance(
             num_rows, (int, np.integer)) else num_rows
         self.live = live
+        self.owned = owned
 
     @property
     def rows_on_host(self) -> bool:
@@ -584,18 +597,44 @@ class ColumnarBatch:
                 f"cols={[c.dtype.name for c in self.columns]})")
 
 
+def _batch_device_key(b: "ColumnarBatch"):
+    """Identity of the single device holding a batch's arrays (None when
+    indeterminate). The grouped download program requires co-located
+    inputs, so to_host_many groups per device — the query-level sink may
+    see batches committed to different chips (ICI exchange outputs)."""
+    if not b.columns:
+        return None
+    devs = getattr(b.columns[0].data, "devices", None)
+    if devs is None:
+        return None
+    try:
+        ds = devs() if callable(devs) else devs
+    except Exception:
+        return None
+    return next(iter(ds)) if len(ds) == 1 else None
+
+
+# device bytes per grouped download transfer; the session's lifted sink
+# accumulates to the SAME budget before flushing (session._SINK_FLUSH_BYTES
+# aliases this), so residency bounds and fence counts stay in step
+DOWNLOAD_BYTE_BUDGET = 256 << 20
+
+
 def to_host_many(batches: Sequence["ColumnarBatch"],
-                 byte_budget: int = 256 << 20) -> List[HostColumnarBatch]:
+                 byte_budget: int = DOWNLOAD_BYTE_BUDGET
+                 ) -> List[HostColumnarBatch]:
     """Download MANY device batches with one grouped transfer (one fence)
     per `byte_budget` worth of data — the collect/transition path would
-    otherwise pay one ~66 ms round trip per batch on tunneled backends."""
+    otherwise pay one ~66 ms round trip per batch on tunneled backends.
+    Batches on different devices download in per-device groups (the
+    grouped pack program needs co-located inputs)."""
     batches = [b if b.live is None else ensure_compact(b) for b in batches]
     out: List[Optional[HostColumnarBatch]] = [None] * len(batches)
-    group: List[Tuple[int, list, Any, int]] = []
-    group_bytes = 0
+    # per-device open group: dev_key -> (entries, bytes)
+    groups: dict = {}
 
-    def flush():
-        nonlocal group, group_bytes
+    def flush(dev_key):
+        group, _bytes = groups.pop(dev_key, ([], 0))
         if not group:
             return
         arrays = tuple(a for _, segs, _, _ in group for a in segs)
@@ -604,7 +643,6 @@ def to_host_many(batches: Sequence["ColumnarBatch"],
         offs = {k: 0 for k in host}
         for bi, _segs, n, trim in group:
             out[bi] = batches[bi]._download_finish(host, offs, n, trim)
-        group, group_bytes = [], 0
 
     for bi, b in enumerate(batches):
         if not b.columns:
@@ -612,11 +650,15 @@ def to_host_many(batches: Sequence["ColumnarBatch"],
             continue
         arrays, n, trim = b._download_plan()
         sz = b.device_memory_size()
+        dev = _batch_device_key(b)
+        group, group_bytes = groups.get(dev, ([], 0))
         if group and group_bytes + sz > byte_budget:
-            flush()
+            flush(dev)
+            group, group_bytes = [], 0
         group.append((bi, arrays, n, trim))
-        group_bytes += sz
-    flush()
+        groups[dev] = (group, group_bytes + sz)
+    for dev in list(groups):
+        flush(dev)
     return out  # type: ignore[return-value]
 
 
@@ -817,7 +859,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
             out_cols[ci] = _concat_string_cols(
                 [b.columns[ci] for b in batches],
                 [b.num_rows for b in batches], cap)
-    return ColumnarBatch(out_cols, total)
+    return ColumnarBatch(out_cols, total, owned=True)
 
 
 def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
@@ -850,7 +892,7 @@ def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
         (live[None, :],))
     cols = [ColumnVector(c.dtype, d, v, vrange=c.vrange)
             for c, (d, v) in zip(batch.columns, outs)]
-    return ColumnarBatch(cols, total)
+    return ColumnarBatch(cols, total, owned=True)
 
 
 def _group_pieces(buckets: Sequence) -> List[Tuple[Any, int, List[int]]]:
@@ -1166,12 +1208,44 @@ def _concat_string_cols(cols: List[ColumnVector], nrows: List[int],
                         max_len=out_ml)
 
 
+def _gather_fixed_cols_donated(cap: int, datas, valids, indices,
+                               indices_valid, out_rows):
+    """Donated flavor of _gather_fixed_cols: the source column buffers
+    (`datas`/`valids`) are donated into the kernel, so the gathered output
+    reuses their HBM instead of doubling the batch footprint
+    (docs/async-execution.md). Cached via get_or_build so the donation
+    flag is part of the program key; callers must hold the consume-once
+    proof (ColumnarBatch.owned) and route the dispatch through
+    with_retry(donated=True)."""
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    key = ("gather_fixed", cap,
+           tuple((d.dtype.name, int(d.shape[0])) for d in datas),
+           indices_valid is None)
+
+    def build(donate_argnums=()):
+        def fn(datas, valids, indices, indices_valid, out_rows):
+            return _gather_fixed_body(cap, datas, valids, indices,
+                                      indices_valid, out_rows)
+
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    return get_or_build(key, build, donate_argnums=(0, 1))(
+        datas, valids, indices, indices_valid, out_rows)
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _gather_fixed_cols(cap: int, datas, valids, indices, indices_valid,
                        out_rows):
     """One fused gather for every fixed-width column of a batch (a single
     device dispatch — critical when the accelerator sits behind a network
     tunnel and each eager op is a round trip)."""
+    return _gather_fixed_body(cap, datas, valids, indices, indices_valid,
+                              out_rows)
+
+
+def _gather_fixed_body(cap: int, datas, valids, indices, indices_valid,
+                       out_rows):
     idx = indices[:cap]
     sel_mask = jnp.arange(cap) < out_rows
     src_cap = valids[0].shape[0] if valids else 0
@@ -1239,7 +1313,8 @@ def _sync_free_strings() -> bool:
 
 def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
                  indices_valid=None,
-                 unique_indices: bool = False) -> ColumnarBatch:
+                 unique_indices: bool = False,
+                 donate: bool = False) -> ColumnarBatch:
     """Gather rows by index into a new batch of `out_rows` logical rows.
     `indices` is a device int32 array of length >= bucket_capacity(out_rows);
     entries >= capacity are treated as 'emit null row' (used by outer joins).
@@ -1248,6 +1323,13 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     permutations, group representatives, contiguous partition slices):
     string output bytes are then bounded by the source buffer, which — on
     high-fence backends — removes the per-gather byte-count round trip.
+
+    donate=True donates the fixed-width source buffers into the gather
+    (the sort-scatter hot path): the caller must own the batch
+    (ColumnarBatch.owned) and wrap the dispatch in
+    with_retry(donated=True) — the sources are consumed, so re-dispatch
+    is impossible. String columns never donate (their source bytes are
+    re-read after the plan phase below).
     """
     cap = bucket_capacity(max(out_rows, 1))
     M.record_dispatch()
@@ -1257,8 +1339,11 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     if fixed:
         datas = tuple(cv.data for _, cv in fixed)
         valids = tuple(cv.validity for _, cv in fixed)
-        outs = _gather_fixed_cols(cap, datas, valids, indices,
-                                  indices_valid, np.int32(out_rows))
+        outs = _gather_fixed_cols_donated(
+            cap, datas, valids, indices, indices_valid,
+            np.int32(out_rows)) if donate else \
+            _gather_fixed_cols(cap, datas, valids, indices,
+                               indices_valid, np.int32(out_rows))
         for (i, cv), (data, validity) in zip(fixed, outs):
             # gathered values are a subset of the source (null lanes hold 0),
             # so the source range bound still holds
@@ -1291,7 +1376,7 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
             cols[i] = ColumnVector(DataType.STRING, out, validity,
                                    new_offsets,
                                    max_len=batch.columns[i].max_len)
-    return ColumnarBatch(cols, out_rows)
+    return ColumnarBatch(cols, out_rows, owned=True)
 
 
 def _string_plan_body(offsets, validity, idx, in_bounds, sel_mask):
@@ -1397,7 +1482,7 @@ def _gather_batch_traced(batch: ColumnarBatch, indices,
                                    int(cv.data.shape[0]))
         cols[i] = ColumnVector(DataType.STRING, out, validity, new_offsets,
                                max_len=cv.max_len)
-    return ColumnarBatch(cols, out_rows)
+    return ColumnarBatch(cols, out_rows, owned=True)
 
 
 @jax.jit

@@ -242,6 +242,17 @@ MAX_READ_BATCH_SIZE_BYTES = _conf("rapids.tpu.sql.reader.batchSizeBytes").doc(
     "Max bytes per batch produced by file readers."
 ).bytes(512 << 20)
 
+IO_PREFETCH_BATCHES = _conf("rapids.tpu.io.prefetchBatches").doc(
+    "Scan decode double-buffering depth: how many host-decoded batches a "
+    "file scan stages AHEAD of the consumer on a background reader thread, "
+    "so batch k+1 decodes (and its upload can issue) while batch k "
+    "computes (docs/async-execution.md). 0 disables prefetch (decode "
+    "inline on the consumer thread); with depth k up to (2 + k) decoded "
+    "batches are live per scan task (the consumer's, the reader's "
+    "in-hand one, and k queued) — the resource analyzer charges "
+    "scan-leaf peak HBM accordingly."
+).check(lambda v: None if 0 <= v <= 16 else "must be in [0,16]").integer(1)
+
 # ---------------------------------------------------------------------------
 # Per-format / per-feature enables (reference: RapidsConf.scala:433-469)
 # ---------------------------------------------------------------------------
@@ -602,6 +613,38 @@ RETRY_BACKOFF_MS = _conf("rapids.tpu.engine.retryBackoffMs").doc(
 ).check(lambda v: None if v >= 0 else "must be >= 0").double(5.0)
 
 # ---------------------------------------------------------------------------
+# Async issue-ahead execution (engine/async_exec.py, docs/async-execution.md)
+# ---------------------------------------------------------------------------
+ASYNC_DISPATCH = _conf("rapids.tpu.execution.asyncDispatch.enabled").doc(
+    "Issue-ahead execution: operators hand downstream UNBLOCKED device "
+    "futures and the query blocks on device values exactly once, at the "
+    "result sink — so a device error may surface at the sink instead of "
+    "the dispatch that issued the failing program. When that happens the "
+    "session re-executes the query once in CHECKED mode (synchronous "
+    "dispatch, donation off) where the originating operator's own "
+    "spill/split-retry machinery owns the error, before any CPU fallback "
+    "(metric: checkedReplays). Off = always run checked."
+).boolean(True)
+
+BUFFER_DONATION = _conf("rapids.tpu.execution.bufferDonation.enabled").doc(
+    "Donate input buffers to consume-once device kernels (fused stages, "
+    "aggregate update, sort gather) via XLA donate_argnums so the output "
+    "reuses the input's HBM instead of allocating fresh — cuts peak HBM "
+    "churn roughly in half on those paths. Effective only on platforms "
+    "that support donation (not the CPU backend). A donated dispatch "
+    "cannot re-dispatch in place after a failure (its inputs are gone), "
+    "so failures escalate to the query-level checked replay, which runs "
+    "with donation off (docs/async-execution.md)."
+).boolean(True)
+
+BUFFER_DONATION_ASSUME_SUPPORTED = _conf(
+    "rapids.tpu.execution.bufferDonation.assumeSupported").doc(
+    "Treat the current backend as donation-capable even when it is the "
+    "CPU backend (tests exercise the donation key-threading and the "
+    "escalation contract without a real chip)."
+).internal().boolean(False)
+
+# ---------------------------------------------------------------------------
 # Fault injection (utils/faultinject.py; the chaos-test substrate)
 # ---------------------------------------------------------------------------
 FAULT_INJECTION_ENABLED = _conf(
@@ -632,6 +675,17 @@ FAULT_INJECTION_RATE = _conf("rapids.tpu.test.faultInjection.rate").doc(
     "terminate; the CPU fallback backstops rate = 1)."
 ).check(lambda v: None if 0.0 <= v <= 1.0 else "must be in [0,1]"
         ).double(0.25)
+
+FAULT_INJECTION_DEFER_TO_SINK = _conf(
+    "rapids.tpu.test.faultInjection.deferToSink").doc(
+    "Model async dispatch's error timing: a fault that fires at a "
+    "device-compute site (scan/fused/agg/join/sort) is RECORDED instead "
+    "of raised, and surfaces at the next result-sink download "
+    "(transfer.download) re-attributed to its originating site — "
+    "exactly how a real XLA async error reaches the host. The checked "
+    "replay (asyncDispatch doc) disables deferral, so the replay's "
+    "faults raise at their sites where split-retry owns them."
+).internal().boolean(False)
 
 # ---------------------------------------------------------------------------
 # Static analysis (plan/verify.py, docs/static-analysis.md)

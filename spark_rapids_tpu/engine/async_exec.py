@@ -1,0 +1,101 @@
+"""Issue-ahead execution state: async dispatch, buffer donation, checked mode.
+
+The tentpole contract (docs/async-execution.md): JAX dispatch is
+asynchronous — a kernel launch returns an unblocked device future and the
+host only waits when a value crosses to it. The engine therefore blocks on
+device values exactly once per query, at the result sink (the
+`site="transfer.download"` grouped downloads); every mid-query
+`device_get`/`np.asarray`/`.item()` is either removed or a pragma-justified
+planned sync. Two consequences this module owns the state for:
+
+1. **Error re-attribution.** Under async dispatch a device error (OOM, a
+   poisoned program) surfaces wherever the host first BLOCKS — the sink —
+   not at the dispatch that issued the failing program. The per-site retry
+   combinators (engine/retry.py) cannot spill-and-retry or bisect a batch
+   whose originating dispatch returned long ago, so the session re-executes
+   the query once in CHECKED mode: synchronous semantics, donation off,
+   fault-injection deferral off. In checked mode errors surface at the
+   issuing dispatch, where `with_retry`/`split_and_retry` re-attribute them
+   to the right batch exactly as before this refactor. Only if the checked
+   replay also fails does the query-level CPU fallback engage.
+
+2. **Donation gating.** `donate_argnums` kernels consume their inputs, so
+   a donated dispatch can never re-dispatch in place; donation is only
+   armed when the platform supports it AND checked mode is off. The flags
+   are process-wide (kernels trace with no session in scope, same contract
+   as conf.sync_int64_narrowing) and refreshed at every query start by
+   session.execute_batches.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_LOCK = threading.Lock()
+_ASYNC_ENABLED = True
+_DONATION_ENABLED = False
+# depth of nested checked-mode scopes (int, not bool: the checked replay
+# may itself re-enter planning helpers that open a scope)
+_CHECKED_DEPTH = 0
+
+
+def configure(tpu_conf, device_manager=None) -> None:
+    """Refresh the issue-ahead flags from the executing session's conf
+    (called at every query start). Donation additionally requires a
+    donation-capable backend: the CPU backend ignores donate_argnums (with
+    a warning per dispatch), so it only arms on a real accelerator — or
+    under the internal assumeSupported override the tests use."""
+    from spark_rapids_tpu import conf as C
+
+    global _ASYNC_ENABLED, _DONATION_ENABLED
+    supported = bool(device_manager is not None and device_manager.is_tpu) \
+        or bool(tpu_conf.get(C.BUFFER_DONATION_ASSUME_SUPPORTED))
+    with _LOCK:
+        _ASYNC_ENABLED = bool(tpu_conf.get(C.ASYNC_DISPATCH))
+        _DONATION_ENABLED = bool(tpu_conf.get(C.BUFFER_DONATION)) and \
+            supported
+
+
+def async_enabled() -> bool:
+    """Issue-ahead semantics are on and we are NOT inside a checked
+    replay (checked mode forces synchronous error attribution)."""
+    with _LOCK:
+        return _ASYNC_ENABLED and _CHECKED_DEPTH == 0
+
+
+def donation_active() -> bool:
+    """Donated kernel variants may be selected for this dispatch. False
+    inside checked mode: the replay must be able to re-dispatch and
+    bisect, which consumed inputs forbid."""
+    with _LOCK:
+        return _DONATION_ENABLED and _CHECKED_DEPTH == 0
+
+
+def in_checked_mode() -> bool:
+    with _LOCK:
+        return _CHECKED_DEPTH > 0
+
+
+def replay_warranted() -> bool:
+    """Whether a device-rooted failure should get one checked replay
+    before the CPU fallback: some issue-ahead behavior (async attribution
+    or donation) was active, and we are not already replaying."""
+    with _LOCK:
+        return (_ASYNC_ENABLED or _DONATION_ENABLED) and \
+            _CHECKED_DEPTH == 0
+
+
+@contextlib.contextmanager
+def checked_mode():
+    """Run a query with synchronous error attribution: async issue-ahead
+    off, donation off, fault-injection sink-deferral off. The session's
+    replay path wraps re-planning AND re-execution in one scope."""
+    global _CHECKED_DEPTH
+    with _LOCK:
+        _CHECKED_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _CHECKED_DEPTH -= 1

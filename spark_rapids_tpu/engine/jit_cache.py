@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Callable, Hashable
+from typing import Any, Callable, Hashable, Optional, Tuple
 
 _LOCK = threading.Lock()
 # LRU-bounded: expression fingerprints embed literal values, so a stream of
@@ -53,15 +53,33 @@ class _SaltPinnedKernel:
             return self._fn(*args, **kwargs)
 
 
-def get_or_build(key: Hashable, builder: Callable[[], Any]) -> Any:
+def get_or_build(key: Hashable, builder: Callable[[], Any],
+                 donate_argnums: Optional[Tuple[int, ...]] = None) -> Any:
+    """Fetch or build a cached kernel. `donate_argnums` is the CALLER'S
+    resolved donation decision for this dispatch ((…) = donate these
+    argument buffers, () = donate nothing, None = not a donation-aware
+    site): the builder is invoked with `donate_argnums=<the tuple>` and
+    must thread it into its jax.jit. The decision is resolved at the call
+    site (engine/async_exec.donation_active + the batch's consume-once
+    proof) and passed down VERBATIM — re-deriving the process-wide flag
+    here could diverge from what the caller's retry wrapper believes
+    (docs/async-execution.md). The tuple is part of the cache key, so
+    donated and undonated variants coexist; flipping the conf or entering
+    a checked replay selects, never invalidates."""
     salt = _key_salt()
-    key = (key, salt)
+    effective_dn: Optional[Tuple[int, ...]] = None
+    if donate_argnums is not None:
+        effective_dn = tuple(donate_argnums)
+        key = (key, salt, ("donate", effective_dn))
+    else:
+        key = (key, salt)
     with _LOCK:
         got = _CACHE.get(key)
         if got is not None:
             _CACHE.move_to_end(key)
             return got
-    built = builder()
+    built = builder(donate_argnums=effective_dn) \
+        if effective_dn is not None else builder()
     if callable(built):
         built = _SaltPinnedKernel(built, salt)
     with _LOCK:

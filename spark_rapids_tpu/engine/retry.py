@@ -68,6 +68,23 @@ class TpuTransientDeviceError(TpuRetryableError):
     transport): re-dispatch after backoff, the input is intact."""
 
 
+class TpuAsyncSinkError(TpuRetryableError):
+    """A device failure the per-site machinery cannot own IN PLACE under
+    issue-ahead execution (docs/async-execution.md): either the error
+    surfaced at the result sink (the dispatch that issued the failing
+    program returned long ago — async attribution), or a DONATED dispatch
+    failed (its inputs were consumed, so neither re-dispatch nor batch
+    bisection has anything to run on). Never retried at the dispatch or
+    task layer; `origin_site` re-attributes it to the operator that issued
+    the work, and the session re-executes the query once in CHECKED mode
+    (engine/async_exec.checked_mode) where that operator's own
+    spill/split-retry machinery owns the error synchronously."""
+
+    def __init__(self, message: str, origin_site: Optional[str] = None):
+        super().__init__(message)
+        self.origin_site = origin_site
+
+
 # deterministic failure classes: retrying cannot change the outcome
 # (moved here from engine/scheduler so every layer classifies identically)
 NON_RETRYABLE = (TypeError, ValueError, AssertionError, NotImplementedError,
@@ -96,6 +113,12 @@ def is_retryable_failure(e: BaseException) -> bool:
     below the cost of failing a query on an unclassified hiccup."""
     from spark_rapids_tpu.engine.scheduler import FetchFailedError
 
+    if isinstance(e, TpuAsyncSinkError):
+        # the failing state is gone (async sink surface / consumed donated
+        # inputs): a task-level re-run would mask the error non-
+        # deterministically — fail fast so the session's checked replay
+        # re-attributes it to the originating op
+        return False
     if isinstance(e, (TpuRetryableError, FetchFailedError)):
         return True
     if isinstance(e, NON_RETRYABLE):
@@ -106,6 +129,26 @@ def is_retryable_failure(e: BaseException) -> bool:
     return True
 
 
+def _cause_chain(e: BaseException):
+    """Walk an exception and its causes/contexts exactly once each."""
+    seen = set()
+    node: Optional[BaseException] = e
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        yield node
+        node = node.__cause__ or node.__context__
+
+
+def failure_needs_checked_replay(e: BaseException) -> bool:
+    """Whether a failure (or anything on its cause chain) is a
+    TpuAsyncSinkError — the only failures whose true origin the per-site
+    machinery could NOT own in place (sink-surfaced async errors, donated
+    dispatches). Everything else was already attributed at its dispatch
+    site and retried/split there; replaying the whole query in checked
+    mode would just repeat the identical failure at 2x cost."""
+    return any(isinstance(n, TpuAsyncSinkError) for n in _cause_chain(e))
+
+
 def failure_is_device_rooted(e: BaseException) -> bool:
     """Whether a failure (or anything on its cause chain) is a typed device
     error or an exhausted shuffle fetch — the gate for query-level CPU
@@ -114,15 +157,9 @@ def failure_is_device_rooted(e: BaseException) -> bool:
     the only alternative to the fallback is failing the job."""
     from spark_rapids_tpu.engine.scheduler import FetchFailedError
 
-    seen = set()
-    node: Optional[BaseException] = e
-    while node is not None and id(node) not in seen:
-        seen.add(id(node))
-        if isinstance(node, FetchFailedError) or \
-                as_typed_error(node) is not None:
-            return True
-        node = node.__cause__ or node.__context__
-    return False
+    return any(isinstance(n, FetchFailedError)
+               or as_typed_error(n) is not None
+               for n in _cause_chain(e))
 
 
 # ---------------------------------------------------------------------------
@@ -195,13 +232,21 @@ def _spill_for_retry(site: str) -> int:
 # ---------------------------------------------------------------------------
 # Combinators
 # ---------------------------------------------------------------------------
-def with_retry(attempt: Callable[[], T], site: str = "device") -> T:
+def with_retry(attempt: Callable[[], T], site: str = "device",
+               donated: bool = False) -> T:
     """Run one dispatch closure with the OOM/transient retry state machine.
 
     The fault-injection harness is consulted INSIDE the attempt loop, so an
     injected fault consumes a retry exactly like a real one and every retry
     re-rolls the (deterministic) injection decision. Non-retryable errors
-    propagate untouched on the first raise."""
+    propagate untouched on the first raise.
+
+    `site="transfer.download"` closures are the engine's device->host
+    fence chokepoint: each counts one fence (utils/metrics.record_fence,
+    the fencesPerQuery unit). `donated=True` marks a dispatch whose input
+    buffers are donated into the kernel: a retryable failure cannot
+    re-dispatch (the inputs are consumed), so it escalates straight to
+    TpuAsyncSinkError for the session's checked replay."""
     from spark_rapids_tpu.utils import faultinject as FI
 
     pol = _POLICY
@@ -211,11 +256,28 @@ def with_retry(attempt: Callable[[], T], site: str = "device") -> T:
     while True:
         try:
             FI.maybe_inject(site)
+            # per ATTEMPT, after injection: a retried download issues a
+            # real second transfer (counted), an injected sink fault
+            # aborts before any transfer (not counted)
+            if site == "transfer.download":
+                M.record_fence()
             return attempt()
         except Exception as e:  # noqa: BLE001 — classification boundary
             typed = as_typed_error(e)
             if typed is None:
                 raise
+            if isinstance(typed, TpuAsyncSinkError):
+                # already attributed for the checked replay: neither this
+                # wrapper nor an outer one may absorb it
+                if typed is e:
+                    raise
+                raise typed from e
+            if donated:
+                raise TpuAsyncSinkError(
+                    f"{site}: donated dispatch failed ({typed}); its "
+                    "inputs were consumed, so in-place retry is "
+                    "impossible — checked replay required",
+                    origin_site=site) from e
             if isinstance(typed, TpuSplitAndRetryOOM):
                 # an inner wrapper already exhausted its OOM budget: do not
                 # multiply budgets, hand the escalation straight up
@@ -306,6 +368,12 @@ def device_op_with_fallback(batch_fn: Callable, batch,
     except Exception as e:  # noqa: BLE001 — classification boundary
         typed = as_typed_error(e)
         if typed is None:
+            raise
+        if isinstance(typed, TpuAsyncSinkError):
+            # the batch may be consumed (donation) or the error belongs to
+            # an earlier dispatch (async sink surface): a per-batch CPU
+            # replay could read poisoned inputs — the session's checked
+            # replay owns this failure
             raise
         breaker.record_failure()
         if cpu_fn is None or not _POLICY.cpu_fallback:

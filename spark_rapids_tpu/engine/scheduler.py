@@ -186,7 +186,15 @@ class TaskScheduler:
 
     def run_job_iter(self, num_partitions: int,
                      fn: Callable[[int], T]) -> Iterator[T]:
-        """Yield per-partition results as they complete (unordered)."""
+        """Yield per-partition results as they complete (unordered).
+        Mirrors run_job's inline fast path: 0/1-partition jobs never
+        touch the pool (single-partition interactive queries are the
+        latency case the issue-ahead sink exists for)."""
+        if num_partitions == 0:
+            return
+        if num_partitions == 1:
+            yield self._run_task(0, fn)
+            return
         pool = self._ensure_pool()
         futures = [pool.submit(self._run_task, p, fn)
                    for p in range(num_partitions)]

@@ -271,7 +271,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
     # -- jitted kernels (cached process-wide by semantic identity) -----------
     def _build_update_kernel(self, input_attrs, key_exprs, input_exprs,
                              op_names, filters, lazy: bool,
-                             n_chunks: int = 0):
+                             n_chunks: int = 0, donate: bool = False):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
         bound_keys = bind_all(key_exprs, input_attrs)
@@ -287,7 +287,7 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         from spark_rapids_tpu.ops.values import EvalContext, ScalarV
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
-        def build():
+        def build(donate_argnums=()):
             def kernel(cols, num_rows):
                 capacity = cols[0].validity.shape[0] if cols else 8
                 ctx = EvalContext(jnp, True, cols, num_rows, capacity)
@@ -327,9 +327,13 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                             gi.num_groups)
                 return key_cols, buf_outs, gi
 
-            return jax.jit(kernel)
+            # donate_argnums=(0,) donates the input batch's columns into
+            # the update program (lazy form only: in-kernel assembly reads
+            # nothing from the inputs afterwards; docs/async-execution.md)
+            return jax.jit(kernel, donate_argnums=donate_argnums)
 
-        return get_or_build(key, build)
+        return get_or_build(key, build,
+                            donate_argnums=(0,) if donate else ())
 
     def _lazy_ok(self) -> bool:
         """In-kernel assembly (device-scalar row counts, zero per-batch
@@ -541,15 +545,21 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
 
         def merge(batch: ColumnarBatch) -> ColumnarBatch:
             nc = str_chunks(batch, str_merge_ords)
-            if merge_kernel[0] is None or merge_kernel[0][0] != nc:
-                merge_kernel[0] = (
-                    nc, self._build_merge_kernel(n_keys, lazy, nc))
+            # capture the kernel in a local: the memo slot is shared by
+            # concurrent partition tasks, and _attempt must dispatch the
+            # kernel THIS batch's key selected, not whatever a racing
+            # task installed meanwhile
+            memo = merge_kernel[0]
+            if memo is None or memo[0] != nc:
+                memo = (nc, self._build_merge_kernel(n_keys, lazy, nc))
+                merge_kernel[0] = memo
+            kern = memo[1]
             cols = [_col_to_colv(c) for c in batch.columns]
             kvr = [c.vrange for c in batch.columns[:n_keys]]
 
             def _attempt():
                 M.record_dispatch()
-                return merge_kernel[0][1](cols, count_arg(batch))
+                return kern(cols, count_arg(batch))
 
             out = with_retry(_attempt, site="agg.merge")
             if lazy:
@@ -572,6 +582,10 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
 
         def agg_partition(pidx: int):
             from spark_rapids_tpu.columnar.batch import ensure_compact
+            from spark_rapids_tpu.engine import async_exec as AX
+            from spark_rapids_tpu.memory.device_manager import (
+                TpuDeviceManager,
+            )
 
             kvr_cache: Dict[tuple, list] = {}
             running: Optional[ColumnarBatch] = None
@@ -583,21 +597,38 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     nc = str_chunks(batch, str_update_ords)
                     b_lazy = update_lazy and \
                         batch.capacity * inter_width <= lazy_out_cap_bytes
-                    if update_kernel[0] is None or \
-                            update_kernel[0][0] != (nc, b_lazy):
-                        update_kernel[0] = ((nc, b_lazy),
-                                            self._build_update_kernel(
+                    # update-side donation (docs/async-execution.md): the
+                    # lazy kernel assembles its output in-trace and reads
+                    # nothing from the inputs afterwards, so an OWNED
+                    # input batch donates its buffers into the update
+                    b_donate = b_lazy and batch.owned and \
+                        AX.donation_active()
+                    # capture the kernel in a local: concurrent partition
+                    # tasks share the memo slot, and a stale read across
+                    # the donation dimension would run a DONATED program
+                    # on a batch whose owner never consented — silent
+                    # buffer consumption, not just a shape error
+                    memo = update_kernel[0]
+                    if memo is None or memo[0] != (nc, b_lazy, b_donate):
+                        memo = ((nc, b_lazy, b_donate),
+                                self._build_update_kernel(
                             child_attrs, key_exprs, input_exprs, op_names,
-                            filters, b_lazy, nc))
+                            filters, b_lazy, nc, donate=b_donate))
+                        update_kernel[0] = memo
+                    kern = memo[1]
                     cols = [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
+                    if b_donate:
+                        TpuDeviceManager.get().note_donation(
+                            batch.device_memory_size())
 
                     def _attempt():
                         M.record_dispatch()
-                        return update_kernel[0][1](cols, count_arg(batch))
+                        return kern(cols, count_arg(batch))
 
-                    out = with_retry(_attempt, site="agg.update")
+                    out = with_retry(_attempt, site="agg.update",
+                                     donated=b_donate)
                     # keyed by the batch's (quantized) column vranges so the
                     # symbolic walk runs once per distinct range profile,
                     # not once per batch

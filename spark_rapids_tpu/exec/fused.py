@@ -190,16 +190,16 @@ class TpuFusedStageExec(TpuExec):
             for k in kinds[kinds.index("expand") + 1:])
         self._programs = {}
 
-    def _program(self, variant: int):
+    def _program(self, variant: int, donated: bool = False):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
-        cached = self._programs.get(variant)
+        cached = self._programs.get((variant, donated))
         if cached is not None:
             return cached
         ops = self._ops
         key = ("fused_stage", tuple(op.fingerprint() for op in ops), variant)
 
-        def build():
+        def build(donate_argnums=()):
             msgs: List[str] = []
 
             def fn(cols: List[ColV], num_rows, partition_id, row_start,
@@ -238,10 +238,15 @@ class TpuFusedStageExec(TpuExec):
                 return ([_widen_physical(c) for c in cur], live,
                         limit_passed, [f for f, _ in ansi])
 
-            return jax.jit(fn), msgs
+            # donate_argnums=(0,) donates the input batch's columns into
+            # the stage program when donation is armed (the cache key
+            # carries the effective donation, so donated/undonated
+            # variants coexist; docs/async-execution.md)
+            return jax.jit(fn, donate_argnums=donate_argnums), msgs
 
-        built = get_or_build(key, build)
-        self._programs[variant] = built
+        built = get_or_build(key, build,
+                             donate_argnums=(0,) if donated else ())
+        self._programs[(variant, donated)] = built
         return built
 
     # -- execution ------------------------------------------------------------
@@ -308,8 +313,8 @@ class TpuFusedStageExec(TpuExec):
                 return cols
 
             def dispatch_variant(variant, cols, n, pidx, row_start,
-                                 remaining):
-                jitted, msgs = self._program(variant)
+                                 remaining, donated=False):
+                jitted, msgs = self._program(variant, donated)
 
                 def _attempt():
                     M.record_dispatch()
@@ -319,7 +324,7 @@ class TpuFusedStageExec(TpuExec):
                     raise_deferred_ansi(flags, msgs)
                     return outs, live, limit_passed
 
-                return with_retry(_attempt, site="fused")
+                return with_retry(_attempt, site="fused", donated=donated)
 
             def compact_plan(live, n):
                 def _attempt():
@@ -331,19 +336,62 @@ class TpuFusedStageExec(TpuExec):
             def run_simple(b: ColumnarBatch, off: int) -> ColumnarBatch:
                 """One-variant no-limit batch: the split-and-retry /
                 CPU-fallback unit."""
+                from spark_rapids_tpu.engine import async_exec as AX
+                from spark_rapids_tpu.memory.device_manager import (
+                    TpuDeviceManager,
+                )
+
                 cols = prep_cols(b)
                 n = jnp.asarray(b.num_rows, dtype=jnp.int32)
+                # the stage consumes its input exactly once, so an OWNED
+                # input batch donates its buffers into the stage program
+                # (docs/async-execution.md); failures then escalate to the
+                # checked replay instead of re-dispatching in place
+                donated = AX.donation_active() and b.owned
+                if donated:
+                    TpuDeviceManager.get().note_donation(
+                        b.device_memory_size())
                 outs, live, _lp = dispatch_variant(
-                    0, cols, n, pidx, row_start + off, None)
-                out = ColumnarBatch([_colv_to_col(o) for o in outs],
-                                    b.num_rows)
-                if self._row_changing:
-                    order, nk = compact_plan(live, n)
-                    # tpulint: host-sync -- policy-gated stage-exit
-                    n_keep = nk if lazy else int(jax.device_get(nk))
-                    out = _gather_batch_traced(out, order, n_keep) \
-                        if lazy else gather_batch(out, order, n_keep)
-                return out
+                    0, cols, n, pidx, row_start + off, None,
+                    donated=donated)
+
+                def finish():
+                    # ownership propagates: outputs are fresh kernel
+                    # buffers (identity pass-throughs alias the consumed
+                    # input, which only an owned input may hand on)
+                    out = ColumnarBatch([_colv_to_col(o) for o in outs],
+                                        b.num_rows, owned=b.owned)
+                    if self._row_changing:
+                        order, nk = compact_plan(live, n)
+                        # tpulint: host-sync -- policy-gated stage-exit
+                        n_keep = nk if lazy else int(jax.device_get(nk))
+                        out2 = _gather_batch_traced(out, order, n_keep) \
+                            if lazy else gather_batch(out, order, n_keep)
+                        return out2
+                    return out
+
+                if not donated:
+                    return finish()
+                try:
+                    return finish()
+                except Exception as e:  # noqa: BLE001 - escalation gate
+                    from spark_rapids_tpu.engine.retry import (
+                        TpuAsyncSinkError,
+                        as_typed_error,
+                    )
+
+                    typed = as_typed_error(e)
+                    if typed is None or \
+                            isinstance(typed, TpuAsyncSinkError):
+                        raise
+                    # the input batch was donated into the stage program:
+                    # split-retry and the per-batch CPU replay would
+                    # re-read consumed buffers — escalate to the checked
+                    # replay (which runs with donation off)
+                    raise TpuAsyncSinkError(
+                        f"fused: failure after a donated dispatch "
+                        f"({typed}); inputs were consumed — checked "
+                        "replay required", origin_site="fused") from e
 
             def cpu_replay(hb, off: int):
                 """Re-run the member chain bottom-up on the host oracle."""

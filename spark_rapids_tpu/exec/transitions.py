@@ -103,6 +103,35 @@ class RequireSingleBatch(CoalesceGoal):
         return isinstance(other, RequireSingleBatch)
 
 
+def sink_download_many(run):
+    """Grouped sink download with async error attribution: the ONE place
+    a query is allowed to block on device values. A device-rooted error
+    surfacing here under issue-ahead execution belongs to some upstream
+    dispatch, not to the transfer — it re-raises as TpuAsyncSinkError so
+    the session's checked replay re-attributes it to the originating op
+    (docs/async-execution.md). Shared by the query-level lifted sink and
+    the per-partition DeviceToHostExec path."""
+    from spark_rapids_tpu.columnar.batch import to_host_many
+    from spark_rapids_tpu.engine.async_exec import async_enabled
+    from spark_rapids_tpu.engine.retry import (
+        TpuAsyncSinkError,
+        as_typed_error,
+        with_retry,
+    )
+
+    try:
+        return with_retry(lambda: to_host_many(run),
+                          site="transfer.download")
+    except Exception as e:  # noqa: BLE001 — attribution boundary
+        typed = as_typed_error(e)
+        if typed is None or isinstance(typed, TpuAsyncSinkError) or \
+                not async_enabled():
+            raise
+        raise TpuAsyncSinkError(
+            f"device error surfaced at the sink download: {typed}"
+        ) from e
+
+
 # ---------------------------------------------------------------------------
 # Transitions
 # ---------------------------------------------------------------------------
@@ -173,9 +202,6 @@ class DeviceToHostExec(PhysicalExec):
         total_time = self.metrics[M.TOTAL_TIME]
 
         def factory(pidx: int) -> Iterator[HostColumnarBatch]:
-            from spark_rapids_tpu.columnar.batch import to_host_many
-            from spark_rapids_tpu.engine.retry import with_retry
-
             sem = TpuSemaphore.get()
             try:
                 # drain in bounded runs and download each run with ONE
@@ -192,15 +218,13 @@ class DeviceToHostExec(PhysicalExec):
                     run_bytes += db.device_memory_size()
                     if len(run) >= run_cap or run_bytes > (128 << 20):
                         with M.trace_range("DeviceToHost", total_time):
-                            hbs = with_retry(lambda: to_host_many(run),
-                                             site="transfer.download")
+                            hbs = sink_download_many(run)
                         yield from hbs
                         run, run_bytes = [], 0
                         run_cap = min(run_cap * 2, 32)
                 if run:
                     with M.trace_range("DeviceToHost", total_time):
-                        hbs = with_retry(lambda: to_host_many(run),
-                                         site="transfer.download")
+                        hbs = sink_download_many(run)
                     yield from hbs
             finally:
                 sem.release_if_necessary(current_task_id())

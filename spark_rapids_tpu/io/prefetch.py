@@ -1,0 +1,136 @@
+"""Bounded background prefetch for scan decode (double buffering).
+
+The issue-ahead executor (docs/async-execution.md) removes the host's
+mid-query waits on the DEVICE; this module removes the symmetric stall on
+the HOST side of a scan: with a prefetch depth of k, a daemon reader
+thread decodes batch n+1..n+k while the consumer computes on batch n —
+Arrow/pyarrow decode releases the GIL for its I/O and parse work, so the
+overlap is real parallelism, not just interleaving. The consumer then
+uploads on ITS OWN thread (admission-semaphore acquisition is per task
+id, and JAX uploads are asynchronous anyway, so the upload also overlaps
+compute without the prefetcher touching device state).
+
+Depth is `rapids.tpu.io.prefetchBatches` (0 = off, decode inline), with a
+per-read override via `spark.read.option("prefetchBatches", k)`.
+
+Contract:
+- item order is preserved exactly (FIFO);
+- an exception in the source iterator propagates to the consumer at the
+  position where the item would have appeared (fault-injection and IO
+  errors keep their per-batch attribution);
+- `close()` (also called by __del__ and at exhaustion) stops the worker
+  promptly — a consumer that abandons the iterator (LIMIT early-exit,
+  task retry) does not leak a thread decoding an unbounded stream.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, TypeVar
+
+T = TypeVar("T")
+
+_END = object()
+
+
+def _prefetch_worker(source, q: "queue.Queue",
+                     closed: threading.Event) -> None:
+    """Worker body — a free function on purpose: a bound-method target
+    would give the thread a strong reference to the iterator, so an
+    abandoned PrefetchIterator could never be garbage-collected and its
+    worker (plus the staged batches) would leak for the session's
+    lifetime. Every put (items AND the END/error sentinel) retries with a
+    timeout so a consumer that stopped draining can never wedge the
+    worker — close() (or GC -> __del__ -> close()) sets `closed` and the
+    worker exits at the next poll."""
+    def put(payload) -> bool:
+        while not closed.is_set():
+            try:
+                q.put(payload, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        for item in source:
+            if not put(("item", item)):
+                return
+        put((None, _END))
+    except BaseException as e:  # noqa: BLE001 - relayed to consumer
+        put(("error", e))
+
+
+class PrefetchIterator:
+    """Iterate `source` with up to `depth` items staged ahead by a daemon
+    worker thread (depth >= 1; use maybe_prefetch for the 0 = inline
+    gate)."""
+
+    def __init__(self, source: Iterator[T], depth: int,
+                 name: str = "scan-prefetch"):
+        self._depth = max(1, int(depth))
+        # exactly `depth` staged items; the END/error sentinel needs no
+        # reserved slot because every put retries with a timeout. Total
+        # decoded batches live per consumer: depth (queue) + 1 in the
+        # worker's hand + the consumer's current one — the (2 + depth)
+        # the resource analyzer charges scan leaves
+        self._queue: "queue.Queue" = queue.Queue(self._depth)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=_prefetch_worker, args=(source, self._queue,
+                                           self._closed),
+            name=name, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self) -> T:
+        if self._closed.is_set():
+            raise StopIteration
+        kind, payload = self._queue.get()
+        if payload is _END:
+            self.close()
+            raise StopIteration
+        if kind == "error":
+            self.close()
+            raise payload
+        return payload
+
+    def close(self) -> None:
+        """Stop the worker; safe to call multiple times / concurrently."""
+        self._closed.set()
+        # unblock a worker waiting on a full queue
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def maybe_prefetch(source: Iterator[T], depth: int) -> Iterator[T]:
+    """`source` staged `depth` ahead on a worker thread, or `source`
+    itself when depth <= 0 (prefetch disabled)."""
+    if depth <= 0:
+        return source
+    return PrefetchIterator(source, depth)
+
+
+def prefetch_depth(conf, split=None) -> int:
+    """Effective prefetch depth for a scan: the per-read option
+    (`prefetchBatches` on the reader) overrides the session conf."""
+    from spark_rapids_tpu import conf as C
+
+    depth = conf.get(C.IO_PREFETCH_BATCHES)
+    if split is not None:
+        override = split.opt("prefetchBatches")
+        if override is not None:
+            depth = int(override)
+    return max(0, min(16, int(depth)))

@@ -23,6 +23,10 @@ class DataFrameReader:
         self._schema: Optional[List[AttributeReference]] = None
 
     def option(self, key: str, value: Any) -> "DataFrameReader":
+        """Set a read option. Besides the format options (header/sep/
+        inferSchema), `prefetchBatches` overrides the session's
+        rapids.tpu.io.prefetchBatches scan double-buffering depth for
+        THIS read only (0 disables prefetch; docs/async-execution.md)."""
         self._options[key] = value
         return self
 

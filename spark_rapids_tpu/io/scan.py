@@ -406,7 +406,11 @@ class _FileScanBase(PhysicalExec):
     def node_name(self):
         return f"{type(self).__name__}({self.fmt}, {len(self.splits)} splits)"
 
-    def _read_host(self, pidx: int, conf) -> List[HostColumnarBatch]:
+    def _read_host_iter(self, pidx: int, conf):
+        """Generator form of the host decode: the Arrow read runs on first
+        pull, so a prefetch wrapper (io/prefetch.py) moves the WHOLE decode
+        onto its worker thread — batch k+1 of the query decodes while
+        batch k computes downstream."""
         from spark_rapids_tpu import conf as C
 
         split = self.splits[pidx]
@@ -420,9 +424,19 @@ class _FileScanBase(PhysicalExec):
             batch = _with_partition_columns(batch, self.attrs, pv)
         max_rows = conf.get(C.MAX_READ_BATCH_SIZE_ROWS)
         if batch.num_rows <= max_rows:
-            return [batch]
-        return [batch.slice(i, max_rows)
-                for i in range(0, batch.num_rows, max_rows)]
+            yield batch
+            return
+        for i in range(0, batch.num_rows, max_rows):
+            yield batch.slice(i, max_rows)
+
+    def _host_batches_prefetched(self, pidx: int, conf):
+        """Host decode iterator with the configured double-buffering depth
+        (rapids.tpu.io.prefetchBatches; per-read option overrides)."""
+        from spark_rapids_tpu.io.prefetch import maybe_prefetch, prefetch_depth
+
+        return maybe_prefetch(
+            self._read_host_iter(pidx, conf),
+            prefetch_depth(conf, self.splits[pidx]))
 
 
 class CpuFileScanExec(_FileScanBase, CpuExec):
@@ -430,8 +444,9 @@ class CpuFileScanExec(_FileScanBase, CpuExec):
 
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         def factory(pidx: int):
-            return count_output(self.metrics,
-                                iter(self._read_host(pidx, ctx.conf)))
+            return count_output(
+                self.metrics,
+                self._host_batches_prefetched(pidx, ctx.conf))
 
         return PartitionedBatches(len(self.splits), factory)
 
@@ -485,7 +500,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                     if batches is not None:
                         yield from batches
                         return
-                for hb in self._read_host(pidx, ctx.conf):
+                # host path: decode double-buffers on the prefetch worker;
+                # the upload ISSUES here (asynchronously — jax returns an
+                # unblocked device future) under this task's admission
+                # permit, so batch k+1's decode and upload overlap batch
+                # k's downstream compute
+                for hb in self._host_batches_prefetched(pidx, ctx.conf):
                     TpuSemaphore.get().acquire_if_necessary(current_task_id())
                     yield with_retry(lambda: hb.to_device(), site="scan")
 
@@ -769,7 +789,10 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 cols.append(dev_cols[a.name])
             else:
                 cols.append(host_part.columns[host_names.index(a.name)])
-        batch = ColumnarBatch(cols, rows)
+        # decode-kernel outputs + a fresh upload: consume-once by
+        # construction, like the host path's to_device batches — keeps
+        # the analyzer's scan-input donation credit sound
+        batch = ColumnarBatch(cols, rows, owned=True)
         max_rows = conf.get(C2.MAX_READ_BATCH_SIZE_ROWS)
         if rows <= max_rows:
             return [batch]

@@ -46,6 +46,8 @@ class TpuDeviceManager:
         # sampled at every device dispatch while tracking is on
         self._peak_lock = threading.Lock()
         self._live_peak = 0
+        # bytes donated into consume-once kernels (note_donation)
+        self._donated_bytes = 0
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -147,6 +149,29 @@ class TpuDeviceManager:
         return _DEFAULT_HBM_BYTES
 
     # -- accounting ----------------------------------------------------------
+    def note_donation(self, nbytes: int) -> None:
+        """Account input bytes donated into a consume-once kernel
+        (docs/async-execution.md). live-bytes tracking needs no manual
+        correction — the backend allocator's bytes_in_use drops when the
+        program consumes the donated buffers, and the live_arrays
+        fallback stops seeing deleted arrays — but the tally (a) feeds
+        the per-query donatedBytes metric and (b) records that these
+        bytes were never spill-store candidates: donation sites gate on
+        ColumnarBatch.owned, which store-tracked batches never carry, so
+        PR 4's synchronous_spill can never try to spill a donated-away
+        buffer."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        M.record_donated_bytes(int(nbytes))
+        with self._peak_lock:
+            self._donated_bytes += int(nbytes)
+
+    @property
+    def donated_bytes(self) -> int:
+        """Total bytes donated into kernels since process start."""
+        with self._peak_lock:
+            return self._donated_bytes
+
     def bytes_in_use(self) -> int:
         try:
             stats = self.device.memory_stats()

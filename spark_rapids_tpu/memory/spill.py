@@ -413,13 +413,18 @@ class SpillFramework:
         the first writer win."""
         with buf.lock:
             if buf.device_batch is not None:
+                # store-held batches are multi-read by construction: they
+                # must never carry the consume-once donation proof
+                buf.device_batch.owned = False
                 return buf.device_batch
             data = self._read_bytes(buf)
         # outside the lock: spill others + upload
         self.watermark.ensure_headroom(len(data))
         batch = deserialize_batch(data).to_device()
+        batch.owned = False  # multi-read once stored (see above)
         with buf.lock:
             if buf.device_batch is not None:  # lost the race
+                buf.device_batch.owned = False
                 return buf.device_batch
             if buf.tier is None:  # freed meanwhile
                 return batch

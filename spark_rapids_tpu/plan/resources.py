@@ -466,6 +466,11 @@ class PlanResourceReport:
         self.dispatches = Interval.exact(0)
         self.dispatches_exact = True
         self.compile_keys = 0
+        # predicted device->host transfer events (the fencesPerQuery
+        # metric's unit): sink downloads + serialized-shuffle encodes.
+        # The issue-ahead executor's whole point is driving this to ~1
+        # (docs/async-execution.md)
+        self.fences = Interval.exact(0)
         self.nodes: List[NodeEstimate] = []
         self.violations: List[PlanViolation] = []
 
@@ -513,6 +518,8 @@ class PlanResourceReport:
             f"device dispatches: {_fmt_n(self.dispatches.lo)}"
             f"..{_fmt_n(self.dispatches.hi)}"
             + (" (exact)" if self.dispatches_exact else ""),
+            f"host fences (device->host transfers): "
+            f"{_fmt_n(self.fences.lo)}..{_fmt_n(self.fences.hi)}",
             f"jit shape-bucket cache keys: {self.compile_keys}",
         ]
         for n in self.nodes:
@@ -532,7 +539,8 @@ class PlanResourceReport:
 # The analyzer
 # ---------------------------------------------------------------------------
 class _Analyzer:
-    def __init__(self, conf: "C.TpuConf", budget: int):
+    def __init__(self, conf: "C.TpuConf", budget: int,
+                 donation: bool = False):
         from spark_rapids_tpu.columnar.batch import physical_np_dtype
 
         self.conf = conf
@@ -540,6 +548,12 @@ class _Analyzer:
         self.physical = physical_np_dtype
         self.concurrency = max(1, min(conf.concurrent_tpu_tasks,
                                       conf.task_threads))
+        # issue-ahead knobs the model must mirror (docs/async-execution.md):
+        # prefetch holds (1 + depth) scan batches in flight per task, and
+        # donation lets a consume-once kernel's output reuse its input's
+        # HBM (subtracting the input from the pipeline chain estimate)
+        self.prefetch_depth = max(0, int(conf.get(C.IO_PREFETCH_BATCHES)))
+        self.donation = bool(donation)
         self.report = PlanResourceReport(budget, self.concurrency)
         self._compile_keys: Set[tuple] = set()
         self._depth = 0
@@ -757,6 +771,15 @@ class _Analyzer:
     def _file_scan(self, node) -> AbsState:
         import os
 
+        from spark_rapids_tpu.io.prefetch import prefetch_depth
+
+        # the runtime honors a per-read .option("prefetchBatches", k)
+        # override carried on the splits — the model must see the SAME
+        # depth or the ceiling under-predicts exactly the deep-prefetch
+        # reads most likely to OOM
+        depth = self.prefetch_depth
+        if node.splits:
+            depth = prefetch_depth(self.conf, node.splits[0])
         parts = len(node.splits)
         total_bytes = 0
         for s in node.splits:
@@ -776,10 +799,16 @@ class _Analyzer:
         st = self._mk(node, Interval(0, rows_hi), parts,
                       Interval(0, parts), Interval(0, INF), batch_rows,
                       set())
-        # decode staging: raw split bytes + one decoded batch per task
+        # decode staging: raw split bytes + the in-flight decoded batches.
+        # Prefetch double-buffering multiplies the latter: with depth k
+        # the consumer's batch, the worker's in-hand batch, and k queued
+        # batches are live per task (2 + k; io/prefetch.py queue sizing)
+        # — the peak-HBM ceiling for scan leaves scales with the
+        # configured depth (rapids.tpu.io.prefetchBatches)
+        staged = 1 if depth == 0 else (2 + depth)
         self._resident(node,
                        self.concurrency * (total_bytes / max(parts, 1)
-                                           + st.batch_bytes)
+                                           + st.batch_bytes * staged)
                        if st.batch_bytes != INF else INF,
                        st, Interval.exact(0))
         if node.placement == "tpu":
@@ -854,6 +883,16 @@ class _Analyzer:
                                 _mulsafe(min(32, _hi_or(cin.batches.hi, 32)),
                                          cin.batch_bytes)),
                        cin, Interval.exact(0), record=False)
+        # sink fences: under issue-ahead execution the session lifts a
+        # root sink to ONE grouped query-level download (floor 1); the
+        # checked/sync path flushes per nonempty partition, and the
+        # 1->32 run ramp bounds the worst case by one transfer per batch
+        lo = 0
+        if cin.batches.lo > 0:
+            lo = 1 if self.conf.get(C.ASYNC_DISPATCH) \
+                else max(1, cin.nonempty.lo)
+        self.report.fences = self.report.fences.add(
+            Interval(lo, cin.batches.hi))
         return AbsState(cin.rows, cin.parts, cin.nonempty, cin.batches,
                         cin.batch_rows, set(cin.buckets), cin.row_bytes,
                         placement="cpu", col_ndv=cin.col_ndv,
@@ -1163,6 +1202,11 @@ class _Analyzer:
                           for a in node.output)
         serialize = self.conf.get(C.SHUFFLE_SERIALIZE)
         is_tpu = node.placement == "tpu"
+        if serialize and is_tpu:
+            # serialized map outputs download host-side: one grouped
+            # transfer per input batch (exchange._encode_pieces_grouped)
+            self.report.fences = self.report.fences.add(
+                Interval(0, cin.batches.hi))
         d = Interval.exact(0)
         if is_tpu:
             if isinstance(p, SinglePartitioning):
@@ -1486,6 +1530,22 @@ class _Analyzer:
         return st
 
     # -- fused stages ----------------------------------------------------------
+    @staticmethod
+    def _stage_donates(node, n_variants: int, has_limit: bool) -> bool:
+        """Whether the fused stage is GUARANTEED to donate at runtime, so
+        subtracting the consumed input keeps the pessimistic peak ceiling
+        sound: only the simple (one-variant, no-limit) form dispatches the
+        donated program, and only on OWNED input batches — which an
+        upload/scan input always produces (exchange-fed inputs may be
+        shared bucket pieces that never donate, so they get no credit)."""
+        from spark_rapids_tpu.exec.transitions import HostToDeviceExec
+        from spark_rapids_tpu.io.scan import TpuFileScanExec
+
+        if n_variants != 1 or has_limit:
+            return False
+        return isinstance(node.input_node,
+                          (HostToDeviceExec, TpuFileScanExec))
+
     def _fused_stage(self, node) -> AbsState:
         from spark_rapids_tpu.exec import basic as B
         from spark_rapids_tpu.exec.expand import TpuExpandExec
@@ -1592,11 +1652,20 @@ class _Analyzer:
             set(cin.buckets) if (lazy or not row_changing) else set(),
             row_bytes, lazy_tail=lazy, placement="tpu", col_ndv=ndv,
             col_range=rngs)
-        st.chain_bytes = _addsafe(cin.chain(), st.batch_bytes)
+        chain_in = cin.chain()
+        if self.donation and chain_in != INF and \
+                cin.batch_bytes != INF and \
+                self._stage_donates(node, n_variants, has_limit):
+            # buffer donation: the stage consumes its input batch into its
+            # output (donate_argnums on the stage program), so the input's
+            # bytes never coexist with the output's — subtract them from
+            # the pipeline chain estimate
+            chain_in = max(0, chain_in - cin.batch_bytes)
+        st.chain_bytes = _addsafe(chain_in, st.batch_bytes)
         self._resident(
             node,
             _mulsafe(self.concurrency,
-                     _addsafe(cin.chain(),
+                     _addsafe(chain_in,
                               _mulsafe(2 if row_changing else 1,
                                        st.batch_bytes))),
             st, d)
@@ -1648,7 +1717,17 @@ def analyze_plan(plan: PhysicalExec, conf: "C.TpuConf",
     """Bottom-up abstract interpretation; never raises on violations."""
     if budget is None:
         budget = resolve_budget(conf, device_manager)
-    return _Analyzer(conf, budget).run(plan)
+    from spark_rapids_tpu.engine.async_exec import in_checked_mode
+
+    # no donation credit inside a checked replay: the replay runs with
+    # donation OFF (engine/async_exec), so its re-analysis must predict
+    # the undonated peak — exactly the run happening because memory is
+    # already tight
+    donation = bool(conf.get(C.BUFFER_DONATION)) and (
+        bool(device_manager is not None and device_manager.is_tpu)
+        or bool(conf.get(C.BUFFER_DONATION_ASSUME_SUPPORTED))) and \
+        not in_checked_mode()
+    return _Analyzer(conf, budget, donation=donation).run(plan)
 
 
 def check_resources(plan: PhysicalExec, conf: "C.TpuConf",

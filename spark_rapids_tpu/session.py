@@ -285,6 +285,7 @@ class TpuSession:
 
     # -- actions --------------------------------------------------------------
     def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
+        from spark_rapids_tpu.engine import async_exec as AX
         from spark_rapids_tpu.engine import retry as R
         from spark_rapids_tpu.plan.fusion import count_fused_stages
         from spark_rapids_tpu.utils import faultinject as FI
@@ -293,16 +294,20 @@ class TpuSession:
         # the executing session's conf drives the process-wide narrowing
         # flag (conf.sync_int64_narrowing: covers clone_with copies and
         # interleaved sessions) — and, same contract, the retry policy,
-        # the circuit breaker knobs, the fault-injection harness, and the
-        # scheduler's per-query retry budget/timeout
+        # the circuit breaker knobs, the fault-injection harness, the
+        # issue-ahead/donation flags, and the scheduler's per-query retry
+        # budget/timeout
         self.conf.sync_int64_narrowing()
         R.set_policy_from_conf(self.conf)
         breaker = R.CircuitBreaker.configure(self.conf)
         FI.configure(self.conf)
+        AX.configure(self.conf, self.device_manager)
         self.scheduler.configure(self.conf)
         dispatches_before = M.dispatch_count()
         before = (M.retry_count(), M.split_retry_count(),
-                  M.cpu_fallback_count(), M.fetch_retry_count())
+                  M.cpu_fallback_count(), M.fetch_retry_count(),
+                  M.fence_count(), M.checked_replay_count(),
+                  M.donated_bytes())
         cpu_fallback_ok = self.conf.get(C.CPU_FALLBACK_ENABLED)
         if breaker.is_open() and cpu_fallback_ok:
             # the session's device is unhealthy: remaining queries plan
@@ -313,27 +318,13 @@ class TpuSession:
             FI.disable()
             physical, results = self._execute_on_cpu(plan)
         else:
-            physical = self._physical_plan(plan)
-            ctx = self._exec_context()
             try:
-                pb = physical.execute(ctx)
-                results = self.scheduler.run_job(
-                    pb.num_partitions, lambda p: list(pb.iterator(p)))
+                physical, results = self._execute_device(plan)
             except Exception as e:  # noqa: BLE001 — degradation boundary
-                if not (cpu_fallback_ok and R.failure_is_device_rooted(e)):
+                if not R.failure_is_device_rooted(e):
                     raise
-                # runtime graceful degradation: an operator with device-
-                # resident state (aggregate/join/sort/scan) exhausted its
-                # retries — re-execute the whole query through the CPU
-                # oracle instead of failing the job
-                breaker.record_failure()
-                M.record_cpu_fallback()
-                log.warning("device execution failed (%r); re-executing "
-                            "the query on the CPU oracle engine", e)
-                # the fallback run is the backstop: injected faults must
-                # not chase it (re-armed at the next query start)
-                FI.disable()
-                physical, results = self._execute_on_cpu(plan)
+                physical, results = self._degrade_device_failure(
+                    plan, e, breaker, cpu_fallback_ok)
         # per-query fusion accounting (process-wide dispatch counter: tasks
         # share one worker pool; interleaved sessions would blur the delta,
         # same caveat as jit_cache stats)
@@ -344,8 +335,151 @@ class TpuSession:
             M.SPLIT_RETRIES: M.split_retry_count() - before[1],
             M.CPU_FALLBACK_EVENTS: M.cpu_fallback_count() - before[2],
             M.FETCH_RETRIES: M.fetch_retry_count() - before[3],
+            M.FENCES: M.fence_count() - before[4],
+            M.CHECKED_REPLAYS: M.checked_replay_count() - before[5],
+            M.DONATED_BYTES: M.donated_bytes() - before[6],
         }
         return [b for part in results for b in part]
+
+    def _execute_device(self, plan: L.LogicalPlan):
+        """Plan and run one query on the device engine (the issue-ahead
+        fast path; also the body of the checked replay).
+
+        When the plan root is the result sink (DeviceToHostExec) and
+        issue-ahead execution is on, the sink is lifted to the QUERY
+        level: every partition task materializes unblocked DEVICE
+        batches, and the whole result downloads in one grouped transfer
+        — the query blocks on device values exactly once
+        (docs/async-execution.md; was one grouped download per output
+        partition, each a ~66 ms fence on a tunneled backend)."""
+        from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.exec.transitions import DeviceToHostExec
+
+        physical = self._physical_plan(plan)
+        ctx = self._exec_context()
+        # the lift streams partitions as they complete (run_job_iter),
+        # which has no per-task timeout plumbing — a timeout-configured
+        # session keeps the per-partition sink
+        if isinstance(physical, DeviceToHostExec) and \
+                AX.async_enabled() and not self.scheduler.task_timeout_s:
+            results = self._execute_lifted_sink(physical, ctx)
+            return physical, results
+        pb = physical.execute(ctx)
+        results = self.scheduler.run_job(
+            pb.num_partitions, lambda p: list(pb.iterator(p)))
+        return physical, results
+
+    # device bytes the lifted sink may hold un-downloaded before flushing
+    # a grouped transfer (ONE shared constant with to_host_many's
+    # internal run budget, so the two can never drift): bounds sink HBM
+    # residency for large results while small interactive results still
+    # download in ONE fence
+    from spark_rapids_tpu.columnar.batch import (
+        DOWNLOAD_BYTE_BUDGET as _SINK_FLUSH_BYTES,
+    )
+
+    def _execute_lifted_sink(self, physical, ctx):
+        """Run the sink's child; download accumulated device batches in
+        grouped per-byte-budget transfers AS PARTITIONS COMPLETE, so sink
+        residency is bounded by the flush budget plus whatever the still-
+        running tasks hold — not by the whole result set. The sink node's
+        own metrics (output rows/batches, DeviceToHost time) are recorded
+        here — this path replaces its per-partition iterators."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        child_pb = physical.children[0].execute(ctx)
+        n = child_pb.num_partitions
+        results: List[Optional[list]] = [None] * n
+        pending: List[tuple] = []  # (pidx, device batches)
+        pending_bytes = 0
+        total_time = physical.metrics[M.TOTAL_TIME]
+
+        def flush():
+            nonlocal pending, pending_bytes
+            with M.trace_range("DeviceToHost", total_time):
+                hosts = self._sink_download(
+                    [b for _, part in pending for b in part])
+            hi = 0
+            for pidx, part in pending:
+                results[pidx] = hosts[hi:hi + len(part)]
+                hi += len(part)
+            pending, pending_bytes = [], 0
+
+        for pidx, part in self.scheduler.run_job_iter(
+                n, lambda p: (p, list(child_pb.iterator(p)))):
+            pending.append((pidx, part))
+            pending_bytes += sum(b.device_memory_size() for b in part)
+            if pending_bytes > self._SINK_FLUSH_BYTES:
+                flush()
+        flush()
+        physical.metrics[M.NUM_OUTPUT_BATCHES].add(
+            sum(len(part) for part in results))
+        physical.metrics[M.NUM_OUTPUT_ROWS].add(
+            sum(b.num_rows for part in results for b in part))
+        return results
+
+    @staticmethod
+    def _sink_download(flat):
+        """THE query sink: one grouped device->host transfer per byte
+        budget for the accumulated device batches, with async error
+        attribution (exec/transitions.sink_download_many). An empty
+        result still surfaces any sink-deferred injected faults — a
+        query is not fault-immune just because nothing survived its
+        filters."""
+        from spark_rapids_tpu.exec.transitions import sink_download_many
+        from spark_rapids_tpu.utils import faultinject as FI
+
+        if not flat:
+            FI.raise_deferred_at_sink()
+            return []
+        return sink_download_many(flat)
+
+    def _degrade_device_failure(self, plan: L.LogicalPlan,
+                                e: BaseException, breaker,
+                                cpu_fallback_ok: bool):
+        """Graceful degradation after a device-rooted failure, in order:
+        (1) one CHECKED replay when issue-ahead behavior was active — the
+        error may have surfaced at the sink (or a donated dispatch lost
+        its inputs), so re-executing with synchronous dispatch and
+        donation off re-attributes it to the originating operator, whose
+        spill/split-retry machinery then owns it (docs/async-execution.md);
+        (2) the query-level CPU-oracle fallback of PR 4."""
+        from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.engine import retry as R
+        from spark_rapids_tpu.utils import faultinject as FI
+        from spark_rapids_tpu.utils import metrics as M
+
+        if AX.replay_warranted() and R.failure_needs_checked_replay(e):
+            M.record_checked_replay()
+            log.warning(
+                "device error surfaced under issue-ahead execution (%r); "
+                "re-executing the query in checked (synchronous) mode so "
+                "the originating op's retry machinery can own it", e)
+            # the replay starts clean: a fresh retry budget, and none of
+            # the first run's undelivered sink faults
+            self.scheduler.begin_query()
+            FI.clear_deferred()
+            try:
+                with AX.checked_mode():
+                    return self._execute_device(plan)
+            except Exception as e2:  # noqa: BLE001 — degradation boundary
+                if not (cpu_fallback_ok and R.failure_is_device_rooted(e2)):
+                    raise
+                e = e2
+        elif not cpu_fallback_ok:
+            raise e
+        # runtime graceful degradation: an operator with device-resident
+        # state (aggregate/join/sort/scan) exhausted its retries —
+        # re-execute the whole query through the CPU oracle instead of
+        # failing the job
+        breaker.record_failure()
+        M.record_cpu_fallback()
+        log.warning("device execution failed (%r); re-executing the query "
+                    "on the CPU oracle engine", e)
+        # the fallback run is the backstop: injected faults must not chase
+        # it (re-armed at the next query start)
+        FI.disable()
+        return self._execute_on_cpu(plan)
 
     def _execute_on_cpu(self, plan: L.LogicalPlan):
         """Plan and run a query entirely on the CPU-oracle engine (runtime

@@ -183,16 +183,42 @@ class _ExchangeBase(PhysicalExec):
 
         def run_map(pidx: int) -> List[List[Any]]:
             buckets: List[List[Any]] = [[] for _ in range(n_out)]
+
+            def emit(routed) -> None:
+                if serialize:
+                    # ONE grouped device->host transfer for ALL of this
+                    # batch's pieces (was one ~66 ms fence per piece —
+                    # the PR 2 range-exchange grouped-transfer fix applied
+                    # to the serialized map output; grouping per input
+                    # batch bounds peak HBM at one batch's pieces)
+                    routed = _encode_pieces_grouped(routed)
+                for target, piece in routed:
+                    buckets[target].append(piece)
+
+            # issue-ahead pipelining (serialized tier only — without
+            # serialization emit is a pure host append with nothing to
+            # overlap): batch k's blocking encode/download runs AFTER
+            # batch k+1's routing dispatches are issued, so the wire
+            # time overlaps the device work already in flight (the
+            # per-partition barrier the issue-ahead executor removes;
+            # docs/async-execution.md)
+            prev = None
             for batch in child_pb.iterator(pidx):
                 if getattr(batch, "rows_on_host", True) and \
                         batch.num_rows == 0:
                     continue
-                for target, piece in map_fn(pidx, batch):
-                    if not getattr(piece, "rows_on_host", True) or \
-                            piece.num_rows > 0:
-                        if serialize:
-                            piece = _encode_piece(piece)
-                        buckets[target].append(piece)
+                routed = [(target, piece)
+                          for target, piece in map_fn(pidx, batch)
+                          if not getattr(piece, "rows_on_host", True)
+                          or piece.num_rows > 0]
+                if not serialize:
+                    emit(routed)
+                    continue
+                if prev is not None:
+                    emit(prev)
+                prev = routed
+            if prev is not None:
+                emit(prev)
             return buckets
 
         from spark_rapids_tpu.engine.scheduler import run_job_or_serial
@@ -208,6 +234,11 @@ class _ExchangeBase(PhysicalExec):
         for m_idx, mb in enumerate(map_results):
             for t in range(n_out):
                 for k, piece in enumerate(mb[t]):
+                    if isinstance(piece, ColumnarBatch):
+                        # bucket-held pieces may be re-read (task retry,
+                        # fetch remap): they lose the consume-once
+                        # donation proof here
+                        piece.owned = False
                     reduce_buckets[t].append(piece)
                     piece_src[t].append((m_idx, k))
                     bytes_m.add(_piece_bytes(piece))
@@ -366,20 +397,70 @@ class _SerializedPiece:
 
 def _encode_piece(piece) -> _SerializedPiece:
     from spark_rapids_tpu.columnar.batch import ensure_compact
-    from spark_rapids_tpu.columnar.serde import serialize_batch
-    from spark_rapids_tpu.memory.spill import SpillFramework, SpillPriorities
+    from spark_rapids_tpu.memory.spill import SpillFramework
 
+    if isinstance(piece, _RoutedSlice):
+        piece = piece.to_batch()
     if isinstance(piece, ColumnarBatch):
         host = ensure_compact(piece).to_host()
     else:
         host = piece
+    return _serialize_host_piece(host, SpillFramework.get())
+
+
+def _serialize_host_piece(host, fw) -> _SerializedPiece:
+    from spark_rapids_tpu.columnar.serde import serialize_batch
+    from spark_rapids_tpu.memory.spill import SpillPriorities
+
     data = serialize_batch(host)
-    fw = SpillFramework.get()
     if fw is not None:
         return _SerializedPiece(
             buf=fw.add_host_bytes(data, SpillPriorities.OUTPUT_FOR_READ),
             fw=fw)
     return _SerializedPiece(data=data)
+
+
+def _encode_pieces_grouped(routed):
+    """Serialize one map batch's (target, piece) list with ONE grouped
+    device->host transfer for every device piece (to_host_many packs all
+    columns of all pieces into per-dtype buffers: one fence per byte
+    budget instead of one per piece). run_map calls this one batch
+    BEHIND the routing dispatches, so the blocking download overlaps the
+    next batch's in-flight device work."""
+    from spark_rapids_tpu.columnar.batch import (
+        ensure_compact,
+        to_host_many,
+    )
+    from spark_rapids_tpu.engine.retry import with_retry
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    fw = SpillFramework.get()
+    dev_idx: List[int] = []
+    dev_batches: List[ColumnarBatch] = []
+    for j, (_target, piece) in enumerate(routed):
+        if isinstance(piece, _RoutedSlice):
+            piece = piece.to_batch()
+        if isinstance(piece, ColumnarBatch):
+            piece = ensure_compact(piece)
+            dev_idx.append(j)
+            dev_batches.append(piece)
+    if dev_batches:
+        # THE grouped map-output download: one planned fence per input
+        # batch replaces one per piece (counted by the fencesPerQuery
+        # instrumentation inside with_retry)
+        hosts = with_retry(lambda: to_host_many(dev_batches),
+                           site="transfer.download")
+    out = []
+    hi = 0
+    for j, (target, piece) in enumerate(routed):
+        if hi < len(dev_idx) and dev_idx[hi] == j:
+            # device piece: its grouped-download host batch
+            host = hosts[hi]
+            hi += 1
+        else:
+            host = piece  # already host-side
+        out.append((target, _serialize_host_piece(host, fw)))
+    return out
 
 
 def _sample_bounds_host(key_cols: List[np.ndarray], orders: List[SortOrder],
@@ -758,6 +839,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                                    bounds_np=bounds_np)
         bytes_m = self.metrics["dataSize"]
         for b in out:
+            b.owned = False  # held for potential re-iteration (task retry)
             bytes_m.add(b.device_memory_size())
 
         def factory(pidx: int):
@@ -872,6 +954,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 pi += 1
                 for t, piece in _device_slices(batch, jnp.asarray(ids), n):
                     if piece.num_rows:
+                        piece.owned = False  # bucket-held: multi-read
                         reduce_buckets[t].append(piece)
 
         def factory(pidx: int):

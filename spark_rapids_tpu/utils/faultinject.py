@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import threading
 import zlib
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import conf as C
 
@@ -53,16 +53,31 @@ SITES: Dict[str, str] = {
 KINDS = ("oom", "dispatch", "transfer", "fetch")
 
 
+# fault kinds that model a device COMPUTE failure: under async dispatch
+# these surface at the sink download, not the issuing dispatch, so the
+# deferToSink mode records them for sink-side re-raise (transfer/fetch
+# faults happen in host-blocking operations and always raise in place)
+_DEFERRABLE_KINDS = ("oom", "dispatch")
+# the sink sites where a deferred fault surfaces (the engine's blocking
+# device->host chokepoints)
+SINK_SITES = ("transfer.download",)
+
+
 class FaultInjector:
     """Armed sites + the seeded decision function."""
 
-    def __init__(self, seed: int, sites_spec: str, rate: float):
+    def __init__(self, seed: int, sites_spec: str, rate: float,
+                 defer_to_sink: bool = False):
         self.seed = int(seed)
         self.rate = float(rate)
+        self.defer_to_sink = bool(defer_to_sink)
         self.armed: Dict[str, str] = _parse_sites(sites_spec)
         self._lock = threading.Lock()
         self._invocations: Dict[str, int] = {}
         self._injected: Dict[str, int] = {}
+        # (origin site, kind) faults recorded under deferToSink, waiting
+        # to surface at the next sink download
+        self._deferred: List[Tuple[str, str]] = []
 
     def decide(self, site: str, invocation: int) -> bool:
         """Pure (seed, site, invocation) -> inject? decision. crc32 keeps
@@ -91,6 +106,22 @@ class FaultInjector:
     def invocation_counts(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._invocations)
+
+    def defer(self, site: str, kind: str) -> None:
+        with self._lock:
+            self._deferred.append((site, kind))
+
+    def pop_deferred(self) -> Optional[Tuple[str, str]]:
+        with self._lock:
+            return self._deferred.pop(0) if self._deferred else None
+
+    def deferred_pending(self) -> int:
+        with self._lock:
+            return len(self._deferred)
+
+    def clear_deferred(self) -> None:
+        with self._lock:
+            self._deferred.clear()
 
 
 def _parse_sites(spec: str) -> Dict[str, str]:
@@ -135,6 +166,7 @@ def configure(tpu_conf: "C.TpuConf") -> Optional[FaultInjector]:
         seed=tpu_conf.get(C.FAULT_INJECTION_SEED),
         sites_spec=tpu_conf.get(C.FAULT_INJECTION_SITES),
         rate=tpu_conf.get(C.FAULT_INJECTION_RATE),
+        defer_to_sink=tpu_conf.get(C.FAULT_INJECTION_DEFER_TO_SINK),
     )
     return _ACTIVE
 
@@ -148,15 +180,62 @@ def active() -> Optional[FaultInjector]:
     return _ACTIVE
 
 
-def maybe_inject(site: str) -> None:
-    """Raise the armed fault for `site`, or return. A single None-check
-    when the harness is off — safe on every hot path."""
+def clear_deferred() -> None:
+    """Drop any recorded-but-unsurfaced deferred faults (called before a
+    checked replay: the replay re-executes from the start, and the first
+    run's undelivered sink faults must not poison its downloads)."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.clear_deferred()
+
+
+def raise_deferred_at_sink(site: str = "transfer.download") -> None:
+    """Surface the oldest recorded deferred fault as a TpuAsyncSinkError
+    naming its origin, or return. Called from `maybe_inject` at the sink
+    sites — and by an EMPTY sink (session._sink_download with nothing to
+    download), which still counts as the query's blocking point: a
+    deferred fault must not vanish just because no rows survived."""
     inj = _ACTIVE
     if inj is None:
         return
+    pending = inj.pop_deferred()
+    if pending is not None:
+        origin, kind = pending
+        from spark_rapids_tpu.engine.retry import TpuAsyncSinkError
+
+        raise TpuAsyncSinkError(
+            f"[injected] async device error surfaced at {site} "
+            f"(origin: {kind} at {origin})", origin_site=origin)
+
+
+def maybe_inject(site: str) -> None:
+    """Raise the armed fault for `site`, or return. A single None-check
+    when the harness is off — safe on every hot path.
+
+    Under deferToSink (docs/async-execution.md) a device-COMPUTE fault
+    (oom/dispatch kinds) is recorded instead of raised, and the next sink
+    download (`transfer.download`) raises it as a TpuAsyncSinkError naming
+    the originating site — modeling where a real async XLA error reaches
+    the host. A checked replay (engine/async_exec.checked_mode) disables
+    the deferral, so replayed faults raise at their sites."""
+    inj = _ACTIVE
+    if inj is None:
+        return
+    if site in SINK_SITES:
+        raise_deferred_at_sink(site)
     kind = inj.check(site)
     if kind is None:
         return
+    if inj.defer_to_sink and kind in _DEFERRABLE_KINDS and \
+            site not in SINK_SITES:
+        from spark_rapids_tpu.engine.async_exec import async_enabled
+
+        # deferral models ASYNC error timing: with issue-ahead off (or
+        # inside a checked replay) dispatch is synchronous, so the fault
+        # raises at its site where the per-op machinery owns it
+        if async_enabled():
+            inj.defer(site, kind)
+            return
     # lazy imports: utils must not pull the engine in at module import
     from spark_rapids_tpu.engine.retry import (
         TpuRetryOOM,

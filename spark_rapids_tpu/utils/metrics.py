@@ -39,6 +39,14 @@ RETRIES = "retries"
 SPLIT_RETRIES = "splitRetries"
 CPU_FALLBACK_EVENTS = "cpuFallbackEvents"
 FETCH_RETRIES = "fetchRetries"
+# async issue-ahead metrics (engine/async_exec.py, docs/async-execution.md):
+# fences = device->host transfer events the engine issued (the
+# site="transfer.download" instrumentation); checkedReplays = whole-query
+# re-executions in checked (synchronous) mode after an error surfaced at
+# the sink; donatedBytes = input bytes donated into consume-once kernels
+FENCES = "fencesPerQuery"
+CHECKED_REPLAYS = "checkedReplays"
+DONATED_BYTES = "donatedBytes"
 
 
 class Metric:
@@ -128,6 +136,9 @@ _RETRIES = Metric(RETRIES)
 _SPLIT_RETRIES = Metric(SPLIT_RETRIES)
 _CPU_FALLBACKS = Metric(CPU_FALLBACK_EVENTS)
 _FETCH_RETRIES = Metric(FETCH_RETRIES)
+_FENCES = Metric(FENCES)
+_CHECKED_REPLAYS = Metric(CHECKED_REPLAYS)
+_DONATED_BYTES = Metric(DONATED_BYTES)
 
 
 def record_retry(n: int = 1) -> None:
@@ -165,6 +176,41 @@ def cpu_fallback_count() -> int:
 
 def fetch_retry_count() -> int:
     return _FETCH_RETRIES.value
+
+
+def record_fence(n: int = 1) -> None:
+    """Count one device->host transfer event (a host fence). The engine's
+    download chokepoints record here: with_retry(site='transfer.download')
+    sink downloads and the shuffle's grouped piece encodes — NOT internal
+    flush granularity, so the unit is 'download transfers the engine
+    issued' (the ~66 ms round trip on a tunneled backend)."""
+    _FENCES.add(n)
+
+
+def fence_count() -> int:
+    return _FENCES.value
+
+
+def record_checked_replay(n: int = 1) -> None:
+    """Count one whole-query checked-mode re-execution (a device error
+    surfaced at the sink under async dispatch / donation; the session
+    replays synchronously so the originating op's retry machinery can
+    own it)."""
+    _CHECKED_REPLAYS.add(n)
+
+
+def checked_replay_count() -> int:
+    return _CHECKED_REPLAYS.value
+
+
+def record_donated_bytes(n: int) -> None:
+    """Count input bytes donated into a consume-once kernel (the HBM the
+    output reused instead of allocating fresh)."""
+    _DONATED_BYTES.add(n)
+
+
+def donated_bytes() -> int:
+    return _DONATED_BYTES.value
 
 
 @contextlib.contextmanager

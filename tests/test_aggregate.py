@@ -68,10 +68,10 @@ def test_auto_policy_follows_fence_cost(session, monkeypatch, fence_ms,
     seen = []
     orig = jc.get_or_build
 
-    def spy(key, builder):
+    def spy(key, builder, **kwargs):
         if isinstance(key, tuple) and key and key[0] == "agg_update":
             seen.append(key[1])  # the lazy flag
-        return orig(key, builder)
+        return orig(key, builder, **kwargs)
 
     monkeypatch.setattr(jc, "get_or_build", spy)
     try:
@@ -99,10 +99,10 @@ def test_auto_policy_big_batch_stays_compact(session, monkeypatch):
     seen = []
     orig = jc.get_or_build
 
-    def spy(key, builder):
+    def spy(key, builder, **kwargs):
         if isinstance(key, tuple) and key and key[0] == "agg_update":
             seen.append(key[1])
-        return orig(key, builder)
+        return orig(key, builder, **kwargs)
 
     monkeypatch.setattr(jc, "get_or_build", spy)
     try:

@@ -1,0 +1,433 @@
+"""Issue-ahead execution (PR 6, docs/async-execution.md): scan prefetch
+double-buffering, buffer donation, sink error re-attribution + checked
+replay, and the fencesPerQuery accounting.
+
+The correctness matrix: TPC-H q1/q5 must equal the CPU oracle across
+prefetch depth x donation, and under OOM fault injection whose errors are
+DEFERRED to the result sink (modeling async dispatch's error timing) the
+checked replay must re-attribute them to the originating op and still
+produce oracle-equal results."""
+
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.io.prefetch import PrefetchIterator, maybe_prefetch
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+PREFETCH = "rapids.tpu.io.prefetchBatches"
+DONATE = "rapids.tpu.execution.bufferDonation.enabled"
+DONATE_FORCE = "rapids.tpu.execution.bufferDonation.assumeSupported"
+ASYNC = "rapids.tpu.execution.asyncDispatch.enabled"
+FI_ON = "rapids.tpu.test.faultInjection.enabled"
+FI_SEED = "rapids.tpu.test.faultInjection.seed"
+FI_SITES = "rapids.tpu.test.faultInjection.sites"
+FI_RATE = "rapids.tpu.test.faultInjection.rate"
+FI_DEFER = "rapids.tpu.test.faultInjection.deferToSink"
+
+
+@pytest.fixture()
+def session():
+    s = srt.new_session()
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator unit behavior
+# ---------------------------------------------------------------------------
+def test_prefetch_preserves_order_and_values():
+    it = PrefetchIterator(iter(range(100)), depth=3)
+    assert list(it) == list(range(100))
+
+
+def test_prefetch_depth_zero_is_inline_passthrough():
+    src = iter([1, 2, 3])
+    assert maybe_prefetch(src, 0) is src
+
+
+def test_prefetch_exception_propagates_in_position():
+    def gen():
+        yield 1
+        yield 2
+        raise IOError("decode failed")
+
+    it = PrefetchIterator(gen(), depth=2)
+    got = [next(it), next(it)]
+    assert got == [1, 2]
+    with pytest.raises(IOError, match="decode failed"):
+        next(it)
+
+
+def test_prefetch_bounds_lookahead():
+    """The worker may stage at most depth items in the queue plus one in
+    hand past the consumer: an unbounded source must not be drained
+    eagerly (the resource analyzer's (2 + depth) scan staging charge
+    depends on this bound)."""
+    produced = []
+
+    def gen():
+        i = 0
+        while True:
+            produced.append(i)
+            yield i
+            i += 1
+
+    it = PrefetchIterator(gen(), depth=2)
+    for _ in range(3):
+        next(it)
+    time.sleep(0.2)  # give the worker time to overrun, if it could
+    assert len(produced) <= 3 + 2 + 1  # consumed + queue slots + in hand
+    it.close()
+
+
+def test_prefetch_close_stops_worker():
+    def gen():
+        while True:
+            yield 0
+
+    it = PrefetchIterator(gen(), depth=1)
+    next(it)
+    it.close()
+    assert it._thread.join(timeout=5.0) is None
+    assert not it._thread.is_alive()
+
+
+def test_prefetch_abandoned_iterator_does_not_leak_worker():
+    """A consumer that abandons the iterator mid-stream (LIMIT early
+    exit, task retry) must not leak the worker thread: the worker holds
+    no reference to the iterator, so GC fires __del__ -> close()."""
+    import gc
+
+    def gen():
+        while True:
+            yield 0
+
+    it = PrefetchIterator(gen(), depth=1)
+    next(it)
+    thread = it._thread
+    del it
+    gc.collect()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# oracle equality across the issue-ahead matrix
+# ---------------------------------------------------------------------------
+def _matrix_conf(depth, donate):
+    return {
+        PREFETCH: depth,
+        DONATE: donate,
+        # force the CPU backend to count as donation-capable so the
+        # donated kernel variants and the donated=True retry contract
+        # actually execute under the tier-1 backend
+        DONATE_FORCE: donate,
+    }
+
+
+@pytest.mark.parametrize("depth", [0, 1, 2])
+@pytest.mark.parametrize("donate", [False, True])
+def test_tpch_q1_oracle_equality_prefetch_donation_matrix(
+        session, depth, donate):
+    def q(s):
+        tables = tpch.gen_tables(s, sf=0.0005, num_partitions=3)
+        return tpch.q1(tables)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9,
+        extra_conf=_matrix_conf(depth, donate))
+
+
+@pytest.mark.parametrize("donate", [False, True])
+def test_tpch_q5_oracle_equality_prefetch_donation(session, donate):
+    """q5 (joins) at the default double-buffering depth; the full q5
+    depth matrix rides the slow tier to protect the tier-1 window."""
+    def q(s):
+        tables = tpch.gen_tables(s, sf=0.0005, num_partitions=3)
+        return tpch.q5(tables)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9,
+        extra_conf=_matrix_conf(1, donate))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("donate", [False, True])
+def test_tpch_q5_oracle_equality_full_matrix(session, depth, donate):
+    def q(s):
+        tables = tpch.gen_tables(s, sf=0.0005, num_partitions=3)
+        return tpch.q5(tables)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9,
+        extra_conf=_matrix_conf(depth, donate))
+
+
+def test_file_scan_prefetch_oracle_equality(session, tmp_path):
+    """Prefetch through a real file scan (the io/scan.py decode path),
+    including a per-read option override."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 5000
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64) % 7),
+        "v": pa.array(np.arange(n, dtype=np.float64))}), path)
+
+    def q(s):
+        return (s.read.option("prefetchBatches", 2).parquet(path)
+                .filter(F.col("v") > 10)
+                .groupBy("k").agg(F.sum("v").alias("s")))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# fences: block once, at the sink
+# ---------------------------------------------------------------------------
+def test_flagship_q1_fences_at_most_two(session):
+    """The acceptance bar: the flagship TPC-H q1 single-chip run blocks
+    on device->host transfers at most twice (was one fence per batch per
+    stage before the issue-ahead executor)."""
+    tables = tpch.gen_tables(session, sf=0.001, num_partitions=2)
+    tpch.q1(tables).collect()
+    m = session.last_query_metrics
+    assert m["fencesPerQuery"] <= 2, m
+    rep = session.last_resource_report
+    assert rep.fences.lo <= m["fencesPerQuery"] <= rep.fences.hi
+
+
+@pytest.mark.hotpath
+def test_flagship_pipeline_zero_implicit_mid_query_downloads(session):
+    """The flagship scan->fused->agg->sort pipeline end to end under
+    jax's transfer guard: every device->host crossing is an EXPLICIT
+    planned sync (the sink download); nothing mid-query syncs
+    implicitly. The static claim is tpulint's host-sync/mid-query-sync
+    rules; this enforces it dynamically."""
+    rng = np.random.default_rng(11)
+    df = session.createDataFrame({
+        "k": rng.integers(0, 25, 6000).astype(np.int64),
+        "v": rng.integers(-50, 50, 6000).astype(np.int64),
+    }, num_partitions=2)
+    out = (df.filter(F.col("v") % 5 != 0)
+             .withColumn("w", F.col("v") * 3 - 1)
+             .groupBy("k").agg(F.sum("w").alias("s"),
+                               F.count("*").alias("n"))
+             .orderBy("k").collect())
+    assert len(out) == 25
+    assert session.last_query_metrics["fencesPerQuery"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# donation plumbing (engine/jit_cache + engine/async_exec)
+# ---------------------------------------------------------------------------
+def _configure_async(session, **overrides):
+    from spark_rapids_tpu.engine import async_exec as AX
+
+    for k, v in overrides.items():
+        session.conf.set(k, v)
+    AX.configure(session.conf, session.device_manager)
+    return AX
+
+
+def test_get_or_build_threads_donation_into_builder(session):
+    """The CALLER resolves the donation decision (donation_active() +
+    the batch's consume-once proof) and get_or_build threads it verbatim
+    into the builder and the cache key — donated and undonated program
+    variants coexist under one logical key."""
+    from spark_rapids_tpu.engine import jit_cache
+
+    AX = _configure_async(session, **{DONATE: True, DONATE_FORCE: True})
+    assert AX.donation_active()
+    seen = []
+
+    def build(donate_argnums=()):
+        seen.append(donate_argnums)
+        return object()
+
+    def site_call():
+        # the donation-site idiom: resolve once, pass verbatim
+        dn = (0,) if AX.donation_active() else ()
+        return jit_cache.get_or_build(("t_donate", 1), build,
+                                      donate_argnums=dn)
+
+    a = site_call()
+    assert seen == [(0,)]
+    # donation off -> the SAME logical key builds a separate, undonated
+    # entry (flags select programs; they never invalidate them)
+    _configure_async(session, **{DONATE: False})
+    b = site_call()
+    assert seen == [(0,), ()]
+    assert a is not b
+    # both entries now cached: no further builds
+    _configure_async(session, **{DONATE: True, DONATE_FORCE: True})
+    assert site_call() is a
+    assert len(seen) == 2
+
+
+def test_checked_mode_disables_issue_ahead_flags(session):
+    AX = _configure_async(session, **{DONATE: True, DONATE_FORCE: True})
+    assert AX.async_enabled() and AX.donation_active()
+    assert AX.replay_warranted()
+    with AX.checked_mode():
+        assert not AX.async_enabled()
+        assert not AX.donation_active()
+        assert not AX.replay_warranted()
+        assert AX.in_checked_mode()
+    assert AX.donation_active()
+
+
+def test_donated_dispatch_failure_escalates_not_retries(session):
+    """A donated dispatch's retryable failure must NOT re-dispatch in
+    place (its inputs are consumed): it escalates as TpuAsyncSinkError,
+    which neither the dispatch nor the task layer retries."""
+    from spark_rapids_tpu.engine import retry as R
+
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise R.TpuRetryOOM("RESOURCE_EXHAUSTED: injected")
+
+    with pytest.raises(R.TpuAsyncSinkError) as ei:
+        R.with_retry(attempt, site="fused", donated=True)
+    assert len(calls) == 1
+    assert ei.value.origin_site == "fused"
+    assert not R.is_retryable_failure(ei.value)
+    assert R.failure_is_device_rooted(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# async error timing: faults surface at the sink, checked replay
+# re-attributes them to the originating op's split-retry
+# ---------------------------------------------------------------------------
+def _tiny_q1(s, sf=0.0005):
+    tables = tpch.gen_tables(s, sf=sf, num_partitions=3)
+    return tpch.q1(tables)
+
+
+@pytest.mark.parametrize("sites", ["scan", "agg.update"])
+def test_deferred_sink_fault_checked_replay_oracle_equality(
+        session, sites):
+    """OOM injected at a device-compute site but SURFACED at the sink
+    (deferToSink models async dispatch): the query must (a) produce
+    oracle-equal results, (b) take exactly the checked-replay path, and
+    (c) let the replay's synchronous faults hit the per-op retry/split
+    machinery (retries observable, zero CPU fallbacks needed)."""
+    def q(s):
+        return _tiny_q1(s)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9,
+        extra_conf={
+            FI_ON: True, FI_SEED: 7, FI_SITES: sites, FI_RATE: 0.08,
+            FI_DEFER: True,
+        })
+
+
+def test_deferred_fused_site_fault_scanform_oracle_equality(session):
+    """The scan-form fused stage (site='fused') under sink-deferred OOM:
+    q1's fused stage is agg-form, so a plain filter->project pipeline
+    exercises the 'fused' dispatch site explicitly."""
+    rng = np.random.default_rng(5)
+    a = rng.integers(0, 1000, 4000).astype(np.int64)
+    b = rng.integers(-10, 10, 4000).astype(np.int64)
+
+    def q(s):
+        df = s.createDataFrame({"a": a, "b": b}, num_partitions=3)
+        return (df.filter(F.col("a") % 3 == 1)
+                  .withColumn("c", F.col("a") * F.col("b")))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True,
+        extra_conf={
+            FI_ON: True, FI_SEED: 11, FI_SITES: "fused", FI_RATE: 0.2,
+            FI_DEFER: True,
+        })
+
+
+def test_deferred_fault_records_checked_replay_metric(session):
+    """Drive the injection rate high enough that a fault definitely
+    fires, and assert the re-attribution machinery engaged: the error
+    surfaced at the sink as a TpuAsyncSinkError naming the origin site,
+    and the session replayed in checked mode exactly once before any
+    degradation."""
+    session.conf.set(FI_ON, True)
+    session.conf.set(FI_SEED, 3)
+    session.conf.set(FI_SITES, "agg.update")
+    session.conf.set(FI_RATE, 0.5)
+    session.conf.set(FI_DEFER, True)
+    got = _tiny_q1(session).collect()
+    m = session.last_query_metrics
+    assert m["checkedReplays"] >= 1, m
+    # the replay's per-op machinery (or, if it too exhausted, the CPU
+    # backstop) must still deliver a result
+    assert got
+    session.conf.set(FI_ON, False)
+    want = sorted(_tiny_q1(session).collect())
+    assert sorted(got) == want
+
+
+def test_deferred_fault_message_names_origin_site():
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.engine.retry import TpuAsyncSinkError
+    from spark_rapids_tpu.utils import faultinject as FI
+
+    conf = C.TpuConf({
+        C.FAULT_INJECTION_ENABLED.key: True,
+        C.FAULT_INJECTION_SITES.key: "fused",
+        C.FAULT_INJECTION_RATE.key: 1.0,
+        C.FAULT_INJECTION_DEFER_TO_SINK.key: True,
+    })
+    FI.configure(conf)
+    try:
+        # the compute site records instead of raising...
+        FI.maybe_inject("fused")
+        assert FI.active().deferred_pending() == 1
+        # ...and the sink surfaces it, re-attributed
+        with pytest.raises(TpuAsyncSinkError) as ei:
+            FI.maybe_inject("transfer.download")
+        assert ei.value.origin_site == "fused"
+        assert "fused" in str(ei.value)
+        assert FI.active().deferred_pending() == 0
+    finally:
+        FI.disable()
+
+
+def test_sync_injection_still_raises_at_site_without_defer():
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.engine.retry import TpuRetryOOM
+    from spark_rapids_tpu.utils import faultinject as FI
+
+    conf = C.TpuConf({
+        C.FAULT_INJECTION_ENABLED.key: True,
+        C.FAULT_INJECTION_SITES.key: "fused",
+        C.FAULT_INJECTION_RATE.key: 1.0,
+    })
+    FI.configure(conf)
+    try:
+        with pytest.raises(TpuRetryOOM):
+            FI.maybe_inject("fused")
+    finally:
+        FI.disable()
+
+
+# ---------------------------------------------------------------------------
+# async dispatch off = always-checked execution still works
+# ---------------------------------------------------------------------------
+def test_async_dispatch_disabled_oracle_equality(session):
+    def q(s):
+        return _tiny_q1(s)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, approx_float=1e-9,
+        extra_conf={ASYNC: False})

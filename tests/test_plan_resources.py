@@ -77,6 +77,7 @@ OK
 == Resource analysis ==
 peak HBM: 0B..3.4KiB (budget 256.0MiB, concurrency 2)
 device dispatches: 6..6 (exact)
+host fences (device->host transfers): 1..2
 jit shape-bucket cache keys: 1
       TpuFusedStage(S)[Filter->Project->Project]: rows=[0, 90] \
 resident~3.4KiB dispatches=[6, 6]
@@ -342,6 +343,90 @@ def test_tpch_dispatch_interval_contains_measured_unfused(session):
         md = session.last_query_metrics["deviceDispatches"]
         assert rep.dispatches.lo <= md <= rep.dispatches.hi, \
             (qname, repr(rep.dispatches), md)
+
+
+# ---------------------------------------------------------------------------
+# issue-ahead model: prefetch depth, donation, and predicted fences
+# (docs/async-execution.md; PR 6)
+# ---------------------------------------------------------------------------
+def _file_scan_plan(session, tmp_path, n=4000):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "b": pa.array(np.arange(n, dtype=np.float64))}), path)
+    return session.read.parquet(path)
+
+
+@pytest.mark.parametrize("depths", [(0, 2), (1, 4)])
+def test_prefetch_depth_scales_scan_peak_ceiling(session, tmp_path,
+                                                 depths):
+    """Prefetch holds (1 + depth) decoded scan batches in flight per
+    task: the scan leaf's peak-HBM CEILING must grow monotonically with
+    the configured depth (and the lower bound — certain residency —
+    must not change: prefetch is an upper-bound phenomenon)."""
+    from spark_rapids_tpu.plan.resources import analyze_plan
+
+    lo_depth, hi_depth = depths
+    df = _file_scan_plan(session, tmp_path)
+    reports = []
+    for d in (lo_depth, hi_depth):
+        session.conf.set("rapids.tpu.io.prefetchBatches", d)
+        plan = session._physical_plan(df._plan)
+        reports.append(analyze_plan(plan, session.conf,
+                                    device_manager=session.device_manager))
+    shallow, deep = reports
+    assert deep.peak_bytes.hi >= shallow.peak_bytes.hi
+    assert deep.peak_bytes.lo == shallow.peak_bytes.lo
+
+    def scan_resident(rep):
+        vals = [n.resident_bytes for n in rep.nodes
+                if "FileScan" in n.name]
+        assert vals, [n.name for n in rep.nodes]
+        return vals[0]
+
+    # the scan-leaf staging term scales with (1 + depth): a strictly
+    # deeper prefetch strictly widens the leaf's finite ceiling
+    assert scan_resident(deep) > scan_resident(shallow)
+
+
+def test_donation_subtracts_consumed_input_bytes(session):
+    """With buffer donation armed (assumeSupported forces the CPU backend
+    to count as capable), a fused stage's consumed input no longer
+    coexists with its output: the peak ceiling must not grow, and the
+    measured execution must stay interval-contained either way."""
+    from spark_rapids_tpu.plan.resources import analyze_plan
+
+    q = _scanform(session)
+    plan = session._physical_plan(q._plan)
+    session.conf.set("rapids.tpu.execution.bufferDonation.enabled", True)
+    session.conf.set(
+        "rapids.tpu.execution.bufferDonation.assumeSupported", True)
+    rep_don = analyze_plan(plan, session.conf,
+                           device_manager=session.device_manager)
+    session.conf.set("rapids.tpu.execution.bufferDonation.enabled", False)
+    rep_off = analyze_plan(plan, session.conf,
+                           device_manager=session.device_manager)
+    assert rep_don.peak_bytes.hi <= rep_off.peak_bytes.hi
+    assert rep_don.peak_bytes.lo == rep_off.peak_bytes.lo
+    # prediction still sound for the real (undonated on CPU) execution
+    q.collect()
+    measured = session.last_query_metrics["deviceDispatches"]
+    assert rep_off.dispatches.lo <= measured <= rep_off.dispatches.hi
+
+
+def test_predicted_fences_contain_measured(session):
+    """The report's host-fence interval must contain the measured
+    fencesPerQuery of the actual run (the site='transfer.download'
+    instrumentation)."""
+    q = _scanform(session)
+    q.collect()
+    rep = session.last_resource_report
+    measured = session.last_query_metrics["fencesPerQuery"]
+    assert rep.fences.lo <= measured <= rep.fences.hi, \
+        (repr(rep.fences), measured)
 
 
 # ---------------------------------------------------------------------------

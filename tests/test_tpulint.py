@@ -19,6 +19,7 @@ from tools.tpulint.core import (  # noqa: E402
 
 HOT = "spark_rapids_tpu/exec/fake.py"
 COLD = "spark_rapids_tpu/plan/fake.py"
+ENGINE = "spark_rapids_tpu/engine/fake.py"
 
 
 def rules_of(findings):
@@ -56,6 +57,50 @@ def test_host_sync_item_and_asarray_flagged():
 def test_host_sync_builtin_over_device_value():
     src = "def f(b):\n    return int(b.num_rows)\n"
     assert rules_of(lint(src)) == ["host-sync"]
+
+
+# ---------------------------------------------------------------------------
+# mid-query-sync (the issue-ahead sync contract for engine/;
+# docs/async-execution.md)
+# ---------------------------------------------------------------------------
+def test_mid_query_sync_item_flagged_in_engine():
+    src = "def f(x):\n    return x.item()\n"
+    assert rules_of(lint(src, path=ENGINE)) == ["mid-query-sync"]
+
+
+def test_mid_query_sync_block_until_ready_flagged_in_engine():
+    src = "def f(x):\n    x.block_until_ready()\n    return x\n"
+    assert rules_of(lint(src, path=ENGINE)) == ["mid-query-sync"]
+
+
+def test_mid_query_sync_float_over_device_value_flagged():
+    src = "def f(b):\n    return float(b.num_rows)\n"
+    assert rules_of(lint(src, path=ENGINE)) == ["mid-query-sync"]
+
+
+def test_mid_query_sync_not_flagged_outside_executor_layers():
+    src = "def f(x):\n    return x.item()\n"
+    assert lint(src, path=COLD) == []
+
+
+def test_mid_query_sync_host_scope_exempt():
+    # the CPU oracle / host helpers are not device hot paths
+    src = "def cpu_finish(x):\n    return x.item()\n"
+    assert lint(src, path=ENGINE) == []
+
+
+def test_mid_query_sync_subsumed_by_host_sync_on_hot_paths():
+    # on exec/ files host-sync reports the same site; exactly ONE finding
+    src = "def f(x):\n    return x.item()\n"
+    got = lint(src, path=HOT)
+    assert [f.rule for f in got] == ["host-sync"]
+
+
+def test_mid_query_sync_pragma_waiver():
+    src = ("def f(x):\n"
+           "    # tpulint: mid-query-sync -- sink boundary: planned sync\n"
+           "    return x.item()\n")
+    assert lint(src, path=ENGINE) == []
 
 
 def test_host_sync_cpu_oracle_scope_exempt():

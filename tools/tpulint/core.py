@@ -50,6 +50,16 @@ the line above; `-- reason` after the rule names documents the waiver):
               stdout-protocol` once (a file directive like
               traced-helpers): stdout-print is disabled for that file,
               every other rule still applies.
+  mid-query-sync  a blocking device sync (.block_until_ready(),
+              .item(), float() over a device value) in the executor
+              layers (exec/ and engine/) outside sink or pragma'd
+              sites: the issue-ahead contract (docs/async-execution.md)
+              is that a query blocks on device values exactly once, at
+              the result sink. On hot-path files (exec/, shuffle/,
+              ops/eval.py) the broader host-sync rule already reports
+              these patterns, so mid-query-sync fires only where
+              host-sync does not — which extends the same guarantee to
+              engine/ (scheduler, retry, jit cache, async executor).
   pragma      tpulint pragma hygiene: unknown rule name, or a pragma
               that suppresses nothing (stale waiver).
 """
@@ -65,6 +75,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 RULES = (
     "host-sync",
+    "mid-query-sync",
     "eager-jnp",
     "jit-cache",
     "conf-key",
@@ -133,6 +144,14 @@ def is_hot_path(path: str) -> bool:
     return ("spark_rapids_tpu/exec/" in p
             or "spark_rapids_tpu/shuffle/" in p
             or p.endswith("spark_rapids_tpu/ops/eval.py"))
+
+
+def is_mid_query_scope(path: str) -> bool:
+    """Files bound by the issue-ahead sync contract: the executor layers
+    (exec/ and engine/) may block on a device value only at the sink."""
+    p = _norm(path)
+    return ("spark_rapids_tpu/exec/" in p
+            or "spark_rapids_tpu/engine/" in p)
 
 
 def _dotted(node: ast.AST) -> str:
@@ -346,6 +365,7 @@ class _Visitor(ast.NodeVisitor):
                  retry_lambdas: Optional[Set[int]] = None):
         self.path = path
         self.hot = is_hot_path(path)
+        self.midquery = is_mid_query_scope(path)
         self.trace = trace
         self.traced_helpers = traced_helpers
         self.stdout_protocol = stdout_protocol
@@ -489,7 +509,29 @@ class _Visitor(ast.NodeVisitor):
                 self._flag(node, "host-sync",
                            "jax.device_get inside a jit-traced function "
                            "cannot work; hoist it out of the trace")
+        # mid-query-sync: the issue-ahead contract for exec/ and engine/
+        # (where host-sync already fires — hot files outside traces — it
+        # subsumes this rule, so only one finding reports per site)
+        if self.midquery and not self.hot and not self._host_scope() \
+                and not in_trace:
+            self._check_mid_query_sync(node, name, tail)
         self.generic_visit(node)
+
+    def _check_mid_query_sync(self, node: ast.Call, name: str,
+                              tail: str) -> None:
+        if isinstance(node.func, ast.Attribute) and \
+                tail in ("item", "block_until_ready") and not node.args:
+            self._flag(node, "mid-query-sync",
+                       f".{tail}() blocks on the device mid-query; the "
+                       "issue-ahead executor syncs exactly once, at the "
+                       "result sink (docs/async-execution.md) — fold it "
+                       "into the sink download or justify with a pragma")
+        elif name in ("bool", "int", "float") and len(node.args) == 1 \
+                and self._looks_device_valued(node.args[0]):
+            self._flag(node, "mid-query-sync",
+                       f"{name}() over a device value forces a mid-query "
+                       "device->host sync; defer it to the sink or "
+                       "justify with a pragma")
 
     def _check_host_sync(self, node: ast.Call, name: str, tail: str) -> None:
         if name in ("jax.device_get", "device_get"):

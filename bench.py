@@ -269,6 +269,23 @@ def _measure_dispatches(session, df) -> dict:
                         _resource_prediction(session).items()})
     finally:
         session.conf.set(key, prior)
+    # single-program SPMD stage (plan/spmd.py): the flagship agg pipeline
+    # as ONE shard_map dispatch — the dispatch-count drop vs the host loop
+    # is the scale-out headline (docs/spmd-stages.md)
+    spmd_key = "rapids.tpu.sql.spmd.enabled"
+    spmd_prior = session.conf.get(C.SPMD_ENABLED)
+    try:
+        session.conf.set(spmd_key, True)
+        _run_query(df)  # warm the stage program
+        _run_query(df)
+        m = session.last_query_metrics
+        out["dispatches_spmd"] = m.get("deviceDispatches", 0)
+        out["spmd_stages"] = m.get("spmdStages", 0)
+        out["collective_bytes"] = m.get("collectiveBytes", 0)
+    except Exception as e:  # noqa: BLE001 - optional measurement
+        _log(f"spmd flagship measurement failed: {e!r}")
+    finally:
+        session.conf.set(spmd_key, spmd_prior)
     return out
 
 
@@ -289,6 +306,12 @@ def _robustness_metrics(session) -> dict:
         "fences_per_query": m.get("fencesPerQuery", 0),
         "checked_replays": m.get("checkedReplays", 0),
         "donated_bytes": m.get("donatedBytes", 0),
+        # single-program SPMD stages (plan/spmd.py): stages that ran as
+        # one mesh program, and the bytes in-program collectives moved —
+        # SPMD stage epochs AND the standalone ICI shuffle tier both
+        # record here (0 when neither ran)
+        "spmd_stages": m.get("spmdStages", 0),
+        "collective_bytes": m.get("collectiveBytes", 0),
     }
 
 
@@ -1148,7 +1171,8 @@ def main() -> None:
         "probe_attempts": probes,
     }
     for k in ("sweep_s", "sweep_gbps", "plateau_rows", "hbm_frac",
-              "dispatches_fused", "dispatches_unfused", "fused_stages",
+              "dispatches_fused", "dispatches_unfused", "dispatches_spmd",
+              "fused_stages", "spmd_stages", "collective_bytes",
               "retries", "split_retries", "cpu_fallback_events",
               "fetch_retries", "fences_per_query", "checked_replays",
               "donated_bytes"):

@@ -503,6 +503,46 @@ FUSION_MAX_OPS = _conf("rapids.tpu.sql.fusion.maxOps").doc(
     "time, which grows with the traced program)."
 ).check(lambda v: None if v >= 2 else "must be >= 2").integer(16)
 
+# ---------------------------------------------------------------------------
+# Single-program SPMD stages (plan/spmd.py, engine/spmd_exec.py,
+# docs/spmd-stages.md)
+# ---------------------------------------------------------------------------
+SPMD_ENABLED = _conf("rapids.tpu.sql.spmd.enabled").doc(
+    "Compile whole SPMD-eligible stage pipelines — a scan-fed fused "
+    "Filter/Project chain, the partial hash aggregate, the hash exchange "
+    "(lowered to an in-program lax.all_to_all over the session mesh), the "
+    "final merge aggregate, and an optional trailing range-exchange+sort "
+    "tail — into ONE jitted shard_map program over the device mesh: one "
+    "device dispatch per stage regardless of partition count, the same "
+    "program on 1 chip or a pod slice (docs/spmd-stages.md). Ineligible "
+    "stages, checked replays, and CPU fallbacks always take the host-loop "
+    "executor, so the PR 4/PR 6 retry and re-attribution contracts hold "
+    "unchanged."
+).boolean(False)
+
+SPMD_MESH_DEVICES = _conf("rapids.tpu.sql.spmd.meshDevices").doc(
+    "Devices in the SPMD stage mesh (0 = all local devices). Tests pin it "
+    "to exercise the 1-chip and pod-slice shapes of the same program on "
+    "one host."
+).integer(0)
+
+SPMD_BUCKET_ROWS = _conf("rapids.tpu.sql.spmd.bucketRows").doc(
+    "Row capacity of each per-target exchange bucket inside an SPMD stage "
+    "program (0 = derive from the resource analyzer's partial-aggregate "
+    "row interval, falling back to the stage input capacity, which is "
+    "always sufficient). A manual value below the real per-target row "
+    "count makes the in-program overflow probe trip and the stage degrade "
+    "to the host-loop executor."
+).integer(0)
+
+SPMD_MAX_SORT_LANES = _conf("rapids.tpu.sql.spmd.maxSortLanes").doc(
+    "Lane budget for absorbing a trailing global sort (range exchange + "
+    "sort) into the SPMD stage program: the sort replicates the merged "
+    "aggregate output to every shard via all_gather, so it is only taken "
+    "when mesh_size * received_lanes stays under this bound; beyond it "
+    "the whole stage falls back to the host-loop executor."
+).integer(1 << 18)
+
 COLUMN_PRUNING = _conf("rapids.tpu.sql.optimizer.columnPruning.enabled").doc(
     "Prune unreferenced columns from the logical plan before physical "
     "planning (the role Spark Catalyst's ColumnPruning rule plays for the "

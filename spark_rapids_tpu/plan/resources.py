@@ -471,6 +471,14 @@ class PlanResourceReport:
         # The issue-ahead executor's whole point is driving this to ~1
         # (docs/async-execution.md)
         self.fences = Interval.exact(0)
+        # single-program SPMD stages (plan/spmd.py): pipelines predicted to
+        # run as ONE shard_map dispatch, and the bytes their in-program
+        # collectives (all_to_all epoch, sort all_gather) are expected to
+        # move across the mesh. The prediction covers SPMD stage epochs
+        # only — the standalone ICI shuffle tier records into the SAME
+        # measured metric but is not modeled here
+        self.spmd_stages = 0
+        self.collective_bytes = Interval.exact(0)
         self.nodes: List[NodeEstimate] = []
         self.violations: List[PlanViolation] = []
 
@@ -522,6 +530,11 @@ class PlanResourceReport:
             f"{_fmt_n(self.fences.lo)}..{_fmt_n(self.fences.hi)}",
             f"jit shape-bucket cache keys: {self.compile_keys}",
         ]
+        if self.spmd_stages:
+            lines.append(
+                f"spmd stages: {self.spmd_stages} (collective bytes "
+                f"{_fmt_bytes(self.collective_bytes.lo)}"
+                f"..{_fmt_bytes(self.collective_bytes.hi)})")
         for n in self.nodes:
             lines.append(
                 "  " * (n.depth + 1)
@@ -557,6 +570,11 @@ class _Analyzer:
         self.report = PlanResourceReport(budget, self.concurrency)
         self._compile_keys: Set[tuple] = set()
         self._depth = 0
+        # SPMD-stage capture: while visiting a TpuSpmdStageExec's subtree,
+        # the hash exchange's INPUT state (the partial-aggregate output) is
+        # stashed here — it sizes the stage program's per-target buckets
+        self._spmd_capture = None
+        self._spmd_captured: Optional[AbsState] = None
         # lazy-compaction policies mirror the exec layer's (devprobe fence
         # measurement + conf); they change capacities, not semantics
         self._filter_lazy = self._policy(C.FILTER_COMPACT_SYNC)
@@ -679,8 +697,12 @@ class _Analyzer:
         from spark_rapids_tpu.io.scan import _FileScanBase
         from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
 
+        from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+
         self._depth += 1
         try:
+            if isinstance(node, TpuSpmdStageExec):
+                return self._spmd_stage(node)
             if isinstance(node, TpuFusedStageExec):
                 return self._fused_stage(node)
             if isinstance(node, B.HostScanExec):
@@ -1186,6 +1208,118 @@ class _Analyzer:
             self._resident(node, 0, st, d)
         return st
 
+    # -- single-program SPMD stages ------------------------------------------
+    def _spmd_stage(self, node) -> AbsState:
+        """Model one TpuSpmdStageExec: the wrapped subtree is analyzed as
+        the host-loop executor would run it (its estimates stay sound for
+        the runtime fallback path), then the subtree's dispatch interval
+        widens DOWN to the SPMD floor — ONE program dispatch for the whole
+        stage, with host-input assembly issuing none — so the combined
+        interval contains the measured count in BOTH modes. The exchange
+        input's row bound is stashed on the node: it sizes the program's
+        per-target exchange buckets (engine/spmd_exec.py)."""
+        before_d = self.report.dispatches
+        # save/restore: a NESTED SPMD stage (double group-by) must not
+        # clobber the outer stage's capture slot
+        prev_cap, prev_state = self._spmd_capture, self._spmd_captured
+        self._spmd_capture = node.info.exchange
+        self._spmd_captured = None
+        cin = self.visit(node.children[0])
+        cap_state = self._spmd_captured
+        self._spmd_capture, self._spmd_captured = prev_cap, prev_state
+        after_d = self.report.dispatches
+        inner_lo = after_d.lo - before_d.lo
+        self.report.dispatches = Interval(
+            before_d.lo + min(1, inner_lo), after_d.hi)
+        self._inexact()
+
+        hint = None
+        if cap_state is not None and cap_state.rows.hi != INF:
+            hint = int(cap_state.rows.hi)
+        node.bucket_rows_hint = hint
+
+        try:
+            import jax
+
+            from spark_rapids_tpu import conf as _C
+
+            m = len(jax.devices())
+            want = int(self.conf.get(_C.SPMD_MESH_DEVICES) or 0)
+            if want:
+                m = min(m, want)
+        except Exception:  # pragma: no cover - no backend at plan time
+            m = 1
+        m_out = 1 if node.info.sort is not None else m
+        inter_bytes = _row_bytes(node.info.exchange.children[0].output,
+                                 self.physical)
+        inter_attrs = node.info.exchange.children[0].output
+        has_strings = any(
+            getattr(a.data_type, "is_string", False)
+            for a in list(inter_attrs) + list(node.output))
+        est_hi = INF
+        if hint is not None:
+            # per-(shard, target) buckets of bucket_cap rows: data +
+            # validity lanes + the live mask; the absorbed sort all_gathers
+            # the merged output (m * received-lanes) to every shard
+            bucket = _bucket(max(hint, 8))
+            est_hi = _mulsafe(m * m * bucket,
+                              inter_bytes + 2 * len(inter_attrs) + 8)
+            if m_out == 1:
+                out_bytes = _row_bytes(node.output, self.physical)
+                est_hi = _addsafe(est_hi, _mulsafe(
+                    m * m * m * bucket,
+                    out_bytes + 2 * len(node.output) + 8))
+        if hint is None or has_strings:
+            # string keys travel as padded byte matrices whose width the
+            # plan cannot bound (the runtime pow2-buckets the actual max
+            # length) — only an unbounded METRIC ceiling is sound. The
+            # residency estimate below stays on the finite per-row-bytes
+            # figure: _resident only raises the pessimistic peak hi, so a
+            # width underestimate can at worst under-warn SPILL_LIKELY
+            coll = Interval(0, INF)
+        else:
+            coll = Interval(0, est_hi)
+        self.report.spmd_stages += 1
+        self.report.collective_bytes = self.report.collective_bytes.add(
+            coll)
+        self._compiles("spmd_stage", node.stage_id, (0,))
+
+        # output: m live-masked partitions (ONE globally sorted partition
+        # when the sort tail is absorbed); union with the host-loop flow so
+        # downstream models stay containment-correct under fallback
+        parts = max(cin.parts, m_out)
+        batches = Interval(0, max(_hi_or(cin.batches.hi, parts), parts))
+        # output batches are live-masked at the program's received-lane
+        # capacity: m * bucket_cap lanes (x m again when the absorbed sort
+        # all_gathers), bucket_cap bounded by the captured partial rows
+        if hint is not None:
+            lane_hi = _mulsafe(m * m if m_out == 1 else m,
+                               _bucket(max(hint, 1)))
+            batch_rows = Interval(0, max(lane_hi,
+                                         _hi_or(cin.batch_rows.hi, 0)))
+        else:
+            batch_rows = Interval(0, INF)
+        st = self._mk(node, cin.rows, parts, Interval(0, parts), batches,
+                      batch_rows, set(), lazy_tail=True,
+                      ndv=cin.col_ndv, rng=cin.col_range)
+        # the executor materializes the WHOLE stage input as [m, cap]
+        # mesh-global arrays before the one dispatch — the host-loop
+        # streaming model above never charges that. 2x covers the pow2
+        # slot padding; strings ride the analyzer-wide per-row estimate
+        # (_row_bytes), same as every other string residency figure
+        try:
+            sub = _Analyzer(self.conf, self.budget, donation=self.donation)
+            in_rows = sub.visit(node.info.input_node).rows.hi
+        except Exception:  # pragma: no cover - estimator is best-effort
+            in_rows = INF
+        if in_rows != INF:
+            in_rows = _bucket(max(int(in_rows), 1))
+        in_bytes = _mulsafe(2, _mulsafe(
+            in_rows, _row_bytes(node.info.input_attrs, self.physical)))
+        self._resident(node, _addsafe(est_hi, in_bytes), st,
+                       Interval(1, 1))
+        return st
+
     # -- exchanges ------------------------------------------------------------
     def _exchange(self, node) -> AbsState:
         from spark_rapids_tpu.shuffle.exchange import (
@@ -1195,6 +1329,8 @@ class _Analyzer:
         )
 
         cin = self.visit(node.children[0])
+        if self._spmd_capture is node:
+            self._spmd_captured = cin
         p = node.partitioning
         n_out = p.num_partitions
         row_bytes = cin.row_bytes

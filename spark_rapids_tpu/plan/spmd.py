@@ -1,0 +1,384 @@
+"""Single-program SPMD stage compiler (plan side).
+
+The scale-out unlock of ROADMAP open item 1: where the host-loop executor
+runs a stage as O(partitions x ops) per-partition dispatches with the
+exchange mediated through host-visible buffers, this pass identifies
+maximal SPMD-eligible stage pipelines in the FINAL physical plan and
+lowers each into ONE jitted `shard_map` program over the session device
+mesh (engine/spmd_exec.py builds and runs it):
+
+    [TpuSortExec                       <- optional absorbed global-sort tail
+      [TpuShuffleExchangeExec(Range)]]
+        TpuHashAggregateExec(final)    <- in-program merge + finalize
+          TpuShuffleExchangeExec(Hash) <- in-program lax.all_to_all epoch
+            TpuHashAggregateExec(partial) + Filter/Project chain
+                                       <- in-program update side
+              <stage input>            <- host batches (scan) or device
+                                          batches (join output, previous
+                                          SPMD stage)
+
+Best-effort TpuCoalesceBatches nodes between the pattern members are
+transparent (they are perf no-ops once the whole pipeline is one program).
+Theseus (PAPERS.md) is the blueprint: the distributed plan is designed
+around data movement — the exchange is a collective INSIDE the stage
+program, not a host-driven boundary between task loops.
+
+Like `TpuFusedStageExec`, the wrapper node keeps the ORIGINAL operator
+subtree as its child: EXPLAIN, the plan verifier, and the resource
+analyzer keep seeing the member nodes, and the host-loop executor is
+always one `children[0].execute()` away — ineligible-at-runtime stages,
+checked replays, and CPU fallbacks all take that path, so the PR 4/PR 6
+retry and re-attribution contracts hold unchanged (docs/spmd-stages.md).
+
+Conf: rapids.tpu.sql.spmd.enabled (default off), spmd.meshDevices,
+spmd.bucketRows, spmd.maxSortLanes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import (
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+    TpuExec,
+    count_output,
+)
+from spark_rapids_tpu.ops.base import AttributeReference, Expression
+
+log = logging.getLogger(__name__)
+
+# merge-safe reduce ops the in-program aggregate supports; everything else
+# (holistic percentiles, order-dependent first/last, string min/max with
+# their chunked arg-extreme machinery) keeps the host-loop executor
+_SPMD_OPS = ("sum", "count", "min", "max")
+
+
+class SpmdStageInfo:
+    """Everything the stage program builder needs, extracted once at plan
+    time. Expressions are UNBOUND (over attr references); the executor
+    binds them against the pruned stage-input schema."""
+
+    __slots__ = (
+        "head", "sort", "sort_keys", "final", "exchange", "partial",
+        "input_node", "host_input", "input_attrs", "needed_ordinals",
+        "key_exprs", "input_exprs", "filters", "op_names", "merge_ops",
+        "result_exprs", "result_key_idx", "hash_key_idx", "n_keys",
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+def _skip_coalesce(node: PhysicalExec) -> PhysicalExec:
+    """Walk through batch coalesces between pattern members. TargetSize
+    coalesces are pure perf; a RequireSingleBatch below a sort only exists
+    so the host-loop sort sees one batch per partition — inside the single
+    stage program both are moot."""
+    from spark_rapids_tpu.exec.transitions import TpuCoalesceBatchesExec
+
+    while isinstance(node, TpuCoalesceBatchesExec):
+        node = node.children[0]
+    return node
+
+
+def _string_refs(e: Expression) -> List[AttributeReference]:
+    return [a for a in e.collect(
+        lambda n: isinstance(n, AttributeReference))
+        if a.data_type is DataType.STRING]
+
+
+def match_spmd_stage(node: PhysicalExec) -> Optional[SpmdStageInfo]:
+    """The SPMD stage pattern rooted at `node`, or None. See the module
+    docstring for the shape; docs/spmd-stages.md for the eligibility
+    rules in prose."""
+    from spark_rapids_tpu.exec.aggregate import (
+        FINAL,
+        PARTIAL,
+        TpuHashAggregateExec,
+        _collapse_scan_chain,
+        rewrite_result_exprs,
+    )
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec, exprs_fusable
+    from spark_rapids_tpu.exec.sort import TpuSortExec
+    from spark_rapids_tpu.shuffle.exchange import (
+        HashPartitioning,
+        RangePartitioning,
+        TpuShuffleExchangeExec,
+    )
+
+    # -- optional global-sort tail -------------------------------------------
+    sort = None
+    cur = node
+    if isinstance(cur, TpuSortExec):
+        below = _skip_coalesce(cur.children[0])
+        if not (isinstance(below, TpuShuffleExchangeExec)
+                and isinstance(below.partitioning, RangePartitioning)):
+            return None
+        rp = below.partitioning
+        if len(rp.orders) != len(cur.orders) or any(
+                not (isinstance(a.child, AttributeReference)
+                     and isinstance(b.child, AttributeReference)
+                     and a.child.expr_id == b.child.expr_id
+                     and a.ascending == b.ascending
+                     and a.nulls_first == b.nulls_first)
+                for a, b in zip(rp.orders, cur.orders)):
+            return None  # the exchange must implement exactly this sort
+        sort = cur
+        cur = _skip_coalesce(below.children[0])
+
+    # -- final aggregate ------------------------------------------------------
+    if not (isinstance(cur, TpuHashAggregateExec) and cur.mode == FINAL
+            and cur.grouping):
+        return None
+    final = cur
+
+    # -- hash exchange --------------------------------------------------------
+    ex = _skip_coalesce(final.children[0])
+    if not (isinstance(ex, TpuShuffleExchangeExec)
+            and isinstance(ex.partitioning, HashPartitioning)
+            and ex.partitioning.exprs):
+        return None
+    exchange = ex
+
+    # -- partial aggregate (possibly inside an agg-form fused stage) ---------
+    pa = _skip_coalesce(exchange.children[0])
+    if isinstance(pa, TpuFusedStageExec) and pa.agg_form:
+        pa = pa.children[0]
+    if not (isinstance(pa, TpuHashAggregateExec) and pa.mode == PARTIAL):
+        return None
+    partial = pa
+
+    n_keys = len(final.grouping)
+    inter = exchange.children[0].output  # partial output: keys + buffers
+    if len(partial.grouping) != n_keys or \
+            len(inter) != n_keys + len(final.buffer_attrs):
+        return None
+    # positional dtype agreement between the partial's emitted buffers and
+    # the final's declared ones (the exchange passes them through verbatim)
+    for a, b in zip(inter, list(final.grouping) + final.buffer_attrs):
+        if a.data_type != b.data_type:
+            return None
+    if any(a.data_type is DataType.STRING for a in final.buffer_attrs):
+        return None  # string min/max buffers stay host-loop
+
+    # the exchange must route by (a subset of) the grouping keys so equal
+    # key tuples meet on one shard
+    hash_key_idx: List[int] = []
+    key_ids = [a.expr_id for a in inter[:n_keys]]
+    for e in exchange.partitioning.exprs:
+        if not isinstance(e, AttributeReference) or e.expr_id not in key_ids:
+            return None
+        hash_key_idx.append(key_ids.index(e.expr_id))
+
+    # -- update side: collapse the chain below the partial -------------------
+    ops = partial._update_ops()
+    op_names = [op for op, _, _ in ops]
+    if any(op not in _SPMD_OPS for op in op_names):
+        return None
+    merge_ops = final._merge_ops()
+    if any(op not in _SPMD_OPS for op, _ in merge_ops):
+        return None
+    raw_exprs = list(partial.key_exprs) + [e for _, e, _ in ops]
+    input_node, rewritten, filters = _collapse_scan_chain(
+        partial.children[0], raw_exprs)
+    key_exprs = rewritten[:n_keys]
+    input_exprs = rewritten[n_keys:]
+    if not exprs_fusable(key_exprs + input_exprs + filters):
+        return None
+
+    # -- string discipline ----------------------------------------------------
+    # string stage-input columns travel as fixed-width byte matrices, so
+    # they may only be consumed as DIRECT key references (hashed/grouped
+    # straight from the matrix representation, shuffle/ici.py); computed
+    # expressions must not read them
+    for e in key_exprs:
+        if e.data_type is DataType.STRING:
+            if not isinstance(e, AttributeReference):
+                return None
+        elif _string_refs(e):
+            return None
+    for e in list(input_exprs) + list(filters):
+        if e.data_type is DataType.STRING or _string_refs(e):
+            return None
+
+    # -- finalize side --------------------------------------------------------
+    result_exprs = rewrite_result_exprs(final.agg_exprs, final.specs)
+    inter_attrs = final._inter_attrs
+    grouping_ids = [a.expr_id for a in final.grouping]
+    result_key_idx: List[Optional[int]] = []
+    for e in result_exprs:
+        if e.data_type is DataType.STRING:
+            if not (isinstance(e, AttributeReference)
+                    and e.expr_id in grouping_ids):
+                return None
+            result_key_idx.append(grouping_ids.index(e.expr_id))
+        else:
+            if _string_refs(e):
+                return None
+            result_key_idx.append(None)
+    if not exprs_fusable(result_exprs):
+        return None
+
+    # -- absorbed sort keys ---------------------------------------------------
+    sort_keys: Optional[List[Tuple[int, bool, bool]]] = None
+    if sort is not None:
+        out_ids = [a.expr_id for a in final.output]
+        sort_keys = []
+        for o in sort.orders:
+            if not (isinstance(o.child, AttributeReference)
+                    and o.child.expr_id in out_ids):
+                return None
+            sort_keys.append((out_ids.index(o.child.expr_id),
+                              o.ascending, o.nulls_first))
+
+    # -- stage input ----------------------------------------------------------
+    from spark_rapids_tpu.exec.transitions import HostToDeviceExec
+
+    host_input = isinstance(input_node, HostToDeviceExec)
+    if not host_input and input_node.placement != "tpu":
+        return None
+
+    # prune the stage input to the columns the program actually reads
+    input_attrs = list(input_node.output)
+    needed_ids = set()
+    for e in key_exprs + input_exprs + filters:
+        for a in e.collect(lambda n: isinstance(n, AttributeReference)):
+            needed_ids.add(a.expr_id)
+    needed_ordinals = [i for i, a in enumerate(input_attrs)
+                       if a.expr_id in needed_ids]
+    pruned = [input_attrs[i] for i in needed_ordinals]
+    if needed_ids - {a.expr_id for a in pruned}:
+        return None  # an expression reads a column the input never emits
+
+    return SpmdStageInfo(
+        head=node, sort=sort, sort_keys=sort_keys, final=final,
+        exchange=exchange, partial=partial, input_node=input_node,
+        host_input=host_input, input_attrs=pruned,
+        needed_ordinals=needed_ordinals, key_exprs=key_exprs,
+        input_exprs=input_exprs, filters=filters, op_names=op_names,
+        merge_ops=merge_ops, result_exprs=result_exprs,
+        result_key_idx=result_key_idx, hash_key_idx=hash_key_idx,
+        n_keys=n_keys)
+
+
+class TpuSpmdStageExec(TpuExec):
+    """One SPMD stage pipeline compiled to a single shard_map program over
+    the mesh (engine/spmd_exec.py). children[0] is the ORIGINAL subtree —
+    the host-loop executor for this stage, taken whenever the program is
+    ineligible at runtime, a fault exhausts its retries, or the session is
+    replaying in checked mode."""
+
+    def __init__(self, stage_id: int, head: PhysicalExec,
+                 info: SpmdStageInfo):
+        super().__init__(head)
+        self.stage_id = stage_id
+        self.info = info
+        # filled by the resource analyzer (plan/resources._spmd_stage):
+        # sound upper bound on the partial-aggregate output rows, sizing
+        # the per-target exchange buckets inside the program
+        self.bucket_rows_hint: Optional[int] = None
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def with_children(self, new_children):
+        info = match_spmd_stage(new_children[0])
+        if info is None:
+            # the rebuilt subtree no longer matches the pattern — hand the
+            # bare subtree back rather than wrap an unrunnable stage
+            return new_children[0]
+        return TpuSpmdStageExec(self.stage_id, new_children[0], info)
+
+    def node_name(self):
+        inner = ["PartialAgg", "AllToAll", "FinalAgg"]
+        if self.info.sort is not None:
+            inner.append("Sort")
+        return f"TpuSpmdStage({self.stage_id})[{'->'.join(inner)}]"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.engine import spmd_exec
+        from spark_rapids_tpu.engine.retry import (
+            TpuAsyncSinkError,
+            failure_is_device_rooted,
+        )
+
+        if AX.in_checked_mode() or not ctx.conf.get(C.SPMD_ENABLED):
+            # the checked replay must re-attribute errors to HOST-LOOP
+            # dispatch sites (docs/async-execution.md); a conf flip between
+            # plan and execute degrades the same way
+            return self._host_loop(ctx)
+        # the fallback runs AFTER the except blocks: the in-flight
+        # exception's traceback pins execute_stage's frame — including the
+        # whole assembled [m, cap] input table — and the host-loop re-run
+        # happens exactly when device memory is tightest
+        try:
+            return spmd_exec.execute_stage(self, ctx)
+        except spmd_exec.SpmdStageFallback as e:
+            log.warning("SPMD stage %d ineligible at runtime (%s); "
+                        "degrading to the host-loop executor",
+                        self.stage_id, e)
+        except Exception as e:  # noqa: BLE001 — degradation boundary
+            if isinstance(e, TpuAsyncSinkError) or not \
+                    failure_is_device_rooted(e):
+                # sink-attributed errors belong to the session's checked
+                # replay; non-device errors are real bugs — neither may be
+                # absorbed by the stage fallback
+                raise
+            log.warning("SPMD stage %d failed on-device (%r); degrading "
+                        "to the host-loop executor", self.stage_id, e)
+        return self._host_loop(ctx)
+
+    def _host_loop(self, ctx: ExecContext) -> PartitionedBatches:
+        pb = self.children[0].execute(ctx)
+        return PartitionedBatches(
+            pb.num_partitions,
+            lambda p: count_output(self.metrics, pb.iterator(p)),
+            bucket_costs=pb.bucket_costs)
+
+
+def lower_spmd_stages(plan: PhysicalExec, conf: C.TpuConf) -> PhysicalExec:
+    """Wrap every maximal SPMD-eligible pipeline in a TpuSpmdStageExec.
+    Runs LAST in the plan pipeline (after fusion), so the wrapped subtree
+    is exactly what the host-loop executor would run."""
+    from spark_rapids_tpu.engine import async_exec as AX
+
+    if not conf.get(C.SPMD_ENABLED) or AX.in_checked_mode():
+        return plan
+    counter = itertools.count(1)
+
+    def walk(node: PhysicalExec) -> PhysicalExec:
+        info = match_spmd_stage(node)
+        if info is not None:
+            # recurse only at/below the stage INPUT (a nested pipeline,
+            # e.g. a double group-by, becomes this stage's device input);
+            # the pattern members themselves are consumed by this stage
+            inp = info.input_node
+            new_inp = walk(inp)
+            if new_inp is not inp:
+                node = node.transform_up(
+                    lambda n: new_inp if n is inp else n)
+                info = match_spmd_stage(node)
+                if info is None:  # pragma: no cover - rebuild kept shape
+                    return node
+            return TpuSpmdStageExec(next(counter), node, info)
+        new_children = [walk(c) for c in node.children]
+        if new_children and any(
+                a is not b for a, b in zip(new_children, node.children)):
+            node = node.with_children(new_children)
+        return node
+
+    return walk(plan)
+
+
+def count_spmd_stages(plan: PhysicalExec) -> int:
+    return len(plan.collect_nodes(
+        lambda n: isinstance(n, TpuSpmdStageExec)))

@@ -149,8 +149,17 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
 
     available = _attr_map(a for c in node.children for a in c.output)
 
+    from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+
     # -- per-class structure/reference checks --------------------------------
-    if isinstance(node, TpuFusedStageExec):
+    if isinstance(node, TpuSpmdStageExec):
+        # the wrapper is schema-transparent over its host-loop subtree
+        # (which is verified member-by-member on its own walk); a missing
+        # lowering record means execute() could never build the program
+        _check_identity_schema(node, out)
+        if node.info is None:
+            out.append(f"{name}: SPMD stage carries no lowering info")
+    elif isinstance(node, TpuFusedStageExec):
         _check_fused_stage(node, out)
     elif isinstance(node, (B.TpuProjectExec, B.CpuProjectExec)):
         if len(output) != len(node.project_list):

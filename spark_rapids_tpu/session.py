@@ -130,6 +130,12 @@ class TpuSession:
         # session's hbm.sizeOverride leaks into every session that
         # follows in the process
         TpuDeviceManager.shutdown()
+        # same leak class for the collective meshes (shuffle/ici.py): a
+        # test session's mesh must not pin its device set (and cached
+        # shard_map programs keyed on it) into later sessions
+        from spark_rapids_tpu.shuffle import ici as _ici
+
+        _ici.reset_mesh()
         with TpuSession._lock:
             if TpuSession._active is self:
                 TpuSession._active = None
@@ -167,11 +173,16 @@ class TpuSession:
 
     def _physical_plan(self, plan: L.LogicalPlan) -> PhysicalExec:
         from spark_rapids_tpu.plan.fusion import fuse_stages
+        from spark_rapids_tpu.plan.spmd import lower_spmd_stages
 
         cpu_plan = plan_physical(self._optimized(plan), self.conf)
         tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         final = fuse_stages(final, self.conf)
+        # LAST: single-program SPMD stage lowering (plan/spmd.py) — the
+        # wrapped subtree is exactly what the host-loop executor would run,
+        # so eligibility fallback is always one children[0].execute() away
+        final = lower_spmd_stages(final, self.conf)
         if self.conf.get(C.PLAN_VERIFY):
             from spark_rapids_tpu.plan.verify import (
                 PlanVerificationError,
@@ -250,6 +261,7 @@ class TpuSession:
     def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
         from spark_rapids_tpu.plan.fusion import fuse_stages
         from spark_rapids_tpu.plan.meta import explain_string
+        from spark_rapids_tpu.plan.spmd import lower_spmd_stages
 
         cpu_plan = plan_physical(self._optimized(plan), self.conf)
         explain_out: List[str] = []
@@ -258,6 +270,7 @@ class TpuSession:
             explain_out=explain_out)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         final = fuse_stages(final, self.conf)
+        final = lower_spmd_stages(final, self.conf)
         parts = []
         if explain_out:
             parts.append("== TPU tagging ==\n" + explain_out[0])
@@ -307,7 +320,8 @@ class TpuSession:
         before = (M.retry_count(), M.split_retry_count(),
                   M.cpu_fallback_count(), M.fetch_retry_count(),
                   M.fence_count(), M.checked_replay_count(),
-                  M.donated_bytes())
+                  M.donated_bytes(), M.spmd_stage_count(),
+                  M.collective_bytes())
         cpu_fallback_ok = self.conf.get(C.CPU_FALLBACK_ENABLED)
         if breaker.is_open() and cpu_fallback_ok:
             # the session's device is unhealthy: remaining queries plan
@@ -338,6 +352,8 @@ class TpuSession:
             M.FENCES: M.fence_count() - before[4],
             M.CHECKED_REPLAYS: M.checked_replay_count() - before[5],
             M.DONATED_BYTES: M.donated_bytes() - before[6],
+            M.SPMD_STAGES: M.spmd_stage_count() - before[7],
+            M.COLLECTIVE_BYTES: M.collective_bytes() - before[8],
         }
         return [b for part in results for b in part]
 

@@ -50,6 +50,9 @@ from spark_rapids_tpu.parallel.mesh import DATA_AXIS, all_to_all_table, build_me
 
 _MESH_LOCK = threading.Lock()
 _MESH: Optional[Mesh] = None
+# SPMD stage meshes keyed by device count (0 = all local devices); unlike
+# _MESH these exist even on a 1-device backend (same program, 1 chip)
+_STAGE_MESHES: dict = {}
 
 
 def session_mesh() -> Optional[Mesh]:
@@ -71,6 +74,38 @@ def session_mesh() -> Optional[Mesh]:
                 else:
                     _MESH = build_mesh()
         return _MESH
+
+
+def stage_mesh(n_devices: int = 0) -> Mesh:
+    """Mesh for single-program SPMD stages (engine/spmd_exec.py): the
+    session mesh when it spans the requested device count, else a 1-D mesh
+    over the first n devices. Unlike `session_mesh` this never returns
+    None — an SPMD stage program runs unchanged on a 1-chip mesh."""
+    n = int(n_devices or 0)
+    with _MESH_LOCK:
+        got = _STAGE_MESHES.get(n)
+        if got is not None:
+            return got
+    if n == 0:
+        full = session_mesh()
+        if full is None:
+            full = build_mesh()
+        mesh = full
+    else:
+        mesh = build_mesh(min(n, len(jax.devices())))
+    with _MESH_LOCK:
+        return _STAGE_MESHES.setdefault(n, mesh)
+
+
+def reset_mesh() -> None:
+    """Forget the process-wide meshes (called from session.stop(), the
+    same process-leak class as the PR 3 device-manager singleton fix): a
+    test session's mesh — built over whatever device set that session
+    saw — must never leak into later sessions in the process."""
+    global _MESH
+    with _MESH_LOCK:
+        _MESH = None
+        _STAGE_MESHES.clear()
 
 
 def supports_ici(partitioning, child_attrs, n: int) -> bool:
@@ -462,6 +497,12 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
              if bounds_np is None else bounds_np)
         args.append(_to_global(jnp.asarray(b), NamedSharding(mesh, P())))
     out = kernel(*args)
+    # bytes the in-program all_to_all moved across the mesh: exactly the
+    # received bucket arrays (metadata only — no value is read)
+    from spark_rapids_tpu.utils import metrics as M
+
+    M.record_collective_bytes(
+        sum(int(np.prod(o.shape)) * o.dtype.itemsize for o in out))
     if not out[0].is_fully_addressable:
         # multi-controller mesh (the exchange spans OS processes): replicate
         # the received arrays so every process can serve any partition to

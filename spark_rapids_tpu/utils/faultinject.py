@@ -45,6 +45,7 @@ SITES: Dict[str, str] = {
     "agg.finalize": "oom",
     "join": "oom",
     "sort": "oom",
+    "spmd.stage": "oom",
     "transfer.upload": "transfer",
     "transfer.download": "transfer",
     "shuffle.fetch": "fetch",

@@ -47,6 +47,12 @@ FETCH_RETRIES = "fetchRetries"
 FENCES = "fencesPerQuery"
 CHECKED_REPLAYS = "checkedReplays"
 DONATED_BYTES = "donatedBytes"
+# single-program SPMD stage metrics (plan/spmd.py, engine/spmd_exec.py):
+# spmdStages = stage pipelines that executed as ONE shard_map program over
+# the mesh; collectiveBytes = bytes moved by in-program ICI collectives
+# (the all_to_all exchange epoch and the sort-absorbing all_gather)
+SPMD_STAGES = "spmdStages"
+COLLECTIVE_BYTES = "collectiveBytes"
 
 
 class Metric:
@@ -139,6 +145,8 @@ _FETCH_RETRIES = Metric(FETCH_RETRIES)
 _FENCES = Metric(FENCES)
 _CHECKED_REPLAYS = Metric(CHECKED_REPLAYS)
 _DONATED_BYTES = Metric(DONATED_BYTES)
+_SPMD_STAGES = Metric(SPMD_STAGES)
+_COLLECTIVE_BYTES = Metric(COLLECTIVE_BYTES)
 
 
 def record_retry(n: int = 1) -> None:
@@ -211,6 +219,27 @@ def record_donated_bytes(n: int) -> None:
 
 def donated_bytes() -> int:
     return _DONATED_BYTES.value
+
+
+def record_spmd_stage(n: int = 1) -> None:
+    """Count one stage pipeline executed as a single SPMD program over the
+    mesh (operators AND exchange compiled into one dispatch)."""
+    _SPMD_STAGES.add(n)
+
+
+def spmd_stage_count() -> int:
+    return _SPMD_STAGES.value
+
+
+def record_collective_bytes(n: int) -> None:
+    """Count bytes moved by an in-program ICI collective (the all_to_all
+    exchange epoch of an SPMD stage or the standalone ICI shuffle tier,
+    and the sort-absorbing all_gather)."""
+    _COLLECTIVE_BYTES.add(n)
+
+
+def collective_bytes() -> int:
+    return _COLLECTIVE_BYTES.value
 
 
 @contextlib.contextmanager

@@ -175,6 +175,51 @@ def main_tpch() -> None:
     }), flush=True)
 
 
+def main_spmd() -> None:
+    """SPMD-stage mode: TPC-H q1 and q5 run with their whole pipeline —
+    partial agg, hash exchange (in-program all_to_all), final agg, sort —
+    compiled into ONE shard_map program spanning the 2-process 8-device
+    global mesh (plan/spmd.py + engine/spmd_exec.py), checked against the
+    in-process CPU oracle. The pod-slice deployment shape of ROADMAP open
+    item 1: same program as the 1-chip run, bigger mesh."""
+    from spark_rapids_tpu.parallel import distributed as D
+
+    assert D.init_distributed(), "expected multi-process env"
+    import jax
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.benchmarks import tpch
+
+    sess = srt.new_session()
+    sess.conf.set("rapids.tpu.sql.enabled", True)
+    sess.conf.set("rapids.tpu.sql.spmd.enabled", True)
+    sess.conf.set("rapids.tpu.sql.shuffle.partitions", len(jax.devices()))
+    sess.conf.set("rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+
+    from tests.harness import assert_rows_equal
+
+    # deterministic generator -> identical tables on every process
+    tables = tpch.gen_tables(sess, sf=0.002, num_partitions=4)
+    results = {}
+    spmd_stages = {}
+    for qname in ("q1", "q5"):
+        got = tpch.QUERIES[qname](tables).collect()
+        spmd_stages[qname] = sess.last_query_metrics["spmdStages"]
+        sess.conf.set("rapids.tpu.sql.enabled", False)
+        want = tpch.QUERIES[qname](tables).collect()
+        sess.conf.set("rapids.tpu.sql.enabled", True)
+        assert_rows_equal(want, got, ignore_order=True, approx_float=1e-9)
+        results[qname] = len(got)
+
+    print(json.dumps({
+        "pid": D.process_index(),
+        "devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "rows": results,
+        "spmd_stages": spmd_stages,
+    }), flush=True)
+
+
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -189,5 +234,7 @@ if __name__ == "__main__":
         main_engine()
     elif len(sys.argv) > 1 and sys.argv[1] == "--tpch":
         main_tpch()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--spmd":
+        main_spmd()
     else:
         main()

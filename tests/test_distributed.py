@@ -105,6 +105,20 @@ def test_two_process_dataframe_query():
 
 
 @pytest.mark.slow
+def test_two_process_spmd_stages():
+    """TPC-H q1 and q5 run their whole agg pipeline as ONE shard_map
+    program spanning the 2-process x 4-device global mesh — the exchange
+    is an in-program all_to_all crossing OS processes over gloo — with
+    each process asserting equality to the CPU oracle in-worker
+    (ROADMAP open item 1's pod-slice shape; docs/spmd-stages.md)."""
+    outs = _run_two_workers("--spmd", timeout=480, label="spmd worker")
+    assert outs[0]["devices"] == 8 and outs[0]["local_devices"] == 4
+    assert outs[0]["spmd_stages"] == {"q1": 1, "q5": 1}
+    assert outs[0]["rows"]["q1"] > 0 and outs[0]["rows"]["q5"] > 0
+    assert outs[0] == {**outs[1], "pid": 0}
+
+
+@pytest.mark.slow
 def test_two_process_tpch_queries():
     """TPC-H q3 (string predicates + join + groupBy + sort) and q6 execute
     across 2 OS processes x 4 devices through the ICI shuffle tier, each

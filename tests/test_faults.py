@@ -516,6 +516,84 @@ def test_circuit_breaker_trips_session_to_cpu(session):
 
 
 # ---------------------------------------------------------------------------
+# SPMD stage path (plan/spmd.py, engine/spmd_exec.py): injected faults in
+# the single-program stage degrade to the host-loop executor — and through
+# it to the full PR 4 ladder — with oracle-equal results
+# ---------------------------------------------------------------------------
+_SPMD_CONF = {
+    "rapids.tpu.sql.spmd.enabled": True,
+    "rapids.tpu.sql.spmd.meshDevices": 1,
+}
+
+
+def test_spmd_stage_site_registered():
+    assert FI.SITES.get("spmd.stage") == "oom"
+
+
+def test_chaos_spmd_stage_oom_retries_then_degrades(session):
+    """rate=1.0 at the spmd.stage site: every program dispatch raises an
+    injected OOM, the with_retry ladder exhausts, and the stage falls
+    back to the host-loop subtree (whose sites are NOT armed) — results
+    equal the oracle and the degraded run counts zero spmdStages."""
+    df_fn = _tpch_q("q1")
+    cpu = run_on_cpu(session, df_fn)
+    conf = dict(_SPMD_CONF)
+    conf.update(_chaos_conf(seed=7, sites="spmd.stage", rate=1.0))
+    tpu = run_on_tpu(session, df_fn, extra_conf=conf)
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    m = session.last_query_metrics
+    assert m["spmdStages"] == 0, m
+    assert m["retries"] > 0, m
+
+
+@pytest.mark.slow  # protects the tier-1 dots window
+def test_chaos_spmd_stage_transient_recovers_in_place(session):
+    """A sub-1.0 rate lets the retry re-roll succeed: the stage must
+    recover IN PLACE (spmdStages == 1) without the host-loop fallback."""
+    df_fn = _tpch_q("q1")
+    cpu = run_on_cpu(session, df_fn)
+    conf = dict(_SPMD_CONF)
+    conf.update(_chaos_conf(seed=3, sites="spmd.stage:dispatch",
+                            rate=0.5))
+    tpu = run_on_tpu(session, df_fn, extra_conf=conf)
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    assert session.last_query_metrics["spmdStages"] == 1
+
+
+@pytest.mark.slow  # protects the tier-1 dots window
+def test_chaos_spmd_defer_to_sink_checked_replay(session):
+    """Under deferToSink the injected stage fault surfaces at the query
+    sink; the session's ONE checked replay re-executes host-loop (SPMD is
+    disabled in checked mode), where the originating site's machinery
+    owns it — the PR 6 re-attribution contract, unchanged."""
+    df_fn = _tpch_q("q1")
+    cpu = run_on_cpu(session, df_fn)
+    conf = dict(_SPMD_CONF)
+    conf.update(_chaos_conf(seed=11, sites="spmd.stage", rate=1.0))
+    conf["rapids.tpu.test.faultInjection.deferToSink"] = True
+    tpu = run_on_tpu(session, df_fn, extra_conf=conf)
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    m = session.last_query_metrics
+    assert m["checkedReplays"] >= 1, m
+    # the FIRST attempt ran (and counted) the SPMD program before its
+    # deferred fault surfaced at the sink; the replay itself is host-loop,
+    # so exactly one stage execution is recorded for the whole query
+    assert m["spmdStages"] == 1, m
+
+
+@pytest.mark.slow  # heavy chaos combination: protects the tier-1 dots window
+def test_chaos_spmd_q1_all_sites(session):
+    """Everything armed at once over the SPMD path: stage faults, scan
+    faults, transfer faults — the query completes and matches."""
+    df_fn = _tpch_q("q1")
+    cpu = run_on_cpu(session, df_fn)
+    conf = dict(_SPMD_CONF)
+    conf.update(_chaos_conf(seed=5, sites="*", rate=0.3))
+    tpu = run_on_tpu(session, df_fn, extra_conf=conf)
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+
+
+# ---------------------------------------------------------------------------
 # No-injection invariants (the acceptance criterion's second half)
 # ---------------------------------------------------------------------------
 def test_no_injection_means_zero_retries(session):

@@ -1,0 +1,242 @@
+"""Single-program SPMD stage tests (plan/spmd.py + engine/spmd_exec.py).
+
+The load-bearing claims, each pinned here:
+- oracle equality: TPC-H q1/q5 over the SPMD path equal the CPU oracle on
+  a 1-device mesh AND on the full 8-virtual-device mesh (same program,
+  different mesh — ROADMAP open item 1's core promise);
+- one dispatch per stage: flagship q1's measured deviceDispatches is
+  INDEPENDENT of the partition count (same at 4 and 16 partitions) and a
+  small fraction of the host-loop executor's;
+- graceful degradation: ineligible shapes, undersized exchange buckets
+  (the in-program overflow probe), and checked replays all take the
+  host-loop subtree with unchanged results;
+- static analysis: the resource analyzer's dispatch prediction contains
+  the measured count in BOTH modes, and EXPLAIN surfaces the stage.
+"""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import (
+    assert_rows_equal,
+    assert_tpu_and_cpu_are_equal_collect,
+    run_on_cpu,
+    run_on_tpu,
+)
+
+SPMD_1DEV = {
+    "rapids.tpu.sql.spmd.enabled": True,
+    "rapids.tpu.sql.spmd.meshDevices": 1,
+}
+SPMD_FULL = {
+    "rapids.tpu.sql.spmd.enabled": True,
+    "rapids.tpu.sql.spmd.meshDevices": 0,
+}
+
+
+def _tpch_q(qname, num_partitions=3):
+    def f(s):
+        tables = tpch.gen_tables(s, sf=0.0005,
+                                 num_partitions=num_partitions)
+        return tpch.QUERIES[qname](tables)
+
+    return f
+
+
+def _metrics_of(session, df_fn, extra_conf):
+    got = run_on_tpu(session, df_fn, extra_conf=extra_conf)
+    return got, dict(session.last_query_metrics)
+
+
+# ---------------------------------------------------------------------------
+# Oracle equality: the q1/q5 flagship shapes
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("qname", ["q1", "q5"])
+def test_tpch_oracle_equality_one_device_mesh(session, qname):
+    """q1 (string-keyed agg + absorbed sort) and q5 (join-fed agg with a
+    string group key + float sort) on a 1-chip mesh: the SPMD program
+    must actually run (spmdStages == 1) and match the oracle."""
+    df_fn = _tpch_q(qname)
+    cpu = run_on_cpu(session, df_fn)
+    got, m = _metrics_of(session, df_fn, SPMD_1DEV)
+    assert m["spmdStages"] == 1, m
+    assert m["collectiveBytes"] > 0, m
+    assert_rows_equal(cpu, got, ignore_order=True, approx_float=1e-9)
+
+
+@pytest.mark.slow  # 8-device stage programs compile slowly on 1-core CI
+@pytest.mark.parametrize("qname", ["q1", "q5"])
+def test_tpch_oracle_equality_full_mesh(session, qname):
+    """The SAME stage program over the full 8-virtual-device mesh — the
+    in-program all_to_all actually crosses shards."""
+    df_fn = _tpch_q(qname)
+    cpu = run_on_cpu(session, df_fn)
+    got, m = _metrics_of(session, df_fn, SPMD_FULL)
+    assert m["spmdStages"] == 1, m
+    assert_rows_equal(cpu, got, ignore_order=True, approx_float=1e-9)
+
+
+def test_plain_groupby_spmd(session):
+    """A bare groupBy().agg() (no sort tail, no fused chain wrapper) also
+    lowers — the output is m live-masked partitions, downloaded by the
+    ordinary sink."""
+    def df_fn(s):
+        df = s.createDataFrame(
+            {"k": [i % 7 for i in range(200)],
+             "v": [float(i) for i in range(200)],
+             "w": list(range(200))},
+            schema=[("k", "long"), ("v", "double"), ("w", "long")],
+            num_partitions=5)
+        return df.groupBy("k").agg(
+            F.sum("v").alias("sv"), F.avg("w").alias("aw"),
+            F.count("*").alias("c"), F.max("v").alias("mv"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, df_fn, ignore_order=True, approx_float=1e-9,
+        extra_conf=SPMD_1DEV)
+    assert session.last_query_metrics["spmdStages"] == 1
+
+
+def test_nullable_keys_and_values(session):
+    """NULL group keys form their own group; all-null value groups emit
+    NULL sums — the in-program key proxies and segment reductions must
+    keep SQL null semantics through the exchange."""
+    def df_fn(s):
+        ks = [None if i % 5 == 0 else f"k{i % 3}" for i in range(60)]
+        vs = [None if i % 4 == 0 else float(i) for i in range(60)]
+        df = s.createDataFrame(
+            {"k": ks, "v": vs},
+            schema=[("k", "string"), ("v", "double")], num_partitions=4)
+        return df.groupBy("k").agg(F.sum("v").alias("s"),
+                                   F.count("v").alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        session, df_fn, ignore_order=True, approx_float=1e-9,
+        extra_conf=SPMD_1DEV)
+    assert session.last_query_metrics["spmdStages"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The dispatch-count acceptance: one dispatch per stage, independent of
+# the partition count
+# ---------------------------------------------------------------------------
+def test_q1_dispatches_independent_of_partition_count(session):
+    disp = {}
+    host_loop_16 = None
+    for parts in (4, 16):
+        df_fn = _tpch_q("q1")
+        conf = dict(SPMD_1DEV)
+        conf["rapids.tpu.sql.shuffle.partitions"] = parts
+        _, m = _metrics_of(session, df_fn, conf)
+        assert m["spmdStages"] == 1, m
+        disp[parts] = m["deviceDispatches"]
+        if parts == 16:
+            conf_off = {"rapids.tpu.sql.shuffle.partitions": parts}
+            _, mh = _metrics_of(session, df_fn, conf_off)
+            host_loop_16 = mh["deviceDispatches"]
+    # the whole eligible pipeline is ONE program dispatch; only the
+    # constant sink-side compaction of the live-masked output adds to it
+    assert disp[4] == disp[16], disp
+    assert disp[16] <= 3
+    assert disp[16] * 3 <= host_loop_16, (disp, host_loop_16)
+
+
+def test_resource_prediction_contains_measured_in_both_modes(session):
+    for conf in (SPMD_1DEV, {}):
+        df_fn = _tpch_q("q1")
+        _, m = _metrics_of(session, df_fn, conf)
+        rep = session.last_resource_report
+        assert rep is not None
+        assert rep.dispatches.lo <= m["deviceDispatches"] \
+            <= rep.dispatches.hi, (conf, m, rep.dispatches)
+        if conf:
+            assert rep.spmd_stages == 1
+            assert rep.collective_bytes.lo <= m["collectiveBytes"] \
+                <= rep.collective_bytes.hi, (m, rep.collective_bytes)
+
+
+def test_explain_surfaces_spmd_stage(session):
+    tables = tpch.gen_tables(session, sf=0.0005, num_partitions=3)
+    df = tpch.QUERIES["q1"](tables)
+    session.conf.set("rapids.tpu.sql.spmd.enabled", True)
+    session.conf.set("rapids.tpu.sql.spmd.meshDevices", 1)
+    out = df.explain()
+    assert "TpuSpmdStage(1)[PartialAgg->AllToAll->FinalAgg->Sort]" in out
+    assert "spmd stages: 1 (collective bytes " in out
+    # the wrapped members stay visible for plan introspection
+    assert "TpuHashAggregateExec(partial)" in out
+    assert "== Plan verification ==\nOK" in out
+
+
+# ---------------------------------------------------------------------------
+# Degradation: ineligible shapes and runtime fallbacks stay oracle-equal
+# ---------------------------------------------------------------------------
+def test_ineligible_single_partition_agg_falls_back(session):
+    """q6's global aggregate exchanges through SinglePartitioning — not an
+    SPMD shape; with the flag on it must still run (host loop) and match."""
+    df_fn = _tpch_q("q6")
+    cpu = run_on_cpu(session, df_fn)
+    got, m = _metrics_of(session, df_fn, SPMD_FULL)
+    assert m["spmdStages"] == 0, m
+    assert_rows_equal(cpu, got, ignore_order=True, approx_float=1e-9)
+
+
+def test_bucket_overflow_degrades_to_host_loop(session):
+    """An undersized per-target bucket trips the in-program overflow probe
+    — the stage must degrade to the host-loop executor (never dropping a
+    row) and still match the oracle."""
+    def df_fn(s):
+        df = s.createDataFrame(
+            {"k": list(range(100)), "v": [float(i) for i in range(100)]},
+            schema=[("k", "long"), ("v", "double")], num_partitions=3)
+        return df.groupBy("k").agg(F.sum("v").alias("s"))
+
+    conf = dict(SPMD_1DEV)
+    conf["rapids.tpu.sql.spmd.bucketRows"] = 1  # bucket_cap floor = 8
+    cpu = run_on_cpu(session, df_fn)
+    got, m = _metrics_of(session, df_fn, conf)
+    assert m["spmdStages"] == 0, m  # the degraded stage must not count
+    assert_rows_equal(cpu, got, ignore_order=True, approx_float=1e-9)
+
+
+def test_spmd_disabled_is_default(session):
+    _, m = _metrics_of(session, _tpch_q("q1"), {})
+    assert m["spmdStages"] == 0
+    assert session.last_resource_report.spmd_stages == 0
+
+
+@pytest.mark.slow  # two stacked 8-device stage programs: compile-heavy
+def test_double_groupby_lowers_nested_stage(session):
+    """q13-style double aggregation: the inner pipeline becomes the outer
+    stage's device input (nested SPMD stages)."""
+    def df_fn(s):
+        df = s.createDataFrame(
+            {"k": [i % 17 for i in range(300)],
+             "v": [i % 4 for i in range(300)]},
+            schema=[("k", "long"), ("v", "long")], num_partitions=4)
+        inner = df.groupBy("k").agg(F.count("*").alias("c"))
+        return inner.groupBy("c").agg(F.count("*").alias("dist"))
+
+    cpu = run_on_cpu(session, df_fn)
+    got, m = _metrics_of(session, df_fn, SPMD_FULL)
+    assert m["spmdStages"] == 2, m
+    assert_rows_equal(cpu, got, ignore_order=True)
+
+
+def test_mesh_reset_on_session_stop():
+    """The collective meshes must not leak across sessions in one process
+    (the PR 3 device-manager singleton leak class)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.shuffle import ici
+
+    s = srt.new_session()
+    try:
+        ici.stage_mesh(1)
+        ici.stage_mesh(0)
+        assert ici._STAGE_MESHES
+    finally:
+        s.stop()
+    assert not ici._STAGE_MESHES
+    assert ici._MESH is None

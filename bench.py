@@ -1269,6 +1269,133 @@ def main_suite(suite: str, sf: float) -> None:
     _emit(out)
 
 
+_SERVING_ROWS = 1 << 14
+_SERVING_CLIENTS = int(os.environ.get("SRT_BENCH_SERVING_CLIENTS", "3"))
+_SERVING_SECS = float(os.environ.get("SRT_BENCH_SERVING_SECS", "6"))
+
+
+def _serving_mode(cache_on: bool, n_clients: int, secs: float) -> dict:
+    """One closed-loop serving run: n tenant clients each loop a
+    look-alike query mix against ONE shared runtime until the deadline.
+    Returns p50/p95 per-query latency + aggregate QPS."""
+    import threading
+
+    import numpy as np
+
+    from spark_rapids_tpu.engine.server import TpuServer
+    from spark_rapids_tpu.plan import functions as F
+    from spark_rapids_tpu.utils import metrics as M
+
+    server = TpuServer({
+        "rapids.tpu.serving.planCache.enabled": cache_on,
+    })
+    latencies: list = []
+    lat_lock = threading.Lock()
+    errors: list = []
+    hits0 = M.plan_cache_hit_count()
+    try:
+        rng = np.random.default_rng(42)
+        tenants = [f"client{i}" for i in range(n_clients)]
+        sessions = {t: server.connect(t) for t in tenants}
+        dfs = {}
+        for t in tenants:
+            data = {
+                "k": rng.integers(0, N_KEYS, _SERVING_ROWS).astype(np.int64),
+                "a": rng.integers(-10_000, 10_000,
+                                  _SERVING_ROWS).astype(np.int64),
+                "b": rng.random(_SERVING_ROWS).astype(np.float32),
+            }
+            dfs[t] = sessions[t].createDataFrame(
+                data, [("k", "long"), ("a", "long"), ("b", "float")],
+                num_partitions=2)
+
+        def mix(df):
+            yield (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+                     .withColumn("c", F.col("a") * 2 + 1)
+                     .groupBy("k")
+                     .agg(F.sum("c").alias("s"), F.count("*").alias("n")))
+            yield df.filter(F.col("a") > 0).withColumn(
+                "d", F.col("b") * 2.0)
+
+        # warmup: compile kernels (and, cache-on, seed the plan cache) so
+        # the loop measures steady-state serving latency, not first-compile
+        for t in tenants:
+            for q in mix(dfs[t]):
+                q.collect()
+        deadline = time.perf_counter() + secs
+
+        def client(t):
+            try:
+                while time.perf_counter() < deadline:
+                    for q in mix(dfs[t]):
+                        t0 = time.perf_counter()
+                        q.collect()
+                        dt = time.perf_counter() - t0
+                        with lat_lock:
+                            latencies.append(dt)
+            except BaseException as e:  # noqa: BLE001 - relayed
+                errors.append(repr(e))
+
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t_start
+    finally:
+        server.stop()
+    if errors:
+        return {"error": errors[:3]}
+    lat = sorted(latencies)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+    return {
+        "queries": len(lat),
+        "p50_s": round(pct(0.50), 5),
+        "p95_s": round(pct(0.95), 5),
+        "qps": round(len(lat) / wall, 2) if wall > 0 else 0.0,
+        "plan_cache_hits": M.plan_cache_hit_count() - hits0,
+    }
+
+
+def main_serving() -> None:
+    """Serving suite (`python bench.py --serving`): closed-loop clients
+    over the multi-tenant runtime, plan cache OFF vs ON (docs/serving.md).
+    Runs in-process on whatever backend is available — the measured work
+    is the host-side serving path, which is exactly what the plan cache
+    removes. Writes BENCH_r09.json."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    _log("serving: cache-off run")
+    off = _serving_mode(False, _SERVING_CLIENTS, _SERVING_SECS)
+    _log("serving: cache-on run")
+    on = _serving_mode(True, _SERVING_CLIENTS, _SERVING_SECS)
+    result = {
+        "metric": "serving_p95_latency_s",
+        "value": on.get("p95_s", 0.0),
+        "unit": "s",
+        # headline: repeat-query latency win of the zero-planning path
+        "vs_baseline": (round(off["p95_s"] / on["p95_s"], 3)
+                        if on.get("p95_s") and off.get("p95_s") else 0.0),
+        "platform": platform,
+        "clients": _SERVING_CLIENTS,
+        "secs_per_mode": _SERVING_SECS,
+        "cache_off": off,
+        "cache_on": on,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r09.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh)
+        fh.write("\n")
+    _emit(result)
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         mode = sys.argv[2]
@@ -1295,5 +1422,7 @@ if __name__ == "__main__":
         main_i64()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--shuffle":
         main_shuffle()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--serving":
+        main_serving()
     else:
         main()

@@ -791,6 +791,66 @@ RESOURCE_HBM_BUDGET = _conf(
     "physical device."
 ).bytes(0)
 
+# ---------------------------------------------------------------------------
+# Multi-tenant serving runtime (engine/server.py, plan/plan_cache.py,
+# engine/admission.py, docs/serving.md)
+# ---------------------------------------------------------------------------
+PLAN_CACHE_ENABLED = _conf("rapids.tpu.serving.planCache.enabled").doc(
+    "Cache fully planned, verified, and analyzed physical plans keyed by "
+    "a canonical plan signature (logical plan structure with normalized "
+    "expression ids + leaf data identity + every explicitly-set conf "
+    "key). A steady-state repeat query skips planning, verification, AND "
+    "resource analysis, and — because the cached plan carries the "
+    "original expression objects — its kernels hit the jit cache with "
+    "zero retracing (metrics: planCacheHits / planCacheMisses). The "
+    "cache is shared by every live session and cleared when the last "
+    "session stops."
+).boolean(True)
+
+PLAN_CACHE_MAX_ENTRIES = _conf(
+    "rapids.tpu.serving.planCache.maxEntries").doc(
+    "LRU bound on cached physical plans. Entries pin their leaf data "
+    "(host batches of in-memory relations) alive, so the bound also "
+    "bounds that residency."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(256)
+
+ADMISSION_ENABLED = _conf("rapids.tpu.serving.admission.enabled").doc(
+    "Analyzer-driven query admission (docs/serving.md): instead of "
+    "first-come-first-served semaphore entry alone, each query declares "
+    "the resource analyzer's predicted peak-HBM bytes before executing; "
+    "a query only starts when aggregate admitted bytes + its own fit "
+    "under the HBM budget — heavy plans queue, light plans interleave "
+    "past them (bounded by admission.maxBypass). Queries without a "
+    "resource report (analysis disabled or the estimator failed) admit "
+    "immediately; the task-level TpuSemaphore remains the inner gate."
+).boolean(True)
+
+ADMISSION_MAX_BYPASS = _conf("rapids.tpu.serving.admission.maxBypass").doc(
+    "How many younger queries may be admitted past a waiting (heavy) "
+    "query before it becomes the blocking head of the queue and no "
+    "later arrival may admit until it does — bounds starvation under a "
+    "steady stream of light queries."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(8)
+
+MICRO_BATCH_WINDOW_MS = _conf(
+    "rapids.tpu.serving.microBatch.windowMs").doc(
+    "Cross-query micro-batching window in milliseconds (0 = off). "
+    "Eligible queries (per-partition-independent Filter/Project "
+    "pipelines over one in-memory relation) that share a plan SHAPE "
+    "signature and arrive within the window are packed into ONE query "
+    "— each constituent's partitions ride as partitions of a shared "
+    "padded device program — and de-multiplexed at the sink by "
+    "partition range (metrics: microBatches / microBatchedQueries). "
+    "Requires submitting through a session wired to a TpuServer's "
+    "micro-batcher (engine/server.py)."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(0.0)
+
+MICRO_BATCH_MAX_QUERIES = _conf(
+    "rapids.tpu.serving.microBatch.maxQueries").doc(
+    "Largest number of queries packed into one micro-batch window; a "
+    "window closes early once this many have joined."
+).check(lambda v: None if v >= 2 else "must be >= 2").integer(8)
+
 
 class TpuConf:
     """Resolved view of the settings map (reference: RapidsConf class).

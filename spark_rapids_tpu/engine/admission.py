@@ -1,0 +1,173 @@
+"""Analyzer-driven HBM admission control (docs/serving.md).
+
+First-come-first-served semaphore entry admits a heavy query the moment a
+permit frees, even when its predicted working set cannot fit beside what is
+already running. This controller is the QUERY-level gate in front of the
+task-level TpuSemaphore: each query declares the resource analyzer's
+predicted peak-HBM bytes (plan/resources.py, cached with the plan by the
+plan cache) and only starts when aggregate admitted bytes + its own stay
+under the device budget — heavy plans queue, light plans interleave past
+them. The aggregate-under-budget invariant holds by construction and is
+pinned by tests/test_serving.py.
+
+Fairness: pure fit-based admission would starve a heavy query behind a
+steady stream of light ones. Each waiter counts how many younger arrivals
+were admitted past it; at `max_bypass` it becomes the BLOCKING HEAD — no
+younger waiter may admit until it runs (rapids.tpu.serving.admission.*).
+
+Queries with no resource report (analysis disabled, estimator error)
+bypass the controller entirely — the semaphore and the spill watermark
+remain the runtime backstops, exactly as before this layer existed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.utils import metrics as M
+
+_INF = float("inf")
+
+
+class AdmissionTicket:
+    __slots__ = ("cost", "tenant", "released")
+
+    def __init__(self, cost: int, tenant: str):
+        self.cost = cost
+        self.tenant = tenant
+        self.released = False
+
+
+class _Waiter:
+    __slots__ = ("seq", "cost", "bypassed")
+
+    def __init__(self, seq: int, cost: int):
+        self.seq = seq
+        self.cost = cost
+        self.bypassed = 0
+
+
+class AdmissionController:
+    """Shared per-process (one device, one HBM budget); refcounted with
+    the session runtime — torn down when the last session stops."""
+
+    _instance: Optional["AdmissionController"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, budget_bytes: int, max_bypass: int = 8):
+        self.budget = max(1, int(budget_bytes))
+        self.max_bypass = max(0, int(max_bypass))
+        self._cv = threading.Condition()
+        self._admitted = 0
+        self._peak_admitted = 0
+        self._waits = 0
+        self._waiters: list = []
+        self._seq = itertools.count()
+
+    # -- lifecycle (session.py runtime refcounting drives this) -------------
+    @classmethod
+    def initialize(cls, budget_bytes: int,
+                   max_bypass: int = 8) -> "AdmissionController":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(budget_bytes, max_bypass)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> Optional["AdmissionController"]:
+        return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    # -- admission -----------------------------------------------------------
+    def _clamp_cost(self, predicted_bytes) -> int:
+        """A query predicted beyond the budget (or unbounded) costs the
+        WHOLE budget: it admits alone, serialized against everything."""
+        if predicted_bytes is None or predicted_bytes == _INF:
+            return self.budget
+        return max(1, min(int(predicted_bytes), self.budget))
+
+    def admit(self, predicted_bytes,
+              tenant: str = "default") -> AdmissionTicket:
+        """Block until `predicted_bytes` fits under the budget alongside
+        everything already admitted (and no blocked-head waiter is owed
+        the next slot). Returns a ticket the caller MUST release."""
+        cost = self._clamp_cost(predicted_bytes)
+        with self._cv:
+            if self._fits(cost, me=None):
+                self._note_bypass(me=None)
+                self._do_admit(cost)
+                return AdmissionTicket(cost, tenant)
+            me = _Waiter(next(self._seq), cost)
+            self._waiters.append(me)
+            self._waits += 1
+            M.record_admission_wait()
+            try:
+                while not self._fits(cost, me):
+                    # timed wait: robust against a missed notify under
+                    # exceptional interleavings (releases always notify,
+                    # but a 100ms re-check costs nothing on this path)
+                    self._cv.wait(timeout=0.1)
+                self._note_bypass(me)
+                self._do_admit(cost)
+            finally:
+                self._waiters.remove(me)
+                self._cv.notify_all()
+        return AdmissionTicket(cost, tenant)
+
+    def _fits(self, cost: int, me: Optional[_Waiter]) -> bool:
+        if self._admitted + cost > self.budget:
+            return False
+        # a blocked-head waiter (bypassed >= max_bypass) owns the next
+        # admission: everyone younger — including a fresh arrival (me is
+        # None: younger than every waiter) — yields to it
+        for w in self._waiters:
+            if w is me:
+                continue
+            if w.bypassed >= self.max_bypass and \
+                    (me is None or w.seq < me.seq):
+                return False
+        return True
+
+    def _note_bypass(self, me: Optional[_Waiter]) -> None:
+        """Being admitted bypasses every OLDER waiter still queued."""
+        for w in self._waiters:
+            if w is not me and (me is None or w.seq < me.seq):
+                w.bypassed += 1
+
+    def _do_admit(self, cost: int) -> None:
+        self._admitted += cost
+        if self._admitted > self._peak_admitted:
+            self._peak_admitted = self._admitted
+
+    def release(self, ticket: AdmissionTicket) -> None:
+        with self._cv:
+            if ticket.released:
+                return
+            ticket.released = True
+            self._admitted -= ticket.cost
+            self._cv.notify_all()
+
+    # -- introspection (tests, server metrics) -------------------------------
+    def admitted_bytes(self) -> int:
+        with self._cv:
+            return self._admitted
+
+    def peak_admitted_bytes(self) -> int:
+        with self._cv:
+            return self._peak_admitted
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "budget": self.budget,
+                "admitted": self._admitted,
+                "peak_admitted": self._peak_admitted,
+                "waiting": len(self._waiters),
+                "waits": self._waits,
+            }

@@ -93,9 +93,12 @@ def checked_mode():
     replay path wraps re-planning AND re-execution in one scope."""
     global _CHECKED_DEPTH
     with _LOCK:
+        # tpulint: shared-state-mutation -- under _LOCK; a depth counter
+        # shared by design (any live replay forces checked semantics)
         _CHECKED_DEPTH += 1
     try:
         yield
     finally:
         with _LOCK:
+            # tpulint: shared-state-mutation -- under _LOCK (see above)
             _CHECKED_DEPTH -= 1

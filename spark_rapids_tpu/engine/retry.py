@@ -395,15 +395,24 @@ def _run_cpu_fallback(cpu_fn: Callable, batch, row_offset: int):
 
 
 # ---------------------------------------------------------------------------
-# Circuit breaker (per-session: session.stop() resets it)
+# Circuit breaker (per-tenant: each session's tenant has its own failure
+# count, carried to worker threads via the ambient QueryContext; a
+# tenant's session.stop() resets only that tenant's breaker)
 # ---------------------------------------------------------------------------
 class CircuitBreaker:
     """Counts device failures (retry exhaustions, not individual retries);
     once `threshold` is reached the breaker opens and stays open for the
     session — remaining batches bypass the device and remaining queries
-    plan on the CPU engine (rapids.tpu.execution.circuitBreaker.*)."""
+    plan on the CPU engine (rapids.tpu.execution.circuitBreaker.*).
+
+    Multi-tenant serving (docs/serving.md): breakers are registered per
+    tenant name, and `get()` prefers the ambient QueryContext's breaker —
+    so a dispatch site deep in the engine charges the failure to the
+    tenant whose query it is running, and one tenant's fault storm can
+    never open another tenant's breaker."""
 
     _instance: Optional["CircuitBreaker"] = None
+    _tenants: dict = {}
     _lock = threading.Lock()
 
     def __init__(self, enabled: bool = True, threshold: int = 4):
@@ -413,13 +422,22 @@ class CircuitBreaker:
         self._cv = threading.Lock()
 
     @classmethod
-    def configure(cls, tpu_conf: "C.TpuConf") -> "CircuitBreaker":
+    def configure(cls, tpu_conf: "C.TpuConf",
+                  tenant: Optional[str] = None) -> "CircuitBreaker":
         """Refresh policy knobs from the session conf; the failure count
-        survives (the breaker is per-session, not per-query)."""
+        survives (the breaker is per-session, not per-query). With a
+        tenant name, the tenant's own breaker is configured and returned;
+        without one, the process-default breaker (single-session flows and
+        direct callers) keeps its historical behavior."""
         with cls._lock:
-            if cls._instance is None:
-                cls._instance = cls()
-            inst = cls._instance
+            if tenant is None or tenant == "default":
+                if cls._instance is None:
+                    cls._instance = cls()
+                inst = cls._instance
+            else:
+                inst = cls._tenants.get(tenant)
+                if inst is None:
+                    inst = cls._tenants[tenant] = cls()
         with inst._cv:
             inst.enabled = tpu_conf.get(C.CIRCUIT_BREAKER_ENABLED)
             inst.threshold = max(
@@ -428,15 +446,26 @@ class CircuitBreaker:
 
     @classmethod
     def get(cls) -> "CircuitBreaker":
+        ctx = M.current_query_ctx()
+        if ctx is not None and ctx.breaker is not None:
+            return ctx.breaker
         with cls._lock:
             if cls._instance is None:
                 cls._instance = cls()
             return cls._instance
 
     @classmethod
-    def reset(cls) -> None:
+    def reset(cls, tenant: Optional[str] = None) -> None:
+        """Reset one tenant's breaker, or (no tenant) every breaker — the
+        full process reset the chaos suite and session teardown use."""
         with cls._lock:
-            cls._instance = None
+            if tenant is None:
+                cls._instance = None
+                cls._tenants.clear()
+            elif tenant == "default":
+                cls._instance = None
+            else:
+                cls._tenants.pop(tenant, None)
 
     def record_failure(self) -> bool:
         """Count one device failure; returns True when the breaker is now

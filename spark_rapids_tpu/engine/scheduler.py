@@ -27,12 +27,14 @@ Hardening (docs/fault-tolerance.md):
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
 import threading
 from typing import Callable, Iterator, List, Optional, TypeVar
 
 from spark_rapids_tpu.engine import retry as R
 from spark_rapids_tpu.exec.transitions import current_task_id, set_task_id
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.utils import metrics as M
 
 T = TypeVar("T")
 
@@ -94,11 +96,26 @@ class TaskScheduler:
         self.begin_query()
 
     def begin_query(self) -> None:
+        """Reset the retry budget for a fresh query run (also called before
+        a checked replay / CPU fallback run so the degraded run does not
+        inherit a drained budget). Resets the ambient QueryContext's
+        per-query budget when one is installed, else the scheduler-level
+        fallback counter."""
+        qctx = M.current_query_ctx()
+        if qctx is not None:
+            qctx.begin_retry_budget(qctx.retry_budget)
         with self._budget_lock:
             self._retries_spent = 0
 
     def _try_spend_retry(self) -> bool:
-        """Reserve one retry from the query budget; False = exhausted."""
+        """Reserve one retry from the query budget; False = exhausted.
+        With an ambient QueryContext (the serving runtime) the budget is
+        PER QUERY on the context — concurrent tenants cannot drain each
+        other's; the scheduler-level counter remains the fallback for
+        standalone schedulers with no session in scope."""
+        qctx = M.current_query_ctx()
+        if qctx is not None:
+            return qctx.try_spend_retry()
         with self._budget_lock:
             if self.retry_budget and self._retries_spent >= self.retry_budget:
                 return False
@@ -179,10 +196,19 @@ class TaskScheduler:
         if num_partitions == 1:
             return [self._run_task(0, fn)]
         pool = self._ensure_pool()
-        futures = [pool.submit(self._run_task, p, fn)
+        futures = [self._submit(pool, p, fn)
                    for p in range(num_partitions)]
         return [self._result_with_timeout(f, p, futures)
                 for p, f in enumerate(futures)]
+
+    def _submit(self, pool: "cf.ThreadPoolExecutor", p: int,
+                fn: Callable[[int], T]) -> "cf.Future":
+        """Submit one partition task, carrying the submitting thread's
+        contextvars (the ambient QueryContext above all — per-tenant
+        metrics, breaker, fault injector, and retry budget must follow
+        the query onto the shared worker pool, docs/serving.md)."""
+        cctx = contextvars.copy_context()
+        return pool.submit(cctx.run, self._run_task, p, fn)
 
     def run_job_iter(self, num_partitions: int,
                      fn: Callable[[int], T]) -> Iterator[T]:
@@ -196,7 +222,7 @@ class TaskScheduler:
             yield self._run_task(0, fn)
             return
         pool = self._ensure_pool()
-        futures = [pool.submit(self._run_task, p, fn)
+        futures = [self._submit(pool, p, fn)
                    for p in range(num_partitions)]
         for f in cf.as_completed(futures):
             yield f.result()

@@ -1,0 +1,298 @@
+"""Multi-tenant serving front-end + cross-query micro-batching
+(docs/serving.md).
+
+TpuServer is the long-lived entry point a service embeds: it hands out one
+TpuSession per tenant, all sharing ONE runtime (device manager, admission
+semaphore + controller, spill framework, ICI mesh, jit cache, plan cache —
+refcounted in spark_rapids_tpu/session.py), while per-tenant state (circuit
+breaker, fault injection, metrics, retry budget) rides each query's
+QueryContext. The grounding is interactive concurrent OLAP serving
+("Accelerating Presto with GPUs", PAPERS.md): steady-state latency is
+dominated by the work AROUND the kernels, so the serving layer's job is to
+make that work shared, cached, and admission-controlled.
+
+Micro-batching: many small look-alike queries (same plan SHAPE signature —
+plan/signature.py — over different data) arriving within a window pack into
+ONE query: each constituent's partitions become partitions of a shared
+template plan, the engine runs it once (one planning pass, one admission,
+and — because the template's expression objects are stable — compiled
+kernels straight from the jit cache), and the sink de-multiplexes results
+by partition range. Eligibility is deliberately conservative: only
+per-partition-independent Filter/Project pipelines over one in-memory
+relation, where partition boundaries ARE query boundaries, so packing
+cannot mix rows across tenants.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.utils import metrics as M
+
+
+# ---------------------------------------------------------------------------
+# Micro-batch eligibility + template plumbing
+# ---------------------------------------------------------------------------
+def micro_batch_eligible(plan: "L.LogicalPlan") -> bool:
+    """Only plans whose partitions are fully independent may pack: a
+    Filter/Project chain over exactly one LocalRelation. Anything with an
+    exchange, aggregate, join, sort, or limit computes ACROSS partitions
+    and would mix constituent queries' rows."""
+    node = plan
+    while isinstance(node, (L.Project, L.Filter)):
+        node = node.children[0]
+    return isinstance(node, L.LocalRelation)
+
+
+def _leaf_of(plan: "L.LogicalPlan") -> "L.LocalRelation":
+    node = plan
+    while isinstance(node, (L.Project, L.Filter)):
+        node = node.children[0]
+    assert isinstance(node, L.LocalRelation)
+    return node
+
+
+def _clone_chain(plan: "L.LogicalPlan",
+                 new_leaf: "L.LocalRelation") -> "L.LogicalPlan":
+    """Rebuild the Filter/Project chain over a fresh leaf. Expressions are
+    SHARED with the first member's plan (they are immutable and bound to
+    the leaf's attribute objects, which the new leaf also shares) — that
+    sharing is what makes every later window's kernels hit the jit cache
+    with zero retracing."""
+    if isinstance(plan, L.LocalRelation):
+        return new_leaf
+    if isinstance(plan, L.Project):
+        return L.Project(plan.project_list,
+                         _clone_chain(plan.children[0], new_leaf))
+    assert isinstance(plan, L.Filter)
+    return L.Filter(plan.condition,
+                    _clone_chain(plan.children[0], new_leaf))
+
+
+class _Template:
+    """One shape signature's reusable packed plan: a detached logical
+    chain whose leaf partition list is REFILLED per window (the physical
+    plan cached for it reads the same list object, so window 2+ reuses
+    the cached plan outright). `lock` serializes windows sharing the
+    template — its leaf is mutable state."""
+
+    __slots__ = ("plan", "leaf", "lock")
+
+    def __init__(self, member_plan: "L.LogicalPlan"):
+        src_leaf = _leaf_of(member_plan)
+        # the leaf SHARES the member's attribute objects (binding) but
+        # owns its partitions list — packing must never mutate a caller's
+        # DataFrame
+        self.leaf = L.LocalRelation(src_leaf.schema, [])
+        self.plan = _clone_chain(member_plan, self.leaf)
+        self.lock = threading.Lock()
+
+
+class _Pending:
+    """One constituent query's slot in a window."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class _Window:
+    __slots__ = ("key", "plan0", "members", "closed", "full")
+
+    def __init__(self, key: str, plan0: "L.LogicalPlan"):
+        self.key = key
+        self.plan0 = plan0
+        self.members: List[tuple] = []  # (partitions, _Pending)
+        self.closed = False
+        self.full = threading.Event()
+
+
+class MicroBatcher:
+    """Packs same-shape queries arriving within a window into one query.
+
+    Protocol: the FIRST arrival for a shape key opens the window and
+    becomes its leader; it waits `window_s` (or until maxQueries join),
+    closes the window, executes the packed plan through its own session,
+    and distributes per-member results. Joiners just wait on their slot.
+    """
+
+    _MAX_TEMPLATES = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._open: Dict[str, _Window] = {}
+        self._templates: Dict[str, _Template] = {}
+
+    def submit(self, session, plan: "L.LogicalPlan", shape_key: str,
+               window_s: float) -> List[List]:
+        """Run `plan` through a packed window; returns the caller's own
+        per-partition host-batch lists (same contract as
+        session.execute_partitions)."""
+        max_q = max(2, session.conf.get(C.MICRO_BATCH_MAX_QUERIES))
+        parts = list(_leaf_of(plan).partitions)
+        pend = _Pending()
+        with self._lock:
+            w = self._open.get(shape_key)
+            if w is not None and not w.closed and len(w.members) < max_q:
+                leader = False
+            else:
+                w = _Window(shape_key, plan)
+                self._open[shape_key] = w
+                leader = True
+            w.members.append((parts, pend))
+            if len(w.members) >= max_q:
+                w.full.set()
+        if leader:
+            try:
+                w.full.wait(timeout=max(0.0, window_s))
+            finally:
+                # the window MUST close whatever happens to the leader —
+                # an open window would keep absorbing members nobody will
+                # ever execute
+                with self._lock:
+                    w.closed = True
+                    if self._open.get(shape_key) is w:
+                        del self._open[shape_key]
+            try:
+                self._execute_window(session, w)
+            except BaseException as e:  # noqa: BLE001 - leader must fan out
+                # belt-and-braces: _execute_window fans failures itself,
+                # but a leader dying anywhere must never strand joiners
+                # in pend.event.wait()
+                self._fan_error(w, e)
+                raise
+        pend.event.wait()
+        if pend.error is not None:
+            raise pend.error
+        return pend.result
+
+    def _execute_window(self, session, w: _Window) -> None:
+        try:
+            tmpl = self._template_for(w.key, w.plan0)
+            with tmpl.lock:
+                packed: List = []
+                spans = []
+                for parts, _ in w.members:
+                    spans.append((len(packed), len(packed) + len(parts)))
+                    packed.extend(parts)
+                # in-place refill: the template (and its stable expression
+                # objects) is what keeps every window's kernels hitting
+                # the jit cache
+                tmpl.leaf.partitions[:] = packed
+                M.record_micro_batch()
+                try:
+                    # use_plan_cache=False: each window carries DIFFERENT
+                    # data through the same leaf object, so a cached plan
+                    # would replay window 1's resource report — admission
+                    # and the semaphore weight must see THIS window's
+                    # rows. Planning a Filter/Project chain is cheap and
+                    # amortized over every member; the expensive part
+                    # (kernel tracing) still hits the jit cache.
+                    results = session.execute_partitions(
+                        tmpl.plan, allow_micro_batch=False,
+                        use_plan_cache=False)
+                finally:
+                    # drop data refs so the template never retains a
+                    # window's batches
+                    tmpl.leaf.partitions[:] = []
+            for (parts, pend), (lo, hi) in zip(w.members, spans):
+                pend.result = results[lo:hi]
+                pend.event.set()
+        except BaseException as e:  # noqa: BLE001 - fan the failure out
+            self._fan_error(w, e)
+            if not isinstance(e, Exception):
+                raise
+
+    @staticmethod
+    def _fan_error(w: _Window, e: BaseException) -> None:
+        """Deliver a window failure to every member still waiting
+        (idempotent: already-delivered slots are left alone)."""
+        for _, pend in w.members:
+            if not pend.event.is_set():
+                pend.error = e
+                pend.event.set()
+
+    def _template_for(self, key: str, plan0: "L.LogicalPlan") -> _Template:
+        with self._lock:
+            tmpl = self._templates.get(key)
+            if tmpl is None:
+                if len(self._templates) >= self._MAX_TEMPLATES:
+                    # simple bound: drop the oldest inserted template
+                    self._templates.pop(next(iter(self._templates)))
+                tmpl = self._templates[key] = _Template(plan0)
+            return tmpl
+
+
+# ---------------------------------------------------------------------------
+# The server front-end
+# ---------------------------------------------------------------------------
+class TpuServer:
+    """Per-tenant session handles over one shared runtime.
+
+    >>> server = TpuServer({"rapids.tpu.serving.microBatch.windowMs": 5})
+    >>> s = server.connect("tenant-a")
+    >>> s.createDataFrame(...).filter(...).collect()
+    >>> server.stop()
+    """
+
+    def __init__(self, settings: Optional[dict] = None):
+        self._settings = dict(settings or {})
+        self._sessions: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self.micro_batcher = MicroBatcher()
+
+    def connect(self, tenant: str = "default",
+                settings: Optional[dict] = None):
+        """The tenant's session (created on first use; later connects for
+        the same tenant return the live session). Tenant sessions share
+        the refcounted runtime and the server's micro-batcher."""
+        from spark_rapids_tpu.session import TpuSession
+
+        with self._lock:
+            s = self._sessions.get(tenant)
+            if s is None:
+                merged = dict(self._settings)
+                merged.update(settings or {})
+                s = TpuSession(merged, tenant=tenant)
+                s.micro_batcher = self.micro_batcher
+                self._sessions[tenant] = s
+            return s
+
+    def sessions(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._sessions)
+
+    def stop(self) -> None:
+        """Stop every tenant session; the last one tears the shared
+        runtime down (session.py shared-runtime lifetime). Only the final
+        stop may run the leaked-session GC sweep — a batch shutdown needs
+        at most one, not one per tenant."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for i, s in enumerate(sessions):
+            s.stop(_sweep_leaked=(i == len(sessions) - 1))
+
+    def metrics(self) -> dict:
+        """Aggregate serving metrics: plan/jit cache stats, admission
+        snapshot, and the process-wide serving counters."""
+        from spark_rapids_tpu.engine import jit_cache
+        from spark_rapids_tpu.engine.admission import AdmissionController
+        from spark_rapids_tpu.plan import plan_cache
+
+        ctl = AdmissionController.get()
+        return {
+            "planCache": {**plan_cache.stats(),
+                          "hits": M.plan_cache_hit_count(),
+                          "misses": M.plan_cache_miss_count()},
+            "jitCache": jit_cache.stats(),
+            "admission": ctl.snapshot() if ctl is not None else None,
+            M.MICRO_BATCHES: M.micro_batch_count(),
+            M.MICRO_BATCHED_QUERIES: M.micro_batched_query_count(),
+        }

@@ -88,7 +88,10 @@ def cached_device_partition_rows(logical_node):
 
 def invalidate(logical_node) -> None:
     with _LOCK:
+        # tpulint: shared-state-mutation -- under _LOCK; invalidate is
+        # the cache's teardown path
         dropped = _DEVICE_CACHE.pop(logical_node, None)
+        # tpulint: shared-state-mutation -- under _LOCK (same teardown)
         _HOST_CACHE.pop(logical_node, None)
     if dropped:
         _free_buffers([b for part in dropped for b in part])
@@ -183,6 +186,8 @@ class TpuCachedScanExec(_CachedScanBase, TpuExec):
 
             parts = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
             with _LOCK:
+                # tpulint: shared-state-mutation -- under _LOCK; setdefault
+                # keeps the first materialization on a concurrent race
                 cached = _DEVICE_CACHE.setdefault(self.logical_node, parts)
                 if cached is parts:
                     # free the buffers when the logical node (cache key) dies

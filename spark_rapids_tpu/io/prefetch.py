@@ -25,6 +25,7 @@ Contract:
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
 from typing import Iterator, TypeVar
@@ -77,9 +78,13 @@ class PrefetchIterator:
         # the resource analyzer charges scan leaves
         self._queue: "queue.Queue" = queue.Queue(self._depth)
         self._closed = threading.Event()
+        # the reader decodes on behalf of the constructing task's QUERY:
+        # carry its contextvars (per-tenant QueryContext — metrics, fault
+        # injector — docs/serving.md) onto the worker thread
+        cctx = contextvars.copy_context()
         self._thread = threading.Thread(
-            target=_prefetch_worker, args=(source, self._queue,
-                                           self._closed),
+            target=cctx.run,
+            args=(_prefetch_worker, source, self._queue, self._closed),
             name=name, daemon=True)
         self._thread.start()
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-from spark_rapids_tpu.utils.metrics import trace_range
+from spark_rapids_tpu.utils.metrics import current_query_ctx, trace_range
 
 
 class TpuSemaphore:
@@ -95,9 +95,16 @@ class TpuSemaphore:
         st = self._state(task_id)
         with st.lock:
             if st.count == 0:
+                # the executing query's analyzer weight rides on the
+                # ambient QueryContext (propagated onto worker threads),
+                # so concurrent tenants' weights cannot cross-talk; the
+                # process-level weight is the no-context fallback
+                qctx = current_query_ctx()
                 with trace_range("Acquire TPU Semaphore"):
                     with self._cv:
-                        want = self._weight
+                        want = qctx.sem_weight if qctx is not None \
+                            else self._weight
+                        want = max(1, min(int(want), self.max_concurrent))
                         while self._available < want:
                             self._cv.wait()
                         self._available -= want

@@ -1,0 +1,75 @@
+"""Process-wide plan-signature -> compiled-plan cache (docs/serving.md).
+
+The serving hot path: a steady-state repeat query (same logical structure,
+same data, same conf — plan/signature.py) reuses a fully planned, VERIFIED,
+and resource-ANALYZED physical plan, skipping the whole plan pipeline. And
+because the cached plan carries the ORIGINAL expression objects, every
+kernel fingerprint matches the first run's — the jit cache returns compiled
+programs with zero retracing. planCacheHits/planCacheMisses prove the
+zero-planning-cost claim (tests/test_serving.py pins it).
+
+Shared by every live session (one cache per process, like the jit cache);
+cleared when the last session stops (spark_rapids_tpu/session.py teardown)
+— entries hold resource reports sized against the device manager's budget,
+which dies with the runtime.
+
+Entries pin their inputs alive on purpose: CachedPlan.logical keeps the
+source logical plan (and thereby the id()s baked into its cache key) from
+being recycled while the entry lives — see plan/signature.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, List, Optional
+
+_LOCK = threading.Lock()
+_CACHE: "collections.OrderedDict[str, CachedPlan]" = collections.OrderedDict()
+
+
+class CachedPlan:
+    """One fully-built query plan: the final physical plan, the resource
+    analyzer's report (None while analysis is disabled — the conf is part
+    of the key, so hit and build always agree), the combined
+    verifier+analyzer violation record, and the source logical plan."""
+
+    __slots__ = ("physical", "report", "violations", "logical")
+
+    def __init__(self, physical: Any, report: Any,
+                 violations: List, logical: Any):
+        self.physical = physical
+        self.report = report
+        self.violations = list(violations)
+        self.logical = logical
+
+
+def lookup(key: str) -> Optional[CachedPlan]:
+    with _LOCK:
+        got = _CACHE.get(key)
+        if got is not None:
+            _CACHE.move_to_end(key)
+        return got
+
+
+def insert(key: str, entry: CachedPlan,
+           max_entries: int = 256) -> CachedPlan:
+    """Insert keeping the FIRST entry on a race (two queries planning the
+    same signature concurrently): the winner's physical plan is the one
+    in flight, so later hits share the same exec/expression objects."""
+    with _LOCK:
+        got = _CACHE.setdefault(key, entry)
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > max(1, int(max_entries)):
+            _CACHE.popitem(last=False)
+        return got
+
+
+def clear() -> None:
+    with _LOCK:
+        _CACHE.clear()
+
+
+def stats() -> dict:
+    with _LOCK:
+        return {"entries": len(_CACHE)}

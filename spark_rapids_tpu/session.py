@@ -35,6 +35,22 @@ from spark_rapids_tpu.plan.transition_overrides import TpuTransitionOverrides
 
 log = logging.getLogger(__name__)
 
+# ---------------------------------------------------------------------------
+# Shared-runtime lifetime (docs/serving.md): N concurrent sessions share
+# ONE device manager, admission semaphore, spill framework, admission
+# controller, ICI mesh, jit cache, and plan cache. The shared pieces tear
+# down only when the LAST live session stops — before this, a second
+# session's stop() yanked the mesh and device manager out from under any
+# session still running. Liveness is a WeakSet, not a refcount: a session
+# that was never stopped and is no longer referenced (a test fixture
+# without a finalizer) must not block teardown forever — once collected
+# it simply stops counting.
+# ---------------------------------------------------------------------------
+import weakref
+
+_RUNTIME_LOCK = threading.Lock()
+_LIVE_SESSIONS: "weakref.WeakSet" = weakref.WeakSet()
+
 
 class PlanCapture:
     """Test hook capturing the final physical plan of each execution
@@ -65,11 +81,18 @@ class TpuSession:
     _active: Optional["TpuSession"] = None
     _lock = threading.Lock()
 
-    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+    def __init__(self, settings: Optional[Dict[str, Any]] = None,
+                 tenant: str = "default"):
         self.conf = C.TpuConf(settings)
+        # tenant name for the serving runtime (docs/serving.md): keys the
+        # per-tenant circuit breaker, metric attribution, and admission
+        # accounting. Single-session flows keep the "default" tenant.
+        self.tenant = tenant
         self.plan_capture = PlanCapture()
         # fusion accounting of the most recent execute_batches (fusedStages,
-        # deviceDispatches) — read by bench.py and the fusion tests
+        # deviceDispatches) — read by bench.py and the fusion tests. Under
+        # concurrent queries this is last-completed-query-wins; per-query
+        # numbers ride the QueryContext (utils/metrics.py)
         self.last_query_metrics: Dict[str, int] = {}
         # static-analysis findings of the most recent plan build: the plan
         # verifier's and the resource analyzer's violations share this one
@@ -78,6 +101,14 @@ class TpuSession:
         # the resource analyzer's full report for the most recent plan
         # build (None while resourceAnalysis is disabled)
         self.last_resource_report = None
+        # wired by TpuServer.connect: queries eligible for cross-query
+        # micro-batching route through the server's shared batcher
+        self.micro_batcher = None
+        self._stopped = False
+        # planning mutates/reads session conf (the CPU-fallback run swaps
+        # sql.enabled); an RLock keeps a concurrent query's signature and
+        # plan build consistent with each other
+        self._plan_lock = threading.RLock()
         # multi-host bring-up FIRST — the coordination service must join
         # before any backend touch (reference: driver ships conf and
         # executors announce themselves before GPU init, Plugin.scala:
@@ -85,17 +116,29 @@ class TpuSession:
         from spark_rapids_tpu.parallel import distributed as _dist
 
         _dist.init_distributed()
-        # executor bring-up (reference: RapidsExecutorPlugin.init)
-        self.device_manager = TpuDeviceManager.initialize(self.conf)
-        # spill store chain + watermark (reference: GpuShuffleEnv.initStorage,
-        # GpuShuffleEnv.scala:57-79). Budget honors this session's conf even
-        # though the device manager is a process singleton.
-        hbm_total = self.conf.get(C.HBM_SIZE_OVERRIDE) or \
-            self.device_manager.hbm_total
-        budget = int(hbm_total * self.conf.get(C.MEMORY_FRACTION))
-        self.spill = SpillFramework.initialize(
-            self.conf, budget, self.device_manager.bytes_in_use)
-        TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
+        from spark_rapids_tpu.engine.admission import AdmissionController
+
+        with _RUNTIME_LOCK:
+            shared_live = len(_LIVE_SESSIONS) > 0
+            # executor bring-up (reference: RapidsExecutorPlugin.init)
+            self.device_manager = TpuDeviceManager.initialize(self.conf)
+            # spill store chain + watermark (reference:
+            # GpuShuffleEnv.initStorage, GpuShuffleEnv.scala:57-79).
+            # Budget honors this session's conf when it is the FIRST live
+            # session; later concurrent sessions share the live framework
+            # (one device, one watermark).
+            hbm_total = self.conf.get(C.HBM_SIZE_OVERRIDE) or \
+                self.device_manager.hbm_total
+            budget = int(hbm_total * self.conf.get(C.MEMORY_FRACTION))
+            fw = SpillFramework.get()
+            if not (shared_live and fw is not None):
+                fw = SpillFramework.initialize(
+                    self.conf, budget, self.device_manager.bytes_in_use)
+            self.spill = fw
+            TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
+            AdmissionController.initialize(
+                budget, self.conf.get(C.ADMISSION_MAX_BYPASS))
+            _LIVE_SESSIONS.add(self)
         self.scheduler = TaskScheduler(self.conf.task_threads)
         self.conf.sync_int64_narrowing()
         with TpuSession._lock:
@@ -113,32 +156,83 @@ class TpuSession:
                 cls._active = TpuSession()
             return cls._active
 
-    def stop(self):
+    def stop(self, _sweep_leaked: bool = True):
         from spark_rapids_tpu.engine.retry import CircuitBreaker
         from spark_rapids_tpu.utils import faultinject as FI
 
+        with _RUNTIME_LOCK:
+            if self._stopped:
+                # idempotent: a double stop() must not re-run teardown (it
+                # would tear the shared device manager/mesh out from under
+                # a concurrent session)
+                return
+            self._stopped = True
+            _LIVE_SESSIONS.discard(self)
+            maybe_last = len(_LIVE_SESSIONS) == 0
+        # always per-session: this session's worker pool, this TENANT's
+        # breaker state (another tenant's failure history is not ours to
+        # reset), and the process-global fault-injection slot — armed
+        # injection must not outlive the session that armed it (running
+        # queries are unaffected: theirs is context-scoped)
         self.scheduler.shutdown()
+        CircuitBreaker.reset(tenant=self.tenant)
+        FI.disable_global()
+        if not maybe_last and _sweep_leaked:
+            # a session that was never stopped but is no longer referenced
+            # anywhere (a leaked test fixture) may linger in cyclic
+            # garbage; one sweep keeps it from blocking teardown forever.
+            # TpuServer.stop() suppresses the sweep for all but its final
+            # session — a batch shutdown needs at most one.
+            import gc
+
+            gc.collect()
+        # teardown decision AND teardown are one atomic step under
+        # _RUNTIME_LOCK: a concurrent TpuSession.__init__ (same lock)
+        # either adopts the still-live runtime BEFORE this block — then
+        # the live-set is non-empty and nothing is torn down — or builds
+        # a fresh runtime after it
+        with _RUNTIME_LOCK:
+            if len(_LIVE_SESSIONS) > 0:
+                with TpuSession._lock:
+                    if TpuSession._active is self:
+                        TpuSession._active = None
+                return
+            self._teardown_shared_runtime()
+        with TpuSession._lock:
+            if TpuSession._active is self:
+                TpuSession._active = None
+
+    @staticmethod
+    def _teardown_shared_runtime() -> None:
+        """Tear down everything the live sessions shared (caller holds
+        _RUNTIME_LOCK and has verified no live session remains)."""
+        from spark_rapids_tpu.engine.admission import AdmissionController
+        from spark_rapids_tpu.engine.retry import CircuitBreaker
+        from spark_rapids_tpu.utils import faultinject as FI
+
         TpuSemaphore.shutdown()
         SpillFramework.shutdown()
-        # fault-tolerance state is per-session: the breaker's failure
-        # count and any armed fault injection must not leak into the next
-        # session in the process
+        AdmissionController.shutdown()
+        # fault-tolerance state must not leak into the next session in
+        # the process (full reset: default + every tenant)
         CircuitBreaker.reset()
-        FI.disable()
+        FI.disable_global()
         # symmetric with the semaphore/spill singletons: a later session
         # must size its budget from ITS conf — without this, a test
         # session's hbm.sizeOverride leaks into every session that
         # follows in the process
         TpuDeviceManager.shutdown()
+        # the plan cache holds physical plans and resource reports sized
+        # against the runtime that just died
+        from spark_rapids_tpu.plan import plan_cache as _pc
+
+        _pc.clear()
         # same leak class for the collective meshes (shuffle/ici.py): a
         # test session's mesh must not pin its device set (and cached
         # shard_map programs keyed on it) into later sessions
         from spark_rapids_tpu.shuffle import ici as _ici
 
         _ici.reset_mesh()
-        with TpuSession._lock:
-            if TpuSession._active is self:
-                TpuSession._active = None
 
     def set_conf(self, key: str, value: Any) -> None:
         self.conf.set(key, value)
@@ -171,9 +265,45 @@ class TpuSession:
 
         return optimize(plan, self.conf)
 
-    def _physical_plan(self, plan: L.LogicalPlan) -> PhysicalExec:
+    def _physical_plan(self, plan: L.LogicalPlan,
+                       use_cache: bool = True) -> PhysicalExec:
+        """Build (or fetch from the plan cache) the final physical plan.
+
+        Serving hot path (docs/serving.md): with the plan cache on, a
+        signature hit returns a previously planned, VERIFIED, and
+        ANALYZED physical plan — zero planning work — and re-applies the
+        cached resource report's admission hints. A checked replay never
+        uses the cache (SPMD lowering differs in checked mode)."""
+        with self._plan_lock:
+            return self._physical_plan_locked(plan, use_cache)
+
+    def _physical_plan_locked(self, plan: L.LogicalPlan,
+                              use_cache: bool) -> PhysicalExec:
+        from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.plan import plan_cache as PC
         from spark_rapids_tpu.plan.fusion import fuse_stages
         from spark_rapids_tpu.plan.spmd import lower_spmd_stages
+        from spark_rapids_tpu.utils import metrics as M
+
+        cache_key = None
+        if use_cache and self.conf.get(C.PLAN_CACHE_ENABLED) and \
+                not AX.in_checked_mode():
+            from spark_rapids_tpu.plan.signature import plan_signature
+
+            sig = plan_signature(plan, self.conf)
+            if sig is not None:
+                cache_key = sig.cache_key
+                entry = PC.lookup(cache_key)
+                if entry is not None:
+                    M.record_plan_cache_hit()
+                    self.last_plan_violations = list(entry.violations)
+                    self.last_resource_report = entry.report
+                    if entry.report is not None:
+                        self._apply_resource_hints(entry.report)
+                    else:
+                        self._reset_resource_hints()
+                    self.plan_capture.record(entry.physical)
+                    return entry.physical
 
         cpu_plan = plan_physical(self._optimized(plan), self.conf)
         tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
@@ -238,10 +368,18 @@ class TpuSession:
             self.last_resource_report = None
             # a previous query's admission weight / spill reserve must not
             # outlive the analysis that produced it
-            TpuSemaphore.get().set_query_weight(1)
-            fw = SpillFramework.get()
-            if fw is not None:
-                fw.set_plan_hint(0.0, None)
+            self._reset_resource_hints()
+        if cache_key is not None:
+            # seed the cache with the fully built (and verified/analyzed
+            # — a raise above never reaches here) plan. insert() keeps
+            # the FIRST entry on a concurrent-build race
+            M.record_plan_cache_miss()
+            entry = PC.insert(
+                cache_key,
+                PC.CachedPlan(final, self.last_resource_report,
+                              self.last_plan_violations, plan),
+                self.conf.get(C.PLAN_CACHE_MAX_ENTRIES))
+            final = entry.physical
         self.plan_capture.record(final)
         return final
 
@@ -250,13 +388,36 @@ class TpuSession:
         semaphore learns how many permits one task of this query should
         hold (heavy plans admit fewer concurrent tasks), and the spill
         framework learns how much transient headroom the plan is predicted
-        to need (docs/static-analysis.md)."""
+        to need (docs/static-analysis.md). The weight and report also land
+        on the ambient QueryContext so concurrent queries keep their own
+        (memory/semaphore.py, engine/admission.py)."""
+        from spark_rapids_tpu.utils import metrics as M
+
         sem = TpuSemaphore.get()
-        sem.set_query_weight(report.admission_weight(sem.max_concurrent))
+        weight = report.admission_weight(sem.max_concurrent)
+        sem.set_query_weight(weight)
+        qctx = M.current_query_ctx()
+        if qctx is not None:
+            qctx.sem_weight = weight
+            qctx.resource_report = report
         fw = SpillFramework.get()
         if fw is not None:
             fw.set_plan_hint(report.spill_pressure,
                              report.per_task_peak_bytes)
+
+    def _reset_resource_hints(self) -> None:
+        """No analysis for this plan: nothing may inherit a previous
+        query's admission weight or spill reserve."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        TpuSemaphore.get().set_query_weight(1)
+        qctx = M.current_query_ctx()
+        if qctx is not None:
+            qctx.sem_weight = 1
+            qctx.resource_report = None
+        fw = SpillFramework.get()
+        if fw is not None:
+            fw.set_plan_hint(0.0, None)
 
     def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
         from spark_rapids_tpu.plan.fusion import fuse_stages
@@ -298,6 +459,17 @@ class TpuSession:
 
     # -- actions --------------------------------------------------------------
     def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
+        results = self.execute_partitions(plan)
+        return [b for part in results for b in part]
+
+    def execute_partitions(self, plan: L.LogicalPlan,
+                           allow_micro_batch: bool = True,
+                           use_plan_cache: bool = True):
+        """Run one query; returns per-partition lists of host batches (in
+        partition order). The serving entry point: installs the per-query
+        QueryContext (tenant metrics + breaker + injector + retry budget),
+        routes eligible queries through the server's micro-batcher, and
+        otherwise runs the device/degradation pipeline."""
         from spark_rapids_tpu.engine import async_exec as AX
         from spark_rapids_tpu.engine import retry as R
         from spark_rapids_tpu.plan.fusion import count_fused_stages
@@ -309,57 +481,98 @@ class TpuSession:
         # interleaved sessions) — and, same contract, the retry policy,
         # the circuit breaker knobs, the fault-injection harness, the
         # issue-ahead/donation flags, and the scheduler's per-query retry
-        # budget/timeout
+        # budget/timeout. Per-tenant state (breaker, injector, budget,
+        # metrics) additionally rides the QueryContext so concurrent
+        # tenants cannot cross-talk.
         self.conf.sync_int64_narrowing()
         R.set_policy_from_conf(self.conf)
-        breaker = R.CircuitBreaker.configure(self.conf)
-        FI.configure(self.conf)
+        breaker = R.CircuitBreaker.configure(self.conf, tenant=self.tenant)
         AX.configure(self.conf, self.device_manager)
         self.scheduler.configure(self.conf)
-        dispatches_before = M.dispatch_count()
-        before = (M.retry_count(), M.split_retry_count(),
-                  M.cpu_fallback_count(), M.fetch_retry_count(),
-                  M.fence_count(), M.checked_replay_count(),
-                  M.donated_bytes(), M.spmd_stage_count(),
-                  M.collective_bytes())
-        cpu_fallback_ok = self.conf.get(C.CPU_FALLBACK_ENABLED)
-        if breaker.is_open() and cpu_fallback_ok:
-            # the session's device is unhealthy: remaining queries plan
-            # straight on the CPU engine instead of burning retries. Like
-            # the device-failure fallback below, this run is the backstop:
-            # injected faults must not chase it
-            M.record_cpu_fallback()
-            FI.disable()
-            physical, results = self._execute_on_cpu(plan)
-        else:
-            try:
-                physical, results = self._execute_device(plan)
-            except Exception as e:  # noqa: BLE001 — degradation boundary
-                if not R.failure_is_device_rooted(e):
-                    raise
-                physical, results = self._degrade_device_failure(
-                    plan, e, breaker, cpu_fallback_ok)
-        # per-query fusion accounting (process-wide dispatch counter: tasks
-        # share one worker pool; interleaved sessions would blur the delta,
-        # same caveat as jit_cache stats)
-        self.last_query_metrics = {
-            M.FUSED_STAGES: count_fused_stages(physical),
-            M.DEVICE_DISPATCHES: M.dispatch_count() - dispatches_before,
-            M.RETRIES: M.retry_count() - before[0],
-            M.SPLIT_RETRIES: M.split_retry_count() - before[1],
-            M.CPU_FALLBACK_EVENTS: M.cpu_fallback_count() - before[2],
-            M.FETCH_RETRIES: M.fetch_retry_count() - before[3],
-            M.FENCES: M.fence_count() - before[4],
-            M.CHECKED_REPLAYS: M.checked_replay_count() - before[5],
-            M.DONATED_BYTES: M.donated_bytes() - before[6],
-            M.SPMD_STAGES: M.spmd_stage_count() - before[7],
-            M.COLLECTIVE_BYTES: M.collective_bytes() - before[8],
-        }
-        return [b for part in results for b in part]
+        qctx = M.QueryContext(self.tenant)
+        qctx.breaker = breaker
+        qctx.begin_retry_budget(self.conf.get(C.RETRY_BUDGET))
+        token = M.push_query_ctx(qctx)
+        physical = None
+        try:
+            FI.configure(self.conf, ctx=qctx)
+            routed = self._maybe_micro_batch(plan, breaker,
+                                             allow_micro_batch)
+            if routed is not None:
+                return routed
+            cpu_fallback_ok = self.conf.get(C.CPU_FALLBACK_ENABLED)
+            if breaker.is_open() and cpu_fallback_ok:
+                # the tenant's device path is unhealthy: remaining queries
+                # plan straight on the CPU engine instead of burning
+                # retries. Like the device-failure fallback below, this
+                # run is the backstop: injected faults must not chase it
+                M.record_cpu_fallback()
+                FI.disable()
+                physical, results = self._execute_on_cpu(
+                    plan, use_plan_cache)
+            else:
+                try:
+                    physical, results = self._execute_device(
+                        plan, use_plan_cache)
+                except Exception as e:  # noqa: BLE001 — degradation boundary
+                    if not R.failure_is_device_rooted(e):
+                        raise
+                    physical, results = self._degrade_device_failure(
+                        plan, e, breaker, cpu_fallback_ok, use_plan_cache)
+            return results
+        finally:
+            M.pop_query_ctx(token)
+            # per-query accounting from THIS query's context (immune to
+            # concurrent tenants, unlike the old global before/after
+            # snapshots). Under concurrency last_query_metrics is
+            # last-completed-wins per session.
+            snap = qctx.snapshot()
+            self.last_query_metrics = {
+                M.FUSED_STAGES: (count_fused_stages(physical)
+                                 if physical is not None else 0),
+            }
+            for name in (M.DEVICE_DISPATCHES, M.RETRIES, M.SPLIT_RETRIES,
+                         M.CPU_FALLBACK_EVENTS, M.FETCH_RETRIES, M.FENCES,
+                         M.CHECKED_REPLAYS, M.DONATED_BYTES, M.SPMD_STAGES,
+                         M.COLLECTIVE_BYTES, M.PLAN_CACHE_HITS,
+                         M.PLAN_CACHE_MISSES, M.ADMISSION_WAITS,
+                         M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES):
+                self.last_query_metrics[name] = snap.get(name, 0)
 
-    def _execute_device(self, plan: L.LogicalPlan):
+    def _maybe_micro_batch(self, plan: L.LogicalPlan, breaker,
+                           allow_micro_batch: bool):
+        """Route an eligible query through the server's micro-batcher
+        (engine/server.py); returns the per-partition results, or None to
+        run it as an ordinary query."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        if not allow_micro_batch or self.micro_batcher is None or \
+                breaker.is_open():
+            return None
+        window_ms = self.conf.get(C.MICRO_BATCH_WINDOW_MS)
+        if window_ms <= 0:
+            return None
+        from spark_rapids_tpu.engine.server import micro_batch_eligible
+        from spark_rapids_tpu.plan.signature import plan_signature
+
+        if not micro_batch_eligible(plan):
+            return None
+        sig = plan_signature(plan, self.conf)
+        if sig is None:
+            return None
+        M.record_micro_batched_query()
+        return self.micro_batcher.submit(self, plan, sig.shape_key,
+                                         window_ms / 1000.0)
+
+    def _execute_device(self, plan: L.LogicalPlan,
+                        use_plan_cache: bool = True):
         """Plan and run one query on the device engine (the issue-ahead
         fast path; also the body of the checked replay).
+
+        Before executing, the query passes analyzer-driven admission
+        (engine/admission.py): its predicted peak-HBM bytes must fit
+        beside everything already admitted, so aggregate admitted HBM
+        stays under budget — heavy plans queue, light plans interleave.
 
         When the plan root is the result sink (DeviceToHostExec) and
         issue-ahead execution is on, the sink is lifted to the QUERY
@@ -369,21 +582,35 @@ class TpuSession:
         (docs/async-execution.md; was one grouped download per output
         partition, each a ~66 ms fence on a tunneled backend)."""
         from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.engine.admission import AdmissionController
         from spark_rapids_tpu.exec.transitions import DeviceToHostExec
+        from spark_rapids_tpu.utils import metrics as M
 
-        physical = self._physical_plan(plan)
-        ctx = self._exec_context()
-        # the lift streams partitions as they complete (run_job_iter),
-        # which has no per-task timeout plumbing — a timeout-configured
-        # session keeps the per-partition sink
-        if isinstance(physical, DeviceToHostExec) and \
-                AX.async_enabled() and not self.scheduler.task_timeout_s:
-            results = self._execute_lifted_sink(physical, ctx)
+        physical = self._physical_plan(plan, use_cache=use_plan_cache)
+        ticket = ctl = None
+        qctx = M.current_query_ctx()
+        report = qctx.resource_report if qctx is not None \
+            else self.last_resource_report
+        if report is not None and self.conf.get(C.ADMISSION_ENABLED):
+            ctl = AdmissionController.get()
+            if ctl is not None:
+                ticket = ctl.admit(report.peak_bytes.hi, tenant=self.tenant)
+        try:
+            ctx = self._exec_context()
+            # the lift streams partitions as they complete (run_job_iter),
+            # which has no per-task timeout plumbing — a timeout-configured
+            # session keeps the per-partition sink
+            if isinstance(physical, DeviceToHostExec) and \
+                    AX.async_enabled() and not self.scheduler.task_timeout_s:
+                results = self._execute_lifted_sink(physical, ctx)
+                return physical, results
+            pb = physical.execute(ctx)
+            results = self.scheduler.run_job(
+                pb.num_partitions, lambda p: list(pb.iterator(p)))
             return physical, results
-        pb = physical.execute(ctx)
-        results = self.scheduler.run_job(
-            pb.num_partitions, lambda p: list(pb.iterator(p)))
-        return physical, results
+        finally:
+            if ticket is not None:
+                ctl.release(ticket)
 
     # device bytes the lifted sink may hold un-downloaded before flushing
     # a grouped transfer (ONE shared constant with to_host_many's
@@ -452,7 +679,8 @@ class TpuSession:
 
     def _degrade_device_failure(self, plan: L.LogicalPlan,
                                 e: BaseException, breaker,
-                                cpu_fallback_ok: bool):
+                                cpu_fallback_ok: bool,
+                                use_plan_cache: bool = True):
         """Graceful degradation after a device-rooted failure, in order:
         (1) one CHECKED replay when issue-ahead behavior was active — the
         error may have surfaced at the sink (or a donated dispatch lost
@@ -477,7 +705,10 @@ class TpuSession:
             FI.clear_deferred()
             try:
                 with AX.checked_mode():
-                    return self._execute_device(plan)
+                    # the checked replay plans fresh (the plan cache is
+                    # bypassed while in_checked_mode: SPMD lowering and
+                    # donation differ in checked plans)
+                    return self._execute_device(plan, use_plan_cache)
             except Exception as e2:  # noqa: BLE001 — degradation boundary
                 if not (cpu_fallback_ok and R.failure_is_device_rooted(e2)):
                     raise
@@ -495,30 +726,37 @@ class TpuSession:
         # the fallback run is the backstop: injected faults must not chase
         # it (re-armed at the next query start)
         FI.disable()
-        return self._execute_on_cpu(plan)
+        return self._execute_on_cpu(plan, use_plan_cache)
 
-    def _execute_on_cpu(self, plan: L.LogicalPlan):
+    def _execute_on_cpu(self, plan: L.LogicalPlan,
+                        use_plan_cache: bool = True):
         """Plan and run a query entirely on the CPU-oracle engine (runtime
         graceful degradation; strict on-TPU assertion is meaningless for a
         deliberate fallback, so it is disabled for this run)."""
-        saved = dict(self.conf.settings)
-        self.conf.settings.update({
-            C.SQL_ENABLED.key: False,
-            C.TEST_ENABLED.key: False,
-        })
         # the device run may have spent the whole per-query retry budget;
         # the fallback run starts fresh
         self.scheduler.begin_query()
-        try:
-            physical = self._physical_plan(plan)
-            ctx = self._exec_context()
-            pb = physical.execute(ctx)
-            results = self.scheduler.run_job(
-                pb.num_partitions, lambda p: list(pb.iterator(p)))
-            return physical, results
-        finally:
-            self.conf.settings.clear()
-            self.conf.settings.update(saved)
+        # conf swap + planning under the plan lock: a CONCURRENT query's
+        # signature/plan build must never observe the fallback's
+        # sql.enabled=False half-applied (the overridden keys are part of
+        # every cache key, so the fallback plan caches separately)
+        with self._plan_lock:
+            saved = dict(self.conf.settings)
+            self.conf.settings.update({
+                C.SQL_ENABLED.key: False,
+                C.TEST_ENABLED.key: False,
+            })
+            try:
+                physical = self._physical_plan(plan,
+                                               use_cache=use_plan_cache)
+            finally:
+                self.conf.settings.clear()
+                self.conf.settings.update(saved)
+        ctx = self._exec_context()
+        pb = physical.execute(ctx)
+        results = self.scheduler.run_job(
+            pb.num_partitions, lambda p: list(pb.iterator(p)))
+        return physical, results
 
     def execute_collect(self, plan: L.LogicalPlan) -> List[tuple]:
         rows: List[tuple] = []

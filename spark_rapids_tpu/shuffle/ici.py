@@ -70,8 +70,12 @@ def session_mesh() -> Optional[Mesh]:
                         global_mesh,
                     )
 
+                    # tpulint: shared-state-mutation -- under _MESH_LOCK;
+                    # build-once mesh singleton (reset in session.stop)
                     _MESH = global_mesh()
                 else:
+                    # tpulint: shared-state-mutation -- under _MESH_LOCK
+                    # (same build-once singleton)
                     _MESH = build_mesh()
         return _MESH
 
@@ -94,6 +98,8 @@ def stage_mesh(n_devices: int = 0) -> Mesh:
     else:
         mesh = build_mesh(min(n, len(jax.devices())))
     with _MESH_LOCK:
+        # tpulint: shared-state-mutation -- under _MESH_LOCK; setdefault
+        # keeps the first mesh on a concurrent-build race
         return _STAGE_MESHES.setdefault(n, mesh)
 
 

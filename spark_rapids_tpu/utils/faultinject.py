@@ -32,6 +32,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.utils import metrics as _M
 
 # every registered site -> its default fault kind. Keep docs/fault-tolerance.md
 # in sync when adding a site.
@@ -156,28 +157,62 @@ def _parse_sites(spec: str) -> Dict[str, str]:
 _ACTIVE: Optional[FaultInjector] = None
 
 
-def configure(tpu_conf: "C.TpuConf") -> Optional[FaultInjector]:
+def configure(tpu_conf: "C.TpuConf", ctx=None) -> Optional[FaultInjector]:
     """Arm (or disarm) the harness from a session conf; called at every
-    query start so the executing session's conf is authoritative."""
+    query start so the executing session's conf is authoritative.
+
+    With a QueryContext (multi-tenant serving, docs/serving.md) the
+    injector is ADDITIONALLY scoped to that query: `active()` prefers the
+    ambient context's injector, which contextvars propagation carries onto
+    the query's worker threads — so one tenant arming injection cannot
+    fault another tenant's concurrently running query. The process-global
+    slot is still set (last writer wins) for direct callers outside any
+    query context."""
     global _ACTIVE
     if not tpu_conf.get(C.FAULT_INJECTION_ENABLED):
         _ACTIVE = None
+        if ctx is not None:
+            ctx.injector = None
+            ctx.fi_scoped = True
         return None
-    _ACTIVE = FaultInjector(
+    inj = FaultInjector(
         seed=tpu_conf.get(C.FAULT_INJECTION_SEED),
         sites_spec=tpu_conf.get(C.FAULT_INJECTION_SITES),
         rate=tpu_conf.get(C.FAULT_INJECTION_RATE),
         defer_to_sink=tpu_conf.get(C.FAULT_INJECTION_DEFER_TO_SINK),
     )
-    return _ACTIVE
+    _ACTIVE = inj
+    if ctx is not None:
+        ctx.injector = inj
+        ctx.fi_scoped = True
+    return inj
 
 
 def disable() -> None:
+    """Disarm injection for the current scope: inside a query context the
+    query's own injector clears (the fallback-run backstop must stay
+    per-tenant); outside one, the process-global slot clears."""
+    ctx = _M.current_query_ctx()
+    if ctx is not None and ctx.fi_scoped:
+        ctx.injector = None
+        return
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def disable_global() -> None:
+    """Unconditionally clear the process-global slot (session teardown)."""
     global _ACTIVE
     _ACTIVE = None
 
 
 def active() -> Optional[FaultInjector]:
+    """The injector governing the calling thread: the ambient query
+    context's when one is installed (per-tenant isolation), else the
+    process-global slot."""
+    ctx = _M.current_query_ctx()
+    if ctx is not None and ctx.fi_scoped:
+        return ctx.injector
     return _ACTIVE
 
 
@@ -185,7 +220,7 @@ def clear_deferred() -> None:
     """Drop any recorded-but-unsurfaced deferred faults (called before a
     checked replay: the replay re-executes from the start, and the first
     run's undelivered sink faults must not poison its downloads)."""
-    inj = _ACTIVE
+    inj = active()
     if inj is not None:
         inj.clear_deferred()
 
@@ -196,7 +231,7 @@ def raise_deferred_at_sink(site: str = "transfer.download") -> None:
     sites — and by an EMPTY sink (session._sink_download with nothing to
     download), which still counts as the query's blocking point: a
     deferred fault must not vanish just because no rows survived."""
-    inj = _ACTIVE
+    inj = active()
     if inj is None:
         return
     pending = inj.pop_deferred()
@@ -219,7 +254,7 @@ def maybe_inject(site: str) -> None:
     the originating site — modeling where a real async XLA error reaches
     the host. A checked replay (engine/async_exec.checked_mode) disables
     the deferral, so replayed faults raise at their sites."""
-    inj = _ACTIVE
+    inj = active()
     if inj is None:
         return
     if site in SINK_SITES:

@@ -12,6 +12,7 @@ Reference parity:
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time
 from typing import Dict, Optional
@@ -53,6 +54,18 @@ DONATED_BYTES = "donatedBytes"
 # (the all_to_all exchange epoch and the sort-absorbing all_gather)
 SPMD_STAGES = "spmdStages"
 COLLECTIVE_BYTES = "collectiveBytes"
+# serving-runtime metrics (plan/plan_cache.py, engine/admission.py,
+# engine/server.py, docs/serving.md): planCacheHits/Misses count
+# signature-cache lookups for cache-enabled queries (a hit skips planning,
+# verification, AND resource analysis); admissionWaits counts queries that
+# blocked in analyzer-driven HBM admission before running;
+# microBatches/microBatchedQueries count packed windows and the individual
+# queries that rode in one
+PLAN_CACHE_HITS = "planCacheHits"
+PLAN_CACHE_MISSES = "planCacheMisses"
+ADMISSION_WAITS = "admissionWaits"
+MICRO_BATCHES = "microBatches"
+MICRO_BATCHED_QUERIES = "microBatchedQueries"
 
 
 class Metric:
@@ -97,11 +110,118 @@ class MetricsMap:
 
 
 # ---------------------------------------------------------------------------
+# Per-query / per-tenant accumulation context
+# ---------------------------------------------------------------------------
+# Before the serving runtime, per-query metrics were before/after snapshots
+# of the process-wide counters — which cross-talk the moment two queries
+# run concurrently. A QueryContext is installed by the session around each
+# query (a contextvar, propagated onto scheduler worker threads and the
+# prefetch reader by contextvars.copy_context), and every record_* helper
+# accumulates into BOTH the global counter (bench/tools keep reading those)
+# and the ambient query's context. The context also carries the per-tenant
+# policy objects that used to be process singletons: the tenant's circuit
+# breaker, the query's fault injector, the per-query retry budget, and the
+# analyzer's semaphore admission weight.
+_QUERY_CTX: "contextvars.ContextVar[Optional[QueryContext]]" = \
+    contextvars.ContextVar("srt_query_ctx", default=None)
+
+
+class QueryContext:
+    """One running query's metric accumulator + per-tenant policy handles
+    (docs/serving.md). Thread-safe: partition tasks on the worker pool add
+    concurrently."""
+
+    __slots__ = ("tenant", "_lock", "_counters", "breaker", "injector",
+                 "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
+                 "resource_report")
+
+    def __init__(self, tenant: str = "default"):
+        self.tenant = tenant
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        # per-tenant circuit breaker (engine/retry.CircuitBreaker.get
+        # consults this before the process default)
+        self.breaker = None
+        # per-query fault injector; fi_scoped=True means the injector slot
+        # is authoritative for this query even when it is None (the query
+        # ran with injection off while another tenant's is armed)
+        self.injector = None
+        self.fi_scoped = False
+        # per-query task-retry budget (0 = unlimited); the scheduler's
+        # _try_spend_retry charges here when a context is ambient, so
+        # concurrent queries cannot drain each other's budget
+        self.retry_budget = 0
+        self._retries_spent = 0
+        # semaphore permits one task of this query holds (the analyzer's
+        # admission weight, read by TpuSemaphore.acquire_if_necessary)
+        self.sem_weight = 1
+        # THIS query's resource-analyzer report (set during planning —
+        # including from a plan-cache hit); the admission controller reads
+        # it here so concurrent queries on one session cannot read each
+        # other's via the session attribute
+        self.resource_report = None
+
+    def add(self, name: str, n: int) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def value(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # -- retry budget (engine/scheduler.py charges here) ---------------------
+    def begin_retry_budget(self, budget: int) -> None:
+        with self._lock:
+            self.retry_budget = max(0, int(budget))
+            self._retries_spent = 0
+
+    def try_spend_retry(self) -> bool:
+        with self._lock:
+            if self.retry_budget and \
+                    self._retries_spent >= self.retry_budget:
+                return False
+            self._retries_spent += 1
+            return True
+
+    @property
+    def retries_spent(self) -> int:
+        with self._lock:
+            return self._retries_spent
+
+
+def current_query_ctx() -> Optional[QueryContext]:
+    return _QUERY_CTX.get()
+
+
+def push_query_ctx(ctx: Optional[QueryContext]):
+    """Install `ctx` as the ambient query context; returns the reset token
+    for pop_query_ctx."""
+    return _QUERY_CTX.set(ctx)
+
+
+def pop_query_ctx(token) -> None:
+    _QUERY_CTX.reset(token)
+
+
+def _note(name: str, n: int) -> None:
+    """Mirror a global-counter increment into the ambient query context."""
+    ctx = _QUERY_CTX.get()
+    if ctx is not None:
+        ctx.add(name, n)
+
+
+# ---------------------------------------------------------------------------
 # Device-dispatch accounting
 # ---------------------------------------------------------------------------
 # Process-wide: partition tasks run on a shared worker pool, so per-exec
-# counters would need threading context; queries snapshot before/after
-# instead (session.execute_batches -> session.last_query_metrics).
+# counters would need threading context; queries ALSO accumulate into the
+# ambient QueryContext (session.execute_batches ->
+# session.last_query_metrics), which is what keeps concurrent tenants'
+# numbers apart.
 _DISPATCHES = Metric(DEVICE_DISPATCHES)
 
 # measurement hook invoked after every record_dispatch (None = disabled).
@@ -125,6 +245,7 @@ def record_dispatch(n: int = 1) -> None:
     kernels and the batch gather/compact helpers — NOT per XLA executable
     internals; the unit is 'host->device dispatches the engine issued'."""
     _DISPATCHES.add(n)
+    _note(DEVICE_DISPATCHES, n)
     hook = _DISPATCH_HOOK
     if hook is not None:
         hook()
@@ -152,22 +273,26 @@ _COLLECTIVE_BYTES = Metric(COLLECTIVE_BYTES)
 def record_retry(n: int = 1) -> None:
     """Count one device re-dispatch (OOM spill+retry or transient retry)."""
     _RETRIES.add(n)
+    _note(RETRIES, n)
 
 
 def record_split_retry(n: int = 1) -> None:
     """Count one batch bisection performed by split-and-retry."""
     _SPLIT_RETRIES.add(n)
+    _note(SPLIT_RETRIES, n)
 
 
 def record_cpu_fallback(n: int = 1) -> None:
     """Count one degradation to the CPU-oracle path (per batch or per
     query, whichever unit fell back)."""
     _CPU_FALLBACKS.add(n)
+    _note(CPU_FALLBACK_EVENTS, n)
 
 
 def record_fetch_retry(n: int = 1) -> None:
     """Count one shuffle-piece re-execution after a fetch failure."""
     _FETCH_RETRIES.add(n)
+    _note(FETCH_RETRIES, n)
 
 
 def retry_count() -> int:
@@ -193,6 +318,7 @@ def record_fence(n: int = 1) -> None:
     flush granularity, so the unit is 'download transfers the engine
     issued' (the ~66 ms round trip on a tunneled backend)."""
     _FENCES.add(n)
+    _note(FENCES, n)
 
 
 def fence_count() -> int:
@@ -205,6 +331,7 @@ def record_checked_replay(n: int = 1) -> None:
     replays synchronously so the originating op's retry machinery can
     own it)."""
     _CHECKED_REPLAYS.add(n)
+    _note(CHECKED_REPLAYS, n)
 
 
 def checked_replay_count() -> int:
@@ -215,6 +342,7 @@ def record_donated_bytes(n: int) -> None:
     """Count input bytes donated into a consume-once kernel (the HBM the
     output reused instead of allocating fresh)."""
     _DONATED_BYTES.add(n)
+    _note(DONATED_BYTES, n)
 
 
 def donated_bytes() -> int:
@@ -225,6 +353,7 @@ def record_spmd_stage(n: int = 1) -> None:
     """Count one stage pipeline executed as a single SPMD program over the
     mesh (operators AND exchange compiled into one dispatch)."""
     _SPMD_STAGES.add(n)
+    _note(SPMD_STAGES, n)
 
 
 def spmd_stage_count() -> int:
@@ -236,10 +365,75 @@ def record_collective_bytes(n: int) -> None:
     exchange epoch of an SPMD stage or the standalone ICI shuffle tier,
     and the sort-absorbing all_gather)."""
     _COLLECTIVE_BYTES.add(n)
+    _note(COLLECTIVE_BYTES, n)
 
 
 def collective_bytes() -> int:
     return _COLLECTIVE_BYTES.value
+
+
+# ---------------------------------------------------------------------------
+# Serving-runtime accounting (plan cache / admission / micro-batching)
+# ---------------------------------------------------------------------------
+_PLAN_CACHE_HITS = Metric(PLAN_CACHE_HITS)
+_PLAN_CACHE_MISSES = Metric(PLAN_CACHE_MISSES)
+_ADMISSION_WAITS = Metric(ADMISSION_WAITS)
+_MICRO_BATCHES = Metric(MICRO_BATCHES)
+_MICRO_BATCHED_QUERIES = Metric(MICRO_BATCHED_QUERIES)
+
+
+def record_plan_cache_hit(n: int = 1) -> None:
+    """Count one signature-cache hit: the query reused a fully planned,
+    verified, and analyzed physical plan — zero planning work (and, via
+    the shared expression objects, zero retracing in the jit cache)."""
+    _PLAN_CACHE_HITS.add(n)
+    _note(PLAN_CACHE_HITS, n)
+
+
+def plan_cache_hit_count() -> int:
+    return _PLAN_CACHE_HITS.value
+
+
+def record_plan_cache_miss(n: int = 1) -> None:
+    """Count one signature-cache miss (the query planned from scratch and
+    seeded the cache). Only cache-enabled, cacheable queries count."""
+    _PLAN_CACHE_MISSES.add(n)
+    _note(PLAN_CACHE_MISSES, n)
+
+
+def plan_cache_miss_count() -> int:
+    return _PLAN_CACHE_MISSES.value
+
+
+def record_admission_wait(n: int = 1) -> None:
+    """Count one query that blocked in analyzer-driven HBM admission
+    (engine/admission.py) before it could start executing."""
+    _ADMISSION_WAITS.add(n)
+    _note(ADMISSION_WAITS, n)
+
+
+def admission_wait_count() -> int:
+    return _ADMISSION_WAITS.value
+
+
+def record_micro_batch(n: int = 1) -> None:
+    """Count one packed micro-batch window executed as a single query."""
+    _MICRO_BATCHES.add(n)
+    _note(MICRO_BATCHES, n)
+
+
+def micro_batch_count() -> int:
+    return _MICRO_BATCHES.value
+
+
+def record_micro_batched_query(n: int = 1) -> None:
+    """Count one individual query that rode in a packed micro-batch."""
+    _MICRO_BATCHED_QUERIES.add(n)
+    _note(MICRO_BATCHED_QUERIES, n)
+
+
+def micro_batched_query_count() -> int:
+    return _MICRO_BATCHED_QUERIES.value
 
 
 @contextlib.contextmanager

@@ -611,3 +611,82 @@ def test_naked_dispatch_pragma_suppresses():
            "    M.record_dispatch()\n"
            "    return jitted(cols)\n")
     assert rules_of(lint(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# shared-state-mutation
+# ---------------------------------------------------------------------------
+def test_shared_state_global_rebind_flagged_in_engine():
+    src = ("_STATE = None\n\n"
+           "def run_query(x):\n"
+           "    global _STATE\n"
+           "    _STATE = x\n"
+           "    return x\n")
+    assert rules_of(lint(src, path=ENGINE)) == ["shared-state-mutation"]
+
+
+def test_shared_state_container_mutation_flagged_in_hot_path():
+    src = ("_SEEN = {}\n\n"
+           "def emit(batch):\n"
+           "    _SEEN[batch.key] = batch\n"
+           "    return batch\n")
+    assert rules_of(lint(src, path=HOT)) == ["shared-state-mutation"]
+
+
+def test_shared_state_mutating_method_flagged():
+    src = ("_PENDING = []\n\n"
+           "def enqueue(b):\n"
+           "    _PENDING.append(b)\n")
+    assert rules_of(lint(src, path=ENGINE)) == ["shared-state-mutation"]
+
+
+def test_shared_state_lifecycle_scope_allowed():
+    # init/configure/reset/shutdown paths may (re)bind module state
+    src = ("_STATE = None\n\n"
+           "def configure(conf):\n"
+           "    global _STATE\n"
+           "    _STATE = conf\n\n"
+           "def reset():\n"
+           "    global _STATE\n"
+           "    _STATE = None\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_shared_state_sanctioned_metric_allowed():
+    # Metric() instances are the locked accumulation idiom
+    src = ("from spark_rapids_tpu.utils.metrics import Metric\n"
+           "_RETRIES = Metric('retries')\n\n"
+           "def note(n):\n"
+           "    _RETRIES.add(n)\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_shared_state_local_and_instance_writes_allowed():
+    src = ("_TABLE = {}\n\n"
+           "class Node:\n"
+           "    def work(self, x):\n"
+           "        self.cache = {}\n"
+           "        self.cache[x] = x\n"
+           "        local = []\n"
+           "        local.append(x)\n"
+           "        return local\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_shared_state_not_flagged_outside_scope():
+    src = ("_STATE = None\n\n"
+           "def run_query(x):\n"
+           "    global _STATE\n"
+           "    _STATE = x\n")
+    assert lint(src, path=COLD) == []
+
+
+def test_shared_state_pragma_suppresses():
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n\n"
+           "def run_query(k, v):\n"
+           "    with _LOCK:\n"
+           "        # tpulint: shared-state-mutation -- under _LOCK\n"
+           "        _TABLE[k] = v\n")
+    assert lint(src, path=ENGINE) == []

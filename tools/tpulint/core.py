@@ -60,6 +60,20 @@ the line above; `-- reason` after the rule names documents the waiver):
               these patterns, so mid-query-sync fires only where
               host-sync does not — which extends the same guarantee to
               engine/ (scheduler, retry, jit cache, async executor).
+  shared-state-mutation  a write to module-level mutable state from
+              hot-path/executor code (exec/, shuffle/, ops/eval.py,
+              engine/) OUTSIDE an allowlisted lifecycle function
+              (init*/configure/reset/shutdown/stop/clear/disable/enable/
+              register/set_*/begin/arm/build*/close/install): rebinding
+              a declared `global`, subscript-assigning into a
+              module-level container, or calling a mutating method
+              (append/update/setdefault/pop/...) on one. Under the
+              multi-tenant serving runtime these paths run concurrently
+              for many queries, so unsynchronized module state is a
+              cross-tenant race. Names bound at module level to a
+              `Metric(...)` or `threading.*`/`contextvars.*` constructor
+              are sanctioned (thread-safe by construction); a justified
+              write (held lock, documented init-once) carries a pragma.
   pragma      tpulint pragma hygiene: unknown rule name, or a pragma
               that suppresses nothing (stale waiver).
 """
@@ -83,6 +97,7 @@ RULES = (
     "stdout-print",
     "untracked-alloc",
     "naked-dispatch",
+    "shared-state-mutation",
     "pragma",
 )
 
@@ -109,6 +124,24 @@ _STAGING_OK = {
 
 # method calls that force a device->host round trip
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+# container methods that mutate their receiver (shared-state-mutation rule)
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "update", "clear",
+    "pop", "popitem", "setdefault", "remove", "discard",
+    "move_to_end", "appendleft", "extendleft",
+}
+
+# function-name shapes allowed to write module state: lifecycle paths that
+# run once per session/query bring-up or teardown, not per batch
+_LIFECYCLE_RE = re.compile(
+    r"(?i)^_?(init|initialize|configure|reset|shutdown|stop|close|clear|"
+    r"disable|enable|install|register|set_|begin|arm|build)")
+
+# module-level constructors whose instances are thread-safe by design —
+# writes through them are the sanctioned accumulation idiom
+_SANCTIONED_CTORS = {"Metric"}
+_SANCTIONED_CTOR_PREFIXES = ("threading.", "contextvars.")
 
 # call sinks whose function/body arguments become jit-traced
 _TRACE_SINKS = {
@@ -152,6 +185,43 @@ def is_mid_query_scope(path: str) -> bool:
     p = _norm(path)
     return ("spark_rapids_tpu/exec/" in p
             or "spark_rapids_tpu/engine/" in p)
+
+
+def is_shared_state_scope(path: str) -> bool:
+    """Files bound by the shared-state-mutation rule: everything that runs
+    per batch/query under the concurrent serving runtime — the hot paths
+    plus the whole engine layer."""
+    return is_hot_path(path) or is_mid_query_scope(path)
+
+
+def _module_mutable_names(tree: ast.Module):
+    """(module-level assigned names, the sanctioned thread-safe subset)."""
+    names: Set[str] = set()
+    sanctioned: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        tnames = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not tnames:
+            continue
+        ok = False
+        if isinstance(value, ast.Call):
+            dn = _dotted(value.func)
+            if dn.rsplit(".", 1)[-1] in _SANCTIONED_CTORS or \
+                    any(dn.startswith(p)
+                        for p in _SANCTIONED_CTOR_PREFIXES):
+                ok = True
+        for t in tnames:
+            names.add(t)
+            if ok:
+                sanctioned.add(t)
+    return names, sanctioned
 
 
 def _dotted(node: ast.AST) -> str:
@@ -362,10 +432,17 @@ class _Visitor(ast.NodeVisitor):
                  traced_helpers: bool = False,
                  stdout_protocol: bool = False,
                  retry_names: Optional[Set[str]] = None,
-                 retry_lambdas: Optional[Set[int]] = None):
+                 retry_lambdas: Optional[Set[int]] = None,
+                 module_names: Optional[Set[str]] = None,
+                 sanctioned_names: Optional[Set[str]] = None):
         self.path = path
         self.hot = is_hot_path(path)
         self.midquery = is_mid_query_scope(path)
+        self.shared_scope = is_shared_state_scope(path)
+        self._module_names = module_names or set()
+        self._sanctioned = sanctioned_names or set()
+        # per-scope `global NAME` declarations (parallel to self.scope)
+        self._global_decls: List[Set[str]] = []
         self.trace = trace
         self.traced_helpers = traced_helpers
         self.stdout_protocol = stdout_protocol
@@ -415,11 +492,13 @@ class _Visitor(ast.NodeVisitor):
             self.visit(deco)
         self.scope.append(name)
         self.scope_kinds.append(kind)
+        self._global_decls.append(set())
         for child in ast.iter_child_nodes(node):
             if child not in getattr(node, "decorator_list", ()):
                 self.visit(child)
         self.scope.pop()
         self.scope_kinds.pop()
+        self._global_decls.pop()
 
     def visit_FunctionDef(self, node):
         self._visit_scoped(node, node.name, "func")
@@ -438,9 +517,70 @@ class _Visitor(ast.NodeVisitor):
             label = "<lambda>"
         self.scope.append(label)
         self.scope_kinds.append("func")
+        self._global_decls.append(set())
         self.generic_visit(node)
         self.scope.pop()
         self.scope_kinds.pop()
+        self._global_decls.pop()
+
+    # -- shared-state-mutation rule ------------------------------------------
+    def visit_Global(self, node: ast.Global):
+        if self._global_decls:
+            self._global_decls[-1].update(node.names)
+        self.generic_visit(node)
+
+    def _lifecycle_scope(self) -> bool:
+        return any(_LIFECYCLE_RE.match(s.lstrip("_"))
+                   for s in self.scope)
+
+    @staticmethod
+    def _base_name(node: ast.AST) -> Optional[str]:
+        """Innermost Name of an attribute/subscript chain, or None."""
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _shared_state_active(self) -> bool:
+        return self.shared_scope and self._per_invocation_scope() and \
+            not self._lifecycle_scope()
+
+    def _check_shared_write(self, node, targets) -> None:
+        if not self._shared_state_active():
+            return
+        globals_here = set().union(*self._global_decls) \
+            if self._global_decls else set()
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                self._check_shared_write(node, list(t.elts))
+                continue
+            if isinstance(t, ast.Name) and t.id in globals_here:
+                self._flag(node, "shared-state-mutation",
+                           f"rebinds module-level global {t.id!r} from "
+                           "hot-path code; concurrent queries race on it "
+                           "— move the state onto the QueryContext / a "
+                           "lifecycle path, or justify with a pragma")
+            elif isinstance(t, (ast.Subscript, ast.Attribute)):
+                base = self._base_name(t)
+                if base in self._module_names and \
+                        base not in self._sanctioned:
+                    self._flag(node, "shared-state-mutation",
+                               f"writes into module-level {base!r} from "
+                               "hot-path code; concurrent queries race "
+                               "on it — guard it in a lifecycle path or "
+                               "justify with a pragma")
+
+    def visit_Assign(self, node: ast.Assign):
+        self._check_shared_write(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_shared_write(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._check_shared_write(node, [node.target])
+        self.generic_visit(node)
 
     # -- calls ---------------------------------------------------------------
     def visit_Call(self, node: ast.Call):
@@ -487,6 +627,19 @@ class _Visitor(ast.NodeVisitor):
                            "compiled program is keyed by function object "
                            "identity, so this recompiles every call — "
                            "cache via get_or_build or a build*() closure")
+
+        # shared-state-mutation: a mutating container method on a
+        # module-level name from per-query code
+        if self._shared_state_active() and \
+                isinstance(node.func, ast.Attribute) and \
+                tail in _MUTATING_METHODS:
+            base = self._base_name(node.func.value)
+            if base in self._module_names and base not in self._sanctioned:
+                self._flag(node, "shared-state-mutation",
+                           f"mutates module-level {base!r} (.{tail}) from "
+                           "hot-path code; concurrent queries race on it "
+                           "— guard it in a lifecycle path or justify "
+                           "with a pragma")
 
         # naked-dispatch: a dispatch site outside the retry combinators
         if self.hot and tail == "record_dispatch" and \
@@ -730,11 +883,14 @@ def lint_source(source: str, path: str,
         return [Finding(path, e.lineno or 1, "pragma",
                         f"cannot parse: {e.msg}")]
     retry_names, retry_lambdas = _retry_guarded(tree)
+    module_names, sanctioned = _module_mutable_names(tree)
     visitor = _Visitor(path, _TraceIndex(tree), conf_keys,
                        traced_helpers=pragmas.traced_helpers,
                        stdout_protocol=pragmas.stdout_protocol,
                        retry_names=retry_names,
-                       retry_lambdas=retry_lambdas)
+                       retry_lambdas=retry_lambdas,
+                       module_names=module_names,
+                       sanctioned_names=sanctioned)
     visitor.visit(tree)
     stmt_start = _stmt_start_map(tree)
     findings = [f for f in visitor.findings

@@ -312,6 +312,12 @@ def _robustness_metrics(session) -> dict:
         # record here (0 when neither ran)
         "spmd_stages": m.get("spmdStages", 0),
         "collective_bytes": m.get("collectiveBytes", 0),
+        # encoded columnar execution (columnar/encoded.py,
+        # docs/compressed-execution.md): columns the scans kept as codes,
+        # explicit decode events, and the scan-point HBM avoided
+        "encoded_columns": m.get("encodedColumns", 0),
+        "late_materializations": m.get("lateMaterializations", 0),
+        "encoded_bytes_saved": m.get("encodedBytesSaved", 0),
     }
 
 
@@ -325,13 +331,21 @@ def _resource_prediction(session) -> dict:
     def _num(v):
         return None if v != v or v in (float("inf"),) else int(v)
 
-    return {
+    out = {
         "pred_dispatches_lo": _num(rep.dispatches.lo),
         "pred_dispatches_hi": _num(rep.dispatches.hi),
         "pred_dispatches_exact": bool(rep.dispatches_exact),
         "pred_peak_bytes_lo": _num(rep.peak_bytes.lo),
         "pred_peak_bytes_hi": _num(rep.peak_bytes.hi),
     }
+    if getattr(rep, "encoded_cols", 0):
+        out.update({
+            "pred_encoded_cols": rep.encoded_cols,
+            "pred_encoded_saved_lo": _num(rep.encoded_saved.lo),
+            "pred_encoded_saved_hi": _num(rep.encoded_saved.hi),
+            "pred_decode_points": list(rep.decode_points),
+        })
+    return out
 
 
 def _spill_count() -> int:
@@ -1362,6 +1376,190 @@ def _serving_mode(cache_on: bool, n_clients: int, secs: float) -> dict:
     }
 
 
+def main_encoded() -> None:
+    """Flagship encoded-on-vs-off comparison (docs/compressed-execution.md)
+    on a dictionary-heavy TPC-H-style query: a lineitem-shaped table whose
+    return-flag/status columns are low-ndv dictionary strings, filtered
+    and grouped by them — exactly the shape the encoded subsystem keeps in
+    code space end-to-end. Measures wall time, SERIALIZED shuffle bytes
+    (codes + one dictionary per piece vs expanded strings), the
+    encoded metrics, and the analyzer's predicted peak/savings; writes
+    BENCH_r10.json."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import spark_rapids_tpu as srt
+    import spark_rapids_tpu.columnar.serde as serde
+    from spark_rapids_tpu.plan import functions as F
+
+    from spark_rapids_tpu.columnar.dtypes import DataType as _DT
+    from spark_rapids_tpu.columnar.encoded import HostDictionaryColumn
+
+    n = int(os.environ.get("SRT_ENCODED_ROWS", "400000"))
+    rng = np.random.default_rng(42)
+    tmpdir = tempfile.mkdtemp(prefix="srt_enc_bench_")
+    path = os.path.join(tmpdir, "lineitem_like.parquet")
+    comments = np.asarray([
+        f"clerk notes row class {i:03d}: carefully packed and inspected"
+        for i in range(200)])
+    pq.write_table(pa.table({
+        "l_returnflag": rng.choice(["A", "N", "R"], size=n),
+        "l_linestatus": rng.choice(["F", "O"], size=n),
+        "l_shipmode": rng.choice(
+            ["AIR", "MAIL", "SHIP", "TRUCK", "RAIL", "FOB", "REG AIR"],
+            size=n),
+        "l_comment": rng.choice(comments, size=n),
+        "l_quantity": rng.integers(1, 51, size=n),
+        "l_extendedprice": rng.integers(100, 100_000, size=n),
+    }), path, use_dictionary=True, row_group_size=n // 8)
+    dim_path = os.path.join(tmpdir, "modes.parquet")
+    pq.write_table(pa.table({
+        "m_mode": np.asarray(["AIR", "MAIL", "SHIP", "TRUCK", "RAIL",
+                              "FOB", "REG AIR"]),
+        "m_cost": np.asarray([3, 1, 2, 2, 2, 4, 3], dtype=np.int64),
+    }), dim_path, use_dictionary=True)
+
+    def q_agg(s):
+        # the code-space pipeline: filter + group-by never leave codes
+        return (s.read.parquet(path)
+                .filter(F.col("l_returnflag") == F.lit("A"))
+                .groupBy("l_linestatus", "l_shipmode")
+                .agg(F.count("*").alias("n"),
+                     F.sum("l_quantity").alias("qty"),
+                     F.sum("l_extendedprice").alias("rev")))
+
+    def q_join(s):
+        # a SHUFFLED dictionary-key join: both sides hash-exchange full
+        # row streams, so the shuffle carries every string column —
+        # where codes + one pruned dictionary copy per piece beat
+        # expanded strings
+        li = s.read.parquet(path)
+        dim = s.read.parquet(dim_path)
+        return (li.join(dim, li["l_shipmode"] == dim["m_mode"], "inner")
+                .groupBy("l_returnflag")
+                .agg(F.count("*").alias("n"),
+                     F.sum("m_cost").alias("cost"),
+                     F.max("l_comment").alias("mc")))
+
+    # count the serialized shuffle bytes actually produced (the exchange's
+    # piece serializer resolves serde.serialize_batch at call time);
+    # string-column bytes separately — the per-encoded-column reduction
+    ser_bytes = [0, 0]  # total, string/dict columns only
+    orig_serialize = serde.serialize_batch
+
+    def _str_col_bytes(batch) -> int:
+        tot = 0
+        bn = batch.num_rows
+        for c in batch.columns:
+            if isinstance(c, HostDictionaryColumn):
+                used = serde._dict_used_codes(
+                    c, bn, np.asarray(c.validity, dtype=bool))
+                dict_b = int(c.dictionary.host_lens[used].sum()) \
+                    if len(used) else 0
+                tot += 4 * bn + 4 + 4 * (len(used) + 1) + dict_b
+            elif c.dtype is _DT.STRING:
+                tot += 4 * (bn + 1) + sum(
+                    len(v.encode("utf-8")) if isinstance(v, str) else
+                    len(v)
+                    for v, ok in zip(c.data[:bn], c.validity[:bn]) if ok)
+        return tot
+
+    def counting(batch):
+        out = orig_serialize(batch)
+        ser_bytes[0] += len(out)
+        ser_bytes[1] += _str_col_bytes(batch)
+        return out
+
+    serde.serialize_batch = counting
+    results = {}
+    try:
+        for label, enabled in (("encoded_on", True), ("encoded_off", False)):
+            session = srt.new_session()
+            session.conf.set("rapids.tpu.shuffle.serialize.enabled", True)
+            session.conf.set("rapids.tpu.sql.encoded.enabled", enabled)
+            # force the SHUFFLED join plan (broadcast would skip the
+            # exchange this flagship measures)
+            session.conf.set("rapids.tpu.sql.autoBroadcastJoinThreshold", 0)
+            session.conf.set(
+                "rapids.tpu.sql.adaptive.runtimeBroadcastJoin.enabled",
+                False)
+            rec = {}
+            for qname, qfn in (("q_agg", q_agg), ("q_join", q_join)):
+                qfn(session).collect()  # warmup/compile
+                ser_bytes[0] = ser_bytes[1] = 0
+                t0 = time.perf_counter()
+                rows = qfn(session).collect()
+                elapsed = time.perf_counter() - t0
+                m = session.last_query_metrics
+                rep = getattr(session, "last_resource_report", None)
+                rec[qname] = {
+                    "time_s": elapsed,
+                    "rows_out": len(rows),
+                    "shuffle_serialized_bytes": ser_bytes[0],
+                    "shuffle_string_col_bytes": ser_bytes[1],
+                    "encoded_columns": m.get("encodedColumns", 0),
+                    "late_materializations":
+                        m.get("lateMaterializations", 0),
+                    "encoded_bytes_saved": m.get("encodedBytesSaved", 0),
+                    "pred_peak_bytes_hi": (
+                        None if rep is None
+                        or rep.peak_bytes.hi == float("inf")
+                        else int(rep.peak_bytes.hi)),
+                    "pred_encoded_cols": getattr(rep, "encoded_cols", 0)
+                    if rep is not None else 0,
+                    "pred_decode_points": list(
+                        getattr(rep, "decode_points", []))
+                    if rep is not None else [],
+                    "pred_encoded_code_bytes_hi": (
+                        None if rep is None
+                        or rep.encoded_code_bytes.hi == float("inf")
+                        else int(rep.encoded_code_bytes.hi)),
+                    "pred_encoded_decoded_bytes_hi": (
+                        None if rep is None
+                        or rep.encoded_decoded_bytes.hi == float("inf")
+                        else int(rep.encoded_decoded_bytes.hi)),
+                }
+                _log(f"encoded[{label}] {qname}: {elapsed:.3f}s, "
+                     f"shuffle {ser_bytes[0]} B "
+                     f"(string cols {ser_bytes[1]} B)")
+            results[label] = rec
+            session.stop()
+    finally:
+        serde.serialize_batch = orig_serialize
+    on, off = results["encoded_on"], results["encoded_off"]
+    summary = {
+        "bench": "encoded_flagship",
+        "rows": n,
+        "queries": {
+            "q_agg": "filter(l_returnflag='A') groupBy(l_linestatus, "
+                     "l_shipmode) agg(count, sum, sum)",
+            "q_join": "lineitem JOIN modes ON l_shipmode (shuffled) "
+                      "groupBy(l_returnflag)",
+        },
+        **results,
+        # the acceptance ratios: string-column shuffle bytes of the
+        # row-stream (join) exchange, and the analyzer's encoded-column
+        # HBM model, encoded-off vs encoded-on
+        "shuffle_string_bytes_ratio": (
+            off["q_join"]["shuffle_string_col_bytes"]
+            / max(on["q_join"]["shuffle_string_col_bytes"], 1)),
+        "shuffle_total_bytes_ratio": (
+            off["q_join"]["shuffle_serialized_bytes"]
+            / max(on["q_join"]["shuffle_serialized_bytes"], 1)),
+        "pred_encoded_hbm_ratio": (
+            (on["q_agg"]["pred_encoded_decoded_bytes_hi"]
+             / max(on["q_agg"]["pred_encoded_code_bytes_hi"] or 1, 1))
+            if on["q_agg"]["pred_encoded_decoded_bytes_hi"] else None),
+    }
+    with open("BENCH_r10.json", "w") as fh:
+        json.dump(summary, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(summary))
+
+
 def main_serving() -> None:
     """Serving suite (`python bench.py --serving`): closed-loop clients
     over the multi-tenant runtime, plan cache OFF vs ON (docs/serving.md).
@@ -1424,5 +1622,7 @@ if __name__ == "__main__":
         main_shuffle()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--serving":
         main_serving()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--encoded":
+        main_encoded()
     else:
         main()

@@ -363,8 +363,18 @@ class HostColumnarBatch:
 
     def estimated_size_bytes(self) -> int:
         total = 0
+        seen_dicts = set()
         for c in self.columns:
-            if c.dtype is DataType.STRING:
+            if getattr(c, "dictionary", None) is not None:
+                # host codes + the dictionary bytes once per distinct
+                # dictionary in THIS batch (cross-batch sharing of an
+                # interned dictionary is deliberately overcounted — the
+                # spill store's accounting must never underestimate)
+                total += c.data.nbytes + len(c.validity)
+                if c.dictionary.did not in seen_dicts:
+                    seen_dicts.add(c.dictionary.did)
+                    total += int(c.dictionary.host_offsets[-1])
+            elif c.dtype is DataType.STRING:
                 total += sum(len(s) for s in c.data) + 5 * len(c.data)
             else:
                 total += c.data.nbytes + len(c.validity)
@@ -381,14 +391,24 @@ class HostColumnarBatch:
         buffer because a device-side u8[n, itemsize] bitcast pads the
         minor dim to the 128-lane tile on TPU — a 32x HBM blowup that
         OOMed real-chip uploads at 64M rows."""
+        from spark_rapids_tpu.columnar.encoded import HostDictionaryColumn
+
         n = self.num_rows
         cap = bucket_capacity(n)
         parts: List[Tuple[str, np.ndarray, bool]] = []  # (group, seg, want_bool)
-        specs = []  # per column: ("fixed", dtype) | ("string",)
+        specs = []  # per column: ("fixed", dtype) | ("string",) | ("dict",)
         for hc in self.columns:
             validity = np.zeros(cap, dtype=bool)
             validity[:n] = hc.validity[:n]
-            if hc.dtype is DataType.STRING:
+            if isinstance(hc, HostDictionaryColumn):
+                # codes upload as fixed int32; the dictionary is interned
+                # and uploads (at most) once per process, not per batch
+                codes = np.zeros(cap, dtype=np.int32)
+                codes[:n] = np.where(hc.validity[:n], hc.data[:n], 0)
+                parts.append(("int32", codes, False))
+                parts.append(("uint8", validity.view(np.uint8), True))
+                specs.append(("dict", hc.dictionary))
+            elif hc.dtype is DataType.STRING:
                 encoded = [
                     s.encode("utf-8") if isinstance(s, str) else bytes(s)
                     for s in hc.data[:n]
@@ -437,6 +457,15 @@ class HostColumnarBatch:
                 ai += 3
                 cols.append(ColumnVector(DataType.STRING, buf, validity,
                                          offsets, max_len=spec[1]))
+            elif spec[0] == "dict":
+                from spark_rapids_tpu.columnar.encoded import (
+                    DictionaryColumn,
+                )
+
+                data, validity = arrays[ai], arrays[ai + 1]
+                ai += 2
+                cols.append(DictionaryColumn(hc.dtype, data, validity,
+                                             spec[1]))
             else:
                 data, validity = arrays[ai], arrays[ai + 1]
                 ai += 2
@@ -509,18 +538,38 @@ class ColumnarBatch:
         return [c.dtype for c in self.columns]
 
     def device_memory_size(self) -> int:
-        return sum(c.device_memory_size() for c in self.columns)
+        total = 0
+        seen_dicts = set()
+        for c in self.columns:
+            total += c.device_memory_size()
+            d = getattr(c, "dictionary", None)
+            if d is not None and d.did not in seen_dicts:
+                # each distinct dictionary's uploaded device footprint
+                # once per batch (cross-batch sharing of an interned
+                # dictionary is deliberately overcounted — spill/HBM
+                # accounting must never underestimate residency)
+                seen_dicts.add(d.did)
+                total += d.device_memory_size()
+        return total
 
     # -- download (reference: GpuColumnarToRowExec copyToRowHost) ------------
     def _download_plan(self):
         """(device arrays to fetch, n_or_None, trim) for this batch — the
-        first phase of to_host, shared with the batched to_host_many."""
+        first phase of to_host, shared with the batched to_host_many.
+        Encoded (dictionary) columns download their CODES only — the
+        dictionary's values already live on the host."""
+        from spark_rapids_tpu.columnar.encoded import is_encoded
+
         if self.rows_on_host:
             n = self.num_rows
             trim = min(self.capacity, bucket_capacity(max(n, 1)))
-        elif self.device_memory_size() <= (1 << 20):
-            # device count + small batch: ride the count inside the ONE
-            # packed transfer instead of paying a separate scalar round trip
+        elif sum(c.device_memory_size()
+                 for c in self.columns) <= (1 << 20):
+            # device count + small batch (DOWNLOAD bytes — dictionaries
+            # never download, so the residency-with-dictionaries figure
+            # would wrongly disqualify small encoded batches): ride the
+            # count inside the ONE packed transfer instead of paying a
+            # separate scalar round trip
             n = None
             trim = self.capacity
         else:
@@ -528,7 +577,7 @@ class ColumnarBatch:
             trim = min(self.capacity, bucket_capacity(max(n, 1)))
         arrays = []
         for cv in self.columns:
-            if cv.dtype is DataType.STRING:
+            if cv.dtype is DataType.STRING and not is_encoded(cv):
                 arrays.extend([cv.offsets[:trim + 1], cv.data,
                                cv.validity[:trim]])
             else:
@@ -538,9 +587,20 @@ class ColumnarBatch:
                                       dtype=jnp.int32).reshape(1))
         return arrays, n, trim
 
-    def _download_finish(self, host, offs, n, trim) -> HostColumnarBatch:
+    def _download_finish(self, host, offs, n, trim,
+                         keep_encoded: bool = False) -> HostColumnarBatch:
         """Reconstruct host columns from the grouped download buffers,
-        consuming segments at the shared per-dtype cursors `offs`."""
+        consuming segments at the shared per-dtype cursors `offs`.
+        Encoded columns arrive as codes: keep_encoded=True (the serialized
+        shuffle) keeps them as HostDictionaryColumn; otherwise they expand
+        here through the host dictionary — the result-sink form of late
+        materialization (the values never crossed the fence)."""
+        from spark_rapids_tpu.columnar.encoded import (
+            HostDictionaryColumn,
+            is_encoded,
+            materialize_host_values,
+        )
+
         def take(count, np_dtype):
             np_dtype = np.dtype(np_dtype)
             key = "uint8" if np_dtype == np.bool_ else np_dtype.name
@@ -554,7 +614,7 @@ class ColumnarBatch:
         # first (the count, when device-resident, rides LAST), then build
         raw = []
         for cv in self.columns:
-            if cv.dtype is DataType.STRING:
+            if cv.dtype is DataType.STRING and not is_encoded(cv):
                 raw.append((take(trim + 1, np.int32),
                             take(int(cv.data.shape[0]), np.uint8),
                             take(trim, np.bool_)))
@@ -566,7 +626,18 @@ class ColumnarBatch:
             self.num_rows = n
         out = []
         for cv, seg in zip(self.columns, raw):
-            if cv.dtype is DataType.STRING:
+            if is_encoded(cv):
+                codes = seg[0][:n].astype(np.int32)
+                validity = seg[1][:n]
+                codes = np.where(validity, codes, 0)
+                if keep_encoded:
+                    out.append(HostDictionaryColumn(
+                        cv.dtype, codes, validity, cv.dictionary))
+                else:
+                    strs = materialize_host_values(codes, validity,
+                                                   cv.dictionary)
+                    out.append(HostColumnVector(cv.dtype, strs, validity))
+            elif cv.dtype is DataType.STRING:
                 offsets, data, validity = seg
                 validity = validity[:n]
                 strs = np.empty(n, dtype=object)
@@ -621,13 +692,15 @@ DOWNLOAD_BYTE_BUDGET = 256 << 20
 
 
 def to_host_many(batches: Sequence["ColumnarBatch"],
-                 byte_budget: int = DOWNLOAD_BYTE_BUDGET
-                 ) -> List[HostColumnarBatch]:
+                 byte_budget: int = DOWNLOAD_BYTE_BUDGET,
+                 keep_encoded: bool = False) -> List[HostColumnarBatch]:
     """Download MANY device batches with one grouped transfer (one fence)
     per `byte_budget` worth of data — the collect/transition path would
     otherwise pay one ~66 ms round trip per batch on tunneled backends.
     Batches on different devices download in per-device groups (the
-    grouped pack program needs co-located inputs)."""
+    grouped pack program needs co-located inputs). keep_encoded=True (the
+    serialized shuffle) keeps dictionary columns as host CODES instead of
+    expanding them at the fence."""
     batches = [b if b.live is None else ensure_compact(b) for b in batches]
     out: List[Optional[HostColumnarBatch]] = [None] * len(batches)
     # per-device open group: dev_key -> (entries, bytes)
@@ -642,7 +715,8 @@ def to_host_many(batches: Sequence["ColumnarBatch"],
             _download_grouped(arrays)).items()}
         offs = {k: 0 for k in host}
         for bi, _segs, n, trim in group:
-            out[bi] = batches[bi]._download_finish(host, offs, n, trim)
+            out[bi] = batches[bi]._download_finish(
+                host, offs, n, trim, keep_encoded=keep_encoded)
 
     for bi, b in enumerate(batches):
         if not b.columns:
@@ -720,9 +794,15 @@ def _pad_array(arr, fill, new_cap: int):
 
 def repad_column(cv: ColumnVector, new_cap: int) -> ColumnVector:
     """Grow a column to a larger capacity bucket."""
+    from spark_rapids_tpu.columnar.encoded import is_encoded
+
     if cv.capacity == new_cap:
         return cv
     assert new_cap > cv.capacity
+    if is_encoded(cv):
+        return cv.with_codes(
+            _pad_array(cv.data, jnp.int32(0), new_cap),
+            _pad_array(cv.validity, False, new_cap))
     if cv.dtype is DataType.STRING:
         new_offsets = jnp.concatenate([
             cv.offsets,
@@ -745,7 +825,13 @@ def repad_column(cv: ColumnVector, new_cap: int) -> ColumnVector:
 
 
 def batch_to_device(b: "ColumnarBatch", dev) -> "ColumnarBatch":
-    """Move a batch's arrays onto one device."""
+    """Move a batch's arrays onto one device. Encoded columns decode
+    first (visible materialize): the shared dictionary's device arrays
+    are committed to the default device, and a cross-device code gather
+    would mix committed devices inside one program."""
+    from spark_rapids_tpu.columnar.encoded import decode_batch
+
+    b = decode_batch(b)
     cols = [ColumnVector(c.dtype, jax.device_put(c.data, dev),
                          jax.device_put(c.validity, dev),
                          None if c.offsets is None
@@ -792,7 +878,14 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     if len(batches) == 1:
         return ensure_compact(batches[0])
     batches = _same_device(batches)
-    has_string = any(c.dtype is DataType.STRING for c in batches[0].columns)
+    # encoded positions first align onto ONE shared dictionary (interned
+    # dictionaries make identity the common case); their codes then
+    # concatenate as ordinary fixed-width columns and re-wrap below
+    from spark_rapids_tpu.columnar.encoded import is_encoded
+
+    batches, enc_dicts = _align_encoded_positions(batches)
+    has_string = any(c.dtype is DataType.STRING and not is_encoded(c)
+                     for c in batches[0].columns)
     if has_string:
         # string concat is host-coordinated (byte totals); force host counts
         # and compact any live-masked views first
@@ -802,7 +895,8 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     all_plain = all(b.rows_on_host and b.live is None for b in batches)
     ncols = batches[0].num_columns
     fixed_idx = [ci for ci in range(ncols)
-                 if batches[0].columns[ci].dtype is not DataType.STRING]
+                 if ci in enc_dicts
+                 or batches[0].columns[ci].dtype is not DataType.STRING]
     out_cols: List[Optional[ColumnVector]] = [None] * ncols
     if all_plain:
         total = sum(b.num_rows for b in batches)
@@ -855,19 +949,63 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
             tuple(g_lives))
         _fill_out_cols(out_cols, fixed_idx, outs, batches)
     for ci in range(ncols):
-        if batches[0].columns[ci].dtype is DataType.STRING:
+        if ci in enc_dicts:
+            c = out_cols[ci]
+            from spark_rapids_tpu.columnar.encoded import DictionaryColumn
+
+            out_cols[ci] = DictionaryColumn(
+                batches[0].columns[ci].dtype, c.data, c.validity,
+                enc_dicts[ci])
+        elif batches[0].columns[ci].dtype is DataType.STRING:
             out_cols[ci] = _concat_string_cols(
                 [b.columns[ci] for b in batches],
                 [b.num_rows for b in batches], cap)
     return ColumnarBatch(out_cols, total, owned=True)
 
 
+def _align_encoded_positions(batches):
+    """Pre-pass for concat: per column position, either every batch is
+    encoded there (align dictionaries, possibly remapping codes into a
+    union) or none is (a mixed position materializes its encoded members
+    through the visible decode path). Returns (batches, {position:
+    shared DeviceDictionary})."""
+    from spark_rapids_tpu.columnar import encoded as ENC
+
+    ncols = batches[0].num_columns
+    flags = [[ENC.is_encoded(b.columns[ci]) for b in batches]
+             for ci in range(ncols)]
+    if not any(any(f) for f in flags):
+        return list(batches), {}
+    new_cols = [list(b.columns) for b in batches]
+    enc_dicts = {}
+    for ci in range(ncols):
+        if not any(flags[ci]):
+            continue
+        if not all(flags[ci]):
+            for bi, b in enumerate(batches):
+                if flags[ci][bi]:
+                    new_cols[bi][ci] = ENC.materialize(new_cols[bi][ci])
+            continue
+        shared, aligned = ENC.align_encoded(
+            [new_cols[bi][ci] for bi in range(len(batches))])
+        for bi in range(len(batches)):
+            new_cols[bi][ci] = aligned[bi]
+        enc_dicts[ci] = shared
+    out = [ColumnarBatch(cols, b.num_rows, live=b.live, owned=b.owned)
+           for cols, b in zip(new_cols, batches)]
+    return out, enc_dicts
+
+
 def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
     """Compact a live-masked shuffle view into a dense batch (single traced
-    scatter; row count stays a device scalar — still no sync)."""
+    scatter; row count stays a device scalar — still no sync). Encoded
+    columns compact their codes as fixed-width lanes."""
+    from spark_rapids_tpu.columnar.encoded import is_encoded
+
     if batch.live is None:
         return batch
-    if any(c.dtype is DataType.STRING for c in batch.columns):
+    if any(c.dtype is DataType.STRING and not is_encoded(c)
+           for c in batch.columns):
         # string view compaction: sync the mask and gather
         mask = np.asarray(jax.device_get(batch.live))
         rows = np.nonzero(mask)[0]
@@ -890,7 +1028,8 @@ def ensure_compact(batch: ColumnarBatch) -> ColumnarBatch:
         cap, 1, ((bkt, 1),), subcols, ncols,
         jnp.zeros((1, 1), jnp.int32), g_datas, g_valids,
         (live[None, :],))
-    cols = [ColumnVector(c.dtype, d, v, vrange=c.vrange)
+    cols = [c.with_codes(d, v) if is_encoded(c)
+            else ColumnVector(c.dtype, d, v, vrange=c.vrange)
             for c, (d, v) in zip(batch.columns, outs)]
     return ColumnarBatch(cols, total, owned=True)
 
@@ -1331,10 +1470,14 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
     is impossible. String columns never donate (their source bytes are
     re-read after the plan phase below).
     """
+    from spark_rapids_tpu.columnar.encoded import is_encoded
+
     cap = bucket_capacity(max(out_rows, 1))
     M.record_dispatch()
+    # encoded (dictionary) columns gather their int32 CODES like any
+    # fixed-width column — the dictionary rides along untouched
     fixed = [(i, cv) for i, cv in enumerate(batch.columns)
-             if cv.dtype is not DataType.STRING]
+             if is_encoded(cv) or cv.dtype is not DataType.STRING]
     cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
     if fixed:
         datas = tuple(cv.data for _, cv in fixed)
@@ -1345,12 +1488,16 @@ def gather_batch(batch: ColumnarBatch, indices, out_rows: int,
             _gather_fixed_cols(cap, datas, valids, indices,
                                indices_valid, np.int32(out_rows))
         for (i, cv), (data, validity) in zip(fixed, outs):
+            if is_encoded(cv):
+                cols[i] = cv.with_codes(data, validity)
+                continue
             # gathered values are a subset of the source (null lanes hold 0),
             # so the source range bound still holds
             cols[i] = ColumnVector(cv.dtype, data, validity,
                                    vrange=cv.vrange)
     sidx = [i for i, cv in enumerate(batch.columns)
-            if cv.dtype is DataType.STRING]
+            if cv.dtype is DataType.STRING and
+            not is_encoded(batch.columns[i])]
     if sidx:
         # plan every string column first so any byte totals still needed
         # come back in a single host transfer (one sync per gather at most)
@@ -1459,21 +1606,25 @@ def _gather_batch_traced(batch: ColumnarBatch, indices,
     input's (static), string byte capacity = the input byte buffer's
     (output bytes of a row-subset gather can never exceed it). No host
     sync anywhere."""
+    from spark_rapids_tpu.columnar.encoded import is_encoded
+
     cap = batch.capacity
     n32 = jnp.asarray(out_rows, dtype=jnp.int32)
     M.record_dispatch()
     fixed = [(i, cv) for i, cv in enumerate(batch.columns)
-             if cv.dtype is not DataType.STRING]
+             if is_encoded(cv) or cv.dtype is not DataType.STRING]
     cols: List[Optional[ColumnVector]] = [None] * batch.num_columns
     if fixed:
         datas = tuple(cv.data for _, cv in fixed)
         valids = tuple(cv.validity for _, cv in fixed)
         outs = _gather_fixed_cols(cap, datas, valids, indices, None, n32)
         for (i, cv), (data, validity) in zip(fixed, outs):
-            cols[i] = ColumnVector(cv.dtype, data, validity,
-                                   vrange=cv.vrange)
+            cols[i] = cv.with_codes(data, validity) if is_encoded(cv) \
+                else ColumnVector(cv.dtype, data, validity,
+                                  vrange=cv.vrange)
     sidx = [i for i, cv in enumerate(batch.columns)
-            if cv.dtype is DataType.STRING]
+            if cv.dtype is DataType.STRING and
+            not is_encoded(batch.columns[i])]
     for i in sidx:
         cv = batch.columns[i]
         starts, lengths, new_offsets, validity = _gather_string_plan_traced(

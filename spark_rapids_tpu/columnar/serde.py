@@ -57,6 +57,11 @@ _DTYPE_CODE = {
 _CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
 # DECIMAL(p,s): code 11, (p << 8) | s in the header's u16 extra field.
 _DECIMAL_CODE = 11
+# Dictionary-encoded STRING: code 12. Payload after the validity bits is
+# codes int32[n], then ndv u32, dict offsets int32[ndv+1], dict utf-8
+# bytes — ONE dictionary copy per piece instead of n expanded strings
+# (columnar/encoded.py; the compressed-shuffle representation).
+_DICT_STRING_CODE = 12
 
 
 def _dtype_code(dt):
@@ -72,6 +77,39 @@ def _code_dtype(code: int, extra: int):
 
 _HEADER = struct.Struct("<4sII")
 _COLHDR = struct.Struct("<BBHQ")
+
+
+def _dict_used_codes(col, n: int, validity: np.ndarray) -> np.ndarray:
+    """Sorted distinct codes the piece actually references (per-piece
+    dictionary PRUNING): a shuffle piece holding a slice of the rows must
+    not pay the WHOLE dictionary — only the entries its rows carry. The
+    codes on the wire re-base into the pruned table's index space."""
+    if n == 0 or not validity.any():
+        return np.empty(0, dtype=np.int32)
+    codes = np.asarray(col.data[:n], dtype=np.int32)
+    return np.unique(codes[validity[:n]]).astype(np.int32)
+
+
+def _pruned_dict_piece(col, n: int, validity: np.ndarray):
+    """(rebased codes int32[n], pruned offsets int32[u+1], pruned bytes)
+    for one HostDictionaryColumn piece."""
+    d = col.dictionary
+    used = _dict_used_codes(col, n, validity)
+    codes = np.ascontiguousarray(col.data[:n], dtype=np.int32)
+    if len(used):
+        rebased = np.searchsorted(used, codes).astype(np.int32)
+        codes = np.where(validity[:n], rebased, np.int32(0))
+    else:
+        codes = np.zeros(n, dtype=np.int32)
+    lens = d.host_lens[used] if len(used) else np.empty(0, np.int32)
+    offs = np.zeros(len(used) + 1, dtype=np.int32)
+    if len(used):
+        np.cumsum(lens, out=offs[1:])
+    out = np.empty(int(offs[-1]), dtype=np.uint8)
+    src_o = d.host_offsets
+    for i, c in enumerate(used):
+        out[offs[i]:offs[i + 1]] = d.host_bytes[src_o[c]:src_o[c + 1]]
+    return codes, offs, out
 
 
 def _string_payload(col: HostColumnVector, n: int) -> List[bytes]:
@@ -99,6 +137,8 @@ def _string_payload(col: HostColumnVector, n: int) -> List[bytes]:
 
 def serialize_batch(batch: HostColumnarBatch) -> bytes:
     """Host batch -> bytes (reference: JCudfSerialization.writeToStream)."""
+    from spark_rapids_tpu.columnar.encoded import HostDictionaryColumn
+
     n = batch.num_rows
     parts: List[bytes] = []
     headers: List[bytes] = []
@@ -106,6 +146,18 @@ def serialize_batch(batch: HostColumnarBatch) -> bytes:
         validity = np.ascontiguousarray(col.validity[:n], dtype=bool)
         vbits = np.packbits(validity, bitorder="little").tobytes()
         payload: List[bytes] = [vbits]
+        if isinstance(col, HostDictionaryColumn):
+            codes, offs, dbytes = _pruned_dict_piece(col, n, validity)
+            payload.extend([
+                codes.tobytes(),
+                struct.pack("<I", len(offs) - 1),
+                offs.tobytes(),
+                dbytes.tobytes(),
+            ])
+            plen = sum(len(p) for p in payload)
+            headers.append(_COLHDR.pack(_DICT_STRING_CODE, 1, 0, plen))
+            parts.extend(payload)
+            continue
         if col.dtype is DataType.STRING:
             payload.extend(_string_payload(col, n))
         else:
@@ -134,15 +186,38 @@ def deserialize_batch(buf: bytes) -> HostColumnarBatch:
     for _ in range(ncols):
         code, _nullable, extra, plen = _COLHDR.unpack_from(mv, off)
         off += _COLHDR.size
-        col_meta.append((_code_dtype(code, extra), plen))
+        col_meta.append((code, extra, plen))
     vbytes = (n + 7) // 8
     cols: List[HostColumnVector] = []
-    for dt, plen in col_meta:
+    for code, extra, plen in col_meta:
+        dt = DataType.STRING if code == _DICT_STRING_CODE else \
+            _code_dtype(code, extra)
         end = off + plen
         validity = np.unpackbits(
             np.frombuffer(mv, dtype=np.uint8, count=vbytes, offset=off),
             bitorder="little")[:n].astype(bool)
         doff = off + vbytes
+        if code == _DICT_STRING_CODE:
+            from spark_rapids_tpu.columnar.encoded import (
+                DeviceDictionary,
+                HostDictionaryColumn,
+            )
+
+            codes = np.frombuffer(mv, dtype=np.int32, count=n,
+                                  offset=doff).copy()
+            p = doff + 4 * n
+            (ndv,) = struct.unpack_from("<I", mv, p)
+            p += 4
+            offsets = np.frombuffer(mv, dtype=np.int32, count=ndv + 1,
+                                    offset=p).copy()
+            p += 4 * (ndv + 1)
+            dbytes = np.frombuffer(mv, dtype=np.uint8,
+                                   count=int(offsets[ndv]),
+                                   offset=p).copy()
+            d = DeviceDictionary.from_byte_table(dbytes, offsets)
+            cols.append(HostDictionaryColumn(dt, codes, validity, d))
+            off = end
+            continue
         if dt is DataType.STRING:
             offsets = np.frombuffer(mv, dtype=np.int32, count=n + 1,
                                     offset=doff)
@@ -165,11 +240,19 @@ def deserialize_batch(buf: bytes) -> HostColumnarBatch:
 
 def serialized_size(batch: HostColumnarBatch) -> int:
     """Exact size of serialize_batch(batch) without building the bytes."""
+    from spark_rapids_tpu.columnar.encoded import HostDictionaryColumn
+
     n = batch.num_rows
     total = _HEADER.size + _COLHDR.size * len(batch.columns)
     for col in batch.columns:
         total += (n + 7) // 8
-        if col.dtype is DataType.STRING:
+        if isinstance(col, HostDictionaryColumn):
+            used = _dict_used_codes(col, n, np.asarray(col.validity,
+                                                      dtype=bool))
+            dict_bytes = int(col.dictionary.host_lens[used].sum()) \
+                if len(used) else 0
+            total += 4 * n + 4 + 4 * (len(used) + 1) + dict_bytes
+        elif col.dtype is DataType.STRING:
             total += 4 * (n + 1)
             total += sum(
                 len(v.encode("utf-8")) if isinstance(v, str) else len(v)

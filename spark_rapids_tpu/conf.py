@@ -851,6 +851,32 @@ MICRO_BATCH_MAX_QUERIES = _conf(
     "window closes early once this many have joined."
 ).check(lambda v: None if v >= 2 else "must be >= 2").integer(8)
 
+# ---------------------------------------------------------------------------
+# Encoded (compressed) columnar execution (columnar/encoded.py,
+# docs/compressed-execution.md)
+# ---------------------------------------------------------------------------
+ENCODED_ENABLED = _conf("rapids.tpu.sql.encoded.enabled").doc(
+    "Keep dictionary-encoded parquet STRING columns ENCODED in HBM as "
+    "int32 codes plus one shared device dictionary, and compute on the "
+    "codes: equality/IN/IS NULL filters rewrite their literals into code "
+    "space once per dictionary, hash aggregates group directly on codes "
+    "(the dictionary is gathered only at finalize/sink), hash joins on "
+    "dictionary keys align the two sides through a build-time code-remap "
+    "table, and the serialized shuffle ships codes + one dictionary copy "
+    "per piece instead of expanded strings. Every other consumer decodes "
+    "at its operator boundary through the explicit materialize() path "
+    "(metrics: encodedColumns / lateMaterializations / "
+    "encodedBytesSaved)."
+).boolean(True)
+
+ENCODED_MAX_DICT_FRACTION = _conf("rapids.tpu.sql.encoded.maxDictFraction").doc(
+    "Per-column opt-in heuristic for encoded scan output: a "
+    "dictionary-encoded column chunk stays encoded only when its "
+    "dictionary size / row count is at or below this fraction (a "
+    "near-unique column gains nothing from codes and would pay the "
+    "dictionary residency twice)."
+).check(lambda v: None if 0.0 < v <= 1.0 else "must be in (0,1]").double(0.5)
+
 
 class TpuConf:
     """Resolved view of the settings map (reference: RapidsConf class).

@@ -182,20 +182,32 @@ class RetryPolicy:
 _POLICY = RetryPolicy()
 
 
-def set_policy_from_conf(tpu_conf: "C.TpuConf") -> None:
-    """Refresh the process retry policy from the executing session's conf
-    (called at every query start, like conf.sync_int64_narrowing)."""
+def set_policy_from_conf(tpu_conf: "C.TpuConf", ctx=None) -> None:
+    """Refresh the retry policy from the executing session's conf (called
+    at every query start, like conf.sync_int64_narrowing). With a
+    QueryContext the policy is ADDITIONALLY scoped to that query
+    (docs/serving.md): every combinator reads `policy()`, which prefers
+    the ambient context's policy — so one tenant tuning its backoff/
+    retry knobs cannot leak them into another tenant's concurrently
+    running query. The process-global slot is still set (last writer
+    wins) for direct callers outside any query context."""
     global _POLICY
-    _POLICY = RetryPolicy(
+    pol = RetryPolicy(
         oom_retries=tpu_conf.get(C.RETRY_OOM_RETRIES),
         transient_retries=tpu_conf.get(C.RETRY_TRANSIENT_RETRIES),
         max_split_depth=tpu_conf.get(C.RETRY_MAX_SPLIT_DEPTH),
         backoff_ms=tpu_conf.get(C.RETRY_BACKOFF_MS),
         cpu_fallback=tpu_conf.get(C.CPU_FALLBACK_ENABLED),
     )
+    _POLICY = pol
+    if ctx is not None:
+        ctx.retry_policy = pol
 
 
 def policy() -> RetryPolicy:
+    ctx = M.current_query_ctx()
+    if ctx is not None and ctx.retry_policy is not None:
+        return ctx.retry_policy
     return _POLICY
 
 
@@ -207,7 +219,7 @@ def deterministic_jitter(*identity) -> float:
 
 
 def backoff_sleep(attempt: int, *identity) -> None:
-    base = _POLICY.backoff_ms
+    base = policy().backoff_ms
     if base <= 0:
         return
     delay_ms = base * (2 ** attempt) * (0.5 + deterministic_jitter(
@@ -249,7 +261,7 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
     TpuAsyncSinkError for the session's checked replay."""
     from spark_rapids_tpu.utils import faultinject as FI
 
-    pol = _POLICY
+    pol = policy()
     oom_left = pol.oom_retries
     transient_left = pol.transient_retries
     attempt_no = 0
@@ -338,7 +350,7 @@ def split_and_retry(batch_fn: Callable, batch, site: str = "device",
         try:
             return [batch_fn(piece, off)]
         except TpuSplitAndRetryOOM:
-            if depth >= _POLICY.max_split_depth:
+            if depth >= policy().max_split_depth:
                 raise
             left, right, mid = split_batch_halves(piece)
             M.record_split_retry()
@@ -360,7 +372,7 @@ def device_op_with_fallback(batch_fn: Callable, batch,
     of work (None = no per-batch fallback; exhaustion propagates for
     query-level handling). Returns a list of device output batches."""
     breaker = CircuitBreaker.get()
-    if cpu_fn is not None and _POLICY.cpu_fallback and breaker.is_open():
+    if cpu_fn is not None and policy().cpu_fallback and breaker.is_open():
         return [_run_cpu_fallback(cpu_fn, batch, row_offset)]
     try:
         return split_and_retry(batch_fn, batch, site=site,
@@ -376,7 +388,7 @@ def device_op_with_fallback(batch_fn: Callable, batch,
             # replay owns this failure
             raise
         breaker.record_failure()
-        if cpu_fn is None or not _POLICY.cpu_fallback:
+        if cpu_fn is None or not policy().cpu_fallback:
             raise
         import logging
 

@@ -174,6 +174,12 @@ def _pack_device_table(mesh, per_part, ordinals, attrs, cap: int):
     for batches in per_part:
         kept = []
         for b in batches:
+            from spark_rapids_tpu.columnar.encoded import decode_batch
+
+            # tpulint: eager-materialize -- the SPMD stage program
+            # assembles raw fixed/string matrices: sanctioned
+            # stage-input boundary decode
+            b = decode_batch(b)
             kept.append(ColumnarBatch(
                 [b.columns[ci] for ci in ordinals], b.num_rows,
                 live=b.live))

@@ -354,11 +354,14 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
         return ColumnarBatch(cols, num_groups)
 
     def _build_merge_kernel(self, n_keys: int, lazy: bool,
-                            n_chunks: int = 0):
+                            n_chunks: int = 0, enc_sig: tuple = ()):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
         ops = [op for op, _ in self._merge_ops()]
-        key = ("agg_merge", lazy, n_keys, n_chunks, tuple(ops),
+        # enc_sig: ordinals of ENCODED key columns — those lanes arrive as
+        # int32 codes (columnar/encoded.py), a different traced program
+        # than the expanded-string flavor under the same inter schema
+        key = ("agg_merge", lazy, n_keys, n_chunks, tuple(ops), enc_sig,
                tuple(a.data_type for a in self._inter_attrs))
         buffer_npdts = tuple(physical_np_dtype(a.data_type)
                              for a in self.buffer_attrs)
@@ -544,17 +547,35 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             return jnp.asarray(n, dtype=jnp.int32)
 
         def merge(batch: ColumnarBatch) -> ColumnarBatch:
+            from spark_rapids_tpu.columnar import encoded as ENC
+
+            # encoded KEY columns merge on their codes (concat already
+            # aligned every piece onto one dictionary per position); any
+            # encoded non-key column decodes at this boundary
+            stray = tuple(i for i in range(n_keys, batch.num_columns)
+                          if ENC.is_encoded(batch.columns[i]))
+            if stray:
+                # tpulint: eager-materialize -- merge-side BUFFER
+                # columns have no code-space reduction; keys stay codes
+                batch = ENC.batch_with_materialized(batch, stray)
+            enc_keys = {i: batch.columns[i].dictionary
+                        for i in range(min(n_keys, batch.num_columns))
+                        if ENC.is_encoded(batch.columns[i])}
+            enc_sig = tuple(sorted(enc_keys))
             nc = str_chunks(batch, str_merge_ords)
             # capture the kernel in a local: the memo slot is shared by
             # concurrent partition tasks, and _attempt must dispatch the
             # kernel THIS batch's key selected, not whatever a racing
             # task installed meanwhile
             memo = merge_kernel[0]
-            if memo is None or memo[0] != nc:
-                memo = (nc, self._build_merge_kernel(n_keys, lazy, nc))
+            if memo is None or memo[0] != (nc, enc_sig):
+                memo = ((nc, enc_sig),
+                        self._build_merge_kernel(n_keys, lazy, nc,
+                                                 enc_sig))
                 merge_kernel[0] = memo
             kern = memo[1]
-            cols = [_col_to_colv(c) for c in batch.columns]
+            cols = ENC.eval_cols(batch, frozenset(enc_keys)) if enc_keys \
+                else [_col_to_colv(c) for c in batch.columns]
             kvr = [c.vrange for c in batch.columns[:n_keys]]
 
             def _attempt():
@@ -564,9 +585,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             out = with_retry(_attempt, site="agg.merge")
             if lazy:
                 outs, num_groups = out
-                return self._lazy_batch(outs, num_groups, kvr)
-            k, b, gi = out
-            return self._assemble(k, b, gi, batch.capacity, kvr)
+                merged = self._lazy_batch(outs, num_groups, kvr)
+            else:
+                k, b, gi = out
+                merged = self._assemble(k, b, gi, batch.capacity, kvr)
+            return ENC.wrap_batch_cols(merged, enc_keys)
 
         # un-compacted (lazy) update output keeps the INPUT batch capacity;
         # past the exchange's zero-copy piece cap that re-introduces the
@@ -588,12 +611,44 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             )
 
             kvr_cache: Dict[tuple, list] = {}
+            enc_plan_memo: Dict[tuple, object] = {}
             running: Optional[ColumnarBatch] = None
             for batch in child_pb.iterator(pidx):
                 if batch.rows_on_host and batch.num_rows == 0:
                     continue
                 batch = ensure_compact(batch)
                 if do_update:
+                    from spark_rapids_tpu.columnar import encoded as ENC
+
+                    # encoded columns group directly on their CODES when
+                    # their only uses are bare grouping keys + code-space
+                    # filters (columnar/encoded.py); aggregate-input uses
+                    # decode here, visibly
+                    ekey = ENC.enc_sig(batch)
+                    if ekey in enc_plan_memo:
+                        enc_plan = enc_plan_memo[ekey]
+                    else:
+                        # memoized per encoded signature — the sig fully
+                        # determines the retyped attrs/keys/filters
+                        # (dictionaries are interned)
+                        enc_plan = enc_plan_memo[ekey] = \
+                            ENC.plan_agg_update(
+                                batch, child_attrs, key_exprs,
+                                input_exprs, filters)
+                    if enc_plan is not None:
+                        # tpulint: eager-materialize -- aggregate
+                        # INPUT expressions (sum/min over the
+                        # column) need values; keys stay codes
+                        batch = ENC.batch_with_materialized(
+                            batch, enc_plan.mat_ords)
+                        eff_attrs = enc_plan.attrs
+                        eff_keys = enc_plan.key_exprs
+                        eff_filters = enc_plan.filters
+                        enc_sig = enc_plan.sig
+                    else:
+                        eff_attrs, eff_keys, eff_filters = \
+                            child_attrs, key_exprs, filters
+                        enc_sig = ()
                     nc = str_chunks(batch, str_update_ords)
                     b_lazy = update_lazy and \
                         batch.capacity * inter_width <= lazy_out_cap_bytes
@@ -609,14 +664,17 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     # on a batch whose owner never consented — silent
                     # buffer consumption, not just a shape error
                     memo = update_kernel[0]
-                    if memo is None or memo[0] != (nc, b_lazy, b_donate):
-                        memo = ((nc, b_lazy, b_donate),
+                    if memo is None or \
+                            memo[0] != (nc, b_lazy, b_donate, enc_sig):
+                        memo = ((nc, b_lazy, b_donate, enc_sig),
                                 self._build_update_kernel(
-                            child_attrs, key_exprs, input_exprs, op_names,
-                            filters, b_lazy, nc, donate=b_donate))
+                            eff_attrs, eff_keys, input_exprs, op_names,
+                            eff_filters, b_lazy, nc, donate=b_donate))
                         update_kernel[0] = memo
                     kern = memo[1]
-                    cols = [_col_to_colv(c) for c in batch.columns]
+                    cols = ENC.eval_cols(
+                        batch, enc_plan.code_ords) if enc_plan is not None \
+                        else [_col_to_colv(c) for c in batch.columns]
                     if not cols:
                         cols = [_synth_col(batch)]
                     if b_donate:
@@ -645,6 +703,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                         k, b, gi = out
                         local = self._assemble(k, b, gi, batch.capacity,
                                                kvr)
+                    if enc_plan is not None and enc_plan.key_dicts:
+                        # code-grouped keys wrap back into encoded columns
+                        # (the dictionary gathers only at finalize/sink)
+                        local = ENC.wrap_batch_cols(local,
+                                                    enc_plan.key_dicts)
                     # a fresh update output has unique keys already
                     if running is None:
                         running = local

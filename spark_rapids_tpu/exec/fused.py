@@ -189,14 +189,101 @@ class TpuFusedStageExec(TpuExec):
             k not in ("filter", "limit")
             for k in kinds[kinds.index("expand") + 1:])
         self._programs = {}
+        # encoded-input stage plans keyed by (ordinal, dictionary) sig
+        self._enc_cache: dict = {}
 
-    def _program(self, variant: int, donated: bool = False):
-        from spark_rapids_tpu.engine.jit_cache import get_or_build
+    # -- encoded-input planning (columnar/encoded.py) -------------------------
+    def _ord_stays_encoded(self, o: int) -> bool:
+        """Can input ordinal `o` flow through the whole member chain as
+        CODES? Its running positions must only be passed through bare by
+        projects or consumed by code-space-supported predicates."""
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.ops.base import Alias, BoundReference
 
-        cached = self._programs.get((variant, donated))
+        pos = {o}
+        for op in self._ops:
+            if op.kind == "filter":
+                if ENC.bound_supported_refs([op.bound], pos) != pos:
+                    return False
+            elif op.kind == "project":
+                newpos = set()
+                others = []
+                for i, e in enumerate(op.bound):
+                    inner = e.child if isinstance(e, Alias) else e
+                    if isinstance(inner, BoundReference) and \
+                            inner.ordinal in pos:
+                        newpos.add(i)
+                        continue
+                    others.append(e)
+                if ENC.bound_supported_refs(others, pos) != pos:
+                    return False
+                pos = newpos
+                if not pos:
+                    return True  # column dropped: nothing left to misuse
+            elif op.kind == "expand":
+                # expand variants would need per-variant encoded schemas;
+                # decode at the stage boundary instead
+                return False
+        return True
+
+    def _enc_ops_for(self, batch: ColumnarBatch):
+        """(rewritten ops, enc_sig, code ordinals, materialize ordinals,
+        output position -> dictionary) for a batch with encoded columns,
+        cached per (ordinal, dictionary) signature."""
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.columnar.dtypes import DataType as DT
+        from spark_rapids_tpu.ops.base import Alias, BoundReference
+
+        enc = {i: c for i, c in enumerate(batch.columns)
+               if ENC.is_encoded(c)}
+        sig = tuple(sorted((i, c.dictionary.did) for i, c in enc.items()))
+        cached = self._enc_cache.get(sig)
         if cached is not None:
             return cached
-        ops = self._ops
+        kept = {o for o in enc if self._ord_stays_encoded(o)}
+        mat = tuple(sorted(set(enc) - kept))
+        pos2ord = {o: o for o in kept}
+        ops2: List[_StageOp] = []
+        for op in self._ops:
+            dicts = {p: enc[pos2ord[p]].dictionary for p in pos2ord}
+            if op.kind == "filter":
+                ops2.append(_StageOp("filter", ENC.rewrite_bound_condition(
+                    op.bound, dicts) if dicts else op.bound))
+            elif op.kind == "project":
+                newmap = {}
+                exprs2 = []
+                for i, e in enumerate(op.bound):
+                    inner = e.child if isinstance(e, Alias) else e
+                    if isinstance(inner, BoundReference) and \
+                            inner.ordinal in pos2ord:
+                        ref2 = BoundReference(inner.ordinal, DT.INT32,
+                                              inner.nullable)
+                        exprs2.append(
+                            Alias(ref2, e.name, e.expr_id)
+                            if isinstance(e, Alias) else ref2)
+                        newmap[i] = pos2ord[inner.ordinal]
+                        continue
+                    exprs2.append(ENC.rewrite_bound_condition(e, dicts)
+                                  if dicts else e)
+                ops2.append(_StageOp("project", exprs2))
+                pos2ord = newmap
+            else:
+                ops2.append(op)
+        out_enc = {p: enc[o].dictionary for p, o in pos2ord.items()}
+        plan = (ops2, sig, frozenset(kept), mat, out_enc)
+        self._enc_cache[sig] = plan
+        while len(self._enc_cache) > 64:
+            self._enc_cache.pop(next(iter(self._enc_cache)))
+        return plan
+
+    def _program(self, variant: int, donated: bool = False, ops=None,
+                 enc_sig: tuple = ()):
+        from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+        cached = self._programs.get((variant, donated, enc_sig))
+        if cached is not None:
+            return cached
+        ops = self._ops if ops is None else ops
         key = ("fused_stage", tuple(op.fingerprint() for op in ops), variant)
 
         def build(donate_argnums=()):
@@ -246,7 +333,9 @@ class TpuFusedStageExec(TpuExec):
 
         built = get_or_build(key, build,
                              donate_argnums=(0,) if donated else ())
-        self._programs[(variant, donated)] = built
+        self._programs[(variant, donated, enc_sig)] = built
+        while len(self._programs) > 128:
+            self._programs.pop(next(iter(self._programs)))
         return built
 
     # -- execution ------------------------------------------------------------
@@ -301,8 +390,24 @@ class TpuFusedStageExec(TpuExec):
             )
             from spark_rapids_tpu.ops.eval import cpu_filter, cpu_project
 
-            def prep_cols(b: ColumnarBatch):
-                cols = [_col_to_colv(c) for c in b.columns]
+            def prep(b: ColumnarBatch):
+                """(batch, eval cols, rewritten ops or None, enc sig,
+                output-position -> dictionary). Encoded inputs keep their
+                codes through the composed program wherever the chain
+                allows; anything else decodes at the stage boundary."""
+                from spark_rapids_tpu.columnar import encoded as ENC
+
+                ops2, sig, out_enc = None, (), {}
+                if ENC.encoded_ordinals(b):
+                    ops2, sig, code_ords, mat, out_enc = \
+                        self._enc_ops_for(b)
+                    # tpulint: eager-materialize -- stage-boundary
+                    # decode for members that need values (non-
+                    # equality predicates, computed projections)
+                    b = ENC.batch_with_materialized(b, mat)
+                    cols = ENC.eval_cols(b, code_ords)
+                else:
+                    cols = [_col_to_colv(c) for c in b.columns]
                 if not cols:
                     cap = bucket_capacity(max(b.host_rows(), 1))
                     # tpulint: eager-jnp, untracked-alloc -- zero-column
@@ -310,11 +415,28 @@ class TpuFusedStageExec(TpuExec):
                     cols = [ColV(DataType.BOOL,
                                  jnp.zeros((cap,), dtype=bool),
                                  jnp.arange(cap) < b.num_rows)]
-                return cols
+                return b, cols, ops2, sig, out_enc
+
+            def wrap_out(outs, rows, owned, out_enc):
+                from spark_rapids_tpu.columnar.encoded import (
+                    DictionaryColumn,
+                )
+
+                cols = []
+                for i, o in enumerate(outs):
+                    c = _colv_to_col(o)
+                    d = out_enc.get(i)
+                    if d is not None:
+                        c = DictionaryColumn(DataType.STRING, c.data,
+                                             c.validity, d)
+                    cols.append(c)
+                return ColumnarBatch(cols, rows, owned=owned)
 
             def dispatch_variant(variant, cols, n, pidx, row_start,
-                                 remaining, donated=False):
-                jitted, msgs = self._program(variant, donated)
+                                 remaining, donated=False, ops=None,
+                                 enc_sig=()):
+                jitted, msgs = self._program(variant, donated, ops=ops,
+                                             enc_sig=enc_sig)
 
                 def _attempt():
                     M.record_dispatch()
@@ -341,26 +463,25 @@ class TpuFusedStageExec(TpuExec):
                     TpuDeviceManager,
                 )
 
-                cols = prep_cols(b)
-                n = jnp.asarray(b.num_rows, dtype=jnp.int32)
+                b2, cols, ops2, enc_sig, out_enc = prep(b)
+                n = jnp.asarray(b2.num_rows, dtype=jnp.int32)
                 # the stage consumes its input exactly once, so an OWNED
                 # input batch donates its buffers into the stage program
                 # (docs/async-execution.md); failures then escalate to the
                 # checked replay instead of re-dispatching in place
-                donated = AX.donation_active() and b.owned
+                donated = AX.donation_active() and b2.owned
                 if donated:
                     TpuDeviceManager.get().note_donation(
-                        b.device_memory_size())
+                        b2.device_memory_size())
                 outs, live, _lp = dispatch_variant(
                     0, cols, n, pidx, row_start + off, None,
-                    donated=donated)
+                    donated=donated, ops=ops2, enc_sig=enc_sig)
 
                 def finish():
                     # ownership propagates: outputs are fresh kernel
                     # buffers (identity pass-throughs alias the consumed
                     # input, which only an owned input may hand on)
-                    out = ColumnarBatch([_colv_to_col(o) for o in outs],
-                                        b.num_rows, owned=b.owned)
+                    out = wrap_out(outs, b2.num_rows, b2.owned, out_enc)
                     if self._row_changing:
                         order, nk = compact_plan(live, n)
                         # tpulint: host-sync -- policy-gated stage-exit
@@ -420,7 +541,7 @@ class TpuFusedStageExec(TpuExec):
                 # transient backoff); exhaustion propagates for task-level
                 # retry / query-level CPU fallback — mid-variant splits
                 # would corrupt the cross-batch LIMIT budget
-                cols = prep_cols(batch)
+                batch, cols, ops2, enc_sig, out_enc = prep(batch)
                 n = jnp.asarray(batch.num_rows, dtype=jnp.int32)
                 order = n_keep = None
                 for variant in range(self._n_variants):
@@ -428,9 +549,9 @@ class TpuFusedStageExec(TpuExec):
                         break
                     with M.trace_range("TpuFusedStage", total_time):
                         outs, live, limit_passed = dispatch_variant(
-                            variant, cols, n, pidx, row_start, remaining)
-                    out = ColumnarBatch([_colv_to_col(o) for o in outs],
-                                        batch.num_rows)
+                            variant, cols, n, pidx, row_start, remaining,
+                            ops=ops2, enc_sig=enc_sig)
+                    out = wrap_out(outs, batch.num_rows, False, out_enc)
                     if self._row_changing:
                         if order is None or not self._live_shared:
                             order, nk = compact_plan(live, n)

@@ -246,12 +246,17 @@ class _DeviceJoiner:
 
         return jax.jit(kernel)
 
-    def plan(self, stream: ColumnarBatch, build: ColumnarBatch):
+    def plan(self, stream: ColumnarBatch, build: ColumnarBatch,
+             s_cols=None, b_cols=None):
         if self._jitted is None:
             self._jitted = self._build()
-        s_cols = [_col_to_colv(c) for c in stream.columns] or \
-            [_synth(stream)]
-        b_cols = [_col_to_colv(c) for c in build.columns] or [_synth(build)]
+        if s_cols is None:
+            s_cols = [_col_to_colv(c) for c in stream.columns]
+        if b_cols is None:
+            b_cols = [_col_to_colv(c) for c in build.columns]
+        s_cols = s_cols or [_synth(stream)]
+        b_cols = b_cols or [_synth(build)]
+
         def cnt(b):
             n = b.num_rows
             if isinstance(n, (int, np.integer)):
@@ -287,6 +292,123 @@ class _TpuJoinMixin:
         mode = st._stream_mode
         joiner = _DeviceJoiner(stream_keys, build_keys, stream_attrs,
                                build_attrs, mode)
+        # encoded-key joining (columnar/encoded.py): key positions where
+        # BOTH sides reference an encoded column join on CODES — the
+        # stream side's codes rewrite into the build dictionary's space
+        # through a build-time remap table (values absent from the build
+        # side map to -1, which can never match). Mixed/unsupported uses
+        # decode at this boundary; emit gathers from the ORIGINAL batches
+        # so pass-through encoded columns stay encoded in the output.
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.ops.base import (
+            AttributeReference as _Attr,
+        )
+
+        def _bare_ord(e, attrs):
+            if isinstance(e, _Attr):
+                for i, a in enumerate(attrs):
+                    if a.expr_id == e.expr_id:
+                        return i
+            return None
+
+        def _ref_ords(exprs, attrs):
+            eids = {r.expr_id for e in exprs
+                    for r in e.collect(lambda x: isinstance(x, _Attr))}
+            return {i for i, a in enumerate(attrs) if a.expr_id in eids}
+
+        _cands = [(kp, _bare_ord(sk, stream_attrs),
+                   _bare_ord(bk, build_attrs))
+                  for kp, (sk, bk) in enumerate(zip(stream_keys,
+                                                    build_keys))]
+        _s_key_refs = _ref_ords(stream_keys, stream_attrs)
+        _b_key_refs = _ref_ords(build_keys, build_attrs)
+        # ordinals referenced inside a NON-bare key expression need the
+        # VALUES there — a column used both as a bare key and inside a
+        # computed key must materialize, not code-join
+        _s_nonbare = _ref_ords(
+            [sk for sk in stream_keys
+             if _bare_ord(sk, stream_attrs) is None], stream_attrs)
+        _b_nonbare = _ref_ords(
+            [bk for bk in build_keys
+             if _bare_ord(bk, build_attrs) is None], build_attrs)
+        _b_enc = set(ENC.encoded_ordinals(build))
+        _enc_joiners: dict = {}
+        _build_forms: dict = {}
+
+        def _retyped(attrs, ords):
+            out = list(attrs)
+            for i in ords:
+                a = attrs[i]
+                out[i] = AttributeReference(a.name, DataType.INT32,
+                                            a.nullable, a.expr_id)
+            return out
+
+        def _retype_keys(keys, attrs2, attrs):
+            out = []
+            for e in keys:
+                o = _bare_ord(e, attrs)
+                out.append(attrs2[o] if o is not None else e)
+            return out
+
+        def _prep_pair(stream_batch):
+            """(joiner, stream batch, s_cols, b_cols) with encoded keys in
+            code space and unsupported encoded key uses decoded."""
+            s_enc = set(ENC.encoded_ordinals(stream_batch))
+            if not s_enc and not _b_enc:
+                return joiner, stream_batch, None, None
+            subs = [(kp, so, bo) for kp, so, bo in _cands
+                    if so is not None and bo is not None
+                    and so in s_enc and bo in _b_enc
+                    and so not in _s_nonbare and bo not in _b_nonbare]
+            # one stream ordinal joined against build columns with
+            # DIFFERENT dictionaries cannot share one remap: those
+            # positions fall back to value comparison
+            by_so: dict = {}
+            for _kp, so, bo in subs:
+                by_so.setdefault(so, set()).add(
+                    build.columns[bo].dictionary.did)
+            subs = [t for t in subs if len(by_so[t[1]]) == 1]
+            sub_s = {so: bo for _kp, so, bo in subs}
+            sub_b = {bo for _kp, _so, bo in subs}
+            s_mat = tuple(sorted((_s_key_refs & s_enc) - set(sub_s)))
+            b_mat = frozenset((_b_key_refs & _b_enc) - sub_b)
+            # tpulint: eager-materialize -- a key encoded on ONE side
+            # only (or used non-bare) must compare as values
+            stream_batch = ENC.batch_with_materialized(stream_batch, s_mat)
+            form = _build_forms.get(b_mat)
+            if form is None:
+                # tpulint: eager-materialize -- build-side key encoded
+                # on one side only: compare as values (cached per form)
+                beval = ENC.batch_with_materialized(build, b_mat)
+                b_cols = []
+                for i, c in enumerate(beval.columns):
+                    b_cols.append(ENC.codes_colv(c) if ENC.is_encoded(c)
+                                  else _col_to_colv(c))
+                form = _build_forms[b_mat] = b_cols
+            b_cols = form
+            s_cols = []
+            for i, c in enumerate(stream_batch.columns):
+                if ENC.is_encoded(c):
+                    if i in sub_s:
+                        bd = build.columns[sub_s[i]].dictionary
+                        remap = ENC.join_remap(c.dictionary, bd)
+                        s_cols.append(ENC.remapped_codes_colv(c, remap))
+                    else:
+                        s_cols.append(ENC.codes_colv(c))
+                else:
+                    s_cols.append(_col_to_colv(c))
+            jkey = tuple(sorted(kp for kp, _s, _b in subs))
+            jv = _enc_joiners.get(jkey)
+            if jv is None:
+                sa2 = _retyped(stream_attrs, {so for _k, so, _b in subs})
+                ba2 = _retyped(build_attrs, {bo for _k, _s, bo in subs})
+                jv = _DeviceJoiner(
+                    _retype_keys(stream_keys, sa2, stream_attrs),
+                    _retype_keys(build_keys, ba2, build_attrs),
+                    sa2, ba2, mode)
+                _enc_joiners[jkey] = jv
+            return jv, stream_batch, s_cols, b_cols
+
         emit_build_cols = mode in ("inner", "outer")
         cond_filter = None
         if st.condition is not None:
@@ -332,13 +454,15 @@ class _TpuJoinMixin:
         for stream_batch in stream_iter:
             if stream_batch.host_rows() == 0:
                 continue
+            jv, stream_batch, s_cols, b_cols = _prep_pair(stream_batch)
             # OOM/transient resilience: the plan and emit dispatches are
             # pure over (stream batch, build), so a spill+re-dispatch is
             # safe; exhaustion propagates for task retry / query-level
             # CPU fallback (the build table is device-resident state —
             # batch bisection cannot recover it)
             plan_out = with_retry(
-                lambda: joiner.plan(stream_batch, build), site="join")
+                lambda: jv.plan(stream_batch, build, s_cols, b_cols),
+                site="join")
             b_matched = plan_out[6]
             if b_matched_acc is None:
                 b_matched_acc = b_matched

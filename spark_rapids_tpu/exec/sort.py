@@ -119,9 +119,14 @@ class TpuSortExec(_SortBase, TpuExec):
             from spark_rapids_tpu.engine import async_exec as AX
 
             for batch in child_pb.iterator(pidx):
+                from spark_rapids_tpu.columnar.encoded import decode_batch
+
                 if batch.host_rows() == 0:
                     yield batch
                     continue
+                # tpulint: eager-materialize -- code order is NOT value
+                # order: the sort boundary is a sanctioned decode site
+                batch = decode_batch(batch)
                 n_chunks = 0
                 if str_ords:
                     n_chunks = max(

@@ -306,8 +306,13 @@ class TpuWindowExec(_WindowBase, TpuExec):
 
         def window_partition(pidx: int):
             for batch in child_pb.iterator(pidx):
+                from spark_rapids_tpu.columnar.encoded import decode_batch
+
                 if batch.host_rows() == 0:
                     continue
+                # tpulint: eager-materialize -- window frames
+                # order/partition by VALUES: sanctioned boundary decode
+                batch = decode_batch(batch)
                 if kernel[0] is None:
                     kernel[0] = self._build_kernel(child_attrs)
                 cols = [_col_to_colv(c) for c in batch.columns]

@@ -31,8 +31,12 @@ class ColumnarPartitions:
         return self._pb.num_partitions
 
     def iterator(self, pidx: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.encoded import decode_batch
+
         for batch in self._pb.iterator(pidx):
-            yield ensure_compact(batch)
+            # external ML consumers read raw (data, validity, offsets)
+            # layouts: encoded columns decode at the export boundary
+            yield decode_batch(ensure_compact(batch))
 
     def collect_batches(self) -> List[ColumnarBatch]:
         out: List[ColumnarBatch] = []

@@ -983,7 +983,9 @@ def _EMPTY_TAB():
 
 def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
                         max_def: int, cap: Optional[int] = None,
-                        codec: str = "UNCOMPRESSED", flba_len: int = 0):
+                        codec: str = "UNCOMPRESSED", flba_len: int = 0,
+                        encoded_ok: bool = False,
+                        max_dict_fraction: float = 1.0):
     """Decode one raw column chunk into a device ColumnVector.
 
     Fixed-width columns: PLAIN / dictionary pages, v1 or v2. STRING
@@ -1027,6 +1029,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
 
     dict_vals = None          # fixed-width dictionary values (device)
     str_dict = None           # (bytes_dev, offs_dev, lens_dev) for strings
+    str_dict_host = None      # host (bytes_np, offs_np) dictionary table
     str_plain = []            # per-page (starts_np, lens_np) for strings
     str_delta = []            # per-page DEVICE (starts, lens, n) for
                               # DELTA_LENGTH_BYTE_ARRAY strings
@@ -1039,6 +1042,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             if is_string:
                 db, do, dl = _parse_dict_strings(chunk, p.data_start,
                                                  p.num_values)
+                str_dict_host = (db, do)
                 str_dict = (jnp.asarray(db), jnp.asarray(do),
                             jnp.asarray(dl))
             elif is_dec_flba:
@@ -1289,6 +1293,23 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
     if str_plain:
         raise _Unsupported("mixed dictionary/plain string pages")
     dict_bytes, dict_offs, dict_lens = str_dict
+    if encoded_ok and str_dict_host is not None:
+        # keep the column ENCODED: the codes ARE the decoded index stream
+        # (`data`), and the host-parsed dictionary table interns into one
+        # shared DeviceDictionary — no dictionary gather, no byte-total
+        # sync, and several-x less HBM (columnar/encoded.py; conf
+        # rapids.tpu.sql.encoded.*)
+        from spark_rapids_tpu.columnar.encoded import (
+            DeviceDictionary,
+            DictionaryColumn,
+            scan_encoded_ok,
+        )
+
+        db, do = str_dict_host
+        if scan_encoded_ok(int(len(do)) - 1, num_rows, max_dict_fraction):
+            d = DeviceDictionary.from_byte_table(db, do)
+            return DictionaryColumn(dtype, data.astype(jnp.int32),
+                                    validity, d)
     row_idx = jnp.clip(data, 0, dict_lens.shape[0] - 1)
     row_lens = jnp.where(validity, dict_lens[row_idx], 0)
     total = int(jax.device_get(jnp.sum(row_lens)))
@@ -1313,6 +1334,70 @@ def _concat_logical(parts, cap: int, fill):
     segs = [p[:n] for p, n in parts]
     out = jnp.concatenate(segs)
     return _pad_to(out, cap, fill)
+
+
+def chunk_dict_ndv(path: str, col_meta) -> Optional[int]:
+    """num_values of a chunk's dictionary page from a header-only read
+    (a few hundred bytes at the dictionary page offset), or None when
+    the chunk has no dictionary page / the header is unreadable. The
+    plan-time half of the encoded-scan heuristic: the resource analyzer
+    must apply the SAME ndv/rows test the runtime decode applies, or its
+    encoded-column byte model would diverge from what executes."""
+    start = getattr(col_meta, "dictionary_page_offset", None)
+    if start is None or start <= 0:
+        return None
+    try:
+        with open(path, "rb") as f:
+            f.seek(start)
+            head = f.read(512)
+        r = _Compact(head, 0)
+        hdr = r.struct()
+        if hdr.get(_PH_TYPE) != PAGE_DICT:
+            return None
+        return int(hdr[_PH_DICT][_DI_NUM_VALUES])
+    except Exception:
+        return None
+
+
+def chunk_dict_only(path: str, col_meta) -> Optional[bool]:
+    """True when EVERY data page of the chunk is dictionary-encoded,
+    proven by walking the page HEADERS only (one small read per page;
+    payloads are skipped by their header-declared size). False when a
+    PLAIN fallback page exists — the footer's `encodings` list cannot
+    distinguish the two (a pure-dict chunk and a mid-chunk dictionary
+    fallback both report {PLAIN, RLE, RLE_DICTIONARY}), and the resource
+    analyzer must not reduce its peak-HBM ceiling on an unprovable
+    claim. None when the headers are unreadable (treated as unproven)."""
+    start = getattr(col_meta, "dictionary_page_offset", None)
+    if start is None or start <= 0:
+        return None
+    try:
+        end = start + col_meta.total_compressed_size
+        with open(path, "rb") as f:
+            pos = start
+            while pos < end:
+                f.seek(pos)
+                head = f.read(min(8192, end - pos))
+                if not head:
+                    break
+                r = _Compact(head, 0)
+                hdr = r.struct()
+                size = hdr[_PH_COMPRESSED]
+                kind = hdr[_PH_TYPE]
+                if kind == PAGE_DATA_V1:
+                    if hdr[_PH_DATA_V1][_DP_ENCODING] not in \
+                            (ENC_PLAIN_DICT, ENC_RLE_DICT):
+                        return False
+                elif kind == PAGE_DATA_V2:
+                    if hdr[_PH_DATA_V2][_D2_ENCODING] not in \
+                            (ENC_PLAIN_DICT, ENC_RLE_DICT):
+                        return False
+                elif kind != PAGE_DICT:
+                    return False
+                pos += r.pos + size
+    except Exception:
+        return None
+    return True
 
 
 def read_chunk_bytes(path: str, col_meta) -> bytes:

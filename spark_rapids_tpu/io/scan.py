@@ -799,6 +799,79 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         return [slice_batch_host(batch, i, max_rows)
                 for i in range(0, rows, max_rows)]
 
+    def encoded_plan(self, conf) -> Dict[str, str]:
+        """Plan-time mirror of the runtime encoded-scan decision
+        (columnar/encoded.py): column name -> 'certain' (every row group
+        of every split is a dictionary-only chunk that clears the
+        ndv/rows heuristic — the decode WILL emit codes) or 'possible'
+        (a dictionary page exists somewhere but dict-only-ness or the
+        heuristic cannot be proven from footers alone). The resource
+        analyzer reduces its byte model only for 'certain' columns (the
+        pessimistic ceiling must stay sound) and widens its savings
+        interval over 'possible' ones (containment against the measured
+        metric). Cached per (enabled, fraction) on the exec."""
+        from spark_rapids_tpu import conf as C3
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.io import parquet_device as PD
+
+        enabled = conf.get(C3.ENCODED_ENABLED) and self.fmt == "parquet" \
+            and conf.get(C3.PARQUET_DEVICE_DECODE)
+        frac = conf.get(C3.ENCODED_MAX_DICT_FRACTION)
+        cached = getattr(self, "_encoded_plan_cache", None)
+        if cached is not None and cached[0] == (enabled, frac):
+            return cached[1]
+        out: Dict[str, str] = {}
+        if enabled:
+            import pyarrow.parquet as pq
+
+            str_attrs = [a for a in self.attrs
+                         if a.data_type is DataType.STRING]
+            # per column: 'certain' only when EVERY row group of every
+            # split is a provably dict-only chunk clearing the heuristic;
+            # 'possible' when ANY group might encode (the savings
+            # interval must cover it); absent otherwise
+            all_certain: Dict[str, bool] = {}
+            any_possible: Dict[str, bool] = {}
+            try:
+                for split in self.splits:
+                    md = pq.ParquetFile(split.path).metadata
+                    schema_index = {
+                        md.row_group(0).column(ci).path_in_schema: ci
+                        for ci in range(md.num_columns)}
+                    groups = list(split.row_groups) \
+                        if split.row_groups is not None \
+                        else list(range(md.num_row_groups))
+                    for a in str_attrs:
+                        ci = schema_index.get(a.name)
+                        all_certain.setdefault(a.name, True)
+                        if ci is None:
+                            all_certain[a.name] = False
+                            continue
+                        for rg in groups:
+                            col = md.row_group(rg).column(ci)
+                            rows = md.row_group(rg).num_rows
+                            ndv = PD.chunk_dict_ndv(split.path, col)
+                            ok = (PD.column_eligible(col, a.data_type)
+                                  and ndv is not None
+                                  and ENC.scan_encoded_ok(ndv, rows, frac))
+                            if not ok:
+                                all_certain[a.name] = False
+                                continue
+                            any_possible[a.name] = True
+                            # 'certain' needs a page-header walk: footer
+                            # encodings cannot distinguish a pure-dict
+                            # chunk from a mid-chunk PLAIN fallback
+                            if PD.chunk_dict_only(split.path, col) \
+                                    is not True:
+                                all_certain[a.name] = False
+                for name in any_possible:
+                    out[name] = "certain" if all_certain.get(name) \
+                        else "possible"
+            except Exception:
+                out = {}
+        self._encoded_plan_cache = ((enabled, frac), out)
+        return out
+
     def _read_device(self, split: FileSplit, conf):
         """Device decode for one split; None -> no column qualified (caller
         uses the host path). Mixed batches combine device-decoded columns
@@ -809,6 +882,11 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         from spark_rapids_tpu.io import parquet_device as PD
         from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
 
+        from spark_rapids_tpu import conf as C3
+        from spark_rapids_tpu.columnar import encoded as ENC
+
+        encoded_ok = conf.get(C3.ENCODED_ENABLED)
+        max_frac = conf.get(C3.ENCODED_MAX_DICT_FRACTION)
         pf = pq.ParquetFile(split.path)
         md = pf.metadata
         pv = dict(split.partition_values)
@@ -849,9 +927,14 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         chunk, a.data_type, rows,
                         max_def=max_def.get(a.name, 1), cap=cap,
                         codec=col.compression,
-                        flba_len=flba_len.get(a.name, 0))
+                        flba_len=flba_len.get(a.name, 0),
+                        encoded_ok=(encoded_ok
+                                    and a.data_type is DataType.STRING),
+                        max_dict_fraction=max_frac)
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback
+                if ENC.is_encoded(dev_cols[a.name]):
+                    ENC.record_scan_emission(dev_cols[a.name], rows)
                 # footer statistics -> value range: device-decoded columns
                 # never pass through a host array, so the upload-time min/max
                 # pass (columnar.batch.host_value_range) can't see them; the

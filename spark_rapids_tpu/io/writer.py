@@ -98,7 +98,13 @@ def execute_write(session, plan: L.WriteFile) -> None:
     write_id = uuid.uuid4().hex[:12]
 
     def write_partition(pidx: int) -> int:
-        batches = [b for b in pb.iterator(pidx) if b.num_rows > 0]
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar.encoded import decode_batch
+
+        # the device encoders read raw (offsets, bytes) string layouts:
+        # encoded columns decode at the writer boundary
+        batches = [decode_batch(b) if isinstance(b, ColumnarBatch) else b
+                   for b in pb.iterator(pidx) if b.num_rows > 0]
         if not batches:
             return 0
         if device_encode and plan.partition_by:

@@ -265,7 +265,12 @@ class DeviceStore(BufferStore):
 
     def _demote(self, buf: SpillableBuffer) -> None:
         if buf.host_bytes is None:
-            buf.host_bytes = serialize_batch(buf.device_batch.to_host())
+            from spark_rapids_tpu.columnar.batch import to_host_many
+
+            # keep_encoded: dictionary columns spill as codes + one
+            # dictionary copy; unspill re-uploads codes and re-interns
+            buf.host_bytes = serialize_batch(to_host_many(
+                [buf.device_batch], keep_encoded=True)[0])
         buf.device_batch = None  # drop device refs -> XLA frees HBM
 
 

@@ -48,6 +48,16 @@ jax.tree_util.register_pytree_node(
 
 
 def _col_to_colv(cv: ColumnVector) -> ColV:
+    from spark_rapids_tpu.columnar.encoded import is_encoded
+
+    if is_encoded(cv):
+        # an encoded column must NEVER reach a value kernel as raw codes —
+        # that would silently compute on dictionary indices. Operators
+        # either keep it in code space deliberately (encoded.codes_colv)
+        # or decode it visibly (encoded.materialize / decode_batch).
+        raise TypeError(
+            "encoded DictionaryColumn reached a kernel boundary without "
+            "materialize(); route through columnar.encoded helpers")
     return ColV(cv.dtype, cv.data, cv.validity, cv.offsets,
                 vrange=cv.vrange, max_len=cv.max_len)
 
@@ -120,16 +130,24 @@ def raise_deferred_ansi(flags, msgs) -> None:
 class DeviceProjector:
     """Compiles and caches the jitted evaluator for a fixed list of bound
     expressions (reference: GpuProjectExec's bound-expression evaluation,
-    basicPhysicalOperators.scala:34-95)."""
+    basicPhysicalOperators.scala:34-95).
+
+    Encoded inputs (columnar.encoded.DictionaryColumn) stay encoded where
+    the projection allows it: a bare-reference output passes the encoded
+    column through untouched, code-space-supported predicates over it
+    rewrite their literals into codes, and only columns a computed
+    expression genuinely needs the VALUES of decode — visibly, through
+    materialize()."""
 
     def __init__(self, exprs: Sequence[Expression]):
         self.exprs = list(exprs)
         self._jitted = None
+        self._enc_plans: dict = {}
 
-    def _build(self):
+    def _build_for(self, exprs):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
-        exprs = self.exprs
+        exprs = list(exprs)
         key = ("project", tuple(e.fingerprint() for e in exprs))
 
         def build():
@@ -156,12 +174,7 @@ class DeviceProjector:
 
         return get_or_build(key, build)
 
-    def project(self, batch: ColumnarBatch, partition_id: int = 0,
-                row_start: int = 0) -> ColumnarBatch:
-        if self._jitted is None:
-            self._jitted = self._build()
-        jitted, msgs = self._jitted
-        cols = [_col_to_colv(c) for c in batch.columns]
+    def _dispatch(self, jitted, msgs, cols, batch, partition_id, row_start):
         if not cols:
             # zero-column input (e.g. COUNT(*) over bare scan): evaluate with a
             # synthetic capacity derived from num_rows
@@ -182,8 +195,85 @@ class DeviceProjector:
             raise_deferred_ansi(flags, msgs)
             return outs
 
-        outs = with_retry(_attempt, site="project")
+        return with_retry(_attempt, site="project")
+
+    def project(self, batch: ColumnarBatch, partition_id: int = 0,
+                row_start: int = 0) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar import encoded as ENC
+
+        if ENC.encoded_ordinals(batch):
+            return self._project_encoded(batch, partition_id, row_start)
+        if self._jitted is None:
+            self._jitted = self._build_for(self.exprs)
+        jitted, msgs = self._jitted
+        cols = [_col_to_colv(c) for c in batch.columns]
+        outs = self._dispatch(jitted, msgs, cols, batch, partition_id,
+                              row_start)
         return ColumnarBatch([_colv_to_col(o) for o in outs], batch.num_rows)
+
+    def _enc_plan(self, batch):
+        """(passthrough map, rewritten eval exprs, code ords, mat ords):
+        cached per (ordinal, dictionary) signature of the batch."""
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.ops.base import Alias, BoundReference
+
+        sig = ENC.enc_sig(batch)
+        plan = self._enc_plans.get(sig)
+        if plan is not None:
+            return plan
+        enc = {i: c for i, c in enumerate(batch.columns)
+               if ENC.is_encoded(c)}
+
+        def pass_ord(e):
+            inner = e.child if isinstance(e, Alias) else e
+            if isinstance(inner, BoundReference) and inner.ordinal in enc:
+                return inner.ordinal
+            return None
+
+        passthrough = {oi: pass_ord(e) for oi, e in enumerate(self.exprs)
+                       if pass_ord(e) is not None}
+        eval_exprs = [e for oi, e in enumerate(self.exprs)
+                      if oi not in passthrough]
+        ok = ENC.bound_supported_refs(eval_exprs, enc.keys())
+        referenced = set()
+        for e in eval_exprs:
+            referenced |= ENC._bound_ref_ords(e)
+        mat = tuple(sorted((set(enc) - ok) & referenced))
+        dict_by_ord = {i: enc[i].dictionary for i in ok}
+        rewritten = [ENC.rewrite_bound_condition(e, dict_by_ord)
+                     if dict_by_ord else e for e in eval_exprs]
+        # the trailing one-slot list caches the built jit handle so the
+        # expression trees are fingerprinted once per signature, not per
+        # batch (_project_encoded fills it on first dispatch)
+        plan = (passthrough, rewritten, frozenset(ok), mat, [None])
+        self._enc_plans[sig] = plan
+        if len(self._enc_plans) > 64:
+            self._enc_plans.pop(next(iter(self._enc_plans)))
+        return plan
+
+    def _project_encoded(self, batch, partition_id, row_start):
+        from spark_rapids_tpu.columnar import encoded as ENC
+
+        passthrough, rewritten, code_ords, mat, built = \
+            self._enc_plan(batch)
+        # tpulint: eager-materialize -- projection expressions outside
+        # the code-space subset need values; passthroughs stay codes
+        batch = ENC.batch_with_materialized(batch, mat)
+        outs: List = [None] * len(self.exprs)
+        if rewritten:
+            cols = ENC.eval_cols(batch, code_ords)
+            if built[0] is None:
+                built[0] = self._build_for(rewritten)
+            jitted, msgs = built[0]
+            evaluated = self._dispatch(jitted, msgs, cols, batch,
+                                       partition_id, row_start)
+            ei = iter(evaluated)
+            for oi in range(len(self.exprs)):
+                if oi not in passthrough:
+                    outs[oi] = _colv_to_col(next(ei))
+        for oi, ord_ in passthrough.items():
+            outs[oi] = batch.columns[ord_]
+        return ColumnarBatch(outs, batch.num_rows)
 
 
 class DeviceFilter:
@@ -193,11 +283,12 @@ class DeviceFilter:
     def __init__(self, condition: Expression):
         self.condition = condition
         self._jitted = None
+        self._enc_jitted: dict = {}
+        self._enc_plans: dict = {}
 
-    def _build(self):
+    def _build_for(self, cond):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
 
-        cond = self.condition
         key = ("filter", cond.fingerprint())
 
         def build():
@@ -219,12 +310,42 @@ class DeviceFilter:
 
     def apply(self, batch: ColumnarBatch, partition_id: int = 0,
               row_start: int = 0, lazy: bool = False) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar import encoded as ENC
         from spark_rapids_tpu.columnar.batch import compact_batch
 
-        if self._jitted is None:
-            self._jitted = self._build()
-        jitted, msgs = self._jitted
-        cols = [_col_to_colv(c) for c in batch.columns]
+        # plan memoized per encoded signature: the sig fully determines
+        # the rewrite (interned dictionaries), so the supported-refs
+        # walks + condition-tree rebuild run once per dictionary set
+        ekey = ENC.enc_sig(batch)
+        if ekey in self._enc_plans:
+            plan = self._enc_plans[ekey]
+        else:
+            plan = ENC.plan_filter(self.condition, batch)
+            self._enc_plans[ekey] = plan
+            while len(self._enc_plans) > 64:
+                self._enc_plans.pop(next(iter(self._enc_plans)))
+        if plan is None:
+            if self._jitted is None:
+                self._jitted = self._build_for(self.condition)
+            jitted, msgs = self._jitted
+            cols = [_col_to_colv(c) for c in batch.columns]
+        else:
+            # code-space filter: supported predicates over encoded columns
+            # compare int32 codes against pre-translated literal codes;
+            # unsupported uses decode first (visible materialize). The
+            # surviving rows compact WITH their codes — the output batch
+            # stays encoded.
+            # tpulint: eager-materialize -- non-equality predicates over
+            # the column need values; supported ordinals stay codes
+            batch = ENC.batch_with_materialized(batch, plan.mat_ords)
+            built = self._enc_jitted.get(plan.sig)
+            if built is None:
+                built = self._enc_jitted[plan.sig] = \
+                    self._build_for(plan.condition)
+                while len(self._enc_jitted) > 64:
+                    self._enc_jitted.pop(next(iter(self._enc_jitted)))
+            jitted, msgs = built
+            cols = ENC.eval_cols(batch, plan.code_ords)
 
         def _attempt():
             M.record_dispatch()

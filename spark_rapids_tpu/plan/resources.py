@@ -73,6 +73,18 @@ FATAL_KINDS = frozenset({OOM_HAZARD, RECOMPILE_CHURN, UNBOUNDED_GENERATE})
 # DataType.STRING.itemsize, the batch-sizing estimate used engine-wide)
 _STR_BYTES_PER_ROW = DataType.STRING.itemsize
 
+# encoded (dictionary) columns: per-row bytes the byte model drops when a
+# STRING column stays CODES (the decoded model charges offsets + validity
+# + the string estimate; the encoded layout is int32 code + validity).
+# The SAVINGS interval reported against the measured encodedBytesSaved
+# metric uses the metric's own formula (columnar.encoded.STR/CODE
+# constants) so containment is a like-for-like comparison.
+from spark_rapids_tpu.columnar.encoded import (  # noqa: E402
+    CODE_BYTES_PER_ROW as _ENC_ROW_BYTES,
+)
+
+_ENC_ROW_MODEL_SAVING = (4 + 1 + _STR_BYTES_PER_ROW) - _ENC_ROW_BYTES
+
 
 class ResourceAnalysisError(ValueError):
     """A physical plan failed resource admission (failOnViolation)."""
@@ -479,6 +491,18 @@ class PlanResourceReport:
         # measured metric but is not modeled here
         self.spmd_stages = 0
         self.collective_bytes = Interval.exact(0)
+        # encoded columnar execution (columnar/encoded.py): how many scan
+        # columns are predicted to emit ENCODED, the HBM-savings interval
+        # in the measured metric's own formula (containment-testable
+        # against encodedBytesSaved), the encoded-vs-decoded byte model
+        # for those columns, and WHERE the plan decodes them (the
+        # late-materialization points — 'sink' when codes survive to the
+        # result download)
+        self.encoded_cols = 0
+        self.encoded_saved = Interval.exact(0)
+        self.encoded_code_bytes = Interval.exact(0)
+        self.encoded_decoded_bytes = Interval.exact(0)
+        self.decode_points: List[str] = []
         self.nodes: List[NodeEstimate] = []
         self.violations: List[PlanViolation] = []
 
@@ -535,6 +559,13 @@ class PlanResourceReport:
                 f"spmd stages: {self.spmd_stages} (collective bytes "
                 f"{_fmt_bytes(self.collective_bytes.lo)}"
                 f"..{_fmt_bytes(self.collective_bytes.hi)})")
+        if self.encoded_cols:
+            pts = ", ".join(self.decode_points) or "none"
+            lines.append(
+                f"encoded columns: {self.encoded_cols} (bytes saved "
+                f"{_fmt_bytes(self.encoded_saved.lo)}"
+                f"..{_fmt_bytes(self.encoded_saved.hi)}; decode at: "
+                f"{pts})")
         for n in self.nodes:
             lines.append(
                 "  " * (n.depth + 1)
@@ -546,6 +577,207 @@ class PlanResourceReport:
         else:
             lines.append("violations: none")
         return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Encoded-column flow (columnar/encoded.py): a structural pre-pass
+# mirroring the runtime's code-space eligibility, so the byte model can
+# charge code-bytes where codes will actually flow and predict WHERE each
+# encoded column decodes (the late-materialization point)
+# ---------------------------------------------------------------------------
+def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
+    """(enc_at: {id(node): {output expr_id: 'certain'|'possible'}},
+    decode_points: ordered unique node labels where encoded columns
+    materialize — 'sink' when codes survive to the result download)."""
+    from spark_rapids_tpu.columnar import encoded as ENCX
+    from spark_rapids_tpu.exec import basic as B
+    from spark_rapids_tpu.exec.aggregate import (
+        COMPLETE,
+        PARTIAL,
+        _HashAggregateBase,
+    )
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+    from spark_rapids_tpu.exec.join import _JoinBase
+    from spark_rapids_tpu.exec.transitions import (
+        CpuCoalesceBatchesExec,
+        DeviceToHostExec,
+        HostToDeviceExec,
+        TpuCoalesceBatchesExec,
+    )
+    from spark_rapids_tpu.io.scan import TpuFileScanExec
+    from spark_rapids_tpu.ops.base import (
+        Alias,
+        AttributeReference,
+        to_attribute,
+    )
+    from spark_rapids_tpu.shuffle.exchange import (
+        HashPartitioning,
+        RangePartitioning,
+        _ExchangeBase,
+    )
+
+    enc_at: Dict[int, Dict[int, str]] = {}
+    decode_points: List[str] = []
+
+    def note_decode(label: str) -> None:
+        if label not in decode_points:
+            decode_points.append(label)
+
+    def refs(e):
+        return {r.expr_id for r in e.collect(
+            lambda x: isinstance(x, AttributeReference))}
+
+    def bare(e):
+        inner = e.child if isinstance(e, Alias) else e
+        return inner.expr_id if isinstance(inner, AttributeReference) \
+            else None
+
+    def walk(node) -> Dict[int, str]:
+        kids = [walk(c) for c in node.children]
+        cin = kids[0] if kids else {}
+        enc: Dict[int, str] = {}
+        if isinstance(node, TpuFileScanExec):
+            try:
+                ep = node.encoded_plan(conf)
+            except Exception:
+                ep = {}
+            by_name = {a.name: a.expr_id for a in node.output}
+            enc = {by_name[n]: st for n, st in ep.items() if n in by_name}
+        elif isinstance(node, TpuFusedStageExec):
+            # children[0] is the member chain's top: its state IS the
+            # stage output's (members were walked on the recursion)
+            enc = dict(cin)
+        elif isinstance(node, (B.TpuFilterExec, B.CpuFilterExec)):
+            enc = dict(cin)
+            ok = ENCX.unbound_supported_refs([node.condition], enc.keys())
+            bad = (set(enc) - ok) & refs(node.condition)
+            if bad:
+                note_decode(node.node_name())
+                for i in bad:
+                    enc.pop(i, None)
+        elif isinstance(node, (B.TpuProjectExec, B.CpuProjectExec)):
+            srcs = {}
+            others = []
+            for a, e in zip(node.output, node.project_list):
+                b = bare(e)
+                if b is not None and b in cin:
+                    enc[a.expr_id] = cin[b]
+                    srcs[a.expr_id] = b
+                else:
+                    others.append(e)
+            ok = ENCX.unbound_supported_refs(others, cin.keys())
+            oref = set()
+            for e in others:
+                oref |= refs(e)
+            bad = (set(cin) - ok) & oref
+            if bad:
+                note_decode(node.node_name())
+                enc = {oe: st for oe, st in enc.items()
+                       if srcs[oe] not in bad}
+        elif isinstance(node, _HashAggregateBase):
+            if cin:
+                key_eids = {g.expr_id for g in node.grouping}
+                if node.mode in (PARTIAL, COMPLETE):
+                    input_refs = set()
+                    for _op, e, _dt in node._update_ops():
+                        input_refs |= refs(e)
+                    nonbare = set()
+                    for e in node.key_exprs:
+                        b = bare(e)
+                        r = refs(e)
+                        if b is not None:
+                            r = r - {b}
+                        nonbare |= r
+                    kept = {i for i in cin if i in key_eids
+                            and i not in input_refs and i not in nonbare}
+                else:
+                    kept = {i for i in cin if i in key_eids}
+                if set(cin) - kept:
+                    note_decode(node.node_name())
+                if node.mode == PARTIAL:
+                    enc = {i: cin[i] for i in kept}
+                else:
+                    for e in node.agg_exprs:
+                        b = bare(e)
+                        if b is not None and b in kept:
+                            enc[to_attribute(e).expr_id] = cin[b]
+        elif isinstance(node, _ExchangeBase):
+            p = node.partitioning
+            if isinstance(p, RangePartitioning):
+                if cin:
+                    note_decode(node.node_name())
+            else:
+                enc = dict(cin)
+                if isinstance(p, HashPartitioning):
+                    bad = set()
+                    for e in p.exprs:
+                        if bare(e) in enc:
+                            continue  # dictionary-hashed key
+                        bad |= refs(e) & set(enc)
+                    if bad:
+                        note_decode(node.node_name())
+                        for i in bad:
+                            enc.pop(i, None)
+        elif isinstance(node, _JoinBase):
+            left = kids[0] if kids else {}
+            right = kids[1] if len(kids) > 1 else {}
+            enc = {}
+            enc.update(left)
+            enc.update(right)
+            bad = set()
+            # one ordinal equi-joined against SEVERAL columns on the
+            # other side may face differing dictionaries at runtime (one
+            # remap cannot serve two code spaces — exec/join falls back
+            # to value comparison), so the ceiling must assume a decode
+            pair_l: dict = {}
+            pair_r: dict = {}
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                lb, rb = bare(lk), bare(rk)
+                if lb is not None and rb is not None and \
+                        lb in left and rb in right:
+                    pair_l.setdefault(lb, set()).add(rb)
+                    pair_r.setdefault(rb, set()).add(lb)
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                lb, rb = bare(lk), bare(rk)
+                if lb is not None and rb is not None and \
+                        lb in left and rb in right:
+                    if len(pair_l[lb]) == 1 and len(pair_r[rb]) == 1:
+                        continue  # both sides encoded: code-remap join
+                    bad.add(lb)
+                    bad.add(rb)
+                    continue
+                for e, side in ((lk, left), (rk, right)):
+                    b = bare(e)
+                    if b is not None and b in side:
+                        bad.add(b)
+                    bad |= refs(e) & set(side)
+            if node.condition is not None:
+                ok = ENCX.unbound_supported_refs([node.condition],
+                                                 enc.keys())
+                bad |= (set(enc) - ok) & refs(node.condition)
+            if bad:
+                note_decode(node.node_name())
+                for i in bad:
+                    enc.pop(i, None)
+        elif isinstance(node, (HostToDeviceExec, TpuCoalesceBatchesExec,
+                               CpuCoalesceBatchesExec,
+                               B.CoalescePartitionsExec,
+                               B.TpuLocalLimitExec, B.CpuLocalLimitExec,
+                               B._GlobalLimitBase)):
+            enc = dict(cin)
+        elif isinstance(node, DeviceToHostExec):
+            if cin:
+                note_decode("sink")
+        else:
+            # sort/window/expand/generate/union/cache/write/unknown:
+            # the operator boundary decode
+            if any(k for k in kids):
+                note_decode(node.node_name())
+        enc_at[id(node)] = enc
+        return enc
+
+    walk(plan)
+    return enc_at, decode_points
 
 
 # ---------------------------------------------------------------------------
@@ -579,6 +811,9 @@ class _Analyzer:
         # measurement + conf); they change capacities, not semantics
         self._filter_lazy = self._policy(C.FILTER_COMPACT_SYNC)
         self._agg_lazy = self._policy(C.AGG_COMPACT_SYNC)
+        # encoded-column flow (populated by run()'s pre-pass): per node,
+        # which output columns are predicted to stay dictionary CODES
+        self._enc_at: Dict[int, Dict[int, str]] = {}
 
     def _policy(self, entry) -> bool:
         policy = self.conf.get(entry)
@@ -643,8 +878,13 @@ class _Analyzer:
 
     # -- entry ---------------------------------------------------------------
     def run(self, plan: PhysicalExec) -> PlanResourceReport:
+        try:
+            self._enc_at, decode_points = _encoded_flow(plan, self.conf)
+        except Exception:
+            self._enc_at, decode_points = {}, []
         final = self.visit(plan)
         r = self.report
+        r.decode_points = decode_points
         r.compile_keys = len(self._compile_keys)
         # plan-level violations ---------------------------------------------
         from spark_rapids_tpu.engine import jit_cache
@@ -755,8 +995,18 @@ class _Analyzer:
     def _mk(self, node, rows, parts, nonempty, batches, batch_rows,
             buckets, lazy_tail=False, ndv=None, rng=None,
             chain=None) -> AbsState:
+        rb = _row_bytes(node.output, self.physical)
+        enc = self._enc_at.get(id(node))
+        if enc:
+            # columns CERTAIN to flow as dictionary codes charge the
+            # encoded layout (int32 code + validity) instead of the
+            # expanded-string estimate; 'possible' columns keep the
+            # decoded charge so the pessimistic ceiling stays sound
+            for a in node.output:
+                if enc.get(a.expr_id) == "certain":
+                    rb = max(1, rb - _ENC_ROW_MODEL_SAVING)
         return AbsState(rows, parts, nonempty, batches, batch_rows,
-                        set(buckets), _row_bytes(node.output, self.physical),
+                        set(buckets), rb,
                         lazy_tail=lazy_tail, placement=node.placement,
                         col_ndv=ndv, col_range=rng, chain_bytes=chain)
 
@@ -836,6 +1086,33 @@ class _Analyzer:
         if node.placement == "tpu":
             # device decode kernels: unknown page/chunk mix
             self._spend(Interval(0, INF), exact=False)
+        enc = self._enc_at.get(id(node))
+        if enc:
+            # predicted encoded emission: savings in the measured metric's
+            # own formula (rows x (STR - CODE) per encoded column), lo
+            # only for certain columns (the heuristic/decode may still
+            # fall back on 'possible' ones, and file row totals are loose
+            # so rows.lo is typically 0 anyway)
+            from spark_rapids_tpu.columnar.encoded import (
+                CODE_BYTES_PER_ROW,
+                STR_BYTES_PER_ROW,
+            )
+
+            per_row = STR_BYTES_PER_ROW - CODE_BYTES_PER_ROW
+            n_cert = sum(1 for s in enc.values() if s == "certain")
+            r = self.report
+            r.encoded_cols += len(enc)
+            r.encoded_saved = r.encoded_saved.add(
+                Interval(_mul0(st.rows.lo, per_row * n_cert),
+                         _mul0(st.rows.hi, per_row * len(enc))))
+            r.encoded_code_bytes = r.encoded_code_bytes.add(
+                Interval(_mul0(st.rows.lo, _ENC_ROW_BYTES * n_cert),
+                         _mul0(st.rows.hi, _ENC_ROW_BYTES * len(enc))))
+            r.encoded_decoded_bytes = r.encoded_decoded_bytes.add(
+                Interval(_mul0(st.rows.lo,
+                               (4 + 1 + _STR_BYTES_PER_ROW) * n_cert),
+                         _mul0(st.rows.hi,
+                               (4 + 1 + _STR_BYTES_PER_ROW) * len(enc))))
         return st
 
     def _cached_scan(self, node) -> AbsState:

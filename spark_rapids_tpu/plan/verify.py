@@ -234,6 +234,30 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
                            f"{_schema_str(c.output)} does not match input "
                            f"0 {_schema_str(first)}")
 
+    # -- encoded scan claims (columnar/encoded.py) ---------------------------
+    from spark_rapids_tpu.io.scan import _FileScanBase
+
+    if isinstance(node, _FileScanBase):
+        cached = getattr(node, "_encoded_plan_cache", None)
+        if cached is not None and cached[1]:
+            out_by_name = {a.name: a for a in output}
+            if node.placement != "tpu":
+                out.append(
+                    f"{name}: claims encoded (dictionary) output columns "
+                    "but is not a device scan — host batches cannot carry "
+                    "DictionaryColumn")
+            for cname in cached[1]:
+                a = out_by_name.get(cname)
+                if a is None:
+                    out.append(
+                        f"{name}: encoded-column claim {cname!r} names a "
+                        "column the scan does not output")
+                elif a.data_type is not DataType.STRING:
+                    out.append(
+                        f"{name}: encoded-column claim {cname!r} has dtype "
+                        f"{a.data_type} — only STRING columns have a "
+                        "dictionary-code representation")
+
     # -- placement edges (every device<->host edge needs a transition) -------
     from spark_rapids_tpu.plan.transition_overrides import (
         _effective_placement,

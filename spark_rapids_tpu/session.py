@@ -485,11 +485,14 @@ class TpuSession:
         # metrics) additionally rides the QueryContext so concurrent
         # tenants cannot cross-talk.
         self.conf.sync_int64_narrowing()
-        R.set_policy_from_conf(self.conf)
         breaker = R.CircuitBreaker.configure(self.conf, tenant=self.tenant)
         AX.configure(self.conf, self.device_manager)
         self.scheduler.configure(self.conf)
         qctx = M.QueryContext(self.tenant)
+        # context-scoped: the retry/backoff policy rides the QueryContext
+        # (combinators read policy() through it), so concurrent tenants'
+        # knobs stay isolated
+        R.set_policy_from_conf(self.conf, ctx=qctx)
         qctx.breaker = breaker
         qctx.begin_retry_budget(self.conf.get(C.RETRY_BUDGET))
         token = M.push_query_ctx(qctx)
@@ -536,7 +539,9 @@ class TpuSession:
                          M.CHECKED_REPLAYS, M.DONATED_BYTES, M.SPMD_STAGES,
                          M.COLLECTIVE_BYTES, M.PLAN_CACHE_HITS,
                          M.PLAN_CACHE_MISSES, M.ADMISSION_WAITS,
-                         M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES):
+                         M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES,
+                         M.ENCODED_COLUMNS, M.LATE_MATERIALIZATIONS,
+                         M.ENCODED_BYTES_SAVED):
                 self.last_query_metrics[name] = snap.get(name, 0)
 
     def _maybe_micro_batch(self, plan: L.LogicalPlan, breaker,

@@ -396,13 +396,15 @@ class _SerializedPiece:
 
 
 def _encode_piece(piece) -> _SerializedPiece:
-    from spark_rapids_tpu.columnar.batch import ensure_compact
+    from spark_rapids_tpu.columnar.batch import ensure_compact, to_host_many
     from spark_rapids_tpu.memory.spill import SpillFramework
 
     if isinstance(piece, _RoutedSlice):
         piece = piece.to_batch()
     if isinstance(piece, ColumnarBatch):
-        host = ensure_compact(piece).to_host()
+        # keep_encoded: dictionary columns cross the exchange as CODES +
+        # one dictionary copy per piece, not expanded strings
+        host = to_host_many([ensure_compact(piece)], keep_encoded=True)[0]
     else:
         host = piece
     return _serialize_host_piece(host, SpillFramework.get())
@@ -448,8 +450,11 @@ def _encode_pieces_grouped(routed):
         # THE grouped map-output download: one planned fence per input
         # batch replaces one per piece (counted by the fencesPerQuery
         # instrumentation inside with_retry)
-        hosts = with_retry(lambda: to_host_many(dev_batches),
-                           site="transfer.download")
+        # keep_encoded: dictionary columns ship codes + one dictionary
+        # copy per piece instead of expanded strings
+        hosts = with_retry(
+            lambda: to_host_many(dev_batches, keep_encoded=True),
+            site="transfer.download")
     out = []
     hi = 0
     for j, (target, piece) in enumerate(routed):
@@ -768,10 +773,19 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             # lazy cap to cover scan-sized batches multiplies reduce-side
             # lane counts 8-16x and regressed the flagship query 13x — the
             # per-lane cost is NOT free even where host fences dominate.)
-            if no_strings and \
+            from spark_rapids_tpu.columnar.encoded import is_encoded
+
+            enc = any(is_encoded(c) for c in batch.columns)
+            # encoded columns slice as fixed-width CODES: the lazy
+            # zero-copy view works for them, and the contiguous split's
+            # gather carries the dictionary along
+            fixed_only = no_strings or (enc and all(
+                is_encoded(c) or c.dtype is not DataType.STRING
+                for c in batch.columns))
+            if fixed_only and \
                     batch.device_memory_size() <= LAZY_PIECE_CAP_BYTES:
                 return _device_slices_lazy(batch, ids, n_)
-            if serialize:
+            if serialize or enc:
                 return _device_slices(batch, ids, n_)
             return _device_slices_routed(batch, ids, n_)
 
@@ -791,7 +805,12 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             jitted = [None]
 
             def hash_map(pidx: int, batch: ColumnarBatch):
+                from spark_rapids_tpu.columnar import encoded as ENC
+
                 batch = _compacted(batch)
+                if ENC.encoded_ordinals(batch):
+                    ids, batch = _hash_ids_encoded(bound, n, batch)
+                    return slicer(batch, ids, n)
                 if jitted[0] is None:
                     jitted[0] = _build_hash_ids(bound, n)
                 cols = [_col_to_colv(c) for c in batch.columns]
@@ -818,7 +837,11 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         child_attrs = self.children[0].output
 
         def mat(pidx: int):
-            return [b for b in child_pb.iterator(pidx)
+            from spark_rapids_tpu.columnar.encoded import decode_batch
+
+            # tpulint: eager-materialize -- the ICI collective assembles
+            # raw fixed/string matrices: sanctioned boundary decode
+            return [decode_batch(b) for b in child_pb.iterator(pidx)
                     if not getattr(b, "rows_on_host", True) or b.num_rows > 0]
 
         from spark_rapids_tpu.engine.scheduler import run_job_or_serial
@@ -875,8 +898,13 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             refs drop as each partition completes)."""
             staged = []
             for batch in child_pb.iterator(pidx):
+                from spark_rapids_tpu.columnar.encoded import decode_batch
+
                 if batch.num_rows == 0:
                     continue
+                # tpulint: eager-materialize -- range bounds need VALUES
+                # (codes order is not value order): sanctioned decode
+                batch = decode_batch(batch)
                 cols = [_col_to_colv(c) for c in batch.columns]
                 dev_keys = kernel(cols, jnp.int32(batch.num_rows)) \
                     if kernel is not None else []
@@ -996,6 +1024,85 @@ def _build_hash_ids(bound_exprs, n: int):
                     r = _scalar_to_colv(ctx, r, e.data_type)
                 key_cols.append(r)
             ids = H.partition_ids(jnp, key_cols, n)
+            return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
+
+        return jax.jit(f)
+
+    return get_or_build(key, build)
+
+
+def _hash_ids_encoded(bound_exprs, n: int, batch):
+    """Partition ids for a batch carrying encoded columns: a bare-ref key
+    over an encoded column hashes through its DICTIONARY's per-entry word
+    table (one gather by code) — bit-identical to hashing the expanded
+    strings, so pieces with different dictionaries (or plain string
+    pieces from other maps) still co-partition. Non-bare uses of encoded
+    columns decode at this boundary. Returns (ids, effective batch)."""
+    from spark_rapids_tpu.columnar import encoded as ENC
+    from spark_rapids_tpu.ops.base import Alias, BoundReference
+
+    enc = set(ENC.encoded_ordinals(batch))
+
+    def bare_ord(e):
+        inner = e.child if isinstance(e, Alias) else e
+        if isinstance(inner, BoundReference) and inner.ordinal in enc:
+            return inner.ordinal
+        return None
+
+    cand = []       # (expr index, ordinal) for bare-ref encoded keys
+    mat = set()
+    for xi, e in enumerate(bound_exprs):
+        o = bare_ord(e)
+        if o is not None:
+            cand.append((xi, o))
+            continue
+        mat |= ENC._bound_ref_ords(e) & enc
+    # an ordinal ALSO referenced inside a computed expression is about
+    # to materialize — its bare keys hash the values (bit-identical)
+    enc_info = [(xi, o) for xi, o in cand if o not in mat]
+    # tpulint: eager-materialize -- non-bare partition-key expressions
+    # need values; bare keys hash through the dictionary word tables
+    batch = ENC.batch_with_materialized(batch, tuple(sorted(mat)))
+    still_enc = frozenset(set(ENC.encoded_ordinals(batch)))
+    cols = ENC.eval_cols(batch, still_enc)
+    tables = tuple(batch.columns[o].dictionary.hash_words()
+                   for _xi, o in enc_info)
+    kern = _build_hash_ids_enc(bound_exprs, n, tuple(enc_info))
+    ids = kern(cols, tables, jnp.asarray(batch.num_rows, dtype=jnp.int32))
+    return ids, batch
+
+
+def _build_hash_ids_enc(bound_exprs, n: int, enc_info):
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+    from spark_rapids_tpu.ops.eval import _scalar_to_colv
+
+    key = ("hash_ids_enc", tuple(e.fingerprint() for e in bound_exprs),
+           enc_info, n)
+    enc_by_xi = dict(enc_info)
+
+    def build():
+        def f(cols, tables, num_rows):
+            capacity = cols[0].validity.shape[0]
+            ctx = EvalContext(jnp, True, cols, num_rows, capacity)
+            entries = []
+            ti = 0
+            for xi, e in enumerate(bound_exprs):
+                if xi in enc_by_xi:
+                    cv = cols[enc_by_xi[xi]]
+                    table = tables[ti]
+                    ti += 1
+                    safe = jnp.clip(cv.data, 0, table[0].shape[0] - 1)
+                    words = [t[safe] for t in table]
+                    entries.append((words, cv.validity))
+                    continue
+                r = e.eval(ctx)
+                if isinstance(r, ScalarV):
+                    r = _scalar_to_colv(ctx, r, e.data_type)
+                words = H.string_words(jnp, r) \
+                    if r.dtype is DataType.STRING else \
+                    H.column_words(jnp, r)
+                entries.append((words, r.validity))
+            ids = H.partition_ids_from_entries(jnp, entries, n)
             return jnp.where(jnp.arange(capacity) < num_rows, ids, n)
 
         return jax.jit(f)

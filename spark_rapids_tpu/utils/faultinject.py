@@ -47,6 +47,7 @@ SITES: Dict[str, str] = {
     "join": "oom",
     "sort": "oom",
     "spmd.stage": "oom",
+    "encoded.materialize": "oom",
     "transfer.upload": "transfer",
     "transfer.download": "transfer",
     "shuffle.fetch": "fetch",

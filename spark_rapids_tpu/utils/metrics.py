@@ -66,6 +66,18 @@ PLAN_CACHE_MISSES = "planCacheMisses"
 ADMISSION_WAITS = "admissionWaits"
 MICRO_BATCHES = "microBatches"
 MICRO_BATCHED_QUERIES = "microBatchedQueries"
+# encoded columnar execution (columnar/encoded.py,
+# docs/compressed-execution.md): encodedColumns counts device columns the
+# scan layer emitted ENCODED (codes + shared dictionary, per column per
+# decoded chunk); lateMaterializations counts explicit decode events — the
+# only path from codes back to values (device materialize() at an operator
+# boundary, host expansion at the result sink / serde); encodedBytesSaved
+# accumulates the HBM the encoded representation avoided at scan emission,
+# rows x (string-estimate bytes - code bytes) per encoded column — the
+# same formula the resource analyzer predicts, so containment is testable
+ENCODED_COLUMNS = "encodedColumns"
+LATE_MATERIALIZATIONS = "lateMaterializations"
+ENCODED_BYTES_SAVED = "encodedBytesSaved"
 
 
 class Metric:
@@ -133,7 +145,7 @@ class QueryContext:
 
     __slots__ = ("tenant", "_lock", "_counters", "breaker", "injector",
                  "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
-                 "resource_report")
+                 "resource_report", "retry_policy")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -160,6 +172,11 @@ class QueryContext:
         # it here so concurrent queries on one session cannot read each
         # other's via the session attribute
         self.resource_report = None
+        # per-query retry policy (engine/retry.set_policy_from_conf):
+        # combinators read policy() through the ambient context, so one
+        # tenant's backoff/retry tuning never leaks into another's
+        # concurrently running query
+        self.retry_policy = None
 
     def add(self, name: str, n: int) -> None:
         with self._lock:
@@ -434,6 +451,51 @@ def record_micro_batched_query(n: int = 1) -> None:
 
 def micro_batched_query_count() -> int:
     return _MICRO_BATCHED_QUERIES.value
+
+
+# ---------------------------------------------------------------------------
+# Encoded columnar execution accounting (columnar/encoded.py)
+# ---------------------------------------------------------------------------
+_ENCODED_COLUMNS = Metric(ENCODED_COLUMNS)
+_LATE_MATERIALIZATIONS = Metric(LATE_MATERIALIZATIONS)
+_ENCODED_BYTES_SAVED = Metric(ENCODED_BYTES_SAVED)
+
+
+def record_encoded_column(n: int = 1) -> None:
+    """Count one device column emitted ENCODED by the scan layer (codes in
+    HBM + shared dictionary; one count per column per decoded chunk)."""
+    _ENCODED_COLUMNS.add(n)
+    _note(ENCODED_COLUMNS, n)
+
+
+def encoded_column_count() -> int:
+    return _ENCODED_COLUMNS.value
+
+
+def record_late_materialization(n: int = 1) -> None:
+    """Count one explicit decode of an encoded column back to values —
+    the materialize() boundary path or the sink/serde host expansion. The
+    compressed-execution contract is that this never happens silently
+    (tpulint rule eager-materialize)."""
+    _LATE_MATERIALIZATIONS.add(n)
+    _note(LATE_MATERIALIZATIONS, n)
+
+
+def late_materialization_count() -> int:
+    return _LATE_MATERIALIZATIONS.value
+
+
+def record_encoded_bytes_saved(n: int) -> None:
+    """Accumulate HBM bytes the encoded representation avoided at scan
+    emission: rows x (string per-row estimate - encoded per-row bytes),
+    the deterministic formula the resource analyzer predicts an interval
+    for (containment pinned by tests)."""
+    _ENCODED_BYTES_SAVED.add(n)
+    _note(ENCODED_BYTES_SAVED, n)
+
+
+def encoded_bytes_saved() -> int:
+    return _ENCODED_BYTES_SAVED.value
 
 
 @contextlib.contextmanager

@@ -1,0 +1,652 @@
+"""Encoded columnar subsystem (columnar/encoded.py): dictionary columns
+stay CODES in HBM and operators compute on the codes with late
+materialization — oracle equality, metric pins, serde round trips,
+analyzer containment, and fault injection at the materialize site."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.columnar import encoded as ENC
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.plan import functions as F
+from tests.harness import (
+    assert_tpu_and_cpu_are_equal_collect,
+    run_on_cpu,
+    run_on_tpu,
+)
+
+# extra seeds ride outside the tier-1 window (the dots budget
+# is shared by the whole suite); seed 0 stays in tier-1
+SEEDS = [0, pytest.param(7, marks=pytest.mark.slow),
+         pytest.param(1234, marks=pytest.mark.slow)]
+
+
+def _write_dict_heavy(tmp_path, seed=0, n=4000, nulls=True,
+                      name="enc.parquet", row_group_size=2500):
+    """Dictionary-heavy parquet: low-ndv string columns + numerics."""
+    rng = np.random.default_rng(seed)
+    flag = rng.choice(["A", "B", "C", "N", "R"], size=n).astype(object)
+    status = rng.choice(["open", "closed", "pending"], size=n).astype(object)
+    v = rng.integers(0, 10_000, size=n)
+    k = rng.integers(0, 50, size=n)
+    if nulls:
+        null_at = rng.random(n) < 0.05
+        flag = np.where(null_at, None, flag)
+    tbl = pa.table({"flag": flag, "status": status, "v": v, "k": k})
+    path = str(tmp_path / name)
+    pq.write_table(tbl, path, use_dictionary=True,
+                   row_group_size=row_group_size)
+    return path
+
+
+def _scan_emits_encoded(session, path) -> bool:
+    run_on_tpu(session, lambda s: s.read.parquet(path))
+    return session.last_query_metrics.get("encodedColumns", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Oracle equality across operators and seeds
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("seed", SEEDS)
+def test_filter_groupby_oracle_equal(session, tmp_path, seed):
+    path = _write_dict_heavy(tmp_path, seed=seed)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag") == F.lit("A"))
+        .groupBy("status").agg(F.count("*").alias("c"),
+                               F.sum("v").alias("t")),
+        ignore_order=True)
+    assert session.last_query_metrics["encodedColumns"] > 0
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_in_isnull_predicates_oracle_equal(session, tmp_path, seed):
+    path = _write_dict_heavy(tmp_path, seed=seed)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag").isin("A", "B", "Z") |
+                F.col("flag").isNull())
+        .groupBy("flag").agg(F.count("*").alias("c")),
+        ignore_order=True)
+    assert session.last_query_metrics["encodedColumns"] > 0
+
+
+def test_absent_literal_matches_nothing(session, tmp_path):
+    path = _write_dict_heavy(tmp_path, seed=1)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag") == F.lit("NOT_IN_DICT"))
+        .groupBy("status").agg(F.count("*").alias("c")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_sort_over_encoded_oracle_equal(session, tmp_path, seed):
+    """Sort needs VALUES (code order is not value order): the sort
+    boundary decodes, results stay oracle-equal."""
+    path = _write_dict_heavy(tmp_path, seed=seed)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .groupBy("flag", "status").agg(F.sum("v").alias("t"))
+        .orderBy("flag", "status"))
+    assert session.last_query_metrics["encodedColumns"] > 0
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_join_on_encoded_keys_oracle_equal(session, tmp_path, seed):
+    """Hash join on dictionary keys: the two sides' dictionaries align
+    through a build-time code-remap table."""
+    left = _write_dict_heavy(tmp_path, seed=seed, name="l.parquet")
+    right = _write_dict_heavy(tmp_path, seed=seed + 100, n=800,
+                              nulls=False, name="r.parquet",
+                              row_group_size=800)
+
+    def q(s):
+        l = s.read.parquet(left)
+        r = s.read.parquet(right).groupBy("status").agg(
+            F.sum("k").alias("rk"))
+        return l.join(r, l["status"] == r["status"], "inner") \
+            .groupBy("flag").agg(F.count("*").alias("c"),
+                                 F.sum("rk").alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+    assert session.last_query_metrics["encodedColumns"] > 0
+
+
+def test_join_key_used_bare_and_computed_oracle_equal(session, tmp_path):
+    """A column used BOTH as a bare key and inside a computed key needs
+    VALUES at the computed position: the whole ordinal materializes
+    instead of code-joining (the computed expression would otherwise
+    evaluate over int32 codes)."""
+    rng = np.random.default_rng(21)
+    vals = ["open", "closed", "pending"]
+    lpath = str(tmp_path / "l.parquet")
+    pq.write_table(pa.table({
+        "status": rng.choice(vals, size=4000).astype(object),
+        "v": rng.integers(0, 100, size=4000)}), lpath,
+        use_dictionary=True, row_group_size=2500)
+    rs = np.array(vals + ["archived"], dtype=object)
+    rpath = str(tmp_path / "r.parquet")
+    pq.write_table(pa.table({
+        "rstatus": rs,
+        "slen": np.array([len(x) for x in rs]),
+        "rk": np.arange(len(rs)) * 10}), rpath, use_dictionary=True)
+
+    def q(s):
+        left = s.read.parquet(lpath)
+        right = s.read.parquet(rpath)
+        return left.join(
+            right, (left["status"] == right["rstatus"]) &
+            (F.length(left["status"]) == right["slen"]), "inner") \
+            .groupBy("status").agg(F.count("*").alias("c"),
+                                   F.sum("rk").alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_join_one_stream_col_against_two_build_dictionaries(
+        session, tmp_path):
+    """One stream ordinal equi-joined against two build columns whose
+    dictionaries DIFFER cannot share one code remap: those key positions
+    must fall back to value comparison (a single remap into either
+    build dictionary's code space silently mismatches the other)."""
+    rng = np.random.default_rng(22)
+    vals = ["open", "closed", "pending"]
+    lpath = str(tmp_path / "l.parquet")
+    pq.write_table(pa.table({
+        "status": rng.choice(vals, size=4000).astype(object),
+        "v": rng.integers(0, 100, size=4000)}), lpath,
+        use_dictionary=True, row_group_size=2500)
+    rpath = str(tmp_path / "r.parquet")
+    pq.write_table(pa.table({
+        "a": rng.choice(vals, size=400).astype(object),
+        "b": rng.choice(vals + ["archived", "stale"],
+                        size=400).astype(object),
+        "rw": rng.integers(0, 9, size=400)}), rpath, use_dictionary=True)
+
+    def q(s):
+        left = s.read.parquet(lpath)
+        right = s.read.parquet(rpath)
+        return left.join(
+            right, (left["status"] == right["a"]) &
+            (left["status"] == right["b"]), "inner") \
+            .groupBy("status").agg(F.count("*").alias("c"),
+                                   F.sum("rw").alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_chunk_dict_only_page_walk(session, tmp_path):
+    """`chunk_dict_only` proves dict-only-ness from page HEADERS: a
+    mid-chunk PLAIN fallback chunk carries the SAME footer encodings as
+    a pure-dict chunk, so the footer alone must never yield 'certain' —
+    the analyzer's ceiling reduction rides on this proof."""
+    from spark_rapids_tpu.io import parquet_device as PD
+    from spark_rapids_tpu.io.scan import TpuFileScanExec
+
+    pure = str(tmp_path / "pure.parquet")
+    rng = np.random.default_rng(23)
+    pq.write_table(pa.table({
+        "s": rng.choice(["open", "closed", "pending"],
+                        size=4000).astype(object)}), pure,
+        use_dictionary=True)
+    # high ndv + tiny dictionary page limit forces a mid-chunk PLAIN
+    # fallback; the footer still reports {PLAIN, RLE, RLE_DICTIONARY}
+    fb = str(tmp_path / "fb.parquet")
+    pq.write_table(pa.table({
+        "s": np.array([f"val_{i % 1500:05d}_{'x' * 20}"
+                       for i in range(4000)], dtype=object)}), fb,
+        use_dictionary=True, dictionary_pagesize_limit=2048,
+        data_page_size=4096)
+    md_p = pq.ParquetFile(pure).metadata.row_group(0).column(0)
+    md_f = pq.ParquetFile(fb).metadata.row_group(0).column(0)
+    assert set(md_p.encodings) == set(md_f.encodings)  # indistinguishable
+    assert PD.chunk_dict_only(pure, md_p) is True
+    assert PD.chunk_dict_only(fb, md_f) is False
+
+    def find_scan(node):
+        if isinstance(node, TpuFileScanExec):
+            return node
+        for c in node.children:
+            got = find_scan(c)
+            if got is not None:
+                return got
+        return None
+
+    # plan-time mirror: the pure chunk may claim 'certain', the
+    # fallback chunk must not (ndv here fails the heuristic anyway,
+    # so it simply never reaches 'certain')
+    scan = find_scan(session._physical_plan(
+        session.read.parquet(pure)._plan))
+    if scan is not None:
+        assert scan.encoded_plan(session.conf).get("s") == "certain"
+
+
+@pytest.mark.slow
+def test_unsupported_predicate_materializes_visibly(session, tmp_path):
+    """A non-equality use (LIKE-style compare) cannot run on codes: the
+    column decodes through materialize() — counted, never silent."""
+    path = _write_dict_heavy(tmp_path, seed=3)
+    if not _scan_emits_encoded(session, path):
+        pytest.skip("scan did not emit encoded columns")
+    got = run_on_tpu(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("status") > F.lit("m"))   # ordering needs values
+        .groupBy("status").agg(F.count("*").alias("c")))
+    assert session.last_query_metrics["lateMaterializations"] >= 1
+    cpu = run_on_cpu(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("status") > F.lit("m"))
+        .groupBy("status").agg(F.count("*").alias("c")))
+    assert sorted(got) == sorted(cpu)
+
+
+# ---------------------------------------------------------------------------
+# The flagship contract: filter + group-by entirely in code space
+# ---------------------------------------------------------------------------
+def test_flagship_zero_materializations_before_sink(session, tmp_path):
+    """Dictionary-heavy filter + group-by runs end-to-end on codes: the
+    ONLY late materializations are the sink's host expansions of the
+    encoded output key column (one per output batch), pinned by the
+    lateMaterializations metric. The tpulint eager-materialize gate
+    (tests/test_lint_clean.py) pins the static half: no unsanctioned
+    decode call sites exist in exec/engine code."""
+    path = _write_dict_heavy(tmp_path, seed=5, n=8000)
+    if not _scan_emits_encoded(session, path):
+        pytest.skip("scan did not emit encoded columns")
+    got = run_on_tpu(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag") == F.lit("A"))
+        .groupBy("status").agg(F.count("*").alias("c"),
+                               F.sum("v").alias("t")))
+    m = session.last_query_metrics
+    assert m["encodedColumns"] > 0
+    assert m["encodedBytesSaved"] > 0
+    # the final-agg output is ONE batch with ONE encoded column (status):
+    # exactly one sink-side expansion, nothing before finalize
+    assert m["lateMaterializations"] == 1
+    cpu = run_on_cpu(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag") == F.lit("A"))
+        .groupBy("status").agg(F.count("*").alias("c"),
+                               F.sum("v").alias("t")))
+    assert sorted(got) == sorted(cpu)
+
+
+def test_encoded_through_fused_stage(session, tmp_path):
+    """A scan-form fused stage (filter+project, no aggregate) keeps the
+    passthrough column encoded through the composed program."""
+    path = _write_dict_heavy(tmp_path, seed=6)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag") == F.lit("B"))
+        .select("status", "v"),
+        ignore_order=True,
+        extra_conf={"rapids.tpu.sql.fusion.enabled": True})
+    assert session.last_query_metrics["encodedColumns"] > 0
+
+
+@pytest.mark.slow
+def test_encoded_off_matches_on(session, tmp_path):
+    """Conf off really disables the subsystem; both modes oracle-equal."""
+    path = _write_dict_heavy(tmp_path, seed=8)
+
+    def q(s):
+        return s.read.parquet(path) \
+            .filter(F.col("flag") == F.lit("A")) \
+            .groupBy("status").agg(F.sum("v").alias("t"))
+
+    on = run_on_tpu(session, q)
+    m_on = dict(session.last_query_metrics)
+    off = run_on_tpu(session, q, extra_conf={
+        "rapids.tpu.sql.encoded.enabled": False})
+    m_off = dict(session.last_query_metrics)
+    assert sorted(on) == sorted(off)
+    assert m_off["encodedColumns"] == 0
+    if m_on["encodedColumns"] == 0:
+        pytest.skip("scan did not emit encoded columns (heuristic)")
+
+
+def test_max_dict_fraction_gates_encoding(session, tmp_path):
+    """A near-unique column (ndv ~ rows) must NOT stay encoded under the
+    default heuristic."""
+    rng = np.random.default_rng(0)
+    n = 2000
+    uniq = np.array([f"u{i:06d}" for i in range(n)], dtype=object)
+    rng.shuffle(uniq)
+    tbl = pa.table({"u": uniq, "v": rng.integers(0, 10, size=n)})
+    path = str(tmp_path / "uniq.parquet")
+    pq.write_table(tbl, path, use_dictionary=True)
+    run_on_tpu(session, lambda s: s.read.parquet(path)
+               .filter(F.col("v") >= F.lit(0)))
+    assert session.last_query_metrics["encodedColumns"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Shuffle bytes: serialized pieces ship codes + one dictionary copy
+# ---------------------------------------------------------------------------
+def test_serialized_shuffle_ships_codes(session, tmp_path):
+    from spark_rapids_tpu.columnar.serde import serialize_batch
+
+    path = _write_dict_heavy(tmp_path, seed=9, n=4000)
+    if not _scan_emits_encoded(session, path):
+        pytest.skip("scan did not emit encoded columns")
+
+    def q(s):
+        return s.read.parquet(path).groupBy("status", "flag").agg(
+            F.sum("v").alias("t"))
+
+    from tests.harness import assert_rows_equal
+
+    base = {"rapids.tpu.shuffle.serialize.enabled": True}
+    on = run_on_tpu(session, q, extra_conf=base)
+    off = run_on_tpu(session, q, extra_conf={
+        **base, "rapids.tpu.sql.encoded.enabled": False})
+    assert_rows_equal(off, on, ignore_order=True)
+
+
+def test_serde_roundtrip_encoded_host_column(session):
+    from spark_rapids_tpu.columnar.batch import HostColumnarBatch
+    from spark_rapids_tpu.columnar.serde import (
+        deserialize_batch,
+        serialize_batch,
+        serialized_size,
+    )
+
+    d = ENC.DeviceDictionary.from_values(["x", "yy", "zzz"])
+    codes = np.array([0, 2, 1, 0, 2, 0], dtype=np.int32)
+    validity = np.array([True, True, True, True, True, False])
+    hc = ENC.HostDictionaryColumn(DataType.STRING, codes, validity, d)
+    hb = HostColumnarBatch([hc], 6)
+    blob = serialize_batch(hb)
+    assert len(blob) == serialized_size(hb)
+    back = deserialize_batch(blob)
+    col = back.columns[0]
+    assert isinstance(col, ENC.HostDictionaryColumn)
+    # every entry referenced -> the pruned table equals the original, and
+    # interning maps identical content onto the SAME object
+    assert col.dictionary is d
+    assert col.to_pylist() == ["x", "zzz", "yy", "x", "zzz", None]
+    # round trip through the device: stays encoded
+    dev = back.to_device()
+    assert ENC.is_encoded(dev.columns[0])
+    assert dev.columns[0].dictionary is d
+    host = dev.to_host()
+    assert host.columns[0].to_pylist() == \
+        ["x", "zzz", "yy", "x", "zzz", None]
+
+
+def test_serde_prunes_dictionary_per_piece():
+    """A piece referencing a subset of the dictionary ships only the
+    entries it uses (per-piece dictionary pruning), and round-trips."""
+    from spark_rapids_tpu.columnar.batch import HostColumnarBatch
+    from spark_rapids_tpu.columnar.serde import (
+        deserialize_batch,
+        serialize_batch,
+        serialized_size,
+    )
+
+    big = ENC.DeviceDictionary.from_values(
+        [f"value_{i:04d}" for i in range(1000)])
+    codes = np.array([7, 7, 42, 7, 42], dtype=np.int32)
+    validity = np.ones(5, dtype=bool)
+    hb = HostColumnarBatch(
+        [ENC.HostDictionaryColumn(DataType.STRING, codes, validity, big)],
+        5)
+    blob = serialize_batch(hb)
+    assert len(blob) == serialized_size(hb)
+    # pruned: far smaller than shipping all 1000 entries (~10KB)
+    assert len(blob) < 200
+    back = deserialize_batch(blob)
+    assert back.columns[0].to_pylist() == \
+        ["value_0007", "value_0007", "value_0042", "value_0007",
+         "value_0042"]
+    assert back.columns[0].dictionary.size == 2
+
+
+def test_serialized_size_smaller_than_expanded():
+    """Codes + one dictionary copy beat expanded strings by >= 2x on
+    dictionary-heavy data (the shuffle-bytes win, measured exactly)."""
+    from spark_rapids_tpu.columnar.batch import (
+        HostColumnVector,
+        HostColumnarBatch,
+    )
+    from spark_rapids_tpu.columnar.serde import serialized_size
+
+    n = 4000
+    values = ["alpha", "bravo", "charlie", "delta"]
+    d = ENC.DeviceDictionary.from_values(values)
+    codes = np.arange(n, dtype=np.int32) % 4
+    validity = np.ones(n, dtype=bool)
+    enc_b = HostColumnarBatch(
+        [ENC.HostDictionaryColumn(DataType.STRING, codes, validity, d)], n)
+    expanded = np.array([values[c] for c in codes], dtype=object)
+    dec_b = HostColumnarBatch(
+        [HostColumnVector(DataType.STRING, expanded, validity)], n)
+    assert serialized_size(dec_b) >= 2 * serialized_size(enc_b)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer: encoded byte model, savings containment, decode point
+# ---------------------------------------------------------------------------
+def test_analyzer_predicts_encoded_savings_and_decode_point(
+        session, tmp_path):
+    path = _write_dict_heavy(tmp_path, seed=11, n=10000)
+
+    def q(s):
+        return s.read.parquet(path) \
+            .filter(F.col("flag") == F.lit("A")) \
+            .groupBy("status").agg(F.sum("v").alias("t"))
+
+    got = run_on_tpu(session, q)
+    assert got is not None
+    m = dict(session.last_query_metrics)
+    if m["encodedColumns"] == 0:
+        pytest.skip("scan did not emit encoded columns")
+    report = session.last_resource_report
+    assert report is not None and report.encoded_cols > 0
+    # containment: measured savings inside the predicted interval
+    saved = m["encodedBytesSaved"]
+    assert report.encoded_saved.lo <= saved <= report.encoded_saved.hi
+    # the decode point: codes survive to the result sink
+    assert "sink" in report.decode_points
+    # the encoded byte model is >= 2x smaller than the decoded equivalent
+    assert report.encoded_decoded_bytes.hi >= \
+        2 * report.encoded_code_bytes.hi > 0
+
+
+def test_analyzer_peak_not_higher_with_encoding(session, tmp_path):
+    path = _write_dict_heavy(tmp_path, seed=12, n=10000)
+
+    def q(s):
+        return s.read.parquet(path) \
+            .filter(F.col("flag") == F.lit("A")) \
+            .groupBy("status").agg(F.sum("v").alias("t"))
+
+    run_on_tpu(session, q)
+    rep_on = session.last_resource_report
+    run_on_tpu(session, q, extra_conf={
+        "rapids.tpu.sql.encoded.enabled": False})
+    rep_off = session.last_resource_report
+    if rep_on is None or rep_off is None or rep_on.encoded_cols == 0:
+        pytest.skip("no encoded prediction")
+    assert rep_on.peak_bytes.hi <= rep_off.peak_bytes.hi
+
+
+def test_verifier_rejects_bogus_encoded_claim(session, tmp_path):
+    from spark_rapids_tpu.plan.verify import verify_plan
+
+    path = _write_dict_heavy(tmp_path, seed=13, n=500)
+    df = session.read.parquet(path)
+    physical = session._physical_plan(df._plan)
+
+    def find_scan(node):
+        from spark_rapids_tpu.io.scan import TpuFileScanExec
+
+        if isinstance(node, TpuFileScanExec):
+            return node
+        for c in node.children:
+            got = find_scan(c)
+            if got is not None:
+                return got
+        return None
+
+    scan = find_scan(physical)
+    if scan is None:
+        pytest.skip("no device scan in plan")
+    # corrupt the cached claim: a column the scan does not output
+    scan._encoded_plan_cache = ((True, 0.5), {"no_such_col": "certain"})
+    violations = verify_plan(physical)
+    assert any("encoded-column claim" in str(v) for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# DictionaryColumn unit behavior
+# ---------------------------------------------------------------------------
+def test_dictionary_interning_and_remap():
+    d1 = ENC.DeviceDictionary.from_values(["a", "b", "c"])
+    d2 = ENC.DeviceDictionary.from_values(["a", "b", "c"])
+    assert d1 is d2  # content-interned
+    d3 = ENC.DeviceDictionary.from_values(["b", "x", "a"])
+    remap = d3.remap_to(d1)
+    assert list(remap) == [1, -1, 0]
+    assert d1.code_of("b") == 1
+    assert d1.code_of("absent") == -1
+
+
+def test_materialize_counts_and_roundtrips(session):
+    import jax.numpy as jnp
+
+    d = ENC.DeviceDictionary.from_values(["aa", "b", "cccc"])
+    codes = jnp.asarray(np.array([2, 0, 1, 0, 0, 0, 0, 0], np.int32))
+    validity = jnp.asarray(
+        np.array([True, True, True, False] + [False] * 4))
+    cv = ENC.DictionaryColumn(DataType.STRING, codes, validity, d)
+    from spark_rapids_tpu.utils import metrics as M
+
+    before = M.late_materialization_count()
+    out = ENC.materialize(cv)
+    assert M.late_materialization_count() == before + 1
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+    host = ColumnarBatch([out], 4).to_host()
+    assert host.columns[0].to_pylist() == ["cccc", "aa", "b", None]
+
+
+def test_concat_aligns_different_dictionaries(session):
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+
+    d1 = ENC.DeviceDictionary.from_values(["a", "b"])
+    d2 = ENC.DeviceDictionary.from_values(["b", "z"])
+    mk = lambda d, codes, n: ColumnarBatch(  # noqa: E731
+        [ENC.DictionaryColumn(
+            DataType.STRING, jnp.asarray(np.asarray(codes, np.int32)),
+            jnp.asarray(np.array([True] * n + [False] *
+                                 (len(codes) - n))), d)], n)
+    b1 = mk(d1, [0, 1, 1, 0, 0, 0, 0, 0], 4)      # a b b a
+    b2 = mk(d2, [1, 0, 0, 0, 0, 0, 0, 0], 3)      # z b b
+    out = concat_batches([b1, b2])
+    assert ENC.is_encoded(out.columns[0])
+    host = out.to_host()
+    assert host.columns[0].to_pylist() == \
+        ["a", "b", "b", "a", "z", "b", "b"]
+
+
+def test_align_encoded_many_pieces_single_union(session):
+    """align_encoded merges ALL distinct dictionaries in one pass: codes
+    stay correct across 3+ overlapping dictionaries, and when the base
+    already covers every value the base dictionary itself is reused."""
+    import jax.numpy as jnp
+
+    mk = lambda d, codes: ENC.DictionaryColumn(  # noqa: E731
+        DataType.STRING, jnp.asarray(np.asarray(codes, np.int32)),
+        jnp.asarray(np.ones(len(codes), dtype=bool)), d)
+    d1 = ENC.DeviceDictionary.from_values(["a", "b", "c"])
+    d2 = ENC.DeviceDictionary.from_values(["c", "d"])
+    d3 = ENC.DeviceDictionary.from_values(["d", "a", "e"])
+    union, cols = ENC.align_encoded(
+        [mk(d1, [0, 2]), mk(d2, [1, 0]), mk(d3, [2, 1])])
+    assert union.size == 5       # a b c d e, each interned once
+    vals = union.host_values()
+    got = [[vals[int(c)] for c in np.asarray(col.data)] for col in cols]
+    assert got == [["a", "c"], ["d", "c"], ["e", "a"]]
+    # base codes are union codes unchanged
+    assert [vals[i] for i in range(3)] == ["a", "b", "c"]
+    # base covering every value: no new dictionary is interned
+    sub = ENC.DeviceDictionary.from_values(["b", "c"])
+    union2, _ = ENC.align_encoded([mk(d1, [0]), mk(sub, [1])])
+    assert union2 is d1
+
+
+def test_mixed_bare_and_computed_partition_keys(session, tmp_path):
+    """Hash partitioning where an encoded column is BOTH a bare key and
+    referenced inside a computed key expression: the ordinal
+    materializes and its bare key hashes the values (bit-identical) —
+    previously this crashed the exchange map task."""
+    path = _write_dict_heavy(tmp_path, seed=17, row_group_size=1000)
+
+    def q(s):
+        return s.read.parquet(path) \
+            .repartition(4, F.col("status"), F.length(F.col("status"))) \
+            .groupBy("status").agg(F.count("*").alias("c"),
+                                   F.sum("v").alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection at the materialize site
+# ---------------------------------------------------------------------------
+def test_fault_injection_at_materialize_site(session, tmp_path):
+    """Injected OOM at encoded.materialize: spill+retry owns it, the
+    query completes oracle-equal."""
+    path = _write_dict_heavy(tmp_path, seed=21, n=3000)
+
+    def q(s):
+        # the ORDER BY forces a sort-boundary materialize
+        return s.read.parquet(path) \
+            .groupBy("status").agg(F.sum("v").alias("t")) \
+            .orderBy("status")
+
+    cpu = run_on_cpu(session, q)
+    got = run_on_tpu(session, q, extra_conf={
+        "rapids.tpu.test.faultInjection.enabled": True,
+        "rapids.tpu.test.faultInjection.sites": "encoded.materialize",
+        "rapids.tpu.test.faultInjection.rate": 1.0,
+        "rapids.tpu.test.faultInjection.seed": 3,
+    })
+    assert got == cpu
+    m = session.last_query_metrics
+    if m["encodedColumns"]:
+        assert m["retries"] + m["cpuFallbackEvents"] >= 1
+
+
+def test_spmd_stage_fallback_with_encoded(session, tmp_path):
+    """SPMD enabled over an encoded scan: the stage either lowers (after
+    the boundary decode) or falls back to the host loop — both paths
+    oracle-equal."""
+    path = _write_dict_heavy(tmp_path, seed=22, n=4000)
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .filter(F.col("flag") == F.lit("A"))
+        .groupBy("status").agg(F.count("*").alias("c"),
+                               F.sum("v").alias("t")),
+        ignore_order=True,
+        extra_conf={"rapids.tpu.sql.spmd.enabled": True})

@@ -103,6 +103,49 @@ def test_mid_query_sync_pragma_waiver():
     assert lint(src, path=ENGINE) == []
 
 
+# ---------------------------------------------------------------------------
+# eager-materialize (the compressed-execution decode contract;
+# docs/compressed-execution.md)
+# ---------------------------------------------------------------------------
+def test_eager_materialize_flagged_in_exec():
+    src = ("def f(ENC, cv):\n"
+           "    return ENC.materialize(cv)\n")
+    assert rules_of(lint(src)) == ["eager-materialize"]
+
+
+def test_eager_materialize_decode_batch_flagged_in_engine():
+    src = ("def f(ENC, b):\n"
+           "    return ENC.decode_batch(b)\n")
+    assert rules_of(lint(src, path=ENGINE)) == ["eager-materialize"]
+
+
+def test_eager_materialize_batch_with_materialized_flagged():
+    src = ("def f(ENC, b, ords):\n"
+           "    return ENC.batch_with_materialized(b, ords)\n")
+    assert rules_of(lint(src)) == ["eager-materialize"]
+
+
+def test_eager_materialize_not_flagged_outside_executor_layers():
+    # columnar/ and plan/ own the decode helpers themselves
+    src = ("def f(ENC, cv):\n"
+           "    return ENC.materialize(cv)\n")
+    assert lint(src, path=COLD) == []
+
+
+def test_eager_materialize_host_scope_exempt():
+    src = ("def cpu_fallback(ENC, b):\n"
+           "    return ENC.decode_batch(b)\n")
+    assert lint(src) == []
+
+
+def test_eager_materialize_pragma_waiver():
+    src = ("def f(ENC, b):\n"
+           "    # tpulint: eager-materialize -- sort boundary: code order\n"
+           "    # is not value order\n"
+           "    return ENC.decode_batch(b)\n")
+    assert lint(src) == []
+
+
 def test_host_sync_cpu_oracle_scope_exempt():
     src = ("import numpy as np\n"
            "def cpu_filter(x):\n"

@@ -74,6 +74,17 @@ the line above; `-- reason` after the rule names documents the waiver):
               `Metric(...)` or `threading.*`/`contextvars.*` constructor
               are sanctioned (thread-safe by construction); a justified
               write (held lock, documented init-once) carries a pragma.
+  eager-materialize  a decode of an ENCODED (dictionary) column —
+              columnar.encoded.materialize / decode_batch /
+              batch_with_materialized — in hot-path/executor code
+              (exec/, shuffle/, engine/, ops/eval.py). The compressed-
+              execution contract (docs/compressed-execution.md) is that
+              codes stay codes until a sanctioned sink/finalize/boundary
+              site; every decode call in these layers must carry a
+              justified pragma naming WHY that operator needs the values,
+              so an accidental decode on the hot path (which silently
+              multiplies HBM and shuffle bytes back up) cannot land
+              unreviewed. Host/CPU-oracle scopes are exempt.
   pragma      tpulint pragma hygiene: unknown rule name, or a pragma
               that suppresses nothing (stale waiver).
 """
@@ -98,8 +109,13 @@ RULES = (
     "untracked-alloc",
     "naked-dispatch",
     "shared-state-mutation",
+    "eager-materialize",
     "pragma",
 )
+
+# the encoded-column decode entry points (columnar/encoded.py): the ONLY
+# paths from dictionary codes back to values (eager-materialize rule)
+_MATERIALIZE_FNS = {"materialize", "decode_batch", "batch_with_materialized"}
 
 # the fault-tolerance combinators (engine/retry.py): a callable passed to
 # one of these has its dispatches covered by the retry state machine
@@ -640,6 +656,17 @@ class _Visitor(ast.NodeVisitor):
                            "hot-path code; concurrent queries race on it "
                            "— guard it in a lifecycle path or justify "
                            "with a pragma")
+
+        # eager-materialize: an encoded-column decode in hot-path/executor
+        # code must be a justified, reviewed boundary (the compressed-
+        # execution contract, docs/compressed-execution.md)
+        if self.shared_scope and tail in _MATERIALIZE_FNS and \
+                not self._host_scope():
+            self._flag(node, "eager-materialize",
+                       f"{tail}() decodes an encoded (dictionary) column "
+                       "on a hot path; keep computing on the codes, or "
+                       "justify the boundary decode with a pragma naming "
+                       "why this operator needs the values")
 
         # naked-dispatch: a dispatch site outside the retry combinators
         if self.hot and tail == "record_dispatch" and \

@@ -1560,6 +1560,95 @@ def main_encoded() -> None:
     print(json.dumps(summary))
 
 
+def main_skew() -> None:
+    """Skew suite (`python bench.py --skew`): a q5-like join whose
+    fact-side key is Zipf-hot (one key takes ~half the rows) joined to a
+    small dimension and aggregated — the shape where the static plan
+    hot-spots one reduce task. Runs AQE off vs on (docs/
+    adaptive-execution.md; serialized shuffle tier so MapOutputStats see
+    exact per-bucket bytes) and records wall time, the adaptive metrics
+    (skewSplits / aqeReplans / joinDemotions), and the stream-side task
+    balance the skew-split spec achieves. Writes BENCH_r11.json."""
+    import jax
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.plan import functions as F
+
+    platform = jax.devices()[0].platform
+    rows = int(os.environ.get("SRT_SKEW_ROWS", "400000"))
+    iters = int(os.environ.get("SRT_SKEW_ITERS", "3"))
+    rng = np.random.default_rng(42)
+    hot = rng.random(rows) < 0.5
+    k = np.where(hot, 0, rng.integers(1, 200, rows)).astype(np.int64)
+    v = rng.integers(0, 1000, rows).astype(np.int64)
+
+    def run_mode(adaptive: bool) -> dict:
+        s = srt.new_session()
+        s.conf.set(C.SHUFFLE_SERIALIZE.key, True)
+        s.conf.set(C.BROADCAST_THRESHOLD.key, 0)
+        s.conf.set(C.RUNTIME_BROADCAST.key, False)
+        s.conf.set(C.ADAPTIVE_ENABLED.key, adaptive)
+        s.conf.set(C.SKEW_JOIN_THRESHOLD.key, 64 << 10)
+        s.conf.set(C.SKEW_JOIN_FACTOR.key, 2.0)
+        s.conf.set(C.ADAPTIVE_TARGET_BYTES.key, 1 << 20)
+        try:
+            fact = s.createDataFrame(
+                {"k": k, "v": v}, [("k", "long"), ("v", "long")],
+                num_partitions=8)
+            dim = s.createDataFrame(
+                {"k": np.arange(200, dtype=np.int64),
+                 "region": (np.arange(200, dtype=np.int64) % 7)},
+                [("k", "long"), ("region", "long")], num_partitions=2)
+            q = fact.join(dim, on="k", how="inner") \
+                .groupBy("region").agg(F.sum("v").alias("rev"),
+                                       F.count("*").alias("n"))
+            q.collect()  # warmup/compile
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                out = q.collect()
+                times.append(time.perf_counter() - t0)
+            m = dict(s.last_query_metrics)
+            return {
+                "best_s": min(times),
+                "times_s": [round(t, 4) for t in times],
+                "rows_out": len(out),
+                "result": sorted(tuple(r) for r in out),
+                "skew_splits": m.get("skewSplits", 0),
+                "aqe_replans": m.get("aqeReplans", 0),
+                "join_demotions": m.get("joinDemotions", 0),
+                "notes": list(s.last_adaptive_report),
+            }
+        finally:
+            s.stop()
+
+    _log("skew: AQE-off run")
+    off = run_mode(False)
+    _log("skew: AQE-on run")
+    on = run_mode(True)
+    result = {
+        "metric": "skewed_join_wall_s",
+        "value": on["best_s"],
+        "unit": "s",
+        "vs_baseline": (round(off["best_s"] / on["best_s"], 3)
+                        if on["best_s"] else 0.0),
+        "platform": platform,
+        "rows": rows,
+        "hot_key_fraction": 0.5,
+        "aqe_off": off,
+        "aqe_on": on,
+        "results_equal": off.pop("result") == on.pop("result"),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r11.json")
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+        fh.write("\n")
+    _emit(result)
+
+
 def main_serving() -> None:
     """Serving suite (`python bench.py --serving`): closed-loop clients
     over the multi-tenant runtime, plan cache OFF vs ON (docs/serving.md).
@@ -1622,6 +1711,8 @@ if __name__ == "__main__":
         main_shuffle()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--serving":
         main_serving()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--skew":
+        main_skew()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--encoded":
         main_encoded()
     else:

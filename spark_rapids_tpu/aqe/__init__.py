@@ -1,0 +1,26 @@
+"""Adaptive query execution (docs/adaptive-execution.md).
+
+Runtime-stats-driven re-optimization between shuffle stages — the role
+Spark AQE plays for the reference plugin (whose adaptive suites run the
+plugin under spark.sql.adaptive.enabled). Behind
+`rapids.tpu.sql.adaptive.enabled`:
+
+- stats.py     per-exchange MapOutputStats, collected from host-known
+               piece metadata with ZERO extra device syncs
+- coalesce.py  the unified partition-coalescing logic (moved here from
+               shuffle/exchange.py) and the ONE runtime gate that
+               enforces the never-coalesce pins
+- stages.py    TpuQueryStageExec (a materialized exchange boundary) and
+               TpuStageReaderExec (an explicit post-stage partition spec:
+               coalesced groups / skew sub-splits — the AQEShuffleRead
+               analog)
+- rules.py     the re-optimization rule catalog: skew-split, join
+               demotion/promotion, unified coalescing
+- loop.py      TpuAdaptiveExec and the stage-by-stage re-optimization
+               loop, including static re-validation (plan/verify.py +
+               plan/resources.py with measured stats) and admission
+               re-posting
+"""
+
+from spark_rapids_tpu.aqe.coalesce import coalesce_groups  # noqa: F401
+from spark_rapids_tpu.aqe.stats import MapOutputStats  # noqa: F401

@@ -1,0 +1,99 @@
+"""Unified adaptive partition coalescing (the CoalesceShufflePartitions
+role, moved here from shuffle/exchange.py so ONE module owns every
+coalescing decision and the never-coalesce pins).
+
+Three consumers share the grouping math below:
+
+- the runtime gate `maybe_coalesce_runtime` — the non-adaptive engine's
+  behavior, identical to the pre-AQE side effect: an exchange's freshly
+  regrouped reduce buckets merge while small. This is the ONLY place the
+  `allow_adaptive` pin (user `repartition(n)`, join-feeding exchanges)
+  is consulted at runtime, so the never-coalesce contract cannot drift
+  between call sites.
+- the AQE CoalescePartitions rule (aqe/rules.py) — with
+  `rapids.tpu.sql.adaptive.enabled` the runtime gate stands down (the
+  stage materializes raw) and coalescing becomes an explicit
+  TpuStageReaderExec in the plan, visible to EXPLAIN, the verifier, and
+  the analyzer instead of a runtime side effect.
+- the shuffled join's coordinated grouping (exec/join.py
+  coalesce_join_inputs) — both inputs group identically from their
+  combined per-bucket costs.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import List
+
+from spark_rapids_tpu import conf as C
+
+# True while the adaptive loop (aqe/loop.py) is materializing an exchange
+# as a query stage: the runtime coalesce gate stands down so the AQE
+# coalesce RULE owns the decision (and the raw per-bucket stats survive
+# for skew detection)
+_IN_ADAPTIVE_STAGE: "contextvars.ContextVar[bool]" = \
+    contextvars.ContextVar("srt_aqe_stage", default=False)
+
+
+def in_adaptive_stage() -> bool:
+    return _IN_ADAPTIVE_STAGE.get()
+
+
+def adaptive_stage_token():
+    """Enter adaptive-stage materialization; returns the reset token."""
+    return _IN_ADAPTIVE_STAGE.set(True)
+
+
+def adaptive_stage_reset(token) -> None:
+    _IN_ADAPTIVE_STAGE.reset(token)
+
+
+def coalesce_groups(costs: List[int], target: int) -> List[List[int]]:
+    """Greedy contiguous grouping: extend the current group while it stays
+    under `target` (every group keeps >= 1 bucket). Contiguity keeps
+    range-partition order; hash buckets union freely."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_cost = 0
+    for t, c in enumerate(costs):
+        if cur and cur_cost + c > target:
+            groups.append(cur)
+            cur, cur_cost = [], 0
+        cur.append(t)
+        cur_cost += c
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def coordinated_groups(left_costs: List[int], right_costs: List[int],
+                       target: int) -> List[List[int]]:
+    """One grouping for BOTH inputs of a shuffled join, from the combined
+    per-bucket costs (Spark AQE's coordinated CoalesceShufflePartitions)."""
+    combined = [lc + rc for lc, rc in zip(left_costs, right_costs)]
+    return coalesce_groups(combined, target)
+
+
+def maybe_coalesce_runtime(exchange, pb, conf):
+    """The ONE runtime coalescing gate, applied by _ExchangeBase after it
+    regroups its reduce buckets. No-ops when:
+
+    - the adaptive loop is materializing this exchange as a stage (the
+      plan-level CoalescePartitions rule owns the decision instead);
+    - the exchange is pinned (`allow_adaptive=False`: user repartition(n)
+      fan-out, or a join input that must keep its co-partitioning);
+    - coalescing is off, or there is nothing to merge.
+    """
+    if in_adaptive_stage():
+        return pb
+    if not exchange.allow_adaptive or pb.num_partitions <= 1:
+        return pb
+    if not conf.get(C.ADAPTIVE_COALESCE):
+        return pb
+    groups = coalesce_groups(pb.bucket_costs,
+                             conf.get(C.ADAPTIVE_TARGET_BYTES))
+    if len(groups) == pb.num_partitions:
+        return pb
+    exchange.metrics["coalescedPartitions"].add(
+        pb.num_partitions - len(groups))
+    return pb.grouped(groups)

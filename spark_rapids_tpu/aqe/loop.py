@@ -1,0 +1,280 @@
+"""TpuAdaptiveExec: the stage-by-stage adaptive re-optimization loop.
+
+With `rapids.tpu.sql.adaptive.enabled` the session wraps the final
+physical plan (below the result sink) in a TpuAdaptiveExec. execute()
+then drives Spark-AQE-shaped execution:
+
+1. pick a READY exchange (no unmaterialized exchange beneath it; build
+   sides of shuffled joins first, so the join-strategy rule can see the
+   measured build before the stream pays its shuffle);
+2. materialize it as a TpuQueryStageExec carrying the exchange's
+   PartitionedBatches + MapOutputStats (the runtime coalesce gate stands
+   down during stage materialization — aqe/coalesce.py — so the rule
+   passes own every regrouping decision);
+3. run the rule catalog (aqe/rules.py) over the not-yet-executed
+   remainder; when a rule fires, the rewritten remainder is statically
+   RE-VALIDATED — plan/verify.py re-checks it and plan/resources.py
+   re-analyzes it with MEASURED stats replacing leaf priors — and the
+   admission hints (semaphore query weight, spill plan reserve) are
+   re-posted from the measured report (metric: aqeReplans).
+
+Degradation contract: any failure in the re-optimization machinery
+(including the `aqe.replan` fault-injection site) abandons further
+rewrites and continues executing the ORIGINAL static plan shape —
+already-materialized stages are just that plan's exchanges already run,
+so results are never wrong, only less optimized. Failures inside stage
+EXECUTION itself keep their existing owners (task retry, spill/split
+retry, query-level CPU fallback).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.aqe import coalesce as AQC
+from spark_rapids_tpu.aqe.rules import apply_rules, _replace_node
+from spark_rapids_tpu.aqe.stages import TpuQueryStageExec, _unwrap_wrappers
+from spark_rapids_tpu.exec.base import (
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+)
+from spark_rapids_tpu.ops.base import AttributeReference
+from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger(__name__)
+
+
+class TpuAdaptiveExec(PhysicalExec):
+    """Schema/placement-transparent wrapper whose execute() runs the
+    adaptive loop over its subtree."""
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__(child)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    @property
+    def placement(self) -> str:
+        return self.children[0].placement
+
+    def with_children(self, new_children):
+        return TpuAdaptiveExec(new_children[0])
+
+    def output_partitioning(self):
+        return self.children[0].output_partitioning()
+
+    def node_name(self):
+        return "TpuAdaptiveExec"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        return run_adaptive(self.children[0], ctx)
+
+
+def maybe_wrap_adaptive(plan: PhysicalExec, conf) -> PhysicalExec:
+    """Wrap the final plan for adaptive execution — below the root sink,
+    so the issue-ahead lifted-sink fast path keeps seeing its
+    DeviceToHostExec root. Plans without a materializing exchange have no
+    stage boundary to re-optimize and stay untouched (with
+    adaptive.enabled=false every plan stays untouched)."""
+    if not conf.get(C.ADAPTIVE_ENABLED):
+        return plan
+    if not _subtree_exchanges(plan):
+        return plan
+    from spark_rapids_tpu.exec.transitions import DeviceToHostExec
+
+    if isinstance(plan, DeviceToHostExec):
+        return plan.with_children([TpuAdaptiveExec(plan.children[0])])
+    return TpuAdaptiveExec(plan)
+
+
+def _subtree_exchanges(node: PhysicalExec, out=None):
+    """Every materializing exchange in the tree, skipping SPMD stage
+    programs (their in-program all_to_all is not a stage boundary the
+    host loop can re-optimize across)."""
+    from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+
+    if out is None:
+        out = []
+    if isinstance(node, TpuSpmdStageExec):
+        return out
+    if isinstance(node, _ExchangeBase):
+        out.append(node)
+    for c in node.children:
+        _subtree_exchanges(c, out)
+    return out
+
+
+def _ready_exchanges(plan: PhysicalExec) -> List[PhysicalExec]:
+    """Exchanges whose subtrees contain no other unmaterialized exchange,
+    ordered build-side-first (a shuffled join's build input materializes
+    before its stream input, so join demotion can elide the stream
+    shuffle entirely)."""
+    all_ex = _subtree_exchanges(plan)
+    ready = [ex for ex in all_ex if not _subtree_exchanges(ex.children[0])]
+    if not ready:
+        return ready
+    build_first = set()
+
+    def mark(node):
+        from spark_rapids_tpu.aqe.rules import _is_shuffled_join
+
+        if _is_shuffled_join(node):
+            bidx = 0 if node.build_left else 1
+            inner = _unwrap_wrappers(node.children[bidx])
+            build_first.add(id(inner))
+        for c in node.children:
+            mark(c)
+
+    mark(plan)
+    ready.sort(key=lambda ex: 0 if id(ex) in build_first else 1)
+    return ready
+
+
+def _materialize_stage(ex, ctx: ExecContext, raw: bool = True):
+    """Execute one exchange as a stage; returns (PartitionedBatches,
+    MapOutputStats-or-None). raw=True stands the runtime coalesce gate
+    down (the rule passes own every regrouping); a DEGRADED loop passes
+    raw=False so remaining stages keep the static engine's runtime
+    coalescing — degradation must reproduce the static plan's behavior,
+    not a worse, never-coalesced one."""
+    if not raw:
+        pb = ex.execute(ctx)
+        return pb, pb.map_stats
+    token = AQC.adaptive_stage_token()
+    try:
+        pb = ex.execute(ctx)
+    finally:
+        AQC.adaptive_stage_reset(token)
+    return pb, pb.map_stats
+
+
+def _note(msg: str) -> None:
+    qctx = M.current_query_ctx()
+    if qctx is not None:
+        qctx.aqe_notes.append(msg)
+
+
+def _degrade_coalesce(plan: PhysicalExec, conf) -> None:
+    """Degradation parity: stages already materialized RAW (coalesce gate
+    stood down for the rule passes that just failed) regain the static
+    engine's runtime coalescing — pure grouping math through the same
+    single gate (aqe/coalesce.py), not a rule rewrite. Stages under an
+    adopted reader keep their re-validated spec untouched."""
+    from spark_rapids_tpu.aqe.stages import TpuStageReaderExec
+
+    def walk(node):
+        if isinstance(node, TpuStageReaderExec):
+            return
+        if isinstance(node, TpuQueryStageExec):
+            if node.pb.bucket_costs is not None:
+                node.pb = AQC.maybe_coalesce_runtime(node.exchange,
+                                                     node.pb, conf)
+            return
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+
+
+def _stats_map(plan: PhysicalExec) -> dict:
+    """The analyzer's measured_stats channel: every materialized stage's
+    MapOutputStats keyed by node id."""
+    out = {}
+
+    def walk(node):
+        if isinstance(node, TpuQueryStageExec) and node.stats is not None:
+            out[id(node)] = node.stats
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def _revalidate(plan: PhysicalExec, ctx: ExecContext) -> None:
+    """Static re-validation of a rewritten remainder: the plan verifier
+    re-checks it, and the resource analyzer re-runs with MEASURED stage
+    stats replacing leaf priors; the admission hints (semaphore query
+    weight, spill plan reserve) re-post from the measured report."""
+    conf = ctx.conf
+    if conf.get(C.PLAN_VERIFY):
+        from spark_rapids_tpu.plan.verify import (
+            PlanVerificationError,
+            verify_plan,
+        )
+
+        violations = verify_plan(plan)
+        if violations:
+            raise PlanVerificationError(violations)
+    if not conf.get(C.RESOURCE_ANALYSIS):
+        return
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.memory.spill import SpillFramework
+    from spark_rapids_tpu.plan.resources import analyze_plan
+
+    report = analyze_plan(plan, conf, device_manager=ctx.device_manager,
+                          measured_stats=_stats_map(plan))
+    qctx = M.current_query_ctx()
+    sem = TpuSemaphore.get()
+    if sem is not None:
+        weight = report.admission_weight(sem.max_concurrent)
+        sem.set_query_weight(weight)
+        if qctx is not None:
+            qctx.sem_weight = weight
+    if qctx is not None:
+        qctx.resource_report = report
+    fw = SpillFramework.get()
+    if fw is not None:
+        fw.set_plan_hint(report.spill_pressure,
+                         report.per_task_peak_bytes, ctx=qctx)
+
+
+def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
+    from spark_rapids_tpu.utils import faultinject as FI
+
+    sid = 0
+    degraded = False
+    while True:
+        ready = _ready_exchanges(plan)
+        if not ready:
+            break
+        ex = ready[0]
+        pb, stats = _materialize_stage(ex, ctx, raw=not degraded)
+        sid += 1
+        stage = TpuQueryStageExec(ex, pb, stats, sid)
+        plan = _replace_node(plan, ex, stage)
+        if degraded:
+            continue
+        try:
+            FI.maybe_inject("aqe.replan")
+            candidate, applied, effects = apply_rules(plan, ctx)
+            if applied:
+                _revalidate(candidate, ctx)
+                # only an ADOPTED rewrite counts: metrics record after
+                # re-validation, never for a discarded candidate
+                plan = candidate
+                M.record_aqe_replan()
+                for fx in effects:
+                    fx()
+                for note in applied:
+                    _note(note)
+        except Exception as e:  # noqa: BLE001 — degradation boundary
+            # the re-optimizer may never take a query down: abandon the
+            # rewrite (and all further rewrites) and keep executing the
+            # static plan shape — materialized stages are simply its
+            # exchanges already run, so results cannot be wrong
+            log.warning(
+                "adaptive re-optimization failed (%r); continuing with "
+                "the static plan", e)
+            _note(f"degraded to static plan after replan failure: {e!r}")
+            degraded = True
+            # already-materialized raw stages regain the static engine's
+            # runtime coalescing (stages under adopted readers keep them)
+            _degrade_coalesce(plan, ctx.conf)
+    return plan.execute(ctx)

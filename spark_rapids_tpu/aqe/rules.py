@@ -1,0 +1,402 @@
+"""The adaptive re-optimization rule catalog (docs/adaptive-execution.md).
+
+Each rule is a pure plan->plan pass over the NOT-yet-executed remainder,
+run by the loop (aqe/loop.py) after every stage materialization; rules
+consume the MEASURED MapOutputStats riding each TpuQueryStageExec instead
+of the analyzer's plan-time priors.
+
+- join strategy (demotion/promotion): a shuffled hash join whose measured
+  build side fits `rapids.tpu.sql.autoBroadcastJoinThreshold` rewrites to
+  the broadcast form AND DROPS the stream side's not-yet-executed
+  exchange (the stream never shuffles — the win Spark AQE's join-strategy
+  switch gets from reading map outputs directly); a statically-planned
+  broadcast join whose build subtree measured past the threshold (a blown
+  plan-time estimate — STRING sizes are estimated at a flat 16 B/row)
+  promotes back to the shuffled form with pinned hash exchanges.
+- skew-split + coordinated coalescing: when both inputs of a shuffled
+  join are materialized, an oversized STREAM bucket (> max(factor *
+  median, thresholdBytes)) splits into contiguous piece-range
+  sub-partitions with the BUILD bucket replicated opposite each, while
+  small buckets group under the advisory target — one aligned spec for
+  both sides (TpuStageReaderExec), so co-partitioning holds.
+- coalesce partitions (unified): a single-consumer stage merges small
+  buckets as an explicit reader node — the plan-visible form of the old
+  runtime side effect (aqe/coalesce.py owns the shared grouping math and
+  the never-coalesce pins).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.aqe.coalesce import coalesce_groups
+from spark_rapids_tpu.aqe.stages import (
+    TpuQueryStageExec,
+    TpuStageReaderExec,
+    _unwrap_wrappers,
+    describe_spec,
+    unwrap_to_stage,
+)
+from spark_rapids_tpu.exec.base import PhysicalExec
+from spark_rapids_tpu.plan.logical import JoinType
+from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger(__name__)
+
+
+def rule_catalog() -> List[str]:
+    return ["joinStrategy (broadcast demotion/promotion)",
+            "skewSplit (oversized stream bucket -> piece-range slices, "
+            "build replicated)",
+            "coalescePartitions (unified small-bucket grouping)"]
+
+
+def apply_rules(plan: PhysicalExec, ctx):
+    """Run the catalog over the remainder; returns (plan, applied notes,
+    deferred metric effects). Rules are idempotent across loop
+    iterations: each only fires on a pattern its own rewrite removes.
+    Metric recording is DEFERRED into `effects` (zero-arg callables the
+    loop runs only after static re-validation adopts the rewrite) — a
+    candidate discarded by a failed re-verify must not count as
+    applied."""
+    notes: List[str] = []
+    effects: List = []
+    if ctx.conf.get(C.ADAPTIVE_JOIN_STRATEGY):
+        plan = _join_strategy(plan, ctx, notes, effects)
+    plan = _skew_and_coalesce_joins(plan, ctx, notes, effects)
+    plan = _coalesce_single_stages(plan, ctx, notes, effects)
+    return plan, notes, effects
+
+
+def _replace_node(root: PhysicalExec, target: PhysicalExec,
+                  repl: PhysicalExec) -> PhysicalExec:
+    """Identity-based single-node substitution, rebuilding only the
+    ancestor spine (the replacement's own subtree is not revisited)."""
+    if root is target:
+        return repl
+    new_children = [_replace_node(c, target, repl) for c in root.children]
+    if all(a is b for a, b in zip(new_children, root.children)):
+        return root
+    return root.with_children(new_children)
+
+
+# ---------------------------------------------------------------------------
+# Join strategy: shuffle -> broadcast demotion, broadcast -> shuffle
+# promotion
+# ---------------------------------------------------------------------------
+def _join_classes():
+    from spark_rapids_tpu.exec.join import (
+        CpuBroadcastHashJoinExec,
+        CpuShuffledHashJoinExec,
+        TpuBroadcastHashJoinExec,
+        TpuShuffledHashJoinExec,
+    )
+
+    return (TpuShuffledHashJoinExec, CpuShuffledHashJoinExec,
+            TpuBroadcastHashJoinExec, CpuBroadcastHashJoinExec)
+
+
+def _is_shuffled_join(node) -> bool:
+    tpu_sh, cpu_sh, _tpu_bc, _cpu_bc = _join_classes()
+    return isinstance(node, (tpu_sh, cpu_sh)) and \
+        not getattr(node, "broadcast", False)
+
+
+def _is_broadcast_join(node) -> bool:
+    tpu_sh, cpu_sh, tpu_bc, _cpu_bc = _join_classes()
+    if isinstance(node, tpu_bc):
+        return True
+    return isinstance(node, cpu_sh) and getattr(node, "broadcast", False)
+
+
+def _find_stage(node: PhysicalExec) -> Optional[TpuQueryStageExec]:
+    if isinstance(node, TpuQueryStageExec):
+        return node
+    for c in node.children:
+        s = _find_stage(c)
+        if s is not None:
+            return s
+    return None
+
+
+# a measured build-side stage sizes the PRE-join subtree: operators
+# between the stage and the join (a final aggregate, filters) can only
+# shrink it, so promotion — which pays two fresh shuffles — demands this
+# much headroom over the threshold before calling the estimate blown
+_PROMOTION_SLACK = 2
+
+
+def _join_strategy(plan: PhysicalExec, ctx,
+                   notes: List[str], effects: List) -> PhysicalExec:
+    from spark_rapids_tpu.shuffle.exchange import (
+        CpuShuffleExchangeExec,
+        HashPartitioning,
+        TpuShuffleExchangeExec,
+        _ExchangeBase,
+    )
+
+    conf = ctx.conf
+    threshold = conf.get(C.BROADCAST_THRESHOLD)
+    tpu_sh, _cpu_sh, tpu_bc, cpu_bc = _join_classes()
+
+    def rewrite(node):
+        # -- demotion: shuffled -> broadcast on a measured small build ----
+        if _is_shuffled_join(node) and threshold > 0 and \
+                node.join_type is not JoinType.FULL_OUTER:
+            bidx = 0 if node.build_left else 1
+            b_stage = unwrap_to_stage(node.children[bidx])
+            s_inner = _unwrap_wrappers(node.children[1 - bidx])
+            if (b_stage is not None and b_stage.stats is not None
+                    and isinstance(s_inner, _ExchangeBase)
+                    and b_stage.stats.total_bytes <= threshold):
+                bcast_cls = tpu_bc if isinstance(node, tpu_sh) else cpu_bc
+                new_children = list(node.children)
+                # the stream side never shuffles: its planned exchange is
+                # dropped and the broadcast build probes the raw stream
+                new_children[1 - bidx] = _replace_node(
+                    node.children[1 - bidx], s_inner, s_inner.children[0])
+                nn = bcast_cls(node.left_keys, node.right_keys,
+                               node.join_type, node.condition,
+                               *new_children)
+                effects.append(M.record_join_demotion)
+                notes.append(
+                    f"joinDemotion: {type(node).__name__} -> "
+                    f"{bcast_cls.__name__} (measured build "
+                    f"{b_stage.stats.total_bytes}B <= threshold "
+                    f"{threshold}B; stream exchange elided)")
+                return nn
+        # -- promotion: broadcast -> shuffled on a blown estimate ---------
+        if _is_broadcast_join(node) and threshold > 0:
+            bidx = 0 if node.build_left else 1
+            stage = _find_stage(node.children[bidx])
+            if stage is not None and stage.stats is not None and \
+                    stage.stats.total_bytes > _PROMOTION_SLACK * threshold:
+                is_tpu = isinstance(node, tpu_bc)
+                sh_cls = tpu_sh if is_tpu else _join_classes()[1]
+                ex_cls = TpuShuffleExchangeExec if is_tpu \
+                    else CpuShuffleExchangeExec
+                n = conf.shuffle_partitions
+                # join-feeding exchanges are pinned (never coalesce), the
+                # same contract the static transition pass applies
+                lex = ex_cls(HashPartitioning(node.left_keys, n),
+                             node.children[0], allow_adaptive=False)
+                rex = ex_cls(HashPartitioning(node.right_keys, n),
+                             node.children[1], allow_adaptive=False)
+                nn = sh_cls(node.left_keys, node.right_keys,
+                            node.join_type, node.condition, lex, rex)
+                effects.append(M.record_join_promotion)
+                notes.append(
+                    f"joinPromotion: {type(node).__name__} -> "
+                    f"{sh_cls.__name__} (measured build-side stage "
+                    f"{stage.stats.total_bytes}B > "
+                    f"{_PROMOTION_SLACK}x threshold {threshold}B)")
+                return nn
+        return node
+
+    return plan.transform_up(rewrite)
+
+
+# ---------------------------------------------------------------------------
+# Skew-split + coordinated coalescing for shuffled joins
+# ---------------------------------------------------------------------------
+def _chunk_pieces(piece_costs: List[int], chunk_target: int,
+                  max_ranges: Optional[int] = None
+                  ) -> List[Tuple[int, int]]:
+    """Greedy contiguous piece ranges, each <= chunk_target + one piece
+    (no piece is ever divided). With max_ranges, adjacent ranges merge
+    (smallest combined bytes first) until the bound holds — the
+    conf-documented maxSplitsPerPartition is a hard cap even when the
+    per-chunk target would produce more."""
+    ranges: List[Tuple[int, int]] = []
+    lo = 0
+    acc = 0
+    for j, c in enumerate(piece_costs):
+        if j > lo and acc + c > chunk_target:
+            ranges.append((lo, j))
+            lo, acc = j, 0
+        acc += c
+    if lo < len(piece_costs):
+        ranges.append((lo, len(piece_costs)))
+    while max_ranges is not None and len(ranges) > max_ranges:
+        def pair_cost(i):
+            lo_i, hi_i = ranges[i]
+            _lo, hi_n = ranges[i + 1]
+            return sum(piece_costs[lo_i:hi_n])
+
+        i = min(range(len(ranges) - 1), key=pair_cost)
+        ranges[i:i + 2] = [(ranges[i][0], ranges[i + 1][1])]
+    return ranges
+
+
+def coordinated_join_spec(build_stats, stream_stats, conf,
+                          allow_split: bool):
+    """One ALIGNED partition spec pair for a shuffled join's two inputs:
+    (stream entries, build entries, buckets split). None = nothing to do.
+    Small buckets group under the advisory target from the COMBINED
+    per-bucket costs (the coordinated CoalesceShufflePartitions role);
+    an oversized stream bucket splits into piece-range slices with the
+    build bucket replicated opposite each."""
+    n = stream_stats.num_buckets
+    target = conf.get(C.ADAPTIVE_TARGET_BYTES)
+    coalesce_on = conf.get(C.ADAPTIVE_COALESCE)
+    skew_on = allow_split and conf.get(C.SKEW_JOIN_ENABLED)
+    stream_sizes = stream_stats.bytes_per_bucket
+    combined = [b + s for b, s in zip(build_stats.bytes_per_bucket,
+                                      stream_sizes)]
+    skew_cut = float("inf")
+    if skew_on and n > 1:
+        med = float(np.median(np.asarray(stream_sizes, dtype=np.float64)))
+        skew_cut = max(conf.get(C.SKEW_JOIN_FACTOR) * med,
+                       float(conf.get(C.SKEW_JOIN_THRESHOLD)))
+    max_splits = max(2, conf.get(C.SKEW_JOIN_MAX_SPLITS))
+
+    stream_spec: List[tuple] = []
+    build_spec: List[tuple] = []
+    run: List[int] = []
+    n_split = 0
+
+    def flush_run():
+        """Group a contiguous run of non-skewed buckets through THE
+        shared grouping math (aqe/coalesce.py) on their combined costs —
+        singletons with coalescing off."""
+        nonlocal run
+        if not run:
+            return
+        if coalesce_on:
+            groups = coalesce_groups([combined[t] for t in run], target)
+        else:
+            groups = [[i] for i in range(len(run))]
+        for g in groups:
+            ts = [run[i] for i in g]
+            stream_spec.append(("group", ts))
+            build_spec.append(("group", ts))
+        run = []
+
+    for t in range(n):
+        pieces = stream_stats.piece_costs[t]
+        if skew_on and stream_sizes[t] > skew_cut and len(pieces) >= 2:
+            chunk_target = max(target,
+                               int(math.ceil(stream_sizes[t] / max_splits)))
+            ranges = _chunk_pieces(pieces, chunk_target,
+                                   max_ranges=max_splits)
+            if len(ranges) >= 2:
+                flush_run()
+                for lo, hi in ranges:
+                    stream_spec.append(("slice", t, lo, hi))
+                    build_spec.append(("full", t))
+                n_split += 1
+                continue
+        run.append(t)
+    flush_run()
+
+    if n_split == 0 and len(stream_spec) == n:
+        return None
+    return stream_spec, build_spec, n_split
+
+
+def _skew_and_coalesce_joins(plan: PhysicalExec, ctx,
+                             notes: List[str],
+                             effects: List) -> PhysicalExec:
+    conf = ctx.conf
+
+    def rewrite(node):
+        if not _is_shuffled_join(node):
+            return node
+        bidx = 0 if node.build_left else 1
+        b_stage = unwrap_to_stage(node.children[bidx])
+        s_stage = unwrap_to_stage(node.children[1 - bidx])
+        if b_stage is None or s_stage is None:
+            return node
+        if b_stage.stats is None or s_stage.stats is None:
+            return node
+        if b_stage.stats.num_buckets != s_stage.stats.num_buckets or \
+                s_stage.stats.num_buckets <= 1:
+            return node
+        allow_split = (node.join_type is not JoinType.FULL_OUTER
+                       and s_stage.pb.piece_range is not None)
+        spec = coordinated_join_spec(b_stage.stats, s_stage.stats, conf,
+                                     allow_split)
+        if spec is None:
+            return node
+        s_spec, b_spec, n_split = spec
+        if n_split:
+            effects.append(lambda n=n_split: M.record_skew_split(n))
+        # buckets merged AWAY by grouping: buckets covered by group
+        # entries minus the group count (split buckets are NOT merged)
+        groups = [e for e in s_spec if e[0] == "group"]
+        merged = sum(len(e[1]) for e in groups) - len(groups)
+        if merged > 0:
+            metric = s_stage.exchange.metrics["coalescedPartitions"]
+            effects.append(lambda m=metric, n=merged: m.add(n))
+        new_children = list(node.children)
+        new_children[bidx] = _replace_node(
+            node.children[bidx], b_stage,
+            TpuStageReaderExec(b_stage, b_spec, True, desc="join-build"))
+        new_children[1 - bidx] = _replace_node(
+            node.children[1 - bidx], s_stage,
+            TpuStageReaderExec(s_stage, s_spec, True, desc="join-stream"))
+        notes.append(
+            f"skewSplit/coalesce on {type(node).__name__}: "
+            f"{describe_spec(s_spec)} (buckets split: {n_split})")
+        return node.with_children(new_children)
+
+    return plan.transform_up(rewrite)
+
+
+# ---------------------------------------------------------------------------
+# Unified coalescing for single-consumer stages
+# ---------------------------------------------------------------------------
+def _coalesce_single_stages(plan: PhysicalExec, ctx,
+                            notes: List[str],
+                            effects: List) -> PhysicalExec:
+    conf = ctx.conf
+    if not conf.get(C.ADAPTIVE_COALESCE):
+        return plan
+    target = conf.get(C.ADAPTIVE_TARGET_BYTES)
+
+    def maybe_group(stage: TpuQueryStageExec):
+        from spark_rapids_tpu.shuffle.exchange import (
+            RangePartitioning,
+            SinglePartitioning,
+        )
+
+        ex = stage.exchange
+        # the never-coalesce pins (repartition(n), join inputs) and the
+        # order-sensitive range exchange keep their planned fan-out —
+        # the same contract aqe/coalesce.maybe_coalesce_runtime enforces
+        # for the non-adaptive engine
+        if not ex.allow_adaptive or stage.stats is None:
+            return stage
+        n = stage.pb.num_partitions
+        if n <= 1 or n != stage.stats.num_buckets:
+            return stage
+        if isinstance(ex.partitioning, (RangePartitioning,
+                                        SinglePartitioning)):
+            return stage
+        groups = coalesce_groups(stage.stats.bytes_per_bucket, target)
+        if len(groups) == n:
+            return stage
+        metric = ex.metrics["coalescedPartitions"]
+        effects.append(lambda m=metric, k=n - len(groups): m.add(k))
+        notes.append(
+            f"coalescePartitions on stage {stage.stage_id}: "
+            f"{n} -> {len(groups)} partitions")
+        return TpuStageReaderExec(stage, [("group", g) for g in groups],
+                                  False, desc="coalesce")
+
+    def rewrite(node):
+        if isinstance(node, TpuStageReaderExec):
+            return node  # its stage already carries a final spec
+        if isinstance(node, TpuQueryStageExec):
+            return maybe_group(node)
+        new_children = [rewrite(c) for c in node.children]
+        if any(a is not b for a, b in zip(new_children, node.children)):
+            return node.with_children(new_children)
+        return node
+
+    return rewrite(plan)

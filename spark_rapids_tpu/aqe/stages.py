@@ -1,0 +1,170 @@
+"""Adaptive stage nodes (the ShuffleQueryStageExec / AQEShuffleReadExec
+analogs).
+
+TpuQueryStageExec — a shuffle exchange the adaptive loop has already
+materialized: a leaf holding the exchange's PartitionedBatches plus its
+MapOutputStats. The not-yet-executed remainder of the plan consumes it
+like any operator; the rule passes read its MEASURED sizes.
+
+TpuStageReaderExec — an explicit post-stage partition spec over a
+materialized stage, so the post-coalesce/post-skew plan is a plan NODE
+(visible to EXPLAIN, the verifier, and the analyzer) instead of a runtime
+side effect. Spec entries:
+
+  ("group", [t0, t1, ...])  buckets chained into one task (unified
+                            coalescing; contiguity keeps range order)
+  ("slice", t, lo, hi)      pieces [lo, hi) of bucket t — one skew
+                            sub-partition of an oversized stream bucket
+  ("full",  t)              the whole bucket t — the replicated build
+                            side opposite a skew slice
+
+For a shuffled join both inputs carry ALIGNED specs (same length, entry
+k of each side pairs at partition k), so pidx-by-pidx co-partitioning
+holds exactly as it does for the pinned static plan.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.exec.base import (
+    ExecContext,
+    PartitionedBatches,
+    PhysicalExec,
+)
+from spark_rapids_tpu.ops.base import AttributeReference
+
+
+class TpuQueryStageExec(PhysicalExec):
+    """A materialized exchange boundary (leaf). Holds the exchange's
+    regrouped-but-unconsumed PartitionedBatches; execute() hands it to
+    the consumer exactly as the exchange would have."""
+
+    def __init__(self, exchange, pb: PartitionedBatches, stats,
+                 stage_id: int):
+        super().__init__()
+        self.exchange = exchange
+        self.pb = pb
+        self.stats = stats
+        self.stage_id = stage_id
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.exchange.output
+
+    @property
+    def placement(self) -> str:
+        return self.exchange.placement
+
+    def output_partitioning(self):
+        return self.exchange.output_partitioning()
+
+    @property
+    def coalesce_after(self) -> bool:
+        return self.exchange.coalesce_after
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        return self.pb
+
+    def node_name(self):
+        parts = self.pb.num_partitions
+        return (f"TpuQueryStage({self.stage_id}, "
+                f"{self.exchange.node_name()}, parts={parts})")
+
+
+def _spec_counts(spec) -> Tuple[int, int]:
+    """(coalesced-away buckets, skew sub-slices) of one spec."""
+    merged = sum(len(e[1]) - 1 for e in spec if e[0] == "group")
+    slices = sum(1 for e in spec if e[0] == "slice")
+    return merged, slices
+
+
+def describe_spec(spec) -> str:
+    merged, slices = _spec_counts(spec)
+    bits = [f"parts={len(spec)}"]
+    if merged:
+        bits.append(f"coalesced={merged}")
+    if slices:
+        bits.append(f"skewSlices={slices}")
+    return ", ".join(bits)
+
+
+class TpuStageReaderExec(PhysicalExec):
+    """Explicit partition spec over a materialized stage (row-preserving,
+    schema/placement transparent)."""
+
+    def __init__(self, child: PhysicalExec, spec, concat_device: bool,
+                 desc: str = ""):
+        super().__init__(child)
+        self.spec = list(spec)
+        self.concat_device = concat_device
+        self.desc = desc
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    @property
+    def placement(self) -> str:
+        return self.children[0].placement
+
+    def with_children(self, new_children):
+        return TpuStageReaderExec(new_children[0], self.spec,
+                                  self.concat_device, self.desc)
+
+    def node_name(self):
+        return f"TpuAqeShuffleRead({describe_spec(self.spec)})"
+
+    def execute(self, ctx: ExecContext) -> PartitionedBatches:
+        pb = self.children[0].execute(ctx)
+        return apply_partition_spec(pb, self.spec, self.concat_device)
+
+
+def apply_partition_spec(pb: PartitionedBatches, spec,
+                         concat_device: bool) -> PartitionedBatches:
+    """Re-map a stage's reduce buckets per the spec. The result publishes
+    NO bucket_costs: a spec'd read is final — downstream consumers
+    (coalesce_join_inputs) must not regroup it again."""
+
+    def factory(gidx: int):
+        entry = spec[gidx]
+        kind = entry[0]
+        if kind == "slice":
+            _k, t, lo, hi = entry
+            if pb.piece_range is None:
+                # the rule only emits slices for piece-addressable
+                # stages; reading the full bucket here instead would
+                # DUPLICATE its rows once per sibling slice — fail loud
+                raise RuntimeError(
+                    "partition spec has a piece slice but the stage "
+                    "publishes no piece_range — refusing to duplicate "
+                    f"bucket {t}")
+            return pb.piece_range(t, lo, hi)
+        if kind == "full":
+            return pb.iterator(entry[1])
+        # "group": the ONE shared grouping policy (exec/base.py)
+        from spark_rapids_tpu.exec.base import iter_bucket_group
+
+        return iter_bucket_group(pb.iterator, entry[1], concat_device)
+
+    return PartitionedBatches(len(spec), factory)
+
+
+def unwrap_to_stage(node: PhysicalExec) -> Optional[TpuQueryStageExec]:
+    """Descend through batch-coalesce wrappers to a materialized stage
+    (None for anything else — including a stage already under a reader,
+    which must not be re-read)."""
+    inner = _unwrap_wrappers(node)
+    return inner if isinstance(inner, TpuQueryStageExec) else None
+
+
+def _unwrap_wrappers(node: PhysicalExec) -> PhysicalExec:
+    from spark_rapids_tpu.exec.transitions import (
+        CpuCoalesceBatchesExec,
+        TpuCoalesceBatchesExec,
+    )
+
+    cur = node
+    while isinstance(cur, (TpuCoalesceBatchesExec, CpuCoalesceBatchesExec)):
+        cur = cur.children[0]
+    return cur

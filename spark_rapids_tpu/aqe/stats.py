@@ -1,0 +1,112 @@
+"""Runtime shuffle map-output statistics (the MapOutputStatistics analog).
+
+Every exchange that materializes map outputs already knows, host-side,
+what each reduce bucket holds: serialized pieces carry their encoded size
+and row count in the header, routed/contiguous device slices carry their
+count from the one planned per-batch counts sync, and ICI collective
+outputs carry their static piece shapes. `MapOutputStats` accumulates
+those numbers per reduce bucket with ZERO extra device syncs — a lazy
+live-mask piece whose row count still lives on the device simply reports
+its rows as unknown (None) rather than forcing the mid-query sync the
+issue-ahead contract forbids (docs/async-execution.md; tpulint
+mid-query-sync covers this module).
+
+The stats ride the exchange's `PartitionedBatches` (`pb.map_stats`) and
+feed the adaptive rule passes (aqe/rules.py): skew detection, join
+demotion thresholds, and unified coalescing all consume MEASURED bytes
+instead of the analyzer's plan-time priors.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def piece_rows(piece) -> Optional[int]:
+    """Host-known row count of one shuffle piece, or None when the count
+    still lives on the device (reading it would be a forbidden sync)."""
+    n = getattr(piece, "num_rows", None)
+    if isinstance(n, (int, np.integer)):
+        return int(n)
+    return None
+
+
+class MapOutputStats:
+    """Measured per-reduce-bucket sizes of one materialized exchange.
+
+    bytes_per_bucket: estimated bytes per reduce bucket (the same
+        host-side cost model the coalescer uses — shared source buffers
+        are pro-rated, serialized pieces report their encoded size).
+    rows_per_bucket: exact rows per bucket, or None for a bucket holding
+        at least one piece whose count is device-resident.
+    piece_costs: per bucket, the per-piece byte costs in map order — the
+        split points skew-splitting may cut a bucket at (a sub-partition
+        is a contiguous piece range, so no piece is ever divided).
+    """
+
+    __slots__ = ("bytes_per_bucket", "rows_per_bucket", "piece_costs")
+
+    def __init__(self, bytes_per_bucket: List[int],
+                 rows_per_bucket: List[Optional[int]],
+                 piece_costs: List[List[int]]):
+        self.bytes_per_bucket = list(bytes_per_bucket)
+        self.rows_per_bucket = list(rows_per_bucket)
+        self.piece_costs = [list(pc) for pc in piece_costs]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.bytes_per_bucket)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes_per_bucket)
+
+    @property
+    def rows_known(self) -> bool:
+        return all(r is not None for r in self.rows_per_bucket)
+
+    @property
+    def total_rows(self) -> Optional[int]:
+        if not self.rows_known:
+            return None
+        return sum(self.rows_per_bucket)
+
+    def nonempty_buckets(self) -> int:
+        return sum(1 for i, b in enumerate(self.bytes_per_bucket)
+                   if b > 0 or (self.rows_per_bucket[i] or 0) > 0
+                   or len(self.piece_costs[i]) > 0)
+
+    def total_pieces(self) -> int:
+        return sum(len(pc) for pc in self.piece_costs)
+
+    def describe(self) -> str:
+        bs = self.bytes_per_bucket
+        mx = max(bs) if bs else 0
+        return (f"MapOutputStats(buckets={self.num_buckets}, "
+                f"bytes={self.total_bytes}, maxBucket={mx}, "
+                f"rowsKnown={self.rows_known})")
+
+    def __repr__(self):
+        return self.describe()
+
+
+def bucket_stats(reduce_buckets, cost_fn) -> MapOutputStats:
+    """Build stats from regrouped reduce buckets: `cost_fn(piece)` is the
+    host-side byte estimate (shuffle/exchange._piece_cost partial)."""
+    piece_costs: List[List[int]] = []
+    rows: List[Optional[int]] = []
+    for bucket in reduce_buckets:
+        piece_costs.append([cost_fn(p) for p in bucket])
+        acc = 0
+        known = True
+        for p in bucket:
+            r = piece_rows(p)
+            if r is None:
+                known = False
+                break
+            acc += r
+        rows.append(acc if known else None)
+    return MapOutputStats([sum(pc) for pc in piece_costs], rows,
+                          piece_costs)

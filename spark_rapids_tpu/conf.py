@@ -434,6 +434,64 @@ ADAPTIVE_TARGET_BYTES = _conf(
     "(Spark's spark.sql.adaptive.advisoryPartitionSizeInBytes analog)."
 ).integer(16 << 20)
 
+# ---------------------------------------------------------------------------
+# Adaptive query execution (spark_rapids_tpu/aqe/,
+# docs/adaptive-execution.md)
+# ---------------------------------------------------------------------------
+ADAPTIVE_ENABLED = _conf("rapids.tpu.sql.adaptive.enabled").doc(
+    "Runtime re-optimization at shuffle-stage boundaries (the Spark AQE "
+    "role the reference plugin runs under): a TpuAdaptiveExec wrapper "
+    "materializes each exchange as a query stage, collects per-bucket "
+    "MapOutputStats from host-known piece metadata (zero extra device "
+    "syncs), and re-runs rule passes over the not-yet-executed remainder "
+    "— skew-split, broadcast join demotion/promotion, and unified "
+    "partition coalescing — with every rewritten remainder re-verified "
+    "and re-analyzed against the MEASURED sizes (metrics: aqeReplans / "
+    "skewSplits / joinDemotions / joinPromotions). Off (default): every "
+    "plan decision stays frozen at plan time exactly as before."
+).boolean(False)
+
+ADAPTIVE_JOIN_STRATEGY = _conf(
+    "rapids.tpu.sql.adaptive.joinStrategy.enabled").doc(
+    "Under adaptive execution, rewrite join strategies from MEASURED "
+    "build sizes: a shuffled hash join whose materialized build side "
+    "fits autoBroadcastJoinThreshold demotes to a broadcast join (the "
+    "stream side's not-yet-executed exchange is elided entirely), and a "
+    "statically-planned broadcast join whose build subtree measured past "
+    "the threshold (a blown plan-time estimate) promotes back to the "
+    "shuffled form."
+).boolean(True)
+
+SKEW_JOIN_ENABLED = _conf("rapids.tpu.sql.adaptive.skewJoin.enabled").doc(
+    "Under adaptive execution, split an oversized reduce bucket of a "
+    "shuffled join's STREAM input into contiguous piece-range "
+    "sub-partitions, replicating the build-side bucket opposite each — "
+    "so a hot key's rows spread over several tasks instead of "
+    "hot-spotting one (Spark's spark.sql.adaptive.skewJoin role). A "
+    "bucket is skewed when its bytes exceed "
+    "max(skewedPartitionFactor * median, skewedPartitionThresholdBytes)."
+).boolean(True)
+
+SKEW_JOIN_FACTOR = _conf(
+    "rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor").doc(
+    "Multiple of the median stream-bucket size beyond which a bucket "
+    "counts as skewed (with skewedPartitionThresholdBytes as the "
+    "absolute floor)."
+).check(lambda v: None if v >= 1.0 else "must be >= 1.0").double(4.0)
+
+SKEW_JOIN_THRESHOLD = _conf(
+    "rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThresholdBytes").doc(
+    "Absolute minimum bytes for a stream bucket to count as skewed "
+    "(guards tiny queries where factor * median is noise)."
+).bytes(64 << 20)
+
+SKEW_JOIN_MAX_SPLITS = _conf(
+    "rapids.tpu.sql.adaptive.skewJoin.maxSplitsPerPartition").doc(
+    "Upper bound on sub-partitions one skewed bucket splits into; the "
+    "per-slice target is max(advisoryPartitionSizeBytes, bucketBytes / "
+    "maxSplitsPerPartition)."
+).check(lambda v: None if v >= 2 else "must be >= 2").integer(8)
+
 SHUFFLE_SERIALIZE = _conf("rapids.tpu.shuffle.serialize.enabled").doc(
     "Force shuffle pieces to cross the exchange as serialized host bytes "
     "(the fallback-tier serializer, reference: "

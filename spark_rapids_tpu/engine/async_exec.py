@@ -21,16 +21,23 @@ planned sync. Two consequences this module owns the state for:
 
 2. **Donation gating.** `donate_argnums` kernels consume their inputs, so
    a donated dispatch can never re-dispatch in place; donation is only
-   armed when the platform supports it AND checked mode is off. The flags
-   are process-wide (kernels trace with no session in scope, same contract
-   as conf.sync_int64_narrowing) and refreshed at every query start by
-   session.execute_batches.
+   armed when the platform supports it AND checked mode is off. The
+   process-wide flags remain the fallback for kernels tracing with no
+   session in scope (same contract as conf.sync_int64_narrowing), but a
+   running query's resolution ADDITIONALLY rides its QueryContext
+   (utils/metrics.py) — contextvars propagation carries it onto the
+   query's worker threads, so concurrent tenants' asyncDispatch/donation
+   settings never cross-talk (docs/serving.md; the AQE loop re-posting
+   hints mid-query relies on the same scoping). Checked-mode depth stays
+   process-global by design: ANY live replay forces checked semantics.
 """
 
 from __future__ import annotations
 
 import contextlib
 import threading
+
+from spark_rapids_tpu.utils import metrics as M
 
 _LOCK = threading.Lock()
 _ASYNC_ENABLED = True
@@ -40,36 +47,60 @@ _DONATION_ENABLED = False
 _CHECKED_DEPTH = 0
 
 
-def configure(tpu_conf, device_manager=None) -> None:
+def configure(tpu_conf, device_manager=None, ctx=None) -> None:
     """Refresh the issue-ahead flags from the executing session's conf
     (called at every query start). Donation additionally requires a
     donation-capable backend: the CPU backend ignores donate_argnums (with
     a warning per dispatch), so it only arms on a real accelerator — or
-    under the internal assumeSupported override the tests use."""
+    under the internal assumeSupported override the tests use. With a
+    QueryContext the resolution is ALSO recorded on it (per-tenant
+    isolation; the globals stay last-writer-wins for context-free
+    callers)."""
     from spark_rapids_tpu import conf as C
 
     global _ASYNC_ENABLED, _DONATION_ENABLED
     supported = bool(device_manager is not None and device_manager.is_tpu) \
         or bool(tpu_conf.get(C.BUFFER_DONATION_ASSUME_SUPPORTED))
+    async_on = bool(tpu_conf.get(C.ASYNC_DISPATCH))
+    donation_on = bool(tpu_conf.get(C.BUFFER_DONATION)) and supported
+    if ctx is not None:
+        ctx.async_dispatch = async_on
+        ctx.donation = donation_on
     with _LOCK:
-        _ASYNC_ENABLED = bool(tpu_conf.get(C.ASYNC_DISPATCH))
-        _DONATION_ENABLED = bool(tpu_conf.get(C.BUFFER_DONATION)) and \
-            supported
+        _ASYNC_ENABLED = async_on
+        _DONATION_ENABLED = donation_on
+
+
+def _ctx_flags():
+    """(async, donation, in_checked) for the calling thread in ONE lock
+    acquisition (these run per device dispatch): the ambient query
+    context's resolution when it has one — the globals are not even read
+    then — else the process-wide fallbacks."""
+    qctx = M.current_query_ctx()
+    a = qctx.async_dispatch if qctx is not None else None
+    d = qctx.donation if qctx is not None else None
+    with _LOCK:
+        checked = _CHECKED_DEPTH > 0
+        if a is None:
+            a = _ASYNC_ENABLED
+        if d is None:
+            d = _DONATION_ENABLED
+    return a, d, checked
 
 
 def async_enabled() -> bool:
     """Issue-ahead semantics are on and we are NOT inside a checked
     replay (checked mode forces synchronous error attribution)."""
-    with _LOCK:
-        return _ASYNC_ENABLED and _CHECKED_DEPTH == 0
+    a, _d, checked = _ctx_flags()
+    return a and not checked
 
 
 def donation_active() -> bool:
     """Donated kernel variants may be selected for this dispatch. False
     inside checked mode: the replay must be able to re-dispatch and
     bisect, which consumed inputs forbid."""
-    with _LOCK:
-        return _DONATION_ENABLED and _CHECKED_DEPTH == 0
+    _a, d, checked = _ctx_flags()
+    return d and not checked
 
 
 def in_checked_mode() -> bool:
@@ -81,9 +112,8 @@ def replay_warranted() -> bool:
     """Whether a device-rooted failure should get one checked replay
     before the CPU fallback: some issue-ahead behavior (async attribution
     or donation) was active, and we are not already replaying."""
-    with _LOCK:
-        return (_ASYNC_ENABLED or _DONATION_ENABLED) and \
-            _CHECKED_DEPTH == 0
+    a, d, checked = _ctx_flags()
+    return (a or d) and not checked
 
 
 @contextlib.contextmanager

@@ -31,9 +31,17 @@ class PartitionedBatches:
     bucket_costs: optional per-partition byte estimates set by exchanges —
     lets a downstream binary consumer (shuffled join) coalesce BOTH inputs
     with one identical grouping (the coordinated half of AQE partition
-    coalescing). Row-preserving wrapper execs propagate it."""
+    coalescing). Row-preserving wrapper execs propagate it.
 
-    __slots__ = ("num_partitions", "_factory", "bucket_costs")
+    map_stats / piece_range: set by materializing exchanges for the
+    adaptive runtime (spark_rapids_tpu/aqe/): `map_stats` is the
+    per-bucket MapOutputStats (measured, zero extra device syncs) and
+    `piece_range(t, lo, hi)` iterates only pieces [lo, hi) of bucket t —
+    the skew-split sub-partition read. Both are advisory: wrappers may
+    drop them (a grouped view has neither)."""
+
+    __slots__ = ("num_partitions", "_factory", "bucket_costs",
+                 "map_stats", "piece_range")
 
     def __init__(self, num_partitions: int,
                  factory: Callable[[int], Iterator],
@@ -41,6 +49,8 @@ class PartitionedBatches:
         self.num_partitions = num_partitions
         self._factory = factory
         self.bucket_costs = bucket_costs
+        self.map_stats = None
+        self.piece_range = None
 
     def iterator(self, pidx: int) -> Iterator:
         return self._factory(pidx)
@@ -56,30 +66,39 @@ class PartitionedBatches:
         original bucket — the reference gets the same effect from
         GpuCoalesceBatches running above its coalesced shuffle reads."""
         def factory(gidx: int):
-            def gen():
-                if not concat_device or len(groups[gidx]) == 1:
-                    for t in groups[gidx]:
-                        yield from self.iterator(t)
-                    return
-                from spark_rapids_tpu.columnar.batch import (
-                    ColumnarBatch, concat_batches)
-
-                all_batches = [b for t in groups[gidx]
-                               for b in self.iterator(t)]
-                device = [b for b in all_batches
-                          if isinstance(b, ColumnarBatch)]
-                if len(device) != len(all_batches):
-                    # mixed host/device: preserve arrival order untouched
-                    yield from all_batches
-                elif len(device) == 1:
-                    yield device[0]
-                elif device:
-                    yield concat_batches(device)
-            return gen()
+            return iter_bucket_group(self.iterator, groups[gidx],
+                                     concat_device)
         costs = None
         if self.bucket_costs is not None:
             costs = [sum(self.bucket_costs[t] for t in g) for g in groups]
         return PartitionedBatches(len(groups), factory, costs)
+
+
+def iter_bucket_group(iter_of: Callable[[int], Iterator], ts,
+                      concat_device: bool) -> Iterator:
+    """Yield the batches of buckets `ts` as one partition: chained, or —
+    with concat_device — each group's device batches concatenated into
+    ONE batch. THE single grouping policy, shared by the runtime coalesce
+    view (PartitionedBatches.grouped) and the adaptive reader's group
+    specs (aqe/stages.py), so the two paths can never diverge."""
+    if not concat_device or len(ts) == 1:
+        for t in ts:
+            yield from iter_of(t)
+        return
+    from spark_rapids_tpu.columnar.batch import (
+        ColumnarBatch,
+        concat_batches,
+    )
+
+    all_batches = [b for t in ts for b in iter_of(t)]
+    device = [b for b in all_batches if isinstance(b, ColumnarBatch)]
+    if len(device) != len(all_batches):
+        # mixed host/device: preserve arrival order untouched
+        yield from all_batches
+    elif len(device) == 1:
+        yield device[0]
+    elif device:
+        yield concat_batches(device)
 
 
 class ExecContext:

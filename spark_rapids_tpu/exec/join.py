@@ -653,11 +653,11 @@ def coalesce_join_inputs(ctx, left_pb, right_pb):
             or left_pb.num_partitions <= 1
             or not ctx.conf.get(C.ADAPTIVE_COALESCE)):
         return left_pb, right_pb
-    from spark_rapids_tpu.shuffle.exchange import _coalesce_groups
+    from spark_rapids_tpu.aqe.coalesce import coordinated_groups
 
-    combined = [l + r for l, r in zip(left_pb.bucket_costs,
-                                      right_pb.bucket_costs)]
-    groups = _coalesce_groups(combined, ctx.conf.get(C.ADAPTIVE_TARGET_BYTES))
+    groups = coordinated_groups(left_pb.bucket_costs,
+                                right_pb.bucket_costs,
+                                ctx.conf.get(C.ADAPTIVE_TARGET_BYTES))
     if len(groups) == left_pb.num_partitions:
         return left_pb, right_pb
     # groups are sized under the advisory target, so concatenating each

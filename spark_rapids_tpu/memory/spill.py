@@ -367,15 +367,20 @@ class SpillFramework:
             cls._instance = None
 
     # -- plan-time hints (plan/resources.py) ---------------------------------
-    def set_plan_hint(self, spill_pressure: float, per_task_peak) -> None:
+    def set_plan_hint(self, spill_pressure: float, per_task_peak,
+                      ctx=None) -> None:
         """Forward the resource analyzer's prediction for the query about
         to run: `spill_pressure` is predicted-peak / budget (> 1.0 means
         the spill framework is expected to engage) and `per_task_peak` is
         the transient bytes one task is predicted to need. The watermark
         uses them to reserve headroom BEFORE the transients allocate, so
         spill happens at upload boundaries (cheap, chosen victims) instead
-        of mid-operator."""
-        self.watermark.set_plan_hint(spill_pressure, per_task_peak)
+        of mid-operator. With a QueryContext the resolved reserve is
+        ADDITIONALLY scoped to that query — an AQE re-plan posting a new
+        hint mid-query (aqe/loop.py) cannot leak into a concurrent
+        tenant's headroom math (docs/serving.md)."""
+        self.watermark.set_plan_hint(spill_pressure, per_task_peak,
+                                     ctx=ctx)
 
     # -- buffer API ----------------------------------------------------------
     def add_device_batch(self, batch: ColumnarBatch,
@@ -514,7 +519,8 @@ class MemoryWatermark:
         # the running query's predicted operator transients
         self.plan_reserve = 0
 
-    def set_plan_hint(self, spill_pressure: float, per_task_peak) -> None:
+    def _reserve_from_hint(self, spill_pressure: float,
+                           per_task_peak) -> int:
         """Reserve predicted-transient headroom only for plans the analyzer
         expects to overrun the budget (pressure > 1.0); light plans keep
         the full budget for resident batches. The reserve is capped at
@@ -524,9 +530,30 @@ class MemoryWatermark:
                 and per_task_peak is not None
                 and per_task_peak == per_task_peak  # not NaN
                 and per_task_peak != float("inf")):
-            self.plan_reserve = min(int(per_task_peak), self.budget // 2)
-        else:
-            self.plan_reserve = 0
+            return min(int(per_task_peak), self.budget // 2)
+        return 0
+
+    def set_plan_hint(self, spill_pressure: float, per_task_peak,
+                      ctx=None) -> None:
+        """Resolve and install the reserve. With a QueryContext the value
+        is scoped to THAT query (ensure_headroom on its worker threads
+        reads it through the ambient context); the watermark-level slot
+        stays the last-writer-wins fallback for context-free callers."""
+        reserve = self._reserve_from_hint(spill_pressure, per_task_peak)
+        if ctx is not None:
+            ctx.spill_plan_hint = reserve
+        self.plan_reserve = reserve
+
+    def _current_reserve(self) -> int:
+        """The reserve governing the calling thread: the ambient query's
+        context-scoped hint when one was posted (0 is a valid posted
+        hint), else the process-wide slot."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        qctx = M.current_query_ctx()
+        if qctx is not None and qctx.spill_plan_hint is not None:
+            return qctx.spill_plan_hint
+        return self.plan_reserve
 
     def ensure_headroom(self, nbytes: int) -> None:
         """Spill tracked device buffers until `nbytes` fits under the budget.
@@ -534,9 +561,10 @@ class MemoryWatermark:
         covered by the bytes_in_use() term when the backend reports it."""
         if self.budget <= 0:
             return
+        reserve = self._current_reserve()
         tracked = self.device_store.current_size
         external = max(0, self.bytes_in_use() - tracked)
-        avail = self.budget - self.plan_reserve - external - tracked
+        avail = self.budget - reserve - external - tracked
         if nbytes > avail:
             self.device_store.synchronous_spill(
-                max(0, self.budget - self.plan_reserve - external - nbytes))
+                max(0, self.budget - reserve - external - nbytes))

@@ -785,11 +785,17 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
 # ---------------------------------------------------------------------------
 class _Analyzer:
     def __init__(self, conf: "C.TpuConf", budget: int,
-                 donation: bool = False):
+                 donation: bool = False, measured_stats=None):
         from spark_rapids_tpu.columnar.batch import physical_np_dtype
 
         self.conf = conf
         self.budget = budget
+        # measured-stats input channel (aqe/loop.py): MapOutputStats per
+        # materialized query-stage node id. A TpuQueryStageExec leaf is
+        # charged from these MEASURED sizes instead of plan-time priors;
+        # a stage also carries its own stats, so the channel only needs
+        # to override when the caller wants different numbers
+        self.measured_stats = dict(measured_stats or {})
         self.physical = physical_np_dtype
         self.concurrency = max(1, min(conf.concurrent_tpu_tasks,
                                       conf.task_threads))
@@ -937,10 +943,23 @@ class _Analyzer:
         from spark_rapids_tpu.io.scan import _FileScanBase
         from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
 
+        from spark_rapids_tpu.aqe.loop import TpuAdaptiveExec
+        from spark_rapids_tpu.aqe.stages import (
+            TpuQueryStageExec,
+            TpuStageReaderExec,
+        )
         from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
 
         self._depth += 1
         try:
+            if isinstance(node, TpuAdaptiveExec):
+                # transparent: the wrapper only drives stage-by-stage
+                # execution of the subtree it declares
+                return self.visit(node.children[0])
+            if isinstance(node, TpuQueryStageExec):
+                return self._query_stage(node)
+            if isinstance(node, TpuStageReaderExec):
+                return self._stage_reader(node)
             if isinstance(node, TpuSpmdStageExec):
                 return self._spmd_stage(node)
             if isinstance(node, TpuFusedStageExec):
@@ -1484,6 +1503,61 @@ class _Analyzer:
             d = Interval.exact(0)
             self._resident(node, 0, st, d)
         return st
+
+    # -- adaptive query stages (spark_rapids_tpu/aqe/) -----------------------
+    def _query_stage(self, node) -> AbsState:
+        """A materialized exchange boundary: MEASURED MapOutputStats
+        replace every plan-time prior for the subtree below it — the
+        data already sits in its reduce buckets, so rows/bytes/partition
+        counts are facts, not estimates."""
+        stats = self.measured_stats.get(id(node))
+        if stats is None:
+            stats = node.stats
+        rb = _row_bytes(node.output, self.physical)
+        parts = node.pb.num_partitions
+        if stats is None:
+            # no stats collected (a range exchange on the CPU oracle
+            # path, say): the stage is opaque but finite
+            self._inexact()
+            st = AbsState(Interval(0, INF), parts, Interval(0, parts),
+                          Interval(0, INF), Interval(0, INF), set(), rb,
+                          placement=node.placement)
+            self._resident(node, 0, st, Interval.exact(0))
+            return st
+        total_rows = stats.total_rows
+        if total_rows is not None:
+            rows = Interval.exact(total_rows)
+            batch_rows = Interval(
+                0, max([r for r in stats.rows_per_bucket], default=0))
+        else:
+            # a lazy piece's count is device-resident: bytes are still
+            # measured, rows stay an interval
+            self._inexact()
+            rows = Interval(0, INF)
+            batch_rows = Interval(0, INF)
+        nonempty = Interval.exact(stats.nonempty_buckets())
+        batches = Interval(nonempty.lo, max(stats.total_pieces(),
+                                            nonempty.lo))
+        st = AbsState(rows, parts, nonempty, batches, batch_rows, set(),
+                      rb, placement=node.placement)
+        # the whole materialized stage is resident until consumed
+        self._resident(node,
+                       stats.total_bytes if node.placement == "tpu" else 0,
+                       st, Interval.exact(0))
+        return st
+
+    def _stage_reader(self, node) -> AbsState:
+        """Partition-spec reader: row-preserving; only the partition
+        count (and per-task grouping) changes."""
+        cin = self.visit(node.children[0])
+        parts = max(1, len(node.spec))
+        self._inexact()
+        return AbsState(cin.rows, parts,
+                        Interval(min(cin.nonempty.lo, parts), parts),
+                        cin.batches, cin.batch_rows, set(cin.buckets),
+                        cin.row_bytes, lazy_tail=cin.lazy_tail,
+                        placement=node.placement, col_ndv=cin.col_ndv,
+                        col_range=cin.col_range)
 
     # -- single-program SPMD stages ------------------------------------------
     def _spmd_stage(self, node) -> AbsState:
@@ -2126,8 +2200,14 @@ def resolve_budget(conf: "C.TpuConf",
 
 def analyze_plan(plan: PhysicalExec, conf: "C.TpuConf",
                  budget: Optional[int] = None,
-                 device_manager=None) -> PlanResourceReport:
-    """Bottom-up abstract interpretation; never raises on violations."""
+                 device_manager=None,
+                 measured_stats=None) -> PlanResourceReport:
+    """Bottom-up abstract interpretation; never raises on violations.
+
+    measured_stats: optional {id(TpuQueryStageExec): MapOutputStats} —
+    the adaptive loop's runtime channel (aqe/loop.py): materialized
+    stages are charged from MEASURED sizes, replacing the leaf priors
+    of everything already executed below them."""
     if budget is None:
         budget = resolve_budget(conf, device_manager)
     from spark_rapids_tpu.engine.async_exec import in_checked_mode
@@ -2140,7 +2220,8 @@ def analyze_plan(plan: PhysicalExec, conf: "C.TpuConf",
         bool(device_manager is not None and device_manager.is_tpu)
         or bool(conf.get(C.BUFFER_DONATION_ASSUME_SUPPORTED))) and \
         not in_checked_mode()
-    return _Analyzer(conf, budget, donation=donation).run(plan)
+    return _Analyzer(conf, budget, donation=donation,
+                     measured_stats=measured_stats).run(plan)
 
 
 def check_resources(plan: PhysicalExec, conf: "C.TpuConf",

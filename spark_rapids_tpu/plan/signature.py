@@ -54,9 +54,16 @@ def plan_signature(plan: "L.LogicalPlan",
     fingerprinted (an unexpected node/value shape — the caller simply
     skips caching)."""
     try:
+        from spark_rapids_tpu import conf as C
+
         conf_tok = ";".join(
             f"{k}={v!r}" for k, v in sorted(
                 conf.settings.items(), key=lambda kv: str(kv[0])))
+        # the RESOLVED adaptive flag keys the signature even when it is
+        # defaulted: a cached static plan must never serve an adaptive
+        # query (or vice versa) — the adaptive plan carries the
+        # TpuAdaptiveExec wrapper and re-optimizes at runtime
+        conf_tok += f";__adaptive={bool(conf.get(C.ADAPTIVE_ENABLED))!r}"
         idmap: Dict[int, int] = {}
         ident = _canon_node(plan, idmap, identity=True)
         idmap = {}

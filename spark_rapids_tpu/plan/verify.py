@@ -149,6 +149,11 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
 
     available = _attr_map(a for c in node.children for a in c.output)
 
+    from spark_rapids_tpu.aqe.loop import TpuAdaptiveExec
+    from spark_rapids_tpu.aqe.stages import (
+        TpuQueryStageExec,
+        TpuStageReaderExec,
+    )
     from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
 
     # -- per-class structure/reference checks --------------------------------
@@ -159,6 +164,23 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
         _check_identity_schema(node, out)
         if node.info is None:
             out.append(f"{name}: SPMD stage carries no lowering info")
+    elif isinstance(node, TpuAdaptiveExec):
+        # schema/placement-transparent adaptive wrapper (aqe/loop.py)
+        _check_identity_schema(node, out)
+    elif isinstance(node, TpuQueryStageExec):
+        # a materialized exchange boundary: a leaf whose schema is the
+        # exchange's; its spec-consuming reader (below) does the rest
+        pass
+    elif isinstance(node, TpuStageReaderExec):
+        # row-preserving partition-spec reader over a materialized stage
+        _check_identity_schema(node, out)
+        if not node.spec:
+            out.append(f"{name}: empty partition spec — the reader would "
+                       "produce zero partitions and drop every row")
+        else:
+            stage = node.children[0]
+            if isinstance(stage, TpuQueryStageExec):
+                _check_reader_spec(name, node.spec, stage, out)
     elif isinstance(node, TpuFusedStageExec):
         _check_fused_stage(node, out)
     elif isinstance(node, (B.TpuProjectExec, B.CpuProjectExec)):
@@ -274,6 +296,60 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
                 not isinstance(node, DeviceToHostExec):
             out.append(f"{name}: host operator consumes device batches "
                        f"from {c.node_name()} without a DeviceToHostExec")
+
+
+def _check_reader_spec(name: str, spec, stage, out: List[str]) -> None:
+    """Coverage/consistency of an adaptive reader's partition spec: every
+    stage bucket must be consumed (a dropped bucket silently drops rows),
+    a bucket may appear in at most ONE kind of entry, grouped buckets
+    appear exactly once, and a bucket's piece slices must partition
+    [0, n_pieces) without gaps or overlap. 'full' entries may repeat —
+    that is the replicated build side opposite skew slices."""
+    n_buckets = stage.pb.num_partitions
+    kinds: Dict[int, str] = {}
+    group_seen: Dict[int, int] = {}
+    slices: Dict[int, List] = {}
+    for e in spec:
+        ts = e[1] if e[0] == "group" else [e[1]]
+        for t in ts:
+            if not (0 <= t < n_buckets):
+                out.append(f"{name}: spec references bucket {t} of a "
+                           f"{n_buckets}-bucket stage")
+                return
+            prev = kinds.get(t)
+            if prev is not None and prev != e[0]:
+                out.append(f"{name}: bucket {t} appears in both "
+                           f"'{prev}' and '{e[0]}' spec entries")
+            kinds[t] = e[0]
+        if e[0] == "group":
+            for t in ts:
+                group_seen[t] = group_seen.get(t, 0) + 1
+        elif e[0] == "slice":
+            slices.setdefault(e[1], []).append((e[2], e[3]))
+    missing = [t for t in range(n_buckets) if t not in kinds]
+    if missing:
+        out.append(f"{name}: spec consumes no entry for bucket(s) "
+                   f"{missing} — their rows would be dropped")
+    for t, cnt in group_seen.items():
+        if cnt > 1:
+            out.append(f"{name}: grouped bucket {t} appears {cnt} times "
+                       "— its rows would be duplicated")
+    stats = stage.stats
+    for t, rs in slices.items():
+        rs.sort()
+        pos = 0
+        for lo, hi in rs:
+            if lo != pos or hi <= lo:
+                out.append(f"{name}: bucket {t} slices {rs} do not "
+                           "partition the piece range (gap/overlap)")
+                break
+            pos = hi
+        else:
+            if stats is not None and t < len(stats.piece_costs) and \
+                    pos != len(stats.piece_costs[t]):
+                out.append(f"{name}: bucket {t} slices end at piece "
+                           f"{pos} but the bucket holds "
+                           f"{len(stats.piece_costs[t])} pieces")
 
 
 def _check_fused_stage(node, out: List[str]) -> None:

@@ -101,6 +101,11 @@ class TpuSession:
         # the resource analyzer's full report for the most recent plan
         # build (None while resourceAnalysis is disabled)
         self.last_resource_report = None
+        # applied-rule notes from the most recent ADAPTIVE execution
+        # (aqe/loop.py via the QueryContext); rendered by EXPLAIN's
+        # '== Adaptive execution ==' section. Empty when adaptive is off
+        # or no rule fired.
+        self.last_adaptive_report: List[str] = []
         # wired by TpuServer.connect: queries eligible for cross-query
         # micro-batching route through the server's shared batcher
         self.micro_batcher = None
@@ -309,10 +314,18 @@ class TpuSession:
         tpu_plan = TpuOverrides.apply(cpu_plan, self.conf)
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         final = fuse_stages(final, self.conf)
-        # LAST: single-program SPMD stage lowering (plan/spmd.py) — the
-        # wrapped subtree is exactly what the host-loop executor would run,
-        # so eligibility fallback is always one children[0].execute() away
+        # single-program SPMD stage lowering (plan/spmd.py) — the wrapped
+        # subtree is exactly what the host-loop executor would run, so
+        # eligibility fallback is always one children[0].execute() away
         final = lower_spmd_stages(final, self.conf)
+        # LAST: adaptive-execution wrapper (spark_rapids_tpu/aqe/) below
+        # the root sink; a no-op unless rapids.tpu.sql.adaptive.enabled
+        # and the plan has a stage boundary to re-optimize across. The
+        # plan-cache key notes the adaptive flag (plan/signature.py), so
+        # cached static plans and AQE plans never cross.
+        from spark_rapids_tpu.aqe.loop import maybe_wrap_adaptive
+
+        final = maybe_wrap_adaptive(final, self.conf)
         if self.conf.get(C.PLAN_VERIFY):
             from spark_rapids_tpu.plan.verify import (
                 PlanVerificationError,
@@ -403,7 +416,7 @@ class TpuSession:
         fw = SpillFramework.get()
         if fw is not None:
             fw.set_plan_hint(report.spill_pressure,
-                             report.per_task_peak_bytes)
+                             report.per_task_peak_bytes, ctx=qctx)
 
     def _reset_resource_hints(self) -> None:
         """No analysis for this plan: nothing may inherit a previous
@@ -417,7 +430,7 @@ class TpuSession:
             qctx.resource_report = None
         fw = SpillFramework.get()
         if fw is not None:
-            fw.set_plan_hint(0.0, None)
+            fw.set_plan_hint(0.0, None, ctx=qctx)
 
     def explain_plan(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
         from spark_rapids_tpu.plan.fusion import fuse_stages
@@ -432,6 +445,9 @@ class TpuSession:
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         final = fuse_stages(final, self.conf)
         final = lower_spmd_stages(final, self.conf)
+        from spark_rapids_tpu.aqe.loop import maybe_wrap_adaptive
+
+        final = maybe_wrap_adaptive(final, self.conf)
         parts = []
         if explain_out:
             parts.append("== TPU tagging ==\n" + explain_out[0])
@@ -452,6 +468,18 @@ class TpuSession:
             report = analyze_plan(final, self.conf,
                                   device_manager=self.device_manager)
             parts.append("== Resource analysis ==\n" + report.render())
+        if self.conf.get(C.ADAPTIVE_ENABLED):
+            from spark_rapids_tpu.aqe.rules import rule_catalog
+
+            lines = ["enabled (runtime re-optimization at stage "
+                     "boundaries; docs/adaptive-execution.md)"]
+            lines += [f"rule: {r}" for r in rule_catalog()]
+            if self.last_adaptive_report:
+                lines.append("last execution applied:")
+                lines += [f"  + {n}" for n in self.last_adaptive_report]
+            else:
+                lines.append("last execution applied: (none)")
+            parts.append("== Adaptive execution ==\n" + "\n".join(lines))
         return "\n".join(parts)
 
     def _exec_context(self) -> ExecContext:
@@ -486,9 +514,13 @@ class TpuSession:
         # tenants cannot cross-talk.
         self.conf.sync_int64_narrowing()
         breaker = R.CircuitBreaker.configure(self.conf, tenant=self.tenant)
-        AX.configure(self.conf, self.device_manager)
-        self.scheduler.configure(self.conf)
         qctx = M.QueryContext(self.tenant)
+        # context-scoped issue-ahead flags: the process globals stay the
+        # fallback for kernels tracing outside any query, but THIS
+        # query's resolution rides its context so concurrent tenants'
+        # asyncDispatch/donation settings cannot cross-talk
+        AX.configure(self.conf, self.device_manager, ctx=qctx)
+        self.scheduler.configure(self.conf)
         # context-scoped: the retry/backoff policy rides the QueryContext
         # (combinators read policy() through it), so concurrent tenants'
         # knobs stay isolated
@@ -541,8 +573,11 @@ class TpuSession:
                          M.PLAN_CACHE_MISSES, M.ADMISSION_WAITS,
                          M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES,
                          M.ENCODED_COLUMNS, M.LATE_MATERIALIZATIONS,
-                         M.ENCODED_BYTES_SAVED):
+                         M.ENCODED_BYTES_SAVED, M.AQE_REPLANS,
+                         M.SKEW_SPLITS, M.JOIN_DEMOTIONS,
+                         M.JOIN_PROMOTIONS):
                 self.last_query_metrics[name] = snap.get(name, 0)
+            self.last_adaptive_report = list(qctx.aqe_notes)
 
     def _maybe_micro_batch(self, plan: L.LogicalPlan, breaker,
                            allow_micro_batch: bool):

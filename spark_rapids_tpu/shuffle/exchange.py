@@ -245,15 +245,17 @@ class _ExchangeBase(PhysicalExec):
 
         to_device = self.placement == "tpu"
 
-        # AQE-style adaptive partition coalescing (reference role: Spark
-        # AQE's CoalesceShufflePartitions, which the plugin runs under in
-        # TpchLikeAdaptiveSparkSuite): group small contiguous reduce buckets
-        # so downstream tasks amortize their fixed dispatch cost. Contiguity
-        # keeps range-partition order; hash buckets union freely. Exchanges
-        # pinned by the transition pass (join inputs) publish their bucket
-        # costs instead, and the JOIN coalesces both sides identically.
-        costs = [sum(_piece_cost(p, n_out) for p in bucket)
-                 for bucket in reduce_buckets]
+        # Map-output statistics (aqe/stats.py): per-bucket bytes, rows,
+        # and piece costs from HOST-KNOWN metadata only — the measured
+        # sizes the adaptive rule passes (and the coordinated join
+        # coalescing) consume. Zero extra device syncs by construction:
+        # a lazy piece whose count is device-resident reports rows
+        # unknown instead of forcing one.
+        from spark_rapids_tpu.aqe.stats import bucket_stats
+
+        stats = bucket_stats(reduce_buckets,
+                             lambda p: _piece_cost(p, n_out))
+        costs = stats.bytes_per_bucket
 
         def decode_with_remap(piece: "_SerializedPiece", t: int, j: int):
             """Decode a serialized piece; on fetch failure re-execute its
@@ -277,56 +279,50 @@ class _ExchangeBase(PhysicalExec):
                         raise
                     piece = fresh[k]
 
-        def factory(pidx: int):
-            def gen():
-                # fuse runs of routed slices into one batch per <=16 slices
-                # (the assemble kernel unrolls per slice; 16 bounds compile
-                # size while one fused gather replaces piece-wise
-                # gather+concat)
-                routed: List[_RoutedSlice] = []
-                for j, piece in enumerate(reduce_buckets[pidx]):
-                    if isinstance(piece, _RoutedSlice):
-                        routed.append(piece)
-                        if len(routed) >= 16:
-                            yield _assemble_routed(routed)
-                            routed = []
-                        continue
-                    if routed:
+        def piece_gen(pidx: int, lo: int = 0, hi: Optional[int] = None):
+            # fuse runs of routed slices into one batch per <=16 slices
+            # (the assemble kernel unrolls per slice; 16 bounds compile
+            # size while one fused gather replaces piece-wise
+            # gather+concat). [lo, hi) bounds serve the adaptive runtime's
+            # skew-split sub-partition reads (aqe/stages.py): piece
+            # indices stay ABSOLUTE so fetch-remap lineage holds.
+            stop = len(reduce_buckets[pidx]) if hi is None else hi
+            routed: List[_RoutedSlice] = []
+            for j, piece in enumerate(reduce_buckets[pidx]):
+                if j < lo or j >= stop:
+                    continue
+                if isinstance(piece, _RoutedSlice):
+                    routed.append(piece)
+                    if len(routed) >= 16:
                         yield _assemble_routed(routed)
                         routed = []
-                    if isinstance(piece, _SerializedPiece):
-                        piece = decode_with_remap(piece, pidx, j)
-                    yield piece
+                    continue
                 if routed:
                     yield _assemble_routed(routed)
-            return count_output(self.metrics, gen())
+                    routed = []
+                if isinstance(piece, _SerializedPiece):
+                    piece = decode_with_remap(piece, pidx, j)
+                yield piece
+            if routed:
+                yield _assemble_routed(routed)
+
+        def factory(pidx: int):
+            return count_output(self.metrics, piece_gen(pidx))
 
         pb = PartitionedBatches(n_out, factory, bucket_costs=costs)
-        if self.allow_adaptive and n_out > 1 and \
-                ctx.conf.get(C.ADAPTIVE_COALESCE):
-            groups = _coalesce_groups(costs,
-                                      ctx.conf.get(C.ADAPTIVE_TARGET_BYTES))
-            if len(groups) != n_out:
-                self.metrics["coalescedPartitions"].add(n_out - len(groups))
-                pb = pb.grouped(groups)
-        return pb
+        pb.map_stats = stats
+        pb.piece_range = lambda t, lo, hi: count_output(
+            self.metrics, piece_gen(t, lo, hi))
+        # adaptive partition coalescing (reference role: Spark AQE's
+        # CoalesceShufflePartitions, which the plugin runs under in
+        # TpchLikeAdaptiveSparkSuite): group small contiguous reduce
+        # buckets so downstream tasks amortize their fixed dispatch cost.
+        # The grouping math, the never-coalesce pins, and the adaptive
+        # rule pass that replaces this runtime side effect all live in
+        # aqe/coalesce.py — one enforcement point.
+        from spark_rapids_tpu.aqe.coalesce import maybe_coalesce_runtime
 
-
-def _coalesce_groups(costs: List[int], target: int) -> List[List[int]]:
-    """Greedy contiguous grouping: extend the current group while it stays
-    under `target` (every group keeps >= 1 bucket)."""
-    groups: List[List[int]] = []
-    cur: List[int] = []
-    cur_cost = 0
-    for t, c in enumerate(costs):
-        if cur and cur_cost + c > target:
-            groups.append(cur)
-            cur, cur_cost = [], 0
-        cur.append(t)
-        cur_cost += c
-    if cur:
-        groups.append(cur)
-    return groups
+        return maybe_coalesce_runtime(self, pb, ctx.conf)
 
 
 def _piece_cost(piece, n_out: int) -> int:
@@ -359,11 +355,15 @@ class _SerializedPiece:
     When the spill framework is up, the bytes live in the host spill store
     (and can demote to disk); the piece frees its buffer when dropped."""
 
-    def __init__(self, data=None, buf=None, fw=None):
+    def __init__(self, data=None, buf=None, fw=None, num_rows=None):
         self._data = data
         self._buf = buf
         self._fw = fw
         self.size = len(data) if data is not None else buf.size
+        # row count from the serialized header (known at encode time):
+        # the adaptive runtime's MapOutputStats read it host-side
+        # (aqe/stats.piece_rows) without decoding the piece
+        self.num_rows = num_rows
 
     def decode(self, to_device: bool):
         from spark_rapids_tpu.columnar.serde import deserialize_batch
@@ -415,11 +415,12 @@ def _serialize_host_piece(host, fw) -> _SerializedPiece:
     from spark_rapids_tpu.memory.spill import SpillPriorities
 
     data = serialize_batch(host)
+    rows = host.num_rows
     if fw is not None:
         return _SerializedPiece(
             buf=fw.add_host_bytes(data, SpillPriorities.OUTPUT_FOR_READ),
-            fw=fw)
-    return _SerializedPiece(data=data)
+            fw=fw, num_rows=rows)
+    return _SerializedPiece(data=data, num_rows=rows)
 
 
 def _encode_pieces_grouped(routed):
@@ -703,7 +704,12 @@ class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
         def factory(pidx: int):
             return count_output(self.metrics, iter(reduce_buckets[pidx]))
 
-        return PartitionedBatches(n, factory)
+        pb = PartitionedBatches(n, factory)
+        from spark_rapids_tpu.aqe.stats import bucket_stats
+
+        pb.map_stats = bucket_stats(reduce_buckets,
+                                    lambda piece: _piece_bytes(piece))
+        return pb
 
 
 def _range_ids_host(key_cols: List[List[Any]], bounds, orders) -> np.ndarray:
@@ -868,7 +874,15 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         def factory(pidx: int):
             return count_output(self.metrics, iter([out[pidx]]))
 
-        return PartitionedBatches(n, factory)
+        pb = PartitionedBatches(n, factory)
+        # ICI piece shapes are host-known (the collective's static
+        # per-target buckets): stats come free (aqe/stats.py)
+        from spark_rapids_tpu.aqe.stats import MapOutputStats, piece_rows
+
+        sizes = [b.device_memory_size() for b in out]
+        pb.map_stats = MapOutputStats(sizes, [piece_rows(b) for b in out],
+                                      [[s] for s in sizes])
+        return pb
 
     def _execute_range(self, ctx: ExecContext,
                        p: RangePartitioning) -> PartitionedBatches:
@@ -988,7 +1002,12 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         def factory(pidx: int):
             return count_output(self.metrics, iter(reduce_buckets[pidx]))
 
-        return PartitionedBatches(n, factory)
+        pb = PartitionedBatches(n, factory)
+        from spark_rapids_tpu.aqe.stats import bucket_stats
+
+        pb.map_stats = bucket_stats(reduce_buckets,
+                                    lambda piece: _piece_bytes(piece))
+        return pb
 
 
 def _jit_rr_ids(n: int):

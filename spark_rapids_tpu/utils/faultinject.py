@@ -48,6 +48,9 @@ SITES: Dict[str, str] = {
     "sort": "oom",
     "spmd.stage": "oom",
     "encoded.materialize": "oom",
+    # the adaptive re-plan site (aqe/loop.py): a fault here must DEGRADE
+    # the query to its original static plan shape, never change results
+    "aqe.replan": "dispatch",
     "transfer.upload": "transfer",
     "transfer.download": "transfer",
     "shuffle.fetch": "fetch",

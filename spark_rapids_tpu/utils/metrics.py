@@ -78,6 +78,16 @@ MICRO_BATCHED_QUERIES = "microBatchedQueries"
 ENCODED_COLUMNS = "encodedColumns"
 LATE_MATERIALIZATIONS = "lateMaterializations"
 ENCODED_BYTES_SAVED = "encodedBytesSaved"
+# adaptive query execution (spark_rapids_tpu/aqe/,
+# docs/adaptive-execution.md): aqeReplans counts rule applications that
+# rewrote (and statically re-validated) the not-yet-executed remainder;
+# skewSplits counts oversized reduce buckets split into sub-partitions;
+# joinDemotions/joinPromotions count runtime join-strategy switches
+# (shuffled -> broadcast / broadcast -> shuffled)
+AQE_REPLANS = "aqeReplans"
+SKEW_SPLITS = "skewSplits"
+JOIN_DEMOTIONS = "joinDemotions"
+JOIN_PROMOTIONS = "joinPromotions"
 
 
 class Metric:
@@ -145,7 +155,8 @@ class QueryContext:
 
     __slots__ = ("tenant", "_lock", "_counters", "breaker", "injector",
                  "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
-                 "resource_report", "retry_policy")
+                 "resource_report", "retry_policy", "aqe_notes",
+                 "spill_plan_hint", "async_dispatch", "donation")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -177,6 +188,21 @@ class QueryContext:
         # tenant's backoff/retry tuning never leaks into another's
         # concurrently running query
         self.retry_policy = None
+        # adaptive-execution notes (aqe/loop.py): applied-rule lines the
+        # session surfaces as last_adaptive_report / EXPLAIN's
+        # '== Adaptive execution ==' section
+        self.aqe_notes = []
+        # context-scoped spill plan reserve (memory/spill.py): resolved
+        # reserve bytes for THIS query's predicted transients. None = no
+        # hint posted yet (the watermark falls back to its process-wide
+        # slot); an AQE re-plan posting a new hint lands here, so it can
+        # never leak into a concurrent tenant's query
+        self.spill_plan_hint = None
+        # context-scoped issue-ahead flags (engine/async_exec.py): the
+        # executing session's asyncDispatch/bufferDonation resolution for
+        # THIS query. None = fall back to the process-wide flags
+        self.async_dispatch = None
+        self.donation = None
 
     def add(self, name: str, n: int) -> None:
         with self._lock:
@@ -496,6 +522,60 @@ def record_encoded_bytes_saved(n: int) -> None:
 
 def encoded_bytes_saved() -> int:
     return _ENCODED_BYTES_SAVED.value
+
+
+# ---------------------------------------------------------------------------
+# Adaptive-execution accounting (spark_rapids_tpu/aqe/)
+# ---------------------------------------------------------------------------
+_AQE_REPLANS = Metric(AQE_REPLANS)
+_SKEW_SPLITS = Metric(SKEW_SPLITS)
+_JOIN_DEMOTIONS = Metric(JOIN_DEMOTIONS)
+_JOIN_PROMOTIONS = Metric(JOIN_PROMOTIONS)
+
+
+def record_aqe_replan(n: int = 1) -> None:
+    """Count one adaptive re-plan: a rule pass rewrote the not-yet-
+    executed remainder and the rewrite passed static re-validation
+    (verify + measured-stats resource analysis)."""
+    _AQE_REPLANS.add(n)
+    _note(AQE_REPLANS, n)
+
+
+def aqe_replan_count() -> int:
+    return _AQE_REPLANS.value
+
+
+def record_skew_split(n: int = 1) -> None:
+    """Count oversized reduce buckets split into piece-range
+    sub-partitions by the skew-split rule."""
+    _SKEW_SPLITS.add(n)
+    _note(SKEW_SPLITS, n)
+
+
+def skew_split_count() -> int:
+    return _SKEW_SPLITS.value
+
+
+def record_join_demotion(n: int = 1) -> None:
+    """Count one runtime shuffled->broadcast join rewrite (measured build
+    side fit under autoBroadcastJoinThreshold)."""
+    _JOIN_DEMOTIONS.add(n)
+    _note(JOIN_DEMOTIONS, n)
+
+
+def join_demotion_count() -> int:
+    return _JOIN_DEMOTIONS.value
+
+
+def record_join_promotion(n: int = 1) -> None:
+    """Count one runtime broadcast->shuffled join rewrite (a blown
+    plan-time build-size estimate measured past the threshold)."""
+    _JOIN_PROMOTIONS.add(n)
+    _note(JOIN_PROMOTIONS, n)
+
+
+def join_promotion_count() -> int:
+    return _JOIN_PROMOTIONS.value
 
 
 @contextlib.contextmanager

@@ -7,9 +7,9 @@ import numpy as np
 import pytest
 
 from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.aqe.coalesce import coalesce_groups as _coalesce_groups
 from spark_rapids_tpu.exec.base import PartitionedBatches
 from spark_rapids_tpu.plan import functions as F
-from spark_rapids_tpu.shuffle.exchange import _coalesce_groups
 
 from tests.harness import assert_tpu_and_cpu_are_equal_collect
 

@@ -1,0 +1,470 @@
+"""Adaptive query execution (spark_rapids_tpu/aqe/,
+docs/adaptive-execution.md): runtime-stats collection, the skew-split /
+join-strategy / unified-coalescing rules, oracle equality of the skewed
+chaos matrix (AQE on/off x fault injection at the aqe.replan site), and
+the adaptive-off parity contract."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.plan import functions as F
+
+from tests.harness import assert_tpu_and_cpu_are_equal_collect
+
+AQE_ON = {
+    C.ADAPTIVE_ENABLED.key: True,
+    # the chaos-scale data is tiny; drop the skew cut so the hot bucket
+    # actually counts as skewed
+    C.SKEW_JOIN_THRESHOLD.key: 4096,
+    C.SKEW_JOIN_FACTOR.key: 2.0,
+    C.ADAPTIVE_TARGET_BYTES.key: 64 << 10,
+    # serialized pieces carry exact rows/bytes in their headers — the
+    # tier whose MapOutputStats see real (not pro-rata) bucket sizes
+    C.SHUFFLE_SERIALIZE.key: True,
+    # force the SHUFFLED join path (the tiny dim side would statically
+    # broadcast at the default threshold, leaving nothing to skew-split)
+    C.BROADCAST_THRESHOLD.key: 0,
+    C.RUNTIME_BROADCAST.key: False,
+}
+
+
+def _skewed_join_df(s, n=9000, hot=0.6, parts=6):
+    """Zipf-flavored join: one hot key takes `hot` of the fact rows."""
+    rng = np.random.default_rng(11)
+    k = np.where(rng.random(n) < hot, 0,
+                 rng.integers(1, 60, n)).astype(np.int64)
+    fact = s.createDataFrame(
+        {"k": k, "v": rng.integers(-50, 50, n).astype(np.int64)},
+        [("k", "long"), ("v", "long")], num_partitions=parts)
+    dim = s.createDataFrame(
+        {"k": np.arange(60, dtype=np.int64),
+         "w": np.arange(60, dtype=np.int64) * 3},
+        [("k", "long"), ("w", "long")], num_partitions=2)
+    return fact, dim
+
+
+def _skew_query(s):
+    fact, dim = _skewed_join_df(s)
+    return fact.join(dim, on="k", how="inner") \
+        .groupBy("w").agg(F.sum("v").alias("sv"), F.count("*").alias("n"))
+
+
+# ---------------------------------------------------------------------------
+# Stats collection
+# ---------------------------------------------------------------------------
+def test_map_output_stats_collected(session):
+    """Every materializing exchange publishes MapOutputStats built from
+    host-known piece metadata (serialized headers here: exact rows AND
+    bytes), with per-piece costs summing to the bucket bytes."""
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+
+    session.conf.set(C.SHUFFLE_SERIALIZE.key, True)
+    fact, _dim = _skewed_join_df(session)
+    plan = session._physical_plan(
+        fact.groupBy("k").agg(F.sum("v").alias("sv"))._plan,
+        use_cache=False)
+    exchanges = plan.collect_nodes(lambda n: isinstance(n, _ExchangeBase))
+    assert exchanges
+    # the default engine coalesces tiny buckets at runtime (the grouped
+    # view drops its stats), so materialize raw as the adaptive loop does
+    from spark_rapids_tpu.aqe import coalesce as AQC
+
+    token = AQC.adaptive_stage_token()
+    try:
+        pb = exchanges[0].execute(session._exec_context())
+    finally:
+        AQC.adaptive_stage_reset(token)
+    stats = pb.map_stats
+    assert stats is not None
+    assert stats.num_buckets == pb.num_partitions
+    assert stats.total_bytes > 0
+    assert stats.rows_known and stats.total_rows > 0
+    for t in range(stats.num_buckets):
+        assert sum(stats.piece_costs[t]) == stats.bytes_per_bucket[t]
+    assert pb.piece_range is not None
+
+
+def test_stats_unknown_rows_for_device_counts():
+    """A piece whose row count lives on the device reports rows unknown
+    instead of forcing a sync."""
+    from spark_rapids_tpu.aqe.stats import bucket_stats
+
+    class _DevPiece:
+        num_rows = object()  # not an int: a traced/device scalar stand-in
+
+    class _HostPiece:
+        num_rows = 7
+
+    stats = bucket_stats([[_HostPiece()], [_DevPiece()]], lambda p: 10)
+    assert stats.rows_per_bucket == [7, None]
+    assert not stats.rows_known
+    assert stats.total_rows is None
+    assert stats.total_bytes == 20
+
+
+# ---------------------------------------------------------------------------
+# Spec math
+# ---------------------------------------------------------------------------
+def test_chunk_pieces_balance():
+    from spark_rapids_tpu.aqe.rules import _chunk_pieces
+
+    costs = [10, 10, 10, 10, 10, 10, 10, 10]
+    ranges = _chunk_pieces(costs, 25)
+    assert [r for r in ranges] == [(0, 2), (2, 4), (4, 6), (6, 8)] or \
+        all(hi > lo for lo, hi in ranges)
+    # full coverage, in order, no overlap
+    flat = [j for lo, hi in ranges for j in range(lo, hi)]
+    assert flat == list(range(len(costs)))
+    # maxSplitsPerPartition is a HARD cap: large pieces that would
+    # greedily chunk past it merge back down (coverage preserved)
+    big = [100] * 12
+    capped = _chunk_pieces(big, 150, max_ranges=8)
+    assert len(capped) <= 8
+    assert [j for lo, hi in capped for j in range(lo, hi)] == \
+        list(range(12))
+
+
+def test_coordinated_join_spec_splits_and_balances():
+    """An oversized stream bucket splits into piece-range slices with the
+    build bucket replicated opposite each; no resulting stream task
+    exceeds 2x the mean task bytes."""
+    from spark_rapids_tpu.aqe.rules import coordinated_join_spec
+    from spark_rapids_tpu.aqe.stats import MapOutputStats
+
+    class _Conf:
+        def get(self, entry):
+            return {
+                C.ADAPTIVE_TARGET_BYTES.key: 100,
+                C.ADAPTIVE_COALESCE.key: True,
+                C.SKEW_JOIN_ENABLED.key: True,
+                C.SKEW_JOIN_FACTOR.key: 2.0,
+                C.SKEW_JOIN_THRESHOLD.key: 50,
+                C.SKEW_JOIN_MAX_SPLITS.key: 8,
+            }[entry.key]
+
+    # bucket 1 is hot: 400 bytes over 8 pieces; others ~40
+    stream = MapOutputStats(
+        [40, 400, 30, 30],
+        [40, 400, 30, 30],
+        [[40], [50] * 8, [30], [30]])
+    build = MapOutputStats([5, 5, 5, 5], [5, 5, 5, 5],
+                           [[5], [5], [5], [5]])
+    got = coordinated_join_spec(build, stream, _Conf(), allow_split=True)
+    assert got is not None
+    s_spec, b_spec, n_split = got
+    assert n_split == 1
+    assert len(s_spec) == len(b_spec)
+    task_bytes = []
+    for se, be in zip(s_spec, b_spec):
+        if se[0] == "slice":
+            _k, t, lo, hi = se
+            assert be == ("full", t)
+            task_bytes.append(sum(stream.piece_costs[t][lo:hi]))
+        else:
+            assert be == se  # groups are identical on both sides
+            task_bytes.append(sum(stream.bytes_per_bucket[t]
+                                  for t in se[1]))
+    # coverage: every stream byte lands in exactly one task
+    assert sum(task_bytes) == stream.total_bytes
+    mean = sum(task_bytes) / len(task_bytes)
+    assert max(task_bytes) <= 2 * mean, task_bytes
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: skew chaos matrix (AQE on/off x fault injection)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("adaptive", [True, False])
+def test_skewed_join_oracle_equal(session, adaptive):
+    extra = dict(AQE_ON)
+    extra[C.ADAPTIVE_ENABLED.key] = adaptive
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _skew_query, ignore_order=True, extra_conf=extra)
+
+
+def test_skew_split_fires_and_results_match(session):
+    from tests.harness import run_on_cpu, run_on_tpu
+
+    cpu = run_on_cpu(session, _skew_query)
+    tpu = run_on_tpu(session, _skew_query, extra_conf=AQE_ON)
+    assert sorted(cpu) == sorted(tpu)
+    m = session.last_query_metrics
+    assert m.get("skewSplits", 0) >= 1, (m, session.last_adaptive_report)
+    assert m.get("aqeReplans", 0) >= 1
+    assert any("skewSplit" in note
+               for note in session.last_adaptive_report)
+
+
+@pytest.mark.parametrize("seed,rate", [(0, 1.0), (7, 0.5)])
+def test_aqe_replan_fault_degrades_to_static(session, seed, rate):
+    """An injected failure at the aqe.replan site degrades the query to
+    its original static plan shape — never wrong rows."""
+    extra = dict(AQE_ON)
+    extra.update({
+        C.FAULT_INJECTION_ENABLED.key: True,
+        C.FAULT_INJECTION_SITES.key: "aqe.replan",
+        C.FAULT_INJECTION_RATE.key: rate,
+        C.FAULT_INJECTION_SEED.key: seed,
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        session, _skew_query, ignore_order=True, extra_conf=extra)
+    if rate == 1.0:
+        # every replan attempt failed: no rule may have applied
+        m = session.last_query_metrics
+        assert m.get("aqeReplans", 0) == 0
+        assert m.get("skewSplits", 0) == 0
+        assert any("degraded" in note
+                   for note in session.last_adaptive_report)
+
+
+@pytest.mark.parametrize("adaptive", [True, False])
+def test_zipf_groupby_oracle_equal(session, adaptive):
+    """Skewed group-by (no join): stages materialize and the unified
+    coalescing rule regroups them; results stay oracle-equal."""
+    def q(s):
+        fact, _ = _skewed_join_df(s, n=6000, hot=0.7)
+        return fact.groupBy("k").agg(F.sum("v").alias("sv"),
+                                     F.count("*").alias("n"))
+
+    extra = dict(AQE_ON)
+    extra[C.ADAPTIVE_ENABLED.key] = adaptive
+    assert_tpu_and_cpu_are_equal_collect(
+        session, q, ignore_order=True, extra_conf=extra)
+
+
+# ---------------------------------------------------------------------------
+# Join strategy: demotion + promotion
+# ---------------------------------------------------------------------------
+def test_join_demotion_to_broadcast(session):
+    """A shuffled join whose MEASURED build side fits the broadcast
+    threshold demotes at runtime (the stream exchange is elided)."""
+    from tests.harness import run_on_cpu, run_on_tpu
+
+    def q(s):
+        rng = np.random.default_rng(3)
+        n = 6000
+        fact = s.createDataFrame(
+            {"k": rng.integers(0, 60, n).astype(np.int64),
+             "v": rng.integers(0, 100, n).astype(np.int64)},
+            [("k", "long"), ("v", "long")], num_partitions=4)
+        dim = s.createDataFrame(
+            {"k": np.arange(60, dtype=np.int64),
+             "w": np.arange(60, dtype=np.int64) * 3},
+            [("k", "long"), ("w", "long")], num_partitions=2)
+        dim_b = s.createDataFrame(
+            {"k": np.arange(60, dtype=np.int64),
+             "c": np.arange(60, dtype=np.int64) % 5},
+            [("k", "long"), ("c", "long")], num_partitions=2)
+        # the build side is a JOIN: its output size estimates unknown, so
+        # the static planner must shuffle; the measured build is tiny
+        small = dim.join(dim_b, on="k", how="inner")
+        return fact.join(small, on="k", how="inner") \
+            .groupBy("c").agg(F.count("*").alias("n"))
+
+    extra = dict(AQE_ON)
+    extra.update({
+        # fact estimates ~190KB (above), the measured build ~2KB (below)
+        C.BROADCAST_THRESHOLD.key: 16384,
+        # isolate the AQE path from the pre-AQE runtime probe
+        C.RUNTIME_BROADCAST.key: False,
+    })
+    cpu = run_on_cpu(session, q)
+    tpu = run_on_tpu(session, q, extra_conf=extra)
+    assert sorted(cpu) == sorted(tpu)
+    m = session.last_query_metrics
+    assert m.get("joinDemotions", 0) >= 1, \
+        (m, session.last_adaptive_report)
+    assert any("joinDemotion" in note
+               for note in session.last_adaptive_report)
+
+
+def test_join_promotion_on_blown_estimate(session):
+    """A statically-planned broadcast join whose build side measures far
+    past the threshold (STRING bytes are estimated at a flat 16 B/row at
+    plan time) promotes back to a shuffled join at runtime."""
+    from tests.harness import run_on_cpu, run_on_tpu
+
+    def q(s):
+        rng = np.random.default_rng(5)
+        n = 3000
+        fact = s.createDataFrame(
+            {"k": rng.integers(0, 60, n).astype(np.int64),
+             "v": rng.integers(0, 100, n).astype(np.int64)},
+            [("k", "long"), ("v", "long")], num_partitions=4)
+        strs = np.asarray(["x" * 250 + str(i) for i in range(60)])
+        dim_s = s.createDataFrame(
+            {"k": np.arange(60, dtype=np.int64), "s": strs},
+            [("k", "long"), ("s", "string")], num_partitions=2)
+        # estimate: 60 rows x 24 B << threshold -> static broadcast;
+        # measured: ~16 KB of string payload >> 2x threshold (the
+        # promotion slack). Keep the string CONSUMED downstream so
+        # pruning cannot drop it.
+        small = dim_s.groupBy("k").agg(F.max("s").alias("s"))
+        return fact.join(small, on="k", how="inner") \
+            .groupBy("k").agg(F.max("s").alias("ms"),
+                              F.count("*").alias("n"))
+
+    extra = dict(AQE_ON)
+    extra[C.BROADCAST_THRESHOLD.key] = 4096
+    cpu = run_on_cpu(session, q)
+    tpu = run_on_tpu(session, q, extra_conf=extra)
+    assert sorted(cpu) == sorted(tpu)
+    m = session.last_query_metrics
+    assert m.get("joinPromotions", 0) >= 1, \
+        (m, session.last_adaptive_report)
+    assert any("joinPromotion" in note
+               for note in session.last_adaptive_report)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive-off parity + contracts
+# ---------------------------------------------------------------------------
+def test_adaptive_off_plan_unchanged(session):
+    """With adaptive.enabled=false the plan carries no adaptive node and
+    no AQE metric moves — the static engine is byte-for-byte the pre-AQE
+    one."""
+    from spark_rapids_tpu.aqe.loop import TpuAdaptiveExec
+
+    plan = session._physical_plan(_skew_query(session)._plan,
+                                  use_cache=False)
+    found = plan.collect_nodes(lambda n: isinstance(n, TpuAdaptiveExec))
+    assert not found
+    _skew_query(session).collect()
+    m = session.last_query_metrics
+    for name in ("aqeReplans", "skewSplits", "joinDemotions",
+                 "joinPromotions"):
+        assert m.get(name, 0) == 0
+    assert session.last_adaptive_report == []
+
+
+def test_adaptive_plan_carries_wrapper(session):
+    from spark_rapids_tpu.aqe.loop import TpuAdaptiveExec
+
+    session.conf.set(C.ADAPTIVE_ENABLED.key, True)
+    try:
+        plan = session._physical_plan(_skew_query(session)._plan,
+                                      use_cache=False)
+    finally:
+        session.conf.set(C.ADAPTIVE_ENABLED.key, False)
+    found = plan.collect_nodes(lambda n: isinstance(n, TpuAdaptiveExec))
+    assert len(found) == 1
+
+
+def test_plan_cache_keys_note_adaptive(session):
+    """The plan-signature cache key resolves the adaptive flag even when
+    defaulted: a cached static plan can never serve an adaptive query."""
+    from spark_rapids_tpu.plan.signature import plan_signature
+
+    plan = _skew_query(session)._plan
+    sig_off = plan_signature(plan, session.conf)
+    sig_on = plan_signature(
+        plan, session.conf.clone_with({C.ADAPTIVE_ENABLED.key: True}))
+    assert sig_off.cache_key != sig_on.cache_key
+
+
+def test_repartition_n_never_coalesced_under_aqe(session, tmp_path):
+    """The explicit repartition(n) fan-out contract holds on the adaptive
+    path too (the pin is enforced in aqe/coalesce.py for both engines)."""
+    import os
+
+    session.conf.set(C.ADAPTIVE_ENABLED.key, True)
+    try:
+        rng = np.random.default_rng(17)
+        df = session.createDataFrame(
+            {"k": rng.integers(0, 97, 300).astype(np.int64)},
+            [("k", "long")], num_partitions=2)
+        path = str(tmp_path / "rp_aqe.parquet")
+        df.repartition(6).write.parquet(path)
+    finally:
+        session.conf.set(C.ADAPTIVE_ENABLED.key, False)
+    files = [f for f in os.listdir(path) if f.endswith(".parquet")]
+    assert len(files) == 6
+
+
+def test_small_shuffle_writes_one_file_under_aqe(session, tmp_path):
+    """Planner-chosen shuffle partitions DO coalesce under AQE — as an
+    explicit TpuStageReaderExec rule application, not a side effect."""
+    import os
+
+    session.conf.set(C.ADAPTIVE_ENABLED.key, True)
+    try:
+        rng = np.random.default_rng(17)
+        df = session.createDataFrame(
+            {"k": rng.integers(0, 97, 500).astype(np.int64),
+             "v": rng.integers(0, 9, 500).astype(np.int64)},
+            [("k", "long"), ("v", "long")], num_partitions=2)
+        path = str(tmp_path / "agg_aqe.parquet")
+        df.groupBy("k").agg(F.sum("v").alias("sv")).write.parquet(path)
+    finally:
+        session.conf.set(C.ADAPTIVE_ENABLED.key, False)
+    files = [f for f in os.listdir(path) if f.endswith(".parquet")]
+    assert len(files) == 1
+
+
+def test_explain_adaptive_section(session):
+    session.conf.set(C.ADAPTIVE_ENABLED.key, True)
+    try:
+        out = session.explain_plan(_skew_query(session)._plan)
+    finally:
+        session.conf.set(C.ADAPTIVE_ENABLED.key, False)
+    assert "== Adaptive execution ==" in out
+    assert "skewSplit" in out and "joinStrategy" in out \
+        and "coalescePartitions" in out
+    assert "TpuAdaptiveExec" in out
+
+
+# ---------------------------------------------------------------------------
+# QueryContext scoping of re-posted hints (serving headroom)
+# ---------------------------------------------------------------------------
+def test_spill_plan_hint_is_context_scoped(session):
+    """A spill plan hint posted inside one query's context (as an AQE
+    re-plan does) must not leak into a concurrent tenant's headroom."""
+    from spark_rapids_tpu.memory.spill import SpillFramework
+    from spark_rapids_tpu.utils import metrics as M
+
+    fw = SpillFramework.get()
+    wm = fw.watermark
+    budget = wm.budget
+    base = wm.plan_reserve
+    try:
+        ctx_a = M.QueryContext("tenant-a")
+        fw.set_plan_hint(2.0, budget // 4 if budget else 128, ctx=ctx_a)
+        assert ctx_a.spill_plan_hint is not None
+        tok = M.push_query_ctx(ctx_a)
+        try:
+            assert wm._current_reserve() == ctx_a.spill_plan_hint
+        finally:
+            M.pop_query_ctx(tok)
+        # a DIFFERENT query context with no hint of its own falls back to
+        # the watermark slot, not tenant A's value
+        ctx_b = M.QueryContext("tenant-b")
+        fw.set_plan_hint(0.0, None, ctx=ctx_b)
+        tok = M.push_query_ctx(ctx_b)
+        try:
+            assert wm._current_reserve() == 0
+        finally:
+            M.pop_query_ctx(tok)
+    finally:
+        wm.plan_reserve = base
+
+
+def test_async_flags_are_context_scoped(session):
+    from spark_rapids_tpu.engine import async_exec as AX
+    from spark_rapids_tpu.utils import metrics as M
+
+    ctx = M.QueryContext("tenant-a")
+    AX.configure(session.conf.clone_with({
+        C.ASYNC_DISPATCH.key: False,
+        C.BUFFER_DONATION.key: False,
+    }), session.device_manager, ctx=ctx)
+    assert ctx.async_dispatch is False and ctx.donation is False
+    # re-arm the globals as another tenant would
+    AX.configure(session.conf, session.device_manager)
+    tok = M.push_query_ctx(ctx)
+    try:
+        assert AX.async_enabled() is False
+        assert AX.donation_active() is False
+    finally:
+        M.pop_query_ctx(tok)
+    # outside the context the globals govern again
+    assert AX.async_enabled() == bool(session.conf.get(C.ASYNC_DISPATCH))

@@ -197,10 +197,13 @@ def is_hot_path(path: str) -> bool:
 
 def is_mid_query_scope(path: str) -> bool:
     """Files bound by the issue-ahead sync contract: the executor layers
-    (exec/ and engine/) may block on a device value only at the sink."""
+    (exec/, engine/, and the adaptive runtime aqe/ — whose stats
+    collection is specified sync-free) may block on a device value only
+    at the sink."""
     p = _norm(path)
     return ("spark_rapids_tpu/exec/" in p
-            or "spark_rapids_tpu/engine/" in p)
+            or "spark_rapids_tpu/engine/" in p
+            or "spark_rapids_tpu/aqe/" in p)
 
 
 def is_shared_state_scope(path: str) -> bool:

@@ -1683,6 +1683,105 @@ def main_serving() -> None:
     _emit(result)
 
 
+def main_obs() -> None:
+    """Observability suite (`python bench.py --obs`): the flagship query
+    traced end to end (docs/observability.md). Records the span-derived
+    per-stage wall-time breakdown and the per-operator measured-vs-
+    predicted table — the cost-model calibration signal BENCH_*.json
+    carry from here on (ROADMAP item 4) — plus the overhead contract
+    evidence: deviceDispatches/fencesPerQuery identical tracing on vs
+    off, and the wall-clock delta between the two modes. Writes
+    BENCH_r12.json."""
+    import jax
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.utils import metrics as M
+
+    platform = jax.devices()[0].platform
+    rows = int(os.environ.get("SRT_OBS_ROWS", str(1 << 20)))
+    iters = int(os.environ.get("SRT_OBS_ITERS", "3"))
+    s = srt.new_session()
+    try:
+        df = _build_df(s, rows)
+
+        def timed_runs() -> list:
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                _run_query(df)
+                times.append(time.perf_counter() - t0)
+            return times
+
+        _log("obs: tracing-off runs")
+        _run_query(df)  # warm compiles
+        off_times = timed_runs()
+        m_off = dict(s.last_query_metrics)
+        _log("obs: tracing-on runs")
+        s.conf.set(C.OBS_TRACING.key, True)
+        _run_query(df)  # warm the traced path
+        on_times = timed_runs()
+        m_on = dict(s.last_query_metrics)
+        trace = s.last_query_trace
+        stage_s = {name: round(secs, 6)
+                   for name, secs in trace.stage_breakdown().items()}
+        ops = {name: {k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in rec.items()}
+               for name, rec in trace.op_breakdown().items()}
+        _log("obs: EXPLAIN ANALYZE run")
+        from spark_rapids_tpu.plan import functions as F
+
+        q = (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+               .withColumn("c", F.col("a") * 2 + 1)
+               .groupBy("k")
+               .agg(F.sum("c").alias("s"), F.count("*").alias("n"),
+                    F.max("a").alias("m")))
+        analyzed = s.explain_analyze(q._plan)
+        report = s.last_resource_report
+        result = {
+            "metric": "obs_tracing_overhead_ratio",
+            # headline: traced/untraced best wall clock — the overhead a
+            # production always-on deployment would pay
+            "value": (round(min(on_times) / min(off_times), 4)
+                      if min(off_times) else 0.0),
+            "unit": "x",
+            "vs_baseline": 1.0,
+            "platform": platform,
+            "rows": rows,
+            "best_s_tracing_off": round(min(off_times), 4),
+            "best_s_tracing_on": round(min(on_times), 4),
+            # the overhead CONTRACT: device work identical on vs off
+            "dispatches_tracing_off": m_off.get(M.DEVICE_DISPATCHES, 0),
+            "dispatches_tracing_on": m_on.get(M.DEVICE_DISPATCHES, 0),
+            "fences_tracing_off": m_off.get(M.FENCES, 0),
+            "fences_tracing_on": m_on.get(M.FENCES, 0),
+            "device_footprint_identical": (
+                m_off.get(M.DEVICE_DISPATCHES, 0)
+                == m_on.get(M.DEVICE_DISPATCHES, 0)
+                and m_off.get(M.FENCES, 0) == m_on.get(M.FENCES, 0)),
+            # the calibration signal (ROADMAP item 4): span-derived
+            # per-stage wall seconds + per-operator measured table with
+            # the analyzer's predictions beside it
+            "stage_wall_s": stage_s,
+            "op_wall": ops,
+            "span_count": sum(1 for _ in trace.spans()),
+            "predicted_dispatches": [report.dispatches.lo,
+                                     report.dispatches.hi]
+            if report is not None else None,
+            "measured_dispatches": s.last_query_metrics.get(
+                M.DEVICE_DISPATCHES, 0),
+            "explain_analyze": analyzed.splitlines(),
+        }
+    finally:
+        s.stop()
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r12.json")
+    with open(out_path, "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    _emit(result)
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         mode = sys.argv[2]
@@ -1715,5 +1814,7 @@ if __name__ == "__main__":
         main_skew()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--encoded":
         main_encoded()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--obs":
+        main_obs()
     else:
         main()

@@ -236,6 +236,7 @@ def _revalidate(plan: PhysicalExec, ctx: ExecContext) -> None:
 
 
 def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
+    from spark_rapids_tpu.obs.trace import span as obs_span
     from spark_rapids_tpu.utils import faultinject as FI
 
     sid = 0
@@ -245,7 +246,9 @@ def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
         if not ready:
             break
         ex = ready[0]
-        pb, stats = _materialize_stage(ex, ctx, raw=not degraded)
+        with obs_span(f"stage:aqe:{sid + 1}", kind="stage",
+                      exchange=ex.node_name()):
+            pb, stats = _materialize_stage(ex, ctx, raw=not degraded)
         sid += 1
         stage = TpuQueryStageExec(ex, pb, stats, sid)
         plan = _replace_node(plan, ex, stage)
@@ -253,17 +256,21 @@ def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
             continue
         try:
             FI.maybe_inject("aqe.replan")
-            candidate, applied, effects = apply_rules(plan, ctx)
-            if applied:
-                _revalidate(candidate, ctx)
-                # only an ADOPTED rewrite counts: metrics record after
-                # re-validation, never for a discarded candidate
-                plan = candidate
-                M.record_aqe_replan()
-                for fx in effects:
-                    fx()
-                for note in applied:
-                    _note(note)
+            with obs_span(f"aqe.replan:{sid}") as replan_span:
+                candidate, applied, effects = apply_rules(plan, ctx)
+                if applied:
+                    _revalidate(candidate, ctx)
+                    # only an ADOPTED rewrite counts: metrics record
+                    # after re-validation, never for a discarded
+                    # candidate
+                    plan = candidate
+                    M.record_aqe_replan()
+                    if replan_span is not None:
+                        replan_span.attrs["applied"] = "; ".join(applied)
+                    for fx in effects:
+                        fx()
+                    for note in applied:
+                        _note(note)
         except Exception as e:  # noqa: BLE001 — degradation boundary
             # the re-optimizer may never take a query down: abandon the
             # rewrite (and all further rewrites) and keep executing the

@@ -936,6 +936,36 @@ ENCODED_MAX_DICT_FRACTION = _conf("rapids.tpu.sql.encoded.maxDictFraction").doc(
 ).check(lambda v: None if 0.0 < v <= 1.0 else "must be in (0,1]").double(0.5)
 
 
+# ---------------------------------------------------------------------------
+# Observability: query tracing + engine telemetry (spark_rapids_tpu/obs/,
+# docs/observability.md)
+# ---------------------------------------------------------------------------
+OBS_TRACING = _conf("rapids.tpu.obs.tracing.enabled").doc(
+    "Record a QueryContext-scoped span tree for every query: query -> "
+    "stage -> operator -> site spans (dispatch/transfer/spill/retry/"
+    "replan/admission-wait) with HOST-clock timestamps only — tracing "
+    "adds zero device dispatches and zero host fences (pinned by "
+    "tests/test_observability.py). The finished tree lands on "
+    "session.last_query_trace (Perfetto/Chrome-trace export via "
+    ".to_perfetto()); EXPLAIN ANALYZE forces it on for its run. Off "
+    "(default): the span API is a true no-op — no allocation, no clock "
+    "reads."
+).boolean(False)
+
+OBS_TRACE_MAX_SPANS = _conf("rapids.tpu.obs.trace.maxSpans").doc(
+    "Upper bound on spans recorded per query; spans past the cap are "
+    "counted in the trace's dropped_spans and not retained (bounds "
+    "tracer memory on pathological many-partition queries)."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(20000)
+
+OBS_TRACE_ANNOTATIONS = _conf("rapids.tpu.obs.traceAnnotations.enabled").doc(
+    "Bridge every live span into a jax.profiler.TraceAnnotation (the "
+    "NvtxWithMetrics analog for XProf): a jax.profiler capture taken "
+    "while tracing shows the engine's span names on the host timeline. "
+    "Off by default — the annotation objects cost allocations per span "
+    "and matter only under an active profiler."
+).boolean(False)
+
 class TpuConf:
     """Resolved view of the settings map (reference: RapidsConf class).
 

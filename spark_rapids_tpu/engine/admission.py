@@ -26,9 +26,15 @@ import itertools
 import threading
 from typing import Optional
 
+from spark_rapids_tpu.obs.trace import span as obs_span
+from spark_rapids_tpu.obs.trace import wall_ns
 from spark_rapids_tpu.utils import metrics as M
 
 _INF = float("inf")
+
+# bounded reservoir of recent wait durations (ns) backing the server
+# snapshot's p50/p95 — admissionWaits counts EVENTS, this keeps the TIME
+_MAX_WAIT_SAMPLES = 512
 
 
 class AdmissionTicket:
@@ -63,6 +69,8 @@ class AdmissionController:
         self._admitted = 0
         self._peak_admitted = 0
         self._waits = 0
+        self._wait_ns_samples: list = []
+        self._wait_ns_total = 0
         self._waiters: list = []
         self._seq = itertools.count()
 
@@ -96,27 +104,54 @@ class AdmissionController:
               tenant: str = "default") -> AdmissionTicket:
         """Block until `predicted_bytes` fits under the budget alongside
         everything already admitted (and no blocked-head waiter is owed
-        the next slot). Returns a ticket the caller MUST release."""
+        the next slot). Returns a ticket the caller MUST release.
+
+        A blocked query's wait is MEASURED (obs wall clock, host only):
+        the duration accumulates into the per-query admissionWaitNs
+        metric and a bounded sample reservoir backing the server
+        snapshot's wait_p50_ms/wait_p95_ms, and the wait shows up as an
+        `admission.wait` site span on the traced timeline."""
         cost = self._clamp_cost(predicted_bytes)
         with self._cv:
             if self._fits(cost, me=None):
                 self._note_bypass(me=None)
                 self._do_admit(cost)
                 return AdmissionTicket(cost, tenant)
+            # failed fast path -> waiter registration under the SAME lock
+            # hold: a younger arrival admitted in between would otherwise
+            # dodge this waiter's bypass accounting (the maxBypass
+            # starvation bound). The wait span opens here too — cv.wait
+            # releases the lock while blocked, and the tracer lock is
+            # only ever taken leaf-wise under the cv.
             me = _Waiter(next(self._seq), cost)
             self._waiters.append(me)
             self._waits += 1
             M.record_admission_wait()
+            t0 = wall_ns()
             try:
-                while not self._fits(cost, me):
-                    # timed wait: robust against a missed notify under
-                    # exceptional interleavings (releases always notify,
-                    # but a 100ms re-check costs nothing on this path)
-                    self._cv.wait(timeout=0.1)
+                with obs_span("admission.wait", kind="site",
+                              tenant=tenant, cost=cost):
+                    while not self._fits(cost, me):
+                        # timed wait: robust against a missed notify under
+                        # exceptional interleavings (releases always
+                        # notify, but a 100ms re-check costs nothing on
+                        # this path)
+                        self._cv.wait(timeout=0.1)
                 self._note_bypass(me)
                 self._do_admit(cost)
             finally:
                 self._waiters.remove(me)
+                waited = wall_ns() - t0
+                self._wait_ns_total += waited
+                self._wait_ns_samples.append(waited)
+                if len(self._wait_ns_samples) > _MAX_WAIT_SAMPLES:
+                    del self._wait_ns_samples[
+                        :len(self._wait_ns_samples) - _MAX_WAIT_SAMPLES]
+                # in the finally so an errored/interrupted wait records
+                # the SAME duration on both surfaces (controller
+                # histogram and per-query counter); takes only leaf
+                # locks, safe under the cv
+                M.record_admission_wait_ns(waited)
                 self._cv.notify_all()
         return AdmissionTicket(cost, tenant)
 
@@ -164,10 +199,24 @@ class AdmissionController:
 
     def snapshot(self) -> dict:
         with self._cv:
+            samples = sorted(self._wait_ns_samples)
             return {
                 "budget": self.budget,
                 "admitted": self._admitted,
                 "peak_admitted": self._peak_admitted,
                 "waiting": len(self._waiters),
                 "waits": self._waits,
+                "wait_total_ms": self._wait_ns_total / 1e6,
+                "wait_p50_ms": _pct_ms(samples, 0.50),
+                "wait_p95_ms": _pct_ms(samples, 0.95),
+                "wait_samples": len(samples),
             }
+
+
+def _pct_ms(sorted_ns: list, q: float) -> float:
+    """Nearest-rank percentile of a sorted ns-sample list, in ms (0.0 when
+    no query has waited yet)."""
+    if not sorted_ns:
+        return 0.0
+    idx = min(len(sorted_ns) - 1, int(round(q * (len(sorted_ns) - 1))))
+    return sorted_ns[idx] / 1e6

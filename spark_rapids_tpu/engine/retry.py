@@ -294,6 +294,8 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
                 # an inner wrapper already exhausted its OOM budget: do not
                 # multiply budgets, hand the escalation straight up
                 raise typed from e
+            from spark_rapids_tpu.obs.trace import span as obs_span
+
             if isinstance(typed, TpuRetryOOM):
                 if oom_left <= 0:
                     raise TpuSplitAndRetryOOM(
@@ -302,7 +304,11 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
                     ) from e
                 oom_left -= 1
                 M.record_retry()
-                _spill_for_retry(site)
+                # recovery work spans (docs/observability.md): the traced
+                # timeline shows time LOST to spilling/backing off between
+                # attempts, attributed to the failing site
+                with obs_span(f"retry.spill:{site}", attempt=attempt_no):
+                    _spill_for_retry(site)
             else:  # transient device error
                 if transient_left <= 0:
                     if typed is e:
@@ -310,7 +316,8 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
                     raise typed from e
                 transient_left -= 1
                 M.record_retry()
-                backoff_sleep(attempt_no, site)
+                with obs_span(f"retry.backoff:{site}", attempt=attempt_no):
+                    backoff_sleep(attempt_no, site)
             attempt_no += 1
 
 
@@ -465,6 +472,16 @@ class CircuitBreaker:
             if cls._instance is None:
                 cls._instance = cls()
             return cls._instance
+
+    @classmethod
+    def peek(cls, tenant: str) -> Optional["CircuitBreaker"]:
+        """Read-only lookup of a tenant's breaker for telemetry
+        (TpuServer.metrics_snapshot): never creates one — a tenant that
+        has not run a query has no breaker state to report."""
+        with cls._lock:
+            if tenant == "default":
+                return cls._instance
+            return cls._tenants.get(tenant)
 
     @classmethod
     def reset(cls, tenant: Optional[str] = None) -> None:

@@ -34,6 +34,7 @@ from typing import Callable, Iterator, List, Optional, TypeVar
 from spark_rapids_tpu.engine import retry as R
 from spark_rapids_tpu.exec.transitions import current_task_id, set_task_id
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.obs.trace import span as obs_span
 from spark_rapids_tpu.utils import metrics as M
 
 T = TypeVar("T")
@@ -153,7 +154,13 @@ class TaskScheduler:
                 task_id = next(_next_task_id)
             set_task_id(task_id)
             try:
-                return fn(pidx)
+                # the task span nests under whatever span was current at
+                # job submission (the submitting thread's contextvars ride
+                # into _submit's copy_context), so per-partition work
+                # lands under its stage in the traced timeline
+                with obs_span(f"task:p{pidx}", kind="task",
+                              attempt=attempt):
+                    return fn(pidx)
             except Exception as e:  # noqa: BLE001 — task isolation boundary
                 last = e
             finally:

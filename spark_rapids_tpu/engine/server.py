@@ -186,6 +186,7 @@ class MicroBatcher:
                 # the jit cache
                 tmpl.leaf.partitions[:] = packed
                 M.record_micro_batch()
+                from spark_rapids_tpu.obs.trace import span as obs_span
                 try:
                     # use_plan_cache=False: each window carries DIFFERENT
                     # data through the same leaf object, so a cached plan
@@ -194,9 +195,16 @@ class MicroBatcher:
                     # rows. Planning a Filter/Project chain is cheap and
                     # amortized over every member; the expensive part
                     # (kernel tracing) still hits the jit cache.
-                    results = session.execute_partitions(
-                        tmpl.plan, allow_micro_batch=False,
-                        use_plan_cache=False)
+                    # The pack span records on the LEADER's outer query
+                    # trace (the packed run installs its own context
+                    # inside execute_partitions), annotating how many
+                    # members rode this window.
+                    with obs_span("microbatch.pack",
+                                  members=len(w.members),
+                                  partitions=len(packed)):
+                        results = session.execute_partitions(
+                            tmpl.plan, allow_micro_batch=False,
+                            use_plan_cache=False)
                 finally:
                     # drop data refs so the template never retains a
                     # window's batches
@@ -296,3 +304,40 @@ class TpuServer:
             M.MICRO_BATCHES: M.micro_batch_count(),
             M.MICRO_BATCHED_QUERIES: M.micro_batched_query_count(),
         }
+
+    def metrics_snapshot(self) -> dict:
+        """The serving telemetry endpoint (docs/observability.md): the
+        aggregate metrics() payload extended with per-tenant lifetime
+        counters (queries/dispatches/retries/fallbacks + breaker state),
+        cache hit RATES, the admission wait histogram (p50/p95, queue
+        depth — snapshot() carries them), and spill-tier occupancy.
+        Pure host-side reads; safe to poll from a scrape thread."""
+        from spark_rapids_tpu.engine.retry import CircuitBreaker
+        from spark_rapids_tpu.memory.spill import SpillFramework
+
+        snap = self.metrics()
+        for cache in ("planCache", "jitCache"):
+            stats = snap.get(cache) or {}
+            looked = (stats.get("hits") or 0) + (stats.get("misses") or 0)
+            stats["hitRate"] = (stats.get("hits", 0) / looked
+                                if looked else 0.0)
+        fw = SpillFramework.get()
+        snap["spill"] = fw.snapshot() if fw is not None else None
+        tenants = {}
+        for tenant, s in self.sessions().items():
+            with s._totals_lock:
+                t = dict(s.tenant_metric_totals)
+                t["queries"] = s.queries_run
+            br = CircuitBreaker.peek(tenant)
+            t["breakerOpen"] = br.is_open() if br is not None else False
+            t["breakerFailures"] = br.failures if br is not None else 0
+            tenants[tenant] = t
+        snap["tenants"] = tenants
+        return snap
+
+    def metrics_prometheus(self) -> str:
+        """metrics_snapshot() in the Prometheus text exposition format —
+        the body of a /metrics scrape response (obs/prometheus.py)."""
+        from spark_rapids_tpu.obs.prometheus import render_prometheus
+
+        return render_prometheus(self.metrics_snapshot())

@@ -448,7 +448,9 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 M.record_dispatch()
                 return kern([o for _, o, _ in fixed], np.int32(n_groups))
 
-            outs = with_retry(_attempt, site="agg.finalize")
+            with M.trace_range("TpuHashAggregate.finalize",
+                               self.metrics[M.TOTAL_TIME]):
+                outs = with_retry(_attempt, site="agg.finalize")
             for (si, _o, dt), (d, v) in zip(fixed, outs):
                 slots[si] = ColumnVector(dt, d, v)
         assert all(c is not None for c in slots)
@@ -582,7 +584,9 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 M.record_dispatch()
                 return kern(cols, count_arg(batch))
 
-            out = with_retry(_attempt, site="agg.merge")
+            with M.trace_range("TpuHashAggregate.merge",
+                               self.metrics[M.TOTAL_TIME]):
+                out = with_retry(_attempt, site="agg.merge")
             if lazy:
                 outs, num_groups = out
                 merged = self._lazy_batch(outs, num_groups, kvr)
@@ -685,8 +689,10 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                         M.record_dispatch()
                         return kern(cols, count_arg(batch))
 
-                    out = with_retry(_attempt, site="agg.update",
-                                     donated=b_donate)
+                    with M.trace_range("TpuHashAggregate.update",
+                                       self.metrics[M.TOTAL_TIME]):
+                        out = with_retry(_attempt, site="agg.update",
+                                         donated=b_donate)
                     # keyed by the batch's (quantized) column vranges so the
                     # symbolic walk runs once per distinct range profile,
                     # not once per batch

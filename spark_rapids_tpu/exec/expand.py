@@ -106,8 +106,12 @@ class TpuExpandExec(_ExpandBase, TpuExec):
             for batch in child_pb.iterator(pidx):
                 batch = ensure_compact(batch)
                 for projector in self._projectors:
+                    # compute inside the range, yield outside it: a
+                    # suspended generator must not keep the span open
+                    # (and current) across the consumer's work
                     with M.trace_range("TpuExpand", total_time):
-                        yield projector.project(batch, partition_id=pidx)
+                        out = projector.project(batch, partition_id=pidx)
+                    yield out
 
         return PartitionedBatches(
             child_pb.num_partitions,

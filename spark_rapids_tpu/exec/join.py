@@ -66,6 +66,7 @@ from spark_rapids_tpu.ops.eval import (
 )
 from spark_rapids_tpu.ops.values import EvalContext, ScalarV
 from spark_rapids_tpu.plan.logical import JoinType
+from spark_rapids_tpu.utils import metrics as M
 
 
 def _nullable(attrs: List[AttributeReference]) -> List[AttributeReference]:
@@ -460,9 +461,11 @@ class _TpuJoinMixin:
             # safe; exhaustion propagates for task retry / query-level
             # CPU fallback (the build table is device-resident state —
             # batch bisection cannot recover it)
-            plan_out = with_retry(
-                lambda: jv.plan(stream_batch, build, s_cols, b_cols),
-                site="join")
+            with M.trace_range("TpuHashJoin.plan",
+                               self.metrics[M.TOTAL_TIME]):
+                plan_out = with_retry(
+                    lambda: jv.plan(stream_batch, build, s_cols, b_cols),
+                    site="join")
             b_matched = plan_out[6]
             if b_matched_acc is None:
                 b_matched_acc = b_matched
@@ -473,12 +476,17 @@ class _TpuJoinMixin:
             except AttributeError:
                 pass  # non-jax scalar (host count path)
             if pending is not None:
-                joined = with_retry(lambda: emit(*pending), site="join")
+                with M.trace_range("TpuHashJoin.emit",
+                                   self.metrics[M.TOTAL_TIME]):
+                    joined = with_retry(lambda: emit(*pending),
+                                        site="join")
                 if joined is not None:
                     yield joined
             pending = (stream_batch, plan_out)
         if pending is not None:
-            joined = with_retry(lambda: emit(*pending), site="join")
+            with M.trace_range("TpuHashJoin.emit",
+                               self.metrics[M.TOTAL_TIME]):
+                joined = with_retry(lambda: emit(*pending), site="join")
             if joined is not None:
                 yield joined
 

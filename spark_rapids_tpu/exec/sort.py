@@ -45,6 +45,7 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exec.transitions import RequireSingleBatch
 from spark_rapids_tpu.ops.base import AttributeReference, SortOrder
 from spark_rapids_tpu.ops.bind import bind_sort_orders
+from spark_rapids_tpu.utils import metrics as M
 from spark_rapids_tpu.ops.eval import _col_to_colv, _host_to_colv, cpu_project
 from spark_rapids_tpu.ops.values import EvalContext, ScalarV
 
@@ -160,7 +161,13 @@ class TpuSortExec(_SortBase, TpuExec):
                 # batch per partition (RequireSingleBatch), so exhaustion
                 # propagates for task retry / query-level CPU fallback
                 # (donated dispatches escalate to the checked replay)
-                yield R.with_retry(_attempt, site="sort", donated=donate)
+                # compute inside the range, yield outside it: a suspended
+                # generator must not keep the span open across the
+                # consumer's work
+                with M.trace_range("TpuSort", self.metrics[M.TOTAL_TIME]):
+                    out = R.with_retry(_attempt, site="sort",
+                                       donated=donate)
+                yield out
 
         def factory(pidx: int):
             return count_output(self.metrics, sort_partition(pidx))

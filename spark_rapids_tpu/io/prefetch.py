@@ -78,6 +78,28 @@ class PrefetchIterator:
         # the resource analyzer charges scan leaves
         self._queue: "queue.Queue" = queue.Queue(self._depth)
         self._closed = threading.Event()
+        # queue-occupancy telemetry (docs/observability.md): the staged
+        # depth observed at each consumer arrival — high-water ~= depth
+        # means the reader keeps ahead (prefetch is winning); ~= 0 means
+        # decode is the bottleneck. Reported as one completed span on the
+        # constructing query's tracer at close(); tracing off = all None
+        # checks, no clock reads.
+        from spark_rapids_tpu.obs.trace import (
+            current_span,
+            current_tracer,
+            wall_ns,
+        )
+
+        self._name = name
+        self._tracer = current_tracer()
+        # parent captured NOW: close() may run late (GC __del__) on a
+        # thread whose current span belongs to a different query
+        self._parent_span = current_span() if self._tracer is not None \
+            else None
+        self._start_ns = wall_ns() if self._tracer is not None else 0
+        self._occ_high = 0
+        self._items = 0
+        self._reported = False
         # the reader decodes on behalf of the constructing task's QUERY:
         # carry its contextvars (per-tenant QueryContext — metrics, fault
         # injector — docs/serving.md) onto the worker thread
@@ -94,6 +116,10 @@ class PrefetchIterator:
     def __next__(self) -> T:
         if self._closed.is_set():
             raise StopIteration
+        if self._tracer is not None:
+            occ = self._queue.qsize()
+            if occ > self._occ_high:
+                self._occ_high = occ
         kind, payload = self._queue.get()
         if payload is _END:
             self.close()
@@ -101,6 +127,7 @@ class PrefetchIterator:
         if kind == "error":
             self.close()
             raise payload
+        self._items += 1
         return payload
 
     def close(self) -> None:
@@ -112,6 +139,15 @@ class PrefetchIterator:
                 self._queue.get_nowait()
             except queue.Empty:
                 break
+        if self._tracer is not None and not self._reported:
+            self._reported = True
+            from spark_rapids_tpu.obs.trace import wall_ns
+
+            self._tracer.note_span(
+                f"prefetch:{self._name}", self._start_ns, wall_ns(),
+                attrs={"depth": self._depth, "items": self._items,
+                       "occupancy_high_water": self._occ_high},
+                parent=self._parent_span)
 
     def __del__(self):  # pragma: no cover - GC timing dependent
         try:

@@ -204,6 +204,8 @@ class BufferStore:
         acquiring another buffer's lock, so spill chains cannot deadlock."""
         if self.spill_store is None:
             raise RuntimeError(f"{self.tier.name} store has no spill target")
+        from spark_rapids_tpu.obs.trace import span as obs_span
+
         self.spill_store.make_room(buf.size)
         with buf.lock:
             if buf.tier is not self.tier or buf.refcount > 0:
@@ -211,7 +213,13 @@ class BufferStore:
             global SPILL_EVENTS
             with _SPILL_EVENTS_LOCK:
                 SPILL_EVENTS += 1
-            self._demote(buf)
+            # traced timelines show each demotion as a site span (bytes +
+            # tier edge in attrs) — spill time is the classic invisible
+            # cost the span tree exists to surface
+            with obs_span(f"spill:{self.tier.name}->"
+                          f"{self.spill_store.tier.name}",
+                          bytes=buf.size):
+                self._demote(buf)
             self.untrack(buf)
             buf.tier = self.spill_store.tier
             self.spill_store.track(buf)
@@ -365,6 +373,24 @@ class SpillFramework:
     def shutdown(cls) -> None:
         with cls._lock:
             cls._instance = None
+
+    # -- telemetry (TpuServer.metrics_snapshot, docs/observability.md) -------
+    def snapshot(self) -> dict:
+        """Spill-tier occupancy: bytes + buffer count per tier, and the
+        process-wide demotion count."""
+        with _SPILL_EVENTS_LOCK:
+            events = SPILL_EVENTS
+        return {
+            "events": events,
+            "tiers": {
+                store.tier.name.lower(): {
+                    "bytes": store.current_size,
+                    "buffers": store.buffer_count(),
+                }
+                for store in (self.device_store, self.host_store,
+                              self.disk_store)
+            },
+        }
 
     # -- plan-time hints (plan/resources.py) ---------------------------------
     def set_plan_hint(self, spill_pressure: float, per_task_peak,

@@ -1,0 +1,27 @@
+"""Engine-wide observability (docs/observability.md).
+
+Three layers over one substrate:
+
+- `obs.trace` — the QueryContext-scoped span tree (query -> stage ->
+  operator -> site spans) every query records when
+  `rapids.tpu.obs.tracing.enabled` is on. Host-clock timestamps only:
+  tracing adds ZERO device dispatches and ZERO host fences (pinned by
+  tests/test_observability.py), and the API is a true no-op when
+  tracing is off.
+- `obs.analyze` — EXPLAIN ANALYZE: the executed physical plan annotated
+  per operator with measured rows/batches/wall-time beside the resource
+  analyzer's plan-time predictions (the predicted-vs-actual table the
+  cost-model roadmap item calibrates from).
+- `obs.perfetto` / `obs.prometheus` — exporters: Chrome-trace-event JSON
+  (`session.last_query_trace.to_perfetto()`, loadable in Perfetto) and
+  the Prometheus text exposition of `TpuServer.metrics_snapshot()`.
+"""
+
+from spark_rapids_tpu.obs.trace import (  # noqa: F401
+    QueryTrace,
+    QueryTracer,
+    Span,
+    current_tracer,
+    span,
+    wall_ns,
+)

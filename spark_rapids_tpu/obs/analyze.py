@@ -1,0 +1,128 @@
+"""EXPLAIN ANALYZE: execute a query and render its physical plan with
+MEASURED per-operator rows/batches/wall-time beside the resource
+analyzer's plan-time PREDICTIONS (docs/observability.md).
+
+The reference's EXPLAIN shows what the plugin planned; its SQLMetrics
+show what ran — but only the Spark UI joins the two. Here the join is a
+first-class string: each operator line carries the measured numbers (from
+the exec node's MetricsMap, diffed against a pre-execution snapshot so
+plan-cache-reused nodes report THIS query only) and, where the analyzer
+produced a NodeEstimate for that operator, the predicted row interval and
+dispatch interval beside them. The trailing totals section pins the
+predicted-vs-actual contract the cost-model roadmap item calibrates from:
+measured deviceDispatches must sit inside the analyzer's interval.
+
+Runs with tracing forced ON (the wall-time column is span-backed), so the
+same call leaves `session.last_query_trace` populated for a Perfetto
+export of the run it just annotated.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.utils import metrics as M
+
+
+def _fmt_ms(ns: int) -> str:
+    return f"{ns / 1e6:.2f}ms"
+
+
+class _PredictionIndex:
+    """Greedy name-ordered matcher from plan nodes to the analyzer's
+    NodeEstimate lines (both walk the same tree, so per-name FIFO order
+    lines up; a node the analyzer never estimated simply gets no
+    prediction suffix)."""
+
+    def __init__(self, report):
+        self._by_name: Dict[str, List] = {}
+        if report is not None:
+            for est in report.nodes:
+                self._by_name.setdefault(est.name, []).append(est)
+
+    def take(self, name: str):
+        q = self._by_name.get(name)
+        return q.pop(0) if q else None
+
+
+def _annotation_for(node, pre: Dict[int, Dict[str, int]],
+                    preds: _PredictionIndex) -> str:
+    snap = node.metrics.snapshot()
+    before = pre.get(id(node), {})
+    rows = snap.get(M.NUM_OUTPUT_ROWS, 0) - before.get(M.NUM_OUTPUT_ROWS, 0)
+    batches = snap.get(M.NUM_OUTPUT_BATCHES, 0) \
+        - before.get(M.NUM_OUTPUT_BATCHES, 0)
+    t_ns = snap.get(M.TOTAL_TIME, 0) - before.get(M.TOTAL_TIME, 0)
+    parts = [f"rows={rows}", f"batches={batches}", f"time={_fmt_ms(t_ns)}"]
+    est = preds.take(node.node_name())
+    if est is not None:
+        parts.append(f"| predicted rows={est.rows!r} "
+                     f"dispatches={est.dispatches!r}")
+    return "  [" + " ".join(parts) + "]"
+
+
+def render_analyzed_plan(physical, pre_metrics: Dict[int, Dict[str, int]],
+                         report) -> str:
+    """The measured/predicted tree body (no execution; analyze-and-render
+    over an already-executed plan)."""
+    from spark_rapids_tpu.plan.meta import explain_string
+
+    preds = _PredictionIndex(report)
+    return explain_string(
+        physical,
+        annotate=lambda node: _annotation_for(node, pre_metrics, preds))
+
+
+def explain_analyze(session, plan) -> str:
+    """Execute `plan` on `session` and return the annotated-plan report.
+    Tracing is forced for THIS run via execute_partitions(force_tracing=
+    True) — the session conf is never touched, so concurrent queries'
+    plan-cache signatures (built from the settings map under the plan
+    lock) cannot observe a transient flag."""
+    cap = session.plan_capture
+    cap.start()
+    try:
+        session.execute_partitions(plan, allow_micro_batch=False,
+                                   force_tracing=True)
+    finally:
+        plans = cap.stop()
+        pre_list = cap.pre_metrics()
+    if not plans:
+        return "== EXPLAIN ANALYZE ==\n(no physical plan captured)"
+    # the LAST captured plan is the one that produced the results (a
+    # checked replay / CPU fallback re-plans; earlier captures are the
+    # abandoned attempts)
+    physical = plans[-1]
+    pre = pre_list[-1] if pre_list else {}
+    report = session.last_resource_report
+    qm = session.last_query_metrics
+    lines = ["== EXPLAIN ANALYZE ==",
+             render_analyzed_plan(physical, pre, report),
+             "== Query totals =="]
+    trace = session.last_query_trace
+    if trace is not None:
+        lines.append(f"wall time: {_fmt_ms(trace.duration_ns)}")
+    measured_d = qm.get(M.DEVICE_DISPATCHES, 0)
+    measured_f = qm.get(M.FENCES, 0)
+    if report is not None:
+        d, f = report.dispatches, report.fences
+        d_ok = d.lo <= measured_d <= d.hi
+        f_ok = f.lo <= measured_f <= f.hi
+        lines.append(f"device dispatches: measured {measured_d}, "
+                     f"predicted {d!r}"
+                     f" ({'within' if d_ok else 'OUTSIDE'} interval)")
+        lines.append(f"host fences: measured {measured_f}, "
+                     f"predicted {f!r}"
+                     f" ({'within' if f_ok else 'OUTSIDE'} interval)")
+    else:
+        lines.append(f"device dispatches: measured {measured_d} "
+                     "(no resource analysis)")
+        lines.append(f"host fences: measured {measured_f}")
+    if trace is not None:
+        stages = trace.stage_breakdown()
+        if stages:
+            lines.append("stage wall-time breakdown:")
+            for name, secs in sorted(stages.items(),
+                                     key=lambda kv: -kv[1]):
+                lines.append(f"  {name}: {secs * 1e3:.2f}ms")
+    return "\n".join(lines)

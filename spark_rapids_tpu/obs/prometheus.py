@@ -1,0 +1,131 @@
+"""Prometheus text exposition of the serving runtime's metrics snapshot
+(docs/observability.md).
+
+`TpuServer.metrics_snapshot()` produces one nested dict (per-tenant
+query/retry/fallback counters, cache hit rates, admission queue depth +
+wait quantiles, breaker state, spill-tier occupancy); this module renders
+it in the Prometheus text format (version 0.0.4: `# HELP` / `# TYPE`
+lines, `name{label="value"} number` samples) so a scrape endpoint is one
+`web.Response(text=server.metrics_prometheus())` away. No HTTP server is
+bundled — the serving runtime stays embeddable (docs/serving.md)."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(*parts: str) -> str:
+    """snake_case-join path segments into a legal metric name."""
+    segs = []
+    for p in parts:
+        p = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", str(p)).lower()
+        segs.append(_NAME_OK.sub("_", p))
+    return "srt_" + "_".join(s for s in segs if s)
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: List[str] = []
+        self._typed: set = set()
+
+    def sample(self, name: str, value, labels: Dict[str, str] = None,
+               mtype: str = "gauge", help_text: str = "") -> None:
+        if value is None:
+            return
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            return
+        if name not in self._typed:
+            if help_text:
+                self.lines.append(f"# HELP {name} {help_text}")
+            self.lines.append(f"# TYPE {name} {mtype}")
+            self._typed.add(name)
+        self.lines.append(f"{name}{_fmt_labels(labels or {})} {value}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """The metrics_snapshot dict as Prometheus exposition text."""
+    w = _Writer()
+    # -- caches ---------------------------------------------------------------
+    for cache in ("planCache", "jitCache"):
+        stats = snapshot.get(cache) or {}
+        for key, mtype in (("hits", "counter"), ("misses", "counter"),
+                           ("entries", "gauge")):
+            name = _metric_name(cache, key)
+            if mtype == "counter":
+                name += "_total"
+            w.sample(name, stats.get(key), mtype=mtype,
+                     help_text=f"{cache} {key}")
+        rate = stats.get("hitRate")
+        w.sample(_metric_name(cache, "hit_ratio"), rate,
+                 help_text=f"{cache} hits / lookups")
+    # -- admission ------------------------------------------------------------
+    adm = snapshot.get("admission") or {}
+    w.sample("srt_admission_budget_bytes", adm.get("budget"))
+    w.sample("srt_admission_admitted_bytes", adm.get("admitted"))
+    w.sample("srt_admission_peak_admitted_bytes", adm.get("peak_admitted"))
+    w.sample("srt_admission_queue_depth", adm.get("waiting"),
+             help_text="queries currently blocked in HBM admission")
+    w.sample("srt_admission_waits_total", adm.get("waits"),
+             mtype="counter")
+    for q in ("p50", "p95"):
+        ms = adm.get(f"wait_{q}_ms")
+        if ms is not None:
+            w.sample("srt_admission_wait_seconds",
+                     ms / 1e3, {"quantile": q.replace("p", "0.")},
+                     mtype="summary",
+                     help_text="admission wait duration quantiles")
+    # -- spill tiers ----------------------------------------------------------
+    spill = snapshot.get("spill") or {}
+    w.sample("srt_spill_events_total", spill.get("events"),
+             mtype="counter", help_text="buffers demoted a tier")
+    for tier, t in sorted((spill.get("tiers") or {}).items()):
+        w.sample("srt_spill_tier_bytes", t.get("bytes"), {"tier": tier},
+                 help_text="bytes resident per spill tier")
+        w.sample("srt_spill_tier_buffers", t.get("buffers"),
+                 {"tier": tier})
+    # -- micro-batching -------------------------------------------------------
+    w.sample("srt_micro_batches_total", snapshot.get("microBatches"),
+             mtype="counter")
+    w.sample("srt_micro_batched_queries_total",
+             snapshot.get("microBatchedQueries"), mtype="counter")
+    # -- per-tenant counters --------------------------------------------------
+    for tenant, t in sorted((snapshot.get("tenants") or {}).items()):
+        labels = {"tenant": tenant}
+        w.sample("srt_tenant_queries_total", t.get("queries"), labels,
+                 mtype="counter", help_text="queries executed per tenant")
+        for key, metric in (("deviceDispatches", "device_dispatches"),
+                            ("retries", "retries"),
+                            ("cpuFallbackEvents", "cpu_fallbacks"),
+                            ("planCacheHits", "plan_cache_hits"),
+                            ("admissionWaits", "admission_waits"),
+                            ("checkedReplays", "checked_replays")):
+            w.sample(f"srt_tenant_{metric}_total", t.get(key), labels,
+                     mtype="counter")
+        w.sample("srt_tenant_admission_wait_seconds_total",
+                 (t.get("admissionWaitNs") or 0) / 1e9, labels,
+                 mtype="counter")
+        w.sample("srt_tenant_breaker_open", t.get("breakerOpen"), labels,
+                 help_text="1 when the tenant's circuit breaker is open")
+        w.sample("srt_tenant_breaker_failures", t.get("breakerFailures"),
+                 labels)
+    return w.text()

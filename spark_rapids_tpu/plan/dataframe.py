@@ -364,6 +364,15 @@ class DataFrame:
         print(text)
         return text
 
+    def explain_analyze(self) -> str:
+        """EXPLAIN ANALYZE: execute this query (tracing forced on) and
+        print the plan annotated with measured per-operator metrics
+        beside the analyzer's predictions (docs/observability.md)."""
+        text = self.session.explain_analyze(self._plan)
+        # tpulint: stdout-print -- explain_analyze() IS the console API
+        print(text)
+        return text
+
     def toPandas(self):
         import pandas as pd
 

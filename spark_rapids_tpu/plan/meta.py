@@ -196,24 +196,34 @@ class ExecMeta(BaseMeta):
         return "\n".join(lines)
 
 
-def explain_string(plan: PhysicalExec, indent: int = 0) -> str:
+def explain_string(plan: PhysicalExec, indent: int = 0,
+                   annotate: Optional[Callable[[PhysicalExec], str]] = None
+                   ) -> str:
     """Render a FINAL physical plan with Spark-style whole-stage markers:
     every operator belonging to fused stage N prints as `*(N) Op` under its
     `TpuFusedStage(N)` node (reference: WholeStageCodegen's `*(N)` EXPLAIN
-    prefix). Non-member nodes print bare."""
+    prefix). Non-member nodes print bare.
+
+    `annotate(node) -> suffix` appends a per-node suffix line-fragment —
+    EXPLAIN ANALYZE (obs/analyze.py) uses it to print measured metrics
+    beside each operator without duplicating this tree layout."""
     from spark_rapids_tpu.exec.fused import TpuFusedStageExec
 
     lines: List[str] = []
 
+    def suffix(node: PhysicalExec) -> str:
+        return annotate(node) if annotate is not None else ""
+
     def walk(node: PhysicalExec, depth: int, stage: Optional[int],
              remaining: int) -> None:
         if isinstance(node, TpuFusedStageExec):
-            lines.append("  " * depth + node.node_name())
+            lines.append("  " * depth + node.node_name() + suffix(node))
             walk(node.children[0], depth + 1, node.stage_id, node.n_ops)
             return
         marker = f"*({stage}) " if stage is not None and remaining > 0 \
             else ""
-        lines.append("  " * depth + marker + node.node_name())
+        lines.append("  " * depth + marker + node.node_name()
+                     + suffix(node))
         in_stage = stage is not None and remaining > 1
         for c in node.children:
             walk(c, depth + 1, stage if in_stage else None,

@@ -54,16 +54,23 @@ _LIVE_SESSIONS: "weakref.WeakSet" = weakref.WeakSet()
 
 class PlanCapture:
     """Test hook capturing the final physical plan of each execution
-    (reference: ExecutionPlanCaptureCallback, Plugin.scala:144-233)."""
+    (reference: ExecutionPlanCaptureCallback, Plugin.scala:144-233).
+
+    Each capture also snapshots every node's metrics AT RECORD TIME
+    (before execution): plan-cache-reused physical plans accumulate
+    metrics across queries, so EXPLAIN ANALYZE (obs/analyze.py) diffs
+    against this snapshot to report THIS execution only."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._plans: List[PhysicalExec] = []
+        self._pre: List[dict] = []
         self.enabled = False
 
     def start(self):
         with self._lock:
             self._plans.clear()
+            self._pre.clear()
             self.enabled = True
 
     def stop(self) -> List[PhysicalExec]:
@@ -71,10 +78,20 @@ class PlanCapture:
             self.enabled = False
             return list(self._plans)
 
+    def pre_metrics(self) -> List[dict]:
+        """Per captured plan: {id(node): metrics snapshot} taken when the
+        plan was recorded (parallel to stop()'s list)."""
+        with self._lock:
+            return list(self._pre)
+
     def record(self, plan: PhysicalExec):
         if self.enabled:
+            pre = {}
+            plan.foreach(lambda n: pre.__setitem__(id(n),
+                                                   n.metrics.snapshot()))
             with self._lock:
                 self._plans.append(plan)
+                self._pre.append(pre)
 
 
 class TpuSession:
@@ -106,6 +123,18 @@ class TpuSession:
         # '== Adaptive execution ==' section. Empty when adaptive is off
         # or no rule fired.
         self.last_adaptive_report: List[str] = []
+        # the finished span tree of the most recent TRACED query
+        # (obs/trace.QueryTrace; None while rapids.tpu.obs.tracing.enabled
+        # is off). Under concurrent queries: last-completed-wins per
+        # session, same contract as last_query_metrics.
+        self.last_query_trace = None
+        # lifetime per-tenant accounting for the serving telemetry
+        # endpoint (TpuServer.metrics_snapshot): every query's
+        # QueryContext counters merge here at completion, plus a query
+        # count — one merge per query, not per increment
+        self.tenant_metric_totals: Dict[str, int] = {}
+        self.queries_run = 0
+        self._totals_lock = threading.Lock()
         # wired by TpuServer.connect: queries eligible for cross-query
         # micro-batching route through the server's shared batcher
         self.micro_batcher = None
@@ -482,6 +511,17 @@ class TpuSession:
             parts.append("== Adaptive execution ==\n" + "\n".join(lines))
         return "\n".join(parts)
 
+    def explain_analyze(self, plan: L.LogicalPlan) -> str:
+        """EXPLAIN ANALYZE (docs/observability.md): EXECUTE the query with
+        tracing forced on, then render the physical plan with measured
+        per-operator rows/batches/wall-time beside the resource
+        analyzer's predictions, plus the measured-vs-predicted dispatch
+        and fence totals. Also leaves session.last_query_trace populated
+        for a Perfetto export of the analyzed run."""
+        from spark_rapids_tpu.obs.analyze import explain_analyze as _ea
+
+        return _ea(self, plan)
+
     def _exec_context(self) -> ExecContext:
         return ExecContext(self.conf, self.scheduler, self.device_manager)
 
@@ -492,7 +532,8 @@ class TpuSession:
 
     def execute_partitions(self, plan: L.LogicalPlan,
                            allow_micro_batch: bool = True,
-                           use_plan_cache: bool = True):
+                           use_plan_cache: bool = True,
+                           force_tracing: bool = False):
         """Run one query; returns per-partition lists of host batches (in
         partition order). The serving entry point: installs the per-query
         QueryContext (tenant metrics + breaker + injector + retry budget),
@@ -527,6 +568,22 @@ class TpuSession:
         R.set_policy_from_conf(self.conf, ctx=qctx)
         qctx.breaker = breaker
         qctx.begin_retry_budget(self.conf.get(C.RETRY_BUDGET))
+        # force_tracing (EXPLAIN ANALYZE) traces THIS run without touching
+        # conf: the settings map feeds plan-cache signatures under
+        # _plan_lock, so a transient conf flip would both race concurrent
+        # signature builds and fork the cache key
+        span_token = None
+        if force_tracing or self.conf.get(C.OBS_TRACING):
+            from spark_rapids_tpu.obs.trace import QueryTracer, reset_current_span
+
+            qctx.trace = QueryTracer(
+                name=type(plan).__name__, tenant=self.tenant,
+                max_spans=self.conf.get(C.OBS_TRACE_MAX_SPANS),
+                annotate=self.conf.get(C.OBS_TRACE_ANNOTATIONS))
+            # a nested run (the micro-batcher's packed execution under the
+            # leader's query) must root its spans in ITS OWN tree, not
+            # under whatever span the enclosing query has open
+            span_token = reset_current_span()
         token = M.push_query_ctx(qctx)
         physical = None
         try:
@@ -571,6 +628,7 @@ class TpuSession:
                          M.CHECKED_REPLAYS, M.DONATED_BYTES, M.SPMD_STAGES,
                          M.COLLECTIVE_BYTES, M.PLAN_CACHE_HITS,
                          M.PLAN_CACHE_MISSES, M.ADMISSION_WAITS,
+                         M.ADMISSION_WAIT_NS,
                          M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES,
                          M.ENCODED_COLUMNS, M.LATE_MATERIALIZATIONS,
                          M.ENCODED_BYTES_SAVED, M.AQE_REPLANS,
@@ -578,6 +636,19 @@ class TpuSession:
                          M.JOIN_PROMOTIONS):
                 self.last_query_metrics[name] = snap.get(name, 0)
             self.last_adaptive_report = list(qctx.aqe_notes)
+            if qctx.trace is not None:
+                self.last_query_trace = qctx.trace.finish()
+                if span_token is not None:
+                    from spark_rapids_tpu.obs.trace import restore_current_span
+
+                    restore_current_span(span_token)
+            # lifetime tenant totals for the serving telemetry endpoint
+            # (TpuServer.metrics_snapshot): one merge per query
+            with self._totals_lock:
+                self.queries_run += 1
+                for name, v in snap.items():
+                    self.tenant_metric_totals[name] = \
+                        self.tenant_metric_totals.get(name, 0) + v
 
     def _maybe_micro_batch(self, plan: L.LogicalPlan, breaker,
                            allow_micro_batch: bool):
@@ -624,9 +695,11 @@ class TpuSession:
         from spark_rapids_tpu.engine import async_exec as AX
         from spark_rapids_tpu.engine.admission import AdmissionController
         from spark_rapids_tpu.exec.transitions import DeviceToHostExec
+        from spark_rapids_tpu.obs.trace import span as obs_span
         from spark_rapids_tpu.utils import metrics as M
 
-        physical = self._physical_plan(plan, use_cache=use_plan_cache)
+        with obs_span("plan", kind="stage"):
+            physical = self._physical_plan(plan, use_cache=use_plan_cache)
         ticket = ctl = None
         qctx = M.current_query_ctx()
         report = qctx.resource_report if qctx is not None \
@@ -645,8 +718,10 @@ class TpuSession:
                 results = self._execute_lifted_sink(physical, ctx)
                 return physical, results
             pb = physical.execute(ctx)
-            results = self.scheduler.run_job(
-                pb.num_partitions, lambda p: list(pb.iterator(p)))
+            with obs_span("stage:result", kind="stage",
+                          partitions=pb.num_partitions):
+                results = self.scheduler.run_job(
+                    pb.num_partitions, lambda p: list(pb.iterator(p)))
             return physical, results
         finally:
             if ticket is not None:
@@ -670,6 +745,8 @@ class TpuSession:
         here — this path replaces its per-partition iterators."""
         from spark_rapids_tpu.utils import metrics as M
 
+        from spark_rapids_tpu.obs.trace import span as obs_span
+
         child_pb = physical.children[0].execute(ctx)
         n = child_pb.num_partitions
         results: List[Optional[list]] = [None] * n
@@ -688,13 +765,17 @@ class TpuSession:
                 hi += len(part)
             pending, pending_bytes = [], 0
 
-        for pidx, part in self.scheduler.run_job_iter(
-                n, lambda p: (p, list(child_pb.iterator(p)))):
-            pending.append((pidx, part))
-            pending_bytes += sum(b.device_memory_size() for b in part)
-            if pending_bytes > self._SINK_FLUSH_BYTES:
-                flush()
-        flush()
+        # the result stage span covers the partition tasks + grouped sink
+        # downloads, but NOT the child execute above — exchanges that
+        # materialized there opened their own stage spans at top level
+        with obs_span("stage:result", kind="stage", partitions=n):
+            for pidx, part in self.scheduler.run_job_iter(
+                    n, lambda p: (p, list(child_pb.iterator(p)))):
+                pending.append((pidx, part))
+                pending_bytes += sum(b.device_memory_size() for b in part)
+                if pending_bytes > self._SINK_FLUSH_BYTES:
+                    flush()
+            flush()
         physical.metrics[M.NUM_OUTPUT_BATCHES].add(
             sum(len(part) for part in results))
         physical.metrics[M.NUM_OUTPUT_ROWS].add(

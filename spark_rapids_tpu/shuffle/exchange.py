@@ -222,8 +222,14 @@ class _ExchangeBase(PhysicalExec):
             return buckets
 
         from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+        from spark_rapids_tpu.obs.trace import span as obs_span
 
-        map_results = run_job_or_serial(ctx.scheduler, n_maps, run_map)
+        # the exchange map job IS a stage boundary: a traced query gets a
+        # stage span covering its partition tasks (the task spans nest
+        # under it via the scheduler's context propagation)
+        with obs_span(f"stage:map:{self.node_name()}", kind="stage",
+                      maps=n_maps, reducers=n_out):
+            map_results = run_job_or_serial(ctx.scheduler, n_maps, run_map)
         reduce_buckets: List[List[Any]] = [[] for _ in range(n_out)]
         # piece provenance (map partition, index within its (map, target)
         # slice list): the lineage needed to RE-EXECUTE the upstream map
@@ -683,8 +689,12 @@ class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
             return out
 
         from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+        from spark_rapids_tpu.obs.trace import span as obs_span
 
-        per_part = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
+        with obs_span(f"stage:map:{self.node_name()}", kind="stage",
+                      maps=child_pb.num_partitions):
+            per_part = run_job_or_serial(ctx.scheduler,
+                                         child_pb.num_partitions, mat)
         all_keys: List[List[Any]] = [[] for _ in p.orders]
         for part in per_part:
             for _, keys in part:
@@ -851,8 +861,12 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                     if not getattr(b, "rows_on_host", True) or b.num_rows > 0]
 
         from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+        from spark_rapids_tpu.obs.trace import span as obs_span
 
-        per_map = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
+        with obs_span(f"stage:map:{self.node_name()}", kind="stage",
+                      maps=child_pb.num_partitions):
+            per_map = run_job_or_serial(ctx.scheduler,
+                                        child_pb.num_partitions, mat)
         bounds_np = None
         if isinstance(p, HashPartitioning):
             spec = ("hash", tuple(bind_all(p.exprs, child_attrs)), ())
@@ -947,8 +961,12 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             return out
 
         from spark_rapids_tpu.engine.scheduler import run_job_or_serial
+        from spark_rapids_tpu.obs.trace import span as obs_span
 
-        per_part = run_job_or_serial(ctx.scheduler, child_pb.num_partitions, mat)
+        with obs_span(f"stage:map:{self.node_name()}", kind="stage",
+                      maps=child_pb.num_partitions):
+            per_part = run_job_or_serial(ctx.scheduler,
+                                         child_pb.num_partitions, mat)
 
         # one fixed byte width per string key across all batches so every
         # packed row compares in the same space

@@ -64,6 +64,11 @@ COLLECTIVE_BYTES = "collectiveBytes"
 PLAN_CACHE_HITS = "planCacheHits"
 PLAN_CACHE_MISSES = "planCacheMisses"
 ADMISSION_WAITS = "admissionWaits"
+# admissionWaits counts EVENTS; this accumulates the waited DURATION in
+# nanoseconds (engine/admission.py measures it via the obs wall clock) —
+# the server snapshot additionally surfaces a p50/p95 from the
+# controller's bounded sample reservoir
+ADMISSION_WAIT_NS = "admissionWaitNs"
 MICRO_BATCHES = "microBatches"
 MICRO_BATCHED_QUERIES = "microBatchedQueries"
 # encoded columnar execution (columnar/encoded.py,
@@ -156,7 +161,7 @@ class QueryContext:
     __slots__ = ("tenant", "_lock", "_counters", "breaker", "injector",
                  "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
                  "resource_report", "retry_policy", "aqe_notes",
-                 "spill_plan_hint", "async_dispatch", "donation")
+                 "spill_plan_hint", "async_dispatch", "donation", "trace")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -203,6 +208,12 @@ class QueryContext:
         # THIS query. None = fall back to the process-wide flags
         self.async_dispatch = None
         self.donation = None
+        # THIS query's span tracer (obs/trace.QueryTracer; None = tracing
+        # off, the zero-cost default). Installed by the session when
+        # rapids.tpu.obs.tracing.enabled; every record_* chokepoint
+        # mirrors its increment onto the tracer's current span via _note,
+        # so the timeline shows WHERE dispatches/retries/fences happened
+        self.trace = None
 
     def add(self, name: str, n: int) -> None:
         with self._lock:
@@ -251,10 +262,16 @@ def pop_query_ctx(token) -> None:
 
 
 def _note(name: str, n: int) -> None:
-    """Mirror a global-counter increment into the ambient query context."""
+    """Mirror a global-counter increment into the ambient query context —
+    and, when the query is traced, onto the tracer's current span (one
+    attribute check when tracing is off: the zero-cost contract of
+    docs/observability.md)."""
     ctx = _QUERY_CTX.get()
     if ctx is not None:
         ctx.add(name, n)
+        tr = ctx.trace
+        if tr is not None:
+            tr.add_count(name, n)
 
 
 # ---------------------------------------------------------------------------
@@ -421,6 +438,7 @@ def collective_bytes() -> int:
 _PLAN_CACHE_HITS = Metric(PLAN_CACHE_HITS)
 _PLAN_CACHE_MISSES = Metric(PLAN_CACHE_MISSES)
 _ADMISSION_WAITS = Metric(ADMISSION_WAITS)
+_ADMISSION_WAIT_NS = Metric(ADMISSION_WAIT_NS)
 _MICRO_BATCHES = Metric(MICRO_BATCHES)
 _MICRO_BATCHED_QUERIES = Metric(MICRO_BATCHED_QUERIES)
 
@@ -457,6 +475,18 @@ def record_admission_wait(n: int = 1) -> None:
 
 def admission_wait_count() -> int:
     return _ADMISSION_WAITS.value
+
+
+def record_admission_wait_ns(n: int) -> None:
+    """Accumulate the DURATION one query spent blocked in analyzer-driven
+    admission (ns; the admissionWaits event counter's missing half —
+    engine/admission.py measures it with the obs wall clock)."""
+    _ADMISSION_WAIT_NS.add(n)
+    _note(ADMISSION_WAIT_NS, n)
+
+
+def admission_wait_ns() -> int:
+    return _ADMISSION_WAIT_NS.value
 
 
 def record_micro_batch(n: int = 1) -> None:
@@ -580,7 +610,16 @@ def join_promotion_count() -> int:
 
 @contextlib.contextmanager
 def trace_range(name: str, metric: Optional[Metric] = None):
-    """NvtxWithMetrics analog: XProf trace annotation + elapsed-ns metric."""
+    """NvtxWithMetrics analog: XProf trace annotation + elapsed-ns metric.
+
+    THE operator-span chokepoint: every kernel/transfer site already
+    wraps its device work in trace_range, so when the ambient query is
+    traced (obs/trace.py) the same call opens an operator span — the
+    span tree gets per-operator timing with no new instrumentation
+    sites. Host clock only; no device syncs."""
+    ctx = _QUERY_CTX.get()
+    tr = ctx.trace if ctx is not None else None
+    handle = tr.open_span(name, "op") if tr is not None else None
     start = time.perf_counter_ns()
     if _TraceAnnotation is not None:
         cm = _TraceAnnotation(name)
@@ -592,3 +631,5 @@ def trace_range(name: str, metric: Optional[Metric] = None):
         finally:
             if metric is not None:
                 metric.add(time.perf_counter_ns() - start)
+            if handle is not None:
+                tr.close_span(handle)

@@ -37,3 +37,22 @@ def test_linter_cli_is_invocable():
     from tools.tpulint.__main__ import main
 
     assert main([os.path.join(REPO, "spark_rapids_tpu")]) == 0
+
+
+def test_obs_package_gated_and_in_sync_scopes():
+    """The observability package is covered by the tier-1 gate with the
+    executor-layer rule scopes wired over it: mid-query-sync (the
+    zero-added-syncs contract of docs/observability.md is machine-
+    checked, not just documented) — while obs/ itself hosts the
+    sanctioned clock, so it is NOT in the naked-timer scope."""
+    from tools.tpulint.core import is_mid_query_scope, is_timer_scope
+
+    assert is_mid_query_scope("spark_rapids_tpu/obs/trace.py")
+    assert not is_timer_scope("spark_rapids_tpu/obs/trace.py")
+    # the engine's timed layers ARE in the naked-timer scope
+    for p in ("spark_rapids_tpu/exec/x.py", "spark_rapids_tpu/engine/x.py",
+              "spark_rapids_tpu/shuffle/x.py", "spark_rapids_tpu/aqe/x.py"):
+        assert is_timer_scope(p), p
+    findings = lint_paths([os.path.join(REPO, "spark_rapids_tpu", "obs")])
+    assert not findings, "tpulint findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings)

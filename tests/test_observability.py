@@ -1,0 +1,432 @@
+"""Query tracing & engine telemetry tests (docs/observability.md).
+
+Pins the subsystem's load-bearing contracts:
+
+- ZERO-COST OFF: with tracing off the span API returns the shared no-op
+  and no trace is recorded;
+- ZERO DEVICE FOOTPRINT ON: deviceDispatches and fencesPerQuery on the
+  flagship query are IDENTICAL with tracing on vs off (tracing is pure
+  host bookkeeping — no extra dispatches, no extra fences);
+- span-tree correctness under the scheduler's thread pool: stage spans
+  contain their partitions' task spans, per-span metric counts sum to
+  the query's own metrics (context propagation), and 3 concurrent
+  tenants' traces never absorb each other's increments;
+- the Chrome-trace exporter emits valid trace-event JSON;
+- EXPLAIN ANALYZE shows measured per-operator wall-time with the
+  analyzer's predicted intervals containing the measured dispatches;
+- admission waits record DURATION (p50/p95 in the controller snapshot,
+  admissionWaitNs per query), not just event counts;
+- the Prometheus exposition renders the server snapshot with per-tenant
+  counters in the text format.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.engine.admission import AdmissionController
+from spark_rapids_tpu.engine.server import TpuServer
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.utils import metrics as M
+
+
+def _mk_df(session, seed=7, n=4096, num_partitions=2):
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": rng.integers(0, 32, n).astype(np.int64),
+        "a": rng.integers(-1000, 1000, n).astype(np.int64),
+        "b": rng.random(n).astype(np.float32),
+    }
+    return session.createDataFrame(
+        data, [("k", "long"), ("a", "long"), ("b", "float")],
+        num_partitions=num_partitions)
+
+
+def _flagship(df):
+    """The bench.py flagship shape: filter + project + hash aggregate."""
+    return (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+              .withColumn("c", F.col("a") * 2 + 1)
+              .groupBy("k")
+              .agg(F.sum("c").alias("s"), F.count("*").alias("n"),
+                   F.max("a").alias("m")))
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost off / zero-device-footprint on
+# ---------------------------------------------------------------------------
+def test_span_api_is_noop_outside_traced_query():
+    from spark_rapids_tpu.obs.trace import _NOOP, span, wall_ns
+
+    cm = span("anything", kind="site", some_attr=1)
+    assert cm is _NOOP
+    with cm as sp:
+        assert sp is None
+    assert isinstance(wall_ns(), int)
+
+
+def test_tracing_off_records_no_trace(session):
+    q = _flagship(_mk_df(session))
+    q.collect()
+    assert session.last_query_trace is None
+
+
+def test_tracing_adds_zero_dispatches_and_zero_fences(session):
+    """THE overhead contract: the flagship query's deviceDispatches and
+    fencesPerQuery are identical with tracing on vs off."""
+    q = _flagship(_mk_df(session))
+    q.collect()  # warm compiles under tracing-off
+    q.collect()
+    off = dict(session.last_query_metrics)
+    session.set_conf(C.OBS_TRACING.key, True)
+    q.collect()  # warm any tracing-path plan-cache interaction
+    q.collect()
+    on = dict(session.last_query_metrics)
+    assert on[M.DEVICE_DISPATCHES] == off[M.DEVICE_DISPATCHES]
+    assert on[M.FENCES] == off[M.FENCES]
+    assert session.last_query_trace is not None
+
+
+# ---------------------------------------------------------------------------
+# Span-tree structure + context propagation on the worker pool
+# ---------------------------------------------------------------------------
+def test_span_tree_structure_and_count_attribution(session):
+    session.set_conf(C.OBS_TRACING.key, True)
+    q = _flagship(_mk_df(session, num_partitions=3))
+    q.collect()
+    trace = session.last_query_trace
+    assert trace is not None
+    kinds = {s.kind for s in trace.spans()}
+    assert trace.root.kind == "query"
+    assert "stage" in kinds and "task" in kinds and "op" in kinds
+    # the map stage contains its partitions' task spans (tasks ran on the
+    # pool; the current-span contextvar rode copy_context into _submit)
+    map_stages = [s for s in trace.spans()
+                  if s.kind == "stage" and s.name.startswith("stage:map:")]
+    assert map_stages, trace.render()
+    task_children = [c for s in map_stages for c in s.children
+                     if c.kind == "task"]
+    assert len(task_children) == 3
+    # every metric increment recorded during the query is attributed to
+    # some span: per-span counts sum exactly to the query's own metrics
+    totals = trace.counts_total()
+    assert totals.get(M.DEVICE_DISPATCHES, 0) == \
+        session.last_query_metrics[M.DEVICE_DISPATCHES]
+    assert totals.get(M.FENCES, 0) == \
+        session.last_query_metrics[M.FENCES]
+    # stage breakdown covers the whole pipeline (plan + map + result)
+    breakdown = trace.stage_breakdown()
+    assert any(name.startswith("stage:map:") for name in breakdown)
+    assert "stage:result" in breakdown
+    assert all(secs >= 0.0 for secs in breakdown.values())
+
+
+def test_concurrent_tenants_traces_do_not_cross():
+    """3 tenants run traced queries concurrently on one shared runtime:
+    each session's last trace carries its own tenant tag and its span
+    counts reconcile exactly with that query's own (context-scoped)
+    metrics — a foreign tenant's increments leaking in would break the
+    equality."""
+    server = TpuServer({C.OBS_TRACING.key: True})
+    try:
+        tenants = [f"obs{i}" for i in range(3)]
+        sessions = {t: server.connect(t) for t in tenants}
+        dfs = {t: _mk_df(sessions[t], seed=30 + i, n=2000,
+                         num_partitions=2 + i)
+               for i, t in enumerate(tenants)}
+        errors = []
+
+        def client(t):
+            try:
+                for _ in range(3):
+                    _flagship(dfs[t]).collect()
+            except BaseException as e:  # noqa: BLE001 - relay to main
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        for t in tenants:
+            s = sessions[t]
+            trace = s.last_query_trace
+            assert trace is not None
+            assert trace.tenant == t
+            assert trace.root.attrs.get("tenant") == t
+            totals = trace.counts_total()
+            assert totals.get(M.DEVICE_DISPATCHES, 0) == \
+                s.last_query_metrics[M.DEVICE_DISPATCHES]
+    finally:
+        server.stop()
+
+
+def test_trace_span_cap_bounds_memory(session):
+    session.set_conf(C.OBS_TRACING.key, True)
+    session.set_conf(C.OBS_TRACE_MAX_SPANS.key, 4)
+    q = _flagship(_mk_df(session, num_partitions=4))
+    q.collect()
+    trace = session.last_query_trace
+    n_spans = sum(1 for _ in trace.spans())
+    assert n_spans <= 4
+    assert trace.dropped_spans > 0
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome-trace exporter
+# ---------------------------------------------------------------------------
+def test_perfetto_export_is_valid_chrome_trace_json(session):
+    session.set_conf(C.OBS_TRACING.key, True)
+    _flagship(_mk_df(session)).collect()
+    trace = session.last_query_trace
+    doc = json.loads(trace.to_perfetto_json())
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and len(events) >= 3
+    phases = set()
+    for ev in events:
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in ("X", "M")
+        phases.add(ev["ph"])
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0.0
+            assert ev["dur"] >= 0.0
+    assert "X" in phases and "M" in phases
+    # durations nest: the root query event is the longest
+    roots = [ev for ev in events
+             if ev["ph"] == "X" and ev["name"].startswith("query:")]
+    assert len(roots) == 1
+    assert roots[0]["dur"] >= max(
+        ev["dur"] for ev in events if ev["ph"] == "X")
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+def test_explain_analyze_measured_beside_predicted(session):
+    """The acceptance pin: EXPLAIN ANALYZE on the flagship shows measured
+    wall-time per operator, and the analyzer's predicted dispatch
+    interval contains the measured count."""
+    q = _flagship(_mk_df(session))
+    text = session.explain_analyze(q._plan)
+    assert "== EXPLAIN ANALYZE ==" in text
+    assert "== Query totals ==" in text
+    # every operator line carries measured columns
+    plan_body = text.split("== Query totals ==")[0]
+    op_lines = [ln for ln in plan_body.splitlines()
+                if "[rows=" in ln]
+    assert len(op_lines) >= 5, text
+    times = [float(m.group(1)) for m in
+             re.finditer(r"time=(\d+\.\d+)ms", plan_body)]
+    assert times and any(t > 0.0 for t in times), text
+    # predictions render beside the measurements for analyzed operators
+    assert "| predicted rows=" in plan_body
+    # measured dispatches sit INSIDE the analyzer's interval
+    m = re.search(r"device dispatches: measured (\d+), "
+                  r"predicted \[([0-9.a-zA-Z]+), ([0-9.a-zA-Z]+)\] "
+                  r"\((within|OUTSIDE) interval\)", text)
+    assert m is not None, text
+    assert m.group(4) == "within", text
+    # the run it analyzed left a trace behind for export
+    assert session.last_query_trace is not None
+    # and tracing was only FORCED for the analyze run, not left on
+    assert not session.conf.get(C.OBS_TRACING)
+
+
+def test_tpch_q1_dispatch_parity_and_explain_analyze(session):
+    """The flagship-q1 acceptance pin: tracing adds zero device
+    dispatches and zero host fences on TPC-H q1, and EXPLAIN ANALYZE
+    shows measured per-operator wall-time with the measured dispatch
+    count inside the analyzer's predicted interval."""
+    from spark_rapids_tpu.benchmarks import tpch
+
+    tables = tpch.gen_tables(session, sf=0.0005, num_partitions=2)
+    q1 = tpch.QUERIES["q1"](tables)
+    q1.collect()  # warm compiles
+    q1.collect()
+    off = dict(session.last_query_metrics)
+    session.set_conf(C.OBS_TRACING.key, True)
+    q1.collect()
+    q1.collect()
+    on = dict(session.last_query_metrics)
+    assert on[M.DEVICE_DISPATCHES] == off[M.DEVICE_DISPATCHES]
+    assert on[M.FENCES] == off[M.FENCES]
+    session.set_conf(C.OBS_TRACING.key, False)
+    text = session.explain_analyze(q1._plan)
+    times = [float(m.group(1)) for m in
+             re.finditer(r"time=(\d+\.\d+)ms", text)]
+    assert times and any(t > 0.0 for t in times), text
+    m = re.search(r"device dispatches: measured \d+, predicted "
+                  r"\[[0-9.a-zA-Z]+, [0-9.a-zA-Z]+\] \((within|OUTSIDE)",
+                  text)
+    assert m is not None and m.group(1) == "within", text
+
+
+def test_explain_analyze_dataframe_api(session, capsys):
+    q = _flagship(_mk_df(session))
+    text = q.explain_analyze()
+    assert "== EXPLAIN ANALYZE ==" in text
+    assert "== EXPLAIN ANALYZE ==" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Admission wait DURATION (the admissionWaits-counts-events-not-time fix)
+# ---------------------------------------------------------------------------
+def test_admission_wait_duration_recorded():
+    server = TpuServer({
+        # small enough that two concurrent queries cannot both fit
+        "rapids.tpu.memory.hbm.sizeOverride": 200 << 10,
+    })
+    try:
+        tenants = [f"w{i}" for i in range(3)]
+        sessions = {t: server.connect(t) for t in tenants}
+        dfs = {t: _mk_df(sessions[t], seed=40 + i, n=2000)
+               for i, t in enumerate(tenants)}
+        ns0 = M.admission_wait_ns()
+        errors = []
+
+        def client(t):
+            try:
+                for _ in range(3):
+                    (dfs[t].groupBy("k")
+                     .agg(F.sum("a").alias("s"))).collect()
+            except BaseException as e:  # noqa: BLE001 - relay to main
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        ctl = AdmissionController.get()
+        snap = ctl.snapshot()
+        assert snap["waits"] > 0
+        # duration recorded, not just events: total + quantiles move
+        assert M.admission_wait_ns() > ns0
+        assert snap["wait_samples"] > 0
+        assert snap["wait_total_ms"] > 0.0
+        assert snap["wait_p95_ms"] >= snap["wait_p50_ms"] >= 0.0
+        # the duration also rode the per-query context of some tenant
+        assert any(
+            s.tenant_metric_totals.get(M.ADMISSION_WAIT_NS, 0) > 0
+            for s in sessions.values())
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serving metrics snapshot + Prometheus exposition
+# ---------------------------------------------------------------------------
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" -?[0-9.eE+-]+$")
+
+
+def test_metrics_snapshot_and_prometheus_exposition():
+    server = TpuServer()
+    try:
+        s = server.connect("prom-a")
+        _flagship(_mk_df(s)).collect()
+        _flagship(_mk_df(s)).collect()
+        snap = server.metrics_snapshot()
+        assert snap["tenants"]["prom-a"]["queries"] == 2
+        assert snap["tenants"]["prom-a"].get(M.DEVICE_DISPATCHES, 0) > 0
+        assert "hitRate" in snap["planCache"]
+        assert snap["spill"] is not None
+        assert "device" in snap["spill"]["tiers"]
+        assert snap["admission"] is not None
+        assert "wait_p50_ms" in snap["admission"]
+        text = server.metrics_prometheus()
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert line.startswith("# HELP") or \
+                    line.startswith("# TYPE"), line
+            else:
+                assert _PROM_SAMPLE.match(line), line
+        assert 'srt_tenant_queries_total{tenant="prom-a"} 2' in text
+        assert "srt_plan_cache_hits_total" in text
+        assert "srt_spill_tier_bytes" in text
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Traced timelines surface retry / replan / prefetch detail
+# ---------------------------------------------------------------------------
+def test_trace_records_aqe_stage_spans(session):
+    session.set_conf(C.OBS_TRACING.key, True)
+    session.set_conf(C.ADAPTIVE_ENABLED.key, True)
+    session.set_conf(C.SHUFFLE_SERIALIZE.key, True)
+    q = _flagship(_mk_df(session))
+    q.collect()
+    trace = session.last_query_trace
+    assert trace is not None
+    assert trace.find("stage:aqe:"), trace.render()
+    assert trace.find("aqe.replan:"), trace.render()
+
+
+def test_micro_batch_pack_span_and_nested_trace_isolation():
+    """Tracing + micro-batching: the leader's trace carries the
+    microbatch.pack span, and the packed inner run roots its spans in
+    ITS OWN tree (the current-span contextvar is reset for nested runs)
+    — the inner trace must contain the packed execution's task spans,
+    not an empty root."""
+    server = TpuServer({
+        C.OBS_TRACING.key: True,
+        "rapids.tpu.serving.microBatch.windowMs": 150,
+        "rapids.tpu.serving.microBatch.maxQueries": 2,
+    })
+    try:
+        tenants = ["mb0", "mb1"]
+        sessions = {t: server.connect(t) for t in tenants}
+        dfs = {t: _mk_df(sessions[t], seed=50 + i)
+               for i, t in enumerate(tenants)}
+        barrier = threading.Barrier(len(tenants))
+        errors = []
+
+        def client(t):
+            try:
+                barrier.wait(timeout=10)
+                dfs[t].filter(F.col("a") % 3 != 0).collect()
+            except BaseException as e:  # noqa: BLE001 - relay to main
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        packs = [sp for s in sessions.values()
+                 if s.last_query_trace is not None
+                 for sp in s.last_query_trace.find("microbatch.pack")]
+        if packs:  # scheduling may split the window; pack => pinned shape
+            # the packed run executed under the pack span's query but
+            # recorded into its OWN tracer: the leader's pack span has no
+            # task children of the inner run
+            assert all(c.kind != "task" for sp in packs
+                       for c in sp.children)
+    finally:
+        server.stop()
+
+
+def test_oracle_equality_with_tracing_on(session):
+    """Tracing must never change results."""
+    from tests.harness import assert_rows_equal, run_on_cpu
+
+    df_fn = lambda s: _flagship(_mk_df(s))  # noqa: E731
+    expected = run_on_cpu(session, df_fn)
+    session.set_conf(C.OBS_TRACING.key, True)
+    got = df_fn(session).collect()
+    assert_rows_equal(expected, got, ignore_order=True)

@@ -733,3 +733,67 @@ def test_shared_state_pragma_suppresses():
            "        # tpulint: shared-state-mutation -- under _LOCK\n"
            "        _TABLE[k] = v\n")
     assert lint(src, path=ENGINE) == []
+
+
+# ---------------------------------------------------------------------------
+# naked-timer
+# ---------------------------------------------------------------------------
+def test_naked_timer_flagged_in_engine():
+    src = ("import time\n\n"
+           "def run_query(x):\n"
+           "    t0 = time.monotonic()\n"
+           "    return x, t0\n")
+    assert rules_of(lint(src, path=ENGINE)) == ["naked-timer"]
+
+
+def test_naked_timer_all_clock_variants_flagged():
+    src = ("import time\n"
+           "from time import perf_counter\n\n"
+           "def run_query(x):\n"
+           "    a = time.time()\n"
+           "    b = time.perf_counter_ns()\n"
+           "    c = perf_counter()\n"
+           "    return a, b, c\n")
+    got = lint(src, path=ENGINE)
+    assert [f.rule for f in got] == ["naked-timer"] * 3
+
+
+def test_naked_timer_scope_covers_all_timed_layers():
+    src = ("import time\n\n"
+           "def f():\n"
+           "    return time.monotonic()\n")
+    for scoped in ("spark_rapids_tpu/exec/fake.py",
+                   "spark_rapids_tpu/engine/fake.py",
+                   "spark_rapids_tpu/shuffle/fake.py",
+                   "spark_rapids_tpu/aqe/fake.py"):
+        assert rules_of(lint(src, path=scoped)) == ["naked-timer"], scoped
+
+
+def test_naked_timer_not_flagged_outside_scope():
+    src = ("import time\n\n"
+           "def f():\n"
+           "    return time.monotonic()\n")
+    assert lint(src, path=COLD) == []
+    assert lint(src, path="spark_rapids_tpu/utils/fake.py") == []
+    assert lint(src, path="spark_rapids_tpu/obs/fake.py") == []
+
+
+def test_naked_timer_sleep_and_span_api_allowed():
+    src = ("import time\n"
+           "from spark_rapids_tpu.obs.trace import span, wall_ns\n\n"
+           "def run_query(x):\n"
+           "    time.sleep(0.01)\n"
+           "    t0 = wall_ns()\n"
+           "    with span('stage:x', kind='stage'):\n"
+           "        pass\n"
+           "    return wall_ns() - t0\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_naked_timer_pragma_suppresses():
+    src = ("import time\n\n"
+           "def run_query(x):\n"
+           "    # tpulint: naked-timer -- pre-session probe, no tracer yet\n"
+           "    t0 = time.monotonic()\n"
+           "    return t0\n")
+    assert lint(src, path=ENGINE) == []

@@ -85,6 +85,16 @@ the line above; `-- reason` after the rule names documents the waiver):
               so an accidental decode on the hot path (which silently
               multiplies HBM and shuffle bytes back up) cannot land
               unreviewed. Host/CPU-oracle scopes are exempt.
+  naked-timer  a direct wall-clock read (time.monotonic / time.time /
+              time.perf_counter and their _ns variants, or the bare
+              imported names) in the engine's timed layers (exec/,
+              engine/, shuffle/, aqe/): wall-clock timing there must go
+              through the span API (spark_rapids_tpu.obs.trace.span /
+              trace_range / wall_ns) so every duration shares the
+              tracing substrate's clock and shows up on the traced
+              timeline instead of in an ad-hoc variable. time.sleep is
+              not a timer; a genuinely untraceable site carries a
+              justified pragma.
   pragma      tpulint pragma hygiene: unknown rule name, or a pragma
               that suppresses nothing (stale waiver).
 """
@@ -108,10 +118,24 @@ RULES = (
     "stdout-print",
     "untracked-alloc",
     "naked-dispatch",
+    "naked-timer",
     "shared-state-mutation",
     "eager-materialize",
     "pragma",
 )
+
+# direct wall-clock reads the naked-timer rule reports in the engine's
+# timed layers (the span API — obs/trace.span / wall_ns / trace_range —
+# is the sanctioned clock there); time.sleep is waiting, not timing
+_TIMER_FNS = {
+    "monotonic", "monotonic_ns", "time", "time_ns",
+    "perf_counter", "perf_counter_ns",
+    "process_time", "process_time_ns",
+    "thread_time", "thread_time_ns",
+}
+# bare imported forms that are unambiguous ('time()' alone could be any
+# local callable; 'monotonic()' is not)
+_TIMER_BARE = _TIMER_FNS - {"time"}
 
 # the encoded-column decode entry points (columnar/encoded.py): the ONLY
 # paths from dictionary codes back to values (eager-materialize rule)
@@ -197,12 +221,25 @@ def is_hot_path(path: str) -> bool:
 
 def is_mid_query_scope(path: str) -> bool:
     """Files bound by the issue-ahead sync contract: the executor layers
-    (exec/, engine/, and the adaptive runtime aqe/ — whose stats
-    collection is specified sync-free) may block on a device value only
+    (exec/, engine/, the adaptive runtime aqe/ — whose stats collection
+    is specified sync-free — and the observability layer obs/, whose
+    whole contract is zero added syncs) may block on a device value only
     at the sink."""
     p = _norm(path)
     return ("spark_rapids_tpu/exec/" in p
             or "spark_rapids_tpu/engine/" in p
+            or "spark_rapids_tpu/aqe/" in p
+            or "spark_rapids_tpu/obs/" in p)
+
+
+def is_timer_scope(path: str) -> bool:
+    """Files bound by the naked-timer rule: the engine's timed layers,
+    where wall-clock reads must go through the span API (obs/trace.py)
+    so durations land on the traced timeline."""
+    p = _norm(path)
+    return ("spark_rapids_tpu/exec/" in p
+            or "spark_rapids_tpu/engine/" in p
+            or "spark_rapids_tpu/shuffle/" in p
             or "spark_rapids_tpu/aqe/" in p)
 
 
@@ -457,6 +494,7 @@ class _Visitor(ast.NodeVisitor):
         self.path = path
         self.hot = is_hot_path(path)
         self.midquery = is_mid_query_scope(path)
+        self.timer_scope = is_timer_scope(path)
         self.shared_scope = is_shared_state_scope(path)
         self._module_names = module_names or set()
         self._sanctioned = sanctioned_names or set()
@@ -670,6 +708,19 @@ class _Visitor(ast.NodeVisitor):
                        "on a hot path; keep computing on the codes, or "
                        "justify the boundary decode with a pragma naming "
                        "why this operator needs the values")
+
+        # naked-timer: a direct wall-clock read in the engine's timed
+        # layers — duration measurement there must ride the span API so
+        # it shares the tracing clock and shows on the traced timeline
+        if self.timer_scope and tail in _TIMER_FNS and \
+                (name == f"time.{tail}"
+                 or (name == tail and tail in _TIMER_BARE)):
+            self._flag(node, "naked-timer",
+                       f"{name}() reads the wall clock directly in a "
+                       "timed engine layer; measure through the span "
+                       "API (spark_rapids_tpu.obs.trace.span / wall_ns "
+                       "or utils.metrics.trace_range) so the duration "
+                       "lands on the traced timeline")
 
         # naked-dispatch: a dispatch site outside the retry combinators
         if self.hot and tail == "record_dispatch" and \

@@ -1683,6 +1683,170 @@ def main_serving() -> None:
     _emit(result)
 
 
+_OVERLOAD_ROWS = int(os.environ.get("SRT_OVERLOAD_ROWS", str(1 << 17)))
+_OVERLOAD_SECS = float(os.environ.get("SRT_OVERLOAD_SECS", "4"))
+_OVERLOAD_RAMP = tuple(
+    int(x) for x in os.environ.get("SRT_OVERLOAD_RAMP", "2,4,8").split(","))
+
+
+def _overload_mode(shed_on: bool, clients: int, secs: float) -> dict:
+    """One closed-loop phase at a fixed offered load: `clients` tenant
+    threads each loop an aggregate query against ONE shared runtime
+    whose admission budget fits roughly one query at a time (a tiny HBM
+    override), so offered load past 1-2 clients exceeds capacity and
+    the admission queue is where the modes diverge. Returns admitted-
+    query latency percentiles, goodput, and shed/error counts."""
+    import threading
+
+    import numpy as np
+
+    from spark_rapids_tpu.engine.cancel import TpuOverloadedError
+    from spark_rapids_tpu.engine.server import TpuServer
+    from spark_rapids_tpu.plan import functions as F
+
+    settings = {
+        # budget ~= one query's working set: admission serializes, the
+        # queue (not the device) is the contended resource
+        "rapids.tpu.memory.hbm.sizeOverride": 8 << 20,
+    }
+    if shed_on:
+        # wait bound a few multiples of the ~0.1-0.3s service time: in-
+        # capacity load never sheds, past-capacity queueing is bounded
+        settings["rapids.tpu.serving.admission.maxQueueDepth"] = 3
+        settings["rapids.tpu.serving.admission.maxQueueWaitMs"] = 1000.0
+    server = TpuServer(settings)
+    latencies: list = []
+    lat_lock = threading.Lock()
+    sheds = [0]
+    errors: list = []
+    try:
+        rng = np.random.default_rng(42)
+        tenants = [f"load{i}" for i in range(clients)]
+        sessions = {t: server.connect(t) for t in tenants}
+        dfs = {}
+        for t in tenants:
+            data = {
+                "k": rng.integers(0, N_KEYS,
+                                  _OVERLOAD_ROWS).astype(np.int64),
+                "a": rng.integers(-10_000, 10_000,
+                                  _OVERLOAD_ROWS).astype(np.int64),
+            }
+            dfs[t] = sessions[t].createDataFrame(
+                data, [("k", "long"), ("a", "long")], num_partitions=2)
+
+        def query(df):
+            return (df.filter(F.col("a") % 3 != 0)
+                      .groupBy("k").agg(F.sum("a").alias("s"),
+                                        F.count("*").alias("n")))
+
+        # warmup: compile kernels once, outside the measured window
+        query(dfs[tenants[0]]).collect()
+        deadline = time.perf_counter() + secs
+
+        def client(t):
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    query(dfs[t]).collect()
+                except TpuOverloadedError:
+                    with lat_lock:
+                        sheds[0] += 1
+                    # a real caller backs off after a shed instead of
+                    # hot-looping re-offers (which would burn the host
+                    # on admission churn and starve admitted work)
+                    time.sleep(0.1)
+                    continue
+                except BaseException as e:  # noqa: BLE001 - relayed
+                    errors.append(repr(e))
+                    return
+                dt = time.perf_counter() - t0
+                with lat_lock:
+                    latencies.append(dt)
+
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t_start
+    finally:
+        server.stop()
+    if errors:
+        return {"error": errors[:3]}
+    lat = sorted(latencies)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+    return {
+        "clients": clients,
+        "completed": len(lat),
+        "shed": sheds[0],
+        "p50_s": round(pct(0.50), 5),
+        "p95_s": round(pct(0.95), 5),
+        "goodput_qps": round(len(lat) / wall, 2) if wall > 0 else 0.0,
+    }
+
+
+def main_overload() -> None:
+    """Overload suite (`python bench.py --overload`): closed-loop offered
+    load ramped PAST capacity (client count sweep over a one-query-at-a-
+    time admission budget), shedding ON vs OFF (docs/fault-tolerance.md).
+    The claim under test: with shedding on, admitted-query p95 stays
+    bounded as offered load grows (refused queries fail fast instead of
+    stretching everyone's queue wait) while goodput is no worse than
+    shedding-off. Writes BENCH_r13.json."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    ramp = {"shed_off": [], "shed_on": []}
+    for clients in _OVERLOAD_RAMP:
+        _log(f"overload: {clients} clients, shedding off")
+        ramp["shed_off"].append(
+            _overload_mode(False, clients, _OVERLOAD_SECS))
+        _log(f"overload: {clients} clients, shedding on")
+        ramp["shed_on"].append(
+            _overload_mode(True, clients, _OVERLOAD_SECS))
+    peak_off = ramp["shed_off"][-1]
+    peak_on = ramp["shed_on"][-1]
+    base_on = ramp["shed_on"][0]
+    p95_growth_on = (peak_on.get("p95_s", 0.0)
+                     / max(base_on.get("p95_s", 0.0), 1e-9))
+    p95_growth_off = (ramp["shed_off"][-1].get("p95_s", 0.0)
+                      / max(ramp["shed_off"][0].get("p95_s", 0.0), 1e-9))
+    result = {
+        "metric": "overload_admitted_p95_s",
+        # headline: admitted p95 at peak offered load with shedding on
+        "value": peak_on.get("p95_s", 0.0),
+        "unit": "s",
+        # vs_baseline: how much smaller the shed-on p95 is than shed-off
+        # at the same (past-capacity) offered load
+        "vs_baseline": (round(peak_off["p95_s"] / peak_on["p95_s"], 3)
+                        if peak_on.get("p95_s") and peak_off.get("p95_s")
+                        else 0.0),
+        "platform": platform,
+        "rows": _OVERLOAD_ROWS,
+        "secs_per_phase": _OVERLOAD_SECS,
+        "ramp_clients": list(_OVERLOAD_RAMP),
+        "ramp": ramp,
+        "p95_growth_shed_on": round(p95_growth_on, 3),
+        "p95_growth_shed_off": round(p95_growth_off, 3),
+        "p95_bounded_under_overload": p95_growth_on <= p95_growth_off,
+        "goodput_ratio_on_vs_off": (
+            round(peak_on["goodput_qps"] / peak_off["goodput_qps"], 3)
+            if peak_on.get("goodput_qps") and peak_off.get("goodput_qps")
+            else 0.0),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r13.json")
+    with open(out_path, "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    _emit(result)
+
+
 def main_obs() -> None:
     """Observability suite (`python bench.py --obs`): the flagship query
     traced end to end (docs/observability.md). Records the span-derived
@@ -1816,5 +1980,7 @@ if __name__ == "__main__":
         main_encoded()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--obs":
         main_obs()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--overload":
+        main_overload()
     else:
         main()

@@ -236,12 +236,16 @@ def _revalidate(plan: PhysicalExec, ctx: ExecContext) -> None:
 
 
 def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
+    from spark_rapids_tpu.engine import cancel as CX
     from spark_rapids_tpu.obs.trace import span as obs_span
     from spark_rapids_tpu.utils import faultinject as FI
 
     sid = 0
     degraded = False
     while True:
+        # cancellation chokepoint between stages: a cancelled query stops
+        # re-optimizing AND stops materializing — no further stage runs
+        CX.check_cancel("aqe.loop")
         ready = _ready_exchanges(plan)
         if not ready:
             break
@@ -271,6 +275,11 @@ def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
                         fx()
                     for note in applied:
                         _note(note)
+        except (CX.TpuQueryCancelled, CX.TpuOverloadedError):
+            # a cancel racing the replan step is TERMINAL, not a replan
+            # failure: degrading to the static plan would keep executing
+            # a query the caller already stopped
+            raise
         except Exception as e:  # noqa: BLE001 — degradation boundary
             # the re-optimizer may never take a query down: abandon the
             # rewrite (and all further rewrites) and keep executing the

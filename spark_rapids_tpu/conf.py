@@ -711,6 +711,34 @@ RETRY_BACKOFF_MS = _conf("rapids.tpu.engine.retryBackoffMs").doc(
 ).check(lambda v: None if v >= 0 else "must be >= 0").double(5.0)
 
 # ---------------------------------------------------------------------------
+# Cooperative cancellation + deadline propagation (engine/cancel.py,
+# docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+ENGINE_DEADLINE_MS = _conf("rapids.tpu.engine.deadlineMs").doc(
+    "Per-query wall-clock deadline in milliseconds (0 = none): a "
+    "CancelToken armed with this budget rides the query's QueryContext "
+    "and every engine chokepoint (task loop, retry backoff, admission "
+    "wait, AQE replan loop, shuffle fetch remap, prefetch, sink "
+    "download) polls it — expiry raises a terminal TpuDeadlineExceeded "
+    "with no retry, no CPU fallback, and no partial rows, and the query "
+    "releases everything it holds (semaphore permits, admission bytes, "
+    "spill entries, prefetch threads). Overridable per call via "
+    "df.collect(timeout=seconds) and per tenant via TpuServer."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(0.0)
+
+DEADLINE_COST_PER_DISPATCH_MS = _conf(
+    "rapids.tpu.engine.deadline.costPerDispatchMs").doc(
+    "Admission-time deadline feasibility model (0 = disabled): predicted "
+    "query work is estimated as the resource analyzer's predicted device "
+    "dispatches (upper bound) times this per-dispatch cost; a query "
+    "whose predicted work cannot fit its remaining deadline is REJECTED "
+    "before execution (zero device dispatches, metric: deadlineRejects) "
+    "instead of admitted to die mid-flight. Calibrate from bench "
+    "history (BENCH_*.json record measured per-dispatch costs per "
+    "platform; a tunneled backend measures ~66ms per fence)."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(0.0)
+
+# ---------------------------------------------------------------------------
 # Async issue-ahead execution (engine/async_exec.py, docs/async-execution.md)
 # ---------------------------------------------------------------------------
 ASYNC_DISPATCH = _conf("rapids.tpu.execution.asyncDispatch.enabled").doc(
@@ -889,6 +917,40 @@ ADMISSION_MAX_BYPASS = _conf("rapids.tpu.serving.admission.maxBypass").doc(
     "later arrival may admit until it does — bounds starvation under a "
     "steady stream of light queries."
 ).check(lambda v: None if v >= 0 else "must be >= 0").integer(8)
+
+ADMISSION_MAX_QUEUE_DEPTH = _conf(
+    "rapids.tpu.serving.admission.maxQueueDepth").doc(
+    "Overload shedding, depth bound (0 = unbounded): how many queries "
+    "may WAIT in analyzer-driven admission at once; an arrival past the "
+    "bound is refused immediately with a terminal TpuOverloadedError "
+    "(metric: shedQueries) instead of joining a queue whose wait "
+    "already exceeds any useful deadline (docs/fault-tolerance.md)."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(0)
+
+ADMISSION_MAX_QUEUE_WAIT_MS = _conf(
+    "rapids.tpu.serving.admission.maxQueueWaitMs").doc(
+    "Overload shedding, wait bound in milliseconds (0 = unbounded): a "
+    "query that has waited in admission longer than this is refused "
+    "with a terminal TpuOverloadedError (metric: shedQueries) rather "
+    "than admitted to die — under sustained overload, bounded tail "
+    "latency comes from shedding work, not queueing it."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(0.0)
+
+DRAIN_POLICY = _conf("rapids.tpu.serving.drain.policy").doc(
+    "What TpuServer.drain() does with in-flight queries: 'await' lets "
+    "them finish (up to drain.timeoutMs, then cancels the stragglers), "
+    "'cancel' fires every in-flight query's CancelToken immediately. "
+    "Either way the server stops admitting first (new queries shed with "
+    "TpuOverloadedError) and tears the runtime down only once quiesced."
+).check(lambda v: None if v in ("await", "cancel")
+        else "must be await|cancel").string("await")
+
+DRAIN_TIMEOUT_MS = _conf("rapids.tpu.serving.drain.timeoutMs").doc(
+    "Bound on how long TpuServer.drain() (and session.stop() with "
+    "queries in flight) waits for in-flight queries to quiesce before "
+    "tearing down anyway; under the 'await' policy, stragglers past the "
+    "bound are cancelled."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(10000.0)
 
 MICRO_BATCH_WINDOW_MS = _conf(
     "rapids.tpu.serving.microBatch.windowMs").doc(

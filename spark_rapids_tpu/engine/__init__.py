@@ -1,1 +1,7 @@
+from spark_rapids_tpu.engine.cancel import (  # noqa: F401
+    CancelToken,
+    TpuDeadlineExceeded,
+    TpuOverloadedError,
+    TpuQueryCancelled,
+)
 from spark_rapids_tpu.engine.scheduler import TaskScheduler  # noqa: F401

@@ -18,6 +18,17 @@ younger waiter may admit until it runs (rapids.tpu.serving.admission.*).
 Queries with no resource report (analysis disabled, estimator error)
 bypass the controller entirely — the semaphore and the spill watermark
 remain the runtime backstops, exactly as before this layer existed.
+
+Overload protection (docs/fault-tolerance.md): an overloaded admission
+queue used to grow without bound while callers waited forever. The
+controller now SHEDS instead — `rapids.tpu.serving.admission.
+maxQueueDepth` bounds how many queries may wait at once (an arrival past
+it is refused immediately), `maxQueueWaitMs` bounds how long any one
+query may wait (a waiter past it is refused rather than admitted to
+die), both raising the terminal TpuOverloadedError (engine/cancel.py,
+metric: shedQueries). The wait loop also polls the ambient query's
+CancelToken, so a cancel or deadline expiry interrupts an admission wait
+exactly like any other engine wait.
 """
 
 from __future__ import annotations
@@ -26,6 +37,7 @@ import itertools
 import threading
 from typing import Optional
 
+from spark_rapids_tpu.engine import cancel as CX
 from spark_rapids_tpu.obs.trace import span as obs_span
 from spark_rapids_tpu.obs.trace import wall_ns
 from spark_rapids_tpu.utils import metrics as M
@@ -62,13 +74,19 @@ class AdmissionController:
     _instance: Optional["AdmissionController"] = None
     _lock = threading.Lock()
 
-    def __init__(self, budget_bytes: int, max_bypass: int = 8):
+    def __init__(self, budget_bytes: int, max_bypass: int = 8,
+                 max_queue_depth: int = 0, max_queue_wait_ms: float = 0.0):
         self.budget = max(1, int(budget_bytes))
         self.max_bypass = max(0, int(max_bypass))
+        # overload-shedding bounds (0 = unbounded, the pre-shedding
+        # behavior); mutable via set_overload_policy
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        self.max_queue_wait_ms = max(0.0, float(max_queue_wait_ms))
         self._cv = threading.Condition()
         self._admitted = 0
         self._peak_admitted = 0
         self._waits = 0
+        self._sheds = 0
         self._wait_ns_samples: list = []
         self._wait_ns_total = 0
         self._waiters: list = []
@@ -92,6 +110,14 @@ class AdmissionController:
         with cls._lock:
             cls._instance = None
 
+    def set_overload_policy(self, max_queue_depth: int,
+                            max_queue_wait_ms: float) -> None:
+        """Install the shedding bounds (session bring-up posts its conf
+        here; last writer wins — one device, one overload policy)."""
+        with self._cv:
+            self.max_queue_depth = max(0, int(max_queue_depth))
+            self.max_queue_wait_ms = max(0.0, float(max_queue_wait_ms))
+
     # -- admission -----------------------------------------------------------
     def _clamp_cost(self, predicted_bytes) -> int:
         """A query predicted beyond the budget (or unbounded) costs the
@@ -112,11 +138,25 @@ class AdmissionController:
         snapshot's wait_p50_ms/wait_p95_ms, and the wait shows up as an
         `admission.wait` site span on the traced timeline."""
         cost = self._clamp_cost(predicted_bytes)
+        tok = CX.current_token()
         with self._cv:
+            if tok is not None:
+                # a query already cancelled / past its deadline must not
+                # join the queue at all
+                tok.check("admission")
             if self._fits(cost, me=None):
                 self._note_bypass(me=None)
                 self._do_admit(cost)
                 return AdmissionTicket(cost, tenant)
+            # overload shedding, depth bound: refusing the (maxQueueDepth
+            # + 1)th waiter NOW beats admitting it to a queue whose wait
+            # already exceeds any useful deadline (docs/fault-tolerance.md)
+            if self.max_queue_depth and \
+                    len(self._waiters) >= self.max_queue_depth:
+                self._sheds += 1
+                self._shed(tenant, f"admission queue full "
+                           f"({len(self._waiters)} waiting, bound "
+                           f"{self.max_queue_depth})")
             # failed fast path -> waiter registration under the SAME lock
             # hold: a younger arrival admitted in between would otherwise
             # dodge this waiter's bypass accounting (the maxBypass
@@ -135,8 +175,21 @@ class AdmissionController:
                         # timed wait: robust against a missed notify under
                         # exceptional interleavings (releases always
                         # notify, but a 100ms re-check costs nothing on
-                        # this path)
-                        self._cv.wait(timeout=0.1)
+                        # this path) — and the poll cadence for the
+                        # cancellation/deadline/shed checks below
+                        self._cv.wait(timeout=0.05)
+                        if tok is not None:
+                            # cancel or deadline expiry interrupts the
+                            # admission wait like any other engine wait
+                            tok.check("admission.wait")
+                        if self.max_queue_wait_ms and \
+                                (wall_ns() - t0) / 1e6 > \
+                                self.max_queue_wait_ms:
+                            self._sheds += 1
+                            self._shed(
+                                tenant,
+                                f"admission wait exceeded "
+                                f"{self.max_queue_wait_ms:.0f}ms")
                 self._note_bypass(me)
                 self._do_admit(cost)
             finally:
@@ -154,6 +207,16 @@ class AdmissionController:
                 M.record_admission_wait_ns(waited)
                 self._cv.notify_all()
         return AdmissionTicket(cost, tenant)
+
+    @staticmethod
+    def _shed(tenant: str, why: str) -> None:
+        """Refuse a query under overload: count the shed (per-tenant via
+        the ambient QueryContext) and raise the terminal error, already
+        marked counted so the session handler does not double-count."""
+        M.record_shed_query()
+        err = CX.TpuOverloadedError(f"query shed ({tenant}): {why}")
+        err.counted = True
+        raise err
 
     def _fits(self, cost: int, me: Optional[_Waiter]) -> bool:
         if self._admitted + cost > self.budget:
@@ -206,6 +269,7 @@ class AdmissionController:
                 "peak_admitted": self._peak_admitted,
                 "waiting": len(self._waiters),
                 "waits": self._waits,
+                "sheds": self._sheds,
                 "wait_total_ms": self._wait_ns_total / 1e6,
                 "wait_p50_ms": _pct_ms(samples, 0.50),
                 "wait_p95_ms": _pct_ms(samples, 0.95),

@@ -1,0 +1,271 @@
+"""Cooperative cancellation + deadline propagation (docs/fault-tolerance.md).
+
+A query, once admitted, used to be unstoppable: no cancel, no deadline,
+and every wait in the engine (retry backoff, admission queue, prefetch
+queue, task futures) was uninterruptible. This module is the substrate
+that fixes that: a `CancelToken` rides each query's QueryContext
+(utils/metrics.py — contextvars propagation carries it onto scheduler
+worker threads and the prefetch reader exactly like the context itself),
+and every chokepoint in the engine polls it through `check_cancel` or
+waits through the cancel-aware helpers instead of sleeping blind.
+
+Polling points (each a one-None-check no-op for context-free callers):
+
+- scheduler task loop (`engine/scheduler._run_task`, before every
+  attempt) and the `run_job`/`run_job_iter` future waits;
+- retry/backoff sleeps (`engine/retry.backoff_sleep` waits on the
+  token's event, so a cancel interrupts the sleep instead of waiting it
+  out);
+- admission queue waits (`engine/admission.admit` — which also enforces
+  the deadline and the overload-shedding bounds there);
+- the AQE re-optimizer loop between stages (`aqe/loop.run_adaptive`);
+- shuffle fetch/remap retries (`shuffle/exchange.decode_with_remap`);
+- the prefetch reader + consumer (`io/prefetch.PrefetchIterator`);
+- the sink download loop (`session._execute_lifted_sink`).
+
+Cancellation semantics (the robustness contract):
+
+- `TpuQueryCancelled` is TERMINAL: never retried (engine/retry
+  classifies it non-retryable), never CPU-fallback'd (it is not
+  device-rooted), never checked-replayed, and the query returns no
+  partial rows — the raise IS the result.
+- Cancellation RECLAIMS everything the query holds: semaphore permits
+  (task completion listeners), the admission ticket (the execute
+  finally), query-scoped spill-store entries and prefetch reader
+  threads (`session._reclaim_cancelled`). `reclamation_report()` is the
+  pinned post-cancel invariant surface the chaos matrix asserts.
+- A deadline is just a self-arming cancel: `CancelToken(deadline_s=...)`
+  cancels itself (reason "deadline") the first time any poll observes
+  the budget exhausted — so deadline expiry propagates through exactly
+  the cancellation machinery, with `TpuDeadlineExceeded` typing it.
+- `TpuOverloadedError` is the shed signal (bounded admission queue
+  depth / max queue wait / draining server): raised BEFORE any device
+  work, equally terminal.
+
+The `cancel.race` fault-injection site lives inside `check_cancel`
+itself: arming it (kind "cancel") fires a cancellation at a randomly
+chosen poll point, modeling a cancel racing the engine's own progress
+(utils/faultinject.py; excluded from the '*' site expansion because a
+cancelled query by design returns no rows to compare).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.obs.trace import wall_ns
+
+
+class TpuQueryCancelled(RuntimeError):
+    """The query was cancelled (caller cancel, deadline, drain). Terminal
+    by contract: no retry, no CPU fallback, no checked replay, no partial
+    rows. `reason` names who fired it; `site` the poll point that
+    observed it."""
+
+    def __init__(self, message: str, reason: str = "cancelled",
+                 site: str = ""):
+        super().__init__(message)
+        self.reason = reason
+        self.site = site
+        # set by the metric-recording raise/handler that already counted
+        # this failure, so the session handler never double-counts
+        self.counted = False
+
+
+class TpuDeadlineExceeded(TpuQueryCancelled):
+    """The query's deadline expired (mid-flight) or its predicted work
+    could not fit the remaining budget (admission-time reject — zero
+    device dispatches by construction)."""
+
+    def __init__(self, message: str, site: str = ""):
+        super().__init__(message, reason="deadline", site=site)
+
+
+class TpuOverloadedError(RuntimeError):
+    """The serving layer shed this query instead of admitting it to die:
+    the admission queue is at its depth bound, the queue wait exceeded
+    its bound, or the server is draining. Terminal and pre-execution —
+    a shed query never dispatches."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.counted = False
+
+
+class CancelToken:
+    """One query's cancellation flag + optional deadline.
+
+    Thread-safe and monotonic: the first cancel wins, later calls are
+    no-ops. The deadline is relative (seconds from construction) against
+    the engine's sanctioned wall clock (obs/trace.wall_ns), so a token
+    built at query start measures exactly the query's wall budget."""
+
+    __slots__ = ("_event", "_lock", "reason", "_deadline_ns")
+
+    def __init__(self, deadline_s: Optional[float] = None):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.reason: Optional[str] = None
+        self._deadline_ns = (wall_ns() + int(deadline_s * 1e9)
+                             if deadline_s is not None and deadline_s > 0
+                             else None)
+
+    # -- firing ---------------------------------------------------------------
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Fire the token; returns True if THIS call was the first."""
+        with self._lock:
+            if self.reason is not None:
+                return False
+            self.reason = reason
+        self._event.set()
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    # -- deadline -------------------------------------------------------------
+    @property
+    def deadline_ns(self) -> Optional[int]:
+        return self._deadline_ns
+
+    def deadline_remaining_s(self) -> Optional[float]:
+        """Seconds left in the budget (None = no deadline; <= 0 =
+        expired). Pure host clock read, no device touch."""
+        if self._deadline_ns is None:
+            return None
+        return (self._deadline_ns - wall_ns()) / 1e9
+
+    def _deadline_expired(self) -> bool:
+        return (self._deadline_ns is not None
+                and wall_ns() >= self._deadline_ns)
+
+    # -- polling --------------------------------------------------------------
+    def check(self, site: str = "") -> None:
+        """Raise if cancelled (or the deadline just expired — which
+        self-arms the cancel so every later poll agrees). The engine's
+        chokepoints call this; a live token costs one Event check."""
+        if not self._event.is_set():
+            if not self._deadline_expired():
+                return
+            self.cancel("deadline")
+        if self.reason == "deadline":
+            raise TpuDeadlineExceeded(
+                f"query deadline exceeded (observed at {site or 'poll'})",
+                site=site)
+        raise TpuQueryCancelled(
+            f"query cancelled ({self.reason}) at {site or 'poll'}",
+            reason=self.reason or "cancelled", site=site)
+
+    def wait(self, timeout_s: float) -> bool:
+        """Block up to `timeout_s` OR until cancelled (clamped to the
+        remaining deadline — sleeping past it would just delay the
+        raise); returns True when the token fired. The cancel-aware
+        replacement for a bare sleep."""
+        remaining = self.deadline_remaining_s()
+        if remaining is not None:
+            timeout_s = min(timeout_s, max(0.0, remaining))
+        fired = self._event.wait(timeout_s)
+        return fired or self._deadline_expired()
+
+
+# ---------------------------------------------------------------------------
+# Ambient-token helpers (the engine's chokepoint API)
+# ---------------------------------------------------------------------------
+def current_token() -> Optional[CancelToken]:
+    """The running query's token, or None outside any query context."""
+    from spark_rapids_tpu.utils import metrics as M
+
+    ctx = M.current_query_ctx()
+    return ctx.cancel if ctx is not None else None
+
+
+def check_cancel(site: str = "") -> None:
+    """THE cancellation poll: raises TpuQueryCancelled /
+    TpuDeadlineExceeded when the ambient query is cancelled or past its
+    deadline; a single None-check otherwise. Also the home of the
+    `cancel.race` fault-injection site — arming it fires a cancellation
+    at one of these polls, modeling a cancel racing engine progress."""
+    tok = current_token()
+    if tok is None:
+        return
+    from spark_rapids_tpu.utils import faultinject as FI
+
+    FI.maybe_inject("cancel.race")
+    tok.check(site)
+
+
+# never-set event backing the no-token sleep fallback: a timed Event.wait
+# is an honest bounded wait (the uncancellable-wait lint rule's point),
+# unlike a bare time.sleep nothing can interrupt
+_FALLBACK_SLEEP = threading.Event()
+
+
+def cancel_aware_sleep(seconds: float, site: str = "backoff") -> None:
+    """Sleep that a cancel (or deadline expiry) interrupts: waits on the
+    ambient token's event and re-raises through check(). Context-free
+    callers get a plain bounded wait. This is the sanctioned wait helper
+    the tpulint `uncancellable-wait` rule points engine code at."""
+    if seconds <= 0:
+        check_cancel(site)
+        return
+    tok = current_token()
+    if tok is None:
+        _FALLBACK_SLEEP.wait(seconds)
+        return
+    if tok.wait(seconds):
+        tok.check(site)
+
+
+def is_cancellation(e: BaseException) -> bool:
+    """Whether a failure (or anything on its cause chain) is terminal
+    cancellation/shed — the one failure class every degradation ladder
+    (dispatch retry, task retry, checked replay, CPU fallback, AQE
+    static-plan degrade) must re-raise instead of absorbing."""
+    seen = set()
+    node: Optional[BaseException] = e
+    while node is not None and id(node) not in seen:
+        if isinstance(node, (TpuQueryCancelled, TpuOverloadedError)):
+            return True
+        seen.add(id(node))
+        node = node.__cause__ or node.__context__
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Post-cancel reclamation invariant (the chaos matrix pins this)
+# ---------------------------------------------------------------------------
+def reclamation_report() -> dict:
+    """Snapshot of everything a cancelled query could have leaked. With
+    no OTHER query running, a clean cancellation leaves: every semaphore
+    permit returned, zero admitted bytes, zero live prefetch reader
+    threads, and no admission waiters. Pure host-side reads."""
+    from spark_rapids_tpu.engine.admission import AdmissionController
+    from spark_rapids_tpu.io.prefetch import live_reader_count
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    sem = TpuSemaphore.get()
+    ctl = AdmissionController.get()
+    with sem._cv:
+        sem_avail, sem_max = sem._available, sem.max_concurrent
+    return {
+        "semaphore_available": sem_avail,
+        "semaphore_max": sem_max,
+        "admitted_bytes": ctl.admitted_bytes() if ctl is not None else 0,
+        "admission_waiting": (ctl.snapshot()["waiting"]
+                              if ctl is not None else 0),
+        "live_prefetch_threads": live_reader_count(),
+    }
+
+
+def assert_reclaimed(report: Optional[dict] = None) -> dict:
+    """Assert the post-cancel invariant (tests; also safe to call after
+    any successful query when nothing else is in flight). Returns the
+    report it checked so failures print the full state."""
+    rep = report if report is not None else reclamation_report()
+    assert rep["semaphore_available"] == rep["semaphore_max"], rep
+    assert rep["admitted_bytes"] == 0, rep
+    assert rep["admission_waiting"] == 0, rep
+    assert rep["live_prefetch_threads"] == 0, rep
+    return rep

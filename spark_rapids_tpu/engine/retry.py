@@ -36,7 +36,6 @@ analyzer's predictions (tests/test_plan_resources.py).
 from __future__ import annotations
 
 import threading
-import time
 import zlib
 from typing import Callable, List, Optional, TypeVar
 
@@ -95,7 +94,15 @@ def as_typed_error(e: BaseException) -> Optional[TpuRetryableError]:
     """The typed view of an arbitrary execution error: already-typed errors
     pass through; backend runtime errors translate via the device manager;
     deterministic errors and everything else return None (not retryable
-    at the dispatch layer)."""
+    at the dispatch layer). Cancellation/shed errors (engine/cancel.py)
+    are terminal by contract — never typed retryable."""
+    from spark_rapids_tpu.engine.cancel import (
+        TpuOverloadedError,
+        TpuQueryCancelled,
+    )
+
+    if isinstance(e, (TpuQueryCancelled, TpuOverloadedError)):
+        return None
     if isinstance(e, TpuRetryableError):
         return e
     if isinstance(e, NON_RETRYABLE):
@@ -111,8 +118,13 @@ def is_retryable_failure(e: BaseException) -> bool:
     plan/analysis errors fail fast; unknown runtime errors are treated as
     transient — on a real cluster the cost of one wasted retry is far
     below the cost of failing a query on an unclassified hiccup."""
+    from spark_rapids_tpu.engine.cancel import is_cancellation
     from spark_rapids_tpu.engine.scheduler import FetchFailedError
 
+    if is_cancellation(e):
+        # a cancelled/shed query is DONE: retrying it would resurrect
+        # work the caller (or the deadline, or the drain) just killed
+        return False
     if isinstance(e, TpuAsyncSinkError):
         # the failing state is gone (async sink surface / consumed donated
         # inputs): a task-level re-run would mask the error non-
@@ -145,7 +157,14 @@ def failure_needs_checked_replay(e: BaseException) -> bool:
     machinery could NOT own in place (sink-surfaced async errors, donated
     dispatches). Everything else was already attributed at its dispatch
     site and retried/split there; replaying the whole query in checked
-    mode would just repeat the identical failure at 2x cost."""
+    mode would just repeat the identical failure at 2x cost. A
+    cancellation anywhere on the chain rules the replay out entirely —
+    replaying a cancelled query would run it twice against the caller's
+    explicit stop."""
+    from spark_rapids_tpu.engine.cancel import is_cancellation
+
+    if is_cancellation(e):
+        return False
     return any(isinstance(n, TpuAsyncSinkError) for n in _cause_chain(e))
 
 
@@ -154,9 +173,14 @@ def failure_is_device_rooted(e: BaseException) -> bool:
     error or an exhausted shuffle fetch — the gate for query-level CPU
     fallback. Fetch failures are not device-health signals in Spark terms,
     but once the in-place map re-execution AND the task retry both gave up
-    the only alternative to the fallback is failing the job."""
+    the only alternative to the fallback is failing the job. A
+    cancellation is never device-rooted: the CPU fallback must not
+    resurrect a query the caller (or deadline, or drain) stopped."""
+    from spark_rapids_tpu.engine.cancel import is_cancellation
     from spark_rapids_tpu.engine.scheduler import FetchFailedError
 
+    if is_cancellation(e):
+        return False
     return any(isinstance(n, FetchFailedError)
                or as_typed_error(n) is not None
                for n in _cause_chain(e))
@@ -219,12 +243,19 @@ def deterministic_jitter(*identity) -> float:
 
 
 def backoff_sleep(attempt: int, *identity) -> None:
+    """Exponential backoff with deterministic jitter, CANCEL-AWARE: the
+    sleep waits on the ambient query's CancelToken event, so a cancel or
+    deadline expiry interrupts the wait and raises instead of burning
+    the rest of the schedule (engine/cancel.cancel_aware_sleep; the
+    tpulint uncancellable-wait rule pins this)."""
+    from spark_rapids_tpu.engine.cancel import cancel_aware_sleep
+
     base = policy().backoff_ms
     if base <= 0:
         return
     delay_ms = base * (2 ** attempt) * (0.5 + deterministic_jitter(
         attempt, *identity))
-    time.sleep(delay_ms / 1000.0)
+    cancel_aware_sleep(delay_ms / 1000.0, site="retry.backoff")
 
 
 def _spill_for_retry(site: str) -> int:

@@ -31,6 +31,7 @@ import contextvars
 import threading
 from typing import Callable, Iterator, List, Optional, TypeVar
 
+from spark_rapids_tpu.engine import cancel as CX
 from spark_rapids_tpu.engine import retry as R
 from spark_rapids_tpu.exec.transitions import current_task_id, set_task_id
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore
@@ -41,6 +42,14 @@ T = TypeVar("T")
 
 _next_task_id = iter(range(1_000_000, 1 << 62))
 _next_task_id_lock = threading.Lock()
+
+# future-wait poll cadence: tight when a CancelToken is watching (prompt
+# cancellation), relaxed otherwise (standalone schedulers in unit tests —
+# still bounded, never an untimed wait); and the bounded drain a cancelled
+# job gives its in-flight tasks to observe the token and exit
+_RESULT_POLL_S = 0.05
+_IDLE_POLL_S = 60.0
+_CANCEL_DRAIN_S = 5.0
 
 
 class TaskFailedError(RuntimeError):
@@ -146,9 +155,15 @@ class TaskScheduler:
     def _run_task(self, pidx: int, fn: Callable[[int], T]) -> T:
         last: Optional[BaseException] = None
         for attempt in range(self.max_failures):
+            # cancellation chokepoint: every attempt (including the
+            # first) polls the ambient query's token before doing work,
+            # so a cancelled query's queued tasks exit without touching
+            # the device (engine/cancel.py)
+            CX.check_cancel("task")
             if attempt > 0:
                 # exponential backoff, jitter a pure function of the retry
-                # identity (docs/fault-tolerance.md)
+                # identity (docs/fault-tolerance.md); the sleep itself is
+                # cancel-aware — a cancel interrupts it mid-wait
                 R.backoff_sleep(attempt - 1, "task", pidx)
             with _next_task_id_lock:
                 task_id = next(_next_task_id)
@@ -167,6 +182,11 @@ class TaskScheduler:
                 # completion-listener analog: always drop the semaphore
                 TpuSemaphore.get().release_if_necessary(task_id)
                 set_task_id(None)
+            if CX.is_cancellation(last):
+                # terminal by contract: propagate RAW (no TaskFailedError
+                # wrap, no retry) so the session's cancellation handler
+                # sees the typed error directly
+                raise last
             if not _is_retryable(last):
                 raise TaskFailedError(pidx, attempt + 1, last) from last
             if attempt + 1 < self.max_failures and \
@@ -174,39 +194,72 @@ class TaskScheduler:
                 raise TaskFailedError(pidx, attempt + 1, last) from last
         raise TaskFailedError(pidx, self.max_failures, last) from last
 
-    def _result_with_timeout(self, fut: "cf.Future", pidx: int,
-                             futures: List["cf.Future"]) -> T:
-        if not self.task_timeout_s:
-            return fut.result()
-        try:
-            return fut.result(timeout=self.task_timeout_s)
-        except cf.TimeoutError:
-            for f in futures:
-                f.cancel()
-            # the wedged worker thread cannot be interrupted: it keeps its
-            # pool slot AND any semaphore permits until its device call
-            # eventually returns (only then does _run_task's finally
-            # release them). TaskTimeoutError is part of the typed device
-            # hierarchy precisely so the query-level CPU fallback engages
-            # — the CPU plan never touches the admission semaphore, so a
-            # wedged device cannot wedge the session with it.
-            raise TaskFailedError(
-                pidx, 1, TaskTimeoutError(
-                    f"partition task {pidx} exceeded "
-                    f"{self.task_timeout_s:.1f}s")) from None
+    def _await_result(self, fut: "cf.Future", pidx: int,
+                      futures: List["cf.Future"]) -> T:
+        """Cancel-aware future wait: polls the ambient query's
+        CancelToken between bounded result waits (a bare fut.result()
+        would outwait a cancellation forever — the uncancellable-wait
+        lint rule's point), and enforces the per-task wall-clock timeout
+        exactly as before."""
+        from spark_rapids_tpu.obs.trace import wall_ns
+
+        tok = CX.current_token()
+        poll = _RESULT_POLL_S if tok is not None else _IDLE_POLL_S
+        timeout_at = None
+        if self.task_timeout_s:
+            timeout_at = wall_ns() + int(self.task_timeout_s * 1e9)
+            poll = min(poll, self.task_timeout_s)
+        while True:
+            try:
+                return fut.result(timeout=poll)
+            except cf.TimeoutError:
+                if tok is not None:
+                    # raises on cancel/deadline; run_job's handler drains
+                    # the job's remaining futures before propagating
+                    tok.check("job.await")
+                if timeout_at is not None and wall_ns() >= timeout_at:
+                    for f in futures:
+                        f.cancel()
+                    # the wedged worker thread cannot be interrupted: it
+                    # keeps its pool slot AND any semaphore permits until
+                    # its device call eventually returns (only then does
+                    # _run_task's finally release them). TaskTimeoutError
+                    # is part of the typed device hierarchy precisely so
+                    # the query-level CPU fallback engages — the CPU plan
+                    # never touches the admission semaphore, so a wedged
+                    # device cannot wedge the session with it.
+                    raise TaskFailedError(
+                        pidx, 1, TaskTimeoutError(
+                            f"partition task {pidx} exceeded "
+                            f"{self.task_timeout_s:.1f}s")) from None
+
+    def _drain_cancelled(self, futures: List["cf.Future"]) -> None:
+        """A cancelled job must not leave tasks of the dead query live on
+        the pool: unstarted futures cancel outright; in-flight tasks
+        observe the token at their next poll (attempt start, backoff
+        wait) and exit — wait for them (bounded) so the reclamation
+        invariant already holds when the raise reaches the session."""
+        for f in futures:
+            f.cancel()
+        cf.wait(futures, timeout=_CANCEL_DRAIN_S)
 
     def run_job(self, num_partitions: int,
                 fn: Callable[[int], T]) -> List[T]:
         """Run fn over every partition index; returns results in order."""
         if num_partitions == 0:
             return []
+        CX.check_cancel("job.submit")
         if num_partitions == 1:
             return [self._run_task(0, fn)]
         pool = self._ensure_pool()
         futures = [self._submit(pool, p, fn)
                    for p in range(num_partitions)]
-        return [self._result_with_timeout(f, p, futures)
-                for p, f in enumerate(futures)]
+        try:
+            return [self._await_result(f, p, futures)
+                    for p, f in enumerate(futures)]
+        except (CX.TpuQueryCancelled, CX.TpuOverloadedError):
+            self._drain_cancelled(futures)
+            raise
 
     def _submit(self, pool: "cf.ThreadPoolExecutor", p: int,
                 fn: Callable[[int], T]) -> "cf.Future":
@@ -225,14 +278,39 @@ class TaskScheduler:
         latency case the issue-ahead sink exists for)."""
         if num_partitions == 0:
             return
+        CX.check_cancel("job.submit")
         if num_partitions == 1:
             yield self._run_task(0, fn)
             return
         pool = self._ensure_pool()
         futures = [self._submit(pool, p, fn)
                    for p in range(num_partitions)]
-        for f in cf.as_completed(futures):
-            yield f.result()
+        tok = CX.current_token()
+        poll = _RESULT_POLL_S if tok is not None else _IDLE_POLL_S
+        pending = set(futures)
+        try:
+            while pending:
+                done, pending = cf.wait(pending, timeout=poll,
+                                        return_when=cf.FIRST_COMPLETED)
+                if not done and tok is not None:
+                    tok.check("job.await")
+                for f in done:
+                    # already completed (cf.wait returned it): timeout=0
+                    # can never block
+                    yield f.result(timeout=0)
+        finally:
+            # a finally, not an except: a cancellation observed by the
+            # CONSUMER (the sink loop's own check_cancel) aborts this
+            # generator with GeneratorExit at the yield, which an except
+            # clause would miss. Abandonment (cancel OR early-exit)
+            # cancels the unstarted remainder; only a real cancellation
+            # additionally WAITS for in-flight tasks — an early-exiting
+            # LIMIT consumer must not block behind them.
+            if pending:
+                for f in futures:
+                    f.cancel()
+                if tok is not None and tok.cancelled:
+                    cf.wait(futures, timeout=_CANCEL_DRAIN_S)
 
 
 def run_job_or_serial(scheduler: Optional[TaskScheduler],
@@ -256,6 +334,8 @@ def run_serial(num_partitions: int, fn: Callable[[int], T]) -> List[T]:
     only pooled tasks)."""
     out: List[T] = []
     for p in range(num_partitions):
+        # same cancellation chokepoint the pooled path polls per attempt
+        CX.check_cancel("job.serial")
         try:
             out.append(fn(p))
         finally:

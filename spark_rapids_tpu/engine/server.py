@@ -148,6 +148,8 @@ class MicroBatcher:
             w.members.append((parts, pend))
             if len(w.members) >= max_q:
                 w.full.set()
+        from spark_rapids_tpu.engine import cancel as CX
+
         if leader:
             try:
                 w.full.wait(timeout=max(0.0, window_s))
@@ -167,7 +169,11 @@ class MicroBatcher:
                 # in pend.event.wait()
                 self._fan_error(w, e)
                 raise
-        pend.event.wait()
+        # cancel-aware join wait: a joiner whose OWN query is cancelled
+        # (or deadline-expired) stops waiting on the window leader — the
+        # leader and the other members are untouched
+        while not pend.event.wait(timeout=0.1):
+            CX.check_cancel("microbatch.join")
         if pend.error is not None:
             raise pend.error
         return pend.result
@@ -275,6 +281,46 @@ class TpuServer:
     def sessions(self) -> Dict[str, object]:
         with self._lock:
             return dict(self._sessions)
+
+    def set_tenant_deadline(self, tenant: str,
+                            deadline_ms: float) -> None:
+        """Arm a per-tenant default deadline: every later query on the
+        tenant's session gets a CancelToken with this budget (a per-call
+        df.collect(timeout=) still overrides it)."""
+        s = self.connect(tenant)
+        s.conf.set(C.ENGINE_DEADLINE_MS.key, float(deadline_ms))
+
+    def drain(self, policy: Optional[str] = None,
+              timeout_s: Optional[float] = None) -> dict:
+        """Graceful serving teardown (docs/fault-tolerance.md): stop
+        admitting (new queries on every tenant session shed with
+        TpuOverloadedError), then per `rapids.tpu.serving.drain.policy`
+        either CANCEL every in-flight query now or AWAIT them (up to the
+        drain timeout, then cancel stragglers), and finally tear the
+        shared runtime down. Returns a summary the caller can log."""
+        server_conf = C.TpuConf(self._settings)
+        policy = policy or server_conf.get(C.DRAIN_POLICY)
+        if timeout_s is None:
+            timeout_s = server_conf.get(C.DRAIN_TIMEOUT_MS) / 1000.0
+        sessions = self.sessions()
+        for s in sessions.values():
+            s.begin_drain()
+        cancelled = 0
+        if policy == "cancel":
+            for s in sessions.values():
+                cancelled += s.cancel_all("server drain")
+        quiesced = all(s._await_quiesce(timeout_s)
+                       for s in sessions.values())
+        if not quiesced:
+            # await policy exhausted its bound (or a cancel straggler
+            # wedged): cancellation is the last resort either way
+            for s in sessions.values():
+                cancelled += s.cancel_all("server drain timeout")
+            quiesced = all(s._await_quiesce(timeout_s)
+                           for s in sessions.values())
+        self.stop()
+        return {"policy": policy, "cancelled": cancelled,
+                "quiesced": quiesced}
 
     def stop(self) -> None:
         """Stop every tenant session; the last one tears the shared

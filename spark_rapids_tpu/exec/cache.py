@@ -179,7 +179,10 @@ class TpuCachedScanExec(_CachedScanBase, TpuExec):
                 for b in child_pb.iterator(pidx):
                     n = b.host_rows() if hasattr(b, "host_rows") else b.num_rows
                     if n > 0:
-                        out.append(fw.add_device_batch(b))
+                        # cache entries OUTLIVE the registering query:
+                        # a later cancellation must not free them
+                        out.append(fw.add_device_batch(
+                            b, scope_to_query=False))
                 return out
 
             from spark_rapids_tpu.engine.scheduler import run_job_or_serial

@@ -19,8 +19,13 @@ Contract:
   position where the item would have appeared (fault-injection and IO
   errors keep their per-batch attribution);
 - `close()` (also called by __del__ and at exhaustion) stops the worker
-  promptly — a consumer that abandons the iterator (LIMIT early-exit,
-  task retry) does not leak a thread decoding an unbounded stream.
+  promptly AND joins the reader thread with a bounded timeout — a
+  consumer that abandons the iterator (LIMIT early-exit, task retry,
+  cancellation) leaves zero live threads behind (pinned by test);
+- cancellation-aware (engine/cancel.py): the consumer's queue waits and
+  the worker's puts both watch the constructing query's CancelToken, so
+  a cancelled query's reader dies at the next poll instead of decoding
+  an unbounded stream for nobody.
 """
 
 from __future__ import annotations
@@ -28,27 +33,48 @@ from __future__ import annotations
 import contextvars
 import queue
 import threading
-from typing import Iterator, TypeVar
+from typing import Iterator, Optional, TypeVar
 
 T = TypeVar("T")
 
 _END = object()
 
+# thread-name prefix every reader carries: the live-thread census
+# (live_reader_count, the post-cancel reclamation invariant) keys on it
+_THREAD_PREFIX = "srt-prefetch:"
 
-def _prefetch_worker(source, q: "queue.Queue",
-                     closed: threading.Event) -> None:
+# bounded waits: the consumer's queue-poll cadence (each wakeup re-checks
+# closed + cancel) and the close()-time thread join bound
+_POLL_S = 0.1
+_JOIN_S = 5.0
+
+
+def live_reader_count() -> int:
+    """Live prefetch reader threads in the process (the reclamation
+    invariant surface: after a cancellation — or any abandoned scan —
+    this must return to zero, engine/cancel.reclamation_report)."""
+    return sum(1 for t in threading.enumerate()
+               if t.name.startswith(_THREAD_PREFIX) and t.is_alive())
+
+
+def _prefetch_worker(source, q: "queue.Queue", closed: threading.Event,
+                     token) -> None:
     """Worker body — a free function on purpose: a bound-method target
     would give the thread a strong reference to the iterator, so an
     abandoned PrefetchIterator could never be garbage-collected and its
     worker (plus the staged batches) would leak for the session's
     lifetime. Every put (items AND the END/error sentinel) retries with a
     timeout so a consumer that stopped draining can never wedge the
-    worker — close() (or GC -> __del__ -> close()) sets `closed` and the
-    worker exits at the next poll."""
+    worker — close() (or GC -> __del__ -> close()) sets `closed`, a
+    query cancel fires `token`, and the worker exits at the next poll."""
+    def dead() -> bool:
+        return closed.is_set() or \
+            (token is not None and token.cancelled)
+
     def put(payload) -> bool:
-        while not closed.is_set():
+        while not dead():
             try:
-                q.put(payload, timeout=0.1)
+                q.put(payload, timeout=_POLL_S)
                 return True
             except queue.Full:
                 continue
@@ -57,6 +83,8 @@ def _prefetch_worker(source, q: "queue.Queue",
     try:
         for item in source:
             if not put(("item", item)):
+                return
+            if dead():
                 return
         put((None, _END))
     except BaseException as e:  # noqa: BLE001 - relayed to consumer
@@ -100,14 +128,29 @@ class PrefetchIterator:
         self._occ_high = 0
         self._items = 0
         self._reported = False
+        # the constructing query's CancelToken (engine/cancel.py): both
+        # sides of the queue watch it, and the query's reclamation pass
+        # closes registered iterators on cancellation
+        from spark_rapids_tpu.engine.cancel import current_token
+        from spark_rapids_tpu.utils import metrics as _M
+
+        self._token = current_token()
+        # registration is paired with DE-registration in close(): the
+        # query's reclamation list must not hold strong references to
+        # finished iterators (an abandoned-unclosed iterator would also
+        # never be GC-collectable while its query runs)
+        self._qctx = _M.current_query_ctx()
+        if self._qctx is not None:
+            self._qctx.prefetchers.append(self)
         # the reader decodes on behalf of the constructing task's QUERY:
         # carry its contextvars (per-tenant QueryContext — metrics, fault
         # injector — docs/serving.md) onto the worker thread
         cctx = contextvars.copy_context()
         self._thread = threading.Thread(
             target=cctx.run,
-            args=(_prefetch_worker, source, self._queue, self._closed),
-            name=name, daemon=True)
+            args=(_prefetch_worker, source, self._queue, self._closed,
+                  self._token),
+            name=_THREAD_PREFIX + name, daemon=True)
         self._thread.start()
 
     def __iter__(self) -> "PrefetchIterator":
@@ -120,7 +163,22 @@ class PrefetchIterator:
             occ = self._queue.qsize()
             if occ > self._occ_high:
                 self._occ_high = occ
-        kind, payload = self._queue.get()
+        while True:
+            # bounded poll: each wakeup re-checks close and the query's
+            # CancelToken, so a cancelled consumer raises promptly
+            # instead of outwaiting a dead reader
+            try:
+                kind, payload = self._queue.get(timeout=_POLL_S)
+                break
+            except queue.Empty:
+                if self._closed.is_set():
+                    raise StopIteration from None
+                if self._token is not None:
+                    try:
+                        self._token.check("prefetch")
+                    except BaseException:
+                        self.close()
+                        raise
         if payload is _END:
             self.close()
             raise StopIteration
@@ -130,8 +188,12 @@ class PrefetchIterator:
         self._items += 1
         return payload
 
-    def close(self) -> None:
-        """Stop the worker; safe to call multiple times / concurrently."""
+    def close(self, join_timeout_s: float = _JOIN_S) -> None:
+        """Stop the worker and JOIN its thread (bounded); safe to call
+        multiple times / concurrently. The join is the satellite-bugfix
+        contract: abandoning an unexhausted scan leaves ZERO live reader
+        threads — the worker observes `closed` within one put/poll
+        period, so the bound only trips if a source read itself wedges."""
         self._closed.set()
         # unblock a worker waiting on a full queue
         while True:
@@ -139,6 +201,16 @@ class PrefetchIterator:
                 self._queue.get_nowait()
             except queue.Empty:
                 break
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=max(0.0, join_timeout_s))
+        qctx = self._qctx
+        if qctx is not None:
+            self._qctx = None
+            try:
+                qctx.prefetchers.remove(self)
+            except ValueError:
+                pass  # already deregistered (reclamation raced close)
         if self._tracer is not None and not self._reported:
             self._reported = True
             from spark_rapids_tpu.obs.trace import wall_ns

@@ -409,13 +409,33 @@ class SpillFramework:
                                      ctx=ctx)
 
     # -- buffer API ----------------------------------------------------------
+    @staticmethod
+    def _scope_to_query(buf: SpillableBuffer) -> None:
+        """Record the buffer on the ambient query's reclamation set
+        (utils/metrics.QueryContext.spill_buffers): a CANCELLED query
+        frees everything it registered, so a dead query's shuffle pieces
+        and staged batches cannot linger in the store
+        (docs/fault-tolerance.md). No-op outside a query context."""
+        from spark_rapids_tpu.utils import metrics as M
+
+        ctx = M.current_query_ctx()
+        if ctx is not None:
+            ctx.spill_buffers.append(buf)
+
     def add_device_batch(self, batch: ColumnarBatch,
                          priority: float = SpillPriorities.DEFAULT,
-                         host_bytes: Optional[bytes] = None) -> SpillableBuffer:
+                         host_bytes: Optional[bytes] = None,
+                         scope_to_query: bool = True) -> SpillableBuffer:
+        """`scope_to_query=False` marks a buffer whose lifetime exceeds
+        the registering query (the relation cache, exec/cache.py) —
+        cancellation must not free it."""
         self.watermark.ensure_headroom(
             len(host_bytes) if host_bytes is not None
             else batch.device_memory_size())
-        return self.device_store.add_batch(batch, priority, host_bytes)
+        buf = self.device_store.add_batch(batch, priority, host_bytes)
+        if scope_to_query:
+            self._scope_to_query(buf)
+        return buf
 
     def add_host_batch(self, host_batch: HostColumnarBatch,
                        priority: float = SpillPriorities.DEFAULT
@@ -423,8 +443,8 @@ class SpillFramework:
         return self.add_host_bytes(serialize_batch(host_batch), priority)
 
     def add_host_bytes(self, data: bytes,
-                       priority: float = SpillPriorities.DEFAULT
-                       ) -> SpillableBuffer:
+                       priority: float = SpillPriorities.DEFAULT,
+                       scope_to_query: bool = True) -> SpillableBuffer:
         """Register already-serialized bytes at the host tier (used by the
         serialized shuffle tier so shuffle pieces participate in spill,
         reference: RapidsCachingWriter registering shuffle buffers,
@@ -434,6 +454,8 @@ class SpillFramework:
         buf.host_bytes = data
         self.catalog.register(buf)
         self.host_store.add_bytes_tracked(buf)
+        if scope_to_query:
+            self._scope_to_query(buf)
         return buf
 
     def read_bytes(self, buf: SpillableBuffer) -> bytes:

@@ -87,6 +87,10 @@ def render_prometheus(snapshot: dict) -> str:
              help_text="queries currently blocked in HBM admission")
     w.sample("srt_admission_waits_total", adm.get("waits"),
              mtype="counter")
+    w.sample("srt_admission_sheds_total", adm.get("sheds"),
+             mtype="counter",
+             help_text="queries refused by the overload policy "
+                       "(queue depth / max wait bounds)")
     for q in ("p50", "p95"):
         ms = adm.get(f"wait_{q}_ms")
         if ms is not None:
@@ -118,7 +122,10 @@ def render_prometheus(snapshot: dict) -> str:
                             ("cpuFallbackEvents", "cpu_fallbacks"),
                             ("planCacheHits", "plan_cache_hits"),
                             ("admissionWaits", "admission_waits"),
-                            ("checkedReplays", "checked_replays")):
+                            ("checkedReplays", "checked_replays"),
+                            ("cancelledQueries", "cancelled_queries"),
+                            ("deadlineRejects", "deadline_rejects"),
+                            ("shedQueries", "shed_queries")):
             w.sample(f"srt_tenant_{metric}_total", t.get(key), labels,
                      mtype="counter")
         w.sample("srt_tenant_admission_wait_seconds_total",

@@ -343,8 +343,13 @@ class DataFrame:
         return self
 
     # -- actions --------------------------------------------------------------
-    def collect(self) -> List[tuple]:
-        return self.session.execute_collect(self._plan)
+    def collect(self, timeout=None) -> List[tuple]:
+        """Run the query and return all rows. `timeout` (seconds) arms a
+        per-call deadline on the query's CancelToken — overriding
+        rapids.tpu.engine.deadlineMs — after which the query raises
+        TpuDeadlineExceeded with no partial rows and releases everything
+        it holds (docs/fault-tolerance.md)."""
+        return self.session.execute_collect(self._plan, timeout_s=timeout)
 
     def toLocalBatches(self):
         return self.session.execute_batches(self._plan)

@@ -138,6 +138,13 @@ class TpuSession:
         # wired by TpuServer.connect: queries eligible for cross-query
         # micro-batching route through the server's shared batcher
         self.micro_batcher = None
+        # in-flight query registry (docs/fault-tolerance.md): every
+        # running query's CancelToken, so cancel_all()/drain/stop can
+        # reach queries mid-flight. _draining sheds NEW queries with
+        # TpuOverloadedError while in-flight ones finish or cancel.
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+        self._draining = False
         self._stopped = False
         # planning mutates/reads session conf (the CPU-fallback run swaps
         # sql.enabled); an RLock keeps a concurrent query's signature and
@@ -170,8 +177,13 @@ class TpuSession:
                     self.conf, budget, self.device_manager.bytes_in_use)
             self.spill = fw
             TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
-            AdmissionController.initialize(
+            ctl = AdmissionController.initialize(
                 budget, self.conf.get(C.ADMISSION_MAX_BYPASS))
+            # overload-shedding bounds (engine/admission.py): one device,
+            # one policy — the newest session's conf wins
+            ctl.set_overload_policy(
+                self.conf.get(C.ADMISSION_MAX_QUEUE_DEPTH),
+                self.conf.get(C.ADMISSION_MAX_QUEUE_WAIT_MS))
             _LIVE_SESSIONS.add(self)
         self.scheduler = TaskScheduler(self.conf.task_threads)
         self.conf.sync_int64_narrowing()
@@ -190,10 +202,58 @@ class TpuSession:
                 cls._active = TpuSession()
             return cls._active
 
+    # -- cancellation / drain (engine/cancel.py, docs/fault-tolerance.md) ----
+    def cancel_all(self, reason: str = "cancelled") -> int:
+        """Fire every in-flight query's CancelToken; returns how many
+        tokens this call fired first. The queries raise TpuQueryCancelled
+        at their next chokepoint poll and release everything they hold."""
+        with self._inflight_lock:
+            tokens = list(self._inflight)
+        return sum(1 for t in tokens if t.cancel(reason))
+
+    def inflight_count(self) -> int:
+        with self._inflight_lock:
+            return len(self._inflight)
+
+    def begin_drain(self) -> None:
+        """Stop admitting: new queries on this session shed immediately
+        with TpuOverloadedError; in-flight ones are untouched (cancel or
+        await them per drain policy — TpuServer.drain / stop)."""
+        self._draining = True
+
+    def _await_quiesce(self, timeout_s: float) -> bool:
+        """Wait (bounded) until no query is in flight; True = quiesced."""
+        from spark_rapids_tpu.obs.trace import wall_ns
+
+        end = wall_ns() + int(max(0.0, timeout_s) * 1e9)
+        poll = threading.Event()
+        while self.inflight_count() > 0:
+            if wall_ns() >= end:
+                return False
+            poll.wait(0.02)
+        return True
+
+    def _drain_for_stop(self) -> None:
+        """stop() with queries in flight drains FIRST (the PR's satellite
+        bugfix): cancel everything running, then wait (bounded by
+        drain.timeoutMs) for the queries to unwind through their own
+        finallys — so teardown never yanks the runtime out from under a
+        live query, and no semaphore permits or admission bytes leak."""
+        self.begin_drain()
+        if self.inflight_count() == 0:
+            return
+        self.cancel_all("session stopped")
+        if not self._await_quiesce(
+                self.conf.get(C.DRAIN_TIMEOUT_MS) / 1000.0):
+            log.warning("session.stop: %d queries still in flight after "
+                        "the drain timeout; tearing down anyway",
+                        self.inflight_count())
+
     def stop(self, _sweep_leaked: bool = True):
         from spark_rapids_tpu.engine.retry import CircuitBreaker
         from spark_rapids_tpu.utils import faultinject as FI
 
+        self._drain_for_stop()
         with _RUNTIME_LOCK:
             if self._stopped:
                 # idempotent: a double stop() must not re-run teardown (it
@@ -526,24 +586,46 @@ class TpuSession:
         return ExecContext(self.conf, self.scheduler, self.device_manager)
 
     # -- actions --------------------------------------------------------------
-    def execute_batches(self, plan: L.LogicalPlan) -> List[HostColumnarBatch]:
-        results = self.execute_partitions(plan)
+    def execute_batches(self, plan: L.LogicalPlan,
+                        timeout_s: Optional[float] = None
+                        ) -> List[HostColumnarBatch]:
+        results = self.execute_partitions(plan, timeout_s=timeout_s)
         return [b for part in results for b in part]
 
     def execute_partitions(self, plan: L.LogicalPlan,
                            allow_micro_batch: bool = True,
                            use_plan_cache: bool = True,
-                           force_tracing: bool = False):
+                           force_tracing: bool = False,
+                           timeout_s: Optional[float] = None):
         """Run one query; returns per-partition lists of host batches (in
         partition order). The serving entry point: installs the per-query
-        QueryContext (tenant metrics + breaker + injector + retry budget),
-        routes eligible queries through the server's micro-batcher, and
-        otherwise runs the device/degradation pipeline."""
+        QueryContext (tenant metrics + breaker + injector + retry budget
+        + CancelToken), routes eligible queries through the server's
+        micro-batcher, and otherwise runs the device/degradation
+        pipeline. `timeout_s` overrides rapids.tpu.engine.deadlineMs for
+        this call (df.collect(timeout=...))."""
         from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.engine import cancel as CX
         from spark_rapids_tpu.engine import retry as R
         from spark_rapids_tpu.plan.fusion import count_fused_stages
         from spark_rapids_tpu.utils import faultinject as FI
         from spark_rapids_tpu.utils import metrics as M
+
+        if self._draining:
+            # drain/stop sheds NEW work up front: nothing was planned,
+            # nothing was admitted, nothing to reclaim. No QueryContext
+            # exists yet, so the tenant's lifetime total is bumped here
+            # directly — the per-tenant shed counters must see drain-time
+            # sheds too (docs/fault-tolerance.md)
+            M.record_shed_query()
+            with self._totals_lock:
+                self.tenant_metric_totals[M.SHED_QUERIES] = \
+                    self.tenant_metric_totals.get(M.SHED_QUERIES, 0) + 1
+            err = CX.TpuOverloadedError(
+                f"session for tenant {self.tenant!r} is draining; "
+                "query refused")
+            err.counted = True
+            raise err
 
         # the executing session's conf drives the process-wide narrowing
         # flag (conf.sync_int64_narrowing: covers clone_with copies and
@@ -568,6 +650,13 @@ class TpuSession:
         R.set_policy_from_conf(self.conf, ctx=qctx)
         qctx.breaker = breaker
         qctx.begin_retry_budget(self.conf.get(C.RETRY_BUDGET))
+        # the query's CancelToken (engine/cancel.py): per-call timeout
+        # wins over the session deadline conf; no deadline = a plain
+        # cancellable token (cancel_all / drain / cancel.race still work)
+        deadline_ms = self.conf.get(C.ENGINE_DEADLINE_MS)
+        deadline_s = timeout_s if timeout_s is not None else (
+            deadline_ms / 1000.0 if deadline_ms > 0 else None)
+        qctx.cancel = CX.CancelToken(deadline_s)
         # force_tracing (EXPLAIN ANALYZE) traces THIS run without touching
         # conf: the settings map feeds plan-cache signatures under
         # _plan_lock, so a transient conf flip would both race concurrent
@@ -585,6 +674,11 @@ class TpuSession:
             # under whatever span the enclosing query has open
             span_token = reset_current_span()
         token = M.push_query_ctx(qctx)
+        # registered LAST, adjacent to the try whose finally discards it:
+        # an exception in the setup above must not leak a token that
+        # would make every later drain/stop burn its full quiesce timeout
+        with self._inflight_lock:
+            self._inflight.add(qctx.cancel)
         physical = None
         try:
             FI.configure(self.conf, ctx=qctx)
@@ -612,7 +706,17 @@ class TpuSession:
                     physical, results = self._degrade_device_failure(
                         plan, e, breaker, cpu_fallback_ok, use_plan_cache)
             return results
+        except (CX.TpuQueryCancelled, CX.TpuOverloadedError) as e:
+            # terminal by contract (engine/cancel.py): count it once,
+            # note it on the trace, reclaim everything the query holds
+            # (query-scoped spill entries, prefetch reader threads —
+            # semaphore permits and the admission ticket released in
+            # their own finallys), and propagate with NO partial rows
+            self._on_query_killed(qctx, e)
+            raise
         finally:
+            with self._inflight_lock:
+                self._inflight.discard(qctx.cancel)
             M.pop_query_ctx(token)
             # per-query accounting from THIS query's context (immune to
             # concurrent tenants, unlike the old global before/after
@@ -633,7 +737,8 @@ class TpuSession:
                          M.ENCODED_COLUMNS, M.LATE_MATERIALIZATIONS,
                          M.ENCODED_BYTES_SAVED, M.AQE_REPLANS,
                          M.SKEW_SPLITS, M.JOIN_DEMOTIONS,
-                         M.JOIN_PROMOTIONS):
+                         M.JOIN_PROMOTIONS, M.CANCELLED_QUERIES,
+                         M.DEADLINE_REJECTS, M.SHED_QUERIES):
                 self.last_query_metrics[name] = snap.get(name, 0)
             self.last_adaptive_report = list(qctx.aqe_notes)
             if qctx.trace is not None:
@@ -649,6 +754,84 @@ class TpuSession:
                 for name, v in snap.items():
                     self.tenant_metric_totals[name] = \
                         self.tenant_metric_totals.get(name, 0) + v
+
+    def _on_query_killed(self, qctx, e: BaseException) -> None:
+        """Account + reclaim for a cancelled/shed/deadline-rejected query
+        (runs with the QueryContext still ambient, so the counters land
+        on the tenant's totals and the trace)."""
+        from spark_rapids_tpu.engine import cancel as CX
+        from spark_rapids_tpu.obs.trace import wall_ns
+        from spark_rapids_tpu.utils import metrics as M
+
+        if not getattr(e, "counted", False):
+            e.counted = True
+            if isinstance(e, CX.TpuOverloadedError):
+                M.record_shed_query()
+            else:
+                M.record_cancelled_query()
+        if qctx.trace is not None:
+            kind = ("shed" if isinstance(e, CX.TpuOverloadedError)
+                    else "deadline" if isinstance(e, CX.TpuDeadlineExceeded)
+                    else "cancelled")
+            t = wall_ns()
+            qctx.trace.note_span(
+                f"query.{kind}", t, t,
+                attrs={"reason": getattr(e, "reason", kind),
+                       "site": getattr(e, "site", "")})
+        self._reclaim_cancelled(qctx)
+
+    @staticmethod
+    def _reclaim_cancelled(qctx) -> None:
+        """Release everything a dead query still holds: close (and join)
+        its prefetch reader threads and free its query-scoped spill-store
+        entries (shuffle pieces, staged batches). Semaphore permits and
+        admission bytes release in their own finallys; the post-cancel
+        invariant (engine/cancel.reclamation_report) pins the union."""
+        for pf in list(qctx.prefetchers):  # close() deregisters in place
+            try:
+                pf.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        qctx.prefetchers.clear()
+        fw = SpillFramework.get()
+        if fw is not None:
+            for buf in qctx.spill_buffers:
+                try:
+                    fw.free(buf)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        qctx.spill_buffers.clear()
+
+    def _check_deadline_feasible(self, qctx, report) -> None:
+        """Admission-time deadline enforcement (docs/fault-tolerance.md):
+        a query whose deadline is already spent — or whose predicted
+        work (analyzer dispatch upper bound x costPerDispatchMs) cannot
+        fit the remaining budget — is REJECTED before any device
+        dispatch, instead of admitted to die mid-flight (metric:
+        deadlineRejects)."""
+        from spark_rapids_tpu.engine import cancel as CX
+        from spark_rapids_tpu.utils import metrics as M
+
+        tok = qctx.cancel if qctx is not None else None
+        if tok is None or tok.deadline_ns is None:
+            return
+        remaining = tok.deadline_remaining_s()
+        predicted_s = 0.0
+        cost_ms = self.conf.get(C.DEADLINE_COST_PER_DISPATCH_MS)
+        if report is not None and cost_ms > 0:
+            hi = getattr(report.dispatches, "hi", None)
+            if hi is not None and hi == hi and hi != float("inf"):
+                predicted_s = float(hi) * cost_ms / 1000.0
+        if remaining > predicted_s:
+            return
+        M.record_deadline_reject()
+        tok.cancel("deadline")
+        err = CX.TpuDeadlineExceeded(
+            f"rejected at admission: predicted work ~{predicted_s:.3f}s "
+            f"cannot fit the remaining deadline "
+            f"{max(0.0, remaining):.3f}s", site="admission")
+        err.counted = True
+        raise err
 
     def _maybe_micro_batch(self, plan: L.LogicalPlan, breaker,
                            allow_micro_batch: bool):
@@ -704,6 +887,9 @@ class TpuSession:
         qctx = M.current_query_ctx()
         report = qctx.resource_report if qctx is not None \
             else self.last_resource_report
+        # deadline feasibility BEFORE admission: an infeasible query runs
+        # zero device dispatches by construction (engine/cancel.py)
+        self._check_deadline_feasible(qctx, report)
         if report is not None and self.conf.get(C.ADMISSION_ENABLED):
             ctl = AdmissionController.get()
             if ctl is not None:
@@ -768,9 +954,14 @@ class TpuSession:
         # the result stage span covers the partition tasks + grouped sink
         # downloads, but NOT the child execute above — exchanges that
         # materialized there opened their own stage spans at top level
+        from spark_rapids_tpu.engine import cancel as CX
+
         with obs_span("stage:result", kind="stage", partitions=n):
             for pidx, part in self.scheduler.run_job_iter(
                     n, lambda p: (p, list(child_pb.iterator(p)))):
+                # sink chokepoint: a cancel between partition completions
+                # stops the download loop before the next grouped fence
+                CX.check_cancel("sink")
                 pending.append((pidx, part))
                 pending_bytes += sum(b.device_memory_size() for b in part)
                 if pending_bytes > self._SINK_FLUSH_BYTES:
@@ -879,9 +1070,10 @@ class TpuSession:
             pb.num_partitions, lambda p: list(pb.iterator(p)))
         return physical, results
 
-    def execute_collect(self, plan: L.LogicalPlan) -> List[tuple]:
+    def execute_collect(self, plan: L.LogicalPlan,
+                        timeout_s: Optional[float] = None) -> List[tuple]:
         rows: List[tuple] = []
-        for b in self.execute_batches(plan):
+        for b in self.execute_batches(plan, timeout_s=timeout_s):
             rows.extend(b.to_pylist_rows())
         return rows
 

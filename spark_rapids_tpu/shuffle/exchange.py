@@ -268,10 +268,14 @@ class _ExchangeBase(PhysicalExec):
             upstream map partition and decode the regenerated piece
             (bounded attempts — beyond them the failure surfaces and the
             task-level retry takes over)."""
+            from spark_rapids_tpu.engine.cancel import check_cancel
             from spark_rapids_tpu.engine.scheduler import FetchFailedError
 
             attempts = 0
             while True:
+                # a cancelled query must not burn fetch-remap attempts
+                # re-running upstream maps it will never consume
+                check_cancel("shuffle.remap")
                 try:
                     return piece.decode(to_device)
                 except FetchFailedError:

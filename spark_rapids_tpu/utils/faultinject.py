@@ -54,9 +54,16 @@ SITES: Dict[str, str] = {
     "transfer.upload": "transfer",
     "transfer.download": "transfer",
     "shuffle.fetch": "fetch",
+    # the cancellation-race site (engine/cancel.check_cancel): armed with
+    # the "cancel" kind it fires a cancellation at one of the engine's
+    # own poll points — a cancel racing engine progress. Excluded from
+    # the '*' expansion: a cancelled query by design returns no rows, so
+    # it can never be oracle-equal (arm it explicitly, chaos matrix in
+    # tests/test_faults.py)
+    "cancel.race": "cancel",
 }
 
-KINDS = ("oom", "dispatch", "transfer", "fetch")
+KINDS = ("oom", "dispatch", "transfer", "fetch", "cancel")
 
 
 # fault kinds that model a device COMPUTE failure: under async dispatch
@@ -142,7 +149,12 @@ def _parse_sites(spec: str) -> Dict[str, str]:
         if not entry:
             continue
         if entry == "*":
-            armed.update(SITES)
+            # everything EXCEPT cancel-kind sites: '*' arms the recover-
+            # and-stay-oracle-equal chaos matrix, and a cancellation by
+            # design produces no rows to compare — cancellation sites are
+            # an explicit opt-in ('cancel.race' / 'site:cancel')
+            armed.update({k: v for k, v in SITES.items()
+                          if v != "cancel"})
             continue
         if ":" in entry:
             name, kind = entry.split(":", 1)
@@ -266,6 +278,18 @@ def maybe_inject(site: str) -> None:
     kind = inj.check(site)
     if kind is None:
         return
+    if kind == "cancel":
+        # a cancellation racing this site: fire the ambient query's token
+        # (every later poll agrees) and raise the terminal error HERE —
+        # never deferred, never retried (engine/cancel.py contract)
+        from spark_rapids_tpu.engine.cancel import TpuQueryCancelled
+
+        ctx = _M.current_query_ctx()
+        if ctx is not None and ctx.cancel is not None:
+            ctx.cancel.cancel(f"injected at {site}")
+        raise TpuQueryCancelled(
+            f"[injected] query cancelled racing {site}",
+            reason=f"injected at {site}", site=site)
     if inj.defer_to_sink and kind in _DEFERRABLE_KINDS and \
             site not in SINK_SITES:
         from spark_rapids_tpu.engine.async_exec import async_enabled

@@ -93,6 +93,18 @@ AQE_REPLANS = "aqeReplans"
 SKEW_SPLITS = "skewSplits"
 JOIN_DEMOTIONS = "joinDemotions"
 JOIN_PROMOTIONS = "joinPromotions"
+# cooperative cancellation / deadline / overload shedding
+# (engine/cancel.py, engine/admission.py, docs/fault-tolerance.md):
+# cancelledQueries counts queries that raised TpuQueryCancelled
+# (explicit cancel, drain, or a MID-FLIGHT deadline expiry);
+# deadlineRejects counts queries rejected BEFORE execution because the
+# deadline was already spent or the predicted work could not fit the
+# remaining budget (zero device dispatches by construction); shedQueries
+# counts queries the overload policy refused (bounded admission queue
+# depth / max queue wait / draining server)
+CANCELLED_QUERIES = "cancelledQueries"
+DEADLINE_REJECTS = "deadlineRejects"
+SHED_QUERIES = "shedQueries"
 
 
 class Metric:
@@ -161,7 +173,8 @@ class QueryContext:
     __slots__ = ("tenant", "_lock", "_counters", "breaker", "injector",
                  "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
                  "resource_report", "retry_policy", "aqe_notes",
-                 "spill_plan_hint", "async_dispatch", "donation", "trace")
+                 "spill_plan_hint", "async_dispatch", "donation", "trace",
+                 "cancel", "spill_buffers", "prefetchers")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -214,6 +227,21 @@ class QueryContext:
         # mirrors its increment onto the tracer's current span via _note,
         # so the timeline shows WHERE dispatches/retries/fences happened
         self.trace = None
+        # THIS query's cancellation token (engine/cancel.CancelToken;
+        # None outside session-driven queries). Installed by the session
+        # at query start and polled at every engine chokepoint —
+        # contextvars propagation carries it onto worker threads and the
+        # prefetch reader exactly like the context itself.
+        self.cancel = None
+        # spill-store buffers registered on behalf of THIS query
+        # (memory/spill.py add_* with scope_to_query): the reclamation
+        # set a cancellation frees so a dead query's shuffle pieces and
+        # staged batches cannot linger in the store
+        self.spill_buffers = []
+        # live PrefetchIterators decoding for THIS query (io/prefetch.py
+        # registers them): cancellation closes them and joins their
+        # reader threads (bounded) so no thread outlives the query
+        self.prefetchers = []
 
     def add(self, name: str, n: int) -> None:
         with self._lock:
@@ -561,6 +589,47 @@ _AQE_REPLANS = Metric(AQE_REPLANS)
 _SKEW_SPLITS = Metric(SKEW_SPLITS)
 _JOIN_DEMOTIONS = Metric(JOIN_DEMOTIONS)
 _JOIN_PROMOTIONS = Metric(JOIN_PROMOTIONS)
+
+
+_CANCELLED_QUERIES = Metric(CANCELLED_QUERIES)
+_DEADLINE_REJECTS = Metric(DEADLINE_REJECTS)
+_SHED_QUERIES = Metric(SHED_QUERIES)
+
+
+def record_cancelled_query(n: int = 1) -> None:
+    """Count one query that terminated with TpuQueryCancelled (explicit
+    cancel, drain, or a mid-flight deadline expiry) — terminal by the
+    engine/cancel.py contract: no retry, no fallback, no partial rows."""
+    _CANCELLED_QUERIES.add(n)
+    _note(CANCELLED_QUERIES, n)
+
+
+def cancelled_query_count() -> int:
+    return _CANCELLED_QUERIES.value
+
+
+def record_deadline_reject(n: int = 1) -> None:
+    """Count one query rejected BEFORE execution because its deadline was
+    already spent or its predicted work could not fit the remaining
+    budget (zero device dispatches)."""
+    _DEADLINE_REJECTS.add(n)
+    _note(DEADLINE_REJECTS, n)
+
+
+def deadline_reject_count() -> int:
+    return _DEADLINE_REJECTS.value
+
+
+def record_shed_query(n: int = 1) -> None:
+    """Count one query the overload policy shed (bounded admission queue
+    depth, max queue wait, or a draining server) instead of admitting it
+    to die waiting."""
+    _SHED_QUERIES.add(n)
+    _note(SHED_QUERIES, n)
+
+
+def shed_query_count() -> int:
+    return _SHED_QUERIES.value
 
 
 def record_aqe_replan(n: int = 1) -> None:

@@ -1,0 +1,500 @@
+"""Cooperative cancellation / deadline / overload-shedding suite
+(engine/cancel.py, docs/fault-tolerance.md).
+
+Covers the PR's robustness contract at every layer: the CancelToken
+itself, cancel-aware backoff sleeps, scheduler job cancellation,
+admission-queue shedding (depth + wait bounds) and in-queue deadline
+expiry, admission-time deadline rejection (zero device dispatches),
+mid-flight deadline cancellation, prefetch reader teardown, session
+drain-on-stop (the satellite bugfix), TpuServer.drain, and the metric/
+Prometheus plumbing. The site-by-site cancellation chaos matrix lives
+with the rest of the chaos suite in tests/test_faults.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu.engine import cancel as CX
+from spark_rapids_tpu.engine import retry as R
+from spark_rapids_tpu.engine.admission import AdmissionController
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.utils import metrics as M
+
+
+def _df(s, n=400, parts=2):
+    rng = np.random.default_rng(7)
+    return s.createDataFrame(
+        {"k": rng.integers(0, 8, n).astype(np.int64),
+         "v": rng.integers(0, 100, n).astype(np.int64)},
+        [("k", "long"), ("v", "long")], num_partitions=parts)
+
+
+def _agg(df):
+    return df.groupBy("k").agg(F.sum("v").alias("s"))
+
+
+# conf that makes a query grind forever (injected dispatch faults with a
+# huge transient-retry budget): the in-flight workload the drain/stop
+# tests cancel mid-retry-backoff
+_GRIND_CONF = {
+    "rapids.tpu.test.faultInjection.enabled": True,
+    "rapids.tpu.test.faultInjection.sites": "agg.update:dispatch",
+    "rapids.tpu.test.faultInjection.rate": 1.0,
+    "rapids.tpu.execution.retry.transientRetries": 100000,
+    "rapids.tpu.engine.retryBackoffMs": 100.0,
+    "rapids.tpu.engine.retryBudget": 0,
+}
+
+
+@pytest.fixture()
+def query_ctx():
+    """An ambient QueryContext with a live CancelToken (unit tests that
+    exercise chokepoints without a session)."""
+    qctx = M.QueryContext()
+    qctx.cancel = CX.CancelToken()
+    token = M.push_query_ctx(qctx)
+    yield qctx
+    M.pop_query_ctx(token)
+
+
+# ---------------------------------------------------------------------------
+# CancelToken semantics
+# ---------------------------------------------------------------------------
+def test_token_cancel_is_monotonic_first_wins():
+    tok = CX.CancelToken()
+    assert not tok.cancelled
+    tok.check("unit")  # live token: no raise
+    assert tok.cancel("caller") is True
+    assert tok.cancel("later") is False  # first reason wins
+    assert tok.cancelled and tok.reason == "caller"
+    with pytest.raises(CX.TpuQueryCancelled) as ei:
+        tok.check("unit")
+    assert ei.value.reason == "caller" and ei.value.site == "unit"
+
+
+def test_token_deadline_self_arms_and_types_the_raise():
+    tok = CX.CancelToken(deadline_s=0.05)
+    assert tok.deadline_remaining_s() > 0
+    time.sleep(0.08)
+    with pytest.raises(CX.TpuDeadlineExceeded):
+        tok.check("unit")
+    # the expiry armed the cancel: every later observer agrees
+    assert tok.cancelled and tok.reason == "deadline"
+    assert tok.deadline_remaining_s() <= 0
+
+
+def test_token_wait_clamps_to_deadline():
+    tok = CX.CancelToken(deadline_s=0.05)
+    t0 = time.monotonic()
+    assert tok.wait(30.0) is True  # returns at the deadline, not 30s
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_cancellation_never_retryable_never_device_rooted():
+    e = CX.TpuQueryCancelled("x")
+    assert not R.is_retryable_failure(e)
+    assert not R.failure_is_device_rooted(e)
+    assert not R.failure_needs_checked_replay(e)
+    assert R.as_typed_error(e) is None
+    # ... even wrapped in a cause chain
+    outer = RuntimeError("wrapper")
+    outer.__cause__ = e
+    assert not R.is_retryable_failure(outer)
+    assert not R.failure_is_device_rooted(outer)
+    shed = CX.TpuOverloadedError("x")
+    assert not R.is_retryable_failure(shed)
+    assert R.as_typed_error(shed) is None
+
+
+# ---------------------------------------------------------------------------
+# Cancel-aware waits (retry backoff, the uncancellable-wait contract)
+# ---------------------------------------------------------------------------
+def test_cancel_aware_sleep_interrupts_promptly(query_ctx):
+    threading.Timer(0.1, query_ctx.cancel.cancel).start()
+    t0 = time.monotonic()
+    with pytest.raises(CX.TpuQueryCancelled):
+        CX.cancel_aware_sleep(30.0)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_with_retry_backoff_interrupted_by_cancel(query_ctx):
+    """A cancel fired DURING a retry backoff raises immediately — no
+    re-dispatch, no waiting out a 10s exponential schedule."""
+    query_ctx.retry_policy = R.RetryPolicy(backoff_ms=10000.0)
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise R.TpuTransientDeviceError("flaky")
+
+    threading.Timer(0.1, query_ctx.cancel.cancel).start()
+    t0 = time.monotonic()
+    with pytest.raises(CX.TpuQueryCancelled):
+        R.with_retry(attempt, site="unit")
+    assert time.monotonic() - t0 < 5.0
+    assert len(calls) == 1  # the cancel killed the re-dispatch
+
+
+def test_scheduler_job_cancelled_mid_flight(query_ctx):
+    """run_job raises TpuQueryCancelled promptly and drains its tasks —
+    no TaskFailedError wrap, no retry, semaphore fully returned."""
+    from spark_rapids_tpu.engine.scheduler import TaskScheduler
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    sched = TaskScheduler(num_threads=2, max_failures=5)
+    started = threading.Event()
+
+    def fn(p):
+        started.set()
+        # grind until cancelled: the backoff path polls the token
+        R.backoff_sleep(0, "unit", p)
+        raise R.TpuTransientDeviceError("keep retrying")
+
+    query_ctx.retry_policy = R.RetryPolicy(backoff_ms=50.0)
+    threading.Timer(0.15, query_ctx.cancel.cancel).start()
+    t0 = time.monotonic()
+    with pytest.raises(CX.TpuQueryCancelled):
+        sched.run_job(2, fn)
+    assert time.monotonic() - t0 < 10.0
+    assert started.is_set()
+    sched.shutdown()
+    sem = TpuSemaphore.get()
+    assert sem._available == sem.max_concurrent
+
+
+# ---------------------------------------------------------------------------
+# Admission: shedding bounds + deadline/cancel polling in the queue
+# ---------------------------------------------------------------------------
+def _hold_whole_budget(ctl):
+    return ctl.admit(None, tenant="hog")  # None -> clamped to the budget
+
+
+def test_admission_queue_depth_shed():
+    ctl = AdmissionController(budget_bytes=100, max_queue_depth=1)
+    t1 = _hold_whole_budget(ctl)
+    got = []
+    th = threading.Thread(target=lambda: got.append(ctl.admit(100)),
+                          daemon=True)
+    th.start()
+    for _ in range(200):  # wait until the waiter registers
+        if ctl.snapshot()["waiting"] == 1:
+            break
+        time.sleep(0.01)
+    assert ctl.snapshot()["waiting"] == 1
+    with pytest.raises(CX.TpuOverloadedError):
+        ctl.admit(100)  # depth bound: refused immediately
+    assert ctl.snapshot()["sheds"] == 1
+    ctl.release(t1)
+    th.join(timeout=10.0)
+    assert not th.is_alive() and got
+    ctl.release(got[0])
+    assert ctl.admitted_bytes() == 0
+
+
+def test_admission_max_wait_shed():
+    ctl = AdmissionController(budget_bytes=100, max_queue_wait_ms=100.0)
+    t1 = _hold_whole_budget(ctl)
+    t0 = time.monotonic()
+    with pytest.raises(CX.TpuOverloadedError):
+        ctl.admit(100)
+    elapsed = time.monotonic() - t0
+    assert 0.05 < elapsed < 10.0
+    snap = ctl.snapshot()
+    assert snap["sheds"] == 1 and snap["waiting"] == 0
+    ctl.release(t1)
+    assert ctl.admitted_bytes() == 0
+
+
+def test_admission_wait_observes_cancel_and_deadline(query_ctx):
+    ctl = AdmissionController(budget_bytes=100)
+    t1 = _hold_whole_budget(ctl)
+    threading.Timer(0.1, query_ctx.cancel.cancel).start()
+    with pytest.raises(CX.TpuQueryCancelled):
+        ctl.admit(100)
+    assert ctl.snapshot()["waiting"] == 0
+    ctl.release(t1)
+
+    # a deadline expiring IN the queue raises the typed deadline error
+    qctx = M.QueryContext()
+    qctx.cancel = CX.CancelToken(deadline_s=0.1)
+    token = M.push_query_ctx(qctx)
+    try:
+        t1 = _hold_whole_budget(ctl)
+        with pytest.raises(CX.TpuDeadlineExceeded):
+            ctl.admit(100)
+        ctl.release(t1)
+    finally:
+        M.pop_query_ctx(token)
+    assert ctl.admitted_bytes() == 0
+
+
+def test_admission_wait_shed_e2e():
+    """End to end through a session: a hogged budget + a wait bound shed
+    the query with shedQueries accounted and everything reclaimed."""
+    s = TpuSession({
+        "rapids.tpu.memory.hbm.sizeOverride": 8 << 20,
+        "rapids.tpu.serving.admission.maxQueueWaitMs": 100.0,
+    })
+    try:
+        df = _df(s)
+        ctl = AdmissionController.get()
+        hog = _hold_whole_budget(ctl)
+        try:
+            with pytest.raises(CX.TpuOverloadedError):
+                _agg(df).collect()
+        finally:
+            ctl.release(hog)
+        m = s.last_query_metrics
+        assert m["shedQueries"] == 1, m
+        assert m["deviceDispatches"] == 0, m
+        CX.assert_reclaimed()
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines end to end
+# ---------------------------------------------------------------------------
+def test_deadline_infeasible_rejected_before_any_dispatch(session):
+    """Admission-time rejection: predicted work (dispatch bound x
+    costPerDispatchMs) cannot fit the deadline — zero device dispatches,
+    deadlineRejects counted, nothing leaked (acceptance criterion)."""
+    session.conf.set("rapids.tpu.engine.deadlineMs", 10000.0)
+    session.conf.set("rapids.tpu.engine.deadline.costPerDispatchMs",
+                     100000.0)
+    with pytest.raises(CX.TpuDeadlineExceeded):
+        _agg(_df(session)).collect()
+    m = session.last_query_metrics
+    assert m["deadlineRejects"] == 1, m
+    assert m["cancelledQueries"] == 0, m  # rejected, not cancelled
+    assert m["deviceDispatches"] == 0, m
+    assert m["fencesPerQuery"] == 0, m
+    CX.assert_reclaimed()
+
+
+def test_mid_flight_deadline_cancels_grinding_query(session):
+    """collect(timeout=) arms a per-call deadline; a query stuck in
+    retry backoff observes the expiry inside the cancel-aware sleep and
+    dies typed — counted as a cancellation, with no partial rows."""
+    for k, v in _GRIND_CONF.items():
+        session.conf.set(k, v)
+    t0 = time.monotonic()
+    with pytest.raises(CX.TpuDeadlineExceeded):
+        _agg(_df(session)).collect(timeout=0.4)
+    assert time.monotonic() - t0 < 20.0
+    m = session.last_query_metrics
+    assert m["cancelledQueries"] == 1, m
+    assert m["deadlineRejects"] == 0, m
+    assert m["cpuFallbackEvents"] == 0 and m["checkedReplays"] == 0, m
+    CX.assert_reclaimed()
+
+
+def test_collect_without_timeout_unaffected(session):
+    rows = _agg(_df(session)).collect()
+    assert len(rows) == 8
+    m = session.last_query_metrics
+    assert m["cancelledQueries"] == 0 and m["shedQueries"] == 0
+    assert m["deadlineRejects"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prefetch reader teardown (satellite bugfix) + cancellation
+# ---------------------------------------------------------------------------
+def test_prefetch_close_joins_reader_thread():
+    from spark_rapids_tpu.io.prefetch import (
+        PrefetchIterator,
+        live_reader_count,
+    )
+
+    def slow_source():
+        i = 0
+        while True:
+            time.sleep(0.01)
+            yield i
+            i += 1
+
+    it = PrefetchIterator(iter(slow_source()), depth=2)
+    assert next(it) == 0
+    it.close()  # abandon unexhausted: the reader must JOIN, not linger
+    assert not it._thread.is_alive()
+    assert live_reader_count() == 0
+
+
+def test_prefetch_consumer_and_reader_observe_cancel(query_ctx):
+    from spark_rapids_tpu.io.prefetch import (
+        PrefetchIterator,
+        live_reader_count,
+    )
+
+    produced = []
+
+    def trickle():
+        while True:
+            time.sleep(0.05)
+            produced.append(1)
+            yield len(produced)
+
+    it = PrefetchIterator(iter(trickle()), depth=1)
+    assert next(it) >= 1
+    # the iterator registered itself for the query's reclamation pass
+    assert it in query_ctx.prefetchers
+    threading.Timer(0.1, query_ctx.cancel.cancel).start()
+    with pytest.raises(CX.TpuQueryCancelled):
+        while True:
+            next(it)
+    assert not it._thread.is_alive()
+    assert live_reader_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# Drain: session.stop with queries in flight (satellite bugfix) + server
+# ---------------------------------------------------------------------------
+def _start_grinding_query(s):
+    errs = []
+    df = _df(s)
+
+    def run():
+        try:
+            _agg(df).collect()
+        except BaseException as e:  # noqa: BLE001 - relayed to assertions
+            errs.append(e)
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    for _ in range(500):
+        if s.inflight_count() > 0:
+            break
+        time.sleep(0.01)
+    assert s.inflight_count() > 0, "query never started"
+    return th, errs
+
+
+def test_session_stop_drains_inflight_queries():
+    """The satellite regression: stop() with queries in flight drains
+    FIRST — the in-flight query dies with TpuQueryCancelled and the
+    post-stop counter state is pinned (no leaked semaphore permits, no
+    leaked admission bytes)."""
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    s = TpuSession(dict(_GRIND_CONF))
+    th, errs = _start_grinding_query(s)
+    sem = TpuSemaphore.get()
+    ctl = AdmissionController.get()
+    t0 = time.monotonic()
+    s.stop()
+    assert time.monotonic() - t0 < 9.0  # drained, not timed out
+    th.join(timeout=10.0)
+    assert not th.is_alive()
+    assert errs and isinstance(errs[0], CX.TpuQueryCancelled), errs
+    # pinned post-stop counter state
+    assert sem._available == sem.max_concurrent
+    assert ctl.admitted_bytes() == 0
+    assert s.inflight_count() == 0
+
+
+def test_draining_session_sheds_new_queries(session):
+    df = _df(session)
+    session.begin_drain()
+    shed0 = M.shed_query_count()
+    with pytest.raises(CX.TpuOverloadedError):
+        _agg(df).collect()
+    assert M.shed_query_count() - shed0 == 1
+
+
+def test_server_drain_cancel_policy():
+    from spark_rapids_tpu.engine.server import TpuServer
+
+    server = TpuServer()
+    s = server.connect("grind", settings=dict(_GRIND_CONF))
+    th, errs = _start_grinding_query(s)
+    summary = server.drain(policy="cancel", timeout_s=10.0)
+    th.join(timeout=10.0)
+    assert not th.is_alive()
+    assert summary["policy"] == "cancel" and summary["quiesced"]
+    assert summary["cancelled"] >= 1
+    assert errs and isinstance(errs[0], CX.TpuQueryCancelled), errs
+
+
+def test_server_drain_await_policy_idle():
+    """await policy over an idle server: quiesces immediately, cancels
+    nothing, and the server is stopped afterwards."""
+    from spark_rapids_tpu.engine.server import TpuServer
+
+    server = TpuServer()
+    s = server.connect("quiet")
+    assert len(_agg(_df(s)).collect()) == 8
+    summary = server.drain()  # conf default: await
+    assert summary == {"policy": "await", "cancelled": 0,
+                       "quiesced": True}
+
+
+def test_tenant_deadline_on_server():
+    from spark_rapids_tpu.engine.server import TpuServer
+
+    server = TpuServer()
+    try:
+        server.set_tenant_deadline("slow-lane", 10000.0)
+        s = server.connect("slow-lane")
+        # make the deadline infeasible so the reject is deterministic
+        s.conf.set("rapids.tpu.engine.deadline.costPerDispatchMs",
+                   100000.0)
+        with pytest.raises(CX.TpuDeadlineExceeded):
+            _agg(_df(s)).collect()
+        assert s.last_query_metrics["deadlineRejects"] == 1
+        # other tenants are untouched
+        other = server.connect("fast-lane")
+        assert len(_agg(_df(other)).collect()) == 8
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry plumbing: tenant totals + Prometheus exposition
+# ---------------------------------------------------------------------------
+def test_cancel_shed_metrics_flow_to_prometheus():
+    from spark_rapids_tpu.engine.server import TpuServer
+
+    server = TpuServer()
+    try:
+        s = server.connect("tenA")
+        df = _df(s)
+        s.conf.set("rapids.tpu.test.faultInjection.enabled", True)
+        s.conf.set("rapids.tpu.test.faultInjection.sites",
+                   "agg.update:cancel")
+        s.conf.set("rapids.tpu.test.faultInjection.rate", 1.0)
+        with pytest.raises(CX.TpuQueryCancelled):
+            _agg(df).collect()
+        s.conf.set("rapids.tpu.test.faultInjection.enabled", False)
+        s.begin_drain()
+        with pytest.raises(CX.TpuOverloadedError):
+            _agg(df).collect()
+        s._draining = False  # resume: only the shed itself was the point
+        snap = server.metrics_snapshot()
+        ten = snap["tenants"]["tenA"]
+        assert ten["cancelledQueries"] == 1
+        text = server.metrics_prometheus()
+        assert 'srt_tenant_cancelled_queries_total{tenant="tenA"} 1' \
+            in text
+        assert "srt_admission_sheds_total" in text
+    finally:
+        server.stop()
+
+
+def test_cancelled_query_noted_on_trace(session):
+    """cancel/shed/deadline events land on the traced timeline."""
+    session.conf.set("rapids.tpu.obs.tracing.enabled", True)
+    session.conf.set("rapids.tpu.test.faultInjection.enabled", True)
+    session.conf.set("rapids.tpu.test.faultInjection.sites",
+                     "agg.update:cancel")
+    session.conf.set("rapids.tpu.test.faultInjection.rate", 1.0)
+    with pytest.raises(CX.TpuQueryCancelled):
+        _agg(_df(session)).collect()
+    trace = session.last_query_trace
+    assert trace is not None
+    assert trace.find("query.cancelled"), trace.render()
+    assert trace.counts_total().get("cancelledQueries") == 1

@@ -193,7 +193,12 @@ def test_injector_site_spec_parsing():
                            rate=1.0)
     assert inj.armed == {"project": "oom", "join": "dispatch"}
     star = FI.FaultInjector(seed=0, sites_spec="*", rate=1.0)
-    assert star.armed == FI.SITES
+    # '*' arms every FAULT site but not cancel-kind sites: a cancelled
+    # query returns no rows, so it can never be oracle-equal — the
+    # cancel.race site is an explicit opt-in (chaos matrix below)
+    assert star.armed == {k: v for k, v in FI.SITES.items()
+                          if v != "cancel"}
+    assert "cancel.race" not in star.armed and "cancel.race" in FI.SITES
     with pytest.raises(ValueError):
         FI.FaultInjector(seed=0, sites_spec="project:nope", rate=1.0)
 
@@ -208,8 +213,13 @@ def test_maybe_inject_noop_when_disabled():
 # Scheduler hardening
 # ---------------------------------------------------------------------------
 def test_scheduler_backoff_is_jittered_and_bounded(monkeypatch):
+    from spark_rapids_tpu.engine import cancel as CX
+
     sleeps = []
-    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    # backoff waits through the cancel-aware helper now (a cancel can
+    # interrupt the sleep); intercept it where backoff_sleep resolves it
+    monkeypatch.setattr(CX, "cancel_aware_sleep",
+                        lambda s, site="": sleeps.append(s))
     sched = TaskScheduler(num_threads=1, max_failures=3)
     calls = []
 
@@ -607,3 +617,143 @@ def test_no_injection_means_zero_retries(session):
     assert m["splitRetries"] == 0
     assert m["cpuFallbackEvents"] == 0
     assert m["fetchRetries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Cancellation chaos matrix (engine/cancel.py): a cancel fired at every
+# registered fault-injection site — including the cancel.race poll-point
+# site — must be TERMINAL (no retry, no fallback, no replay, no partial
+# rows) and must reclaim everything the query held; a site the plan never
+# exercises leaves the run oracle-equal and untouched.
+# ---------------------------------------------------------------------------
+from spark_rapids_tpu.engine import cancel as CX  # noqa: E402
+
+
+def _cancel_conf(site: str, extra=None) -> dict:
+    conf = {
+        "rapids.tpu.test.faultInjection.enabled": True,
+        "rapids.tpu.test.faultInjection.seed": 0,
+        "rapids.tpu.test.faultInjection.sites": f"{site}:cancel",
+        "rapids.tpu.test.faultInjection.rate": 1.0,
+    }
+    conf.update(extra or {})
+    return conf
+
+
+def _run_cancel_at_site(session, df_fn, site: str, extra=None) -> bool:
+    """Run df_fn with a cancellation armed at `site`; assert the terminal
+    + reclamation contract if it fired, oracle-equality if the plan never
+    reached the site. Returns whether the cancel fired."""
+    cpu = run_on_cpu(session, df_fn)
+    cancelled = False
+    try:
+        rows = run_on_tpu(session, df_fn,
+                          extra_conf=_cancel_conf(site, extra))
+    except CX.TpuQueryCancelled:
+        cancelled = True
+    m = session.last_query_metrics
+    if cancelled:
+        # terminal: never retried, never CPU-fallback'd, never replayed,
+        # and the raise IS the result (no partial rows to compare)
+        assert m["cancelledQueries"] == 1, (site, m)
+        assert m["retries"] == 0 and m["splitRetries"] == 0, (site, m)
+        assert m["cpuFallbackEvents"] == 0, (site, m)
+        assert m["checkedReplays"] == 0, (site, m)
+    else:
+        assert_rows_equal(cpu, rows, ignore_order=True,
+                          approx_float=1e-9)
+        assert m["cancelledQueries"] == 0, (site, m)
+    # the pinned post-cancel resource-reclamation invariant: semaphore
+    # permits, admission bytes, admission queue, prefetch threads
+    CX.assert_reclaimed()
+    return cancelled
+
+
+# q1 exercises these sites on the in-memory TPC-H tables (upload,
+# aggregate, order-by, download, and the cancel.race poll point); the
+# full site matrix (incl. sites q1 never reaches, exercised via the
+# oracle-equal branch) runs under @slow
+_CANCEL_SITES_Q1_FAST = ["transfer.upload", "agg.update", "sort",
+                         "transfer.download", "cancel.race"]
+
+
+@pytest.mark.parametrize("site", _CANCEL_SITES_Q1_FAST)
+def test_cancel_matrix_q1_fast(session, site):
+    assert _run_cancel_at_site(session, _tpch_q("q1"), site), \
+        f"site {site} was never reached by q1"
+
+
+def test_cancel_during_retry_backoff_reclaims(session):
+    """A cancel landing DURING a retry backoff (dispatch faults force the
+    backoff, a timer fires the token) is terminal and fully reclaimed."""
+    import spark_rapids_tpu.utils.metrics as _M
+
+    conf = {
+        "rapids.tpu.test.faultInjection.enabled": True,
+        "rapids.tpu.test.faultInjection.sites": "agg.update:dispatch",
+        "rapids.tpu.test.faultInjection.rate": 1.0,
+        "rapids.tpu.execution.retry.transientRetries": 100000,
+        "rapids.tpu.engine.retryBackoffMs": 100.0,
+    }
+    for k, v in conf.items():
+        session.conf.set(k, v)
+    fired = threading.Event()
+
+    def cancel_when_inflight():
+        for _ in range(1000):
+            if session.inflight_count() > 0:
+                break
+            time.sleep(0.005)
+        time.sleep(0.2)  # land inside the (cancel-aware) backoff
+        session.cancel_all("test")
+        fired.set()
+
+    th = threading.Thread(target=cancel_when_inflight, daemon=True)
+    th.start()
+    c0 = _M.cancelled_query_count()
+    with pytest.raises(CX.TpuQueryCancelled):
+        _tpch_q("q1")(session).collect()
+    th.join(timeout=10.0)
+    assert fired.is_set()
+    assert _M.cancelled_query_count() - c0 == 1
+    CX.assert_reclaimed()
+
+
+def test_cancel_during_aqe_replan_is_terminal(session):
+    """A cancel racing the AQE re-optimizer must NOT degrade to the
+    static plan (that would keep executing a stopped query): it is
+    terminal, counts no replans, and reclaims everything."""
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.default_rng(21)
+    n = 2000
+    dk = rng.integers(0, 1 << 12, n).astype(np.int64)
+    dv = rng.integers(0, 100, n).astype(np.int64)
+
+    def q(s):
+        df = s.createDataFrame({"k": dk, "v": dv}, num_partitions=3)
+        return df.repartition(6, F.col("k")).groupBy("k").agg(
+            F.sum("v").alias("s"))
+
+    with pytest.raises(CX.TpuQueryCancelled):
+        run_on_tpu(session, q, extra_conf=_cancel_conf(
+            "aqe.replan", {"rapids.tpu.sql.adaptive.enabled": True}))
+    m = session.last_query_metrics
+    assert m["cancelledQueries"] == 1, m
+    assert m["aqeReplans"] == 0, m
+    CX.assert_reclaimed()
+
+
+@pytest.mark.slow  # full site matrix: protects the tier-1 dots window
+@pytest.mark.parametrize("site", sorted(FI.SITES))
+def test_cancel_matrix_q1_all_sites(session, site):
+    _run_cancel_at_site(session, _tpch_q("q1"), site)
+
+
+@pytest.mark.slow  # heavy chaos combination: protects the tier-1 dots window
+@pytest.mark.parametrize("site", sorted(FI.SITES))
+def test_cancel_matrix_q5_all_sites(session, site):
+    # serialized shuffle arms the fetch path; joins arm the join site
+    _run_cancel_at_site(
+        session, _tpch_q("q5"), site,
+        extra={"rapids.tpu.shuffle.serialize.enabled": True})

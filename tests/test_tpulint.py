@@ -787,7 +787,11 @@ def test_naked_timer_sleep_and_span_api_allowed():
            "    with span('stage:x', kind='stage'):\n"
            "        pass\n"
            "    return wall_ns() - t0\n")
-    assert lint(src, path=ENGINE) == []
+    # time.sleep is waiting, not timing: the naked-timer rule stays
+    # silent — but in engine/ it IS an uninterruptible wait, so the
+    # uncancellable-wait rule (and only it) reports the sleep
+    found = lint(src, path=ENGINE)
+    assert [f.rule for f in found] == ["uncancellable-wait"]
 
 
 def test_naked_timer_pragma_suppresses():
@@ -796,4 +800,61 @@ def test_naked_timer_pragma_suppresses():
            "    # tpulint: naked-timer -- pre-session probe, no tracer yet\n"
            "    t0 = time.monotonic()\n"
            "    return t0\n")
+    assert lint(src, path=ENGINE) == []
+
+
+# ---------------------------------------------------------------------------
+# uncancellable-wait (engine/cancel.py, docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+def test_uncancellable_wait_time_sleep_flagged_in_scope():
+    src = ("import time\n\n"
+           "def backoff(x):\n"
+           "    time.sleep(0.5)\n")
+    for path in (ENGINE, HOT, "spark_rapids_tpu/io/fake.py",
+                 "spark_rapids_tpu/aqe/fake.py",
+                 "spark_rapids_tpu/shuffle/fake.py"):
+        got = lint(src, path=path)
+        assert "uncancellable-wait" in rules_of(got), path
+
+
+def test_uncancellable_wait_untimed_blocking_waits_flagged():
+    src = ("def f(ev, fut, th):\n"
+           "    ev.wait()\n"
+           "    r = fut.result()\n"
+           "    th.join()\n"
+           "    return r\n")
+    got = lint(src, path=ENGINE)
+    assert [f.rule for f in got] == ["uncancellable-wait"] * 3
+    assert [f.line for f in got] == [2, 3, 4]
+
+
+def test_uncancellable_wait_timed_and_helper_waits_allowed():
+    src = ("from spark_rapids_tpu.engine.cancel import (\n"
+           "    cancel_aware_sleep, check_cancel)\n\n"
+           "def f(ev, fut, th, tok):\n"
+           "    cancel_aware_sleep(0.5)\n"
+           "    while not ev.wait(timeout=0.1):\n"
+           "        check_cancel('unit')\n"
+           "    r = fut.result(timeout=5.0)\n"
+           "    th.join(timeout=2.0)\n"
+           "    tok.wait(0.1)\n"
+           "    return r\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_uncancellable_wait_not_flagged_outside_scope():
+    src = ("import time\n\n"
+           "def f(ev):\n"
+           "    time.sleep(0.5)\n"
+           "    ev.wait()\n")
+    assert lint(src, path=COLD) == []
+    assert lint(src, path="spark_rapids_tpu/utils/fake.py") == []
+
+
+def test_uncancellable_wait_pragma_suppresses():
+    src = ("import time\n\n"
+           "def f():\n"
+           "    # tpulint: uncancellable-wait -- process bring-up, no "
+           "query can exist yet\n"
+           "    time.sleep(0.5)\n")
     assert lint(src, path=ENGINE) == []

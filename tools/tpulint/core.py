@@ -85,6 +85,17 @@ the line above; `-- reason` after the rule names documents the waiver):
               so an accidental decode on the hot path (which silently
               multiplies HBM and shuffle bytes back up) cannot land
               unreviewed. Host/CPU-oracle scopes are exempt.
+  uncancellable-wait  a bare `time.sleep(...)` or an UNTIMED blocking
+              wait — `.wait()` / `.result()` / `.join()` with no
+              arguments — in the layers the cooperative-cancellation
+              contract covers (engine/, exec/, io/, aqe/, shuffle/):
+              nothing can interrupt such a wait, so a cancelled or
+              deadline-expired query (engine/cancel.py,
+              docs/fault-tolerance.md) sits it out in full. Wait through
+              the cancel-aware helpers (engine.cancel.cancel_aware_sleep
+              / CancelToken.wait / check_cancel polling loops) or give
+              the wait a timeout and poll; a genuinely uninterruptible
+              site carries a justified pragma.
   naked-timer  a direct wall-clock read (time.monotonic / time.time /
               time.perf_counter and their _ns variants, or the bare
               imported names) in the engine's timed layers (exec/,
@@ -119,6 +130,7 @@ RULES = (
     "untracked-alloc",
     "naked-dispatch",
     "naked-timer",
+    "uncancellable-wait",
     "shared-state-mutation",
     "eager-materialize",
     "pragma",
@@ -241,6 +253,18 @@ def is_timer_scope(path: str) -> bool:
             or "spark_rapids_tpu/engine/" in p
             or "spark_rapids_tpu/shuffle/" in p
             or "spark_rapids_tpu/aqe/" in p)
+
+
+def is_cancel_wait_scope(path: str) -> bool:
+    """Files bound by the uncancellable-wait rule: every layer a query's
+    CancelToken must be able to interrupt — the engine, the executors,
+    the IO/prefetch layer, the adaptive runtime, and the shuffle."""
+    p = _norm(path)
+    return ("spark_rapids_tpu/engine/" in p
+            or "spark_rapids_tpu/exec/" in p
+            or "spark_rapids_tpu/io/" in p
+            or "spark_rapids_tpu/aqe/" in p
+            or "spark_rapids_tpu/shuffle/" in p)
 
 
 def is_shared_state_scope(path: str) -> bool:
@@ -495,6 +519,7 @@ class _Visitor(ast.NodeVisitor):
         self.hot = is_hot_path(path)
         self.midquery = is_mid_query_scope(path)
         self.timer_scope = is_timer_scope(path)
+        self.cancel_scope = is_cancel_wait_scope(path)
         self.shared_scope = is_shared_state_scope(path)
         self._module_names = module_names or set()
         self._sanctioned = sanctioned_names or set()
@@ -721,6 +746,27 @@ class _Visitor(ast.NodeVisitor):
                        "API (spark_rapids_tpu.obs.trace.span / wall_ns "
                        "or utils.metrics.trace_range) so the duration "
                        "lands on the traced timeline")
+
+        # uncancellable-wait: a bare sleep / untimed blocking wait in a
+        # layer the cooperative-cancellation contract covers — nothing
+        # can interrupt it, so a cancelled or deadline-expired query
+        # sits it out in full (engine/cancel.py)
+        if self.cancel_scope:
+            if name == "time.sleep":
+                self._flag(node, "uncancellable-wait",
+                           "time.sleep() cannot be interrupted by a "
+                           "query cancel or deadline; wait through "
+                           "engine.cancel.cancel_aware_sleep (or a "
+                           "CancelToken.wait loop) instead")
+            elif isinstance(node.func, ast.Attribute) and \
+                    tail in ("wait", "result", "join") and \
+                    not node.args and not node.keywords:
+                self._flag(node, "uncancellable-wait",
+                           f".{tail}() with no timeout blocks until the "
+                           "other side acts — a cancelled query waits "
+                           "forever; use a timed wait in a loop that "
+                           "polls engine.cancel.check_cancel, or "
+                           "justify with a pragma")
 
         # naked-dispatch: a dispatch site outside the retry combinators
         if self.hot and tail == "record_dispatch" and \

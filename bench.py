@@ -1649,6 +1649,111 @@ def main_skew() -> None:
     _emit(result)
 
 
+def main_spmd() -> None:
+    """Whole-query single-program suite (`python bench.py --spmd`): per
+    TPC-H flagship (q1, q5) x shuffle partitions (4, 16), the measured
+    deviceDispatches / wall-clock of the SPMD stage compiler — chained
+    segments, lowered joins, encoded inputs — against the host-loop
+    baseline on the same backend, results-equal checked per cell. q5's
+    five INNER joins lower in-program (spmd_joins pinned in the record),
+    and lateMaterializations ride along so the encoded-input parity
+    claim is auditable. Writes BENCH_r14.json."""
+    import jax
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.benchmarks import tpch
+
+    platform = jax.devices()[0].platform
+    sf = float(os.environ.get("SRT_SPMD_SF", "0.002"))
+    iters = int(os.environ.get("SRT_SPMD_ITERS", "3"))
+
+    def run_cell(qname: str, parts: int, spmd: bool) -> dict:
+        s = srt.new_session()
+        try:
+            s.conf.set(C.SPMD_ENABLED.key, spmd)
+            s.conf.set(C.SHUFFLE_PARTITIONS.key, parts)
+            tables = tpch.gen_tables(s, sf=sf, num_partitions=4)
+            q = tpch.QUERIES[qname](tables)
+            q.collect()  # warmup/compile
+            times = []
+            out = None
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                out = q.collect()
+                times.append(time.perf_counter() - t0)
+            m = dict(s.last_query_metrics)
+            return {
+                "best_s": round(min(times), 4),
+                "times_s": [round(t, 4) for t in times],
+                "dispatches": m.get("deviceDispatches", 0),
+                "spmd_stages": m.get("spmdStages", 0),
+                "spmd_joins": m.get("spmdJoins", 0),
+                "collective_bytes": m.get("collectiveBytes", 0),
+                "late_materializations": m.get("lateMaterializations", 0),
+                "result": sorted(tuple(r) for r in out),
+            }
+        finally:
+            s.stop()
+
+    def rows_equal(a, b, rel=1e-9) -> bool:
+        # reduction order differs between the in-program segmented
+        # reduce and the host loop: float sums match to relative 1e-9
+        # (the same tolerance the oracle-equality tests use)
+        if len(a) != len(b):
+            return False
+        for ra, rb in zip(a, b):
+            if len(ra) != len(rb):
+                return False
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float) and isinstance(vb, float):
+                    if abs(va - vb) > rel * max(abs(va), abs(vb), 1.0):
+                        return False
+                elif va != vb:
+                    return False
+        return True
+
+    cells = {}
+    equal = True
+    for qname in ("q1", "q5"):
+        for parts in (4, 16):
+            _log(f"spmd: {qname} parts={parts} host-loop run")
+            off = run_cell(qname, parts, False)
+            _log(f"spmd: {qname} parts={parts} spmd run")
+            on = run_cell(qname, parts, True)
+            equal = equal and rows_equal(off.pop("result"),
+                                         on.pop("result"))
+            cells[f"{qname}_p{parts}"] = {
+                "dispatches_host": off["dispatches"],
+                "dispatches_spmd": on["dispatches"],
+                "spmd_stages": on["spmd_stages"],
+                "spmd_joins": on["spmd_joins"],
+                "late_materializations_host":
+                    off["late_materializations"],
+                "late_materializations_spmd":
+                    on["late_materializations"],
+                "host": off, "spmd": on,
+            }
+    q1 = cells["q1_p16"]
+    result = {
+        "metric": "flagship_dispatches_spmd",
+        "value": q1["dispatches_spmd"],
+        "unit": "dispatches",
+        "vs_baseline": (round(q1["dispatches_host"]
+                              / max(q1["dispatches_spmd"], 1), 3)),
+        "platform": platform,
+        "sf": sf,
+        "results_equal": equal,
+        "cells": cells,
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r14.json")
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+        fh.write("\n")
+    _emit(result)
+
+
 def main_serving() -> None:
     """Serving suite (`python bench.py --serving`): closed-loop clients
     over the multi-tenant runtime, plan cache OFF vs ON (docs/serving.md).
@@ -1976,6 +2081,8 @@ if __name__ == "__main__":
         main_serving()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--skew":
         main_skew()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--spmd":
+        main_spmd()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--encoded":
         main_encoded()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--obs":

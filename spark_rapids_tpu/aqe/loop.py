@@ -93,15 +93,23 @@ def maybe_wrap_adaptive(plan: PhysicalExec, conf) -> PhysicalExec:
 
 
 def _subtree_exchanges(node: PhysicalExec, out=None):
-    """Every materializing exchange in the tree, skipping SPMD stage
-    programs (their in-program all_to_all is not a stage boundary the
-    host loop can re-optimize across)."""
+    """Every materializing exchange in the tree, skipping the members of
+    SPMD stage programs (their in-program all_to_all is not a stage
+    boundary the host loop can re-optimize across). Exchanges at/below a
+    stage chain's innermost INPUT still materialize through the host loop
+    and remain re-optimizable — materializing one also feeds the stage's
+    MEASURED capacity channel (engine/spmd_exec reads the resulting
+    TpuQueryStageExec stats when sizing its exchange buckets)."""
     from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
     from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
 
     if out is None:
         out = []
     if isinstance(node, TpuSpmdStageExec):
+        _subtree_exchanges(node.infos[0].input_node, out)
+        for info in node.infos:
+            for jp in info.joins:
+                _subtree_exchanges(jp.build_input_node, out)
         return out
     if isinstance(node, _ExchangeBase):
         out.append(node)
@@ -182,6 +190,37 @@ def _degrade_coalesce(plan: PhysicalExec, conf) -> None:
     walk(plan)
 
 
+def _refresh_spmd_measured(plan: PhysicalExec, conf) -> None:
+    """Tighten SPMD bucket hints from MEASURED MapOutputStats: whenever a
+    stage chain's innermost input is now a materialized TpuQueryStageExec
+    with known row counts, that measured total replaces (or clamps) the
+    resource analyzer's pessimistic row-interval hint. The executor reads
+    the same channel at dispatch time (spmd_exec._measured_input_rows);
+    refreshing the plan-side hints here keeps EXPLAIN and the replans'
+    re-validation consistent with what will actually run."""
+    if not conf.get(C.SPMD_MEASURED_CAPACITY):
+        return
+    from spark_rapids_tpu.engine.spmd_exec import _measured_input_rows
+    from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+
+    def walk(node):
+        if isinstance(node, TpuSpmdStageExec):
+            for s, info in enumerate(node.infos):
+                if info.joins:
+                    # a lowered fan-out join can GROW the row count, so
+                    # measured INPUT rows do not bound the aggregate
+                    continue
+                r = _measured_input_rows(info.input_node)
+                if r is not None:
+                    h = node.bucket_rows_hints[s]
+                    node.bucket_rows_hints[s] = \
+                        r if not h or h <= 0 else min(int(h), r)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+
+
 def _stats_map(plan: PhysicalExec) -> dict:
     """The analyzer's measured_stats channel: every materialized stage's
     MapOutputStats keyed by node id."""
@@ -256,6 +295,11 @@ def run_adaptive(plan: PhysicalExec, ctx: ExecContext) -> PartitionedBatches:
         sid += 1
         stage = TpuQueryStageExec(ex, pb, stats, sid)
         plan = _replace_node(plan, ex, stage)
+        # measured-capacity channel: an SPMD stage whose input just
+        # materialized takes the MEASURED row count as its bucket bound
+        # (tightening the analyzer's interval; the in-program overflow
+        # probe backstops it)
+        _refresh_spmd_measured(plan, ctx.conf)
         if degraded:
             continue
         try:

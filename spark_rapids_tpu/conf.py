@@ -567,16 +567,20 @@ FUSION_MAX_OPS = _conf("rapids.tpu.sql.fusion.maxOps").doc(
 # ---------------------------------------------------------------------------
 SPMD_ENABLED = _conf("rapids.tpu.sql.spmd.enabled").doc(
     "Compile whole SPMD-eligible stage pipelines — a scan-fed fused "
-    "Filter/Project chain, the partial hash aggregate, the hash exchange "
-    "(lowered to an in-program lax.all_to_all over the session mesh), the "
-    "final merge aggregate, and an optional trailing range-exchange+sort "
-    "tail — into ONE jitted shard_map program over the device mesh: one "
-    "device dispatch per stage regardless of partition count, the same "
-    "program on 1 chip or a pod slice (docs/spmd-stages.md). Ineligible "
-    "stages, checked replays, and CPU fallbacks always take the host-loop "
-    "executor, so the PR 4/PR 6 retry and re-attribution contracts hold "
-    "unchanged."
-).boolean(False)
+    "Filter/Project chain, lowered INNER equi-joins (build side broadcast "
+    "in-program via lax.all_gather), the partial hash aggregate, the hash "
+    "exchange (lowered to an in-program lax.all_to_all over the session "
+    "mesh), the final merge aggregate, and an optional trailing "
+    "range-exchange+sort tail — into ONE jitted shard_map program over "
+    "the device mesh: one device dispatch per stage chain regardless of "
+    "partition count, the same program on 1 chip or a pod slice "
+    "(docs/spmd-stages.md). Consecutive eligible stages CHAIN inside one "
+    "program (spmd.chainStages.enabled). Ineligible stages, checked "
+    "replays, and CPU fallbacks always take the host-loop executor, so "
+    "the PR 4/PR 6 retry and re-attribution contracts hold unchanged. On "
+    "by default since the r14 bench confirmed flagship parity on the CPU "
+    "backend (BENCH_r14.json)."
+).boolean(True)
 
 SPMD_MESH_DEVICES = _conf("rapids.tpu.sql.spmd.meshDevices").doc(
     "Devices in the SPMD stage mesh (0 = all local devices). Tests pin it "
@@ -600,6 +604,53 @@ SPMD_MAX_SORT_LANES = _conf("rapids.tpu.sql.spmd.maxSortLanes").doc(
     "when mesh_size * received_lanes stays under this bound; beyond it "
     "the whole stage falls back to the host-loop executor."
 ).integer(1 << 18)
+
+SPMD_JOIN_LOWERING = _conf("rapids.tpu.sql.spmd.joinLowering.enabled").doc(
+    "Lower INNER equi-joins below an SPMD stage's partial aggregate into "
+    "the stage program: the build side assembles like a second stage "
+    "input and an in-program lax.all_gather replicates it to every shard "
+    "(the planned join exchanges are elided in-program; the host-loop "
+    "fallback subtree keeps them), while the probe side streams on "
+    "through the stage's in-program all_to_all hash exchange. Join "
+    "output rows expand into a static capacity taken from the resource "
+    "analyzer's join row interval (spmd.joinRows overrides); an "
+    "in-program overflow probe degrades the stage to the host-loop "
+    "executor rather than ever dropping a row."
+).boolean(True)
+
+SPMD_CHAIN_STAGES = _conf("rapids.tpu.sql.spmd.chainStages.enabled").doc(
+    "Chain consecutive SPMD-eligible stages (a double group-by) inside "
+    "ONE shard_map program: the post-exchange merged buckets of stage k "
+    "become stage k+1's in-trace input, never re-assembled into [m, cap] "
+    "slots through the host. Each chained segment still counts in "
+    "spmdStages; deviceDispatches reflects the single shared program."
+).boolean(True)
+
+SPMD_MAX_JOIN_LANES = _conf("rapids.tpu.sql.spmd.maxJoinLanes").doc(
+    "Lane budget for one in-program join's expanded output per shard: a "
+    "join whose static expansion capacity (analyzer row interval or "
+    "spmd.joinRows) would exceed this compiles into an impractically "
+    "large program, so the whole stage falls back to the host-loop "
+    "executor instead (mirrors spmd.maxSortLanes)."
+).integer(1 << 17)
+
+SPMD_JOIN_ROWS = _conf("rapids.tpu.sql.spmd.joinRows").doc(
+    "Row capacity of an in-program join's expanded output per shard "
+    "(0 = derive from the resource analyzer's join row interval, falling "
+    "back to max(frontier lanes, gathered build lanes)). A manual value "
+    "below the real match count makes the in-program join overflow probe "
+    "trip and the stage degrade to the host-loop executor."
+).integer(0)
+
+SPMD_MEASURED_CAPACITY = _conf(
+    "rapids.tpu.sql.spmd.measuredCapacity.enabled").doc(
+    "Size SPMD stage capacities from AQE's MEASURED MapOutputStats "
+    "instead of the resource analyzer's pessimistic interval whenever a "
+    "prior stage of the same query already materialized (aqe/loop.py "
+    "publishes per-query measured exchange stats; docs/spmd-stages.md). "
+    "Measured sizing is backstopped by the in-program overflow probes — "
+    "an undersized bucket degrades to the host loop, never drops a row."
+).boolean(True)
 
 COLUMN_PRUNING = _conf("rapids.tpu.sql.optimizer.columnPruning.enabled").doc(
     "Prune unreferenced columns from the logical plan before physical "

@@ -252,6 +252,30 @@ def _collapse_scan_chain(child: PhysicalExec, exprs: List[Expression],
     return node, exprs, filters
 
 
+def collapse_update_chain(child: PhysicalExec, exprs: List[Expression]):
+    """`_collapse_scan_chain` extended to see through non-agg-form fused
+    stage wrappers (TpuFusedStageExec keeps the ORIGINAL chain as its
+    child, so collapsing through it is sound — the wrapper is pure
+    packaging). The traced SPMD stage builder (plan/spmd.py) uses this to
+    absorb chains that the fusion pass already claimed, e.g. a fused
+    Filter/Project stage feeding a lowered join's build side."""
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+
+    node = child
+    cur_exprs = list(exprs)
+    filters: List[Expression] = []
+    while True:
+        node2, cur_exprs, f2 = _collapse_scan_chain(node, cur_exprs)
+        filters.extend(f2)
+        if isinstance(node2, TpuFusedStageExec) and not node2.agg_form:
+            node = node2.children[0]
+            continue
+        if node2 is node:
+            break
+        node = node2
+    return node, cur_exprs, filters
+
+
 class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
     placement = "tpu"
 

@@ -140,6 +140,88 @@ class _JoinBase(PhysicalExec):
 # ===========================================================================
 # TPU equi-join kernel
 # ===========================================================================
+def _cat_promote(a, b):
+    if a.dtype == b.dtype:
+        return jnp.concatenate([a, b])
+    dt = jnp.promote_types(a.dtype, b.dtype)
+    return jnp.concatenate([a.astype(dt), b.astype(dt)])
+
+
+def union_key_proxies(s_proxies, b_proxies):
+    """Union the per-side key proxies so equality becomes one dense-rank
+    grouping problem: stream rows at [0, s_cap), build rows at
+    [s_cap, cap). Traced helper, shared between the per-batch joiner
+    kernel below and the single-program SPMD stage (engine/spmd_exec.py
+    lowers joins with exactly this core). Returns (union proxies,
+    any-null flags per side — null keys never match)."""
+    s_cap = s_proxies[0].null_flag.shape[0]
+    b_cap = b_proxies[0].null_flag.shape[0]
+    proxies = []
+    any_null_s = jnp.zeros((s_cap,), bool)
+    any_null_b = jnp.zeros((b_cap,), bool)
+    for sp, bp in zip(s_proxies, b_proxies):
+        arrays = tuple(_cat_promote(a, b)
+                       for a, b in zip(sp.arrays, bp.arrays))
+        null_flag = jnp.concatenate([sp.null_flag, bp.null_flag])
+        proxies.append(RK.KeyProxy(arrays, null_flag, sp.orderable))
+        any_null_s = any_null_s | sp.null_flag
+        any_null_b = any_null_b | bp.null_flag
+    return proxies, any_null_s, any_null_b
+
+
+def traced_join_plan(proxies, any_null_s, any_null_b, s_live, b_live,
+                     mode: str):
+    """The interval-probe join plan over unioned key proxies (see the
+    module docstring): dense-rank both sides together, sort build rows by
+    group id, and express each stream row's matches as a contiguous range
+    of the sorted build order. Runs inside a jit (the per-batch joiner's
+    kernel or an SPMD stage program). Returns (offsets, total, b_order,
+    b_start, s_safe_gid, match_cnt, b_matched)."""
+    s_cap = any_null_s.shape[0]
+    b_cap = any_null_b.shape[0]
+    cap = s_cap + b_cap
+    s_grp = s_live & ~any_null_s
+    b_grp = b_live & ~any_null_b
+    valid = jnp.concatenate([s_grp, b_grp])
+    gi = RK.group_ids_masked(proxies, valid, cap)
+    s_gid = gi.gid[:s_cap]
+    b_gid = gi.gid[s_cap:]
+
+    # sort build rows by gid; per-gid contiguous ranges
+    b_order = jnp.argsort(jnp.where(b_grp, b_gid, cap),
+                          stable=True).astype(jnp.int32)
+    b_cnt = jax.ops.segment_sum(
+        jnp.ones((b_cap,), jnp.int32),
+        jnp.where(b_grp, b_gid, cap), num_segments=cap)
+    b_start = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(b_cnt, dtype=jnp.int32)[:-1]])
+
+    s_safe_gid = jnp.where(s_grp, s_gid, cap - 1)
+    match_cnt = jnp.where(s_grp, b_cnt[s_safe_gid], 0)
+    if mode == "inner":
+        out_cnt = jnp.where(s_live, match_cnt, 0)
+    elif mode == "outer":
+        out_cnt = jnp.where(s_live, jnp.maximum(match_cnt, 1), 0)
+    elif mode == "semi":
+        out_cnt = jnp.where(s_live & (match_cnt > 0), 1, 0)
+    else:  # anti
+        out_cnt = jnp.where(s_live & (match_cnt == 0), 1, 0)
+
+    offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(out_cnt, dtype=jnp.int32)])
+    total = offsets[-1]
+    # build-side matched flags (for full-outer tail emission)
+    s_cnt_per_gid = jax.ops.segment_sum(
+        jnp.ones((s_cap,), jnp.int32),
+        jnp.where(s_grp, s_gid, cap), num_segments=cap)
+    b_matched = b_grp & \
+        (s_cnt_per_gid[jnp.where(b_grp, b_gid, cap - 1)] > 0)
+    return (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
+            b_matched)
+
+
 class _DeviceJoiner:
     """Per-(stream schema, build schema) jitted equi-join planner."""
 
@@ -180,70 +262,17 @@ class _DeviceJoiner:
 
             s_keys = keys_of(s_ctx, bound_stream)
             b_keys = keys_of(b_ctx, bound_build)
-            cap = s_cap + b_cap
-
-            def cat(a, b):
-                if a.dtype == b.dtype:
-                    return jnp.concatenate([a, b])
-                dt = jnp.promote_types(a.dtype, b.dtype)
-                return jnp.concatenate([a.astype(dt), b.astype(dt)])
 
             # union proxies: stream rows at [0,s_cap), build at [s_cap,cap)
-            proxies = []
-            any_null_s = jnp.zeros((s_cap,), bool)
-            any_null_b = jnp.zeros((b_cap,), bool)
-            for sk, bk in zip(s_keys, b_keys):
-                sp = RK.key_proxy(sk)
-                bp = RK.key_proxy(bk)
-                arrays = tuple(cat(a, b)
-                               for a, b in zip(sp.arrays, bp.arrays))
-                null_flag = jnp.concatenate([sp.null_flag, bp.null_flag])
-                proxies.append(RK.KeyProxy(arrays, null_flag, sp.orderable))
-                any_null_s = any_null_s | sp.null_flag
-                any_null_b = any_null_b | bp.null_flag
-
+            proxies, any_null_s, any_null_b = union_key_proxies(
+                [RK.key_proxy(sk) for sk in s_keys],
+                [RK.key_proxy(bk) for bk in b_keys])
             s_live = (jnp.arange(s_cap) < s_rows)
             b_live = (jnp.arange(b_cap) < b_rows)
-            # null keys never match: exclude them from grouping entirely
-            s_grp = s_live & ~any_null_s
-            b_grp = b_live & ~any_null_b
-            valid = jnp.concatenate([s_grp, b_grp])
-            gi = RK.group_ids_masked(proxies, valid, cap)
-            s_gid = gi.gid[:s_cap]
-            b_gid = gi.gid[s_cap:]
-
-            # sort build rows by gid; per-gid contiguous ranges
-            b_order = jnp.argsort(jnp.where(b_grp, b_gid, cap),
-                                  stable=True).astype(jnp.int32)
-            b_cnt = jax.ops.segment_sum(
-                jnp.ones((b_cap,), jnp.int32),
-                jnp.where(b_grp, b_gid, cap), num_segments=cap)
-            b_start = jnp.concatenate([
-                jnp.zeros((1,), jnp.int32),
-                jnp.cumsum(b_cnt, dtype=jnp.int32)[:-1]])
-
-            s_safe_gid = jnp.where(s_grp, s_gid, cap - 1)
-            match_cnt = jnp.where(s_grp, b_cnt[s_safe_gid], 0)
-            if mode == "inner":
-                out_cnt = jnp.where(s_live, match_cnt, 0)
-            elif mode == "outer":
-                out_cnt = jnp.where(s_live, jnp.maximum(match_cnt, 1), 0)
-            elif mode == "semi":
-                out_cnt = jnp.where(s_live & (match_cnt > 0), 1, 0)
-            else:  # anti
-                out_cnt = jnp.where(s_live & (match_cnt == 0), 1, 0)
-
-            offsets = jnp.concatenate([
-                jnp.zeros((1,), jnp.int32),
-                jnp.cumsum(out_cnt, dtype=jnp.int32)])
-            total = offsets[-1]
-            # build-side matched flags (for full-outer tail emission)
-            s_cnt_per_gid = jax.ops.segment_sum(
-                jnp.ones((s_cap,), jnp.int32),
-                jnp.where(s_grp, s_gid, cap), num_segments=cap)
-            b_matched = b_grp & (s_cnt_per_gid[jnp.where(b_grp, b_gid, cap - 1)] > 0)
-            return (offsets, total, b_order, b_start, s_safe_gid, match_cnt,
-                    b_matched)
+            # null keys never match: traced_join_plan excludes them from
+            # the union grouping entirely
+            return traced_join_plan(proxies, any_null_s, any_null_b,
+                                    s_live, b_live, mode)
 
         return jax.jit(kernel)
 

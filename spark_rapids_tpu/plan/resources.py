@@ -491,6 +491,12 @@ class PlanResourceReport:
         # measured metric but is not modeled here
         self.spmd_stages = 0
         self.collective_bytes = Interval.exact(0)
+        # stage coverage for the EXPLAIN line `spmd stages: N of M
+        # stages`: M = SPMD segments + materializing exchanges that
+        # stayed host-loop stage boundaries — partial lowering is visible
+        # instead of a bare count
+        self.host_exchange_stages = 0
+        self.total_stages = 0
         # encoded columnar execution (columnar/encoded.py): how many scan
         # columns are predicted to emit ENCODED, the HBM-savings interval
         # in the measured metric's own formula (containment-testable
@@ -555,8 +561,10 @@ class PlanResourceReport:
             f"jit shape-bucket cache keys: {self.compile_keys}",
         ]
         if self.spmd_stages:
+            total = max(self.total_stages, self.spmd_stages)
             lines.append(
-                f"spmd stages: {self.spmd_stages} (collective bytes "
+                f"spmd stages: {self.spmd_stages} of {total} stages "
+                f"(collective bytes "
                 f"{_fmt_bytes(self.collective_bytes.lo)}"
                 f"..{_fmt_bytes(self.collective_bytes.hi)})")
         if self.encoded_cols:
@@ -618,6 +626,11 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
 
     enc_at: Dict[int, Dict[int, str]] = {}
     decode_points: List[str] = []
+
+    def _is_spmd_stage(node) -> bool:
+        from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+
+        return isinstance(node, TpuSpmdStageExec)
 
     def note_decode(label: str) -> None:
         if label not in decode_points:
@@ -768,6 +781,19 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
         elif isinstance(node, DeviceToHostExec):
             if cin:
                 note_decode("sink")
+        elif _is_spmd_stage(node):
+            # the stage program preserves the member chain's code flow:
+            # encoded group keys pass through the in-program exchange as
+            # int32 lanes and emit encoded (engine/spmd_exec.py); the
+            # members themselves were walked on the recursion. An
+            # absorbed sort tail sorts codes through a rank LUT, so a
+            # sort-topped subtree keeps its input's flow rather than the
+            # sort node's conservative boundary decode
+            enc = dict(cin)
+            if not cin and len(node.infos) == 1 \
+                    and node.infos[0].sort is not None:
+                below = enc_at.get(id(node.infos[0].final), {})
+                enc = dict(below)
         else:
             # sort/window/expand/generate/union/cache/write/unknown:
             # the operator boundary decode
@@ -809,10 +835,15 @@ class _Analyzer:
         self._compile_keys: Set[tuple] = set()
         self._depth = 0
         # SPMD-stage capture: while visiting a TpuSpmdStageExec's subtree,
-        # the hash exchange's INPUT state (the partial-aggregate output) is
-        # stashed here — it sizes the stage program's per-target buckets
-        self._spmd_capture = None
-        self._spmd_captured: Optional[AbsState] = None
+        # the abstract states of its member exchanges (per-target bucket
+        # sizing) and lowered join nodes (expansion sizing) are stashed
+        # here, keyed by node id (see visit())
+        self._spmd_capture_map: Optional[Dict[int, Optional[AbsState]]] = \
+            None
+        # exchanges absorbed IN-PROGRAM by an SPMD stage (its hash
+        # exchange, the absorbed range exchange, elided join shuffles):
+        # not host-loop stage boundaries for the coverage accounting
+        self._covered_exchanges: Set[int] = set()
         # lazy-compaction policies mirror the exec layer's (devprobe fence
         # measurement + conf); they change capacities, not semantics
         self._filter_lazy = self._policy(C.FILTER_COMPACT_SYNC)
@@ -892,6 +923,7 @@ class _Analyzer:
         r = self.report
         r.decode_points = decode_points
         r.compile_keys = len(self._compile_keys)
+        r.total_stages = r.spmd_stages + r.host_exchange_stages
         # plan-level violations ---------------------------------------------
         from spark_rapids_tpu.engine import jit_cache
 
@@ -926,6 +958,20 @@ class _Analyzer:
 
     # -- dispatch table -------------------------------------------------------
     def visit(self, node: PhysicalExec) -> AbsState:
+        self._depth += 1
+        try:
+            st = self._dispatch(node)
+            cm = self._spmd_capture_map
+            if cm is not None and id(node) in cm:
+                # SPMD-stage capture (_spmd_stage): abstract states of the
+                # member exchanges / lowered joins, sizing the program's
+                # per-target buckets and join expansion capacities
+                cm[id(node)] = st
+            return st
+        finally:
+            self._depth -= 1
+
+    def _dispatch(self, node: PhysicalExec) -> AbsState:
         from spark_rapids_tpu.exec import basic as B
         from spark_rapids_tpu.exec.aggregate import _HashAggregateBase
         from spark_rapids_tpu.exec.cache import _CachedScanBase
@@ -950,65 +996,61 @@ class _Analyzer:
         )
         from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
 
-        self._depth += 1
-        try:
-            if isinstance(node, TpuAdaptiveExec):
-                # transparent: the wrapper only drives stage-by-stage
-                # execution of the subtree it declares
-                return self.visit(node.children[0])
-            if isinstance(node, TpuQueryStageExec):
-                return self._query_stage(node)
-            if isinstance(node, TpuStageReaderExec):
-                return self._stage_reader(node)
-            if isinstance(node, TpuSpmdStageExec):
-                return self._spmd_stage(node)
-            if isinstance(node, TpuFusedStageExec):
-                return self._fused_stage(node)
-            if isinstance(node, B.HostScanExec):
-                return self._host_scan(node)
-            if isinstance(node, B.RangeExec):
-                return self._range(node)
-            if isinstance(node, _FileScanBase):
-                return self._file_scan(node)
-            if isinstance(node, _CachedScanBase):
-                return self._cached_scan(node)
-            if isinstance(node, HostToDeviceExec):
-                return self._host_to_device(node)
-            if isinstance(node, DeviceToHostExec):
-                return self._device_to_host(node)
-            if isinstance(node, (TpuCoalesceBatchesExec,
-                                 CpuCoalesceBatchesExec)):
-                return self._coalesce(node)
-            if isinstance(node, B.CoalescePartitionsExec):
-                return self._coalesce_parts(node)
-            if isinstance(node, (B.TpuProjectExec, B.CpuProjectExec)):
-                return self._project(node)
-            if isinstance(node, (B.TpuFilterExec, B.CpuFilterExec)):
-                return self._filter(node)
-            if isinstance(node, (B.TpuLocalLimitExec, B.CpuLocalLimitExec)):
-                return self._local_limit(node)
-            if isinstance(node, B._GlobalLimitBase):
-                return self._global_limit(node)
-            if isinstance(node, B._UnionBase):
-                return self._union(node)
-            if isinstance(node, _GenerateBase):
-                return self._generate(node)
-            if isinstance(node, _ExpandBase):
-                return self._expand(node)
-            if isinstance(node, _SortBase):
-                return self._sort(node)
-            if isinstance(node, _ExchangeBase):
-                return self._exchange(node)
-            if isinstance(node, _JoinBase):
-                return self._join(node)
-            if isinstance(node, _HashAggregateBase):
-                return self._aggregate(node, node.children[0],
-                                       collapsed=False)
-            if isinstance(node, _WindowBase):
-                return self._window(node)
-            return self._unknown(node)
-        finally:
-            self._depth -= 1
+        if isinstance(node, TpuAdaptiveExec):
+            # transparent: the wrapper only drives stage-by-stage
+            # execution of the subtree it declares
+            return self.visit(node.children[0])
+        if isinstance(node, TpuQueryStageExec):
+            return self._query_stage(node)
+        if isinstance(node, TpuStageReaderExec):
+            return self._stage_reader(node)
+        if isinstance(node, TpuSpmdStageExec):
+            return self._spmd_stage(node)
+        if isinstance(node, TpuFusedStageExec):
+            return self._fused_stage(node)
+        if isinstance(node, B.HostScanExec):
+            return self._host_scan(node)
+        if isinstance(node, B.RangeExec):
+            return self._range(node)
+        if isinstance(node, _FileScanBase):
+            return self._file_scan(node)
+        if isinstance(node, _CachedScanBase):
+            return self._cached_scan(node)
+        if isinstance(node, HostToDeviceExec):
+            return self._host_to_device(node)
+        if isinstance(node, DeviceToHostExec):
+            return self._device_to_host(node)
+        if isinstance(node, (TpuCoalesceBatchesExec,
+                             CpuCoalesceBatchesExec)):
+            return self._coalesce(node)
+        if isinstance(node, B.CoalescePartitionsExec):
+            return self._coalesce_parts(node)
+        if isinstance(node, (B.TpuProjectExec, B.CpuProjectExec)):
+            return self._project(node)
+        if isinstance(node, (B.TpuFilterExec, B.CpuFilterExec)):
+            return self._filter(node)
+        if isinstance(node, (B.TpuLocalLimitExec, B.CpuLocalLimitExec)):
+            return self._local_limit(node)
+        if isinstance(node, B._GlobalLimitBase):
+            return self._global_limit(node)
+        if isinstance(node, B._UnionBase):
+            return self._union(node)
+        if isinstance(node, _GenerateBase):
+            return self._generate(node)
+        if isinstance(node, _ExpandBase):
+            return self._expand(node)
+        if isinstance(node, _SortBase):
+            return self._sort(node)
+        if isinstance(node, _ExchangeBase):
+            return self._exchange(node)
+        if isinstance(node, _JoinBase):
+            return self._join(node)
+        if isinstance(node, _HashAggregateBase):
+            return self._aggregate(node, node.children[0],
+                                   collapsed=False)
+        if isinstance(node, _WindowBase):
+            return self._window(node)
+        return self._unknown(node)
 
     # -- leaves ---------------------------------------------------------------
     def _mk(self, node, rows, parts, nonempty, batches, batch_rows,
@@ -1561,33 +1603,49 @@ class _Analyzer:
 
     # -- single-program SPMD stages ------------------------------------------
     def _spmd_stage(self, node) -> AbsState:
-        """Model one TpuSpmdStageExec: the wrapped subtree is analyzed as
-        the host-loop executor would run it (its estimates stay sound for
-        the runtime fallback path), then the subtree's dispatch interval
-        widens DOWN to the SPMD floor — ONE program dispatch for the whole
-        stage, with host-input assembly issuing none — so the combined
-        interval contains the measured count in BOTH modes. The exchange
-        input's row bound is stashed on the node: it sizes the program's
-        per-target exchange buckets (engine/spmd_exec.py)."""
+        """Model one TpuSpmdStageExec — possibly a CHAIN of segments with
+        lowered joins: the wrapped subtree is analyzed as the host-loop
+        executor would run it (its estimates stay sound for the runtime
+        fallback path), then the subtree's dispatch interval widens DOWN
+        to the SPMD floor — ONE program dispatch for the whole chain,
+        with host-input assembly issuing none — so the combined interval
+        contains the measured count in BOTH modes. Per segment, the
+        exchange's row bound is stashed on the node (per-target bucket
+        sizing) and each lowered join's output row bound on its join spec
+        (expansion sizing); the member exchanges are marked COVERED for
+        the `spmd stages: N of M stages` coverage accounting."""
         before_d = self.report.dispatches
         # save/restore: a NESTED SPMD stage (double group-by) must not
-        # clobber the outer stage's capture slot
-        prev_cap, prev_state = self._spmd_capture, self._spmd_captured
-        self._spmd_capture = node.info.exchange
-        self._spmd_captured = None
+        # clobber the outer stage's capture map
+        prev_map = self._spmd_capture_map
+        cm: Dict[int, Optional[AbsState]] = {}
+        for info in node.infos:
+            cm[id(info.exchange)] = None
+            for jp in info.joins:
+                cm[id(jp.join)] = None
+            self._covered_exchanges.update(
+                id(x) for x in info.covered_exchanges())
+        self._spmd_capture_map = cm
         cin = self.visit(node.children[0])
-        cap_state = self._spmd_captured
-        self._spmd_capture, self._spmd_captured = prev_cap, prev_state
+        self._spmd_capture_map = prev_map
         after_d = self.report.dispatches
         inner_lo = after_d.lo - before_d.lo
         self.report.dispatches = Interval(
             before_d.lo + min(1, inner_lo), after_d.hi)
         self._inexact()
 
-        hint = None
-        if cap_state is not None and cap_state.rows.hi != INF:
-            hint = int(cap_state.rows.hi)
-        node.bucket_rows_hint = hint
+        any_joins = False
+        node.bucket_rows_hints = [None] * len(node.infos)
+        for s, info in enumerate(node.infos):
+            st = cm.get(id(info.exchange))
+            if st is not None and st.rows.hi != INF:
+                node.bucket_rows_hints[s] = int(st.rows.hi)
+            for jp in info.joins:
+                any_joins = True
+                jst = cm.get(id(jp.join))
+                jp.rows_hint = int(jst.rows.hi) \
+                    if jst is not None and jst.rows.hi != INF else None
+        hint = node.bucket_rows_hints[-1]
 
         try:
             import jax
@@ -1601,36 +1659,44 @@ class _Analyzer:
         except Exception:  # pragma: no cover - no backend at plan time
             m = 1
         m_out = 1 if node.info.sort is not None else m
-        inter_bytes = _row_bytes(node.info.exchange.children[0].output,
-                                 self.physical)
-        inter_attrs = node.info.exchange.children[0].output
-        has_strings = any(
-            getattr(a.data_type, "is_string", False)
-            for a in list(inter_attrs) + list(node.output))
-        est_hi = INF
-        if hint is not None:
+        est_total = 0
+        unbounded = any_joins  # join all_gather volume is data-dependent
+        for s, info in enumerate(node.infos):
+            inter_attrs = info.exchange.children[0].output
+            inter_bytes = _row_bytes(inter_attrs, self.physical)
+            has_strings = any(
+                getattr(a.data_type, "is_string", False)
+                for a in list(inter_attrs) + list(info.final.output))
+            h = node.bucket_rows_hints[s]
+            if h is None or has_strings:
+                # string keys travel as padded byte matrices whose width
+                # the plan cannot bound (the runtime pow2-buckets the
+                # actual max length) — only an unbounded METRIC ceiling
+                # is sound. The residency estimate below stays on the
+                # finite per-row-bytes figure: _resident only raises the
+                # pessimistic peak hi, so a width underestimate can at
+                # worst under-warn SPILL_LIKELY
+                unbounded = True
+                continue
             # per-(shard, target) buckets of bucket_cap rows: data +
-            # validity lanes + the live mask; the absorbed sort all_gathers
-            # the merged output (m * received-lanes) to every shard
-            bucket = _bucket(max(hint, 8))
-            est_hi = _mulsafe(m * m * bucket,
-                              inter_bytes + 2 * len(inter_attrs) + 8)
-            if m_out == 1:
+            # validity lanes + the live mask; the absorbed sort
+            # all_gathers the merged output (m * received-lanes) to every
+            # shard
+            bucket = _bucket(max(h, 8))
+            est_total = _addsafe(est_total, _mulsafe(
+                m * m * bucket, inter_bytes + 2 * len(inter_attrs) + 8))
+            if s == len(node.infos) - 1 and m_out == 1:
                 out_bytes = _row_bytes(node.output, self.physical)
-                est_hi = _addsafe(est_hi, _mulsafe(
+                est_total = _addsafe(est_total, _mulsafe(
                     m * m * m * bucket,
                     out_bytes + 2 * len(node.output) + 8))
-        if hint is None or has_strings:
-            # string keys travel as padded byte matrices whose width the
-            # plan cannot bound (the runtime pow2-buckets the actual max
-            # length) — only an unbounded METRIC ceiling is sound. The
-            # residency estimate below stays on the finite per-row-bytes
-            # figure: _resident only raises the pessimistic peak hi, so a
-            # width underestimate can at worst under-warn SPILL_LIKELY
-            coll = Interval(0, INF)
-        else:
-            coll = Interval(0, est_hi)
-        self.report.spmd_stages += 1
+        # `unbounded` widens only the collective-bytes METRIC ceiling
+        # (string matrix widths and join all_gather volume are
+        # data-dependent); the residency estimate below stays on the
+        # finite per-segment sum — _resident only raises the pessimistic
+        # peak hi, so an underestimate can at worst under-warn
+        coll = Interval(0, INF if unbounded else est_total)
+        self.report.spmd_stages += len(node.infos)
         self.report.collective_bytes = self.report.collective_bytes.add(
             coll)
         self._compiles("spmd_stage", node.stage_id, (0,))
@@ -1653,21 +1719,33 @@ class _Analyzer:
         st = self._mk(node, cin.rows, parts, Interval(0, parts), batches,
                       batch_rows, set(), lazy_tail=True,
                       ndv=cin.col_ndv, rng=cin.col_range)
-        # the executor materializes the WHOLE stage input as [m, cap]
-        # mesh-global arrays before the one dispatch — the host-loop
-        # streaming model above never charges that. 2x covers the pow2
-        # slot padding; strings ride the analyzer-wide per-row estimate
-        # (_row_bytes), same as every other string residency figure
-        try:
-            sub = _Analyzer(self.conf, self.budget, donation=self.donation)
-            in_rows = sub.visit(node.info.input_node).rows.hi
-        except Exception:  # pragma: no cover - estimator is best-effort
-            in_rows = INF
-        if in_rows != INF:
-            in_rows = _bucket(max(int(in_rows), 1))
-        in_bytes = _mulsafe(2, _mulsafe(
-            in_rows, _row_bytes(node.info.input_attrs, self.physical)))
-        self._resident(node, _addsafe(est_hi, in_bytes), st,
+        # the executor materializes EVERY stage input — the innermost
+        # segment's probe input and each lowered join's build side — as
+        # [m, cap] mesh-global arrays before the one dispatch; the
+        # host-loop streaming model above never charges that. 2x covers
+        # the pow2 slot padding; a build side additionally replicates to
+        # every shard through the in-program all_gather (x m); strings
+        # ride the analyzer-wide per-row estimate (_row_bytes), same as
+        # every other string residency figure
+        def _table_bytes(input_node, attrs, replicate: int) -> int:
+            try:
+                sub = _Analyzer(self.conf, self.budget,
+                                donation=self.donation)
+                in_rows = sub.visit(input_node).rows.hi
+            except Exception:  # pragma: no cover - best-effort estimator
+                in_rows = INF
+            if in_rows != INF:
+                in_rows = _bucket(max(int(in_rows), 1))
+            return _mulsafe(2 * replicate, _mulsafe(
+                in_rows, _row_bytes(attrs, self.physical)))
+
+        in_bytes = _table_bytes(node.infos[0].input_node,
+                                node.infos[0].input_attrs, 1)
+        for info in node.infos:
+            for jp in info.joins:
+                in_bytes = _addsafe(in_bytes, _table_bytes(
+                    jp.build_input_node, jp.build_attrs, m))
+        self._resident(node, _addsafe(est_total, in_bytes), st,
                        Interval(1, 1))
         return st
 
@@ -1680,8 +1758,10 @@ class _Analyzer:
         )
 
         cin = self.visit(node.children[0])
-        if self._spmd_capture is node:
-            self._spmd_captured = cin
+        if id(node) not in self._covered_exchanges:
+            # a materializing exchange that stays OUTSIDE every SPMD
+            # program is a host-loop stage boundary (coverage line)
+            self.report.host_exchange_stages += 1
         p = node.partitioning
         n_out = p.num_partitions
         row_bytes = cin.row_bytes

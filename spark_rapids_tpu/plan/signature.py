@@ -64,6 +64,10 @@ def plan_signature(plan: "L.LogicalPlan",
         # query (or vice versa) — the adaptive plan carries the
         # TpuAdaptiveExec wrapper and re-optimizes at runtime
         conf_tok += f";__adaptive={bool(conf.get(C.ADAPTIVE_ENABLED))!r}"
+        # same for the RESOLVED spmd flag (default ON since r14): the
+        # lowered plan carries TpuSpmdStageExec wrappers a host-loop
+        # query must never be served
+        conf_tok += f";__spmd={bool(conf.get(C.SPMD_ENABLED))!r}"
         idmap: Dict[int, int] = {}
         ident = _canon_node(plan, idmap, identity=True)
         idmap = {}

@@ -176,6 +176,19 @@ def insert_hash_optimize_sort(plan: PhysicalExec,
     from spark_rapids_tpu.ops.base import AttributeReference, SortOrder
 
     def sort_keys(n: PhysicalExec):
+        from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+
+        if isinstance(n, TpuSpmdStageExec):
+            # the stage program ends in the final hash aggregate (or an
+            # absorbed sort, which already clusters): sort its output by
+            # the grouping keys it actually emits
+            info = n.info
+            if info.sort is not None or not info.final.grouping:
+                return None
+            out_ids = {a.expr_id for a in n.output}
+            return [a for a in info.final.grouping
+                    if isinstance(a, AttributeReference)
+                    and a.expr_id in out_ids]
         if isinstance(n, _HashAggregateBase) and n.grouping:
             return [a for a in n.grouping
                     if isinstance(a, AttributeReference)]

@@ -730,7 +730,8 @@ class TpuSession:
             for name in (M.DEVICE_DISPATCHES, M.RETRIES, M.SPLIT_RETRIES,
                          M.CPU_FALLBACK_EVENTS, M.FETCH_RETRIES, M.FENCES,
                          M.CHECKED_REPLAYS, M.DONATED_BYTES, M.SPMD_STAGES,
-                         M.COLLECTIVE_BYTES, M.PLAN_CACHE_HITS,
+                         M.COLLECTIVE_BYTES, M.SPMD_JOINS,
+                         M.SPMD_MEASURED_CAPS, M.PLAN_CACHE_HITS,
                          M.PLAN_CACHE_MISSES, M.ADMISSION_WAITS,
                          M.ADMISSION_WAIT_NS,
                          M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES,

@@ -334,6 +334,31 @@ def _build_exchange_kernel(mesh: Mesh, dtypes_key: Tuple, pid_spec,
     return jax.jit(smapped)
 
 
+def stack_global(mesh: Mesh, parts, shape_tail, dtype):
+    """Assemble per-shard pieces into ONE [m, ...] mesh-global array.
+    Slot parts may be COMMITTED to different chips (outputs of a previous
+    exchange feeding this one, e.g. join -> groupBy): each part
+    device_puts to its own target shard — never a cross-device stack —
+    and the global assembles zero-copy from the per-device pieces. `None`
+    parts fill with zeros. Shared by the exchange epoch below and the
+    SPMD stage-input assembly (engine/spmd_exec.py)."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    devs = list(mesh.devices.ravel())
+    if jax.process_count() > 1:
+        host = np.stack([
+            # tpulint: host-sync -- multi-process path must host-stage
+            np.asarray(jax.device_get(p)) if p is not None
+            else np.zeros(shape_tail, dtype) for p in parts])
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+    arrs = []
+    for s, p in enumerate(parts):
+        x = p if p is not None else jnp.zeros(shape_tail, dtype)
+        arrs.append(jax.device_put(x[None], devs[s]))
+    return jax.make_array_from_single_device_arrays(
+        (len(parts),) + tuple(shape_tail), sharding, arrs)
+
+
 @jax.jit
 def _string_lens(offsets):
     return offsets[1:] - offsets[:-1]
@@ -420,28 +445,9 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
                 widths[ci] = int(bucket_capacity(max(max(vals, default=1),
                                                      1)))
 
-    # place per-shard padded columns as [m, cap(, W)] globals. Slot parts
-    # may be COMMITTED to different chips (outputs of a previous exchange
-    # feeding this one, e.g. join -> groupBy): each part device_puts to its
-    # own target shard — never a cross-device stack — and the global
-    # assembles zero-copy from the per-device pieces.
+    # place per-shard padded columns as [m, cap(, W)] globals via the
+    # shared zero-copy per-device assembly (stack_global above)
     sharding = NamedSharding(mesh, P(DATA_AXIS))
-    devs = list(mesh.devices.ravel())
-
-    def stack_global(parts, shape_tail, dtype):
-        if jax.process_count() > 1:
-            host = np.stack([
-                # tpulint: host-sync -- multi-process path must host-stage
-                np.asarray(jax.device_get(p)) if p is not None
-                else np.zeros(shape_tail, dtype) for p in parts])
-            return jax.make_array_from_callback(
-                host.shape, sharding, lambda idx: host[idx])
-        arrs = []
-        for s, p in enumerate(parts):
-            x = p if p is not None else jnp.zeros(shape_tail, dtype)
-            arrs.append(jax.device_put(x[None], devs[s]))
-        return jax.make_array_from_single_device_arrays(
-            (len(parts),) + tuple(shape_tail), sharding, arrs)
 
     live_np = np.zeros((m, cap), dtype=bool)
     for s, r in enumerate(rows):
@@ -481,10 +487,11 @@ def ici_exchange(per_map: List[List[ColumnarBatch]], pid_spec,
 
                 phys = jnp.dtype(physical_np_dtype(dtypes[ci]))
         shape = (cap, widths[ci]) if is_str else (cap,)
-        datas.append(stack_global(col_parts, shape, phys))
-        valids.append(stack_global(val_parts, (cap,), jnp.dtype(bool)))
+        datas.append(stack_global(mesh, col_parts, shape, phys))
+        valids.append(stack_global(mesh, val_parts, (cap,),
+                                   jnp.dtype(bool)))
         if is_str:
-            lens_stk[ci] = stack_global(len_parts, (cap,),
+            lens_stk[ci] = stack_global(mesh, len_parts, (cap,),
                                         jnp.dtype(jnp.int32))
 
     lens_in = [lens_stk[ci] for ci in str_cols]

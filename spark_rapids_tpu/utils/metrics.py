@@ -89,6 +89,13 @@ ENCODED_BYTES_SAVED = "encodedBytesSaved"
 # skewSplits counts oversized reduce buckets split into sub-partitions;
 # joinDemotions/joinPromotions count runtime join-strategy switches
 # (shuffled -> broadcast / broadcast -> shuffled)
+# single-program SPMD composition (plan/spmd.py, engine/spmd_exec.py):
+# spmdJoins counts INNER equi-joins lowered INTO a stage program (build
+# broadcast via in-program all_gather); spmdMeasuredCaps counts stage
+# segments whose exchange-bucket capacity came from AQE's MEASURED
+# MapOutputStats instead of the analyzer's pessimistic interval
+SPMD_JOINS = "spmdJoins"
+SPMD_MEASURED_CAPS = "spmdMeasuredCaps"
 AQE_REPLANS = "aqeReplans"
 SKEW_SPLITS = "skewSplits"
 JOIN_DEMOTIONS = "joinDemotions"
@@ -458,6 +465,32 @@ def record_collective_bytes(n: int) -> None:
 
 def collective_bytes() -> int:
     return _COLLECTIVE_BYTES.value
+
+
+_SPMD_JOINS = Metric(SPMD_JOINS)
+_SPMD_MEASURED_CAPS = Metric(SPMD_MEASURED_CAPS)
+
+
+def record_spmd_join(n: int = 1) -> None:
+    """Count one INNER equi-join lowered into an SPMD stage program (the
+    build side broadcast in-program via lax.all_gather)."""
+    _SPMD_JOINS.add(n)
+    _note(SPMD_JOINS, n)
+
+
+def spmd_join_count() -> int:
+    return _SPMD_JOINS.value
+
+
+def record_spmd_measured_cap(n: int = 1) -> None:
+    """Count one SPMD stage segment whose capacities came from AQE's
+    MEASURED MapOutputStats instead of the analyzer's interval."""
+    _SPMD_MEASURED_CAPS.add(n)
+    _note(SPMD_MEASURED_CAPS, n)
+
+
+def spmd_measured_cap_count() -> int:
+    return _SPMD_MEASURED_CAPS.value
 
 
 # ---------------------------------------------------------------------------

@@ -68,9 +68,16 @@ def eight_devices():
 
 @pytest.fixture()
 def session():
-    """A fresh TpuSession per test."""
+    """A fresh TpuSession per test. SPMD stage programs (on by default
+    since r14) compile over a 1-device mesh here: an 8-virtual-device
+    shard_map program costs multi-second XLA compiles per distinct
+    schema on 1-core CI, which the tier-1 dots budget cannot afford for
+    every incidental aggregate. The full-mesh shapes are exercised
+    explicitly (tests/test_spmd.py sets spmd.meshDevices=0), and tests
+    pinning the host-loop executor's metrics disable spmd themselves."""
     import spark_rapids_tpu as srt
 
     s = srt.new_session()
+    s.conf.set("rapids.tpu.sql.spmd.meshDevices", 1)
     yield s
     s.stop()

@@ -81,7 +81,10 @@ def test_auto_policy_follows_fence_cost(session, monkeypatch, fence_ms,
                                  ("v", IntGen(DataType.INT64))], n=400)
             .groupBy("k").agg(F.sum("v").alias("s")),
             ignore_order=True,
-            extra_conf={"rapids.tpu.engine.aggCompactSync": "auto"})
+            # the HOST-LOOP update kernel's policy is under test: keep the
+            # SPMD stage compiler (default on since r14) out of the way
+            extra_conf={"rapids.tpu.engine.aggCompactSync": "auto",
+                        "rapids.tpu.sql.spmd.enabled": False})
     finally:
         devprobe.reset()
     assert seen and all(flag is expect_lazy for flag in seen), seen
@@ -113,7 +116,9 @@ def test_auto_policy_big_batch_stays_compact(session, monkeypatch):
                                  ("v", IntGen(DataType.INT64))], n=300_000)
             .groupBy("k").agg(F.sum("v").alias("s")),
             ignore_order=True,
-            extra_conf={"rapids.tpu.engine.aggCompactSync": "auto"})
+            # host-loop policy pin (see test_auto_policy_follows_fence_cost)
+            extra_conf={"rapids.tpu.engine.aggCompactSync": "auto",
+                        "rapids.tpu.sql.spmd.enabled": False})
     finally:
         devprobe.reset()
     assert seen and all(flag is False for flag in seen), seen

@@ -14,6 +14,10 @@ from tests.harness import assert_tpu_and_cpu_are_equal_collect
 
 AQE_ON = {
     C.ADAPTIVE_ENABLED.key: True,
+    # the AQE rule passes under test fire on HOST-LOOP stage boundaries;
+    # the SPMD stage compiler (default on since r14) would absorb the
+    # join+agg pipelines into one program with nothing left to rewrite
+    "rapids.tpu.sql.spmd.enabled": False,
     # the chaos-scale data is tiny; drop the skew cut so the hot bucket
     # actually counts as skewed
     C.SKEW_JOIN_THRESHOLD.key: 4096,
@@ -340,6 +344,10 @@ def test_adaptive_off_plan_unchanged(session):
 def test_adaptive_plan_carries_wrapper(session):
     from spark_rapids_tpu.aqe.loop import TpuAdaptiveExec
 
+    # host-loop stage boundaries are under test: with the SPMD stage
+    # compiler (default on since r14) the skew query's exchanges lower
+    # in-program and there is nothing left for AQE to re-optimize
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
     session.conf.set(C.ADAPTIVE_ENABLED.key, True)
     try:
         plan = session._physical_plan(_skew_query(session)._plan,
@@ -402,6 +410,7 @@ def test_small_shuffle_writes_one_file_under_aqe(session, tmp_path):
 
 
 def test_explain_adaptive_section(session):
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
     session.conf.set(C.ADAPTIVE_ENABLED.key, True)
     try:
         out = session.explain_plan(_skew_query(session)._plan)

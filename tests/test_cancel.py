@@ -463,6 +463,8 @@ def test_cancel_shed_metrics_flow_to_prometheus():
     try:
         s = server.connect("tenA")
         df = _df(s)
+        # the agg.update poll point only exists on the host loop
+        s.conf.set("rapids.tpu.sql.spmd.enabled", False)
         s.conf.set("rapids.tpu.test.faultInjection.enabled", True)
         s.conf.set("rapids.tpu.test.faultInjection.sites",
                    "agg.update:cancel")
@@ -488,6 +490,8 @@ def test_cancel_shed_metrics_flow_to_prometheus():
 def test_cancelled_query_noted_on_trace(session):
     """cancel/shed/deadline events land on the traced timeline."""
     session.conf.set("rapids.tpu.obs.tracing.enabled", True)
+    # host-loop agg poll point (see test_cancel_shed_metrics_flow...)
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
     session.conf.set("rapids.tpu.test.faultInjection.enabled", True)
     session.conf.set("rapids.tpu.test.faultInjection.sites",
                      "agg.update:cancel")

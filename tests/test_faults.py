@@ -378,6 +378,10 @@ def _assert_chaos_equal(session, df_fn, seed, sites="*", rate=0.3):
 
 
 def test_chaos_q1_oom_everywhere(session):
+    # the host-loop per-operator retry ladders are under test (one SPMD
+    # program reaches almost none of the armed sites; its own ladder is
+    # exercised by the test_chaos_spmd_* cases below)
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
     m = _assert_chaos_equal(session, _tpch_q("q1"), seed=1)
     # at rate 0.3 over every site SOMETHING must have fired and recovered
     assert m["retries"] + m["splitRetries"] + m["cpuFallbackEvents"] > 0
@@ -488,8 +492,11 @@ def test_chaos_hard_failure_falls_back_to_cpu_query(session):
         return df.groupBy("k").agg(F.sum("v").alias("s"))
 
     cpu = run_on_cpu(session, q)
-    tpu = run_on_tpu(session, q, extra_conf=_chaos_conf(
-        seed=0, sites="agg.update", rate=1.0))
+    tpu = run_on_tpu(session, q, extra_conf={
+        # the agg.update dispatch site only exists on the host loop (the
+        # SPMD stage compiler, default on since r14, absorbs the agg)
+        "rapids.tpu.sql.spmd.enabled": False,
+        **_chaos_conf(seed=0, sites="agg.update", rate=1.0)})
     assert_rows_equal(cpu, tpu, ignore_order=True)
     assert session.last_query_metrics["cpuFallbackEvents"] >= 1
 
@@ -511,6 +518,8 @@ def test_circuit_breaker_trips_session_to_cpu(session):
     cpu = run_on_cpu(session, q)
     conf = {
         **_chaos_conf(seed=0, sites="agg.update", rate=1.0),
+        # host-loop agg site under test (see above)
+        "rapids.tpu.sql.spmd.enabled": False,
         "rapids.tpu.execution.circuitBreaker.failureThreshold": 1,
     }
     first = run_on_tpu(session, q, extra_conf=conf)
@@ -679,6 +688,10 @@ _CANCEL_SITES_Q1_FAST = ["transfer.upload", "agg.update", "sort",
 
 @pytest.mark.parametrize("site", _CANCEL_SITES_Q1_FAST)
 def test_cancel_matrix_q1_fast(session, site):
+    # the per-operator host-loop dispatch sites are under test: the SPMD
+    # stage compiler (default on since r14) would absorb agg/sort into
+    # one program that never reaches them
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
     assert _run_cancel_at_site(session, _tpch_q("q1"), site), \
         f"site {site} was never reached by q1"
 
@@ -689,6 +702,8 @@ def test_cancel_during_retry_backoff_reclaims(session):
     import spark_rapids_tpu.utils.metrics as _M
 
     conf = {
+        # host-loop agg dispatch site under test (see the cancel matrix)
+        "rapids.tpu.sql.spmd.enabled": False,
         "rapids.tpu.test.faultInjection.enabled": True,
         "rapids.tpu.test.faultInjection.sites": "agg.update:dispatch",
         "rapids.tpu.test.faultInjection.rate": 1.0,

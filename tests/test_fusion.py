@@ -56,9 +56,15 @@ def assert_fused_unfused_equal(session, df_fn, ignore_order=True,
     toggles TpuFusedStageExec presence, and that fusing never dispatches
     MORE device programs than the per-operator path."""
     cpu = run_on_cpu(session, df_fn)
-    fused = run_on_tpu(session, df_fn, extra_conf={FUSION_KEY: True})
+    # the host-loop fusion machinery is under test: the SPMD stage
+    # compiler (default on since r14) would collapse both modes to the
+    # same one-program dispatch count
+    off = {"rapids.tpu.sql.spmd.enabled": False}
+    fused = run_on_tpu(session, df_fn,
+                       extra_conf={FUSION_KEY: True, **off})
     m_fused = dict(session.last_query_metrics)
-    unfused = run_on_tpu(session, df_fn, extra_conf={FUSION_KEY: False})
+    unfused = run_on_tpu(session, df_fn,
+                         extra_conf={FUSION_KEY: False, **off})
     m_unfused = dict(session.last_query_metrics)
     assert_rows_equal(cpu, fused, ignore_order=ignore_order)
     assert_rows_equal(cpu, unfused, ignore_order=ignore_order)

@@ -25,6 +25,9 @@ from tests.harness import (
 ICI = {
     "rapids.tpu.shuffle.mode": "ici",
     "rapids.tpu.sql.shuffle.partitions": 8,
+    # the STANDALONE ICI exchange tier is under test: keep the SPMD stage
+    # compiler (default on since r14) from absorbing the exchanges
+    "rapids.tpu.sql.spmd.enabled": False,
 }
 SER = {"rapids.tpu.shuffle.serialize.enabled": True}
 

@@ -95,6 +95,9 @@ def test_tracing_adds_zero_dispatches_and_zero_fences(session):
 # Span-tree structure + context propagation on the worker pool
 # ---------------------------------------------------------------------------
 def test_span_tree_structure_and_count_attribution(session):
+    # the host loop's map-stage/task span tree is under test (the SPMD
+    # stage compiler, default on since r14, collapses it to one program)
+    session.set_conf("rapids.tpu.sql.spmd.enabled", False)
     session.set_conf(C.OBS_TRACING.key, True)
     q = _flagship(_mk_df(session, num_partitions=3))
     q.collect()
@@ -364,6 +367,8 @@ def test_metrics_snapshot_and_prometheus_exposition():
 # Traced timelines surface retry / replan / prefetch detail
 # ---------------------------------------------------------------------------
 def test_trace_records_aqe_stage_spans(session):
+    # AQE stage spans exist only for host-loop exchange boundaries
+    session.set_conf("rapids.tpu.sql.spmd.enabled", False)
     session.set_conf(C.OBS_TRACING.key, True)
     session.set_conf(C.ADAPTIVE_ENABLED.key, True)
     session.set_conf(C.SHUFFLE_SERIALIZE.key, True)

@@ -263,6 +263,8 @@ def test_breaker_isolation_across_tenants():
     server = TpuServer()
     try:
         sa = server.connect("storm", settings={
+            # the agg.update dispatch site only exists on the host loop
+            "rapids.tpu.sql.spmd.enabled": False,
             "rapids.tpu.test.faultInjection.enabled": True,
             "rapids.tpu.test.faultInjection.seed": 0,
             "rapids.tpu.test.faultInjection.sites": "agg.update",

@@ -1558,6 +1558,149 @@ def main_encoded() -> None:
         json.dump(summary, fh, indent=1)
         fh.write("\n")
     print(json.dumps(summary))
+    main_encoded_rank()
+
+
+def main_encoded_rank() -> None:
+    """Order-preserving + run-aware flagship (docs/compressed-execution.md,
+    rank-space sections): a SORTED low-cardinality dictionary table runs
+    ORDER BY (range repartition + sort), min/max aggregation, and a
+    run-collapsible group-by, encoded-on vs encoded-off. The acceptance
+    signal is `lateMaterializations` dropping to SINK-ONLY (sort /
+    range-bounds / finalize decodes eliminated — counted against the
+    off-mode's per-operator decode storm), plus the serialized
+    shuffle-byte and runCollapsedRows deltas. Writes BENCH_r15.json."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import spark_rapids_tpu as srt
+    import spark_rapids_tpu.columnar.serde as serde
+    from spark_rapids_tpu.plan import functions as F
+
+    n = int(os.environ.get("SRT_ENCODED_ROWS", "400000"))
+    rng = np.random.default_rng(7)
+    tmpdir = tempfile.mkdtemp(prefix="srt_rank_bench_")
+    path = os.path.join(tmpdir, "sorted_lowcard.parquet")
+    # sorted ship-mode -> pure-RLE index runs (run tables attach);
+    # return-flag random low-ndv (rank-space sort/min-max exercise)
+    pq.write_table(pa.table({
+        "l_shipmode": np.sort(rng.choice(
+            ["AIR", "MAIL", "SHIP", "TRUCK", "RAIL", "FOB", "REG AIR"],
+            size=n)),
+        "l_returnflag": rng.choice(["A", "N", "R"], size=n),
+        "l_quantity": rng.integers(1, 51, size=n),
+        # sorted bucket id: pure-RLE runs AND an integral sum input, so
+        # the run-granular collapse covers count + sum together
+        "l_bucket": np.sort(rng.integers(0, 32, size=n)).astype(np.int64),
+    }), path, use_dictionary=True, row_group_size=n // 8)
+
+    def q_sort(s):
+        # global ORDER BY over dictionary columns: range exchange
+        # (bounds as ranks) + per-partition rank-space sort
+        return (s.read.parquet(path)
+                .groupBy("l_returnflag", "l_shipmode")
+                .agg(F.sum("l_quantity").alias("qty"))
+                .orderBy("l_returnflag", "l_shipmode"))
+
+    def q_minmax(s):
+        # min/max over an encoded column: rank reduction, winning code
+        # carried to the sink
+        return (s.read.parquet(path)
+                .groupBy("l_returnflag")
+                .agg(F.min("l_shipmode").alias("mn"),
+                     F.max("l_shipmode").alias("mx"),
+                     F.count("*").alias("c")))
+
+    def q_runs(s):
+        # sorted low-cardinality group-by over run-tabled columns only:
+        # the run-granular collapse (count -> run-length sums, sum ->
+        # value x run_length)
+        return (s.read.parquet(path)
+                .groupBy("l_shipmode")
+                .agg(F.count("*").alias("c"),
+                     F.sum("l_bucket").alias("b")))
+
+    ser_bytes = [0]
+    orig_serialize = serde.serialize_batch
+
+    def counting(batch):
+        out = orig_serialize(batch)
+        ser_bytes[0] += len(out)
+        return out
+
+    serde.serialize_batch = counting
+    results = {}
+    try:
+        for label, enabled in (("encoded_on", True),
+                               ("encoded_off", False)):
+            session = srt.new_session()
+            session.conf.set("rapids.tpu.shuffle.serialize.enabled", True)
+            session.conf.set("rapids.tpu.sql.encoded.enabled", enabled)
+            # pin the host loop: the rank-space operators under
+            # measurement are the sort/exchange/aggregate execs (the
+            # SPMD chain absorbs them into one program either way)
+            session.conf.set("rapids.tpu.sql.spmd.enabled", False)
+            rec = {}
+            for qname, qfn in (("q_sort", q_sort),
+                               ("q_minmax", q_minmax),
+                               ("q_runs", q_runs)):
+                qfn(session).collect()  # warmup/compile
+                ser_bytes[0] = 0
+                t0 = time.perf_counter()
+                rows = qfn(session).collect()
+                elapsed = time.perf_counter() - t0
+                m = session.last_query_metrics
+                rec[qname] = {
+                    "time_s": elapsed,
+                    "rows_out": len(rows),
+                    "shuffle_serialized_bytes": ser_bytes[0],
+                    "encoded_columns": m.get("encodedColumns", 0),
+                    "late_materializations":
+                        m.get("lateMaterializations", 0),
+                    "order_preserving_sorts":
+                        m.get("orderPreservingSorts", 0),
+                    "run_collapsed_rows": m.get("runCollapsedRows", 0),
+                }
+                _log(f"rank[{label}] {qname}: {elapsed:.3f}s, "
+                     f"lateMat {rec[qname]['late_materializations']}, "
+                     f"opSorts {rec[qname]['order_preserving_sorts']}, "
+                     f"runRows {rec[qname]['run_collapsed_rows']}")
+            results[label] = rec
+            session.stop()
+    finally:
+        serde.serialize_batch = orig_serialize
+    on, off = results["encoded_on"], results["encoded_off"]
+    summary = {
+        "bench": "encoded_rank_flagship",
+        "rows": n,
+        "queries": {
+            "q_sort": "groupBy(flag, shipmode) agg(sum) ORDER BY both "
+                      "(range repartition + sort in rank space)",
+            "q_minmax": "groupBy(flag) agg(min/max shipmode) "
+                        "(rank reduction, sink-only decode)",
+            "q_runs": "groupBy(sorted shipmode) agg(count, sum) "
+                      "(run-granular collapse)",
+        },
+        **results,
+        # acceptance: encoded-on sorts/range/min-max keep decodes at
+        # sink only (counted), and the shuffle-byte delta vs encoded-off
+        "sort_shuffle_bytes_ratio": (
+            off["q_sort"]["shuffle_serialized_bytes"]
+            / max(on["q_sort"]["shuffle_serialized_bytes"], 1)),
+        "sort_late_materializations_delta": (
+            off["q_sort"]["late_materializations"]
+            - on["q_sort"]["late_materializations"]),
+        "minmax_late_materializations": (
+            on["q_minmax"]["late_materializations"]),
+        "run_collapsed_rows": on["q_runs"]["run_collapsed_rows"],
+    }
+    with open("BENCH_r15.json", "w") as fh:
+        json.dump(summary, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(summary))
 
 
 def main_skew() -> None:

@@ -168,10 +168,15 @@ class ColumnVector:
     gather output byte capacities both come from it, which removes the
     per-batch ~66 ms count fences on tunneled backends. Like vrange it
     rides pytree aux data (pow2-bucketed so it rarely retraces).
+
+    `runs` (optional columnar.runs.RunTable, scan-attached) is HOST run
+    metadata for run-granular compute; it deliberately does NOT ride the
+    pytree, so any kernel that rebuilds the column drops it — exactly
+    the invalidation a row-reordering op needs.
     """
 
     __slots__ = ("dtype", "data", "validity", "offsets", "vrange",
-                 "max_len")
+                 "max_len", "runs")
 
     def __init__(self, dtype: DataType, data, validity, offsets=None,
                  vrange=None, max_len=None):
@@ -181,6 +186,7 @@ class ColumnVector:
         self.offsets = offsets
         self.vrange = vrange
         self.max_len = max_len
+        self.runs = None
 
     @property
     def capacity(self) -> int:
@@ -960,7 +966,33 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
             out_cols[ci] = _concat_string_cols(
                 [b.columns[ci] for b in batches],
                 [b.num_rows for b in batches], cap)
+    if all_plain:
+        # scan run tables survive a plain concat: pieces stack in order,
+        # so per-piece run starts shift by the piece's row offset. (The
+        # encoded alignment above already remapped run VALUES into the
+        # union dictionary's code space — _align_encoded_positions.)
+        _concat_run_tables(out_cols, batches)
     return ColumnarBatch(out_cols, total, owned=True)
+
+
+def _concat_run_tables(out_cols, batches) -> None:
+    from spark_rapids_tpu.columnar.runs import RunTable
+
+    for ci, out in enumerate(out_cols):
+        tabs = [b.columns[ci].runs for b in batches]
+        if any(t is None for t in tabs):
+            continue
+        if any(t.num_rows != b.num_rows for t, b in zip(tabs, batches)):
+            continue
+        starts = []
+        values = []
+        base = 0
+        for t in tabs:
+            starts.append(t.starts + base)
+            values.append(np.asarray(t.values))
+            base += t.num_rows
+        out.runs = RunTable(np.concatenate(starts),
+                            np.concatenate(values), base)
 
 
 def _align_encoded_positions(batches):
@@ -986,9 +1018,25 @@ def _align_encoded_positions(batches):
                 if flags[ci][bi]:
                     new_cols[bi][ci] = ENC.materialize(new_cols[bi][ci])
             continue
-        shared, aligned = ENC.align_encoded(
-            [new_cols[bi][ci] for bi in range(len(batches))])
+        originals = [new_cols[bi][ci] for bi in range(len(batches))]
+        shared, aligned = ENC.align_encoded(originals)
         for bi in range(len(batches)):
+            orig = originals[bi]
+            if orig.runs is not None and aligned[bi] is not orig:
+                # the column's codes were remapped into the union
+                # dictionary: remap (or keep) the run-table CODES the
+                # same way, so a stale pre-union run value can never
+                # describe post-union codes
+                from spark_rapids_tpu.columnar.runs import RunTable
+
+                remap = orig.dictionary.remap_to(shared)
+                vals = np.asarray(orig.runs.values)
+                if remap is not None and len(vals):
+                    vals = remap[np.clip(vals, 0, len(remap) - 1)]
+                aligned[bi].runs = RunTable(orig.runs.starts, vals,
+                                            orig.runs.num_rows)
+            elif orig.runs is not None:
+                aligned[bi].runs = orig.runs
             new_cols[bi][ci] = aligned[bi]
         enc_dicts[ci] = shared
     out = [ColumnarBatch(cols, b.num_rows, live=b.live, owned=b.owned)

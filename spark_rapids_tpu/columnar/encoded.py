@@ -82,17 +82,37 @@ def _next_did() -> int:
 
 
 class DeviceDictionary:
-    """One shared dictionary: `size` distinct utf-8 values held as a flat
-    host byte table (control plane: literal lookup, remaps, serde) and a
-    lazily-uploaded device (bytes, offsets) pair (data plane: the
-    materialize gather and the hash word tables). Immutable."""
+    """One shared dictionary: `size` distinct values held as a flat host
+    byte table (control plane: literal lookup, remaps, serde) and a
+    lazily-uploaded device value table (data plane: the materialize gather
+    and the hash word tables). Immutable.
+
+    `value_dtype` is the logical value type. STRING dictionaries hold
+    utf-8 byte values; FIXED dictionaries (INT64/DATE/TIMESTAMP parquet
+    dictionary chunks, ROADMAP item 5) hold the raw little-endian value
+    bytes at a uniform width — byte equality IS value equality either
+    way, so interning, code_of, remaps, and unions are representation-
+    agnostic. Only ordering, materialization, and hashing branch on the
+    value dtype.
+
+    Order-preserving machinery (docs/compressed-execution.md): every
+    dictionary can answer `sorted_dict()` — the interned dictionary
+    holding the SAME values in ascending value order, whose codes are
+    therefore RANKS (code order == value order). `rank_remap()` is the
+    cached code->rank permutation into it (None when this dictionary is
+    already sorted), built once per interned dictionary; consumers
+    re-encode a column through `to_rank_space` and then sorts, range
+    bounds, min/max reductions, and comparison predicates all compute on
+    int32 codes directly."""
 
     __slots__ = ("size", "did", "fingerprint", "host_bytes", "host_offsets",
-                 "host_lens", "max_len", "_lock", "_dev", "_code_of",
-                 "_host_strs", "_hash_words", "_remaps")
+                 "host_lens", "max_len", "value_dtype", "_lock", "_dev",
+                 "_code_of", "_host_strs", "_hash_words", "_remaps",
+                 "_order", "_sorted", "_fixed_dev")
 
     def __init__(self, host_bytes: np.ndarray, host_offsets: np.ndarray,
-                 fingerprint: str):
+                 fingerprint: str,
+                 value_dtype: DataType = DataType.STRING):
         self.size = int(len(host_offsets) - 1)
         self.did = _next_did()
         self.fingerprint = fingerprint
@@ -102,22 +122,32 @@ class DeviceDictionary:
             np.int32)
         self.max_len = len_bucket(int(self.host_lens.max())
                                   if self.size else 1)
+        self.value_dtype = value_dtype
         self._lock = threading.Lock()
         self._dev = None          # (bytes_dev, offsets_dev, lens_dev)
         self._code_of = None      # {value bytes: code}
-        self._host_strs = None    # np object array of str
-        self._hash_words = None   # 3 x uint32 device arrays [cap]
+        self._host_strs = None    # np array of decoded values
+        self._hash_words = None   # uint32 device arrays [cap]
         self._remaps: Dict[int, np.ndarray] = {}  # other.did -> remap table
+        self._order = None        # (order np, rank np, is_sorted)
+        self._sorted = None       # the sorted-value sibling dictionary
+        self._fixed_dev = None    # padded device value table (fixed dicts)
+
+    @property
+    def is_fixed(self) -> bool:
+        return self.value_dtype is not DataType.STRING
 
     # -- constructors --------------------------------------------------------
     @staticmethod
-    def from_byte_table(host_bytes: np.ndarray, host_offsets: np.ndarray
+    def from_byte_table(host_bytes: np.ndarray, host_offsets: np.ndarray,
+                        value_dtype: DataType = DataType.STRING
                         ) -> "DeviceDictionary":
         """Intern a dictionary given its flat byte table (the exact layout
         the parquet dictionary-page parser produces)."""
         host_bytes = np.ascontiguousarray(host_bytes, dtype=np.uint8)
         host_offsets = np.ascontiguousarray(host_offsets, dtype=np.int32)
         h = hashlib.sha1()
+        h.update(value_dtype.name.encode())
         h.update(host_offsets.tobytes())
         h.update(host_bytes[:int(host_offsets[-1])].tobytes())
         fp = h.hexdigest()
@@ -125,12 +155,27 @@ class DeviceDictionary:
             got = _DICT_CACHE.get(fp)
             if got is not None:
                 return got
-        d = DeviceDictionary(host_bytes, host_offsets, fp)
+        d = DeviceDictionary(host_bytes, host_offsets, fp, value_dtype)
         with _DICT_CACHE_LOCK:
             got = _DICT_CACHE.setdefault(fp, d)
             while len(_DICT_CACHE) > _DICT_CACHE_MAX:
                 _DICT_CACHE.pop(next(iter(_DICT_CACHE)))
             return got
+
+    @staticmethod
+    def from_fixed_values(values: np.ndarray,
+                          value_dtype: DataType) -> "DeviceDictionary":
+        """Intern a FIXED-width dictionary (INT64/DATE/TIMESTAMP parquet
+        dictionary chunks): the byte table is the raw little-endian value
+        bytes at the dtype's uniform width."""
+        npdt = value_dtype.to_np()
+        values = np.ascontiguousarray(values, dtype=npdt)
+        w = npdt.itemsize
+        offsets = (np.arange(len(values) + 1, dtype=np.int64) * w)
+        if int(offsets[-1]) > np.iinfo(np.int32).max:
+            raise ValueError("fixed dictionary byte table exceeds int32")
+        return DeviceDictionary.from_byte_table(
+            values.view(np.uint8), offsets.astype(np.int32), value_dtype)
 
     @staticmethod
     def from_values(values: Sequence) -> "DeviceDictionary":
@@ -152,18 +197,33 @@ class DeviceDictionary:
         return self.host_bytes[o[code]:o[code + 1]].tobytes()
 
     def host_values(self) -> np.ndarray:
-        """np object array of str values (cached; the sink expansion and
-        serde read through this)."""
+        """np array of decoded values (object str array for STRING, the
+        value-dtype array for fixed; cached). The sink expansion and
+        serde read through this."""
         with self._lock:
             if self._host_strs is None:
-                out = np.empty(self.size, dtype=object)
-                o = self.host_offsets
-                raw = self.host_bytes.tobytes()
-                for i in range(self.size):
-                    out[i] = raw[o[i]:o[i + 1]].decode(
-                        "utf-8", errors="replace")
-                self._host_strs = out
+                if self.is_fixed:
+                    self._host_strs = self.host_bytes[
+                        :int(self.host_offsets[-1])].view(
+                            self.value_dtype.to_np()).copy()
+                else:
+                    out = np.empty(self.size, dtype=object)
+                    o = self.host_offsets
+                    raw = self.host_bytes.tobytes()
+                    for i in range(self.size):
+                        out[i] = raw[o[i]:o[i + 1]].decode(
+                            "utf-8", errors="replace")
+                    self._host_strs = out
             return self._host_strs
+
+    def _value_key(self, value) -> bytes:
+        """Canonical byte key of one literal value (the representation
+        `code_of` and the union builders compare on)."""
+        if isinstance(value, str):
+            return value.encode("utf-8")
+        if self.is_fixed and isinstance(value, (int, np.integer)):
+            return self.value_dtype.to_np().type(value).tobytes()
+        return bytes(value)
 
     def code_of(self, value) -> int:
         """Code of a literal value, or -1 when absent (a code that can
@@ -175,8 +235,110 @@ class DeviceDictionary:
                 raw = self.host_bytes.tobytes()
                 self._code_of = {raw[o[i]:o[i + 1]]: i
                                  for i in range(self.size)}
-        b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
-        return self._code_of.get(b, -1)
+        return self._code_of.get(self._value_key(value), -1)
+
+    # -- order-preserving views ----------------------------------------------
+    def _order_rank(self):
+        """(order rank->code, rank code->rank, is_sorted), cached once per
+        interned dictionary. STRING values order by utf-8 BYTES — identical
+        to code-point order and to the engine's device byte-matrix
+        comparators (rowkeys.string_order_proxy); fixed values order
+        numerically."""
+        with self._lock:
+            got = self._order
+        if got is not None:
+            return got
+        if self.size == 0:
+            built = (np.zeros(0, np.int32), np.zeros(0, np.int32), True)
+        else:
+            if self.is_fixed:
+                vals = self.host_values()
+            else:
+                o = self.host_offsets
+                raw = self.host_bytes.tobytes()
+                vals = np.array([raw[o[i]:o[i + 1]]
+                                 for i in range(self.size)], dtype=object)
+            order = np.argsort(vals, kind="stable").astype(np.int32)
+            rank = np.empty(self.size, np.int32)
+            rank[order] = np.arange(self.size, dtype=np.int32)
+            built = (order, rank,
+                     bool((order == np.arange(self.size)).all()))
+        with self._lock:
+            if self._order is None:
+                self._order = built
+            return self._order
+
+    @property
+    def is_sorted(self) -> bool:
+        """Code order == value order (the order-preserving property)."""
+        return self._order_rank()[2]
+
+    def rank_codes(self) -> np.ndarray:
+        """int32 code->rank table (identity when already sorted). Always
+        materialized — the SPMD absorbed-sort LUT and host rank transforms
+        read through this."""
+        order, rank, is_sorted = self._order_rank()
+        if is_sorted:
+            return np.arange(self.size, dtype=np.int32)
+        return rank
+
+    def rank_remap(self) -> Optional[np.ndarray]:
+        """code -> rank permutation into `sorted_dict()`'s code space, in
+        the exact shape `apply_remap` consumes (None = identity: this
+        dictionary is already order-preserving)."""
+        order, rank, is_sorted = self._order_rank()
+        return None if is_sorted else rank
+
+    def sorted_dict(self) -> "DeviceDictionary":
+        """The interned dictionary holding the SAME values in ascending
+        value order — its codes are ranks, so every downstream consumer
+        (equality, hashing, joins, serde, materialize) works unchanged
+        while code comparisons become value comparisons. Identity when
+        already sorted; built + interned once per dictionary."""
+        order, rank, is_sorted = self._order_rank()
+        if is_sorted:
+            return self
+        with self._lock:
+            if self._sorted is not None:
+                return self._sorted
+        o = self.host_offsets
+        lens = self.host_lens[order]
+        offsets = np.zeros(self.size + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+        for r, c in enumerate(order):
+            buf[offsets[r]:offsets[r + 1]] = self.host_bytes[o[c]:o[c + 1]]
+        sd = DeviceDictionary.from_byte_table(
+            buf, offsets.astype(np.int32), self.value_dtype)
+        with self._lock:
+            if self._sorted is None:
+                self._sorted = sd
+            return self._sorted
+
+    def count_lt_le(self, value) -> Tuple[int, int]:
+        """(# values < literal, # values <= literal) in VALUE order — the
+        rank thresholds a comparison predicate rewrites its literal to
+        (docs/compressed-execution.md). Works on any dictionary via its
+        sorted order; on a sorted dictionary the counts ARE code-space
+        split points."""
+        order, _rank, _s = self._order_rank()
+        if self.size == 0:
+            return 0, 0
+        if self.is_fixed:
+            svals = self.host_values()[order]
+            v = self.value_dtype.to_np().type(value)
+            return (int(np.searchsorted(svals, v, side="left")),
+                    int(np.searchsorted(svals, v, side="right")))
+        key = self._value_key(value)
+        o = self.host_offsets
+        raw = self.host_bytes.tobytes()
+        lo = hi = 0
+        import bisect
+
+        svals = [raw[o[c]:o[c + 1]] for c in order]
+        lo = bisect.bisect_left(svals, key)
+        hi = bisect.bisect_right(svals, key)
+        return lo, hi
 
     # -- device views --------------------------------------------------------
     def device_values(self):
@@ -197,27 +359,54 @@ class DeviceDictionary:
                              jnp.asarray(lens))
             return self._dev
 
+    def device_fixed_values(self):
+        """Padded device value table of a FIXED dictionary (one upload per
+        interned dictionary) — the materialize gather's source."""
+        assert self.is_fixed
+        with self._lock:
+            got = self._fixed_dev
+        if got is not None:
+            return got
+        cap = bucket_capacity(max(self.size, 1))
+        npdt = self.value_dtype.to_np()
+        buf = np.zeros(cap, dtype=npdt)
+        buf[:self.size] = self.host_values()
+        built = jnp.asarray(buf)
+        with self._lock:
+            if self._fixed_dev is None:
+                self._fixed_dev = built
+            return self._fixed_dev
+
     def device_memory_size(self) -> int:
         total = 0
         if self._dev is not None:
             b, o, l = self._dev
             total += int(b.size + o.size * 4 + l.size * 4)
+        if self._fixed_dev is not None:
+            total += int(self._fixed_dev.size
+                         * self._fixed_dev.dtype.itemsize)
         if self._hash_words is not None:
             total += sum(int(w.size) * 4 for w in self._hash_words)
         return total
 
     def hash_words(self):
-        """Per-entry string hash words (h1, h2, len — the exact triple
-        hashing.string_words derives from the expanded column), one jitted
+        """Per-entry hash words (for STRING the exact (h1, h2, len) triple
+        hashing.string_words derives from the expanded column; for fixed
+        dictionaries the column_words of the value table), one jitted
         computation per dictionary: a row's hash words are then one gather
         by code, so hashing an encoded column is bit-identical to hashing
         its expansion — pieces with DIFFERENT dictionaries (or plain
-        string pieces) still co-partition."""
+        pieces) still co-partition."""
         with self._lock:
             if self._hash_words is not None:
                 return self._hash_words
-        byts, offs, _lens = self.device_values()
-        words = _dict_hash_words_kernel(byts, offs, np.int32(self.size))
+        if self.is_fixed:
+            words = _dict_fixed_hash_words_kernel(
+                self.device_fixed_values(), self.value_dtype,
+                np.int32(self.size))
+        else:
+            byts, offs, _lens = self.device_values()
+            words = _dict_hash_words_kernel(byts, offs, np.int32(self.size))
         with self._lock:
             if self._hash_words is None:
                 self._hash_words = tuple(words)
@@ -243,6 +432,32 @@ class DeviceDictionary:
 
     def __repr__(self):
         return f"DeviceDictionary(size={self.size}, did={self.did})"
+
+
+def _dict_fixed_hash_words_kernel(vals, value_dtype, size):
+    from spark_rapids_tpu.engine.jit_cache import get_or_build
+
+    key = ("dict_fixed_hash_words", value_dtype, int(vals.shape[0]))
+
+    def build():
+        def fn(v, n):
+            from spark_rapids_tpu.ops import hashing as H
+            from spark_rapids_tpu.ops.values import ColV
+
+            cap = v.shape[0]
+            validity = jnp.arange(cap) < n
+            col = ColV(value_dtype, v, validity)
+            return H.column_words(jnp, col)
+
+        return jax.jit(fn)
+
+    def _attempt():
+        M.record_dispatch()
+        return get_or_build(key, build)(vals, jnp.int32(size))
+
+    from spark_rapids_tpu.engine.retry import with_retry
+
+    return with_retry(_attempt, site="encoded.materialize")
 
 
 def _dict_hash_words_kernel(byts, offs, size):
@@ -343,6 +558,21 @@ def materialize(cv: DictionaryColumn,
     assert is_encoded(cv)
     M.record_late_materialization()
     d = cv.dictionary
+    if d.is_fixed:
+        # fixed-value dictionary: one jitted value-table gather
+        vals = d.device_fixed_values()
+
+        def _attempt_fixed():
+            M.record_dispatch()
+            return _materialize_fixed_kernel(vals, cv.data, cv.validity)
+
+        data = with_retry(_attempt_fixed, site=site)
+        vr = None
+        from spark_rapids_tpu.columnar.batch import host_value_range
+
+        if d.size:
+            vr = host_value_range(d.value_dtype, d.host_values())
+        return ColumnVector(cv.dtype, data, cv.validity, vrange=vr)
     byts, offs, lens = d.device_values()
     cap = cv.capacity
     bound = cap * d.max_len
@@ -366,6 +596,12 @@ def materialize(cv: DictionaryColumn,
     out_bytes, out_offs = with_retry(_attempt, site=site)
     return ColumnVector(cv.dtype, out_bytes, cv.validity, out_offs,
                         max_len=d.max_len)
+
+
+@jax.jit
+def _materialize_fixed_kernel(vals, codes, validity):
+    safe = jnp.clip(codes, 0, vals.shape[0] - 1)
+    return jnp.where(validity, vals[safe], jnp.zeros((), vals.dtype))
 
 
 @jax.jit
@@ -404,6 +640,13 @@ def materialize_host_values(codes: np.ndarray, validity: np.ndarray,
     take through the dictionary's host values — the cheap form of late
     materialization (codes crossed the fence, values never did)."""
     M.record_late_materialization()
+    if dictionary.is_fixed:
+        npdt = dictionary.value_dtype.to_np()
+        if dictionary.size == 0:
+            return np.zeros(len(codes), dtype=npdt)
+        vals = dictionary.host_values()
+        out = vals[np.clip(codes, 0, dictionary.size - 1)]
+        return np.where(validity, out, npdt.type(0))
     if dictionary.size == 0:
         return np.full(len(codes), "", dtype=object)
     vals = dictionary.host_values()
@@ -467,6 +710,34 @@ def _remap_kernel(remap, codes, validity):
     return jnp.where(validity, remap[safe], 0).astype(jnp.int32)
 
 
+def to_rank_space(cv: DictionaryColumn) -> DictionaryColumn:
+    """Re-encode a column through its dictionary's SORTED sibling so code
+    order == value order (one jitted permutation gather; identity — zero
+    dispatches — when the dictionary is already order-preserving). The
+    result is an ordinary encoded column over an interned dictionary:
+    every downstream consumer works unchanged, and sorts / range bounds /
+    min-max / comparisons now compute on the codes directly. NOT a decode
+    — lateMaterializations is untouched."""
+    d = cv.dictionary
+    return apply_remap(cv, d.rank_remap(), d.sorted_dict())
+
+
+def batch_to_rank_space(batch: ColumnarBatch, ords) -> ColumnarBatch:
+    """`to_rank_space` over a subset of a batch's encoded columns."""
+    if not ords:
+        return batch
+    cols = list(batch.columns)
+    changed = False
+    for i in ords:
+        if is_encoded(cols[i]) and not cols[i].dictionary.is_sorted:
+            cols[i] = to_rank_space(cols[i])
+            changed = True
+    if not changed:
+        return batch
+    return ColumnarBatch(cols, batch.num_rows, live=batch.live,
+                         owned=batch.owned)
+
+
 def align_encoded(cols: Sequence[DictionaryColumn]
                   ) -> Tuple[DeviceDictionary, List[DictionaryColumn]]:
     """Bring same-position encoded columns of several batches onto ONE
@@ -505,10 +776,43 @@ def align_encoded(cols: Sequence[DictionaryColumn]
         offsets = np.zeros(len(lens) + 1, dtype=np.int32)
         np.cumsum(lens, out=offsets[1:])
         union = DeviceDictionary.from_byte_table(
-            np.concatenate(pieces), offsets)
+            np.concatenate(pieces), offsets, base.value_dtype)
     out = [apply_remap(c, c.dictionary.remap_to(union), union)
            for c in cols]
     return union, out
+
+
+def union_rank_tables(dicts: Sequence[DeviceDictionary]
+                      ) -> Dict[int, np.ndarray]:
+    """{did: int32 code -> GLOBAL rank} over the VALUE UNION of several
+    dictionaries — the host-side transform that makes range-partition
+    bounds comparable across pieces carrying different dictionaries
+    (codes download, values never do). Ranks are dense over the union's
+    distinct values, so ties across dictionaries collapse to one rank
+    and the quantile split points are exact."""
+    if len(dicts) == 1:
+        d = dicts[0]
+        return {d.did: d.rank_codes()}
+    fixed = dicts[0].is_fixed
+    per_dict = []
+    for d in dicts:
+        if fixed:
+            per_dict.append(np.asarray(d.host_values()))
+        else:
+            o = d.host_offsets
+            raw = d.host_bytes.tobytes()
+            per_dict.append([raw[o[i]:o[i + 1]] for i in range(d.size)])
+    if fixed:
+        union = np.unique(np.concatenate(
+            [v for v in per_dict if len(v)])) if any(
+            len(v) for v in per_dict) else np.zeros(0)
+        return {d.did: np.searchsorted(union, vals).astype(np.int32)
+                for d, vals in zip(dicts, per_dict)}
+    union = sorted(set(b for vals in per_dict for b in vals))
+    pos = {b: i for i, b in enumerate(union)}
+    return {d.did: np.asarray([pos[b] for b in vals], dtype=np.int32)
+            if vals else np.zeros(0, np.int32)
+            for d, vals in zip(dicts, per_dict)}
 
 
 def join_remap(stream_dict: DeviceDictionary,
@@ -552,21 +856,58 @@ def _is_str_literal(e) -> bool:
         e.data_type is DataType.STRING or e.value is None)
 
 
-def supported_code_refs(exprs: Sequence, enc_ids, ref_pred, ref_id):
-    """The subset of `enc_ids` whose EVERY reference across `exprs` sits
-    in a code-space-computable position: equality / null-safe equality
-    against a literal, IN over literals, IS [NOT] NULL. Any other use
-    (ordering, LIKE, concat, ...) needs the values — the column must
-    materialize instead.
+_FIXED_DICT_DTYPES = (DataType.INT64, DataType.DATE, DataType.TIMESTAMP)
+
+
+def _is_enc_literal(e, ref) -> bool:
+    """Is `e` a literal translatable into the code space of a reference's
+    value type? STRING columns take string literals; fixed dictionary
+    columns take integral literals of a matching kind (an INT32 literal
+    against an INT64 column is fine — the value embeds exactly)."""
+    from spark_rapids_tpu.ops.literals import Literal
+
+    if not isinstance(e, Literal):
+        return False
+    if e.value is None:
+        return True
+    rdt = ref.data_type
+    if rdt is DataType.STRING:
+        return e.data_type is DataType.STRING
+    if rdt is DataType.INT64:
+        return e.data_type in (DataType.INT32, DataType.INT64)
+    return e.data_type is rdt
+
+
+def classify_code_refs(exprs: Sequence, enc_ids, ref_pred, ref_id):
+    """(code_ids, rank_ids): the subset of `enc_ids` whose EVERY reference
+    across `exprs` sits in a code-space-computable position — equality /
+    null-safe equality against a literal, IN over literals, IS [NOT]
+    NULL, and ORDER comparisons (<, <=, >, >=, i.e. BETWEEN after
+    lowering) against a literal. Ids with at least one ORDER-comparison
+    use land in `rank_ids` (a subset of code_ids): their column must
+    re-encode through the order-preserving sorted dictionary
+    (`to_rank_space`) before the rewritten predicate runs, because code
+    order is not value order on an arbitrary dictionary. Any other use
+    (LIKE, concat, arithmetic, ...) needs the values — the column
+    materializes instead.
 
     Parameterized over the reference node kind so the same walk serves
     bound trees (BoundReference.ordinal — the exec layer) and unbound
     trees (AttributeReference.expr_id — the plan-time analyzer)."""
     from spark_rapids_tpu.ops.literals import Literal
     from spark_rapids_tpu.ops.nulls import IsNotNull, IsNull
-    from spark_rapids_tpu.ops.predicates import EqualNullSafe, EqualTo, In
+    from spark_rapids_tpu.ops.predicates import (
+        EqualNullSafe,
+        EqualTo,
+        GreaterThan,
+        GreaterThanOrEqual,
+        In,
+        LessThan,
+        LessThanOrEqual,
+    )
 
     ok = set(enc_ids)
+    rank = set()
 
     def is_enc_ref(e) -> bool:
         return ref_pred(e) and ref_id(e) in enc_ids
@@ -574,14 +915,23 @@ def supported_code_refs(exprs: Sequence, enc_ids, ref_pred, ref_id):
     def walk(e) -> None:
         if isinstance(e, (EqualTo, EqualNullSafe)):
             l, r = e.left, e.right
-            if is_enc_ref(l) and _is_str_literal(r):
+            if is_enc_ref(l) and _is_enc_literal(r, l):
                 return
-            if is_enc_ref(r) and _is_str_literal(l):
+            if is_enc_ref(r) and _is_enc_literal(l, r):
+                return
+        elif isinstance(e, (LessThan, LessThanOrEqual, GreaterThan,
+                            GreaterThanOrEqual)):
+            l, r = e.left, e.right
+            if is_enc_ref(l) and _is_enc_literal(r, l):
+                rank.add(ref_id(l))
+                return
+            if is_enc_ref(r) and _is_enc_literal(l, r):
+                rank.add(ref_id(r))
                 return
         elif isinstance(e, In):
             if is_enc_ref(e.value) and \
                     all(isinstance(c, Literal) for c in e.candidates) and \
-                    all(_is_str_literal(c) for c in e.candidates):
+                    all(_is_enc_literal(c, e.value) for c in e.candidates):
                 return
         elif isinstance(e, (IsNull, IsNotNull)) and is_enc_ref(e.child):
             return
@@ -593,7 +943,15 @@ def supported_code_refs(exprs: Sequence, enc_ids, ref_pred, ref_id):
 
     for e in exprs:
         walk(e)
-    return ok
+    return ok, rank & ok
+
+
+def supported_code_refs(exprs: Sequence, enc_ids, ref_pred, ref_id):
+    """classify_code_refs restricted to pure code space (no ORDER
+    comparisons admitted) — for callers that cannot re-encode through the
+    sorted dictionary (the SPMD stage's in-trace rewrite)."""
+    ok, rank = classify_code_refs(exprs, enc_ids, ref_pred, ref_id)
+    return ok - rank
 
 
 def bound_supported_refs(exprs: Sequence, enc_ords):
@@ -614,33 +972,93 @@ def unbound_supported_refs(exprs: Sequence, enc_expr_ids):
         lambda e: e.expr_id)
 
 
+def classify_bound_refs(exprs: Sequence, enc_ords):
+    from spark_rapids_tpu.ops.base import BoundReference
+
+    return classify_code_refs(
+        exprs, set(enc_ords),
+        lambda e: isinstance(e, BoundReference),
+        lambda e: e.ordinal)
+
+
+def classify_unbound_refs(exprs: Sequence, enc_expr_ids):
+    from spark_rapids_tpu.ops.base import AttributeReference
+
+    return classify_code_refs(
+        exprs, set(enc_expr_ids),
+        lambda e: isinstance(e, AttributeReference),
+        lambda e: e.expr_id)
+
+
 def rewrite_condition(expr, dict_by_id, ref_pred, ref_id, make_ref):
     """Rewrite a predicate into code space for the references in
-    `dict_by_id` (id -> DeviceDictionary): string literals translate to
-    their dictionary code ONCE here (absent values become -1, a code no
-    row carries), references retype to INT32, and the numeric comparison
-    kernels do the rest. Callers must have proven supportedness with
-    supported_code_refs first."""
+    `dict_by_id` (id -> DeviceDictionary): literals translate to their
+    dictionary code ONCE here (absent values become -1, a code no row
+    carries), references retype to INT32, and the numeric comparison
+    kernels do the rest.
+
+    ORDER comparisons (<, <=, >, >=) rewrite their literal to a RANK
+    THRESHOLD: the caller must have re-encoded the column through the
+    order-preserving sorted dictionary (to_rank_space) and pass THAT
+    dictionary here, so its codes are ranks and `count_lt_le` yields the
+    exact code-space split points (value < x  <=>  code < #{v: v < x}).
+    Callers must have proven supportedness with classify_code_refs
+    first."""
     from spark_rapids_tpu.ops.literals import Literal
     from spark_rapids_tpu.ops.nulls import IsNotNull, IsNull
-    from spark_rapids_tpu.ops.predicates import EqualNullSafe, EqualTo, In
+    from spark_rapids_tpu.ops.predicates import (
+        EqualNullSafe,
+        EqualTo,
+        GreaterThan,
+        GreaterThanOrEqual,
+        In,
+        LessThan,
+        LessThanOrEqual,
+    )
 
     def lit_code(d, lit) -> "Literal":
         if lit.value is None:
             return Literal(None, DataType.INT32)
         return Literal(int(d.code_of(lit.value)), DataType.INT32)
 
+    def rank_lit(d, lit, ref_side: str, cls) -> "Literal":
+        """Rank threshold for one comparison: lt = #{v < x}, le = #{v <=
+        x}. With ranks r in [0, size): v < x <=> r < lt; v <= x <=> r <=
+        le-1; v > x <=> r > le-1; v >= x <=> r >= lt. Mirrored when the
+        reference sits on the RIGHT (lit OP col reads col OP' lit)."""
+        if lit.value is None:
+            return Literal(None, DataType.INT32)
+        lt, le = d.count_lt_le(lit.value)
+        if ref_side == "left":
+            want_lt = cls in (LessThan, GreaterThanOrEqual)
+        else:
+            want_lt = cls in (LessThanOrEqual, GreaterThan)
+        return Literal(int(lt if want_lt else le - 1), DataType.INT32)
+
     def rw(e):
         if isinstance(e, (EqualTo, EqualNullSafe)):
             l, r = e.left, e.right
             if ref_pred(l) and ref_id(l) in dict_by_id and \
-                    _is_str_literal(r):
+                    _is_enc_literal(r, l):
                 d = dict_by_id[ref_id(l)]
                 return type(e)(make_ref(l), lit_code(d, r))
             if ref_pred(r) and ref_id(r) in dict_by_id and \
-                    _is_str_literal(l):
+                    _is_enc_literal(l, r):
                 d = dict_by_id[ref_id(r)]
                 return type(e)(lit_code(d, l), make_ref(r))
+        elif isinstance(e, (LessThan, LessThanOrEqual, GreaterThan,
+                            GreaterThanOrEqual)):
+            l, r = e.left, e.right
+            if ref_pred(l) and ref_id(l) in dict_by_id and \
+                    _is_enc_literal(r, l):
+                d = dict_by_id[ref_id(l)]
+                return type(e)(make_ref(l),
+                               rank_lit(d, r, "left", type(e)))
+            if ref_pred(r) and ref_id(r) in dict_by_id and \
+                    _is_enc_literal(l, r):
+                d = dict_by_id[ref_id(r)]
+                return type(e)(rank_lit(d, l, "right", type(e)),
+                               make_ref(r))
         elif isinstance(e, In):
             v = e.value
             if ref_pred(v) and ref_id(v) in dict_by_id:
@@ -682,13 +1100,16 @@ def rewrite_unbound_condition(expr, dict_by_eid, attr_by_eid):
 # ---------------------------------------------------------------------------
 class FilterPlan:
     """Per-(condition, dictionary-set) filter rewrite: which ordinals stay
-    codes, the rewritten condition, and which must materialize."""
+    codes, which of those must first re-encode through the sorted
+    dictionary (`rank_ords` — ORDER comparisons over them), the rewritten
+    condition, and which must materialize."""
 
-    __slots__ = ("condition", "code_ords", "mat_ords", "sig")
+    __slots__ = ("condition", "code_ords", "rank_ords", "mat_ords", "sig")
 
-    def __init__(self, condition, code_ords, mat_ords, sig):
+    def __init__(self, condition, code_ords, rank_ords, mat_ords, sig):
         self.condition = condition
         self.code_ords = code_ords
+        self.rank_ords = rank_ords
         self.mat_ords = mat_ords
         self.sig = sig
 
@@ -696,19 +1117,22 @@ class FilterPlan:
 def plan_filter(bound_condition, batch: ColumnarBatch) -> Optional[FilterPlan]:
     """None when the batch carries no encoded columns; otherwise the
     code-space rewrite of the condition for the supported ordinals plus
-    the (visible) materialize set for the rest."""
+    the (visible) materialize set for the rest. Ordinals with ORDER
+    comparisons rewrite against the SORTED dictionary — the caller
+    converts those columns with batch_to_rank_space before evaluating."""
     enc = {i: c for i, c in enumerate(batch.columns) if is_encoded(c)}
     if not enc:
         return None
-    ok = bound_supported_refs([bound_condition], enc.keys())
+    ok, rank = classify_bound_refs([bound_condition], enc.keys())
     referenced = _bound_ref_ords(bound_condition)
     mat = sorted((set(enc) - ok) & referenced)
-    dict_by_ord = {i: enc[i].dictionary for i in ok}
+    dict_by_ord = {i: (enc[i].dictionary.sorted_dict() if i in rank
+                       else enc[i].dictionary) for i in ok}
     cond = rewrite_bound_condition(bound_condition, dict_by_ord) \
         if dict_by_ord else bound_condition
     sig = tuple(sorted((i, enc[i].dictionary.did) for i in ok)) + \
-        ("mat",) + tuple(mat)
-    return FilterPlan(cond, frozenset(ok), tuple(mat), sig)
+        ("rank",) + tuple(sorted(rank)) + ("mat",) + tuple(mat)
+    return FilterPlan(cond, frozenset(ok), frozenset(rank), tuple(mat), sig)
 
 
 def enc_sig(batch: ColumnarBatch) -> tuple:
@@ -763,32 +1187,43 @@ def eval_cols(batch: ColumnarBatch, code_ords=()):
 # ---------------------------------------------------------------------------
 class AggEncPlan:
     """Per-(batch dictionaries) update-kernel plan: which input ordinals
-    stay codes, the retyped attrs/keys and code-space filters to bind the
-    kernel with, and which OUTPUT key positions wrap back into
-    DictionaryColumn (the dictionary is gathered only at finalize)."""
+    stay codes (and which of those re-encode through the SORTED
+    dictionary first — `rank_ords`: min/max inputs and order-comparison
+    filters), the retyped attrs/keys and code-space filters to bind the
+    kernel with, and which OUTPUT positions wrap back into
+    DictionaryColumn: grouping keys AND min/max buffers (the dictionary
+    is gathered only at the sink — the finalize decode point is
+    closed)."""
 
-    __slots__ = ("attrs", "key_exprs", "filters", "code_ords", "mat_ords",
-                 "key_dicts", "sig")
+    __slots__ = ("attrs", "key_exprs", "filters", "code_ords", "rank_ords",
+                 "mat_ords", "key_dicts", "buf_dicts", "out_dicts", "sig")
 
-    def __init__(self, attrs, key_exprs, filters, code_ords, mat_ords,
-                 key_dicts, sig):
+    def __init__(self, attrs, key_exprs, filters, code_ords, rank_ords,
+                 mat_ords, key_dicts, buf_dicts, out_dicts, sig):
         self.attrs = attrs
         self.key_exprs = key_exprs
         self.filters = filters
         self.code_ords = code_ords
+        self.rank_ords = rank_ords     # batch ordinals -> to_rank_space
         self.mat_ords = mat_ords
         self.key_dicts = key_dicts     # key position -> DeviceDictionary
+        self.buf_dicts = buf_dicts     # buffer slot -> DeviceDictionary
+        self.out_dicts = out_dicts     # inter position -> DeviceDictionary
         self.sig = sig
 
 
 def plan_agg_update(batch: ColumnarBatch, child_attrs, key_exprs,
-                    input_exprs, filters) -> Optional[AggEncPlan]:
+                    input_exprs, filters, op_names=()) -> Optional[AggEncPlan]:
     """None when the batch has no encoded columns. An encoded column stays
     CODES through the update kernel when its only uses are (a) a bare
     grouping-key reference — grouping on codes partitions rows exactly
     like grouping on values, since codes are injective per dictionary —
-    and (b) code-space-supported filter predicates. Any aggregate-input
-    use needs the values and decodes at the boundary instead."""
+    (b) code-space-supported filter predicates, and (c) a bare MIN/MAX
+    aggregate input: the column re-encodes through the order-preserving
+    sorted dictionary (rank_ords) and the reduction runs over int32 ranks,
+    emitting the winning CODE per group — the value gathers only at the
+    sink. Any other aggregate-input use needs the values and decodes at
+    the boundary instead."""
     from spark_rapids_tpu.ops.base import Alias, AttributeReference
 
     enc = {i: c for i, c in enumerate(batch.columns) if is_encoded(c)}
@@ -807,9 +1242,18 @@ def plan_agg_update(batch: ColumnarBatch, child_attrs, key_exprs,
         return {r.expr_id for r in e.collect(
             lambda x: isinstance(x, AttributeReference))}
 
-    input_refs = set()
-    for e in input_exprs:
-        input_refs |= refs(e)
+    # aggregate inputs: bare min/max references reduce over ranks; every
+    # other input use needs values
+    minmax_eids = set()
+    other_input_refs = set()
+    for xi, e in enumerate(input_exprs):
+        op = op_names[xi] if xi < len(op_names) else None
+        b = e.expr_id if isinstance(e, AttributeReference) else None
+        if op in ("min", "max") and b is not None and b in enc_by_eid:
+            minmax_eids.add(b)
+        else:
+            other_input_refs |= refs(e)
+    minmax_eids -= other_input_refs
     nonbare_key_refs = set()
     for e in key_exprs:
         b = bare_eid(e)
@@ -817,14 +1261,22 @@ def plan_agg_update(batch: ColumnarBatch, child_attrs, key_exprs,
         if b is not None:
             r = r - {b}
         nonbare_key_refs |= r
-    filter_ok = unbound_supported_refs(filters, enc_by_eid.keys()) \
-        if filters else set(enc_by_eid)
+    if filters:
+        filter_ok, filter_rank = classify_unbound_refs(
+            filters, enc_by_eid.keys())
+    else:
+        filter_ok, filter_rank = set(enc_by_eid), set()
     kept_eids = {eid for eid in enc_by_eid
-                 if eid not in input_refs
+                 if eid not in other_input_refs
                  and eid not in nonbare_key_refs
                  and eid in filter_ok}
+    minmax_eids &= kept_eids
+    rank_eids = (minmax_eids | filter_rank) & kept_eids
     code_ords = frozenset(enc_by_eid[eid][0] for eid in kept_eids)
-    referenced = input_refs | nonbare_key_refs
+    rank_ords = frozenset(enc_by_eid[eid][0] for eid in rank_eids)
+    referenced = other_input_refs | nonbare_key_refs | minmax_eids
+    for e in input_exprs:
+        referenced |= refs(e)
     for e in key_exprs:
         b = bare_eid(e)
         if b is not None:
@@ -834,6 +1286,11 @@ def plan_agg_update(batch: ColumnarBatch, child_attrs, key_exprs,
     mat_ords = tuple(sorted(
         enc_by_eid[eid][0] for eid in enc_by_eid
         if eid not in kept_eids and eid in referenced))
+
+    def eff_dict(eid) -> DeviceDictionary:
+        d = enc_by_eid[eid][1].dictionary
+        return d.sorted_dict() if eid in rank_eids else d
+
     attr2_by_eid = {}
     attrs2 = list(child_attrs)
     for eid in kept_eids:
@@ -851,16 +1308,23 @@ def plan_agg_update(batch: ColumnarBatch, child_attrs, key_exprs,
             a2 = attr2_by_eid[b]
             key_exprs2.append(Alias(a2, e.name, e.expr_id)
                               if isinstance(e, Alias) else a2)
-            key_dicts[k] = enc_by_eid[b][1].dictionary
+            key_dicts[k] = eff_dict(b)
         else:
             key_exprs2.append(e)
-    dict_by_eid = {eid: enc_by_eid[eid][1].dictionary
-                   for eid in kept_eids}
+    buf_dicts = {}
+    for xi, e in enumerate(input_exprs):
+        b = e.expr_id if isinstance(e, AttributeReference) else None
+        if b is not None and b in minmax_eids:
+            buf_dicts[xi] = eff_dict(b)
+    dict_by_eid = {eid: eff_dict(eid) for eid in kept_eids}
     filters2 = [rewrite_unbound_condition(f, dict_by_eid, attr2_by_eid)
                 for f in filters] if dict_by_eid else list(filters)
+    out_dicts = dict(key_dicts)
+    for bi, d in buf_dicts.items():
+        out_dicts[len(key_exprs) + bi] = d
     sig = tuple(sorted((i, c.dictionary.did) for i, c in enc.items()))
-    return AggEncPlan(attrs2, key_exprs2, filters2, code_ords, mat_ords,
-                      key_dicts, sig)
+    return AggEncPlan(attrs2, key_exprs2, filters2, code_ords, rank_ords,
+                      mat_ords, key_dicts, buf_dicts, out_dicts, sig)
 
 
 def wrap_batch_cols(batch: ColumnarBatch,
@@ -872,7 +1336,7 @@ def wrap_batch_cols(batch: ColumnarBatch,
     cols = list(batch.columns)
     for i, d in dicts.items():
         c = cols[i]
-        cols[i] = DictionaryColumn(DataType.STRING, c.data, c.validity, d)
+        cols[i] = DictionaryColumn(d.value_dtype, c.data, c.validity, d)
     return ColumnarBatch(cols, batch.num_rows, live=batch.live,
                          owned=batch.owned)
 
@@ -889,10 +1353,24 @@ def scan_encoded_ok(ndv: int, rows: int, max_fraction: float) -> bool:
     return (ndv / rows) <= max_fraction
 
 
+def decoded_bytes_per_row(value_dtype: DataType) -> int:
+    """Per-row device bytes of the DECODED representation an encoded
+    column avoided: the engine-wide string estimate for STRING values,
+    physical width + validity for fixed values. Shared by the measured
+    encodedBytesSaved metric and the analyzer's prediction — the two must
+    stay one formula."""
+    if value_dtype is DataType.STRING:
+        return STR_BYTES_PER_ROW
+    from spark_rapids_tpu.columnar.batch import physical_np_dtype
+
+    return int(physical_np_dtype(value_dtype).itemsize) + 1
+
+
 def record_scan_emission(cv: DictionaryColumn, rows: int) -> None:
     """Metrics at the scan boundary: one encoded column emitted, and the
-    HBM it avoided versus the expanded-string estimate (the deterministic
-    formula the analyzer predicts an interval for)."""
+    HBM it avoided versus the decoded estimate (the deterministic formula
+    the analyzer predicts an interval for)."""
     M.record_encoded_column()
     M.record_encoded_bytes_saved(
-        max(0, rows) * (STR_BYTES_PER_ROW - CODE_BYTES_PER_ROW))
+        max(0, rows) * max(0, decoded_bytes_per_row(
+            cv.dictionary.value_dtype) - CODE_BYTES_PER_ROW))

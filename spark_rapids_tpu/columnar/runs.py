@@ -1,0 +1,236 @@
+"""Run-aware compressed compute: aggregate on RLE runs, not rows.
+
+The parquet reader's dictionary chunks arrive as RLE/bit-packed run
+tables that today always expand to per-row arrays before the first
+operator runs. For sorted / low-cardinality columns — exactly the
+columns dictionary encoding targets — the run count is a small fraction
+of the row count, and "GPU Acceleration of SQL Analytics on Compressed
+Data" (PAPERS.md) shows the win of computing per RUN: filters evaluate
+one predicate per run and aggregates accumulate value x run_length per
+run.
+
+This module implements that as a REWRITE, not a new kernel family: when
+every column an aggregate's keys / inputs / collapsed filters reference
+carries a host `RunTable` (attached by io/parquet_device.py for pure-RLE
+no-null dictionary chunks), the update batch collapses to ONE ROW PER
+MERGED RUN (the union of all referenced columns' run boundaries, so
+every referenced column is constant within each merged run) plus a
+synthetic `__run_len` column, and the aggregate's ordinary update kernel
+runs over it with its input expressions rewritten:
+
+    sum(e)   ->  sum(e * __run_len)          (exact for integral sums:
+                                              modular multiply == modular
+                                              repeated addition)
+    count(e) ->  sum(IF(e IS NOT NULL, __run_len, 0))
+    min/max/any/first/last: unchanged (run-constant)
+    filters / grouping keys: unchanged (evaluate once per run)
+
+Everything downstream — code-space planning over DictionaryColumn run
+values, rank-space min/max, group-id assignment, donation, retry — is
+the ordinary row-space machinery, just over `runs` rows instead of
+`rows`. The path is gated by `rapids.tpu.sql.runAware.enabled` and the
+`runAware.maxRunFraction` ratio (a batch whose merged run count does not
+clear it falls back to row space), and the collapse is recorded in the
+`runCollapsedRows` metric.
+
+Float sums are EXCLUDED on purpose: v * n rounds differently from n
+additions of v, and the engine's oracle-equality contract is exact
+where the CPU oracle is exact. Holistic aggregates (percentile) are
+excluded because a run is not a multiset expansion under them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    HostColumnarBatch,
+    HostColumnVector,
+)
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.utils import metrics as M
+
+RUN_LEN_NAME = "__run_len"
+
+# ops the run rewrite can serve; everything else falls back to row space
+_RUN_OK_OPS = frozenset({"sum", "count", "min", "max", "any", "first",
+                         "last", "first_ignore_nulls",
+                         "last_ignore_nulls"})
+
+
+class RunTable:
+    """Host run table of one scan column: `starts[i]` is the first row of
+    run i (ascending, starts[0] == 0), `values[i]` its constant value —
+    raw values for a plain column, int32 CODES for a DictionaryColumn.
+    Covers rows [0, num_rows) with no holes and NO NULLS (the scan only
+    attaches tables to all-present chunks). Host metadata only — never
+    uploaded; any device op that rebuilds a column drops it (the pytree
+    unflatten does not carry it), which is exactly the invalidation
+    run-consumers need."""
+
+    __slots__ = ("starts", "values", "num_rows")
+
+    def __init__(self, starts: np.ndarray, values: np.ndarray,
+                 num_rows: int):
+        self.starts = np.asarray(starts, dtype=np.int64)
+        self.values = values
+        self.num_rows = int(num_rows)
+
+    @property
+    def num_runs(self) -> int:
+        return int(len(self.starts))
+
+    def __repr__(self):
+        return f"RunTable(runs={self.num_runs}, rows={self.num_rows})"
+
+
+def runs_ok(n_runs: int, rows: int, max_fraction: float) -> bool:
+    """The collapse is worth it only when runs are a small fraction of
+    rows (the run-length factor IS the speedup)."""
+    if rows <= 0 or n_runs <= 0:
+        return False
+    return (n_runs / rows) <= max_fraction
+
+
+class CollapsedUpdate:
+    """One collapsed update batch + the rewritten kernel inputs."""
+
+    __slots__ = ("batch", "attrs", "input_exprs", "op_names", "collapsed")
+
+    def __init__(self, batch, attrs, input_exprs, op_names, collapsed):
+        self.batch = batch
+        self.attrs = attrs
+        self.input_exprs = input_exprs
+        self.op_names = op_names
+        self.collapsed = collapsed
+
+
+def _referenced_ordinals(child_attrs, exprs) -> Optional[set]:
+    """Batch ordinals referenced by `exprs`, or None when something does
+    not resolve against the child schema."""
+    from spark_rapids_tpu.ops.base import AttributeReference
+
+    by_eid = {a.expr_id: i for i, a in enumerate(child_attrs)}
+    out = set()
+    for e in exprs:
+        for r in e.collect(lambda x: isinstance(x, AttributeReference)):
+            o = by_eid.get(r.expr_id)
+            if o is None:
+                return None
+            out.add(o)
+    return out
+
+
+def _intlike(dt: DataType) -> bool:
+    try:
+        return np.dtype(dt.to_np()).kind in "iu"
+    except Exception:
+        return False
+
+
+def collapse_update(batch: ColumnarBatch, child_attrs, key_exprs,
+                    input_exprs: Sequence, op_names: Sequence[str],
+                    filters, max_fraction: float
+                    ) -> Optional[CollapsedUpdate]:
+    """Try to collapse one aggregate-update input batch to run space.
+    Returns None whenever ANY eligibility condition fails — the caller
+    keeps the ordinary row-space path."""
+    from spark_rapids_tpu.columnar import encoded as ENC
+    from spark_rapids_tpu.ops.base import AttributeReference
+    from spark_rapids_tpu.ops.cast import Cast
+    from spark_rapids_tpu.ops.conditional import If
+    from spark_rapids_tpu.ops.arithmetic import Multiply
+    from spark_rapids_tpu.ops.literals import Literal
+    from spark_rapids_tpu.ops.nulls import IsNotNull
+
+    if batch.live is not None or not batch.rows_on_host:
+        return None
+    rows = batch.num_rows
+    if rows <= 0:
+        return None
+    for op in op_names:
+        if op not in _RUN_OK_OPS:
+            return None
+    referenced = _referenced_ordinals(
+        child_attrs, list(key_exprs) + list(input_exprs) + list(filters))
+    if referenced is None:
+        return None
+    run_tabs: Dict[int, RunTable] = {}
+    for o in referenced:
+        if o >= len(batch.columns):
+            return None
+        rt = getattr(batch.columns[o], "runs", None)
+        if rt is None or rt.num_rows != rows:
+            return None
+        run_tabs[o] = rt
+    # sum rewrites multiply value x length: exact only for integral
+    # accumulators (float rounding differs from repeated addition)
+    for op, e in zip(op_names, input_exprs):
+        if op == "sum" and not _intlike(e.data_type):
+            return None
+    # merged boundaries: every referenced column is constant within each
+    if run_tabs:
+        bounds = np.unique(np.concatenate(
+            [rt.starts for rt in run_tabs.values()]))
+    else:
+        bounds = np.zeros(1, dtype=np.int64)
+    n_runs = int(len(bounds))
+    if not runs_ok(n_runs, rows, max_fraction):
+        return None
+
+    lengths = np.diff(np.concatenate(
+        [bounds, np.asarray([rows], np.int64)]))
+    host_cols: List[HostColumnVector] = []
+    for o, (a, cv) in enumerate(zip(child_attrs, batch.columns)):
+        if o in run_tabs:
+            rt = run_tabs[o]
+            sel = np.searchsorted(rt.starts, bounds, side="right") - 1
+            vals = np.asarray(rt.values)[sel]
+            valid = np.ones(n_runs, dtype=bool)
+            if ENC.is_encoded(cv):
+                host_cols.append(ENC.HostDictionaryColumn(
+                    a.data_type, vals.astype(np.int32), valid,
+                    cv.dictionary))
+            elif a.data_type is DataType.STRING:
+                host_cols.append(HostColumnVector(
+                    DataType.STRING, vals.astype(object), valid))
+            else:
+                host_cols.append(HostColumnVector(
+                    a.data_type, vals.astype(a.data_type.to_np()), valid))
+        elif a.data_type is DataType.STRING:
+            # unreferenced: dead all-null placeholder (never evaluated)
+            host_cols.append(HostColumnVector(
+                DataType.STRING, np.full(n_runs, "", dtype=object),
+                np.zeros(n_runs, dtype=bool)))
+        else:
+            host_cols.append(HostColumnVector(
+                a.data_type, np.zeros(n_runs, dtype=a.data_type.to_np()),
+                np.zeros(n_runs, dtype=bool)))
+    host_cols.append(HostColumnVector(
+        DataType.INT64, lengths.astype(np.int64),
+        np.ones(n_runs, dtype=bool)))
+    run_batch = HostColumnarBatch(host_cols, n_runs).to_device()
+
+    len_attr = AttributeReference(RUN_LEN_NAME, DataType.INT64, False)
+    attrs2 = list(child_attrs) + [len_attr]
+    exprs2: List = []
+    ops2: List[str] = []
+    for op, e in zip(op_names, input_exprs):
+        if op == "sum":
+            rhs = len_attr if e.data_type is DataType.INT64 \
+                else Cast(len_attr, e.data_type)
+            exprs2.append(Multiply(e, rhs))
+            ops2.append("sum")
+        elif op == "count":
+            exprs2.append(If(IsNotNull(e), len_attr,
+                             Literal(0, DataType.INT64)))
+            ops2.append("sum")
+        else:
+            exprs2.append(e)
+            ops2.append(op)
+    M.record_run_collapsed_rows(rows - n_runs)
+    return CollapsedUpdate(run_batch, attrs2, exprs2, tuple(ops2),
+                           rows - n_runs)

@@ -57,10 +57,13 @@ _DTYPE_CODE = {
 _CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
 # DECIMAL(p,s): code 11, (p << 8) | s in the header's u16 extra field.
 _DECIMAL_CODE = 11
-# Dictionary-encoded STRING: code 12. Payload after the validity bits is
-# codes int32[n], then ndv u32, dict offsets int32[ndv+1], dict utf-8
-# bytes — ONE dictionary copy per piece instead of n expanded strings
-# (columnar/encoded.py; the compressed-shuffle representation).
+# Dictionary-encoded column: code 12. Payload after the validity bits is
+# codes int32[n], then ndv u32, dict offsets int32[ndv+1], dict value
+# bytes — ONE dictionary copy per piece instead of n expanded values
+# (columnar/encoded.py; the compressed-shuffle representation). The
+# header's u16 extra field carries the VALUE dtype's wire code (utf-8
+# byte values for STRING; raw little-endian fixed-width values for
+# INT64/DATE/TIMESTAMP dictionary chunks); 0 is legacy-STRING.
 _DICT_STRING_CODE = 12
 
 
@@ -155,7 +158,8 @@ def serialize_batch(batch: HostColumnarBatch) -> bytes:
                 dbytes.tobytes(),
             ])
             plen = sum(len(p) for p in payload)
-            headers.append(_COLHDR.pack(_DICT_STRING_CODE, 1, 0, plen))
+            vcode, _ = _dtype_code(col.dictionary.value_dtype)
+            headers.append(_COLHDR.pack(_DICT_STRING_CODE, 1, vcode, plen))
             parts.extend(payload)
             continue
         if col.dtype is DataType.STRING:
@@ -190,8 +194,10 @@ def deserialize_batch(buf: bytes) -> HostColumnarBatch:
     vbytes = (n + 7) // 8
     cols: List[HostColumnVector] = []
     for code, extra, plen in col_meta:
-        dt = DataType.STRING if code == _DICT_STRING_CODE else \
-            _code_dtype(code, extra)
+        if code == _DICT_STRING_CODE:
+            dt = _code_dtype(extra, 0) if extra else DataType.STRING
+        else:
+            dt = _code_dtype(code, extra)
         end = off + plen
         validity = np.unpackbits(
             np.frombuffer(mv, dtype=np.uint8, count=vbytes, offset=off),
@@ -214,7 +220,7 @@ def deserialize_batch(buf: bytes) -> HostColumnarBatch:
             dbytes = np.frombuffer(mv, dtype=np.uint8,
                                    count=int(offsets[ndv]),
                                    offset=p).copy()
-            d = DeviceDictionary.from_byte_table(dbytes, offsets)
+            d = DeviceDictionary.from_byte_table(dbytes, offsets, dt)
             cols.append(HostDictionaryColumn(dt, codes, validity, d))
             off = end
             continue

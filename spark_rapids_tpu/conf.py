@@ -1048,6 +1048,38 @@ ENCODED_MAX_DICT_FRACTION = _conf("rapids.tpu.sql.encoded.maxDictFraction").doc(
     "dictionary residency twice)."
 ).check(lambda v: None if 0.0 < v <= 1.0 else "must be in (0,1]").double(0.5)
 
+ENCODED_FIXED_DICTIONARIES = _conf(
+    "rapids.tpu.sql.encoded.fixedDictionaries.enabled").doc(
+    "Admit INT64 / DATE / TIMESTAMP dictionary-encoded parquet chunks as "
+    "ENCODED columns under the same maxDictFraction eligibility as "
+    "strings: codes stay int32 in HBM with a shared fixed-value "
+    "dictionary, group-bys run on codes, sorts / range bounds / min-max "
+    "and comparison predicates run in rank space through the "
+    "order-preserving sorted dictionary, and materialize() is one "
+    "value-table gather. Off limits encoded emission to STRING columns "
+    "(the PR 9 behavior)."
+).boolean(True)
+
+RUN_AWARE_ENABLED = _conf("rapids.tpu.sql.runAware.enabled").doc(
+    "Run-granular aggregate fast path (columnar/runs.py): when every "
+    "column an aggregate update's keys / inputs / collapsed filters "
+    "reference carries a host RLE run table from the parquet scan "
+    "(pure-RLE, no-null dictionary chunks), the update batch collapses "
+    "to one row per merged run plus a __run_len column — filters "
+    "evaluate one predicate per run, integral sums become value x "
+    "run_length, counts become sums of run lengths — before the "
+    "ordinary update kernel runs. Falls back to row space whenever any "
+    "eligibility condition fails (metric: runCollapsedRows)."
+).boolean(True)
+
+RUN_AWARE_MAX_RUN_FRACTION = _conf(
+    "rapids.tpu.sql.runAware.maxRunFraction").doc(
+    "The run collapse engages only when merged runs / rows is at or "
+    "below this fraction: the run-length factor IS the win, and a "
+    "near-unique column would pay the collapse (host boundary merge + "
+    "re-upload) for nothing."
+).check(lambda v: None if 0.0 < v <= 1.0 else "must be in (0,1]").double(0.5)
+
 
 # ---------------------------------------------------------------------------
 # Observability: query tracing + engine telemetry (spark_rapids_tpu/obs/,

@@ -626,17 +626,15 @@ def _plan_prod(exprs, attrs, kinds, dicts):
 
 
 def _rank_lut(d):
-    """code -> lexicographic(byte-order) rank, for sorting on CODES: the
-    absorbed sort tail orders an encoded key exactly as the byte-matrix
-    sort would order the decoded values."""
+    """code -> rank LUT for sorting on CODES: the absorbed sort tail
+    orders an encoded key exactly as the byte-matrix sort would order the
+    decoded values. Backed by the SHARED order-preserving machinery
+    (DeviceDictionary.rank_codes — built + cached once per interned
+    dictionary, the same table exec/sort and the range exchange use)
+    instead of a stage-local argsort."""
     if d.size == 0:
         return jnp.zeros((1,), jnp.int32)
-    vals = d.host_values()
-    enc = np.array([str(v).encode("utf-8") for v in vals], dtype=object)
-    order = np.argsort(enc, kind="stable")
-    rank = np.empty(d.size, np.int32)
-    rank[order] = np.arange(d.size, dtype=np.int32)
-    return jnp.asarray(rank)
+    return jnp.asarray(d.rank_codes())
 
 
 # ---------------------------------------------------------------------------
@@ -1397,6 +1395,10 @@ def _execute_stage_impl(node, ctx, holder):
     gi = iter(got[m:])
     M.record_collective_bytes(int(coll))
     M.record_spmd_stage(len(infos))
+    if segs and segs[-1].sort_luts:
+        # the absorbed sort tail ordered encoded keys through the shared
+        # code->rank LUT — the in-program form of the rank-space sort
+        M.record_order_preserving_sort()
     if total_joins:
         M.record_spmd_join(total_joins)
     if measured_used:

@@ -422,7 +422,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
 
     # -- assembling an intermediate [keys+buffers] device batch --------------
     def _assemble(self, key_cols, buf_outs, gi, capacity,
-                  key_vranges=None) -> ColumnarBatch:
+                  key_vranges=None, buf_dicts=None) -> ColumnarBatch:
+        """buf_dicts: buffer slot -> DeviceDictionary for min/max buffers
+        reduced over RANKS — those slots hold int32 CODES of the (sorted)
+        dictionary and wrap back into DictionaryColumn; the winning value
+        gathers only at the sink."""
         # tpulint: host-sync -- merge-side group count at the blocking
         # aggregate boundary; sizes the assembled intermediate batch
         n_groups = int(jax.device_get(gi.num_groups))
@@ -446,7 +450,9 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     cols[i].vrange = vr
         fixed: List[Tuple[int, Tuple[Any, Any], Any]] = []
         slots: List[Optional[ColumnVector]] = []
-        for out, battr in zip(buf_outs, self.buffer_attrs):
+        enc_slots: Dict[int, Any] = {}
+        for bi, (out, battr) in enumerate(zip(buf_outs,
+                                              self.buffer_attrs)):
             if len(out) == 2 and getattr(out[1], "is_string", False):
                 # string min/max: (arg-row per group, source string ColV) —
                 # gather the winning row's string per group (the ColV rides
@@ -458,6 +464,13 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     capacity)
                 g = gather_batch(src, sel, n_groups, unique_indices=True)
                 slots.append(g.columns[0])
+                continue
+            if buf_dicts and bi in buf_dicts:
+                # rank-reduced min/max: the per-group winner is an int32
+                # CODE of the sorted dictionary — stays encoded
+                enc_slots[len(slots)] = buf_dicts[bi]
+                fixed.append((len(slots), out, DataType.INT32))
+                slots.append(None)
                 continue
             fixed.append((len(slots), out, battr.data_type))
             slots.append(None)
@@ -476,7 +489,16 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                                self.metrics[M.TOTAL_TIME]):
                 outs = with_retry(_attempt, site="agg.finalize")
             for (si, _o, dt), (d, v) in zip(fixed, outs):
-                slots[si] = ColumnVector(dt, d, v)
+                if si in enc_slots:
+                    from spark_rapids_tpu.columnar.encoded import (
+                        DictionaryColumn,
+                    )
+
+                    dct = enc_slots[si]
+                    slots[si] = DictionaryColumn(dct.value_dtype, d, v,
+                                                 dct)
+                else:
+                    slots[si] = ColumnVector(dt, d, v)
         assert all(c is not None for c in slots)
         cols.extend(slots)
         return ColumnarBatch(cols, n_groups)
@@ -572,22 +594,44 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 return np.int32(n)  # host count: no eager device convert
             return jnp.asarray(n, dtype=jnp.int32)
 
+        merge_op_names = [op for op, _ in self._merge_ops()]
+
         def merge(batch: ColumnarBatch) -> ColumnarBatch:
             from spark_rapids_tpu.columnar import encoded as ENC
 
             # encoded KEY columns merge on their codes (concat already
-            # aligned every piece onto one dictionary per position); any
-            # encoded non-key column decodes at this boundary
-            stray = tuple(i for i in range(n_keys, batch.num_columns)
-                          if ENC.is_encoded(batch.columns[i]))
+            # aligned every piece onto one dictionary per position);
+            # encoded MIN/MAX buffers merge over RANKS — the column
+            # re-encodes through the sorted dictionary (identity when the
+            # update side already emitted sorted-dict codes) and the
+            # reduction is a plain int32 segment min/max; any other
+            # encoded buffer decodes at this boundary
+            enc_buf_pos = []
+            stray = []
+            for i in range(n_keys, batch.num_columns):
+                if not ENC.is_encoded(batch.columns[i]):
+                    continue
+                bi = i - n_keys
+                if bi < len(merge_op_names) and \
+                        merge_op_names[bi] in ("min", "max"):
+                    enc_buf_pos.append(i)
+                else:
+                    stray.append(i)
             if stray:
                 # tpulint: eager-materialize -- merge-side BUFFER
-                # columns have no code-space reduction; keys stay codes
-                batch = ENC.batch_with_materialized(batch, stray)
+                # columns outside min/max have no code-space reduction;
+                # keys and min/max buffers stay codes
+                batch = ENC.batch_with_materialized(batch, tuple(stray))
+            if enc_buf_pos:
+                batch = ENC.batch_to_rank_space(batch, enc_buf_pos)
             enc_keys = {i: batch.columns[i].dictionary
                         for i in range(min(n_keys, batch.num_columns))
                         if ENC.is_encoded(batch.columns[i])}
-            enc_sig = tuple(sorted(enc_keys))
+            buf_dicts = {i - n_keys: batch.columns[i].dictionary
+                         for i in enc_buf_pos}
+            enc_sig = tuple(sorted(enc_keys)) + ("buf",) + \
+                tuple(sorted(buf_dicts))
+            m_lazy = lazy and not enc_keys and not buf_dicts
             nc = str_chunks(batch, str_merge_ords)
             # capture the kernel in a local: the memo slot is shared by
             # concurrent partition tasks, and _attempt must dispatch the
@@ -596,11 +640,12 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             memo = merge_kernel[0]
             if memo is None or memo[0] != (nc, enc_sig):
                 memo = ((nc, enc_sig),
-                        self._build_merge_kernel(n_keys, lazy, nc,
+                        self._build_merge_kernel(n_keys, m_lazy, nc,
                                                  enc_sig))
                 merge_kernel[0] = memo
             kern = memo[1]
-            cols = ENC.eval_cols(batch, frozenset(enc_keys)) if enc_keys \
+            code_ords = frozenset(enc_keys) | frozenset(enc_buf_pos)
+            cols = ENC.eval_cols(batch, code_ords) if code_ords \
                 else [_col_to_colv(c) for c in batch.columns]
             kvr = [c.vrange for c in batch.columns[:n_keys]]
 
@@ -611,12 +656,13 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             with M.trace_range("TpuHashAggregate.merge",
                                self.metrics[M.TOTAL_TIME]):
                 out = with_retry(_attempt, site="agg.merge")
-            if lazy:
+            if m_lazy:
                 outs, num_groups = out
                 merged = self._lazy_batch(outs, num_groups, kvr)
             else:
                 k, b, gi = out
-                merged = self._assemble(k, b, gi, batch.capacity, kvr)
+                merged = self._assemble(k, b, gi, batch.capacity, kvr,
+                                        buf_dicts=buf_dicts)
             return ENC.wrap_batch_cols(merged, enc_keys)
 
         # un-compacted (lazy) update output keeps the INPUT batch capacity;
@@ -630,6 +676,10 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
             (physical_np_dtype(a.data_type).itemsize + 1)
             for a in self._inter_attrs) or 1
         lazy_out_cap_bytes = LAZY_PIECE_CAP_BYTES
+
+        run_aware = do_update and self.placement == "tpu" and \
+            ctx.conf.get(C.RUN_AWARE_ENABLED)
+        run_fraction = ctx.conf.get(C.RUN_AWARE_MAX_RUN_FRACTION)
 
         def agg_partition(pidx: int):
             from spark_rapids_tpu.columnar.batch import ensure_compact
@@ -645,14 +695,34 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                 if batch.rows_on_host and batch.num_rows == 0:
                     continue
                 batch = ensure_compact(batch)
+                # run-granular collapse (columnar/runs.py): when every
+                # referenced column carries a scan run table, aggregate
+                # one row per merged run (sum -> value x run_length),
+                # through the SAME update kernel machinery
+                eff_inputs, eff_ops, run_key = input_exprs, op_names, False
+                eff_child_attrs = child_attrs
+                if run_aware and do_update:
+                    from spark_rapids_tpu.columnar import runs as RUNS
+
+                    cu = RUNS.collapse_update(
+                        batch, child_attrs, key_exprs, input_exprs,
+                        op_names, filters, run_fraction)
+                    if cu is not None:
+                        batch = cu.batch
+                        eff_inputs = cu.input_exprs
+                        eff_ops = cu.op_names
+                        eff_child_attrs = cu.attrs
+                        run_key = True
                 if do_update:
                     from spark_rapids_tpu.columnar import encoded as ENC
 
                     # encoded columns group directly on their CODES when
                     # their only uses are bare grouping keys + code-space
-                    # filters (columnar/encoded.py); aggregate-input uses
-                    # decode here, visibly
-                    ekey = ENC.enc_sig(batch)
+                    # filters, and min/max aggregate inputs reduce over
+                    # RANKS through the sorted dictionary
+                    # (columnar/encoded.py); any other aggregate-input
+                    # use decodes here, visibly
+                    ekey = (run_key,) + ENC.enc_sig(batch)
                     if ekey in enc_plan_memo:
                         enc_plan = enc_plan_memo[ekey]
                     else:
@@ -661,24 +731,27 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                         # (dictionaries are interned)
                         enc_plan = enc_plan_memo[ekey] = \
                             ENC.plan_agg_update(
-                                batch, child_attrs, key_exprs,
-                                input_exprs, filters)
+                                batch, eff_child_attrs, key_exprs,
+                                eff_inputs, filters, eff_ops)
                     if enc_plan is not None:
                         # tpulint: eager-materialize -- aggregate
-                        # INPUT expressions (sum/min over the
-                        # column) need values; keys stay codes
+                        # INPUT expressions outside bare min/max
+                        # need values; keys + min/max inputs stay codes
                         batch = ENC.batch_with_materialized(
                             batch, enc_plan.mat_ords)
+                        batch = ENC.batch_to_rank_space(
+                            batch, enc_plan.rank_ords)
                         eff_attrs = enc_plan.attrs
                         eff_keys = enc_plan.key_exprs
                         eff_filters = enc_plan.filters
                         enc_sig = enc_plan.sig
                     else:
                         eff_attrs, eff_keys, eff_filters = \
-                            child_attrs, key_exprs, filters
+                            eff_child_attrs, key_exprs, filters
                         enc_sig = ()
                     nc = str_chunks(batch, str_update_ords)
                     b_lazy = update_lazy and \
+                        (enc_plan is None or not enc_plan.code_ords) and \
                         batch.capacity * inter_width <= lazy_out_cap_bytes
                     # update-side donation (docs/async-execution.md): the
                     # lazy kernel assembles its output in-trace and reads
@@ -693,10 +766,11 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                     # buffer consumption, not just a shape error
                     memo = update_kernel[0]
                     if memo is None or \
-                            memo[0] != (nc, b_lazy, b_donate, enc_sig):
-                        memo = ((nc, b_lazy, b_donate, enc_sig),
+                            memo[0] != (nc, b_lazy, b_donate, enc_sig,
+                                        run_key):
+                        memo = ((nc, b_lazy, b_donate, enc_sig, run_key),
                                 self._build_update_kernel(
-                            eff_attrs, eff_keys, input_exprs, op_names,
+                            eff_attrs, eff_keys, eff_inputs, eff_ops,
                             eff_filters, b_lazy, nc, donate=b_donate))
                         update_kernel[0] = memo
                     kern = memo[1]
@@ -731,11 +805,14 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                         local = self._lazy_batch(outs, num_groups, kvr)
                     else:
                         k, b, gi = out
-                        local = self._assemble(k, b, gi, batch.capacity,
-                                               kvr)
+                        local = self._assemble(
+                            k, b, gi, batch.capacity, kvr,
+                            buf_dicts=(enc_plan.buf_dicts
+                                       if enc_plan is not None else None))
                     if enc_plan is not None and enc_plan.key_dicts:
                         # code-grouped keys wrap back into encoded columns
-                        # (the dictionary gathers only at finalize/sink)
+                        # (min/max buffers were wrapped by _assemble; the
+                        # dictionary gathers only at the sink)
                         local = ENC.wrap_batch_cols(local,
                                                     enc_plan.key_dicts)
                     # a fresh update output has unique keys already

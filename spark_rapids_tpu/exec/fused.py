@@ -193,18 +193,25 @@ class TpuFusedStageExec(TpuExec):
         self._enc_cache: dict = {}
 
     # -- encoded-input planning (columnar/encoded.py) -------------------------
-    def _ord_stays_encoded(self, o: int) -> bool:
+    def _ord_stays_encoded(self, o: int) -> Optional[str]:
         """Can input ordinal `o` flow through the whole member chain as
         CODES? Its running positions must only be passed through bare by
-        projects or consumed by code-space-supported predicates."""
+        projects or consumed by code-space-supported predicates. Returns
+        None (no — decode at the boundary), 'code' (yes), or 'rank' (yes,
+        but an ORDER comparison consumes it — the column re-encodes
+        through the sorted dictionary first and literals rewrite to rank
+        thresholds)."""
         from spark_rapids_tpu.columnar import encoded as ENC
         from spark_rapids_tpu.ops.base import Alias, BoundReference
 
         pos = {o}
+        need_rank = False
         for op in self._ops:
             if op.kind == "filter":
-                if ENC.bound_supported_refs([op.bound], pos) != pos:
-                    return False
+                ok, rank = ENC.classify_bound_refs([op.bound], pos)
+                if ok != pos:
+                    return None
+                need_rank = need_rank or bool(rank)
             elif op.kind == "project":
                 newpos = set()
                 others = []
@@ -215,21 +222,25 @@ class TpuFusedStageExec(TpuExec):
                         newpos.add(i)
                         continue
                     others.append(e)
-                if ENC.bound_supported_refs(others, pos) != pos:
-                    return False
+                ok, rank = ENC.classify_bound_refs(others, pos)
+                if ok != pos:
+                    return None
+                need_rank = need_rank or bool(rank)
                 pos = newpos
                 if not pos:
-                    return True  # column dropped: nothing left to misuse
+                    # column dropped: nothing left to misuse
+                    return "rank" if need_rank else "code"
             elif op.kind == "expand":
                 # expand variants would need per-variant encoded schemas;
                 # decode at the stage boundary instead
-                return False
-        return True
+                return None
+        return "rank" if need_rank else "code"
 
     def _enc_ops_for(self, batch: ColumnarBatch):
-        """(rewritten ops, enc_sig, code ordinals, materialize ordinals,
-        output position -> dictionary) for a batch with encoded columns,
-        cached per (ordinal, dictionary) signature."""
+        """(rewritten ops, enc_sig, code ordinals, rank ordinals,
+        materialize ordinals, output position -> dictionary) for a batch
+        with encoded columns, cached per (ordinal, dictionary)
+        signature."""
         from spark_rapids_tpu.columnar import encoded as ENC
         from spark_rapids_tpu.columnar.dtypes import DataType as DT
         from spark_rapids_tpu.ops.base import Alias, BoundReference
@@ -240,12 +251,20 @@ class TpuFusedStageExec(TpuExec):
         cached = self._enc_cache.get(sig)
         if cached is not None:
             return cached
-        kept = {o for o in enc if self._ord_stays_encoded(o)}
+        kind_by_ord = {o: self._ord_stays_encoded(o) for o in enc}
+        kept = {o for o, k in kind_by_ord.items() if k is not None}
+        rank_ords = frozenset(o for o, k in kind_by_ord.items()
+                              if k == "rank")
         mat = tuple(sorted(set(enc) - kept))
+
+        def eff_dict(o):
+            d = enc[o].dictionary
+            return d.sorted_dict() if o in rank_ords else d
+
         pos2ord = {o: o for o in kept}
         ops2: List[_StageOp] = []
         for op in self._ops:
-            dicts = {p: enc[pos2ord[p]].dictionary for p in pos2ord}
+            dicts = {p: eff_dict(pos2ord[p]) for p in pos2ord}
             if op.kind == "filter":
                 ops2.append(_StageOp("filter", ENC.rewrite_bound_condition(
                     op.bound, dicts) if dicts else op.bound))
@@ -269,8 +288,8 @@ class TpuFusedStageExec(TpuExec):
                 pos2ord = newmap
             else:
                 ops2.append(op)
-        out_enc = {p: enc[o].dictionary for p, o in pos2ord.items()}
-        plan = (ops2, sig, frozenset(kept), mat, out_enc)
+        out_enc = {p: eff_dict(o) for p, o in pos2ord.items()}
+        plan = (ops2, sig, frozenset(kept), rank_ords, mat, out_enc)
         self._enc_cache[sig] = plan
         while len(self._enc_cache) > 64:
             self._enc_cache.pop(next(iter(self._enc_cache)))
@@ -399,12 +418,13 @@ class TpuFusedStageExec(TpuExec):
 
                 ops2, sig, out_enc = None, (), {}
                 if ENC.encoded_ordinals(b):
-                    ops2, sig, code_ords, mat, out_enc = \
+                    ops2, sig, code_ords, rank_ords, mat, out_enc = \
                         self._enc_ops_for(b)
                     # tpulint: eager-materialize -- stage-boundary
                     # decode for members that need values (non-
-                    # equality predicates, computed projections)
+                    # code-space predicates, computed projections)
                     b = ENC.batch_with_materialized(b, mat)
+                    b = ENC.batch_to_rank_space(b, rank_ords)
                     cols = ENC.eval_cols(b, code_ords)
                 else:
                     cols = [_col_to_colv(c) for c in b.columns]
@@ -427,7 +447,7 @@ class TpuFusedStageExec(TpuExec):
                     c = _colv_to_col(o)
                     d = out_enc.get(i)
                     if d is not None:
-                        c = DictionaryColumn(DataType.STRING, c.data,
+                        c = DictionaryColumn(d.value_dtype, c.data,
                                              c.validity, d)
                     cols.append(c)
                 return ColumnarBatch(cols, rows, owned=owned)

@@ -31,6 +31,7 @@ from spark_rapids_tpu.columnar.batch import (
     HostColumnVector,
     gather_batch,
 )
+from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.engine import retry as R
 from spark_rapids_tpu.exec import rowkeys as RK
 from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
@@ -74,17 +75,34 @@ class _SortBase(PhysicalExec):
 class TpuSortExec(_SortBase, TpuExec):
     """Device sort incl. string keys: strings use chunked big-endian uint64
     order keys whose chunk count is a static per-batch bound (the cudf
-    string comparator analog; see rowkeys.string_order_proxy)."""
+    string comparator analog; see rowkeys.string_order_proxy).
+
+    Encoded (dictionary) sort keys never decode: the column re-encodes
+    through its ORDER-PRESERVING sorted dictionary (columnar/encoded.py
+    to_rank_space — one permutation gather, zero for an already-sorted
+    dictionary) and the kernel sorts the int32 codes directly, which ARE
+    value ranks. Non-key encoded columns ride the output permutation as
+    codes untouched — the sort decode point is closed, not bypassed."""
 
     placement = "tpu"
 
-    def _build_kernel(self, input_attrs, n_chunks: int):
+    def _build_kernel(self, input_attrs, n_chunks: int,
+                      enc_ords: frozenset = frozenset()):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
+        from spark_rapids_tpu.ops.base import AttributeReference
 
+        if enc_ords:
+            # encoded key columns arrive as int32 RANK codes: retype their
+            # attrs so the bound references read the code lanes
+            input_attrs = [
+                AttributeReference(a.name, DataType.INT32, a.nullable,
+                                   a.expr_id) if i in enc_ords else a
+                for i, a in enumerate(input_attrs)]
         bound = bind_sort_orders(self.orders, input_attrs)
         directions = [(o.ascending, o.nulls_first) for o in bound]
-        key = ("sort", tuple(o.fingerprint() for o in bound), n_chunks)
+        key = ("sort", tuple(o.fingerprint() for o in bound), n_chunks,
+               tuple(sorted(enc_ords)))
 
         def build():
             def kernel(cols, num_rows):
@@ -111,47 +129,88 @@ class TpuSortExec(_SortBase, TpuExec):
         return [o.child.ordinal for o in bound
                 if o.child.data_type.is_string]
 
+    def _encoded_key_plan(self, batch, bound):
+        """(rank_ords, mat_ords) for one batch: bare encoded key ordinals
+        sort on ranks; encoded ordinals reached only through COMPUTED key
+        expressions need values."""
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.ops.base import BoundReference
+
+        enc = set(ENC.encoded_ordinals(batch))
+        if not enc:
+            return frozenset(), ()
+        rank_ords = set()
+        mat_ords = set()
+        for o in bound:
+            if isinstance(o.child, BoundReference):
+                if o.child.ordinal in enc:
+                    rank_ords.add(o.child.ordinal)
+            else:
+                mat_ords |= ENC._bound_ref_ords(o.child) & enc
+        return frozenset(rank_ords - mat_ords), tuple(sorted(mat_ords))
+
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         child_pb = self.children[0].execute(ctx)
         child_attrs = self.children[0].output
         str_ords = self._string_ordinals(child_attrs)
+        bound_static = bind_sort_orders(self.orders, child_attrs)
 
         def sort_partition(pidx: int):
+            from spark_rapids_tpu.columnar import encoded as ENC
             from spark_rapids_tpu.engine import async_exec as AX
 
             for batch in child_pb.iterator(pidx):
-                from spark_rapids_tpu.columnar.encoded import decode_batch
-
                 if batch.host_rows() == 0:
                     yield batch
                     continue
-                # tpulint: eager-materialize -- code order is NOT value
-                # order: the sort boundary is a sanctioned decode site
-                batch = decode_batch(batch)
+                # order-preserving sort: bare encoded key columns
+                # re-encode through the sorted dictionary and sort on
+                # int32 ranks — NO decode; computed key expressions over
+                # an encoded column are the one remaining (visible)
+                # boundary
+                rank_ords, mat_ords = self._encoded_key_plan(batch,
+                                                             bound_static)
+                if mat_ords:
+                    # tpulint: eager-materialize -- COMPUTED sort-key
+                    # expressions need values; bare keys sort on ranks
+                    batch = ENC.batch_with_materialized(batch, mat_ords)
+                if rank_ords:
+                    batch = ENC.batch_to_rank_space(batch, rank_ords)
+                    M.record_order_preserving_sort()
                 n_chunks = 0
-                if str_ords:
+                plain_str = [i for i in str_ords
+                             if not ENC.is_encoded(batch.columns[i])]
+                if plain_str:
                     n_chunks = max(
                         RK.string_chunks_needed(batch.columns[i])
-                        for i in str_ords)
-                kernel = self._build_kernel(child_attrs, n_chunks)
-                cols = [_col_to_colv(c) for c in batch.columns]
+                        for i in plain_str)
+                kernel = self._build_kernel(child_attrs, n_chunks,
+                                            rank_ords)
+                enc_all = ENC.encoded_ordinals(batch)
+                # non-key encoded columns ride as untouched code lanes
+                # (the kernel never evaluates them; the output gather
+                # keeps them encoded)
+                cols = ENC.eval_cols(batch, frozenset(enc_all)) \
+                    if enc_all else [_col_to_colv(c) for c in batch.columns]
                 # sort scatter donation (docs/async-execution.md): the
                 # coalesced partition batch is consume-once (owned) and
                 # the permutation gather replaces it wholesale, so its
                 # fixed-width buffers donate into the gather — peak HBM
                 # for the sorted copy drops from 2x to ~1x the partition
                 donate = AX.donation_active() and batch.owned and \
-                    not str_ords
+                    not plain_str
 
                 def _attempt():
                     if donate:
                         # only the fixed-width buffers donate (string
                         # payload columns go through the undonated
-                        # string gather): tally what is actually consumed
+                        # string gather; encoded columns ARE fixed int32
+                        # code lanes): tally what is actually consumed
                         TpuDeviceManager.get().note_donation(sum(
                             c.device_memory_size()
                             for c in batch.columns
-                            if not c.dtype.is_string))
+                            if not c.dtype.is_string
+                            or ENC.is_encoded(c)))
                     perm = kernel(cols, np.int32(batch.num_rows))
                     return gather_batch(batch, perm, batch.num_rows,
                                         unique_indices=True,

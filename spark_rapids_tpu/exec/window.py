@@ -63,6 +63,7 @@ from spark_rapids_tpu.ops.base import (
 )
 from spark_rapids_tpu.ops.bind import bind_all, bind_sort_orders
 from spark_rapids_tpu.ops.eval import _col_to_colv, cpu_project
+from spark_rapids_tpu.utils import metrics as M
 from spark_rapids_tpu.ops.values import EvalContext, ScalarV
 from spark_rapids_tpu.ops.window import (
     UNBOUNDED,
@@ -162,10 +163,20 @@ def _run_end(change, pos, live_s, cap: int):
 class TpuWindowExec(_WindowBase, TpuExec):
     placement = "tpu"
 
-    def _build_kernel(self, input_attrs):
+    def _build_kernel(self, input_attrs, enc_ords: frozenset = frozenset()):
         from spark_rapids_tpu.engine.jit_cache import get_or_build
         from spark_rapids_tpu.ops.eval import _scalar_to_colv
 
+        if enc_ords:
+            # encoded partition-by / order-by columns arrive as int32
+            # RANK codes (order-preserving sorted dictionary): retype
+            # their attrs so the bound references read the code lanes —
+            # grouping on codes clusters exactly like values, ordering on
+            # ranks orders exactly like values
+            input_attrs = [
+                AttributeReference(a.name, DataType.INT32, a.nullable,
+                                   a.expr_id) if i in enc_ords else a
+                for i, a in enumerate(input_attrs)]
         spec = self._spec()
         bound_part = bind_all(spec.partition_by, input_attrs)
         bound_orders = bind_sort_orders(spec.order_by, input_attrs)
@@ -182,7 +193,8 @@ class TpuWindowExec(_WindowBase, TpuExec):
                tuple(o.fingerprint() for o in bound_orders),
                tuple(w.fingerprint() for w in wexprs),
                tuple(b.fingerprint() if b is not None else ""
-                     for b in bound_inputs))
+                     for b in bound_inputs),
+               tuple(sorted(enc_ords)))
 
         def build():
             def kernel(cols, num_rows):
@@ -298,6 +310,58 @@ class TpuWindowExec(_WindowBase, TpuExec):
 
         return get_or_build(key, build)
 
+    def _encoded_plan(self, batch, wexprs):
+        """(rank_ords, mat_ords) per batch: encoded columns used ONLY as
+        bare partition-by / order-by references stay encoded as ranks
+        (the sorted-dictionary codes cluster AND order exactly like the
+        values); window-function inputs and computed spec expressions
+        need values. Finite RANGE-offset frames do key ARITHMETIC on the
+        single order column — rank distance is not value distance, so
+        encoded order columns decode there."""
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.ops.base import AttributeReference
+
+        enc_ords = set(ENC.encoded_ordinals(batch))
+        if not enc_ords:
+            return frozenset(), ()
+        attrs = self.children[0].output
+        ord_by_eid = {a.expr_id: i for i, a in enumerate(attrs)}
+
+        def eref(e):
+            if isinstance(e, AttributeReference):
+                o = ord_by_eid.get(e.expr_id)
+                return o if o in enc_ords else None
+            return None
+
+        def refs(e):
+            return {ord_by_eid.get(r.expr_id) for r in e.collect(
+                lambda x: isinstance(x, AttributeReference))} & enc_ords
+
+        spec = self._spec()
+        finite_range = any(
+            w.spec.frame.frame_type == "range"
+            and (w.spec.frame.lower not in (UNBOUNDED, 0)
+                 or w.spec.frame.upper not in (UNBOUNDED, 0))
+            for w in wexprs)
+        rank_ords, mat_ords = set(), set()
+        for e in spec.partition_by:
+            o = eref(e)
+            (rank_ords.add(o) if o is not None
+             else mat_ords.update(refs(e)))
+        for so in spec.order_by:
+            o = eref(so.child)
+            if o is not None and not finite_range:
+                rank_ords.add(o)
+            elif o is not None:
+                mat_ords.add(o)
+            else:
+                mat_ords.update(refs(so.child))
+        for w in wexprs:
+            for c in w.function.children():
+                mat_ords.update(refs(c))
+        rank_ords -= mat_ords
+        return frozenset(rank_ords), tuple(sorted(mat_ords))
+
     def execute(self, ctx: ExecContext) -> PartitionedBatches:
         child_pb = self.children[0].execute(ctx)
         child_attrs = self.children[0].output
@@ -305,18 +369,33 @@ class TpuWindowExec(_WindowBase, TpuExec):
         wexprs = [_unwrap(e) for e in self.window_exprs]
 
         def window_partition(pidx: int):
-            for batch in child_pb.iterator(pidx):
-                from spark_rapids_tpu.columnar.encoded import decode_batch
+            from spark_rapids_tpu.columnar import encoded as ENC
 
+            for batch in child_pb.iterator(pidx):
                 if batch.host_rows() == 0:
                     continue
-                # tpulint: eager-materialize -- window frames
-                # order/partition by VALUES: sanctioned boundary decode
-                batch = decode_batch(batch)
-                if kernel[0] is None:
-                    kernel[0] = self._build_kernel(child_attrs)
-                cols = [_col_to_colv(c) for c in batch.columns]
-                outs = kernel[0](cols, jnp.int32(batch.num_rows))
+                # order-preserving window: bare encoded partition/order
+                # columns stay encoded as RANK codes; function inputs
+                # (and computed spec expressions / finite RANGE offsets)
+                # decode visibly
+                rank_ords, mat_ords = self._encoded_plan(batch, wexprs)
+                if mat_ords:
+                    # tpulint: eager-materialize -- window-function
+                    # inputs and range-offset order keys need VALUES;
+                    # bare partition/order refs stay rank codes
+                    batch = ENC.batch_with_materialized(batch, mat_ords)
+                if rank_ords:
+                    batch = ENC.batch_to_rank_space(batch, rank_ords)
+                    M.record_order_preserving_sort()
+                memo = kernel[0]
+                if memo is None or memo[0] != rank_ords:
+                    memo = (rank_ords,
+                            self._build_kernel(child_attrs, rank_ords))
+                    kernel[0] = memo
+                enc_all = ENC.encoded_ordinals(batch)
+                cols = ENC.eval_cols(batch, frozenset(enc_all)) \
+                    if enc_all else [_col_to_colv(c) for c in batch.columns]
+                outs = memo[1](cols, jnp.int32(batch.num_rows))
                 new_cols = list(batch.columns)
                 for (data, valid), w in zip(outs, wexprs):
                     new_cols.append(ColumnVector(w.data_type, data, valid))

@@ -1072,6 +1072,38 @@ def expand_string_column(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
     return data, validity, offsets
 
 
+def expand_string_codes(stripe_dev_u8, plan: ColumnPlan, num_rows: int,
+                        cap: int):
+    """DEVICE data plane for a DICTIONARY_V2 string column kept ENCODED
+    (columnar/encoded.py): expand the index stream to per-row int32
+    CODES — no dictionary gather, no byte-total sync. Returns
+    (codes, validity, dict_lens_np): the dictionary LENGTH stream
+    expands on device (dict-capacity sized — tiny) and downloads once so
+    the host can intern the byte table (one small sync per stripe, in
+    place of the gather-sizing sync the decode path pays)."""
+    from spark_rapids_tpu.columnar.batch import bucket_capacity
+
+    assert plan.dict_len_rt is not None
+    validity = _expand_validity(stripe_dev_u8, plan, cap) & \
+        (jnp.arange(cap) < num_rows)
+    dict_cap = bucket_capacity(max(plan.dict_size, 1))
+    dict_lens = _expand_rt_dense(stripe_dev_u8, plan.dict_len_rt, dict_cap)
+    in_dict = jnp.arange(dict_cap) < plan.dict_size
+    dict_lens = jnp.where(in_dict, dict_lens, 0).astype(jnp.int32)
+    if plan.rt.kind.size == 0:  # entirely-null column in this stripe
+        codes = jnp.zeros((cap,), jnp.int32)
+    else:
+        prefix = jnp.clip(jnp.cumsum(validity.astype(jnp.int32)) - 1, 0,
+                          cap - 1)
+        idx_dense = _expand_rt_dense(stripe_dev_u8, plan.rt, cap)
+        idx_row = jnp.clip(idx_dense[prefix], 0, dict_cap - 1).astype(
+            jnp.int32)
+        codes = jnp.where(validity, idx_row, 0)
+    lens_np = np.asarray(
+        jax.device_get(dict_lens))[:plan.dict_size].astype(np.int32)
+    return codes, validity, lens_np
+
+
 def expand_float_column(stripe_dev_u8, plan: ColumnPlan, dtype: DataType,
                         num_rows: int, cap: int):
     """DEVICE data plane for FLOAT/DOUBLE columns: the DATA stream is raw

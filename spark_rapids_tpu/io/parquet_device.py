@@ -821,6 +821,47 @@ def _flat_dict_kernel(chunk_u8, def_tab, val_tab, dict_vals, bw: int,
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _flat_dict_codes_kernel(chunk_u8, def_tab, val_tab, bw: int,
+                            cap: int, cap_p: int, has_def: bool):
+    """_flat_dict_kernel WITHOUT the dictionary gather: the expanded
+    index stream IS the encoded column's code array
+    (columnar/encoded.py — fixed-value dictionary chunks)."""
+    if has_def:
+        validity = _expand_hybrid(chunk_u8, *def_tab, 1, cap).astype(bool)
+    else:
+        validity = jnp.ones((cap,), bool)
+    idx = _expand_hybrid(chunk_u8, *val_tab, bw, cap_p)
+    return idx.astype(jnp.int32), validity
+
+
+def _rle_run_table(val_tabs, num_rows: int):
+    """Host RunTable (columnar/runs.py) from a chunk's PURE-RLE value run
+    tables, or None when any bit-packed group is present (its values are
+    not host-known) or the stream is empty. Only meaningful for all-
+    present chunks (no def levels): run output offsets are then row
+    offsets."""
+    from spark_rapids_tpu.columnar.runs import RunTable as _RT
+
+    starts_parts = []
+    values_parts = []
+    for out_start, is_rle, value, _bit_off in val_tabs:
+        if not bool(np.all(is_rle)):
+            return None
+        starts_parts.append(out_start.astype(np.int64))
+        values_parts.append(value)
+    if not starts_parts:
+        return None
+    starts = np.concatenate(starts_parts)
+    values = np.concatenate(values_parts)
+    keep = starts < num_rows
+    starts, values = starts[keep], values[keep]
+    if len(starts) == 0 or starts[0] != 0 or \
+            bool(np.any(np.diff(starts) <= 0)):
+        return None
+    return _RT(starts, values, num_rows)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
 def _flat_plain_kernel(chunk_u8, def_tab, page_meta, np_dtype_name: str,
                        cap: int, cap_p: int, has_def: bool):
     """Whole-chunk PLAIN decode: per-lane page lookup (searchsorted over
@@ -855,8 +896,13 @@ def _flat_finish(dense, validity, nums, cap: int):
     return data, validity
 
 
+_FIXED_ENC_DTYPES = (DataType.INT64, DataType.DATE, DataType.TIMESTAMP)
+
+
 def _try_flat_fixed(chunk: bytes, chunk_dev, pages, dtype: DataType,
-                    num_rows: int, max_def: int, cap: int, npdt):
+                    num_rows: int, max_def: int, cap: int, npdt,
+                    encoded_ok: bool = False,
+                    max_dict_fraction: float = 1.0):
     """Whole-chunk fixed-width decode with ZERO per-page device work:
     host computes every page's present count (bit-popcount over def-level
     bytes), all pages' run tables concatenate into one flat table (output
@@ -864,6 +910,15 @@ def _try_flat_fixed(chunk: bytes, chunk_dev, pages, dtype: DataType,
     and 2-3 jitted dispatches decode the entire chunk. Returns a
     ColumnVector, or None when the chunk's shape needs the general
     per-page path (mixed/exotic encodings, strings, bools, FLBA).
+
+    With `encoded_ok`, an INT64/DATE/TIMESTAMP dictionary chunk clearing
+    the ndv/rows heuristic emits a DictionaryColumn instead: codes ARE
+    the expanded index stream (no dictionary gather) and the host-parsed
+    PLAIN dictionary page interns into one shared fixed-value
+    DeviceDictionary (ROADMAP item 5: INT64 dictionary chunks). Either
+    way, an all-present pure-RLE value stream additionally attaches a
+    host RunTable for the run-granular aggregate path
+    (columnar/runs.py).
 
     Reference bar: on-accelerator decode is the FAST path
     (GpuParquetScan.scala:536-556); round 4's per-page loop paid one
@@ -946,12 +1001,56 @@ def _try_flat_fixed(chunk: bytes, chunk_dev, pages, dtype: DataType,
     nums = np.asarray([num_rows, present], np.int32)
     if dict_mode:
         dp = dict_pages[0]
+        # host run table: only when the whole chunk is present (run
+        # output offsets == row offsets — a nullable schema still
+        # qualifies as long as no NULL actually occurs) and every value
+        # run is RLE
+        runs = _rle_run_table(val_tabs, num_rows) if present == rows \
+            else None
+        if encoded_ok and dtype in _FIXED_ENC_DTYPES:
+            from spark_rapids_tpu.columnar.encoded import (
+                DeviceDictionary,
+                DictionaryColumn,
+                scan_encoded_ok,
+            )
+
+            if scan_encoded_ok(dp.num_values, num_rows,
+                               max_dict_fraction):
+                host_vals = np.frombuffer(
+                    chunk, dtype=np.dtype(npdt), count=dp.num_values,
+                    offset=dp.data_start).astype(dtype.to_np())
+                d = DeviceDictionary.from_fixed_values(host_vals, dtype)
+                val_tab = tuple(jnp.asarray(a)
+                                for a in _pack_flat_tabs(val_tabs))
+                codes, validity = _flat_dict_codes_kernel(
+                    chunk_dev, def_tab, val_tab, int(bw or 1), cap,
+                    cap_p, has_def)
+                codes, validity = _flat_finish(codes, validity, nums, cap)
+                out = DictionaryColumn(dtype, codes, validity, d)
+                out.runs = runs  # run values ARE codes for encoded cols
+                return out
         dict_vals = _bitcast_values(chunk_dev, np.int32(dp.data_start),
                                     dp.num_values, np.dtype(npdt).name)
         val_tab = tuple(jnp.asarray(a) for a in _pack_flat_tabs(val_tabs))
         dense, validity = _flat_dict_kernel(
             chunk_dev, def_tab, val_tab, dict_vals, int(bw or 1), cap,
             cap_p, has_def)
+        runs_out = None
+        if runs is not None and dp.num_values:
+            # decoded emission still benefits from runs: values via one
+            # host take through the dictionary page's raw values
+            from spark_rapids_tpu.columnar.runs import RunTable as _RT
+
+            host_vals = np.frombuffer(
+                chunk, dtype=np.dtype(npdt), count=dp.num_values,
+                offset=dp.data_start)
+            sel = np.clip(runs.values, 0, dp.num_values - 1)
+            runs_out = _RT(runs.starts,
+                           host_vals[sel].astype(dtype.to_np()), num_rows)
+        data, validity = _flat_finish(dense, validity, nums, cap)
+        out = ColumnVector(dtype, data, validity)
+        out.runs = runs_out
+        return out
     else:
         meta = np.zeros((2, len(plain_pos)), np.int64)
         meta[0] = plain_dense_end
@@ -1023,13 +1122,17 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
 
     if not is_string and not is_dec_flba:
         flat = _try_flat_fixed(chunk, chunk_dev, pages, dtype, num_rows,
-                               max_def, cap, npdt)
+                               max_def, cap, npdt,
+                               encoded_ok=encoded_ok,
+                               max_dict_fraction=max_dict_fraction)
         if flat is not None:
             return flat
 
     dict_vals = None          # fixed-width dictionary values (device)
     str_dict = None           # (bytes_dev, offs_dev, lens_dev) for strings
     str_dict_host = None      # host (bytes_np, offs_np) dictionary table
+    str_run_tabs = []         # per-page value run tables (no-null chunks)
+    row_base = 0              # rows decoded so far (run-table shifting)
     str_plain = []            # per-page (starts_np, lens_np) for strings
     str_delta = []            # per-page DEVICE (starts, lens, n) for
                               # DELTA_LENGTH_BYTE_ARRAY strings
@@ -1102,14 +1205,20 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
             if bit_width > 24:
                 raise _Unsupported(f"dict index bit width {bit_width}")
             pos += 1
+            all_present = n_present == p.num_values
             if bit_width == 0:
                 idx = jnp.zeros((page_cap,), dtype=jnp.int32)
+                if all_present:
+                    str_run_tabs.append(_synth_rle_tab(row_base, 0))
             else:
                 rt = parse_runs(chunk, pos, end, bit_width, n_present)
                 idx = _expand_hybrid(
                     chunk_dev, jnp.asarray(rt.out_start),
                     jnp.asarray(rt.is_rle), jnp.asarray(rt.value),
                     jnp.asarray(rt.bit_off), bit_width, page_cap)
+                if all_present:
+                    str_run_tabs.append(
+                        _shifted_tab(rt, row_base, n_present))
             if is_string:
                 page_dense = idx  # gather through the dict AFTER assembly
             else:
@@ -1213,6 +1322,7 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         if page_dense is not None:
             dense_parts.append((page_dense, n_present))
         valid_parts.append((page_valid, p.num_values))
+        row_base += p.num_values
 
     # stitch pages (single-page chunks — the common case with row-group
     # splits — take the fast path)
@@ -1308,8 +1418,14 @@ def decode_chunk_device(chunk: bytes, dtype: DataType, num_rows: int,
         db, do = str_dict_host
         if scan_encoded_ok(int(len(do)) - 1, num_rows, max_dict_fraction):
             d = DeviceDictionary.from_byte_table(db, do)
-            return DictionaryColumn(dtype, data.astype(jnp.int32),
-                                    validity, d)
+            out = DictionaryColumn(dtype, data.astype(jnp.int32),
+                                   validity, d)
+            if len(str_run_tabs) == len(
+                    [p for p in pages if p.kind != PAGE_DICT]):
+                # all-present pure-RLE index stream: attach the host run
+                # table for run-granular compute (values are CODES)
+                out.runs = _rle_run_table(str_run_tabs, num_rows)
+            return out
     row_idx = jnp.clip(data, 0, dict_lens.shape[0] - 1)
     row_lens = jnp.where(validity, dict_lens[row_idx], 0)
     total = int(jax.device_get(jnp.sum(row_lens)))

@@ -712,11 +712,41 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                 region, _streams, _encs, _tz = OD.normalize_stripe(
                     region, si, meta.compression, eligible_cids)
             stripe_dev = jnp.asarray(np.frombuffer(region, dtype=np.uint8))
+            from spark_rapids_tpu import conf as C3
+            from spark_rapids_tpu.columnar import encoded as ENC
+
+            enc_ok = conf.get(C3.ENCODED_ENABLED)
+            enc_frac = conf.get(C3.ENCODED_MAX_DICT_FRACTION)
             dev_cols = {}
             for a in eligible:
                 if a.data_type is DataType.STRING:
+                    plan = stripe_plans[sidx][a.name]
+                    if enc_ok and plan.dict_len_rt is not None and \
+                            ENC.scan_encoded_ok(plan.dict_size, rows,
+                                                enc_frac):
+                        # DICTIONARY_V2 stays ENCODED: codes off the
+                        # index stream, dictionary bytes interned from
+                        # the host stripe image — ORC joins the
+                        # code-space pipeline on the same eligibility
+                        # as parquet (columnar/encoded.py)
+                        codes, v, lens_np = OD.expand_string_codes(
+                            stripe_dev, plan, rows, cap)
+                        offs_np = np.zeros(len(lens_np) + 1,
+                                           dtype=np.int32)
+                        np.cumsum(lens_np, out=offs_np[1:])
+                        db = np.frombuffer(
+                            region, dtype=np.uint8,
+                            count=int(offs_np[-1]),
+                            offset=plan.data_start).copy()
+                        dct = ENC.DeviceDictionary.from_byte_table(
+                            db, offs_np)
+                        cv = ENC.DictionaryColumn(a.data_type, codes, v,
+                                                  dct)
+                        ENC.record_scan_emission(cv, rows)
+                        dev_cols[a.name] = cv
+                        continue
                     d, v, offs = OD.expand_string_column(
-                        stripe_dev, stripe_plans[sidx][a.name], rows, cap)
+                        stripe_dev, plan, rows, cap)
                     dev_cols[a.name] = ColumnVector(a.data_type, d, v,
                                                     offs)
                 elif a.data_type in (DataType.FLOAT32, DataType.FLOAT64):
@@ -814,18 +844,81 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         from spark_rapids_tpu.columnar import encoded as ENC
         from spark_rapids_tpu.io import parquet_device as PD
 
-        enabled = conf.get(C3.ENCODED_ENABLED) and self.fmt == "parquet" \
-            and conf.get(C3.PARQUET_DEVICE_DECODE)
+        enabled = conf.get(C3.ENCODED_ENABLED) and (
+            (self.fmt == "parquet"
+             and conf.get(C3.PARQUET_DEVICE_DECODE))
+            or (self.fmt == "orc" and conf.get(C3.ORC_DEVICE_DECODE)))
         frac = conf.get(C3.ENCODED_MAX_DICT_FRACTION)
+        fixed_conf = conf.get(C3.ENCODED_FIXED_DICTIONARIES)
         cached = getattr(self, "_encoded_plan_cache", None)
-        if cached is not None and cached[0] == (enabled, frac):
+        if cached is not None and cached[0] == (enabled, frac,
+                                                fixed_conf):
             return cached[1]
         out: Dict[str, str] = {}
+        if enabled and self.fmt == "orc":
+            # ORC: a stripe's DICTIONARY_V2 choice + dictionarySize live
+            # in the stripe FOOTER — 'possible' when any stripe might
+            # encode (the savings interval must cover it); 'certain' is
+            # NOT claimed (the byte model's pessimistic ceiling stays on
+            # the decoded estimate; runtime decides per stripe).
+            # METADATA cost only: file meta from the tail, then each
+            # stripe's footer bytes read + parsed ONCE for all columns —
+            # never the data streams.
+            try:
+                from spark_rapids_tpu.io import orc_device as OD
+
+                for split in self.splits:
+                    size = os.path.getsize(split.path)
+                    with open(split.path, "rb") as f:
+                        f.seek(max(0, size - (1 << 20)))
+                        tail = f.read()
+                        try:
+                            meta = OD.parse_file_meta(tail)
+                        except Exception:
+                            f.seek(0)
+                            meta = OD.parse_file_meta(f.read())
+                        name_to_cid = {n: i for i, n in
+                                       enumerate(meta.names)}
+                        want = {name_to_cid[a.name]: a.name
+                                for a in self.attrs
+                                if a.data_type is DataType.STRING
+                                and a.name not in out
+                                and a.name in name_to_cid}
+                        for si in meta.stripes:
+                            if not want:
+                                break
+                            fstart = si.offset + si.index_length + \
+                                si.data_length
+                            f.seek(fstart)
+                            fbytes = f.read(si.footer_length)
+                            if meta.compression != 0:
+                                fbuf = OD.decompress_blocks(
+                                    fbytes, 0, si.footer_length,
+                                    meta.compression)
+                            else:
+                                fbuf = fbytes
+                            _s, encs, _tz = OD._walk_stripe_footer(
+                                fbuf, 0, len(fbuf), 0)
+                            for cid in list(want):
+                                enc, dict_size = encs.get(cid, (-1, 0))
+                                if enc == OD.E_DICT_V2 and \
+                                        ENC.scan_encoded_ok(
+                                            dict_size, si.num_rows,
+                                            frac):
+                                    out[want.pop(cid)] = "possible"
+            except Exception:
+                out = {}
+            self._encoded_plan_cache = ((enabled, frac, fixed_conf), out)
+            return out
         if enabled:
             import pyarrow.parquet as pq
 
+            fixed_ok = fixed_conf
             str_attrs = [a for a in self.attrs
-                         if a.data_type is DataType.STRING]
+                         if a.data_type is DataType.STRING
+                         or (fixed_ok and a.data_type in (
+                             DataType.INT64, DataType.DATE,
+                             DataType.TIMESTAMP))]
             # per column: 'certain' only when EVERY row group of every
             # split is a provably dict-only chunk clearing the heuristic;
             # 'possible' when ANY group might encode (the savings
@@ -869,7 +962,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         else "possible"
             except Exception:
                 out = {}
-        self._encoded_plan_cache = ((enabled, frac), out)
+        self._encoded_plan_cache = ((enabled, frac, fixed_conf), out)
         return out
 
     def _read_device(self, split: FileSplit, conf):
@@ -886,6 +979,7 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
         from spark_rapids_tpu.columnar import encoded as ENC
 
         encoded_ok = conf.get(C3.ENCODED_ENABLED)
+        fixed_ok = encoded_ok and conf.get(C3.ENCODED_FIXED_DICTIONARIES)
         max_frac = conf.get(C3.ENCODED_MAX_DICT_FRACTION)
         pf = pq.ParquetFile(split.path)
         md = pf.metadata
@@ -928,8 +1022,12 @@ class TpuFileScanExec(_FileScanBase, TpuExec):
                         max_def=max_def.get(a.name, 1), cap=cap,
                         codec=col.compression,
                         flba_len=flba_len.get(a.name, 0),
-                        encoded_ok=(encoded_ok
-                                    and a.data_type is DataType.STRING),
+                        encoded_ok=(
+                            (encoded_ok
+                             and a.data_type is DataType.STRING)
+                            or (fixed_ok and a.data_type in (
+                                DataType.INT64, DataType.DATE,
+                                DataType.TIMESTAMP))),
                         max_dict_fraction=max_frac)
                 except Exception:
                     return None  # unexpected page shape: whole-split fallback

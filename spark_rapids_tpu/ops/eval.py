@@ -234,18 +234,20 @@ class DeviceProjector:
                        if pass_ord(e) is not None}
         eval_exprs = [e for oi, e in enumerate(self.exprs)
                       if oi not in passthrough]
-        ok = ENC.bound_supported_refs(eval_exprs, enc.keys())
+        ok, rank = ENC.classify_bound_refs(eval_exprs, enc.keys())
         referenced = set()
         for e in eval_exprs:
             referenced |= ENC._bound_ref_ords(e)
         mat = tuple(sorted((set(enc) - ok) & referenced))
-        dict_by_ord = {i: enc[i].dictionary for i in ok}
+        dict_by_ord = {i: (enc[i].dictionary.sorted_dict() if i in rank
+                           else enc[i].dictionary) for i in ok}
         rewritten = [ENC.rewrite_bound_condition(e, dict_by_ord)
                      if dict_by_ord else e for e in eval_exprs]
         # the trailing one-slot list caches the built jit handle so the
         # expression trees are fingerprinted once per signature, not per
         # batch (_project_encoded fills it on first dispatch)
-        plan = (passthrough, rewritten, frozenset(ok), mat, [None])
+        plan = (passthrough, rewritten, frozenset(ok), frozenset(rank),
+                mat, [None])
         self._enc_plans[sig] = plan
         if len(self._enc_plans) > 64:
             self._enc_plans.pop(next(iter(self._enc_plans)))
@@ -254,11 +256,12 @@ class DeviceProjector:
     def _project_encoded(self, batch, partition_id, row_start):
         from spark_rapids_tpu.columnar import encoded as ENC
 
-        passthrough, rewritten, code_ords, mat, built = \
+        passthrough, rewritten, code_ords, rank_ords, mat, built = \
             self._enc_plan(batch)
         # tpulint: eager-materialize -- projection expressions outside
         # the code-space subset need values; passthroughs stay codes
         batch = ENC.batch_with_materialized(batch, mat)
+        batch = ENC.batch_to_rank_space(batch, rank_ords)
         outs: List = [None] * len(self.exprs)
         if rewritten:
             cols = ENC.eval_cols(batch, code_ords)
@@ -331,13 +334,16 @@ class DeviceFilter:
             cols = [_col_to_colv(c) for c in batch.columns]
         else:
             # code-space filter: supported predicates over encoded columns
-            # compare int32 codes against pre-translated literal codes;
-            # unsupported uses decode first (visible materialize). The
-            # surviving rows compact WITH their codes — the output batch
-            # stays encoded.
-            # tpulint: eager-materialize -- non-equality predicates over
+            # compare int32 codes against pre-translated literal codes —
+            # ORDER comparisons first re-encode the column through the
+            # sorted dictionary so the literal's rank threshold splits
+            # code space exactly; unsupported uses decode (visible
+            # materialize). The surviving rows compact WITH their codes —
+            # the output batch stays encoded.
+            # tpulint: eager-materialize -- non-code-space predicates over
             # the column need values; supported ordinals stay codes
             batch = ENC.batch_with_materialized(batch, plan.mat_ords)
+            batch = ENC.batch_to_rank_space(batch, plan.rank_ords)
             built = self._enc_jitted.get(plan.sig)
             if built is None:
                 built = self._enc_jitted[plan.sig] = \

@@ -508,6 +508,10 @@ class PlanResourceReport:
         self.encoded_saved = Interval.exact(0)
         self.encoded_code_bytes = Interval.exact(0)
         self.encoded_decoded_bytes = Interval.exact(0)
+        # HOST bytes of scan-attached RLE run tables (run-aware kernels,
+        # columnar/runs.py) — bounded by maxRunFraction; reported so the
+        # collapse's host residency is visible, never charged to HBM
+        self.run_table_bytes = Interval.exact(0)
         self.decode_points: List[str] = []
         self.nodes: List[NodeEstimate] = []
         self.violations: List[PlanViolation] = []
@@ -574,6 +578,11 @@ class PlanResourceReport:
                 f"{_fmt_bytes(self.encoded_saved.lo)}"
                 f"..{_fmt_bytes(self.encoded_saved.hi)}; decode at: "
                 f"{pts})")
+            if self.run_table_bytes.hi:
+                lines.append(
+                    f"run tables (host): "
+                    f"{_fmt_bytes(self.run_table_bytes.lo)}"
+                    f"..{_fmt_bytes(self.run_table_bytes.hi)}")
         for n in self.nodes:
             lines.append(
                 "  " * (n.depth + 1)
@@ -632,6 +641,21 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
 
         return isinstance(node, TpuSpmdStageExec)
 
+    def _is_sort(node) -> bool:
+        from spark_rapids_tpu.exec.sort import _SortBase
+
+        return isinstance(node, _SortBase)
+
+    def _is_window(node) -> bool:
+        from spark_rapids_tpu.exec.window import _WindowBase
+
+        return isinstance(node, _WindowBase)
+
+    def _unwrap_window(e):
+        from spark_rapids_tpu.exec.window import _unwrap
+
+        return _unwrap(e)
+
     def note_decode(label: str) -> None:
         if label not in decode_points:
             decode_points.append(label)
@@ -689,11 +713,28 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
                        if srcs[oe] not in bad}
         elif isinstance(node, _HashAggregateBase):
             if cin:
+                from spark_rapids_tpu.ops.aggregates import (
+                    AggregateFunction,
+                    Max,
+                    Min,
+                )
+
                 key_eids = {g.expr_id for g in node.grouping}
+                minmax_kept = set()   # buffer eids of rank-space min/max
                 if node.mode in (PARTIAL, COMPLETE):
+                    # bare MIN/MAX inputs reduce over RANKS (the sorted
+                    # dictionary) and stay encoded; any other input use
+                    # decodes — mirror exec/aggregate.plan_agg_update
+                    minmax_in = set()
                     input_refs = set()
-                    for _op, e, _dt in node._update_ops():
-                        input_refs |= refs(e)
+                    for op, e, _dt in node._update_ops():
+                        b = bare(e)
+                        if op in ("min", "max") and b is not None \
+                                and b in cin:
+                            minmax_in.add(b)
+                        else:
+                            input_refs |= refs(e)
+                    minmax_in -= input_refs
                     nonbare = set()
                     for e in node.key_exprs:
                         b = bare(e)
@@ -701,24 +742,78 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
                         if b is not None:
                             r = r - {b}
                         nonbare |= r
-                    kept = {i for i in cin if i in key_eids
+                    kept = {i for i in cin
+                            if (i in key_eids or i in minmax_in)
                             and i not in input_refs and i not in nonbare}
+                    for spec in node.specs:
+                        for (_bn, op, e), battr in zip(
+                                spec.func.update_aggs(), spec.buffers):
+                            b = bare(e)
+                            if op in ("min", "max") and b is not None \
+                                    and b in kept and b in minmax_in:
+                                minmax_kept.add(battr.expr_id)
                 else:
-                    kept = {i for i in cin if i in key_eids}
+                    # FINAL: encoded grouping keys and min/max BUFFER
+                    # columns (cin carries the partial schema) merge in
+                    # code space
+                    buf_eids = {b.expr_id for s in node.specs
+                                for (_bn, op), b in zip(s.func.merge_aggs(),
+                                                        s.buffers)
+                                if op in ("min", "max")}
+                    kept = {i for i in cin
+                            if i in key_eids or i in buf_eids}
+                    minmax_kept = kept - key_eids
                 if set(cin) - kept:
                     note_decode(node.node_name())
                 if node.mode == PARTIAL:
                     enc = {i: cin[i] for i in kept}
+                    for spec in node.specs:
+                        for (_bn, op, e), battr in zip(
+                                spec.func.update_aggs(), spec.buffers):
+                            if battr.expr_id in minmax_kept:
+                                enc[battr.expr_id] = cin[bare(e)]
                 else:
                     for e in node.agg_exprs:
                         b = bare(e)
                         if b is not None and b in kept:
                             enc[to_attribute(e).expr_id] = cin[b]
+                            continue
+                        # Alias(Min/Max(kept ref/buffer)) emits the
+                        # winning CODE — encoded through to the sink
+                        fs = e.collect(
+                            lambda x: isinstance(x, AggregateFunction))
+                        if len(fs) != 1 or not isinstance(fs[0],
+                                                          (Min, Max)):
+                            continue
+                        if node.mode == COMPLETE:
+                            inner = bare(fs[0].children()[0]) \
+                                if fs[0].children() else None
+                            if inner is not None and inner in kept:
+                                enc[to_attribute(e).expr_id] = cin[inner]
+                        else:  # FINAL: map through the buffer attr
+                            bufs = [s for s in node.specs
+                                    if s.func.fingerprint()
+                                    == fs[0].fingerprint()]
+                            if bufs and bufs[0].buffers[0].expr_id \
+                                    in kept:
+                                enc[to_attribute(e).expr_id] = \
+                                    cin[bufs[0].buffers[0].expr_id]
         elif isinstance(node, _ExchangeBase):
             p = node.partitioning
             if isinstance(p, RangePartitioning):
-                if cin:
+                # bare-ref encoded keys route in RANK space (bounds
+                # sampled as union ranks — shuffle/exchange.py); only
+                # computed key expressions over an encoded column decode
+                enc = dict(cin)
+                bad = set()
+                for o in p.orders:
+                    if bare(o.child) in enc:
+                        continue
+                    bad |= refs(o.child) & set(enc)
+                if bad:
                     note_decode(node.node_name())
+                    for i in bad:
+                        enc.pop(i, None)
             else:
                 enc = dict(cin)
                 if isinstance(p, HashPartitioning):
@@ -794,8 +889,56 @@ def _encoded_flow(plan: PhysicalExec, conf: "C.TpuConf"):
                     and node.infos[0].sort is not None:
                 below = enc_at.get(id(node.infos[0].final), {})
                 enc = dict(below)
+        elif _is_sort(node):
+            # order-preserving sort: bare encoded keys sort on RANKS
+            # (exec/sort.py) — no decode; computed key expressions over
+            # an encoded column decode
+            enc = dict(cin)
+            bad = set()
+            for o in node.orders:
+                if bare(o.child) in enc:
+                    continue
+                bad |= refs(o.child) & set(enc)
+            if bad:
+                note_decode(node.node_name())
+                for i in bad:
+                    enc.pop(i, None)
+        elif _is_window(node):
+            # bare encoded partition/order refs stay RANK codes; window
+            # function inputs, computed spec expressions, and finite
+            # RANGE offsets decode (mirror exec/window._encoded_plan)
+            from spark_rapids_tpu.ops.window import UNBOUNDED
+
+            enc = dict(cin)
+            spec = node._spec()
+            wexprs = [w for e in node.window_exprs
+                      for w in [_unwrap_window(e)]]
+            finite_range = any(
+                w.spec.frame.frame_type == "range"
+                and (w.spec.frame.lower not in (UNBOUNDED, 0)
+                     or w.spec.frame.upper not in (UNBOUNDED, 0))
+                for w in wexprs)
+            bad = set()
+            for e in spec.partition_by:
+                if bare(e) in enc:
+                    continue
+                bad |= refs(e) & set(enc)
+            for so in spec.order_by:
+                b = bare(so.child)
+                if b in enc and not finite_range:
+                    continue
+                if b in enc:
+                    bad.add(b)
+                bad |= refs(so.child) & set(enc)
+            for w in wexprs:
+                for c in w.function.children():
+                    bad |= refs(c) & set(enc)
+            if bad:
+                note_decode(node.node_name())
+                for i in bad:
+                    enc.pop(i, None)
         else:
-            # sort/window/expand/generate/union/cache/write/unknown:
+            # expand/generate/union/cache/write/unknown:
             # the operator boundary decode
             if any(k for k in kids):
                 note_decode(node.node_name())
@@ -1156,16 +1299,26 @@ class _Analyzer:
             # so rows.lo is typically 0 anyway)
             from spark_rapids_tpu.columnar.encoded import (
                 CODE_BYTES_PER_ROW,
-                STR_BYTES_PER_ROW,
+                decoded_bytes_per_row,
             )
 
-            per_row = STR_BYTES_PER_ROW - CODE_BYTES_PER_ROW
+            # per-claim decoded estimate: the string estimate for STRING
+            # columns, physical width + validity for fixed dictionary
+            # columns — the measured encodedBytesSaved metric's own
+            # formula (columnar/encoded.record_scan_emission)
+            dt_by_name = {a.name: a.data_type for a in node.output}
+            per_rows = {n: max(0, decoded_bytes_per_row(
+                dt_by_name.get(n, DataType.STRING)) - CODE_BYTES_PER_ROW)
+                for n in enc}
+            cert_saved = sum(per_rows[n] for n, s in enc.items()
+                             if s == "certain")
+            all_saved = sum(per_rows.values())
             n_cert = sum(1 for s in enc.values() if s == "certain")
             r = self.report
             r.encoded_cols += len(enc)
             r.encoded_saved = r.encoded_saved.add(
-                Interval(_mul0(st.rows.lo, per_row * n_cert),
-                         _mul0(st.rows.hi, per_row * len(enc))))
+                Interval(_mul0(st.rows.lo, cert_saved),
+                         _mul0(st.rows.hi, all_saved)))
             r.encoded_code_bytes = r.encoded_code_bytes.add(
                 Interval(_mul0(st.rows.lo, _ENC_ROW_BYTES * n_cert),
                          _mul0(st.rows.hi, _ENC_ROW_BYTES * len(enc))))
@@ -1174,6 +1327,14 @@ class _Analyzer:
                                (4 + 1 + _STR_BYTES_PER_ROW) * n_cert),
                          _mul0(st.rows.hi,
                                (4 + 1 + _STR_BYTES_PER_ROW) * len(enc))))
+            if self.conf.get(C.RUN_AWARE_ENABLED):
+                # host run-table residency bound: <= maxRunFraction x
+                # rows x (8 B start + 8 B value) per covered column —
+                # HOST bytes (never uploaded), reported, not charged to
+                # the HBM ceiling
+                frac = self.conf.get(C.RUN_AWARE_MAX_RUN_FRACTION)
+                r.run_table_bytes = r.run_table_bytes.add(Interval(
+                    0, _mul0(st.rows.hi, int(16 * frac) * len(enc))))
         return st
 
     def _cached_scan(self, node) -> AbsState:

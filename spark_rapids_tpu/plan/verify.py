@@ -274,10 +274,13 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
                     out.append(
                         f"{name}: encoded-column claim {cname!r} names a "
                         "column the scan does not output")
-                elif a.data_type is not DataType.STRING:
+                elif a.data_type not in (DataType.STRING, DataType.INT64,
+                                         DataType.DATE,
+                                         DataType.TIMESTAMP):
                     out.append(
                         f"{name}: encoded-column claim {cname!r} has dtype "
-                        f"{a.data_type} — only STRING columns have a "
+                        f"{a.data_type} — only STRING and fixed "
+                        "INT64/DATE/TIMESTAMP columns have a "
                         "dictionary-code representation")
 
     # -- placement edges (every device<->host edge needs a transition) -------

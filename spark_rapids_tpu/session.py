@@ -736,7 +736,8 @@ class TpuSession:
                          M.ADMISSION_WAIT_NS,
                          M.MICRO_BATCHES, M.MICRO_BATCHED_QUERIES,
                          M.ENCODED_COLUMNS, M.LATE_MATERIALIZATIONS,
-                         M.ENCODED_BYTES_SAVED, M.AQE_REPLANS,
+                         M.ENCODED_BYTES_SAVED, M.ORDER_PRESERVING_SORTS,
+                         M.RUN_COLLAPSED_ROWS, M.AQE_REPLANS,
                          M.SKEW_SPLITS, M.JOIN_DEMOTIONS,
                          M.JOIN_PROMOTIONS, M.CANCELLED_QUERIES,
                          M.DEADLINE_REJECTS, M.SHED_QUERIES):

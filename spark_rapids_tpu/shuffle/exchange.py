@@ -910,52 +910,108 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         GpuRangePartitioner.scala:42-230, does the same). Bucket assignment
         is fully vectorized — composite keys pack into one bytes column and
         bounds/ids come from numpy sort/searchsorted. Routing/slicing stays
-        on device."""
+        on device.
+
+        ENCODED bare-ref keys never decode: their int32 CODES download in
+        the same grouped transfer, the host maps them through a union RANK
+        table (columnar/encoded.union_rank_tables — comparable across
+        pieces with different dictionaries), and bounds are sampled as
+        ranks. The batches route and slice still carrying codes — the
+        range-bounds decode point is closed. Only a mixed key position
+        (encoded pieces meeting plain pieces) falls back to host values
+        through the dictionary."""
+        from spark_rapids_tpu.columnar import encoded as ENC
+        from spark_rapids_tpu.ops.base import BoundReference
+
         child_pb = self._child_pb(ctx)
         child_attrs = self.children[0].output
         bound = bind_all([o.child for o in p.orders], child_attrs)
         n = p.num_partitions
         str_key = [b.data_type is DataType.STRING for b in bound]
-        fixed_bound = [b for b, s in zip(bound, str_key) if not s]
-        kernel = _build_order_keys_kernel(fixed_bound) if fixed_bound \
-            else None
+        bare_ord = [b.ordinal if isinstance(b, BoundReference) else None
+                    for b in bound]
+        computed_refs = set()
+        for b in bound:
+            if not isinstance(b, BoundReference):
+                computed_refs |= ENC._bound_ref_ords(b)
+        kernel_memo: dict = {}
+
+        def kernel_for(skip_kis: frozenset):
+            """Order-keys kernel over the fixed keys NOT handled in code
+            space for this batch signature (encoded bare refs download
+            codes instead of evaluating)."""
+            got = kernel_memo.get(skip_kis)
+            if got is None:
+                fb = [b for ki, (b, s) in enumerate(zip(bound, str_key))
+                      if not s and ki not in skip_kis]
+                got = (_build_order_keys_kernel(fb) if fb else None,
+                       len(fb))
+                kernel_memo[skip_kis] = got
+            return got[0]
 
         def mat(pidx: int):
-            """Materialize batches + DISPATCH the order-key kernel per
-            batch, then download the partition's fixed-width order bits in
-            ONE grouped transfer (the per-batch device_get pair this
-            replaces cost 2*n_keys fences per batch on tunneled backends;
-            grouping per PARTITION rather than per exchange keeps peak HBM
-            for key arrays bounded by one partition's batches — the device
-            refs drop as each partition completes)."""
+            """Stage batches + DISPATCH the order-key kernel per batch,
+            then download the partition's fixed-width order bits AND
+            encoded-key codes in ONE grouped transfer (the per-batch
+            device_get pair this replaces cost 2*n_keys fences per batch
+            on tunneled backends; grouping per PARTITION rather than per
+            exchange keeps peak HBM for key arrays bounded by one
+            partition's batches — the device refs drop as each partition
+            completes)."""
             staged = []
             for batch in child_pb.iterator(pidx):
-                from spark_rapids_tpu.columnar.encoded import decode_batch
-
                 if batch.num_rows == 0:
                     continue
-                # tpulint: eager-materialize -- range bounds need VALUES
-                # (codes order is not value order): sanctioned decode
-                batch = decode_batch(batch)
-                cols = [_col_to_colv(c) for c in batch.columns]
-                dev_keys = kernel(cols, jnp.int32(batch.num_rows)) \
-                    if kernel is not None else []
-                staged.append((batch, dev_keys))
+                enc = set(ENC.encoded_ordinals(batch))
+                if enc & computed_refs:
+                    # tpulint: eager-materialize -- COMPUTED range-key
+                    # expressions need values; bare keys stay codes and
+                    # bound in rank space
+                    batch = ENC.batch_with_materialized(
+                        batch, tuple(sorted(enc & computed_refs)))
+                    enc = set(ENC.encoded_ordinals(batch))
+                enc_kis = frozenset(
+                    ki for ki, o in enumerate(bare_ord)
+                    if o is not None and o in enc)
+                kern = kernel_for(enc_kis)
+                cols = ENC.eval_cols(batch, frozenset(enc)) if enc \
+                    else [_col_to_colv(c) for c in batch.columns]
+                dev_keys = kern(cols, jnp.int32(batch.num_rows)) \
+                    if kern is not None else []
+                enc_cols = [(ki, batch.columns[bare_ord[ki]])
+                            for ki in sorted(enc_kis)]
+                if enc_kis:
+                    M.record_order_preserving_sort()
+                staged.append((batch, dev_keys, enc_cols))
+            to_get = []
+            for _b, dev, encs in staged:
+                for ob, nf in dev:
+                    to_get.extend([ob, nf])
+                for _ki, c in encs:
+                    to_get.extend([c.data, c.validity])
             # tpulint: host-sync -- one grouped key download per partition
-            flat = jax.device_get([arr for _, dev in staged
-                                   for ob, nf in dev for arr in (ob, nf)])
+            flat = jax.device_get(to_get)
             got = iter(flat)
             out = []
-            for batch, dev in staged:
+            for batch, dev, encs in staged:
                 # tpulint: host-sync -- already host: grouped download above
                 fixed_keys = [
                     (np.asarray(next(got))[:batch.num_rows],
                      np.asarray(next(got))[:batch.num_rows])
                     for _ in dev]
+                enc_keys = {}
+                for ki, c in encs:
+                    # tpulint: host-sync -- already host: grouped download
+                    codes = np.asarray(next(got))[:batch.num_rows]
+                    # tpulint: host-sync -- already host: grouped download
+                    valid = np.asarray(next(got))[:batch.num_rows]
+                    enc_keys[ki] = ("enc", codes, valid, c.dictionary)
                 host_keys = []
                 fi = 0
-                for b, is_str in zip(bound, str_key):
-                    if is_str:
+                for ki, (b, is_str) in enumerate(zip(bound, str_key)):
+                    if ki in enc_keys:
+                        host_keys.append(enc_keys[ki])
+                    elif is_str:
                         host_keys.append(
                             ("str", _host_string_values(batch, b.ordinal)))
                     else:
@@ -972,14 +1028,45 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             per_part = run_job_or_serial(ctx.scheduler,
                                          child_pb.num_partitions, mat)
 
+        # encoded keys: global rank tables over the union of every piece's
+        # dictionary; a MIXED position (encoded pieces + plain pieces)
+        # repairs to host values through the dictionary instead
+        enc_tables: dict = {}
+        for ki in range(len(bound)):
+            entries = [hks[ki] for part in per_part for _b, hks in part]
+            kinds = {e[0] for e in entries}
+            if "enc" not in kinds:
+                continue
+            if kinds == {"enc"}:
+                dicts = {e[3].did: e[3] for e in entries}
+                enc_tables[ki] = ENC.union_rank_tables(
+                    list(dicts.values()))
+                continue
+            for part in per_part:
+                for _b, hks in part:
+                    if hks[ki][0] != "enc":
+                        continue
+                    _k, codes, valid, d = hks[ki]
+                    vals = ENC.materialize_host_values(codes, valid, d)
+                    if str_key[ki]:
+                        hks[ki] = ("str", [v if ok else None for v, ok
+                                           in zip(vals, valid)])
+                    else:
+                        # tpulint: host-sync -- numpy bools from the
+                        # grouped download, not device values
+                        hks[ki] = ("bits", (vals.astype(np.int64),
+                                            ~np.asarray(valid, bool)))
+
         # one fixed byte width per string key across all batches so every
         # packed row compares in the same space
         widths = [0] * len(bound)
         for ki, is_str in enumerate(str_key):
-            if is_str:
+            if is_str and ki not in enc_tables:
                 w = 1
                 for part in per_part:
                     for _, host_keys in part:
+                        if host_keys[ki][0] != "str":
+                            continue
                         vals = host_keys[ki][1]
                         w = max(w, max((len(v.encode("utf-8"))
                                         for v in vals if v is not None),
@@ -988,11 +1075,24 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 
         def pack_batch(host_keys) -> np.ndarray:
             levels: List[np.ndarray] = []
-            for (kind, payload), o, w in zip(host_keys, p.orders, widths):
-                if kind == "str":
-                    nr, mat_b = _string_key_levels_np(payload, o, w)
+            for ki, ((kind, *payload), o, w) in enumerate(
+                    zip(host_keys, p.orders, widths)):
+                if kind == "enc":
+                    codes, valid, d = payload
+                    table = enc_tables[ki][d.did]
+                    size = max(len(table), 1)
+                    ranks = table[np.clip(codes, 0, size - 1)] \
+                        if len(table) else np.zeros(len(codes), np.int64)
+                    # tpulint: host-sync -- numpy bools from the grouped
+                    # download, not device values
+                    nr, mat_b = _fixed_key_levels_np(
+                        ranks.astype(np.int64),
+                        ~np.asarray(valid, bool), o)
+                elif kind == "str":
+                    nr, mat_b = _string_key_levels_np(payload[0], o, w)
                 else:
-                    nr, u = _fixed_key_levels_np(payload[0], payload[1], o)
+                    nr, u = _fixed_key_levels_np(payload[0][0],
+                                                 payload[0][1], o)
                     mat_b = u
                 levels.append(nr)
                 levels.append(mat_b)

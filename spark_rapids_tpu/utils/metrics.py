@@ -83,6 +83,14 @@ MICRO_BATCHED_QUERIES = "microBatchedQueries"
 ENCODED_COLUMNS = "encodedColumns"
 LATE_MATERIALIZATIONS = "lateMaterializations"
 ENCODED_BYTES_SAVED = "encodedBytesSaved"
+# order-preserving / run-aware compressed compute (PR: rank-space sorts):
+# orderPreservingSorts counts sorts / range-bound computations / window
+# orderings that ran over rank codes instead of decoding (one count per
+# batch kept in rank space); runCollapsedRows accumulates rows the
+# run-granular aggregate path collapsed away (rows - runs per collapsed
+# update batch)
+ORDER_PRESERVING_SORTS = "orderPreservingSorts"
+RUN_COLLAPSED_ROWS = "runCollapsedRows"
 # adaptive query execution (spark_rapids_tpu/aqe/,
 # docs/adaptive-execution.md): aqeReplans counts rule applications that
 # rewrote (and statically re-validated) the not-yet-executed remainder;
@@ -613,6 +621,32 @@ def record_encoded_bytes_saved(n: int) -> None:
 
 def encoded_bytes_saved() -> int:
     return _ENCODED_BYTES_SAVED.value
+
+
+_ORDER_PRESERVING_SORTS = Metric(ORDER_PRESERVING_SORTS)
+_RUN_COLLAPSED_ROWS = Metric(RUN_COLLAPSED_ROWS)
+
+
+def record_order_preserving_sort(n: int = 1) -> None:
+    """Count one batch whose sort / range-bound / window ordering ran
+    over order-preserving rank codes instead of decoding the column."""
+    _ORDER_PRESERVING_SORTS.add(n)
+    _note(ORDER_PRESERVING_SORTS, n)
+
+
+def order_preserving_sort_count() -> int:
+    return _ORDER_PRESERVING_SORTS.value
+
+
+def record_run_collapsed_rows(n: int) -> None:
+    """Accumulate rows the run-granular aggregate path collapsed away
+    (input rows minus merged runs, per collapsed update batch)."""
+    _RUN_COLLAPSED_ROWS.add(n)
+    _note(RUN_COLLAPSED_ROWS, n)
+
+
+def run_collapsed_row_count() -> int:
+    return _RUN_COLLAPSED_ROWS.value
 
 
 # ---------------------------------------------------------------------------

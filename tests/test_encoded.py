@@ -90,8 +90,8 @@ def test_absent_literal_matches_nothing(session, tmp_path):
 
 @pytest.mark.parametrize("seed", SEEDS[:2])
 def test_sort_over_encoded_oracle_equal(session, tmp_path, seed):
-    """Sort needs VALUES (code order is not value order): the sort
-    boundary decodes, results stay oracle-equal."""
+    """Sort over encoded keys runs in RANK space (the order-preserving
+    sorted dictionary) — no boundary decode; results oracle-equal."""
     path = _write_dict_heavy(tmp_path, seed=seed)
     assert_tpu_and_cpu_are_equal_collect(
         session,
@@ -331,8 +331,13 @@ def test_max_dict_fraction_gates_encoding(session, tmp_path):
     tbl = pa.table({"u": uniq, "v": rng.integers(0, 10, size=n)})
     path = str(tmp_path / "uniq.parquet")
     pq.write_table(tbl, path, use_dictionary=True)
+    # fixed dictionaries off: the low-cardinality INT column would
+    # (correctly) encode and mask the string heuristic this test pins
     run_on_tpu(session, lambda s: s.read.parquet(path)
-               .filter(F.col("v") >= F.lit(0)))
+               .filter(F.col("v") >= F.lit(0)),
+               extra_conf={
+                   "rapids.tpu.sql.encoded.fixedDictionaries.enabled":
+                   False})
     assert session.last_query_metrics["encodedColumns"] == 0
 
 
@@ -653,3 +658,384 @@ def test_spmd_stage_fallback_with_encoded(session, tmp_path):
                                F.sum("v").alias("t")),
         ignore_order=True,
         extra_conf={"rapids.tpu.sql.spmd.enabled": True})
+
+
+# ===========================================================================
+# Order-preserving codes (rank space): sort / range / min-max / window /
+# comparison predicates compute on codes of the SORTED dictionary
+# ===========================================================================
+HOST_LOOP = {"rapids.tpu.sql.spmd.enabled": False}
+
+
+def _write_sorted_lowcard(tmp_path, seed=0, n=4000, name="rr.parquet",
+                          nulls=False):
+    """Sorted / low-cardinality columns: RLE-friendly (run tables attach)
+    AND dictionary-encoded — the run-aware + rank-space flagship shape."""
+    rng = np.random.default_rng(seed)
+    status = np.sort(rng.choice(["open", "closed", "pending"],
+                                size=n)).astype(object)
+    grp = np.sort(rng.integers(0, 8, size=n)).astype(np.int64)
+    flag = rng.choice(["A", "B", "C", "N", "R"], size=n).astype(object)
+    if nulls:
+        flag = np.where(rng.random(n) < 0.05, None, flag)
+    v = rng.integers(0, 10_000, size=n)
+    tbl = pa.table({"status": status, "grp": grp, "flag": flag, "v": v})
+    path = str(tmp_path / name)
+    pq.write_table(tbl, path, use_dictionary=True, row_group_size=2500)
+    return path
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+@pytest.mark.parametrize("asc,nulls_first", [
+    (True, True), (False, False),
+    pytest.param(True, False, marks=pytest.mark.slow),
+    pytest.param(False, True, marks=pytest.mark.slow)])
+def test_encoded_orderby_rank_space(session, tmp_path, seed, asc,
+                                    nulls_first):
+    """ORDER BY over encoded columns sorts on RANK codes — zero decodes
+    before the sink — across directions and null placement."""
+    path = _write_dict_heavy(tmp_path, seed=seed)
+    col = F.col("flag").asc() if (asc and nulls_first) else \
+        F.col("flag").asc_nulls_last() if asc else \
+        F.col("flag").desc_nulls_first() if nulls_first else \
+        F.col("flag").desc()
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .groupBy("flag").agg(F.sum("v").alias("t")).orderBy(col),
+        extra_conf=HOST_LOOP)
+    m = session.last_query_metrics
+    if m["encodedColumns"]:
+        assert m["orderPreservingSorts"] > 0
+
+
+def test_encoded_range_repartition_bounds_in_rank_space(session, tmp_path):
+    """The global-sort RANGE exchange samples bounds as union RANKS from
+    downloaded CODES: the batches route still encoded, and the only
+    decodes are the sink expansions (one per non-empty output
+    partition)."""
+    path = _write_sorted_lowcard(tmp_path, seed=3)
+    got = run_on_tpu(
+        session,
+        lambda s: s.read.parquet(path).select("flag", "v")
+        .orderBy("flag"), extra_conf=HOST_LOOP)
+    m = session.last_query_metrics
+    assert m["encodedColumns"] > 0
+    assert m["orderPreservingSorts"] > 0
+    # sink-only decodes: one expansion of the encoded column per
+    # non-empty sorted output partition, nothing at the range bounds
+    n_out = len({r[0] for r in got})
+    assert 0 < m["lateMaterializations"] <= n_out + 1
+    cpu = run_on_cpu(session,
+                     lambda s: s.read.parquet(path).select("flag", "v")
+                     .orderBy("flag"))
+    assert got == cpu
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_encoded_minmax_rank_space(session, tmp_path, seed):
+    """MIN/MAX over an encoded column reduces int32 RANKS per group and
+    carries the winning CODE through partial -> exchange -> final: the
+    finalize decode point is closed (sink-only expansions)."""
+    path = _write_dict_heavy(tmp_path, seed=seed)
+    got = run_on_tpu(
+        session,
+        lambda s: s.read.parquet(path)
+        .groupBy("status").agg(F.min("flag").alias("mn"),
+                               F.max("flag").alias("mx")),
+        extra_conf=HOST_LOOP)
+    m = session.last_query_metrics
+    cpu = run_on_cpu(
+        session,
+        lambda s: s.read.parquet(path)
+        .groupBy("status").agg(F.min("flag").alias("mn"),
+                               F.max("flag").alias("mx")))
+    assert sorted(got) == sorted(cpu)
+    if m["encodedColumns"]:
+        # ONE output batch with three encoded columns (status, mn, mx):
+        # exactly the sink expansions, nothing at update/merge/finalize
+        assert m["lateMaterializations"] == 3
+
+
+@pytest.mark.parametrize("op,lit", [("lt", "closed"), ("le", "open"),
+                                    ("gt", "closed"), ("ge", "x_absent"),
+                                    ("between", None)])
+def test_comparison_predicates_rank_thresholds(session, tmp_path, op, lit):
+    """<, <=, >, >= (and BETWEEN, which lowers onto them) against string
+    literals rewrite to RANK thresholds — including literals ABSENT from
+    the dictionary — with no decode before the sink."""
+    path = _write_dict_heavy(tmp_path, seed=11, nulls=True)
+
+    def q(s):
+        c = F.col("status")
+        cond = {"lt": c < F.lit(lit), "le": c <= F.lit(lit),
+                "gt": c > F.lit(lit), "ge": c >= F.lit(lit),
+                "between": (c >= F.lit("closed")) & (c <= F.lit("open"))
+                }[op]
+        return s.read.parquet(path).filter(cond) \
+            .groupBy("status").agg(F.count("*").alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True)
+
+
+def test_window_rank_space(session, tmp_path):
+    """Window partition-by/order-by over encoded columns stays encoded as
+    RANK codes; only window-function inputs decode."""
+    from spark_rapids_tpu.plan.window_api import Window
+
+    path = _write_dict_heavy(tmp_path, seed=12, nulls=False)
+    w = Window.partitionBy("status").orderBy("flag")
+    assert_tpu_and_cpu_are_equal_collect(
+        session,
+        lambda s: s.read.parquet(path)
+        .select("status", "flag", "v",
+                F.row_number().over(w).alias("rn")),
+        ignore_order=True, extra_conf=HOST_LOOP)
+    m = session.last_query_metrics
+    if m["encodedColumns"]:
+        assert m["orderPreservingSorts"] > 0
+
+
+def test_sort_and_range_bounds_decode_pragmas_gone():
+    """The decode points are CLOSED, not bypassed: the sanctioned
+    eager-materialize pragmas that marked the sort and range-bounds
+    boundary decodes no longer exist (sorts run on ranks; range bounds
+    sample ranks from downloaded codes)."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    sort_src = (root / "spark_rapids_tpu" / "exec" / "sort.py").read_text()
+    assert "code order is NOT value order" not in sort_src
+    assert "sanctioned decode site" not in sort_src
+    ex_src = (root / "spark_rapids_tpu" / "shuffle" /
+              "exchange.py").read_text()
+    assert "range bounds need VALUES" not in ex_src
+    assert "codes order is not value order" not in ex_src
+
+
+def test_int64_dictionary_chunks(session, tmp_path):
+    """INT64 dictionary-encoded chunks emit encoded columns (ROADMAP
+    item 5): group-by on codes, min/max + comparisons in rank space,
+    oracle-equal; fixedDictionaries.enabled=False restores PR 9
+    behavior."""
+    path = _write_sorted_lowcard(tmp_path, seed=4)
+
+    def q(s):
+        return s.read.parquet(path) \
+            .filter(F.col("grp") >= F.lit(2)) \
+            .groupBy("grp").agg(F.count("*").alias("c"),
+                                F.min("grp").alias("mn"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True,
+                                         extra_conf=HOST_LOOP)
+    m_on = dict(session.last_query_metrics)
+    assert m_on["encodedColumns"] > 0
+    off = run_on_tpu(session, q, extra_conf={
+        **HOST_LOOP,
+        "rapids.tpu.sql.encoded.fixedDictionaries.enabled": False})
+    cpu = run_on_cpu(session, q)
+    assert sorted(off) == sorted(cpu)
+
+
+def test_orc_dictionary_emission(session, tmp_path):
+    """ORC DICTIONARY_V2 string columns join the code-space pipeline
+    under the same eligibility as parquet."""
+    import pyarrow.orc as po
+
+    rng = np.random.default_rng(5)
+    n = 4000
+    tbl = pa.table({
+        "flag": rng.choice(["A", "B", "C", "N", "R"],
+                           size=n).astype(object),
+        "v": rng.integers(0, 100, size=n)})
+    path = str(tmp_path / "t.orc")
+    po.write_table(tbl, path, dictionary_key_size_threshold=1.0)
+
+    def q(s):
+        return s.read.orc(path).filter(F.col("flag") <= F.lit("C")) \
+            .groupBy("flag").agg(F.count("*").alias("c"),
+                                 F.sum("v").alias("t")).orderBy("flag")
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, extra_conf=HOST_LOOP)
+    m = session.last_query_metrics
+    if m["encodedColumns"] == 0:
+        pytest.skip("ORC writer did not dictionary-encode")
+    assert m["orderPreservingSorts"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Rank-table units: construction, caching per interned dictionary,
+# union-remap consistency (incl. the concat regression)
+# ---------------------------------------------------------------------------
+def test_rank_table_construction_and_caching():
+    d = ENC.DeviceDictionary.from_values(["cherry", "apple", "banana"])
+    assert not d.is_sorted
+    assert list(d.rank_codes()) == [2, 0, 1]
+    sd = d.sorted_dict()
+    assert sd.is_sorted and list(sd.host_values()) == [
+        "apple", "banana", "cherry"]
+    # cached per interned dictionary: same objects back
+    assert d.sorted_dict() is sd
+    assert d.rank_remap() is d.rank_remap()
+    d2 = ENC.DeviceDictionary.from_values(["cherry", "apple", "banana"])
+    assert d2 is d and d2.sorted_dict() is sd
+    # an already-sorted dictionary is its own rank space (zero-cost)
+    assert sd.sorted_dict() is sd and sd.rank_remap() is None
+    # rank thresholds: count_lt_le over present and absent literals
+    assert d.count_lt_le("banana") == (1, 2)
+    assert d.count_lt_le("aardvark") == (0, 0)
+    assert d.count_lt_le("zebra") == (3, 3)
+
+
+def test_fixed_rank_table_and_materialize():
+    import jax.numpy as jnp
+
+    d = ENC.DeviceDictionary.from_fixed_values(
+        np.array([30, 10, 20]), DataType.INT64)
+    assert d.is_fixed and list(d.rank_codes()) == [2, 0, 1]
+    assert d.code_of(20) == 2 and d.code_of(15) == -1
+    assert d.count_lt_le(15) == (1, 1)
+    col = ENC.DictionaryColumn(
+        DataType.INT64, jnp.asarray(np.array([0, 1, 2, 0], np.int32)),
+        jnp.asarray(np.array([True, True, True, False])), d)
+    m = ENC.materialize(col)
+    assert m.dtype is DataType.INT64
+    assert list(np.asarray(m.data)[:3]) == [30, 10, 20]
+    r = ENC.to_rank_space(col)
+    assert r.dictionary is d.sorted_dict()
+    assert list(np.asarray(r.data)) == [2, 0, 1, 0]
+
+
+def test_union_remap_rank_consistency(session):
+    """REGRESSION (concat union remap x rank tables): after concat
+    aligns two batches onto a UNION dictionary, ordering the combined
+    codes through the union's rank table must equal value order — a
+    stale pre-union rank permutation can never order post-union codes,
+    because rank tables cache on the immutable interned dictionary and
+    the union is a DIFFERENT dictionary object."""
+    from spark_rapids_tpu.columnar.batch import concat_batches
+    import jax.numpy as jnp
+
+    def enc_batch(values, dict_values):
+        d = ENC.DeviceDictionary.from_values(dict_values)
+        codes = np.array([dict_values.index(v) for v in values], np.int32)
+        cap = 8
+        codes = np.pad(codes, (0, cap - len(codes)))
+        valid = np.zeros(cap, bool)
+        valid[:len(values)] = True
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+        col = ENC.DictionaryColumn(DataType.STRING, jnp.asarray(codes),
+                                   jnp.asarray(valid), d)
+        return ColumnarBatch([col], len(values)), d
+
+    b1, d1 = enc_batch(["mango", "apple"], ["mango", "apple"])
+    b2, d2 = enc_batch(["kiwi", "apple"], ["kiwi", "apple"])
+    rank1_before = d1.rank_codes().copy()
+    merged = concat_batches([b1, b2])
+    u = merged.columns[0].dictionary
+    assert u is not d1 and u is not d2
+    # order the merged codes through the UNION's rank table
+    codes = np.asarray(merged.columns[0].data)[:merged.num_rows]
+    ranks = u.rank_codes()[codes]
+    vals = [u.host_values()[c] for c in codes]
+    assert [v for _, v in sorted(zip(ranks, vals))] == sorted(vals)
+    # the pre-union dictionary's cached table is untouched (immutable)
+    assert list(d1.rank_codes()) == list(rank1_before)
+
+
+def test_serde_roundtrip_fixed_dictionary():
+    from spark_rapids_tpu.columnar.serde import (
+        deserialize_batch,
+        serialize_batch,
+    )
+
+    d = ENC.DeviceDictionary.from_fixed_values(
+        np.array([100, 7, 42]), DataType.INT64)
+    col = ENC.HostDictionaryColumn(
+        DataType.INT64, np.array([2, 0, 1, 2], np.int32),
+        np.array([True, True, False, True]), d)
+    from spark_rapids_tpu.columnar.batch import HostColumnarBatch
+
+    buf = serialize_batch(HostColumnarBatch([col], 4))
+    back = deserialize_batch(buf)
+    c = back.columns[0]
+    assert isinstance(c, ENC.HostDictionaryColumn)
+    assert c.dictionary.value_dtype is DataType.INT64
+    assert c.to_pylist() == [42, 100, None, 42]
+
+
+# ---------------------------------------------------------------------------
+# Run-aware kernels: aggregate per RUN, not per row
+# ---------------------------------------------------------------------------
+def test_run_tables_attach_and_survive_concat(session, tmp_path):
+    from spark_rapids_tpu.io import parquet_device as PD
+    import pyarrow.parquet as pq2
+
+    path = _write_sorted_lowcard(tmp_path, seed=6)
+    md = pq2.ParquetFile(path).metadata
+    idx = {md.row_group(0).column(i).path_in_schema: i
+           for i in range(md.num_columns)}
+    col = md.row_group(0).column(idx["status"])
+    cv = PD.decode_chunk_device(
+        PD.read_chunk_bytes(path, col), DataType.STRING,
+        md.row_group(0).num_rows, max_def=1, codec=col.compression,
+        encoded_ok=True, max_dict_fraction=0.5)
+    assert cv.runs is not None
+    assert cv.runs.num_runs < md.row_group(0).num_rows // 4
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_run_collapsed_aggregate_oracle_equal(session, tmp_path, seed):
+    """Sorted/low-cardinality scan -> the update batch collapses to one
+    row per merged run: counts become run-length sums, integral sums
+    become value x run_length, min/max/filters evaluate per run —
+    oracle-equal with runCollapsedRows > 0."""
+    path = _write_sorted_lowcard(tmp_path, seed=seed)
+
+    def q(s):
+        return s.read.parquet(path) \
+            .filter(F.col("status") != F.lit("zzz")) \
+            .groupBy("status", "grp").agg(
+                F.count("*").alias("c"), F.sum("grp").alias("t"),
+                F.min("grp").alias("mn"), F.max("status").alias("mx"))
+
+    assert_tpu_and_cpu_are_equal_collect(session, q, ignore_order=True,
+                                         extra_conf=HOST_LOOP)
+    m = session.last_query_metrics
+    if m["encodedColumns"]:
+        assert m["runCollapsedRows"] > 0
+
+
+def test_run_aware_off_matches_on(session, tmp_path):
+    path = _write_sorted_lowcard(tmp_path, seed=7)
+
+    def q(s):
+        return s.read.parquet(path).groupBy("status").agg(
+            F.count("*").alias("c"), F.sum("v").alias("t"))
+
+    on = run_on_tpu(session, q, extra_conf=HOST_LOOP)
+    m_on = dict(session.last_query_metrics)
+    off = run_on_tpu(session, q, extra_conf={
+        **HOST_LOOP, "rapids.tpu.sql.runAware.enabled": False})
+    m_off = dict(session.last_query_metrics)
+    assert sorted(on) == sorted(off)
+    assert m_off["runCollapsedRows"] == 0
+    # v (near-unique) is an aggregate input: its column has no run table
+    # only when the scan couldn't prove pure-RLE — the collapse falls
+    # back silently either way; when it engaged, rows really collapsed
+    if m_on["runCollapsedRows"]:
+        assert m_on["runCollapsedRows"] > 0
+
+
+def test_run_fraction_gates_collapse(session, tmp_path):
+    """A run fraction of ~0 disables the collapse (merged runs never
+    clear it)."""
+    path = _write_sorted_lowcard(tmp_path, seed=8)
+    run_on_tpu(session,
+               lambda s: s.read.parquet(path).groupBy("status").agg(
+                   F.count("*").alias("c")),
+               extra_conf={**HOST_LOOP,
+                           "rapids.tpu.sql.runAware.maxRunFraction":
+                           0.0001})
+    assert session.last_query_metrics["runCollapsedRows"] == 0

@@ -2099,20 +2099,29 @@ def main_obs() -> None:
     """Observability suite (`python bench.py --obs`): the flagship query
     traced end to end (docs/observability.md). Records the span-derived
     per-stage wall-time breakdown and the per-operator measured-vs-
-    predicted table — the cost-model calibration signal BENCH_*.json
-    carry from here on (ROADMAP item 4) — plus the overhead contract
-    evidence: deviceDispatches/fencesPerQuery identical tracing on vs
-    off, and the wall-clock delta between the two modes. Writes
-    BENCH_r12.json."""
+    predicted table, plus the overhead contract evidence
+    (deviceDispatches/fencesPerQuery identical tracing on vs off and the
+    wall-clock delta between the two modes) — and, new in r16, the
+    CALIBRATION STATE: a >= 20-query warmup recorded through the flight
+    recorder (obs/history.py), the per-class fitted coefficients /
+    sample counts / error percentiles (obs/calibrate.py, blended with
+    the repo's BENCH trajectory), and the measured-vs-predicted
+    wall-time error on the flagship — ROADMAP item 4's calibration
+    signal, now persisted. Writes BENCH_r16.json."""
+    import tempfile
+
     import jax
 
     import spark_rapids_tpu as srt
     from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.obs import calibrate as CAL
+    from spark_rapids_tpu.obs import history as OH
     from spark_rapids_tpu.utils import metrics as M
 
     platform = jax.devices()[0].platform
     rows = int(os.environ.get("SRT_OBS_ROWS", str(1 << 20)))
     iters = int(os.environ.get("SRT_OBS_ITERS", "3"))
+    warmup = int(os.environ.get("SRT_OBS_WARMUP", "21"))
     s = srt.new_session()
     try:
         df = _build_df(s, rows)
@@ -2140,7 +2149,37 @@ def main_obs() -> None:
         ops = {name: {k: (round(v, 6) if isinstance(v, float) else v)
                       for k, v in rec.items()}
                for name, rec in trace.op_breakdown().items()}
-        _log("obs: EXPLAIN ANALYZE run")
+        _log("obs: flight-recorder warmup (%d queries)" % warmup)
+        hist_path = os.path.join(tempfile.gettempdir(),
+                                 "srt_bench_obs_history.jsonl")
+        try:
+            os.unlink(hist_path)
+        except OSError:
+            pass
+        s.conf.set(C.OBS_HISTORY_ENABLED.key, True)
+        s.conf.set(C.OBS_HISTORY_PATH.key, hist_path)
+        warm_times = []
+        for _ in range(warmup):
+            t0 = time.perf_counter()
+            _run_query(df)
+            warm_times.append(time.perf_counter() - t0)
+        store = OH.active_store()
+        store.flush(60.0)
+        _log("obs: fitting cost model from %d records + BENCH trajectory"
+             % store.snapshot()["records_written"])
+        repo_dir = os.path.dirname(os.path.abspath(__file__))
+        model = CAL.fit_from_store(hist_path, bench_dir=repo_dir)
+        CAL.set_active(model)
+        flagship_report = s.last_resource_report
+        measured_wall_ns = s.last_query_trace.duration_ns
+        pred_lo, pred_hi, calibrated_cls, fallback_cls = \
+            model.predict_report(flagship_report, flat_cost_ms=0.0,
+                                 min_samples=5)
+        mid = 0.5 * (pred_lo + pred_hi) if pred_hi != float("inf") \
+            else pred_lo
+        wall_err = abs(mid - measured_wall_ns) / max(measured_wall_ns, 1)
+        s.conf.set(C.OBS_HISTORY_ENABLED.key, False)
+        _log("obs: EXPLAIN ANALYZE run (calibrated)")
         from spark_rapids_tpu.plan import functions as F
 
         q = (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
@@ -2182,12 +2221,31 @@ def main_obs() -> None:
             if report is not None else None,
             "measured_dispatches": s.last_query_metrics.get(
                 M.DEVICE_DISPATCHES, 0),
+            # the persisted calibration state (ROADMAP item 4): fitted
+            # per-class coefficients + sample counts + error
+            # percentiles, and the flagship's measured-vs-predicted
+            # wall-time error under the fit
+            "history": store.snapshot(),
+            "calibration": model.snapshot(),
+            "calibrated_classes": calibrated_cls,
+            "fallback_classes": fallback_cls,
+            "flagship_wall_measured_s": round(measured_wall_ns / 1e9, 6),
+            "flagship_wall_predicted_s": [
+                round(pred_lo / 1e9, 6),
+                (round(pred_hi / 1e9, 6)
+                 if pred_hi != float("inf") else -1.0)],
+            "flagship_wall_error_ratio": round(wall_err, 4),
+            "flagship_wall_within_3x": bool(
+                pred_hi >= measured_wall_ns / 3.0
+                and pred_lo <= measured_wall_ns * 3.0),
+            "warmup_queries": warmup,
+            "warmup_best_s": round(min(warm_times), 4),
             "explain_analyze": analyzed.splitlines(),
         }
     finally:
         s.stop()
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r12.json")
+                            "BENCH_r16.json")
     with open(out_path, "w") as fh:
         json.dump(result, fh, indent=1)
         fh.write("\n")

@@ -1111,6 +1111,68 @@ OBS_TRACE_ANNOTATIONS = _conf("rapids.tpu.obs.traceAnnotations.enabled").doc(
     "and matter only under an active profiler."
 ).boolean(False)
 
+OBS_HISTORY_ENABLED = _conf("rapids.tpu.obs.history.enabled").doc(
+    "Flight recorder (obs/history.py, docs/observability.md): persist "
+    "one JSONL record per finished query — plan signature, per-operator "
+    "measured spans flattened from the trace, the resource analyzer's "
+    "predicted intervals, correlated engine events (retries, spills, "
+    "sheds, cancellations, AQE rewrites), and the terminal status "
+    "(ok/failed/cancelled/deadline/shed). Persistence is WRITE-BEHIND: "
+    "a single daemon writer appends after the sink, off the query's "
+    "critical path, so the flagship deviceDispatches/fencesPerQuery are "
+    "identical with history on vs off (pinned by tests). Enabling "
+    "history also turns span tracing on for recorded queries — the "
+    "record's per-operator rows ride the span tree."
+).boolean(False)
+
+OBS_HISTORY_PATH = _conf("rapids.tpu.obs.history.path").doc(
+    "Path of the query-history JSONL store. Empty (default) resolves to "
+    "srt_query_history-<pid>.jsonl under the system temp directory — "
+    "point it somewhere durable to accumulate calibration history "
+    "across processes. One line = one complete JSON record; a corrupt "
+    "trailing line (crash mid-append) is skipped on read, never fatal."
+).string("")
+
+OBS_HISTORY_MAX_BYTES = _conf("rapids.tpu.obs.history.maxBytes").doc(
+    "Retention bound of the history store: when an append would push "
+    "the file past this size it is compacted in place to the NEWEST "
+    "records totaling at most half the bound, then the append proceeds "
+    "— the store never grows past maxBytes + one record."
+).check(lambda v: None if v >= 4096 else "must be >= 4096").bytes(16 << 20)
+
+OBS_HISTORY_QUEUE_DEPTH = _conf("rapids.tpu.obs.history.queueDepth").doc(
+    "Bound on query records awaiting the write-behind history writer; "
+    "records past it are DROPPED (counted in the store snapshot) rather "
+    "than blocking a query's completion path."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(256)
+
+OBS_CALIBRATION_ENABLED = _conf("rapids.tpu.obs.calibration.enabled").doc(
+    "Consume the fitted per-operator-class cost model (obs/calibrate.py) "
+    "where the engine prices predicted work: the resource analysis "
+    "renders a predicted wall-time interval, EXPLAIN ANALYZE shows a "
+    "per-operator prediction-error column, and the admission-time "
+    "deadline feasibility check uses calibrated per-class costs instead "
+    "of the flat rapids.tpu.engine.deadline.costPerDispatchMs — which "
+    "stays the cold-start fallback for classes with fewer than "
+    "calibration.minSamples samples."
+).boolean(True)
+
+OBS_CALIBRATION_MIN_SAMPLES = _conf(
+    "rapids.tpu.obs.calibration.minSamples").doc(
+    "Samples a cost class needs before its fitted coefficients are "
+    "trusted; below it the class prices at the flat "
+    "deadline.costPerDispatchMs cold-start fallback "
+    "(docs/observability.md, the cold-start fallback contract)."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(5)
+
+OBS_CALIBRATION_REFIT_EVERY = _conf(
+    "rapids.tpu.obs.calibration.refitEvery").doc(
+    "Refit the cost model from recent history every N recorded queries "
+    "(on the write-behind writer thread, never the query path); 0 "
+    "disables automatic refits (obs.calibrate.fit_from_store remains "
+    "the manual path)."
+).check(lambda v: None if v >= 0 else "must be >= 0").integer(16)
+
 class TpuConf:
     """Resolved view of the settings map (reference: RapidsConf class).
 

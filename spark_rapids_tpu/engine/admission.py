@@ -284,3 +284,43 @@ def _pct_ms(sorted_ns: list, q: float) -> float:
         return 0.0
     idx = min(len(sorted_ns) - 1, int(round(q * (len(sorted_ns) - 1))))
     return sorted_ns[idx] / 1e6
+
+
+# ---------------------------------------------------------------------------
+# Deadline feasibility pricing (docs/observability.md, the cold-start
+# fallback contract): the admission-time deadline check used to price a
+# dispatch with one flat conf number; with a fitted CostModel active
+# (obs/calibrate.py) the prediction prices each operator at its CLASS's
+# calibrated coefficients, the flat costPerDispatchMs covering only the
+# classes with too few samples.
+# ---------------------------------------------------------------------------
+def predict_query_work_s(report, conf) -> "tuple[float, str]":
+    """Predicted wall seconds of one analyzed plan for the deadline
+    feasibility check. Returns (seconds, source) where source is
+    'calibrated' when at least one class priced at fitted coefficients,
+    'flat' for the pure cold-start model, 'none' when no prediction is
+    possible (no report / both models disabled)."""
+    from spark_rapids_tpu import conf as C
+
+    if report is None:
+        return 0.0, "none"
+    cost_ms = conf.get(C.DEADLINE_COST_PER_DISPATCH_MS)
+    model = None
+    if conf.get(C.OBS_CALIBRATION_ENABLED):
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        model = CAL.active_model()
+    if model is not None:
+        lo_ns, hi_ns, calibrated, _fallback = model.predict_report(
+            report, flat_cost_ms=cost_ms,
+            min_samples=conf.get(C.OBS_CALIBRATION_MIN_SAMPLES))
+        if calibrated:
+            # an unbounded hi (an unbounded dispatch/row interval) must
+            # not auto-reject every deadline: fall back to the certain lo
+            ns = hi_ns if hi_ns != _INF else lo_ns
+            return ns / 1e9, "calibrated"
+    if cost_ms > 0:
+        hi = getattr(report.dispatches, "hi", None)
+        if hi is not None and hi == hi and hi != _INF:
+            return float(hi) * cost_ms / 1000.0, "flat"
+    return 0.0, "none"

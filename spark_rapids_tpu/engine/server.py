@@ -351,17 +351,40 @@ class TpuServer:
             M.MICRO_BATCHED_QUERIES: M.micro_batched_query_count(),
         }
 
+    def history_snapshot(self) -> dict:
+        """The flight recorder's store state (obs/history.py): file
+        occupancy, write/drop/compaction counters, and the writer queue
+        depth — None-safe while history is off. Pure host-side reads."""
+        from spark_rapids_tpu.obs import history as OH
+
+        store = OH.active_store()
+        return store.snapshot() if store is not None else {
+            "path": None, "bytes": 0, "records_written": 0,
+            "records_dropped": 0, "pending": 0, "occupancy": 0.0}
+
+    def calibration_snapshot(self) -> dict:
+        """The fitted cost model's per-class coefficients, sample
+        counts, and prediction-error percentiles (obs/calibrate.py);
+        {'active': False} until a fit has been installed."""
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        return CAL.snapshot()
+
     def metrics_snapshot(self) -> dict:
         """The serving telemetry endpoint (docs/observability.md): the
         aggregate metrics() payload extended with per-tenant lifetime
         counters (queries/dispatches/retries/fallbacks + breaker state),
         cache hit RATES, the admission wait histogram (p50/p95, queue
-        depth — snapshot() carries them), and spill-tier occupancy.
+        depth — snapshot() carries them), spill-tier occupancy, the
+        flight recorder's store occupancy, and the calibration model's
+        per-class prediction-error percentiles.
         Pure host-side reads; safe to poll from a scrape thread."""
         from spark_rapids_tpu.engine.retry import CircuitBreaker
         from spark_rapids_tpu.memory.spill import SpillFramework
 
         snap = self.metrics()
+        snap["history"] = self.history_snapshot()
+        snap["calibration"] = self.calibration_snapshot()
         for cache in ("planCache", "jitCache"):
             stats = snap.get(cache) or {}
             looked = (stats.get("hits") or 0) + (stats.get("misses") or 0)

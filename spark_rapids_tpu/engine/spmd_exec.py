@@ -77,6 +77,7 @@ from spark_rapids_tpu.parallel.mesh import (
     all_to_all_table,
     shard_map,
 )
+from spark_rapids_tpu.obs.trace import wall_ns as _wall_ns
 from spark_rapids_tpu.shuffle import ici
 from spark_rapids_tpu.utils import metrics as M
 
@@ -1063,6 +1064,35 @@ def _note_degraded(holder) -> None:
     holder.clear()
 
 
+class _SegmentTimer:
+    """Per-segment measured wall-time of the bind/lower phase — the only
+    per-segment host-observable phase of a chain that compiles into ONE
+    program. `begin(s)` closes segment s-1's window and opens segment
+    s's; the accumulated ns land in the node's `spmdSegment{s}LowerTime`
+    metric, which EXPLAIN ANALYZE renders as one sub-row per segment
+    (obs/analyze.py) instead of one opaque chain row. Clock: the
+    sanctioned obs wall clock via trace.wall_ns (no span is opened, so
+    an exception mid-loop can never leak a current-span token)."""
+
+    __slots__ = ("_node", "_s", "_t0")
+
+    def __init__(self, node):
+        self._node = node
+        self._s = None
+        self._t0 = 0
+
+    def begin(self, s: int) -> None:
+        self.end()
+        self._s = s
+        self._t0 = _wall_ns()
+
+    def end(self) -> None:
+        if self._s is not None:
+            self._node.metrics[f"spmdSegment{self._s}LowerTime"].add(
+                _wall_ns() - self._t0)
+            self._s = None
+
+
 def execute_stage(node, ctx):
     """Run one TpuSpmdStageExec (a chain of segments) as a single mesh
     program; returns the output PartitionedBatches (m live-masked
@@ -1119,7 +1149,9 @@ def _execute_stage_impl(node, ctx, holder):
     def fps(exprs):
         return tuple(e.fingerprint() for e in exprs)
 
+    seg_timer = _SegmentTimer(node)
     for s, info in enumerate(infos):
+        seg_timer.begin(s)
         if s == 0:
             tb = tables_rt[0]
             in_attrs = info.input_attrs
@@ -1287,6 +1319,7 @@ def _execute_stage_impl(node, ctx, holder):
         prev_rcap = rcap
         if s == len(infos) - 1:
             out_dicts_final = result_dicts
+    seg_timer.end()
 
     key = ("spmd_stage", mesh, tuple(keyparts))
     program = get_or_build(
@@ -1399,6 +1432,8 @@ def _execute_stage_impl(node, ctx, holder):
         # the absorbed sort tail ordered encoded keys through the shared
         # code->rank LUT — the in-program form of the rank-space sort
         M.record_order_preserving_sort()
+        # per-node attribution for EXPLAIN ANALYZE's inline counter
+        node.metrics[M.ORDER_PRESERVING_SORTS].add(1)
     if total_joins:
         M.record_spmd_join(total_joins)
     if measured_used:

@@ -713,6 +713,10 @@ class TpuHashAggregateExec(_HashAggregateBase, TpuExec):
                         eff_ops = cu.op_names
                         eff_child_attrs = cu.attrs
                         run_key = True
+                        # per-node attribution: EXPLAIN ANALYZE renders
+                        # the collapse inline on this aggregate's row
+                        self.metrics[M.RUN_COLLAPSED_ROWS].add(
+                            cu.collapsed)
                 if do_update:
                     from spark_rapids_tpu.columnar import encoded as ENC
 

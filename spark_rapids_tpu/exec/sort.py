@@ -177,6 +177,9 @@ class TpuSortExec(_SortBase, TpuExec):
                 if rank_ords:
                     batch = ENC.batch_to_rank_space(batch, rank_ords)
                     M.record_order_preserving_sort()
+                    # per-node attribution: EXPLAIN ANALYZE renders the
+                    # counter inline on THIS operator's row
+                    self.metrics[M.ORDER_PRESERVING_SORTS].add(1)
                 n_chunks = 0
                 plain_str = [i for i in str_ords
                              if not ENC.is_encoded(batch.columns[i])]

@@ -387,6 +387,9 @@ class TpuWindowExec(_WindowBase, TpuExec):
                 if rank_ords:
                     batch = ENC.batch_to_rank_space(batch, rank_ords)
                     M.record_order_preserving_sort()
+                    # per-node attribution for EXPLAIN ANALYZE's inline
+                    # counter column
+                    self.metrics[M.ORDER_PRESERVING_SORTS].add(1)
                 memo = kernel[0]
                 if memo is None or memo[0] != rank_ords:
                     memo = (rank_ords,

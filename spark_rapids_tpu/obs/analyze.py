@@ -9,8 +9,22 @@ the exec node's MetricsMap, diffed against a pre-execution snapshot so
 plan-cache-reused nodes report THIS query only) and, where the analyzer
 produced a NodeEstimate for that operator, the predicted row interval and
 dispatch interval beside them. The trailing totals section pins the
-predicted-vs-actual contract the cost-model roadmap item calibrates from:
+predicted-vs-actual contract the cost observatory calibrates from:
 measured deviceDispatches must sit inside the analyzer's interval.
+
+With a fitted cost model active (obs/calibrate.py), each estimated
+operator additionally shows its calibrated wall-time prediction and a
+PREDICTION-ERROR column (measured wall vs the predicted interval, signed
+percent distance to the nearest bound, 'ok' when inside), and the totals
+show the whole-query predicted wall interval beside the measured wall —
+the closed feedback loop ROADMAP item 4 builds on.
+
+PR 13/14 nodes render structured, not opaque: a `TpuSpmdStageExec` chain
+gets one sub-row per segment (the per-segment measured lowering wall-time
+— the host-observable phase of a chain that runs as ONE program — plus
+its joins and capacity hints), and rank-space sorts / run-collapsed
+aggregates show their orderPreservingSorts / runCollapsedRows counters
+inline on the operator line.
 
 Runs with tracing forced ON (the wall-time column is span-backed), so the
 same call leaves `session.last_query_trace` populated for a Perfetto
@@ -21,11 +35,21 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from spark_rapids_tpu.plan.resources import _fmt_ms
 from spark_rapids_tpu.utils import metrics as M
 
+_INF = float("inf")
 
-def _fmt_ms(ns: int) -> str:
-    return f"{ns / 1e6:.2f}ms"
+
+def _fmt_err(measured_ns: int, lo: float, hi: float) -> str:
+    """Signed prediction error of a measured duration vs a predicted
+    interval: distance to the nearest bound as a percent ('ok' inside
+    the interval, '+NN%' slower than predicted, '-NN%' faster)."""
+    if hi != _INF and measured_ns > hi:
+        return f"+{100.0 * (measured_ns - hi) / max(hi, 1.0):.0f}%"
+    if measured_ns < lo:
+        return f"-{100.0 * (lo - measured_ns) / max(lo, 1.0):.0f}%"
+    return "ok"
 
 
 class _PredictionIndex:
@@ -45,8 +69,40 @@ class _PredictionIndex:
         return q.pop(0) if q else None
 
 
+# per-node diffs of the compressed-compute counters rendered INLINE on
+# the operator row (exec/sort.py, exec/window.py, shuffle/exchange.py,
+# exec/aggregate.py, engine/spmd_exec.py record them per node)
+_INLINE_COUNTERS = (M.ORDER_PRESERVING_SORTS, M.RUN_COLLAPSED_ROWS)
+
+
+def _spmd_segment_lines(node, snap: Dict[str, int],
+                        before: Dict[str, int]) -> str:
+    """One sub-row per chain segment of a TpuSpmdStageExec: the measured
+    per-segment lowering wall-time (engine/spmd_exec._SegmentTimer) plus
+    the segment's shape — joins lowered in-program and the analyzer's
+    bucket-row hint feeding its exchange capacity."""
+    lines = []
+    for s, info in enumerate(node.infos):
+        t_ns = snap.get(f"spmdSegment{s}LowerTime", 0) \
+            - before.get(f"spmdSegment{s}LowerTime", 0)
+        shape = []
+        if info.joins:
+            shape.append(f"Join*{len(info.joins)}")
+        shape.extend(["PartialAgg", "AllToAll", "FinalAgg"])
+        if info.sort is not None:
+            shape.append("Sort")
+        hint = node.bucket_rows_hints[s] \
+            if s < len(node.bucket_rows_hints) else None
+        extras = f" bucketRowsHint={int(hint)}" \
+            if hint and hint != _INF else ""
+        lines.append(f"      seg {s}: {'->'.join(shape)} "
+                     f"[lower={_fmt_ms(t_ns)}{extras}]")
+    return ("\n" + "\n".join(lines)) if lines else ""
+
+
 def _annotation_for(node, pre: Dict[int, Dict[str, int]],
-                    preds: _PredictionIndex) -> str:
+                    preds: _PredictionIndex, model=None,
+                    min_samples: int = 1) -> str:
     snap = node.metrics.snapshot()
     before = pre.get(id(node), {})
     rows = snap.get(M.NUM_OUTPUT_ROWS, 0) - before.get(M.NUM_OUTPUT_ROWS, 0)
@@ -54,15 +110,31 @@ def _annotation_for(node, pre: Dict[int, Dict[str, int]],
         - before.get(M.NUM_OUTPUT_BATCHES, 0)
     t_ns = snap.get(M.TOTAL_TIME, 0) - before.get(M.TOTAL_TIME, 0)
     parts = [f"rows={rows}", f"batches={batches}", f"time={_fmt_ms(t_ns)}"]
+    for name in _INLINE_COUNTERS:
+        v = snap.get(name, 0) - before.get(name, 0)
+        if v:
+            parts.append(f"{name}={v}")
     est = preds.take(node.node_name())
     if est is not None:
         parts.append(f"| predicted rows={est.rows!r} "
                      f"dispatches={est.dispatches!r}")
-    return "  [" + " ".join(parts) + "]"
+        if model is not None:
+            pred = model.predict_node_ns(node.node_name(), est.dispatches,
+                                         est.rows, min_samples)
+            if pred is not None:
+                lo, hi = pred
+                parts.append(f"pred_wall={_fmt_ms(lo)}..{_fmt_ms(hi)} "
+                             f"err={_fmt_err(t_ns, lo, hi)}")
+    suffix = "  [" + " ".join(parts) + "]"
+    from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+
+    if isinstance(node, TpuSpmdStageExec):
+        suffix += _spmd_segment_lines(node, snap, before)
+    return suffix
 
 
 def render_analyzed_plan(physical, pre_metrics: Dict[int, Dict[str, int]],
-                         report) -> str:
+                         report, model=None, min_samples: int = 1) -> str:
     """The measured/predicted tree body (no execution; analyze-and-render
     over an already-executed plan)."""
     from spark_rapids_tpu.plan.meta import explain_string
@@ -70,7 +142,8 @@ def render_analyzed_plan(physical, pre_metrics: Dict[int, Dict[str, int]],
     preds = _PredictionIndex(report)
     return explain_string(
         physical,
-        annotate=lambda node: _annotation_for(node, pre_metrics, preds))
+        annotate=lambda node: _annotation_for(node, pre_metrics, preds,
+                                              model, min_samples))
 
 
 def explain_analyze(session, plan) -> str:
@@ -79,6 +152,8 @@ def explain_analyze(session, plan) -> str:
     True) — the session conf is never touched, so concurrent queries'
     plan-cache signatures (built from the settings map under the plan
     lock) cannot observe a transient flag."""
+    from spark_rapids_tpu import conf as C
+
     cap = session.plan_capture
     cap.start()
     try:
@@ -96,8 +171,16 @@ def explain_analyze(session, plan) -> str:
     pre = pre_list[-1] if pre_list else {}
     report = session.last_resource_report
     qm = session.last_query_metrics
+    model = None
+    min_samples = 1
+    if session.conf.get(C.OBS_CALIBRATION_ENABLED):
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        model = CAL.active_model()
+        min_samples = session.conf.get(C.OBS_CALIBRATION_MIN_SAMPLES)
     lines = ["== EXPLAIN ANALYZE ==",
-             render_analyzed_plan(physical, pre, report),
+             render_analyzed_plan(physical, pre, report, model,
+                                  min_samples),
              "== Query totals =="]
     trace = session.last_query_trace
     if trace is not None:
@@ -114,6 +197,21 @@ def explain_analyze(session, plan) -> str:
         lines.append(f"host fences: measured {measured_f}, "
                      f"predicted {f!r}"
                      f" ({'within' if f_ok else 'OUTSIDE'} interval)")
+        if model is not None:
+            # the whole-query calibrated prediction, re-priced LIVE (a
+            # plan-cache-reused report may predate the current fit)
+            lo, hi, calibrated, fallback = model.predict_report(
+                report,
+                flat_cost_ms=session.conf.get(
+                    C.DEADLINE_COST_PER_DISPATCH_MS),
+                min_samples=min_samples)
+            if calibrated and trace is not None:
+                lines.append(
+                    f"predicted wall time: {_fmt_ms(lo)}..{_fmt_ms(hi)} "
+                    f"(calibrated: {','.join(calibrated)}"
+                    + (f"; flat fallback: {','.join(fallback)}"
+                       if fallback else "")
+                    + f") err={_fmt_err(trace.duration_ns, lo, hi)}")
     else:
         lines.append(f"device dispatches: measured {measured_d} "
                      "(no resource analysis)")

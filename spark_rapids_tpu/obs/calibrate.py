@@ -1,0 +1,423 @@
+"""Calibrated operator cost observatory (docs/observability.md).
+
+Fits per-operator-class cost coefficients — ns/dispatch, ns/row, ns/byte
+for the scan / filter-project / agg / join / sort / exchange /
+spmd-stage classes — from the flight recorder's history store
+(obs/history.py) and from the repo's BENCH_r*.json trajectory, and
+exposes the fit as a `CostModel` snapshot with per-class sample counts
+and error percentiles.
+
+Consumers (the feedback loop ROADMAP item 4 needs):
+
+- `plan/resources.py` renders a predicted wall-time interval per plan in
+  `== Resource analysis ==` when a model is active;
+- `obs/analyze.py` (EXPLAIN ANALYZE) prints a per-operator
+  prediction-error column beside the measured wall-time;
+- `engine/admission.predict_query_work_s` prices deadline feasibility
+  with the calibrated per-class costs — the flat
+  `rapids.tpu.engine.deadline.costPerDispatchMs` stays the COLD-START
+  FALLBACK for classes with fewer than `obs.calibration.minSamples`
+  samples (the fallback contract, docs/observability.md).
+
+Fitting is deliberately robust rather than clever: per class,
+ns/dispatch is the median of wall/dispatches across samples, ns/row and
+ns/byte are medians of the per-sample residual ratios — monotone,
+outlier-resistant, and stable even when a warmup consists of one
+repeated query (where a least-squares fit would be degenerate). Error
+percentiles (p50/p95 of |pred-measured|/measured) quantify how much to
+trust each class.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.obs.trace import wall_ns
+
+_INF = float("inf")
+
+# the operator cost classes (ISSUE 15 / ROADMAP item 4's unit of
+# calibration); `other` absorbs anything unrecognized so every operator
+# prices SOMEWHERE
+CLASSES = ("scan", "filter-project", "agg", "join", "sort", "exchange",
+           "spmd-stage", "other")
+
+# ordered substring patterns over the lowercased span/node name; first
+# hit wins (spmd before agg/join: a chain's name contains both)
+_PATTERNS: Tuple[Tuple[str, str], ...] = (
+    ("spmdstage", "spmd-stage"),
+    ("spmd", "spmd-stage"),
+    # join before the exchange/sort groups: ShuffledHashJoin /
+    # SortMergeJoin name both and are joins
+    ("join", "join"),
+    ("scan", "scan"),
+    ("parquet", "scan"),
+    ("orc", "scan"),
+    ("csv", "scan"),
+    ("hosttodevice", "scan"),
+    ("upload", "scan"),
+    ("prefetch", "scan"),
+    ("exchange", "exchange"),
+    ("shuffle", "exchange"),
+    ("alltoall", "exchange"),
+    ("devicetohost", "exchange"),
+    ("download", "exchange"),
+    ("ici", "exchange"),
+    ("coalesce", "exchange"),
+    ("agg", "agg"),
+    ("sort", "sort"),
+    ("window", "sort"),
+    ("filter", "filter-project"),
+    ("project", "filter-project"),
+    ("fused", "filter-project"),
+    ("expand", "filter-project"),
+    ("limit", "filter-project"),
+    ("generate", "filter-project"),
+)
+
+
+def classify(name: str) -> str:
+    """Cost class of one operator span / plan-node name."""
+    n = (name or "").lower()
+    for pat, cls in _PATTERNS:
+        if pat in n:
+            return cls
+    return "other"
+
+
+def _median(xs: List[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    mid = len(s) // 2
+    if len(s) % 2:
+        return s[mid]
+    return 0.5 * (s[mid - 1] + s[mid])
+
+
+def _pct(sorted_xs: List[float], q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = min(len(sorted_xs) - 1, int(round(q * (len(sorted_xs) - 1))))
+    return sorted_xs[idx]
+
+
+class ClassCoeffs:
+    """One cost class's fitted coefficients + fit quality."""
+
+    __slots__ = ("ns_per_dispatch", "ns_per_row", "ns_per_byte",
+                 "samples", "err_p50", "err_p95")
+
+    def __init__(self, ns_per_dispatch: float = 0.0,
+                 ns_per_row: float = 0.0, ns_per_byte: float = 0.0,
+                 samples: int = 0, err_p50: float = 0.0,
+                 err_p95: float = 0.0):
+        self.ns_per_dispatch = float(ns_per_dispatch)
+        self.ns_per_row = float(ns_per_row)
+        self.ns_per_byte = float(ns_per_byte)
+        self.samples = int(samples)
+        self.err_p50 = float(err_p50)
+        self.err_p95 = float(err_p95)
+
+    def predict_ns(self, dispatches: float, rows: float = 0.0,
+                   nbytes: float = 0.0) -> float:
+        return (self.ns_per_dispatch * dispatches
+                + self.ns_per_row * rows + self.ns_per_byte * nbytes)
+
+    def as_dict(self) -> dict:
+        return {
+            "nsPerDispatch": round(self.ns_per_dispatch, 3),
+            "nsPerRow": round(self.ns_per_row, 6),
+            "nsPerByte": round(self.ns_per_byte, 9),
+            "samples": self.samples,
+            "errP50": round(self.err_p50, 4),
+            "errP95": round(self.err_p95, 4),
+        }
+
+
+class CostModel:
+    """An immutable fitted snapshot: per-class coefficients + provenance.
+
+    `overhead_ns` is the fitted per-query HOST-OVERHEAD constant — the
+    median residual of (measured query wall − Σ per-class predictions)
+    across the fit records. Op spans cover kernel/transfer windows; the
+    scheduler, host assembly, and sink bookkeeping between them are real
+    wall time a whole-query prediction must carry, and a constant fitted
+    from the same distribution is the robust way to carry it."""
+
+    def __init__(self, coeffs: Dict[str, ClassCoeffs],
+                 source: str = "history", records: int = 0,
+                 overhead_ns: float = 0.0, overhead_samples: int = 0,
+                 query_err_p50: float = 0.0, query_err_p95: float = 0.0):
+        self.coeffs = dict(coeffs)
+        self.source = source
+        self.records = int(records)
+        self.overhead_ns = float(overhead_ns)
+        self.overhead_samples = int(overhead_samples)
+        self.query_err_p50 = float(query_err_p50)
+        self.query_err_p95 = float(query_err_p95)
+        self.fitted_at_ns = wall_ns()
+
+    # -- per-node / per-report prediction ------------------------------------
+    def coeffs_for(self, cls: str,
+                   min_samples: int = 1) -> Optional[ClassCoeffs]:
+        c = self.coeffs.get(cls)
+        if c is None or c.samples < max(1, int(min_samples)):
+            return None
+        return c
+
+    def predict_node_ns(self, name: str, dispatches, rows,
+                        min_samples: int = 1):
+        """(lo_ns, hi_ns) for one plan node's estimate intervals, or None
+        when the node's class lacks enough samples. `dispatches`/`rows`
+        duck-type plan.resources.Interval."""
+        c = self.coeffs_for(classify(name), min_samples)
+        if c is None:
+            return None
+        d_lo, d_hi = float(dispatches.lo), float(dispatches.hi)
+        r_lo = float(rows.lo)
+        r_hi = float(rows.hi) if rows.hi != _INF else r_lo
+        lo = c.predict_ns(d_lo, r_lo)
+        hi = c.predict_ns(d_hi, r_hi) if d_hi != _INF else _INF
+        return lo, max(lo, hi)
+
+    def predict_report(self, report, flat_cost_ms: float = 0.0,
+                       min_samples: int = 1):
+        """Predicted wall-time interval (ns) for one PlanResourceReport:
+        calibrated classes price at their fitted coefficients, cold
+        classes at the flat per-dispatch fallback. Returns
+        (lo_ns, hi_ns, calibrated_classes, fallback_classes)."""
+        lo = hi = 0.0
+        calibrated: List[str] = []
+        fallback: List[str] = []
+        flat_ns = max(0.0, float(flat_cost_ms)) * 1e6
+        for est in getattr(report, "nodes", ()) or ():
+            cls = classify(est.name)
+            pred = self.predict_node_ns(est.name, est.dispatches, est.rows,
+                                        min_samples)
+            if pred is not None:
+                lo += pred[0]
+                hi = _INF if (hi == _INF or pred[1] == _INF) \
+                    else hi + pred[1]
+                if cls not in calibrated:
+                    calibrated.append(cls)
+            else:
+                d = est.dispatches
+                lo += float(d.lo) * flat_ns
+                hi = _INF if (hi == _INF or d.hi == _INF) \
+                    else hi + float(d.hi) * flat_ns
+                if cls not in fallback:
+                    fallback.append(cls)
+        if calibrated and self.overhead_samples >= max(1, min_samples):
+            # the whole-QUERY prediction carries the fitted host-overhead
+            # constant once (per-node predictions never do)
+            lo += self.overhead_ns
+            hi = _INF if hi == _INF else hi + self.overhead_ns
+        return lo, hi, calibrated, fallback
+
+    def snapshot(self) -> dict:
+        return {
+            "source": self.source,
+            "records": self.records,
+            "fitted_at_ns": self.fitted_at_ns,
+            "overheadNs": round(self.overhead_ns, 1),
+            "overheadSamples": self.overhead_samples,
+            "queryErrP50": round(self.query_err_p50, 4),
+            "queryErrP95": round(self.query_err_p95, 4),
+            "classes": {cls: c.as_dict()
+                        for cls, c in sorted(self.coeffs.items())},
+        }
+
+
+# ---------------------------------------------------------------------------
+# Fitting
+# ---------------------------------------------------------------------------
+# record statuses the fitter trusts: a cancelled/deadline/shed/failed
+# query's spans are force-closed at kill time (obs/trace.finish), so its
+# per-class walls measure WHERE it died, not what an operator costs —
+# such records persist for observability but never calibrate. Records
+# without a status (unit fixtures) are treated as ok.
+_FIT_STATUSES = (None, "ok", "bench")
+
+
+def _fittable(rec: dict) -> bool:
+    return isinstance(rec.get("classes"), dict) and \
+        rec.get("status") in _FIT_STATUSES
+
+
+def _class_samples(records: List[dict]) -> Dict[str, List[dict]]:
+    """history records -> per-class sample rows {wall_ns, dispatches,
+    rows, bytes} (one sample per (record, class)); killed/failed
+    queries' records are excluded (see _FIT_STATUSES)."""
+    out: Dict[str, List[dict]] = {}
+    for rec in records:
+        if not _fittable(rec):
+            continue
+        classes = rec.get("classes")
+        for cls, s in classes.items():
+            try:
+                w = float(s.get("wall_ns", 0))
+                d = float(s.get("dispatches", 0))
+                r = float(s.get("rows", 0))
+                b = float(s.get("bytes", 0))
+            except (TypeError, ValueError):
+                continue
+            if w <= 0:
+                continue
+            out.setdefault(cls, []).append(
+                {"wall_ns": w, "dispatches": d, "rows": r, "bytes": b})
+    return out
+
+
+def fit(records: List[dict], source: str = "history") -> CostModel:
+    """Fit a CostModel from history records (see module docstring for
+    the estimator). Classes with zero usable samples are absent."""
+    coeffs: Dict[str, ClassCoeffs] = {}
+    for cls, samples in _class_samples(records).items():
+        with_d = [s for s in samples if s["dispatches"] > 0]
+        a = _median([s["wall_ns"] / s["dispatches"] for s in with_d])
+        resid = [(s, max(0.0, s["wall_ns"] - a * s["dispatches"]))
+                 for s in samples]
+        b = _median([r / s["rows"] for s, r in resid if s["rows"] > 0])
+        resid2 = [(s, max(0.0, r - b * s["rows"])) for s, r in resid]
+        c = _median([r / s["bytes"] for s, r in resid2
+                     if s["bytes"] > 0])
+        cc = ClassCoeffs(a, b, c, samples=len(samples))
+        errs = sorted(
+            abs(cc.predict_ns(s["dispatches"], s["rows"], s["bytes"])
+                - s["wall_ns"]) / max(s["wall_ns"], 1.0)
+            for s in samples)
+        cc.err_p50 = _pct(errs, 0.50)
+        cc.err_p95 = _pct(errs, 0.95)
+        coeffs[cls] = cc
+    # second pass: the per-query host-overhead constant — the median of
+    # (measured total wall - sum of per-class predictions) over records
+    # that carry a total wall (bench-synthesized records do not)
+    def _class_pred(rec: dict) -> float:
+        total = 0.0
+        for cls, s in (rec.get("classes") or {}).items():
+            cc = coeffs.get(cls)
+            if cc is not None:
+                try:
+                    total += cc.predict_ns(float(s.get("dispatches", 0)),
+                                           float(s.get("rows", 0)),
+                                           float(s.get("bytes", 0)))
+                except (TypeError, ValueError):
+                    pass
+        return total
+
+    walls: List[Tuple[dict, float]] = []
+    for rec in records:
+        if not _fittable(rec):
+            continue
+        try:
+            wall = float(rec.get("wall_ns", 0))
+        except (TypeError, ValueError):
+            continue
+        if wall > 0:
+            walls.append((rec, wall))
+    overhead = _median([max(0.0, w - _class_pred(rec))
+                        for rec, w in walls])
+    q_errs = sorted(abs((_class_pred(rec) + overhead) - w) / w
+                    for rec, w in walls)
+    return CostModel(coeffs, source=source, records=len(records),
+                     overhead_ns=overhead,
+                     overhead_samples=len(walls),
+                     query_err_p50=_pct(q_errs, 0.50),
+                     query_err_p95=_pct(q_errs, 0.95))
+
+
+def bench_records(bench_dir: str) -> List[dict]:
+    """Synthesize history-shaped records from the BENCH_r*.json
+    trajectory: artifacts carrying a span-derived `op_wall` table
+    (bench.py --obs) contribute one record each. Malformed or
+    signal-free artifacts are skipped — the watchdog
+    (tools/benchwatch.py), not the fitter, polices artifact health."""
+    out: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path, "r") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        op_wall = doc.get("op_wall") if isinstance(doc, dict) else None
+        if not isinstance(op_wall, dict):
+            continue
+        classes: Dict[str, dict] = {}
+        for name, rec in op_wall.items():
+            if not isinstance(rec, dict):
+                continue
+            cls = classes.setdefault(
+                classify(name),
+                {"wall_ns": 0.0, "dispatches": 0.0, "rows": 0.0,
+                 "bytes": 0.0})
+            cls["wall_ns"] += float(rec.get("seconds", 0.0)) * 1e9
+            cls["dispatches"] += float(rec.get("deviceDispatches", 0.0))
+        if classes:
+            out.append({"qid": os.path.basename(path),
+                        "status": "bench", "classes": classes})
+    return out
+
+
+def fit_from_store(path: str,
+                   bench_dir: Optional[str] = None) -> CostModel:
+    """Fit from an on-disk history file, optionally blended with the
+    BENCH_r*.json trajectory in `bench_dir` (each bench artifact is one
+    more record; corrupt trailing history lines are skipped)."""
+    from spark_rapids_tpu.obs import history as OH
+
+    records = OH.read_records(path)
+    source = "history"
+    if bench_dir:
+        records = records + bench_records(bench_dir)
+        source = "history+bench"
+    return fit(records, source=source)
+
+
+# ---------------------------------------------------------------------------
+# The active-model slot (process-wide, torn down with the shared runtime)
+# ---------------------------------------------------------------------------
+_MODEL_LOCK = threading.Lock()
+_MODEL: Optional[CostModel] = None
+
+
+def set_active(model: Optional[CostModel]) -> None:
+    global _MODEL
+    with _MODEL_LOCK:
+        _MODEL = model
+
+
+def active_model() -> Optional[CostModel]:
+    return _MODEL
+
+
+def refit_from_records(records: List[dict]) -> Optional[CostModel]:
+    """Refit + install from in-memory records (the write-behind writer's
+    automatic refit path); returns the installed model, or None when
+    there was nothing to fit."""
+    if not records:
+        return None
+    model = fit(records)
+    if not model.coeffs:
+        return None
+    set_active(model)
+    return model
+
+
+def reset() -> None:
+    set_active(None)
+
+
+def snapshot() -> dict:
+    """The serving endpoint's calibration payload (None-safe)."""
+    m = active_model()
+    if m is None:
+        return {"active": False, "classes": {}}
+    snap = m.snapshot()
+    snap["active"] = True
+    return snap

@@ -107,6 +107,40 @@ def render_prometheus(snapshot: dict) -> str:
                  help_text="bytes resident per spill tier")
         w.sample("srt_spill_tier_buffers", t.get("buffers"),
                  {"tier": tier})
+    # -- flight recorder (obs/history.py) -------------------------------------
+    hist = snapshot.get("history") or {}
+    w.sample("srt_history_bytes", hist.get("bytes"),
+             help_text="query-history store size on disk")
+    w.sample("srt_history_occupancy_ratio", hist.get("occupancy"),
+             help_text="history store bytes / maxBytes retention bound")
+    w.sample("srt_history_records_written_total",
+             hist.get("records_written"), mtype="counter")
+    w.sample("srt_history_records_dropped_total",
+             hist.get("records_dropped"), mtype="counter",
+             help_text="records dropped at the write-behind queue bound")
+    w.sample("srt_history_compactions_total", hist.get("compactions"),
+             mtype="counter")
+    w.sample("srt_history_queue_depth", hist.get("pending"),
+             help_text="records awaiting the write-behind writer")
+    # -- calibrated cost model (obs/calibrate.py) -----------------------------
+    cal = snapshot.get("calibration") or {}
+    w.sample("srt_calibration_active", cal.get("active"),
+             help_text="1 when a fitted cost model is installed")
+    w.sample("srt_calibration_records", cal.get("records"),
+             help_text="history records behind the active fit")
+    for cls, c in sorted((cal.get("classes") or {}).items()):
+        labels = {"op_class": cls}
+        w.sample("srt_cost_class_samples", c.get("samples"), labels,
+                 help_text="fit samples per operator cost class")
+        w.sample("srt_cost_class_ns_per_dispatch",
+                 c.get("nsPerDispatch"), labels)
+        for q in ("p50", "p95"):
+            err = c.get("errP50" if q == "p50" else "errP95")
+            w.sample("srt_cost_class_prediction_error_ratio", err,
+                     {**labels, "quantile": q.replace("p", "0.")},
+                     mtype="summary",
+                     help_text="per-class |pred-measured|/measured "
+                               "prediction-error quantiles")
     # -- micro-batching -------------------------------------------------------
     w.sample("srt_micro_batches_total", snapshot.get("microBatches"),
              mtype="counter")

@@ -229,7 +229,20 @@ class QueryTracer:
     def finish(self) -> "QueryTrace":
         with self._lock:
             self._finished = True
-        self.root.end_ns = wall_ns()
+        end = wall_ns()
+        # a query killed mid-flight (cancel / deadline expiry / shed,
+        # engine/cancel.py) unwinds through exceptions that skip worker
+        # threads' close_span calls: close every still-open span at the
+        # query-end timestamp, so a cancelled query still exports a
+        # COMPLETE tree (valid Perfetto durations, pinned by
+        # tests/test_cancel.py). _finished is set first under the lock,
+        # so no new span can attach while we walk.
+        stack = [self.root]
+        while stack:
+            sp = stack.pop()
+            if sp.end_ns is None:
+                sp.end_ns = end
+            stack.extend(sp.children)
         return QueryTrace(self.root, self.tenant, self.dropped_spans)
 
 

@@ -155,6 +155,12 @@ def _fmt_n(v) -> str:
     return str(int(v))
 
 
+def _fmt_ms(v) -> str:
+    if v == INF:
+        return "inf"
+    return f"{v / 1e6:.2f}ms"
+
+
 def _fmt_bytes(v) -> str:
     if v == INF:
         return "inf"
@@ -515,6 +521,16 @@ class PlanResourceReport:
         self.decode_points: List[str] = []
         self.nodes: List[NodeEstimate] = []
         self.violations: List[PlanViolation] = []
+        # calibrated wall-time prediction (obs/calibrate.py): the fitted
+        # cost model's [lo, hi] ns interval for this plan, attached by
+        # analyze_plan when a model is active at PLAN time (None
+        # otherwise — the render line is conditional, so plans analyzed
+        # without calibration keep the golden EXPLAIN layout).
+        # wall_calibrated/wall_fallback name the classes priced at
+        # fitted vs cold-start-fallback coefficients.
+        self.predicted_wall_ns: Optional[Interval] = None
+        self.wall_calibrated: List[str] = []
+        self.wall_fallback: List[str] = []
 
     # -- hints consumed by session wiring ------------------------------------
     @property
@@ -564,6 +580,15 @@ class PlanResourceReport:
             f"{_fmt_n(self.fences.lo)}..{_fmt_n(self.fences.hi)}",
             f"jit shape-bucket cache keys: {self.compile_keys}",
         ]
+        if self.predicted_wall_ns is not None:
+            cal = ",".join(self.wall_calibrated) or "none"
+            lines.append(
+                f"predicted wall time: "
+                f"{_fmt_ms(self.predicted_wall_ns.lo)}"
+                f"..{_fmt_ms(self.predicted_wall_ns.hi)} "
+                f"(calibrated: {cal}"
+                + (f"; flat fallback: {','.join(self.wall_fallback)}"
+                   if self.wall_fallback else "") + ")")
         if self.spmd_stages:
             total = max(self.total_stages, self.spmd_stages)
             lines.append(
@@ -2461,8 +2486,40 @@ def analyze_plan(plan: PhysicalExec, conf: "C.TpuConf",
         bool(device_manager is not None and device_manager.is_tpu)
         or bool(conf.get(C.BUFFER_DONATION_ASSUME_SUPPORTED))) and \
         not in_checked_mode()
-    return _Analyzer(conf, budget, donation=donation,
-                     measured_stats=measured_stats).run(plan)
+    report = _Analyzer(conf, budget, donation=donation,
+                       measured_stats=measured_stats).run(plan)
+    _attach_wall_prediction(report, conf)
+    return report
+
+
+def _attach_wall_prediction(report: PlanResourceReport,
+                            conf: "C.TpuConf") -> None:
+    """Price the plan's predicted wall time with the fitted cost model
+    (obs/calibrate.py) when one is active: classes with enough samples
+    at their calibrated coefficients, the rest at the flat
+    deadline.costPerDispatchMs cold-start fallback. A plan analyzed
+    before any calibration keeps predicted_wall_ns=None (and the render
+    line absent) — the estimator is additive, never load-bearing."""
+    try:
+        if not conf.get(C.OBS_CALIBRATION_ENABLED):
+            return
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        model = CAL.active_model()
+        if model is None:
+            return
+        lo, hi, calibrated, fallback = model.predict_report(
+            report,
+            flat_cost_ms=conf.get(C.DEADLINE_COST_PER_DISPATCH_MS),
+            min_samples=conf.get(C.OBS_CALIBRATION_MIN_SAMPLES))
+        if not calibrated:
+            return
+        report.predicted_wall_ns = Interval(
+            int(lo), INF if hi == INF else int(hi))
+        report.wall_calibrated = list(calibrated)
+        report.wall_fallback = list(fallback)
+    except Exception:  # noqa: BLE001 - calibration is best-effort
+        report.predicted_wall_ns = None
 
 
 def check_resources(plan: PhysicalExec, conf: "C.TpuConf",

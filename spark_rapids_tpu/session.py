@@ -327,6 +327,14 @@ class TpuSession:
         from spark_rapids_tpu.shuffle import ici as _ici
 
         _ici.reset_mesh()
+        # flight recorder + calibrated cost model (obs/): the history
+        # writer thread and the fitted model are shared-runtime state —
+        # a later session must not inherit a prior test's coefficients
+        from spark_rapids_tpu.obs import calibrate as _cal
+        from spark_rapids_tpu.obs import history as _oh
+
+        _oh.shutdown()
+        _cal.reset()
 
     def set_conf(self, key: str, value: Any) -> None:
         self.conf.set(key, value)
@@ -660,9 +668,17 @@ class TpuSession:
         # force_tracing (EXPLAIN ANALYZE) traces THIS run without touching
         # conf: the settings map feeds plan-cache signatures under
         # _plan_lock, so a transient conf flip would both race concurrent
-        # signature builds and fork the cache key
+        # signature builds and fork the cache key. The flight recorder
+        # (obs/history.py) rides the span tree, so history-enabled
+        # queries trace too — tracing adds zero dispatches and zero
+        # fences (the pinned overhead contract), and so does history
+        # (pinned by tests/test_history.py).
+        from spark_rapids_tpu.obs.trace import wall_ns as _wall_ns
+
+        record_history = self.conf.get(C.OBS_HISTORY_ENABLED)
+        q_started_ns = _wall_ns()
         span_token = None
-        if force_tracing or self.conf.get(C.OBS_TRACING):
+        if force_tracing or self.conf.get(C.OBS_TRACING) or record_history:
             from spark_rapids_tpu.obs.trace import QueryTracer, reset_current_span
 
             qctx.trace = QueryTracer(
@@ -680,11 +696,16 @@ class TpuSession:
         with self._inflight_lock:
             self._inflight.add(qctx.cancel)
         physical = None
+        # explicit success flag for the flight recorder's status tag:
+        # sys.exc_info() inside the finally would also see an ENCLOSING
+        # handler's exception and mislabel a successful nested query
+        q_succeeded = False
         try:
             FI.configure(self.conf, ctx=qctx)
             routed = self._maybe_micro_batch(plan, breaker,
                                              allow_micro_batch)
             if routed is not None:
+                q_succeeded = True
                 return routed
             cpu_fallback_ok = self.conf.get(C.CPU_FALLBACK_ENABLED)
             if breaker.is_open() and cpu_fallback_ok:
@@ -705,6 +726,7 @@ class TpuSession:
                         raise
                     physical, results = self._degrade_device_failure(
                         plan, e, breaker, cpu_fallback_ok, use_plan_cache)
+            q_succeeded = True
             return results
         except (CX.TpuQueryCancelled, CX.TpuOverloadedError) as e:
             # terminal by contract (engine/cancel.py): count it once,
@@ -743,8 +765,9 @@ class TpuSession:
                          M.DEADLINE_REJECTS, M.SHED_QUERIES):
                 self.last_query_metrics[name] = snap.get(name, 0)
             self.last_adaptive_report = list(qctx.aqe_notes)
+            finished_trace = None
             if qctx.trace is not None:
-                self.last_query_trace = qctx.trace.finish()
+                finished_trace = self.last_query_trace = qctx.trace.finish()
                 if span_token is not None:
                     from spark_rapids_tpu.obs.trace import restore_current_span
 
@@ -756,6 +779,10 @@ class TpuSession:
                 for name, v in snap.items():
                     self.tenant_metric_totals[name] = \
                         self.tenant_metric_totals.get(name, 0) + v
+            if record_history:
+                self._record_history(qctx, physical, snap, finished_trace,
+                                     _wall_ns() - q_started_ns,
+                                     q_succeeded)
 
     def _on_query_killed(self, qctx, e: BaseException) -> None:
         """Account + reclaim for a cancelled/shed/deadline-rejected query
@@ -771,10 +798,13 @@ class TpuSession:
                 M.record_shed_query()
             else:
                 M.record_cancelled_query()
+        kind = ("shed" if isinstance(e, CX.TpuOverloadedError)
+                else "deadline" if isinstance(e, CX.TpuDeadlineExceeded)
+                else "cancelled")
+        # terminal-status tag for the flight recorder: the history record
+        # of a killed query carries HOW it died (obs/history.py)
+        qctx.kill_reason = kind
         if qctx.trace is not None:
-            kind = ("shed" if isinstance(e, CX.TpuOverloadedError)
-                    else "deadline" if isinstance(e, CX.TpuDeadlineExceeded)
-                    else "cancelled")
             t = wall_ns()
             qctx.trace.note_span(
                 f"query.{kind}", t, t,
@@ -804,33 +834,62 @@ class TpuSession:
                     pass
         qctx.spill_buffers.clear()
 
+    def _record_history(self, qctx, physical, counters, finished_trace,
+                        wall_total_ns, succeeded: bool) -> None:
+        """Flight recorder (obs/history.py, docs/observability.md):
+        enqueue one record for the finished query onto the write-behind
+        store. Everything captured here is already host-resident (the
+        counter snapshot, the FINISHED span tree, the resource report);
+        flattening, JSON encoding, and disk IO run on the writer thread
+        — nothing below adds a dispatch or a fence to the query."""
+        from spark_rapids_tpu.obs import history as OH
+
+        try:
+            store = OH.get_store(self.conf)
+            if store is None:
+                return
+            status = qctx.kill_reason
+            if status is None:
+                status = "ok" if succeeded else "failed"
+            qid = OH.next_query_id(self.tenant)
+            sig = OH.plan_fingerprint(physical)
+            wall = finished_trace.duration_ns if finished_trace is not None \
+                else wall_total_ns
+            report = qctx.resource_report
+            notes = list(qctx.aqe_notes)
+            tenant = self.tenant
+            store.enqueue(lambda: OH.build_record(
+                qid, tenant, status, sig, wall, counters, finished_trace,
+                report, notes))
+        except Exception:  # noqa: BLE001 - the recorder must never
+            # surface into a query's result path
+            log.warning("history record dropped", exc_info=True)
+
     def _check_deadline_feasible(self, qctx, report) -> None:
         """Admission-time deadline enforcement (docs/fault-tolerance.md):
         a query whose deadline is already spent — or whose predicted
-        work (analyzer dispatch upper bound x costPerDispatchMs) cannot
-        fit the remaining budget — is REJECTED before any device
-        dispatch, instead of admitted to die mid-flight (metric:
-        deadlineRejects)."""
+        work cannot fit the remaining budget — is REJECTED before any
+        device dispatch, instead of admitted to die mid-flight (metric:
+        deadlineRejects). The work prediction prices each operator class
+        at the FITTED cost model when calibration has enough samples
+        (engine/admission.predict_query_work_s, obs/calibrate.py); the
+        flat costPerDispatchMs stays the cold-start fallback."""
         from spark_rapids_tpu.engine import cancel as CX
+        from spark_rapids_tpu.engine.admission import predict_query_work_s
         from spark_rapids_tpu.utils import metrics as M
 
         tok = qctx.cancel if qctx is not None else None
         if tok is None or tok.deadline_ns is None:
             return
         remaining = tok.deadline_remaining_s()
-        predicted_s = 0.0
-        cost_ms = self.conf.get(C.DEADLINE_COST_PER_DISPATCH_MS)
-        if report is not None and cost_ms > 0:
-            hi = getattr(report.dispatches, "hi", None)
-            if hi is not None and hi == hi and hi != float("inf"):
-                predicted_s = float(hi) * cost_ms / 1000.0
+        predicted_s, source = predict_query_work_s(report, self.conf)
         if remaining > predicted_s:
             return
         M.record_deadline_reject()
         tok.cancel("deadline")
         err = CX.TpuDeadlineExceeded(
             f"rejected at admission: predicted work ~{predicted_s:.3f}s "
-            f"cannot fit the remaining deadline "
+            f"({source} cost model) cannot fit the remaining deadline "
             f"{max(0.0, remaining):.3f}s", site="admission")
         err.counted = True
         raise err

@@ -982,6 +982,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                             for ki in sorted(enc_kis)]
                 if enc_kis:
                     M.record_order_preserving_sort()
+                    # per-node attribution for EXPLAIN ANALYZE's inline
+                    # counter column
+                    self.metrics[M.ORDER_PRESERVING_SORTS].add(1)
                 staged.append((batch, dev_keys, enc_cols))
             to_get = []
             for _b, dev, encs in staged:

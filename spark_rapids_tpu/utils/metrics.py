@@ -189,7 +189,7 @@ class QueryContext:
                  "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
                  "resource_report", "retry_policy", "aqe_notes",
                  "spill_plan_hint", "async_dispatch", "donation", "trace",
-                 "cancel", "spill_buffers", "prefetchers")
+                 "cancel", "spill_buffers", "prefetchers", "kill_reason")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -257,6 +257,10 @@ class QueryContext:
         # registers them): cancellation closes them and joins their
         # reader threads (bounded) so no thread outlives the query
         self.prefetchers = []
+        # terminal-status tag for the flight recorder (obs/history.py):
+        # session._on_query_killed stamps "cancelled"/"deadline"/"shed"
+        # so the persisted history record carries how the query ended
+        self.kill_reason = None
 
     def add(self, name: str, n: int) -> None:
         with self._lock:

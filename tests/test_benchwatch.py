@@ -1,0 +1,127 @@
+"""Bench regression watchdog tests (tools/benchwatch.py,
+docs/observability.md).
+
+The watchdog is a tier-1 repo check: `--check` over the repo's own
+BENCH_r*.json trajectory must pass (a malformed artifact fails fast),
+and the diff mode must flag a regressed metric in its bad direction —
+both directions of "bad" (throughput down, overhead up)."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.benchwatch import (  # noqa: E402
+    check_artifacts,
+    diff_trajectory,
+    load_artifact,
+    lower_is_better,
+    main,
+    trajectory,
+)
+
+
+def _write(tmp_path, name, doc):
+    with open(tmp_path / name, "w") as fh:
+        json.dump(doc, fh)
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 repo check: the repo's own artifacts are healthy
+# ---------------------------------------------------------------------------
+def test_repo_trajectory_passes_check_smoke():
+    assert trajectory(REPO), "repo has no BENCH_r*.json trajectory"
+    assert check_artifacts(REPO) == []
+    assert main(["--check", "--dir", REPO]) == 0
+
+
+def test_repo_trajectory_diff_is_invocable():
+    # the diff itself must run over the heterogeneous real artifacts
+    # (non-comparable ones skipped, none malformed); whether it finds a
+    # regression is the bench's business, not this smoke's
+    regressions, comparisons, skipped, errors = \
+        diff_trajectory(REPO, threshold=0.30)
+    assert errors == []
+    assert isinstance(comparisons, list)
+
+
+# ---------------------------------------------------------------------------
+# Malformed artifacts fail fast
+# ---------------------------------------------------------------------------
+def test_malformed_artifact_fails_check(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", {"metric": "m", "value": 1.0})
+    with open(tmp_path / "BENCH_r02.json", "w") as fh:
+        fh.write('{"metric": "m", "value": ')  # truncated write
+    assert main(["--check", "--dir", str(tmp_path)]) == 2
+    errs = check_artifacts(str(tmp_path))
+    assert len(errs) == 1 and "BENCH_r02.json" in errs[0]
+
+
+def test_non_numeric_value_is_malformed(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", {"metric": "m", "value": "fast"})
+    assert main(["--check", "--dir", str(tmp_path)]) == 2
+    doc, err = load_artifact(str(tmp_path / "BENCH_r01.json"))
+    assert doc is None and "non-numeric" in err
+
+
+def test_empty_dir_fails_check(tmp_path):
+    assert main(["--check", "--dir", str(tmp_path)]) == 2
+
+
+def test_schema_free_artifacts_are_skipped_not_malformed(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", {"n": 1, "parsed": []})
+    _write(tmp_path, "BENCH_r02.json", {"bench": "encoded", "rows": 5})
+    assert main(["--check", "--dir", str(tmp_path)]) == 0
+    _regs, _comps, skipped, errors = diff_trajectory(str(tmp_path), 0.3)
+    assert errors == [] and len(skipped) == 2
+
+
+# ---------------------------------------------------------------------------
+# Regression detection, both directions
+# ---------------------------------------------------------------------------
+def test_throughput_regression_exits_nonzero(tmp_path):
+    for i, v in enumerate((10.0, 10.5, 9.8), start=1):
+        _write(tmp_path, f"BENCH_r0{i}.json",
+               {"metric": "serving_qps", "value": v, "unit": "qps"})
+    _write(tmp_path, "BENCH_r04.json",
+           {"metric": "serving_qps", "value": 5.0, "unit": "qps"})
+    rc = main(["--dir", str(tmp_path), "--threshold", "0.30"])
+    assert rc == 1
+    regs, _c, _s, _e = diff_trajectory(str(tmp_path), 0.30)
+    assert len(regs) == 1 and "serving_qps" in regs[0]
+
+
+def test_overhead_regression_direction_is_inverted(tmp_path):
+    # overhead-like metric: UP is bad, DOWN is fine
+    assert lower_is_better("obs_tracing_overhead_ratio", "x")
+    assert lower_is_better("p95_latency", "")
+    assert lower_is_better("best_wall", "s")
+    assert not lower_is_better("serving_qps", "qps")
+    for i, v in enumerate((1.0, 1.02), start=1):
+        _write(tmp_path, f"BENCH_r0{i}.json",
+               {"metric": "flagship_overhead_ratio", "value": v,
+                "unit": "x"})
+    _write(tmp_path, "BENCH_r03.json",
+           {"metric": "flagship_overhead_ratio", "value": 2.0,
+            "unit": "x"})
+    assert main(["--dir", str(tmp_path), "--threshold", "0.30"]) == 1
+    # an IMPROVEMENT (overhead down) is not a regression
+    _write(tmp_path, "BENCH_r03.json",
+           {"metric": "flagship_overhead_ratio", "value": 0.5,
+            "unit": "x"})
+    assert main(["--dir", str(tmp_path), "--threshold", "0.30"]) == 0
+
+
+def test_within_threshold_passes(tmp_path):
+    for i, v in enumerate((10.0, 10.5, 9.8), start=1):
+        _write(tmp_path, f"BENCH_r0{i}.json",
+               {"metric": "serving_qps", "value": v, "unit": "qps"})
+    assert main(["--dir", str(tmp_path), "--threshold", "0.30"]) == 0
+
+
+def test_single_point_series_not_compared(tmp_path):
+    _write(tmp_path, "BENCH_r01.json",
+           {"metric": "only_once", "value": 1.0})
+    assert main(["--dir", str(tmp_path)]) == 0

@@ -43,9 +43,16 @@ def test_obs_package_gated_and_in_sync_scopes():
     """The observability package is covered by the tier-1 gate with the
     executor-layer rule scopes wired over it: mid-query-sync (the
     zero-added-syncs contract of docs/observability.md is machine-
-    checked, not just documented) — while obs/ itself hosts the
-    sanctioned clock, so it is NOT in the naked-timer scope."""
-    from tools.tpulint.core import is_mid_query_scope, is_timer_scope
+    checked, not just documented) — while obs/trace.py itself hosts the
+    sanctioned clock, so it is NOT in the naked-timer scope. The cost
+    observatory's modules (history writer, calibration fitter, the
+    benchwatch CLI) ARE: their durations feed the calibration loop and
+    their waits run while queries are in flight."""
+    from tools.tpulint.core import (
+        is_cancel_wait_scope,
+        is_mid_query_scope,
+        is_timer_scope,
+    )
 
     assert is_mid_query_scope("spark_rapids_tpu/obs/trace.py")
     assert not is_timer_scope("spark_rapids_tpu/obs/trace.py")
@@ -53,6 +60,15 @@ def test_obs_package_gated_and_in_sync_scopes():
     for p in ("spark_rapids_tpu/exec/x.py", "spark_rapids_tpu/engine/x.py",
               "spark_rapids_tpu/shuffle/x.py", "spark_rapids_tpu/aqe/x.py"):
         assert is_timer_scope(p), p
-    findings = lint_paths([os.path.join(REPO, "spark_rapids_tpu", "obs")])
+    # observatory modules: held to naked-timer, uncancellable-wait, and
+    # mid-query-sync (the ISSUE 15 CI satellite)
+    for p in ("spark_rapids_tpu/obs/history.py",
+              "spark_rapids_tpu/obs/calibrate.py",
+              "tools/benchwatch.py"):
+        assert is_timer_scope(p), p
+        assert is_cancel_wait_scope(p), p
+        assert is_mid_query_scope(p), p
+    findings = lint_paths([os.path.join(REPO, "spark_rapids_tpu", "obs"),
+                           os.path.join(REPO, "tools", "benchwatch.py")])
     assert not findings, "tpulint findings:\n" + "\n".join(
         f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings)

@@ -1,0 +1,220 @@
+# tpulint: stdout-protocol -- watchdog CLI: stdout is the report
+"""Bench regression watchdog (docs/observability.md).
+
+Diffs the LATEST `BENCH_r*.json` against the repo's bench trajectory and
+exits nonzero on a regression past the threshold — the repo-check teeth
+behind the flight recorder's calibration signal: a PR that slows a
+flagship shows up as a trajectory break here, not three PRs later.
+
+Usage:
+    python -m tools.benchwatch [--dir DIR] [--threshold 0.30] [--check]
+
+Modes:
+- default: for every metric name that appears in >= 2 trajectory
+  artifacts, compare the newest value against the MEDIAN of the prior
+  ones. Direction is per metric: throughput-like metrics (the default)
+  regress DOWN, latency/overhead-like metrics (unit of seconds, or a
+  name mentioning overhead/latency/seconds/p95) regress UP. Exit 1 when
+  any metric moved past `--threshold` in its bad direction, 2 on a
+  malformed artifact.
+- --check: artifact health smoke (the tier-1 gate,
+  tests/test_benchwatch.py): every BENCH_r*.json must parse as JSON and
+  any artifact claiming the common schema (a `metric` key) must carry a
+  numeric `value`. Exit 2 on the first malformed artifact.
+
+Heterogeneous artifacts are fine: files without the common
+{metric, value} schema (raw probe dumps, suite tables) are listed as
+non-comparable and skipped — only the health check, not the diff,
+polices them.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_TRAJECTORY_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# metric-name / unit shapes whose value REGRESSES UP (lower is better);
+# everything else is treated as throughput-like (higher is better)
+_LOWER_BETTER_NAME = re.compile(
+    r"(?i)(overhead|latency|seconds|wall|p95|p99|_s$|_ms$|_ns$)")
+_LOWER_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "ns"}
+
+
+def trajectory(bench_dir: str) -> List[Tuple[int, str]]:
+    """(round, path) for every BENCH_r*.json, oldest first."""
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _TRAJECTORY_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def load_artifact(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """(doc, error). doc is None exactly when the artifact is malformed:
+    unparseable JSON, a non-object top level, or a common-schema claim
+    (`metric` present) without a numeric `value`."""
+    try:
+        with open(path, "r") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable JSON: {e}"
+    if not isinstance(doc, (dict, list)):
+        return None, f"unexpected top-level {type(doc).__name__}"
+    if isinstance(doc, dict) and "metric" in doc:
+        if not isinstance(doc.get("metric"), str):
+            return None, "non-string 'metric'"
+        v = doc.get("value")
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None, f"non-numeric 'value' {v!r} for " \
+                f"metric {doc['metric']!r}"
+    return doc, None
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    return bool(_LOWER_BETTER_NAME.search(metric)) or \
+        (unit or "").lower() in _LOWER_BETTER_UNITS
+
+
+def _median(xs: List[float]) -> float:
+    # deliberately duplicated from obs/calibrate.py: the watchdog must
+    # stay importable without the package (and its jax imports) so a
+    # bare CI container can run the artifact check
+    s = sorted(xs)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def diff_trajectory(bench_dir: str, threshold: float):
+    """(regressions, comparisons, skipped, errors) over the trajectory.
+    regressions/comparisons are report lines; errors are malformed
+    artifacts."""
+    series: Dict[str, List[Tuple[int, float, str]]] = {}
+    skipped: List[str] = []
+    errors: List[str] = []
+    for rnd, path in trajectory(bench_dir):
+        doc, err = load_artifact(path)
+        if err is not None:
+            errors.append(f"{os.path.basename(path)}: {err}")
+            continue
+        if not (isinstance(doc, dict) and "metric" in doc):
+            skipped.append(os.path.basename(path))
+            continue
+        series.setdefault(doc["metric"], []).append(
+            (rnd, float(doc["value"]), str(doc.get("unit", ""))))
+    newest_round = max((r for pts in series.values() for r, _v, _u in pts),
+                       default=0)
+    regressions: List[str] = []
+    comparisons: List[str] = []
+    for metric, points in sorted(series.items()):
+        if len(points) < 2:
+            continue
+        points.sort()
+        latest_rnd, latest, unit = points[-1]
+        baseline = _median([v for _, v, _ in points[:-1]])
+        if baseline == 0:
+            continue
+        ratio = latest / baseline
+        down = lower_is_better(metric, unit)
+        bad = (ratio > 1.0 + threshold) if down \
+            else (ratio < 1.0 - threshold)
+        # a DEAD series (its last point predates the newest artifact)
+        # is informational only — it would otherwise ring forever
+        stale = latest_rnd < newest_round
+        line = (f"{metric}: r{latest_rnd} {latest:g}{unit} vs trajectory "
+                f"median {baseline:g}{unit} (x{ratio:.3f}, "
+                f"{'lower' if down else 'higher'} is better"
+                + ("; stale series" if stale and bad else "") + ")")
+        comparisons.append(line)
+        if bad and not stale:
+            regressions.append(line)
+    return regressions, comparisons, skipped, errors
+
+
+def check_artifacts(bench_dir: str) -> List[str]:
+    """--check mode: malformed-artifact report lines (empty = healthy)."""
+    errors = []
+    paths = trajectory(bench_dir)
+    if not paths:
+        return [f"no BENCH_r*.json artifacts under {bench_dir}"]
+    for _rnd, path in paths:
+        _doc, err = load_artifact(path)
+        if err is not None:
+            errors.append(f"{os.path.basename(path)}: {err}")
+    return errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    bench_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    threshold = 0.30
+    check_only = False
+    try:
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+            if a == "--dir":
+                i += 1
+                bench_dir = argv[i]
+            elif a.startswith("--dir="):
+                bench_dir = a.split("=", 1)[1]
+            elif a == "--threshold":
+                i += 1
+                threshold = float(argv[i])
+            elif a.startswith("--threshold="):
+                threshold = float(a.split("=", 1)[1])
+            elif a == "--check":
+                check_only = True
+            else:
+                print(__doc__)
+                return 2
+            i += 1
+    except (IndexError, ValueError) as e:
+        # a missing/non-numeric option value is a usage error, not a
+        # traceback: the exit-code contract (1 = regression, 2 =
+        # malformed/usage) must hold for the CI wiring
+        print(f"benchwatch: bad arguments ({e})")
+        print(__doc__)
+        return 2
+
+    if check_only:
+        errors = check_artifacts(bench_dir)
+        if errors:
+            print("benchwatch --check: MALFORMED artifacts:")
+            for e in errors:
+                print(f"  ! {e}")
+            return 2
+        n = len(trajectory(bench_dir))
+        print(f"benchwatch --check: {n} artifacts healthy")
+        return 0
+
+    regressions, comparisons, skipped, errors = \
+        diff_trajectory(bench_dir, threshold)
+    for line in comparisons:
+        marker = "!" if line in regressions else " "
+        print(f"{marker} {line}")
+    if skipped:
+        print(f"  (skipped {len(skipped)} non-comparable artifacts: "
+              + ", ".join(skipped) + ")")
+    if errors:
+        print("benchwatch: MALFORMED artifacts:")
+        for e in errors:
+            print(f"  ! {e}")
+        return 2
+    if regressions:
+        print(f"benchwatch: {len(regressions)} regression(s) past "
+              f"threshold {threshold:.0%}")
+        return 1
+    print(f"benchwatch: no regressions past threshold {threshold:.0%} "
+          f"({len(comparisons)} metrics compared)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

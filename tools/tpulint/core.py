@@ -231,40 +231,61 @@ def is_hot_path(path: str) -> bool:
             or p.endswith("spark_rapids_tpu/ops/eval.py"))
 
 
+# the cost observatory's modules (obs/history.py writer thread,
+# obs/calibrate.py fitter, tools/benchwatch.py CLI) hold to the engine's
+# timing/wait/sync rules even though they live outside the executor
+# layers: the flight recorder's writer runs while queries are in flight
+# (its waits must be bounded, its clock the sanctioned one), and the
+# watchdog is wired into the tier-1 gate
+def _is_observatory_module(p: str) -> bool:
+    return (p.endswith("spark_rapids_tpu/obs/history.py")
+            or p.endswith("spark_rapids_tpu/obs/calibrate.py")
+            or p.endswith("tools/benchwatch.py"))
+
+
 def is_mid_query_scope(path: str) -> bool:
     """Files bound by the issue-ahead sync contract: the executor layers
     (exec/, engine/, the adaptive runtime aqe/ — whose stats collection
     is specified sync-free — and the observability layer obs/, whose
     whole contract is zero added syncs) may block on a device value only
-    at the sink."""
+    at the sink. tools/benchwatch.py (pure host artifact diffing) is
+    held to the same bar."""
     p = _norm(path)
     return ("spark_rapids_tpu/exec/" in p
             or "spark_rapids_tpu/engine/" in p
             or "spark_rapids_tpu/aqe/" in p
-            or "spark_rapids_tpu/obs/" in p)
+            or "spark_rapids_tpu/obs/" in p
+            or _is_observatory_module(p))
 
 
 def is_timer_scope(path: str) -> bool:
     """Files bound by the naked-timer rule: the engine's timed layers,
     where wall-clock reads must go through the span API (obs/trace.py)
-    so durations land on the traced timeline."""
+    so durations land on the traced timeline — plus the observatory
+    modules (history writer / calibration / benchwatch), whose durations
+    feed the SAME calibration loop. obs/trace.py itself hosts the
+    sanctioned clock and stays out of scope."""
     p = _norm(path)
     return ("spark_rapids_tpu/exec/" in p
             or "spark_rapids_tpu/engine/" in p
             or "spark_rapids_tpu/shuffle/" in p
-            or "spark_rapids_tpu/aqe/" in p)
+            or "spark_rapids_tpu/aqe/" in p
+            or _is_observatory_module(p))
 
 
 def is_cancel_wait_scope(path: str) -> bool:
     """Files bound by the uncancellable-wait rule: every layer a query's
     CancelToken must be able to interrupt — the engine, the executors,
-    the IO/prefetch layer, the adaptive runtime, and the shuffle."""
+    the IO/prefetch layer, the adaptive runtime, and the shuffle — plus
+    the flight recorder's write-behind writer (an untimed wait there
+    would wedge shared-runtime teardown)."""
     p = _norm(path)
     return ("spark_rapids_tpu/engine/" in p
             or "spark_rapids_tpu/exec/" in p
             or "spark_rapids_tpu/io/" in p
             or "spark_rapids_tpu/aqe/" in p
-            or "spark_rapids_tpu/shuffle/" in p)
+            or "spark_rapids_tpu/shuffle/" in p
+            or _is_observatory_module(p))
 
 
 def is_shared_state_scope(path: str) -> bool:

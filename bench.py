@@ -2252,6 +2252,191 @@ def main_obs() -> None:
     _emit(result)
 
 
+def main_placement() -> None:
+    """Placement suite (`python bench.py --placement`): the cost-based
+    placement analyzer's acceptance shape (docs/placement.md). Warms the
+    device cost model through the flight recorder, trains the host model
+    from forced-host runs (writing BENCH_r17_cpu.json with the
+    per-operator-class op_wall table that seeds a cold machine's host
+    fit), then sweeps the flagship aggregate 1k -> 1M rows with
+    placement on vs off. Headline: the small-end best-of-N speedup
+    (placement_small_speedup, higher is better) — best-of-N on both
+    sides, the timeit rationale: at the 1k point one collect is ~15ms
+    and thread-pool/GC jitter swamps a median of a few samples, while
+    the minimum is the least noise-contaminated estimate of either
+    path's cost. The p50s stay in the sweep rows for the skeptic. The
+    large end records the device-dispatch delta — the analyzer must
+    not tax the scale the engine exists for. Writes BENCH_r17.json."""
+    import statistics
+    import tempfile
+
+    import jax
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.obs import calibrate as CAL
+    from spark_rapids_tpu.obs import history as OH
+    from spark_rapids_tpu.utils import metrics as M
+
+    platform = jax.devices()[0].platform
+    iters = int(os.environ.get("SRT_PLACEMENT_ITERS", "5"))
+    warmup = int(os.environ.get("SRT_PLACEMENT_WARMUP", "8"))
+    sizes = [1_000, 10_000, 100_000, 1_000_000]
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    hist_path = os.path.join(tempfile.gettempdir(),
+                             "srt_bench_placement_history.jsonl")
+    try:
+        os.unlink(hist_path)
+    except OSError:
+        pass
+    s = srt.new_session()
+    try:
+        s.conf.set(C.OBS_HISTORY_ENABLED.key, True)
+        s.conf.set(C.OBS_HISTORY_PATH.key, hist_path)
+        # train BOTH models at two sizes: a single-size history cannot
+        # separate per-dispatch from per-row coefficients (the fit puts
+        # everything on one term and the transfer fence prices at 0,
+        # which makes the DP emit boundary-happy mixed plans)
+        train_dfs = [_build_df(s, 4096), _build_df(s, 1 << 17)]
+        _log("placement: device-model warmup (%d queries x 2 sizes)"
+             % warmup)
+        for df in train_dfs:
+            for _ in range(warmup):
+                _run_query(df)
+        store = OH.active_store()
+        store.flush(60.0)
+        dev_model = CAL.fit_from_store(hist_path, bench_dir=repo_dir)
+        CAL.set_active(dev_model)
+        _log("placement: host-model training (forced-host runs)")
+        s.conf.set(C.PLACEMENT_ENABLED.key, True)
+        s.conf.set(C.PLACEMENT_MODE.key, "host")
+        host_wall = []
+        for df in train_dfs:
+            for _ in range(max(warmup // 2, 3)):
+                t0 = time.perf_counter()
+                _run_query(df)
+                host_wall.append(time.perf_counter() - t0)
+        store.flush(60.0)
+        # the forced-host runs' per-class walls/rows become the *_cpu
+        # artifact's op_wall table: classify() round-trips class names,
+        # so a cold machine's fit_host_from_store(bench_dir=...) learns
+        # the same coefficients this run measured
+        op_wall = {}
+        for rec in OH.read_records(hist_path):
+            if not CAL.is_host_run(rec):
+                continue
+            for cls, c in (rec.get("classes") or {}).items():
+                slot = op_wall.setdefault(cls,
+                                          {"seconds": 0.0, "rows": 0.0})
+                slot["seconds"] += float(c.get("wall_ns", 0.0)) / 1e9
+                slot["rows"] += float(c.get("rows", 0.0))
+        cpu_doc = {"round": 17, "platform": platform,
+                   "host_best_s": round(min(host_wall), 4),
+                   "op_wall": {cls: {"seconds": round(v["seconds"], 6),
+                                     "rows": v["rows"]}
+                               for cls, v in op_wall.items()}}
+        with open(os.path.join(repo_dir, "BENCH_r17_cpu.json"),
+                  "w") as fh:
+            json.dump(cpu_doc, fh, indent=1)
+            fh.write("\n")
+        host_model = CAL.fit_host_from_store(hist_path,
+                                             bench_dir=repo_dir)
+        CAL.set_active_host(host_model)
+        _log("placement: host classes fitted: %s"
+             % sorted(host_model.coeffs))
+        s.conf.set(C.OBS_HISTORY_ENABLED.key, False)
+        s.conf.set(C.PLACEMENT_MODE.key, "auto")
+        s.conf.set(C.PLACEMENT_MIN_SAMPLES.key, 2)
+
+        def p50_point(n, placement_on):
+            s.conf.set(C.PLACEMENT_ENABLED.key, placement_on)
+            df = _build_df(s, n)
+            from spark_rapids_tpu.plan import functions as F
+
+            qq = (df.filter((F.col("a") % 3 != 0) & (F.col("b") < 0.9))
+                    .withColumn("c", F.col("a") * 2 + 1)
+                    .groupBy("k")
+                    .agg(F.sum("c").alias("s"),
+                         F.count("*").alias("n"),
+                         F.max("a").alias("m")))
+            # small points are cheap but noisy (~15ms against thread-pool
+            # and GC jitter): sample them much harder than the large ones
+            reps = iters if n > 10_000 else max(iters * 8, 24)
+            for _ in range(1 if n > 10_000 else 3):
+                qq.collect()  # warm compiles / cache population
+            walls = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                qq.collect()
+                walls.append(time.perf_counter() - t0)
+            m = dict(s.last_query_metrics)
+            verdict = ""
+            if placement_on:
+                txt = s.explain_plan(qq._plan)
+                i = txt.find("== Placement ==")
+                if i >= 0:
+                    verdict = txt[i:].splitlines()[1].strip()
+                _log("placement: n=%d verdict: %s" % (n, verdict))
+            return (statistics.median(walls), min(walls),
+                    m.get(M.DEVICE_DISPATCHES, 0),
+                    m.get(M.HOST_PLACED_OPS, 0),
+                    verdict)
+
+        sweep = []
+        for n in sizes:
+            off_p50, off_best, off_disp, _, _ = p50_point(n, False)
+            on_p50, on_best, on_disp, on_host_ops, verdict = \
+                p50_point(n, True)
+            _log("placement: n=%d off=%.4fs on=%.4fs best %.4f/%.4f "
+                 "(host ops %d)"
+                 % (n, off_p50, on_p50, off_best, on_best, on_host_ops))
+            sweep.append({"rows": n,
+                          "p50_s_off": round(off_p50, 6),
+                          "p50_s_on": round(on_p50, 6),
+                          "best_s_off": round(off_best, 6),
+                          "best_s_on": round(on_best, 6),
+                          "speedup": (round(off_best / on_best, 4)
+                                      if on_best else 0.0),
+                          "speedup_p50": (round(off_p50 / on_p50, 4)
+                                          if on_p50 else 0.0),
+                          "dispatches_off": off_disp,
+                          "dispatches_on": on_disp,
+                          "host_placed_ops": on_host_ops,
+                          "verdict": verdict})
+        small, large = sweep[0], sweep[-1]
+        result = {
+            "metric": "placement_small_speedup",
+            # headline: placement-on vs off best-of-N at the 1k-row end
+            # — the toy-scale case the analyzer exists for (higher is
+            # better; see the docstring for the estimator choice)
+            "value": small["speedup"],
+            "unit": "x",
+            "vs_baseline": 1.0,
+            "platform": platform,
+            "iters": iters,
+            "sweep": sweep,
+            "small_rows": small["rows"],
+            "small_dispatches_on": small["dispatches_on"],
+            "small_host_placed_ops": small["host_placed_ops"],
+            # the large end must not regress: record the dispatch delta
+            # placement introduces at scale (0 = untouched)
+            "large_rows": large["rows"],
+            "large_dispatch_delta": (large["dispatches_on"]
+                                     - large["dispatches_off"]),
+            "large_speedup": large["speedup"],
+            "device_model_classes": sorted(dev_model.coeffs),
+            "host_model_classes": sorted(host_model.coeffs),
+        }
+    finally:
+        CAL.set_active(None)
+        CAL.set_active_host(None)
+        s.stop()
+    with open(os.path.join(repo_dir, "BENCH_r17.json"), "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    _emit(result)
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         mode = sys.argv[2]
@@ -2290,5 +2475,7 @@ if __name__ == "__main__":
         main_obs()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--overload":
         main_overload()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--placement":
+        main_placement()
     else:
         main()

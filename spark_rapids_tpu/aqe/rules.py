@@ -53,7 +53,9 @@ def rule_catalog() -> List[str]:
     return ["joinStrategy (broadcast demotion/promotion)",
             "skewSplit (oversized stream bucket -> piece-range slices, "
             "build replicated)",
-            "coalescePartitions (unified small-bucket grouping)"]
+            "coalescePartitions (unified small-bucket grouping)",
+            "placementReplan (re-price device-vs-host on measured "
+            "stage sizes)"]
 
 
 def apply_rules(plan: PhysicalExec, ctx):
@@ -70,6 +72,8 @@ def apply_rules(plan: PhysicalExec, ctx):
         plan = _join_strategy(plan, ctx, notes, effects)
     plan = _skew_and_coalesce_joins(plan, ctx, notes, effects)
     plan = _coalesce_single_stages(plan, ctx, notes, effects)
+    if ctx.conf.get(C.PLACEMENT_ENABLED):
+        plan = _replace_placement(plan, ctx, notes, effects)
     return plan, notes, effects
 
 
@@ -346,6 +350,44 @@ def _skew_and_coalesce_joins(plan: PhysicalExec, ctx,
         return node.with_children(new_children)
 
     return plan.transform_up(rewrite)
+
+
+# ---------------------------------------------------------------------------
+# Placement re-plan on measured stage sizes
+# ---------------------------------------------------------------------------
+def _replace_placement(plan: PhysicalExec, ctx,
+                       notes: List[str], effects: List) -> PhysicalExec:
+    """Re-run the cost-based placement analyzer (plan/placement.py) over
+    the not-yet-executed remainder with the materialized stages' MEASURED
+    MapOutputStats replacing the analyzer's plan-time priors — a blown
+    row estimate that flipped the static device-vs-host comparison gets
+    corrected at the next stage boundary. Materialized stages themselves
+    are placement atoms (their data already lives where it lives);
+    idempotent because a re-placed remainder is already on its chosen
+    side and re-prices as a no-op."""
+    from spark_rapids_tpu.plan.placement import place_plan
+
+    stats = {}
+    for stage in plan.collect_nodes(
+            lambda n: isinstance(n, TpuQueryStageExec)):
+        if stage.stats is not None:
+            stats[id(stage)] = stage.stats
+    if not stats:
+        return plan  # nothing measured: the static pass already decided
+    try:
+        placed, rep = place_plan(plan, ctx.conf, measured_stats=stats)
+    except Exception:  # noqa: BLE001 - placement is best-effort
+        log.warning("adaptive placement re-plan failed; keeping the "
+                    "current remainder", exc_info=True)
+        return plan
+    if placed is plan or not rep.changed:
+        return plan
+    effects.append(M.record_placement_replacement)
+    notes.append(
+        f"placementReplan: {rep.host_ops} op(s) re-placed host-side on "
+        f"measured stage sizes ({rep.boundaries} boundary "
+        f"transition(s))")
+    return placed
 
 
 # ---------------------------------------------------------------------------

@@ -928,6 +928,43 @@ RESOURCE_HBM_BUDGET = _conf(
     "physical device."
 ).bytes(0)
 
+PLACEMENT_ENABLED = _conf("rapids.tpu.sql.placement.enabled").doc(
+    "Run the cost-based placement analyzer on every FINAL physical plan "
+    "(plan/placement.py, docs/placement.md): a bottom-up abstract cost "
+    "interpreter that prices each operator on the device (fitted "
+    "CostModel from obs/calibrate.py) and on the host (a parallel "
+    "host-side coefficient fit from CPU-fallback history and *_cpu "
+    "BENCH artifacts), adds transfer-edge costs at every would-be "
+    "boundary, and chooses a per-subtree placement by dynamic "
+    "programming — emitting MIXED plans realized with HostToDeviceExec/"
+    "DeviceToHostExec transitions. The placed plan is re-verified and "
+    "re-priced (planVerify placement rules, resourceAnalysis admission "
+    "cost), rendered in EXPLAIN under '== Placement ==', and every "
+    "decision lands in the flight recorder with a post-hoc "
+    "placementRegret signal. Off by default: placement changes which "
+    "backend executes each operator."
+).boolean(False)
+
+PLACEMENT_MODE = _conf("rapids.tpu.sql.placement.mode").doc(
+    "Placement strategy when the analyzer is enabled. 'auto' (default): "
+    "DP over fitted device/host/transfer costs, cold-start falling back "
+    "to all-device below minSamples. 'device': force every operator "
+    "onto the TPU (today's behavior, useful as the A side of an A/B). "
+    "'host': force the whole plan host-side — the toy-scale escape "
+    "hatch and the training source for the host-side coefficient fit."
+).check(
+    lambda v: None if v in ("auto", "device", "host")
+    else "must be auto|device|host"
+).string("auto")
+
+PLACEMENT_MIN_SAMPLES = _conf("rapids.tpu.sql.placement.minSamples").doc(
+    "Minimum fitted samples an operator class needs on BOTH the device "
+    "and host cost models before 'auto' placement will move it off the "
+    "device. Below this the class is cold and pinned to the TPU — the "
+    "cold-start contract: an unwarmed model reproduces all-device "
+    "plans exactly."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(5)
+
 # ---------------------------------------------------------------------------
 # Multi-tenant serving runtime (engine/server.py, plan/plan_cache.py,
 # engine/admission.py, docs/serving.md)

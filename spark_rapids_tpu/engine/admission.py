@@ -306,14 +306,17 @@ def predict_query_work_s(report, conf) -> "tuple[float, str]":
         return 0.0, "none"
     cost_ms = conf.get(C.DEADLINE_COST_PER_DISPATCH_MS)
     model = None
+    host_model = None
     if conf.get(C.OBS_CALIBRATION_ENABLED):
         from spark_rapids_tpu.obs import calibrate as CAL
 
         model = CAL.active_model()
+        host_model = CAL.active_host_model()
     if model is not None:
         lo_ns, hi_ns, calibrated, _fallback = model.predict_report(
             report, flat_cost_ms=cost_ms,
-            min_samples=conf.get(C.OBS_CALIBRATION_MIN_SAMPLES))
+            min_samples=conf.get(C.OBS_CALIBRATION_MIN_SAMPLES),
+            host_model=host_model)
         if calibrated:
             # an unbounded hi (an unbounded dispatch/row interval) must
             # not auto-reject every deadline: fall back to the certain lo

@@ -1058,6 +1058,107 @@ def _max_sql(a, b):
     return a if a >= b else b
 
 
+_FAST_OPS = frozenset(("sum", "count", "min", "max"))
+
+
+def _fast_groups(evs, n_keys, key_dtypes, ops):
+    """Vectorized group-by for the oracle's hot shape, or None.
+
+    Returns (key_cols, buf_data, buf_valid) group-major arrays — fed to
+    _fast_inter_batch instead of the per-row loop's acc dicts — when
+    every semantic subtlety is provably absent: integer/bool all-valid
+    keys (no _canonical_key float/string/null cases), ops limited to
+    sum/count/min/max, and no NaN among valid float values (the
+    _min_sql/_max_sql NaN ordering). Anything else falls back to the
+    loop. int64 sums wrap per-addition exactly like _HostAcc (modular
+    arithmetic is associative), float sums accumulate in row order via
+    the unbuffered np.*.at ufuncs, and an all-null group stays invalid
+    for sum/min/max (its buf_data slot holds an unused sentinel) while
+    count stays valid.
+    """
+    if not evs or not ops or any(op not in _FAST_OPS for op in ops):
+        return None
+    if len(evs[0].columns) != n_keys + len(ops):
+        return None
+    for dt in key_dtypes:
+        if dt in (DataType.FLOAT32, DataType.FLOAT64, DataType.STRING):
+            return None
+
+    def _cat(cidx, what):
+        # tpulint: host-sync -- CPU-oracle columns; HostColumnVector data
+        # and validity are already numpy, asarray is a no-op view
+        return np.concatenate(
+            [np.asarray(getattr(ev.columns[cidx], what)) for ev in evs]) \
+            if len(evs) > 1 else np.asarray(getattr(evs[0].columns[cidx],
+                                                    what))
+
+    kdata = []
+    for c in range(n_keys):
+        if not _cat(c, "validity").all():
+            return None  # null key rows take the _canonical_key path
+        kd = _cat(c, "data")
+        if kd.dtype.kind not in "iub":
+            return None
+        kdata.append(kd)
+    vdata, vvalid = [], []
+    for j, op in enumerate(ops):
+        d = _cat(n_keys + j, "data")
+        v = _cat(n_keys + j, "validity").astype(bool, copy=False)
+        if op != "count":  # count never reads the value column
+            if d.dtype.kind == "f":
+                if np.isnan(d[v]).any():
+                    return None
+            elif d.dtype.kind not in "iu":
+                return None
+        vdata.append(d)
+        vvalid.append(v)
+
+    total = evs[0].num_rows if len(evs) == 1 else \
+        sum(ev.num_rows for ev in evs)
+    if n_keys == 0:
+        grp_count = 1
+        inv = np.zeros(total, dtype=np.intp)
+        key_cols = []
+    elif n_keys == 1:
+        uniq, inv = np.unique(kdata[0], return_inverse=True)
+        grp_count = len(uniq)
+        key_cols = [uniq]
+    else:
+        mat = np.stack(
+            [k.astype(np.int64, copy=False) for k in kdata], axis=1)
+        uniq, inv = np.unique(mat, axis=0, return_inverse=True)
+        inv = inv.ravel()
+        grp_count = len(uniq)
+        key_cols = [uniq[:, c] for c in range(n_keys)]
+
+    buf_data, buf_valid = [], []
+    for op, d, v in zip(ops, vdata, vvalid):
+        nvalid = np.bincount(
+            inv, weights=v.astype(np.float64),
+            minlength=grp_count).astype(np.int64)
+        if op == "count":
+            buf_data.append(nvalid)
+            buf_valid.append(np.ones(grp_count, dtype=bool))
+            continue
+        is_float = d.dtype.kind == "f"
+        dv = d[v].astype(np.float64 if is_float else np.int64, copy=False)
+        iv = inv[v]
+        if op == "sum":
+            out = np.zeros(grp_count, dtype=dv.dtype)
+            np.add.at(out, iv, dv)
+        elif op == "min":
+            out = np.full(grp_count, np.inf) if is_float else \
+                np.full(grp_count, np.iinfo(np.int64).max, dtype=np.int64)
+            np.minimum.at(out, iv, dv)
+        else:  # max
+            out = np.full(grp_count, -np.inf) if is_float else \
+                np.full(grp_count, np.iinfo(np.int64).min, dtype=np.int64)
+            np.maximum.at(out, iv, dv)
+        buf_data.append(out)
+        buf_valid.append(nvalid > 0)
+    return key_cols, buf_data, buf_valid
+
+
 class CpuHashAggregateExec(_HashAggregateBase, CpuExec):
     placement = "cpu"
 
@@ -1079,6 +1180,7 @@ class CpuHashAggregateExec(_HashAggregateBase, CpuExec):
                 child_attrs) if do_update else None
             saw_input = False
 
+            evs = []
             for batch in child_pb.iterator(pidx):
                 if batch.num_rows == 0:
                     continue
@@ -1087,6 +1189,12 @@ class CpuHashAggregateExec(_HashAggregateBase, CpuExec):
                     ev = cpu_project(bound_update, batch, partition_id=pidx)
                 else:
                     ev = batch
+                evs.append(ev)
+
+            fast = _fast_groups(evs, n_keys, key_dtypes, ops)
+            if fast is not None:
+                evs = []
+            for ev in evs:
                 kcols = ev.columns[:n_keys]
                 vcols = ev.columns[n_keys:]
                 for i in range(ev.num_rows):
@@ -1108,8 +1216,11 @@ class CpuHashAggregateExec(_HashAggregateBase, CpuExec):
                             v = v.item()
                         acc.add(v, bool(col.validity[i]))
 
-            inter = self._build_inter_batch(order, key_rows, groups, saw_input,
-                                            pidx)
+            if fast is not None:
+                inter = self._fast_inter_batch(*fast)
+            else:
+                inter = self._build_inter_batch(order, key_rows, groups,
+                                                saw_input, pidx)
             if inter is None:
                 return
             if self.mode == PARTIAL:
@@ -1123,6 +1234,25 @@ class CpuHashAggregateExec(_HashAggregateBase, CpuExec):
             return count_output(self.metrics, agg_partition(pidx))
 
         return PartitionedBatches(child_pb.num_partitions, factory)
+
+    def _fast_inter_batch(self, key_cols, buf_data, buf_valid):
+        """_build_inter_batch for _fast_groups' group-major arrays: the
+        same inter batch, built column-at-a-time. Invalid buffer slots
+        carry a sentinel in buf_data — zero them BEFORE the dtype cast
+        (inf through an int cast is undefined)."""
+        n = len(key_cols[0]) if key_cols else len(buf_data[0])
+        cols: List[HostColumnVector] = []
+        for c, attr in enumerate(self.grouping):
+            npdt = attr.data_type.to_np()
+            cols.append(HostColumnVector(
+                attr.data_type, key_cols[c].astype(npdt, copy=False),
+                np.ones(n, dtype=bool)))
+        for b, battr in enumerate(self.buffer_attrs):
+            npdt = battr.data_type.to_np()
+            valid = buf_valid[b]
+            data = np.where(valid, buf_data[b], 0).astype(npdt, copy=False)
+            cols.append(HostColumnVector(battr.data_type, data, valid))
+        return HostColumnarBatch(cols, n)
 
     def _build_inter_batch(self, order, key_rows, groups, saw_input, pidx):
         n_keys = len(self.grouping)

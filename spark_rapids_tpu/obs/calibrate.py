@@ -186,10 +186,14 @@ class CostModel:
         return lo, max(lo, hi)
 
     def predict_report(self, report, flat_cost_ms: float = 0.0,
-                       min_samples: int = 1):
+                       min_samples: int = 1, host_model=None):
         """Predicted wall-time interval (ns) for one PlanResourceReport:
         calibrated classes price at their fitted coefficients, cold
-        classes at the flat per-dispatch fallback. Returns
+        classes at the flat per-dispatch fallback. Host-placed nodes of
+        a mixed plan (NodeEstimate.placement == "cpu") price via
+        `host_model` when one is supplied — they dispatch nothing, so
+        the flat per-dispatch fallback correctly prices them at zero
+        when the host model is cold. Returns
         (lo_ns, hi_ns, calibrated_classes, fallback_classes)."""
         lo = hi = 0.0
         calibrated: List[str] = []
@@ -197,8 +201,12 @@ class CostModel:
         flat_ns = max(0.0, float(flat_cost_ms)) * 1e6
         for est in getattr(report, "nodes", ()) or ():
             cls = classify(est.name)
-            pred = self.predict_node_ns(est.name, est.dispatches, est.rows,
-                                        min_samples)
+            pricer = self
+            if host_model is not None and \
+                    getattr(est, "placement", "tpu") == "cpu":
+                pricer = host_model
+            pred = pricer.predict_node_ns(est.name, est.dispatches,
+                                          est.rows, min_samples)
             if pred is not None:
                 lo += pred[0]
                 hi = _INF if (hi == _INF or pred[1] == _INF) \
@@ -340,6 +348,11 @@ def bench_records(bench_dir: str) -> List[dict]:
     out: List[dict] = []
     for path in sorted(glob.glob(os.path.join(bench_dir,
                                               "BENCH_r*.json"))):
+        # *_cpu artifacts are HOST measurements (host_bench_records);
+        # blending them into the device fit would teach the device
+        # model host speeds
+        if os.path.basename(path).endswith("_cpu.json"):
+            continue
         try:
             with open(path, "r") as fh:
                 doc = json.load(fh)
@@ -380,10 +393,148 @@ def fit_from_store(path: str,
 
 
 # ---------------------------------------------------------------------------
+# Host-side fit (plan/placement.py's second price column)
+# ---------------------------------------------------------------------------
+# A history record measures the HOST when the query never dispatched to
+# the device: a CPU fallback, or a plan the placement analyzer put fully
+# host-side. Records without a metrics map at all (hand-built unit
+# fixtures) are conservatively treated as device runs.
+
+def is_host_run(rec: dict) -> bool:
+    """True when this history record's per-class walls measure host
+    execution (zero device dispatches + an explicit host signal)."""
+    metrics = rec.get("metrics")
+    if rec.get("host_run"):
+        return True
+    if not isinstance(metrics, dict):
+        return False
+    if float(metrics.get("deviceDispatches", 0) or 0) > 0:
+        return False
+    return bool(metrics.get("cpuFallbackEvents")
+                or metrics.get("hostPlacedOps"))
+
+
+def host_bench_records(bench_dir: str) -> List[dict]:
+    """Synthesize host-run records from `BENCH_*_cpu.json` artifacts
+    carrying an `op_wall` table (bench.py --placement writes one).
+    Artifacts without per-operator walls (suite-level *_cpu tables)
+    carry no per-class signal and are skipped."""
+    out: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "BENCH_*_cpu.json"))):
+        try:
+            with open(path, "r") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        op_wall = doc.get("op_wall") if isinstance(doc, dict) else None
+        if not isinstance(op_wall, dict):
+            continue
+        classes: Dict[str, dict] = {}
+        for name, rec in op_wall.items():
+            if not isinstance(rec, dict):
+                continue
+            cls = classes.setdefault(
+                classify(name),
+                {"wall_ns": 0.0, "dispatches": 0.0, "rows": 0.0,
+                 "bytes": 0.0})
+            cls["wall_ns"] += float(rec.get("seconds", 0.0)) * 1e9
+            cls["rows"] += float(rec.get("rows", 0.0))
+        if classes:
+            out.append({"qid": os.path.basename(path),
+                        "status": "bench", "host_run": True,
+                        "classes": classes})
+    return out
+
+
+def fit_host(records: List[dict],
+             source: str = "host-history") -> CostModel:
+    """Fit the host-side CostModel from host-run records. Classes whose
+    fitted coefficients are ALL zero are dropped: a wall-only sample
+    (no dispatch/row/byte features) would otherwise fit a zero-cost
+    class that prices every host operator as free."""
+    model = fit(records, source=source)
+    model.coeffs = {
+        cls: c for cls, c in model.coeffs.items()
+        if (c.ns_per_dispatch or c.ns_per_row or c.ns_per_byte)}
+    return model
+
+
+def fit_host_from_store(path: str,
+                        bench_dir: Optional[str] = None) -> CostModel:
+    """Fit the host model from an on-disk history file's host-run
+    records, optionally blended with `BENCH_*_cpu.json` artifacts."""
+    from spark_rapids_tpu.obs import history as OH
+
+    records = [r for r in OH.read_records(path) if is_host_run(r)]
+    source = "host-history"
+    if bench_dir:
+        records = records + host_bench_records(bench_dir)
+        source = "host-history+bench"
+    return fit_host(records, source=source)
+
+
+# ---------------------------------------------------------------------------
+# Transfer-edge coefficients (plan/placement.py's boundary prices)
+# ---------------------------------------------------------------------------
+# Cold-start defaults: ~4 GB/s PCIe-order transfer and a 100 us fence —
+# deliberately round; a warmed device model replaces both from its own
+# fitted classes (HostToDevice/upload spans classify as `scan`,
+# DeviceToHost/download spans as `exchange`).
+_DEFAULT_XFER_NS_PER_BYTE = 0.25
+_DEFAULT_FENCE_NS = 100_000.0
+
+
+class TransferCoeffs:
+    """Per-boundary transfer prices the placement DP charges on every
+    host<->device edge."""
+
+    __slots__ = ("upload_ns_per_byte", "download_ns_per_byte", "fence_ns")
+
+    def __init__(self, upload_ns_per_byte: float = _DEFAULT_XFER_NS_PER_BYTE,
+                 download_ns_per_byte: float = _DEFAULT_XFER_NS_PER_BYTE,
+                 fence_ns: float = _DEFAULT_FENCE_NS):
+        self.upload_ns_per_byte = float(upload_ns_per_byte)
+        self.download_ns_per_byte = float(download_ns_per_byte)
+        self.fence_ns = float(fence_ns)
+
+    def upload_ns(self, nbytes: float) -> float:
+        return self.fence_ns + self.upload_ns_per_byte * max(0.0, nbytes)
+
+    def download_ns(self, nbytes: float) -> float:
+        return self.fence_ns + self.download_ns_per_byte * max(0.0, nbytes)
+
+    def as_dict(self) -> dict:
+        return {"uploadNsPerByte": round(self.upload_ns_per_byte, 6),
+                "downloadNsPerByte": round(self.download_ns_per_byte, 6),
+                "fenceNs": round(self.fence_ns, 1)}
+
+
+def transfer_coeffs(model: Optional[CostModel]) -> TransferCoeffs:
+    """Derive transfer prices from a fitted device model (upload spans
+    land in the `scan` class, download spans in `exchange`), falling
+    back to the cold-start constants per component."""
+    tc = TransferCoeffs()
+    if model is None:
+        return tc
+    up = model.coeffs.get("scan")
+    if up is not None and up.ns_per_byte > 0:
+        tc.upload_ns_per_byte = up.ns_per_byte
+    down = model.coeffs.get("exchange")
+    if down is not None:
+        if down.ns_per_byte > 0:
+            tc.download_ns_per_byte = down.ns_per_byte
+        if down.ns_per_dispatch > 0:
+            tc.fence_ns = down.ns_per_dispatch
+    return tc
+
+
+# ---------------------------------------------------------------------------
 # The active-model slot (process-wide, torn down with the shared runtime)
 # ---------------------------------------------------------------------------
 _MODEL_LOCK = threading.Lock()
 _MODEL: Optional[CostModel] = None
+_HOST_MODEL: Optional[CostModel] = None
 
 
 def set_active(model: Optional[CostModel]) -> None:
@@ -396,13 +547,32 @@ def active_model() -> Optional[CostModel]:
     return _MODEL
 
 
+def set_active_host(model: Optional[CostModel]) -> None:
+    global _HOST_MODEL
+    with _MODEL_LOCK:
+        _HOST_MODEL = model
+
+
+def active_host_model() -> Optional[CostModel]:
+    return _HOST_MODEL
+
+
 def refit_from_records(records: List[dict]) -> Optional[CostModel]:
     """Refit + install from in-memory records (the write-behind writer's
-    automatic refit path); returns the installed model, or None when
-    there was nothing to fit."""
+    automatic refit path); returns the installed device model, or None
+    when there was nothing to fit. Host-run records feed the HOST model
+    instead of polluting the device fit."""
     if not records:
         return None
-    model = fit(records)
+    host_recs = [r for r in records if is_host_run(r)]
+    dev_recs = [r for r in records if not is_host_run(r)]
+    if host_recs:
+        host = fit_host(host_recs)
+        if host.coeffs:
+            set_active_host(host)
+    if not dev_recs:
+        return None
+    model = fit(dev_recs)
     if not model.coeffs:
         return None
     set_active(model)
@@ -411,13 +581,18 @@ def refit_from_records(records: List[dict]) -> Optional[CostModel]:
 
 def reset() -> None:
     set_active(None)
+    set_active_host(None)
 
 
 def snapshot() -> dict:
     """The serving endpoint's calibration payload (None-safe)."""
     m = active_model()
     if m is None:
-        return {"active": False, "classes": {}}
-    snap = m.snapshot()
-    snap["active"] = True
+        snap = {"active": False, "classes": {}}
+    else:
+        snap = m.snapshot()
+        snap["active"] = True
+    h = active_host_model()
+    if h is not None:
+        snap["host"] = h.snapshot()
     return snap

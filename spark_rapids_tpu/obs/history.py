@@ -95,7 +95,9 @@ def _interval(iv) -> Optional[List[float]]:
 
 def build_record(qid: str, tenant: str, status: str, plan_sig,
                  wall_ns_total: int, counters: Dict[str, int], trace,
-                 report, aqe_notes: List[str]) -> dict:
+                 report, aqe_notes: List[str],
+                 placement: Optional[dict] = None,
+                 host_op_rows: Optional[List[tuple]] = None) -> dict:
     """Flatten one finished query into its history record (runs on the
     WRITER thread — everything passed in is immutable/finished by the
     time the session enqueued it)."""
@@ -176,7 +178,64 @@ def build_record(qid: str, tenant: str, status: str, plan_sig,
         classes["exchange"]["bytes"] = cb
     elif cb and "spmd-stage" in classes:
         classes["spmd-stage"]["bytes"] = cb
+    # host-run synthesis: Cpu operators have no kernel chokepoint that
+    # opens op spans, so a zero-dispatch host run (placement analyzer
+    # or CPU fallback) would persist an EMPTY class table and the host
+    # fit (obs/calibrate.fit_host) would never train. Apportion the
+    # measured query wall across the analyzer's host-placed classes by
+    # exact row volume — the host model prices on rows alone, so this
+    # is exactly the feature/response pair it regresses.
+    if wall_ns_total > 0 and \
+            (host_op_rows or report is not None) and \
+            not counters.get("deviceDispatches") and \
+            (counters.get("hostPlacedOps")
+             or counters.get("cpuFallbackEvents")):
+        rows_by_cls: Dict[str, int] = {}
+        if host_op_rows:
+            # measured output rows from the executed Cpu nodes — the
+            # preferred (exact) feature source
+            for op_name, rows in host_op_rows:
+                if rows > 0:
+                    cl_name = CAL.classify(op_name)
+                    rows_by_cls[cl_name] = (rows_by_cls.get(cl_name, 0)
+                                            + int(rows))
+        else:
+            for est in getattr(report, "nodes", ()) or ():
+                if getattr(est, "placement", "tpu") != "cpu":
+                    continue
+                rows_iv = getattr(est, "rows", None)
+                if rows_iv is not None and getattr(rows_iv, "is_exact",
+                                                   False):
+                    cl_name = CAL.classify(est.name)
+                    rows_by_cls[cl_name] = (rows_by_cls.get(cl_name, 0)
+                                            + int(rows_iv.lo))
+        # span-derived classes (engine-level host work like the shuffle
+        # write) measured wall but no rows — backfill the feature so the
+        # host fit keeps them instead of dropping an all-zero class
+        for cl_name, c in classes.items():
+            if not c.get("rows") and rows_by_cls.get(cl_name):
+                c["rows"] = rows_by_cls[cl_name]
+        missing = {cl_name: rows for cl_name, rows in rows_by_cls.items()
+                   if cl_name not in classes and rows > 0}
+        spent = sum(c.get("wall_ns", 0) for c in classes.values())
+        budget = max(0, int(wall_ns_total) - int(spent))
+        total_rows = sum(missing.values())
+        if total_rows > 0 and budget > 0:
+            for cl_name, rows in missing.items():
+                classes[cl_name] = {
+                    "wall_ns": max(1, int(budget * rows / total_rows)),
+                    "dispatches": 0, "rows": rows, "bytes": 0}
     rec["classes"] = classes
+    # placement decision + post-hoc regret (plan/placement.py): when the
+    # analyzer moved work and predicted the road NOT taken at `altNs`,
+    # a measured wall past that prediction is regret — the self-
+    # correction signal bad coefficients surface as
+    if placement:
+        rec["placement"] = dict(placement)
+        alt = placement.get("altNs")
+        if isinstance(alt, (int, float)) and alt == alt and \
+                alt != float("inf") and wall_ns_total > 0:
+            rec["placementRegret"] = max(0, int(wall_ns_total - alt))
     return rec
 
 
@@ -204,6 +263,11 @@ class QueryHistoryStore:
         self.recent: deque = deque(maxlen=512)
         self._refit_every = 0
         self._since_refit = 0
+        # tpulint: naked-thread -- write-behind daemon: deliberately
+        # context-free. It serves EVERY tenant's queue for the store's
+        # whole lifetime; record builders are closures that captured
+        # their query's state at enqueue time, so no ambient
+        # QueryContext belongs on this thread.
         self._writer = threading.Thread(
             target=self._writer_loop, name="srt-history-writer",
             daemon=True)

@@ -1,0 +1,703 @@
+"""Cost-based placement analyzer (docs/placement.md).
+
+A bottom-up abstract COST interpreter over the final physical plan — the
+PR 3 mold (plan/resources.py) applied to the device-vs-host decision:
+every operator is priced on the device (the fitted CostModel,
+obs/calibrate.py) and on the host (the parallel host-side fit trained
+from CPU-fallback history and `BENCH_*_cpu.json` artifacts), every
+would-be boundary is priced at the fitted transfer coefficients
+(bytes x upload/download ns/byte + a per-fence constant), and a dynamic
+program over the plan tree picks the cheapest side per subtree:
+
+    dev(n)  = dev_op(n)  + sum_c min(dev(c),  host(c) + up(c))
+    host(n) = host_op(n) + sum_c min(host(c), dev(c)  + down(c))
+
+The winning assignment is REALIZED, not just reported: host-side device
+operators are swapped for their Cpu twins (the inverse of the
+plan/overrides.py EXEC_RULES map), and the standard transition pass
+re-inserts `HostToDeviceExec`/`DeviceToHostExec` at exactly the chosen
+boundaries — so a mixed plan flows through the same verifier
+(plan/verify.py placement rules), resource analyzer, and executor as an
+all-device one.
+
+Cold-start contract (`rapids.tpu.sql.placement.minSamples`): in `auto`
+mode an operator class leaves the device only when the decision is
+calibrated on BOTH sides — the host model carries >= minSamples for the
+class, and the device side is fitted either per-class or at the stage
+granularity the device actually executes (SPMD/fusion rolls member
+spans into the stage class, so a member class the device model has
+never seen is priced by its fitted stage class). Below that the class
+is pinned to the TPU, and with no fitted model at all the pass is an
+exact no-op (today's all-device behavior). SPMD chains are all-or-nothing — a
+`TpuSpmdStageExec` either stays a single device program or its ORIGINAL
+subtree (children[0]) is re-placed host-side wholesale — so no chain
+ever straddles a boundary. Encoded-claiming device scans are
+device-pinned in auto mode (their dictionary claims are meaningless to
+a host scan).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.exec import base as B
+from spark_rapids_tpu.exec import basic as XB
+from spark_rapids_tpu.exec import cache as XC
+from spark_rapids_tpu.exec import expand as XE
+from spark_rapids_tpu.exec import join as XJ
+from spark_rapids_tpu.exec import sort as XS
+from spark_rapids_tpu.exec import window as XW
+from spark_rapids_tpu.exec.aggregate import (
+    CpuHashAggregateExec,
+    TpuHashAggregateExec,
+)
+from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+from spark_rapids_tpu.exec.transitions import (
+    CpuCoalesceBatchesExec,
+    DeviceToHostExec,
+    HostToDeviceExec,
+    TpuCoalesceBatchesExec,
+)
+from spark_rapids_tpu.io.scan import CpuFileScanExec, TpuFileScanExec
+from spark_rapids_tpu.plan.spmd import TpuSpmdStageExec
+from spark_rapids_tpu.plan.transition_overrides import (
+    _insert_transitions,
+    _optimize_transitions,
+)
+from spark_rapids_tpu.shuffle.exchange import (
+    CpuShuffleExchangeExec,
+    TpuShuffleExchangeExec,
+)
+
+_INF = float("inf")
+
+# nodes the DP looks THROUGH: their cost is their child's, and the
+# realization pass rebuilds/re-inserts them on whichever side the child
+# landed (transitions are dropped and re-inserted by the standard pass)
+_TRANSITIONS = (HostToDeviceExec, DeviceToHostExec)
+_COALESCES = (TpuCoalesceBatchesExec, CpuCoalesceBatchesExec)
+
+
+def _host_equiv(node: B.PhysicalExec,
+                kids: Tuple[B.PhysicalExec, ...]) -> Optional[B.PhysicalExec]:
+    """The Cpu twin of one device operator over already-realized
+    children — the inverse of the plan/overrides.py EXEC_RULES map.
+    None when the node has no host form (AQE stage atoms, transitions)."""
+    if isinstance(node, XB.TpuProjectExec):
+        return XB.CpuProjectExec(node.project_list, kids[0])
+    if isinstance(node, XB.TpuFilterExec):
+        return XB.CpuFilterExec(node.condition, kids[0])
+    if isinstance(node, XB.TpuUnionExec):
+        return XB.CpuUnionExec(*kids)
+    if isinstance(node, XB.TpuLocalLimitExec):
+        return XB.CpuLocalLimitExec(node.limit, kids[0])
+    if isinstance(node, XB.TpuGlobalLimitExec):
+        return XB.CpuGlobalLimitExec(node.limit, kids[0])
+    if isinstance(node, TpuHashAggregateExec):
+        return CpuHashAggregateExec(node.grouping, node.agg_exprs,
+                                    node.mode, kids[0], node.specs)
+    if isinstance(node, XS.TpuSortExec):
+        return XS.CpuSortExec(node.orders, kids[0])
+    if isinstance(node, XW.TpuWindowExec):
+        return XW.CpuWindowExec(node.window_exprs, kids[0])
+    if isinstance(node, TpuShuffleExchangeExec):
+        return CpuShuffleExchangeExec(node.partitioning, kids[0],
+                                      node.allow_adaptive)
+    if isinstance(node, XJ.TpuShuffledHashJoinExec):
+        return XJ.CpuShuffledHashJoinExec(
+            node.left_keys, node.right_keys, node.join_type,
+            node.condition, kids[0], kids[1])
+    if isinstance(node, XJ.TpuBroadcastHashJoinExec):
+        return XJ.CpuBroadcastHashJoinExec(
+            node.left_keys, node.right_keys, node.join_type,
+            node.condition, kids[0], kids[1])
+    if isinstance(node, XJ.TpuNestedLoopJoinExec):
+        return XJ.CpuNestedLoopJoinExec(
+            node.left_keys, node.right_keys, node.join_type,
+            node.condition, kids[0], kids[1])
+    if isinstance(node, XE.TpuExpandExec):
+        return XE.CpuExpandExec(node.projections, node.output_attrs,
+                                kids[0])
+    if isinstance(node, XE.TpuGenerateExec):
+        return XE.CpuGenerateExec(node.include_pos, node.elem_exprs,
+                                  node.generator_output, kids[0])
+    if isinstance(node, XC.TpuCachedScanExec):
+        return XC.CpuCachedScanExec(node.logical_node, kids[0])
+    if isinstance(node, TpuFileScanExec):
+        # a FRESH scan: any encoded-dictionary claims on the device scan
+        # describe device decode output and must not survive conversion
+        return CpuFileScanExec(node.attrs, node.splits, node.fmt)
+    if isinstance(node, TpuFusedStageExec):
+        # unfuse onto the host: rebuild the member chain bottom-up over
+        # the realized stage input (members[0] is the chain top)
+        cur = kids[0]
+        for m in reversed(node.members):
+            cur = _host_equiv(m, (cur,))
+            if cur is None:
+                return None
+        return cur
+    return None
+
+
+# a host-placed shuffle below this many estimated rows collapses to one
+# partition: the device plan's fan-out (conf shuffle partitions) buys
+# nothing on the host interpreter and costs a scheduler round-trip per
+# post-shuffle partition — exactly the toy-scale tax placement exists
+# to remove
+_HOST_COALESCE_ROWS = 1 << 16
+
+
+def _coalesce_host_exchange(twin: "CpuShuffleExchangeExec",
+                            rows_hi: float) -> "CpuShuffleExchangeExec":
+    """Partition count is not semantic (collect concatenates partitions
+    and oracle comparisons ignore order), so only the fan-out changes;
+    unestimated (rows_hi <= 0) inputs keep the planned width."""
+    from spark_rapids_tpu.shuffle.exchange import (HashPartitioning,
+                                                   RoundRobinPartitioning)
+
+    part = twin.partitioning
+    if rows_hi <= 0 or rows_hi > _HOST_COALESCE_ROWS:
+        return twin
+    if isinstance(part, HashPartitioning) and part.num_partitions > 1:
+        new = HashPartitioning(part.exprs, 1)
+    elif isinstance(part, RoundRobinPartitioning) and \
+            part.num_partitions > 1:
+        new = RoundRobinPartitioning(1)
+    else:
+        return twin
+    return CpuShuffleExchangeExec(new, twin.children[0],
+                                  twin.allow_adaptive)
+
+
+def _host_convertible(node: B.PhysicalExec) -> bool:
+    if isinstance(node, TpuFusedStageExec):
+        return all(_host_convertible(m) for m in node.members)
+    probe = (XB.TpuProjectExec, XB.TpuFilterExec, XB.TpuUnionExec,
+             XB.TpuLocalLimitExec, XB.TpuGlobalLimitExec,
+             TpuHashAggregateExec, XS.TpuSortExec, XW.TpuWindowExec,
+             TpuShuffleExchangeExec, XJ.TpuShuffledHashJoinExec,
+             XJ.TpuBroadcastHashJoinExec, XJ.TpuNestedLoopJoinExec,
+             XE.TpuExpandExec, XE.TpuGenerateExec, XC.TpuCachedScanExec,
+             TpuFileScanExec)
+    return isinstance(node, probe)
+
+
+def _is_aqe_atom(node: B.PhysicalExec) -> bool:
+    """Materialized AQE artifacts: their data already lives where it
+    lives — the DP treats them as zero-cost device leaves and never
+    descends (a host parent pays the download at the edge)."""
+    return type(node).__name__ in ("TpuQueryStageExec",
+                                   "TpuStageReaderExec")
+
+
+class PlacementDecision:
+    """One operator's price comparison + chosen side."""
+
+    __slots__ = ("name", "cls", "device_ns", "host_ns", "side", "why")
+
+    def __init__(self, name: str, cls: str, device_ns: float,
+                 host_ns: float, side: str, why: str = ""):
+        self.name = name
+        self.cls = cls
+        self.device_ns = device_ns
+        self.host_ns = host_ns
+        self.side = side
+        self.why = why
+
+
+class PlacementReport:
+    """The analyzer's verdict for one final physical plan: per-operator
+    prices, the chosen assignment, and the predicted cost of the road
+    not taken (the post-hoc `placementRegret` baseline)."""
+
+    def __init__(self, mode: str):
+        self.mode = mode
+        self.changed = False
+        self.reason: Optional[str] = None
+        self.decisions: List[PlacementDecision] = []
+        self.host_ops = 0
+        self.device_ops = 0
+        self.boundaries = 0
+        # predicted ns of the EMITTED plan and of the all-device
+        # alternative; wall > alt_ns after choosing to move work means
+        # the move was regretted (obs/history.py scores it)
+        self.predicted_ns: Optional[float] = None
+        self.alt_device_ns: Optional[float] = None
+        self.transfer: Optional[dict] = None
+
+    def render(self) -> str:
+        head = f"placement: mode={self.mode}"
+        if self.reason:
+            return f"{head} — {self.reason}"
+        lines = [head + f", {self.device_ops} device / "
+                 f"{self.host_ops} host op(s), "
+                 f"{self.boundaries} boundary transition(s)"]
+        if self.predicted_ns is not None and \
+                self.alt_device_ns is not None:
+            lines.append(
+                f"predicted {self.predicted_ns / 1e6:.3f} ms placed vs "
+                f"{self.alt_device_ns / 1e6:.3f} ms all-device")
+        for d in self.decisions:
+            dev = "inf" if d.device_ns == _INF \
+                else f"{d.device_ns / 1e6:.3f}ms"
+            host = "inf" if d.host_ns == _INF \
+                else f"{d.host_ns / 1e6:.3f}ms"
+            note = f" ({d.why})" if d.why else ""
+            lines.append(f"{d.name}: device={dev} host={host} "
+                         f"-> {d.side}{note}")
+        return "\n".join(lines)
+
+    def to_payload(self) -> dict:
+        """Flight-recorder form (obs/history.py attaches regret)."""
+        out = {
+            "mode": self.mode,
+            "changed": self.changed,
+            "hostOps": self.host_ops,
+            "deviceOps": self.device_ops,
+            "boundaries": self.boundaries,
+        }
+        if self.reason:
+            out["reason"] = self.reason
+        if self.predicted_ns is not None and self.predicted_ns != _INF:
+            out["predictedNs"] = round(self.predicted_ns, 1)
+        # the regret baseline is the predicted cost of what we did NOT
+        # emit: all-device when we moved work, absent otherwise
+        if self.changed and self.alt_device_ns is not None and \
+                self.alt_device_ns != _INF:
+            out["altNs"] = round(self.alt_device_ns, 1)
+        if self.decisions:
+            out["decisions"] = [
+                {"name": d.name, "cls": d.cls, "side": d.side,
+                 "deviceNs": None if d.device_ns == _INF
+                 else round(d.device_ns, 1),
+                 "hostNs": None if d.host_ns == _INF
+                 else round(d.host_ns, 1)}
+                for d in self.decisions[:64]]
+        return out
+
+
+class _Coster:
+    """Per-node device/host operator prices from the two fitted models
+    and the resource analyzer's estimates."""
+
+    def __init__(self, est_map, dev_model, host_model, min_samples: int,
+                 flat_ns: float, pin_host: Set[str],
+                 lenient: bool = False):
+        self.est_map = est_map
+        self.dev_model = dev_model
+        self.host_model = host_model
+        self.min_samples = max(1, int(min_samples))
+        self.flat_ns = max(0.0, flat_ns)
+        self.pin_host = pin_host
+        # forced-host mode prices cold classes at zero instead of INF:
+        # the mode exists to RUN host-side (and to train the host fit),
+        # so an unfitted class must not veto it
+        self.lenient = lenient
+        # >0 while pricing the host alternative of a stage atom whose
+        # DEVICE price is its own calibrated stage class: the per-class
+        # device-calibration gate in host_op_named is moot there — the
+        # device never executes the members individually
+        self.stage_depth = 0
+        self._dispatch_ns_memo: Optional[float] = None
+
+    def dev_calibrated(self, node) -> bool:
+        """True when the device price of this stage atom comes from its
+        own fitted class (the granularity the device executes)."""
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        if self.dev_model is None:
+            return False
+        cls = CAL.classify(node.node_name())
+        return self.dev_model.coeffs_for(cls, self.min_samples) \
+            is not None
+
+    @staticmethod
+    def _hi(iv) -> float:
+        lo = float(iv.lo)
+        hi = float(iv.hi)
+        return lo if hi == _INF else hi
+
+    def _rows(self, node) -> float:
+        est = self.est_map.get(id(node))
+        return self._hi(est.rows) if est is not None else 0.0
+
+    def bytes_of(self, node) -> float:
+        cur = node
+        while True:
+            est = self.est_map.get(id(cur))
+            if est is not None:
+                return float(est.resident_bytes)
+            if cur.children and isinstance(
+                    cur, _TRANSITIONS + _COALESCES +
+                    (XB.CoalescePartitionsExec,)):
+                cur = cur.children[0]
+                continue
+            # no estimate (host-native leaves like HostScanExec never
+            # enter the analyzer's resident set): price the boundary at
+            # the fence constant alone — same as a cpu-placed node's
+            # zero resident bytes, and never an INF that would forbid
+            # every boundary over an unestimated subtree
+            return 0.0
+
+    def dev_op(self, node) -> float:
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        if self.pin_host:
+            # the failure re-placement path: a pinned class just faulted
+            # on the device, so no fitted price makes it attractive —
+            # and a stage FUSING a pinned member is poisoned wholesale
+            if CAL.classify(node.node_name()) in self.pin_host:
+                return _INF
+            if isinstance(node, TpuFusedStageExec) and any(
+                    CAL.classify(m.node_name()) in self.pin_host
+                    for m in node.members):
+                return _INF
+        est = self.est_map.get(id(node))
+        if est is None:
+            return self.flat_ns
+        if self.dev_model is not None:
+            # the same minSamples contract as the host side: a class
+            # with fewer samples (one stray bench record) must not
+            # price a whole stage
+            pred = self.dev_model.predict_node_ns(
+                est.name, est.dispatches, est.rows, self.min_samples)
+            if pred is not None:
+                return pred[0] if pred[1] == _INF else pred[1]
+        return self._hi(est.dispatches) * self._dispatch_ns()
+
+    def _dispatch_ns(self) -> float:
+        """Per-dispatch price for a class the device model never saw:
+        the fitted model's own median ns_per_dispatch (launch + fence
+        overhead is roughly class-independent, and a measured scale
+        beats the conf constant), falling back to the conf constant
+        only when nothing is fitted."""
+        if self._dispatch_ns_memo is None:
+            fitted = []
+            if self.dev_model is not None:
+                fitted = sorted(
+                    c.ns_per_dispatch
+                    for c in self.dev_model.coeffs.values()
+                    if c.samples >= self.min_samples and
+                    c.ns_per_dispatch > 0)
+            self._dispatch_ns_memo = \
+                fitted[len(fitted) // 2] if fitted else self.flat_ns
+        return self._dispatch_ns_memo
+
+    def host_op(self, node) -> float:
+        """Host price of one operator, or INF when the cold-start
+        contract pins its class to the device."""
+        return self.host_op_named(node.node_name(), self._rows(node))
+
+    def fused_host_op(self, node: TpuFusedStageExec) -> float:
+        """An unfused host chain prices as the sum of its members'
+        class predictions at the stage's row estimate."""
+        rows = self._rows(node)
+        total = 0.0
+        for m in node.members:
+            c = self.host_op_named(m.node_name(), rows)
+            if c == _INF:
+                return _INF
+            total += c
+        return total
+
+    def host_op_named(self, name: str, rows: float) -> float:
+        from spark_rapids_tpu.obs import calibrate as CAL
+
+        cls = CAL.classify(name)
+        if cls in self.pin_host or self.lenient:
+            hc = self.host_model.coeffs_for(cls, 1) \
+                if self.host_model is not None else None
+            return hc.predict_ns(0.0, rows) if hc is not None else 0.0
+        if self.dev_model is None or self.host_model is None:
+            return _INF
+        hc = self.host_model.coeffs_for(cls, self.min_samples)
+        if hc is None:
+            return _INF
+        # the device side of the comparison must be calibrated too —
+        # per-class when the device model has seen the class, or at the
+        # stage granularity the device actually executes (SPMD/fusion
+        # rolls member spans into the stage class; under stage_depth
+        # the enclosing atom's fitted stage class IS the device price,
+        # so an under-sampled member class must not veto the move)
+        if not self.stage_depth and cls in self.dev_model.coeffs and \
+                self.dev_model.coeffs_for(cls, self.min_samples) is None:
+            return _INF
+        return hc.predict_ns(0.0, rows)
+
+
+def place_plan(plan: B.PhysicalExec, conf,
+               device_manager=None, measured_stats=None,
+               pin_host_classes: Optional[Set[str]] = None,
+               forced_mode: Optional[str] = None):
+    """Price + (maybe) re-place one FINAL physical plan. Returns
+    (placed_plan, PlacementReport); the plan object is the ORIGINAL
+    when the DP keeps everything on the device.
+
+    `pin_host_classes` is the failure re-placement hook (session
+    `_degrade_device_failure`): those operator classes price at
+    device=INF so the DP moves exactly the faulting subtree host-side.
+    `measured_stats` flows to the resource analyzer (the AQE re-place
+    rule passes the stages' measured MapOutputStats)."""
+    from spark_rapids_tpu.obs import calibrate as CAL
+    from spark_rapids_tpu.plan import resources as R
+
+    mode = forced_mode or conf.get(C.PLACEMENT_MODE)
+    report = PlacementReport(mode)
+    pin_host = set(pin_host_classes or ())
+
+    if mode == "device":
+        report.reason = "forced all-device"
+        return plan, report
+
+    dev_model = CAL.active_model()
+    host_model = CAL.active_host_model()
+    if mode == "auto" and not pin_host and \
+            (dev_model is None or host_model is None):
+        missing = "device" if dev_model is None else "host"
+        report.reason = f"cold start: no fitted {missing} model " \
+            f"(all-device)"
+        return plan, report
+
+    # price every node off the analyzer's estimates (measured stats win
+    # over static bounds when the AQE loop supplies them)
+    try:
+        res = R.analyze_plan(plan, conf, device_manager=device_manager,
+                             measured_stats=measured_stats)
+        est_map = {est.node_id: est for est in res.nodes}
+    except Exception:  # noqa: BLE001 - placement is best-effort
+        est_map = {}
+
+    flat_ns = max(0.0, float(
+        conf.get(C.DEADLINE_COST_PER_DISPATCH_MS))) * 1e6
+    force_host = mode == "host"
+    # forced-host mode AND failure re-placement price the host leniently
+    # (cold classes at their best guess instead of INF): both exist to
+    # GET OFF the device, not to win a calibrated comparison
+    coster = _Coster(est_map, dev_model, host_model,
+                     conf.get(C.PLACEMENT_MIN_SAMPLES), flat_ns,
+                     pin_host, lenient=force_host or bool(pin_host))
+    tc = CAL.transfer_coeffs(dev_model)
+    report.transfer = tc.as_dict()
+
+    # -- the DP -------------------------------------------------------------
+    memo: Dict[int, Tuple[float, float]] = {}
+
+    def up(node) -> float:
+        b = coster.bytes_of(node)
+        return _INF if b == _INF else tc.upload_ns(b)
+
+    def down(node) -> float:
+        b = coster.bytes_of(node)
+        return _INF if b == _INF else tc.download_ns(b)
+
+    def costs(node) -> Tuple[float, float]:
+        """(dev, host): cheapest cost of computing this subtree with
+        its OUTPUT resident on the device / on the host."""
+        got = memo.get(id(node))
+        if got is not None:
+            return got
+        if isinstance(node, _TRANSITIONS) or \
+                isinstance(node, _COALESCES) or \
+                isinstance(node, XB.CoalescePartitionsExec):
+            out = costs(node.children[0])
+        elif _is_aqe_atom(node):
+            out = (0.0, _INF)
+        elif isinstance(node, TpuSpmdStageExec):
+            # all-or-nothing: one device program, or the original
+            # subtree re-placed host wholesale (never straddled). The
+            # device price is the stage's OWN class, so the host
+            # alternative is priced with the per-member device gate
+            # relaxed (stage_depth)
+            relax = coster.dev_calibrated(node)
+            if relax:
+                coster.stage_depth += 1
+            try:
+                host = costs(node.children[0])[1]
+            finally:
+                if relax:
+                    coster.stage_depth -= 1
+            dev = _INF if force_host else coster.dev_op(node)
+            if pin_host and dev != _INF:
+                from spark_rapids_tpu.obs import calibrate as CAL2
+
+                # a single-program stage chaining a pinned (faulted)
+                # operator class is poisoned wholesale
+                if node.children[0].collect_nodes(
+                        lambda n: CAL2.classify(n.node_name())
+                        in pin_host):
+                    dev = _INF
+            out = (dev, host)
+        elif isinstance(node, TpuFusedStageExec):
+            # the fused node WRAPS its member chain (children[0] is the
+            # chain top); price the stage as one operator over the node
+            # BELOW the chain so the members are never double-counted
+            inp = node.input_node
+            cd, ch = costs(inp)
+            kid_dev = min(cd, ch + up(inp))
+            kid_host = min(ch, cd + down(inp))
+            relax = coster.dev_calibrated(node)
+            if relax:
+                coster.stage_depth += 1
+            try:
+                host_self = coster.fused_host_op(node)
+            finally:
+                if relax:
+                    coster.stage_depth -= 1
+            dev = coster.dev_op(node) + kid_dev
+            host = (host_self + kid_host) if host_self != _INF else _INF
+            if force_host and host != _INF:
+                dev = _INF
+            out = (dev, host)
+        elif getattr(node, "placement", "tpu") == "cpu" and \
+                not _host_convertible(node):
+            # a host-native leaf/operator (HostScanExec, RangeExec, a
+            # Cpu op already below a transition): placement keeps it
+            out = (_INF,
+                   sum(min(costs(c)[1], costs(c)[0] + down(c))
+                       for c in node.children) if node.children else 0.0)
+        else:
+            kid_dev = kid_host = 0.0
+            for c in node.children:
+                cd, ch = costs(c)
+                kid_dev += min(cd, ch + up(c))
+                kid_host += min(ch, cd + down(c))
+            if _host_convertible(node):
+                host_self = coster.host_op(node)
+            else:
+                host_self = _INF
+            if isinstance(node, TpuFileScanExec) and \
+                    getattr(node, "_encoded_plan_cache", None) and \
+                    not force_host:
+                # encoded-dictionary claims describe DEVICE decode
+                # output; auto mode never moves such a scan
+                host_self = _INF
+            dev = coster.dev_op(node) + kid_dev
+            host = (host_self + kid_host) if host_self != _INF else _INF
+            if force_host and host != _INF:
+                dev = _INF
+            out = (dev, host)
+        memo[id(node)] = out
+        return out
+
+    root_dev, root_host = costs(plan)
+    # the query's result is consumed on the host either way
+    choose_host_root = root_host <= root_dev + down(plan) \
+        if root_host != _INF else False
+    if root_dev == _INF and root_host == _INF:
+        report.reason = "no feasible placement (kept as planned)"
+        return plan, report
+
+    # -- realize the assignment ---------------------------------------------
+    # `forced` marks a region inside a dissolved SPMD atom: the DP
+    # priced that atom host WHOLESALE (its interior estimates describe
+    # the single device program — dispatch counts of 0, free in-program
+    # exchanges — and are meaningless for a device island), so every
+    # node in the region goes host without per-node re-decision
+    def realize(node, side: str, forced: bool = False):
+        if isinstance(node, _TRANSITIONS):
+            return realize(node.children[0], side, forced)
+        if isinstance(node, _COALESCES):
+            c = realize(node.children[0], side, forced)
+            if c.placement == "tpu":
+                return TpuCoalesceBatchesExec(node.goal, c)
+            return CpuCoalesceBatchesExec(node.goal, c)
+        if isinstance(node, XB.CoalescePartitionsExec):
+            return XB.CoalescePartitionsExec(
+                node.num_partitions,
+                realize(node.children[0], side, forced))
+        if _is_aqe_atom(node):
+            return node
+        cd, ch = costs(node)
+        dec_side = side
+        if side == "tpu" and cd == _INF:
+            dec_side = "cpu"
+        if side == "cpu" and ch == _INF and not forced:
+            dec_side = "tpu"
+        if isinstance(node, TpuSpmdStageExec):
+            if dec_side == "cpu":
+                return realize(node.children[0], "cpu", True)
+            report.decisions.append(PlacementDecision(
+                node.node_name(), "spmd-stage", cd, ch, "tpu",
+                "spmd atom"))
+            report.device_ops += 1
+            return node
+        if isinstance(node, TpuFusedStageExec):
+            inp = node.input_node
+            kid = realize(inp, "cpu" if forced or costs(inp)[1] <=
+                          costs(inp)[0] + down(inp) else "tpu", forced)
+            if dec_side == "cpu":
+                twin = _host_equiv(node, (kid,))
+                if twin is not None:
+                    report.decisions.append(PlacementDecision(
+                        node.node_name(), "fused-stage", cd, ch, "cpu",
+                        "unfused"))
+                    report.host_ops += len(node.members)
+                    report.changed = True
+                    return twin
+            report.decisions.append(PlacementDecision(
+                node.node_name(), "fused-stage", cd, ch, "tpu"))
+            report.device_ops += 1
+            if kid is not inp:
+                # re-thread the member chain over the re-placed input,
+                # then re-wrap: with_children would rebuild from the OLD
+                # chain top and lose the new input
+                cur = kid
+                for m in reversed(node.members):
+                    cur = m.with_children((cur,))
+                return TpuFusedStageExec(node.stage_id, cur, node.n_ops)
+            return node
+        if getattr(node, "placement", "tpu") == "cpu" and \
+                not _host_convertible(node):
+            kids = tuple(
+                realize(c, "cpu" if forced or costs(c)[1] <=
+                        costs(c)[0] + down(c) else "tpu", forced)
+                for c in node.children)
+            if kids != node.children:
+                return node.with_children(kids)
+            return node
+        if dec_side == "cpu":
+            kids = tuple(
+                realize(c, "cpu" if forced or costs(c)[1] <=
+                        costs(c)[0] + down(c) else "tpu", forced)
+                for c in node.children)
+            twin = _host_equiv(node, kids)
+            if twin is not None:
+                if isinstance(twin, CpuShuffleExchangeExec):
+                    twin = _coalesce_host_exchange(
+                        twin, coster._rows(node))
+                report.decisions.append(PlacementDecision(
+                    node.node_name(), CAL.classify(node.node_name()),
+                    cd, ch, "cpu"))
+                report.host_ops += 1
+                report.changed = True
+                return twin
+            # unreachable in practice (an inconvertible node prices
+            # host=INF), but keep the device node rather than corrupt
+        kids = tuple(
+            realize(c, "tpu" if not forced and costs(c)[0] <=
+                    costs(c)[1] + up(c) else "cpu", forced)
+            for c in node.children)
+        report.decisions.append(PlacementDecision(
+            node.node_name(), CAL.classify(node.node_name()),
+            cd, ch, "tpu"))
+        report.device_ops += 1
+        if kids != node.children:
+            return node.with_children(kids)
+        return node
+
+    placed = realize(plan, "cpu" if choose_host_root else "tpu")
+    report.predicted_ns = root_host if choose_host_root \
+        else root_dev + down(plan)
+    report.alt_device_ns = None if root_dev == _INF \
+        else root_dev + down(plan)
+
+    if not report.changed:
+        report.reason = "all operators cheapest on device"
+        return plan, report
+
+    placed = _insert_transitions(placed, want_host_output=True)
+    placed = _optimize_transitions(placed)
+    report.boundaries = len(placed.collect_nodes(
+        lambda n: isinstance(n, _TRANSITIONS)))
+    return placed, report

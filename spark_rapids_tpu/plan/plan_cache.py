@@ -34,14 +34,20 @@ class CachedPlan:
     of the key, so hit and build always agree), the combined
     verifier+analyzer violation record, and the source logical plan."""
 
-    __slots__ = ("physical", "report", "violations", "logical")
+    __slots__ = ("physical", "report", "violations", "logical",
+                 "placement")
 
     def __init__(self, physical: Any, report: Any,
-                 violations: List, logical: Any):
+                 violations: List, logical: Any,
+                 placement: Any = None):
         self.physical = physical
         self.report = report
         self.violations = list(violations)
         self.logical = logical
+        # the placement analyzer's PlacementReport (None when the pass
+        # was off/no-op): a cache hit must restore the session's
+        # last_placement_report exactly like a fresh plan would
+        self.placement = placement
 
 
 def lookup(key: str) -> Optional[CachedPlan]:

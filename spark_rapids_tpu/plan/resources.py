@@ -463,15 +463,22 @@ def _filter_selectivity(cond, col_ndv: Dict[int, int],
 class NodeEstimate:
     """One line of the per-stage breakdown."""
 
-    __slots__ = ("name", "depth", "rows", "resident_bytes", "dispatches")
+    __slots__ = ("name", "depth", "rows", "resident_bytes", "dispatches",
+                 "node_id", "placement")
 
     def __init__(self, name: str, depth: int, rows: Interval,
-                 resident_bytes, dispatches: Interval):
+                 resident_bytes, dispatches: Interval,
+                 node_id: int = 0, placement: str = "tpu"):
         self.name = name
         self.depth = depth
         self.rows = rows
         self.resident_bytes = resident_bytes
         self.dispatches = dispatches
+        # identity + side of the plan node this line prices — the
+        # placement analyzer keys its DP table on node_id and the
+        # mixed-plan cost split on placement
+        self.node_id = node_id
+        self.placement = placement
 
 
 class PlanResourceReport:
@@ -1064,7 +1071,8 @@ class _Analyzer:
         if record:
             self.report.nodes.append(NodeEstimate(
                 node.node_name(), self._depth, state.rows, nbytes,
-                dispatches))
+                dispatches, node_id=id(node),
+                placement=getattr(node, "placement", "tpu")))
 
     def _resident_floor(self, nbytes) -> None:
         """Raise the peak's CERTAIN lower bound: only for residency the
@@ -2310,7 +2318,8 @@ class _Analyzer:
             st = self._aggregate(agg, node.input_node, collapsed=True)
             self.report.nodes.append(NodeEstimate(
                 node.node_name(), self._depth, st.rows,
-                st.batch_bytes, Interval.exact(0)))
+                st.batch_bytes, Interval.exact(0), node_id=id(node),
+                placement=getattr(node, "placement", "tpu")))
             return st
 
         cin = self.visit(node.input_node)
@@ -2511,7 +2520,8 @@ def _attach_wall_prediction(report: PlanResourceReport,
         lo, hi, calibrated, fallback = model.predict_report(
             report,
             flat_cost_ms=conf.get(C.DEADLINE_COST_PER_DISPATCH_MS),
-            min_samples=conf.get(C.OBS_CALIBRATION_MIN_SAMPLES))
+            min_samples=conf.get(C.OBS_CALIBRATION_MIN_SAMPLES),
+            host_model=CAL.active_host_model())
         if not calibrated:
             return
         report.predicted_wall_ns = Interval(

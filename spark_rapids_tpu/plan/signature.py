@@ -68,6 +68,19 @@ def plan_signature(plan: "L.LogicalPlan",
         # lowered plan carries TpuSpmdStageExec wrappers a host-loop
         # query must never be served
         conf_tok += f";__spmd={bool(conf.get(C.SPMD_ENABLED))!r}"
+        # the placement pass keys on the FITTED MODELS, not just the
+        # conf: warming either model must invalidate the cached
+        # all-device plan, so the model fit stamps join the token
+        if conf.get(C.PLACEMENT_ENABLED):
+            from spark_rapids_tpu.obs import calibrate as CAL
+
+            dm = CAL.active_model()
+            hm = CAL.active_host_model()
+            conf_tok += (
+                f";__placement={conf.get(C.PLACEMENT_MODE)}"
+                f":{conf.get(C.PLACEMENT_MIN_SAMPLES)}"
+                f":{0 if dm is None else dm.fitted_at_ns}"
+                f":{0 if hm is None else hm.fitted_at_ns}")
         idmap: Dict[int, int] = {}
         ident = _canon_node(plan, idmap, identity=True)
         idmap = {}

@@ -164,6 +164,38 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
         _check_identity_schema(node, out)
         if node.info is None:
             out.append(f"{name}: SPMD stage carries no lowering info")
+        # placement-consistency: an SPMD chain compiles to ONE device
+        # program — a host-placed compute operator or a download edge
+        # inside its subtree means a placement boundary STRADDLES the
+        # chain (the placement pass re-places chains wholesale; a plan
+        # that splits one is corrupt)
+        from spark_rapids_tpu.exec.aggregate import CpuHashAggregateExec
+        from spark_rapids_tpu.exec.cache import CpuCachedScanExec
+        from spark_rapids_tpu.exec.expand import (
+            CpuExpandExec,
+            CpuGenerateExec,
+        )
+        from spark_rapids_tpu.exec.join import (
+            CpuNestedLoopJoinExec,
+            CpuShuffledHashJoinExec,
+        )
+        from spark_rapids_tpu.exec.sort import CpuSortExec
+        from spark_rapids_tpu.exec.window import CpuWindowExec
+        from spark_rapids_tpu.shuffle.exchange import CpuShuffleExchangeExec
+
+        host_compute = (B.CpuProjectExec, B.CpuFilterExec, B.CpuUnionExec,
+                        B.CpuLocalLimitExec, B.CpuGlobalLimitExec,
+                        CpuHashAggregateExec,
+                        CpuSortExec, CpuWindowExec, CpuShuffleExchangeExec,
+                        CpuShuffledHashJoinExec, CpuNestedLoopJoinExec,
+                        CpuExpandExec, CpuGenerateExec, CpuCachedScanExec,
+                        DeviceToHostExec)
+        for s in node.children[0].collect_nodes(
+                lambda n: isinstance(n, host_compute)):
+            out.append(
+                f"{name}: SPMD chain straddles a placement boundary — "
+                f"{s.node_name()} is host-placed inside a single-program "
+                "device stage")
     elif isinstance(node, TpuAdaptiveExec):
         # schema/placement-transparent adaptive wrapper (aqe/loop.py)
         _check_identity_schema(node, out)
@@ -299,6 +331,27 @@ def _check_node(node: PhysicalExec, out: List[str]) -> None:
                 not isinstance(node, DeviceToHostExec):
             out.append(f"{name}: host operator consumes device batches "
                        f"from {c.node_name()} without a DeviceToHostExec")
+
+    # -- placement-boundary shape (one transition per boundary) --------------
+    if isinstance(node, (HostToDeviceExec, DeviceToHostExec)):
+        child = node.children[0]
+        if isinstance(child, (HostToDeviceExec, DeviceToHostExec)):
+            out.append(
+                f"{name}: a placement boundary must carry exactly one "
+                f"transition node, but {child.node_name()} is stacked "
+                "directly beneath (the transition optimizer fuses "
+                "inverse pairs — a surviving stack is a corrupt "
+                "mixed plan)")
+        elif isinstance(node, HostToDeviceExec) and \
+                _effective_placement(child) == "tpu":
+            out.append(
+                f"{name}: upload transition over device-resident input "
+                f"{child.node_name()} — no placement boundary here")
+        elif isinstance(node, DeviceToHostExec) and \
+                _effective_placement(child) == "cpu":
+            out.append(
+                f"{name}: download transition over host-resident input "
+                f"{child.node_name()} — no placement boundary here")
 
 
 def _check_reader_spec(name: str, spec, stage, out: List[str]) -> None:

@@ -118,6 +118,13 @@ class TpuSession:
         # the resource analyzer's full report for the most recent plan
         # build (None while resourceAnalysis is disabled)
         self.last_resource_report = None
+        # the placement analyzer's report for the most recent plan build
+        # (plan/placement.py; None while placement is disabled)
+        self.last_placement_report = None
+        # failure re-placement pin (set transiently by
+        # _degrade_device_failure): operator classes the NEXT plan build
+        # must price at device=INF so the faulting subtree lands host-side
+        self._placement_pin = None
         # applied-rule notes from the most recent ADAPTIVE execution
         # (aqe/loop.py via the QueryContext); rendered by EXPLAIN's
         # '== Adaptive execution ==' section. Empty when adaptive is off
@@ -400,6 +407,7 @@ class TpuSession:
                     M.record_plan_cache_hit()
                     self.last_plan_violations = list(entry.violations)
                     self.last_resource_report = entry.report
+                    self.last_placement_report = entry.placement
                     if entry.report is not None:
                         self._apply_resource_hints(entry.report)
                     else:
@@ -415,6 +423,24 @@ class TpuSession:
         # subtree is exactly what the host-loop executor would run, so
         # eligibility fallback is always one children[0].execute() away
         final = lower_spmd_stages(final, self.conf)
+        # cost-based placement (plan/placement.py): price every operator
+        # device-vs-host and realize the cheaper mixed plan. Runs BEFORE
+        # the verifier/analyzer below so the emitted plan is the one
+        # that gets verified and admission-priced; best-effort — a
+        # pricing bug keeps the all-device plan, never aborts the query
+        self.last_placement_report = None
+        if self.conf.get(C.PLACEMENT_ENABLED):
+            from spark_rapids_tpu.plan.placement import place_plan
+
+            try:
+                final, placement = place_plan(
+                    final, self.conf,
+                    device_manager=self.device_manager,
+                    pin_host_classes=self._placement_pin)
+                self.last_placement_report = placement
+            except Exception:  # noqa: BLE001 - placement is best-effort
+                log.warning("placement analysis failed; keeping the "
+                            "all-device plan", exc_info=True)
         # LAST: adaptive-execution wrapper (spark_rapids_tpu/aqe/) below
         # the root sink; a no-op unless rapids.tpu.sql.adaptive.enabled
         # and the plan has a stage boundary to re-optimize across. The
@@ -487,7 +513,8 @@ class TpuSession:
             entry = PC.insert(
                 cache_key,
                 PC.CachedPlan(final, self.last_resource_report,
-                              self.last_plan_violations, plan),
+                              self.last_plan_violations, plan,
+                              self.last_placement_report),
                 self.conf.get(C.PLAN_CACHE_MAX_ENTRIES))
             final = entry.physical
         self.plan_capture.record(final)
@@ -542,6 +569,16 @@ class TpuSession:
         final = TpuTransitionOverrides.apply(tpu_plan, self.conf)
         final = fuse_stages(final, self.conf)
         final = lower_spmd_stages(final, self.conf)
+        placement_report = None
+        if self.conf.get(C.PLACEMENT_ENABLED):
+            from spark_rapids_tpu.plan.placement import place_plan
+
+            try:
+                final, placement_report = place_plan(
+                    final, self.conf, device_manager=self.device_manager)
+            except Exception:  # noqa: BLE001 - placement is best-effort
+                log.warning("placement analysis failed in EXPLAIN",
+                            exc_info=True)
         from spark_rapids_tpu.aqe.loop import maybe_wrap_adaptive
 
         final = maybe_wrap_adaptive(final, self.conf)
@@ -551,7 +588,7 @@ class TpuSession:
         parts.append("== Final plan ==\n" + explain_string(final))
         # static-analysis sections render in a FIXED order after the plan
         # tree: verification, then resources (tests/test_plan_resources.py
-        # pins the golden layout)
+        # pins the golden layout), then placement (only when enabled)
         if self.conf.get(C.PLAN_VERIFY):
             from spark_rapids_tpu.plan.verify import verify_plan
 
@@ -565,6 +602,8 @@ class TpuSession:
             report = analyze_plan(final, self.conf,
                                   device_manager=self.device_manager)
             parts.append("== Resource analysis ==\n" + report.render())
+        if placement_report is not None:
+            parts.append("== Placement ==\n" + placement_report.render())
         if self.conf.get(C.ADAPTIVE_ENABLED):
             from spark_rapids_tpu.aqe.rules import rule_catalog
 
@@ -762,7 +801,8 @@ class TpuSession:
                          M.RUN_COLLAPSED_ROWS, M.AQE_REPLANS,
                          M.SKEW_SPLITS, M.JOIN_DEMOTIONS,
                          M.JOIN_PROMOTIONS, M.CANCELLED_QUERIES,
-                         M.DEADLINE_REJECTS, M.SHED_QUERIES):
+                         M.DEADLINE_REJECTS, M.SHED_QUERIES,
+                         M.HOST_PLACED_OPS, M.PLACEMENT_REPLACEMENTS):
                 self.last_query_metrics[name] = snap.get(name, 0)
             self.last_adaptive_report = list(qctx.aqe_notes)
             finished_trace = None
@@ -843,6 +883,7 @@ class TpuSession:
         flattening, JSON encoding, and disk IO run on the writer thread
         — nothing below adds a dispatch or a fence to the query."""
         from spark_rapids_tpu.obs import history as OH
+        from spark_rapids_tpu.utils import metrics as M
 
         try:
             store = OH.get_store(self.conf)
@@ -858,9 +899,27 @@ class TpuSession:
             report = qctx.resource_report
             notes = list(qctx.aqe_notes)
             tenant = self.tenant
+            placement = qctx.placement_payload
+            # zero-dispatch runs: measured output rows of the host-placed
+            # operators (Cpu nodes have no kernel span chokepoint, so the
+            # trace carries nothing for them) — the host-fit's
+            # feature/response pairs (obs/calibrate.fit_host)
+            host_rows = None
+            if physical is not None and \
+                    not counters.get(M.DEVICE_DISPATCHES):
+                try:
+                    host_rows = [
+                        (n.node_name(),
+                         int(n.metrics[M.NUM_OUTPUT_ROWS].value))
+                        for n in physical.collect_nodes(
+                            lambda n: getattr(n, "placement",
+                                              "tpu") == "cpu")]
+                except Exception:  # noqa: BLE001 - best-effort capture
+                    host_rows = None
             store.enqueue(lambda: OH.build_record(
                 qid, tenant, status, sig, wall, counters, finished_trace,
-                report, notes))
+                report, notes, placement=placement,
+                host_op_rows=host_rows))
         except Exception:  # noqa: BLE001 - the recorder must never
             # surface into a query's result path
             log.warning("history record dropped", exc_info=True)
@@ -946,6 +1005,15 @@ class TpuSession:
             physical = self._physical_plan(plan, use_cache=use_plan_cache)
         ticket = ctl = None
         qctx = M.current_query_ctx()
+        placement = self.last_placement_report
+        if placement is not None:
+            # surface the placement decision on the query's metrics and
+            # stamp the payload for the flight recorder (obs/history.py
+            # computes placementRegret from it post-hoc)
+            if qctx is not None:
+                qctx.placement_payload = placement.to_payload()
+            if placement.host_ops:
+                M.record_host_placed_ops(placement.host_ops)
         report = qctx.resource_report if qctx is not None \
             else self.last_resource_report
         # deadline feasibility BEFORE admission: an infeasible query runs
@@ -1088,6 +1156,40 @@ class TpuSession:
                 e = e2
         elif not cpu_fallback_ok:
             raise e
+        # placement-pinned re-plan BEFORE the whole-query CPU oracle: when
+        # the placement analyzer is on, pin the FAILING operator class to
+        # the host side and re-plan — the rest of the query keeps its
+        # device placement instead of losing the device entirely
+        if (self.conf.get(C.PLACEMENT_ENABLED)
+                and self._placement_pin is None):
+            from spark_rapids_tpu.obs import calibrate as CAL
+
+            site = getattr(e, "origin_site", None)
+            if not site:
+                # injected/engine faults name their site as a trailing
+                # "... at <site>"; fall back to the error class name
+                msg = str(e)
+                site = msg.rsplit(" at ", 1)[-1].strip() \
+                    if " at " in msg else type(e).__name__
+            self._placement_pin = {CAL.classify(str(site))}
+            log.warning(
+                "device execution failed (%r); re-planning with operator "
+                "class %s pinned to the host", e, self._placement_pin)
+            try:
+                # bypass the plan cache: the cached entry is the plan that
+                # just failed. Injected faults stay ARMED — the pinned
+                # subtree now runs on the host, out of their reach, which
+                # is exactly the claim under test.
+                self.scheduler.begin_query()
+                FI.clear_deferred()
+                out = self._execute_device(plan, use_plan_cache=False)
+                M.record_placement_replacement()
+                return out
+            except Exception:  # noqa: BLE001 — degradation boundary
+                log.warning("pinned re-plan failed too; falling back to "
+                            "the CPU oracle", exc_info=True)
+            finally:
+                self._placement_pin = None
         # runtime graceful degradation: an operator with device-resident
         # state (aggregate/join/sort/scan) exhausted its retries —
         # re-execute the whole query through the CPU oracle instead of

@@ -120,6 +120,13 @@ JOIN_PROMOTIONS = "joinPromotions"
 CANCELLED_QUERIES = "cancelledQueries"
 DEADLINE_REJECTS = "deadlineRejects"
 SHED_QUERIES = "shedQueries"
+# cost-based placement (plan/placement.py, docs/placement.md):
+# hostPlacedOps counts operators the placement analyzer moved host-side
+# in the emitted plan; placementReplacements counts re-placements after
+# the fact (an AQE re-place on measured stats, or a device failure
+# re-placed onto the host instead of a whole-query CPU fallback)
+HOST_PLACED_OPS = "hostPlacedOps"
+PLACEMENT_REPLACEMENTS = "placementReplacements"
 
 
 class Metric:
@@ -189,7 +196,8 @@ class QueryContext:
                  "fi_scoped", "retry_budget", "_retries_spent", "sem_weight",
                  "resource_report", "retry_policy", "aqe_notes",
                  "spill_plan_hint", "async_dispatch", "donation", "trace",
-                 "cancel", "spill_buffers", "prefetchers", "kill_reason")
+                 "cancel", "spill_buffers", "prefetchers", "kill_reason",
+                 "placement_payload")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -261,6 +269,10 @@ class QueryContext:
         # session._on_query_killed stamps "cancelled"/"deadline"/"shed"
         # so the persisted history record carries how the query ended
         self.kill_reason = None
+        # THIS query's placement decision (plan/placement.py
+        # PlacementReport.to_payload()): the flight recorder persists it
+        # and scores placementRegret against the measured wall
+        self.placement_payload = None
 
     def add(self, name: str, n: int) -> None:
         with self._lock:
@@ -665,6 +677,8 @@ _JOIN_PROMOTIONS = Metric(JOIN_PROMOTIONS)
 _CANCELLED_QUERIES = Metric(CANCELLED_QUERIES)
 _DEADLINE_REJECTS = Metric(DEADLINE_REJECTS)
 _SHED_QUERIES = Metric(SHED_QUERIES)
+_HOST_PLACED_OPS = Metric(HOST_PLACED_OPS)
+_PLACEMENT_REPLACEMENTS = Metric(PLACEMENT_REPLACEMENTS)
 
 
 def record_cancelled_query(n: int = 1) -> None:
@@ -713,6 +727,29 @@ def record_aqe_replan(n: int = 1) -> None:
 
 def aqe_replan_count() -> int:
     return _AQE_REPLANS.value
+
+
+def record_host_placed_ops(n: int = 1) -> None:
+    """Count operators the placement analyzer moved host-side in the
+    plan this query actually executed."""
+    _HOST_PLACED_OPS.add(n)
+    _note(HOST_PLACED_OPS, n)
+
+
+def host_placed_op_count() -> int:
+    return _HOST_PLACED_OPS.value
+
+
+def record_placement_replacement(n: int = 1) -> None:
+    """Count one post-plan re-placement: AQE contradicting the static
+    estimate with measured stats, or a device failure re-placed onto
+    the host instead of degrading the whole query to CPU fallback."""
+    _PLACEMENT_REPLACEMENTS.add(n)
+    _note(PLACEMENT_REPLACEMENTS, n)
+
+
+def placement_replacement_count() -> int:
+    return _PLACEMENT_REPLACEMENTS.value
 
 
 def record_skew_split(n: int = 1) -> None:

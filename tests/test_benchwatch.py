@@ -114,6 +114,42 @@ def test_overhead_regression_direction_is_inverted(tmp_path):
     assert main(["--dir", str(tmp_path), "--threshold", "0.30"]) == 0
 
 
+def test_speedup_metric_direction_is_higher_better(tmp_path):
+    # an explicit speedup name beats every lower-is-better shape: the
+    # placement headline is a ratio of seconds, but UP is the win
+    assert not lower_is_better("placement_small_speedup", "x")
+    assert not lower_is_better("p95_speedup", "")
+    assert not lower_is_better("decode_throughput_gbps", "gbps")
+    assert lower_is_better("obs_tracing_overhead_ratio", "x")
+    for i, v in enumerate((2.0, 2.1), start=1):
+        _write(tmp_path, f"BENCH_r0{i}.json",
+               {"metric": "placement_small_speedup", "value": v,
+                "unit": "x"})
+    # speedup DROPPING is the regression
+    _write(tmp_path, "BENCH_r03.json",
+           {"metric": "placement_small_speedup", "value": 1.0,
+            "unit": "x"})
+    assert main(["--dir", str(tmp_path), "--threshold", "0.30"]) == 1
+    _write(tmp_path, "BENCH_r03.json",
+           {"metric": "placement_small_speedup", "value": 4.0,
+            "unit": "x"})
+    assert main(["--dir", str(tmp_path), "--threshold", "0.30"]) == 0
+
+
+def test_cpu_companion_artifact_not_in_trajectory(tmp_path):
+    # BENCH_r17_cpu.json seeds the host cost fit; it carries no
+    # "metric" and must stay out of the round trajectory
+    from tools.benchwatch import trajectory
+
+    _write(tmp_path, "BENCH_r01.json",
+           {"metric": "m", "value": 1.0})
+    _write(tmp_path, "BENCH_r17_cpu.json",
+           {"round": 17, "op_wall": {"agg": {"seconds": 0.5,
+                                             "rows": 1e6}}})
+    assert [r for r, _ in trajectory(str(tmp_path))] == [1]
+    assert main(["--dir", str(tmp_path)]) == 0
+
+
 def test_within_threshold_passes(tmp_path):
     for i, v in enumerate((10.0, 10.5, 9.8), start=1):
         _write(tmp_path, f"BENCH_r0{i}.json",

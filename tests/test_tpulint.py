@@ -515,6 +515,70 @@ def test_untracked_alloc_pragma_suppresses():
 
 
 # ---------------------------------------------------------------------------
+# naked-thread (contextvars propagation across thread hand-offs;
+# docs/serving.md)
+# ---------------------------------------------------------------------------
+def test_naked_thread_ctor_flagged_in_engine():
+    src = ("import threading\n"
+           "def spawn(fn):\n"
+           "    t = threading.Thread(target=fn, daemon=True)\n"
+           "    t.start()\n")
+    got = lint(src, path=ENGINE)
+    assert rules_of(got) == ["naked-thread"]
+    assert got[0].line == 3
+
+
+def test_naked_thread_submit_flagged_in_io():
+    src = ("def run(pool, fn):\n"
+           "    return pool.submit(fn, 1)\n")
+    got = lint(src, path="spark_rapids_tpu/io/fake.py")
+    assert rules_of(got) == ["naked-thread"]
+
+
+def test_naked_thread_copy_context_span_ok():
+    # the scheduler._submit idiom: snapshot then submit ctx.run
+    src = ("import contextvars\n"
+           "def submit(pool, fn):\n"
+           "    cctx = contextvars.copy_context()\n"
+           "    return pool.submit(cctx.run, fn)\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_naked_thread_ctx_run_target_ok_without_local_snapshot():
+    # the snapshot may have been taken elsewhere; target=ctx.run is the
+    # idiom either way (io/prefetch.py)
+    src = ("import threading\n"
+           "def spawn(cctx, fn):\n"
+           "    t = threading.Thread(target=cctx.run, args=(fn,),\n"
+           "                         daemon=True)\n"
+           "    t.start()\n")
+    assert lint(src, path="spark_rapids_tpu/io/fake.py") == []
+
+
+def test_naked_thread_not_flagged_outside_scope():
+    src = ("import threading\n"
+           "def spawn(fn):\n"
+           "    threading.Thread(target=fn).start()\n")
+    assert lint(src, path=COLD) == []
+
+
+def test_naked_thread_pool_creation_not_flagged():
+    # creating an executor is fine; only the hand-off must carry context
+    src = ("import concurrent.futures as cf\n"
+           "def mk():\n"
+           "    return cf.ThreadPoolExecutor(max_workers=4)\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_naked_thread_pragma_suppresses():
+    src = ("import threading\n"
+           "def start(self):\n"
+           "    # tpulint: naked-thread -- context-free daemon by design\n"
+           "    threading.Thread(target=self._loop, daemon=True).start()\n")
+    assert lint(src, path="spark_rapids_tpu/obs/fake.py") == []
+
+
+# ---------------------------------------------------------------------------
 # pragma hygiene
 # ---------------------------------------------------------------------------
 def test_unknown_pragma_rule_reported():

@@ -40,7 +40,11 @@ from typing import Dict, List, Optional, Tuple
 _TRAJECTORY_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 # metric-name / unit shapes whose value REGRESSES UP (lower is better);
-# everything else is treated as throughput-like (higher is better)
+# everything else is treated as throughput-like (higher is better).
+# An explicit higher-is-better name wins over both lower-is-better
+# shapes: `placement_small_speedup` is a ratio of seconds, but the
+# ratio itself improves upward
+_HIGHER_BETTER_NAME = re.compile(r"(?i)(speedup|throughput|_x$)")
 _LOWER_BETTER_NAME = re.compile(
     r"(?i)(overhead|latency|seconds|wall|p95|p99|_s$|_ms$|_ns$)")
 _LOWER_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "ns"}
@@ -78,6 +82,8 @@ def load_artifact(path: str) -> Tuple[Optional[dict], Optional[str]]:
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
+    if _HIGHER_BETTER_NAME.search(metric or ""):
+        return False
     return bool(_LOWER_BETTER_NAME.search(metric)) or \
         (unit or "").lower() in _LOWER_BETTER_UNITS
 

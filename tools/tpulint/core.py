@@ -106,6 +106,20 @@ the line above; `-- reason` after the rule names documents the waiver):
               timeline instead of in an ad-hoc variable. time.sleep is
               not a timer; a genuinely untraceable site carries a
               justified pragma.
+  naked-thread  a thread hand-off — a threading.Thread(...) construction
+              or an executor `.submit(...)` — in the layers that spawn
+              work while queries are in flight (engine/, io/, obs/)
+              that does not carry the submitting thread's contextvars.
+              The serving runtime's per-tenant ambient state
+              (QueryContext metrics, fault injector, circuit breaker,
+              retry budget, cancel token — docs/serving.md) lives in
+              contextvars; a naked hand-off runs the task with NO
+              ambient query, silently detaching its accounting and
+              cancellation from the tenant. Snapshot with
+              contextvars.copy_context() and run the task through
+              `ctx.run` (engine/scheduler.py, io/prefetch.py are the
+              template); a deliberately context-free daemon carries a
+              justified pragma.
   pragma      tpulint pragma hygiene: unknown rule name, or a pragma
               that suppresses nothing (stale waiver).
 """
@@ -131,6 +145,7 @@ RULES = (
     "naked-dispatch",
     "naked-timer",
     "uncancellable-wait",
+    "naked-thread",
     "shared-state-mutation",
     "eager-materialize",
     "pragma",
@@ -288,6 +303,20 @@ def is_cancel_wait_scope(path: str) -> bool:
             or _is_observatory_module(p))
 
 
+def is_thread_scope(path: str) -> bool:
+    """Files bound by the naked-thread rule: the layers that hand work to
+    other threads while queries are in flight — the engine's scheduler/
+    executor machinery, the IO/prefetch layer, and the observatory's
+    write-behind paths. Work crossing a thread boundary there must carry
+    the submitting thread's contextvars (the ambient QueryContext above
+    all) via contextvars.copy_context, or the task's metrics, fault
+    injection, and cancellation detach from its tenant."""
+    p = _norm(path)
+    return ("spark_rapids_tpu/engine/" in p
+            or "spark_rapids_tpu/io/" in p
+            or "spark_rapids_tpu/obs/" in p)
+
+
 def is_shared_state_scope(path: str) -> bool:
     """Files bound by the shared-state-mutation rule: everything that runs
     per batch/query under the concurrent serving runtime — the hot paths
@@ -323,6 +352,25 @@ def _module_mutable_names(tree: ast.Module):
             if ok:
                 sanctioned.add(t)
     return names, sanctioned
+
+
+def _context_propagating_spans(tree: ast.Module) -> List[Tuple[int, int]]:
+    """Line spans of functions/lambdas that call contextvars.copy_context:
+    a thread hand-off inside one is presumed to ship the snapshot (the
+    scheduler._submit / PrefetchIterator idiom snapshots immediately
+    before constructing/submitting — naked-thread rule)."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    _dotted(sub.func).rsplit(".", 1)[-1] == "copy_context":
+                spans.append((node.lineno,
+                              getattr(node, "end_lineno", node.lineno)))
+                break
+    return spans
 
 
 def _dotted(node: ast.AST) -> str:
@@ -535,13 +583,17 @@ class _Visitor(ast.NodeVisitor):
                  retry_names: Optional[Set[str]] = None,
                  retry_lambdas: Optional[Set[int]] = None,
                  module_names: Optional[Set[str]] = None,
-                 sanctioned_names: Optional[Set[str]] = None):
+                 sanctioned_names: Optional[Set[str]] = None,
+                 ctx_spans: Optional[Sequence[Tuple[int, int]]] = None):
         self.path = path
         self.hot = is_hot_path(path)
         self.midquery = is_mid_query_scope(path)
         self.timer_scope = is_timer_scope(path)
         self.cancel_scope = is_cancel_wait_scope(path)
+        self.thread_scope = is_thread_scope(path)
         self.shared_scope = is_shared_state_scope(path)
+        # spans of functions that snapshot contextvars (naked-thread rule)
+        self._ctx_spans = tuple(ctx_spans or ())
         self._module_names = module_names or set()
         self._sanctioned = sanctioned_names or set()
         # per-scope `global NAME` declarations (parallel to self.scope)
@@ -789,6 +841,33 @@ class _Visitor(ast.NodeVisitor):
                            "polls engine.cancel.check_cancel, or "
                            "justify with a pragma")
 
+        # naked-thread: a thread hand-off that drops the submitting
+        # thread's contextvars — the task runs with NO ambient
+        # QueryContext, so per-tenant metrics, fault injection, and
+        # cancellation silently detach (docs/serving.md)
+        if self.thread_scope and \
+                not self._ctx_propagating(node.lineno):
+            if name in ("threading.Thread", "Thread"):
+                if not self._hands_off_context_run(node):
+                    self._flag(node, "naked-thread",
+                               "threading.Thread without the submitting "
+                               "thread's contextvars; snapshot with "
+                               "contextvars.copy_context() and run the "
+                               "target through ctx.run (io/prefetch.py "
+                               "is the template), or justify a "
+                               "deliberately context-free daemon with a "
+                               "pragma")
+            elif isinstance(node.func, ast.Attribute) and \
+                    tail == "submit" and (node.args or node.keywords):
+                if not self._hands_off_context_run(node):
+                    self._flag(node, "naked-thread",
+                               ".submit() without the submitting "
+                               "thread's contextvars; submit "
+                               "copy_context().run (engine/scheduler.py "
+                               "_submit is the template) so the task "
+                               "keeps its query's ambient state, or "
+                               "justify with a pragma")
+
         # naked-dispatch: a dispatch site outside the retry combinators
         if self.hot and tail == "record_dispatch" and \
                 not self._retry_guarded_scope():
@@ -906,6 +985,26 @@ class _Visitor(ast.NodeVisitor):
         # DIRECTLY to get_or_build is a builder — an arbitrary enclosing
         # lambda is still a fresh function object per invocation
         return "<builder>" in self.scope
+
+    def _ctx_propagating(self, line: int) -> bool:
+        """True when `line` sits inside a function/lambda that calls
+        contextvars.copy_context (naked-thread rule: the hand-off is
+        presumed to ship that snapshot)."""
+        return any(lo <= line <= hi for lo, hi in self._ctx_spans)
+
+    @staticmethod
+    def _hands_off_context_run(node: ast.Call) -> bool:
+        """True when the hand-off's callable is a `<ctx>.run` attribute —
+        the contextvars idiom even when the snapshot happened elsewhere:
+        Thread(target=cctx.run, ...) / pool.submit(cctx.run, fn, ...)."""
+        cands: List[ast.AST] = []
+        if node.args:
+            cands.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "target":
+                cands.append(kw.value)
+        return any(isinstance(c, ast.Attribute) and c.attr == "run"
+                   for c in cands)
 
     def _retry_guarded_scope(self) -> bool:
         """True when the current scope chain runs under a retry combinator:
@@ -1038,7 +1137,8 @@ def lint_source(source: str, path: str,
                        retry_names=retry_names,
                        retry_lambdas=retry_lambdas,
                        module_names=module_names,
-                       sanctioned_names=sanctioned)
+                       sanctioned_names=sanctioned,
+                       ctx_spans=_context_propagating_spans(tree))
     visitor.visit(tree)
     stmt_start = _stmt_start_map(tree)
     findings = [f for f in visitor.findings

@@ -42,6 +42,7 @@ from spark_rapids_tpu.aqe.stages import (
     describe_spec,
     unwrap_to_stage,
 )
+from spark_rapids_tpu.engine import cancel as CX
 from spark_rapids_tpu.exec.base import PhysicalExec
 from spark_rapids_tpu.plan.logical import JoinType
 from spark_rapids_tpu.utils import metrics as M
@@ -376,7 +377,9 @@ def _replace_placement(plan: PhysicalExec, ctx,
         return plan  # nothing measured: the static pass already decided
     try:
         placed, rep = place_plan(plan, ctx.conf, measured_stats=stats)
-    except Exception:  # noqa: BLE001 - placement is best-effort
+    except Exception as e:  # noqa: BLE001 - placement is best-effort
+        if CX.is_cancellation(e):
+            raise
         log.warning("adaptive placement re-plan failed; keeping the "
                     "current remainder", exc_info=True)
         return plan

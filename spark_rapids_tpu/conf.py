@@ -735,6 +735,22 @@ CIRCUIT_BREAKER_THRESHOLD = _conf(
     "session tolerates before the circuit breaker opens."
 ).check(lambda v: None if v >= 1 else "must be >= 1").integer(4)
 
+CIRCUIT_BREAKER_COOLDOWN_MS = _conf(
+    "rapids.tpu.execution.circuitBreaker.cooldownMs").doc(
+    "Half-open recovery: once a breaker has been open this many "
+    "milliseconds it admits up to probeQueries device probes — a probe "
+    "succeeding closes the breaker (failure count resets), a probe "
+    "failing re-opens it and restarts the cooldown. 0 = the pre-r18 "
+    "behavior (an open breaker stays open until session.stop())."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(30000.0)
+
+CIRCUIT_BREAKER_PROBE_QUERIES = _conf(
+    "rapids.tpu.execution.circuitBreaker.probeQueries").doc(
+    "Device queries admitted through a HALF-OPEN breaker per cooldown "
+    "window before it re-latches open awaiting their verdict; the first "
+    "probe that completes decides (success closes, failure re-opens)."
+).check(lambda v: None if v >= 1 else "must be >= 1").integer(1)
+
 TASK_TIMEOUT_SECONDS = _conf("rapids.tpu.engine.taskTimeoutSeconds").doc(
     "Wall-clock budget for one partition task; a pooled job whose task "
     "exceeds it fails with a TaskFailedError(TaskTimeoutError) instead "
@@ -760,6 +776,65 @@ RETRY_BACKOFF_MS = _conf("rapids.tpu.engine.retryBackoffMs").doc(
     "(0.5 + jitter) where jitter is a deterministic hash of the retry "
     "identity — reproducible schedules, no thundering herd."
 ).check(lambda v: None if v >= 0 else "must be >= 0").double(5.0)
+
+# ---------------------------------------------------------------------------
+# Self-healing execution (engine/scheduler.py speculation +
+# engine/watchdog.py, docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+SPECULATION_ENABLED = _conf("rapids.tpu.engine.speculation.enabled").doc(
+    "Cost-calibrated straggler speculation: a pooled partition task "
+    "still running past max(minRuntimeMs, multiplier x its predicted "
+    "duration) while at least `quantile` of its job's sibling tasks "
+    "have finished gets ONE speculative duplicate (an idempotent "
+    "re-execution from source, never shared device buffers); the first "
+    "completion wins and the loser is cancelled through its task-scoped "
+    "CancelToken. Metrics: speculativeTasks / speculativeWins."
+).boolean(True)
+
+SPECULATION_MIN_RUNTIME_MS = _conf(
+    "rapids.tpu.engine.speculation.minRuntimeMs").doc(
+    "Floor under the speculation threshold: a task is never speculated "
+    "before running at least this long, whatever the cost model "
+    "predicts — guards sub-millisecond tasks against duplicate storms."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(500.0)
+
+SPECULATION_MULTIPLIER = _conf(
+    "rapids.tpu.engine.speculation.multiplier").doc(
+    "Straggler threshold as a multiple of the task's predicted p95 "
+    "duration (the calibrated CostModel prediction when enough samples "
+    "exist, the flat per-dispatch model otherwise; with no prediction "
+    "at all the median of finished sibling durations stands in)."
+).check(lambda v: None if v >= 1.0 else "must be >= 1.0").double(4.0)
+
+SPECULATION_QUANTILE = _conf("rapids.tpu.engine.speculation.quantile").doc(
+    "Fraction of a job's sibling tasks that must have FINISHED before "
+    "any task of that job may be speculated (a uniformly slow job is "
+    "not straggling; one laggard among finished siblings is)."
+).check(lambda v: None if 0.0 <= v <= 1.0 else "must be in [0,1]"
+        ).double(0.5)
+
+WATCHDOG_ENABLED = _conf("rapids.tpu.engine.watchdog.enabled").doc(
+    "Hung-dispatch watchdog: one scheduler-owned daemon thread "
+    "heartbeats every in-flight retry-wrapped dispatch; a dispatch "
+    "silent past its timeout is classified WEDGED (metric: "
+    "watchdogKills), its cooperative wait-points are released so the "
+    "attempt raises a retryable TpuDispatchWedged and re-dispatches on "
+    "fresh buffers, and a dispatch still silent past 2x the timeout "
+    "escalates by firing the owning query's CancelToken."
+).boolean(True)
+
+WATCHDOG_DISPATCH_TIMEOUT_MS = _conf(
+    "rapids.tpu.engine.watchdog.dispatchTimeoutMs").doc(
+    "Silence budget for one in-flight dispatch before the watchdog "
+    "classifies it wedged. 0 = calibrated: 8x the active CostModel's "
+    "predicted per-task wall when a prediction exists, else a 30s "
+    "cold-start default."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(0.0)
+
+WATCHDOG_POLL_MS = _conf("rapids.tpu.engine.watchdog.pollMs").doc(
+    "Heartbeat cadence of the watchdog daemon's scan over in-flight "
+    "dispatch registrations."
+).check(lambda v: None if v >= 1 else "must be >= 1").double(50.0)
 
 # ---------------------------------------------------------------------------
 # Cooperative cancellation + deadline propagation (engine/cancel.py,
@@ -841,9 +916,11 @@ FAULT_INJECTION_SEED = _conf("rapids.tpu.test.faultInjection.seed").doc(
 
 FAULT_INJECTION_SITES = _conf("rapids.tpu.test.faultInjection.sites").doc(
     "Comma-separated injection sites, each 'name' or 'name:kind' with "
-    "kind one of oom|dispatch|transfer|fetch ('*' = every registered "
-    "site at its default kind). Registered sites: see "
-    "spark_rapids_tpu.utils.faultinject.SITES / docs/fault-tolerance.md."
+    "kind one of oom|dispatch|transfer|fetch|delay|wedge|device_loss "
+    "('*' = every registered site at its default kind; the cancel, "
+    "delay, wedge, and device_loss kinds are explicit opt-ins). "
+    "Registered sites: see spark_rapids_tpu.utils.faultinject.SITES / "
+    "docs/fault-tolerance.md."
 ).string("*")
 
 FAULT_INJECTION_RATE = _conf("rapids.tpu.test.faultInjection.rate").doc(
@@ -852,6 +929,14 @@ FAULT_INJECTION_RATE = _conf("rapids.tpu.test.faultInjection.rate").doc(
     "terminate; the CPU fallback backstops rate = 1)."
 ).check(lambda v: None if 0.0 <= v <= 1.0 else "must be in [0,1]"
         ).double(0.25)
+
+FAULT_INJECTION_DELAY_MS = _conf(
+    "rapids.tpu.test.faultInjection.delayMs").doc(
+    "Straggler model: an armed site firing the `delay` kind sleeps this "
+    "long (cancel-aware) before proceeding NORMALLY — the work still "
+    "happens and results stay oracle-equal, the task just runs late, "
+    "which is what straggler speculation exists to absorb."
+).check(lambda v: None if v >= 0 else "must be >= 0").double(400.0)
 
 FAULT_INJECTION_DEFER_TO_SINK = _conf(
     "rapids.tpu.test.faultInjection.deferToSink").doc(

@@ -243,6 +243,20 @@ class AdmissionController:
         if self._admitted > self._peak_admitted:
             self._peak_admitted = self._admitted
 
+    def note_device_loss(self, healthy: int, total: int) -> int:
+        """Re-scale the HBM budget after a device quarantine
+        (docs/fault-tolerance.md self-healing): the lost chip's HBM must
+        stop being priced, so admitted-bytes headroom shrinks to the
+        surviving fraction. With no survivors the budget stands — the
+        session is degrading to CPU and a zero budget would wedge every
+        waiter instead of letting the breaker route around the device.
+        Returns the budget in force."""
+        with self._cv:
+            if total > 0 and 0 < healthy < total:
+                self.budget = max(1, int(self.budget * healthy / total))
+            self._cv.notify_all()
+            return self.budget
+
     def release(self, ticket: AdmissionTicket) -> None:
         with self._cv:
             if ticket.released:

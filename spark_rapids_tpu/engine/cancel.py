@@ -51,6 +51,7 @@ cancelled query by design returns no rows to compare).
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from typing import Optional
 
@@ -181,19 +182,49 @@ def current_token() -> Optional[CancelToken]:
     return ctx.cancel if ctx is not None else None
 
 
+# A TASK-scoped token, narrower than the query token: the scheduler's
+# speculation race (engine/scheduler.py) arms one per racing attempt so
+# the losing duplicate can be cancelled WITHOUT touching the query token
+# (which would be terminal for the whole query). It rides contextvars
+# exactly like the query context, so a copy_context'd worker thread
+# carries its own attempt's token.
+_TASK_TOKEN: contextvars.ContextVar = contextvars.ContextVar(
+    "srt-task-token", default=None)
+
+
+def set_task_token(tok: Optional[CancelToken]):
+    """Install a task-scoped token for the current context; returns the
+    contextvars reset handle for `reset_task_token`."""
+    return _TASK_TOKEN.set(tok)
+
+
+def reset_task_token(handle) -> None:
+    _TASK_TOKEN.reset(handle)
+
+
+def current_task_token() -> Optional[CancelToken]:
+    return _TASK_TOKEN.get()
+
+
 def check_cancel(site: str = "") -> None:
     """THE cancellation poll: raises TpuQueryCancelled /
     TpuDeadlineExceeded when the ambient query is cancelled or past its
-    deadline; a single None-check otherwise. Also the home of the
-    `cancel.race` fault-injection site — arming it fires a cancellation
-    at one of these polls, modeling a cancel racing engine progress."""
+    deadline; a single None-check otherwise. Also polls the task-scoped
+    token (speculation loser-cancel) when one is installed. Also the
+    home of the `cancel.race` fault-injection site — arming it fires a
+    cancellation at one of these polls, modeling a cancel racing engine
+    progress."""
     tok = current_token()
-    if tok is None:
+    ttok = _TASK_TOKEN.get()
+    if tok is None and ttok is None:
         return
     from spark_rapids_tpu.utils import faultinject as FI
 
     FI.maybe_inject("cancel.race")
-    tok.check(site)
+    if tok is not None:
+        tok.check(site)
+    if ttok is not None:
+        ttok.check(site)
 
 
 # never-set event backing the no-token sleep fallback: a timed Event.wait
@@ -211,9 +242,25 @@ def cancel_aware_sleep(seconds: float, site: str = "backoff") -> None:
         check_cancel(site)
         return
     tok = current_token()
-    if tok is None:
+    ttok = _TASK_TOKEN.get()
+    if tok is None and ttok is None:
         _FALLBACK_SLEEP.wait(seconds)
         return
+    if ttok is not None:
+        # a speculation loser must wake from its sleep the moment the
+        # sibling attempt wins, or it keeps its semaphore permits for the
+        # full nap: wait on the task token (instant wake on loser-cancel)
+        # and poll the query token on the same short cadence
+        deadline = wall_ns() + int(seconds * 1e9)
+        while True:
+            remain = (deadline - wall_ns()) / 1e9
+            if remain <= 0:
+                check_cancel(site)
+                return
+            if ttok.wait(min(remain, 0.02)):
+                ttok.check(site)
+            if tok is not None:
+                tok.check(site)
     if tok.wait(seconds):
         tok.check(site)
 

@@ -67,6 +67,23 @@ class TpuTransientDeviceError(TpuRetryableError):
     transport): re-dispatch after backoff, the input is intact."""
 
 
+class TpuDispatchWedged(TpuTransientDeviceError):
+    """A dispatch the watchdog (engine/watchdog.py) classified as WEDGED:
+    it went silent past its timeout, so its cooperative wait-points were
+    released and the attempt raises this instead of blocking on a fence
+    that will never land. Transient by design — the retry combinators
+    re-dispatch on fresh buffers."""
+
+
+class TpuDeviceLostError(TpuTransientDeviceError):
+    """The device itself is gone (backend restart, ICI peer loss, reset):
+    distinct from a transient dispatch hiccup because re-dispatching IN
+    PLACE cannot help — with_retry hands it straight up, the device
+    manager quarantines the device, and the session replays once from
+    the plan cache (checked mode) before degrading to CPU via the
+    per-tenant breaker (metric: deviceResets)."""
+
+
 class TpuAsyncSinkError(TpuRetryableError):
     """A device failure the per-site machinery cannot own IN PLACE under
     issue-ahead execution (docs/async-execution.md): either the error
@@ -166,6 +183,19 @@ def failure_needs_checked_replay(e: BaseException) -> bool:
     if is_cancellation(e):
         return False
     return any(isinstance(n, TpuAsyncSinkError) for n in _cause_chain(e))
+
+
+def failure_is_device_loss(e: BaseException) -> bool:
+    """Whether a failure (or anything on its cause chain) is a
+    TpuDeviceLostError — the device itself is gone, so the session's
+    recovery rung (quarantine + replay-once + breaker/CPU) owns it
+    instead of the in-place retry machinery. Cancellation wins as
+    always: a cancelled query is never 'recovered'."""
+    from spark_rapids_tpu.engine.cancel import is_cancellation
+
+    if is_cancellation(e):
+        return False
+    return any(isinstance(n, TpuDeviceLostError) for n in _cause_chain(e))
 
 
 def failure_is_device_rooted(e: BaseException) -> bool:
@@ -289,7 +319,13 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
     the fencesPerQuery unit). `donated=True` marks a dispatch whose input
     buffers are donated into the kernel: a retryable failure cannot
     re-dispatch (the inputs are consumed), so it escalates straight to
-    TpuAsyncSinkError for the session's checked replay."""
+    TpuAsyncSinkError for the session's checked replay.
+
+    Every attempt is registered with the hung-dispatch watchdog
+    (engine/watchdog.py) for its whole in-flight window — this wrapper IS
+    the dispatch chokepoint, so the watchdog's heartbeat covers every
+    retry-guarded device call with no per-site instrumentation."""
+    from spark_rapids_tpu.engine import watchdog as WD
     from spark_rapids_tpu.utils import faultinject as FI
 
     pol = policy()
@@ -297,6 +333,7 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
     transient_left = pol.transient_retries
     attempt_no = 0
     while True:
+        entry = WD.register(site)
         try:
             FI.maybe_inject(site)
             # per ATTEMPT, after injection: a retried download issues a
@@ -306,12 +343,24 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
                 M.record_fence()
             return attempt()
         except Exception as e:  # noqa: BLE001 — classification boundary
+            # the attempt is no longer in flight: drop its heartbeat
+            # BEFORE classification/backoff so the watchdog never fires
+            # on time spent sleeping between attempts
+            WD.deregister(entry)
+            entry = None
             typed = as_typed_error(e)
             if typed is None:
                 raise
             if isinstance(typed, TpuAsyncSinkError):
                 # already attributed for the checked replay: neither this
                 # wrapper nor an outer one may absorb it
+                if typed is e:
+                    raise
+                raise typed from e
+            if isinstance(typed, TpuDeviceLostError):
+                # the device is GONE: an in-place re-dispatch lands on the
+                # same dead backend, so hand the loss straight up for the
+                # session's quarantine + replay ladder
                 if typed is e:
                     raise
                 raise typed from e
@@ -350,6 +399,8 @@ def with_retry(attempt: Callable[[], T], site: str = "device",
                 with obs_span(f"retry.backoff:{site}", attempt=attempt_no):
                     backoff_sleep(attempt_no, site)
             attempt_no += 1
+        finally:
+            WD.deregister(entry)
 
 
 def split_batch_halves(batch):
@@ -451,9 +502,19 @@ def _run_cpu_fallback(cpu_fn: Callable, batch, row_offset: int):
 # ---------------------------------------------------------------------------
 class CircuitBreaker:
     """Counts device failures (retry exhaustions, not individual retries);
-    once `threshold` is reached the breaker opens and stays open for the
-    session — remaining batches bypass the device and remaining queries
-    plan on the CPU engine (rapids.tpu.execution.circuitBreaker.*).
+    once `threshold` is reached the breaker OPENS and the remaining work
+    routes to the CPU — batches bypass the device and new queries plan on
+    the CPU engine (rapids.tpu.execution.circuitBreaker.*).
+
+    Half-open recovery (r18): after `cooldown_ms` of open time the
+    breaker admits up to `probe_queries` device probes (is_open() returns
+    False while probe slots remain — the session charges one slot per
+    query via note_probe()). A probe SUCCEEDING (note_success from a
+    device query that completed) closes the breaker and resets its
+    failure count; a probe FAILING (record_failure) re-opens it and
+    restarts the cooldown. cooldown_ms=0 keeps the pre-r18 latch-open
+    behavior. State transitions count for telemetry
+    (TpuServer.metrics_prometheus).
 
     Multi-tenant serving (docs/serving.md): breakers are registered per
     tenant name, and `get()` prefers the ambient QueryContext's breaker —
@@ -465,10 +526,16 @@ class CircuitBreaker:
     _tenants: dict = {}
     _lock = threading.Lock()
 
-    def __init__(self, enabled: bool = True, threshold: int = 4):
+    def __init__(self, enabled: bool = True, threshold: int = 4,
+                 cooldown_ms: float = 0.0, probe_queries: int = 1):
         self.enabled = enabled
         self.threshold = max(1, threshold)
+        self.cooldown_ms = max(0.0, float(cooldown_ms))
+        self.probe_queries = max(1, int(probe_queries))
         self._failures = 0
+        self._opened_ns = 0
+        self._probes_used = 0
+        self._transitions = {"opened": 0, "half_opened": 0, "closed": 0}
         self._cv = threading.Lock()
 
     @classmethod
@@ -492,6 +559,10 @@ class CircuitBreaker:
             inst.enabled = tpu_conf.get(C.CIRCUIT_BREAKER_ENABLED)
             inst.threshold = max(
                 1, tpu_conf.get(C.CIRCUIT_BREAKER_THRESHOLD))
+            inst.cooldown_ms = max(
+                0.0, tpu_conf.get(C.CIRCUIT_BREAKER_COOLDOWN_MS))
+            inst.probe_queries = max(
+                1, tpu_conf.get(C.CIRCUIT_BREAKER_PROBE_QUERIES))
         return inst
 
     @classmethod
@@ -529,10 +600,65 @@ class CircuitBreaker:
 
     def record_failure(self) -> bool:
         """Count one device failure; returns True when the breaker is now
-        open."""
+        open. A failure landing in the half-open window is a failed probe:
+        the breaker re-opens and the cooldown restarts."""
         with self._cv:
+            was_tripped = self.enabled and self._failures >= self.threshold
+            # a failure after the cooldown elapsed is a failed PROBE
+            # (whether or not its slot was charged yet): re-open and
+            # restart the cooldown window
+            probing = was_tripped and self.cooldown_ms > 0 and \
+                (_now_ns() - self._opened_ns) >= self.cooldown_ms * 1e6
             self._failures += 1
-            return self.enabled and self._failures >= self.threshold
+            now_open = self.enabled and self._failures >= self.threshold
+            if now_open and (not was_tripped or probing):
+                self._opened_ns = _now_ns()
+                self._probes_used = 0
+                self._transitions["opened"] += 1
+            return now_open
+
+    def note_probe(self) -> None:
+        """Charge one half-open probe slot (the session calls this once
+        per device query admitted through a half-open breaker)."""
+        with self._cv:
+            if self._phase() == "half_open":
+                if self._probes_used == 0:
+                    self._transitions["half_opened"] += 1
+                self._probes_used += 1
+
+    def note_success(self) -> None:
+        """A device query completed: a tripped breaker's probe verdict is
+        SUCCESS — close it (failure count resets). A breaker that never
+        tripped ignores the note (the common path stays counter-free),
+        and so does a latch-mode breaker (cooldown_ms=0 — the pre-r18
+        open-until-session-stop contract)."""
+        with self._cv:
+            if self.enabled and self.cooldown_ms > 0 and \
+                    self._failures >= self.threshold:
+                self._failures = 0
+                self._opened_ns = 0
+                self._probes_used = 0
+                self._transitions["closed"] += 1
+
+    def _phase(self) -> str:
+        """Lock held by caller. closed | open | half_open."""
+        if not (self.enabled and self._failures >= self.threshold):
+            return "closed"
+        if self.cooldown_ms <= 0:
+            return "open"
+        if (_now_ns() - self._opened_ns) < self.cooldown_ms * 1e6:
+            return "open"
+        if self._probes_used < self.probe_queries:
+            return "half_open"
+        return "open"
+
+    def state(self) -> str:
+        with self._cv:
+            return self._phase()
+
+    def transitions(self) -> dict:
+        with self._cv:
+            return dict(self._transitions)
 
     @property
     def failures(self) -> int:
@@ -540,5 +666,15 @@ class CircuitBreaker:
             return self._failures
 
     def is_open(self) -> bool:
+        """Whether device work must bypass to CPU right now: a tripped
+        breaker inside its cooldown, or one whose half-open probe slots
+        are spent without a verdict. Half-open returns False so probe
+        queries (and their batches) actually reach the device."""
         with self._cv:
-            return self.enabled and self._failures >= self.threshold
+            return self._phase() == "open"
+
+
+def _now_ns() -> int:
+    from spark_rapids_tpu.obs.trace import wall_ns
+
+    return wall_ns()

@@ -22,6 +22,21 @@ Hardening (docs/fault-tolerance.md):
 - an optional per-task wall-clock timeout fails a pooled job whose task
   wedges instead of hanging the query (the worker thread itself cannot be
   interrupted — single-partition jobs run inline and are not covered).
+
+Straggler speculation (docs/fault-tolerance.md self-healing): a pooled
+job tracks per-task elapsed against a cost-calibrated prediction — the
+admission-time CostModel estimate of the query's work divided across the
+job's tasks (QueryContext.predicted_work_ns), falling back to the p95 of
+the job's own FINISHED sibling durations when no fitted model is active.
+When a task runs past `max(speculation.minRuntimeMs, speculation.
+multiplier x predicted_p95)` while at least `speculation.quantile` of
+its siblings have finished, the scheduler launches ONE speculative
+duplicate. Tasks are idempotent by construction (each attempt re-reads
+from its source/piece-range and never shares device buffers — the same
+property task RETRY already requires), so racing two attempts is safe:
+the first completion wins and the loser is cancelled through a
+TASK-scoped CancelToken (engine/cancel.py) that unwinds just that
+attempt, never the query. Metrics: speculativeTasks / speculativeWins.
 """
 
 from __future__ import annotations
@@ -82,6 +97,25 @@ def _is_retryable(e: BaseException) -> bool:
     return R.is_retryable_failure(e)
 
 
+class _Attempt:
+    """One racing execution attempt of a partition task (primary or
+    speculative duplicate), with its task-scoped cancel token."""
+
+    __slots__ = ("future", "token", "submit_ns", "started_ns",
+                 "speculative")
+
+    def __init__(self, future: "cf.Future", token: "CX.CancelToken",
+                 submit_ns: int, speculative: bool):
+        self.future = future
+        self.token = token
+        self.submit_ns = submit_ns
+        # stamped by the task itself when a pool thread PICKS IT UP:
+        # straggler math must never count queue wait as runtime (16 tasks
+        # on an 8-thread pool would read the whole second wave as slow)
+        self.started_ns: Optional[int] = None
+        self.speculative = speculative
+
+
 class TaskScheduler:
     def __init__(self, num_threads: int = 8, max_failures: int = 2,
                  task_timeout_s: float = 0.0, retry_budget: int = 0):
@@ -95,6 +129,13 @@ class TaskScheduler:
         self._budget_lock = threading.Lock()
         self._pool: Optional[cf.ThreadPoolExecutor] = None
         self._lock = threading.Lock()
+        # straggler speculation: OFF for standalone schedulers (the unit-
+        # test surface pins the legacy harvest); sessions arm it from
+        # conf via configure()
+        self.spec_enabled = False
+        self.spec_min_runtime_ms = 500.0
+        self.spec_multiplier = 4.0
+        self.spec_quantile = 0.5
 
     def configure(self, tpu_conf) -> None:
         """Refresh scheduler policy from the executing session's conf and
@@ -103,6 +144,13 @@ class TaskScheduler:
 
         self.task_timeout_s = max(0.0, tpu_conf.get(C.TASK_TIMEOUT_SECONDS))
         self.retry_budget = max(0, tpu_conf.get(C.RETRY_BUDGET))
+        self.spec_enabled = bool(tpu_conf.get(C.SPECULATION_ENABLED))
+        self.spec_min_runtime_ms = max(
+            0.0, tpu_conf.get(C.SPECULATION_MIN_RUNTIME_MS))
+        self.spec_multiplier = max(
+            1.0, tpu_conf.get(C.SPECULATION_MULTIPLIER))
+        self.spec_quantile = min(
+            1.0, max(0.0, tpu_conf.get(C.SPECULATION_QUANTILE)))
         self.begin_query()
 
     def begin_query(self) -> None:
@@ -187,6 +235,11 @@ class TaskScheduler:
                 # wrap, no retry) so the session's cancellation handler
                 # sees the typed error directly
                 raise last
+            if R.failure_is_device_loss(last):
+                # the device is GONE — a task-level re-run would dispatch
+                # to the same dead chip; the session's recovery rung
+                # (quarantine + replay + breaker) owns this failure class
+                raise last
             if not _is_retryable(last):
                 raise TaskFailedError(pidx, attempt + 1, last) from last
             if attempt + 1 < self.max_failures and \
@@ -252,6 +305,8 @@ class TaskScheduler:
         if num_partitions == 1:
             return [self._run_task(0, fn)]
         pool = self._ensure_pool()
+        if self.spec_enabled:
+            return self._run_job_speculative(pool, num_partitions, fn)
         futures = [self._submit(pool, p, fn)
                    for p in range(num_partitions)]
         try:
@@ -259,6 +314,191 @@ class TaskScheduler:
                     for p, f in enumerate(futures)]
         except (CX.TpuQueryCancelled, CX.TpuOverloadedError):
             self._drain_cancelled(futures)
+            raise
+
+    # -- straggler speculation (self-healing, docs/fault-tolerance.md) -------
+    def _speculation_threshold_ns(self, num_partitions: int,
+                                  finished_ns: List[int]) -> Optional[float]:
+        """The elapsed beyond which a task is a straggler:
+        max(minRuntimeMs, multiplier x predicted_p95). The prediction is
+        the admission-time CostModel estimate of per-task wall
+        (QueryContext.predicted_work_ns / tasks) when calibration priced
+        this query, else the p95 of the job's own finished sibling
+        durations; None = no prior yet, no speculation."""
+        qctx = M.current_query_ctx()
+        predicted = getattr(qctx, "predicted_work_ns", 0) if qctx else 0
+        candidates = []
+        if predicted and predicted > 0:
+            candidates.append(predicted / max(1, num_partitions))
+        if finished_ns:
+            s = sorted(finished_ns)
+            candidates.append(s[min(len(s) - 1,
+                                    int(round(0.95 * (len(s) - 1))))])
+        if not candidates:
+            return None
+        # the tighter prior wins: an overshooting flat/calibrated estimate
+        # must not blind the scheduler to a task 10x slower than every
+        # sibling it can SEE finished (minRuntimeMs floors the race)
+        pred_task_ns = min(candidates)
+        return max(self.spec_min_runtime_ms * 1e6,
+                   self.spec_multiplier * pred_task_ns)
+
+    def _spawn_attempt(self, pool: "cf.ThreadPoolExecutor", p: int,
+                       fn: Callable[[int], T],
+                       speculative: bool) -> _Attempt:
+        """Submit one racing attempt with its own task-scoped token, so
+        the losing duplicate can be cancelled without touching the query
+        token (which is terminal for the whole query)."""
+        from spark_rapids_tpu.obs.trace import wall_ns
+
+        token = CX.CancelToken()
+        attempt = _Attempt(None, token, wall_ns(), speculative)
+        cctx = contextvars.copy_context()
+        attempt.future = pool.submit(cctx.run, self._run_task_scoped, p,
+                                     fn, token, speculative, attempt)
+        return attempt
+
+    def _run_task_scoped(self, p: int, fn: Callable[[int], T],
+                         token: "CX.CancelToken", speculative: bool,
+                         attempt: _Attempt) -> T:
+        from spark_rapids_tpu.obs.trace import wall_ns
+
+        attempt.started_ns = wall_ns()
+        handle = CX.set_task_token(token)
+        try:
+            if speculative:
+                # its own span: the traced timeline shows the duplicate
+                # racing the straggler it shadows
+                with obs_span(f"speculate:p{p}", kind="site"):
+                    return self._run_task(p, fn)
+            return self._run_task(p, fn)
+        finally:
+            CX.reset_task_token(handle)
+
+    @staticmethod
+    def _cancel_losers(attempts: List[_Attempt], winner: _Attempt) -> None:
+        for a in attempts:
+            if a is winner:
+                continue
+            a.future.cancel()
+            a.token.cancel("speculation: sibling attempt won")
+
+    def _run_job_speculative(self, pool: "cf.ThreadPoolExecutor",
+                             num_partitions: int,
+                             fn: Callable[[int], T]) -> List[T]:
+        """run_job's harvest loop with straggler speculation: identical
+        results and failure typing, plus at most ONE speculative
+        duplicate per straggling task; first completion wins, the loser
+        unwinds through its task-scoped token. Idempotency contract:
+        `fn` must re-read from its source/piece-range per call and never
+        hand shared device buffers across attempts — the same property
+        task retry already requires of it."""
+        from spark_rapids_tpu.obs.trace import wall_ns
+
+        tok = CX.current_token()
+        # straggler detection needs a steady cadence even with no cancel
+        # token to poll: the idle long-wait would sleep through the whole
+        # window in which a duplicate could still win
+        poll = _RESULT_POLL_S
+        deadline_ns = None
+        if self.task_timeout_s:
+            deadline_ns = wall_ns() + int(self.task_timeout_s * 1e9)
+            poll = min(poll, self.task_timeout_s)
+        attempts = {p: [self._spawn_attempt(pool, p, fn, False)]
+                    for p in range(num_partitions)}
+        results: dict = {}
+        finished_ns: List[int] = []
+        try:
+            while len(results) < num_partitions:
+                live = [a.future
+                        for p, al in attempts.items() if p not in results
+                        for a in al if not a.future.done()]
+                if live:
+                    cf.wait(live, timeout=poll,
+                            return_when=cf.FIRST_COMPLETED)
+                if tok is not None:
+                    tok.check("job.await")
+                now = wall_ns()
+                for p in range(num_partitions):
+                    if p in results:
+                        continue
+                    al = attempts[p]
+                    winner = None
+                    errors: List[BaseException] = []
+                    for a in al:
+                        if not a.future.done():
+                            continue
+                        try:
+                            res = a.future.result(timeout=0)
+                        except cf.CancelledError:
+                            continue  # loser cancelled before starting
+                        except BaseException as e:  # noqa: BLE001 — attempt race harvest; losers re-raise below
+                            errors.append(e)
+                        else:
+                            winner = (a, res)
+                            break
+                    if winner is not None:
+                        a, res = winner
+                        results[p] = res
+                        finished_ns.append(
+                            now - (a.started_ns or a.submit_ns))
+                        if a.speculative:
+                            M.record_speculative_win()
+                        self._cancel_losers(al, a)
+                        continue
+                    if all(a.future.done() for a in al):
+                        # every racing attempt failed: surface the real
+                        # failure, never a loser's own cancellation
+                        real = [e for e in errors
+                                if not CX.is_cancellation(e)] or errors
+                        if real:
+                            raise real[0]
+                        raise TaskFailedError(
+                            p, len(al),
+                            RuntimeError("all attempts cancelled"))
+                    if deadline_ns is not None and now >= deadline_ns:
+                        for al2 in attempts.values():
+                            for a in al2:
+                                a.future.cancel()
+                        raise TaskFailedError(
+                            p, 1, TaskTimeoutError(
+                                f"partition task {p} exceeded "
+                                f"{self.task_timeout_s:.1f}s")) from None
+                done_frac = len(results) / num_partitions
+                if results and done_frac >= self.spec_quantile and \
+                        len(results) < num_partitions:
+                    thr_ns = self._speculation_threshold_ns(
+                        num_partitions, finished_ns)
+                    if thr_ns is not None:
+                        for p in range(num_partitions):
+                            if p in results:
+                                continue
+                            al = attempts[p]
+                            if len(al) > 1:
+                                continue  # one duplicate max
+                            a0 = al[0]
+                            # a still-QUEUED task is not a straggler — a
+                            # duplicate would queue right behind it
+                            if a0.started_ns is None or \
+                                    not a0.future.running():
+                                continue
+                            if now - a0.started_ns < thr_ns:
+                                continue
+                            al.append(self._spawn_attempt(
+                                pool, p, fn, True))
+                            M.record_speculative_task()
+            # losers unwind fast (their task tokens fired and every
+            # cancel-aware wait polls them) but the query must not report
+            # complete while a loser still holds pool slots or semaphore
+            # permits — reclamation is part of the result contract
+            losers = [a.future for al in attempts.values() for a in al
+                      if not a.future.done()]
+            if losers:
+                cf.wait(losers, timeout=_CANCEL_DRAIN_S)
+            return [results[p] for p in range(num_partitions)]
+        except (CX.TpuQueryCancelled, CX.TpuOverloadedError):
+            self._drain_cancelled([a.future for al in attempts.values()
+                                   for a in al])
             raise
 
     def _submit(self, pool: "cf.ThreadPoolExecutor", p: int,

@@ -400,6 +400,9 @@ class TpuServer:
             br = CircuitBreaker.peek(tenant)
             t["breakerOpen"] = br.is_open() if br is not None else False
             t["breakerFailures"] = br.failures if br is not None else 0
+            t["breakerState"] = br.state() if br is not None else "closed"
+            t["breakerTransitions"] = (br.transitions()
+                                       if br is not None else {})
             tenants[tenant] = t
         snap["tenants"] = tenants
         return snap

@@ -65,6 +65,7 @@ from spark_rapids_tpu.columnar.batch import (
     repad_column,
 )
 from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.engine import cancel as CX
 from spark_rapids_tpu.engine.jit_cache import get_or_build
 from spark_rapids_tpu.exec import join as JN
 from spark_rapids_tpu.exec import rowkeys as RK
@@ -225,8 +226,12 @@ def _pack_device_table(mesh, per_part, ordinals, attrs, cap: int,
             else:
                 try:
                     shared, aligned = ENC.align_encoded(cols)
-                except Exception:  # pragma: no cover - alignment is
-                    continue       # best-effort; decode path stays sound
+                except Exception as e:  # pragma: no cover - alignment is
+                    # best-effort; decode path stays sound — but a
+                    # cancellation racing it is terminal, not a miss
+                    if CX.is_cancellation(e):
+                        raise
+                    continue
                 enc_keep[pi] = shared
                 enc_aligned[pi] = aligned
 

@@ -1037,7 +1037,7 @@ class _HostAcc:
 def _is_nan(v) -> bool:
     try:
         return v != v
-    except Exception:
+    except TypeError:
         return False
 
 

@@ -105,6 +105,9 @@ def _free_buffers(bufs) -> None:
         for b in bufs:
             try:
                 fw.free(b)
+            # tpulint: swallowed-cancellation -- best-effort free of an
+            # already-condemned buffer on a reclamation path; raising
+            # here would leak the REST of the buffers
             except Exception:
                 pass
 

@@ -72,6 +72,7 @@ class TpuDeviceManager:
     def shutdown(cls) -> None:
         with cls._lock:
             cls._instance = None
+        cls.clear_quarantine()
 
     def _do_init(self) -> None:
         devices = jax.devices()
@@ -104,6 +105,16 @@ class TpuDeviceManager:
     _TRANSIENT_MARKERS = ("ABORTED", "UNAVAILABLE", "DEADLINE_EXCEEDED",
                           "DATA_LOSS", "device disconnected",
                           "premature end of stream")
+    # markers of the device itself being GONE (backend restart, ICI peer
+    # loss, hardware reset) — checked BEFORE the transient family because
+    # loss messages often carry UNAVAILABLE too, and the recovery path is
+    # different: never retried in place, the session quarantines the
+    # device and replays/degrades (docs/fault-tolerance.md self-healing)
+    _DEVICE_LOSS_MARKERS = ("device lost", "Device lost", "DEVICE_RESET",
+                            "backend restarted", "backend restart",
+                            "peer is unreachable", "ICI peer loss",
+                            "device has been reset",
+                            "hardware failure")
     # backend exception type names that carry device-runtime failures
     # (matched by name: jaxlib layouts move across versions and the
     # translation must not hard-depend on them)
@@ -113,12 +124,15 @@ class TpuDeviceManager:
     @classmethod
     def translate_device_error(cls, e: BaseException):
         """Map a backend runtime error into the typed retryable hierarchy
-        (engine/retry.py): RESOURCE_EXHAUSTED -> TpuRetryOOM, ABORTED/
-        UNAVAILABLE -> TpuTransientDeviceError, anything else -> None
-        (not a device-health failure; the caller re-raises). This is the
-        TPU analog of the RMM failure callback classifying allocation
+        (engine/retry.py): RESOURCE_EXHAUSTED -> TpuRetryOOM, the
+        unavailable/reset family -> TpuDeviceLostError (quarantine +
+        replay, never retried in place), ABORTED/UNAVAILABLE ->
+        TpuTransientDeviceError, anything else -> None (not a
+        device-health failure; the caller re-raises). This is the TPU
+        analog of the RMM failure callback classifying allocation
         failures for the retry state machine."""
         from spark_rapids_tpu.engine.retry import (
+            TpuDeviceLostError,
             TpuRetryOOM,
             TpuTransientDeviceError,
         )
@@ -131,10 +145,69 @@ class TpuDeviceManager:
         msg = str(e)
         if any(m in msg for m in cls._OOM_MARKERS):
             return TpuRetryOOM(f"device OOM ({tname}): {msg}")
+        if any(m in msg for m in cls._DEVICE_LOSS_MARKERS):
+            return TpuDeviceLostError(f"device lost ({tname}): {msg}")
         if any(m in msg for m in cls._TRANSIENT_MARKERS):
             return TpuTransientDeviceError(
                 f"transient device error ({tname}): {msg}")
         return None
+
+    # -- device quarantine (self-healing, docs/fault-tolerance.md) -----------
+    # A device a TpuDeviceLostError was rooted on is POISONED: the session
+    # quarantines it (quarantine_device), the ICI mesh rebuilds on the
+    # survivors (shuffle/ici.session_mesh filters quarantined ids), and
+    # admission re-scales its byte budget so it stops pricing the lost
+    # chip's HBM. Process-wide state, cleared with the shared runtime.
+    _quarantined_ids: set = set()
+    _quarantine_lock = threading.Lock()
+
+    @classmethod
+    def quarantine_device(cls, device=None, reason: str = "") -> int:
+        """Mark `device` (default: the manager's own) poisoned; rebuilds
+        the ICI mesh on the survivors and returns the healthy count."""
+        if device is None:
+            mgr = cls._instance
+            device = mgr.device if mgr is not None else None
+        did = getattr(device, "id", 0)
+        with cls._quarantine_lock:
+            already = did in cls._quarantined_ids
+            cls._quarantined_ids.add(did)
+        if not already:
+            log.warning("device %s quarantined: %s", did,
+                        reason or "device loss")
+            from spark_rapids_tpu.shuffle import ici as _ici
+
+            _ici.reset_mesh()
+        return cls.healthy_device_count()
+
+    @classmethod
+    def is_quarantined(cls, device) -> bool:
+        with cls._quarantine_lock:
+            return getattr(device, "id", 0) in cls._quarantined_ids
+
+    @classmethod
+    def quarantined_count(cls) -> int:
+        with cls._quarantine_lock:
+            return len(cls._quarantined_ids)
+
+    @classmethod
+    def healthy_devices(cls) -> list:
+        with cls._quarantine_lock:
+            bad = set(cls._quarantined_ids)
+        try:
+            devs = jax.devices()
+        except Exception:
+            return []
+        return [d for d in devs if getattr(d, "id", 0) not in bad]
+
+    @classmethod
+    def healthy_device_count(cls) -> int:
+        return len(cls.healthy_devices())
+
+    @classmethod
+    def clear_quarantine(cls) -> None:
+        with cls._quarantine_lock:
+            cls._quarantined_ids.clear()
 
     @staticmethod
     def _detect_hbm(device) -> int:

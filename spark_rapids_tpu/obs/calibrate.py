@@ -253,8 +253,12 @@ _FIT_STATUSES = (None, "ok", "bench")
 
 
 def _fittable(rec: dict) -> bool:
+    # self_healed (obs/history.py): speculation/watchdog/device-loss
+    # recovery ran during the query, so its measured walls include
+    # killed/raced attempts — excluded from fits like host runs are
     return isinstance(rec.get("classes"), dict) and \
-        rec.get("status") in _FIT_STATUSES
+        rec.get("status") in _FIT_STATUSES and \
+        not rec.get("self_healed")
 
 
 def _class_samples(records: List[dict]) -> Dict[str, List[dict]]:

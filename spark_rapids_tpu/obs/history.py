@@ -58,7 +58,20 @@ _EVENT_COUNTERS = (
     ("cancelledQueries", "cancel"),
     ("deadlineRejects", "deadline"),
     ("admissionWaits", "admission"),
+    # self-healing recovery events (docs/fault-tolerance.md): the serving
+    # layer and calibration read flaky hardware off these rows
+    ("speculativeTasks", "speculation"),
+    ("speculativeWins", "speculation"),
+    ("watchdogKills", "watchdog"),
+    ("deviceResets", "device"),
 )
+
+# counters whose presence marks a record's measured walls as POLLUTED by
+# self-healing (a speculated straggler, a watchdog-released wedge, a
+# device-loss replay): the calibration layer must exclude such records
+# from per-class fits exactly like is_host_run excludes host runs
+_SELF_HEALED_COUNTERS = ("speculativeTasks", "watchdogKills",
+                         "deviceResets")
 
 _QID = itertools.count(1)
 
@@ -116,6 +129,11 @@ def build_record(qid: str, tenant: str, status: str, plan_sig,
         "wall_ns": int(wall_ns_total),
         "metrics": {k: v for k, v in sorted(counters.items()) if v},
     }
+    if any(counters.get(k) for k in _SELF_HEALED_COUNTERS):
+        # provenance tag (the is_host_run precedent): killed/speculated
+        # attempts inflate measured walls, so obs/calibrate.py keeps
+        # these records out of the per-class fits
+        rec["self_healed"] = True
     # per-operator measured spans flattened from the PR 11 trace
     ops: Dict[str, dict] = {}
     events: List[dict] = []

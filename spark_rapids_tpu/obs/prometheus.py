@@ -159,7 +159,11 @@ def render_prometheus(snapshot: dict) -> str:
                             ("checkedReplays", "checked_replays"),
                             ("cancelledQueries", "cancelled_queries"),
                             ("deadlineRejects", "deadline_rejects"),
-                            ("shedQueries", "shed_queries")):
+                            ("shedQueries", "shed_queries"),
+                            ("speculativeTasks", "speculative_tasks"),
+                            ("speculativeWins", "speculative_wins"),
+                            ("watchdogKills", "watchdog_kills"),
+                            ("deviceResets", "device_resets")):
             w.sample(f"srt_tenant_{metric}_total", t.get(key), labels,
                      mtype="counter")
         w.sample("srt_tenant_admission_wait_seconds_total",
@@ -169,4 +173,16 @@ def render_prometheus(snapshot: dict) -> str:
                  help_text="1 when the tenant's circuit breaker is open")
         w.sample("srt_tenant_breaker_failures", t.get("breakerFailures"),
                  labels)
+        # breaker phase as labeled one-hot gauges (the writer only emits
+        # numeric samples, so the string state rides in a label)
+        state = t.get("breakerState") or "closed"
+        for phase in ("closed", "open", "half_open"):
+            w.sample("srt_tenant_breaker_state", int(state == phase),
+                     {**labels, "state": phase},
+                     help_text="1 for the tenant breaker's current phase")
+        for trans, n in sorted((t.get("breakerTransitions") or {}).items()):
+            w.sample("srt_tenant_breaker_transitions_total", n,
+                     {**labels, "transition": trans}, mtype="counter",
+                     help_text="breaker lifecycle transitions "
+                               "(opened / half_opened / closed)")
     return w.text()

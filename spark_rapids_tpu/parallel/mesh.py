@@ -39,10 +39,12 @@ DATA_AXIS = "data"
 
 
 def build_mesh(n_devices: Optional[int] = None,
-               axis: str = DATA_AXIS) -> Mesh:
+               axis: str = DATA_AXIS, devices=None) -> Mesh:
     """1-D mesh over the first n devices (the executor-per-chip analog of
-    GpuDeviceManager's one-GPU-per-executor policy)."""
-    devs = jax.devices()
+    GpuDeviceManager's one-GPU-per-executor policy). An explicit device
+    list overrides discovery — the quarantine-aware mesh rebuild
+    (shuffle/ici.session_mesh) passes the surviving devices."""
+    devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))

@@ -318,6 +318,11 @@ class TpuSession:
         # the process (full reset: default + every tenant)
         CircuitBreaker.reset()
         FI.disable_global()
+        # the hung-dispatch watchdog daemon dies with the shared runtime
+        # (its in-flight registry is meaningless across sessions)
+        from spark_rapids_tpu.engine.watchdog import DispatchWatchdog
+
+        DispatchWatchdog.shutdown()
         # symmetric with the semaphore/spill singletons: a later session
         # must size its budget from ITS conf — without this, a test
         # session's hbm.sizeOverride leaks into every session that
@@ -741,6 +746,11 @@ class TpuSession:
         q_succeeded = False
         try:
             FI.configure(self.conf, ctx=qctx)
+            # the hung-dispatch watchdog refreshes from the executing
+            # session's conf exactly like the injector (engine/watchdog)
+            from spark_rapids_tpu.engine.watchdog import DispatchWatchdog
+
+            DispatchWatchdog.configure(self.conf)
             routed = self._maybe_micro_batch(plan, breaker,
                                              allow_micro_batch)
             if routed is not None:
@@ -757,9 +767,18 @@ class TpuSession:
                 physical, results = self._execute_on_cpu(
                     plan, use_plan_cache)
             else:
+                # half-open recovery (engine/retry.CircuitBreaker): a
+                # tripped breaker past its cooldown lets probe queries
+                # through — charge the slot so a silent wedge cannot hold
+                # the half-open window open forever
+                if breaker.state() == "half_open":
+                    breaker.note_probe()
                 try:
                     physical, results = self._execute_device(
                         plan, use_plan_cache)
+                    # the probe verdict: a device query completing closes
+                    # a tripped breaker (no-op on a closed one)
+                    breaker.note_success()
                 except Exception as e:  # noqa: BLE001 — degradation boundary
                     if not R.failure_is_device_rooted(e):
                         raise
@@ -802,7 +821,9 @@ class TpuSession:
                          M.SKEW_SPLITS, M.JOIN_DEMOTIONS,
                          M.JOIN_PROMOTIONS, M.CANCELLED_QUERIES,
                          M.DEADLINE_REJECTS, M.SHED_QUERIES,
-                         M.HOST_PLACED_OPS, M.PLACEMENT_REPLACEMENTS):
+                         M.HOST_PLACED_OPS, M.PLACEMENT_REPLACEMENTS,
+                         M.SPECULATIVE_TASKS, M.SPECULATIVE_WINS,
+                         M.WATCHDOG_KILLS, M.DEVICE_RESETS):
                 self.last_query_metrics[name] = snap.get(name, 0)
             self.last_adaptive_report = list(qctx.aqe_notes)
             finished_trace = None
@@ -938,10 +959,16 @@ class TpuSession:
         from spark_rapids_tpu.utils import metrics as M
 
         tok = qctx.cancel if qctx is not None else None
+        predicted_s, source = predict_query_work_s(report, self.conf)
+        if qctx is not None and predicted_s > 0:
+            # stash the cost-model prediction for the self-healing layer:
+            # scheduler speculation and the watchdog's calibrated timeout
+            # divide it across the query's tasks (host math only — the
+            # zero-dispatch contract of this check is untouched)
+            qctx.predicted_work_ns = int(predicted_s * 1e9)
         if tok is None or tok.deadline_ns is None:
             return
         remaining = tok.deadline_remaining_s()
-        predicted_s, source = predict_query_work_s(report, self.conf)
         if remaining > predicted_s:
             return
         M.record_deadline_reject()
@@ -1134,6 +1161,12 @@ class TpuSession:
         from spark_rapids_tpu.utils import faultinject as FI
         from spark_rapids_tpu.utils import metrics as M
 
+        if R.failure_is_device_loss(e):
+            # the device itself is GONE: its own recovery rung
+            # (quarantine + replay-once + breaker/CPU) owns this
+            return self._recover_device_loss(plan, e, breaker,
+                                             cpu_fallback_ok,
+                                             use_plan_cache)
         if AX.replay_warranted() and R.failure_needs_checked_replay(e):
             M.record_checked_replay()
             log.warning(
@@ -1200,6 +1233,51 @@ class TpuSession:
                     "on the CPU oracle engine", e)
         # the fallback run is the backstop: injected faults must not chase
         # it (re-armed at the next query start)
+        FI.disable()
+        return self._execute_on_cpu(plan, use_plan_cache)
+
+    def _recover_device_loss(self, plan: L.LogicalPlan, e: BaseException,
+                             breaker, cpu_fallback_ok: bool,
+                             use_plan_cache: bool = True):
+        """Device-loss recovery (docs/fault-tolerance.md self-healing):
+        the failing device QUARANTINES (the mesh rebuilds on survivors,
+        admission stops pricing the lost chip's HBM), the in-flight query
+        replays ONCE from the plan cache in checked mode (synchronous
+        dispatch: a second loss attributes cleanly), and a failed replay
+        degrades to the CPU oracle through the per-tenant breaker. Every
+        step lands on the flight recorder as structured event rows
+        (deviceResets / checkedReplays / cpuFallbackEvents)."""
+        from spark_rapids_tpu.engine import async_exec as AX
+        from spark_rapids_tpu.engine import retry as R
+        from spark_rapids_tpu.engine.admission import AdmissionController
+        from spark_rapids_tpu.utils import faultinject as FI
+        from spark_rapids_tpu.utils import metrics as M
+
+        M.record_device_reset()
+        before = max(1, TpuDeviceManager.healthy_device_count())
+        healthy = TpuDeviceManager.quarantine_device(reason=str(e))
+        ctl = AdmissionController.get()
+        if ctl is not None:
+            ctl.note_device_loss(healthy, before)
+        log.warning(
+            "device lost (%r): device quarantined (%d healthy remain); "
+            "replaying the query once in checked mode", e, healthy)
+        M.record_checked_replay()
+        # the replay starts clean: fresh retry budget, no stale deferred
+        # sink faults from the dead run
+        self.scheduler.begin_query()
+        FI.clear_deferred()
+        try:
+            with AX.checked_mode():
+                return self._execute_device(plan, use_plan_cache)
+        except Exception as e2:  # noqa: BLE001 — degradation boundary
+            if not (cpu_fallback_ok and R.failure_is_device_rooted(e2)):
+                raise
+            e = e2
+        breaker.record_failure()
+        M.record_cpu_fallback()
+        log.warning("device-loss replay failed too (%r); re-executing the "
+                    "query on the CPU oracle engine", e)
         FI.disable()
         return self._execute_on_cpu(plan, use_plan_cache)
 

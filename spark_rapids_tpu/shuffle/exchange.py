@@ -401,6 +401,9 @@ class _SerializedPiece:
         if self._buf is not None and self._fw is not None:
             try:
                 self._fw.free(self._buf)
+            # tpulint: swallowed-cancellation -- a __del__ must never
+            # raise (the interpreter would just print and drop it), and
+            # finalizer timing is unrelated to the owning query's state
             except Exception:
                 pass
 

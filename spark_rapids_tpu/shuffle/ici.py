@@ -55,14 +55,31 @@ _MESH: Optional[Mesh] = None
 _STAGE_MESHES: dict = {}
 
 
+def _healthy_local_devices() -> list:
+    """All local devices minus quarantined ones (self-healing,
+    docs/fault-tolerance.md): after a device loss the session calls
+    `reset_mesh()` and the next build lands on the survivors only."""
+    from spark_rapids_tpu.memory.device_manager import TpuDeviceManager
+
+    if TpuDeviceManager.quarantined_count():
+        healthy = TpuDeviceManager.healthy_devices()
+        if healthy:
+            return healthy
+        # every local device quarantined: the session is degrading to CPU
+        # anyway, but a replay attempt must not crash building an empty
+        # mesh — fall through to the full set as a last resort
+    return jax.devices()
+
+
 def session_mesh() -> Optional[Mesh]:
-    """The process-wide 1-D mesh over all local devices, or None when only
-    one device is visible (reference: one-GPU-per-executor means the mesh is
-    the executor set; here it is the chip set of this host/pod slice)."""
+    """The process-wide 1-D mesh over all local HEALTHY devices, or None
+    when only one is visible (reference: one-GPU-per-executor means the
+    mesh is the executor set; here it is the chip set of this host/pod
+    slice, minus any quarantined chips)."""
     global _MESH
     with _MESH_LOCK:
         if _MESH is None:
-            devs = jax.devices()
+            devs = _healthy_local_devices()
             if len(devs) > 1:
                 if jax.process_count() > 1:
                     # host-major order keeps intra-host traffic on ICI
@@ -76,7 +93,7 @@ def session_mesh() -> Optional[Mesh]:
                 else:
                     # tpulint: shared-state-mutation -- under _MESH_LOCK
                     # (same build-once singleton)
-                    _MESH = build_mesh()
+                    _MESH = build_mesh(devices=devs)
         return _MESH
 
 
@@ -93,10 +110,11 @@ def stage_mesh(n_devices: int = 0) -> Mesh:
     if n == 0:
         full = session_mesh()
         if full is None:
-            full = build_mesh()
+            full = build_mesh(devices=_healthy_local_devices())
         mesh = full
     else:
-        mesh = build_mesh(min(n, len(jax.devices())))
+        hd = _healthy_local_devices()
+        mesh = build_mesh(devices=hd[:min(n, len(hd))])
     with _MESH_LOCK:
         # tpulint: shared-state-mutation -- under _MESH_LOCK; setdefault
         # keeps the first mesh on a concurrent-build race

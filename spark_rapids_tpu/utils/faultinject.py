@@ -18,6 +18,15 @@ Fault kinds and what they model:
 - transfer  a failed host<->device transfer -> TpuTransientDeviceError
 - fetch     a lost shuffle piece -> FetchFailedError (upstream map
             partition re-execution, then task retry)
+- delay     a straggler: the site sleeps faultInjection.delayMs (cancel-
+            aware) then proceeds NORMALLY — no error raised; the self-
+            healing layer (scheduler speculation) must hide the latency
+- wedge     a hung dispatch: the site blocks until the watchdog
+            (engine/watchdog.py) classifies it wedged, then raises a
+            retryable TpuDispatchWedged (re-dispatch on fresh buffers)
+- device_loss  the backend vanished (restart, ICI peer loss) ->
+            TpuDeviceLostError; never retried in place — the session
+            quarantines the device and replays/degrades (self-healing)
 
 The reference grows the same substrate inside RMM for its retry tests
 (RmmSpark.forceRetryOOM / forceSplitAndRetryOOM injecting OOMs at chosen
@@ -63,7 +72,8 @@ SITES: Dict[str, str] = {
     "cancel.race": "cancel",
 }
 
-KINDS = ("oom", "dispatch", "transfer", "fetch", "cancel")
+KINDS = ("oom", "dispatch", "transfer", "fetch", "cancel",
+         "delay", "wedge", "device_loss")
 
 
 # fault kinds that model a device COMPUTE failure: under async dispatch
@@ -80,10 +90,11 @@ class FaultInjector:
     """Armed sites + the seeded decision function."""
 
     def __init__(self, seed: int, sites_spec: str, rate: float,
-                 defer_to_sink: bool = False):
+                 defer_to_sink: bool = False, delay_ms: float = 400.0):
         self.seed = int(seed)
         self.rate = float(rate)
         self.defer_to_sink = bool(defer_to_sink)
+        self.delay_ms = max(0.0, float(delay_ms))
         self.armed: Dict[str, str] = _parse_sites(sites_spec)
         self._lock = threading.Lock()
         self._invocations: Dict[str, int] = {}
@@ -196,6 +207,7 @@ def configure(tpu_conf: "C.TpuConf", ctx=None) -> Optional[FaultInjector]:
         sites_spec=tpu_conf.get(C.FAULT_INJECTION_SITES),
         rate=tpu_conf.get(C.FAULT_INJECTION_RATE),
         defer_to_sink=tpu_conf.get(C.FAULT_INJECTION_DEFER_TO_SINK),
+        delay_ms=tpu_conf.get(C.FAULT_INJECTION_DELAY_MS),
     )
     _ACTIVE = inj
     if ctx is not None:
@@ -290,6 +302,27 @@ def maybe_inject(site: str) -> None:
         raise TpuQueryCancelled(
             f"[injected] query cancelled racing {site}",
             reason=f"injected at {site}", site=site)
+    if kind == "delay":
+        # a straggler, not an error: sleep (cancel-aware — a deadline or
+        # cancel still wins) and then let the site proceed normally. The
+        # speculation layer's job is to make this latency invisible.
+        from spark_rapids_tpu.engine.cancel import cancel_aware_sleep
+
+        cancel_aware_sleep(inj.delay_ms / 1000.0, site=site)
+        return
+    if kind == "wedge":
+        # a hung dispatch: block until the watchdog classifies this
+        # attempt wedged, then raise the retryable TpuDispatchWedged
+        from spark_rapids_tpu.engine.watchdog import simulate_wedge
+
+        simulate_wedge(site)
+        return
+    if kind == "device_loss":
+        from spark_rapids_tpu.engine.retry import TpuDeviceLostError
+
+        raise TpuDeviceLostError(
+            f"[injected] UNAVAILABLE: device lost at {site} "
+            f"(backend restart / ICI peer loss)")
     if inj.defer_to_sink and kind in _DEFERRABLE_KINDS and \
             site not in SINK_SITES:
         from spark_rapids_tpu.engine.async_exec import async_enabled

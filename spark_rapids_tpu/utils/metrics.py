@@ -127,6 +127,17 @@ SHED_QUERIES = "shedQueries"
 # re-placed onto the host instead of a whole-query CPU fallback)
 HOST_PLACED_OPS = "hostPlacedOps"
 PLACEMENT_REPLACEMENTS = "placementReplacements"
+# self-healing execution (engine/scheduler.py speculation,
+# engine/watchdog.py, memory/device_manager.py quarantine;
+# docs/fault-tolerance.md): speculativeTasks counts straggler duplicates
+# launched, speculativeWins the duplicates that finished first;
+# watchdogKills counts in-flight dispatches the watchdog classified
+# wedged (released for retry or escalated to a query kill); deviceResets
+# counts device-loss events that quarantined a device
+SPECULATIVE_TASKS = "speculativeTasks"
+SPECULATIVE_WINS = "speculativeWins"
+WATCHDOG_KILLS = "watchdogKills"
+DEVICE_RESETS = "deviceResets"
 
 
 class Metric:
@@ -197,7 +208,7 @@ class QueryContext:
                  "resource_report", "retry_policy", "aqe_notes",
                  "spill_plan_hint", "async_dispatch", "donation", "trace",
                  "cancel", "spill_buffers", "prefetchers", "kill_reason",
-                 "placement_payload")
+                 "placement_payload", "predicted_work_ns")
 
     def __init__(self, tenant: str = "default"):
         self.tenant = tenant
@@ -273,6 +284,11 @@ class QueryContext:
         # PlacementReport.to_payload()): the flight recorder persists it
         # and scores placementRegret against the measured wall
         self.placement_payload = None
+        # the admission-time cost-model prediction of THIS query's device
+        # work in ns (0 = no prediction): the scheduler's straggler
+        # speculation and the watchdog's calibrated timeout divide it by
+        # the job's task count to price one task's expected wall
+        self.predicted_work_ns = 0
 
     def add(self, name: str, n: int) -> None:
         with self._lock:
@@ -783,6 +799,61 @@ def record_join_promotion(n: int = 1) -> None:
 
 def join_promotion_count() -> int:
     return _JOIN_PROMOTIONS.value
+
+
+# ---------------------------------------------------------------------------
+# Self-healing accounting (engine/scheduler.py speculation,
+# engine/watchdog.py, memory/device_manager.py quarantine)
+# ---------------------------------------------------------------------------
+_SPECULATIVE_TASKS = Metric(SPECULATIVE_TASKS)
+_SPECULATIVE_WINS = Metric(SPECULATIVE_WINS)
+_WATCHDOG_KILLS = Metric(WATCHDOG_KILLS)
+_DEVICE_RESETS = Metric(DEVICE_RESETS)
+
+
+def record_speculative_task(n: int = 1) -> None:
+    """Count one speculative duplicate launched for a straggling task
+    (an idempotent re-execution from source, never shared buffers)."""
+    _SPECULATIVE_TASKS.add(n)
+    _note(SPECULATIVE_TASKS, n)
+
+
+def speculative_task_count() -> int:
+    return _SPECULATIVE_TASKS.value
+
+
+def record_speculative_win(n: int = 1) -> None:
+    """Count one speculative duplicate that finished before its original
+    (the original was cancelled through its task-scoped token)."""
+    _SPECULATIVE_WINS.add(n)
+    _note(SPECULATIVE_WINS, n)
+
+
+def speculative_win_count() -> int:
+    return _SPECULATIVE_WINS.value
+
+
+def record_watchdog_kill(n: int = 1) -> None:
+    """Count one in-flight dispatch the watchdog classified wedged:
+    released to raise a retryable TpuDispatchWedged, or — past the
+    escalation grace — killed through the owning query's token."""
+    _WATCHDOG_KILLS.add(n)
+    _note(WATCHDOG_KILLS, n)
+
+
+def watchdog_kill_count() -> int:
+    return _WATCHDOG_KILLS.value
+
+
+def record_device_reset(n: int = 1) -> None:
+    """Count one device-loss event (unavailable/reset family): the
+    device quarantined and the session entered recovery."""
+    _DEVICE_RESETS.add(n)
+    _note(DEVICE_RESETS, n)
+
+
+def device_reset_count() -> int:
+    return _DEVICE_RESETS.value
 
 
 @contextlib.contextmanager

@@ -772,3 +772,312 @@ def test_cancel_matrix_q5_all_sites(session, site):
     _run_cancel_at_site(
         session, _tpch_q("q5"), site,
         extra={"rapids.tpu.shuffle.serialize.enabled": True})
+
+
+# ---------------------------------------------------------------------------
+# Self-healing (docs/fault-tolerance.md): straggler speculation, the
+# hung-dispatch watchdog, and device-loss recovery. Everything here is
+# deterministic — injection decisions are pure functions of
+# (seed, site, invocation) and the proven seeds below are pinned.
+# ---------------------------------------------------------------------------
+from spark_rapids_tpu.engine import watchdog as WD  # noqa: E402
+from spark_rapids_tpu.engine.watchdog import DispatchWatchdog  # noqa: E402
+from spark_rapids_tpu.memory.device_manager import TpuDeviceManager  # noqa: E402
+
+
+def test_translate_device_loss_family():
+    # the unavailable/reset family maps to TpuDeviceLostError — a
+    # TRANSIENT subclass (so legacy classifiers still see it as
+    # device-rooted) that the retry ladders hand straight up instead of
+    # re-dispatching in place
+    typed = R.as_typed_error(
+        XlaRuntimeError("INTERNAL: device lost: chip reset"))
+    assert isinstance(typed, R.TpuDeviceLostError)
+    assert isinstance(typed, R.TpuTransientDeviceError)
+    assert R.failure_is_device_loss(typed)
+    wrapped = RuntimeError("task failed")
+    wrapped.__cause__ = typed
+    assert R.failure_is_device_loss(wrapped)
+    assert not R.failure_is_device_loss(RuntimeError("unrelated"))
+
+
+def test_scheduler_speculates_straggler_directly():
+    """Unit-level speculation: partition 3's FIRST attempt naps far past
+    the sibling p95; the harvest launches one speculative duplicate,
+    the duplicate wins, the loser is cancelled through its task token
+    (it wakes from cancel_aware_sleep), and the job's wall stays far
+    under the nap."""
+    sched = TaskScheduler()
+    sched.spec_enabled = True
+    sched.spec_min_runtime_ms = 50.0
+    sched.spec_multiplier = 2.0
+    sched.spec_quantile = 0.5
+    calls = {}
+    mu = threading.Lock()
+
+    def fn(p):
+        with mu:
+            calls[p] = calls.get(p, 0) + 1
+            attempt = calls[p]
+        if p == 3 and attempt == 1:
+            CX.cancel_aware_sleep(5.0, site="unit-straggler")
+        else:
+            time.sleep(0.05)
+        return p * 10
+
+    t0 = time.monotonic()
+    try:
+        res = sched.run_job(8, fn)
+        wall = time.monotonic() - t0
+    finally:
+        sched.shutdown()
+    assert res == [p * 10 for p in range(8)]
+    assert calls[3] == 2  # original + exactly one speculative duplicate
+    assert wall < 3.0     # the 5s nap never gates the job
+    CX.assert_reclaimed()
+
+
+def test_watchdog_tier1_releases_silent_entry():
+    # a registration silent past its timeout is classified wedged: its
+    # cooperative release Event fires and the site lands in telemetry
+    wd = DispatchWatchdog(timeout_ms=40.0, poll_ms=10.0)
+    old = DispatchWatchdog._instance
+    DispatchWatchdog._instance = wd
+    try:
+        entry = WD.register("unit.wedge")
+        assert entry is not None
+        assert entry.released.wait(timeout=3.0)
+        assert wd.wedged_sites().get("unit.wedge") == 1
+        WD.deregister(entry)
+        assert wd.inflight_count() == 0
+    finally:
+        DispatchWatchdog._instance = old
+        wd._stop.set()
+
+
+def test_watchdog_tier2_escalates_to_query_token():
+    # an entry STILL silent at 2x its timeout with no wait-point picking
+    # up the release gets its owning query's token fired
+    wd = DispatchWatchdog(timeout_ms=30.0, poll_ms=10.0)
+    old = DispatchWatchdog._instance
+    DispatchWatchdog._instance = wd
+    try:
+        entry = WD.register("unit.stuck")
+        tok = CX.CancelToken()
+        entry.token = tok
+        deadline = time.monotonic() + 3.0
+        while not tok.cancelled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert tok.cancelled
+        assert "watchdog" in (tok.reason or "")
+        WD.deregister(entry)
+    finally:
+        DispatchWatchdog._instance = old
+        wd._stop.set()
+
+
+def test_watchdog_timeout_ladder():
+    # conf override > calibrated multiple of the predicted task wall >
+    # cold-start default (no ambient query context here, so the middle
+    # rung is exercised by the e2e cases)
+    wd = DispatchWatchdog(timeout_ms=0.0, poll_ms=50.0)
+    assert wd._entry_timeout_ms() == 30000.0
+    wd.timeout_ms = 123.0
+    assert wd._entry_timeout_ms() == 123.0
+
+
+# -- circuit breaker half-open recovery --------------------------------------
+def test_circuit_breaker_half_open_probe_success_closes():
+    br = R.CircuitBreaker(enabled=True, threshold=2, cooldown_ms=30.0,
+                          probe_queries=1)
+    assert not br.record_failure()
+    assert br.record_failure()  # hits threshold: opens
+    assert br.state() == "open" and br.is_open()
+    time.sleep(0.05)            # cooldown elapses
+    assert br.state() == "half_open"
+    assert not br.is_open()     # a probe slot admits one device query
+    br.note_probe()
+    assert br.is_open()         # slots exhausted until the verdict
+    br.note_success()
+    assert br.state() == "closed"
+    assert br.failures == 0
+    assert br.transitions() == {"opened": 1, "half_opened": 1,
+                                "closed": 1}
+
+
+def test_circuit_breaker_half_open_probe_failure_reopens():
+    br = R.CircuitBreaker(enabled=True, threshold=1, cooldown_ms=30.0)
+    assert br.record_failure()
+    time.sleep(0.05)
+    assert br.state() == "half_open"
+    br.note_probe()
+    assert br.record_failure()  # the probe failed: re-open, new cooldown
+    assert br.state() == "open"
+    assert br.transitions()["opened"] == 2
+    time.sleep(0.05)
+    assert br.state() == "half_open"  # ...and the cycle can repeat
+
+
+def test_circuit_breaker_latch_mode_ignores_success():
+    # cooldown_ms=0 keeps the pre-r18 contract: open until session stop
+    br = R.CircuitBreaker(enabled=True, threshold=1, cooldown_ms=0.0)
+    assert br.record_failure()
+    br.note_success()
+    assert br.state() == "open" and br.is_open()
+    assert br.transitions()["closed"] == 0
+
+
+# -- end-to-end: the three fault kinds against the oracle --------------------
+def _self_heal_conf(seed, sites, rate, **extra):
+    conf = {
+        **_chaos_conf(seed, sites, rate),
+        # route DeviceToHostExec through run_job (the speculative
+        # harvest); the default lifted-sink path stays pinned by the
+        # flagship fence tests
+        "rapids.tpu.engine.taskTimeoutSeconds": 120.0,
+    }
+    conf.update(extra)
+    return conf
+
+
+@pytest.mark.slow  # timed A/B walls: protects the tier-1 dots window
+def test_speculation_cuts_straggler_wall(session):
+    """The acceptance pin: one injected 3s delay on one of 16 q1 tasks.
+    Without speculation the job wall eats the whole delay; with it the
+    duplicate wins and the wall collapses. Seed 24 at rate 0.07 hits
+    exactly one agg.update invocation (of 16)."""
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
+    df = _tpch_q("q1", num_partitions=16)
+    cpu = run_on_cpu(session, df)
+    # warm the compile caches: cold XLA compiles (~seconds/task) would
+    # contaminate the sibling-duration priors AND both timed walls
+    run_on_tpu(session, df, extra_conf={
+        "rapids.tpu.engine.taskTimeoutSeconds": 120.0})
+    delay_conf = _self_heal_conf(
+        24, "agg.update:delay", 0.07,
+        **{"rapids.tpu.test.faultInjection.delayMs": 3000.0,
+           "rapids.tpu.engine.speculation.minRuntimeMs": 50.0,
+           "rapids.tpu.engine.speculation.multiplier": 3.0})
+    t0 = time.monotonic()
+    tpu_off = run_on_tpu(session, df, extra_conf={
+        **delay_conf, "rapids.tpu.engine.speculation.enabled": False})
+    wall_off = time.monotonic() - t0
+    assert session.last_query_metrics["speculativeTasks"] == 0
+    t0 = time.monotonic()
+    tpu_spec = run_on_tpu(session, df, extra_conf=delay_conf)
+    wall_spec = time.monotonic() - t0
+    m = session.last_query_metrics
+    assert_rows_equal(cpu, tpu_off, ignore_order=True, approx_float=1e-9)
+    assert_rows_equal(cpu, tpu_spec, ignore_order=True, approx_float=1e-9)
+    assert m["speculativeTasks"] >= 1
+    assert m["speculativeWins"] >= 1
+    # observed ~15x; pin a conservative floor so CI noise cannot flake it
+    assert wall_off / wall_spec >= 2.0, (wall_off, wall_spec)
+    CX.assert_reclaimed()
+
+
+@pytest.mark.slow  # protects the tier-1 dots window
+def test_watchdog_releases_wedged_dispatch(session):
+    """Speculation disabled: the wedged agg.update dispatch is released
+    by the watchdog (tier 1), raises retryable TpuDispatchWedged, and
+    the retry combinators re-dispatch — oracle-equal, nothing leaked."""
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
+    df = _tpch_q("q1")
+    cpu = run_on_cpu(session, df)
+    # warm compiles: an unwarmed multi-second compile under a tight
+    # timeout would look wedged and trip tier-2 escalation
+    run_on_tpu(session, df)
+    tpu = run_on_tpu(session, df, extra_conf=_self_heal_conf(
+        3, "agg.update:wedge", 0.2,
+        **{"rapids.tpu.engine.speculation.enabled": False,
+           "rapids.tpu.engine.watchdog.dispatchTimeoutMs": 800.0,
+           "rapids.tpu.engine.watchdog.pollMs": 20.0}))
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    m = session.last_query_metrics
+    assert m["watchdogKills"] >= 1
+    assert m["retries"] >= 1
+    assert m["cpuFallbackEvents"] == 0
+    CX.assert_reclaimed()
+
+
+@pytest.mark.slow  # protects the tier-1 dots window
+def test_speculation_absorbs_wedged_task(session):
+    """Speculation enabled: the duplicate of the wedged task wins the
+    race, so the query's wall never waits for the watchdog timeout."""
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
+    df = _tpch_q("q1")
+    cpu = run_on_cpu(session, df)
+    run_on_tpu(session, df)  # warm compiles
+    tpu = run_on_tpu(session, df, extra_conf=_self_heal_conf(
+        3, "agg.update:wedge", 0.2,
+        **{"rapids.tpu.engine.speculation.minRuntimeMs": 50.0,
+           "rapids.tpu.engine.speculation.multiplier": 3.0,
+           "rapids.tpu.engine.watchdog.dispatchTimeoutMs": 800.0,
+           "rapids.tpu.engine.watchdog.pollMs": 20.0}))
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    m = session.last_query_metrics
+    assert m["speculativeWins"] >= 1
+    CX.assert_reclaimed()
+
+
+@pytest.mark.slow  # protects the tier-1 dots window
+def test_device_loss_quarantines_and_replays(session):
+    """An injected device loss at agg.update: the task ladder hands the
+    loss up (never re-dispatches in place), the session quarantines the
+    device, rebuilds the mesh on survivors, and replays the query once
+    from the plan cache in checked mode — oracle-equal, no CPU rung."""
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
+    df = _tpch_q("q1")
+    cpu = run_on_cpu(session, df)
+    assert TpuDeviceManager.quarantined_count() == 0
+    tpu = run_on_tpu(session, df, extra_conf=_self_heal_conf(
+        5, "agg.update:device_loss", 0.2))
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    m = session.last_query_metrics
+    assert m["deviceResets"] == 1
+    assert m["checkedReplays"] >= 1
+    assert m["cpuFallbackEvents"] == 0
+    assert TpuDeviceManager.quarantined_count() == 1
+    CX.assert_reclaimed()
+
+
+@pytest.mark.slow  # heavy chaos combination: protects the tier-1 dots window
+@pytest.mark.parametrize("kind", ["delay", "wedge", "device_loss"])
+@pytest.mark.parametrize("qname,seed", [("q1", 3), ("q1", 5), ("q5", 3)])
+def test_chaos_self_healing_matrix(session, qname, seed, kind):
+    """The new fault kinds against the oracle: whatever combination of
+    speculation, watchdog release, and device-loss recovery fires, the
+    query completes, equals the CPU oracle, and reclaims everything."""
+    session.conf.set("rapids.tpu.sql.spmd.enabled", False)
+    df = _tpch_q(qname)
+    cpu = run_on_cpu(session, df)
+    run_on_tpu(session, df)  # warm compiles (see the wedge test above)
+    tpu = run_on_tpu(session, df, extra_conf=_self_heal_conf(
+        seed, f"agg.update:{kind},sort:{kind}", 0.2,
+        **{"rapids.tpu.test.faultInjection.delayMs": 200.0,
+           "rapids.tpu.engine.speculation.minRuntimeMs": 50.0,
+           "rapids.tpu.engine.watchdog.dispatchTimeoutMs": 800.0,
+           "rapids.tpu.engine.watchdog.pollMs": 20.0}))
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=1e-9)
+    CX.assert_reclaimed()
+
+
+def test_no_injection_zero_self_healing_events(session):
+    """The do-no-harm half of the acceptance criterion: with no fault
+    injected, the self-healing machinery is pure observation — zero
+    speculative tasks, zero watchdog kills, zero device resets, and the
+    flagship dispatch/fence counters identical to a run with the whole
+    subsystem disabled."""
+    base = run_on_tpu(session, _tpch_q("q1"))
+    m_on = dict(session.last_query_metrics)
+    off = run_on_tpu(session, _tpch_q("q1"), extra_conf={
+        "rapids.tpu.engine.speculation.enabled": False,
+        "rapids.tpu.engine.watchdog.enabled": False,
+    })
+    m_off = dict(session.last_query_metrics)
+    assert_rows_equal(base, off, ignore_order=True, approx_float=1e-9)
+    for k in ("speculativeTasks", "speculativeWins", "watchdogKills",
+              "deviceResets", "checkedReplays"):
+        assert m_on.get(k, 0) == 0, (k, m_on)
+    for k in ("deviceDispatches", "fencesPerQuery"):
+        assert m_on.get(k) == m_off.get(k), (k, m_on, m_off)

@@ -439,6 +439,37 @@ def test_fit_excludes_killed_query_records():
     assert model.overhead_samples == 6
 
 
+def test_fit_excludes_self_healed_records():
+    """A self-healed run's measured walls include killed and raced
+    attempts (speculation losers, watchdog-released wedges, a
+    device-loss replay): obs/history.py tags the record self_healed and
+    the calibrator keeps it out of the per-class fits, exactly like
+    host runs (the is_host_run precedent)."""
+    healed_rec = OH.build_record(
+        "q-sh", "default", "ok", None, int(5e6),
+        {"speculativeTasks": 1, "speculativeWins": 1}, None, None, [])
+    assert healed_rec.get("self_healed") is True
+    for counter in ("watchdogKills", "deviceResets"):
+        rec = OH.build_record("q-sh2", "default", "ok", None, int(5e6),
+                              {counter: 1}, None, None, [])
+        assert rec.get("self_healed") is True, counter
+    clean_rec = OH.build_record(
+        "q-ok", "default", "ok", None, int(5e6),
+        {"deviceDispatches": 4}, None, None, [])
+    assert "self_healed" not in clean_rec
+    good = {"status": "ok",
+            "classes": {"agg": {"wall_ns": 1e6, "dispatches": 2,
+                                "rows": 0, "bytes": 0}}}
+    healed = {"status": "ok", "self_healed": True,
+              "classes": {"agg": {"wall_ns": 9e9, "dispatches": 2,
+                                  "rows": 0, "bytes": 0}}}
+    model = CAL.fit([dict(good) for _ in range(6)]
+                    + [dict(healed) for _ in range(6)])
+    cc = model.coeffs["agg"]
+    assert cc.samples == 6
+    assert cc.ns_per_dispatch == 0.5e6
+
+
 def test_fit_ignores_malformed_records():
     recs = [{"classes": {"sort": {"wall_ns": 5e6, "dispatches": 2,
                                   "rows": 0, "bytes": 0}}},

@@ -922,3 +922,128 @@ def test_uncancellable_wait_pragma_suppresses():
            "query can exist yet\n"
            "    time.sleep(0.5)\n")
     assert lint(src, path=ENGINE) == []
+
+
+# ---------------------------------------------------------------------------
+# swallowed-cancellation (engine/cancel.py, docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+def test_swallowed_cancellation_named_catch_flagged_in_scope():
+    src = ("from spark_rapids_tpu.engine.cancel import TpuQueryCancelled\n\n"
+           "def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except TpuQueryCancelled:\n"
+           "        return None\n")
+    for path in (ENGINE, HOT, "spark_rapids_tpu/aqe/fake.py",
+                 "spark_rapids_tpu/shuffle/fake.py"):
+        got = lint(src, path=path)
+        assert "swallowed-cancellation" in rules_of(got), path
+        assert [f.line for f in got
+                if f.rule == "swallowed-cancellation"] == [6], path
+
+
+def test_swallowed_cancellation_broad_and_bare_catch_flagged():
+    src = ("def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except Exception:\n"
+           "        return None\n\n"
+           "def g(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except BaseException:\n"
+           "        pass\n\n"
+           "def h(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except:\n"
+           "        pass\n")
+    got = [f for f in lint(src, path=ENGINE)
+           if f.rule == "swallowed-cancellation"]
+    assert [f.line for f in got] == [4, 10, 16]
+
+
+def test_swallowed_cancellation_reraise_and_guard_idiom_allowed():
+    src = ("from spark_rapids_tpu.engine import cancel as CX\n\n"
+           "def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except Exception as e:\n"
+           "        if CX.is_cancellation(e):\n"
+           "            raise\n"
+           "        return None\n\n"
+           "def g(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except CX.TpuQueryCancelled:\n"
+           "        raise\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_swallowed_cancellation_is_cancellation_function_exempt():
+    # a function that routes failures through the classifier ANYWHERE
+    # (the scheduler's speculative harvest stores exceptions and
+    # classifies them later) is trusted to re-raise
+    src = ("from spark_rapids_tpu.engine.cancel import is_cancellation\n\n"
+           "def harvest(run):\n"
+           "    failures = []\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except Exception as e:\n"
+           "        failures.append(e)\n"
+           "    for e in failures:\n"
+           "        if is_cancellation(e):\n"
+           "            raise e\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_swallowed_cancellation_prior_reraising_clause_shields():
+    # the aqe/loop.py idiom: an earlier clause catches TpuQueryCancelled
+    # and re-raises, so the broad degradation clause below can never
+    # observe a cancellation
+    src = ("from spark_rapids_tpu.engine import cancel as CX\n\n"
+           "def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except (CX.TpuQueryCancelled, CX.TpuOverloadedError):\n"
+           "        raise\n"
+           "    except Exception:\n"
+           "        return None\n")
+    assert lint(src, path=ENGINE) == []
+
+
+def test_swallowed_cancellation_nested_def_raise_does_not_count():
+    # a raise inside a nested def runs later (if ever) — it does not
+    # re-raise the caught cancellation
+    src = ("def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except Exception:\n"
+           "        def again():\n"
+           "            raise RuntimeError('later')\n"
+           "        return again\n")
+    got = [f for f in lint(src, path=ENGINE)
+           if f.rule == "swallowed-cancellation"]
+    assert [f.line for f in got] == [4]
+
+
+def test_swallowed_cancellation_not_flagged_outside_scope():
+    src = ("def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    except Exception:\n"
+           "        return None\n")
+    assert lint(src, path=COLD) == []
+    assert lint(src, path="spark_rapids_tpu/io/fake.py") == []
+    assert lint(src, path="spark_rapids_tpu/utils/fake.py") == []
+
+
+def test_swallowed_cancellation_pragma_suppresses():
+    src = ("def f(run):\n"
+           "    try:\n"
+           "        return run()\n"
+           "    # tpulint: swallowed-cancellation -- best-effort "
+           "cleanup, nothing to propagate\n"
+           "    except Exception:\n"
+           "        return None\n")
+    assert lint(src, path=ENGINE) == []

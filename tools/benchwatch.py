@@ -46,7 +46,7 @@ _TRAJECTORY_RE = re.compile(r"BENCH_r(\d+)\.json$")
 # ratio itself improves upward
 _HIGHER_BETTER_NAME = re.compile(r"(?i)(speedup|throughput|_x$)")
 _LOWER_BETTER_NAME = re.compile(
-    r"(?i)(overhead|latency|seconds|wall|p95|p99|_s$|_ms$|_ns$)")
+    r"(?i)(overhead|latency|seconds|wall|recovery|p95|p99|_s$|_ms$|_ns$)")
 _LOWER_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "ns"}
 
 

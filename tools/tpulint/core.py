@@ -96,6 +96,20 @@ the line above; `-- reason` after the rule names documents the waiver):
               / CancelToken.wait / check_cancel polling loops) or give
               the wait a timeout and poll; a genuinely uninterruptible
               site carries a justified pragma.
+  swallowed-cancellation  an except clause in the cancellation
+              propagation layers (engine/, exec/, aqe/, shuffle/) that
+              can absorb TpuQueryCancelled / TpuDeadlineExceeded — it
+              names them directly, or catches a broad base (Exception /
+              BaseException / bare except) — without any `raise` in its
+              body. Cancellation is TERMINAL by contract
+              (docs/fault-tolerance.md): no retry, no fallback, no
+              partial rows — an except that eats it turns a cancelled
+              query into a silently wrong one and strands reclamation.
+              Re-raise (the `if CX.is_cancellation(e): raise` guard is
+              the idiom), narrow the except, or — for a handler whose
+              enclosing function routes the failure through
+              is_cancellation elsewhere — nothing: such functions are
+              exempt. A deliberate absorb carries a justified pragma.
   naked-timer  a direct wall-clock read (time.monotonic / time.time /
               time.perf_counter and their _ns variants, or the bare
               imported names) in the engine's timed layers (exec/,
@@ -145,6 +159,7 @@ RULES = (
     "naked-dispatch",
     "naked-timer",
     "uncancellable-wait",
+    "swallowed-cancellation",
     "naked-thread",
     "shared-state-mutation",
     "eager-materialize",
@@ -301,6 +316,19 @@ def is_cancel_wait_scope(path: str) -> bool:
             or "spark_rapids_tpu/aqe/" in p
             or "spark_rapids_tpu/shuffle/" in p
             or _is_observatory_module(p))
+
+
+def is_cancel_catch_scope(path: str) -> bool:
+    """Files bound by the swallowed-cancellation rule: the layers whose
+    except clauses sit between a cancellation raise and the session's
+    terminal handling of it — the engine's combinators and scheduler,
+    the executors, the adaptive runtime, and the shuffle. (io/ waits are
+    covered by uncancellable-wait; its excepts re-raise structurally.)"""
+    p = _norm(path)
+    return ("spark_rapids_tpu/engine/" in p
+            or "spark_rapids_tpu/exec/" in p
+            or "spark_rapids_tpu/aqe/" in p
+            or "spark_rapids_tpu/shuffle/" in p)
 
 
 def is_thread_scope(path: str) -> bool:
@@ -575,6 +603,58 @@ class _TraceIndex:
 # ---------------------------------------------------------------------------
 # Pass 2: rule visitor
 # ---------------------------------------------------------------------------
+# cancellation types the swallowed-cancellation rule protects, and the
+# broad bases that catch them incidentally
+_CANCEL_EXC_NAMES = {"TpuQueryCancelled", "TpuDeadlineExceeded"}
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _handler_exc_names(type_node) -> Set[str]:
+    """Rightmost names of every exception class an except clause lists
+    (handles `except E`, `except m.E`, `except (A, B)`)."""
+    if type_node is None:
+        return set()
+    elts = (type_node.elts if isinstance(type_node, ast.Tuple)
+            else [type_node])
+    out: Set[str] = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+def _body_raises(body) -> bool:
+    """Whether a handler body contains a `raise` that runs IN the
+    handler (raises inside nested defs/lambdas execute later, if ever,
+    and do not re-raise the caught cancellation)."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _refs_is_cancellation(fn) -> bool:
+    """Whether a function consults the cancellation classifier
+    (`is_cancellation`, engine/cancel.py) anywhere in its body — such
+    functions route caught failures by class explicitly (the scheduler's
+    speculative harvest is the template) and are exempt from the
+    swallowed-cancellation rule."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name) and n.id == "is_cancellation":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "is_cancellation":
+            return True
+    return False
+
+
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, trace: _TraceIndex,
                  conf_keys: Optional["ConfKeyIndex"],
@@ -590,6 +670,7 @@ class _Visitor(ast.NodeVisitor):
         self.midquery = is_mid_query_scope(path)
         self.timer_scope = is_timer_scope(path)
         self.cancel_scope = is_cancel_wait_scope(path)
+        self.cancel_catch_scope = is_cancel_catch_scope(path)
         self.thread_scope = is_thread_scope(path)
         self.shared_scope = is_shared_state_scope(path)
         # spans of functions that snapshot contextvars (naked-thread rule)
@@ -612,6 +693,14 @@ class _Visitor(ast.NodeVisitor):
         # combinators (naked-dispatch rule; collected by _retry_guarded)
         self._retry_names: Set[str] = retry_names or set()
         self._retry_lambdas: Set[int] = retry_lambdas or set()
+        # swallowed-cancellation: per-scope "routes failures through
+        # is_cancellation" flags (parallel to self.scope) — a function
+        # that consults the classifier anywhere is trusted to re-raise
+        self._cancel_aware: List[bool] = []
+        # handlers shielded by an earlier sibling clause that catches
+        # TpuQueryCancelled and re-raises (the aqe/loop.py idiom): a
+        # broad clause after it can never see a cancellation
+        self._cancel_covered: Set[int] = set()
         self.findings: List[Finding] = []
 
     # -- helpers -------------------------------------------------------------
@@ -648,12 +737,15 @@ class _Visitor(ast.NodeVisitor):
         self.scope.append(name)
         self.scope_kinds.append(kind)
         self._global_decls.append(set())
+        self._cancel_aware.append(
+            kind == "func" and _refs_is_cancellation(node))
         for child in ast.iter_child_nodes(node):
             if child not in getattr(node, "decorator_list", ()):
                 self.visit(child)
         self.scope.pop()
         self.scope_kinds.pop()
         self._global_decls.pop()
+        self._cancel_aware.pop()
 
     def visit_FunctionDef(self, node):
         self._visit_scoped(node, node.name, "func")
@@ -673,10 +765,54 @@ class _Visitor(ast.NodeVisitor):
         self.scope.append(label)
         self.scope_kinds.append("func")
         self._global_decls.append(set())
+        self._cancel_aware.append(False)
         self.generic_visit(node)
         self.scope.pop()
         self.scope_kinds.pop()
         self._global_decls.pop()
+        self._cancel_aware.pop()
+
+    # -- swallowed-cancellation ----------------------------------------------
+    def visit_Try(self, node):
+        # an earlier clause catching TpuQueryCancelled (the superclass —
+        # it covers TpuDeadlineExceeded too) that re-raises shields every
+        # LATER clause of the same try: they can never see a cancellation
+        covered = False
+        for h in node.handlers:
+            if covered:
+                self._cancel_covered.add(id(h))
+            elif ("TpuQueryCancelled" in _handler_exc_names(h.type)
+                    and _body_raises(h.body)):
+                covered = True
+        self.generic_visit(node)
+
+    visit_TryStar = visit_Try
+
+    def visit_ExceptHandler(self, node):
+        if (self.cancel_catch_scope
+                and id(node) not in self._cancel_covered
+                and not any(self._cancel_aware)
+                and not _body_raises(node.body)):
+            names = _handler_exc_names(node.type)
+            caught = names & _CANCEL_EXC_NAMES
+            broad = node.type is None or bool(names & _BROAD_EXC_NAMES)
+            if caught:
+                what = "/".join(sorted(caught))
+                self._flag(
+                    node, "swallowed-cancellation",
+                    f"except catches {what} without re-raising: "
+                    "cancellation is terminal by contract "
+                    "(docs/fault-tolerance.md) — absorbing it returns "
+                    "partial state as if the query succeeded")
+            elif broad:
+                self._flag(
+                    node, "swallowed-cancellation",
+                    "broad except with no raise in a cancellation "
+                    "propagation layer can swallow TpuQueryCancelled / "
+                    "TpuDeadlineExceeded: re-raise via the "
+                    "`if CX.is_cancellation(e): raise` guard, narrow "
+                    "the except, or pragma a deliberate absorb")
+        self.generic_visit(node)
 
     # -- shared-state-mutation rule ------------------------------------------
     def visit_Global(self, node: ast.Global):
